@@ -1,0 +1,133 @@
+"""Layered user configuration.
+
+Parity: /root/reference/sky/skypilot_config.py:1-259 (YAML config loaded at
+import, `get_nested` with task-level override keys, jsonschema validation).
+Config file: ``$SKYTPU_HOME/config.yaml`` (env override ``SKYTPU_CONFIG``).
+"""
+from __future__ import annotations
+
+import copy
+import os
+import threading
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import common_utils
+
+# Keys a task YAML's `experimental.config_overrides` may override.
+OVERRIDEABLE_CONFIG_KEYS: Tuple[Tuple[str, ...], ...] = (
+    ('gcp', 'labels'),
+    ('gcp', 'managed_instance_group'),
+    ('tpu', 'runtime_version'),
+    ('tpu', 'provision_mode'),
+    ('jobs', 'controller', 'resources'),
+    ('serve', 'controller', 'resources'),
+    ('nvidia_gpus', 'disable'),
+)
+
+_lock = threading.Lock()
+_dict: Optional[Dict[str, Any]] = None
+_loaded_path: Optional[str] = None
+
+
+def _config_path() -> str:
+    env = os.environ.get('SKYTPU_CONFIG')
+    if env:
+        return os.path.expanduser(env)
+    return os.path.join(common_utils.skytpu_home(), 'config.yaml')
+
+
+def _validate(config: Dict[str, Any], path: str) -> None:
+    try:
+        import jsonschema  # pylint: disable=import-outside-toplevel
+    except ImportError:
+        return
+    from skypilot_tpu.utils import schemas  # pylint: disable=import-outside-toplevel
+    try:
+        jsonschema.validate(config, schemas.get_config_schema())
+    except jsonschema.ValidationError as e:
+        raise exceptions.InvalidSkyTpuConfigError(
+            f'Invalid config {path}: {e.message}') from e
+
+
+def _load() -> Dict[str, Any]:
+    global _dict, _loaded_path
+    path = _config_path()
+    with _lock:
+        if _dict is not None and _loaded_path == path:
+            return _dict
+        if os.path.exists(path):
+            config = common_utils.read_yaml(path)
+            _validate(config, path)
+            _dict = config
+        else:
+            _dict = {}
+        _loaded_path = path
+        return _dict
+
+
+def reload_config() -> None:
+    """Drop the cache; next access re-reads from disk (used by tests/CLI)."""
+    global _dict, _loaded_path
+    with _lock:
+        _dict = None
+        _loaded_path = None
+
+
+def loaded() -> bool:
+    return bool(_load())
+
+
+def get_nested(keys: Iterable[str],
+               default_value: Any = None,
+               override_configs: Optional[Dict[str, Any]] = None) -> Any:
+    """Fetch config[k0][k1]... with optional per-task overrides applied."""
+    config = copy.deepcopy(_load())
+    if override_configs:
+        config = _recursive_update(config, override_configs,
+                                   allowed=OVERRIDEABLE_CONFIG_KEYS)
+    cur = config
+    for k in keys:
+        if not isinstance(cur, dict) or k not in cur:
+            return default_value
+        cur = cur[k]
+    return cur
+
+
+def set_nested(keys: Iterable[str], value: Any) -> None:
+    """In-memory override (tests / controller-side mutation)."""
+    config = _load()
+    with _lock:
+        cur = config
+        keys = list(keys)
+        for k in keys[:-1]:
+            cur = cur.setdefault(k, {})
+        cur[keys[-1]] = value
+
+
+def to_dict() -> Dict[str, Any]:
+    return copy.deepcopy(_load())
+
+
+def _recursive_update(base: Dict[str, Any], overrides: Dict[str, Any],
+                      allowed: Tuple[Tuple[str, ...], ...],
+                      prefix: Tuple[str, ...] = ()) -> Dict[str, Any]:
+    for k, v in overrides.items():
+        key_path = prefix + (k,)
+        permitted = any(key_path == a[:len(key_path)] for a in allowed)
+        if not permitted:
+            raise exceptions.InvalidSkyTpuConfigError(
+                f'Config key {".".join(key_path)} may not be overridden by a '
+                f'task. Overridable keys: '
+                f'{[".".join(a) for a in OVERRIDEABLE_CONFIG_KEYS]}')
+        is_prefix_of_longer = any(
+            len(a) > len(key_path) and a[:len(key_path)] == key_path
+            for a in allowed)
+        if isinstance(v, dict) and is_prefix_of_longer:
+            sub = base.get(k)
+            if not isinstance(sub, dict):
+                sub = {}
+            base[k] = _recursive_update(sub, v, allowed, key_path)
+        else:
+            base[k] = v
+    return base

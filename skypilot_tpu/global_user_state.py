@@ -1,0 +1,386 @@
+"""Client-side sqlite state store.
+
+Parity: /root/reference/sky/global_user_state.py:34-139 (tables: clusters with
+pickled handle/status/autostop/owner-identity, cluster_history for cost
+report, storage, enabled_clouds) — extended with a `queued_requests` notion
+folded into cluster status (WAITING) for async TPU queued-resources.
+
+DB path: $SKYTPU_HOME/state.db. All accessors open a short-lived connection;
+sqlite's locking is the only concurrency control, as in the reference.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import sqlite3
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import status_lib
+from skypilot_tpu.utils import common_utils
+
+_CREATE_TABLES = """\
+CREATE TABLE IF NOT EXISTS clusters (
+    name TEXT PRIMARY KEY,
+    launched_at INTEGER,
+    handle BLOB,
+    last_use TEXT,
+    status TEXT,
+    autostop INTEGER DEFAULT -1,
+    to_down INTEGER DEFAULT 0,
+    metadata TEXT DEFAULT '{}',
+    owner TEXT DEFAULT null,
+    cluster_hash TEXT DEFAULT null,
+    storage_mounts_metadata BLOB DEFAULT null,
+    cluster_ever_up INTEGER DEFAULT 0);
+CREATE TABLE IF NOT EXISTS cluster_history (
+    cluster_hash TEXT PRIMARY KEY,
+    name TEXT,
+    num_nodes INTEGER,
+    requested_resources BLOB,
+    launched_resources BLOB,
+    usage_intervals BLOB);
+CREATE TABLE IF NOT EXISTS storage (
+    name TEXT PRIMARY KEY,
+    launched_at INTEGER,
+    handle BLOB,
+    last_use TEXT,
+    status TEXT);
+CREATE TABLE IF NOT EXISTS enabled_clouds (
+    name TEXT PRIMARY KEY);
+"""
+
+
+def _db_path() -> str:
+    home = common_utils.ensure_dir(common_utils.skytpu_home())
+    return os.path.join(home, 'state.db')
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn.executescript(_CREATE_TABLES)
+    return conn
+
+
+# ---------------------------------------------------------------- clusters
+
+
+def add_or_update_cluster(cluster_name: str,
+                          cluster_handle: Any,
+                          requested_resources: Optional[set],
+                          ready: bool,
+                          is_launch: bool = True) -> None:
+    """Record a cluster in INIT (not ready) or UP (ready) state."""
+    status = (status_lib.ClusterStatus.UP
+              if ready else status_lib.ClusterStatus.INIT)
+    handle = pickle.dumps(cluster_handle)
+    now = int(time.time())
+    cluster_hash = _get_hash_for_existing_cluster(cluster_name) or str(
+        uuid.uuid4())
+    usage_intervals = _get_cluster_usage_intervals(cluster_hash) or []
+    if ready and (not usage_intervals or usage_intervals[-1][1] is not None):
+        usage_intervals.append((now, None))
+    with _conn() as conn:
+        conn.execute(
+            'INSERT INTO clusters (name, launched_at, handle, last_use, '
+            'status, autostop, to_down, metadata, owner, cluster_hash, '
+            'cluster_ever_up) '
+            'VALUES (?, ?, ?, ?, ?, -1, 0, ?, null, ?, ?) '
+            'ON CONFLICT(name) DO UPDATE SET '
+            'handle=excluded.handle, status=excluded.status, '
+            'launched_at=CASE WHEN ? THEN excluded.launched_at '
+            '            ELSE clusters.launched_at END, '
+            'last_use=excluded.last_use, '
+            'cluster_ever_up=clusters.cluster_ever_up OR excluded.cluster_ever_up',
+            (cluster_name, now, handle, _last_use(), status.value, '{}',
+             cluster_hash, int(ready), int(is_launch)))
+        if requested_resources is not None:
+            launched = getattr(cluster_handle, 'launched_resources', None)
+            num_nodes = getattr(cluster_handle, 'launched_nodes', None)
+            conn.execute(
+                'INSERT INTO cluster_history (cluster_hash, name, num_nodes, '
+                'requested_resources, launched_resources, usage_intervals) '
+                'VALUES (?, ?, ?, ?, ?, ?) '
+                'ON CONFLICT(cluster_hash) DO UPDATE SET '
+                'num_nodes=excluded.num_nodes, '
+                'requested_resources=excluded.requested_resources, '
+                'launched_resources=excluded.launched_resources, '
+                'usage_intervals=excluded.usage_intervals',
+                (cluster_hash, cluster_name, num_nodes,
+                 pickle.dumps(requested_resources), pickle.dumps(launched),
+                 pickle.dumps(usage_intervals)))
+
+
+def _last_use() -> str:
+    import sys  # pylint: disable=import-outside-toplevel
+    return ' '.join([os.path.basename(sys.argv[0])] + sys.argv[1:])[:256]
+
+
+def update_cluster_handle(cluster_name: str, cluster_handle: Any) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE clusters SET handle=? WHERE name=?',
+                     (pickle.dumps(cluster_handle), cluster_name))
+
+
+def set_cluster_status(cluster_name: str,
+                       status: status_lib.ClusterStatus) -> None:
+    with _conn() as conn:
+        cur = conn.execute('UPDATE clusters SET status=? WHERE name=?',
+                           (status.value, cluster_name))
+        if cur.rowcount == 0:
+            raise ValueError(f'Cluster {cluster_name} not found.')
+    if status == status_lib.ClusterStatus.STOPPED:
+        _close_usage_interval(cluster_name)
+
+
+def set_cluster_autostop_value(cluster_name: str, idle_minutes: int,
+                               to_down: bool) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE clusters SET autostop=?, to_down=? WHERE name=?',
+                     (idle_minutes, int(to_down), cluster_name))
+
+
+def get_cluster_from_name(cluster_name: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute('SELECT * FROM clusters WHERE name=?',
+                           (cluster_name,)).fetchone()
+        if row is None:
+            return None
+        cols = [d[0] for d in conn.execute(
+            'SELECT * FROM clusters LIMIT 0').description]
+    return _row_to_record(dict(zip(cols, row)))
+
+
+def _row_to_record(r: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        'name': r['name'],
+        'launched_at': r['launched_at'],
+        'handle': pickle.loads(r['handle']) if r['handle'] else None,
+        'last_use': r['last_use'],
+        'status': status_lib.ClusterStatus(r['status']),
+        'autostop': r['autostop'],
+        'to_down': bool(r['to_down']),
+        'metadata': json.loads(r['metadata'] or '{}'),
+        'owner': r['owner'],
+        'cluster_hash': r['cluster_hash'],
+        'cluster_ever_up': bool(r['cluster_ever_up']),
+    }
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        cols = [d[0] for d in conn.execute(
+            'SELECT * FROM clusters LIMIT 0').description]
+        rows = conn.execute(
+            'SELECT * FROM clusters ORDER BY launched_at DESC').fetchall()
+    return [_row_to_record(dict(zip(cols, r))) for r in rows]
+
+
+def get_glob_cluster_names(glob_pattern: str) -> List[str]:
+    with _conn() as conn:
+        rows = conn.execute('SELECT name FROM clusters WHERE name GLOB ?',
+                            (glob_pattern,)).fetchall()
+    return [r[0] for r in rows]
+
+
+def remove_cluster(cluster_name: str, terminate: bool) -> None:
+    _close_usage_interval(cluster_name)
+    with _conn() as conn:
+        if terminate:
+            conn.execute('DELETE FROM clusters WHERE name=?', (cluster_name,))
+        else:
+            record = get_cluster_from_name(cluster_name)
+            if record is None:
+                return
+            handle = record['handle']
+            if handle is not None and hasattr(handle, 'stable_internal_external_ips'):
+                handle.stable_internal_external_ips = None
+            conn.execute(
+                'UPDATE clusters SET handle=?, status=? WHERE name=?',
+                (pickle.dumps(handle), status_lib.ClusterStatus.STOPPED.value,
+                 cluster_name))
+
+
+def set_owner_identity_for_cluster(cluster_name: str,
+                                   owner_identity: Optional[List[str]]) -> None:
+    if owner_identity is None:
+        return
+    with _conn() as conn:
+        conn.execute('UPDATE clusters SET owner=? WHERE name=?',
+                     (json.dumps(owner_identity), cluster_name))
+
+
+def get_owner_identity_for_cluster(cluster_name: str) -> Optional[List[str]]:
+    with _conn() as conn:
+        row = conn.execute('SELECT owner FROM clusters WHERE name=?',
+                           (cluster_name,)).fetchone()
+    if row is None or row[0] is None:
+        return None
+    return json.loads(row[0])
+
+
+def get_cluster_metadata(cluster_name: str) -> Optional[Dict[str, Any]]:
+    rec = get_cluster_from_name(cluster_name)
+    return rec['metadata'] if rec else None
+
+
+def set_cluster_metadata(cluster_name: str, metadata: Dict[str, Any]) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE clusters SET metadata=? WHERE name=?',
+                     (json.dumps(metadata), cluster_name))
+
+
+def set_cluster_storage_mounts_metadata(cluster_name: str,
+                                        metadata: Any) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE clusters SET storage_mounts_metadata=? WHERE name=?',
+            (pickle.dumps(metadata), cluster_name))
+
+
+def get_cluster_storage_mounts_metadata(cluster_name: str) -> Any:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT storage_mounts_metadata FROM clusters WHERE name=?',
+            (cluster_name,)).fetchone()
+    if row is None or row[0] is None:
+        return None
+    return pickle.loads(row[0])
+
+
+# ------------------------------------------------------- usage / cost report
+
+
+def _get_hash_for_existing_cluster(cluster_name: str) -> Optional[str]:
+    with _conn() as conn:
+        row = conn.execute('SELECT cluster_hash FROM clusters WHERE name=?',
+                           (cluster_name,)).fetchone()
+    return row[0] if row else None
+
+
+def _get_cluster_usage_intervals(cluster_hash: Optional[str]):
+    if cluster_hash is None:
+        return None
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT usage_intervals FROM cluster_history WHERE cluster_hash=?',
+            (cluster_hash,)).fetchone()
+    if row is None or row[0] is None:
+        return None
+    return pickle.loads(row[0])
+
+
+def _close_usage_interval(cluster_name: str) -> None:
+    cluster_hash = _get_hash_for_existing_cluster(cluster_name)
+    intervals = _get_cluster_usage_intervals(cluster_hash)
+    if not intervals or intervals[-1][1] is not None:
+        return
+    start, _ = intervals[-1]
+    intervals[-1] = (start, int(time.time()))
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE cluster_history SET usage_intervals=? WHERE cluster_hash=?',
+            (pickle.dumps(intervals), cluster_hash))
+
+
+def get_cluster_duration(cluster_hash: str) -> int:
+    intervals = _get_cluster_usage_intervals(cluster_hash) or []
+    total = 0
+    for start, end in intervals:
+        if end is None:
+            end = int(time.time())
+        total += end - start
+    return total
+
+
+def get_clusters_from_history() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT ch.cluster_hash, ch.name, ch.num_nodes, '
+            'ch.requested_resources, ch.launched_resources, '
+            'ch.usage_intervals, c.status '
+            'FROM cluster_history ch LEFT JOIN clusters c '
+            'ON ch.cluster_hash = c.cluster_hash').fetchall()
+    records = []
+    for (cluster_hash, name, num_nodes, requested, launched, intervals,
+         status) in rows:
+        records.append({
+            'name': name,
+            'num_nodes': num_nodes,
+            'requested_resources': pickle.loads(requested) if requested else None,
+            'launched_resources': pickle.loads(launched) if launched else None,
+            'duration': get_cluster_duration(cluster_hash),
+            'status': status_lib.ClusterStatus(status) if status else None,
+        })
+    return records
+
+
+# ----------------------------------------------------------------- storage
+
+
+def add_or_update_storage(storage_name: str, storage_handle: Any,
+                          storage_status: status_lib.StorageStatus) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO storage VALUES (?, ?, ?, ?, ?)',
+            (storage_name, int(time.time()), pickle.dumps(storage_handle),
+             _last_use(), storage_status.value))
+
+
+def remove_storage(storage_name: str) -> None:
+    with _conn() as conn:
+        conn.execute('DELETE FROM storage WHERE name=?', (storage_name,))
+
+
+def set_storage_status(storage_name: str,
+                       storage_status: status_lib.StorageStatus) -> None:
+    with _conn() as conn:
+        cur = conn.execute('UPDATE storage SET status=? WHERE name=?',
+                           (storage_status.value, storage_name))
+        if cur.rowcount == 0:
+            raise ValueError(f'Storage {storage_name} not found.')
+
+
+def get_storage_status(
+        storage_name: str) -> Optional[status_lib.StorageStatus]:
+    with _conn() as conn:
+        row = conn.execute('SELECT status FROM storage WHERE name=?',
+                           (storage_name,)).fetchone()
+    return status_lib.StorageStatus(row[0]) if row else None
+
+
+def get_handle_from_storage_name(storage_name: str) -> Any:
+    with _conn() as conn:
+        row = conn.execute('SELECT handle FROM storage WHERE name=?',
+                           (storage_name,)).fetchone()
+    return pickle.loads(row[0]) if row and row[0] else None
+
+
+def get_storage() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute('SELECT * FROM storage').fetchall()
+    return [{
+        'name': r[0],
+        'launched_at': r[1],
+        'handle': pickle.loads(r[2]) if r[2] else None,
+        'last_use': r[3],
+        'status': status_lib.StorageStatus(r[4]),
+    } for r in rows]
+
+
+# ------------------------------------------------------------ enabled infra
+
+
+def set_enabled_clouds(enabled_clouds: List[str]) -> None:
+    with _conn() as conn:
+        conn.execute('DELETE FROM enabled_clouds')
+        conn.executemany('INSERT INTO enabled_clouds VALUES (?)',
+                         [(c,) for c in enabled_clouds])
+
+
+def get_enabled_clouds() -> List[str]:
+    with _conn() as conn:
+        rows = conn.execute('SELECT name FROM enabled_clouds').fetchall()
+    return [r[0] for r in rows]

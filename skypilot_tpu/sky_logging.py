@@ -1,0 +1,85 @@
+"""Logging setup.
+
+Parity: /root/reference/sky/sky_logging.py:1-145 (env-tunable logger with a
+single stream handler and a `silent` context). Simplified: one formatter, no
+ray-specific line processors.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import sys
+import threading
+
+_FORMAT = '%(levelname).1s %(asctime)s %(filename)s:%(lineno)d] %(message)s'
+_DATE_FORMAT = '%m-%d %H:%M:%S'
+
+_lock = threading.Lock()
+_root_logger = logging.getLogger('skypilot_tpu')
+_default_handler: 'logging.Handler | None' = None
+
+# Thread-local silence flag, toggled by the `silent()` context manager.
+_local = threading.local()
+
+
+def _show_logging_prefix() -> bool:
+    return os.environ.get('SKYTPU_DEBUG', '0') == '1'
+
+
+class _FmtFilter(logging.Filter):
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        return not getattr(_local, 'silent', False)
+
+
+def _setup() -> None:
+    global _default_handler
+    with _lock:
+        if _default_handler is not None:
+            return
+        _default_handler = logging.StreamHandler(sys.stdout)
+        _default_handler.setLevel(logging.DEBUG)
+        fmt = _FORMAT if _show_logging_prefix() else '%(message)s'
+        _default_handler.setFormatter(
+            logging.Formatter(fmt, datefmt=_DATE_FORMAT))
+        _default_handler.addFilter(_FmtFilter())
+        _root_logger.addHandler(_default_handler)
+        level = logging.DEBUG if os.environ.get('SKYTPU_DEBUG') else logging.INFO
+        _root_logger.setLevel(level)
+        _root_logger.propagate = False
+
+
+def init_logger(name: str) -> logging.Logger:
+    _setup()
+    return logging.getLogger(f'skypilot_tpu.{name}')
+
+
+def reload_logger() -> None:
+    """Re-create the handler (e.g. after env flags change in tests)."""
+    global _default_handler
+    with _lock:
+        if _default_handler is not None:
+            _root_logger.removeHandler(_default_handler)
+            _default_handler = None
+    _setup()
+
+
+@contextlib.contextmanager
+def silent():
+    """Suppress all framework log output inside the context."""
+    prev = getattr(_local, 'silent', False)
+    _local.silent = True
+    try:
+        yield
+    finally:
+        _local.silent = prev
+
+
+def is_silent() -> bool:
+    return getattr(_local, 'silent', False)
+
+
+def print_exception_no_traceback():
+    """Context: raise with a clean one-line error (no traceback) in CLI paths."""
+    return contextlib.nullcontext()

@@ -1,0 +1,70 @@
+"""Remote-runtime constants: paths, env-var names, bootstrap commands.
+
+Parity: /root/reference/sky/skylet/constants.py:1-291 — with the Ray-specific
+pieces (SKY_REMOTE_RAY_PORT, ray launcher shims) replaced by the TPU job
+contract: rank/host-list env plus JAX coordinator variables, so user code can
+call `jax.distributed.initialize()` with zero glue.
+"""
+from __future__ import annotations
+
+SKYTPU_REMOTE_HOME = '~/.skytpu'
+SKY_LOGS_DIRECTORY = '~/sky_logs'
+SKY_REMOTE_WORKDIR = '~/sky_workdir'
+SKY_REMOTE_APP_DIR = '~/.skytpu/app'
+SKY_REMOTE_PACKAGE_DIR = '~/.skytpu/wheels'
+
+JOB_DB_PATH = '~/.skytpu/jobs.db'
+SKYLET_PID_FILE = '~/.skytpu/skylet.pid'
+SKYLET_LOG_FILE = '~/.skytpu/skylet.log'
+AUTOSTOP_CONFIG_FILE = '~/.skytpu/autostop_config.json'
+AUTOSTOP_LAST_ACTIVE_FILE = '~/.skytpu/autostop_last_active'
+
+# --- The TPU job contract: env exported to every task process. ---
+# Gang identity (parity with SKYPILOT_NODE_RANK/NODE_IPS/NUM_NODES,
+# reference cloud_vm_ray_backend.py:579-634).
+ENV_HOST_RANK = 'SKYTPU_HOST_RANK'          # global host rank, 0..N-1
+ENV_HOST_IPS = 'SKYTPU_HOST_IPS'            # newline-separated, rank order
+ENV_NUM_HOSTS = 'SKYTPU_NUM_HOSTS'
+ENV_NUM_SLICES = 'SKYTPU_NUM_SLICES'        # multislice (DCN) width
+ENV_SLICE_ID = 'SKYTPU_SLICE_ID'            # which slice this host is in
+ENV_TASK_ID = 'SKYTPU_TASK_ID'              # globally unique task run id
+ENV_CLUSTER_NAME = 'SKYTPU_CLUSTER_NAME'
+ENV_JOB_ID = 'SKYTPU_JOB_ID'
+# JAX coordination (consumed by jax.distributed.initialize / libtpu).
+ENV_COORDINATOR_ADDRESS = 'SKYTPU_COORDINATOR_ADDRESS'  # host0_ip:port
+ENV_ACCEL_TYPE = 'SKYTPU_ACCELERATOR_TYPE'  # e.g. tpu-v5e-16
+ENV_TOPOLOGY = 'SKYTPU_TOPOLOGY'            # e.g. 4x4 / 2x2x4
+ENV_CHIPS_PER_HOST = 'SKYTPU_CHIPS_PER_HOST'
+# Checkpoint contract (first-class, unlike the reference — SURVEY.md §5):
+# a per-job directory (bucket-mounted when storage is configured) that
+# trainers should write orbax checkpoints into; managed-jobs recovery
+# relaunches with the same path so auto-resume is a convention, not code.
+ENV_CHECKPOINT_DIR = 'SKYTPU_CHECKPOINT_DIR'
+
+JAX_COORDINATOR_PORT = 8476
+SKYLET_EVENT_INTERVAL_SECONDS = 20
+
+# Default container-side python. Overridable because local (hermetic) hosts
+# share the client's interpreter.
+SKY_PYTHON_CMD = 'python3'
+
+# Bootstrap run on every fresh host before the skylet starts: make dirs,
+# ensure the app package is importable. The app package is rsynced (not
+# pip-wheel-installed as the reference does, cloud_vm_ray_backend.py:2748) —
+# rsync of the package tree has the same idempotency with less latency.
+RUNTIME_SETUP_COMMANDS = (
+    f'mkdir -p {SKY_LOGS_DIRECTORY} {SKY_REMOTE_WORKDIR} '
+    f'{SKYTPU_REMOTE_HOME}; true')
+
+SKYLET_START_COMMAND = (
+    f'cd ~ && PYTHONPATH={SKY_REMOTE_APP_DIR}:$PYTHONPATH '
+    f'nohup {SKY_PYTHON_CMD} -m skypilot_tpu.skylet.attempt_skylet '
+    f'>> {SKYLET_LOG_FILE} 2>&1')
+
+# Reference parity names kept importable for task authors migrating over.
+LEGACY_ENV_ALIASES = {
+    'SKYPILOT_NODE_RANK': ENV_HOST_RANK,
+    'SKYPILOT_NODE_IPS': ENV_HOST_IPS,
+    'SKYPILOT_NUM_NODES': ENV_NUM_HOSTS,
+    'SKYPILOT_TASK_ID': ENV_TASK_ID,
+}

@@ -1,0 +1,452 @@
+"""On-cluster job queue: sqlite-backed FIFO with status reconciliation.
+
+Parity: /root/reference/sky/skylet/job_lib.py:101-939 (JobStatus lifecycle,
+FIFOScheduler, update_job_status reconciliation, is_cluster_idle for
+autostop, JobLibCodeGen). TPU-first difference: jobs are executed by the
+framework's own gang supervisor (skypilot_tpu.backends.gang_exec run on the
+head host) instead of `ray job submit`; the queue tracks the supervisor PID
+and reconciles by liveness probe, not Ray job states.
+"""
+from __future__ import annotations
+
+import enum
+import getpass
+import json
+import os
+import shlex
+import signal
+import sqlite3
+import subprocess
+import time
+from typing import Any, Dict, List, Optional
+
+import psutil
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.skylet import constants
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _db_path() -> str:
+    path = os.environ.get('SKYTPU_JOB_DB',
+                          os.path.expanduser(constants.JOB_DB_PATH))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    return path
+
+
+_CREATE = """\
+CREATE TABLE IF NOT EXISTS jobs (
+    job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+    job_name TEXT,
+    username TEXT,
+    submitted_at REAL,
+    status TEXT,
+    run_timestamp TEXT,
+    start_at REAL DEFAULT -1,
+    end_at REAL DEFAULT NULL,
+    resources TEXT,
+    pid INTEGER DEFAULT -1,
+    run_cmd TEXT,
+    log_dir TEXT);
+CREATE TABLE IF NOT EXISTS pending_jobs (
+    job_id INTEGER PRIMARY KEY,
+    run_cmd TEXT,
+    submit REAL,
+    created_time REAL);
+"""
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn.executescript(_CREATE)
+    return conn
+
+
+class JobStatus(enum.Enum):
+    """Job lifecycle (parity: reference job_lib.py:101-160)."""
+    INIT = 'INIT'
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    FAILED_DRIVER = 'FAILED_DRIVER'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    CANCELLED = 'CANCELLED'
+
+    @classmethod
+    def nonterminal_statuses(cls) -> List['JobStatus']:
+        return [cls.INIT, cls.PENDING, cls.SETTING_UP, cls.RUNNING]
+
+    def is_terminal(self) -> bool:
+        return self not in self.nonterminal_statuses()
+
+    def __lt__(self, other: 'JobStatus') -> bool:
+        order = list(JobStatus)
+        return order.index(self) < order.index(other)
+
+    def colored_str(self) -> str:
+        color = {
+            JobStatus.SUCCEEDED: '\x1b[32m',
+            JobStatus.FAILED: '\x1b[31m',
+            JobStatus.FAILED_SETUP: '\x1b[31m',
+            JobStatus.FAILED_DRIVER: '\x1b[31m',
+            JobStatus.CANCELLED: '\x1b[33m',
+        }.get(self, '\x1b[36m')
+        return f'{color}{self.value}\x1b[0m'
+
+
+# ------------------------------------------------------------------ CRUD
+
+
+def add_job(job_name: str, username: str, run_timestamp: str,
+            resources_str: str) -> int:
+    """Insert a job in INIT; returns its id. Called before codegen exec."""
+    with _conn() as conn:
+        cur = conn.execute(
+            'INSERT INTO jobs (job_name, username, submitted_at, status, '
+            'run_timestamp, resources, log_dir) VALUES (?, ?, ?, ?, ?, ?, ?)',
+            (job_name, username, time.time(), JobStatus.INIT.value,
+             run_timestamp, resources_str,
+             os.path.join(constants.SKY_LOGS_DIRECTORY, run_timestamp)))
+        return int(cur.lastrowid)
+
+
+def set_status(job_id: int, status: JobStatus) -> None:
+    with _conn() as conn:
+        if status == JobStatus.RUNNING:
+            conn.execute(
+                'UPDATE jobs SET status=?, start_at=? WHERE job_id=?',
+                (status.value, time.time(), job_id))
+        elif status.is_terminal():
+            conn.execute(
+                'UPDATE jobs SET status=?, end_at=? WHERE job_id=? ',
+                (status.value, time.time(), job_id))
+        else:
+            conn.execute('UPDATE jobs SET status=? WHERE job_id=?',
+                         (status.value, job_id))
+
+
+def set_job_started(job_id: int) -> None:
+    set_status(job_id, JobStatus.RUNNING)
+
+
+def set_pid(job_id: int, pid: int) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE jobs SET pid=? WHERE job_id=?', (pid, job_id))
+
+
+def get_status(job_id: int) -> Optional[JobStatus]:
+    with _conn() as conn:
+        row = conn.execute('SELECT status FROM jobs WHERE job_id=?',
+                           (job_id,)).fetchone()
+    return JobStatus(row[0]) if row else None
+
+
+def get_record(job_id: int) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT job_id, job_name, username, submitted_at, status, '
+            'run_timestamp, start_at, end_at, resources, pid, log_dir '
+            'FROM jobs WHERE job_id=?', (job_id,)).fetchone()
+    if row is None:
+        return None
+    return _record(row)
+
+
+def _record(row: tuple) -> Dict[str, Any]:
+    return {
+        'job_id': row[0],
+        'job_name': row[1],
+        'username': row[2],
+        'submitted_at': row[3],
+        'status': JobStatus(row[4]),
+        'run_timestamp': row[5],
+        'start_at': row[6],
+        'end_at': row[7],
+        'resources': row[8],
+        'pid': row[9],
+        'log_dir': row[10],
+    }
+
+
+def get_jobs(statuses: Optional[List[JobStatus]] = None,
+             limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    q = ('SELECT job_id, job_name, username, submitted_at, status, '
+         'run_timestamp, start_at, end_at, resources, pid, log_dir FROM jobs')
+    params: list = []
+    if statuses:
+        q += ' WHERE status IN (%s)' % ','.join('?' * len(statuses))
+        params += [s.value for s in statuses]
+    q += ' ORDER BY job_id DESC'
+    if limit:
+        q += ' LIMIT ?'
+        params.append(limit)
+    with _conn() as conn:
+        rows = conn.execute(q, params).fetchall()
+    return [_record(r) for r in rows]
+
+
+def get_latest_job_id() -> Optional[int]:
+    with _conn() as conn:
+        row = conn.execute('SELECT MAX(job_id) FROM jobs').fetchone()
+    return row[0] if row and row[0] is not None else None
+
+
+def get_log_dir_for_job(job_id: int) -> Optional[str]:
+    rec = get_record(job_id)
+    return rec['log_dir'] if rec else None
+
+
+def run_timestamp_with_fallback(job_id: Optional[int]) -> Optional[str]:
+    if job_id is None:
+        job_id = get_latest_job_id()
+        if job_id is None:
+            return None
+    rec = get_record(job_id)
+    return rec['run_timestamp'] if rec else None
+
+
+# ------------------------------------------------------------- scheduler
+
+
+class FIFOScheduler:
+    """Launch queued jobs in submit order, one pass per invocation.
+
+    Parity: reference job_lib.py:163-217. The queued command is the gang
+    supervisor invocation (a shell line); we spawn it detached and record
+    its PID for liveness reconciliation.
+    """
+
+    ALIVE_STATUSES = (JobStatus.SETTING_UP, JobStatus.RUNNING)
+
+    def queue(self, job_id: int, cmd: str) -> None:
+        with _conn() as conn:
+            conn.execute(
+                'INSERT OR REPLACE INTO pending_jobs VALUES (?, ?, 0, ?)',
+                (job_id, cmd, time.time()))
+        set_status(job_id, JobStatus.PENDING)
+        self.schedule_step()
+
+    def remove_job_no_lock(self, job_id: int) -> None:
+        with _conn() as conn:
+            conn.execute('DELETE FROM pending_jobs WHERE job_id=?', (job_id,))
+
+    def _get_pending_job(self) -> Optional[tuple]:
+        with _conn() as conn:
+            return conn.execute(
+                'SELECT job_id, run_cmd FROM pending_jobs WHERE submit=0 '
+                'ORDER BY job_id ASC LIMIT 1').fetchone()
+
+    def schedule_step(self) -> None:
+        # Strictly FIFO: launch the oldest pending job; one at a time on
+        # the slice (a TPU slice runs one gang job at a time — chips are
+        # exclusive, unlike fractional GPUs).
+        alive = get_jobs(list(self.ALIVE_STATUSES))
+        if alive:
+            return
+        row = self._get_pending_job()
+        if row is None:
+            return
+        job_id, run_cmd = row
+        status = get_status(job_id)
+        if status is None or status != JobStatus.PENDING:
+            self.remove_job_no_lock(job_id)
+            return self.schedule_step()
+        with _conn() as conn:
+            conn.execute('UPDATE pending_jobs SET submit=? WHERE job_id=?',
+                         (time.time(), job_id))
+        set_status(job_id, JobStatus.SETTING_UP)
+        proc = subprocess.Popen(run_cmd,
+                                shell=True,
+                                executable='/bin/bash',
+                                stdin=subprocess.DEVNULL,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL,
+                                start_new_session=True)
+        set_pid(job_id, proc.pid)
+        self.remove_job_no_lock(job_id)
+
+
+scheduler = FIFOScheduler()
+
+
+# --------------------------------------------------------- reconciliation
+
+
+def update_job_status(job_ids: Optional[List[int]] = None) -> None:
+    """Fix statuses that have drifted from reality (dead supervisors).
+
+    Parity: reference job_lib.py:527-650 (reconciles against Ray job
+    states); here the source of truth is supervisor-PID liveness.
+    """
+    if job_ids is None:
+        job_ids = [r['job_id'] for r in get_jobs(JobStatus.nonterminal_statuses())]
+    for job_id in job_ids:
+        rec = get_record(job_id)
+        if rec is None or rec['status'].is_terminal():
+            continue
+        pid = rec['pid']
+        if rec['status'] in (JobStatus.INIT, JobStatus.PENDING):
+            # Not yet scheduled; stale if pending for > 24h.
+            if time.time() - rec['submitted_at'] > 86400:
+                set_status(job_id, JobStatus.FAILED_DRIVER)
+            continue
+        if pid <= 0 or not psutil.pid_exists(pid):
+            # Supervisor died without setting a terminal state.
+            set_status(job_id, JobStatus.FAILED_DRIVER)
+
+
+def is_cluster_idle() -> bool:
+    """True iff no nonterminal jobs exist (consulted by autostop)."""
+    return not get_jobs(JobStatus.nonterminal_statuses(), limit=1)
+
+
+def cancel_jobs(job_ids: Optional[List[int]] = None,
+                cancel_all: bool = False) -> List[int]:
+    """Kill supervisors (whole process trees) and mark CANCELLED."""
+    if cancel_all:
+        records = get_jobs(JobStatus.nonterminal_statuses())
+    elif job_ids:
+        records = [r for jid in job_ids if (r := get_record(jid)) is not None]
+    else:
+        latest = get_latest_job_id()
+        records = [get_record(latest)] if latest else []
+    cancelled = []
+    for rec in records:
+        if rec is None or rec['status'].is_terminal():
+            continue
+        scheduler.remove_job_no_lock(rec['job_id'])
+        pid = rec['pid']
+        if pid > 0 and psutil.pid_exists(pid):
+            from skypilot_tpu.utils import subprocess_utils  # pylint: disable=import-outside-toplevel
+            try:
+                os.killpg(os.getpgid(pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                subprocess_utils.kill_children_processes([pid], force=True)
+        set_status(rec['job_id'], JobStatus.CANCELLED)
+        cancelled.append(rec['job_id'])
+    return cancelled
+
+
+def fail_all_jobs_in_progress() -> None:
+    for rec in get_jobs(JobStatus.nonterminal_statuses()):
+        set_status(rec['job_id'], JobStatus.FAILED_DRIVER)
+
+
+def format_job_queue(records: List[Dict[str, Any]]) -> str:
+    lines = [f'{"ID":<5}{"NAME":<18}{"SUBMITTED":<22}{"STATUS":<15}{"LOG":<40}']
+    for r in records:
+        submitted = time.strftime('%Y-%m-%d %H:%M:%S',
+                                  time.localtime(r['submitted_at']))
+        lines.append(f'{r["job_id"]:<5}{(r["job_name"] or "-")[:17]:<18}'
+                     f'{submitted:<22}{r["status"].value:<15}'
+                     f'{(r["log_dir"] or "-"):<40}')
+    return '\n'.join(lines)
+
+
+# ------------------------------------------------------------- codegen
+
+
+class JobLibCodeGen:
+    """Generate python one-liners executed on the head host over ssh.
+
+    Parity: reference job_lib.py:818-939. ssh + codegen is the client↔head
+    RPC layer: no persistent service needed.
+    """
+
+    _PREFIX = ('import os; '
+               "os.environ.setdefault('PYTHONUNBUFFERED','1'); "
+               'from skypilot_tpu.skylet import job_lib, log_lib')
+
+    @classmethod
+    def _build(cls, code: List[str]) -> str:
+        full = '; '.join([cls._PREFIX] + code)
+        python = constants.SKY_PYTHON_CMD
+        app_dir = constants.SKY_REMOTE_APP_DIR
+        return (f'PYTHONPATH={app_dir}:$PYTHONPATH {python} -u -c '
+                f'{shlex.quote(full)}')
+
+    @classmethod
+    def add_job(cls, job_name: Optional[str], username: str,
+                run_timestamp: str, resources_str: str) -> str:
+        name = job_name or '-'
+        return cls._build([
+            f'job_id = job_lib.add_job({name!r}, {username!r}, '
+            f'{run_timestamp!r}, {resources_str!r})',
+            'print("job_id=" + str(job_id), flush=True)',
+        ])
+
+    @classmethod
+    def queue_job(cls, job_id: int, cmd: str) -> str:
+        return cls._build([f'job_lib.scheduler.queue({job_id}, {cmd!r})'])
+
+    @classmethod
+    def update_status(cls) -> str:
+        return cls._build(['job_lib.update_job_status()'])
+
+    @classmethod
+    def get_job_queue(cls, all_jobs: bool = True) -> str:
+        statuses = (None if all_jobs else
+                    '[job_lib.JobStatus(s) for s in '
+                    f'{[s.value for s in JobStatus.nonterminal_statuses()]}]')
+        return cls._build([
+            'job_lib.update_job_status()',
+            f'records = job_lib.get_jobs({statuses})',
+            'import json',
+            'print("JOBS:" + json.dumps([{k: (v.value if hasattr(v, "value") '
+            'else v) for k, v in r.items()} for r in records]), flush=True)',
+        ])
+
+    @classmethod
+    def cancel_jobs(cls, job_ids: Optional[List[int]],
+                    cancel_all: bool = False) -> str:
+        return cls._build([
+            f'cancelled = job_lib.cancel_jobs({job_ids!r}, {cancel_all})',
+            'import json; print("CANCELLED:" + json.dumps(cancelled), flush=True)',
+        ])
+
+    @classmethod
+    def tail_logs(cls, job_id: Optional[int], follow: bool = True,
+                  tail: int = 0) -> str:
+        return cls._build([
+            f'job_id = {job_id} if {job_id!r} is not None else '
+            'job_lib.get_latest_job_id()',
+            'log_dir = job_lib.get_log_dir_for_job(job_id) '
+            'if job_id is not None else None',
+            f'import sys; sys.exit(log_lib.tail_logs(job_id, log_dir, '
+            f'follow={follow}, tail={tail}))',
+        ])
+
+    @classmethod
+    def get_job_status(cls, job_ids: Optional[List[int]] = None) -> str:
+        return cls._build([
+            'job_lib.update_job_status()',
+            f'ids = {job_ids!r} or ([job_lib.get_latest_job_id()] '
+            'if job_lib.get_latest_job_id() else [])',
+            'import json',
+            'print("STATUS:" + json.dumps({str(i): (job_lib.get_status(i).value'
+            ' if job_lib.get_status(i) else None) for i in ids}), flush=True)',
+        ])
+
+
+def parse_job_id(stdout: str) -> int:
+    for line in stdout.splitlines():
+        if line.startswith('job_id='):
+            return int(line.split('=', 1)[1])
+    raise ValueError(f'Could not parse job id from: {stdout!r}')
+
+
+def parse_tagged_json(stdout: str, tag: str) -> Any:
+    for line in stdout.splitlines():
+        if line.startswith(tag):
+            return json.loads(line[len(tag):])
+    raise ValueError(f'No {tag} line in: {stdout!r}')
+
+
+def get_current_username() -> str:
+    try:
+        return getpass.getuser()
+    except OSError:
+        return 'unknown'

@@ -1,0 +1,220 @@
+"""Subprocess execution with log capture/streaming, and log tailing.
+
+Parity: /root/reference/sky/skylet/log_lib.py:131-458 (`run_with_log`,
+`make_task_bash_script`, `tail_logs` with follow). Used on both sides: the
+client tees ssh output through it; slice hosts wrap the user command with it.
+"""
+from __future__ import annotations
+
+import io
+import os
+import selectors
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+from typing import Dict, Iterator, List, Optional, Tuple, Union
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.skylet import constants
+
+logger = sky_logging.init_logger(__name__)
+
+_SKY_LOG_WAITING_GAP_SECONDS = 1
+
+
+def process_subprocess_stream(proc: subprocess.Popen,
+                              log_path: str,
+                              stream_logs: bool,
+                              require_outputs: bool = False,
+                              line_prefix: str = '') -> Tuple[str, str]:
+    """Pump stdout/stderr of `proc` to logfile (+optionally console/RAM)."""
+    stdout_io = io.StringIO() if require_outputs else None
+    stderr_io = io.StringIO() if require_outputs else None
+    sel = selectors.DefaultSelector()
+    streams = {}
+    if proc.stdout is not None:
+        sel.register(proc.stdout, selectors.EVENT_READ, 'stdout')
+        streams['stdout'] = stdout_io
+    if proc.stderr is not None:
+        sel.register(proc.stderr, selectors.EVENT_READ, 'stderr')
+        streams['stderr'] = stderr_io
+
+    os.makedirs(os.path.dirname(os.path.abspath(log_path)), exist_ok=True)
+    with open(log_path, 'a', encoding='utf-8') as fout:
+        open_count = len(sel.get_map())
+        while open_count > 0:
+            for key, _ in sel.select():
+                line = key.fileobj.readline()
+                if not line:
+                    sel.unregister(key.fileobj)
+                    open_count -= 1
+                    continue
+                name = key.data
+                fout.write(line)
+                fout.flush()
+                mem = streams.get(name)
+                if mem is not None:
+                    mem.write(line)
+                if stream_logs:
+                    out = sys.stderr if name == 'stderr' else sys.stdout
+                    out.write(line_prefix + line)
+                    out.flush()
+    stdout = stdout_io.getvalue() if stdout_io else ''
+    stderr = stderr_io.getvalue() if stderr_io else ''
+    return stdout, stderr
+
+
+def run_with_log(cmd: Union[str, List[str]],
+                 log_path: str,
+                 *,
+                 require_outputs: bool = False,
+                 stream_logs: bool = False,
+                 shell: bool = False,
+                 with_ray: bool = False,
+                 env: Optional[Dict[str, str]] = None,
+                 cwd: Optional[str] = None,
+                 line_prefix: str = '',
+                 **kwargs) -> Union[int, Tuple[int, str, str]]:
+    """Run cmd, teeing output to `log_path`; returns rc (or rc, out, err)."""
+    del with_ray  # reference-API compat; no Ray here
+    assert process_stream_ok(kwargs)
+    log_path = os.path.expanduser(log_path)
+    with subprocess.Popen(cmd,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.PIPE,
+                          start_new_session=True,
+                          shell=shell,
+                          executable='/bin/bash' if shell else None,
+                          text=True,
+                          env=env,
+                          cwd=cwd) as proc:
+        try:
+            stdout, stderr = process_subprocess_stream(
+                proc, log_path, stream_logs, require_outputs, line_prefix)
+            proc.wait()
+            if require_outputs:
+                return proc.returncode, stdout, stderr
+            return proc.returncode
+        except KeyboardInterrupt:
+            from skypilot_tpu.utils import subprocess_utils  # pylint: disable=import-outside-toplevel
+            subprocess_utils.kill_children_processes([proc.pid], force=True)
+            raise
+
+
+def process_stream_ok(kwargs: dict) -> bool:
+    kwargs.pop('process_stream', None)
+    return not kwargs
+
+
+def make_task_bash_script(codegen: str,
+                          env_vars: Optional[Dict[str, str]] = None) -> str:
+    """Wrap user `run` commands in a bash script with exported env.
+
+    Parity: reference log_lib.py:256-300 (login-shell semantics so conda/venv
+    activation in ~/.bashrc applies; `set -e`-free so partial failures
+    surface via exit codes, not silent aborts).
+    """
+    script = [
+        textwrap.dedent(f"""\
+            #!/bin/bash
+            source ~/.bashrc 2>/dev/null || true
+            set -a
+            . ~/.skytpu/task_env 2>/dev/null || true
+            set +a
+            cd {constants.SKY_REMOTE_WORKDIR} 2>/dev/null || cd ~
+            """),
+    ]
+    if env_vars:
+        for k, v in env_vars.items():
+            script.append(f'export {k}={subprocess_quote(v)}')
+    script.append(codegen)
+    return '\n'.join(script) + '\n'
+
+
+def subprocess_quote(s: str) -> str:
+    import shlex  # pylint: disable=import-outside-toplevel
+    return shlex.quote(str(s))
+
+
+def run_bash_command_with_log(bash_command: str,
+                              log_path: str,
+                              env_vars: Optional[Dict[str, str]] = None,
+                              stream_logs: bool = False,
+                              line_prefix: str = '') -> int:
+    """Materialize a script file then run it with logging (host-side exec)."""
+    with tempfile.NamedTemporaryFile('w', prefix='sky_app_', suffix='.sh',
+                                     delete=False) as fp:
+        fp.write(make_task_bash_script(bash_command, env_vars))
+        script_path = fp.name
+    os.chmod(script_path, 0o755)
+    return run_with_log(f'/bin/bash {script_path}', log_path, shell=True,
+                        stream_logs=stream_logs, line_prefix=line_prefix)  # type: ignore[return-value]
+
+
+def _follow_file(f, exit_when) -> Iterator[str]:
+    while True:
+        line = f.readline()
+        if line:
+            yield line
+        else:
+            if exit_when():
+                # Drain anything written between the check and now.
+                rest = f.read()
+                if rest:
+                    yield rest
+                return
+            time.sleep(_SKY_LOG_WAITING_GAP_SECONDS)
+
+
+def tail_logs(job_id: Optional[int],
+              log_dir: Optional[str],
+              follow: bool = True,
+              tail: int = 0) -> int:
+    """Print a job's run.log; optionally follow until the job terminates.
+
+    Parity: reference log_lib.py:331-458. Returns the job's exit-ish status
+    code (0 on SUCCEEDED).
+    """
+    from skypilot_tpu.skylet import job_lib  # pylint: disable=import-outside-toplevel
+    if log_dir is None:
+        print(f'Job {job_id} not found (see `sky queue`).', file=sys.stderr)
+        return 1
+    log_path = os.path.join(os.path.expanduser(log_dir), 'run.log')
+    deadline = time.time() + 60
+    while not os.path.exists(log_path):
+        if time.time() > deadline:
+            print(f'Log file not found: {log_path}', file=sys.stderr)
+            return 1
+        status = job_lib.get_status(job_id) if job_id is not None else None
+        if status is not None and status.is_terminal():
+            break
+        time.sleep(_SKY_LOG_WAITING_GAP_SECONDS)
+    if not os.path.exists(log_path):
+        return 0
+
+    def _job_done() -> bool:
+        if job_id is None:
+            return True
+        status = job_lib.get_status(job_id)
+        return status is None or status.is_terminal()
+
+    with open(log_path, 'r', encoding='utf-8', errors='replace') as f:
+        if tail > 0:
+            lines = f.readlines()[-tail:]
+            for line in lines:
+                print(line, end='')
+        if follow:
+            if tail == 0:
+                for line in f:
+                    print(line, end='')
+            for line in _follow_file(f, _job_done):
+                print(line, end='', flush=True)
+        elif tail == 0:
+            for line in f:
+                print(line, end='')
+    if job_id is not None:
+        status = job_lib.get_status(job_id)
+        return 0 if status == job_lib.JobStatus.SUCCEEDED else 1
+    return 0

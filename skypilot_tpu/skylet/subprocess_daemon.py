@@ -1,0 +1,62 @@
+"""Orphan-reaper daemon: kills a job's process tree when its parent dies.
+
+Parity: /root/reference/sky/skylet/subprocess_daemon.py:13-88. Spawned
+detached (start_new_session) alongside every gang-supervised user process so
+that `sky cancel` or a dead supervisor never leaves trainers holding TPU
+chips (libtpu grabs an exclusive lock per chip; a leaked process bricks the
+slice for subsequent jobs).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import psutil
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--parent-pid', type=int, required=True)
+    parser.add_argument('--proc-pid', type=int, required=True)
+    args = parser.parse_args()
+
+    try:
+        process = psutil.Process(args.proc_pid)
+    except psutil.NoSuchProcess:
+        sys.exit(0)
+
+    parent = None
+    try:
+        parent = psutil.Process(args.parent_pid)
+    except psutil.NoSuchProcess:
+        pass
+
+    if parent is not None:
+        try:
+            parent.wait()
+        except psutil.Error:
+            pass
+
+    # Parent is gone: reap the whole descendant tree, children first.
+    try:
+        children = process.children(recursive=True)
+    except psutil.NoSuchProcess:
+        sys.exit(0)
+    victims = children + [process]
+    for proc in victims:
+        try:
+            proc.terminate()
+        except psutil.NoSuchProcess:
+            continue
+    _, alive = psutil.wait_procs(victims, timeout=5)
+    for proc in alive:
+        try:
+            proc.kill()
+        except psutil.NoSuchProcess:
+            continue
+    time.sleep(0.1)
+
+
+if __name__ == '__main__':
+    main()

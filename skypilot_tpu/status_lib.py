@@ -1,0 +1,40 @@
+"""Cluster / storage status enums.
+
+Parity: /root/reference/sky/status_lib.py:1-51, extended with TPU
+queued-resource states: a slice requested through the GCP queued-resources API
+can sit in WAITING for minutes-to-days before the cloud fulfills it, which the
+reference's {INIT, UP, STOPPED} model cannot express (SURVEY.md §7.4).
+"""
+from __future__ import annotations
+
+import enum
+
+
+class ClusterStatus(enum.Enum):
+    """Lifecycle of a slice-cluster as recorded in local state."""
+    # A launch has started but the slice is not fully up (or launch failed
+    # midway). Also the state while provisioning/bootstrapping runs.
+    INIT = 'INIT'
+    # Queued-resource request submitted; waiting for the cloud to grant
+    # capacity. New vs the reference (async provisioning).
+    WAITING = 'WAITING'
+    # All hosts of every slice are up and the runtime (skylet) is healthy.
+    UP = 'UP'
+    # Instances stopped but disks (and the queued-resource reservation,
+    # where applicable) retained.
+    STOPPED = 'STOPPED'
+
+    def colored_str(self) -> str:
+        color = {
+            ClusterStatus.INIT: '\x1b[33m',     # yellow
+            ClusterStatus.WAITING: '\x1b[36m',  # cyan
+            ClusterStatus.UP: '\x1b[32m',       # green
+            ClusterStatus.STOPPED: '\x1b[90m',  # gray
+        }[self]
+        return f'{color}{self.value}\x1b[0m'
+
+
+class StorageStatus(enum.Enum):
+    INIT = 'INIT'
+    UPLOAD_FAILED = 'UPLOAD_FAILED'
+    READY = 'READY'

@@ -1,0 +1,200 @@
+"""Small shared helpers: user/cluster naming, hashing, retries, validation.
+
+Parity: /root/reference/sky/utils/common_utils.py (user hash, cluster-name
+validation, backoff) — re-implemented minimally.
+"""
+from __future__ import annotations
+
+import functools
+import hashlib
+import json
+import os
+import random
+import re
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+_USER_HASH_FILE_NAME = 'user_hash'
+USER_HASH_LENGTH = 8
+
+CLUSTER_NAME_VALID_REGEX = re.compile(r'^[a-zA-Z]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?$')
+
+
+def skytpu_home() -> str:
+    """Root of all client-side state (overridable for hermetic tests)."""
+    return os.path.expanduser(os.environ.get('SKYTPU_HOME', '~/.skytpu'))
+
+
+def ensure_dir(path: str) -> str:
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def get_user_hash() -> str:
+    """Stable per-user identifier, cached on disk."""
+    env = os.environ.get('SKYTPU_USER_HASH')
+    if env:
+        return env[:USER_HASH_LENGTH]
+    path = os.path.join(skytpu_home(), _USER_HASH_FILE_NAME)
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            h = f.read().strip()
+        if h:
+            return h[:USER_HASH_LENGTH]
+    h = hashlib.md5(uuid.uuid4().bytes).hexdigest()[:USER_HASH_LENGTH]
+    ensure_dir(skytpu_home())
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(h)
+    return h
+
+
+def get_user() -> str:
+    return os.environ.get('USER', os.environ.get('LOGNAME', 'unknown'))
+
+
+def check_cluster_name_is_valid(name: Optional[str]) -> None:
+    from skypilot_tpu import exceptions  # pylint: disable=import-outside-toplevel
+    if name is None:
+        return
+    if len(name) > 63 or CLUSTER_NAME_VALID_REGEX.match(name) is None:
+        raise exceptions.InvalidClusterNameError(
+            f'Cluster name {name!r} is invalid: must match '
+            f'{CLUSTER_NAME_VALID_REGEX.pattern} and be <= 63 chars.')
+
+
+def make_cluster_name_on_cloud(display_name: str,
+                               max_length: int = 35) -> str:
+    """Append the user hash so two users' clusters never collide on-cloud."""
+    user_hash = get_user_hash()
+    name = f'{display_name}-{user_hash}'
+    if len(name) <= max_length:
+        return name
+    digest = hashlib.md5(display_name.encode()).hexdigest()[:4]
+    prefix_len = max_length - len(user_hash) - len(digest) - 2
+    return f'{display_name[:prefix_len]}-{digest}-{user_hash}'
+
+
+def base36(n: int) -> str:
+    chars = '0123456789abcdefghijklmnopqrstuvwxyz'
+    if n == 0:
+        return '0'
+    out = []
+    while n:
+        n, r = divmod(n, 36)
+        out.append(chars[r])
+    return ''.join(reversed(out))
+
+
+def get_global_job_id(job_timestamp: str, cluster_name: str,
+                      job_id: str) -> str:
+    return f'{job_timestamp}_{cluster_name}_id-{job_id}'
+
+
+def generate_run_id() -> str:
+    return f'sky-{time.strftime("%Y-%m-%d-%H-%M-%S-%f")}-{uuid.uuid4().hex[:6]}'
+
+
+class Backoff:
+    """Exponential backoff with jitter."""
+
+    MULTIPLIER = 1.6
+    JITTER = 0.4
+
+    def __init__(self, initial_backoff: float = 5.0,
+                 max_backoff_factor: int = 5) -> None:
+        self._initial = initial_backoff
+        self._max = initial_backoff * (self.MULTIPLIER**max_backoff_factor)
+        self._backoff = 0.0
+        self._next = initial_backoff
+
+    @property
+    def current_backoff(self) -> float:
+        """Advance and return the next backoff duration in seconds."""
+        self._backoff = min(self._next, self._max)
+        self._next = self._backoff * self.MULTIPLIER
+        jitter = self._backoff * self.JITTER * (2 * random.random() - 1)
+        return max(0.1, self._backoff + jitter)
+
+
+def retry(fn: Optional[Callable] = None, *, max_retries: int = 3,
+          initial_backoff: float = 1.0,
+          exceptions_to_retry: tuple = (Exception,)) -> Callable:
+    """Decorator: retry with exponential backoff."""
+
+    def deco(func: Callable) -> Callable:
+
+        @functools.wraps(func)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            backoff = Backoff(initial_backoff)
+            for attempt in range(max_retries + 1):
+                try:
+                    return func(*args, **kwargs)
+                except exceptions_to_retry:
+                    if attempt == max_retries:
+                        raise
+                    time.sleep(backoff.current_backoff)
+            raise AssertionError('unreachable')
+
+        return wrapper
+
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+def read_yaml(path: str) -> Dict[str, Any]:
+    import yaml  # pylint: disable=import-outside-toplevel
+    with open(path, encoding='utf-8') as f:
+        config = yaml.safe_load(f)
+    return config if config is not None else {}
+
+
+def read_yaml_all(path: str) -> list:
+    import yaml  # pylint: disable=import-outside-toplevel
+    with open(path, encoding='utf-8') as f:
+        return [c for c in yaml.safe_load_all(f) if c is not None]
+
+
+def dump_yaml(path: str, config: Any) -> None:
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(dump_yaml_str(config))
+
+
+def dump_yaml_str(config: Any) -> str:
+    import yaml  # pylint: disable=import-outside-toplevel
+
+    class _Dumper(yaml.SafeDumper):
+        pass
+
+    _Dumper.add_representer(
+        type(None),
+        lambda d, _: d.represent_scalar('tag:yaml.org,2002:null', 'null'))
+    if isinstance(config, list):
+        return yaml.dump_all(config, Dumper=_Dumper, default_flow_style=False)
+    return yaml.dump(config, Dumper=_Dumper, default_flow_style=False)
+
+
+def format_exception(e: BaseException, use_bracket: bool = False) -> str:
+    name = type(e).__name__
+    if use_bracket:
+        return f'[{name}] {e}'
+    return f'{name}: {e}'
+
+
+def json_dumps_compact(obj: Any) -> str:
+    return json.dumps(obj, separators=(',', ':'), sort_keys=True)
+
+
+def truncate_long_string(s: str, max_length: int = 35) -> str:
+    if len(s) <= max_length:
+        return s
+    return s[:max_length - 3] + '...'
+
+
+def fill_template(template_str: str, variables: Dict[str, Any]) -> str:
+    import jinja2  # pylint: disable=import-outside-toplevel
+    env = jinja2.Environment(undefined=jinja2.StrictUndefined,
+                             trim_blocks=True,
+                             lstrip_blocks=True)
+    return env.from_string(template_str).render(**variables)

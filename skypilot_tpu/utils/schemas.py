@@ -1,0 +1,206 @@
+"""JSON schemas for task YAML, resources, storage, and user config.
+
+Parity: /root/reference/sky/utils/schemas.py (941 LoC of draft-07 schemas) —
+trimmed to the fields this framework supports, extended with the TPU
+grammar: `accelerators: tpu-v5e-16`, `topology`, `capacity_type`
+(on_demand | spot | reserved | queued), and multislice `num_slices`.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+def _case_insensitive_enum(values) -> Dict[str, Any]:
+    return {'type': 'string', 'case_insensitive_enum': list(values)}
+
+
+_RESOURCES_PROPERTIES: Dict[str, Any] = {
+    'infra': {'type': 'string'},       # 'gcp', 'gke', 'local'
+    'cloud': {'type': 'string'},       # reference-compat alias for infra
+    'region': {'type': 'string'},
+    'zone': {'type': 'string'},
+    'instance_type': {'type': 'string'},
+    'accelerators': {
+        'anyOf': [{'type': 'string'}, {'type': 'object'}, {'type': 'null'}],
+    },
+    'topology': {'type': ['string', 'null']},       # e.g. '4x4', '2x2x4'
+    'num_slices': {'type': 'integer', 'minimum': 1},
+    'capacity_type': {
+        'type': 'string',
+        'enum': ['on_demand', 'spot', 'reserved', 'queued', 'best_effort'],
+    },
+    'use_spot': {'type': 'boolean'},   # reference-compat alias
+    'spot_recovery': {'type': ['string', 'null']},
+    'job_recovery': {
+        'anyOf': [{'type': 'string'}, {'type': 'object'}, {'type': 'null'}],
+    },
+    'cpus': {'type': ['string', 'number', 'null']},
+    'memory': {'type': ['string', 'number', 'null']},
+    'disk_size': {'type': 'integer'},
+    'disk_tier': {'type': ['string', 'null']},
+    'ports': {
+        'anyOf': [{'type': 'string'}, {'type': 'integer'},
+                  {'type': 'array'}, {'type': 'null'}],
+    },
+    'labels': {'type': 'object'},
+    'image_id': {'type': ['string', 'object', 'null']},
+    'runtime_version': {'type': ['string', 'null']},  # TPU software version
+    'reservation': {'type': ['string', 'null']},
+    'any_of': {'type': 'array'},
+    'ordered': {'type': 'array'},
+    'accelerator_args': {'type': ['object', 'null']},
+    'autostop': {
+        'anyOf': [{'type': 'integer'}, {'type': 'boolean'},
+                  {'type': 'object'}, {'type': 'null'}],
+    },
+}
+
+
+def get_resources_schema() -> Dict[str, Any]:
+    return {
+        '$schema': 'http://json-schema.org/draft-07/schema#',
+        'type': 'object',
+        'additionalProperties': False,
+        'properties': _RESOURCES_PROPERTIES,
+    }
+
+
+def get_storage_schema() -> Dict[str, Any]:
+    return {
+        '$schema': 'http://json-schema.org/draft-07/schema#',
+        'type': 'object',
+        'additionalProperties': False,
+        'properties': {
+            'name': {'type': 'string'},
+            'source': {
+                'anyOf': [{'type': 'string'},
+                          {'type': 'array', 'items': {'type': 'string'}}],
+            },
+            'store': {'type': 'string', 'enum': ['gcs', 's3', 'local']},
+            'persistent': {'type': 'boolean'},
+            'mode': {'type': 'string',
+                     'enum': ['MOUNT', 'COPY', 'mount', 'copy']},
+            '_force_delete': {'type': 'boolean'},
+        },
+    }
+
+
+def get_service_schema() -> Dict[str, Any]:
+    return {
+        '$schema': 'http://json-schema.org/draft-07/schema#',
+        'type': 'object',
+        'additionalProperties': False,
+        'required': ['readiness_probe'],
+        'properties': {
+            'readiness_probe': {
+                'anyOf': [{'type': 'string'}, {
+                    'type': 'object',
+                    'additionalProperties': False,
+                    'required': ['path'],
+                    'properties': {
+                        'path': {'type': 'string'},
+                        'initial_delay_seconds': {'type': 'number'},
+                        'timeout_seconds': {'type': 'number'},
+                        'post_data': {'type': ['string', 'object']},
+                    },
+                }],
+            },
+            'replica_policy': {
+                'type': 'object',
+                'additionalProperties': False,
+                'properties': {
+                    'min_replicas': {'type': 'integer', 'minimum': 0},
+                    'max_replicas': {'type': 'integer', 'minimum': 0},
+                    'target_qps_per_replica': {'type': 'number'},
+                    'upscale_delay_seconds': {'type': 'number'},
+                    'downscale_delay_seconds': {'type': 'number'},
+                    'base_ondemand_fallback_replicas': {'type': 'integer'},
+                    'use_ondemand_fallback': {'type': 'boolean'},
+                },
+            },
+            'replicas': {'type': 'integer'},
+        },
+    }
+
+
+def get_task_schema() -> Dict[str, Any]:
+    return {
+        '$schema': 'http://json-schema.org/draft-07/schema#',
+        'type': 'object',
+        'additionalProperties': False,
+        'properties': {
+            'name': {'type': ['string', 'null']},
+            'workdir': {'type': ['string', 'null']},
+            'setup': {'type': ['string', 'null']},
+            'run': {'type': ['string', 'null']},
+            'envs': {'type': 'object',
+                     'additionalProperties': {'type': ['string', 'number',
+                                                       'boolean', 'null']}},
+            'num_nodes': {'type': ['integer', 'null']},
+            'resources': {'type': ['object', 'null']},
+            'file_mounts': {'type': ['object', 'null']},
+            'storage_mounts': {'type': ['object', 'null']},
+            'service': {'type': ['object', 'null']},
+            'experimental': {
+                'type': 'object',
+                'additionalProperties': False,
+                'properties': {'config_overrides': {'type': 'object'}},
+            },
+        },
+    }
+
+
+def get_config_schema() -> Dict[str, Any]:
+    """Schema for $SKYTPU_HOME/config.yaml."""
+    controller_resources = {
+        'type': 'object',
+        'additionalProperties': False,
+        'properties': {
+            'controller': {
+                'type': 'object',
+                'additionalProperties': False,
+                'properties': {
+                    'resources': {'type': 'object'},
+                },
+            },
+        },
+    }
+    return {
+        '$schema': 'http://json-schema.org/draft-07/schema#',
+        'type': 'object',
+        'additionalProperties': False,
+        'properties': {
+            'jobs': controller_resources,
+            'serve': controller_resources,
+            'tpu': {
+                'type': 'object',
+                'additionalProperties': False,
+                'properties': {
+                    'runtime_version': {'type': 'string'},
+                    'provision_mode': {
+                        'type': 'string',
+                        'enum': ['direct', 'queued', 'auto'],
+                    },
+                },
+            },
+            'gcp': {
+                'type': 'object',
+                'additionalProperties': False,
+                'properties': {
+                    'project_id': {'type': 'string'},
+                    'labels': {'type': 'object'},
+                    'managed_instance_group': {'type': 'object'},
+                },
+            },
+            'nvidia_gpus': {
+                'type': 'object',
+                'additionalProperties': False,
+                'properties': {'disable': {'type': 'boolean'}},
+            },
+            'allowed_clouds': {
+                'type': 'array',
+                'items': {'type': 'string'},
+            },
+            'admin_policy': {'type': 'string'},
+        },
+    }

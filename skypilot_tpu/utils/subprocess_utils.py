@@ -1,0 +1,155 @@
+"""Subprocess helpers: logged execution and bounded parallel fan-out.
+
+Parity: /root/reference/sky/utils/subprocess_utils.py (run_in_parallel,
+process-tree kill) — the fan-out primitive used for gang operations across
+all hosts of a TPU slice.
+"""
+from __future__ import annotations
+
+import os
+import resource
+import shlex
+import subprocess
+from concurrent import futures
+from typing import Any, Callable, Iterable, List, Optional, Tuple, Union
+
+import psutil
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+def get_parallel_threads() -> int:
+    """Cap parallelism; ssh fan-out to 64 slice hosts should not fork-bomb."""
+    cpu_count = os.cpu_count() or 8
+    return max(4, min(cpu_count, 32))
+
+
+def run_in_parallel(func: Callable,
+                    args: Iterable[Any],
+                    num_threads: Optional[int] = None) -> List[Any]:
+    """Map func over args with a thread pool; preserves order; re-raises."""
+    args = list(args)
+    if not args:
+        return []
+    if len(args) == 1:
+        return [func(args[0])]
+    n = num_threads or get_parallel_threads()
+    with futures.ThreadPoolExecutor(max_workers=min(n, len(args))) as pool:
+        return list(pool.map(func, args))
+
+
+def run(cmd: Union[str, List[str]], **kwargs: Any) -> subprocess.CompletedProcess:
+    shell = isinstance(cmd, str)
+    kwargs.setdefault('shell', shell)
+    kwargs.setdefault('check', True)
+    kwargs.setdefault('executable', '/bin/bash' if shell else None)
+    if not shell:
+        kwargs.pop('executable', None)
+    return subprocess.run(cmd, **kwargs)
+
+
+def run_no_outputs(cmd: Union[str, List[str]], **kwargs: Any):
+    return run(cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+               **kwargs)
+
+
+def handle_returncode(returncode: int,
+                      command: str,
+                      error_msg: Union[str, Callable[[], str]],
+                      stderr: Optional[str] = None,
+                      stream_logs: bool = True) -> None:
+    if returncode == 0:
+        return
+    echo = logger.error if stream_logs else logger.debug
+    if stderr:
+        echo(stderr)
+    msg = error_msg() if callable(error_msg) else error_msg
+    raise exceptions.CommandError(returncode, command, msg, stderr)
+
+
+def kill_children_processes(parent_pids: Optional[List[int]] = None,
+                            force: bool = False) -> None:
+    """Kill whole process trees (orphan prevention on job cancel).
+
+    Parity: reference subprocess_daemon.py:40-80 — kill the user job's
+    descendants so `cancel` never leaves stray trainers holding TPU chips
+    (a leaked process keeps libtpu locked and bricks the slice for the
+    next job, so this matters more on TPU than on GPU).
+    """
+    if parent_pids is None:
+        parent_pids = [os.getpid()]
+    procs: List[psutil.Process] = []
+    for pid in parent_pids:
+        try:
+            parent = psutil.Process(pid)
+        except psutil.NoSuchProcess:
+            continue
+        procs.extend(parent.children(recursive=True))
+        if pid != os.getpid():
+            procs.append(parent)
+    for proc in procs:
+        try:
+            if force:
+                proc.kill()
+            else:
+                proc.terminate()
+        except psutil.NoSuchProcess:
+            pass
+    gone, alive = psutil.wait_procs(procs, timeout=5)
+    del gone
+    for proc in alive:
+        try:
+            proc.kill()
+        except psutil.NoSuchProcess:
+            pass
+
+
+def kill_process_daemon(process_pid: int) -> None:
+    """Spawn a detached watcher that reaps `process_pid`'s tree if we die."""
+    daemon_script = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                                 'skylet', 'subprocess_daemon.py')
+    python = shlex.quote(os.environ.get('SKYTPU_PYTHON', 'python3'))
+    subprocess.Popen(
+        f'{python} {shlex.quote(daemon_script)} '
+        f'--parent-pid {os.getpid()} --proc-pid {process_pid}',
+        shell=True,
+        stdin=subprocess.DEVNULL,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True)
+
+
+def get_max_workers_for_file_mounts(num_items: int) -> int:
+    fd_limit, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+    fd_per_rsync = 5
+    max_workers = max(1, (fd_limit - 100) // fd_per_rsync)
+    return min(max_workers, num_items, get_parallel_threads())
+
+
+def run_with_retries(cmd: str,
+                     max_retry: int = 3,
+                     retry_returncode: Optional[List[int]] = None,
+                     retry_stderrs: Optional[List[str]] = None
+                     ) -> Tuple[int, str, str]:
+    """Run a shell command, retrying on specified returncodes/stderr patterns."""
+    retry_cnt = 0
+    while True:
+        proc = subprocess.run(cmd, shell=True, executable='/bin/bash',
+                              capture_output=True, text=True, check=False)
+        stdout, stderr = proc.stdout, proc.stderr
+        if proc.returncode == 0:
+            return 0, stdout, stderr
+        retry_cnt += 1
+        if retry_cnt > max_retry:
+            return proc.returncode, stdout, stderr
+        should_retry = False
+        if retry_returncode and proc.returncode in retry_returncode:
+            should_retry = True
+        if retry_stderrs and any(s in stderr for s in retry_stderrs):
+            should_retry = True
+        if not should_retry:
+            return proc.returncode, stdout, stderr
+        logger.debug(f'Retrying ({retry_cnt}/{max_retry}): {cmd}')

@@ -1,0 +1,127 @@
+"""Chrome trace-event timeline for control-plane profiling.
+
+Parity: /root/reference/sky/utils/timeline.py:1-133 — `@timeline.event`
+decorated spans plus FileLock contention spans, dumped as a Chrome
+trace-event JSON when SKYTPU_TIMELINE_FILE is set.
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, List, Optional, Union
+
+import filelock
+
+_events: List[dict] = []
+_events_lock = threading.Lock()
+_enabled_path: Optional[str] = None
+
+
+def _now_us() -> int:
+    return int(time.time() * 10**6)
+
+
+class Event:
+    """A named span; use as a context manager or via the @event decorator."""
+
+    def __init__(self, name: str, message: Optional[str] = None) -> None:
+        self._name = name
+        self._message = message
+
+    def begin(self) -> None:
+        self._record('B')
+
+    def end(self) -> None:
+        self._record('E')
+
+    def _record(self, phase: str) -> None:
+        if _enabled_path is None:
+            return
+        evt = {
+            'name': self._name,
+            'cat': 'default',
+            'ph': phase,
+            'ts': _now_us(),
+            'pid': os.getpid(),
+            'tid': threading.get_ident(),
+        }
+        if self._message is not None:
+            evt['args'] = {'message': self._message}
+        with _events_lock:
+            _events.append(evt)
+
+    def __enter__(self) -> 'Event':
+        self.begin()
+        return self
+
+    def __exit__(self, *args: Any) -> None:
+        self.end()
+
+
+def event(name_or_fn: Union[str, Callable], message: Optional[str] = None):
+    """Decorator (or decorator factory) recording the call as a span."""
+    if callable(name_or_fn):
+        fn = name_or_fn
+
+        @functools.wraps(fn)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            with Event(f'{fn.__module__}.{fn.__qualname__}'):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    def deco(fn: Callable) -> Callable:
+
+        @functools.wraps(fn)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            with Event(str(name_or_fn), message):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+class FileLockEvent:
+    """A filelock whose acquisition wait is recorded as a timeline span."""
+
+    def __init__(self, lockfile: str, timeout: float = -1) -> None:
+        self._lockfile = lockfile
+        os.makedirs(os.path.dirname(os.path.abspath(lockfile)), exist_ok=True)
+        self._lock = filelock.FileLock(lockfile, timeout)
+        self._hold_event = Event(f'[FileLock.hold]:{lockfile}')
+
+    def acquire(self) -> None:
+        with Event(f'[FileLock.acquire]:{self._lockfile}'):
+            self._lock.acquire()
+        self._hold_event.begin()
+
+    def release(self) -> None:
+        self._hold_event.end()
+        self._lock.release()
+
+    def __enter__(self) -> 'FileLockEvent':
+        self.acquire()
+        return self
+
+    def __exit__(self, *args: Any) -> None:
+        self.release()
+
+
+def save_timeline() -> None:
+    if _enabled_path is None or not _events:
+        return
+    with _events_lock:
+        payload = {'traceEvents': list(_events)}
+    os.makedirs(os.path.dirname(os.path.abspath(_enabled_path)), exist_ok=True)
+    with open(_enabled_path, 'w', encoding='utf-8') as f:
+        json.dump(payload, f)
+
+
+_enabled_path = os.environ.get('SKYTPU_TIMELINE_FILE')
+if _enabled_path is not None:
+    atexit.register(save_timeline)

@@ -1,0 +1,47 @@
+"""Shared test fixtures.
+
+Test strategy mirrors SURVEY.md §4: unit tests are hermetic (temp
+SKYTPU_HOME, no cloud access); compute tests run on a virtual 8-device CPU
+mesh (`xla_force_host_platform_device_count`) so multi-chip sharding is
+exercised without TPU hardware.
+"""
+from __future__ import annotations
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolated_home(tmp_path, monkeypatch):
+    """Every test gets a fresh SKYTPU_HOME (state.db, config, jobs.db)."""
+    home = tmp_path / 'skytpu_home'
+    home.mkdir()
+    monkeypatch.setenv('SKYTPU_HOME', str(home))
+    monkeypatch.setenv('SKYTPU_JOB_DB', str(home / 'jobs.db'))
+    monkeypatch.delenv('SKYTPU_CONFIG', raising=False)
+    from skypilot_tpu import config as config_mod
+    config_mod.reload_config()
+    yield home
+    config_mod.reload_config()
+
+
+@pytest.fixture
+def enable_all_infra(monkeypatch):
+    """Pretend every infra has credentials (parity: reference
+    tests/common.py enable_all_clouds), so optimizer/catalog tests run
+    offline."""
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu.clouds import registry
+    global_user_state.set_enabled_clouds(list(registry.CLOUD_REGISTRY.keys()))
+    for cloud in registry.CLOUD_REGISTRY.values():
+        monkeypatch.setattr(type(cloud), 'check_credentials',
+                            lambda self: (True, None))
+    yield

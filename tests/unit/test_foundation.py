@@ -1,0 +1,173 @@
+"""Unit tests: L0 foundation (state store, config, utils, logging)."""
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from skypilot_tpu import config as config_mod
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import status_lib
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import subprocess_utils
+
+
+class FakeHandle:
+    def __init__(self, name):
+        self.cluster_name = name
+        self.launched_resources = {'accelerator': 'tpu-v5e-8'}
+        self.launched_nodes = 1
+
+
+class TestGlobalUserState:
+
+    def test_add_and_get_cluster(self):
+        handle = FakeHandle('c1')
+        global_user_state.add_or_update_cluster('c1', handle, {'r'}, ready=False)
+        rec = global_user_state.get_cluster_from_name('c1')
+        assert rec is not None
+        assert rec['status'] == status_lib.ClusterStatus.INIT
+        assert rec['handle'].cluster_name == 'c1'
+        assert not rec['cluster_ever_up']
+
+        global_user_state.add_or_update_cluster('c1', handle, {'r'}, ready=True)
+        rec = global_user_state.get_cluster_from_name('c1')
+        assert rec['status'] == status_lib.ClusterStatus.UP
+        assert rec['cluster_ever_up']
+
+    def test_status_transitions_and_remove(self):
+        handle = FakeHandle('c2')
+        global_user_state.add_or_update_cluster('c2', handle, set(), ready=True)
+        global_user_state.set_cluster_status(
+            'c2', status_lib.ClusterStatus.STOPPED)
+        rec = global_user_state.get_cluster_from_name('c2')
+        assert rec['status'] == status_lib.ClusterStatus.STOPPED
+        global_user_state.remove_cluster('c2', terminate=True)
+        assert global_user_state.get_cluster_from_name('c2') is None
+
+    def test_set_status_missing_cluster_raises(self):
+        with pytest.raises(ValueError):
+            global_user_state.set_cluster_status(
+                'nope', status_lib.ClusterStatus.UP)
+
+    def test_autostop(self):
+        global_user_state.add_or_update_cluster('c3', FakeHandle('c3'), set(),
+                                                ready=True)
+        global_user_state.set_cluster_autostop_value('c3', 10, to_down=True)
+        rec = global_user_state.get_cluster_from_name('c3')
+        assert rec['autostop'] == 10
+        assert rec['to_down'] is True
+
+    def test_glob(self):
+        for name in ('train-1', 'train-2', 'serve-1'):
+            global_user_state.add_or_update_cluster(name, FakeHandle(name),
+                                                    set(), ready=True)
+        assert sorted(global_user_state.get_glob_cluster_names('train-*')) == [
+            'train-1', 'train-2'
+        ]
+
+    def test_cost_report_duration(self):
+        global_user_state.add_or_update_cluster('c4', FakeHandle('c4'), {'r'},
+                                                ready=True)
+        time.sleep(1.1)
+        global_user_state.set_cluster_status(
+            'c4', status_lib.ClusterStatus.STOPPED)
+        history = global_user_state.get_clusters_from_history()
+        rec = [h for h in history if h['name'] == 'c4'][0]
+        assert rec['duration'] >= 1
+
+    def test_enabled_clouds_roundtrip(self):
+        global_user_state.set_enabled_clouds(['gcp', 'local'])
+        assert set(global_user_state.get_enabled_clouds()) == {'gcp', 'local'}
+
+
+class TestConfig:
+
+    def test_missing_config_defaults(self):
+        assert config_mod.get_nested(('tpu', 'runtime_version'), 'x') == 'x'
+
+    def test_load_and_get_nested(self, _isolated_home):
+        cfg = _isolated_home / 'config.yaml'
+        cfg.write_text('tpu:\n  runtime_version: v2-alpha-tpuv5-lite\n')
+        config_mod.reload_config()
+        assert config_mod.get_nested(
+            ('tpu', 'runtime_version'), None) == 'v2-alpha-tpuv5-lite'
+
+    def test_invalid_config_rejected(self, _isolated_home):
+        cfg = _isolated_home / 'config.yaml'
+        cfg.write_text('bogus_key: 1\n')
+        config_mod.reload_config()
+        with pytest.raises(exceptions.InvalidSkyTpuConfigError):
+            config_mod.get_nested(('tpu',), None)
+
+    def test_task_override_allowed_keys_only(self, _isolated_home):
+        cfg = _isolated_home / 'config.yaml'
+        cfg.write_text('tpu:\n  runtime_version: a\n')
+        config_mod.reload_config()
+        v = config_mod.get_nested(('tpu', 'runtime_version'), None,
+                                  override_configs={'tpu': {'runtime_version': 'b'}})
+        assert v == 'b'
+        with pytest.raises(exceptions.InvalidSkyTpuConfigError):
+            config_mod.get_nested(('gcp', 'project_id'), None,
+                                  override_configs={'gcp': {'project_id': 'x'}})
+
+
+class TestCommonUtils:
+
+    def test_user_hash_stable(self):
+        h1 = common_utils.get_user_hash()
+        h2 = common_utils.get_user_hash()
+        assert h1 == h2
+        assert len(h1) == common_utils.USER_HASH_LENGTH
+
+    def test_cluster_name_validation(self):
+        common_utils.check_cluster_name_is_valid('ok-name_1')
+        with pytest.raises(exceptions.InvalidClusterNameError):
+            common_utils.check_cluster_name_is_valid('1bad')
+        with pytest.raises(exceptions.InvalidClusterNameError):
+            common_utils.check_cluster_name_is_valid('a' * 80)
+        common_utils.check_cluster_name_is_valid(None)
+
+    def test_cluster_name_on_cloud_truncates(self):
+        name = common_utils.make_cluster_name_on_cloud('x' * 60, max_length=30)
+        assert len(name) <= 30
+        assert common_utils.get_user_hash() in name
+
+    def test_backoff_grows(self):
+        b = common_utils.Backoff(initial_backoff=1.0)
+        v1 = b.current_backoff
+        v2 = b.current_backoff
+        assert v2 > v1 * 0.9
+
+    def test_yaml_roundtrip(self, tmp_path):
+        path = str(tmp_path / 'x.yaml')
+        common_utils.dump_yaml(path, {'a': 1, 'b': None})
+        assert common_utils.read_yaml(path) == {'a': 1, 'b': None}
+
+
+class TestSubprocessUtils:
+
+    def test_run_in_parallel_order(self):
+        out = subprocess_utils.run_in_parallel(lambda x: x * 2, [3, 1, 2])
+        assert out == [6, 2, 4]
+
+    def test_run_in_parallel_raises(self):
+        def boom(x):
+            raise RuntimeError('x')
+        with pytest.raises(RuntimeError):
+            subprocess_utils.run_in_parallel(boom, [1, 2])
+
+    def test_handle_returncode(self):
+        subprocess_utils.handle_returncode(0, 'true', 'no')
+        with pytest.raises(exceptions.CommandError):
+            subprocess_utils.handle_returncode(1, 'false', 'failed',
+                                               stream_logs=False)
+
+    def test_run_with_retries_retry_on_stderr(self):
+        rc, _, _ = subprocess_utils.run_with_retries('true')
+        assert rc == 0
+        rc, _, _ = subprocess_utils.run_with_retries(
+            'echo flaky >&2; false', max_retry=1, retry_stderrs=['flaky'])
+        assert rc != 0

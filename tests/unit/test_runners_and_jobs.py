@@ -1,0 +1,150 @@
+"""Unit tests: command runners (local-process transport) + job queue."""
+from __future__ import annotations
+
+import os
+import time
+
+from skypilot_tpu.skylet import job_lib, log_lib
+from skypilot_tpu.utils import command_runner
+
+
+def _mk_runner(tmp_path, name='host0'):
+    return command_runner.LocalProcessRunner(
+        node=(name, 0), root_dir=str(tmp_path / name))
+
+
+class TestLocalProcessRunner:
+
+    def test_run_and_outputs(self, tmp_path):
+        r = _mk_runner(tmp_path)
+        rc, out, err = r.run('echo hello; echo oops >&2',
+                             require_outputs=True, stream_logs=False)
+        assert rc == 0
+        assert out.strip() == 'hello'
+        assert err.strip() == 'oops'
+
+    def test_home_is_host_root(self, tmp_path):
+        r = _mk_runner(tmp_path)
+        rc, out, _ = r.run('cd ~ && pwd', require_outputs=True,
+                           stream_logs=False)
+        assert rc == 0
+        assert out.strip() == r.root_dir
+
+    def test_env_injection(self, tmp_path):
+        r = command_runner.LocalProcessRunner(
+            node=('h', 0), root_dir=str(tmp_path / 'h'),
+            env={'SKYTPU_HOST_RANK': '3'})
+        rc, out, _ = r.run('echo $SKYTPU_HOST_RANK', require_outputs=True,
+                           stream_logs=False)
+        assert rc == 0
+        assert out.strip() == '3'
+
+    def test_rsync_up_down(self, tmp_path):
+        src = tmp_path / 'src'
+        src.mkdir()
+        (src / 'a.txt').write_text('content')
+        r = _mk_runner(tmp_path)
+        r.rsync(str(src), '~/workdir', up=True, stream_logs=False)
+        assert (tmp_path / 'host0' / 'workdir' / 'a.txt').read_text() == 'content'
+        down = tmp_path / 'down'
+        r.rsync('~/workdir', str(down), up=False, stream_logs=False)
+        assert (down / 'a.txt').read_text() == 'content'
+
+    def test_gang_fanout(self, tmp_path):
+        runners = [_mk_runner(tmp_path, f'host{i}') for i in range(4)]
+        results = command_runner.run_on_all(runners, 'hostname > marker')
+        assert results == [0, 0, 0, 0]
+        for i in range(4):
+            assert (tmp_path / f'host{i}' / 'marker').exists()
+
+    def test_wait_until_ready(self, tmp_path):
+        runners = [_mk_runner(tmp_path, f'h{i}') for i in range(2)]
+        command_runner.wait_until_ready(runners, timeout=10)
+
+
+class TestLogLib:
+
+    def test_run_with_log_writes_file(self, tmp_path):
+        log = str(tmp_path / 'x.log')
+        rc = log_lib.run_with_log('echo line1; echo line2', log, shell=True)
+        assert rc == 0
+        assert open(log).read() == 'line1\nline2\n'
+
+    def test_run_bash_command_with_log_env(self, tmp_path):
+        log = str(tmp_path / 'y.log')
+        rc = log_lib.run_bash_command_with_log(
+            'echo "rank=$MYRANK"', log, env_vars={'MYRANK': '7'})
+        assert rc == 0
+        assert 'rank=7' in open(log).read()
+
+
+class TestJobLib:
+
+    def test_lifecycle(self):
+        job_id = job_lib.add_job('j1', 'user', 'ts-1', 'tpu-v5e-8')
+        assert job_lib.get_status(job_id) == job_lib.JobStatus.INIT
+        job_lib.set_status(job_id, job_lib.JobStatus.PENDING)
+        job_lib.set_job_started(job_id)
+        assert job_lib.get_status(job_id) == job_lib.JobStatus.RUNNING
+        assert not job_lib.is_cluster_idle()
+        job_lib.set_status(job_id, job_lib.JobStatus.SUCCEEDED)
+        assert job_lib.get_status(job_id).is_terminal()
+        assert job_lib.is_cluster_idle()
+        rec = job_lib.get_record(job_id)
+        assert rec['end_at'] is not None
+
+    def test_fifo_scheduler_runs_job(self, tmp_path):
+        marker = tmp_path / 'ran'
+        job_id = job_lib.add_job('j2', 'user', 'ts-2', '-')
+        job_lib.scheduler.queue(job_id, f'touch {marker}')
+        deadline = time.time() + 10
+        while not marker.exists() and time.time() < deadline:
+            time.sleep(0.1)
+        assert marker.exists()
+
+    def test_fifo_one_at_a_time(self, tmp_path):
+        # While a job is RUNNING, the next stays PENDING.
+        j1 = job_lib.add_job('a', 'u', 't1', '-')
+        job_lib.set_job_started(j1)
+        j2 = job_lib.add_job('b', 'u', 't2', '-')
+        job_lib.scheduler.queue(j2, 'true')
+        assert job_lib.get_status(j2) == job_lib.JobStatus.PENDING
+        job_lib.set_status(j1, job_lib.JobStatus.SUCCEEDED)
+        job_lib.scheduler.schedule_step()
+        deadline = time.time() + 5
+        while (job_lib.get_status(j2) == job_lib.JobStatus.PENDING and
+               time.time() < deadline):
+            time.sleep(0.05)
+        assert job_lib.get_status(j2) != job_lib.JobStatus.PENDING
+
+    def test_update_job_status_reaps_dead_pid(self):
+        job_id = job_lib.add_job('dead', 'u', 't3', '-')
+        job_lib.set_status(job_id, job_lib.JobStatus.RUNNING)
+        job_lib.set_pid(job_id, 2**22 + 12345)  # certainly not alive
+        job_lib.update_job_status([job_id])
+        assert job_lib.get_status(job_id) == job_lib.JobStatus.FAILED_DRIVER
+
+    def test_cancel_marks_cancelled(self):
+        job_id = job_lib.add_job('c', 'u', 't4', '-')
+        job_lib.set_status(job_id, job_lib.JobStatus.RUNNING)
+        cancelled = job_lib.cancel_jobs([job_id])
+        assert cancelled == [job_id]
+        assert job_lib.get_status(job_id) == job_lib.JobStatus.CANCELLED
+
+    def test_codegen_roundtrip_parsers(self):
+        assert job_lib.parse_job_id('blah\njob_id=17\n') == 17
+        assert job_lib.parse_tagged_json('x\nSTATUS:{"1": "RUNNING"}',
+                                         'STATUS:') == {'1': 'RUNNING'}
+
+    def test_codegen_add_job_executes(self, tmp_path):
+        # The generated one-liner must actually run under this interpreter.
+        import subprocess, sys
+        code = job_lib.JobLibCodeGen.add_job('n', 'u', 'ts', 'res')
+        env = dict(os.environ)
+        env['PYTHONPATH'] = os.pathsep.join(
+            [os.getcwd()] + env.get('PYTHONPATH', '').split(os.pathsep))
+        proc = subprocess.run(code, shell=True, executable='/bin/bash',
+                              capture_output=True, text=True, env=env,
+                              check=False)
+        assert proc.returncode == 0, proc.stderr
+        assert job_lib.parse_job_id(proc.stdout) >= 1
