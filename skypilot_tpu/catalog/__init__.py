@@ -1,0 +1,300 @@
+"""Catalog query API: accelerators, prices, instance shapes, regions.
+
+Parity: /root/reference/sky/clouds/service_catalog/__init__.py:56-357
+(list_accelerators, get_hourly_cost, get_instance_type_for_accelerator,
+validate_region_zone, ...) — reorganized so TPUs price by (generation zone
+offering × chip count) via `TpuSliceSpec` instead of an instance-type table.
+
+Every function takes a `cloud` name string ('gcp', 'local'); the cloud
+classes in `skypilot_tpu.clouds` call through here.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.catalog import common
+from skypilot_tpu.utils import accelerator_registry
+
+InstanceTypeInfo = common.InstanceTypeInfo
+TpuOffering = common.TpuOffering
+
+_INSTANCE_CSVS = {
+    'gcp': 'gcp_instances.csv',
+    'local': 'local_instances.csv',
+}
+_TPU_CSVS = {
+    'gcp': 'gcp_tpus.csv',
+}
+
+
+def _instances(cloud: str) -> Tuple[InstanceTypeInfo, ...]:
+    csv_name = _INSTANCE_CSVS.get(cloud)
+    if csv_name is None:
+        return ()
+    return common.load_instance_catalog(cloud, csv_name)
+
+
+def _tpus(cloud: str) -> Tuple[TpuOffering, ...]:
+    csv_name = _TPU_CSVS.get(cloud)
+    if csv_name is None:
+        return ()
+    return common.load_tpu_catalog(cloud, csv_name)
+
+
+# ------------------------------------------------------------------ pricing
+
+
+def get_tpu_hourly_cost(cloud: str,
+                        accelerator_name: str,
+                        use_spot: bool = False,
+                        region: Optional[str] = None,
+                        zone: Optional[str] = None) -> float:
+    """Slice $/hr = chips × per-chip-hour price (host VMs included)."""
+    spec = accelerator_registry.parse_tpu_name(accelerator_name)
+    if spec is None:
+        raise ValueError(f'Not a TPU accelerator: {accelerator_name}')
+    offerings = [
+        o for o in _tpus(cloud)
+        if o.generation == spec.generation and
+        (region is None or o.region == region) and
+        (zone is None or o.zone == zone)
+    ]
+    if not offerings:
+        raise exceptions.ResourcesUnavailableError(
+            f'No {spec.generation} TPU offering in cloud={cloud} '
+            f'region={region} zone={zone}.')
+    per_chip = min((o.spot_price_per_chip_hour if use_spot else
+                    o.price_per_chip_hour) for o in offerings)
+    return per_chip * spec.num_chips
+
+
+def get_hourly_cost(cloud: str,
+                    instance_type: str,
+                    use_spot: bool = False,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+    rows = [
+        r for r in _instances(cloud)
+        if r.instance_type == instance_type and
+        (region is None or r.region == region) and
+        (zone is None or r.zone == zone)
+    ]
+    if not rows:
+        raise exceptions.ResourcesUnavailableError(
+            f'Instance type {instance_type!r} not found in {cloud} catalog '
+            f'(region={region}, zone={zone}).')
+    return min((r.spot_price if use_spot else r.price) for r in rows)
+
+
+# ----------------------------------------------------------------- lookups
+
+
+def instance_type_exists(cloud: str, instance_type: str) -> bool:
+    return any(r.instance_type == instance_type for r in _instances(cloud))
+
+
+def get_vcpus_mem_from_instance_type(
+        cloud: str, instance_type: str) -> Tuple[Optional[float], Optional[float]]:
+    for r in _instances(cloud):
+        if r.instance_type == instance_type:
+            return r.cpu_count, r.memory_gib
+    return None, None
+
+
+def get_accelerators_from_instance_type(
+        cloud: str, instance_type: str) -> Optional[Dict[str, int]]:
+    for r in _instances(cloud):
+        if r.instance_type == instance_type:
+            if r.accelerator_name is None:
+                return None
+            return {r.accelerator_name: r.accelerator_count}
+    return None
+
+
+def get_instance_type_for_accelerator(
+        cloud: str,
+        accelerator_name: str,
+        accelerator_count: int,
+        cpus: Optional[str] = None,
+        memory: Optional[str] = None,
+        region: Optional[str] = None,
+        zone: Optional[str] = None) -> Optional[List[str]]:
+    """GPU accelerator → hosting instance types, cheapest first.
+
+    TPU accelerators do not map to instance types (the slice is the unit);
+    callers must branch on `accelerator_registry.is_tpu` first.
+    """
+    matches = [
+        r for r in _instances(cloud)
+        if r.accelerator_name is not None and
+        r.accelerator_name.lower() == accelerator_name.lower() and
+        r.accelerator_count == accelerator_count and
+        (region is None or r.region == region) and
+        (zone is None or r.zone == zone) and
+        _fits(r, cpus, memory)
+    ]
+    if not matches:
+        return None
+    by_type: Dict[str, float] = {}
+    for r in matches:
+        by_type[r.instance_type] = min(r.price,
+                                       by_type.get(r.instance_type, r.price))
+    return sorted(by_type, key=by_type.get)
+
+
+def _parse_cpus_or_memory(value: Optional[str]) -> Tuple[Optional[float], bool]:
+    """'4' → (4, exact); '4+' → (4, at-least); None → (None, ...)."""
+    if value is None:
+        return None, False
+    s = str(value).strip()
+    if s.endswith('+'):
+        return float(s[:-1]), True
+    return float(s), False
+
+
+def _fits(r: InstanceTypeInfo, cpus: Optional[str],
+          memory: Optional[str]) -> bool:
+    want_cpu, cpu_plus = _parse_cpus_or_memory(cpus)
+    if want_cpu is not None:
+        if cpu_plus and r.cpu_count < want_cpu:
+            return False
+        if not cpu_plus and r.cpu_count != want_cpu:
+            return False
+    want_mem, mem_plus = _parse_cpus_or_memory(memory)
+    if want_mem is not None:
+        if mem_plus and r.memory_gib < want_mem:
+            return False
+        if not mem_plus and r.memory_gib != want_mem:
+            return False
+    return True
+
+
+def get_default_instance_type(cloud: str,
+                              cpus: Optional[str] = None,
+                              memory: Optional[str] = None) -> Optional[str]:
+    """Cheapest CPU-only instance satisfying the cpus/memory request.
+
+    Defaults mirror the reference (8 vCPUs, cpus-to-memory 1:4) when no
+    request is given.
+    """
+    if cpus is None and memory is None:
+        cpus = '8+'
+    candidates = [
+        r for r in _instances(cloud)
+        if r.accelerator_name is None and _fits(r, cpus, memory)
+    ]
+    if not candidates:
+        return None
+    return min(candidates, key=lambda r: r.price).instance_type
+
+
+# ----------------------------------------------------------- regions/zones
+
+
+def get_region_zones_for_instance_type(
+        cloud: str, instance_type: str,
+        use_spot: bool = False) -> List[Tuple[str, str]]:
+    rows = [r for r in _instances(cloud) if r.instance_type == instance_type]
+    rows.sort(key=lambda r: r.spot_price if use_spot else r.price)
+    return [(r.region, r.zone) for r in rows]
+
+
+def get_region_zones_for_tpu(cloud: str,
+                             accelerator_name: str,
+                             use_spot: bool = False) -> List[Tuple[str, str]]:
+    spec = accelerator_registry.parse_tpu_name(accelerator_name)
+    if spec is None:
+        return []
+    offs = [o for o in _tpus(cloud) if o.generation == spec.generation]
+    offs.sort(key=lambda o: (o.spot_price_per_chip_hour
+                             if use_spot else o.price_per_chip_hour))
+    return [(o.region, o.zone) for o in offs]
+
+
+def validate_region_zone(
+        cloud: str, region: Optional[str],
+        zone: Optional[str]) -> Tuple[Optional[str], Optional[str]]:
+    """Check (region, zone) appear in the catalog; infer region from zone."""
+    known: Dict[str, set] = collections.defaultdict(set)
+    for r in _instances(cloud):
+        known[r.region].add(r.zone)
+    for o in _tpus(cloud):
+        known[o.region].add(o.zone)
+    if zone is not None and region is None:
+        for reg, zones in known.items():
+            if zone in zones:
+                region = reg
+                break
+        else:
+            raise ValueError(f'Unknown zone {zone!r} for cloud {cloud}.')
+    if region is not None:
+        if region not in known:
+            raise ValueError(f'Unknown region {region!r} for cloud {cloud}. '
+                             f'Known: {sorted(known)}')
+        if zone is not None and zone not in known[region]:
+            raise ValueError(f'Zone {zone!r} is not in region {region!r} '
+                             f'for cloud {cloud}.')
+    return region, zone
+
+
+# ------------------------------------------------------------- enumeration
+
+
+@dataclasses.dataclass(frozen=True)
+class AcceleratorOffering:
+    """One row of `list_accelerators` output (CLI `show-tpus` / `show-gpus`)."""
+    cloud: str
+    accelerator_name: str
+    accelerator_count: int
+    instance_type: Optional[str]   # None for TPU slices
+    num_hosts: int
+    price: float
+    spot_price: float
+    region: str
+
+
+def list_accelerators(
+        name_filter: Optional[str] = None,
+        clouds: Optional[List[str]] = None,
+        max_tpu_chips: int = 1024
+) -> Dict[str, List[AcceleratorOffering]]:
+    clouds = clouds or list(_INSTANCE_CSVS)
+    result: Dict[str, List[AcceleratorOffering]] = collections.defaultdict(list)
+    for cloud in clouds:
+        seen_gpu = set()
+        for r in _instances(cloud):
+            if r.accelerator_name is None:
+                continue
+            key = (r.instance_type, r.region)
+            if key in seen_gpu:
+                continue
+            seen_gpu.add(key)
+            result[r.accelerator_name].append(
+                AcceleratorOffering(cloud, r.accelerator_name,
+                                    r.accelerator_count, r.instance_type, 1,
+                                    r.price, r.spot_price, r.region))
+        tpu_regions: Dict[str, TpuOffering] = {}
+        for o in _tpus(cloud):
+            cur = tpu_regions.get(o.generation)
+            if cur is None or o.price_per_chip_hour < cur.price_per_chip_hour:
+                tpu_regions[o.generation] = o
+        for name in accelerator_registry.list_tpu_names(max_tpu_chips):
+            spec = accelerator_registry.parse_tpu_name(name)
+            assert spec is not None, name
+            o = tpu_regions.get(spec.generation)
+            if o is None:
+                continue
+            result[name].append(
+                AcceleratorOffering(
+                    cloud, name, spec.num_chips, None, spec.num_hosts,
+                    o.price_per_chip_hour * spec.num_chips,
+                    o.spot_price_per_chip_hour * spec.num_chips, o.region))
+    if name_filter:
+        lowered = name_filter.lower()
+        result = collections.defaultdict(
+            list,
+            {k: v for k, v in result.items() if lowered in k.lower()})
+    return dict(result)

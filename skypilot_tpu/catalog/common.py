@@ -1,0 +1,105 @@
+"""Catalog data model + CSV loading.
+
+Parity: /root/reference/sky/clouds/service_catalog/common.py:33-553
+(`InstanceTypeInfo`, LazyDataFrame CSV catalogs, query helpers). Differences:
+(1) plain-stdlib csv instead of pandas — catalogs here are small embedded
+snapshots, refreshable by `catalog.data_fetchers`; (2) TPU offerings are a
+separate first-class table keyed by *generation* with per-chip-hour pricing,
+so every valid slice shape (`tpu-v5p-64`) prices as chips × chip-price
+without a combinatorial instance table.
+"""
+from __future__ import annotations
+
+import csv
+import dataclasses
+import functools
+import os
+from typing import Dict, List, Optional, Tuple
+
+_DATA_DIR = os.path.join(os.path.dirname(__file__), 'data')
+
+
+@dataclasses.dataclass(frozen=True)
+class InstanceTypeInfo:
+    """One (instance type, zone) VM offering."""
+    cloud: str
+    instance_type: str
+    accelerator_name: Optional[str]
+    accelerator_count: int
+    cpu_count: float
+    memory_gib: float
+    price: float
+    spot_price: float
+    region: str
+    zone: str
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuOffering:
+    """One (TPU generation, zone) offering, priced per chip-hour.
+
+    TPU-VM pricing includes the host VMs, so slice cost is simply
+    num_chips * price_per_chip_hour.
+    """
+    cloud: str
+    generation: str            # 'v5e'
+    price_per_chip_hour: float
+    spot_price_per_chip_hour: float
+    region: str
+    zone: str
+
+
+def _read_csv(name: str) -> List[Dict[str, str]]:
+    path = os.path.join(_DATA_DIR, name)
+    # A user-refreshed catalog (written by data_fetchers) takes precedence.
+    from skypilot_tpu.utils import common_utils  # pylint: disable=import-outside-toplevel
+    user_path = os.path.join(common_utils.skytpu_home(), 'catalogs', name)
+    if os.path.exists(user_path):
+        path = user_path
+    if not os.path.exists(path):
+        return []
+    with open(path, newline='', encoding='utf-8') as f:
+        return list(csv.DictReader(f))
+
+
+@functools.lru_cache(maxsize=None)
+def load_instance_catalog(cloud: str, csv_name: str) -> Tuple[InstanceTypeInfo, ...]:
+    rows = []
+    for r in _read_csv(csv_name):
+        rows.append(
+            InstanceTypeInfo(
+                cloud=cloud,
+                instance_type=r['InstanceType'],
+                accelerator_name=r['AcceleratorName'] or None,
+                accelerator_count=int(r['AcceleratorCount'] or 0),
+                cpu_count=float(r['vCPUs']),
+                memory_gib=float(r['MemoryGiB']),
+                price=float(r['Price']),
+                spot_price=float(r['SpotPrice']),
+                region=r['Region'],
+                zone=r['AvailabilityZone'],
+            ))
+    return tuple(rows)
+
+
+@functools.lru_cache(maxsize=None)
+def load_tpu_catalog(cloud: str, csv_name: str) -> Tuple[TpuOffering, ...]:
+    rows = []
+    for r in _read_csv(csv_name):
+        # 'tpu-v5e' → 'v5e'
+        generation = r['AcceleratorName'].removeprefix('tpu-')
+        rows.append(
+            TpuOffering(
+                cloud=cloud,
+                generation=generation,
+                price_per_chip_hour=float(r['PricePerChipHour']),
+                spot_price_per_chip_hour=float(r['SpotPricePerChipHour']),
+                region=r['Region'],
+                zone=r['AvailabilityZone'],
+            ))
+    return tuple(rows)
+
+
+def clear_catalog_caches() -> None:
+    load_instance_catalog.cache_clear()
+    load_tpu_catalog.cache_clear()

@@ -1,0 +1,184 @@
+"""Cloud abstraction: capability flags, pricing, feasibility, deploy vars.
+
+Parity: /root/reference/sky/clouds/cloud.py:28-820 (`Cloud` ABC,
+`CloudImplementationFeatures`, region/zone iteration, pricing hooks,
+`make_deploy_resources_variables`, feasibility, credential checks).
+TPU-first reshaping: feasibility returns *slice launchables* (a TPU slice or
+a GPU/CPU VM group) and deploy variables describe a slice request (generation,
+topology, hosts, capacity type incl. QUEUED) instead of a Ray autoscaler
+node config.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+class CloudImplementationFeatures(enum.Enum):
+    """Capabilities a task may require; clouds declare what they cannot do.
+
+    Parity: reference cloud.py:28-48, extended with TPU capacity modes.
+    """
+    STOP = 'stop'
+    MULTI_NODE = 'multi_node'
+    IMAGE_ID = 'image_id'
+    DOCKER_IMAGE = 'docker_image'
+    SPOT_INSTANCE = 'spot_instance'
+    QUEUED_RESOURCE = 'queued_resource'    # async TPU capacity (new)
+    RESERVATION = 'reservation'
+    CUSTOM_DISK_TIER = 'custom_disk_tier'
+    OPEN_PORTS = 'open_ports'
+    STORAGE_MOUNTING = 'storage_mounting'
+    HOST_CONTROLLERS = 'host_controllers'
+    AUTOSTOP = 'autostop'
+    TPU = 'tpu'
+    CLONE_DISK = 'clone_disk'
+
+
+class ProvisionMode(enum.Enum):
+    """How TPU capacity is requested (`resources.capacity` in task YAML)."""
+    ON_DEMAND = 'on_demand'
+    SPOT = 'spot'
+    QUEUED = 'queued'        # GCP queued-resources: async, may WAIT
+    RESERVED = 'reserved'
+
+
+@dataclasses.dataclass
+class Region:
+    name: str
+    zones: List['Zone'] = dataclasses.field(default_factory=list)
+
+    def set_zones(self, zones: List['Zone']) -> 'Region':
+        self.zones = zones
+        return self
+
+
+@dataclasses.dataclass
+class Zone:
+    name: str
+    region: Optional[str] = None
+
+
+class Cloud:
+    """Base class for infra providers (GCP TPU/GPU, GKE, Local)."""
+
+    # Subclasses override.
+    _REPR = 'Cloud'
+    # Which provision module implements this cloud
+    # (skypilot_tpu.provision.<name>).
+    PROVISIONER = 'none'
+
+    _CLOUD_UNSUPPORTED_FEATURES: Dict[CloudImplementationFeatures, str] = {}
+
+    def __repr__(self) -> str:
+        return self._REPR
+
+    @property
+    def name(self) -> str:
+        return self._REPR.lower()
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Cloud) and self._REPR == other._REPR
+
+    def __hash__(self) -> int:
+        return hash(self._REPR)
+
+    # --------------------------------------------------------- capabilities
+
+    @classmethod
+    def check_features_are_supported(
+            cls, resources: 'resources_lib.Resources',
+            requested_features: Set[CloudImplementationFeatures]) -> None:
+        """Raise NotSupportedError if any requested feature is unsupported."""
+        del resources
+        from skypilot_tpu import exceptions  # pylint: disable=import-outside-toplevel
+        unsupported = {
+            f: reason for f, reason in cls._CLOUD_UNSUPPORTED_FEATURES.items()
+            if f in requested_features
+        }
+        if unsupported:
+            raise exceptions.NotSupportedError(
+                f'{cls._REPR} does not support: '
+                f'{ {f.value: r for f, r in unsupported.items()} }')
+
+    # ------------------------------------------------------- regions/zones
+
+    def regions_with_offering(self, resources: 'resources_lib.Resources'
+                              ) -> List[Region]:
+        raise NotImplementedError
+
+    def zones_provision_loop(
+            self, resources: 'resources_lib.Resources',
+            region: Optional[str] = None
+    ) -> Iterator[Tuple[Region, Optional[List[Zone]]]]:
+        """Yield (region, zones) tuples in provisioning-attempt order.
+
+        Mirrors the reference's `_yield_zones` contract
+        (cloud_vm_ray_backend.py:1178): the failover loop walks this.
+        """
+        for r in self.regions_with_offering(resources):
+            if region is not None and r.name != region:
+                continue
+            yield r, r.zones or None
+
+    # ------------------------------------------------------------- pricing
+
+    def instance_type_to_hourly_cost(self, instance_type: str, use_spot: bool,
+                                     region: Optional[str],
+                                     zone: Optional[str]) -> float:
+        raise NotImplementedError
+
+    def accelerators_to_hourly_cost(self, accelerators: Dict[str, int],
+                                    use_spot: bool, region: Optional[str],
+                                    zone: Optional[str]) -> float:
+        """Extra cost of accelerators (0 when bundled into instance price)."""
+        raise NotImplementedError
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        return 0.0
+
+    # -------------------------------------------------------- feasibility
+
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Tuple[List['resources_lib.Resources'], List[str]]:
+        """Concretize a (possibly partial) request into launchable resources.
+
+        Returns (launchables, fuzzy_candidate_names). Parity:
+        reference cloud.py:369 + optimizer.py:1255.
+        """
+        raise NotImplementedError
+
+    def get_default_instance_type(self, cpus: Optional[str],
+                                  memory: Optional[str]) -> Optional[str]:
+        raise NotImplementedError
+
+    def validate_region_zone(self, region: Optional[str], zone: Optional[str]
+                             ) -> Tuple[Optional[str], Optional[str]]:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ deploy
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: Region, zones: Optional[List[Zone]]) -> Dict[str, Any]:
+        """Resources → variables consumed by this cloud's provisioner."""
+        raise NotImplementedError
+
+    # --------------------------------------------------------- credentials
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        """(ok, reason-if-not)."""
+        raise NotImplementedError
+
+    def get_current_user_identity(self) -> Optional[List[str]]:
+        return None
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        """Local credential files to replicate onto provisioned hosts."""
+        return {}

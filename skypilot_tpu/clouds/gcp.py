@@ -1,0 +1,240 @@
+"""GCP: TPU slices (TPU-VM), GPU VMs, CPU VMs.
+
+Parity: /root/reference/sky/clouds/gcp.py:190-934 (TPU-VM vs TPU-node
+distinction, tpu template vars, pod-cannot-stop, spot-TPU cleanup) — rebuilt
+around slices: there is no 'TPU-node' legacy mode and no `instance_type ==
+'TPU-VM'` sentinel; a TPU request carries no instance type at all and deploys
+through the queued-resources/TPU-VM API with an explicit capacity mode.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu import config as config_lib
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import accelerator_registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+# Default TPU software version per generation (overridable via
+# `accelerator_args: {runtime_version: ...}` or config tpu.runtime_version).
+_DEFAULT_RUNTIME_VERSIONS = {
+    'v2': 'tpu-ubuntu2204-base',
+    'v3': 'tpu-ubuntu2204-base',
+    'v4': 'tpu-ubuntu2204-base',
+    'v5e': 'v2-alpha-tpuv5-lite',
+    'v5p': 'v2-alpha-tpuv5',
+    'v6e': 'v2-alpha-tpuv6e',
+}
+
+# GCP TPU API accelerator-type spelling per generation: the API still calls
+# v5e 'v5litepod'.
+_API_GENERATION_NAMES = {'v5e': 'v5litepod'}
+
+
+def tpu_api_accelerator_type(spec: accelerator_registry.TpuSliceSpec) -> str:
+    gen = _API_GENERATION_NAMES.get(spec.generation, spec.generation)
+    return f'{gen}-{spec.size}'
+
+
+class GCP(cloud_lib.Cloud):
+    _REPR = 'GCP'
+    PROVISIONER = 'gcp'
+
+    _CLOUD_UNSUPPORTED_FEATURES = {
+        cloud_lib.CloudImplementationFeatures.CLONE_DISK:
+            'Disk cloning is not supported on GCP TPU-VMs.',
+    }
+
+    @classmethod
+    def check_features_are_supported(cls, resources, requested_features):
+        super().check_features_are_supported(resources, requested_features)
+        from skypilot_tpu import exceptions  # pylint: disable=import-outside-toplevel
+        spec = resources.tpu_spec
+        if spec is not None and spec.is_pod and (
+                cloud_lib.CloudImplementationFeatures.STOP
+                in requested_features):
+            # Parity: reference gcp.py:190-201 — multi-host slices cannot be
+            # stopped, only deleted.
+            raise exceptions.NotSupportedError(
+                f'Multi-host TPU slice {spec.name} cannot be stopped '
+                '(GCP limitation); use down/terminate instead.')
+
+    # ------------------------------------------------------- regions/zones
+
+    def regions_with_offering(self, resources) -> List[cloud_lib.Region]:
+        spec = resources.tpu_spec
+        if spec is not None:
+            pairs = catalog.get_region_zones_for_tpu('gcp', spec.name,
+                                                     resources.use_spot)
+        elif resources.instance_type is not None:
+            pairs = catalog.get_region_zones_for_instance_type(
+                'gcp', resources.instance_type, resources.use_spot)
+        else:
+            pairs = []
+        regions: Dict[str, cloud_lib.Region] = {}
+        for region_name, zone_name in pairs:
+            if resources.region is not None and region_name != resources.region:
+                continue
+            if resources.zone is not None and zone_name != resources.zone:
+                continue
+            region = regions.setdefault(region_name,
+                                        cloud_lib.Region(region_name))
+            region.zones.append(cloud_lib.Zone(zone_name, region_name))
+        return list(regions.values())
+
+    # ------------------------------------------------------------- pricing
+
+    def instance_type_to_hourly_cost(self, instance_type, use_spot, region,
+                                     zone) -> float:
+        return catalog.get_hourly_cost('gcp', instance_type, use_spot, region,
+                                       zone)
+
+    def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
+                                    zone) -> float:
+        acc, _ = next(iter(accelerators.items()))
+        if accelerator_registry.is_tpu(acc):
+            return catalog.get_tpu_hourly_cost('gcp', acc, use_spot, region,
+                                               zone)
+        # GPU prices are bundled into the hosting instance type's price.
+        return 0.0
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # Public GCP internet egress tiering (reference optimizer.py:76-105
+        # uses the same shape for its egress model).
+        if num_gigabytes <= 0:
+            return 0.0
+        if num_gigabytes <= 1024:
+            return num_gigabytes * 0.12
+        return 1024 * 0.12 + (num_gigabytes - 1024) * 0.11
+
+    # -------------------------------------------------------- feasibility
+
+    def get_feasible_launchable_resources(self, resources):
+        fuzzy: List[str] = []
+        launchable: List['resources_lib.Resources'] = []
+        spec = resources.tpu_spec
+        if spec is not None:
+            regions = self.regions_with_offering(resources)
+            if regions:
+                launchable.append(
+                    resources.copy(cloud=self, instance_type=None))
+            else:
+                fuzzy.extend(
+                    n for n in accelerator_registry.list_tpu_names(64)
+                    if n.split('-')[1] == spec.generation)
+            return launchable, fuzzy
+        if resources.accelerators:
+            acc, count = next(iter(resources.accelerators.items()))
+            instance_types = catalog.get_instance_type_for_accelerator(
+                'gcp', acc, count, resources.cpus, resources.memory,
+                resources.region, resources.zone)
+            if not instance_types:
+                offerings = catalog.list_accelerators(name_filter=acc,
+                                                      clouds=['gcp'])
+                fuzzy.extend(sorted(offerings))
+                return [], fuzzy
+            return [
+                resources.copy(cloud=self, instance_type=instance_types[0])
+            ], fuzzy
+        if resources.instance_type is not None:
+            if catalog.instance_type_exists('gcp', resources.instance_type):
+                return [resources.copy(cloud=self)], fuzzy
+            return [], fuzzy
+        default = self.get_default_instance_type(resources.cpus,
+                                                 resources.memory)
+        if default is None:
+            return [], fuzzy
+        return [resources.copy(cloud=self, instance_type=default)], fuzzy
+
+    def get_default_instance_type(self, cpus, memory) -> Optional[str]:
+        return catalog.get_default_instance_type('gcp', cpus, memory)
+
+    def validate_region_zone(self, region, zone):
+        return catalog.validate_region_zone('gcp', region, zone)
+
+    # ------------------------------------------------------------- deploy
+
+    def make_deploy_resources_variables(self, resources, cluster_name, region,
+                                        zones) -> Dict[str, Any]:
+        zone_names = [z.name for z in (zones or [])]
+        spec = resources.tpu_spec
+        common: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'region': region.name,
+            'zones': zone_names,
+            'use_spot': resources.use_spot,
+            'labels': dict(resources.labels or {}),
+            'ports': list(resources.ports or []),
+            'disk_size': resources.disk_size,
+            'image_id': resources.image_id,
+        }
+        if spec is not None:
+            args = resources.accelerator_args or {}
+            runtime_version = (
+                args.get('runtime_version') or
+                config_lib.get_nested(('tpu', 'runtime_version')) or
+                _DEFAULT_RUNTIME_VERSIONS[spec.generation])
+            provision_mode = resources.provision_mode.value
+            common.update({
+                'tpu': True,
+                'tpu_generation': spec.generation,
+                'tpu_accelerator_type': tpu_api_accelerator_type(spec),
+                'tpu_topology': spec.topology_str,
+                'tpu_num_chips': spec.num_chips,
+                'tpu_num_hosts': spec.num_hosts,
+                'tpu_runtime_version': runtime_version,
+                'provision_mode': provision_mode,
+                'reservation': args.get('reservation'),
+                'num_slices': resources.num_slices,
+            })
+        else:
+            common.update({
+                'tpu': False,
+                'instance_type': resources.instance_type,
+                'num_nodes': 1,
+            })
+        return common
+
+    # --------------------------------------------------------- credentials
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        adc = os.environ.get(
+            'GOOGLE_APPLICATION_CREDENTIALS',
+            os.path.expanduser(
+                '~/.config/gcloud/application_default_credentials.json'))
+        if os.path.exists(os.path.expanduser(adc)):
+            return True, None
+        try:
+            proc = subprocess.run(
+                ['gcloud', 'auth', 'list', '--format=value(account)'],
+                capture_output=True, text=True, timeout=10, check=False)
+            if proc.returncode == 0 and proc.stdout.strip():
+                return True, None
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            pass
+        return False, ('GCP credentials not found. Run `gcloud auth '
+                       'application-default login` or set '
+                       'GOOGLE_APPLICATION_CREDENTIALS.')
+
+    def get_current_user_identity(self) -> Optional[List[str]]:
+        try:
+            proc = subprocess.run(
+                ['gcloud', 'config', 'list', '--format=value(core.account)'],
+                capture_output=True, text=True, timeout=10, check=False)
+            account = proc.stdout.strip()
+            return [account] if account else None
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            return None
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        mounts = {}
+        gcloud_dir = os.path.expanduser('~/.config/gcloud')
+        if os.path.isdir(gcloud_dir):
+            mounts['~/.config/gcloud'] = '~/.config/gcloud'
+        return mounts

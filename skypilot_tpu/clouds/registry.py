@@ -1,0 +1,26 @@
+"""Cloud registry: name → Cloud singleton.
+
+Parity: /root/reference/sky/clouds/cloud_registry.py (CLOUD_REGISTRY dict).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.clouds import gcp
+from skypilot_tpu.clouds import local
+
+CLOUD_REGISTRY: Dict[str, cloud_lib.Cloud] = {
+    'gcp': gcp.GCP(),
+    'local': local.Local(),
+}
+
+
+def from_str(name: Optional[str]) -> Optional[cloud_lib.Cloud]:
+    if name is None:
+        return None
+    cloud = CLOUD_REGISTRY.get(name.lower())
+    if cloud is None:
+        raise ValueError(
+            f'Unknown cloud {name!r}. Available: {sorted(CLOUD_REGISTRY)}')
+    return cloud

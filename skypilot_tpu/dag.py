@@ -1,0 +1,96 @@
+"""Dag: a DAG of Tasks with a thread-local `with` context.
+
+Parity: /root/reference/sky/dag.py:1-101 — same surface (add/remove,
+`is_chain`, context manager) without the networkx dependency: the graph is
+small (tasks in a pipeline), so plain adjacency sets suffice.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set
+
+from skypilot_tpu import task as task_lib
+
+_thread_local = threading.local()
+
+
+def get_current_dag() -> Optional['Dag']:
+    stack = getattr(_thread_local, 'dag_stack', None)
+    if not stack:
+        return None
+    return stack[-1]
+
+
+class Dag:
+    def __init__(self, name: Optional[str] = None) -> None:
+        self.name = name
+        self.tasks: List[task_lib.Task] = []
+        self._edges: Dict[task_lib.Task, Set[task_lib.Task]] = {}
+
+    def add(self, task: task_lib.Task) -> None:
+        if task not in self.tasks:
+            self.tasks.append(task)
+            self._edges.setdefault(task, set())
+
+    def remove(self, task: task_lib.Task) -> None:
+        self.tasks.remove(task)
+        self._edges.pop(task, None)
+        for dsts in self._edges.values():
+            dsts.discard(task)
+
+    def add_edge(self, src: task_lib.Task, dst: task_lib.Task) -> None:
+        self.add(src)
+        self.add(dst)
+        self._edges[src].add(dst)
+
+    def successors(self, task: task_lib.Task) -> List[task_lib.Task]:
+        return [t for t in self.tasks if t in self._edges.get(task, ())]
+
+    def predecessors(self, task: task_lib.Task) -> List[task_lib.Task]:
+        return [t for t in self.tasks if task in self._edges.get(t, ())]
+
+    def in_degree(self, task: task_lib.Task) -> int:
+        return len(self.predecessors(task))
+
+    def out_degree(self, task: task_lib.Task) -> int:
+        return len(self._edges.get(task, ()))
+
+    def topological_order(self) -> List[task_lib.Task]:
+        order: List[task_lib.Task] = []
+        indeg = {t: self.in_degree(t) for t in self.tasks}
+        ready = [t for t in self.tasks if indeg[t] == 0]
+        while ready:
+            t = ready.pop(0)
+            order.append(t)
+            for s in self.successors(t):
+                indeg[s] -= 1
+                if indeg[s] == 0:
+                    ready.append(s)
+        if len(order) != len(self.tasks):
+            raise ValueError('Dag has a cycle.')
+        return order
+
+    def is_chain(self) -> bool:
+        if len(self.tasks) <= 1:
+            return True
+        num_roots = sum(1 for t in self.tasks if self.in_degree(t) == 0)
+        return num_roots == 1 and all(
+            self.out_degree(t) <= 1 and self.in_degree(t) <= 1
+            for t in self.tasks)
+
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    def __enter__(self) -> 'Dag':
+        stack = getattr(_thread_local, 'dag_stack', None)
+        if stack is None:
+            stack = []
+            _thread_local.dag_stack = stack
+        stack.append(self)
+        return self
+
+    def __exit__(self, *args) -> None:
+        _thread_local.dag_stack.pop()
+
+    def __repr__(self) -> str:
+        return f'<Dag {self.name or "<unnamed>"} tasks={len(self.tasks)}>'

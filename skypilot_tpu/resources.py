@@ -1,0 +1,438 @@
+"""Resources: what hardware a task wants, TPU slices first-class.
+
+Parity: /root/reference/sky/resources.py:30-1104 (cloud/instance/accelerator
+request, '4+' cpu grammar, validation against catalog, `less_demanding_than`
+reuse check, `get_cost`, YAML round-trip). TPU-first redesign:
+
+* ``accelerators: tpu-v5p-64`` resolves to a :class:`TpuSliceSpec` — the
+  slice (not a VM) is the launchable unit; no `instance_type: TPU-VM`
+  sentinel and no `accelerator_args: {tpu_vm: ...}` legacy switch
+  (reference resources.py:544-615).
+* ``capacity: on_demand | spot | queued | reserved`` generalizes `use_spot`
+  with GCP queued-resources and reservations (absent in the reference).
+* ``num_slices`` requests a multislice (DCN-connected) job.
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, List, Optional, Set, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import accelerator_registry
+
+if typing.TYPE_CHECKING:
+    pass
+
+_DEFAULT_DISK_SIZE_GB = 256
+
+
+class Resources:
+    """An (in)complete hardware request; becomes launchable once a cloud and
+    a concrete shape (instance type or TPU slice) are filled in."""
+
+    def __init__(
+        self,
+        cloud: Union[None, str, cloud_lib.Cloud] = None,
+        instance_type: Optional[str] = None,
+        accelerators: Union[None, str, Dict[str, int]] = None,
+        cpus: Union[None, int, float, str] = None,
+        memory: Union[None, int, float, str] = None,
+        use_spot: Optional[bool] = None,
+        capacity: Union[None, str, cloud_lib.ProvisionMode] = None,
+        job_recovery: Optional[str] = None,
+        region: Optional[str] = None,
+        zone: Optional[str] = None,
+        image_id: Optional[str] = None,
+        disk_size: Optional[int] = None,
+        ports: Optional[List[int]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        accelerator_args: Optional[Dict[str, Any]] = None,
+        num_slices: int = 1,
+        _validate: bool = True,
+    ) -> None:
+        from skypilot_tpu.clouds import registry  # pylint: disable=import-outside-toplevel
+        if isinstance(cloud, str):
+            cloud = registry.from_str(cloud)
+        self._cloud: Optional[cloud_lib.Cloud] = cloud
+        self._instance_type = instance_type
+        self._accelerators = self._parse_accelerators(accelerators)
+        self._cpus = None if cpus is None else str(cpus)
+        self._memory = None if memory is None else str(memory)
+
+        if isinstance(capacity, str):
+            capacity = cloud_lib.ProvisionMode(capacity.lower())
+        if capacity is None:
+            capacity = (cloud_lib.ProvisionMode.SPOT
+                        if use_spot else cloud_lib.ProvisionMode.ON_DEMAND)
+        elif use_spot is not None:
+            want_spot = capacity is cloud_lib.ProvisionMode.SPOT
+            if use_spot != want_spot:
+                raise exceptions.InvalidTaskError(
+                    f'use_spot={use_spot} conflicts with '
+                    f'capacity={capacity.value}.')
+        self._capacity = capacity
+
+        self._job_recovery = job_recovery
+        self._region = region
+        self._zone = zone
+        self._image_id = image_id
+        self._disk_size = (_DEFAULT_DISK_SIZE_GB
+                           if disk_size is None else int(disk_size))
+        self._ports = list(ports) if ports else None
+        self._labels = dict(labels) if labels else None
+        self._accelerator_args = (dict(accelerator_args)
+                                  if accelerator_args else None)
+        if num_slices < 1:
+            raise exceptions.InvalidTaskError(
+                f'num_slices must be >= 1, got {num_slices}.')
+        self._num_slices = int(num_slices)
+        if _validate:
+            self._try_validate()
+
+    # ------------------------------------------------------------- parsing
+
+    @staticmethod
+    def _parse_accelerators(
+            accelerators: Union[None, str, Dict[str, int]]
+    ) -> Optional[Dict[str, int]]:
+        """'A100:8' / 'tpu-v5e-16' / {'A100': 8} → canonical {name: count}."""
+        if accelerators is None:
+            return None
+        if isinstance(accelerators, dict):
+            items = list(accelerators.items())
+        else:
+            s = accelerators.strip()
+            if ':' in s:
+                name, _, count = s.partition(':')
+                try:
+                    items = [(name, int(count))]
+                except ValueError as e:
+                    raise exceptions.InvalidTaskError(
+                        f'Invalid accelerator count in {s!r}.') from e
+            else:
+                items = [(s, 1)]
+        if len(items) != 1:
+            raise exceptions.InvalidTaskError(
+                f'Exactly one accelerator type may be requested, '
+                f'got {accelerators!r}.')
+        name, count = items[0]
+        canonical = accelerator_registry.canonicalize_accelerator_name(name)
+        spec = accelerator_registry.parse_tpu_name(canonical)
+        if spec is not None:
+            if count not in (1, spec.num_chips):
+                raise exceptions.InvalidTaskError(
+                    f'TPU slices are atomic; request a larger slice type '
+                    f'instead of {canonical}:{count}.')
+            return {canonical: spec.num_chips}
+        return {canonical: int(count)}
+
+    # ---------------------------------------------------------- properties
+
+    @property
+    def cloud(self) -> Optional[cloud_lib.Cloud]:
+        return self._cloud
+
+    @property
+    def instance_type(self) -> Optional[str]:
+        return self._instance_type
+
+    @property
+    def accelerators(self) -> Optional[Dict[str, int]]:
+        if self._accelerators is not None:
+            return dict(self._accelerators)
+        if self._cloud is not None and self._instance_type is not None:
+            from skypilot_tpu import catalog  # pylint: disable=import-outside-toplevel
+            return catalog.get_accelerators_from_instance_type(
+                self._cloud.name, self._instance_type)
+        return None
+
+    @property
+    def tpu_spec(self) -> Optional[accelerator_registry.TpuSliceSpec]:
+        if self._accelerators is None:
+            return None
+        name = next(iter(self._accelerators))
+        return accelerator_registry.parse_tpu_name(name)
+
+    @property
+    def cpus(self) -> Optional[str]:
+        return self._cpus
+
+    @property
+    def memory(self) -> Optional[str]:
+        return self._memory
+
+    @property
+    def use_spot(self) -> bool:
+        return self._capacity is cloud_lib.ProvisionMode.SPOT
+
+    @property
+    def provision_mode(self) -> cloud_lib.ProvisionMode:
+        return self._capacity
+
+    @property
+    def job_recovery(self) -> Optional[str]:
+        return self._job_recovery
+
+    @property
+    def region(self) -> Optional[str]:
+        return self._region
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self._zone
+
+    @property
+    def image_id(self) -> Optional[str]:
+        return self._image_id
+
+    @property
+    def disk_size(self) -> int:
+        return self._disk_size
+
+    @property
+    def ports(self) -> Optional[List[int]]:
+        return list(self._ports) if self._ports else None
+
+    @property
+    def labels(self) -> Optional[Dict[str, str]]:
+        return dict(self._labels) if self._labels else None
+
+    @property
+    def accelerator_args(self) -> Optional[Dict[str, Any]]:
+        return dict(self._accelerator_args) if self._accelerator_args else None
+
+    @property
+    def num_slices(self) -> int:
+        return self._num_slices
+
+    @property
+    def num_hosts(self) -> int:
+        """Hosts per slice-cluster: the gang width of one launch unit."""
+        spec = self.tpu_spec
+        if spec is None:
+            return 1
+        return spec.num_hosts * self._num_slices
+
+    def is_launchable(self) -> bool:
+        return self._cloud is not None and (self._instance_type is not None or
+                                            self.tpu_spec is not None)
+
+    # ---------------------------------------------------------- validation
+
+    def _try_validate(self) -> None:
+        if self._region is not None or self._zone is not None:
+            if self._cloud is not None:
+                self._region, self._zone = self._cloud.validate_region_zone(
+                    self._region, self._zone)
+        spec = self.tpu_spec
+        if spec is not None:
+            if self._instance_type is not None:
+                raise exceptions.InvalidTaskError(
+                    'TPU requests must not set instance_type (the slice is '
+                    f'the unit): got {self._instance_type!r}.')
+            if self._capacity is cloud_lib.ProvisionMode.RESERVED:
+                args = self._accelerator_args or {}
+                if not args.get('reservation'):
+                    raise exceptions.InvalidTaskError(
+                        'capacity: reserved requires accelerator_args: '
+                        '{reservation: <name>}.')
+        elif self._num_slices != 1:
+            raise exceptions.InvalidTaskError(
+                'num_slices > 1 requires a TPU accelerator.')
+        if (self._instance_type is not None and self._cloud is not None and
+                not self._cloud.name == 'local'):
+            from skypilot_tpu import catalog  # pylint: disable=import-outside-toplevel
+            if not catalog.instance_type_exists(self._cloud.name,
+                                                self._instance_type):
+                raise exceptions.InvalidTaskError(
+                    f'Instance type {self._instance_type!r} not in the '
+                    f'{self._cloud.name} catalog.')
+
+    def get_required_cloud_features(
+            self) -> Set[cloud_lib.CloudImplementationFeatures]:
+        features: Set[cloud_lib.CloudImplementationFeatures] = set()
+        if self.use_spot:
+            features.add(cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE)
+        if self._capacity is cloud_lib.ProvisionMode.QUEUED:
+            features.add(cloud_lib.CloudImplementationFeatures.QUEUED_RESOURCE)
+        if self._capacity is cloud_lib.ProvisionMode.RESERVED:
+            features.add(cloud_lib.CloudImplementationFeatures.RESERVATION)
+        if self.tpu_spec is not None:
+            features.add(cloud_lib.CloudImplementationFeatures.TPU)
+        if self._image_id is not None:
+            features.add(cloud_lib.CloudImplementationFeatures.IMAGE_ID)
+        if self._ports:
+            features.add(cloud_lib.CloudImplementationFeatures.OPEN_PORTS)
+        return features
+
+    # ---------------------------------------------------------------- cost
+
+    def get_cost(self, seconds: float) -> float:
+        """USD for running this (launchable) resource for `seconds`."""
+        if self._cloud is None:
+            raise ValueError('Cost requires a concrete cloud.')
+        hours = seconds / 3600.0
+        cost = 0.0
+        if self._instance_type is not None:
+            cost += self._cloud.instance_type_to_hourly_cost(
+                self._instance_type, self.use_spot, self._region, self._zone)
+        if self._accelerators is not None:
+            cost += self._cloud.accelerators_to_hourly_cost(
+                self._accelerators, self.use_spot, self._region, self._zone)
+        return cost * hours * self._num_slices
+
+    # ---------------------------------------------------------------- copy
+
+    def copy(self, **override: Any) -> 'Resources':
+        fields: Dict[str, Any] = {
+            'cloud': self._cloud,
+            'instance_type': self._instance_type,
+            'accelerators': self._accelerators,
+            'cpus': self._cpus,
+            'memory': self._memory,
+            'capacity': self._capacity,
+            'job_recovery': self._job_recovery,
+            'region': self._region,
+            'zone': self._zone,
+            'image_id': self._image_id,
+            'disk_size': self._disk_size,
+            'ports': self._ports,
+            'labels': self._labels,
+            'accelerator_args': self._accelerator_args,
+            'num_slices': self._num_slices,
+        }
+        fields.update(override)
+        return Resources(**fields)
+
+    # -------------------------------------------------------------- reuse
+
+    def less_demanding_than(self, other: 'Resources') -> bool:
+        """Can a task wanting `self` run on a cluster launched as `other`?
+
+        Parity: reference resources.py:1104 — used by the cluster-reuse
+        check in the backend.
+        """
+        if self._cloud is not None and self._cloud != other._cloud:
+            return False
+        if (self._region is not None and other._region is not None and
+                self._region != other._region):
+            return False
+        if (self._zone is not None and other._zone is not None and
+                self._zone != other._zone):
+            return False
+        if self.use_spot != other.use_spot:
+            return False
+        if (self._instance_type is not None and
+                self._instance_type != other._instance_type):
+            return False
+        mine = self._accelerators
+        if mine is not None:
+            theirs = other.accelerators or {}
+            for name, count in mine.items():
+                if theirs.get(name, 0) < count:
+                    return False
+        if self._num_slices > other._num_slices:
+            return False
+        return True
+
+    # ---------------------------------------------------------------- yaml
+
+    @classmethod
+    def from_yaml_config(cls, config: Optional[Dict[str, Any]]) -> 'Resources':
+        if config is None:
+            return cls()
+        config = dict(config)
+        known = {
+            'cloud', 'instance_type', 'accelerators', 'cpus', 'memory',
+            'use_spot', 'capacity', 'job_recovery', 'region', 'zone',
+            'image_id', 'disk_size', 'ports', 'labels', 'accelerator_args',
+            'num_slices',
+        }
+        unknown = set(config) - known
+        if unknown:
+            raise exceptions.InvalidTaskError(
+                f'Unknown resources fields: {sorted(unknown)}')
+        ports = config.get('ports')
+        if isinstance(ports, (int, str)):
+            ports = [int(ports)]
+        elif ports is not None:
+            ports = [int(p) for p in ports]
+        config['ports'] = ports
+        return cls(**config)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {}
+        if self._cloud is not None:
+            config['cloud'] = self._cloud.name
+        if self._instance_type is not None:
+            config['instance_type'] = self._instance_type
+        if self._accelerators is not None:
+            name, count = next(iter(self._accelerators.items()))
+            spec = accelerator_registry.parse_tpu_name(name)
+            config['accelerators'] = (name if spec is not None else
+                                      f'{name}:{count}')
+        for key, value in (
+            ('cpus', self._cpus),
+            ('memory', self._memory),
+            ('job_recovery', self._job_recovery),
+            ('region', self._region),
+            ('zone', self._zone),
+            ('image_id', self._image_id),
+            ('ports', self._ports),
+            ('labels', self._labels),
+            ('accelerator_args', self._accelerator_args),
+        ):
+            if value is not None:
+                config[key] = value
+        if self._capacity is not cloud_lib.ProvisionMode.ON_DEMAND:
+            config['capacity'] = self._capacity.value
+        if self._disk_size != _DEFAULT_DISK_SIZE_GB:
+            config['disk_size'] = self._disk_size
+        if self._num_slices != 1:
+            config['num_slices'] = self._num_slices
+        return config
+
+    # ---------------------------------------------------------------- repr
+
+    def __repr__(self) -> str:
+        parts = []
+        if self._cloud is not None:
+            parts.append(str(self._cloud))
+        if self._instance_type is not None:
+            parts.append(self._instance_type)
+        if self._accelerators is not None:
+            name, count = next(iter(self._accelerators.items()))
+            spec = accelerator_registry.parse_tpu_name(name)
+            if spec is not None:
+                label = name
+                if self._num_slices > 1:
+                    label += f'×{self._num_slices}'
+                parts.append(label)
+            else:
+                parts.append(f'{name}:{count}')
+        if self._cpus:
+            parts.append(f'cpus={self._cpus}')
+        if self._memory:
+            parts.append(f'mem={self._memory}')
+        if self.use_spot:
+            parts.append('[spot]')
+        elif self._capacity not in (None, cloud_lib.ProvisionMode.ON_DEMAND):
+            parts.append(f'[{self._capacity.value}]')
+        if self._region:
+            parts.append(f'region={self._region}')
+        if self._zone:
+            parts.append(f'zone={self._zone}')
+        return '<Resources: ' + ' '.join(parts or ['(empty)']) + '>'
+
+    def __eq__(self, other: Any) -> bool:
+        if not isinstance(other, Resources):
+            return NotImplemented
+        return self.to_yaml_config() == other.to_yaml_config()
+
+    def __hash__(self) -> int:
+        import json  # pylint: disable=import-outside-toplevel
+        # sort_keys canonicalizes nested dicts (labels, accelerator_args) so
+        # hash agrees with __eq__ regardless of insertion order.
+        return hash(json.dumps(self.to_yaml_config(), sort_keys=True,
+                               default=str))

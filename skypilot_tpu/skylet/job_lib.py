@@ -57,9 +57,17 @@ CREATE TABLE IF NOT EXISTS pending_jobs (
 """
 
 
+_initialized_paths: set = set()
+
+
 def _conn() -> sqlite3.Connection:
-    conn = sqlite3.connect(_db_path(), timeout=10)
-    conn.executescript(_CREATE)
+    path = _db_path()
+    conn = sqlite3.connect(path, timeout=10)
+    # Schema DDL (and its implicit COMMIT) only once per db per process;
+    # keyed by path because tests repoint SKYTPU_JOB_DB.
+    if path not in _initialized_paths:
+        conn.executescript(_CREATE)
+        _initialized_paths.add(path)
     return conn
 
 
@@ -257,7 +265,6 @@ class FIFOScheduler:
         with _conn() as conn:
             conn.execute('UPDATE pending_jobs SET submit=? WHERE job_id=?',
                          (time.time(), job_id))
-        set_status(job_id, JobStatus.SETTING_UP)
         proc = subprocess.Popen(run_cmd,
                                 shell=True,
                                 executable='/bin/bash',
@@ -265,7 +272,12 @@ class FIFOScheduler:
                                 stdout=subprocess.DEVNULL,
                                 stderr=subprocess.DEVNULL,
                                 start_new_session=True)
-        set_pid(job_id, proc.pid)
+        # Status + pid in one write: a concurrent update_job_status must
+        # never observe SETTING_UP with the pid column still -1 (it would
+        # declare the healthy job FAILED_DRIVER).
+        with _conn() as conn:
+            conn.execute('UPDATE jobs SET status=?, pid=? WHERE job_id=?',
+                         (JobStatus.SETTING_UP.value, proc.pid, job_id))
         self.remove_job_no_lock(job_id)
 
 

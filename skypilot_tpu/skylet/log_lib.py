@@ -149,8 +149,14 @@ def run_bash_command_with_log(bash_command: str,
         fp.write(make_task_bash_script(bash_command, env_vars))
         script_path = fp.name
     os.chmod(script_path, 0o755)
-    return run_with_log(f'/bin/bash {script_path}', log_path, shell=True,
-                        stream_logs=stream_logs, line_prefix=line_prefix)  # type: ignore[return-value]
+    try:
+        return run_with_log(f'/bin/bash {script_path}', log_path, shell=True,
+                            stream_logs=stream_logs, line_prefix=line_prefix)  # type: ignore[return-value]
+    finally:
+        try:
+            os.remove(script_path)
+        except OSError:
+            pass
 
 
 def _follow_file(f, exit_when) -> Iterator[str]:

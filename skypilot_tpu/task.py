@@ -1,0 +1,249 @@
+"""Task: the user-facing unit of work.
+
+Parity: /root/reference/sky/task.py:73-1194 (name/setup/run/workdir/
+num_nodes/envs/file_mounts/storage_mounts/resources/service, YAML round-trip,
+env-var substitution, `>>` DAG chaining). TPU-first addition: tasks carry an
+optional `checkpoint_dir` making the checkpoint/auto-resume contract
+first-class (SURVEY.md §5 — the reference leaves this to user convention).
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Set, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.utils import common_utils
+
+_TASK_NAME_RE = re.compile(r'^[a-zA-Z0-9]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?$')
+
+CommandOrGenerator = Union[None, str, Callable[[int, List[str]], Optional[str]]]
+
+
+def _substitute_env_vars(text: str, envs: Dict[str, str]) -> str:
+    """Expand $VAR / ${VAR} for declared env vars only (parity task.py:73)."""
+
+    def repl(m: 're.Match[str]') -> str:
+        name = m.group(1) or m.group(2)
+        return envs.get(name, m.group(0))
+
+    return re.sub(r'\$\{(\w+)\}|\$(\w+)', repl, text)
+
+
+class Task:
+    """A task: setup + run commands executed on provisioned resources."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: CommandOrGenerator = None,
+        workdir: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+        envs: Optional[Dict[str, str]] = None,
+        file_mounts: Optional[Dict[str, str]] = None,
+        storage_mounts: Optional[Dict[str, Any]] = None,
+        checkpoint_dir: Optional[str] = None,
+    ) -> None:
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self.workdir = workdir
+        self.num_nodes = num_nodes if num_nodes is not None else 1
+        self._envs = dict(envs) if envs else {}
+        # file_mounts: {remote_path: local_path_or_cloud_uri}
+        self.file_mounts: Dict[str, str] = dict(file_mounts) if file_mounts else {}
+        # storage_mounts: {remote_path: data.Storage} — filled by set_storage_mounts
+        self.storage_mounts: Dict[str, Any] = dict(storage_mounts) if storage_mounts else {}
+        self.checkpoint_dir = checkpoint_dir
+        self._resources: Set[resources_lib.Resources] = {
+            resources_lib.Resources()
+        }
+        self.service: Optional[Any] = None  # serve.SkyServiceSpec
+        self.best_resources: Optional[resources_lib.Resources] = None
+        # Estimator hooks for the optimizer's TIME target
+        # (parity task.py:687 set_time_estimator).
+        self._time_estimator: Optional[Callable[[resources_lib.Resources],
+                                                float]] = None
+        self.estimated_outputs_size_gigabytes: Optional[float] = None
+        self._validate()
+
+    # ---------------------------------------------------------- validation
+
+    def _validate(self) -> None:
+        if self.name is not None and not _TASK_NAME_RE.match(self.name):
+            raise exceptions.InvalidTaskError(
+                f'Invalid task name {self.name!r}.')
+        if self.num_nodes < 1:
+            raise exceptions.InvalidTaskError(
+                f'num_nodes must be >= 1, got {self.num_nodes}.')
+        if self.run is not None and not (isinstance(self.run, str) or
+                                         callable(self.run)):
+            raise exceptions.InvalidTaskError(
+                'run must be a string command or a callable '
+                '(node_rank, host_ips) -> command.')
+        if self.workdir is not None:
+            expanded = os.path.expanduser(self.workdir)
+            if not os.path.isdir(expanded):
+                raise exceptions.InvalidTaskError(
+                    f'workdir {self.workdir!r} is not a directory.')
+        for dst, src in self.file_mounts.items():
+            if not os.path.isabs(dst) and not dst.startswith('~'):
+                raise exceptions.InvalidTaskError(
+                    f'file_mounts destination must be absolute or ~-based, '
+                    f'got {dst!r}.')
+            if src.startswith(('gs://', 's3://', 'r2://')):
+                continue
+            if not os.path.exists(os.path.expanduser(src)):
+                raise exceptions.InvalidTaskError(
+                    f'file_mounts source {src!r} does not exist.')
+
+    # ---------------------------------------------------------- resources
+
+    def set_resources(
+        self, resources: Union[resources_lib.Resources,
+                               Set[resources_lib.Resources],
+                               List[resources_lib.Resources]]
+    ) -> 'Task':
+        if isinstance(resources, resources_lib.Resources):
+            resources = {resources}
+        self._resources = set(resources)
+        return self
+
+    @property
+    def resources(self) -> Set[resources_lib.Resources]:
+        return self._resources
+
+    @property
+    def envs(self) -> Dict[str, str]:
+        return dict(self._envs)
+
+    def update_envs(self, envs: Dict[str, str]) -> 'Task':
+        self._envs.update(envs)
+        return self
+
+    def set_time_estimator(
+            self, estimator: Callable[[resources_lib.Resources],
+                                      float]) -> 'Task':
+        """Seconds-to-complete estimate per candidate resource (optimizer
+        TIME target; parity reference task.py:687)."""
+        self._time_estimator = estimator
+        return self
+
+    def estimate_runtime(self, resources: resources_lib.Resources) -> float:
+        if self._time_estimator is None:
+            raise exceptions.InvalidTaskError(
+                f'Task {self.name!r} has no time estimator; '
+                'optimize with minimize=COST or call set_time_estimator().')
+        return self._time_estimator(resources)
+
+    def set_storage_mounts(self, storage_mounts: Dict[str, Any]) -> 'Task':
+        self.storage_mounts = dict(storage_mounts)
+        return self
+
+    # --------------------------------------------------------------- yaml
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Task':
+        config = dict(config)
+        envs = {
+            str(k): str(v) for k, v in (config.pop('envs', None) or {}).items()
+        }
+
+        def sub(v: Optional[str]) -> Optional[str]:
+            return _substitute_env_vars(v, envs) if isinstance(v, str) else v
+
+        known = {
+            'name', 'setup', 'run', 'workdir', 'num_nodes', 'envs',
+            'file_mounts', 'resources', 'service', 'checkpoint_dir',
+            'experimental',
+        }
+        unknown = set(config) - known
+        if unknown:
+            raise exceptions.InvalidTaskError(
+                f'Unknown task fields: {sorted(unknown)}')
+        file_mounts = {
+            dst: sub(src)
+            for dst, src in (config.get('file_mounts') or {}).items()
+        }
+        task = cls(
+            name=config.get('name'),
+            setup=sub(config.get('setup')),
+            run=sub(config.get('run')),
+            workdir=sub(config.get('workdir')),
+            num_nodes=config.get('num_nodes'),
+            envs=envs,
+            file_mounts=file_mounts,
+            checkpoint_dir=sub(config.get('checkpoint_dir')),
+        )
+        resources_config = config.get('resources')
+        if resources_config is not None:
+            if isinstance(resources_config, list):
+                task.set_resources({
+                    resources_lib.Resources.from_yaml_config(r)
+                    for r in resources_config
+                })
+            else:
+                task.set_resources(
+                    resources_lib.Resources.from_yaml_config(resources_config))
+        service = config.get('service')
+        if service is not None:
+            from skypilot_tpu.serve import service_spec  # pylint: disable=import-outside-toplevel
+            task.service = service_spec.SkyServiceSpec.from_yaml_config(service)
+        return task
+
+    @classmethod
+    def from_yaml(cls, yaml_path: str) -> 'Task':
+        config = common_utils.read_yaml(os.path.expanduser(yaml_path))
+        if not isinstance(config, dict):
+            raise exceptions.InvalidTaskError(
+                f'{yaml_path} is not a YAML mapping.')
+        return cls.from_yaml_config(config)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {}
+        for key, value in (
+            ('name', self.name),
+            ('workdir', self.workdir),
+            ('setup', self.setup),
+            ('run', self.run if isinstance(self.run, str) else None),
+            ('checkpoint_dir', self.checkpoint_dir),
+        ):
+            if value is not None:
+                config[key] = value
+        if self.num_nodes != 1:
+            config['num_nodes'] = self.num_nodes
+        if self._envs:
+            config['envs'] = dict(self._envs)
+        if self.file_mounts:
+            config['file_mounts'] = dict(self.file_mounts)
+        if len(self._resources) == 1:
+            r = next(iter(self._resources)).to_yaml_config()
+            if r:
+                config['resources'] = r
+        elif self._resources:
+            config['resources'] = [r.to_yaml_config() for r in self._resources]
+        if self.service is not None:
+            config['service'] = self.service.to_yaml_config()
+        return config
+
+    # ----------------------------------------------------------------- dag
+
+    def __rshift__(self, other: 'Task') -> 'Task':
+        """task_a >> task_b adds an edge in the ambient Dag context."""
+        from skypilot_tpu import dag as dag_lib  # pylint: disable=import-outside-toplevel
+        dag = dag_lib.get_current_dag()
+        if dag is None:
+            raise exceptions.InvalidTaskError(
+                'task >> task requires an active `with sky.Dag():` context.')
+        dag.add_edge(self, other)
+        return other
+
+    def __repr__(self) -> str:
+        label = self.name or '<unnamed>'
+        num_resources = len(self._resources)
+        res = (repr(next(iter(self._resources)))
+               if num_resources == 1 else f'{num_resources} candidates')
+        return f'<Task {label} nodes={self.num_nodes} {res}>'

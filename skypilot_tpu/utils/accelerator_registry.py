@@ -1,0 +1,222 @@
+"""Canonical accelerator names and the TPU topology model.
+
+Parity: /root/reference/sky/utils/accelerator_registry.py:1-118 (canonical
+names, `is_schedulable_non_gpu_accelerator`) — but where the reference treats
+TPUs as an opaque custom Ray resource, here the *slice* is the first-class
+scheduling unit: every TPU accelerator string (``tpu-v5p-64``) resolves to a
+:class:`TpuSliceSpec` carrying chips/hosts/topology/HBM, which the backend
+uses for gang sizing and the compute layer uses for mesh construction.
+
+Naming grammar (canonical, lower-case):
+    tpu-v2-8, tpu-v3-32, tpu-v4-128, tpu-v5e-16, tpu-v5p-64, tpu-v6e-256
+The trailing number follows Google's public convention: TensorCore count for
+v2/v3/v4/v5p, chip count for v5e/v6e. ``TpuSliceSpec`` normalizes all of this
+into chips and hosts so no other layer needs to know the convention.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+# GPUs kept fungible with TPUs in the optimizer (SURVEY.md: BASELINE.json
+# north star — "TPU chips as cost/availability-fungible with GPUs").
+_CANONICAL_GPUS = (
+    'A100', 'A100-80GB', 'H100', 'L4', 'T4', 'V100', 'P100', 'K80',
+)
+
+_TPU_NAME_RE = re.compile(r'^tpu-v(?P<gen>[23456])(?P<flavor>[ep]?)-(?P<size>\d+)$')
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuGeneration:
+    """Per-generation hardware facts used to expand a name into a slice spec.
+
+    Numbers are the public machine shapes: cores_per_chip distinguishes the
+    size-suffix convention (v2/v3/v4/v5p count TensorCores, v5e/v6e count
+    chips); chips_per_host is the host granularity used for multi-host
+    slices; hbm_gib_per_chip bounds what fits for the compute layer.
+    """
+    name: str                   # 'v5p'
+    size_is_cores: bool         # trailing number counts cores (else chips)
+    cores_per_chip: int
+    chips_per_host: int         # multi-host slice host granularity
+    max_single_host_chips: int  # largest slice that is still one host
+    hbm_gib_per_chip: float
+    bf16_tflops_per_chip: float  # peak dense bf16 (public spec sheets)
+    supports_3d_torus: bool     # v4/v5p have 3D ICI torus; others 2D
+
+
+TPU_GENERATIONS: Dict[str, TpuGeneration] = {
+    'v2': TpuGeneration('v2', True, 2, 4, 4, 8.0, 23.0, False),
+    'v3': TpuGeneration('v3', True, 2, 4, 4, 16.0, 61.0, False),
+    'v4': TpuGeneration('v4', True, 2, 4, 4, 32.0, 137.5, True),
+    'v5e': TpuGeneration('v5e', False, 1, 4, 8, 16.0, 98.3, False),
+    'v5p': TpuGeneration('v5p', True, 2, 4, 4, 95.0, 229.1, True),
+    'v6e': TpuGeneration('v6e', False, 1, 4, 8, 32.0, 459.2, False),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuSliceSpec:
+    """A fully-resolved TPU slice: the atomic provisioning unit.
+
+    One handle = one slice = ``num_hosts`` TPU-VM workers (generalizing the
+    reference's ``num_ips_per_node``, cloud_vm_ray_backend.py:2475-2483).
+    """
+    name: str                # canonical 'tpu-v5p-64'
+    generation: str          # 'v5p'
+    size: int                # the trailing number as written
+    num_chips: int
+    num_hosts: int
+    chips_per_host: int
+    topology: Tuple[int, ...]  # ICI torus shape in chips, e.g. (4, 4) / (2, 2, 4)
+    hbm_gib_per_chip: float
+    bf16_tflops_per_chip: float
+
+    @property
+    def is_pod(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def total_hbm_gib(self) -> float:
+        return self.hbm_gib_per_chip * self.num_chips
+
+    @property
+    def total_bf16_tflops(self) -> float:
+        return self.bf16_tflops_per_chip * self.num_chips
+
+    @property
+    def topology_str(self) -> str:
+        return 'x'.join(str(d) for d in self.topology)
+
+
+def _default_topology(gen: TpuGeneration, num_chips: int) -> Tuple[int, ...]:
+    """Smallest-surface torus of the right dimensionality for num_chips.
+
+    v4/v5p use a 3D torus built from 2x2x1 host blocks; 2D generations use
+    the most-square 2D factorization. This mirrors the default shapes the
+    TPU API assigns when no explicit topology is requested.
+    """
+    if num_chips <= 1:
+        return (1,)
+    if gen.supports_3d_torus and num_chips >= 8:
+        # Factor into (x, y, z) as close to cubic as possible, dims even
+        # (hosts are 2x2x1 blocks of 4 chips).
+        best = None
+        for x in range(2, int(round(num_chips ** (1 / 3))) + 2, 2):
+            if num_chips % x:
+                continue
+            rest = num_chips // x
+            for y in range(x, int(math.isqrt(rest)) + 2, 2):
+                if rest % y:
+                    continue
+                z = rest // y
+                if z < y:
+                    continue
+                cand = (x, y, z)
+                if best is None or max(cand) < max(best):
+                    best = cand
+        if best is not None:
+            return best
+    # 2D: most-square factorization.
+    for w in range(int(math.isqrt(num_chips)), 0, -1):
+        if num_chips % w == 0:
+            return (w, num_chips // w)
+    return (1, num_chips)
+
+
+def parse_tpu_name(name: str) -> Optional[TpuSliceSpec]:
+    """'tpu-v5p-64' → TpuSliceSpec, or None if not a TPU name."""
+    m = _TPU_NAME_RE.match(name.lower().strip())
+    if m is None:
+        return None
+    gen_key = f"v{m.group('gen')}{m.group('flavor')}"
+    gen = TPU_GENERATIONS.get(gen_key)
+    if gen is None:
+        return None
+    size = int(m.group('size'))
+    if size <= 0:
+        return None
+    num_chips = size // gen.cores_per_chip if gen.size_is_cores else size
+    if num_chips < 1:
+        return None
+    if num_chips <= gen.max_single_host_chips:
+        num_hosts = 1
+        chips_per_host = num_chips
+    else:
+        if num_chips % gen.chips_per_host:
+            return None  # not a valid multi-host shape
+        num_hosts = num_chips // gen.chips_per_host
+        chips_per_host = gen.chips_per_host
+    return TpuSliceSpec(
+        name=f'tpu-{gen_key}-{size}',
+        generation=gen_key,
+        size=size,
+        num_chips=num_chips,
+        num_hosts=num_hosts,
+        chips_per_host=chips_per_host,
+        topology=_default_topology(gen, num_chips),
+        hbm_gib_per_chip=gen.hbm_gib_per_chip,
+        bf16_tflops_per_chip=gen.bf16_tflops_per_chip,
+    )
+
+
+def is_tpu(accelerator_name: Optional[str]) -> bool:
+    if accelerator_name is None:
+        return False
+    return parse_tpu_name(accelerator_name) is not None
+
+
+def is_tpu_pod(accelerator_name: Optional[str]) -> bool:
+    if accelerator_name is None:
+        return False
+    spec = parse_tpu_name(accelerator_name)
+    return spec is not None and spec.is_pod
+
+
+def canonicalize_accelerator_name(name: str) -> str:
+    """Map user spellings to the canonical name.
+
+    Accepts 'TPU-V5P-64', 'tpu-v5litepod-8' (GCP API spelling for v5e),
+    'v5e-16' shorthand, and case-insensitive GPU names.
+    """
+    lowered = name.lower().strip()
+    lowered = lowered.replace('v5litepod', 'v5e').replace('v5lite', 'v5e')
+    if not lowered.startswith('tpu-') and re.match(r'^v[23456][ep]?-\d+$',
+                                                   lowered):
+        lowered = f'tpu-{lowered}'
+    spec = parse_tpu_name(lowered)
+    if spec is not None:
+        return spec.name
+    for gpu in _CANONICAL_GPUS:
+        if lowered == gpu.lower():
+            return gpu
+    return name
+
+
+def is_schedulable_non_gpu_accelerator(accelerator_name: str) -> bool:
+    """TPUs are scheduled as slices (host gangs), not device-count GPUs.
+
+    Parity: reference accelerator_registry.py's same-named predicate, used to
+    route TPU jobs away from `num_gpus` scheduling
+    (cloud_vm_ray_backend.py:396,565).
+    """
+    return is_tpu(accelerator_name)
+
+
+def list_tpu_names(max_chips: int = 4096) -> List[str]:
+    """All valid canonical TPU names up to max_chips (for catalog/docs)."""
+    names = []
+    for gen_key, gen in TPU_GENERATIONS.items():
+        chips = 1
+        while chips <= max_chips:
+            if chips <= gen.max_single_host_chips or (
+                    chips % gen.chips_per_host == 0):
+                size = chips * gen.cores_per_chip if gen.size_is_cores else chips
+                spec = parse_tpu_name(f'tpu-{gen_key}-{size}')
+                if spec is not None:
+                    names.append(spec.name)
+            chips *= 2
+    return names

@@ -92,7 +92,9 @@ def get_global_job_id(job_timestamp: str, cluster_name: str,
 
 
 def generate_run_id() -> str:
-    return f'sky-{time.strftime("%Y-%m-%d-%H-%M-%S-%f")}-{uuid.uuid4().hex[:6]}'
+    import datetime  # pylint: disable=import-outside-toplevel
+    ts = datetime.datetime.now().strftime('%Y-%m-%d-%H-%M-%S-%f')
+    return f'sky-{ts}-{uuid.uuid4().hex[:6]}'
 
 
 class Backoff:

@@ -1,0 +1,42 @@
+"""Helpers to normalize entrypoints (Task | Dag) into a Dag.
+
+Parity: /root/reference/sky/utils/dag_utils.py:1-172.
+"""
+from __future__ import annotations
+
+from typing import Union
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+
+
+def convert_entrypoint_to_dag(
+        entrypoint: Union[task_lib.Task, dag_lib.Dag]) -> dag_lib.Dag:
+    if isinstance(entrypoint, dag_lib.Dag):
+        return entrypoint
+    if isinstance(entrypoint, task_lib.Task):
+        dag = dag_lib.Dag(name=entrypoint.name)
+        dag.add(entrypoint)
+        return dag
+    raise exceptions.InvalidTaskError(
+        f'Entrypoint must be a Task or Dag, got {type(entrypoint)}.')
+
+
+def load_chain_dag_from_yaml(yaml_path: str) -> dag_lib.Dag:
+    """A YAML file with multiple documents is a chain DAG (managed jobs)."""
+    from skypilot_tpu.utils import common_utils  # pylint: disable=import-outside-toplevel
+    configs = common_utils.read_yaml_all(yaml_path)
+    dag = dag_lib.Dag()
+    prev = None
+    for config in configs:
+        if not config:
+            continue
+        task = task_lib.Task.from_yaml_config(config)
+        dag.add(task)
+        if prev is not None:
+            dag.add_edge(prev, task)
+        prev = task
+    if dag.name is None and dag.tasks:
+        dag.name = dag.tasks[0].name
+    return dag

@@ -1,0 +1,258 @@
+"""Spec-layer tests: accelerator registry, catalog, resources, task, dag.
+
+Mirrors the reference's offline test strategy (tests/unit_tests/
+test_resources.py, test_yaml_parser.py, test_list_accelerators.py) — all
+hermetic, no cloud access.
+"""
+from __future__ import annotations
+
+import textwrap
+
+import pytest
+
+from skypilot_tpu import catalog
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.utils import accelerator_registry as ar
+
+
+# ------------------------------------------------------ accelerator registry
+
+
+def test_parse_tpu_names():
+    spec = ar.parse_tpu_name('tpu-v5p-64')
+    assert spec is not None
+    assert spec.generation == 'v5p'
+    assert spec.num_chips == 32          # v5p counts TensorCores
+    assert spec.num_hosts == 8           # 4 chips per host
+    assert spec.chips_per_host == 4
+
+    v5e = ar.parse_tpu_name('tpu-v5e-16')
+    assert v5e.num_chips == 16           # v5e counts chips
+    assert v5e.num_hosts == 4
+
+    single = ar.parse_tpu_name('tpu-v5e-8')
+    assert single.num_hosts == 1         # single host up to 8 chips
+    assert not single.is_pod
+
+    v4 = ar.parse_tpu_name('tpu-v4-8')
+    assert v4.num_chips == 4
+    assert v4.num_hosts == 1
+
+    assert ar.parse_tpu_name('A100') is None
+    assert ar.parse_tpu_name('tpu-v9-8') is None
+
+
+def test_topology_is_consistent():
+    for name in ar.list_tpu_names(256):
+        spec = ar.parse_tpu_name(name)
+        product = 1
+        for d in spec.topology:
+            product *= d
+        assert product == spec.num_chips, name
+
+
+def test_canonicalize():
+    assert ar.canonicalize_accelerator_name('TPU-V5P-64') == 'tpu-v5p-64'
+    assert ar.canonicalize_accelerator_name('tpu-v5litepod-8') == 'tpu-v5e-8'
+    assert ar.canonicalize_accelerator_name('v5e-16') == 'tpu-v5e-16'
+    assert ar.canonicalize_accelerator_name('a100') == 'A100'
+    assert ar.is_schedulable_non_gpu_accelerator('tpu-v4-8')
+    assert not ar.is_schedulable_non_gpu_accelerator('A100')
+
+
+# ------------------------------------------------------------------ catalog
+
+
+def test_tpu_hourly_cost():
+    cost = catalog.get_tpu_hourly_cost('gcp', 'tpu-v5e-16')
+    assert cost == pytest.approx(1.2 * 16)
+    spot = catalog.get_tpu_hourly_cost('gcp', 'tpu-v5e-16', use_spot=True)
+    assert spot < cost
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        catalog.get_tpu_hourly_cost('gcp', 'tpu-v5e-16', region='us-central1')
+
+
+def test_gpu_instance_lookup():
+    types = catalog.get_instance_type_for_accelerator('gcp', 'A100', 8)
+    assert types == ['a2-highgpu-8g']
+    assert catalog.get_instance_type_for_accelerator('gcp', 'A100', 3) is None
+    cpus, mem = catalog.get_vcpus_mem_from_instance_type('gcp', 'a2-highgpu-8g')
+    assert cpus == 96 and mem == 680
+
+
+def test_default_instance_type():
+    assert catalog.get_default_instance_type('gcp') == 'n2-standard-8'
+    assert catalog.get_default_instance_type('gcp', cpus='16+') == 'n2-standard-16'
+
+
+def test_validate_region_zone():
+    region, zone = catalog.validate_region_zone('gcp', None, 'us-central2-b')
+    assert region == 'us-central2'
+    with pytest.raises(ValueError):
+        catalog.validate_region_zone('gcp', 'nowhere', None)
+
+
+def test_list_accelerators_filter():
+    accs = catalog.list_accelerators(name_filter='v5p')
+    assert any('tpu-v5p' in name for name in accs)
+    offering = accs['tpu-v5p-8'][0]
+    assert offering.num_hosts == 1
+    assert offering.price == pytest.approx(4.2 * 4)
+
+
+# ---------------------------------------------------------------- resources
+
+
+def test_resources_tpu_grammar():
+    r = resources_lib.Resources(accelerators='tpu-v5p-64')
+    assert r.tpu_spec is not None
+    assert r.num_hosts == 8
+    assert not r.use_spot
+
+    r2 = resources_lib.Resources(accelerators='tpu-v5e-16', capacity='spot')
+    assert r2.use_spot
+    assert r2.provision_mode is cloud_lib.ProvisionMode.SPOT
+
+    r3 = resources_lib.Resources(accelerators='tpu-v5e-16', num_slices=4)
+    assert r3.num_hosts == 16
+
+
+def test_resources_invalid():
+    with pytest.raises(exceptions.InvalidTaskError):
+        resources_lib.Resources(accelerators='tpu-v5e-16',
+                                instance_type='n2-standard-8')
+    with pytest.raises(exceptions.InvalidTaskError):
+        resources_lib.Resources(accelerators='A100:8', num_slices=2)
+    with pytest.raises(exceptions.InvalidTaskError):
+        resources_lib.Resources(accelerators='tpu-v5e-16', capacity='reserved')
+    with pytest.raises(exceptions.InvalidTaskError):
+        resources_lib.Resources(use_spot=True, capacity='on_demand')
+
+
+def test_resources_cost():
+    r = resources_lib.Resources(cloud='gcp', accelerators='tpu-v5e-8')
+    assert r.get_cost(3600) == pytest.approx(1.2 * 8)
+    vm = resources_lib.Resources(cloud='gcp', instance_type='a2-highgpu-8g')
+    assert vm.get_cost(3600) == pytest.approx(29.3864)
+
+
+def test_resources_reuse_check():
+    small = resources_lib.Resources(accelerators='tpu-v5e-8')
+    big = resources_lib.Resources(accelerators='tpu-v5e-16')
+    assert not big.less_demanding_than(small)
+    same = resources_lib.Resources(accelerators='tpu-v5e-8')
+    assert same.less_demanding_than(small)
+
+
+def test_resources_yaml_round_trip():
+    r = resources_lib.Resources(cloud='gcp', accelerators='tpu-v5p-32',
+                                capacity='queued', region='us-east5',
+                                labels={'team': 'ml'})
+    r2 = resources_lib.Resources.from_yaml_config(r.to_yaml_config())
+    assert r == r2
+    assert r2.provision_mode is cloud_lib.ProvisionMode.QUEUED
+
+
+def test_gcp_feasibility():
+    gcp = registry.from_str('gcp')
+    launchable, _ = gcp.get_feasible_launchable_resources(
+        resources_lib.Resources(accelerators='tpu-v5e-16'))
+    assert len(launchable) == 1
+    assert launchable[0].is_launchable()
+
+    launchable, _ = gcp.get_feasible_launchable_resources(
+        resources_lib.Resources(accelerators='A100:8'))
+    assert launchable[0].instance_type == 'a2-highgpu-8g'
+
+    launchable, fuzzy = gcp.get_feasible_launchable_resources(
+        resources_lib.Resources(accelerators='A100:3'))
+    assert not launchable and fuzzy
+
+
+def test_gcp_pod_cannot_stop():
+    gcp = registry.from_str('gcp')
+    pod = resources_lib.Resources(accelerators='tpu-v5e-16')
+    with pytest.raises(exceptions.NotSupportedError):
+        type(gcp).check_features_are_supported(
+            pod, {cloud_lib.CloudImplementationFeatures.STOP})
+    # Single-host slices can stop.
+    single = resources_lib.Resources(accelerators='tpu-v5e-8')
+    type(gcp).check_features_are_supported(
+        single, {cloud_lib.CloudImplementationFeatures.STOP})
+
+
+# --------------------------------------------------------------- task / dag
+
+
+def test_task_yaml_round_trip(tmp_path):
+    yaml_text = textwrap.dedent("""\
+        name: train
+        num_nodes: 1
+        envs:
+          MODEL: llama3-8b
+        resources:
+          accelerators: tpu-v5p-64
+          capacity: spot
+        setup: pip install -e .
+        run: python train.py --model $MODEL
+        """)
+    path = tmp_path / 'task.yaml'
+    path.write_text(yaml_text)
+    task = task_lib.Task.from_yaml(str(path))
+    assert task.name == 'train'
+    # Declared env vars are substituted into run.
+    assert task.run == 'python train.py --model llama3-8b'
+    r = next(iter(task.resources))
+    assert r.tpu_spec.name == 'tpu-v5p-64'
+    assert r.use_spot
+    config = task.to_yaml_config()
+    task2 = task_lib.Task.from_yaml_config(config)
+    assert next(iter(task2.resources)) == r
+
+
+def test_task_validation():
+    with pytest.raises(exceptions.InvalidTaskError):
+        task_lib.Task(name='bad name!')
+    with pytest.raises(exceptions.InvalidTaskError):
+        task_lib.Task(num_nodes=0)
+    with pytest.raises(exceptions.InvalidTaskError):
+        task_lib.Task(workdir='/nonexistent/dir')
+
+
+def test_dag_chain():
+    with dag_lib.Dag('pipeline') as dag:
+        a = task_lib.Task(name='a')
+        b = task_lib.Task(name='b')
+        c = task_lib.Task(name='c')
+        a >> b >> c
+    assert dag.is_chain()
+    assert dag.topological_order() == [a, b, c]
+    d = task_lib.Task(name='d')
+    dag.add(d)
+    dag.add_edge(a, d)
+    assert not dag.is_chain()
+
+
+def test_local_tpu_feasibility():
+    local = registry.from_str('local')
+    launchable, _ = local.get_feasible_launchable_resources(
+        resources_lib.Resources(accelerators='tpu-v5e-16'))
+    assert launchable[0].is_launchable()
+    assert launchable[0].instance_type is None
+    region = local.regions_with_offering(launchable[0])[0]
+    vars_ = local.make_deploy_resources_variables(launchable[0], 'c', region,
+                                                  region.zones)
+    assert vars_['tpu_num_hosts'] == 4
+
+
+def test_resources_hash_eq_consistent():
+    a = resources_lib.Resources(labels={'a': '1', 'b': '2'})
+    b = resources_lib.Resources(labels={'b': '2', 'a': '1'})
+    assert a == b
+    assert hash(a) == hash(b)
+    assert len({a, b}) == 1
