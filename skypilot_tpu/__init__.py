@@ -1,0 +1,59 @@
+"""skypilot_tpu: a TPU-native orchestration framework.
+
+Capability parity with SkyPilot (/root/reference/sky/__init__.py:139 __all__)
+rebuilt TPU-first: slices are the atomic resource, gangs are implicit in
+topology, and the job contract hands user code a ready JAX distributed
+environment instead of raw IP lists.
+"""
+from __future__ import annotations
+
+__version__ = '0.1.0'
+
+from skypilot_tpu import clouds
+from skypilot_tpu.check import check
+from skypilot_tpu.core import autostop
+from skypilot_tpu.core import cancel
+from skypilot_tpu.core import cost_report
+from skypilot_tpu.core import down
+from skypilot_tpu.core import download_logs
+from skypilot_tpu.core import job_status
+from skypilot_tpu.core import queue
+from skypilot_tpu.core import start
+from skypilot_tpu.core import status
+from skypilot_tpu.core import stop
+from skypilot_tpu.core import tail_logs
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.execution import exec  # pylint: disable=redefined-builtin
+from skypilot_tpu.execution import launch
+from skypilot_tpu.optimizer import Optimizer
+from skypilot_tpu.optimizer import OptimizeTarget
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+GCP = clouds.GCP
+Local = clouds.Local
+
+__all__ = [
+    '__version__',
+    'Dag',
+    'GCP',
+    'Local',
+    'Optimizer',
+    'OptimizeTarget',
+    'Resources',
+    'Task',
+    'autostop',
+    'cancel',
+    'check',
+    'cost_report',
+    'down',
+    'download_logs',
+    'exec',
+    'job_status',
+    'launch',
+    'queue',
+    'start',
+    'status',
+    'stop',
+    'tail_logs',
+]

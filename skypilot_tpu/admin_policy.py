@@ -1,0 +1,61 @@
+"""Pluggable org-level admin policy applied to every launch.
+
+Parity: /root/reference/sky/admin_policy.py:1-101 +
+utils/admin_policy_utils.py (validate_and_mutate hook loaded from config).
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import typing
+from typing import Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import dag as dag_lib
+
+
+@dataclasses.dataclass
+class UserRequest:
+    dag: 'dag_lib.Dag'
+
+
+@dataclasses.dataclass
+class MutatedUserRequest:
+    dag: 'dag_lib.Dag'
+
+
+class AdminPolicy:
+    """Subclass and set config `admin_policy: my_module.MyPolicy`."""
+
+    @classmethod
+    def validate_and_mutate(cls,
+                            user_request: UserRequest) -> MutatedUserRequest:
+        return MutatedUserRequest(dag=user_request.dag)
+
+
+def _load_policy() -> Optional[type]:
+    path = config_lib.get_nested(('admin_policy',))
+    if not path:
+        return None
+    module_name, _, class_name = path.rpartition('.')
+    try:
+        module = importlib.import_module(module_name)
+        policy = getattr(module, class_name)
+    except (ImportError, AttributeError) as e:
+        raise exceptions.UserRequestRejectedByPolicy(
+            f'Could not load admin policy {path!r}: {e}') from e
+    if not issubclass(policy, AdminPolicy):
+        raise exceptions.UserRequestRejectedByPolicy(
+            f'{path!r} is not an AdminPolicy subclass.')
+    return policy
+
+
+def apply(dag: 'dag_lib.Dag') -> 'dag_lib.Dag':
+    policy = _load_policy()
+    if policy is None:
+        return dag
+    mutated = policy.validate_and_mutate(UserRequest(dag=dag))
+    return mutated.dag

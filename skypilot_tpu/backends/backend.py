@@ -1,0 +1,101 @@
+"""Backend interface: provision → sync → setup → execute → teardown.
+
+Parity: /root/reference/sky/backends/backend.py:30-170 (`Backend` ABC +
+`ResourceHandle`), with the same timeline instrumentation points.
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Generic, Optional, TypeVar
+
+from skypilot_tpu.utils import timeline
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+
+
+class ResourceHandle:
+    """Opaque, picklable pointer to launched capacity."""
+
+    def get_cluster_name(self) -> str:
+        raise NotImplementedError
+
+
+_HandleT = TypeVar('_HandleT', bound=ResourceHandle)
+
+
+class Backend(Generic[_HandleT]):
+    """Abstract orchestration backend."""
+
+    NAME = 'backend'
+
+    # --- public API (timeline-instrumented), parity backend.py:45-125 ---
+
+    @timeline.event
+    def provision(self,
+                  task: 'task_lib.Task',
+                  to_provision: Optional['resources_lib.Resources'],
+                  dryrun: bool,
+                  stream_logs: bool,
+                  cluster_name: str,
+                  retry_until_up: bool = False) -> Optional[_HandleT]:
+        return self._provision(task, to_provision, dryrun, stream_logs,
+                               cluster_name, retry_until_up)
+
+    @timeline.event
+    def sync_workdir(self, handle: _HandleT, workdir: str) -> None:
+        return self._sync_workdir(handle, workdir)
+
+    @timeline.event
+    def sync_file_mounts(self, handle: _HandleT,
+                         all_file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]]) -> None:
+        return self._sync_file_mounts(handle, all_file_mounts, storage_mounts)
+
+    @timeline.event
+    def setup(self, handle: _HandleT, task: 'task_lib.Task',
+              detach_setup: bool = False) -> None:
+        return self._setup(handle, task, detach_setup)
+
+    @timeline.event
+    def execute(self, handle: _HandleT, task: 'task_lib.Task',
+                detach_run: bool, dryrun: bool = False) -> Optional[int]:
+        return self._execute(handle, task, detach_run, dryrun)
+
+    @timeline.event
+    def post_execute(self, handle: _HandleT, down: bool) -> None:
+        return self._post_execute(handle, down)
+
+    @timeline.event
+    def teardown(self, handle: _HandleT, terminate: bool,
+                 purge: bool = False) -> None:
+        return self._teardown(handle, terminate, purge)
+
+    def register_info(self, **kwargs: Any) -> None:
+        """Inject runtime info (optimize target, requested features...)."""
+        del kwargs
+
+    # --- subclass hooks ---
+
+    def _provision(self, task, to_provision, dryrun, stream_logs,
+                   cluster_name, retry_until_up):
+        raise NotImplementedError
+
+    def _sync_workdir(self, handle, workdir):
+        raise NotImplementedError
+
+    def _sync_file_mounts(self, handle, all_file_mounts, storage_mounts):
+        raise NotImplementedError
+
+    def _setup(self, handle, task, detach_setup):
+        raise NotImplementedError
+
+    def _execute(self, handle, task, detach_run, dryrun):
+        raise NotImplementedError
+
+    def _post_execute(self, handle, down):
+        raise NotImplementedError
+
+    def _teardown(self, handle, terminate, purge):
+        raise NotImplementedError

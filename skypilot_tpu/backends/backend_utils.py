@@ -1,0 +1,123 @@
+"""Backend helpers: cluster status refresh — the state reconciler.
+
+Parity: /root/reference/sky/backends/backend_utils.py:1669-2004
+(`_update_cluster_status_no_lock`, `refresh_cluster_status_handle`) — 230
+lines of subtlety in the reference, simplified here by the all-or-nothing
+slice model: a slice is UP only if *every* host is up; any mix is abnormal
+and degrades to INIT (or removal if the cloud says everything is gone).
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision
+from skypilot_tpu import sky_logging
+from skypilot_tpu import status_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.backends import slice_backend
+
+logger = sky_logging.init_logger(__name__)
+
+
+def refresh_cluster_status(
+        cluster_name: str) -> Optional[status_lib.ClusterStatus]:
+    """Reconcile recorded status with the provider's live view.
+
+    Returns the (possibly updated) status, or None if the cluster no longer
+    exists anywhere.
+    """
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None:
+        return None
+    handle = record['handle']
+    if handle is None:
+        return record['status']
+    try:
+        statuses = provision.query_instances(handle.provider_name,
+                                             cluster_name)
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning(f'Status query failed for {cluster_name}: {e}')
+        return record['status']
+
+    if not statuses:
+        # The cloud has no trace of it: cluster is gone.
+        global_user_state.remove_cluster(cluster_name, terminate=True)
+        return None
+    values = list(statuses.values())
+    if all(s == status_lib.ClusterStatus.UP for s in values):
+        new_status = (record['status']
+                      if record['status'] in (status_lib.ClusterStatus.INIT,
+                                              status_lib.ClusterStatus.UP)
+                      else status_lib.ClusterStatus.INIT)
+        if record['status'] == status_lib.ClusterStatus.UP:
+            new_status = status_lib.ClusterStatus.UP
+        elif record['status'] == status_lib.ClusterStatus.WAITING:
+            # Queued capacity got granted behind our back.
+            new_status = status_lib.ClusterStatus.INIT
+    elif all(s == status_lib.ClusterStatus.STOPPED for s in values):
+        new_status = status_lib.ClusterStatus.STOPPED
+    elif all(s is None for s in values):
+        global_user_state.remove_cluster(cluster_name, terminate=True)
+        return None
+    else:
+        # Partial slice (some hosts up, some stopped/preempted): abnormal.
+        new_status = status_lib.ClusterStatus.INIT
+    if new_status != record['status']:
+        global_user_state.set_cluster_status(cluster_name, new_status)
+    return new_status
+
+
+def refresh_cluster_record(cluster_name: str) -> Optional[Dict[str, Any]]:
+    status = refresh_cluster_status(cluster_name)
+    if status is None:
+        return None
+    return global_user_state.get_cluster_from_name(cluster_name)
+
+
+def check_cluster_available(
+        cluster_name: str) -> 'slice_backend.SliceResourceHandle':
+    """Raise unless the cluster exists and is UP; returns its handle.
+
+    Parity: reference backend_utils check_cluster_available
+    (execution.py:547 call site).
+    """
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    status = refresh_cluster_status(cluster_name)
+    if status is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} no longer exists on the cloud.')
+    if status != status_lib.ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {status.value}, not UP.',
+            cluster_status=status, handle=record['handle'])
+    if record['handle'] is None:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} has no handle (launch in progress?).',
+            cluster_status=status)
+    return record['handle']
+
+
+def get_clusters(refresh: bool = False,
+                 cluster_names: Optional[List[str]] = None
+                 ) -> List[Dict[str, Any]]:
+    records = global_user_state.get_clusters()
+    if cluster_names is not None:
+        wanted = set()
+        for pattern in cluster_names:
+            wanted.update(global_user_state.get_glob_cluster_names(pattern))
+        records = [r for r in records if r['name'] in wanted]
+    if not refresh:
+        return records
+    refreshed = []
+    for record in records:
+        new_record = refresh_cluster_record(record['name'])
+        if new_record is not None:
+            refreshed.append(new_record)
+    return refreshed

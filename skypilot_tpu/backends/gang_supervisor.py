@@ -1,0 +1,149 @@
+"""Gang supervisor: runs ON the head host, drives one job across all hosts.
+
+This replaces the reference's generated Ray driver program (`RayCodeGen`,
+/root/reference/sky/backends/cloud_vm_ray_backend.py:209-686): where the
+reference builds a placement group with STRICT_SPREAD and launches one Ray
+task per node, a TPU slice *is already a gang* — membership and spread are
+fixed by the hardware topology — so the supervisor simply fans the task
+command out to every host over command runners, multiplexes per-rank logs,
+fans failures in (`get_or_fail` semantics, reference :294-328), and records
+the final job status in the head's job queue.
+
+Invoked by the FIFO scheduler as `python -m
+skypilot_tpu.backends.gang_supervisor --job-id N`; reads the job spec the
+client wrote to ``~/.skytpu/jobs/<job_id>/spec.json``:
+
+    {
+      "provider": "local" | "gcp" | ...,
+      "cluster_name": ...,
+      "run_cmd": "...",                  # user task command
+      "envs": {...},                     # user-declared env vars
+      "env_contract": {...},             # TPU job contract (shared part)
+      "log_dir": "~/sky_logs/<ts>",
+      "num_hosts": N, "hosts_per_slice": H
+    }
+
+Exit status: 0 iff every rank exited 0. Any rank failing cancels the
+remaining ranks (all-or-nothing, like a real slice failure).
+"""
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+from skypilot_tpu import provision
+from skypilot_tpu.skylet import constants
+from skypilot_tpu.skylet import job_lib
+from skypilot_tpu.skylet import log_lib
+
+
+def _spec_path(job_id: int) -> str:
+    return os.path.expanduser(f'~/.skytpu/jobs/{job_id}/spec.json')
+
+
+def load_spec(job_id: int) -> Dict[str, Any]:
+    with open(_spec_path(job_id), encoding='utf-8') as f:
+        return json.load(f)
+
+
+def _rank_env(spec: Dict[str, Any], rank: int,
+              host_ips: List[str]) -> Dict[str, str]:
+    hosts_per_slice = int(spec.get('hosts_per_slice') or 1)
+    num_hosts = len(host_ips)
+    env = dict(spec.get('env_contract') or {})
+    env.update({
+        constants.ENV_HOST_RANK: str(rank),
+        constants.ENV_HOST_IPS: '\n'.join(host_ips),
+        constants.ENV_NUM_HOSTS: str(num_hosts),
+        constants.ENV_SLICE_ID: str(rank // hosts_per_slice),
+        constants.ENV_NUM_SLICES: str(max(1, num_hosts // hosts_per_slice)),
+        constants.ENV_COORDINATOR_ADDRESS:
+            f'{host_ips[0]}:{constants.JAX_COORDINATOR_PORT}',
+    })
+    # TPU runtime worker identity (consumed by libtpu on multi-host slices).
+    env['TPU_WORKER_ID'] = str(rank % hosts_per_slice)
+    env['TPU_WORKER_HOSTNAMES'] = ','.join(
+        host_ips[(rank // hosts_per_slice) * hosts_per_slice:
+                 (rank // hosts_per_slice + 1) * hosts_per_slice])
+    for legacy, ours in constants.LEGACY_ENV_ALIASES.items():
+        if ours in env:
+            env[legacy] = env[ours]
+    env.update(spec.get('envs') or {})
+    return env
+
+
+def run_gang(job_id: int, spec: Dict[str, Any]) -> int:
+    provider = spec['provider']
+    cluster_name = spec['cluster_name']
+    cluster_info = provision.get_cluster_info(provider, cluster_name)
+    runners = provision.get_command_runners(provider, cluster_info)
+    host_ips = cluster_info.get_feasible_ips()
+    log_dir = os.path.expanduser(spec['log_dir'])
+    os.makedirs(os.path.join(log_dir, 'tasks'), exist_ok=True)
+
+    job_lib.set_status(job_id, job_lib.JobStatus.RUNNING)
+    run_cmd = spec['run_cmd']
+
+    def _one_rank(rank: int) -> int:
+        runner = runners[rank]
+        env = _rank_env(spec, rank, host_ips)
+        exports = log_lib.make_task_bash_script(run_cmd, env)
+        log_path = os.path.join(log_dir, 'tasks', f'rank-{rank}.log')
+        # stream_logs mirrors rank output to the supervisor's stdout, which
+        # the scheduler redirects to run.log — what `sky logs` tails.
+        return runner.run(exports, log_path=log_path, stream_logs=True)
+
+    # Rank 0's log additionally mirrors to run.log for `sky logs` tailing.
+    returncodes: Dict[int, int] = {}
+    failed_rank = -1
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, len(runners))) as pool:
+        futures = {
+            pool.submit(_one_rank, rank): rank
+            for rank in range(len(runners))
+        }
+        for fut in concurrent.futures.as_completed(futures):
+            rank = futures[fut]
+            if fut.cancelled():
+                returncodes[rank] = 254  # never started: gang aborted
+                continue
+            try:
+                rc = fut.result()
+            except Exception as e:  # pylint: disable=broad-except
+                print(f'rank {rank} supervisor error: {e}', flush=True)
+                rc = 255
+            returncodes[rank] = rc
+            if rc != 0 and failed_rank < 0:
+                failed_rank = rank
+                # Fan-in failure (all-or-nothing slice semantics; parity
+                # get_or_fail :294-328): not-yet-started ranks are dropped;
+                # in-flight ranks share the supervisor's process group and
+                # are killed with it when the scheduler cancels the job.
+                for fut_other in futures:
+                    fut_other.cancel()
+
+    ok = all(rc == 0 for rc in returncodes.values())
+    status = (job_lib.JobStatus.SUCCEEDED if ok else job_lib.JobStatus.FAILED)
+    job_lib.set_status(job_id, status)
+    summary = {str(r): rc for r, rc in sorted(returncodes.items())}
+    print(f'gang finished: {json.dumps(summary)}', flush=True)
+    return 0 if ok else 1
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--job-id', type=int, required=True)
+    args = parser.parse_args()
+    spec = load_spec(args.job_id)
+    log_dir = os.path.expanduser(spec['log_dir'])
+    os.makedirs(log_dir, exist_ok=True)
+    # The supervisor's own output is the job's driver log.
+    sys.exit(run_gang(args.job_id, spec))
+
+
+if __name__ == '__main__':
+    main()

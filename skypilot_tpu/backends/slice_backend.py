@@ -1,0 +1,585 @@
+"""SliceBackend: the orchestration brain, slice-native and Ray-free.
+
+Parity: /root/reference/sky/backends/cloud_vm_ray_backend.py — the
+CloudVmRayBackend (:2545), CloudVmRayResourceHandle (:2086),
+RetryingVmProvisioner (:1134) and RayCodeGen (:209) collapse here into three
+smaller pieces:
+
+* :class:`SliceResourceHandle` — one handle = one slice-cluster = N hosts
+  (generalizing `num_ips_per_node`, reference :2475-2483).
+* :class:`RetryingProvisioner` — the failover loop over (launchable ×
+  region × zone) with a blocklist, re-enumerating candidates through the
+  optimizer on exhaustion (parity `provision_with_retries` :1934), plus the
+  WAITING path for queued TPU capacity.
+* :class:`SliceBackend` — provision/sync/setup/execute/teardown. Execution
+  ships a job spec to the head and queues the gang supervisor
+  (`backends/gang_supervisor.py`) in the head's job queue; a slice is
+  already a gang, so no placement groups and no Ray dependency on hosts.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import provision
+from skypilot_tpu import sky_logging
+from skypilot_tpu import status_lib
+from skypilot_tpu.backends import backend as backend_lib
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import provisioner as provisioner_lib
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.skylet import autostop_lib
+from skypilot_tpu.skylet import constants
+from skypilot_tpu.skylet import job_lib
+from skypilot_tpu.skylet import log_lib
+from skypilot_tpu.utils import command_runner as command_runner_lib
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import subprocess_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+_QUEUED_CAPACITY_TIMEOUT_MINUTES_DEFAULT = 30
+
+
+class SliceResourceHandle(backend_lib.ResourceHandle):
+    """Picklable pointer to one launched slice-cluster."""
+
+    def __init__(self, cluster_name: str, provider_name: str,
+                 launched_resources: Resources, launched_nodes: int) -> None:
+        self.cluster_name = cluster_name
+        self.provider_name = provider_name
+        self.launched_resources = launched_resources
+        self.launched_nodes = launched_nodes
+        # Cached (refreshable) connectivity info.
+        self.stable_internal_external_ips: Optional[List[Tuple[str, str]]] = None
+        self.launched_at = time.time()
+
+    def get_cluster_name(self) -> str:
+        return self.cluster_name
+
+    @property
+    def num_hosts(self) -> int:
+        return self.launched_resources.num_hosts * self.launched_nodes
+
+    def get_cluster_info(self) -> provision_common.ClusterInfo:
+        return provision.get_cluster_info(self.provider_name,
+                                          self.cluster_name)
+
+    def get_command_runners(
+            self,
+            cluster_info: Optional[provision_common.ClusterInfo] = None
+    ) -> List[command_runner_lib.CommandRunner]:
+        if cluster_info is None:
+            cluster_info = self.get_cluster_info()
+        return provision.get_command_runners(self.provider_name, cluster_info)
+
+    def cache_ips(self,
+                  cluster_info: provision_common.ClusterInfo) -> None:
+        self.stable_internal_external_ips = [
+            (inst.internal_ip, inst.external_ip or inst.internal_ip)
+            for inst in cluster_info.instances
+        ]
+
+    def external_ips(self) -> Optional[List[str]]:
+        if self.stable_internal_external_ips is None:
+            return None
+        return [pair[1] for pair in self.stable_internal_external_ips]
+
+    def __repr__(self) -> str:
+        return (f'<SliceResourceHandle {self.cluster_name} '
+                f'{self.launched_resources!r} hosts={self.num_hosts}>')
+
+
+class RetryingProvisioner:
+    """Failover loop: launchable × region × zone, with blocklist + re-opt."""
+
+    def __init__(self, requested_task: 'task_lib.Task',
+                 cluster_name: str) -> None:
+        self._task = requested_task
+        self._cluster_name = cluster_name
+        self._blocked: List[Resources] = []
+        self._failover_history: List[Exception] = []
+
+    def provision_with_retries(
+        self, to_provision: Resources
+    ) -> Tuple[provision_common.ProvisionRecord, Resources]:
+        """Try the chosen launchable; fail over across zones/regions/
+        candidates until something provisions (parity reference :1934)."""
+        candidate = to_provision
+        while True:
+            result = self._try_candidate(candidate)
+            if result is not None:
+                return result
+            self._blocked.append(candidate)
+            try:
+                launchables = optimizer_lib.Optimizer.enumerate_launchables(
+                    self._task, blocked_resources=self._blocked)
+            except exceptions.ResourcesUnavailableError as e:
+                raise exceptions.ResourcesUnavailableError(
+                    f'Failed to provision {self._cluster_name} on all '
+                    f'feasible resources. Attempts: '
+                    f'{[str(x) for x in self._failover_history]}',
+                    failover_history=self._failover_history) from e
+            candidate = launchables[0][0]
+            logger.info(f'Failing over to next candidate: {candidate!r}')
+
+    def _try_candidate(
+        self, resources: Resources
+    ) -> Optional[Tuple[provision_common.ProvisionRecord, Resources]]:
+        cloud = resources.cloud
+        assert cloud is not None, resources
+        for region, zones in cloud.zones_provision_loop(
+                resources, region=resources.region):
+            zone_names = [z.name for z in (zones or [])]
+            if resources.zone is not None:
+                zone_names = [z for z in zone_names if z == resources.zone]
+                if not zone_names:
+                    continue
+            for zone_name in (zone_names or [None]):
+                attempt = resources.copy(region=region.name, zone=zone_name)
+                try:
+                    record = self._provision_once(cloud, attempt, region,
+                                                  zone_name)
+                    return record, attempt
+                except (exceptions.ProvisionError,
+                        exceptions.ResourcesUnavailableError) as e:
+                    logger.warning(
+                        f'Provision attempt failed in {region.name}/'
+                        f'{zone_name}: {e}')
+                    self._failover_history.append(e)
+                    continue
+        return None
+
+    def _provision_once(
+            self, cloud: cloud_lib.Cloud, resources: Resources,
+            region: cloud_lib.Region,
+            zone_name: Optional[str]) -> provision_common.ProvisionRecord:
+        zones = ([cloud_lib.Zone(zone_name, region.name)]
+                 if zone_name else region.zones)
+        deploy_vars = cloud.make_deploy_resources_variables(
+            resources, self._cluster_name, region, zones)
+        config = provision_common.ProvisionConfig(
+            provider_name=cloud.PROVISIONER,
+            cluster_name=self._cluster_name,
+            region=region.name,
+            zones=[z.name for z in zones],
+            deploy_vars=deploy_vars,
+            count=self._task.num_nodes,
+            ports_to_open=resources.ports or [],
+        )
+        global_user_state.add_or_update_cluster(
+            self._cluster_name,
+            SliceResourceHandle(self._cluster_name, cloud.PROVISIONER,
+                                resources, self._task.num_nodes),
+            requested_resources=set(self._task.resources),
+            ready=False)
+        record = provisioner_lib.bulk_provision(config)
+        if record.waiting:
+            global_user_state.set_cluster_status(
+                self._cluster_name, status_lib.ClusterStatus.WAITING)
+            timeout_minutes = config_lib.get_nested(
+                ('tpu', 'queued_timeout_minutes'),
+                _QUEUED_CAPACITY_TIMEOUT_MINUTES_DEFAULT)
+            granted = provisioner_lib.wait_for_queued_capacity(
+                cloud.PROVISIONER, self._cluster_name,
+                timeout=timeout_minutes * 60)
+            if not granted:
+                provisioner_lib.teardown_cluster(cloud.PROVISIONER,
+                                                 self._cluster_name,
+                                                 terminate=True)
+                raise exceptions.ProvisionError(
+                    f'Queued capacity not granted within '
+                    f'{timeout_minutes} minutes.')
+            provision.wait_instances(cloud.PROVISIONER, self._cluster_name)
+        return record
+
+
+class SliceBackend(backend_lib.Backend[SliceResourceHandle]):
+    """The default backend."""
+
+    NAME = 'slice'
+
+    def __init__(self) -> None:
+        self._optimize_target = optimizer_lib.OptimizeTarget.COST
+        self._requested_features: set = set()
+
+    def register_info(self, **kwargs: Any) -> None:
+        self._optimize_target = kwargs.get('minimize_target',
+                                           self._optimize_target)
+        self._requested_features = kwargs.get('requested_features',
+                                              self._requested_features)
+
+    # ----------------------------------------------------------- provision
+
+    def check_existing_cluster(
+            self, cluster_name: str,
+            task: 'task_lib.Task') -> Optional[SliceResourceHandle]:
+        """Reuse an UP cluster if it satisfies the request.
+
+        Parity: reference `_check_existing_cluster` (:4280).
+        """
+        record = global_user_state.get_cluster_from_name(cluster_name)
+        if record is None or record['handle'] is None:
+            return None
+        handle: SliceResourceHandle = record['handle']
+        from skypilot_tpu.backends import backend_utils  # pylint: disable=import-outside-toplevel
+        status = backend_utils.refresh_cluster_status(cluster_name)
+        if status is None:
+            return None
+        if status != status_lib.ClusterStatus.UP:
+            raise exceptions.ClusterNotUpError(
+                f'Cluster {cluster_name} exists but is {status.value}; '
+                f'run start first or pick a new name.',
+                cluster_status=status, handle=handle)
+        for requested in task.resources:
+            if requested.less_demanding_than(handle.launched_resources):
+                return handle
+        raise exceptions.ResourcesMismatchError(
+            f'Cluster {cluster_name} ({handle.launched_resources!r}) does '
+            f'not satisfy the requested resources '
+            f'({[str(r) for r in task.resources]}).')
+
+    def _provision(self, task: 'task_lib.Task',
+                   to_provision: Optional[Resources], dryrun: bool,
+                   stream_logs: bool, cluster_name: str,
+                   retry_until_up: bool = False
+                   ) -> Optional[SliceResourceHandle]:
+        del stream_logs
+        common_utils.check_cluster_name_is_valid(cluster_name)
+        existing = self.check_existing_cluster(cluster_name, task)
+        if existing is not None:
+            logger.info(f'Reusing existing cluster {cluster_name}.')
+            return existing
+        if to_provision is None:
+            launchables = optimizer_lib.Optimizer.enumerate_launchables(task)
+            to_provision = launchables[0][0]
+        if dryrun:
+            logger.info(f'Dryrun: would provision {to_provision!r} as '
+                        f'{cluster_name}.')
+            return None
+        cloud = to_provision.cloud
+        assert cloud is not None
+        type(cloud).check_features_are_supported(to_provision,
+                                                 self._requested_features)
+
+        backoff = common_utils.Backoff(initial_backoff=10.0)
+        while True:
+            retrier = RetryingProvisioner(task, cluster_name)
+            try:
+                record, launched = retrier.provision_with_retries(to_provision)
+                break
+            except exceptions.ResourcesUnavailableError:
+                global_user_state.remove_cluster(cluster_name, terminate=True)
+                if not retry_until_up:
+                    raise
+                sleep_s = backoff.current_backoff()
+                logger.info(
+                    f'retry_until_up: all candidates exhausted; retrying in '
+                    f'{sleep_s:.0f}s.')
+                time.sleep(sleep_s)
+
+        cluster_info = provisioner_lib.post_provision_runtime_setup(
+            record.provider_name, cluster_name,
+            credential_files=cloud.get_credential_file_mounts())
+        handle = SliceResourceHandle(cluster_name, record.provider_name,
+                                     launched, task.num_nodes)
+        handle.cache_ips(cluster_info)
+        global_user_state.add_or_update_cluster(
+            cluster_name, handle, requested_resources=set(task.resources),
+            ready=True)
+        global_user_state.set_owner_identity_for_cluster(
+            cluster_name, cloud.get_current_user_identity())
+        return handle
+
+    # ---------------------------------------------------------------- sync
+
+    def _sync_workdir(self, handle: SliceResourceHandle,
+                      workdir: str) -> None:
+        runners = handle.get_command_runners()
+
+        def _one(runner: command_runner_lib.CommandRunner) -> None:
+            runner.rsync(workdir, constants.SKY_REMOTE_WORKDIR, up=True,
+                         stream_logs=False)
+
+        subprocess_utils.run_in_parallel(_one, runners)
+        logger.info(f'Synced workdir {workdir!r} to '
+                    f'{len(runners)} host(s).')
+
+    def _sync_file_mounts(self, handle: SliceResourceHandle,
+                          all_file_mounts: Optional[Dict[str, str]],
+                          storage_mounts: Optional[Dict[str, Any]]) -> None:
+        if all_file_mounts:
+            runners = handle.get_command_runners()
+
+            def _one(runner: command_runner_lib.CommandRunner) -> None:
+                for dst, src in all_file_mounts.items():
+                    if src.startswith(('gs://', 's3://', 'r2://')):
+                        continue  # handled via storage layer
+                    parent = os.path.dirname(dst.rstrip('/'))
+                    if parent and parent not in ('~', '/'):
+                        runner.run(f'mkdir -p {parent}', stream_logs=False)
+                    runner.rsync(os.path.expanduser(src), dst, up=True,
+                                 stream_logs=False)
+
+            subprocess_utils.run_in_parallel(_one, runners)
+        if storage_mounts:
+            from skypilot_tpu.data import storage_mounting  # pylint: disable=import-outside-toplevel
+            storage_mounting.execute_storage_mounts(handle, storage_mounts)
+
+    # --------------------------------------------------------------- setup
+
+    def _setup(self, handle: SliceResourceHandle, task: 'task_lib.Task',
+               detach_setup: bool = False) -> None:
+        del detach_setup
+        if task.setup is None:
+            return
+        runners = handle.get_command_runners()
+        script = log_lib.make_task_bash_script(
+            f'cd {constants.SKY_REMOTE_WORKDIR} 2>/dev/null; {task.setup}',
+            task.envs)
+        run_timestamp = common_utils.generate_run_id()
+        log_dir = os.path.join(os.path.expanduser('~/sky_logs'),
+                               run_timestamp)
+        results = command_runner_lib.run_on_all(runners, script,
+                                               log_dir=log_dir)
+        failed = [i for i, rc in enumerate(results) if rc != 0]
+        if failed:
+            raise exceptions.CommandError(
+                returncode=1,
+                command=f'setup ({task.setup[:80]}...)',
+                error_msg=f'Setup failed on host(s) {failed}; logs in '
+                          f'{log_dir}.')
+        logger.info(f'Setup completed on {len(runners)} host(s).')
+
+    # ------------------------------------------------------------- execute
+
+    def _job_env_contract(self, handle: SliceResourceHandle,
+                          task: 'task_lib.Task',
+                          job_id: int) -> Dict[str, str]:
+        resources = handle.launched_resources
+        spec = resources.tpu_spec
+        task_id = common_utils.get_global_job_id(
+            common_utils.generate_run_id(), handle.cluster_name, str(job_id))
+        env = {
+            constants.ENV_TASK_ID: task_id,
+            constants.ENV_CLUSTER_NAME: handle.cluster_name,
+            constants.ENV_JOB_ID: str(job_id),
+        }
+        if spec is not None:
+            env.update({
+                constants.ENV_ACCEL_TYPE: spec.name,
+                constants.ENV_TOPOLOGY: spec.topology_str,
+                constants.ENV_CHIPS_PER_HOST: str(spec.chips_per_host),
+            })
+        if task.checkpoint_dir is not None:
+            env[constants.ENV_CHECKPOINT_DIR] = task.checkpoint_dir
+        return env
+
+    def _execute(self, handle: SliceResourceHandle, task: 'task_lib.Task',
+                 detach_run: bool, dryrun: bool = False) -> Optional[int]:
+        if dryrun:
+            logger.info(f'Dryrun: would execute {task!r} on '
+                        f'{handle.cluster_name}.')
+            return None
+        if task.run is None:
+            logger.info('Task has no run command; provisioning only.')
+            return None
+        cluster_info = handle.get_cluster_info()
+        runners = handle.get_command_runners(cluster_info)
+        head = runners[0]
+        run_timestamp = common_utils.generate_run_id()
+
+        resources_str = repr(handle.launched_resources)
+        code = job_lib.JobLibCodeGen.add_job(task.name,
+                                             job_lib.get_current_username(),
+                                             run_timestamp, resources_str)
+        rc, stdout, stderr = head.run(code, require_outputs=True,
+                                      stream_logs=False)
+        subprocess_utils.handle_returncode(rc, code,
+                                           'Failed to register job.',
+                                           stderr)
+        job_id = job_lib.parse_job_id(stdout)
+
+        run_cmd = task.run
+        if callable(run_cmd):
+            ips = cluster_info.get_feasible_ips()
+            run_cmd = run_cmd(0, ips)
+            if run_cmd is None:
+                logger.info('Run generator returned None; nothing to do.')
+                return job_id
+        spec_dict = {
+            'provider': handle.provider_name,
+            'cluster_name': handle.cluster_name,
+            'run_cmd': f'cd {constants.SKY_REMOTE_WORKDIR} 2>/dev/null; '
+                       f'{run_cmd}',
+            'envs': task.envs,
+            'env_contract': self._job_env_contract(handle, task, job_id),
+            'log_dir': os.path.join(constants.SKY_LOGS_DIRECTORY,
+                                    run_timestamp),
+            'num_hosts': handle.num_hosts,
+            'hosts_per_slice':
+                (handle.launched_resources.tpu_spec.num_hosts
+                 if handle.launched_resources.tpu_spec else 1),
+        }
+        with tempfile.NamedTemporaryFile('w', suffix='.json',
+                                         delete=False) as fp:
+            json.dump(spec_dict, fp)
+            local_spec = fp.name
+        try:
+            head.run(f'mkdir -p ~/.skytpu/jobs/{job_id}', stream_logs=False)
+            head.rsync(local_spec, f'~/.skytpu/jobs/{job_id}/spec.json',
+                       up=True, stream_logs=False)
+        finally:
+            os.remove(local_spec)
+
+        supervisor_cmd = (
+            f'mkdir -p {spec_dict["log_dir"]} && '
+            f'PYTHONPATH={constants.SKY_REMOTE_APP_DIR}:$PYTHONPATH '
+            f'{constants.SKY_PYTHON_CMD} -u -m '
+            f'skypilot_tpu.backends.gang_supervisor --job-id {job_id} '
+            f'>> {spec_dict["log_dir"]}/run.log 2>&1')
+        code = job_lib.JobLibCodeGen.queue_job(job_id, supervisor_cmd)
+        rc, _, stderr = head.run(code, require_outputs=True,
+                                 stream_logs=False)
+        subprocess_utils.handle_returncode(rc, code, 'Failed to queue job.',
+                                           stderr)
+        logger.info(f'Job {job_id} submitted on {handle.cluster_name} '
+                    f'({handle.num_hosts} host(s)).')
+        if not detach_run:
+            self.tail_logs(handle, job_id)
+        return job_id
+
+    def _post_execute(self, handle: SliceResourceHandle, down: bool) -> None:
+        del handle, down
+
+    # ---------------------------------------------------------------- logs
+
+    def tail_logs(self, handle: SliceResourceHandle,
+                  job_id: Optional[int], follow: bool = True,
+                  tail: int = 0) -> int:
+        head = handle.get_command_runners()[0]
+        code = job_lib.JobLibCodeGen.tail_logs(job_id, follow=follow,
+                                               tail=tail)
+        rc = head.run(code, stream_logs=True)
+        return rc if isinstance(rc, int) else rc[0]
+
+    def sync_down_logs(self, handle: SliceResourceHandle,
+                       job_id: Optional[int], local_dir: str) -> str:
+        """Download a job's log directory from the head host."""
+        head = handle.get_command_runners()[0]
+        code = job_lib.JobLibCodeGen.get_log_dir(job_id)
+        rc, stdout, stderr = head.run(code, require_outputs=True,
+                                      stream_logs=False)
+        subprocess_utils.handle_returncode(rc, code, 'Failed to resolve log '
+                                           'dir.', stderr)
+        remote_dir = job_lib.parse_tagged_json(stdout, 'LOG_DIR:')
+        if remote_dir is None:
+            raise exceptions.JobError(f'Job {job_id} has no logs.')
+        target = os.path.join(os.path.expanduser(local_dir),
+                              os.path.basename(remote_dir.rstrip('/')))
+        os.makedirs(target, exist_ok=True)
+        head.rsync(remote_dir, target, up=False, stream_logs=False)
+        return target
+
+    # ----------------------------------------------------------- job queue
+
+    def get_job_queue(self, handle: SliceResourceHandle,
+                      all_jobs: bool = True) -> List[Dict[str, Any]]:
+        head = handle.get_command_runners()[0]
+        code = job_lib.JobLibCodeGen.get_job_queue(all_jobs)
+        rc, stdout, stderr = head.run(code, require_outputs=True,
+                                      stream_logs=False)
+        subprocess_utils.handle_returncode(rc, code,
+                                           'Failed to fetch job queue.',
+                                           stderr)
+        return job_lib.parse_tagged_json(stdout, 'JOBS:')
+
+    def cancel_jobs(self, handle: SliceResourceHandle,
+                    job_ids: Optional[List[int]],
+                    cancel_all: bool = False) -> List[int]:
+        head = handle.get_command_runners()[0]
+        code = job_lib.JobLibCodeGen.cancel_jobs(job_ids, cancel_all)
+        rc, stdout, stderr = head.run(code, require_outputs=True,
+                                      stream_logs=False)
+        subprocess_utils.handle_returncode(rc, code, 'Failed to cancel.',
+                                           stderr)
+        return job_lib.parse_tagged_json(stdout, 'CANCELLED:')
+
+    def get_job_status(
+            self, handle: SliceResourceHandle,
+            job_ids: Optional[List[int]] = None
+    ) -> Dict[str, Optional[str]]:
+        head = handle.get_command_runners()[0]
+        code = job_lib.JobLibCodeGen.get_job_status(job_ids)
+        rc, stdout, stderr = head.run(code, require_outputs=True,
+                                      stream_logs=False)
+        subprocess_utils.handle_returncode(rc, code,
+                                           'Failed to fetch job status.',
+                                           stderr)
+        return job_lib.parse_tagged_json(stdout, 'STATUS:')
+
+    # ------------------------------------------------------------ autostop
+
+    def set_autostop(self, handle: SliceResourceHandle, idle_minutes: int,
+                     down: bool = False) -> None:
+        head = handle.get_command_runners()[0]
+        code = autostop_lib_codegen(idle_minutes, down, handle.provider_name,
+                                    handle.cluster_name)
+        rc, _, stderr = head.run(code, require_outputs=True,
+                                 stream_logs=False)
+        subprocess_utils.handle_returncode(rc, code,
+                                           'Failed to set autostop.', stderr)
+        global_user_state.set_cluster_autostop_value(handle.cluster_name,
+                                                     idle_minutes, down)
+
+    # ------------------------------------------------------------ teardown
+
+    def _teardown(self, handle: SliceResourceHandle, terminate: bool,
+                  purge: bool = False) -> None:
+        spec = handle.launched_resources.tpu_spec
+        if not terminate and spec is not None and spec.is_pod:
+            raise exceptions.NotSupportedError(
+                f'Multi-host TPU slice {handle.cluster_name} cannot be '
+                'stopped; use down/terminate.')
+        try:
+            provisioner_lib.teardown_cluster(handle.provider_name,
+                                             handle.cluster_name, terminate)
+        except Exception:  # pylint: disable=broad-except
+            if not purge:
+                raise
+            logger.warning(f'Purge: ignoring teardown failure of '
+                           f'{handle.cluster_name}.')
+        global_user_state.remove_cluster(handle.cluster_name,
+                                         terminate=terminate)
+
+    def run_on_head(self, handle: SliceResourceHandle, cmd: str,
+                    **kwargs: Any) -> Any:
+        """Arbitrary command on the head host (parity reference :4204)."""
+        head = handle.get_command_runners()[0]
+        return head.run(cmd, **kwargs)
+
+
+def autostop_lib_codegen(idle_minutes: int, down: bool, provider_name: str,
+                         cluster_name: str) -> str:
+    """Head-side autostop config write, shipped like all JobLib codegens."""
+    python = constants.SKY_PYTHON_CMD
+    app_dir = constants.SKY_REMOTE_APP_DIR
+    body = ('from skypilot_tpu.skylet import autostop_lib; '
+            f'autostop_lib.set_autostop({idle_minutes}, {down}, '
+            f'{provider_name!r}, {cluster_name!r})')
+    import shlex  # pylint: disable=import-outside-toplevel
+    return (f'PYTHONPATH={app_dir}:$PYTHONPATH {python} -u -c '
+            f'{shlex.quote(body)}')

@@ -1,0 +1,47 @@
+"""`sky check`: verify credentials per infra, persist the enabled set.
+
+Parity: /root/reference/sky/check.py:19-100.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu.clouds import registry
+
+logger = sky_logging.init_logger(__name__)
+
+
+def check(quiet: bool = False) -> List[str]:
+    """Probe every registered infra; returns the enabled list."""
+    enabled = []
+    results: Dict[str, Tuple[bool, Optional[str]]] = {}
+    for name, cloud in registry.CLOUD_REGISTRY.items():
+        try:
+            ok, reason = cloud.check_credentials()
+        except Exception as e:  # pylint: disable=broad-except
+            ok, reason = False, str(e)
+        results[name] = (ok, reason)
+        if ok:
+            enabled.append(name)
+    global_user_state.set_enabled_clouds(enabled)
+    if not quiet:
+        for name, (ok, reason) in sorted(results.items()):
+            mark = '\x1b[32m✔\x1b[0m' if ok else '\x1b[31m✗\x1b[0m'
+            line = f'  {mark} {name}'
+            if not ok and reason:
+                line += f' — {reason.splitlines()[0]}'
+            logger.info(line)
+    if not enabled:
+        raise exceptions.NoCloudAccessError(
+            'No infra has valid credentials.')
+    return enabled
+
+
+def get_cached_enabled_clouds_or_refresh() -> List[str]:
+    enabled = global_user_state.get_enabled_clouds()
+    if enabled:
+        return enabled
+    return check(quiet=True)

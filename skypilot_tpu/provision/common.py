@@ -1,0 +1,107 @@
+"""Provision-layer dataclasses shared by all provider impls.
+
+Parity: /root/reference/sky/provision/common.py:39-272 (ProvisionConfig,
+ProvisionRecord, InstanceInfo, ClusterInfo, Endpoint) — reshaped so the
+*slice* is the instance: one InstanceInfo per slice host (worker), grouped
+under a slice id, with TPU metadata (topology, worker rank) first-class.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ProvisionConfig:
+    """Everything a provider impl needs to create capacity."""
+    provider_name: str                 # 'gcp' | 'gke' | 'local'
+    cluster_name: str
+    region: str
+    zones: List[str]
+    # Output of cloud.make_deploy_resources_variables().
+    deploy_vars: Dict[str, Any]
+    # Number of launch units (slices for TPU, VMs otherwise).
+    count: int = 1
+    authentication_config: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
+    ports_to_open: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def is_tpu(self) -> bool:
+        return bool(self.deploy_vars.get('tpu'))
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    """One reachable host (a TPU-VM worker or a VM)."""
+    instance_id: str
+    internal_ip: str
+    external_ip: Optional[str]
+    ssh_port: int = 22
+    slice_id: int = 0                  # which slice (multislice index)
+    worker_id: int = 0                 # rank within the slice
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def get_feasible_ip(self) -> str:
+        return self.external_ip or self.internal_ip
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    """What a run_instances call actually did (idempotency bookkeeping)."""
+    provider_name: str
+    cluster_name: str
+    region: str
+    zone: Optional[str]
+    head_instance_id: Optional[str]
+    created_instance_ids: List[str] = dataclasses.field(default_factory=list)
+    resumed_instance_ids: List[str] = dataclasses.field(default_factory=list)
+    # Async (queued-resource) provisioning: capacity granted later.
+    waiting: bool = False
+    queued_resource_id: Optional[str] = None
+
+    def is_instance_just_booted(self, instance_id: str) -> bool:
+        return (instance_id in self.created_instance_ids or
+                instance_id in self.resumed_instance_ids)
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Live view of a provisioned slice-cluster."""
+    provider_name: str
+    cluster_name: str
+    region: str
+    zone: Optional[str]
+    # rank-ordered: instances[0] is the head host (slice 0, worker 0).
+    instances: List[InstanceInfo] = dataclasses.field(default_factory=list)
+    head_instance_id: Optional[str] = None
+    ssh_user: str = 'skytpu'
+    ssh_private_key: Optional[str] = None
+    # Provider-specific extras (e.g. local root dirs, TPU node names).
+    custom_metadata: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.instances)
+
+    def get_head_instance(self) -> Optional[InstanceInfo]:
+        for inst in self.instances:
+            if inst.instance_id == self.head_instance_id:
+                return inst
+        return self.instances[0] if self.instances else None
+
+    def get_feasible_ips(self) -> List[str]:
+        return [inst.get_feasible_ip() for inst in self.instances]
+
+    def ip_list_str(self) -> str:
+        return '\n'.join(self.get_feasible_ips())
+
+
+@dataclasses.dataclass
+class Endpoint:
+    """An externally reachable (ip, port) for an opened service port."""
+    host: str
+    port: int
+
+    def url(self, protocol: str = 'http') -> str:
+        return f'{protocol}://{self.host}:{self.port}'

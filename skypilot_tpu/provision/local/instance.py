@@ -1,0 +1,248 @@
+"""Local provisioner: slice hosts as directories + subprocesses.
+
+The hermetic counterpart of a TPU-VM slice (SURVEY.md §4: the reference has
+no fake provisioner; this is the fix). A "cluster" is a directory under
+``$SKYTPU_HOME/local_clusters/<name>/`` with one ``host<i>/`` root per slice
+host and a ``meta.json``; every provision-API function manipulates that
+state, and `get_command_runners` hands back LocalProcessRunners so the whole
+backend/skylet/jobs/serve stack runs unmodified against it.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.utils import command_runner
+from skypilot_tpu.utils import common_utils
+
+_FAIL_MARKER_ENV = 'SKYTPU_LOCAL_PROVISION_FAIL'  # test hook: fail cluster names containing this substring
+
+
+def _clusters_root() -> str:
+    return common_utils.ensure_dir(
+        os.path.join(common_utils.skytpu_home(), 'local_clusters'))
+
+
+def _cluster_dir(cluster_name: str) -> str:
+    return os.path.join(_clusters_root(), cluster_name)
+
+
+def _meta_path(cluster_name: str) -> str:
+    return os.path.join(_cluster_dir(cluster_name), 'meta.json')
+
+
+def _read_meta(cluster_name: str) -> Optional[Dict[str, Any]]:
+    path = _meta_path(cluster_name)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding='utf-8') as f:
+        return json.load(f)
+
+
+def _write_meta(cluster_name: str, meta: Dict[str, Any]) -> None:
+    os.makedirs(_cluster_dir(cluster_name), exist_ok=True)
+    with open(_meta_path(cluster_name), 'w', encoding='utf-8') as f:
+        json.dump(meta, f, indent=2)
+
+
+# ----------------------------------------------------------------- the API
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    cluster_name = config.cluster_name
+    fail_marker = os.environ.get(_FAIL_MARKER_ENV)
+    if fail_marker and fail_marker in cluster_name:
+        from skypilot_tpu import exceptions  # pylint: disable=import-outside-toplevel
+        raise exceptions.ProvisionError(
+            f'Injected provisioning failure for {cluster_name!r}.')
+    deploy_vars = config.deploy_vars
+    hosts_per_slice = int(deploy_vars.get('tpu_num_hosts') or 1)
+    num_slices = int(deploy_vars.get('num_slices') or 1)
+    num_hosts = hosts_per_slice * num_slices * config.count
+
+    meta = _read_meta(cluster_name)
+    created, resumed = [], []
+    if meta is None:
+        hosts = []
+        for i in range(num_hosts):
+            host_id = f'{cluster_name}-host{i}'
+            root = os.path.join(_cluster_dir(cluster_name), f'host{i}')
+            os.makedirs(root, exist_ok=True)
+            hosts.append({
+                'instance_id': host_id,
+                'root_dir': root,
+                'slice_id': i // hosts_per_slice,
+                'worker_id': i % hosts_per_slice,
+                'status': 'running',
+            })
+            created.append(host_id)
+        meta = {
+            'cluster_name': cluster_name,
+            'provider': 'local',
+            'created_at': time.time(),
+            'deploy_vars': deploy_vars,
+            'hosts_per_slice': hosts_per_slice,
+            'hosts': hosts,
+        }
+    else:
+        if len(meta['hosts']) != num_hosts:
+            from skypilot_tpu import exceptions  # pylint: disable=import-outside-toplevel
+            raise exceptions.ResourcesMismatchError(
+                f'Cluster {cluster_name} exists with {len(meta["hosts"])} '
+                f'hosts; requested {num_hosts}.')
+        for host in meta['hosts']:
+            if host['status'] != 'running':
+                host['status'] = 'running'
+                resumed.append(host['instance_id'])
+    _write_meta(cluster_name, meta)
+    return common.ProvisionRecord(
+        provider_name='local',
+        cluster_name=cluster_name,
+        region=config.region,
+        zone=config.zones[0] if config.zones else 'local',
+        head_instance_id=meta['hosts'][0]['instance_id'],
+        created_instance_ids=created,
+        resumed_instance_ids=resumed,
+    )
+
+
+def wait_instances(cluster_name: str, state: Optional[str] = None) -> None:
+    del cluster_name, state  # Local hosts are ready the moment they exist.
+
+
+def wait_capacity(cluster_name: str, timeout: float = 0) -> bool:
+    del cluster_name, timeout
+    return True  # Local capacity is synchronous.
+
+
+def stop_instances(cluster_name: str, worker_only: bool = False) -> None:
+    meta = _read_meta(cluster_name)
+    if meta is None:
+        return
+    if not worker_only:
+        # Stopping a VM kills its processes; disks (host dirs) persist.
+        _kill_host_processes(cluster_name)
+    for host in meta['hosts']:
+        if worker_only and host['worker_id'] == 0 and host['slice_id'] == 0:
+            continue
+        host['status'] = 'stopped'
+    _write_meta(cluster_name, meta)
+
+
+def _kill_host_processes(cluster_name: str) -> None:
+    """Kill skylet + job supervisors spawned inside the emulated hosts.
+
+    A real terminate destroys the VMs and everything on them; here the
+    equivalent is killing every process whose pid we recorded under the
+    host roots (skylet pid file + nonterminal jobs in the head's jobs.db).
+    """
+    import psutil  # pylint: disable=import-outside-toplevel
+    import sqlite3  # pylint: disable=import-outside-toplevel
+    meta = _read_meta(cluster_name)
+    if meta is None:
+        return
+    pids = []
+    for host in meta['hosts']:
+        pid_file = os.path.join(host['root_dir'], '.skytpu', 'skylet.pid')
+        try:
+            with open(pid_file, encoding='utf-8') as f:
+                pids.append(int(f.read().strip()))
+        except (OSError, ValueError):
+            pass
+        job_db = os.path.join(host['root_dir'], '.skytpu', 'jobs.db')
+        if os.path.exists(job_db):
+            try:
+                conn = sqlite3.connect(job_db, timeout=2)
+                rows = conn.execute(
+                    'SELECT pid FROM jobs WHERE pid > 0 AND status NOT IN '
+                    "('SUCCEEDED','FAILED','FAILED_SETUP','FAILED_DRIVER',"
+                    "'CANCELLED')").fetchall()
+                conn.close()
+                pids.extend(int(r[0]) for r in rows)
+            except sqlite3.Error:
+                pass
+    for pid in pids:
+        try:
+            proc = psutil.Process(pid)
+            for child in proc.children(recursive=True):
+                child.kill()
+            proc.kill()
+        except (psutil.NoSuchProcess, psutil.AccessDenied):
+            pass
+
+
+def terminate_instances(cluster_name: str, worker_only: bool = False) -> None:
+    if worker_only:
+        stop_instances(cluster_name, worker_only=True)
+        return
+    _kill_host_processes(cluster_name)
+    shutil.rmtree(_cluster_dir(cluster_name), ignore_errors=True)
+
+
+def query_instances(cluster_name: str) -> Dict[str, Optional[ClusterStatus]]:
+    meta = _read_meta(cluster_name)
+    if meta is None:
+        return {}
+    mapping = {'running': ClusterStatus.UP, 'stopped': ClusterStatus.STOPPED}
+    return {
+        host['instance_id']: mapping.get(host['status'])
+        for host in meta['hosts']
+    }
+
+
+def get_cluster_info(cluster_name: str,
+                     region: Optional[str] = None) -> common.ClusterInfo:
+    del region
+    meta = _read_meta(cluster_name)
+    if meta is None:
+        from skypilot_tpu import exceptions  # pylint: disable=import-outside-toplevel
+        raise exceptions.FetchClusterInfoError(
+            exceptions.FetchClusterInfoError.Reason.HEAD)
+    instances = []
+    for i, host in enumerate(meta['hosts']):
+        instances.append(
+            common.InstanceInfo(
+                instance_id=host['instance_id'],
+                internal_ip=f'127.0.0.1',
+                external_ip='127.0.0.1',
+                ssh_port=0,
+                slice_id=host['slice_id'],
+                worker_id=host['worker_id'],
+                tags={'root_dir': host['root_dir'], 'rank': str(i)},
+            ))
+    return common.ClusterInfo(
+        provider_name='local',
+        cluster_name=cluster_name,
+        region='local',
+        zone='local',
+        instances=instances,
+        head_instance_id=meta['hosts'][0]['instance_id'],
+        ssh_user=common_utils.get_user(),
+        custom_metadata={'cluster_dir': _cluster_dir(cluster_name)},
+    )
+
+
+def open_ports(cluster_name: str, ports: List[int]) -> None:
+    del cluster_name, ports  # Everything is localhost.
+
+
+def cleanup_ports(cluster_name: str) -> None:
+    del cluster_name
+
+
+def get_command_runners(cluster_info: common.ClusterInfo,
+                        **kwargs: Any) -> List[command_runner.CommandRunner]:
+    del kwargs
+    runners: List[command_runner.CommandRunner] = []
+    for inst in cluster_info.instances:
+        runners.append(
+            command_runner.LocalProcessRunner(
+                node=(inst.instance_id, 0),
+                root_dir=inst.tags['root_dir'],
+            ))
+    return runners

@@ -1,0 +1,96 @@
+"""Provision orchestration: create capacity, then bring up the runtime.
+
+Parity: /root/reference/sky/provision/provisioner.py:99-588 (`bulk_provision`
+with retries, `wait_for_ssh`, `post_provision_runtime_setup`). TPU-first
+changes: (1) a WAITING path for queued-resource requests whose capacity is
+granted asynchronously (SURVEY.md §7.4 — breaks the synchronous provision
+contract, so the record carries `waiting=True` and callers persist it);
+(2) runtime setup is app-sync + skylet, not Ray head/worker bring-up.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import instance_setup
+from skypilot_tpu.utils import command_runner as command_runner_lib
+
+logger = sky_logging.init_logger(__name__)
+
+_WAIT_READY_TIMEOUT_SECONDS = 600
+
+
+def bulk_provision(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    """Create (or resume) capacity for one cluster; may return WAITING.
+
+    Raises ProvisionError on definite failure (caller's failover loop moves
+    to the next zone/region/cloud).
+    """
+    provider = config.provider_name
+    logger.debug(f'bulk_provision: {config.cluster_name} on {provider} '
+                 f'({config.region}/{config.zones})')
+    record = provision.run_instances(provider, config)
+    if record.waiting:
+        logger.info(
+            f'Cluster {config.cluster_name}: queued-resource request '
+            f'{record.queued_resource_id} submitted; capacity pending.')
+        return record
+    provision.wait_instances(provider, config.cluster_name)
+    return record
+
+
+def wait_for_queued_capacity(provider: str, cluster_name: str,
+                             timeout: float) -> bool:
+    """Poll an async capacity request until granted or timeout."""
+    deadline = time.time() + timeout
+    interval = 10.0
+    while True:
+        if provision.wait_capacity(provider, cluster_name):
+            return True
+        if time.time() >= deadline:
+            return False
+        time.sleep(min(interval, max(0.0, deadline - time.time())))
+        interval = min(interval * 1.5, 120.0)
+
+
+def post_provision_runtime_setup(
+        provider: str,
+        cluster_name: str,
+        credential_files: Optional[Dict[str, str]] = None,
+        wait_timeout: float = _WAIT_READY_TIMEOUT_SECONDS
+) -> common.ClusterInfo:
+    """Hosts reachable → dirs → app package (+creds) → skylet on head.
+
+    Parity: reference provisioner.py:392-556, minus Ray.
+    """
+    cluster_info = provision.get_cluster_info(provider, cluster_name)
+    runners = provision.get_command_runners(provider, cluster_info)
+    if not runners:
+        raise exceptions.ProvisionError(
+            f'Cluster {cluster_name} has no reachable hosts.')
+    try:
+        command_runner_lib.wait_until_ready(runners, timeout=wait_timeout)
+    except TimeoutError as e:
+        raise exceptions.ProvisionError(str(e)) from e
+    instance_setup.setup_runtime_on_cluster(runners)
+    instance_setup.internal_file_mounts(runners, credential_files)
+    instance_setup.start_skylet_on_head_node(runners[0])
+    logger.debug(f'Runtime ready on {len(runners)} host(s) of '
+                 f'{cluster_name}.')
+    return cluster_info
+
+
+def teardown_cluster(provider: str, cluster_name: str,
+                     terminate: bool) -> None:
+    """Stop or delete all of a cluster's capacity.
+
+    Parity: reference provisioner.py:198.
+    """
+    if terminate:
+        provision.terminate_instances(provider, cluster_name)
+    else:
+        provision.stop_instances(provider, cluster_name)

@@ -57,8 +57,8 @@ class Resources:
         self._cloud: Optional[cloud_lib.Cloud] = cloud
         self._instance_type = instance_type
         self._accelerators = self._parse_accelerators(accelerators)
-        self._cpus = None if cpus is None else str(cpus)
-        self._memory = None if memory is None else str(memory)
+        self._cpus = self._validate_count_str('cpus', cpus)
+        self._memory = self._validate_count_str('memory', memory)
 
         if isinstance(capacity, str):
             capacity = cloud_lib.ProvisionMode(capacity.lower())
@@ -91,6 +91,20 @@ class Resources:
             self._try_validate()
 
     # ------------------------------------------------------------- parsing
+
+    @staticmethod
+    def _validate_count_str(
+            field: str, value: Union[None, int, float, str]) -> Optional[str]:
+        """'4' / '4.5' / '4+' grammar for cpus and memory requests."""
+        if value is None:
+            return None
+        s = str(value).strip()
+        import re  # pylint: disable=import-outside-toplevel
+        if not re.fullmatch(r'\d+(\.\d+)?\+?', s):
+            raise exceptions.InvalidTaskError(
+                f'Invalid {field} request {value!r}: expected a number '
+                "optionally followed by '+' (e.g. '4', '4+').")
+        return s
 
     @staticmethod
     def _parse_accelerators(

@@ -1,0 +1,58 @@
+"""Idempotent skylet (re)start, invoked on the head host at provision time.
+
+Parity: /root/reference/sky/skylet/attempt_skylet.py:1-63. Version-stamps
+the running skylet so a re-provision with newer app code restarts it.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import psutil
+
+from skypilot_tpu.skylet import constants
+
+VERSION_FILE = os.path.expanduser('~/.skytpu/skylet_version')
+
+
+def _running_skylet_pid() -> int:
+    pid_file = os.path.expanduser(constants.SKYLET_PID_FILE)
+    try:
+        with open(pid_file, encoding='utf-8') as f:
+            pid = int(f.read().strip())
+    except (OSError, ValueError):
+        return -1
+    try:
+        proc = psutil.Process(pid)
+        if 'skylet' in ' '.join(proc.cmdline()):
+            return pid
+    except (psutil.NoSuchProcess, psutil.AccessDenied):
+        pass
+    return -1
+
+
+def main() -> None:
+    pid = _running_skylet_pid()
+    restart = os.environ.get('SKYTPU_RESTART_SKYLET') == '1'
+    if pid > 0 and not restart:
+        print(f'skylet already running (pid={pid}).')
+        return
+    if pid > 0:
+        psutil.Process(pid).terminate()
+    os.makedirs(os.path.expanduser('~/.skytpu'), exist_ok=True)
+    log_file = os.path.expanduser(constants.SKYLET_LOG_FILE)
+    env = dict(os.environ)
+    with open(log_file, 'a', encoding='utf-8') as log:
+        proc = subprocess.Popen(  # pylint: disable=consider-using-with
+            [sys.executable, '-m', 'skypilot_tpu.skylet.skylet'],
+            stdout=log, stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL, start_new_session=True, env=env)
+    with open(os.path.expanduser(constants.SKYLET_PID_FILE), 'w',
+              encoding='utf-8') as f:
+        f.write(str(proc.pid))
+    print(f'skylet started (pid={proc.pid}).')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,66 @@
+"""Autostop config + activity tracking on the head host.
+
+Parity: /root/reference/sky/skylet/autostop_lib.py:1-131. The config
+additionally records the provider + cluster name so the AutostopEvent can
+call the provision API directly (the reference instead re-parses the Ray
+cluster YAML shipped to the head).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Optional
+
+from skypilot_tpu.skylet import constants
+
+
+def _config_path() -> str:
+    return os.path.expanduser(constants.AUTOSTOP_CONFIG_FILE)
+
+
+def _last_active_path() -> str:
+    return os.path.expanduser(constants.AUTOSTOP_LAST_ACTIVE_FILE)
+
+
+@dataclasses.dataclass
+class AutostopConfig:
+    autostop_idle_minutes: int     # <0 disables
+    down: bool                     # terminate instead of stop
+    provider_name: str
+    cluster_name: str
+
+    @property
+    def enabled(self) -> bool:
+        return self.autostop_idle_minutes >= 0
+
+
+def set_autostop(idle_minutes: int, down: bool, provider_name: str,
+                 cluster_name: str) -> None:
+    config = AutostopConfig(idle_minutes, down, provider_name, cluster_name)
+    os.makedirs(os.path.dirname(_config_path()), exist_ok=True)
+    with open(_config_path(), 'w', encoding='utf-8') as f:
+        json.dump(dataclasses.asdict(config), f)
+    set_last_active_time_to_now()
+
+
+def get_autostop_config() -> Optional[AutostopConfig]:
+    if not os.path.exists(_config_path()):
+        return None
+    with open(_config_path(), encoding='utf-8') as f:
+        return AutostopConfig(**json.load(f))
+
+
+def set_last_active_time_to_now() -> None:
+    os.makedirs(os.path.dirname(_last_active_path()), exist_ok=True)
+    with open(_last_active_path(), 'w', encoding='utf-8') as f:
+        f.write(str(time.time()))
+
+
+def get_last_active_time() -> float:
+    try:
+        with open(_last_active_path(), encoding='utf-8') as f:
+            return float(f.read().strip())
+    except (OSError, ValueError):
+        return -1.0

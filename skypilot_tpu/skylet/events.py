@@ -1,0 +1,76 @@
+"""Skylet periodic events: job scheduling, reconciliation, autostop.
+
+Parity: /root/reference/sky/skylet/events.py:26-291 (SkyletEvent base with
+per-event intervals; JobSchedulerEvent; AutostopEvent). The AutostopEvent
+here stops/terminates the slice through the provision API using the provider
+recorded in the autostop config — no Ray-YAML re-parsing and no monkey-
+patched `ray up` (reference events.py:90-291).
+"""
+from __future__ import annotations
+
+import time
+import traceback
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.skylet import autostop_lib
+from skypilot_tpu.skylet import job_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+class SkyletEvent:
+    """Base: `run()` is invoked every EVENT_INTERVAL_SECONDS ticks."""
+    EVENT_INTERVAL_SECONDS = 300
+
+    def __init__(self) -> None:
+        self._last_run_at = 0.0
+
+    def maybe_run(self) -> None:
+        now = time.time()
+        if now - self._last_run_at < self.EVENT_INTERVAL_SECONDS:
+            return
+        self._last_run_at = now
+        try:
+            self.run()
+        except Exception:  # pylint: disable=broad-except
+            logger.error(f'{type(self).__name__} failed:\n'
+                         f'{traceback.format_exc()}')
+
+    def run(self) -> None:
+        raise NotImplementedError
+
+
+class JobSchedulerEvent(SkyletEvent):
+    """Launch queued jobs FIFO + reconcile drifted statuses."""
+    EVENT_INTERVAL_SECONDS = 20
+
+    def run(self) -> None:
+        job_lib.update_job_status()
+        job_lib.scheduler.schedule_step()
+        if not job_lib.is_cluster_idle():
+            autostop_lib.set_last_active_time_to_now()
+
+
+class AutostopEvent(SkyletEvent):
+    """Stop/terminate this cluster after the configured idle window."""
+    EVENT_INTERVAL_SECONDS = 60
+
+    def run(self) -> None:
+        config = autostop_lib.get_autostop_config()
+        if config is None or not config.enabled:
+            return
+        if not job_lib.is_cluster_idle():
+            return
+        last_active = autostop_lib.get_last_active_time()
+        idle_seconds = time.time() - last_active if last_active > 0 else 0.0
+        if idle_seconds < config.autostop_idle_minutes * 60:
+            return
+        logger.info(
+            f'Autostop: idle {idle_seconds / 60:.1f}m >= '
+            f'{config.autostop_idle_minutes}m; '
+            f'{"terminating" if config.down else "stopping"} '
+            f'{config.cluster_name}.')
+        from skypilot_tpu.provision import provisioner  # pylint: disable=import-outside-toplevel
+        provisioner.teardown_cluster(config.provider_name,
+                                     config.cluster_name,
+                                     terminate=config.down)

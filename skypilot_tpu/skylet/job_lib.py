@@ -62,10 +62,12 @@ _initialized_paths: set = set()
 
 def _conn() -> sqlite3.Connection:
     path = _db_path()
-    conn = sqlite3.connect(path, timeout=10)
     # Schema DDL (and its implicit COMMIT) only once per db per process;
-    # keyed by path because tests repoint SKYTPU_JOB_DB.
-    if path not in _initialized_paths:
+    # keyed by path because tests repoint SKYTPU_JOB_DB. Re-run it if the
+    # file vanished (connect() recreates an empty, schema-less db).
+    needs_ddl = path not in _initialized_paths or not os.path.exists(path)
+    conn = sqlite3.connect(path, timeout=10)
+    if needs_ddl:
         conn.executescript(_CREATE)
         _initialized_paths.add(path)
     return conn
@@ -272,12 +274,19 @@ class FIFOScheduler:
                                 stdout=subprocess.DEVNULL,
                                 stderr=subprocess.DEVNULL,
                                 start_new_session=True)
-        # Status + pid in one write: a concurrent update_job_status must
-        # never observe SETTING_UP with the pid column still -1 (it would
-        # declare the healthy job FAILED_DRIVER).
+        # Ordering matters twice over: (1) pid is written before the status
+        # leaves PENDING, so a concurrent update_job_status can never see
+        # SETTING_UP with pid=-1 (would mark the job FAILED_DRIVER);
+        # (2) the status write is guarded on still-PENDING, so if the (very
+        # fast) supervisor already advanced to RUNNING/terminal, we do not
+        # regress its status.
         with _conn() as conn:
-            conn.execute('UPDATE jobs SET status=?, pid=? WHERE job_id=?',
-                         (JobStatus.SETTING_UP.value, proc.pid, job_id))
+            conn.execute('UPDATE jobs SET pid=? WHERE job_id=?',
+                         (proc.pid, job_id))
+            conn.execute('UPDATE jobs SET status=? WHERE job_id=? '
+                         'AND status=?',
+                         (JobStatus.SETTING_UP.value, job_id,
+                          JobStatus.PENDING.value))
         self.remove_job_no_lock(job_id)
 
 
@@ -423,12 +432,22 @@ class JobLibCodeGen:
     def tail_logs(cls, job_id: Optional[int], follow: bool = True,
                   tail: int = 0) -> str:
         return cls._build([
-            f'job_id = {job_id} if {job_id!r} is not None else '
-            'job_lib.get_latest_job_id()',
+            f'job_id = {job_id!r}',
+            'job_id = job_lib.get_latest_job_id() if job_id is None else job_id',
             'log_dir = job_lib.get_log_dir_for_job(job_id) '
             'if job_id is not None else None',
             f'import sys; sys.exit(log_lib.tail_logs(job_id, log_dir, '
             f'follow={follow}, tail={tail}))',
+        ])
+
+    @classmethod
+    def get_log_dir(cls, job_id: Optional[int] = None) -> str:
+        return cls._build([
+            f'job_id = {job_id!r}',
+            'job_id = job_lib.get_latest_job_id() if job_id is None else job_id',
+            'log_dir = job_lib.get_log_dir_for_job(job_id) '
+            'if job_id is not None else None',
+            'import json; print("LOG_DIR:" + json.dumps(log_dir), flush=True)',
         ])
 
     @classmethod

@@ -1,0 +1,31 @@
+"""The skylet daemon: tiny event loop on the slice head host.
+
+Parity: /root/reference/sky/skylet/skylet.py:1-33 (infinite loop over
+events every tick).
+"""
+from __future__ import annotations
+
+import time
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.skylet import constants
+from skypilot_tpu.skylet import events
+
+logger = sky_logging.init_logger(__name__)
+
+EVENTS = (
+    events.JobSchedulerEvent(),
+    events.AutostopEvent(),
+)
+
+
+def main() -> None:
+    logger.info('skylet started.')
+    while True:
+        time.sleep(constants.SKYLET_EVENT_INTERVAL_SECONDS)
+        for event in EVENTS:
+            event.maybe_run()
+
+
+if __name__ == '__main__':
+    main()

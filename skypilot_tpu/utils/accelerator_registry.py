@@ -139,6 +139,8 @@ def parse_tpu_name(name: str) -> Optional[TpuSliceSpec]:
     size = int(m.group('size'))
     if size <= 0:
         return None
+    if gen.size_is_cores and size % gen.cores_per_chip:
+        return None  # e.g. 'tpu-v5p-3': core counts must be whole chips
     num_chips = size // gen.cores_per_chip if gen.size_is_cores else size
     if num_chips < 1:
         return None

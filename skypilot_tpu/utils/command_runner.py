@@ -287,6 +287,12 @@ class LocalProcessRunner(CommandRunner):
         if isinstance(cmd, list):
             cmd = ' '.join(cmd)
         env = {**os.environ, **self._env, 'HOME': self.root_dir}
+        # The host's job queue lives under its own HOME; a client-side
+        # SKYTPU_JOB_DB override (tests) must not leak in. SKYTPU_HOME *is*
+        # inherited on purpose: it is how the emulated host reaches the
+        # local provisioner's state, standing in for cloud API access.
+        if 'SKYTPU_JOB_DB' not in self._env:
+            env.pop('SKYTPU_JOB_DB', None)
         return _run_local(cmd, shell=True, require_outputs=require_outputs,
                           log_path=log_path, stream_logs=stream_logs, env=env,
                           cwd=self.root_dir)

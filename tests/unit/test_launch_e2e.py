@@ -1,0 +1,211 @@
+"""End-to-end hermetic launch tests on the local provisioner.
+
+The milestone SURVEY.md §7.3 calls 'minimum end-to-end slice': launch() runs
+OPTIMIZE→PROVISION→SYNC→SETUP→EXEC against emulated slice hosts, including
+the n-host gang with rank env, log multiplexing, failure fan-in, queue/
+cancel/autostop/down. The reference can only cover this with real-cloud
+smoke tests (tests/test_smoke.py); here it is a unit test.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import status_lib
+from skypilot_tpu.backends import backend_utils
+
+
+def _wait_job(cluster: str, job_id: int, timeout: float = 60.0) -> str:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        statuses = sky.job_status(cluster, [job_id])
+        value = statuses.get(str(job_id))
+        if value in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP', 'FAILED_DRIVER',
+                     'CANCELLED'):
+            return value
+        time.sleep(0.5)
+    raise TimeoutError(f'Job {job_id} did not finish; last={statuses}')
+
+
+@pytest.fixture
+def local_infra():
+    global_user_state.set_enabled_clouds(['local'])
+    yield
+    for record in global_user_state.get_clusters():
+        try:
+            sky.down(record['name'])
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def test_launch_single_host(local_infra):
+    task = sky.Task(name='hello', run='echo "hello from $SKYTPU_HOST_RANK"')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id = sky.launch(task, cluster_name='t0', stream_logs=False,
+                        detach_run=True)
+    assert job_id == 1
+    assert _wait_job('t0', job_id) == 'SUCCEEDED'
+    record = global_user_state.get_cluster_from_name('t0')
+    assert record['status'] == status_lib.ClusterStatus.UP
+
+
+def test_launch_tpu_slice_gang(local_infra, tmp_path):
+    """4-host emulated v5e-16 slice: every rank runs with the TPU contract."""
+    out_marker = tmp_path / 'out'
+    out_marker.mkdir()
+    task = sky.Task(
+        name='gang',
+        run=(f'echo "rank=$SKYTPU_HOST_RANK hosts=$SKYTPU_NUM_HOSTS '
+             f'slice=$SKYTPU_SLICE_ID worker=$TPU_WORKER_ID '
+             f'coord=$SKYTPU_COORDINATOR_ADDRESS '
+             f'accel=$SKYTPU_ACCELERATOR_TYPE topo=$SKYTPU_TOPOLOGY" '
+             f'> {out_marker}/rank-$SKYTPU_HOST_RANK.txt'))
+    task.set_resources(
+        sky.Resources(cloud='local', accelerators='tpu-v5e-16'))
+    job_id = sky.launch(task, cluster_name='slice1', stream_logs=False,
+                        detach_run=True)
+    assert _wait_job('slice1', job_id) == 'SUCCEEDED'
+    ranks = sorted(os.listdir(out_marker))
+    assert ranks == ['rank-0.txt', 'rank-1.txt', 'rank-2.txt', 'rank-3.txt']
+    content = (out_marker / 'rank-2.txt').read_text()
+    assert 'rank=2 hosts=4' in content
+    assert 'worker=2' in content
+    assert 'accel=tpu-v5e-16 topo=4x4' in content
+    assert ':8476' in content
+    # Handle records the slice shape.
+    handle = global_user_state.get_cluster_from_name('slice1')['handle']
+    assert handle.num_hosts == 4
+
+
+def test_gang_failure_fan_in(local_infra):
+    """One rank failing fails the whole job (all-or-nothing slice)."""
+    task = sky.Task(
+        name='partial-fail',
+        run='if [ "$SKYTPU_HOST_RANK" = "1" ]; then exit 7; fi; sleep 0.2')
+    task.set_resources(
+        sky.Resources(cloud='local', accelerators='tpu-v5e-16'))
+    job_id = sky.launch(task, cluster_name='failgang', stream_logs=False,
+                        detach_run=True)
+    assert _wait_job('failgang', job_id) == 'FAILED'
+
+
+def test_setup_failure_raises(local_infra):
+    task = sky.Task(name='badsetup', setup='exit 3', run='echo hi')
+    task.set_resources(sky.Resources(cloud='local'))
+    with pytest.raises(exceptions.CommandError):
+        sky.launch(task, cluster_name='bad1', stream_logs=False,
+                   detach_run=True)
+
+
+def test_exec_reuses_cluster_and_queue(local_infra):
+    task = sky.Task(name='first', run='sleep 0.1 && echo one')
+    task.set_resources(sky.Resources(cloud='local'))
+    job1 = sky.launch(task, cluster_name='reuse1', stream_logs=False,
+                      detach_run=True)
+    task2 = sky.Task(name='second', run='echo two')
+    job2 = sky.exec(task2, cluster_name='reuse1', detach_run=True,
+                    stream_logs=False)
+    assert job2 == job1 + 1
+    assert _wait_job('reuse1', job2) == 'SUCCEEDED'
+    jobs = sky.queue('reuse1')
+    names = {j['job_name'] for j in jobs}
+    assert names == {'first', 'second'}
+
+
+def test_cancel_running_job(local_infra):
+    task = sky.Task(name='longrun', run='sleep 120')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id = sky.launch(task, cluster_name='cancel1', stream_logs=False,
+                        detach_run=True)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if sky.job_status('cancel1', [job_id])[str(job_id)] == 'RUNNING':
+            break
+        time.sleep(0.3)
+    cancelled = sky.cancel('cancel1', [job_id])
+    assert cancelled == [job_id]
+    assert sky.job_status('cancel1', [job_id])[str(job_id)] == 'CANCELLED'
+
+
+def test_workdir_and_file_mounts(local_infra, tmp_path):
+    workdir = tmp_path / 'wd'
+    workdir.mkdir()
+    (workdir / 'data.txt').write_text('payload')
+    extra = tmp_path / 'extra.txt'
+    extra.write_text('mounted')
+    out = tmp_path / 'result.txt'
+    task = sky.Task(
+        name='files',
+        workdir=str(workdir),
+        file_mounts={'/tmp/extra_mount.txt': str(extra)},
+        run=f'cat data.txt /tmp/extra_mount.txt > {out}')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id = sky.launch(task, cluster_name='files1', stream_logs=False,
+                        detach_run=True)
+    assert _wait_job('files1', job_id) == 'SUCCEEDED'
+    assert out.read_text() == 'paylo' 'admounted'
+
+
+def test_down_removes_cluster(local_infra):
+    task = sky.Task(name='x', run='echo x')
+    task.set_resources(sky.Resources(cloud='local'))
+    sky.launch(task, cluster_name='gone1', stream_logs=False,
+               detach_run=True)
+    sky.down('gone1')
+    assert global_user_state.get_cluster_from_name('gone1') is None
+    with pytest.raises(exceptions.ClusterDoesNotExist):
+        sky.queue('gone1')
+
+
+def test_stop_start_cycle(local_infra):
+    task = sky.Task(name='x', run='echo x')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id = sky.launch(task, cluster_name='cycle1', stream_logs=False,
+                        detach_run=True)
+    _wait_job('cycle1', job_id)
+    sky.stop('cycle1')
+    record = global_user_state.get_cluster_from_name('cycle1')
+    assert record['status'] == status_lib.ClusterStatus.STOPPED
+    with pytest.raises(exceptions.ClusterNotUpError):
+        sky.queue('cycle1')
+    sky.start('cycle1')
+    assert backend_utils.refresh_cluster_status(
+        'cycle1') == status_lib.ClusterStatus.UP
+    job2 = sky.exec(sky.Task(name='y', run='echo y').set_resources(
+        sky.Resources(cloud='local')), cluster_name='cycle1',
+        detach_run=True, stream_logs=False)
+    assert _wait_job('cycle1', job2) == 'SUCCEEDED'
+
+
+def test_provision_failover_to_next_candidate(local_infra, monkeypatch):
+    """Injected failure on first candidate falls over gracefully."""
+    monkeypatch.setenv('SKYTPU_LOCAL_PROVISION_FAIL', 'failme')
+    task = sky.Task(name='x', run='echo x')
+    task.set_resources(sky.Resources(cloud='local'))
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        sky.launch(task, cluster_name='failme-c', stream_logs=False,
+                   detach_run=True)
+    # A different name provisions fine.
+    job = sky.launch(task, cluster_name='okcluster', stream_logs=False,
+                     detach_run=True)
+    assert _wait_job('okcluster', job) == 'SUCCEEDED'
+
+
+def test_refresh_detects_missing_cluster(local_infra):
+    task = sky.Task(name='x', run='echo x')
+    task.set_resources(sky.Resources(cloud='local'))
+    sky.launch(task, cluster_name='vanish1', stream_logs=False,
+               detach_run=True)
+    # Simulate out-of-band deletion (cloud console): the VMs die with
+    # their processes, then all trace disappears.
+    import shutil
+    from skypilot_tpu.provision.local import instance as local_instance
+    local_instance._kill_host_processes('vanish1')  # pylint: disable=protected-access
+    shutil.rmtree(local_instance._cluster_dir('vanish1'))  # pylint: disable=protected-access
+    assert backend_utils.refresh_cluster_status('vanish1') is None
+    assert global_user_state.get_cluster_from_name('vanish1') is None

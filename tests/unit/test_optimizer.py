@@ -1,0 +1,103 @@
+"""Optimizer dryruns (parity: reference tests/test_optimizer_dryruns.py) —
+fully offline via the enable_all_infra fixture."""
+from __future__ import annotations
+
+import pytest
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+
+Optimizer = optimizer_lib.Optimizer
+OptimizeTarget = optimizer_lib.OptimizeTarget
+
+
+def _single_task_dag(task):
+    dag = dag_lib.Dag()
+    dag.add(task)
+    return dag
+
+
+def test_requires_enabled_clouds():
+    task = task_lib.Task(name='t')
+    with pytest.raises(exceptions.NoCloudAccessError):
+        Optimizer.optimize(_single_task_dag(task), quiet=True)
+
+
+def test_tpu_vs_gpu_fungibility(enable_all_infra):
+    """The north-star behavior: TPU and GPU candidates compete on cost."""
+    task = task_lib.Task(name='train')
+    task.set_resources({
+        resources_lib.Resources(cloud='gcp', accelerators='tpu-v5e-8'),
+        resources_lib.Resources(cloud='gcp', accelerators='A100:8'),
+    })
+    Optimizer.optimize(_single_task_dag(task), quiet=True)
+    best = task.best_resources
+    # v5e-8 is $9.6/hr vs $29.39/hr for A100:8.
+    assert best.tpu_spec is not None and best.tpu_spec.name == 'tpu-v5e-8'
+
+
+def test_time_target_uses_estimator(enable_all_infra):
+    task = task_lib.Task(name='train')
+    v5e = resources_lib.Resources(cloud='gcp', accelerators='tpu-v5e-8')
+    a100 = resources_lib.Resources(cloud='gcp', accelerators='A100:8')
+    task.set_resources({v5e, a100})
+    # User says the A100 is 10x faster for this workload.
+    task.set_time_estimator(
+        lambda r: 600.0 if r.accelerators and 'A100' in r.accelerators else 6000.0)
+    Optimizer.optimize(_single_task_dag(task), minimize=OptimizeTarget.TIME,
+                       quiet=True)
+    assert 'A100' in task.best_resources.accelerators
+
+
+def test_spot_cheaper_than_on_demand(enable_all_infra):
+    task = task_lib.Task(name='t')
+    task.set_resources({
+        resources_lib.Resources(cloud='gcp', accelerators='tpu-v5e-8'),
+        resources_lib.Resources(cloud='gcp', accelerators='tpu-v5e-8',
+                                capacity='spot'),
+    })
+    Optimizer.optimize(_single_task_dag(task), quiet=True)
+    assert task.best_resources.use_spot
+
+
+def test_blocked_resources_failover(enable_all_infra):
+    task = task_lib.Task(name='t')
+    spot = resources_lib.Resources(cloud='gcp', accelerators='tpu-v5e-8',
+                                   capacity='spot')
+    od = resources_lib.Resources(cloud='gcp', accelerators='tpu-v5e-8')
+    task.set_resources({spot, od})
+    launchables = Optimizer.enumerate_launchables(task)
+    cheapest = launchables[0][0]
+    assert cheapest.use_spot
+    Optimizer.optimize(_single_task_dag(task), blocked_resources=[cheapest],
+                       quiet=True)
+    assert not task.best_resources.use_spot
+
+
+def test_chain_dag_plan(enable_all_infra):
+    with dag_lib.Dag('pipe') as dag:
+        train = task_lib.Task(name='train')
+        train.set_resources(
+            resources_lib.Resources(cloud='gcp', accelerators='tpu-v5p-8'))
+        serve = task_lib.Task(name='serve')
+        serve.set_resources(
+            resources_lib.Resources(cloud='gcp', accelerators='tpu-v5e-8'))
+        train >> serve
+    Optimizer.optimize(dag, quiet=True)
+    assert train.best_resources.tpu_spec.generation == 'v5p'
+    assert serve.best_resources.tpu_spec.generation == 'v5e'
+    table = optimizer_lib.format_plan_table(
+        {t: (t.best_resources, 0.0) for t in dag.tasks},
+        OptimizeTarget.COST)
+    assert 'tpu-v5p-8' in table and 'tpu-v5e-8' in table
+
+
+def test_infeasible_raises_with_fuzzy_hint(enable_all_infra):
+    task = task_lib.Task(name='t')
+    task.set_resources(
+        resources_lib.Resources(cloud='gcp', accelerators='A100:5'))
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        Optimizer.optimize(_single_task_dag(task), quiet=True)

@@ -256,3 +256,13 @@ def test_resources_hash_eq_consistent():
     assert a == b
     assert hash(a) == hash(b)
     assert len({a, b}) == 1
+
+
+def test_invalid_tpu_and_count_strings():
+    assert ar.parse_tpu_name('tpu-v5p-3') is None  # partial chip
+    with pytest.raises(exceptions.InvalidTaskError):
+        resources_lib.Resources(cpus='4cores')
+    with pytest.raises(exceptions.InvalidTaskError):
+        resources_lib.Resources(memory='lots+')
+    assert resources_lib.Resources(cpus=4).cpus == '4'
+    assert resources_lib.Resources(memory='16+').memory == '16+'
