@@ -1,0 +1,92 @@
+"""Benchmark: flagship-model training throughput on the local accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no training-throughput numbers (BASELINE.md —
+`published: {}`), so vs_baseline is reported against the MFU-derived
+roofline expectation for the detected chip (1.0 == hitting 40% MFU,
+a typical well-tuned TPU training MFU).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _param_count(params) -> int:
+    import jax
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))
+
+
+def _peak_flops(device) -> float:
+    """Peak bf16 FLOP/s for known TPU generations (fallback: v5e)."""
+    kind = getattr(device, 'device_kind', '').lower()
+    table = {
+        'v2': 45e12, 'v3': 123e12, 'v4': 275e12,
+        'v5litepod': 197e12, 'v5e': 197e12, 'v5p': 459e12, 'v6e': 918e12,
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import configs
+    from skypilot_tpu.models.train import TrainConfig
+    from skypilot_tpu.models.train import create_train_state
+    from skypilot_tpu.models.train import train_step
+
+    dev = jax.devices()[0]
+    on_tpu = jax.default_backend() not in ('cpu',)
+    if on_tpu:
+        cfg = configs.get_config('small')
+        batch, seq = 16, 1024
+    else:  # CI / laptop fallback
+        cfg = configs.get_config('tiny')
+        batch, seq = 4, 128
+
+    state, _ = create_train_state(cfg, TrainConfig(), batch_size=batch,
+                                  seq_len=seq)
+    n_params = _param_count(state.params)
+
+    step = jax.jit(train_step, donate_argnums=(0,))
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(key, (batch, seq + 1), 0, cfg.vocab_size,
+                                dtype=jnp.int32)
+    batch_dict = {'tokens': tokens}
+
+    # Warmup (compile) + timed steps.
+    for _ in range(2):
+        state, metrics = step(state, batch_dict)
+    jax.block_until_ready(metrics['loss'])
+    n_steps = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, batch_dict)
+    jax.block_until_ready(metrics['loss'])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * n_steps / dt
+    # Training FLOPs/token ~= 6 * params; MFU vs chip roofline.
+    achieved_flops = 6.0 * n_params * tokens_per_sec
+    mfu = achieved_flops / _peak_flops(dev)
+    vs_baseline = mfu / 0.40  # 1.0 == 40% MFU (well-tuned TPU training)
+
+    print(json.dumps({
+        'metric': 'llama_train_tokens_per_sec_per_chip',
+        'value': round(tokens_per_sec, 1),
+        'unit': 'tokens/s',
+        'vs_baseline': round(vs_baseline, 3),
+    }))
+    print(f'# device={dev.device_kind} model={cfg.d_model}x{cfg.n_layers} '
+          f'params={n_params/1e6:.1f}M mfu={mfu:.3f} '
+          f'loss={float(metrics["loss"]):.3f}', file=sys.stderr)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,12 @@
+"""Storage subsystem: bucket-backed data and checkpoints.
+
+Parity: /root/reference/sky/data/ (storage.py, mounting_utils.py,
+storage_utils.py) — GCS-first (TPU jobs live next to GCS), with the
+checkpoint-dir auto-resume contract the reference leaves to user code
+(SURVEY.md §5 checkpoint/resume) made first-class.
+"""
+from skypilot_tpu.data.storage import Storage
+from skypilot_tpu.data.storage import StorageMode
+from skypilot_tpu.data.storage import StoreType
+
+__all__ = ['Storage', 'StorageMode', 'StoreType']

@@ -1,0 +1,79 @@
+"""First-class checkpoint/resume contract.
+
+The reference leaves checkpointing entirely to user code (SURVEY.md §5:
+"not in the framework" — users mount a bucket and hand-roll resume).
+Here it is a framework contract:
+
+- Managed jobs (and `launch --checkpoint-bucket`) auto-create a bucket
+  mount at CHECKPOINT_PATH and export SKYTPU_CHECKPOINT_DIR
+  (skylet/constants.py:42) keyed by task id.
+- User code calls `checkpoint_manager()` to get an orbax
+  CheckpointManager rooted there, and `latest_step()` /
+  `restore_or_init()` for the resume-on-recovery convention.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.skylet import constants
+
+logger = sky_logging.init_logger(__name__)
+
+# Where the checkpoint bucket is mounted on cluster hosts.
+CHECKPOINT_PATH = '/checkpoint'
+
+
+def default_bucket_name(user_hash: str) -> str:
+    return f'skytpu-checkpoints-{user_hash}'
+
+
+def checkpoint_dir() -> Optional[str]:
+    """The directory user code should checkpoint into (None when the
+    task was launched without the checkpoint contract)."""
+    return os.environ.get(constants.ENV_CHECKPOINT_DIR)
+
+
+def checkpoint_manager(directory: Optional[str] = None,
+                       *,
+                       max_to_keep: int = 3,
+                       save_interval_steps: int = 1) -> Any:
+    """An orbax CheckpointManager rooted at the task's checkpoint dir."""
+    import orbax.checkpoint as ocp  # pylint: disable=import-outside-toplevel
+    directory = directory or checkpoint_dir()
+    if directory is None:
+        raise RuntimeError(
+            'No checkpoint dir: set SKYTPU_CHECKPOINT_DIR or pass '
+            'directory=.')
+    options = ocp.CheckpointManagerOptions(
+        max_to_keep=max_to_keep,
+        save_interval_steps=save_interval_steps,
+        create=True)
+    return ocp.CheckpointManager(directory, options=options)
+
+
+def latest_step(directory: Optional[str] = None) -> Optional[int]:
+    """Latest saved step in the checkpoint dir, or None."""
+    import orbax.checkpoint as ocp  # pylint: disable=import-outside-toplevel
+    directory = directory or checkpoint_dir()
+    if directory is None or not os.path.isdir(str(directory)):
+        return None
+    mgr = ocp.CheckpointManager(directory)
+    return mgr.latest_step()
+
+
+def restore_or_init(mgr: Any, state: Any) -> tuple:
+    """(state, start_step): restore latest checkpoint if one exists.
+
+    The auto-resume convention managed jobs rely on after preemption
+    recovery: relaunched tasks call this and continue from where the
+    evicted run left off.
+    """
+    import orbax.checkpoint as ocp  # pylint: disable=import-outside-toplevel
+    step = mgr.latest_step()
+    if step is None:
+        return state, 0
+    restored = mgr.restore(step, args=ocp.args.StandardRestore(state))
+    logger.info(f'Restored checkpoint at step {step}')
+    return restored, step + 1

@@ -1,0 +1,61 @@
+"""FUSE mount / batch-sync command generation.
+
+Parity: /root/reference/sky/data/mounting_utils.py (install + mount
+command strings executed on cluster hosts).  GCS-first: gcsfuse is the
+primary mounter (TPU-VM images ship it); s3 via goofys kept for
+cross-cloud data.
+"""
+from __future__ import annotations
+
+import shlex
+import textwrap
+
+GCSFUSE_VERSION = '2.4.0'
+_MOUNT_BINARY_DIR = '/usr/local/bin'
+
+# Stat/type/negative caches sized for training workloads (many many
+# small reads of the same shards); parity with the reference's tuned
+# flags (mounting_utils.py:83-94) but gcsfuse-2.x option names.
+GCSFUSE_FLAGS = ('--implicit-dirs '
+                 '--stat-cache-capacity 4096 '
+                 '--stat-cache-ttl 5s --type-cache-ttl 5s '
+                 '--rename-dir-limit 10000')
+
+
+def get_gcsfuse_install_cmd() -> str:
+    """Idempotent gcsfuse install (TPU-VM images usually have it)."""
+    return textwrap.dedent(f"""\
+        which gcsfuse >/dev/null 2>&1 || {{
+          ARCH=$(uname -m | sed 's/aarch64/arm64/;s/x86_64/amd64/');
+          curl -fsSL -o /tmp/gcsfuse.deb \
+            https://github.com/GoogleCloudPlatform/gcsfuse/releases/download/v{GCSFUSE_VERSION}/gcsfuse_{GCSFUSE_VERSION}_$ARCH.deb && \
+          sudo dpkg -i /tmp/gcsfuse.deb || sudo apt-get install -f -y; }}""")
+
+
+def get_mount_cmd(bucket_name: str, mount_path: str,
+                  readonly: bool = False, only_dir: str = '') -> str:
+    """Mount a GCS bucket (optionally one sub-directory) at mount_path
+    (idempotent)."""
+    ro_flag = '-o ro ' if readonly else ''
+    dir_flag = f'--only-dir {shlex.quote(only_dir)} ' if only_dir else ''
+    q = shlex.quote
+    return (f'sudo mkdir -p {q(mount_path)} && '
+            f'sudo chmod 777 {q(mount_path)} && '
+            f'{{ mountpoint -q {q(mount_path)} || '
+            f'gcsfuse {GCSFUSE_FLAGS} {ro_flag}{dir_flag}'
+            f'{q(bucket_name)} {q(mount_path)}; }}')
+
+
+def get_unmount_cmd(mount_path: str) -> str:
+    q = shlex.quote
+    return (f'mountpoint -q {q(mount_path)} && '
+            f'fusermount -u {q(mount_path)} || true')
+
+
+def get_copy_down_cmd(bucket_url: str, dst_path: str) -> str:
+    """COPY mode: materialize bucket contents onto local disk."""
+    q = shlex.quote
+    return (f'mkdir -p {q(dst_path)} && '
+            f'(gcloud storage rsync -r {q(bucket_url)} {q(dst_path)} '
+            f'2>/dev/null || gsutil -m rsync -r {q(bucket_url)} '
+            f'{q(dst_path)})')

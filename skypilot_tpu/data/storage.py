@@ -1,0 +1,367 @@
+"""Bucket-backed Storage objects (MOUNT / COPY modes).
+
+Parity: /root/reference/sky/data/storage.py:109,192,384 (StoreType,
+StorageMode, Storage) and the per-store create/upload/delete/
+mount_command surface (S3Store/GcsStore :1080+).  TPU-first: GCS is the
+primary store (colocated with TPU zones; gcsfuse on TPU-VM images), S3
+is the cross-cloud secondary.  Transfers go through the cloud CLIs
+(`gcloud storage` / `gsutil` / `aws s3`) exactly like the reference's
+batch sync path (storage.py:1267) — no SDK dependency on the hot path.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import re
+import shlex
+import subprocess
+import urllib.parse
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu import status_lib
+from skypilot_tpu.data import mounting_utils
+from skypilot_tpu.data import storage_utils
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+_BUCKET_NAME_RE = re.compile(r'^[a-z0-9][a-z0-9._-]{1,61}[a-z0-9]$')
+
+
+class StoreType(enum.Enum):
+    GCS = 'GCS'
+    S3 = 'S3'
+
+    @classmethod
+    def from_url(cls, url: str) -> 'StoreType':
+        scheme = urllib.parse.urlsplit(url).scheme
+        if scheme == 'gs':
+            return cls.GCS
+        if scheme == 's3':
+            return cls.S3
+        raise ValueError(f'Unknown store URL scheme: {url!r}')
+
+
+class StorageMode(enum.Enum):
+    MOUNT = 'MOUNT'
+    COPY = 'COPY'
+
+
+def _run(cmd: List[str], **kw) -> subprocess.CompletedProcess:
+    logger.debug(f'storage: $ {" ".join(cmd)}')
+    return subprocess.run(cmd, capture_output=True, text=True, check=False,
+                          **kw)
+
+
+class AbstractStore:
+    """One bucket (optionally a sub-path prefix) in one object store."""
+
+    store_type: StoreType
+
+    def __init__(self, name: str, source: Optional[str] = None,
+                 prefix: str = ''):
+        if not _BUCKET_NAME_RE.match(name):
+            raise exceptions.StorageNameError(
+                f'Invalid bucket name {name!r} (3-63 chars, lowercase '
+                'alphanumeric, ., -, _)')
+        self.name = name
+        self.source = source
+        self.prefix = prefix.strip('/')
+
+    @property
+    def url(self) -> str:
+        raise NotImplementedError
+
+    def exists(self) -> bool:
+        raise NotImplementedError
+
+    def create(self) -> None:
+        raise NotImplementedError
+
+    def upload(self, source: str) -> None:
+        raise NotImplementedError
+
+    def delete(self) -> None:
+        raise NotImplementedError
+
+    def mount_command(self, mount_path: str) -> str:
+        raise NotImplementedError
+
+    def copy_down_command(self, dst_path: str) -> str:
+        return mounting_utils.get_copy_down_cmd(self.url, dst_path)
+
+
+class GcsStore(AbstractStore):
+    """GCS bucket driven by gcloud storage / gsutil CLIs."""
+
+    store_type = StoreType.GCS
+
+    def __init__(self, name: str, source: Optional[str] = None,
+                 prefix: str = '', region: str = 'us-central2'):
+        super().__init__(name, source, prefix)
+        self.region = region
+
+    @property
+    def url(self) -> str:
+        if self.prefix:
+            return f'gs://{self.name}/{self.prefix}'
+        return f'gs://{self.name}'
+
+    def exists(self) -> bool:
+        return _run(['gsutil', 'ls', '-b',
+                     f'gs://{self.name}']).returncode == 0
+
+    def create(self) -> None:
+        if self.exists():
+            return
+        res = _run(['gsutil', 'mb', '-l', self.region, f'gs://{self.name}'])
+        if res.returncode != 0:
+            raise exceptions.StorageBucketCreateError(
+                f'Failed to create {self.url}: {res.stderr.strip()}')
+        logger.info(f'Created GCS bucket {self.url} in {self.region}')
+
+    def upload(self, source: str) -> None:
+        source = os.path.expanduser(source)
+        if os.path.isdir(source):
+            cmd = ['gsutil', '-m', 'rsync', '-r']
+            excluded = storage_utils.get_excluded_files(source)
+            if excluded:
+                # gsutil honors only ONE -x: a single alternation regex
+                # (parity: reference storage.py:1771).
+                cmd += ['-x', '|'.join(
+                    re.escape(rel.rstrip('/')) + r'($|/.*)'
+                    for rel in excluded)]
+            cmd += [source, self.url]
+        else:
+            cmd = ['gsutil', 'cp', source, self.url]
+        res = _run(cmd)
+        if res.returncode != 0:
+            raise exceptions.StorageUploadError(
+                f'Upload {source} -> {self.url} failed: '
+                f'{res.stderr.strip()}')
+
+    def delete(self) -> None:
+        res = _run(['gsutil', '-m', 'rm', '-r', self.url])
+        if res.returncode != 0 and 'BucketNotFound' not in res.stderr:
+            raise exceptions.StorageBucketDeleteError(
+                f'Failed to delete {self.url}: {res.stderr.strip()}')
+
+    def mount_command(self, mount_path: str) -> str:
+        return (mounting_utils.get_gcsfuse_install_cmd() + ' && ' +
+                mounting_utils.get_mount_cmd(self.name, mount_path,
+                                             only_dir=self.prefix))
+
+
+class S3Store(AbstractStore):
+    """S3 bucket driven by the aws CLI (cross-cloud data residency)."""
+
+    store_type = StoreType.S3
+
+    def __init__(self, name: str, source: Optional[str] = None,
+                 prefix: str = '', region: str = 'us-east-1'):
+        super().__init__(name, source, prefix)
+        self.region = region
+
+    @property
+    def url(self) -> str:
+        if self.prefix:
+            return f's3://{self.name}/{self.prefix}'
+        return f's3://{self.name}'
+
+    def exists(self) -> bool:
+        return _run(['aws', 's3api', 'head-bucket', '--bucket',
+                     self.name]).returncode == 0
+
+    def create(self) -> None:
+        if self.exists():
+            return
+        cmd = ['aws', 's3api', 'create-bucket', '--bucket', self.name]
+        if self.region != 'us-east-1':
+            cmd += ['--create-bucket-configuration',
+                    f'LocationConstraint={self.region}']
+        res = _run(cmd)
+        if res.returncode != 0:
+            raise exceptions.StorageBucketCreateError(
+                f'Failed to create {self.url}: {res.stderr.strip()}')
+
+    def upload(self, source: str) -> None:
+        source = os.path.expanduser(source)
+        if os.path.isdir(source):
+            cmd = ['aws', 's3', 'sync', source, self.url]
+            for rel in storage_utils.get_excluded_files(source):
+                rel = rel.rstrip('/')
+                # Exclude both the entry and (for directories) its
+                # contents — 'aws s3 sync --exclude dir' alone matches
+                # nothing inside dir.
+                cmd += ['--exclude', rel, '--exclude', f'{rel}/*']
+        else:
+            cmd = ['aws', 's3', 'cp', source, self.url]
+        res = _run(cmd)
+        if res.returncode != 0:
+            raise exceptions.StorageUploadError(
+                f'Upload {source} -> {self.url} failed: '
+                f'{res.stderr.strip()}')
+
+    def delete(self) -> None:
+        res = _run(['aws', 's3', 'rb', self.url, '--force'])
+        if res.returncode != 0 and 'NoSuchBucket' not in res.stderr:
+            raise exceptions.StorageBucketDeleteError(
+                f'Failed to delete {self.url}: {res.stderr.strip()}')
+
+    def mount_command(self, mount_path: str) -> str:
+        q = shlex.quote
+        # goofys for S3 (parity: reference mounting_utils.py goofys path).
+        return (f'which goofys >/dev/null 2>&1 || {{ sudo curl -fsSL -o '
+                f'{q("/usr/local/bin/goofys")} '
+                'https://github.com/kahing/goofys/releases/latest/download/goofys'
+                ' && sudo chmod +x /usr/local/bin/goofys; }; '
+                f'sudo mkdir -p {q(mount_path)} && '
+                f'sudo chmod 777 {q(mount_path)} && '
+                f'{{ mountpoint -q {q(mount_path)} || '
+                f'goofys {q(self.name + (":" + self.prefix if self.prefix else ""))} '
+                f'{q(mount_path)}; }}')
+
+    def copy_down_command(self, dst_path: str) -> str:
+        q = shlex.quote
+        return (f'mkdir -p {q(dst_path)} && '
+                f'aws s3 sync {q(self.url)} {q(dst_path)}')
+
+
+_STORE_CLASSES = {StoreType.GCS: GcsStore, StoreType.S3: S3Store}
+
+
+class Storage:
+    """A named storage object, backed by one or more stores.
+
+    Parity: reference storage.py:384.  YAML surface:
+      name: my-bucket
+      source: ./data | gs://bucket | s3://bucket
+      store: gcs | s3
+      mode: MOUNT | COPY
+      persistent: true
+    """
+
+    def __init__(self,
+                 name: Optional[str] = None,
+                 source: Optional[str] = None,
+                 stores: Optional[Dict[StoreType, AbstractStore]] = None,
+                 persistent: bool = True,
+                 mode: StorageMode = StorageMode.MOUNT):
+        self.source = source
+        self.persistent = persistent
+        self.mode = mode
+        self.stores: Dict[StoreType, AbstractStore] = stores or {}
+
+        self._source_prefix = ''
+        if source and not _is_local(source):
+            split = urllib.parse.urlsplit(source)
+            self._source_prefix = split.path.strip('/')
+            if name is None:
+                name = split.netloc
+        if name is None:
+            raise exceptions.StorageSpecError(
+                'Storage requires a name (or a bucket-URL source).')
+        self.name = name
+
+        if source and not _is_local(source):
+            stype = StoreType.from_url(source)
+            if stype not in self.stores:
+                self.stores[stype] = _STORE_CLASSES[stype](
+                    self.name, source, prefix=self._source_prefix)
+        elif source:
+            expanded = os.path.expanduser(source)
+            if not os.path.exists(expanded):
+                raise exceptions.StorageSourceError(
+                    f'Local source {source!r} does not exist.')
+
+    # ------------------------------------------------------------- stores
+
+    def add_store(self, store_type: StoreType,
+                  region: Optional[str] = None) -> AbstractStore:
+        if store_type in self.stores:
+            return self.stores[store_type]
+        kwargs = {'region': region} if region else {}
+        store = _STORE_CLASSES[store_type](self.name, self.source,
+                                           prefix=self._source_prefix,
+                                           **kwargs)
+        store.create()
+        if self.source and _is_local(self.source):
+            store.upload(self.source)
+        self.stores[store_type] = store
+        global_user_state.add_or_update_storage(
+            self.name, self.handle(), status_lib.StorageStatus.READY)
+        return store
+
+    def get_default_store(self) -> AbstractStore:
+        if not self.stores:
+            return self.add_store(StoreType.GCS)
+        if StoreType.GCS in self.stores:
+            return self.stores[StoreType.GCS]
+        return next(iter(self.stores.values()))
+
+    def delete(self, store_type: Optional[StoreType] = None) -> None:
+        targets = ([store_type] if store_type is not None
+                   else list(self.stores))
+        for stype in targets:
+            if stype not in self.stores:
+                raise exceptions.StorageError(
+                    f'Storage {self.name!r} has no {stype.value} store '
+                    f'(attached: {[t.value for t in self.stores]})')
+            self.stores.pop(stype).delete()
+        if not self.stores:
+            global_user_state.remove_storage(self.name)
+
+    def handle(self) -> Dict[str, Any]:
+        return {
+            'name': self.name,
+            'source': self.source,
+            'mode': self.mode.value,
+            'persistent': self.persistent,
+            'store_types': [t.value for t in self.stores],
+        }
+
+    # --------------------------------------------------------------- yaml
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Storage':
+        config = dict(config)
+        common_utils.validate_schema_keys(
+            config, {'name', 'source', 'store', 'mode', 'persistent'},
+            'storage')
+        mode = StorageMode(config.get('mode', 'MOUNT').upper())
+        storage = cls(name=config.get('name'),
+                      source=config.get('source'),
+                      persistent=config.get('persistent', True),
+                      mode=mode)
+        store = config.get('store')
+        if store is not None:
+            stype = StoreType(store.upper())
+            if stype not in storage.stores:
+                storage.stores[stype] = _STORE_CLASSES[stype](
+                    storage.name, storage.source,
+                    prefix=storage._source_prefix)  # pylint: disable=protected-access
+        return storage
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {'name': self.name}
+        if self.source is not None:
+            config['source'] = self.source
+        if self.stores:
+            config['store'] = next(iter(self.stores)).value.lower()
+        if not self.persistent:
+            config['persistent'] = False
+        if self.mode is not StorageMode.MOUNT:
+            config['mode'] = self.mode.value
+        return config
+
+    def __repr__(self) -> str:
+        return (f'Storage(name={self.name!r}, source={self.source!r}, '
+                f'mode={self.mode.value}, '
+                f'stores={[t.value for t in self.stores]})')
+
+
+def _is_local(source: str) -> bool:
+    return urllib.parse.urlsplit(source).scheme == ''

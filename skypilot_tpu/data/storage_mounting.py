@@ -1,0 +1,46 @@
+"""Execute storage mounts on every host of a slice-cluster.
+
+Parity: /root/reference/sky/backends/cloud_vm_ray_backend.py:4543
+(_execute_storage_mounts) — but fanned out over all TPU-VM workers in
+parallel (every worker needs the data, not just the head).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.data import storage as storage_lib
+from skypilot_tpu.utils import subprocess_utils
+
+logger = sky_logging.init_logger(__name__)
+
+
+def execute_storage_mounts(handle: Any,
+                           storage_mounts: Dict[str, Any]) -> None:
+    """Mount (or copy down) each Storage at its mount path on all hosts."""
+    if not storage_mounts:
+        return
+    runners = handle.get_command_runners()
+    for mount_path, storage in storage_mounts.items():
+        if isinstance(storage, dict):
+            storage = storage_lib.Storage.from_yaml_config(storage)
+        store = storage.get_default_store()
+        if storage.mode is storage_lib.StorageMode.MOUNT:
+            cmd = store.mount_command(mount_path)
+            action = 'Mounting'
+        else:
+            cmd = store.copy_down_command(mount_path)
+            action = 'Copying'
+        logger.info(f'{action} {store.url} at {mount_path} on '
+                    f'{len(runners)} host(s)')
+
+        def _do(runner, cmd=cmd, mount_path=mount_path):
+            rc, _, stderr = runner.run(cmd, stream_logs=False,
+                                       require_outputs=True)
+            if rc != 0:
+                raise exceptions.CommandError(
+                    rc, cmd, f'Failed to set up storage at {mount_path} '
+                    f'on {runner.node_id}: {stderr[-500:]}')
+
+        subprocess_utils.run_in_parallel(_do, runners)

@@ -1,0 +1,62 @@
+"""Upload exclusion lists (.skyignore / .gitignore).
+
+Parity: /root/reference/sky/data/storage_utils.py
+(get_excluded_files_from_skyignore / from_gitignore).
+"""
+from __future__ import annotations
+
+import fnmatch
+import os
+import subprocess
+from typing import List
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+SKYIGNORE_FILE = '.skyignore'
+GITIGNORE_FILE = '.gitignore'
+
+
+def get_excluded_files_from_skyignore(src_dir: str) -> List[str]:
+    """Relative paths under src_dir matching .skyignore patterns."""
+    excluded: List[str] = []
+    skyignore = os.path.join(src_dir, SKYIGNORE_FILE)
+    if not os.path.isfile(skyignore):
+        return excluded
+    with open(skyignore, encoding='utf-8') as f:
+        patterns = [ln.strip() for ln in f
+                    if ln.strip() and not ln.strip().startswith('#')]
+    for root, dirs, files in os.walk(src_dir):
+        rel_root = os.path.relpath(root, src_dir)
+        for name in dirs + files:
+            rel = os.path.normpath(os.path.join(rel_root, name))
+            for pat in patterns:
+                pat = pat.lstrip('/')
+                if (fnmatch.fnmatch(rel, pat) or
+                        fnmatch.fnmatch(os.path.basename(rel), pat)):
+                    excluded.append(rel)
+                    break
+    return excluded
+
+
+def get_excluded_files_from_gitignore(src_dir: str) -> List[str]:
+    """Use git itself to enumerate ignored files (exact semantics)."""
+    if not os.path.isdir(os.path.join(src_dir, '.git')):
+        return []
+    try:
+        out = subprocess.run(
+            ['git', 'ls-files', '--ignored', '--others',
+             '--exclude-standard'],
+            cwd=src_dir, capture_output=True, text=True, check=False,
+            timeout=30)
+        return [ln for ln in out.stdout.splitlines() if ln]
+    except (subprocess.SubprocessError, OSError) as e:
+        logger.debug(f'gitignore enumeration failed: {e}')
+        return []
+
+
+def get_excluded_files(src_dir: str) -> List[str]:
+    if os.path.isfile(os.path.join(src_dir, SKYIGNORE_FILE)):
+        return get_excluded_files_from_skyignore(src_dir)
+    return get_excluded_files_from_gitignore(src_dir)

@@ -127,6 +127,18 @@ class StorageUploadError(StorageError):
     pass
 
 
+class StorageBucketDeleteError(StorageError):
+    pass
+
+
+class StorageNameError(StorageError, ValueError):
+    pass
+
+
+class StorageSourceError(StorageError, ValueError):
+    pass
+
+
 class FetchClusterInfoError(SkyTpuError):
     """Failed to query live instance info from the cloud."""
 

@@ -1,0 +1,17 @@
+"""Model family: Llama-style decoder transformers, TPU-first.
+
+The reference ships no model code (models are user payloads, e.g.
+/root/reference/llm/llama-3_1-finetuning); this framework makes the
+flagship finetune path first-class so `launch`/`jobs`/`serve` have a
+native workload: flax modules with logical sharding annotations, a
+pjit-able train step, and orbax checkpointing wired to the framework's
+checkpoint-dir contract.
+"""
+from skypilot_tpu.models.configs import ModelConfig
+from skypilot_tpu.models.transformer import Transformer
+from skypilot_tpu.models.train import TrainConfig
+from skypilot_tpu.models.train import create_train_state
+from skypilot_tpu.models.train import train_step
+
+__all__ = ['ModelConfig', 'TrainConfig', 'Transformer',
+           'create_train_state', 'train_step']

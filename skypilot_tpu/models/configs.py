@@ -1,0 +1,57 @@
+"""Model configurations (flagship: Llama-3-8B, per BASELINE.json)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 128256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16   # activations/compute
+    param_dtype: jnp.dtype = jnp.float32
+    remat: bool = True                # jax.checkpoint each layer
+    scan_layers: bool = True          # lax.scan over layers (fast compile)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def replace(self, **kw) -> 'ModelConfig':
+        return dataclasses.replace(self, **kw)
+
+
+LLAMA3_8B = ModelConfig()
+LLAMA3_70B = ModelConfig(d_model=8192, n_layers=80, n_heads=64,
+                         n_kv_heads=8, d_ff=28672)
+# Small config for single-chip benches; tiny for CPU tests.
+SMALL = ModelConfig(vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+                    n_kv_heads=8, d_ff=4096, max_seq_len=2048)
+TINY = ModelConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                   n_kv_heads=2, d_ff=128, max_seq_len=128,
+                   dtype=jnp.float32, remat=False)
+
+PRESETS = {
+    'llama3-8b': LLAMA3_8B,
+    'llama3-70b': LLAMA3_70B,
+    'small': SMALL,
+    'tiny': TINY,
+}
+
+
+def get_config(name: str, **overrides) -> ModelConfig:
+    if name not in PRESETS:
+        raise ValueError(f'Unknown model preset {name!r}; '
+                         f'have {sorted(PRESETS)}')
+    cfg = PRESETS[name]
+    return cfg.replace(**overrides) if overrides else cfg

@@ -1,0 +1,123 @@
+"""pjit-able training step for the model family.
+
+Everything is sharding-annotated, jit-compiled once, and static-shaped:
+params are placed by the logical-axis rules (parallel/sharding.py), the
+batch rides ('data','fsdp'), and the optimizer is optax adamw.  This is
+the "JAX-native job contract" end of the framework (SURVEY.md §7 build
+plan item (c)) — what managed jobs checkpoint/resume and `bench`
+measures.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+
+from skypilot_tpu.models.configs import ModelConfig
+from skypilot_tpu.models.transformer import Transformer
+from skypilot_tpu.parallel.sharding import LOGICAL_AXIS_RULES
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.1
+    b1: float = 0.9
+    b2: float = 0.95
+    grad_clip: float = 1.0
+
+
+class TrainState(train_state.TrainState):
+    pass
+
+
+def make_optimizer(tcfg: TrainConfig) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(tcfg.grad_clip),
+        optax.adamw(tcfg.learning_rate, b1=tcfg.b1, b2=tcfg.b2,
+                    weight_decay=tcfg.weight_decay),
+    )
+
+
+def loss_fn(logits, targets, mask=None):
+    """Next-token cross entropy. logits [b,s,V]; targets [b,s]."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        return -jnp.mean(ll)
+    return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+
+def create_train_state(cfg: ModelConfig,
+                       tcfg: Optional[TrainConfig] = None,
+                       *,
+                       mesh=None,
+                       rng=None,
+                       batch_size: int = 8,
+                       seq_len: Optional[int] = None) -> Tuple[Any, Any]:
+    """Returns (state, state_shardings); params initialized on-mesh.
+
+    With a mesh, init runs under jit with NamedSharding outputs so the
+    8B flagship never materialises unsharded on one device.
+    """
+    tcfg = tcfg or TrainConfig()
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    seq_len = seq_len or min(cfg.max_seq_len, 2048)
+    model = Transformer(cfg, mesh)
+    tokens = jnp.zeros((batch_size, seq_len), jnp.int32)
+    tx = make_optimizer(tcfg)
+
+    def init_fn(rng):
+        params = model.init(rng, tokens)['params']
+        return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+    if mesh is None:
+        return init_fn(rng), None
+
+    with mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
+        abstract = jax.eval_shape(init_fn, rng)
+        specs = nn.get_partition_spec(abstract)
+        shardings = nn.logical_to_mesh_sharding(specs, mesh,
+                                                LOGICAL_AXIS_RULES)
+        state = jax.jit(init_fn, out_shardings=shardings)(rng)
+    return state, shardings
+
+
+def train_step(state: TrainState, batch, *, mesh=None):
+    """One optimizer step. batch = {'tokens': [b,s+1] int32} or
+    {'inputs','targets'}.  Call under jit (see jit_train_step)."""
+    if 'tokens' in batch:
+        inputs = batch['tokens'][:, :-1]
+        targets = batch['tokens'][:, 1:]
+    else:
+        inputs, targets = batch['inputs'], batch['targets']
+
+    def compute_loss(params):
+        logits = state.apply_fn({'params': params}, inputs)
+        return loss_fn(logits, targets, batch.get('mask'))
+
+    loss, grads = jax.value_and_grad(compute_loss)(state.params)
+    new_state = state.apply_gradients(grads=grads)
+    metrics = {'loss': loss,
+               'grad_norm': optax.global_norm(grads)}
+    return new_state, metrics
+
+
+def jit_train_step(mesh, state_shardings, batch_sharding):
+    """jit train_step with explicit in/out shardings for the mesh."""
+
+    def _step(state, batch):
+        with nn.logical_axis_rules(LOGICAL_AXIS_RULES):
+            return train_step(state, batch, mesh=mesh)
+
+    return jax.jit(
+        _step,
+        in_shardings=(state_shardings, batch_sharding),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,),
+    )

@@ -1,0 +1,196 @@
+"""Llama-style decoder-only transformer (flax.linen), TPU-first.
+
+- GQA attention through ops.flash_attention (Pallas on TPU) or
+  ops.ring_attention when the mesh has a non-trivial 'sequence' axis
+  (long-context; SURVEY.md §5).
+- All parameters carry logical axis names via nn.with_logical_partitioning
+  so parallel/sharding.py rules place them on the [dcn, ici] mesh; GSPMD
+  inserts the collectives.
+- Layers run under nn.scan + nn.remat: one compiled layer body,
+  rematerialised activations (HBM-friendly).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models.configs import ModelConfig
+from skypilot_tpu.ops import flash_attention
+from skypilot_tpu.ops import ring_attention
+
+
+def _rope(x, positions, theta: float):
+    """Rotary embeddings on [b, h, s, d] with positions [s]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
+    cos = jnp.cos(angles)[None, None]  # [1,1,s,d/2]
+    sin = jnp.sin(angles)[None, None]
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    y1 = x1 * cos - x2 * sin
+    y2 = x1 * sin + x2 * cos
+    return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            'scale', nn.with_logical_partitioning(nn.initializers.ones,
+                                                  ('embed',)),
+            (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (normed * scale).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    config: ModelConfig
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        b, s, _ = x.shape
+        hd = cfg.head_dim
+
+        def proj(name, heads, logical):
+            return nn.DenseGeneral(
+                features=(heads, hd), axis=-1, use_bias=False,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), logical),
+                name=name)
+
+        q = proj('q_proj', cfg.n_heads, ('embed', 'heads', 'head_dim'))(x)
+        k = proj('k_proj', cfg.n_kv_heads, ('embed', 'kv_heads', 'head_dim'))(x)
+        v = proj('v_proj', cfg.n_kv_heads, ('embed', 'kv_heads', 'head_dim'))(x)
+
+        # [b, s, h, d] -> [b, h, s, d]
+        q = q.transpose(0, 2, 1, 3)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+        # GQA: repeat kv heads up to n_heads (XLA fuses the broadcast).
+        rep = cfg.n_heads // cfg.n_kv_heads
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+
+        seq_parallel = (self.mesh is not None and
+                        'sequence' in self.mesh.axis_names and
+                        self.mesh.shape['sequence'] > 1)
+        if seq_parallel:
+            out = ring_attention(q, k, v, mesh=self.mesh, causal=True)
+        else:
+            out = flash_attention(q, k, v, causal=True)
+
+        out = out.transpose(0, 2, 1, 3)  # [b, s, h, d]
+        return nn.DenseGeneral(
+            features=cfg.d_model, axis=(-2, -1), use_bias=False,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(),
+                ('heads', 'head_dim', 'embed')),
+            name='o_proj')(out)
+
+
+class MLP(nn.Module):
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+
+        def dense(name, feats, logical):
+            return nn.DenseGeneral(
+                features=feats, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), logical),
+                name=name)
+
+        gate = dense('gate_proj', cfg.d_ff, ('embed', 'mlp'))(x)
+        up = dense('up_proj', cfg.d_ff, ('embed', 'mlp'))(x)
+        return dense('down_proj', cfg.d_model, ('mlp', 'embed'))(
+            nn.silu(gate) * up)
+
+
+class DecoderLayer(nn.Module):
+    config: ModelConfig
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        x = x + Attention(cfg, self.mesh, name='attn')(
+            RMSNorm(cfg.norm_eps, name='attn_norm')(x), positions)
+        x = x + MLP(cfg, name='mlp')(
+            RMSNorm(cfg.norm_eps, name='mlp_norm')(x))
+        return x
+
+
+class _ScannedLayer(nn.Module):
+    """DecoderLayer with the (carry, out) signature nn.scan expects."""
+    config: ModelConfig
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        return DecoderLayer(self.config, self.mesh, name='layer')(
+            x, positions), None
+
+
+class Transformer(nn.Module):
+    config: ModelConfig
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        _, s = tokens.shape
+        positions = jnp.arange(s)
+
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ('vocab', 'embed')),
+            name='embed')
+        x = embed(tokens)
+        x = nn.with_logical_constraint(x, ('batch', 'seq', 'embed'))
+
+        if cfg.scan_layers:
+            scan_target = _ScannedLayer
+            if cfg.remat:
+                scan_target = nn.remat(scan_target, prevent_cse=False)
+            x, _ = nn.scan(
+                scan_target,
+                variable_axes={'params': 0},
+                split_rngs={'params': True},
+                in_axes=nn.broadcast,
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: 'layers'},
+            )(cfg, self.mesh, name='layers')(x, positions)
+        else:
+            layer_cls = nn.remat(DecoderLayer) if cfg.remat else DecoderLayer
+            for i in range(cfg.n_layers):
+                x = layer_cls(cfg, self.mesh, name=f'layer_{i}')(
+                    x, positions)
+
+        x = RMSNorm(cfg.norm_eps, name='final_norm')(x)
+        logits = nn.DenseGeneral(
+            cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ('embed', 'vocab')),
+            name='lm_head')(x)
+        return logits

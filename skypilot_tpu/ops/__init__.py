@@ -1,0 +1,12 @@
+"""TPU compute kernels (Pallas) with pure-JAX fallbacks.
+
+The reference framework ships zero kernels (SURVEY.md §2.1 — no native
+code); long-context and model compute are delegated entirely to user
+payloads.  In this framework they are first-class: flash attention on a
+single chip, ring attention across the 'sequence' mesh axis for
+long-context (SURVEY.md §5), both differentiable.
+"""
+from skypilot_tpu.ops.attention import flash_attention
+from skypilot_tpu.ops.ring_attention import ring_attention
+
+__all__ = ['flash_attention', 'ring_attention']

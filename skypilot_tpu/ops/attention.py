@@ -1,0 +1,227 @@
+"""Causal multi-head attention: Pallas flash kernel + blockwise fallback.
+
+Design (TPU-first):
+- Forward on TPU uses a Pallas flash-attention kernel: online softmax,
+  q-blocks on the grid, k-blocks streamed through VMEM, matmuls in
+  bfloat16 onto the MXU with float32 accumulation.
+- Everywhere else (CPU tests, and the backward pass) uses a blockwise
+  `lax.scan` implementation with the same online-softmax math — memory
+  O(seq * block) instead of O(seq^2), so XLA can pipeline it, and
+  autodiff through it is the flash backward recipe.
+
+No reference equivalent: SkyPilot ships no kernels (SURVEY.md §2.1).
+Shapes follow [batch, num_heads, seq, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == 'tpu'
+    except Exception:  # pylint: disable=broad-except
+        return False
+
+
+def mha_reference(q, k, v, *, causal: bool = True,
+                  sm_scale: Optional[float] = None):
+    """O(seq^2)-memory reference attention (tests / tiny shapes)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum('bhqd,bhkd->bhqk', q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        q_len, k_len = logits.shape[-2], logits.shape[-1]
+        qpos = jnp.arange(q_len)[:, None] + (k_len - q_len)
+        kpos = jnp.arange(k_len)[None, :]
+        logits = jnp.where(kpos <= qpos, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum('bhqk,bhkd->bhqd', probs, v).astype(q.dtype)
+
+
+def _blockwise_attention(q, k, v, *, causal: bool, sm_scale: float,
+                         block_k: int):
+    """Online-softmax attention scanning over k/v blocks."""
+    orig_dtype = q.dtype
+    b, h, q_len, d = q.shape
+    k_len = k.shape[2]
+    num_blocks = max(1, (k_len + block_k - 1) // block_k)
+    pad = num_blocks * block_k - k_len
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(b, h, num_blocks, block_k, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, num_blocks, block_k, d).transpose(2, 0, 1, 3, 4)
+
+    q32 = q.astype(jnp.float32)
+    qpos = jnp.arange(q_len) + (k_len - q_len)
+
+    @jax.checkpoint
+    def step(carry, blk):
+        o, m, l = carry
+        k_blk, v_blk, blk_idx = blk
+        s = jnp.einsum('bhqd,bhkd->bhqk', q32, k_blk.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * sm_scale
+        kpos = blk_idx * block_k + jnp.arange(block_k)
+        mask = kpos[None, :] < k_len  # padding mask
+        if causal:
+            mask = mask & (kpos[None, :] <= qpos[:, None])
+        s = jnp.where(mask[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            'bhqk,bhkd->bhqd', p, v_blk.astype(jnp.float32))
+        return (o_new, m_new, l_new), None
+
+    o0 = jnp.zeros((b, h, q_len, d), jnp.float32)
+    m0 = jnp.full((b, h, q_len), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, q_len), jnp.float32)
+    (o, _, l), _ = jax.lax.scan(
+        step, (o0, m0, l0),
+        (kb, vb, jnp.arange(num_blocks)))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(orig_dtype)
+
+
+# ---------------------------------------------------------------- Pallas
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
+                      causal: bool, block_k: int, k_len: int):
+    """One (batch*head, q_block) program: stream k/v blocks through VMEM.
+
+    Refs: q [1, block_q, d]; k/v [1, k_len_padded, d]; o [1, block_q, d]
+    (leading dim is the batch*head grid axis, blocked to 1).
+    """
+    from jax.experimental import pallas as pl  # pylint: disable=import-outside-toplevel
+
+    _, block_q, d = q_ref.shape
+    q_blk_idx = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+    qpos = q_blk_idx * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    num_k_blocks = pl.cdiv(k_len, block_k)
+    if causal:
+        # Skip k-blocks strictly above the diagonal for this q-block.
+        num_k_blocks = jnp.minimum(
+            num_k_blocks,
+            pl.cdiv((q_blk_idx + 1) * block_q, block_k))
+
+    def body(kb, carry):
+        o, m, l = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        kpos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = kpos < k_len
+        if causal:
+            mask &= kpos <= qpos
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    o, _, l = jax.lax.fori_loop(0, num_k_blocks, body, (o0, m0, l0))
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
+                      block_q: int, block_k: int):
+    from jax.experimental import pallas as pl  # pylint: disable=import-outside-toplevel
+    from jax.experimental.pallas import tpu as pltpu  # pylint: disable=import-outside-toplevel
+
+    b, h, q_len, d = q.shape
+    k_len = k.shape[2]
+    block_q = min(block_q, q_len)
+    block_k = min(block_k, k_len)
+    # Pad seq lens to block multiples; kernel masks the padding.
+    q_pad = (-q_len) % block_q
+    k_pad = (-k_len) % block_k
+    if q_pad:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, q_pad), (0, 0)))
+    if k_pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, k_pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, k_pad), (0, 0)))
+    qp = q.reshape(b * h, q_len + q_pad, d)
+    kp = k.reshape(b * h, k_len + k_pad, d)
+    vp = v.reshape(b * h, k_len + k_pad, d)
+
+    grid = (b * h, (q_len + q_pad) // block_q)
+    kernel = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
+                               causal=causal, block_k=block_k, k_len=k_len)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_len + k_pad, d), lambda bh, qi: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_len + k_pad, d), lambda bh, qi: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, q_len + q_pad, d), q.dtype),
+    )(qp, kp, vp)
+    return out.reshape(b, h, q_len + q_pad, d)[:, :, :q_len]
+
+
+# ------------------------------------------------------------- public op
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k):
+    if _on_tpu():
+        return _flash_fwd_pallas(q, k, v, causal=causal, sm_scale=sm_scale,
+                                 block_q=block_q, block_k=block_k)
+    return _blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                                block_k=block_k)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    out = _flash(q, k, v, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
+    q, k, v = res
+    # Backward = autodiff of the blockwise forward (recompute; flash
+    # backward recipe).  Same math as the Pallas forward.
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _blockwise_attention(
+            q_, k_, v_, causal=causal, sm_scale=sm_scale, block_k=block_k),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Flash attention over [batch, heads, seq, head_dim] arrays."""
+    if sm_scale is None:
+        sm_scale = float(q.shape[-1]) ** -0.5
+    return _flash(q, k, v, causal, float(sm_scale), block_q, block_k)

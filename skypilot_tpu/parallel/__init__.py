@@ -1,0 +1,24 @@
+"""TPU-native parallelism layer.
+
+The reference (SkyPilot) stops at handing user code an IP list
+(/root/reference/sky/backends/cloud_vm_ray_backend.py:579-634 rank/IP env
+export); all model parallelism is delegated to user code.  Here it is
+first-class: mesh construction from the provisioned slice topology
+([dcn, ici] axis ordering), `jax.distributed` coordinator bootstrap from
+the env the gang-exec layer exports, and sharding-rule helpers.
+"""
+from skypilot_tpu.parallel.distributed import initialize_from_env
+from skypilot_tpu.parallel.mesh import MeshConfig
+from skypilot_tpu.parallel.mesh import build_mesh
+from skypilot_tpu.parallel.mesh import slice_topology
+from skypilot_tpu.parallel.sharding import LOGICAL_AXIS_RULES
+from skypilot_tpu.parallel.sharding import logical_sharding
+
+__all__ = [
+    'LOGICAL_AXIS_RULES',
+    'MeshConfig',
+    'build_mesh',
+    'initialize_from_env',
+    'logical_sharding',
+    'slice_topology',
+]

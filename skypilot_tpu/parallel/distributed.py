@@ -1,0 +1,57 @@
+"""`jax.distributed` bootstrap from the gang-exec environment.
+
+The gang-exec layer (backends/gang_supervisor.py) exports
+SKYTPU_HOST_RANK / SKYTPU_HOST_IPS / SKYTPU_COORDINATOR_ADDRESS on every
+TPU-VM worker (skylet/constants.py:25-44).  This module turns that into a
+ready multi-host JAX runtime — the TPU-native replacement for the
+reference's "here is SKYPILOT_NODE_IPS, wire up torch.distributed
+yourself" contract (/root/reference/sky/backends/cloud_vm_ray_backend.py:
+579-634).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.skylet import constants
+
+logger = sky_logging.init_logger(__name__)
+
+_initialized = False
+
+
+def initialize_from_env(*, force: bool = False) -> bool:
+    """Initialize jax.distributed from SKYTPU_* env, if present.
+
+    Idempotent; returns True if the distributed runtime is (now) up,
+    False when running single-process (no gang env → nothing to do).
+    """
+    global _initialized
+    if _initialized and not force:
+        return True
+    coordinator = os.environ.get(constants.ENV_COORDINATOR_ADDRESS)
+    num_hosts = int(os.environ.get(constants.ENV_NUM_HOSTS, '1'))
+    if coordinator is None or num_hosts <= 1:
+        return False
+    rank = int(os.environ.get(constants.ENV_HOST_RANK, '0'))
+    import jax  # pylint: disable=import-outside-toplevel
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_hosts,
+        process_id=rank,
+    )
+    _initialized = True
+    logger.info(f'jax.distributed up: rank {rank}/{num_hosts} '
+                f'coordinator {coordinator}')
+    return True
+
+
+def task_checkpoint_dir() -> Optional[str]:
+    """The per-task checkpoint dir handed to user code (auto-resume
+    contract; SURVEY.md §5 checkpoint/resume)."""
+    return os.environ.get(constants.ENV_CHECKPOINT_DIR)
+
+
+def num_slices() -> int:
+    return int(os.environ.get(constants.ENV_NUM_SLICES, '1'))

@@ -1,0 +1,167 @@
+"""Device-mesh construction from TPU slice topology.
+
+TPU-first design note: the scheduling unit in this framework is a *slice*
+(e.g. v5p-64 = 8 hosts x 4 chips), and multislice jobs add a DCN dimension
+across slices.  Collectives must ride ICI inside a slice and DCN only on
+the outermost (data/pipeline) axes, so the mesh is always laid out with
+DCN axes *first* (slowest-varying) and ICI axes last — the "[dcn, ici]"
+ordering from the scaling-book recipe.  The reference has no equivalent
+(its parallelism ends at gang scheduling; SURVEY.md §2.3).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+# Standard mesh axis names, outermost first.  'data' and 'pipeline' may
+# span DCN (across slices); 'fsdp', 'tensor', 'sequence', 'expert' must
+# stay inside a slice (ICI).
+DCN_AXES = ('data', 'pipeline')
+ICI_AXES = ('fsdp', 'sequence', 'tensor', 'expert')
+
+# chips per host for each TPU generation (v4/v5p: 4 chips/host;
+# v5e/v6e: 8 chips/host for the 2x4 host form factor).
+_CHIPS_PER_HOST = {
+    'v2': 4, 'v3': 4, 'v4': 4, 'v5p': 4,
+    'v5e': 8, 'v5litepod': 8, 'v6e': 8,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    """Physical shape of one TPU slice."""
+    generation: str          # 'v5p', 'v5e', ...
+    num_chips: int           # total chips in the slice
+    num_hosts: int           # TPU-VM workers in the slice
+    chips_per_host: int
+
+    @property
+    def accelerator_name(self) -> str:
+        return f'tpu-{self.generation}-{self.num_chips}'
+
+
+def slice_topology(accelerator: str) -> SliceTopology:
+    """Parse 'tpu-v5p-64' / 'v5e-8' into a SliceTopology.
+
+    The chip-count grammar matches the reference's TPU naming
+    (/root/reference/sky/clouds/utils/gcp_utils.py:28-59 is_tpu_vm_pod /
+    get_num_tpu_devices), except counts are chips, not cores-for-v2/v3.
+    """
+    name = accelerator.lower()
+    if name.startswith('tpu-'):
+        name = name[len('tpu-'):]
+    parts = name.rsplit('-', 1)
+    if len(parts) != 2 or not parts[1].isdigit():
+        raise ValueError(f'Cannot parse TPU accelerator name: {accelerator!r}')
+    gen, count = parts[0], int(parts[1])
+    if gen not in _CHIPS_PER_HOST:
+        raise ValueError(f'Unknown TPU generation {gen!r} in {accelerator!r}')
+    # v2/v3 names count cores (2 cores/chip); v4+ count chips.
+    num_chips = count // 2 if gen in ('v2', 'v3') else count
+    chips_per_host = _CHIPS_PER_HOST[gen]
+    num_hosts = max(1, math.ceil(num_chips / chips_per_host))
+    return SliceTopology(generation=gen, num_chips=num_chips,
+                         num_hosts=num_hosts,
+                         chips_per_host=min(chips_per_host, num_chips))
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Requested logical mesh: axis name -> size.
+
+    Sizes of -1 are inferred (at most one per group).  Axes in DCN_AXES
+    multiply to num_slices * (any leftover data parallelism); axes in
+    ICI_AXES multiply to chips-per-slice.
+    """
+    data: int = -1
+    pipeline: int = 1
+    fsdp: int = 1
+    sequence: int = 1
+    tensor: int = 1
+    expert: int = 1
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {
+            'data': self.data, 'pipeline': self.pipeline,
+            'fsdp': self.fsdp, 'sequence': self.sequence,
+            'tensor': self.tensor, 'expert': self.expert,
+        }
+
+
+def _infer(sizes: List[int], total: int, what: str) -> List[int]:
+    """Fill in at most one -1 so that prod(sizes) == total."""
+    unknown = [i for i, s in enumerate(sizes) if s == -1]
+    if len(unknown) > 1:
+        raise ValueError(f'At most one inferred (-1) axis allowed in {what}')
+    known = math.prod(s for s in sizes if s != -1)
+    if unknown:
+        if total % known != 0:
+            raise ValueError(
+                f'{what}: cannot infer axis; {total} devices not divisible '
+                f'by product of fixed axes {known}')
+        sizes = list(sizes)
+        sizes[unknown[0]] = total // known
+    elif known != total:
+        raise ValueError(
+            f'{what}: axis sizes multiply to {known}, but there are '
+            f'{total} devices')
+    return sizes
+
+
+def build_mesh(config: Optional[MeshConfig] = None,
+               *,
+               devices=None,
+               num_slices: int = 1):
+    """Construct a jax.sharding.Mesh with [dcn, ici] axis ordering.
+
+    Single-slice: a plain mesh over all devices with DCN axes degenerate
+    or folded into the device order.  Multislice: uses
+    `mesh_utils.create_hybrid_device_mesh` so DCN axes map across slices
+    and ICI axes map within a slice (collectives on inner axes then ride
+    ICI links only).
+    """
+    import jax  # pylint: disable=import-outside-toplevel
+    from jax.experimental import mesh_utils  # pylint: disable=import-outside-toplevel
+
+    config = config or MeshConfig()
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+
+    axis_names = list(DCN_AXES + ICI_AXES)
+    sizes = config.axis_sizes()
+    dcn_sizes = [sizes[a] for a in DCN_AXES]
+    ici_sizes = [sizes[a] for a in ICI_AXES]
+
+    if num_slices > 1:
+        per_slice = n // num_slices
+        ici_sizes = _infer(ici_sizes, per_slice, 'ICI axes')
+        dcn_sizes = _infer(dcn_sizes, num_slices, 'DCN axes')
+        if hasattr(devices[0], 'slice_index'):
+            # Real multislice TPU: let mesh_utils group by slice_index.
+            # Per-axis shapes of equal rank: ICI sizes on the inner axes
+            # (within a slice), DCN sizes on the outer (across slices).
+            mesh_shape = [1] * len(DCN_AXES) + ici_sizes
+            dcn_mesh_shape = dcn_sizes + [1] * len(ICI_AXES)
+            device_array = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape, dcn_mesh_shape, devices=devices)
+        else:
+            # Virtual/test devices carry no slice_index: consecutive
+            # blocks of n/num_slices devices stand in for slices.
+            device_array = np.asarray(devices).reshape(
+                dcn_sizes + ici_sizes)
+    else:
+        # All axes share one ICI domain; infer across the whole product.
+        all_sizes = _infer(dcn_sizes + ici_sizes, n, 'mesh axes')
+        dcn_sizes, ici_sizes = all_sizes[:len(DCN_AXES)], \
+            all_sizes[len(DCN_AXES):]
+        device_array = np.asarray(devices).reshape(dcn_sizes + ici_sizes)
+
+    return jax.sharding.Mesh(device_array, axis_names)

@@ -1,0 +1,66 @@
+"""Logical-axis sharding rules and helpers.
+
+Models annotate parameters/activations with *logical* axis names
+('batch', 'embed', 'heads', ...); these rules map them onto the physical
+mesh axes from parallel/mesh.py.  GSPMD then inserts the collectives —
+nothing here hand-schedules communication.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+# (logical axis, mesh axis or tuple of mesh axes) — first matching rule
+# wins.  batch rides data(+fsdp) — DCN-safe; everything model-internal
+# stays on ICI axes.
+LOGICAL_AXIS_RULES: Tuple[Tuple[str, Optional[object]], ...] = (
+    ('batch', ('data', 'fsdp')),
+    ('seq', 'sequence'),
+    ('embed', 'fsdp'),
+    ('heads', 'tensor'),
+    ('kv_heads', 'tensor'),
+    ('mlp', 'tensor'),
+    ('vocab', 'tensor'),
+    ('expert', 'expert'),
+    ('head_dim', None),
+    ('kv', None),
+    ('stage', 'pipeline'),
+    ('layers', None),
+)
+
+
+def logical_sharding(mesh, *logical_axes: Optional[str]):
+    """NamedSharding for an array whose dims carry these logical names."""
+    import jax  # pylint: disable=import-outside-toplevel
+    rules = dict(LOGICAL_AXIS_RULES)
+    spec = []
+    used = set()
+    for name in logical_axes:
+        mesh_axes = rules.get(name) if name is not None else None
+        if mesh_axes is None:
+            spec.append(None)
+            continue
+        if isinstance(mesh_axes, str):
+            mesh_axes = (mesh_axes,)
+        # Drop axes not in the mesh or already used by an earlier dim
+        # (an axis may shard at most one dim of a given array).
+        usable = tuple(a for a in mesh_axes
+                       if a in mesh.axis_names and a not in used)
+        used.update(usable)
+        if not usable:
+            spec.append(None)
+        elif len(usable) == 1:
+            spec.append(usable[0])
+        else:
+            spec.append(usable)
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(*spec))
+
+
+def batch_sharding(mesh):
+    """Sharding for [batch, seq, ...] input arrays."""
+    return logical_sharding(mesh, 'batch', 'seq')
+
+
+def replicated(mesh):
+    import jax  # pylint: disable=import-outside-toplevel
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())

@@ -99,6 +99,11 @@ class Task:
             if not os.path.exists(os.path.expanduser(src)):
                 raise exceptions.InvalidTaskError(
                     f'file_mounts source {src!r} does not exist.')
+        for dst in self.storage_mounts:
+            if not os.path.isabs(dst) and not dst.startswith('~'):
+                raise exceptions.InvalidTaskError(
+                    f'storage mount destination must be absolute or '
+                    f'~-based, got {dst!r}.')
 
     # ---------------------------------------------------------- resources
 
@@ -164,10 +169,20 @@ class Task:
         if unknown:
             raise exceptions.InvalidTaskError(
                 f'Unknown task fields: {sorted(unknown)}')
-        file_mounts = {
-            dst: sub(src)
-            for dst, src in (config.get('file_mounts') or {}).items()
-        }
+        # file_mounts values may be plain paths/URLs (copied via rsync)
+        # or storage configs (dicts) that become bucket-backed
+        # storage_mounts (parity: reference task.py file_mounts dual
+        # syntax).
+        file_mounts = {}
+        storage_mounts = {}
+        for dst, src in (config.get('file_mounts') or {}).items():
+            if isinstance(src, dict):
+                from skypilot_tpu.data import storage as storage_lib  # pylint: disable=import-outside-toplevel
+                storage_mounts[dst] = storage_lib.Storage.from_yaml_config(
+                    {k: sub(v) if isinstance(v, str) else v
+                     for k, v in src.items()})
+            else:
+                file_mounts[dst] = sub(src)
         task = cls(
             name=config.get('name'),
             setup=sub(config.get('setup')),
@@ -176,6 +191,7 @@ class Task:
             num_nodes=config.get('num_nodes'),
             envs=envs,
             file_mounts=file_mounts,
+            storage_mounts=storage_mounts,
             checkpoint_dir=sub(config.get('checkpoint_dir')),
         )
         resources_config = config.get('resources')
@@ -217,8 +233,10 @@ class Task:
             config['num_nodes'] = self.num_nodes
         if self._envs:
             config['envs'] = dict(self._envs)
-        if self.file_mounts:
+        if self.file_mounts or self.storage_mounts:
             config['file_mounts'] = dict(self.file_mounts)
+            for dst, storage in self.storage_mounts.items():
+                config['file_mounts'][dst] = storage.to_yaml_config()
         if len(self._resources) == 1:
             r = next(iter(self._resources)).to_yaml_config()
             if r:

@@ -200,3 +200,14 @@ def fill_template(template_str: str, variables: Dict[str, Any]) -> str:
                              trim_blocks=True,
                              lstrip_blocks=True)
     return env.from_string(template_str).render(**variables)
+
+
+def validate_schema_keys(config: Dict[str, Any], allowed: set,
+                         what: str) -> None:
+    """Reject unknown keys in a YAML sub-config with a pointed error."""
+    from skypilot_tpu import exceptions  # pylint: disable=import-outside-toplevel
+    unknown = set(config) - allowed
+    if unknown:
+        raise exceptions.InvalidTaskError(
+            f'Unknown key(s) in {what} config: {sorted(unknown)}; '
+            f'allowed: {sorted(allowed)}')

@@ -8,9 +8,34 @@ exercised without TPU hardware.
 from __future__ import annotations
 
 import os
+import sys
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Tests must be hermetic and fast: always virtual CPU devices, never the
+# tunnelled TPU.  The environment may pre-register a remote-compile PJRT
+# plugin at *interpreter start* (sitecustomize keyed off
+# PALLAS_AXON_POOL_IPS), which routes even CPU compiles through the TPU
+# relay — too late to undo from here.  Re-exec once with a clean env so
+# the interpreter starts without the plugin.
+def pytest_configure(config):
+    if not os.environ.get('PALLAS_AXON_POOL_IPS'):
+        return
+    # Restore the real stdout/stderr fds before exec'ing, else all
+    # output of the re-exec'd run lands in the dead capture tempfile.
+    capman = config.pluginmanager.getplugin('capturemanager')
+    if capman is not None:
+        capman.stop_global_capturing()
+    env = {k: v for k, v in os.environ.items()
+           if k != 'PALLAS_AXON_POOL_IPS'}
+    env['JAX_PLATFORMS'] = 'cpu'
+    flags = env.get('XLA_FLAGS', '')
+    if 'xla_force_host_platform_device_count' not in flags:
+        env['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=8').strip()
+    os.execve(sys.executable,
+              [sys.executable, '-m', 'pytest'] + sys.argv[1:], env)
+
+
+os.environ['JAX_PLATFORMS'] = 'cpu'
 _flags = os.environ.get('XLA_FLAGS', '')
 if 'xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (

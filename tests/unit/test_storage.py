@@ -1,0 +1,185 @@
+"""Storage subsystem tests (hermetic — CLI calls are faked).
+
+Parity with the reference's offline storage tests
+(/root/reference/tests/test_storage.py approach: no real buckets for
+unit-level checks).
+"""
+from __future__ import annotations
+
+import subprocess
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import mounting_utils
+from skypilot_tpu.data import storage as storage_lib
+from skypilot_tpu.data import storage_mounting
+from skypilot_tpu.data import storage_utils
+from skypilot_tpu.data.storage import Storage
+from skypilot_tpu.data.storage import StorageMode
+from skypilot_tpu.data.storage import StoreType
+
+
+def _fake_run(history):
+    """Fake CLI: bucket-existence probes report 'not found' (rc 1)."""
+
+    def run(cmd, **kwargs):
+        history.append(cmd)
+        rc = 1 if ('ls' in cmd and '-b' in cmd) or 'head-bucket' in cmd \
+            else 0
+        return subprocess.CompletedProcess(cmd, rc, stdout='', stderr='')
+
+    return run
+
+
+class TestStoreType:
+
+    def test_from_url(self):
+        assert StoreType.from_url('gs://b/path') is StoreType.GCS
+        assert StoreType.from_url('s3://b') is StoreType.S3
+        with pytest.raises(ValueError):
+            StoreType.from_url('azure://x')
+
+
+class TestStorage:
+
+    def test_name_from_bucket_url(self):
+        s = Storage(source='gs://my-bucket')
+        assert s.name == 'my-bucket'
+        assert StoreType.GCS in s.stores
+
+    def test_subpath_source_preserved(self):
+        s = Storage(source='gs://my-bkt/train-data')
+        store = s.stores[StoreType.GCS]
+        assert store.url == 'gs://my-bkt/train-data'
+        assert '--only-dir train-data' in store.mount_command('/data')
+
+    def test_delete_missing_store_raises(self):
+        s = Storage(source='gs://bkt-one')
+        with pytest.raises(exceptions.StorageError):
+            s.delete(StoreType.S3)
+
+    def test_requires_name_for_local(self, tmp_path):
+        with pytest.raises(exceptions.StorageSpecError):
+            Storage(source=str(tmp_path))
+
+    def test_local_source_must_exist(self):
+        with pytest.raises(exceptions.StorageSourceError):
+            Storage(name='b1', source='/nonexistent/path/xyz')
+
+    def test_invalid_bucket_name(self):
+        with pytest.raises(exceptions.StorageNameError):
+            storage_lib.GcsStore('UPPER_CASE_BAD')
+
+    def test_yaml_round_trip(self, tmp_path):
+        cfg = {'name': 'bkt', 'source': str(tmp_path), 'mode': 'COPY',
+               'store': 'gcs', 'persistent': False}
+        s = Storage.from_yaml_config(cfg)
+        assert s.mode is StorageMode.COPY
+        out = s.to_yaml_config()
+        assert out['name'] == 'bkt'
+        assert out['mode'] == 'COPY'
+        assert out['store'] == 'gcs'
+        assert out['persistent'] is False
+
+    def test_unknown_yaml_key_rejected(self):
+        with pytest.raises(exceptions.InvalidTaskError):
+            Storage.from_yaml_config({'name': 'b', 'frobnicate': 1})
+
+    def test_add_store_uploads_local_source(self, tmp_path, monkeypatch):
+        (tmp_path / 'f.txt').write_text('hi')
+        history = []
+        monkeypatch.setattr(storage_lib, '_run', _fake_run(history))
+        s = Storage(name='bkt', source=str(tmp_path))
+        s.add_store(StoreType.GCS)
+        joined = [' '.join(c) for c in history]
+        assert any('mb' in c for c in joined)         # bucket create
+        assert any('rsync' in c for c in joined)      # upload
+
+    def test_exists_skips_create(self, monkeypatch):
+        calls = []
+
+        def run(cmd, **kw):
+            calls.append(cmd)
+            return subprocess.CompletedProcess(cmd, 0, stdout='',
+                                               stderr='')
+
+        monkeypatch.setattr(storage_lib, '_run', run)
+        store = storage_lib.GcsStore('bkt')
+        store.create()
+        assert not any('mb' in ' '.join(c) for c in calls)
+
+
+class TestMountingUtils:
+
+    def test_gcs_mount_cmd_idempotent(self):
+        cmd = mounting_utils.get_mount_cmd('bkt', '/data')
+        assert 'gcsfuse' in cmd
+        assert 'mountpoint -q /data' in cmd
+
+    def test_readonly_flag(self):
+        cmd = mounting_utils.get_mount_cmd('bkt', '/data', readonly=True)
+        assert '-o ro' in cmd
+
+    def test_copy_down(self):
+        cmd = mounting_utils.get_copy_down_cmd('gs://b', '/data')
+        assert 'rsync' in cmd
+
+
+class TestSkyignore:
+
+    def test_skyignore_patterns(self, tmp_path):
+        (tmp_path / '.skyignore').write_text('*.log\nbuild\n')
+        (tmp_path / 'a.log').write_text('')
+        (tmp_path / 'keep.py').write_text('')
+        (tmp_path / 'build').mkdir()
+        excluded = storage_utils.get_excluded_files(str(tmp_path))
+        assert 'a.log' in excluded
+        assert 'build' in excluded
+        assert 'keep.py' not in excluded
+
+
+class _FakeRunner:
+
+    def __init__(self, node_id):
+        self.node_id = node_id
+        self.commands = []
+
+    def run(self, cmd, **kwargs):
+        self.commands.append(cmd)
+        return 0, '', ''
+
+
+class _FakeHandle:
+
+    def __init__(self, n=2):
+        self.runners = [_FakeRunner(f'host-{i}') for i in range(n)]
+
+    def get_command_runners(self):
+        return self.runners
+
+
+class TestStorageMounting:
+
+    def test_mounts_on_all_hosts(self, tmp_path):
+        handle = _FakeHandle(3)
+        storage = Storage(source='gs://data-bkt')
+        storage_mounting.execute_storage_mounts(handle, {'/data': storage})
+        for runner in handle.runners:
+            assert len(runner.commands) == 1
+            assert 'gcsfuse' in runner.commands[0]
+
+    def test_copy_mode_uses_rsync(self):
+        handle = _FakeHandle(1)
+        storage = Storage(source='gs://data-bkt')
+        storage.mode = StorageMode.COPY
+        storage_mounting.execute_storage_mounts(handle, {'/data': storage})
+        assert 'rsync' in handle.runners[0].commands[0]
+
+    def test_failure_raises(self):
+        handle = _FakeHandle(1)
+        handle.runners[0].run = lambda cmd, **kw: (1, '', 'boom')
+        storage = Storage(source='gs://data-bkt')
+        with pytest.raises(exceptions.CommandError):
+            storage_mounting.execute_storage_mounts(handle,
+                                                    {'/data': storage})
