@@ -19,13 +19,18 @@ def _param_count(params) -> int:
 
 
 def _peak_flops(device) -> float:
-    """Peak bf16 FLOP/s for known TPU generations (fallback: v5e)."""
+    """Peak bf16 FLOP/s for known TPU generations (fallback: v5e).
+
+    Matched against real device_kind strings ('TPU v5 lite', 'TPU v5p',
+    'TPU v6 lite', ...) — most specific key first.
+    """
     kind = getattr(device, 'device_kind', '').lower()
-    table = {
-        'v2': 45e12, 'v3': 123e12, 'v4': 275e12,
-        'v5litepod': 197e12, 'v5e': 197e12, 'v5p': 459e12, 'v6e': 918e12,
-    }
-    for key, val in table.items():
+    table = (
+        ('v6 lite', 918e12), ('v6e', 918e12),
+        ('v5 lite', 197e12), ('v5litepod', 197e12), ('v5e', 197e12),
+        ('v5p', 459e12), ('v4', 275e12), ('v3', 123e12), ('v2', 45e12),
+    )
+    for key, val in table:
         if key in kind:
             return val
     return 197e12
@@ -41,7 +46,11 @@ def main() -> None:
     from skypilot_tpu.models.train import train_step
 
     dev = jax.devices()[0]
-    on_tpu = jax.default_backend() not in ('cpu',)
+    # The TPU plugin may register under a custom platform name (e.g. a
+    # tunnel), so also accept a TPU device_kind; GPU/CPU take the small
+    # fallback path (the MFU roofline table is TPU-only).
+    on_tpu = (jax.default_backend() == 'tpu' or
+              'tpu' in getattr(dev, 'device_kind', '').lower())
     if on_tpu:
         cfg = configs.get_config('small')
         batch, seq = 16, 1024

@@ -1,0 +1,550 @@
+"""CLI: the `sky`-equivalent command surface.
+
+Parity: /root/reference/sky/cli.py (launch :1044, exec :1173,
+status :1554, queue/logs/cancel/stop/autostop/start/down :1948-2581,
+check :2948, show_gpus :3001, groups storage/jobs/serve :3416-4025).
+Exposed as `python -m skypilot_tpu.cli` and the `skytpu` entry point.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import click
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _parse_env(env: Tuple[str, ...]) -> Dict[str, str]:
+    out = {}
+    for item in env:
+        if '=' in item:
+            key, value = item.split('=', 1)
+        else:
+            key, value = item, os.environ.get(item, '')
+        out[key] = value
+    return out
+
+
+def _make_task(entrypoint: Optional[str], *, name: Optional[str],
+               workdir: Optional[str], cloud: Optional[str],
+               region: Optional[str], zone: Optional[str],
+               accelerators: Optional[str], cpus: Optional[str],
+               memory: Optional[str], instance_type: Optional[str],
+               use_spot: Optional[bool], num_nodes: Optional[int],
+               env: Tuple[str, ...], command: Optional[str] = None):
+    """YAML (or inline command) → Task with CLI overrides applied.
+
+    Parity: reference cli.py:702
+    (_make_task_or_dag_from_entrypoint_with_overrides).
+    """
+    from skypilot_tpu import resources as resources_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu import task as task_lib  # pylint: disable=import-outside-toplevel
+
+    if entrypoint and (entrypoint.endswith(('.yaml', '.yml')) or
+                       os.path.isfile(os.path.expanduser(entrypoint))):
+        task = task_lib.Task.from_yaml(entrypoint)
+    else:
+        cmd = command if command is not None else entrypoint
+        task = task_lib.Task(run=cmd)
+
+    if name is not None:
+        task.name = name
+    if workdir is not None:
+        task.workdir = workdir
+    if num_nodes is not None:
+        task.num_nodes = num_nodes
+    if env:
+        task.update_envs(_parse_env(env))
+
+    override: Dict[str, Any] = {}
+    if cloud is not None:
+        override['cloud'] = cloud
+    if region is not None:
+        override['region'] = region
+    if zone is not None:
+        override['zone'] = zone
+    if accelerators is not None:
+        override['accelerators'] = accelerators
+    if cpus is not None:
+        override['cpus'] = cpus
+    if memory is not None:
+        override['memory'] = memory
+    if instance_type is not None:
+        override['instance_type'] = instance_type
+    if use_spot is not None:
+        override['use_spot'] = use_spot
+    if override:
+        if task.resources:
+            task.set_resources(
+                {r.copy(**override) for r in task.resources})
+        else:
+            task.set_resources(resources_lib.Resources(**override))
+    return task
+
+
+_TASK_OPTIONS = [
+    click.option('--name', '-n', default=None, help='Task/cluster name.'),
+    click.option('--workdir', default=None,
+                 help='Directory synced to all hosts.'),
+    click.option('--cloud', default=None,
+                 help='Infra to use (gcp | local).'),
+    click.option('--region', default=None),
+    click.option('--zone', default=None),
+    click.option('--gpus', '--accelerators', 'accelerators', default=None,
+                 help="Accelerators, e.g. 'tpu-v5e-8' or 'A100:8'."),
+    click.option('--cpus', default=None),
+    click.option('--memory', default=None),
+    click.option('--instance-type', '-t', default=None),
+    click.option('--use-spot/--no-use-spot', 'use_spot', default=None),
+    click.option('--num-nodes', type=int, default=None,
+                 help='Number of slices/nodes.'),
+    click.option('--env', multiple=True,
+                 help='Env var KEY=VALUE (repeatable).'),
+]
+
+
+def _add_options(options):
+
+    def deco(f):
+        for option in reversed(options):
+            f = option(f)
+        return f
+
+    return deco
+
+
+@click.group()
+@click.version_option(message='%(version)s')
+def cli():
+    """skypilot_tpu: run AI workloads on TPU slices, anywhere."""
+
+
+# ------------------------------------------------------------------ launch
+
+
+@cli.command()
+@click.argument('entrypoint', required=False)
+@click.option('--cluster', '-c', default=None, help='Cluster name.')
+@click.option('--dryrun', is_flag=True, default=False)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
+@click.option('--down', is_flag=True, default=False,
+              help='Tear down the cluster when the job finishes.')
+@click.option('--retry-until-up', '-r', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+@click.option('--no-setup', is_flag=True, default=False)
+@_add_options(_TASK_OPTIONS)
+def launch(entrypoint, cluster, dryrun, detach_run,
+           idle_minutes_to_autostop, down, retry_until_up, yes, no_setup,
+           **task_args):
+    """Launch a task (YAML file or inline command) on a (new) cluster."""
+    from skypilot_tpu import execution  # pylint: disable=import-outside-toplevel
+    task = _make_task(entrypoint, **task_args)
+    if not yes and not dryrun:
+        click.confirm(f'Launching task on cluster '
+                      f'{cluster or "(auto-named)"}. Proceed?',
+                      default=True, abort=True)
+    try:
+        job_id = execution.launch(
+            task, cluster_name=cluster, dryrun=dryrun,
+            detach_run=detach_run, down=down,
+            idle_minutes_to_autostop=idle_minutes_to_autostop,
+            retry_until_up=retry_until_up, no_setup=no_setup)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(common_utils.format_exception(e))
+    if job_id is not None:
+        click.echo(f'Job submitted with ID: {job_id}')
+
+
+@cli.command(name='exec')
+@click.argument('cluster')
+@click.argument('entrypoint', required=False)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@_add_options(_TASK_OPTIONS)
+def exec_cmd(cluster, entrypoint, detach_run, **task_args):
+    """Run a task on an existing cluster (skip provision/setup)."""
+    from skypilot_tpu import execution  # pylint: disable=import-outside-toplevel
+    task = _make_task(entrypoint, **task_args)
+    try:
+        job_id = execution.exec(task, cluster_name=cluster,
+                                detach_run=detach_run)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(common_utils.format_exception(e))
+    if job_id is not None:
+        click.echo(f'Job submitted with ID: {job_id}')
+
+
+# ------------------------------------------------------------------ status
+
+
+@cli.command()
+@click.option('--refresh', '-r', is_flag=True, default=False,
+              help='Re-query live cluster status from the provider.')
+@click.argument('clusters', nargs=-1)
+def status(refresh, clusters):
+    """Show clusters."""
+    from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+    records = core.status(cluster_names=list(clusters) or None,
+                          refresh=refresh)
+    if not records:
+        click.echo('No existing clusters.')
+        return
+    rows = []
+    for r in records:
+        handle = r.get('handle')
+        resources_str = '-'
+        if handle is not None and getattr(handle, 'launched_resources',
+                                          None) is not None:
+            resources_str = str(handle.launched_resources)
+        rows.append((r['name'], resources_str, str(r['status'].value),
+                     r.get('autostop', '-')))
+    _print_table(['NAME', 'RESOURCES', 'STATUS', 'AUTOSTOP'], rows)
+
+
+def _print_table(headers: List[str], rows: List[tuple]) -> None:
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(str(cell)))
+    fmt = '  '.join(f'{{:<{w}}}' for w in widths)
+    click.echo(fmt.format(*headers))
+    for row in rows:
+        click.echo(fmt.format(*[str(c) for c in row]))
+
+
+# ------------------------------------------------------- lifecycle verbs
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def stop(clusters, yes):
+    """Stop cluster(s) (restartable with `start`)."""
+    _lifecycle('stop', clusters, yes)
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def start(clusters, yes):
+    """Restart stopped cluster(s)."""
+    _lifecycle('start', clusters, yes)
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+@click.option('--purge', is_flag=True, default=False)
+def down(clusters, yes, purge):
+    """Terminate cluster(s)."""
+    _lifecycle('down', clusters, yes, purge=purge)
+
+
+def _lifecycle(verb: str, clusters, yes: bool, **kwargs) -> None:
+    from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu import global_user_state  # pylint: disable=import-outside-toplevel
+    names: List[str] = []
+    for pattern in clusters:
+        names.extend(global_user_state.get_glob_cluster_names(pattern))
+    names = sorted(set(names))
+    if not names:
+        click.echo(f'No clusters match {clusters}.')
+        return
+    if not yes:
+        click.confirm(f'{verb} cluster(s) {", ".join(names)}?',
+                      default=True, abort=True)
+    for name in names:
+        try:
+            getattr(core, verb)(name, **kwargs)
+            click.echo(f'{verb}: {name} done.')
+        except exceptions.SkyTpuError as e:
+            click.echo(f'{verb}: {name} failed: '
+                       f'{common_utils.format_exception(e)}', err=True)
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes', '-i', type=int, required=True)
+@click.option('--down', is_flag=True, default=False)
+@click.option('--cancel', is_flag=True, default=False)
+def autostop(cluster, idle_minutes, down, cancel):
+    """Schedule stop/down after idle minutes (-1 or --cancel clears)."""
+    from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+    if cancel:
+        idle_minutes = -1
+    core.autostop(cluster, idle_minutes, down=down)
+    click.echo('Autostop updated.')
+
+
+# ----------------------------------------------------------- job verbs
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--skip-finished', '-s', is_flag=True, default=False)
+def queue(cluster, skip_finished):
+    """Show the cluster's job queue."""
+    from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+    jobs = core.queue(cluster, all_jobs=not skip_finished)
+    rows = [(j['job_id'], j['job_name'], j.get('username', '-'),
+             j['status']) for j in jobs]
+    _print_table(['ID', 'NAME', 'USER', 'STATUS'], rows)
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', required=False, type=int)
+@click.option('--no-follow', is_flag=True, default=False)
+def logs(cluster, job_id, no_follow):
+    """Tail a job's logs."""
+    from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+    core.tail_logs(cluster, job_id, follow=not no_follow)
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_ids', nargs=-1, type=int)
+@click.option('--all', '-a', 'all_jobs', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def cancel(cluster, job_ids, all_jobs, yes):
+    """Cancel job(s) on a cluster."""
+    from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+    if not job_ids and not all_jobs:
+        raise click.UsageError('Provide job ids or --all.')
+    if not yes:
+        what = 'all jobs' if all_jobs else f'jobs {list(job_ids)}'
+        click.confirm(f'Cancel {what} on {cluster}?', default=True,
+                      abort=True)
+    core.cancel(cluster, job_ids=list(job_ids) or None,
+                all_jobs=all_jobs)
+
+
+# ------------------------------------------------------------------ check
+
+
+@cli.command()
+def check():
+    """Verify credentials for each infra and enable the usable ones."""
+    from skypilot_tpu import check as check_lib  # pylint: disable=import-outside-toplevel
+    check_lib.check()
+
+
+@cli.command(name='show-tpus')
+@click.option('--all', '-a', 'show_all', is_flag=True, default=False)
+def show_tpus(show_all):
+    """List TPU (and GPU) offerings with pricing."""
+    from skypilot_tpu import catalog  # pylint: disable=import-outside-toplevel
+    entries = catalog.list_accelerators()
+    rows = []
+    for name, infos in sorted(entries.items()):
+        for info in infos:
+            if not show_all and not name.startswith('tpu'):
+                continue
+            rows.append((name, info.accelerator_count, info.cloud,
+                         info.region or '-',
+                         f'{info.price:.2f}' if info.price else '-',
+                         f'{info.spot_price:.2f}'
+                         if info.spot_price else '-'))
+    _print_table(
+        ['ACCELERATOR', 'COUNT', 'CLOUD', 'REGION', '$/HR', 'SPOT $/HR'],
+        rows)
+
+
+# ------------------------------------------------------------- jobs group
+
+
+@cli.group(name='jobs')
+def jobs_group():
+    """Managed jobs with auto-recovery."""
+
+
+@jobs_group.command(name='launch')
+@click.argument('entrypoint', required=False)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+@_add_options(_TASK_OPTIONS)
+def jobs_launch(entrypoint, detach_run, yes, **task_args):
+    """Launch a managed job (supervised, auto-recovered)."""
+    from skypilot_tpu import jobs  # pylint: disable=import-outside-toplevel
+    task = _make_task(entrypoint, **task_args)
+    if not yes:
+        click.confirm('Launch managed job?', default=True, abort=True)
+    job_id = jobs.launch(task, detach_run=detach_run)
+    click.echo(f'Managed job ID: {job_id}')
+
+
+@jobs_group.command(name='queue')
+def jobs_queue():
+    """List managed jobs."""
+    from skypilot_tpu import jobs  # pylint: disable=import-outside-toplevel
+    records = jobs.queue()
+    rows = [(r['job_id'], r['task_id'], r['job_name'], r['status'],
+             r['recovery_count']) for r in records]
+    _print_table(['ID', 'TASK', 'NAME', 'STATUS', 'RECOVERIES'], rows)
+
+
+@jobs_group.command(name='cancel')
+@click.argument('job_ids', nargs=-1, type=int)
+@click.option('--all', '-a', 'all_jobs', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_cancel(job_ids, all_jobs, yes):
+    """Cancel managed job(s)."""
+    from skypilot_tpu import jobs  # pylint: disable=import-outside-toplevel
+    if not job_ids and not all_jobs:
+        raise click.UsageError('Provide job ids or --all.')
+    if not yes:
+        click.confirm('Cancel managed job(s)?', default=True, abort=True)
+    cancelled = jobs.cancel(list(job_ids) or None, all_jobs=all_jobs)
+    click.echo(f'Cancellation requested for: {cancelled}')
+
+
+@jobs_group.command(name='logs')
+@click.argument('job_id', required=False, type=int)
+@click.option('--no-follow', is_flag=True, default=False)
+def jobs_logs(job_id, no_follow):
+    """Tail a managed job's logs."""
+    from skypilot_tpu import jobs  # pylint: disable=import-outside-toplevel
+    jobs.tail_logs(job_id, follow=not no_follow)
+
+
+# ------------------------------------------------------------ serve group
+
+
+@cli.group(name='serve')
+def serve_group():
+    """Autoscaled serving."""
+
+
+@serve_group.command(name='up')
+@click.argument('entrypoint')
+@click.option('--service-name', '-n', default=None)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_up(entrypoint, service_name, yes):
+    """Start a service from a task YAML with a `service:` section."""
+    from skypilot_tpu import serve  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu import task as task_lib  # pylint: disable=import-outside-toplevel
+    task = task_lib.Task.from_yaml(entrypoint)
+    if not yes:
+        click.confirm('Start service?', default=True, abort=True)
+    name, endpoint = serve.up(task, service_name)
+    click.echo(f'Service {name} starting; endpoint: {endpoint}')
+
+
+@serve_group.command(name='update')
+@click.argument('service_name')
+@click.argument('entrypoint')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_update(service_name, entrypoint, yes):
+    """Roll the service over to a new task YAML."""
+    from skypilot_tpu import serve  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu import task as task_lib  # pylint: disable=import-outside-toplevel
+    task = task_lib.Task.from_yaml(entrypoint)
+    if not yes:
+        click.confirm(f'Update service {service_name}?', default=True,
+                      abort=True)
+    version = serve.update(task, service_name)
+    click.echo(f'Service {service_name} updating to version {version}.')
+
+
+@serve_group.command(name='status')
+@click.argument('service_names', nargs=-1)
+def serve_status(service_names):
+    """Show services and their replicas."""
+    from skypilot_tpu import serve  # pylint: disable=import-outside-toplevel
+    records = serve.status(list(service_names) or None)
+    if not records:
+        click.echo('No services.')
+        return
+    rows = []
+    for r in records:
+        ready = sum(1 for rep in r['replicas']
+                    if rep['status'] == 'READY')
+        rows.append((r['name'], r['status'], r['version'],
+                     f'{ready}/{len(r["replicas"])}',
+                     r.get('load_balancer_port') or '-'))
+    _print_table(['NAME', 'STATUS', 'VERSION', 'READY', 'LB PORT'], rows)
+
+
+@serve_group.command(name='down')
+@click.argument('service_names', nargs=-1, required=True)
+@click.option('--purge', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_down(service_names, purge, yes):
+    """Stop service(s) and terminate replicas."""
+    from skypilot_tpu import serve  # pylint: disable=import-outside-toplevel
+    if not yes:
+        click.confirm(f'Tear down {", ".join(service_names)}?',
+                      default=True, abort=True)
+    for name in service_names:
+        serve.down(name, purge=purge)
+        click.echo(f'Service {name} torn down.')
+
+
+@serve_group.command(name='logs')
+@click.argument('service_name')
+@click.option('--replica-id', type=int, default=None)
+@click.option('--target', default='replica',
+              type=click.Choice(['replica', 'controller']))
+def serve_logs(service_name, replica_id, target):
+    """Show replica or controller logs."""
+    from skypilot_tpu import serve  # pylint: disable=import-outside-toplevel
+    serve.tail_logs(service_name, target=target, replica_id=replica_id)
+
+
+# ---------------------------------------------------------- storage group
+
+
+@cli.group(name='storage')
+def storage_group():
+    """Bucket-backed storage objects."""
+
+
+@storage_group.command(name='ls')
+def storage_ls():
+    """List storage objects."""
+    from skypilot_tpu import global_user_state  # pylint: disable=import-outside-toplevel
+    records = global_user_state.get_storage()
+    rows = [(r['name'], r['status'],
+             ', '.join(r['handle'].get('store_types', []))
+             if isinstance(r.get('handle'), dict) else '-')
+            for r in records]
+    _print_table(['NAME', 'STATUS', 'STORES'], rows)
+
+
+@storage_group.command(name='delete')
+@click.argument('names', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def storage_delete(names, yes):
+    """Delete storage objects (and their buckets)."""
+    from skypilot_tpu import global_user_state  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.data import storage as storage_lib  # pylint: disable=import-outside-toplevel
+    if not yes:
+        click.confirm(f'Delete storage {", ".join(names)}?',
+                      default=True, abort=True)
+    for name in names:
+        handle = global_user_state.get_handle_from_storage_name(name)
+        if handle is None:
+            click.echo(f'Storage {name} not found.', err=True)
+            continue
+        storage = storage_lib.Storage(
+            name=handle['name'], source=handle.get('source'),
+            mode=storage_lib.StorageMode(handle.get('mode', 'MOUNT')))
+        for stype in handle.get('store_types', []):
+            storage.stores[storage_lib.StoreType(stype)] = (
+                storage_lib._STORE_CLASSES[  # pylint: disable=protected-access
+                    storage_lib.StoreType(stype)](handle['name']))
+        storage.delete()
+        click.echo(f'Storage {name} deleted.')
+
+
+def main() -> None:
+    cli()
+
+
+if __name__ == '__main__':
+    main()

@@ -257,10 +257,16 @@ class Storage:
 
         self._source_prefix = ''
         if source and not _is_local(source):
+            # Bucket-URL source: the URL names the bucket.  An explicit
+            # `name` is only the Storage object's registry name — stores
+            # must still target the URL's bucket, never `name`.
             split = urllib.parse.urlsplit(source)
             self._source_prefix = split.path.strip('/')
+            self._bucket_name = split.netloc
             if name is None:
                 name = split.netloc
+        else:
+            self._bucket_name = name
         if name is None:
             raise exceptions.StorageSpecError(
                 'Storage requires a name (or a bucket-URL source).')
@@ -270,7 +276,8 @@ class Storage:
             stype = StoreType.from_url(source)
             if stype not in self.stores:
                 self.stores[stype] = _STORE_CLASSES[stype](
-                    self.name, source, prefix=self._source_prefix)
+                    self._bucket_name, source,
+                    prefix=self._source_prefix)
         elif source:
             expanded = os.path.expanduser(source)
             if not os.path.exists(expanded):
@@ -284,7 +291,7 @@ class Storage:
         if store_type in self.stores:
             return self.stores[store_type]
         kwargs = {'region': region} if region else {}
-        store = _STORE_CLASSES[store_type](self.name, self.source,
+        store = _STORE_CLASSES[store_type](self._bucket_name, self.source,
                                            prefix=self._source_prefix,
                                            **kwargs)
         store.create()
@@ -317,6 +324,7 @@ class Storage:
     def handle(self) -> Dict[str, Any]:
         return {
             'name': self.name,
+            'bucket': self._bucket_name,
             'source': self.source,
             'mode': self.mode.value,
             'persistent': self.persistent,
@@ -341,7 +349,7 @@ class Storage:
             stype = StoreType(store.upper())
             if stype not in storage.stores:
                 storage.stores[stype] = _STORE_CLASSES[stype](
-                    storage.name, storage.source,
+                    storage._bucket_name, storage.source,  # pylint: disable=protected-access
                     prefix=storage._source_prefix)  # pylint: disable=protected-access
         return storage
 

@@ -1,0 +1,20 @@
+"""Managed jobs: launch-and-forget with preemption auto-recovery.
+
+Parity: /root/reference/sky/jobs/ (core.py, controller.py,
+recovery_strategy.py, state.py) — a controller process supervises each
+job, relaunching its cluster on preemption/hardware loss and resuming
+from the framework checkpoint contract (which the reference leaves to
+user convention; SURVEY.md §5).
+
+TPU-first specifics: spot-TPU slices must be *deleted* before relaunch
+(a preempted TPU-VM lingers in a broken state — reference gcp.py:928-934
+behavior generalized), multi-host slices fail as a unit, and recovered
+tasks find their checkpoint dir pre-mounted (SKYTPU_CHECKPOINT_DIR).
+"""
+from skypilot_tpu.jobs.core import cancel
+from skypilot_tpu.jobs.core import launch
+from skypilot_tpu.jobs.core import queue
+from skypilot_tpu.jobs.core import tail_logs
+from skypilot_tpu.jobs.state import ManagedJobStatus
+
+__all__ = ['ManagedJobStatus', 'cancel', 'launch', 'queue', 'tail_logs']

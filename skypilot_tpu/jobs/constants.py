@@ -1,0 +1,18 @@
+"""Managed-jobs constants."""
+
+# Controller placement: 'process' runs the per-job controller as a local
+# daemon process (hermetic, no extra VM); 'cluster' launches a controller
+# cluster via the normal stack (parity with the reference's controller-VM
+# design, /root/reference/sky/jobs/core.py:33).
+CONTROLLER_MODE_KEY = ('jobs', 'controller', 'mode')
+DEFAULT_CONTROLLER_MODE = 'process'
+
+CONTROLLER_CLUSTER_NAME = 'skytpu-jobs-controller'
+
+# Seconds between monitor-loop status checks
+# (parity: reference jobs/utils.py JOB_STATUS_CHECK_GAP_SECONDS).
+JOB_STATUS_CHECK_GAP_SECONDS = 20.0
+# Initial delay before the first status check after (re)launch.
+JOB_STARTED_CHECK_GAP_SECONDS = 5.0
+
+ENV_MANAGED_JOB_ID = 'SKYTPU_MANAGED_JOB_ID'

@@ -1,0 +1,212 @@
+"""Per-job controller: supervise, detect preemption, recover.
+
+Parity: /root/reference/sky/jobs/controller.py:46-341 (JobsController —
+one process per managed job, running the DAG's tasks in order; a monitor
+loop classifies user failure vs preemption and triggers the recovery
+strategy).  Runnable directly:
+
+    python -m skypilot_tpu.jobs.controller --job-id N --dag-yaml PATH
+
+TPU specifics inherited from the strategy layer: preempted slices are
+terminated before relaunch; recovered tasks resume from the checkpoint
+contract (SKYTPU_CHECKPOINT_DIR / storage mounts travel with the task).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import time
+import traceback
+from typing import Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import status_lib
+from skypilot_tpu.jobs import constants
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import state
+from skypilot_tpu.skylet import job_lib
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import dag_utils
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _check_gap() -> float:
+    return float(
+        os.environ.get('SKYTPU_JOB_STATUS_CHECK_GAP',
+                       constants.JOB_STATUS_CHECK_GAP_SECONDS))
+
+
+def _started_gap() -> float:
+    return float(
+        os.environ.get('SKYTPU_JOB_STARTED_CHECK_GAP',
+                       constants.JOB_STARTED_CHECK_GAP_SECONDS))
+
+
+class JobsController:
+
+    def __init__(self, job_id: int, dag_yaml: str) -> None:
+        self.job_id = job_id
+        self.dag = dag_utils.load_chain_dag_from_yaml(dag_yaml)
+
+    # ------------------------------------------------------------ public
+
+    def run(self) -> None:
+        state.set_controller_pid(self.job_id, os.getpid())
+        try:
+            for task_id, task in enumerate(self.dag.tasks):
+                succeeded = self._run_one_task(task_id, task)
+                if not succeeded:
+                    # Remaining tasks in the chain never start.
+                    for later_id in range(task_id + 1, len(self.dag.tasks)):
+                        state.set_status(self.job_id, later_id,
+                                         state.ManagedJobStatus.CANCELLED)
+                    return
+        except exceptions.SkyTpuError as e:
+            logger.error(traceback.format_exc())
+            for task_id in range(len(self.dag.tasks)):
+                cur = self._task_status(task_id)
+                if cur is not None and not cur.is_terminal():
+                    state.set_status(
+                        self.job_id, task_id,
+                        state.ManagedJobStatus.FAILED_CONTROLLER,
+                        failure_reason=common_utils.format_exception(e))
+
+    def _task_status(self, task_id: int) -> Optional[state.ManagedJobStatus]:
+        for rec in state.get_job_records(self.job_id):
+            if rec['task_id'] == task_id:
+                return state.ManagedJobStatus(rec['status'])
+        return None
+
+    # ----------------------------------------------------------- workers
+
+    def _cluster_name(self, task_id: int, task) -> str:
+        base = task.name or 'task'
+        return f'{base}-{self.job_id}-{task_id}'
+
+    def _cancel_requested(self) -> bool:
+        status = state.get_status(self.job_id)
+        return status is state.ManagedJobStatus.CANCELLING
+
+    def _run_one_task(self, task_id: int, task) -> bool:
+        """Returns True iff the task SUCCEEDED."""
+        job_id = self.job_id
+        cluster_name = self._cluster_name(task_id, task)
+        state.set_cluster_name(job_id, task_id, cluster_name)
+        state.set_status(job_id, task_id, state.ManagedJobStatus.STARTING)
+        strategy = recovery_strategy.StrategyExecutor.make(
+            cluster_name, task)
+        try:
+            remote_job_id = strategy.launch()
+        except exceptions.ResourcesUnavailableError as e:
+            state.set_status(
+                job_id, task_id, state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                failure_reason=common_utils.format_exception(e))
+            return False
+        state.set_status(job_id, task_id, state.ManagedJobStatus.RUNNING)
+
+        time.sleep(_started_gap())
+        while True:
+            if self._cancel_requested():
+                strategy.cleanup_cluster()
+                state.set_status(job_id, task_id,
+                                 state.ManagedJobStatus.CANCELLED)
+                return False
+
+            job_status = self._query_job_status(cluster_name,
+                                                remote_job_id)
+            if job_status is job_lib.JobStatus.SUCCEEDED:
+                state.set_status(job_id, task_id,
+                                 state.ManagedJobStatus.SUCCEEDED)
+                strategy.cleanup_cluster()
+                return True
+            if job_status in (job_lib.JobStatus.FAILED,
+                              job_lib.JobStatus.FAILED_SETUP):
+                # User-code failure: bounded restarts, then fail the job
+                # (parity: reference controller.py max_restarts_on_errors).
+                if (strategy.restart_count_on_errors <
+                        strategy.max_restarts_on_errors):
+                    strategy.restart_count_on_errors += 1
+                    logger.info(
+                        f'user failure; restart '
+                        f'{strategy.restart_count_on_errors}/'
+                        f'{strategy.max_restarts_on_errors}')
+                    state.set_recovering(job_id, task_id)
+                    remote_job_id = strategy.recover()
+                    state.set_status(job_id, task_id,
+                                     state.ManagedJobStatus.RUNNING)
+                    continue
+                failed_status = (
+                    state.ManagedJobStatus.FAILED_SETUP
+                    if job_status is job_lib.JobStatus.FAILED_SETUP else
+                    state.ManagedJobStatus.FAILED)
+                state.set_status(
+                    job_id, task_id, failed_status,
+                    failure_reason='user code exited non-zero')
+                strategy.cleanup_cluster()
+                return False
+            if job_status is job_lib.JobStatus.CANCELLED:
+                state.set_status(job_id, task_id,
+                                 state.ManagedJobStatus.CANCELLED)
+                return False
+            if job_status is None:
+                # Cannot read the job queue: cluster preempted, hardware
+                # lost, or still in a transient state — reconcile with
+                # the cloud and recover (parity: reference
+                # controller.py:195-340 anomaly path).
+                cluster_status = self._query_cluster_status(cluster_name)
+                if cluster_status is not status_lib.ClusterStatus.UP:
+                    logger.info(
+                        f'cluster {cluster_name} is '
+                        f'{cluster_status}; recovering')
+                    state.set_recovering(job_id, task_id)
+                    try:
+                        remote_job_id = strategy.recover()
+                    except exceptions.ResourcesUnavailableError as e:
+                        state.set_status(
+                            job_id, task_id,
+                            state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                            failure_reason=common_utils.format_exception(
+                                e))
+                        return False
+                    state.set_status(job_id, task_id,
+                                     state.ManagedJobStatus.RUNNING)
+            time.sleep(_check_gap())
+
+    # ------------------------------------------------------------ helpers
+
+    def _query_job_status(self, cluster_name: str,
+                          remote_job_id: Optional[int]):
+        from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+        try:
+            statuses = core.job_status(cluster_name, [remote_job_id]
+                                       if remote_job_id else None)
+            if not statuses:
+                return None
+            value = next(iter(statuses.values()))
+            return job_lib.JobStatus(value) if value else None
+        except exceptions.SkyTpuError:
+            return None
+
+    def _query_cluster_status(self, cluster_name: str):
+        from skypilot_tpu.backends import backend_utils  # pylint: disable=import-outside-toplevel
+        try:
+            record = backend_utils.refresh_cluster_record(cluster_name)
+        except exceptions.SkyTpuError:
+            return None
+        if record is None:
+            return None
+        return record['status']
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--job-id', type=int, required=True)
+    parser.add_argument('--dag-yaml', type=str, required=True)
+    args = parser.parse_args()
+    JobsController(args.job_id, args.dag_yaml).run()
+
+
+if __name__ == '__main__':
+    main()

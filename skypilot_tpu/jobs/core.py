@@ -1,0 +1,182 @@
+"""Managed-jobs client API: launch / queue / cancel / tail_logs.
+
+Parity: /root/reference/sky/jobs/core.py:33 (launch wraps the user DAG
+into a controller task).  Controller placement is configurable
+(jobs.constants):
+
+- 'process' (default): the per-job controller runs as a detached local
+  daemon — hermetic, no extra VM, same supervision semantics.
+- 'cluster': a controller cluster is launched through the normal stack
+  and runs the identical controller module (reference behavior with the
+  controller VM; the task ships the DAG YAML as a file mount).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import constants
+from skypilot_tpu.jobs import state
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import dag_utils
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _dag_yaml_dir() -> str:
+    return common_utils.ensure_dir(
+        os.path.join(common_utils.skytpu_home(), 'managed_jobs'))
+
+
+def launch(entrypoint: Union[task_lib.Task, 'Any'],
+           name: Optional[str] = None,
+           *,
+           detach_run: bool = True) -> int:
+    """Submit a managed job; returns the managed job id.
+
+    The DAG may be a chain (task_a >> task_b); each task runs on its own
+    cluster under the controller's supervision.
+    """
+    dag = dag_utils.convert_entrypoint_to_dag(entrypoint)
+    if not dag.is_chain():
+        raise exceptions.InvalidTaskError(
+            'Managed jobs support single tasks or chain DAGs.')
+    job_name = name or dag.name or dag.tasks[0].name or 'managed-job'
+
+    for task in dag.tasks:
+        task._validate()  # pylint: disable=protected-access
+
+    job_id = state.next_job_id()
+    yaml_path = os.path.join(_dag_yaml_dir(), f'{job_name}-{job_id}.yaml')
+    dag_utils.dump_chain_dag_to_yaml(dag, yaml_path)
+    state.submit_job(job_id, job_name, yaml_path,
+                     [t.name or f'task-{i}'
+                      for i, t in enumerate(dag.tasks)])
+    state.set_status(job_id, 0, state.ManagedJobStatus.SUBMITTED)
+
+    mode = config_lib.get_nested(constants.CONTROLLER_MODE_KEY,
+                                 constants.DEFAULT_CONTROLLER_MODE)
+    if mode == 'process':
+        _start_controller_process(job_id, yaml_path)
+    elif mode == 'cluster':
+        _launch_controller_cluster(job_id, job_name, yaml_path)
+    else:
+        raise exceptions.InvalidSkyTpuConfigError(
+            f'jobs.controller.mode must be process|cluster, got {mode!r}')
+
+    logger.info(f'Managed job {job_id} ({job_name}) submitted '
+                f'(controller mode: {mode}).')
+    if not detach_run:
+        _wait_for_terminal(job_id)
+    return job_id
+
+
+def _start_controller_process(job_id: int, yaml_path: str) -> None:
+    env = dict(os.environ)
+    env[constants.ENV_MANAGED_JOB_ID] = str(job_id)
+    log_dir = common_utils.ensure_dir(
+        os.path.join(common_utils.skytpu_home(), 'managed_jobs', 'logs'))
+    log_path = os.path.join(log_dir, f'controller-{job_id}.log')
+    with open(log_path, 'ab') as log_f:
+        proc = subprocess.Popen(  # pylint: disable=consider-using-with
+            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+             '--job-id', str(job_id), '--dag-yaml', yaml_path],
+            stdout=log_f, stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL, env=env,
+            start_new_session=True)
+    state.set_controller_pid(job_id, proc.pid)
+
+
+def _launch_controller_cluster(job_id: int, job_name: str,
+                               yaml_path: str) -> None:
+    from skypilot_tpu import execution  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu import resources as resources_lib  # pylint: disable=import-outside-toplevel
+    remote_yaml = f'~/.skytpu/managed_jobs/{job_name}-{job_id}.yaml'
+    controller_task = task_lib.Task(
+        name=f'jobs-controller-{job_id}',
+        run=(f'python -m skypilot_tpu.jobs.controller '
+             f'--job-id {job_id} --dag-yaml {remote_yaml}'),
+        file_mounts={remote_yaml: yaml_path},
+    )
+    controller_task.set_resources(
+        resources_lib.Resources(cpus='4+', memory='8+'))
+    execution.launch(controller_task,
+                     cluster_name=constants.CONTROLLER_CLUSTER_NAME,
+                     stream_logs=False, detach_run=True)
+
+
+def _wait_for_terminal(job_id: int, poll: float = 2.0) -> None:
+    while True:
+        status = state.get_status(job_id)
+        if status is None or status.is_terminal():
+            return
+        time.sleep(poll)
+
+
+def queue(refresh: bool = False,
+          job_ids: Optional[List[int]] = None) -> List[Dict[str, Any]]:
+    """All managed-job records (newest first).
+
+    Parity: reference jobs/core.py queue().
+    """
+    del refresh  # state is local; nothing to refresh yet
+    records = state.get_job_records()
+    if job_ids is not None:
+        records = [r for r in records if r['job_id'] in job_ids]
+    return records
+
+
+def cancel(job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    """Request cancellation; the controller tears down the task cluster
+    and marks CANCELLED."""
+    if all_jobs:
+        job_ids = state.get_nonterminal_job_ids()
+    if not job_ids:
+        return []
+    cancelled = []
+    for job_id in job_ids:
+        status = state.get_status(job_id)
+        if status is None or status.is_terminal():
+            continue
+        for rec in state.get_job_records(job_id):
+            if not state.ManagedJobStatus(rec['status']).is_terminal():
+                state.set_status(job_id, rec['task_id'],
+                                 state.ManagedJobStatus.CANCELLING)
+        cancelled.append(job_id)
+    return cancelled
+
+
+def tail_logs(job_id: Optional[int] = None, follow: bool = True) -> None:
+    """Tail the job's task-cluster logs (falls back to the controller
+    log before the first cluster exists)."""
+    from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+    if job_id is None:
+        ids = [r['job_id'] for r in state.get_job_records()]
+        if not ids:
+            raise exceptions.ManagedJobStatusError('No managed jobs.')
+        job_id = max(ids)
+    records = state.get_job_records(job_id)
+    if not records:
+        raise exceptions.ManagedJobStatusError(
+            f'No managed job with id {job_id}.')
+    active = [r for r in records if r['cluster_name']]
+    if active:
+        rec = active[-1]
+        try:
+            core.tail_logs(rec['cluster_name'], follow=follow)
+            return
+        except exceptions.SkyTpuError:
+            pass
+    log_path = os.path.join(common_utils.skytpu_home(), 'managed_jobs',
+                            'logs', f'controller-{job_id}.log')
+    if os.path.exists(log_path):
+        with open(log_path, encoding='utf-8', errors='replace') as f:
+            print(f.read(), end='')

@@ -1,0 +1,149 @@
+"""Recovery strategies: how a managed job's cluster is (re)launched.
+
+Parity: /root/reference/sky/jobs/recovery_strategy.py
+(StrategyExecutor.make registry :63-126, FAILOVER :395,
+EAGER_NEXT_REGION :483).  TPU-first: before any relaunch of a
+preempted/broken slice the old capacity is *terminated* — a preempted
+TPU-VM lingers in an unusable state and a multi-host slice fails as a
+unit (reference cleans up spot TPUs specially, gcp.py:928-934; here it
+is the default for every recovery).
+"""
+from __future__ import annotations
+
+import time
+import typing
+from typing import Dict, Optional, Type
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.jobs import constants
+from skypilot_tpu.utils import common_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+RECOVERY_STRATEGIES: Dict[str, Type['StrategyExecutor']] = {}
+DEFAULT_RECOVERY_STRATEGY = 'EAGER_NEXT_REGION'
+
+# Max consecutive launch failures before giving up a recovery attempt
+# entirely (parity: reference MAX_JOB_CHECKING_RETRY).
+_MAX_LAUNCH_RETRY = 3
+_RETRY_GAP_SECONDS = 2.0
+
+
+def _register(name: str):
+
+    def deco(cls):
+        RECOVERY_STRATEGIES[name] = cls
+        cls.NAME = name
+        return cls
+
+    return deco
+
+
+class StrategyExecutor:
+    """Launch / recover one task's cluster."""
+
+    NAME = 'base'
+
+    def __init__(self, cluster_name: str, task: 'task_lib.Task',
+                 retry_until_up: bool = True,
+                 max_restarts_on_errors: int = 0) -> None:
+        self.cluster_name = cluster_name
+        self.task = task
+        self.retry_until_up = retry_until_up
+        self.max_restarts_on_errors = max_restarts_on_errors
+        self.restart_count_on_errors = 0
+
+    @classmethod
+    def make(cls, cluster_name: str,
+             task: 'task_lib.Task') -> 'StrategyExecutor':
+        """Pick the strategy from the task's resources.job_recovery."""
+        names = set()
+        for resources in task.resources:
+            recovery = resources.job_recovery
+            if recovery:
+                names.add(str(recovery).upper())
+        if len(names) > 1:
+            raise exceptions.InvalidTaskError(
+                f'All resources options must share one job_recovery '
+                f'strategy, got {sorted(names)}')
+        name = names.pop() if names else DEFAULT_RECOVERY_STRATEGY
+        if name not in RECOVERY_STRATEGIES:
+            raise exceptions.InvalidTaskError(
+                f'Unknown job_recovery strategy {name!r}; have '
+                f'{sorted(RECOVERY_STRATEGIES)}')
+        return RECOVERY_STRATEGIES[name](cluster_name, task)
+
+    # ------------------------------------------------------------ launch
+
+    def launch(self) -> Optional[int]:
+        """First launch; returns the job id on the task cluster."""
+        return self._launch(prefer_same_region=False)
+
+    def recover(self) -> Optional[int]:
+        """Tear down broken capacity, then relaunch per strategy."""
+        raise NotImplementedError
+
+    def cleanup_cluster(self) -> None:
+        """Terminate the task cluster (idempotent; slices are
+        all-or-nothing so partial teardown is never kept)."""
+        from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+        try:
+            core.down(self.cluster_name)
+        except (exceptions.ClusterNotUpError, ValueError):
+            pass
+        except exceptions.SkyTpuError as e:
+            logger.warning(
+                f'cleanup of {self.cluster_name} failed (will still '
+                f'relaunch): {common_utils.format_exception(e)}')
+
+    def _launch(self, prefer_same_region: bool,
+                raise_on_failure: bool = True) -> Optional[int]:
+        from skypilot_tpu import execution  # pylint: disable=import-outside-toplevel
+        del prefer_same_region  # used by subclasses via task mutation
+        backoff = common_utils.Backoff(_RETRY_GAP_SECONDS)
+        for attempt in range(_MAX_LAUNCH_RETRY):
+            try:
+                job_id = execution.launch(
+                    self.task, cluster_name=self.cluster_name,
+                    stream_logs=False, detach_run=True,
+                    retry_until_up=self.retry_until_up)
+                return job_id
+            except exceptions.ResourcesUnavailableError as e:
+                if raise_on_failure and attempt == _MAX_LAUNCH_RETRY - 1:
+                    raise
+                logger.info(f'launch attempt {attempt + 1} failed: '
+                            f'{common_utils.format_exception(e)}')
+                time.sleep(backoff.current_backoff())
+        return None
+
+
+@_register('EAGER_NEXT_REGION')
+class EagerNextRegionStrategy(StrategyExecutor):
+    """On recovery, immediately re-optimize across regions/zones (the
+    preempting region is likely still capacity-starved).  Default —
+    parity: reference recovery_strategy.py:483."""
+
+    def recover(self) -> Optional[int]:
+        self.cleanup_cluster()
+        # Drop any region/zone pinning learned from the previous launch
+        # so the optimizer searches the full space again.
+        return self._launch(prefer_same_region=False)
+
+
+@_register('FAILOVER')
+class FailoverStrategy(StrategyExecutor):
+    """On recovery, first retry in the same region (cheap if transient),
+    then fall back to the full search.  Parity: reference
+    recovery_strategy.py:395."""
+
+    def recover(self) -> Optional[int]:
+        self.cleanup_cluster()
+        job_id = self._launch(prefer_same_region=True,
+                              raise_on_failure=False)
+        if job_id is not None:
+            return job_id
+        return self._launch(prefer_same_region=False)

@@ -1,0 +1,202 @@
+"""Managed-job state machine + sqlite store (lives on the controller).
+
+Parity: /root/reference/sky/jobs/state.py:151 (ManagedJobStatus) and its
+spot_jobs sqlite schema.  One row per (job_id, task_id) so chain DAGs
+report per-task progress.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import pathlib
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import common_utils
+
+
+class ManagedJobStatus(enum.Enum):
+    PENDING = 'PENDING'
+    SUBMITTED = 'SUBMITTED'
+    STARTING = 'STARTING'
+    RUNNING = 'RUNNING'
+    RECOVERING = 'RECOVERING'
+    SUCCEEDED = 'SUCCEEDED'
+    CANCELLING = 'CANCELLING'
+    CANCELLED = 'CANCELLED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_PRECHECKS = 'FAILED_PRECHECKS'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    def is_failed(self) -> bool:
+        return self in _FAILED
+
+    @classmethod
+    def terminal_statuses(cls) -> List['ManagedJobStatus']:
+        return list(_TERMINAL)
+
+    def colored_str(self) -> str:
+        color = {
+            ManagedJobStatus.RUNNING: '\x1b[32m',
+            ManagedJobStatus.SUCCEEDED: '\x1b[32m',
+            ManagedJobStatus.RECOVERING: '\x1b[36m',
+            ManagedJobStatus.CANCELLED: '\x1b[90m',
+            ManagedJobStatus.CANCELLING: '\x1b[90m',
+        }.get(self, '\x1b[33m' if not self.is_failed() else '\x1b[31m')
+        return f'{color}{self.value}\x1b[0m'
+
+
+_TERMINAL = {
+    ManagedJobStatus.SUCCEEDED, ManagedJobStatus.CANCELLED,
+    ManagedJobStatus.FAILED, ManagedJobStatus.FAILED_SETUP,
+    ManagedJobStatus.FAILED_PRECHECKS, ManagedJobStatus.FAILED_NO_RESOURCE,
+    ManagedJobStatus.FAILED_CONTROLLER,
+}
+_FAILED = {
+    ManagedJobStatus.FAILED, ManagedJobStatus.FAILED_SETUP,
+    ManagedJobStatus.FAILED_PRECHECKS, ManagedJobStatus.FAILED_NO_RESOURCE,
+    ManagedJobStatus.FAILED_CONTROLLER,
+}
+
+_CREATE = """\
+CREATE TABLE IF NOT EXISTS managed_jobs (
+    job_id INTEGER,
+    task_id INTEGER DEFAULT 0,
+    job_name TEXT,
+    task_name TEXT,
+    status TEXT,
+    submitted_at REAL,
+    start_at REAL,
+    end_at REAL,
+    last_recovered_at REAL DEFAULT -1,
+    recovery_count INTEGER DEFAULT 0,
+    failure_reason TEXT,
+    cluster_name TEXT,
+    run_timestamp TEXT,
+    controller_pid INTEGER,
+    dag_yaml_path TEXT,
+    PRIMARY KEY (job_id, task_id)
+)"""
+
+
+def _db_path() -> str:
+    path = os.environ.get('SKYTPU_MANAGED_JOB_DB')
+    if path is None:
+        path = os.path.join(common_utils.skytpu_home(), 'managed_jobs.db')
+    pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
+    return path
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn.execute(_CREATE)
+    return conn
+
+
+def next_job_id() -> int:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT MAX(job_id) FROM managed_jobs').fetchone()
+        return (row[0] or 0) + 1
+
+
+def submit_job(job_id: int, job_name: str, dag_yaml_path: str,
+               task_names: List[str]) -> None:
+    with _conn() as conn:
+        for task_id, task_name in enumerate(task_names):
+            conn.execute(
+                'INSERT OR REPLACE INTO managed_jobs '
+                '(job_id, task_id, job_name, task_name, status, '
+                'submitted_at, dag_yaml_path) VALUES (?,?,?,?,?,?,?)',
+                (job_id, task_id, job_name, task_name,
+                 ManagedJobStatus.PENDING.value, time.time(),
+                 dag_yaml_path))
+
+
+def set_status(job_id: int, task_id: int, status: ManagedJobStatus,
+               failure_reason: Optional[str] = None) -> None:
+    sets = ['status=?']
+    vals: List[Any] = [status.value]
+    if status is ManagedJobStatus.RUNNING:
+        sets.append('start_at=COALESCE(start_at, ?)')
+        vals.append(time.time())
+    if status.is_terminal():
+        sets.append('end_at=?')
+        vals.append(time.time())
+    if failure_reason is not None:
+        sets.append('failure_reason=?')
+        vals.append(failure_reason)
+    vals += [job_id, task_id]
+    with _conn() as conn:
+        conn.execute(
+            f'UPDATE managed_jobs SET {", ".join(sets)} '
+            'WHERE job_id=? AND task_id=?', vals)
+
+
+def set_recovering(job_id: int, task_id: int) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE managed_jobs SET status=?, recovery_count='
+            'recovery_count+1, last_recovered_at=? '
+            'WHERE job_id=? AND task_id=?',
+            (ManagedJobStatus.RECOVERING.value, time.time(), job_id,
+             task_id))
+
+
+def set_cluster_name(job_id: int, task_id: int,
+                     cluster_name: str) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE managed_jobs SET cluster_name=? '
+            'WHERE job_id=? AND task_id=?', (cluster_name, job_id, task_id))
+
+
+def set_controller_pid(job_id: int, pid: int) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE managed_jobs SET controller_pid=? '
+                     'WHERE job_id=?', (pid, job_id))
+
+
+def get_status(job_id: int) -> Optional[ManagedJobStatus]:
+    """Aggregate status over the job's tasks (first non-terminal, else
+    worst terminal)."""
+    records = get_job_records(job_id)
+    if not records:
+        return None
+    statuses = [ManagedJobStatus(r['status']) for r in records]
+    for s in statuses:
+        if not s.is_terminal():
+            return s
+    for s in statuses:
+        if s.is_failed() or s is ManagedJobStatus.CANCELLED:
+            return s
+    return statuses[-1]
+
+
+def get_job_records(job_id: Optional[int] = None) -> List[Dict[str, Any]]:
+    query = 'SELECT * FROM managed_jobs'
+    vals: tuple = ()
+    if job_id is not None:
+        query += ' WHERE job_id=?'
+        vals = (job_id,)
+    query += ' ORDER BY job_id DESC, task_id ASC'
+    with _conn() as conn:
+        conn.row_factory = sqlite3.Row
+        rows = conn.execute(query, vals).fetchall()
+    return [dict(r) for r in rows]
+
+
+def get_nonterminal_job_ids() -> List[int]:
+    terminal = [s.value for s in ManagedJobStatus.terminal_statuses()]
+    q = ','.join('?' * len(terminal))
+    with _conn() as conn:
+        rows = conn.execute(
+            f'SELECT DISTINCT job_id FROM managed_jobs '
+            f'WHERE status NOT IN ({q})', terminal).fetchall()
+    return [r[0] for r in rows]

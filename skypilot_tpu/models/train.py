@@ -88,9 +88,10 @@ def create_train_state(cfg: ModelConfig,
     return state, shardings
 
 
-def train_step(state: TrainState, batch, *, mesh=None):
+def train_step(state: TrainState, batch):
     """One optimizer step. batch = {'tokens': [b,s+1] int32} or
-    {'inputs','targets'}.  Call under jit (see jit_train_step)."""
+    {'inputs','targets'}.  Call under jit (see jit_train_step) —
+    placement comes from the jit in/out shardings, not from here."""
     if 'tokens' in batch:
         inputs = batch['tokens'][:, :-1]
         targets = batch['tokens'][:, 1:]
@@ -108,12 +109,13 @@ def train_step(state: TrainState, batch, *, mesh=None):
     return new_state, metrics
 
 
-def jit_train_step(mesh, state_shardings, batch_sharding):
-    """jit train_step with explicit in/out shardings for the mesh."""
+def jit_train_step(state_shardings, batch_sharding):
+    """jit train_step with explicit in/out shardings (the NamedShardings
+    carry their mesh)."""
 
     def _step(state, batch):
         with nn.logical_axis_rules(LOGICAL_AXIS_RULES):
-            return train_step(state, batch, mesh=mesh)
+            return train_step(state, batch)
 
     return jax.jit(
         _step,

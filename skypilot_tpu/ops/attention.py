@@ -95,7 +95,8 @@ def _blockwise_attention(q, k, v, *, causal: bool, sm_scale: float,
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
-                      causal: bool, block_k: int, k_len: int):
+                      causal: bool, block_k: int, k_len: int,
+                      pos_offset: int):
     """One (batch*head, q_block) program: stream k/v blocks through VMEM.
 
     Refs: q [1, block_q, d]; k/v [1, k_len_padded, d]; o [1, block_q, d]
@@ -106,7 +107,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
     _, block_q, d = q_ref.shape
     q_blk_idx = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale
-    qpos = q_blk_idx * block_q + jax.lax.broadcasted_iota(
+    # pos_offset = k_len - q_len aligns the causal diagonal when q is a
+    # suffix of the kv sequence (decode-style q_len < k_len), matching
+    # mha_reference/_blockwise_attention.
+    qpos = pos_offset + q_blk_idx * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
 
     num_k_blocks = pl.cdiv(k_len, block_k)
@@ -114,7 +118,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
         # Skip k-blocks strictly above the diagonal for this q-block.
         num_k_blocks = jnp.minimum(
             num_k_blocks,
-            pl.cdiv((q_blk_idx + 1) * block_q, block_k))
+            pl.cdiv(pos_offset + (q_blk_idx + 1) * block_q, block_k))
 
     def body(kb, carry):
         o, m, l = carry
@@ -168,7 +172,8 @@ def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
 
     grid = (b * h, (q_len + q_pad) // block_q)
     kernel = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
-                               causal=causal, block_k=block_k, k_len=k_len)
+                               causal=causal, block_k=block_k, k_len=k_len,
+                               pos_offset=k_len - q_len)
     out = pl.pallas_call(
         kernel,
         grid=grid,

@@ -89,7 +89,19 @@ def ring_attention(q, k, v, *, mesh, axis_name: str = 'sequence',
         sm_scale = float(q.shape[-1]) ** -0.5
     from jax.experimental.shard_map import shard_map  # pylint: disable=import-outside-toplevel
     P = jax.sharding.PartitionSpec
-    spec = P(None, None, axis_name, None)
+
+    # Keep batch on the data axes and heads on the tensor axis — only
+    # the sequence dim participates in the ring.  Replicating them here
+    # would force all-gathers and redundant compute across every
+    # non-sequence mesh axis.
+    def _axes(*names):
+        present = tuple(a for a in names if a in mesh.axis_names and
+                        mesh.shape[a] > 1)
+        return present if present else None
+
+    batch_axes = _axes('data', 'fsdp')
+    head_axes = _axes('tensor')
+    spec = P(batch_axes, head_axes, axis_name, None)
     fn = functools.partial(_ring_attention_sharded, axis_name=axis_name,
                            sm_scale=float(sm_scale), causal=causal)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),

@@ -11,7 +11,6 @@ yourself" contract (/root/reference/sky/backends/cloud_vm_ray_backend.py:
 from __future__ import annotations
 
 import os
-from typing import Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.skylet import constants
@@ -45,12 +44,6 @@ def initialize_from_env(*, force: bool = False) -> bool:
     logger.info(f'jax.distributed up: rank {rank}/{num_hosts} '
                 f'coordinator {coordinator}')
     return True
-
-
-def task_checkpoint_dir() -> Optional[str]:
-    """The per-task checkpoint dir handed to user code (auto-resume
-    contract; SURVEY.md §5 checkpoint/resume)."""
-    return os.environ.get(constants.ENV_CHECKPOINT_DIR)
 
 
 def num_slices() -> int:

@@ -1,0 +1,17 @@
+"""SkyServe-equivalent: autoscaled serving on TPU slices.
+
+Parity: /root/reference/sky/serve/ (controller, load balancer, replica
+manager, autoscalers, service spec) — replicas are slice-clusters
+launched through the normal stack; the control plane (controller + LB)
+runs as local daemon processes or on a controller cluster, mirroring
+the reference's controller-VM design (serve/service.py).
+"""
+from skypilot_tpu.serve.core import down
+from skypilot_tpu.serve.core import status
+from skypilot_tpu.serve.core import tail_logs
+from skypilot_tpu.serve.core import up
+from skypilot_tpu.serve.core import update
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+__all__ = ['SkyServiceSpec', 'down', 'status', 'tail_logs', 'up',
+           'update']

@@ -1,0 +1,109 @@
+"""Autoscalers: decide the target replica count from request rate.
+
+Parity: /root/reference/sky/serve/autoscalers.py:145-530
+(RequestRateAutoscaler with upscale/downscale hysteresis,
+FallbackRequestRateAutoscaler mixing spot + on-demand).  Pure logic —
+no clock or cluster access — so it is directly unit-testable; the
+controller owns time and actuation.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import typing
+from typing import List, Optional
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+# Window over which QPS is measured (parity: reference
+# autoscalers.py qps_window_size).
+QPS_WINDOW_SIZE_SECONDS = 60.0
+
+
+@dataclasses.dataclass
+class AutoscalerDecision:
+    target_num_replicas: int
+    # For the fallback autoscaler: how many of the target should be
+    # on-demand (the rest spot).
+    num_ondemand: int = 0
+
+
+class RequestRateAutoscaler:
+    """Scale to ceil(qps / target_qps_per_replica) with hysteresis."""
+
+    def __init__(self, spec: 'SkyServiceSpec') -> None:
+        self.min_replicas = spec.min_replicas
+        self.max_replicas = spec.max_replicas
+        self.target_qps_per_replica = spec.target_qps_per_replica
+        self.upscale_delay_seconds = spec.upscale_delay_seconds
+        self.downscale_delay_seconds = spec.downscale_delay_seconds
+        self.target_num_replicas = spec.min_replicas
+        self._upscale_since: Optional[float] = None
+        self._downscale_since: Optional[float] = None
+        self.request_timestamps: List[float] = []
+
+    # ------------------------------------------------------------- inputs
+
+    def collect_request_information(self, timestamps: List[float],
+                                    now: float) -> None:
+        self.request_timestamps.extend(timestamps)
+        cutoff = now - QPS_WINDOW_SIZE_SECONDS
+        self.request_timestamps = [t for t in self.request_timestamps
+                                   if t >= cutoff]
+
+    def _desired_from_qps(self, now: float) -> int:
+        if self.target_qps_per_replica is None:
+            return self.target_num_replicas
+        qps = len(self.request_timestamps) / QPS_WINDOW_SIZE_SECONDS
+        desired = math.ceil(qps / self.target_qps_per_replica)
+        return max(self.min_replicas,
+                   min(self.max_replicas, desired))
+
+    # ----------------------------------------------------------- decision
+
+    def evaluate_scaling(self, now: float) -> AutoscalerDecision:
+        desired = self._desired_from_qps(now)
+        if desired > self.target_num_replicas:
+            self._downscale_since = None
+            if self._upscale_since is None:
+                self._upscale_since = now
+            if now - self._upscale_since >= self.upscale_delay_seconds:
+                self.target_num_replicas = desired
+                self._upscale_since = None
+        elif desired < self.target_num_replicas:
+            self._upscale_since = None
+            if self._downscale_since is None:
+                self._downscale_since = now
+            if now - self._downscale_since >= self.downscale_delay_seconds:
+                self.target_num_replicas = desired
+                self._downscale_since = None
+        else:
+            self._upscale_since = None
+            self._downscale_since = None
+        return AutoscalerDecision(self.target_num_replicas)
+
+
+class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
+    """Spot replicas with an on-demand safety base: keep
+    `base_ondemand_fallback_replicas` on-demand replicas regardless of
+    scale; the remainder of the target rides spot capacity.
+
+    Parity: reference autoscalers.py:480-530.
+    """
+
+    def __init__(self, spec: 'SkyServiceSpec') -> None:
+        super().__init__(spec)
+        self.base_ondemand = spec.base_ondemand_fallback_replicas
+
+    def evaluate_scaling(self, now: float) -> AutoscalerDecision:
+        decision = super().evaluate_scaling(now)
+        decision.num_ondemand = min(self.base_ondemand,
+                                    decision.target_num_replicas)
+        return decision
+
+
+def make_autoscaler(spec: 'SkyServiceSpec') -> RequestRateAutoscaler:
+    if spec.base_ondemand_fallback_replicas > 0:
+        return FallbackRequestRateAutoscaler(spec)
+    return RequestRateAutoscaler(spec)

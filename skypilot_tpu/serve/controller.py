@@ -1,0 +1,203 @@
+"""SkyServe controller: autoscaler loop + replica reconciliation + a
+small HTTP control endpoint the load balancer syncs against.
+
+Parity: /root/reference/sky/serve/controller.py:36-145
+(SkyServeController: autoscaler loop :64-96; endpoints
+/controller/load_balancer_sync, /update_service, /terminate_replica).
+Built on stdlib ThreadingHTTPServer (no ASGI dependency; the control
+plane is not a hot path — replicas serve the traffic).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+from http.server import ThreadingHTTPServer
+from typing import Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _sync_interval() -> float:
+    return float(os.environ.get('SKYTPU_SERVE_SYNC_INTERVAL', '20'))
+
+
+class SkyServeController:
+
+    def __init__(self, service_name: str, port: int = 0) -> None:
+        self.service_name = service_name
+        record = serve_state.get_service(service_name)
+        assert record is not None, f'service {service_name} not in state'
+        self.spec = SkyServiceSpec.from_yaml_config(record['spec'])
+        self.version = record['version']
+        task = task_lib.Task.from_yaml(record['task_yaml_path'])
+        self.replica_manager = replica_managers.ReplicaManager(
+            service_name, self.spec, task, version=self.version)
+        self.autoscaler = autoscalers.make_autoscaler(self.spec)
+        self.port = port
+        self._stop = threading.Event()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # -------------------------------------------------------- HTTP control
+
+    def _make_handler(self):
+        controller = self
+
+        class Handler(BaseHTTPRequestHandler):
+
+            def log_message(self, *args):  # quiet
+                del args
+
+            def _json(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == '/controller/load_balancer_sync':
+                    self._json(200, {
+                        'ready_replica_urls':
+                            controller.replica_manager.ready_urls()})
+                else:
+                    self._json(404, {'error': 'unknown path'})
+
+            def do_POST(self):
+                length = int(self.headers.get('Content-Length', 0))
+                data = json.loads(self.rfile.read(length) or b'{}')
+                if self.path == '/controller/load_balancer_sync':
+                    controller.autoscaler.collect_request_information(
+                        data.get('request_timestamps', []), time.time())
+                    self._json(200, {
+                        'ready_replica_urls':
+                            controller.replica_manager.ready_urls()})
+                elif self.path == '/controller/update_service':
+                    controller.reload_version()
+                    self._json(200, {'version': controller.version})
+                elif self.path == '/controller/terminate':
+                    controller.stop()
+                    self._json(200, {'ok': True})
+                else:
+                    self._json(404, {'error': 'unknown path'})
+
+        return Handler
+
+    def start_http(self) -> int:
+        self._httpd = ThreadingHTTPServer(('127.0.0.1', self.port),
+                                          self._make_handler())
+        self.port = self._httpd.server_port
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        return self.port
+
+    # ------------------------------------------------------ rolling update
+
+    def reload_version(self) -> None:
+        record = serve_state.get_service(self.service_name)
+        if record is None or record['version'] == self.version:
+            return
+        self.version = record['version']
+        self.spec = SkyServiceSpec.from_yaml_config(record['spec'])
+        task = task_lib.Task.from_yaml(record['task_yaml_path'])
+        self.replica_manager.set_version(self.spec, task, self.version)
+        self.autoscaler = autoscalers.make_autoscaler(self.spec)
+        logger.info(f'service {self.service_name} updated to '
+                    f'version {self.version}')
+
+    def _rolling_replace_outdated(self) -> None:
+        """Replace at most one outdated replica per pass, and only when
+        a newer-version replica is READY to take the traffic (rolling
+        update; parity: reference UpdateMode.ROLLING)."""
+        replicas = self.replica_manager.active_replicas()
+        outdated = [r for r in replicas if r['version'] < self.version]
+        if not outdated:
+            return
+        current_ready = [
+            r for r in replicas
+            if r['version'] == self.version and
+            r['status'] == ReplicaStatus.READY.value]
+        current = [r for r in replicas if r['version'] == self.version]
+        target = self.autoscaler.target_num_replicas
+        if len(current) < target:
+            return  # new-version capacity still coming up
+        if current_ready:
+            self.replica_manager.scale_down(outdated[0]['replica_id'])
+
+    # ---------------------------------------------------------- main loop
+
+    def reconcile_once(self) -> None:
+        self.reload_version()
+        self.replica_manager.sync()
+        decision = self.autoscaler.evaluate_scaling(time.time())
+        replicas = self.replica_manager.active_replicas()
+        current_version = [r for r in replicas
+                           if r['version'] >= self.version]
+        n_active = len(current_version)
+        if n_active < decision.target_num_replicas:
+            # Spot/on-demand mix: the first `num_ondemand` replicas are
+            # on-demand, the rest spot (None = as the task asked).
+            use_spot: Optional[bool] = None
+            if decision.num_ondemand > 0:
+                n_ondemand = sum(
+                    1 for r in current_version if not r['is_spot'])
+                use_spot = n_ondemand >= decision.num_ondemand
+            for _ in range(decision.target_num_replicas - n_active):
+                self.replica_manager.scale_up(use_spot=use_spot)
+        elif n_active > decision.target_num_replicas:
+            extra = n_active - decision.target_num_replicas
+            # Retire not-ready first, then newest.
+            candidates = sorted(
+                current_version,
+                key=lambda r: (r['status'] == ReplicaStatus.READY.value,
+                               r['replica_id']))
+            for replica in candidates[:extra]:
+                self.replica_manager.scale_down(replica['replica_id'])
+        self._rolling_replace_outdated()
+        self._update_service_status()
+
+    def _update_service_status(self) -> None:
+        ready = self.replica_manager.ready_urls()
+        active = self.replica_manager.active_replicas()
+        if ready:
+            status = ServiceStatus.READY
+        elif active:
+            status = ServiceStatus.REPLICA_INIT
+        else:
+            status = ServiceStatus.NO_REPLICA
+        serve_state.set_service_status(self.service_name, status)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run_loop(self) -> None:
+        """Reconcile until stopped (HTTP endpoint must be started)."""
+        while not self._stop.is_set():
+            try:
+                self.reconcile_once()
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('controller reconcile error')
+            self._stop.wait(_sync_interval())
+        if self._httpd is not None:
+            self._httpd.shutdown()
+
+    def run(self) -> None:
+        self.start_http()
+        record = serve_state.get_service(self.service_name)
+        lb_port = record.get('load_balancer_port') if record else None
+        serve_state.set_service_ports(self.service_name, self.port,
+                                      lb_port or 0)
+        logger.info(f'controller for {self.service_name} on :{self.port}')
+        self.run_loop()

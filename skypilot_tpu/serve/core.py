@@ -1,0 +1,186 @@
+"""Serve client API: up / update / down / status / tail_logs.
+
+Parity: /root/reference/sky/serve/core.py:95-648.  The service daemon
+(controller + LB) runs as a detached local process by default — the
+same supervision code the reference runs on a controller VM.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _yaml_dir() -> str:
+    return common_utils.ensure_dir(
+        os.path.join(common_utils.skytpu_home(), 'serve'))
+
+
+def _validate(task: task_lib.Task, service_name: str) -> None:
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            'Task must carry a `service:` section for serve.up().')
+    common_utils.check_cluster_name_is_valid(service_name)
+
+
+def up(task: task_lib.Task, service_name: Optional[str] = None,
+       *, detach: bool = True) -> Tuple[str, str]:
+    """Start a service; returns (service_name, endpoint_url)."""
+    service_name = service_name or task.name or 'service'
+    _validate(task, service_name)
+    if serve_state.get_service(service_name) is not None:
+        raise exceptions.InvalidTaskError(
+            f'Service {service_name!r} already exists; use '
+            'serve.update() for in-place updates.')
+    yaml_path = os.path.join(_yaml_dir(), f'{service_name}.yaml')
+    common_utils.dump_yaml(yaml_path, task.to_yaml_config())
+    serve_state.add_service(service_name,
+                            task.service.to_yaml_config(), yaml_path)
+    _start_daemon(service_name)
+    endpoint = _wait_for_endpoint(service_name)
+    if not detach:
+        _wait_until_ready(service_name)
+    return service_name, endpoint
+
+
+def update(task: task_lib.Task, service_name: str) -> int:
+    """Install a new task/spec version; the controller rolls replicas
+    over to it one at a time. Returns the new version."""
+    _validate(task, service_name)
+    record = serve_state.get_service(service_name)
+    if record is None:
+        raise exceptions.InvalidTaskError(
+            f'Service {service_name!r} does not exist; use serve.up().')
+    yaml_path = os.path.join(
+        _yaml_dir(), f'{service_name}.yaml')
+    common_utils.dump_yaml(yaml_path, task.to_yaml_config())
+    version = serve_state.update_service_spec(
+        service_name, task.service.to_yaml_config(), yaml_path)
+    # Nudge the controller (best effort; it also polls state).
+    port = record.get('controller_port')
+    if port:
+        try:
+            import requests  # pylint: disable=import-outside-toplevel
+            requests.post(
+                f'http://127.0.0.1:{port}/controller/update_service',
+                json={}, timeout=5)
+        except Exception:  # pylint: disable=broad-except
+            pass
+    return version
+
+
+def down(service_name: str, purge: bool = False) -> None:
+    """Stop the daemon, terminate all replicas, remove state."""
+    record = serve_state.get_service(service_name)
+    if record is None:
+        if purge:
+            return
+        raise exceptions.InvalidTaskError(
+            f'Service {service_name!r} does not exist.')
+    serve_state.set_service_status(service_name,
+                                   ServiceStatus.SHUTTING_DOWN)
+    for pid_key in ('controller_pid', 'lb_pid'):
+        pid = record.get(pid_key)
+        if pid:
+            try:
+                os.killpg(os.getpgid(pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError, OSError):
+                pass
+    # Terminate replica clusters.
+    from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+    for replica in serve_state.get_replicas(service_name):
+        try:
+            core.down(replica['cluster_name'])
+        except (exceptions.SkyTpuError, ValueError):
+            if not purge:
+                logger.warning(
+                    f'failed to tear down {replica["cluster_name"]}')
+    serve_state.remove_service(service_name)
+
+
+def status(service_names: Optional[List[str]] = None
+           ) -> List[Dict[str, Any]]:
+    records = serve_state.get_services()
+    if service_names is not None:
+        records = [r for r in records if r['name'] in service_names]
+    for record in records:
+        record['replicas'] = serve_state.get_replicas(record['name'])
+    return records
+
+
+def tail_logs(service_name: str, *, target: str = 'replica',
+              replica_id: Optional[int] = None,
+              follow: bool = False) -> None:
+    """Print logs for a replica cluster (or the service daemon)."""
+    record = serve_state.get_service(service_name)
+    if record is None:
+        raise exceptions.InvalidTaskError(
+            f'Service {service_name!r} does not exist.')
+    if target == 'replica':
+        replicas = serve_state.get_replicas(service_name)
+        if not replicas:
+            raise exceptions.InvalidTaskError('No replicas yet.')
+        if replica_id is None:
+            replica_id = replicas[0]['replica_id']
+        cluster = next(r['cluster_name'] for r in replicas
+                       if r['replica_id'] == replica_id)
+        from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+        core.tail_logs(cluster, follow=follow)
+    else:
+        log_path = os.path.join(_yaml_dir(), 'logs',
+                                f'{service_name}.log')
+        if os.path.exists(log_path):
+            with open(log_path, encoding='utf-8',
+                      errors='replace') as f:
+                print(f.read(), end='')
+
+
+# ------------------------------------------------------------------ util
+
+
+def _start_daemon(service_name: str) -> None:
+    log_dir = common_utils.ensure_dir(os.path.join(_yaml_dir(), 'logs'))
+    log_path = os.path.join(log_dir, f'{service_name}.log')
+    with open(log_path, 'ab') as log_f:
+        proc = subprocess.Popen(  # pylint: disable=consider-using-with
+            [sys.executable, '-m', 'skypilot_tpu.serve.service',
+             '--service-name', service_name],
+            stdout=log_f, stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL, start_new_session=True)
+    serve_state.set_service_pids(service_name, controller_pid=proc.pid,
+                                 lb_pid=proc.pid)
+
+
+def _wait_for_endpoint(service_name: str, timeout: float = 60.0) -> str:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        record = serve_state.get_service(service_name)
+        if record and record.get('load_balancer_port'):
+            return f'http://127.0.0.1:{record["load_balancer_port"]}'
+        time.sleep(0.3)
+    raise exceptions.SkyTpuError(
+        f'Service {service_name} daemon did not come up in {timeout}s '
+        f'(see {_yaml_dir()}/logs/{service_name}.log).')
+
+
+def _wait_until_ready(service_name: str, timeout: float = 600.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        record = serve_state.get_service(service_name)
+        if record and record['status'] == ServiceStatus.READY.value:
+            return
+        time.sleep(1.0)
+    raise exceptions.SkyTpuError(
+        f'Service {service_name} not READY within {timeout}s.')

@@ -1,0 +1,163 @@
+"""Load balancer: HTTP reverse proxy over ready replicas.
+
+Parity: /root/reference/sky/serve/load_balancer.py:22-205
+(SkyServeLoadBalancer: syncs ready-replica URLs + reports request
+timestamps to the controller every sync interval :58-111; per-request
+replica pick + stream-proxy) and load_balancing_policies.py
+(RoundRobinPolicy).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+from http.server import ThreadingHTTPServer
+from typing import List, Optional
+
+import requests
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_HOP_HEADERS = {'connection', 'keep-alive', 'proxy-authenticate',
+                'proxy-authorization', 'te', 'trailers',
+                'transfer-encoding', 'upgrade', 'host',
+                'content-length'}
+
+
+def _lb_sync_interval() -> float:
+    return float(os.environ.get('SKYTPU_LB_SYNC_INTERVAL', '20'))
+
+
+class LoadBalancingPolicy:
+
+    def select(self, urls: List[str]) -> Optional[str]:
+        raise NotImplementedError
+
+
+class RoundRobinPolicy(LoadBalancingPolicy):
+
+    def __init__(self) -> None:
+        self._index = 0
+        self._lock = threading.Lock()
+
+    def select(self, urls: List[str]) -> Optional[str]:
+        if not urls:
+            return None
+        with self._lock:
+            url = urls[self._index % len(urls)]
+            self._index += 1
+        return url
+
+
+class SkyServeLoadBalancer:
+
+    def __init__(self, controller_url: str, port: int = 0,
+                 policy: Optional[LoadBalancingPolicy] = None) -> None:
+        self.controller_url = controller_url.rstrip('/')
+        self.port = port
+        self.policy = policy or RoundRobinPolicy()
+        self.ready_urls: List[str] = []
+        self.request_timestamps: List[float] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # ------------------------------------------------------ controller sync
+
+    def _sync_with_controller(self) -> None:
+        with self._lock:
+            timestamps, self.request_timestamps = \
+                self.request_timestamps, []
+        try:
+            resp = requests.post(
+                self.controller_url + '/controller/load_balancer_sync',
+                json={'request_timestamps': timestamps}, timeout=5)
+            urls = resp.json().get('ready_replica_urls', [])
+            with self._lock:
+                self.ready_urls = urls
+        except (requests.RequestException, ValueError) as e:
+            logger.warning(f'LB sync failed: {e}')
+
+    def _sync_loop(self) -> None:
+        while not self._stop.is_set():
+            self._sync_with_controller()
+            self._stop.wait(_lb_sync_interval())
+
+    # -------------------------------------------------------------- proxy
+
+    def _make_handler(self):
+        lb = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, *args):
+                del args
+
+            def _proxy(self):
+                with lb._lock:  # pylint: disable=protected-access
+                    lb.request_timestamps.append(time.time())
+                    urls = list(lb.ready_urls)
+                target = lb.policy.select(urls)
+                if target is None:
+                    body = b'No ready replicas.'
+                    self.send_response(503)
+                    self.send_header('Content-Length', str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                length = int(self.headers.get('Content-Length', 0))
+                data = self.rfile.read(length) if length else None
+                headers = {k: v for k, v in self.headers.items()
+                           if k.lower() not in _HOP_HEADERS}
+                try:
+                    resp = requests.request(
+                        self.command, target + self.path, data=data,
+                        headers=headers, stream=True, timeout=300)
+                except requests.RequestException as e:
+                    body = f'Bad gateway: {e}'.encode()
+                    self.send_response(502)
+                    self.send_header('Content-Length', str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                content = resp.raw.read()
+                self.send_response(resp.status_code)
+                for key, value in resp.headers.items():
+                    if key.lower() not in _HOP_HEADERS:
+                        self.send_header(key, value)
+                self.send_header('Content-Length', str(len(content)))
+                self.end_headers()
+                self.wfile.write(content)
+
+            do_GET = _proxy
+            do_POST = _proxy
+            do_PUT = _proxy
+            do_DELETE = _proxy
+            do_PATCH = _proxy
+            do_HEAD = _proxy
+
+        return Handler
+
+    # ---------------------------------------------------------------- run
+
+    def start(self) -> int:
+        """Start proxy + sync threads; returns the bound LB port."""
+        self._httpd = ThreadingHTTPServer(('0.0.0.0', self.port),
+                                          self._make_handler())
+        self.port = self._httpd.server_port
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        threading.Thread(target=self._sync_loop, daemon=True).start()
+        logger.info(f'load balancer on :{self.port} -> '
+                    f'{self.controller_url}')
+        return self.port
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()

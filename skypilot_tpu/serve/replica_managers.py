@@ -1,0 +1,225 @@
+"""Replica manager: launch, probe, and retire replica clusters.
+
+Parity: /root/reference/sky/serve/replica_managers.py:58-784
+(SkyPilotReplicaManager — replicas are clusters launched via recursive
+sky.launch; readiness probing; preemption handling).  TPU-first: a
+replica is a slice-cluster, and a preempted replica is terminated before
+its slot is refilled (slices fail as a unit).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+import typing
+from typing import Dict, List, Optional
+
+import requests
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import status_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.utils import common_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+logger = sky_logging.init_logger(__name__)
+
+ENV_REPLICA_ID = 'SKYTPU_SERVE_REPLICA_ID'
+ENV_REPLICA_PORT = 'SKYTPU_SERVE_REPLICA_PORT'
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('', 0))
+        return s.getsockname()[1]
+
+
+class ReplicaManager:
+
+    def __init__(self, service_name: str, spec: 'SkyServiceSpec',
+                 task: 'task_lib.Task', version: int = 1) -> None:
+        self.service_name = service_name
+        self.spec = spec
+        self.task = task
+        self.version = version
+        self._launch_threads: Dict[int, threading.Thread] = {}
+        self._first_probe_at: Dict[int, float] = {}
+        self._lock = threading.Lock()
+
+    def set_version(self, spec: 'SkyServiceSpec', task: 'task_lib.Task',
+                    version: int) -> None:
+        self.spec = spec
+        self.task = task
+        self.version = version
+
+    # ------------------------------------------------------------- naming
+
+    def _cluster_name(self, replica_id: int) -> str:
+        return f'{self.service_name}-{replica_id}'
+
+    def _is_local(self) -> bool:
+        for resources in self.task.resources:
+            if resources.cloud is not None and str(
+                    resources.cloud).lower() == 'local':
+                return True
+        return False
+
+    # ----------------------------------------------------------- scale up
+
+    def scale_up(self, use_spot: Optional[bool] = None) -> int:
+        """Launch one replica asynchronously; returns its id."""
+        replica_id = serve_state.next_replica_id(self.service_name)
+        cluster_name = self._cluster_name(replica_id)
+        port = _free_port() if self._is_local() else self.spec.replica_port
+        serve_state.add_replica(self.service_name, replica_id,
+                                cluster_name,
+                                is_spot=bool(use_spot),
+                                version=self.version)
+        url = None  # filled once the cluster's head IP is known
+        thread = threading.Thread(
+            target=self._launch_replica,
+            args=(replica_id, cluster_name, port, use_spot),
+            daemon=True)
+        with self._lock:
+            self._launch_threads[replica_id] = thread
+        thread.start()
+        del url
+        return replica_id
+
+    def _launch_replica(self, replica_id: int, cluster_name: str,
+                        port: int, use_spot: Optional[bool]) -> None:
+        from skypilot_tpu import execution  # pylint: disable=import-outside-toplevel
+        from skypilot_tpu.backends import backend_utils  # pylint: disable=import-outside-toplevel
+        import copy  # pylint: disable=import-outside-toplevel
+        task = copy.deepcopy(self.task)
+        task.update_envs({
+            ENV_REPLICA_ID: str(replica_id),
+            ENV_REPLICA_PORT: str(port),
+        })
+        if use_spot is not None:
+            task.set_resources({
+                r.copy(use_spot=use_spot) for r in task.resources})
+        try:
+            execution.launch(task, cluster_name=cluster_name,
+                             stream_logs=False, detach_run=True,
+                             retry_until_up=False)
+            handle = backend_utils.check_cluster_available(cluster_name)
+            ips = handle.external_ips() or ['127.0.0.1']
+            url = f'http://{ips[0]}:{port}'
+            serve_state.set_replica_status(self.service_name, replica_id,
+                                           ReplicaStatus.STARTING, url=url)
+            self._first_probe_at[replica_id] = time.time()
+        except exceptions.SkyTpuError as e:
+            logger.warning(
+                f'replica {replica_id} launch failed: '
+                f'{common_utils.format_exception(e)}')
+            serve_state.set_replica_status(self.service_name, replica_id,
+                                           ReplicaStatus.FAILED_PROVISION)
+
+    # --------------------------------------------------------- scale down
+
+    def scale_down(self, replica_id: int,
+                   final_status: ReplicaStatus = ReplicaStatus.TERMINATED
+                   ) -> None:
+        """Tear down the replica's cluster; the row is kept in a
+        terminal state (history + monotonic replica ids)."""
+        from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       ReplicaStatus.SHUTTING_DOWN)
+        cluster_name = self._cluster_name(replica_id)
+        try:
+            core.down(cluster_name)
+        except (exceptions.SkyTpuError, ValueError):
+            pass
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       final_status)
+        self._first_probe_at.pop(replica_id, None)
+
+    # -------------------------------------------------------------- probe
+
+    def _probe_one(self, replica: Dict) -> None:
+        replica_id = replica['replica_id']
+        url = replica['url']
+        if not url:
+            return
+        ready = False
+        try:
+            resp = requests.get(url + self.spec.readiness_path,
+                                timeout=self.spec.readiness_timeout_seconds)
+            ready = resp.status_code == 200
+        except requests.RequestException:
+            ready = False
+        status = ReplicaStatus(replica['status'])
+        if ready:
+            if status is not ReplicaStatus.READY:
+                serve_state.set_replica_status(
+                    self.service_name, replica_id, ReplicaStatus.READY)
+            return
+        if status is ReplicaStatus.READY:
+            serve_state.set_replica_status(self.service_name, replica_id,
+                                           ReplicaStatus.NOT_READY)
+        elif status is ReplicaStatus.STARTING:
+            first = self._first_probe_at.get(replica_id, time.time())
+            if time.time() - first > self.spec.initial_delay_seconds:
+                logger.warning(f'replica {replica_id} never became ready '
+                               f'within initial_delay; retiring')
+                serve_state.set_replica_status(
+                    self.service_name, replica_id,
+                    ReplicaStatus.FAILED_INITIAL_DELAY)
+
+    def _check_preempted(self, replica: Dict) -> bool:
+        """True if the replica's cluster is gone/stopped (eviction)."""
+        from skypilot_tpu.backends import backend_utils  # pylint: disable=import-outside-toplevel
+        try:
+            record = backend_utils.refresh_cluster_record(
+                replica['cluster_name'])
+        except exceptions.SkyTpuError:
+            return False
+        return (record is None or
+                record['status'] is not status_lib.ClusterStatus.UP)
+
+    def sync(self) -> None:
+        """One reconciliation pass: probe health, detect preemption,
+        retire failed replicas."""
+        for replica in serve_state.get_replicas(self.service_name):
+            status = ReplicaStatus(replica['status'])
+            replica_id = replica['replica_id']
+            if status in (ReplicaStatus.READY, ReplicaStatus.NOT_READY,
+                          ReplicaStatus.STARTING):
+                if status is not ReplicaStatus.STARTING and \
+                        self._check_preempted(replica):
+                    logger.info(f'replica {replica_id} preempted')
+                    self.scale_down(replica_id,
+                                    final_status=ReplicaStatus.PREEMPTED)
+                    continue
+                self._probe_one(replica)
+            elif status.is_terminal() and \
+                    status is not ReplicaStatus.TERMINATED:
+                # Newly failed replica: free its slot (the terminal row
+                # is kept).  Skip once its cluster is already gone —
+                # that marks the failure as handled.
+                from skypilot_tpu import global_user_state  # pylint: disable=import-outside-toplevel
+                if global_user_state.get_cluster_from_name(
+                        replica['cluster_name']) is not None:
+                    self.scale_down(replica_id, final_status=status)
+
+    # ------------------------------------------------------------- counts
+
+    def active_replicas(self) -> List[Dict]:
+        return [r for r in serve_state.get_replicas(self.service_name)
+                if not ReplicaStatus(r['status']).is_terminal()]
+
+    def ready_urls(self) -> List[str]:
+        return [r['url'] for r in serve_state.get_replicas(
+            self.service_name)
+                if r['status'] == ReplicaStatus.READY.value and r['url']]
+
+    def terminate_all(self) -> None:
+        for replica in serve_state.get_replicas(self.service_name):
+            self.scale_down(replica['replica_id'])

@@ -1,0 +1,238 @@
+"""Serve state store: services + replicas (sqlite).
+
+Parity: /root/reference/sky/serve/serve_state.py (ServiceStatus,
+ReplicaStatus tables on the controller).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import pathlib
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+
+class ServiceStatus(enum.Enum):
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'
+    READY = 'READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    FAILED_CLEANUP = 'FAILED_CLEANUP'
+    NO_REPLICA = 'NO_REPLICA'
+
+    def is_terminal(self) -> bool:
+        return self in (ServiceStatus.FAILED,
+                        ServiceStatus.FAILED_CLEANUP)
+
+
+class ReplicaStatus(enum.Enum):
+    PENDING = 'PENDING'
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    FAILED_INITIAL_DELAY = 'FAILED_INITIAL_DELAY'
+    FAILED_PROBING = 'FAILED_PROBING'
+    FAILED_PROVISION = 'FAILED_PROVISION'
+    PREEMPTED = 'PREEMPTED'
+    # Cluster torn down; row kept for history and id monotonicity
+    # (parity: the reference keeps terminal replica records).
+    TERMINATED = 'TERMINATED'
+
+    def is_terminal(self) -> bool:
+        return self in (ReplicaStatus.FAILED,
+                        ReplicaStatus.FAILED_INITIAL_DELAY,
+                        ReplicaStatus.FAILED_PROBING,
+                        ReplicaStatus.FAILED_PROVISION,
+                        ReplicaStatus.PREEMPTED,
+                        ReplicaStatus.TERMINATED)
+
+    @classmethod
+    def failed_statuses(cls) -> List['ReplicaStatus']:
+        return [s for s in cls if s.is_terminal()]
+
+
+_CREATE_SERVICES = """\
+CREATE TABLE IF NOT EXISTS services (
+    name TEXT PRIMARY KEY,
+    status TEXT,
+    controller_port INTEGER,
+    load_balancer_port INTEGER,
+    controller_pid INTEGER,
+    lb_pid INTEGER,
+    spec_json TEXT,
+    task_yaml_path TEXT,
+    version INTEGER DEFAULT 1,
+    created_at REAL
+)"""
+
+_CREATE_REPLICAS = """\
+CREATE TABLE IF NOT EXISTS replicas (
+    service_name TEXT,
+    replica_id INTEGER,
+    cluster_name TEXT,
+    status TEXT,
+    url TEXT,
+    is_spot INTEGER DEFAULT 0,
+    version INTEGER DEFAULT 1,
+    launched_at REAL,
+    PRIMARY KEY (service_name, replica_id)
+)"""
+
+
+def _db_path() -> str:
+    path = os.environ.get('SKYTPU_SERVE_DB')
+    if path is None:
+        from skypilot_tpu.utils import common_utils  # pylint: disable=import-outside-toplevel
+        path = os.path.join(common_utils.skytpu_home(), 'serve.db')
+    pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
+    return path
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn.execute(_CREATE_SERVICES)
+    conn.execute(_CREATE_REPLICAS)
+    return conn
+
+
+# ---------------------------------------------------------------- services
+
+
+def add_service(name: str, spec_json: Dict[str, Any],
+                task_yaml_path: str) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO services (name, status, spec_json, '
+            'task_yaml_path, created_at) VALUES (?,?,?,?,?)',
+            (name, ServiceStatus.CONTROLLER_INIT.value,
+             json.dumps(spec_json), task_yaml_path, time.time()))
+
+
+def set_service_status(name: str, status: ServiceStatus) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE services SET status=? WHERE name=?',
+                     (status.value, name))
+
+
+def set_service_ports(name: str, controller_port: int,
+                      lb_port: int) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE services SET controller_port=?, load_balancer_port=? '
+            'WHERE name=?', (controller_port, lb_port, name))
+
+
+def set_service_pids(name: str, controller_pid: Optional[int] = None,
+                     lb_pid: Optional[int] = None) -> None:
+    with _conn() as conn:
+        if controller_pid is not None:
+            conn.execute('UPDATE services SET controller_pid=? '
+                         'WHERE name=?', (controller_pid, name))
+        if lb_pid is not None:
+            conn.execute('UPDATE services SET lb_pid=? WHERE name=?',
+                         (lb_pid, name))
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        conn.row_factory = sqlite3.Row
+        row = conn.execute('SELECT * FROM services WHERE name=?',
+                           (name,)).fetchone()
+    if row is None:
+        return None
+    rec = dict(row)
+    rec['spec'] = json.loads(rec.pop('spec_json') or '{}')
+    return rec
+
+
+def get_services() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        conn.row_factory = sqlite3.Row
+        rows = conn.execute(
+            'SELECT * FROM services ORDER BY created_at').fetchall()
+    out = []
+    for row in rows:
+        rec = dict(row)
+        rec['spec'] = json.loads(rec.pop('spec_json') or '{}')
+        out.append(rec)
+    return out
+
+
+def remove_service(name: str) -> None:
+    with _conn() as conn:
+        conn.execute('DELETE FROM services WHERE name=?', (name,))
+        conn.execute('DELETE FROM replicas WHERE service_name=?', (name,))
+
+
+def update_service_spec(name: str, spec_json: Dict[str, Any],
+                        task_yaml_path: str) -> int:
+    """Install a new spec/task version; returns the new version."""
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE services SET spec_json=?, task_yaml_path=?, '
+            'version=version+1 WHERE name=?',
+            (json.dumps(spec_json), task_yaml_path, name))
+        row = conn.execute('SELECT version FROM services WHERE name=?',
+                           (name,)).fetchone()
+    return row[0] if row else 1
+
+
+# ---------------------------------------------------------------- replicas
+
+
+def add_replica(service_name: str, replica_id: int, cluster_name: str,
+                is_spot: bool = False, version: int = 1) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO replicas (service_name, replica_id, '
+            'cluster_name, status, is_spot, version, launched_at) '
+            'VALUES (?,?,?,?,?,?,?)',
+            (service_name, replica_id, cluster_name,
+             ReplicaStatus.PROVISIONING.value, int(is_spot), version,
+             time.time()))
+
+
+def set_replica_status(service_name: str, replica_id: int,
+                       status: ReplicaStatus,
+                       url: Optional[str] = None) -> None:
+    with _conn() as conn:
+        if url is not None:
+            conn.execute(
+                'UPDATE replicas SET status=?, url=? '
+                'WHERE service_name=? AND replica_id=?',
+                (status.value, url, service_name, replica_id))
+        else:
+            conn.execute(
+                'UPDATE replicas SET status=? '
+                'WHERE service_name=? AND replica_id=?',
+                (status.value, service_name, replica_id))
+
+
+def remove_replica(service_name: str, replica_id: int) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'DELETE FROM replicas WHERE service_name=? AND replica_id=?',
+            (service_name, replica_id))
+
+
+def get_replicas(service_name: str) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        conn.row_factory = sqlite3.Row
+        rows = conn.execute(
+            'SELECT * FROM replicas WHERE service_name=? '
+            'ORDER BY replica_id', (service_name,)).fetchall()
+    return [dict(r) for r in rows]
+
+
+def next_replica_id(service_name: str) -> int:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT MAX(replica_id) FROM replicas WHERE service_name=?',
+            (service_name,)).fetchone()
+    return (row[0] or 0) + 1

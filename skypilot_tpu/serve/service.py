@@ -1,0 +1,43 @@
+"""Service daemon entrypoint: one process running controller + LB.
+
+Parity: /root/reference/sky/serve/service.py (spawns the
+SkyServeController and SkyServeLoadBalancer for one service).
+
+    python -m skypilot_tpu.serve.service --service-name NAME
+"""
+from __future__ import annotations
+
+import argparse
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import controller as controller_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import serve_state
+
+logger = sky_logging.init_logger(__name__)
+
+
+def run_service(service_name: str, lb_port: int = 0) -> None:
+    controller = controller_lib.SkyServeController(service_name)
+    controller_port = controller.start_http()
+    lb = lb_lib.SkyServeLoadBalancer(
+        f'http://127.0.0.1:{controller_port}', port=lb_port)
+    bound_lb_port = lb.start()
+    serve_state.set_service_ports(service_name, controller_port,
+                                  bound_lb_port)
+    try:
+        controller.run_loop()
+    finally:
+        lb.stop()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--service-name', required=True)
+    parser.add_argument('--lb-port', type=int, default=0)
+    args = parser.parse_args()
+    run_service(args.service_name, args.lb_port)
+
+
+if __name__ == '__main__':
+    main()

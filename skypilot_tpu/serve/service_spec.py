@@ -1,0 +1,131 @@
+"""Service spec: the `service:` section of a task YAML.
+
+Parity: /root/reference/sky/serve/service_spec.py:312 (SkyServiceSpec —
+readiness probe, replica policy, QPS target, spot fallback mix).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import common_utils
+
+DEFAULT_INITIAL_DELAY_SECONDS = 1200
+DEFAULT_READINESS_PATH = '/'
+
+
+class SkyServiceSpec:
+
+    def __init__(self,
+                 readiness_path: str = DEFAULT_READINESS_PATH,
+                 initial_delay_seconds: int = DEFAULT_INITIAL_DELAY_SECONDS,
+                 readiness_timeout_seconds: int = 15,
+                 min_replicas: int = 1,
+                 max_replicas: Optional[int] = None,
+                 target_qps_per_replica: Optional[float] = None,
+                 upscale_delay_seconds: int = 300,
+                 downscale_delay_seconds: int = 1200,
+                 replica_port: int = 8080,
+                 base_ondemand_fallback_replicas: int = 0) -> None:
+        if not readiness_path.startswith('/'):
+            raise exceptions.InvalidTaskError(
+                f'readiness path must start with /, got {readiness_path!r}')
+        if max_replicas is not None and max_replicas < min_replicas:
+            raise exceptions.InvalidTaskError(
+                'max_replicas must be >= min_replicas')
+        if target_qps_per_replica is not None and target_qps_per_replica <= 0:
+            raise exceptions.InvalidTaskError(
+                'target_qps_per_replica must be positive')
+        self.readiness_path = readiness_path
+        self.initial_delay_seconds = initial_delay_seconds
+        self.readiness_timeout_seconds = readiness_timeout_seconds
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas if max_replicas is not None \
+            else min_replicas
+        self.target_qps_per_replica = target_qps_per_replica
+        self.upscale_delay_seconds = upscale_delay_seconds
+        self.downscale_delay_seconds = downscale_delay_seconds
+        self.replica_port = replica_port
+        self.base_ondemand_fallback_replicas = base_ondemand_fallback_replicas
+
+    @property
+    def autoscaling_enabled(self) -> bool:
+        return self.target_qps_per_replica is not None
+
+    # --------------------------------------------------------------- yaml
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'SkyServiceSpec':
+        config = dict(config)
+        common_utils.validate_schema_keys(
+            config, {'readiness_probe', 'replica_policy', 'replicas',
+                     'replica_port'}, 'service')
+        kwargs: Dict[str, Any] = {}
+        probe = config.get('readiness_probe')
+        if isinstance(probe, str):
+            kwargs['readiness_path'] = probe
+        elif isinstance(probe, dict):
+            common_utils.validate_schema_keys(
+                probe, {'path', 'initial_delay_seconds',
+                        'timeout_seconds'}, 'readiness_probe')
+            if 'path' in probe:
+                kwargs['readiness_path'] = probe['path']
+            if 'initial_delay_seconds' in probe:
+                kwargs['initial_delay_seconds'] = int(
+                    probe['initial_delay_seconds'])
+            if 'timeout_seconds' in probe:
+                kwargs['readiness_timeout_seconds'] = int(
+                    probe['timeout_seconds'])
+        policy = config.get('replica_policy')
+        if policy is not None:
+            common_utils.validate_schema_keys(
+                policy, {'min_replicas', 'max_replicas',
+                         'target_qps_per_replica', 'upscale_delay_seconds',
+                         'downscale_delay_seconds',
+                         'base_ondemand_fallback_replicas'},
+                'replica_policy')
+            for key in ('min_replicas', 'max_replicas',
+                        'upscale_delay_seconds', 'downscale_delay_seconds',
+                        'base_ondemand_fallback_replicas'):
+                if key in policy:
+                    kwargs[key] = int(policy[key])
+            if 'target_qps_per_replica' in policy:
+                kwargs['target_qps_per_replica'] = float(
+                    policy['target_qps_per_replica'])
+        elif config.get('replicas') is not None:
+            # Fixed-size service shorthand (parity: reference
+            # service_spec 'replicas' field).
+            kwargs['min_replicas'] = int(config['replicas'])
+            kwargs['max_replicas'] = int(config['replicas'])
+        if config.get('replica_port') is not None:
+            kwargs['replica_port'] = int(config['replica_port'])
+        return cls(**kwargs)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {
+            'readiness_probe': {
+                'path': self.readiness_path,
+                'initial_delay_seconds': self.initial_delay_seconds,
+                'timeout_seconds': self.readiness_timeout_seconds,
+            },
+            'replica_policy': {
+                'min_replicas': self.min_replicas,
+                'max_replicas': self.max_replicas,
+            },
+            'replica_port': self.replica_port,
+        }
+        policy = config['replica_policy']
+        if self.target_qps_per_replica is not None:
+            policy['target_qps_per_replica'] = self.target_qps_per_replica
+            policy['upscale_delay_seconds'] = self.upscale_delay_seconds
+            policy['downscale_delay_seconds'] = self.downscale_delay_seconds
+        if self.base_ondemand_fallback_replicas:
+            policy['base_ondemand_fallback_replicas'] = (
+                self.base_ondemand_fallback_replicas)
+        return config
+
+    def __repr__(self) -> str:
+        return (f'SkyServiceSpec(replicas=[{self.min_replicas}, '
+                f'{self.max_replicas}], qps_target='
+                f'{self.target_qps_per_replica}, '
+                f'probe={self.readiness_path!r})')

@@ -40,3 +40,12 @@ def load_chain_dag_from_yaml(yaml_path: str) -> dag_lib.Dag:
     if dag.name is None and dag.tasks:
         dag.name = dag.tasks[0].name
     return dag
+
+def dump_chain_dag_to_yaml(dag: dag_lib.Dag, yaml_path: str) -> None:
+    """Serialize a chain DAG as a multi-document YAML (inverse of
+    load_chain_dag_from_yaml)."""
+    import yaml  # pylint: disable=import-outside-toplevel
+    configs = [task.to_yaml_config() for task in dag.tasks]
+    with open(yaml_path, 'w', encoding='utf-8') as f:
+        yaml.safe_dump_all(configs, f, default_flow_style=False,
+                           sort_keys=False)

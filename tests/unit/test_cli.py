@@ -1,0 +1,133 @@
+"""CLI tests via click.testing.CliRunner.
+
+Parity: /root/reference/tests/test_cli.py approach — drive the real CLI
+against hermetic state (local provisioner stands in for the cloud).
+"""
+from __future__ import annotations
+
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu import cli as cli_mod
+from skypilot_tpu import global_user_state
+
+
+@pytest.fixture()
+def runner():
+    global_user_state.set_enabled_clouds(['local'])
+    return CliRunner()
+
+
+def _invoke(runner, args, **kw):
+    result = runner.invoke(cli_mod.cli, args, catch_exceptions=False,
+                           **kw)
+    return result
+
+
+class TestBasics:
+
+    def test_help(self, runner):
+        result = _invoke(runner, ['--help'])
+        assert result.exit_code == 0
+        for cmd in ('launch', 'exec', 'status', 'jobs', 'serve',
+                    'storage'):
+            assert cmd in result.output
+
+    def test_status_empty(self, runner):
+        result = _invoke(runner, ['status'])
+        assert result.exit_code == 0
+        assert 'No existing clusters' in result.output
+
+    def test_show_tpus(self, runner):
+        result = _invoke(runner, ['show-tpus'])
+        assert result.exit_code == 0
+        assert 'tpu-v5p' in result.output or 'tpu-v5e' in result.output
+
+
+class TestLaunchFlow:
+
+    def test_launch_status_queue_logs_down(self, runner, tmp_path):
+        yaml_path = tmp_path / 'task.yaml'
+        yaml_path.write_text(
+            'name: clitask\n'
+            'run: echo CLI_RUN_OK\n'
+            'resources:\n  cloud: local\n')
+        result = _invoke(runner, ['launch', str(yaml_path), '-y',
+                                  '-c', 'cli-c1'])
+        assert result.exit_code == 0, result.output
+        assert 'CLI_RUN_OK' in result.output
+
+        result = _invoke(runner, ['status'])
+        assert 'cli-c1' in result.output
+        assert 'UP' in result.output
+
+        result = _invoke(runner, ['queue', 'cli-c1'])
+        assert 'SUCCEEDED' in result.output
+
+        result = _invoke(runner, ['logs', 'cli-c1', '1', '--no-follow'])
+        assert 'CLI_RUN_OK' in result.output
+
+        result = _invoke(runner, ['exec', 'cli-c1', 'echo EXEC_OK'])
+        assert result.exit_code == 0, result.output
+        assert 'EXEC_OK' in result.output
+
+        result = _invoke(runner, ['down', 'cli-c1', '-y'])
+        assert result.exit_code == 0
+        result = _invoke(runner, ['status'])
+        assert 'No existing clusters' in result.output
+
+    def test_launch_inline_command_with_overrides(self, runner):
+        result = _invoke(runner, ['launch', 'echo INLINE_OK', '-y',
+                                  '-c', 'cli-c2', '--cloud', 'local'])
+        assert result.exit_code == 0, result.output
+        assert 'INLINE_OK' in result.output
+        _invoke(runner, ['down', 'cli-c2', '-y'])
+
+    def test_launch_confirm_abort(self, runner):
+        result = runner.invoke(
+            cli_mod.cli, ['launch', 'echo X', '--cloud', 'local'],
+            input='n\n')
+        assert result.exit_code != 0
+        assert 'Aborted' in result.output
+
+    def test_down_glob(self, runner):
+        _invoke(runner, ['launch', 'echo A', '-y', '-c', 'glob-a',
+                         '--cloud', 'local'])
+        _invoke(runner, ['launch', 'echo B', '-y', '-c', 'glob-b',
+                         '--cloud', 'local'])
+        result = _invoke(runner, ['down', 'glob-*', '-y'])
+        assert 'glob-a' in result.output
+        assert 'glob-b' in result.output
+        result = _invoke(runner, ['status'])
+        assert 'No existing clusters' in result.output
+
+
+class TestJobsCLI:
+
+    def test_jobs_queue_empty(self, runner, _isolated_home, monkeypatch):
+        monkeypatch.setenv('SKYTPU_MANAGED_JOB_DB',
+                           str(_isolated_home / 'mj.db'))
+        result = _invoke(runner, ['jobs', 'queue'])
+        assert result.exit_code == 0
+
+    def test_jobs_cancel_requires_ids(self, runner):
+        result = runner.invoke(cli_mod.cli, ['jobs', 'cancel', '-y'])
+        assert result.exit_code != 0
+
+
+class TestServeCLI:
+
+    def test_serve_status_empty(self, runner, _isolated_home,
+                                monkeypatch):
+        monkeypatch.setenv('SKYTPU_SERVE_DB',
+                           str(_isolated_home / 'serve.db'))
+        result = _invoke(runner, ['serve', 'status'])
+        assert result.exit_code == 0
+        assert 'No services' in result.output
+
+
+class TestStorageCLI:
+
+    def test_storage_ls_empty(self, runner):
+        result = _invoke(runner, ['storage', 'ls'])
+        assert result.exit_code == 0

@@ -183,7 +183,7 @@ class TestModel:
             mesh = build_mesh(mesh_cfg)
             state, shardings = create_train_state(
                 cfg, TrainConfig(), mesh=mesh, batch_size=8, seq_len=32)
-            step = jit_train_step(mesh, shardings, batch_sharding(mesh))
+            step = jit_train_step(shardings, batch_sharding(mesh))
             _, metrics = step(state, batch)
             losses[name] = float(metrics['loss'])
         vals = list(losses.values())

@@ -1,0 +1,294 @@
+"""Serve tests: spec, autoscaler hysteresis, controller E2E with real
+local replicas and a live LB proxy.
+
+Parity with the reference's offline serve tests
+(/root/reference/tests/test_serve_autoscaler.py approach for the
+autoscaler; skyserve smoke behaviors reproduced hermetically on the
+local provisioner).
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+import requests
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_user_state
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import core as serve_core
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.controller import SkyServeController
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+
+@pytest.fixture(autouse=True)
+def _serve_env(monkeypatch, _isolated_home):
+    monkeypatch.setenv('SKYTPU_SERVE_DB',
+                       str(_isolated_home / 'serve.db'))
+    monkeypatch.setenv('SKYTPU_SERVE_SYNC_INTERVAL', '0.3')
+    monkeypatch.setenv('SKYTPU_LB_SYNC_INTERVAL', '0.3')
+    global_user_state.set_enabled_clouds(['local'])
+    yield
+
+
+def _spec(**kw) -> SkyServiceSpec:
+    kw.setdefault('initial_delay_seconds', 30)
+    kw.setdefault('readiness_timeout_seconds', 2)
+    return SkyServiceSpec(**kw)
+
+
+class TestServiceSpec:
+
+    def test_yaml_round_trip(self):
+        spec = SkyServiceSpec.from_yaml_config({
+            'readiness_probe': {'path': '/health',
+                                'initial_delay_seconds': 10},
+            'replica_policy': {'min_replicas': 1, 'max_replicas': 3,
+                               'target_qps_per_replica': 2.0},
+            'replica_port': 9000,
+        })
+        assert spec.readiness_path == '/health'
+        assert spec.max_replicas == 3
+        assert spec.autoscaling_enabled
+        out = spec.to_yaml_config()
+        spec2 = SkyServiceSpec.from_yaml_config(out)
+        assert spec2.target_qps_per_replica == 2.0
+        assert spec2.replica_port == 9000
+
+    def test_replicas_shorthand(self):
+        spec = SkyServiceSpec.from_yaml_config({'replicas': 2})
+        assert spec.min_replicas == spec.max_replicas == 2
+        assert not spec.autoscaling_enabled
+
+    def test_bad_path_rejected(self):
+        with pytest.raises(Exception):
+            SkyServiceSpec(readiness_path='health')
+
+    def test_bad_replica_bounds(self):
+        with pytest.raises(Exception):
+            SkyServiceSpec(min_replicas=3, max_replicas=1)
+
+
+class TestAutoscaler:
+
+    def _scaler(self, **kw):
+        kw.setdefault('min_replicas', 1)
+        kw.setdefault('max_replicas', 5)
+        kw.setdefault('target_qps_per_replica', 1.0)
+        kw.setdefault('upscale_delay_seconds', 10)
+        kw.setdefault('downscale_delay_seconds', 20)
+        return autoscalers.RequestRateAutoscaler(_spec(**kw))
+
+    def test_upscale_needs_sustained_load(self):
+        scaler = self._scaler()
+        now = 1000.0
+
+        def set_qps(qps, at):
+            # Exactly qps*window stamps inside the window at time `at`.
+            scaler.request_timestamps = [
+                at - i / qps
+                for i in range(int(qps *
+                                   autoscalers.QPS_WINDOW_SIZE_SECONDS))]
+
+        # 3 qps sustained -> desired 3, but only after upscale_delay.
+        set_qps(3, now)
+        assert scaler.evaluate_scaling(now).target_num_replicas == 1
+        set_qps(3, now + 5)
+        assert scaler.evaluate_scaling(now + 5).target_num_replicas == 1
+        set_qps(3, now + 11)
+        assert scaler.evaluate_scaling(now + 11).target_num_replicas == 3
+
+    def test_downscale_slower_than_upscale(self):
+        scaler = self._scaler()
+        scaler.target_num_replicas = 4
+        now = 1000.0
+        assert scaler.evaluate_scaling(now).target_num_replicas == 4
+        # Zero traffic: no downscale before the delay...
+        assert scaler.evaluate_scaling(now + 19).target_num_replicas == 4
+        # ...then drop to min.
+        assert scaler.evaluate_scaling(now + 21).target_num_replicas == 1
+
+    def test_bounds_respected(self):
+        scaler = self._scaler(max_replicas=2)
+        now = 0.0
+        scaler.collect_request_information(
+            [now - i * 0.01 for i in range(6000)], now)  # 100 qps
+        scaler.evaluate_scaling(now)
+        assert scaler.evaluate_scaling(
+            now + 11).target_num_replicas == 2
+
+    def test_fallback_mix(self):
+        spec = _spec(min_replicas=3, max_replicas=3,
+                     base_ondemand_fallback_replicas=1)
+        scaler = autoscalers.make_autoscaler(spec)
+        assert isinstance(scaler,
+                          autoscalers.FallbackRequestRateAutoscaler)
+        decision = scaler.evaluate_scaling(0.0)
+        assert decision.target_num_replicas == 3
+        assert decision.num_ondemand == 1
+
+
+class TestRoundRobin:
+
+    def test_cycles(self):
+        policy = lb_lib.RoundRobinPolicy()
+        urls = ['a', 'b', 'c']
+        assert [policy.select(urls) for _ in range(4)] == \
+            ['a', 'b', 'c', 'a']
+
+    def test_empty(self):
+        assert lb_lib.RoundRobinPolicy().select([]) is None
+
+
+def _serve_task(name='svc', replicas=1, **spec_kw):
+    task = sky.Task(
+        name=name,
+        run='exec python3 -m http.server $SKYTPU_SERVE_REPLICA_PORT')
+    task.set_resources(sky.Resources(cloud='local'))
+    spec_kw.setdefault('min_replicas', replicas)
+    spec_kw.setdefault('max_replicas', replicas)
+    task.service = _spec(**spec_kw)
+    return task
+
+
+def _drive(controller, predicate, timeout=90.0, gap=0.5):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        controller.reconcile_once()
+        if predicate():
+            return True
+        time.sleep(gap)
+    return False
+
+
+def _register_service(task, name):
+    import os as _os
+    from skypilot_tpu.utils import common_utils
+    yaml_dir = common_utils.ensure_dir(
+        _os.path.join(common_utils.skytpu_home(), 'serve'))
+    yaml_path = _os.path.join(yaml_dir, f'{name}.yaml')
+    common_utils.dump_yaml(yaml_path, task.to_yaml_config())
+    serve_state.add_service(name, task.service.to_yaml_config(),
+                            yaml_path)
+
+
+class TestControllerE2E:
+
+    def test_replica_becomes_ready_and_lb_proxies(self):
+        task = _serve_task(name='svc1')
+        _register_service(task, 'svc1')
+        controller = SkyServeController('svc1')
+        controller.start_http()
+        try:
+            assert _drive(controller,
+                          lambda: controller.replica_manager.ready_urls())
+            record = serve_state.get_service('svc1')
+            assert record['status'] == ServiceStatus.READY.value
+
+            lb = lb_lib.SkyServeLoadBalancer(
+                f'http://127.0.0.1:{controller.port}')
+            lb_port = lb.start()
+            try:
+                deadline = time.time() + 10
+                while time.time() < deadline and not lb.ready_urls:
+                    time.sleep(0.2)
+                resp = requests.get(f'http://127.0.0.1:{lb_port}/',
+                                    timeout=10)
+                assert resp.status_code == 200
+                # request timestamps flow to the autoscaler on sync
+                time.sleep(1.0)
+                assert controller.autoscaler.request_timestamps
+            finally:
+                lb.stop()
+        finally:
+            controller.stop()
+            controller.replica_manager.terminate_all()
+
+    def test_replica_preemption_refilled(self):
+        task = _serve_task(name='svc2')
+        _register_service(task, 'svc2')
+        controller = SkyServeController('svc2')
+        controller.start_http()
+        try:
+            assert _drive(controller,
+                          lambda: controller.replica_manager.ready_urls())
+            first = serve_state.get_replicas('svc2')[0]
+            # Simulate slice eviction behind the controller's back.
+            sky.down(first['cluster_name'])
+
+            def refilled():
+                reps = serve_state.get_replicas('svc2')
+                newer = [r for r in reps
+                         if r['replica_id'] != first['replica_id']]
+                return bool(newer and
+                            controller.replica_manager.ready_urls())
+
+            assert _drive(controller, refilled)
+            # The evicted replica is kept as history, marked PREEMPTED.
+            old = next(r for r in serve_state.get_replicas('svc2')
+                       if r['replica_id'] == first['replica_id'])
+            assert old['status'] == ReplicaStatus.PREEMPTED.value
+        finally:
+            controller.stop()
+            controller.replica_manager.terminate_all()
+
+    def test_rolling_update(self):
+        task = _serve_task(name='svc3')
+        _register_service(task, 'svc3')
+        controller = SkyServeController('svc3')
+        controller.start_http()
+        try:
+            assert _drive(controller,
+                          lambda: controller.replica_manager.ready_urls())
+            old = serve_state.get_replicas('svc3')[0]
+            assert old['version'] == 1
+            # Install version 2 (same task; metadata-only change).
+            serve_state.update_service_spec(
+                'svc3', task.service.to_yaml_config(),
+                serve_state.get_service('svc3')['task_yaml_path'])
+
+            def rolled():
+                active = controller.replica_manager.active_replicas()
+                return (active and
+                        all(r['version'] == 2 for r in active) and
+                        controller.replica_manager.ready_urls())
+
+            assert _drive(controller, rolled)
+            # Old replica retired (kept as a terminal history row).
+            active_ids = [
+                r['replica_id']
+                for r in controller.replica_manager.active_replicas()]
+            assert old['replica_id'] not in active_ids
+        finally:
+            controller.stop()
+            controller.replica_manager.terminate_all()
+
+
+class TestServeClientAPI:
+
+    def test_up_status_down_daemonized(self):
+        task = _serve_task(name='svc-api')
+        name, endpoint = serve_core.up(task, 'svc-api')
+        try:
+            assert name == 'svc-api'
+            assert endpoint.startswith('http://127.0.0.1:')
+            deadline = time.time() + 90
+            ready = False
+            while time.time() < deadline:
+                recs = serve_core.status(['svc-api'])
+                if recs and recs[0]['status'] == 'READY':
+                    ready = True
+                    break
+                time.sleep(0.5)
+            assert ready, serve_core.status(['svc-api'])
+            resp = requests.get(endpoint + '/', timeout=10)
+            assert resp.status_code == 200
+        finally:
+            serve_core.down('svc-api', purge=True)
+        assert serve_core.status(['svc-api']) == []
+        assert sky.status() == []
