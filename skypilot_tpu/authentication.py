@@ -1,0 +1,49 @@
+"""SSH keypair management + per-cloud key injection.
+
+Parity: /root/reference/sky/authentication.py (generates
+~/.sky/sky-key(.pub); injects into cloud metadata).  Here: the keypair
+lives under SKYTPU_HOME and is propagated to TPU-VMs via instance
+metadata at node-create time (provision/gcp).
+"""
+from __future__ import annotations
+
+import functools
+import os
+import subprocess
+from typing import Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+_SSH_KEY_NAME = 'skytpu-key'
+DEFAULT_SSH_USER = 'skytpu'
+
+
+@functools.lru_cache()
+def get_or_generate_keys() -> Tuple[str, str]:
+    """Returns (private_key_path, public_key_path), generating once."""
+    key_dir = common_utils.ensure_dir(
+        os.path.join(common_utils.skytpu_home(), 'keys'))
+    private = os.path.join(key_dir, _SSH_KEY_NAME)
+    public = private + '.pub'
+    if not (os.path.exists(private) and os.path.exists(public)):
+        subprocess.run(
+            ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f', private,
+             '-C', 'skytpu'],
+            check=True, capture_output=True)
+        os.chmod(private, 0o600)
+        logger.info(f'Generated SSH keypair at {private}')
+    return private, public
+
+
+def public_key_str() -> str:
+    _, public = get_or_generate_keys()
+    with open(public, encoding='utf-8') as f:
+        return f.read().strip()
+
+
+def gcp_ssh_metadata(ssh_user: str = DEFAULT_SSH_USER) -> str:
+    """The `ssh-keys` metadata value GCP expects: 'user:key-material'."""
+    return f'{ssh_user}:{public_key_str()}'

@@ -88,6 +88,45 @@ def run_gang(job_id: int, spec: Dict[str, Any]) -> int:
     job_lib.set_status(job_id, job_lib.JobStatus.RUNNING)
     run_cmd = spec['run_cmd']
 
+    returncodes = _run_gang_native(spec, runners, host_ips, log_dir,
+                                   run_cmd)
+    if returncodes is None:
+        returncodes = _run_gang_python(runners, spec, host_ips, log_dir,
+                                       run_cmd)
+
+    ok = bool(returncodes) and all(rc == 0
+                                   for rc in returncodes.values())
+    status = (job_lib.JobStatus.SUCCEEDED if ok else job_lib.JobStatus.FAILED)
+    job_lib.set_status(job_id, status)
+    summary = {str(r): rc for r, rc in sorted(returncodes.items())}
+    print(f'gang finished: {json.dumps(summary)}', flush=True)
+    return 0 if ok else 1
+
+
+def _run_gang_native(spec, runners, host_ips, log_dir, run_cmd):
+    """Supervise the gang with the C++ fan-in (one child per rank,
+    line-multiplexed logs, fail-fast kill).  None → fall back."""
+    from skypilot_tpu import native  # pylint: disable=import-outside-toplevel
+    binary = native.ensure_fanin_built()
+    if binary is None:
+        return None
+    argvs, log_paths = [], []
+    for rank, runner in enumerate(runners):
+        env = _rank_env(spec, rank, host_ips)
+        exports = log_lib.make_task_bash_script(run_cmd, env)
+        argv = runner.spawn_spec(exports)
+        if argv is None:
+            return None
+        argvs.append(argv)
+        log_paths.append(os.path.join(log_dir, 'tasks',
+                                      f'rank-{rank}.log'))
+    spec_path = os.path.join(log_dir, 'fanin.spec')
+    native.write_spec(spec_path, log_paths, argvs)
+    return native.run_fanin(binary, spec_path)
+
+
+def _run_gang_python(runners, spec, host_ips, log_dir, run_cmd):
+
     def _one_rank(rank: int) -> int:
         runner = runners[rank]
         env = _rank_env(spec, rank, host_ips)
@@ -125,13 +164,7 @@ def run_gang(job_id: int, spec: Dict[str, Any]) -> int:
                 # are killed with it when the scheduler cancels the job.
                 for fut_other in futures:
                     fut_other.cancel()
-
-    ok = all(rc == 0 for rc in returncodes.values())
-    status = (job_lib.JobStatus.SUCCEEDED if ok else job_lib.JobStatus.FAILED)
-    job_lib.set_status(job_id, status)
-    summary = {str(r): rc for r, rc in sorted(returncodes.items())}
-    print(f'gang finished: {json.dumps(summary)}', flush=True)
-    return 0 if ok else 1
+    return returncodes
 
 
 def main() -> None:

@@ -1,0 +1,13 @@
+"""Benchmark harness: try one task on N candidate resources, report
+$/step and time-to-K-steps.
+
+Parity: /root/reference/sky/benchmark/ (benchmark_utils.py:432-629
+launch-in-parallel + log collection, benchmark_state.py sqlite) — the
+north-star tool for TPU-vs-GPU fungibility decisions (BASELINE.md).
+"""
+from skypilot_tpu.benchmark.benchmark_utils import down_benchmark_clusters
+from skypilot_tpu.benchmark.benchmark_utils import get_benchmark_results
+from skypilot_tpu.benchmark.benchmark_utils import launch_benchmark
+
+__all__ = ['down_benchmark_clusters', 'get_benchmark_results',
+           'launch_benchmark']

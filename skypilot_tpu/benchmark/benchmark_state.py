@@ -1,0 +1,116 @@
+"""Benchmark state store (sqlite).
+
+Parity: /root/reference/sky/benchmark/benchmark_state.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+_CREATE_BENCHMARKS = """\
+CREATE TABLE IF NOT EXISTS benchmarks (
+    name TEXT PRIMARY KEY,
+    task_yaml TEXT,
+    clusters TEXT DEFAULT '[]',
+    launched_at REAL
+)"""
+
+_CREATE_RESULTS = """\
+CREATE TABLE IF NOT EXISTS benchmark_results (
+    benchmark TEXT,
+    cluster TEXT,
+    resources TEXT,
+    cost_per_hour REAL,
+    num_steps INTEGER,
+    seconds_per_step REAL,
+    first_step_seconds REAL,
+    cost_per_step REAL,
+    raw_summary TEXT,
+    PRIMARY KEY (benchmark, cluster)
+)"""
+
+
+def _db_path() -> str:
+    path = os.environ.get('SKYTPU_BENCHMARK_DB')
+    if path is None:
+        from skypilot_tpu.utils import common_utils  # pylint: disable=import-outside-toplevel
+        path = os.path.join(common_utils.skytpu_home(), 'benchmark.db')
+    pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
+    return path
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn.execute(_CREATE_BENCHMARKS)
+    conn.execute(_CREATE_RESULTS)
+    return conn
+
+
+def add_benchmark(name: str, task_yaml: str) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO benchmarks VALUES (?,?,?,?)',
+            (name, task_yaml, '[]', time.time()))
+
+
+def set_benchmark_clusters(name: str, clusters: List[str]) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE benchmarks SET clusters=? WHERE name=?',
+                     (json.dumps(clusters), name))
+
+
+def get_benchmark_clusters(name: str) -> List[str]:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT clusters FROM benchmarks WHERE name=?',
+            (name,)).fetchone()
+    return json.loads(row[0]) if row and row[0] else []
+
+
+def add_result(benchmark: str, cluster: str, resources: str,
+               cost_per_hour: float, summary: Dict[str, Any]) -> None:
+    sps = summary.get('seconds_per_step')
+    cost_per_step = (cost_per_hour / 3600.0 * sps
+                     if sps is not None else None)
+    with _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO benchmark_results VALUES '
+            '(?,?,?,?,?,?,?,?,?)',
+            (benchmark, cluster, resources, cost_per_hour,
+             summary.get('num_steps'), sps,
+             summary.get('first_step_seconds'), cost_per_step,
+             json.dumps(summary)))
+
+
+def get_benchmarks() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        conn.row_factory = sqlite3.Row
+        return [dict(r) for r in conn.execute(
+            'SELECT * FROM benchmarks ORDER BY launched_at').fetchall()]
+
+
+def get_results(benchmark: str) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        conn.row_factory = sqlite3.Row
+        return [dict(r) for r in conn.execute(
+            'SELECT * FROM benchmark_results WHERE benchmark=? '
+            'ORDER BY cost_per_step', (benchmark,)).fetchall()]
+
+
+def remove_benchmark(name: str) -> None:
+    with _conn() as conn:
+        conn.execute('DELETE FROM benchmarks WHERE name=?', (name,))
+        conn.execute('DELETE FROM benchmark_results WHERE benchmark=?',
+                     (name,))
+
+
+def get_benchmark(name: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        conn.row_factory = sqlite3.Row
+        row = conn.execute('SELECT * FROM benchmarks WHERE name=?',
+                           (name,)).fetchone()
+    return dict(row) if row else None

@@ -1,0 +1,111 @@
+"""Benchmark orchestration: parallel candidate launches + result pull.
+
+Parity: /root/reference/sky/benchmark/benchmark_utils.py:432-629 —
+launch the same task once per candidate Resources (each on its own
+cluster), let the in-loop callback write `summary.json`, pull it back
+over the cluster's command runners, and score $/step.
+"""
+from __future__ import annotations
+
+import copy
+import json
+import os
+import tempfile
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.benchmark import benchmark_state
+from skypilot_tpu.callbacks import base as callback_base
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import subprocess_utils
+
+logger = sky_logging.init_logger(__name__)
+
+_REMOTE_LOG_DIR = '~/.skytpu/benchmark_logs'
+
+
+def _cluster_name(benchmark: str, index: int) -> str:
+    return f'skytpu-bench-{benchmark}-{index}'
+
+
+def launch_benchmark(task: task_lib.Task, benchmark: str,
+                     candidates: List[Any],
+                     idle_minutes_to_autostop: Optional[int] = 5
+                     ) -> List[str]:
+    """Launch `task` once per candidate Resources; returns clusters.
+
+    Each candidate cluster gets SKYTPU_BENCHMARK_LOG_DIR exported so
+    skytpu_callback lands summaries where `get_benchmark_results` looks.
+    """
+    from skypilot_tpu import execution  # pylint: disable=import-outside-toplevel
+    benchmark_state.add_benchmark(
+        benchmark, common_utils.dump_yaml_str(task.to_yaml_config()))
+
+    clusters = []
+
+    def _launch_one(item):
+        index, resources = item
+        candidate_task = copy.deepcopy(task)
+        candidate_task.set_resources(resources)
+        candidate_task.update_envs(
+            {callback_base.ENV_LOG_DIR: _REMOTE_LOG_DIR})
+        name = _cluster_name(benchmark, index)
+        execution.launch(
+            candidate_task, cluster_name=name, stream_logs=False,
+            detach_run=True,
+            idle_minutes_to_autostop=idle_minutes_to_autostop)
+        return name
+
+    results = subprocess_utils.run_in_parallel(
+        _launch_one, list(enumerate(candidates)))
+    clusters.extend(results)
+    benchmark_state.set_benchmark_clusters(benchmark, clusters)
+    return clusters
+
+
+def get_benchmark_results(benchmark: str) -> List[Dict[str, Any]]:
+    """Pull summary.json from each candidate cluster and score it."""
+    from skypilot_tpu.backends import backend_utils  # pylint: disable=import-outside-toplevel
+    record = benchmark_state.get_benchmark(benchmark)
+    if record is None:
+        raise exceptions.SkyTpuError(f'No benchmark named {benchmark!r}.')
+    for name in benchmark_state.get_benchmark_clusters(benchmark):
+        try:
+            handle = backend_utils.check_cluster_available(name)
+        except exceptions.SkyTpuError as e:
+            logger.warning(f'benchmark cluster {name} unavailable: {e}')
+            continue
+        summary = _pull_summary(handle)
+        if summary is not None:
+            resources = handle.launched_resources
+            cost_per_hour = (resources.get_cost(3600.0)
+                             if resources is not None else 0.0)
+            benchmark_state.add_result(
+                benchmark, name, str(resources), cost_per_hour, summary)
+    return benchmark_state.get_results(benchmark)
+
+
+def _pull_summary(handle) -> Optional[Dict[str, Any]]:
+    head = handle.get_command_runners()[0]
+    with tempfile.TemporaryDirectory() as tmp:
+        local = os.path.join(tmp, 'summary.json')
+        try:
+            head.rsync(f'{_REMOTE_LOG_DIR}/{callback_base.SUMMARY_FILE}',
+                       local, up=False, stream_logs=False)
+            with open(local, encoding='utf-8') as f:
+                return json.load(f)
+        except (exceptions.SkyTpuError, OSError, ValueError) as e:
+            logger.warning(f'no benchmark summary from '
+                           f'{handle.cluster_name}: {e}')
+            return None
+
+
+def down_benchmark_clusters(benchmark: str) -> None:
+    from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+    for name in benchmark_state.get_benchmark_clusters(benchmark):
+        try:
+            core.down(name)
+        except (exceptions.SkyTpuError, ValueError) as e:
+            logger.warning(f'failed to tear down {name}: {e}')

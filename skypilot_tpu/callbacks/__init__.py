@@ -1,0 +1,16 @@
+"""skytpu_callback: in-training-loop step timestamping for `bench`.
+
+Parity: /root/reference/sky/callbacks/sky_callback/ (init/on_step_begin/
+step context + framework integrations writing benchmark summaries).
+Zero framework dependencies: user training code calls `init()` once and
+`step()` per step; summaries land in BENCHMARK_LOG_DIR for the bench
+harness to aggregate.
+"""
+from skypilot_tpu.callbacks.base import SkyTpuCallback
+from skypilot_tpu.callbacks.base import init
+from skypilot_tpu.callbacks.base import on_step_begin
+from skypilot_tpu.callbacks.base import on_step_end
+from skypilot_tpu.callbacks.base import step
+
+__all__ = ['SkyTpuCallback', 'init', 'on_step_begin', 'on_step_end',
+           'step']

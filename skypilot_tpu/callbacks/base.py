@@ -1,0 +1,115 @@
+"""Step-timestamp logger used by the bench harness.
+
+Parity: /root/reference/sky/callbacks/sky_callback/base.py — `init()`
+then `step()` (context manager) or `on_step_begin()/on_step_end()`;
+timestamps are flushed to `<log_dir>/summary.json` so `bench` can
+compute $/step and time-to-K-steps without touching user code
+internals.
+"""
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+ENV_LOG_DIR = 'SKYTPU_BENCHMARK_LOG_DIR'
+DEFAULT_LOG_DIR = '~/.skytpu/benchmark_logs'
+SUMMARY_FILE = 'summary.json'
+
+_instance: Optional['SkyTpuCallback'] = None
+
+
+class SkyTpuCallback:
+
+    def __init__(self, log_dir: Optional[str] = None,
+                 total_steps: Optional[int] = None,
+                 flush_every: int = 10) -> None:
+        log_dir = log_dir or os.environ.get(ENV_LOG_DIR, DEFAULT_LOG_DIR)
+        self.log_dir = os.path.expanduser(log_dir)
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.total_steps = total_steps
+        self.flush_every = flush_every
+        self.start_time = time.time()
+        self.step_begins: list = []
+        self.step_ends: list = []
+        self._lock = threading.Lock()
+        atexit.register(self.flush)
+
+    def on_step_begin(self) -> None:
+        with self._lock:
+            self.step_begins.append(time.time())
+
+    def on_step_end(self) -> None:
+        with self._lock:
+            self.step_ends.append(time.time())
+            if len(self.step_ends) % self.flush_every == 0:
+                self._flush_no_lock()
+
+    @contextlib.contextmanager
+    def step(self):
+        self.on_step_begin()
+        try:
+            yield
+        finally:
+            self.on_step_end()
+
+    def summary(self) -> Dict[str, Any]:
+        steps = len(self.step_ends)
+        elapsed = (self.step_ends[-1] - self.start_time) if steps else 0.0
+        seconds_per_step = None
+        if steps >= 2:
+            # Steady-state: ignore the first (compile-heavy) step.
+            seconds_per_step = ((self.step_ends[-1] - self.step_ends[0]) /
+                                (steps - 1))
+        return {
+            'start_time': self.start_time,
+            'num_steps': steps,
+            'elapsed_seconds': elapsed,
+            'seconds_per_step': seconds_per_step,
+            'first_step_seconds':
+                (self.step_ends[0] - self.start_time) if steps else None,
+            'total_steps': self.total_steps,
+            'last_step_time': self.step_ends[-1] if steps else None,
+        }
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_no_lock()
+
+    def _flush_no_lock(self) -> None:
+        path = os.path.join(self.log_dir, SUMMARY_FILE)
+        tmp = path + '.tmp'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump(self.summary(), f)
+        os.replace(tmp, path)
+
+
+def init(log_dir: Optional[str] = None,
+         total_steps: Optional[int] = None) -> SkyTpuCallback:
+    global _instance
+    if _instance is None:
+        _instance = SkyTpuCallback(log_dir=log_dir,
+                                   total_steps=total_steps)
+    return _instance
+
+
+def _require() -> SkyTpuCallback:
+    if _instance is None:
+        raise RuntimeError('call skytpu_callback init() first')
+    return _instance
+
+
+def on_step_begin() -> None:
+    _require().on_step_begin()
+
+
+def on_step_end() -> None:
+    _require().on_step_end()
+
+
+def step():
+    return _require().step()

@@ -496,6 +496,95 @@ def serve_logs(service_name, replica_id, target):
     serve.tail_logs(service_name, target=target, replica_id=replica_id)
 
 
+# ------------------------------------------------------------ bench group
+
+
+@cli.group(name='bench')
+def bench_group():
+    """Benchmark a task across candidate resources ($/step)."""
+
+
+@bench_group.command(name='launch')
+@click.argument('entrypoint')
+@click.option('--benchmark', '-b', required=True, help='Benchmark name.')
+@click.option('--gpus', '--accelerators', 'candidate_accels',
+              multiple=True, required=True,
+              help="Candidate accelerators (repeatable), e.g. "
+                   "-A tpu-v5e-8 -A A100:8.")
+@click.option('--cloud', default=None)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def bench_launch(entrypoint, benchmark, candidate_accels, cloud, yes):
+    """Launch ENTRYPOINT once per candidate accelerator."""
+    from skypilot_tpu import benchmark as bench_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu import resources as resources_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu import task as task_lib  # pylint: disable=import-outside-toplevel
+    task = task_lib.Task.from_yaml(entrypoint)
+    candidates = [
+        resources_lib.Resources(cloud=cloud, accelerators=accel)
+        for accel in candidate_accels
+    ]
+    if not yes:
+        click.confirm(
+            f'Launch {len(candidates)} benchmark cluster(s)?',
+            default=True, abort=True)
+    clusters = bench_lib.launch_benchmark(task, benchmark, candidates)
+    click.echo(f'Benchmark {benchmark} running on: {", ".join(clusters)}')
+
+
+@bench_group.command(name='show')
+@click.argument('benchmark')
+def bench_show(benchmark):
+    """Collect and show benchmark results."""
+    from skypilot_tpu import benchmark as bench_lib  # pylint: disable=import-outside-toplevel
+    results = bench_lib.get_benchmark_results(benchmark)
+    rows = []
+    for r in results:
+        rows.append((r['cluster'], r['resources'] or '-',
+                     r['num_steps'] or '-',
+                     f"{r['seconds_per_step']:.3f}"
+                     if r['seconds_per_step'] else '-',
+                     f"{r['first_step_seconds']:.1f}"
+                     if r['first_step_seconds'] else '-',
+                     f"${r['cost_per_step']:.6f}"
+                     if r['cost_per_step'] else '-'))
+    _print_table(['CLUSTER', 'RESOURCES', 'STEPS', 'SEC/STEP',
+                  'FIRST STEP (s)', '$/STEP'], rows)
+
+
+@bench_group.command(name='ls')
+def bench_ls():
+    """List benchmarks."""
+    from skypilot_tpu.benchmark import benchmark_state  # pylint: disable=import-outside-toplevel
+    rows = [(b['name'],) for b in benchmark_state.get_benchmarks()]
+    _print_table(['BENCHMARK'], rows)
+
+
+@bench_group.command(name='down')
+@click.argument('benchmark')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def bench_down(benchmark, yes):
+    """Terminate all clusters of a benchmark."""
+    from skypilot_tpu import benchmark as bench_lib  # pylint: disable=import-outside-toplevel
+    if not yes:
+        click.confirm(f'Tear down benchmark {benchmark} clusters?',
+                      default=True, abort=True)
+    bench_lib.down_benchmark_clusters(benchmark)
+    click.echo('Done.')
+
+
+@bench_group.command(name='delete')
+@click.argument('benchmark')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def bench_delete(benchmark, yes):
+    """Delete a benchmark's records."""
+    from skypilot_tpu.benchmark import benchmark_state  # pylint: disable=import-outside-toplevel
+    if not yes:
+        click.confirm(f'Delete benchmark {benchmark}?', default=True,
+                      abort=True)
+    benchmark_state.remove_benchmark(benchmark)
+    click.echo('Deleted.')
+
+
 # ---------------------------------------------------------- storage group
 
 

@@ -63,7 +63,9 @@ class JobsController:
                         state.set_status(self.job_id, later_id,
                                          state.ManagedJobStatus.CANCELLED)
                     return
-        except exceptions.SkyTpuError as e:
+        except Exception as e:  # pylint: disable=broad-except
+            # ANY controller crash must land the job in a terminal state,
+            # or clients block forever on non-terminal rows.
             logger.error(traceback.format_exc())
             for task_id in range(len(self.dag.tasks)):
                 cur = self._task_status(task_id)

@@ -53,7 +53,7 @@ def launch(entrypoint: Union[task_lib.Task, 'Any'],
     for task in dag.tasks:
         task._validate()  # pylint: disable=protected-access
 
-    job_id = state.next_job_id()
+    job_id = state.allocate_job_id(job_name)
     yaml_path = os.path.join(_dag_yaml_dir(), f'{job_name}-{job_id}.yaml')
     dag_utils.dump_chain_dag_to_yaml(dag, yaml_path)
     state.submit_job(job_id, job_name, yaml_path,
@@ -124,19 +124,35 @@ def queue(refresh: bool = False,
           job_ids: Optional[List[int]] = None) -> List[Dict[str, Any]]:
     """All managed-job records (newest first).
 
-    Parity: reference jobs/core.py queue().
+    Parity: reference jobs/core.py queue().  In 'cluster' controller
+    mode the state db lives on the controller cluster, so the query
+    routes there over ssh codegen (ManagedJobCodeGen).
     """
-    del refresh  # state is local; nothing to refresh yet
-    records = state.get_job_records()
+    del refresh  # the controller writes state continuously
+    records = _query_records()
     if job_ids is not None:
         records = [r for r in records if r['job_id'] in job_ids]
     return records
+
+
+def _query_records() -> List[Dict[str, Any]]:
+    from skypilot_tpu.jobs import utils as jobs_utils  # pylint: disable=import-outside-toplevel
+    if jobs_utils.controller_mode() == 'cluster':
+        return jobs_utils.run_on_controller_cluster(
+            jobs_utils.ManagedJobCodeGen.queue(), 'MJOBS:')
+    return state.get_job_records()
 
 
 def cancel(job_ids: Optional[List[int]] = None,
            all_jobs: bool = False) -> List[int]:
     """Request cancellation; the controller tears down the task cluster
     and marks CANCELLED."""
+    from skypilot_tpu.jobs import utils as jobs_utils  # pylint: disable=import-outside-toplevel
+    if (jobs_utils.controller_mode() == 'cluster' and
+            os.environ.get('SKYTPU_ON_CONTROLLER') != '1'):
+        return jobs_utils.run_on_controller_cluster(
+            jobs_utils.ManagedJobCodeGen.cancel(job_ids, all_jobs),
+            'MCANCELLED:')
     if all_jobs:
         job_ids = state.get_nonterminal_job_ids()
     if not job_ids:

@@ -99,11 +99,20 @@ def _conn() -> sqlite3.Connection:
     return conn
 
 
-def next_job_id() -> int:
+def allocate_job_id(job_name: str) -> int:
+    """Atomically claim the next job id (a placeholder row for task 0 is
+    inserted in the same write transaction, so concurrent launches can
+    never claim the same id)."""
     with _conn() as conn:
+        conn.execute(
+            'INSERT INTO managed_jobs (job_id, task_id, job_name, '
+            'status, submitted_at) '
+            'SELECT COALESCE(MAX(job_id), 0) + 1, 0, ?, ?, ? '
+            'FROM managed_jobs',
+            (job_name, ManagedJobStatus.PENDING.value, time.time()))
         row = conn.execute(
             'SELECT MAX(job_id) FROM managed_jobs').fetchone()
-        return (row[0] or 0) + 1
+        return row[0]
 
 
 def submit_job(job_id: int, job_name: str, dag_yaml_path: str,

@@ -1,0 +1,72 @@
+"""Managed-jobs codegen: client↔controller-cluster RPC over ssh.
+
+Parity: /root/reference/sky/jobs/utils.py ManagedJobCodeGen — when the
+controller runs on its own cluster (jobs.controller.mode: cluster), the
+managed-job state db lives THERE; queue/cancel route through these
+generated one-liners executed on the controller cluster's head, exactly
+like the skylet JobLibCodeGen transport.
+"""
+from __future__ import annotations
+
+import shlex
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.skylet import constants
+
+
+class ManagedJobCodeGen:
+
+    _PREFIX = ('import json, os; '
+               "os.environ.setdefault('PYTHONUNBUFFERED','1'); "
+               'from skypilot_tpu.jobs import state')
+
+    @classmethod
+    def _build(cls, code: List[str]) -> str:
+        full = '; '.join([cls._PREFIX] + code)
+        python = constants.SKY_PYTHON_CMD
+        app_dir = constants.SKY_REMOTE_APP_DIR
+        return (f'PYTHONPATH={app_dir}:$PYTHONPATH {python} -u -c '
+                f'{shlex.quote(full)}')
+
+    @classmethod
+    def queue(cls) -> str:
+        return cls._build([
+            'records = state.get_job_records()',
+            'print("MJOBS:" + json.dumps(records), flush=True)',
+        ])
+
+    @classmethod
+    def cancel(cls, job_ids: Optional[List[int]],
+               all_jobs: bool = False) -> str:
+        return cls._build([
+            # Marker breaks the cluster-mode recursion: on the
+            # controller, cancel() must act on the local state db.
+            "os.environ['SKYTPU_ON_CONTROLLER'] = '1'",
+            'from skypilot_tpu.jobs import core',
+            f'cancelled = core.cancel({job_ids!r}, all_jobs={all_jobs})',
+            'print("MCANCELLED:" + json.dumps(cancelled), flush=True)',
+        ])
+
+
+def run_on_controller_cluster(code: str, tag: str) -> Any:
+    """Execute codegen on the controller cluster's head; parse the
+    tagged JSON line."""
+    from skypilot_tpu.backends import backend_utils  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.jobs import constants as jobs_constants  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.skylet import job_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.utils import subprocess_utils  # pylint: disable=import-outside-toplevel
+    handle = backend_utils.check_cluster_available(
+        jobs_constants.CONTROLLER_CLUSTER_NAME)
+    head = handle.get_command_runners()[0]
+    rc, stdout, stderr = head.run(code, require_outputs=True,
+                                  stream_logs=False)
+    subprocess_utils.handle_returncode(
+        rc, code, 'Failed to reach the jobs controller cluster.', stderr)
+    return job_lib.parse_tagged_json(stdout, tag)
+
+
+def controller_mode() -> str:
+    from skypilot_tpu import config as config_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.jobs import constants as jobs_constants  # pylint: disable=import-outside-toplevel
+    return config_lib.get_nested(jobs_constants.CONTROLLER_MODE_KEY,
+                                 jobs_constants.DEFAULT_CONTROLLER_MODE)

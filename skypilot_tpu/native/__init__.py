@@ -1,0 +1,95 @@
+"""Native runtime components (C++), built on demand and cached.
+
+The reference framework is pure Python with native work delegated to
+Ray's C++ core (SURVEY.md §2.1).  Here the gang-exec hot path — N-host
+process supervision + log fan-in — is a small C++ tool (fanin.cpp),
+compiled once per source hash into SKYTPU_HOME/native/ and used by the
+gang supervisor when available; callers fall back to the pure-Python
+thread-pool path when no toolchain exists.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+from typing import Dict, List, Optional, Sequence
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+_SOURCE = os.path.join(os.path.dirname(__file__), 'fanin.cpp')
+ENV_DISABLE = 'SKYTPU_DISABLE_NATIVE_FANIN'
+
+
+def _build_dir() -> str:
+    return common_utils.ensure_dir(
+        os.path.join(common_utils.skytpu_home(), 'native'))
+
+
+def ensure_fanin_built() -> Optional[str]:
+    """Compile (or reuse) the fanin binary; None when unavailable."""
+    if os.environ.get(ENV_DISABLE) == '1':
+        return None
+    compiler = shutil.which('g++') or shutil.which('c++')
+    if compiler is None:
+        return None
+    try:
+        with open(_SOURCE, 'rb') as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return None
+    binary = os.path.join(_build_dir(), f'fanin-{digest}')
+    if os.path.exists(binary):
+        return binary
+    # Unique tmp per process: concurrent gang supervisors may race to
+    # build; os.replace makes the final install atomic either way.
+    tmp = f'{binary}.{os.getpid()}.tmp'
+    proc = subprocess.run(
+        [compiler, '-O2', '-std=c++17', '-o', tmp, _SOURCE],
+        capture_output=True, text=True, check=False)
+    if proc.returncode != 0:
+        logger.warning(f'native fanin build failed (falling back to '
+                       f'python): {proc.stderr[-400:]}')
+        return None
+    os.replace(tmp, binary)
+    logger.debug(f'built native fanin at {binary}')
+    return binary
+
+
+def write_spec(path: str, log_paths: Sequence[str],
+               argvs: Sequence[Sequence[str]]) -> None:
+    assert len(log_paths) == len(argvs)
+    with open(path, 'wb') as f:
+        f.write(f'SKYFANIN1\n{len(argvs)}\n'.encode())
+        for log_path, argv in zip(log_paths, argvs):
+            f.write(log_path.encode() + b'\0')
+            f.write(str(len(argv)).encode() + b'\0')
+            for arg in argv:
+                f.write(arg.encode() + b'\0')
+
+
+def run_fanin(binary: str, spec_path: str,
+              env: Optional[Dict[str, str]] = None,
+              cwd: Optional[str] = None) -> Dict[int, int]:
+    """Run the gang; streams multiplexed output to our stdout.  Returns
+    {rank: exit_code} parsed from the FANIN_EXIT trailer."""
+    proc = subprocess.Popen(  # pylint: disable=consider-using-with
+        [binary, spec_path], stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, errors='replace', env=env,
+        cwd=cwd)
+    returncodes: Dict[int, int] = {}
+    assert proc.stdout is not None
+    for line in proc.stdout:
+        if line.startswith('FANIN_EXIT '):
+            returncodes = {
+                int(k): v
+                for k, v in json.loads(line[len('FANIN_EXIT '):]).items()
+            }
+        else:
+            print(line, end='', flush=True)
+    proc.wait()
+    return returncodes

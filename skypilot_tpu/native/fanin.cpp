@@ -1,0 +1,273 @@
+// skytpu-fanin: native gang process supervisor + log multiplexer.
+//
+// The hot loop of gang execution (SURVEY.md §7.9: "log-pipe fan-in"):
+// spawn one command per slice host (the ssh client or local process),
+// multiplex their interleaved output line-by-line onto stdout with
+// "(rank N)" prefixes, tee each rank's raw stream to its own log file,
+// and enforce all-or-nothing slice semantics — the first non-zero rank
+// SIGTERMs every other rank's process group (escalating to SIGKILL),
+// mirroring the reference's `get_or_fail` fan-in
+// (/root/reference/sky/backends/cloud_vm_ray_backend.py:294-328) without
+// a Ray dependency or per-rank Python threads.
+//
+// Spec file format (written by skypilot_tpu/native/__init__.py):
+//   "SKYFANIN1\n<num_ranks>\n" followed, per rank, by NUL-delimited
+//   fields: log_path NUL argc NUL arg0 NUL arg1 NUL ... argN NUL
+//
+// Final stdout line:  FANIN_EXIT {"0":rc0,"1":rc1,...}
+// Exit status: 0 iff every rank exited 0.
+#include <cerrno>
+#include <cassert>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <poll.h>
+#include <string>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Rank {
+  std::string log_path;
+  std::vector<std::string> argv;
+  pid_t pid = -1;
+  int pipe_fd = -1;
+  int log_fd = -1;
+  int exit_code = -1;   // -1: still running
+  std::string linebuf;  // partial line accumulator
+};
+
+volatile sig_atomic_t g_got_signal = 0;
+
+void signal_handler(int sig) { g_got_signal = sig; }
+
+std::string read_file(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (f == nullptr) {
+    std::perror("fanin: open spec");
+    std::exit(252);
+  }
+  std::string out;
+  char buf[1 << 16];
+  size_t n;
+  while ((n = std::fread(buf, 1, sizeof(buf), f)) > 0) out.append(buf, n);
+  std::fclose(f);
+  return out;
+}
+
+std::vector<Rank> parse_spec(const std::string& data) {
+  const char kHeader[] = "SKYFANIN1\n";
+  if (data.rfind(kHeader, 0) != 0) {
+    std::fprintf(stderr, "fanin: bad spec header\n");
+    std::exit(252);
+  }
+  size_t pos = sizeof(kHeader) - 1;
+  size_t eol = data.find('\n', pos);
+  int num_ranks = std::atoi(data.substr(pos, eol - pos).c_str());
+  pos = eol + 1;
+  auto next_field = [&]() {
+    size_t nul = data.find('\0', pos);
+    assert(nul != std::string::npos);
+    std::string field = data.substr(pos, nul - pos);
+    pos = nul + 1;
+    return field;
+  };
+  std::vector<Rank> ranks(num_ranks);
+  for (auto& rank : ranks) {
+    rank.log_path = next_field();
+    int argc = std::atoi(next_field().c_str());
+    for (int i = 0; i < argc; ++i) rank.argv.push_back(next_field());
+  }
+  return ranks;
+}
+
+void spawn(Rank& rank) {
+  int fds[2];
+  if (pipe(fds) != 0) {
+    std::perror("fanin: pipe");
+    std::exit(252);
+  }
+  pid_t pid = fork();
+  if (pid < 0) {
+    std::perror("fanin: fork");
+    std::exit(252);
+  }
+  if (pid == 0) {
+    // Child: own process group so the whole remote-driver tree (ssh or
+    // bash) can be signalled as a unit.
+    setpgid(0, 0);
+    dup2(fds[1], STDOUT_FILENO);
+    dup2(fds[1], STDERR_FILENO);
+    close(fds[0]);
+    close(fds[1]);
+    std::vector<char*> argv;
+    argv.reserve(rank.argv.size() + 1);
+    for (auto& a : rank.argv) argv.push_back(const_cast<char*>(a.c_str()));
+    argv.push_back(nullptr);
+    execvp(argv[0], argv.data());
+    std::fprintf(stderr, "fanin: execvp %s: %s\n", argv[0],
+                 std::strerror(errno));
+    _exit(253);
+  }
+  setpgid(pid, pid);  // also from parent: avoid the startup race
+  close(fds[1]);
+  fcntl(fds[0], F_SETFL, O_NONBLOCK);
+  rank.pid = pid;
+  rank.pipe_fd = fds[0];
+  rank.log_fd = open(rank.log_path.c_str(),
+                     O_WRONLY | O_CREAT | O_APPEND, 0644);
+}
+
+void emit_lines(Rank& rank, size_t idx, const char* buf, ssize_t n) {
+  if (rank.log_fd >= 0) {
+    ssize_t off = 0;
+    while (off < n) {
+      ssize_t w = write(rank.log_fd, buf + off, n - off);
+      if (w <= 0) break;
+      off += w;
+    }
+  }
+  rank.linebuf.append(buf, n);
+  size_t start = 0;
+  for (;;) {
+    size_t nl = rank.linebuf.find('\n', start);
+    if (nl == std::string::npos) break;
+    std::fprintf(stdout, "(rank %zu) %.*s\n", idx,
+                 static_cast<int>(nl - start), rank.linebuf.data() + start);
+    start = nl + 1;
+  }
+  rank.linebuf.erase(0, start);
+  std::fflush(stdout);
+}
+
+void flush_tail(Rank& rank, size_t idx) {
+  if (!rank.linebuf.empty()) {
+    std::fprintf(stdout, "(rank %zu) %s\n", idx, rank.linebuf.c_str());
+    rank.linebuf.clear();
+    std::fflush(stdout);
+  }
+}
+
+void kill_all(std::vector<Rank>& ranks, int sig) {
+  for (auto& rank : ranks) {
+    if (rank.pid > 0 && rank.exit_code < 0) kill(-rank.pid, sig);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 2) {
+    std::fprintf(stderr, "usage: fanin <specfile>\n");
+    return 252;
+  }
+  std::signal(SIGTERM, signal_handler);
+  std::signal(SIGINT, signal_handler);
+  std::signal(SIGPIPE, SIG_IGN);
+
+  std::vector<Rank> ranks = parse_spec(read_file(argv[1]));
+  for (auto& rank : ranks) spawn(rank);
+
+  size_t alive = ranks.size();
+  bool failed = false;
+  int grace_polls_left = -1;  // countdown to SIGKILL after fail-fast
+
+  while (alive > 0) {
+    if (g_got_signal != 0) {
+      kill_all(ranks, SIGTERM);
+      g_got_signal = 0;
+      failed = true;
+      grace_polls_left = 50;  // ~5s then SIGKILL
+    }
+    std::vector<pollfd> pfds;
+    std::vector<size_t> owner;
+    for (size_t i = 0; i < ranks.size(); ++i) {
+      if (ranks[i].pipe_fd >= 0) {
+        pfds.push_back({ranks[i].pipe_fd, POLLIN, 0});
+        owner.push_back(i);
+      }
+    }
+    if (!pfds.empty()) {
+      int rv = poll(pfds.data(), pfds.size(), 100);
+      if (rv > 0) {
+        char buf[1 << 16];
+        for (size_t p = 0; p < pfds.size(); ++p) {
+          if (pfds[p].revents == 0) continue;
+          Rank& rank = ranks[owner[p]];
+          for (;;) {
+            ssize_t n = read(rank.pipe_fd, buf, sizeof(buf));
+            if (n > 0) {
+              emit_lines(rank, owner[p], buf, n);
+              continue;
+            }
+            if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+            // EOF or error: stream closed.
+            flush_tail(rank, owner[p]);
+            close(rank.pipe_fd);
+            rank.pipe_fd = -1;
+            break;
+          }
+        }
+      }
+    } else {
+      // All pipes closed; children may still be exiting.
+      usleep(50 * 1000);
+    }
+    // Reap exits.
+    for (size_t i = 0; i < ranks.size(); ++i) {
+      Rank& rank = ranks[i];
+      if (rank.pid <= 0 || rank.exit_code >= 0) continue;
+      int status = 0;
+      pid_t r = waitpid(rank.pid, &status, WNOHANG);
+      if (r == rank.pid) {
+        rank.exit_code = WIFEXITED(status) ? WEXITSTATUS(status)
+                         : 128 + WTERMSIG(status);
+        --alive;
+        if (rank.exit_code != 0 && !failed) {
+          // All-or-nothing: first failure cancels the gang.
+          failed = true;
+          std::fprintf(stdout,
+                       "(fanin) rank %zu exited %d; cancelling gang\n", i,
+                       rank.exit_code);
+          std::fflush(stdout);
+          kill_all(ranks, SIGTERM);
+          grace_polls_left = 50;
+        }
+      }
+    }
+    if (grace_polls_left > 0 && --grace_polls_left == 0) {
+      kill_all(ranks, SIGKILL);
+    }
+  }
+
+  // Drain any last buffered output, close logs.
+  for (size_t i = 0; i < ranks.size(); ++i) {
+    if (ranks[i].pipe_fd >= 0) {
+      char buf[1 << 16];
+      ssize_t n;
+      while ((n = read(ranks[i].pipe_fd, buf, sizeof(buf))) > 0)
+        emit_lines(ranks[i], i, buf, n);
+      flush_tail(ranks[i], i);
+      close(ranks[i].pipe_fd);
+    }
+    if (ranks[i].log_fd >= 0) close(ranks[i].log_fd);
+  }
+
+  std::string summary = "FANIN_EXIT {";
+  bool ok = true;
+  for (size_t i = 0; i < ranks.size(); ++i) {
+    if (i != 0) summary += ",";
+    summary += "\"" + std::to_string(i) +
+               "\":" + std::to_string(ranks[i].exit_code);
+    if (ranks[i].exit_code != 0) ok = false;
+  }
+  summary += "}";
+  std::fprintf(stdout, "%s\n", summary.c_str());
+  std::fflush(stdout);
+  return ok ? 0 : 1;
+}

@@ -147,14 +147,17 @@ class SkyServeController:
                            if r['version'] >= self.version]
         n_active = len(current_version)
         if n_active < decision.target_num_replicas:
-            # Spot/on-demand mix: the first `num_ondemand` replicas are
-            # on-demand, the rest spot (None = as the task asked).
-            use_spot: Optional[bool] = None
-            if decision.num_ondemand > 0:
-                n_ondemand = sum(
-                    1 for r in current_version if not r['is_spot'])
-                use_spot = n_ondemand >= decision.num_ondemand
+            # Spot/on-demand mix: keep `num_ondemand` on-demand replicas,
+            # the rest spot (None = as the task asked).  Recount per
+            # launch so a cold start fills the base before going spot.
+            n_ondemand = sum(
+                1 for r in current_version if not r['is_spot'])
             for _ in range(decision.target_num_replicas - n_active):
+                use_spot: Optional[bool] = None
+                if decision.num_ondemand > 0:
+                    use_spot = n_ondemand >= decision.num_ondemand
+                    if not use_spot:
+                        n_ondemand += 1
                 self.replica_manager.scale_up(use_spot=use_spot)
         elif n_active > decision.target_num_replicas:
             extra = n_active - decision.target_num_replicas

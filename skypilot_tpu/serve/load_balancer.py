@@ -125,14 +125,25 @@ class SkyServeLoadBalancer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
-                content = resp.raw.read()
                 self.send_response(resp.status_code)
                 for key, value in resp.headers.items():
                     if key.lower() not in _HOP_HEADERS:
                         self.send_header(key, value)
-                self.send_header('Content-Length', str(len(content)))
+                # Stream chunks through (SSE / LLM token streams must
+                # not be buffered); HTTP/1.1 + chunked framing.
+                self.send_header('Transfer-Encoding', 'chunked')
                 self.end_headers()
-                self.wfile.write(content)
+                try:
+                    for chunk in resp.iter_content(chunk_size=65536):
+                        if not chunk:
+                            continue
+                        self.wfile.write(
+                            f'{len(chunk):x}\r\n'.encode())
+                        self.wfile.write(chunk)
+                        self.wfile.write(b'\r\n')
+                    self.wfile.write(b'0\r\n\r\n')
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
 
             do_GET = _proxy
             do_POST = _proxy

@@ -74,14 +74,11 @@ class ReplicaManager:
 
     def scale_up(self, use_spot: Optional[bool] = None) -> int:
         """Launch one replica asynchronously; returns its id."""
-        replica_id = serve_state.next_replica_id(self.service_name)
+        replica_id = serve_state.allocate_replica(
+            self.service_name, self.service_name,
+            is_spot=bool(use_spot), version=self.version)
         cluster_name = self._cluster_name(replica_id)
         port = _free_port() if self._is_local() else self.spec.replica_port
-        serve_state.add_replica(self.service_name, replica_id,
-                                cluster_name,
-                                is_spot=bool(use_spot),
-                                version=self.version)
-        url = None  # filled once the cluster's head IP is known
         thread = threading.Thread(
             target=self._launch_replica,
             args=(replica_id, cluster_name, port, use_spot),
@@ -89,7 +86,6 @@ class ReplicaManager:
         with self._lock:
             self._launch_threads[replica_id] = thread
         thread.start()
-        del url
         return replica_id
 
     def _launch_replica(self, replica_id: int, cluster_name: str,
@@ -165,7 +161,11 @@ class ReplicaManager:
             serve_state.set_replica_status(self.service_name, replica_id,
                                            ReplicaStatus.NOT_READY)
         elif status is ReplicaStatus.STARTING:
-            first = self._first_probe_at.get(replica_id, time.time())
+            # Anchor on the persisted launch time so the timeout
+            # survives controller restarts (the in-memory map alone
+            # would reset the clock and never retire a dead replica).
+            first = self._first_probe_at.get(
+                replica_id, replica.get('launched_at') or time.time())
             if time.time() - first > self.spec.initial_delay_seconds:
                 logger.warning(f'replica {replica_id} never became ready '
                                f'within initial_delay; retiring')

@@ -230,9 +230,23 @@ def get_replicas(service_name: str) -> List[Dict[str, Any]]:
     return [dict(r) for r in rows]
 
 
-def next_replica_id(service_name: str) -> int:
+def allocate_replica(service_name: str, cluster_prefix: str,
+                     is_spot: bool = False, version: int = 1) -> int:
+    """Atomically claim the next replica id and insert its row (ids stay
+    monotonic and unique under concurrent scale-ups)."""
     with _conn() as conn:
-        row = conn.execute(
+        conn.execute(
+            'INSERT INTO replicas (service_name, replica_id, '
+            'cluster_name, status, is_spot, version, launched_at) '
+            "SELECT ?, COALESCE(MAX(replica_id), 0) + 1, '', ?, ?, ?, ? "
+            'FROM replicas WHERE service_name=?',
+            (service_name, ReplicaStatus.PROVISIONING.value,
+             int(is_spot), version, time.time(), service_name))
+        rid = conn.execute(
             'SELECT MAX(replica_id) FROM replicas WHERE service_name=?',
-            (service_name,)).fetchone()
-    return (row[0] or 0) + 1
+            (service_name,)).fetchone()[0]
+        conn.execute(
+            'UPDATE replicas SET cluster_name=? '
+            'WHERE service_name=? AND replica_id=?',
+            (f'{cluster_prefix}-{rid}', service_name, rid))
+    return rid

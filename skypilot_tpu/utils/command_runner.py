@@ -105,6 +105,13 @@ class CommandRunner:
               log_path: str = os.devnull, stream_logs: bool = True) -> None:
         raise NotImplementedError
 
+    def spawn_spec(self, cmd: str) -> Optional[List[str]]:
+        """argv that runs `cmd` on this node as a standalone child
+        process (for the native gang fan-in); None when the runner
+        cannot express itself as a plain argv."""
+        del cmd
+        return None
+
     def check_connection(self) -> bool:
         returncode = self.run('true', connect_timeout=5, stream_logs=False,
                               require_outputs=False)
@@ -214,6 +221,11 @@ class SSHCommandRunner(CommandRunner):
                           require_outputs=require_outputs, log_path=log_path,
                           stream_logs=stream_logs)
 
+    def spawn_spec(self, cmd: str) -> Optional[List[str]]:
+        base = self._ssh_base_command(ssh_mode=SshMode.NON_INTERACTIVE,
+                                      connect_timeout=None)
+        return base + [f'bash -c {shlex.quote(cmd)}']
+
     def rsync(self, source: str, target: str, *, up: bool,
               log_path: str = os.devnull, stream_logs: bool = True) -> None:
         rsync_command = ['rsync', RSYNC_DISPLAY_OPTION]
@@ -296,6 +308,15 @@ class LocalProcessRunner(CommandRunner):
         return _run_local(cmd, shell=True, require_outputs=require_outputs,
                           log_path=log_path, stream_logs=stream_logs, env=env,
                           cwd=self.root_dir)
+
+    def spawn_spec(self, cmd: str) -> Optional[List[str]]:
+        # env(1) options must precede KEY=VALUE assignments.
+        argv = ['env', '-C', self.root_dir]
+        if 'SKYTPU_JOB_DB' not in self._env:
+            argv += ['-u', 'SKYTPU_JOB_DB']
+        argv += [f'HOME={self.root_dir}']
+        argv += [f'{k}={v}' for k, v in self._env.items()]
+        return argv + ['bash', '-c', cmd]
 
     def rsync(self, source: str, target: str, *, up: bool,
               log_path: str = os.devnull, stream_logs: bool = True) -> None:

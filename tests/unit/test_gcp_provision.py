@@ -1,0 +1,295 @@
+"""GCP TPU-VM provisioner tests against a faked TPU REST API.
+
+The injectable transport (tpu_api.set_session_factory) is the hermetic
+seam the reference lacks (SURVEY.md §4: "no mocked/fake cloud
+provisioner" — fixed here).
+"""
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common as pcommon
+from skypilot_tpu.provision.gcp import instance as gcp_instance
+from skypilot_tpu.provision.gcp import tpu_api
+from skypilot_tpu.status_lib import ClusterStatus
+
+
+class _Resp:
+
+    def __init__(self, status_code=200, payload=None):
+        self.status_code = status_code
+        self._payload = payload if payload is not None else {}
+        self.content = json.dumps(self._payload).encode()
+        self.text = json.dumps(self._payload)
+
+    def json(self):
+        return self._payload
+
+
+class FakeTpuService:
+    """In-memory TPU API: nodes + queued resources + instant LROs."""
+
+    def __init__(self):
+        self.nodes: Dict[str, dict] = {}        # 'zone/node_id' -> node
+        self.queued: Dict[str, dict] = {}
+        self.create_calls = []
+        self.deleted = []
+        # Test hooks
+        self.fail_create_with = None            # GcpApiError status to raise
+        self.qr_states = None                   # iterator of QR states
+
+    # requests.Session interface ------------------------------------
+
+    def request(self, method, url, json=None, params=None, headers=None,
+                timeout=None):
+        del headers, timeout
+        path = url.replace(tpu_api.TPU_API + '/', '')
+        m = re.match(
+            r'projects/(?P<proj>[^/]+)/locations/(?P<zone>[^/]+)'
+            r'(?P<rest>/.*)?$', path)
+        assert m, path
+        zone, rest = m.group('zone'), m.group('rest') or ''
+        if rest.startswith('/nodes'):
+            return self._nodes(method, zone, rest, json, params)
+        if rest.startswith('/queuedResources'):
+            return self._queued(method, zone, rest, json, params)
+        raise AssertionError(f'unhandled path {path}')
+
+    def _nodes(self, method, zone, rest, body, params):
+        if rest == '/nodes' and method == 'POST':
+            node_id = params['nodeId']
+            if self.fail_create_with:
+                status = self.fail_create_with
+                self.fail_create_with = None
+                return _Resp(status,
+                             {'error': {'message': 'no more capacity'}})
+            self.create_calls.append((zone, node_id, body))
+            node = dict(body)
+            node['state'] = 'READY'
+            node.setdefault('networkEndpoints', [
+                {'ipAddress': '10.0.0.1',
+                 'accessConfig': {'externalIp': '34.1.2.3'}},
+            ])
+            self.nodes[f'{zone}/{node_id}'] = node
+            return _Resp(200, {'name': 'op/create', 'done': True})
+        m = re.match(r'/nodes/(?P<nid>[^:/]+)(?P<verb>:\w+)?$', rest)
+        assert m, rest
+        key = f'{zone}/{m.group("nid")}'
+        node = self.nodes.get(key)
+        verb = m.group('verb')
+        if method == 'GET':
+            if node is None:
+                return _Resp(404, {'error': {'message': 'not found'}})
+            return _Resp(200, node)
+        if method == 'DELETE':
+            if node is None:
+                return _Resp(404, {'error': {'message': 'not found'}})
+            self.deleted.append(key)
+            del self.nodes[key]
+            return _Resp(200, {'name': 'op/delete', 'done': True})
+        if verb == ':stop':
+            node['state'] = 'STOPPED'
+            return _Resp(200, {'name': 'op/stop', 'done': True})
+        if verb == ':start':
+            node['state'] = 'READY'
+            return _Resp(200, {'name': 'op/start', 'done': True})
+        raise AssertionError(f'unhandled {method} {rest}')
+
+    def _queued(self, method, zone, rest, body, params):
+        if rest == '/queuedResources' and method == 'POST':
+            qr_id = params['queuedResourceId']
+            self.queued[f'{zone}/{qr_id}'] = {
+                'body': body,
+                'state': {'state': 'WAITING_FOR_RESOURCES'},
+            }
+            return _Resp(200, {'name': 'op/qr', 'done': True})
+        m = re.match(r'/queuedResources/(?P<qid>[^:/]+)$', rest)
+        assert m, rest
+        key = f'{zone}/{m.group("qid")}'
+        qr = self.queued.get(key)
+        if method == 'GET':
+            if qr is None:
+                return _Resp(404, {'error': {'message': 'not found'}})
+            if self.qr_states is not None:
+                try:
+                    qr['state'] = {'state': next(self.qr_states)}
+                except StopIteration:
+                    pass
+            if qr['state']['state'] == 'ACTIVE':
+                # Fulfilment: materialize the requested nodes.
+                for spec in qr['body']['tpu']['nodeSpec']:
+                    node = dict(spec['node'])
+                    node['state'] = 'READY'
+                    node.setdefault('networkEndpoints', [
+                        {'ipAddress': '10.0.0.9',
+                         'accessConfig': {'externalIp': '34.9.9.9'}}])
+                    self.nodes[f'{zone}/{spec["nodeId"]}'] = node
+            return _Resp(200, qr)
+        if method == 'DELETE':
+            if qr is None:
+                return _Resp(404, {'error': {'message': 'not found'}})
+            del self.queued[key]
+            return _Resp(200, {'name': 'op/qrdel', 'done': True})
+        raise AssertionError(f'unhandled {method} {rest}')
+
+
+@pytest.fixture()
+def fake_api(monkeypatch):
+    service = FakeTpuService()
+    monkeypatch.setattr(tpu_api, '_session_factory', lambda: service)
+    monkeypatch.setattr(tpu_api, '_gcloud_token', lambda: 'fake-token')
+    monkeypatch.setenv('SKYTPU_GCP_PROJECT', 'test-proj')
+    yield service
+
+
+def _config(cluster='tc1', mode='on_demand', num_slices=1,
+            accel='v5litepod-8', hosts=2):
+    return pcommon.ProvisionConfig(
+        provider_name='gcp', cluster_name=cluster, region='us-central2',
+        zones=['us-central2-b'],
+        deploy_vars={
+            'tpu': True,
+            'tpu_accelerator_type': accel,
+            'tpu_runtime_version': 'tpu-ubuntu2204-base',
+            'tpu_num_hosts': hosts,
+            'provision_mode': mode,
+            'num_slices': num_slices,
+            'use_spot': mode == 'spot',
+            'labels': {'team': 'ml'},
+        })
+
+
+@pytest.fixture(autouse=True)
+def _fake_keys(monkeypatch):
+    monkeypatch.setattr(
+        'skypilot_tpu.authentication.gcp_ssh_metadata',
+        lambda ssh_user='skytpu': f'{ssh_user}:ssh-ed25519 FAKEKEY')
+    monkeypatch.setattr(
+        'skypilot_tpu.authentication.get_or_generate_keys',
+        lambda: ('/fake/key', '/fake/key.pub'))
+
+
+class TestOnDemand:
+
+    def test_create_and_info(self, fake_api):
+        record = gcp_instance.run_instances(_config())
+        assert record.created_instance_ids == ['tc1']
+        assert not record.waiting
+        zone, node_id, body = fake_api.create_calls[0]
+        assert zone == 'us-central2-b'
+        assert body['acceleratorType'] == 'v5litepod-8'
+        assert body['labels']['skytpu-cluster'] == 'tc1'
+        assert 'ssh-keys' in body['metadata']
+        assert 'schedulingConfig' not in body
+
+        gcp_instance.wait_instances('tc1')
+        info = gcp_instance.get_cluster_info('tc1')
+        assert info.num_hosts == 1
+        assert info.instances[0].external_ip == '34.1.2.3'
+        assert info.ssh_user == 'skytpu'
+
+        statuses = gcp_instance.query_instances('tc1')
+        assert statuses == {'tc1': ClusterStatus.UP}
+
+    def test_idempotent_rerun(self, fake_api):
+        gcp_instance.run_instances(_config())
+        record = gcp_instance.run_instances(_config())
+        assert record.created_instance_ids == []
+        assert len(fake_api.create_calls) == 1
+
+    def test_stop_start_single_host(self, fake_api):
+        gcp_instance.run_instances(_config(hosts=1))
+        gcp_instance.stop_instances('tc1')
+        assert gcp_instance.query_instances('tc1') == {
+            'tc1': ClusterStatus.STOPPED}
+        record = gcp_instance.run_instances(_config(hosts=1))
+        assert record.resumed_instance_ids == ['tc1']
+        assert gcp_instance.query_instances('tc1') == {
+            'tc1': ClusterStatus.UP}
+
+    def test_multihost_stop_rejected(self, fake_api):
+        gcp_instance.run_instances(_config(hosts=4))
+        gcp_instance.get_cluster_info('tc1')  # records num_hosts
+        with pytest.raises(exceptions.NotSupportedError):
+            gcp_instance.stop_instances('tc1')
+
+    def test_terminate(self, fake_api):
+        gcp_instance.run_instances(_config())
+        gcp_instance.terminate_instances('tc1')
+        assert fake_api.deleted == ['us-central2-b/tc1']
+        assert gcp_instance.query_instances('tc1') == {}
+        # idempotent
+        gcp_instance.terminate_instances('tc1')
+
+
+class TestSpot:
+
+    def test_spot_scheduling_config(self, fake_api):
+        gcp_instance.run_instances(_config(mode='spot'))
+        _, _, body = fake_api.create_calls[0]
+        assert body['schedulingConfig']['preemptible'] is True
+
+    def test_preempted_node_deleted_then_recreated(self, fake_api):
+        gcp_instance.run_instances(_config(mode='spot'))
+        fake_api.nodes['us-central2-b/tc1']['state'] = 'PREEMPTED'
+        assert gcp_instance.query_instances('tc1') == {'tc1': None}
+        record = gcp_instance.run_instances(_config(mode='spot'))
+        assert record.created_instance_ids == ['tc1']
+        assert fake_api.deleted == ['us-central2-b/tc1']
+        assert len(fake_api.create_calls) == 2
+
+
+class TestMultislice:
+
+    def test_two_slices_two_nodes(self, fake_api):
+        record = gcp_instance.run_instances(_config(num_slices=2))
+        assert record.created_instance_ids == ['tc1-0', 'tc1-1']
+        info = gcp_instance.get_cluster_info('tc1')
+        assert [i.slice_id for i in info.instances] == [0, 1]
+        assert info.instances[0].tags['node_id'] == 'tc1-0'
+
+
+class TestQueuedResources:
+
+    def test_queued_waits_then_fulfils(self, fake_api):
+        record = gcp_instance.run_instances(_config(mode='queued'))
+        assert record.waiting
+        assert record.queued_resource_id == 'tc1'
+        assert 'us-central2-b/tc1' in fake_api.queued
+        # Capacity not granted yet:
+        assert gcp_instance.wait_capacity('tc1', timeout=0) is False
+        # Grant it:
+        fake_api.queued['us-central2-b/tc1']['state'] = {
+            'state': 'ACTIVE'}
+        assert gcp_instance.wait_capacity('tc1', timeout=0) is True
+        info = gcp_instance.get_cluster_info('tc1')
+        assert info.num_hosts == 1
+
+    def test_queued_failure_raises(self, fake_api):
+        gcp_instance.run_instances(_config(mode='queued'))
+        fake_api.queued['us-central2-b/tc1']['state'] = {
+            'state': 'FAILED'}
+        with pytest.raises(exceptions.ProvisionError):
+            gcp_instance.wait_capacity('tc1', timeout=0)
+
+    def test_terminate_deletes_queued_resource(self, fake_api):
+        gcp_instance.run_instances(_config(mode='queued'))
+        fake_api.queued['us-central2-b/tc1']['state'] = {
+            'state': 'ACTIVE'}
+        gcp_instance.wait_capacity('tc1', timeout=0)
+        gcp_instance.terminate_instances('tc1')
+        assert fake_api.queued == {}
+
+
+class TestErrors:
+
+    def test_capacity_error_classified(self, fake_api):
+        fake_api.fail_create_with = 429
+        with pytest.raises(tpu_api.GcpApiError) as err:
+            gcp_instance.run_instances(_config())
+        assert err.value.is_quota_or_capacity

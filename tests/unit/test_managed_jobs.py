@@ -50,7 +50,7 @@ def _submit(task_or_dag, name=None):
     from skypilot_tpu.utils import dag_utils
     dag = dag_utils.convert_entrypoint_to_dag(task_or_dag)
     job_name = name or dag.name or 'mjob'
-    job_id = state.next_job_id()
+    job_id = state.allocate_job_id(job_name)
     yaml_path = os.path.join(jobs_core._dag_yaml_dir(),  # pylint: disable=protected-access
                              f'{job_name}-{job_id}.yaml')
     dag_utils.dump_chain_dag_to_yaml(dag, yaml_path)

@@ -1,0 +1,100 @@
+"""Native (C++) gang fan-in tests: build, multiplex, fail-fast kill."""
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_user_state
+from skypilot_tpu import native
+
+
+@pytest.fixture()
+def fanin_binary():
+    binary = native.ensure_fanin_built()
+    if binary is None:
+        pytest.skip('no C++ toolchain available')
+    return binary
+
+
+def _run(binary, tmp_path, argvs, logs=None):
+    logs = logs or [str(tmp_path / f'rank-{i}.log')
+                    for i in range(len(argvs))]
+    spec = str(tmp_path / 'spec')
+    native.write_spec(spec, logs, argvs)
+    proc = subprocess.run([binary, spec], capture_output=True, text=True,
+                          check=False, timeout=60)
+    return proc, logs
+
+
+class TestFanin:
+
+    def test_multiplexes_and_prefixes(self, fanin_binary, tmp_path):
+        proc, logs = _run(fanin_binary, tmp_path, [
+            ['bash', '-c', 'echo from-zero'],
+            ['bash', '-c', 'echo from-one'],
+        ])
+        assert proc.returncode == 0
+        assert '(rank 0) from-zero' in proc.stdout
+        assert '(rank 1) from-one' in proc.stdout
+        assert 'FANIN_EXIT {"0":0,"1":0}' in proc.stdout
+        assert 'from-zero' in open(logs[0], encoding='utf-8').read()
+        assert 'from-one' in open(logs[1], encoding='utf-8').read()
+
+    def test_fail_fast_kills_gang(self, fanin_binary, tmp_path):
+        marker = tmp_path / 'finished_sleep'
+        start = time.time()
+        proc, _ = _run(fanin_binary, tmp_path, [
+            ['bash', '-c', f'sleep 30 && touch {marker}'],
+            ['bash', '-c', 'sleep 0.2; exit 7'],
+        ])
+        elapsed = time.time() - start
+        assert proc.returncode == 1
+        assert elapsed < 20, 'gang was not cancelled promptly'
+        assert not marker.exists()
+        assert '"1":7' in proc.stdout
+        assert 'cancelling gang' in proc.stdout
+
+    def test_nonzero_exit_reported_per_rank(self, fanin_binary, tmp_path):
+        proc, _ = _run(fanin_binary, tmp_path, [
+            ['bash', '-c', 'exit 3'],
+        ])
+        assert proc.returncode == 1
+        assert 'FANIN_EXIT {"0":3}' in proc.stdout
+
+    def test_run_fanin_wrapper_parses_exit(self, fanin_binary, tmp_path):
+        spec = str(tmp_path / 'spec')
+        native.write_spec(
+            spec, [str(tmp_path / 'l0.log')],
+            [['bash', '-c', 'echo hi; exit 5']])
+        codes = native.run_fanin(fanin_binary, spec)
+        assert codes == {0: 5}
+
+
+class TestGangUsesNative:
+
+    def test_launch_via_native_fanin(self, monkeypatch):
+        """End-to-end launch goes through the C++ supervisor (native
+        disabled → this still passes via fallback, so assert on the
+        binary actually being built and used)."""
+        if native.ensure_fanin_built() is None:
+            pytest.skip('no C++ toolchain available')
+        global_user_state.set_enabled_clouds(['local'])
+        task = sky.Task(name='nat', run='echo NATIVE_GANG_OK',
+                        num_nodes=2)
+        task.set_resources(sky.Resources(cloud='local'))
+        job_id = sky.launch(task, cluster_name='nat-c1',
+                            stream_logs=False)
+        import io
+        import contextlib
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            sky.tail_logs('nat-c1', job_id, follow=False)
+        out = buf.getvalue()
+        assert out.count('NATIVE_GANG_OK') == 2
+        # The native path prefixes ranks.
+        assert '(rank 0)' in out or '(rank 1)' in out
+        sky.down('nat-c1')
