@@ -10,7 +10,12 @@ from __future__ import annotations
 __version__ = '0.1.0'
 
 from skypilot_tpu import clouds
+from skypilot_tpu import jobs
+from skypilot_tpu import serve
 from skypilot_tpu.check import check
+from skypilot_tpu.data.storage import Storage
+from skypilot_tpu.data.storage import StorageMode
+from skypilot_tpu.data.storage import StoreType
 from skypilot_tpu.core import autostop
 from skypilot_tpu.core import cancel
 from skypilot_tpu.core import cost_report
@@ -31,16 +36,21 @@ from skypilot_tpu.resources import Resources
 from skypilot_tpu.task import Task
 
 GCP = clouds.GCP
+GKE = clouds.GKE
 Local = clouds.Local
 
 __all__ = [
     '__version__',
     'Dag',
     'GCP',
+    'GKE',
     'Local',
     'Optimizer',
     'OptimizeTarget',
     'Resources',
+    'Storage',
+    'StorageMode',
+    'StoreType',
     'Task',
     'autostop',
     'cancel',
@@ -50,8 +60,10 @@ __all__ = [
     'download_logs',
     'exec',
     'job_status',
+    'jobs',
     'launch',
     'queue',
+    'serve',
     'start',
     'status',
     'stop',

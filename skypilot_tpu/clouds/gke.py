@@ -1,0 +1,88 @@
+"""GKE: TPU slices as node pools in a Google Kubernetes Engine cluster.
+
+The reference's Kubernetes path has **no TPU support**
+(/root/reference/sky/provision/kubernetes/utils.py:517 TODO); here GKE
+TPU node pools are a first-class second provisioner (SURVEY.md §7.8).
+Pricing/regions reuse the GCP TPU catalog (node pools bill as the
+underlying TPU VMs).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.clouds import gcp
+
+# GKE TPU machine types per generation x chips-per-host
+# (cloud.google.com/kubernetes-engine/docs/concepts/tpus).
+_MACHINE_TYPES = {
+    ('v4', 4): 'ct4p-hightpu-4t',
+    ('v5p', 4): 'ct5p-hightpu-4t',
+    ('v5e', 1): 'ct5lp-hightpu-1t',
+    ('v5e', 4): 'ct5lp-hightpu-4t',
+    ('v5e', 8): 'ct5lp-hightpu-8t',
+    ('v5litepod', 1): 'ct5lp-hightpu-1t',
+    ('v5litepod', 4): 'ct5lp-hightpu-4t',
+    ('v5litepod', 8): 'ct5lp-hightpu-8t',
+    ('v6e', 1): 'ct6e-standard-1t',
+    ('v6e', 4): 'ct6e-standard-4t',
+    ('v6e', 8): 'ct6e-standard-8t',
+}
+
+
+class GKE(gcp.GCP):
+    _REPR = 'GKE'
+    PROVISIONER = 'gke'
+
+    _CLOUD_UNSUPPORTED_FEATURES = {
+        **gcp.GCP._CLOUD_UNSUPPORTED_FEATURES,  # pylint: disable=protected-access
+        cloud_lib.CloudImplementationFeatures.STOP:
+            'GKE node pools are deleted, not stopped.',
+    }
+
+    def get_feasible_launchable_resources(self, resources):
+        # TPU-only: GKE CPU/GPU workloads go through the k8s ecosystem
+        # proper; this cloud exists to gang-schedule TPU slices.
+        spec = resources.tpu_spec
+        if spec is None:
+            return [], []
+        chips_per_host = max(1, spec.num_chips // max(1, spec.num_hosts))
+        if (spec.generation, chips_per_host) not in _MACHINE_TYPES:
+            # No node-pool machine type (e.g. v2/v3): reject at optimize
+            # time so the search falls back to GCP TPU-VMs instead of
+            # failing deep in provisioning.
+            fuzzy = sorted({f'tpu-{gen}'
+                            for gen, _ in _MACHINE_TYPES})
+            return [], fuzzy
+        return super().get_feasible_launchable_resources(resources)
+
+    def make_deploy_resources_variables(self, resources, cluster_name,
+                                        region, zones) -> Dict[str, Any]:
+        common = super().make_deploy_resources_variables(
+            resources, cluster_name, region, zones)
+        spec = resources.tpu_spec
+        assert spec is not None
+        chips_per_host = max(1, spec.num_chips // max(1, spec.num_hosts))
+        machine_type = _MACHINE_TYPES.get(
+            (spec.generation, chips_per_host))
+        common.update({
+            'gke_cluster': config_lib.get_nested(('gke', 'cluster'), None),
+            'gke_location': config_lib.get_nested(('gke', 'location'),
+                                                  region.name),
+            'gke_machine_type': machine_type,
+            'gke_namespace': config_lib.get_nested(('gke', 'namespace'),
+                                                   'default'),
+            'gke_context': config_lib.get_nested(('gke', 'context'), None),
+        })
+        return common
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        ok, hint = super().check_credentials()
+        if not ok:
+            return ok, hint
+        if config_lib.get_nested(('gke', 'cluster'), None) is None:
+            return False, ('Set gke.cluster (and gke.location) in '
+                           '~/.skytpu/config.yaml to name the GKE '
+                           'cluster that hosts TPU node pools.')
+        return True, None

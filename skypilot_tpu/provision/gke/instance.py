@@ -1,0 +1,401 @@
+"""GKE TPU node-pool provisioner.
+
+The reference's Kubernetes path has no TPU support
+(/root/reference/sky/provision/kubernetes/utils.py:517 TODO); this
+provisioner makes GKE TPU node pools a first-class slice substrate
+(SURVEY.md §7.8):
+
+- capacity: one TPU node pool per skytpu cluster
+  (`gcloud container node-pools create --tpu-topology ...`);
+- hosts: one long-running "host pod" per TPU VM, pinned to the pool via
+  nodeSelector + `google.com/tpu` resource requests (kubectl);
+- access: KubernetesCommandRunner (`kubectl exec`), so the whole
+  backend/skylet/gang stack runs unchanged on pods.
+
+All gcloud/kubectl invocations go through an injectable `_run_cli` seam
+so the provisioner is hermetically testable (same design as the GCP
+TPU REST transport).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import subprocess
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.utils import command_runner
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+_LABEL = 'skytpu-cluster'
+_POD_IMAGE = 'python:3.11-slim'
+
+
+def _default_run_cli(argv: List[str],
+                     stdin: Optional[str] = None
+                     ) -> subprocess.CompletedProcess:
+    logger.debug(f'gke: $ {" ".join(argv)}')
+    return subprocess.run(argv, input=stdin, capture_output=True,
+                          text=True, check=False, timeout=600)
+
+
+# Test seam.
+_run_cli: Callable[..., subprocess.CompletedProcess] = _default_run_cli
+
+
+def set_cli_runner(runner: Callable[..., subprocess.CompletedProcess]
+                   ) -> None:
+    global _run_cli
+    _run_cli = runner
+
+
+def _check(proc: subprocess.CompletedProcess, what: str,
+           allow_missing: bool = False) -> subprocess.CompletedProcess:
+    if proc.returncode != 0:
+        stderr = proc.stderr or ''
+        if allow_missing and ('NotFound' in stderr or
+                              'not found' in stderr):
+            return proc
+        raise exceptions.ProvisionError(
+            f'{what} failed: {stderr.strip()[-500:]}')
+    return proc
+
+
+# -------------------------------------------------------------- meta cache
+
+
+def _meta_dir() -> str:
+    return common_utils.ensure_dir(
+        os.path.join(common_utils.skytpu_home(), 'gke_clusters'))
+
+
+def _meta_path(name: str) -> str:
+    return os.path.join(_meta_dir(), f'{name}.json')
+
+
+def _read_meta(name: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_meta_path(name), encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _write_meta(name: str, meta: Dict[str, Any]) -> None:
+    with open(_meta_path(name), 'w', encoding='utf-8') as f:
+        json.dump(meta, f, indent=2)
+
+
+def _require_meta(name: str) -> Dict[str, Any]:
+    meta = _read_meta(name)
+    if meta is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'No GKE metadata for cluster {name!r}.')
+    return meta
+
+
+# ------------------------------------------------------------------ pieces
+
+
+def _pool_name(cluster_name: str) -> str:
+    return f'skytpu-{cluster_name}'[:39]  # GKE node-pool name limit 40
+
+
+def _create_node_pool(meta: Dict[str, Any],
+                      deploy: Dict[str, Any]) -> None:
+    argv = [
+        'gcloud', 'container', 'node-pools', 'create',
+        meta['pool_name'],
+        '--cluster', meta['gke_cluster'],
+        '--location', meta['gke_location'],
+        '--machine-type', meta['machine_type'],
+        '--num-nodes', str(meta['num_hosts']),
+        '--node-labels', f'{_LABEL}={meta["cluster_name"]}',
+    ]
+    topology = deploy.get('tpu_topology')
+    if topology and meta['num_hosts'] > 1:
+        argv += ['--tpu-topology', topology]
+    if deploy.get('use_spot'):
+        argv += ['--spot']
+    existing = _run_cli(['gcloud', 'container', 'node-pools', 'describe',
+                         meta['pool_name'], '--cluster',
+                         meta['gke_cluster'], '--location',
+                         meta['gke_location'], '--format', 'json'])
+    if existing.returncode == 0:
+        return
+    _check(_run_cli(argv), 'node-pool create')
+
+
+def _pod_manifest(meta: Dict[str, Any], host_index: int) -> Dict[str, Any]:
+    chips = meta['chips_per_host']
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Pod',
+        'metadata': {
+            'name': f'{meta["cluster_name"]}-host{host_index}',
+            'namespace': meta['namespace'],
+            'labels': {_LABEL: meta['cluster_name'],
+                       'skytpu-host': str(host_index)},
+        },
+        'spec': {
+            'restartPolicy': 'Never',
+            'nodeSelector': {
+                'cloud.google.com/gke-nodepool': meta['pool_name'],
+            },
+            'containers': [{
+                'name': 'host',
+                'image': _POD_IMAGE,
+                'command': ['bash', '-c', 'sleep infinity'],
+                'resources': {
+                    'requests': {'google.com/tpu': str(chips)},
+                    'limits': {'google.com/tpu': str(chips)},
+                },
+            }],
+        },
+    }
+
+
+def _kubectl(meta: Dict[str, Any], *args: str,
+             stdin: Optional[str] = None) -> subprocess.CompletedProcess:
+    base = ['kubectl']
+    if meta.get('context'):
+        base += ['--context', meta['context']]
+    base += ['-n', meta['namespace']]
+    return _run_cli(base + list(args), stdin=stdin)
+
+
+def _ensure_credentials(meta: Dict[str, Any]) -> None:
+    """Point kubectl at the configured GKE cluster (not whatever the
+    ambient current-context happens to be)."""
+    if meta.get('context'):
+        return  # explicit gke.context: user manages kubeconfig
+    proc = _run_cli(['gcloud', 'container', 'clusters', 'get-credentials',
+                     meta['gke_cluster'], '--location',
+                     meta['gke_location']])
+    if proc.returncode != 0:
+        raise exceptions.ProvisionError(
+            f'cannot get kubectl credentials for GKE cluster '
+            f'{meta["gke_cluster"]}: {(proc.stderr or "").strip()[-300:]}')
+    # gcloud names the context gke_<project>_<location>_<cluster>; it
+    # also sets it current, but pin it explicitly for later calls.
+    probe = _run_cli(['kubectl', 'config', 'current-context'])
+    if probe.returncode == 0 and probe.stdout.strip():
+        meta['context'] = probe.stdout.strip()
+
+
+# ------------------------------------------------------------------ the API
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    deploy = config.deploy_vars
+    if not deploy.get('tpu'):
+        raise exceptions.NotSupportedError(
+            'The gke provisioner schedules TPU slices only.')
+    gke_cluster = deploy.get('gke_cluster')
+    if not gke_cluster:
+        raise exceptions.ProvisionError(
+            'gke.cluster is not configured (~/.skytpu/config.yaml).')
+    if not deploy.get('gke_machine_type'):
+        raise exceptions.ProvisionError(
+            f'No GKE TPU machine type for {deploy.get("tpu_accelerator_type")!r}.')
+    num_hosts = int(deploy.get('tpu_num_hosts') or 1)
+    meta = {
+        'cluster_name': config.cluster_name,
+        'gke_cluster': gke_cluster,
+        'gke_location': deploy.get('gke_location') or config.region,
+        'namespace': deploy.get('gke_namespace') or 'default',
+        'machine_type': deploy['gke_machine_type'],
+        'pool_name': _pool_name(config.cluster_name),
+        'num_hosts': num_hosts,
+        'chips_per_host': max(1, int(deploy.get('tpu_num_chips') or 1) //
+                              num_hosts),
+        'context': deploy.get('gke_context'),
+    }
+    _ensure_credentials(meta)
+    _write_meta(config.cluster_name, meta)
+    _create_node_pool(meta, deploy)
+
+    record = common.ProvisionRecord(
+        provider_name='gke', cluster_name=config.cluster_name,
+        region=config.region, zone=meta['gke_location'],
+        head_instance_id=f'{config.cluster_name}-host0')
+    for i in range(num_hosts):
+        pod = _pod_manifest(meta, i)
+        name = pod['metadata']['name']
+        exists = _kubectl(meta, 'get', 'pod', name, '-o', 'name')
+        if exists.returncode == 0:
+            record.resumed_instance_ids.append(name)
+            continue
+        _check(_kubectl(meta, 'apply', '-f', '-',
+                        stdin=json.dumps(pod)), f'pod {name} create')
+        record.created_instance_ids.append(name)
+    return record
+
+
+def wait_instances(cluster_name: str, state: Optional[str] = None) -> None:
+    del state
+    meta = _require_meta(cluster_name)
+    deadline = time.time() + 1800
+    while True:
+        pods = _pods(meta)
+        phases = [p['status'].get('phase') for p in pods]
+        if len(pods) >= meta['num_hosts'] and all(
+                ph == 'Running' for ph in phases):
+            return
+        # Fail fast on terminal pod phases — waiting out the full
+        # deadline would stall zone/cloud failover for 30 min.
+        bad = [ph for ph in phases
+               if ph in ('Failed', 'Succeeded', 'Unknown')]
+        if bad:
+            raise exceptions.ProvisionError(
+                f'GKE pods for {cluster_name} entered terminal '
+                f'phase(s) {bad} before becoming Running.')
+        if time.time() > deadline:
+            raise exceptions.ProvisionError(
+                f'GKE pods for {cluster_name} not Running: {phases}')
+        time.sleep(10)
+
+
+def wait_capacity(cluster_name: str, timeout: float = 0) -> bool:
+    del cluster_name, timeout
+    return True
+
+
+def _pods(meta: Dict[str, Any],
+          raise_on_error: bool = True) -> List[Dict[str, Any]]:
+    proc = _kubectl(meta, 'get', 'pods', '-l',
+                    f'{_LABEL}={meta["cluster_name"]}', '-o', 'json')
+    if proc.returncode != 0:
+        if raise_on_error:
+            # A transient kubectl failure must NOT read as "all pods
+            # gone" — callers (status refresh) would terminate the
+            # cluster record while the node pool keeps billing.
+            raise exceptions.ClusterStatusFetchingError(
+                f'kubectl get pods failed: '
+                f'{(proc.stderr or "").strip()[-300:]}')
+        return []
+    return json.loads(proc.stdout).get('items', [])
+
+
+def stop_instances(cluster_name: str, worker_only: bool = False) -> None:
+    del worker_only
+    raise exceptions.NotSupportedError(
+        'GKE node pools are deleted, not stopped.')
+
+
+def terminate_instances(cluster_name: str,
+                        worker_only: bool = False) -> None:
+    del worker_only
+    meta = _read_meta(cluster_name)
+    if meta is None:
+        return
+    _kubectl(meta, 'delete', 'pods', '-l', f'{_LABEL}={cluster_name}',
+             '--ignore-not-found', '--wait=false')
+    _check(_run_cli(['gcloud', 'container', 'node-pools', 'delete',
+                     meta['pool_name'], '--cluster', meta['gke_cluster'],
+                     '--location', meta['gke_location'], '--quiet']),
+           'node-pool delete', allow_missing=True)
+    try:
+        os.remove(_meta_path(cluster_name))
+    except OSError:
+        pass
+
+
+def query_instances(cluster_name: str
+                    ) -> Dict[str, Optional[ClusterStatus]]:
+    meta = _read_meta(cluster_name)
+    if meta is None:
+        return {}
+    out: Dict[str, Optional[ClusterStatus]] = {}
+    phase_map = {
+        'Pending': ClusterStatus.INIT,
+        'Running': ClusterStatus.UP,
+        'Succeeded': None,
+        'Failed': None,
+        'Unknown': None,
+    }
+    pods = {p['metadata']['name']: p for p in _pods(meta)}  # raises on
+    # kubectl failure → status refresh keeps the recorded state
+    for i in range(meta['num_hosts']):
+        name = f'{cluster_name}-host{i}'
+        pod = pods.get(name)
+        out[name] = (phase_map.get(pod['status'].get('phase'))
+                     if pod else None)
+    return out
+
+
+def get_cluster_info(cluster_name: str,
+                     region: Optional[str] = None) -> common.ClusterInfo:
+    del region
+    meta = _require_meta(cluster_name)
+    instances = []
+    for pod in sorted(_pods(meta),
+                      key=lambda p: int(
+                          p['metadata']['labels'].get('skytpu-host', 0))):
+        idx = int(pod['metadata']['labels'].get('skytpu-host', 0))
+        instances.append(common.InstanceInfo(
+            instance_id=pod['metadata']['name'],
+            internal_ip=pod['status'].get('podIP', ''),
+            external_ip=None,
+            slice_id=0,
+            worker_id=idx,
+            tags={'namespace': meta['namespace']},
+        ))
+    return common.ClusterInfo(
+        provider_name='gke',
+        cluster_name=cluster_name,
+        region=meta['gke_location'],
+        zone=meta['gke_location'],
+        instances=instances,
+        head_instance_id=instances[0].instance_id if instances else None,
+        ssh_user='root',
+        custom_metadata={'namespace': meta['namespace'],
+                         'pool_name': meta['pool_name'],
+                         'context': meta.get('context')},
+    )
+
+
+def open_ports(cluster_name: str, ports: List[int]) -> None:
+    meta = _require_meta(cluster_name)
+    # Expose via a NodePort service per opened port set.
+    service = {
+        'apiVersion': 'v1',
+        'kind': 'Service',
+        'metadata': {'name': f'{cluster_name}-svc',
+                     'namespace': meta['namespace']},
+        'spec': {
+            'type': 'NodePort',
+            'selector': {_LABEL: cluster_name, 'skytpu-host': '0'},
+            'ports': [{'name': f'p{p}', 'port': p, 'targetPort': p}
+                      for p in ports],
+        },
+    }
+    _check(_kubectl(meta, 'apply', '-f', '-', stdin=json.dumps(service)),
+           'service create')
+
+
+def cleanup_ports(cluster_name: str) -> None:
+    meta = _read_meta(cluster_name)
+    if meta is None:
+        return
+    _kubectl(meta, 'delete', 'service', f'{cluster_name}-svc',
+             '--ignore-not-found')
+
+
+def get_command_runners(cluster_info: common.ClusterInfo,
+                        **kwargs: Any) -> List[Any]:
+    namespace = cluster_info.custom_metadata.get('namespace', 'default')
+    context = cluster_info.custom_metadata.get('context')
+    return [
+        command_runner.KubernetesCommandRunner(
+            node=(inst.instance_id, 0), namespace=namespace,
+            context=context, **kwargs)
+        for inst in cluster_info.instances
+    ]

@@ -405,3 +405,137 @@ def wait_until_ready(runners: List[CommandRunner], timeout: float = 300,
             raise TimeoutError(
                 f'Hosts not reachable after {timeout}s: {ids}')
         time.sleep(poll_interval)
+
+
+class KubernetesCommandRunner(CommandRunner):
+    """Runner for pods (GKE TPU node-pool hosts) via `kubectl exec`.
+
+    Parity: reference command_runner.py:656-857 (KubernetesCommandRunner) —
+    pods stand in for slice hosts; file transfer rides `kubectl exec` + tar
+    (no rsync dependency inside minimal TPU images).
+    """
+
+    def __init__(self, node: Tuple[str, int], namespace: str = 'default',
+                 context: Optional[str] = None, container: Optional[str] = None,
+                 **kwargs: Any) -> None:
+        super().__init__(node)
+        self.pod_name = node[0]
+        self.namespace = namespace
+        self.context = context
+        self.container = container
+        del kwargs
+
+    def _kubectl_base(self) -> List[str]:
+        base = ['kubectl']
+        if self.context:
+            base += ['--context', self.context]
+        base += ['-n', self.namespace]
+        return base
+
+    def _exec_argv(self, cmd: str, interactive: bool = False) -> List[str]:
+        argv = self._kubectl_base() + ['exec']
+        if interactive:
+            argv.append('-i')
+        argv.append(self.pod_name)
+        if self.container:
+            argv += ['-c', self.container]
+        return argv + ['--', 'bash', '-c', cmd]
+
+    def run(self,
+            cmd: Union[str, List[str]],
+            *,
+            require_outputs: bool = False,
+            log_path: str = os.devnull,
+            stream_logs: bool = True,
+            connect_timeout: Optional[int] = None,
+            **kwargs: Any) -> Union[int, Tuple[int, str, str]]:
+        del connect_timeout, kwargs
+        if isinstance(cmd, list):
+            cmd = ' '.join(cmd)
+        return _run_local(self._exec_argv(cmd), shell=False,
+                          require_outputs=require_outputs,
+                          log_path=log_path, stream_logs=stream_logs)
+
+    def spawn_spec(self, cmd: str) -> Optional[List[str]]:
+        return self._exec_argv(cmd)
+
+    @staticmethod
+    def _remote_quote(path: str) -> str:
+        """Quote a remote path while keeping leading '~' expandable
+        (every framework remote path is '~/...'; quoting the tilde
+        would create a literal './~' directory in the pod)."""
+        if path == '~':
+            return '"$HOME"'
+        if path.startswith('~/'):
+            return '"$HOME"' + shlex.quote(path[1:])
+        return shlex.quote(path)
+
+    @staticmethod
+    def _tar_excludes(src: str) -> List[str]:
+        """Honor .skyignore/.gitignore on upload (parity with the ssh
+        and local runners' exclude behavior)."""
+        from skypilot_tpu.data import storage_utils  # pylint: disable=import-outside-toplevel
+        excludes = ['--exclude', './.git']
+        for rel in storage_utils.get_excluded_files(src):
+            excludes += ['--exclude', f'./{rel.rstrip("/")}']
+        return excludes
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              log_path: str = os.devnull, stream_logs: bool = True) -> None:
+        # tar-over-exec: works for files and directories both ways.
+        q = self._remote_quote
+        if up:
+            src = os.path.expanduser(source)
+            parent, base = os.path.split(src.rstrip('/'))
+            if os.path.isdir(src):
+                tar_in = subprocess.Popen(
+                    ['tar', '-C', src] + self._tar_excludes(src) +
+                    ['-cf', '-', '.'],
+                    stdout=subprocess.PIPE)
+                untar = self._exec_argv(
+                    f'mkdir -p {q(target)} && '
+                    f'tar -C {q(target)} -xf -', interactive=True)
+            else:
+                tar_in = subprocess.Popen(
+                    ['tar', '-C', parent or '.', '-cf', '-', base],
+                    stdout=subprocess.PIPE)
+                dst_dir = os.path.dirname(target) or '.'
+                untar = self._exec_argv(
+                    f'mkdir -p {q(dst_dir)} && '
+                    f'tar -C {q(dst_dir)} -xf - && '
+                    f'mv {q(os.path.join(dst_dir, base))} '
+                    f'{q(target)} 2>/dev/null || true',
+                    interactive=True)
+            proc = subprocess.run(untar, stdin=tar_in.stdout, check=False,
+                                  capture_output=True, text=True)
+            tar_in.wait()
+            subprocess_utils.handle_returncode(
+                proc.returncode, ' '.join(untar),
+                f'Failed to sync up {source} -> {target}', proc.stderr,
+                stream_logs)
+        else:
+            import tarfile
+            os.makedirs(os.path.dirname(os.path.expanduser(target)) or '.',
+                        exist_ok=True)
+            parent = os.path.dirname(source.rstrip('/')) or '.'
+            base = os.path.basename(source.rstrip('/'))
+            tar_out = self._exec_argv(
+                f'tar -C {q(parent)} -cf - {shlex.quote(base)}')
+            # Stream the archive straight into tarfile (no full-buffer
+            # copy: sync-down may be multi-GB of logs/checkpoints).
+            proc = subprocess.Popen(tar_out, stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE)
+            target_dir = os.path.expanduser(target)
+            extract_to = (target_dir if os.path.isdir(target_dir)
+                          else os.path.dirname(target_dir) or '.')
+            try:
+                with tarfile.open(fileobj=proc.stdout, mode='r|') as tf:
+                    tf.extractall(extract_to)
+            except tarfile.TarError:
+                pass  # handled via returncode below
+            _, stderr = proc.communicate()
+            if proc.returncode != 0:
+                subprocess_utils.handle_returncode(
+                    proc.returncode, ' '.join(tar_out),
+                    f'Failed to sync down {source}',
+                    stderr.decode(errors='replace'), stream_logs)

@@ -161,6 +161,9 @@ def get_config_schema() -> Dict[str, Any]:
                 'additionalProperties': False,
                 'properties': {
                     'resources': {'type': 'object'},
+                    # 'process' (local daemon) | 'cluster' (controller VM)
+                    'mode': {'type': 'string',
+                             'enum': ['process', 'cluster']},
                 },
             },
         },
@@ -190,6 +193,16 @@ def get_config_schema() -> Dict[str, Any]:
                     'project_id': {'type': 'string'},
                     'labels': {'type': 'object'},
                     'managed_instance_group': {'type': 'object'},
+                },
+            },
+            'gke': {
+                'type': 'object',
+                'additionalProperties': False,
+                'properties': {
+                    'cluster': {'type': 'string'},
+                    'location': {'type': 'string'},
+                    'namespace': {'type': 'string'},
+                    'context': {'type': 'string'},
                 },
             },
             'nvidia_gpus': {

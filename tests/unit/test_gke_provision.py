@@ -1,0 +1,233 @@
+"""GKE TPU node-pool provisioner tests against a faked gcloud/kubectl."""
+from __future__ import annotations
+
+import json
+import subprocess
+from typing import Dict, List
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common as pcommon
+from skypilot_tpu.provision.gke import instance as gke
+from skypilot_tpu.status_lib import ClusterStatus
+
+
+class FakeGkeCli:
+    """Emulates gcloud node pools + kubectl pods in memory."""
+
+    def __init__(self):
+        self.pools: Dict[str, dict] = {}
+        self.pods: Dict[str, dict] = {}
+        self.services: Dict[str, dict] = {}
+        self.commands: List[List[str]] = []
+
+    def __call__(self, argv, stdin=None):
+        self.commands.append(argv)
+        if argv[:3] == ['gcloud', 'container', 'node-pools']:
+            return self._pools(argv)
+        if argv[:4] == ['gcloud', 'container', 'clusters',
+                        'get-credentials']:
+            return self._done()
+        if argv[:3] == ['kubectl', 'config', 'current-context']:
+            return self._done(0, 'gke_test-proj_us-central2-b_my-gke\n')
+        if argv[0] == 'kubectl':
+            return self._kubectl(argv, stdin)
+        raise AssertionError(f'unhandled {argv}')
+
+    @staticmethod
+    def _done(rc=0, stdout='', stderr=''):
+        return subprocess.CompletedProcess([], rc, stdout=stdout,
+                                           stderr=stderr)
+
+    def _pools(self, argv):
+        verb, name = argv[3], argv[4]
+        if verb == 'describe':
+            if name in self.pools:
+                return self._done(0, json.dumps(self.pools[name]))
+            return self._done(1, stderr='NotFound')
+        if verb == 'create':
+            self.pools[name] = {'argv': argv}
+            return self._done()
+        if verb == 'delete':
+            if name not in self.pools:
+                return self._done(1, stderr='NotFound')
+            del self.pools[name]
+            return self._done()
+        raise AssertionError(argv)
+
+    def _kubectl(self, argv, stdin):
+        args = argv[argv.index('-n') + 2:]  # skip kubectl [--context c] -n ns
+        if args[0] == 'apply':
+            obj = json.loads(stdin)
+            if obj['kind'] == 'Pod':
+                name = obj['metadata']['name']
+                obj['status'] = {'phase': 'Running',
+                                 'podIP': f'10.8.0.{len(self.pods) + 1}'}
+                self.pods[name] = obj
+            else:
+                self.services[obj['metadata']['name']] = obj
+            return self._done()
+        if args[0] == 'get' and args[1] == 'pod':
+            name = args[2]
+            if name in self.pods:
+                return self._done(0, f'pod/{name}')
+            return self._done(1, stderr='not found')
+        if args[0] == 'get' and args[1] == 'pods':
+            label = args[args.index('-l') + 1]
+            cluster = label.split('=')[1]
+            items = [p for p in self.pods.values()
+                     if p['metadata']['labels'].get('skytpu-cluster') ==
+                     cluster]
+            return self._done(0, json.dumps({'items': items}))
+        if args[0] == 'delete' and args[1] == 'pods':
+            label = args[args.index('-l') + 1]
+            cluster = label.split('=')[1]
+            self.pods = {
+                n: p for n, p in self.pods.items()
+                if p['metadata']['labels'].get('skytpu-cluster') != cluster}
+            return self._done()
+        if args[0] == 'delete' and args[1] == 'service':
+            self.services.pop(args[2], None)
+            return self._done()
+        raise AssertionError(argv)
+
+
+@pytest.fixture()
+def fake_cli(monkeypatch):
+    cli = FakeGkeCli()
+    monkeypatch.setattr(gke, '_run_cli', cli)
+    yield cli
+
+
+def _config(cluster='gk1', hosts=2, chips=8, spot=False):
+    return pcommon.ProvisionConfig(
+        provider_name='gke', cluster_name=cluster, region='us-central2',
+        zones=['us-central2-b'],
+        deploy_vars={
+            'tpu': True,
+            'tpu_accelerator_type': 'v5litepod-8',
+            'tpu_topology': '2x4',
+            'tpu_num_hosts': hosts,
+            'tpu_num_chips': chips,
+            'use_spot': spot,
+            'gke_cluster': 'my-gke',
+            'gke_location': 'us-central2-b',
+            'gke_machine_type': 'ct5lp-hightpu-4t',
+            'gke_namespace': 'default',
+        })
+
+
+class TestGke:
+
+    def test_create_pool_and_pods(self, fake_cli):
+        record = gke.run_instances(_config())
+        assert record.created_instance_ids == ['gk1-host0', 'gk1-host1']
+        assert 'skytpu-gk1' in fake_cli.pools
+        create = fake_cli.pools['skytpu-gk1']['argv']
+        assert '--tpu-topology' in create
+        assert '--machine-type' in create
+        pod = fake_cli.pods['gk1-host0']
+        assert pod['spec']['containers'][0]['resources']['requests'][
+            'google.com/tpu'] == '4'
+        assert pod['spec']['nodeSelector'][
+            'cloud.google.com/gke-nodepool'] == 'skytpu-gk1'
+
+        gke.wait_instances('gk1')
+        info = gke.get_cluster_info('gk1')
+        assert info.num_hosts == 2
+        assert [i.worker_id for i in info.instances] == [0, 1]
+        runners = gke.get_command_runners(info)
+        assert runners[0].pod_name == 'gk1-host0'
+
+    def test_idempotent(self, fake_cli):
+        gke.run_instances(_config())
+        record = gke.run_instances(_config())
+        assert record.created_instance_ids == []
+        assert record.resumed_instance_ids == ['gk1-host0', 'gk1-host1']
+
+    def test_spot_flag(self, fake_cli):
+        gke.run_instances(_config(spot=True))
+        assert '--spot' in fake_cli.pools['skytpu-gk1']['argv']
+
+    def test_query_and_terminate(self, fake_cli):
+        gke.run_instances(_config())
+        statuses = gke.query_instances('gk1')
+        assert statuses == {'gk1-host0': ClusterStatus.UP,
+                            'gk1-host1': ClusterStatus.UP}
+        gke.terminate_instances('gk1')
+        assert fake_cli.pools == {}
+        assert fake_cli.pods == {}
+        assert gke.query_instances('gk1') == {}
+
+    def test_stop_rejected(self, fake_cli):
+        gke.run_instances(_config())
+        with pytest.raises(exceptions.NotSupportedError):
+            gke.stop_instances('gk1')
+
+    def test_open_cleanup_ports(self, fake_cli):
+        gke.run_instances(_config())
+        gke.open_ports('gk1', [8080, 9000])
+        svc = fake_cli.services['gk1-svc']
+        assert {p['port'] for p in svc['spec']['ports']} == {8080, 9000}
+        gke.cleanup_ports('gk1')
+        assert fake_cli.services == {}
+
+    def test_missing_gke_cluster_config(self, fake_cli):
+        config = _config()
+        config.deploy_vars['gke_cluster'] = None
+        with pytest.raises(exceptions.ProvisionError):
+            gke.run_instances(config)
+
+    def test_kubectl_pinned_to_cluster_context(self, fake_cli):
+        gke.run_instances(_config())
+        kubectl_cmds = [c for c in fake_cli.commands
+                        if c[0] == 'kubectl' and '--context' in c]
+        assert kubectl_cmds, 'kubectl calls must pin --context'
+        ctx = kubectl_cmds[0][kubectl_cmds[0].index('--context') + 1]
+        assert 'my-gke' in ctx
+
+    def test_query_raises_on_kubectl_failure(self, fake_cli,
+                                             monkeypatch):
+        gke.run_instances(_config())
+
+        def broken(argv, stdin=None):
+            if argv[0] == 'kubectl' and 'get' in argv:
+                import subprocess as sp
+                return sp.CompletedProcess(argv, 1, stdout='',
+                                           stderr='connection refused')
+            return fake_cli(argv, stdin)
+
+        monkeypatch.setattr(gke, '_run_cli', broken)
+        with pytest.raises(exceptions.ClusterStatusFetchingError):
+            gke.query_instances('gk1')
+
+    def test_wait_fails_fast_on_terminal_pod(self, fake_cli):
+        gke.run_instances(_config())
+        fake_cli.pods['gk1-host1']['status']['phase'] = 'Failed'
+        with pytest.raises(exceptions.ProvisionError,
+                           match='terminal'):
+            gke.wait_instances('gk1')
+
+
+class TestGkeCloud:
+
+    def test_registry_and_deploy_vars(self, monkeypatch, _isolated_home):
+        from skypilot_tpu import Resources
+        from skypilot_tpu import config as config_lib
+        from skypilot_tpu.clouds import registry
+        cfg_path = _isolated_home / 'config.yaml'
+        cfg_path.write_text('gke:\n  cluster: my-gke\n'
+                            '  location: us-central2-b\n')
+        monkeypatch.setenv('SKYTPU_CONFIG', str(cfg_path))
+        config_lib.reload_config()
+        cloud = registry.from_str('gke')
+        resources = Resources(cloud='gke', accelerators='tpu-v5e-8')
+        launchable, _ = cloud.get_feasible_launchable_resources(resources)
+        assert launchable
+        region = cloud.regions_with_offering(resources)[0]
+        deploy = cloud.make_deploy_resources_variables(
+            resources, 'c1', region, region.zones)
+        assert deploy['gke_cluster'] == 'my-gke'
+        assert deploy['gke_machine_type'] == 'ct5lp-hightpu-8t'
+        config_lib.reload_config()
