@@ -22,6 +22,11 @@ class ModelConfig:
     param_dtype: jnp.dtype = jnp.float32
     remat: bool = True                # jax.checkpoint each layer
     scan_layers: bool = True          # lax.scan over layers (fast compile)
+    # Mixture-of-Experts (0 experts = dense MLP).
+    n_experts: int = 0
+    expert_top_k: int = 2
+    expert_capacity_factor: float = 1.25
+    router_aux_loss_coef: float = 0.02
 
     @property
     def head_dim(self) -> int:
@@ -40,12 +45,19 @@ SMALL = ModelConfig(vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
 TINY = ModelConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
                    n_kv_heads=2, d_ff=128, max_seq_len=128,
                    dtype=jnp.float32, remat=False)
+# Mixtral-style MoE (8 experts, top-2).
+MIXTRAL_8X7B = ModelConfig(vocab_size=32000, d_model=4096, n_layers=32,
+                           n_heads=32, n_kv_heads=8, d_ff=14336,
+                           rope_theta=1e6, n_experts=8, expert_top_k=2)
+TINY_MOE = TINY.replace(n_experts=4, expert_top_k=2)
 
 PRESETS = {
     'llama3-8b': LLAMA3_8B,
     'llama3-70b': LLAMA3_70B,
+    'mixtral-8x7b': MIXTRAL_8X7B,
     'small': SMALL,
     'tiny': TINY,
+    'tiny-moe': TINY_MOE,
 }
 
 

@@ -133,8 +133,12 @@ class DecoderLayer(nn.Module):
         cfg = self.config
         x = x + Attention(cfg, self.mesh, name='attn')(
             RMSNorm(cfg.norm_eps, name='attn_norm')(x), positions)
-        x = x + MLP(cfg, name='mlp')(
-            RMSNorm(cfg.norm_eps, name='mlp_norm')(x))
+        if cfg.n_experts > 0:
+            from skypilot_tpu.models.moe import MoEMLP  # pylint: disable=import-outside-toplevel
+            mlp = MoEMLP(cfg, name='moe_mlp')
+        else:
+            mlp = MLP(cfg, name='mlp')
+        x = x + mlp(RMSNorm(cfg.norm_eps, name='mlp_norm')(x))
         return x
 
 
