@@ -5,12 +5,29 @@ The reference publishes no training-throughput numbers (BASELINE.md —
 `published: {}`), so vs_baseline is reported against the MFU-derived
 roofline expectation for the detected chip (1.0 == hitting 40% MFU,
 a typical well-tuned TPU training MFU).
+
+Robustness contract (VERDICT round-1 item 1): the JSON line is emitted
+even when the pre-registered TPU platform fails to initialize or hangs.
+The benchmark itself runs in a subprocess; the orchestrator tries the
+ambient environment first (real TPU via the tunnel), then falls back to
+platform autodetection, then to pure CPU — each attempt bounded by a
+timeout — and re-prints the first JSON line an attempt produces.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
+
+_REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+_METRIC = 'llama_train_tokens_per_sec_per_chip'
+# Shared with the dryrun contract: env vars that (re)register the
+# remote-compile PJRT plugin and must be scrubbed for fallback attempts.
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+from __graft_entry__ import _PLUGIN_ENV_VARS  # noqa: E402
 
 
 def _param_count(params) -> int:
@@ -87,7 +104,7 @@ def main() -> None:
     vs_baseline = mfu / 0.40  # 1.0 == 40% MFU (well-tuned TPU training)
 
     print(json.dumps({
-        'metric': 'llama_train_tokens_per_sec_per_chip',
+        'metric': _METRIC,
         'value': round(tokens_per_sec, 1),
         'unit': 'tokens/s',
         'vs_baseline': round(vs_baseline, 3),
@@ -97,5 +114,64 @@ def main() -> None:
           f'loss={float(metrics["loss"]):.3f}', file=sys.stderr)
 
 
+def _attempt_envs():
+    """(name, env, timeout_s) attempts, most capable platform first."""
+    base = dict(os.environ)
+    base['SKYTPU_BENCH_INNER'] = '1'
+    base['PYTHONPATH'] = os.pathsep.join(
+        p for p in (_REPO_ROOT, base.get('PYTHONPATH')) if p)
+    yield 'ambient', dict(base), 1200
+
+    stripped = {k: v for k, v in base.items()
+                if k not in _PLUGIN_ENV_VARS}
+    yield 'autodetect', dict(stripped), 600
+
+    cpu = dict(stripped)
+    cpu['JAX_PLATFORMS'] = 'cpu'
+    yield 'cpu', cpu, 600
+
+
+def _extract_json_line(stdout: bytes):
+    for line in (stdout or b'').decode(errors='replace').splitlines():
+        line = line.strip()
+        if not line.startswith('{'):
+            continue
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if parsed.get('metric'):
+            return line
+    return None
+
+
+def orchestrate() -> None:
+    for name, env, timeout_s in _attempt_envs():
+        print(f'# bench attempt: {name}', file=sys.stderr)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, cwd=_REPO_ROOT, timeout=timeout_s,
+                stdout=subprocess.PIPE, stderr=None)
+            stdout, rc = proc.stdout, proc.returncode
+        except subprocess.TimeoutExpired as exc:
+            # The inner run may have printed its result and then hung in
+            # teardown (relay-down failure mode) — salvage it.
+            stdout, rc = exc.stdout, f'timeout after {timeout_s}s'
+        line = _extract_json_line(stdout)
+        if line is not None:
+            print(line)
+            return
+        print(f'# bench attempt {name}: rc={rc}, no JSON line',
+              file=sys.stderr)
+    # Last resort: every attempt failed — still emit a parseable line so
+    # the round records a number instead of a crash.
+    print(json.dumps({'metric': _METRIC, 'value': 0.0, 'unit': 'tokens/s',
+                      'vs_baseline': 0.0}))
+
+
 if __name__ == '__main__':
-    main()
+    if os.environ.get('SKYTPU_BENCH_INNER'):
+        main()
+    else:
+        orchestrate()

@@ -7,6 +7,7 @@ single chip, ring attention across the 'sequence' mesh axis for
 long-context (SURVEY.md §5), both differentiable.
 """
 from skypilot_tpu.ops.attention import flash_attention
+from skypilot_tpu.ops.attention import flash_attention_with_lse
 from skypilot_tpu.ops.ring_attention import ring_attention
 
-__all__ = ['flash_attention', 'ring_attention']
+__all__ = ['flash_attention', 'flash_attention_with_lse', 'ring_attention']

@@ -1,13 +1,20 @@
-"""Causal multi-head attention: Pallas flash kernel + blockwise fallback.
+"""Causal multi-head attention: Pallas flash kernels + blockwise fallback.
 
 Design (TPU-first):
 - Forward on TPU uses a Pallas flash-attention kernel: online softmax,
   q-blocks on the grid, k-blocks streamed through VMEM, matmuls in
-  bfloat16 onto the MXU with float32 accumulation.
-- Everywhere else (CPU tests, and the backward pass) uses a blockwise
-  `lax.scan` implementation with the same online-softmax math — memory
-  O(seq * block) instead of O(seq^2), so XLA can pipeline it, and
-  autodiff through it is the flash backward recipe.
+  bfloat16 onto the MXU with float32 accumulation.  The kernel also
+  emits the per-row logsumexp (LSE).
+- Backward on TPU is two Pallas kernels (recompute-style flash
+  backward): a dq kernel gridded over q-blocks and a fused dk/dv kernel
+  gridded over k-blocks, both recomputing p = exp(s - lse) instead of
+  materialising the O(seq^2) probability matrix, with causal
+  block-skipping.  `delta = rowsum(dO * O)` is a cheap XLA-fused
+  pre-pass.
+- On CPU (tests) the same kernels run under Pallas interpret mode when
+  SKYTPU_PALLAS_INTERPRET=1; otherwise a blockwise `lax.scan`
+  implementation with identical online-softmax math is used, and its
+  autodiff is the backward.
 
 No reference equivalent: SkyPilot ships no kernels (SURVEY.md §2.1).
 Shapes follow [batch, num_heads, seq, head_dim].
@@ -15,12 +22,16 @@ Shapes follow [batch, num_heads, seq, head_dim].
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+# Padded q rows get LSE=+BIG so recomputed p = exp(s - lse) underflows
+# to exactly 0 in the backward kernels (no separate validity mask).
+LSE_PAD = 1e30
 
 
 def _on_tpu() -> bool:
@@ -28,6 +39,15 @@ def _on_tpu() -> bool:
         return jax.default_backend() == 'tpu'
     except Exception:  # pylint: disable=broad-except
         return False
+
+
+def _interpret() -> bool:
+    """Run the Pallas kernels in interpret mode (CPU tests)."""
+    return os.environ.get('SKYTPU_PALLAS_INTERPRET', '') == '1'
+
+
+def _use_pallas() -> bool:
+    return _on_tpu() or _interpret()
 
 
 def mha_reference(q, k, v, *, causal: bool = True,
@@ -47,7 +67,7 @@ def mha_reference(q, k, v, *, causal: bool = True,
 
 
 def _blockwise_attention(q, k, v, *, causal: bool, sm_scale: float,
-                         block_k: int):
+                         block_k: int, return_lse: bool = False):
     """Online-softmax attention scanning over k/v blocks."""
     orig_dtype = q.dtype
     b, h, q_len, d = q.shape
@@ -85,18 +105,21 @@ def _blockwise_attention(q, k, v, *, causal: bool, sm_scale: float,
     o0 = jnp.zeros((b, h, q_len, d), jnp.float32)
     m0 = jnp.full((b, h, q_len), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, q_len), jnp.float32)
-    (o, _, l), _ = jax.lax.scan(
+    (o, m, l), _ = jax.lax.scan(
         step, (o0, m0, l0),
         (kb, vb, jnp.arange(num_blocks)))
-    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(orig_dtype)
+    out = (o / jnp.maximum(l, 1e-30)[..., None]).astype(orig_dtype)
+    if return_lse:
+        return out, m + jnp.log(jnp.maximum(l, 1e-30))
+    return out
 
 
 # ---------------------------------------------------------------- Pallas
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
-                      causal: bool, block_k: int, k_len: int,
-                      pos_offset: int):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      sm_scale: float, causal: bool, block_k: int,
+                      k_len: int, pos_offset: int):
     """One (batch*head, q_block) program: stream k/v blocks through VMEM.
 
     Refs: q [1, block_q, d]; k/v [1, k_len_padded, d]; o [1, block_q, d]
@@ -145,12 +168,14 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
     o0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
-    o, _, l = jax.lax.fori_loop(0, num_k_blocks, body, (o0, m0, l0))
+    o, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (o0, m0, l0))
     o_ref[0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
                       block_q: int, block_k: int):
+    """Returns (out [b,h,q,d], lse [b,h,q] float32)."""
     from jax.experimental import pallas as pl  # pylint: disable=import-outside-toplevel
     from jax.experimental.pallas import tpu as pltpu  # pylint: disable=import-outside-toplevel
 
@@ -174,7 +199,7 @@ def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
     kernel = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
                                causal=causal, block_k=block_k, k_len=k_len,
                                pos_offset=k_len - q_len)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -185,42 +210,250 @@ def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
             pl.BlockSpec((1, k_len + k_pad, d), lambda bh, qi: (bh, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b * h, q_len + q_pad, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, q_len + q_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, q_len + q_pad), jnp.float32),
+        ],
+        interpret=_interpret(),
     )(qp, kp, vp)
-    return out.reshape(b, h, q_len + q_pad, d)[:, :, :q_len]
+    return (out.reshape(b, h, q_len + q_pad, d)[:, :, :q_len],
+            lse.reshape(b, h, q_len + q_pad)[:, :, :q_len])
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, sm_scale: float, causal: bool,
+                         block_k: int, k_len: int, pos_offset: int):
+    """dQ for one (batch*head, q_block): stream k/v blocks, recompute
+    p = exp(s - lse).  dS = P * (dP - delta); dQ = scale * dS @ K."""
+    from jax.experimental import pallas as pl  # pylint: disable=import-outside-toplevel
+
+    _, block_q, d = q_ref.shape
+    q_blk_idx = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    qpos = pos_offset + q_blk_idx * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    num_k_blocks = pl.cdiv(k_len, block_k)
+    if causal:
+        num_k_blocks = jnp.minimum(
+            num_k_blocks,
+            pl.cdiv(pos_offset + (q_blk_idx + 1) * block_q, block_k))
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        kpos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = kpos < k_len
+        if causal:
+            mask &= kpos <= qpos
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_k_blocks, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, sm_scale: float, causal: bool,
+                          block_q: int, q_len: int, pos_offset: int):
+    """Fused dK/dV for one (batch*head, k_block): stream q/do blocks.
+    dV = P^T @ dO; dK = scale * dS^T @ Q.  Padded q rows carry
+    lse=LSE_PAD so their recomputed p underflows to 0."""
+    from jax.experimental import pallas as pl  # pylint: disable=import-outside-toplevel
+
+    _, block_k, d = k_ref.shape
+    k_blk_idx = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    kpos = k_blk_idx * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    num_q_blocks = pl.cdiv(q_len, block_q)
+    if causal:
+        # First q block whose last row can see this k block:
+        # qpos >= kpos  <=>  qi >= kpos - pos_offset.
+        first = jnp.maximum(
+            0, (k_blk_idx * block_k - pos_offset) // block_q)
+    else:
+        first = 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(
+            jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q)]
+        delta_blk = delta_ref[0, pl.ds(qb * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        qpos = pos_offset + qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        mask = kpos >= 0  # k padding handled by caller slicing
+        if causal:
+            mask &= kpos <= qpos
+        p = jnp.where(mask, jnp.exp(s - lse_blk[:, None]), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(first, num_q_blocks, body, (dk0, dv0))
+    dk_ref[0] = (dk * sm_scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, g, g_lse, *, causal: bool,
+                      sm_scale: float, block_q: int, block_k: int):
+    from jax.experimental import pallas as pl  # pylint: disable=import-outside-toplevel
+    from jax.experimental.pallas import tpu as pltpu  # pylint: disable=import-outside-toplevel
+
+    b, h, q_len, d = q.shape
+    k_len = k.shape[2]
+    block_q = min(block_q, q_len)
+    block_k = min(block_k, k_len)
+    q_pad = (-q_len) % block_q
+    k_pad = (-k_len) % block_k
+    pos_offset = k_len - q_len
+
+    # delta = rowsum(dO * O) — cheap XLA-fused pre-pass.  An incoming
+    # LSE cotangent folds in exactly here: dS = P*(dP - delta + g_lse)
+    # since dlse/dS = P, so delta_eff = delta - g_lse.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
+    if q_pad:
+        pad4 = ((0, 0), (0, 0), (0, q_pad), (0, 0))
+        q = jnp.pad(q, pad4)
+        g = jnp.pad(g, pad4)
+        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, q_pad)),
+                      constant_values=LSE_PAD)
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, q_pad)))
+    if k_pad:
+        pad4 = ((0, 0), (0, 0), (0, k_pad), (0, 0))
+        k = jnp.pad(k, pad4)
+        v = jnp.pad(v, pad4)
+    qlp, klp = q_len + q_pad, k_len + k_pad
+    qp = q.reshape(b * h, qlp, d)
+    kp = k.reshape(b * h, klp, d)
+    vp = v.reshape(b * h, klp, d)
+    dop = g.reshape(b * h, qlp, d)
+    lsep = lse.reshape(b * h, qlp)
+    deltap = delta.reshape(b * h, qlp)
+
+    qd_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0),
+                           memory_space=pltpu.VMEM)
+    q1_spec = pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi),
+                           memory_space=pltpu.VMEM)
+    kfull_spec = pl.BlockSpec((1, klp, d), lambda bh, qi: (bh, 0, 0),
+                              memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
+                          causal=causal, block_k=block_k, k_len=k_len,
+                          pos_offset=pos_offset),
+        grid=(b * h, qlp // block_q),
+        in_specs=[qd_spec, kfull_spec, kfull_spec, qd_spec, q1_spec,
+                  q1_spec],
+        out_specs=qd_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, qlp, d), q.dtype),
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    kd_spec = pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0),
+                           memory_space=pltpu.VMEM)
+    qfull_spec = pl.BlockSpec((1, qlp, d), lambda bh, ki: (bh, 0, 0),
+                              memory_space=pltpu.VMEM)
+    qfull1_spec = pl.BlockSpec((1, qlp), lambda bh, ki: (bh, 0),
+                               memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, q_len=q_len,
+                          pos_offset=pos_offset),
+        grid=(b * h, klp // block_k),
+        in_specs=[qfull_spec, kd_spec, kd_spec, qfull_spec, qfull1_spec,
+                  qfull1_spec],
+        out_specs=[kd_spec, kd_spec],
+        out_shape=[jax.ShapeDtypeStruct((b * h, klp, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, klp, d), v.dtype)],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    dq = dq.reshape(b, h, qlp, d)[:, :, :q_len]
+    dk = dk.reshape(b, h, klp, d)[:, :, :k_len]
+    dv = dv.reshape(b, h, klp, d)[:, :, :k_len]
+    return dq, dk, dv
 
 
 # ------------------------------------------------------------- public op
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k):
-    if _on_tpu():
+def _flash_impl(q, k, v, causal, sm_scale, block_q, block_k):
+    """Returns (out, lse)."""
+    if _use_pallas():
         return _flash_fwd_pallas(q, k, v, causal=causal, sm_scale=sm_scale,
                                  block_q=block_q, block_k=block_k)
     return _blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale,
-                                block_k=block_k)
+                                block_k=block_k, return_lse=True)
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    out = _flash(q, k, v, causal, sm_scale, block_q, block_k)
-    return out, (q, k, v)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, causal, sm_scale, block_q, block_k):
+    return _flash_impl(q, k, v, causal, sm_scale, block_q, block_k)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
-    q, k, v = res
-    # Backward = autodiff of the blockwise forward (recompute; flash
-    # backward recipe).  Same math as the Pallas forward.
+def _flash_lse_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    out, lse = _flash_impl(q, k, v, causal, sm_scale, block_q, block_k)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, sm_scale, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    g_out, g_lse = g
+    if _use_pallas():
+        # Kernel-grade backward: recompute-style Pallas dq + dk/dv.
+        return _flash_bwd_pallas(q, k, v, out, lse, g_out, g_lse,
+                                 causal=causal, sm_scale=sm_scale,
+                                 block_q=block_q, block_k=block_k)
+    # CPU fallback: autodiff of the blockwise forward (same math).
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _blockwise_attention(
-            q_, k_, v_, causal=causal, sm_scale=sm_scale, block_k=block_k),
+            q_, k_, v_, causal=causal, sm_scale=sm_scale, block_k=block_k,
+            return_lse=True),
         q, k, v)
-    return vjp(g)
+    return vjp((g_out, g_lse))
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
@@ -229,4 +462,17 @@ def flash_attention(q, k, v, *, causal: bool = True,
     """Flash attention over [batch, heads, seq, head_dim] arrays."""
     if sm_scale is None:
         sm_scale = float(q.shape[-1]) ** -0.5
-    return _flash(q, k, v, causal, float(sm_scale), block_q, block_k)
+    out, _ = _flash_lse(q, k, v, causal, float(sm_scale), block_q, block_k)
+    return out
+
+
+def flash_attention_with_lse(q, k, v, *, causal: bool = True,
+                             sm_scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128):
+    """Flash attention returning (out, lse) — the building block for
+    ring attention's per-hop online-softmax combine.  Gradients flow
+    through BOTH outputs (the LSE cotangent folds into the Pallas
+    backward's delta term)."""
+    if sm_scale is None:
+        sm_scale = float(q.shape[-1]) ** -0.5
+    return _flash_lse(q, k, v, causal, float(sm_scale), block_q, block_k)

@@ -3,13 +3,21 @@
 Long-context design (SURVEY.md §5 — absent in the reference): the
 sequence is sharded over the 'sequence' mesh axis; each device holds its
 local q/k/v chunk and, for `ring_size` steps, attends its q against the
-currently-resident k/v chunk with online-softmax accumulation while
-`ppermute`-ing the k/v chunks one hop around the ring.  Compute and
-ICI transfer overlap (XLA schedules the ppermute DMA alongside the
-attention matmuls), so the hot loop stays MXU-bound.
+currently-resident k/v chunk while `ppermute`-ing the k/v chunks one hop
+around the ring.  Compute and ICI transfer overlap (XLA schedules the
+ppermute DMA alongside the attention matmuls), so the hot loop stays
+MXU-bound.
+
+Each hop's attend is the FLASH KERNEL (ops/attention.py — Pallas on
+TPU), not a full-chunk einsum: the kernel returns (out, lse) and hops
+combine with a logaddexp-weighted merge.  Chunk-level causality is
+decided per hop with `lax.switch`: diagonal chunk -> causal flash,
+earlier chunk -> full flash, later chunk -> skipped (zero contribution),
+so ~half the hops do no attention FLOPs at all.
 
 Differentiable: autodiff through the ring (ppermute transposes to the
-reverse permutation) reproduces the blockwise backward.
+reverse permutation); the flash op propagates both out- and
+lse-cotangents into its Pallas backward.
 """
 from __future__ import annotations
 
@@ -20,64 +28,69 @@ import jax
 import jax.numpy as jnp
 
 from skypilot_tpu.ops.attention import NEG_INF
-
-
-def _ring_step_attend(q, k, v, q_chunk_idx, kv_chunk_idx, chunk_len,
-                      sm_scale, causal):
-    """Attend local q [b,h,s,d] against one k/v chunk; returns (o,m,l)
-    partials in float32."""
-    s = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
-                   k.astype(jnp.float32),
-                   preferred_element_type=jnp.float32) * sm_scale
-    if causal:
-        qpos = q_chunk_idx * chunk_len + jnp.arange(chunk_len)
-        kpos = kv_chunk_idx * chunk_len + jnp.arange(chunk_len)
-        mask = kpos[None, :] <= qpos[:, None]
-        s = jnp.where(mask[None, None], s, NEG_INF)
-    m = jnp.max(s, axis=-1)
-    p = jnp.exp(s - m[..., None])
-    l = jnp.sum(p, axis=-1)
-    o = jnp.einsum('bhqk,bhkd->bhqd', p, v.astype(jnp.float32))
-    return o, m, l
+from skypilot_tpu.ops.attention import flash_attention_with_lse
 
 
 def _ring_attention_sharded(q, k, v, *, axis_name: str, sm_scale: float,
-                            causal: bool):
+                            causal: bool, block_q: int, block_k: int):
     """Body run under shard_map: q/k/v are per-device chunks."""
     ring_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
-    chunk_len = q.shape[2]
     perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+    b, h, s, d = q.shape
+
+    def attend(is_causal):
+        def fn(args):
+            k_cur, v_cur = args
+            out, lse = flash_attention_with_lse(
+                q, k_cur, v_cur, causal=is_causal, sm_scale=sm_scale,
+                block_q=block_q, block_k=block_k)
+            return out.astype(jnp.float32), lse
+        return fn
+
+    def skip(args):
+        del args
+        return (jnp.zeros((b, h, s, d), jnp.float32),
+                jnp.full((b, h, s), NEG_INF, jnp.float32))
 
     @jax.checkpoint
     def step(carry, step_idx):
-        o, m, l, k_cur, v_cur = carry
+        o, lse, k_cur, v_cur = carry
         # k/v chunk currently resident came from device (my_idx - step).
         kv_idx = (my_idx - step_idx) % ring_size
-        o_p, m_p, l_p = _ring_step_attend(q, k_cur, v_cur, my_idx, kv_idx,
-                                          chunk_len, sm_scale, causal)
-        m_new = jnp.maximum(m, m_p)
-        corr = jnp.exp(m - m_new)
-        corr_p = jnp.exp(m_p - m_new)
-        l_new = l * corr + l_p * corr_p
-        o_new = o * corr[..., None] + o_p * corr_p[..., None]
+        if causal:
+            # 0: diagonal (causal flash), 1: earlier chunk (full flash),
+            # 2: later chunk (skip — fully masked).
+            branch = jnp.where(kv_idx == my_idx, 0,
+                               jnp.where(kv_idx < my_idx, 1, 2))
+            o_c, lse_c = jax.lax.switch(
+                branch, [attend(True), attend(False), skip],
+                (k_cur, v_cur))
+        else:
+            o_c, lse_c = attend(False)((k_cur, v_cur))
+        # Online-softmax merge of normalized partials.  NEG_INF is a
+        # finite sentinel, so exp(lse - lse_new) stays NaN-free even for
+        # fully-masked rows.
+        lse_new = jnp.logaddexp(lse, lse_c)
+        alpha = jnp.exp(lse - lse_new)
+        beta = jnp.exp(lse_c - lse_new)
+        o_new = o * alpha[..., None] + o_c * beta[..., None]
         # Rotate k/v one hop around the ring (skipped result unused on
         # the last step; XLA overlaps this DMA with the matmuls above).
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+        return (o_new, lse_new, k_nxt, v_nxt), None
 
-    b, h, s, d = q.shape
     o0 = jnp.zeros((b, h, s, d), jnp.float32)
-    m0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, s), jnp.float32)
-    (o, _, l, _, _), _ = jax.lax.scan(
-        step, (o0, m0, l0, k, v), jnp.arange(ring_size))
-    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    lse0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
+    (o, _, _, _), _ = jax.lax.scan(
+        step, (o0, lse0, k, v), jnp.arange(ring_size))
+    return o.astype(q.dtype)
 
 
 def ring_attention(q, k, v, *, mesh, axis_name: str = 'sequence',
-                   causal: bool = True, sm_scale: Optional[float] = None):
+                   causal: bool = True, sm_scale: Optional[float] = None,
+                   block_q: int = 128, block_k: int = 128):
     """Sequence-parallel attention.
 
     Args:
@@ -87,7 +100,7 @@ def ring_attention(q, k, v, *, mesh, axis_name: str = 'sequence',
     """
     if sm_scale is None:
         sm_scale = float(q.shape[-1]) ** -0.5
-    from jax.experimental.shard_map import shard_map  # pylint: disable=import-outside-toplevel
+    shard_map = jax.shard_map
     P = jax.sharding.PartitionSpec
 
     # Keep batch on the data axes and heads on the tensor axis — only
@@ -103,6 +116,7 @@ def ring_attention(q, k, v, *, mesh, axis_name: str = 'sequence',
     head_axes = _axes('tensor')
     spec = P(batch_axes, head_axes, axis_name, None)
     fn = functools.partial(_ring_attention_sharded, axis_name=axis_name,
-                           sm_scale=float(sm_scale), causal=causal)
+                           sm_scale=float(sm_scale), causal=causal,
+                           block_q=block_q, block_k=block_k)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_rep=False)(q, k, v)
+                     out_specs=spec, check_vma=False)(q, k, v)

@@ -1,0 +1,247 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the 'pipeline'
+mesh axis with collective-permute stage handoff.
+
+TPU-first design (no reference equivalent — SkyPilot's parallelism ends
+at gang scheduling, SURVEY.md §2.3; the 'pipeline' axis here is meant to
+span DCN across slices, parallel/mesh.py DCN_AXES):
+
+- The decoder stack is split into `n_stages` contiguous stages; stage
+  parameters are stacked on a leading 'stage' axis sharded over the
+  'pipeline' mesh axis (logical rule ('stage','pipeline')).
+- Inside one `shard_map`, every device runs the same compiled tick
+  `num_microbatches + n_stages - 1` times (a `lax.scan`, static trip
+  count): apply my stage to the resident activation, then `ppermute` the
+  result one hop down the pipeline.  XLA overlaps the permute DMA with
+  the next tick's matmuls.
+- Backward is autodiff through the scan+ppermute (ppermute transposes to
+  the reverse hop), which reproduces the GPipe backward schedule;
+  `jax.checkpoint` on the stage body keeps activation memory at
+  O(microbatches) stage boundaries instead of O(ticks) full traces.
+- Embedding and the LM head run outside the shard_map under plain GSPMD
+  (batch-sharded); the final-stage activations are returned to every
+  pipeline rank with a masked psum.  For very large vocabularies place
+  the head on the last stage instead — here the psum keeps the public
+  loss function mesh-shape-agnostic.
+
+Correctness contract (tested in tests/unit/test_pipeline.py): the
+pipelined loss equals the non-pipelined `models.train.loss_fn` on the
+same params at equal global batch.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+P = jax.sharding.PartitionSpec
+
+
+def split_stage_params(params: Dict[str, Any], n_stages: int) -> Dict[str, Any]:
+    """Reshape the scanned-layer params [L, ...] -> [S, L//S, ...].
+
+    `params` is the Transformer param tree with scan_layers=True, i.e.
+    params['layers']['layer'] leaves carry a leading n_layers axis.
+    """
+    layers = params['layers']['layer']
+
+    def _split(leaf):
+        n_layers = leaf.shape[0]
+        if n_layers % n_stages:
+            raise ValueError(
+                f'n_layers={n_layers} not divisible by n_stages={n_stages}')
+        return leaf.reshape(n_stages, n_layers // n_stages, *leaf.shape[1:])
+
+    out = dict(params)
+    out['layers'] = {'layer': jax.tree.map(_split, layers)}
+    return out
+
+
+def merge_stage_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of split_stage_params."""
+    layers = params['layers']['layer']
+    out = dict(params)
+    out['layers'] = {'layer': jax.tree.map(
+        lambda leaf: leaf.reshape(-1, *leaf.shape[2:]), layers)}
+    return out
+
+
+def pipeline_param_shardings(params: Dict[str, Any], mesh):
+    """NamedShardings: stage axis over 'pipeline', everything else
+    replicated (compose TP/FSDP by extending the per-leaf specs)."""
+    stage = jax.sharding.NamedSharding(mesh, P('pipeline'))
+    repl = jax.sharding.NamedSharding(mesh, P())
+    return {
+        name: (jax.tree.map(lambda _: stage, sub) if name == 'layers'
+               else jax.tree.map(lambda _: repl, sub))
+        for name, sub in params.items()
+    }
+
+
+
+
+def _pipeline_body(stage_params, x_mb, *, cfg, n_stages: int, remat: bool):
+    """Per-device GPipe schedule (runs under shard_map).
+
+    stage_params leaves: [1, layers_per_stage, ...] (this device's stage);
+    x_mb: [M, mb, s, d] microbatched embeddings (only stage 0 reads it).
+    Returns [M, mb, s, d] final-stage activations, valid on every
+    pipeline rank (masked psum).
+    """
+    from skypilot_tpu.models.transformer import DecoderLayer  # pylint: disable=import-outside-toplevel
+
+    sp = jax.tree.map(lambda a: a[0], stage_params)
+    stage_idx = jax.lax.axis_index('pipeline')
+    num_mb, _, seq, _ = x_mb.shape
+    positions = jnp.arange(seq)
+    layer = DecoderLayer(cfg)
+
+    def stage_fn(h):
+        def body(carry, lp):
+            return layer.apply({'params': lp}, carry, positions), None
+        out, _ = jax.lax.scan(body, h, sp)
+        return out
+
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        buf, outputs = carry
+        # Stage 0 feeds microbatch t (clipped in the drain phase — the
+        # result is garbage there and never written); others consume the
+        # activation ppermuted from the previous stage.
+        inp = jnp.where(stage_idx == 0,
+                        jax.lax.dynamic_index_in_dim(
+                            x_mb, jnp.clip(t, 0, num_mb - 1), 0,
+                            keepdims=False),
+                        buf)
+        out = stage_fn(inp)
+        # The last stage finishes microbatch t-(n_stages-1) at tick t.
+        out_idx = jnp.clip(t - (n_stages - 1), 0, num_mb - 1)
+        valid = t >= (n_stages - 1)
+        upd = jnp.where(valid, out,
+                        jax.lax.dynamic_index_in_dim(outputs, out_idx, 0,
+                                                     keepdims=False))
+        outputs = jax.lax.dynamic_update_index_in_dim(outputs, upd,
+                                                      out_idx, 0)
+        buf = jax.lax.ppermute(out, 'pipeline', perm)
+        return (buf, outputs), None
+
+    ticks = jnp.arange(num_mb + n_stages - 1)
+    carry0 = (jnp.zeros_like(x_mb[0]), jnp.zeros_like(x_mb))
+    (_, outputs), _ = jax.lax.scan(tick, carry0, ticks)
+    # Only the last stage holds real outputs; broadcast around the ring.
+    outputs = jax.lax.psum(
+        jnp.where(stage_idx == n_stages - 1, outputs,
+                  jnp.zeros_like(outputs)),
+        'pipeline')
+    return outputs
+
+
+def pipeline_forward(cfg, params, inputs, *, mesh,
+                     num_microbatches: int):
+    """Pipelined Transformer forward: tokens [b, s] -> logits [b, s, V].
+
+    `params` must be stage-split (split_stage_params).  Mathematically
+    identical to models.transformer.Transformer on the merged params.
+    """
+    n_stages = mesh.shape['pipeline']
+    if mesh.shape.get('sequence', 1) > 1:
+        raise ValueError('pipeline_forward does not compose with a '
+                         'non-trivial sequence axis yet; use ring '
+                         'attention without PP for long-context')
+    b, seq = inputs.shape
+    if b % num_microbatches:
+        raise ValueError(f'batch {b} not divisible by '
+                         f'num_microbatches {num_microbatches}')
+
+    # Embedding outside the pipeline (plain GSPMD, batch-sharded).
+    emb = params['embed']['embedding']
+    x = jnp.take(emb, inputs, axis=0).astype(cfg.dtype)
+    mb = b // num_microbatches
+    x_mb = x.reshape(num_microbatches, mb, seq, cfg.d_model)
+
+    batch_axes = tuple(a for a in ('data', 'fsdp')
+                       if a in mesh.axis_names and mesh.shape[a] > 1) or None
+    if batch_axes:
+        dp = 1
+        for a in batch_axes:
+            dp *= mesh.shape[a]
+        if mb % dp:
+            raise ValueError(
+                f'per-microbatch batch {mb} not divisible by the '
+                f'data-parallel degree {dp}; need batch >= '
+                f'num_microbatches * dp')
+    act_spec = P(None, batch_axes, None, None)
+    body = functools.partial(_pipeline_body, cfg=cfg, n_stages=n_stages,
+                             remat=cfg.remat)
+    out_mb = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P('pipeline'), act_spec),
+        out_specs=act_spec,
+        check_vma=False,
+    )(params['layers']['layer'], x_mb)
+
+    from skypilot_tpu.models.transformer import RMSNorm  # pylint: disable=import-outside-toplevel
+    x = out_mb.reshape(b, seq, cfg.d_model)
+    x = RMSNorm(cfg.norm_eps).apply({'params': params['final_norm']}, x)
+    logits = jnp.einsum(
+        'bsd,dv->bsv', x.astype(jnp.float32),
+        params['lm_head']['kernel'].astype(jnp.float32))
+    return logits
+
+
+def pipeline_loss_fn(cfg, params, tokens, *, mesh, num_microbatches: int):
+    """Next-token CE on a pipelined forward. tokens [b, s+1]."""
+    from skypilot_tpu.models.train import loss_fn  # pylint: disable=import-outside-toplevel
+    logits = pipeline_forward(cfg, params, tokens[:, :-1], mesh=mesh,
+                              num_microbatches=num_microbatches)
+    return loss_fn(logits, tokens[:, 1:])
+
+
+def pipeline_train_step(cfg, tcfg, mesh, *, batch: int, seq: int,
+                        num_microbatches: int,
+                        rng: Optional[jax.Array] = None) -> float:
+    """Init a stage-sharded model on `mesh` and run ONE pipelined
+    optimizer step; returns the loss.  Used by the multichip dryrun and
+    the PP tests."""
+    import optax  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.models.train import make_optimizer  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.models.transformer import Transformer  # pylint: disable=import-outside-toplevel
+
+    if not cfg.scan_layers:
+        raise ValueError('pipeline_train_step requires scan_layers=True '
+                         '(stacked layer params)')
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    n_stages = mesh.shape['pipeline']
+
+    import flax.linen as nn  # pylint: disable=import-outside-toplevel
+    model = Transformer(cfg)
+    init_tokens = jnp.zeros((batch, seq), jnp.int32)
+    params = nn.meta.unbox(model.init(rng, init_tokens)['params'])
+    params = split_stage_params(params, n_stages)
+    params = jax.device_put(params, pipeline_param_shardings(params, mesh))
+
+    tx = make_optimizer(tcfg)
+    opt_state = tx.init(params)
+    tokens = jax.random.randint(jax.random.fold_in(rng, 1),
+                                (batch, seq + 1), 0, cfg.vocab_size,
+                                dtype=jnp.int32)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: pipeline_loss_fn(
+                cfg, p, tokens, mesh=mesh,
+                num_microbatches=num_microbatches))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    params, opt_state, loss = step(params, opt_state, tokens)
+    jax.block_until_ready(loss)
+    return float(loss)

@@ -1,0 +1,114 @@
+"""Pipeline parallelism: GPipe schedule over the 'pipeline' mesh axis.
+
+VERDICT round-1 item 3: loss parity with the non-PP baseline at equal
+global batch, and gradient agreement — i.e. PP is a schedule, not a
+different model.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import configs
+from skypilot_tpu.models.train import TrainConfig
+from skypilot_tpu.models.train import loss_fn
+from skypilot_tpu.models.transformer import Transformer
+from skypilot_tpu.parallel import MeshConfig
+from skypilot_tpu.parallel import build_mesh
+from skypilot_tpu.parallel.pipeline import merge_stage_params
+from skypilot_tpu.parallel.pipeline import pipeline_loss_fn
+from skypilot_tpu.parallel.pipeline import pipeline_train_step
+from skypilot_tpu.parallel.pipeline import split_stage_params
+
+
+@pytest.fixture(scope='module')
+def setup():
+    cfg = configs.get_config('tiny')
+    model = Transformer(cfg)
+    rng = jax.random.PRNGKey(0)
+    batch, seq = 4, 32
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1),
+                                0, cfg.vocab_size, dtype=jnp.int32)
+    import flax.linen as nn
+    params = nn.meta.unbox(model.init(rng, tokens[:, :-1])['params'])
+    return cfg, model, params, tokens
+
+
+def _baseline_loss(model, params, tokens):
+    logits = model.apply({'params': params}, tokens[:, :-1])
+    return loss_fn(logits, tokens[:, 1:])
+
+
+def test_split_merge_roundtrip(setup):
+    cfg, _, params, _ = setup
+    split = split_stage_params(params, 2)
+    merged = merge_stage_params(split)
+    jax.tree.map(np.testing.assert_array_equal, params, merged)
+
+
+@pytest.mark.parametrize('num_microbatches', [1, 2, 4])
+def test_pipeline_loss_parity(setup, num_microbatches):
+    cfg, model, params, tokens = setup
+    mesh = build_mesh(MeshConfig(data=-1, pipeline=2),
+                      devices=jax.devices()[:2])
+    split = split_stage_params(params, 2)
+    pp_loss = jax.jit(
+        lambda p, t: pipeline_loss_fn(cfg, p, t, mesh=mesh,
+                                      num_microbatches=num_microbatches)
+    )(split, tokens)
+    base = _baseline_loss(model, params, tokens)
+    np.testing.assert_allclose(np.asarray(pp_loss), np.asarray(base),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_with_data_parallel(setup):
+    """dp=2 x pp=2: microbatches shard over data inside the pipeline."""
+    cfg, model, params, tokens = setup
+    mesh = build_mesh(MeshConfig(data=-1, pipeline=2),
+                      devices=jax.devices()[:4])
+    split = split_stage_params(params, 2)
+    pp_loss = jax.jit(
+        lambda p, t: pipeline_loss_fn(cfg, p, t, mesh=mesh,
+                                      num_microbatches=2))(split, tokens)
+    base = _baseline_loss(model, params, tokens)
+    np.testing.assert_allclose(np.asarray(pp_loss), np.asarray(base),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_grad_parity(setup):
+    cfg, model, params, tokens = setup
+    mesh = build_mesh(MeshConfig(data=-1, pipeline=2),
+                      devices=jax.devices()[:2])
+    split = split_stage_params(params, 2)
+    pp_grads = jax.jit(jax.grad(
+        lambda p: pipeline_loss_fn(cfg, p, tokens, mesh=mesh,
+                                   num_microbatches=2)))(split)
+    base_grads = jax.grad(
+        lambda p: _baseline_loss(model, p, tokens))(params)
+    merged = merge_stage_params(pp_grads)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5),
+        merged, base_grads)
+
+
+def test_pipeline_train_step_runs(setup):
+    cfg, _, _, _ = setup
+    mesh = build_mesh(MeshConfig(data=-1, pipeline=2),
+                      devices=jax.devices()[:4])
+    loss = pipeline_train_step(cfg, TrainConfig(), mesh, batch=4, seq=32,
+                               num_microbatches=2)
+    assert np.isfinite(loss)
+
+
+def test_pipeline_rejects_bad_shapes(setup):
+    cfg, _, params, tokens = setup
+    mesh = build_mesh(MeshConfig(data=-1, pipeline=2),
+                      devices=jax.devices()[:2])
+    split = split_stage_params(params, 2)
+    with pytest.raises(ValueError, match='not divisible'):
+        pipeline_loss_fn(cfg, split, tokens, mesh=mesh, num_microbatches=3)
+    with pytest.raises(ValueError, match='not divisible'):
+        split_stage_params(params, 3)
