@@ -1,34 +1,127 @@
-"""Backend helpers: cluster status refresh — the state reconciler.
+"""Backend helpers: per-cluster locking + cluster status reconciliation.
 
 Parity: /root/reference/sky/backends/backend_utils.py:1669-2004
-(`_update_cluster_status_no_lock`, `refresh_cluster_status_handle`) — 230
-lines of subtlety in the reference, simplified here by the all-or-nothing
-slice model: a slice is UP only if *every* host is up; any mix is abnormal
-and degrades to INIT (or removal if the cloud says everything is gone).
+(`_update_cluster_status_no_lock`, `refresh_cluster_status_handle`) and
+the per-cluster FileLock the reference holds around provision/teardown
+(/root/reference/sky/backends/cloud_vm_ray_backend.py:2729-2731).
+
+Reconciliation is two-phase, like the reference: the cloud API gives the
+instance view, but "all hosts UP" is necessary, not sufficient — an UP
+record is only confirmed UP if the skylet daemon on the head host
+answers a liveness probe over ssh (the reference probes `ray status`
+the same way, backend_utils.py:1669).  The all-or-nothing slice model
+simplifies the drift matrix: any partial state degrades to INIT.
 """
 from __future__ import annotations
 
+import contextlib
+import os
 import typing
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Iterator, List, Optional
+
+import filelock
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import global_user_state
 from skypilot_tpu import provision
 from skypilot_tpu import sky_logging
 from skypilot_tpu import status_lib
+from skypilot_tpu.skylet import constants
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import timeline
 
 if typing.TYPE_CHECKING:
     from skypilot_tpu.backends import slice_backend
 
 logger = sky_logging.init_logger(__name__)
 
+# How long a status refresh waits for a cluster lock before giving up
+# and returning the cached record (someone else is mutating the
+# cluster; their final state lands in the DB anyway).
+_STATUS_LOCK_TIMEOUT_SECONDS = 10.0
+_SKYLET_PROBE_CMD = (
+    f'test -f {constants.SKYLET_PID_FILE} && '
+    f'kill -0 "$(cat {constants.SKYLET_PID_FILE})" 2>/dev/null')
+
+
+def cluster_lock_path(cluster_name: str) -> str:
+    lock_dir = common_utils.ensure_dir(
+        os.path.join(common_utils.skytpu_home(), 'locks'))
+    return os.path.join(lock_dir, f'{cluster_name}.lock')
+
+
+@contextlib.contextmanager
+def cluster_file_lock(cluster_name: str,
+                      timeout: float = -1) -> Iterator[None]:
+    """Per-cluster advisory lock serializing provision/teardown/status
+    transitions across processes.  timeout<0 waits forever; raises
+    filelock.Timeout otherwise."""
+    path = cluster_lock_path(cluster_name)
+    with timeline.FileLockEvent(path, timeout=timeout):
+        yield
+
+
+def probe_skylet(handle: 'slice_backend.SliceResourceHandle') -> bool:
+    """True iff the skylet daemon on the head host is alive (over ssh)."""
+    try:
+        runners = handle.get_command_runners()
+    except Exception as e:  # pylint: disable=broad-except
+        logger.debug(f'probe_skylet: no runners for '
+                     f'{handle.cluster_name}: {e}')
+        return False
+    if not runners:
+        return False
+    try:
+        rc = runners[0].run(_SKYLET_PROBE_CMD, stream_logs=False)
+    except Exception as e:  # pylint: disable=broad-except
+        logger.debug(f'probe_skylet: probe failed for '
+                     f'{handle.cluster_name}: {e}')
+        return False
+    return rc == 0
+
+
+def _reconcile(record: Dict[str, Any],
+               cloud_statuses: List[Optional[status_lib.ClusterStatus]],
+               probe_runtime: bool) -> Optional[status_lib.ClusterStatus]:
+    """The drift matrix: (recorded status, cloud view) -> new status.
+
+    Returns None when the cluster should be removed from the records.
+    """
+    recorded = record['status']
+    handle = record['handle']
+    if all(s is None for s in cloud_statuses):
+        # Vanished: the cloud has no trace of any host.
+        return None
+    if all(s == status_lib.ClusterStatus.UP for s in cloud_statuses):
+        if recorded == status_lib.ClusterStatus.UP:
+            # UP-but-dead-skylet: ssh probe decides whether the runtime
+            # is actually healthy.
+            if probe_runtime and not probe_skylet(handle):
+                logger.warning(
+                    f'Cluster {record["name"]!r}: hosts are up but the '
+                    'skylet is unreachable; marking INIT.')
+                return status_lib.ClusterStatus.INIT
+            return status_lib.ClusterStatus.UP
+        # STOPPED-but-running, WAITING-granted, or half-finished launch:
+        # hosts exist but the runtime was never confirmed — INIT until a
+        # launch re-runs runtime setup.
+        return status_lib.ClusterStatus.INIT
+    if all(s == status_lib.ClusterStatus.STOPPED for s in cloud_statuses):
+        return status_lib.ClusterStatus.STOPPED
+    # Partial slice (mixed up/stopped/missing): abnormal by the
+    # all-or-nothing slice model.
+    return status_lib.ClusterStatus.INIT
+
 
 def refresh_cluster_status(
-        cluster_name: str) -> Optional[status_lib.ClusterStatus]:
+        cluster_name: str,
+        *,
+        probe_runtime: bool = True,
+        acquire_lock: bool = True) -> Optional[status_lib.ClusterStatus]:
     """Reconcile recorded status with the provider's live view.
 
-    Returns the (possibly updated) status, or None if the cluster no longer
-    exists anywhere.
+    Returns the (possibly updated) status, or None if the cluster no
+    longer exists anywhere.
     """
     record = global_user_state.get_cluster_from_name(cluster_name)
     if record is None:
@@ -36,6 +129,19 @@ def refresh_cluster_status(
     handle = record['handle']
     if handle is None:
         return record['status']
+
+    if acquire_lock:
+        try:
+            with cluster_file_lock(cluster_name,
+                                   timeout=_STATUS_LOCK_TIMEOUT_SECONDS):
+                return refresh_cluster_status(cluster_name,
+                                              probe_runtime=probe_runtime,
+                                              acquire_lock=False)
+        except filelock.Timeout:
+            logger.debug(f'{cluster_name}: status lock busy; returning '
+                         'cached status.')
+            return record['status']
+
     try:
         statuses = provision.query_instances(handle.provider_name,
                                              cluster_name)
@@ -44,28 +150,12 @@ def refresh_cluster_status(
         return record['status']
 
     if not statuses:
-        # The cloud has no trace of it: cluster is gone.
         global_user_state.remove_cluster(cluster_name, terminate=True)
         return None
-    values = list(statuses.values())
-    if all(s == status_lib.ClusterStatus.UP for s in values):
-        new_status = (record['status']
-                      if record['status'] in (status_lib.ClusterStatus.INIT,
-                                              status_lib.ClusterStatus.UP)
-                      else status_lib.ClusterStatus.INIT)
-        if record['status'] == status_lib.ClusterStatus.UP:
-            new_status = status_lib.ClusterStatus.UP
-        elif record['status'] == status_lib.ClusterStatus.WAITING:
-            # Queued capacity got granted behind our back.
-            new_status = status_lib.ClusterStatus.INIT
-    elif all(s == status_lib.ClusterStatus.STOPPED for s in values):
-        new_status = status_lib.ClusterStatus.STOPPED
-    elif all(s is None for s in values):
+    new_status = _reconcile(record, list(statuses.values()), probe_runtime)
+    if new_status is None:
         global_user_state.remove_cluster(cluster_name, terminate=True)
         return None
-    else:
-        # Partial slice (some hosts up, some stopped/preempted): abnormal.
-        new_status = status_lib.ClusterStatus.INIT
     if new_status != record['status']:
         global_user_state.set_cluster_status(cluster_name, new_status)
     return new_status
@@ -89,7 +179,11 @@ def check_cluster_available(
     if record is None:
         raise exceptions.ClusterDoesNotExist(
             f'Cluster {cluster_name!r} does not exist.')
-    status = refresh_cluster_status(cluster_name)
+    # No skylet probe here: the caller is about to ssh anyway and fails
+    # fast if the runtime is dead; probing would double every
+    # exec/queue/logs round-trip.  Explicit `status --refresh` and the
+    # launch reuse-decision keep the probe.
+    status = refresh_cluster_status(cluster_name, probe_runtime=False)
     if status is None:
         raise exceptions.ClusterDoesNotExist(
             f'Cluster {cluster_name!r} no longer exists on the cloud.')

@@ -225,8 +225,8 @@ class SliceBackend(backend_lib.Backend[SliceResourceHandle]):
     # ----------------------------------------------------------- provision
 
     def check_existing_cluster(
-            self, cluster_name: str,
-            task: 'task_lib.Task') -> Optional[SliceResourceHandle]:
+            self, cluster_name: str, task: 'task_lib.Task',
+            acquire_lock: bool = True) -> Optional[SliceResourceHandle]:
         """Reuse an UP cluster if it satisfies the request.
 
         Parity: reference `_check_existing_cluster` (:4280).
@@ -236,7 +236,8 @@ class SliceBackend(backend_lib.Backend[SliceResourceHandle]):
             return None
         handle: SliceResourceHandle = record['handle']
         from skypilot_tpu.backends import backend_utils  # pylint: disable=import-outside-toplevel
-        status = backend_utils.refresh_cluster_status(cluster_name)
+        status = backend_utils.refresh_cluster_status(
+            cluster_name, acquire_lock=acquire_lock)
         if status is None:
             return None
         if status != status_lib.ClusterStatus.UP:
@@ -257,9 +258,24 @@ class SliceBackend(backend_lib.Backend[SliceResourceHandle]):
                    stream_logs: bool, cluster_name: str,
                    retry_until_up: bool = False
                    ) -> Optional[SliceResourceHandle]:
+        # Per-cluster lock: concurrent `launch`es on one name must not
+        # race provision (parity: reference FileLock,
+        # cloud_vm_ray_backend.py:2729-2731).
+        from skypilot_tpu.backends import backend_utils  # pylint: disable=import-outside-toplevel
+        with backend_utils.cluster_file_lock(cluster_name):
+            return self._provision_no_lock(task, to_provision, dryrun,
+                                           stream_logs, cluster_name,
+                                           retry_until_up)
+
+    def _provision_no_lock(self, task: 'task_lib.Task',
+                           to_provision: Optional[Resources], dryrun: bool,
+                           stream_logs: bool, cluster_name: str,
+                           retry_until_up: bool = False
+                           ) -> Optional[SliceResourceHandle]:
         del stream_logs
         common_utils.check_cluster_name_is_valid(cluster_name)
-        existing = self.check_existing_cluster(cluster_name, task)
+        existing = self.check_existing_cluster(cluster_name, task,
+                                               acquire_lock=False)
         if existing is not None:
             logger.info(f'Reusing existing cluster {cluster_name}.')
             return existing
@@ -554,16 +570,19 @@ class SliceBackend(backend_lib.Backend[SliceResourceHandle]):
             raise exceptions.NotSupportedError(
                 f'Multi-host TPU slice {handle.cluster_name} cannot be '
                 'stopped; use down/terminate.')
-        try:
-            provisioner_lib.teardown_cluster(handle.provider_name,
-                                             handle.cluster_name, terminate)
-        except Exception:  # pylint: disable=broad-except
-            if not purge:
-                raise
-            logger.warning(f'Purge: ignoring teardown failure of '
-                           f'{handle.cluster_name}.')
-        global_user_state.remove_cluster(handle.cluster_name,
-                                         terminate=terminate)
+        from skypilot_tpu.backends import backend_utils  # pylint: disable=import-outside-toplevel
+        with backend_utils.cluster_file_lock(handle.cluster_name):
+            try:
+                provisioner_lib.teardown_cluster(handle.provider_name,
+                                                 handle.cluster_name,
+                                                 terminate)
+            except Exception:  # pylint: disable=broad-except
+                if not purge:
+                    raise
+                logger.warning(f'Purge: ignoring teardown failure of '
+                               f'{handle.cluster_name}.')
+            global_user_state.remove_cluster(handle.cluster_name,
+                                             terminate=terminate)
 
     def run_on_head(self, handle: SliceResourceHandle, cmd: str,
                     **kwargs: Any) -> Any:

@@ -298,3 +298,32 @@ def list_accelerators(
             list,
             {k: v for k, v in result.items() if lowered in k.lower()})
     return dict(result)
+
+
+# ------------------------------------------------------------------ refresh
+
+
+def refresh(cloud: str = 'gcp', **kwargs) -> Dict[str, str]:
+    """Re-fetch the cloud's price catalogs into $SKYTPU_HOME/catalogs/.
+
+    Parity: the reference's TTL auto-download
+    (/root/reference/sky/clouds/service_catalog/common.py:122-234) made
+    explicit; kwargs (e.g. `transport`, `api_key`) pass through to the
+    fetcher.  Clears in-process caches so new prices apply immediately.
+    """
+    from skypilot_tpu.catalog import data_fetchers  # pylint: disable=import-outside-toplevel
+    fetcher = data_fetchers.FETCHERS.get(cloud)
+    if fetcher is None:
+        raise ValueError(
+            f'No catalog fetcher for cloud {cloud!r}; '
+            f'have {sorted(data_fetchers.FETCHERS)}')
+    out = fetcher(**kwargs)
+    common.clear_catalog_caches()
+    return out
+
+
+def catalog_age_hours(cloud: str = 'gcp') -> Dict[str, Optional[float]]:
+    """Freshness per catalog CSV (None = embedded snapshot in use)."""
+    names = [n for n in (_INSTANCE_CSVS.get(cloud), _TPU_CSVS.get(cloud))
+             if n is not None]
+    return {name: common.catalog_age_hours(name) for name in names}

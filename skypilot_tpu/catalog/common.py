@@ -1,22 +1,37 @@
-"""Catalog data model + CSV loading.
+"""Catalog data model + CSV loading with freshness (TTL) tracking.
 
 Parity: /root/reference/sky/clouds/service_catalog/common.py:33-553
-(`InstanceTypeInfo`, LazyDataFrame CSV catalogs, query helpers). Differences:
-(1) plain-stdlib csv instead of pandas — catalogs here are small embedded
-snapshots, refreshable by `catalog.data_fetchers`; (2) TPU offerings are a
-separate first-class table keyed by *generation* with per-chip-hour pricing,
-so every valid slice shape (`tpu-v5p-64`) prices as chips × chip-price
-without a combinatorial instance table.
+(`InstanceTypeInfo`, TTL-downloaded LazyDataFrame CSV catalogs, query
+helpers — common.py:122-234). Differences: (1) plain-stdlib csv instead
+of pandas — catalogs here are small embedded snapshots, refreshable by
+`catalog.data_fetchers`; (2) TPU offerings are a separate first-class
+table keyed by *generation* with per-chip-hour pricing, so every valid
+slice shape (`tpu-v5p-64`) prices as chips × chip-price without a
+combinatorial instance table; (3) refresh is explicit (`sky catalog
+refresh` / catalog.refresh()) rather than an implicit download on
+import — this image has no egress, and implicit network-on-import is
+the reference behavior we deliberately dropped.  A fetched catalog
+older than the TTL logs a staleness warning and keeps serving.
 """
 from __future__ import annotations
 
 import csv
 import dataclasses
 import functools
+import json
 import os
+import time
 from typing import Dict, List, Optional, Tuple
 
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
 _DATA_DIR = os.path.join(os.path.dirname(__file__), 'data')
+# Reference pulls catalogs every 7 hours (common.py _PULL_FREQUENCY_HOURS);
+# explicit-refresh model tolerates a longer default.
+CATALOG_TTL_HOURS = 7 * 24
+_warned_stale: set = set()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,6 +64,20 @@ class TpuOffering:
     zone: str
 
 
+def catalog_age_hours(name: str) -> Optional[float]:
+    """Hours since the user catalog was fetched; None if only the
+    embedded snapshot exists."""
+    from skypilot_tpu.utils import common_utils  # pylint: disable=import-outside-toplevel
+    meta = os.path.join(common_utils.skytpu_home(), 'catalogs',
+                        f'{name}.meta.json')
+    try:
+        with open(meta, encoding='utf-8') as f:
+            fetched_at = json.load(f)['fetched_at']
+    except (OSError, ValueError, KeyError):
+        return None
+    return (time.time() - fetched_at) / 3600.0
+
+
 def _read_csv(name: str) -> List[Dict[str, str]]:
     path = os.path.join(_DATA_DIR, name)
     # A user-refreshed catalog (written by data_fetchers) takes precedence.
@@ -56,6 +85,14 @@ def _read_csv(name: str) -> List[Dict[str, str]]:
     user_path = os.path.join(common_utils.skytpu_home(), 'catalogs', name)
     if os.path.exists(user_path):
         path = user_path
+        age = catalog_age_hours(name)
+        if (age is not None and age > CATALOG_TTL_HOURS and
+                name not in _warned_stale):
+            _warned_stale.add(name)
+            logger.warning(
+                f'Catalog {name} is {age / 24:.1f} days old (TTL '
+                f'{CATALOG_TTL_HOURS / 24:.0f}d); prices may be stale. '
+                "Run 'sky catalog refresh' to update.")
     if not os.path.exists(path):
         return []
     with open(path, newline='', encoding='utf-8') as f:

@@ -1,0 +1,12 @@
+"""Catalog data fetchers: rebuild the price CSVs from cloud APIs.
+
+Parity: /root/reference/sky/clouds/service_catalog/data_fetchers/
+(fetch_gcp.py scrapes the GCP SKU API incl. TPU pricing, fetch_gcp.py:34-50).
+"""
+from skypilot_tpu.catalog.data_fetchers import fetch_gcp
+
+FETCHERS = {
+    'gcp': fetch_gcp.fetch,
+}
+
+__all__ = ['FETCHERS', 'fetch_gcp']

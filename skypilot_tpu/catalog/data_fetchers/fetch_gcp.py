@@ -1,0 +1,299 @@
+"""GCP catalog fetcher: Cloud Billing SKU API -> price CSVs.
+
+Parity: /root/reference/sky/clouds/service_catalog/data_fetchers/
+fetch_gcp.py:34-50 (SKU scrape incl. TPU pricing).  Rebuilt with the
+same injectable-transport seam as provision/gcp/tpu_api.py so the whole
+pipeline is unit-testable without network, and with a component-pricing
+model: an instance shape prices as cores*core_price + ram_gib*ram_price
++ gpus*gpu_price from the machine family's SKUs, which is how GCP
+itself bills N2/A2/A3/G2.
+
+Output: gcp_instances.csv + gcp_tpus.csv under $SKYTPU_HOME/catalogs/
+plus a .meta.json freshness stamp consumed by catalog.common's TTL
+check.
+"""
+from __future__ import annotations
+
+import collections
+import csv
+import json
+import os
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+BILLING_API = 'https://cloudbilling.googleapis.com/v1'
+# Compute Engine's fixed service id in the billing catalog (public,
+# stable; same constant the reference uses).
+COMPUTE_SERVICE_ID = '6F81-5844-456A'
+
+# Instance *shapes* are static facts (vCPU/mem/GPU count per type);
+# only their prices move.  Component keys: (family, resource).
+# GPU-attached families price as VM components + per-GPU SKU.
+_SHAPES: Tuple[Dict[str, Any], ...] = (
+    # family, instance_type, vcpus, mem, gpu (name, count)
+    *({'family': 'N2', 'instance_type': f'n2-standard-{n}',
+       'vcpus': n, 'memory': 4 * n, 'gpu': None}
+      for n in (2, 4, 8, 16, 32, 64)),
+    *({'family': 'A2', 'instance_type': f'a2-highgpu-{n}g',
+       'vcpus': 12 * n, 'memory': 85 * n, 'gpu': ('A100', n)}
+      for n in (1, 2, 4, 8)),
+    *({'family': 'A2', 'instance_type': f'a2-ultragpu-{n}g',
+       'vcpus': 12 * n, 'memory': 170 * n, 'gpu': ('A100-80GB', n)}
+      for n in (1, 2, 4, 8)),
+    {'family': 'A3', 'instance_type': 'a3-highgpu-8g', 'vcpus': 208,
+     'memory': 1872, 'gpu': ('H100', 8)},
+    {'family': 'A3', 'instance_type': 'a3-megagpu-8g', 'vcpus': 208,
+     'memory': 1872, 'gpu': ('H100-MEGA', 8)},
+    {'family': 'G2', 'instance_type': 'g2-standard-4', 'vcpus': 4,
+     'memory': 16, 'gpu': ('L4', 1)},
+    {'family': 'G2', 'instance_type': 'g2-standard-8', 'vcpus': 8,
+     'memory': 32, 'gpu': ('L4', 1)},
+    {'family': 'G2', 'instance_type': 'g2-standard-24', 'vcpus': 24,
+     'memory': 96, 'gpu': ('L4', 2)},
+    {'family': 'G2', 'instance_type': 'g2-standard-48', 'vcpus': 48,
+     'memory': 192, 'gpu': ('L4', 4)},
+    *({'family': 'N1', 'instance_type': f'n1-standard-8-t4x{n}',
+       'vcpus': 8, 'memory': 30, 'gpu': ('T4', n)} for n in (1, 2, 4)),
+    *({'family': 'N1', 'instance_type': f'n1-standard-8-v100x{n}',
+       'vcpus': 8, 'memory': 30, 'gpu': ('V100', n)} for n in (1, 4, 8)),
+)
+
+# SKU description fragment -> GPU name (per-GPU-hour SKUs).
+_GPU_SKU_PATTERNS: Tuple[Tuple[str, str], ...] = (
+    ('nvidia tesla a100 80gb', 'A100-80GB'),
+    ('nvidia a100 80gb', 'A100-80GB'),
+    ('nvidia tesla a100', 'A100'),
+    ('nvidia h100 80gb plus', 'H100-MEGA'),
+    ('nvidia h100 mega', 'H100-MEGA'),
+    ('nvidia h100 80gb', 'H100'),
+    ('nvidia l4', 'L4'),
+    ('nvidia tesla t4', 'T4'),
+    ('nvidia tesla v100', 'V100'),
+)
+
+# SKU description fragment -> TPU generation (per-chip-hour SKUs).
+_TPU_SKU_PATTERNS: Tuple[Tuple[str, str], ...] = (
+    ('tpu v6e', 'tpu-v6e'), ('tpu-v6e', 'tpu-v6e'),
+    ('tpu v5p', 'tpu-v5p'),
+    ('tpu v5e', 'tpu-v5e'), ('tpu v5 lite', 'tpu-v5e'),
+    ('tpu v4', 'tpu-v4'),
+    ('tpu v3', 'tpu-v3'),
+    ('tpu v2', 'tpu-v2'),
+)
+
+# Zones emitted per region (suffix list).  Static topology fact.
+_REGION_ZONES = {
+    'us-central1': ('a', 'b', 'c', 'f'),
+    'us-central2': ('b',),
+    'us-east1': ('b', 'c', 'd'),
+    'us-east5': ('a', 'b'),
+    'us-west1': ('a', 'b'),
+    'us-west4': ('a', 'b'),
+    'europe-west4': ('a', 'b'),
+    'asia-east1': ('c',),
+    'asia-northeast1': ('b',),
+    'asia-southeast1': ('b',),
+}
+
+Transport = Callable[[str, Dict[str, Any]], Dict[str, Any]]
+
+
+def _default_transport(url: str, params: Dict[str, Any]) -> Dict[str, Any]:
+    import requests  # pylint: disable=import-outside-toplevel
+    resp = requests.get(url, params=params, timeout=30)
+    resp.raise_for_status()
+    return resp.json()
+
+
+def list_skus(transport: Optional[Transport] = None,
+              api_key: Optional[str] = None) -> List[Dict[str, Any]]:
+    """All Compute Engine SKUs (paginated)."""
+    transport = transport or _default_transport
+    url = f'{BILLING_API}/services/{COMPUTE_SERVICE_ID}/skus'
+    skus: List[Dict[str, Any]] = []
+    page_token = ''
+    while True:
+        params: Dict[str, Any] = {'pageSize': 500}
+        if api_key:
+            params['key'] = api_key
+        if page_token:
+            params['pageToken'] = page_token
+        payload = transport(url, params)
+        skus.extend(payload.get('skus', ()))
+        page_token = payload.get('nextPageToken', '')
+        if not page_token:
+            return skus
+
+
+def _sku_unit_price(sku: Dict[str, Any]) -> Optional[float]:
+    """$/unit/hour from the SKU's tiered rate (first tier)."""
+    try:
+        pricing = sku['pricingInfo'][0]['pricingExpression']
+        tier = pricing['tieredRates'][0]['unitPrice']
+        return int(tier.get('units', 0)) + tier.get('nanos', 0) / 1e9
+    except (KeyError, IndexError, TypeError):
+        return None
+
+
+def _classify(sku: Dict[str, Any]):
+    """-> (kind, key, spot) or None.
+
+    kind 'gpu': key = gpu name; 'tpu': key = tpu generation;
+    'core'/'ram': key = machine family.
+    """
+    category = sku.get('category', {})
+    if category.get('serviceDisplayName') not in (None, 'Compute Engine'):
+        return None
+    usage = category.get('usageType', '')
+    if usage not in ('OnDemand', 'Preemptible'):
+        return None
+    spot = usage == 'Preemptible'
+    desc = sku.get('description', '').lower()
+    if 'custom' in desc or 'sole tenancy' in desc or 'commitment' in desc:
+        return None
+    resource_group = category.get('resourceGroup', '')
+    if resource_group == 'GPU' or 'gpu' in desc:
+        for pattern, name in _GPU_SKU_PATTERNS:
+            if pattern in desc:
+                return 'gpu', name, spot
+        return None
+    if resource_group == 'TPU' or 'tpu' in desc:
+        for pattern, gen in _TPU_SKU_PATTERNS:
+            if pattern in desc:
+                return 'tpu', gen, spot
+        return None
+    for family in ('N2', 'A2', 'A3', 'G2', 'N1'):
+        if desc.startswith(f'{family.lower()} instance'):
+            if 'core' in desc:
+                return 'core', family, spot
+            if 'ram' in desc:
+                return 'ram', family, spot
+    return None
+
+
+def _index_prices(skus: Iterable[Dict[str, Any]]):
+    """-> {(kind, key, region, spot): $/unit/hr} (min across SKUs)."""
+    prices: Dict[Tuple[str, str, str, bool], float] = {}
+    for sku in skus:
+        classified = _classify(sku)
+        if classified is None:
+            continue
+        kind, key, spot = classified
+        unit_price = _sku_unit_price(sku)
+        if unit_price is None or unit_price <= 0:
+            continue
+        for region in sku.get('serviceRegions', ()):
+            entry = (kind, key, region, spot)
+            if entry not in prices or unit_price < prices[entry]:
+                prices[entry] = unit_price
+    return prices
+
+
+def _shape_price(shape: Dict[str, Any], prices, region: str,
+                 spot: bool) -> Optional[float]:
+    family = shape['family']
+    core = prices.get(('core', family, region, spot))
+    ram = prices.get(('ram', family, region, spot))
+    if core is None or ram is None:
+        return None
+    total = shape['vcpus'] * core + shape['memory'] * ram
+    if shape['gpu'] is not None:
+        name, count = shape['gpu']
+        gpu = prices.get(('gpu', name, region, spot))
+        if gpu is None:
+            return None
+        total += count * gpu
+    return total
+
+
+def build_instance_rows(prices) -> List[Dict[str, Any]]:
+    rows = []
+    for shape in _SHAPES:
+        for region, zones in _REGION_ZONES.items():
+            price = _shape_price(shape, prices, region, spot=False)
+            spot_price = _shape_price(shape, prices, region, spot=True)
+            if price is None:
+                continue
+            gpu_name, gpu_count = shape['gpu'] or (None, 0)
+            for suffix in zones:
+                rows.append({
+                    'InstanceType': shape['instance_type'],
+                    'AcceleratorName': gpu_name or '',
+                    'AcceleratorCount': gpu_count,
+                    'vCPUs': shape['vcpus'],
+                    'MemoryGiB': shape['memory'],
+                    'Price': round(price, 4),
+                    'SpotPrice': round(spot_price if spot_price is not None
+                                       else price * 0.3, 4),
+                    'Region': region,
+                    'AvailabilityZone': f'{region}-{suffix}',
+                })
+    return rows
+
+
+def build_tpu_rows(prices) -> List[Dict[str, Any]]:
+    rows = []
+    generations = sorted({k for (kind, k, _, _) in prices
+                          if kind == 'tpu'})
+    for gen in generations:
+        regions = sorted({r for (kind, k, r, _) in prices
+                          if kind == 'tpu' and k == gen})
+        for region in regions:
+            price = prices.get(('tpu', gen, region, False))
+            if price is None:
+                continue
+            spot = prices.get(('tpu', gen, region, True))
+            for suffix in _REGION_ZONES.get(region, ('a',)):
+                rows.append({
+                    'AcceleratorName': gen,
+                    'PricePerChipHour': round(price, 4),
+                    'SpotPricePerChipHour': round(
+                        spot if spot is not None else price * 0.3, 4),
+                    'Region': region,
+                    'AvailabilityZone': f'{region}-{suffix}',
+                })
+    return rows
+
+
+def _write_csv(path: str, rows: List[Dict[str, Any]]) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        writer.writeheader()
+        writer.writerows(rows)
+
+
+def fetch(transport: Optional[Transport] = None,
+          api_key: Optional[str] = None,
+          output_dir: Optional[str] = None) -> Dict[str, str]:
+    """Fetch SKUs and (re)write the GCP catalogs.
+
+    Returns {csv_name: path}.  Raises on network/API failure — callers
+    keep serving the previous (or embedded) catalog.
+    """
+    skus = list_skus(transport, api_key)
+    prices = _index_prices(skus)
+    instance_rows = build_instance_rows(prices)
+    tpu_rows = build_tpu_rows(prices)
+    if not instance_rows or not tpu_rows:
+        raise RuntimeError(
+            f'GCP SKU parse produced {len(instance_rows)} instance rows / '
+            f'{len(tpu_rows)} TPU rows; refusing to overwrite catalogs.')
+    if output_dir is None:
+        output_dir = os.path.join(common_utils.skytpu_home(), 'catalogs')
+    out = {}
+    for name, rows in (('gcp_instances.csv', instance_rows),
+                       ('gcp_tpus.csv', tpu_rows)):
+        path = os.path.join(output_dir, name)
+        _write_csv(path, rows)
+        with open(f'{path}.meta.json', 'w', encoding='utf-8') as f:
+            json.dump({'fetched_at': time.time(), 'num_rows': len(rows)}, f)
+        out[name] = path
+    logger.info(f'GCP catalog refreshed: {len(instance_rows)} instance '
+                f'rows, {len(tpu_rows)} TPU rows.')
+    return out

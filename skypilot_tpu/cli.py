@@ -330,8 +330,10 @@ def cancel(cluster, job_ids, all_jobs, yes):
 @cli.command()
 def check():
     """Verify credentials for each infra and enable the usable ones."""
-    from skypilot_tpu import check as check_lib  # pylint: disable=import-outside-toplevel
-    check_lib.check()
+    # NB: `skypilot_tpu.check` the *attribute* is the function (rebound
+    # by the package __init__), so import it from the module directly.
+    from skypilot_tpu.check import check as check_fn  # pylint: disable=import-outside-toplevel
+    check_fn()
 
 
 @cli.command(name='show-tpus')
@@ -629,6 +631,43 @@ def storage_delete(names, yes):
                     storage_lib.StoreType(stype)](handle['name']))
         storage.delete()
         click.echo(f'Storage {name} deleted.')
+
+
+# ---------------------------------------------------------- catalog group
+
+
+@cli.group(name='catalog')
+def catalog_group():
+    """Price catalogs (list/refresh)."""
+
+
+@catalog_group.command(name='refresh')
+@click.option('--cloud', default='gcp', help='Cloud whose catalog to fetch.')
+@click.option('--api-key', default=None,
+              help='API key for the billing catalog API (optional).')
+def catalog_refresh(cloud, api_key):
+    """Re-fetch price catalogs from the cloud's SKU API."""
+    from skypilot_tpu import catalog  # pylint: disable=import-outside-toplevel
+    try:
+        out = catalog.refresh(cloud, api_key=api_key)
+    except Exception as e:  # pylint: disable=broad-except
+        raise click.ClickException(
+            f'Catalog refresh failed ({e}); the previous catalog remains '
+            'in use.')
+    for name, path in out.items():
+        click.echo(f'{name}: {path}')
+
+
+@catalog_group.command(name='status')
+@click.option('--cloud', default='gcp')
+def catalog_status(cloud):
+    """Show catalog freshness."""
+    from skypilot_tpu import catalog  # pylint: disable=import-outside-toplevel
+    rows = []
+    for name, age in catalog.catalog_age_hours(cloud).items():
+        rows.append((name, 'embedded snapshot' if age is None
+                     else f'fetched {age:.1f}h ago'))
+    _print_table(['CATALOG', 'FRESHNESS'], rows)
 
 
 def main() -> None:

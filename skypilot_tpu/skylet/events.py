@@ -1,21 +1,35 @@
 """Skylet periodic events: job scheduling, reconciliation, autostop.
 
 Parity: /root/reference/sky/skylet/events.py:26-291 (SkyletEvent base with
-per-event intervals; JobSchedulerEvent; AutostopEvent). The AutostopEvent
-here stops/terminates the slice through the provision API using the provider
-recorded in the autostop config — no Ray-YAML re-parsing and no monkey-
-patched `ray up` (reference events.py:90-291).
+per-event intervals; JobSchedulerEvent; ManagedJobUpdateEvent;
+ServiceUpdateEvent; AutostopEvent). The AutostopEvent here stops/terminates
+the slice through the provision API using the provider recorded in the
+autostop config — no Ray-YAML re-parsing and no monkey-patched `ray up`
+(reference events.py:90-291).
 """
 from __future__ import annotations
 
 import time
 import traceback
 
+import psutil
+
 from skypilot_tpu import sky_logging
 from skypilot_tpu.skylet import autostop_lib
 from skypilot_tpu.skylet import job_lib
 
 logger = sky_logging.init_logger(__name__)
+
+
+def _pid_alive(pid) -> bool:
+    if not pid or pid <= 0:
+        return False
+    try:
+        proc = psutil.Process(int(pid))
+        return proc.is_running() and \
+            proc.status() != psutil.STATUS_ZOMBIE
+    except (psutil.NoSuchProcess, psutil.AccessDenied, ValueError):
+        return False
 
 
 class SkyletEvent:
@@ -49,6 +63,76 @@ class JobSchedulerEvent(SkyletEvent):
         job_lib.scheduler.schedule_step()
         if not job_lib.is_cluster_idle():
             autostop_lib.set_last_active_time_to_now()
+
+
+class ManagedJobUpdateEvent(SkyletEvent):
+    """Mark managed jobs whose controller process died as
+    FAILED_CONTROLLER (parity: reference events.py:70-78 — an orphaned
+    job would otherwise show RUNNING forever)."""
+    EVENT_INTERVAL_SECONDS = 300
+
+    def run(self) -> None:
+        from skypilot_tpu.jobs import state as jobs_state  # pylint: disable=import-outside-toplevel
+
+        for job_id in jobs_state.get_nonterminal_job_ids():
+            records = jobs_state.get_job_records(job_id)
+            if not records:
+                continue
+            pid = records[0].get('controller_pid')
+            if pid is None:
+                # Controller never registered; leave submission-time
+                # races to the submitter.
+                continue
+            if _pid_alive(pid):
+                continue
+            logger.warning(
+                f'Managed job {job_id}: controller pid {pid} is gone; '
+                'marking FAILED_CONTROLLER.')
+            for record in records:
+                status = jobs_state.ManagedJobStatus(record['status'])
+                if status.is_terminal():
+                    continue
+                jobs_state.set_status(
+                    job_id, record['task_id'],
+                    jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+                    failure_reason=f'Controller process {pid} died.')
+
+
+class ServiceUpdateEvent(SkyletEvent):
+    """Mark services whose controller/LB process died as FAILED
+    (parity: reference events.py:81-88 ServiceUpdateEvent controller
+    liveness check)."""
+    EVENT_INTERVAL_SECONDS = 300
+
+    def run(self) -> None:
+        from skypilot_tpu.serve import serve_state  # pylint: disable=import-outside-toplevel
+
+        for service in serve_state.get_services():
+            status = serve_state.ServiceStatus(service['status'])
+            if status in (serve_state.ServiceStatus.SHUTTING_DOWN,) or \
+                    status.is_terminal():
+                continue
+            dead = None
+            for role in ('controller_pid', 'lb_pid'):
+                pid = service.get(role)
+                if pid is not None and not _pid_alive(pid):
+                    dead = (role, pid)
+                    break
+            if dead is None:
+                continue
+            role, pid = dead
+            name = service['name']
+            logger.warning(f'Service {name}: {role} {pid} is gone; '
+                           'marking FAILED.')
+            serve_state.set_service_status(
+                name, serve_state.ServiceStatus.FAILED)
+            for replica in serve_state.get_replicas(name):
+                rstatus = serve_state.ReplicaStatus(replica['status'])
+                if rstatus.is_terminal():
+                    continue
+                serve_state.set_replica_status(
+                    name, replica['replica_id'],
+                    serve_state.ReplicaStatus.FAILED)
 
 
 class AutostopEvent(SkyletEvent):

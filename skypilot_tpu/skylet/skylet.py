@@ -15,6 +15,8 @@ logger = sky_logging.init_logger(__name__)
 
 EVENTS = (
     events.JobSchedulerEvent(),
+    events.ManagedJobUpdateEvent(),
+    events.ServiceUpdateEvent(),
     events.AutostopEvent(),
 )
 

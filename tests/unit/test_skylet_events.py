@@ -1,0 +1,138 @@
+"""Skylet reconciliation events: orphaned controllers are detected.
+
+VERDICT round-1 item 7 (parity: /root/reference/sky/skylet/events.py:70-88
+ManagedJobUpdateEvent / ServiceUpdateEvent): a managed job or service
+whose controller process died must not show RUNNING/READY forever.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.skylet import events
+
+
+def _spawn_victim() -> subprocess.Popen:
+    return subprocess.Popen([sys.executable, '-c',
+                             'import time; time.sleep(600)'])
+
+
+def _run(event: events.SkyletEvent) -> None:
+    event._last_run_at = 0.0  # pylint: disable=protected-access
+    event.maybe_run()
+
+
+def _submit(job_id: int, pid: int, status=jobs_state.ManagedJobStatus.RUNNING):
+    jobs_state.allocate_job_id(f'job{job_id}')
+    jobs_state.submit_job(job_id, f'job{job_id}', '/tmp/dag.yaml',
+                          task_names=['t'])
+    jobs_state.set_status(job_id, 0, status)
+    jobs_state.set_controller_pid(job_id, pid)
+
+
+class TestManagedJobUpdateEvent:
+
+    def test_dead_controller_marks_failed_controller(self):
+        victim = _spawn_victim()
+        _submit(1, victim.pid)
+        victim.kill()
+        victim.wait()
+        _run(events.ManagedJobUpdateEvent())
+        assert jobs_state.get_status(1) == \
+            jobs_state.ManagedJobStatus.FAILED_CONTROLLER
+        reason = jobs_state.get_job_records(1)[0]['failure_reason']
+        assert 'died' in reason
+
+    def test_live_controller_untouched(self):
+        victim = _spawn_victim()
+        try:
+            _submit(2, victim.pid)
+            _run(events.ManagedJobUpdateEvent())
+            assert jobs_state.get_status(2) == \
+                jobs_state.ManagedJobStatus.RUNNING
+        finally:
+            victim.kill()
+            victim.wait()
+
+    def test_terminal_job_untouched(self):
+        _submit(3, 999999999,
+                status=jobs_state.ManagedJobStatus.SUCCEEDED)
+        _run(events.ManagedJobUpdateEvent())
+        assert jobs_state.get_status(3) == \
+            jobs_state.ManagedJobStatus.SUCCEEDED
+
+    def test_unregistered_controller_untouched(self):
+        jobs_state.allocate_job_id('job4')
+        jobs_state.submit_job(4, 'job4', '/tmp/dag.yaml', task_names=['t'])
+        jobs_state.set_status(4, 0, jobs_state.ManagedJobStatus.PENDING)
+        _run(events.ManagedJobUpdateEvent())
+        assert jobs_state.get_status(4) == \
+            jobs_state.ManagedJobStatus.PENDING
+
+
+class TestServiceUpdateEvent:
+
+    def _add_service(self, name: str, pid: int) -> None:
+        serve_state.add_service(name, spec_json={},
+                                task_yaml_path='/tmp/task.yaml')
+        serve_state.set_service_status(name,
+                                       serve_state.ServiceStatus.READY)
+        serve_state.set_service_pids(name, controller_pid=pid)
+        rid = serve_state.allocate_replica(name, cluster_prefix=f'{name}-r')
+        serve_state.set_replica_status(name, rid,
+                                       serve_state.ReplicaStatus.READY)
+
+    def test_dead_controller_marks_service_failed(self):
+        victim = _spawn_victim()
+        self._add_service('svc1', victim.pid)
+        victim.kill()
+        victim.wait()
+        _run(events.ServiceUpdateEvent())
+        assert serve_state.get_service('svc1')['status'] == \
+            serve_state.ServiceStatus.FAILED.value
+        replicas = serve_state.get_replicas('svc1')
+        assert all(r['status'] == serve_state.ReplicaStatus.FAILED.value
+                   for r in replicas)
+
+    def test_live_controller_untouched(self):
+        victim = _spawn_victim()
+        try:
+            self._add_service('svc2', victim.pid)
+            _run(events.ServiceUpdateEvent())
+            assert serve_state.get_service('svc2')['status'] == \
+                serve_state.ServiceStatus.READY.value
+        finally:
+            victim.kill()
+            victim.wait()
+
+    def test_dead_lb_marks_service_failed(self):
+        controller = _spawn_victim()
+        lb = _spawn_victim()
+        try:
+            self._add_service('svc3', controller.pid)
+            serve_state.set_service_pids('svc3', lb_pid=lb.pid)
+            lb.kill()
+            lb.wait()
+            _run(events.ServiceUpdateEvent())
+            assert serve_state.get_service('svc3')['status'] == \
+                serve_state.ServiceStatus.FAILED.value
+        finally:
+            controller.kill()
+            controller.wait()
+
+
+def test_pid_alive_helper():
+    assert events._pid_alive(os.getpid())  # pylint: disable=protected-access
+    victim = _spawn_victim()
+    assert events._pid_alive(victim.pid)  # pylint: disable=protected-access
+    victim.kill()
+    victim.wait()
+    # Reaped child: zombie or gone, either way not alive.
+    time.sleep(0.1)
+    assert not events._pid_alive(victim.pid)  # pylint: disable=protected-access
+    assert not events._pid_alive(None)  # pylint: disable=protected-access
+    assert not events._pid_alive(0)  # pylint: disable=protected-access
