@@ -66,8 +66,18 @@ def get_tpu_hourly_cost(cloud: str,
         raise exceptions.ResourcesUnavailableError(
             f'No {spec.generation} TPU offering in cloud={cloud} '
             f'region={region} zone={zone}.')
-    per_chip = min((o.spot_price_per_chip_hour if use_spot else
-                    o.price_per_chip_hour) for o in offerings)
+    if use_spot:
+        # spot_price None = no preemptible SKU there; such offerings are
+        # not spot-feasible (prices are never synthesized).
+        spot_prices = [o.spot_price_per_chip_hour for o in offerings
+                       if o.spot_price_per_chip_hour is not None]
+        if not spot_prices:
+            raise exceptions.ResourcesUnavailableError(
+                f'No SPOT {spec.generation} TPU offering in cloud={cloud} '
+                f'region={region} zone={zone} (no preemptible SKU).')
+        per_chip = min(spot_prices)
+    else:
+        per_chip = min(o.price_per_chip_hour for o in offerings)
     return per_chip * spec.num_chips
 
 
@@ -86,7 +96,15 @@ def get_hourly_cost(cloud: str,
         raise exceptions.ResourcesUnavailableError(
             f'Instance type {instance_type!r} not found in {cloud} catalog '
             f'(region={region}, zone={zone}).')
-    return min((r.spot_price if use_spot else r.price) for r in rows)
+    if use_spot:
+        spot_prices = [r.spot_price for r in rows
+                       if r.spot_price is not None]
+        if not spot_prices:
+            raise exceptions.ResourcesUnavailableError(
+                f'Instance type {instance_type!r} has no SPOT offering in '
+                f'{cloud} (region={region}, zone={zone}).')
+        return min(spot_prices)
+    return min(r.price for r in rows)
 
 
 # ----------------------------------------------------------------- lookups
@@ -198,7 +216,11 @@ def get_region_zones_for_instance_type(
         cloud: str, instance_type: str,
         use_spot: bool = False) -> List[Tuple[str, str]]:
     rows = [r for r in _instances(cloud) if r.instance_type == instance_type]
-    rows.sort(key=lambda r: r.spot_price if use_spot else r.price)
+    if use_spot:
+        rows = [r for r in rows if r.spot_price is not None]
+        rows.sort(key=lambda r: r.spot_price)
+    else:
+        rows.sort(key=lambda r: r.price)
     return [(r.region, r.zone) for r in rows]
 
 
@@ -209,8 +231,11 @@ def get_region_zones_for_tpu(cloud: str,
     if spec is None:
         return []
     offs = [o for o in _tpus(cloud) if o.generation == spec.generation]
-    offs.sort(key=lambda o: (o.spot_price_per_chip_hour
-                             if use_spot else o.price_per_chip_hour))
+    if use_spot:
+        offs = [o for o in offs if o.spot_price_per_chip_hour is not None]
+        offs.sort(key=lambda o: o.spot_price_per_chip_hour)
+    else:
+        offs.sort(key=lambda o: o.price_per_chip_hour)
     return [(o.region, o.zone) for o in offs]
 
 
@@ -252,7 +277,7 @@ class AcceleratorOffering:
     instance_type: Optional[str]   # None for TPU slices
     num_hosts: int
     price: float
-    spot_price: float
+    spot_price: Optional[float]    # None = no spot offering
     region: str
 
 
@@ -291,7 +316,9 @@ def list_accelerators(
                 AcceleratorOffering(
                     cloud, name, spec.num_chips, None, spec.num_hosts,
                     o.price_per_chip_hour * spec.num_chips,
-                    o.spot_price_per_chip_hour * spec.num_chips, o.region))
+                    (o.spot_price_per_chip_hour * spec.num_chips
+                     if o.spot_price_per_chip_hour is not None else None),
+                    o.region))
     if name_filter:
         lowered = name_filter.lower()
         result = collections.defaultdict(

@@ -44,7 +44,9 @@ class InstanceTypeInfo:
     cpu_count: float
     memory_gib: float
     price: float
-    spot_price: float
+    # None = no preemptible offering in this zone (never synthesized —
+    # the optimizer must not rank on made-up spot prices).
+    spot_price: Optional[float]
     region: str
     zone: str
 
@@ -59,7 +61,7 @@ class TpuOffering:
     cloud: str
     generation: str            # 'v5e'
     price_per_chip_hour: float
-    spot_price_per_chip_hour: float
+    spot_price_per_chip_hour: Optional[float]   # None = no spot offering
     region: str
     zone: str
 
@@ -112,7 +114,8 @@ def load_instance_catalog(cloud: str, csv_name: str) -> Tuple[InstanceTypeInfo, 
                 cpu_count=float(r['vCPUs']),
                 memory_gib=float(r['MemoryGiB']),
                 price=float(r['Price']),
-                spot_price=float(r['SpotPrice']),
+                spot_price=(float(r['SpotPrice'])
+                            if r.get('SpotPrice') else None),
                 region=r['Region'],
                 zone=r['AvailabilityZone'],
             ))
@@ -130,7 +133,9 @@ def load_tpu_catalog(cloud: str, csv_name: str) -> Tuple[TpuOffering, ...]:
                 cloud=cloud,
                 generation=generation,
                 price_per_chip_hour=float(r['PricePerChipHour']),
-                spot_price_per_chip_hour=float(r['SpotPricePerChipHour']),
+                spot_price_per_chip_hour=(
+                    float(r['SpotPricePerChipHour'])
+                    if r.get('SpotPricePerChipHour') else None),
                 region=r['Region'],
                 zone=r['AvailabilityZone'],
             ))

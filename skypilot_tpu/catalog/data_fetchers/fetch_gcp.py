@@ -228,8 +228,11 @@ def build_instance_rows(prices) -> List[Dict[str, Any]]:
                     'vCPUs': shape['vcpus'],
                     'MemoryGiB': shape['memory'],
                     'Price': round(price, 4),
-                    'SpotPrice': round(spot_price if spot_price is not None
-                                       else price * 0.3, 4),
+                    # No preemptible SKU -> blank, never a synthesized
+                    # price: the optimizer must not rank spot
+                    # feasibility on made-up numbers (VERDICT r2 #6).
+                    'SpotPrice': (round(spot_price, 4)
+                                  if spot_price is not None else ''),
                     'Region': region,
                     'AvailabilityZone': f'{region}-{suffix}',
                 })
@@ -252,8 +255,11 @@ def build_tpu_rows(prices) -> List[Dict[str, Any]]:
                 rows.append({
                     'AcceleratorName': gen,
                     'PricePerChipHour': round(price, 4),
-                    'SpotPricePerChipHour': round(
-                        spot if spot is not None else price * 0.3, 4),
+                    # Blank when no preemptible SKU exists (see
+                    # build_instance_rows) — spot capacity simply is not
+                    # offered there.
+                    'SpotPricePerChipHour': (round(spot, 4)
+                                             if spot is not None else ''),
                     'Region': region,
                     'AvailabilityZone': f'{region}-{suffix}',
                 })

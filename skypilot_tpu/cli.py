@@ -122,6 +122,10 @@ def _add_options(options):
 @click.version_option(message='%(version)s')
 def cli():
     """skypilot_tpu: run AI workloads on TPU slices, anywhere."""
+    # Crash-safe orphan cleanup: kill daemons whose state dir vanished
+    # (e.g. a kill -9'd run left skylets behind).  Cheap no-op normally.
+    from skypilot_tpu.utils import daemon_registry  # pylint: disable=import-outside-toplevel
+    daemon_registry.reap_stale()
 
 
 # ------------------------------------------------------------------ launch

@@ -13,6 +13,18 @@ import textwrap
 GCSFUSE_VERSION = '2.4.0'
 _MOUNT_BINARY_DIR = '/usr/local/bin'
 
+
+def quote_path(path: str) -> str:
+    """shlex.quote that still lets a leading ~ expand on the REMOTE
+    side: '~/x' -> '"$HOME"/x'.  Plain quoting would create a literal
+    './~' directory (mount paths are user-provided and often ~-based).
+    """
+    if path == '~':
+        return '"$HOME"'
+    if path.startswith('~/'):
+        return '"$HOME"' + shlex.quote(path[1:])
+    return shlex.quote(path)
+
 # Stat/type/negative caches sized for training workloads (many many
 # small reads of the same shards); parity with the reference's tuned
 # flags (mounting_utils.py:83-94) but gcsfuse-2.x option names.
@@ -38,7 +50,7 @@ def get_mount_cmd(bucket_name: str, mount_path: str,
     (idempotent)."""
     ro_flag = '-o ro ' if readonly else ''
     dir_flag = f'--only-dir {shlex.quote(only_dir)} ' if only_dir else ''
-    q = shlex.quote
+    q = quote_path
     return (f'sudo mkdir -p {q(mount_path)} && '
             f'sudo chmod 777 {q(mount_path)} && '
             f'{{ mountpoint -q {q(mount_path)} || '
@@ -47,15 +59,16 @@ def get_mount_cmd(bucket_name: str, mount_path: str,
 
 
 def get_unmount_cmd(mount_path: str) -> str:
-    q = shlex.quote
+    q = quote_path
     return (f'mountpoint -q {q(mount_path)} && '
             f'fusermount -u {q(mount_path)} || true')
 
 
 def get_copy_down_cmd(bucket_url: str, dst_path: str) -> str:
     """COPY mode: materialize bucket contents onto local disk."""
-    q = shlex.quote
+    q = quote_path
+    qb = shlex.quote(bucket_url)
     return (f'mkdir -p {q(dst_path)} && '
-            f'(gcloud storage rsync -r {q(bucket_url)} {q(dst_path)} '
-            f'2>/dev/null || gsutil -m rsync -r {q(bucket_url)} '
+            f'(gcloud storage rsync -r {qb} {q(dst_path)} '
+            f'2>/dev/null || gsutil -m rsync -r {qb} '
             f'{q(dst_path)})')

@@ -34,6 +34,11 @@ _BUCKET_NAME_RE = re.compile(r'^[a-z0-9][a-z0-9._-]{1,61}[a-z0-9]$')
 class StoreType(enum.Enum):
     GCS = 'GCS'
     S3 = 'S3'
+    # Directory-backed "bucket" on this machine — pairs with the local
+    # cloud/provisioner so file-mount translation and controller flows
+    # are testable hermetically (no reference equivalent; the reference
+    # has no fake provisioner either, SURVEY.md §4).
+    LOCAL = 'LOCAL'
 
     @classmethod
     def from_url(cls, url: str) -> 'StoreType':
@@ -42,6 +47,8 @@ class StoreType(enum.Enum):
             return cls.GCS
         if scheme == 's3':
             return cls.S3
+        if scheme == 'local':
+            return cls.LOCAL
         raise ValueError(f'Unknown store URL scheme: {url!r}')
 
 
@@ -212,7 +219,7 @@ class S3Store(AbstractStore):
                 f'Failed to delete {self.url}: {res.stderr.strip()}')
 
     def mount_command(self, mount_path: str) -> str:
-        q = shlex.quote
+        q = mounting_utils.quote_path
         # goofys for S3 (parity: reference mounting_utils.py goofys path).
         return (f'which goofys >/dev/null 2>&1 || {{ sudo curl -fsSL -o '
                 f'{q("/usr/local/bin/goofys")} '
@@ -225,12 +232,95 @@ class S3Store(AbstractStore):
                 f'{q(mount_path)}; }}')
 
     def copy_down_command(self, dst_path: str) -> str:
-        q = shlex.quote
+        q = mounting_utils.quote_path
         return (f'mkdir -p {q(dst_path)} && '
-                f'aws s3 sync {q(self.url)} {q(dst_path)}')
+                f'aws s3 sync {shlex.quote(self.url)} {q(dst_path)}')
 
 
-_STORE_CLASSES = {StoreType.GCS: GcsStore, StoreType.S3: S3Store}
+class LocalStore(AbstractStore):
+    """Directory-backed bucket under $SKYTPU_HOME/local_buckets/<name>.
+
+    The 'bucket' is a plain directory; hosts provisioned by the local
+    cloud share the filesystem, so mount == symlink and copy == cp.
+    Exists so managed-jobs/serve controller flows (auto-bucket
+    file-mount translation) run hermetically in tests.
+    """
+
+    store_type = StoreType.LOCAL
+
+    def __init__(self, name: str, source: Optional[str] = None,
+                 prefix: str = '', region: str = 'local'):
+        super().__init__(name, source, prefix)
+        self.region = region
+
+    @property
+    def bucket_dir(self) -> str:
+        return os.path.join(common_utils.skytpu_home(), 'local_buckets',
+                            self.name)
+
+    @property
+    def _data_dir(self) -> str:
+        if self.prefix:
+            return os.path.join(self.bucket_dir, self.prefix)
+        return self.bucket_dir
+
+    @property
+    def url(self) -> str:
+        if self.prefix:
+            return f'local://{self.name}/{self.prefix}'
+        return f'local://{self.name}'
+
+    def exists(self) -> bool:
+        return os.path.isdir(self.bucket_dir)
+
+    def create(self) -> None:
+        os.makedirs(self._data_dir, exist_ok=True)
+
+    def upload(self, source: str) -> None:
+        import shutil  # pylint: disable=import-outside-toplevel
+        source = os.path.expanduser(source)
+        os.makedirs(self._data_dir, exist_ok=True)
+        if os.path.isdir(source):
+            excluded = {os.path.normpath(e) for e in
+                        storage_utils.get_excluded_files(source)}
+            src_root = source.rstrip('/')
+
+            def _ignore(dirpath, names):
+                rel = os.path.relpath(dirpath, src_root)
+                rel = '' if rel == '.' else rel
+                return {n for n in names
+                        if os.path.normpath(os.path.join(rel, n))
+                        in excluded}
+
+            shutil.copytree(src_root, self._data_dir, ignore=_ignore,
+                            dirs_exist_ok=True)
+        else:
+            shutil.copy2(source, self._data_dir)
+
+    def delete(self) -> None:
+        import shutil  # pylint: disable=import-outside-toplevel
+        shutil.rmtree(self.bucket_dir, ignore_errors=True)
+
+    def mount_command(self, mount_path: str) -> str:
+        q = mounting_utils.quote_path
+        # Same-filesystem 'mount': a symlink gives MOUNT-mode semantics
+        # (writes land in the bucket dir).  Refuses to clobber an
+        # existing non-symlink path — mounting must never delete user
+        # data (ln -sfn alone replaces a previous symlink).
+        return (f'mkdir -p {q(os.path.dirname(mount_path) or ".")} && '
+                f'if [ -e {q(mount_path)} ] && [ ! -L {q(mount_path)} ]; '
+                f'then echo "mount path {mount_path} exists and is not '
+                f'a symlink; refusing to replace it" >&2; exit 1; fi && '
+                f'ln -sfn {shlex.quote(self._data_dir)} {q(mount_path)}')
+
+    def copy_down_command(self, dst_path: str) -> str:
+        q = mounting_utils.quote_path
+        return (f'mkdir -p {q(dst_path)} && '
+                f'cp -a {shlex.quote(self._data_dir)}/. {q(dst_path)}/')
+
+
+_STORE_CLASSES = {StoreType.GCS: GcsStore, StoreType.S3: S3Store,
+                  StoreType.LOCAL: LocalStore}
 
 
 class Storage:

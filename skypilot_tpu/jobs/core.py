@@ -53,6 +53,17 @@ def launch(entrypoint: Union[task_lib.Task, 'Any'],
     for task in dag.tasks:
         task._validate()  # pylint: disable=protected-access
 
+    mode = config_lib.get_nested(constants.CONTROLLER_MODE_KEY,
+                                 constants.DEFAULT_CONTROLLER_MODE)
+    if mode == 'cluster':
+        # The controller cluster cannot see this machine's filesystem:
+        # rewrite local workdir/file_mounts into auto-bucket storage
+        # mounts and upload now (reference controller_utils.py:679).
+        from skypilot_tpu.utils import controller_utils  # pylint: disable=import-outside-toplevel
+        for task in dag.tasks:
+            controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+                task, task_type='jobs')
+
     job_id = state.allocate_job_id(job_name)
     yaml_path = os.path.join(_dag_yaml_dir(), f'{job_name}-{job_id}.yaml')
     dag_utils.dump_chain_dag_to_yaml(dag, yaml_path)
@@ -60,9 +71,6 @@ def launch(entrypoint: Union[task_lib.Task, 'Any'],
                      [t.name or f'task-{i}'
                       for i, t in enumerate(dag.tasks)])
     state.set_status(job_id, 0, state.ManagedJobStatus.SUBMITTED)
-
-    mode = config_lib.get_nested(constants.CONTROLLER_MODE_KEY,
-                                 constants.DEFAULT_CONTROLLER_MODE)
     if mode == 'process':
         _start_controller_process(job_id, yaml_path)
     elif mode == 'cluster':
@@ -91,6 +99,8 @@ def _start_controller_process(job_id: int, yaml_path: str) -> None:
             stdout=log_f, stderr=subprocess.STDOUT,
             stdin=subprocess.DEVNULL, env=env,
             start_new_session=True)
+    from skypilot_tpu.utils import daemon_registry  # pylint: disable=import-outside-toplevel
+    daemon_registry.register(proc.pid, 'jobs-controller')
     state.set_controller_pid(job_id, proc.pid)
 
 

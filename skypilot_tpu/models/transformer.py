@@ -79,12 +79,10 @@ class Attention(nn.Module):
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
 
-        # GQA: repeat kv heads up to n_heads (XLA fuses the broadcast).
-        rep = cfg.n_heads // cfg.n_kv_heads
-        if rep > 1:
-            k = jnp.repeat(k, rep, axis=1)
-            v = jnp.repeat(v, rep, axis=1)
-
+        # GQA is native to the attention ops: the Pallas kernels map
+        # q-head -> kv-head via their BlockSpec index maps, so repeated
+        # K/V is never materialised in HBM (XLA fallbacks broadcast
+        # internally).
         seq_parallel = (self.mesh is not None and
                         'sequence' in self.mesh.axis_names and
                         self.mesh.shape['sequence'] > 1)

@@ -32,6 +32,11 @@ NEG_INF = -1e30
 # Padded q rows get LSE=+BIG so recomputed p = exp(s - lse) underflows
 # to exactly 0 in the backward kernels (no separate validity mask).
 LSE_PAD = 1e30
+# Mosaic requires the last two dims of every block to be divisible by
+# (8, 128) (f32 tile) or equal to the array dims.  Per-row scalars (LSE,
+# delta) therefore ride in a broadcast 128-lane trailing dim — the same
+# layout the official JAX TPU flash kernel uses for its l/m residuals.
+_LANES = 128
 
 
 def _on_tpu() -> bool:
@@ -50,9 +55,21 @@ def _use_pallas() -> bool:
     return _on_tpu() or _interpret()
 
 
+def _repeat_kv(q, k, v):
+    """GQA: broadcast kv heads up to q heads (XLA paths only — the
+    Pallas kernels instead fold the repeat into their index maps so the
+    repeated K/V never materialises in HBM)."""
+    rep = q.shape[1] // k.shape[1]
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    return k, v
+
+
 def mha_reference(q, k, v, *, causal: bool = True,
                   sm_scale: Optional[float] = None):
     """O(seq^2)-memory reference attention (tests / tiny shapes)."""
+    k, v = _repeat_kv(q, k, v)
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     logits = jnp.einsum('bhqd,bhkd->bhqk', q, k,
@@ -69,6 +86,7 @@ def mha_reference(q, k, v, *, causal: bool = True,
 def _blockwise_attention(q, k, v, *, causal: bool, sm_scale: float,
                          block_k: int, return_lse: bool = False):
     """Online-softmax attention scanning over k/v blocks."""
+    k, v = _repeat_kv(q, k, v)
     orig_dtype = q.dtype
     b, h, q_len, d = q.shape
     k_len = k.shape[2]
@@ -122,8 +140,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                       k_len: int, pos_offset: int):
     """One (batch*head, q_block) program: stream k/v blocks through VMEM.
 
-    Refs: q [1, block_q, d]; k/v [1, k_len_padded, d]; o [1, block_q, d]
-    (leading dim is the batch*head grid axis, blocked to 1).
+    Refs: q [1, block_q, d]; k/v [1, k_len_padded, d]; o [1, block_q, d];
+    lse [1, block_q, _LANES] (per-row LSE broadcast across the lane dim
+    so the block satisfies Mosaic tiling).  Leading dim is the
+    batch*head grid axis, blocked to 1.  Row-wise softmax stats are kept
+    as 2D (block_q, 1) values for layout-safe Mosaic lowering.
     """
     from jax.experimental import pallas as pl  # pylint: disable=import-outside-toplevel
 
@@ -156,21 +177,22 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         if causal:
             mask &= kpos <= qpos
         s = jnp.where(mask, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        o_new = o * corr[:, None] + jax.lax.dot_general(
+        p = jnp.exp(s - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = o * corr + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return o_new, m_new, l_new
 
     o0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
     o, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (o0, m0, l0))
-    o_ref[0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (o / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l_safe), (block_q, _LANES))
 
 
 def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
@@ -180,7 +202,10 @@ def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
     from jax.experimental.pallas import tpu as pltpu  # pylint: disable=import-outside-toplevel
 
     b, h, q_len, d = q.shape
-    k_len = k.shape[2]
+    h_kv, k_len = k.shape[1], k.shape[2]
+    # GQA: the kernel maps q-head bh to kv-head bh // rep via the k/v
+    # index maps — the repeated K/V never exists in HBM.
+    rep = h // h_kv
     block_q = min(block_q, q_len)
     block_k = min(block_k, k_len)
     # Pad seq lens to block multiples; kernel masks the padding.
@@ -192,8 +217,8 @@ def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
         k = jnp.pad(k, ((0, 0), (0, 0), (0, k_pad), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, k_pad), (0, 0)))
     qp = q.reshape(b * h, q_len + q_pad, d)
-    kp = k.reshape(b * h, k_len + k_pad, d)
-    vp = v.reshape(b * h, k_len + k_pad, d)
+    kp = k.reshape(b * h_kv, k_len + k_pad, d)
+    vp = v.reshape(b * h_kv, k_len + k_pad, d)
 
     grid = (b * h, (q_len + q_pad) // block_q)
     kernel = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
@@ -205,25 +230,28 @@ def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k_len + k_pad, d), lambda bh, qi: (bh, 0, 0),
+            pl.BlockSpec((1, k_len + k_pad, d),
+                         lambda bh, qi: (bh // rep, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k_len + k_pad, d), lambda bh, qi: (bh, 0, 0),
+            pl.BlockSpec((1, k_len + k_pad, d),
+                         lambda bh, qi: (bh // rep, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi),
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, qi: (bh, qi, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, q_len + q_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, q_len + q_pad), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, q_len + q_pad, _LANES),
+                                 jnp.float32),
         ],
         interpret=_interpret(),
     )(qp, kp, vp)
     return (out.reshape(b, h, q_len + q_pad, d)[:, :, :q_len],
-            lse.reshape(b, h, q_len + q_pad)[:, :, :q_len])
+            lse[:, :, 0].reshape(b, h, q_len + q_pad)[:, :, :q_len])
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -237,8 +265,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     q_blk_idx = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    # lse/delta blocks are [1, block_q, _LANES] with all lanes equal; a
+    # lane-max recovers the per-row scalar as a 2D (block_q, 1) value.
+    lse = jnp.max(lse_ref[0], axis=-1, keepdims=True)
+    delta = jnp.max(delta_ref[0], axis=-1, keepdims=True)
     qpos = pos_offset + q_blk_idx * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
 
@@ -259,11 +289,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = kpos < k_len
         if causal:
             mask &= kpos <= qpos
-        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         return dq + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -302,8 +332,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(
             jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q)]
-        delta_blk = delta_ref[0, pl.ds(qb * block_q, block_q)]
+        lse_blk = jnp.max(lse_ref[0, pl.ds(qb * block_q, block_q), :],
+                          axis=-1, keepdims=True)
+        delta_blk = jnp.max(delta_ref[0, pl.ds(qb * block_q, block_q), :],
+                            axis=-1, keepdims=True)
         s = jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -312,14 +344,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = kpos >= 0  # k padding handled by caller slicing
         if causal:
             mask &= kpos <= qpos
-        p = jnp.where(mask, jnp.exp(s - lse_blk[:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(s - lse_blk), 0.0)
         dv = dv + jax.lax.dot_general(
             p, do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do_blk, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_blk[:, None])
+        ds = p * (dp - delta_blk)
         dk = dk + jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -338,7 +370,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, g_lse, *, causal: bool,
     from jax.experimental.pallas import tpu as pltpu  # pylint: disable=import-outside-toplevel
 
     b, h, q_len, d = q.shape
-    k_len = k.shape[2]
+    h_kv, k_len = k.shape[1], k.shape[2]
+    rep = h // h_kv
     block_q = min(block_q, q_len)
     block_k = min(block_k, k_len)
     q_pad = (-q_len) % block_q
@@ -365,17 +398,22 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, g_lse, *, causal: bool,
         v = jnp.pad(v, pad4)
     qlp, klp = q_len + q_pad, k_len + k_pad
     qp = q.reshape(b * h, qlp, d)
-    kp = k.reshape(b * h, klp, d)
-    vp = v.reshape(b * h, klp, d)
+    kp = k.reshape(b * h_kv, klp, d)
+    vp = v.reshape(b * h_kv, klp, d)
     dop = g.reshape(b * h, qlp, d)
-    lsep = lse.reshape(b * h, qlp)
-    deltap = delta.reshape(b * h, qlp)
+    # Per-row scalars ride in a broadcast 128-lane trailing dim so their
+    # BlockSpecs satisfy Mosaic tiling (see _LANES).
+    lsep = jnp.broadcast_to(lse.reshape(b * h, qlp)[:, :, None],
+                            (b * h, qlp, _LANES))
+    deltap = jnp.broadcast_to(delta.reshape(b * h, qlp)[:, :, None],
+                              (b * h, qlp, _LANES))
 
     qd_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0),
                            memory_space=pltpu.VMEM)
-    q1_spec = pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi),
+    q1_spec = pl.BlockSpec((1, block_q, _LANES),
+                           lambda bh, qi: (bh, qi, 0),
                            memory_space=pltpu.VMEM)
-    kfull_spec = pl.BlockSpec((1, klp, d), lambda bh, qi: (bh, 0, 0),
+    kfull_spec = pl.BlockSpec((1, klp, d), lambda bh, qi: (bh // rep, 0, 0),
                               memory_space=pltpu.VMEM)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
@@ -389,28 +427,41 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, g_lse, *, causal: bool,
         interpret=_interpret(),
     )(qp, kp, vp, dop, lsep, deltap)
 
-    kd_spec = pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0),
-                           memory_space=pltpu.VMEM)
+    kd_in_spec = pl.BlockSpec((1, block_k, d),
+                              lambda bh, ki: (bh // rep, ki, 0),
+                              memory_space=pltpu.VMEM)
+    kd_out_spec = pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0),
+                               memory_space=pltpu.VMEM)
     qfull_spec = pl.BlockSpec((1, qlp, d), lambda bh, ki: (bh, 0, 0),
                               memory_space=pltpu.VMEM)
-    qfull1_spec = pl.BlockSpec((1, qlp), lambda bh, ki: (bh, 0),
+    qfull1_spec = pl.BlockSpec((1, qlp, _LANES), lambda bh, ki: (bh, 0, 0),
                                memory_space=pltpu.VMEM)
+    # GQA: each program computes q-head bh's contribution to kv-head
+    # bh // rep; the per-q-head partials are group-summed below (one
+    # cheap XLA reduction — dq/dk/dv stay a single kernel pass each).
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=block_q, q_len=q_len,
                           pos_offset=pos_offset),
         grid=(b * h, klp // block_k),
-        in_specs=[qfull_spec, kd_spec, kd_spec, qfull_spec, qfull1_spec,
-                  qfull1_spec],
-        out_specs=[kd_spec, kd_spec],
+        in_specs=[qfull_spec, kd_in_spec, kd_in_spec, qfull_spec,
+                  qfull1_spec, qfull1_spec],
+        out_specs=[kd_out_spec, kd_out_spec],
         out_shape=[jax.ShapeDtypeStruct((b * h, klp, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, klp, d), v.dtype)],
         interpret=_interpret(),
     )(qp, kp, vp, dop, lsep, deltap)
 
     dq = dq.reshape(b, h, qlp, d)[:, :, :q_len]
-    dk = dk.reshape(b, h, klp, d)[:, :, :k_len]
-    dv = dv.reshape(b, h, klp, d)[:, :, :k_len]
+    dk = dk.reshape(b, h_kv, rep, klp, d)[:, :, :, :k_len]
+    dv = dv.reshape(b, h_kv, rep, klp, d)[:, :, :, :k_len]
+    if rep > 1:
+        # Sum in f32: rep-way bf16 accumulation would lose mantissa bits.
+        dk = dk.astype(jnp.float32).sum(axis=2).astype(k.dtype)
+        dv = dv.astype(jnp.float32).sum(axis=2).astype(v.dtype)
+    else:
+        dk = dk[:, :, 0]
+        dv = dv[:, :, 0]
     return dq, dk, dv
 
 

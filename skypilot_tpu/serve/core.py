@@ -159,6 +159,8 @@ def _start_daemon(service_name: str) -> None:
              '--service-name', service_name],
             stdout=log_f, stderr=subprocess.STDOUT,
             stdin=subprocess.DEVNULL, start_new_session=True)
+    from skypilot_tpu.utils import daemon_registry  # pylint: disable=import-outside-toplevel
+    daemon_registry.register(proc.pid, 'serve-daemon')
     serve_state.set_service_pids(service_name, controller_pid=proc.pid,
                                  lb_pid=proc.pid)
 

@@ -33,6 +33,10 @@ def _running_skylet_pid() -> int:
 
 
 def main() -> None:
+    from skypilot_tpu.utils import daemon_registry
+    # Reap daemons whose home dir vanished (crash-interrupted runs)
+    # before starting a new one.
+    daemon_registry.reap_stale()
     pid = _running_skylet_pid()
     restart = os.environ.get('SKYTPU_RESTART_SKYLET') == '1'
     if pid > 0 and not restart:
@@ -51,6 +55,8 @@ def main() -> None:
     with open(os.path.expanduser(constants.SKYLET_PID_FILE), 'w',
               encoding='utf-8') as f:
         f.write(str(proc.pid))
+    daemon_registry.register(proc.pid, 'skylet',
+                             home=os.path.expanduser('~'))
     print(f'skylet started (pid={proc.pid}).')
 
 

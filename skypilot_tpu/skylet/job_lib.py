@@ -274,6 +274,9 @@ class FIFOScheduler:
                                 stdout=subprocess.DEVNULL,
                                 stderr=subprocess.DEVNULL,
                                 start_new_session=True)
+        from skypilot_tpu.utils import daemon_registry  # pylint: disable=import-outside-toplevel
+        daemon_registry.register(proc.pid, 'job-supervisor',
+                                 home=os.path.expanduser('~'))
         # Ordering matters twice over: (1) pid is written before the status
         # leaves PENDING, so a concurrent update_job_status can never see
         # SETTING_UP with pid=-1 (would mark the job FAILED_DRIVER);

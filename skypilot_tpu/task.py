@@ -94,7 +94,7 @@ class Task:
                 raise exceptions.InvalidTaskError(
                     f'file_mounts destination must be absolute or ~-based, '
                     f'got {dst!r}.')
-            if src.startswith(('gs://', 's3://', 'r2://')):
+            if src.startswith(('gs://', 's3://', 'r2://', 'local://')):
                 continue
             if not os.path.exists(os.path.expanduser(src)):
                 raise exceptions.InvalidTaskError(

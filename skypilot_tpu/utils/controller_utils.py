@@ -1,0 +1,166 @@
+"""Shared jobs/serve controller machinery: file-mount translation.
+
+Parity: /root/reference/sky/utils/controller_utils.py:679
+(`maybe_translate_local_file_mounts_and_sync_up`).  A controller
+cluster/VM has no access to the user's laptop filesystem, so every
+local path a task references (workdir, local file_mounts, local
+storage-mount sources) is rewritten into an auto-created bucket before
+the task is handed to the controller:
+
+- workdir             -> bucket/workdir            (COPY at ~/sky_workdir)
+- local file_mounts   -> bucket/local-file-mounts/i (COPY at each dst)
+- local storage srcs  -> uploaded into their own store
+
+The store type comes from the `<jobs|serve>.bucket` config key (a
+`gs://` / `s3://` / `local://` URL, reference config parity); `local://`
+pairs with the local provisioner so the whole flow is hermetically
+testable.
+"""
+from __future__ import annotations
+
+import getpass
+import os
+import re
+import uuid
+from typing import Optional, Tuple
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.data import storage as storage_lib
+from skypilot_tpu.skylet import constants as skylet_constants
+
+logger = sky_logging.init_logger(__name__)
+
+_INVALID_BUCKET_CHARS = re.compile(r'[^a-z0-9-]')
+
+
+def _auto_bucket_name(task_type: str, run_id: str) -> str:
+    user = _INVALID_BUCKET_CHARS.sub('-', getpass.getuser().lower())[:16]
+    return f'skytpu-{task_type}-{user}-{run_id}'
+
+
+def _configured_store(task_type: str) -> Tuple[storage_lib.StoreType,
+                                               Optional[str]]:
+    """-> (store type, fixed bucket name or None) from `<type>.bucket`."""
+    url = config_lib.get_nested((task_type, 'bucket'), None)
+    if url is None:
+        return storage_lib.StoreType.GCS, None
+    store_type = storage_lib.StoreType.from_url(url)
+    import urllib.parse  # pylint: disable=import-outside-toplevel
+    name = urllib.parse.urlsplit(url).netloc or None
+    return store_type, name
+
+
+def maybe_translate_local_file_mounts_and_sync_up(
+        task: 'task_lib.Task', task_type: str = 'jobs') -> 'task_lib.Task':
+    """Rewrite local paths into bucket-backed storage mounts, in place.
+
+    No-op for tasks that reference nothing local.  Uploads happen here
+    (client side, where the files live); the controller/task cluster
+    later copies them down from the bucket.
+    """
+    has_local_file_mounts = any(
+        not src.startswith(('gs://', 's3://', 'r2://', 'local://'))
+        for src in task.file_mounts.values())
+    local_storage_srcs = {
+        dst: storage for dst, storage in task.storage_mounts.items()
+        if storage.source is not None and
+        not storage.stores and
+        not str(storage.source).startswith(
+            ('gs://', 's3://', 'r2://', 'local://'))
+    }
+    if (task.workdir is None and not has_local_file_mounts and
+            not local_storage_srcs):
+        return task
+
+    store_type, fixed_name = _configured_store(task_type)
+    run_id = uuid.uuid4().hex[:8]
+    bucket_name = fixed_name or _auto_bucket_name(task_type, run_id)
+    # One bucket per translated task; sub-prefixes keep workdir and each
+    # file mount separate (reference uses one bucket with sub-dirs too).
+    subdir = f'{task.name or "task"}-{run_id}'
+
+    def _mount(prefix: str, local_src: str) -> storage_lib.Storage:
+        store_cls = storage_lib._STORE_CLASSES[store_type]  # pylint: disable=protected-access
+        store = store_cls(bucket_name, local_src,
+                          prefix=f'{subdir}/{prefix}')
+        store.create()
+        store.upload(local_src)
+        # source = the store's bucket URL (incl. prefix) so the mount
+        # survives the DAG-YAML round-trip to the controller: the
+        # controller re-creates the exact store from the URL alone.
+        storage = storage_lib.Storage(
+            name=bucket_name, source=store.url,
+            stores={store_type: store},
+            persistent=False, mode=storage_lib.StorageMode.COPY)
+        return storage
+
+    if task.workdir is not None:
+        workdir = task.workdir
+        task.workdir = None
+        task.storage_mounts[skylet_constants.SKY_REMOTE_WORKDIR] = _mount(
+            'workdir', workdir)
+        logger.info(f'Translated workdir {workdir!r} -> '
+                    f'{store_type.value} bucket {bucket_name!r}')
+
+    import collections  # pylint: disable=import-outside-toplevel
+    import shutil  # pylint: disable=import-outside-toplevel
+    import tempfile  # pylint: disable=import-outside-toplevel
+
+    new_file_mounts = {}
+    file_dsts_by_parent = collections.defaultdict(list)
+    dir_mounts = []
+    for dst, src in sorted(task.file_mounts.items()):
+        if src.startswith(('gs://', 's3://', 'r2://', 'local://')):
+            new_file_mounts[dst] = src
+            continue
+        expanded = os.path.expanduser(src)
+        if os.path.isdir(expanded):
+            dir_mounts.append((dst, src))
+        else:
+            parent = os.path.dirname(dst.rstrip('/')) or '.'
+            file_dsts_by_parent[parent].append((dst, expanded))
+        logger.info(f'Translating file_mount {src!r} -> '
+                    f'{store_type.value} bucket {bucket_name!r}')
+    translated_dir_mounts = {}
+    for i, (dst, src) in enumerate(dir_mounts):
+        translated_dir_mounts[dst.rstrip('/')] = task.storage_mounts[dst] \
+            = _mount(f'local-file-mounts/{i}', src)
+    # Single files are staged under their DESTINATION basename, one
+    # staging dir per remote parent dir, so the copy-down of the prefix
+    # into the parent lands every file at exactly its dst (src and dst
+    # basenames may differ; multiple files may share a parent).
+    for i, (parent, entries) in enumerate(
+            sorted(file_dsts_by_parent.items())):
+        with tempfile.TemporaryDirectory() as staging:
+            for dst, expanded in entries:
+                shutil.copy2(
+                    expanded,
+                    os.path.join(staging,
+                                 os.path.basename(dst.rstrip('/'))))
+            if parent.rstrip('/') in translated_dir_mounts:
+                # {'/data': dir, '/data/cfg.yaml': file}: add the staged
+                # file(s) into the already-translated dir mount's bucket
+                # prefix instead of clobbering that mount.
+                store = translated_dir_mounts[
+                    parent.rstrip('/')].get_default_store()
+                for name in os.listdir(staging):
+                    store.upload(os.path.join(staging, name))
+            elif parent in task.storage_mounts:
+                raise ValueError(
+                    f'file_mounts place single file(s) under {parent!r}, '
+                    f'which already has a storage mount; move the files '
+                    f'or mount the bucket elsewhere.')
+            else:
+                task.storage_mounts[parent] = _mount(
+                    f'local-single-files/{i}', staging)
+    task.file_mounts = new_file_mounts
+
+    # Storage mounts whose source is a local path and which have no
+    # store yet: attach the configured store (add_store uploads).
+    for dst, storage in local_storage_srcs.items():
+        storage.add_store(store_type)
+        logger.info(f'Uploaded storage mount source {storage.source!r} '
+                    f'for {dst!r}')
+    return task

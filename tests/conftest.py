@@ -17,6 +17,11 @@ import sys
 # relay — too late to undo from here.  Re-exec once with a clean env so
 # the interpreter starts without the plugin.
 def pytest_configure(config):
+    if os.environ.get('SKYTPU_TPU_TESTS') == '1':
+        # Hardware mode: run against the real TPU (tests/tpu smoke
+        # suite).  Interpret mode must never green-light a kernel that
+        # won't lower, so the real chip is the point here.
+        return
     if not os.environ.get('PALLAS_AXON_POOL_IPS'):
         return
     # Restore the real stdout/stderr fds before exec'ing, else all
@@ -35,11 +40,12 @@ def pytest_configure(config):
               [sys.executable, '-m', 'pytest'] + sys.argv[1:], env)
 
 
-os.environ['JAX_PLATFORMS'] = 'cpu'
-_flags = os.environ.get('XLA_FLAGS', '')
-if 'xla_force_host_platform_device_count' not in _flags:
-    os.environ['XLA_FLAGS'] = (
-        _flags + ' --xla_force_host_platform_device_count=8').strip()
+if os.environ.get('SKYTPU_TPU_TESTS') != '1':
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    _flags = os.environ.get('XLA_FLAGS', '')
+    if 'xla_force_host_platform_device_count' not in _flags:
+        os.environ['XLA_FLAGS'] = (
+            _flags + ' --xla_force_host_platform_device_count=8').strip()
 
 import pytest  # noqa: E402
 
@@ -109,6 +115,29 @@ def _skylet_pids() -> set:
         if 'skypilot_tpu.skylet' in cmdline:
             pids.add(proc.info['pid'])
     return pids
+
+
+@pytest.fixture(scope='session', autouse=True)
+def _daemon_registry_env(tmp_path_factory):
+    """Session-scoped spawn registry OUTSIDE per-test homes.
+
+    Every daemon spawn records itself here (utils/daemon_registry); at
+    session start we reap strays from crash-interrupted PREVIOUS runs —
+    their registry is the default real-home path, so check that one too.
+    """
+    from skypilot_tpu.utils import daemon_registry
+    # First: reap orphans left by earlier (possibly kill -9'd) runs,
+    # recorded in the default registry.
+    daemon_registry.reap_stale()
+    # Then isolate this session's spawns in a session-local registry.
+    path = str(tmp_path_factory.mktemp('daemon_registry') / 'reg.jsonl')
+    os.environ['SKYTPU_DAEMON_REGISTRY'] = path
+    yield path
+    # Kill anything still alive that this session spawned.
+    for rec in daemon_registry._load():  # pylint: disable=protected-access
+        if daemon_registry._same_process(rec):  # pylint: disable=protected-access
+            daemon_registry._kill_tree(rec['pid'])  # pylint: disable=protected-access
+    os.environ.pop('SKYTPU_DAEMON_REGISTRY', None)
 
 
 @pytest.fixture(scope='session', autouse=True)

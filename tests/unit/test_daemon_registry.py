@@ -1,0 +1,108 @@
+"""Spawn-time daemon registry: crash-safe orphan reaping (VERDICT r2
+weak #5 — session fixtures never run on kill -9; the registry must)."""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import psutil
+import pytest
+
+from skypilot_tpu.utils import daemon_registry
+
+
+@pytest.fixture
+def _registry(tmp_path, monkeypatch):
+    path = str(tmp_path / 'registry.jsonl')
+    monkeypatch.setenv('SKYTPU_DAEMON_REGISTRY', path)
+    yield path
+
+
+def _spawn_sleeper():
+    return subprocess.Popen([sys.executable, '-c',
+                             'import time; time.sleep(600)'],
+                            stdin=subprocess.DEVNULL,
+                            stdout=subprocess.DEVNULL,
+                            start_new_session=True)
+
+
+def test_register_appends_record(_registry, tmp_path):
+    proc = _spawn_sleeper()
+    try:
+        daemon_registry.register(proc.pid, 'skylet',
+                                 home=str(tmp_path))
+        recs = daemon_registry._load()
+        assert len(recs) == 1
+        assert recs[0]['pid'] == proc.pid
+        assert recs[0]['kind'] == 'skylet'
+        assert recs[0]['create_time'] is not None
+    finally:
+        proc.kill()
+
+
+def test_reap_kills_daemon_with_vanished_home(_registry, tmp_path):
+    """The kill -9 scenario: a daemon whose (tmp) home was deleted is an
+    orphan and must be reaped by the NEXT run's startup."""
+    home = tmp_path / 'fake_home'
+    home.mkdir()
+    proc = _spawn_sleeper()
+    try:
+        daemon_registry.register(proc.pid, 'skylet', home=str(home))
+        # Home still exists: not reaped.
+        assert daemon_registry.reap_stale() == 0
+        assert psutil.pid_exists(proc.pid)
+        # Simulate the deleted test home.
+        home.rmdir()
+        assert daemon_registry.reap_stale() == 1
+        # Kill delivered; the process is gone (or a zombie child of us).
+        time.sleep(0.2)
+        assert (not psutil.pid_exists(proc.pid) or
+                psutil.Process(proc.pid).status() ==
+                psutil.STATUS_ZOMBIE)
+    finally:
+        try:
+            proc.kill()
+        except Exception:  # pylint: disable=broad-except
+            pass
+        proc.wait(timeout=5)
+
+
+def test_reap_prunes_dead_entries(_registry, tmp_path):
+    proc = _spawn_sleeper()
+    daemon_registry.register(proc.pid, 'skylet', home=str(tmp_path))
+    proc.kill()
+    proc.wait(timeout=5)
+    daemon_registry.reap_stale()
+    assert daemon_registry._load() == []
+
+
+def test_pid_reuse_guard(_registry, tmp_path):
+    """A recorded pid now naming a DIFFERENT process must not be
+    killed."""
+    proc = _spawn_sleeper()
+    try:
+        # Record the live pid but with a create_time from long ago —
+        # as if the original daemon died and the pid was reused.
+        rec = {'pid': proc.pid, 'kind': 'skylet',
+               'home': str(tmp_path / 'gone'),
+               'create_time': time.time() - 10_000,
+               'registered_at': time.time() - 10_000}
+        with open(_registry, 'w', encoding='utf-8') as f:
+            f.write(json.dumps(rec) + '\n')
+        assert daemon_registry.reap_stale() == 0
+        assert psutil.pid_exists(proc.pid)
+    finally:
+        proc.kill()
+        proc.wait(timeout=5)
+
+
+def test_corrupt_lines_ignored(_registry):
+    with open(_registry, 'w', encoding='utf-8') as f:
+        f.write('not json\n{"pid": 999999999, "kind": "x", '
+                '"home": "/nonexistent", "create_time": 1.0, '
+                '"registered_at": 1.0}\n')
+    assert daemon_registry.reap_stale() == 0
+    assert daemon_registry._load() == []

