@@ -337,13 +337,28 @@ class SliceBackend(backend_lib.Backend[SliceResourceHandle]):
     def _sync_file_mounts(self, handle: SliceResourceHandle,
                           all_file_mounts: Optional[Dict[str, str]],
                           storage_mounts: Optional[Dict[str, Any]]) -> None:
-        if all_file_mounts:
+        # Bucket-URL file mounts ({dst: 'gs://...'}) are COPY-mode
+        # storage mounts in disguise — route them through the storage
+        # layer (parity: reference cloud_vm_ray_backend.py:4406 turns
+        # URL sources into cloud-CLI downloads on the cluster).
+        storage_mounts = dict(storage_mounts or {})
+        rsync_mounts: Dict[str, str] = {}
+        for dst, src in (all_file_mounts or {}).items():
+            if src.startswith(('gs://', 's3://', 'local://')):
+                from skypilot_tpu.data import storage as storage_lib  # pylint: disable=import-outside-toplevel
+                storage_mounts.setdefault(
+                    dst, storage_lib.Storage(
+                        source=src, mode=storage_lib.StorageMode.COPY))
+            elif src.startswith('r2://'):
+                raise exceptions.NotSupportedError(
+                    'r2:// file mounts are not supported yet.')
+            else:
+                rsync_mounts[dst] = src
+        if rsync_mounts:
             runners = handle.get_command_runners()
 
             def _one(runner: command_runner_lib.CommandRunner) -> None:
-                for dst, src in all_file_mounts.items():
-                    if src.startswith(('gs://', 's3://', 'r2://')):
-                        continue  # handled via storage layer
+                for dst, src in rsync_mounts.items():
                     parent = os.path.dirname(dst.rstrip('/'))
                     if parent and parent not in ('~', '/'):
                         runner.run(f'mkdir -p {parent}', stream_logs=False)

@@ -143,7 +143,9 @@ class GcsStore(AbstractStore):
                     for rel in excluded)]
             cmd += [source, self.url]
         else:
-            cmd = ['gsutil', 'cp', source, self.url]
+            # Trailing slash: store the file UNDER the prefix (without
+            # it, gsutil writes an object literally named the prefix).
+            cmd = ['gsutil', 'cp', source, self.url.rstrip('/') + '/']
         res = _run(cmd)
         if res.returncode != 0:
             raise exceptions.StorageUploadError(
@@ -205,7 +207,8 @@ class S3Store(AbstractStore):
                 # nothing inside dir.
                 cmd += ['--exclude', rel, '--exclude', f'{rel}/*']
         else:
-            cmd = ['aws', 's3', 'cp', source, self.url]
+            # Trailing slash: store the file UNDER the prefix key.
+            cmd = ['aws', 's3', 'cp', source, self.url.rstrip('/') + '/']
         res = _run(cmd)
         if res.returncode != 0:
             raise exceptions.StorageUploadError(
@@ -307,10 +310,11 @@ class LocalStore(AbstractStore):
         # (writes land in the bucket dir).  Refuses to clobber an
         # existing non-symlink path — mounting must never delete user
         # data (ln -sfn alone replaces a previous symlink).
+        err = shlex.quote(f'mount path {mount_path} exists and is not '
+                          'a symlink; refusing to replace it')
         return (f'mkdir -p {q(os.path.dirname(mount_path) or ".")} && '
                 f'if [ -e {q(mount_path)} ] && [ ! -L {q(mount_path)} ]; '
-                f'then echo "mount path {mount_path} exists and is not '
-                f'a symlink; refusing to replace it" >&2; exit 1; fi && '
+                f'then echo {err} >&2; exit 1; fi && '
                 f'ln -sfn {shlex.quote(self._data_dir)} {q(mount_path)}')
 
     def copy_down_command(self, dst_path: str) -> str:

@@ -53,6 +53,11 @@ class RMSNorm(nn.Module):
 class Attention(nn.Module):
     config: ModelConfig
     mesh: Optional[Any] = None
+    # Set when the module already runs INSIDE a manual (shard_map)
+    # region whose named axis shards the sequence dim (PP x SP
+    # composition, parallel/pipeline.py): attention then rings over
+    # that axis directly instead of wrapping its own shard_map.
+    sequence_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -86,7 +91,15 @@ class Attention(nn.Module):
         seq_parallel = (self.mesh is not None and
                         'sequence' in self.mesh.axis_names and
                         self.mesh.shape['sequence'] > 1)
-        if seq_parallel:
+        if self.sequence_axis is not None:
+            # Already inside a manual region sharded over sequence_axis:
+            # ring directly (a nested shard_map would be illegal here).
+            from skypilot_tpu.ops.ring_attention import _ring_attention_sharded  # pylint: disable=import-outside-toplevel
+            out = _ring_attention_sharded(
+                q, k, v, axis_name=self.sequence_axis,
+                sm_scale=float(hd) ** -0.5, causal=True,
+                block_q=128, block_k=128)
+        elif seq_parallel:
             out = ring_attention(q, k, v, mesh=self.mesh, causal=True)
         else:
             out = flash_attention(q, k, v, causal=True)
@@ -125,11 +138,13 @@ class MLP(nn.Module):
 class DecoderLayer(nn.Module):
     config: ModelConfig
     mesh: Optional[Any] = None
+    sequence_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, positions):
         cfg = self.config
-        x = x + Attention(cfg, self.mesh, name='attn')(
+        x = x + Attention(cfg, self.mesh, self.sequence_axis,
+                          name='attn')(
             RMSNorm(cfg.norm_eps, name='attn_norm')(x), positions)
         if cfg.n_experts > 0:
             from skypilot_tpu.models.moe import MoEMLP  # pylint: disable=import-outside-toplevel

@@ -114,6 +114,17 @@ def ring_attention(q, k, v, *, mesh, axis_name: str = 'sequence',
 
     batch_axes = _axes('data', 'fsdp')
     head_axes = _axes('tensor')
+    if head_axes:
+        tp = 1
+        for a in head_axes:
+            tp *= mesh.shape[a]
+        if k.shape[1] % tp:
+            # GQA kv heads don't divide the tensor axis: broadcast them
+            # up to q heads so the head shard is well-defined (the
+            # Pallas kernel's index-map GQA still applies within the
+            # shard when kv heads DO divide).
+            from skypilot_tpu.ops.attention import _repeat_kv  # pylint: disable=import-outside-toplevel
+            k, v = _repeat_kv(q, k, v)
     spec = P(batch_axes, head_axes, axis_name, None)
     fn = functools.partial(_ring_attention_sharded, axis_name=axis_name,
                            sm_scale=float(sm_scale), causal=causal,

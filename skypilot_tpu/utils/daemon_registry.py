@@ -66,10 +66,18 @@ def register(pid: int, kind: str, home: Optional[str] = None) -> None:
     path = _registry_path()
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, 'a', encoding='utf-8') as f:
-            f.write(json.dumps(rec) + '\n')
+        with _lock(path):
+            with open(path, 'a', encoding='utf-8') as f:
+                f.write(json.dumps(rec) + '\n')
     except OSError as e:
         logger.debug(f'daemon registry append failed: {e}')
+
+
+def _lock(path: str):
+    """Registry mutations are cross-process (any CLI/test may reap
+    while a launch registers): serialize via filelock."""
+    import filelock  # pylint: disable=import-outside-toplevel
+    return filelock.FileLock(f'{path}.lock', timeout=10)
 
 
 def _load() -> List[Dict[str, Any]]:
@@ -121,7 +129,18 @@ def _kill_tree(pid: int) -> None:
 
 def reap_stale() -> int:
     """Kill registered daemons whose home dir vanished; prune dead
-    entries.  Returns the number of daemons killed."""
+    entries.  Returns the number of daemons killed.  Load + rewrite run
+    under the registry lock so a concurrent register() is never lost."""
+    path = _registry_path()
+    try:
+        with _lock(path):
+            return _reap_stale_locked(path)
+    except OSError as e:
+        logger.debug(f'daemon registry reap failed: {e}')
+        return 0
+
+
+def _reap_stale_locked(path: str) -> int:
     records = _load()
     if not records:
         return 0
@@ -142,7 +161,6 @@ def reap_stale() -> int:
             continue
         keep.append(rec)
     # Rewrite compacted registry (best-effort; atomic replace).
-    path = _registry_path()
     try:
         tmp = f'{path}.tmp.{os.getpid()}'
         with open(tmp, 'w', encoding='utf-8') as f:

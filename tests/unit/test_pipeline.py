@@ -17,10 +17,12 @@ from skypilot_tpu.models.train import loss_fn
 from skypilot_tpu.models.transformer import Transformer
 from skypilot_tpu.parallel import MeshConfig
 from skypilot_tpu.parallel import build_mesh
+from skypilot_tpu.parallel.pipeline import create_pipeline_train_state
 from skypilot_tpu.parallel.pipeline import merge_stage_params
 from skypilot_tpu.parallel.pipeline import pipeline_loss_fn
-from skypilot_tpu.parallel.pipeline import pipeline_train_step
+from skypilot_tpu.parallel.pipeline import run_pipeline_train_step
 from skypilot_tpu.parallel.pipeline import split_stage_params
+from skypilot_tpu.parallel.pipeline import stage_param_shardings
 
 
 @pytest.fixture(scope='module')
@@ -94,12 +96,67 @@ def test_pipeline_grad_parity(setup):
         merged, base_grads)
 
 
-def test_pipeline_train_step_runs(setup):
+def test_pipeline_with_tensor_parallel(setup):
+    """pp=2 x tp=2 (VERDICT r2 item 5): the stage compute is
+    GSPMD-tensor-partitioned inside the manual pipeline region; loss
+    must still match the unsharded baseline."""
+    cfg, model, params, tokens = setup
+    mesh = build_mesh(MeshConfig(data=-1, pipeline=2, tensor=2),
+                      devices=jax.devices()[:8])
+    split = split_stage_params(params, 2)
+    pp_loss = jax.jit(
+        lambda p, t: pipeline_loss_fn(cfg, p, t, mesh=mesh,
+                                      num_microbatches=2))(split, tokens)
+    base = _baseline_loss(model, params, tokens)
+    np.testing.assert_allclose(np.asarray(pp_loss), np.asarray(base),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_with_sequence_parallel(setup):
+    """pp=2 x sp=2: ring attention inside the pipeline stage (the
+    DCN-PP x ICI-SP long-context layout)."""
+    cfg, model, params, tokens = setup
+    mesh = build_mesh(MeshConfig(data=-1, pipeline=2, sequence=2),
+                      devices=jax.devices()[:8])
+    split = split_stage_params(params, 2)
+    pp_loss = jax.jit(
+        lambda p, t: pipeline_loss_fn(cfg, p, t, mesh=mesh,
+                                      num_microbatches=2))(split, tokens)
+    base = _baseline_loss(model, params, tokens)
+    np.testing.assert_allclose(np.asarray(pp_loss), np.asarray(base),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_stage_param_shardings_compose(setup):
+    """Stage leaves carry pipeline x TP placement (not replication)."""
     cfg, _, _, _ = setup
-    mesh = build_mesh(MeshConfig(data=-1, pipeline=2),
-                      devices=jax.devices()[:4])
-    loss = pipeline_train_step(cfg, TrainConfig(), mesh, batch=4, seq=32,
-                               num_microbatches=2)
+    mesh = build_mesh(MeshConfig(data=-1, pipeline=2, tensor=2),
+                      devices=jax.devices()[:8])
+    shardings = stage_param_shardings(cfg, mesh, 2)
+    # A q_proj kernel [S, L/S, embed, heads, head_dim]: stage axis on
+    # 'pipeline', heads on 'tensor'.
+    q_spec = shardings['layers']['layer']['attn']['q_proj'][
+        'kernel'].spec
+    assert q_spec[0] == 'pipeline'
+    assert 'tensor' in q_spec
+    # Embedding (outside the pipeline) keeps vocab on 'tensor'.
+    emb_spec = shardings['embed']['embedding'].spec
+    assert 'tensor' in emb_spec
+
+
+def test_pipeline_train_state_and_step(setup):
+    """TrainState integration: stage-sharded state + one composed
+    optimizer step (pp=2 x tp=2) descends finite loss."""
+    cfg, _, _, _ = setup
+    mesh = build_mesh(MeshConfig(data=-1, pipeline=2, tensor=2),
+                      devices=jax.devices()[:8])
+    state, shardings = create_pipeline_train_state(
+        cfg, TrainConfig(), mesh=mesh, batch_size=4, seq_len=32)
+    # Params actually landed stage-sharded.
+    q_kernel = state.params['layers']['layer']['attn']['q_proj']['kernel']
+    assert q_kernel.sharding.spec[0] == 'pipeline'
+    loss = run_pipeline_train_step(cfg, TrainConfig(), mesh, batch=4,
+                                   seq=32, num_microbatches=2)
     assert np.isfinite(loss)
 
 
