@@ -127,6 +127,15 @@ def main() -> None:
     print(f'# device={dev.device_kind} model={cfg.d_model}x{cfg.n_layers} '
           f'params={n_params/1e6:.1f}M mfu={mfu:.3f} '
           f'loss={final_loss:.3f}', file=sys.stderr)
+    if on_tpu:
+        # Feed the optimizer's fungibility prior with the measured MFU
+        # (utils/throughput_registry; VERDICT r2 weak #8).
+        from skypilot_tpu.utils import throughput_registry
+        key = throughput_registry.device_kind_to_key(dev.device_kind)
+        if key is not None:
+            throughput_registry.record_measurement(
+                key, mfu, tokens_per_sec=tokens_per_sec,
+                model=f'{cfg.d_model}x{cfg.n_layers}')
 
 
 def _attempt_envs():

@@ -44,6 +44,118 @@ _SKYLET_PROBE_CMD = (
     f'kill -0 "$(cat {constants.SKYLET_PID_FILE})" 2>/dev/null')
 
 
+class SSHConfigHelper:
+    """`ssh <cluster>` UX: managed Host blocks in the user's ssh config.
+
+    Parity: /root/reference/sky/backends/backend_utils.py:399
+    (SSHConfigHelper).  Per-cluster config files live under
+    $SKYTPU_HOME/ssh/<cluster>.conf; one managed `Include` line at the
+    TOP of ~/.ssh/config pulls them in (Include must precede the first
+    Host block to apply globally).  `ssh <cluster>` reaches the head
+    host; workers are `<cluster>-worker1..N`.
+    """
+
+    _INCLUDE_MARK = '# Added by skypilot_tpu'
+
+    @classmethod
+    def _ssh_dir(cls) -> str:
+        return common_utils.ensure_dir(
+            os.path.join(common_utils.skytpu_home(), 'ssh'), mode=0o700)
+
+    @classmethod
+    def _cluster_conf_path(cls, cluster_name: str) -> str:
+        return os.path.join(cls._ssh_dir(), f'{cluster_name}.conf')
+
+    @classmethod
+    def _ensure_include(cls) -> None:
+        config_path = os.path.expanduser('~/.ssh/config')
+        include_line = f'Include {cls._ssh_dir()}/*.conf'
+        content = ''
+        if os.path.exists(config_path):
+            with open(config_path, encoding='utf-8') as f:
+                content = f.read()
+        if include_line in content:
+            return
+        os.makedirs(os.path.dirname(config_path), mode=0o700,
+                    exist_ok=True)
+        new = (f'{cls._INCLUDE_MARK}\n{include_line}\n\n' + content)
+        with open(config_path, 'w', encoding='utf-8') as f:
+            f.write(new)
+        os.chmod(config_path, 0o600)
+
+    @classmethod
+    def add_cluster(cls, cluster_name: str, ips: List[str], *,
+                    ssh_user: str, ssh_private_key: Optional[str],
+                    port: int = 22,
+                    ssh_proxy_command: Optional[str] = None) -> None:
+        if not ips:
+            return
+        cls._ensure_include()
+        blocks = []
+        for i, ip in enumerate(ips):
+            host = cluster_name if i == 0 else f'{cluster_name}-worker{i}'
+            lines = [
+                f'Host {host}',
+                f'  HostName {ip}',
+                f'  User {ssh_user}',
+                f'  Port {port}',
+                '  StrictHostKeyChecking no',
+                '  UserKnownHostsFile /dev/null',
+                '  IdentitiesOnly yes',
+            ]
+            if ssh_private_key:
+                lines.append(f'  IdentityFile {ssh_private_key}')
+            if ssh_proxy_command:
+                lines.append(f'  ProxyCommand {ssh_proxy_command}')
+            blocks.append('\n'.join(lines))
+        path = cls._cluster_conf_path(cluster_name)
+        with open(path, 'w', encoding='utf-8') as f:
+            f.write(f'{cls._INCLUDE_MARK}: cluster {cluster_name}\n'
+                    + '\n\n'.join(blocks) + '\n')
+        os.chmod(path, 0o600)
+        logger.debug(f'ssh config written for {cluster_name} '
+                     f'({len(ips)} host(s)).')
+
+    @classmethod
+    def remove_cluster(cls, cluster_name: str) -> None:
+        try:
+            os.remove(cls._cluster_conf_path(cluster_name))
+        except OSError:
+            pass
+
+    @classmethod
+    def list_clusters(cls) -> List[str]:
+        try:
+            return sorted(
+                f[:-len('.conf')] for f in os.listdir(cls._ssh_dir())
+                if f.endswith('.conf'))
+        except OSError:
+            return []
+
+
+def check_remote_runtime_version(
+        handle: 'slice_backend.SliceResourceHandle') -> Optional[str]:
+    """Client/remote version-skew check (reference backend_utils.py:2593).
+
+    The handle records the client version that shipped the app tree at
+    provision time (`launched_runtime_version`), so the check is a
+    LOCAL comparison — no per-exec ssh round-trip on the
+    time-to-first-step hot path.  Returns a warning string on skew,
+    None when in sync or unknowable (pre-stamp handles).
+    """
+    import skypilot_tpu  # pylint: disable=import-outside-toplevel
+    local_version = getattr(skypilot_tpu, '__version__', None)
+    remote_version = getattr(handle, 'launched_runtime_version', None)
+    if local_version is None or remote_version is None:
+        return None
+    if remote_version != local_version:
+        return (f'Cluster {handle.cluster_name} runs skypilot_tpu '
+                f'{remote_version}, client is {local_version}; '
+                f'restart the cluster (sky stop/start) or relaunch to '
+                f'resync the runtime.')
+    return None
+
+
 def cluster_lock_path(cluster_name: str) -> str:
     lock_dir = common_utils.ensure_dir(
         os.path.join(common_utils.skytpu_home(), 'locks'))

@@ -66,6 +66,12 @@ class SliceResourceHandle(backend_lib.ResourceHandle):
         # Cached (refreshable) connectivity info.
         self.stable_internal_external_ips: Optional[List[Tuple[str, str]]] = None
         self.launched_at = time.time()
+        # Runtime version shipped to the cluster at provision time (the
+        # app tree is rsynced then) — lets the skew check compare
+        # versions locally, with zero per-exec ssh round-trips.
+        import skypilot_tpu  # pylint: disable=import-outside-toplevel
+        self.launched_runtime_version = getattr(skypilot_tpu,
+                                                '__version__', None)
 
     def get_cluster_name(self) -> str:
         return self.cluster_name
@@ -318,6 +324,16 @@ class SliceBackend(backend_lib.Backend[SliceResourceHandle]):
             ready=True)
         global_user_state.set_owner_identity_for_cluster(
             cluster_name, cloud.get_current_user_identity())
+        # `ssh <cluster>` UX (reference backend_utils.py:399): write the
+        # managed Host block ONLY for clusters actually reachable over
+        # ssh (an ssh key was provisioned).  Local hosts are
+        # directories; GKE pods are kubectl-exec — neither runs sshd.
+        from skypilot_tpu.backends import backend_utils  # pylint: disable=import-outside-toplevel
+        if cluster_info.ssh_private_key:
+            ips = handle.external_ips() or []
+            backend_utils.SSHConfigHelper.add_cluster(
+                cluster_name, ips, ssh_user=cluster_info.ssh_user,
+                ssh_private_key=cluster_info.ssh_private_key)
         return handle
 
     # ---------------------------------------------------------------- sync
@@ -598,6 +614,8 @@ class SliceBackend(backend_lib.Backend[SliceResourceHandle]):
                                f'{handle.cluster_name}.')
             global_user_state.remove_cluster(handle.cluster_name,
                                              terminate=terminate)
+            backend_utils.SSHConfigHelper.remove_cluster(
+                handle.cluster_name)
 
     def run_on_head(self, handle: SliceResourceHandle, cmd: str,
                     **kwargs: Any) -> Any:

@@ -189,10 +189,13 @@ def exec_cmd(cluster, entrypoint, detach_run, **task_args):
 @cli.command()
 @click.option('--refresh', '-r', is_flag=True, default=False,
               help='Re-query live cluster status from the provider.')
+@click.option('--verbose', '-v', is_flag=True, default=False,
+              help='Show the last launch stage-runtime decomposition.')
 @click.argument('clusters', nargs=-1)
-def status(refresh, clusters):
+def status(refresh, verbose, clusters):
     """Show clusters."""
     from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu import usage_lib  # pylint: disable=import-outside-toplevel
     records = core.status(cluster_names=list(clusters) or None,
                           refresh=refresh)
     if not records:
@@ -205,9 +208,19 @@ def status(refresh, clusters):
         if handle is not None and getattr(handle, 'launched_resources',
                                           None) is not None:
             resources_str = str(handle.launched_resources)
+        launch_rec = r.get('last_launch')
+        ttfs = (f'{launch_rec["time_to_first_step"]:.1f}s'
+                if launch_rec else '-')
         rows.append((r['name'], resources_str, str(r['status'].value),
-                     r.get('autostop', '-')))
-    _print_table(['NAME', 'RESOURCES', 'STATUS', 'AUTOSTOP'], rows)
+                     r.get('autostop', '-'), ttfs))
+    _print_table(['NAME', 'RESOURCES', 'STATUS', 'AUTOSTOP',
+                  'TIME-TO-FIRST-STEP'], rows)
+    if verbose:
+        for r in records:
+            if r.get('last_launch'):
+                click.echo(f'\n{r["name"]}: '
+                           + usage_lib.format_decomposition(
+                               r['last_launch']))
 
 
 def _print_table(headers: List[str], rows: List[tuple]) -> None:
@@ -416,6 +429,54 @@ def jobs_logs(job_id, no_follow):
     """Tail a managed job's logs."""
     from skypilot_tpu import jobs  # pylint: disable=import-outside-toplevel
     jobs.tail_logs(job_id, follow=not no_follow)
+
+
+@jobs_group.command(name='dashboard')
+@click.option('--refresh', '-r', 'refresh_every', type=float, default=0,
+              help='Redraw every N seconds (0 = print once and exit).')
+def jobs_dashboard(refresh_every):
+    """Live text dashboard of managed jobs.
+
+    Parity: reference sky/jobs/dashboard (web) — rendered as a
+    terminal table: status mix, per-job state, recoveries, age.
+    """
+    import collections  # pylint: disable=import-outside-toplevel
+    import datetime  # pylint: disable=import-outside-toplevel
+    import time as time_lib  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu import jobs  # pylint: disable=import-outside-toplevel
+
+    def _render():
+        records = jobs.queue()
+        by_status = collections.Counter(r['status'] for r in records)
+        summary = '  '.join(f'{s}: {n}'
+                            for s, n in sorted(by_status.items()))
+        now = time_lib.time()
+        rows = []
+        for r in records:
+            age = '-'
+            if r.get('submitted_at'):
+                age = str(datetime.timedelta(
+                    seconds=int(now - r['submitted_at'])))
+            rows.append((r['job_id'], r['task_id'], r['job_name'],
+                         r['status'], r['recovery_count'],
+                         r.get('cluster_name') or '-', age))
+        click.echo(f'Managed jobs — {len(records)} total'
+                   + (f'  ({summary})' if summary else ''))
+        _print_table(
+            ['ID', 'TASK', 'NAME', 'STATUS', 'RECOVERIES', 'CLUSTER',
+             'AGE'], rows)
+
+    if refresh_every <= 0:
+        _render()
+        return
+    try:
+        while True:
+            click.clear()
+            _render()
+            time_lib.sleep(refresh_every)
+    except KeyboardInterrupt:
+        pass
 
 
 # ------------------------------------------------------------ serve group

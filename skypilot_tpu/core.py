@@ -21,9 +21,20 @@ logger = sky_logging.init_logger(__name__)
 
 def status(cluster_names: Optional[List[str]] = None,
            refresh: bool = False) -> List[Dict[str, Any]]:
-    """Cluster records from local state (optionally cloud-reconciled)."""
-    return backend_utils.get_clusters(refresh=refresh,
-                                      cluster_names=cluster_names)
+    """Cluster records from local state (optionally cloud-reconciled).
+
+    Each record carries 'last_launch' — the most recent launch's
+    stage-runtime decomposition (usage_lib) — so time-to-first-step is
+    inspectable per cluster (reference usage_lib.py:265 parity,
+    surfaced locally instead of phoned home).
+    """
+    from skypilot_tpu import usage_lib  # pylint: disable=import-outside-toplevel
+    records = backend_utils.get_clusters(refresh=refresh,
+                                         cluster_names=cluster_names)
+    launches = usage_lib.latest_launches()
+    for record in records:
+        record['last_launch'] = launches.get(record['name'])
+    return records
 
 
 def start(cluster_name: str,
@@ -149,7 +160,9 @@ def cost_report() -> List[Dict[str, Any]]:
 
     Parity: reference core.py cost_report (resources price × up-duration).
     """
+    from skypilot_tpu import usage_lib  # pylint: disable=import-outside-toplevel
     records = global_user_state.get_clusters_from_history()
+    launches = usage_lib.latest_launches()
     for record in records:
         launched = record.get('launched_resources')
         duration = record.get('duration', 0)
@@ -161,6 +174,11 @@ def cost_report() -> List[Dict[str, Any]]:
             except Exception:  # pylint: disable=broad-except
                 cost = 0.0
         record['total_cost'] = cost
+        # Launch-overhead decomposition: cost is only half the story —
+        # time-to-first-step is the north-star denominator.
+        launch_rec = launches.get(record.get('name') or '')
+        record['time_to_first_step'] = (
+            launch_rec['time_to_first_step'] if launch_rec else None)
     return records
 
 

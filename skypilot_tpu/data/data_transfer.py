@@ -1,0 +1,130 @@
+"""Cross-cloud bulk bucket transfer via GCP Storage Transfer Service.
+
+Parity: /root/reference/sky/data/data_transfer.py (s3_to_gcs uses the
+Storage Transfer Service so the bytes move cloud-side at line rate —
+never through the client).  Rebuilt with the injectable-transport seam
+used across this repo (catalog/data_fetchers, provision/gcp) so the
+whole flow is unit-testable without network or google SDKs.
+
+Local-to-local transfers (LocalStore) copy directly — the hermetic
+path used by tests and the local provisioner.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.data import storage as storage_lib
+
+logger = sky_logging.init_logger(__name__)
+
+STS_API = 'https://storagetransfer.googleapis.com/v1'
+_POLL_INTERVAL = 5.0
+
+# transport(method, url, json_body) -> response dict
+Transport = Callable[[str, str, Optional[Dict[str, Any]]],
+                     Dict[str, Any]]
+
+
+def _default_transport(method: str, url: str,
+                       body: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    import requests  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.provision.gcp import tpu_api  # pylint: disable=import-outside-toplevel
+    token = tpu_api._gcloud_token()  # pylint: disable=protected-access
+    resp = requests.request(method, url, json=body,
+                            headers={'Authorization': f'Bearer {token}'},
+                            timeout=60)
+    resp.raise_for_status()
+    return resp.json() if resp.content else {}
+
+
+def _transfer_spec(src: storage_lib.AbstractStore,
+                   dst: storage_lib.AbstractStore) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {}
+    if src.store_type is storage_lib.StoreType.S3:
+        spec['awsS3DataSource'] = {'bucketName': src.name}
+    elif src.store_type is storage_lib.StoreType.GCS:
+        spec['gcsDataSource'] = {'bucketName': src.name}
+    else:
+        raise exceptions.NotSupportedError(
+            f'Transfer source {src.store_type.value} is not supported '
+            'by the Storage Transfer Service.')
+    if src.prefix:
+        spec['objectConditions'] = {'includePrefixes': [src.prefix]}
+    if dst.store_type is not storage_lib.StoreType.GCS:
+        raise exceptions.NotSupportedError(
+            'Storage Transfer Service only lands in GCS buckets; '
+            f'got {dst.store_type.value}.')
+    spec['gcsDataSink'] = {'bucketName': dst.name}
+    return spec
+
+
+def transfer(src: storage_lib.AbstractStore,
+             dst: storage_lib.AbstractStore,
+             *,
+             project_id: Optional[str] = None,
+             transport: Optional[Transport] = None,
+             wait: bool = True,
+             timeout: float = 3600.0) -> Dict[str, Any]:
+    """Move a bucket (or prefix) between stores; returns the job record.
+
+    local->local copies directly; every cloud pair routes through the
+    Storage Transfer Service (S3->GCS, GCS->GCS).
+    """
+    if (src.store_type is storage_lib.StoreType.LOCAL and
+            dst.store_type is storage_lib.StoreType.LOCAL):
+        dst.create()
+        dst.upload(src._data_dir)  # type: ignore[attr-defined]  # pylint: disable=protected-access
+        return {'status': 'DONE', 'mechanism': 'local-copy'}
+
+    transport = transport or _default_transport
+    if project_id is None:
+        from skypilot_tpu import config as config_lib  # pylint: disable=import-outside-toplevel
+        project_id = config_lib.get_nested(('gcp', 'project_id'), None)
+    if project_id is None:
+        raise exceptions.InvalidSkyTpuConfigError(
+            'Cross-cloud transfer needs gcp.project_id in config.')
+
+    job_body = {
+        'description': f'skytpu transfer {src.url} -> {dst.url}',
+        'status': 'ENABLED',
+        'projectId': project_id,
+        'transferSpec': _transfer_spec(src, dst),
+    }
+    job = transport('POST', f'{STS_API}/transferJobs', job_body)
+    job_name = job.get('name')
+    logger.info(f'Transfer job {job_name}: {src.url} -> {dst.url}')
+    run = transport(
+        'POST', f'{STS_API}/{job_name}:run', {'projectId': project_id})
+    op_name = run.get('name')
+    if not wait:
+        return {'job': job_name, 'operation': op_name,
+                'status': 'IN_PROGRESS'}
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        op = transport('GET', f'{STS_API}/{op_name}', None)
+        if op.get('done'):
+            if 'error' in op:
+                raise exceptions.StorageError(
+                    f'Transfer {src.url} -> {dst.url} failed: '
+                    f'{op["error"]}')
+            return {'job': job_name, 'operation': op_name,
+                    'status': 'DONE'}
+        time.sleep(_POLL_INTERVAL)
+    raise exceptions.StorageError(
+        f'Transfer {src.url} -> {dst.url} timed out after {timeout}s.')
+
+
+def s3_to_gcs(s3_bucket: str, gcs_bucket: str, **kwargs) -> Dict[str, Any]:
+    """Parity shim for the reference's data_transfer.s3_to_gcs."""
+    return transfer(storage_lib.S3Store(s3_bucket),
+                    storage_lib.GcsStore(gcs_bucket), **kwargs)
+
+
+def gcs_to_gcs(src_bucket: str, dst_bucket: str,
+               **kwargs) -> Dict[str, Any]:
+    return transfer(storage_lib.GcsStore(src_bucket),
+                    storage_lib.GcsStore(dst_bucket), **kwargs)

@@ -70,53 +70,72 @@ def _execute(
                                                idle_minutes_to_autostop))
     stages = stages or list(Stage)
 
-    to_provision: Optional[Resources] = None
-    if Stage.OPTIMIZE in stages:
-        existing = None
-        try:
-            existing = backend.check_existing_cluster(cluster_name, task)
-        except (exceptions.ClusterNotUpError,
-                exceptions.ResourcesMismatchError):
-            raise
-        if existing is None:
-            optimizer_lib.Optimizer.optimize(dag, minimize=optimize_target,
-                                             quiet=not stream_logs)
-            to_provision = task.best_resources
+    # Stage-runtime decomposition: time-to-first-step is the north-star
+    # denominator (BASELINE.md); every invocation records where its
+    # wall-clock went (usage_lib; surfaced by `sky status`).
+    from skypilot_tpu import usage_lib  # pylint: disable=import-outside-toplevel
+    run_rec = usage_lib.RunRecord(
+        'launch' if Stage.PROVISION in stages else 'exec', cluster_name)
+    try:
+        to_provision: Optional[Resources] = None
+        if Stage.OPTIMIZE in stages:
+            with run_rec.stage('optimize'):
+                existing = backend.check_existing_cluster(cluster_name,
+                                                          task)
+                if existing is None:
+                    optimizer_lib.Optimizer.optimize(
+                        dag, minimize=optimize_target,
+                        quiet=not stream_logs)
+                    to_provision = task.best_resources
 
-    handle = None
-    if Stage.PROVISION in stages:
-        handle = backend.provision(task, to_provision, dryrun=dryrun,
-                                   stream_logs=stream_logs,
-                                   cluster_name=cluster_name,
-                                   retry_until_up=retry_until_up)
-        if dryrun:
-            return None
-        assert handle is not None
-    else:
-        handle = backend_utils.check_cluster_available(cluster_name)
+        handle = None
+        if Stage.PROVISION in stages:
+            with run_rec.stage('provision'):
+                handle = backend.provision(task, to_provision,
+                                           dryrun=dryrun,
+                                           stream_logs=stream_logs,
+                                           cluster_name=cluster_name,
+                                           retry_until_up=retry_until_up)
+            if dryrun:
+                return None
+            assert handle is not None
+        else:
+            handle = backend_utils.check_cluster_available(cluster_name)
 
-    if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
-        backend.sync_workdir(handle, task.workdir)
+        if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
+            with run_rec.stage('sync_workdir'):
+                backend.sync_workdir(handle, task.workdir)
 
-    if Stage.SYNC_FILE_MOUNTS in stages:
-        if task.file_mounts or task.storage_mounts:
-            backend.sync_file_mounts(handle, task.file_mounts,
-                                     task.storage_mounts)
+        if Stage.SYNC_FILE_MOUNTS in stages:
+            if task.file_mounts or task.storage_mounts:
+                with run_rec.stage('sync_file_mounts'):
+                    backend.sync_file_mounts(handle, task.file_mounts,
+                                             task.storage_mounts)
 
-    if Stage.SETUP in stages and not no_setup:
-        backend.setup(handle, task)
+        if Stage.SETUP in stages and not no_setup:
+            with run_rec.stage('setup'):
+                backend.setup(handle, task)
 
-    if Stage.PRE_EXEC in stages:
-        if idle_minutes_to_autostop is not None:
-            backend.set_autostop(handle, idle_minutes_to_autostop, down)
+        if Stage.PRE_EXEC in stages:
+            if idle_minutes_to_autostop is not None:
+                with run_rec.stage('pre_exec'):
+                    backend.set_autostop(handle, idle_minutes_to_autostop,
+                                         down)
 
-    job_id = None
-    if Stage.EXEC in stages:
-        job_id = backend.execute(handle, task, detach_run=detach_run)
+        job_id = None
+        if Stage.EXEC in stages:
+            # exec_submit covers handing the job to the cluster, not
+            # the job's own runtime (that is the job's, not ours).
+            with run_rec.stage('exec_submit'):
+                job_id = backend.execute(handle, task,
+                                         detach_run=detach_run)
 
-    if Stage.DOWN in stages and down and idle_minutes_to_autostop is None:
-        backend.teardown(handle, terminate=True)
-    return job_id
+        if (Stage.DOWN in stages and down and
+                idle_minutes_to_autostop is None):
+            backend.teardown(handle, terminate=True)
+        return job_id
+    finally:
+        run_rec.finalize()
 
 
 def _requested_features(task: task_lib.Task, down: bool,
@@ -180,7 +199,12 @@ def exec(  # pylint: disable=redefined-builtin
 
     Parity: reference execution.py:477.
     """
-    backend_utils.check_cluster_available(cluster_name)
+    handle = backend_utils.check_cluster_available(cluster_name)
+    # Stale-runtime guard (reference backend_utils.py:2593): warn when
+    # the cluster's app tree no longer matches this client.
+    skew = backend_utils.check_remote_runtime_version(handle)
+    if skew:
+        logger.warning(skew)
     return _execute(
         task,
         cluster_name=cluster_name,

@@ -108,10 +108,13 @@ def _launch_controller_cluster(job_id: int, job_name: str,
                                yaml_path: str) -> None:
     from skypilot_tpu import execution  # pylint: disable=import-outside-toplevel
     from skypilot_tpu import resources as resources_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.skylet import constants as skylet_constants  # pylint: disable=import-outside-toplevel
     remote_yaml = f'~/.skytpu/managed_jobs/{job_name}-{job_id}.yaml'
     controller_task = task_lib.Task(
         name=f'jobs-controller-{job_id}',
-        run=(f'python -m skypilot_tpu.jobs.controller '
+        run=(f'PYTHONPATH={skylet_constants.SKY_REMOTE_APP_DIR}'
+             f':$PYTHONPATH {skylet_constants.SKY_PYTHON_CMD} '
+             f'-m skypilot_tpu.jobs.controller '
              f'--job-id {job_id} --dag-yaml {remote_yaml}'),
         file_mounts={remote_yaml: yaml_path},
     )

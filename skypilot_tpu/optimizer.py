@@ -51,21 +51,27 @@ def _enabled_clouds() -> List[str]:
 def _relative_throughput(resources: Resources) -> float:
     """Throughput prior for cross-accelerator TIME estimates.
 
-    Aggregate dense-bf16 TFLOPs of the launchable; a crude but monotone
-    proxy (SURVEY.md §7 'optimizer fungibility' names this the hard part —
-    user `set_time_estimator` hints override it entirely).
+    Effective (sustained) TFLOPs = peak dense-bf16 TFLOPs x MFU, where
+    the MFU factor is MEASURED when a bench has run on that accelerator
+    (utils/throughput_registry; bench.py records its result) and a
+    conservative family default otherwise (SURVEY.md §7 'optimizer
+    fungibility'; user `set_time_estimator` hints override entirely).
     """
+    from skypilot_tpu.utils import throughput_registry  # pylint: disable=import-outside-toplevel
     spec = resources.tpu_spec
     if spec is not None:
-        return spec.total_bf16_tflops * resources.num_slices
+        key = f'tpu-{spec.generation}'
+        return (spec.total_bf16_tflops * resources.num_slices *
+                throughput_registry.mfu_for(key))
     accs = resources.accelerators
     if accs:
         name, count = next(iter(accs.items()))
         gpu_tflops = {
-            'A100': 312.0, 'A100-80GB': 312.0, 'H100': 989.0, 'L4': 121.0,
-            'T4': 65.0, 'V100': 125.0, 'P100': 21.0, 'K80': 8.7,
+            'A100': 312.0, 'A100-80GB': 312.0, 'H100': 989.0,
+            'H100-MEGA': 989.0, 'A10G': 125.0, 'L4': 121.0, 'T4': 65.0,
+            'V100': 125.0, 'P100': 21.0, 'K80': 8.7,
         }.get(name, 50.0)
-        return gpu_tflops * count
+        return gpu_tflops * count * throughput_registry.mfu_for(name)
     return 1.0
 
 
@@ -236,17 +242,44 @@ def _optimize_chain_by_dp(
 def format_plan_table(
         plan: 'collections.OrderedDict[task_lib.Task, Tuple[Resources, float]]',
         minimize: OptimizeTarget) -> str:
-    """Human-readable plan summary (parity optimizer.py:718 pretty table)."""
+    """Human-readable plan summary (parity optimizer.py:718 pretty table).
+
+    TFLOPS is the candidate's EFFECTIVE throughput (peak x MFU; `*`
+    marks a bench-MEASURED MFU rather than a family default).
+    EST.TIME is printed only when the task carries a real
+    `set_time_estimator` — never a fabricated absolute from the
+    default-runtime scalar.
+    """
+    from skypilot_tpu.utils import throughput_registry  # pylint: disable=import-outside-toplevel
     lines = [f'Optimizer target: {minimize.value.upper()}', '']
-    header = f'{"TASK":<20} {"RESOURCES":<42} {"$/HR":>8} {"HOSTS":>6}'
+    header = (f'{"TASK":<20} {"RESOURCES":<42} {"$/HR":>8} {"HOSTS":>6} '
+              f'{"TFLOPS":>9} {"EST.TIME":>9}')
     lines.append(header)
     lines.append('-' * len(header))
+    any_measured = False
     for task, (resources, _) in plan.items():
         hourly = resources.get_cost(3600.0) * task.num_nodes
         spec = resources.tpu_spec
         label = repr(resources)[len('<Resources: '):-1]
         hosts = (spec.num_hosts * resources.num_slices
                  if spec is not None else 1) * task.num_nodes
+        if spec is not None:
+            measured = throughput_registry.is_measured(
+                f'tpu-{spec.generation}')
+        elif resources.accelerators:
+            measured = throughput_registry.is_measured(
+                next(iter(resources.accelerators)))
+        else:
+            measured = False
+        any_measured |= measured
+        tflops = (f'{_relative_throughput(resources):.0f}'
+                  + ('*' if measured else ''))
+        try:
+            est = f'{task.estimate_runtime(resources) / 3600.0:.1f}h'
+        except exceptions.InvalidTaskError:
+            est = '-'
         lines.append(f'{(task.name or "-")[:20]:<20} {label:<42} '
-                     f'{hourly:>8.2f} {hosts:>6}')
+                     f'{hourly:>8.2f} {hosts:>6} {tflops:>9} {est:>9}')
+    if any_measured:
+        lines.append('* = effective TFLOPs from a measured bench MFU')
     return '\n'.join(lines)

@@ -1,0 +1,19 @@
+"""Serve constants: controller placement config.
+
+Parity: /root/reference/sky/serve/constants.py + serve/core.py:203 (the
+reference ALWAYS places the serve controller on a provisioned VM; here
+placement is configurable like managed jobs'):
+
+- 'process' (default): controller + LB run as a detached local daemon.
+- 'cluster': a controller cluster is launched through the normal stack
+  and runs the identical service daemon (reference behavior); client
+  queries route there over ssh codegen (serve/utils.py ServeCodeGen).
+"""
+from __future__ import annotations
+
+CONTROLLER_MODE_KEY = ('serve', 'controller', 'mode')
+DEFAULT_CONTROLLER_MODE = 'process'
+# One shared controller cluster hosts every service's daemon (parity:
+# the reference multiplexes services onto one controller VM).
+CONTROLLER_CLUSTER_NAME = 'skytpu-serve-controller'
+ENV_ON_CONTROLLER = 'SKYTPU_ON_CONTROLLER'

@@ -37,9 +37,19 @@ def _validate(task: task_lib.Task, service_name: str) -> None:
 
 def up(task: task_lib.Task, service_name: Optional[str] = None,
        *, detach: bool = True) -> Tuple[str, str]:
-    """Start a service; returns (service_name, endpoint_url)."""
+    """Start a service; returns (service_name, endpoint_url).
+
+    With `serve.controller.mode: cluster` the service daemon
+    (controller + LB) runs on a provisioned controller cluster
+    (reference serve/core.py:203 behavior) instead of a local process;
+    replica clusters are then launched FROM that cluster and survive
+    this client machine going away.
+    """
     service_name = service_name or task.name or 'service'
     _validate(task, service_name)
+    from skypilot_tpu.serve import utils as serve_utils  # pylint: disable=import-outside-toplevel
+    if serve_utils.controller_mode() == 'cluster':
+        return _up_on_cluster(task, service_name, detach=detach)
     if serve_state.get_service(service_name) is not None:
         raise exceptions.InvalidTaskError(
             f'Service {service_name!r} already exists; use '
@@ -55,10 +65,105 @@ def up(task: task_lib.Task, service_name: Optional[str] = None,
     return service_name, endpoint
 
 
+def _up_on_cluster(task: task_lib.Task, service_name: str,
+                   *, detach: bool) -> Tuple[str, str]:
+    from skypilot_tpu import execution  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu import resources as resources_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import constants as serve_constants  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import utils as serve_utils  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.utils import controller_utils  # pylint: disable=import-outside-toplevel
+
+    if serve_utils.run_if_controller_exists(
+            serve_utils.ServeCodeGen.get_service(service_name),
+            'SERVE_RECORD:') is not None:
+        raise exceptions.InvalidTaskError(
+            f'Service {service_name!r} already exists; use '
+            'serve.update() for in-place updates.')
+    # The controller cluster cannot see this machine's filesystem: the
+    # SERVICE task's local paths must be translated before handoff
+    # (replicas launch from the controller).
+    controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+        task, task_type='serve')
+    yaml_path = os.path.join(_yaml_dir(), f'{service_name}.yaml')
+    common_utils.dump_yaml(yaml_path, task.to_yaml_config())
+    remote_yaml = f'~/.skytpu/serve/{service_name}.yaml'
+    from skypilot_tpu.skylet import constants as skylet_constants  # pylint: disable=import-outside-toplevel
+    controller_task = task_lib.Task(
+        name=f'serve-daemon-{service_name}',
+        run=(f'PYTHONPATH={skylet_constants.SKY_REMOTE_APP_DIR}'
+             f':$PYTHONPATH {skylet_constants.SKY_PYTHON_CMD} '
+             f'-m skypilot_tpu.serve.service '
+             f'--service-name {service_name} '
+             f'--register-from-yaml {remote_yaml}'),
+        file_mounts={remote_yaml: yaml_path},
+        envs={serve_constants.ENV_ON_CONTROLLER: '1'},
+    )
+    controller_task.set_resources(
+        resources_lib.Resources(cpus='4+', memory='8+'))
+    execution.launch(controller_task,
+                     cluster_name=serve_constants.CONTROLLER_CLUSTER_NAME,
+                     stream_logs=False, detach_run=True)
+    endpoint = _wait_for_cluster_endpoint(service_name)
+    if not detach:
+        _wait_until_ready_on_cluster(service_name)
+    return service_name, endpoint
+
+
+def _wait_for_cluster_endpoint(service_name: str,
+                               timeout: float = 120.0) -> str:
+    from skypilot_tpu.serve import utils as serve_utils  # pylint: disable=import-outside-toplevel
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        record = serve_utils.run_on_serve_controller(
+            serve_utils.ServeCodeGen.get_service(service_name),
+            'SERVE_RECORD:')
+        if record and record.get('load_balancer_port'):
+            host = serve_utils.controller_head_ip()
+            return f'http://{host}:{record["load_balancer_port"]}'
+        time.sleep(1.0)
+    raise exceptions.SkyTpuError(
+        f'Service {service_name} daemon did not come up on the '
+        f'controller cluster in {timeout}s.')
+
+
+def _wait_until_ready_on_cluster(service_name: str,
+                                 timeout: float = 600.0) -> None:
+    from skypilot_tpu.serve import utils as serve_utils  # pylint: disable=import-outside-toplevel
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        record = serve_utils.run_on_serve_controller(
+            serve_utils.ServeCodeGen.get_service(service_name),
+            'SERVE_RECORD:')
+        if record and record['status'] == ServiceStatus.READY.value:
+            return
+        time.sleep(1.0)
+    raise exceptions.SkyTpuError(
+        f'Service {service_name} not READY within {timeout}s.')
+
+
 def update(task: task_lib.Task, service_name: str) -> int:
     """Install a new task/spec version; the controller rolls replicas
     over to it one at a time. Returns the new version."""
     _validate(task, service_name)
+    from skypilot_tpu.serve import utils as serve_utils  # pylint: disable=import-outside-toplevel
+    if serve_utils.controller_mode() == 'cluster':
+        from skypilot_tpu.backends import backend_utils  # pylint: disable=import-outside-toplevel
+        from skypilot_tpu.serve import constants as serve_constants  # pylint: disable=import-outside-toplevel
+        from skypilot_tpu.utils import controller_utils  # pylint: disable=import-outside-toplevel
+        controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+            task, task_type='serve')
+        yaml_path = os.path.join(_yaml_dir(), f'{service_name}.yaml')
+        common_utils.dump_yaml(yaml_path, task.to_yaml_config())
+        remote_yaml = f'~/.skytpu/serve/{service_name}.yaml'
+        handle = backend_utils.check_cluster_available(
+            serve_constants.CONTROLLER_CLUSTER_NAME)
+        for runner in handle.get_command_runners()[:1]:
+            runner.run(f'mkdir -p ~/.skytpu/serve', stream_logs=False)
+            runner.rsync(yaml_path, remote_yaml, up=True,
+                         stream_logs=False)
+        return serve_utils.run_on_serve_controller(
+            serve_utils.ServeCodeGen.update(service_name, remote_yaml),
+            'SERVE_VERSION:')
     record = serve_state.get_service(service_name)
     if record is None:
         raise exceptions.InvalidTaskError(
@@ -83,6 +188,21 @@ def update(task: task_lib.Task, service_name: str) -> int:
 
 def down(service_name: str, purge: bool = False) -> None:
     """Stop the daemon, terminate all replicas, remove state."""
+    from skypilot_tpu.serve import utils as serve_utils  # pylint: disable=import-outside-toplevel
+    if serve_utils.controller_mode() == 'cluster':
+        try:
+            result = serve_utils.run_if_controller_exists(
+                serve_utils.ServeCodeGen.down(service_name, purge),
+                'SERVE_DOWN:')
+        except exceptions.SkyTpuError:
+            if not purge:
+                raise
+            result = True  # best effort: controller unreachable
+        if result is None and not purge:
+            raise exceptions.InvalidTaskError(
+                f'Service {service_name!r} does not exist (no serve '
+                'controller cluster).')
+        return
     record = serve_state.get_service(service_name)
     if record is None:
         if purge:
@@ -112,6 +232,11 @@ def down(service_name: str, purge: bool = False) -> None:
 
 def status(service_names: Optional[List[str]] = None
            ) -> List[Dict[str, Any]]:
+    from skypilot_tpu.serve import utils as serve_utils  # pylint: disable=import-outside-toplevel
+    if serve_utils.controller_mode() == 'cluster':
+        return serve_utils.run_if_controller_exists(
+            serve_utils.ServeCodeGen.status(service_names),
+            'SERVE_STATUS:') or []
     records = serve_state.get_services()
     if service_names is not None:
         records = [r for r in records if r['name'] in service_names]

@@ -40,6 +40,8 @@ class LoadBalancingPolicy:
 
 class RoundRobinPolicy(LoadBalancingPolicy):
 
+    NAME = 'round_robin'
+
     def __init__(self) -> None:
         self._index = 0
         self._lock = threading.Lock()
@@ -51,6 +53,52 @@ class RoundRobinPolicy(LoadBalancingPolicy):
             url = urls[self._index % len(urls)]
             self._index += 1
         return url
+
+
+class LeastConnectionsPolicy(LoadBalancingPolicy):
+    """Pick the replica with the fewest in-flight requests — better
+    than round-robin for LLM serving, where generation lengths (and so
+    request costs) are wildly uneven.  Callers must bracket the request
+    with acquire/release."""
+
+    NAME = 'least_connections'
+
+    def __init__(self) -> None:
+        self._inflight: dict = {}
+        self._lock = threading.Lock()
+
+    def select(self, urls: List[str]) -> Optional[str]:
+        if not urls:
+            return None
+        with self._lock:
+            return min(urls, key=lambda u: (self._inflight.get(u, 0), u))
+
+    def acquire(self, url: str) -> None:
+        with self._lock:
+            self._inflight[url] = self._inflight.get(url, 0) + 1
+
+    def release(self, url: str) -> None:
+        with self._lock:
+            n = self._inflight.get(url, 0) - 1
+            if n <= 0:
+                self._inflight.pop(url, None)
+            else:
+                self._inflight[url] = n
+
+
+POLICIES = {
+    RoundRobinPolicy.NAME: RoundRobinPolicy,
+    LeastConnectionsPolicy.NAME: LeastConnectionsPolicy,
+}
+
+
+def make_policy(name: Optional[str]) -> LoadBalancingPolicy:
+    if name is None:
+        return RoundRobinPolicy()
+    if name not in POLICIES:
+        raise ValueError(f'Unknown load_balancing_policy {name!r}; '
+                         f'have {sorted(POLICIES)}')
+    return POLICIES[name]()
 
 
 class SkyServeLoadBalancer:
@@ -110,6 +158,19 @@ class SkyServeLoadBalancer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                # acquire/release must bracket EVERYTHING that can
+                # raise (bad Content-Length, client disconnects mid
+                # stream, ...) or in-flight counts leak and
+                # least_connections starves the replica forever.
+                if isinstance(lb.policy, LeastConnectionsPolicy):
+                    lb.policy.acquire(target)
+                try:
+                    self._proxy_to(target)
+                finally:
+                    if isinstance(lb.policy, LeastConnectionsPolicy):
+                        lb.policy.release(target)
+
+            def _proxy_to(self, target):
                 length = int(self.headers.get('Content-Length', 0))
                 data = self.rfile.read(length) if length else None
                 headers = {k: v for k, v in self.headers.items()

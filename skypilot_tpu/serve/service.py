@@ -18,24 +18,57 @@ logger = sky_logging.init_logger(__name__)
 
 
 def run_service(service_name: str, lb_port: int = 0) -> None:
+    import os  # pylint: disable=import-outside-toplevel
     controller = controller_lib.SkyServeController(service_name)
     controller_port = controller.start_http()
     lb = lb_lib.SkyServeLoadBalancer(
-        f'http://127.0.0.1:{controller_port}', port=lb_port)
+        f'http://127.0.0.1:{controller_port}', port=lb_port,
+        policy=lb_lib.make_policy(
+            getattr(controller.spec, 'load_balancing_policy', None)))
     bound_lb_port = lb.start()
     serve_state.set_service_ports(service_name, controller_port,
                                   bound_lb_port)
+    # Record our own pid so `down` can terminate the daemon even when
+    # it was started by a job supervisor on a controller cluster (in
+    # process mode the parent overwrites this with the same value).
+    serve_state.set_service_pids(service_name, controller_pid=os.getpid(),
+                                 lb_pid=os.getpid())
     try:
         controller.run_loop()
     finally:
         lb.stop()
 
 
+def register_from_yaml(service_name: str, task_yaml: str) -> None:
+    """Idempotently add the service record to the LOCAL state db.
+
+    Cluster mode ships only the task YAML to the controller cluster;
+    the daemon registers the service into the controller-side sqlite
+    before starting (parity: reference serve/service.py loads the spec
+    from the mounted service dir)."""
+    import os  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu import task as task_lib  # pylint: disable=import-outside-toplevel
+    if serve_state.get_service(service_name) is not None:
+        return
+    task_yaml = os.path.expanduser(task_yaml)
+    task = task_lib.Task.from_yaml(task_yaml)
+    if task.service is None:
+        raise ValueError(f'{task_yaml} has no `service:` section.')
+    serve_state.add_service(service_name,
+                            task.service.to_yaml_config(), task_yaml)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--service-name', required=True)
     parser.add_argument('--lb-port', type=int, default=0)
+    parser.add_argument('--register-from-yaml', default=None,
+                        help='Task YAML to register before serving '
+                             '(controller-cluster mode).')
     args = parser.parse_args()
+    if args.register_from_yaml:
+        register_from_yaml(args.service_name, args.register_from_yaml)
     run_service(args.service_name, args.lb_port)
 
 

@@ -26,7 +26,8 @@ class SkyServiceSpec:
                  upscale_delay_seconds: int = 300,
                  downscale_delay_seconds: int = 1200,
                  replica_port: int = 8080,
-                 base_ondemand_fallback_replicas: int = 0) -> None:
+                 base_ondemand_fallback_replicas: int = 0,
+                 load_balancing_policy: Optional[str] = None) -> None:
         if not readiness_path.startswith('/'):
             raise exceptions.InvalidTaskError(
                 f'readiness path must start with /, got {readiness_path!r}')
@@ -47,6 +48,14 @@ class SkyServiceSpec:
         self.downscale_delay_seconds = downscale_delay_seconds
         self.replica_port = replica_port
         self.base_ondemand_fallback_replicas = base_ondemand_fallback_replicas
+        if load_balancing_policy is not None:
+            from skypilot_tpu.serve import load_balancer as lb_lib  # pylint: disable=import-outside-toplevel
+            if load_balancing_policy not in lb_lib.POLICIES:
+                raise exceptions.InvalidTaskError(
+                    f'Unknown load_balancing_policy '
+                    f'{load_balancing_policy!r}; have '
+                    f'{sorted(lb_lib.POLICIES)}')
+        self.load_balancing_policy = load_balancing_policy
 
     @property
     def autoscaling_enabled(self) -> bool:
@@ -59,7 +68,7 @@ class SkyServiceSpec:
         config = dict(config)
         common_utils.validate_schema_keys(
             config, {'readiness_probe', 'replica_policy', 'replicas',
-                     'replica_port'}, 'service')
+                     'replica_port', 'load_balancing_policy'}, 'service')
         kwargs: Dict[str, Any] = {}
         probe = config.get('readiness_probe')
         if isinstance(probe, str):
@@ -99,6 +108,9 @@ class SkyServiceSpec:
             kwargs['max_replicas'] = int(config['replicas'])
         if config.get('replica_port') is not None:
             kwargs['replica_port'] = int(config['replica_port'])
+        if config.get('load_balancing_policy') is not None:
+            kwargs['load_balancing_policy'] = str(
+                config['load_balancing_policy'])
         return cls(**kwargs)
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -122,6 +134,8 @@ class SkyServiceSpec:
         if self.base_ondemand_fallback_replicas:
             policy['base_ondemand_fallback_replicas'] = (
                 self.base_ondemand_fallback_replicas)
+        if self.load_balancing_policy is not None:
+            config['load_balancing_policy'] = self.load_balancing_policy
         return config
 
     def __repr__(self) -> str:

@@ -1,0 +1,114 @@
+"""Serve codegen: client↔controller-cluster RPC over ssh.
+
+Parity: /root/reference/sky/serve/serve_utils.py ServeCodeGen — in
+cluster mode the serve state db lives on the controller cluster;
+status/down/endpoint queries route through generated one-liners
+executed on its head, the same transport as jobs/utils.py.
+"""
+from __future__ import annotations
+
+import shlex
+from typing import Any, List, Optional
+
+from skypilot_tpu.serve import constants as serve_constants
+from skypilot_tpu.skylet import constants
+
+
+class ServeCodeGen:
+
+    _PREFIX = ('import json, os; '
+               "os.environ.setdefault('PYTHONUNBUFFERED','1'); "
+               f"os.environ['{serve_constants.ENV_ON_CONTROLLER}'] = '1'; "
+               'from skypilot_tpu.serve import serve_state')
+
+    @classmethod
+    def _build(cls, code: List[str]) -> str:
+        full = '; '.join([cls._PREFIX] + code)
+        python = constants.SKY_PYTHON_CMD
+        app_dir = constants.SKY_REMOTE_APP_DIR
+        return (f'PYTHONPATH={app_dir}:$PYTHONPATH {python} -u -c '
+                f'{shlex.quote(full)}')
+
+    @classmethod
+    def status(cls, service_names: Optional[List[str]]) -> str:
+        return cls._build([
+            'from skypilot_tpu.serve import core',
+            f'records = core.status({service_names!r})',
+            'print("SERVE_STATUS:" + json.dumps(records), flush=True)',
+        ])
+
+    @classmethod
+    def get_service(cls, service_name: str) -> str:
+        return cls._build([
+            f'record = serve_state.get_service({service_name!r})',
+            'print("SERVE_RECORD:" + json.dumps(record), flush=True)',
+        ])
+
+    @classmethod
+    def down(cls, service_name: str, purge: bool) -> str:
+        return cls._build([
+            'from skypilot_tpu.serve import core',
+            f'core.down({service_name!r}, purge={purge})',
+            'print("SERVE_DOWN:" + json.dumps(True), flush=True)',
+        ])
+
+    @classmethod
+    def update(cls, service_name: str, remote_yaml: str) -> str:
+        return cls._build([
+            'from skypilot_tpu import task as task_lib',
+            'from skypilot_tpu.serve import core',
+            f'task = task_lib.Task.from_yaml('
+            f'os.path.expanduser({remote_yaml!r}))',
+            f'version = core.update(task, {service_name!r})',
+            'print("SERVE_VERSION:" + json.dumps(version), flush=True)',
+        ])
+
+
+def run_on_serve_controller(code: str, tag: str) -> Any:
+    """Execute codegen on the serve controller cluster's head; parse
+    the tagged JSON line."""
+    from skypilot_tpu.backends import backend_utils  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.skylet import job_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.utils import subprocess_utils  # pylint: disable=import-outside-toplevel
+    handle = backend_utils.check_cluster_available(
+        serve_constants.CONTROLLER_CLUSTER_NAME)
+    head = handle.get_command_runners()[0]
+    rc, stdout, stderr = head.run(code, require_outputs=True,
+                                  stream_logs=False)
+    subprocess_utils.handle_returncode(
+        rc, code, 'Failed to reach the serve controller cluster.', stderr)
+    return job_lib.parse_tagged_json(stdout, tag)
+
+
+def run_if_controller_exists(code: str, tag: str) -> Any:
+    """Like run_on_serve_controller but returns None when the
+    controller cluster does not exist yet (first `serve up`).
+
+    An EXISTING-but-unreachable controller raises — conflating the two
+    would let `serve up` double-start a daemon and `serve status`
+    report 'no services' while replicas keep running."""
+    from skypilot_tpu import global_user_state  # pylint: disable=import-outside-toplevel
+    record = global_user_state.get_cluster_from_name(
+        serve_constants.CONTROLLER_CLUSTER_NAME)
+    if record is None:
+        return None
+    return run_on_serve_controller(code, tag)
+
+
+def controller_head_ip() -> str:
+    from skypilot_tpu.backends import backend_utils  # pylint: disable=import-outside-toplevel
+    handle = backend_utils.check_cluster_available(
+        serve_constants.CONTROLLER_CLUSTER_NAME)
+    ips = handle.external_ips()
+    return ips[0] if ips else '127.0.0.1'
+
+
+def controller_mode() -> str:
+    import os  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu import config as config_lib  # pylint: disable=import-outside-toplevel
+    if os.environ.get(serve_constants.ENV_ON_CONTROLLER) == '1':
+        # On the controller itself, every operation is local.
+        return 'process'
+    return config_lib.get_nested(serve_constants.CONTROLLER_MODE_KEY,
+                                 serve_constants.DEFAULT_CONTROLLER_MODE)

@@ -1,0 +1,130 @@
+"""Stage-runtime recording: where did my launch time go?
+
+Parity: /root/reference/sky/usage/usage_lib.py:66,265 (`UsageMessage...
+update_runtime` records per-stage wall clock) — minus the phone-home:
+the reference POSTs usage messages to a Loki endpoint; here records
+stay on the user's machine (JSONL under $SKYTPU_HOME/usage/) and feed
+`sky status` / `sky cost-report`.  Time-to-first-step is the declared
+north-star denominator (BASELINE.md), so its decomposition
+(optimize/provision/sync/setup/exec-submit) must be visible for every
+launch.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, Iterator, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+# Stages whose wall-clock sums to "time to first step" (everything
+# between the user's command and their code running on the slice).
+TTFS_STAGES = ('optimize', 'provision', 'sync_workdir',
+               'sync_file_mounts', 'setup', 'pre_exec', 'exec_submit')
+
+
+def _usage_dir() -> str:
+    from skypilot_tpu.utils import common_utils  # pylint: disable=import-outside-toplevel
+    return common_utils.ensure_dir(
+        os.path.join(common_utils.skytpu_home(), 'usage'))
+
+
+def _runs_path() -> str:
+    return os.path.join(_usage_dir(), 'runs.jsonl')
+
+
+class RunRecord:
+    """One launch/exec invocation's stage decomposition."""
+
+    def __init__(self, entrypoint: str,
+                 cluster_name: Optional[str] = None) -> None:
+        self.run_id = uuid.uuid4().hex[:12]
+        self.entrypoint = entrypoint
+        self.cluster_name = cluster_name
+        self.started_at = time.time()
+        self.stage_runtimes: Dict[str, float] = {}
+        self._finalized = False
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.stage_runtimes[name] = round(
+                self.stage_runtimes.get(name, 0.0) +
+                time.perf_counter() - t0, 3)
+
+    @property
+    def time_to_first_step(self) -> float:
+        return round(sum(self.stage_runtimes.get(s, 0.0)
+                         for s in TTFS_STAGES), 3)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            'run_id': self.run_id,
+            'entrypoint': self.entrypoint,
+            'cluster_name': self.cluster_name,
+            'started_at': self.started_at,
+            'stage_runtimes': dict(self.stage_runtimes),
+            'time_to_first_step': self.time_to_first_step,
+        }
+
+    def finalize(self) -> None:
+        """Append to the JSONL store (idempotent)."""
+        if self._finalized:
+            return
+        self._finalized = True
+        try:
+            with open(_runs_path(), 'a', encoding='utf-8') as f:
+                f.write(json.dumps(self.to_dict()) + '\n')
+        except OSError as e:
+            logger.debug(f'usage record append failed: {e}')
+
+
+def records(limit: Optional[int] = None) -> list:
+    """All run records, oldest first."""
+    try:
+        with open(_runs_path(), encoding='utf-8') as f:
+            out = []
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        return []
+    return out[-limit:] if limit else out
+
+
+def latest_launches() -> Dict[str, Dict[str, Any]]:
+    """cluster_name -> most recent LAUNCH decomposition, in one file
+    pass (status/cost_report call this once for all clusters instead of
+    re-parsing the JSONL per record)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for rec in records():
+        if rec.get('entrypoint') == 'launch' and rec.get('cluster_name'):
+            out[rec['cluster_name']] = rec
+    return out
+
+
+def latest_for_cluster(cluster_name: str) -> Optional[Dict[str, Any]]:
+    """The most recent LAUNCH decomposition for a cluster."""
+    return latest_launches().get(cluster_name)
+
+
+def format_decomposition(rec: Dict[str, Any]) -> str:
+    """'total 12.3s = provision 8.1s + setup 2.0s + exec 0.4s + ...'"""
+    runtimes = rec.get('stage_runtimes', {})
+    parts = [f'{name} {runtimes[name]:.1f}s'
+             for name in TTFS_STAGES if runtimes.get(name)]
+    return (f'time-to-first-step {rec.get("time_to_first_step", 0):.1f}s'
+            + (f' = {" + ".join(parts)}' if parts else ''))

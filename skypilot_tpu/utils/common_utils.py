@@ -26,8 +26,8 @@ def skytpu_home() -> str:
     return os.path.expanduser(os.environ.get('SKYTPU_HOME', '~/.skytpu'))
 
 
-def ensure_dir(path: str) -> str:
-    os.makedirs(path, exist_ok=True)
+def ensure_dir(path: str, mode: int = 0o777) -> str:
+    os.makedirs(path, mode=mode, exist_ok=True)
     return path
 
 

@@ -145,6 +145,38 @@ class TestRoundRobin:
         assert lb_lib.RoundRobinPolicy().select([]) is None
 
 
+class TestLeastConnections:
+
+    def test_prefers_idle_replica(self):
+        policy = lb_lib.LeastConnectionsPolicy()
+        urls = ['a', 'b']
+        first = policy.select(urls)
+        policy.acquire(first)
+        second = policy.select(urls)
+        assert second != first
+        policy.acquire(second)
+        # Release one; it becomes preferred again.
+        policy.release(first)
+        assert policy.select(urls) == first
+
+    def test_policy_factory_and_spec_validation(self):
+        assert isinstance(lb_lib.make_policy(None),
+                          lb_lib.RoundRobinPolicy)
+        assert isinstance(lb_lib.make_policy('least_connections'),
+                          lb_lib.LeastConnectionsPolicy)
+        with pytest.raises(ValueError):
+            lb_lib.make_policy('bogus')
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        spec = SkyServiceSpec.from_yaml_config(
+            {'replicas': 1, 'load_balancing_policy': 'least_connections'})
+        assert spec.load_balancing_policy == 'least_connections'
+        assert (SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+                .load_balancing_policy == 'least_connections')
+        with pytest.raises(Exception):
+            SkyServiceSpec.from_yaml_config(
+                {'replicas': 1, 'load_balancing_policy': 'bogus'})
+
+
 def _serve_task(name='svc', replicas=1, **spec_kw):
     task = sky.Task(
         name=name,

@@ -1,0 +1,108 @@
+"""Serve controller-on-cluster mode (VERDICT r2 missing #2).
+
+The service daemon (controller + LB) runs on a provisioned controller
+cluster — reference serve/core.py:203 behavior — instead of a local
+process.  Hermetic: the controller cluster and the replica clusters it
+launches all come from the local provisioner.
+"""
+from __future__ import annotations
+
+import time
+
+import pytest
+import requests
+
+import skypilot_tpu as sky
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import global_user_state
+from skypilot_tpu.serve import core as serve_core
+from skypilot_tpu.serve import constants as serve_constants
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+
+@pytest.fixture(autouse=True)
+def _cluster_mode(monkeypatch, _isolated_home):
+    monkeypatch.setenv('SKYTPU_SERVE_SYNC_INTERVAL', '0.5')
+    monkeypatch.setenv('SKYTPU_SERVE_PROBE_INTERVAL', '0.5')
+    config_lib.set_nested(serve_constants.CONTROLLER_MODE_KEY, 'cluster')
+    config_lib.set_nested(('serve', 'bucket'), 'local://serve-auto')
+    global_user_state.set_enabled_clouds(['local'])
+    yield
+    config_lib.reload_config()
+
+
+def _serve_task(name: str, replicas: int = 1) -> sky.Task:
+    task = sky.Task(
+        name=name,
+        run='exec python3 -m http.server $SKYTPU_SERVE_REPLICA_PORT')
+    task.set_resources(sky.Resources(cloud='local'))
+    task.service = SkyServiceSpec.from_yaml_config({
+        'readiness_probe': '/',
+        'replica_policy': {'min_replicas': replicas,
+                           'max_replicas': replicas},
+    })
+    return task
+
+
+def _wait(predicate, timeout=120.0, gap=0.5):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(gap)
+    return False
+
+
+def test_serve_up_on_cluster_with_refill():
+    """up -> controller cluster hosts the daemon -> replica serves ->
+    replica eviction is refilled -> down cleans up."""
+    name, endpoint = serve_core.up(_serve_task('csvc'), detach=True)
+    assert name == 'csvc'
+
+    # The controller cluster exists and hosts the daemon.
+    record = global_user_state.get_cluster_from_name(
+        serve_constants.CONTROLLER_CLUSTER_NAME)
+    assert record is not None
+
+    # Service reaches READY; the LB endpoint proxies to a replica.
+    def ready():
+        recs = serve_core.status(['csvc'])
+        return recs and recs[0]['status'] == 'READY'
+    assert _wait(ready), serve_core.status(['csvc'])
+
+    def _serves():
+        # The LB needs one sync cycle after READY to learn the replica.
+        try:
+            return requests.get(endpoint, timeout=10).status_code == 200
+        except requests.RequestException:
+            return False
+    assert _wait(_serves, timeout=30)
+
+    # Replica refill: tear the replica cluster down behind the
+    # controller's back (slice eviction).
+    replicas = serve_core.status(['csvc'])[0]['replicas']
+    first = [r for r in replicas if r['status'] == 'READY'][0]
+    sky.down(first['cluster_name'])
+
+    def refilled():
+        recs = serve_core.status(['csvc'])
+        if not recs or recs[0]['status'] != 'READY':
+            return False
+        newer = [r for r in recs[0]['replicas']
+                 if r['replica_id'] != first['replica_id'] and
+                 r['status'] == 'READY']
+        return bool(newer)
+    assert _wait(refilled), serve_core.status(['csvc'])
+    assert _wait(_serves, timeout=30)
+
+    # Down removes the service and its replicas (controller cluster
+    # itself stays, like the reference's shared controller VM).
+    serve_core.down('csvc')
+    assert _wait(lambda: not serve_core.status(['csvc']))
+
+
+def test_status_empty_without_controller():
+    assert serve_core.status() == []
+    with pytest.raises(Exception):
+        serve_core.down('nosuch')

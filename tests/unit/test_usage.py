@@ -1,0 +1,99 @@
+"""Stage-runtime observability (VERDICT r2 missing #3): every launch
+records its wall-clock decomposition; `sky status` surfaces
+time-to-first-step; `sky jobs dashboard` renders the jobs table."""
+from __future__ import annotations
+
+import time
+
+from click.testing import CliRunner
+
+import skypilot_tpu as sky
+from skypilot_tpu import cli as cli_mod
+from skypilot_tpu import core
+from skypilot_tpu import global_user_state
+from skypilot_tpu import usage_lib
+
+
+def _launch_local(name='usg'):
+    global_user_state.set_enabled_clouds(['local'])
+    task = sky.Task(name='t', run='echo ok')
+    task.set_resources(sky.Resources(cloud='local'))
+    return sky.launch(task, cluster_name=name, stream_logs=False)
+
+
+class TestRunRecord:
+
+    def test_stage_timing_and_ttfs(self):
+        rec = usage_lib.RunRecord('launch', 'c1')
+        with rec.stage('provision'):
+            time.sleep(0.05)
+        with rec.stage('exec_submit'):
+            time.sleep(0.01)
+        assert rec.stage_runtimes['provision'] >= 0.05
+        assert rec.time_to_first_step >= 0.06
+        rec.finalize()
+        rec.finalize()  # idempotent
+        stored = usage_lib.records()
+        assert len(stored) == 1
+        assert stored[0]['cluster_name'] == 'c1'
+
+    def test_format_decomposition(self):
+        rec = usage_lib.RunRecord('launch', 'c1')
+        with rec.stage('provision'):
+            pass
+        rec.stage_runtimes['provision'] = 8.1
+        text = usage_lib.format_decomposition(rec.to_dict())
+        assert 'time-to-first-step' in text
+        assert 'provision 8.1s' in text
+
+
+class TestEndToEnd:
+
+    def test_launch_records_decomposition(self):
+        _launch_local('usg1')
+        rec = usage_lib.latest_for_cluster('usg1')
+        assert rec is not None
+        assert rec['entrypoint'] == 'launch'
+        assert rec['stage_runtimes'].get('provision', 0) > 0
+        assert rec['stage_runtimes'].get('exec_submit', 0) > 0
+        assert rec['time_to_first_step'] > 0
+        # status() attaches the decomposition per cluster.
+        record = core.status(['usg1'])[0]
+        assert record['last_launch']['run_id'] == rec['run_id']
+        sky.down('usg1')
+
+    def test_status_cli_shows_ttfs(self):
+        _launch_local('usg2')
+        result = CliRunner().invoke(cli_mod.cli, ['status', '-v'])
+        assert result.exit_code == 0, result.output
+        assert 'TIME-TO-FIRST-STEP' in result.output
+        assert 'time-to-first-step' in result.output
+        sky.down('usg2')
+
+    def test_exec_records_separately(self):
+        _launch_local('usg3')
+        task = sky.Task(name='t2', run='echo again')
+        sky.exec(task, cluster_name='usg3')
+        recs = [r for r in usage_lib.records()
+                if r['cluster_name'] == 'usg3']
+        assert [r['entrypoint'] for r in recs] == ['launch', 'exec']
+        # latest_for_cluster keeps pointing at the LAUNCH record.
+        assert usage_lib.latest_for_cluster(
+            'usg3')['entrypoint'] == 'launch'
+        sky.down('usg3')
+
+
+class TestJobsDashboard:
+
+    def test_dashboard_renders(self, monkeypatch, _isolated_home):
+        monkeypatch.setenv('SKYTPU_MANAGED_JOB_DB',
+                           str(_isolated_home / 'managed_jobs.db'))
+        from skypilot_tpu.jobs import state
+        job_id = state.allocate_job_id('dashjob')
+        state.submit_job(job_id, 'dashjob', '/tmp/x.yaml', ['t0'])
+        state.set_status(job_id, 0, state.ManagedJobStatus.RUNNING)
+        result = CliRunner().invoke(cli_mod.cli, ['jobs', 'dashboard'])
+        assert result.exit_code == 0, result.output
+        assert 'dashjob' in result.output
+        assert 'RUNNING' in result.output
+        assert 'RECOVERIES' in result.output
