@@ -28,14 +28,58 @@ def get_or_generate_keys() -> Tuple[str, str]:
         os.path.join(common_utils.skytpu_home(), 'keys'))
     private = os.path.join(key_dir, _SSH_KEY_NAME)
     public = private + '.pub'
-    if not (os.path.exists(private) and os.path.exists(public)):
-        subprocess.run(
-            ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f', private,
-             '-C', 'skytpu'],
-            check=True, capture_output=True)
+    if os.path.exists(private) and not os.path.exists(public):
+        # NEVER regenerate over an existing private key (live clusters
+        # carry its pubkey); re-derive the lost .pub instead.
+        _rederive_public_key(private, public)
+        return private, public
+    if not os.path.exists(private):
+        try:
+            subprocess.run(
+                ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f',
+                 private, '-C', 'skytpu'],
+                check=True, capture_output=True)
+        except FileNotFoundError:
+            # Hermetic images may lack ssh-keygen; generate in-process.
+            _generate_keypair_python(private, public)
         os.chmod(private, 0o600)
         logger.info(f'Generated SSH keypair at {private}')
     return private, public
+
+
+def _rederive_public_key(private: str, public: str) -> None:
+    try:
+        proc = subprocess.run(['ssh-keygen', '-y', '-f', private],
+                              check=True, capture_output=True, text=True)
+        with open(public, 'w', encoding='utf-8') as f:
+            f.write(proc.stdout.strip() + ' skytpu\n')
+        return
+    except (FileNotFoundError, subprocess.CalledProcessError):
+        pass
+    from cryptography.hazmat.primitives import serialization  # pylint: disable=import-outside-toplevel
+    with open(private, 'rb') as f:
+        key = serialization.load_ssh_private_key(f.read(), password=None)
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.OpenSSH,
+        serialization.PublicFormat.OpenSSH)
+    with open(public, 'wb') as f:
+        f.write(pub + b' skytpu\n')
+
+
+def _generate_keypair_python(private: str, public: str) -> None:
+    from cryptography.hazmat.primitives import serialization  # pylint: disable=import-outside-toplevel
+    from cryptography.hazmat.primitives.asymmetric import ed25519  # pylint: disable=import-outside-toplevel
+    key = ed25519.Ed25519PrivateKey.generate()
+    with open(private, 'wb') as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.OpenSSH,
+            serialization.NoEncryption()))
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.OpenSSH,
+        serialization.PublicFormat.OpenSSH)
+    with open(public, 'wb') as f:
+        f.write(pub + b' skytpu\n')
 
 
 def public_key_str() -> str:

@@ -22,6 +22,7 @@ InstanceTypeInfo = common.InstanceTypeInfo
 TpuOffering = common.TpuOffering
 
 _INSTANCE_CSVS = {
+    'aws': 'aws_instances.csv',
     'gcp': 'gcp_instances.csv',
     'local': 'local_instances.csv',
 }

@@ -1,0 +1,162 @@
+"""AWS: GPU and CPU VMs — the fungible accelerator alternative to TPUs.
+
+Parity: /root/reference/sky/clouds/aws.py:1-1084 (region enumeration,
+pricing, deploy vars, credential checks) — minus what doesn't apply to
+the TPU-first design: no TPUs live here, so every accelerator request
+maps to a hosting EC2 instance type from the catalog; the optimizer
+weighs these against GCP TPU slices with measured-MFU throughput priors
+(utils/throughput_registry).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+class AWS(cloud_lib.Cloud):
+    _REPR = 'AWS'
+    PROVISIONER = 'aws'
+
+    _CLOUD_UNSUPPORTED_FEATURES = {
+        cloud_lib.CloudImplementationFeatures.CLONE_DISK:
+            'Disk cloning is not implemented for AWS.',
+    }
+
+    # ------------------------------------------------------- regions/zones
+
+    def regions_with_offering(self, resources) -> List[cloud_lib.Region]:
+        if resources.tpu_spec is not None:
+            return []  # TPUs are GCP-only.
+        if resources.instance_type is not None:
+            pairs = catalog.get_region_zones_for_instance_type(
+                'aws', resources.instance_type, resources.use_spot)
+        else:
+            pairs = []
+        regions: Dict[str, cloud_lib.Region] = {}
+        for region_name, zone_name in pairs:
+            if (resources.region is not None and
+                    region_name != resources.region):
+                continue
+            if resources.zone is not None and zone_name != resources.zone:
+                continue
+            region = regions.setdefault(region_name,
+                                        cloud_lib.Region(region_name))
+            region.zones.append(cloud_lib.Zone(zone_name, region_name))
+        return list(regions.values())
+
+    # ------------------------------------------------------------- pricing
+
+    def instance_type_to_hourly_cost(self, instance_type, use_spot, region,
+                                     zone) -> float:
+        return catalog.get_hourly_cost('aws', instance_type, use_spot,
+                                       region, zone)
+
+    def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
+                                    zone) -> float:
+        # GPU prices are bundled into the hosting instance type's price.
+        del accelerators, use_spot, region, zone
+        return 0.0
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # Public AWS internet egress tiering.
+        if num_gigabytes <= 0:
+            return 0.0
+        if num_gigabytes <= 10240:
+            return num_gigabytes * 0.09
+        return 10240 * 0.09 + (num_gigabytes - 10240) * 0.085
+
+    # -------------------------------------------------------- feasibility
+
+    def get_feasible_launchable_resources(self, resources):
+        fuzzy: List[str] = []
+        launchable: List['resources_lib.Resources'] = []
+        if resources.tpu_spec is not None:
+            return [], fuzzy  # TPUs do not exist on AWS.
+        if resources.accelerators:
+            acc, count = next(iter(resources.accelerators.items()))
+            instance_types = catalog.get_instance_type_for_accelerator(
+                'aws', acc, count, resources.cpus, resources.memory,
+                resources.region, resources.zone)
+            if not instance_types:
+                offerings = catalog.list_accelerators(name_filter=acc,
+                                                      clouds=['aws'])
+                fuzzy.extend(sorted(offerings))
+                return [], fuzzy
+            return [
+                resources.copy(cloud=self, instance_type=instance_types[0])
+            ], fuzzy
+        if resources.instance_type is not None:
+            if catalog.instance_type_exists('aws', resources.instance_type):
+                return [resources.copy(cloud=self)], fuzzy
+            return [], fuzzy
+        default = self.get_default_instance_type(resources.cpus,
+                                                 resources.memory)
+        if default is None:
+            return [], fuzzy
+        return [resources.copy(cloud=self, instance_type=default)], fuzzy
+
+    def get_default_instance_type(self, cpus, memory) -> Optional[str]:
+        return catalog.get_default_instance_type('aws', cpus, memory)
+
+    def validate_region_zone(self, region, zone):
+        return catalog.validate_region_zone('aws', region, zone)
+
+    # ------------------------------------------------------------- deploy
+
+    def make_deploy_resources_variables(self, resources, cluster_name,
+                                        region, zones) -> Dict[str, Any]:
+        return {
+            'cluster_name': cluster_name,
+            'region': region.name,
+            'zones': [z.name for z in (zones or [])],
+            'use_spot': resources.use_spot,
+            'labels': dict(resources.labels or {}),
+            'ports': list(resources.ports or []),
+            'disk_size': resources.disk_size,
+            'image_id': resources.image_id,
+            'tpu': False,
+            'instance_type': resources.instance_type,
+            'num_nodes': 1,
+        }
+
+    # --------------------------------------------------------- credentials
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        if os.path.exists(os.path.expanduser('~/.aws/credentials')) or \
+                os.environ.get('AWS_ACCESS_KEY_ID'):
+            return True, None
+        try:
+            proc = subprocess.run(
+                ['aws', 'sts', 'get-caller-identity'],
+                capture_output=True, text=True, timeout=10, check=False)
+            if proc.returncode == 0:
+                return True, None
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            pass
+        return False, ('AWS credentials not found. Run `aws configure` '
+                       'or set AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY.')
+
+    def get_current_user_identity(self) -> Optional[List[str]]:
+        try:
+            proc = subprocess.run(
+                ['aws', 'sts', 'get-caller-identity',
+                 '--query', 'Arn', '--output', 'text'],
+                capture_output=True, text=True, timeout=10, check=False)
+            arn = proc.stdout.strip()
+            return [arn] if proc.returncode == 0 and arn else None
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            return None
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        aws_dir = os.path.expanduser('~/.aws')
+        if os.path.isdir(aws_dir):
+            return {'~/.aws': '~/.aws'}
+        return {}

@@ -6,12 +6,14 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
+from skypilot_tpu.clouds import aws
 from skypilot_tpu.clouds import cloud as cloud_lib
 from skypilot_tpu.clouds import gcp
 from skypilot_tpu.clouds import gke
 from skypilot_tpu.clouds import local
 
 CLOUD_REGISTRY: Dict[str, cloud_lib.Cloud] = {
+    'aws': aws.AWS(),
     'gcp': gcp.GCP(),
     'gke': gke.GKE(),
     'local': local.Local(),

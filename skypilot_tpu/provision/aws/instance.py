@@ -1,0 +1,404 @@
+"""AWS EC2 provisioner: GPU/CPU VMs as the fungible GPU alternative.
+
+Parity: /root/reference/sky/provision/aws/ (boto3) — rebuilt on the aws
+CLI's JSON output with an injectable runner (`set_cli_runner`), the
+same no-SDK seam as provision/gcp/tpu_api.py and data_transfer.py, so
+the whole flow is unit-testable without credentials or network.
+
+Cluster membership is tag-based (`skytpu-cluster=<name>`, per-node
+`skytpu-rank`), the reference's own scheme.  Gang semantics: one
+run-instances call creates all nodes; any shortfall terminates the
+partial set and raises (all-or-nothing, like TPU slices).
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.utils import command_runner
+
+logger = sky_logging.init_logger(__name__)
+
+_CLUSTER_TAG = 'skytpu-cluster'
+_RANK_TAG = 'skytpu-rank'
+_KEY_NAME = 'skytpu-key'
+_SG_NAME = 'skytpu-sg'
+DEFAULT_SSH_USER = 'ubuntu'
+# Canonical's SSM alias for the current Ubuntu 22.04 x86 AMI.
+_UBUNTU_SSM = ('/aws/service/canonical/ubuntu/server/22.04/stable/'
+               'current/amd64/hvm/ebs-gp3/ami-id')
+
+# CLI seam: runner(args: List[str]) -> (returncode, stdout, stderr).
+CliRunner = Callable[[List[str]], tuple]
+
+
+def _default_cli_runner(args: List[str]) -> tuple:
+    proc = subprocess.run(args, capture_output=True, text=True,
+                          check=False, timeout=300)
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+_cli_runner: CliRunner = _default_cli_runner
+
+
+def set_cli_runner(runner: Optional[CliRunner]) -> None:
+    """Inject a fake aws CLI for tests (None restores the real one)."""
+    global _cli_runner
+    _cli_runner = runner or _default_cli_runner
+
+
+def _aws(region: str, *args: str) -> Any:
+    """Run `aws --region <region> <args...> --output json` -> parsed."""
+    argv = ['aws', '--region', region, *args, '--output', 'json']
+    rc, stdout, stderr = _cli_runner(argv)
+    if rc != 0:
+        raise exceptions.ProvisionError(
+            f'aws {" ".join(args[:2])} failed (rc={rc}): '
+            f'{stderr.strip()[:500]}')
+    if not stdout.strip():
+        return {}
+    try:
+        return json.loads(stdout)
+    except ValueError as e:
+        raise exceptions.ProvisionError(
+            f'aws returned non-JSON output: {e}') from e
+
+
+def _describe(region: str, cluster_name: str,
+              states: Optional[List[str]] = None) -> List[Dict[str, Any]]:
+    filters = [f'Name=tag:{_CLUSTER_TAG},Values={cluster_name}']
+    filters.append('Name=instance-state-name,Values=' + ','.join(
+        states or ['pending', 'running', 'stopping', 'stopped']))
+    out = _aws(region, 'ec2', 'describe-instances',
+               '--filters', *filters)
+    instances = []
+    for reservation in out.get('Reservations', ()):
+        instances.extend(reservation.get('Instances', ()))
+    return instances
+
+
+def _tag_value(instance: Dict[str, Any], key: str) -> Optional[str]:
+    for tag in instance.get('Tags', ()):
+        if tag.get('Key') == key:
+            return tag.get('Value')
+    return None
+
+
+_REGION_CACHE: Dict[str, str] = {}
+
+
+def _remember_region(cluster_name: str, region: str) -> None:
+    import os  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.utils import common_utils  # pylint: disable=import-outside-toplevel
+    _REGION_CACHE[cluster_name] = region
+    path = common_utils.ensure_dir(
+        os.path.join(common_utils.skytpu_home(), 'aws_regions'))
+    with open(os.path.join(path, cluster_name), 'w',
+              encoding='utf-8') as f:
+        f.write(region)
+
+
+def _recall_region(cluster_name: str) -> str:
+    import os  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.utils import common_utils  # pylint: disable=import-outside-toplevel
+    if cluster_name in _REGION_CACHE:
+        return _REGION_CACHE[cluster_name]
+    path = os.path.join(common_utils.skytpu_home(), 'aws_regions',
+                        cluster_name)
+    try:
+        with open(path, encoding='utf-8') as f:
+            region = f.read().strip()
+    except OSError as e:
+        raise exceptions.FetchClusterInfoError(
+            exceptions.FetchClusterInfoError.Reason.HEAD) from e
+    _REGION_CACHE[cluster_name] = region
+    return region
+
+
+def _resolve_ami(region: str, image_id: Optional[str]) -> str:
+    if image_id:
+        return image_id
+    out = _aws(region, 'ssm', 'get-parameters', '--names', _UBUNTU_SSM)
+    params = out.get('Parameters', ())
+    if not params:
+        raise exceptions.ProvisionError(
+            f'Could not resolve the default Ubuntu AMI in {region}.')
+    return params[0]['Value']
+
+
+def _ensure_key_pair(region: str) -> str:
+    out = _aws(region, 'ec2', 'describe-key-pairs')
+    names = {k.get('KeyName') for k in out.get('KeyPairs', ())}
+    if _KEY_NAME in names:
+        return _KEY_NAME
+    from skypilot_tpu import authentication  # pylint: disable=import-outside-toplevel
+    _, public_path = authentication.get_or_generate_keys()
+    # fileb:// keeps CLI v2 from base64-decoding the material (raw
+    # OpenSSH text would be rejected as invalid base64).
+    _aws(region, 'ec2', 'import-key-pair', '--key-name', _KEY_NAME,
+         '--public-key-material', f'fileb://{public_path}')
+    return _KEY_NAME
+
+
+def _ensure_security_group(region: str) -> str:
+    out = _aws(region, 'ec2', 'describe-security-groups',
+               '--filters', f'Name=group-name,Values={_SG_NAME}')
+    groups = out.get('SecurityGroups', ())
+    if groups:
+        return groups[0]['GroupId']
+    created = _aws(region, 'ec2', 'create-security-group',
+                   '--group-name', _SG_NAME,
+                   '--description', 'skypilot_tpu managed')
+    group_id = created['GroupId']
+    # ssh from anywhere + all traffic within the group (gang comms).
+    _aws(region, 'ec2', 'authorize-security-group-ingress',
+         '--group-id', group_id, '--protocol', 'tcp', '--port', '22',
+         '--cidr', '0.0.0.0/0')
+    _aws(region, 'ec2', 'authorize-security-group-ingress',
+         '--group-id', group_id, '--protocol', '-1',
+         '--source-group', group_id)
+    return group_id
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    cluster_name = config.cluster_name
+    region = config.region
+    deploy_vars = config.deploy_vars
+    instance_type = deploy_vars.get('instance_type')
+    if not instance_type:
+        raise exceptions.ProvisionError(
+            'AWS provisioning needs an instance_type (TPUs live on GCP).')
+    count = config.count
+    _remember_region(cluster_name, region)
+
+    existing = _describe(region, cluster_name)
+    created: List[str] = []
+    resumed: List[str] = []
+    if existing:
+        if len(existing) != count:
+            raise exceptions.ResourcesMismatchError(
+                f'Cluster {cluster_name} exists with {len(existing)} '
+                f'nodes; requested {count}.')
+        stopping = [i['InstanceId'] for i in existing
+                    if i['State']['Name'] == 'stopping']
+        if stopping:
+            # EC2 rejects start-instances while still 'stopping'.
+            _wait_for_state(region, cluster_name, stopping, 'stopped')
+        stopped = [i['InstanceId'] for i in existing
+                   if i['State']['Name'] in ('stopped', 'stopping')]
+        if stopped:
+            _aws(region, 'ec2', 'start-instances', '--instance-ids',
+                 *stopped)
+            resumed = stopped
+        _ensure_rank_tags(region, cluster_name)
+    else:
+        ami = _resolve_ami(region, deploy_vars.get('image_id'))
+        key = _ensure_key_pair(region)
+        sg = _ensure_security_group(region)
+        tag_spec = (
+            'ResourceType=instance,Tags=['
+            f'{{Key={_CLUSTER_TAG},Value={cluster_name}}}]')
+        args = ['ec2', 'run-instances',
+                '--image-id', ami,
+                '--instance-type', instance_type,
+                '--count', str(count),
+                '--key-name', key,
+                '--security-group-ids', sg,
+                '--tag-specifications', tag_spec,
+                '--block-device-mappings',
+                json.dumps([{
+                    'DeviceName': '/dev/sda1',
+                    'Ebs': {'VolumeSize':
+                            int(deploy_vars.get('disk_size') or 256),
+                            'VolumeType': 'gp3'},
+                }])]
+        if deploy_vars.get('use_spot'):
+            args += ['--instance-market-options',
+                     json.dumps({'MarketType': 'spot'})]
+        if config.zones:
+            args += ['--placement',
+                     json.dumps({'AvailabilityZone': config.zones[0]})]
+        out = _aws(region, *args)
+        instances = out.get('Instances', ())
+        created = [i['InstanceId'] for i in instances]
+        if len(created) != count:
+            # All-or-nothing gang, like a TPU slice.
+            if created:
+                _aws(region, 'ec2', 'terminate-instances',
+                     '--instance-ids', *created)
+            raise exceptions.ProvisionError(
+                f'Requested {count} x {instance_type}, got '
+                f'{len(created)}; terminated the partial set.')
+        # Stable rank assignment (sorted instance ids).
+        for rank, iid in enumerate(sorted(created)):
+            _aws(region, 'ec2', 'create-tags', '--resources', iid,
+                 '--tags', f'Key={_RANK_TAG},Value={rank}')
+    head = sorted([i['InstanceId'] for i in existing] or created)[0]
+    return common.ProvisionRecord(
+        provider_name='aws',
+        cluster_name=cluster_name,
+        region=region,
+        zone=config.zones[0] if config.zones else '',
+        head_instance_id=head,
+        created_instance_ids=created,
+        resumed_instance_ids=resumed,
+    )
+
+
+def _wait_for_state(region: str, cluster_name: str, ids: List[str],
+                    want: str, timeout: float = 300) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        by_id = {i['InstanceId']: i['State']['Name']
+                 for i in _describe(region, cluster_name)}
+        if all(by_id.get(iid) == want for iid in ids):
+            return
+        time.sleep(5)
+    raise exceptions.ProvisionError(
+        f'Instances {ids} did not reach {want!r} within {timeout}s.')
+
+
+def _ensure_rank_tags(region: str, cluster_name: str) -> None:
+    """Assign missing rank tags (sorted instance ids) — a create-tags
+    failure mid-provision must not leave a cluster where worker_only
+    operations cannot tell the head apart."""
+    instances = _describe(region, cluster_name)
+    untagged = [i['InstanceId'] for i in instances
+                if _tag_value(i, _RANK_TAG) is None]
+    if not untagged:
+        return
+    for rank, iid in enumerate(
+            sorted(i['InstanceId'] for i in instances)):
+        _aws(region, 'ec2', 'create-tags', '--resources', iid,
+             '--tags', f'Key={_RANK_TAG},Value={rank}')
+
+
+def wait_instances(cluster_name: str, state: Optional[str] = None) -> None:
+    region = _recall_region(cluster_name)
+    want = state or 'running'
+    deadline = time.time() + 600
+    while time.time() < deadline:
+        instances = _describe(region, cluster_name)
+        if instances and all(i['State']['Name'] == want
+                             for i in instances):
+            return
+        time.sleep(5)
+    raise exceptions.ProvisionError(
+        f'Instances of {cluster_name} did not reach {want!r} in 600s.')
+
+
+def wait_capacity(cluster_name: str, timeout: float = 0) -> bool:
+    del cluster_name, timeout
+    return True  # EC2 capacity is synchronous (no queued resources).
+
+
+def stop_instances(cluster_name: str, worker_only: bool = False) -> None:
+    region = _recall_region(cluster_name)
+    instances = _describe(region, cluster_name,
+                          states=['pending', 'running'])
+    ids = [i['InstanceId'] for i in instances
+           if not (worker_only and _tag_value(i, _RANK_TAG) == '0')]
+    if ids:
+        _aws(region, 'ec2', 'stop-instances', '--instance-ids', *ids)
+
+
+def terminate_instances(cluster_name: str,
+                        worker_only: bool = False) -> None:
+    region = _recall_region(cluster_name)
+    instances = _describe(region, cluster_name)
+    ids = [i['InstanceId'] for i in instances
+           if not (worker_only and _tag_value(i, _RANK_TAG) == '0')]
+    if ids:
+        _aws(region, 'ec2', 'terminate-instances', '--instance-ids', *ids)
+
+
+_STATE_MAP = {
+    'pending': ClusterStatus.INIT,
+    'running': ClusterStatus.UP,
+    'stopping': ClusterStatus.STOPPED,
+    'stopped': ClusterStatus.STOPPED,
+}
+
+
+def query_instances(cluster_name: str
+                    ) -> Dict[str, Optional[ClusterStatus]]:
+    region = _recall_region(cluster_name)
+    return {
+        i['InstanceId']: _STATE_MAP.get(i['State']['Name'])
+        for i in _describe(region, cluster_name)
+    }
+
+
+def get_cluster_info(cluster_name: str,
+                     region: Optional[str] = None) -> common.ClusterInfo:
+    region = region or _recall_region(cluster_name)
+    instances = _describe(region, cluster_name, states=['running'])
+    if not instances:
+        raise exceptions.FetchClusterInfoError(
+            exceptions.FetchClusterInfoError.Reason.HEAD)
+    instances.sort(key=lambda i: int(_tag_value(i, _RANK_TAG) or 0))
+    infos = []
+    for rank, inst in enumerate(instances):
+        infos.append(
+            common.InstanceInfo(
+                instance_id=inst['InstanceId'],
+                internal_ip=inst.get('PrivateIpAddress', ''),
+                external_ip=inst.get('PublicIpAddress'),
+                ssh_port=22,
+                slice_id=0,
+                worker_id=rank,
+                tags={'rank': str(rank)},
+            ))
+    from skypilot_tpu import authentication  # pylint: disable=import-outside-toplevel
+    private_key, _ = authentication.get_or_generate_keys()
+    return common.ClusterInfo(
+        provider_name='aws',
+        cluster_name=cluster_name,
+        region=region,
+        zone=instances[0].get('Placement', {}).get('AvailabilityZone'),
+        instances=infos,
+        head_instance_id=infos[0].instance_id,
+        ssh_user=DEFAULT_SSH_USER,
+        ssh_private_key=private_key,
+    )
+
+
+def open_ports(cluster_name: str, ports: List[int]) -> None:
+    region = _recall_region(cluster_name)
+    sg = _ensure_security_group(region)
+    for port in ports:
+        try:
+            _aws(region, 'ec2', 'authorize-security-group-ingress',
+                 '--group-id', sg, '--protocol', 'tcp',
+                 '--port', str(port), '--cidr', '0.0.0.0/0')
+        except exceptions.ProvisionError as e:
+            if 'Duplicate' not in str(e):
+                raise
+
+
+def cleanup_ports(cluster_name: str) -> None:
+    del cluster_name  # The shared SG persists (reference behavior).
+
+
+def get_command_runners(cluster_info: common.ClusterInfo,
+                        **kwargs: Any) -> List[command_runner.CommandRunner]:
+    del kwargs
+    runners: List[command_runner.CommandRunner] = []
+    for inst in cluster_info.instances:
+        ip = inst.external_ip or inst.internal_ip
+        runners.append(
+            command_runner.SSHCommandRunner(
+                node=(ip, inst.ssh_port),
+                ssh_user=cluster_info.ssh_user,
+                ssh_private_key=cluster_info.ssh_private_key,
+                ssh_control_name=cluster_info.cluster_name,
+            ))
+    return runners

@@ -1,0 +1,235 @@
+"""AWS cloud + EC2 provisioner (cloud breadth: VERDICT r2 partial #16/
+#24).  The aws CLI sits behind an injectable runner, so the whole
+provision lifecycle is tested without credentials or network."""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.aws import instance as aws_instance
+from skypilot_tpu.utils import dag_utils
+
+
+class FakeAwsCli:
+    """Minimal EC2 state machine keyed on the aws CLI argv surface."""
+
+    def __init__(self):
+        self.instances = {}       # id -> dict
+        self.calls = []
+        self._next = 0
+
+    def __call__(self, argv):
+        self.calls.append(argv)
+        args = argv
+        cmd = ' '.join(args[3:5])
+        if cmd == 'ssm get-parameters':
+            return 0, json.dumps(
+                {'Parameters': [{'Value': 'ami-ubuntu2204'}]}), ''
+        if cmd == 'ec2 describe-key-pairs':
+            return 0, json.dumps({'KeyPairs': []}), ''
+        if cmd == 'ec2 import-key-pair':
+            return 0, '{}', ''
+        if cmd == 'ec2 describe-security-groups':
+            return 0, json.dumps({'SecurityGroups': [
+                {'GroupId': 'sg-123'}]}), ''
+        if cmd == 'ec2 authorize-security-group-ingress':
+            return 0, '{}', ''
+        if cmd == 'ec2 run-instances':
+            count = int(args[args.index('--count') + 1])
+            itype = args[args.index('--instance-type') + 1]
+            tag_spec = args[args.index('--tag-specifications') + 1]
+            cluster = tag_spec.split('Value=')[1].split('}')[0]
+            out = []
+            for _ in range(count):
+                iid = f'i-{self._next:04d}'
+                self._next += 1
+                self.instances[iid] = {
+                    'InstanceId': iid,
+                    'InstanceType': itype,
+                    'State': {'Name': 'running'},
+                    'PrivateIpAddress': f'10.0.0.{self._next}',
+                    'PublicIpAddress': f'54.0.0.{self._next}',
+                    'Placement': {'AvailabilityZone': 'us-east-1a'},
+                    'Tags': [{'Key': 'skytpu-cluster',
+                              'Value': cluster}],
+                }
+                out.append(self.instances[iid])
+            return 0, json.dumps({'Instances': out}), ''
+        if cmd == 'ec2 create-tags':
+            iid = args[args.index('--resources') + 1]
+            key, value = args[args.index('--tags') + 1].replace(
+                'Key=', '').replace('Value=', '').split(',')
+            self.instances[iid]['Tags'].append(
+                {'Key': key, 'Value': value})
+            return 0, '{}', ''
+        if cmd == 'ec2 describe-instances':
+            filters = [a for a in args if a.startswith('Name=')]
+            cluster = next(f.split('Values=')[1] for f in filters
+                           if 'tag:skytpu-cluster' in f)
+            states = next(f.split('Values=')[1].split(',')
+                          for f in filters
+                          if 'instance-state-name' in f)
+            matched = [
+                i for i in self.instances.values()
+                if any(t['Key'] == 'skytpu-cluster' and
+                       t['Value'] == cluster for t in i['Tags'])
+                and i['State']['Name'] in states
+            ]
+            return 0, json.dumps(
+                {'Reservations': [{'Instances': matched}]}), ''
+        if cmd in ('ec2 stop-instances', 'ec2 terminate-instances',
+                   'ec2 start-instances'):
+            ids = args[args.index('--instance-ids') + 1:-2]
+            state = {'ec2 stop-instances': 'stopped',
+                     'ec2 start-instances': 'running',
+                     'ec2 terminate-instances': 'terminated'}[cmd]
+            for iid in ids:
+                if state == 'terminated':
+                    self.instances.pop(iid, None)
+                else:
+                    self.instances[iid]['State']['Name'] = state
+            return 0, '{}', ''
+        return 1, '', f'unhandled: {cmd}'
+
+
+@pytest.fixture
+def fake_cli():
+    cli = FakeAwsCli()
+    aws_instance.set_cli_runner(cli)
+    aws_instance._REGION_CACHE.clear()
+    yield cli
+    aws_instance.set_cli_runner(None)
+
+
+def _config(cluster='awsc', count=2, itype='p4d.24xlarge', spot=False):
+    return provision_common.ProvisionConfig(
+        provider_name='aws', cluster_name=cluster, region='us-east-1',
+        zones=['us-east-1a'],
+        deploy_vars={'instance_type': itype, 'use_spot': spot,
+                     'disk_size': 256}, count=count)
+
+
+class TestProvisionLifecycle:
+
+    def test_run_query_info_terminate(self, fake_cli):
+        record = aws_instance.run_instances(_config())
+        assert record.provider_name == 'aws'
+        assert len(record.created_instance_ids) == 2
+
+        status = aws_instance.query_instances('awsc')
+        assert len(status) == 2
+        assert all(s.value == 'UP' for s in status.values())
+
+        info = aws_instance.get_cluster_info('awsc')
+        assert len(info.instances) == 2
+        assert info.ssh_user == 'ubuntu'
+        assert info.instances[0].tags['rank'] == '0'
+        # Rank ordering is stable (sorted instance ids).
+        assert (info.instances[0].instance_id <
+                info.instances[1].instance_id)
+
+        runners = aws_instance.get_command_runners(info)
+        assert len(runners) == 2
+        assert runners[0].ssh_user == 'ubuntu'
+
+        aws_instance.terminate_instances('awsc')
+        assert aws_instance.query_instances('awsc') == {}
+
+    def test_stop_start_resume(self, fake_cli):
+        aws_instance.run_instances(_config())
+        aws_instance.stop_instances('awsc')
+        status = aws_instance.query_instances('awsc')
+        assert all(s.value == 'STOPPED' for s in status.values())
+        record = aws_instance.run_instances(_config())
+        assert len(record.resumed_instance_ids) == 2
+        status = aws_instance.query_instances('awsc')
+        assert all(s.value == 'UP' for s in status.values())
+
+    def test_count_mismatch_rejected(self, fake_cli):
+        aws_instance.run_instances(_config(count=2))
+        with pytest.raises(exceptions.ResourcesMismatchError):
+            aws_instance.run_instances(_config(count=3))
+
+    def test_spot_flag_passed(self, fake_cli):
+        aws_instance.run_instances(_config(cluster='spotc', spot=True))
+        run_call = next(c for c in fake_cli.calls
+                        if 'run-instances' in c)
+        assert '--instance-market-options' in run_call
+
+    def test_rank_tags_recovered_on_resume(self, fake_cli):
+        """A lost rank tag (create-tags failed mid-provision) is
+        re-assigned on the next run_instances (review finding)."""
+        aws_instance.run_instances(_config())
+        # Simulate the partially-tagged cluster.
+        for inst in fake_cli.instances.values():
+            inst['Tags'] = [t for t in inst['Tags']
+                            if t['Key'] != 'skytpu-rank']
+        aws_instance.run_instances(_config())
+        info = aws_instance.get_cluster_info('awsc')
+        assert [i.tags['rank'] for i in info.instances] == ['0', '1']
+
+    def test_keypair_import_uses_fileb(self, fake_cli, tmp_path,
+                                       monkeypatch):
+        monkeypatch.setenv('SKYTPU_HOME', str(tmp_path))
+        from skypilot_tpu import authentication
+        authentication.get_or_generate_keys.cache_clear()
+        fake_cli.instances.clear()
+        aws_instance._ensure_key_pair('us-east-1')
+        import_call = next(c for c in fake_cli.calls
+                           if 'import-key-pair' in c)
+        material = import_call[import_call.index(
+            '--public-key-material') + 1]
+        assert material.startswith('fileb://')
+        authentication.get_or_generate_keys.cache_clear()
+
+
+class TestAwsCloud:
+
+    def test_feasibility_gpu_to_instance_type(self):
+        aws = registry.CLOUD_REGISTRY['aws']
+        r = sky.Resources(cloud='aws', accelerators='A100:8')
+        launchable, _ = aws.get_feasible_launchable_resources(r)
+        assert launchable
+        assert launchable[0].instance_type == 'p4d.24xlarge'
+
+    def test_tpu_not_feasible_on_aws(self):
+        aws = registry.CLOUD_REGISTRY['aws']
+        r = sky.Resources(accelerators='tpu-v5e-8')
+        launchable, _ = aws.get_feasible_launchable_resources(r)
+        assert launchable == []
+
+    def test_pricing(self):
+        cost = catalog.get_hourly_cost('aws', 'p4d.24xlarge')
+        assert cost == pytest.approx(32.7726)
+        spot = catalog.get_hourly_cost('aws', 'p4d.24xlarge',
+                                       use_spot=True)
+        assert spot < cost
+        # p5 has no spot snapshot: honest unavailability.
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            catalog.get_hourly_cost('aws', 'p5.48xlarge', use_spot=True)
+
+    def test_optimizer_cross_cloud_fungibility(self, enable_all_infra):
+        """An accelerator-agnostic task picks the cheaper of TPU/GPU
+        candidates — the BASELINE.json north-star behavior."""
+        task = sky.Task(name='t', run='true')
+        task.set_resources({
+            sky.Resources(cloud='gcp', accelerators='tpu-v5e-8'),
+            sky.Resources(cloud='aws', accelerators='A100:8'),
+        })
+        dag = dag_utils.convert_entrypoint_to_dag(task)
+        optimizer_lib.Optimizer.optimize(
+            dag, minimize=optimizer_lib.OptimizeTarget.COST, quiet=True)
+        best = task.best_resources
+        assert best is not None
+        tpu_cost = catalog.get_tpu_hourly_cost('gcp', 'tpu-v5e-8')
+        gpu_cost = catalog.get_hourly_cost('aws', 'p4d.24xlarge')
+        expected_cloud = 'gcp' if tpu_cost <= gpu_cost else 'aws'
+        assert best.cloud is registry.CLOUD_REGISTRY[expected_cloud]
