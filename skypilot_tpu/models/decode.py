@@ -1,0 +1,230 @@
+"""KV-cache autoregressive decoding for the model family.
+
+TPU-first inference path (no reference equivalent — SkyPilot ships no
+model code): static-shape KV caches (max_len fixed at jit time,
+position advanced with `lax.dynamic_update_slice`), a flash-kernel
+prefill (the Pallas kernel natively handles q_len < k_len decode
+shapes), and a jit-able single-token step for the generation loop.
+Serving replicas (serve/) wrap this in their model servers.
+
+Design notes:
+- The cache is a plain pytree {k: [L, b, h_kv, max_len, d], v: ...,
+  'index': []} — scan_layers stacks the per-layer cache on a leading
+  axis exactly like the params, so cache shardings follow the same
+  logical rules (kv_heads on 'tensor').
+- Decode attends with an explicit length mask (positions > index are
+  masked), so one compiled step serves every sequence length.
+- Sampling: greedy or temperature/top-k, RNG threaded explicitly.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models.configs import ModelConfig
+from skypilot_tpu.models.transformer import _rope
+from skypilot_tpu.ops.attention import NEG_INF
+from skypilot_tpu.ops.attention import flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    temperature: float = 0.0   # 0 = greedy
+    top_k: int = 0             # 0 = no top-k filtering
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_len: int
+               ) -> Dict[str, Any]:
+    """Zeroed KV cache pytree (per-layer stacked, scan-layout)."""
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
+    return {
+        'k': jnp.zeros(shape, cfg.dtype),
+        'v': jnp.zeros(shape, cfg.dtype),
+        'index': jnp.zeros((), jnp.int32),
+    }
+
+
+def _layer_params(params: Dict[str, Any], cfg: ModelConfig):
+    """-> per-layer param pytree with leading [L] axis (scan layout)."""
+    if cfg.n_experts > 0:
+        # MoE layers store params under 'moe_mlp' with routed experts;
+        # the decode fast path only implements dense MLPs so far.
+        raise NotImplementedError(
+            'KV-cache decoding supports dense models only (MoE decode '
+            'routing is not implemented yet).')
+    if cfg.scan_layers:
+        return params['layers']['layer']
+    stacked = jax.tree.map(
+        lambda *leaves: jnp.stack(leaves),
+        *[params[f'layer_{i}'] for i in range(cfg.n_layers)])
+    return stacked
+
+
+def _attn_proj(x, kernel):
+    """[b, s, d_model] x [d_model, heads, hd] -> [b, heads, s, hd]."""
+    return jnp.einsum('bsd,dhk->bhsk', x, kernel.astype(x.dtype))
+
+
+def _mlp(x, lp, cfg):
+    gate = jnp.einsum('bsd,df->bsf', x,
+                      lp['mlp']['gate_proj']['kernel'].astype(x.dtype))
+    up = jnp.einsum('bsd,df->bsf', x,
+                    lp['mlp']['up_proj']['kernel'].astype(x.dtype))
+    return jnp.einsum('bsf,fd->bsd', jax.nn.silu(gate) * up,
+                      lp['mlp']['down_proj']['kernel'].astype(x.dtype))
+
+
+def _norm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    normed = x32 * jax.lax.rsqrt(
+        jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (normed * scale).astype(x.dtype)
+
+
+def _layer_forward(x, lp, cfg, positions, k_cache, v_cache, cache_len,
+                   *, use_flash: bool):
+    """One decoder layer against an explicit KV cache slice.
+
+    x [b, s, d]; k_cache/v_cache [b, h_kv, max_len, hd] already contain
+    this call's k/v written at [positions]; cache_len = total valid
+    length after the write.  Returns the layer output.
+    """
+    h = _norm(x, lp['attn_norm']['scale'], cfg.norm_eps)
+    q = _attn_proj(h, lp['attn']['q_proj']['kernel'])
+    q = _rope(q, positions, cfg.rope_theta)
+
+    if use_flash:
+        # Prefill from index 0: the valid cache region is exactly the
+        # prompt window [0, s) — a STATIC slice (q.shape[2]), as jit
+        # requires.  (Chunked prefill at index>0 would need the masked
+        # path instead.)
+        del cache_len
+        s = q.shape[2]
+        out = flash_attention(q, k_cache[:, :, :s],
+                              v_cache[:, :, :s], causal=True)
+    else:
+        # Single-token decode: one einsum against the cache beats a
+        # kernel launch at q_len=1.  GQA: broadcast kv heads.
+        rep = cfg.n_heads // cfg.n_kv_heads
+        k = jnp.repeat(k_cache, rep, axis=1) if rep > 1 else k_cache
+        v = jnp.repeat(v_cache, rep, axis=1) if rep > 1 else v_cache
+        s = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * (cfg.head_dim ** -0.5)
+        kpos = jnp.arange(k.shape[2])
+        mask = kpos[None, None, None, :] < cache_len
+        s = jnp.where(mask, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum('bhqk,bhkd->bhqd', p,
+                         v.astype(jnp.float32)).astype(x.dtype)
+
+    out = jnp.einsum('bhsk,hkd->bsd', out,
+                     lp['attn']['o_proj']['kernel'].astype(x.dtype))
+    x = x + out
+    h = _norm(x, lp['mlp_norm']['scale'], cfg.norm_eps)
+    return x + _mlp(h, lp, cfg)
+
+
+def _write_cache(k_cache, v_cache, k_new, v_new, start):
+    """Write k/v [b, h_kv, s, d] into the cache at [start, start+s)."""
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k_new.astype(k_cache.dtype), (0, 0, start, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v_new.astype(v_cache.dtype), (0, 0, start, 0))
+    return k_cache, v_cache
+
+
+def _forward_with_cache(cfg, params, tokens, cache, *, use_flash: bool):
+    """Shared prefill/step body: embeds tokens at cache['index'],
+    updates every layer's cache, returns (logits_last, new_cache)."""
+    layers = _layer_params(params, cfg)
+    b, s = tokens.shape
+    start = cache['index']
+    positions = start + jnp.arange(s)
+    x = jnp.take(params['embed']['embedding'], tokens,
+                 axis=0).astype(cfg.dtype)
+    cache_len = start + s
+
+    def body(x, layer_state):
+        lp, k_cache, v_cache = layer_state
+        h = _norm(x, lp['attn_norm']['scale'], cfg.norm_eps)
+        k = _attn_proj(h, lp['attn']['k_proj']['kernel'])
+        v = _attn_proj(h, lp['attn']['v_proj']['kernel'])
+        k = _rope(k, positions, cfg.rope_theta)
+        k_cache, v_cache = _write_cache(k_cache, v_cache, k, v, start)
+        x = _layer_forward(x, lp, cfg, positions, k_cache, v_cache,
+                           cache_len, use_flash=use_flash)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        lambda carry, ls: body(carry, ls),
+        x, (layers, cache['k'], cache['v']))
+    x = _norm(x[:, -1:], params['final_norm']['scale'], cfg.norm_eps)
+    logits = jnp.einsum(
+        'bsd,dv->bsv', x.astype(jnp.float32),
+        params['lm_head']['kernel'].astype(jnp.float32))[:, 0]
+    new_cache = {'k': new_k, 'v': new_v, 'index': cache_len}
+    return logits, new_cache
+
+
+def prefill(cfg: ModelConfig, params, tokens, cache):
+    """Process the prompt [b, s] into a FRESH cache (index 0); returns
+    (last-token logits [b, V], cache).  Flash-kernel attention."""
+    return _forward_with_cache(cfg, params, tokens, cache,
+                               use_flash=True)
+
+
+def decode_step(cfg: ModelConfig, params, token, cache):
+    """One token [b, 1] -> (logits [b, V], cache).  jit this."""
+    return _forward_with_cache(cfg, params, token, cache,
+                               use_flash=False)
+
+
+def sample(logits, rng, sampling: SamplingConfig):
+    """logits [b, V] -> token ids [b]."""
+    if sampling.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / sampling.temperature
+    if sampling.top_k > 0:
+        top = jax.lax.top_k(logits, sampling.top_k)[0][..., -1:]
+        logits = jnp.where(logits < top, NEG_INF, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def generate(cfg: ModelConfig, params, prompt, *, max_new_tokens: int,
+             max_len: Optional[int] = None,
+             sampling: Optional[SamplingConfig] = None,
+             rng: Optional[jax.Array] = None
+             ) -> Tuple[jax.Array, jax.Array]:
+    """Greedy/temperature generation.  prompt [b, s] -> (tokens
+    [b, s+max_new_tokens], new token slice [b, max_new_tokens]).
+
+    The step loop is a lax.scan under one jit: static shapes, one
+    compile, the whole decode runs device-side.
+    """
+    sampling = sampling or SamplingConfig()
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    b, prompt_len = prompt.shape
+    max_len = max_len or (prompt_len + max_new_tokens)
+    if max_len < prompt_len + max_new_tokens:
+        raise ValueError(f'max_len {max_len} < prompt {prompt_len} + '
+                         f'new {max_new_tokens}')
+
+    cache = init_cache(cfg, b, max_len)
+    logits, cache = prefill(cfg, params, prompt, cache)
+    rng, first_rng = jax.random.split(rng)
+    first = sample(logits, first_rng, sampling)
+
+    def step(carry, step_rng):
+        token, cache = carry
+        logits, cache = decode_step(cfg, params, token[:, None], cache)
+        nxt = sample(logits, step_rng, sampling)
+        return (nxt, cache), nxt
+
+    (_, _), rest = jax.lax.scan(
+        step, (first, cache), jax.random.split(rng, max_new_tokens - 1))
+    new_tokens = jnp.concatenate(
+        [first[:, None], rest.transpose(1, 0)], axis=1)
+    return jnp.concatenate([prompt, new_tokens], axis=1), new_tokens
