@@ -27,6 +27,9 @@ def main() -> None:
     parser.add_argument('--fsdp', type=int, default=1)
     parser.add_argument('--tensor', type=int, default=1)
     parser.add_argument('--sequence', type=int, default=1)
+    parser.add_argument('--data', default=None,
+                        help='SKYTOK1 token file (data.loader); random '
+                             'tokens when omitted.')
     args = parser.parse_args()
 
     import jax
@@ -39,7 +42,7 @@ def main() -> None:
     from skypilot_tpu.models.train import TrainConfig
     from skypilot_tpu.models.train import create_train_state
     from skypilot_tpu.models.train import jit_train_step
-    from skypilot_tpu.parallel.sharding import batch_sharding
+    from skypilot_tpu.parallel.sharding import token_batch_sharding
 
     parallel.initialize_from_env()
     mesh = parallel.build_mesh(
@@ -52,7 +55,7 @@ def main() -> None:
     state, shardings = create_train_state(
         cfg, TrainConfig(), mesh=mesh, batch_size=args.batch_size,
         seq_len=args.seq_len)
-    step_fn = jit_train_step(shardings, batch_sharding(mesh))
+    step_fn = jit_train_step(shardings, token_batch_sharding(mesh))
 
     start_step = 0
     mgr = None
@@ -62,13 +65,29 @@ def main() -> None:
         print(f'resuming from step {start_step}')
 
     cb = callbacks.init(total_steps=args.steps)
-    key = jax.random.PRNGKey(start_step)
-    tokens = jax.random.randint(
-        key, (args.batch_size, args.seq_len), 0, cfg.vocab_size,
-        dtype=jnp.int32)
-    batch = {'inputs': tokens, 'targets': jnp.roll(tokens, -1, axis=1)}
+    if args.data:
+        # Real data path: host-sharded resumable batches + async device
+        # prefetch (resume continues at start_step deterministically).
+        from skypilot_tpu.data import loader as loader_lib
+        from skypilot_tpu.parallel import distributed
+        batches = loader_lib.HostShardedBatches(
+            loader_lib.TokenDataset(args.data),
+            global_batch=args.batch_size * distributed.num_hosts(),
+            seq_len=args.seq_len,
+            host_rank=distributed.host_rank(),
+            num_hosts=distributed.num_hosts())
+        batch_iter = loader_lib.DevicePrefetcher(
+            batches.batches(start_step=start_step),
+            sharding=token_batch_sharding(mesh))
+    else:
+        key = jax.random.PRNGKey(start_step)
+        tokens = jax.random.randint(
+            key, (args.batch_size, args.seq_len + 1), 0, cfg.vocab_size,
+            dtype=jnp.int32)
+        batch_iter = iter(lambda: {'tokens': tokens}, None)
 
     for step in range(start_step, args.steps):
+        batch = next(batch_iter)
         with cb.step():
             state, metrics = step_fn(state, batch)
             jax.block_until_ready(metrics['loss'])

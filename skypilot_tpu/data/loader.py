@@ -1,0 +1,177 @@
+"""Token data loading: memmap datasets, host-sharded resumable
+batching, async device prefetch.
+
+TPU-first design (no reference equivalent — SkyPilot delegates IO to
+user code):
+
+- `TokenDataset`: a flat binary file of token ids read through
+  np.memmap — no copies, instant open, scales past RAM.
+- `HostShardedBatches`: STATELESS batch addressing.  Batch `step` is a
+  pure function of (seed, step, host_rank), so (1) every host of a
+  slice draws disjoint rows of the same global batch with zero
+  coordination, and (2) resuming from a checkpoint is just "continue
+  at step N" — the loader IS the data-side half of the checkpoint
+  contract (data/checkpoints.py holds the model side).
+- `DevicePrefetcher`: a one-slot background thread that stages the
+  next batch onto device (optionally with a NamedSharding) while the
+  current step computes — hides host->HBM latency without pulling in a
+  framework dependency.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_MAGIC = b'SKYTOK1\n'
+_DTYPES = {2: np.uint16, 4: np.uint32}
+
+
+def write_token_file(path: str, tokens: np.ndarray) -> None:
+    """Write a flat token file (8-byte magic + 1-byte itemsize +
+    little-endian ids)."""
+    tokens = np.asarray(tokens)
+    if tokens.ndim != 1:
+        raise ValueError(f'tokens must be 1-D, got shape {tokens.shape}')
+    itemsize = 2 if tokens.max(initial=0) < 2**16 else 4
+    dtype = _DTYPES[itemsize]
+    with open(path, 'wb') as f:
+        f.write(_MAGIC)
+        f.write(bytes([itemsize]))
+        f.write(tokens.astype(dtype).tobytes())
+
+
+class TokenDataset:
+    """Flat token-id file, memory-mapped."""
+
+    def __init__(self, path: str):
+        with open(path, 'rb') as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise exceptions.SkyTpuError(
+                    f'{path} is not a SKYTOK1 token file.')
+            itemsize = f.read(1)[0]
+        if itemsize not in _DTYPES:
+            raise exceptions.SkyTpuError(
+                f'{path}: unsupported token itemsize {itemsize}.')
+        self.path = path
+        self._offset = len(_MAGIC) + 1
+        self.tokens = np.memmap(path, dtype=_DTYPES[itemsize], mode='r',
+                                offset=self._offset)
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+    def window(self, start: int, length: int) -> np.ndarray:
+        return np.asarray(self.tokens[start:start + length])
+
+
+class HostShardedBatches:
+    """Stateless per-host batch stream over a TokenDataset.
+
+    Yields {'tokens': [local_batch, seq_len + 1] int32} — the +1 column
+    feeds the next-token shift in models.train.  Window starts are
+    drawn per (seed, step) with a counter-based RNG, so any step's
+    batch is reconstructible without replaying the stream.
+    """
+
+    def __init__(self, dataset: TokenDataset, *, global_batch: int,
+                 seq_len: int, host_rank: int = 0, num_hosts: int = 1,
+                 seed: int = 0):
+        if global_batch % num_hosts:
+            raise ValueError(f'global_batch {global_batch} not divisible '
+                             f'by num_hosts {num_hosts}')
+        if len(dataset) < seq_len + 1:
+            raise ValueError(
+                f'dataset has {len(dataset)} tokens; need at least '
+                f'seq_len+1 = {seq_len + 1}')
+        self.dataset = dataset
+        self.global_batch = global_batch
+        self.local_batch = global_batch // num_hosts
+        self.seq_len = seq_len
+        self.host_rank = host_rank
+        self.num_hosts = num_hosts
+        self.seed = seed
+
+    def batch_at(self, step: int) -> Dict[str, np.ndarray]:
+        """The batch for `step` (pure function — resumable/addressable)."""
+        rng = np.random.default_rng((self.seed, step))
+        # Draw the GLOBAL batch's starts, then slice this host's rows:
+        # every host sees the same draw, takes a disjoint contiguous
+        # stripe — no cross-host communication.
+        starts = rng.integers(
+            0, len(self.dataset) - self.seq_len - 1,
+            size=self.global_batch)
+        lo = self.host_rank * self.local_batch
+        rows = [self.dataset.window(s, self.seq_len + 1)
+                for s in starts[lo:lo + self.local_batch]]
+        return {'tokens': np.stack(rows).astype(np.int32)}
+
+    def batches(self, start_step: int = 0) -> Iterator[Dict[str, Any]]:
+        step = start_step
+        while True:
+            yield self.batch_at(step)
+            step += 1
+
+
+class DevicePrefetcher:
+    """Stage the next batch onto device while the current one computes.
+
+    Wraps any iterator of host arrays; `sharding` (a NamedSharding)
+    places batches directly into their distributed layout.  Depth-1
+    double buffering — deeper queues only add HBM pressure when the
+    producer is a memmap.
+    """
+
+    def __init__(self, iterator: Iterator[Any],
+                 sharding: Optional[Any] = None, depth: int = 1):
+        self._iterator = iterator
+        self._sharding = sharding
+        self._queue: 'queue.Queue[Any]' = queue.Queue(maxsize=depth)
+        self._done = object()
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _put_on_device(self, batch: Any) -> Any:
+        import jax  # pylint: disable=import-outside-toplevel
+        if self._sharding is not None:
+            if jax.process_count() > 1:
+                # Multi-host: this process holds only ITS stripe of the
+                # global batch (HostShardedBatches); assemble the global
+                # array from per-process local data.  A plain device_put
+                # here would silently treat the stripe as the whole
+                # batch (dropping every other host's rows).
+                return jax.tree.map(
+                    lambda a: jax.make_array_from_process_local_data(
+                        self._sharding, a), batch)
+            return jax.tree.map(
+                lambda a: jax.device_put(a, self._sharding), batch)
+        return jax.tree.map(jax.device_put, batch)
+
+    def _run(self) -> None:
+        try:
+            for batch in self._iterator:
+                self._queue.put(self._put_on_device(batch))
+        except BaseException as e:  # pylint: disable=broad-except
+            self._error = e
+        finally:
+            self._queue.put(self._done)
+
+    def __iter__(self) -> 'DevicePrefetcher':
+        return self
+
+    def __next__(self) -> Any:
+        item = self._queue.get()
+        if item is self._done:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item

@@ -106,19 +106,22 @@ def _layer_forward(x, lp, cfg, positions, k_cache, v_cache, cache_len,
         out = flash_attention(q, k_cache[:, :, :s],
                               v_cache[:, :, :s], causal=True)
     else:
-        # Single-token decode: one einsum against the cache beats a
-        # kernel launch at q_len=1.  GQA: broadcast kv heads.
+        # Single-token decode: grouped einsums against the cache — GQA
+        # q-heads fold into a `rep` axis per kv-head, so the repeated
+        # K/V never materialises (8x cache-read savings on llama3-70b).
+        b, h, qs, d = q.shape
         rep = cfg.n_heads // cfg.n_kv_heads
-        k = jnp.repeat(k_cache, rep, axis=1) if rep > 1 else k_cache
-        v = jnp.repeat(v_cache, rep, axis=1) if rep > 1 else v_cache
-        s = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
-                       k.astype(jnp.float32)) * (cfg.head_dim ** -0.5)
-        kpos = jnp.arange(k.shape[2])
-        mask = kpos[None, None, None, :] < cache_len
+        qg = q.reshape(b, cfg.n_kv_heads, rep, qs, d).astype(jnp.float32)
+        k32 = k_cache.astype(jnp.float32)
+        s = jnp.einsum('bgrqd,bgkd->bgrqk', qg, k32) * (
+            cfg.head_dim ** -0.5)
+        kpos = jnp.arange(k_cache.shape[2])
+        mask = kpos[None, None, None, None, :] < cache_len
         s = jnp.where(mask, s, NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum('bhqk,bhkd->bhqd', p,
-                         v.astype(jnp.float32)).astype(x.dtype)
+        out = jnp.einsum('bgrqk,bgkd->bgrqd', p,
+                         v_cache.astype(jnp.float32))
+        out = out.reshape(b, h, qs, d).astype(x.dtype)
 
     out = jnp.einsum('bhsk,hkd->bsd', out,
                      lp['attn']['o_proj']['kernel'].astype(x.dtype))
@@ -169,9 +172,15 @@ def _forward_with_cache(cfg, params, tokens, cache, *, use_flash: bool):
     return logits, new_cache
 
 
-def prefill(cfg: ModelConfig, params, tokens, cache):
-    """Process the prompt [b, s] into a FRESH cache (index 0); returns
-    (last-token logits [b, V], cache).  Flash-kernel attention."""
+def prefill(cfg: ModelConfig, params, tokens, *, max_len: int):
+    """Process the prompt [b, s] into a FRESH cache; returns
+    (last-token logits [b, V], cache).  Flash-kernel attention.
+
+    Builds the cache itself: the flash path is only correct from
+    index 0 (it attends over the static [0, s) window), so accepting a
+    caller-supplied cache would invite silent corruption on index>0.
+    """
+    cache = init_cache(cfg, tokens.shape[0], max_len)
     return _forward_with_cache(cfg, params, tokens, cache,
                                use_flash=True)
 
@@ -206,14 +215,13 @@ def generate(cfg: ModelConfig, params, prompt, *, max_new_tokens: int,
     """
     sampling = sampling or SamplingConfig()
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    b, prompt_len = prompt.shape
+    prompt_len = prompt.shape[1]
     max_len = max_len or (prompt_len + max_new_tokens)
     if max_len < prompt_len + max_new_tokens:
         raise ValueError(f'max_len {max_len} < prompt {prompt_len} + '
                          f'new {max_new_tokens}')
 
-    cache = init_cache(cfg, b, max_len)
-    logits, cache = prefill(cfg, params, prompt, cache)
+    logits, cache = prefill(cfg, params, prompt, max_len=max_len)
     rng, first_rng = jax.random.split(rng)
     first = sample(logits, first_rng, sampling)
 

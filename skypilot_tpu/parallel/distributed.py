@@ -48,3 +48,11 @@ def initialize_from_env(*, force: bool = False) -> bool:
 
 def num_slices() -> int:
     return int(os.environ.get(constants.ENV_NUM_SLICES, '1'))
+
+
+def num_hosts() -> int:
+    return int(os.environ.get(constants.ENV_NUM_HOSTS, '1'))
+
+
+def host_rank() -> int:
+    return int(os.environ.get(constants.ENV_HOST_RANK, '0'))

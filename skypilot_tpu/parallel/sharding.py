@@ -61,6 +61,17 @@ def batch_sharding(mesh):
     return logical_sharding(mesh, 'batch', 'seq')
 
 
+def token_batch_sharding(mesh):
+    """Sharding for raw token batches [batch, seq_len + 1].
+
+    The +1 next-token column makes the seq dim indivisible by a
+    non-trivial 'sequence' axis, so tokens shard on batch only; the
+    model's logical constraints re-shard activations onto the sequence
+    axis after the embedding (where the dim is seq_len again).
+    """
+    return logical_sharding(mesh, 'batch', None)
+
+
 def replicated(mesh):
     import jax  # pylint: disable=import-outside-toplevel
     return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())

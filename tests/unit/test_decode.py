@@ -36,8 +36,7 @@ def _naive_generate(model, params, prompt, n):
 
 def test_prefill_logits_match_full_forward(setup):
     cfg, model, params, prompt = setup
-    cache = decode.init_cache(cfg, prompt.shape[0], 32)
-    logits, cache = decode.prefill(cfg, params, prompt, cache)
+    logits, cache = decode.prefill(cfg, params, prompt, max_len=32)
     full = model.apply({'params': params}, prompt)
     np.testing.assert_allclose(np.asarray(logits),
                                np.asarray(full[:, -1]),
@@ -47,8 +46,7 @@ def test_prefill_logits_match_full_forward(setup):
 
 def test_decode_step_matches_full_forward(setup):
     cfg, model, params, prompt = setup
-    cache = decode.init_cache(cfg, prompt.shape[0], 32)
-    logits, cache = decode.prefill(cfg, params, prompt, cache)
+    logits, cache = decode.prefill(cfg, params, prompt, max_len=32)
     nxt = jnp.argmax(logits, axis=-1)
     step_logits, cache = decode.decode_step(cfg, params, nxt[:, None],
                                             cache)
