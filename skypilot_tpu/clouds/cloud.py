@@ -69,6 +69,9 @@ class Cloud:
 
     # Subclasses override.
     _REPR = 'Cloud'
+    # Clouds without a price catalog (local/docker: free local capacity)
+    # skip instance-type catalog validation.
+    HAS_CATALOG = True
     # Which provision module implements this cloud
     # (skypilot_tpu.provision.<name>).
     PROVISIONER = 'none'

@@ -1,0 +1,96 @@
+"""Docker cloud: local containers for image-faithful quick iteration.
+
+Parity: /root/reference/sky/backends/local_docker_backend.py (a
+parallel Backend class there; a cloud + provisioner here, so the whole
+normal stack — optimizer, backend, skylet, jobs — runs unmodified
+against containers).  Complements the `local` cloud: local emulates
+slice hosts as bare directories (fastest, no daemon needed); docker
+runs tasks inside the actual container image they would ship with.
+"""
+from __future__ import annotations
+
+import subprocess
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+class Docker(cloud_lib.Cloud):
+    _REPR = 'Docker'
+    PROVISIONER = 'docker'
+    HAS_CATALOG = False
+
+    _CLOUD_UNSUPPORTED_FEATURES = {
+        cloud_lib.CloudImplementationFeatures.QUEUED_RESOURCE:
+            'Container capacity is immediate.',
+        cloud_lib.CloudImplementationFeatures.RESERVATION:
+            'Container capacity is immediate.',
+        cloud_lib.CloudImplementationFeatures.CLONE_DISK:
+            'No disks to clone for containers.',
+        cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+            'Containers are not preemptible capacity.',
+    }
+
+    def regions_with_offering(self, resources) -> List[cloud_lib.Region]:
+        del resources
+        return [
+            cloud_lib.Region('docker').set_zones(
+                [cloud_lib.Zone('docker', 'docker')])
+        ]
+
+    def instance_type_to_hourly_cost(self, instance_type, use_spot,
+                                     region, zone) -> float:
+        return 0.0
+
+    def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
+                                    zone) -> float:
+        return 0.0
+
+    def get_feasible_launchable_resources(self, resources):
+        if resources.tpu_spec is not None or resources.accelerators:
+            # Plain CPU containers: no TPUs, and no GPU passthrough —
+            # accepting an accelerator request at $0 would win every
+            # cost comparison and land the job on a GPU-less container.
+            return [], []
+        return [resources.copy(cloud=self, instance_type='docker')], []
+
+    def get_default_instance_type(self, cpus, memory) -> Optional[str]:
+        del cpus, memory
+        return 'docker'
+
+    def validate_region_zone(self, region, zone):
+        if region not in (None, 'docker') or zone not in (None, 'docker'):
+            raise ValueError('The docker cloud has a single region/zone '
+                             "named 'docker'.")
+        return region, zone
+
+    def make_deploy_resources_variables(self, resources, cluster_name,
+                                        region, zones) -> Dict[str, Any]:
+        return {
+            'cluster_name': cluster_name,
+            'region': region.name,
+            'zones': [z.name for z in (zones or [])],
+            'tpu': False,
+            'image_id': resources.image_id,
+            'instance_type': resources.instance_type or 'docker',
+            'use_spot': False,
+        }
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        try:
+            proc = subprocess.run(['docker', 'info'], capture_output=True,
+                                  timeout=10, check=False)
+            if proc.returncode == 0:
+                return True, None
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            pass
+        return False, ('docker daemon not reachable; install docker or '
+                       'start the daemon.')
+
+    def get_current_user_identity(self) -> Optional[List[str]]:
+        from skypilot_tpu.utils import common_utils  # pylint: disable=import-outside-toplevel
+        return [common_utils.get_user_hash()]

@@ -8,12 +8,14 @@ from typing import Dict, Optional
 
 from skypilot_tpu.clouds import aws
 from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.clouds import docker
 from skypilot_tpu.clouds import gcp
 from skypilot_tpu.clouds import gke
 from skypilot_tpu.clouds import local
 
 CLOUD_REGISTRY: Dict[str, cloud_lib.Cloud] = {
     'aws': aws.AWS(),
+    'docker': docker.Docker(),
     'gcp': gcp.GCP(),
     'gke': gke.GKE(),
     'local': local.Local(),

@@ -106,8 +106,10 @@ class HostShardedBatches:
         # Draw the GLOBAL batch's starts, then slice this host's rows:
         # every host sees the same draw, takes a disjoint contiguous
         # stripe — no cross-host communication.
+        # Exclusive high: the last valid window start is
+        # len - (seq_len+1), so high = len - seq_len.
         starts = rng.integers(
-            0, len(self.dataset) - self.seq_len - 1,
+            0, len(self.dataset) - self.seq_len,
             size=self.global_batch)
         lo = self.host_rank * self.local_batch
         rows = [self.dataset.window(s, self.seq_len + 1)
@@ -171,6 +173,10 @@ class DevicePrefetcher:
     def __next__(self) -> Any:
         item = self._queue.get()
         if item is self._done:
+            # Re-enqueue the sentinel: the iterator protocol allows
+            # repeated next() after exhaustion (must keep raising, not
+            # deadlock on an empty queue).
+            self._queue.put(self._done)
             if self._error is not None:
                 raise self._error
             raise StopIteration

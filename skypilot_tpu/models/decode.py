@@ -49,12 +49,6 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int
 
 def _layer_params(params: Dict[str, Any], cfg: ModelConfig):
     """-> per-layer param pytree with leading [L] axis (scan layout)."""
-    if cfg.n_experts > 0:
-        # MoE layers store params under 'moe_mlp' with routed experts;
-        # the decode fast path only implements dense MLPs so far.
-        raise NotImplementedError(
-            'KV-cache decoding supports dense models only (MoE decode '
-            'routing is not implemented yet).')
     if cfg.scan_layers:
         return params['layers']['layer']
     stacked = jax.tree.map(
@@ -69,12 +63,50 @@ def _attn_proj(x, kernel):
 
 
 def _mlp(x, lp, cfg):
+    if cfg.n_experts > 0:
+        return _moe_mlp(x, lp['moe_mlp'], cfg)
     gate = jnp.einsum('bsd,df->bsf', x,
                       lp['mlp']['gate_proj']['kernel'].astype(x.dtype))
     up = jnp.einsum('bsd,df->bsf', x,
                     lp['mlp']['up_proj']['kernel'].astype(x.dtype))
     return jnp.einsum('bsf,fd->bsd', jax.nn.silu(gate) * up,
                       lp['mlp']['down_proj']['kernel'].astype(x.dtype))
+
+
+def _moe_mlp(x, mp, cfg):
+    """Inference MoE.  Prefill (s > 1) reuses the training path's
+    capacity dispatch (`moe.moe_apply`) — identical math AND identical
+    FLOPs profile, instead of paying n_experts/top_k x on long prompts.
+    Single-token decode uses dense-gather top-k without capacity
+    dropping (every selected token computes — the Mixtral inference
+    convention; with one token per sequence, balanced batched dispatch
+    buys nothing)."""
+    b, s, d = x.shape
+    tokens = x.reshape(b * s, d)
+    logits = jnp.einsum('nd,de->ne', tokens.astype(jnp.float32),
+                        mp['router']['kernel'].astype(jnp.float32))
+    if s > 1:
+        from skypilot_tpu.models import moe  # pylint: disable=import-outside-toplevel
+        out, _ = moe.moe_apply(tokens, logits, mp['gate_proj'],
+                               mp['up_proj'], mp['down_proj'], cfg)
+        return out.astype(x.dtype).reshape(b, s, d)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, cfg.expert_top_k)
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+    # Dense [N, E] gates (zero off the top-k): tiny N makes computing
+    # every expert cheaper than gather/scatter of expert weights.
+    gates = jnp.sum(
+        jax.nn.one_hot(gate_idx, cfg.n_experts, dtype=jnp.float32) *
+        gate_vals[..., None], axis=1)                    # [N, E]
+    xt = tokens.astype(jnp.float32)
+    h = jax.nn.silu(jnp.einsum('nd,edf->nef', xt,
+                               mp['gate_proj'].astype(jnp.float32)))
+    h = h * jnp.einsum('nd,edf->nef', xt,
+                       mp['up_proj'].astype(jnp.float32))
+    out_e = jnp.einsum('nef,efd->ned', h,
+                       mp['down_proj'].astype(jnp.float32))
+    out = jnp.einsum('ne,ned->nd', gates, out_e)
+    return out.astype(x.dtype).reshape(b, s, d)
 
 
 def _norm(x, scale, eps):

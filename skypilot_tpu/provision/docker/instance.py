@@ -1,0 +1,219 @@
+"""Docker provisioner: local containers as cluster hosts.
+
+Parity: /root/reference/sky/backends/local_docker_backend.py (+
+docker_utils.py) — quick local iteration without a cloud, rebuilt as a
+provisioner (containers are hosts, same interface as every other
+provider) instead of a parallel Backend class.  The docker CLI sits
+behind an injectable runner (`set_cli_runner`), so the lifecycle is
+unit-testable without a docker daemon.
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.utils import command_runner
+
+logger = sky_logging.init_logger(__name__)
+
+_LABEL = 'skytpu-cluster'
+_RANK_LABEL = 'skytpu-rank'
+DEFAULT_IMAGE = 'python:3.11-slim'
+
+CliRunner = Callable[[List[str]], tuple]
+
+
+def _default_cli_runner(args: List[str]) -> tuple:
+    proc = subprocess.run(args, capture_output=True, text=True,
+                          check=False, timeout=300)
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+_cli_runner: CliRunner = _default_cli_runner
+
+
+def set_cli_runner(runner: Optional[CliRunner]) -> None:
+    global _cli_runner
+    _cli_runner = runner or _default_cli_runner
+
+
+def _docker(*args: str) -> str:
+    rc, stdout, stderr = _cli_runner(['docker', *args])
+    if rc != 0:
+        raise exceptions.ProvisionError(
+            f'docker {args[0]} failed (rc={rc}): {stderr.strip()[:400]}')
+    return stdout
+
+
+def _container_name(cluster_name: str, rank: int) -> str:
+    return f'skytpu-{cluster_name}-{rank}'
+
+
+def _ps(cluster_name: str, all_states: bool = True) -> List[Dict[str, Any]]:
+    args = ['ps', '--filter', f'label={_LABEL}={cluster_name}',
+            '--format', '{{json .}}']
+    if all_states:
+        args.insert(1, '-a')
+    out = _docker(*args)
+    rows = []
+    for line in out.splitlines():
+        line = line.strip()
+        if line:
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                continue
+    rows.sort(key=_rank_of)
+    return rows
+
+
+def _rank_of(row: Dict[str, Any]) -> int:
+    """Rank from the skytpu-rank label (docker ps Labels is a
+    'k=v,k=v' string); name-suffix fallback for robustness.  Numeric —
+    lexicographic Name sorting would order rank 10 before rank 2."""
+    labels = row.get('Labels', '') or ''
+    for part in labels.split(','):
+        if part.startswith(f'{_RANK_LABEL}='):
+            try:
+                return int(part.split('=', 1)[1])
+            except ValueError:
+                break
+    try:
+        return int(row.get('Names', '').rsplit('-', 1)[-1])
+    except ValueError:
+        return 1 << 30
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    cluster_name = config.cluster_name
+    count = config.count
+    image = config.deploy_vars.get('image_id') or DEFAULT_IMAGE
+    existing = _ps(cluster_name)
+    created: List[str] = []
+    resumed: List[str] = []
+    if existing:
+        if len(existing) != count:
+            raise exceptions.ResourcesMismatchError(
+                f'Cluster {cluster_name} exists with {len(existing)} '
+                f'containers; requested {count}.')
+        for row in existing:
+            if 'Up' not in row.get('Status', ''):
+                _docker('start', row['Names'])
+                resumed.append(row['Names'])
+    else:
+        for rank in range(count):
+            name = _container_name(cluster_name, rank)
+            _docker('run', '-d', '--name', name,
+                    '--label', f'{_LABEL}={cluster_name}',
+                    '--label', f'{_RANK_LABEL}={rank}',
+                    image, 'sleep', 'infinity')
+            created.append(name)
+    head = _container_name(cluster_name, 0)
+    return common.ProvisionRecord(
+        provider_name='docker',
+        cluster_name=cluster_name,
+        region='docker',
+        zone='docker',
+        head_instance_id=head,
+        created_instance_ids=created,
+        resumed_instance_ids=resumed,
+    )
+
+
+def wait_instances(cluster_name: str, state: Optional[str] = None) -> None:
+    del cluster_name, state  # docker run returns only once started.
+
+
+def wait_capacity(cluster_name: str, timeout: float = 0) -> bool:
+    del cluster_name, timeout
+    return True
+
+
+def stop_instances(cluster_name: str, worker_only: bool = False) -> None:
+    for row in _ps(cluster_name):
+        if worker_only and row['Names'].endswith('-0'):
+            continue
+        if 'Up' in row.get('Status', ''):
+            _docker('stop', row['Names'])
+
+
+def terminate_instances(cluster_name: str,
+                        worker_only: bool = False) -> None:
+    for row in _ps(cluster_name):
+        if worker_only and row['Names'].endswith('-0'):
+            continue
+        _docker('rm', '-f', row['Names'])
+
+
+def query_instances(cluster_name: str
+                    ) -> Dict[str, Optional[ClusterStatus]]:
+    out = {}
+    for row in _ps(cluster_name):
+        status = row.get('Status', '')
+        if status.startswith('Up'):
+            out[row['Names']] = ClusterStatus.UP
+        elif status.startswith(('Exited', 'Created', 'Paused')):
+            out[row['Names']] = ClusterStatus.STOPPED
+        else:
+            out[row['Names']] = None
+    return out
+
+
+def get_cluster_info(cluster_name: str,
+                     region: Optional[str] = None) -> common.ClusterInfo:
+    del region
+    rows = _ps(cluster_name)
+    if not rows:
+        raise exceptions.FetchClusterInfoError(
+            exceptions.FetchClusterInfoError.Reason.HEAD)
+    down = [r['Names'] for r in rows if 'Up' not in r.get('Status', '')]
+    if down:
+        # All-or-nothing gang: a partially-up cluster must surface as
+        # unfetchable, not silently renumber the remaining ranks (the
+        # gang would launch with the wrong world size).
+        raise exceptions.FetchClusterInfoError(
+            exceptions.FetchClusterInfoError.Reason.WORKER)
+    instances = []
+    for row in rows:
+        rank = _rank_of(row)
+        instances.append(
+            common.InstanceInfo(
+                instance_id=row['Names'],
+                internal_ip='127.0.0.1',
+                external_ip='127.0.0.1',
+                ssh_port=0,
+                slice_id=0,
+                worker_id=rank,
+                tags={'rank': str(rank)},
+            ))
+    return common.ClusterInfo(
+        provider_name='docker',
+        cluster_name=cluster_name,
+        region='docker',
+        zone='docker',
+        instances=instances,
+        head_instance_id=instances[0].instance_id,
+        ssh_user='root',
+    )
+
+
+def open_ports(cluster_name: str, ports: List[int]) -> None:
+    del cluster_name, ports  # Localhost; port mapping is at run time.
+
+
+def cleanup_ports(cluster_name: str) -> None:
+    del cluster_name
+
+
+def get_command_runners(cluster_info: common.ClusterInfo,
+                        **kwargs: Any) -> List[command_runner.CommandRunner]:
+    del kwargs
+    return [
+        command_runner.DockerCommandRunner(node=(inst.instance_id, 0))
+        for inst in cluster_info.instances
+    ]

@@ -255,7 +255,7 @@ class Resources:
             raise exceptions.InvalidTaskError(
                 'num_slices > 1 requires a TPU accelerator.')
         if (self._instance_type is not None and self._cloud is not None and
-                not self._cloud.name == 'local'):
+                self._cloud.HAS_CATALOG):
             from skypilot_tpu import catalog  # pylint: disable=import-outside-toplevel
             if not catalog.instance_type_exists(self._cloud.name,
                                                 self._instance_type):

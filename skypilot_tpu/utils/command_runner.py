@@ -539,3 +539,24 @@ class KubernetesCommandRunner(CommandRunner):
                     proc.returncode, ' '.join(tar_out),
                     f'Failed to sync down {source}',
                     stderr.decode(errors='replace'), stream_logs)
+
+
+class DockerCommandRunner(KubernetesCommandRunner):
+    """Runner for local docker containers via `docker exec`.
+
+    Parity: reference backends/local_docker_backend.py +
+    docker_utils.py — containers stand in for slice hosts (quick
+    local iteration without a cloud).  Inherits the tar-over-exec
+    file-transfer machinery; only the exec argv differs.
+    """
+
+    def __init__(self, node: Tuple[str, int], **kwargs: Any) -> None:
+        CommandRunner.__init__(self, node)
+        self.container_name = node[0]
+        del kwargs
+
+    def _exec_argv(self, cmd: str, interactive: bool = False) -> List[str]:
+        argv = ['docker', 'exec']
+        if interactive:
+            argv.append('-i')
+        return argv + [self.container_name, 'bash', '-c', cmd]

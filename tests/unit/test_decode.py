@@ -86,12 +86,20 @@ def test_max_len_validation(setup):
                         max_len=12)
 
 
-def test_moe_rejected_clearly(setup):
-    cfg, _, params, prompt = setup
-    moe_cfg = configs.get_config('tiny-moe')
-    with pytest.raises(NotImplementedError, match='dense'):
-        decode.generate(moe_cfg, params, prompt, max_new_tokens=2,
-                        max_len=16)
+def test_moe_greedy_generation_parity():
+    """MoE decode (dense-gather routing) matches the training-path
+    forward when capacity never drops tokens (factor large enough)."""
+    cfg = configs.get_config('tiny-moe',
+                             expert_capacity_factor=16.0)
+    model = Transformer(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    params = nn.meta.unbox(model.init(jax.random.PRNGKey(2),
+                                      prompt)['params'])
+    tokens, _ = decode.generate(cfg, params, prompt, max_new_tokens=4,
+                                max_len=16)
+    naive = _naive_generate(model, params, prompt, 4)
+    np.testing.assert_array_equal(np.asarray(tokens), np.asarray(naive))
 
 
 def test_generate_is_jittable(setup):

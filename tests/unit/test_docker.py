@@ -1,0 +1,143 @@
+"""Docker cloud + provisioner (reference local_docker_backend parity,
+VERDICT inventory row #12).  docker CLI behind an injectable runner."""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.docker import instance as docker_instance
+from skypilot_tpu.utils import command_runner
+
+
+class FakeDockerCli:
+    """Container state machine keyed on docker CLI argv."""
+
+    def __init__(self):
+        self.containers = {}  # name -> {'labels': {...}, 'status': str}
+        self.calls = []
+
+    def __call__(self, argv):
+        self.calls.append(argv)
+        cmd = argv[1]
+        if cmd == 'run':
+            name = argv[argv.index('--name') + 1]
+            labels = {}
+            for i, a in enumerate(argv):
+                if a == '--label':
+                    k, v = argv[i + 1].split('=', 1)
+                    labels[k] = v
+            image = argv[-3]
+            self.containers[name] = {'labels': labels, 'status': 'Up',
+                                     'image': image}
+            return 0, name + '\n', ''
+        if cmd == 'ps':
+            label_filter = next(a for a in argv if a.startswith('label='))
+            _, kv = label_filter.split('=', 1)
+            key, value = kv.split('=', 1)
+            include_stopped = '-a' in argv
+            rows = []
+            for name, c in self.containers.items():
+                if c['labels'].get(key) != value:
+                    continue
+                if not include_stopped and c['status'] != 'Up':
+                    continue
+                status = ('Up 5 minutes' if c['status'] == 'Up'
+                          else 'Exited (0) 1 minute ago')
+                rows.append(json.dumps({'Names': name, 'Status': status}))
+            return 0, '\n'.join(rows) + '\n', ''
+        if cmd == 'stop':
+            self.containers[argv[2]]['status'] = 'Exited'
+            return 0, '', ''
+        if cmd == 'start':
+            self.containers[argv[2]]['status'] = 'Up'
+            return 0, '', ''
+        if cmd == 'rm':
+            self.containers.pop(argv[-1], None)
+            return 0, '', ''
+        return 1, '', f'unhandled docker {cmd}'
+
+
+@pytest.fixture
+def fake_docker():
+    cli = FakeDockerCli()
+    docker_instance.set_cli_runner(cli)
+    yield cli
+    docker_instance.set_cli_runner(None)
+
+
+def _config(cluster='dkr', count=2, image=None):
+    return provision_common.ProvisionConfig(
+        provider_name='docker', cluster_name=cluster, region='docker',
+        zones=['docker'], deploy_vars={'image_id': image}, count=count)
+
+
+class TestDockerProvisioner:
+
+    def test_lifecycle(self, fake_docker):
+        record = docker_instance.run_instances(_config())
+        assert record.created_instance_ids == ['skytpu-dkr-0',
+                                               'skytpu-dkr-1']
+        assert record.head_instance_id == 'skytpu-dkr-0'
+        status = docker_instance.query_instances('dkr')
+        assert all(s.value == 'UP' for s in status.values())
+
+        info = docker_instance.get_cluster_info('dkr')
+        assert [i.instance_id for i in info.instances] == [
+            'skytpu-dkr-0', 'skytpu-dkr-1']
+        runners = docker_instance.get_command_runners(info)
+        assert isinstance(runners[0],
+                          command_runner.DockerCommandRunner)
+        argv = runners[0]._exec_argv('echo hi')
+        assert argv[:2] == ['docker', 'exec']
+        assert 'skytpu-dkr-0' in argv
+
+        docker_instance.stop_instances('dkr')
+        status = docker_instance.query_instances('dkr')
+        assert all(s.value == 'STOPPED' for s in status.values())
+
+        record = docker_instance.run_instances(_config())
+        assert len(record.resumed_instance_ids) == 2
+
+        docker_instance.terminate_instances('dkr')
+        assert docker_instance.query_instances('dkr') == {}
+
+    def test_custom_image(self, fake_docker):
+        docker_instance.run_instances(_config(image='myimage:1'))
+        assert fake_docker.containers['skytpu-dkr-0']['image'] == \
+            'myimage:1'
+
+    def test_default_image(self, fake_docker):
+        docker_instance.run_instances(_config())
+        assert fake_docker.containers['skytpu-dkr-0']['image'] == \
+            docker_instance.DEFAULT_IMAGE
+
+    def test_count_mismatch(self, fake_docker):
+        docker_instance.run_instances(_config(count=1))
+        with pytest.raises(exceptions.ResourcesMismatchError):
+            docker_instance.run_instances(_config(count=2))
+
+    def test_worker_only_preserves_head(self, fake_docker):
+        docker_instance.run_instances(_config(count=3))
+        docker_instance.terminate_instances('dkr', worker_only=True)
+        assert list(docker_instance.query_instances('dkr')) == [
+            'skytpu-dkr-0']
+
+
+class TestDockerCloud:
+
+    def test_registered_and_feasible(self):
+        cloud = registry.CLOUD_REGISTRY['docker']
+        r = sky.Resources(cloud='docker')
+        launchable, _ = cloud.get_feasible_launchable_resources(r)
+        assert launchable and launchable[0].instance_type == 'docker'
+
+    def test_no_tpus_in_containers(self):
+        cloud = registry.CLOUD_REGISTRY['docker']
+        r = sky.Resources(accelerators='tpu-v5e-8')
+        launchable, _ = cloud.get_feasible_launchable_resources(r)
+        assert launchable == []

@@ -98,6 +98,16 @@ class TestHostShardedBatches:
         with pytest.raises(ValueError, match='divisible'):
             self._loader(token_file, global_batch=6, num_hosts=4)
 
+    def test_minimal_dataset_works(self, tmp_path):
+        """len == seq_len+1, the smallest accepted dataset, must yield
+        (off-by-one regression from review: high bound hit 0)."""
+        path = str(tmp_path / 't.bin')
+        loader.write_token_file(path, np.arange(17))
+        batches = loader.HostShardedBatches(
+            loader.TokenDataset(path), global_batch=2, seq_len=16)
+        batch = batches.batch_at(0)
+        np.testing.assert_array_equal(batch['tokens'][0], np.arange(17))
+
     def test_tiny_dataset_rejected(self, tmp_path):
         path = str(tmp_path / 't.bin')
         loader.write_token_file(path, np.arange(10))
@@ -128,6 +138,17 @@ class TestDevicePrefetcher:
         pf = loader.DevicePrefetcher(boom())
         next(pf)
         with pytest.raises(RuntimeError, match='producer failed'):
+            next(pf)
+        # Repeated next() keeps raising instead of deadlocking.
+        with pytest.raises(RuntimeError, match='producer failed'):
+            next(pf)
+
+    def test_exhaustion_is_repeatable(self):
+        pf = loader.DevicePrefetcher(iter([{'x': np.zeros(2)}]))
+        next(pf)
+        with pytest.raises(StopIteration):
+            next(pf)
+        with pytest.raises(StopIteration):
             next(pf)
 
     def test_sharded_placement(self, token_file):
