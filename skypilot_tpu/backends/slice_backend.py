@@ -357,17 +357,14 @@ class SliceBackend(backend_lib.Backend[SliceResourceHandle]):
         # storage mounts in disguise — route them through the storage
         # layer (parity: reference cloud_vm_ray_backend.py:4406 turns
         # URL sources into cloud-CLI downloads on the cluster).
+        from skypilot_tpu.data import storage as storage_lib  # pylint: disable=import-outside-toplevel
         storage_mounts = dict(storage_mounts or {})
         rsync_mounts: Dict[str, str] = {}
         for dst, src in (all_file_mounts or {}).items():
-            if src.startswith(('gs://', 's3://', 'local://')):
-                from skypilot_tpu.data import storage as storage_lib  # pylint: disable=import-outside-toplevel
+            if src.startswith(storage_lib.BUCKET_URL_PREFIXES):
                 storage_mounts.setdefault(
                     dst, storage_lib.Storage(
                         source=src, mode=storage_lib.StorageMode.COPY))
-            elif src.startswith('r2://'):
-                raise exceptions.NotSupportedError(
-                    'r2:// file mounts are not supported yet.')
             else:
                 rsync_mounts[dst] = src
         if rsync_mounts:

@@ -3,10 +3,12 @@
 Parity: /root/reference/sky/clouds/service_catalog/data_fetchers/
 (fetch_gcp.py scrapes the GCP SKU API incl. TPU pricing, fetch_gcp.py:34-50).
 """
+from skypilot_tpu.catalog.data_fetchers import fetch_aws
 from skypilot_tpu.catalog.data_fetchers import fetch_gcp
 
 FETCHERS = {
+    'aws': fetch_aws.fetch,
     'gcp': fetch_gcp.fetch,
 }
 
-__all__ = ['FETCHERS', 'fetch_gcp']
+__all__ = ['FETCHERS', 'fetch_aws', 'fetch_gcp']

@@ -1,0 +1,150 @@
+"""AWS catalog fetcher: public EC2 pricing bulk JSON -> price CSV.
+
+Parity: /root/reference/sky/clouds/service_catalog/data_fetchers/
+fetch_aws.py — rebuilt WITHOUT boto3: the no-auth pricing bulk feed
+(https://pricing.us-east-1.amazonaws.com/offers/v1.0/aws/AmazonEC2/
+current/<region>/index.json) provides on-demand prices per region, so
+the whole pipeline needs only an injectable GET transport (same seam
+as fetch_gcp.py).  Spot prices are NOT in the bulk feed and are
+emitted blank — never synthesized (same honesty contract as the GCP
+fetcher's preemptible SKUs).
+"""
+from __future__ import annotations
+
+import csv
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+PRICING_URL = ('https://pricing.us-east-1.amazonaws.com/offers/v1.0/'
+               'aws/AmazonEC2/current/{region}/index.json')
+
+# Instance families worth cataloging (GPU boxes + the m6i CPU family);
+# everything else in the ~100MB feed is skipped during parse.
+_FAMILIES = ('p3', 'p4d', 'p4de', 'p5', 'g4dn', 'g5', 'g6', 'm6i')
+
+# instanceType prefix -> accelerator name (the feed's gpu field gives
+# the count; the model must come from the family).
+_GPU_BY_FAMILY = {
+    'p3': 'V100', 'p4d': 'A100', 'p4de': 'A100-80GB', 'p5': 'H100',
+    'g4dn': 'T4', 'g5': 'A10G', 'g6': 'L4',
+}
+
+DEFAULT_REGIONS = ('us-east-1', 'us-west-2', 'eu-west-1')
+# The bulk feed keys AZs only indirectly; emit the standard suffixes
+# (same static-topology simplification as fetch_gcp._REGION_ZONES).
+_ZONE_SUFFIXES = ('a', 'b', 'c')
+
+Transport = Callable[[str], Dict[str, Any]]
+
+
+def _default_transport(url: str) -> Dict[str, Any]:
+    import requests  # pylint: disable=import-outside-toplevel
+    resp = requests.get(url, timeout=300)
+    resp.raise_for_status()
+    return resp.json()
+
+
+def _family(instance_type: str) -> str:
+    return instance_type.split('.', 1)[0]
+
+
+def parse_region(payload: Dict[str, Any], region: str
+                 ) -> List[Dict[str, Any]]:
+    """One region's bulk feed -> catalog rows."""
+    products = payload.get('products', {})
+    terms = payload.get('terms', {}).get('OnDemand', {})
+
+    def ondemand_price(sku: str) -> Optional[float]:
+        for offer in terms.get(sku, {}).values():
+            for dim in offer.get('priceDimensions', {}).values():
+                usd = dim.get('pricePerUnit', {}).get('USD')
+                if usd is not None:
+                    try:
+                        price = float(usd)
+                    except ValueError:
+                        continue
+                    if price > 0:
+                        return price
+        return None
+
+    rows = []
+    for sku, product in products.items():
+        attrs = product.get('attributes', {})
+        itype = attrs.get('instanceType', '')
+        if not itype or _family(itype) not in _FAMILIES:
+            continue
+        # Shared-tenancy Linux on-demand boxes only (the reference's
+        # fetcher applies the same filters via the pricing API).
+        if (attrs.get('operatingSystem') != 'Linux' or
+                attrs.get('tenancy') not in ('Shared',) or
+                attrs.get('preInstalledSw', 'NA') != 'NA' or
+                attrs.get('capacitystatus') != 'Used'):
+            continue
+        price = ondemand_price(sku)
+        if price is None:
+            continue
+        try:
+            vcpus = int(attrs.get('vcpu', 0))
+            memory = float(
+                attrs.get('memory', '0').replace(' GiB', '').replace(
+                    ',', ''))
+            gpu_count = int(attrs.get('gpu', 0) or 0)
+        except ValueError:
+            continue
+        gpu_name = _GPU_BY_FAMILY.get(_family(itype), '') \
+            if gpu_count else ''
+        for suffix in _ZONE_SUFFIXES:
+            rows.append({
+                'InstanceType': itype,
+                'AcceleratorName': gpu_name,
+                'AcceleratorCount': gpu_count,
+                'vCPUs': vcpus,
+                'MemoryGiB': memory,
+                'Price': round(price, 4),
+                # Spot is not in the bulk feed: blank, never made up.
+                'SpotPrice': '',
+                'Region': region,
+                'AvailabilityZone': f'{region}{suffix}',
+            })
+    rows.sort(key=lambda r: (r['InstanceType'], r['AvailabilityZone']))
+    return rows
+
+
+def fetch(transport: Optional[Transport] = None,
+          regions: Optional[List[str]] = None,
+          output_dir: Optional[str] = None) -> Dict[str, str]:
+    """Fetch the bulk pricing feeds and (re)write aws_instances.csv.
+
+    Raises on network failure — callers keep serving the previous (or
+    embedded) catalog, exactly like the GCP fetcher.
+    """
+    transport = transport or _default_transport
+    regions = list(regions or DEFAULT_REGIONS)
+    rows: List[Dict[str, Any]] = []
+    for region in regions:
+        payload = transport(PRICING_URL.format(region=region))
+        rows.extend(parse_region(payload, region))
+    if not rows:
+        raise RuntimeError(
+            'AWS pricing parse produced 0 rows; refusing to overwrite '
+            'the catalog.')
+    if output_dir is None:
+        output_dir = os.path.join(common_utils.skytpu_home(), 'catalogs')
+    os.makedirs(output_dir, exist_ok=True)
+    path = os.path.join(output_dir, 'aws_instances.csv')
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        writer.writeheader()
+        writer.writerows(rows)
+    with open(f'{path}.meta.json', 'w', encoding='utf-8') as f:
+        json.dump({'fetched_at': time.time(), 'num_rows': len(rows)}, f)
+    logger.info(f'AWS catalog refreshed: {len(rows)} instance rows '
+                f'across {len(regions)} region(s).')
+    return {'aws_instances.csv': path}

@@ -30,11 +30,16 @@ logger = sky_logging.init_logger(__name__)
 
 _BUCKET_NAME_RE = re.compile(r'^[a-z0-9][a-z0-9._-]{1,61}[a-z0-9]$')
 
+# Every URL scheme that names a bucket store (single source of truth
+# for "is this file_mount source a bucket or a local path?" checks).
+BUCKET_URL_PREFIXES = ('gs://', 's3://', 'r2://', 'az://', 'local://')
+
 
 class StoreType(enum.Enum):
     GCS = 'GCS'
     S3 = 'S3'
     R2 = 'R2'
+    AZURE = 'AZURE'
     # Directory-backed "bucket" on this machine — pairs with the local
     # cloud/provisioner so file-mount translation and controller flows
     # are testable hermetically (no reference equivalent; the reference
@@ -50,6 +55,8 @@ class StoreType(enum.Enum):
             return cls.S3
         if scheme == 'r2':
             return cls.R2
+        if scheme == 'az':
+            return cls.AZURE
         if scheme == 'local':
             return cls.LOCAL
         raise ValueError(f'Unknown store URL scheme: {url!r}')
@@ -401,8 +408,150 @@ class R2Store(S3Store):
         return f'--endpoint {shlex.quote(self._endpoint_url)} '
 
 
+class AzureBlobStore(AbstractStore):
+    """Azure Blob container driven by the az CLI.
+
+    Parity: reference storage.py AzureBlobStore (:1080+ family).  The
+    'bucket name' is a container; the storage account comes from
+    $AZURE_STORAGE_ACCOUNT (or account_name=), matching the az CLI's
+    own convention.  Mounts use blobfuse2 (the reference's mounter).
+    URL scheme: az://container[/prefix].
+    """
+
+    store_type = StoreType.AZURE
+
+    def __init__(self, name: str, source: Optional[str] = None,
+                 prefix: str = '', region: str = 'eastus',
+                 account_name: Optional[str] = None):
+        super().__init__(name, source, prefix)
+        self.region = region
+        self.account_name = (account_name or
+                             os.environ.get('AZURE_STORAGE_ACCOUNT'))
+
+    def _account_args(self) -> List[str]:
+        if not self.account_name:
+            raise exceptions.StorageSpecError(
+                'Azure stores need a storage account: set '
+                '$AZURE_STORAGE_ACCOUNT or pass account_name=.')
+        return ['--account-name', self.account_name]
+
+    @property
+    def url(self) -> str:
+        if self.prefix:
+            return f'az://{self.name}/{self.prefix}'
+        return f'az://{self.name}'
+
+    def exists(self) -> bool:
+        res = _run(['az', 'storage', 'container', 'exists', '--name',
+                    self.name] + self._account_args())
+        return res.returncode == 0 and '"exists": true' in res.stdout
+
+    def create(self) -> None:
+        if self.exists():
+            return
+        res = _run(['az', 'storage', 'container', 'create', '--name',
+                    self.name] + self._account_args())
+        if res.returncode != 0:
+            raise exceptions.StorageBucketCreateError(
+                f'Failed to create {self.url}: {res.stderr.strip()}')
+
+    def upload(self, source: str) -> None:
+        source = os.path.expanduser(source)
+        staging_ctx = None
+        if os.path.isdir(source):
+            # Exclusion lists (.skyignore/.gitignore) are applied by
+            # staging the tree minus exclusions — upload-batch has no
+            # exclude flag (same end behavior as the other stores).
+            excluded = storage_utils.get_excluded_files(source)
+            if excluded:
+                import shutil  # pylint: disable=import-outside-toplevel
+                import tempfile  # pylint: disable=import-outside-toplevel
+                staging_ctx = tempfile.TemporaryDirectory()
+                staged = os.path.join(staging_ctx.name, 'tree')
+                norm = {os.path.normpath(e) for e in excluded}
+                src_root = source.rstrip('/')
+
+                def _ignore(dirpath, names):
+                    rel = os.path.relpath(dirpath, src_root)
+                    rel = '' if rel == '.' else rel
+                    return {n for n in names
+                            if os.path.normpath(os.path.join(rel, n))
+                            in norm}
+
+                shutil.copytree(src_root, staged, ignore=_ignore)
+                source = staged
+            cmd = ['az', 'storage', 'blob', 'upload-batch',
+                   '--destination', self.name, '--source', source,
+                   '--overwrite']
+            if self.prefix:
+                cmd += ['--destination-path', self.prefix]
+        else:
+            blob = (f'{self.prefix}/{os.path.basename(source)}'
+                    if self.prefix else os.path.basename(source))
+            cmd = ['az', 'storage', 'blob', 'upload', '--container-name',
+                   self.name, '--file', source, '--name', blob,
+                   '--overwrite']
+        try:
+            res = _run(cmd + self._account_args())
+        finally:
+            if staging_ctx is not None:
+                staging_ctx.cleanup()
+        if res.returncode != 0:
+            raise exceptions.StorageUploadError(
+                f'Upload {source} -> {self.url} failed: '
+                f'{res.stderr.strip()}')
+
+    def delete(self) -> None:
+        res = _run(['az', 'storage', 'container', 'delete', '--name',
+                    self.name] + self._account_args())
+        if res.returncode != 0 and 'ContainerNotFound' not in res.stderr:
+            raise exceptions.StorageBucketDeleteError(
+                f'Failed to delete {self.url}: {res.stderr.strip()}')
+
+    def mount_command(self, mount_path: str) -> str:
+        q = mounting_utils.quote_path
+        account = self._account_args()[1]
+        # blobfuse2 lives in the packages.microsoft.com repo, not stock
+        # apt (reference mounting_utils blobfuse path installs it the
+        # same way).  Auth: account key/SAS from the environment, or
+        # managed identity on Azure VMs.
+        install = (
+            'which blobfuse2 >/dev/null 2>&1 || { '
+            'curl -fsSL -o /tmp/msprod.deb https://packages.microsoft.com'
+            '/config/ubuntu/22.04/packages-microsoft-prod.deb && '
+            'sudo dpkg -i /tmp/msprod.deb && sudo apt-get update -y && '
+            'sudo apt-get install -y blobfuse2; }')
+        return (f'{install}; '
+                f'sudo mkdir -p {q(mount_path)} && '
+                f'sudo chmod 777 {q(mount_path)} && '
+                f'{{ mountpoint -q {q(mount_path)} || '
+                f'AZURE_STORAGE_ACCOUNT={shlex.quote(account)} '
+                f'AZURE_STORAGE_AUTH_TYPE='
+                f'"${{AZURE_STORAGE_AUTH_TYPE:-msi}}" '
+                f'blobfuse2 mount {q(mount_path)} '
+                f'--container-name {shlex.quote(self.name)}; }}')
+
+    def copy_down_command(self, dst_path: str) -> str:
+        q = mounting_utils.quote_path
+        account = self._account_args()[1]
+        cmd = (f'mkdir -p {q(dst_path)} && '
+               f'az storage blob download-batch --destination '
+               f'{q(dst_path)} --source {shlex.quote(self.name)} '
+               f'--account-name {shlex.quote(account)}')
+        if self.prefix:
+            # download-batch preserves blob paths; relocate the prefix
+            # CONTENTS to dst (same landing layout as gs://, s3://).
+            qp = shlex.quote(self.prefix)
+            cmd += (f' --pattern {shlex.quote(self.prefix + "/*")} && '
+                    f'if [ -d {q(dst_path)}/{qp} ]; then '
+                    f'cp -a {q(dst_path)}/{qp}/. {q(dst_path)}/ && '
+                    f'rm -rf {q(dst_path)}/{qp}; fi')
+        return cmd
+
+
 _STORE_CLASSES = {StoreType.GCS: GcsStore, StoreType.S3: S3Store,
-                  StoreType.R2: R2Store, StoreType.LOCAL: LocalStore}
+                  StoreType.R2: R2Store, StoreType.AZURE: AzureBlobStore,
+                  StoreType.LOCAL: LocalStore}
 
 
 class Storage:

@@ -94,7 +94,8 @@ class Task:
                 raise exceptions.InvalidTaskError(
                     f'file_mounts destination must be absolute or ~-based, '
                     f'got {dst!r}.')
-            if src.startswith(('gs://', 's3://', 'r2://', 'local://')):
+            from skypilot_tpu.data import storage as storage_lib  # pylint: disable=import-outside-toplevel
+            if src.startswith(storage_lib.BUCKET_URL_PREFIXES):
                 continue
             if not os.path.exists(os.path.expanduser(src)):
                 raise exceptions.InvalidTaskError(

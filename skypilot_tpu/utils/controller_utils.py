@@ -61,14 +61,14 @@ def maybe_translate_local_file_mounts_and_sync_up(
     later copies them down from the bucket.
     """
     has_local_file_mounts = any(
-        not src.startswith(('gs://', 's3://', 'r2://', 'local://'))
+        not src.startswith(storage_lib.BUCKET_URL_PREFIXES)
         for src in task.file_mounts.values())
     local_storage_srcs = {
         dst: storage for dst, storage in task.storage_mounts.items()
         if storage.source is not None and
         not storage.stores and
         not str(storage.source).startswith(
-            ('gs://', 's3://', 'r2://', 'local://'))
+            storage_lib.BUCKET_URL_PREFIXES)
     }
     if (task.workdir is None and not has_local_file_mounts and
             not local_storage_srcs):
@@ -112,7 +112,7 @@ def maybe_translate_local_file_mounts_and_sync_up(
     file_dsts_by_parent = collections.defaultdict(list)
     dir_mounts = []
     for dst, src in sorted(task.file_mounts.items()):
-        if src.startswith(('gs://', 's3://', 'r2://', 'local://')):
+        if src.startswith(storage_lib.BUCKET_URL_PREFIXES):
             new_file_mounts[dst] = src
             continue
         expanded = os.path.expanduser(src)

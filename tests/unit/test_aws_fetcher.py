@@ -1,0 +1,108 @@
+"""AWS catalog fetcher (public bulk pricing feed, injectable
+transport — no boto3, no network in tests)."""
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from skypilot_tpu import catalog
+from skypilot_tpu.catalog.data_fetchers import fetch_aws
+
+
+def _product(sku, itype, vcpu, mem, gpu=0, os_name='Linux',
+             tenancy='Shared', presw='NA', capacity='Used'):
+    return sku, {
+        'attributes': {
+            'instanceType': itype, 'vcpu': str(vcpu),
+            'memory': f'{mem} GiB', 'gpu': str(gpu),
+            'operatingSystem': os_name, 'tenancy': tenancy,
+            'preInstalledSw': presw, 'capacitystatus': capacity,
+        }
+    }
+
+
+def _term(sku, price):
+    return sku, {
+        f'{sku}.offer': {
+            'priceDimensions': {
+                f'{sku}.dim': {'pricePerUnit': {'USD': str(price)}}
+            }
+        }
+    }
+
+
+def _payload():
+    products = dict([
+        _product('SKU1', 'p4d.24xlarge', 96, 1152, gpu=8),
+        _product('SKU2', 'm6i.2xlarge', 8, 32),
+        # Filtered out: wrong OS, dedicated tenancy, SQL preinstalled,
+        # reserved capacity, uninteresting family.
+        _product('SKU3', 'p4d.24xlarge', 96, 1152, gpu=8,
+                 os_name='Windows'),
+        _product('SKU4', 'p4d.24xlarge', 96, 1152, gpu=8,
+                 tenancy='Dedicated'),
+        _product('SKU5', 'g5.xlarge', 4, 16, gpu=1, presw='SQL Std'),
+        _product('SKU6', 'p3.2xlarge', 8, 61, gpu=1,
+                 capacity='AllocatedCapacityReservation'),
+        _product('SKU7', 'c7g.xlarge', 4, 8),
+    ])
+    terms = {'OnDemand': dict([
+        _term('SKU1', 32.77), _term('SKU2', 0.384), _term('SKU3', 50.0),
+        _term('SKU4', 40.0), _term('SKU5', 1.5), _term('SKU6', 3.06),
+        _term('SKU7', 0.145),
+    ])}
+    return {'products': products, 'terms': terms}
+
+
+class TestParse:
+
+    def test_filters_and_maps(self):
+        rows = fetch_aws.parse_region(_payload(), 'us-east-1')
+        by_type = {r['InstanceType'] for r in rows}
+        assert by_type == {'p4d.24xlarge', 'm6i.2xlarge'}
+        p4d = [r for r in rows if r['InstanceType'] == 'p4d.24xlarge']
+        assert len(p4d) == 3  # one per zone suffix
+        assert p4d[0]['AcceleratorName'] == 'A100'
+        assert p4d[0]['AcceleratorCount'] == 8
+        assert p4d[0]['Price'] == pytest.approx(32.77)
+        assert p4d[0]['SpotPrice'] == ''  # never synthesized
+
+    def test_no_price_skipped(self):
+        payload = _payload()
+        del payload['terms']['OnDemand']['SKU1']
+        rows = fetch_aws.parse_region(payload, 'us-east-1')
+        assert all(r['InstanceType'] != 'p4d.24xlarge' for r in rows)
+
+
+class TestFetch:
+
+    def test_fetch_writes_csv_and_feeds_queries(self, tmp_path):
+        calls = []
+
+        def transport(url):
+            calls.append(url)
+            return _payload()
+
+        out = fetch_aws.fetch(transport, regions=['us-east-1'],
+                              output_dir=str(tmp_path))
+        assert os.path.exists(out['aws_instances.csv'])
+        meta = json.load(open(out['aws_instances.csv'] + '.meta.json',
+                              encoding='utf-8'))
+        assert meta['num_rows'] == 6
+        assert 'us-east-1' in calls[0]
+
+    def test_refresh_via_catalog_api(self, _isolated_home):
+        catalog.refresh('aws', transport=lambda url: _payload(),
+                        regions=['us-east-1'])
+        cost = catalog.get_hourly_cost('aws', 'p4d.24xlarge')
+        assert cost == pytest.approx(32.77)
+        ages = catalog.catalog_age_hours('aws')
+        assert ages['aws_instances.csv'] is not None
+
+    def test_empty_parse_refuses(self, tmp_path):
+        with pytest.raises(RuntimeError, match='refusing'):
+            fetch_aws.fetch(lambda url: {'products': {}, 'terms': {}},
+                            regions=['us-east-1'],
+                            output_dir=str(tmp_path))

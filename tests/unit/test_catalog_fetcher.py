@@ -156,7 +156,7 @@ class TestFetcher:
 
     def test_refresh_unknown_cloud(self):
         with pytest.raises(ValueError, match='No catalog fetcher'):
-            catalog.refresh('aws')
+            catalog.refresh('ibm')
 
 
 class TestTtl:

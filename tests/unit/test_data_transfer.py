@@ -52,6 +52,33 @@ class TestR2Store:
         assert storage.stores[storage_lib.StoreType.R2].prefix == 'path'
 
 
+class TestAzureBlobStore:
+
+    def test_from_url_and_prefix(self, monkeypatch):
+        monkeypatch.setenv('AZURE_STORAGE_ACCOUNT', 'acct')
+        assert (storage_lib.StoreType.from_url('az://cont/p') is
+                storage_lib.StoreType.AZURE)
+        storage = storage_lib.Storage(source='az://cont/prefix')
+        store = storage.stores[storage_lib.StoreType.AZURE]
+        assert store.url == 'az://cont/prefix'
+        assert store.prefix == 'prefix'
+
+    def test_requires_account(self, monkeypatch):
+        monkeypatch.delenv('AZURE_STORAGE_ACCOUNT', raising=False)
+        store = storage_lib.AzureBlobStore('cont')
+        with pytest.raises(exceptions.StorageSpecError, match='account'):
+            store._account_args()
+
+    def test_commands(self, monkeypatch):
+        monkeypatch.setenv('AZURE_STORAGE_ACCOUNT', 'acct')
+        store = storage_lib.AzureBlobStore('cont', prefix='ckpt')
+        copy = store.copy_down_command('/data')
+        assert 'download-batch' in copy and 'acct' in copy
+        assert "--pattern 'ckpt/*'" in copy
+        mount = store.mount_command('/data')
+        assert 'blobfuse2' in mount and 'cont' in mount
+
+
 class _FakeStsTransport:
     """Records calls; completes the operation after N polls."""
 
