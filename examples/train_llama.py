@@ -30,6 +30,9 @@ def main() -> None:
     parser.add_argument('--data', default=None,
                         help='SKYTOK1 token file (data.loader); random '
                              'tokens when omitted.')
+    parser.add_argument('--preflight', action='store_true',
+                        help='Probe ICI/DCN collectives before training '
+                             '(fail fast on a sick fabric).')
     args = parser.parse_args()
 
     import jax
@@ -50,6 +53,11 @@ def main() -> None:
                             sequence=args.sequence, tensor=args.tensor),
         num_slices=parallel.distributed.num_slices())
     print(f'mesh: {dict(mesh.shape)} over {jax.device_count()} devices')
+
+    if args.preflight:
+        from skypilot_tpu.parallel import preflight
+        preflight.check_collectives(mesh)
+        print('collective preflight: healthy')
 
     cfg = configs.get_config(args.model)
     state, shardings = create_train_state(

@@ -83,6 +83,24 @@ def test_flash_ragged_and_decode_shapes_lower():
             atol=3e-2)
 
 
+def test_ring_attention_lowers_on_tpu():
+    """The TPU-native SP path (ring attention -> per-hop flash kernel)
+    lowers and runs on hardware.  A 1-device mesh degenerates to a
+    single causal hop — the kernel call is identical to any ring
+    position's, which is exactly what round 2 found broken (VERDICT
+    §2.3: flash failed to lower, so SP never ran on TPUs)."""
+    from skypilot_tpu.ops.attention import mha_reference
+    from skypilot_tpu.ops.ring_attention import ring_attention
+    from skypilot_tpu.parallel import MeshConfig, build_mesh
+    mesh = build_mesh(MeshConfig(sequence=1), devices=jax.devices()[:1])
+    q, k, v = _qkv(h=4, s=256)
+    out = ring_attention(q, k, v, mesh=mesh)
+    ref = mha_reference(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=3e-2)
+
+
 def test_kv_cache_generation_on_tpu():
     """Prefill (flash kernel, q_len<k_len path) + jit'd decode loop
     produce greedy-parity tokens on the real chip."""

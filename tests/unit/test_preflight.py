@@ -1,0 +1,39 @@
+"""Collective preflight probe (SURVEY §7 item 9): fabric health checks
+before committing a job to a slice."""
+from __future__ import annotations
+
+import jax
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.parallel import MeshConfig, build_mesh
+from skypilot_tpu.parallel import preflight
+
+
+@pytest.fixture(scope='module')
+def mesh():
+    return build_mesh(MeshConfig(data=-1, tensor=2),
+                      devices=jax.devices()[:8])
+
+
+def test_probe_reports_all_nontrivial_axes(mesh):
+    results = preflight.probe_collectives(mesh, bandwidth_mb=1,
+                                          repeats=2)
+    assert set(results) == {'data', 'tensor'}
+    for stats in results.values():
+        assert stats['psum_latency_ms'] > 0
+        assert stats['psum_gbps'] > 0
+        assert stats['size'] in (2.0, 4.0)
+
+
+def test_check_passes_on_healthy_fabric(mesh):
+    preflight.check_collectives(
+        mesh, results=preflight.probe_collectives(mesh, bandwidth_mb=1,
+                                                  repeats=2))
+
+
+def test_check_fails_on_sick_fabric(mesh):
+    sick = {'data': {'size': 4.0, 'psum_latency_ms': 1e9,
+                     'psum_gbps': 1e-6}}
+    with pytest.raises(exceptions.SkyTpuError, match='preflight'):
+        preflight.check_collectives(mesh, results=sick)
