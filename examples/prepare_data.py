@@ -1,0 +1,67 @@
+"""Tokenize text into the SKYTOK format consumed by data.loader.
+
+    python examples/prepare_data.py --input corpus.txt \
+        --output tokens.bin --tokenizer meta-llama/Meta-Llama-3-8B
+
+Any HuggingFace tokenizer works (transformers is a baked-in
+dependency); the output feeds `train_llama.py --data tokens.bin` and
+the resumable host-sharded loader.
+"""
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--input', required=True,
+                        help='UTF-8 text file (one document per line '
+                             'or free-form).')
+    parser.add_argument('--output', required=True,
+                        help='SKYTOK token file to write.')
+    parser.add_argument('--tokenizer', default='bytes',
+                        help="HuggingFace tokenizer name/path, or "
+                             "'bytes' for dependency-free UTF-8 byte "
+                             "ids (0-255; works offline, pairs with "
+                             "vocab_size>=256 configs).")
+    parser.add_argument('--append-eos', action='store_true',
+                        help='Append EOS after each line.')
+    args = parser.parse_args()
+
+    import numpy as np
+
+    from skypilot_tpu.data import loader
+
+    ids = []
+    if args.tokenizer == 'bytes':
+        with open(args.input, 'rb') as f:
+            for raw in f:
+                line = raw.strip()
+                if not line:
+                    continue
+                ids.extend(line)
+                if args.append_eos:
+                    ids.append(0)  # NUL as EOS in byte mode
+    else:
+        from transformers import AutoTokenizer
+        tok = AutoTokenizer.from_pretrained(args.tokenizer)
+        with open(args.input, encoding='utf-8') as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                ids.extend(tok.encode(line))
+                if args.append_eos and tok.eos_token_id is not None:
+                    ids.append(tok.eos_token_id)
+    if not ids:
+        raise SystemExit(
+            f'{args.input} produced no tokens (empty or all-blank '
+            'file); nothing written.')
+    tokens = np.asarray(ids, dtype=np.int64)
+    loader.write_token_file(args.output, tokens)
+    print(f'{args.output}: {len(tokens):,} tokens '
+          f'(vocab max id {int(tokens.max())})')
+
+
+if __name__ == '__main__':
+    main()

@@ -341,6 +341,34 @@ def cancel(cluster, job_ids, all_jobs, yes):
                 all_jobs=all_jobs)
 
 
+# ------------------------------------------------------------ cost report
+
+
+@cli.command(name='cost-report')
+def cost_report():
+    """Accumulated cost + launch-overhead per cluster (incl. history).
+
+    Parity: reference `sky cost-report`; adds the time-to-first-step
+    column (the north-star denominator, usage_lib).
+    """
+    from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+    records = core.cost_report()
+    if not records:
+        click.echo('No clusters in history.')
+        return
+    rows = []
+    for r in records:
+        duration_h = (r.get('duration', 0) or 0) / 3600.0
+        ttfs = (f'{r["time_to_first_step"]:.1f}s'
+                if r.get('time_to_first_step') else '-')
+        status = r.get('status')
+        rows.append((r.get('name', '-'), f'{duration_h:.1f}h',
+                     f'${r.get("total_cost", 0.0):.2f}', ttfs,
+                     status.value if status else 'TERMINATED'))
+    _print_table(['NAME', 'UPTIME', 'COST', 'TIME-TO-FIRST-STEP',
+                  'STATUS'], rows)
+
+
 # ------------------------------------------------------------------ check
 
 

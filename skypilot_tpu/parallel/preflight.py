@@ -63,21 +63,31 @@ def probe_collectives(mesh, *, bandwidth_mb: float = 64.0,
 
         def _sharded(shape, axis=axis):
             sharding = jax.sharding.NamedSharding(mesh, P(axis))
-            rows_local = (shape[0] // jax.process_count()
-                          if shape[0] % jax.process_count() == 0
-                          else shape[0])
-            local = np.ones((rows_local, shape[1]), np.float32)
-            return jax.make_array_from_process_local_data(
-                sharding, local, shape)
+
+            def _block(index):
+                dims = [
+                    (s.stop if s.stop is not None else dim) -
+                    (s.start if s.start is not None else 0)
+                    for s, dim in zip(index, shape)
+                ]
+                return np.ones(dims, np.float32)
+
+            # make_array_from_callback asks each process only for the
+            # shards it addresses — correct on ANY process/axis layout
+            # (replicated axes, multi-slice meshes) where row-count
+            # heuristics are not.
+            return jax.make_array_from_callback(shape, sharding, _block)
 
         probe = jax.jit(functools.partial(
             jax.shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(),
             axis_names={axis}, check_vma=False)(_probe_fn))
 
         tiny = _sharded((n, 8))
-        # Per-shard payload sized so the all-reduced bytes match
-        # bandwidth_mb.
-        elems = max(8, int(bandwidth_mb * 1e6 / 4 / n))
+        # Each PARTICIPANT holds bandwidth_mb of payload (per-rank
+        # bytes are what ring all-reduce cost scales with — sizing by
+        # the global array would shrink wide axes' probes into
+        # latency-dominated noise).
+        elems = max(8, int(bandwidth_mb * 1e6 / 4))
         big = _sharded((n, elems))
         # Warm up (compile) outside the timed region.
         float(jax.device_get(probe(tiny)))
@@ -93,13 +103,15 @@ def probe_collectives(mesh, *, bandwidth_mb: float = 64.0,
             t0 = time.perf_counter()
             float(jax.device_get(probe(big)))
             bw.append(time.perf_counter() - t0)
-        # Ring all-reduce moves ~2x payload bytes per hop chain.
-        payload_gb = n * elems * 4 / 1e9
+        # Standard all-reduce bus bandwidth: each rank moves
+        # 2*(n-1)/n x its payload over its links.
+        per_rank_gb = elems * 4 / 1e9
+        busbw = (2 * (n - 1) / n) * per_rank_gb / max(
+            float(np.median(bw)), 1e-9)
         results[axis] = {
             'size': float(n),
             'psum_latency_ms': round(float(np.median(lat)) * 1e3, 3),
-            'psum_gbps': round(payload_gb * 2 /
-                               max(float(np.median(bw)), 1e-9), 3),
+            'psum_gbps': round(busbw, 3),
         }
         logger.info(f'preflight[{axis}]: {results[axis]}')
     return results

@@ -70,6 +70,16 @@ class TestEndToEnd:
         assert 'time-to-first-step' in result.output
         sky.down('usg2')
 
+    def test_cost_report_cli(self):
+        _launch_local('usgc')
+        import skypilot_tpu as sky_mod
+        sky_mod.down('usgc')
+        result = CliRunner().invoke(cli_mod.cli, ['cost-report'])
+        assert result.exit_code == 0, result.output
+        assert 'usgc' in result.output
+        assert 'TIME-TO-FIRST-STEP' in result.output
+        assert 'TERMINATED' in result.output
+
     def test_exec_records_separately(self):
         _launch_local('usg3')
         task = sky.Task(name='t2', run='echo again')
