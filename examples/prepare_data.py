@@ -32,16 +32,25 @@ def main() -> None:
 
     from skypilot_tpu.data import loader
 
-    ids = []
+    # Accumulate int64 CHUNKS, not a Python list of int objects — a
+    # multi-GB corpus would otherwise cost ~30 bytes per token in RAM.
+    chunks, buf = [], []
+
+    def _flush(force=False):
+        if buf and (force or len(buf) >= 1_000_000):
+            chunks.append(np.asarray(buf, dtype=np.int64))
+            buf.clear()
+
     if args.tokenizer == 'bytes':
         with open(args.input, 'rb') as f:
             for raw in f:
                 line = raw.strip()
                 if not line:
                     continue
-                ids.extend(line)
+                buf.extend(line)
                 if args.append_eos:
-                    ids.append(0)  # NUL as EOS in byte mode
+                    buf.append(0)  # NUL as EOS in byte mode
+                _flush()
     else:
         from transformers import AutoTokenizer
         tok = AutoTokenizer.from_pretrained(args.tokenizer)
@@ -50,14 +59,18 @@ def main() -> None:
                 line = line.strip()
                 if not line:
                     continue
-                ids.extend(tok.encode(line))
+                # No BOS/EOS injected mid-corpus; --append-eos is the
+                # only document separator.
+                buf.extend(tok.encode(line, add_special_tokens=False))
                 if args.append_eos and tok.eos_token_id is not None:
-                    ids.append(tok.eos_token_id)
-    if not ids:
+                    buf.append(tok.eos_token_id)
+                _flush()
+    _flush(force=True)
+    if not chunks:
         raise SystemExit(
             f'{args.input} produced no tokens (empty or all-blank '
             'file); nothing written.')
-    tokens = np.asarray(ids, dtype=np.int64)
+    tokens = np.concatenate(chunks)
     loader.write_token_file(args.output, tokens)
     print(f'{args.output}: {len(tokens):,} tokens '
           f'(vocab max id {int(tokens.max())})')

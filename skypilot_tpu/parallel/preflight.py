@@ -40,9 +40,10 @@ def probe_collectives(mesh, *, bandwidth_mb: float = 64.0,
     'psum_gbps': ..}} for every mesh axis with size > 1.
 
     Multi-host safe by construction: probe inputs are assembled with
-    `make_array_from_process_local_data` (mesh may span non-addressable
-    devices) and stay committed in their target sharding across the
-    timed iterations; each timed call returns only a REPLICATED SCALAR
+    `make_array_from_callback` (each process materialises exactly the
+    shards it addresses, on any process/axis layout) and stay committed
+    in their target sharding across the timed iterations; each timed
+    call returns only a REPLICATED SCALAR
     (the collective's payload never crosses PCIe), synced by a
     `device_get` of that scalar — airtight on every platform (bench.py's
     lesson) while keeping the timed region fabric-dominated.

@@ -1,0 +1,79 @@
+"""Full-stack distributed gang test: `sky launch` a 2-host cluster
+whose TASK does a cross-host jax.distributed psum.
+
+This is the complete SURVEY §7 'JAX-native job contract' demo on the
+hermetic local provisioner: the gang supervisor exports
+SKYTPU_HOST_RANK / SKYTPU_NUM_HOSTS / SKYTPU_COORDINATOR_ADDRESS, and
+user code just calls parallel.initialize_from_env() — the framework
+owns the bootstrap, XLA owns the collectives.
+"""
+from __future__ import annotations
+
+import textwrap
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_user_state
+
+_TASK_SCRIPT = textwrap.dedent("""
+    import os
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    # One device per host process: the psum below must cross HOSTS.
+    os.environ.pop('XLA_FLAGS', None)
+    import sys
+    sys.path.insert(0, '/root/repo')
+    import jax
+    import numpy as np
+    from skypilot_tpu.parallel import distributed
+
+    assert distributed.initialize_from_env(), 'no gang env present'
+    rank = distributed.host_rank()
+    n = jax.device_count()
+    assert jax.process_count() == 2, jax.process_count()
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ('data',))
+    P = jax.sharding.PartitionSpec
+    sharding = jax.sharding.NamedSharding(mesh, P('data'))
+    arr = jax.make_array_from_callback(
+        (n,), sharding,
+        lambda idx: np.asarray([1.0], dtype=np.float32))
+    out = jax.jit(jax.shard_map(
+        lambda x: jax.lax.psum(x, 'data'), mesh=mesh,
+        in_specs=P('data'), out_specs=P()))(arr)
+    got = float(jax.device_get(out.addressable_shards[0].data)[0])
+    assert got == float(n), (got, n)
+    print(f'GANG_PSUM_OK rank={rank} world={n}', flush=True)
+""")
+
+
+def test_gang_task_runs_distributed_psum(tmp_path, monkeypatch):
+    global_user_state.set_enabled_clouds(['local'])
+    script = tmp_path / 'dist_task.py'
+    script.write_text(_TASK_SCRIPT)
+    task = sky.Task(
+        name='distpsum', num_nodes=2,
+        file_mounts={'/tmp/skytpu_dist_task.py': str(script)},
+        run='python3 /tmp/skytpu_dist_task.py')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id = sky.launch(task, cluster_name='gdist', stream_logs=False)
+
+    deadline = time.time() + 120
+    status = None
+    while time.time() < deadline:
+        q = sky.queue('gdist')
+        status = next(r['status'] for r in q if r['job_id'] == job_id)
+        if status in ('SUCCEEDED', 'FAILED', 'FAILED_DRIVER'):
+            break
+        time.sleep(1.0)
+    import io
+    import contextlib
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        sky.tail_logs('gdist', job_id=job_id, follow=False)
+    logs = buf.getvalue()
+    assert status == 'SUCCEEDED', f'status={status}\n{logs[-3000:]}'
+    assert 'GANG_PSUM_OK rank=0 world=2' in logs
+    assert 'GANG_PSUM_OK rank=1 world=2' in logs
+    sky.down('gdist')

@@ -72,8 +72,7 @@ class TestEndToEnd:
 
     def test_cost_report_cli(self):
         _launch_local('usgc')
-        import skypilot_tpu as sky_mod
-        sky_mod.down('usgc')
+        sky.down('usgc')
         result = CliRunner().invoke(cli_mod.cli, ['cost-report'])
         assert result.exit_code == 0, result.output
         assert 'usgc' in result.output
