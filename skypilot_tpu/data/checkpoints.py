@@ -63,6 +63,59 @@ def latest_step(directory: Optional[str] = None) -> Optional[int]:
     return mgr.latest_step()
 
 
+def restore_params(directory: str,
+                   params_template: Any = None) -> Any:
+    """Restore just the PARAMS from the newest training checkpoint.
+
+    Inference-side counterpart of restore_or_init: training saves the
+    full TrainState (params + Adam moments ~= 3x the weight bytes);
+    servers only want weights, so only the 'params' subtree is read
+    from disk (every other leaf is an orbax PLACEHOLDER, skipped
+    entirely).  The restore template comes from the checkpoint's own
+    metadata; `params_template` is only the no-checkpoint fallback
+    return value (callers handle fresh-weight init).
+    """
+    import jax  # pylint: disable=import-outside-toplevel
+    import orbax.checkpoint as ocp  # pylint: disable=import-outside-toplevel
+    step = latest_step(directory)
+    if step is None:
+        logger.warning(f'No checkpoint under {directory}; returning '
+                       'the template unchanged.')
+        return params_template
+    mgr = ocp.CheckpointManager(
+        directory, item_handlers=ocp.PyTreeCheckpointHandler())
+    # Template comes from the CHECKPOINT's own metadata (no structure
+    # assumptions about the caller's tree); every leaf outside the
+    # 'params' subtree becomes PLACEHOLDER, which orbax skips entirely
+    # — optimizer moments never touch disk or RAM.
+    meta = mgr.item_metadata(step)
+
+    def _leaf(path, leaf):
+        if getattr(path[0], 'key', None) != 'params':
+            return ocp.PLACEHOLDER
+        return jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype)
+
+    template = jax.tree_util.tree_map_with_path(_leaf, meta)
+    restored = mgr.restore(step,
+                           args=ocp.args.PyTreeRestore(item=template))
+    logger.info(f'Restored params from step {step} of {directory}')
+    return _strip_partition_boxes(restored['params'])
+
+
+def _strip_partition_boxes(tree: Any) -> Any:
+    """Collapse flax partitioning-box levels in a restored tree.
+
+    Training saves boxed params (nn.with_logical_partitioning wraps
+    each leaf in a node that serializes as {'value': leaf}); inference
+    wants the plain arrays.
+    """
+    if isinstance(tree, dict):
+        if set(tree) == {'value'}:
+            return _strip_partition_boxes(tree['value'])
+        return {k: _strip_partition_boxes(v) for k, v in tree.items()}
+    return tree
+
+
 def restore_or_init(mgr: Any, state: Any) -> tuple:
     """(state, start_step): restore latest checkpoint if one exists.
 

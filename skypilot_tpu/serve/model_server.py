@@ -1,0 +1,182 @@
+"""Model server: the TPU inference path behind an HTTP endpoint.
+
+The reference serves whatever container the user brings; this framework
+also ships a native replica server wired to its own compute layer
+(models/decode.py — flash-kernel prefill + jit'd KV-cache decode), so
+`sky serve up` of a model is one YAML:
+
+    run: python -m skypilot_tpu.serve.model_server --model tiny \
+            --port $SKYTPU_SERVE_REPLICA_PORT
+
+Endpoints:
+  GET  /            -> health (the serve readiness probe target)
+  POST /generate    -> {"prompt_ids": [[..]], "max_new_tokens": N,
+                        "temperature": T, "top_k": K}
+                       => {"tokens": [[..]], "latency_ms": ..}
+
+Token-id in/out keeps the server dependency-free (tokenization happens
+client-side or via examples/prepare_data.py's conventions).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+class ModelServer:
+
+    def __init__(self, model: str, *, checkpoint_dir: Optional[str] = None,
+                 max_len: int = 512, max_batch: int = 8,
+                 seed: int = 0) -> None:
+        import jax
+        import flax.linen as nn
+
+        from skypilot_tpu.models import configs
+        from skypilot_tpu.models.transformer import Transformer
+
+        self.cfg = configs.get_config(model)
+        self.max_len = max_len
+        self.max_batch = max_batch
+        model_mod = Transformer(self.cfg)
+        init_tokens = jax.numpy.zeros((1, 8), jax.numpy.int32)
+        key = jax.random.PRNGKey(seed)
+
+        def _init(rng):
+            return nn.meta.unbox(
+                model_mod.init(rng, init_tokens)['params'])
+
+        from skypilot_tpu.data import checkpoints
+        if (checkpoint_dir and
+                checkpoints.latest_step(checkpoint_dir) is not None):
+            # Restore straight from checkpoint metadata: random weights
+            # are never materialised just to be overwritten (for an 8B
+            # model that would double peak memory and add minutes of
+            # startup), and optimizer moments are never read at all.
+            params = checkpoints.restore_params(checkpoint_dir, None)
+        else:
+            if checkpoint_dir:
+                logger.warning(
+                    f'No checkpoint under {checkpoint_dir}; serving '
+                    'FRESH random-init weights.')
+            else:
+                logger.warning('No --checkpoint-dir given; serving '
+                               'FRESH random-init weights.')
+            params = jax.jit(_init)(key)
+        self.params = params
+        # One generation at a time: KV caches are sized per call and
+        # the chip is exclusive anyway; the HTTP layer queues.
+        self._lock = threading.Lock()
+
+    def generate(self, prompt_ids, max_new_tokens: int,
+                 temperature: float = 0.0, top_k: int = 0) -> Any:
+        import jax.numpy as jnp
+
+        from skypilot_tpu.models import decode
+        prompt = jnp.asarray(prompt_ids, jnp.int32)
+        if prompt.ndim != 2:
+            raise ValueError('prompt_ids must be [batch, seq]')
+        if prompt.shape[0] > self.max_batch:
+            raise ValueError(
+                f'batch {prompt.shape[0]} > max_batch {self.max_batch}')
+        if prompt.shape[1] + max_new_tokens > self.max_len:
+            raise ValueError(
+                f'prompt {prompt.shape[1]} + new {max_new_tokens} '
+                f'exceeds max_len {self.max_len}')
+        sampling = decode.SamplingConfig(temperature=temperature,
+                                         top_k=top_k)
+        with self._lock:
+            tokens, new = decode.generate(
+                self.cfg, self.params, prompt,
+                max_new_tokens=max_new_tokens, max_len=self.max_len,
+                sampling=sampling)
+        del tokens
+        return new.tolist()
+
+
+def _make_handler(server: ModelServer):
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = 'HTTP/1.1'
+
+        def log_message(self, *args):
+            del args
+
+        def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header('Content-Type', 'application/json')
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            self._reply(200, {'status': 'ok',
+                              'model': f'{server.cfg.d_model}x'
+                                       f'{server.cfg.n_layers}'})
+
+        def do_POST(self):
+            if self.path != '/generate':
+                self._reply(404, {'error': 'unknown path'})
+                return
+            try:
+                length = int(self.headers.get('Content-Length', 0))
+                req = json.loads(self.rfile.read(length))
+                t0 = time.perf_counter()
+                tokens = server.generate(
+                    req['prompt_ids'],
+                    int(req.get('max_new_tokens', 16)),
+                    float(req.get('temperature', 0.0)),
+                    int(req.get('top_k', 0)))
+                self._reply(200, {
+                    'tokens': tokens,
+                    'latency_ms': round(
+                        (time.perf_counter() - t0) * 1e3, 1),
+                })
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._reply(400, {'error': str(e)})
+
+    return Handler
+
+
+def serve_forever(server: ModelServer, port: int = 0) -> int:
+    httpd = ThreadingHTTPServer(('0.0.0.0', port),
+                                _make_handler(server))
+    port = httpd.server_port
+    logger.info(f'model server on :{port}')
+    httpd.serve_forever()
+    return port
+
+
+def start_background(server: ModelServer, port: int = 0):
+    """Tests: start the server on a daemon thread; returns (port,
+    shutdown_fn)."""
+    httpd = ThreadingHTTPServer(('0.0.0.0', port),
+                                _make_handler(server))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd.server_port, httpd.shutdown
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='tiny')
+    parser.add_argument('--port', type=int, default=8080)
+    parser.add_argument('--max-len', type=int, default=512)
+    parser.add_argument('--max-batch', type=int, default=8)
+    parser.add_argument('--checkpoint-dir', default=None)
+    args = parser.parse_args()
+    server = ModelServer(args.model, checkpoint_dir=args.checkpoint_dir,
+                         max_len=args.max_len, max_batch=args.max_batch)
+    serve_forever(server, args.port)
+
+
+if __name__ == '__main__':
+    main()

@@ -57,11 +57,12 @@ def _free_port() -> int:
 
 def test_two_process_bootstrap_and_psum(tmp_path):
     port = _free_port()
+    repo_root = str(__import__('pathlib').Path(__file__).parents[2])
     env_base = {
         **os.environ,
         'SKYTPU_COORDINATOR_ADDRESS': f'127.0.0.1:{port}',
         'SKYTPU_NUM_HOSTS': '2',
-        'PYTHONPATH': '/root/repo',
+        'PYTHONPATH': repo_root,
     }
     env_base.pop('PALLAS_AXON_POOL_IPS', None)
     env_base.pop('XLA_FLAGS', None)  # one device per process

@@ -17,13 +17,15 @@ import pytest
 import skypilot_tpu as sky
 from skypilot_tpu import global_user_state
 
+_REPO_ROOT = str(__import__('pathlib').Path(__file__).parents[2])
+
 _TASK_SCRIPT = textwrap.dedent("""
     import os
     os.environ['JAX_PLATFORMS'] = 'cpu'
     # One device per host process: the psum below must cross HOSTS.
     os.environ.pop('XLA_FLAGS', None)
     import sys
-    sys.path.insert(0, '/root/repo')
+    sys.path.insert(0, __REPO_ROOT__)
     import jax
     import numpy as np
     from skypilot_tpu.parallel import distributed
@@ -45,7 +47,7 @@ _TASK_SCRIPT = textwrap.dedent("""
     got = float(jax.device_get(out.addressable_shards[0].data)[0])
     assert got == float(n), (got, n)
     print(f'GANG_PSUM_OK rank={rank} world={n}', flush=True)
-""")
+""").replace('__REPO_ROOT__', repr(_REPO_ROOT))
 
 
 def test_gang_task_runs_distributed_psum(tmp_path, monkeypatch):
@@ -58,22 +60,24 @@ def test_gang_task_runs_distributed_psum(tmp_path, monkeypatch):
         run='python3 /tmp/skytpu_dist_task.py')
     task.set_resources(sky.Resources(cloud='local'))
     job_id = sky.launch(task, cluster_name='gdist', stream_logs=False)
-
-    deadline = time.time() + 120
-    status = None
-    while time.time() < deadline:
-        q = sky.queue('gdist')
-        status = next(r['status'] for r in q if r['job_id'] == job_id)
-        if status in ('SUCCEEDED', 'FAILED', 'FAILED_DRIVER'):
-            break
-        time.sleep(1.0)
-    import io
-    import contextlib
-    buf = io.StringIO()
-    with contextlib.redirect_stdout(buf):
-        sky.tail_logs('gdist', job_id=job_id, follow=False)
-    logs = buf.getvalue()
-    assert status == 'SUCCEEDED', f'status={status}\n{logs[-3000:]}'
-    assert 'GANG_PSUM_OK rank=0 world=2' in logs
-    assert 'GANG_PSUM_OK rank=1 world=2' in logs
-    sky.down('gdist')
+    try:
+        deadline = time.time() + 120
+        status = None
+        while time.time() < deadline:
+            q = sky.queue('gdist')
+            status = next(r['status'] for r in q
+                          if r['job_id'] == job_id)
+            if status in ('SUCCEEDED', 'FAILED', 'FAILED_DRIVER'):
+                break
+            time.sleep(1.0)
+        import io
+        import contextlib
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            sky.tail_logs('gdist', job_id=job_id, follow=False)
+        logs = buf.getvalue()
+        assert status == 'SUCCEEDED', f'status={status}\n{logs[-3000:]}'
+        assert 'GANG_PSUM_OK rank=0 world=2' in logs
+        assert 'GANG_PSUM_OK rank=1 world=2' in logs
+    finally:
+        sky.down('gdist')
