@@ -6,11 +6,12 @@ from skypilot_tpu.clouds.cloud import Region
 from skypilot_tpu.clouds.cloud import Zone
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.gke import GKE
+from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.local import Local
 from skypilot_tpu.clouds.registry import CLOUD_REGISTRY
 from skypilot_tpu.clouds.registry import from_str
 
 __all__ = [
     'Cloud', 'CloudImplementationFeatures', 'ProvisionMode', 'Region', 'Zone',
-    'GCP', 'GKE', 'Local', 'CLOUD_REGISTRY', 'from_str',
+    'GCP', 'GKE', 'Kubernetes', 'Local', 'CLOUD_REGISTRY', 'from_str',
 ]

@@ -1,0 +1,200 @@
+"""Generic Kubernetes cloud: pods as cluster hosts (CPU / GPU).
+
+Parity: /root/reference/sky/clouds/kubernetes.py (642 LoC; pods stand in
+for VMs, `{cpus}CPU--{mem}GB` virtual instance types, nvidia.com/gpu
+requests) + /root/reference/sky/provision/kubernetes/.  Differences,
+TPU-first: TPU slices on Kubernetes go through the GKE cloud (node
+pools + google.com/tpu — the reference's k8s path has NO TPU support,
+utils.py:517 TODO); this cloud covers the complementary CPU/GPU pods on
+*any* kubeconfig context (kind, on-prem, EKS, ...).
+
+Virtual instance types are `k8s-<cpus>cpu-<mem>gb` — pods have no
+catalog; price is 0 (pre-owned capacity), matching the reference's
+treatment of k8s as free capacity that always wins cost ties when
+feasible.
+"""
+from __future__ import annotations
+
+import re
+import subprocess
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+_INSTANCE_RE = re.compile(r'^k8s-(\d+)cpu-(\d+)gb$')
+_DEFAULT_CPUS = 2
+_DEFAULT_MEM = 8
+
+# GPU resource key per vendor; node-selector handled via config
+# (`kubernetes.gpu_label`).  nvidia.com/gpu covers the common case.
+_GPU_RESOURCE_KEY = 'nvidia.com/gpu'
+
+
+def make_instance_type(cpus: int, mem_gb: int) -> str:
+    return f'k8s-{cpus}cpu-{mem_gb}gb'
+
+
+def parse_instance_type(instance_type: str) -> Optional[Tuple[int, int]]:
+    m = _INSTANCE_RE.match(instance_type or '')
+    if m is None:
+        return None
+    return int(m.group(1)), int(m.group(2))
+
+
+def _parse_plus(value: Optional[str], default: int) -> int:
+    """'4', '4+', 4.0 → 4; None → default."""
+    if value is None:
+        return default
+    s = str(value).strip().rstrip('+')
+    try:
+        return max(1, int(float(s)))
+    except ValueError:
+        return default
+
+
+class Kubernetes(cloud_lib.Cloud):
+    _REPR = 'Kubernetes'
+    PROVISIONER = 'kubernetes'
+    HAS_CATALOG = False
+
+    _CLOUD_UNSUPPORTED_FEATURES = {
+        cloud_lib.CloudImplementationFeatures.STOP:
+            'Pods are deleted, not stopped.',
+        cloud_lib.CloudImplementationFeatures.AUTOSTOP:
+            'Pods are deleted, not stopped.',
+        cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+            'Pods are not preemptible capacity.',
+        cloud_lib.CloudImplementationFeatures.QUEUED_RESOURCE:
+            'Pod capacity is immediate.',
+        cloud_lib.CloudImplementationFeatures.RESERVATION:
+            'No reservations for pods.',
+        cloud_lib.CloudImplementationFeatures.CLONE_DISK:
+            'No disks to clone for pods.',
+        cloud_lib.CloudImplementationFeatures.TPU:
+            'TPU-on-Kubernetes goes through the GKE cloud '
+            '(node pools + google.com/tpu).',
+        cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+            'Pod ephemeral storage has no disk tiers.',
+    }
+
+    # ------------------------------------------------------ regions/zones
+
+    def regions_with_offering(self, resources) -> List[cloud_lib.Region]:
+        del resources
+        context = config_lib.get_nested(('kubernetes', 'context'),
+                                        None) or 'in-context'
+        return [
+            cloud_lib.Region(context).set_zones(
+                [cloud_lib.Zone(context, context)])
+        ]
+
+    def validate_region_zone(self, region, zone):
+        # Region == kubeconfig context; any single name is accepted.
+        return region, zone
+
+    # ------------------------------------------------------------ pricing
+
+    def instance_type_to_hourly_cost(self, instance_type, use_spot,
+                                     region, zone) -> float:
+        del instance_type, use_spot, region, zone
+        return 0.0
+
+    def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
+                                    zone) -> float:
+        del accelerators, use_spot, region, zone
+        return 0.0
+
+    # -------------------------------------------------------- feasibility
+
+    def get_feasible_launchable_resources(self, resources):
+        if resources.tpu_spec is not None:
+            # TPU slices ride the GKE cloud.
+            return [], []
+        if resources.use_spot:
+            return [], []
+        if resources.instance_type:
+            if parse_instance_type(resources.instance_type) is None:
+                return [], [make_instance_type(_DEFAULT_CPUS, _DEFAULT_MEM)]
+            return [resources.copy(cloud=self)], []
+        cpus = _parse_plus(resources.cpus, _DEFAULT_CPUS)
+        mem = _parse_plus(resources.memory, _DEFAULT_MEM)
+        return [resources.copy(cloud=self,
+                               instance_type=make_instance_type(cpus, mem))
+                ], []
+
+    def get_default_instance_type(self, cpus, memory) -> Optional[str]:
+        return make_instance_type(_parse_plus(cpus, _DEFAULT_CPUS),
+                                  _parse_plus(memory, _DEFAULT_MEM))
+
+    # ------------------------------------------------------------ deploy
+
+    def make_deploy_resources_variables(self, resources, cluster_name,
+                                        region, zones) -> Dict[str, Any]:
+        parsed = parse_instance_type(
+            resources.instance_type or
+            make_instance_type(_DEFAULT_CPUS, _DEFAULT_MEM))
+        cpus, mem = parsed or (_DEFAULT_CPUS, _DEFAULT_MEM)
+        gpus = 0
+        gpu_type = None
+        accels = resources.accelerators
+        if accels:
+            gpu_type, gpus = next(iter(accels.items()))
+        return {
+            'cluster_name': cluster_name,
+            'region': region.name,
+            'zones': [z.name for z in (zones or [])],
+            'tpu': False,
+            'instance_type': resources.instance_type,
+            'cpus': cpus,
+            'memory_gb': mem,
+            'gpus': int(gpus),
+            'gpu_type': gpu_type,
+            'gpu_resource_key': config_lib.get_nested(
+                ('kubernetes', 'gpu_resource_key'), _GPU_RESOURCE_KEY),
+            'gpu_label': config_lib.get_nested(
+                ('kubernetes', 'gpu_label'), None),
+            'image_id': resources.image_id or config_lib.get_nested(
+                ('kubernetes', 'image'), None),
+            'namespace': config_lib.get_nested(
+                ('kubernetes', 'namespace'), 'default'),
+            'context': config_lib.get_nested(
+                ('kubernetes', 'context'), None),
+            'use_spot': False,
+        }
+
+    # --------------------------------------------------------- credentials
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        # Probe the SAME context provisioning will use: a configured
+        # `kubernetes.context` must be pinned here too, or the check
+        # reflects whatever ambient current-context happens to be.
+        argv = ['kubectl']
+        context = config_lib.get_nested(('kubernetes', 'context'), None)
+        if context:
+            argv += ['--context', context]
+        argv += ['cluster-info', '--request-timeout=5s']
+        try:
+            proc = subprocess.run(argv, capture_output=True, timeout=15,
+                                  check=False)
+            if proc.returncode == 0:
+                return True, None
+            return False, ('kubectl cannot reach a cluster: '
+                           f'{(proc.stderr or b"").decode()[-200:]}')
+        except (FileNotFoundError, subprocess.TimeoutExpired) as e:
+            return False, f'kubectl unavailable: {e}'
+
+    def get_current_user_identity(self) -> Optional[List[str]]:
+        try:
+            proc = subprocess.run(
+                ['kubectl', 'config', 'current-context'],
+                capture_output=True, text=True, timeout=10, check=False)
+            if proc.returncode == 0 and proc.stdout.strip():
+                return [proc.stdout.strip()]
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            pass
+        return None

@@ -11,6 +11,7 @@ from skypilot_tpu.clouds import cloud as cloud_lib
 from skypilot_tpu.clouds import docker
 from skypilot_tpu.clouds import gcp
 from skypilot_tpu.clouds import gke
+from skypilot_tpu.clouds import kubernetes
 from skypilot_tpu.clouds import local
 
 CLOUD_REGISTRY: Dict[str, cloud_lib.Cloud] = {
@@ -18,14 +19,20 @@ CLOUD_REGISTRY: Dict[str, cloud_lib.Cloud] = {
     'docker': docker.Docker(),
     'gcp': gcp.GCP(),
     'gke': gke.GKE(),
+    'kubernetes': kubernetes.Kubernetes(),
     'local': local.Local(),
 }
+
+# Aliases accepted by from_str (kept OUT of the registry dict so that
+# `sky check` and registry iteration see each cloud exactly once).
+_ALIASES = {'k8s': 'kubernetes'}
 
 
 def from_str(name: Optional[str]) -> Optional[cloud_lib.Cloud]:
     if name is None:
         return None
-    cloud = CLOUD_REGISTRY.get(name.lower())
+    key = name.lower()
+    cloud = CLOUD_REGISTRY.get(_ALIASES.get(key, key))
     if cloud is None:
         raise ValueError(
             f'Unknown cloud {name!r}. Available: {sorted(CLOUD_REGISTRY)}')

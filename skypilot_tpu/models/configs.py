@@ -22,6 +22,11 @@ class ModelConfig:
     param_dtype: jnp.dtype = jnp.float32
     remat: bool = True                # jax.checkpoint each layer
     scan_layers: bool = True          # lax.scan over layers (fast compile)
+    # lm_head matmul precision.  False runs the vocab projection on the
+    # MXU in the activation dtype (bf16) and upcasts the logits to f32
+    # immediately after — softmax/CE numerics stay f32 either way.  True
+    # forces the matmul itself into f32 (slower; the MXU is bf16-native).
+    logits_in_f32: bool = True
     # Mixture-of-Experts (0 experts = dense MLP).
     n_experts: int = 0
     expert_top_k: int = 2

@@ -205,9 +205,12 @@ class Transformer(nn.Module):
 
         x = RMSNorm(cfg.norm_eps, name='final_norm')(x)
         logits = nn.DenseGeneral(
-            cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+            cfg.vocab_size, use_bias=False,
+            dtype=jnp.float32 if cfg.logits_in_f32 else cfg.dtype,
             param_dtype=cfg.param_dtype,
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ('embed', 'vocab')),
             name='lm_head')(x)
-        return logits
+        # Logits leave in f32 regardless of matmul precision: the CE
+        # loss' log_softmax is always computed in f32.
+        return logits.astype(jnp.float32)

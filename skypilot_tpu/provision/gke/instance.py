@@ -19,8 +19,6 @@ TPU REST transport).
 from __future__ import annotations
 
 import json
-import os
-import shlex
 import subprocess
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -28,14 +26,15 @@ from typing import Any, Callable, Dict, List, Optional
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu.provision import common
+from skypilot_tpu.provision import kube_utils
 from skypilot_tpu.status_lib import ClusterStatus
 from skypilot_tpu.utils import command_runner
-from skypilot_tpu.utils import common_utils
 
 logger = sky_logging.init_logger(__name__)
 
 _LABEL = 'skytpu-cluster'
 _POD_IMAGE = 'python:3.11-slim'
+_META = 'gke_clusters'
 
 
 def _default_run_cli(argv: List[str],
@@ -58,47 +57,23 @@ def set_cli_runner(runner: Callable[..., subprocess.CompletedProcess]
 
 def _check(proc: subprocess.CompletedProcess, what: str,
            allow_missing: bool = False) -> subprocess.CompletedProcess:
-    if proc.returncode != 0:
-        stderr = proc.stderr or ''
-        if allow_missing and ('NotFound' in stderr or
-                              'not found' in stderr):
-            return proc
-        raise exceptions.ProvisionError(
-            f'{what} failed: {stderr.strip()[-500:]}')
-    return proc
+    return kube_utils.check(proc, what, allow_missing)
 
 
-# -------------------------------------------------------------- meta cache
-
-
-def _meta_dir() -> str:
-    return common_utils.ensure_dir(
-        os.path.join(common_utils.skytpu_home(), 'gke_clusters'))
-
-
-def _meta_path(name: str) -> str:
-    return os.path.join(_meta_dir(), f'{name}.json')
+# Meta cache + kubectl plumbing shared with the generic kubernetes
+# provisioner (provision/kube_utils.py).
 
 
 def _read_meta(name: str) -> Optional[Dict[str, Any]]:
-    try:
-        with open(_meta_path(name), encoding='utf-8') as f:
-            return json.load(f)
-    except (OSError, ValueError):
-        return None
+    return kube_utils.read_meta(_META, name)
 
 
 def _write_meta(name: str, meta: Dict[str, Any]) -> None:
-    with open(_meta_path(name), 'w', encoding='utf-8') as f:
-        json.dump(meta, f, indent=2)
+    kube_utils.write_meta(_META, name, meta)
 
 
 def _require_meta(name: str) -> Dict[str, Any]:
-    meta = _read_meta(name)
-    if meta is None:
-        raise exceptions.ClusterDoesNotExist(
-            f'No GKE metadata for cluster {name!r}.')
-    return meta
+    return kube_utils.require_meta(_META, name)
 
 
 # ------------------------------------------------------------------ pieces
@@ -164,11 +139,7 @@ def _pod_manifest(meta: Dict[str, Any], host_index: int) -> Dict[str, Any]:
 
 def _kubectl(meta: Dict[str, Any], *args: str,
              stdin: Optional[str] = None) -> subprocess.CompletedProcess:
-    base = ['kubectl']
-    if meta.get('context'):
-        base += ['--context', meta['context']]
-    base += ['-n', meta['namespace']]
-    return _run_cli(base + list(args), stdin=stdin)
+    return kube_utils.kubectl(_run_cli, meta, *args, stdin=stdin)
 
 
 def _ensure_credentials(meta: Dict[str, Any]) -> None:
@@ -228,14 +199,13 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
         head_instance_id=f'{config.cluster_name}-host0')
     for i in range(num_hosts):
         pod = _pod_manifest(meta, i)
-        name = pod['metadata']['name']
-        exists = _kubectl(meta, 'get', 'pod', name, '-o', 'name')
-        if exists.returncode == 0:
-            record.resumed_instance_ids.append(name)
-            continue
-        _check(_kubectl(meta, 'apply', '-f', '-',
-                        stdin=json.dumps(pod)), f'pod {name} create')
-        record.created_instance_ids.append(name)
+        # ensure_pod recreates pods stuck in a terminal phase (Failed
+        # after eviction/OOM) instead of "resuming" an unrunnable pod.
+        outcome = kube_utils.ensure_pod(_run_cli, meta, pod)
+        if outcome == 'resumed':
+            record.resumed_instance_ids.append(pod['metadata']['name'])
+        else:
+            record.created_instance_ids.append(pod['metadata']['name'])
     return record
 
 
@@ -270,18 +240,10 @@ def wait_capacity(cluster_name: str, timeout: float = 0) -> bool:
 
 def _pods(meta: Dict[str, Any],
           raise_on_error: bool = True) -> List[Dict[str, Any]]:
-    proc = _kubectl(meta, 'get', 'pods', '-l',
-                    f'{_LABEL}={meta["cluster_name"]}', '-o', 'json')
-    if proc.returncode != 0:
-        if raise_on_error:
-            # A transient kubectl failure must NOT read as "all pods
-            # gone" — callers (status refresh) would terminate the
-            # cluster record while the node pool keeps billing.
-            raise exceptions.ClusterStatusFetchingError(
-                f'kubectl get pods failed: '
-                f'{(proc.stderr or "").strip()[-300:]}')
-        return []
-    return json.loads(proc.stdout).get('items', [])
+    # Raises on kubectl failure by default: a transient error must not
+    # read as "all pods gone" while the node pool keeps billing.
+    return kube_utils.get_pods(_run_cli, meta, _LABEL,
+                               meta['cluster_name'], raise_on_error)
 
 
 def stop_instances(cluster_name: str, worker_only: bool = False) -> None:
@@ -302,10 +264,7 @@ def terminate_instances(cluster_name: str,
                      meta['pool_name'], '--cluster', meta['gke_cluster'],
                      '--location', meta['gke_location'], '--quiet']),
            'node-pool delete', allow_missing=True)
-    try:
-        os.remove(_meta_path(cluster_name))
-    except OSError:
-        pass
+    kube_utils.remove_meta(_META, cluster_name)
 
 
 def query_instances(cluster_name: str

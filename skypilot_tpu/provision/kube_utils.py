@@ -1,0 +1,133 @@
+"""Shared kubectl-CLI plumbing for the pod-based provisioners.
+
+The GKE (TPU node pools) and generic Kubernetes (CPU/GPU pods)
+provisioners drive clusters through the kubectl CLI with a JSON
+meta-file cache per skytpu cluster.  Each provisioner keeps its OWN
+module-level `_run_cli` seam (tests monkeypatch it per module); these
+helpers take that runner as their first argument so the logic lives
+once.
+
+Parity note: the reference implements this layer twice over the
+kubernetes SDK (sky/provision/kubernetes/instance.py) and adaptors;
+here the CLI is the adaptor and this module is the single copy.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import common_utils
+
+RunCli = Callable[..., subprocess.CompletedProcess]
+
+# Pod phases that will never become Running again (restartPolicy: Never).
+TERMINAL_PHASES = ('Failed', 'Succeeded', 'Unknown')
+
+
+def check(proc: subprocess.CompletedProcess, what: str,
+          allow_missing: bool = False) -> subprocess.CompletedProcess:
+    if proc.returncode != 0:
+        stderr = proc.stderr or ''
+        if allow_missing and ('NotFound' in stderr or
+                              'not found' in stderr):
+            return proc
+        raise exceptions.ProvisionError(
+            f'{what} failed: {stderr.strip()[-500:]}')
+    return proc
+
+
+# -------------------------------------------------------------- meta cache
+
+
+def meta_path(subdir: str, name: str) -> str:
+    d = common_utils.ensure_dir(
+        os.path.join(common_utils.skytpu_home(), subdir))
+    return os.path.join(d, f'{name}.json')
+
+
+def read_meta(subdir: str, name: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(meta_path(subdir, name), encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def write_meta(subdir: str, name: str, meta: Dict[str, Any]) -> None:
+    with open(meta_path(subdir, name), 'w', encoding='utf-8') as f:
+        json.dump(meta, f, indent=2)
+
+
+def require_meta(subdir: str, name: str) -> Dict[str, Any]:
+    meta = read_meta(subdir, name)
+    if meta is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'No {subdir} metadata for cluster {name!r}.')
+    return meta
+
+
+def remove_meta(subdir: str, name: str) -> None:
+    try:
+        os.remove(meta_path(subdir, name))
+    except OSError:
+        pass
+
+
+# ------------------------------------------------------------------ kubectl
+
+
+def kubectl(run_cli: RunCli, meta: Dict[str, Any], *args: str,
+            stdin: Optional[str] = None) -> subprocess.CompletedProcess:
+    """kubectl pinned to the cluster's context + namespace."""
+    base = ['kubectl']
+    if meta.get('context'):
+        base += ['--context', meta['context']]
+    base += ['-n', meta['namespace']]
+    return run_cli(base + list(args), stdin=stdin)
+
+
+def get_pods(run_cli: RunCli, meta: Dict[str, Any], label: str,
+             cluster_name: str,
+             raise_on_error: bool = True) -> List[Dict[str, Any]]:
+    """Pods labeled `<label>=<cluster_name>`.
+
+    A transient kubectl failure must NOT read as "all pods gone" —
+    status-refresh callers would drop a live cluster record — so by
+    default failures raise ClusterStatusFetchingError.
+    """
+    proc = kubectl(run_cli, meta, 'get', 'pods', '-l',
+                   f'{label}={cluster_name}', '-o', 'json')
+    if proc.returncode != 0:
+        if raise_on_error:
+            raise exceptions.ClusterStatusFetchingError(
+                f'kubectl get pods failed: '
+                f'{(proc.stderr or "").strip()[-300:]}')
+        return []
+    return json.loads(proc.stdout).get('items', [])
+
+
+def ensure_pod(run_cli: RunCli, meta: Dict[str, Any],
+               manifest: Dict[str, Any]) -> str:
+    """Create the pod if absent; recreate if it sits in a terminal
+    phase (a Failed/Succeeded/Unknown pod with restartPolicy: Never can
+    never run again — resuming it would wedge the cluster permanently).
+
+    Returns 'created' | 'resumed'.
+    """
+    name = manifest['metadata']['name']
+    probe = kubectl(run_cli, meta, 'get', 'pod', name, '-o', 'json')
+    if probe.returncode == 0:
+        try:
+            phase = json.loads(probe.stdout)['status'].get('phase')
+        except (ValueError, KeyError):
+            phase = None
+        if phase not in TERMINAL_PHASES:
+            return 'resumed'
+        kubectl(run_cli, meta, 'delete', 'pod', name,
+                '--ignore-not-found', '--wait=true')
+    check(kubectl(run_cli, meta, 'apply', '-f', '-',
+                  stdin=json.dumps(manifest)), f'pod {name} create')
+    return 'created'

@@ -1,0 +1,275 @@
+"""Generic Kubernetes provisioner: one pod per cluster host.
+
+Parity: /root/reference/sky/provision/kubernetes/instance.py (pods as
+VMs, 921 LoC via the kubernetes SDK) — rebuilt on the kubectl CLI with
+an injectable runner (`set_cli_runner`) so the lifecycle is hermetically
+unit-testable, the same seam as the docker and GKE provisioners.  TPU
+slices on k8s are the GKE provisioner's job; this one covers CPU/GPU
+pods on any kubeconfig context.  Shared kubectl/meta plumbing lives in
+provision/kube_utils.py (single copy for GKE + here).
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import kube_utils
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.utils import command_runner
+
+logger = sky_logging.init_logger(__name__)
+
+_LABEL = 'skytpu-cluster'
+_RANK_LABEL = 'skytpu-host'
+_DEFAULT_IMAGE = 'python:3.11-slim'
+_META = 'k8s_clusters'
+
+
+def _default_run_cli(argv: List[str],
+                     stdin: Optional[str] = None
+                     ) -> subprocess.CompletedProcess:
+    logger.debug(f'kubernetes: $ {" ".join(argv)}')
+    return subprocess.run(argv, input=stdin, capture_output=True,
+                          text=True, check=False, timeout=600)
+
+
+_run_cli: Callable[..., subprocess.CompletedProcess] = _default_run_cli
+
+
+def set_cli_runner(runner: Optional[Callable[..., Any]]) -> None:
+    global _run_cli
+    _run_cli = runner or _default_run_cli
+
+
+def _pods(meta: Dict[str, Any],
+          raise_on_error: bool = True) -> List[Dict[str, Any]]:
+    return kube_utils.get_pods(_run_cli, meta, _LABEL,
+                               meta['cluster_name'], raise_on_error)
+
+
+# ------------------------------------------------------------------ pods
+
+
+def _pod_manifest(meta: Dict[str, Any], host_index: int) -> Dict[str, Any]:
+    requests: Dict[str, str] = {
+        'cpu': str(meta['cpus']),
+        'memory': f'{meta["memory_gb"]}Gi',
+    }
+    limits: Dict[str, str] = {}
+    if meta.get('gpus'):
+        requests[meta['gpu_resource_key']] = str(meta['gpus'])
+        limits[meta['gpu_resource_key']] = str(meta['gpus'])
+    spec: Dict[str, Any] = {
+        'restartPolicy': 'Never',
+        'containers': [{
+            'name': 'host',
+            'image': meta['image'],
+            'command': ['bash', '-c', 'sleep infinity'],
+            'resources': {'requests': requests,
+                          **({'limits': limits} if limits else {})},
+        }],
+    }
+    # GPU node targeting: `kubernetes.gpu_label` config is 'key=value'
+    # (e.g. cloud.google.com/gke-accelerator=nvidia-tesla-a100 or a
+    # vendor-specific label on-prem).
+    if meta.get('gpus') and meta.get('gpu_label'):
+        key, _, value = meta['gpu_label'].partition('=')
+        spec['nodeSelector'] = {key: value}
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Pod',
+        'metadata': {
+            'name': f'{meta["cluster_name"]}-host{host_index}',
+            'namespace': meta['namespace'],
+            'labels': {_LABEL: meta['cluster_name'],
+                       _RANK_LABEL: str(host_index)},
+        },
+        'spec': spec,
+    }
+
+
+# ------------------------------------------------------------------ the API
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    deploy = config.deploy_vars
+    meta = {
+        'cluster_name': config.cluster_name,
+        'namespace': deploy.get('namespace') or 'default',
+        'context': deploy.get('context'),
+        'cpus': int(deploy.get('cpus') or 2),
+        'memory_gb': int(deploy.get('memory_gb') or 8),
+        'gpus': int(deploy.get('gpus') or 0),
+        'gpu_type': deploy.get('gpu_type'),
+        'gpu_resource_key': deploy.get('gpu_resource_key') or
+                            'nvidia.com/gpu',
+        'gpu_label': deploy.get('gpu_label'),
+        'image': deploy.get('image_id') or _DEFAULT_IMAGE,
+        'num_hosts': int(config.count or 1),
+    }
+    kube_utils.write_meta(_META, config.cluster_name, meta)
+
+    record = common.ProvisionRecord(
+        provider_name='kubernetes', cluster_name=config.cluster_name,
+        region=config.region, zone=meta.get('context') or 'in-context',
+        head_instance_id=f'{config.cluster_name}-host0')
+    for i in range(meta['num_hosts']):
+        pod = _pod_manifest(meta, i)
+        outcome = kube_utils.ensure_pod(_run_cli, meta, pod)
+        if outcome == 'resumed':
+            record.resumed_instance_ids.append(pod['metadata']['name'])
+        else:
+            record.created_instance_ids.append(pod['metadata']['name'])
+    return record
+
+
+def wait_instances(cluster_name: str, state: Optional[str] = None) -> None:
+    del state
+    meta = kube_utils.require_meta(_META, cluster_name)
+    deadline = time.time() + 600
+    while True:
+        pods = _pods(meta)
+        phases = [p['status'].get('phase') for p in pods]
+        if len(pods) >= meta['num_hosts'] and all(
+                ph == 'Running' for ph in phases):
+            return
+        bad = [ph for ph in phases if ph in kube_utils.TERMINAL_PHASES]
+        if bad:
+            # Fail fast: a terminal phase will never become Running and
+            # waiting out the deadline stalls failover.
+            raise exceptions.ProvisionError(
+                f'pods for {cluster_name} entered terminal phase(s) '
+                f'{bad} before Running.')
+        if time.time() > deadline:
+            raise exceptions.ProvisionError(
+                f'pods for {cluster_name} not Running: {phases}')
+        time.sleep(5)
+
+
+def wait_capacity(cluster_name: str, timeout: float = 0) -> bool:
+    del cluster_name, timeout
+    return True
+
+
+def stop_instances(cluster_name: str, worker_only: bool = False) -> None:
+    del worker_only
+    raise exceptions.NotSupportedError('Pods are deleted, not stopped.')
+
+
+def terminate_instances(cluster_name: str,
+                        worker_only: bool = False) -> None:
+    meta = kube_utils.read_meta(_META, cluster_name)
+    if meta is None:
+        return
+    if worker_only:
+        # Head is rank 0; delete every other rank.
+        for pod in _pods(meta, raise_on_error=False):
+            rank = pod['metadata']['labels'].get(_RANK_LABEL, '0')
+            if rank != '0':
+                kube_utils.kubectl(_run_cli, meta, 'delete', 'pod',
+                                   pod['metadata']['name'],
+                                   '--ignore-not-found', '--wait=false')
+        return
+    kube_utils.kubectl(_run_cli, meta, 'delete', 'pods', '-l',
+                       f'{_LABEL}={cluster_name}',
+                       '--ignore-not-found', '--wait=false')
+    kube_utils.kubectl(_run_cli, meta, 'delete', 'service',
+                       f'{cluster_name}-svc', '--ignore-not-found')
+    kube_utils.remove_meta(_META, cluster_name)
+
+
+def query_instances(cluster_name: str
+                    ) -> Dict[str, Optional[ClusterStatus]]:
+    meta = kube_utils.read_meta(_META, cluster_name)
+    if meta is None:
+        return {}
+    phase_map = {
+        'Pending': ClusterStatus.INIT,
+        'Running': ClusterStatus.UP,
+        'Succeeded': None,
+        'Failed': None,
+        'Unknown': None,
+    }
+    pods = {p['metadata']['name']: p for p in _pods(meta)}
+    out: Dict[str, Optional[ClusterStatus]] = {}
+    for i in range(meta['num_hosts']):
+        name = f'{cluster_name}-host{i}'
+        pod = pods.get(name)
+        out[name] = (phase_map.get(pod['status'].get('phase'))
+                     if pod else None)
+    return out
+
+
+def get_cluster_info(cluster_name: str,
+                     region: Optional[str] = None) -> common.ClusterInfo:
+    del region
+    meta = kube_utils.require_meta(_META, cluster_name)
+    instances = []
+    for pod in sorted(_pods(meta),
+                      key=lambda p: int(
+                          p['metadata']['labels'].get(_RANK_LABEL, 0))):
+        idx = int(pod['metadata']['labels'].get(_RANK_LABEL, 0))
+        instances.append(common.InstanceInfo(
+            instance_id=pod['metadata']['name'],
+            internal_ip=pod['status'].get('podIP', ''),
+            external_ip=None,
+            slice_id=0,
+            worker_id=idx,
+            tags={'namespace': meta['namespace']},
+        ))
+    return common.ClusterInfo(
+        provider_name='kubernetes',
+        cluster_name=cluster_name,
+        region=meta.get('context') or 'in-context',
+        zone=meta.get('context') or 'in-context',
+        instances=instances,
+        head_instance_id=instances[0].instance_id if instances else None,
+        ssh_user='root',
+        custom_metadata={'namespace': meta['namespace'],
+                         'context': meta.get('context')},
+    )
+
+
+def open_ports(cluster_name: str, ports: List[int]) -> None:
+    meta = kube_utils.require_meta(_META, cluster_name)
+    service = {
+        'apiVersion': 'v1',
+        'kind': 'Service',
+        'metadata': {'name': f'{cluster_name}-svc',
+                     'namespace': meta['namespace']},
+        'spec': {
+            'type': 'NodePort',
+            'selector': {_LABEL: cluster_name, _RANK_LABEL: '0'},
+            'ports': [{'name': f'p{p}', 'port': p, 'targetPort': p}
+                      for p in ports],
+        },
+    }
+    kube_utils.check(
+        kube_utils.kubectl(_run_cli, meta, 'apply', '-f', '-',
+                           stdin=json.dumps(service)),
+        'service create')
+
+
+def cleanup_ports(cluster_name: str) -> None:
+    meta = kube_utils.read_meta(_META, cluster_name)
+    if meta is None:
+        return
+    kube_utils.kubectl(_run_cli, meta, 'delete', 'service',
+                       f'{cluster_name}-svc', '--ignore-not-found')
+
+
+def get_command_runners(cluster_info: common.ClusterInfo,
+                        **kwargs: Any) -> List[Any]:
+    namespace = cluster_info.custom_metadata.get('namespace', 'default')
+    context = cluster_info.custom_metadata.get('context')
+    return [
+        command_runner.KubernetesCommandRunner(
+            node=(inst.instance_id, 0), namespace=namespace,
+            context=context, **kwargs)
+        for inst in cluster_info.instances
+    ]

@@ -205,6 +205,17 @@ def get_config_schema() -> Dict[str, Any]:
                     'context': {'type': 'string'},
                 },
             },
+            'kubernetes': {
+                'type': 'object',
+                'additionalProperties': False,
+                'properties': {
+                    'context': {'type': 'string'},
+                    'namespace': {'type': 'string'},
+                    'image': {'type': 'string'},
+                    'gpu_resource_key': {'type': 'string'},
+                    'gpu_label': {'type': 'string'},
+                },
+            },
             'nvidia_gpus': {
                 'type': 'object',
                 'additionalProperties': False,

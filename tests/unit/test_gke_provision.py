@@ -71,6 +71,8 @@ class FakeGkeCli:
         if args[0] == 'get' and args[1] == 'pod':
             name = args[2]
             if name in self.pods:
+                if '-o' in args and args[args.index('-o') + 1] == 'json':
+                    return self._done(0, json.dumps(self.pods[name]))
                 return self._done(0, f'pod/{name}')
             return self._done(1, stderr='not found')
         if args[0] == 'get' and args[1] == 'pods':
@@ -80,6 +82,9 @@ class FakeGkeCli:
                      if p['metadata']['labels'].get('skytpu-cluster') ==
                      cluster]
             return self._done(0, json.dumps({'items': items}))
+        if args[0] == 'delete' and args[1] == 'pod':
+            self.pods.pop(args[2], None)
+            return self._done()
         if args[0] == 'delete' and args[1] == 'pods':
             label = args[args.index('-l') + 1]
             cluster = label.split('=')[1]

@@ -1,0 +1,253 @@
+"""Generic Kubernetes provisioner + cloud tests against a faked kubectl.
+
+Mirrors the reference's k8s coverage goals
+(/root/reference/sky/provision/kubernetes/) hermetically: the kubectl
+CLI sits behind the injectable `set_cli_runner` seam.
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+from typing import Dict, List
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common as pcommon
+from skypilot_tpu.provision.kubernetes import instance as k8s
+from skypilot_tpu.status_lib import ClusterStatus
+
+
+class FakeKubectl:
+    """Emulates pods + services in memory."""
+
+    def __init__(self):
+        self.pods: Dict[str, dict] = {}
+        self.services: Dict[str, dict] = {}
+        self.commands: List[List[str]] = []
+
+    def __call__(self, argv, stdin=None):
+        self.commands.append(argv)
+        assert argv[0] == 'kubectl', argv
+        args = argv[argv.index('-n') + 2:]
+        if args[0] == 'apply':
+            obj = json.loads(stdin)
+            if obj['kind'] == 'Pod':
+                name = obj['metadata']['name']
+                obj['status'] = {'phase': 'Running',
+                                 'podIP': f'10.4.0.{len(self.pods) + 1}'}
+                self.pods[name] = obj
+            else:
+                self.services[obj['metadata']['name']] = obj
+            return self._done()
+        if args[0] == 'get' and args[1] == 'pod':
+            name = args[2]
+            if name in self.pods:
+                if '-o' in args and args[args.index('-o') + 1] == 'json':
+                    return self._done(0, json.dumps(self.pods[name]))
+                return self._done(0, f'pod/{name}')
+            return self._done(1, stderr='not found')
+        if args[0] == 'get' and args[1] == 'pods':
+            label = args[args.index('-l') + 1]
+            cluster = label.split('=')[1]
+            items = [p for p in self.pods.values()
+                     if p['metadata']['labels'].get('skytpu-cluster') ==
+                     cluster]
+            return self._done(0, json.dumps({'items': items}))
+        if args[0] == 'delete' and args[1] == 'pods':
+            label = args[args.index('-l') + 1]
+            cluster = label.split('=')[1]
+            self.pods = {
+                n: p for n, p in self.pods.items()
+                if p['metadata']['labels'].get('skytpu-cluster') != cluster}
+            return self._done()
+        if args[0] == 'delete' and args[1] == 'pod':
+            self.pods.pop(args[2], None)
+            return self._done()
+        if args[0] == 'delete' and args[1] == 'service':
+            self.services.pop(args[2], None)
+            return self._done()
+        raise AssertionError(argv)
+
+    @staticmethod
+    def _done(rc=0, stdout='', stderr=''):
+        return subprocess.CompletedProcess([], rc, stdout=stdout,
+                                           stderr=stderr)
+
+
+@pytest.fixture()
+def fake_cli(monkeypatch):
+    cli = FakeKubectl()
+    monkeypatch.setattr(k8s, '_run_cli', cli)
+    yield cli
+
+
+def _config(cluster='kc1', hosts=2, gpus=0, gpu_label=None,
+            context='kind-test'):
+    return pcommon.ProvisionConfig(
+        provider_name='kubernetes', cluster_name=cluster,
+        region=context, zones=[context], count=hosts,
+        deploy_vars={
+            'tpu': False,
+            'cpus': 4,
+            'memory_gb': 16,
+            'gpus': gpus,
+            'gpu_type': 'A100' if gpus else None,
+            'gpu_resource_key': 'nvidia.com/gpu',
+            'gpu_label': gpu_label,
+            'image_id': None,
+            'namespace': 'default',
+            'context': context,
+        })
+
+
+class TestKubernetesProvision:
+
+    def test_create_pods(self, fake_cli):
+        record = k8s.run_instances(_config())
+        assert record.created_instance_ids == ['kc1-host0', 'kc1-host1']
+        pod = fake_cli.pods['kc1-host0']
+        requests = pod['spec']['containers'][0]['resources']['requests']
+        assert requests == {'cpu': '4', 'memory': '16Gi'}
+        assert 'nodeSelector' not in pod['spec']
+
+        k8s.wait_instances('kc1')
+        info = k8s.get_cluster_info('kc1')
+        assert info.num_hosts == 2
+        assert [i.worker_id for i in info.instances] == [0, 1]
+        runners = k8s.get_command_runners(info)
+        assert runners[0].pod_name == 'kc1-host0'
+
+    def test_gpu_requests_and_node_selector(self, fake_cli):
+        k8s.run_instances(_config(
+            gpus=4, gpu_label='accel=nvidia-a100'))
+        pod = fake_cli.pods['kc1-host0']
+        res = pod['spec']['containers'][0]['resources']
+        assert res['requests']['nvidia.com/gpu'] == '4'
+        assert res['limits']['nvidia.com/gpu'] == '4'
+        assert pod['spec']['nodeSelector'] == {'accel': 'nvidia-a100'}
+
+    def test_idempotent(self, fake_cli):
+        k8s.run_instances(_config())
+        record = k8s.run_instances(_config())
+        assert record.created_instance_ids == []
+        assert record.resumed_instance_ids == ['kc1-host0', 'kc1-host1']
+
+    def test_terminal_phase_pod_recreated(self, fake_cli):
+        """A Failed pod (restartPolicy: Never) is deleted and recreated
+        on relaunch, not 'resumed' into a permanently wedged cluster."""
+        k8s.run_instances(_config())
+        fake_cli.pods['kc1-host1']['status']['phase'] = 'Failed'
+        record = k8s.run_instances(_config())
+        assert record.resumed_instance_ids == ['kc1-host0']
+        assert record.created_instance_ids == ['kc1-host1']
+        assert fake_cli.pods['kc1-host1']['status']['phase'] == 'Running'
+        k8s.wait_instances('kc1')
+
+    def test_query_terminate(self, fake_cli):
+        k8s.run_instances(_config())
+        assert k8s.query_instances('kc1') == {
+            'kc1-host0': ClusterStatus.UP, 'kc1-host1': ClusterStatus.UP}
+        k8s.terminate_instances('kc1')
+        assert fake_cli.pods == {}
+        assert k8s.query_instances('kc1') == {}
+
+    def test_terminate_worker_only(self, fake_cli):
+        k8s.run_instances(_config())
+        k8s.terminate_instances('kc1', worker_only=True)
+        assert set(fake_cli.pods) == {'kc1-host0'}
+
+    def test_stop_rejected(self, fake_cli):
+        k8s.run_instances(_config())
+        with pytest.raises(exceptions.NotSupportedError):
+            k8s.stop_instances('kc1')
+
+    def test_ports(self, fake_cli):
+        k8s.run_instances(_config())
+        k8s.open_ports('kc1', [8000])
+        svc = fake_cli.services['kc1-svc']
+        assert svc['spec']['ports'][0]['port'] == 8000
+        assert svc['spec']['selector']['skytpu-host'] == '0'
+        k8s.cleanup_ports('kc1')
+        assert fake_cli.services == {}
+
+    def test_context_pinned(self, fake_cli):
+        k8s.run_instances(_config())
+        for cmd in fake_cli.commands:
+            assert cmd[cmd.index('--context') + 1] == 'kind-test'
+
+    def test_query_raises_on_kubectl_failure(self, fake_cli, monkeypatch):
+        k8s.run_instances(_config())
+
+        def broken(argv, stdin=None):
+            if 'get' in argv and 'pods' in argv:
+                return subprocess.CompletedProcess(
+                    argv, 1, stdout='', stderr='connection refused')
+            return fake_cli(argv, stdin)
+
+        monkeypatch.setattr(k8s, '_run_cli', broken)
+        with pytest.raises(exceptions.ClusterStatusFetchingError):
+            k8s.query_instances('kc1')
+
+    def test_wait_fails_fast_on_terminal_pod(self, fake_cli):
+        k8s.run_instances(_config())
+        fake_cli.pods['kc1-host1']['status']['phase'] = 'Failed'
+        with pytest.raises(exceptions.ProvisionError, match='terminal'):
+            k8s.wait_instances('kc1')
+
+
+class TestKubernetesCloud:
+
+    def test_instance_type_grammar(self):
+        from skypilot_tpu.clouds import kubernetes as kcloud
+        assert kcloud.make_instance_type(4, 16) == 'k8s-4cpu-16gb'
+        assert kcloud.parse_instance_type('k8s-4cpu-16gb') == (4, 16)
+        assert kcloud.parse_instance_type('n1-standard-8') is None
+
+    def test_feasibility_cpu(self):
+        from skypilot_tpu import Resources
+        from skypilot_tpu.clouds import registry
+        cloud = registry.from_str('kubernetes')
+        launchable, _ = cloud.get_feasible_launchable_resources(
+            Resources(cloud='kubernetes', cpus='8+', memory='32'))
+        assert len(launchable) == 1
+        assert launchable[0].instance_type == 'k8s-8cpu-32gb'
+        assert launchable[0].get_cost(3600) == 0.0
+
+    def test_feasibility_rejects_tpu_and_spot(self):
+        from skypilot_tpu import Resources
+        from skypilot_tpu.clouds import registry
+        cloud = registry.from_str('k8s')  # alias resolves
+        tpus, _ = cloud.get_feasible_launchable_resources(
+            Resources(accelerators='tpu-v5e-8'))
+        assert tpus == []
+        spot, _ = cloud.get_feasible_launchable_resources(
+            Resources(cloud='kubernetes', use_spot=True))
+        assert spot == []
+
+    def test_gpu_deploy_vars(self, monkeypatch, _isolated_home):
+        from skypilot_tpu import Resources
+        from skypilot_tpu import config as config_lib
+        from skypilot_tpu.clouds import registry
+        cfg_path = _isolated_home / 'config.yaml'
+        cfg_path.write_text('kubernetes:\n  context: kind-test\n'
+                            '  namespace: ml\n')
+        monkeypatch.setenv('SKYTPU_CONFIG', str(cfg_path))
+        config_lib.reload_config()
+        try:
+            cloud = registry.from_str('kubernetes')
+            resources = Resources(cloud='kubernetes',
+                                  accelerators={'A100': 2})
+            launchable, _ = cloud.get_feasible_launchable_resources(
+                resources)
+            assert launchable
+            region = cloud.regions_with_offering(resources)[0]
+            assert region.name == 'kind-test'
+            deploy = cloud.make_deploy_resources_variables(
+                launchable[0], 'c1', region, region.zones)
+            assert deploy['gpus'] == 2
+            assert deploy['gpu_type'] == 'A100'
+            assert deploy['namespace'] == 'ml'
+            assert deploy['context'] == 'kind-test'
+        finally:
+            config_lib.reload_config()
