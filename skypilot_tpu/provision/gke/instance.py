@@ -214,15 +214,23 @@ def wait_instances(cluster_name: str, state: Optional[str] = None) -> None:
     meta = _require_meta(cluster_name)
     deadline = time.time() + 1800
     while True:
-        pods = _pods(meta)
+        try:
+            pods = _pods(meta)
+        except exceptions.ClusterStatusFetchingError:
+            # Transient apiserver blip mid-wait: keep polling until the
+            # deadline (the raise is for status-refresh callers).
+            if time.time() > deadline:
+                raise
+            time.sleep(10)
+            continue
         phases = [p['status'].get('phase') for p in pods]
         if len(pods) >= meta['num_hosts'] and all(
                 ph == 'Running' for ph in phases):
             return
         # Fail fast on terminal pod phases — waiting out the full
         # deadline would stall zone/cloud failover for 30 min.
-        bad = [ph for ph in phases
-               if ph in ('Failed', 'Succeeded', 'Unknown')]
+        # ('Unknown' is transient — node partitions self-heal.)
+        bad = [ph for ph in phases if ph in kube_utils.TERMINAL_PHASES]
         if bad:
             raise exceptions.ProvisionError(
                 f'GKE pods for {cluster_name} entered terminal '

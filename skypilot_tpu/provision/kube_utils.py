@@ -23,8 +23,10 @@ from skypilot_tpu.utils import common_utils
 
 RunCli = Callable[..., subprocess.CompletedProcess]
 
-# Pod phases that will never become Running again (restartPolicy: Never).
-TERMINAL_PHASES = ('Failed', 'Succeeded', 'Unknown')
+# Pod phases that will never become Running again (restartPolicy:
+# Never).  'Unknown' is NOT terminal: a node partition reports Unknown
+# and the pod returns to Running when the kubelet reconnects.
+TERMINAL_PHASES = ('Failed', 'Succeeded')
 
 
 def check(proc: subprocess.CompletedProcess, what: str,
@@ -126,8 +128,10 @@ def ensure_pod(run_cli: RunCli, meta: Dict[str, Any],
             phase = None
         if phase not in TERMINAL_PHASES:
             return 'resumed'
+        # Bounded wait: an unreachable node can never confirm deletion
+        # and an unbounded --wait would hang into the CLI timeout.
         kubectl(run_cli, meta, 'delete', 'pod', name,
-                '--ignore-not-found', '--wait=true')
+                '--ignore-not-found', '--wait=true', '--timeout=120s')
     check(kubectl(run_cli, meta, 'apply', '-f', '-',
                   stdin=json.dumps(manifest)), f'pod {name} create')
     return 'created'

@@ -133,7 +133,16 @@ def wait_instances(cluster_name: str, state: Optional[str] = None) -> None:
     meta = kube_utils.require_meta(_META, cluster_name)
     deadline = time.time() + 600
     while True:
-        pods = _pods(meta)
+        try:
+            pods = _pods(meta)
+        except exceptions.ClusterStatusFetchingError:
+            # Transient apiserver blip mid-wait: keep polling until the
+            # deadline instead of failing a provision that is seconds
+            # from Running (the raise is for status-refresh callers).
+            if time.time() > deadline:
+                raise
+            time.sleep(5)
+            continue
         phases = [p['status'].get('phase') for p in pods]
         if len(pods) >= meta['num_hosts'] and all(
                 ph == 'Running' for ph in phases):
@@ -175,9 +184,14 @@ def terminate_instances(cluster_name: str,
                                    pod['metadata']['name'],
                                    '--ignore-not-found', '--wait=false')
         return
-    kube_utils.kubectl(_run_cli, meta, 'delete', 'pods', '-l',
-                       f'{_LABEL}={cluster_name}',
-                       '--ignore-not-found', '--wait=false')
+    # A failed delete must NOT drop the meta record: the pods would
+    # keep consuming cluster capacity with nothing left to retry
+    # termination against.
+    kube_utils.check(
+        kube_utils.kubectl(_run_cli, meta, 'delete', 'pods', '-l',
+                           f'{_LABEL}={cluster_name}',
+                           '--ignore-not-found', '--wait=false'),
+        'pods delete', allow_missing=True)
     kube_utils.kubectl(_run_cli, meta, 'delete', 'service',
                        f'{cluster_name}-svc', '--ignore-not-found')
     kube_utils.remove_meta(_META, cluster_name)

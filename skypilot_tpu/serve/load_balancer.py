@@ -1,20 +1,25 @@
-"""Load balancer: HTTP reverse proxy over ready replicas.
+"""Load balancer: asyncio streaming HTTP reverse proxy over ready replicas.
 
 Parity: /root/reference/sky/serve/load_balancer.py:22-205
 (SkyServeLoadBalancer: syncs ready-replica URLs + reports request
 timestamps to the controller every sync interval :58-111; per-request
-replica pick + stream-proxy) and load_balancing_policies.py
-(RoundRobinPolicy).
+replica pick + stream-proxy via FastAPI/httpx) and
+load_balancing_policies.py.  Here the proxy is a single-threaded
+asyncio server (no per-connection threads): request bodies stream to
+the replica as they arrive and response bytes stream back chunk-by-
+chunk with backpressure — SSE / LLM token streams are never buffered.
+Policies: round_robin and least_connections (better for LLM serving,
+where generation lengths make request costs wildly uneven).
 """
 from __future__ import annotations
 
-import json
+import asyncio
 import os
+import ssl as ssl_lib
 import threading
 import time
-from http.server import BaseHTTPRequestHandler
-from http.server import ThreadingHTTPServer
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import urlsplit
 
 import requests
 
@@ -22,10 +27,14 @@ from skypilot_tpu import sky_logging
 
 logger = sky_logging.init_logger(__name__)
 
+# Hop-by-hop headers never forwarded (RFC 9110 §7.6.1).  Content-Length
+# and Transfer-Encoding ARE forwarded: the body bytes pass through with
+# their original framing.
 _HOP_HEADERS = {'connection', 'keep-alive', 'proxy-authenticate',
-                'proxy-authorization', 'te', 'trailers',
-                'transfer-encoding', 'upgrade', 'host',
-                'content-length'}
+                'proxy-authorization', 'te', 'trailers', 'upgrade'}
+_MAX_HEAD = 64 * 1024
+_UPSTREAM_CONNECT_TIMEOUT = 10.0
+_CHUNK = 64 * 1024
 
 
 def _lb_sync_interval() -> float:
@@ -36,6 +45,12 @@ class LoadBalancingPolicy:
 
     def select(self, urls: List[str]) -> Optional[str]:
         raise NotImplementedError
+
+    def acquire(self, url: str) -> None:  # request started
+        del url
+
+    def release(self, url: str) -> None:  # request finished (any outcome)
+        del url
 
 
 class RoundRobinPolicy(LoadBalancingPolicy):
@@ -56,15 +71,12 @@ class RoundRobinPolicy(LoadBalancingPolicy):
 
 
 class LeastConnectionsPolicy(LoadBalancingPolicy):
-    """Pick the replica with the fewest in-flight requests — better
-    than round-robin for LLM serving, where generation lengths (and so
-    request costs) are wildly uneven.  Callers must bracket the request
-    with acquire/release."""
+    """Pick the replica with the fewest in-flight requests."""
 
     NAME = 'least_connections'
 
     def __init__(self) -> None:
-        self._inflight: dict = {}
+        self._inflight: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     def select(self, urls: List[str]) -> Optional[str]:
@@ -101,7 +113,111 @@ def make_policy(name: Optional[str]) -> LoadBalancingPolicy:
     return POLICIES[name]()
 
 
+class _HeadTooLarge(Exception):
+    pass
+
+
+async def _read_head(reader: asyncio.StreamReader) -> bytes:
+    # The server's StreamReader limit is 2 * _MAX_HEAD, so readuntil
+    # raising LimitOverrunError IS the too-large signal.
+    try:
+        return await reader.readuntil(b'\r\n\r\n')
+    except asyncio.LimitOverrunError as e:
+        raise _HeadTooLarge() from e
+
+
+def _parse_head(head: bytes) -> Tuple[str, List[Tuple[str, str]]]:
+    """Returns (start_line, [(name, value), ...])."""
+    lines = head.decode('latin-1').split('\r\n')
+    headers = []
+    for line in lines[1:]:
+        if not line:
+            continue
+        name, _, value = line.partition(':')
+        headers.append((name.strip(), value.strip()))
+    return lines[0], headers
+
+
+def _body_framing(headers: List[Tuple[str, str]]) -> Tuple[str, int]:
+    """('length', N) | ('chunked', 0) | ('none', 0)."""
+    for name, value in headers:
+        lname = name.lower()
+        if lname == 'transfer-encoding' and 'chunked' in value.lower():
+            return 'chunked', 0
+        if lname == 'content-length':
+            try:
+                return 'length', int(value)
+            except ValueError:
+                return 'none', 0
+    return 'none', 0
+
+
+async def _relay_body(reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter,
+                      framing: Tuple[str, int]) -> None:
+    """Stream a message body with its original framing preserved."""
+    kind, length = framing
+    if kind == 'length':
+        remaining = length
+        while remaining > 0:
+            chunk = await reader.read(min(_CHUNK, remaining))
+            if not chunk:
+                raise ConnectionError('body truncated')
+            writer.write(chunk)
+            await writer.drain()
+            remaining -= len(chunk)
+    elif kind == 'chunked':
+        # Pass chunks through verbatim while tracking the framing so we
+        # know where the body ends (incl. the trailing CRLF / trailers).
+        while True:
+            size_line = await reader.readline()
+            writer.write(size_line)
+            try:
+                size = int(size_line.strip().split(b';')[0], 16)
+            except ValueError as e:
+                raise ConnectionError(f'bad chunk size {size_line!r}') from e
+            if size == 0:
+                # Trailers (if any) end with an empty line.
+                while True:
+                    trailer = await reader.readline()
+                    writer.write(trailer)
+                    if trailer in (b'\r\n', b'\n', b''):
+                        break
+                await writer.drain()
+                return
+            remaining = size + 2  # chunk data + CRLF
+            while remaining > 0:
+                chunk = await reader.read(min(_CHUNK, remaining))
+                if not chunk:
+                    raise ConnectionError('chunk truncated')
+                writer.write(chunk)
+                remaining -= len(chunk)
+            await writer.drain()
+
+
+async def _relay_until_eof(reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+    while True:
+        chunk = await reader.read(_CHUNK)
+        if not chunk:
+            return
+        writer.write(chunk)
+        await writer.drain()  # backpressure: never buffer a token stream
+
+
+class _UpstreamError(Exception):
+    """Failure before any response byte was relayed → client gets 502."""
+
+
+def _simple_response(status: int, reason: str, body: bytes) -> bytes:
+    return (f'HTTP/1.1 {status} {reason}\r\n'
+            f'Content-Length: {len(body)}\r\n'
+            f'Content-Type: text/plain\r\n'
+            f'Connection: close\r\n\r\n').encode() + body
+
+
 class SkyServeLoadBalancer:
+    """Streams requests to replicas; reports QPS to the controller."""
 
     def __init__(self, controller_url: str, port: int = 0,
                  policy: Optional[LoadBalancingPolicy] = None) -> None:
@@ -112,7 +228,9 @@ class SkyServeLoadBalancer:
         self.request_timestamps: List[float] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
-        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._started = threading.Event()
 
     # ------------------------------------------------------ controller sync
 
@@ -137,93 +255,144 @@ class SkyServeLoadBalancer:
 
     # -------------------------------------------------------------- proxy
 
-    def _make_handler(self):
-        lb = self
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        target = None
+        try:
+            head = await asyncio.wait_for(_read_head(reader), timeout=60)
+            start_line, headers = _parse_head(head)
+            with self._lock:
+                self.request_timestamps.append(time.time())
+                urls = list(self.ready_urls)
+            target = self.policy.select(urls)
+            if target is None:
+                writer.write(_simple_response(
+                    503, 'Service Unavailable', b'No ready replicas.'))
+                await writer.drain()
+                return
+            # acquire/release bracket EVERYTHING that can raise (bad
+            # framing, disconnects mid-stream) or in-flight counts leak
+            # and least_connections starves the replica forever.
+            self.policy.acquire(target)
+            try:
+                await self._proxy_to(target, reader, writer, start_line,
+                                     headers)
+            finally:
+                self.policy.release(target)
+        except _HeadTooLarge:
+            try:
+                writer.write(_simple_response(
+                    431, 'Request Header Fields Too Large',
+                    b'Request head exceeds limit.'))
+                await writer.drain()
+            except (ConnectionError, OSError):
+                pass
+        except _UpstreamError as e:
+            # No response byte was relayed yet — a 502 is still clean.
+            try:
+                writer.write(_simple_response(
+                    502, 'Bad Gateway', f'Bad gateway: {e}'.encode()))
+                await writer.drain()
+            except (ConnectionError, OSError):
+                pass
+        except (asyncio.IncompleteReadError, asyncio.TimeoutError,
+                ConnectionError, ValueError, OSError):
+            # Client went away or the stream broke mid-relay: close.
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = 'HTTP/1.1'
-
-            def log_message(self, *args):
-                del args
-
-            def _proxy(self):
-                with lb._lock:  # pylint: disable=protected-access
-                    lb.request_timestamps.append(time.time())
-                    urls = list(lb.ready_urls)
-                target = lb.policy.select(urls)
-                if target is None:
-                    body = b'No ready replicas.'
-                    self.send_response(503)
-                    self.send_header('Content-Length', str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                    return
-                # acquire/release must bracket EVERYTHING that can
-                # raise (bad Content-Length, client disconnects mid
-                # stream, ...) or in-flight counts leak and
-                # least_connections starves the replica forever.
-                if isinstance(lb.policy, LeastConnectionsPolicy):
-                    lb.policy.acquire(target)
-                try:
-                    self._proxy_to(target)
-                finally:
-                    if isinstance(lb.policy, LeastConnectionsPolicy):
-                        lb.policy.release(target)
-
-            def _proxy_to(self, target):
-                length = int(self.headers.get('Content-Length', 0))
-                data = self.rfile.read(length) if length else None
-                headers = {k: v for k, v in self.headers.items()
-                           if k.lower() not in _HOP_HEADERS}
-                try:
-                    resp = requests.request(
-                        self.command, target + self.path, data=data,
-                        headers=headers, stream=True, timeout=300)
-                except requests.RequestException as e:
-                    body = f'Bad gateway: {e}'.encode()
-                    self.send_response(502)
-                    self.send_header('Content-Length', str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                    return
-                self.send_response(resp.status_code)
-                for key, value in resp.headers.items():
-                    if key.lower() not in _HOP_HEADERS:
-                        self.send_header(key, value)
-                # Stream chunks through (SSE / LLM token streams must
-                # not be buffered); HTTP/1.1 + chunked framing.
-                self.send_header('Transfer-Encoding', 'chunked')
-                self.end_headers()
-                try:
-                    for chunk in resp.iter_content(chunk_size=65536):
-                        if not chunk:
-                            continue
-                        self.wfile.write(
-                            f'{len(chunk):x}\r\n'.encode())
-                        self.wfile.write(chunk)
-                        self.wfile.write(b'\r\n')
-                    self.wfile.write(b'0\r\n\r\n')
-                except (BrokenPipeError, ConnectionResetError):
-                    pass
-
-            do_GET = _proxy
-            do_POST = _proxy
-            do_PUT = _proxy
-            do_DELETE = _proxy
-            do_PATCH = _proxy
-            do_HEAD = _proxy
-
-        return Handler
+    async def _proxy_to(self, target: str, creader: asyncio.StreamReader,
+                        cwriter: asyncio.StreamWriter, start_line: str,
+                        headers: List[Tuple[str, str]]) -> None:
+        split = urlsplit(target)
+        host = split.hostname or '127.0.0.1'
+        use_tls = split.scheme == 'https'
+        port = split.port or (443 if use_tls else 80)
+        try:
+            ureader, uwriter = await asyncio.wait_for(
+                asyncio.open_connection(
+                    host, port,
+                    ssl=ssl_lib.create_default_context() if use_tls
+                    else None),
+                timeout=_UPSTREAM_CONNECT_TIMEOUT)
+        except (OSError, asyncio.TimeoutError) as e:
+            raise _UpstreamError(f'cannot reach replica {target}: {e}') \
+                from e
+        try:
+            # Expect: 100-continue — the client waits for our go-ahead
+            # before sending the body (curl does this for large bodies);
+            # answer it ourselves and strip the header upstream, since
+            # we relay the body unconditionally.
+            expects_continue = any(
+                n.lower() == 'expect' and '100-continue' in v.lower()
+                for n, v in headers)
+            if expects_continue:
+                cwriter.write(b'HTTP/1.1 100 Continue\r\n\r\n')
+                await cwriter.drain()
+            # Rewrite the head: drop hop-by-hop, pin Host, close after.
+            out = [start_line]
+            out.extend(f'{n}: {v}' for n, v in headers
+                       if n.lower() not in _HOP_HEADERS and
+                       n.lower() not in ('host', 'expect'))
+            out.append(f'Host: {host}:{port}')
+            out.append('Connection: close')
+            try:
+                uwriter.write(
+                    ('\r\n'.join(out) + '\r\n\r\n').encode('latin-1'))
+                await uwriter.drain()
+                # Stream the request body with its original framing.
+                await _relay_body(creader, uwriter, _body_framing(headers))
+                first = await ureader.read(_CHUNK)
+            except (OSError, ConnectionError) as e:
+                raise _UpstreamError(
+                    f'replica {target} dropped the request: {e}') from e
+            if not first:
+                raise _UpstreamError(f'replica {target} sent no response')
+            # Stream the response verbatim until upstream EOF: with
+            # Connection: close the replica's EOF is the end marker, so
+            # no response re-framing is needed and first bytes reach the
+            # client as soon as the replica emits them.
+            cwriter.write(first)
+            await cwriter.drain()
+            await _relay_until_eof(ureader, cwriter)
+        finally:
+            try:
+                uwriter.close()
+                await uwriter.wait_closed()
+            except (ConnectionError, OSError):
+                pass
 
     # ---------------------------------------------------------------- run
 
+    def _run_loop(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def serve():
+            self._server = await asyncio.start_server(
+                self._handle, '0.0.0.0', self.port, limit=2 * _MAX_HEAD)
+            self.port = self._server.sockets[0].getsockname()[1]
+            self._started.set()
+            async with self._server:
+                await self._server.serve_forever()
+
+        try:
+            self._loop.run_until_complete(serve())
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self._loop.close()
+
     def start(self) -> int:
         """Start proxy + sync threads; returns the bound LB port."""
-        self._httpd = ThreadingHTTPServer(('0.0.0.0', self.port),
-                                          self._make_handler())
-        self.port = self._httpd.server_port
-        threading.Thread(target=self._httpd.serve_forever,
-                         daemon=True).start()
+        threading.Thread(target=self._run_loop, daemon=True).start()
+        if not self._started.wait(10):
+            raise RuntimeError('load balancer failed to bind')
         threading.Thread(target=self._sync_loop, daemon=True).start()
         logger.info(f'load balancer on :{self.port} -> '
                     f'{self.controller_url}')
@@ -231,5 +400,6 @@ class SkyServeLoadBalancer:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._httpd is not None:
-            self._httpd.shutdown()
+        loop, server = self._loop, self._server
+        if loop is not None and server is not None:
+            loop.call_soon_threadsafe(server.close)

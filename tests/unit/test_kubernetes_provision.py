@@ -144,6 +144,32 @@ class TestKubernetesProvision:
         assert fake_cli.pods['kc1-host1']['status']['phase'] == 'Running'
         k8s.wait_instances('kc1')
 
+    def test_unknown_phase_is_transient_not_terminal(self, fake_cli):
+        """'Unknown' (node partition) self-heals; the pod must be
+        resumed, not deleted/recreated."""
+        k8s.run_instances(_config())
+        fake_cli.pods['kc1-host1']['status']['phase'] = 'Unknown'
+        record = k8s.run_instances(_config())
+        assert 'kc1-host1' in record.resumed_instance_ids
+
+    def test_terminate_failure_keeps_meta(self, fake_cli, monkeypatch):
+        """If kubectl delete fails, the meta record must survive so
+        termination can be retried (else pods leak unrecoverably)."""
+        k8s.run_instances(_config())
+
+        def broken(argv, stdin=None):
+            if 'delete' in argv and 'pods' in argv:
+                return subprocess.CompletedProcess(
+                    argv, 1, stdout='', stderr='apiserver unreachable')
+            return fake_cli(argv, stdin)
+
+        monkeypatch.setattr(k8s, '_run_cli', broken)
+        with pytest.raises(exceptions.ProvisionError):
+            k8s.terminate_instances('kc1')
+        monkeypatch.setattr(k8s, '_run_cli', fake_cli)
+        k8s.terminate_instances('kc1')  # retry succeeds
+        assert k8s.query_instances('kc1') == {}
+
     def test_query_terminate(self, fake_cli):
         k8s.run_instances(_config())
         assert k8s.query_instances('kc1') == {

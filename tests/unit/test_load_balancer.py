@@ -1,0 +1,236 @@
+"""Asyncio streaming load-balancer tests (no controller needed: the
+replica set is injected into `ready_urls` directly; sync-loop behavior
+is covered by tests/unit/test_serve.py's controller e2e)."""
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+
+import pytest
+import requests
+
+from skypilot_tpu.serve import load_balancer as lb_lib
+
+
+class _Replica(http.server.ThreadingHTTPServer):
+    """Tiny replica: echoes method/path/body; /stream sends timed SSE
+    chunks; /slow sleeps before responding."""
+
+    def __init__(self):
+        super().__init__(('127.0.0.1', 0), _Handler)
+        self.chunk_times = []
+
+    @property
+    def url(self):
+        return f'http://127.0.0.1:{self.server_address[1]}'
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    protocol_version = 'HTTP/1.1'
+
+    def log_message(self, *args):
+        del args
+
+    def _echo(self):
+        length = int(self.headers.get('Content-Length', 0))
+        body = self.rfile.read(length) if length else b''
+        payload = json.dumps({
+            'method': self.command,
+            'path': self.path,
+            'body': body.decode(),
+            'port': self.server.server_address[1],
+        }).encode()
+        self.send_response(200)
+        self.send_header('Content-Length', str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):
+        if self.path == '/stream':
+            self.send_response(200)
+            self.send_header('Content-Type', 'text/event-stream')
+            self.send_header('Transfer-Encoding', 'chunked')
+            self.end_headers()
+            for i in range(3):
+                chunk = f'data: tok{i}\n\n'.encode()
+                self.wfile.write(f'{len(chunk):x}\r\n'.encode() + chunk +
+                                 b'\r\n')
+                self.wfile.flush()
+                self.server.chunk_times.append(time.time())
+                time.sleep(0.15)
+            self.wfile.write(b'0\r\n\r\n')
+            return
+        self._echo()
+
+    do_POST = _echo
+
+
+@pytest.fixture()
+def replica():
+    server = _Replica()
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server
+    server.shutdown()
+
+
+@pytest.fixture()
+def lb(replica):
+    balancer = lb_lib.SkyServeLoadBalancer('http://127.0.0.1:1',
+                                           policy=lb_lib.make_policy(None))
+    balancer.ready_urls = [replica.url]
+    port = balancer.start()
+    yield balancer, port
+    balancer.stop()
+
+
+class TestStreamingProxy:
+
+    def test_get_roundtrip(self, lb):
+        _, port = lb
+        resp = requests.get(f'http://127.0.0.1:{port}/hello?q=1',
+                            timeout=10)
+        assert resp.status_code == 200
+        data = resp.json()
+        assert data['method'] == 'GET'
+        assert data['path'] == '/hello?q=1'
+
+    def test_post_body_forwarded(self, lb):
+        _, port = lb
+        resp = requests.post(f'http://127.0.0.1:{port}/infer',
+                             data=b'{"prompt": "hi"}', timeout=10)
+        assert resp.json()['body'] == '{"prompt": "hi"}'
+
+    def test_streaming_chunks_arrive_incrementally(self, lb):
+        """First SSE chunk must reach the client while the replica is
+        still emitting — the proxy may not buffer the response."""
+        _, port = lb
+        arrive_times = []
+        with requests.get(f'http://127.0.0.1:{port}/stream', stream=True,
+                          timeout=10) as resp:
+            for line in resp.iter_lines():
+                if line:
+                    arrive_times.append((time.time(), line))
+        assert [l for _, l in arrive_times] == [
+            b'data: tok0', b'data: tok1', b'data: tok2']
+        # tok0 arrived at least one inter-chunk gap before the end.
+        assert arrive_times[-1][0] - arrive_times[0][0] > 0.2
+
+    def test_503_when_no_replicas(self, lb):
+        balancer, port = lb
+        balancer.ready_urls = []
+        resp = requests.get(f'http://127.0.0.1:{port}/', timeout=10)
+        assert resp.status_code == 503
+
+    def test_502_when_replica_dead(self, lb):
+        balancer, port = lb
+        balancer.ready_urls = ['http://127.0.0.1:9']  # discard port
+        resp = requests.get(f'http://127.0.0.1:{port}/', timeout=10)
+        assert resp.status_code == 502
+
+    def test_round_robin_spreads(self, lb, replica):
+        balancer, port = lb
+        second = _Replica()
+        threading.Thread(target=second.serve_forever, daemon=True).start()
+        try:
+            balancer.ready_urls = [replica.url, second.url]
+            ports = {requests.get(f'http://127.0.0.1:{port}/',
+                                  timeout=10).json()['port']
+                     for _ in range(4)}
+            assert ports == {replica.server_address[1],
+                             second.server_address[1]}
+        finally:
+            second.shutdown()
+
+    def test_request_timestamps_recorded(self, lb):
+        balancer, port = lb
+        requests.get(f'http://127.0.0.1:{port}/', timeout=10)
+        assert balancer.request_timestamps
+
+    def test_431_on_oversized_head(self, lb):
+        _, port = lb
+        resp = requests.get(f'http://127.0.0.1:{port}/',
+                            headers={'X-Big': 'x' * (150 * 1024)},
+                            timeout=10)
+        assert resp.status_code == 431
+
+    def test_expect_100_continue(self, lb):
+        """A client that waits for '100 Continue' before sending its
+        body must get the interim response (curl's default for large
+        POSTs); the proxy answers it itself."""
+        import socket
+        _, port = lb
+        body = b'{"p": 1}'
+        with socket.create_connection(('127.0.0.1', port),
+                                      timeout=10) as sock:
+            sock.sendall(
+                b'POST /infer HTTP/1.1\r\n'
+                b'Host: x\r\n'
+                b'Expect: 100-continue\r\n'
+                b'Content-Length: ' + str(len(body)).encode() +
+                b'\r\n\r\n')
+            sock.settimeout(10)
+            interim = sock.recv(1024)
+            assert b'100 Continue' in interim
+            sock.sendall(body)
+            data = b''
+            while True:
+                chunk = sock.recv(4096)
+                if not chunk:
+                    break
+                data += chunk
+        assert b'200' in data.split(b'\r\n', 1)[0]
+        assert b'{\\"p\\": 1}' in data or b'"body": "{' in data
+
+
+class TestLeastConnections:
+
+    def test_select_prefers_idle(self):
+        policy = lb_lib.LeastConnectionsPolicy()
+        urls = ['http://a', 'http://b']
+        policy.acquire('http://a')
+        assert policy.select(urls) == 'http://b'
+        policy.acquire('http://b')
+        policy.acquire('http://b')
+        assert policy.select(urls) == 'http://a'
+        policy.release('http://a')
+        policy.release('http://a')  # over-release never goes negative
+        assert policy.select(urls) == 'http://a'
+
+    def test_inflight_released_after_proxy(self, replica):
+        policy = lb_lib.LeastConnectionsPolicy()
+        balancer = lb_lib.SkyServeLoadBalancer('http://127.0.0.1:1',
+                                               policy=policy)
+        balancer.ready_urls = [replica.url]
+        port = balancer.start()
+        try:
+            requests.get(f'http://127.0.0.1:{port}/', timeout=10)
+            deadline = time.time() + 5
+            while time.time() < deadline and policy._inflight:  # pylint: disable=protected-access
+                time.sleep(0.05)
+            assert not policy._inflight  # pylint: disable=protected-access
+        finally:
+            balancer.stop()
+
+    def test_released_even_on_dead_replica(self):
+        policy = lb_lib.LeastConnectionsPolicy()
+        balancer = lb_lib.SkyServeLoadBalancer('http://127.0.0.1:1',
+                                               policy=policy)
+        balancer.ready_urls = ['http://127.0.0.1:9']
+        port = balancer.start()
+        try:
+            resp = requests.get(f'http://127.0.0.1:{port}/', timeout=10)
+            assert resp.status_code == 502
+            assert not policy._inflight  # pylint: disable=protected-access
+        finally:
+            balancer.stop()
+
+    def test_make_policy(self):
+        assert isinstance(lb_lib.make_policy('least_connections'),
+                          lb_lib.LeastConnectionsPolicy)
+        assert isinstance(lb_lib.make_policy('round_robin'),
+                          lb_lib.RoundRobinPolicy)
+        with pytest.raises(ValueError):
+            lb_lib.make_policy('bogus')
