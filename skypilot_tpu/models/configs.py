@@ -21,6 +21,12 @@ class ModelConfig:
     dtype: jnp.dtype = jnp.bfloat16   # activations/compute
     param_dtype: jnp.dtype = jnp.float32
     remat: bool = True                # jax.checkpoint each layer
+    # What the layer checkpoint saves: 'full' recomputes everything in
+    # the backward (min HBM, ~4/3 flops); 'dots' saves non-batch matmul
+    # outputs (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    # — most of the recompute gone for a modest activation footprint).
+    # Ignored when remat=False (everything saved; fastest if it fits).
+    remat_policy: str = 'full'
     scan_layers: bool = True          # lax.scan over layers (fast compile)
     # lm_head matmul precision.  False runs the vocab projection on the
     # MXU in the activation dtype (bf16) and upcasts the logits to f32

@@ -35,6 +35,17 @@ def _rope(x, positions, theta: float):
     return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 
+def _remat_policy(cfg: ModelConfig):
+    """ModelConfig.remat_policy → jax.checkpoint policy (None = save
+    nothing, i.e. full recompute)."""
+    if cfg.remat_policy == 'full':
+        return None
+    if cfg.remat_policy == 'dots':
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f'Unknown remat_policy {cfg.remat_policy!r}; '
+                     "have 'full', 'dots'.")
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-5
 
@@ -188,7 +199,8 @@ class Transformer(nn.Module):
         if cfg.scan_layers:
             scan_target = _ScannedLayer
             if cfg.remat:
-                scan_target = nn.remat(scan_target, prevent_cse=False)
+                scan_target = nn.remat(scan_target, prevent_cse=False,
+                                       policy=_remat_policy(cfg))
             x, _ = nn.scan(
                 scan_target,
                 variable_axes={'params': 0},
@@ -198,7 +210,8 @@ class Transformer(nn.Module):
                 metadata_params={nn.PARTITION_NAME: 'layers'},
             )(cfg, self.mesh, name='layers')(x, positions)
         else:
-            layer_cls = nn.remat(DecoderLayer) if cfg.remat else DecoderLayer
+            layer_cls = (nn.remat(DecoderLayer, policy=_remat_policy(cfg))
+                         if cfg.remat else DecoderLayer)
             for i in range(cfg.n_layers):
                 x = layer_cls(cfg, self.mesh, name=f'layer_{i}')(
                     x, positions)

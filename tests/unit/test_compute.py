@@ -166,6 +166,35 @@ class TestModel:
         assert n1 == n2
         assert out1.shape == (1, 16, cfg.vocab_size)
 
+    def test_remat_policy_and_logits_dtype_parity(self):
+        """remat full/dots/off and lm_head matmul precision change the
+        schedule, never the math: loss and grads must agree."""
+        cfg0 = configs.get_config('tiny', remat=True)  # exercise policies
+        tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0,
+                                    cfg0.vocab_size)
+
+        def loss_and_gradsum(cfg):
+            model = Transformer(cfg)
+            params = model.init(jax.random.PRNGKey(0), tokens)
+
+            def loss(p):
+                logits = model.apply(p, tokens)
+                return jnp.mean(jax.nn.log_softmax(logits)[..., 0])
+
+            l, g = jax.value_and_grad(loss)(params)
+            gsum = jax.tree_util.tree_reduce(
+                lambda a, b: a + jnp.sum(jnp.abs(b)), g, 0.0)
+            return float(l), float(gsum)
+
+        ref = loss_and_gradsum(cfg0)
+        for kw in ({'remat_policy': 'dots'}, {'remat': False},
+                   {'logits_in_f32': False}):
+            got = loss_and_gradsum(cfg0.replace(**kw))
+            assert got[0] == pytest.approx(ref[0], rel=1e-5), kw
+            assert got[1] == pytest.approx(ref[1], rel=1e-4), kw
+        with pytest.raises(ValueError):
+            loss_and_gradsum(cfg0.replace(remat_policy='bogus'))
+
     def test_sharded_train_step_loss_matches_single(self):
         cfg = configs.get_config('tiny')
         inputs = jax.random.randint(jax.random.PRNGKey(5), (8, 32), 0,

@@ -101,3 +101,75 @@ def test_infeasible_raises_with_fuzzy_hint(enable_all_infra):
         resources_lib.Resources(cloud='gcp', accelerators='A100:5'))
     with pytest.raises(exceptions.ResourcesUnavailableError):
         Optimizer.optimize(_single_task_dag(task), quiet=True)
+
+
+def _diamond_dag():
+    """A → {B, C} → D (non-chain)."""
+    with dag_lib.Dag('diamond') as dag:
+        a = task_lib.Task(name='prep')
+        b = task_lib.Task(name='train-b')
+        c = task_lib.Task(name='train-c')
+        d = task_lib.Task(name='eval')
+        for t in (a, b, c, d):
+            dag.add(t)
+        dag.add_edge(a, b)
+        dag.add_edge(a, c)
+        dag.add_edge(b, d)
+        dag.add_edge(c, d)
+    return dag, (a, b, c, d)
+
+
+def test_general_dag_cost_plan(enable_all_infra):
+    """Non-chain DAGs are optimized (parity: reference _optimize_by_ilp)
+    instead of rejected; every task gets best_resources."""
+    dag, tasks = _diamond_dag()
+    assert not dag.is_chain()
+    for t in tasks:
+        t.set_resources({
+            resources_lib.Resources(cloud='gcp', accelerators='tpu-v5e-8'),
+            resources_lib.Resources(cloud='gcp', accelerators='A100:8'),
+        })
+    Optimizer.optimize(dag, quiet=True)
+    for t in tasks:
+        assert t.best_resources is not None
+        # v5e is strictly cheaper, so exact search must pick it everywhere.
+        assert t.best_resources.tpu_spec is not None
+
+
+def test_general_dag_egress_prefers_colocation(enable_all_infra):
+    """With large intermediate outputs, cross-cloud hops must be avoided
+    even when the remote candidate is marginally cheaper per hour."""
+    dag, tasks = _diamond_dag()
+    a, b, c, d = tasks
+    gcp = resources_lib.Resources(cloud='gcp', accelerators='tpu-v5e-8')
+    aws = resources_lib.Resources(cloud='aws', accelerators='A10G:8')
+    for t in tasks:
+        t.set_resources({gcp, aws})
+        t.estimated_outputs_size_gigabytes = 500.0
+    Optimizer.optimize(dag, quiet=True)
+    clouds = {t.best_resources.cloud.name for t in tasks}
+    assert len(clouds) == 1, f'split placement pays egress: {clouds}'
+
+
+def test_general_dag_time_target(enable_all_infra):
+    """TIME minimizes the critical path: the slow branch must get the
+    fast accelerator when the estimator says it dominates."""
+    dag, tasks = _diamond_dag()
+    a, b, c, d = tasks
+    v5e = resources_lib.Resources(cloud='gcp', accelerators='tpu-v5e-8')
+    a100 = resources_lib.Resources(cloud='gcp', accelerators='A100:8')
+    for t in tasks:
+        t.set_resources({v5e, a100})
+        t.set_time_estimator(
+            lambda r: 600.0 if r.accelerators and
+            'A100' in (r.accelerators or {}) else 6000.0)
+    Optimizer.optimize(dag, minimize=OptimizeTarget.TIME, quiet=True)
+    for t in tasks:
+        assert 'A100' in t.best_resources.accelerators
+
+
+def test_general_dag_local_search_path(enable_all_infra, monkeypatch):
+    """Above the exact-search limit the coordinate-descent path must
+    still converge to colocation (the same answer exact search gives)."""
+    monkeypatch.setattr(optimizer_lib, '_EXACT_LIMIT', 1)
+    test_general_dag_egress_prefers_colocation(enable_all_infra)
