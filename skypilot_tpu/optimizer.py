@@ -316,14 +316,50 @@ def _optimize_general(
             for task in order
         }
         # Coordinate descent: re-pick one task at a time against the
-        # rest until a full sweep makes no improvement.
+        # rest until a full sweep makes no improvement.  The COST
+        # objective is separable (task cost + incident-edge egress), so
+        # a move is scored by its O(degree) delta; TIME (critical path)
+        # is not separable and pays the full DAG walk per move.
+        children: Dict[task_lib.Task, List[task_lib.Task]] = {
+            t: [] for t in order}
+        for task in order:
+            for parent in parents[task]:
+                children[parent].append(task)
+
+        def move_cost(task: task_lib.Task, i: int) -> float:
+            """Task i's cost + egress on every incident edge, given the
+            rest of `assign` (COST objective only)."""
+            res, cost, _ = cands[task][i]
+            total = cost
+            for parent in parents[task]:
+                pres = cands[parent][assign[parent]][0]
+                total += _egress_metrics(
+                    pres, res, parent.estimated_outputs_size_gigabytes)[0]
+            for child in children[task]:
+                cres = cands[child][assign[child]][0]
+                total += _egress_metrics(
+                    res, cres, task.estimated_outputs_size_gigabytes)[0]
+            return total
+
+        is_cost = minimize is OptimizeTarget.COST
         best_obj = objective(assign)
         for _ in range(10):  # sweeps; converges in 2-3 in practice
             improved = False
             for task in order:
                 current = assign[task]
+                if is_cost:
+                    base = move_cost(task, current)
                 for i in range(len(cands[task])):
                     if i == current:
+                        continue
+                    if is_cost:
+                        delta = move_cost(task, i) - base
+                        if delta < -1e-12:
+                            assign[task] = i
+                            best_obj += delta
+                            current = i
+                            base = move_cost(task, i)
+                            improved = True
                         continue
                     assign[task] = i
                     obj = objective(assign)
