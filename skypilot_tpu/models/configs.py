@@ -33,6 +33,11 @@ class ModelConfig:
     # immediately after — softmax/CE numerics stay f32 either way.  True
     # forces the matmul itself into f32 (slower; the MXU is bf16-native).
     logits_in_f32: bool = True
+    # Long-context sequence parallelism over the 'sequence' mesh axis:
+    # 'ring' (k/v rotate the ICI ring; any head count) or 'ulysses'
+    # (two all-to-alls re-shard seq<->heads, one plain flash per
+    # device; needs heads % sequence_axis == 0).  See ops/.
+    sequence_parallel: str = 'ring'
     # Mixture-of-Experts (0 experts = dense MLP).
     n_experts: int = 0
     expert_top_k: int = 2

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from skypilot_tpu.models.configs import ModelConfig
 from skypilot_tpu.ops import flash_attention
 from skypilot_tpu.ops import ring_attention
+from skypilot_tpu.ops import ulysses_attention
 
 
 def _rope(x, positions, theta: float):
@@ -99,19 +100,31 @@ class Attention(nn.Module):
         # q-head -> kv-head via their BlockSpec index maps, so repeated
         # K/V is never materialised in HBM (XLA fallbacks broadcast
         # internally).
+        if cfg.sequence_parallel not in ('ring', 'ulysses'):
+            raise ValueError(
+                f'Unknown sequence_parallel {cfg.sequence_parallel!r}; '
+                "have 'ring', 'ulysses'.")
         seq_parallel = (self.mesh is not None and
                         'sequence' in self.mesh.axis_names and
                         self.mesh.shape['sequence'] > 1)
         if self.sequence_axis is not None:
-            # Already inside a manual region sharded over sequence_axis:
-            # ring directly (a nested shard_map would be illegal here).
+            # Already inside a manual region sharded over sequence_axis
+            # (a nested shard_map would be illegal here): call the
+            # chosen strategy's sharded body directly.
             from skypilot_tpu.ops.ring_attention import _ring_attention_sharded  # pylint: disable=import-outside-toplevel
-            out = _ring_attention_sharded(
+            from skypilot_tpu.ops.ulysses_attention import _ulysses_attention_sharded  # pylint: disable=import-outside-toplevel
+            sharded = (_ulysses_attention_sharded
+                       if cfg.sequence_parallel == 'ulysses'
+                       else _ring_attention_sharded)
+            out = sharded(
                 q, k, v, axis_name=self.sequence_axis,
                 sm_scale=float(hd) ** -0.5, causal=True,
                 block_q=128, block_k=128)
         elif seq_parallel:
-            out = ring_attention(q, k, v, mesh=self.mesh, causal=True)
+            attn = (ulysses_attention
+                    if cfg.sequence_parallel == 'ulysses'
+                    else ring_attention)
+            out = attn(q, k, v, mesh=self.mesh, causal=True)
         else:
             out = flash_attention(q, k, v, causal=True)
 

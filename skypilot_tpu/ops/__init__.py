@@ -9,5 +9,7 @@ long-context (SURVEY.md §5), both differentiable.
 from skypilot_tpu.ops.attention import flash_attention
 from skypilot_tpu.ops.attention import flash_attention_with_lse
 from skypilot_tpu.ops.ring_attention import ring_attention
+from skypilot_tpu.ops.ulysses_attention import ulysses_attention
 
-__all__ = ['flash_attention', 'flash_attention_with_lse', 'ring_attention']
+__all__ = ['flash_attention', 'flash_attention_with_lse', 'ring_attention',
+           'ulysses_attention']

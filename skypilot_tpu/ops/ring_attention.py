@@ -27,6 +27,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from skypilot_tpu.ops import sp_common
 from skypilot_tpu.ops.attention import NEG_INF
 from skypilot_tpu.ops.attention import flash_attention_with_lse
 
@@ -100,34 +101,18 @@ def ring_attention(q, k, v, *, mesh, axis_name: str = 'sequence',
     """
     if sm_scale is None:
         sm_scale = float(q.shape[-1]) ** -0.5
-    shard_map = jax.shard_map
-    P = jax.sharding.PartitionSpec
-
     # Keep batch on the data axes and heads on the tensor axis — only
     # the sequence dim participates in the ring.  Replicating them here
     # would force all-gathers and redundant compute across every
-    # non-sequence mesh axis.
-    def _axes(*names):
-        present = tuple(a for a in names if a in mesh.axis_names and
-                        mesh.shape[a] > 1)
-        return present if present else None
-
-    batch_axes = _axes('data', 'fsdp')
-    head_axes = _axes('tensor')
+    # non-sequence mesh axis.  (Shared with ulysses: ops/sp_common.py.)
+    spec, head_axes, tp = sp_common.sp_partition(mesh, axis_name)
     if head_axes:
-        tp = 1
-        for a in head_axes:
-            tp *= mesh.shape[a]
-        if k.shape[1] % tp:
-            # GQA kv heads don't divide the tensor axis: broadcast them
-            # up to q heads so the head shard is well-defined (the
-            # Pallas kernel's index-map GQA still applies within the
-            # shard when kv heads DO divide).
-            from skypilot_tpu.ops.attention import _repeat_kv  # pylint: disable=import-outside-toplevel
-            k, v = _repeat_kv(q, k, v)
-    spec = P(batch_axes, head_axes, axis_name, None)
+        # GQA kv heads must divide the tensor axis or be broadcast up
+        # to q heads (the Pallas kernel's index-map GQA still applies
+        # within the shard when kv heads DO divide).
+        k, v = sp_common.broadcast_gqa_if_indivisible(q, k, v, tp)
     fn = functools.partial(_ring_attention_sharded, axis_name=axis_name,
                            sm_scale=float(sm_scale), causal=causal,
                            block_q=block_q, block_k=block_k)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

@@ -1,0 +1,38 @@
+"""Shared shard_map plumbing for the sequence-parallel attention ops.
+
+ring_attention and ulysses_attention wrap the same mesh logic: batch
+stays on the data axes, heads on the tensor axis, only the sequence dim
+participates in the SP collective.  One copy here so axis selection and
+the GQA fallback cannot diverge between the two strategies.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+
+
+def sp_partition(mesh, axis_name: str) -> Tuple[object, tuple, int]:
+    """→ (PartitionSpec for [b, h, s, d], head_axes, tensor degree)."""
+    P = jax.sharding.PartitionSpec
+
+    def _axes(*names):
+        present = tuple(a for a in names if a in mesh.axis_names and
+                        mesh.shape[a] > 1)
+        return present if present else None
+
+    batch_axes = _axes('data', 'fsdp')
+    head_axes = _axes('tensor')
+    tp = 1
+    for a in (head_axes or ()):
+        tp *= mesh.shape[a]
+    return P(batch_axes, head_axes, axis_name, None), head_axes, tp
+
+
+def broadcast_gqa_if_indivisible(q, k, v, divisor: int):
+    """Broadcast kv heads up to q heads when they don't divide the head
+    sharding (`divisor` = the product of head-sharding mesh axes)."""
+    if k.shape[1] % divisor:
+        from skypilot_tpu.ops.attention import _repeat_kv  # pylint: disable=import-outside-toplevel
+        k, v = _repeat_kv(q, k, v)
+    return k, v

@@ -1,0 +1,83 @@
+"""Ulysses-style all-to-all sequence parallelism (PAPERS.md: DeepSpeed-
+Ulysses pattern, re-built on XLA collectives).
+
+The alternative long-context strategy to ring attention (SURVEY.md
+§2.3 mandates "ring attention or all-to-all sequence/context
+parallelism"; this framework ships both):
+
+- ring: k/v chunks rotate around the ICI ring, P hops, per-hop flash +
+  logaddexp merge.  Communication scales with k/v size only; works for
+  any head count.
+- ulysses (this module): two `all_to_all`s re-shard the SEQUENCE axis
+  into the HEAD axis — each device then holds h/P full-sequence heads
+  and runs ONE ordinary causal flash kernel, then the output is
+  re-sharded back.  Cheaper compute structure (no per-hop switch, no
+  merge math, exact flash numerics) and the collectives are single
+  fused all-to-alls on ICI; requires num_heads % ring_size == 0.
+
+Choose per layer via ModelConfig.sequence_parallel ('ring'|'ulysses').
+Differentiable end-to-end (all_to_all transposes to all_to_all).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+
+from skypilot_tpu.ops import sp_common
+from skypilot_tpu.ops.attention import flash_attention
+
+
+def _ulysses_attention_sharded(q, k, v, *, axis_name: str,
+                               sm_scale: float, causal: bool,
+                               block_q: int, block_k: int):
+    """Body under shard_map: q/k/v are [b, h, s/P, d] local chunks.
+
+    all_to_all(split heads → concat seq) yields [b, h/P, s, d]: every
+    device attends h/P heads over the FULL sequence, so plain causal
+    flash is exact — seq chunks concatenate in device order, preserving
+    global positions.
+    """
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            tiled=True)
+    # [b, h, s/P, d] -> [b, h/P, s, d]
+    qh = a2a(q, split_axis=1, concat_axis=2)
+    kh = a2a(k, split_axis=1, concat_axis=2)
+    vh = a2a(v, split_axis=1, concat_axis=2)
+    out = flash_attention(qh, kh, vh, causal=causal, sm_scale=sm_scale,
+                          block_q=block_q, block_k=block_k)
+    # [b, h/P, s, d] -> [b, h, s/P, d]
+    return a2a(out, split_axis=2, concat_axis=1)
+
+
+def ulysses_attention(q, k, v, *, mesh, axis_name: str = 'sequence',
+                      causal: bool = True,
+                      sm_scale: Optional[float] = None,
+                      block_q: int = 128, block_k: int = 128):
+    """All-to-all sequence-parallel attention.
+
+    Args:
+      q, k, v: [batch, heads, seq, head_dim] GLOBAL arrays (seq sharded
+        over `axis_name`).  Requires q heads (and kv heads, unless they
+        are broadcast up) to divide the sequence-axis size.
+      mesh: the jax.sharding.Mesh to run under.
+    """
+    if sm_scale is None:
+        sm_scale = float(q.shape[-1]) ** -0.5
+    sp = mesh.shape[axis_name]
+    spec, _, tp = sp_common.sp_partition(mesh, axis_name)
+    # Heads are sharded tensor-wise first, then each tensor shard's
+    # heads are all-to-all'd over the sequence axis — so heads must
+    # divide tp * sp.
+    if q.shape[1] % (tp * sp):
+        raise ValueError(
+            f'ulysses needs num_heads ({q.shape[1]}) divisible by '
+            f'tensor ({tp}) x {axis_name} ({sp}); use ring attention '
+            'instead.')
+    k, v = sp_common.broadcast_gqa_if_indivisible(q, k, v, tp * sp)
+    fn = functools.partial(_ulysses_attention_sharded,
+                           axis_name=axis_name, sm_scale=float(sm_scale),
+                           causal=causal, block_q=block_q, block_k=block_k)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

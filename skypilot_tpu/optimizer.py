@@ -353,12 +353,12 @@ def _optimize_general(
                     if i == current:
                         continue
                     if is_cost:
-                        delta = move_cost(task, i) - base
-                        if delta < -1e-12:
+                        cand_cost = move_cost(task, i)
+                        if cand_cost < base - 1e-12:
                             assign[task] = i
-                            best_obj += delta
+                            best_obj += cand_cost - base
                             current = i
-                            base = move_cost(task, i)
+                            base = cand_cost
                             improved = True
                         continue
                     assign[task] = i

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import os
+import sys
 import time
 
 import pytest
@@ -37,8 +38,8 @@ class TestJobQueueBurst:
             job_id = job_lib.add_job(f'j{i}', 'u', f'ts-{i}', 'unused')
             sched.queue(job_id,
                         f'echo {job_id} >> {marker}; '
-                        f'python -c "from skypilot_tpu.skylet import '
-                        f'job_lib; job_lib.set_status({job_id}, '
+                        f'{sys.executable} -c "from skypilot_tpu.skylet '
+                        f'import job_lib; job_lib.set_status({job_id}, '
                         f'job_lib.JobStatus.SUCCEEDED)"')
             ids.append(job_id)
         deadline = time.time() + 60
