@@ -21,11 +21,14 @@ from skypilot_tpu.core import cancel
 from skypilot_tpu.core import cost_report
 from skypilot_tpu.core import down
 from skypilot_tpu.core import download_logs
+from skypilot_tpu.core import endpoints
 from skypilot_tpu.core import job_status
 from skypilot_tpu.core import queue
 from skypilot_tpu.core import start
 from skypilot_tpu.core import status
 from skypilot_tpu.core import stop
+from skypilot_tpu.core import storage_delete
+from skypilot_tpu.core import storage_ls
 from skypilot_tpu.core import tail_logs
 from skypilot_tpu.dag import Dag
 from skypilot_tpu.execution import exec  # pylint: disable=redefined-builtin
@@ -58,14 +61,22 @@ __all__ = [
     'cost_report',
     'down',
     'download_logs',
+    'endpoints',
     'exec',
     'job_status',
     'jobs',
     'launch',
+    'optimize',
     'queue',
     'serve',
     'start',
     'status',
     'stop',
+    'storage_delete',
+    'storage_ls',
     'tail_logs',
 ]
+
+# `sky.optimize(dag)` parity (reference sky/__init__.py exports the
+# Optimizer entry point as a top-level verb).
+optimize = Optimizer.optimize

@@ -691,8 +691,8 @@ def storage_group():
 @storage_group.command(name='ls')
 def storage_ls():
     """List storage objects."""
-    from skypilot_tpu import global_user_state  # pylint: disable=import-outside-toplevel
-    records = global_user_state.get_storage()
+    from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+    records = core.storage_ls()
     rows = [(r['name'], r['status'],
              ', '.join(r['handle'].get('store_types', []))
              if isinstance(r.get('handle'), dict) else '-')
@@ -705,24 +705,17 @@ def storage_ls():
 @click.option('--yes', '-y', is_flag=True, default=False)
 def storage_delete(names, yes):
     """Delete storage objects (and their buckets)."""
-    from skypilot_tpu import global_user_state  # pylint: disable=import-outside-toplevel
-    from skypilot_tpu.data import storage as storage_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu import exceptions  # pylint: disable=import-outside-toplevel
     if not yes:
         click.confirm(f'Delete storage {", ".join(names)}?',
                       default=True, abort=True)
     for name in names:
-        handle = global_user_state.get_handle_from_storage_name(name)
-        if handle is None:
-            click.echo(f'Storage {name} not found.', err=True)
+        try:
+            core.storage_delete(name)
+        except exceptions.StorageError as e:
+            click.echo(str(e), err=True)
             continue
-        storage = storage_lib.Storage(
-            name=handle['name'], source=handle.get('source'),
-            mode=storage_lib.StorageMode(handle.get('mode', 'MOUNT')))
-        for stype in handle.get('store_types', []):
-            storage.stores[storage_lib.StoreType(stype)] = (
-                storage_lib._STORE_CLASSES[  # pylint: disable=protected-access
-                    storage_lib.StoreType(stype)](handle['name']))
-        storage.delete()
         click.echo(f'Storage {name} deleted.')
 
 

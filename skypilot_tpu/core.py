@@ -190,3 +190,61 @@ def queued_status(cluster_name: str) -> bool:
             f'Cluster {cluster_name!r} does not exist.')
     handle = record['handle']
     return provision.wait_capacity(handle.provider_name, cluster_name)
+
+
+def endpoints(cluster_name: str,
+              port: Optional[int] = None) -> Dict[int, str]:
+    """Exposed `port -> host:port` endpoints of a cluster's head host.
+
+    Parity: reference core.py:189 (endpoints). Ports come from the
+    launched resources' `ports` request; the host is the head node's
+    externally reachable IP.
+    """
+    handle = backend_utils.check_cluster_available(cluster_name)
+    ips = handle.external_ips() or []
+    if not ips:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} has no reachable IPs.')
+    resources = getattr(handle, 'launched_resources', None)
+    ports = list(getattr(resources, 'ports', None) or [])
+    if not ports:
+        # Reference parity: an UP cluster without a ports request is an
+        # error, not an empty dict — the user asked for endpoints that
+        # were never opened.
+        raise ValueError(
+            f'Cluster {cluster_name!r} has no open ports; request '
+            "`resources.ports` at launch to expose endpoints.")
+    if port is not None:
+        if port not in ports:
+            raise ValueError(
+                f'Port {port} was not opened on {cluster_name!r} '
+                f'(open ports: {ports or "none"}).')
+        ports = [port]
+    return {p: f'{ips[0]}:{p}' for p in ports}
+
+
+def storage_ls() -> List[Dict[str, Any]]:
+    """Storage records from local state.
+
+    Parity: reference core.py:877 (storage_ls).
+    """
+    return global_user_state.get_storage()
+
+
+def storage_delete(name: str) -> None:
+    """Delete a storage object and its bucket(s).
+
+    Parity: reference core.py:899 (storage_delete).
+    """
+    from skypilot_tpu.data import storage as storage_lib  # pylint: disable=import-outside-toplevel
+    handle = global_user_state.get_handle_from_storage_name(name)
+    if handle is None:
+        raise exceptions.StorageError(f'Storage {name!r} not found.')
+    storage = storage_lib.Storage(
+        name=handle['name'], source=handle.get('source'),
+        mode=storage_lib.StorageMode(handle.get('mode', 'MOUNT')))
+    for stype in handle.get('store_types', []):
+        storage.stores[storage_lib.StoreType(stype)] = (
+            storage_lib._STORE_CLASSES[  # pylint: disable=protected-access
+                storage_lib.StoreType(stype)](handle['name']))
+    storage.delete()

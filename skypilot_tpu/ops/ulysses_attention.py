@@ -38,7 +38,20 @@ def _ulysses_attention_sharded(q, k, v, *, axis_name: str,
     device attends h/P heads over the FULL sequence, so plain causal
     flash is exact — seq chunks concatenate in device order, preserving
     global positions.
+
+    Safe to call directly from inside an existing manual region (the
+    PP x SP path): divisibility is re-checked here against the axis
+    size — `psum(1, axis)` is concrete under shard_map — and GQA kv
+    heads are broadcast up when they don't divide.
     """
+    sp = jax.lax.psum(1, axis_name)
+    if q.shape[1] % sp:
+        raise ValueError(
+            f'ulysses needs num_heads ({q.shape[1]}) divisible by the '
+            f'{axis_name!r} axis ({sp}); use ring attention instead.')
+    if k.shape[1] % sp:
+        from skypilot_tpu.ops.attention import _repeat_kv  # pylint: disable=import-outside-toplevel
+        k, v = _repeat_kv(q, k, v)
     a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
                             tiled=True)
     # [b, h, s/P, d] -> [b, h/P, s, d]

@@ -294,3 +294,23 @@ class TestModel:
             losses[name] = float(metrics['loss'])
         vals = list(losses.values())
         np.testing.assert_allclose(vals, vals[0], rtol=1e-4)
+
+
+class TestUlyssesManualRegion:
+
+    def test_pipeline_sp_ulysses_gqa(self):
+        """PP x SP with ulysses on a GQA model: the sharded body must
+        broadcast kv heads (2 -> 4) instead of crashing in all_to_all."""
+        from skypilot_tpu.models.train import TrainConfig
+        from skypilot_tpu.parallel.pipeline import run_pipeline_train_step
+        cfg = configs.get_config('tiny', sequence_parallel='ulysses')
+        assert cfg.n_kv_heads == 2  # indivisible by sequence=4
+        mesh = build_mesh(MeshConfig(data=1, pipeline=2, sequence=4))
+        loss = run_pipeline_train_step(cfg, TrainConfig(), mesh,
+                                       batch=2, seq=64,
+                                       num_microbatches=2)
+        cfg_ring = cfg.replace(sequence_parallel='ring')
+        loss_ring = run_pipeline_train_step(cfg_ring, TrainConfig(), mesh,
+                                            batch=2, seq=64,
+                                            num_microbatches=2)
+        assert loss == pytest.approx(loss_ring, rel=1e-4)

@@ -1,0 +1,74 @@
+"""SDK verb parity tests (reference core.py:189 endpoints, :877
+storage_ls, :899 storage_delete; sky.optimize export)."""
+from __future__ import annotations
+
+import types
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+from skypilot_tpu.backends import backend_utils
+
+
+class _FakeHandle:
+
+    def __init__(self, ips, ports):
+        self.launched_resources = types.SimpleNamespace(ports=ports)
+        self._ips = ips
+
+    def external_ips(self):
+        return self._ips
+
+
+class TestEndpoints:
+
+    def _patch(self, monkeypatch, handle):
+        monkeypatch.setattr(backend_utils, 'check_cluster_available',
+                            lambda name: handle)
+        # core.py binds the module, not the function, so patching the
+        # module attribute is enough.
+
+    def test_all_ports(self, monkeypatch):
+        self._patch(monkeypatch, _FakeHandle(['1.2.3.4'], [8080, 9090]))
+        assert core.endpoints('c') == {8080: '1.2.3.4:8080',
+                                       9090: '1.2.3.4:9090'}
+
+    def test_single_port_and_unknown_port(self, monkeypatch):
+        self._patch(monkeypatch, _FakeHandle(['1.2.3.4'], [8080]))
+        assert core.endpoints('c', port=8080) == {8080: '1.2.3.4:8080'}
+        with pytest.raises(ValueError, match='not opened'):
+            core.endpoints('c', port=1234)
+
+    def test_no_ips_raises(self, monkeypatch):
+        self._patch(monkeypatch, _FakeHandle([], [8080]))
+        with pytest.raises(exceptions.ClusterNotUpError):
+            core.endpoints('c')
+
+
+class TestStorageSdk:
+
+    def test_ls_empty(self, _isolated_home):
+        assert core.storage_ls() == []
+
+    def test_delete_missing_raises(self, _isolated_home):
+        with pytest.raises(exceptions.StorageError, match='not found'):
+            core.storage_delete('nope')
+
+
+def test_public_api_exports():
+    for name in ('endpoints', 'storage_ls', 'storage_delete', 'optimize'):
+        assert name in sky.__all__
+        assert callable(getattr(sky, name))
+    assert sky.optimize is sky.Optimizer.optimize
+
+
+class TestEndpointsNoPorts:
+
+    def test_no_ports_raises(self, monkeypatch):
+        handle = _FakeHandle(['1.2.3.4'], [])
+        monkeypatch.setattr(backend_utils, 'check_cluster_available',
+                            lambda name: handle)
+        with pytest.raises(ValueError, match='no open ports'):
+            core.endpoints('c')
