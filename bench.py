@@ -53,14 +53,50 @@ def _peak_flops(device) -> float:
     return 197e12
 
 
-def main() -> None:
+def _run_config(cfg, batch: int, seq: int, n_steps: int):
+    """Compile + warm up + time one training config.
+
+    Returns (tokens_per_sec, n_params, final_loss).  Synchronisation
+    contract (VERDICT round-2 weak #3): `jax.block_until_ready` was
+    observed NOT to synchronize on the relay TPU platform (a loop timed
+    that way yielded a physically impossible 132 MFU), so the timed
+    region ends with a `device_get` of the FINAL step's loss.  That
+    value transitively depends on every prior step (each step consumes
+    the previous step's donated TrainState), so fetching it cannot
+    complete before all timed steps actually executed on the chip —
+    while avoiding a per-step host round-trip (~100 ms through the
+    relay tunnel, measured — it inflated step time ~35%).
+    """
     import jax
     import jax.numpy as jnp
 
-    from skypilot_tpu.models import configs
     from skypilot_tpu.models.train import TrainConfig
     from skypilot_tpu.models.train import create_train_state
     from skypilot_tpu.models.train import train_step
+
+    state, _ = create_train_state(cfg, TrainConfig(), batch_size=batch,
+                                  seq_len=seq)
+    n_params = _param_count(state.params)
+    step = jax.jit(train_step, donate_argnums=(0,))
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(key, (batch, seq + 1), 0, cfg.vocab_size,
+                                dtype=jnp.int32)
+    batch_dict = {'tokens': tokens}
+    for _ in range(2):
+        state, metrics = step(state, batch_dict)
+    float(jax.device_get(metrics['loss']))
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, batch_dict)
+    final_loss = float(jax.device_get(metrics['loss']))
+    dt = time.perf_counter() - t0
+    return batch * seq * n_steps / dt, n_params, final_loss
+
+
+def main() -> None:
+    import jax
+
+    from skypilot_tpu.models import configs
 
     dev = jax.devices()[0]
     # The TPU plugin may register under a custom platform name (e.g. a
@@ -69,44 +105,39 @@ def main() -> None:
     on_tpu = (jax.default_backend() == 'tpu' or
               'tpu' in getattr(dev, 'device_kind', '').lower())
     if on_tpu:
-        cfg = configs.get_config('small')
+        base = configs.get_config('small', logits_in_f32=False)
         batch, seq = 16, 1024
+        # Fastest schedule first; each step down trades flops for HBM.
+        # 'small' at b=16/s=1024 is estimated to fit without remat on a
+        # 16 GB v5e but the estimate is not a guarantee, so OOM (or any
+        # config-specific failure) falls through to the next schedule
+        # rather than burning the whole TPU attempt.
+        candidates = [
+            ('noremat+lmbf16', base.replace(remat=False)),
+            ('dots+lmbf16', base.replace(remat_policy='dots')),
+            ('full+lmbf16', base),
+            ('full', configs.get_config('small')),
+        ]
+        n_steps = 20
     else:  # CI / laptop fallback
-        cfg = configs.get_config('tiny')
+        candidates = [('tiny', configs.get_config('tiny'))]
         batch, seq = 4, 128
+        n_steps = 3
 
-    state, _ = create_train_state(cfg, TrainConfig(), batch_size=batch,
-                                  seq_len=seq)
-    n_params = _param_count(state.params)
+    tokens_per_sec = n_params = final_loss = None
+    config_name = cfg_used = None
+    for name, cfg in candidates:
+        try:
+            tokens_per_sec, n_params, final_loss = _run_config(
+                cfg, batch, seq, n_steps)
+            config_name, cfg_used = name, cfg
+            break
+        except Exception as e:  # pylint: disable=broad-except
+            print(f'# bench config {name} failed: '
+                  f'{type(e).__name__}: {str(e)[:200]}', file=sys.stderr)
+    if tokens_per_sec is None:
+        raise RuntimeError('every bench config failed')
 
-    step = jax.jit(train_step, donate_argnums=(0,))
-    key = jax.random.PRNGKey(0)
-    tokens = jax.random.randint(key, (batch, seq + 1), 0, cfg.vocab_size,
-                                dtype=jnp.int32)
-    batch_dict = {'tokens': tokens}
-
-    # Warmup (compile) + timed steps.  Synchronisation contract
-    # (VERDICT round-2 weak #3): `jax.block_until_ready` was observed
-    # NOT to synchronize on the relay TPU platform (a loop timed that
-    # way yielded a physically impossible 132 MFU), so the timed region
-    # ends with a `device_get` of the FINAL step's loss.  That value
-    # transitively depends on every prior step (each step consumes the
-    # previous step's donated TrainState), so fetching it cannot
-    # complete before all timed steps actually executed on the chip —
-    # while avoiding a per-step host round-trip (~100 ms through the
-    # relay tunnel, measured — it inflated step time ~35%).
-    for _ in range(2):
-        state, metrics = step(state, batch_dict)
-    float(jax.device_get(metrics['loss']))
-    n_steps = 20 if on_tpu else 3
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, metrics = step(state, batch_dict)
-    final_loss = float(jax.device_get(metrics['loss']))
-    dt = time.perf_counter() - t0
-
-    tokens_per_step = batch * seq
-    tokens_per_sec = tokens_per_step * n_steps / dt
     # Training FLOPs/token ~= 6 * params; MFU vs chip roofline.
     achieved_flops = 6.0 * n_params * tokens_per_sec
     mfu = achieved_flops / _peak_flops(dev)
@@ -122,9 +153,10 @@ def main() -> None:
         'vs_baseline': round(vs_baseline, 3),
         'device': dev.device_kind,
         'mfu': round(mfu, 4),
+        'config': config_name,
         'synced_timing': 'device_get_final_loss_chained',
     }))
-    print(f'# device={dev.device_kind} model={cfg.d_model}x{cfg.n_layers} '
+    print(f'# device={dev.device_kind} config={config_name} '
           f'params={n_params/1e6:.1f}M mfu={mfu:.3f} '
           f'loss={final_loss:.3f}', file=sys.stderr)
     if on_tpu:
@@ -135,7 +167,8 @@ def main() -> None:
         if key is not None:
             throughput_registry.record_measurement(
                 key, mfu, tokens_per_sec=tokens_per_sec,
-                model=f'{cfg.d_model}x{cfg.n_layers}')
+                model=f'{cfg_used.d_model}x{cfg_used.n_layers}'
+                      f'/{config_name}')
 
 
 def _attempt_envs():

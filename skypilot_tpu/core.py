@@ -218,7 +218,7 @@ def endpoints(cluster_name: str,
         if port not in ports:
             raise ValueError(
                 f'Port {port} was not opened on {cluster_name!r} '
-                f'(open ports: {ports or "none"}).')
+                f'(open ports: {ports}).')
         ports = [port]
     return {p: f'{ips[0]}:{p}' for p in ports}
 

@@ -49,9 +49,7 @@ def _ulysses_attention_sharded(q, k, v, *, axis_name: str,
         raise ValueError(
             f'ulysses needs num_heads ({q.shape[1]}) divisible by the '
             f'{axis_name!r} axis ({sp}); use ring attention instead.')
-    if k.shape[1] % sp:
-        from skypilot_tpu.ops.attention import _repeat_kv  # pylint: disable=import-outside-toplevel
-        k, v = _repeat_kv(q, k, v)
+    k, v = sp_common.broadcast_gqa_if_indivisible(q, k, v, sp)
     a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
                             tiled=True)
     # [b, h, s/P, d] -> [b, h/P, s, d]
