@@ -1,0 +1,156 @@
+"""Auxiliary-subsystem tests: timeline tracing, admin policy hook,
+autostop config persistence (SURVEY.md §5 — tracing, config/flag
+system, failure handling building blocks)."""
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from skypilot_tpu import admin_policy
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.skylet import autostop_lib
+from skypilot_tpu.utils import timeline
+
+
+class TestTimeline:
+
+    def test_spans_written_as_chrome_trace(self, _isolated_home,
+                                           monkeypatch):
+        path = str(_isolated_home / 'trace.json')
+        monkeypatch.setenv('SKYTPU_TIMELINE_FILE', path)
+        monkeypatch.setattr(timeline, '_enabled_path', path)
+        monkeypatch.setattr(timeline, '_events', [])
+
+        with timeline.Event('provision', 'cluster c1'):
+            pass
+
+        @timeline.event
+        def sync_workdir():
+            return 42
+
+        assert sync_workdir() == 42
+        timeline.save_timeline()
+        with open(path, encoding='utf-8') as f:
+            trace = json.load(f)
+        events = trace['traceEvents'] if isinstance(trace, dict) else trace
+        names = [e['name'] for e in events]
+        assert any('provision' in n for n in names)
+        assert any('sync_workdir' in n for n in names)
+        phases = {e['ph'] for e in events}
+        assert {'B', 'E'} <= phases
+
+    def test_disabled_is_noop(self, monkeypatch):
+        monkeypatch.setattr(timeline, '_enabled_path', None)
+        events_before = list(timeline._events)  # pylint: disable=protected-access
+        with timeline.Event('x'):
+            pass
+        assert timeline._events == events_before  # pylint: disable=protected-access
+
+    def test_filelock_event_acquires(self, _isolated_home, monkeypatch):
+        monkeypatch.setattr(timeline, '_enabled_path', None)
+        lock_path = str(_isolated_home / 'x.lock')
+        with timeline.FileLockEvent(lock_path):
+            assert os.path.exists(lock_path)
+
+
+class _RejectTpuPolicy(admin_policy.AdminPolicy):
+
+    @classmethod
+    def validate_and_mutate(cls, user_request):
+        for task in user_request.dag.tasks:
+            for res in task.resources:
+                if res.tpu_spec is not None:
+                    raise exceptions.UserRequestRejectedByPolicy(
+                        'TPUs forbidden by org policy.')
+        return admin_policy.MutatedUserRequest(dag=user_request.dag)
+
+
+class _AddLabelPolicy(admin_policy.AdminPolicy):
+
+    @classmethod
+    def validate_and_mutate(cls, user_request):
+        for task in user_request.dag.tasks:
+            task.name = f'org-{task.name}'
+        return admin_policy.MutatedUserRequest(dag=user_request.dag)
+
+
+def _dag_with(resources=None):
+    dag = dag_lib.Dag()
+    task = task_lib.Task(name='t')
+    if resources is not None:
+        task.set_resources(resources)
+    dag.add(task)
+    return dag
+
+
+class TestAdminPolicy:
+
+    def _use(self, monkeypatch, cls_name):
+        from skypilot_tpu import config as config_lib
+        monkeypatch.setattr(
+            config_lib, 'get_nested',
+            lambda keys, default=None:
+            f'{__name__}.{cls_name}' if keys == ('admin_policy',)
+            else default)
+
+    def test_no_policy_passthrough(self, monkeypatch):
+        from skypilot_tpu import config as config_lib
+        monkeypatch.setattr(config_lib, 'get_nested',
+                            lambda keys, default=None: None)
+        dag = _dag_with()
+        assert admin_policy.apply(dag) is dag
+
+    def test_rejecting_policy(self, monkeypatch):
+        from skypilot_tpu import Resources
+        self._use(monkeypatch, '_RejectTpuPolicy')
+        dag = _dag_with(Resources(accelerators='tpu-v5e-8'))
+        with pytest.raises(exceptions.UserRequestRejectedByPolicy,
+                           match='forbidden'):
+            admin_policy.apply(dag)
+
+    def test_mutating_policy(self, monkeypatch):
+        self._use(monkeypatch, '_AddLabelPolicy')
+        dag = admin_policy.apply(_dag_with())
+        assert dag.tasks[0].name == 'org-t'
+
+    def test_bad_policy_path(self, monkeypatch):
+        self._use(monkeypatch, 'NoSuchPolicy')
+        with pytest.raises(exceptions.UserRequestRejectedByPolicy,
+                           match='Could not load'):
+            admin_policy.apply(_dag_with())
+
+    def test_non_policy_class_rejected(self, monkeypatch):
+        from skypilot_tpu import config as config_lib
+        monkeypatch.setattr(
+            config_lib, 'get_nested',
+            lambda keys, default=None:
+            'builtins.dict' if keys == ('admin_policy',) else default)
+        with pytest.raises(exceptions.UserRequestRejectedByPolicy,
+                           match='not an AdminPolicy'):
+            admin_policy.apply(_dag_with())
+
+
+class TestAutostopLib:
+
+    def test_round_trip_and_enabled(self, _isolated_home):
+        autostop_lib.set_autostop(30, down=True, provider_name='local',
+                                  cluster_name='c1')
+        cfg = autostop_lib.get_autostop_config()
+        assert cfg is not None
+        assert cfg.autostop_idle_minutes == 30
+        assert cfg.down and cfg.enabled
+        assert cfg.provider_name == 'local'
+
+        autostop_lib.set_autostop(-1, down=False, provider_name='local',
+                                  cluster_name='c1')
+        cfg = autostop_lib.get_autostop_config()
+        assert cfg is not None and not cfg.enabled
+
+    def test_last_active_advances(self, _isolated_home):
+        autostop_lib.set_last_active_time_to_now()
+        t1 = autostop_lib.get_last_active_time()
+        assert t1 > 0
