@@ -144,8 +144,7 @@ def main() -> None:
                   file=sys.stderr)
             if not oom_like or i == len(candidates) - 1:
                 raise
-    if tokens_per_sec is None:
-        raise RuntimeError('every bench config failed')
+    assert tokens_per_sec is not None  # loop breaks on success or raises
 
     # Training FLOPs/token ~= 6 * params; MFU vs chip roofline.
     achieved_flops = 6.0 * n_params * tokens_per_sec

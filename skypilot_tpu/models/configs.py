@@ -43,6 +43,12 @@ class ModelConfig:
     expert_top_k: int = 2
     expert_capacity_factor: float = 1.25
     router_aux_loss_coef: float = 0.02
+    # Family switches beyond Llama (Gemma/Qwen-style decoders):
+    tie_embeddings: bool = False      # lm_head = embed^T (Gemma)
+    qkv_bias: bool = False            # bias on q/k/v projections (Qwen2)
+    mlp_act: str = 'silu'             # 'silu' (Llama) | 'gelu' (Gemma)
+    norm_scale_plus_one: bool = False  # RMSNorm x (1 + w), w init 0 (Gemma)
+    scale_embeddings: bool = False    # embed x sqrt(d_model) (Gemma)
 
     @property
     def head_dim(self) -> int:
@@ -66,14 +72,35 @@ MIXTRAL_8X7B = ModelConfig(vocab_size=32000, d_model=4096, n_layers=32,
                            n_heads=32, n_kv_heads=8, d_ff=14336,
                            rope_theta=1e6, n_experts=8, expert_top_k=2)
 TINY_MOE = TINY.replace(n_experts=4, expert_top_k=2)
+# Gemma family: tied embeddings, GeGLU, (1+w) norms, scaled embeddings,
+# head_dim decoupled via extra heads convention (7B: 16 heads x 256 =
+# d_model 3072 x ... here heads x head_dim must equal d_model, so the
+# 2B shape is used for the preset).
+GEMMA_2B = ModelConfig(vocab_size=256000, d_model=2048, n_layers=18,
+                       n_heads=8, n_kv_heads=1, d_ff=16384,
+                       rope_theta=10000.0, tie_embeddings=True,
+                       mlp_act='gelu', norm_scale_plus_one=True,
+                       scale_embeddings=True)
+# Qwen2 family: biases on q/k/v, high-theta rope.
+QWEN2_7B = ModelConfig(vocab_size=152064, d_model=3584, n_layers=28,
+                       n_heads=28, n_kv_heads=4, d_ff=18944,
+                       rope_theta=1e6, qkv_bias=True)
+TINY_GEMMA = TINY.replace(tie_embeddings=True, mlp_act='gelu',
+                          norm_scale_plus_one=True, scale_embeddings=True,
+                          n_kv_heads=1)
+TINY_QWEN = TINY.replace(qkv_bias=True)
 
 PRESETS = {
     'llama3-8b': LLAMA3_8B,
     'llama3-70b': LLAMA3_70B,
     'mixtral-8x7b': MIXTRAL_8X7B,
+    'gemma-2b': GEMMA_2B,
+    'qwen2-7b': QWEN2_7B,
     'small': SMALL,
     'tiny': TINY,
     'tiny-moe': TINY_MOE,
+    'tiny-gemma': TINY_GEMMA,
+    'tiny-qwen': TINY_QWEN,
 }
 
 

@@ -57,19 +57,25 @@ def _layer_params(params: Dict[str, Any], cfg: ModelConfig):
     return stacked
 
 
-def _attn_proj(x, kernel):
-    """[b, s, d_model] x [d_model, heads, hd] -> [b, heads, s, hd]."""
-    return jnp.einsum('bsd,dhk->bhsk', x, kernel.astype(x.dtype))
+def _attn_proj(x, proj):
+    """[b, s, d_model] x [d_model, heads, hd] -> [b, heads, s, hd].
+    `proj` is the q/k/v param dict; bias present iff cfg.qkv_bias."""
+    out = jnp.einsum('bsd,dhk->bhsk', x, proj['kernel'].astype(x.dtype))
+    bias = proj.get('bias')
+    if bias is not None:  # [heads, hd] -> broadcast over [b, ., s, .]
+        out = out + bias.astype(x.dtype)[None, :, None, :]
+    return out
 
 
 def _mlp(x, lp, cfg):
     if cfg.n_experts > 0:
         return _moe_mlp(x, lp['moe_mlp'], cfg)
+    act = {'silu': jax.nn.silu, 'gelu': jax.nn.gelu}[cfg.mlp_act]
     gate = jnp.einsum('bsd,df->bsf', x,
                       lp['mlp']['gate_proj']['kernel'].astype(x.dtype))
     up = jnp.einsum('bsd,df->bsf', x,
                     lp['mlp']['up_proj']['kernel'].astype(x.dtype))
-    return jnp.einsum('bsf,fd->bsd', jax.nn.silu(gate) * up,
+    return jnp.einsum('bsf,fd->bsd', act(gate) * up,
                       lp['mlp']['down_proj']['kernel'].astype(x.dtype))
 
 
@@ -99,8 +105,9 @@ def _moe_mlp(x, mp, cfg):
         jax.nn.one_hot(gate_idx, cfg.n_experts, dtype=jnp.float32) *
         gate_vals[..., None], axis=1)                    # [N, E]
     xt = tokens.astype(jnp.float32)
-    h = jax.nn.silu(jnp.einsum('nd,edf->nef', xt,
-                               mp['gate_proj'].astype(jnp.float32)))
+    act = {'silu': jax.nn.silu, 'gelu': jax.nn.gelu}[cfg.mlp_act]
+    h = act(jnp.einsum('nd,edf->nef', xt,
+                       mp['gate_proj'].astype(jnp.float32)))
     h = h * jnp.einsum('nd,edf->nef', xt,
                        mp['up_proj'].astype(jnp.float32))
     out_e = jnp.einsum('nef,efd->ned', h,
@@ -109,7 +116,19 @@ def _moe_mlp(x, mp, cfg):
     return out.astype(x.dtype).reshape(b, s, d)
 
 
-def _norm(x, scale, eps):
+def _unembed(x, params, cfg):
+    """[b, s, d] -> logits [b, s, V] (tied embeddings or lm_head)."""
+    if cfg.tie_embeddings:
+        kernel = params['embed']['embedding'].T  # [d, V]
+    else:
+        kernel = params['lm_head']['kernel']
+    return jnp.einsum('bsd,dv->bsv', x.astype(jnp.float32),
+                      kernel.astype(jnp.float32))
+
+
+def _norm(x, scale, eps, plus_one: bool = False):
+    if plus_one:  # Gemma: weights parameterize (1 + w)
+        scale = 1.0 + scale
     x32 = x.astype(jnp.float32)
     normed = x32 * jax.lax.rsqrt(
         jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
@@ -124,8 +143,9 @@ def _layer_forward(x, lp, cfg, positions, k_cache, v_cache, cache_len,
     this call's k/v written at [positions]; cache_len = total valid
     length after the write.  Returns the layer output.
     """
-    h = _norm(x, lp['attn_norm']['scale'], cfg.norm_eps)
-    q = _attn_proj(h, lp['attn']['q_proj']['kernel'])
+    h = _norm(x, lp['attn_norm']['scale'], cfg.norm_eps,
+              cfg.norm_scale_plus_one)
+    q = _attn_proj(h, lp['attn']['q_proj'])
     q = _rope(q, positions, cfg.rope_theta)
 
     if use_flash:
@@ -158,7 +178,8 @@ def _layer_forward(x, lp, cfg, positions, k_cache, v_cache, cache_len,
     out = jnp.einsum('bhsk,hkd->bsd', out,
                      lp['attn']['o_proj']['kernel'].astype(x.dtype))
     x = x + out
-    h = _norm(x, lp['mlp_norm']['scale'], cfg.norm_eps)
+    h = _norm(x, lp['mlp_norm']['scale'], cfg.norm_eps,
+              cfg.norm_scale_plus_one)
     return x + _mlp(h, lp, cfg)
 
 
@@ -180,13 +201,16 @@ def _forward_with_cache(cfg, params, tokens, cache, *, use_flash: bool):
     positions = start + jnp.arange(s)
     x = jnp.take(params['embed']['embedding'], tokens,
                  axis=0).astype(cfg.dtype)
+    if cfg.scale_embeddings:  # Gemma
+        x = x * jnp.asarray(cfg.d_model ** 0.5, x.dtype)
     cache_len = start + s
 
     def body(x, layer_state):
         lp, k_cache, v_cache = layer_state
-        h = _norm(x, lp['attn_norm']['scale'], cfg.norm_eps)
-        k = _attn_proj(h, lp['attn']['k_proj']['kernel'])
-        v = _attn_proj(h, lp['attn']['v_proj']['kernel'])
+        h = _norm(x, lp['attn_norm']['scale'], cfg.norm_eps,
+                  cfg.norm_scale_plus_one)
+        k = _attn_proj(h, lp['attn']['k_proj'])
+        v = _attn_proj(h, lp['attn']['v_proj'])
         k = _rope(k, positions, cfg.rope_theta)
         k_cache, v_cache = _write_cache(k_cache, v_cache, k, v, start)
         x = _layer_forward(x, lp, cfg, positions, k_cache, v_cache,
@@ -196,10 +220,9 @@ def _forward_with_cache(cfg, params, tokens, cache, *, use_flash: bool):
     x, (new_k, new_v) = jax.lax.scan(
         lambda carry, ls: body(carry, ls),
         x, (layers, cache['k'], cache['v']))
-    x = _norm(x[:, -1:], params['final_norm']['scale'], cfg.norm_eps)
-    logits = jnp.einsum(
-        'bsd,dv->bsv', x.astype(jnp.float32),
-        params['lm_head']['kernel'].astype(jnp.float32))[:, 0]
+    x = _norm(x[:, -1:], params['final_norm']['scale'], cfg.norm_eps,
+              cfg.norm_scale_plus_one)
+    logits = _unembed(x, params, cfg)[:, 0]
     new_cache = {'k': new_k, 'v': new_v, 'index': cache_len}
     return logits, new_cache
 

@@ -68,8 +68,9 @@ def moe_apply(tokens, router_logits, w_gate, w_up, w_down,
     expert_in = nn.with_logical_constraint(
         expert_in.astype(cfg.dtype), ('expert', None, 'embed'))
 
-    h = jax.nn.silu(jnp.einsum('ecd,edf->ecf', expert_in,
-                               w_gate.astype(cfg.dtype)))
+    act = {'silu': jax.nn.silu, 'gelu': jax.nn.gelu}[cfg.mlp_act]
+    h = act(jnp.einsum('ecd,edf->ecf', expert_in,
+                       w_gate.astype(cfg.dtype)))
     h = h * jnp.einsum('ecd,edf->ecf', expert_in,
                        w_up.astype(cfg.dtype))
     expert_out = jnp.einsum('ecf,efd->ecd', h,

@@ -49,13 +49,19 @@ def _remat_policy(cfg: ModelConfig):
 
 class RMSNorm(nn.Module):
     eps: float = 1e-5
+    # Gemma-style: scale = (1 + w) with w initialized to zero, so the
+    # norm starts as identity-scale.
+    scale_plus_one: bool = False
 
     @nn.compact
     def __call__(self, x):
+        init = (nn.initializers.zeros if self.scale_plus_one
+                else nn.initializers.ones)
         scale = self.param(
-            'scale', nn.with_logical_partitioning(nn.initializers.ones,
-                                                  ('embed',)),
+            'scale', nn.with_logical_partitioning(init, ('embed',)),
             (x.shape[-1],), jnp.float32)
+        if self.scale_plus_one:
+            scale = 1.0 + scale
         x32 = x.astype(jnp.float32)
         normed = x32 * jax.lax.rsqrt(
             jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
@@ -79,7 +85,7 @@ class Attention(nn.Module):
 
         def proj(name, heads, logical):
             return nn.DenseGeneral(
-                features=(heads, hd), axis=-1, use_bias=False,
+                features=(heads, hd), axis=-1, use_bias=cfg.qkv_bias,
                 dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                 kernel_init=nn.with_logical_partitioning(
                     nn.initializers.lecun_normal(), logical),
@@ -153,10 +159,11 @@ class MLP(nn.Module):
                     nn.initializers.lecun_normal(), logical),
                 name=name)
 
+        act = {'silu': nn.silu, 'gelu': nn.gelu}[cfg.mlp_act]
         gate = dense('gate_proj', cfg.d_ff, ('embed', 'mlp'))(x)
         up = dense('up_proj', cfg.d_ff, ('embed', 'mlp'))(x)
         return dense('down_proj', cfg.d_model, ('mlp', 'embed'))(
-            nn.silu(gate) * up)
+            act(gate) * up)
 
 
 class DecoderLayer(nn.Module):
@@ -169,13 +176,15 @@ class DecoderLayer(nn.Module):
         cfg = self.config
         x = x + Attention(cfg, self.mesh, self.sequence_axis,
                           name='attn')(
-            RMSNorm(cfg.norm_eps, name='attn_norm')(x), positions)
+            RMSNorm(cfg.norm_eps, cfg.norm_scale_plus_one,
+                    name='attn_norm')(x), positions)
         if cfg.n_experts > 0:
             from skypilot_tpu.models.moe import MoEMLP  # pylint: disable=import-outside-toplevel
             mlp = MoEMLP(cfg, name='moe_mlp')
         else:
             mlp = MLP(cfg, name='mlp')
-        x = x + mlp(RMSNorm(cfg.norm_eps, name='mlp_norm')(x))
+        x = x + mlp(RMSNorm(cfg.norm_eps, cfg.norm_scale_plus_one,
+                            name='mlp_norm')(x))
         return x
 
 
@@ -207,6 +216,8 @@ class Transformer(nn.Module):
                 nn.initializers.normal(stddev=0.02), ('vocab', 'embed')),
             name='embed')
         x = embed(tokens)
+        if cfg.scale_embeddings:  # Gemma: embeddings carry sqrt(d).
+            x = x * jnp.asarray(cfg.d_model ** 0.5, x.dtype)
         x = nn.with_logical_constraint(x, ('batch', 'seq', 'embed'))
 
         if cfg.scan_layers:
@@ -229,14 +240,23 @@ class Transformer(nn.Module):
                 x = layer_cls(cfg, self.mesh, name=f'layer_{i}')(
                     x, positions)
 
-        x = RMSNorm(cfg.norm_eps, name='final_norm')(x)
-        logits = nn.DenseGeneral(
-            cfg.vocab_size, use_bias=False,
-            dtype=jnp.float32 if cfg.logits_in_f32 else cfg.dtype,
-            param_dtype=cfg.param_dtype,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), ('embed', 'vocab')),
-            name='lm_head')(x)
+        x = RMSNorm(cfg.norm_eps, cfg.norm_scale_plus_one,
+                    name='final_norm')(x)
+        if cfg.tie_embeddings:
+            # lm_head = embed^T (Gemma/GPT-style weight tying).  NOT
+            # embed.attend(): that promotes to the module dtype (bf16),
+            # silently undoing the logits_in_f32 upcast.
+            mm_dtype = jnp.float32 if cfg.logits_in_f32 else cfg.dtype
+            logits = jnp.einsum('bsd,vd->bsv', x.astype(mm_dtype),
+                                embed.embedding.astype(mm_dtype))
+        else:
+            logits = nn.DenseGeneral(
+                cfg.vocab_size, use_bias=False,
+                dtype=jnp.float32 if cfg.logits_in_f32 else cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), ('embed', 'vocab')),
+                name='lm_head')(x)
         # Logits leave in f32 regardless of matmul precision: the CE
         # loss' log_softmax is always computed in f32.
         return logits.astype(jnp.float32)

@@ -222,6 +222,8 @@ def pipeline_forward(cfg, params, inputs, *, mesh,
     # Embedding outside the pipeline (plain GSPMD, batch-sharded).
     emb = params['embed']['embedding']
     x = jnp.take(emb, inputs, axis=0).astype(cfg.dtype)
+    if cfg.scale_embeddings:  # Gemma
+        x = x * jnp.asarray(cfg.d_model ** 0.5, x.dtype)
     mb = b // num_microbatches
     x_mb = x.reshape(num_microbatches, mb, seq, cfg.d_model)
 
@@ -240,11 +242,10 @@ def pipeline_forward(cfg, params, inputs, *, mesh,
 
     from skypilot_tpu.models.transformer import RMSNorm  # pylint: disable=import-outside-toplevel
     x = out_mb.reshape(b, seq, cfg.d_model)
-    x = RMSNorm(cfg.norm_eps).apply({'params': params['final_norm']}, x)
-    logits = jnp.einsum(
-        'bsd,dv->bsv', x.astype(jnp.float32),
-        params['lm_head']['kernel'].astype(jnp.float32))
-    return logits
+    x = RMSNorm(cfg.norm_eps, cfg.norm_scale_plus_one).apply(
+        {'params': params['final_norm']}, x)
+    from skypilot_tpu.models.decode import _unembed  # pylint: disable=import-outside-toplevel
+    return _unembed(x, params, cfg)
 
 
 def pipeline_loss_fn(cfg, params, tokens, *, mesh, num_microbatches: int):

@@ -109,3 +109,24 @@ def test_generate_is_jittable(setup):
         cfg, p, t, max_new_tokens=4, max_len=16)[1])
     out = fn(params, prompt)
     assert out.shape == (2, 4)
+
+
+@pytest.mark.parametrize('preset', ['tiny-gemma', 'tiny-qwen'])
+def test_family_variants_generation_parity(preset):
+    """Gemma-style (tied embeddings, GeGLU, +1 norms, scaled embed) and
+    Qwen-style (qkv bias) models decode identically to a full
+    re-forward."""
+    cfg = configs.get_config(preset)
+    model = Transformer(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 8), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    params = nn.meta.unbox(model.init(jax.random.PRNGKey(4),
+                                      prompt)['params'])
+    if cfg.tie_embeddings:
+        assert 'lm_head' not in params
+    if cfg.qkv_bias:
+        assert 'bias' in params['layers']['layer']['attn']['q_proj']
+    tokens, _ = decode.generate(cfg, params, prompt, max_new_tokens=4,
+                                max_len=32)
+    ref = _naive_generate(model, params, prompt, 4)
+    np.testing.assert_array_equal(np.asarray(tokens), np.asarray(ref))

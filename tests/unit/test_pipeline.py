@@ -169,3 +169,25 @@ def test_pipeline_rejects_bad_shapes(setup):
         pipeline_loss_fn(cfg, split, tokens, mesh=mesh, num_microbatches=3)
     with pytest.raises(ValueError, match='not divisible'):
         split_stage_params(params, 3)
+
+
+def test_pipeline_gemma_family_parity():
+    """Tied-embedding / scaled-embed / +1-norm models must pipeline
+    identically to the plain forward (the PP path re-implements the
+    embed/unembed ends)."""
+    cfg = configs.get_config('tiny-gemma')
+    model = Transformer(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (4, 17), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    import flax.linen as nn
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(0), tokens[:, :-1])['params'])
+    mesh = build_mesh(MeshConfig(data=-1, pipeline=2),
+                      devices=jax.devices()[:2])
+    split = split_stage_params(params, 2)
+    pp_loss = jax.jit(
+        lambda p, t: pipeline_loss_fn(cfg, p, t, mesh=mesh,
+                                      num_microbatches=2))(split, tokens)
+    base = _baseline_loss(model, params, tokens)
+    np.testing.assert_allclose(np.asarray(pp_loss), np.asarray(base),
+                               rtol=2e-5, atol=2e-5)
