@@ -27,6 +27,11 @@ def main() -> None:
     parser.add_argument('--fsdp', type=int, default=1)
     parser.add_argument('--tensor', type=int, default=1)
     parser.add_argument('--sequence', type=int, default=1)
+    parser.add_argument('--sp-mode', default='ring',
+                        choices=['ring', 'ulysses'],
+                        help='Sequence-parallel strategy when '
+                             '--sequence > 1 (ops/ring_attention vs '
+                             'ops/ulysses_attention).')
     parser.add_argument('--data', default=None,
                         help='SKYTOK1 token file (data.loader); random '
                              'tokens when omitted.')
@@ -59,7 +64,7 @@ def main() -> None:
         preflight.check_collectives(mesh)
         print('collective preflight: healthy')
 
-    cfg = configs.get_config(args.model)
+    cfg = configs.get_config(args.model, sequence_parallel=args.sp_mode)
     state, shardings = create_train_state(
         cfg, TrainConfig(), mesh=mesh, batch_size=args.batch_size,
         seq_len=args.seq_len)

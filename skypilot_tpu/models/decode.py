@@ -24,6 +24,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from skypilot_tpu.models import heads
 from skypilot_tpu.models.configs import ModelConfig
 from skypilot_tpu.models.transformer import _rope
 from skypilot_tpu.ops.attention import NEG_INF
@@ -114,16 +115,6 @@ def _moe_mlp(x, mp, cfg):
                        mp['down_proj'].astype(jnp.float32))
     out = jnp.einsum('ne,ned->nd', gates, out_e)
     return out.astype(x.dtype).reshape(b, s, d)
-
-
-def _unembed(x, params, cfg):
-    """[b, s, d] -> logits [b, s, V] (tied embeddings or lm_head)."""
-    if cfg.tie_embeddings:
-        kernel = params['embed']['embedding'].T  # [d, V]
-    else:
-        kernel = params['lm_head']['kernel']
-    return jnp.einsum('bsd,dv->bsv', x.astype(jnp.float32),
-                      kernel.astype(jnp.float32))
 
 
 def _norm(x, scale, eps, plus_one: bool = False):
@@ -222,7 +213,7 @@ def _forward_with_cache(cfg, params, tokens, cache, *, use_flash: bool):
         x, (layers, cache['k'], cache['v']))
     x = _norm(x[:, -1:], params['final_norm']['scale'], cfg.norm_eps,
               cfg.norm_scale_plus_one)
-    logits = _unembed(x, params, cfg)[:, 0]
+    logits = heads.unembed(x, params, cfg)[:, 0]
     new_cache = {'k': new_k, 'v': new_v, 'index': cache_len}
     return logits, new_cache
 

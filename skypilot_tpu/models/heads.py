@@ -1,0 +1,21 @@
+"""Output-head helpers shared by the raw-param forward paths.
+
+The flax Transformer handles its own unembedding in-module; the decode
+(inference) and pipeline (manual PP) paths operate on the plain param
+dict and share this one implementation, so tied/untied dispatch can
+never drift between them.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def unembed(x, params, cfg):
+    """[b, s, d] -> logits [b, s, V] in f32 (tied embeddings or
+    lm_head)."""
+    if cfg.tie_embeddings:
+        kernel = params['embed']['embedding'].T  # [d, V]
+    else:
+        kernel = params['lm_head']['kernel']
+    return jnp.einsum('bsd,dv->bsv', x.astype(jnp.float32),
+                      kernel.astype(jnp.float32))

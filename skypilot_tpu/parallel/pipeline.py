@@ -244,8 +244,8 @@ def pipeline_forward(cfg, params, inputs, *, mesh,
     x = out_mb.reshape(b, seq, cfg.d_model)
     x = RMSNorm(cfg.norm_eps, cfg.norm_scale_plus_one).apply(
         {'params': params['final_norm']}, x)
-    from skypilot_tpu.models.decode import _unembed  # pylint: disable=import-outside-toplevel
-    return _unembed(x, params, cfg)
+    from skypilot_tpu.models import heads  # pylint: disable=import-outside-toplevel
+    return heads.unembed(x, params, cfg)
 
 
 def pipeline_loss_fn(cfg, params, tokens, *, mesh, num_microbatches: int):

@@ -357,6 +357,31 @@ class Resources:
         if config is None:
             return cls()
         config = dict(config)
+        # Reference-familiar aliases (sky YAML: infra/capacity_type/
+        # spot_recovery) normalize onto the canonical field names.
+        for alias, canonical in (('infra', 'cloud'),
+                                 ('capacity_type', 'capacity'),
+                                 ('spot_recovery', 'job_recovery')):
+            if alias in config:
+                if canonical in config:
+                    raise exceptions.InvalidTaskError(
+                        f'Give either {alias!r} or {canonical!r}, '
+                        'not both.')
+                config[canonical] = config.pop(alias)
+        # TPU slice details ride in accelerator_args; the flat spelling
+        # is accepted and folded in.
+        flat_args = {k: config.pop(k)
+                     for k in ('topology', 'runtime_version', 'reservation')
+                     if k in config}
+        if flat_args:
+            merged = dict(config.get('accelerator_args') or {})
+            dup = set(flat_args) & set(merged)
+            if dup:
+                raise exceptions.InvalidTaskError(
+                    f'{sorted(dup)} given both top-level and inside '
+                    'accelerator_args; give each once.')
+            merged.update(flat_args)
+            config['accelerator_args'] = merged
         known = {
             'cloud', 'instance_type', 'accelerators', 'cpus', 'memory',
             'use_spot', 'capacity', 'job_recovery', 'region', 'zone',

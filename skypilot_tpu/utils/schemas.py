@@ -14,6 +14,14 @@ def _case_insensitive_enum(values) -> Dict[str, Any]:
     return {'type': 'string', 'case_insensitive_enum': list(values)}
 
 
+# One definition for both the canonical 'capacity' key and its
+# 'capacity_type' alias — must track cloud_lib.ProvisionMode.
+_CAPACITY_SCHEMA: Dict[str, Any] = {
+    'type': 'string',
+    'enum': ['on_demand', 'spot', 'reserved', 'queued'],
+}
+
+
 _RESOURCES_PROPERTIES: Dict[str, Any] = {
     'infra': {'type': 'string'},       # 'gcp', 'gke', 'local'
     'cloud': {'type': 'string'},       # reference-compat alias for infra
@@ -25,10 +33,8 @@ _RESOURCES_PROPERTIES: Dict[str, Any] = {
     },
     'topology': {'type': ['string', 'null']},       # e.g. '4x4', '2x2x4'
     'num_slices': {'type': 'integer', 'minimum': 1},
-    'capacity_type': {
-        'type': 'string',
-        'enum': ['on_demand', 'spot', 'reserved', 'queued', 'best_effort'],
-    },
+    'capacity': _CAPACITY_SCHEMA,
+    'capacity_type': _CAPACITY_SCHEMA,  # alias for capacity
     'use_spot': {'type': 'boolean'},   # reference-compat alias
     'spot_recovery': {'type': ['string', 'null']},
     'job_recovery': {
@@ -37,7 +43,6 @@ _RESOURCES_PROPERTIES: Dict[str, Any] = {
     'cpus': {'type': ['string', 'number', 'null']},
     'memory': {'type': ['string', 'number', 'null']},
     'disk_size': {'type': 'integer'},
-    'disk_tier': {'type': ['string', 'null']},
     'ports': {
         'anyOf': [{'type': 'string'}, {'type': 'integer'},
                   {'type': 'array'}, {'type': 'null'}],
@@ -46,13 +51,7 @@ _RESOURCES_PROPERTIES: Dict[str, Any] = {
     'image_id': {'type': ['string', 'object', 'null']},
     'runtime_version': {'type': ['string', 'null']},  # TPU software version
     'reservation': {'type': ['string', 'null']},
-    'any_of': {'type': 'array'},
-    'ordered': {'type': 'array'},
     'accelerator_args': {'type': ['object', 'null']},
-    'autostop': {
-        'anyOf': [{'type': 'integer'}, {'type': 'boolean'},
-                  {'type': 'object'}, {'type': 'null'}],
-    },
 }
 
 

@@ -266,3 +266,35 @@ def test_invalid_tpu_and_count_strings():
         resources_lib.Resources(memory='lots+')
     assert resources_lib.Resources(cpus=4).cpus == '4'
     assert resources_lib.Resources(memory='16+').memory == '16+'
+
+
+class TestResourcesYamlAliases:
+    """Reference-familiar YAML spellings normalize onto canonical
+    fields (docs/migration.md documents these)."""
+
+    def test_infra_capacity_type_spot_recovery(self):
+        from skypilot_tpu.resources import Resources
+        r = Resources.from_yaml_config({
+            'infra': 'gcp', 'accelerators': 'tpu-v5e-8',
+            'capacity_type': 'spot', 'spot_recovery': 'FAILOVER'})
+        assert r.cloud is not None and r.cloud.name == 'gcp'
+        assert r.use_spot
+        assert r.job_recovery == 'FAILOVER'
+
+    def test_flat_tpu_args_fold_into_accelerator_args(self):
+        from skypilot_tpu.resources import Resources
+        r = Resources.from_yaml_config({
+            'accelerators': 'tpu-v5p-16', 'topology': '2x2x4',
+            'runtime_version': 'v2-alpha',
+            'accelerator_args': {'reservation': 'res-1'}})
+        assert r.accelerator_args == {'topology': '2x2x4',
+                                      'runtime_version': 'v2-alpha',
+                                      'reservation': 'res-1'}
+
+    def test_alias_conflict_rejected(self):
+        import pytest as _pytest
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.resources import Resources
+        with _pytest.raises(exceptions.InvalidTaskError,
+                            match='not both'):
+            Resources.from_yaml_config({'cloud': 'gcp', 'infra': 'aws'})
