@@ -1,0 +1,109 @@
+"""Framework integrations for the bench step-timestamp logger.
+
+Parity: /root/reference/sky/callbacks/sky_callback/integrations/
+(Keras / PyTorch-Lightning / HuggingFace-Transformers callbacks that
+drive base.on_step_begin/end from inside the user's training loop).
+TPU-first additions: a JAX step-function wrapper (the idiomatic loop
+here has no callback object) and lazy imports so none of the host
+frameworks are required unless used.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+from skypilot_tpu.callbacks import base
+
+
+def wrap_jax_step(step_fn: Callable[..., Any],
+                  log_dir: Optional[str] = None,
+                  total_steps: Optional[int] = None) -> Callable[..., Any]:
+    """Wrap a (jitted) train-step callable so every invocation is
+    timestamped:
+
+        step_fn = integrations.wrap_jax_step(jit_train_step(...))
+        for batch in data:
+            state, metrics = step_fn(state, batch)
+
+    Timing note: the wrapper brackets the DISPATCH of the step.  Under
+    JAX's async dispatch consecutive step calls still measure true
+    steady-state step time (each dispatch blocks once the pipeline is
+    ~2 steps deep), matching how bench.py times the same loop.
+    """
+    cb = base.init(log_dir=log_dir, total_steps=total_steps)
+
+    @functools.wraps(step_fn)
+    def wrapped(*args: Any, **kwargs: Any) -> Any:
+        with cb.step():
+            return step_fn(*args, **kwargs)
+
+    return wrapped
+
+
+def transformers_callback(log_dir: Optional[str] = None):
+    """A HuggingFace `transformers.TrainerCallback` that reports step
+    timestamps (reference: integrations/transformers wrapper):
+
+        trainer = Trainer(..., callbacks=[transformers_callback()])
+    """
+    from transformers import TrainerCallback  # pylint: disable=import-outside-toplevel
+
+    class _SkyTpuTransformersCallback(TrainerCallback):
+
+        def on_train_begin(self, args, state, control, **kwargs):
+            del args, control, kwargs
+            base.init(log_dir=log_dir, total_steps=state.max_steps or None)
+
+        def on_step_begin(self, args, state, control, **kwargs):
+            del args, state, control, kwargs
+            base.on_step_begin()
+
+        def on_step_end(self, args, state, control, **kwargs):
+            del args, state, control, kwargs
+            base.on_step_end()
+
+    return _SkyTpuTransformersCallback()
+
+
+def lightning_callback(log_dir: Optional[str] = None):
+    """A pytorch_lightning.Callback reporting step timestamps."""
+    import pytorch_lightning as pl  # pylint: disable=import-outside-toplevel
+
+    class _SkyTpuLightningCallback(pl.Callback):
+
+        def on_train_start(self, trainer, pl_module):
+            del pl_module
+            total = getattr(trainer, 'max_steps', None)
+            base.init(log_dir=log_dir,
+                      total_steps=total if total and total > 0 else None)
+
+        def on_train_batch_start(self, *args: Any, **kwargs: Any):
+            del args, kwargs
+            base.on_step_begin()
+
+        def on_train_batch_end(self, *args: Any, **kwargs: Any):
+            del args, kwargs
+            base.on_step_end()
+
+    return _SkyTpuLightningCallback()
+
+
+def keras_callback(log_dir: Optional[str] = None):
+    """A tf.keras.callbacks.Callback reporting step timestamps."""
+    from tensorflow import keras  # pylint: disable=import-outside-toplevel
+
+    class _SkyTpuKerasCallback(keras.callbacks.Callback):
+
+        def on_train_begin(self, logs=None):
+            del logs
+            base.init(log_dir=log_dir)
+
+        def on_train_batch_begin(self, batch, logs=None):
+            del batch, logs
+            base.on_step_begin()
+
+        def on_train_batch_end(self, batch, logs=None):
+            del batch, logs
+            base.on_step_end()
+
+    return _SkyTpuKerasCallback()

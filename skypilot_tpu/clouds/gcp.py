@@ -185,7 +185,11 @@ class GCP(cloud_lib.Cloud):
                 'tpu': True,
                 'tpu_generation': spec.generation,
                 'tpu_accelerator_type': tpu_api_accelerator_type(spec),
-                'tpu_topology': spec.topology_str,
+                # An explicit accelerator_args topology (e.g. a
+                # non-default ICI torus like 2x4x4) overrides the
+                # registry default for the chip count.
+                'tpu_topology': (args.get('topology') or
+                                 spec.topology_str),
                 'tpu_num_chips': spec.num_chips,
                 'tpu_num_hosts': spec.num_hosts,
                 'tpu_runtime_version': runtime_version,

@@ -11,11 +11,15 @@ import jax.numpy as jnp
 
 
 def unembed(x, params, cfg):
-    """[b, s, d] -> logits [b, s, V] in f32 (tied embeddings or
-    lm_head)."""
+    """[b, s, d] -> logits [b, s, V], always RETURNED in f32 (CE/
+    sampling numerics) with the matmul itself in f32 or the activation
+    dtype per cfg.logits_in_f32 — the same contract as the flax
+    Transformer's in-module unembedding."""
     if cfg.tie_embeddings:
         kernel = params['embed']['embedding'].T  # [d, V]
     else:
         kernel = params['lm_head']['kernel']
-    return jnp.einsum('bsd,dv->bsv', x.astype(jnp.float32),
-                      kernel.astype(jnp.float32))
+    mm_dtype = jnp.float32 if cfg.logits_in_f32 else cfg.dtype
+    logits = jnp.einsum('bsd,dv->bsv', x.astype(mm_dtype),
+                        kernel.astype(mm_dtype))
+    return logits.astype(jnp.float32)

@@ -1,0 +1,104 @@
+"""Bench callback + framework integration tests (reference:
+sky/callbacks/sky_callback + integrations/)."""
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from skypilot_tpu.callbacks import base
+from skypilot_tpu.callbacks import integrations
+
+
+@pytest.fixture(autouse=True)
+def _fresh_singleton(monkeypatch, _isolated_home):
+    log_dir = str(_isolated_home / 'bench_logs')
+    monkeypatch.setenv(base.ENV_LOG_DIR, log_dir)
+    monkeypatch.setattr(base, '_instance', None)
+    yield log_dir
+
+
+def _summary(log_dir):
+    with open(os.path.join(log_dir, base.SUMMARY_FILE),
+              encoding='utf-8') as f:
+        return json.load(f)
+
+
+class TestBase:
+
+    def test_step_context_and_summary(self, _fresh_singleton):
+        cb = base.init(total_steps=5)
+        for _ in range(3):
+            with cb.step():
+                pass
+        cb.flush()
+        summary = _summary(_fresh_singleton)
+        assert summary['num_steps'] == 3
+        assert summary['total_steps'] == 5
+        assert summary['seconds_per_step'] is not None
+
+    def test_module_level_requires_init(self):
+        with pytest.raises(RuntimeError, match='init'):
+            base.on_step_begin()
+
+
+class TestIntegrations:
+
+    def test_wrap_jax_step(self, _fresh_singleton):
+        calls = []
+
+        def step_fn(state, batch):
+            calls.append(batch)
+            return state + 1, {'loss': 0.0}
+
+        wrapped = integrations.wrap_jax_step(step_fn, total_steps=4)
+        state = 0
+        for i in range(4):
+            state, _ = wrapped(state, i)
+        assert state == 4 and calls == [0, 1, 2, 3]
+        base._instance.flush()  # pylint: disable=protected-access
+        assert _summary(_fresh_singleton)['num_steps'] == 4
+
+    def test_transformers_callback(self, _fresh_singleton):
+        import types
+        cb = integrations.transformers_callback()
+        state = types.SimpleNamespace(max_steps=7)
+        cb.on_train_begin(None, state, None)
+        for _ in range(2):
+            cb.on_step_begin(None, None, None)
+            cb.on_step_end(None, None, None)
+        base._instance.flush()  # pylint: disable=protected-access
+        summary = _summary(_fresh_singleton)
+        assert summary['num_steps'] == 2
+        assert summary['total_steps'] == 7
+
+    def test_lightning_callback_gated(self, _fresh_singleton):
+        pytest.importorskip('pytorch_lightning')
+        cb = integrations.lightning_callback()
+        import types
+        cb.on_train_start(types.SimpleNamespace(max_steps=3), None)
+        cb.on_train_batch_start()
+        cb.on_train_batch_end()
+        base._instance.flush()  # pylint: disable=protected-access
+        assert _summary(_fresh_singleton)['num_steps'] == 1
+
+    def test_keras_callback_gated(self, _fresh_singleton):
+        pytest.importorskip('tensorflow')
+        cb = integrations.keras_callback()
+        cb.on_train_begin()
+        cb.on_train_batch_begin(0)
+        cb.on_train_batch_end(0)
+        base._instance.flush()  # pylint: disable=protected-access
+        assert _summary(_fresh_singleton)['num_steps'] == 1
+
+
+class TestInitContract:
+
+    def test_late_total_steps_adopted_and_log_dir_conflict(
+            self, _fresh_singleton):
+        base.init()
+        cb = base.init(total_steps=9)
+        assert cb.total_steps == 9
+        with pytest.raises(RuntimeError, match='already initialized'):
+            base.init(log_dir='/somewhere/else')

@@ -293,3 +293,22 @@ class TestErrors:
         with pytest.raises(tpu_api.GcpApiError) as err:
             gcp_instance.run_instances(_config())
         assert err.value.is_quota_or_capacity
+
+
+def test_explicit_topology_overrides_registry_default(enable_all_infra):
+    """accelerator_args topology (or the flat YAML spelling) must reach
+    the provisioner deploy vars, not be silently dropped."""
+    from skypilot_tpu import Resources
+    from skypilot_tpu.clouds import registry
+    cloud = registry.from_str('gcp')
+    resources = Resources.from_yaml_config({
+        'cloud': 'gcp', 'accelerators': 'tpu-v5p-32',
+        'topology': '2x4x4'})
+    region = cloud.regions_with_offering(resources)[0]
+    deploy = cloud.make_deploy_resources_variables(
+        resources, 'c1', region, region.zones)
+    assert deploy['tpu_topology'] == '2x4x4'
+    default = cloud.make_deploy_resources_variables(
+        Resources(cloud='gcp', accelerators='tpu-v5p-32'),
+        'c2', region, region.zones)
+    assert default['tpu_topology'] != '2x4x4'
