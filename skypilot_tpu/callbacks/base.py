@@ -96,15 +96,16 @@ def init(log_dir: Optional[str] = None,
                                    total_steps=total_steps)
         return _instance
     # Singleton exists: later callers' arguments must not silently
-    # vanish — total_steps is adopted, a different log_dir is an error
-    # (two destinations cannot both hold the summary).
-    if total_steps is not None:
-        _instance.total_steps = total_steps
+    # vanish — a different log_dir is an error (two destinations cannot
+    # both hold the summary; checked FIRST so a rejected call leaves
+    # the singleton untouched), then total_steps is adopted.
     if (log_dir is not None and
             os.path.expanduser(log_dir) != _instance.log_dir):
         raise RuntimeError(
             f'skytpu callback already initialized with log_dir='
             f'{_instance.log_dir!r}; cannot switch to {log_dir!r}.')
+    if total_steps is not None:
+        _instance.total_steps = total_steps
     return _instance
 
 

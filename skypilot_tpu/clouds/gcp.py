@@ -37,6 +37,26 @@ _DEFAULT_RUNTIME_VERSIONS = {
 _API_GENERATION_NAMES = {'v5e': 'v5litepod'}
 
 
+def _validated_topology(topology: Optional[str],
+                        spec: accelerator_registry.TpuSliceSpec) -> str:
+    """Explicit topology must describe exactly the slice's chip count."""
+    if not topology:
+        return spec.topology_str
+    try:
+        dims = [int(d) for d in str(topology).lower().split('x')]
+        chips = 1
+        for d in dims:
+            chips *= d
+    except ValueError as e:
+        raise ValueError(
+            f'Bad TPU topology {topology!r}; expected NxN[xN].') from e
+    if chips != spec.num_chips:
+        raise ValueError(
+            f'topology {topology!r} is {chips} chips but '
+            f'{spec.name} is a {spec.num_chips}-chip slice.')
+    return str(topology)
+
+
 def tpu_api_accelerator_type(spec: accelerator_registry.TpuSliceSpec) -> str:
     gen = _API_GENERATION_NAMES.get(spec.generation, spec.generation)
     return f'{gen}-{spec.size}'
@@ -185,11 +205,12 @@ class GCP(cloud_lib.Cloud):
                 'tpu': True,
                 'tpu_generation': spec.generation,
                 'tpu_accelerator_type': tpu_api_accelerator_type(spec),
-                # An explicit accelerator_args topology (e.g. a
-                # non-default ICI torus like 2x4x4) overrides the
-                # registry default for the chip count.
-                'tpu_topology': (args.get('topology') or
-                                 spec.topology_str),
+                # An explicit accelerator_args topology (a non-default
+                # ICI torus) overrides the registry default — but only
+                # for the SAME chip count, or the TPU API rejects the
+                # AcceleratorType/topology pair deep in provisioning.
+                'tpu_topology': _validated_topology(
+                    args.get('topology'), spec),
                 'tpu_num_chips': spec.num_chips,
                 'tpu_num_hosts': spec.num_hosts,
                 'tpu_runtime_version': runtime_version,

@@ -71,7 +71,7 @@ class SkyServeController:
                 if self.path == '/controller/load_balancer_sync':
                     self._json(200, {
                         'ready_replica_urls':
-                            controller.replica_manager.ready_urls()})
+                            controller.serving_urls()})
                 else:
                     self._json(404, {'error': 'unknown path'})
 
@@ -83,7 +83,7 @@ class SkyServeController:
                         data.get('request_timestamps', []), time.time())
                     self._json(200, {
                         'ready_replica_urls':
-                            controller.replica_manager.ready_urls()})
+                            controller.serving_urls()})
                 elif self.path == '/controller/update_service':
                     controller.reload_version()
                     self._json(200, {'version': controller.version})
@@ -103,6 +103,27 @@ class SkyServeController:
                          daemon=True).start()
         return self.port
 
+    # ------------------------------------------------------------- traffic
+
+    def serving_urls(self):
+        """Replica URLs the LB should serve.
+
+        rolling: every READY replica (old and new versions mix during
+        an update).  blue_green: the OLD fleet keeps all traffic until
+        the full NEW fleet is READY, then traffic flips to new-only in
+        one step (parity: reference UpdateMode.BLUE_GREEN)."""
+        if self.spec.update_mode != 'blue_green':
+            return self.replica_manager.ready_urls()
+        replicas = self.replica_manager.active_replicas()
+        ready = [r for r in replicas
+                 if r['status'] == ReplicaStatus.READY.value and r['url']]
+        old_ready = [r for r in ready if r['version'] < self.version]
+        new_ready = [r for r in ready if r['version'] >= self.version]
+        target = self.autoscaler.target_num_replicas
+        if old_ready and len(new_ready) < target:
+            return [r['url'] for r in old_ready]  # green not ready yet
+        return [r['url'] for r in new_ready]
+
     # ------------------------------------------------------ rolling update
 
     def reload_version(self) -> None:
@@ -117,10 +138,15 @@ class SkyServeController:
         logger.info(f'service {self.service_name} updated to '
                     f'version {self.version}')
 
-    def _rolling_replace_outdated(self) -> None:
-        """Replace at most one outdated replica per pass, and only when
-        a newer-version replica is READY to take the traffic (rolling
-        update; parity: reference UpdateMode.ROLLING)."""
+    def _replace_outdated(self) -> None:
+        """Retire old-version replicas per the spec's update mode.
+
+        rolling (parity: reference UpdateMode.ROLLING): at most one
+        outdated replica per pass, and only when a newer-version
+        replica is READY to take the traffic.  blue_green (parity:
+        UpdateMode.BLUE_GREEN): the old fleet is untouched until the
+        FULL new fleet is READY (serving_urls flips traffic at that
+        moment), then every outdated replica is retired at once."""
         replicas = self.replica_manager.active_replicas()
         outdated = [r for r in replicas if r['version'] < self.version]
         if not outdated:
@@ -133,6 +159,11 @@ class SkyServeController:
         target = self.autoscaler.target_num_replicas
         if len(current) < target:
             return  # new-version capacity still coming up
+        if self.spec.update_mode == 'blue_green':
+            if len(current_ready) >= target:
+                for replica in outdated:
+                    self.replica_manager.scale_down(replica['replica_id'])
+            return
         if current_ready:
             self.replica_manager.scale_down(outdated[0]['replica_id'])
 
@@ -168,7 +199,7 @@ class SkyServeController:
                                r['replica_id']))
             for replica in candidates[:extra]:
                 self.replica_manager.scale_down(replica['replica_id'])
-        self._rolling_replace_outdated()
+        self._replace_outdated()
         self._update_service_status()
 
     def _update_service_status(self) -> None:

@@ -27,7 +27,8 @@ class SkyServiceSpec:
                  downscale_delay_seconds: int = 1200,
                  replica_port: int = 8080,
                  base_ondemand_fallback_replicas: int = 0,
-                 load_balancing_policy: Optional[str] = None) -> None:
+                 load_balancing_policy: Optional[str] = None,
+                 update_mode: str = 'rolling') -> None:
         if not readiness_path.startswith('/'):
             raise exceptions.InvalidTaskError(
                 f'readiness path must start with /, got {readiness_path!r}')
@@ -56,6 +57,11 @@ class SkyServiceSpec:
                     f'{load_balancing_policy!r}; have '
                     f'{sorted(lb_lib.POLICIES)}')
         self.load_balancing_policy = load_balancing_policy
+        if update_mode not in ('rolling', 'blue_green'):
+            raise exceptions.InvalidTaskError(
+                f'update_mode must be rolling or blue_green, '
+                f'got {update_mode!r}')
+        self.update_mode = update_mode
 
     @property
     def autoscaling_enabled(self) -> bool:
@@ -68,7 +74,8 @@ class SkyServiceSpec:
         config = dict(config)
         common_utils.validate_schema_keys(
             config, {'readiness_probe', 'replica_policy', 'replicas',
-                     'replica_port', 'load_balancing_policy'}, 'service')
+                     'replica_port', 'load_balancing_policy',
+                     'update_mode'}, 'service')
         kwargs: Dict[str, Any] = {}
         probe = config.get('readiness_probe')
         if isinstance(probe, str):
@@ -111,6 +118,8 @@ class SkyServiceSpec:
         if config.get('load_balancing_policy') is not None:
             kwargs['load_balancing_policy'] = str(
                 config['load_balancing_policy'])
+        if config.get('update_mode') is not None:
+            kwargs['update_mode'] = str(config['update_mode'])
         return cls(**kwargs)
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -136,6 +145,8 @@ class SkyServiceSpec:
                 self.base_ondemand_fallback_replicas)
         if self.load_balancing_policy is not None:
             config['load_balancing_policy'] = self.load_balancing_policy
+        if self.update_mode != 'rolling':
+            config['update_mode'] = self.update_mode
         return config
 
     def __repr__(self) -> str:

@@ -301,14 +301,25 @@ def test_explicit_topology_overrides_registry_default(enable_all_infra):
     from skypilot_tpu import Resources
     from skypilot_tpu.clouds import registry
     cloud = registry.from_str('gcp')
+    # tpu-v5p-32 counts cores: 16 chips; 4x2x2 is a valid non-default
+    # 16-chip torus.
     resources = Resources.from_yaml_config({
         'cloud': 'gcp', 'accelerators': 'tpu-v5p-32',
-        'topology': '2x4x4'})
+        'topology': '4x2x2'})
     region = cloud.regions_with_offering(resources)[0]
     deploy = cloud.make_deploy_resources_variables(
         resources, 'c1', region, region.zones)
-    assert deploy['tpu_topology'] == '2x4x4'
+    assert deploy['tpu_topology'] == '4x2x2'
     default = cloud.make_deploy_resources_variables(
         Resources(cloud='gcp', accelerators='tpu-v5p-32'),
         'c2', region, region.zones)
-    assert default['tpu_topology'] != '2x4x4'
+    assert default['tpu_topology'] != '4x2x2'
+    # A topology whose chip product mismatches the slice is rejected
+    # at validation time, not deep in provisioning.
+    import pytest as _pytest
+    bad = Resources.from_yaml_config({
+        'cloud': 'gcp', 'accelerators': 'tpu-v5p-32',
+        'topology': '2x4x4'})  # 32 chips != 16
+    with _pytest.raises(ValueError, match='16-chip'):
+        cloud.make_deploy_resources_variables(bad, 'c3', region,
+                                              region.zones)

@@ -301,6 +301,46 @@ class TestControllerE2E:
             controller.replica_manager.terminate_all()
 
 
+    def test_blue_green_update(self):
+        """Old fleet serves until the FULL new fleet is READY; traffic
+        then flips at once and every old replica retires together."""
+        task = _serve_task(name='svc-bg', update_mode='blue_green')
+        _register_service(task, 'svc-bg')
+        controller = SkyServeController('svc-bg')
+        controller.start_http()
+        try:
+            assert _drive(controller,
+                          lambda: controller.replica_manager.ready_urls())
+            old = serve_state.get_replicas('svc-bg')[0]
+            old_url = old['url']
+            serve_state.update_service_spec(
+                'svc-bg', task.service.to_yaml_config(),
+                serve_state.get_service('svc-bg')['task_yaml_path'])
+
+            saw_old_serving_during_update = []
+
+            def flipped():
+                active = controller.replica_manager.active_replicas()
+                urls = controller.serving_urls()
+                old_active = [r for r in active if r['version'] == 1]
+                new_ready = [r for r in active if r['version'] == 2 and
+                             r['status'] == ReplicaStatus.READY.value]
+                if old_active and not new_ready:
+                    # Mid-update: blue must still hold ALL traffic.
+                    saw_old_serving_during_update.append(
+                        urls == [old_url])
+                return (active and
+                        all(r['version'] == 2 for r in active) and
+                        urls and old_url not in urls)
+
+            assert _drive(controller, flipped)
+            assert saw_old_serving_during_update
+            assert all(saw_old_serving_during_update)
+        finally:
+            controller.stop()
+            controller.replica_manager.terminate_all()
+
+
 class TestServeClientAPI:
 
     def test_up_status_down_daemonized(self):
