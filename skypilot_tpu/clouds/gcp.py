@@ -50,6 +50,10 @@ def _validated_topology(topology: Optional[str],
     except ValueError as e:
         raise ValueError(
             f'Bad TPU topology {topology!r}; expected NxN[xN].') from e
+    if len(dims) < 2 or any(d <= 0 for d in dims):
+        raise ValueError(
+            f'Bad TPU topology {topology!r}; expected >= 2 positive '
+            'dims like 4x4 or 2x2x4.')
     if chips != spec.num_chips:
         raise ValueError(
             f'topology {topology!r} is {chips} chips but '

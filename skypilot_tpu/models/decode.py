@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from skypilot_tpu.models import heads
 from skypilot_tpu.models.configs import ModelConfig
+from skypilot_tpu.models.quantize import maybe_dequant
 from skypilot_tpu.models.transformer import _rope
 from skypilot_tpu.ops.attention import NEG_INF
 from skypilot_tpu.ops.attention import flash_attention
@@ -61,7 +62,8 @@ def _layer_params(params: Dict[str, Any], cfg: ModelConfig):
 def _attn_proj(x, proj):
     """[b, s, d_model] x [d_model, heads, hd] -> [b, heads, s, hd].
     `proj` is the q/k/v param dict; bias present iff cfg.qkv_bias."""
-    out = jnp.einsum('bsd,dhk->bhsk', x, proj['kernel'].astype(x.dtype))
+    out = jnp.einsum('bsd,dhk->bhsk', x,
+                     maybe_dequant(proj['kernel'], x.dtype))
     bias = proj.get('bias')
     if bias is not None:  # [heads, hd] -> broadcast over [b, ., s, .]
         out = out + bias.astype(x.dtype)[None, :, None, :]
@@ -73,11 +75,14 @@ def _mlp(x, lp, cfg):
         return _moe_mlp(x, lp['moe_mlp'], cfg)
     act = {'silu': jax.nn.silu, 'gelu': jax.nn.gelu}[cfg.mlp_act]
     gate = jnp.einsum('bsd,df->bsf', x,
-                      lp['mlp']['gate_proj']['kernel'].astype(x.dtype))
+                      maybe_dequant(lp['mlp']['gate_proj']['kernel'],
+                                    x.dtype))
     up = jnp.einsum('bsd,df->bsf', x,
-                    lp['mlp']['up_proj']['kernel'].astype(x.dtype))
+                    maybe_dequant(lp['mlp']['up_proj']['kernel'],
+                                  x.dtype))
     return jnp.einsum('bsf,fd->bsd', act(gate) * up,
-                      lp['mlp']['down_proj']['kernel'].astype(x.dtype))
+                      maybe_dequant(lp['mlp']['down_proj']['kernel'],
+                                    x.dtype))
 
 
 def _moe_mlp(x, mp, cfg):
@@ -90,12 +95,16 @@ def _moe_mlp(x, mp, cfg):
     buys nothing)."""
     b, s, d = x.shape
     tokens = x.reshape(b * s, d)
+    # Router stays full precision (routing decisions are
+    # quality-critical); expert stacks may be int8.
+    w_gate = maybe_dequant(mp['gate_proj'], jnp.float32)
+    w_up = maybe_dequant(mp['up_proj'], jnp.float32)
+    w_down = maybe_dequant(mp['down_proj'], jnp.float32)
     logits = jnp.einsum('nd,de->ne', tokens.astype(jnp.float32),
                         mp['router']['kernel'].astype(jnp.float32))
     if s > 1:
         from skypilot_tpu.models import moe  # pylint: disable=import-outside-toplevel
-        out, _ = moe.moe_apply(tokens, logits, mp['gate_proj'],
-                               mp['up_proj'], mp['down_proj'], cfg)
+        out, _ = moe.moe_apply(tokens, logits, w_gate, w_up, w_down, cfg)
         return out.astype(x.dtype).reshape(b, s, d)
     probs = jax.nn.softmax(logits, axis=-1)
     gate_vals, gate_idx = jax.lax.top_k(probs, cfg.expert_top_k)
@@ -107,12 +116,9 @@ def _moe_mlp(x, mp, cfg):
         gate_vals[..., None], axis=1)                    # [N, E]
     xt = tokens.astype(jnp.float32)
     act = {'silu': jax.nn.silu, 'gelu': jax.nn.gelu}[cfg.mlp_act]
-    h = act(jnp.einsum('nd,edf->nef', xt,
-                       mp['gate_proj'].astype(jnp.float32)))
-    h = h * jnp.einsum('nd,edf->nef', xt,
-                       mp['up_proj'].astype(jnp.float32))
-    out_e = jnp.einsum('nef,efd->ned', h,
-                       mp['down_proj'].astype(jnp.float32))
+    h = act(jnp.einsum('nd,edf->nef', xt, w_gate))
+    h = h * jnp.einsum('nd,edf->nef', xt, w_up)
+    out_e = jnp.einsum('nef,efd->ned', h, w_down)
     out = jnp.einsum('ne,ned->nd', gates, out_e)
     return out.astype(x.dtype).reshape(b, s, d)
 
@@ -167,7 +173,8 @@ def _layer_forward(x, lp, cfg, positions, k_cache, v_cache, cache_len,
         out = out.reshape(b, h, qs, d).astype(x.dtype)
 
     out = jnp.einsum('bhsk,hkd->bsd', out,
-                     lp['attn']['o_proj']['kernel'].astype(x.dtype))
+                     maybe_dequant(lp['attn']['o_proj']['kernel'],
+                                   x.dtype))
     x = x + out
     h = _norm(x, lp['mlp_norm']['scale'], cfg.norm_eps,
               cfg.norm_scale_plus_one)

@@ -9,17 +9,18 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from skypilot_tpu.models.quantize import maybe_dequant
+
 
 def unembed(x, params, cfg):
     """[b, s, d] -> logits [b, s, V], always RETURNED in f32 (CE/
     sampling numerics) with the matmul itself in f32 or the activation
     dtype per cfg.logits_in_f32 — the same contract as the flax
     Transformer's in-module unembedding."""
-    if cfg.tie_embeddings:
-        kernel = params['embed']['embedding'].T  # [d, V]
-    else:
-        kernel = params['lm_head']['kernel']
     mm_dtype = jnp.float32 if cfg.logits_in_f32 else cfg.dtype
-    logits = jnp.einsum('bsd,dv->bsv', x.astype(mm_dtype),
-                        kernel.astype(mm_dtype))
+    if cfg.tie_embeddings:
+        kernel = params['embed']['embedding'].astype(mm_dtype).T  # [d, V]
+    else:
+        kernel = maybe_dequant(params['lm_head']['kernel'], mm_dtype)
+    logits = jnp.einsum('bsd,dv->bsv', x.astype(mm_dtype), kernel)
     return logits.astype(jnp.float32)

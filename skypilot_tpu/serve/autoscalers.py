@@ -45,6 +45,19 @@ class RequestRateAutoscaler:
 
     # ------------------------------------------------------------- inputs
 
+    def carry_over(self, old: 'RequestRateAutoscaler') -> None:
+        """Adopt a predecessor's live state across a service update.
+
+        A version reload replaces the autoscaler object; without this,
+        target_num_replicas collapses to min_replicas and the request
+        history vanishes — mid-update that reads as "new fleet of 1 is
+        enough" and blue_green flips a 5-replica service onto a single
+        replica (a capacity cliff under live load)."""
+        self.request_timestamps = list(old.request_timestamps)
+        self.target_num_replicas = max(
+            self.min_replicas,
+            min(old.target_num_replicas, self.max_replicas))
+
     def collect_request_information(self, timestamps: List[float],
                                     now: float) -> None:
         self.request_timestamps.extend(timestamps)

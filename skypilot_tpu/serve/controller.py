@@ -134,7 +134,12 @@ class SkyServeController:
         self.spec = SkyServiceSpec.from_yaml_config(record['spec'])
         task = task_lib.Task.from_yaml(record['task_yaml_path'])
         self.replica_manager.set_version(self.spec, task, self.version)
-        self.autoscaler = autoscalers.make_autoscaler(self.spec)
+        new_scaler = autoscalers.make_autoscaler(self.spec)
+        # Keep live request history + scale target across the update
+        # (a reset would collapse the blue-green flip threshold to
+        # min_replicas — a capacity cliff).
+        new_scaler.carry_over(self.autoscaler)
+        self.autoscaler = new_scaler
         logger.info(f'service {self.service_name} updated to '
                     f'version {self.version}')
 

@@ -168,3 +168,23 @@ def test_restore_params_from_training_checkpoint(tmp_path):
         lambda a, b: np.testing.assert_array_equal(
             np.asarray(a), np.asarray(b)),
         srv.params, expected)
+
+
+def test_quantized_server_generates():
+    """--quantize int8: weights live as int8 + scales, and generation
+    still serves tokens through the HTTP surface."""
+    from skypilot_tpu.models import quantize as quantize_lib
+    server = model_server.ModelServer('tiny', max_len=32, max_batch=2,
+                                      quantize='int8')
+    layer = server.params['layers']['layer']
+    assert quantize_lib.is_quantized_leaf(layer['attn']['q_proj']['kernel'])
+    port, shutdown = model_server.start_background(server)
+    try:
+        resp = requests.post(
+            f'http://127.0.0.1:{port}/generate',
+            json={'prompt_ids': [[1, 2, 3]], 'max_new_tokens': 3},
+            timeout=120)
+        resp.raise_for_status()
+        assert len(resp.json()['tokens'][0]) == 3
+    finally:
+        shutdown()

@@ -364,3 +364,26 @@ class TestServeClientAPI:
             serve_core.down('svc-api', purge=True)
         assert serve_core.status(['svc-api']) == []
         assert sky.status() == []
+
+
+class TestAutoscalerCarryOver:
+
+    def test_update_preserves_scale_target(self):
+        """A version reload must not collapse the autoscaler target to
+        min_replicas mid-update (the blue-green flip threshold)."""
+        from skypilot_tpu.serve import autoscalers
+        spec = _spec(min_replicas=1, max_replicas=8,
+                     target_qps_per_replica=1.0)
+        old = autoscalers.make_autoscaler(spec)
+        old.target_num_replicas = 5
+        old.request_timestamps = [time.time()] * 50
+        new = autoscalers.make_autoscaler(spec)
+        new.carry_over(old)
+        assert new.target_num_replicas == 5
+        assert len(new.request_timestamps) == 50
+        # Clamped into the NEW spec's bounds.
+        small = autoscalers.make_autoscaler(
+            _spec(min_replicas=1, max_replicas=3,
+                  target_qps_per_replica=1.0))
+        small.carry_over(old)
+        assert small.target_num_replicas == 3
