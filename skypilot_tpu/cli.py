@@ -416,13 +416,45 @@ def jobs_group():
 @click.option('--yes', '-y', is_flag=True, default=False)
 @_add_options(_TASK_OPTIONS)
 def jobs_launch(entrypoint, detach_run, yes, **task_args):
-    """Launch a managed job (supervised, auto-recovered)."""
+    """Launch a managed job (supervised, auto-recovered).
+
+    A multi-document YAML is a chain pipeline: each stage runs on its
+    own cluster in order, supervised end-to-end (parity: reference
+    managed-jobs pipelines)."""
     from skypilot_tpu import jobs  # pylint: disable=import-outside-toplevel
-    task = _make_task(entrypoint, **task_args)
+    entry = _load_chain_if_multidoc(entrypoint, task_args)
+    if entry is None:
+        entry = _make_task(entrypoint, **task_args)
     if not yes:
         click.confirm('Launch managed job?', default=True, abort=True)
-    job_id = jobs.launch(task, detach_run=detach_run)
+    job_id = jobs.launch(entry, detach_run=detach_run)
     click.echo(f'Managed job ID: {job_id}')
+
+
+def _load_chain_if_multidoc(entrypoint, task_args):
+    """-> Dag when `entrypoint` is a multi-document YAML, else None."""
+    if not (entrypoint and (entrypoint.endswith(('.yaml', '.yml')) or
+                            os.path.isfile(
+                                os.path.expanduser(entrypoint)))):
+        return None
+    from skypilot_tpu.utils import common_utils  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.utils import dag_utils  # pylint: disable=import-outside-toplevel
+    try:
+        docs = [d for d in common_utils.read_yaml_all(
+            os.path.expanduser(entrypoint)) if d]
+    except OSError:
+        return None
+    if len(docs) <= 1:
+        return None
+    overrides = {k: v for k, v in task_args.items()
+                 if v not in (None, ())}
+    if overrides:
+        raise click.UsageError(
+            f'CLI task overrides {sorted(overrides)} cannot apply to a '
+            'multi-stage pipeline YAML; set per-stage fields in the '
+            'file instead.')
+    return dag_utils.load_chain_dag_from_yaml(
+        os.path.expanduser(entrypoint))
 
 
 @jobs_group.command(name='queue')

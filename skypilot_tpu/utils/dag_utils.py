@@ -26,12 +26,15 @@ def convert_entrypoint_to_dag(
 def load_chain_dag_from_yaml(yaml_path: str) -> dag_lib.Dag:
     """A YAML file with multiple documents is a chain DAG (managed jobs)."""
     from skypilot_tpu.utils import common_utils  # pylint: disable=import-outside-toplevel
-    configs = common_utils.read_yaml_all(yaml_path)
+    configs = [c for c in common_utils.read_yaml_all(yaml_path) if c]
     dag = dag_lib.Dag()
+    # Reference convention: a first document containing ONLY `name:`
+    # names the pipeline; it is not a task.
+    if len(configs) > 1 and set(configs[0]) == {'name'}:
+        dag.name = configs[0]['name']
+        configs = configs[1:]
     prev = None
     for config in configs:
-        if not config:
-            continue
         task = task_lib.Task.from_yaml_config(config)
         dag.add(task)
         if prev is not None:
@@ -43,9 +46,16 @@ def load_chain_dag_from_yaml(yaml_path: str) -> dag_lib.Dag:
 
 def dump_chain_dag_to_yaml(dag: dag_lib.Dag, yaml_path: str) -> None:
     """Serialize a chain DAG as a multi-document YAML (inverse of
-    load_chain_dag_from_yaml)."""
+    load_chain_dag_from_yaml).
+
+    A name-only header document always leads, so the round trip
+    preserves the DAG name AND a first task that happens to serialize
+    to only `name:` can never be mistaken for the header on reload.
+    """
     import yaml  # pylint: disable=import-outside-toplevel
-    configs = [task.to_yaml_config() for task in dag.tasks]
+    configs = [{'name': dag.name or (dag.tasks[0].name if dag.tasks
+                                     else None)}]
+    configs += [task.to_yaml_config() for task in dag.tasks]
     with open(yaml_path, 'w', encoding='utf-8') as f:
         yaml.safe_dump_all(configs, f, default_flow_style=False,
                            sort_keys=False)

@@ -211,3 +211,40 @@ class TestClientAPI:
                 break
             time.sleep(0.5)
         assert state.get_status(job_id) is ManagedJobStatus.SUCCEEDED
+
+
+def test_pipeline_yaml_header_doc_names_dag(tmp_path):
+    """A first document with only `name:` names the pipeline (reference
+    convention) instead of becoming a phantom no-op task."""
+    from skypilot_tpu.utils import dag_utils
+    p = tmp_path / 'pipe.yaml'
+    p.write_text('name: my-pipe\n---\nname: a\nrun: echo a\n---\n'
+                 'name: b\nrun: echo b\n')
+    dag = dag_utils.load_chain_dag_from_yaml(str(p))
+    assert dag.name == 'my-pipe'
+    assert [t.name for t in dag.tasks] == ['a', 'b']
+    # A single-doc YAML whose only key is name still loads as a task.
+    p2 = tmp_path / 'single.yaml'
+    p2.write_text('name: solo\n')
+    dag2 = dag_utils.load_chain_dag_from_yaml(str(p2))
+    assert [t.name for t in dag2.tasks] == ['solo']
+
+
+def test_chain_dump_load_round_trip_preserves_all_tasks(tmp_path):
+    """Round trip keeps the DAG name and every task — including a
+    name-only first task that would otherwise be mistaken for the
+    pipeline header."""
+    from skypilot_tpu import dag as dag_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.utils import dag_utils
+    dag = dag_lib.Dag('pipe')
+    gate = task_lib.Task(name='gate')  # serializes to name-only
+    train = task_lib.Task(name='train', run='echo t')
+    dag.add(gate)
+    dag.add(train)
+    dag.add_edge(gate, train)
+    p = tmp_path / 'round.yaml'
+    dag_utils.dump_chain_dag_to_yaml(dag, str(p))
+    loaded = dag_utils.load_chain_dag_from_yaml(str(p))
+    assert loaded.name == 'pipe'
+    assert [t.name for t in loaded.tasks] == ['gate', 'train']
