@@ -30,6 +30,12 @@ def _parse_env(env: Tuple[str, ...]) -> Dict[str, str]:
     return out
 
 
+def _entrypoint_is_yaml(entrypoint: Optional[str]) -> bool:
+    return bool(entrypoint and
+                (entrypoint.endswith(('.yaml', '.yml')) or
+                 os.path.isfile(os.path.expanduser(entrypoint))))
+
+
 def _make_task(entrypoint: Optional[str], *, name: Optional[str],
                workdir: Optional[str], cloud: Optional[str],
                region: Optional[str], zone: Optional[str],
@@ -45,8 +51,7 @@ def _make_task(entrypoint: Optional[str], *, name: Optional[str],
     from skypilot_tpu import resources as resources_lib  # pylint: disable=import-outside-toplevel
     from skypilot_tpu import task as task_lib  # pylint: disable=import-outside-toplevel
 
-    if entrypoint and (entrypoint.endswith(('.yaml', '.yml')) or
-                       os.path.isfile(os.path.expanduser(entrypoint))):
+    if _entrypoint_is_yaml(entrypoint):
         task = task_lib.Task.from_yaml(entrypoint)
     else:
         cmd = command if command is not None else entrypoint
@@ -235,6 +240,22 @@ def _print_table(headers: List[str], rows: List[tuple]) -> None:
 
 
 # ------------------------------------------------------- lifecycle verbs
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('port', required=False, type=int)
+def endpoints(cluster, port):
+    """Show a cluster's exposed port endpoints.
+
+    Parity: reference `sky status --endpoints` / core.endpoints."""
+    from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+    try:
+        eps = core.endpoints(cluster, port=port)
+    except Exception as e:  # pylint: disable=broad-except
+        raise click.ClickException(str(e)) from e
+    for p, addr in sorted(eps.items()):
+        click.echo(f'{p}: http://{addr}')
 
 
 @cli.command()
@@ -433,9 +454,7 @@ def jobs_launch(entrypoint, detach_run, yes, **task_args):
 
 def _load_chain_if_multidoc(entrypoint, task_args):
     """-> Dag when `entrypoint` is a multi-document YAML, else None."""
-    if not (entrypoint and (entrypoint.endswith(('.yaml', '.yml')) or
-                            os.path.isfile(
-                                os.path.expanduser(entrypoint)))):
+    if not _entrypoint_is_yaml(entrypoint):
         return None
     from skypilot_tpu.utils import common_utils  # pylint: disable=import-outside-toplevel
     from skypilot_tpu.utils import dag_utils  # pylint: disable=import-outside-toplevel
@@ -453,8 +472,7 @@ def _load_chain_if_multidoc(entrypoint, task_args):
             f'CLI task overrides {sorted(overrides)} cannot apply to a '
             'multi-stage pipeline YAML; set per-stage fields in the '
             'file instead.')
-    return dag_utils.load_chain_dag_from_yaml(
-        os.path.expanduser(entrypoint))
+    return dag_utils.load_chain_dag_from_configs(docs)
 
 
 @jobs_group.command(name='queue')

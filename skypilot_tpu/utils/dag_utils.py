@@ -27,10 +27,17 @@ def load_chain_dag_from_yaml(yaml_path: str) -> dag_lib.Dag:
     """A YAML file with multiple documents is a chain DAG (managed jobs)."""
     from skypilot_tpu.utils import common_utils  # pylint: disable=import-outside-toplevel
     configs = [c for c in common_utils.read_yaml_all(yaml_path) if c]
+    return load_chain_dag_from_configs(configs)
+
+
+def load_chain_dag_from_configs(configs) -> dag_lib.Dag:
+    """Chain DAG from already-parsed YAML documents (callers that have
+    the docs in hand avoid re-reading the file)."""
     dag = dag_lib.Dag()
-    # Reference convention: a first document containing ONLY `name:`
-    # names the pipeline; it is not a task.
-    if len(configs) > 1 and set(configs[0]) == {'name'}:
+    # Reference convention: a first MAPPING document containing ONLY
+    # `name:` names the pipeline; it is not a task.
+    if (len(configs) > 1 and isinstance(configs[0], dict) and
+            set(configs[0]) == {'name'}):
         dag.name = configs[0]['name']
         configs = configs[1:]
     prev = None

@@ -72,3 +72,17 @@ class TestEndpointsNoPorts:
                             lambda name: handle)
         with pytest.raises(ValueError, match='no open ports'):
             core.endpoints('c')
+
+
+def test_cli_endpoints_command(monkeypatch):
+    from click.testing import CliRunner
+    from skypilot_tpu import cli
+    handle = _FakeHandle(['9.9.9.9'], [8080])
+    monkeypatch.setattr(backend_utils, 'check_cluster_available',
+                        lambda name: handle)
+    result = CliRunner().invoke(cli.cli, ['endpoints', 'c1'])
+    assert result.exit_code == 0, result.output
+    assert '8080: http://9.9.9.9:8080' in result.output
+    result = CliRunner().invoke(cli.cli, ['endpoints', 'c1', '9'])
+    assert result.exit_code != 0
+    assert 'not opened' in result.output
