@@ -165,7 +165,12 @@ def _layer_forward(x, lp, cfg, positions, k_cache, v_cache, cache_len,
         s = jnp.einsum('bgrqd,bgkd->bgrqk', qg, k32) * (
             cfg.head_dim ** -0.5)
         kpos = jnp.arange(k_cache.shape[2])
-        mask = kpos[None, None, None, None, :] < cache_len
+        # cache_len is a scalar (single-sequence decode) or [B]
+        # (slot-batched decode — every slot at its own depth).
+        cl = jnp.asarray(cache_len)
+        if cl.ndim == 1:
+            cl = cl[:, None, None, None, None]
+        mask = kpos[None, None, None, None, :] < cl
         s = jnp.where(mask, s, NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum('bgrqk,bgkd->bgrqd', p,
@@ -181,27 +186,22 @@ def _layer_forward(x, lp, cfg, positions, k_cache, v_cache, cache_len,
     return x + _mlp(h, lp, cfg)
 
 
-def _write_cache(k_cache, v_cache, k_new, v_new, start):
-    """Write k/v [b, h_kv, s, d] into the cache at [start, start+s)."""
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k_new.astype(k_cache.dtype), (0, 0, start, 0))
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v_new.astype(v_cache.dtype), (0, 0, start, 0))
-    return k_cache, v_cache
-
-
-def _forward_with_cache(cfg, params, tokens, cache, *, use_flash: bool):
-    """Shared prefill/step body: embeds tokens at cache['index'],
-    updates every layer's cache, returns (logits_last, new_cache)."""
-    layers = _layer_params(params, cfg)
-    b, s = tokens.shape
-    start = cache['index']
-    positions = start + jnp.arange(s)
+def _embed(cfg, params, tokens):
     x = jnp.take(params['embed']['embedding'], tokens,
                  axis=0).astype(cfg.dtype)
     if cfg.scale_embeddings:  # Gemma
         x = x * jnp.asarray(cfg.d_model ** 0.5, x.dtype)
-    cache_len = start + s
+    return x
+
+
+def _scan_layers_and_unembed(cfg, params, x, positions, cache_k, cache_v,
+                             cache_len, write_fn, *, use_flash: bool):
+    """The shared per-layer loop: project+rope k/v, write them into the
+    cache via `write_fn(k_cache, k_new) -> k_cache`, run the layer, then
+    final-norm + unembed the last position.  Single-sequence decode and
+    slot-batched decode differ ONLY in write_fn / positions / cache_len
+    shapes."""
+    layers = _layer_params(params, cfg)
 
     def body(x, layer_state):
         lp, k_cache, v_cache = layer_state
@@ -210,19 +210,37 @@ def _forward_with_cache(cfg, params, tokens, cache, *, use_flash: bool):
         k = _attn_proj(h, lp['attn']['k_proj'])
         v = _attn_proj(h, lp['attn']['v_proj'])
         k = _rope(k, positions, cfg.rope_theta)
-        k_cache, v_cache = _write_cache(k_cache, v_cache, k, v, start)
+        k_cache = write_fn(k_cache, k)
+        v_cache = write_fn(v_cache, v)
         x = _layer_forward(x, lp, cfg, positions, k_cache, v_cache,
                            cache_len, use_flash=use_flash)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(
         lambda carry, ls: body(carry, ls),
-        x, (layers, cache['k'], cache['v']))
+        x, (layers, cache_k, cache_v))
     x = _norm(x[:, -1:], params['final_norm']['scale'], cfg.norm_eps,
               cfg.norm_scale_plus_one)
     logits = heads.unembed(x, params, cfg)[:, 0]
-    new_cache = {'k': new_k, 'v': new_v, 'index': cache_len}
-    return logits, new_cache
+    return logits, new_k, new_v
+
+
+def _forward_with_cache(cfg, params, tokens, cache, *, use_flash: bool):
+    """Shared prefill/step body: embeds tokens at cache['index'],
+    updates every layer's cache, returns (logits_last, new_cache)."""
+    _, s = tokens.shape
+    start = cache['index']
+    positions = start + jnp.arange(s)
+    cache_len = start + s
+
+    def write(c, new):
+        return jax.lax.dynamic_update_slice(
+            c, new.astype(c.dtype), (0, 0, start, 0))
+
+    logits, new_k, new_v = _scan_layers_and_unembed(
+        cfg, params, _embed(cfg, params, tokens), positions,
+        cache['k'], cache['v'], cache_len, write, use_flash=use_flash)
+    return logits, {'k': new_k, 'v': new_v, 'index': cache_len}
 
 
 def prefill(cfg: ModelConfig, params, tokens, *, max_len: int):
@@ -289,3 +307,60 @@ def generate(cfg: ModelConfig, params, prompt, *, max_new_tokens: int,
     new_tokens = jnp.concatenate(
         [first[:, None], rest.transpose(1, 0)], axis=1)
     return jnp.concatenate([prompt, new_tokens], axis=1), new_tokens
+
+
+# -------------------------------------------------- slot-batched decoding
+# Building blocks for continuous batching (serve/batching_engine.py):
+# a fixed pool of B cache slots, each at its OWN depth, decoded
+# together in one jit'd step.  Static shapes throughout — slots, not
+# requests, are the batch dimension.
+
+
+def init_slot_cache(cfg: ModelConfig, slots: int, max_len: int
+                    ) -> Dict[str, Any]:
+    """Zeroed slot cache: like init_cache but with per-slot lengths."""
+    shape = (cfg.n_layers, slots, cfg.n_kv_heads, max_len, cfg.head_dim)
+    return {
+        'k': jnp.zeros(shape, cfg.dtype),
+        'v': jnp.zeros(shape, cfg.dtype),
+        'lengths': jnp.zeros((slots,), jnp.int32),
+    }
+
+
+def insert_prefill(slot_cache: Dict[str, Any], slot: int,
+                   prefill_cache: Dict[str, Any],
+                   length) -> Dict[str, Any]:
+    """Adopt a single-sequence prefill cache ([L, 1, h_kv, max_len, d])
+    into slot `slot`.  Jit-safe (slot may be traced)."""
+    k = jax.lax.dynamic_update_slice_in_dim(
+        slot_cache['k'], prefill_cache['k'].astype(slot_cache['k'].dtype),
+        slot, axis=1)
+    v = jax.lax.dynamic_update_slice_in_dim(
+        slot_cache['v'], prefill_cache['v'].astype(slot_cache['v'].dtype),
+        slot, axis=1)
+    lengths = slot_cache['lengths'].at[slot].set(
+        jnp.asarray(length, jnp.int32))
+    return {'k': k, 'v': v, 'lengths': lengths}
+
+
+def batched_step(cfg: ModelConfig, params, tokens, slot_cache):
+    """One decode step across ALL slots; each slot attends its own
+    depth.  tokens [B, 1]; returns (logits [B, V], new slot_cache with
+    every length advanced by 1 — callers ignore/reset inactive slots).
+    """
+    lengths = slot_cache['lengths']                    # [B]
+    positions = lengths[:, None]                       # [B, 1]
+
+    def write(c, new):
+        # Per-slot scatter at that slot's depth: vmap the single-
+        # sequence dynamic_update_slice over the slot axis.
+        return jax.vmap(
+            lambda cc, nn, st: jax.lax.dynamic_update_slice(
+                cc, nn.astype(cc.dtype), (0, st, 0))
+        )(c, new, lengths)
+
+    logits, new_k, new_v = _scan_layers_and_unembed(
+        cfg, params, _embed(cfg, params, tokens), positions,
+        slot_cache['k'], slot_cache['v'], lengths + 1, write,
+        use_flash=False)
+    return logits, {'k': new_k, 'v': new_v, 'lengths': lengths + 1}

@@ -24,12 +24,18 @@ from skypilot_tpu.ops import ulysses_attention
 
 
 def _rope(x, positions, theta: float):
-    """Rotary embeddings on [b, h, s, d] with positions [s]."""
+    """Rotary embeddings on [b, h, s, d]; positions [s] (shared) or
+    [b, s] (per-sequence — continuous batching decodes slots at
+    different depths in one step)."""
     d = x.shape[-1]
     freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
-    cos = jnp.cos(angles)[None, None]  # [1,1,s,d/2]
-    sin = jnp.sin(angles)[None, None]
+    angles = positions[..., :, None].astype(jnp.float32) * freqs
+    if angles.ndim == 2:
+        cos = jnp.cos(angles)[None, None]   # [1,1,s,d/2]
+        sin = jnp.sin(angles)[None, None]
+    else:
+        cos = jnp.cos(angles)[:, None]      # [b,1,s,d/2]
+        sin = jnp.sin(angles)[:, None]
     x1, x2 = x[..., ::2], x[..., 1::2]
     y1 = x1 * cos - x2 * sin
     y2 = x1 * sin + x2 * cos

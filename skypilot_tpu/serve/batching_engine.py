@@ -1,0 +1,230 @@
+"""Continuous batching engine for the model server.
+
+vLLM-style scheduling, rebuilt TPU-first (no reference equivalent —
+SkyPilot ships no serving internals): a FIXED pool of KV-cache slots is
+the batch dimension, so every jit'd shape is static.  Requests join a
+running batch the moment a slot frees (no wait for the batch to drain),
+and one `models.decode.batched_step` call advances every active slot a
+token per engine tick — new arrivals ride along with half-finished
+generations.
+
+Exact-prefill trick for static shapes: the prompt's first n-1 tokens
+are prefilled PADDED to a power-of-two bucket (bounding compile count),
+the slot is inserted at length n-1, and the LAST real prompt token is
+fed through the next batched step — it overwrites the first pad
+position and attends only real keys, so logits match unpadded decode
+exactly (tests pin this against decode.generate).
+
+Greedy decoding (temperature 0) — the deterministic serving default;
+per-request stop token and max_new_tokens.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+class _Request:
+
+    def __init__(self, prompt_ids: List[int], max_new_tokens: int,
+                 stop_token: Optional[int]) -> None:
+        self.prompt_ids = list(prompt_ids)
+        self.max_new_tokens = max_new_tokens
+        self.stop_token = stop_token
+        self.done = threading.Event()
+        self.tokens: List[int] = []
+        self.error: Optional[Exception] = None
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self.done.wait(timeout):
+            raise TimeoutError('generation timed out')
+        if self.error is not None:
+            raise self.error
+        return self.tokens
+
+
+class _Slot:
+
+    def __init__(self) -> None:
+        self.request: Optional[_Request] = None
+        self.next_token = 0
+
+    @property
+    def active(self) -> bool:
+        return self.request is not None
+
+
+class ContinuousBatchingEngine:
+    """Submit() from any thread; one worker thread owns the device."""
+
+    def __init__(self, cfg, params, *, max_len: int = 512,
+                 slots: int = 4) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from skypilot_tpu.models import decode
+
+        self.cfg = cfg
+        self.params = params
+        self.max_len = max_len
+        self._jnp = jnp
+        self._decode = decode
+        self._slots = [_Slot() for _ in range(slots)]
+        self._cache = decode.init_slot_cache(cfg, slots, max_len)
+        self._tokens = jnp.zeros((slots, 1), jnp.int32)
+        self._queue: 'queue.Queue[_Request]' = queue.Queue()
+        self._stop = threading.Event()
+
+        def step(params, tokens, cache):
+            return decode.batched_step(cfg, params, tokens, cache)
+
+        self._step = jax.jit(step, donate_argnums=(2,))
+        # Jitted prefill: one compile per prompt-length bucket (the
+        # whole point of the bucket padding), not eager per-op dispatch
+        # per admission.
+        self._prefill = jax.jit(
+            lambda params, toks: decode.prefill(cfg, params, toks,
+                                                max_len=max_len))
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ public
+
+    def submit(self, prompt_ids: List[int], max_new_tokens: int,
+               stop_token: Optional[int] = None) -> _Request:
+        if not prompt_ids:
+            raise ValueError('empty prompt')
+        if len(prompt_ids) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f'prompt {len(prompt_ids)} + new {max_new_tokens} '
+                f'exceeds max_len {self.max_len}')
+        request = _Request(prompt_ids, max_new_tokens, stop_token)
+        self._queue.put(request)
+        return request
+
+    def generate(self, prompt_ids: List[int], max_new_tokens: int,
+                 stop_token: Optional[int] = None,
+                 timeout: float = 600.0) -> List[int]:
+        return self.submit(prompt_ids, max_new_tokens,
+                           stop_token).result(timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+        # Fail fast for anything still queued or in flight — callers
+        # must not sit out their full result() timeout at shutdown.
+        shutdown_error = RuntimeError('batching engine stopped')
+        while True:
+            try:
+                request = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            request.error = shutdown_error
+            request.done.set()
+        for slot in self._slots:
+            if slot.request is not None:
+                slot.request.error = shutdown_error
+                slot.request.done.set()
+                slot.request = None
+
+    # ------------------------------------------------------------ worker
+
+    def _bucket(self, n: int) -> int:
+        for b in _PREFILL_BUCKETS:
+            if n <= b:
+                return b
+        return n
+
+    def _admit(self, slot_id: int, request: _Request) -> None:
+        jnp = self._jnp
+        decode = self._decode
+        slot = self._slots[slot_id]
+        prompt = request.prompt_ids
+        n = len(prompt)
+        if n > 1:
+            # Prefill tokens [0, n-1) padded to a bucket (capped at
+            # max_len — the cache cannot hold more); pad keys land at
+            # positions >= n-1 where they are masked (and the first one
+            # is overwritten by the real last token's step).
+            bucket = min(self._bucket(n - 1), self.max_len)
+            padded = jnp.zeros((1, bucket), jnp.int32)
+            padded = padded.at[0, :n - 1].set(
+                jnp.asarray(prompt[:-1], jnp.int32))
+            _, pre = self._prefill(self.params, padded)
+            self._cache = decode.insert_prefill(
+                self._cache, slot_id, pre, n - 1)
+        else:
+            # Single-token prompt: empty slot; stale keys are masked
+            # (lengths = 0) and position 0 is overwritten next step.
+            self._cache = dict(
+                self._cache,
+                lengths=self._cache['lengths'].at[slot_id].set(0))
+        slot.request = request
+        slot.next_token = int(prompt[-1])
+
+    def _tick(self) -> None:
+        jnp = self._jnp
+        active = [i for i, s in enumerate(self._slots) if s.active]
+        if not active:
+            return
+        tokens = self._tokens
+        for i in active:
+            tokens = tokens.at[i, 0].set(self._slots[i].next_token)
+        logits, self._cache = self._step(self.params, tokens, self._cache)
+        import numpy as np  # pylint: disable=import-outside-toplevel
+        nxt = np.asarray(jnp.argmax(logits, axis=-1))  # one host sync
+        for i in active:
+            slot = self._slots[i]
+            request = slot.request
+            token = int(nxt[i])
+            request.tokens.append(token)
+            finished = (len(request.tokens) >= request.max_new_tokens or
+                        (request.stop_token is not None and
+                         token == request.stop_token))
+            if finished:
+                slot.request = None
+                request.done.set()
+            else:
+                slot.next_token = token
+        self._tokens = tokens
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                # Fill free slots from the queue; block briefly when
+                # fully idle so shutdown stays responsive.
+                idle = not any(s.active for s in self._slots)
+                free = [i for i, s in enumerate(self._slots)
+                        if not s.active]
+                admitted = False
+                for slot_id in free:
+                    try:
+                        request = self._queue.get(
+                            timeout=0.05 if idle and not admitted
+                            else 0.0)
+                    except queue.Empty:
+                        break
+                    try:
+                        self._admit(slot_id, request)
+                        admitted = True
+                    except Exception as e:  # pylint: disable=broad-except
+                        request.error = e
+                        request.done.set()
+                self._tick()
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('batching engine tick failed')
+                # Fail every in-flight request rather than hanging
+                # clients on a wedged engine.
+                for slot in self._slots:
+                    if slot.request is not None:
+                        slot.request.error = RuntimeError(
+                            'batching engine error')
+                        slot.request.done.set()
+                        slot.request = None

@@ -35,7 +35,8 @@ class ModelServer:
 
     def __init__(self, model: str, *, checkpoint_dir: Optional[str] = None,
                  max_len: int = 512, max_batch: int = 8,
-                 seed: int = 0, quantize: Optional[str] = None) -> None:
+                 seed: int = 0, quantize: Optional[str] = None,
+                 continuous_batching: bool = False) -> None:
         import jax
         import flax.linen as nn
 
@@ -87,6 +88,21 @@ class ModelServer:
         # One generation at a time: KV caches are sized per call and
         # the chip is exclusive anyway; the HTTP layer queues.
         self._lock = threading.Lock()
+        self._engine = None
+        if continuous_batching:
+            # Requests join a running batch as slots free (greedy
+            # decoding; per-request temperature/top_k are rejected).
+            from skypilot_tpu.serve import batching_engine
+            self._engine = batching_engine.ContinuousBatchingEngine(
+                self.cfg, self.params, max_len=max_len,
+                slots=max_batch)
+
+    def close(self) -> None:
+        """Release background resources (the batching engine's worker
+        thread + slot KV cache); safe to call twice."""
+        if self._engine is not None:
+            self._engine.stop()
+            self._engine = None
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, top_k: int = 0) -> Any:
@@ -103,6 +119,19 @@ class ModelServer:
             raise ValueError(
                 f'prompt {prompt.shape[1]} + new {max_new_tokens} '
                 f'exceeds max_len {self.max_len}')
+        if self._engine is not None:
+            if temperature or top_k:
+                raise ValueError(
+                    'continuous batching serves greedy decoding; '
+                    'sampling params are not supported')
+            # Each row is its own request: they decode TOGETHER with
+            # whatever else is in flight (no lock — that is the point).
+            requests = [
+                self._engine.submit([int(t) for t in row],
+                                    max_new_tokens)
+                for row in prompt_ids
+            ]
+            return [r.result(timeout=600) for r in requests]
         sampling = decode.SamplingConfig(temperature=temperature,
                                          top_k=top_k)
         with self._lock:
@@ -165,7 +194,10 @@ def serve_forever(server: ModelServer, port: int = 0) -> int:
                                 _make_handler(server))
     port = httpd.server_port
     logger.info(f'model server on :{port}')
-    httpd.serve_forever()
+    try:
+        httpd.serve_forever()
+    finally:
+        server.close()
     return port
 
 
@@ -188,10 +220,15 @@ def main() -> None:
     parser.add_argument('--quantize', default=None, choices=['int8'],
                         help='Weight-only quantization: ~2x less HBM '
                              'traffic per decoded token vs bf16.')
+    parser.add_argument('--continuous-batching', action='store_true',
+                        help='Slot-pool scheduling: requests join a '
+                             'running batch as slots free (greedy '
+                             'decoding; max_batch = slot count).')
     args = parser.parse_args()
     server = ModelServer(args.model, checkpoint_dir=args.checkpoint_dir,
                          max_len=args.max_len, max_batch=args.max_batch,
-                         quantize=args.quantize)
+                         quantize=args.quantize,
+                         continuous_batching=args.continuous_batching)
     serve_forever(server, args.port)
 
 

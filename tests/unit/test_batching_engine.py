@@ -1,0 +1,89 @@
+"""Continuous batching engine tests: exactness vs single-sequence
+decode, mid-flight admission, slot reuse, stop tokens."""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import configs
+from skypilot_tpu.models import decode
+from skypilot_tpu.models.transformer import Transformer
+from skypilot_tpu.serve import batching_engine
+
+
+@pytest.fixture(scope='module')
+def setup():
+    cfg = configs.get_config('tiny')
+    model = Transformer(cfg)
+    seed_tokens = jnp.zeros((1, 8), jnp.int32)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(0), seed_tokens)['params'])
+    return cfg, params
+
+
+def _reference(cfg, params, prompt_ids, n):
+    prompt = jnp.asarray([prompt_ids], jnp.int32)
+    _, new = decode.generate(cfg, params, prompt, max_new_tokens=n,
+                             max_len=64)
+    return [int(t) for t in np.asarray(new)[0]]
+
+
+@pytest.fixture()
+def engine(setup):
+    cfg, params = setup
+    eng = batching_engine.ContinuousBatchingEngine(
+        cfg, params, max_len=64, slots=2)
+    yield eng
+    eng.stop()
+
+
+class TestEngine:
+
+    def test_single_request_matches_decode(self, setup, engine):
+        cfg, params = setup
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        got = engine.generate(prompt, max_new_tokens=6, timeout=120)
+        assert got == _reference(cfg, params, prompt, 6)
+
+    def test_single_token_prompt(self, setup, engine):
+        cfg, params = setup
+        got = engine.generate([7], max_new_tokens=4, timeout=120)
+        assert got == _reference(cfg, params, [7], 4)
+
+    def test_concurrent_requests_exact(self, setup, engine):
+        """Different lengths and generation budgets decoded together:
+        each must match its own single-sequence reference exactly."""
+        cfg, params = setup
+        prompts = [([3, 1, 4, 1, 5], 5), ([2, 7], 8),
+                   ([9, 9, 8, 2, 1, 0, 3], 3)]
+        requests = [engine.submit(p, n) for p, n in prompts]
+        results = [r.result(timeout=180) for r in requests]
+        for (p, n), got in zip(prompts, results):
+            assert got == _reference(cfg, params, p, n), (p, n)
+
+    def test_more_requests_than_slots_reuses(self, setup, engine):
+        """5 requests through 2 slots: admission happens as slots free
+        (continuous), and every result is still exact."""
+        cfg, params = setup
+        prompts = [[i + 1, i + 2, i + 3] for i in range(5)]
+        requests = [engine.submit(p, 4) for p in prompts]
+        for p, r in zip(prompts, requests):
+            assert r.result(timeout=240) == _reference(cfg, params, p, 4)
+
+    def test_stop_token(self, setup, engine):
+        cfg, params = setup
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        ref = _reference(cfg, params, prompt, 8)
+        stop = ref[2]
+        got = engine.generate(prompt, max_new_tokens=8, stop_token=stop,
+                              timeout=120)
+        assert got == ref[:3]  # stops AT the stop token (inclusive)
+
+    def test_validation(self, engine):
+        with pytest.raises(ValueError, match='empty'):
+            engine.submit([], 4)
+        with pytest.raises(ValueError, match='exceeds'):
+            engine.submit([1, 2, 3], 100)

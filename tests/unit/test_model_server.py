@@ -188,3 +188,40 @@ def test_quantized_server_generates():
         assert len(resp.json()['tokens'][0]) == 3
     finally:
         shutdown()
+
+
+def test_continuous_batching_server_parity():
+    """The CB server returns the same greedy tokens as the lock-step
+    server, with concurrent requests decoded together."""
+    import concurrent.futures
+    ref_server = model_server.ModelServer('tiny', max_len=64, max_batch=2)
+    cb_server = model_server.ModelServer('tiny', max_len=64, max_batch=2,
+                                         continuous_batching=True)
+    # Same seed -> same weights.
+    prompts = [[3, 1, 4, 1, 5], [2, 7, 1], [9, 8, 2, 1]]
+    try:
+        expected = [ref_server.generate([p], 4)[0] for p in prompts]
+        port, shutdown = model_server.start_background(cb_server)
+        try:
+            def call(p):
+                r = requests.post(
+                    f'http://127.0.0.1:{port}/generate',
+                    json={'prompt_ids': [p], 'max_new_tokens': 4},
+                    timeout=300)
+                r.raise_for_status()
+                return r.json()['tokens'][0]
+
+            with concurrent.futures.ThreadPoolExecutor(3) as pool:
+                got = list(pool.map(call, prompts))
+            assert got == expected
+            # Sampling params are rejected under CB.
+            r = requests.post(
+                f'http://127.0.0.1:{port}/generate',
+                json={'prompt_ids': [[1, 2]], 'max_new_tokens': 2,
+                      'temperature': 0.7}, timeout=60)
+            assert r.status_code == 400
+        finally:
+            shutdown()
+    finally:
+        cb_server.close()
+        cb_server.close()  # idempotent
