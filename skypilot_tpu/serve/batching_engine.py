@@ -8,12 +8,16 @@ and one `models.decode.batched_step` call advances every active slot a
 token per engine tick — new arrivals ride along with half-finished
 generations.
 
-Exact-prefill trick for static shapes: the prompt's first n-1 tokens
-are prefilled PADDED to a power-of-two bucket (bounding compile count),
-the slot is inserted at length n-1, and the LAST real prompt token is
-fed through the next batched step — it overwrites the first pad
-position and attends only real keys, so logits match unpadded decode
-exactly (tests pin this against decode.generate).
+Exact-prefill trick for static shapes (dense models): the prompt's
+first n-1 tokens are prefilled PADDED to a power-of-two bucket
+(bounding compile count), the slot is inserted at length n-1, and the
+LAST real prompt token is fed through the next batched step — it
+overwrites the first pad position and attends only real keys, so
+logits match unpadded decode exactly (tests pin this against
+decode.generate).  MoE models instead prefill the FULL prompt unpadded
+(the capacity dispatch couples every token, so both padding and the
+n-1 split would perturb expert drops) and take their first token from
+the prefill logits.
 
 Greedy decoding (temperature 0) — the deterministic serving default;
 per-request stop token and max_new_tokens.
@@ -41,6 +45,18 @@ class _Request:
         self.done = threading.Event()
         self.tokens: List[int] = []
         self.error: Optional[Exception] = None
+        # Streaming consumers read tokens as they are produced; the
+        # None sentinel marks the end of the stream.
+        self._live: 'queue.Queue[Optional[int]]' = queue.Queue()
+
+    def _push(self, token: int) -> None:
+        self.tokens.append(token)
+        self._live.put(token)
+
+    def _finish(self, error: Optional[Exception] = None) -> None:
+        self.error = error
+        self.done.set()
+        self._live.put(None)
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         if not self.done.wait(timeout):
@@ -48,6 +64,16 @@ class _Request:
         if self.error is not None:
             raise self.error
         return self.tokens
+
+    def stream(self, timeout: Optional[float] = None):
+        """Yield tokens as the engine produces them."""
+        while True:
+            token = self._live.get(timeout=timeout)
+            if token is None:
+                if self.error is not None:
+                    raise self.error
+                return
+            yield token
 
 
 class _Slot:
@@ -92,6 +118,12 @@ class ContinuousBatchingEngine:
         self._prefill = jax.jit(
             lambda params, toks: decode.prefill(cfg, params, toks,
                                                 max_len=max_len))
+        # Jitted in-place slot adoption: eager dynamic_update_slice
+        # would materialize two full copies of the pool cache per
+        # admission; donation lets XLA update it in place.
+        self._insert = jax.jit(decode.insert_prefill,
+                               donate_argnums=(0,))
+        self._failed: Optional[Exception] = None
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -101,12 +133,25 @@ class ContinuousBatchingEngine:
                stop_token: Optional[int] = None) -> _Request:
         if not prompt_ids:
             raise ValueError('empty prompt')
+        if max_new_tokens < 1:
+            raise ValueError(
+                f'max_new_tokens must be >= 1, got {max_new_tokens}')
         if len(prompt_ids) + max_new_tokens > self.max_len:
             raise ValueError(
                 f'prompt {len(prompt_ids)} + new {max_new_tokens} '
                 f'exceeds max_len {self.max_len}')
+        if self._stop.is_set() or self._failed is not None:
+            raise RuntimeError('batching engine is stopped'
+                               if self._failed is None else
+                               f'batching engine failed: {self._failed}')
         request = _Request(prompt_ids, max_new_tokens, stop_token)
         self._queue.put(request)
+        if self._stop.is_set():
+            # Lost the race with stop(): its drain may have already run,
+            # so fail this request directly (idempotent via the event).
+            if not request.done.is_set():
+                request._finish(  # pylint: disable=protected-access
+                    RuntimeError('batching engine stopped'))
         return request
 
     def generate(self, prompt_ids: List[int], max_new_tokens: int,
@@ -126,12 +171,10 @@ class ContinuousBatchingEngine:
                 request = self._queue.get_nowait()
             except queue.Empty:
                 break
-            request.error = shutdown_error
-            request.done.set()
+            request._finish(shutdown_error)  # pylint: disable=protected-access
         for slot in self._slots:
             if slot.request is not None:
-                slot.request.error = shutdown_error
-                slot.request.done.set()
+                slot.request._finish(shutdown_error)  # pylint: disable=protected-access
                 slot.request = None
 
     # ------------------------------------------------------------ worker
@@ -148,18 +191,37 @@ class ContinuousBatchingEngine:
         slot = self._slots[slot_id]
         prompt = request.prompt_ids
         n = len(prompt)
+        del decode
+        if self.cfg.n_experts > 0 and n > 0:
+            # MoE: the capacity dispatch couples EVERY prompt token, so
+            # both pad tokens and an n-1/last-token split change which
+            # tokens drop — only a full-prompt unpadded prefill matches
+            # the single-sequence reference.  The first generated token
+            # therefore comes from the prefill logits (one compile per
+            # distinct MoE prompt length).
+            logits, pre = self._prefill(
+                self.params, jnp.asarray([prompt], jnp.int32))
+            self._cache = self._insert(self._cache, slot_id, pre, n)
+            first = int(jnp.argmax(logits[0]))
+            request._push(first)  # pylint: disable=protected-access
+            if (request.max_new_tokens <= 1 or
+                    first == request.stop_token):
+                request._finish()  # pylint: disable=protected-access
+                return
+            slot.request = request
+            slot.next_token = first
+            return
         if n > 1:
-            # Prefill tokens [0, n-1) padded to a bucket (capped at
-            # max_len — the cache cannot hold more); pad keys land at
-            # positions >= n-1 where they are masked (and the first one
-            # is overwritten by the real last token's step).
+            # Dense: prefill tokens [0, n-1) padded to a bucket (capped
+            # at max_len — the cache cannot hold more); pad keys land
+            # at positions >= n-1 where they are masked (and the first
+            # one is overwritten by the real last token's step).
             bucket = min(self._bucket(n - 1), self.max_len)
             padded = jnp.zeros((1, bucket), jnp.int32)
             padded = padded.at[0, :n - 1].set(
                 jnp.asarray(prompt[:-1], jnp.int32))
             _, pre = self._prefill(self.params, padded)
-            self._cache = decode.insert_prefill(
-                self._cache, slot_id, pre, n - 1)
+            self._cache = self._insert(self._cache, slot_id, pre, n - 1)
         else:
             # Single-token prompt: empty slot; stale keys are masked
             # (lengths = 0) and position 0 is overwritten next step.
@@ -184,13 +246,13 @@ class ContinuousBatchingEngine:
             slot = self._slots[i]
             request = slot.request
             token = int(nxt[i])
-            request.tokens.append(token)
+            request._push(token)  # pylint: disable=protected-access
             finished = (len(request.tokens) >= request.max_new_tokens or
                         (request.stop_token is not None and
                          token == request.stop_token))
             if finished:
                 slot.request = None
-                request.done.set()
+                request._finish()  # pylint: disable=protected-access
             else:
                 slot.next_token = token
         self._tokens = tokens
@@ -215,16 +277,27 @@ class ContinuousBatchingEngine:
                         self._admit(slot_id, request)
                         admitted = True
                     except Exception as e:  # pylint: disable=broad-except
-                        request.error = e
-                        request.done.set()
+                        request._finish(e)  # pylint: disable=protected-access
                 self._tick()
-            except Exception:  # pylint: disable=broad-except
+            except Exception as e:  # pylint: disable=broad-except
                 logger.exception('batching engine tick failed')
-                # Fail every in-flight request rather than hanging
-                # clients on a wedged engine.
+                # The jit'd step donates the slot cache — after a
+                # failure mid-step the cache buffers may be invalid, so
+                # the engine CANNOT safely continue: fail everything in
+                # flight, mark failed (submit() rejects from now on),
+                # and exit the worker.
+                self._failed = e
+                self._stop.set()
                 for slot in self._slots:
                     if slot.request is not None:
-                        slot.request.error = RuntimeError(
-                            'batching engine error')
-                        slot.request.done.set()
+                        slot.request._finish(RuntimeError(  # pylint: disable=protected-access
+                            f'batching engine failed: {e}'))
                         slot.request = None
+                while True:
+                    try:
+                        request = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    request._finish(RuntimeError(  # pylint: disable=protected-access
+                        f'batching engine failed: {e}'))
+                return

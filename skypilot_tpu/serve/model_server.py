@@ -164,7 +164,64 @@ def _make_handler(server: ModelServer):
                               'model': f'{server.cfg.d_model}x'
                                        f'{server.cfg.n_layers}'})
 
+        def _generate_stream(self):
+            """SSE token stream: `data: {"token": N}` per token, then
+            `data: [DONE]`.  Requires --continuous-batching (the engine
+            produces tokens one step at a time); single prompt only.
+            The LB relays these chunks unbuffered end-to-end."""
+            try:
+                length = int(self.headers.get('Content-Length', 0))
+                req = json.loads(self.rfile.read(length))
+                prompt = req['prompt_ids']
+                if (isinstance(prompt, list) and prompt and
+                        isinstance(prompt[0], list)):
+                    if len(prompt) != 1:
+                        raise ValueError(
+                            'streaming serves one prompt per request')
+                    prompt = prompt[0]
+                if server._engine is None:  # pylint: disable=protected-access
+                    self._reply(400, {
+                        'error': 'streaming requires '
+                                 '--continuous-batching'})
+                    return
+                request = server._engine.submit(  # pylint: disable=protected-access
+                    [int(t) for t in prompt],
+                    int(req.get('max_new_tokens', 16)),
+                    stop_token=req.get('stop_token'))
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._reply(400, {'error': str(e)})
+                return
+            self.send_response(200)
+            self.send_header('Content-Type', 'text/event-stream')
+            self.send_header('Cache-Control', 'no-cache')
+            self.send_header('Transfer-Encoding', 'chunked')
+            self.end_headers()
+
+            def chunk(data: str) -> None:
+                payload = f'data: {data}\n\n'.encode()
+                self.wfile.write(f'{len(payload):x}\r\n'.encode() +
+                                 payload + b'\r\n')
+                self.wfile.flush()
+
+            try:
+                for token in request.stream(timeout=600):
+                    chunk(json.dumps({'token': token}))
+                chunk('[DONE]')
+                self.wfile.write(b'0\r\n\r\n')
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            except Exception as e:  # pylint: disable=broad-except
+                try:
+                    chunk(json.dumps({'error': str(e)}))
+                    self.wfile.write(b'0\r\n\r\n')
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+
         def do_POST(self):
+            if self.path == '/generate_stream':
+                self._generate_stream()
+                return
             if self.path != '/generate':
                 self._reply(404, {'error': 'unknown path'})
                 return
@@ -185,6 +242,11 @@ def _make_handler(server: ModelServer):
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as e:
                 self._reply(400, {'error': str(e)})
+            except Exception as e:  # pylint: disable=broad-except
+                # Engine failures (stopped engine, tick error, result
+                # timeout) must reach the client as an HTTP error, not
+                # a dropped connection.
+                self._reply(500, {'error': f'{type(e).__name__}: {e}'})
 
     return Handler
 

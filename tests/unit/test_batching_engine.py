@@ -87,3 +87,52 @@ class TestEngine:
             engine.submit([], 4)
         with pytest.raises(ValueError, match='exceeds'):
             engine.submit([1, 2, 3], 100)
+
+
+class TestEngineRobustness:
+
+    def test_moe_config_exact(self, setup):
+        """MoE prefill must stay exact (pad tokens would perturb the
+        capacity dispatch, so MoE prompts prefill unpadded)."""
+        cfg = configs.get_config('tiny-moe')
+        model = Transformer(cfg)
+        prompt = [3, 1, 4, 1, 5, 9, 2]
+        params = nn.meta.unbox(model.init(
+            jax.random.PRNGKey(0),
+            jnp.asarray([prompt], jnp.int32))['params'])
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=64, slots=2)
+        try:
+            got = eng.generate(prompt, max_new_tokens=5, timeout=180)
+            assert got == _reference(cfg, params, prompt, 5)
+        finally:
+            eng.stop()
+
+    def test_submit_after_stop_rejected(self, setup):
+        cfg, params = setup
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=32, slots=1)
+        eng.stop()
+        with pytest.raises(RuntimeError, match='stopped'):
+            eng.submit([1, 2], 2)
+
+    def test_zero_max_new_tokens_rejected(self, engine):
+        with pytest.raises(ValueError, match='>= 1'):
+            engine.submit([1, 2], 0)
+
+    def test_tick_failure_fails_fast_and_rejects(self, setup,
+                                                 monkeypatch):
+        cfg, params = setup
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=32, slots=1)
+        try:
+            def boom(*a, **k):
+                raise RuntimeError('chip fell over')
+            monkeypatch.setattr(eng, '_step', boom)
+            request = eng.submit([1, 2, 3], 4)
+            with pytest.raises(RuntimeError, match='failed'):
+                request.result(timeout=30)
+            with pytest.raises(RuntimeError, match='failed'):
+                eng.submit([1, 2], 2)
+        finally:
+            eng.stop()

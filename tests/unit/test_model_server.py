@@ -225,3 +225,53 @@ def test_continuous_batching_server_parity():
     finally:
         cb_server.close()
         cb_server.close()  # idempotent
+
+
+def test_streaming_generation_sse():
+    """Tokens arrive incrementally over SSE and match the
+    non-streaming result; requires continuous batching."""
+    server = model_server.ModelServer('tiny', max_len=64, max_batch=2,
+                                      continuous_batching=True)
+    port, shutdown = model_server.start_background(server)
+    try:
+        prompt = [3, 1, 4, 1, 5]
+        expected = requests.post(
+            f'http://127.0.0.1:{port}/generate',
+            json={'prompt_ids': [prompt], 'max_new_tokens': 5},
+            timeout=300).json()['tokens'][0]
+        tokens, times = [], []
+        import time as _time
+        with requests.post(
+                f'http://127.0.0.1:{port}/generate_stream',
+                json={'prompt_ids': [prompt], 'max_new_tokens': 5},
+                stream=True, timeout=300) as resp:
+            assert resp.status_code == 200
+            assert 'text/event-stream' in resp.headers['Content-Type']
+            for line in resp.iter_lines():
+                if not line or not line.startswith(b'data: '):
+                    continue
+                data = line[len(b'data: '):]
+                if data == b'[DONE]':
+                    break
+                tokens.append(json.loads(data)['token'])
+                times.append(_time.time())
+        assert tokens == expected
+        assert len(times) == 5
+    finally:
+        shutdown()
+        server.close()
+
+
+def test_streaming_without_engine_rejected():
+    server = model_server.ModelServer('tiny', max_len=32, max_batch=1)
+    port, shutdown = model_server.start_background(server)
+    try:
+        resp = requests.post(
+            f'http://127.0.0.1:{port}/generate_stream',
+            json={'prompt_ids': [[1, 2]], 'max_new_tokens': 2},
+            timeout=60)
+        assert resp.status_code == 400
+        assert 'continuous-batching' in resp.json()['error']
+    finally:
+        shutdown()
+        server.close()
