@@ -264,13 +264,54 @@ def decode_step(cfg: ModelConfig, params, token, cache):
 
 def sample(logits, rng, sampling: SamplingConfig):
     """logits [b, V] -> token ids [b]."""
-    if sampling.temperature <= 0.0:
+    return _sample(logits, rng, sampling.temperature,
+                   greedy=sampling.temperature <= 0.0,
+                   top_k=sampling.top_k)
+
+
+def _sample(logits, rng, temperature, *, greedy: bool, top_k: int):
+    """Jit-friendly split: `greedy`/`top_k` are static (they change the
+    graph shape); `temperature` is traced (a serving replica must not
+    recompile per client-supplied float)."""
+    if greedy:
         return jnp.argmax(logits, axis=-1)
-    logits = logits / sampling.temperature
-    if sampling.top_k > 0:
-        top = jax.lax.top_k(logits, sampling.top_k)[0][..., -1:]
+    logits = logits / temperature
+    if top_k > 0:
+        top = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < top, NEG_INF, logits)
     return jax.random.categorical(rng, logits, axis=-1)
+
+
+def _generate_impl(cfg, params, prompt, rng, temperature,
+                   max_new_tokens, max_len, greedy, top_k):
+    logits, cache = prefill(cfg, params, prompt, max_len=max_len)
+    rng, first_rng = jax.random.split(rng)
+    first = _sample(logits, first_rng, temperature, greedy=greedy,
+                    top_k=top_k)
+
+    def step(carry, step_rng):
+        token, cache = carry
+        logits, cache = decode_step(cfg, params, token[:, None], cache)
+        nxt = _sample(logits, step_rng, temperature, greedy=greedy,
+                      top_k=top_k)
+        return (nxt, cache), nxt
+
+    (_, _), rest = jax.lax.scan(
+        step, (first, cache), jax.random.split(rng, max_new_tokens - 1))
+    new_tokens = jnp.concatenate(
+        [first[:, None], rest.transpose(1, 0)], axis=1)
+    return jnp.concatenate([prompt, new_tokens], axis=1), new_tokens
+
+
+# One compile per (cfg, prompt shape, generation length, greedy flag,
+# top_k) — cached at module level so every caller (model server, the
+# serving bench, tests) reuses it.  Temperature is TRACED: client-
+# supplied floats must not trigger recompiles (compile-storm DoS on a
+# replica); top_k stays static because lax.top_k's k shapes the graph.
+_generate_jit = jax.jit(
+    _generate_impl,
+    static_argnames=('cfg', 'max_new_tokens', 'max_len', 'greedy',
+                     'top_k'))
 
 
 def generate(cfg: ModelConfig, params, prompt, *, max_new_tokens: int,
@@ -281,8 +322,8 @@ def generate(cfg: ModelConfig, params, prompt, *, max_new_tokens: int,
     """Greedy/temperature generation.  prompt [b, s] -> (tokens
     [b, s+max_new_tokens], new token slice [b, max_new_tokens]).
 
-    The step loop is a lax.scan under one jit: static shapes, one
-    compile, the whole decode runs device-side.
+    The whole prefill + step loop runs as ONE cached jit: static
+    shapes, one compile per configuration, the full decode device-side.
     """
     sampling = sampling or SamplingConfig()
     rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -291,22 +332,11 @@ def generate(cfg: ModelConfig, params, prompt, *, max_new_tokens: int,
     if max_len < prompt_len + max_new_tokens:
         raise ValueError(f'max_len {max_len} < prompt {prompt_len} + '
                          f'new {max_new_tokens}')
-
-    logits, cache = prefill(cfg, params, prompt, max_len=max_len)
-    rng, first_rng = jax.random.split(rng)
-    first = sample(logits, first_rng, sampling)
-
-    def step(carry, step_rng):
-        token, cache = carry
-        logits, cache = decode_step(cfg, params, token[:, None], cache)
-        nxt = sample(logits, step_rng, sampling)
-        return (nxt, cache), nxt
-
-    (_, _), rest = jax.lax.scan(
-        step, (first, cache), jax.random.split(rng, max_new_tokens - 1))
-    new_tokens = jnp.concatenate(
-        [first[:, None], rest.transpose(1, 0)], axis=1)
-    return jnp.concatenate([prompt, new_tokens], axis=1), new_tokens
+    return _generate_jit(
+        cfg, params, prompt, rng,
+        jnp.asarray(max(sampling.temperature, 1e-6), jnp.float32),
+        max_new_tokens, max_len, sampling.temperature <= 0.0,
+        sampling.top_k)
 
 
 # -------------------------------------------------- slot-batched decoding

@@ -45,6 +45,7 @@ class _Request:
         self.done = threading.Event()
         self.tokens: List[int] = []
         self.error: Optional[Exception] = None
+        self.cancelled = False
         # Streaming consumers read tokens as they are produced; the
         # None sentinel marks the end of the stream.
         self._live: 'queue.Queue[Optional[int]]' = queue.Queue()
@@ -75,6 +76,11 @@ class _Request:
                 return
             yield token
 
+    def cancel(self) -> None:
+        """Stop generating for this request (client went away); the
+        engine frees the slot on its next tick."""
+        self.cancelled = True
+
 
 class _Slot:
 
@@ -101,7 +107,6 @@ class ContinuousBatchingEngine:
         self.params = params
         self.max_len = max_len
         self._jnp = jnp
-        self._decode = decode
         self._slots = [_Slot() for _ in range(slots)]
         self._cache = decode.init_slot_cache(cfg, slots, max_len)
         self._tokens = jnp.zeros((slots, 1), jnp.int32)
@@ -187,11 +192,9 @@ class ContinuousBatchingEngine:
 
     def _admit(self, slot_id: int, request: _Request) -> None:
         jnp = self._jnp
-        decode = self._decode
         slot = self._slots[slot_id]
         prompt = request.prompt_ids
         n = len(prompt)
-        del decode
         if self.cfg.n_experts > 0 and n > 0:
             # MoE: the capacity dispatch couples EVERY prompt token, so
             # both pad tokens and an n-1/last-token split change which
@@ -233,6 +236,16 @@ class ContinuousBatchingEngine:
 
     def _tick(self) -> None:
         jnp = self._jnp
+        active = [i for i, s in enumerate(self._slots) if s.active]
+        if not active:
+            return
+        # Free slots whose client cancelled before spending a tick on
+        # them (the cancel flag is read once per tick).
+        for i in active:
+            req = self._slots[i].request
+            if req.cancelled:
+                self._slots[i].request = None
+                req._finish()  # pylint: disable=protected-access
         active = [i for i, s in enumerate(self._slots) if s.active]
         if not active:
             return

@@ -192,6 +192,11 @@ def _make_handler(server: ModelServer):
                     json.JSONDecodeError) as e:
                 self._reply(400, {'error': str(e)})
                 return
+            except Exception as e:  # pylint: disable=broad-except
+                # Stopped/failed engine: an HTTP error, not a dropped
+                # connection.
+                self._reply(503, {'error': f'{type(e).__name__}: {e}'})
+                return
             self.send_response(200)
             self.send_header('Content-Type', 'text/event-stream')
             self.send_header('Cache-Control', 'no-cache')
@@ -210,7 +215,9 @@ def _make_handler(server: ModelServer):
                 chunk('[DONE]')
                 self.wfile.write(b'0\r\n\r\n')
             except (BrokenPipeError, ConnectionResetError):
-                pass
+                # Client went away: free the slot instead of decoding
+                # the rest of max_new_tokens for nobody.
+                request.cancel()
             except Exception as e:  # pylint: disable=broad-except
                 try:
                     chunk(json.dumps({'error': str(e)}))

@@ -136,3 +136,45 @@ class TestEngineRobustness:
                 eng.submit([1, 2], 2)
         finally:
             eng.stop()
+
+
+def test_cancel_frees_slot(setup):
+    cfg, params = setup
+    eng = batching_engine.ContinuousBatchingEngine(
+        cfg, params, max_len=64, slots=1)
+    try:
+        import time as _time
+        request = eng.submit([1, 2, 3], 50)
+        # Take a couple of tokens then hang up.
+        stream = request.stream(timeout=60)
+        next(stream)
+        request.cancel()
+        assert request.done.wait(30)
+        # The slot must be free for the next request promptly.
+        got = eng.generate([4, 5], 3, timeout=60)
+        assert len(got) == 3
+        assert len(request.tokens) < 50
+        del _time
+    finally:
+        eng.stop()
+
+
+def test_temperature_sweep_no_recompile_storm(setup):
+    """Distinct temperatures must reuse one compiled executable
+    (temperature is traced, not a static jit key)."""
+    cfg, params = setup
+    import time as _time
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    sampling0 = decode.SamplingConfig(temperature=0.7)
+    t0 = _time.time()
+    decode.generate(cfg, params, prompt, max_new_tokens=3, max_len=16,
+                    sampling=sampling0)
+    first = _time.time() - t0
+    t0 = _time.time()
+    for i in range(5):
+        decode.generate(cfg, params, prompt, max_new_tokens=3,
+                        max_len=16,
+                        sampling=decode.SamplingConfig(
+                            temperature=0.5 + i * 0.01))
+    per = (_time.time() - t0) / 5
+    assert per < first / 2, (first, per)  # cached, not recompiled
