@@ -191,6 +191,11 @@ class ContinuousBatchingEngine:
         return n
 
     def _admit(self, slot_id: int, request: _Request) -> None:
+        if request.cancelled:
+            # Cancelled while queued: don't pay a prefill (possibly a
+            # fresh bucket compile) for a dead request.
+            request._finish()  # pylint: disable=protected-access
+            return
         jnp = self._jnp
         slot = self._slots[slot_id]
         prompt = request.prompt_ids

@@ -219,8 +219,13 @@ def _make_handler(server: ModelServer):
                 # the rest of max_new_tokens for nobody.
                 request.cancel()
             except Exception as e:  # pylint: disable=broad-except
+                # Same slot-leak logic for every other failure (stalled
+                # stream timeout, other socket errors): nobody is
+                # reading this request anymore.
+                request.cancel()
                 try:
-                    chunk(json.dumps({'error': str(e)}))
+                    chunk(json.dumps(
+                        {'error': f'{type(e).__name__}: {e}'}))
                     self.wfile.write(b'0\r\n\r\n')
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass

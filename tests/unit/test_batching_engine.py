@@ -143,7 +143,6 @@ def test_cancel_frees_slot(setup):
     eng = batching_engine.ContinuousBatchingEngine(
         cfg, params, max_len=64, slots=1)
     try:
-        import time as _time
         request = eng.submit([1, 2, 3], 50)
         # Take a couple of tokens then hang up.
         stream = request.stream(timeout=60)
@@ -154,7 +153,6 @@ def test_cancel_frees_slot(setup):
         got = eng.generate([4, 5], 3, timeout=60)
         assert len(got) == 3
         assert len(request.tokens) < 50
-        del _time
     finally:
         eng.stop()
 
