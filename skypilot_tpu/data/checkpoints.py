@@ -64,7 +64,8 @@ def latest_step(directory: Optional[str] = None) -> Optional[int]:
 
 
 def restore_params(directory: str,
-                   params_template: Any = None) -> Any:
+                   params_template: Any = None,
+                   shardings: Any = None) -> Any:
     """Restore just the PARAMS from the newest training checkpoint.
 
     Inference-side counterpart of restore_or_init: training saves the
@@ -90,14 +91,45 @@ def restore_params(directory: str,
     # — optimizer moments never touch disk or RAM.
     meta = mgr.item_metadata(step)
 
+    # Sharded restore: each leaf's ShapeDtypeStruct carries the target
+    # NamedSharding so orbax streams every shard straight to its device
+    # — the full tree never materializes on one chip (the whole point
+    # of tensor-sharded serving).  The shardings tree is the UNBOXED
+    # param structure; the checkpoint's is boxed ({'value': leaf}), but
+    # boxing preserves leaf traversal order, so leaves pair up 1:1.
+    sharding_iter = None
+    if shardings is not None:
+        sharding_iter = iter(jax.tree_util.tree_leaves(
+            shardings,
+            is_leaf=lambda x: isinstance(x, jax.sharding.Sharding)))
+
     def _leaf(path, leaf):
         if getattr(path[0], 'key', None) != 'params':
             return ocp.PLACEHOLDER
-        return jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype)
+        sharding = next(sharding_iter) if sharding_iter else None
+        return jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype,
+                                    sharding=sharding)
 
     template = jax.tree_util.tree_map_with_path(_leaf, meta)
-    restored = mgr.restore(step,
-                           args=ocp.args.PyTreeRestore(item=template))
+    restore_kwargs = {}
+    if shardings is not None:
+        # PyTreeRestore only honors a target sharding via explicit
+        # restore_args; build them from the template's annotations.
+        def _restore_arg(leaf):
+            if (isinstance(leaf, jax.ShapeDtypeStruct) and
+                    leaf.sharding is not None):
+                return ocp.ArrayRestoreArgs(sharding=leaf.sharding,
+                                            global_shape=leaf.shape,
+                                            dtype=leaf.dtype)
+            return ocp.RestoreArgs()
+
+        restore_kwargs['restore_args'] = jax.tree_util.tree_map(
+            _restore_arg, template,
+            is_leaf=lambda x: x is ocp.PLACEHOLDER or
+            isinstance(x, jax.ShapeDtypeStruct))
+    restored = mgr.restore(
+        step, args=ocp.args.PyTreeRestore(item=template,
+                                          **restore_kwargs))
     logger.info(f'Restored params from step {step} of {directory}')
     return _strip_partition_boxes(restored['params'])
 

@@ -36,7 +36,8 @@ class ModelServer:
     def __init__(self, model: str, *, checkpoint_dir: Optional[str] = None,
                  max_len: int = 512, max_batch: int = 8,
                  seed: int = 0, quantize: Optional[str] = None,
-                 continuous_batching: bool = False) -> None:
+                 continuous_batching: bool = False,
+                 tensor: int = 1) -> None:
         import jax
         import flax.linen as nn
 
@@ -48,12 +49,46 @@ class ModelServer:
             # restore, not after.
             raise ValueError(f'Unknown quantize mode {quantize!r}; '
                              "have 'int8'.")
+        if tensor > 1 and quantize:
+            raise ValueError(
+                'quantize + tensor sharding is not supported yet '
+                '(quantized leaves change the param pytree the '
+                'shardings were computed for).')
         self.cfg = configs.get_config(model)
         self.max_len = max_len
         self.max_batch = max_batch
         model_mod = Transformer(self.cfg)
         init_tokens = jax.numpy.zeros((1, 8), jax.numpy.int32)
         key = jax.random.PRNGKey(seed)
+
+        # Tensor-sharded serving (models too big for one chip): params
+        # carry NamedShardings over a tensor mesh; GSPMD partitions the
+        # decode einsums and inserts the collectives — the decode code
+        # is unchanged.
+        self._shardings = None
+        if tensor > 1:
+            from skypilot_tpu.parallel import MeshConfig, build_mesh
+            from skypilot_tpu.parallel.sharding import LOGICAL_AXIS_RULES
+            if len(jax.devices()) < tensor:
+                raise ValueError(
+                    f'tensor={tensor} needs {tensor} devices; have '
+                    f'{len(jax.devices())}.')
+            for dim, value in (('n_kv_heads', self.cfg.n_kv_heads),
+                               ('n_heads', self.cfg.n_heads),
+                               ('d_ff', self.cfg.d_ff),
+                               ('vocab_size', self.cfg.vocab_size)):
+                if value % tensor:
+                    raise ValueError(
+                        f'tensor={tensor} must divide {dim} ({value}) '
+                        f'for {model!r}; pick a smaller degree.')
+            mesh = build_mesh(MeshConfig(tensor=tensor),
+                              devices=jax.devices()[:tensor])
+            abstract = jax.eval_shape(
+                lambda rng: model_mod.init(rng, init_tokens)['params'],
+                key)
+            specs = nn.get_partition_spec(abstract)
+            self._shardings = nn.meta.unbox(nn.logical_to_mesh_sharding(
+                specs, mesh, LOGICAL_AXIS_RULES))
 
         def _init(rng):
             return nn.meta.unbox(
@@ -66,7 +101,10 @@ class ModelServer:
             # are never materialised just to be overwritten (for an 8B
             # model that would double peak memory and add minutes of
             # startup), and optimizer moments are never read at all.
-            params = checkpoints.restore_params(checkpoint_dir, None)
+            # With tensor sharding, shards stream straight to their
+            # devices — the unsharded tree never exists on one chip.
+            params = checkpoints.restore_params(
+                checkpoint_dir, None, shardings=self._shardings)
         else:
             if checkpoint_dir:
                 logger.warning(
@@ -75,7 +113,9 @@ class ModelServer:
             else:
                 logger.warning('No --checkpoint-dir given; serving '
                                'FRESH random-init weights.')
-            params = jax.jit(_init)(key)
+            params = jax.jit(
+                _init,
+                out_shardings=self._shardings)(key)
         if quantize:
             from skypilot_tpu.models import quantize as quantize_lib
             params = quantize_lib.quantize_params(params)
@@ -298,11 +338,16 @@ def main() -> None:
                         help='Slot-pool scheduling: requests join a '
                              'running batch as slots free (greedy '
                              'decoding; max_batch = slot count).')
+    parser.add_argument('--tensor', type=int, default=1,
+                        help='Tensor-shard the model over N local '
+                             'devices (models too big for one chip); '
+                             'GSPMD partitions the decode einsums.')
     args = parser.parse_args()
     server = ModelServer(args.model, checkpoint_dir=args.checkpoint_dir,
                          max_len=args.max_len, max_batch=args.max_batch,
                          quantize=args.quantize,
-                         continuous_batching=args.continuous_batching)
+                         continuous_batching=args.continuous_batching,
+                         tensor=args.tensor)
     serve_forever(server, args.port)
 
 
