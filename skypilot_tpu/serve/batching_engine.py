@@ -129,6 +129,7 @@ class ContinuousBatchingEngine:
         self._insert = jax.jit(decode.insert_prefill,
                                donate_argnums=(0,))
         self._failed: Optional[Exception] = None
+        self._tokens_generated = 0
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -164,6 +165,18 @@ class ContinuousBatchingEngine:
                  timeout: float = 600.0) -> List[int]:
         return self.submit(prompt_ids, max_new_tokens,
                            stop_token).result(timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        """Live scheduling stats (surfaced via the server's /health —
+        queue depth + slot occupancy are the autoscaling signals)."""
+        busy = sum(1 for s in self._slots if s.active)
+        return {
+            'slots': len(self._slots),
+            'busy_slots': busy,
+            'queued_requests': self._queue.qsize(),
+            'tokens_generated': self._tokens_generated,
+            'failed': self._failed is not None,
+        }
 
     def stop(self) -> None:
         self._stop.set()
@@ -212,6 +225,7 @@ class ContinuousBatchingEngine:
             self._cache = self._insert(self._cache, slot_id, pre, n)
             first = int(jnp.argmax(logits[0]))
             request._push(first)  # pylint: disable=protected-access
+            self._tokens_generated += 1
             if (request.max_new_tokens <= 1 or
                     first == request.stop_token):
                 request._finish()  # pylint: disable=protected-access
@@ -265,6 +279,7 @@ class ContinuousBatchingEngine:
             request = slot.request
             token = int(nxt[i])
             request._push(token)  # pylint: disable=protected-access
+            self._tokens_generated += 1
             finished = (len(request.tokens) >= request.max_new_tokens or
                         (request.stop_token is not None and
                          token == request.stop_token))

@@ -200,9 +200,20 @@ def _make_handler(server: ModelServer):
             self.wfile.write(body)
 
         def do_GET(self):
-            self._reply(200, {'status': 'ok',
-                              'model': f'{server.cfg.d_model}x'
-                                       f'{server.cfg.n_layers}'})
+            payload = {'status': 'ok',
+                       'model': f'{server.cfg.d_model}x'
+                                f'{server.cfg.n_layers}'}
+            engine = server._engine  # pylint: disable=protected-access
+            code = 200
+            if engine is not None:  # local bind: close() may race
+                stats = engine.stats()
+                payload['engine'] = stats
+                if stats['failed']:
+                    # A dead engine must fail the readiness probe or
+                    # the LB keeps routing to a black hole.
+                    payload['status'] = 'engine_failed'
+                    code = 503
+            self._reply(code, payload)
 
         def _generate_stream(self):
             """SSE token stream: `data: {"token": N}` per token, then

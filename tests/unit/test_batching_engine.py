@@ -176,3 +176,46 @@ def test_temperature_sweep_no_recompile_storm(setup):
                             temperature=0.5 + i * 0.01))
     per = (_time.time() - t0) / 5
     assert per < first / 2, (first, per)  # cached, not recompiled
+
+
+def test_stats(setup):
+    cfg, params = setup
+    eng = batching_engine.ContinuousBatchingEngine(
+        cfg, params, max_len=32, slots=2)
+    try:
+        stats = eng.stats()
+        assert stats == {'slots': 2, 'busy_slots': 0,
+                         'queued_requests': 0, 'tokens_generated': 0,
+                         'failed': False}
+        eng.generate([1, 2, 3], 4, timeout=120)
+        stats = eng.stats()
+        assert stats['tokens_generated'] == 4
+        assert stats['busy_slots'] == 0
+    finally:
+        eng.stop()
+
+
+def test_failed_engine_fails_health_probe(setup, monkeypatch):
+    """A dead engine must flip /health to 503 so the replica stops
+    being READY (the LB would otherwise black-hole traffic)."""
+    import requests as _requests
+    from skypilot_tpu.serve import model_server
+    server = model_server.ModelServer('tiny', max_len=32, max_batch=1,
+                                      continuous_batching=True)
+    port, shutdown = model_server.start_background(server)
+    try:
+        assert _requests.get(f'http://127.0.0.1:{port}/health',
+                             timeout=30).status_code == 200
+
+        def boom(*a, **k):
+            raise RuntimeError('chip fell over')
+        monkeypatch.setattr(server._engine, '_step', boom)
+        req = server._engine.submit([1, 2, 3], 4)
+        assert req.done.wait(30)
+        resp = _requests.get(f'http://127.0.0.1:{port}/health',
+                             timeout=30)
+        assert resp.status_code == 503
+        assert resp.json()['status'] == 'engine_failed'
+    finally:
+        shutdown()
+        server.close()
