@@ -7,7 +7,7 @@ environment instead of raw IP lists.
 """
 from __future__ import annotations
 
-__version__ = '0.1.0'
+__version__ = '0.3.0'
 
 from skypilot_tpu import clouds
 from skypilot_tpu import jobs

@@ -12,6 +12,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import click
 
+from skypilot_tpu import __version__
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu.utils import common_utils
@@ -124,7 +125,9 @@ def _add_options(options):
 
 
 @click.group()
-@click.version_option(message='%(version)s')
+# Explicit version: click's package introspection fails when running
+# from a source tree (PYTHONPATH) rather than an installed wheel.
+@click.version_option(version=__version__, message='%(version)s')
 def cli():
     """skypilot_tpu: run AI workloads on TPU slices, anywhere."""
     # Crash-safe orphan cleanup: kill daemons whose state dir vanished
