@@ -9,10 +9,15 @@ also ships a native replica server wired to its own compute layer
             --port $SKYTPU_SERVE_REPLICA_PORT
 
 Endpoints:
-  GET  /            -> health (the serve readiness probe target)
-  POST /generate    -> {"prompt_ids": [[..]], "max_new_tokens": N,
-                        "temperature": T, "top_k": K}
-                       => {"tokens": [[..]], "latency_ms": ..}
+  GET  /                 -> health + engine stats (readiness probe)
+  POST /generate         -> {"prompt_ids": [[..]], "max_new_tokens": N,
+                             "temperature": T, "top_k": K}
+                            => {"tokens": [[..]], "latency_ms": ..}
+  POST /generate_stream  -> SSE: data: {"token": N} per token, then
+                            data: [DONE]  (continuous batching only)
+  POST /generate_text    -> {"prompt": "...", "max_new_tokens": N}
+                            => {"completion": "...", ...} via the
+                            byte-level tokenizer (vocab_size >= 256)
 
 Token-id in/out keeps the server dependency-free (tokenization happens
 client-side or via examples/prepare_data.py's conventions).
@@ -145,7 +150,8 @@ class ModelServer:
             self._engine = None
 
     def generate(self, prompt_ids, max_new_tokens: int,
-                 temperature: float = 0.0, top_k: int = 0) -> Any:
+                 temperature: float = 0.0, top_k: int = 0,
+                 stop_token: Optional[int] = None) -> Any:
         import jax.numpy as jnp
 
         from skypilot_tpu.models import decode
@@ -168,7 +174,8 @@ class ModelServer:
             # whatever else is in flight (no lock — that is the point).
             requests = [
                 self._engine.submit([int(t) for t in row],
-                                    max_new_tokens)
+                                    max_new_tokens,
+                                    stop_token=stop_token)
                 for row in prompt_ids
             ]
             return [r.result(timeout=600) for r in requests]
@@ -190,6 +197,10 @@ def _make_handler(server: ModelServer):
 
         def log_message(self, *args):
             del args
+
+        def _read_json(self) -> Dict[str, Any]:
+            length = int(self.headers.get('Content-Length', 0))
+            return json.loads(self.rfile.read(length) or b'{}')
 
         def _reply(self, code: int, payload: Dict[str, Any]) -> None:
             body = json.dumps(payload).encode()
@@ -215,14 +226,55 @@ def _make_handler(server: ModelServer):
                     code = 503
             self._reply(code, payload)
 
+        def _generate_text(self):
+            """Text in, text out via the byte-level tokenizer (the
+            dependency-free convention of examples/prepare_data.py:
+            UTF-8 bytes are the ids, NUL is EOS).  Needs
+            vocab_size >= 256; checkpoints trained on byte data."""
+            try:
+                if server.cfg.vocab_size < 256:
+                    raise ValueError(
+                        'byte-level text serving needs vocab_size '
+                        f'>= 256 (model has {server.cfg.vocab_size})')
+                req = self._read_json()
+                text = req['prompt']
+                if not isinstance(text, str) or not text:
+                    raise ValueError('prompt must be a non-empty string')
+                ids = list(text.encode('utf-8'))
+                t0 = time.perf_counter()
+                # NUL is EOS in byte mode: under continuous batching the
+                # engine stops AT it (freeing the slot); the lock-step
+                # scan is fixed-length, so truncation below still
+                # applies either way.
+                tokens = server.generate(
+                    [ids], int(req.get('max_new_tokens', 64)),
+                    float(req.get('temperature', 0.0)),
+                    int(req.get('top_k', 0)),
+                    stop_token=0)[0]
+                if 0 in tokens:  # NUL = EOS in byte mode
+                    tokens = tokens[:tokens.index(0)]
+                completion = bytes(
+                    t for t in tokens if 0 < t < 256).decode(
+                        'utf-8', errors='replace')
+                self._reply(200, {
+                    'completion': completion,
+                    'tokens': tokens,
+                    'latency_ms': round(
+                        (time.perf_counter() - t0) * 1e3, 1),
+                })
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._reply(400, {'error': str(e)})
+            except Exception as e:  # pylint: disable=broad-except
+                self._reply(500, {'error': f'{type(e).__name__}: {e}'})
+
         def _generate_stream(self):
             """SSE token stream: `data: {"token": N}` per token, then
             `data: [DONE]`.  Requires --continuous-batching (the engine
             produces tokens one step at a time); single prompt only.
             The LB relays these chunks unbuffered end-to-end."""
             try:
-                length = int(self.headers.get('Content-Length', 0))
-                req = json.loads(self.rfile.read(length))
+                req = self._read_json()
                 prompt = req['prompt_ids']
                 if (isinstance(prompt, list) and prompt and
                         isinstance(prompt[0], list)):
@@ -285,12 +337,14 @@ def _make_handler(server: ModelServer):
             if self.path == '/generate_stream':
                 self._generate_stream()
                 return
+            if self.path == '/generate_text':
+                self._generate_text()
+                return
             if self.path != '/generate':
                 self._reply(404, {'error': 'unknown path'})
                 return
             try:
-                length = int(self.headers.get('Content-Length', 0))
-                req = json.loads(self.rfile.read(length))
+                req = self._read_json()
                 t0 = time.perf_counter()
                 tokens = server.generate(
                     req['prompt_ids'],

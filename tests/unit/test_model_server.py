@@ -337,3 +337,29 @@ def test_sharded_restore_streams_to_devices(tmp_path):
     assert len(leaf.sharding.device_set) == 2
     prompt = [[5, 3, 2, 1]]
     assert sharded.generate(prompt, 4) == plain.generate(prompt, 4)
+
+
+def test_generate_text_byte_tokenizer():
+    """Text in/out over the byte-level convention (UTF-8 bytes are the
+    ids, NUL is EOS)."""
+    server = model_server.ModelServer('tiny', max_len=64, max_batch=1)
+    port, shutdown = model_server.start_background(server)
+    try:
+        r = requests.post(f'http://127.0.0.1:{port}/generate_text',
+                          json={'prompt': 'hello', 'max_new_tokens': 6},
+                          timeout=120)
+        r.raise_for_status()
+        body = r.json()
+        assert isinstance(body['completion'], str)
+        assert len(body['tokens']) <= 6
+        # Deterministic: same prompt -> same completion.
+        r2 = requests.post(f'http://127.0.0.1:{port}/generate_text',
+                           json={'prompt': 'hello',
+                                 'max_new_tokens': 6}, timeout=120)
+        assert r2.json()['completion'] == body['completion']
+        bad = requests.post(f'http://127.0.0.1:{port}/generate_text',
+                            json={'prompt': ''}, timeout=60)
+        assert bad.status_code == 400
+    finally:
+        shutdown()
+        server.close()
