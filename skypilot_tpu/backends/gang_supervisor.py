@@ -32,7 +32,10 @@ import argparse
 import concurrent.futures
 import json
 import os
+import signal
+import subprocess
 import sys
+import threading
 from typing import Any, Dict, List
 
 from skypilot_tpu import provision
@@ -110,31 +113,107 @@ def _run_gang_native(spec, runners, host_ips, log_dir, run_cmd):
     binary = native.ensure_fanin_built()
     if binary is None:
         return None
-    argvs, log_paths = [], []
+    gang_tag = os.path.basename(log_dir.rstrip('/'))
+    argvs, log_paths, pidfiles = [], [], []
     for rank, runner in enumerate(runners):
         env = _rank_env(spec, rank, host_ips)
-        exports = log_lib.make_task_bash_script(run_cmd, env)
+        pidfile = f'~/.skytpu/gang/{gang_tag}-rank{rank}.pid'
+        exports = log_lib.make_task_bash_script(run_cmd, env,
+                                                pidfile=pidfile)
         argv = runner.spawn_spec(exports)
         if argv is None:
             return None
         argvs.append(argv)
+        pidfiles.append(pidfile)
         log_paths.append(os.path.join(log_dir, 'tasks',
                                       f'rank-{rank}.log'))
     spec_path = os.path.join(log_dir, 'fanin.spec')
     native.write_spec(spec_path, log_paths, argvs)
-    return native.run_fanin(binary, spec_path)
+    returncodes = native.run_fanin(binary, spec_path)
+    if returncodes is not None and any(
+            rc != 0 for rc in returncodes.values()):
+        # The fan-in killed the LOCAL transports; over ssh/kubectl the
+        # remote rank processes survive that, so sweep their process
+        # trees via the pidfiles (ranks that exited cleanly removed
+        # theirs — the sweep is a no-op there).
+        _sweep_remote_kills(runners, pidfiles)
+    return returncodes
+
+
+def _sweep_remote_kills(runners, pidfiles) -> None:
+    def _one(runner, pidfile):
+        try:
+            runner.run(log_lib.make_kill_tree_command(pidfile),
+                       stream_logs=False)
+        except Exception:  # pylint: disable=broad-except
+            pass  # best-effort: the host may be the one that died
+
+    threads = [
+        threading.Thread(target=_one, args=(r, p), daemon=True)
+        for r, p in zip(runners, pidfiles)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
 
 
 def _run_gang_python(runners, spec, host_ips, log_dir, run_cmd):
+    # Live transport processes by rank, so the first failure can kill
+    # the survivors (fail-fast, matching the C++ fan-in and the
+    # reference's get_or_fail :294-328) instead of leaving them blocked
+    # in collectives until a timeout or manual cancel.
+    procs_lock = threading.Lock()
+    procs: Dict[int, Any] = {}
+    aborting = threading.Event()
+    # Each rank records its remote PID so abort can kill the REMOTE
+    # process tree: SIGTERMing the local ssh/kubectl client alone never
+    # signals the far side (no tty; ControlMaster keeps the TCP up).
+    gang_tag = os.path.basename(log_dir.rstrip('/'))
+
+    def _pidfile(rank: int) -> str:
+        return f'~/.skytpu/gang/{gang_tag}-rank{rank}.pid'
 
     def _one_rank(rank: int) -> int:
         runner = runners[rank]
         env = _rank_env(spec, rank, host_ips)
-        exports = log_lib.make_task_bash_script(run_cmd, env)
+        exports = log_lib.make_task_bash_script(run_cmd, env,
+                                                pidfile=_pidfile(rank))
         log_path = os.path.join(log_dir, 'tasks', f'rank-{rank}.log')
+
+        def _register(proc):
+            with procs_lock:
+                procs[rank] = proc
+            if aborting.is_set():
+                # Lost the race with the abort sweep: kill immediately.
+                _kill_rank(runners[rank], _pidfile(rank), proc)
+
         # stream_logs mirrors rank output to the supervisor's stdout, which
         # the scheduler redirects to run.log — what `sky logs` tails.
-        return runner.run(exports, log_path=log_path, stream_logs=True)
+        return runner.run(exports, log_path=log_path, stream_logs=True,
+                          on_spawn=_register)
+
+    def _abort_survivors(failed: int) -> None:
+        aborting.set()
+        with procs_lock:
+            victims = [(r, p) for r, p in procs.items()
+                       if r != failed and p.poll() is None]
+        if not victims:
+            return
+        print(f'rank {failed} failed: terminating ranks '
+              f'{sorted(r for r, _ in victims)}', flush=True)
+        # Remote + local kills fan out in parallel; SIGKILL escalation
+        # shares one deadline rather than 5s per rank.
+        kill_threads = [
+            threading.Thread(target=_kill_rank,
+                             args=(runners[rank], _pidfile(rank), proc),
+                             daemon=True)
+            for rank, proc in victims
+        ]
+        for t in kill_threads:
+            t.start()
+        for t in kill_threads:
+            t.join(timeout=30)
 
     # Rank 0's log additionally mirrors to run.log for `sky logs` tailing.
     returncodes: Dict[int, int] = {}
@@ -156,15 +235,39 @@ def _run_gang_python(runners, spec, host_ips, log_dir, run_cmd):
                 print(f'rank {rank} supervisor error: {e}', flush=True)
                 rc = 255
             returncodes[rank] = rc
-            if rc != 0 and failed_rank < 0:
+            if rc != 0 and failed_rank < 0 and not aborting.is_set():
                 failed_rank = rank
-                # Fan-in failure (all-or-nothing slice semantics; parity
-                # get_or_fail :294-328): not-yet-started ranks are dropped;
-                # in-flight ranks share the supervisor's process group and
-                # are killed with it when the scheduler cancels the job.
+                # Fan-in failure (all-or-nothing slice semantics):
+                # not-yet-started ranks are dropped; in-flight ranks are
+                # SIGTERMed via their transport process groups.
                 for fut_other in futures:
                     fut_other.cancel()
+                _abort_survivors(rank)
     return returncodes
+
+
+def _kill_rank(runner, pidfile: str, proc) -> None:
+    """Kill one surviving rank: first its process tree ON THE HOST (via
+    the pidfile the task script wrote — reaches through ssh/kubectl
+    where killing the local client cannot), then the local transport
+    process group (run_with_log starts each child in its own session,
+    so pid == pgid), escalating to SIGKILL if it ignores SIGTERM."""
+    try:
+        runner.run(log_lib.make_kill_tree_command(pidfile),
+                   stream_logs=False)
+    except Exception:  # pylint: disable=broad-except
+        pass  # best-effort: the host may be the one that died
+    try:
+        os.killpg(proc.pid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        return
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
 
 
 def main() -> None:

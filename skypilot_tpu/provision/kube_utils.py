@@ -114,8 +114,10 @@ def get_pods(run_cli: RunCli, meta: Dict[str, Any], label: str,
 def ensure_pod(run_cli: RunCli, meta: Dict[str, Any],
                manifest: Dict[str, Any]) -> str:
     """Create the pod if absent; recreate if it sits in a terminal
-    phase (a Failed/Succeeded/Unknown pod with restartPolicy: Never can
-    never run again — resuming it would wedge the cluster permanently).
+    phase (a Failed/Succeeded pod with restartPolicy: Never can never
+    run again — resuming it would wedge the cluster permanently).
+    'Unknown' is deliberately resumed, not recreated: node partitions
+    report Unknown and self-heal (see TERMINAL_PHASES).
 
     Returns 'created' | 'resumed'.
     """

@@ -49,15 +49,28 @@ class _Request:
         # Streaming consumers read tokens as they are produced; the
         # None sentinel marks the end of the stream.
         self._live: 'queue.Queue[Optional[int]]' = queue.Queue()
+        # _finish can race (worker finishing vs stop() failing-fast vs
+        # submit() losing the stop race): first caller wins, later
+        # calls are no-ops — otherwise two None sentinels truncate a
+        # stream() and a success can be overwritten with an error.
+        self._state_lock = threading.Lock()
 
     def _push(self, token: int) -> None:
-        self.tokens.append(token)
-        self._live.put(token)
+        with self._state_lock:
+            if self.done.is_set():
+                # stop() already finished this request; a worker still
+                # mid-tick must not append past the sentinel.
+                return
+            self.tokens.append(token)
+            self._live.put(token)
 
     def _finish(self, error: Optional[Exception] = None) -> None:
-        self.error = error
-        self.done.set()
-        self._live.put(None)
+        with self._state_lock:
+            if self.done.is_set():
+                return
+            self.error = error
+            self.done.set()
+            self._live.put(None)
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         if not self.done.wait(timeout):

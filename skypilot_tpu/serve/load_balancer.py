@@ -34,6 +34,12 @@ _HOP_HEADERS = {'connection', 'keep-alive', 'proxy-authenticate',
                 'proxy-authorization', 'te', 'trailers', 'upgrade'}
 _MAX_HEAD = 64 * 1024
 _UPSTREAM_CONNECT_TIMEOUT = 10.0
+# Max silence between upstream response chunks.  Generous because a
+# busy engine can legitimately take minutes before the first token, but
+# finite so a wedged replica releases the client connection (and the
+# least_connections in-flight count) instead of pinning both forever.
+_UPSTREAM_IDLE_TIMEOUT = float(
+    os.environ.get('SKYTPU_LB_UPSTREAM_IDLE_TIMEOUT', '300'))
 _CHUNK = 64 * 1024
 
 
@@ -155,22 +161,32 @@ def _body_framing(headers: List[Tuple[str, str]]) -> Tuple[str, int]:
 async def _relay_body(reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter,
                       framing: Tuple[str, int]) -> None:
-    """Stream a message body with its original framing preserved."""
+    """Stream a message body with its original framing preserved.
+
+    Every read AND drain is idle-bounded: a replica that stops READING
+    the request body wedges `drain()` (send buffers full) exactly like
+    one that stops writing the response — both must release the client
+    connection and the in-flight count, not pin them forever.
+    """
+
+    def _bounded(awaitable):
+        return asyncio.wait_for(awaitable, timeout=_UPSTREAM_IDLE_TIMEOUT)
+
     kind, length = framing
     if kind == 'length':
         remaining = length
         while remaining > 0:
-            chunk = await reader.read(min(_CHUNK, remaining))
+            chunk = await _bounded(reader.read(min(_CHUNK, remaining)))
             if not chunk:
                 raise ConnectionError('body truncated')
             writer.write(chunk)
-            await writer.drain()
+            await _bounded(writer.drain())
             remaining -= len(chunk)
     elif kind == 'chunked':
         # Pass chunks through verbatim while tracking the framing so we
         # know where the body ends (incl. the trailing CRLF / trailers).
         while True:
-            size_line = await reader.readline()
+            size_line = await _bounded(reader.readline())
             writer.write(size_line)
             try:
                 size = int(size_line.strip().split(b';')[0], 16)
@@ -179,26 +195,28 @@ async def _relay_body(reader: asyncio.StreamReader,
             if size == 0:
                 # Trailers (if any) end with an empty line.
                 while True:
-                    trailer = await reader.readline()
+                    trailer = await _bounded(reader.readline())
                     writer.write(trailer)
                     if trailer in (b'\r\n', b'\n', b''):
                         break
-                await writer.drain()
+                await _bounded(writer.drain())
                 return
             remaining = size + 2  # chunk data + CRLF
             while remaining > 0:
-                chunk = await reader.read(min(_CHUNK, remaining))
+                chunk = await _bounded(
+                    reader.read(min(_CHUNK, remaining)))
                 if not chunk:
                     raise ConnectionError('chunk truncated')
                 writer.write(chunk)
                 remaining -= len(chunk)
-            await writer.drain()
+            await _bounded(writer.drain())
 
 
 async def _relay_until_eof(reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
     while True:
-        chunk = await reader.read(_CHUNK)
+        chunk = await asyncio.wait_for(reader.read(_CHUNK),
+                                       timeout=_UPSTREAM_IDLE_TIMEOUT)
         if not chunk:
             return
         writer.write(chunk)
@@ -347,8 +365,11 @@ class SkyServeLoadBalancer:
                 await uwriter.drain()
                 # Stream the request body with its original framing.
                 await _relay_body(creader, uwriter, _body_framing(headers))
-                first = await ureader.read(_CHUNK)
-            except (OSError, ConnectionError) as e:
+                # Idle timeout: a replica that accepts the connection
+                # but never answers must not pin the client forever.
+                first = await asyncio.wait_for(
+                    ureader.read(_CHUNK), timeout=_UPSTREAM_IDLE_TIMEOUT)
+            except (OSError, ConnectionError, asyncio.TimeoutError) as e:
                 raise _UpstreamError(
                     f'replica {target} dropped the request: {e}') from e
             if not first:

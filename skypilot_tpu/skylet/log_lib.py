@@ -14,7 +14,7 @@ import sys
 import tempfile
 import textwrap
 import time
-from typing import Dict, Iterator, List, Optional, Tuple, Union
+from typing import Callable, Dict, Iterator, List, Optional, Tuple, Union
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.skylet import constants
@@ -76,8 +76,14 @@ def run_with_log(cmd: Union[str, List[str]],
                  env: Optional[Dict[str, str]] = None,
                  cwd: Optional[str] = None,
                  line_prefix: str = '',
+                 on_spawn: Optional[Callable[['subprocess.Popen'],
+                                             None]] = None,
                  **kwargs) -> Union[int, Tuple[int, str, str]]:
-    """Run cmd, teeing output to `log_path`; returns rc (or rc, out, err)."""
+    """Run cmd, teeing output to `log_path`; returns rc (or rc, out, err).
+
+    `on_spawn` (if given) receives the Popen right after launch — the
+    gang supervisor uses it to hold rank handles for fail-fast kills.
+    """
     del with_ray  # reference-API compat; no Ray here
     assert process_stream_ok(kwargs)
     log_path = os.path.expanduser(log_path)
@@ -90,6 +96,8 @@ def run_with_log(cmd: Union[str, List[str]],
                           text=True,
                           env=env,
                           cwd=cwd) as proc:
+        if on_spawn is not None:
+            on_spawn(proc)
         try:
             stdout, stderr = process_subprocess_stream(
                 proc, log_path, stream_logs, require_outputs, line_prefix)
@@ -109,12 +117,18 @@ def process_stream_ok(kwargs: dict) -> bool:
 
 
 def make_task_bash_script(codegen: str,
-                          env_vars: Optional[Dict[str, str]] = None) -> str:
+                          env_vars: Optional[Dict[str, str]] = None,
+                          pidfile: Optional[str] = None) -> str:
     """Wrap user `run` commands in a bash script with exported env.
 
     Parity: reference log_lib.py:256-300 (login-shell semantics so conda/venv
     activation in ~/.bashrc applies; `set -e`-free so partial failures
     surface via exit codes, not silent aborts).
+
+    `pidfile` (a remote path; '~' stays unquoted for expansion) records
+    the script's own PID on the host it runs on, so a supervisor can
+    later kill the task's process tree over the transport — killing the
+    local ssh/kubectl client alone never signals the remote process.
     """
     script = [
         textwrap.dedent(f"""\
@@ -126,11 +140,29 @@ def make_task_bash_script(codegen: str,
             cd {constants.SKY_REMOTE_WORKDIR} 2>/dev/null || cd ~
             """),
     ]
+    if pidfile:
+        script.append(f'mkdir -p "$(dirname {pidfile})" && '
+                      f'echo $$ > {pidfile} && '
+                      # Self-clean on normal exit so a later kill sweep
+                      # cannot TERM a reused PID.
+                      f"trap 'rm -f {pidfile}' EXIT")
     if env_vars:
         for k, v in env_vars.items():
             script.append(f'export {k}={subprocess_quote(v)}')
     script.append(codegen)
     return '\n'.join(script) + '\n'
+
+
+def make_kill_tree_command(pidfile: str) -> str:
+    """Shell one-liner that TERM-kills the process tree rooted at the
+    PID in `pidfile` (deepest-first so re-parenting cannot orphan
+    grandchildren mid-walk), then removes the pidfile."""
+    return (f'pid=$(cat {pidfile} 2>/dev/null); '
+            'if [ -n "$pid" ]; then '
+            'kill_tree() { local c; '
+            'for c in $(pgrep -P "$1" 2>/dev/null); do kill_tree "$c"; '
+            'done; kill -TERM "$1" 2>/dev/null; }; '
+            f'kill_tree "$pid"; rm -f {pidfile}; fi')
 
 
 def subprocess_quote(s: str) -> str:

@@ -136,7 +136,8 @@ class CommandRunner:
 def _run_local(cmd: List[str] | str, *, shell: bool, require_outputs: bool,
                log_path: str, stream_logs: bool,
                env: Optional[Dict[str, str]] = None,
-               cwd: Optional[str] = None
+               cwd: Optional[str] = None,
+               on_spawn: Optional[Any] = None
                ) -> Union[int, Tuple[int, str, str]]:
     """Shared subprocess execution with tee-to-logfile semantics."""
     from skypilot_tpu.skylet import log_lib  # pylint: disable=import-outside-toplevel
@@ -146,7 +147,8 @@ def _run_local(cmd: List[str] | str, *, shell: bool, require_outputs: bool,
                                 stream_logs=stream_logs,
                                 shell=shell,
                                 env=env,
-                                cwd=cwd)
+                                cwd=cwd,
+                                on_spawn=on_spawn)
 
 
 class SSHCommandRunner(CommandRunner):
@@ -206,6 +208,7 @@ class SSHCommandRunner(CommandRunner):
             connect_timeout: Optional[int] = None,
             source_bashrc: bool = False,
             **kwargs: Any) -> Union[int, Tuple[int, str, str]]:
+        on_spawn = kwargs.pop('on_spawn', None)
         del kwargs
         base = self._ssh_base_command(ssh_mode=ssh_mode,
                                       connect_timeout=connect_timeout)
@@ -219,7 +222,7 @@ class SSHCommandRunner(CommandRunner):
         command = base + [f'{shell_prefix} {shlex.quote(cmd)}']
         return _run_local(command, shell=False,
                           require_outputs=require_outputs, log_path=log_path,
-                          stream_logs=stream_logs)
+                          stream_logs=stream_logs, on_spawn=on_spawn)
 
     def spawn_spec(self, cmd: str) -> Optional[List[str]]:
         base = self._ssh_base_command(ssh_mode=SshMode.NON_INTERACTIVE,
@@ -295,6 +298,7 @@ class LocalProcessRunner(CommandRunner):
             stream_logs: bool = True,
             connect_timeout: Optional[int] = None,
             **kwargs: Any) -> Union[int, Tuple[int, str, str]]:
+        on_spawn = kwargs.pop('on_spawn', None)
         del connect_timeout, kwargs
         if isinstance(cmd, list):
             cmd = ' '.join(cmd)
@@ -307,7 +311,7 @@ class LocalProcessRunner(CommandRunner):
             env.pop('SKYTPU_JOB_DB', None)
         return _run_local(cmd, shell=True, require_outputs=require_outputs,
                           log_path=log_path, stream_logs=stream_logs, env=env,
-                          cwd=self.root_dir)
+                          cwd=self.root_dir, on_spawn=on_spawn)
 
     def spawn_spec(self, cmd: str) -> Optional[List[str]]:
         # env(1) options must precede KEY=VALUE assignments.
@@ -449,12 +453,14 @@ class KubernetesCommandRunner(CommandRunner):
             stream_logs: bool = True,
             connect_timeout: Optional[int] = None,
             **kwargs: Any) -> Union[int, Tuple[int, str, str]]:
+        on_spawn = kwargs.pop('on_spawn', None)
         del connect_timeout, kwargs
         if isinstance(cmd, list):
             cmd = ' '.join(cmd)
         return _run_local(self._exec_argv(cmd), shell=False,
                           require_outputs=require_outputs,
-                          log_path=log_path, stream_logs=stream_logs)
+                          log_path=log_path, stream_logs=stream_logs,
+                          on_spawn=on_spawn)
 
     def spawn_spec(self, cmd: str) -> Optional[List[str]]:
         return self._exec_argv(cmd)

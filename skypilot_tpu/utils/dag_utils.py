@@ -58,10 +58,20 @@ def dump_chain_dag_to_yaml(dag: dag_lib.Dag, yaml_path: str) -> None:
     A name-only header document always leads, so the round trip
     preserves the DAG name AND a first task that happens to serialize
     to only `name:` can never be mistaken for the header on reload.
+
+    An empty DAG dumps as an empty file — losing its name: a lone
+    header document would reload as a task config (the header rule
+    needs >1 documents, matching the reference convention that a
+    single-document YAML is a task) and crash Task.from_yaml_config.
+    No production path dumps an empty DAG; the round trip just must
+    not crash.
     """
     import yaml  # pylint: disable=import-outside-toplevel
-    configs = [{'name': dag.name or (dag.tasks[0].name if dag.tasks
-                                     else None)}]
+    if not dag.tasks:
+        with open(yaml_path, 'w', encoding='utf-8') as f:
+            f.write('')
+        return
+    configs = [{'name': dag.name or dag.tasks[0].name}]
     configs += [task.to_yaml_config() for task in dag.tasks]
     with open(yaml_path, 'w', encoding='utf-8') as f:
         yaml.safe_dump_all(configs, f, default_flow_style=False,

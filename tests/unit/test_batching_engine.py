@@ -219,3 +219,20 @@ def test_failed_engine_fails_health_probe(setup, monkeypatch):
     finally:
         shutdown()
         server.close()
+
+
+def test_request_finish_is_idempotent():
+    """A _finish race (worker vs stop() vs submit-after-stop) must not
+    push two stream sentinels or overwrite a success with an error."""
+    from skypilot_tpu.serve.batching_engine import _Request
+    req = _Request([1], max_new_tokens=4, stop_token=None)
+    req._push(42)
+    req._finish()
+    req._finish(RuntimeError('late shutdown'))  # loser of the race
+    assert req.error is None  # success not overwritten
+    assert req.result(timeout=1) == [42]
+    # Exactly one sentinel: the stream ends after 42, and a token pushed
+    # after finish is dropped rather than appearing past the end.
+    req._push(99)
+    assert list(req.stream(timeout=1)) == [42]
+    assert req.tokens == [42]

@@ -248,3 +248,14 @@ def test_chain_dump_load_round_trip_preserves_all_tasks(tmp_path):
     loaded = dag_utils.load_chain_dag_from_yaml(str(p))
     assert loaded.name == 'pipe'
     assert [t.name for t in loaded.tasks] == ['gate', 'train']
+
+
+def test_empty_dag_dump_load_round_trip(tmp_path):
+    """An empty DAG dumps to an empty file and reloads as an empty DAG
+    (a lone header doc would reload as a task config and crash)."""
+    from skypilot_tpu import dag as dag_lib
+    from skypilot_tpu.utils import dag_utils
+    p = tmp_path / 'empty.yaml'
+    dag_utils.dump_chain_dag_to_yaml(dag_lib.Dag('nothing'), str(p))
+    loaded = dag_utils.load_chain_dag_from_yaml(str(p))
+    assert loaded.tasks == []

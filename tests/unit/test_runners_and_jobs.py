@@ -148,3 +148,36 @@ class TestJobLib:
                               check=False)
         assert proc.returncode == 0, proc.stderr
         assert job_lib.parse_job_id(proc.stdout) >= 1
+
+
+class TestGangFailFast:
+    """The Python-fallback gang supervisor must kill in-flight ranks on
+    the first failure (all-or-nothing slice semantics), not let them run
+    to completion while the dead rank's peers block in collectives."""
+
+    def test_first_failure_terminates_survivors(self, tmp_path):
+        from skypilot_tpu.backends import gang_supervisor
+        runners = [
+            command_runner.LocalProcessRunner(
+                node=(f'host{i}', 0), root_dir=str(tmp_path / f'host{i}'))
+            for i in range(4)
+        ]
+        log_dir = str(tmp_path / 'logs')
+        os.makedirs(os.path.join(log_dir, 'tasks'), exist_ok=True)
+        # Rank 2 dies immediately; the others would sleep for 60s. With
+        # fail-fast the whole gang must settle in seconds.
+        run_cmd = ('if [ "$SKYTPU_HOST_RANK" = "2" ]; then exit 7; fi; '
+                   'sleep 60; echo SURVIVED')
+        start = time.time()
+        rcs = gang_supervisor._run_gang_python(  # pylint: disable=protected-access
+            runners, {'hosts_per_slice': 1}, ['127.0.0.1'] * 4, log_dir,
+            run_cmd)
+        elapsed = time.time() - start
+        assert elapsed < 30, f'gang did not fail fast: {elapsed:.1f}s'
+        assert rcs[2] == 7
+        # Every surviving rank was terminated, not left to finish.
+        for rank in (0, 1, 3):
+            assert rcs[rank] != 0, rcs
+        for rank in (0, 1, 3):
+            log = tmp_path / 'logs' / 'tasks' / f'rank-{rank}.log'
+            assert 'SURVIVED' not in log.read_text()
