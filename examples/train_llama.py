@@ -20,7 +20,15 @@ import time
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='tiny',
-                        help='tiny | small | llama3-8b | llama3-70b')
+                        help="tiny | small | llama3-8b | llama3-70b | "
+                             "'auto' (shape from --init-from's "
+                             'model_config.json)')
+    parser.add_argument('--init-from', default=None,
+                        help='Converted checkpoint dir '
+                             '(models/import_weights.py) to START the '
+                             'finetune from; auto-resume from the '
+                             'checkpoint contract still wins after a '
+                             'preemption.')
     parser.add_argument('--steps', type=int, default=20)
     parser.add_argument('--batch-size', type=int, default=8)
     parser.add_argument('--seq-len', type=int, default=512)
@@ -64,7 +72,18 @@ def main() -> None:
         preflight.check_collectives(mesh)
         print('collective preflight: healthy')
 
-    cfg = configs.get_config(args.model, sequence_parallel=args.sp_mode)
+    if args.model == 'auto':
+        from skypilot_tpu.models import import_weights
+        if not args.init_from:
+            raise SystemExit('--model auto needs --init-from')
+        cfg = import_weights.load_model_config(args.init_from)
+        if cfg is None:
+            raise SystemExit(
+                f'No model_config.json under {args.init_from}')
+        cfg = cfg.replace(sequence_parallel=args.sp_mode)
+    else:
+        cfg = configs.get_config(args.model,
+                                 sequence_parallel=args.sp_mode)
     state, shardings = create_train_state(
         cfg, TrainConfig(), mesh=mesh, batch_size=args.batch_size,
         seq_len=args.seq_len)
@@ -76,6 +95,13 @@ def main() -> None:
         mgr = checkpoints.checkpoint_manager(save_interval_steps=10)
         state, start_step = checkpoints.restore_or_init(mgr, state)
         print(f'resuming from step {start_step}')
+    if start_step == 0 and args.init_from:
+        # Real-weights finetune start (Llama-3-8B from a converted HF
+        # checkpoint — the BASELINE.md north-star workload); a resumed
+        # preemption recovery above takes precedence.
+        from skypilot_tpu.models.train import load_pretrained_params
+        state = load_pretrained_params(state, args.init_from)
+        print(f'initialized params from {args.init_from}')
 
     cb = callbacks.init(total_steps=args.steps)
     if args.data:

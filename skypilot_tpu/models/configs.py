@@ -49,13 +49,43 @@ class ModelConfig:
     mlp_act: str = 'silu'             # 'silu' (Llama) | 'gelu' (Gemma)
     norm_scale_plus_one: bool = False  # RMSNorm x (1 + w), w init 0 (Gemma)
     scale_embeddings: bool = False    # embed x sqrt(d_model) (Gemma)
+    # Per-head width when decoupled from d_model // n_heads (Gemma-7B:
+    # d_model 3072, 16 heads x head_dim 256).  None = derived.
+    head_dim_override: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.d_model // self.n_heads
 
     def replace(self, **kw) -> 'ModelConfig':
         return dataclasses.replace(self, **kw)
+
+    def to_json_dict(self) -> dict:
+        """JSON-serializable form (dtypes as strings); inverse of
+        config_from_json_dict.  Written next to converted checkpoints
+        so servers/trainers can reconstruct non-preset shapes."""
+        import numpy as np  # pylint: disable=import-outside-toplevel
+        d = dataclasses.asdict(self)
+        d['dtype'] = np.dtype(self.dtype).name
+        d['param_dtype'] = np.dtype(self.param_dtype).name
+        return d
+
+
+def config_from_json_dict(d: dict) -> ModelConfig:
+    import numpy as np  # pylint: disable=import-outside-toplevel
+    d = dict(d)
+    for key in ('dtype', 'param_dtype'):
+        if isinstance(d.get(key), str):
+            # np.dtype resolves 'bfloat16' via ml_dtypes registration.
+            d[key] = (jnp.bfloat16 if d[key] == 'bfloat16'
+                      else np.dtype(d[key]).type)
+    known = {f.name for f in dataclasses.fields(ModelConfig)}
+    unknown = set(d) - known
+    if unknown:
+        raise ValueError(f'Unknown ModelConfig fields {sorted(unknown)}')
+    return ModelConfig(**d)
 
 
 LLAMA3_8B = ModelConfig()

@@ -1,0 +1,365 @@
+"""Tokenizers for real checkpoints: text in/out for serving + finetune.
+
+Three backends behind one interface (encode/decode/eos_id/vocab_size):
+
+- HFTokenizer: HF `tokenizer.json` via the `tokenizers` library when
+  present (exact fidelity for Llama-3/Qwen/Gemma/Mixtral releases).
+- SentencePieceTokenizer: pure-Python reader for SentencePiece `.model`
+  protobufs (no sentencepiece dependency): parses the piece table and
+  encodes with score-based Viterbi (exact for unigram models; for
+  BPE-type models a highest-score merge loop) with byte fallback.
+- ByteTokenizer: the framework's dependency-free byte-level convention
+  (UTF-8 bytes are the ids, NUL is EOS) — what examples/prepare_data.py
+  produces and tiny test checkpoints train on.
+
+`load_tokenizer(dir)` picks the best available for a checkpoint
+directory (converted checkpoints carry their tokenizer files —
+models/import_weights.py copies them next to the orbax step).
+
+StreamDecoder turns a token stream into UTF-8-safe text deltas for SSE:
+multi-byte sequences split across tokens are held back until complete,
+so clients always receive valid UTF-8.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+class Tokenizer:
+    """Interface: ids are plain ints; decode ignores ids it cannot map."""
+
+    eos_id: Optional[int] = None
+    bos_id: Optional[int] = None
+
+    @property
+    def vocab_size(self) -> int:
+        raise NotImplementedError
+
+    def encode(self, text: str, *, add_bos: bool = False) -> List[int]:
+        raise NotImplementedError
+
+    def decode(self, ids: Sequence[int]) -> str:
+        raise NotImplementedError
+
+
+class ByteTokenizer(Tokenizer):
+    """UTF-8 bytes as ids; NUL (0) is EOS.  The hermetic fallback."""
+
+    eos_id = 0
+
+    @property
+    def vocab_size(self) -> int:
+        return 256
+
+    def encode(self, text: str, *, add_bos: bool = False) -> List[int]:
+        del add_bos
+        return list(text.encode('utf-8'))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(t for t in ids if 0 < t < 256).decode(
+            'utf-8', errors='replace')
+
+
+class HFTokenizer(Tokenizer):
+    """tokenizer.json via the `tokenizers` library (exact HF fidelity)."""
+
+    def __init__(self, tokenizer_json: str,
+                 tokenizer_config: Optional[str] = None) -> None:
+        import tokenizers  # pylint: disable=import-outside-toplevel
+        self._tok = tokenizers.Tokenizer.from_file(tokenizer_json)
+        self.bos_token = None
+        self.eos_token = None
+        if tokenizer_config and os.path.exists(tokenizer_config):
+            with open(tokenizer_config, encoding='utf-8') as f:
+                cfg = json.load(f)
+            self.bos_token = _token_str(cfg.get('bos_token'))
+            self.eos_token = _token_str(cfg.get('eos_token'))
+        self.bos_id = (self._tok.token_to_id(self.bos_token)
+                       if self.bos_token else None)
+        self.eos_id = (self._tok.token_to_id(self.eos_token)
+                       if self.eos_token else None)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+    def encode(self, text: str, *, add_bos: bool = False) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=False).ids
+        if add_bos and self.bos_id is not None:
+            return [self.bos_id] + ids
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+
+def _token_str(token: Any) -> Optional[str]:
+    """tokenizer_config.json stores tokens as str or AddedToken dicts."""
+    if token is None:
+        return None
+    if isinstance(token, dict):
+        return token.get('content')
+    return str(token)
+
+
+# --------------------------------------------------------------------------
+# SentencePiece .model (pure-Python protobuf subset)
+# --------------------------------------------------------------------------
+
+_SP_NORMAL, _SP_UNKNOWN, _SP_CONTROL, _SP_USER_DEFINED, _SP_BYTE = \
+    1, 2, 3, 4, 6
+_SP_SPACE = '▁'  # '▁'
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _parse_sp_model(data: bytes):
+    """(pieces, model_type): pieces = [(text, score, type)], from the
+    SentencePiece ModelProto (field 1 = repeated SentencePiece, field 2
+    = TrainerSpec whose field 3 is model_type: 1 unigram, 2 bpe)."""
+    pieces: List[Tuple[str, float, int]] = []
+    model_type = 1
+    pos = 0
+    while pos < len(data):
+        tag, pos = _read_varint(data, pos)
+        field, wire = tag >> 3, tag & 7
+        if field == 1 and wire == 2:  # SentencePiece message
+            size, pos = _read_varint(data, pos)
+            end = pos + size
+            text, score, ptype = '', 0.0, _SP_NORMAL
+            while pos < end:
+                t, pos = _read_varint(data, pos)
+                f, w = t >> 3, t & 7
+                if f == 1 and w == 2:
+                    n, pos = _read_varint(data, pos)
+                    text = data[pos:pos + n].decode('utf-8')
+                    pos += n
+                elif f == 2 and w == 5:
+                    score = struct.unpack('<f', data[pos:pos + 4])[0]
+                    pos += 4
+                elif f == 3 and w == 0:
+                    ptype, pos = _read_varint(data, pos)
+                else:
+                    pos = _skip_field(data, pos, w)
+            pieces.append((text, score, ptype))
+        elif field == 2 and wire == 2:  # TrainerSpec
+            size, pos = _read_varint(data, pos)
+            end = pos + size
+            while pos < end:
+                t, pos = _read_varint(data, pos)
+                f, w = t >> 3, t & 7
+                if f == 3 and w == 0:
+                    model_type, pos = _read_varint(data, pos)
+                else:
+                    pos = _skip_field(data, pos, w)
+        else:
+            pos = _skip_field(data, pos, wire)
+    return pieces, model_type
+
+
+def _skip_field(data: bytes, pos: int, wire: int) -> int:
+    if wire == 0:
+        _, pos = _read_varint(data, pos)
+        return pos
+    if wire == 1:
+        return pos + 8
+    if wire == 2:
+        n, pos = _read_varint(data, pos)
+        return pos + n
+    if wire == 5:
+        return pos + 4
+    raise ValueError(f'Unsupported protobuf wire type {wire}')
+
+
+class SentencePieceTokenizer(Tokenizer):
+    """Pure-Python SentencePiece: Viterbi segmentation over piece
+    scores (the unigram objective; also a faithful stand-in for
+    BPE-type models, whose merge order follows the same scores), with
+    <0xNN> byte fallback for uncovered characters."""
+
+    def __init__(self, model_path: str) -> None:
+        with open(model_path, 'rb') as f:
+            pieces, self._model_type = _parse_sp_model(f.read())
+        self._pieces = pieces
+        self._id_of: Dict[str, int] = {}
+        self._byte_ids: Dict[int, int] = {}
+        self.unk_id = 0
+        for idx, (text, _, ptype) in enumerate(pieces):
+            self._id_of.setdefault(text, idx)
+            if ptype == _SP_UNKNOWN:
+                self.unk_id = idx
+            elif ptype == _SP_BYTE:
+                self._byte_ids[int(text[1:-1], 16)] = idx
+        self.bos_id = self._id_of.get('<s>')
+        self.eos_id = self._id_of.get('</s>')
+        self._max_piece_len = max((len(t) for t, _, _ in pieces),
+                                  default=1)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._pieces)
+
+    def encode(self, text: str, *, add_bos: bool = False) -> List[int]:
+        # SP normalization subset: spaces -> ▁ with a dummy prefix.
+        s = _SP_SPACE + text.replace(' ', _SP_SPACE)
+        n = len(s)
+        # Viterbi: best[i] = (score, backpointer, piece_id) for s[:i].
+        neg_inf = float('-inf')
+        best = [(neg_inf, -1, -1)] * (n + 1)
+        best[0] = (0.0, -1, -1)
+        for i in range(n):
+            base = best[i][0]
+            if base == neg_inf:
+                continue
+            upper = min(n, i + self._max_piece_len)
+            for j in range(i + 1, upper + 1):
+                piece = s[i:j]
+                pid = self._id_of.get(piece)
+                if pid is None:
+                    continue
+                score = base + self._pieces[pid][1]
+                if score > best[j][0]:
+                    best[j] = (score, i, pid)
+            if best[i + 1][0] == neg_inf:
+                # No piece covers s[i]: byte-fallback (or unk) for one
+                # char, with a large penalty so real pieces win.
+                best[i + 1] = (base - 100.0, i, -2)
+        ids: List[int] = []
+        segments: List[Tuple[int, int, int]] = []
+        j = n
+        while j > 0:
+            _, i, pid = best[j]
+            segments.append((i, j, pid))
+            j = i
+        for i, j, pid in reversed(segments):
+            if pid >= 0:
+                ids.append(pid)
+            else:  # byte-fallback segment (single char)
+                for b in s[i:j].encode('utf-8'):
+                    ids.append(self._byte_ids.get(b, self.unk_id))
+        if add_bos and self.bos_id is not None:
+            return [self.bos_id] + ids
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        out: List[str] = []
+        pending_bytes: List[int] = []
+
+        def flush() -> None:
+            if pending_bytes:
+                out.append(bytes(pending_bytes).decode(
+                    'utf-8', errors='replace'))
+                pending_bytes.clear()
+
+        for i in ids:
+            if not 0 <= i < len(self._pieces):
+                continue
+            text, _, ptype = self._pieces[i]
+            if ptype == _SP_BYTE:
+                pending_bytes.append(int(text[1:-1], 16))
+                continue
+            flush()
+            if ptype in (_SP_CONTROL, _SP_UNKNOWN):
+                continue
+            out.append(text)
+        flush()
+        return ''.join(out).replace(_SP_SPACE, ' ').lstrip(' ')
+
+
+class StreamDecoder:
+    """Incremental UTF-8-safe decoding for SSE text streaming.
+
+    push(token) returns the NEW text produced by that token (possibly
+    '' while a multi-byte sequence is still incomplete).  Sliding-
+    window detokenization (the TGI/vLLM scheme): only the ids since
+    the last emitted boundary are re-decoded — two short decodes per
+    token, NOT the whole history — with a one-token prefix window so
+    space-bearing decoders (Metaspace/SentencePiece '▁') see identical
+    left context in both decodes.  Text ending in U+FFFD (a multi-byte
+    sequence split across tokens) is held back until complete."""
+
+    def __init__(self, tokenizer: Tokenizer) -> None:
+        self._tok = tokenizer
+        self._ids: List[int] = []
+        # ids[prefix:read] decoded = text already emitted for the
+        # current window; ids[:prefix] are fully retired.
+        self._prefix = 0
+        self._read = 0
+
+    def push(self, token: int) -> str:
+        self._ids.append(token)
+        window = self._ids[self._prefix:]
+        emitted = self._tok.decode(self._ids[self._prefix:self._read])
+        text = self._tok.decode(window)
+        if text.endswith('�'):
+            # Incomplete UTF-8 sequence: hold everything back.
+            return ''
+        if not text.startswith(emitted):
+            # Decoder rewrote the window's earlier text (rare merge
+            # behavior): emit the whole window fresh.
+            delta = text
+        else:
+            delta = text[len(emitted):]
+        # Advance: retire all but the last token (it keeps supplying
+        # left context for the next decode), mark everything emitted.
+        self._read = len(self._ids)
+        self._prefix = max(0, self._read - 1)
+        return delta
+
+    def finish(self) -> str:
+        """Remaining text (with any genuinely invalid bytes surfaced
+        as replacement chars)."""
+        emitted = self._tok.decode(self._ids[self._prefix:self._read])
+        text = self._tok.decode(self._ids[self._prefix:])
+        delta = (text[len(emitted):] if text.startswith(emitted)
+                 else text)
+        self._read = len(self._ids)
+        self._prefix = max(0, self._read - 1)
+        return delta
+
+
+def load_tokenizer(path: Optional[str]) -> Tokenizer:
+    """Best tokenizer for a checkpoint dir (or explicit file path).
+
+    Preference: tokenizer.json (exact, via `tokenizers`) >
+    SentencePiece .model (pure-Python) > byte-level fallback.
+    """
+    if path is None:
+        return ByteTokenizer()
+    if os.path.isfile(path):
+        if path.endswith('.model'):
+            return SentencePieceTokenizer(path)
+        # Specials (bos/eos) live in the sibling tokenizer_config.json;
+        # without them generation would never stop at EOS.
+        return HFTokenizer(path, os.path.join(os.path.dirname(path),
+                                              'tokenizer_config.json'))
+    tj = os.path.join(path, 'tokenizer.json')
+    if os.path.exists(tj):
+        try:
+            return HFTokenizer(
+                tj, os.path.join(path, 'tokenizer_config.json'))
+        except ImportError:
+            logger.warning('tokenizer.json present but the tokenizers '
+                           'library is unavailable; trying others.')
+    sp = os.path.join(path, 'tokenizer.model')
+    if os.path.exists(sp):
+        return SentencePieceTokenizer(sp)
+    logger.warning(f'No tokenizer files under {path}; using the '
+                   'byte-level fallback.')
+    return ByteTokenizer()

@@ -88,6 +88,42 @@ def create_train_state(cfg: ModelConfig,
     return state, shardings
 
 
+def load_pretrained_params(state: TrainState, directory: str) -> TrainState:
+    """Start a finetune from a CONVERTED checkpoint (import_weights) or
+    any params-bearing checkpoint: restores the params subtree and
+    places each leaf on the existing state's sharding/dtype (optimizer
+    moments stay fresh — this is init, not resume).
+
+    Leaf order pairs the restored plain tree with the state's boxed
+    params (boxing preserves traversal order, same invariant
+    checkpoints.restore_params relies on); every leaf is shape-checked.
+    Peak memory note: the random-init params exist until replaced —
+    for the largest models prefer a tensor/fsdp mesh so both trees are
+    sharded.
+    """
+    from skypilot_tpu.data import checkpoints  # pylint: disable=import-outside-toplevel
+    plain = checkpoints.restore_params(directory)
+    if plain is None:
+        raise FileNotFoundError(f'No checkpoint under {directory}')
+    old_leaves, treedef = jax.tree_util.tree_flatten(state.params)
+    new_leaves = jax.tree_util.tree_leaves(plain)
+    if len(old_leaves) != len(new_leaves):
+        raise ValueError(
+            f'Checkpoint has {len(new_leaves)} arrays; model expects '
+            f'{len(old_leaves)} — wrong model_config for this state?')
+    placed = []
+    for old, new in zip(old_leaves, new_leaves):
+        if tuple(old.shape) != tuple(new.shape):
+            raise ValueError(f'Shape mismatch: checkpoint {new.shape} '
+                             f'vs model {old.shape}')
+        arr = jnp.asarray(new, old.dtype)
+        sharding = getattr(old, 'sharding', None)
+        placed.append(jax.device_put(arr, sharding)
+                      if sharding is not None else arr)
+    return state.replace(
+        params=jax.tree_util.tree_unflatten(treedef, placed))
+
+
 def train_step(state: TrainState, batch):
     """One optimizer step. batch = {'tokens': [b,s+1] int32} or
     {'inputs','targets'}.  Call under jit (see jit_train_step) —

@@ -220,7 +220,12 @@ async def _relay_until_eof(reader: asyncio.StreamReader,
         if not chunk:
             return
         writer.write(chunk)
-        await writer.drain()  # backpressure: never buffer a token stream
+        # Backpressure (never buffer a token stream) but idle-bounded:
+        # a client that stops READING (zero receive window) must not
+        # pin the replica connection + in-flight count any more than a
+        # replica that stops writing.
+        await asyncio.wait_for(writer.drain(),
+                               timeout=_UPSTREAM_IDLE_TIMEOUT)
 
 
 class _UpstreamError(Exception):
@@ -379,7 +384,8 @@ class SkyServeLoadBalancer:
             # no response re-framing is needed and first bytes reach the
             # client as soon as the replica emits them.
             cwriter.write(first)
-            await cwriter.drain()
+            await asyncio.wait_for(cwriter.drain(),
+                                   timeout=_UPSTREAM_IDLE_TIMEOUT)
             await _relay_until_eof(ureader, cwriter)
         finally:
             try:

@@ -17,10 +17,19 @@ Endpoints:
                             data: [DONE]  (continuous batching only)
   POST /generate_text    -> {"prompt": "...", "max_new_tokens": N}
                             => {"completion": "...", ...} via the
-                            byte-level tokenizer (vocab_size >= 256)
+                            checkpoint's real tokenizer
+                            (models/tokenizer.py) or the byte-level
+                            fallback; {"stream": true} upgrades the
+                            response to SSE data: {"text": "<delta>"}
+                            events with UTF-8-safe incremental decode
+                            (continuous batching only).
 
-Token-id in/out keeps the server dependency-free (tokenization happens
-client-side or via examples/prepare_data.py's conventions).
+Real checkpoints: point --checkpoint-dir at a converted HF checkpoint
+(models/import_weights.py) — --model auto reads its model_config.json
+and the tokenizer files sitting next to it, so one directory serves
+Llama/Gemma/Qwen/Mixtral releases end to end.  Without tokenizer
+files the byte-level convention (UTF-8 bytes are ids, NUL is EOS)
+keeps the server dependency-free.
 """
 from __future__ import annotations
 
@@ -42,7 +51,8 @@ class ModelServer:
                  max_len: int = 512, max_batch: int = 8,
                  seed: int = 0, quantize: Optional[str] = None,
                  continuous_batching: bool = False,
-                 tensor: int = 1) -> None:
+                 tensor: int = 1,
+                 tokenizer_path: Optional[str] = None) -> None:
         import jax
         import flax.linen as nn
 
@@ -59,7 +69,26 @@ class ModelServer:
                 'quantize + tensor sharding is not supported yet '
                 '(quantized leaves change the param pytree the '
                 'shardings were computed for).')
-        self.cfg = configs.get_config(model)
+        if model == 'auto':
+            # Converted checkpoints carry their own ModelConfig
+            # (import_weights writes model_config.json next to the
+            # orbax step) — no preset needed for real releases.
+            from skypilot_tpu.models import import_weights
+            cfg = (import_weights.load_model_config(checkpoint_dir)
+                   if checkpoint_dir else None)
+            if cfg is None:
+                raise ValueError(
+                    "--model auto needs --checkpoint-dir pointing at a "
+                    "converted checkpoint (with model_config.json); "
+                    "see python -m skypilot_tpu.models.import_weights.")
+            self.cfg = cfg
+        else:
+            self.cfg = configs.get_config(model)
+        # Real tokenizer when the checkpoint ships one (converted
+        # checkpoints do); byte-level fallback otherwise.
+        from skypilot_tpu.models import tokenizer as tokenizer_lib
+        self.tokenizer = tokenizer_lib.load_tokenizer(
+            tokenizer_path or checkpoint_dir)
         self.max_len = max_len
         self.max_batch = max_batch
         model_mod = Transformer(self.cfg)
@@ -227,37 +256,41 @@ def _make_handler(server: ModelServer):
             self._reply(code, payload)
 
         def _generate_text(self):
-            """Text in, text out via the byte-level tokenizer (the
-            dependency-free convention of examples/prepare_data.py:
-            UTF-8 bytes are the ids, NUL is EOS).  Needs
-            vocab_size >= 256; checkpoints trained on byte data."""
+            """Text in, text out through the checkpoint's tokenizer
+            (models/tokenizer.py: real tokenizer.json / .model when
+            present, byte-level fallback otherwise).  With
+            {"stream": true} the response is SSE {"text": delta}
+            events, decoded incrementally UTF-8-safe."""
             try:
-                if server.cfg.vocab_size < 256:
+                tok = server.tokenizer
+                if server.cfg.vocab_size < tok.vocab_size:
                     raise ValueError(
-                        'byte-level text serving needs vocab_size '
-                        f'>= 256 (model has {server.cfg.vocab_size})')
+                        f'model vocab {server.cfg.vocab_size} < '
+                        f'tokenizer vocab {tok.vocab_size}: checkpoint '
+                        'and tokenizer do not match')
                 req = self._read_json()
                 text = req['prompt']
                 if not isinstance(text, str) or not text:
                     raise ValueError('prompt must be a non-empty string')
-                ids = list(text.encode('utf-8'))
+                ids = tok.encode(text, add_bos=True)
+                if not ids:
+                    raise ValueError('prompt tokenized to nothing')
+                if req.get('stream'):
+                    self._stream_text(tok, ids, req)
+                    return
                 t0 = time.perf_counter()
-                # NUL is EOS in byte mode: under continuous batching the
-                # engine stops AT it (freeing the slot); the lock-step
-                # scan is fixed-length, so truncation below still
-                # applies either way.
+                # The engine stops AT the tokenizer's EOS (freeing the
+                # slot); the lock-step scan is fixed-length, so the
+                # truncation below applies either way.
                 tokens = server.generate(
                     [ids], int(req.get('max_new_tokens', 64)),
                     float(req.get('temperature', 0.0)),
                     int(req.get('top_k', 0)),
-                    stop_token=0)[0]
-                if 0 in tokens:  # NUL = EOS in byte mode
-                    tokens = tokens[:tokens.index(0)]
-                completion = bytes(
-                    t for t in tokens if 0 < t < 256).decode(
-                        'utf-8', errors='replace')
+                    stop_token=tok.eos_id)[0]
+                if tok.eos_id in tokens:
+                    tokens = tokens[:tokens.index(tok.eos_id)]
                 self._reply(200, {
-                    'completion': completion,
+                    'completion': tok.decode(tokens),
                     'tokens': tokens,
                     'latency_ms': round(
                         (time.perf_counter() - t0) * 1e3, 1),
@@ -267,6 +300,43 @@ def _make_handler(server: ModelServer):
                 self._reply(400, {'error': str(e)})
             except Exception as e:  # pylint: disable=broad-except
                 self._reply(500, {'error': f'{type(e).__name__}: {e}'})
+
+        def _stream_text(self, tok, ids, req):
+            """SSE text deltas: data: {"text": "..."} per decode step
+            (skipping steps buffered inside a multi-byte sequence),
+            then data: [DONE].  Needs --continuous-batching."""
+            from skypilot_tpu.models.tokenizer import StreamDecoder
+            if server._engine is None:  # pylint: disable=protected-access
+                self._reply(400, {'error': 'streaming requires '
+                                           '--continuous-batching'})
+                return
+            request = server._engine.submit(  # pylint: disable=protected-access
+                ids, int(req.get('max_new_tokens', 64)),
+                stop_token=tok.eos_id)
+            self._start_sse()
+            decoder = StreamDecoder(tok)
+            try:
+                for token in request.stream(timeout=600):
+                    if token == tok.eos_id:
+                        break
+                    delta = decoder.push(token)
+                    if delta:
+                        self._sse_chunk(json.dumps({'text': delta}))
+                tail = decoder.finish()
+                if tail:
+                    self._sse_chunk(json.dumps({'text': tail}))
+                self._sse_chunk('[DONE]')
+                self.wfile.write(b'0\r\n\r\n')
+            except (BrokenPipeError, ConnectionResetError):
+                request.cancel()
+            except Exception as e:  # pylint: disable=broad-except
+                request.cancel()
+                try:
+                    self._sse_chunk(json.dumps(
+                        {'error': f'{type(e).__name__}: {e}'}))
+                    self.wfile.write(b'0\r\n\r\n')
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
 
         def _generate_stream(self):
             """SSE token stream: `data: {"token": N}` per token, then
@@ -300,22 +370,11 @@ def _make_handler(server: ModelServer):
                 # connection.
                 self._reply(503, {'error': f'{type(e).__name__}: {e}'})
                 return
-            self.send_response(200)
-            self.send_header('Content-Type', 'text/event-stream')
-            self.send_header('Cache-Control', 'no-cache')
-            self.send_header('Transfer-Encoding', 'chunked')
-            self.end_headers()
-
-            def chunk(data: str) -> None:
-                payload = f'data: {data}\n\n'.encode()
-                self.wfile.write(f'{len(payload):x}\r\n'.encode() +
-                                 payload + b'\r\n')
-                self.wfile.flush()
-
+            self._start_sse()
             try:
                 for token in request.stream(timeout=600):
-                    chunk(json.dumps({'token': token}))
-                chunk('[DONE]')
+                    self._sse_chunk(json.dumps({'token': token}))
+                self._sse_chunk('[DONE]')
                 self.wfile.write(b'0\r\n\r\n')
             except (BrokenPipeError, ConnectionResetError):
                 # Client went away: free the slot instead of decoding
@@ -327,11 +386,24 @@ def _make_handler(server: ModelServer):
                 # reading this request anymore.
                 request.cancel()
                 try:
-                    chunk(json.dumps(
+                    self._sse_chunk(json.dumps(
                         {'error': f'{type(e).__name__}: {e}'}))
                     self.wfile.write(b'0\r\n\r\n')
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass
+
+        def _start_sse(self) -> None:
+            self.send_response(200)
+            self.send_header('Content-Type', 'text/event-stream')
+            self.send_header('Cache-Control', 'no-cache')
+            self.send_header('Transfer-Encoding', 'chunked')
+            self.end_headers()
+
+        def _sse_chunk(self, data: str) -> None:
+            payload = f'data: {data}\n\n'.encode()
+            self.wfile.write(f'{len(payload):x}\r\n'.encode() +
+                             payload + b'\r\n')
+            self.wfile.flush()
 
         def do_POST(self):
             if self.path == '/generate_stream':
@@ -391,11 +463,18 @@ def start_background(server: ModelServer, port: int = 0):
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument('--model', default='tiny')
+    parser.add_argument('--model', default='tiny',
+                        help="Preset name, or 'auto' to read "
+                             'model_config.json from --checkpoint-dir '
+                             '(converted real checkpoints).')
     parser.add_argument('--port', type=int, default=8080)
     parser.add_argument('--max-len', type=int, default=512)
     parser.add_argument('--max-batch', type=int, default=8)
     parser.add_argument('--checkpoint-dir', default=None)
+    parser.add_argument('--tokenizer', default=None,
+                        help='Tokenizer file/dir (default: tokenizer '
+                             'files next to --checkpoint-dir, else the '
+                             'byte-level fallback).')
     parser.add_argument('--quantize', default=None, choices=['int8'],
                         help='Weight-only quantization: ~2x less HBM '
                              'traffic per decoded token vs bf16.')
@@ -412,7 +491,8 @@ def main() -> None:
                          max_len=args.max_len, max_batch=args.max_batch,
                          quantize=args.quantize,
                          continuous_batching=args.continuous_batching,
-                         tensor=args.tensor)
+                         tensor=args.tensor,
+                         tokenizer_path=args.tokenizer)
     serve_forever(server, args.port)
 
 

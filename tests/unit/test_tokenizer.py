@@ -1,0 +1,155 @@
+"""Tokenizer backends + streaming decode.
+
+HFTokenizer is pinned against the `tokenizers` library directly (build
+a real BPE tokenizer.json in-test).  SentencePieceTokenizer is pinned
+against a hand-serialized ModelProto (the pure-Python parser reads the
+same wire format sentencepiece writes).  StreamDecoder is checked for
+UTF-8 split safety.
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import pytest
+
+from skypilot_tpu.models import tokenizer as tok_lib
+
+
+# ------------------------------------------------------------------ HF BPE
+
+
+def _build_bpe_json(tmp_path):
+    """A tiny real byte-level BPE tokenizer via the tokenizers lib."""
+    tokenizers = pytest.importorskip('tokenizers')
+    from tokenizers import models, pre_tokenizers, decoders, trainers
+    tk = tokenizers.Tokenizer(models.BPE(unk_token=None))
+    tk.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tk.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=400, special_tokens=['<|begin|>', '<|end|>'],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
+    tk.train_from_iterator(
+        ['the quick brown fox jumps over the lazy dog',
+         'hello world, hello tpu serving'] * 50, trainer)
+    path = tmp_path / 'tokenizer.json'
+    tk.save(str(path))
+    (tmp_path / 'tokenizer_config.json').write_text(json.dumps({
+        'bos_token': '<|begin|>',
+        'eos_token': {'content': '<|end|>'},
+    }))
+    return tmp_path
+
+
+def test_hf_tokenizer_round_trip(tmp_path):
+    d = _build_bpe_json(tmp_path)
+    tok = tok_lib.load_tokenizer(str(d))
+    assert isinstance(tok, tok_lib.HFTokenizer)
+    text = 'hello world, the quick fox'
+    ids = tok.encode(text)
+    assert ids and all(isinstance(i, int) for i in ids)
+    assert tok.decode(ids) == text
+    assert tok.eos_id is not None and tok.bos_id is not None
+    assert tok.encode(text, add_bos=True)[0] == tok.bos_id
+    assert tok.vocab_size > 250
+
+
+def test_stream_decoder_utf8_safe(tmp_path):
+    """Multi-byte chars split across byte-level BPE tokens must never
+    emit partial UTF-8 (no replacement chars mid-stream)."""
+    d = _build_bpe_json(tmp_path)
+    tok = tok_lib.load_tokenizer(str(d))
+    text = 'héllo wörld ünïcode 東京 🚀 done'
+    ids = tok.encode(text)
+    dec = tok_lib.StreamDecoder(tok)
+    out = []
+    for i in ids:
+        delta = dec.push(i)
+        assert '�' not in delta
+        out.append(delta)
+    out.append(dec.finish())
+    assert ''.join(out) == text
+
+
+def test_byte_tokenizer_round_trip():
+    tok = tok_lib.ByteTokenizer()
+    assert tok.decode(tok.encode('hi 東京')) == 'hi 東京'
+    assert tok.eos_id == 0
+    dec = tok_lib.StreamDecoder(tok)
+    deltas = [dec.push(t) for t in tok.encode('a東b')]
+    assert '�' not in ''.join(deltas)
+    assert ''.join(deltas) + dec.finish() == 'a東b'
+
+
+# ------------------------------------------------------- SentencePiece
+
+def _varint(n: int) -> bytes:
+    out = b''
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _sp_piece(text: str, score: float, ptype: int = 1) -> bytes:
+    body = (bytes([0x0A]) + _varint(len(text.encode())) + text.encode() +
+            bytes([0x15]) + struct.pack('<f', score))
+    if ptype != 1:
+        body += bytes([0x18]) + _varint(ptype)
+    return bytes([0x0A]) + _varint(len(body)) + body
+
+
+def _build_sp_model(tmp_path, model_type: int = 1):
+    """Serialize a ModelProto by hand: <unk>, <s>, </s>, some word
+    pieces, and the 256 byte-fallback pieces."""
+    pieces = [_sp_piece('<unk>', 0.0, 2), _sp_piece('<s>', 0.0, 3),
+              _sp_piece('</s>', 0.0, 3)]
+    vocab = ['▁hello', '▁world', '▁the', '▁quick', 'ing', '▁fox',
+             'hel', 'lo', '▁', 'h', 'e', 'l', 'o', 'w', 'r', 'd',
+             't', 'q', 'u', 'i', 'c', 'k', 'n', 'g', 'f', 'x']
+    for rank, piece in enumerate(vocab):
+        # Longer pieces score better, like a trained unigram model.
+        pieces.append(_sp_piece(piece, -float(rank) / 4.0 - 1.0))
+    for b in range(256):
+        pieces.append(_sp_piece(f'<0x{b:02X}>', -100.0, 6))
+    trainer = bytes([0x18]) + _varint(model_type)  # field 3 varint
+    blob = (b''.join(pieces) +
+            bytes([0x12]) + _varint(len(trainer)) + trainer)
+    path = tmp_path / 'tokenizer.model'
+    path.write_bytes(blob)
+    return str(tmp_path)
+
+
+def test_sentencepiece_parse_and_round_trip(tmp_path):
+    d = _build_sp_model(tmp_path)
+    tok = tok_lib.load_tokenizer(d)
+    assert isinstance(tok, tok_lib.SentencePieceTokenizer)
+    assert tok.bos_id == 1 and tok.eos_id == 2
+    ids = tok.encode('hello world')
+    # Viterbi must pick the big pieces, not char soup.
+    assert ids == [tok._id_of['▁hello'], tok._id_of['▁world']]
+    assert tok.decode(ids) == 'hello world'
+    assert tok.encode('hello', add_bos=True)[0] == 1
+
+
+def test_sentencepiece_byte_fallback(tmp_path):
+    d = _build_sp_model(tmp_path)
+    tok = tok_lib.load_tokenizer(d)
+    # 東 is not in the vocab: must byte-fallback, and decode restores it.
+    ids = tok.encode('hello 東')
+    assert tok.decode(ids) == 'hello 東'
+    byte_ids = [i for i in ids
+                if tok._pieces[i][2] == tok_lib._SP_BYTE]
+    assert len(byte_ids) == 3  # 東 is 3 UTF-8 bytes
+
+
+def test_load_tokenizer_fallbacks(tmp_path):
+    assert isinstance(tok_lib.load_tokenizer(None),
+                      tok_lib.ByteTokenizer)
+    empty = tmp_path / 'empty'
+    empty.mkdir()
+    assert isinstance(tok_lib.load_tokenizer(str(empty)),
+                      tok_lib.ByteTokenizer)
