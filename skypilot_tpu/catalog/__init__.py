@@ -23,6 +23,7 @@ TpuOffering = common.TpuOffering
 
 _INSTANCE_CSVS = {
     'aws': 'aws_instances.csv',
+    'azure': 'azure_instances.csv',
     'gcp': 'gcp_instances.csv',
     'local': 'local_instances.csv',
 }

@@ -1,0 +1,163 @@
+"""Azure: GPU and CPU VMs — the third fungible accelerator pool.
+
+Parity: /root/reference/sky/clouds/azure.py:1-689 (region enumeration,
+pricing, deploy vars, credential checks via `az account show`) — minus
+what doesn't apply to the TPU-first design: no TPUs live here, so every
+accelerator request maps to a hosting VM size from the catalog, and the
+optimizer weighs those against GCP TPU slices (and AWS GPUs) with
+measured-MFU throughput priors (utils/throughput_registry).
+
+Azure has no availability-zone placement in this flow (the reference
+provisions region-level too, sky/clouds/azure.py:378-380): catalog rows
+carry an empty zone and the provisioner ignores zones.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+class Azure(cloud_lib.Cloud):
+    _REPR = 'Azure'
+    PROVISIONER = 'azure'
+
+    _CLOUD_UNSUPPORTED_FEATURES = {
+        cloud_lib.CloudImplementationFeatures.CLONE_DISK:
+            'Disk cloning is not implemented for Azure.',
+    }
+
+    # ------------------------------------------------------- regions/zones
+
+    def regions_with_offering(self, resources) -> List[cloud_lib.Region]:
+        if resources.tpu_spec is not None:
+            return []  # TPUs are GCP-only.
+        if resources.instance_type is not None:
+            pairs = catalog.get_region_zones_for_instance_type(
+                'azure', resources.instance_type, resources.use_spot)
+        else:
+            pairs = []
+        regions: Dict[str, cloud_lib.Region] = {}
+        for region_name, _ in pairs:  # zone column is empty on Azure
+            if (resources.region is not None and
+                    region_name != resources.region):
+                continue
+            regions.setdefault(region_name, cloud_lib.Region(region_name))
+        return list(regions.values())
+
+    # ------------------------------------------------------------- pricing
+
+    def instance_type_to_hourly_cost(self, instance_type, use_spot, region,
+                                     zone) -> float:
+        return catalog.get_hourly_cost('azure', instance_type, use_spot,
+                                       region, zone)
+
+    def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
+                                    zone) -> float:
+        # GPU prices are bundled into the hosting VM size's price.
+        del accelerators, use_spot, region, zone
+        return 0.0
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # Azure internet egress: first 100 GB/month free, then a flat
+        # tier (reference sky/clouds/azure.py:120-139 shape).
+        if num_gigabytes <= 100:
+            return 0.0
+        return (num_gigabytes - 100) * 0.0875
+
+    # -------------------------------------------------------- feasibility
+
+    def get_feasible_launchable_resources(self, resources):
+        fuzzy: List[str] = []
+        if resources.tpu_spec is not None:
+            return [], fuzzy  # TPUs do not exist on Azure.
+        if resources.accelerators:
+            acc, count = next(iter(resources.accelerators.items()))
+            instance_types = catalog.get_instance_type_for_accelerator(
+                'azure', acc, count, resources.cpus, resources.memory,
+                resources.region, resources.zone)
+            if not instance_types:
+                offerings = catalog.list_accelerators(name_filter=acc,
+                                                      clouds=['azure'])
+                fuzzy.extend(sorted(offerings))
+                return [], fuzzy
+            return [
+                resources.copy(cloud=self, instance_type=instance_types[0])
+            ], fuzzy
+        if resources.instance_type is not None:
+            if catalog.instance_type_exists('azure',
+                                            resources.instance_type):
+                return [resources.copy(cloud=self)], fuzzy
+            return [], fuzzy
+        default = self.get_default_instance_type(resources.cpus,
+                                                 resources.memory)
+        if default is None:
+            return [], fuzzy
+        return [resources.copy(cloud=self, instance_type=default)], fuzzy
+
+    def get_default_instance_type(self, cpus, memory) -> Optional[str]:
+        return catalog.get_default_instance_type('azure', cpus, memory)
+
+    def validate_region_zone(self, region, zone):
+        if zone is not None:
+            raise ValueError(
+                'Azure does not take zone placement here (region only); '
+                f'got zone={zone!r}.')
+        return catalog.validate_region_zone('azure', region, None)
+
+    # ------------------------------------------------------------- deploy
+
+    def make_deploy_resources_variables(self, resources, cluster_name,
+                                        region, zones) -> Dict[str, Any]:
+        del zones  # region-level provisioning
+        return {
+            'cluster_name': cluster_name,
+            'region': region.name,
+            'zones': [],
+            'use_spot': resources.use_spot,
+            'labels': dict(resources.labels or {}),
+            'ports': list(resources.ports or []),
+            'disk_size': resources.disk_size,
+            'image_id': resources.image_id,
+            'tpu': False,
+            'instance_type': resources.instance_type,
+            'num_nodes': 1,
+        }
+
+    # --------------------------------------------------------- credentials
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        try:
+            proc = subprocess.run(['az', 'account', 'show'],
+                                  capture_output=True, text=True,
+                                  timeout=15, check=False)
+            if proc.returncode == 0:
+                return True, None
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            pass
+        return False, ('Azure credentials not found. Run `az login` '
+                       '(and `az account set -s <subscription>`).')
+
+    def get_current_user_identity(self) -> Optional[List[str]]:
+        try:
+            proc = subprocess.run(
+                ['az', 'account', 'show',
+                 '--query', '[user.name,id]', '--output', 'tsv'],
+                capture_output=True, text=True, timeout=15, check=False)
+            lines = proc.stdout.split()
+            return lines or None if proc.returncode == 0 else None
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            return None
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        azure_dir = os.path.expanduser('~/.azure')
+        if os.path.isdir(azure_dir):
+            return {'~/.azure': '~/.azure'}
+        return {}

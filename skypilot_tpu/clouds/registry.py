@@ -7,6 +7,7 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 from skypilot_tpu.clouds import aws
+from skypilot_tpu.clouds import azure
 from skypilot_tpu.clouds import cloud as cloud_lib
 from skypilot_tpu.clouds import docker
 from skypilot_tpu.clouds import gcp
@@ -16,6 +17,7 @@ from skypilot_tpu.clouds import local
 
 CLOUD_REGISTRY: Dict[str, cloud_lib.Cloud] = {
     'aws': aws.AWS(),
+    'azure': azure.Azure(),
     'docker': docker.Docker(),
     'gcp': gcp.GCP(),
     'gke': gke.GKE(),

@@ -1,0 +1,1 @@
+"""Azure VM provisioner (az CLI JSON with an injectable runner)."""
