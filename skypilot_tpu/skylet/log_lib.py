@@ -141,11 +141,18 @@ def make_task_bash_script(codegen: str,
             """),
     ]
     if pidfile:
+        # Handshake with make_kill_tree_command: we WRITE the pidfile
+        # then READ the abort tombstone; the killer WRITES the tombstone
+        # then READS the pidfile. Whatever the interleaving, at least
+        # one side observes the other — an abort can never slip through
+        # just because this prologue was slow to reach the echo line.
         script.append(f'mkdir -p "$(dirname {pidfile})" && '
                       f'echo $$ > {pidfile} && '
                       # Self-clean on normal exit so a later kill sweep
                       # cannot TERM a reused PID.
-                      f"trap 'rm -f {pidfile}' EXIT")
+                      f"trap 'rm -f {pidfile}' EXIT; "
+                      f'if [ -e {pidfile}.abort ]; then '
+                      f'rm -f {pidfile} {pidfile}.abort; exit 143; fi')
     if env_vars:
         for k, v in env_vars.items():
             script.append(f'export {k}={subprocess_quote(v)}')
@@ -154,15 +161,56 @@ def make_task_bash_script(codegen: str,
 
 
 def make_kill_tree_command(pidfile: str) -> str:
-    """Shell one-liner that TERM-kills the process tree rooted at the
-    PID in `pidfile` (deepest-first so re-parenting cannot orphan
-    grandchildren mid-walk), then removes the pidfile."""
-    return (f'pid=$(cat {pidfile} 2>/dev/null); '
-            'if [ -n "$pid" ]; then '
-            'kill_tree() { local c; '
-            'for c in $(pgrep -P "$1" 2>/dev/null); do kill_tree "$c"; '
-            'done; kill -TERM "$1" 2>/dev/null; }; '
-            f'kill_tree "$pid"; rm -f {pidfile}; fi')
+    """Shell one-liner that kills the process tree rooted at the PID in
+    `pidfile`, then removes the pidfile.
+
+    Kill order matters: TERMing a shell's *child* before the shell lets
+    bash resume from `wait` and execute the task script's next command
+    before its own TERM arrives (a gang-aborted `prepare && train &&
+    upload` could still run `upload`). So the walk first SIGSTOPs the
+    tree root-first — a stopped shell cannot resume, and a stopped
+    process cannot fork new children mid-sweep — then TERMs every
+    collected PID (pending while stopped), then CONTs them so the TERM
+    is processed before any user code runs again.
+
+    Slow-start race (the pidfile is not there yet because the task
+    script's prologue — login shell sourcing, cd — has not reached its
+    `echo $$` line): the killer first drops a `.abort` tombstone, then
+    reads the pidfile once. The task prologue writes the pidfile and
+    THEN checks the tombstone (make_task_bash_script) — each side
+    writes before it reads, so whichever timing wins, either the killer
+    sees the pidfile or the task sees the tombstone and exits 143
+    before running any user command. No polling needed: a task whose
+    pidfile is absent has not run user code and will stop itself. The
+    tombstone is consumed by whichever side reads it (killed-task
+    sweep removes it; a self-aborting prologue removes it); for ranks
+    that already exited cleanly it lingers in the uniquely-tagged gang
+    dir — bounded litter, never matching a future pidfile path.
+
+    The sequence runs under `setsid` (falling back to `nohup ... &`):
+    if the transport drops mid-sweep — sshd HUPs the session's process
+    group on disconnect — an in-flight killer interrupted between STOP
+    and TERM/CONT would otherwise strand the task tree frozen forever.
+    Detached from the session/group, the killer finishes regardless.
+    """
+    seq = (f'mkdir -p "$(dirname {pidfile})"; touch {pidfile}.abort; '
+           f'pid=$(cat {pidfile} 2>/dev/null); '
+           'if [ -n "$pid" ]; then '
+           'stop_tree() { local c; kill -STOP "$1" 2>/dev/null; '
+           'pids="$pids $1"; '
+           'for c in $(pgrep -P "$1" 2>/dev/null); do stop_tree "$c"; '
+           'done; }; '
+           f'pids=""; stop_tree "$pid"; '
+           'kill -TERM $pids 2>/dev/null; '
+           'kill -CONT $pids 2>/dev/null; '
+           f'rm -f {pidfile} {pidfile}.abort; fi')
+    quoted = subprocess_quote(seq)
+    # setsid detaches session+group; where absent (minimal containers),
+    # nohup+background at least survives the HUP a dropped ssh session
+    # delivers. Callers treat the kill as best-effort either way.
+    return (f'if command -v setsid >/dev/null 2>&1; '
+            f'then setsid bash -c {quoted}; '
+            f'else nohup bash -c {quoted} >/dev/null 2>&1 & fi')
 
 
 def subprocess_quote(s: str) -> str:

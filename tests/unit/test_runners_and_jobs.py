@@ -181,3 +181,30 @@ class TestGangFailFast:
         for rank in (0, 1, 3):
             log = tmp_path / 'logs' / 'tasks' / f'rank-{rank}.log'
             assert 'SURVIVED' not in log.read_text()
+
+    def test_abort_tombstone_beats_slow_start(self, tmp_path):
+        """An abort that fires BEFORE the task script starts must still
+        stop it: the killer leaves a tombstone; the script's prologue
+        (pidfile write, then tombstone check) exits 143 without running
+        any user command — regardless of how slow the prologue was."""
+        r = _mk_runner(tmp_path)
+        pidfile = '~/.skytpu/gang/tgang-rank0.pid'
+        # Abort first: no pidfile yet, so the killer only drops the
+        # tombstone (instant no-op kill).
+        start = time.time()
+        rc = r.run(log_lib.make_kill_tree_command(pidfile),
+                   stream_logs=False)
+        assert rc == 0
+        assert time.time() - start < 10
+        # Task starts late: prologue must see the tombstone and bail.
+        script = log_lib.make_task_bash_script('echo SURVIVED',
+                                              pidfile=pidfile)
+        rc, out, _ = r.run(script, require_outputs=True, stream_logs=False)
+        assert rc == 143
+        assert 'SURVIVED' not in out
+        # Both handshake files are gone; a FRESH gang tag is unaffected.
+        rc, out, _ = r.run(
+            log_lib.make_task_bash_script(
+                'echo RAN', pidfile='~/.skytpu/gang/tgang2-rank0.pid'),
+            require_outputs=True, stream_logs=False)
+        assert rc == 0 and 'RAN' in out
