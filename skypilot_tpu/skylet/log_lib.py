@@ -146,6 +146,11 @@ def make_task_bash_script(codegen: str,
         # then READS the pidfile. Whatever the interleaving, at least
         # one side observes the other — an abort can never slip through
         # just because this prologue was slow to reach the echo line.
+        # GC: tombstones of ranks that never consumed them (clean exits
+        # swept by a gang abort) have no other deletion path; age them
+        # out here so ~/.skytpu/gang cannot creep over cluster life.
+        script.append(f'find "$(dirname {pidfile})" -name "*.abort" '
+                      '-mtime +7 -type f -delete 2>/dev/null || true')
         script.append(f'mkdir -p "$(dirname {pidfile})" && '
                       f'echo $$ > {pidfile} && '
                       # Self-clean on normal exit so a later kill sweep

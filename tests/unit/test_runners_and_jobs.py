@@ -202,7 +202,11 @@ class TestGangFailFast:
         rc, out, _ = r.run(script, require_outputs=True, stream_logs=False)
         assert rc == 143
         assert 'SURVIVED' not in out
-        # Both handshake files are gone; a FRESH gang tag is unaffected.
+        # Both handshake files were consumed by the aborting prologue.
+        gang_dir = tmp_path / 'host0' / '.skytpu' / 'gang'
+        assert not (gang_dir / 'tgang-rank0.pid').exists()
+        assert not (gang_dir / 'tgang-rank0.pid.abort').exists()
+        # A FRESH gang tag is unaffected.
         rc, out, _ = r.run(
             log_lib.make_task_bash_script(
                 'echo RAN', pidfile='~/.skytpu/gang/tgang2-rank0.pid'),
