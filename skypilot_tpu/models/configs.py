@@ -17,6 +17,17 @@ class ModelConfig:
     d_ff: int = 14336
     max_seq_len: int = 8192
     rope_theta: float = 500000.0
+    # RoPE frequency scaling for long-context checkpoints.  None = plain
+    # RoPE; 'linear' divides every frequency by rope_scaling_factor
+    # (position interpolation); 'llama3' is the Llama-3.1 scheme —
+    # low-frequency (long-wavelength) bands divide by the factor,
+    # high-frequency bands pass through, with a smooth ramp between the
+    # low/high cutoffs derived from the original pretrain context.
+    rope_scaling_type: Optional[str] = None
+    rope_scaling_factor: float = 1.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_len: int = 8192
     norm_eps: float = 1e-5
     dtype: jnp.dtype = jnp.bfloat16   # activations/compute
     param_dtype: jnp.dtype = jnp.float32

@@ -143,7 +143,7 @@ def _layer_forward(x, lp, cfg, positions, k_cache, v_cache, cache_len,
     h = _norm(x, lp['attn_norm']['scale'], cfg.norm_eps,
               cfg.norm_scale_plus_one)
     q = _attn_proj(h, lp['attn']['q_proj'])
-    q = _rope(q, positions, cfg.rope_theta)
+    q = _rope(q, positions, cfg)
 
     if use_flash:
         # Prefill from index 0: the valid cache region is exactly the
@@ -209,7 +209,7 @@ def _scan_layers_and_unembed(cfg, params, x, positions, cache_k, cache_v,
                   cfg.norm_scale_plus_one)
         k = _attn_proj(h, lp['attn']['k_proj'])
         v = _attn_proj(h, lp['attn']['v_proj'])
-        k = _rope(k, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg)
         k_cache = write_fn(k_cache, k)
         v_cache = write_fn(v_cache, v)
         x = _layer_forward(x, lp, cfg, positions, k_cache, v_cache,

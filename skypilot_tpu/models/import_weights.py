@@ -202,6 +202,47 @@ def config_from_hf(hf: Dict[str, Any]) -> Tuple[configs.ModelConfig, str]:
         param_dtype=jnp.float32,
         tie_embeddings=bool(hf.get('tie_word_embeddings', False)),
     )
+    # rope_scaling (Llama-3.1+, long-context Qwen2): silently importing
+    # with plain RoPE would contradict the module's exact-fidelity
+    # contract — map the supported schemes, reject the rest loudly.
+    rs = hf.get('rope_scaling') or None
+    if rs:
+        rtype = rs.get('rope_type') or rs.get('type')
+        if rtype in (None, 'default'):
+            pass
+        elif rtype == 'llama3':
+            common.update(
+                rope_scaling_type='llama3',
+                rope_scaling_factor=float(rs['factor']),
+                rope_low_freq_factor=float(rs.get('low_freq_factor', 1.0)),
+                rope_high_freq_factor=float(
+                    rs.get('high_freq_factor', 4.0)),
+                rope_original_max_len=int(
+                    rs.get('original_max_position_embeddings', 8192)),
+            )
+        elif rtype == 'linear':
+            common.update(rope_scaling_type='linear',
+                          rope_scaling_factor=float(rs['factor']))
+        else:
+            raise ValueError(
+                f'Unsupported rope_scaling type {rtype!r} (have '
+                "'llama3', 'linear'); importing with plain RoPE would "
+                'silently diverge from the source model.')
+    # Sliding-window attention is not implemented; only reject it when
+    # it would actually truncate attention inside the usable context
+    # (configs often carry an inert window >= max_position_embeddings).
+    window = hf.get('sliding_window')
+    window_active = (window is not None and
+                     int(window) < int(common['max_seq_len']))
+    if family == 'qwen2':
+        window_active = window_active and bool(
+            hf.get('use_sliding_window', False))
+    if window_active:
+        raise ValueError(
+            f'{family} checkpoint uses sliding-window attention '
+            f'(window={window} < context={common["max_seq_len"]}), '
+            'which this importer does not implement; importing would '
+            'silently change attention semantics.')
     if family == 'qwen2':
         common['qkv_bias'] = True
     elif family == 'gemma':

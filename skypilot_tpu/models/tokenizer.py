@@ -85,6 +85,25 @@ class HFTokenizer(Tokenizer):
                        if self.bos_token else None)
         self.eos_id = (self._tok.token_to_id(self.eos_token)
                        if self.eos_token else None)
+        if self.eos_id is None:
+            # No tokenizer_config.json (or no eos in it): without an
+            # EOS id generation never stops early, holding batching
+            # slots to max_new_tokens.  Fall back to the conventional
+            # EOS names in the vocab/added-tokens table — model-level
+            # EOS names first ('<|end_of_text|>' etc.), chat turn-end
+            # markers ('<|eot_id|>', '<|im_end|>') last: a base model
+            # never emits the latter.  This is a guess; the warning
+            # stays so operators know to ship tokenizer_config.json.
+            for cand in ('<|end_of_text|>', '<|endoftext|>', '</s>',
+                         '<eos>', '<|end|>', '<|eot_id|>',
+                         '<|im_end|>'):
+                tid = self._tok.token_to_id(cand)
+                if tid is not None:
+                    self.eos_token, self.eos_id = cand, tid
+                    logger.warning(
+                        f'No eos_token in tokenizer_config; falling '
+                        f'back to {cand!r} (id {tid}) from the vocab.')
+                    break
 
     @property
     def vocab_size(self) -> int:
@@ -188,10 +207,15 @@ def _skip_field(data: bytes, pos: int, wire: int) -> int:
 
 
 class SentencePieceTokenizer(Tokenizer):
-    """Pure-Python SentencePiece: Viterbi segmentation over piece
-    scores (the unigram objective; also a faithful stand-in for
-    BPE-type models, whose merge order follows the same scores), with
-    <0xNN> byte fallback for uncovered characters."""
+    """Pure-Python SentencePiece with both segmentation algorithms:
+    Viterbi over piece scores for unigram models (model_type 1, the
+    exact unigram objective) and merge-rank BPE for BPE models
+    (model_type 2, e.g. Llama-2: repeatedly merge the adjacent pair
+    whose merged piece scores highest — scores encode merge order in
+    SP BPE models, so this reproduces the training merge sequence).
+    Both use <0xNN> byte fallback for uncovered characters.  Each is
+    pinned against the `tokenizers` library's independent Unigram/BPE
+    implementations in tests/unit/test_tokenizer.py."""
 
     def __init__(self, model_path: str) -> None:
         with open(model_path, 'rb') as f:
@@ -218,6 +242,73 @@ class SentencePieceTokenizer(Tokenizer):
     def encode(self, text: str, *, add_bos: bool = False) -> List[int]:
         # SP normalization subset: spaces -> ▁ with a dummy prefix.
         s = _SP_SPACE + text.replace(' ', _SP_SPACE)
+        if self._model_type == 2:
+            ids = self._encode_bpe(s)
+        else:
+            ids = self._encode_unigram(s)
+        if add_bos and self.bos_id is not None:
+            return [self.bos_id] + ids
+        return ids
+
+    def _encode_bpe(self, s: str) -> List[int]:
+        """Merge-rank BPE: repeatedly merge the adjacent symbol pair
+        whose merged piece has the highest score (ties: leftmost) —
+        the same order real SP BPE applies its learned merges.  Heap
+        over candidate pairs + linked symbol list (the sentencepiece
+        bpe_model scheme): O(n log n), not O(n^2) rescans — encode is
+        on the serving request path."""
+        import heapq  # pylint: disable=import-outside-toplevel
+        n = len(s)
+        if n == 0:
+            return []
+        sym = list(s)
+        nxt = list(range(1, n)) + [-1]
+        prv = [-1] + list(range(n - 1))
+        alive = [True] * n
+        heap: List[Tuple[float, int, str, str]] = []
+
+        def consider(i: int) -> None:
+            j = nxt[i]
+            if j < 0:
+                return
+            pid = self._id_of.get(sym[i] + sym[j])
+            if pid is not None:
+                # Max-score pops first; ties pop leftmost (smaller i).
+                heapq.heappush(
+                    heap, (-self._pieces[pid][1], i, sym[i], sym[j]))
+
+        for i in range(n - 1):
+            consider(i)
+        while heap:
+            _, i, a, b = heapq.heappop(heap)
+            # Lazy invalidation: stale entries name symbols that have
+            # since merged away.
+            if not alive[i] or sym[i] != a:
+                continue
+            j = nxt[i]
+            if j < 0 or sym[j] != b:
+                continue
+            sym[i] = a + b
+            alive[j] = False
+            nxt[i] = nxt[j]
+            if nxt[i] >= 0:
+                prv[nxt[i]] = i
+            consider(i)
+            if prv[i] >= 0:
+                consider(prv[i])
+        ids: List[int] = []
+        i = 0  # index 0 is always a merge survivor (never a right pair)
+        while i >= 0:
+            pid = self._id_of.get(sym[i])
+            if pid is not None:
+                ids.append(pid)
+            else:  # unmerged char not in vocab: byte-fallback
+                for b_ in sym[i].encode('utf-8'):
+                    ids.append(self._byte_ids.get(b_, self.unk_id))
+            i = nxt[i]
+        return ids
+
+    def _encode_unigram(self, s: str) -> List[int]:
         n = len(s)
         # Viterbi: best[i] = (score, backpointer, piece_id) for s[:i].
         neg_inf = float('-inf')
@@ -253,8 +344,6 @@ class SentencePieceTokenizer(Tokenizer):
             else:  # byte-fallback segment (single char)
                 for b in s[i:j].encode('utf-8'):
                     ids.append(self._byte_ids.get(b, self.unk_id))
-        if add_bos and self.bos_id is not None:
-            return [self.bos_id] + ids
         return ids
 
     def decode(self, ids: Sequence[int]) -> str:

@@ -23,12 +23,37 @@ from skypilot_tpu.ops import ring_attention
 from skypilot_tpu.ops import ulysses_attention
 
 
-def _rope(x, positions, theta: float):
+def _rope_freqs(d: int, cfg: ModelConfig):
+    """Per-pair rotary frequencies [d/2], with the config's long-context
+    scaling applied (HF rope_scaling parity; see ModelConfig)."""
+    freqs = 1.0 / (cfg.rope_theta **
+                   (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    st = cfg.rope_scaling_type
+    if st is None:
+        return freqs
+    factor = cfg.rope_scaling_factor
+    if st == 'linear':
+        return freqs / factor
+    if st == 'llama3':
+        orig = float(cfg.rope_original_max_len)
+        low_wl = orig / cfg.rope_low_freq_factor    # longest kept-ish
+        high_wl = orig / cfg.rope_high_freq_factor  # shortest scaled-ish
+        wavelen = 2.0 * jnp.pi / freqs
+        smooth = ((orig / wavelen - cfg.rope_low_freq_factor) /
+                  (cfg.rope_high_freq_factor - cfg.rope_low_freq_factor))
+        mid = (1.0 - smooth) * freqs / factor + smooth * freqs
+        return jnp.where(wavelen > low_wl, freqs / factor,
+                         jnp.where(wavelen < high_wl, freqs, mid))
+    raise ValueError(f'Unknown rope_scaling_type {st!r}; '
+                     "have None, 'linear', 'llama3'.")
+
+
+def _rope(x, positions, cfg: ModelConfig):
     """Rotary embeddings on [b, h, s, d]; positions [s] (shared) or
     [b, s] (per-sequence — continuous batching decodes slots at
     different depths in one step)."""
     d = x.shape[-1]
-    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = _rope_freqs(d, cfg)
     angles = positions[..., :, None].astype(jnp.float32) * freqs
     if angles.ndim == 2:
         cos = jnp.cos(angles)[None, None]   # [1,1,s,d/2]
@@ -105,8 +130,8 @@ class Attention(nn.Module):
         q = q.transpose(0, 2, 1, 3)
         k = k.transpose(0, 2, 1, 3)
         v = v.transpose(0, 2, 1, 3)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        q = _rope(q, positions, cfg)
+        k = _rope(k, positions, cfg)
 
         # GQA is native to the attention ops: the Pallas kernels map
         # q-head -> kv-head via their BlockSpec index maps, so repeated

@@ -89,6 +89,14 @@ class ModelServer:
         from skypilot_tpu.models import tokenizer as tokenizer_lib
         self.tokenizer = tokenizer_lib.load_tokenizer(
             tokenizer_path or checkpoint_dir)
+        if self.tokenizer.eos_id is None:
+            # stop_token=None means every request runs to
+            # max_new_tokens, holding batching slots; say so once at
+            # startup instead of silently degrading throughput.
+            logger.warning(
+                'Tokenizer has no EOS id (missing/incomplete '
+                'tokenizer_config.json?): generation cannot stop '
+                'early and will always run to max_new_tokens.')
         self.max_len = max_len
         self.max_batch = max_batch
         model_mod = Transformer(self.cfg)
