@@ -221,17 +221,25 @@ class SentencePieceTokenizer(Tokenizer):
         with open(model_path, 'rb') as f:
             pieces, self._model_type = _parse_sp_model(f.read())
         self._pieces = pieces
+        # Encodable vocab: NORMAL + USER_DEFINED only.  Real
+        # sentencepiece never matches CONTROL/UNKNOWN/BYTE pieces
+        # against input text — otherwise a prompt literally containing
+        # '</s>' would encode to eos_id (user-controlled EOS injection)
+        # instead of being spelled out from characters/bytes.
         self._id_of: Dict[str, int] = {}
+        all_ids: Dict[str, int] = {}
         self._byte_ids: Dict[int, int] = {}
         self.unk_id = 0
         for idx, (text, _, ptype) in enumerate(pieces):
-            self._id_of.setdefault(text, idx)
-            if ptype == _SP_UNKNOWN:
+            all_ids.setdefault(text, idx)
+            if ptype in (_SP_NORMAL, _SP_USER_DEFINED):
+                self._id_of.setdefault(text, idx)
+            elif ptype == _SP_UNKNOWN:
                 self.unk_id = idx
             elif ptype == _SP_BYTE:
                 self._byte_ids[int(text[1:-1], 16)] = idx
-        self.bos_id = self._id_of.get('<s>')
-        self.eos_id = self._id_of.get('</s>')
+        self.bos_id = all_ids.get('<s>')
+        self.eos_id = all_ids.get('</s>')
         self._max_piece_len = max((len(t) for t, _, _ in pieces),
                                   default=1)
 

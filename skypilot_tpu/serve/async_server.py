@@ -1,0 +1,373 @@
+"""Asyncio HTTP front end for the model server replica.
+
+Replaces the stdlib ThreadingHTTPServer front (serve/model_server.py)
+on the serving path: one event loop owns every socket — N concurrent
+SSE streams, health probes, and JSON requests never spawn a thread per
+connection in front of the GIL'd engine.  Token delivery rides the
+engine's watcher hook (batching_engine._Request.add_watcher →
+loop.call_soon_threadsafe → asyncio.Queue), so a streaming response
+wakes only when its request produces a token.  Blocking compute that
+cannot stream (lock-step decode.generate, engine result() for the
+non-stream endpoints) runs in the default executor, bounded by the
+engine's own slot count.
+
+Zero dependencies, same endpoint surface as the threaded front
+(GET /, POST /generate, /generate_stream, /generate_text); the
+hand-rolled HTTP follows serve/load_balancer.py's precedent.
+
+Parity: the reference ships no replica server (SkyPilot serves user
+containers); this is the framework-native replica of SURVEY.md's
+serve stack.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import model_server as model_server_lib
+
+logger = sky_logging.init_logger(__name__)
+
+_MAX_BODY = 64 * 1024 * 1024
+_IDLE_TIMEOUT = 300.0
+
+
+class _HttpError(Exception):
+
+    def __init__(self, code: int, message: str) -> None:
+        super().__init__(message)
+        self.code = code
+
+
+async def _read_request(reader: asyncio.StreamReader
+                        ) -> Optional[Tuple[str, str, Dict[str, str],
+                                            bytes]]:
+    """(method, path, headers, body) or None on clean EOF."""
+    try:
+        head = await asyncio.wait_for(reader.readuntil(b'\r\n\r\n'),
+                                      timeout=_IDLE_TIMEOUT)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    except asyncio.TimeoutError:
+        return None
+    lines = head.decode('latin-1').split('\r\n')
+    try:
+        method, path, _ = lines[0].split(' ', 2)
+    except ValueError as e:
+        raise _HttpError(400, f'bad request line: {lines[0]!r}') from e
+    headers: Dict[str, str] = {}
+    for line in lines[1:]:
+        if ':' in line:
+            k, v = line.split(':', 1)
+            headers[k.strip().lower()] = v.strip()
+    try:
+        length = int(headers.get('content-length', 0))
+    except ValueError as e:
+        raise _HttpError(400, 'bad Content-Length') from e
+    if length > _MAX_BODY:
+        raise _HttpError(413, 'request body too large')
+    body = await reader.readexactly(length) if length else b''
+    return method, path, headers, body
+
+
+def _json_response(code: int, payload: Dict[str, Any]) -> bytes:
+    body = json.dumps(payload).encode()
+    reason = {200: 'OK', 400: 'Bad Request', 404: 'Not Found',
+              413: 'Payload Too Large', 500: 'Internal Server Error',
+              503: 'Service Unavailable'}.get(code, 'Error')
+    return (f'HTTP/1.1 {code} {reason}\r\n'
+            f'Content-Type: application/json\r\n'
+            f'Content-Length: {len(body)}\r\n'
+            f'\r\n').encode() + body
+
+
+class AsyncModelServer:
+    """Serves a ModelServer's model/engine from one asyncio loop."""
+
+    def __init__(self, server: 'model_server_lib.ModelServer') -> None:
+        self.server = server
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+
+    # ------------------------------------------------------------ bridge
+
+    def _watch(self, request) -> 'asyncio.Queue':
+        """Bridge an engine request's tokens onto the event loop."""
+        assert self._loop is not None
+        q: 'asyncio.Queue' = asyncio.Queue()
+        loop = self._loop
+        request.add_watcher(
+            lambda token: loop.call_soon_threadsafe(q.put_nowait, token))
+        return q
+
+    # --------------------------------------------------------- endpoints
+
+    def _health(self) -> Tuple[int, Dict[str, Any]]:
+        server = self.server
+        payload: Dict[str, Any] = {
+            'status': 'ok',
+            'model': f'{server.cfg.d_model}x{server.cfg.n_layers}',
+        }
+        engine = server._engine  # pylint: disable=protected-access
+        code = 200
+        if engine is not None:
+            stats = engine.stats()
+            payload['engine'] = stats
+            if stats['failed']:
+                payload['status'] = 'engine_failed'
+                code = 503
+        return code, payload
+
+    async def _generate(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        tokens = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.server.generate(
+                req['prompt_ids'],
+                int(req.get('max_new_tokens', 16)),
+                float(req.get('temperature', 0.0)),
+                int(req.get('top_k', 0))))
+        return {'tokens': tokens,
+                'latency_ms': round((time.perf_counter() - t0) * 1e3, 1)}
+
+    async def _generate_text(self, req: Dict[str, Any],
+                             writer: asyncio.StreamWriter) -> None:
+        server = self.server
+        tok = server.tokenizer
+        if server.cfg.vocab_size < tok.vocab_size:
+            raise _HttpError(
+                400, f'model vocab {server.cfg.vocab_size} < tokenizer '
+                     f'vocab {tok.vocab_size}: checkpoint and tokenizer '
+                     'do not match')
+        text = req.get('prompt')
+        if not isinstance(text, str) or not text:
+            raise _HttpError(400, 'prompt must be a non-empty string')
+        ids = tok.encode(text, add_bos=True)
+        if not ids:
+            raise _HttpError(400, 'prompt tokenized to nothing')
+        if req.get('stream'):
+            await self._stream(writer, ids, req, text_mode=True)
+            return
+        t0 = time.perf_counter()
+        tokens = (await asyncio.get_running_loop().run_in_executor(
+            None, lambda: server.generate(
+                [ids], int(req.get('max_new_tokens', 64)),
+                float(req.get('temperature', 0.0)),
+                int(req.get('top_k', 0)), stop_token=tok.eos_id)))[0]
+        if tok.eos_id in tokens:
+            tokens = tokens[:tokens.index(tok.eos_id)]
+        writer.write(_json_response(200, {
+            'completion': tok.decode(tokens),
+            'tokens': tokens,
+            'latency_ms': round((time.perf_counter() - t0) * 1e3, 1),
+        }))
+        await writer.drain()
+
+    async def _stream(self, writer: asyncio.StreamWriter, ids, req,
+                      *, text_mode: bool) -> None:
+        """SSE over chunked transfer; token events or UTF-8-safe text
+        deltas.  Purely event-driven: no thread parks waiting."""
+        server = self.server
+        engine = server._engine  # pylint: disable=protected-access
+        if engine is None:
+            raise _HttpError(
+                400, 'streaming requires --continuous-batching')
+        tok = server.tokenizer
+        stop_token = (tok.eos_id if text_mode
+                      else req.get('stop_token'))
+        request = engine.submit(
+            [int(t) for t in ids],
+            int(req.get('max_new_tokens', 64 if text_mode else 16)),
+            stop_token=stop_token)
+        q = self._watch(request)
+        writer.write(b'HTTP/1.1 200 OK\r\n'
+                     b'Content-Type: text/event-stream\r\n'
+                     b'Cache-Control: no-cache\r\n'
+                     b'Transfer-Encoding: chunked\r\n\r\n')
+
+        def chunk(data: str) -> bytes:
+            payload = f'data: {data}\n\n'.encode()
+            return (f'{len(payload):x}\r\n'.encode() + payload + b'\r\n')
+
+        decoder = None
+        if text_mode:
+            from skypilot_tpu.models.tokenizer import StreamDecoder  # pylint: disable=import-outside-toplevel
+            decoder = StreamDecoder(tok)
+        try:
+            while True:
+                token = await asyncio.wait_for(q.get(), timeout=600)
+                if token is None:
+                    if request.error is not None:
+                        raise request.error
+                    break
+                if text_mode:
+                    if token == stop_token:
+                        break
+                    delta = decoder.push(token)
+                    if delta:
+                        writer.write(chunk(json.dumps({'text': delta})))
+                else:
+                    writer.write(chunk(json.dumps({'token': token})))
+                await writer.drain()
+            if decoder is not None:
+                tail = decoder.finish()
+                if tail:
+                    writer.write(chunk(json.dumps({'text': tail})))
+            writer.write(chunk('[DONE]') + b'0\r\n\r\n')
+            await writer.drain()
+        except (BrokenPipeError, ConnectionResetError):
+            # Client went away: free the slot instead of decoding the
+            # rest of max_new_tokens for nobody.
+            request.cancel()
+        except Exception as e:  # pylint: disable=broad-except
+            request.cancel()
+            try:
+                writer.write(chunk(json.dumps(
+                    {'error': f'{type(e).__name__}: {e}'})) +
+                    b'0\r\n\r\n')
+                await writer.drain()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+
+    # ------------------------------------------------------- connection
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    parsed = await _read_request(reader)
+                except _HttpError as e:
+                    # Malformed request line / Content-Length / too-big
+                    # body: answer like the threaded front does, then
+                    # drop the connection (framing is unreliable now).
+                    writer.write(_json_response(e.code,
+                                                {'error': str(e)}))
+                    await writer.drain()
+                    break
+                except (asyncio.LimitOverrunError, ValueError) as e:
+                    writer.write(_json_response(
+                        400, {'error': f'bad request: {e}'}))
+                    await writer.drain()
+                    break
+                if parsed is None:
+                    break
+                method, path, _, body = parsed
+                try:
+                    if method == 'GET':
+                        code, payload = self._health()
+                        writer.write(_json_response(code, payload))
+                        await writer.drain()
+                        continue
+                    if method != 'POST':
+                        raise _HttpError(404, 'unknown method')
+                    try:
+                        req = json.loads(body or b'{}')
+                    except json.JSONDecodeError as e:
+                        raise _HttpError(400, f'bad JSON: {e}') from e
+                    if path == '/generate':
+                        writer.write(_json_response(
+                            200, await self._generate(req)))
+                        await writer.drain()
+                    elif path == '/generate_stream':
+                        prompt = req['prompt_ids']
+                        if (isinstance(prompt, list) and prompt and
+                                isinstance(prompt[0], list)):
+                            if len(prompt) != 1:
+                                raise _HttpError(
+                                    400,
+                                    'streaming serves one prompt '
+                                    'per request')
+                            prompt = prompt[0]
+                        await self._stream(writer, prompt, req,
+                                           text_mode=False)
+                    elif path == '/generate_text':
+                        await self._generate_text(req, writer)
+                    else:
+                        raise _HttpError(404, 'unknown path')
+                except _HttpError as e:
+                    writer.write(_json_response(
+                        e.code, {'error': str(e)}))
+                    await writer.drain()
+                except (KeyError, ValueError, TypeError) as e:
+                    writer.write(_json_response(400, {'error': str(e)}))
+                    await writer.drain()
+                except (BrokenPipeError, ConnectionResetError):
+                    break
+                except Exception as e:  # pylint: disable=broad-except
+                    # Engine failures must reach the client as HTTP,
+                    # not a dropped connection.
+                    writer.write(_json_response(
+                        500, {'error': f'{type(e).__name__}: {e}'}))
+                    await writer.drain()
+        except (BrokenPipeError, ConnectionResetError,
+                asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+
+    # ------------------------------------------------------------ server
+
+    async def run(self, host: str = '0.0.0.0', port: int = 0,
+                  ready: Optional['asyncio.Future'] = None) -> None:
+        self._loop = asyncio.get_running_loop()
+        server = await asyncio.start_server(self._handle, host, port)
+        bound = server.sockets[0].getsockname()[1]
+        logger.info(f'async model server on :{bound}')
+        if ready is not None:
+            ready.set_result(bound)
+        async with server:
+            await server.serve_forever()
+
+
+def serve_forever(server: 'model_server_lib.ModelServer',
+                  port: int = 0) -> None:
+    try:
+        asyncio.run(AsyncModelServer(server).run(port=port))
+    finally:
+        server.close()
+
+
+def start_background(server: 'model_server_lib.ModelServer',
+                     port: int = 0):
+    """Tests: run the async front on a daemon thread's event loop;
+    returns (port, shutdown_fn)."""
+    import threading  # pylint: disable=import-outside-toplevel
+    front = AsyncModelServer(server)
+    loop = asyncio.new_event_loop()
+    ready: 'asyncio.Future' = loop.create_future()
+    boot_error: list = []
+
+    def _run() -> None:
+        asyncio.set_event_loop(loop)
+        try:
+            loop.run_until_complete(front.run(port=port, ready=ready))
+        except asyncio.CancelledError:
+            pass
+        except Exception as e:  # pylint: disable=broad-except
+            boot_error.append(e)  # e.g. EADDRINUSE before ready
+        finally:
+            loop.close()
+
+    thread = threading.Thread(target=_run, daemon=True)
+    thread.start()
+    while not ready.done():
+        if not thread.is_alive():
+            raise RuntimeError(
+                f'async server failed to start: '
+                f'{boot_error[0] if boot_error else "unknown"}')
+        time.sleep(0.01)
+
+    def shutdown() -> None:
+        def _stop() -> None:
+            for task in asyncio.all_tasks(loop):
+                task.cancel()
+        loop.call_soon_threadsafe(_stop)
+        thread.join(timeout=10)
+
+    return ready.result(), shutdown

@@ -54,6 +54,24 @@ class _Request:
         # calls are no-ops — otherwise two None sentinels truncate a
         # stream() and a success can be overwritten with an error.
         self._state_lock = threading.Lock()
+        # Event-loop bridges (serve/async_server.py): called with each
+        # token and a final None, from the engine worker thread, under
+        # the state lock — watchers must be cheap and non-blocking
+        # (call_soon_threadsafe qualifies).
+        self._watchers: List[Any] = []
+
+    def add_watcher(self, fn) -> None:
+        """Subscribe fn(token|None) to this request's token stream;
+        tokens already produced are replayed first, so late subscribers
+        never miss a prefix (the admission path can push the first
+        token before the caller gets the request handle back)."""
+        with self._state_lock:
+            for token in self.tokens:
+                fn(token)
+            if self.done.is_set():
+                fn(None)
+            else:
+                self._watchers.append(fn)
 
     def _push(self, token: int) -> None:
         with self._state_lock:
@@ -63,6 +81,7 @@ class _Request:
                 return
             self.tokens.append(token)
             self._live.put(token)
+            self._notify(token)
 
     def _finish(self, error: Optional[Exception] = None) -> None:
         with self._state_lock:
@@ -71,6 +90,22 @@ class _Request:
             self.error = error
             self.done.set()
             self._live.put(None)
+            self._notify(None)
+            self._watchers.clear()
+
+    def _notify(self, token: Optional[int]) -> None:
+        # A raising watcher (e.g. call_soon_threadsafe on a closed
+        # event loop at shutdown) must not propagate into the engine
+        # worker — that would fail the WHOLE engine for one dead
+        # subscriber.  Drop it instead.
+        for fn in list(self._watchers):
+            try:
+                fn(token)
+            except Exception:  # pylint: disable=broad-except
+                try:
+                    self._watchers.remove(fn)
+                except ValueError:
+                    pass
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         if not self.done.wait(timeout):

@@ -494,6 +494,12 @@ def main() -> None:
                         help='Tensor-shard the model over N local '
                              'devices (models too big for one chip); '
                              'GSPMD partitions the decode einsums.')
+    parser.add_argument('--http-server', default='async',
+                        choices=['async', 'threaded'],
+                        help='Connection front end: one asyncio event '
+                             'loop (default; N concurrent SSE streams '
+                             'without a thread per connection) or the '
+                             'legacy thread-per-connection server.')
     args = parser.parse_args()
     server = ModelServer(args.model, checkpoint_dir=args.checkpoint_dir,
                          max_len=args.max_len, max_batch=args.max_batch,
@@ -501,7 +507,11 @@ def main() -> None:
                          continuous_batching=args.continuous_batching,
                          tensor=args.tensor,
                          tokenizer_path=args.tokenizer)
-    serve_forever(server, args.port)
+    if args.http_server == 'async':
+        from skypilot_tpu.serve import async_server  # pylint: disable=import-outside-toplevel
+        async_server.serve_forever(server, args.port)
+    else:
+        serve_forever(server, args.port)
 
 
 if __name__ == '__main__':

@@ -1,0 +1,180 @@
+"""Asyncio model-server front end (serve/async_server).
+
+The VERDICT r4 'done' bar: N concurrent SSE streams + health probes
+served from ONE event loop (no thread per connection), with the same
+endpoint surface and generation results as the threaded front.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import requests
+
+from skypilot_tpu.models import decode
+from skypilot_tpu.serve import async_server, model_server
+
+
+@pytest.fixture(scope='module')
+def cb_server():
+    """Continuous-batching server behind the async front."""
+    srv = model_server.ModelServer('tiny', max_len=64, max_batch=4,
+                                   continuous_batching=True)
+    port, shutdown = async_server.start_background(srv)
+    yield srv, port
+    shutdown()
+    srv.close()
+
+
+def test_health_and_engine_stats(cb_server):
+    _, port = cb_server
+    resp = requests.get(f'http://127.0.0.1:{port}/', timeout=10)
+    assert resp.status_code == 200
+    body = resp.json()
+    assert body['status'] == 'ok'
+    assert body['engine']['slots'] == 4
+
+
+def test_generate_parity_with_decode(cb_server):
+    srv, port = cb_server
+    prompt = [[5, 7, 11, 13]]
+    resp = requests.post(
+        f'http://127.0.0.1:{port}/generate',
+        json={'prompt_ids': prompt, 'max_new_tokens': 6}, timeout=120)
+    assert resp.status_code == 200, resp.text
+    _, expected = decode.generate(
+        srv.cfg, srv.params, jnp.asarray(prompt, jnp.int32),
+        max_new_tokens=6, max_len=srv.max_len)
+    np.testing.assert_array_equal(
+        np.asarray(resp.json()['tokens']), np.asarray(expected))
+
+
+def test_validation_and_unknown_path(cb_server):
+    _, port = cb_server
+    assert requests.post(f'http://127.0.0.1:{port}/generate',
+                         json={'prompt_ids': [[1] * 60],
+                               'max_new_tokens': 30},
+                         timeout=30).status_code == 400
+    assert requests.post(f'http://127.0.0.1:{port}/nope', json={},
+                         timeout=30).status_code == 404
+    bad = requests.post(f'http://127.0.0.1:{port}/generate',
+                        data=b'{not json', timeout=30)
+    assert bad.status_code == 400
+
+
+def _read_sse(resp):
+    events = []
+    for line in resp.iter_lines():
+        if line.startswith(b'data: '):
+            events.append(line[len(b'data: '):].decode())
+    return events
+
+
+def test_concurrent_sse_streams_one_loop(cb_server):
+    """More simultaneous SSE streams than engine slots, all served from
+    the single event loop; every stream completes with [DONE] and the
+    same token sequence as a solo run."""
+    srv, port = cb_server
+    prompt = [3, 1, 4, 1, 5]
+    n_streams = 8  # > 4 slots: some wait queued while others stream
+
+    solo = requests.post(
+        f'http://127.0.0.1:{port}/generate',
+        json={'prompt_ids': [prompt], 'max_new_tokens': 5},
+        timeout=120).json()['tokens'][0]
+
+    results = [None] * n_streams
+
+    def one(i):
+        resp = requests.post(
+            f'http://127.0.0.1:{port}/generate_stream',
+            json={'prompt_ids': prompt, 'max_new_tokens': 5},
+            stream=True, timeout=300)
+        assert resp.status_code == 200
+        events = _read_sse(resp)
+        assert events[-1] == '[DONE]'
+        results[i] = [json.loads(e)['token'] for e in events[:-1]]
+
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(n_streams)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    for got in results:
+        assert got == solo, (got, solo)
+
+
+def test_stream_client_disconnect_frees_slot(cb_server):
+    """Dropping an SSE connection mid-stream cancels the request: the
+    engine's busy slot count returns to zero."""
+    srv, port = cb_server
+    sock = socket.create_connection(('127.0.0.1', port), timeout=10)
+    body = json.dumps({'prompt_ids': [1, 2, 3],
+                       'max_new_tokens': 50}).encode()
+    sock.sendall(
+        b'POST /generate_stream HTTP/1.1\r\n'
+        b'Content-Type: application/json\r\n'
+        + f'Content-Length: {len(body)}\r\n\r\n'.encode() + body)
+    sock.recv(1024)  # wait for the stream to actually start
+    sock.close()     # client vanishes mid-generation
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        stats = requests.get(f'http://127.0.0.1:{port}/',
+                             timeout=10).json()['engine']
+        if stats['busy_slots'] == 0 and stats['queued_requests'] == 0:
+            return
+        time.sleep(0.5)
+    pytest.fail(f'slot leaked after disconnect: {stats}')
+
+
+def test_generate_text_roundtrip(cb_server):
+    """/generate_text (byte tokenizer fallback) + SSE text streaming
+    through the async front."""
+    _, port = cb_server
+    resp = requests.post(
+        f'http://127.0.0.1:{port}/generate_text',
+        json={'prompt': 'ab', 'max_new_tokens': 4}, timeout=120)
+    assert resp.status_code == 200, resp.text
+    assert 'completion' in resp.json()
+
+    stream = requests.post(
+        f'http://127.0.0.1:{port}/generate_text',
+        json={'prompt': 'ab', 'max_new_tokens': 4, 'stream': True},
+        stream=True, timeout=120)
+    events = _read_sse(stream)
+    assert events[-1] == '[DONE]'
+
+
+def test_keepalive_connection_reuse(cb_server):
+    """Multiple requests ride one kept-alive connection."""
+    _, port = cb_server
+    with requests.Session() as session:
+        for _ in range(3):
+            assert session.get(f'http://127.0.0.1:{port}/',
+                               timeout=10).status_code == 200
+
+
+def test_lockstep_server_without_engine():
+    """The async front also serves a non-continuous-batching server
+    (lock-step decode in the executor); streaming is rejected."""
+    srv = model_server.ModelServer('tiny', max_len=32, max_batch=2)
+    port, shutdown = async_server.start_background(srv)
+    try:
+        resp = requests.post(
+            f'http://127.0.0.1:{port}/generate',
+            json={'prompt_ids': [[3, 5]], 'max_new_tokens': 3},
+            timeout=120)
+        assert resp.status_code == 200, resp.text
+        assert requests.post(
+            f'http://127.0.0.1:{port}/generate_stream',
+            json={'prompt_ids': [3, 5], 'max_new_tokens': 3},
+            timeout=30).status_code == 400
+    finally:
+        shutdown()
+        srv.close()

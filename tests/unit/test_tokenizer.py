@@ -278,6 +278,19 @@ def test_hf_eos_fallback_from_vocab(tmp_path):
     assert tok.eos_token == '<|end|>'
 
 
+def test_sp_control_tokens_not_encodable(tmp_path):
+    """User text spelling a control token must NOT encode to its
+    special id (EOS injection): real sentencepiece excludes
+    CONTROL/UNKNOWN pieces from segmentation."""
+    d = _build_sp_model(tmp_path)
+    tok = tok_lib.load_tokenizer(d)
+    assert tok.eos_id == 2
+    ids = tok.encode('</s>')
+    assert tok.eos_id not in ids and tok.bos_id not in ids
+    # Spelled out from chars/bytes instead; decode survives.
+    assert '</s>' in tok.decode(ids) or 's' in tok.decode(ids)
+
+
 def test_load_tokenizer_fallbacks(tmp_path):
     assert isinstance(tok_lib.load_tokenizer(None),
                       tok_lib.ByteTokenizer)
