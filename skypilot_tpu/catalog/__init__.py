@@ -25,7 +25,9 @@ _INSTANCE_CSVS = {
     'aws': 'aws_instances.csv',
     'azure': 'azure_instances.csv',
     'gcp': 'gcp_instances.csv',
+    'lambda': 'lambda_instances.csv',
     'local': 'local_instances.csv',
+    'oci': 'oci_instances.csv',
 }
 _TPU_CSVS = {
     'gcp': 'gcp_tpus.csv',

@@ -1,0 +1,181 @@
+"""Lambda Cloud: bare-metal GPU boxes — a fourth fungible GPU pool.
+
+Parity: /root/reference/sky/clouds/lambda_cloud.py:1-301 (region
+enumeration, pricing, feature gates, `~/.lambda_cloud/lambda_keys`
+credential check) — rebuilt on the public REST API behind an
+injectable transport (provision/lambda_cloud/instance.py) instead of
+the reference's `lambda_utils` requests wrapper.
+
+Lambda's model is simpler than the hyperscalers and the feature gates
+say so honestly: no stop/resume (instances only launch and terminate),
+no spot market, no custom images, no per-instance port rules (boxes
+come up with an open firewall profile), region-level placement only.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+CREDENTIALS_PATH = '~/.lambda_cloud/lambda_keys'
+
+
+def read_api_key() -> Optional[str]:
+    """API key from env or the reference-compatible keys file
+    (`api_key = <key>` lines)."""
+    key = os.environ.get('LAMBDA_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser(CREDENTIALS_PATH)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding='utf-8') as f:
+        for line in f:
+            if line.strip().startswith('api_key'):
+                _, _, value = line.partition('=')
+                return value.strip() or None
+    return None
+
+
+class LambdaCloud(cloud_lib.Cloud):
+    _REPR = 'Lambda'
+    PROVISIONER = 'lambda_cloud'
+
+    _CLOUD_UNSUPPORTED_FEATURES = {
+        cloud_lib.CloudImplementationFeatures.STOP:
+            'Lambda instances cannot be stopped (launch/terminate only).',
+        cloud_lib.CloudImplementationFeatures.AUTOSTOP:
+            'No stop support; use autodown.',
+        cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+            'Lambda has no spot market.',
+        cloud_lib.CloudImplementationFeatures.IMAGE_ID:
+            'Lambda boxes boot a fixed Ubuntu + CUDA image.',
+        cloud_lib.CloudImplementationFeatures.OPEN_PORTS:
+            'No per-instance firewall API; ports are account-level.',
+        cloud_lib.CloudImplementationFeatures.CLONE_DISK:
+            'Disk cloning is not implemented for Lambda.',
+        cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+            'Disk size/tier is fixed per instance type.',
+    }
+
+    # ------------------------------------------------------- regions/zones
+
+    def regions_with_offering(self, resources) -> List[cloud_lib.Region]:
+        if resources.tpu_spec is not None:
+            return []  # TPUs are GCP-only.
+        if resources.use_spot:
+            return []
+        if resources.instance_type is not None:
+            pairs = catalog.get_region_zones_for_instance_type(
+                'lambda', resources.instance_type, False)
+        else:
+            pairs = []
+        regions: Dict[str, cloud_lib.Region] = {}
+        for region_name, _ in pairs:  # no zones on Lambda
+            if (resources.region is not None and
+                    region_name != resources.region):
+                continue
+            regions.setdefault(region_name, cloud_lib.Region(region_name))
+        return list(regions.values())
+
+    # ------------------------------------------------------------- pricing
+
+    def instance_type_to_hourly_cost(self, instance_type, use_spot, region,
+                                     zone) -> float:
+        return catalog.get_hourly_cost('lambda', instance_type, use_spot,
+                                       region, zone)
+
+    def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
+                                    zone) -> float:
+        del accelerators, use_spot, region, zone
+        return 0.0  # bundled into the instance price
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        del num_gigabytes
+        return 0.0  # Lambda meters no egress
+
+    # -------------------------------------------------------- feasibility
+
+    def get_feasible_launchable_resources(self, resources):
+        fuzzy: List[str] = []
+        if resources.tpu_spec is not None:
+            return [], fuzzy
+        if resources.use_spot:
+            return [], fuzzy
+        if resources.accelerators:
+            acc, count = next(iter(resources.accelerators.items()))
+            instance_types = catalog.get_instance_type_for_accelerator(
+                'lambda', acc, count, resources.cpus, resources.memory,
+                resources.region, resources.zone)
+            if not instance_types:
+                offerings = catalog.list_accelerators(name_filter=acc,
+                                                      clouds=['lambda'])
+                fuzzy.extend(sorted(offerings))
+                return [], fuzzy
+            return [
+                resources.copy(cloud=self, instance_type=instance_types[0])
+            ], fuzzy
+        if resources.instance_type is not None:
+            if catalog.instance_type_exists('lambda',
+                                            resources.instance_type):
+                return [resources.copy(cloud=self)], fuzzy
+            return [], fuzzy
+        default = self.get_default_instance_type(resources.cpus,
+                                                 resources.memory)
+        if default is None:
+            return [], fuzzy
+        return [resources.copy(cloud=self, instance_type=default)], fuzzy
+
+    def get_default_instance_type(self, cpus, memory) -> Optional[str]:
+        return catalog.get_default_instance_type('lambda', cpus, memory)
+
+    def validate_region_zone(self, region, zone):
+        if zone is not None:
+            raise ValueError(
+                'Lambda has no zone placement (region only); '
+                f'got zone={zone!r}.')
+        return catalog.validate_region_zone('lambda', region, None)
+
+    # ------------------------------------------------------------- deploy
+
+    def make_deploy_resources_variables(self, resources, cluster_name,
+                                        region, zones) -> Dict[str, Any]:
+        del zones
+        return {
+            'cluster_name': cluster_name,
+            'region': region.name,
+            'zones': [],
+            'use_spot': False,
+            'labels': dict(resources.labels or {}),
+            'ports': list(resources.ports or []),
+            'disk_size': resources.disk_size,
+            'image_id': None,
+            'tpu': False,
+            'instance_type': resources.instance_type,
+            'num_nodes': 1,
+        }
+
+    # --------------------------------------------------------- credentials
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        if read_api_key():
+            return True, None
+        return False, (f'Lambda API key not found. Put `api_key = ...` '
+                       f'in {CREDENTIALS_PATH} or set LAMBDA_API_KEY.')
+
+    def get_current_user_identity(self) -> Optional[List[str]]:
+        key = read_api_key()
+        # The API exposes no identity endpoint; the key prefix is the
+        # stable account discriminator.
+        return [f'lambda:{key[:8]}'] if key else None
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        if os.path.exists(os.path.expanduser(CREDENTIALS_PATH)):
+            return {CREDENTIALS_PATH: CREDENTIALS_PATH}
+        return {}

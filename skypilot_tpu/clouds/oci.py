@@ -1,0 +1,169 @@
+"""Oracle Cloud (OCI): GPU VMs/bare-metal — a fifth fungible GPU pool.
+
+Parity: /root/reference/sky/clouds/oci.py:1-633 (region/AD enumeration,
+pricing, image + launch config, ~/.oci/config credential check) —
+rebuilt on the oci CLI's JSON output with an injectable runner
+(provision/oci/instance.py), the same no-SDK seam as aws/azure, minus
+the reference's image-OCID resolution machinery (the provisioner takes
+an image OCID directly or uses the platform default).
+
+OCI placement is region + availability domain (the catalog's zone
+column holds simplified AD names: AD-1..AD-3).  Instances live in one
+compartment, configured via `oci.compartment_ocid` in the layered
+config or the OCI_COMPARTMENT_OCID env var.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+class OCI(cloud_lib.Cloud):
+    _REPR = 'OCI'
+    PROVISIONER = 'oci'
+
+    _CLOUD_UNSUPPORTED_FEATURES = {
+        cloud_lib.CloudImplementationFeatures.CLONE_DISK:
+            'Disk cloning is not implemented for OCI.',
+        cloud_lib.CloudImplementationFeatures.DOCKER_IMAGE:
+            'Docker-image runtime is not implemented for OCI.',
+        cloud_lib.CloudImplementationFeatures.OPEN_PORTS:
+            'Per-port ingress rides the VCN security list, not a '
+            'per-instance API; configure the subnet instead.',
+    }
+
+    # ------------------------------------------------------- regions/zones
+
+    def regions_with_offering(self, resources) -> List[cloud_lib.Region]:
+        if resources.tpu_spec is not None:
+            return []  # TPUs are GCP-only.
+        if resources.instance_type is not None:
+            pairs = catalog.get_region_zones_for_instance_type(
+                'oci', resources.instance_type, resources.use_spot)
+        else:
+            pairs = []
+        regions: Dict[str, cloud_lib.Region] = {}
+        for region_name, zone_name in pairs:
+            if (resources.region is not None and
+                    region_name != resources.region):
+                continue
+            if resources.zone is not None and zone_name != resources.zone:
+                continue
+            region = regions.setdefault(region_name,
+                                        cloud_lib.Region(region_name))
+            region.zones.append(cloud_lib.Zone(zone_name, region_name))
+        return list(regions.values())
+
+    # ------------------------------------------------------------- pricing
+
+    def instance_type_to_hourly_cost(self, instance_type, use_spot, region,
+                                     zone) -> float:
+        return catalog.get_hourly_cost('oci', instance_type, use_spot,
+                                       region, zone)
+
+    def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
+                                    zone) -> float:
+        del accelerators, use_spot, region, zone
+        return 0.0  # bundled into the shape price
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # OCI internet egress: first 10 TB/month free, then ~$0.0085/GB.
+        if num_gigabytes <= 10240:
+            return 0.0
+        return (num_gigabytes - 10240) * 0.0085
+
+    # -------------------------------------------------------- feasibility
+
+    def get_feasible_launchable_resources(self, resources):
+        fuzzy: List[str] = []
+        if resources.tpu_spec is not None:
+            return [], fuzzy
+        if resources.accelerators:
+            acc, count = next(iter(resources.accelerators.items()))
+            instance_types = catalog.get_instance_type_for_accelerator(
+                'oci', acc, count, resources.cpus, resources.memory,
+                resources.region, resources.zone)
+            if not instance_types:
+                offerings = catalog.list_accelerators(name_filter=acc,
+                                                      clouds=['oci'])
+                fuzzy.extend(sorted(offerings))
+                return [], fuzzy
+            return [
+                resources.copy(cloud=self, instance_type=instance_types[0])
+            ], fuzzy
+        if resources.instance_type is not None:
+            if catalog.instance_type_exists('oci',
+                                            resources.instance_type):
+                return [resources.copy(cloud=self)], fuzzy
+            return [], fuzzy
+        default = self.get_default_instance_type(resources.cpus,
+                                                 resources.memory)
+        if default is None:
+            return [], fuzzy
+        return [resources.copy(cloud=self, instance_type=default)], fuzzy
+
+    def get_default_instance_type(self, cpus, memory) -> Optional[str]:
+        return catalog.get_default_instance_type('oci', cpus, memory)
+
+    def validate_region_zone(self, region, zone):
+        return catalog.validate_region_zone('oci', region, zone)
+
+    # ------------------------------------------------------------- deploy
+
+    def make_deploy_resources_variables(self, resources, cluster_name,
+                                        region, zones) -> Dict[str, Any]:
+        return {
+            'cluster_name': cluster_name,
+            'region': region.name,
+            'zones': [z.name for z in (zones or [])],
+            'use_spot': resources.use_spot,
+            'labels': dict(resources.labels or {}),
+            'ports': list(resources.ports or []),
+            'disk_size': resources.disk_size,
+            'image_id': resources.image_id,
+            'tpu': False,
+            'instance_type': resources.instance_type,
+            'num_nodes': 1,
+        }
+
+    # --------------------------------------------------------- credentials
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        if not os.path.exists(os.path.expanduser('~/.oci/config')):
+            return False, ('OCI config not found. Run `oci setup config` '
+                           '(and set oci.compartment_ocid in '
+                           '~/.skytpu/config.yaml).')
+        try:
+            proc = subprocess.run(['oci', 'iam', 'region', 'list'],
+                                  capture_output=True, text=True,
+                                  timeout=15, check=False)
+            if proc.returncode == 0:
+                return True, None
+            return False, ('`oci iam region list` failed: '
+                           f'{proc.stderr.strip().splitlines()[:1]}')
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            return False, 'oci CLI not installed or not responding.'
+
+    def get_current_user_identity(self) -> Optional[List[str]]:
+        path = os.path.expanduser('~/.oci/config')
+        if not os.path.exists(path):
+            return None
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                if line.strip().startswith('user'):
+                    _, _, value = line.partition('=')
+                    return [value.strip()]
+        return None
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        if os.path.isdir(os.path.expanduser('~/.oci')):
+            return {'~/.oci': '~/.oci'}
+        return {}

@@ -13,7 +13,9 @@ from skypilot_tpu.clouds import docker
 from skypilot_tpu.clouds import gcp
 from skypilot_tpu.clouds import gke
 from skypilot_tpu.clouds import kubernetes
+from skypilot_tpu.clouds import lambda_cloud
 from skypilot_tpu.clouds import local
+from skypilot_tpu.clouds import oci
 
 CLOUD_REGISTRY: Dict[str, cloud_lib.Cloud] = {
     'aws': aws.AWS(),
@@ -22,12 +24,14 @@ CLOUD_REGISTRY: Dict[str, cloud_lib.Cloud] = {
     'gcp': gcp.GCP(),
     'gke': gke.GKE(),
     'kubernetes': kubernetes.Kubernetes(),
+    'lambda': lambda_cloud.LambdaCloud(),
     'local': local.Local(),
+    'oci': oci.OCI(),
 }
 
 # Aliases accepted by from_str (kept OUT of the registry dict so that
 # `sky check` and registry iteration see each cloud exactly once).
-_ALIASES = {'k8s': 'kubernetes'}
+_ALIASES = {'k8s': 'kubernetes', 'lambda_cloud': 'lambda'}
 
 
 def from_str(name: Optional[str]) -> Optional[cloud_lib.Cloud]:

@@ -69,14 +69,24 @@ async def _read_request(reader: asyncio.StreamReader
         raise _HttpError(400, 'bad Content-Length') from e
     if length > _MAX_BODY:
         raise _HttpError(413, 'request body too large')
-    body = await reader.readexactly(length) if length else b''
+    if length:
+        # Same idle bound as the head read: a client that sends headers
+        # then stalls must not hold a task + fd forever.
+        try:
+            body = await asyncio.wait_for(reader.readexactly(length),
+                                          timeout=_IDLE_TIMEOUT)
+        except asyncio.TimeoutError as e:
+            raise _HttpError(408, 'request body timed out') from e
+    else:
+        body = b''
     return method, path, headers, body
 
 
 def _json_response(code: int, payload: Dict[str, Any]) -> bytes:
     body = json.dumps(payload).encode()
     reason = {200: 'OK', 400: 'Bad Request', 404: 'Not Found',
-              413: 'Payload Too Large', 500: 'Internal Server Error',
+              408: 'Request Timeout', 413: 'Payload Too Large',
+              500: 'Internal Server Error',
               503: 'Service Unavailable'}.get(code, 'Error')
     return (f'HTTP/1.1 {code} {reason}\r\n'
             f'Content-Type: application/json\r\n'
@@ -176,10 +186,18 @@ class AsyncModelServer:
         tok = server.tokenizer
         stop_token = (tok.eos_id if text_mode
                       else req.get('stop_token'))
-        request = engine.submit(
-            [int(t) for t in ids],
-            int(req.get('max_new_tokens', 64 if text_mode else 16)),
-            stop_token=stop_token)
+        try:
+            request = engine.submit(
+                [int(t) for t in ids],
+                int(req.get('max_new_tokens', 64 if text_mode else 16)),
+                stop_token=stop_token)
+        except ValueError:
+            raise
+        except Exception as e:  # pylint: disable=broad-except
+            # Stopped/failed engine: the replica is unavailable, not
+            # the request wrong — 503 like the threaded front, so LB
+            # retry logic classifies it correctly.
+            raise _HttpError(503, f'{type(e).__name__}: {e}') from e
         q = self._watch(request)
         writer.write(b'HTTP/1.1 200 OK\r\n'
                      b'Content-Type: text/event-stream\r\n'
@@ -220,6 +238,11 @@ class AsyncModelServer:
             # Client went away: free the slot instead of decoding the
             # rest of max_new_tokens for nobody.
             request.cancel()
+        except asyncio.CancelledError:
+            # Task cancelled (loop shutdown): same slot-leak logic,
+            # then propagate — cancellation must not be swallowed.
+            request.cancel()
+            raise
         except Exception as e:  # pylint: disable=broad-except
             request.cancel()
             try:

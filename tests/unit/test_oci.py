@@ -1,0 +1,211 @@
+"""OCI cloud + compute provisioner (cloud breadth: VERDICT r4 missing
+#1).  The oci CLI sits behind an injectable runner
+(provision/oci/instance.py: set_cli_runner), so the lifecycle —
+tagged launch per rank, all-or-nothing sweep, stop/start via instance
+actions, lifecycle-state mapping, vnic IP discovery — runs without
+credentials or network.  Model: tests/unit/test_azure.py."""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.oci import instance as oci_instance
+
+
+class FakeOciCli:
+    """Minimal compute state machine keyed on the oci CLI argv
+    surface."""
+
+    def __init__(self):
+        self.instances = {}   # id -> row (oci list shape)
+        self.calls = []
+        self._next = 0
+        # Test knobs:
+        self.fail_after = None  # launch N instances then rc=1
+
+    def _arg(self, args, flag, default=None):
+        return args[args.index(flag) + 1] if flag in args else default
+
+    def __call__(self, argv):
+        self.calls.append(argv)
+        assert argv[0] == 'oci' and argv[-2:] == ['--output', 'json']
+        args = argv[1:-2]
+        cmd = ' '.join(args[:3])
+        if cmd == 'compute instance launch':
+            if (self.fail_after is not None and
+                    len(self.instances) >= self.fail_after):
+                return 1, '', 'LimitExceeded: shape quota reached'
+            iid = f'ocid1.instance.oc1..{self._next:06d}'
+            self._next += 1
+            tags = json.loads(self._arg(args, '--freeform-tags'))
+            self.instances[iid] = {
+                'id': iid,
+                'display-name': self._arg(args, '--display-name'),
+                'lifecycle-state': 'RUNNING',
+                'shape': self._arg(args, '--shape'),
+                'availability-domain': self._arg(
+                    args, '--availability-domain'),
+                'freeform-tags': tags,
+            }
+            return 0, json.dumps({'data': self.instances[iid]}), ''
+        if cmd == 'compute instance list':
+            states = self._arg(args, '--lifecycle-state', '').split(',')
+            rows = [r for r in self.instances.values()
+                    if r['lifecycle-state'] in states]
+            return 0, json.dumps({'data': rows}), ''
+        if cmd == 'compute instance action':
+            iid = self._arg(args, '--instance-id')
+            action = self._arg(args, '--action')
+            self.instances[iid]['lifecycle-state'] = (
+                'RUNNING' if action == 'START' else 'STOPPED')
+            return 0, '{}', ''
+        if cmd == 'compute instance terminate':
+            self.instances.pop(self._arg(args, '--instance-id'), None)
+            return 0, '', ''
+        if cmd == 'compute instance list-vnics':
+            iid = self._arg(args, '--instance-id')
+            n = int(iid.rsplit('.', 1)[-1])
+            return 0, json.dumps({'data': [{
+                'private-ip': f'10.3.0.{n + 1}',
+                'public-ip': f'150.1.0.{n + 1}',
+            }]}), ''
+        return 1, '', f'unhandled: {cmd}'
+
+
+@pytest.fixture
+def fake_oci(monkeypatch):
+    monkeypatch.setenv('OCI_COMPARTMENT_OCID',
+                       'ocid1.compartment.oc1..test')
+    cli = FakeOciCli()
+    oci_instance.set_cli_runner(cli)
+    yield cli
+    oci_instance.set_cli_runner(None)
+
+
+def _config(cluster='ocic', count=2, itype='BM.GPU4.8', spot=False):
+    return provision_common.ProvisionConfig(
+        provider_name='oci', cluster_name=cluster,
+        region='us-ashburn-1', zones=['AD-1'],
+        deploy_vars={'instance_type': itype, 'use_spot': spot,
+                     'disk_size': 256}, count=count)
+
+
+class TestProvisionLifecycle:
+
+    def test_launch_query_info_terminate(self, fake_oci):
+        record = oci_instance.run_instances(_config())
+        assert record.provider_name == 'oci'
+        assert record.zone == 'AD-1'
+        assert len(record.created_instance_ids) == 2
+        names = sorted(r['display-name']
+                       for r in fake_oci.instances.values())
+        assert names == ['ocic-0', 'ocic-1']
+        # Rank identity lives in OUR tags, not the display name.
+        ranks = sorted((r['freeform-tags']['skytpu-rank'])
+                       for r in fake_oci.instances.values())
+        assert ranks == ['0', '1']
+
+        status = oci_instance.query_instances('ocic')
+        assert all(s.value == 'UP' for s in status.values())
+
+        info = oci_instance.get_cluster_info('ocic')
+        assert info.ssh_user == 'ubuntu'
+        assert [i.tags['rank'] for i in info.instances] == ['0', '1']
+        assert info.instances[0].external_ip.startswith('150.')
+        assert info.instances[0].internal_ip.startswith('10.3.')
+
+        oci_instance.terminate_instances('ocic')
+        assert oci_instance.query_instances('ocic') == {}
+
+    def test_stop_start_resume(self, fake_oci):
+        oci_instance.run_instances(_config())
+        oci_instance.stop_instances('ocic')
+        status = oci_instance.query_instances('ocic')
+        assert all(s.value == 'STOPPED' for s in status.values())
+        record = oci_instance.run_instances(_config())
+        assert len(record.resumed_instance_ids) == 2
+        status = oci_instance.query_instances('ocic')
+        assert all(s.value == 'UP' for s in status.values())
+
+    def test_count_mismatch_rejected(self, fake_oci):
+        oci_instance.run_instances(_config(count=2))
+        with pytest.raises(exceptions.ResourcesMismatchError):
+            oci_instance.run_instances(_config(count=3))
+
+    def test_partial_launch_sweeps_created(self, fake_oci):
+        """Rank 1's launch hits a quota error: rank 0 is terminated
+        and the error surfaces (all-or-nothing gang)."""
+        fake_oci.fail_after = 1
+        with pytest.raises(exceptions.ProvisionError,
+                           match='LimitExceeded'):
+            oci_instance.run_instances(_config(count=2))
+        assert fake_oci.instances == {}
+
+    def test_preemptible_flag(self, fake_oci):
+        oci_instance.run_instances(_config(cluster='spotc', count=1,
+                                           spot=True))
+        launch = next(c for c in fake_oci.calls
+                      if 'launch' in c)
+        cfg = json.loads(
+            launch[launch.index('--preemptible-instance-config') + 1])
+        assert cfg['preemptionAction']['type'] == 'TERMINATE'
+
+    def test_worker_only_operations_keep_head(self, fake_oci):
+        oci_instance.run_instances(_config(count=3))
+        oci_instance.stop_instances('ocic', worker_only=True)
+        states = {r['freeform-tags']['skytpu-rank']: r['lifecycle-state']
+                  for r in fake_oci.instances.values()}
+        assert states == {'0': 'RUNNING', '1': 'STOPPED', '2': 'STOPPED'}
+
+    def test_missing_compartment_rejected(self, fake_oci, monkeypatch):
+        monkeypatch.delenv('OCI_COMPARTMENT_OCID')
+        with pytest.raises(exceptions.ProvisionError,
+                           match='compartment'):
+            oci_instance.run_instances(_config())
+
+
+class TestOciCloud:
+
+    def test_feasibility_gpu_to_instance_type(self):
+        oci = registry.CLOUD_REGISTRY['oci']
+        r = sky.Resources(cloud='oci', accelerators='A100:8')
+        launchable, _ = oci.get_feasible_launchable_resources(r)
+        assert launchable
+        assert launchable[0].instance_type == 'BM.GPU4.8'
+
+    def test_tpu_not_feasible(self):
+        oci = registry.CLOUD_REGISTRY['oci']
+        r = sky.Resources(accelerators='tpu-v5e-8')
+        assert oci.get_feasible_launchable_resources(r)[0] == []
+
+    def test_pricing_spot_and_zones(self):
+        assert catalog.get_hourly_cost(
+            'oci', 'BM.GPU4.8') == pytest.approx(24.40)
+        assert catalog.get_hourly_cost(
+            'oci', 'BM.GPU4.8', use_spot=True) == pytest.approx(12.20)
+        oci = registry.CLOUD_REGISTRY['oci']
+        regions = oci.regions_with_offering(
+            sky.Resources(cloud='oci', instance_type='BM.GPU4.8'))
+        assert {r.name for r in regions} == {'us-ashburn-1',
+                                             'us-phoenix-1'}
+        assert regions[0].zones[0].name == 'AD-1'
+
+    def test_open_ports_gated(self):
+        from skypilot_tpu.clouds import cloud as cloud_lib
+        oci = registry.CLOUD_REGISTRY['oci']
+        with pytest.raises(exceptions.NotSupportedError):
+            oci.check_features_are_supported(
+                sky.Resources(cloud='oci'),
+                {cloud_lib.CloudImplementationFeatures.OPEN_PORTS})
+
+    def test_egress_cost_tiering(self):
+        oci = registry.CLOUD_REGISTRY['oci']
+        assert oci.get_egress_cost(10000) == 0.0
+        assert oci.get_egress_cost(10240 + 100) == pytest.approx(
+            100 * 0.0085)
