@@ -135,25 +135,51 @@ class SSHConfigHelper:
 
 def check_remote_runtime_version(
         handle: 'slice_backend.SliceResourceHandle') -> Optional[str]:
-    """Client/remote version-skew check (reference backend_utils.py:2593).
+    """Client/remote version-skew check (reference backend_utils.py:2593;
+    policy codified from tests/backward_compatibility_tests.sh).
 
     The handle records the client version that shipped the app tree at
     provision time (`launched_runtime_version`), so the check is a
     LOCAL comparison — no per-exec ssh round-trip on the
-    time-to-first-step hot path.  Returns a warning string on skew,
-    None when in sync or unknowable (pre-stamp handles).
+    time-to-first-step hot path.
+
+    Skew policy:
+    - same version → None (silent);
+    - same MAJOR (minor/patch drift) → warning string: the job codegen
+      and wire contract are stable within a major, exec proceeds;
+    - different MAJOR → RuntimeVersionSkewError: the contract may have
+      changed; exec refuses until a relaunch resyncs the runtime.
+      Read-only verbs (status/queue/logs) never call this check — an
+      old cluster stays inspectable from any client.
+    - unknowable (pre-stamp handle / dev tree) → None.
     """
+    from skypilot_tpu import exceptions  # pylint: disable=import-outside-toplevel
     import skypilot_tpu  # pylint: disable=import-outside-toplevel
     local_version = getattr(skypilot_tpu, '__version__', None)
     remote_version = getattr(handle, 'launched_runtime_version', None)
     if local_version is None or remote_version is None:
         return None
-    if remote_version != local_version:
+    if remote_version == local_version:
+        return None
+
+    def _major(version: str) -> Optional[str]:
+        head = version.split('.', 1)[0]
+        return head if head.isdigit() else None
+
+    resync_hint = ('relaunch the cluster (`sky launch` on the same '
+                   'name) to resync the runtime.')
+    local_major, remote_major = _major(local_version), _major(
+        remote_version)
+    if (local_major is None or remote_major is None or
+            local_major == remote_major):
         return (f'Cluster {handle.cluster_name} runs skypilot_tpu '
                 f'{remote_version}, client is {local_version}; '
-                f'restart the cluster (sky stop/start) or relaunch to '
-                f'resync the runtime.')
-    return None
+                f'{resync_hint}')
+    raise exceptions.RuntimeVersionSkewError(
+        f'Cluster {handle.cluster_name} runs skypilot_tpu '
+        f'{remote_version}; this client is {local_version} — a major '
+        f'version apart, so the job wire contract may differ. '
+        f'Refusing to exec; {resync_hint}')
 
 
 def cluster_lock_path(cluster_name: str) -> str:

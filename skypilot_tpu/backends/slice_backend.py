@@ -259,6 +259,34 @@ class SliceBackend(backend_lib.Backend[SliceResourceHandle]):
             f'not satisfy the requested resources '
             f'({[str(r) for r in task.resources]}).')
 
+    def _resync_runtime_if_upgraded(
+            self, cluster_name: str,
+            handle: SliceResourceHandle) -> None:
+        """A reused cluster whose runtime predates this client gets the
+        app tree re-shipped and the handle restamped — `sky launch` on
+        the same name IS the upgrade path the skew check's error
+        message promises (reference re-runs runtime setup on every
+        launch; we pay the cost only on version change)."""
+        import skypilot_tpu  # pylint: disable=import-outside-toplevel
+        local_version = getattr(skypilot_tpu, '__version__', None)
+        remote_version = getattr(handle, 'launched_runtime_version', None)
+        if local_version is None or remote_version == local_version:
+            return
+        logger.info(
+            f'Cluster {cluster_name} runtime is {remote_version}; '
+            f'client is {local_version} — re-shipping the runtime.')
+        cloud = handle.launched_resources.cloud
+        provisioner_lib.post_provision_runtime_setup(
+            handle.provider_name, cluster_name,
+            credential_files=(cloud.get_credential_file_mounts()
+                              if cloud is not None else None))
+        handle.launched_runtime_version = local_version
+        # requested_resources=None: restamping must not rewrite the
+        # provision-time request in cluster history.
+        global_user_state.add_or_update_cluster(
+            cluster_name, handle, requested_resources=None, ready=True,
+            is_launch=False)
+
     def _provision(self, task: 'task_lib.Task',
                    to_provision: Optional[Resources], dryrun: bool,
                    stream_logs: bool, cluster_name: str,
@@ -284,6 +312,8 @@ class SliceBackend(backend_lib.Backend[SliceResourceHandle]):
                                                acquire_lock=False)
         if existing is not None:
             logger.info(f'Reusing existing cluster {cluster_name}.')
+            if not dryrun:  # dryrun must stay side-effect free
+                self._resync_runtime_if_upgraded(cluster_name, existing)
             return existing
         if to_provision is None:
             launchables = optimizer_lib.Optimizer.enumerate_launchables(task)

@@ -72,6 +72,12 @@ def start(cluster_name: str,
         handle.provider_name, cluster_name,
         credential_files=cloud.get_credential_file_mounts())
     handle.cache_ips(cluster_info)
+    # The runtime just re-shipped from THIS client: restamp so the
+    # exec-time skew check agrees stop/start resyncs (the skew policy's
+    # documented second healing path besides relaunch).
+    import skypilot_tpu  # pylint: disable=import-outside-toplevel
+    handle.launched_runtime_version = getattr(skypilot_tpu,
+                                              '__version__', None)
     global_user_state.add_or_update_cluster(cluster_name, handle,
                                             requested_resources=None,
                                             ready=True, is_launch=False)

@@ -79,6 +79,13 @@ class NotSupportedError(SkyTpuError):
     """Feature is not supported by the selected infra/capacity type."""
 
 
+class RuntimeVersionSkewError(SkyTpuError):
+    """Client and cluster runtime differ by a MAJOR version: the job
+    codegen/wire contract may have changed, so exec is refused until
+    the cluster runtime is resynced (relaunch or stop/start).  Minor/
+    patch skew only warns — the contract is stable within a major."""
+
+
 class CommandError(SkyTpuError):
     """A remote or local command exited non-zero."""
 
