@@ -124,10 +124,102 @@ def _add_options(options):
     return deco
 
 
+# Shell completion (parity: reference cli.py:345
+# --install-shell-completion).  Click emits the completion script
+# itself (_SKYTPU_COMPLETE=<shell>_source skytpu); these options wire
+# it into the user's rc file / completions dir.
+_COMPLETION_SETUP = {
+    'bash': ('~/.bashrc',
+             'eval "$(_SKYTPU_COMPLETE=bash_source skytpu)"'),
+    'zsh': ('~/.zshrc',
+            'eval "$(_SKYTPU_COMPLETE=zsh_source skytpu)"'),
+    'fish': ('~/.config/fish/completions/skytpu.fish',
+             '_SKYTPU_COMPLETE=fish_source skytpu | source'),
+}
+_COMPLETION_MARK = '# skytpu shell completion'
+
+
+def _install_completion(ctx, param, value):
+    del param
+    if not value or ctx.resilient_parsing:
+        return
+    rc_path, line = _COMPLETION_SETUP[value]
+    path = os.path.expanduser(rc_path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    content = ''
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            content = f.read()
+    if _COMPLETION_MARK in content:
+        click.echo(f'Shell completion already installed in {rc_path}.')
+    else:
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(f'\n{_COMPLETION_MARK}\n{line}\n')
+        click.echo(f'Installed {value} completion in {rc_path}; '
+                   f'restart your shell to activate.')
+    ctx.exit()
+
+
+def _uninstall_completion(ctx, param, value):
+    del param
+    if not value or ctx.resilient_parsing:
+        return
+    rc_path, _ = _COMPLETION_SETUP[value]
+    path = os.path.expanduser(rc_path)
+    removed = False
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            lines = f.read().splitlines()
+        kept, skip_next = [], False
+        for line in lines:
+            if skip_next:
+                skip_next = False
+                continue
+            if line.strip() == _COMPLETION_MARK:
+                removed = True
+                skip_next = True  # the eval line that follows the mark
+                # Also drop the blank separator install wrote, so
+                # install/uninstall cycles don't accumulate blanks.
+                if kept and not kept[-1].strip():
+                    kept.pop()
+                continue
+            kept.append(line)
+        if removed:
+            with open(path, 'w', encoding='utf-8') as f:
+                f.write('\n'.join(kept) + ('\n' if kept else ''))
+    if removed:
+        click.echo(f'Removed skytpu completion from {rc_path}.')
+    else:
+        click.echo(f'No skytpu completion found in {rc_path}; '
+                   'nothing removed.')
+    ctx.exit()
+
+
+def _complete_cluster_name(ctx, param, incomplete):
+    """Cluster-name completion for every cluster-taking command."""
+    del ctx, param
+    try:
+        from skypilot_tpu import global_user_state  # pylint: disable=import-outside-toplevel
+        return [r['name'] for r in global_user_state.get_clusters()
+                if r['name'].startswith(incomplete)]
+    except Exception:  # pylint: disable=broad-except
+        return []  # completion must never crash the shell
+
+
 @click.group()
 # Explicit version: click's package introspection fails when running
 # from a source tree (PYTHONPATH) rather than an installed wheel.
 @click.version_option(version=__version__, message='%(version)s')
+@click.option('--install-shell-completion',
+              type=click.Choice(sorted(_COMPLETION_SETUP)),
+              callback=_install_completion, expose_value=False,
+              is_eager=True,
+              help='Install shell tab-completion and exit.')
+@click.option('--uninstall-shell-completion',
+              type=click.Choice(sorted(_COMPLETION_SETUP)),
+              callback=_uninstall_completion, expose_value=False,
+              is_eager=True,
+              help='Remove shell tab-completion and exit.')
 def cli():
     """skypilot_tpu: run AI workloads on TPU slices, anywhere."""
     # Crash-safe orphan cleanup: kill daemons whose state dir vanished
@@ -141,7 +233,8 @@ def cli():
 
 @cli.command()
 @click.argument('entrypoint', required=False)
-@click.option('--cluster', '-c', default=None, help='Cluster name.')
+@click.option('--cluster', '-c', default=None, help='Cluster name.',
+              shell_complete=_complete_cluster_name)
 @click.option('--dryrun', is_flag=True, default=False)
 @click.option('--detach-run', '-d', is_flag=True, default=False)
 @click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
@@ -174,7 +267,7 @@ def launch(entrypoint, cluster, dryrun, detach_run,
 
 
 @cli.command(name='exec')
-@click.argument('cluster')
+@click.argument('cluster', shell_complete=_complete_cluster_name)
 @click.argument('entrypoint', required=False)
 @click.option('--detach-run', '-d', is_flag=True, default=False)
 @_add_options(_TASK_OPTIONS)
@@ -199,7 +292,7 @@ def exec_cmd(cluster, entrypoint, detach_run, **task_args):
               help='Re-query live cluster status from the provider.')
 @click.option('--verbose', '-v', is_flag=True, default=False,
               help='Show the last launch stage-runtime decomposition.')
-@click.argument('clusters', nargs=-1)
+@click.argument('clusters', nargs=-1, shell_complete=_complete_cluster_name)
 def status(refresh, verbose, clusters):
     """Show clusters."""
     from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
@@ -246,7 +339,7 @@ def _print_table(headers: List[str], rows: List[tuple]) -> None:
 
 
 @cli.command()
-@click.argument('cluster')
+@click.argument('cluster', shell_complete=_complete_cluster_name)
 @click.argument('port', required=False, type=int)
 def endpoints(cluster, port):
     """Show a cluster's exposed port endpoints.
@@ -262,7 +355,8 @@ def endpoints(cluster, port):
 
 
 @cli.command()
-@click.argument('clusters', nargs=-1, required=True)
+@click.argument('clusters', nargs=-1, required=True,
+                shell_complete=_complete_cluster_name)
 @click.option('--yes', '-y', is_flag=True, default=False)
 def stop(clusters, yes):
     """Stop cluster(s) (restartable with `start`)."""
@@ -270,7 +364,8 @@ def stop(clusters, yes):
 
 
 @cli.command()
-@click.argument('clusters', nargs=-1, required=True)
+@click.argument('clusters', nargs=-1, required=True,
+                shell_complete=_complete_cluster_name)
 @click.option('--yes', '-y', is_flag=True, default=False)
 def start(clusters, yes):
     """Restart stopped cluster(s)."""
@@ -278,7 +373,8 @@ def start(clusters, yes):
 
 
 @cli.command()
-@click.argument('clusters', nargs=-1, required=True)
+@click.argument('clusters', nargs=-1, required=True,
+                shell_complete=_complete_cluster_name)
 @click.option('--yes', '-y', is_flag=True, default=False)
 @click.option('--purge', is_flag=True, default=False)
 def down(clusters, yes, purge):
@@ -309,7 +405,7 @@ def _lifecycle(verb: str, clusters, yes: bool, **kwargs) -> None:
 
 
 @cli.command()
-@click.argument('cluster')
+@click.argument('cluster', shell_complete=_complete_cluster_name)
 @click.option('--idle-minutes', '-i', type=int, required=True)
 @click.option('--down', is_flag=True, default=False)
 @click.option('--cancel', is_flag=True, default=False)
@@ -326,7 +422,7 @@ def autostop(cluster, idle_minutes, down, cancel):
 
 
 @cli.command()
-@click.argument('cluster')
+@click.argument('cluster', shell_complete=_complete_cluster_name)
 @click.option('--skip-finished', '-s', is_flag=True, default=False)
 def queue(cluster, skip_finished):
     """Show the cluster's job queue."""
@@ -338,7 +434,7 @@ def queue(cluster, skip_finished):
 
 
 @cli.command()
-@click.argument('cluster')
+@click.argument('cluster', shell_complete=_complete_cluster_name)
 @click.argument('job_id', required=False, type=int)
 @click.option('--no-follow', is_flag=True, default=False)
 def logs(cluster, job_id, no_follow):
@@ -348,7 +444,7 @@ def logs(cluster, job_id, no_follow):
 
 
 @cli.command()
-@click.argument('cluster')
+@click.argument('cluster', shell_complete=_complete_cluster_name)
 @click.argument('job_ids', nargs=-1, type=int)
 @click.option('--all', '-a', 'all_jobs', is_flag=True, default=False)
 @click.option('--yes', '-y', is_flag=True, default=False)
@@ -810,7 +906,10 @@ def catalog_status(cloud):
 
 
 def main() -> None:
-    cli()
+    # Pin the completion trigger var: click otherwise derives it from
+    # the program name, which breaks completion when invoked as
+    # `python -m skypilot_tpu.cli` instead of the `skytpu` script.
+    cli(complete_var='_SKYTPU_COMPLETE')
 
 
 if __name__ == '__main__':

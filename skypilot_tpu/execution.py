@@ -74,12 +74,15 @@ def _execute(
     # denominator (BASELINE.md); every invocation records where its
     # wall-clock went (usage_lib; surfaced by `sky status`).
     from skypilot_tpu import usage_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.utils import rich_utils  # pylint: disable=import-outside-toplevel
     run_rec = usage_lib.RunRecord(
         'launch' if Stage.PROVISION in stages else 'exec', cluster_name)
     try:
         to_provision: Optional[Resources] = None
         if Stage.OPTIMIZE in stages:
-            with run_rec.stage('optimize'):
+            with run_rec.stage('optimize'), rich_utils.safe_status(
+                    'Optimizing resource placement',
+                    enabled=not stream_logs):
                 existing = backend.check_existing_cluster(cluster_name,
                                                           task)
                 if existing is None:
@@ -90,7 +93,9 @@ def _execute(
 
         handle = None
         if Stage.PROVISION in stages:
-            with run_rec.stage('provision'):
+            with run_rec.stage('provision'), rich_utils.safe_status(
+                    f'Launching cluster {cluster_name}',
+                    enabled=not stream_logs):
                 handle = backend.provision(task, to_provision,
                                            dryrun=dryrun,
                                            stream_logs=stream_logs,
@@ -103,17 +108,21 @@ def _execute(
             handle = backend_utils.check_cluster_available(cluster_name)
 
         if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
-            with run_rec.stage('sync_workdir'):
+            with run_rec.stage('sync_workdir'), rich_utils.safe_status(
+                    'Syncing workdir', enabled=not stream_logs):
                 backend.sync_workdir(handle, task.workdir)
 
         if Stage.SYNC_FILE_MOUNTS in stages:
             if task.file_mounts or task.storage_mounts:
-                with run_rec.stage('sync_file_mounts'):
+                with run_rec.stage('sync_file_mounts'), \
+                        rich_utils.safe_status('Syncing file mounts',
+                                               enabled=not stream_logs):
                     backend.sync_file_mounts(handle, task.file_mounts,
                                              task.storage_mounts)
 
         if Stage.SETUP in stages and not no_setup:
-            with run_rec.stage('setup'):
+            with run_rec.stage('setup'), rich_utils.safe_status(
+                    'Running setup', enabled=not stream_logs):
                 backend.setup(handle, task)
 
         if Stage.PRE_EXEC in stages:
@@ -126,7 +135,9 @@ def _execute(
         if Stage.EXEC in stages:
             # exec_submit covers handing the job to the cluster, not
             # the job's own runtime (that is the job's, not ours).
-            with run_rec.stage('exec_submit'):
+            with run_rec.stage('exec_submit'), \
+                    rich_utils.safe_status('Submitting job',
+                                           enabled=not stream_logs):
                 job_id = backend.execute(handle, task,
                                          detach_run=detach_run)
 

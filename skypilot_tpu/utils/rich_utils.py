@@ -1,0 +1,118 @@
+"""Interactive status spinners for long-running CLI operations.
+
+Parity: /root/reference/sky/utils/rich_utils.py (`safe_status`,
+`force_update_status`) — rebuilt dependency-free: a background thread
+animates braille frames on stderr when it is a TTY, and degrades to a
+single log line when piped/redirected (CI, `sky launch | tee`), so
+output stays machine-readable.
+
+Nesting: one live spinner per process; nested `safe_status` calls
+update the message of the outer spinner and restore it on exit, the
+same contract the reference's client_status provides.
+"""
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+from typing import Iterator, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_FRAMES = '⠋⠙⠹⠸⠼⠴⠦⠧⠇⠏'
+_INTERVAL = 0.1
+
+_lock = threading.Lock()
+_active: Optional['_Spinner'] = None
+
+
+class _Spinner:
+
+    def __init__(self, message: str) -> None:
+        self.message = message
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self) -> None:
+        i = 0
+        while not self._stop.wait(_INTERVAL):
+            with _lock:
+                msg = self.message
+            frame = _FRAMES[i % len(_FRAMES)]
+            sys.stderr.write(f'\r\x1b[2K{frame} {msg}')
+            sys.stderr.flush()
+            i += 1
+        sys.stderr.write('\r\x1b[2K')
+        sys.stderr.flush()
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+def _tty() -> bool:
+    try:
+        return sys.stderr.isatty()
+    except (AttributeError, ValueError):
+        return False
+
+
+@contextlib.contextmanager
+def safe_status(message: str, enabled: bool = True) -> Iterator[None]:
+    """Show `message` with a spinner while the block runs.
+
+    TTY: animated line on stderr, cleared on exit.  Non-TTY, or
+    `enabled=False` (callers streaming subprocess logs — a live
+    spinner would rewrite the line their output lands on): one log
+    line, nothing else.
+
+    One spinner per PROCESS: the claim-or-nest decision happens
+    atomically under the module lock, so concurrent `safe_status`
+    blocks (two threads launching different clusters) never start two
+    spinners fighting over stderr — later entrants swap the live
+    spinner's message for their block's duration and restore it.
+    """
+    global _active
+    if not enabled or not _tty():
+        logger.info(message)
+        yield
+        return
+    with _lock:
+        outer = _active
+        if outer is not None:
+            saved = outer.message
+            outer.message = message
+        else:
+            spinner = _Spinner(message)
+            _active = spinner
+    if outer is not None:
+        try:
+            yield
+        finally:
+            with _lock:
+                # The owner may have exited first (cross-thread nest);
+                # only restore a spinner that is still the live one.
+                if _active is outer:
+                    outer.message = saved
+        return
+    spinner.start()
+    try:
+        yield
+    finally:
+        spinner.stop()
+        with _lock:
+            _active = None
+
+
+def force_update_status(message: str) -> None:
+    """Change the live spinner's message (no-op without one)."""
+    with _lock:
+        if _active is not None:
+            _active.message = message
+        else:
+            logger.info(message)
