@@ -28,6 +28,7 @@ _INSTANCE_CSVS = {
     'lambda': 'lambda_instances.csv',
     'local': 'local_instances.csv',
     'oci': 'oci_instances.csv',
+    'runpod': 'runpod_instances.csv',
 }
 _TPU_CSVS = {
     'gcp': 'gcp_tpus.csv',

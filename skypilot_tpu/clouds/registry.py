@@ -16,6 +16,7 @@ from skypilot_tpu.clouds import kubernetes
 from skypilot_tpu.clouds import lambda_cloud
 from skypilot_tpu.clouds import local
 from skypilot_tpu.clouds import oci
+from skypilot_tpu.clouds import runpod
 
 CLOUD_REGISTRY: Dict[str, cloud_lib.Cloud] = {
     'aws': aws.AWS(),
@@ -27,6 +28,7 @@ CLOUD_REGISTRY: Dict[str, cloud_lib.Cloud] = {
     'lambda': lambda_cloud.LambdaCloud(),
     'local': local.Local(),
     'oci': oci.OCI(),
+    'runpod': runpod.RunPod(),
 }
 
 # Aliases accepted by from_str (kept OUT of the registry dict so that

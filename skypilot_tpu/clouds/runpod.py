@@ -1,0 +1,185 @@
+"""RunPod: community GPU pods — a sixth fungible GPU pool.
+
+Parity: /root/reference/sky/clouds/runpod.py:1-280 (feature gates,
+region enumeration, `~/.runpod/config.toml` credential check) —
+rebuilt on RunPod's GraphQL API behind an injectable transport
+(provision/runpod/instance.py) instead of the reference's `runpod`
+SDK.
+
+RunPod is single-node GPU pods: no gang interconnect, no spot market
+via the API, no stop/resume worth relying on for training state (the
+container filesystem survives a stop but the GPU is released and may
+not come back) — the feature gates mirror the reference's honest
+list, so the optimizer only routes single-node, on-demand,
+COPY-storage tasks here.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+CREDENTIALS_PATH = '~/.runpod/config.toml'
+
+
+def read_api_key() -> Optional[str]:
+    """API key from env or the reference-compatible config.toml
+    (`api_key = "<key>"` under any section)."""
+    key = os.environ.get('RUNPOD_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser(CREDENTIALS_PATH)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding='utf-8') as f:
+        for line in f:
+            stripped = line.strip()
+            if stripped.startswith('api_key'):
+                _, _, value = stripped.partition('=')
+                return value.strip().strip('"\'') or None
+    return None
+
+
+class RunPod(cloud_lib.Cloud):
+    _REPR = 'RunPod'
+    PROVISIONER = 'runpod'
+
+    _CLOUD_UNSUPPORTED_FEATURES = {
+        cloud_lib.CloudImplementationFeatures.STOP:
+            'Stopping pods releases the GPU; not supported.',
+        cloud_lib.CloudImplementationFeatures.AUTOSTOP:
+            'No stop support; use autodown.',
+        cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+            'The RunPod API exposes no spot market.',
+        cloud_lib.CloudImplementationFeatures.MULTI_NODE:
+            'No gang interconnect between pods.',
+        cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+            'Disk tier is fixed per pod type.',
+        cloud_lib.CloudImplementationFeatures.STORAGE_MOUNTING:
+            'Object-store mounting is unavailable in pods; use '
+            'mode: COPY.',
+        cloud_lib.CloudImplementationFeatures.CLONE_DISK:
+            'Disk cloning is not implemented for RunPod.',
+        cloud_lib.CloudImplementationFeatures.IMAGE_ID:
+            'Pods boot the framework CUDA image.',
+        # OPEN_PORTS is supported: declared ports are opened AT POD
+        # CREATION (the only time RunPod allows it), which is exactly
+        # when this framework opens ports (ProvisionConfig.
+        # ports_to_open) — so port-declaring tasks are launchable.
+    }
+
+    # ------------------------------------------------------- regions/zones
+
+    def regions_with_offering(self, resources) -> List[cloud_lib.Region]:
+        if resources.tpu_spec is not None or resources.use_spot:
+            return []
+        if resources.instance_type is not None:
+            pairs = catalog.get_region_zones_for_instance_type(
+                'runpod', resources.instance_type, False)
+        else:
+            pairs = []
+        regions: Dict[str, cloud_lib.Region] = {}
+        for region_name, _ in pairs:  # no zones on RunPod
+            if (resources.region is not None and
+                    region_name != resources.region):
+                continue
+            regions.setdefault(region_name, cloud_lib.Region(region_name))
+        return list(regions.values())
+
+    # ------------------------------------------------------------- pricing
+
+    def instance_type_to_hourly_cost(self, instance_type, use_spot, region,
+                                     zone) -> float:
+        return catalog.get_hourly_cost('runpod', instance_type, use_spot,
+                                       region, zone)
+
+    def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
+                                    zone) -> float:
+        del accelerators, use_spot, region, zone
+        return 0.0  # bundled into the pod price
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        del num_gigabytes
+        return 0.0  # RunPod meters no egress
+
+    # -------------------------------------------------------- feasibility
+
+    def get_feasible_launchable_resources(self, resources):
+        fuzzy: List[str] = []
+        if resources.tpu_spec is not None or resources.use_spot:
+            return [], fuzzy
+        if resources.accelerators:
+            acc, count = next(iter(resources.accelerators.items()))
+            instance_types = catalog.get_instance_type_for_accelerator(
+                'runpod', acc, count, resources.cpus, resources.memory,
+                resources.region, resources.zone)
+            if not instance_types:
+                offerings = catalog.list_accelerators(name_filter=acc,
+                                                      clouds=['runpod'])
+                fuzzy.extend(sorted(offerings))
+                return [], fuzzy
+            return [
+                resources.copy(cloud=self, instance_type=instance_types[0])
+            ], fuzzy
+        if resources.instance_type is not None:
+            if catalog.instance_type_exists('runpod',
+                                            resources.instance_type):
+                return [resources.copy(cloud=self)], fuzzy
+            return [], fuzzy
+        default = self.get_default_instance_type(resources.cpus,
+                                                 resources.memory)
+        if default is None:
+            return [], fuzzy
+        return [resources.copy(cloud=self, instance_type=default)], fuzzy
+
+    def get_default_instance_type(self, cpus, memory) -> Optional[str]:
+        return catalog.get_default_instance_type('runpod', cpus, memory)
+
+    def validate_region_zone(self, region, zone):
+        if zone is not None:
+            raise ValueError(
+                'RunPod has no zone placement (region only); '
+                f'got zone={zone!r}.')
+        return catalog.validate_region_zone('runpod', region, None)
+
+    # ------------------------------------------------------------- deploy
+
+    def make_deploy_resources_variables(self, resources, cluster_name,
+                                        region, zones) -> Dict[str, Any]:
+        del zones
+        return {
+            'cluster_name': cluster_name,
+            'region': region.name,
+            'zones': [],
+            'use_spot': False,
+            'labels': dict(resources.labels or {}),
+            'ports': list(resources.ports or []),
+            'disk_size': resources.disk_size,
+            'image_id': None,
+            'tpu': False,
+            'instance_type': resources.instance_type,
+            'num_nodes': 1,
+        }
+
+    # --------------------------------------------------------- credentials
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        if read_api_key():
+            return True, None
+        return False, (f'RunPod API key not found. Put `api_key = "..."` '
+                       f'in {CREDENTIALS_PATH} or set RUNPOD_API_KEY.')
+
+    def get_current_user_identity(self) -> Optional[List[str]]:
+        key = read_api_key()
+        return [f'runpod:{key[:8]}'] if key else None
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        if os.path.exists(os.path.expanduser(CREDENTIALS_PATH)):
+            return {CREDENTIALS_PATH: CREDENTIALS_PATH}
+        return {}

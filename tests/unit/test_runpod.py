@@ -1,0 +1,241 @@
+"""RunPod cloud + GraphQL provisioner (cloud breadth).  The API sits
+behind an injectable transport (provision/runpod/instance.py:
+set_api_runner), so the pod lifecycle — deploy, ssh port-mapping
+discovery, status map, terminate — runs without credentials or
+network.  Model: tests/unit/test_lambda_cloud.py."""
+from __future__ import annotations
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.runpod import instance as runpod_instance
+
+
+class FakeRunpodApi:
+    """Minimal GraphQL account state machine."""
+
+    def __init__(self):
+        self.pods = {}     # id -> pod dict (myself{pods} shape)
+        self.calls = []
+        self._next = 0
+        self.no_capacity = False
+
+    def __call__(self, query, variables):
+        self.calls.append((query, variables))
+        if 'myself' in query and 'pods' in query:
+            return 200, {'data': {'myself': {
+                'pods': list(self.pods.values())}}}
+        if 'podFindAndDeployOnDemand' in query:
+            if self.no_capacity:
+                return 200, {'errors': [
+                    {'message': 'There are no longer any instances '
+                                'available with the requested '
+                                'specifications.'}]}
+            inp = variables['input']
+            pid = f'pod-{self._next:04d}'
+            self._next += 1
+            self.pods[pid] = {
+                'id': pid,
+                'name': inp['name'],
+                'desiredStatus': 'RUNNING',
+                'machine': {'podHostId': f'host{self._next}'},
+                'runtime': {'ports': [
+                    {'ip': f'194.1.0.{self._next}', 'isIpPublic': True,
+                     'privatePort': 22, 'publicPort': 10022 + self._next},
+                    {'ip': '10.4.0.9', 'isIpPublic': False,
+                     'privatePort': 8000, 'publicPort': 8000},
+                ]},
+                '_input': inp,
+            }
+            return 200, {'data': {'podFindAndDeployOnDemand':
+                                  {'id': pid, 'name': inp['name']}}}
+        if 'podTerminate' in query:
+            self.pods.pop(variables['input']['podId'], None)
+            return 200, {'data': {'podTerminate': None}}
+        return 404, {'errors': [{'message': f'unhandled: {query[:40]}'}]}
+
+
+@pytest.fixture
+def fake_api():
+    api = FakeRunpodApi()
+    runpod_instance.set_api_runner(api)
+    yield api
+    runpod_instance.set_api_runner(None)
+
+
+def _config(cluster='rpc', itype='NVIDIA A100 80GB PCIe:1', count=1,
+            ports=None):
+    return provision_common.ProvisionConfig(
+        provider_name='runpod', cluster_name=cluster, region='US',
+        zones=[], deploy_vars={'instance_type': itype, 'disk_size': 64},
+        count=count, ports_to_open=ports or [])
+
+
+class TestProvisionLifecycle:
+
+    def test_deploy_query_info_terminate(self, fake_api):
+        record = runpod_instance.run_instances(_config(ports=[8000]))
+        assert record.provider_name == 'runpod'
+        assert len(record.created_instance_ids) == 1
+        pod = next(iter(fake_api.pods.values()))
+        assert pod['_input']['gpuTypeId'] == 'NVIDIA A100 80GB PCIe'
+        assert pod['_input']['gpuCount'] == 1
+        # Declared ports + ssh ride the creation call (launch-only).
+        assert pod['_input']['ports'] == '22/tcp,8000/tcp'
+        assert pod['_input']['env'][0]['key'] == 'PUBLIC_KEY'
+
+        status = runpod_instance.query_instances('rpc')
+        assert list(status.values())[0].value == 'UP'
+
+        info = runpod_instance.get_cluster_info('rpc')
+        assert info.ssh_user == 'root'
+        # SSH goes through the proxy mapping for private port 22.
+        assert info.instances[0].ssh_port > 10022
+        assert info.instances[0].external_ip.startswith('194.')
+        runners = runpod_instance.get_command_runners(info)
+        assert runners[0].node[1] == info.instances[0].ssh_port
+
+        runpod_instance.terminate_instances('rpc')
+        assert runpod_instance.query_instances('rpc') == {}
+
+    def test_idempotent_relaunch(self, fake_api):
+        runpod_instance.run_instances(_config())
+        record = runpod_instance.run_instances(_config())
+        assert record.created_instance_ids == []
+        assert len(fake_api.pods) == 1
+
+    def test_community_tier_matches_catalog_prices(self, fake_api):
+        """The optimizer priced COMMUNITY rates; deploying SECURE would
+        bill above the cost decision."""
+        runpod_instance.run_instances(_config())
+        pod = next(iter(fake_api.pods.values()))
+        assert pod['_input']['cloudType'] == 'COMMUNITY'
+
+    def test_dead_pod_swept_and_redeployed(self, fake_api):
+        """Pods persist after their container exits and cannot resume:
+        relaunch must terminate the corpse and deploy fresh, not
+        return it (review finding: 600s opaque hang)."""
+        runpod_instance.run_instances(_config())
+        old_id = next(iter(fake_api.pods))
+        fake_api.pods[old_id]['desiredStatus'] = 'EXITED'
+        record = runpod_instance.run_instances(_config())
+        assert len(record.created_instance_ids) == 1
+        assert record.created_instance_ids[0] != old_id
+        assert old_id not in fake_api.pods
+
+    def test_wait_fails_fast_on_dead_pod(self, fake_api):
+        runpod_instance.run_instances(_config())
+        pod = next(iter(fake_api.pods.values()))
+        pod['desiredStatus'] = 'EXITED'
+        import time
+        start = time.time()
+        with pytest.raises(exceptions.ProvisionError,
+                           match='died while waiting'):
+            runpod_instance.wait_instances('rpc')
+        assert time.time() - start < 30
+
+    def test_port_declaring_task_is_launchable(self):
+        """OPEN_PORTS is satisfied at pod creation, so the provision-
+        time feature check (slice_backend) must accept a port-declaring
+        task on RunPod (review finding: the gate made the port wiring
+        dead code — this asserts the exact gate path)."""
+        rp = registry.CLOUD_REGISTRY['runpod']
+        r = sky.Resources(cloud='runpod', accelerators='H100:1',
+                          ports=[8000])
+        feats = r.get_required_cloud_features()
+        from skypilot_tpu.clouds import cloud as cloud_lib
+        assert cloud_lib.CloudImplementationFeatures.OPEN_PORTS in feats
+        rp.check_features_are_supported(r, feats)  # must not raise
+
+    def test_multinode_rejected(self, fake_api):
+        with pytest.raises(exceptions.ProvisionError,
+                           match='single-node'):
+            runpod_instance.run_instances(_config(count=2))
+
+    def test_no_capacity_surfaces(self, fake_api):
+        fake_api.no_capacity = True
+        with pytest.raises(exceptions.ProvisionError,
+                           match='no longer any instances'):
+            runpod_instance.run_instances(_config())
+
+    def test_stop_and_ports_rejected(self, fake_api):
+        runpod_instance.run_instances(_config())
+        with pytest.raises(exceptions.NotSupportedError):
+            runpod_instance.stop_instances('rpc')
+        with pytest.raises(exceptions.NotSupportedError):
+            runpod_instance.open_ports('rpc', [9000])
+
+    def test_status_map(self, fake_api):
+        runpod_instance.run_instances(_config())
+        pod = next(iter(fake_api.pods.values()))
+        from skypilot_tpu.status_lib import ClusterStatus
+        for api_status, want in [('RUNNING', ClusterStatus.UP),
+                                 ('CREATED', ClusterStatus.INIT),
+                                 ('EXITED', ClusterStatus.STOPPED),
+                                 ('TERMINATED', None)]:
+            pod['desiredStatus'] = api_status
+            assert runpod_instance.query_instances('rpc') == {
+                pod['id']: want}
+
+
+class TestRunPodCloud:
+
+    def test_feasibility_gpu_to_instance_type(self):
+        rp = registry.CLOUD_REGISTRY['runpod']
+        r = sky.Resources(cloud='runpod', accelerators='H100:1')
+        launchable, _ = rp.get_feasible_launchable_resources(r)
+        assert launchable
+        assert launchable[0].instance_type == 'NVIDIA H100 PCIe:1'
+
+    def test_tpu_spot_multinode_gated(self):
+        from skypilot_tpu.clouds import cloud as cloud_lib
+        rp = registry.CLOUD_REGISTRY['runpod']
+        assert rp.get_feasible_launchable_resources(
+            sky.Resources(accelerators='tpu-v5e-8'))[0] == []
+        spot = sky.Resources(cloud='runpod', accelerators='H100:1',
+                             capacity='spot')
+        assert rp.get_feasible_launchable_resources(spot)[0] == []
+        with pytest.raises(exceptions.NotSupportedError):
+            rp.check_features_are_supported(
+                sky.Resources(cloud='runpod'),
+                {cloud_lib.CloudImplementationFeatures.MULTI_NODE})
+        with pytest.raises(exceptions.NotSupportedError):
+            rp.check_features_are_supported(
+                sky.Resources(cloud='runpod'),
+                {cloud_lib.CloudImplementationFeatures.STORAGE_MOUNTING})
+
+    def test_pricing(self):
+        assert catalog.get_hourly_cost(
+            'runpod', 'NVIDIA A100 80GB PCIe:1') == pytest.approx(1.64)
+
+    def test_credentials_from_toml(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('HOME', str(tmp_path))
+        monkeypatch.delenv('RUNPOD_API_KEY', raising=False)
+        rp = registry.CLOUD_REGISTRY['runpod']
+        ok, reason = rp.check_credentials()
+        assert not ok and 'config.toml' in reason
+        cfg = tmp_path / '.runpod'
+        cfg.mkdir()
+        (cfg / 'config.toml').write_text(
+            '[default]\napi_key = "rk-abc123def"\n')
+        ok, _ = rp.check_credentials()
+        assert ok
+        assert rp.get_current_user_identity() == ['runpod:rk-abc12']
+
+    def test_cheapest_a100_pool_is_runpod(self, enable_all_infra):
+        """RunPod's community A100 undercuts every other pool."""
+        from skypilot_tpu import optimizer as optimizer_lib
+        from skypilot_tpu.utils import dag_utils
+        task = sky.Task(name='t', run='true')
+        task.set_resources({
+            sky.Resources(cloud=c, accelerators='A100-80GB:1')
+            for c in ('azure', 'runpod')
+        })
+        dag = dag_utils.convert_entrypoint_to_dag(task)
+        optimizer_lib.Optimizer.optimize(
+            dag, minimize=optimizer_lib.OptimizeTarget.COST, quiet=True)
+        assert str(task.best_resources.cloud).lower() == 'runpod'
