@@ -24,10 +24,12 @@ TpuOffering = common.TpuOffering
 _INSTANCE_CSVS = {
     'aws': 'aws_instances.csv',
     'azure': 'azure_instances.csv',
+    'cudo': 'cudo_instances.csv',
     'gcp': 'gcp_instances.csv',
     'lambda': 'lambda_instances.csv',
     'local': 'local_instances.csv',
     'oci': 'oci_instances.csv',
+    'paperspace': 'paperspace_instances.csv',
     'runpod': 'runpod_instances.csv',
 }
 _TPU_CSVS = {

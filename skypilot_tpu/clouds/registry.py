@@ -9,6 +9,7 @@ from typing import Dict, Optional
 from skypilot_tpu.clouds import aws
 from skypilot_tpu.clouds import azure
 from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.clouds import cudo
 from skypilot_tpu.clouds import docker
 from skypilot_tpu.clouds import gcp
 from skypilot_tpu.clouds import gke
@@ -16,11 +17,13 @@ from skypilot_tpu.clouds import kubernetes
 from skypilot_tpu.clouds import lambda_cloud
 from skypilot_tpu.clouds import local
 from skypilot_tpu.clouds import oci
+from skypilot_tpu.clouds import paperspace
 from skypilot_tpu.clouds import runpod
 
 CLOUD_REGISTRY: Dict[str, cloud_lib.Cloud] = {
     'aws': aws.AWS(),
     'azure': azure.Azure(),
+    'cudo': cudo.Cudo(),
     'docker': docker.Docker(),
     'gcp': gcp.GCP(),
     'gke': gke.GKE(),
@@ -28,6 +31,7 @@ CLOUD_REGISTRY: Dict[str, cloud_lib.Cloud] = {
     'lambda': lambda_cloud.LambdaCloud(),
     'local': local.Local(),
     'oci': oci.OCI(),
+    'paperspace': paperspace.Paperspace(),
     'runpod': runpod.RunPod(),
 }
 

@@ -1,0 +1,1 @@
+"""Cudo Compute provisioner package."""

@@ -1,0 +1,313 @@
+"""Cudo Compute provisioner: REST API with an injectable transport.
+
+Parity: /root/reference/sky/provision/cudo/ (+ cudo_wrapper, ~500 LoC
+of cudo-compute SDK calls) — rebuilt on the public REST endpoint
+behind `set_api_runner`, the same no-SDK seam as
+provision/lambda_cloud and provision/paperspace.
+
+API surface used (https://rest.compute.cudo.org/v1, project-scoped):
+  GET    /projects/{p}/vms                    list
+  POST   /projects/{p}/vm                     create {vmId,
+                                              dataCenterId, machineType,
+                                              gpus, bootDisk,
+                                              customSshKeys, ...}
+  POST   /projects/{p}/vms/{id}/start|stop    power actions
+  POST   /projects/{p}/vms/{id}/terminate     delete
+
+VMs are named (vmId) `<cluster>-<rank>`; recovery lists the project
+and filters by the prefix.  Stop/start is real (disk persists).  Gang
+semantics: N individual creates, all-or-nothing sweep on failure.
+The project comes from `cudo.project_id` in the layered config or
+CUDO_PROJECT_ID.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.utils import command_runner
+
+logger = sky_logging.init_logger(__name__)
+
+_API_BASE = 'https://rest.compute.cudo.org/v1'
+DEFAULT_SSH_USER = 'root'
+_IMAGE = 'ubuntu-2204-nvidia-535-docker-v20240214'
+
+# Transport seam: runner(method, path, payload|None) -> (status, dict).
+ApiRunner = Callable[[str, str, Optional[Dict[str, Any]]],
+                     Tuple[int, Dict[str, Any]]]
+
+
+def _default_api_runner(method: str, path: str,
+                        payload: Optional[Dict[str, Any]]
+                        ) -> Tuple[int, Dict[str, Any]]:
+    from skypilot_tpu.clouds import cudo as cudo_cloud  # pylint: disable=import-outside-toplevel
+    key = cudo_cloud.read_api_key()
+    if not key:
+        raise exceptions.ProvisionError(
+            'Cudo API key not found (see `sky check`).')
+    req = urllib.request.Request(
+        _API_BASE + path,
+        data=(json.dumps(payload).encode()
+              if payload is not None else None),
+        headers={'Authorization': f'Bearer {key}',
+                 'Content-Type': 'application/json'},
+        method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, json.loads(resp.read() or b'{}')
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read() or b'{}')
+        except ValueError:
+            body = {}
+        return e.code, body
+
+
+_api_runner: ApiRunner = _default_api_runner
+
+
+def set_api_runner(runner: Optional[ApiRunner]) -> None:
+    """Inject a fake Cudo API for tests (None restores the real one)."""
+    global _api_runner
+    _api_runner = runner or _default_api_runner
+
+
+def _api(method: str, path: str,
+         payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    status, body = _api_runner(method, path, payload)
+    if status >= 400:
+        raise exceptions.ProvisionError(
+            f'Cudo API {method} {path} failed ({status}): '
+            f'{body.get("message", body)}')
+    return body
+
+
+def _project() -> str:
+    project = os.environ.get('CUDO_PROJECT_ID')
+    if not project:
+        from skypilot_tpu import config as config_lib  # pylint: disable=import-outside-toplevel
+        project = config_lib.get_nested(('cudo', 'project_id'), None)
+    if not project:
+        raise exceptions.ProvisionError(
+            'Cudo project not configured: set cudo.project_id in '
+            '~/.skytpu/config.yaml or CUDO_PROJECT_ID.')
+    return project
+
+
+def _vm_rank(vm: Dict[str, Any]) -> int:
+    return int(vm['id'].rsplit('-', 1)[-1])
+
+
+def _is_ours(vm_id: str, cluster_name: str) -> bool:
+    """`<cluster>-<digits>` exactly: a user's hand-made VM named
+    '<cluster>-head' in the same project must not crash (or be
+    swept by) our lifecycle ops."""
+    prefix, _, rank = vm_id.rpartition('-')
+    return prefix == cluster_name and rank.isdigit()
+
+
+def _list_vms(cluster_name: str) -> List[Dict[str, Any]]:
+    body = _api('GET', f'/projects/{_project()}/vms')
+    vms = body.get('VMs', body.get('vms', []))
+    mine = [vm for vm in vms if _is_ours(vm.get('id', ''), cluster_name)]
+    return sorted(mine, key=_vm_rank)
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    cluster_name = config.cluster_name
+    deploy_vars = config.deploy_vars
+    instance_type = deploy_vars.get('instance_type')
+    if not instance_type:
+        raise exceptions.ProvisionError(
+            'Cudo provisioning needs an instance_type (TPUs live on '
+            'GCP).')
+    count = config.count
+    project = _project()
+
+    existing = _list_vms(cluster_name)
+    created: List[str] = []
+    resumed: List[str] = []
+    if existing:
+        if len(existing) != count:
+            raise exceptions.ResourcesMismatchError(
+                f'Cluster {cluster_name} exists with {len(existing)} '
+                f'VMs; requested {count}.')
+        stopped = [vm['id'] for vm in existing
+                   if vm.get('state') in ('STOPPED', 'STOPPING')]
+        for vid in stopped:
+            _api('POST', f'/projects/{project}/vms/{vid}/start')
+        resumed = stopped
+    else:
+        from skypilot_tpu import authentication  # pylint: disable=import-outside-toplevel
+        _, public_key_path = authentication.get_or_generate_keys()
+        with open(public_key_path, encoding='utf-8') as f:
+            public_key = f.read().strip()
+        # Catalog instance types are '<machineType>:<gpu count>'.
+        machine_type, _, gpus = instance_type.rpartition(':')
+        try:
+            for rank in range(count):
+                _api('POST', f'/projects/{project}/vm', {
+                    'vmId': f'{cluster_name}-{rank}',
+                    'dataCenterId': config.region,
+                    'machineType': machine_type,
+                    'gpus': int(gpus or 0),
+                    'bootDiskImageId': _IMAGE,
+                    'bootDisk': {
+                        'sizeGib':
+                            int(deploy_vars.get('disk_size') or 100)},
+                    'customSshKeys': [public_key],
+                })
+                created.append(f'{cluster_name}-{rank}')
+        except exceptions.ProvisionError:
+            # All-or-nothing gang: sweep the partial set.  Best-effort
+            # per VM — a sweep failure must not mask the original
+            # create error or strand later VMs unswept.
+            for vid in created:
+                try:
+                    _api('POST',
+                         f'/projects/{project}/vms/{vid}/terminate',
+                         {})
+                except exceptions.ProvisionError as e:
+                    logger.warning(
+                        f'Sweep of partial VM {vid} failed: {e}')
+            raise
+    head = existing[0]['id'] if existing else created[0]
+    return common.ProvisionRecord(
+        provider_name='cudo', cluster_name=cluster_name,
+        region=config.region, zone=None, head_instance_id=head,
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def wait_instances(cluster_name: str, state: Optional[str] = None) -> None:
+    want = state or 'ACTIVE'
+    deadline = time.time() + 900
+    while time.time() < deadline:
+        vms = _list_vms(cluster_name)
+        if vms and all(vm.get('state') == want for vm in vms):
+            return
+        bad = [vm['id'] for vm in vms
+               if vm.get('state') in ('FAILED', 'DELETED')]
+        if bad:
+            raise exceptions.ProvisionError(
+                f'VMs {bad} of {cluster_name} failed while '
+                'provisioning.')
+        time.sleep(10)
+    raise exceptions.ProvisionError(
+        f'VMs of {cluster_name} did not reach {want!r} in 900s.')
+
+
+def wait_capacity(cluster_name: str, timeout: float = 0) -> bool:
+    del cluster_name, timeout
+    return True
+
+
+def stop_instances(cluster_name: str, worker_only: bool = False) -> None:
+    project = _project()
+    for vm in _list_vms(cluster_name):
+        if worker_only and _vm_rank(vm) == 0:
+            continue
+        _api('POST', f'/projects/{project}/vms/{vm["id"]}/stop')
+
+
+def terminate_instances(cluster_name: str,
+                        worker_only: bool = False) -> None:
+    project = _project()
+    for vm in _list_vms(cluster_name):
+        if worker_only and _vm_rank(vm) == 0:
+            continue
+        _api('POST', f'/projects/{project}/vms/{vm["id"]}/terminate',
+             {})
+
+
+# Every live Cudo state must map to SOMETHING: the status layer treats
+# None as 'instance gone' and an all-None cluster as vanished (record
+# removed) — only DELETING/DELETED may read as gone.
+_STATE_MAP = {
+    'ACTIVE': ClusterStatus.UP,
+    'PENDING': ClusterStatus.INIT,
+    'BOOTING': ClusterStatus.INIT,
+    'STARTING': ClusterStatus.INIT,
+    'RECREATING': ClusterStatus.INIT,
+    'FAILED': ClusterStatus.INIT,  # exists + needs manual sweep
+    'STOPPING': ClusterStatus.STOPPED,
+    'STOPPED': ClusterStatus.STOPPED,
+}
+
+
+def query_instances(cluster_name: str
+                    ) -> Dict[str, Optional[ClusterStatus]]:
+    return {
+        vm['id']: _STATE_MAP.get(vm.get('state'))
+        for vm in _list_vms(cluster_name)
+    }
+
+
+def get_cluster_info(cluster_name: str,
+                     region: Optional[str] = None) -> common.ClusterInfo:
+    vms = [vm for vm in _list_vms(cluster_name)
+           if vm.get('state') == 'ACTIVE']
+    if not vms:
+        raise exceptions.FetchClusterInfoError(
+            exceptions.FetchClusterInfoError.Reason.HEAD)
+    infos = []
+    for vm in vms:
+        rank = _vm_rank(vm)
+        nic = (vm.get('nics') or [{}])[0]
+        infos.append(
+            common.InstanceInfo(
+                instance_id=vm['id'],
+                internal_ip=nic.get('internalIpAddress', ''),
+                external_ip=nic.get('externalIpAddress'),
+                ssh_port=22,
+                slice_id=0,
+                worker_id=rank,
+                tags={'rank': str(rank)},
+            ))
+    from skypilot_tpu import authentication  # pylint: disable=import-outside-toplevel
+    private_key, _ = authentication.get_or_generate_keys()
+    return common.ClusterInfo(
+        provider_name='cudo',
+        cluster_name=cluster_name,
+        region=region or '',
+        zone=None,
+        instances=infos,
+        head_instance_id=infos[0].instance_id,
+        ssh_user=DEFAULT_SSH_USER,
+        ssh_private_key=private_key,
+    )
+
+
+def open_ports(cluster_name: str, ports: List[int]) -> None:
+    # Network-level security groups only; the cloud layer gates
+    # OPEN_PORTS so reaching this is a bug.
+    raise exceptions.NotSupportedError(
+        f'Cudo has no per-instance port API (requested {ports}).')
+
+
+def cleanup_ports(cluster_name: str) -> None:
+    del cluster_name
+
+
+def get_command_runners(cluster_info: common.ClusterInfo,
+                        **kwargs: Any) -> List[command_runner.CommandRunner]:
+    del kwargs
+    runners: List[command_runner.CommandRunner] = []
+    for inst in cluster_info.instances:
+        ip = inst.external_ip or inst.internal_ip
+        runners.append(
+            command_runner.SSHCommandRunner(
+                node=(ip, inst.ssh_port),
+                ssh_user=cluster_info.ssh_user,
+                ssh_private_key=cluster_info.ssh_private_key,
+                ssh_control_name=cluster_info.cluster_name,
+            ))
+    return runners

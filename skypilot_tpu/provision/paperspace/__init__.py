@@ -1,0 +1,1 @@
+"""Paperspace provisioner package."""

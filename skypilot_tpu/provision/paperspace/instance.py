@@ -1,0 +1,328 @@
+"""Paperspace provisioner: machines REST API with an injectable
+transport.
+
+Parity: /root/reference/sky/provision/paperspace/ (+ utils.py, ~600
+LoC of requests calls) — rebuilt on the public v1 machines API behind
+`set_api_runner`, the same no-SDK seam as provision/lambda_cloud.
+
+API surface used (https://api.paperspace.com/v1):
+  GET    /machines?name=...          list (machines carry name,
+                                     state, publicIp, privateIp)
+  POST   /machines                   create {name, machineType,
+                                     templateId, region, diskSize,
+                                     publicIpType, startupScript}
+  PATCH  /machines/:id/start|stop    power actions
+  DELETE /machines/:id               terminate
+
+Machines are named `<cluster>-<rank>`; recovery lists by the cluster
+name prefix.  Stop/start is REAL here (billing pauses, disk persists)
+so autostop works; gang semantics: N individual creates with an
+all-or-nothing sweep on failure.  The startup script installs our ssh
+public key for the `paperspace` user.
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.utils import command_runner
+
+logger = sky_logging.init_logger(__name__)
+
+_API_BASE = 'https://api.paperspace.com/v1'
+DEFAULT_SSH_USER = 'paperspace'
+_TEMPLATE = 'tpnvqkjn'  # ML-in-a-Box Ubuntu 22.04
+_DISK_TIERS = (50, 100, 250, 500, 1000, 2000)  # the only valid sizes
+
+
+def _disk_tier(size_gb: int) -> int:
+    """Round up to Paperspace's fixed disk tiers (a raw 256 — the
+    framework default — would 400 on create)."""
+    for tier in _DISK_TIERS:
+        if size_gb <= tier:
+            return tier
+    return _DISK_TIERS[-1]
+
+# Transport seam: runner(method, path, payload|None) -> (status, dict).
+ApiRunner = Callable[[str, str, Optional[Dict[str, Any]]],
+                     Tuple[int, Dict[str, Any]]]
+
+
+def _default_api_runner(method: str, path: str,
+                        payload: Optional[Dict[str, Any]]
+                        ) -> Tuple[int, Dict[str, Any]]:
+    from skypilot_tpu.clouds import paperspace as ps_cloud  # pylint: disable=import-outside-toplevel
+    key = ps_cloud.read_api_key()
+    if not key:
+        raise exceptions.ProvisionError(
+            'Paperspace API key not found (see `sky check`).')
+    req = urllib.request.Request(
+        _API_BASE + path,
+        data=(json.dumps(payload).encode()
+              if payload is not None else None),
+        headers={'Authorization': f'Bearer {key}',
+                 'Content-Type': 'application/json'},
+        method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, json.loads(resp.read() or b'{}')
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read() or b'{}')
+        except ValueError:
+            body = {}
+        return e.code, body
+
+
+_api_runner: ApiRunner = _default_api_runner
+
+
+def set_api_runner(runner: Optional[ApiRunner]) -> None:
+    """Inject a fake Paperspace API for tests (None restores the real
+    one)."""
+    global _api_runner
+    _api_runner = runner or _default_api_runner
+
+
+def _api(method: str, path: str,
+         payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    status, body = _api_runner(method, path, payload)
+    if status >= 400:
+        raise exceptions.ProvisionError(
+            f'Paperspace API {method} {path} failed ({status}): '
+            f'{body.get("message", body)}')
+    return body
+
+
+def _machine_rank(machine: Dict[str, Any]) -> int:
+    return int(machine['name'].rsplit('-', 1)[-1])
+
+
+def _is_ours(name: str, cluster_name: str) -> bool:
+    """`<cluster>-<digits>` exactly: a user's hand-made machine named
+    '<cluster>-head' must not crash (or be terminated by) our
+    lifecycle ops."""
+    prefix, _, rank = name.rpartition('-')
+    return prefix == cluster_name and rank.isdigit()
+
+
+def _list_machines(cluster_name: str) -> List[Dict[str, Any]]:
+    # No server-side name filter: Paperspace's ?name= is an EXACT
+    # match, and machines are named `<cluster>-<rank>` — filtering
+    # client-side over all pages is the correct recovery listing.
+    items: List[Dict[str, Any]] = []
+    after: Optional[str] = None
+    while True:
+        path = '/machines'
+        if after:
+            path += '?' + urllib.parse.urlencode({'after': after})
+        body = _api('GET', path)
+        if isinstance(body, list):
+            items.extend(body)
+            break
+        items.extend(body.get('items', []))
+        if not body.get('hasMore') or not body.get('nextPage'):
+            break
+        after = body['nextPage']
+    mine = [m for m in items
+            if _is_ours(m.get('name', ''), cluster_name)]
+    return sorted(mine, key=_machine_rank)
+
+
+def _startup_script() -> str:
+    from skypilot_tpu import authentication  # pylint: disable=import-outside-toplevel
+    _, public_key_path = authentication.get_or_generate_keys()
+    with open(public_key_path, encoding='utf-8') as f:
+        public_key = f.read().strip()
+    return ('mkdir -p ~paperspace/.ssh && '
+            f'echo {json.dumps(public_key)} >> '
+            '~paperspace/.ssh/authorized_keys')
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    cluster_name = config.cluster_name
+    instance_type = config.deploy_vars.get('instance_type')
+    if not instance_type:
+        raise exceptions.ProvisionError(
+            'Paperspace provisioning needs an instance_type (TPUs '
+            'live on GCP).')
+    count = config.count
+
+    existing = _list_machines(cluster_name)
+    created: List[str] = []
+    resumed: List[str] = []
+    if existing:
+        if len(existing) != count:
+            raise exceptions.ResourcesMismatchError(
+                f'Cluster {cluster_name} exists with {len(existing)} '
+                f'machines; requested {count}.')
+        stopped = [m['id'] for m in existing
+                   if m.get('state') in ('off', 'stopping')]
+        for mid in stopped:
+            _api('PATCH', f'/machines/{mid}/start')
+        resumed = stopped
+    else:
+        script = _startup_script()
+        try:
+            for rank in range(count):
+                body = _api('POST', '/machines', {
+                    'name': f'{cluster_name}-{rank}',
+                    'machineType': instance_type,
+                    'templateId': _TEMPLATE,
+                    'region': config.region,
+                    'diskSize': _disk_tier(
+                        int(config.deploy_vars.get('disk_size') or 100)),
+                    'publicIpType': 'dynamic',
+                    'startupScript': script,
+                })
+                machine = body.get('data', body)
+                created.append(machine['id'])
+        except exceptions.ProvisionError:
+            # All-or-nothing gang: sweep the partial set.  Best-effort
+            # per machine — a sweep failure (e.g. the same rate limit
+            # that broke the create) must not mask the original error
+            # or strand later machines unswept.
+            for mid in created:
+                try:
+                    _api('DELETE', f'/machines/{mid}')
+                except exceptions.ProvisionError as e:
+                    logger.warning(
+                        f'Sweep of partial machine {mid} failed: {e}')
+            raise
+    head = existing[0]['id'] if existing else created[0]
+    return common.ProvisionRecord(
+        provider_name='paperspace', cluster_name=cluster_name,
+        region=config.region, zone=None, head_instance_id=head,
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def wait_instances(cluster_name: str, state: Optional[str] = None) -> None:
+    want = state or 'ready'
+    deadline = time.time() + 900
+    while time.time() < deadline:
+        machines = _list_machines(cluster_name)
+        if machines and all(m.get('state') == want for m in machines):
+            return
+        bad = [m['id'] for m in machines
+               if m.get('state') in ('error', 'restarting')]
+        if bad:
+            raise exceptions.ProvisionError(
+                f'Machines {bad} of {cluster_name} errored while '
+                'provisioning.')
+        time.sleep(10)
+    raise exceptions.ProvisionError(
+        f'Machines of {cluster_name} did not reach {want!r} in 900s.')
+
+
+def wait_capacity(cluster_name: str, timeout: float = 0) -> bool:
+    del cluster_name, timeout
+    return True
+
+
+def stop_instances(cluster_name: str, worker_only: bool = False) -> None:
+    for machine in _list_machines(cluster_name):
+        if worker_only and _machine_rank(machine) == 0:
+            continue
+        _api('PATCH', f'/machines/{machine["id"]}/stop')
+
+
+def terminate_instances(cluster_name: str,
+                        worker_only: bool = False) -> None:
+    for machine in _list_machines(cluster_name):
+        if worker_only and _machine_rank(machine) == 0:
+            continue
+        _api('DELETE', f'/machines/{machine["id"]}')
+
+
+# Every live Paperspace state must map to SOMETHING: the status layer
+# treats None as 'instance gone' and an all-None cluster as vanished
+# (record removed) — a machine mid-'restarting' must never read as
+# deleted while it keeps billing.
+_STATE_MAP = {
+    'ready': ClusterStatus.UP,
+    'serviceready': ClusterStatus.INIT,
+    'provisioning': ClusterStatus.INIT,
+    'starting': ClusterStatus.INIT,
+    'restarting': ClusterStatus.INIT,
+    'upgrading': ClusterStatus.INIT,
+    'error': ClusterStatus.INIT,  # exists + billing; never 'gone'
+    'stopping': ClusterStatus.STOPPED,
+    'off': ClusterStatus.STOPPED,
+}
+
+
+def query_instances(cluster_name: str
+                    ) -> Dict[str, Optional[ClusterStatus]]:
+    return {
+        m['id']: _STATE_MAP.get(m.get('state'))
+        for m in _list_machines(cluster_name)
+    }
+
+
+def get_cluster_info(cluster_name: str,
+                     region: Optional[str] = None) -> common.ClusterInfo:
+    machines = [m for m in _list_machines(cluster_name)
+                if m.get('state') == 'ready']
+    if not machines:
+        raise exceptions.FetchClusterInfoError(
+            exceptions.FetchClusterInfoError.Reason.HEAD)
+    infos = []
+    for machine in machines:
+        rank = _machine_rank(machine)
+        infos.append(
+            common.InstanceInfo(
+                instance_id=machine['id'],
+                internal_ip=machine.get('privateIp') or
+                machine.get('publicIp', ''),
+                external_ip=machine.get('publicIp'),
+                ssh_port=22,
+                slice_id=0,
+                worker_id=rank,
+                tags={'rank': str(rank)},
+            ))
+    from skypilot_tpu import authentication  # pylint: disable=import-outside-toplevel
+    private_key, _ = authentication.get_or_generate_keys()
+    return common.ClusterInfo(
+        provider_name='paperspace',
+        cluster_name=cluster_name,
+        region=region or (machines[0].get('region') or ''),
+        zone=None,
+        instances=infos,
+        head_instance_id=infos[0].instance_id,
+        ssh_user=DEFAULT_SSH_USER,
+        ssh_private_key=private_key,
+    )
+
+
+def open_ports(cluster_name: str, ports: List[int]) -> None:
+    del cluster_name, ports
+    # Paperspace machines have no per-port firewall API; the dynamic
+    # public IP is open.  Nothing to do.
+
+
+def cleanup_ports(cluster_name: str) -> None:
+    del cluster_name
+
+
+def get_command_runners(cluster_info: common.ClusterInfo,
+                        **kwargs: Any) -> List[command_runner.CommandRunner]:
+    del kwargs
+    runners: List[command_runner.CommandRunner] = []
+    for inst in cluster_info.instances:
+        ip = inst.external_ip or inst.internal_ip
+        runners.append(
+            command_runner.SSHCommandRunner(
+                node=(ip, inst.ssh_port),
+                ssh_user=cluster_info.ssh_user,
+                ssh_private_key=cluster_info.ssh_private_key,
+                ssh_control_name=cluster_info.cluster_name,
+            ))
+    return runners

@@ -1,0 +1,190 @@
+"""Cudo Compute cloud + REST provisioner (cloud breadth).  The API
+sits behind an injectable transport (provision/cudo/instance.py:
+set_api_runner); project-scoped like OCI's compartment.  Model:
+tests/unit/test_paperspace.py."""
+from __future__ import annotations
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.cudo import instance as cudo_instance
+
+
+class FakeCudoApi:
+    """Minimal project-scoped VM state machine."""
+
+    def __init__(self):
+        self.vms = {}        # vmId -> vm dict
+        self.calls = []
+        self._next = 0
+        self.fail_after = None
+
+    def __call__(self, method, path, payload):
+        self.calls.append((method, path, payload))
+        assert path.startswith('/projects/proj-1/'), path
+        if method == 'GET' and path.endswith('/vms'):
+            return 200, {'VMs': list(self.vms.values())}
+        if method == 'POST' and path.endswith('/vm'):
+            if (self.fail_after is not None and
+                    len(self.vms) >= self.fail_after):
+                return 400, {'message': 'no hosts available'}
+            self._next += 1
+            vm = {
+                'id': payload['vmId'],
+                'state': 'ACTIVE',
+                'machineType': payload['machineType'],
+                'gpus': payload['gpus'],
+                'nics': [{'externalIpAddress': f'185.1.0.{self._next}',
+                          'internalIpAddress': f'10.6.0.{self._next}'}],
+                '_input': payload,
+            }
+            self.vms[vm['id']] = vm
+            return 200, {'id': vm['id']}
+        if method == 'POST' and path.endswith('/stop'):
+            vid = path.split('/')[-2]
+            self.vms[vid]['state'] = 'STOPPED'
+            return 200, {}
+        if method == 'POST' and path.endswith('/start'):
+            vid = path.split('/')[-2]
+            self.vms[vid]['state'] = 'ACTIVE'
+            return 200, {}
+        if method == 'POST' and path.endswith('/terminate'):
+            self.vms.pop(path.split('/')[-2], None)
+            return 200, {}
+        return 404, {'message': f'unhandled {method} {path}'}
+
+
+@pytest.fixture
+def fake_api(monkeypatch):
+    monkeypatch.setenv('CUDO_PROJECT_ID', 'proj-1')
+    api = FakeCudoApi()
+    cudo_instance.set_api_runner(api)
+    yield api
+    cudo_instance.set_api_runner(None)
+
+
+def _config(cluster='cdc', count=2, itype='epyc-milan-a100:1'):
+    return provision_common.ProvisionConfig(
+        provider_name='cudo', cluster_name=cluster,
+        region='us-santaclara-1', zones=[],
+        deploy_vars={'instance_type': itype, 'disk_size': 100},
+        count=count)
+
+
+class TestProvisionLifecycle:
+
+    def test_create_query_info_terminate(self, fake_api):
+        record = cudo_instance.run_instances(_config())
+        assert record.provider_name == 'cudo'
+        assert record.created_instance_ids == ['cdc-0', 'cdc-1']
+        inp = fake_api.vms['cdc-0']['_input']
+        assert inp['machineType'] == 'epyc-milan-a100'
+        assert inp['gpus'] == 1
+        assert inp['dataCenterId'] == 'us-santaclara-1'
+        assert inp['customSshKeys']  # our key rides creation
+
+        status = cudo_instance.query_instances('cdc')
+        assert all(s.value == 'UP' for s in status.values())
+
+        info = cudo_instance.get_cluster_info('cdc')
+        assert info.ssh_user == 'root'
+        assert [i.tags['rank'] for i in info.instances] == ['0', '1']
+        assert info.instances[0].external_ip.startswith('185.')
+
+        cudo_instance.terminate_instances('cdc')
+        assert cudo_instance.query_instances('cdc') == {}
+
+    def test_stop_start_resume(self, fake_api):
+        cudo_instance.run_instances(_config())
+        cudo_instance.stop_instances('cdc')
+        assert all(s.value == 'STOPPED' for s in
+                   cudo_instance.query_instances('cdc').values())
+        record = cudo_instance.run_instances(_config())
+        assert len(record.resumed_instance_ids) == 2
+        assert all(s.value == 'UP' for s in
+                   cudo_instance.query_instances('cdc').values())
+
+    def test_partial_create_sweeps(self, fake_api):
+        fake_api.fail_after = 1
+        with pytest.raises(exceptions.ProvisionError,
+                           match='no hosts'):
+            cudo_instance.run_instances(_config(count=2))
+        assert fake_api.vms == {}
+
+    def test_count_mismatch_rejected(self, fake_api):
+        cudo_instance.run_instances(_config(count=2))
+        with pytest.raises(exceptions.ResourcesMismatchError):
+            cudo_instance.run_instances(_config(count=3))
+
+    def test_missing_project_rejected(self, fake_api, monkeypatch):
+        monkeypatch.delenv('CUDO_PROJECT_ID')
+        with pytest.raises(exceptions.ProvisionError, match='project'):
+            cudo_instance.run_instances(_config())
+
+    def test_prefix_does_not_cross_clusters(self, fake_api):
+        cudo_instance.run_instances(_config(cluster='cdc', count=1))
+        cudo_instance.run_instances(_config(cluster='cdc-x', count=1))
+        assert len(cudo_instance.query_instances('cdc')) == 1
+        assert len(cudo_instance.query_instances('cdc-x')) == 1
+
+    def test_foreign_vm_with_nonnumeric_suffix_ignored(self, fake_api):
+        """A user's 'cdc-head' VM in the same project must neither
+        crash rank parsing nor be swept (review finding)."""
+        fake_api.vms['cdc-head'] = {'id': 'cdc-head',
+                                    'state': 'ACTIVE', 'nics': []}
+        cudo_instance.run_instances(_config(cluster='cdc', count=1))
+        assert len(cudo_instance.query_instances('cdc')) == 1
+        cudo_instance.terminate_instances('cdc')
+        assert 'cdc-head' in fake_api.vms  # untouched
+
+    def test_failed_state_never_reads_as_gone(self, fake_api):
+        """A FAILED VM still exists; None would make the status layer
+        drop the record while the VM leaks (review finding)."""
+        cudo_instance.run_instances(_config(count=1))
+        vm = next(iter(fake_api.vms.values()))
+        for state in ('FAILED', 'BOOTING', 'RECREATING'):
+            vm['state'] = state
+            statuses = cudo_instance.query_instances('cdc')
+            assert list(statuses.values())[0] is not None, state
+
+
+class TestCudoCloud:
+
+    def test_feasibility_and_pricing(self):
+        cd = registry.CLOUD_REGISTRY['cudo']
+        r = sky.Resources(cloud='cudo', accelerators='A100-80GB:8')
+        launchable, _ = cd.get_feasible_launchable_resources(r)
+        assert launchable
+        assert launchable[0].instance_type == 'epyc-milan-a100:8'
+        assert catalog.get_hourly_cost(
+            'cudo', 'epyc-milan-a100:1') == pytest.approx(2.19)
+
+    def test_tpu_spot_ports_gated(self):
+        from skypilot_tpu.clouds import cloud as cloud_lib
+        cd = registry.CLOUD_REGISTRY['cudo']
+        assert cd.get_feasible_launchable_resources(
+            sky.Resources(accelerators='tpu-v5e-8'))[0] == []
+        spot = sky.Resources(cloud='cudo', accelerators='H100:1',
+                             capacity='spot')
+        assert cd.get_feasible_launchable_resources(spot)[0] == []
+        with pytest.raises(exceptions.NotSupportedError):
+            cd.check_features_are_supported(
+                sky.Resources(cloud='cudo'),
+                {cloud_lib.CloudImplementationFeatures.OPEN_PORTS})
+
+    def test_credentials_from_yml(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('HOME', str(tmp_path))
+        monkeypatch.delenv('CUDO_API_KEY', raising=False)
+        cd = registry.CLOUD_REGISTRY['cudo']
+        ok, reason = cd.check_credentials()
+        assert not ok and 'cudo.yml' in reason
+        cfg = tmp_path / '.config' / 'cudo'
+        cfg.mkdir(parents=True)
+        (cfg / 'cudo.yml').write_text('api-key: ck-987654321\n')
+        ok, _ = cd.check_credentials()
+        assert ok
+        assert cd.get_current_user_identity() == ['cudo:ck-98765']

@@ -1,0 +1,237 @@
+"""Paperspace cloud + machines-API provisioner (cloud breadth).  The
+REST API sits behind an injectable transport
+(provision/paperspace/instance.py: set_api_runner).  Unlike
+Lambda/RunPod, Paperspace machines stop/start for real, so the
+resume path is exercised too.  Model: tests/unit/test_lambda_cloud.py.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.paperspace import instance as ps_instance
+
+
+class FakePaperspaceApi:
+    """Minimal machines-API state machine."""
+
+    def __init__(self):
+        self.machines = {}   # id -> machine dict
+        self.calls = []
+        self._next = 0
+        self.fail_after = None   # create N machines then 400
+
+    def __call__(self, method, path, payload):
+        self.calls.append((method, path, payload))
+        if method == 'GET' and path.startswith('/machines'):
+            return 200, {'items': list(self.machines.values())}
+        if (method, path) == ('POST', '/machines'):
+            if (self.fail_after is not None and
+                    len(self.machines) >= self.fail_after):
+                return 400, {'message': 'machine quota exceeded'}
+            mid = f'ps-{self._next:05d}'
+            self._next += 1
+            self.machines[mid] = {
+                'id': mid,
+                'name': payload['name'],
+                'state': 'ready',
+                'region': payload['region'],
+                'machineType': payload['machineType'],
+                'publicIp': f'172.8.0.{self._next}',
+                'privateIp': f'10.5.0.{self._next}',
+                '_input': payload,
+            }
+            return 200, {'data': {'id': mid}}
+        if method == 'PATCH' and path.endswith('/stop'):
+            mid = path.split('/')[2]
+            self.machines[mid]['state'] = 'off'
+            return 200, {}
+        if method == 'PATCH' and path.endswith('/start'):
+            mid = path.split('/')[2]
+            self.machines[mid]['state'] = 'ready'
+            return 200, {}
+        if method == 'DELETE':
+            self.machines.pop(path.split('/')[2], None)
+            return 200, {}
+        return 404, {'message': f'unhandled {method} {path}'}
+
+
+@pytest.fixture
+def fake_api():
+    api = FakePaperspaceApi()
+    ps_instance.set_api_runner(api)
+    yield api
+    ps_instance.set_api_runner(None)
+
+
+def _config(cluster='psc', count=2, itype='A100-80G'):
+    return provision_common.ProvisionConfig(
+        provider_name='paperspace', cluster_name=cluster,
+        region='East Coast (NY2)', zones=[],
+        deploy_vars={'instance_type': itype, 'disk_size': 100},
+        count=count)
+
+
+class TestProvisionLifecycle:
+
+    def test_create_query_info_terminate(self, fake_api):
+        record = ps_instance.run_instances(_config())
+        assert record.provider_name == 'paperspace'
+        assert len(record.created_instance_ids) == 2
+        names = sorted(m['name'] for m in fake_api.machines.values())
+        assert names == ['psc-0', 'psc-1']
+        inp = next(iter(fake_api.machines.values()))['_input']
+        assert inp['machineType'] == 'A100-80G'
+        # Our public key is installed via the startup script.
+        assert 'authorized_keys' in inp['startupScript']
+
+        status = ps_instance.query_instances('psc')
+        assert all(s.value == 'UP' for s in status.values())
+
+        info = ps_instance.get_cluster_info('psc')
+        assert info.ssh_user == 'paperspace'
+        assert [i.tags['rank'] for i in info.instances] == ['0', '1']
+        assert info.instances[0].external_ip.startswith('172.8.')
+
+        ps_instance.terminate_instances('psc')
+        assert ps_instance.query_instances('psc') == {}
+
+    def test_stop_start_resume(self, fake_api):
+        ps_instance.run_instances(_config())
+        ps_instance.stop_instances('psc')
+        status = ps_instance.query_instances('psc')
+        assert all(s.value == 'STOPPED' for s in status.values())
+        record = ps_instance.run_instances(_config())
+        assert len(record.resumed_instance_ids) == 2
+        status = ps_instance.query_instances('psc')
+        assert all(s.value == 'UP' for s in status.values())
+
+    def test_count_mismatch_rejected(self, fake_api):
+        ps_instance.run_instances(_config(count=2))
+        with pytest.raises(exceptions.ResourcesMismatchError):
+            ps_instance.run_instances(_config(count=3))
+
+    def test_partial_create_sweeps(self, fake_api):
+        fake_api.fail_after = 1
+        with pytest.raises(exceptions.ProvisionError,
+                           match='quota exceeded'):
+            ps_instance.run_instances(_config(count=2))
+        assert fake_api.machines == {}
+
+    def test_worker_only_stop_keeps_head(self, fake_api):
+        ps_instance.run_instances(_config(count=3))
+        ps_instance.stop_instances('psc', worker_only=True)
+        states = {m['name']: m['state']
+                  for m in fake_api.machines.values()}
+        assert states == {'psc-0': 'ready', 'psc-1': 'off',
+                          'psc-2': 'off'}
+
+    def test_name_prefix_does_not_cross_clusters(self, fake_api):
+        """Cluster 'psc' must not see machines of cluster 'psc-extra'
+        (both share a name prefix)."""
+        ps_instance.run_instances(_config(cluster='psc', count=1))
+        ps_instance.run_instances(_config(cluster='psc-extra', count=1))
+        assert len(ps_instance.query_instances('psc')) == 1
+        assert len(ps_instance.query_instances('psc-extra')) == 1
+
+    def test_foreign_machine_with_nonnumeric_suffix_ignored(self,
+                                                            fake_api):
+        """A user's hand-made 'psc-head' machine must neither crash
+        rank parsing nor be terminated by our sweep (review finding)."""
+        fake_api.machines['alien'] = {
+            'id': 'alien', 'name': 'psc-head', 'state': 'ready',
+            'publicIp': '1.2.3.4', 'privateIp': '10.0.0.9',
+        }
+        ps_instance.run_instances(_config(cluster='psc', count=1))
+        assert len(ps_instance.query_instances('psc')) == 1
+        ps_instance.terminate_instances('psc')
+        assert 'alien' in fake_api.machines  # untouched
+
+    def test_disk_size_rounds_to_valid_tier(self, fake_api):
+        """Paperspace only accepts fixed disk tiers; the framework
+        default of 256 must round up to 500, not 400 on create."""
+        cfg = _config(count=1)
+        cfg.deploy_vars['disk_size'] = 256
+        ps_instance.run_instances(cfg)
+        inp = next(iter(fake_api.machines.values()))['_input']
+        assert inp['diskSize'] == 500
+
+    def test_transitional_states_never_read_as_gone(self, fake_api):
+        """'restarting'/'serviceready' machines exist and bill; mapping
+        them to None would make the status layer remove the cluster
+        record while machines keep running (review finding)."""
+        ps_instance.run_instances(_config(count=1))
+        machine = next(iter(fake_api.machines.values()))
+        for state in ('serviceready', 'restarting', 'upgrading',
+                      'error', 'provisioning'):
+            machine['state'] = state
+            statuses = ps_instance.query_instances('psc')
+            assert list(statuses.values())[0] is not None, state
+
+    def test_sweep_is_best_effort(self, fake_api):
+        """A failing DELETE during the partial-create sweep must not
+        mask the original create error."""
+        fake_api.fail_after = 1
+        orig = fake_api.__call__
+
+        def flaky(method, path, payload):
+            if method == 'DELETE':
+                return 429, {'message': 'rate limited'}
+            return orig(method, path, payload)
+
+        fake_api_call = fake_api.__class__.__call__
+        fake_api.__class__.__call__ = lambda self, m, p, d: flaky(m, p, d)
+        try:
+            with pytest.raises(exceptions.ProvisionError,
+                               match='quota exceeded'):
+                ps_instance.run_instances(_config(count=2))
+        finally:
+            fake_api.__class__.__call__ = fake_api_call
+
+
+class TestPaperspaceCloud:
+
+    def test_feasibility_and_pricing(self):
+        ps = registry.CLOUD_REGISTRY['paperspace']
+        r = sky.Resources(cloud='paperspace', accelerators='A100-80GB:8')
+        launchable, _ = ps.get_feasible_launchable_resources(r)
+        assert launchable
+        assert launchable[0].instance_type == 'A100-80Gx8'
+        assert catalog.get_hourly_cost(
+            'paperspace', 'A100-80G') == pytest.approx(3.18)
+
+    def test_tpu_and_spot_not_feasible(self):
+        ps = registry.CLOUD_REGISTRY['paperspace']
+        assert ps.get_feasible_launchable_resources(
+            sky.Resources(accelerators='tpu-v5e-8'))[0] == []
+        spot = sky.Resources(cloud='paperspace', accelerators='A100:1',
+                             capacity='spot')
+        assert ps.get_feasible_launchable_resources(spot)[0] == []
+
+    def test_stop_supported(self):
+        """Unlike Lambda/RunPod, STOP is NOT gated: autostop works."""
+        from skypilot_tpu.clouds import cloud as cloud_lib
+        ps = registry.CLOUD_REGISTRY['paperspace']
+        ps.check_features_are_supported(
+            sky.Resources(cloud='paperspace'),
+            {cloud_lib.CloudImplementationFeatures.STOP})
+
+    def test_credentials_from_config_json(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('HOME', str(tmp_path))
+        monkeypatch.delenv('PAPERSPACE_API_KEY', raising=False)
+        ps = registry.CLOUD_REGISTRY['paperspace']
+        ok, reason = ps.check_credentials()
+        assert not ok and 'config.json' in reason
+        cfg = tmp_path / '.paperspace'
+        cfg.mkdir()
+        (cfg / 'config.json').write_text(
+            json.dumps({'apiKey': 'psk-12345678'}))
+        ok, _ = ps.check_credentials()
+        assert ok
+        assert ps.get_current_user_identity() == ['paperspace:psk-1234']
