@@ -25,6 +25,7 @@ _INSTANCE_CSVS = {
     'aws': 'aws_instances.csv',
     'azure': 'azure_instances.csv',
     'cudo': 'cudo_instances.csv',
+    'fluidstack': 'fluidstack_instances.csv',
     'gcp': 'gcp_instances.csv',
     'lambda': 'lambda_instances.csv',
     'local': 'local_instances.csv',

@@ -1,0 +1,168 @@
+"""FluidStack: marketplace GPU VMs — a ninth fungible GPU pool.
+
+Parity: /root/reference/sky/clouds/fluidstack.py:1-280 (feature
+gates, `~/.fluidstack/api_key` credential check) — rebuilt on the
+platform REST API behind an injectable transport
+(provision/fluidstack/instance.py) instead of the reference's
+fluidstack_utils requests wrapper.
+
+FluidStack instances stop/start (the reference gated STOP for SDK
+reasons; the platform API exposes it); no spot market, no custom
+images, no per-instance firewall.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+CREDENTIALS_PATH = '~/.fluidstack/api_key'
+
+
+def read_api_key() -> Optional[str]:
+    key = os.environ.get('FLUIDSTACK_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser(CREDENTIALS_PATH)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding='utf-8') as f:
+        return f.read().strip() or None
+
+
+class FluidStack(cloud_lib.Cloud):
+    _REPR = 'FluidStack'
+    PROVISIONER = 'fluidstack'
+
+    _CLOUD_UNSUPPORTED_FEATURES = {
+        cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+            'FluidStack has no spot market.',
+        cloud_lib.CloudImplementationFeatures.IMAGE_ID:
+            'Instances boot the framework Ubuntu image.',
+        cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+            'Disk tier is fixed per configuration.',
+        cloud_lib.CloudImplementationFeatures.CLONE_DISK:
+            'Disk cloning is not implemented for FluidStack.',
+        cloud_lib.CloudImplementationFeatures.OPEN_PORTS:
+            'No per-instance firewall API.',
+        cloud_lib.CloudImplementationFeatures.HOST_CONTROLLERS:
+            'Marketplace capacity is not suitable for long-lived '
+            'controllers.',
+    }
+
+    # ------------------------------------------------------- regions/zones
+
+    def regions_with_offering(self, resources) -> List[cloud_lib.Region]:
+        if resources.tpu_spec is not None or resources.use_spot:
+            return []
+        if resources.instance_type is not None:
+            pairs = catalog.get_region_zones_for_instance_type(
+                'fluidstack', resources.instance_type, False)
+        else:
+            pairs = []
+        regions: Dict[str, cloud_lib.Region] = {}
+        for region_name, _ in pairs:
+            if (resources.region is not None and
+                    region_name != resources.region):
+                continue
+            regions.setdefault(region_name, cloud_lib.Region(region_name))
+        return list(regions.values())
+
+    # ------------------------------------------------------------- pricing
+
+    def instance_type_to_hourly_cost(self, instance_type, use_spot, region,
+                                     zone) -> float:
+        return catalog.get_hourly_cost('fluidstack', instance_type,
+                                       use_spot, region, zone)
+
+    def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
+                                    zone) -> float:
+        del accelerators, use_spot, region, zone
+        return 0.0
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        del num_gigabytes
+        return 0.0
+
+    # -------------------------------------------------------- feasibility
+
+    def get_feasible_launchable_resources(self, resources):
+        fuzzy: List[str] = []
+        if resources.tpu_spec is not None or resources.use_spot:
+            return [], fuzzy
+        if resources.accelerators:
+            acc, count = next(iter(resources.accelerators.items()))
+            instance_types = catalog.get_instance_type_for_accelerator(
+                'fluidstack', acc, count, resources.cpus,
+                resources.memory, resources.region, resources.zone)
+            if not instance_types:
+                offerings = catalog.list_accelerators(
+                    name_filter=acc, clouds=['fluidstack'])
+                fuzzy.extend(sorted(offerings))
+                return [], fuzzy
+            return [
+                resources.copy(cloud=self, instance_type=instance_types[0])
+            ], fuzzy
+        if resources.instance_type is not None:
+            if catalog.instance_type_exists('fluidstack',
+                                            resources.instance_type):
+                return [resources.copy(cloud=self)], fuzzy
+            return [], fuzzy
+        default = self.get_default_instance_type(resources.cpus,
+                                                 resources.memory)
+        if default is None:
+            return [], fuzzy
+        return [resources.copy(cloud=self, instance_type=default)], fuzzy
+
+    def get_default_instance_type(self, cpus, memory) -> Optional[str]:
+        return catalog.get_default_instance_type('fluidstack', cpus,
+                                                 memory)
+
+    def validate_region_zone(self, region, zone):
+        if zone is not None:
+            raise ValueError(
+                'FluidStack has no zone placement (region only); '
+                f'got zone={zone!r}.')
+        return catalog.validate_region_zone('fluidstack', region, None)
+
+    # ------------------------------------------------------------- deploy
+
+    def make_deploy_resources_variables(self, resources, cluster_name,
+                                        region, zones) -> Dict[str, Any]:
+        del zones
+        return {
+            'cluster_name': cluster_name,
+            'region': region.name,
+            'zones': [],
+            'use_spot': False,
+            'labels': dict(resources.labels or {}),
+            'ports': list(resources.ports or []),
+            'disk_size': resources.disk_size,
+            'image_id': None,
+            'tpu': False,
+            'instance_type': resources.instance_type,
+            'num_nodes': 1,
+        }
+
+    # --------------------------------------------------------- credentials
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        if read_api_key():
+            return True, None
+        return False, (f'FluidStack API key not found. Put the key in '
+                       f'{CREDENTIALS_PATH} or set FLUIDSTACK_API_KEY.')
+
+    def get_current_user_identity(self) -> Optional[List[str]]:
+        key = read_api_key()
+        return [f'fluidstack:{key[:8]}'] if key else None
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        if os.path.exists(os.path.expanduser(CREDENTIALS_PATH)):
+            return {CREDENTIALS_PATH: CREDENTIALS_PATH}
+        return {}

@@ -11,6 +11,7 @@ from skypilot_tpu.clouds import azure
 from skypilot_tpu.clouds import cloud as cloud_lib
 from skypilot_tpu.clouds import cudo
 from skypilot_tpu.clouds import docker
+from skypilot_tpu.clouds import fluidstack
 from skypilot_tpu.clouds import gcp
 from skypilot_tpu.clouds import gke
 from skypilot_tpu.clouds import kubernetes
@@ -25,6 +26,7 @@ CLOUD_REGISTRY: Dict[str, cloud_lib.Cloud] = {
     'azure': azure.Azure(),
     'cudo': cudo.Cudo(),
     'docker': docker.Docker(),
+    'fluidstack': fluidstack.FluidStack(),
     'gcp': gcp.GCP(),
     'gke': gke.GKE(),
     'kubernetes': kubernetes.Kubernetes(),

@@ -1,0 +1,1 @@
+"""FluidStack provisioner package."""

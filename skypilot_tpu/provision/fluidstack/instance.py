@@ -1,0 +1,303 @@
+"""FluidStack provisioner: platform REST API with an injectable
+transport.
+
+Parity: /root/reference/sky/provision/fluidstack/ (+
+fluidstack_utils.py, ~500 LoC of requests calls) — rebuilt on the
+platform API behind `set_api_runner`, the same no-SDK seam as
+provision/lambda_cloud and provision/paperspace.
+
+API surface used (https://platform.fluidstack.io, `api-key` header):
+  GET    /ssh_keys  /  POST /ssh_keys        key registry
+  GET    /instances                          account's instances
+  POST   /instances                          create {name, gpu_type,
+                                             gpu_count, ssh_key}
+  POST   /instances/{id}/start|stop          power actions
+  DELETE /instances/{id}                     terminate
+
+Instances are named `<cluster>-<rank>`; recovery lists the account
+and filters `<cluster>-<digits>` client-side.  Stop/start is real.
+Gang semantics: N individual creates, best-effort all-or-nothing
+sweep on failure.
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.utils import command_runner
+
+logger = sky_logging.init_logger(__name__)
+
+_API_BASE = 'https://platform.fluidstack.io'
+DEFAULT_SSH_USER = 'ubuntu'
+_KEY_NAME = 'skypilot-tpu'
+
+# Transport seam: runner(method, path, payload|None) -> (status, dict).
+ApiRunner = Callable[[str, str, Optional[Dict[str, Any]]],
+                     Tuple[int, Dict[str, Any]]]
+
+
+def _default_api_runner(method: str, path: str,
+                        payload: Optional[Dict[str, Any]]
+                        ) -> Tuple[int, Dict[str, Any]]:
+    from skypilot_tpu.clouds import fluidstack as fs_cloud  # pylint: disable=import-outside-toplevel
+    key = fs_cloud.read_api_key()
+    if not key:
+        raise exceptions.ProvisionError(
+            'FluidStack API key not found (see `sky check`).')
+    req = urllib.request.Request(
+        _API_BASE + path,
+        data=(json.dumps(payload).encode()
+              if payload is not None else None),
+        headers={'api-key': key, 'Content-Type': 'application/json'},
+        method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            body = resp.read() or b'{}'
+            parsed = json.loads(body)
+            if isinstance(parsed, list):
+                parsed = {'items': parsed}
+            return resp.status, parsed
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read() or b'{}')
+        except ValueError:
+            body = {}
+        return e.code, body
+
+
+_api_runner: ApiRunner = _default_api_runner
+
+
+def set_api_runner(runner: Optional[ApiRunner]) -> None:
+    """Inject a fake FluidStack API for tests (None restores the real
+    one)."""
+    global _api_runner
+    _api_runner = runner or _default_api_runner
+
+
+def _api(method: str, path: str,
+         payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    status, body = _api_runner(method, path, payload)
+    if status >= 400:
+        raise exceptions.ProvisionError(
+            f'FluidStack API {method} {path} failed ({status}): '
+            f'{body.get("message", body.get("detail", body))}')
+    return body
+
+
+def _instance_rank(inst: Dict[str, Any]) -> int:
+    return int(inst['name'].rsplit('-', 1)[-1])
+
+
+def _is_ours(name: str, cluster_name: str) -> bool:
+    prefix, _, rank = name.rpartition('-')
+    return prefix == cluster_name and rank.isdigit()
+
+
+def _list_instances(cluster_name: str) -> List[Dict[str, Any]]:
+    body = _api('GET', '/instances')
+    items = body.get('items', [])
+    # Terminated instances may linger in listings; they are corpses —
+    # including them would make a relaunch adopt them as `existing`
+    # (head = a dead instance) and `sky down` re-DELETE them.
+    mine = [i for i in items
+            if _is_ours(i.get('name', ''), cluster_name) and
+            i.get('status') != 'terminated']
+    return sorted(mine, key=_instance_rank)
+
+
+def _ensure_ssh_key() -> str:
+    from skypilot_tpu import authentication  # pylint: disable=import-outside-toplevel
+    _, public_key_path = authentication.get_or_generate_keys()
+    with open(public_key_path, encoding='utf-8') as f:
+        public_key = f.read().strip()
+    keys = _api('GET', '/ssh_keys').get('items', [])
+    for key in keys:
+        if key.get('name') == _KEY_NAME:
+            return _KEY_NAME
+    _api('POST', '/ssh_keys', {'name': _KEY_NAME,
+                               'public_key': public_key})
+    return _KEY_NAME
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    cluster_name = config.cluster_name
+    instance_type = config.deploy_vars.get('instance_type')
+    if not instance_type:
+        raise exceptions.ProvisionError(
+            'FluidStack provisioning needs an instance_type (TPUs '
+            'live on GCP).')
+    count = config.count
+    # Catalog instance types are '<gpu_type>:<count>'.
+    gpu_type, _, gpu_count = instance_type.rpartition(':')
+
+    existing = _list_instances(cluster_name)
+    created: List[str] = []
+    resumed: List[str] = []
+    if existing:
+        if len(existing) != count:
+            raise exceptions.ResourcesMismatchError(
+                f'Cluster {cluster_name} exists with {len(existing)} '
+                f'instances; requested {count}.')
+        stopped = [i['id'] for i in existing
+                   if i.get('status') in ('stopped', 'stopping')]
+        for iid in stopped:
+            _api('POST', f'/instances/{iid}/start')
+        resumed = stopped
+    else:
+        key_name = _ensure_ssh_key()
+        try:
+            for rank in range(count):
+                body = _api('POST', '/instances', {
+                    'name': f'{cluster_name}-{rank}',
+                    'gpu_type': gpu_type,
+                    'gpu_count': int(gpu_count or 1),
+                    'ssh_key': key_name,
+                })
+                created.append(body.get('id') or
+                               body.get('data', {}).get('id'))
+        except exceptions.ProvisionError:
+            # Best-effort all-or-nothing sweep: a failing terminate
+            # must not mask the original error or strand later
+            # instances unswept.
+            for iid in created:
+                try:
+                    _api('DELETE', f'/instances/{iid}')
+                except exceptions.ProvisionError as e:
+                    logger.warning(
+                        f'Sweep of partial instance {iid} failed: {e}')
+            raise
+    head = existing[0]['id'] if existing else created[0]
+    return common.ProvisionRecord(
+        provider_name='fluidstack', cluster_name=cluster_name,
+        region=config.region, zone=None, head_instance_id=head,
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def wait_instances(cluster_name: str, state: Optional[str] = None) -> None:
+    want = state or 'running'
+    deadline = time.time() + 900
+    while time.time() < deadline:
+        instances = _list_instances(cluster_name)
+        if instances and all(i.get('status') == want
+                             for i in instances):
+            return
+        # ('terminated' never shows here: _list_instances filters it.)
+        bad = [i['id'] for i in instances
+               if i.get('status') == 'failed']
+        if bad:
+            raise exceptions.ProvisionError(
+                f'Instances {bad} of {cluster_name} failed while '
+                'provisioning.')
+        time.sleep(10)
+    raise exceptions.ProvisionError(
+        f'Instances of {cluster_name} did not reach {want!r} in 900s.')
+
+
+def wait_capacity(cluster_name: str, timeout: float = 0) -> bool:
+    del cluster_name, timeout
+    return True
+
+
+def stop_instances(cluster_name: str, worker_only: bool = False) -> None:
+    for inst in _list_instances(cluster_name):
+        if worker_only and _instance_rank(inst) == 0:
+            continue
+        _api('POST', f'/instances/{inst["id"]}/stop')
+
+
+def terminate_instances(cluster_name: str,
+                        worker_only: bool = False) -> None:
+    for inst in _list_instances(cluster_name):
+        if worker_only and _instance_rank(inst) == 0:
+            continue
+        _api('DELETE', f'/instances/{inst["id"]}')
+
+
+# Every live state maps to SOMETHING (None == gone == record removal).
+_STATE_MAP = {
+    'running': ClusterStatus.UP,
+    'pending': ClusterStatus.INIT,
+    'provisioning': ClusterStatus.INIT,
+    'starting': ClusterStatus.INIT,
+    'failed': ClusterStatus.INIT,  # exists + needs manual sweep
+    'stopping': ClusterStatus.STOPPED,
+    'stopped': ClusterStatus.STOPPED,
+}
+
+
+def query_instances(cluster_name: str
+                    ) -> Dict[str, Optional[ClusterStatus]]:
+    return {
+        inst['id']: _STATE_MAP.get(inst.get('status'))
+        for inst in _list_instances(cluster_name)
+    }
+
+
+def get_cluster_info(cluster_name: str,
+                     region: Optional[str] = None) -> common.ClusterInfo:
+    instances = [i for i in _list_instances(cluster_name)
+                 if i.get('status') == 'running']
+    if not instances:
+        raise exceptions.FetchClusterInfoError(
+            exceptions.FetchClusterInfoError.Reason.HEAD)
+    infos = []
+    for inst in instances:
+        rank = _instance_rank(inst)
+        infos.append(
+            common.InstanceInfo(
+                instance_id=inst['id'],
+                internal_ip=inst.get('private_ip') or
+                inst.get('ip_address', ''),
+                external_ip=inst.get('ip_address'),
+                ssh_port=22,
+                slice_id=0,
+                worker_id=rank,
+                tags={'rank': str(rank)},
+            ))
+    from skypilot_tpu import authentication  # pylint: disable=import-outside-toplevel
+    private_key, _ = authentication.get_or_generate_keys()
+    return common.ClusterInfo(
+        provider_name='fluidstack',
+        cluster_name=cluster_name,
+        region=region or '',
+        zone=None,
+        instances=infos,
+        head_instance_id=infos[0].instance_id,
+        ssh_user=DEFAULT_SSH_USER,
+        ssh_private_key=private_key,
+    )
+
+
+def open_ports(cluster_name: str, ports: List[int]) -> None:
+    # No per-instance firewall API; the cloud layer gates OPEN_PORTS.
+    raise exceptions.NotSupportedError(
+        f'FluidStack has no per-instance port API (requested {ports}).')
+
+
+def cleanup_ports(cluster_name: str) -> None:
+    del cluster_name
+
+
+def get_command_runners(cluster_info: common.ClusterInfo,
+                        **kwargs: Any) -> List[command_runner.CommandRunner]:
+    del kwargs
+    runners: List[command_runner.CommandRunner] = []
+    for inst in cluster_info.instances:
+        ip = inst.external_ip or inst.internal_ip
+        runners.append(
+            command_runner.SSHCommandRunner(
+                node=(ip, inst.ssh_port),
+                ssh_user=cluster_info.ssh_user,
+                ssh_private_key=cluster_info.ssh_private_key,
+                ssh_control_name=cluster_info.cluster_name,
+            ))
+    return runners

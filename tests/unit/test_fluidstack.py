@@ -1,0 +1,190 @@
+"""FluidStack cloud + platform-API provisioner (cloud breadth).  The
+REST API sits behind an injectable transport
+(provision/fluidstack/instance.py: set_api_runner).  Model:
+tests/unit/test_lambda_cloud.py / test_paperspace.py."""
+from __future__ import annotations
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.fluidstack import instance as fs_instance
+
+
+class FakeFluidstackApi:
+    """Minimal platform-API state machine."""
+
+    def __init__(self):
+        self.instances = {}
+        self.ssh_keys = []
+        self.calls = []
+        self._next = 0
+        self.fail_after = None
+
+    def __call__(self, method, path, payload):
+        self.calls.append((method, path, payload))
+        if (method, path) == ('GET', '/ssh_keys'):
+            return 200, {'items': list(self.ssh_keys)}
+        if (method, path) == ('POST', '/ssh_keys'):
+            self.ssh_keys.append(dict(payload))
+            return 200, {}
+        if (method, path) == ('GET', '/instances'):
+            return 200, {'items': list(self.instances.values())}
+        if (method, path) == ('POST', '/instances'):
+            if (self.fail_after is not None and
+                    len(self.instances) >= self.fail_after):
+                return 400, {'message': 'gpu type out of capacity'}
+            self._next += 1
+            iid = f'fs-{self._next:05d}'
+            self.instances[iid] = {
+                'id': iid,
+                'name': payload['name'],
+                'status': 'running',
+                'ip_address': f'91.1.0.{self._next}',
+                'private_ip': f'10.7.0.{self._next}',
+                '_input': payload,
+            }
+            return 200, {'id': iid}
+        if method == 'POST' and path.endswith('/stop'):
+            self.instances[path.split('/')[2]]['status'] = 'stopped'
+            return 200, {}
+        if method == 'POST' and path.endswith('/start'):
+            self.instances[path.split('/')[2]]['status'] = 'running'
+            return 200, {}
+        if method == 'DELETE':
+            self.instances.pop(path.split('/')[2], None)
+            return 200, {}
+        return 404, {'message': f'unhandled {method} {path}'}
+
+
+@pytest.fixture
+def fake_api():
+    api = FakeFluidstackApi()
+    fs_instance.set_api_runner(api)
+    yield api
+    fs_instance.set_api_runner(None)
+
+
+def _config(cluster='fsc', count=2, itype='A100_PCIE_80GB:1'):
+    return provision_common.ProvisionConfig(
+        provider_name='fluidstack', cluster_name=cluster,
+        region='NORWAY', zones=[],
+        deploy_vars={'instance_type': itype, 'disk_size': 100},
+        count=count)
+
+
+class TestProvisionLifecycle:
+
+    def test_create_query_info_terminate(self, fake_api):
+        record = fs_instance.run_instances(_config())
+        assert record.provider_name == 'fluidstack'
+        assert len(record.created_instance_ids) == 2
+        assert [k['name'] for k in fake_api.ssh_keys] == ['skypilot-tpu']
+        inp = next(iter(fake_api.instances.values()))['_input']
+        assert inp['gpu_type'] == 'A100_PCIE_80GB'
+        assert inp['gpu_count'] == 1
+        assert inp['ssh_key'] == 'skypilot-tpu'
+
+        status = fs_instance.query_instances('fsc')
+        assert all(s.value == 'UP' for s in status.values())
+
+        info = fs_instance.get_cluster_info('fsc')
+        assert info.ssh_user == 'ubuntu'
+        assert [i.tags['rank'] for i in info.instances] == ['0', '1']
+        assert info.instances[0].external_ip.startswith('91.')
+
+        fs_instance.terminate_instances('fsc')
+        assert fs_instance.query_instances('fsc') == {}
+
+    def test_stop_start_resume(self, fake_api):
+        fs_instance.run_instances(_config())
+        fs_instance.stop_instances('fsc')
+        assert all(s.value == 'STOPPED' for s in
+                   fs_instance.query_instances('fsc').values())
+        record = fs_instance.run_instances(_config())
+        assert len(record.resumed_instance_ids) == 2
+        assert all(s.value == 'UP' for s in
+                   fs_instance.query_instances('fsc').values())
+
+    def test_partial_create_sweeps_best_effort(self, fake_api):
+        fake_api.fail_after = 1
+        with pytest.raises(exceptions.ProvisionError,
+                           match='out of capacity'):
+            fs_instance.run_instances(_config(count=2))
+        assert fake_api.instances == {}
+
+    def test_count_mismatch_rejected(self, fake_api):
+        fs_instance.run_instances(_config(count=2))
+        with pytest.raises(exceptions.ResourcesMismatchError):
+            fs_instance.run_instances(_config(count=3))
+
+    def test_foreign_instance_ignored(self, fake_api):
+        fake_api.instances['alien'] = {'id': 'alien',
+                                       'name': 'fsc-head',
+                                       'status': 'running'}
+        fs_instance.run_instances(_config(count=1))
+        assert len(fs_instance.query_instances('fsc')) == 1
+        fs_instance.terminate_instances('fsc')
+        assert 'alien' in fake_api.instances
+
+    def test_live_states_never_read_as_gone(self, fake_api):
+        fs_instance.run_instances(_config(count=1))
+        inst = next(iter(fake_api.instances.values()))
+        for state in ('pending', 'provisioning', 'failed', 'starting'):
+            inst['status'] = state
+            statuses = fs_instance.query_instances('fsc')
+            assert list(statuses.values())[0] is not None, state
+
+    def test_terminated_corpses_invisible_to_relaunch(self, fake_api):
+        """Terminated instances lingering in listings must not be
+        adopted as `existing` by a relaunch (review finding: head
+        would be a corpse), nor re-DELETEd by down."""
+        fs_instance.run_instances(_config(count=1))
+        old = next(iter(fake_api.instances.values()))
+        old['status'] = 'terminated'
+        assert fs_instance.query_instances('fsc') == {}
+        record = fs_instance.run_instances(_config(count=1))
+        assert len(record.created_instance_ids) == 1
+        assert record.head_instance_id != old['id']
+        fs_instance.terminate_instances('fsc')  # corpse untouched
+        assert old['id'] in fake_api.instances
+
+
+class TestFluidStackCloud:
+
+    def test_feasibility_and_pricing(self):
+        fs = registry.CLOUD_REGISTRY['fluidstack']
+        r = sky.Resources(cloud='fluidstack', accelerators='A100-80GB:8')
+        launchable, _ = fs.get_feasible_launchable_resources(r)
+        assert launchable
+        assert launchable[0].instance_type == 'A100_PCIE_80GB:8'
+        assert catalog.get_hourly_cost(
+            'fluidstack', 'A100_PCIE_80GB:1') == pytest.approx(1.79)
+
+    def test_tpu_spot_ports_controllers_gated(self):
+        from skypilot_tpu.clouds import cloud as cloud_lib
+        fs = registry.CLOUD_REGISTRY['fluidstack']
+        assert fs.get_feasible_launchable_resources(
+            sky.Resources(accelerators='tpu-v5e-8'))[0] == []
+        for feat in ('SPOT_INSTANCE', 'OPEN_PORTS', 'HOST_CONTROLLERS'):
+            with pytest.raises(exceptions.NotSupportedError):
+                fs.check_features_are_supported(
+                    sky.Resources(cloud='fluidstack'),
+                    {getattr(cloud_lib.CloudImplementationFeatures,
+                             feat)})
+
+    def test_credentials_from_key_file(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('HOME', str(tmp_path))
+        monkeypatch.delenv('FLUIDSTACK_API_KEY', raising=False)
+        fs = registry.CLOUD_REGISTRY['fluidstack']
+        ok, reason = fs.check_credentials()
+        assert not ok and 'api_key' in reason
+        cfg = tmp_path / '.fluidstack'
+        cfg.mkdir()
+        (cfg / 'api_key').write_text('fk-555666777\n')
+        ok, _ = fs.check_credentials()
+        assert ok
+        assert fs.get_current_user_identity() == ['fluidstack:fk-55566']
