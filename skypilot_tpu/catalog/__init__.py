@@ -27,6 +27,7 @@ _INSTANCE_CSVS = {
     'cudo': 'cudo_instances.csv',
     'fluidstack': 'fluidstack_instances.csv',
     'gcp': 'gcp_instances.csv',
+    'ibm': 'ibm_instances.csv',
     'lambda': 'lambda_instances.csv',
     'local': 'local_instances.csv',
     'oci': 'oci_instances.csv',

@@ -1,0 +1,177 @@
+"""IBM Cloud VPC: GPU VSIs — a tenth fungible GPU pool.
+
+Parity: /root/reference/sky/clouds/ibm.py:1-495 (feature gates, region
+enumeration, `~/.ibm/credentials.yaml` check) — rebuilt on the
+`ibmcloud is` CLI's JSON output with an injectable runner
+(provision/ibm/instance.py), the same no-SDK seam as aws/azure/oci,
+instead of the reference's ibm-vpc SDK + Ray node provider.
+
+Placement is region + zone (VPC zones like 'us-south-1').  The VPC
+and subnet the framework may use come from the layered config
+(`ibm.vpc_id`, `ibm.subnet_id`) — IBM VPC networking is account
+topology, not something a provisioner should invent.  GPU profiles
+(gx2 V100, gx3 L4/L40S, gx3d H100) price via the catalog.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+CREDENTIALS_PATH = '~/.ibm/credentials.yaml'
+
+
+def read_credentials() -> Dict[str, str]:
+    """`iam_api_key:`/`resource_group_id:` from the reference-
+    compatible credentials.yaml (flat YAML subset, no dependency)."""
+    path = os.path.expanduser(CREDENTIALS_PATH)
+    if not os.path.exists(path):
+        return {}
+    creds: Dict[str, str] = {}
+    with open(path, encoding='utf-8') as f:
+        for line in f:
+            key, sep, value = line.strip().partition(':')
+            if sep and value.strip():
+                creds[key.strip()] = value.strip().strip('"\'')
+    return creds
+
+
+class IBM(cloud_lib.Cloud):
+    _REPR = 'IBM'
+    PROVISIONER = 'ibm'
+
+    _CLOUD_UNSUPPORTED_FEATURES = {
+        cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+            'IBM VPC has no spot market for VSIs.',
+        cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+            'Boot volume tier is fixed per profile.',
+        cloud_lib.CloudImplementationFeatures.CLONE_DISK:
+            'Disk cloning is not implemented for IBM.',
+        cloud_lib.CloudImplementationFeatures.OPEN_PORTS:
+            'Ports ride the VPC security group, not a per-instance '
+            'API; configure the group instead.',
+    }
+
+    # ------------------------------------------------------- regions/zones
+
+    def regions_with_offering(self, resources) -> List[cloud_lib.Region]:
+        if resources.tpu_spec is not None or resources.use_spot:
+            return []
+        if resources.instance_type is not None:
+            pairs = catalog.get_region_zones_for_instance_type(
+                'ibm', resources.instance_type, False)
+        else:
+            pairs = []
+        regions: Dict[str, cloud_lib.Region] = {}
+        for region_name, zone_name in pairs:
+            if (resources.region is not None and
+                    region_name != resources.region):
+                continue
+            if resources.zone is not None and zone_name != resources.zone:
+                continue
+            region = regions.setdefault(region_name,
+                                        cloud_lib.Region(region_name))
+            region.zones.append(cloud_lib.Zone(zone_name, region_name))
+        return list(regions.values())
+
+    # ------------------------------------------------------------- pricing
+
+    def instance_type_to_hourly_cost(self, instance_type, use_spot, region,
+                                     zone) -> float:
+        return catalog.get_hourly_cost('ibm', instance_type, use_spot,
+                                       region, zone)
+
+    def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
+                                    zone) -> float:
+        del accelerators, use_spot, region, zone
+        return 0.0
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # IBM internet egress: first 250 GB/month free, then a flat
+        # tier (reference sky/clouds/ibm.py shape).
+        if num_gigabytes <= 250:
+            return 0.0
+        return (num_gigabytes - 250) * 0.09
+
+    # -------------------------------------------------------- feasibility
+
+    def get_feasible_launchable_resources(self, resources):
+        fuzzy: List[str] = []
+        if resources.tpu_spec is not None or resources.use_spot:
+            return [], fuzzy
+        if resources.accelerators:
+            acc, count = next(iter(resources.accelerators.items()))
+            instance_types = catalog.get_instance_type_for_accelerator(
+                'ibm', acc, count, resources.cpus, resources.memory,
+                resources.region, resources.zone)
+            if not instance_types:
+                offerings = catalog.list_accelerators(name_filter=acc,
+                                                      clouds=['ibm'])
+                fuzzy.extend(sorted(offerings))
+                return [], fuzzy
+            return [
+                resources.copy(cloud=self, instance_type=instance_types[0])
+            ], fuzzy
+        if resources.instance_type is not None:
+            if catalog.instance_type_exists('ibm',
+                                            resources.instance_type):
+                return [resources.copy(cloud=self)], fuzzy
+            return [], fuzzy
+        default = self.get_default_instance_type(resources.cpus,
+                                                 resources.memory)
+        if default is None:
+            return [], fuzzy
+        return [resources.copy(cloud=self, instance_type=default)], fuzzy
+
+    def get_default_instance_type(self, cpus, memory) -> Optional[str]:
+        return catalog.get_default_instance_type('ibm', cpus, memory)
+
+    def validate_region_zone(self, region, zone):
+        return catalog.validate_region_zone('ibm', region, zone)
+
+    # ------------------------------------------------------------- deploy
+
+    def make_deploy_resources_variables(self, resources, cluster_name,
+                                        region, zones) -> Dict[str, Any]:
+        return {
+            'cluster_name': cluster_name,
+            'region': region.name,
+            'zones': [z.name for z in (zones or [])],
+            'use_spot': False,
+            'labels': dict(resources.labels or {}),
+            'ports': list(resources.ports or []),
+            'disk_size': resources.disk_size,
+            'image_id': resources.image_id,
+            'tpu': False,
+            'instance_type': resources.instance_type,
+            'num_nodes': 1,
+        }
+
+    # --------------------------------------------------------- credentials
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        creds = read_credentials()
+        missing = {'iam_api_key', 'resource_group_id'} - set(creds)
+        if not missing:
+            return True, None
+        return False, (f'IBM credentials incomplete: missing '
+                       f'{sorted(missing)} in {CREDENTIALS_PATH} '
+                       '(and set ibm.vpc_id / ibm.subnet_id in '
+                       '~/.skytpu/config.yaml; `ibmcloud login '
+                       '--apikey` authenticates the CLI).')
+
+    def get_current_user_identity(self) -> Optional[List[str]]:
+        creds = read_credentials()
+        key = creds.get('iam_api_key')
+        return [f'ibm:{key[:8]}'] if key else None
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        if os.path.exists(os.path.expanduser(CREDENTIALS_PATH)):
+            return {CREDENTIALS_PATH: CREDENTIALS_PATH}
+        return {}

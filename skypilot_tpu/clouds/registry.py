@@ -14,6 +14,7 @@ from skypilot_tpu.clouds import docker
 from skypilot_tpu.clouds import fluidstack
 from skypilot_tpu.clouds import gcp
 from skypilot_tpu.clouds import gke
+from skypilot_tpu.clouds import ibm
 from skypilot_tpu.clouds import kubernetes
 from skypilot_tpu.clouds import lambda_cloud
 from skypilot_tpu.clouds import local
@@ -29,6 +30,7 @@ CLOUD_REGISTRY: Dict[str, cloud_lib.Cloud] = {
     'fluidstack': fluidstack.FluidStack(),
     'gcp': gcp.GCP(),
     'gke': gke.GKE(),
+    'ibm': ibm.IBM(),
     'kubernetes': kubernetes.Kubernetes(),
     'lambda': lambda_cloud.LambdaCloud(),
     'local': local.Local(),

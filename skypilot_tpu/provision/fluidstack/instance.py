@@ -161,6 +161,10 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
                     'gpu_type': gpu_type,
                     'gpu_count': int(gpu_count or 1),
                     'ssh_key': key_name,
+                    # The optimizer priced THIS region's offering; an
+                    # unpinned create could land anywhere with
+                    # capacity.
+                    'region': config.region,
                 })
                 created.append(body.get('id') or
                                body.get('data', {}).get('id'))

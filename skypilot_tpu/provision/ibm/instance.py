@@ -1,0 +1,370 @@
+"""IBM Cloud VPC provisioner: ibmcloud CLI JSON with an injectable
+runner.
+
+Parity: /root/reference/sky/skylet/providers/ibm/ (node_provider +
+vpc_provider, ~1,700 LoC of ibm-vpc SDK + Ray plumbing) — rebuilt on
+the `ibmcloud is` CLI behind `set_cli_runner`, the same no-SDK seam
+as provision/azure and provision/oci.
+
+CLI surface used (all `--output json`):
+  ibmcloud is instances                       list (account-wide)
+  ibmcloud is instance-create NAME VPC ZONE PROFILE --subnet --image
+      --keys --resource-group-id               create one VSI
+  ibmcloud is floating-ip-reserve NAME --nic   public IP per VSI
+  ibmcloud is floating-ip-release ID -f
+  ibmcloud is instance-start|stop ID [-f]      power actions
+  ibmcloud is instance-delete ID -f            terminate
+  ibmcloud is keys / key-create                ssh key registry
+
+Instances are named `<cluster>-<rank>`; recovery filters the account
+listing by `<cluster>-<digits>`.  Each VSI gets a floating IP at
+create (VPC private IPs are unreachable from the client); the
+floating IP is named `<instance-name>-fip` and released on
+terminate.  The VPC/subnet come from the layered config (`ibm.vpc_id`,
+`ibm.subnet_id`) or IBM_VPC_ID/IBM_SUBNET_ID; gang semantics: N
+individual creates with a best-effort all-or-nothing sweep.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.utils import command_runner
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_SSH_USER = 'ubuntu'
+_KEY_NAME = 'skypilot-tpu'
+_DEFAULT_IMAGE_PREFIX = 'ibm-ubuntu-22-04'
+
+# CLI seam: runner(args: List[str]) -> (returncode, stdout, stderr).
+CliRunner = Callable[[List[str]], tuple]
+
+
+def _default_cli_runner(args: List[str]) -> tuple:
+    proc = subprocess.run(args, capture_output=True, text=True,
+                          check=False, timeout=900)
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+_cli_runner: CliRunner = _default_cli_runner
+
+
+def set_cli_runner(runner: Optional[CliRunner]) -> None:
+    """Inject a fake ibmcloud CLI for tests (None restores the real
+    one)."""
+    global _cli_runner
+    _cli_runner = runner or _default_cli_runner
+
+
+def _ibm(*args: str, allow_fail: bool = False) -> Any:
+    argv = ['ibmcloud', 'is', *args, '--output', 'json']
+    rc, stdout, stderr = _cli_runner(argv)
+    if rc != 0:
+        if allow_fail:
+            return None
+        raise exceptions.ProvisionError(
+            f'ibmcloud is {" ".join(args[:2])} failed (rc={rc}): '
+            f'{stderr.strip()[:500]}')
+    if not stdout.strip():
+        return {}
+    try:
+        return json.loads(stdout)
+    except ValueError as e:
+        raise exceptions.ProvisionError(
+            f'ibmcloud returned non-JSON output: {e}') from e
+
+
+def _net_config() -> Dict[str, str]:
+    from skypilot_tpu import config as config_lib  # pylint: disable=import-outside-toplevel
+    out = {}
+    for key, env in (('vpc_id', 'IBM_VPC_ID'),
+                     ('subnet_id', 'IBM_SUBNET_ID')):
+        value = os.environ.get(env) or config_lib.get_nested(
+            ('ibm', key), None)
+        if not value:
+            raise exceptions.ProvisionError(
+                f'IBM network not configured: set ibm.{key} in '
+                f'~/.skytpu/config.yaml or {env}.')
+        out[key] = value
+    return out
+
+
+def _resource_group() -> str:
+    from skypilot_tpu.clouds import ibm as ibm_cloud  # pylint: disable=import-outside-toplevel
+    group = ibm_cloud.read_credentials().get('resource_group_id')
+    if not group:
+        raise exceptions.ProvisionError(
+            'IBM resource_group_id missing from '
+            f'{ibm_cloud.CREDENTIALS_PATH}.')
+    return group
+
+
+def _instance_rank(inst: Dict[str, Any]) -> int:
+    return int(inst['name'].rsplit('-', 1)[-1])
+
+
+def _is_ours(name: str, cluster_name: str) -> bool:
+    prefix, _, rank = name.rpartition('-')
+    return prefix == cluster_name and rank.isdigit()
+
+
+def _list_instances(cluster_name: str) -> List[Dict[str, Any]]:
+    # NO allow_fail: a CLI failure (expired IAM token, network blip)
+    # must raise, not read as 'no instances' — an empty answer makes
+    # the status layer drop the cluster record while VSIs keep
+    # billing, and terminate would silently no-op.
+    out = _ibm('instances')
+    rows = out if isinstance(out, list) else []
+    mine = [r for r in rows
+            if _is_ours(r.get('name', ''), cluster_name) and
+            r.get('status') != 'deleting']
+    return sorted(mine, key=_instance_rank)
+
+
+def _ensure_key() -> str:
+    from skypilot_tpu import authentication  # pylint: disable=import-outside-toplevel
+    _, public_key_path = authentication.get_or_generate_keys()
+    keys = _ibm('keys', allow_fail=True) or []
+    for key in keys:
+        if key.get('name') == _KEY_NAME:
+            return _KEY_NAME
+    _ibm('key-create', _KEY_NAME, f'@{public_key_path}')
+    return _KEY_NAME
+
+
+def _default_image() -> str:
+    images = _ibm('images', '--status', 'available',
+                  allow_fail=True) or []
+    for image in images:
+        name = image.get('name', '')
+        if (name.startswith(_DEFAULT_IMAGE_PREFIX) and
+                'amd64' in name):
+            return image['id']
+    raise exceptions.ProvisionError(
+        f'No available {_DEFAULT_IMAGE_PREFIX}* amd64 image in this '
+        'region; pass resources.image_id.')
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    cluster_name = config.cluster_name
+    deploy_vars = config.deploy_vars
+    instance_type = deploy_vars.get('instance_type')
+    if not instance_type:
+        raise exceptions.ProvisionError(
+            'IBM provisioning needs an instance_type (TPUs live on '
+            'GCP).')
+    count = config.count
+    zone = (config.zones[0] if config.zones
+            else f'{config.region}-1')
+
+    existing = _list_instances(cluster_name)
+    created: List[str] = []
+    resumed: List[str] = []
+    if existing:
+        if len(existing) != count:
+            raise exceptions.ResourcesMismatchError(
+                f'Cluster {cluster_name} exists with {len(existing)} '
+                f'instances; requested {count}.')
+        stopped = [r['id'] for r in existing
+                   if r.get('status') in ('stopped', 'stopping')]
+        for iid in stopped:
+            _ibm('instance-start', iid)
+        resumed = stopped
+    else:
+        net = _net_config()
+        key_name = _ensure_key()
+        image = deploy_vars.get('image_id') or _default_image()
+        group = _resource_group()
+        try:
+            for rank in range(count):
+                name = f'{cluster_name}-{rank}'
+                # Real CLI shape: instance-create NAME VPC ZONE
+                # PROFILE SUBNET [flags] — SUBNET is positional.
+                out = _ibm('instance-create', name, net['vpc_id'],
+                           zone, instance_type, net['subnet_id'],
+                           '--image', image,
+                           '--keys', key_name,
+                           '--boot-volume-size',
+                           str(int(deploy_vars.get('disk_size')
+                                   or 100)),
+                           '--resource-group-id', group)
+                iid = out['id']
+                created.append(iid)
+                # Public reachability: one floating IP per VSI, bound
+                # to its primary NIC (VPC private IPs are not
+                # client-reachable).
+                nic = out['primary_network_interface']['id']
+                _ibm('floating-ip-reserve', f'{name}-fip',
+                     '--nic', nic)
+        except (exceptions.ProvisionError, KeyError) as e:
+            # Best-effort all-or-nothing sweep (instances + their
+            # floating IPs); never mask the original error.
+            for rank, iid in enumerate(created):
+                try:
+                    _ibm('instance-delete', iid, '-f')
+                    _release_fip(f'{cluster_name}-{rank}-fip')
+                except exceptions.ProvisionError as sweep_err:
+                    logger.warning(
+                        f'Sweep of partial VSI {iid} failed: '
+                        f'{sweep_err}')
+            if isinstance(e, KeyError):
+                raise exceptions.ProvisionError(
+                    f'ibmcloud instance-create returned no {e} '
+                    'field.') from e
+            raise
+    head = existing[0]['id'] if existing else created[0]
+    return common.ProvisionRecord(
+        provider_name='ibm', cluster_name=cluster_name,
+        region=config.region, zone=zone, head_instance_id=head,
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def _fips() -> List[Dict[str, Any]]:
+    return _ibm('floating-ips', allow_fail=True) or []
+
+
+def _release_fip(fip_name: str) -> None:
+    for fip in _fips():
+        if fip.get('name') == fip_name:
+            _ibm('floating-ip-release', fip['id'], '-f',
+                 allow_fail=True)
+            return
+
+
+def wait_instances(cluster_name: str, state: Optional[str] = None) -> None:
+    want = state or 'running'
+    deadline = time.time() + 900
+    while time.time() < deadline:
+        instances = _list_instances(cluster_name)
+        if instances and all(r.get('status') == want
+                             for r in instances):
+            return
+        bad = [r['id'] for r in instances
+               if r.get('status') == 'failed']
+        if bad:
+            raise exceptions.ProvisionError(
+                f'VSIs {bad} of {cluster_name} failed while '
+                'provisioning.')
+        time.sleep(10)
+    raise exceptions.ProvisionError(
+        f'VSIs of {cluster_name} did not reach {want!r} in 900s.')
+
+
+def wait_capacity(cluster_name: str, timeout: float = 0) -> bool:
+    del cluster_name, timeout
+    return True
+
+
+def stop_instances(cluster_name: str, worker_only: bool = False) -> None:
+    for inst in _list_instances(cluster_name):
+        if worker_only and _instance_rank(inst) == 0:
+            continue
+        _ibm('instance-stop', inst['id'], '-f')
+
+
+def terminate_instances(cluster_name: str,
+                        worker_only: bool = False) -> None:
+    # One floating-ip listing for the whole teardown, not one per node.
+    fips_by_name = {f.get('name'): f.get('id') for f in _fips()}
+    for inst in _list_instances(cluster_name):
+        if worker_only and _instance_rank(inst) == 0:
+            continue
+        _ibm('instance-delete', inst['id'], '-f')
+        fip_id = fips_by_name.get(f'{inst["name"]}-fip')
+        if fip_id:
+            _ibm('floating-ip-release', fip_id, '-f', allow_fail=True)
+
+
+# Every live state maps to SOMETHING (None == gone == record removal).
+_STATE_MAP = {
+    'running': ClusterStatus.UP,
+    'pending': ClusterStatus.INIT,
+    'starting': ClusterStatus.INIT,
+    'restarting': ClusterStatus.INIT,
+    'resuming': ClusterStatus.INIT,
+    'failed': ClusterStatus.INIT,  # exists + needs manual sweep
+    'pausing': ClusterStatus.STOPPED,
+    'paused': ClusterStatus.STOPPED,
+    'stopping': ClusterStatus.STOPPED,
+    'stopped': ClusterStatus.STOPPED,
+}
+
+
+def query_instances(cluster_name: str
+                    ) -> Dict[str, Optional[ClusterStatus]]:
+    return {
+        inst['id']: _STATE_MAP.get(inst.get('status'))
+        for inst in _list_instances(cluster_name)
+    }
+
+
+def get_cluster_info(cluster_name: str,
+                     region: Optional[str] = None) -> common.ClusterInfo:
+    instances = [r for r in _list_instances(cluster_name)
+                 if r.get('status') == 'running']
+    if not instances:
+        raise exceptions.FetchClusterInfoError(
+            exceptions.FetchClusterInfoError.Reason.HEAD)
+    fips = {f.get('name'): f.get('address') for f in _fips()}
+    infos = []
+    for inst in instances:
+        rank = _instance_rank(inst)
+        nic = inst.get('primary_network_interface') or {}
+        private = (nic.get('primary_ip') or {}).get('address', '')
+        infos.append(
+            common.InstanceInfo(
+                instance_id=inst['id'],
+                internal_ip=private,
+                external_ip=fips.get(f'{inst["name"]}-fip'),
+                ssh_port=22,
+                slice_id=0,
+                worker_id=rank,
+                tags={'rank': str(rank)},
+            ))
+    from skypilot_tpu import authentication  # pylint: disable=import-outside-toplevel
+    private_key, _ = authentication.get_or_generate_keys()
+    return common.ClusterInfo(
+        provider_name='ibm',
+        cluster_name=cluster_name,
+        region=region or '',
+        zone=None,
+        instances=infos,
+        head_instance_id=infos[0].instance_id,
+        ssh_user=DEFAULT_SSH_USER,
+        ssh_private_key=private_key,
+    )
+
+
+def open_ports(cluster_name: str, ports: List[int]) -> None:
+    # Ports ride the VPC's security group (account topology); the
+    # cloud layer gates OPEN_PORTS so reaching this is a bug.
+    raise exceptions.NotSupportedError(
+        f'IBM ports ride the VPC security group (requested {ports}).')
+
+
+def cleanup_ports(cluster_name: str) -> None:
+    del cluster_name
+
+
+def get_command_runners(cluster_info: common.ClusterInfo,
+                        **kwargs: Any) -> List[command_runner.CommandRunner]:
+    del kwargs
+    runners: List[command_runner.CommandRunner] = []
+    for inst in cluster_info.instances:
+        ip = inst.external_ip or inst.internal_ip
+        runners.append(
+            command_runner.SSHCommandRunner(
+                node=(ip, inst.ssh_port),
+                ssh_user=cluster_info.ssh_user,
+                ssh_private_key=cluster_info.ssh_private_key,
+                ssh_control_name=cluster_info.cluster_name,
+            ))
+    return runners

@@ -87,6 +87,7 @@ class TestProvisionLifecycle:
         assert inp['gpu_type'] == 'A100_PCIE_80GB'
         assert inp['gpu_count'] == 1
         assert inp['ssh_key'] == 'skypilot-tpu'
+        assert inp['region'] == 'NORWAY'  # priced region is pinned
 
         status = fs_instance.query_instances('fsc')
         assert all(s.value == 'UP' for s in status.values())

@@ -1,0 +1,268 @@
+"""IBM Cloud VPC + ibmcloud-CLI provisioner (cloud breadth).  The CLI
+sits behind an injectable runner (provision/ibm/instance.py:
+set_cli_runner); VPC/subnet come from config like OCI's compartment.
+Covers the floating-IP lifecycle that makes VPC VSIs reachable.
+Model: tests/unit/test_oci.py."""
+from __future__ import annotations
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.ibm import instance as ibm_instance
+
+
+class FakeIbmCli:
+    """Minimal VPC state machine keyed on the ibmcloud-is argv
+    surface."""
+
+    def __init__(self):
+        self.instances = {}   # id -> instance dict (list shape)
+        self.fips = {}        # id -> fip dict
+        self.keys = []
+        self.calls = []
+        self._next = 0
+        self.fail_after = None
+
+    def _json(self, obj):
+        import json
+        return 0, json.dumps(obj), ''
+
+    def __call__(self, argv):
+        self.calls.append(argv)
+        assert argv[:2] == ['ibmcloud', 'is']
+        assert argv[-2:] == ['--output', 'json']
+        args = argv[2:-2]
+        cmd = args[0]
+        if cmd == 'instances':
+            return self._json(list(self.instances.values()))
+        if cmd == 'images':
+            return self._json([
+                {'id': 'img-arm', 'name': 'ibm-ubuntu-22-04-arm64-1'},
+                {'id': 'img-ok', 'name': 'ibm-ubuntu-22-04-amd64-3'},
+            ])
+        if cmd == 'keys':
+            return self._json(list(self.keys))
+        if cmd == 'key-create':
+            self.keys.append({'name': args[1]})
+            return self._json({'name': args[1]})
+        if cmd == 'instance-create':
+            if (self.fail_after is not None and
+                    len(self.instances) >= self.fail_after):
+                return 1, '', 'quota exceeded for profile'
+            name, vpc, zone, profile, subnet = args[1:6]
+            assert subnet == 'subnet-1'  # positional, not a flag
+            self._next += 1
+            iid = f'vsi-{self._next:04d}'
+            inst = {
+                'id': iid, 'name': name, 'status': 'running',
+                'vpc': {'id': vpc}, 'zone': {'name': zone},
+                'profile': {'name': profile},
+                'primary_network_interface': {
+                    'id': f'nic-{iid}',
+                    'primary_ip': {'address': f'10.8.0.{self._next}'},
+                },
+                '_args': args,
+            }
+            self.instances[iid] = inst
+            return self._json(inst)
+        if cmd == 'floating-ip-reserve':
+            self._next += 1
+            fip = {'id': f'fip-{self._next:04d}', 'name': args[1],
+                   'address': f'158.1.0.{self._next}'}
+            self.fips[fip['id']] = fip
+            return self._json(fip)
+        if cmd == 'floating-ips':
+            return self._json(list(self.fips.values()))
+        if cmd == 'floating-ip-release':
+            self.fips.pop(args[1], None)
+            return self._json({})
+        if cmd in ('instance-start', 'instance-stop'):
+            iid = args[1]
+            self.instances[iid]['status'] = (
+                'running' if cmd == 'instance-start' else 'stopped')
+            return self._json({})
+        if cmd == 'instance-delete':
+            self.instances.pop(args[1], None)
+            return self._json({})
+        return 1, '', f'unhandled: {cmd}'
+
+
+@pytest.fixture
+def fake_cli(monkeypatch, tmp_path):
+    monkeypatch.setenv('IBM_VPC_ID', 'vpc-1')
+    monkeypatch.setenv('IBM_SUBNET_ID', 'subnet-1')
+    monkeypatch.setenv('HOME', str(tmp_path))
+    ibm_dir = tmp_path / '.ibm'
+    ibm_dir.mkdir()
+    (ibm_dir / 'credentials.yaml').write_text(
+        'iam_api_key: ik-000111222\nresource_group_id: rg-1\n')
+    cli = FakeIbmCli()
+    ibm_instance.set_cli_runner(cli)
+    yield cli
+    ibm_instance.set_cli_runner(None)
+
+
+def _config(cluster='ibc', count=2, itype='gx2-8x64x1v100'):
+    return provision_common.ProvisionConfig(
+        provider_name='ibm', cluster_name=cluster, region='us-south',
+        zones=['us-south-1'],
+        deploy_vars={'instance_type': itype, 'disk_size': 100},
+        count=count)
+
+
+class TestProvisionLifecycle:
+
+    def test_create_query_info_terminate(self, fake_cli):
+        record = ibm_instance.run_instances(_config())
+        assert record.provider_name == 'ibm'
+        assert record.zone == 'us-south-1'
+        assert len(record.created_instance_ids) == 2
+        inst = next(iter(fake_cli.instances.values()))
+        assert inst['_args'][2] == 'vpc-1'
+        assert inst['_args'][5] == 'subnet-1'  # SUBNET is positional
+        assert inst['_args'][
+            inst['_args'].index('--boot-volume-size') + 1] == '100'
+        # amd64 image picked over the arm64 row.
+        assert inst['_args'][inst['_args'].index('--image') + 1] == \
+            'img-ok'
+        # One floating IP per VSI, named after the instance.
+        assert sorted(f['name'] for f in fake_cli.fips.values()) == [
+            'ibc-0-fip', 'ibc-1-fip']
+
+        status = ibm_instance.query_instances('ibc')
+        assert all(s.value == 'UP' for s in status.values())
+
+        info = ibm_instance.get_cluster_info('ibc')
+        assert info.ssh_user == 'ubuntu'
+        assert [i.tags['rank'] for i in info.instances] == ['0', '1']
+        # SSH goes to the floating IP, not the private VPC address.
+        assert info.instances[0].external_ip.startswith('158.')
+        assert info.instances[0].internal_ip.startswith('10.8.')
+
+        ibm_instance.terminate_instances('ibc')
+        assert ibm_instance.query_instances('ibc') == {}
+        assert fake_cli.fips == {}  # floating IPs released too
+
+    def test_stop_start_resume(self, fake_cli):
+        ibm_instance.run_instances(_config())
+        ibm_instance.stop_instances('ibc')
+        assert all(s.value == 'STOPPED' for s in
+                   ibm_instance.query_instances('ibc').values())
+        record = ibm_instance.run_instances(_config())
+        assert len(record.resumed_instance_ids) == 2
+        assert all(s.value == 'UP' for s in
+                   ibm_instance.query_instances('ibc').values())
+
+    def test_partial_create_sweeps_instances_and_fips(self, fake_cli):
+        fake_cli.fail_after = 1
+        with pytest.raises(exceptions.ProvisionError,
+                           match='quota exceeded'):
+            ibm_instance.run_instances(_config(count=2))
+        assert fake_cli.instances == {}
+        assert fake_cli.fips == {}
+
+    def test_count_mismatch_rejected(self, fake_cli):
+        ibm_instance.run_instances(_config(count=2))
+        with pytest.raises(exceptions.ResourcesMismatchError):
+            ibm_instance.run_instances(_config(count=3))
+
+    def test_missing_network_config_rejected(self, fake_cli,
+                                             monkeypatch):
+        monkeypatch.delenv('IBM_VPC_ID')
+        with pytest.raises(exceptions.ProvisionError,
+                           match='ibm.vpc_id'):
+            ibm_instance.run_instances(_config())
+
+    def test_key_registered_once(self, fake_cli):
+        ibm_instance.run_instances(_config(cluster='a', count=1))
+        ibm_instance.run_instances(_config(cluster='b', count=1))
+        creates = [c for c in fake_cli.calls if c[2] == 'key-create']
+        assert len(creates) == 1
+
+    def test_foreign_instance_ignored(self, fake_cli):
+        fake_cli.instances['alien'] = {
+            'id': 'alien', 'name': 'ibc-head', 'status': 'running',
+            'primary_network_interface': {'id': 'n',
+                                          'primary_ip': {}}}
+        ibm_instance.run_instances(_config(count=1))
+        assert len(ibm_instance.query_instances('ibc')) == 1
+        ibm_instance.terminate_instances('ibc')
+        assert 'alien' in fake_cli.instances
+
+    def test_list_failure_raises_not_empty(self, fake_cli):
+        """An ibmcloud failure (expired token) must raise, never read
+        as 'no instances' — the status layer would drop the record
+        while VSIs keep billing (review finding)."""
+        ibm_instance.run_instances(_config(count=1))
+        orig = fake_cli.__class__.__call__
+
+        def broken(self, argv):
+            if argv[2] == 'instances':
+                return 1, '', 'token expired'
+            return orig(self, argv)
+
+        fake_cli.__class__.__call__ = broken
+        try:
+            with pytest.raises(exceptions.ProvisionError,
+                               match='token expired'):
+                ibm_instance.query_instances('ibc')
+        finally:
+            fake_cli.__class__.__call__ = orig
+
+    def test_live_states_never_read_as_gone(self, fake_cli):
+        ibm_instance.run_instances(_config(count=1))
+        inst = next(iter(fake_cli.instances.values()))
+        for state in ('pending', 'restarting', 'resuming', 'failed',
+                      'paused'):
+            inst['status'] = state
+            statuses = ibm_instance.query_instances('ibc')
+            assert list(statuses.values())[0] is not None, state
+
+
+class TestIbmCloud:
+
+    def test_feasibility_pricing_zones(self):
+        ib = registry.CLOUD_REGISTRY['ibm']
+        r = sky.Resources(cloud='ibm', accelerators='V100:2')
+        launchable, _ = ib.get_feasible_launchable_resources(r)
+        assert launchable
+        assert launchable[0].instance_type == 'gx2-16x128x2v100'
+        assert catalog.get_hourly_cost(
+            'ibm', 'gx2-8x64x1v100') == pytest.approx(2.49)
+        regions = ib.regions_with_offering(
+            sky.Resources(cloud='ibm', instance_type='gx2-8x64x1v100'))
+        assert {r.name for r in regions} == {'us-south', 'us-east'}
+
+    def test_tpu_spot_ports_gated(self):
+        from skypilot_tpu.clouds import cloud as cloud_lib
+        ib = registry.CLOUD_REGISTRY['ibm']
+        assert ib.get_feasible_launchable_resources(
+            sky.Resources(accelerators='tpu-v5e-8'))[0] == []
+        spot = sky.Resources(cloud='ibm', accelerators='V100:1',
+                             capacity='spot')
+        assert ib.get_feasible_launchable_resources(spot)[0] == []
+        with pytest.raises(exceptions.NotSupportedError):
+            ib.check_features_are_supported(
+                sky.Resources(cloud='ibm'),
+                {cloud_lib.CloudImplementationFeatures.OPEN_PORTS})
+
+    def test_credentials_from_yaml(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('HOME', str(tmp_path))
+        ib = registry.CLOUD_REGISTRY['ibm']
+        ok, reason = ib.check_credentials()
+        assert not ok and 'iam_api_key' in reason
+        ibm_dir = tmp_path / '.ibm'
+        ibm_dir.mkdir()
+        (ibm_dir / 'credentials.yaml').write_text(
+            'iam_api_key: ik-abcdef123\n')
+        ok, reason = ib.check_credentials()
+        assert not ok and 'resource_group_id' in reason
+        (ibm_dir / 'credentials.yaml').write_text(
+            'iam_api_key: ik-abcdef123\nresource_group_id: rg-9\n')
+        ok, _ = ib.check_credentials()
+        assert ok
+        assert ib.get_current_user_identity() == ['ibm:ik-abcde']
