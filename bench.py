@@ -53,44 +53,73 @@ def _peak_flops(device) -> float:
     return 197e12
 
 
-def _run_config(cfg, batch: int, seq: int, n_steps: int):
+def _run_config(cfg, batch: int, seq: int, n_steps: int, tcfg=None):
     """Compile + warm up + time one training config.
 
-    Returns (tokens_per_sec, n_params, final_loss).  Synchronisation
-    contract (VERDICT round-2 weak #3): `jax.block_until_ready` was
-    observed NOT to synchronize on the relay TPU platform (a loop timed
-    that way yielded a physically impossible 132 MFU), so the timed
-    region ends with a `device_get` of the FINAL step's loss.  That
-    value transitively depends on every prior step (each step consumes
-    the previous step's donated TrainState), so fetching it cannot
-    complete before all timed steps actually executed on the chip —
-    while avoiding a per-step host round-trip (~100 ms through the
-    relay tunnel, measured — it inflated step time ~35%).
-    """
-    import jax
-    import jax.numpy as jnp
+    Returns (tokens_per_sec, n_params, final_loss, peak_bytes).
+    `tcfg` threads the hot-path knobs (fused CE, accumulation) into
+    train_step; batches stream through the double-buffered
+    DevicePrefetcher (data/prefetch.py) so step N+1's host->device
+    transfer overlaps step N's compute — the same path the gang job
+    contract uses.  peak_bytes is the compiled step's temp allocation
+    (XLA CompiledMemoryStats; None when the backend hides it).
 
+    Synchronisation contract (VERDICT round-2 weak #3):
+    `jax.block_until_ready` was observed NOT to synchronize on the
+    relay TPU platform (a loop timed that way yielded a physically
+    impossible 132 MFU), so the timed region ends with a `device_get`
+    of the FINAL step's loss.  That value transitively depends on every
+    prior step (each step consumes the previous step's donated
+    TrainState), so fetching it cannot complete before all timed steps
+    actually executed on the chip — while avoiding a per-step host
+    round-trip (~100 ms through the relay tunnel, measured — it
+    inflated step time ~35%).
+    """
+    import functools
+
+    import jax
+    import numpy as np
+
+    from skypilot_tpu.data.prefetch import prefetch_to_device
     from skypilot_tpu.models.train import TrainConfig
     from skypilot_tpu.models.train import create_train_state
     from skypilot_tpu.models.train import train_step
 
-    state, _ = create_train_state(cfg, TrainConfig(), batch_size=batch,
-                                  seq_len=seq)
+    state, _ = create_train_state(cfg, tcfg or TrainConfig(),
+                                  batch_size=batch, seq_len=seq)
     n_params = _param_count(state.params)
-    step = jax.jit(train_step, donate_argnums=(0,))
-    key = jax.random.PRNGKey(0)
-    tokens = jax.random.randint(key, (batch, seq + 1), 0, cfg.vocab_size,
-                                dtype=jnp.int32)
-    batch_dict = {'tokens': tokens}
-    for _ in range(2):
-        state, metrics = step(state, batch_dict)
+    step_fn = functools.partial(train_step, tcfg=tcfg)
+    jitted = jax.jit(step_fn, donate_argnums=(0,))
+
+    rng = np.random.default_rng(0)
+
+    def host_batches(n):
+        for _ in range(n):
+            yield {'tokens': rng.integers(
+                0, cfg.vocab_size,
+                size=(batch, seq + 1)).astype(np.int32)}
+
+    warmup = 2
+    # One AOT compile serves both the memory stats and execution (a
+    # second trace through jit would double the TPU compile time).
+    first = next(prefetch_to_device(host_batches(1)))
+    compiled = jitted.lower(state, first).compile()
+    try:
+        stats = compiled.memory_analysis()
+        peak_bytes = int(stats.temp_size_in_bytes)
+    except Exception:  # pylint: disable=broad-except
+        peak_bytes = None
+
+    prefetched = prefetch_to_device(host_batches(warmup + n_steps))
+    for _ in range(warmup):
+        state, metrics = compiled(state, next(prefetched))
     float(jax.device_get(metrics['loss']))
     t0 = time.perf_counter()
     for _ in range(n_steps):
-        state, metrics = step(state, batch_dict)
+        state, metrics = compiled(state, next(prefetched))
     final_loss = float(jax.device_get(metrics['loss']))
     dt = time.perf_counter() - t0
-    return batch * seq * n_steps / dt, n_params, final_loss
+    return batch * seq * n_steps / dt, n_params, final_loss, peak_bytes
 
 
 def main() -> None:
@@ -119,16 +148,20 @@ def main() -> None:
         ]
         n_steps = 20
     else:  # CI / laptop fallback
-        candidates = [('tiny', configs.get_config('tiny'))]
+        # vocab 8192 (vs tiny's 256) makes the logits tensor the
+        # dominant live buffer, so the fused-CE memory drop is visible
+        # even at CPU scale.
+        candidates = [('tiny-v8k',
+                       configs.get_config('tiny', vocab_size=8192))]
         batch, seq = 4, 128
         n_steps = 3
 
-    tokens_per_sec = n_params = final_loss = None
+    tokens_per_sec = n_params = final_loss = peak_bytes = None
     config_name = cfg_used = None
     for i, (name, cfg) in enumerate(candidates):
         try:
-            tokens_per_sec, n_params, final_loss = _run_config(
-                cfg, batch, seq, n_steps)
+            tokens_per_sec, n_params, final_loss, peak_bytes = \
+                _run_config(cfg, batch, seq, n_steps)
             config_name, cfg_used = name, cfg
             break
         except Exception as e:  # pylint: disable=broad-except
@@ -146,8 +179,25 @@ def main() -> None:
                 raise
     assert tokens_per_sec is not None  # loop breaks on success or raises
 
+    # Fused linear+CE pass over the SAME schedule (models/losses.py):
+    # the [b,s,V] logits tensor never materializes.  Best-effort — a
+    # fused failure must not cost the unfused number already in hand.
+    from skypilot_tpu.models.train import TrainConfig
+    fused_tps = fused_peak = None
+    try:
+        chunk = min(8192, max(1024, cfg_used.vocab_size // 8))
+        fused_tps, _, fused_loss, fused_peak = _run_config(
+            cfg_used, batch, seq, n_steps,
+            tcfg=TrainConfig(fused_ce=True, vocab_chunk=chunk))
+        print(f'# fused CE: {fused_tps:.1f} tok/s '
+              f'loss={fused_loss:.3f} peak={fused_peak}', file=sys.stderr)
+    except Exception as e:  # pylint: disable=broad-except
+        print(f'# fused CE attempt failed: '
+              f'{type(e).__name__}: {e}'[:300], file=sys.stderr)
+
+    best_tps = max(tokens_per_sec, fused_tps or 0.0)
     # Training FLOPs/token ~= 6 * params; MFU vs chip roofline.
-    achieved_flops = 6.0 * n_params * tokens_per_sec
+    achieved_flops = 6.0 * n_params * best_tps
     mfu = achieved_flops / _peak_flops(dev)
     vs_baseline = mfu / 0.40  # 1.0 == 40% MFU (well-tuned TPU training)
 
@@ -156,12 +206,17 @@ def main() -> None:
     # for a TPU number by scoreboard consumers reading 'parsed' alone.
     print(json.dumps({
         'metric': _METRIC,
-        'value': round(tokens_per_sec, 1),
+        'value': round(best_tps, 1),
         'unit': 'tokens/s',
         'vs_baseline': round(vs_baseline, 3),
         'device': dev.device_kind,
         'mfu': round(mfu, 4),
         'config': config_name,
+        'tokens_per_sec_unfused': round(tokens_per_sec, 1),
+        'tokens_per_sec_fused': (round(fused_tps, 1)
+                                 if fused_tps is not None else None),
+        'peak_bytes_unfused': peak_bytes,
+        'peak_bytes_fused': fused_peak,
         'synced_timing': 'device_get_final_loss_chained',
     }))
     print(f'# device={dev.device_kind} config={config_name} '
@@ -174,7 +229,7 @@ def main() -> None:
         key = throughput_registry.device_kind_to_key(dev.device_kind)
         if key is not None:
             throughput_registry.record_measurement(
-                key, mfu, tokens_per_sec=tokens_per_sec,
+                key, mfu, tokens_per_sec=best_tps,
                 model=f'{cfg_used.d_model}x{cfg_used.n_layers}'
                       f'/{config_name}')
 

@@ -32,6 +32,19 @@ def main() -> None:
     parser.add_argument('--steps', type=int, default=20)
     parser.add_argument('--batch-size', type=int, default=8)
     parser.add_argument('--seq-len', type=int, default=512)
+    parser.add_argument('--fused-ce', action='store_true',
+                        help='Fused linear+CE loss (models/losses.py): '
+                             'the [b,s,V] logits tensor never '
+                             'materializes — the big win for '
+                             'Llama-class vocabs.')
+    parser.add_argument('--accum-steps', type=int, default=1,
+                        help='Microbatch gradient accumulation: '
+                             'effective batch = batch-size, computed '
+                             'in accum-steps scan slices of '
+                             'batch-size/accum-steps rows each '
+                             '(same loss trajectory, lower peak HBM).')
+    parser.add_argument('--vocab-chunk', type=int, default=8192,
+                        help='Vocab chunk width for the fused CE.')
     parser.add_argument('--fsdp', type=int, default=1)
     parser.add_argument('--tensor', type=int, default=1)
     parser.add_argument('--sequence', type=int, default=1)
@@ -84,10 +97,13 @@ def main() -> None:
     else:
         cfg = configs.get_config(args.model,
                                  sequence_parallel=args.sp_mode)
+    tcfg = TrainConfig(fused_ce=args.fused_ce,
+                       accum_steps=args.accum_steps,
+                       vocab_chunk=args.vocab_chunk)
     state, shardings = create_train_state(
-        cfg, TrainConfig(), mesh=mesh, batch_size=args.batch_size,
+        cfg, tcfg, mesh=mesh, batch_size=args.batch_size,
         seq_len=args.seq_len)
-    step_fn = jit_train_step(shardings, token_batch_sharding(mesh))
+    step_fn = jit_train_step(shardings, token_batch_sharding(mesh), tcfg)
 
     start_step = 0
     mgr = None
@@ -105,9 +121,12 @@ def main() -> None:
 
     cb = callbacks.init(total_steps=args.steps)
     if args.data:
-        # Real data path: host-sharded resumable batches + async device
-        # prefetch (resume continues at start_step deterministically).
+        # Real data path: host-sharded resumable batches + the
+        # double-buffered device prefetcher (data/prefetch.py) — step
+        # N+1's host->device transfer overlaps step N's compute
+        # (resume continues at start_step deterministically).
         from skypilot_tpu.data import loader as loader_lib
+        from skypilot_tpu.data import prefetch as prefetch_lib
         from skypilot_tpu.parallel import distributed
         batches = loader_lib.HostShardedBatches(
             loader_lib.TokenDataset(args.data),
@@ -115,7 +134,7 @@ def main() -> None:
             seq_len=args.seq_len,
             host_rank=distributed.host_rank(),
             num_hosts=distributed.num_hosts())
-        batch_iter = loader_lib.DevicePrefetcher(
+        batch_iter = prefetch_lib.prefetch_to_device(
             batches.batches(start_step=start_step),
             sharding=token_batch_sharding(mesh))
     else:

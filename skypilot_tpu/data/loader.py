@@ -12,21 +12,24 @@ user code):
   coordination, and (2) resuming from a checkpoint is just "continue
   at step N" — the loader IS the data-side half of the checkpoint
   contract (data/checkpoints.py holds the model side).
-- `DevicePrefetcher`: a one-slot background thread that stages the
-  next batch onto device (optionally with a NamedSharding) while the
-  current step computes — hides host->HBM latency without pulling in a
-  framework dependency.
+- `DevicePrefetcher` (re-exported from data/prefetch.py, where the
+  training hot path's double-buffered implementation lives): stages
+  upcoming batches onto device while the current step computes —
+  hides host->HBM latency without pulling in a framework dependency.
 """
 from __future__ import annotations
 
-import queue
-import threading
-from typing import Any, Dict, Iterator, Optional
+from typing import Dict, Iterator
 
 import numpy as np
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
+from skypilot_tpu.data.prefetch import DevicePrefetcher
+from skypilot_tpu.data.prefetch import prefetch_to_device
+
+__all__ = ['TokenDataset', 'HostShardedBatches', 'DevicePrefetcher',
+           'prefetch_to_device', 'write_token_file']
 
 logger = sky_logging.init_logger(__name__)
 
@@ -116,68 +119,8 @@ class HostShardedBatches:
                 for s in starts[lo:lo + self.local_batch]]
         return {'tokens': np.stack(rows).astype(np.int32)}
 
-    def batches(self, start_step: int = 0) -> Iterator[Dict[str, Any]]:
+    def batches(self, start_step: int = 0) -> Iterator[Dict[str, np.ndarray]]:
         step = start_step
         while True:
             yield self.batch_at(step)
             step += 1
-
-
-class DevicePrefetcher:
-    """Stage the next batch onto device while the current one computes.
-
-    Wraps any iterator of host arrays; `sharding` (a NamedSharding)
-    places batches directly into their distributed layout.  Depth-1
-    double buffering — deeper queues only add HBM pressure when the
-    producer is a memmap.
-    """
-
-    def __init__(self, iterator: Iterator[Any],
-                 sharding: Optional[Any] = None, depth: int = 1):
-        self._iterator = iterator
-        self._sharding = sharding
-        self._queue: 'queue.Queue[Any]' = queue.Queue(maxsize=depth)
-        self._done = object()
-        self._error: Optional[BaseException] = None
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
-
-    def _put_on_device(self, batch: Any) -> Any:
-        import jax  # pylint: disable=import-outside-toplevel
-        if self._sharding is not None:
-            if jax.process_count() > 1:
-                # Multi-host: this process holds only ITS stripe of the
-                # global batch (HostShardedBatches); assemble the global
-                # array from per-process local data.  A plain device_put
-                # here would silently treat the stripe as the whole
-                # batch (dropping every other host's rows).
-                return jax.tree.map(
-                    lambda a: jax.make_array_from_process_local_data(
-                        self._sharding, a), batch)
-            return jax.tree.map(
-                lambda a: jax.device_put(a, self._sharding), batch)
-        return jax.tree.map(jax.device_put, batch)
-
-    def _run(self) -> None:
-        try:
-            for batch in self._iterator:
-                self._queue.put(self._put_on_device(batch))
-        except BaseException as e:  # pylint: disable=broad-except
-            self._error = e
-        finally:
-            self._queue.put(self._done)
-
-    def __iter__(self) -> 'DevicePrefetcher':
-        return self
-
-    def __next__(self) -> Any:
-        item = self._queue.get()
-        if item is self._done:
-            # Re-enqueue the sentinel: the iterator protocol allows
-            # repeated next() after exhaustion (must keep raising, not
-            # deadlock on an empty queue).
-            self._queue.put(self._done)
-            if self._error is not None:
-                raise self._error
-            raise StopIteration
-        return item

@@ -8,10 +8,13 @@ pjit-able train step, and orbax checkpointing wired to the framework's
 checkpoint-dir contract.
 """
 from skypilot_tpu.models.configs import ModelConfig
+from skypilot_tpu.models.losses import fused_linear_cross_entropy
+from skypilot_tpu.models.losses import streaming_cross_entropy
 from skypilot_tpu.models.transformer import Transformer
 from skypilot_tpu.models.train import TrainConfig
 from skypilot_tpu.models.train import create_train_state
 from skypilot_tpu.models.train import train_step
 
 __all__ = ['ModelConfig', 'TrainConfig', 'Transformer',
-           'create_train_state', 'train_step']
+           'create_train_state', 'fused_linear_cross_entropy',
+           'streaming_cross_entropy', 'train_step']

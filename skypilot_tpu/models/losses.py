@@ -1,0 +1,295 @@
+"""Streaming + fused cross-entropy: the training hot path never
+materializes the [batch, seq, vocab] float32 log-softmax.
+
+`bench` scores this repo on llama_train_tokens_per_sec_per_chip, and
+for a Llama-class vocab (128k) the full-logits CE in models/train.py
+is the single largest live tensor of the step — bigger than every
+activation the scan/remat machinery avoids keeping.  Two exact
+(not approximate) replacements:
+
+- `streaming_cross_entropy(logits, ...)`: takes existing logits but
+  runs the log-softmax as an online logsumexp over vocab chunks, so
+  the f32 [b,s,V] softmax copy never exists; the backward writes the
+  (unavoidable) d_logits buffer chunk by chunk.
+- `fused_linear_cross_entropy(hidden, kernel, ...)`: takes the final
+  hidden states [b,s,d] plus the lm-head kernel [d,V] and computes
+  each vocab chunk's logits on the fly inside the same online
+  logsumexp — the [b,s,V] tensor never exists in either pass.  The
+  backward recomputes each chunk's logits (flash-attention-style
+  rematerialisation) and accumulates dx/dW per chunk.
+
+Both carry a custom VJP: without it, reverse-mode AD through the chunk
+scan would save per-chunk logits as residuals and quietly rebuild the
+full [b,s,V] footprint.  Matmul dtype follows the kernel's dtype —
+models/transformer.py pre-casts the kernel per cfg.logits_in_f32, so
+fused numerics match the unfused DenseGeneral path; the logsumexp
+itself is always f32, same as train.loss_fn.
+
+Masking contract matches train.loss_fn exactly: mean over all targets
+when mask is None, else sum(nll * mask) / max(sum(mask), 1).  The
+'sum' reduction returns the raw summed NLL for microbatch gradient
+accumulation (train.train_step divides by the full-batch denominator
+after accumulating, which is what makes accum_steps=k bitwise-
+equivalent in expectation to one big batch).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_VOCAB_CHUNK = 8192
+
+
+def _ones_mask(targets):
+    return jnp.ones(targets.shape, jnp.float32)
+
+
+def _denominator(targets, mask):
+    if mask is None:
+        return jnp.asarray(float(targets.size), jnp.float32)
+    return jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+
+
+def _online_update(carry, logits_c, targets, col0):
+    """One online-logsumexp step over a [b,s,c] f32 logits chunk whose
+    columns are vocab ids [col0, col0+c).  Carry: running max m [b,s],
+    running sum-of-exp s [b,s] (relative to m), target logit t [b,s]."""
+    m, s, t = carry
+    c = logits_c.shape[-1]
+    chunk_max = jnp.max(logits_c, axis=-1)
+    m_new = jnp.maximum(m, chunk_max)
+    # exp(-inf - finite) == 0 handles the first chunk's m == -inf.
+    s_new = (s * jnp.exp(m - m_new) +
+             jnp.sum(jnp.exp(logits_c - m_new[..., None]), axis=-1))
+    local = targets - col0
+    hit = (local >= 0) & (local < c)
+    gathered = jnp.take_along_axis(
+        logits_c, jnp.clip(local, 0, c - 1)[..., None], axis=-1)[..., 0]
+    t_new = t + jnp.where(hit, gathered, 0.0)
+    return m_new, s_new, t_new
+
+
+def _init_carry(shape):
+    return (jnp.full(shape, -jnp.inf, jnp.float32),
+            jnp.zeros(shape, jnp.float32),
+            jnp.zeros(shape, jnp.float32))
+
+
+# --------------------------------------------------------------------------
+# Streaming CE over existing logits
+# --------------------------------------------------------------------------
+
+
+def _streaming_lse_and_target(logits, targets, vocab_chunk):
+    """(lse [b,s], target_logit [b,s]) via chunked online logsumexp.
+    lax.scan over equal chunks guarantees XLA schedules them serially
+    (one chunk live at a time); a ragged tail runs once outside."""
+    vocab = logits.shape[-1]
+    chunk = min(vocab_chunk, vocab)
+    n_full = vocab // chunk
+    carry = _init_carry(targets.shape)
+
+    def body(carry, i):
+        col0 = i * chunk
+        logits_c = jax.lax.dynamic_slice_in_dim(
+            logits, col0, chunk, axis=-1).astype(jnp.float32)
+        return _online_update(carry, logits_c, targets, col0), None
+
+    carry, _ = jax.lax.scan(body, carry, jnp.arange(n_full))
+    if vocab % chunk:
+        tail = logits[..., n_full * chunk:].astype(jnp.float32)
+        carry = _online_update(carry, tail, targets, n_full * chunk)
+    m, s, t = carry
+    return m + jnp.log(s), t
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _streaming_nll_sum(logits, targets, mask, vocab_chunk):
+    lse, tgt = _streaming_lse_and_target(logits, targets, vocab_chunk)
+    return jnp.sum((lse - tgt) * mask)
+
+
+def _streaming_nll_fwd(logits, targets, mask, vocab_chunk):
+    lse, tgt = _streaming_lse_and_target(logits, targets, vocab_chunk)
+    return jnp.sum((lse - tgt) * mask), (logits, targets, mask, lse, tgt)
+
+
+def _streaming_nll_bwd(vocab_chunk, res, g):
+    logits, targets, mask, lse, tgt = res
+    vocab = logits.shape[-1]
+    chunk = min(vocab_chunk, vocab)
+    n_full = vocab // chunk
+    coeff = (g * mask)[..., None]
+
+    def grad_chunk(col0, width):
+        logits_c = jax.lax.dynamic_slice_in_dim(
+            logits, col0, width, axis=-1).astype(jnp.float32)
+        p = jnp.exp(logits_c - lse[..., None])
+        local = targets - col0
+        hit = (local >= 0) & (local < width)
+        onehot = jax.nn.one_hot(jnp.where(hit, local, -1), width,
+                                dtype=jnp.float32)
+        return (p - onehot) * coeff
+
+    def body(dlogits, i):
+        col0 = i * chunk
+        return jax.lax.dynamic_update_slice_in_dim(
+            dlogits, grad_chunk(col0, chunk).astype(logits.dtype),
+            col0, axis=-1), None
+
+    dlogits = jnp.zeros_like(logits)
+    dlogits, _ = jax.lax.scan(body, dlogits, jnp.arange(n_full))
+    if vocab % chunk:
+        col0 = n_full * chunk
+        dlogits = jax.lax.dynamic_update_slice_in_dim(
+            dlogits, grad_chunk(col0, vocab - col0).astype(logits.dtype),
+            col0, axis=-1)
+    return dlogits, None, g * (lse - tgt)
+
+
+_streaming_nll_sum.defvjp(_streaming_nll_fwd, _streaming_nll_bwd)
+
+
+def streaming_cross_entropy(logits, targets, mask=None, *,
+                            vocab_chunk: int = DEFAULT_VOCAB_CHUNK,
+                            reduction: str = 'mean'):
+    """Exact chunked-vocab CE on existing logits; drop-in for
+    train.loss_fn (same masked/unmasked semantics to ≤1e-5)."""
+    denom = _denominator(targets, mask)
+    mask = _ones_mask(targets) if mask is None else mask
+    nll = _streaming_nll_sum(logits, targets,
+                             mask.astype(jnp.float32), vocab_chunk)
+    if reduction == 'sum':
+        return nll
+    if reduction == 'mean':
+        return nll / denom
+    raise ValueError(f"Unknown reduction {reduction!r}; "
+                     "have 'mean', 'sum'.")
+
+
+# --------------------------------------------------------------------------
+# Fused linear + CE (logits never materialize)
+# --------------------------------------------------------------------------
+
+
+def _fused_lse_and_target(hidden, kernel, targets, vocab_chunk):
+    vocab = kernel.shape[-1]
+    chunk = min(vocab_chunk, vocab)
+    n_full = vocab // chunk
+    x = hidden.astype(kernel.dtype)
+    carry = _init_carry(targets.shape)
+
+    def chunk_logits(kernel_c):
+        # Matmul in the kernel's dtype (the caller pre-casts per
+        # cfg.logits_in_f32), logsumexp always in f32 — the same
+        # contract as the unfused DenseGeneral + loss_fn path.
+        return jnp.einsum('bsd,dc->bsc', x, kernel_c).astype(jnp.float32)
+
+    def body(carry, i):
+        col0 = i * chunk
+        kernel_c = jax.lax.dynamic_slice_in_dim(kernel, col0, chunk,
+                                                axis=-1)
+        return _online_update(carry, chunk_logits(kernel_c), targets,
+                              col0), None
+
+    carry, _ = jax.lax.scan(body, carry, jnp.arange(n_full))
+    if vocab % chunk:
+        col0 = n_full * chunk
+        carry = _online_update(carry, chunk_logits(kernel[:, col0:]),
+                               targets, col0)
+    m, s, t = carry
+    return m + jnp.log(s), t
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fused_nll_sum(hidden, kernel, targets, mask, vocab_chunk):
+    lse, tgt = _fused_lse_and_target(hidden, kernel, targets, vocab_chunk)
+    return jnp.sum((lse - tgt) * mask)
+
+
+def _fused_nll_fwd(hidden, kernel, targets, mask, vocab_chunk):
+    lse, tgt = _fused_lse_and_target(hidden, kernel, targets, vocab_chunk)
+    return (jnp.sum((lse - tgt) * mask),
+            (hidden, kernel, targets, mask, lse, tgt))
+
+
+def _fused_nll_bwd(vocab_chunk, res, g):
+    hidden, kernel, targets, mask, lse, tgt = res
+    vocab = kernel.shape[-1]
+    chunk = min(vocab_chunk, vocab)
+    n_full = vocab // chunk
+    x = hidden.astype(kernel.dtype)
+    x32 = hidden.astype(jnp.float32)
+    coeff = (g * mask)[..., None]
+
+    def dprobs(kernel_c, col0, width):
+        """(softmax - onehot) * mask * g for one recomputed chunk."""
+        logits_c = jnp.einsum('bsd,dc->bsc', x,
+                              kernel_c).astype(jnp.float32)
+        p = jnp.exp(logits_c - lse[..., None])
+        local = targets - col0
+        hit = (local >= 0) & (local < width)
+        onehot = jax.nn.one_hot(jnp.where(hit, local, -1), width,
+                                dtype=jnp.float32)
+        return (p - onehot) * coeff
+
+    def body(carry, i):
+        dx, dkernel = carry
+        col0 = i * chunk
+        kernel_c = jax.lax.dynamic_slice_in_dim(kernel, col0, chunk,
+                                                axis=-1)
+        scaled = dprobs(kernel_c, col0, chunk)
+        dx = dx + jnp.einsum('bsc,dc->bsd', scaled,
+                             kernel_c.astype(jnp.float32))
+        dkernel_c = jnp.einsum('bsd,bsc->dc', x32, scaled)
+        dkernel = jax.lax.dynamic_update_slice_in_dim(
+            dkernel, dkernel_c.astype(kernel.dtype), col0, axis=-1)
+        return (dx, dkernel), None
+
+    dx = jnp.zeros(hidden.shape, jnp.float32)
+    dkernel = jnp.zeros_like(kernel)
+    (dx, dkernel), _ = jax.lax.scan(body, (dx, dkernel),
+                                    jnp.arange(n_full))
+    if vocab % chunk:
+        col0 = n_full * chunk
+        kernel_c = kernel[:, col0:]
+        scaled = dprobs(kernel_c, col0, vocab - col0)
+        dx = dx + jnp.einsum('bsc,dc->bsd', scaled,
+                             kernel_c.astype(jnp.float32))
+        dkernel = jax.lax.dynamic_update_slice_in_dim(
+            dkernel,
+            jnp.einsum('bsd,bsc->dc', x32, scaled).astype(kernel.dtype),
+            col0, axis=-1)
+    return (dx.astype(hidden.dtype), dkernel, None, g * (lse - tgt))
+
+
+_fused_nll_sum.defvjp(_fused_nll_fwd, _fused_nll_bwd)
+
+
+def fused_linear_cross_entropy(hidden, kernel, targets,
+                               mask: Optional[jax.Array] = None, *,
+                               vocab_chunk: int = DEFAULT_VOCAB_CHUNK,
+                               reduction: str = 'mean'):
+    """Exact CE from final hidden states [b,s,d] + lm-head kernel
+    [d,V]; per-chunk logits are computed on the fly (and recomputed in
+    the backward), so the [b,s,V] tensor never exists.  For tied
+    embeddings pass the transposed embedding (transformer's
+    return_hidden path does this) — the transpose fuses into the
+    matmul, it is not a copy."""
+    if hidden.shape[-1] != kernel.shape[0]:
+        raise ValueError(
+            f'hidden d_model {hidden.shape[-1]} != kernel rows '
+            f'{kernel.shape[0]} — pass the kernel as [d_model, vocab].')
+    denom = _denominator(targets, mask)
+    mask = _ones_mask(targets) if mask is None else mask
+    nll = _fused_nll_sum(hidden, kernel, targets,
+                         mask.astype(jnp.float32), vocab_chunk)
+    if reduction == 'sum':
+        return nll
+    if reduction == 'mean':
+        return nll / denom
+    raise ValueError(f"Unknown reduction {reduction!r}; "
+                     "have 'mean', 'sum'.")

@@ -32,11 +32,30 @@ from skypilot_tpu import sky_logging
 logger = sky_logging.init_logger(__name__)
 
 
+# Chat-template turn-end markers: an instruct checkpoint's effective
+# stop token (Llama-3-Instruct emits '<|eot_id|>', ChatML models
+# '<|im_end|>') — a BASE model never emits them, so including them in
+# the stop set is always safe and lets instruct checkpoints shipped
+# without tokenizer_config.json stop at turn ends instead of streaming
+# to max_new_tokens.
+CHAT_TURN_END_TOKENS = ('<|eot_id|>', '<|im_end|>')
+
+
 class Tokenizer:
     """Interface: ids are plain ints; decode ignores ids it cannot map."""
 
     eos_id: Optional[int] = None
     bos_id: Optional[int] = None
+    # Additional stop ids beyond eos_id (chat turn-end markers).
+    extra_stop_ids: frozenset = frozenset()
+
+    @property
+    def eos_ids(self) -> frozenset:
+        """Every id generation should stop at: the model-level EOS plus
+        chat turn-end markers present in the vocab.  The serve layer
+        checks membership here instead of `== eos_id`."""
+        base = frozenset() if self.eos_id is None else {self.eos_id}
+        return frozenset(base) | self.extra_stop_ids
 
     @property
     def vocab_size(self) -> int:
@@ -85,25 +104,43 @@ class HFTokenizer(Tokenizer):
                        if self.bos_token else None)
         self.eos_id = (self._tok.token_to_id(self.eos_token)
                        if self.eos_token else None)
+        # Chat turn-end markers present in the vocab join the stop set
+        # (eos_ids) unconditionally: a base model never emits them, and
+        # an instruct checkpoint's effective stop IS one of them — with
+        # only the model-level EOS, Llama-3-Instruct-style checkpoints
+        # stream past turn ends to max_new_tokens.
+        chat_markers = {
+            cand: tid for cand in CHAT_TURN_END_TOKENS
+            if (tid := self._tok.token_to_id(cand)) is not None
+        }
         if self.eos_id is None:
             # No tokenizer_config.json (or no eos in it): without an
             # EOS id generation never stops early, holding batching
             # slots to max_new_tokens.  Fall back to the conventional
             # EOS names in the vocab/added-tokens table — model-level
             # EOS names first ('<|end_of_text|>' etc.), chat turn-end
-            # markers ('<|eot_id|>', '<|im_end|>') last: a base model
-            # never emits the latter.  This is a guess; the warning
-            # stays so operators know to ship tokenizer_config.json.
+            # markers last.  This is a guess; the warning stays so
+            # operators know to ship tokenizer_config.json.
             for cand in ('<|end_of_text|>', '<|endoftext|>', '</s>',
-                         '<eos>', '<|end|>', '<|eot_id|>',
-                         '<|im_end|>'):
+                         '<eos>', '<|end|>', *CHAT_TURN_END_TOKENS):
                 tid = self._tok.token_to_id(cand)
                 if tid is not None:
                     self.eos_token, self.eos_id = cand, tid
+                    extra = ''
+                    if chat_markers and cand not in chat_markers:
+                        extra = (
+                            '; chat turn-end markers '
+                            f'{sorted(chat_markers)} also found in the '
+                            'vocab and added to the stop set (an '
+                            'instruct checkpoint stops there, not at '
+                            f'{cand!r})')
                     logger.warning(
                         f'No eos_token in tokenizer_config; falling '
-                        f'back to {cand!r} (id {tid}) from the vocab.')
+                        f'back to {cand!r} (id {tid}) from the '
+                        f'vocab{extra}.')
                     break
+        self.extra_stop_ids = frozenset(
+            tid for tid in chat_markers.values() if tid != self.eos_id)
 
     @property
     def vocab_size(self) -> int:

@@ -30,6 +30,20 @@ class TrainConfig:
     b1: float = 0.9
     b2: float = 0.95
     grad_clip: float = 1.0
+    # --- training hot path (docs/training.md) ---
+    # Fused linear+CE (models/losses.py): the forward returns final
+    # hidden states + the lm-head kernel and the loss computes vocab
+    # chunks on the fly, so the [b,s,V] logits tensor never exists.
+    # Exact (online logsumexp), not an approximation.
+    fused_ce: bool = False
+    # Vocab chunk width for the streaming/fused CE.
+    vocab_chunk: int = 8192
+    # lax.scan microbatch gradient accumulation: the batch is split
+    # into accum_steps microbatches whose SUMMED NLL gradients are
+    # accumulated and normalized by the full-batch denominator, so
+    # accum_steps=k matches one big batch (same loss trajectory)
+    # while peak activation memory stays at one microbatch.
+    accum_steps: int = 1
 
 
 class TrainState(train_state.TrainState):
@@ -44,13 +58,23 @@ def make_optimizer(tcfg: TrainConfig) -> optax.GradientTransformation:
     )
 
 
-def loss_fn(logits, targets, mask=None):
-    """Next-token cross entropy. logits [b,s,V]; targets [b,s]."""
+def loss_fn(logits, targets, mask=None, reduction: str = 'mean'):
+    """Next-token cross entropy. logits [b,s,V]; targets [b,s].
+
+    The reference implementation (full f32 log-softmax) — the fused
+    hot path in models/losses.py is pinned against it.  reduction
+    'sum' returns the raw summed NLL (microbatch accumulation divides
+    by the full-batch denominator itself).
+    """
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        ll = ll * mask
+    if reduction == 'sum':
+        return -jnp.sum(ll)
     if mask is None:
         return -jnp.mean(ll)
-    return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    return -jnp.sum(ll) / jnp.maximum(jnp.sum(mask), 1)
 
 
 def create_train_state(cfg: ModelConfig,
@@ -124,34 +148,104 @@ def load_pretrained_params(state: TrainState, directory: str) -> TrainState:
         params=jax.tree_util.tree_unflatten(treedef, placed))
 
 
-def train_step(state: TrainState, batch):
+def _microbatch_nll(state, params, inputs, targets, mask,
+                    tcfg: TrainConfig):
+    """Summed (unnormalized) NLL of one microbatch — the unit both the
+    single-shot and the accumulated path build on."""
+    from skypilot_tpu.models import losses  # pylint: disable=import-outside-toplevel
+    if tcfg.fused_ce:
+        hidden, kernel = state.apply_fn({'params': params}, inputs,
+                                        return_hidden=True)
+        return losses.fused_linear_cross_entropy(
+            hidden, kernel, targets, mask,
+            vocab_chunk=tcfg.vocab_chunk, reduction='sum')
+    logits = state.apply_fn({'params': params}, inputs)
+    return loss_fn(logits, targets, mask, reduction='sum')
+
+
+def train_step(state: TrainState, batch,
+               tcfg: Optional[TrainConfig] = None):
     """One optimizer step. batch = {'tokens': [b,s+1] int32} or
-    {'inputs','targets'}.  Call under jit (see jit_train_step) —
-    placement comes from the jit in/out shardings, not from here."""
+    {'inputs','targets'} (+ optional 'mask').  Call under jit (see
+    jit_train_step) — placement comes from the jit in/out shardings,
+    not from here.
+
+    With a TrainConfig, the hot-path knobs apply: fused_ce routes the
+    loss through models/losses.py (the [b,s,V] logits tensor never
+    materializes) and accum_steps>1 runs lax.scan microbatch gradient
+    accumulation — summed-NLL grads accumulate across microbatches and
+    are normalized by the FULL batch's denominator, so the update is
+    equivalent to one big batch while peak activation memory stays at
+    one microbatch.
+    """
     if 'tokens' in batch:
         inputs = batch['tokens'][:, :-1]
         targets = batch['tokens'][:, 1:]
     else:
         inputs, targets = batch['inputs'], batch['targets']
+    mask = batch.get('mask')
 
-    def compute_loss(params):
-        logits = state.apply_fn({'params': params}, inputs)
-        return loss_fn(logits, targets, batch.get('mask'))
+    if tcfg is None or (not tcfg.fused_ce and tcfg.accum_steps <= 1):
+        def compute_loss(params):
+            logits = state.apply_fn({'params': params}, inputs)
+            return loss_fn(logits, targets, mask)
 
-    loss, grads = jax.value_and_grad(compute_loss)(state.params)
+        loss, grads = jax.value_and_grad(compute_loss)(state.params)
+    else:
+        if mask is None:
+            denom = jnp.asarray(float(targets.size), jnp.float32)
+        else:
+            denom = jnp.maximum(jnp.sum(mask), 1)
+        accum = tcfg.accum_steps
+        if accum <= 1:
+            nll, grads = jax.value_and_grad(
+                lambda p: _microbatch_nll(state, p, inputs, targets,
+                                          mask, tcfg))(state.params)
+        else:
+            b = inputs.shape[0]
+            if b % accum:
+                raise ValueError(
+                    f'batch size {b} not divisible by accum_steps '
+                    f'{accum}')
+            split = lambda a: (None if a is None else
+                               a.reshape(accum, b // accum, *a.shape[1:]))
+            micro = {'inputs': split(inputs), 'targets': split(targets)}
+            if mask is not None:
+                micro['mask'] = split(mask)
+
+            def body(carry, mb):
+                acc_nll, acc_grads = carry
+                nll, grads = jax.value_and_grad(
+                    lambda p: _microbatch_nll(
+                        state, p, mb['inputs'], mb['targets'],
+                        mb.get('mask'), tcfg))(state.params)
+                acc_grads = jax.tree_util.tree_map(jnp.add, acc_grads,
+                                                   grads)
+                return (acc_nll + nll, acc_grads), None
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+            (nll, grads), _ = jax.lax.scan(body, (jnp.zeros((),
+                                                            jnp.float32),
+                                                  zeros), micro)
+        loss = nll / denom
+        grads = jax.tree_util.tree_map(lambda g: g / denom.astype(g.dtype),
+                                       grads)
+
     new_state = state.apply_gradients(grads=grads)
     metrics = {'loss': loss,
                'grad_norm': optax.global_norm(grads)}
     return new_state, metrics
 
 
-def jit_train_step(state_shardings, batch_sharding):
+def jit_train_step(state_shardings, batch_sharding,
+                   tcfg: Optional[TrainConfig] = None):
     """jit train_step with explicit in/out shardings (the NamedShardings
-    carry their mesh)."""
+    carry their mesh); tcfg threads the hot-path knobs (fused CE,
+    microbatch accumulation) into the compiled step."""
 
     def _step(state, batch):
         with nn.logical_axis_rules(LOGICAL_AXIS_RULES):
-            return train_step(state, batch)
+            return train_step(state, batch, tcfg)
 
     return jax.jit(
         _step,

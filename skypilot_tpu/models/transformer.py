@@ -219,6 +219,30 @@ class DecoderLayer(nn.Module):
         return x
 
 
+class LMHead(nn.Module):
+    """Untied vocab projection as an explicit module so the fused-CE
+    path (models/losses.py) can fetch the kernel WITHOUT running the
+    [b,s,V] matmul.  Param tree ('lm_head'/'kernel', [d_model, vocab],
+    lecun_normal) is identical to the nn.DenseGeneral it replaces —
+    same init stream, so checkpoints and import_weights are unaffected.
+    """
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, x=None, *, return_kernel: bool = False):
+        cfg = self.config
+        kernel = self.param(
+            'kernel',
+            nn.with_logical_partitioning(nn.initializers.lecun_normal(),
+                                         ('embed', 'vocab')),
+            (cfg.d_model, cfg.vocab_size), cfg.param_dtype)
+        mm_dtype = jnp.float32 if cfg.logits_in_f32 else cfg.dtype
+        if return_kernel:
+            return kernel.astype(mm_dtype)
+        return jnp.einsum('bsd,dv->bsv', x.astype(mm_dtype),
+                          kernel.astype(mm_dtype))
+
+
 class _ScannedLayer(nn.Module):
     """DecoderLayer with the (carry, out) signature nn.scan expects."""
     config: ModelConfig
@@ -235,7 +259,11 @@ class Transformer(nn.Module):
     mesh: Optional[Any] = None
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden: bool = False):
+        """tokens [b,s] -> logits [b,s,V] f32; with return_hidden=True,
+        -> (final hidden [b,s,d], lm-head kernel [d,V] pre-cast to the
+        cfg.logits_in_f32 matmul dtype) for the fused linear+CE loss
+        (models/losses.py) — the [b,s,V] tensor is never built."""
         cfg = self.config
         _, s = tokens.shape
         positions = jnp.arange(s)
@@ -273,21 +301,22 @@ class Transformer(nn.Module):
 
         x = RMSNorm(cfg.norm_eps, cfg.norm_scale_plus_one,
                     name='final_norm')(x)
+        x = nn.with_logical_constraint(x, ('batch', 'seq', 'embed'))
+        mm_dtype = jnp.float32 if cfg.logits_in_f32 else cfg.dtype
         if cfg.tie_embeddings:
             # lm_head = embed^T (Gemma/GPT-style weight tying).  NOT
             # embed.attend(): that promotes to the module dtype (bf16),
             # silently undoing the logits_in_f32 upcast.
-            mm_dtype = jnp.float32 if cfg.logits_in_f32 else cfg.dtype
-            logits = jnp.einsum('bsd,vd->bsv', x.astype(mm_dtype),
-                                embed.embedding.astype(mm_dtype))
+            kernel = embed.embedding.astype(mm_dtype).T  # [d, V]
+            if return_hidden:
+                return x, kernel
+            logits = jnp.einsum('bsd,dv->bsv', x.astype(mm_dtype),
+                                kernel)
         else:
-            logits = nn.DenseGeneral(
-                cfg.vocab_size, use_bias=False,
-                dtype=jnp.float32 if cfg.logits_in_f32 else cfg.dtype,
-                param_dtype=cfg.param_dtype,
-                kernel_init=nn.with_logical_partitioning(
-                    nn.initializers.lecun_normal(), ('embed', 'vocab')),
-                name='lm_head')(x)
+            head = LMHead(cfg, name='lm_head')
+            if return_hidden:
+                return x, head(return_kernel=True)
+            logits = head(x)
         # Logits leave in f32 regardless of matmul precision: the CE
         # loss' log_softmax is always computed in f32.
         return logits.astype(jnp.float32)

@@ -72,6 +72,16 @@ def token_batch_sharding(mesh):
     return logical_sharding(mesh, 'batch', None)
 
 
+def head_kernel_sharding(mesh):
+    """Sharding for the lm-head kernel [embed, vocab] when it travels
+    as a PLAIN array rather than a flax param — the fused linear+CE
+    hot path (models/losses.py) takes the kernel as a function
+    argument, so its placement must match the in-module annotation
+    ('embed', 'vocab') or GSPMD re-gathers the whole [d, V] matrix
+    before every chunk matmul."""
+    return logical_sharding(mesh, 'embed', 'vocab')
+
+
 def replicated(mesh):
     import jax  # pylint: disable=import-outside-toplevel
     return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())

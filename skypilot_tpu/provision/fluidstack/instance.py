@@ -166,8 +166,17 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
                     # capacity.
                     'region': config.region,
                 })
-                created.append(body.get('id') or
-                               body.get('data', {}).get('id'))
+                iid = (body.get('id') or
+                       (body.get('data') or {}).get('id'))
+                if not iid:
+                    # A create "success" without an id must fail loudly
+                    # here: appending None would persist
+                    # head_instance_id=None and make the sweep DELETE
+                    # /instances/None.
+                    raise exceptions.ProvisionError(
+                        f'FluidStack create for {cluster_name}-{rank} '
+                        f'returned no instance id: {body}')
+                created.append(iid)
         except exceptions.ProvisionError:
             # Best-effort all-or-nothing sweep: a failing terminate
             # must not mask the original error or strand later

@@ -88,17 +88,36 @@ def _compartment() -> str:
     return ocid
 
 
-def _list_instances(cluster_name: str) -> List[Dict[str, Any]]:
-    """Live instances of this cluster, rank-ordered via the rank tag."""
+# States that count as "this instance exists" for recovery/lifecycle
+# purposes; TERMINATING/TERMINATED are corpses (but wait_instances
+# inspects them for fail-fast — pass lifecycle_states=None there).
+_LIVE_STATES = frozenset(
+    ('RUNNING', 'PROVISIONING', 'STARTING', 'STOPPING', 'STOPPED'))
+
+
+def _list_instances(
+        cluster_name: str,
+        lifecycle_states: Optional[frozenset] = _LIVE_STATES
+) -> List[Dict[str, Any]]:
+    """Instances of this cluster, rank-ordered via the rank tag.
+
+    States are filtered CLIENT-side: the real oci CLI validates
+    `--lifecycle-state` as a single enum, so the old comma-joined
+    multi-state value failed every listing — and with allow_fail that
+    read as "empty cluster": terminate/stop silently no-oped while
+    instances kept billing, and the status layer dropped the record.
+    Listing failures therefore RAISE (same contract as the IBM
+    provisioner's recovery listing) — an expired token must never look
+    like an empty cluster.
+    """
     out = _oci('compute', 'instance', 'list',
-               '--compartment-id', _compartment(),
-               '--lifecycle-state', 'RUNNING,PROVISIONING,STARTING,'
-               'STOPPING,STOPPED',
-               allow_fail=True)
-    rows = (out or {}).get('data', []) if isinstance(out, dict) else []
+               '--compartment-id', _compartment())
+    rows = out.get('data', []) if isinstance(out, dict) else []
     mine = [r for r in rows
             if (r.get('freeform-tags') or {}).get(_CLUSTER_TAG)
-            == cluster_name]
+            == cluster_name and
+            (lifecycle_states is None or
+             r.get('lifecycle-state') in lifecycle_states)]
     return sorted(
         mine,
         key=lambda r: int((r.get('freeform-tags') or {})
@@ -181,10 +200,29 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
 
 
 def wait_instances(cluster_name: str, state: Optional[str] = None) -> None:
+    """Poll until every instance reaches `state` — failing FAST when an
+    instance moves to TERMINATING/TERMINATED or vanishes from the
+    listing (preemption, manual console kill), instead of burning the
+    full 900s window like the pre-fix waiter did."""
     want = state or 'RUNNING'
     deadline = time.time() + 900
+    expected: Optional[int] = None
     while time.time() < deadline:
-        rows = _list_instances(cluster_name)
+        rows = _list_instances(cluster_name, lifecycle_states=None)
+        dead = [r['id'] for r in rows
+                if r.get('lifecycle-state') in ('TERMINATING',
+                                                'TERMINATED')]
+        if dead:
+            raise exceptions.ProvisionError(
+                f'Instance(s) {dead} of {cluster_name} terminated while '
+                f'waiting for {want!r} (preempted or externally '
+                'deleted).')
+        if expected is None and rows:
+            expected = len(rows)
+        elif expected is not None and len(rows) < expected:
+            raise exceptions.ProvisionError(
+                f'{expected - len(rows)} instance(s) of {cluster_name} '
+                f'disappeared while waiting for {want!r}.')
         if rows and all(r.get('lifecycle-state') == want for r in rows):
             return
         time.sleep(10)

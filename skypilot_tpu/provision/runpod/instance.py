@@ -47,11 +47,15 @@ def _default_api_runner(query: str,
     if not key:
         raise exceptions.ProvisionError(
             'RunPod API key not found (see `sky check`).')
+    # The key rides an Authorization header, NEVER the URL query
+    # string: URLs are routinely captured by proxies, access logs, and
+    # error traces, leaking the credential (ADVICE round 5).
     req = urllib.request.Request(
-        f'{_API_URL}?api_key={key}',
+        _API_URL,
         data=json.dumps({'query': query,
                          'variables': variables}).encode(),
-        headers={'Content-Type': 'application/json'},
+        headers={'Content-Type': 'application/json',
+                 'Authorization': f'Bearer {key}'},
         method='POST')
     try:
         with urllib.request.urlopen(req, timeout=60) as resp:

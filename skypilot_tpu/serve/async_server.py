@@ -164,9 +164,11 @@ class AsyncModelServer:
             None, lambda: server.generate(
                 [ids], int(req.get('max_new_tokens', 64)),
                 float(req.get('temperature', 0.0)),
-                int(req.get('top_k', 0)), stop_token=tok.eos_id)))[0]
-        if tok.eos_id in tokens:
-            tokens = tokens[:tokens.index(tok.eos_id)]
+                int(req.get('top_k', 0)),
+                stop_token=tok.eos_ids or None)))[0]
+        stops = [i for i, t in enumerate(tokens) if t in tok.eos_ids]
+        if stops:
+            tokens = tokens[:stops[0]]
         writer.write(_json_response(200, {
             'completion': tok.decode(tokens),
             'tokens': tokens,
@@ -184,13 +186,16 @@ class AsyncModelServer:
             raise _HttpError(
                 400, 'streaming requires --continuous-batching')
         tok = server.tokenizer
-        stop_token = (tok.eos_id if text_mode
-                      else req.get('stop_token'))
+        # Text mode stops at the tokenizer's full stop set (model EOS +
+        # chat turn-end markers — instruct checkpoints end turns there).
+        # Token mode keeps the request's raw stop_token (may be int 0).
+        stop_ids = ((tok.eos_ids or None) if text_mode
+                    else req.get('stop_token'))
         try:
             request = engine.submit(
                 [int(t) for t in ids],
                 int(req.get('max_new_tokens', 64 if text_mode else 16)),
-                stop_token=stop_token)
+                stop_token=stop_ids)
         except ValueError:
             raise
         except Exception as e:  # pylint: disable=broad-except
@@ -220,7 +225,7 @@ class AsyncModelServer:
                         raise request.error
                     break
                 if text_mode:
-                    if token == stop_token:
+                    if token in tok.eos_ids:
                         break
                     delta = decoder.push(token)
                     if delta:

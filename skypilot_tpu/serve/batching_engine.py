@@ -38,10 +38,18 @@ _PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 class _Request:
 
     def __init__(self, prompt_ids: List[int], max_new_tokens: int,
-                 stop_token: Optional[int]) -> None:
+                 stop_token) -> None:
         self.prompt_ids = list(prompt_ids)
         self.max_new_tokens = max_new_tokens
-        self.stop_token = stop_token
+        # stop_token: None, a single id, or any iterable of ids (the
+        # tokenizer's multi-EOS stop set — instruct checkpoints stop at
+        # chat turn-end markers, not just the model-level EOS).
+        if stop_token is None:
+            self.stop_ids = frozenset()
+        elif isinstance(stop_token, int):
+            self.stop_ids = frozenset({stop_token})
+        else:
+            self.stop_ids = frozenset(int(t) for t in stop_token)
         self.done = threading.Event()
         self.tokens: List[int] = []
         self.error: Optional[Exception] = None
@@ -184,7 +192,10 @@ class ContinuousBatchingEngine:
     # ------------------------------------------------------------ public
 
     def submit(self, prompt_ids: List[int], max_new_tokens: int,
-               stop_token: Optional[int] = None) -> _Request:
+               stop_token=None) -> _Request:
+        """stop_token: None, one id, or an iterable of ids — the
+        request finishes at the FIRST generated member of the set
+        (multi-EOS: model-level EOS + chat turn-end markers)."""
         if not prompt_ids:
             raise ValueError('empty prompt')
         if max_new_tokens < 1:
@@ -209,7 +220,7 @@ class ContinuousBatchingEngine:
         return request
 
     def generate(self, prompt_ids: List[int], max_new_tokens: int,
-                 stop_token: Optional[int] = None,
+                 stop_token=None,
                  timeout: float = 600.0) -> List[int]:
         return self.submit(prompt_ids, max_new_tokens,
                            stop_token).result(timeout)
@@ -275,7 +286,7 @@ class ContinuousBatchingEngine:
             request._push(first)  # pylint: disable=protected-access
             self._tokens_generated += 1
             if (request.max_new_tokens <= 1 or
-                    first == request.stop_token):
+                    first in request.stop_ids):
                 request._finish()  # pylint: disable=protected-access
                 return
             slot.request = request
@@ -329,8 +340,7 @@ class ContinuousBatchingEngine:
             request._push(token)  # pylint: disable=protected-access
             self._tokens_generated += 1
             finished = (len(request.tokens) >= request.max_new_tokens or
-                        (request.stop_token is not None and
-                         token == request.stop_token))
+                        token in request.stop_ids)
             if finished:
                 slot.request = None
                 request._finish()  # pylint: disable=protected-access

@@ -188,7 +188,9 @@ class ModelServer:
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, top_k: int = 0,
-                 stop_token: Optional[int] = None) -> Any:
+                 stop_token=None) -> Any:
+        """stop_token: None, a single id, or an iterable of ids (the
+        tokenizer's multi-EOS stop set)."""
         import jax.numpy as jnp
 
         from skypilot_tpu.models import decode
@@ -294,9 +296,11 @@ def _make_handler(server: ModelServer):
                     [ids], int(req.get('max_new_tokens', 64)),
                     float(req.get('temperature', 0.0)),
                     int(req.get('top_k', 0)),
-                    stop_token=tok.eos_id)[0]
-                if tok.eos_id in tokens:
-                    tokens = tokens[:tokens.index(tok.eos_id)]
+                    stop_token=tok.eos_ids or None)[0]
+                stops = [i for i, t in enumerate(tokens)
+                         if t in tok.eos_ids]
+                if stops:
+                    tokens = tokens[:stops[0]]
                 self._reply(200, {
                     'completion': tok.decode(tokens),
                     'tokens': tokens,
@@ -320,12 +324,12 @@ def _make_handler(server: ModelServer):
                 return
             request = server._engine.submit(  # pylint: disable=protected-access
                 ids, int(req.get('max_new_tokens', 64)),
-                stop_token=tok.eos_id)
+                stop_token=tok.eos_ids or None)
             self._start_sse()
             decoder = StreamDecoder(tok)
             try:
                 for token in request.stream(timeout=600):
-                    if token == tok.eos_id:
+                    if token in tok.eos_ids:
                         break
                     delta = decoder.push(token)
                     if delta:

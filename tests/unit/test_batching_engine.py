@@ -82,6 +82,19 @@ class TestEngine:
                               timeout=120)
         assert got == ref[:3]  # stops AT the stop token (inclusive)
 
+    def test_stop_token_set(self, setup, engine):
+        """A multi-EOS stop set (tokenizer.eos_ids): generation ends at
+        the FIRST member produced — instruct checkpoints stop at chat
+        turn-end markers, not just the model-level EOS."""
+        cfg, params = setup
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        ref = _reference(cfg, params, prompt, 8)
+        # Decoy id that never appears + the real 3rd generated token.
+        stops = frozenset({ref[2], max(ref) + 1})
+        got = engine.generate(prompt, max_new_tokens=8,
+                              stop_token=stops, timeout=120)
+        assert got == ref[:3]
+
     def test_validation(self, engine):
         with pytest.raises(ValueError, match='empty'):
             engine.submit([], 4)

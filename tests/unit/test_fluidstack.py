@@ -122,6 +122,28 @@ class TestProvisionLifecycle:
         with pytest.raises(exceptions.ResourcesMismatchError):
             fs_instance.run_instances(_config(count=3))
 
+    def test_create_without_id_raises_and_sweeps(self, fake_api):
+        """A create 'success' with no id in the body must raise (not
+        append None -> head_instance_id=None + DELETE /instances/None),
+        and the all-or-nothing sweep must only touch REAL ids."""
+        creates = []
+
+        def runner(method, path, payload):
+            if (method, path) == ('POST', '/instances'):
+                creates.append(path)
+                if len(creates) > 1:  # second create: malformed body
+                    fake_api.calls.append((method, path, payload))
+                    return 200, {'status': 'ok'}
+            return fake_api(method, path, payload)
+
+        fs_instance.set_api_runner(runner)
+        with pytest.raises(exceptions.ProvisionError,
+                           match='returned no instance id'):
+            fs_instance.run_instances(_config(count=2))
+        deletes = [p for m, p, _ in fake_api.calls if m == 'DELETE']
+        assert deletes and all('None' not in p for p in deletes)
+        assert fake_api.instances == {}  # rank 0 swept
+
     def test_foreign_instance_ignored(self, fake_api):
         fake_api.instances['alien'] = {'id': 'alien',
                                        'name': 'fsc-head',

@@ -1,0 +1,224 @@
+"""Training hot path: streaming/fused cross-entropy (models/losses.py)
+and microbatch gradient accumulation (models/train.py).
+
+Everything is pinned against the reference full-logits loss_fn — the
+fused path must be EXACT (online logsumexp is a reassociation, not an
+approximation), so parity bars are float32-roundoff tight.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import configs
+from skypilot_tpu.models import losses
+from skypilot_tpu.models.train import TrainConfig
+from skypilot_tpu.models.train import create_train_state
+from skypilot_tpu.models.train import loss_fn
+from skypilot_tpu.models.train import train_step
+from skypilot_tpu.models.transformer import Transformer
+
+
+@pytest.fixture
+def ce_inputs():
+    key = jax.random.PRNGKey(0)
+    logits = jax.random.normal(key, (2, 16, 257)) * 3.0
+    targets = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 257)
+    mask = (jax.random.uniform(jax.random.PRNGKey(2), (2, 16))
+            > 0.3).astype(jnp.float32)
+    return logits, targets, mask
+
+
+class TestStreamingCE:
+
+    @pytest.mark.parametrize('masked', [False, True])
+    @pytest.mark.parametrize('chunk', [100, 257, 4096])
+    def test_matches_loss_fn(self, ce_inputs, masked, chunk):
+        """Ragged tail (100), exact fit (257), single chunk (4096):
+        all must match the reference to f32 roundoff.  257 is prime,
+        so chunk=100 exercises the uneven final chunk."""
+        logits, targets, mask = ce_inputs
+        m = mask if masked else None
+        ref = loss_fn(logits, targets, m)
+        got = losses.streaming_cross_entropy(logits, targets, m,
+                                             vocab_chunk=chunk)
+        assert float(got) == pytest.approx(float(ref), abs=1e-5)
+
+    def test_grad_matches_loss_fn(self, ce_inputs):
+        logits, targets, mask = ce_inputs
+        for m in (None, mask):
+            g_ref = jax.grad(lambda l: loss_fn(l, targets, m))(logits)
+            g_got = jax.grad(lambda l: losses.streaming_cross_entropy(
+                l, targets, m, vocab_chunk=100))(logits)
+            np.testing.assert_allclose(g_got, g_ref, atol=1e-6)
+
+    def test_sum_reduction(self, ce_inputs):
+        logits, targets, mask = ce_inputs
+        total = losses.streaming_cross_entropy(
+            logits, targets, mask, vocab_chunk=64, reduction='sum')
+        mean = losses.streaming_cross_entropy(
+            logits, targets, mask, vocab_chunk=64)
+        denom = float(jnp.maximum(jnp.sum(mask), 1))
+        assert float(total) / denom == pytest.approx(float(mean),
+                                                     rel=1e-6)
+
+    def test_unknown_reduction_rejected(self, ce_inputs):
+        logits, targets, _ = ce_inputs
+        with pytest.raises(ValueError, match='reduction'):
+            losses.streaming_cross_entropy(logits, targets,
+                                           reduction='median')
+
+
+class TestFusedLinearCE:
+
+    @pytest.mark.parametrize('preset', ['tiny', 'tiny-moe', 'tiny-gemma'])
+    @pytest.mark.parametrize('masked', [False, True])
+    def test_matches_unfused_model_loss(self, preset, masked):
+        """Dense, MoE, and tied-embedding (Gemma) heads: loss AND
+        param grads of the fused path match the full-logits path.
+        return_hidden must not change the param tree."""
+        cfg = configs.get_config(preset)
+        model = Transformer(cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0,
+                                    cfg.vocab_size)
+        targets = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0,
+                                     cfg.vocab_size)
+        mask = ((jax.random.uniform(jax.random.PRNGKey(5), (2, 16))
+                 > 0.3).astype(jnp.float32) if masked else None)
+        params = model.init(jax.random.PRNGKey(0), tokens)['params']
+
+        def ref(p):
+            return loss_fn(model.apply({'params': p}, tokens), targets,
+                           mask)
+
+        def fused(p):
+            hidden, kernel = model.apply({'params': p}, tokens,
+                                         return_hidden=True)
+            assert hidden.shape == (2, 16, cfg.d_model)
+            assert kernel.shape == (cfg.d_model, cfg.vocab_size)
+            return losses.fused_linear_cross_entropy(
+                hidden, kernel, targets, mask, vocab_chunk=100)
+
+        l_ref, g_ref = jax.value_and_grad(ref)(params)
+        l_fused, g_fused = jax.value_and_grad(fused)(params)
+        assert float(l_fused) == pytest.approx(float(l_ref), abs=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(g_ref),
+                        jax.tree_util.tree_leaves(g_fused)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=5e-6)
+
+    def test_return_hidden_param_tree_unchanged(self):
+        """The LMHead refactor must keep the exact DenseGeneral param
+        tree AND init stream — checkpoints/import_weights depend on
+        ('lm_head','kernel') of shape [d_model, vocab]."""
+        import flax.linen as nn
+        cfg = configs.get_config('tiny')
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        params = nn.meta.unbox(
+            Transformer(cfg).init(jax.random.PRNGKey(0),
+                                  tokens)['params'])
+        assert params['lm_head']['kernel'].shape == (cfg.d_model,
+                                                     cfg.vocab_size)
+
+    def test_shape_mismatch_rejected(self):
+        with pytest.raises(ValueError, match='d_model'):
+            losses.fused_linear_cross_entropy(
+                jnp.zeros((1, 4, 8)), jnp.zeros((16, 32)),
+                jnp.zeros((1, 4), jnp.int32))
+
+    def test_bf16_hidden_matches_bf16_logits_path(self):
+        """logits_in_f32=False: the fused matmul runs in the kernel's
+        (bf16) dtype, matching the unfused DenseGeneral numerics."""
+        cfg = configs.get_config('tiny', dtype=jnp.bfloat16,
+                                 param_dtype=jnp.float32,
+                                 logits_in_f32=False)
+        model = Transformer(cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(6), (2, 16), 0,
+                                    cfg.vocab_size)
+        targets = jnp.roll(tokens, -1, axis=1)
+        params = model.init(jax.random.PRNGKey(0), tokens)['params']
+        ref = loss_fn(model.apply({'params': params}, tokens), targets)
+        hidden, kernel = model.apply({'params': params}, tokens,
+                                     return_hidden=True)
+        assert kernel.dtype == jnp.bfloat16
+        got = losses.fused_linear_cross_entropy(hidden, kernel, targets,
+                                                vocab_chunk=64)
+        assert float(got) == pytest.approx(float(ref), abs=1e-5)
+
+
+class TestTrainStepHotPath:
+
+    def _trajectory(self, cfg, tcfg, batch, steps=10):
+        state, _ = create_train_state(cfg, tcfg, batch_size=8,
+                                      seq_len=32)
+        step = jax.jit(functools.partial(train_step, tcfg=tcfg))
+        out = []
+        for _ in range(steps):
+            state, metrics = step(state, batch)
+            out.append(float(metrics['loss']))
+        return out
+
+    def test_accum_equivalence_10_steps(self):
+        """accum_steps=4 must reproduce the single-shot big-batch loss
+        trajectory (≤1e-4 drift over 10 steps) — summed-NLL grads
+        normalized by the full-batch denominator make the update
+        mathematically identical."""
+        cfg = configs.get_config('tiny')
+        tokens = jax.random.randint(jax.random.PRNGKey(7), (8, 33), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        batch = {'tokens': tokens}
+        base = self._trajectory(cfg, TrainConfig(), batch)
+        for tcfg in (TrainConfig(accum_steps=4),
+                     TrainConfig(accum_steps=4, fused_ce=True,
+                                 vocab_chunk=100),
+                     TrainConfig(fused_ce=True, vocab_chunk=100)):
+            got = self._trajectory(cfg, tcfg, batch)
+            drift = max(abs(a - b) for a, b in zip(base, got))
+            assert drift <= 1e-4, (tcfg, drift, base, got)
+
+    def test_accum_equivalence_masked(self):
+        """Microbatches with UNEQUAL mask sums: per-microbatch mean
+        losses would weight them wrongly — the summed-NLL contract must
+        still match the big batch."""
+        cfg = configs.get_config('tiny')
+        inputs = jax.random.randint(jax.random.PRNGKey(8), (4, 16), 0,
+                                    cfg.vocab_size)
+        targets = jnp.roll(inputs, -1, axis=1)
+        mask = jnp.array([[1.0] * 16, [1.0] * 4 + [0.0] * 12,
+                          [0.0] * 15 + [1.0], [1.0] * 8 + [0.0] * 8])
+        batch = {'inputs': inputs, 'targets': targets, 'mask': mask}
+        state, _ = create_train_state(cfg, TrainConfig(), batch_size=4,
+                                      seq_len=16)
+        _, m1 = train_step(state, batch)
+        _, m2 = train_step(state, batch, TrainConfig(accum_steps=4))
+        _, m3 = train_step(state, batch,
+                           TrainConfig(accum_steps=2, fused_ce=True,
+                                       vocab_chunk=100))
+        assert float(m2['loss']) == pytest.approx(float(m1['loss']),
+                                                  abs=1e-5)
+        assert float(m3['loss']) == pytest.approx(float(m1['loss']),
+                                                  abs=1e-5)
+        assert float(m2['grad_norm']) == pytest.approx(
+            float(m1['grad_norm']), rel=1e-4)
+
+    def test_indivisible_accum_rejected(self):
+        cfg = configs.get_config('tiny')
+        state, _ = create_train_state(cfg, TrainConfig(), batch_size=3,
+                                      seq_len=16)
+        batch = {'tokens': jnp.zeros((3, 17), jnp.int32)}
+        with pytest.raises(ValueError, match='divisible'):
+            train_step(state, batch, TrainConfig(accum_steps=2))
+
+    def test_legacy_signature_unchanged(self):
+        """train_step(state, batch) with no TrainConfig is the exact
+        pre-refactor path (bench robustness + old callers)."""
+        cfg = configs.get_config('tiny')
+        state, _ = create_train_state(cfg, TrainConfig(), batch_size=2,
+                                      seq_len=16)
+        batch = {'tokens': jnp.zeros((2, 17), jnp.int32)}
+        _, metrics = train_step(state, batch)
+        assert np.isfinite(float(metrics['loss']))

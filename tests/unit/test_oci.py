@@ -55,9 +55,15 @@ class FakeOciCli:
             }
             return 0, json.dumps({'data': self.instances[iid]}), ''
         if cmd == 'compute instance list':
-            states = self._arg(args, '--lifecycle-state', '').split(',')
+            state = self._arg(args, '--lifecycle-state')
+            if state is not None and ',' in state:
+                # The real CLI validates this as a SINGLE enum — the
+                # comma-joined multi-state value is the regression the
+                # client-side filter fix removed (ADVICE round 5).
+                return 1, '', (f'Invalid value for --lifecycle-state: '
+                               f'{state}')
             rows = [r for r in self.instances.values()
-                    if r['lifecycle-state'] in states]
+                    if state is None or r['lifecycle-state'] == state]
             return 0, json.dumps({'data': rows}), ''
         if cmd == 'compute instance action':
             iid = self._arg(args, '--instance-id')
@@ -168,6 +174,57 @@ class TestProvisionLifecycle:
         with pytest.raises(exceptions.ProvisionError,
                            match='compartment'):
             oci_instance.run_instances(_config())
+
+    def test_listing_failure_raises_not_empty(self, fake_oci):
+        """An expired token / CLI failure must surface as an error —
+        never read as 'no instances' (which made terminate a silent
+        no-op and dropped live clusters from the status layer)."""
+        oci_instance.run_instances(_config())
+
+        def broken(argv):
+            if 'list' in argv and 'list-vnics' not in argv:
+                return 1, '', 'NotAuthenticated: token expired'
+            return fake_oci(argv)
+
+        oci_instance.set_cli_runner(broken)
+        with pytest.raises(exceptions.ProvisionError,
+                           match='NotAuthenticated'):
+            oci_instance.query_instances('ocic')
+        with pytest.raises(exceptions.ProvisionError):
+            oci_instance.terminate_instances('ocic')
+
+    def test_list_filters_states_client_side(self, fake_oci):
+        """No --lifecycle-state flag on the wire (the real CLI rejects
+        multi-state values); corpse states are filtered client-side."""
+        oci_instance.run_instances(_config())
+        list_calls = [c for c in fake_oci.calls
+                      if 'list' in c and 'list-vnics' not in c]
+        assert list_calls and all(
+            '--lifecycle-state' not in c for c in list_calls)
+        iid = next(iter(fake_oci.instances))
+        fake_oci.instances[iid]['lifecycle-state'] = 'TERMINATED'
+        assert iid not in oci_instance.query_instances('ocic')
+
+    def test_wait_fails_fast_on_terminating(self, fake_oci):
+        oci_instance.run_instances(_config())
+        iid = next(iter(fake_oci.instances))
+        fake_oci.instances[iid]['lifecycle-state'] = 'TERMINATING'
+        with pytest.raises(exceptions.ProvisionError,
+                           match='terminated while'):
+            oci_instance.wait_instances('ocic')
+
+    def test_wait_fails_fast_on_disappeared(self, fake_oci,
+                                            monkeypatch):
+        oci_instance.run_instances(_config())
+        monkeypatch.setattr(
+            'skypilot_tpu.provision.oci.instance.time.sleep',
+            lambda s: fake_oci.instances.pop(
+                next(iter(fake_oci.instances)), None) and None)
+        # All instances start RUNNING, so the wait returns before the
+        # sleep hook fires; ask for STOPPED to force polling.
+        with pytest.raises(exceptions.ProvisionError,
+                           match='disappeared'):
+            oci_instance.wait_instances('ocic', state='STOPPED')
 
 
 class TestOciCloud:

@@ -212,6 +212,42 @@ class TestRunPodCloud:
         assert catalog.get_hourly_cost(
             'runpod', 'NVIDIA A100 80GB PCIe:1') == pytest.approx(1.64)
 
+    def test_api_key_in_header_not_url(self, monkeypatch):
+        """The credential rides an Authorization: Bearer header — a key
+        in the URL query string leaks through proxies/access logs."""
+        import io
+        import urllib.request as urlreq
+
+        from skypilot_tpu.provision.runpod import instance as rp_inst
+
+        captured = {}
+
+        def fake_urlopen(req, timeout=None):
+            captured['url'] = req.full_url
+            captured['auth'] = req.get_header('Authorization')
+
+            class _Resp(io.BytesIO):
+                status = 200
+
+                def __enter__(self):
+                    return self
+
+                def __exit__(self, *a):
+                    return False
+
+            return _Resp(b'{"data": {"myself": {"pods": []}}}')
+
+        monkeypatch.setattr(urlreq, 'urlopen', fake_urlopen)
+        monkeypatch.setattr(
+            'skypilot_tpu.clouds.runpod.read_api_key',
+            lambda: 'rk-secret')
+        status, body = rp_inst._default_api_runner(  # pylint: disable=protected-access
+            'query { myself { pods { id } } }', {})
+        assert status == 200 and body['data']['myself']['pods'] == []
+        assert 'rk-secret' not in captured['url']
+        assert 'api_key' not in captured['url']
+        assert captured['auth'] == 'Bearer rk-secret'
+
     def test_credentials_from_toml(self, tmp_path, monkeypatch):
         monkeypatch.setenv('HOME', str(tmp_path))
         monkeypatch.delenv('RUNPOD_API_KEY', raising=False)

@@ -278,6 +278,60 @@ def test_hf_eos_fallback_from_vocab(tmp_path):
     assert tok.eos_token == '<|end|>'
 
 
+def _build_instruct_bpe_json(tmp_path):
+    """BPE vocab carrying chat turn-end markers (Llama-3-Instruct /
+    ChatML style) alongside the base-model EOS names."""
+    tokenizers = pytest.importorskip('tokenizers')
+    from tokenizers import models, pre_tokenizers, decoders, trainers
+    tk = tokenizers.Tokenizer(models.BPE(unk_token=None))
+    tk.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tk.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=400,
+        special_tokens=['<|begin_of_text|>', '<|end_of_text|>',
+                        '<|eot_id|>', '<|im_end|>'],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
+    tk.train_from_iterator(['hello world'] * 50, trainer)
+    tk.save(str(tmp_path / 'tokenizer.json'))
+    return tmp_path
+
+
+def test_hf_eos_fallback_multi_stop_set(tmp_path, monkeypatch):
+    """Instruct checkpoint without tokenizer_config.json: the fallback
+    picks the model-level EOS, but chat turn-end markers present in the
+    vocab must join eos_ids (the serve stop set) and be surfaced in the
+    warning — otherwise Llama-3-Instruct streams past every turn end
+    to max_new_tokens (ADVICE round 5)."""
+    warnings = []
+    monkeypatch.setattr(tok_lib.logger, 'warning',
+                        lambda msg, *a: warnings.append(str(msg)))
+    _build_instruct_bpe_json(tmp_path)
+    tok = tok_lib.load_tokenizer(str(tmp_path))
+    assert tok.eos_token == '<|end_of_text|>'
+    eot = tok._tok.token_to_id('<|eot_id|>')
+    im_end = tok._tok.token_to_id('<|im_end|>')
+    assert tok.eos_ids == {tok.eos_id, eot, im_end}
+    warning = ' '.join(warnings)
+    assert '<|eot_id|>' in warning and '<|im_end|>' in warning
+
+
+def test_hf_config_eos_still_gains_chat_markers(tmp_path):
+    """Even WITH tokenizer_config.json, chat markers in the vocab join
+    the stop set (a base model never emits them — always safe)."""
+    _build_instruct_bpe_json(tmp_path)
+    (tmp_path / 'tokenizer_config.json').write_text(json.dumps({
+        'eos_token': '<|end_of_text|>'}))
+    tok = tok_lib.load_tokenizer(str(tmp_path))
+    assert tok.eos_id == tok._tok.token_to_id('<|end_of_text|>')
+    assert tok._tok.token_to_id('<|eot_id|>') in tok.eos_ids
+    assert len(tok.eos_ids) == 3
+
+
+def test_eos_ids_base_interface():
+    tok = tok_lib.ByteTokenizer()
+    assert tok.eos_ids == {0}
+
+
 def test_sp_control_tokens_not_encodable(tmp_path):
     """User text spelling a control token must NOT encode to its
     special id (EOS injection): real sentencepiece excludes
