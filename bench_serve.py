@@ -1,0 +1,355 @@
+"""Serving benchmark: the decode hot loop under open-loop load.
+
+Drives `serve.batching_engine.ContinuousBatchingEngine` directly (no
+HTTP in the way) with Poisson arrivals over mixed prompt lengths and
+reports the numbers a serving SLO is written in:
+
+- decode tokens/s        (aggregate, across all in-flight requests)
+- TTFT p50/p99           (submit -> first token)
+- ITL  p50/p99           (gap between consecutive tokens of a request)
+- speedup vs the pre-pipeline engine (`pipelined=False`: inline
+  full-prompt prefill + one host sync per generated token) on the SAME
+  workload — the A/B for the on-device-sampling + pipelined-tick loop.
+- chunked-prefill stall probe: while `slots-1` decodes run, admit one
+  LONG prompt and measure the worst ITL the running requests suffer;
+  with chunked prefill that stall is bounded by ONE chunk's compute
+  (reported alongside the unchunked stall for contrast).
+
+Prints ONE JSON line and writes it to --out (BENCH_serve.json;
+--smoke uses a seconds-scale config and BENCH_serve_smoke.json — the
+tier-1 perf smoke `tests/unit/test_bench_serve.py` runs).
+
+On a TPU replica this measures the serving half of $/token; on CPU
+(tiny config) it is a functional perf smoke — the pipelined win there
+comes from removing the per-token host sync + per-slot eager staging,
+which is also the mechanism that matters on real hardware.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _percentile(values: List[float], pct: float) -> float:
+    if not values:
+        return 0.0
+    values = sorted(values)
+    idx = min(len(values) - 1, int(round(pct / 100.0 * (len(values) - 1))))
+    return values[idx]
+
+
+class _Tracked:
+    """One benchmark request: submit time + per-token arrival times."""
+
+    def __init__(self, prompt: List[int], max_new: int) -> None:
+        self.prompt = prompt
+        self.max_new = max_new
+        self.submit_t: float = 0.0
+        self.token_times: List[float] = []
+        self.handle = None
+
+    def watcher(self, token: Optional[int]) -> None:
+        if token is not None:
+            self.token_times.append(time.perf_counter())
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if not self.token_times:
+            return None
+        return self.token_times[0] - self.submit_t
+
+    @property
+    def itls(self) -> List[float]:
+        return [b - a for a, b in zip(self.token_times,
+                                      self.token_times[1:])]
+
+
+def _workload(rng, n_requests: int, rate: float, prompt_lens: List[int],
+              max_new: int, vocab: int) -> List[Any]:
+    """[(arrival_offset_s, _Tracked)] — Poisson arrivals, prompt length
+    cycling through the mix with +-25% jitter."""
+    out = []
+    t = 0.0
+    for i in range(n_requests):
+        t += float(rng.exponential(1.0 / rate)) if rate > 0 else 0.0
+        base = prompt_lens[i % len(prompt_lens)]
+        n = max(1, int(base * (0.75 + 0.5 * rng.random())))
+        prompt = [int(x) for x in rng.integers(1, vocab - 1, size=n)]
+        out.append((t, _Tracked(prompt, max_new)))
+    return out
+
+
+def _run_load(engine, workload) -> Dict[str, Any]:
+    """Submit the workload open-loop; wait for every request."""
+    t0 = time.perf_counter()
+
+    def submitter():
+        for offset, tracked in workload:
+            now = time.perf_counter() - t0
+            if offset > now:
+                time.sleep(offset - now)
+            tracked.submit_t = time.perf_counter()
+            tracked.handle = engine.submit(tracked.prompt,
+                                           tracked.max_new)
+            tracked.handle.add_watcher(tracked.watcher)
+
+    thread = threading.Thread(target=submitter)
+    thread.start()
+    thread.join()
+    for _, tracked in workload:
+        tracked.handle.result(timeout=600)
+    tokens = sum(len(t.token_times) for _, t in workload)
+    last = max(t.token_times[-1] for _, t in workload if t.token_times)
+    first = min(t.submit_t for _, t in workload)
+    span = max(last - first, 1e-9)
+    ttfts = [t.ttft for _, t in workload if t.ttft is not None]
+    itls = [g for _, t in workload for g in t.itls]
+    return {
+        'requests': len(workload),
+        'tokens': tokens,
+        'tokens_per_s': round(tokens / span, 2),
+        'ttft_p50_ms': round(_percentile(ttfts, 50) * 1e3, 2),
+        'ttft_p99_ms': round(_percentile(ttfts, 99) * 1e3, 2),
+        'itl_p50_ms': round(_percentile(itls, 50) * 1e3, 2),
+        'itl_p99_ms': round(_percentile(itls, 99) * 1e3, 2),
+    }
+
+
+def _measure_chunk_compute(cfg, params, chunk: int, max_len: int,
+                           vocab: int) -> float:
+    """Median wall time of ONE jitted prefill-chunk continuation (the
+    unit the chunked-prefill stall bound is stated in)."""
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import decode
+    fn = jax.jit(lambda p, t, c: decode.prefill_chunk(cfg, p, t, c))
+    _, cache = decode.prefill(
+        cfg, params, jnp.ones((1, chunk), jnp.int32), max_len=max_len)
+    piece = jnp.ones((1, chunk), jnp.int32) % (vocab - 1) + 1
+    logits, _ = fn(params, piece, cache)   # compile
+    logits.block_until_ready()
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        logits, new_cache = fn(params, piece, cache)
+        logits.block_until_ready()
+        del new_cache
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2]
+
+
+def _stall_probe(cfg, params, *, slots: int, prompt_len: int,
+                 chunk: int, max_new_bg: int, vocab: int,
+                 pipelined_chunked: bool) -> Dict[str, Any]:
+    """Admit a long prompt while slots-1 decodes run; the worst ITL the
+    running decodes see during the admission window IS the head-of-line
+    stall that admission imposed."""
+    import numpy as np
+
+    from skypilot_tpu.serve import batching_engine
+    max_len = prompt_len + 2 * max_new_bg + 16
+    eng = batching_engine.ContinuousBatchingEngine(
+        cfg, params, max_len=max_len, slots=slots,
+        prefill_chunk=chunk if pipelined_chunked else max(prompt_len, 16))
+    try:
+        # Warm every compile on the admission path (tick, the long
+        # prompt's chunk-0 bucket, the chunk continuation, insert) so
+        # the probe measures the steady-state stall, not XLA.
+        eng.generate([1, 2, 3], 2, timeout=600)
+        eng.generate(list(range(1, prompt_len + 1)), 2, timeout=600)
+        rng = np.random.default_rng(0)
+        background = []
+        for _ in range(max(1, slots - 1)):
+            tracked = _Tracked(
+                [int(x) for x in rng.integers(1, vocab - 1, size=8)],
+                max_new_bg)
+            tracked.submit_t = time.perf_counter()
+            tracked.handle = eng.submit(tracked.prompt, tracked.max_new)
+            tracked.handle.add_watcher(tracked.watcher)
+            background.append(tracked)
+        # Steady decode before the admission hits.
+        deadline = time.time() + 120
+        while (min(len(t.token_times) for t in background) < 5 and
+               time.time() < deadline):
+            time.sleep(0.005)
+        long_prompt = [int(x)
+                       for x in rng.integers(1, vocab - 1,
+                                             size=prompt_len)]
+        t_admit = time.perf_counter()
+        handle = eng.submit(long_prompt, 2)
+        handle.result(timeout=600)
+        t_first = time.perf_counter()
+        for t in background:
+            t.handle.cancel()
+        # Worst gap any running decode saw inside the admission window.
+        stall = 0.0
+        for t in background:
+            times = [x for x in t.token_times
+                     if t_admit - 0.5 <= x <= t_first + 0.5]
+            stall = max(stall, max(
+                (b - a for a, b in zip(times, times[1:])), default=0.0))
+        baseline_itls = [g for t in background for g in t.itls
+                         if g > 0]
+        return {
+            'max_itl_during_admission_ms': round(stall * 1e3, 2),
+            'baseline_itl_p50_ms': round(
+                _percentile(baseline_itls, 50) * 1e3, 2),
+        }
+    finally:
+        eng.stop()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--model', default='tiny')
+    parser.add_argument('--slots', type=int, default=4)
+    parser.add_argument('--max-len', type=int, default=512)
+    parser.add_argument('--requests', type=int, default=48)
+    parser.add_argument('--rate', type=float, default=150.0,
+                        help='Poisson arrival rate (requests/s).  The '
+                             'default SATURATES the CPU tiny config so '
+                             'tokens/s measures engine capacity, not '
+                             'offered load; lower it to probe latency '
+                             'at sub-saturation.')
+    parser.add_argument('--max-new-tokens', type=int, default=32)
+    parser.add_argument('--prompt-lens', default='8,24,64,128',
+                        help='Comma-separated prompt-length mix.')
+    parser.add_argument('--prefill-chunk', type=int, default=256)
+    parser.add_argument('--stall-prompt-len', type=int, default=2048,
+                        help='Long-admission prompt for the ITL stall '
+                             'probe.')
+    parser.add_argument('--seed', type=int, default=0)
+    parser.add_argument('--skip-legacy', action='store_true',
+                        help='Skip the pre-pipeline A/B run.')
+    parser.add_argument('--skip-stall-probe', action='store_true')
+    parser.add_argument('--smoke', action='store_true',
+                        help='Seconds-scale config for CI '
+                             '(tests/unit/test_bench_serve.py).')
+    parser.add_argument('--out', default=None,
+                        help='Output JSON path (default '
+                             'BENCH_serve.json, or '
+                             'BENCH_serve_smoke.json with --smoke).')
+    args = parser.parse_args()
+    if args.smoke:
+        # Seconds-scale but still SATURATING (offered load well above
+        # the legacy engine's capacity) so speedup_vs_legacy measures
+        # the decode loop, not the arrival process.
+        args.requests = 32
+        args.rate = 400.0
+        args.max_new_tokens = 16
+        args.prompt_lens = '4,8,16'
+        args.max_len = 64
+        args.prefill_chunk = 32
+        args.stall_prompt_len = 96
+    out_path = args.out or ('BENCH_serve_smoke.json' if args.smoke
+                            else 'BENCH_serve.json')
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from skypilot_tpu.models import configs
+    from skypilot_tpu.serve import batching_engine
+
+    cfg = configs.get_config(args.model)
+    from skypilot_tpu.models.transformer import Transformer
+    params = nn.meta.unbox(Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))['params'])
+    vocab = cfg.vocab_size
+    prompt_lens = [int(x) for x in args.prompt_lens.split(',')]
+
+    results: Dict[str, Any] = {}
+    for mode, pipelined in (('pipelined', True), ('legacy', False)):
+        if mode == 'legacy' and args.skip_legacy:
+            continue
+        rng = np.random.default_rng(args.seed)
+        workload = _workload(rng, args.requests, args.rate, prompt_lens,
+                             args.max_new_tokens, vocab)
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=args.max_len, slots=args.slots,
+            prefill_chunk=args.prefill_chunk, pipelined=pipelined)
+        try:
+            # Warm every compile (tick, buckets, chunk) outside the
+            # timed region with the REAL shapes — including the top
+            # bucket the +25% prompt-length jitter can reach.
+            warm_lens = sorted(set(prompt_lens) |
+                               {int(max(prompt_lens) * 1.25) + 1})
+            for base in warm_lens:
+                eng.generate(list(range(1, base + 1)),
+                             min(4, args.max_new_tokens), timeout=600)
+            result = _run_load(eng, workload)
+        finally:
+            eng.stop()
+        results[mode] = result
+
+    payload: Dict[str, Any] = {
+        'metric': 'serve_decode_tokens_per_sec',
+        'value': results['pipelined']['tokens_per_s'],
+        'unit': 'tokens/s',
+        'config': {
+            'model': args.model,
+            'slots': args.slots,
+            'max_len': args.max_len,
+            'requests': args.requests,
+            'poisson_rate': args.rate,
+            'max_new_tokens': args.max_new_tokens,
+            'prompt_lens': prompt_lens,
+            'prefill_chunk': args.prefill_chunk,
+            'backend': jax.default_backend(),
+        },
+        'pipelined': results['pipelined'],
+    }
+    if 'legacy' in results:
+        payload['legacy'] = results['legacy']
+        legacy_tps = max(results['legacy']['tokens_per_s'], 1e-9)
+        payload['speedup_vs_legacy'] = round(
+            results['pipelined']['tokens_per_s'] / legacy_tps, 2)
+
+    if not args.skip_stall_probe:
+        chunk_s = _measure_chunk_compute(
+            cfg, params, args.prefill_chunk,
+            args.stall_prompt_len + 64, vocab)
+        max_new_bg = 80 if args.smoke else 400
+        chunked = _stall_probe(
+            cfg, params, slots=args.slots,
+            prompt_len=args.stall_prompt_len,
+            chunk=args.prefill_chunk, max_new_bg=max_new_bg,
+            vocab=vocab, pipelined_chunked=True)
+        unchunked = _stall_probe(
+            cfg, params, slots=args.slots,
+            prompt_len=args.stall_prompt_len,
+            chunk=args.prefill_chunk, max_new_bg=max_new_bg,
+            vocab=vocab, pipelined_chunked=False)
+        # The engine runs at most one chunk between ticks, so a running
+        # decode's worst gap is one chunk + one tick (+ host noise):
+        # bound it by one chunk's compute plus a few baseline ITLs.
+        bound_ms = round(chunk_s * 1e3 +
+                         max(5 * chunked['baseline_itl_p50_ms'], 50.0),
+                         2)
+        payload['chunked_prefill_stall'] = {
+            'stall_prompt_len': args.stall_prompt_len,
+            'prefill_chunk': args.prefill_chunk,
+            'chunk_compute_ms': round(chunk_s * 1e3, 2),
+            'max_itl_during_admission_ms':
+                chunked['max_itl_during_admission_ms'],
+            'baseline_itl_p50_ms': chunked['baseline_itl_p50_ms'],
+            'bound_ms': bound_ms,
+            'stall_bounded_by_chunk':
+                chunked['max_itl_during_admission_ms'] <= bound_ms,
+            'unchunked_max_itl_ms':
+                unchunked['max_itl_during_admission_ms'],
+        }
+
+    line = json.dumps(payload)
+    print(line)
+    with open(out_path, 'w', encoding='utf-8') as f:
+        f.write(line + '\n')
+
+
+if __name__ == '__main__':
+    main()

@@ -36,6 +36,9 @@ from skypilot_tpu.ops.attention import flash_attention
 class SamplingConfig:
     temperature: float = 0.0   # 0 = greedy
     top_k: int = 0             # 0 = no top-k filtering
+    # Per-request RNG seed for temperature sampling (serving: a client
+    # pins its own stream; greedy ignores it).
+    seed: int = 0
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int
@@ -132,13 +135,12 @@ def _norm(x, scale, eps, plus_one: bool = False):
     return (normed * scale).astype(x.dtype)
 
 
-def _layer_forward(x, lp, cfg, positions, k_cache, v_cache, cache_len,
+def _layer_forward(x, lp, cfg, positions, k_cache, v_cache,
                    *, use_flash: bool):
     """One decoder layer against an explicit KV cache slice.
 
     x [b, s, d]; k_cache/v_cache [b, h_kv, max_len, hd] already contain
-    this call's k/v written at [positions]; cache_len = total valid
-    length after the write.  Returns the layer output.
+    this call's k/v written at [positions].  Returns the layer output.
     """
     h = _norm(x, lp['attn_norm']['scale'], cfg.norm_eps,
               cfg.norm_scale_plus_one)
@@ -148,14 +150,12 @@ def _layer_forward(x, lp, cfg, positions, k_cache, v_cache, cache_len,
     if use_flash:
         # Prefill from index 0: the valid cache region is exactly the
         # prompt window [0, s) — a STATIC slice (q.shape[2]), as jit
-        # requires.  (Chunked prefill at index>0 would need the masked
-        # path instead.)
-        del cache_len
+        # requires.  (Chunks at index>0 take the masked path instead.)
         s = q.shape[2]
         out = flash_attention(q, k_cache[:, :, :s],
                               v_cache[:, :, :s], causal=True)
     else:
-        # Single-token decode: grouped einsums against the cache — GQA
+        # Masked decode: grouped einsums against the cache — GQA
         # q-heads fold into a `rep` axis per kv-head, so the repeated
         # K/V never materialises (8x cache-read savings on llama3-70b).
         b, h, qs, d = q.shape
@@ -165,12 +165,17 @@ def _layer_forward(x, lp, cfg, positions, k_cache, v_cache, cache_len,
         s = jnp.einsum('bgrqd,bgkd->bgrqk', qg, k32) * (
             cfg.head_dim ** -0.5)
         kpos = jnp.arange(k_cache.shape[2])
-        # cache_len is a scalar (single-sequence decode) or [B]
-        # (slot-batched decode — every slot at its own depth).
-        cl = jnp.asarray(cache_len)
-        if cl.ndim == 1:
-            cl = cl[:, None, None, None, None]
-        mask = kpos[None, None, None, None, :] < cl
+        # Per-query-position causal mask: query at absolute position p
+        # attends keys at kpos <= p.  positions is [s] (single-sequence
+        # prefill continuation), [B, 1] (slot-batched decode — every
+        # slot at its own depth), or [B, s] — so one masked path serves
+        # single-token decode AND multi-token chunked prefill at
+        # index > 0 (where the flash window-from-0 trick is invalid).
+        pos = jnp.asarray(positions)
+        if pos.ndim == 1:
+            pos = pos[None]                               # [1, s]
+        mask = (kpos[None, None, None, None, :] <=
+                pos[:, None, None, :, None])
         s = jnp.where(mask, s, NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum('bgrqk,bgkd->bgrqd', p,
@@ -195,12 +200,11 @@ def _embed(cfg, params, tokens):
 
 
 def _scan_layers_and_unembed(cfg, params, x, positions, cache_k, cache_v,
-                             cache_len, write_fn, *, use_flash: bool):
+                             write_fn, *, use_flash: bool):
     """The shared per-layer loop: project+rope k/v, write them into the
     cache via `write_fn(k_cache, k_new) -> k_cache`, run the layer, then
     final-norm + unembed the last position.  Single-sequence decode and
-    slot-batched decode differ ONLY in write_fn / positions / cache_len
-    shapes."""
+    slot-batched decode differ ONLY in write_fn / positions shapes."""
     layers = _layer_params(params, cfg)
 
     def body(x, layer_state):
@@ -213,7 +217,7 @@ def _scan_layers_and_unembed(cfg, params, x, positions, cache_k, cache_v,
         k_cache = write_fn(k_cache, k)
         v_cache = write_fn(v_cache, v)
         x = _layer_forward(x, lp, cfg, positions, k_cache, v_cache,
-                           cache_len, use_flash=use_flash)
+                           use_flash=use_flash)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -239,7 +243,7 @@ def _forward_with_cache(cfg, params, tokens, cache, *, use_flash: bool):
 
     logits, new_k, new_v = _scan_layers_and_unembed(
         cfg, params, _embed(cfg, params, tokens), positions,
-        cache['k'], cache['v'], cache_len, write, use_flash=use_flash)
+        cache['k'], cache['v'], write, use_flash=use_flash)
     return logits, {'k': new_k, 'v': new_v, 'index': cache_len}
 
 
@@ -259,6 +263,21 @@ def prefill(cfg: ModelConfig, params, tokens, *, max_len: int):
 def decode_step(cfg: ModelConfig, params, token, cache):
     """One token [b, 1] -> (logits [b, V], cache).  jit this."""
     return _forward_with_cache(cfg, params, token, cache,
+                               use_flash=False)
+
+
+def prefill_chunk(cfg: ModelConfig, params, tokens, cache):
+    """Continue a prefill at cache['index'] with a multi-token chunk.
+
+    tokens [b, c] -> (last-position logits [b, V], cache with index
+    advanced by c).  Uses the masked path with a per-query-position
+    causal mask, so it is exact at ANY starting index — this is what
+    lets a serving engine split a long prompt's prefill into bounded
+    chunks interleaved with decode ticks instead of stalling every
+    in-flight request for the whole prompt.  Chunk 0 can still use
+    `prefill` (flash path); later chunks must come through here.
+    """
+    return _forward_with_cache(cfg, params, tokens, cache,
                                use_flash=False)
 
 
@@ -326,7 +345,8 @@ def generate(cfg: ModelConfig, params, prompt, *, max_new_tokens: int,
     shapes, one compile per configuration, the full decode device-side.
     """
     sampling = sampling or SamplingConfig()
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rng = (rng if rng is not None
+           else jax.random.PRNGKey(sampling.seed))
     prompt_len = prompt.shape[1]
     max_len = max_len or (prompt_len + max_new_tokens)
     if max_len < prompt_len + max_new_tokens:
@@ -373,10 +393,15 @@ def insert_prefill(slot_cache: Dict[str, Any], slot: int,
     return {'k': k, 'v': v, 'lengths': lengths}
 
 
-def batched_step(cfg: ModelConfig, params, tokens, slot_cache):
+def batched_step(cfg: ModelConfig, params, tokens, slot_cache,
+                 active=None):
     """One decode step across ALL slots; each slot attends its own
-    depth.  tokens [B, 1]; returns (logits [B, V], new slot_cache with
-    every length advanced by 1 — callers ignore/reset inactive slots).
+    depth.  tokens [B, 1]; returns (logits [B, V], new slot_cache).
+    Without `active`, every length advances by 1 (callers ignore/reset
+    inactive slots).  With `active` [B] bool, only active slots advance
+    — inactive slots' writes land at their frozen length (garbage that
+    is overwritten by the next admission) and their logits are garbage
+    the caller masks out.
     """
     lengths = slot_cache['lengths']                    # [B]
     positions = lengths[:, None]                       # [B, 1]
@@ -391,6 +416,115 @@ def batched_step(cfg: ModelConfig, params, tokens, slot_cache):
 
     logits, new_k, new_v = _scan_layers_and_unembed(
         cfg, params, _embed(cfg, params, tokens), positions,
-        slot_cache['k'], slot_cache['v'], lengths + 1, write,
+        slot_cache['k'], slot_cache['v'], write,
         use_flash=False)
-    return logits, {'k': new_k, 'v': new_v, 'lengths': lengths + 1}
+    advance = (jnp.ones_like(lengths) if active is None
+               else active.astype(lengths.dtype))
+    return logits, {'k': new_k, 'v': new_v, 'lengths': lengths + advance}
+
+
+def batched_sample(logits, keys, temperature, top_k, *,
+                   max_top_k: int = 64):
+    """Per-slot token selection, fully on device: logits [B, V],
+    keys [B, 2] (one PRNG key per slot), temperature [B] (<= 0 means
+    greedy for that slot), top_k [B] (0 = no filtering).
+
+    temperature and top_k are TRACED — per-request sampling params must
+    not recompile a serving replica.  lax.top_k needs a static k, so
+    the graph computes the top `max_top_k` once and each slot reads its
+    own (traced) k-th threshold out of that table; submit-side
+    validation keeps requested top_k <= max_top_k.  Row-for-row parity
+    with `sample`: the same key and logits produce the same token
+    (pinned by tests/unit/test_decode.py).
+    """
+    greedy_tok = jnp.argmax(logits, axis=-1)
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+    kk = min(max(int(max_top_k), 1), logits.shape[-1])
+    topvals = jax.lax.top_k(scaled, kk)[0]               # [B, kk]
+    idx = jnp.clip(top_k - 1, 0, kk - 1)[:, None]
+    kth = jnp.take_along_axis(topvals, idx, axis=1)      # [B, 1]
+    scaled = jnp.where((top_k[:, None] > 0) & (scaled < kth),
+                       NEG_INF, scaled)
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+    return jnp.where(temperature <= 0.0, greedy_tok, sampled)
+
+
+def init_engine_state(slots: int, max_stop_ids: int = 16
+                      ) -> Dict[str, Any]:
+    """Device-resident per-slot decode state for the serving engine:
+    everything the hot loop needs so a tick never waits on Python.
+
+    tokens      [B]    next input token (tick t+1 input IS tick t output)
+    active      [B]    slot is decoding (flips off ON DEVICE at stop)
+    remaining   [B]    max_new_tokens countdown
+    stop_ids    [B,S]  per-slot stop set, -1 padded (multi-EOS)
+    keys        [B,2]  per-slot PRNG key chain (split once per tick)
+    temperature [B]    <= 0 -> greedy
+    top_k       [B]    0 -> no filtering
+    """
+    return {
+        'tokens': jnp.zeros((slots,), jnp.int32),
+        'active': jnp.zeros((slots,), jnp.bool_),
+        'remaining': jnp.zeros((slots,), jnp.int32),
+        'stop_ids': jnp.full((slots, max_stop_ids), -1, jnp.int32),
+        'keys': jnp.zeros((slots, 2), jnp.uint32),
+        'temperature': jnp.zeros((slots,), jnp.float32),
+        'top_k': jnp.zeros((slots,), jnp.int32),
+    }
+
+
+def engine_step(cfg: ModelConfig, params, state, slot_cache, *,
+                max_top_k: int = 64):
+    """One fully-on-device serving tick: decode every active slot,
+    select its next token (greedy or temperature/top-k), and update the
+    stop bookkeeping — no host round-trip anywhere in the loop.
+
+    Returns (new_state, new_cache, finished [B]).  new_state['tokens']
+    is the next tick's input, so the engine can dispatch tick t+1
+    before fetching tick t's tokens and read results one tick behind;
+    slots that stop at tick t are already inactive ON DEVICE when tick
+    t+1 runs, so the pipelined tick never decodes past a stop.
+    Inactive slots freeze: their token/remaining are unchanged and
+    their cache length does not advance.
+    """
+    active = state['active']
+    logits, new_cache = batched_step(cfg, params,
+                                     state['tokens'][:, None],
+                                     slot_cache, active)
+    split = jax.vmap(lambda k: jax.random.split(k, 2))(state['keys'])
+    nxt = batched_sample(logits, split[:, 1], state['temperature'],
+                         state['top_k'], max_top_k=max_top_k)
+    nxt = jnp.where(active, nxt.astype(jnp.int32), state['tokens'])
+    stopped = jnp.any(nxt[:, None] == state['stop_ids'], axis=1)
+    remaining = state['remaining'] - active.astype(jnp.int32)
+    finished = active & (stopped | (remaining <= 0))
+    new_state = dict(
+        state,
+        tokens=nxt,
+        active=active & ~finished,
+        remaining=remaining,
+        keys=split[:, 0],
+    )
+    return new_state, new_cache, finished
+
+
+def admit_slot_state(state, slot, token, max_new_tokens, stop_row, key,
+                     temperature, top_k):
+    """Write one slot's admission into the engine state (jit this with
+    the state donated): ONE dispatch per admission instead of seven
+    eager `.at[slot].set` updates on the hot path."""
+    return {
+        'tokens': state['tokens'].at[slot].set(
+            jnp.asarray(token, jnp.int32)),
+        'active': state['active'].at[slot].set(True),
+        'remaining': state['remaining'].at[slot].set(
+            jnp.asarray(max_new_tokens, jnp.int32)),
+        'stop_ids': state['stop_ids'].at[slot].set(
+            jnp.asarray(stop_row, jnp.int32)),
+        'keys': state['keys'].at[slot].set(key),
+        'temperature': state['temperature'].at[slot].set(
+            jnp.asarray(temperature, jnp.float32)),
+        'top_k': state['top_k'].at[slot].set(
+            jnp.asarray(top_k, jnp.int32)),
+    }

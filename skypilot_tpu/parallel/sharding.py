@@ -82,6 +82,26 @@ def head_kernel_sharding(mesh):
     return logical_sharding(mesh, 'embed', 'vocab')
 
 
+def slot_cache_sharding(mesh):
+    """Sharding for the serving engine's slot KV cache
+    [layers, slots, kv_heads, max_len, head_dim]: kv_heads ride the
+    'tensor' axis exactly like the attention params, so the batched
+    decode step's cache reads/writes stay local to each tensor shard;
+    slots and positions are replicated axes (the slot pool is the batch
+    dimension and every chip holds every slot's depth)."""
+    return logical_sharding(mesh, 'layers', None, 'kv_heads', None,
+                            'head_dim')
+
+
+def engine_state_sharding(mesh):
+    """Sharding for the engine's per-slot decode state arrays (tokens,
+    masks, counters, keys): fully replicated — they are a few bytes per
+    slot and every tensor shard needs them to agree, so GSPMD must not
+    be tempted to shard the tiny batch axis."""
+    import jax  # pylint: disable=import-outside-toplevel
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+
 def replicated(mesh):
     import jax  # pylint: disable=import-outside-toplevel
     return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())

@@ -27,6 +27,7 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import batching_engine as batching_engine_lib
 from skypilot_tpu.serve import model_server as model_server_lib
 
 logger = sky_logging.init_logger(__name__)
@@ -37,9 +38,24 @@ _IDLE_TIMEOUT = 300.0
 
 class _HttpError(Exception):
 
-    def __init__(self, code: int, message: str) -> None:
+    def __init__(self, code: int, message: str,
+                 headers: Optional[Dict[str, str]] = None) -> None:
         super().__init__(message)
         self.code = code
+        self.headers = headers or {}
+
+
+def _backpressure_error(e: Exception) -> Optional[_HttpError]:
+    """Admission-control pushback as honest HTTP: 429 + Retry-After
+    when the engine queue is full, 503 + Retry-After when the request
+    expired queued — so the LB/client backs off instead of timing out."""
+    if isinstance(e, batching_engine_lib.QueueFull):
+        return _HttpError(429, str(e),
+                          {'Retry-After': str(int(e.retry_after))})
+    if isinstance(e, batching_engine_lib.QueueExpired):
+        return _HttpError(503, str(e),
+                          {'Retry-After': str(int(e.retry_after))})
+    return None
 
 
 async def _read_request(reader: asyncio.StreamReader
@@ -82,15 +98,20 @@ async def _read_request(reader: asyncio.StreamReader
     return method, path, headers, body
 
 
-def _json_response(code: int, payload: Dict[str, Any]) -> bytes:
+def _json_response(code: int, payload: Dict[str, Any],
+                   headers: Optional[Dict[str, str]] = None) -> bytes:
     body = json.dumps(payload).encode()
     reason = {200: 'OK', 400: 'Bad Request', 404: 'Not Found',
               408: 'Request Timeout', 413: 'Payload Too Large',
+              429: 'Too Many Requests',
               500: 'Internal Server Error',
               503: 'Service Unavailable'}.get(code, 'Error')
+    extra = ''.join(f'{k}: {v}\r\n'
+                    for k, v in (headers or {}).items())
     return (f'HTTP/1.1 {code} {reason}\r\n'
             f'Content-Type: application/json\r\n'
             f'Content-Length: {len(body)}\r\n'
+            f'{extra}'
             f'\r\n').encode() + body
 
 
@@ -130,14 +151,22 @@ class AsyncModelServer:
                 code = 503
         return code, payload
 
+    def _sampling(self, req: Dict[str, Any]):
+        """(temperature, top_k, seed) — request fields, falling back to
+        the server's CLI defaults."""
+        server = self.server
+        return (float(req.get('temperature', server.default_temperature)),
+                int(req.get('top_k', server.default_top_k)),
+                int(req.get('seed', server.default_seed)))
+
     async def _generate(self, req: Dict[str, Any]) -> Dict[str, Any]:
         t0 = time.perf_counter()
+        temperature, top_k, seed = self._sampling(req)
         tokens = await asyncio.get_running_loop().run_in_executor(
             None, lambda: self.server.generate(
                 req['prompt_ids'],
                 int(req.get('max_new_tokens', 16)),
-                float(req.get('temperature', 0.0)),
-                int(req.get('top_k', 0))))
+                temperature, top_k, seed=seed))
         return {'tokens': tokens,
                 'latency_ms': round((time.perf_counter() - t0) * 1e3, 1)}
 
@@ -160,12 +189,12 @@ class AsyncModelServer:
             await self._stream(writer, ids, req, text_mode=True)
             return
         t0 = time.perf_counter()
+        temperature, top_k, seed = self._sampling(req)
         tokens = (await asyncio.get_running_loop().run_in_executor(
             None, lambda: server.generate(
                 [ids], int(req.get('max_new_tokens', 64)),
-                float(req.get('temperature', 0.0)),
-                int(req.get('top_k', 0)),
-                stop_token=tok.eos_ids or None)))[0]
+                temperature, top_k,
+                stop_token=tok.eos_ids or None, seed=seed)))[0]
         stops = [i for i, t in enumerate(tokens) if t in tok.eos_ids]
         if stops:
             tokens = tokens[:stops[0]]
@@ -191,17 +220,25 @@ class AsyncModelServer:
         # Token mode keeps the request's raw stop_token (may be int 0).
         stop_ids = ((tok.eos_ids or None) if text_mode
                     else req.get('stop_token'))
+        from skypilot_tpu.models import decode  # pylint: disable=import-outside-toplevel
+        temperature, top_k, seed = self._sampling(req)
         try:
             request = engine.submit(
                 [int(t) for t in ids],
                 int(req.get('max_new_tokens', 64 if text_mode else 16)),
-                stop_token=stop_ids)
+                stop_token=stop_ids,
+                sampling=decode.SamplingConfig(
+                    temperature=temperature, top_k=top_k, seed=seed))
         except ValueError:
             raise
         except Exception as e:  # pylint: disable=broad-except
-            # Stopped/failed engine: the replica is unavailable, not
-            # the request wrong — 503 like the threaded front, so LB
-            # retry logic classifies it correctly.
+            # Full admission queue: 429 + Retry-After.  Stopped/failed
+            # engine: the replica is unavailable, not the request
+            # wrong — 503 like the threaded front, so LB retry logic
+            # classifies it correctly.
+            bp = _backpressure_error(e)
+            if bp is not None:
+                raise bp from e
             raise _HttpError(503, f'{type(e).__name__}: {e}') from e
         q = self._watch(request)
         writer.write(b'HTTP/1.1 200 OK\r\n'
@@ -316,7 +353,7 @@ class AsyncModelServer:
                         raise _HttpError(404, 'unknown path')
                 except _HttpError as e:
                     writer.write(_json_response(
-                        e.code, {'error': str(e)}))
+                        e.code, {'error': str(e)}, e.headers))
                     await writer.drain()
                 except (KeyError, ValueError, TypeError) as e:
                     writer.write(_json_response(400, {'error': str(e)}))
@@ -325,9 +362,15 @@ class AsyncModelServer:
                     break
                 except Exception as e:  # pylint: disable=broad-except
                     # Engine failures must reach the client as HTTP,
-                    # not a dropped connection.
-                    writer.write(_json_response(
-                        500, {'error': f'{type(e).__name__}: {e}'}))
+                    # not a dropped connection; admission pushback as
+                    # 429/503 + Retry-After.
+                    bp = _backpressure_error(e)
+                    if bp is not None:
+                        writer.write(_json_response(
+                            bp.code, {'error': str(bp)}, bp.headers))
+                    else:
+                        writer.write(_json_response(
+                            500, {'error': f'{type(e).__name__}: {e}'}))
                     await writer.drain()
         except (BrokenPipeError, ConnectionResetError,
                 asyncio.IncompleteReadError):

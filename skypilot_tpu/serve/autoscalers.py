@@ -36,12 +36,18 @@ class RequestRateAutoscaler:
         self.min_replicas = spec.min_replicas
         self.max_replicas = spec.max_replicas
         self.target_qps_per_replica = spec.target_qps_per_replica
+        self.target_slot_utilization = getattr(
+            spec, 'target_slot_utilization', None)
         self.upscale_delay_seconds = spec.upscale_delay_seconds
         self.downscale_delay_seconds = spec.downscale_delay_seconds
         self.target_num_replicas = spec.min_replicas
         self._upscale_since: Optional[float] = None
         self._downscale_since: Optional[float] = None
         self.request_timestamps: List[float] = []
+        # Latest per-replica decode load (busy_slots/slots fractions
+        # from the replicas' /health engine stats); empty until the
+        # controller's probe loop reports.
+        self.replica_loads: List[float] = []
 
     # ------------------------------------------------------------- inputs
 
@@ -65,11 +71,35 @@ class RequestRateAutoscaler:
         self.request_timestamps = [t for t in self.request_timestamps
                                    if t >= cutoff]
 
+    def collect_replica_load(self, loads: List[float]) -> None:
+        """Report per-replica decode saturation (busy_slots/slots from
+        each ready replica's /health engine stats).  Lets the
+        autoscaler scale on DECODE saturation, not just QPS: long
+        generations pin every KV slot at a QPS the request-rate signal
+        reads as idle."""
+        self.replica_loads = [max(0.0, min(1.0, float(u)))
+                              for u in loads]
+
+    def _desired_from_load(self) -> int:
+        """ceil(ready * mean_util / target_util), the slot-utilization
+        analogue of the QPS rule; 0 when the signal is absent."""
+        if self.target_slot_utilization is None or not self.replica_loads:
+            return 0
+        mean_util = (sum(self.replica_loads) /
+                     len(self.replica_loads))
+        return math.ceil(len(self.replica_loads) * mean_util /
+                         self.target_slot_utilization)
+
     def _desired_from_qps(self, now: float) -> int:
-        if self.target_qps_per_replica is None:
+        del now
+        if (self.target_qps_per_replica is None and
+                self.target_slot_utilization is None):
             return self.target_num_replicas
-        qps = len(self.request_timestamps) / QPS_WINDOW_SIZE_SECONDS
-        desired = math.ceil(qps / self.target_qps_per_replica)
+        desired = self._desired_from_load()
+        if self.target_qps_per_replica is not None:
+            qps = len(self.request_timestamps) / QPS_WINDOW_SIZE_SECONDS
+            desired = max(desired,
+                          math.ceil(qps / self.target_qps_per_replica))
         return max(self.min_replicas,
                    min(self.max_replicas, desired))
 

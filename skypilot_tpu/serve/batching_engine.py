@@ -4,9 +4,24 @@ vLLM-style scheduling, rebuilt TPU-first (no reference equivalent —
 SkyPilot ships no serving internals): a FIXED pool of KV-cache slots is
 the batch dimension, so every jit'd shape is static.  Requests join a
 running batch the moment a slot frees (no wait for the batch to drain),
-and one `models.decode.batched_step` call advances every active slot a
+and one `models.decode.engine_step` call advances every active slot a
 token per engine tick — new arrivals ride along with half-finished
 generations.
+
+Decode hot loop (the device never waits on Python):
+- Token selection happens ON DEVICE inside the jitted step — greedy
+  argmax plus per-slot temperature/top-k sampling, stop-set matching,
+  and max_new_tokens countdown all live in `decode.engine_step`, so
+  tick t+1's input IS tick t's output with zero host transfer.
+- Ticks are PIPELINED one deep: the worker dispatches tick t+1 before
+  fetching tick t's tokens and reads results one tick behind for
+  stream/stop bookkeeping, so host work overlaps device compute.  A
+  slot that stops at tick t is already inactive on device when tick
+  t+1 runs — the pipeline never decodes past a stop.
+- Prompt prefill is CHUNKED: `_admit` splits a long prompt into
+  fixed-size chunks interleaved with decode ticks (at most one chunk
+  between ticks), so the worst ITL stall any admission can impose on
+  running requests is one chunk's compute, not one prompt's.
 
 Exact-prefill trick for static shapes (dense models): the prompt's
 first n-1 tokens are prefilled PADDED to a power-of-two bucket
@@ -14,31 +29,68 @@ first n-1 tokens are prefilled PADDED to a power-of-two bucket
 LAST real prompt token is fed through the next batched step — it
 overwrites the first pad position and attends only real keys, so
 logits match unpadded decode exactly (tests pin this against
-decode.generate).  MoE models instead prefill the FULL prompt unpadded
-(the capacity dispatch couples every token, so both padding and the
-n-1 split would perturb expert drops) and take their first token from
-the prefill logits.
+decode.generate).  Chunk 0 keeps that flash-prefill path; chunks at
+index > 0 run `decode.prefill_chunk` (per-position causal mask), which
+preserves the same n-1/last-token trick per chunk.  MoE models instead
+prefill the FULL prompt unpadded in one piece (the capacity dispatch
+couples every token, so padding, the n-1 split, and chunk boundaries
+would all perturb expert drops) and take their first token from the
+prefill logits.
 
-Greedy decoding (temperature 0) — the deterministic serving default;
-per-request stop token and max_new_tokens.
+Admission is BOUNDED: `max_queue` rejects new submits when the backlog
+is full (`QueueFull` -> HTTP 429) and `queue_ttl` expires requests
+that waited too long queued (`QueueExpired` -> HTTP 503), so a load
+spike degrades with fast, honest rejections instead of unbounded TTFT.
+
+`pipelined=False` keeps the pre-pipeline loop (inline full-prompt
+prefill, one host sync per generated token, greedy only) for A/B
+benchmarking — `bench_serve.py` reports the speedup against it.
 """
 from __future__ import annotations
 
+import collections
 import queue
 import threading
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from skypilot_tpu import sky_logging
 
 logger = sky_logging.init_logger(__name__)
 
 _PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+# Queue-wait histogram bucket upper bounds (seconds); the last bucket
+# is open-ended.  Surfaced via stats() -> /health for autoscaling.
+_WAIT_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+
+
+class QueueFull(RuntimeError):
+    """submit() rejected: the admission queue is at max_queue.
+
+    `retry_after` is the engine's estimate (seconds) of when a slot's
+    worth of backlog will have drained — servers surface it as an HTTP
+    Retry-After header on the 429.
+    """
+
+    def __init__(self, message: str, retry_after: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after = max(1.0, retry_after)
+
+
+class QueueExpired(RuntimeError):
+    """The request sat queued past queue_ttl and was never admitted
+    (servers map this to 503 + Retry-After)."""
+
+    def __init__(self, message: str, retry_after: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after = max(1.0, retry_after)
 
 
 class _Request:
 
     def __init__(self, prompt_ids: List[int], max_new_tokens: int,
-                 stop_token) -> None:
+                 stop_token, temperature: float = 0.0, top_k: int = 0,
+                 seed: int = 0) -> None:
         self.prompt_ids = list(prompt_ids)
         self.max_new_tokens = max_new_tokens
         # stop_token: None, a single id, or any iterable of ids (the
@@ -50,6 +102,10 @@ class _Request:
             self.stop_ids = frozenset({stop_token})
         else:
             self.stop_ids = frozenset(int(t) for t in stop_token)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
+        self.submit_time = time.monotonic()
         self.done = threading.Event()
         self.tokens: List[int] = []
         self.error: Optional[Exception] = None
@@ -142,18 +198,37 @@ class _Slot:
 
     def __init__(self) -> None:
         self.request: Optional[_Request] = None
-        self.next_token = 0
+        self.next_token = 0          # legacy (unpipelined) loop only
 
     @property
     def active(self) -> bool:
         return self.request is not None
 
 
+class _PendingPrefill:
+    """A dense prompt mid-chunked-prefill: the slot is reserved but
+    does not join decode ticks until every chunk has run."""
+
+    def __init__(self, slot_id: int, request: _Request,
+                 n_target: int) -> None:
+        self.slot_id = slot_id
+        self.request = request
+        self.n_target = n_target     # tokens to prefill (n-1, dense)
+        self.consumed = 0
+        self.cache: Optional[Dict[str, Any]] = None  # private [*,1,..]
+
+
 class ContinuousBatchingEngine:
     """Submit() from any thread; one worker thread owns the device."""
 
     def __init__(self, cfg, params, *, max_len: int = 512,
-                 slots: int = 4) -> None:
+                 slots: int = 4, prefill_chunk: int = 512,
+                 max_queue: int = 0,
+                 queue_ttl: Optional[float] = None,
+                 max_top_k: int = 64, max_stop_ids: int = 16,
+                 pipelined: bool = True, mesh=None) -> None:
+        import functools
+
         import jax
         import jax.numpy as jnp
 
@@ -162,40 +237,90 @@ class ContinuousBatchingEngine:
         self.cfg = cfg
         self.params = params
         self.max_len = max_len
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        self.max_queue = int(max_queue)          # 0 = unbounded
+        self.queue_ttl = queue_ttl               # None = no expiry
+        self.max_top_k = int(max_top_k)
+        self.max_stop_ids = int(max_stop_ids)
+        self.pipelined = pipelined
         self._jnp = jnp
+        self._jax = jax
         self._slots = [_Slot() for _ in range(slots)]
         self._cache = decode.init_slot_cache(cfg, slots, max_len)
-        self._tokens = jnp.zeros((slots, 1), jnp.int32)
-        self._queue: 'queue.Queue[_Request]' = queue.Queue()
+        self._state = decode.init_engine_state(slots, max_stop_ids)
+        if mesh is not None:
+            # Tensor-sharded serving: place the slot KV pool and the
+            # tiny per-slot state explicitly (kv_heads on 'tensor',
+            # state replicated) instead of leaving GSPMD to guess from
+            # the first donated step.
+            from skypilot_tpu.parallel import sharding as sharding_lib
+            self._cache = jax.device_put(
+                self._cache, sharding_lib.slot_cache_sharding(mesh))
+            self._state = jax.device_put(
+                self._state, sharding_lib.engine_state_sharding(mesh))
+        self._tokens = jnp.zeros((slots, 1), jnp.int32)  # legacy loop
+        self._queue: Deque[_Request] = collections.deque()
+        self._cond = threading.Condition()
         self._stop = threading.Event()
 
-        def step(params, tokens, cache):
-            return decode.batched_step(cfg, params, tokens, cache)
-
-        self._step = jax.jit(step, donate_argnums=(2,))
+        self._step = jax.jit(
+            functools.partial(decode.engine_step, cfg,
+                              max_top_k=self.max_top_k),
+            donate_argnums=(2,))
+        self._legacy_step = jax.jit(
+            lambda p, t, c: decode.batched_step(cfg, p, t, c),
+            donate_argnums=(2,))
         # Jitted prefill: one compile per prompt-length bucket (the
         # whole point of the bucket padding), not eager per-op dispatch
         # per admission.
         self._prefill = jax.jit(
             lambda params, toks: decode.prefill(cfg, params, toks,
                                                 max_len=max_len))
+        # Chunk continuation at index > 0 (masked per-position causal
+        # path): one compile per chunk width; the private prefill cache
+        # is donated so XLA extends it in place.
+        self._prefill_chunk = jax.jit(
+            lambda params, toks, cache: decode.prefill_chunk(
+                cfg, params, toks, cache),
+            donate_argnums=(2,))
         # Jitted in-place slot adoption: eager dynamic_update_slice
         # would materialize two full copies of the pool cache per
         # admission; donation lets XLA update it in place.
         self._insert = jax.jit(decode.insert_prefill,
                                donate_argnums=(0,))
+        # One dispatch per admission for the whole per-slot state write
+        # (NOT donated: the previous tick's token buffer may still be
+        # pending its one-tick-behind host read).
+        self._admit_state = jax.jit(decode.admit_slot_state)
+        self._sample_one = jax.jit(
+            functools.partial(decode.batched_sample,
+                              max_top_k=self.max_top_k))
         self._failed: Optional[Exception] = None
+
+        # ---- metrics (updated under _metrics_lock; read by stats()).
+        self._metrics_lock = threading.Lock()
         self._tokens_generated = 0
+        self._ticks = 0
+        self._prefill_chunks = 0
+        self._queue_wait_hist = [0] * (len(_WAIT_BUCKETS) + 1)
+        self._rate_window: Deque[Tuple[float, int]] = collections.deque()
+
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
     # ------------------------------------------------------------ public
 
     def submit(self, prompt_ids: List[int], max_new_tokens: int,
-               stop_token=None) -> _Request:
+               stop_token=None, sampling=None) -> _Request:
         """stop_token: None, one id, or an iterable of ids — the
         request finishes at the FIRST generated member of the set
-        (multi-EOS: model-level EOS + chat turn-end markers)."""
+        (multi-EOS: model-level EOS + chat turn-end markers).
+
+        sampling: optional models.decode.SamplingConfig.  temperature
+        <= 0 decodes greedily (the deterministic serving default);
+        temperature > 0 samples on device with per-request top_k/seed —
+        deterministic for a given seed (the slot's key chain splits
+        once per generated token, independent of other traffic)."""
         if not prompt_ids:
             raise ValueError('empty prompt')
         if max_new_tokens < 1:
@@ -205,12 +330,36 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f'prompt {len(prompt_ids)} + new {max_new_tokens} '
                 f'exceeds max_len {self.max_len}')
+        temperature, top_k, seed = 0.0, 0, 0
+        if sampling is not None:
+            temperature = float(sampling.temperature)
+            top_k = int(sampling.top_k)
+            seed = int(getattr(sampling, 'seed', 0))
+        if top_k > self.max_top_k:
+            raise ValueError(
+                f'top_k {top_k} > engine max_top_k {self.max_top_k}')
+        if temperature > 0.0 and not self.pipelined:
+            raise ValueError(
+                'the legacy (pipelined=False) loop serves greedy '
+                'decoding only')
+        request = _Request(prompt_ids, max_new_tokens, stop_token,
+                           temperature=temperature, top_k=top_k,
+                           seed=seed)
+        if len(request.stop_ids) > self.max_stop_ids:
+            raise ValueError(
+                f'{len(request.stop_ids)} stop ids > engine '
+                f'max_stop_ids {self.max_stop_ids}')
         if self._stop.is_set() or self._failed is not None:
             raise RuntimeError('batching engine is stopped'
                                if self._failed is None else
                                f'batching engine failed: {self._failed}')
-        request = _Request(prompt_ids, max_new_tokens, stop_token)
-        self._queue.put(request)
+        with self._cond:
+            if self.max_queue and len(self._queue) >= self.max_queue:
+                raise QueueFull(
+                    f'admission queue full ({self.max_queue} waiting); '
+                    'retry later', retry_after=self._drain_estimate())
+            self._queue.append(request)
+            self._cond.notify()
         if self._stop.is_set():
             # Lost the race with stop(): its drain may have already run,
             # so fail this request directly (idempotent via the event).
@@ -220,39 +369,97 @@ class ContinuousBatchingEngine:
         return request
 
     def generate(self, prompt_ids: List[int], max_new_tokens: int,
-                 stop_token=None,
+                 stop_token=None, sampling=None,
                  timeout: float = 600.0) -> List[int]:
-        return self.submit(prompt_ids, max_new_tokens,
-                           stop_token).result(timeout)
+        return self.submit(prompt_ids, max_new_tokens, stop_token,
+                           sampling=sampling).result(timeout)
+
+    def _drain_estimate(self) -> float:
+        """Rough seconds until one queue position frees: backlog size
+        over the recent decode rate (floor 1s — it feeds Retry-After)."""
+        rate = self._decode_rate()
+        if rate <= 0:
+            return 1.0
+        avg_new = 32.0  # no per-request oracle; a slot's typical budget
+        return max(1.0, len(self._queue) * avg_new /
+                   (rate * max(1, len(self._slots))))
+
+    def _decode_rate(self) -> float:
+        with self._metrics_lock:
+            if not self._rate_window:
+                return 0.0
+            t0 = self._rate_window[0][0]
+            span = time.monotonic() - t0
+            total = sum(n for _, n in self._rate_window)
+        return total / max(span, 1e-3)
 
     def stats(self) -> Dict[str, Any]:
-        """Live scheduling stats (surfaced via the server's /health —
-        queue depth + slot occupancy are the autoscaling signals)."""
+        """Live scheduling + decode-saturation stats (surfaced via the
+        server's /health): queue depth and slot occupancy are the
+        scale-out signals, decode_tokens_per_s and the queue-wait
+        histogram say whether the replica is decode-bound rather than
+        merely popular (serve/autoscalers.py consumes busy/slots as
+        replica load)."""
         busy = sum(1 for s in self._slots if s.active)
-        return {
-            'slots': len(self._slots),
-            'busy_slots': busy,
-            'queued_requests': self._queue.qsize(),
-            'tokens_generated': self._tokens_generated,
-            'failed': self._failed is not None,
-        }
+        with self._metrics_lock:
+            hist = {}
+            for i, bound in enumerate(_WAIT_BUCKETS):
+                hist[f'<{bound}s'] = self._queue_wait_hist[i]
+            hist[f'>={_WAIT_BUCKETS[-1]}s'] = self._queue_wait_hist[-1]
+            stats = {
+                'slots': len(self._slots),
+                'busy_slots': busy,
+                'queued_requests': len(self._queue),
+                'tokens_generated': self._tokens_generated,
+                'failed': self._failed is not None,
+                'ticks': self._ticks,
+                'prefill_chunks': self._prefill_chunks,
+                'queue_wait_hist': hist,
+                'max_queue': self.max_queue,
+                'prefill_chunk': self.prefill_chunk,
+                'pipelined': self.pipelined,
+            }
+        stats['decode_tokens_per_s'] = round(self._decode_rate(), 3)
+        return stats
 
     def stop(self) -> None:
         self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
         self._thread.join(timeout=10)
         # Fail fast for anything still queued or in flight — callers
         # must not sit out their full result() timeout at shutdown.
         shutdown_error = RuntimeError('batching engine stopped')
         while True:
-            try:
-                request = self._queue.get_nowait()
-            except queue.Empty:
-                break
+            with self._cond:
+                if not self._queue:
+                    break
+                request = self._queue.popleft()
             request._finish(shutdown_error)  # pylint: disable=protected-access
         for slot in self._slots:
             if slot.request is not None:
                 slot.request._finish(shutdown_error)  # pylint: disable=protected-access
                 slot.request = None
+
+    # ------------------------------------------------------------ metrics
+
+    def _record_tokens(self, n: int) -> None:
+        now = time.monotonic()
+        with self._metrics_lock:
+            self._tokens_generated += n
+            self._rate_window.append((now, n))
+            while (self._rate_window and
+                   now - self._rate_window[0][0] > 10.0):
+                self._rate_window.popleft()
+
+    def _record_queue_wait(self, request: _Request) -> None:
+        wait = time.monotonic() - request.submit_time
+        with self._metrics_lock:
+            for i, bound in enumerate(_WAIT_BUCKETS):
+                if wait < bound:
+                    self._queue_wait_hist[i] += 1
+                    return
+            self._queue_wait_hist[-1] += 1
 
     # ------------------------------------------------------------ worker
 
@@ -262,10 +469,293 @@ class ContinuousBatchingEngine:
                 return b
         return n
 
-    def _admit(self, slot_id: int, request: _Request) -> None:
+    def _pop_request(self) -> Optional[_Request]:
+        """Pop the next live queued request, expiring stale ones."""
+        while True:
+            with self._cond:
+                if not self._queue:
+                    return None
+                request = self._queue.popleft()
+            if request.cancelled:
+                request._finish()  # pylint: disable=protected-access
+                continue
+            if (self.queue_ttl is not None and
+                    time.monotonic() - request.submit_time >
+                    self.queue_ttl):
+                request._finish(QueueExpired(  # pylint: disable=protected-access
+                    f'request expired after {self.queue_ttl}s queued',
+                    retry_after=self._drain_estimate()))
+                continue
+            self._record_queue_wait(request)
+            return request
+
+    def _expire_queued(self) -> None:
+        """Fail requests that outlived queue_ttl while still queued —
+        without this a saturated engine leaves them waiting out their
+        whole client timeout."""
+        if self.queue_ttl is None:
+            return
+        now = time.monotonic()
+        expired = []
+        with self._cond:
+            if not self._queue:
+                return
+            keep: Deque[_Request] = collections.deque()
+            for request in self._queue:
+                if now - request.submit_time > self.queue_ttl:
+                    expired.append(request)
+                else:
+                    keep.append(request)
+            self._queue = keep
+        for request in expired:
+            request._finish(QueueExpired(  # pylint: disable=protected-access
+                f'request expired after {self.queue_ttl}s queued',
+                retry_after=self._drain_estimate()))
+
+    # ----------------------------------------------- pipelined admission
+
+    def _start_admission(self, slot_id: int, request: _Request
+                         ) -> Optional[_PendingPrefill]:
+        """Begin admitting `request` into `slot_id`.  Returns a
+        _PendingPrefill when chunks remain, None when the slot is live
+        (or the request finished at admission)."""
+        jnp = self._jnp
+        slot = self._slots[slot_id]
+        prompt = request.prompt_ids
+        n = len(prompt)
+        if self.cfg.n_experts > 0 and n > 0:
+            # MoE: the capacity dispatch couples EVERY prompt token, so
+            # pad tokens, an n-1/last-token split, and chunk boundaries
+            # would all change which tokens drop — only a full-prompt
+            # unpadded prefill matches the single-sequence reference.
+            # The first generated token therefore comes from the
+            # prefill logits (one compile per distinct MoE prompt
+            # length), selected with the same key chain a tick uses.
+            logits, pre = self._prefill(
+                self.params, jnp.asarray([prompt], jnp.int32))
+            self._cache = self._insert(self._cache, slot_id, pre, n)
+            key = self._jax.random.PRNGKey(request.seed)
+            carry, sub = self._jax.random.split(key)
+            first = int(self._sample_one(
+                logits, sub[None],
+                jnp.asarray([request.temperature], jnp.float32),
+                jnp.asarray([request.top_k], jnp.int32))[0])
+            request._push(first)  # pylint: disable=protected-access
+            self._record_tokens(1)
+            if (request.max_new_tokens <= 1 or
+                    first in request.stop_ids):
+                request._finish()  # pylint: disable=protected-access
+                return None
+            slot.request = request
+            self._activate(slot_id, request, first, n,
+                           remaining=request.max_new_tokens - 1,
+                           key=carry)
+            return None
+        if n <= 1:
+            # Single-token prompt: empty slot; stale keys are masked
+            # (per-position causal mask) and position 0 is overwritten
+            # by the first step's write.
+            self._cache = dict(
+                self._cache,
+                lengths=self._cache['lengths'].at[slot_id].set(0))
+            slot.request = request
+            self._activate(slot_id, request, int(prompt[-1]), 0,
+                           remaining=request.max_new_tokens,
+                           key=self._jax.random.PRNGKey(request.seed))
+            return None
+        # Dense: prefill tokens [0, n-1) in chunks; the last REAL
+        # prompt token is fed through the first batched step (it
+        # overwrites the first pad position and attends only real
+        # keys, so logits match unpadded decode exactly).
+        slot.request = request
+        pending = _PendingPrefill(slot_id, request, n - 1)
+        return pending
+
+    def _advance_prefill(self, pending: _PendingPrefill) -> bool:
+        """Run ONE chunk of a pending prefill (this is the whole point:
+        an admission stalls running decodes by at most one chunk).
+        Returns True when the prefill completed and the slot went live.
+        """
+        jnp = self._jnp
+        request = pending.request
         if request.cancelled:
-            # Cancelled while queued: don't pay a prefill (possibly a
-            # fresh bucket compile) for a dead request.
+            request._finish()  # pylint: disable=protected-access
+            self._slots[pending.slot_id].request = None
+            return True  # pending is finished (slot freed)
+        import numpy as np  # pylint: disable=import-outside-toplevel
+        n_target = pending.n_target
+        chunk = self.prefill_chunk
+        if pending.cache is None:
+            # Chunk 0: flash prefill from index 0 into a fresh private
+            # cache.  Width = the bucket of min(n_target, chunk) so
+            # short prompts keep today's bucket-bounded compile count;
+            # pad keys land at positions >= the real length where the
+            # causal mask hides them (and the first one is overwritten
+            # by the real last token's step).  Padding is staged in
+            # NUMPY: eager `.at[:n].set` would compile a tiny scatter
+            # per distinct prompt length, right on the admission path.
+            take = min(n_target, chunk)
+            bucket = min(self._bucket(take), self.max_len)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :take] = request.prompt_ids[:take]
+            _, pending.cache = self._prefill(self.params,
+                                             jnp.asarray(padded))
+            # The padded flash cache advanced index to `bucket`; chunk
+            # continuations must write at the REAL consumed length.
+            pending.cache = dict(pending.cache,
+                                 index=jnp.asarray(take, jnp.int32))
+            pending.consumed = take
+        else:
+            # Chunk i>0: masked per-position-causal continuation at
+            # index = consumed.  Always `chunk` wide (one compile);
+            # the final partial chunk is zero-padded — pad positions
+            # are beyond every real query's causal horizon and each is
+            # overwritten by the decode step that reaches it.
+            start = pending.consumed
+            take = min(n_target - start, chunk)
+            piece = np.zeros((1, chunk), np.int32)
+            piece[0, :take] = request.prompt_ids[start:start + take]
+            _, pending.cache = self._prefill_chunk(
+                self.params, jnp.asarray(piece), pending.cache)
+            pending.cache = dict(
+                pending.cache,
+                index=jnp.asarray(start + take, jnp.int32))
+            pending.consumed = start + take
+        with self._metrics_lock:
+            self._prefill_chunks += 1
+        if pending.consumed < n_target:
+            return False
+        # All chunks in: adopt the private cache into the slot pool and
+        # join the next decode tick at length n-1 with the last REAL
+        # prompt token as input.
+        self._cache = self._insert(self._cache, pending.slot_id,
+                                   pending.cache, n_target)
+        self._activate(pending.slot_id, request,
+                       int(request.prompt_ids[-1]), n_target,
+                       remaining=request.max_new_tokens,
+                       key=self._jax.random.PRNGKey(request.seed))
+        return True
+
+    def _activate(self, slot_id: int, request: _Request, token: int,
+                  length: int, *, remaining: int, key) -> None:
+        """Flip a slot live in the device state (one jitted dispatch)."""
+        del length  # cache lengths are set by insert/admission paths
+        jnp = self._jnp
+        stop_row = [-1] * self.max_stop_ids
+        for i, sid in enumerate(sorted(request.stop_ids)):
+            stop_row[i] = sid
+        self._state = self._admit_state(
+            self._state, slot_id, token, remaining,
+            jnp.asarray(stop_row, jnp.int32), key,
+            request.temperature, request.top_k)
+
+    def _deactivate(self, slot_ids: List[int]) -> None:
+        """Host-forced slot shutdown (cancel): flip active off so the
+        next tick freezes the slot."""
+        active = self._state['active']
+        for i in slot_ids:
+            active = active.at[i].set(False)
+        self._state = dict(self._state, active=active)
+
+    # ------------------------------------------------- pipelined worker
+
+    def _run(self) -> None:
+        if not self.pipelined:
+            self._run_legacy()
+            return
+        import numpy as np  # pylint: disable=import-outside-toplevel
+        # One in-flight tick: (state_handles, finished_handle,
+        # [(slot_id, request), ...]) — read one tick behind.
+        inflight: Optional[Tuple[Any, Any, List[Tuple[int, Any]]]] = None
+        pending_prefills: Deque[_PendingPrefill] = collections.deque()
+        live: Dict[int, _Request] = {}   # slot -> decoding request
+        while not self._stop.is_set():
+            try:
+                self._expire_queued()
+                # Cancelled live requests: freeze their slots on device
+                # before the next dispatch, free them for admission.
+                cancelled = [i for i, r in live.items() if r.cancelled]
+                if cancelled:
+                    self._deactivate(cancelled)
+                    for i in cancelled:
+                        request = live.pop(i)
+                        self._slots[i].request = None
+                        request._finish()  # pylint: disable=protected-access
+                # Admissions: hand free slots to queued requests.  The
+                # prompt's chunks run interleaved with ticks below.
+                free = [i for i, s in enumerate(self._slots)
+                        if not s.active]
+                for slot_id in free:
+                    request = self._pop_request()
+                    if request is None:
+                        break
+                    pending = self._start_admission(slot_id, request)
+                    if pending is not None:
+                        pending_prefills.append(pending)
+                    elif self._slots[slot_id].request is not None:
+                        live[slot_id] = request
+                # At most ONE prefill chunk between ticks — the bound
+                # on the ITL stall an admission can impose.
+                if pending_prefills:
+                    pending = pending_prefills.popleft()
+                    done = self._advance_prefill(pending)
+                    if done:
+                        if self._slots[pending.slot_id].request is not None:
+                            live[pending.slot_id] = pending.request
+                    else:
+                        pending_prefills.append(pending)
+                # Dispatch tick t+1 BEFORE reading tick t: the host's
+                # token fetch and stream bookkeeping below overlap the
+                # device's compute of this new step.
+                dispatched = None
+                if live:
+                    self._state, self._cache, finished = self._step(
+                        self.params, self._state, self._cache)
+                    dispatched = (self._state, finished,
+                                  list(live.items()))
+                if inflight is not None:
+                    state_t, finished_t, snapshot = inflight
+                    toks = np.asarray(state_t['tokens'])
+                    fins = np.asarray(finished_t)
+                    pushed = 0
+                    for slot_id, request in snapshot:
+                        if request.done.is_set():
+                            # Finished in an earlier tick (device froze
+                            # the slot); this tick's value is a repeat.
+                            continue
+                        request._push(int(toks[slot_id]))  # pylint: disable=protected-access
+                        pushed += 1
+                        if fins[slot_id]:
+                            live.pop(slot_id, None)
+                            self._slots[slot_id].request = None
+                            request._finish()  # pylint: disable=protected-access
+                    if pushed:
+                        self._record_tokens(pushed)
+                    with self._metrics_lock:
+                        self._ticks += 1
+                inflight = dispatched
+                if (inflight is None and not live and
+                        not pending_prefills):
+                    with self._cond:
+                        if not self._queue and not self._stop.is_set():
+                            self._cond.wait(timeout=0.05)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.exception('batching engine tick failed')
+                # The jit'd step donates the slot cache — after a
+                # failure mid-step the cache buffers may be invalid, so
+                # the engine CANNOT safely continue: fail everything in
+                # flight, mark failed (submit() rejects from now on),
+                # and exit the worker.
+                self._fail_everything(e)
+                return
+
+    # --------------------------------------------------- legacy worker
+
+    def _admit_legacy(self, slot_id: int, request: _Request) -> None:
+        """Pre-pipeline admission: the WHOLE prompt prefills inline
+        (one long stall for every running request — what chunked
+        prefill bounds)."""
+        if request.cancelled:
             request._finish()  # pylint: disable=protected-access
             return
         jnp = self._jnp
@@ -273,18 +763,12 @@ class ContinuousBatchingEngine:
         prompt = request.prompt_ids
         n = len(prompt)
         if self.cfg.n_experts > 0 and n > 0:
-            # MoE: the capacity dispatch couples EVERY prompt token, so
-            # both pad tokens and an n-1/last-token split change which
-            # tokens drop — only a full-prompt unpadded prefill matches
-            # the single-sequence reference.  The first generated token
-            # therefore comes from the prefill logits (one compile per
-            # distinct MoE prompt length).
             logits, pre = self._prefill(
                 self.params, jnp.asarray([prompt], jnp.int32))
             self._cache = self._insert(self._cache, slot_id, pre, n)
             first = int(jnp.argmax(logits[0]))
             request._push(first)  # pylint: disable=protected-access
-            self._tokens_generated += 1
+            self._record_tokens(1)
             if (request.max_new_tokens <= 1 or
                     first in request.stop_ids):
                 request._finish()  # pylint: disable=protected-access
@@ -293,10 +777,6 @@ class ContinuousBatchingEngine:
             slot.next_token = first
             return
         if n > 1:
-            # Dense: prefill tokens [0, n-1) padded to a bucket (capped
-            # at max_len — the cache cannot hold more); pad keys land
-            # at positions >= n-1 where they are masked (and the first
-            # one is overwritten by the real last token's step).
             bucket = min(self._bucket(n - 1), self.max_len)
             padded = jnp.zeros((1, bucket), jnp.int32)
             padded = padded.at[0, :n - 1].set(
@@ -304,21 +784,19 @@ class ContinuousBatchingEngine:
             _, pre = self._prefill(self.params, padded)
             self._cache = self._insert(self._cache, slot_id, pre, n - 1)
         else:
-            # Single-token prompt: empty slot; stale keys are masked
-            # (lengths = 0) and position 0 is overwritten next step.
             self._cache = dict(
                 self._cache,
                 lengths=self._cache['lengths'].at[slot_id].set(0))
         slot.request = request
         slot.next_token = int(prompt[-1])
 
-    def _tick(self) -> None:
+    def _tick_legacy(self) -> None:
+        """Pre-pipeline tick: eager per-slot token staging, one host
+        sync per generated token, greedy only.  Kept as the A/B
+        baseline `bench_serve.py` measures the pipelined loop against
+        (and as a debugging fallback)."""
         jnp = self._jnp
         active = [i for i, s in enumerate(self._slots) if s.active]
-        if not active:
-            return
-        # Free slots whose client cancelled before spending a tick on
-        # them (the cancel flag is read once per tick).
         for i in active:
             req = self._slots[i].request
             if req.cancelled:
@@ -330,15 +808,17 @@ class ContinuousBatchingEngine:
         tokens = self._tokens
         for i in active:
             tokens = tokens.at[i, 0].set(self._slots[i].next_token)
-        logits, self._cache = self._step(self.params, tokens, self._cache)
+        logits, self._cache = self._legacy_step(self.params, tokens,
+                                                self._cache)
         import numpy as np  # pylint: disable=import-outside-toplevel
         nxt = np.asarray(jnp.argmax(logits, axis=-1))  # one host sync
+        pushed = 0
         for i in active:
             slot = self._slots[i]
             request = slot.request
             token = int(nxt[i])
             request._push(token)  # pylint: disable=protected-access
-            self._tokens_generated += 1
+            pushed += 1
             finished = (len(request.tokens) >= request.max_new_tokens or
                         token in request.stop_ids)
             if finished:
@@ -347,48 +827,53 @@ class ContinuousBatchingEngine:
             else:
                 slot.next_token = token
         self._tokens = tokens
+        self._record_tokens(pushed)
+        with self._metrics_lock:
+            self._ticks += 1
 
-    def _run(self) -> None:
+    def _run_legacy(self) -> None:
         while not self._stop.is_set():
             try:
-                # Fill free slots from the queue; block briefly when
-                # fully idle so shutdown stays responsive.
+                self._expire_queued()
                 idle = not any(s.active for s in self._slots)
                 free = [i for i, s in enumerate(self._slots)
                         if not s.active]
-                admitted = False
                 for slot_id in free:
+                    request = self._pop_request()
+                    if request is None:
+                        if idle:
+                            with self._cond:
+                                if (not self._queue and
+                                        not self._stop.is_set()):
+                                    self._cond.wait(timeout=0.05)
+                            request = self._pop_request()
+                        if request is None:
+                            break
                     try:
-                        request = self._queue.get(
-                            timeout=0.05 if idle and not admitted
-                            else 0.0)
-                    except queue.Empty:
-                        break
-                    try:
-                        self._admit(slot_id, request)
-                        admitted = True
+                        self._admit_legacy(slot_id, request)
+                        idle = False
                     except Exception as e:  # pylint: disable=broad-except
                         request._finish(e)  # pylint: disable=protected-access
-                self._tick()
+                self._tick_legacy()
             except Exception as e:  # pylint: disable=broad-except
                 logger.exception('batching engine tick failed')
-                # The jit'd step donates the slot cache — after a
-                # failure mid-step the cache buffers may be invalid, so
-                # the engine CANNOT safely continue: fail everything in
-                # flight, mark failed (submit() rejects from now on),
-                # and exit the worker.
-                self._failed = e
-                self._stop.set()
-                for slot in self._slots:
-                    if slot.request is not None:
-                        slot.request._finish(RuntimeError(  # pylint: disable=protected-access
-                            f'batching engine failed: {e}'))
-                        slot.request = None
-                while True:
-                    try:
-                        request = self._queue.get_nowait()
-                    except queue.Empty:
-                        break
-                    request._finish(RuntimeError(  # pylint: disable=protected-access
-                        f'batching engine failed: {e}'))
+                self._fail_everything(e)
                 return
+
+    # ------------------------------------------------------------ failure
+
+    def _fail_everything(self, e: Exception) -> None:
+        self._failed = e
+        self._stop.set()
+        for slot in self._slots:
+            if slot.request is not None:
+                slot.request._finish(RuntimeError(  # pylint: disable=protected-access
+                    f'batching engine failed: {e}'))
+                slot.request = None
+        while True:
+            with self._cond:
+                if not self._queue:
+                    break
+                request = self._queue.popleft()
+            request._finish(RuntimeError(  # pylint: disable=protected-access
+                f'batching engine failed: {e}'))

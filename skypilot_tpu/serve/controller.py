@@ -177,6 +177,12 @@ class SkyServeController:
     def reconcile_once(self) -> None:
         self.reload_version()
         self.replica_manager.sync()
+        # Decode-saturation signal from the replicas' last healthy
+        # probes (busy_slots/slots out of the model server's /health):
+        # with target_slot_utilization set, the autoscaler scales on
+        # decode pressure even when QPS reads as idle.
+        self.autoscaler.collect_replica_load(
+            self.replica_manager.ready_loads())
         decision = self.autoscaler.evaluate_scaling(time.time())
         replicas = self.replica_manager.active_replicas()
         current_version = [r for r in replicas

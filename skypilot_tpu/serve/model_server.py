@@ -11,8 +11,13 @@ also ships a native replica server wired to its own compute layer
 Endpoints:
   GET  /                 -> health + engine stats (readiness probe)
   POST /generate         -> {"prompt_ids": [[..]], "max_new_tokens": N,
-                             "temperature": T, "top_k": K}
+                             "temperature": T, "top_k": K, "seed": S}
                             => {"tokens": [[..]], "latency_ms": ..}
+                            (sampling params work under continuous
+                            batching too — selection runs on device in
+                            the engine tick, seeded per request; a full
+                            admission queue answers 429 + Retry-After,
+                            an expired queued request 503.)
   POST /generate_stream  -> SSE: data: {"token": N} per token, then
                             data: [DONE]  (continuous batching only)
   POST /generate_text    -> {"prompt": "...", "max_new_tokens": N}
@@ -41,6 +46,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import batching_engine as batching_engine_lib
 
 logger = sky_logging.init_logger(__name__)
 
@@ -52,7 +58,13 @@ class ModelServer:
                  seed: int = 0, quantize: Optional[str] = None,
                  continuous_batching: bool = False,
                  tensor: int = 1,
-                 tokenizer_path: Optional[str] = None) -> None:
+                 tokenizer_path: Optional[str] = None,
+                 max_queue: int = 0,
+                 queue_ttl: Optional[float] = None,
+                 prefill_chunk: int = 512,
+                 default_temperature: float = 0.0,
+                 default_top_k: int = 0,
+                 default_seed: int = 0) -> None:
         import jax
         import flax.linen as nn
 
@@ -107,7 +119,13 @@ class ModelServer:
         # carry NamedShardings over a tensor mesh; GSPMD partitions the
         # decode einsums and inserts the collectives — the decode code
         # is unchanged.
+        # Request-side sampling defaults (the CLI's --temperature /
+        # --top-k / --seed): applied when a request omits the field.
+        self.default_temperature = float(default_temperature)
+        self.default_top_k = int(default_top_k)
+        self.default_seed = int(default_seed)
         self._shardings = None
+        self._mesh = None
         if tensor > 1:
             from skypilot_tpu.parallel import MeshConfig, build_mesh
             from skypilot_tpu.parallel.sharding import LOGICAL_AXIS_RULES
@@ -125,6 +143,7 @@ class ModelServer:
                         f'for {model!r}; pick a smaller degree.')
             mesh = build_mesh(MeshConfig(tensor=tensor),
                               devices=jax.devices()[:tensor])
+            self._mesh = mesh
             abstract = jax.eval_shape(
                 lambda rng: model_mod.init(rng, init_tokens)['params'],
                 key)
@@ -172,12 +191,14 @@ class ModelServer:
         self._lock = threading.Lock()
         self._engine = None
         if continuous_batching:
-            # Requests join a running batch as slots free (greedy
-            # decoding; per-request temperature/top_k are rejected).
-            from skypilot_tpu.serve import batching_engine
-            self._engine = batching_engine.ContinuousBatchingEngine(
+            # Requests join a running batch as slots free; token
+            # selection (greedy or per-request temperature/top-k) runs
+            # on device inside the pipelined tick.
+            self._engine = batching_engine_lib.ContinuousBatchingEngine(
                 self.cfg, self.params, max_len=max_len,
-                slots=max_batch)
+                slots=max_batch, max_queue=max_queue,
+                queue_ttl=queue_ttl, prefill_chunk=prefill_chunk,
+                mesh=self._mesh)
 
     def close(self) -> None:
         """Release background resources (the batching engine's worker
@@ -188,9 +209,10 @@ class ModelServer:
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, top_k: int = 0,
-                 stop_token=None) -> Any:
+                 stop_token=None, seed: int = 0) -> Any:
         """stop_token: None, a single id, or an iterable of ids (the
         tokenizer's multi-EOS stop set)."""
+        import jax
         import jax.numpy as jnp
 
         from skypilot_tpu.models import decode
@@ -204,27 +226,26 @@ class ModelServer:
             raise ValueError(
                 f'prompt {prompt.shape[1]} + new {max_new_tokens} '
                 f'exceeds max_len {self.max_len}')
+        sampling = decode.SamplingConfig(temperature=temperature,
+                                         top_k=top_k, seed=seed)
         if self._engine is not None:
-            if temperature or top_k:
-                raise ValueError(
-                    'continuous batching serves greedy decoding; '
-                    'sampling params are not supported')
             # Each row is its own request: they decode TOGETHER with
             # whatever else is in flight (no lock — that is the point).
+            # Sampling runs ON DEVICE inside the engine tick, seeded
+            # per request.
             requests = [
                 self._engine.submit([int(t) for t in row],
                                     max_new_tokens,
-                                    stop_token=stop_token)
+                                    stop_token=stop_token,
+                                    sampling=sampling)
                 for row in prompt_ids
             ]
             return [r.result(timeout=600) for r in requests]
-        sampling = decode.SamplingConfig(temperature=temperature,
-                                         top_k=top_k)
         with self._lock:
             tokens, new = decode.generate(
                 self.cfg, self.params, prompt,
                 max_new_tokens=max_new_tokens, max_len=self.max_len,
-                sampling=sampling)
+                sampling=sampling, rng=jax.random.PRNGKey(seed))
         del tokens
         return new.tolist()
 
@@ -241,13 +262,39 @@ def _make_handler(server: ModelServer):
             length = int(self.headers.get('Content-Length', 0))
             return json.loads(self.rfile.read(length) or b'{}')
 
-        def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+        def _reply(self, code: int, payload: Dict[str, Any],
+                   headers: Optional[Dict[str, str]] = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header('Content-Type', 'application/json')
             self.send_header('Content-Length', str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
+
+        def _reply_backpressure(self, e: Exception) -> bool:
+            """Admission-control errors become honest HTTP status +
+            Retry-After instead of a generic 500: 429 when the queue is
+            full, 503 when the request expired waiting (the client
+            should hit another replica / back off, not time out)."""
+            if isinstance(e, batching_engine_lib.QueueFull):
+                self._reply(429, {'error': str(e)},
+                            {'Retry-After': str(int(e.retry_after))})
+                return True
+            if isinstance(e, batching_engine_lib.QueueExpired):
+                self._reply(503, {'error': str(e)},
+                            {'Retry-After': str(int(e.retry_after))})
+                return True
+            return False
+
+        def _sampling(self, req: Dict[str, Any]):
+            """(temperature, top_k, seed) — request fields, falling
+            back to the server's CLI defaults."""
+            return (float(req.get('temperature',
+                                  server.default_temperature)),
+                    int(req.get('top_k', server.default_top_k)),
+                    int(req.get('seed', server.default_seed)))
 
         def do_GET(self):
             payload = {'status': 'ok',
@@ -292,11 +339,11 @@ def _make_handler(server: ModelServer):
                 # The engine stops AT the tokenizer's EOS (freeing the
                 # slot); the lock-step scan is fixed-length, so the
                 # truncation below applies either way.
+                temperature, top_k, seed = self._sampling(req)
                 tokens = server.generate(
                     [ids], int(req.get('max_new_tokens', 64)),
-                    float(req.get('temperature', 0.0)),
-                    int(req.get('top_k', 0)),
-                    stop_token=tok.eos_ids or None)[0]
+                    temperature, top_k,
+                    stop_token=tok.eos_ids or None, seed=seed)[0]
                 stops = [i for i, t in enumerate(tokens)
                          if t in tok.eos_ids]
                 if stops:
@@ -311,20 +358,25 @@ def _make_handler(server: ModelServer):
                     json.JSONDecodeError) as e:
                 self._reply(400, {'error': str(e)})
             except Exception as e:  # pylint: disable=broad-except
-                self._reply(500, {'error': f'{type(e).__name__}: {e}'})
+                if not self._reply_backpressure(e):
+                    self._reply(500, {'error': f'{type(e).__name__}: {e}'})
 
         def _stream_text(self, tok, ids, req):
             """SSE text deltas: data: {"text": "..."} per decode step
             (skipping steps buffered inside a multi-byte sequence),
             then data: [DONE].  Needs --continuous-batching."""
+            from skypilot_tpu.models import decode
             from skypilot_tpu.models.tokenizer import StreamDecoder
             if server._engine is None:  # pylint: disable=protected-access
                 self._reply(400, {'error': 'streaming requires '
                                            '--continuous-batching'})
                 return
+            temperature, top_k, seed = self._sampling(req)
             request = server._engine.submit(  # pylint: disable=protected-access
                 ids, int(req.get('max_new_tokens', 64)),
-                stop_token=tok.eos_ids or None)
+                stop_token=tok.eos_ids or None,
+                sampling=decode.SamplingConfig(
+                    temperature=temperature, top_k=top_k, seed=seed))
             self._start_sse()
             decoder = StreamDecoder(tok)
             try:
@@ -369,18 +421,26 @@ def _make_handler(server: ModelServer):
                         'error': 'streaming requires '
                                  '--continuous-batching'})
                     return
+                from skypilot_tpu.models import decode
+                temperature, top_k, seed = self._sampling(req)
                 request = server._engine.submit(  # pylint: disable=protected-access
                     [int(t) for t in prompt],
                     int(req.get('max_new_tokens', 16)),
-                    stop_token=req.get('stop_token'))
+                    stop_token=req.get('stop_token'),
+                    sampling=decode.SamplingConfig(
+                        temperature=temperature, top_k=top_k,
+                        seed=seed))
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as e:
                 self._reply(400, {'error': str(e)})
                 return
             except Exception as e:  # pylint: disable=broad-except
-                # Stopped/failed engine: an HTTP error, not a dropped
-                # connection.
-                self._reply(503, {'error': f'{type(e).__name__}: {e}'})
+                # Stopped/failed engine (503) or a full admission
+                # queue (429 + Retry-After): an HTTP error, not a
+                # dropped connection.
+                if not self._reply_backpressure(e):
+                    self._reply(503,
+                                {'error': f'{type(e).__name__}: {e}'})
                 return
             self._start_sse()
             try:
@@ -430,11 +490,11 @@ def _make_handler(server: ModelServer):
             try:
                 req = self._read_json()
                 t0 = time.perf_counter()
+                temperature, top_k, seed = self._sampling(req)
                 tokens = server.generate(
                     req['prompt_ids'],
                     int(req.get('max_new_tokens', 16)),
-                    float(req.get('temperature', 0.0)),
-                    int(req.get('top_k', 0)))
+                    temperature, top_k, seed=seed)
                 self._reply(200, {
                     'tokens': tokens,
                     'latency_ms': round(
@@ -445,9 +505,11 @@ def _make_handler(server: ModelServer):
                 self._reply(400, {'error': str(e)})
             except Exception as e:  # pylint: disable=broad-except
                 # Engine failures (stopped engine, tick error, result
-                # timeout) must reach the client as an HTTP error, not
-                # a dropped connection.
-                self._reply(500, {'error': f'{type(e).__name__}: {e}'})
+                # timeout) must reach the client as an HTTP error —
+                # and admission-control pushback as 429/503 with
+                # Retry-After — not a dropped connection.
+                if not self._reply_backpressure(e):
+                    self._reply(500, {'error': f'{type(e).__name__}: {e}'})
 
     return Handler
 
@@ -492,8 +554,30 @@ def main() -> None:
                              'traffic per decoded token vs bf16.')
     parser.add_argument('--continuous-batching', action='store_true',
                         help='Slot-pool scheduling: requests join a '
-                             'running batch as slots free (greedy '
-                             'decoding; max_batch = slot count).')
+                             'running batch as slots free '
+                             '(max_batch = slot count); pipelined '
+                             'decode ticks with on-device sampling.')
+    parser.add_argument('--max-queue', type=int, default=0,
+                        help='Bound the admission queue: submits '
+                             'beyond this many waiting requests get '
+                             'HTTP 429 + Retry-After (0 = unbounded).')
+    parser.add_argument('--queue-ttl', type=float, default=None,
+                        help='Seconds a request may wait queued before '
+                             'it expires with HTTP 503 + Retry-After.')
+    parser.add_argument('--prefill-chunk', type=int, default=512,
+                        help='Chunked prefill width: long prompts '
+                             'prefill in chunks interleaved with '
+                             'decode ticks, bounding the ITL stall an '
+                             'admission imposes on running requests.')
+    parser.add_argument('--temperature', type=float, default=0.0,
+                        help='Default sampling temperature for '
+                             'requests that omit it (0 = greedy).')
+    parser.add_argument('--top-k', type=int, default=0,
+                        help='Default top-k filter for requests that '
+                             'omit it (0 = off).')
+    parser.add_argument('--seed', type=int, default=0,
+                        help='Default sampling seed for requests that '
+                             'omit it.')
     parser.add_argument('--tensor', type=int, default=1,
                         help='Tensor-shard the model over N local '
                              'devices (models too big for one chip); '
@@ -510,7 +594,13 @@ def main() -> None:
                          quantize=args.quantize,
                          continuous_batching=args.continuous_batching,
                          tensor=args.tensor,
-                         tokenizer_path=args.tokenizer)
+                         tokenizer_path=args.tokenizer,
+                         max_queue=args.max_queue,
+                         queue_ttl=args.queue_ttl,
+                         prefill_chunk=args.prefill_chunk,
+                         default_temperature=args.temperature,
+                         default_top_k=args.top_k,
+                         default_seed=args.seed)
     if args.http_server == 'async':
         from skypilot_tpu.serve import async_server  # pylint: disable=import-outside-toplevel
         async_server.serve_forever(server, args.port)

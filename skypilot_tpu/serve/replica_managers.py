@@ -50,6 +50,9 @@ class ReplicaManager:
         self.version = version
         self._launch_threads: Dict[int, threading.Thread] = {}
         self._first_probe_at: Dict[int, float] = {}
+        # replica_id -> busy_slots/slots from the last healthy probe
+        # (decode-saturation autoscaling signal).
+        self._last_load: Dict[int, float] = {}
         self._lock = threading.Lock()
 
     def set_version(self, spec: 'SkyServiceSpec', task: 'task_lib.Task',
@@ -149,6 +152,20 @@ class ReplicaManager:
             resp = requests.get(url + self.spec.readiness_path,
                                 timeout=self.spec.readiness_timeout_seconds)
             ready = resp.status_code == 200
+            # Decode-saturation signal: the native model server's
+            # health payload carries engine stats; remember
+            # busy_slots/slots per replica so the controller can feed
+            # the autoscaler a load signal (user containers without
+            # engine stats just never report).
+            if ready:
+                try:
+                    engine = resp.json().get('engine') or {}
+                    slots = engine.get('slots')
+                    if slots:
+                        self._last_load[replica_id] = (
+                            engine.get('busy_slots', 0) / slots)
+                except (ValueError, TypeError, ZeroDivisionError):
+                    pass
         except requests.RequestException:
             ready = False
         status = ReplicaStatus(replica['status'])
@@ -157,6 +174,7 @@ class ReplicaManager:
                 serve_state.set_replica_status(
                     self.service_name, replica_id, ReplicaStatus.READY)
             return
+        self._last_load.pop(replica_id, None)
         if status is ReplicaStatus.READY:
             serve_state.set_replica_status(self.service_name, replica_id,
                                            ReplicaStatus.NOT_READY)
@@ -219,6 +237,16 @@ class ReplicaManager:
         return [r['url'] for r in serve_state.get_replicas(
             self.service_name)
                 if r['status'] == ReplicaStatus.READY.value and r['url']]
+
+    def ready_loads(self) -> List[float]:
+        """Per-replica decode load (busy_slots/slots) from the latest
+        healthy probes — the autoscaler's decode-saturation input.
+        Only replicas whose health payload reports engine stats appear."""
+        ready_ids = {r['replica_id'] for r in serve_state.get_replicas(
+            self.service_name)
+            if r['status'] == ReplicaStatus.READY.value}
+        return [load for rid, load in self._last_load.items()
+                if rid in ready_ids]
 
     def terminate_all(self) -> None:
         for replica in serve_state.get_replicas(self.service_name):

@@ -23,6 +23,7 @@ class SkyServiceSpec:
                  min_replicas: int = 1,
                  max_replicas: Optional[int] = None,
                  target_qps_per_replica: Optional[float] = None,
+                 target_slot_utilization: Optional[float] = None,
                  upscale_delay_seconds: int = 300,
                  downscale_delay_seconds: int = 1200,
                  replica_port: int = 8080,
@@ -38,6 +39,10 @@ class SkyServiceSpec:
         if target_qps_per_replica is not None and target_qps_per_replica <= 0:
             raise exceptions.InvalidTaskError(
                 'target_qps_per_replica must be positive')
+        if (target_slot_utilization is not None and
+                not 0.0 < target_slot_utilization <= 1.0):
+            raise exceptions.InvalidTaskError(
+                'target_slot_utilization must be in (0, 1]')
         self.readiness_path = readiness_path
         self.initial_delay_seconds = initial_delay_seconds
         self.readiness_timeout_seconds = readiness_timeout_seconds
@@ -45,6 +50,11 @@ class SkyServiceSpec:
         self.max_replicas = max_replicas if max_replicas is not None \
             else min_replicas
         self.target_qps_per_replica = target_qps_per_replica
+        # Decode-saturation autoscaling: mean busy_slots/slots across
+        # ready replicas (from the model server's /health engine stats)
+        # above this fraction scales out — a replica can be decode-
+        # bound at modest QPS when generations are long.
+        self.target_slot_utilization = target_slot_utilization
         self.upscale_delay_seconds = upscale_delay_seconds
         self.downscale_delay_seconds = downscale_delay_seconds
         self.replica_port = replica_port
@@ -65,7 +75,8 @@ class SkyServiceSpec:
 
     @property
     def autoscaling_enabled(self) -> bool:
-        return self.target_qps_per_replica is not None
+        return (self.target_qps_per_replica is not None or
+                self.target_slot_utilization is not None)
 
     # --------------------------------------------------------------- yaml
 
@@ -96,7 +107,9 @@ class SkyServiceSpec:
         if policy is not None:
             common_utils.validate_schema_keys(
                 policy, {'min_replicas', 'max_replicas',
-                         'target_qps_per_replica', 'upscale_delay_seconds',
+                         'target_qps_per_replica',
+                         'target_slot_utilization',
+                         'upscale_delay_seconds',
                          'downscale_delay_seconds',
                          'base_ondemand_fallback_replicas'},
                 'replica_policy')
@@ -108,6 +121,9 @@ class SkyServiceSpec:
             if 'target_qps_per_replica' in policy:
                 kwargs['target_qps_per_replica'] = float(
                     policy['target_qps_per_replica'])
+            if 'target_slot_utilization' in policy:
+                kwargs['target_slot_utilization'] = float(
+                    policy['target_slot_utilization'])
         elif config.get('replicas') is not None:
             # Fixed-size service shorthand (parity: reference
             # service_spec 'replicas' field).
@@ -140,6 +156,13 @@ class SkyServiceSpec:
             policy['target_qps_per_replica'] = self.target_qps_per_replica
             policy['upscale_delay_seconds'] = self.upscale_delay_seconds
             policy['downscale_delay_seconds'] = self.downscale_delay_seconds
+        if self.target_slot_utilization is not None:
+            policy['target_slot_utilization'] = (
+                self.target_slot_utilization)
+            policy.setdefault('upscale_delay_seconds',
+                              self.upscale_delay_seconds)
+            policy.setdefault('downscale_delay_seconds',
+                              self.downscale_delay_seconds)
         if self.base_ondemand_fallback_replicas:
             policy['base_ondemand_fallback_replicas'] = (
                 self.base_ondemand_fallback_replicas)

@@ -111,6 +111,11 @@ def get_service_schema() -> Dict[str, Any]:
                     'min_replicas': {'type': 'integer', 'minimum': 0},
                     'max_replicas': {'type': 'integer', 'minimum': 0},
                     'target_qps_per_replica': {'type': 'number'},
+                    'target_slot_utilization': {
+                        'type': 'number',
+                        'exclusiveMinimum': 0,
+                        'maximum': 1,
+                    },
                     'upscale_delay_seconds': {'type': 'number'},
                     'downscale_delay_seconds': {'type': 'number'},
                     'base_ondemand_fallback_replicas': {'type': 'integer'},

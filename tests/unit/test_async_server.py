@@ -178,3 +178,54 @@ def test_lockstep_server_without_engine():
     finally:
         shutdown()
         srv.close()
+
+
+def test_sampling_through_async_front(cb_server):
+    """temperature/top_k/seed ride the JSON API into the engine's
+    on-device sampler; same seed -> same stream."""
+    _, port = cb_server
+
+    def call():
+        resp = requests.post(
+            f'http://127.0.0.1:{port}/generate',
+            json={'prompt_ids': [[2, 4, 6]], 'max_new_tokens': 5,
+                  'temperature': 0.8, 'top_k': 8, 'seed': 17},
+            timeout=120)
+        resp.raise_for_status()
+        return resp.json()['tokens'][0]
+
+    first = call()
+    assert len(first) == 5
+    assert call() == first
+
+
+def test_async_429_and_retry_after_on_full_queue():
+    srv = model_server.ModelServer('tiny', max_len=64, max_batch=1,
+                                   continuous_batching=True,
+                                   max_queue=1)
+    port, shutdown = async_server.start_background(srv)
+    try:
+        engine = srv._engine  # pylint: disable=protected-access
+        blocker = engine.submit([1, 2, 3], 50)
+        deadline = time.time() + 30
+        while (engine.stats()['busy_slots'] == 0 and
+               time.time() < deadline):
+            time.sleep(0.01)
+        queued = engine.submit([4, 5], 4)
+        resp = requests.post(
+            f'http://127.0.0.1:{port}/generate',
+            json={'prompt_ids': [[6, 7]], 'max_new_tokens': 2},
+            timeout=60)
+        assert resp.status_code == 429, resp.text
+        assert int(resp.headers['Retry-After']) >= 1
+        # Streaming submits get the same pushback.
+        resp = requests.post(
+            f'http://127.0.0.1:{port}/generate_stream',
+            json={'prompt_ids': [6, 7], 'max_new_tokens': 2},
+            timeout=60)
+        assert resp.status_code == 429
+        blocker.cancel()
+        queued.result(timeout=120)
+    finally:
+        shutdown()
+        srv.close()

@@ -197,13 +197,25 @@ def test_stats(setup):
         cfg, params, max_len=32, slots=2)
     try:
         stats = eng.stats()
-        assert stats == {'slots': 2, 'busy_slots': 0,
-                         'queued_requests': 0, 'tokens_generated': 0,
-                         'failed': False}
+        # The autoscaling contract: these keys feed /health.
+        assert stats['slots'] == 2
+        assert stats['busy_slots'] == 0
+        assert stats['queued_requests'] == 0
+        assert stats['tokens_generated'] == 0
+        assert stats['failed'] is False
+        assert stats['ticks'] == 0
+        assert stats['prefill_chunks'] == 0
+        assert stats['decode_tokens_per_s'] == 0
+        assert sum(stats['queue_wait_hist'].values()) == 0
         eng.generate([1, 2, 3], 4, timeout=120)
         stats = eng.stats()
         assert stats['tokens_generated'] == 4
         assert stats['busy_slots'] == 0
+        assert stats['ticks'] > 0
+        assert stats['prefill_chunks'] >= 1
+        assert stats['decode_tokens_per_s'] > 0
+        # Exactly one admission went through the queue-wait histogram.
+        assert sum(stats['queue_wait_hist'].values()) == 1
     finally:
         eng.stop()
 
@@ -232,6 +244,213 @@ def test_failed_engine_fails_health_probe(setup, monkeypatch):
     finally:
         shutdown()
         server.close()
+
+
+class TestChunkedPrefill:
+
+    def test_chunked_prefill_exact(self, setup):
+        """A long prompt prefilled in 4-token chunks must decode
+        token-exact vs decode.generate (the n-1/last-token trick holds
+        per chunk; the padded final chunk's garbage positions are
+        masked then overwritten)."""
+        cfg, params = setup
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=64, slots=2, prefill_chunk=4)
+        try:
+            for prompt in (list(range(1, 21)),   # 19 = 4*4 + 3 partial
+                           list(range(5, 22)),   # 16 = exact chunks
+                           [7, 9],               # below one chunk
+                           [3]):                 # no prefill at all
+                got = eng.generate(prompt, 5, timeout=180)
+                assert got == _reference(cfg, params, prompt, 5), prompt
+            assert eng.stats()['prefill_chunks'] > 4
+        finally:
+            eng.stop()
+
+    def test_chunked_admission_does_not_corrupt_running(self, setup):
+        """A long admission interleaves with a running decode; the
+        running request's tokens must stay exact end to end."""
+        cfg, params = setup
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=64, slots=2, prefill_chunk=4)
+        try:
+            running = eng.submit([2, 7, 1, 8], 12)
+            long_prompt = list(range(1, 25))
+            late = eng.submit(long_prompt, 4)
+            assert running.result(timeout=180) == _reference(
+                cfg, params, [2, 7, 1, 8], 12)
+            assert late.result(timeout=180) == _reference(
+                cfg, params, long_prompt, 4)
+        finally:
+            eng.stop()
+
+    def test_cancel_mid_prefill_frees_slot(self, setup):
+        """Cancelling a request whose prompt is still chunking must
+        abandon the remaining chunks and free the slot."""
+        cfg, params = setup
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=64, slots=1, prefill_chunk=2)
+        try:
+            blocker = eng.submit(list(range(1, 31)), 8)
+            victim = eng.submit(list(range(1, 25)), 8)
+            victim.cancel()
+            assert blocker.result(timeout=180) == _reference(
+                cfg, params, list(range(1, 31)), 8)
+            assert victim.done.wait(60)
+            assert victim.error is None
+            # Slot is reusable afterwards.
+            assert eng.generate([4, 5], 3, timeout=120) == _reference(
+                cfg, params, [4, 5], 3)
+        finally:
+            eng.stop()
+
+
+class TestSampling:
+
+    def test_sampled_deterministic_per_seed(self, setup):
+        cfg, params = setup
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=64, slots=2)
+        try:
+            sampling = decode.SamplingConfig(temperature=0.8, top_k=10,
+                                             seed=123)
+            a = eng.generate([3, 1, 4], 6, sampling=sampling,
+                             timeout=120)
+            b = eng.generate([3, 1, 4], 6, sampling=sampling,
+                             timeout=120)
+            assert a == b
+            c = eng.generate(
+                [3, 1, 4], 6, timeout=120,
+                sampling=decode.SamplingConfig(temperature=0.8,
+                                               top_k=10, seed=7))
+            assert len(c) == 6  # a different seed may (and does) differ
+        finally:
+            eng.stop()
+
+    def test_sampled_independent_of_other_traffic(self, setup):
+        """A request's sample stream depends only on its seed (the
+        slot's key chain splits once per generated token), so the same
+        seeded request returns the same tokens with or without
+        neighbours decoding."""
+        cfg, params = setup
+        sampling = decode.SamplingConfig(temperature=0.9, seed=42)
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=64, slots=2)
+        try:
+            alone = eng.generate([5, 3, 2], 6, sampling=sampling,
+                                 timeout=120)
+            noisy = eng.submit([9, 9, 1, 2, 3], 10)
+            crowded = eng.generate([5, 3, 2], 6, sampling=sampling,
+                                   timeout=120)
+            noisy.result(timeout=120)
+            assert alone == crowded
+        finally:
+            eng.stop()
+
+    def test_greedy_sampling_config_matches_default(self, setup):
+        """temperature=0 through the sampling path is exactly the
+        greedy default — the existing parity pin is not weakened by
+        threading SamplingConfig through submit()."""
+        cfg, params = setup
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=64, slots=2)
+        try:
+            prompt = [3, 1, 4, 1, 5]
+            explicit = eng.generate(
+                prompt, 5, timeout=120,
+                sampling=decode.SamplingConfig(temperature=0.0, seed=9))
+            assert explicit == _reference(cfg, params, prompt, 5)
+        finally:
+            eng.stop()
+
+    def test_sampling_validation(self, setup):
+        cfg, params = setup
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=32, slots=1, max_top_k=8,
+            max_stop_ids=2)
+        try:
+            with pytest.raises(ValueError, match='max_top_k'):
+                eng.submit([1, 2], 2, sampling=decode.SamplingConfig(
+                    temperature=0.5, top_k=9))
+            with pytest.raises(ValueError, match='max_stop_ids'):
+                eng.submit([1, 2], 2, stop_token=[1, 2, 3])
+        finally:
+            eng.stop()
+
+    def test_legacy_mode_rejects_sampling(self, setup):
+        cfg, params = setup
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=32, slots=1, pipelined=False)
+        try:
+            with pytest.raises(ValueError, match='greedy'):
+                eng.submit([1, 2], 2, sampling=decode.SamplingConfig(
+                    temperature=0.5))
+        finally:
+            eng.stop()
+
+
+class TestBoundedAdmission:
+
+    def test_queue_full_raises_429_class(self, setup):
+        cfg, params = setup
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=64, slots=1, max_queue=2)
+        try:
+            blocker = eng.submit([1, 2, 3], 50)
+            # Give the worker a moment to move the blocker to a slot.
+            import time as _time
+            deadline = _time.time() + 30
+            while (eng.stats()['busy_slots'] == 0 and
+                   _time.time() < deadline):
+                _time.sleep(0.01)
+            queued = [eng.submit([4, 5], 4) for _ in range(2)]
+            with pytest.raises(batching_engine.QueueFull) as err:
+                eng.submit([6, 7], 4)
+            assert err.value.retry_after >= 1.0
+            blocker.cancel()
+            for request in queued:
+                request.result(timeout=120)
+        finally:
+            eng.stop()
+
+    def test_queue_ttl_expires_waiting_requests(self, setup):
+        cfg, params = setup
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=64, slots=1, queue_ttl=0.05)
+        try:
+            blocker = eng.submit([1, 2, 3], 60)
+            stale = eng.submit([4, 5], 4)
+            with pytest.raises(batching_engine.QueueExpired):
+                stale.result(timeout=60)
+            blocker.cancel()
+        finally:
+            eng.stop()
+
+    def test_unbounded_queue_by_default(self, setup):
+        cfg, params = setup
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=32, slots=1)
+        try:
+            requests = [eng.submit([1, 2], 2) for _ in range(20)]
+            for request in requests:
+                assert len(request.result(timeout=240)) == 2
+        finally:
+            eng.stop()
+
+
+def test_legacy_mode_parity(setup):
+    """pipelined=False keeps the pre-change loop (bench baseline):
+    still token-exact vs decode.generate."""
+    cfg, params = setup
+    eng = batching_engine.ContinuousBatchingEngine(
+        cfg, params, max_len=64, slots=2, pipelined=False)
+    try:
+        prompt = [3, 1, 4, 1, 5, 9]
+        assert eng.generate(prompt, 5, timeout=120) == _reference(
+            cfg, params, prompt, 5)
+        assert eng.stats()['pipelined'] is False
+    finally:
+        eng.stop()
 
 
 def test_request_finish_is_idempotent():

@@ -1,0 +1,49 @@
+"""Serving perf smoke: `bench_serve.py --smoke` runs on every PR
+(tier-1, NOT slow-marked — this is the guardrail that keeps the decode
+hot loop fast), writing BENCH_serve_smoke.json at the repo root so the
+serving perf trajectory has a point per change."""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_bench_serve_smoke():
+    out_path = os.path.join(_REPO_ROOT, 'BENCH_serve_smoke.json')
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    # The remote-compile PJRT plugin must not route this CPU smoke
+    # through a TPU tunnel (same scrub as conftest's re-exec).
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO_ROOT, 'bench_serve.py'),
+         '--smoke', '--out', out_path],
+        cwd=_REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=480, check=False)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(out_path, encoding='utf-8') as f:
+        data = json.load(f)
+    # Schema the BENCH trajectory depends on.
+    assert data['metric'] == 'serve_decode_tokens_per_sec'
+    assert data['unit'] == 'tokens/s'
+    assert data['value'] > 0
+    for mode in ('pipelined', 'legacy'):
+        stats = data[mode]
+        assert stats['tokens'] > 0
+        for key in ('tokens_per_s', 'ttft_p50_ms', 'ttft_p99_ms',
+                    'itl_p50_ms', 'itl_p99_ms'):
+            assert stats[key] >= 0, (mode, key, stats)
+    # The pipelined loop must not regress below the pre-change engine
+    # on the saturating smoke workload (the PR's perf claim is >= 1.5x;
+    # the smoke asserts a conservative floor so CI noise can't flake).
+    assert data['speedup_vs_legacy'] >= 1.2, data
+    stall = data['chunked_prefill_stall']
+    assert stall['max_itl_during_admission_ms'] > 0
+    assert stall['chunk_compute_ms'] > 0
+    # Chunked admission must stall running decodes by at most ~one
+    # chunk's compute (the bound includes scheduling slack).
+    assert stall['stall_bounded_by_chunk'], stall

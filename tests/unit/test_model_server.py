@@ -214,17 +214,104 @@ def test_continuous_batching_server_parity():
             with concurrent.futures.ThreadPoolExecutor(3) as pool:
                 got = list(pool.map(call, prompts))
             assert got == expected
-            # Sampling params are rejected under CB.
-            r = requests.post(
-                f'http://127.0.0.1:{port}/generate',
-                json={'prompt_ids': [[1, 2]], 'max_new_tokens': 2,
-                      'temperature': 0.7}, timeout=60)
-            assert r.status_code == 400
+            # Sampling params now work under CB (on-device selection
+            # in the engine tick), deterministic per seed.
+            def sampled():
+                r = requests.post(
+                    f'http://127.0.0.1:{port}/generate',
+                    json={'prompt_ids': [[1, 2]], 'max_new_tokens': 4,
+                          'temperature': 0.7, 'top_k': 5, 'seed': 3},
+                    timeout=120)
+                r.raise_for_status()
+                return r.json()['tokens'][0]
+            first = sampled()
+            assert len(first) == 4
+            assert sampled() == first
         finally:
             shutdown()
     finally:
         cb_server.close()
         cb_server.close()  # idempotent
+
+
+def test_queue_full_replies_429_with_retry_after():
+    """A bounded engine queue turns load-spike submits into fast 429s
+    with a Retry-After hint instead of unbounded TTFT."""
+    server = model_server.ModelServer('tiny', max_len=64, max_batch=1,
+                                      continuous_batching=True,
+                                      max_queue=1)
+    port, shutdown = model_server.start_background(server)
+    try:
+        import time as _time
+        engine = server._engine  # pylint: disable=protected-access
+        blocker = engine.submit([1, 2, 3], 50)
+        deadline = _time.time() + 30
+        while (engine.stats()['busy_slots'] == 0 and
+               _time.time() < deadline):
+            _time.sleep(0.01)
+        queued = engine.submit([4, 5], 4)     # fills max_queue=1
+        resp = requests.post(
+            f'http://127.0.0.1:{port}/generate',
+            json={'prompt_ids': [[6, 7]], 'max_new_tokens': 2},
+            timeout=60)
+        assert resp.status_code == 429, resp.text
+        assert int(resp.headers['Retry-After']) >= 1
+        blocker.cancel()
+        queued.result(timeout=120)
+    finally:
+        shutdown()
+        server.close()
+
+
+def test_queue_ttl_replies_503_with_retry_after():
+    server = model_server.ModelServer('tiny', max_len=64, max_batch=1,
+                                      continuous_batching=True,
+                                      queue_ttl=0.05)
+    port, shutdown = model_server.start_background(server)
+    try:
+        engine = server._engine  # pylint: disable=protected-access
+        blocker = engine.submit([1, 2, 3], 60)
+        resp = requests.post(
+            f'http://127.0.0.1:{port}/generate',
+            json={'prompt_ids': [[6, 7]], 'max_new_tokens': 2},
+            timeout=60)
+        assert resp.status_code == 503, resp.text
+        assert int(resp.headers['Retry-After']) >= 1
+        blocker.cancel()
+    finally:
+        shutdown()
+        server.close()
+
+
+def test_cli_default_sampling_applied():
+    """--temperature/--top-k/--seed server defaults apply when the
+    request omits sampling fields (and a request override wins)."""
+    server = model_server.ModelServer('tiny', max_len=64, max_batch=2,
+                                      continuous_batching=True,
+                                      default_temperature=0.9,
+                                      default_top_k=4,
+                                      default_seed=21)
+    port, shutdown = model_server.start_background(server)
+    try:
+        def call(payload):
+            r = requests.post(f'http://127.0.0.1:{port}/generate',
+                              json=payload, timeout=120)
+            r.raise_for_status()
+            return r.json()['tokens'][0]
+        base = {'prompt_ids': [[5, 6, 7]], 'max_new_tokens': 4}
+        # Defaults are deterministic per the server-level seed.
+        assert call(dict(base)) == call(dict(base))
+        # Explicit greedy override beats the sampled default.
+        greedy = call(dict(base, temperature=0.0))
+        from skypilot_tpu.models import decode as decode_lib
+        _, expected = decode_lib.generate(
+            server.cfg, server.params,
+            jnp.asarray([[5, 6, 7]], jnp.int32),
+            max_new_tokens=4, max_len=server.max_len)
+        assert greedy == [int(t) for t in np.asarray(expected)[0]]
+    finally:
+        shutdown()
+        server.close()
 
 
 def test_streaming_generation_sse():

@@ -133,6 +133,75 @@ class TestAutoscaler:
         assert decision.num_ondemand == 1
 
 
+class TestDecodeSaturationAutoscaler:
+    """Scaling on busy_slots/slots from the replicas' /health engine
+    stats — a replica can be decode-bound (every KV slot pinned by long
+    generations) at a QPS the request-rate signal reads as idle."""
+
+    def _scaler(self, **kw):
+        kw.setdefault('min_replicas', 1)
+        kw.setdefault('max_replicas', 5)
+        kw.setdefault('target_slot_utilization', 0.5)
+        kw.setdefault('target_qps_per_replica', None)
+        kw.setdefault('upscale_delay_seconds', 10)
+        kw.setdefault('downscale_delay_seconds', 20)
+        return autoscalers.RequestRateAutoscaler(_spec(**kw))
+
+    def test_scales_on_slot_utilization_without_qps(self):
+        scaler = self._scaler()
+        now = 1000.0
+        # 2 ready replicas fully decode-saturated at target 0.5 ->
+        # desired ceil(2 * 1.0 / 0.5) = 4, after the upscale delay.
+        scaler.collect_replica_load([1.0, 1.0])
+        assert scaler.evaluate_scaling(now).target_num_replicas == 1
+        scaler.collect_replica_load([1.0, 1.0])
+        assert scaler.evaluate_scaling(
+            now + 11).target_num_replicas == 4
+
+    def test_idle_slots_downscale(self):
+        scaler = self._scaler()
+        scaler.target_num_replicas = 4
+        now = 1000.0
+        scaler.collect_replica_load([0.1, 0.1, 0.0, 0.1])
+        assert scaler.evaluate_scaling(now).target_num_replicas == 4
+        scaler.collect_replica_load([0.1, 0.1, 0.0, 0.1])
+        assert scaler.evaluate_scaling(
+            now + 21).target_num_replicas == 1
+
+    def test_max_of_qps_and_load_signals(self):
+        scaler = self._scaler(target_qps_per_replica=1.0)
+        now = 1000.0
+        # QPS asks for 3 replicas; saturation asks for 2 -> QPS wins.
+        scaler.request_timestamps = [
+            now - i / 3
+            for i in range(int(3 * autoscalers.QPS_WINDOW_SIZE_SECONDS))]
+        scaler.collect_replica_load([1.0])
+        scaler.evaluate_scaling(now)
+        scaler.request_timestamps = [
+            now + 11 - i / 3
+            for i in range(int(3 * autoscalers.QPS_WINDOW_SIZE_SECONDS))]
+        scaler.collect_replica_load([1.0])
+        assert scaler.evaluate_scaling(
+            now + 11).target_num_replicas == 3
+
+    def test_no_load_signal_is_qps_only(self):
+        scaler = self._scaler(target_qps_per_replica=1.0)
+        now = 1000.0
+        assert scaler.evaluate_scaling(now).target_num_replicas == 1
+
+    def test_spec_yaml_and_validation(self):
+        spec = SkyServiceSpec.from_yaml_config({
+            'replica_policy': {'min_replicas': 1, 'max_replicas': 3,
+                               'target_slot_utilization': 0.6}})
+        assert spec.target_slot_utilization == 0.6
+        assert spec.autoscaling_enabled
+        round_trip = SkyServiceSpec.from_yaml_config(
+            spec.to_yaml_config())
+        assert round_trip.target_slot_utilization == 0.6
+        with pytest.raises(Exception):
+            _spec(target_slot_utilization=1.5)
+
+
 class TestRoundRobin:
 
     def test_cycles(self):
