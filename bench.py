@@ -104,11 +104,9 @@ def _run_config(cfg, batch: int, seq: int, n_steps: int, tcfg=None):
     # second trace through jit would double the TPU compile time).
     first = next(prefetch_to_device(host_batches(1)))
     compiled = jitted.lower(state, first).compile()
-    try:
-        stats = compiled.memory_analysis()
-        peak_bytes = int(stats.temp_size_in_bytes)
-    except Exception:  # pylint: disable=broad-except
-        peak_bytes = None
+    from skypilot_tpu.models.train import compiled_peak_memory
+    # Also feeds the skytpu_train_peak_memory_bytes gauge.
+    peak_bytes = compiled_peak_memory(compiled)
 
     prefetched = prefetch_to_device(host_batches(warmup + n_steps))
     for _ in range(warmup):

@@ -14,6 +14,11 @@ reports the numbers a serving SLO is written in:
   LONG prompt and measure the worst ITL the running requests suffer;
   with chunked prefill that stall is bounded by ONE chunk's compute
   (reported alongside the unchunked stall for contrast).
+- --smoke also scrapes `/metrics` (observability/metrics.py exposition
+  served on a loopback port) before, during, and after the pipelined
+  run, asserts the key engine series are present and monotone (ticks,
+  decode tokens), and writes the samples into the JSON — the perf
+  trajectory carries an observability signal per change.
 
 Prints ONE JSON line and writes it to --out (BENCH_serve.json;
 --smoke uses a seconds-scale config and BENCH_serve_smoke.json — the
@@ -115,6 +120,32 @@ def _run_load(engine, workload) -> Dict[str, Any]:
         'ttft_p99_ms': round(_percentile(ttfts, 99) * 1e3, 2),
         'itl_p50_ms': round(_percentile(itls, 50) * 1e3, 2),
         'itl_p99_ms': round(_percentile(itls, 99) * 1e3, 2),
+    }
+
+
+def _scrape_metrics(port: int) -> Dict[str, Any]:
+    """One /metrics scrape over real HTTP -> the counter values the
+    smoke asserts on (summed across label sets)."""
+    import urllib.request
+
+    from skypilot_tpu.observability import metrics as metrics_lib
+    with urllib.request.urlopen(
+            f'http://127.0.0.1:{port}/metrics', timeout=10) as resp:
+        text = resp.read().decode()
+    parsed = metrics_lib.parse_exposition(text)
+
+    def total(name: str) -> float:
+        return sum((parsed.get(name) or {}).values())
+
+    return {
+        'ticks': total('skytpu_engine_ticks_total'),
+        'decode_tokens': total('skytpu_engine_decode_tokens_total'),
+        'queue_wait_count':
+            total('skytpu_engine_queue_wait_seconds_count'),
+        'itl_count': total('skytpu_engine_itl_seconds_count'),
+        'histograms_present': all(
+            f'skytpu_engine_{h}_seconds_bucket' in parsed
+            for h in ('queue_wait', 'itl', 'ttft')),
     }
 
 
@@ -263,6 +294,16 @@ def main() -> None:
     vocab = cfg.vocab_size
     prompt_lens = [int(x) for x in args.prompt_lens.split(',')]
 
+    # --smoke: serve /metrics on loopback and sample it around the
+    # pipelined run (the observability signal the smoke asserts on).
+    metrics_port = None
+    metrics_shutdown = None
+    scrape_samples: List[Dict[str, Any]] = []
+    if args.smoke:
+        from skypilot_tpu.observability import metrics as obs_metrics
+        metrics_port, metrics_shutdown = (
+            obs_metrics.start_exposition_server())
+
     results: Dict[str, Any] = {}
     for mode, pipelined in (('pipelined', True), ('legacy', False)):
         if mode == 'legacy' and args.skip_legacy:
@@ -282,10 +323,25 @@ def main() -> None:
             for base in warm_lens:
                 eng.generate(list(range(1, base + 1)),
                              min(4, args.max_new_tokens), timeout=600)
+            scraper = None
+            if mode == 'pipelined' and metrics_port is not None:
+                scrape_samples.append(_scrape_metrics(metrics_port))
+
+                def _mid_scrape():
+                    time.sleep(0.3)  # land inside the ~seconds run
+                    scrape_samples.append(_scrape_metrics(metrics_port))
+
+                scraper = threading.Thread(target=_mid_scrape)
+                scraper.start()
             result = _run_load(eng, workload)
+            if scraper is not None:
+                scraper.join()
+                scrape_samples.append(_scrape_metrics(metrics_port))
         finally:
             eng.stop()
         results[mode] = result
+    if metrics_shutdown is not None:
+        metrics_shutdown()
 
     payload: Dict[str, Any] = {
         'metric': 'serve_decode_tokens_per_sec',
@@ -309,6 +365,28 @@ def main() -> None:
         legacy_tps = max(results['legacy']['tokens_per_s'], 1e-9)
         payload['speedup_vs_legacy'] = round(
             results['pipelined']['tokens_per_s'] / legacy_tps, 2)
+
+    if scrape_samples:
+        # The observability contract of the smoke: key series exist,
+        # the latency histograms are exposed, and the counters are
+        # monotone (and actually advanced) across the run's scrapes.
+        for key in ('ticks', 'decode_tokens'):
+            values = [s[key] for s in scrape_samples]
+            if any(b < a for a, b in zip(values, values[1:])):
+                raise RuntimeError(
+                    f'/metrics counter {key} went BACKWARDS across '
+                    f'scrapes: {values}')
+            if values[-1] <= values[0]:
+                raise RuntimeError(
+                    f'/metrics counter {key} did not advance over the '
+                    f'pipelined run: {values}')
+        if not all(s['histograms_present'] for s in scrape_samples):
+            raise RuntimeError(
+                'queue-wait/ITL/TTFT histograms missing from /metrics')
+        payload['metrics_scrape'] = {
+            'samples': scrape_samples,
+            'series_monotone': True,
+        }
 
     if not args.skip_stall_probe:
         chunk_s = _measure_chunk_compute(

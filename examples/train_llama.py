@@ -119,7 +119,8 @@ def main() -> None:
         state = load_pretrained_params(state, args.init_from)
         print(f'initialized params from {args.init_from}')
 
-    cb = callbacks.init(total_steps=args.steps)
+    cb = callbacks.init(total_steps=args.steps,
+                        tokens_per_step=args.batch_size * args.seq_len)
     if args.data:
         # Real data path: host-sharded resumable batches + the
         # double-buffered device prefetcher (data/prefetch.py) — step
@@ -144,10 +145,22 @@ def main() -> None:
             dtype=jnp.int32)
         batch_iter = iter(lambda: {'tokens': tokens}, None)
 
+    from skypilot_tpu.models.train import compiled_peak_memory
+    compiled_fn = None
     for step in range(start_step, args.steps):
         batch = next(batch_iter)
+        if compiled_fn is None:
+            # AOT-compile on the first real batch (same shapes every
+            # step) so the compiled step's peak-memory estimate feeds
+            # the telemetry (skytpu_train_peak_memory_bytes +
+            # summary.json) before the run is underway.
+            compiled_fn = step_fn.lower(state, batch).compile()
+            peak = compiled_peak_memory(compiled_fn)
+            if peak is not None:
+                print(f'compiled step peak temp memory: '
+                      f'{peak / 1e9:.2f} GB')
         with cb.step():
-            state, metrics = step_fn(state, batch)
+            state, metrics = compiled_fn(state, batch)
             jax.block_until_ready(metrics['loss'])
         if step % 10 == 0 or step == args.steps - 1:
             print(f'step {step}: loss={float(metrics["loss"]):.4f} '

@@ -5,6 +5,21 @@ then `step()` (context manager) or `on_step_begin()/on_step_end()`;
 timestamps are flushed to `<log_dir>/summary.json` so `bench` can
 compute $/step and time-to-K-steps without touching user code
 internals.
+
+Training telemetry (observability/metrics.py): every step feeds the
+process-global registry (steps, step-seconds histogram, tokens/s,
+data-wait, peak memory), and `summary()` splits compute time from
+data-wait — `seconds_per_step` (inter-end deltas, kept for
+compatibility) folds data-loading gaps into step time, while
+`compute_seconds_per_step` (begin→end) and `data_wait_seconds`
+(end→next-begin gaps) report the two separately, so "the input
+pipeline is the bottleneck" is a number, not a guess.  The hooks
+`record_data_wait` / `record_peak_memory` are fed by
+data/prefetch.py and models/train.py.
+
+Set SKYTPU_JAX_PROFILE_DIR to capture a jax.profiler trace for the
+whole run (started at init(), stopped atexit) — the device-level
+companion to this host-level telemetry.
 """
 from __future__ import annotations
 
@@ -16,9 +31,31 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from skypilot_tpu.observability import metrics as metrics_lib
+
 ENV_LOG_DIR = 'SKYTPU_BENCHMARK_LOG_DIR'
+ENV_PROFILE_DIR = 'SKYTPU_JAX_PROFILE_DIR'
 DEFAULT_LOG_DIR = '~/.skytpu/benchmark_logs'
 SUMMARY_FILE = 'summary.json'
+
+_M_STEPS = metrics_lib.counter(
+    'skytpu_train_steps_total', 'Optimizer steps completed.')
+_M_STEP_SECONDS = metrics_lib.histogram(
+    'skytpu_train_step_seconds',
+    'Wall seconds per step (on_step_begin -> on_step_end).',
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+             30.0, 60.0, 300.0))
+_M_DATA_WAIT = metrics_lib.counter(
+    'skytpu_train_data_wait_seconds_total',
+    'Seconds the training loop blocked waiting for input batches.')
+_M_TOKENS_PER_S = metrics_lib.gauge(
+    'skytpu_train_tokens_per_s',
+    'Training throughput over the steady-state steps '
+    '(needs tokens_per_step).')
+_M_PEAK_MEMORY = metrics_lib.gauge(
+    'skytpu_train_peak_memory_bytes',
+    "The compiled step's peak temp allocation (XLA "
+    'CompiledMemoryStats).')
 
 _instance: Optional['SkyTpuCallback'] = None
 
@@ -27,17 +64,37 @@ class SkyTpuCallback:
 
     def __init__(self, log_dir: Optional[str] = None,
                  total_steps: Optional[int] = None,
-                 flush_every: int = 10) -> None:
+                 flush_every: int = 10,
+                 tokens_per_step: Optional[int] = None) -> None:
         log_dir = log_dir or os.environ.get(ENV_LOG_DIR, DEFAULT_LOG_DIR)
         self.log_dir = os.path.expanduser(log_dir)
         os.makedirs(self.log_dir, exist_ok=True)
         self.total_steps = total_steps
+        self.tokens_per_step = tokens_per_step
         self.flush_every = flush_every
         self.start_time = time.time()
         self.step_begins: list = []
         self.step_ends: list = []
+        self.prefetch_wait_seconds = 0.0   # fed by record_data_wait
+        self.peak_memory_bytes: Optional[int] = None
         self._lock = threading.Lock()
         atexit.register(self.flush)
+        self._maybe_start_profiler()
+
+    def _maybe_start_profiler(self) -> None:
+        """SKYTPU_JAX_PROFILE_DIR=<dir>: one jax.profiler trace for the
+        whole run (view with TensorBoard / Perfetto); never fatal — a
+        CPU-only box without profiler support still trains."""
+        profile_dir = os.environ.get(ENV_PROFILE_DIR)
+        if not profile_dir:
+            return
+        try:
+            import jax  # pylint: disable=import-outside-toplevel
+            jax.profiler.start_trace(os.path.expanduser(profile_dir))
+            atexit.register(jax.profiler.stop_trace)
+        except Exception as e:  # pylint: disable=broad-except
+            print(f'skytpu callback: jax.profiler trace not started '
+                  f'({type(e).__name__}: {e})')
 
     def on_step_begin(self) -> None:
         with self._lock:
@@ -45,9 +102,24 @@ class SkyTpuCallback:
 
     def on_step_end(self) -> None:
         with self._lock:
-            self.step_ends.append(time.time())
-            if len(self.step_ends) % self.flush_every == 0:
+            now = time.time()
+            self.step_ends.append(now)
+            n = len(self.step_ends)
+            if len(self.step_begins) >= n:
+                _M_STEP_SECONDS.observe(now - self.step_begins[n - 1])
+            if n % self.flush_every == 0:
                 self._flush_no_lock()
+        _M_STEPS.inc()
+        if self.tokens_per_step:
+            rate = self._tokens_per_s()
+            if rate is not None:
+                _M_TOKENS_PER_S.set(rate)
+
+    def _tokens_per_s(self) -> Optional[float]:
+        compute = self._compute_seconds_per_step()
+        if compute is None or compute <= 0 or not self.tokens_per_step:
+            return None
+        return self.tokens_per_step / compute
 
     @contextlib.contextmanager
     def step(self):
@@ -57,12 +129,38 @@ class SkyTpuCallback:
         finally:
             self.on_step_end()
 
+    def _compute_seconds_per_step(self) -> Optional[float]:
+        """Mean begin→end duration over the steady-state steps (the
+        first, compile-heavy step is excluded when there are >= 2):
+        pure step compute, with data-loading gaps OUT."""
+        n = min(len(self.step_begins), len(self.step_ends))
+        durations = [self.step_ends[i] - self.step_begins[i]
+                     for i in range(n)]
+        if not durations:
+            return None
+        if len(durations) >= 2:
+            durations = durations[1:]
+        return sum(durations) / len(durations)
+
+    def _data_wait_seconds(self) -> float:
+        """Total end→next-begin gap: time the loop spent NOT inside a
+        step (fetching batches, checkpointing, logging).  This is what
+        `seconds_per_step`'s inter-end deltas silently folded into
+        step time."""
+        n = min(len(self.step_begins), len(self.step_ends))
+        return sum(max(0.0, self.step_begins[i] - self.step_ends[i - 1])
+                   for i in range(1, n))
+
     def summary(self) -> Dict[str, Any]:
         steps = len(self.step_ends)
         elapsed = (self.step_ends[-1] - self.start_time) if steps else 0.0
         seconds_per_step = None
         if steps >= 2:
             # Steady-state: ignore the first (compile-heavy) step.
+            # NOTE: inter-END deltas — includes data-wait gaps; kept
+            # for compatibility with existing bench consumers.  The
+            # split view is compute_seconds_per_step +
+            # data_wait_seconds below.
             seconds_per_step = ((self.step_ends[-1] - self.step_ends[0]) /
                                 (steps - 1))
         return {
@@ -70,6 +168,12 @@ class SkyTpuCallback:
             'num_steps': steps,
             'elapsed_seconds': elapsed,
             'seconds_per_step': seconds_per_step,
+            'compute_seconds_per_step': self._compute_seconds_per_step(),
+            'data_wait_seconds': self._data_wait_seconds(),
+            'prefetch_wait_seconds': self.prefetch_wait_seconds,
+            'tokens_per_step': self.tokens_per_step,
+            'tokens_per_s': self._tokens_per_s(),
+            'peak_memory_bytes': self.peak_memory_bytes,
             'first_step_seconds':
                 (self.step_ends[0] - self.start_time) if steps else None,
             'total_steps': self.total_steps,
@@ -89,11 +193,13 @@ class SkyTpuCallback:
 
 
 def init(log_dir: Optional[str] = None,
-         total_steps: Optional[int] = None) -> SkyTpuCallback:
+         total_steps: Optional[int] = None,
+         tokens_per_step: Optional[int] = None) -> SkyTpuCallback:
     global _instance
     if _instance is None:
         _instance = SkyTpuCallback(log_dir=log_dir,
-                                   total_steps=total_steps)
+                                   total_steps=total_steps,
+                                   tokens_per_step=tokens_per_step)
         return _instance
     # Singleton exists: later callers' arguments must not silently
     # vanish — a different log_dir is an error (two destinations cannot
@@ -106,6 +212,8 @@ def init(log_dir: Optional[str] = None,
             f'{_instance.log_dir!r}; cannot switch to {log_dir!r}.')
     if total_steps is not None:
         _instance.total_steps = total_steps
+    if tokens_per_step is not None:
+        _instance.tokens_per_step = tokens_per_step
     return _instance
 
 
@@ -125,3 +233,27 @@ def on_step_end() -> None:
 
 def step():
     return _require().step()
+
+
+# ------------------------------------------------------------- hooks
+# Fed by data/prefetch.py and models/train.py; safe to call whether or
+# not init() ran (the registry metric always updates, the summary
+# field only with a live singleton).
+
+
+def record_data_wait(seconds: float) -> None:
+    """The consumer blocked `seconds` waiting for an input batch
+    (DevicePrefetcher reports its queue-get block time here)."""
+    if seconds <= 0:
+        return
+    _M_DATA_WAIT.inc(seconds)
+    if _instance is not None:
+        _instance.prefetch_wait_seconds += seconds
+
+
+def record_peak_memory(nbytes: int) -> None:
+    """The compiled train step's peak temp allocation
+    (models/train.py::compiled_peak_memory feeds this)."""
+    _M_PEAK_MEMORY.set(nbytes)
+    if _instance is not None:
+        _instance.peak_memory_bytes = int(nbytes)

@@ -697,7 +697,11 @@ def serve_update(service_name, entrypoint, yes):
 
 @serve_group.command(name='status')
 @click.argument('service_names', nargs=-1)
-def serve_status(service_names):
+@click.option('--metrics', 'show_metrics', is_flag=True, default=False,
+              help='Scrape /metrics from each READY replica and show '
+                   'live engine telemetry (decode tokens/s, slots, '
+                   'queue, TTFT/ITL p50/p99).')
+def serve_status(service_names, show_metrics):
     """Show services and their replicas."""
     from skypilot_tpu import serve  # pylint: disable=import-outside-toplevel
     records = serve.status(list(service_names) or None)
@@ -712,6 +716,80 @@ def serve_status(service_names):
                      f'{ready}/{len(r["replicas"])}',
                      r.get('load_balancer_port') or '-'))
     _print_table(['NAME', 'STATUS', 'VERSION', 'READY', 'LB PORT'], rows)
+    if show_metrics:
+        _serve_metrics_table(records)
+
+
+def _hist_quantile(parsed, name: str, q: float):
+    """Approximate quantile from an exposed Prometheus histogram
+    (upper bound of the bucket where the cumulative count crosses q)."""
+    buckets = parsed.get(f'{name}_bucket')
+    if not buckets:
+        return None
+    rows = []
+    for labels, value in buckets.items():
+        le = dict(labels).get('le')
+        if le is None:
+            continue
+        rows.append((float('inf') if le == '+Inf' else float(le), value))
+    rows.sort()
+    if not rows or rows[-1][1] <= 0:
+        return None
+    target = q * rows[-1][1]
+    for bound, cum in rows:
+        if cum >= target:
+            return bound
+    return rows[-1][0]
+
+
+def _serve_metrics_table(records) -> None:
+    """One row per READY replica, scraped live from GET /metrics
+    (observability/metrics.py exposition on the model server)."""
+    import requests  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.observability import metrics as metrics_lib  # pylint: disable=import-outside-toplevel
+
+    def fmt_ms(seconds):
+        return '-' if seconds is None else (
+            'inf' if seconds == float('inf')
+            else f'{seconds * 1e3:g}ms')
+
+    rows = []
+    for r in records:
+        for rep in r['replicas']:
+            if rep['status'] != 'READY' or not rep.get('url'):
+                continue
+            url = rep['url']
+            try:
+                resp = requests.get(url + '/metrics', timeout=5)
+                resp.raise_for_status()
+                parsed = metrics_lib.parse_exposition(resp.text)
+            except (requests.RequestException, ValueError) as e:
+                rows.append((r['name'], rep['replica_id'], url,
+                             f'scrape failed: {e}', '-', '-', '-', '-'))
+                continue
+
+            def total(name, parsed=parsed):
+                return sum((parsed.get(name) or {}).values())
+
+            busy = int(total('skytpu_engine_busy_slots'))
+            slots = int(total('skytpu_engine_slots'))
+            rows.append((
+                r['name'], rep['replica_id'], url,
+                f'{total("skytpu_engine_decode_tokens_per_s"):g}',
+                f'{busy}/{slots}',
+                int(total('skytpu_engine_queue_depth')),
+                f'{fmt_ms(_hist_quantile(parsed, "skytpu_engine_ttft_seconds", 0.5))}'
+                f'/{fmt_ms(_hist_quantile(parsed, "skytpu_engine_ttft_seconds", 0.99))}',
+                f'{fmt_ms(_hist_quantile(parsed, "skytpu_engine_itl_seconds", 0.5))}'
+                f'/{fmt_ms(_hist_quantile(parsed, "skytpu_engine_itl_seconds", 0.99))}',
+            ))
+    if not rows:
+        click.echo('No READY replicas to scrape.')
+        return
+    click.echo('')
+    _print_table(['SERVICE', 'REPLICA', 'URL', 'TOK/S', 'SLOTS',
+                  'QUEUE', 'TTFT p50/p99', 'ITL p50/p99'], rows)
 
 
 @serve_group.command(name='down')

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Iterator, Optional
 
 
@@ -79,7 +80,17 @@ class DevicePrefetcher:
         return self
 
     def __next__(self) -> Any:
+        t0 = time.perf_counter()
         item = self._queue.get()
+        waited = time.perf_counter() - t0
+        if waited > 1e-4:
+            # The consumer actually blocked: the producer (host read +
+            # device_put) is behind compute.  Feed the training
+            # telemetry so "input-bound" shows up as a number
+            # (callbacks/base summary prefetch_wait_seconds + the
+            # skytpu_train_data_wait_seconds_total counter).
+            from skypilot_tpu.callbacks import base as callbacks  # pylint: disable=import-outside-toplevel
+            callbacks.record_data_wait(waited)
         if item is self._done:
             # Re-enqueue the sentinel: the iterator protocol allows
             # repeated next() after exhaustion (must keep raising, not

@@ -237,6 +237,23 @@ def train_step(state: TrainState, batch,
     return new_state, metrics
 
 
+def compiled_peak_memory(compiled) -> Optional[int]:
+    """Peak temp allocation (bytes) of an AOT-compiled step, from XLA
+    CompiledMemoryStats (None when the backend hides it).  Feeds the
+    training telemetry (callbacks/base.record_peak_memory →
+    skytpu_train_peak_memory_bytes gauge + summary.json), so the
+    memory headroom of a run is a scrapeable number, not a one-off
+    bench.py printout."""
+    try:
+        stats = compiled.memory_analysis()
+        peak = int(stats.temp_size_in_bytes)
+    except Exception:  # pylint: disable=broad-except
+        return None
+    from skypilot_tpu.callbacks import base as callbacks  # pylint: disable=import-outside-toplevel
+    callbacks.record_peak_memory(peak)
+    return peak
+
+
 def jit_train_step(state_shardings, batch_sharding,
                    tcfg: Optional[TrainConfig] = None):
     """jit train_step with explicit in/out shardings (the NamedShardings

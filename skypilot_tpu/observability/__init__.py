@@ -1,0 +1,22 @@
+"""Unified observability layer: metrics + request tracing.
+
+One dependency-free substrate every layer reports into (SURVEY.md
+north star: a production service is only as debuggable as its
+telemetry):
+
+- `metrics`: Counter/Gauge/Histogram instruments with label support, a
+  process-global registry, and Prometheus text-format exposition — the
+  serving fronts answer `GET /metrics` from it, the training callback
+  feeds step telemetry into it.
+- `tracing`: request-id generation + per-request span records (queue
+  wait, prefill, TTFT, ITL, total decode) propagated load_balancer →
+  server → batching-engine slot via the `X-SkyTPU-Request-Id` header,
+  and emitted into the Chrome-trace timeline (utils/timeline.py).
+
+See docs/observability.md for the metrics catalog and the request-id
+propagation diagram.
+"""
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import tracing
+
+__all__ = ['metrics', 'tracing']

@@ -1,0 +1,178 @@
+"""Request tracing: ids, per-request spans, and timeline emission.
+
+Answers "why was THIS request slow": every request carries an id (the
+`X-SkyTPU-Request-Id` header, generated at the outermost layer that
+sees the request — load balancer, else server front, else engine) and
+the batching engine records a `RequestSpan` per request with the
+phase breakdown a serving SLO decomposes into:
+
+    queue_wait  — submit() until the engine pops the request
+    prefill     — chunked prompt prefill (count + total seconds)
+    ttft        — submit() until the first generated token
+    itl         — inter-token gaps during decode (count/mean/max)
+    total       — submit() until the request finished
+
+Finished spans land in a bounded `SpanStore` (newest-first, surfaced
+through `engine.stats()['recent_spans']` → `/health`) and are emitted
+into the Chrome-trace timeline (utils/timeline.py) as `X` complete
+events, so `SKYTPU_TIMELINE_FILE=trace.json` shows per-request
+queue/prefill/decode bars next to the control-plane spans.
+
+Span bookkeeping is mutation-from-one-thread (the engine worker) plus
+read-from-any (stats()); the store's lock covers the handoff.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import uuid
+from typing import Any, Deque, Dict, List, Optional
+
+from skypilot_tpu.utils import timeline
+
+# Propagated load_balancer -> model_server/async_server -> engine slot;
+# servers echo it on the response so clients can correlate.
+REQUEST_ID_HEADER = 'X-SkyTPU-Request-Id'
+
+# Spans kept per store; old spans fall off (a replica serving millions
+# of requests must not grow without bound).
+DEFAULT_STORE_SIZE = 256
+# Spans inlined into stats() -> /health (the store keeps more).
+STATS_SPAN_LIMIT = 8
+
+
+def new_request_id() -> str:
+    """16 hex chars: unique enough per fleet, short enough for logs."""
+    return uuid.uuid4().hex[:16]
+
+
+class RequestSpan:
+    """Phase timings of one serving request (times are monotonic
+    internally; wall-clock start is kept for the timeline)."""
+
+    def __init__(self, request_id: Optional[str] = None) -> None:
+        self.request_id = request_id or new_request_id()
+        self.submit_wall = time.time()
+        self._submit = time.monotonic()
+        self.queue_wait_s: Optional[float] = None
+        self.prefill_chunks = 0
+        self.prefill_s = 0.0
+        self.ttft_s: Optional[float] = None
+        self._last_token: Optional[float] = None
+        self.itl_count = 0
+        self.itl_sum_s = 0.0
+        self.itl_max_s = 0.0
+        self.tokens = 0
+        self.total_s: Optional[float] = None
+        self.status: Optional[str] = None
+
+    # ----------------------------------------------- recording (engine)
+
+    def mark_admitted(self) -> None:
+        if self.queue_wait_s is None:
+            self.queue_wait_s = time.monotonic() - self._submit
+
+    def mark_prefill_chunk(self, duration_s: float) -> None:
+        self.prefill_chunks += 1
+        self.prefill_s += duration_s
+
+    def mark_token(self) -> Optional[float]:
+        """Record one generated token; returns the inter-token gap in
+        seconds (None for the first token — that one sets TTFT)."""
+        now = time.monotonic()
+        self.tokens += 1
+        gap: Optional[float] = None
+        if self.ttft_s is None:
+            self.ttft_s = now - self._submit
+        elif self._last_token is not None:
+            gap = now - self._last_token
+            self.itl_count += 1
+            self.itl_sum_s += gap
+            self.itl_max_s = max(self.itl_max_s, gap)
+        self._last_token = now
+        return gap
+
+    def finish(self, status: str = 'ok') -> None:
+        if self.total_s is not None:
+            return  # idempotent like _Request._finish
+        self.total_s = time.monotonic() - self._submit
+        self.status = status
+        self._emit_timeline()
+
+    # ------------------------------------------------------------ output
+
+    def to_dict(self) -> Dict[str, Any]:
+        def ms(v: Optional[float]) -> Optional[float]:
+            return None if v is None else round(v * 1e3, 3)
+
+        itl_mean = (self.itl_sum_s / self.itl_count
+                    if self.itl_count else None)
+        return {
+            'request_id': self.request_id,
+            'submit_time': self.submit_wall,
+            'status': self.status,
+            'queue_wait_ms': ms(self.queue_wait_s),
+            'prefill_chunks': self.prefill_chunks,
+            'prefill_ms': ms(self.prefill_s),
+            'ttft_ms': ms(self.ttft_s),
+            'itl_mean_ms': ms(itl_mean),
+            'itl_max_ms': ms(self.itl_max_s if self.itl_count else None),
+            'tokens': self.tokens,
+            'total_ms': ms(self.total_s),
+        }
+
+    def _emit_timeline(self) -> None:
+        if not timeline.enabled():
+            return
+        base = f'request:{self.request_id}'
+        wall0 = self.submit_wall
+        timeline.add_complete_event(
+            base, wall0, self.total_s or 0.0,
+            args={k: v for k, v in self.to_dict().items()
+                  if v is not None})
+        if self.queue_wait_s:
+            timeline.add_complete_event(f'{base}/queue', wall0,
+                                        self.queue_wait_s)
+        if self.ttft_s is not None:
+            # Prefill runs between admission and first token; the span
+            # bar shows its aggregate (chunks interleave with ticks, so
+            # a contiguous bar is an approximation labeled as such).
+            if self.prefill_s:
+                timeline.add_complete_event(
+                    f'{base}/prefill',
+                    wall0 + (self.queue_wait_s or 0.0), self.prefill_s,
+                    args={'chunks': self.prefill_chunks})
+            decode_s = (self.total_s or self.ttft_s) - self.ttft_s
+            timeline.add_complete_event(
+                f'{base}/decode', wall0 + self.ttft_s, decode_s,
+                args={'tokens': self.tokens})
+
+
+class SpanStore:
+    """Bounded newest-first store of finished spans."""
+
+    def __init__(self, maxlen: int = DEFAULT_STORE_SIZE) -> None:
+        self._spans: Deque[RequestSpan] = collections.deque(
+            maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def add(self, span: RequestSpan) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    def get(self, request_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            for span in reversed(self._spans):
+                if span.request_id == request_id:
+                    return span.to_dict()
+        return None
+
+    def recent(self, n: int = STATS_SPAN_LIMIT) -> List[Dict[str, Any]]:
+        with self._lock:
+            spans = list(self._spans)[-n:]
+        return [s.to_dict() for s in reversed(spans)]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)

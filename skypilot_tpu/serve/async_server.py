@@ -12,8 +12,9 @@ non-stream endpoints) runs in the default executor, bounded by the
 engine's own slot count.
 
 Zero dependencies, same endpoint surface as the threaded front
-(GET /, POST /generate, /generate_stream, /generate_text); the
-hand-rolled HTTP follows serve/load_balancer.py's precedent.
+(GET /, GET /metrics, POST /generate, /generate_stream,
+/generate_text — all POST routes honor and echo X-SkyTPU-Request-Id);
+the hand-rolled HTTP follows serve/load_balancer.py's precedent.
 
 Parity: the reference ships no replica server (SkyPilot serves user
 containers); this is the framework-native replica of SURVEY.md's
@@ -27,10 +28,14 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import batching_engine as batching_engine_lib
 from skypilot_tpu.serve import model_server as model_server_lib
 
 logger = sky_logging.init_logger(__name__)
+
+_REQUEST_ID_KEY = tracing.REQUEST_ID_HEADER.lower()
 
 _MAX_BODY = 64 * 1024 * 1024
 _IDLE_TIMEOUT = 300.0
@@ -159,19 +164,21 @@ class AsyncModelServer:
                 int(req.get('top_k', server.default_top_k)),
                 int(req.get('seed', server.default_seed)))
 
-    async def _generate(self, req: Dict[str, Any]) -> Dict[str, Any]:
+    async def _generate(self, req: Dict[str, Any],
+                        rid: str) -> Dict[str, Any]:
         t0 = time.perf_counter()
         temperature, top_k, seed = self._sampling(req)
         tokens = await asyncio.get_running_loop().run_in_executor(
             None, lambda: self.server.generate(
                 req['prompt_ids'],
                 int(req.get('max_new_tokens', 16)),
-                temperature, top_k, seed=seed))
+                temperature, top_k, seed=seed, request_id=rid))
         return {'tokens': tokens,
                 'latency_ms': round((time.perf_counter() - t0) * 1e3, 1)}
 
     async def _generate_text(self, req: Dict[str, Any],
-                             writer: asyncio.StreamWriter) -> None:
+                             writer: asyncio.StreamWriter,
+                             rid: str) -> None:
         server = self.server
         tok = server.tokenizer
         if server.cfg.vocab_size < tok.vocab_size:
@@ -186,7 +193,7 @@ class AsyncModelServer:
         if not ids:
             raise _HttpError(400, 'prompt tokenized to nothing')
         if req.get('stream'):
-            await self._stream(writer, ids, req, text_mode=True)
+            await self._stream(writer, ids, req, rid, text_mode=True)
             return
         t0 = time.perf_counter()
         temperature, top_k, seed = self._sampling(req)
@@ -194,7 +201,8 @@ class AsyncModelServer:
             None, lambda: server.generate(
                 [ids], int(req.get('max_new_tokens', 64)),
                 temperature, top_k,
-                stop_token=tok.eos_ids or None, seed=seed)))[0]
+                stop_token=tok.eos_ids or None, seed=seed,
+                request_id=rid)))[0]
         stops = [i for i, t in enumerate(tokens) if t in tok.eos_ids]
         if stops:
             tokens = tokens[:stops[0]]
@@ -202,11 +210,11 @@ class AsyncModelServer:
             'completion': tok.decode(tokens),
             'tokens': tokens,
             'latency_ms': round((time.perf_counter() - t0) * 1e3, 1),
-        }))
+        }, {tracing.REQUEST_ID_HEADER: rid}))
         await writer.drain()
 
     async def _stream(self, writer: asyncio.StreamWriter, ids, req,
-                      *, text_mode: bool) -> None:
+                      rid: str, *, text_mode: bool) -> None:
         """SSE over chunked transfer; token events or UTF-8-safe text
         deltas.  Purely event-driven: no thread parks waiting."""
         server = self.server
@@ -228,7 +236,8 @@ class AsyncModelServer:
                 int(req.get('max_new_tokens', 64 if text_mode else 16)),
                 stop_token=stop_ids,
                 sampling=decode.SamplingConfig(
-                    temperature=temperature, top_k=top_k, seed=seed))
+                    temperature=temperature, top_k=top_k, seed=seed),
+                request_id=rid)
         except ValueError:
             raise
         except Exception as e:  # pylint: disable=broad-except
@@ -243,7 +252,8 @@ class AsyncModelServer:
         q = self._watch(request)
         writer.write(b'HTTP/1.1 200 OK\r\n'
                      b'Content-Type: text/event-stream\r\n'
-                     b'Cache-Control: no-cache\r\n'
+                     b'Cache-Control: no-cache\r\n' +
+                     f'{tracing.REQUEST_ID_HEADER}: {rid}\r\n'.encode() +
                      b'Transfer-Encoding: chunked\r\n\r\n')
 
         def chunk(data: str) -> bytes:
@@ -318,11 +328,23 @@ class AsyncModelServer:
                     break
                 if parsed is None:
                     break
-                method, path, _, body = parsed
+                method, path, headers, body = parsed
                 try:
                     if method == 'GET':
-                        code, payload = self._health()
-                        writer.write(_json_response(code, payload))
+                        if path == '/metrics':
+                            engine = self.server._engine  # pylint: disable=protected-access
+                            if engine is not None:
+                                engine.stats()  # freshen gauges
+                            text = metrics_lib.expose().encode()
+                            writer.write(
+                                (f'HTTP/1.1 200 OK\r\n'
+                                 f'Content-Type: '
+                                 f'{metrics_lib.CONTENT_TYPE}\r\n'
+                                 f'Content-Length: {len(text)}\r\n'
+                                 f'\r\n').encode() + text)
+                        else:
+                            code, payload = self._health()
+                            writer.write(_json_response(code, payload))
                         await writer.drain()
                         continue
                     if method != 'POST':
@@ -331,9 +353,14 @@ class AsyncModelServer:
                         req = json.loads(body or b'{}')
                     except json.JSONDecodeError as e:
                         raise _HttpError(400, f'bad JSON: {e}') from e
+                    # Propagated request id (LB injects one when the
+                    # client didn't send it); echoed on every reply.
+                    rid = (headers.get(_REQUEST_ID_KEY) or
+                           tracing.new_request_id())
                     if path == '/generate':
                         writer.write(_json_response(
-                            200, await self._generate(req)))
+                            200, await self._generate(req, rid),
+                            {tracing.REQUEST_ID_HEADER: rid}))
                         await writer.drain()
                     elif path == '/generate_stream':
                         prompt = req['prompt_ids']
@@ -345,10 +372,10 @@ class AsyncModelServer:
                                     'streaming serves one prompt '
                                     'per request')
                             prompt = prompt[0]
-                        await self._stream(writer, prompt, req,
+                        await self._stream(writer, prompt, req, rid,
                                            text_mode=False)
                     elif path == '/generate_text':
-                        await self._generate_text(req, writer)
+                        await self._generate_text(req, writer, rid)
                     else:
                         raise _HttpError(404, 'unknown path')
                 except _HttpError as e:

@@ -55,6 +55,8 @@ import time
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import tracing
 
 logger = sky_logging.init_logger(__name__)
 
@@ -62,6 +64,47 @@ _PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 # Queue-wait histogram bucket upper bounds (seconds); the last bucket
 # is open-ended.  Surfaced via stats() -> /health for autoscaling.
 _WAIT_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+
+# Process-global registry instruments (observability/metrics.py) —
+# what `GET /metrics` on the serving fronts exposes.  Counters are
+# process-cumulative (Prometheus semantics: rates come from deltas);
+# the per-ENGINE view lives in stats().  Gauges describe the most
+# recently constructed engine — one engine per serving process.
+_M_TICKS = metrics_lib.counter(
+    'skytpu_engine_ticks_total', 'Decode engine ticks dispatched.')
+_M_TOKENS = metrics_lib.counter(
+    'skytpu_engine_decode_tokens_total',
+    'Tokens generated across all requests.')
+_M_PREFILL_CHUNKS = metrics_lib.counter(
+    'skytpu_engine_prefill_chunks_total',
+    'Prompt prefill chunks executed.')
+_M_ADMITTED = metrics_lib.counter(
+    'skytpu_engine_admitted_total',
+    'Requests admitted into a KV slot.')
+_M_REJECTED = metrics_lib.counter(
+    'skytpu_engine_rejected_total',
+    'Requests rejected at admission, by reason.', ('reason',))
+_M_QUEUE_DEPTH = metrics_lib.gauge(
+    'skytpu_engine_queue_depth', 'Requests waiting for a slot.')
+_M_BUSY_SLOTS = metrics_lib.gauge(
+    'skytpu_engine_busy_slots', 'KV slots currently decoding.')
+_M_SLOTS = metrics_lib.gauge(
+    'skytpu_engine_slots', 'Total KV slots in the pool.')
+_M_DECODE_RATE = metrics_lib.gauge(
+    'skytpu_engine_decode_tokens_per_s',
+    'Decode tokens/s over the trailing 10s window.')
+_M_QUEUE_WAIT = metrics_lib.histogram(
+    'skytpu_engine_queue_wait_seconds',
+    'Seconds a request waited queued before admission.',
+    buckets=_WAIT_BUCKETS)
+_M_TTFT = metrics_lib.histogram(
+    'skytpu_engine_ttft_seconds',
+    'Submit-to-first-token latency per request.')
+_M_ITL = metrics_lib.histogram(
+    'skytpu_engine_itl_seconds',
+    'Inter-token gaps during decode.',
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5, 5.0))
 
 
 class QueueFull(RuntimeError):
@@ -90,9 +133,14 @@ class _Request:
 
     def __init__(self, prompt_ids: List[int], max_new_tokens: int,
                  stop_token, temperature: float = 0.0, top_k: int = 0,
-                 seed: int = 0) -> None:
+                 seed: int = 0,
+                 request_id: Optional[str] = None) -> None:
         self.prompt_ids = list(prompt_ids)
         self.max_new_tokens = max_new_tokens
+        # Per-request phase trace (queue/prefill/TTFT/ITL/total); the
+        # id arrives via X-SkyTPU-Request-Id or is generated here.
+        self.span = tracing.RequestSpan(request_id)
+        self.request_id = self.span.request_id
         # stop_token: None, a single id, or any iterable of ids (the
         # tokenizer's multi-EOS stop set — instruct checkpoints stop at
         # chat turn-end markers, not just the model-level EOS).
@@ -123,6 +171,8 @@ class _Request:
         # the state lock — watchers must be cheap and non-blocking
         # (call_soon_threadsafe qualifies).
         self._watchers: List[Any] = []
+        # Set by the engine at submit(): finished spans land here.
+        self._span_store: Optional[tracing.SpanStore] = None
 
     def add_watcher(self, fn) -> None:
         """Subscribe fn(token|None) to this request's token stream;
@@ -143,6 +193,12 @@ class _Request:
                 # stop() already finished this request; a worker still
                 # mid-tick must not append past the sentinel.
                 return
+            gap = self.span.mark_token()
+            if gap is None:
+                if self.span.ttft_s is not None:
+                    _M_TTFT.observe(self.span.ttft_s)
+            else:
+                _M_ITL.observe(gap)
             self.tokens.append(token)
             self._live.put(token)
             self._notify(token)
@@ -153,6 +209,15 @@ class _Request:
                 return
             self.error = error
             self.done.set()
+            if error is not None:
+                status = type(error).__name__
+            elif self.cancelled:
+                status = 'cancelled'
+            else:
+                status = 'ok'
+            self.span.finish(status)
+            if self._span_store is not None:
+                self._span_store.add(self.span)
             self._live.put(None)
             self._notify(None)
             self._watchers.clear()
@@ -298,12 +363,23 @@ class ContinuousBatchingEngine:
         self._failed: Optional[Exception] = None
 
         # ---- metrics (updated under _metrics_lock; read by stats()).
+        # These are the per-ENGINE view; every update is mirrored into
+        # the process-global registry instruments above (what
+        # GET /metrics exposes).
         self._metrics_lock = threading.Lock()
         self._tokens_generated = 0
         self._ticks = 0
         self._prefill_chunks = 0
+        self._queue_full_rejections = 0
+        self._queue_ttl_expiries = 0
         self._queue_wait_hist = [0] * (len(_WAIT_BUCKETS) + 1)
         self._rate_window: Deque[Tuple[float, int]] = collections.deque()
+        # Finished per-request spans (queue/prefill/TTFT/ITL/total),
+        # bounded; surfaced via stats()['recent_spans'] and span().
+        self._spans = tracing.SpanStore()
+        _M_SLOTS.set(slots)
+        _M_BUSY_SLOTS.set(0)
+        _M_QUEUE_DEPTH.set(0)
 
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -311,7 +387,8 @@ class ContinuousBatchingEngine:
     # ------------------------------------------------------------ public
 
     def submit(self, prompt_ids: List[int], max_new_tokens: int,
-               stop_token=None, sampling=None) -> _Request:
+               stop_token=None, sampling=None,
+               request_id: Optional[str] = None) -> _Request:
         """stop_token: None, one id, or an iterable of ids — the
         request finishes at the FIRST generated member of the set
         (multi-EOS: model-level EOS + chat turn-end markers).
@@ -320,7 +397,10 @@ class ContinuousBatchingEngine:
         <= 0 decodes greedily (the deterministic serving default);
         temperature > 0 samples on device with per-request top_k/seed —
         deterministic for a given seed (the slot's key chain splits
-        once per generated token, independent of other traffic)."""
+        once per generated token, independent of other traffic).
+
+        request_id: the propagated X-SkyTPU-Request-Id (generated when
+        absent); names the request's span record and timeline events."""
         if not prompt_ids:
             raise ValueError('empty prompt')
         if max_new_tokens < 1:
@@ -344,7 +424,8 @@ class ContinuousBatchingEngine:
                 'decoding only')
         request = _Request(prompt_ids, max_new_tokens, stop_token,
                            temperature=temperature, top_k=top_k,
-                           seed=seed)
+                           seed=seed, request_id=request_id)
+        request._span_store = self._spans  # pylint: disable=protected-access
         if len(request.stop_ids) > self.max_stop_ids:
             raise ValueError(
                 f'{len(request.stop_ids)} stop ids > engine '
@@ -355,10 +436,14 @@ class ContinuousBatchingEngine:
                                f'batching engine failed: {self._failed}')
         with self._cond:
             if self.max_queue and len(self._queue) >= self.max_queue:
+                with self._metrics_lock:
+                    self._queue_full_rejections += 1
+                _M_REJECTED.labels(reason='queue_full').inc()
                 raise QueueFull(
                     f'admission queue full ({self.max_queue} waiting); '
                     'retry later', retry_after=self._drain_estimate())
             self._queue.append(request)
+            _M_QUEUE_DEPTH.set(len(self._queue))
             self._cond.notify()
         if self._stop.is_set():
             # Lost the race with stop(): its drain may have already run,
@@ -414,13 +499,30 @@ class ContinuousBatchingEngine:
                 'failed': self._failed is not None,
                 'ticks': self._ticks,
                 'prefill_chunks': self._prefill_chunks,
+                'queue_full_rejections': self._queue_full_rejections,
+                'queue_ttl_expiries': self._queue_ttl_expiries,
                 'queue_wait_hist': hist,
                 'max_queue': self.max_queue,
                 'prefill_chunk': self.prefill_chunk,
                 'pipelined': self.pipelined,
             }
-        stats['decode_tokens_per_s'] = round(self._decode_rate(), 3)
+        rate = round(self._decode_rate(), 3)
+        stats['decode_tokens_per_s'] = rate
+        # Per-request phase traces (newest first) — the "why was THIS
+        # request slow" answer, keyed by X-SkyTPU-Request-Id.
+        stats['recent_spans'] = self._spans.recent()
+        # Freshen the scrape-time gauges so /metrics agrees with
+        # /health no matter which is polled.
+        _M_SLOTS.set(stats['slots'])
+        _M_BUSY_SLOTS.set(busy)
+        _M_QUEUE_DEPTH.set(stats['queued_requests'])
+        _M_DECODE_RATE.set(rate)
         return stats
+
+    def span(self, request_id: str) -> Optional[Dict[str, Any]]:
+        """The finished span record for a request id (None while the
+        request is still running or once it aged out of the store)."""
+        return self._spans.get(request_id)
 
     def stop(self) -> None:
         self._stop.set()
@@ -451,9 +553,14 @@ class ContinuousBatchingEngine:
             while (self._rate_window and
                    now - self._rate_window[0][0] > 10.0):
                 self._rate_window.popleft()
+        _M_TOKENS.inc(n)
+        _M_DECODE_RATE.set(round(self._decode_rate(), 3))
 
     def _record_queue_wait(self, request: _Request) -> None:
+        request.span.mark_admitted()
         wait = time.monotonic() - request.submit_time
+        _M_ADMITTED.inc()
+        _M_QUEUE_WAIT.observe(wait)
         with self._metrics_lock:
             for i, bound in enumerate(_WAIT_BUCKETS):
                 if wait < bound:
@@ -482,12 +589,20 @@ class ContinuousBatchingEngine:
             if (self.queue_ttl is not None and
                     time.monotonic() - request.submit_time >
                     self.queue_ttl):
+                self._record_expiry(1)
                 request._finish(QueueExpired(  # pylint: disable=protected-access
                     f'request expired after {self.queue_ttl}s queued',
                     retry_after=self._drain_estimate()))
                 continue
             self._record_queue_wait(request)
+            with self._cond:
+                _M_QUEUE_DEPTH.set(len(self._queue))
             return request
+
+    def _record_expiry(self, n: int) -> None:
+        with self._metrics_lock:
+            self._queue_ttl_expiries += n
+        _M_REJECTED.labels(reason='queue_expired').inc(n)
 
     def _expire_queued(self) -> None:
         """Fail requests that outlived queue_ttl while still queued —
@@ -507,6 +622,9 @@ class ContinuousBatchingEngine:
                 else:
                     keep.append(request)
             self._queue = keep
+            _M_QUEUE_DEPTH.set(len(keep))
+        if expired:
+            self._record_expiry(len(expired))
         for request in expired:
             request._finish(QueueExpired(  # pylint: disable=protected-access
                 f'request expired after {self.queue_ttl}s queued',
@@ -531,8 +649,11 @@ class ContinuousBatchingEngine:
             # The first generated token therefore comes from the
             # prefill logits (one compile per distinct MoE prompt
             # length), selected with the same key chain a tick uses.
+            t_prefill = time.perf_counter()
             logits, pre = self._prefill(
                 self.params, jnp.asarray([prompt], jnp.int32))
+            request.span.mark_prefill_chunk(
+                time.perf_counter() - t_prefill)
             self._cache = self._insert(self._cache, slot_id, pre, n)
             key = self._jax.random.PRNGKey(request.seed)
             carry, sub = self._jax.random.split(key)
@@ -583,6 +704,7 @@ class ContinuousBatchingEngine:
             self._slots[pending.slot_id].request = None
             return True  # pending is finished (slot freed)
         import numpy as np  # pylint: disable=import-outside-toplevel
+        t_chunk0 = time.perf_counter()
         n_target = pending.n_target
         chunk = self.prefill_chunk
         if pending.cache is None:
@@ -621,6 +743,8 @@ class ContinuousBatchingEngine:
                 pending.cache,
                 index=jnp.asarray(start + take, jnp.int32))
             pending.consumed = start + take
+        request.span.mark_prefill_chunk(time.perf_counter() - t_chunk0)
+        _M_PREFILL_CHUNKS.inc()
         with self._metrics_lock:
             self._prefill_chunks += 1
         if pending.consumed < n_target:
@@ -733,6 +857,9 @@ class ContinuousBatchingEngine:
                         self._record_tokens(pushed)
                     with self._metrics_lock:
                         self._ticks += 1
+                    _M_TICKS.inc()
+                    _M_BUSY_SLOTS.set(
+                        sum(1 for s in self._slots if s.active))
                 inflight = dispatched
                 if (inflight is None and not live and
                         not pending_prefills):
@@ -830,6 +957,8 @@ class ContinuousBatchingEngine:
         self._record_tokens(pushed)
         with self._metrics_lock:
             self._ticks += 1
+        _M_TICKS.inc()
+        _M_BUSY_SLOTS.set(sum(1 for s in self._slots if s.active))
 
     def _run_legacy(self) -> None:
         while not self._stop.is_set():

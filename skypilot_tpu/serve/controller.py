@@ -19,6 +19,7 @@ from typing import Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.serve import autoscalers
 from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import serve_state
@@ -27,6 +28,20 @@ from skypilot_tpu.serve.serve_state import ServiceStatus
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
 
 logger = sky_logging.init_logger(__name__)
+
+# Autoscaler-signal gauges (observability/metrics.py): what the scaler
+# saw and what it decided, per service — the "why did it scale"
+# dashboard row.
+_M_TARGET_REPLICAS = metrics_lib.gauge(
+    'skytpu_autoscaler_target_replicas',
+    'Replica target from the last scaling evaluation.', ('service',))
+_M_QPS = metrics_lib.gauge(
+    'skytpu_autoscaler_qps',
+    'Request rate over the autoscaler QPS window.', ('service',))
+_M_READY_REPLICAS = metrics_lib.gauge(
+    'skytpu_autoscaler_ready_replicas',
+    'Ready replicas serving traffic at evaluation time.',
+    ('service',))
 
 
 def _sync_interval() -> float:
@@ -184,6 +199,13 @@ class SkyServeController:
         self.autoscaler.collect_replica_load(
             self.replica_manager.ready_loads())
         decision = self.autoscaler.evaluate_scaling(time.time())
+        _M_TARGET_REPLICAS.labels(service=self.service_name).set(
+            decision.target_num_replicas)
+        _M_QPS.labels(service=self.service_name).set(
+            len(self.autoscaler.request_timestamps) /
+            autoscalers.QPS_WINDOW_SIZE_SECONDS)
+        _M_READY_REPLICAS.labels(service=self.service_name).set(
+            len(self.replica_manager.ready_urls()))
         replicas = self.replica_manager.active_replicas()
         current_version = [r for r in replicas
                            if r['version'] >= self.version]

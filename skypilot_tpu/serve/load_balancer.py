@@ -24,8 +24,48 @@ from urllib.parse import urlsplit
 import requests
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import tracing
 
 logger = sky_logging.init_logger(__name__)
+
+# Registry instruments (GET /metrics on the replica fronts; the LB has
+# no HTTP exposition of its own yet — scrape via
+# metrics.start_exposition_server when running it standalone).
+_M_REQUESTS = metrics_lib.counter(
+    'skytpu_lb_requests_total',
+    'Requests proxied, by load-balancing policy.', ('policy',))
+_M_UPSTREAM_INFLIGHT = metrics_lib.gauge(
+    'skytpu_lb_upstream_inflight',
+    'In-flight proxied requests per upstream replica.', ('upstream',))
+_M_PROXY_LATENCY = metrics_lib.histogram(
+    'skytpu_lb_proxy_seconds',
+    'Client head parsed until upstream EOF relayed (includes full '
+    'token streams).')
+_M_NO_REPLICA = metrics_lib.counter(
+    'skytpu_lb_no_replica_total',
+    'Requests answered 503: no ready replicas.')
+_M_UPSTREAM_ERRORS = metrics_lib.counter(
+    'skytpu_lb_upstream_errors_total',
+    'Requests answered 502: replica unreachable or dropped the '
+    'request before any response byte.')
+_M_DROPPED_TIMESTAMPS = metrics_lib.counter(
+    'skytpu_lb_dropped_request_timestamps_total',
+    'QPS samples dropped (oldest-first) because controller sync '
+    'kept failing.')
+_M_SYNC_FAILURES = metrics_lib.counter(
+    'skytpu_lb_controller_sync_failures_total',
+    'Controller sync attempts that failed.')
+
+_REQUEST_ID_KEY = tracing.REQUEST_ID_HEADER.lower()
+
+
+def _max_pending_timestamps() -> int:
+    """Cap on buffered QPS samples while controller sync is failing
+    (drop-oldest beyond it — the autoscaler signal degrades, the LB
+    process does not)."""
+    return int(os.environ.get('SKYTPU_LB_MAX_PENDING_TIMESTAMPS',
+                              '100000'))
 
 # Hop-by-hop headers never forwarded (RFC 9110 §7.6.1).  Content-Length
 # and Transfer-Encoding ARE forwarded: the body bytes pass through with
@@ -249,6 +289,9 @@ class SkyServeLoadBalancer:
         self.policy = policy or RoundRobinPolicy()
         self.ready_urls: List[str] = []
         self.request_timestamps: List[float] = []
+        self.dropped_timestamps = 0
+        self._sync_failures = 0       # consecutive; reset on success
+        self._next_failure_warn = 1   # exponential-backoff WARNING
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -268,8 +311,43 @@ class SkyServeLoadBalancer:
             urls = resp.json().get('ready_replica_urls', [])
             with self._lock:
                 self.ready_urls = urls
+                if self._sync_failures:
+                    logger.info(
+                        f'LB sync recovered after '
+                        f'{self._sync_failures} failed attempt(s)')
+                self._sync_failures = 0
+                self._next_failure_warn = 1
         except (requests.RequestException, ValueError) as e:
-            logger.warning(f'LB sync failed: {e}')
+            # The samples go back on the (bounded) buffer so a
+            # transient controller outage doesn't lose the QPS signal.
+            with self._lock:
+                self.request_timestamps = (timestamps +
+                                           self.request_timestamps)
+                self._trim_timestamps_locked()
+                self._sync_failures += 1
+                failures = self._sync_failures
+                warn = failures >= self._next_failure_warn
+                if warn:
+                    self._next_failure_warn = max(
+                        2, self._next_failure_warn * 2)
+            _M_SYNC_FAILURES.inc()
+            # WARNING with exponential backoff (attempt 1, 2, 4, 8,
+            # ...), DEBUG otherwise: a controller that is down for an
+            # hour must not emit 180 identical warnings.
+            if warn:
+                logger.warning(
+                    f'LB sync failed ({failures} consecutive): {e}')
+            else:
+                logger.debug(f'LB sync failed ({failures}): {e}')
+
+    def _trim_timestamps_locked(self) -> None:
+        """Drop-oldest beyond the cap (call with self._lock held)."""
+        cap = _max_pending_timestamps()
+        overflow = len(self.request_timestamps) - cap
+        if overflow > 0:
+            del self.request_timestamps[:overflow]
+            self.dropped_timestamps += overflow
+            _M_DROPPED_TIMESTAMPS.inc(overflow)
 
     def _sync_loop(self) -> None:
         while not self._stop.is_set():
@@ -284,11 +362,16 @@ class SkyServeLoadBalancer:
         try:
             head = await asyncio.wait_for(_read_head(reader), timeout=60)
             start_line, headers = _parse_head(head)
+            t_start = time.perf_counter()
             with self._lock:
                 self.request_timestamps.append(time.time())
+                self._trim_timestamps_locked()
                 urls = list(self.ready_urls)
             target = self.policy.select(urls)
+            _M_REQUESTS.labels(policy=getattr(
+                self.policy, 'NAME', type(self.policy).__name__)).inc()
             if target is None:
+                _M_NO_REPLICA.inc()
                 writer.write(_simple_response(
                     503, 'Service Unavailable', b'No ready replicas.'))
                 await writer.drain()
@@ -297,10 +380,14 @@ class SkyServeLoadBalancer:
             # framing, disconnects mid-stream) or in-flight counts leak
             # and least_connections starves the replica forever.
             self.policy.acquire(target)
+            inflight = _M_UPSTREAM_INFLIGHT.labels(upstream=target)
+            inflight.inc()
             try:
                 await self._proxy_to(target, reader, writer, start_line,
                                      headers)
+                _M_PROXY_LATENCY.observe(time.perf_counter() - t_start)
             finally:
+                inflight.dec()
                 self.policy.release(target)
         except _HeadTooLarge:
             try:
@@ -312,6 +399,7 @@ class SkyServeLoadBalancer:
                 pass
         except _UpstreamError as e:
             # No response byte was relayed yet — a 502 is still clean.
+            _M_UPSTREAM_ERRORS.inc()
             try:
                 writer.write(_simple_response(
                     502, 'Bad Gateway', f'Bad gateway: {e}'.encode()))
@@ -362,6 +450,14 @@ class SkyServeLoadBalancer:
             out.extend(f'{n}: {v}' for n, v in headers
                        if n.lower() not in _HOP_HEADERS and
                        n.lower() not in ('host', 'expect'))
+            # The LB is the outermost layer: requests without an
+            # X-SkyTPU-Request-Id get one here, so the replica's span
+            # records and the client's response header line up
+            # end to end.
+            if not any(n.lower() == _REQUEST_ID_KEY
+                       for n, _ in headers):
+                out.append(f'{tracing.REQUEST_ID_HEADER}: '
+                           f'{tracing.new_request_id()}')
             out.append(f'Host: {host}:{port}')
             out.append('Connection: close')
             try:

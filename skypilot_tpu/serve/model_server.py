@@ -9,7 +9,12 @@ also ships a native replica server wired to its own compute layer
             --port $SKYTPU_SERVE_REPLICA_PORT
 
 Endpoints:
-  GET  /                 -> health + engine stats (readiness probe)
+  GET  /                 -> health + engine stats (readiness probe;
+                            includes recent request spans)
+  GET  /metrics          -> Prometheus text exposition (observability/
+                            metrics.py process-global registry: engine
+                            ticks, decode tokens/s, queue-wait + TTFT +
+                            ITL histograms, admission rejections)
   POST /generate         -> {"prompt_ids": [[..]], "max_new_tokens": N,
                              "temperature": T, "top_k": K, "seed": S}
                             => {"tokens": [[..]], "latency_ms": ..}
@@ -46,6 +51,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import batching_engine as batching_engine_lib
 
 logger = sky_logging.init_logger(__name__)
@@ -209,9 +216,14 @@ class ModelServer:
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, top_k: int = 0,
-                 stop_token=None, seed: int = 0) -> Any:
+                 stop_token=None, seed: int = 0,
+                 request_id: Optional[str] = None) -> Any:
         """stop_token: None, a single id, or an iterable of ids (the
-        tokenizer's multi-EOS stop set)."""
+        tokenizer's multi-EOS stop set).
+
+        request_id: propagated X-SkyTPU-Request-Id; under continuous
+        batching it names the request's span record (multi-row batches
+        suffix `-1`, `-2`, ... on rows after the first)."""
         import jax
         import jax.numpy as jnp
 
@@ -237,8 +249,12 @@ class ModelServer:
                 self._engine.submit([int(t) for t in row],
                                     max_new_tokens,
                                     stop_token=stop_token,
-                                    sampling=sampling)
-                for row in prompt_ids
+                                    sampling=sampling,
+                                    request_id=(
+                                        None if request_id is None
+                                        else (request_id if i == 0 else
+                                              f'{request_id}-{i}')))
+                for i, row in enumerate(prompt_ids)
             ]
             return [r.result(timeout=600) for r in requests]
         with self._lock:
@@ -296,7 +312,25 @@ def _make_handler(server: ModelServer):
                     int(req.get('top_k', server.default_top_k)),
                     int(req.get('seed', server.default_seed)))
 
+        def _request_id(self) -> str:
+            """The propagated X-SkyTPU-Request-Id, or a fresh id when
+            this server is the outermost layer that saw the request."""
+            return (self.headers.get(tracing.REQUEST_ID_HEADER) or
+                    tracing.new_request_id())
+
         def do_GET(self):
+            if self.path == '/metrics':
+                engine = server._engine  # pylint: disable=protected-access
+                if engine is not None:
+                    engine.stats()  # freshen the scrape-time gauges
+                body = metrics_lib.expose().encode()
+                self.send_response(200)
+                self.send_header('Content-Type',
+                                 metrics_lib.CONTENT_TYPE)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             payload = {'status': 'ok',
                        'model': f'{server.cfg.d_model}x'
                                 f'{server.cfg.n_layers}'}
@@ -332,8 +366,9 @@ def _make_handler(server: ModelServer):
                 ids = tok.encode(text, add_bos=True)
                 if not ids:
                     raise ValueError('prompt tokenized to nothing')
+                rid = self._request_id()
                 if req.get('stream'):
-                    self._stream_text(tok, ids, req)
+                    self._stream_text(tok, ids, req, rid)
                     return
                 t0 = time.perf_counter()
                 # The engine stops AT the tokenizer's EOS (freeing the
@@ -343,7 +378,8 @@ def _make_handler(server: ModelServer):
                 tokens = server.generate(
                     [ids], int(req.get('max_new_tokens', 64)),
                     temperature, top_k,
-                    stop_token=tok.eos_ids or None, seed=seed)[0]
+                    stop_token=tok.eos_ids or None, seed=seed,
+                    request_id=rid)[0]
                 stops = [i for i, t in enumerate(tokens)
                          if t in tok.eos_ids]
                 if stops:
@@ -353,7 +389,7 @@ def _make_handler(server: ModelServer):
                     'tokens': tokens,
                     'latency_ms': round(
                         (time.perf_counter() - t0) * 1e3, 1),
-                })
+                }, {tracing.REQUEST_ID_HEADER: rid})
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as e:
                 self._reply(400, {'error': str(e)})
@@ -361,7 +397,7 @@ def _make_handler(server: ModelServer):
                 if not self._reply_backpressure(e):
                     self._reply(500, {'error': f'{type(e).__name__}: {e}'})
 
-        def _stream_text(self, tok, ids, req):
+        def _stream_text(self, tok, ids, req, rid):
             """SSE text deltas: data: {"text": "..."} per decode step
             (skipping steps buffered inside a multi-byte sequence),
             then data: [DONE].  Needs --continuous-batching."""
@@ -376,8 +412,9 @@ def _make_handler(server: ModelServer):
                 ids, int(req.get('max_new_tokens', 64)),
                 stop_token=tok.eos_ids or None,
                 sampling=decode.SamplingConfig(
-                    temperature=temperature, top_k=top_k, seed=seed))
-            self._start_sse()
+                    temperature=temperature, top_k=top_k, seed=seed),
+                request_id=rid)
+            self._start_sse(rid)
             decoder = StreamDecoder(tok)
             try:
                 for token in request.stream(timeout=600):
@@ -423,13 +460,15 @@ def _make_handler(server: ModelServer):
                     return
                 from skypilot_tpu.models import decode
                 temperature, top_k, seed = self._sampling(req)
+                rid = self._request_id()
                 request = server._engine.submit(  # pylint: disable=protected-access
                     [int(t) for t in prompt],
                     int(req.get('max_new_tokens', 16)),
                     stop_token=req.get('stop_token'),
                     sampling=decode.SamplingConfig(
                         temperature=temperature, top_k=top_k,
-                        seed=seed))
+                        seed=seed),
+                    request_id=rid)
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as e:
                 self._reply(400, {'error': str(e)})
@@ -442,7 +481,7 @@ def _make_handler(server: ModelServer):
                     self._reply(503,
                                 {'error': f'{type(e).__name__}: {e}'})
                 return
-            self._start_sse()
+            self._start_sse(rid)
             try:
                 for token in request.stream(timeout=600):
                     self._sse_chunk(json.dumps({'token': token}))
@@ -464,11 +503,13 @@ def _make_handler(server: ModelServer):
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass
 
-        def _start_sse(self) -> None:
+        def _start_sse(self, rid: Optional[str] = None) -> None:
             self.send_response(200)
             self.send_header('Content-Type', 'text/event-stream')
             self.send_header('Cache-Control', 'no-cache')
             self.send_header('Transfer-Encoding', 'chunked')
+            if rid is not None:
+                self.send_header(tracing.REQUEST_ID_HEADER, rid)
             self.end_headers()
 
         def _sse_chunk(self, data: str) -> None:
@@ -491,15 +532,16 @@ def _make_handler(server: ModelServer):
                 req = self._read_json()
                 t0 = time.perf_counter()
                 temperature, top_k, seed = self._sampling(req)
+                rid = self._request_id()
                 tokens = server.generate(
                     req['prompt_ids'],
                     int(req.get('max_new_tokens', 16)),
-                    temperature, top_k, seed=seed)
+                    temperature, top_k, seed=seed, request_id=rid)
                 self._reply(200, {
                     'tokens': tokens,
                     'latency_ms': round(
                         (time.perf_counter() - t0) * 1e3, 1),
-                })
+                }, {tracing.REQUEST_ID_HEADER: rid})
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as e:
                 self._reply(400, {'error': str(e)})

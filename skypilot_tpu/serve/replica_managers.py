@@ -20,6 +20,7 @@ import requests
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu import status_lib
+from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.utils import common_utils
@@ -29,6 +30,17 @@ if typing.TYPE_CHECKING:
     from skypilot_tpu.serve.service_spec import SkyServiceSpec
 
 logger = sky_logging.init_logger(__name__)
+
+# Controller-side fleet gauges (observability/metrics.py): replica
+# counts by status and the decode-load signal the autoscaler consumes.
+_M_REPLICAS = metrics_lib.gauge(
+    'skytpu_serve_replicas',
+    'Replicas per service by status (set each reconcile pass).',
+    ('service', 'status'))
+_M_REPLICA_LOAD = metrics_lib.gauge(
+    'skytpu_serve_replica_load_mean',
+    'Mean busy_slots/slots across ready replicas reporting engine '
+    'stats (the decode-saturation autoscaler signal).', ('service',))
 
 ENV_REPLICA_ID = 'SKYTPU_SERVE_REPLICA_ID'
 ENV_REPLICA_PORT = 'SKYTPU_SERVE_REPLICA_PORT'
@@ -205,6 +217,7 @@ class ReplicaManager:
     def sync(self) -> None:
         """One reconciliation pass: probe health, detect preemption,
         retire failed replicas."""
+        self._export_gauges()
         for replica in serve_state.get_replicas(self.service_name):
             status = ReplicaStatus(replica['status'])
             replica_id = replica['replica_id']
@@ -226,6 +239,26 @@ class ReplicaManager:
                 if global_user_state.get_cluster_from_name(
                         replica['cluster_name']) is not None:
                     self.scale_down(replica_id, final_status=status)
+
+    def _export_gauges(self) -> None:
+        """Fleet state -> registry gauges: every status gets set (not
+        just the ones present) so a drained status reads 0, not its
+        last value."""
+        records = serve_state.get_replicas(self.service_name)
+        by_status: Dict[str, int] = {}
+        for replica in records:
+            by_status[replica['status']] = (
+                by_status.get(replica['status'], 0) + 1)
+        for status in ReplicaStatus:
+            _M_REPLICAS.labels(
+                service=self.service_name, status=status.value).set(
+                    by_status.get(status.value, 0))
+        loads = self.ready_loads()
+        if loads:
+            _M_REPLICA_LOAD.labels(service=self.service_name).set(
+                sum(loads) / len(loads))
+        else:
+            _M_REPLICA_LOAD.labels(service=self.service_name).set(0.0)
 
     # ------------------------------------------------------------- counts
 

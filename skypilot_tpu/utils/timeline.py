@@ -3,6 +3,14 @@
 Parity: /root/reference/sky/utils/timeline.py:1-133 — `@timeline.event`
 decorated spans plus FileLock contention spans, dumped as a Chrome
 trace-event JSON when SKYTPU_TIMELINE_FILE is set.
+
+Enabling is no longer import-time-only: `start(path)` turns recording
+on programmatically, and `save_timeline()` re-checks the env var so a
+process that sets SKYTPU_TIMELINE_FILE after this module imported
+still gets its dump.  The serving request spans
+(observability/tracing.py) emit completed phases here via
+`add_complete_event`, so one chrome://tracing load shows control-plane
+spans and per-request queue/prefill/decode phases on a shared clock.
 """
 from __future__ import annotations
 
@@ -19,10 +27,39 @@ import filelock
 _events: List[dict] = []
 _events_lock = threading.Lock()
 _enabled_path: Optional[str] = None
+_atexit_registered = False
 
 
 def _now_us() -> int:
     return int(time.time() * 10**6)
+
+
+def _register_atexit_once() -> None:
+    global _atexit_registered
+    if not _atexit_registered:
+        _atexit_registered = True
+        atexit.register(save_timeline)
+
+
+def start(path: str) -> None:
+    """Enable recording to `path` (programmatic alternative to setting
+    SKYTPU_TIMELINE_FILE before import); registers the atexit dump
+    exactly once no matter how often enabling happens."""
+    global _enabled_path
+    _enabled_path = path
+    _register_atexit_once()
+
+
+def enabled() -> bool:
+    return _active_path() is not None
+
+
+def _active_path() -> Optional[str]:
+    """The dump path, honoring an env var set AFTER import (late
+    enabling was silently ignored before)."""
+    if _enabled_path is not None:
+        return _enabled_path
+    return os.environ.get('SKYTPU_TIMELINE_FILE')
 
 
 class Event:
@@ -39,7 +76,7 @@ class Event:
         self._record('E')
 
     def _record(self, phase: str) -> None:
-        if _enabled_path is None:
+        if _active_path() is None:
             return
         evt = {
             'name': self._name,
@@ -112,16 +149,42 @@ class FileLockEvent:
         self.release()
 
 
+def add_complete_event(name: str, start_s: float, duration_s: float,
+                       args: Optional[dict] = None,
+                       cat: str = 'request') -> None:
+    """Record an already-finished span ('X' complete event): `start_s`
+    is wall-clock seconds (time.time()), `duration_s` its length.  Used
+    by observability/tracing.py, whose phases are only known in
+    retrospect (queue wait ends when the engine admits the request)."""
+    if _active_path() is None:
+        return
+    evt = {
+        'name': name,
+        'cat': cat,
+        'ph': 'X',
+        'ts': int(start_s * 10**6),
+        'dur': max(0, int(duration_s * 10**6)),
+        'pid': os.getpid(),
+        'tid': threading.get_ident(),
+    }
+    if args:
+        evt['args'] = args
+    with _events_lock:
+        _events.append(evt)
+
+
 def save_timeline() -> None:
-    if _enabled_path is None or not _events:
+    # Re-check the env var: a path set after import (programmatic
+    # runs, tests) must still produce a dump.
+    path = _active_path()
+    if path is None or not _events:
         return
     with _events_lock:
         payload = {'traceEvents': list(_events)}
-    os.makedirs(os.path.dirname(os.path.abspath(_enabled_path)), exist_ok=True)
-    with open(_enabled_path, 'w', encoding='utf-8') as f:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
         json.dump(payload, f)
 
 
-_enabled_path = os.environ.get('SKYTPU_TIMELINE_FILE')
-if _enabled_path is not None:
-    atexit.register(save_timeline)
+if os.environ.get('SKYTPU_TIMELINE_FILE') is not None:
+    _register_atexit_once()

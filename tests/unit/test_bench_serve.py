@@ -41,6 +41,16 @@ def test_bench_serve_smoke():
     # on the saturating smoke workload (the PR's perf claim is >= 1.5x;
     # the smoke asserts a conservative floor so CI noise can't flake).
     assert data['speedup_vs_legacy'] >= 1.2, data
+    # Observability signal: the smoke scraped /metrics around the
+    # pipelined run; key engine counters must exist, be monotone, and
+    # have advanced (bench_serve itself raises when they don't).
+    scrape = data['metrics_scrape']
+    assert scrape['series_monotone'] is True
+    samples = scrape['samples']
+    assert len(samples) >= 2
+    assert samples[-1]['ticks'] > samples[0]['ticks']
+    assert samples[-1]['decode_tokens'] > samples[0]['decode_tokens']
+    assert all(s['histograms_present'] for s in samples)
     stall = data['chunked_prefill_stall']
     assert stall['max_itl_during_admission_ms'] > 0
     assert stall['chunk_compute_ms'] > 0
