@@ -36,12 +36,22 @@ import signal
 import subprocess
 import sys
 import threading
-from typing import Any, Dict, List
+import time
+from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import provision
+from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import events as events_lib
 from skypilot_tpu.skylet import constants
 from skypilot_tpu.skylet import job_lib
 from skypilot_tpu.skylet import log_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _journal(job_id: Optional[int]) -> Optional[events_lib.EventJournal]:
+    return (events_lib.cluster_job_journal(job_id)
+            if job_id is not None else None)
 
 
 def _spec_path(job_id: int) -> str:
@@ -91,22 +101,38 @@ def run_gang(job_id: int, spec: Dict[str, Any]) -> int:
     job_lib.set_status(job_id, job_lib.JobStatus.RUNNING)
     run_cmd = spec['run_cmd']
 
+    journal = _journal(job_id)
+    if journal is not None:
+        journal.append('gang_start', job_id=job_id,
+                       cluster=cluster_name, num_ranks=len(runners))
+    events_lib.gang_ranks_gauge().set(len(runners))
+
     returncodes = _run_gang_native(spec, runners, host_ips, log_dir,
-                                   run_cmd)
+                                   run_cmd, job_id=job_id)
     if returncodes is None:
         returncodes = _run_gang_python(runners, spec, host_ips, log_dir,
-                                       run_cmd)
+                                       run_cmd, job_id=job_id)
 
     ok = bool(returncodes) and all(rc == 0
                                    for rc in returncodes.values())
     status = (job_lib.JobStatus.SUCCEEDED if ok else job_lib.JobStatus.FAILED)
     job_lib.set_status(job_id, status)
     summary = {str(r): rc for r, rc in sorted(returncodes.items())}
-    print(f'gang finished: {json.dumps(summary)}', flush=True)
+    for rank, rc in sorted(returncodes.items()):
+        events_lib.gang_rank_exits().labels(code=str(rc)).inc()
+        if journal is not None:
+            journal.append('rank_exit', job_id=job_id, rank=rank,
+                           returncode=rc)
+    if journal is not None:
+        journal.append('gang_end', job_id=job_id,
+                       status='ok' if ok else 'fail',
+                       returncodes=summary)
+    logger.info(f'[job {job_id}] gang finished: {json.dumps(summary)}')
     return 0 if ok else 1
 
 
-def _run_gang_native(spec, runners, host_ips, log_dir, run_cmd):
+def _run_gang_native(spec, runners, host_ips, log_dir, run_cmd,
+                     job_id=None):
     """Supervise the gang with the C++ fan-in (one child per rank,
     line-multiplexed logs, fail-fast kill).  None → fall back."""
     from skypilot_tpu import native  # pylint: disable=import-outside-toplevel
@@ -114,6 +140,7 @@ def _run_gang_native(spec, runners, host_ips, log_dir, run_cmd):
     if binary is None:
         return None
     gang_tag = os.path.basename(log_dir.rstrip('/'))
+    journal = _journal(job_id)
     argvs, log_paths, pidfiles = [], [], []
     for rank, runner in enumerate(runners):
         env = _rank_env(spec, rank, host_ips)
@@ -129,6 +156,10 @@ def _run_gang_native(spec, runners, host_ips, log_dir, run_cmd):
                                       f'rank-{rank}.log'))
     spec_path = os.path.join(log_dir, 'fanin.spec')
     native.write_spec(spec_path, log_paths, argvs)
+    if journal is not None:
+        for rank in range(len(runners)):
+            journal.append('rank_start', job_id=job_id, rank=rank,
+                           supervisor='native')
     returncodes = native.run_fanin(binary, spec_path)
     if returncodes is not None and any(
             rc != 0 for rc in returncodes.values()):
@@ -158,7 +189,8 @@ def _sweep_remote_kills(runners, pidfiles) -> None:
         t.join(timeout=30)
 
 
-def _run_gang_python(runners, spec, host_ips, log_dir, run_cmd):
+def _run_gang_python(runners, spec, host_ips, log_dir, run_cmd,
+                     job_id=None):
     # Live transport processes by rank, so the first failure can kill
     # the survivors (fail-fast, matching the C++ fan-in and the
     # reference's get_or_fail :294-328) instead of leaving them blocked
@@ -166,6 +198,7 @@ def _run_gang_python(runners, spec, host_ips, log_dir, run_cmd):
     procs_lock = threading.Lock()
     procs: Dict[int, Any] = {}
     aborting = threading.Event()
+    journal = _journal(job_id)
     # Each rank records its remote PID so abort can kill the REMOTE
     # process tree: SIGTERMing the local ssh/kubectl client alone never
     # signals the far side (no tty; ControlMaster keeps the TCP up).
@@ -180,6 +213,9 @@ def _run_gang_python(runners, spec, host_ips, log_dir, run_cmd):
         exports = log_lib.make_task_bash_script(run_cmd, env,
                                                 pidfile=_pidfile(rank))
         log_path = os.path.join(log_dir, 'tasks', f'rank-{rank}.log')
+        if journal is not None:
+            journal.append('rank_start', job_id=job_id, rank=rank,
+                           supervisor='python')
 
         def _register(proc):
             with procs_lock:
@@ -200,8 +236,10 @@ def _run_gang_python(runners, spec, host_ips, log_dir, run_cmd):
                        if r != failed and p.poll() is None]
         if not victims:
             return
-        print(f'rank {failed} failed: terminating ranks '
-              f'{sorted(r for r, _ in victims)}', flush=True)
+        victim_ranks = sorted(r for r, _ in victims)
+        logger.warning(f'[job {job_id}] rank {failed} failed: '
+                       f'terminating ranks {victim_ranks}')
+        t0 = time.monotonic()
         # Remote + local kills fan out in parallel; SIGKILL escalation
         # shares one deadline rather than 5s per rank.
         kill_threads = [
@@ -214,6 +252,12 @@ def _run_gang_python(runners, spec, host_ips, log_dir, run_cmd):
             t.start()
         for t in kill_threads:
             t.join(timeout=30)
+        abort_s = time.monotonic() - t0
+        events_lib.gang_abort_hist().observe(abort_s)
+        if journal is not None:
+            journal.append('gang_abort', job_id=job_id,
+                           failed_rank=failed, victims=victim_ranks,
+                           duration_s=round(abort_s, 6))
 
     # Rank 0's log additionally mirrors to run.log for `sky logs` tailing.
     returncodes: Dict[int, int] = {}
@@ -232,7 +276,8 @@ def _run_gang_python(runners, spec, host_ips, log_dir, run_cmd):
             try:
                 rc = fut.result()
             except Exception as e:  # pylint: disable=broad-except
-                print(f'rank {rank} supervisor error: {e}', flush=True)
+                logger.error(f'[job {job_id}] rank {rank} supervisor '
+                             f'error: {e}')
                 rc = 255
             returncodes[rank] = rc
             if rc != 0 and failed_rank < 0 and not aborting.is_set():

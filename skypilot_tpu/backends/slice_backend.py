@@ -35,6 +35,7 @@ from skypilot_tpu import status_lib
 from skypilot_tpu.backends import backend as backend_lib
 from skypilot_tpu.clouds import cloud as cloud_lib
 from skypilot_tpu.clouds import registry
+from skypilot_tpu.observability import events as events_lib
 from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu.provision import provisioner as provisioner_lib
 from skypilot_tpu.resources import Resources
@@ -124,6 +125,7 @@ class RetryingProvisioner:
     ) -> Tuple[provision_common.ProvisionRecord, Resources]:
         """Try the chosen launchable; fail over across zones/regions/
         candidates until something provisions (parity reference :1934)."""
+        journal = events_lib.cluster_journal(self._cluster_name)
         candidate = to_provision
         while True:
             result = self._try_candidate(candidate)
@@ -134,12 +136,19 @@ class RetryingProvisioner:
                 launchables = optimizer_lib.Optimizer.enumerate_launchables(
                     self._task, blocked_resources=self._blocked)
             except exceptions.ResourcesUnavailableError as e:
+                journal.append(
+                    'provision_exhausted',
+                    attempts=len(self._failover_history),
+                    history=[type(x).__name__
+                             for x in self._failover_history])
                 raise exceptions.ResourcesUnavailableError(
                     f'Failed to provision {self._cluster_name} on all '
                     f'feasible resources. Attempts: '
                     f'{[str(x) for x in self._failover_history]}',
                     failover_history=self._failover_history) from e
             candidate = launchables[0][0]
+            journal.append('provision_failover_candidate',
+                           candidate=repr(candidate))
             logger.info(f'Failing over to next candidate: {candidate!r}')
 
     def _try_candidate(
@@ -147,6 +156,8 @@ class RetryingProvisioner:
     ) -> Optional[Tuple[provision_common.ProvisionRecord, Resources]]:
         cloud = resources.cloud
         assert cloud is not None, resources
+        journal = events_lib.cluster_journal(self._cluster_name)
+        cloud_name = str(getattr(cloud, 'PROVISIONER', cloud))
         for region, zones in cloud.zones_provision_loop(
                 resources, region=resources.region):
             zone_names = [z.name for z in (zones or [])]
@@ -156,12 +167,32 @@ class RetryingProvisioner:
                     continue
             for zone_name in (zone_names or [None]):
                 attempt = resources.copy(region=region.name, zone=zone_name)
+                events_lib.provision_attempts().labels(
+                    cloud=cloud_name).inc()
+                journal.append('provision_attempt_start',
+                               cloud=cloud_name, region=region.name,
+                               zone=zone_name or '-')
+                t0 = time.monotonic()
                 try:
                     record = self._provision_once(cloud, attempt, region,
                                                   zone_name)
+                    journal.append(
+                        'provision_attempt_end', status='ok',
+                        cloud=cloud_name, region=region.name,
+                        zone=zone_name or '-',
+                        duration_s=round(time.monotonic() - t0, 6))
                     return record, attempt
                 except (exceptions.ProvisionError,
                         exceptions.ResourcesUnavailableError) as e:
+                    reason = type(e).__name__
+                    journal.append(
+                        'provision_attempt_end', status='fail',
+                        cloud=cloud_name, region=region.name,
+                        zone=zone_name or '-', reason=reason,
+                        error=str(e)[:500],
+                        duration_s=round(time.monotonic() - t0, 6))
+                    events_lib.provision_failovers().labels(
+                        reason=reason).inc()
                     logger.warning(
                         f'Provision attempt failed in {region.name}/'
                         f'{zone_name}: {e}')

@@ -31,7 +31,10 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import metrics as metrics_lib
+
+logger = sky_logging.init_logger(__name__)
 
 ENV_LOG_DIR = 'SKYTPU_BENCHMARK_LOG_DIR'
 ENV_PROFILE_DIR = 'SKYTPU_JAX_PROFILE_DIR'
@@ -93,8 +96,8 @@ class SkyTpuCallback:
             jax.profiler.start_trace(os.path.expanduser(profile_dir))
             atexit.register(jax.profiler.stop_trace)
         except Exception as e:  # pylint: disable=broad-except
-            print(f'skytpu callback: jax.profiler trace not started '
-                  f'({type(e).__name__}: {e})')
+            logger.warning(f'skytpu callback: jax.profiler trace not '
+                           f'started ({type(e).__name__}: {e})')
 
     def on_step_begin(self) -> None:
         with self._lock:

@@ -292,11 +292,23 @@ def exec_cmd(cluster, entrypoint, detach_run, **task_args):
               help='Re-query live cluster status from the provider.')
 @click.option('--verbose', '-v', is_flag=True, default=False,
               help='Show the last launch stage-runtime decomposition.')
+@click.option('--events', 'show_events', is_flag=True, default=False,
+              help='Print the control-plane event timeline (flight '
+                   'recorder) for the given cluster(s).')
+@click.option('--export-trace', 'export_trace', default=None,
+              help='With --events: also write the events as a '
+                   'Chrome-trace JSON to this path.')
 @click.argument('clusters', nargs=-1, shell_complete=_complete_cluster_name)
-def status(refresh, verbose, clusters):
+def status(refresh, verbose, show_events, export_trace, clusters):
     """Show clusters."""
     from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
     from skypilot_tpu import usage_lib  # pylint: disable=import-outside-toplevel
+    if show_events:
+        if not clusters:
+            raise click.UsageError(
+                'status --events requires at least one cluster name.')
+        _print_cluster_events(list(clusters), export_trace)
+        return
     records = core.status(cluster_names=list(clusters) or None,
                           refresh=refresh)
     if not records:
@@ -322,6 +334,28 @@ def status(refresh, verbose, clusters):
                 click.echo(f'\n{r["name"]}: '
                            + usage_lib.format_decomposition(
                                r['last_launch']))
+
+
+def _print_cluster_events(clusters: List[str],
+                          export_trace: Optional[str]) -> None:
+    """`status --events`: render each cluster's flight-recorder journal
+    as a readable timeline (and optionally a Chrome trace)."""
+    from skypilot_tpu.observability import events as events_lib  # pylint: disable=import-outside-toplevel
+    all_events = []
+    for name in clusters:
+        events = events_lib.cluster_events(name)
+        if not events:
+            click.echo(f'{name}: no recorded events.')
+            continue
+        click.echo(f'Events for cluster {name} '
+                   f'({len(events)} recorded):')
+        for line in events_lib.format_timeline(events):
+            click.echo(f'  {line}')
+        all_events.extend(events)
+    if export_trace and all_events:
+        events_lib.export_chrome_trace(all_events, export_trace)
+        click.echo(f'Chrome trace written to {export_trace} '
+                   '(open in chrome://tracing or Perfetto).')
 
 
 def _print_table(headers: List[str], rows: List[tuple]) -> None:
@@ -579,9 +613,43 @@ def jobs_queue():
     """List managed jobs."""
     from skypilot_tpu import jobs  # pylint: disable=import-outside-toplevel
     records = jobs.queue()
-    rows = [(r['job_id'], r['task_id'], r['job_name'], r['status'],
-             r['recovery_count']) for r in records]
-    _print_table(['ID', 'TASK', 'NAME', 'STATUS', 'RECOVERIES'], rows)
+    rows = []
+    for r in records:
+        # WHY the job is (or last was) recovering, not just that it is.
+        reason = r.get('last_recovery_reason') or r.get(
+            'failure_reason') or '-'
+        rows.append((r['job_id'], r['task_id'], r['job_name'],
+                     r['status'], r['recovery_count'],
+                     common_utils.truncate_long_string(str(reason), 48)))
+    _print_table(['ID', 'TASK', 'NAME', 'STATUS', 'RECOVERIES',
+                  'REASON'], rows)
+
+
+@jobs_group.command(name='events')
+@click.argument('job_id', type=int)
+@click.option('--export-trace', 'export_trace', default=None,
+              help='Also write the events as a Chrome-trace JSON to '
+                   'this path.')
+def jobs_events(job_id, export_trace):
+    """Show a managed job's control-plane event timeline.
+
+    The flight recorder journals every launch attempt, preemption
+    detection, and recovery span the controller performed for this job;
+    this renders them as a timeline (post-mortemable after the
+    controller exits)."""
+    from skypilot_tpu.observability import events as events_lib  # pylint: disable=import-outside-toplevel
+    events = events_lib.job_events(job_id)
+    if not events:
+        click.echo(f'Managed job {job_id}: no recorded events.')
+        return
+    click.echo(f'Events for managed job {job_id} '
+               f'({len(events)} recorded):')
+    for line in events_lib.format_timeline(events):
+        click.echo(f'  {line}')
+    if export_trace:
+        events_lib.export_chrome_trace(events, export_trace)
+        click.echo(f'Chrome trace written to {export_trace} '
+                   '(open in chrome://tracing or Perfetto).')
 
 
 @jobs_group.command(name='cancel')

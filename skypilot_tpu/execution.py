@@ -72,17 +72,27 @@ def _execute(
 
     # Stage-runtime decomposition: time-to-first-step is the north-star
     # denominator (BASELINE.md); every invocation records where its
-    # wall-clock went (usage_lib; surfaced by `sky status`).
+    # wall-clock went (usage_lib; surfaced by `sky status`), and every
+    # stage is journaled into the cluster's flight recorder
+    # (observability/events.py; surfaced by `sky status --events`).
     from skypilot_tpu import usage_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.observability import events as events_lib  # pylint: disable=import-outside-toplevel
     from skypilot_tpu.utils import rich_utils  # pylint: disable=import-outside-toplevel
-    run_rec = usage_lib.RunRecord(
-        'launch' if Stage.PROVISION in stages else 'exec', cluster_name)
+    entrypoint_name = 'launch' if Stage.PROVISION in stages else 'exec'
+    run_rec = usage_lib.RunRecord(entrypoint_name, cluster_name)
+    journal = events_lib.cluster_journal(cluster_name)
+    journal.append(f'{entrypoint_name}_start', task=task.name,
+                   dryrun=dryrun)
+    job_id: Optional[int] = None
+    final_status = 'ok'
     try:
         to_provision: Optional[Resources] = None
         if Stage.OPTIMIZE in stages:
-            with run_rec.stage('optimize'), rich_utils.safe_status(
-                    'Optimizing resource placement',
-                    enabled=not stream_logs):
+            with run_rec.stage('optimize'), \
+                    events_lib.ControlSpan(journal, 'optimize'), \
+                    rich_utils.safe_status(
+                        'Optimizing resource placement',
+                        enabled=not stream_logs):
                 existing = backend.check_existing_cluster(cluster_name,
                                                           task)
                 if existing is None:
@@ -93,9 +103,11 @@ def _execute(
 
         handle = None
         if Stage.PROVISION in stages:
-            with run_rec.stage('provision'), rich_utils.safe_status(
-                    f'Launching cluster {cluster_name}',
-                    enabled=not stream_logs):
+            with run_rec.stage('provision'), \
+                    events_lib.ControlSpan(journal, 'provision'), \
+                    rich_utils.safe_status(
+                        f'Launching cluster {cluster_name}',
+                        enabled=not stream_logs):
                 handle = backend.provision(task, to_provision,
                                            dryrun=dryrun,
                                            stream_logs=stream_logs,
@@ -108,21 +120,27 @@ def _execute(
             handle = backend_utils.check_cluster_available(cluster_name)
 
         if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
-            with run_rec.stage('sync_workdir'), rich_utils.safe_status(
-                    'Syncing workdir', enabled=not stream_logs):
+            with run_rec.stage('sync_workdir'), \
+                    events_lib.ControlSpan(journal, 'sync_workdir'), \
+                    rich_utils.safe_status('Syncing workdir',
+                                           enabled=not stream_logs):
                 backend.sync_workdir(handle, task.workdir)
 
         if Stage.SYNC_FILE_MOUNTS in stages:
             if task.file_mounts or task.storage_mounts:
                 with run_rec.stage('sync_file_mounts'), \
+                        events_lib.ControlSpan(journal,
+                                               'sync_file_mounts'), \
                         rich_utils.safe_status('Syncing file mounts',
                                                enabled=not stream_logs):
                     backend.sync_file_mounts(handle, task.file_mounts,
                                              task.storage_mounts)
 
         if Stage.SETUP in stages and not no_setup:
-            with run_rec.stage('setup'), rich_utils.safe_status(
-                    'Running setup', enabled=not stream_logs):
+            with run_rec.stage('setup'), \
+                    events_lib.ControlSpan(journal, 'setup'), \
+                    rich_utils.safe_status('Running setup',
+                                           enabled=not stream_logs):
                 backend.setup(handle, task)
 
         if Stage.PRE_EXEC in stages:
@@ -131,22 +149,29 @@ def _execute(
                     backend.set_autostop(handle, idle_minutes_to_autostop,
                                          down)
 
-        job_id = None
         if Stage.EXEC in stages:
             # exec_submit covers handing the job to the cluster, not
             # the job's own runtime (that is the job's, not ours).
             with run_rec.stage('exec_submit'), \
+                    events_lib.ControlSpan(journal, 'exec') as span, \
                     rich_utils.safe_status('Submitting job',
                                            enabled=not stream_logs):
                 job_id = backend.execute(handle, task,
                                          detach_run=detach_run)
+                span.add(job_id=job_id)
 
         if (Stage.DOWN in stages and down and
                 idle_minutes_to_autostop is None):
             backend.teardown(handle, terminate=True)
         return job_id
+    except BaseException as e:  # noqa: B036 — re-raised below
+        final_status = type(e).__name__
+        raise
     finally:
         run_rec.finalize()
+        journal.append(
+            f'{entrypoint_name}_end', status=final_status, job_id=job_id,
+            time_to_first_step_s=run_rec.time_to_first_step)
 
 
 def _requested_features(task: task_lib.Task, down: bool,

@@ -25,6 +25,7 @@ from skypilot_tpu import status_lib
 from skypilot_tpu.jobs import constants
 from skypilot_tpu.jobs import recovery_strategy
 from skypilot_tpu.jobs import state
+from skypilot_tpu.observability import events as events_lib
 from skypilot_tpu.skylet import job_lib
 from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import dag_utils
@@ -95,10 +96,13 @@ class JobsController:
         """Returns True iff the task SUCCEEDED."""
         job_id = self.job_id
         cluster_name = self._cluster_name(task_id, task)
+        journal = events_lib.job_journal(job_id)
         state.set_cluster_name(job_id, task_id, cluster_name)
         state.set_status(job_id, task_id, state.ManagedJobStatus.STARTING)
+        journal.append('task_start', job_id=job_id, task_id=task_id,
+                       task=task.name, cluster=cluster_name)
         strategy = recovery_strategy.StrategyExecutor.make(
-            cluster_name, task)
+            cluster_name, task, job_id=job_id, task_id=task_id)
         try:
             remote_job_id = strategy.launch()
         except exceptions.ResourcesUnavailableError as e:
@@ -121,6 +125,9 @@ class JobsController:
             if job_status is job_lib.JobStatus.SUCCEEDED:
                 state.set_status(job_id, task_id,
                                  state.ManagedJobStatus.SUCCEEDED)
+                journal.append('task_end', job_id=job_id,
+                               task_id=task_id, status='SUCCEEDED',
+                               recoveries=strategy.recovery_attempts)
                 strategy.cleanup_cluster()
                 return True
             if job_status in (job_lib.JobStatus.FAILED,
@@ -134,7 +141,11 @@ class JobsController:
                         f'user failure; restart '
                         f'{strategy.restart_count_on_errors}/'
                         f'{strategy.max_restarts_on_errors}')
-                    state.set_recovering(job_id, task_id)
+                    state.set_recovering(
+                        job_id, task_id,
+                        reason=f'user code failed; restart '
+                               f'{strategy.restart_count_on_errors}/'
+                               f'{strategy.max_restarts_on_errors}')
                     remote_job_id = strategy.recover()
                     state.set_status(job_id, task_id,
                                      state.ManagedJobStatus.RUNNING)
@@ -146,6 +157,10 @@ class JobsController:
                 state.set_status(
                     job_id, task_id, failed_status,
                     failure_reason='user code exited non-zero')
+                journal.append('task_end', job_id=job_id,
+                               task_id=task_id,
+                               status=failed_status.value,
+                               recoveries=strategy.recovery_attempts)
                 strategy.cleanup_cluster()
                 return False
             if job_status is job_lib.JobStatus.CANCELLED:
@@ -159,10 +174,19 @@ class JobsController:
                 # controller.py:195-340 anomaly path).
                 cluster_status = self._query_cluster_status(cluster_name)
                 if cluster_status is not status_lib.ClusterStatus.UP:
+                    status_str = (cluster_status.value
+                                  if cluster_status is not None
+                                  else 'gone')
+                    reason = (f'cluster {cluster_name} preempted/lost '
+                              f'(status: {status_str})')
                     logger.info(
                         f'cluster {cluster_name} is '
                         f'{cluster_status}; recovering')
-                    state.set_recovering(job_id, task_id)
+                    events_lib.jobs_preemptions().inc()
+                    journal.append('preemption_detected', job_id=job_id,
+                                   task_id=task_id, cluster=cluster_name,
+                                   cluster_status=status_str)
+                    state.set_recovering(job_id, task_id, reason=reason)
                     try:
                         remote_job_id = strategy.recover()
                     except exceptions.ResourcesUnavailableError as e:

@@ -17,6 +17,7 @@ from typing import Dict, Optional, Type
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu.jobs import constants
+from skypilot_tpu.observability import events as events_lib
 from skypilot_tpu.utils import common_utils
 
 if typing.TYPE_CHECKING:
@@ -50,16 +51,24 @@ class StrategyExecutor:
 
     def __init__(self, cluster_name: str, task: 'task_lib.Task',
                  retry_until_up: bool = True,
-                 max_restarts_on_errors: int = 0) -> None:
+                 max_restarts_on_errors: int = 0,
+                 job_id: Optional[int] = None,
+                 task_id: int = 0) -> None:
         self.cluster_name = cluster_name
         self.task = task
         self.retry_until_up = retry_until_up
         self.max_restarts_on_errors = max_restarts_on_errors
         self.restart_count_on_errors = 0
+        # Managed-job identity for the flight recorder; None when the
+        # executor is used outside a managed job (journaling off).
+        self.job_id = job_id
+        self.task_id = task_id
+        self.recovery_attempts = 0
 
     @classmethod
-    def make(cls, cluster_name: str,
-             task: 'task_lib.Task') -> 'StrategyExecutor':
+    def make(cls, cluster_name: str, task: 'task_lib.Task',
+             job_id: Optional[int] = None,
+             task_id: int = 0) -> 'StrategyExecutor':
         """Pick the strategy from the task's resources.job_recovery."""
         names = set()
         for resources in task.resources:
@@ -75,7 +84,13 @@ class StrategyExecutor:
             raise exceptions.InvalidTaskError(
                 f'Unknown job_recovery strategy {name!r}; have '
                 f'{sorted(RECOVERY_STRATEGIES)}')
-        return RECOVERY_STRATEGIES[name](cluster_name, task)
+        return RECOVERY_STRATEGIES[name](cluster_name, task,
+                                         job_id=job_id, task_id=task_id)
+
+    def _journal(self) -> Optional['events_lib.EventJournal']:
+        if self.job_id is None:
+            return None
+        return events_lib.job_journal(self.job_id)
 
     # ------------------------------------------------------------ launch
 
@@ -84,7 +99,44 @@ class StrategyExecutor:
         return self._launch(prefer_same_region=False)
 
     def recover(self) -> Optional[int]:
-        """Tear down broken capacity, then relaunch per strategy."""
+        """Tear down broken capacity, then relaunch per strategy.
+
+        Template method: journals the recovery attempt (start/end with
+        duration + status) and feeds `skytpu_jobs_recovery_seconds`;
+        the strategy-specific relaunch policy lives in `_do_recover`.
+        """
+        self.recovery_attempts += 1
+        journal = self._journal()
+        t0 = time.monotonic()
+        if journal is not None:
+            journal.append('recovery_start', job_id=self.job_id,
+                           task_id=self.task_id,
+                           attempt=self.recovery_attempts,
+                           strategy=self.NAME,
+                           cluster=self.cluster_name)
+        try:
+            remote_job_id = self._do_recover()
+        except Exception as e:
+            if journal is not None:
+                journal.append(
+                    'recovery_end', job_id=self.job_id,
+                    task_id=self.task_id,
+                    attempt=self.recovery_attempts, status=type(e).__name__,
+                    error=str(e)[:500],
+                    duration_s=round(time.monotonic() - t0, 6))
+            raise
+        duration = time.monotonic() - t0
+        events_lib.jobs_recovery_hist().observe(duration)
+        if journal is not None:
+            journal.append('recovery_end', job_id=self.job_id,
+                           task_id=self.task_id,
+                           attempt=self.recovery_attempts, status='ok',
+                           remote_job_id=remote_job_id,
+                           duration_s=round(duration, 6))
+        return remote_job_id
+
+    def _do_recover(self) -> Optional[int]:
+        """Strategy-specific relaunch policy."""
         raise NotImplementedError
 
     def cleanup_cluster(self) -> None:
@@ -104,6 +156,7 @@ class StrategyExecutor:
                 raise_on_failure: bool = True) -> Optional[int]:
         from skypilot_tpu import execution  # pylint: disable=import-outside-toplevel
         del prefer_same_region  # used by subclasses via task mutation
+        journal = self._journal()
         backoff = common_utils.Backoff(_RETRY_GAP_SECONDS)
         for attempt in range(_MAX_LAUNCH_RETRY):
             try:
@@ -111,8 +164,19 @@ class StrategyExecutor:
                     self.task, cluster_name=self.cluster_name,
                     stream_logs=False, detach_run=True,
                     retry_until_up=self.retry_until_up)
+                if journal is not None:
+                    journal.append('launch_attempt', job_id=self.job_id,
+                                   task_id=self.task_id,
+                                   attempt=attempt + 1, status='ok',
+                                   cluster=self.cluster_name)
                 return job_id
             except exceptions.ResourcesUnavailableError as e:
+                if journal is not None:
+                    journal.append('launch_attempt', job_id=self.job_id,
+                                   task_id=self.task_id,
+                                   attempt=attempt + 1, status='fail',
+                                   cluster=self.cluster_name,
+                                   error=str(e)[:500])
                 if raise_on_failure and attempt == _MAX_LAUNCH_RETRY - 1:
                     raise
                 logger.info(f'launch attempt {attempt + 1} failed: '
@@ -127,7 +191,7 @@ class EagerNextRegionStrategy(StrategyExecutor):
     preempting region is likely still capacity-starved).  Default —
     parity: reference recovery_strategy.py:483."""
 
-    def recover(self) -> Optional[int]:
+    def _do_recover(self) -> Optional[int]:
         self.cleanup_cluster()
         # Drop any region/zone pinning learned from the previous launch
         # so the optimizer searches the full space again.
@@ -140,7 +204,7 @@ class FailoverStrategy(StrategyExecutor):
     then fall back to the full search.  Parity: reference
     recovery_strategy.py:395."""
 
-    def recover(self) -> Optional[int]:
+    def _do_recover(self) -> Optional[int]:
         self.cleanup_cluster()
         job_id = self._launch(prefer_same_region=True,
                               raise_on_failure=False)

@@ -76,6 +76,7 @@ CREATE TABLE IF NOT EXISTS managed_jobs (
     end_at REAL,
     last_recovered_at REAL DEFAULT -1,
     recovery_count INTEGER DEFAULT 0,
+    last_recovery_reason TEXT,
     failure_reason TEXT,
     cluster_name TEXT,
     run_timestamp TEXT,
@@ -96,7 +97,18 @@ def _db_path() -> str:
 def _conn() -> sqlite3.Connection:
     conn = sqlite3.connect(_db_path(), timeout=10)
     conn.execute(_CREATE)
+    _migrate(conn)
     return conn
+
+
+def _migrate(conn: sqlite3.Connection) -> None:
+    """Additive schema upgrades for DBs created before a column existed
+    (CREATE IF NOT EXISTS never alters an existing table)."""
+    cols = {row[1] for row in
+            conn.execute('PRAGMA table_info(managed_jobs)')}
+    if 'last_recovery_reason' not in cols:
+        conn.execute('ALTER TABLE managed_jobs '
+                     'ADD COLUMN last_recovery_reason TEXT')
 
 
 def allocate_job_id(job_name: str) -> int:
@@ -148,14 +160,22 @@ def set_status(job_id: int, task_id: int, status: ManagedJobStatus,
             'WHERE job_id=? AND task_id=?', vals)
 
 
-def set_recovering(job_id: int, task_id: int) -> None:
+def set_recovering(job_id: int, task_id: int,
+                   reason: Optional[str] = None) -> None:
+    """Mark RECOVERING; `reason` persists WHY (preemption, user-code
+    restart, …) so `jobs queue` can show it, not just that recovery is
+    happening.  The attempt count is the incremented recovery_count."""
+    sets = ['status=?', 'recovery_count=recovery_count+1',
+            'last_recovered_at=?']
+    vals: List[Any] = [ManagedJobStatus.RECOVERING.value, time.time()]
+    if reason is not None:
+        sets.append('last_recovery_reason=?')
+        vals.append(reason)
+    vals += [job_id, task_id]
     with _conn() as conn:
         conn.execute(
-            'UPDATE managed_jobs SET status=?, recovery_count='
-            'recovery_count+1, last_recovered_at=? '
-            'WHERE job_id=? AND task_id=?',
-            (ManagedJobStatus.RECOVERING.value, time.time(), job_id,
-             task_id))
+            f'UPDATE managed_jobs SET {", ".join(sets)} '
+            'WHERE job_id=? AND task_id=?', vals)
 
 
 def set_cluster_name(job_id: int, task_id: int,

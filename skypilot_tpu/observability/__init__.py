@@ -12,11 +12,16 @@ telemetry):
   wait, prefill, TTFT, ITL, total decode) propagated load_balancer →
   server → batching-engine slot via the `X-SkyTPU-Request-Id` header,
   and emitted into the Chrome-trace timeline (utils/timeline.py).
+- `events`: the control-plane flight recorder — per-cluster / per-job
+  JSONL event journals, `ControlSpan` phase spans over the launch and
+  recovery paths, and the `skytpu_provision_* / skytpu_gang_* /
+  skytpu_skylet_* / skytpu_jobs_*` fleet-health series.
 
-See docs/observability.md for the metrics catalog and the request-id
-propagation diagram.
+See docs/observability.md for the metrics catalog, the request-id
+propagation diagram, and the control-plane event schema.
 """
+from skypilot_tpu.observability import events
 from skypilot_tpu.observability import metrics
 from skypilot_tpu.observability import tracing
 
-__all__ = ['metrics', 'tracing']
+__all__ = ['events', 'metrics', 'tracing']

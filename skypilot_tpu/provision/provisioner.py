@@ -15,6 +15,7 @@ from typing import Dict, List, Optional
 from skypilot_tpu import exceptions
 from skypilot_tpu import provision
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import events as events_lib
 from skypilot_tpu.provision import common
 from skypilot_tpu.provision import instance_setup
 from skypilot_tpu.utils import command_runner as command_runner_lib
@@ -35,6 +36,10 @@ def bulk_provision(config: common.ProvisionConfig) -> common.ProvisionRecord:
                  f'({config.region}/{config.zones})')
     record = provision.run_instances(provider, config)
     if record.waiting:
+        events_lib.cluster_journal(config.cluster_name).append(
+            'queued_resource_submitted', provider=provider,
+            region=config.region,
+            queued_resource_id=record.queued_resource_id)
         logger.info(
             f'Cluster {config.cluster_name}: queued-resource request '
             f'{record.queued_resource_id} submitted; capacity pending.')
@@ -45,14 +50,35 @@ def bulk_provision(config: common.ProvisionConfig) -> common.ProvisionRecord:
 
 def wait_for_queued_capacity(provider: str, cluster_name: str,
                              timeout: float) -> bool:
-    """Poll an async capacity request until granted or timeout."""
+    """Poll an async capacity request until granted or timeout.
+
+    Every poll is journaled (wait progress is THE question during a
+    multi-hour queued-resource wait) and the final wait lands in the
+    `skytpu_provision_wait_seconds` histogram either way.
+    """
+    journal = events_lib.cluster_journal(cluster_name)
+    journal.append('queued_wait_start', provider=provider,
+                   timeout_s=timeout)
+    start = time.monotonic()
     deadline = time.time() + timeout
     interval = 10.0
+    polls = 0
     while True:
-        if provision.wait_capacity(provider, cluster_name):
+        granted = provision.wait_capacity(provider, cluster_name)
+        polls += 1
+        waited = time.monotonic() - start
+        if granted:
+            journal.append('queued_wait_end', status='granted',
+                           wait_s=round(waited, 3), polls=polls)
+            events_lib.provision_wait_hist().observe(waited)
             return True
         if time.time() >= deadline:
+            journal.append('queued_wait_end', status='timeout',
+                           wait_s=round(waited, 3), polls=polls)
+            events_lib.provision_wait_hist().observe(waited)
             return False
+        journal.append('queued_wait_poll', wait_s=round(waited, 3),
+                       polls=polls)
         time.sleep(min(interval, max(0.0, deadline - time.time())))
         interval = min(interval * 1.5, 120.0)
 
@@ -90,6 +116,8 @@ def teardown_cluster(provider: str, cluster_name: str,
 
     Parity: reference provisioner.py:198.
     """
+    events_lib.cluster_journal(cluster_name).append(
+        'teardown', provider=provider, terminate=terminate)
     if terminate:
         provision.terminate_instances(provider, cluster_name)
     else:

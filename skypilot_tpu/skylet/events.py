@@ -9,16 +9,26 @@ autostop config — no Ray-YAML re-parsing and no monkey-patched `ray up`
 """
 from __future__ import annotations
 
+import itertools
 import time
 import traceback
 
 import psutil
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import events as obs_events
 from skypilot_tpu.skylet import autostop_lib
 from skypilot_tpu.skylet import job_lib
 
 logger = sky_logging.init_logger(__name__)
+
+# Failure backoff cap: a persistently crashing event re-fires at most
+# this many intervals apart (it keeps signalling via the failure
+# counter + journal instead of hammering at full rate forever).
+MAX_BACKOFF_MULTIPLIER = 16
+# Initial runs are spread over this many slots of each event's own
+# interval so daemon start doesn't fire every event on the first tick.
+_STAGGER_SLOTS = 8
 
 
 def _pid_alive(pid) -> bool:
@@ -33,22 +43,67 @@ def _pid_alive(pid) -> bool:
 
 
 class SkyletEvent:
-    """Base: `run()` is invoked every EVENT_INTERVAL_SECONDS ticks."""
+    """Base: `run()` is invoked every EVENT_INTERVAL_SECONDS ticks.
+
+    Initial runs are staggered (event k of the daemon first fires
+    ~k/8 of its interval after start — `_last_run_at = 0.0` used to
+    make every event fire on the first tick simultaneously), failures
+    back off exponentially up to MAX_BACKOFF_MULTIPLIER × interval,
+    and every run is journaled with its duration plus counted in
+    `skytpu_skylet_tick_seconds` / `skytpu_skylet_event_failures_total`.
+    """
     EVENT_INTERVAL_SECONDS = 300
 
+    _instance_counter = itertools.count()
+
     def __init__(self) -> None:
-        self._last_run_at = 0.0
+        idx = next(SkyletEvent._instance_counter)
+        stagger = ((idx % _STAGGER_SLOTS) / _STAGGER_SLOTS *
+                   self.EVENT_INTERVAL_SECONDS)
+        self._last_run_at = (time.time() - self.EVENT_INTERVAL_SECONDS +
+                             stagger)
+        self._consecutive_failures = 0
+
+    def current_interval(self) -> float:
+        """Seconds between runs, inflated while the event is failing."""
+        if self._consecutive_failures == 0:
+            return float(self.EVENT_INTERVAL_SECONDS)
+        return float(self.EVENT_INTERVAL_SECONDS * min(
+            2**self._consecutive_failures, MAX_BACKOFF_MULTIPLIER))
 
     def maybe_run(self) -> None:
         now = time.time()
-        if now - self._last_run_at < self.EVENT_INTERVAL_SECONDS:
+        if now - self._last_run_at < self.current_interval():
             return
         self._last_run_at = now
+        name = type(self).__name__
+        t0 = time.perf_counter()
         try:
             self.run()
         except Exception:  # pylint: disable=broad-except
-            logger.error(f'{type(self).__name__} failed:\n'
-                         f'{traceback.format_exc()}')
+            self._consecutive_failures += 1
+            duration = time.perf_counter() - t0
+            obs_events.skylet_event_failures().labels(event=name).inc()
+            self._record_tick(name, duration, 'fail')
+            logger.error(
+                f'{name} failed ({self._consecutive_failures} '
+                f'consecutive; next attempt in '
+                f'{self.current_interval():.0f}s):\n'
+                f'{traceback.format_exc()}')
+        else:
+            self._consecutive_failures = 0
+            self._record_tick(name, time.perf_counter() - t0, 'ok')
+
+    def _record_tick(self, name: str, duration: float,
+                     status: str) -> None:
+        obs_events.skylet_tick_hist().labels(event=name).observe(duration)
+        try:
+            obs_events.skylet_journal().append(
+                'skylet_event', event_name=name, status=status,
+                duration_s=round(duration, 6),
+                consecutive_failures=self._consecutive_failures)
+        except Exception:  # pylint: disable=broad-except
+            pass  # the recorder must never break the event loop
 
     def run(self) -> None:
         raise NotImplementedError

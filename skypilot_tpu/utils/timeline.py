@@ -173,6 +173,16 @@ def add_complete_event(name: str, start_s: float, duration_s: float,
         _events.append(evt)
 
 
+def write_trace(path: str, trace_events: List[dict]) -> None:
+    """Write an arbitrary list of Chrome trace events as a standalone
+    trace file — the flight-recorder journal export
+    (observability/events.py) renders through this, independent of the
+    live-recording buffer above."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump({'traceEvents': list(trace_events)}, f)
+
+
 def save_timeline() -> None:
     # Re-check the env var: a path set after import (programmatic
     # runs, tests) must still produce a dump.

@@ -1,0 +1,518 @@
+"""Flight-recorder tests: journal round-trip, launch failover evidence,
+gang telemetry, preemption→recovery evidence (ISSUE 4 acceptance).
+
+Hermetic like the rest of the suite: the local provisioner stands in
+for the cloud; multi-zone failover is simulated by giving the Local
+cloud two zones and failing the first one at the provisioner layer, so
+the real RetryingProvisioner journals the real attempt sequence.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+from click.testing import CliRunner
+
+import skypilot_tpu as sky
+from skypilot_tpu import cli as cli_mod
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.clouds import local as local_cloud
+from skypilot_tpu.observability import events as events_lib
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.provision import provisioner as provisioner_lib
+
+
+# ------------------------------------------------------------- journal core
+
+
+class TestEventJournal:
+
+    def test_append_tail_read_round_trip(self, tmp_path):
+        journal = events_lib.EventJournal(str(tmp_path / 'j.jsonl'))
+        journal.append('alpha', n=1)
+        journal.append('beta', n=2, label='x')
+        # In-process tail.
+        tail = journal.tail()
+        assert [e['event'] for e in tail] == ['alpha', 'beta']
+        assert tail[1]['label'] == 'x'
+        # Disk round-trip (fresh reader instance, as the CLI would use).
+        reader = events_lib.EventJournal(str(tmp_path / 'j.jsonl'))
+        events = reader.read()
+        assert [e['event'] for e in events] == ['alpha', 'beta']
+        assert all('ts' in e and 'seq' in e for e in events)
+
+    def test_rotation_keeps_one_generation(self, tmp_path):
+        path = str(tmp_path / 'rot.jsonl')
+        journal = events_lib.EventJournal(path, max_bytes=400)
+        for i in range(50):
+            journal.append('tick', i=i, pad='p' * 40)
+        assert os.path.exists(path + '.1')
+        events = journal.read()
+        # The newest event always survives; older generations beyond
+        # current+previous are dropped by design.
+        assert events[-1]['i'] == 49
+        assert 0 < len(events) < 50
+
+    def test_corrupt_lines_skipped(self, tmp_path):
+        path = str(tmp_path / 'c.jsonl')
+        journal = events_lib.EventJournal(path)
+        journal.append('good', n=1)
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write('{not json\n')
+        journal.append('also_good', n=2)
+        assert [e['event'] for e in journal.read()] == ['good',
+                                                       'also_good']
+
+    def test_tail_bounded(self, tmp_path):
+        journal = events_lib.EventJournal(str(tmp_path / 't.jsonl'),
+                                          tail_len=4)
+        for i in range(10):
+            journal.append('e', i=i)
+        assert [e['i'] for e in journal.tail()] == [6, 7, 8, 9]
+        assert [e['i'] for e in journal.tail(2)] == [8, 9]
+
+    def test_append_survives_unwritable_path(self):
+        journal = events_lib.EventJournal('/proc/nope/dir/x.jsonl')
+        record = journal.append('e', n=1)  # must not raise
+        assert record['event'] == 'e'
+        assert journal.tail()[-1]['n'] == 1
+
+
+class TestControlSpan:
+
+    def test_ok_span(self, tmp_path):
+        journal = events_lib.EventJournal(str(tmp_path / 's.jsonl'))
+        with events_lib.ControlSpan(journal, 'phase', cluster='c1') as s:
+            s.add(job_id=7)
+        events = journal.read()
+        assert [e['event'] for e in events] == ['phase_start',
+                                                'phase_end']
+        end = events[1]
+        assert end['status'] == 'ok'
+        assert end['duration_s'] >= 0
+        assert end['job_id'] == 7
+        assert end['cluster'] == 'c1'
+
+    def test_error_span_records_exception(self, tmp_path):
+        journal = events_lib.EventJournal(str(tmp_path / 's.jsonl'))
+        with pytest.raises(ValueError):
+            with events_lib.ControlSpan(journal, 'phase'):
+                raise ValueError('boom')
+        end = journal.read()[-1]
+        assert end['event'] == 'phase_end'
+        assert end['status'] == 'ValueError'
+        assert 'boom' in end['error']
+
+    def test_span_without_journal_is_noop(self):
+        with events_lib.ControlSpan(None, 'phase'):
+            pass  # timeline-only mode must not raise
+
+
+class TestRendering:
+
+    def _sample(self, tmp_path):
+        journal = events_lib.EventJournal(str(tmp_path / 'r.jsonl'))
+        journal.append('launch_start', task='t')
+        with events_lib.ControlSpan(journal, 'provision', zone='z-a'):
+            pass
+        return journal.read()
+
+    def test_format_timeline(self, tmp_path):
+        lines = events_lib.format_timeline(self._sample(tmp_path))
+        assert len(lines) == 3
+        assert 'launch_start' in lines[0] and 'task=t' in lines[0]
+        assert lines[0].split()[1].startswith('+')
+        assert 'provision_end' in lines[2] and 'status=ok' in lines[2]
+        assert events_lib.format_timeline([]) == []
+
+    def test_chrome_trace_export(self, tmp_path):
+        events = self._sample(tmp_path)
+        out = str(tmp_path / 'trace.json')
+        events_lib.export_chrome_trace(events, out)
+        with open(out, encoding='utf-8') as f:
+            trace = json.load(f)['traceEvents']
+        phases = {e['name']: e['ph'] for e in trace}
+        assert phases['launch_start'] == 'i'
+        assert phases['provision_start'] == 'i'
+        assert phases['provision'] == 'X'  # *_end folded into a span
+        span = next(e for e in trace if e['name'] == 'provision')
+        assert span['args']['status'] == 'ok'
+
+
+# --------------------------------------------- acceptance: launch failover
+
+
+def _wait_job(cluster: str, job_id: int, timeout: float = 60.0) -> str:
+    deadline = time.time() + timeout
+    statuses = {}
+    while time.time() < deadline:
+        statuses = sky.job_status(cluster, [job_id])
+        value = statuses.get(str(job_id))
+        if value in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP', 'CANCELLED'):
+            return value
+        time.sleep(0.5)
+    raise TimeoutError(f'Job {job_id} did not finish; last={statuses}')
+
+
+@pytest.fixture
+def local_infra():
+    global_user_state.set_enabled_clouds(['local'])
+    yield
+    for record in global_user_state.get_clusters():
+        try:
+            sky.down(record['name'])
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+@pytest.fixture
+def two_zone_local(monkeypatch):
+    """Local cloud with two zones; provisioning zone-a always fails."""
+    def regions(self, resources):
+        del self, resources
+        return [cloud_lib.Region('local').set_zones(
+            [cloud_lib.Zone('zone-a', 'local'),
+             cloud_lib.Zone('zone-b', 'local')])]
+
+    monkeypatch.setattr(local_cloud.Local, 'regions_with_offering',
+                        regions)
+    monkeypatch.setattr(local_cloud.Local, 'validate_region_zone',
+                        lambda self, region, zone: (region, zone))
+    orig_bulk = provisioner_lib.bulk_provision
+
+    def failing_bulk(config):
+        if config.zones == ['zone-a']:
+            raise exceptions.ProvisionError(
+                'no capacity in zone-a (simulated stockout)')
+        return orig_bulk(config)
+
+    monkeypatch.setattr(provisioner_lib, 'bulk_provision', failing_bulk)
+    yield
+
+
+def test_failover_launch_yields_ordered_journal(local_infra,
+                                                two_zone_local):
+    """Acceptance (a)+(b)+(c): two-zone failover launch produces the
+    ordered journal, the skytpu_provision_* series, and a readable
+    `status --events` timeline."""
+    attempts_before = events_lib.provision_attempts().labels(
+        cloud='local').value
+    failovers_before = events_lib.provision_failovers().labels(
+        reason='ProvisionError').value
+
+    task = sky.Task(name='flightrec', run='echo FLIGHT_OK')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id = sky.launch(task, cluster_name='fo1', stream_logs=False,
+                        detach_run=True)
+    assert _wait_job('fo1', job_id) == 'SUCCEEDED'
+
+    # (a) ordered optimize / provision-attempt{zone,reason} / setup /
+    #     exec events in the cluster journal.
+    events = events_lib.cluster_events('fo1')
+    names = [e['event'] for e in events]
+    expected_order = [
+        'launch_start', 'optimize_start', 'optimize_end',
+        'provision_start', 'provision_attempt_start',
+        'provision_attempt_end',   # zone-a, fail
+        'provision_attempt_start',
+        'provision_attempt_end',   # zone-b, ok
+        'provision_end', 'setup_start', 'setup_end', 'exec_start',
+        'exec_end', 'launch_end',
+    ]
+    pos = -1
+    for want in expected_order:
+        pos = names.index(want, pos + 1)  # raises if order broken
+
+    attempt_ends = [e for e in events
+                    if e['event'] == 'provision_attempt_end']
+    assert attempt_ends[0]['zone'] == 'zone-a'
+    assert attempt_ends[0]['status'] == 'fail'
+    assert attempt_ends[0]['reason'] == 'ProvisionError'
+    assert 'stockout' in attempt_ends[0]['error']
+    assert attempt_ends[1]['zone'] == 'zone-b'
+    assert attempt_ends[1]['status'] == 'ok'
+    exec_end = next(e for e in events if e['event'] == 'exec_end')
+    assert exec_end['job_id'] == job_id
+    launch_end = next(e for e in events if e['event'] == 'launch_end')
+    assert launch_end['status'] == 'ok'
+    assert launch_end['time_to_first_step_s'] > 0
+
+    # (b) skytpu_provision_* series in the exposition.
+    assert events_lib.provision_attempts().labels(
+        cloud='local').value == attempts_before + 2
+    assert events_lib.provision_failovers().labels(
+        reason='ProvisionError').value == failovers_before + 1
+    parsed = metrics.parse_exposition(metrics.expose())
+    assert (('cloud', 'local'),) in parsed[
+        'skytpu_provision_attempts_total']
+    assert (('reason', 'ProvisionError'),) in parsed[
+        'skytpu_provision_failover_total']
+
+    # The gang supervisor (subprocess, shared home on the local cloud)
+    # journaled the per-rank lifecycle.
+    gang_events = events_lib.cluster_job_events(job_id)
+    gang_names = [e['event'] for e in gang_events]
+    for want in ('gang_start', 'rank_start', 'rank_exit', 'gang_end'):
+        assert want in gang_names, gang_names
+    assert all(e['returncode'] == 0 for e in gang_events
+               if e['event'] == 'rank_exit')
+
+    # (c) readable `status --events` timeline through the CLI.
+    runner = CliRunner()
+    result = runner.invoke(cli_mod.cli, ['status', '--events', 'fo1'],
+                           catch_exceptions=False)
+    assert result.exit_code == 0, result.output
+    assert 'provision_attempt_end' in result.output
+    assert 'zone=zone-a' in result.output
+    assert 'zone=zone-b' in result.output
+    assert 'reason=ProvisionError' in result.output
+
+    # Chrome-trace export through the CLI flag.
+    trace_path = os.path.join(os.environ['SKYTPU_HOME'], 'fo1.trace')
+    result = runner.invoke(
+        cli_mod.cli,
+        ['status', '--events', 'fo1', '--export-trace', trace_path],
+        catch_exceptions=False)
+    assert result.exit_code == 0, result.output
+    with open(trace_path, encoding='utf-8') as f:
+        trace = json.load(f)['traceEvents']
+    assert any(e['name'] == 'provision_attempt' and e['ph'] == 'X'
+               for e in trace)
+
+
+def test_status_events_requires_cluster_and_handles_empty(local_infra):
+    runner = CliRunner()
+    result = runner.invoke(cli_mod.cli, ['status', '--events'])
+    assert result.exit_code != 0
+    result = runner.invoke(cli_mod.cli, ['status', '--events', 'ghost'],
+                           catch_exceptions=False)
+    assert result.exit_code == 0
+    assert 'no recorded events' in result.output
+
+
+def test_provision_exhaustion_journaled(local_infra, monkeypatch):
+    def always_fail(config):
+        raise exceptions.ProvisionError('nothing anywhere')
+
+    monkeypatch.setattr(provisioner_lib, 'bulk_provision', always_fail)
+    task = sky.Task(name='x', run='echo x')
+    task.set_resources(sky.Resources(cloud='local'))
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        sky.launch(task, cluster_name='doomed', stream_logs=False,
+                   detach_run=True)
+    events = events_lib.cluster_events('doomed')
+    names = [e['event'] for e in events]
+    assert 'provision_exhausted' in names
+    launch_end = next(e for e in events if e['event'] == 'launch_end')
+    assert launch_end['status'] == 'ResourcesUnavailableError'
+
+
+# --------------------------------------------------- gang metrics (inline)
+
+
+class _StubProc:
+    pid = 0
+
+    def poll(self):
+        return 0
+
+
+class _StubRunner:
+
+    def __init__(self, rc: int) -> None:
+        self._rc = rc
+
+    def spawn_spec(self, cmd):
+        del cmd
+        return None  # force the python supervisor path
+
+    def run(self, cmd, log_path=None, stream_logs=False, on_spawn=None,
+            **kwargs):
+        del cmd, log_path, stream_logs, kwargs
+        if on_spawn is not None:
+            on_spawn(_StubProc())
+        return self._rc
+
+
+def test_gang_metrics_and_journal_inline(monkeypatch, tmp_path):
+    """run_gang records skytpu_gang_* series + the per-rank journal."""
+    from skypilot_tpu.backends import gang_supervisor as gs
+
+    class _Info:
+
+        def get_feasible_ips(self):
+            return ['127.0.0.1', '127.0.0.2']
+
+    monkeypatch.setattr(gs.provision, 'get_cluster_info',
+                        lambda provider, name: _Info())
+    monkeypatch.setattr(gs.provision, 'get_command_runners',
+                        lambda provider, info: [_StubRunner(0),
+                                                _StubRunner(7)])
+    monkeypatch.setattr(gs.job_lib, 'set_status', lambda *a, **k: None)
+    monkeypatch.setattr(gs, '_run_gang_native',
+                        lambda *a, **k: None)  # python path, no cc build
+
+    exits0_before = events_lib.gang_rank_exits().labels(code='0').value
+    exits7_before = events_lib.gang_rank_exits().labels(code='7').value
+    spec = {
+        'provider': 'stub', 'cluster_name': 'gangc',
+        'run_cmd': 'true', 'envs': {}, 'env_contract': {},
+        'log_dir': str(tmp_path / 'logs'), 'num_hosts': 2,
+        'hosts_per_slice': 1,
+    }
+    rc = gs.run_gang(99, spec)
+    assert rc == 1  # one rank failed -> gang failed
+
+    assert events_lib.gang_ranks_gauge().value == 2
+    assert events_lib.gang_rank_exits().labels(
+        code='0').value == exits0_before + 1
+    assert events_lib.gang_rank_exits().labels(
+        code='7').value == exits7_before + 1
+    parsed = metrics.parse_exposition(metrics.expose())
+    assert (('code', '7'),) in parsed['skytpu_gang_rank_exits_total']
+    assert 'skytpu_gang_ranks' in parsed
+
+    events = events_lib.cluster_job_events(99)
+    names = [e['event'] for e in events]
+    assert names.count('rank_start') == 2
+    assert names.count('rank_exit') == 2
+    gang_end = next(e for e in events if e['event'] == 'gang_end')
+    assert gang_end['status'] == 'fail'
+    assert gang_end['returncodes'] == {'0': 0, '1': 7}
+
+
+# --------------------------------- acceptance: preemption -> recovery
+
+
+@pytest.fixture
+def managed_jobs_env(monkeypatch, _isolated_home):
+    monkeypatch.setenv('SKYTPU_JOB_STATUS_CHECK_GAP', '0.3')
+    monkeypatch.setenv('SKYTPU_JOB_STARTED_CHECK_GAP', '0.3')
+    monkeypatch.setenv('SKYTPU_MANAGED_JOB_DB',
+                       str(_isolated_home / 'managed_jobs.db'))
+    global_user_state.set_enabled_clouds(['local'])
+    yield
+
+
+def test_preemption_recovery_evidence(managed_jobs_env, monkeypatch):
+    """Acceptance: a mocked preemption yields skytpu_jobs_* samples, a
+    persisted attempt count + reason, and the journal event sequence."""
+    from skypilot_tpu.jobs import controller as controller_lib
+    from skypilot_tpu.jobs import core as jobs_core
+    from skypilot_tpu.jobs import state
+    from skypilot_tpu.utils import dag_utils
+
+    task = sky.Task(
+        name='preempt',
+        run=(f'if [ -f {os.environ["SKYTPU_HOME"]}/marker ]; then '
+             f'echo RESUMED; else '
+             f'touch {os.environ["SKYTPU_HOME"]}/marker && sleep 60; fi'))
+    task.set_resources(sky.Resources(cloud='local'))
+    dag = dag_utils.convert_entrypoint_to_dag(task)
+    job_id = state.allocate_job_id('preempt')
+    yaml_path = os.path.join(jobs_core._dag_yaml_dir(),  # pylint: disable=protected-access
+                             f'preempt-{job_id}.yaml')
+    dag_utils.dump_chain_dag_to_yaml(dag, yaml_path)
+    state.submit_job(job_id, 'preempt', yaml_path, task_names=['preempt'])
+    state.set_status(job_id, 0, state.ManagedJobStatus.SUBMITTED)
+
+    marker = os.path.join(os.environ['SKYTPU_HOME'], 'marker')
+    preempted = {'done': False}
+    orig_query = controller_lib.JobsController._query_job_status
+
+    def query_and_preempt(self, cluster_name, remote_job_id):
+        status = orig_query(self, cluster_name, remote_job_id)
+        if not preempted['done'] and os.path.exists(marker):
+            preempted['done'] = True
+            sky.down(cluster_name)  # simulate slice eviction
+            return None
+        return status
+
+    monkeypatch.setattr(controller_lib.JobsController,
+                        '_query_job_status', query_and_preempt)
+
+    preemptions_before = events_lib.jobs_preemptions().value
+    recoveries_before = events_lib.jobs_recovery_hist().count
+
+    controller_lib.JobsController(job_id, yaml_path).run()
+    assert preempted['done']
+
+    # Persisted evidence on the job record.
+    rec = state.get_job_records(job_id)[0]
+    assert rec['status'] == 'SUCCEEDED'
+    assert rec['recovery_count'] >= 1
+    assert 'preempted' in rec['last_recovery_reason']
+
+    # Metrics: preemption counter + recovery-duration histogram sample.
+    assert events_lib.jobs_preemptions().value == preemptions_before + 1
+    assert events_lib.jobs_recovery_hist().count == recoveries_before + 1
+    parsed = metrics.parse_exposition(metrics.expose())
+    assert 'skytpu_jobs_recovery_seconds_count' in parsed
+    assert 'skytpu_jobs_preemptions_total' in parsed
+
+    # Journal: ordered preemption -> recovery span with duration.
+    events = events_lib.job_events(job_id)
+    names = [e['event'] for e in events]
+    for want in ('task_start', 'preemption_detected', 'recovery_start',
+                 'recovery_end', 'task_end'):
+        assert want in names, names
+    assert names.index('preemption_detected') < names.index(
+        'recovery_start') < names.index('recovery_end')
+    recovery_end = next(e for e in events
+                        if e['event'] == 'recovery_end')
+    assert recovery_end['status'] == 'ok'
+    assert recovery_end['duration_s'] > 0
+    assert recovery_end['attempt'] == 1
+
+    # CLI: jobs queue shows WHY, jobs events shows the timeline.
+    runner = CliRunner()
+    result = runner.invoke(cli_mod.cli, ['jobs', 'queue'],
+                           catch_exceptions=False)
+    assert result.exit_code == 0
+    assert 'REASON' in result.output
+    assert 'preempted' in result.output
+    result = runner.invoke(cli_mod.cli,
+                           ['jobs', 'events', str(job_id)],
+                           catch_exceptions=False)
+    assert result.exit_code == 0, result.output
+    assert 'preemption_detected' in result.output
+    assert 'recovery_end' in result.output
+
+
+def test_jobs_events_empty(managed_jobs_env):
+    runner = CliRunner()
+    result = runner.invoke(cli_mod.cli, ['jobs', 'events', '424242'],
+                           catch_exceptions=False)
+    assert result.exit_code == 0
+    assert 'no recorded events' in result.output
+
+
+def test_state_migration_adds_recovery_reason_column(tmp_path,
+                                                     monkeypatch):
+    """A pre-existing DB without last_recovery_reason is upgraded in
+    place instead of crashing every query."""
+    import sqlite3
+
+    from skypilot_tpu.jobs import state
+    db = tmp_path / 'old.db'
+    conn = sqlite3.connect(str(db))
+    conn.execute("""CREATE TABLE managed_jobs (
+        job_id INTEGER, task_id INTEGER DEFAULT 0, job_name TEXT,
+        task_name TEXT, status TEXT, submitted_at REAL, start_at REAL,
+        end_at REAL, last_recovered_at REAL DEFAULT -1,
+        recovery_count INTEGER DEFAULT 0, failure_reason TEXT,
+        cluster_name TEXT, run_timestamp TEXT, controller_pid INTEGER,
+        dag_yaml_path TEXT, PRIMARY KEY (job_id, task_id))""")
+    conn.execute("INSERT INTO managed_jobs (job_id, job_name, status) "
+                 "VALUES (1, 'old', 'RUNNING')")
+    conn.commit()
+    conn.close()
+    monkeypatch.setenv('SKYTPU_MANAGED_JOB_DB', str(db))
+    state.set_recovering(1, 0, reason='why not')
+    rec = state.get_job_records(1)[0]
+    assert rec['last_recovery_reason'] == 'why not'
+    assert rec['recovery_count'] == 1

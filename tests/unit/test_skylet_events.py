@@ -125,6 +125,87 @@ class TestServiceUpdateEvent:
             controller.wait()
 
 
+class _TickEvent(events.SkyletEvent):
+    EVENT_INTERVAL_SECONDS = 100
+
+    def __init__(self):
+        super().__init__()
+        self.calls = 0
+        self.fail = False
+
+    def run(self):
+        self.calls += 1
+        if self.fail:
+            raise RuntimeError('boom')
+
+
+class TestStaggerAndBackoff:
+    """ISSUE 4 satellite: `_last_run_at = 0.0` used to fire every event
+    on the first tick simultaneously, and a persistently crashing event
+    re-fired at full interval forever."""
+
+    def test_initial_runs_staggered(self):
+        batch = [_TickEvent() for _ in range(8)]
+        due = [e for e in batch
+               if time.time() - e._last_run_at >=  # pylint: disable=protected-access
+               e.current_interval()]
+        # Exactly one of 8 consecutive instances lands on the zero
+        # offset; the rest wait out their stagger slot.
+        assert len(due) == 1
+
+    def test_failure_backoff_capped_and_reset(self):
+        from skypilot_tpu.observability import events as obs_events
+        event = _TickEvent()
+        event.fail = True
+        failures = obs_events.skylet_event_failures().labels(
+            event='_TickEvent')
+        before = failures.value
+
+        event._last_run_at = 0.0  # pylint: disable=protected-access
+        event.maybe_run()
+        assert event.calls == 1
+        assert failures.value == before + 1
+        assert event.current_interval() == 200  # 2x after 1 failure
+
+        # Within the backed-off window: suppressed even though the base
+        # interval elapsed.
+        event._last_run_at = time.time() - 150  # pylint: disable=protected-access
+        event.maybe_run()
+        assert event.calls == 1
+
+        # Past the backed-off window: runs again, backoff doubles.
+        event._last_run_at = time.time() - 250  # pylint: disable=protected-access
+        event.maybe_run()
+        assert event.calls == 2
+        assert event.current_interval() == 400
+
+        # Cap: never beyond MAX_BACKOFF_MULTIPLIER x interval.
+        event._consecutive_failures = 99  # pylint: disable=protected-access
+        assert event.current_interval() == \
+            100 * events.MAX_BACKOFF_MULTIPLIER
+
+        # A success resets the backoff to the base interval.
+        event.fail = False
+        event._consecutive_failures = 3  # pylint: disable=protected-access
+        event._last_run_at = 0.0  # pylint: disable=protected-access
+        event.maybe_run()
+        assert event.calls == 3
+        assert event.current_interval() == 100
+
+    def test_tick_journaled_with_duration(self):
+        from skypilot_tpu.observability import events as obs_events
+        event = _TickEvent()
+        event._last_run_at = 0.0  # pylint: disable=protected-access
+        event.maybe_run()
+        ticks = [e for e in obs_events.skylet_journal().read()
+                 if e.get('event_name') == '_TickEvent']
+        assert ticks, 'tick not journaled'
+        assert ticks[-1]['status'] == 'ok'
+        assert ticks[-1]['duration_s'] >= 0
+        hist = obs_events.skylet_tick_hist().labels(event='_TickEvent')
+        assert hist.value >= 1  # histogram count
+
+
 def test_pid_alive_helper():
     assert events._pid_alive(os.getpid())  # pylint: disable=protected-access
     victim = _spawn_victim()
