@@ -41,6 +41,7 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import provision
 from skypilot_tpu import sky_logging
+from skypilot_tpu.chaos import injector as chaos_injector
 from skypilot_tpu.observability import events as events_lib
 from skypilot_tpu.skylet import constants
 from skypilot_tpu.skylet import job_lib
@@ -136,6 +137,12 @@ def _run_gang_native(spec, runners, host_ips, log_dir, run_cmd,
     """Supervise the gang with the C++ fan-in (one child per rank,
     line-multiplexed logs, fail-fast kill).  None → fall back."""
     from skypilot_tpu import native  # pylint: disable=import-outside-toplevel
+    # Per-rank fault injection lives in the python supervisor's exec
+    # path; an armed gang fault must not be silently bypassed by the
+    # C++ fan-in.
+    if chaos_injector.site_armed('gang.rank_exec') or \
+            chaos_injector.site_armed('runner.exec'):
+        return None
     binary = native.ensure_fanin_built()
     if binary is None:
         return None
@@ -224,10 +231,24 @@ def _run_gang_python(runners, spec, host_ips, log_dir, run_cmd,
                 # Lost the race with the abort sweep: kill immediately.
                 _kill_rank(runners[rank], _pidfile(rank), proc)
 
+        def _on_retry(attempt, reason):
+            # Expose the retry count to the flight recorder: a rank
+            # that needed N transport attempts is a flaky host.
+            if journal is not None:
+                journal.append('runner_retry', job_id=job_id, rank=rank,
+                               attempt=attempt, error=str(reason)[:500])
+
+        # Chaos site: raising here kills exactly this rank (its
+        # supervisor thread returns 255) and triggers the gang abort.
+        chaos_injector.inject('gang.rank_exec', rank=rank,
+                              job_id=job_id,
+                              cluster=spec.get('cluster_name'))
         # stream_logs mirrors rank output to the supervisor's stdout, which
         # the scheduler redirects to run.log — what `sky logs` tails.
-        return runner.run(exports, log_path=log_path, stream_logs=True,
-                          on_spawn=_register)
+        return runner.run_with_retry(exports, log_path=log_path,
+                                     stream_logs=True,
+                                     on_spawn=_register,
+                                     on_retry=_on_retry)
 
     def _abort_survivors(failed: int) -> None:
         aborting.set()

@@ -33,6 +33,7 @@ from skypilot_tpu import provision
 from skypilot_tpu import sky_logging
 from skypilot_tpu import status_lib
 from skypilot_tpu.backends import backend as backend_lib
+from skypilot_tpu.chaos import injector as chaos_injector
 from skypilot_tpu.clouds import cloud as cloud_lib
 from skypilot_tpu.clouds import registry
 from skypilot_tpu.observability import events as events_lib
@@ -174,6 +175,14 @@ class RetryingProvisioner:
                                zone=zone_name or '-')
                 t0 = time.monotonic()
                 try:
+                    # Chaos site: a ProvisionError here is
+                    # indistinguishable from a zone stockout, driving
+                    # the real failover machinery below.
+                    chaos_injector.inject('provision.create',
+                                          cluster=self._cluster_name,
+                                          cloud=cloud_name,
+                                          region=region.name,
+                                          zone=zone_name or '-')
                     record = self._provision_once(cloud, attempt, region,
                                                   zone_name)
                     journal.append(

@@ -1,0 +1,38 @@
+"""Chaos subsystem: deterministic fault injection + recovery scenarios.
+
+The north star makes preemptible TPU slices first-class, so preemption,
+provision failover, and rank death are the NORMAL operating mode — yet
+nothing exercised those paths systematically until a real eviction hit.
+This package converts the recovery surface into a regression-tested
+contract:
+
+- :mod:`faults` — the seeded :class:`FaultPlan` DSL (JSON / env-loadable
+  via ``SKYTPU_CHAOS_PLAN``): faults are described by *site*
+  (``provision.create``, ``gang.rank_exec``, ...), trigger (nth-call,
+  seeded probability, time window, ctx match) and effect (raise typed
+  error, preemption-style kill, added latency, hang, deny).
+- :mod:`injector` — the process-global registry with an
+  ``inject(site, **ctx)`` hook that is a no-op fast path when no plan is
+  armed.  Every injection journals ``chaos_fault_injected`` and bumps
+  ``skytpu_chaos_faults_total``.
+- :mod:`scenarios` — end-to-end launch→fault→recover flows on the local
+  backend, verified against the flight-recorder journal.
+- :mod:`invariants` — liveness/safety checks replayed over journals.
+
+CLI: ``sky chaos list`` / ``sky chaos run <scenario> [--seed N]
+[--export-trace PATH]``.  See docs/chaos.md.
+"""
+from skypilot_tpu.chaos.faults import ChaosError
+from skypilot_tpu.chaos.faults import Fault
+from skypilot_tpu.chaos.faults import FaultPlan
+from skypilot_tpu.chaos.faults import SITES
+from skypilot_tpu.chaos.injector import DENY
+from skypilot_tpu.chaos.injector import arm
+from skypilot_tpu.chaos.injector import disarm
+from skypilot_tpu.chaos.injector import inject
+from skypilot_tpu.chaos.injector import site_armed
+
+__all__ = [
+    'ChaosError', 'Fault', 'FaultPlan', 'SITES', 'DENY', 'arm', 'disarm',
+    'inject', 'site_armed',
+]

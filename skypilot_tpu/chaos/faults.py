@@ -1,0 +1,222 @@
+"""Fault-plan DSL: what to break, when, and how — deterministically.
+
+A :class:`FaultPlan` is a seed plus an ordered list of :class:`Fault`s.
+Each fault names a registered *site* (the vocabulary below — enforced by
+an AST lint in tests), a *trigger* (nth call at the site, every-k,
+seeded probability, a time window after arming, and/or a ``where`` match
+on the call context), and an *effect*:
+
+    raise    raise a typed error (``error`` picks the class)
+    preempt  kill the cluster named in the call ctx, then raise — the
+             closest local-backend analogue of a TPU slice eviction
+    delay    sleep ``delay_s`` then continue
+    hang     sleep ``deadline_s`` then raise (a stuck cloud API call)
+    deny     return the DENY sentinel; cooperative sites interpret it
+             as "the guarded operation reported not-ready/failed"
+
+Plans load from JSON (inline, a path, or ``@path``) — the
+``SKYTPU_CHAOS_PLAN`` environment variable uses the same forms, which is
+how a plan armed in the client propagates into emulated-host
+subprocesses (gang supervisor, skylet).
+
+Determinism: probability draws come from a per-fault
+``random.Random(f'{seed}:{fault_index}')``, and per-site call counters
+are process-local — the same plan + seed over the same call sequence
+yields a byte-identical fault sequence (guarded by a test).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from skypilot_tpu import exceptions
+
+# Environment variable carrying the armed plan (inline JSON, a path to a
+# .json file, or '@<path>').
+PLAN_ENV_VAR = 'SKYTPU_CHAOS_PLAN'
+
+
+class ChaosError(exceptions.SkyTpuError):
+    """Default error raised by injected faults."""
+
+
+# Site vocabulary: every `inject(<site>, ...)` call site must use one of
+# these names, and every name must have >= 1 call site (AST lint:
+# tests/unit/test_chaos_sites_lint.py).  Keep docs/chaos.md's table in
+# sync.
+SITES: Dict[str, str] = {
+    'provision.create':
+        'RetryingProvisioner zone attempt, before the cloud create call '
+        '(backends/slice_backend.py) — raise ProvisionError here to '
+        'drive the failover loop',
+    'queued_resource.poll':
+        'wait_for_queued_capacity poll (provision/provisioner.py) — '
+        'cooperative: effect "deny" makes the poll report not-granted',
+    'runner.exec':
+        'CommandRunner.run_with_retry attempt (utils/command_runner.py) '
+        '— raise TransientRunnerError to exercise the retry loop',
+    'gang.rank_exec':
+        'gang supervisor per-rank exec (backends/gang_supervisor.py) — '
+        'a raise kills that rank and triggers the gang abort',
+    'jobs.status_poll':
+        'managed-jobs controller job-status poll (jobs/controller.py) — '
+        'effect "preempt" downs the task cluster behind the '
+        'controller\'s back, the local analogue of a slice eviction',
+    'jobs.recover':
+        'recovery strategy recover() (jobs/recovery_strategy.py) — '
+        'raise ResourcesUnavailableError to fail a recovery attempt',
+    'serve.replica_probe':
+        'replica readiness probe (serve/replica_managers.py) — raise '
+        'RequestException (or ChaosError) to flap a replica',
+    'skylet.tick':
+        'skylet periodic event run (skylet/events.py) — a raise counts '
+        'as an event failure and exercises the failure backoff',
+}
+
+EFFECTS = ('raise', 'preempt', 'delay', 'hang', 'deny')
+
+
+def _error_types() -> Dict[str, Any]:
+    """Name -> exception class for the `raise` effect.  Built lazily so
+    importing faults.py never drags in requests."""
+    import requests  # pylint: disable=import-outside-toplevel
+    return {
+        'ChaosError': ChaosError,
+        'ProvisionError': exceptions.ProvisionError,
+        'ResourcesUnavailableError': exceptions.ResourcesUnavailableError,
+        'TransientRunnerError': exceptions.TransientRunnerError,
+        'CommandError': None,  # needs args; built in make_error
+        'RequestException': requests.RequestException,
+        'TimeoutError': TimeoutError,
+        'OSError': OSError,
+        'RuntimeError': RuntimeError,
+    }
+
+
+@dataclasses.dataclass
+class Fault:
+    """One fault: site + trigger + effect."""
+    site: str
+    effect: str = 'raise'
+    # Effect parameters.
+    error: str = 'ChaosError'
+    message: Optional[str] = None
+    delay_s: float = 0.0
+    deadline_s: float = 0.0
+    # Trigger: at most one of nth/every/probability; all other given
+    # conditions AND together.  Call numbers are 1-based per site.
+    nth: Optional[Union[int, Sequence[int]]] = None
+    every: Optional[int] = None
+    probability: Optional[float] = None
+    max_times: Optional[int] = None
+    after_s: float = 0.0
+    until_s: Optional[float] = None
+    where: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if self.site not in SITES:
+            raise ValueError(
+                f'Unknown chaos site {self.site!r}; registered sites: '
+                f'{sorted(SITES)}')
+        if self.effect not in EFFECTS:
+            raise ValueError(
+                f'Unknown chaos effect {self.effect!r}; one of {EFFECTS}')
+        selectors = [s for s in (self.nth, self.every, self.probability)
+                     if s is not None]
+        if len(selectors) > 1:
+            raise ValueError(
+                'A fault takes at most one of nth/every/probability')
+        if self.probability is not None and not 0.0 <= self.probability <= 1.0:
+            raise ValueError('probability must be in [0, 1]')
+        if isinstance(self.nth, int):
+            self.nth = [self.nth]
+        elif self.nth is not None:
+            self.nth = [int(n) for n in self.nth]
+
+    def matches_ctx(self, ctx: Dict[str, Any]) -> bool:
+        """`where` is satisfied iff every key is present in ctx with an
+        equal value (string-compared, so JSON '1' matches int rank 1)."""
+        for key, want in self.where.items():
+            if key not in ctx or str(ctx[key]) != str(want):
+                return False
+        return True
+
+    def make_error(self) -> Exception:
+        message = self.message or (
+            f'chaos: injected {self.error} at {self.site}')
+        if self.error == 'CommandError':
+            return exceptions.CommandError(returncode=255,
+                                           command=f'chaos@{self.site}',
+                                           error_msg=message)
+        cls = _error_types().get(self.error)
+        if cls is None:
+            raise ValueError(f'Unknown chaos error type {self.error!r}; '
+                             f'one of {sorted(_error_types())}')
+        return cls(message)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = dataclasses.asdict(self)
+        # Drop defaults for compact plans.
+        for key, default in (('error', 'ChaosError'), ('message', None),
+                             ('delay_s', 0.0), ('deadline_s', 0.0),
+                             ('nth', None), ('every', None),
+                             ('probability', None), ('max_times', None),
+                             ('after_s', 0.0), ('until_s', None),
+                             ('where', {})):
+            if out.get(key) == default:
+                out.pop(key, None)
+        return out
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A seed + ordered faults.  First matching fault at a site wins."""
+    seed: int = 0
+    faults: List[Fault] = dataclasses.field(default_factory=list)
+    name: str = ''
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> 'FaultPlan':
+        if not isinstance(data, dict):
+            raise ValueError(f'Fault plan must be a JSON object, got '
+                             f'{type(data).__name__}')
+        unknown = set(data) - {'seed', 'faults', 'name'}
+        if unknown:
+            raise ValueError(f'Unknown fault-plan keys: {sorted(unknown)}')
+        faults = [f if isinstance(f, Fault) else Fault(**f)
+                  for f in data.get('faults', [])]
+        return cls(seed=int(data.get('seed', 0)), faults=faults,
+                   name=str(data.get('name', '')))
+
+    @classmethod
+    def from_json(cls, text: str) -> 'FaultPlan':
+        return cls.from_dict(json.loads(text))
+
+    @classmethod
+    def from_env_value(cls, value: str) -> 'FaultPlan':
+        """Parse the SKYTPU_CHAOS_PLAN forms: inline JSON, '@<path>', or
+        a bare path ending in .json."""
+        value = value.strip()
+        if value.startswith('@'):
+            path = os.path.expanduser(value[1:])
+            with open(path, encoding='utf-8') as f:
+                return cls.from_json(f.read())
+        if value.endswith('.json') and not value.startswith('{'):
+            with open(os.path.expanduser(value), encoding='utf-8') as f:
+                return cls.from_json(f.read())
+        return cls.from_json(value)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {'seed': self.seed,
+                               'faults': [f.to_dict() for f in self.faults]}
+        if self.name:
+            out['name'] = self.name
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    def sites(self) -> List[str]:
+        return sorted({f.site for f in self.faults})

@@ -1,0 +1,180 @@
+"""Invariant checkers: liveness/safety properties replayed over journals.
+
+Each checker takes a ts-ordered list of flight-recorder events (one
+journal, or several merged with :func:`merge`) and returns a list of
+violation strings — empty means the property held.  They are pure
+functions over journal records, so they run identically against a live
+scenario, a post-mortem `events/` directory, or a synthetic fixture.
+
+The registry maps names (used by scenarios and the CLI) to checkers:
+
+    recovery_liveness      every preemption_detected is followed by a
+                           terminal recovery_end
+    gang_abort_coverage    a gang abort accounts for every started rank
+                           (victims + the failed rank + clean exits)
+    no_excluded_zone_retry the failover loop never re-attempts a zone
+                           that already failed within the same launch
+    queued_wait_terminal   every queued_wait_start reaches a terminal
+                           queued_wait_end (granted or timeout)
+    spans_closed           every <name>_start has a matching <name>_end
+    no_injections          zero chaos_fault_injected events (clean runs)
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Sequence
+
+
+Event = Dict[str, Any]
+
+
+def merge(*event_lists: Sequence[Event]) -> List[Event]:
+    """Merge journals into one ts-ordered stream (ties keep input
+    order, so same-process seq ordering survives)."""
+    merged: List[Event] = []
+    for events in event_lists:
+        merged.extend(events)
+    merged.sort(key=lambda e: e.get('ts', 0.0))
+    return merged
+
+
+def _named(events: Sequence[Event], name: str) -> List[Event]:
+    return [e for e in events if e.get('event') == name]
+
+
+# ----------------------------------------------------------------- checkers
+
+
+def recovery_liveness(events: Sequence[Event]) -> List[str]:
+    """Liveness: a detected preemption must reach a recovery_end (any
+    status — giving up IS a terminal answer; silence is the bug)."""
+    violations = []
+    indexed = list(enumerate(events))
+    for i, e in indexed:
+        if e.get('event') != 'preemption_detected':
+            continue
+        followed = any(
+            later.get('event') == 'recovery_end' and
+            later.get('job_id') == e.get('job_id')
+            for _, later in indexed[i + 1:])
+        if not followed:
+            violations.append(
+                f'preemption_detected (job {e.get("job_id")}, task '
+                f'{e.get("task_id")}) has no subsequent recovery_end')
+    return violations
+
+
+def gang_abort_coverage(events: Sequence[Event]) -> List[str]:
+    """Safety: when a gang aborts, victims + the failed rank + ranks
+    that had already exited must cover every started rank — a rank left
+    running after an abort would burn the slice in a dead collective."""
+    violations = []
+    started = {e.get('rank') for e in _named(events, 'rank_start')}
+    exited = {e.get('rank') for e in _named(events, 'rank_exit')}
+    for abort in _named(events, 'gang_abort'):
+        covered = set(abort.get('victims') or [])
+        covered.add(abort.get('failed_rank'))
+        # Ranks that exited on their own before/after the abort are
+        # accounted for by their rank_exit records.
+        missing = started - covered - exited
+        if missing:
+            violations.append(
+                f'gang_abort covers {sorted(covered)} but ranks '
+                f'{sorted(missing)} started and never exited')
+    if started - exited:
+        violations.append(
+            f'ranks {sorted(started - exited)} have rank_start but no '
+            f'rank_exit')
+    return violations
+
+
+def no_excluded_zone_retry(events: Sequence[Event]) -> List[str]:
+    """Safety: within one launch, a (cloud, region, zone) that failed a
+    provision attempt is excluded — re-attempting it wastes the
+    failover budget on known-bad capacity."""
+    violations = []
+    failed: set = set()
+    for e in events:
+        name = e.get('event')
+        key = (e.get('cloud'), e.get('region'), e.get('zone'))
+        if name == 'provision_attempt_start' and key in failed:
+            violations.append(
+                f'provision re-attempted excluded zone {key}')
+        elif name == 'provision_attempt_end' and e.get('status') == 'fail':
+            failed.add(key)
+        elif name == 'launch_start':
+            failed.clear()  # a new launch may legitimately retry
+    return violations
+
+
+def queued_wait_terminal(events: Sequence[Event]) -> List[str]:
+    """Liveness: every queued-capacity wait reaches a terminal verdict
+    within its journal (granted or timeout), never silence."""
+    violations = []
+    open_waits = 0
+    for e in events:
+        if e.get('event') == 'queued_wait_start':
+            open_waits += 1
+        elif e.get('event') == 'queued_wait_end':
+            open_waits -= 1
+            if e.get('status') not in ('granted', 'timeout'):
+                violations.append(
+                    f'queued_wait_end has non-terminal status '
+                    f'{e.get("status")!r}')
+    if open_waits > 0:
+        violations.append(
+            f'{open_waits} queued_wait_start without queued_wait_end')
+    return violations
+
+
+def spans_closed(events: Sequence[Event]) -> List[str]:
+    """Every <name>_start has a later matching <name>_end (crashed
+    processes legitimately violate this — apply it to scenarios that
+    are supposed to finish cleanly)."""
+    violations = []
+    open_spans: Dict[str, int] = {}
+    for e in events:
+        name = e.get('event', '')
+        if name.endswith('_start'):
+            base = name[:-len('_start')]
+            open_spans[base] = open_spans.get(base, 0) + 1
+        elif name.endswith('_end'):
+            base = name[:-len('_end')]
+            open_spans[base] = open_spans.get(base, 0) - 1
+    for base, count in sorted(open_spans.items()):
+        if count > 0:
+            violations.append(f'{count} {base}_start without {base}_end')
+    return violations
+
+
+def no_injections(events: Sequence[Event]) -> List[str]:
+    """With no plan armed, the chaos subsystem must be invisible."""
+    injected = _named(events, 'chaos_fault_injected')
+    if injected:
+        return [f'{len(injected)} chaos_fault_injected events on a run '
+                f'that armed no plan']
+    return []
+
+
+CHECKERS: Dict[str, Callable[[Sequence[Event]], List[str]]] = {
+    'recovery_liveness': recovery_liveness,
+    'gang_abort_coverage': gang_abort_coverage,
+    'no_excluded_zone_retry': no_excluded_zone_retry,
+    'queued_wait_terminal': queued_wait_terminal,
+    'spans_closed': spans_closed,
+    'no_injections': no_injections,
+}
+
+
+def check(events: Sequence[Event],
+          invariant_names: Sequence[str]) -> List[str]:
+    """Run the named checkers; returns all violations, each prefixed
+    with the invariant that caught it."""
+    violations = []
+    for name in invariant_names:
+        checker = CHECKERS.get(name)
+        if checker is None:
+            violations.append(f'{name}: unknown invariant (have '
+                              f'{sorted(CHECKERS)})')
+            continue
+        violations.extend(f'{name}: {v}' for v in checker(events))
+    return violations

@@ -1,0 +1,518 @@
+"""End-to-end chaos scenarios: launch → fault → recover, journal-verified.
+
+Each scenario arms a seeded :class:`~skypilot_tpu.chaos.faults.FaultPlan`
+(via ``SKYTPU_CHAOS_PLAN``, so emulated-host subprocesses inherit it),
+drives a real flow on the local backend — the same provisioner /
+backend / gang supervisor / jobs controller / serve code paths that run
+against clouds — and then replays the flight-recorder journals through
+:mod:`~skypilot_tpu.chaos.invariants`.  A scenario passes iff every
+invariant holds AND its scenario-specific expectations match.
+
+Scenarios (CLI: ``sky chaos list`` / ``sky chaos run <name>``):
+
+- ``provision_failover``   zone-a stockout → failover provisions zone-b
+- ``preemption_recovery``  task cluster evicted mid-job → controller
+                           detects, recovers, job still succeeds
+- ``rank_crash``           one rank of a 4-host gang dies → fail-fast
+                           abort covers every live rank
+- ``queued_stall``         queued-resource capacity never granted →
+                           wait times out with a terminal verdict
+- ``serve_replica_flap``   readiness probes fail transiently → replica
+                           flaps NOT_READY and returns to READY
+
+Determinism: the fault sequence (site, effect, per-site call number) is
+a pure function of plan + seed over the driven call sequence; the
+scenario result carries it so the same ``--seed`` can be diffed run
+over run.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.chaos import faults as faults_lib
+from skypilot_tpu.chaos import injector
+from skypilot_tpu.chaos import invariants
+from skypilot_tpu.observability import events as events_lib
+
+logger = sky_logging.init_logger(__name__)
+
+_WAIT_JOB_TIMEOUT_SECONDS = 120.0
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    name: str
+    seed: int
+    violations: List[str]
+    # (site, effect, per-site call number, fault index) — deterministic
+    # for a given plan+seed; environmental ctx is deliberately excluded.
+    fault_sequence: List[Dict[str, Any]]
+    events: List[Dict[str, Any]]
+    details: Dict[str, Any]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def summary(self) -> str:
+        status = 'PASS' if self.ok else 'FAIL'
+        return (f'{self.name} (seed {self.seed}): {status} — '
+                f'{len(self.fault_sequence)} fault(s) injected, '
+                f'{len(self.violations)} violation(s)')
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str
+    description: str
+    run: Callable[[int], ScenarioResult]
+
+
+SCENARIOS: Dict[str, Scenario] = {}
+
+
+def _register(name: str, description: str):
+
+    def deco(fn):
+        SCENARIOS[name] = Scenario(name, description, fn)
+        return fn
+
+    return deco
+
+
+def run_scenario(name: str, seed: int = 0,
+                 export_trace: Optional[str] = None) -> ScenarioResult:
+    """Run one scenario; optionally export its merged journal as a
+    Chrome trace for post-mortem."""
+    scenario = SCENARIOS.get(name)
+    if scenario is None:
+        raise ValueError(f'Unknown scenario {name!r}; have '
+                         f'{sorted(SCENARIOS)}')
+    result = scenario.run(seed)
+    if export_trace:
+        events_lib.export_chrome_trace(result.events, export_trace)
+    return result
+
+
+# ----------------------------------------------------------- shared helpers
+
+
+@contextlib.contextmanager
+def _armed(plan: faults_lib.FaultPlan) -> Iterator[None]:
+    """Arm via the environment (inherited by emulated-host subprocesses)
+    and leave nothing armed afterwards."""
+    prior = os.environ.get(faults_lib.PLAN_ENV_VAR)
+    os.environ[faults_lib.PLAN_ENV_VAR] = plan.to_json()
+    injector.disarm()  # drop any stale cached plan
+    try:
+        yield
+    finally:
+        if prior is None:
+            os.environ.pop(faults_lib.PLAN_ENV_VAR, None)
+        else:
+            os.environ[faults_lib.PLAN_ENV_VAR] = prior
+        injector.disarm()
+
+
+@contextlib.contextmanager
+def _local_cloud_enabled() -> Iterator[None]:
+    from skypilot_tpu import global_user_state  # pylint: disable=import-outside-toplevel
+    prior = global_user_state.get_enabled_clouds()
+    global_user_state.set_enabled_clouds(['local'])
+    try:
+        yield
+    finally:
+        if prior and prior != ['local']:
+            global_user_state.set_enabled_clouds(prior)
+
+
+@contextlib.contextmanager
+def _two_zone_local() -> Iterator[None]:
+    """Give the Local cloud two zones so the failover loop has somewhere
+    to go (the real cloud path; zones are synthetic)."""
+    from skypilot_tpu.clouds import cloud as cloud_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.clouds import local as local_cloud  # pylint: disable=import-outside-toplevel
+
+    def regions(self, resources):
+        del self, resources
+        return [cloud_lib.Region('local').set_zones(
+            [cloud_lib.Zone('zone-a', 'local'),
+             cloud_lib.Zone('zone-b', 'local')])]
+
+    saved_regions = local_cloud.Local.regions_with_offering
+    saved_validate = local_cloud.Local.validate_region_zone
+    local_cloud.Local.regions_with_offering = regions
+    local_cloud.Local.validate_region_zone = (
+        lambda self, region, zone: (region, zone))
+    try:
+        yield
+    finally:
+        local_cloud.Local.regions_with_offering = saved_regions
+        local_cloud.Local.validate_region_zone = saved_validate
+
+
+def _down(cluster_name: str) -> None:
+    from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu import exceptions  # pylint: disable=import-outside-toplevel
+    try:
+        core.down(cluster_name)
+    except (exceptions.SkyTpuError, ValueError):
+        pass
+
+
+def _wait_job(cluster: str, job_id: int,
+              timeout: float = _WAIT_JOB_TIMEOUT_SECONDS) -> str:
+    import skypilot_tpu as sky  # pylint: disable=import-outside-toplevel
+    deadline = time.time() + timeout
+    value = None
+    while time.time() < deadline:
+        value = sky.job_status(cluster, [job_id]).get(str(job_id))
+        if value in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP',
+                     'FAILED_DRIVER', 'CANCELLED'):
+            return value
+        time.sleep(0.5)
+    raise TimeoutError(f'Job {job_id} on {cluster} did not finish '
+                       f'(last status: {value})')
+
+
+def _since(journal: events_lib.EventJournal,
+           t0: float) -> List[Dict[str, Any]]:
+    """Journal events appended since t0 (journals persist across runs of
+    the same scenario/seed; the window keeps replays scoped)."""
+    return [e for e in journal.read() if e.get('ts', 0.0) >= t0]
+
+
+def _fault_sequence(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    return [{'site': e.get('site'), 'effect': e.get('effect'),
+             'call': e.get('call')}
+            for e in events if e.get('event') == 'chaos_fault_injected']
+
+
+def _finish(name: str, seed: int, t0: float,
+            scoped_events: List[Dict[str, Any]],
+            invariant_names: List[str],
+            extra_violations: List[str],
+            details: Dict[str, Any]) -> ScenarioResult:
+    chaos_events = _since(injector.chaos_journal(), t0)
+    merged = invariants.merge(scoped_events, chaos_events)
+    violations = invariants.check(merged, invariant_names)
+    violations.extend(extra_violations)
+    return ScenarioResult(name=name, seed=seed, violations=violations,
+                          fault_sequence=_fault_sequence(merged),
+                          events=merged, details=details)
+
+
+def _expect(condition: bool, message: str,
+            violations: List[str]) -> None:
+    if not condition:
+        violations.append(f'expectation: {message}')
+
+
+# --------------------------------------------------------------- scenarios
+
+
+@_register(
+    'provision_failover',
+    'zone-a provision stockout -> failover loop lands the slice in '
+    'zone-b; journal shows fail->ok attempts and no excluded-zone retry')
+def provision_failover(seed: int) -> ScenarioResult:
+    import skypilot_tpu as sky  # pylint: disable=import-outside-toplevel
+    plan = faults_lib.FaultPlan(seed=seed, name='provision_failover',
+                                faults=[faults_lib.Fault(
+                                    site='provision.create',
+                                    effect='raise',
+                                    error='ProvisionError',
+                                    message='chaos: zone-a stockout',
+                                    where={'zone': 'zone-a'})])
+    cluster = f'chaos-fo-{seed}'
+    t0 = time.time()
+    extra: List[str] = []
+    details: Dict[str, Any] = {'cluster': cluster}
+    with _local_cloud_enabled(), _two_zone_local(), _armed(plan):
+        try:
+            task = sky.Task(name='chaos-fo', run='echo CHAOS_FAILOVER_OK')
+            task.set_resources(sky.Resources(cloud='local'))
+            job_id = sky.launch(task, cluster_name=cluster,
+                                stream_logs=False, detach_run=True)
+            details['job_status'] = _wait_job(cluster, job_id)
+        finally:
+            cluster_events = _since(events_lib.cluster_journal(cluster),
+                                    t0)
+            _down(cluster)
+
+    _expect(details.get('job_status') == 'SUCCEEDED',
+            f'job SUCCEEDED after failover '
+            f'(got {details.get("job_status")})', extra)
+    attempts = [e for e in cluster_events
+                if e.get('event') == 'provision_attempt_end']
+    details['attempts'] = [(a.get('zone'), a.get('status'))
+                           for a in attempts]
+    _expect(len(attempts) == 2, f'exactly two provision attempts '
+            f'(got {details["attempts"]})', extra)
+    if len(attempts) == 2:
+        _expect(attempts[0].get('zone') == 'zone-a' and
+                attempts[0].get('status') == 'fail',
+                f'first attempt fails in zone-a (got {details["attempts"]})',
+                extra)
+        _expect(attempts[1].get('zone') == 'zone-b' and
+                attempts[1].get('status') == 'ok',
+                f'second attempt succeeds in zone-b '
+                f'(got {details["attempts"]})', extra)
+    return _finish('provision_failover', seed, t0, cluster_events,
+                   ['no_excluded_zone_retry', 'spans_closed'],
+                   extra, details)
+
+
+@_register(
+    'preemption_recovery',
+    'task cluster evicted mid-job (preempt effect) -> controller '
+    'detects the preemption, recovers, and the managed job succeeds')
+def preemption_recovery(seed: int) -> ScenarioResult:
+    import skypilot_tpu as sky  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.jobs import controller as controller_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.jobs import state as jobs_state  # pylint: disable=import-outside-toplevel
+
+    plan = faults_lib.FaultPlan(seed=seed, name='preemption_recovery',
+                                faults=[faults_lib.Fault(
+                                    site='jobs.status_poll',
+                                    effect='preempt',
+                                    nth=2, max_times=1)])
+    t0 = time.time()
+    extra: List[str] = []
+    details: Dict[str, Any] = {}
+    os.makedirs(events_lib.journal_root(), exist_ok=True)
+    marker = os.path.join(
+        events_lib.journal_root(), f'.chaos-preempt-marker-{seed}-{t0:.0f}')
+    # First run parks in a long sleep after dropping the marker; the
+    # recovered run finds the marker and exits immediately (the
+    # checkpoint-resume contract in miniature).
+    run_cmd = (f'if [ -f {marker} ]; then echo CHAOS_RESUMED; '
+               f'else touch {marker} && sleep 30; fi')
+    poll_env = {'SKYTPU_JOB_STATUS_CHECK_GAP': '0.4',
+                'SKYTPU_JOB_STARTED_CHECK_GAP': '0.4'}
+    saved_env = {k: os.environ.get(k) for k in poll_env}
+    os.environ.update(poll_env)
+    try:
+        with _local_cloud_enabled(), _armed(plan):
+            task = sky.Task(name='chaos-preempt', run=run_cmd)
+            task.set_resources(sky.Resources(cloud='local'))
+            job_id = _submit_managed(task, 'chaos-preempt')
+            details['job_id'] = job_id
+            controller_lib.JobsController(
+                job_id, jobs_state.get_job_records(job_id)[0]
+                ['dag_yaml_path']).run()
+    finally:
+        for key, value in saved_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+        try:
+            os.remove(marker)
+        except OSError:
+            pass
+
+    record = jobs_state.get_job_records(details['job_id'])[0]
+    details['status'] = record['status']
+    details['recovery_count'] = record['recovery_count']
+    details['last_recovery_reason'] = record['last_recovery_reason']
+    job_events = _since(events_lib.job_journal(details['job_id']), t0)
+    _expect(record['status'] == 'SUCCEEDED',
+            f'managed job SUCCEEDED after recovery '
+            f'(got {record["status"]})', extra)
+    _expect(record['recovery_count'] >= 1,
+            'recovery_count >= 1 after the injected eviction', extra)
+    names = [e.get('event') for e in job_events]
+    _expect('preemption_detected' in names,
+            'controller journaled preemption_detected', extra)
+    recovery_ends = [e for e in job_events
+                     if e.get('event') == 'recovery_end']
+    _expect(any(e.get('status') == 'ok' for e in recovery_ends),
+            'a recovery_end with status=ok was journaled', extra)
+    return _finish('preemption_recovery', seed, t0, job_events,
+                   ['recovery_liveness'], extra, details)
+
+
+def _submit_managed(task, name: str) -> int:
+    """Submit a managed job without spawning the controller daemon (the
+    scenario runs the controller inline for determinism)."""
+    from skypilot_tpu.jobs import core as jobs_core  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.jobs import state as jobs_state  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.utils import dag_utils  # pylint: disable=import-outside-toplevel
+    dag = dag_utils.convert_entrypoint_to_dag(task)
+    job_id = jobs_state.allocate_job_id(name)
+    yaml_path = os.path.join(jobs_core._dag_yaml_dir(),  # pylint: disable=protected-access
+                             f'{name}-{job_id}.yaml')
+    dag_utils.dump_chain_dag_to_yaml(dag, yaml_path)
+    jobs_state.submit_job(job_id, name, yaml_path,
+                          [t.name or f'task-{i}'
+                           for i, t in enumerate(dag.tasks)])
+    jobs_state.set_status(job_id, 0,
+                          jobs_state.ManagedJobStatus.SUBMITTED)
+    return job_id
+
+
+@_register(
+    'rank_crash',
+    'rank 1 of a 4-host gang dies at exec -> fail-fast abort terminates '
+    'every live rank; no rank is left running in a dead collective')
+def rank_crash(seed: int) -> ScenarioResult:
+    import skypilot_tpu as sky  # pylint: disable=import-outside-toplevel
+    plan = faults_lib.FaultPlan(seed=seed, name='rank_crash',
+                                faults=[faults_lib.Fault(
+                                    site='gang.rank_exec',
+                                    effect='raise',
+                                    where={'rank': 1},
+                                    max_times=1)])
+    cluster = f'chaos-rank-{seed}'
+    t0 = time.time()
+    extra: List[str] = []
+    details: Dict[str, Any] = {'cluster': cluster}
+    with _local_cloud_enabled(), _armed(plan):
+        try:
+            task = sky.Task(name='chaos-rank', run='sleep 30')
+            task.set_resources(
+                sky.Resources(cloud='local', accelerators='tpu-v5e-16'))
+            job_id = sky.launch(task, cluster_name=cluster,
+                                stream_logs=False, detach_run=True)
+            details['job_status'] = _wait_job(cluster, job_id)
+            gang_events = _since(
+                events_lib.cluster_job_journal(job_id), t0)
+        finally:
+            _down(cluster)
+
+    _expect(details.get('job_status') == 'FAILED',
+            f'all-or-nothing gang FAILED (got {details.get("job_status")})',
+            extra)
+    names = [e.get('event') for e in gang_events]
+    _expect('gang_abort' in names, 'gang_abort was journaled', extra)
+    gang_end = next((e for e in gang_events
+                     if e.get('event') == 'gang_end'), None)
+    _expect(gang_end is not None and gang_end.get('status') == 'fail',
+            'gang_end has status=fail', extra)
+    aborts = [e for e in gang_events if e.get('event') == 'gang_abort']
+    if aborts:
+        details['failed_rank'] = aborts[0].get('failed_rank')
+        details['victims'] = aborts[0].get('victims')
+        _expect(aborts[0].get('failed_rank') == 1,
+                f'rank 1 is the failed rank '
+                f'(got {aborts[0].get("failed_rank")})', extra)
+    return _finish('rank_crash', seed, t0, gang_events,
+                   ['gang_abort_coverage'], extra, details)
+
+
+@_register(
+    'queued_stall',
+    'queued-resource capacity never granted (deny effect) -> the wait '
+    'loop reaches its deadline and journals a terminal timeout verdict')
+def queued_stall(seed: int) -> ScenarioResult:
+    from skypilot_tpu.provision import provisioner as provisioner_lib  # pylint: disable=import-outside-toplevel
+    plan = faults_lib.FaultPlan(seed=seed, name='queued_stall',
+                                faults=[faults_lib.Fault(
+                                    site='queued_resource.poll',
+                                    effect='deny')])
+    cluster = f'chaos-queued-{seed}'
+    t0 = time.time()
+    extra: List[str] = []
+    with _armed(plan):
+        granted = provisioner_lib.wait_for_queued_capacity(
+            'local', cluster, timeout=1.2)
+    cluster_events = _since(events_lib.cluster_journal(cluster), t0)
+    details: Dict[str, Any] = {'cluster': cluster, 'granted': granted}
+    _expect(granted is False,
+            'capacity is NOT granted while every poll is denied', extra)
+    end = next((e for e in cluster_events
+                if e.get('event') == 'queued_wait_end'), None)
+    _expect(end is not None and end.get('status') == 'timeout',
+            f'queued_wait_end status=timeout '
+            f'(got {end.get("status") if end else None})', extra)
+    if end is not None:
+        details['wait_s'] = end.get('wait_s')
+        details['polls'] = end.get('polls')
+        _expect((end.get('wait_s') or 0) >= 1.0,
+                'the wait actually lasted to the deadline', extra)
+    return _finish('queued_stall', seed, t0, cluster_events,
+                   ['queued_wait_terminal'], extra, details)
+
+
+@_register(
+    'serve_replica_flap',
+    'readiness probes fail transiently -> the replica flaps READY -> '
+    'NOT_READY and returns to READY once probes pass again')
+def serve_replica_flap(seed: int) -> ScenarioResult:
+    import http.server  # pylint: disable=import-outside-toplevel
+    import threading  # pylint: disable=import-outside-toplevel
+
+    import skypilot_tpu as sky  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import replica_managers  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import serve_state  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import service_spec  # pylint: disable=import-outside-toplevel
+
+    plan = faults_lib.FaultPlan(seed=seed, name='serve_replica_flap',
+                                faults=[faults_lib.Fault(
+                                    site='serve.replica_probe',
+                                    effect='raise',
+                                    error='RequestException',
+                                    nth=[1, 2])])
+
+    class _Health(http.server.BaseHTTPRequestHandler):
+
+        def do_GET(self):  # noqa: N802  (stdlib naming)
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b'{"status": "ok"}')
+
+        def log_message(self, *args):
+            del args
+
+    server = http.server.ThreadingHTTPServer(('127.0.0.1', 0), _Health)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    service = f'chaos-flap-{seed}'
+    t0 = time.time()
+    extra: List[str] = []
+    details: Dict[str, Any] = {'service': service, 'transitions': []}
+    try:
+        spec = service_spec.SkyServiceSpec(readiness_path='/health',
+                                           initial_delay_seconds=120,
+                                           readiness_timeout_seconds=2)
+        task = sky.Task(name='chaos-flap', run='sleep 1')
+        task.set_resources(sky.Resources(cloud='local'))
+        serve_state.add_service(service, spec_json={}, task_yaml_path='')
+        manager = replica_managers.ReplicaManager(service, spec, task)
+        replica_id = serve_state.allocate_replica(service, service)
+        url = f'http://127.0.0.1:{server.server_address[1]}'
+        serve_state.set_replica_status(
+            service, replica_id, serve_state.ReplicaStatus.READY, url=url)
+        with _armed(plan):
+            for _ in range(4):
+                replica = serve_state.get_replicas(service)[0]
+                manager._probe_one(replica)  # pylint: disable=protected-access
+                status = serve_state.get_replicas(service)[0]['status']
+                details['transitions'].append(status)
+                if (len(details['transitions']) >= 3 and
+                        status == 'READY'):
+                    break
+    finally:
+        server.shutdown()
+
+    transitions = details['transitions']
+    _expect('NOT_READY' in transitions,
+            f'replica flapped to NOT_READY (transitions: {transitions})',
+            extra)
+    _expect(transitions and transitions[-1] == 'READY',
+            f'replica returned to READY (transitions: {transitions})',
+            extra)
+    chaos_events = _since(injector.chaos_journal(), t0)
+    injected = [e for e in chaos_events
+                if e.get('event') == 'chaos_fault_injected']
+    _expect(len(injected) == 2,
+            f'exactly two probe faults injected (got {len(injected)})',
+            extra)
+    return _finish('serve_replica_flap', seed, t0, [], [], extra,
+                   details)

@@ -86,6 +86,16 @@ class RuntimeVersionSkewError(SkyTpuError):
     patch skew only warns — the contract is stable within a major."""
 
 
+class TransientRunnerError(SkyTpuError):
+    """A command-runner exec failed in a way that is worth retrying
+    (ssh transport blip, connection reset, injected chaos fault) —
+    distinct from the command itself exiting non-zero."""
+
+    def __init__(self, message: str, attempts: int = 1) -> None:
+        super().__init__(message)
+        self.attempts = attempts
+
+
 class CommandError(SkyTpuError):
     """A remote or local command exited non-zero."""
 

@@ -22,6 +22,7 @@ from typing import Optional
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu import status_lib
+from skypilot_tpu.chaos import injector as chaos_injector
 from skypilot_tpu.jobs import constants
 from skypilot_tpu.jobs import recovery_strategy
 from skypilot_tpu.jobs import state
@@ -154,9 +155,28 @@ class JobsController:
                     state.ManagedJobStatus.FAILED_SETUP
                     if job_status is job_lib.JobStatus.FAILED_SETUP else
                     state.ManagedJobStatus.FAILED)
+                failure_reason = 'user code exited non-zero'
+                recovery_reason = None
+                if strategy.max_restarts_on_errors > 0:
+                    # Restart budget exhausted: persist WHY the job is
+                    # terminal (not just that it failed) and journal it
+                    # — exhaustion used to be log-only.
+                    recovery_reason = (
+                        f'max_restarts_on_errors exhausted '
+                        f'({strategy.restart_count_on_errors}/'
+                        f'{strategy.max_restarts_on_errors}); last '
+                        f'failure: {failure_reason}')
+                    failure_reason = recovery_reason
+                    journal.append(
+                        'recovery_exhausted', job_id=job_id,
+                        task_id=task_id,
+                        restarts=strategy.restart_count_on_errors,
+                        max_restarts=strategy.max_restarts_on_errors,
+                        reason=failure_reason)
                 state.set_status(
                     job_id, task_id, failed_status,
-                    failure_reason='user code exited non-zero')
+                    failure_reason=failure_reason,
+                    last_recovery_reason=recovery_reason)
                 journal.append('task_end', job_id=job_id,
                                task_id=task_id,
                                status=failed_status.value,
@@ -206,6 +226,11 @@ class JobsController:
                           remote_job_id: Optional[int]):
         from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
         try:
+            # Chaos site: the 'preempt' effect downs the task cluster
+            # behind the controller's back and raises — this poll then
+            # reports None and the real preemption-detection path runs.
+            chaos_injector.inject('jobs.status_poll', job_id=self.job_id,
+                                  cluster=cluster_name)
             statuses = core.job_status(cluster_name, [remote_job_id]
                                        if remote_job_id else None)
             if not statuses:

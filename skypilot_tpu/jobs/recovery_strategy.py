@@ -16,6 +16,7 @@ from typing import Dict, Optional, Type
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
+from skypilot_tpu.chaos import injector as chaos_injector
 from skypilot_tpu.jobs import constants
 from skypilot_tpu.observability import events as events_lib
 from skypilot_tpu.utils import common_utils
@@ -115,6 +116,13 @@ class StrategyExecutor:
                            strategy=self.NAME,
                            cluster=self.cluster_name)
         try:
+            # Chaos site: a raise here fails this recovery attempt the
+            # same way a real relaunch failure would (journaled as the
+            # recovery_end status below).
+            chaos_injector.inject('jobs.recover', job_id=self.job_id,
+                                  cluster=self.cluster_name,
+                                  attempt=self.recovery_attempts,
+                                  strategy=self.NAME)
             remote_job_id = self._do_recover()
         except Exception as e:
             if journal is not None:

@@ -141,7 +141,8 @@ def submit_job(job_id: int, job_name: str, dag_yaml_path: str,
 
 
 def set_status(job_id: int, task_id: int, status: ManagedJobStatus,
-               failure_reason: Optional[str] = None) -> None:
+               failure_reason: Optional[str] = None,
+               last_recovery_reason: Optional[str] = None) -> None:
     sets = ['status=?']
     vals: List[Any] = [status.value]
     if status is ManagedJobStatus.RUNNING:
@@ -153,6 +154,12 @@ def set_status(job_id: int, task_id: int, status: ManagedJobStatus,
     if failure_reason is not None:
         sets.append('failure_reason=?')
         vals.append(failure_reason)
+    if last_recovery_reason is not None:
+        # Terminal states reached through the recovery machinery (e.g.
+        # restart-budget exhaustion) persist why, where `jobs queue`
+        # surfaces it.
+        sets.append('last_recovery_reason=?')
+        vals.append(last_recovery_reason)
     vals += [job_id, task_id]
     with _conn() as conn:
         conn.execute(

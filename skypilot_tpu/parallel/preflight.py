@@ -16,7 +16,6 @@ and real slices.
 """
 from __future__ import annotations
 
-import functools
 import time
 from typing import Any, Dict, Optional
 
@@ -30,6 +29,21 @@ logger = sky_logging.init_logger(__name__)
 # (orders of magnitude off), not mild regressions.
 DEFAULT_MIN_BANDWIDTH_GBPS = 0.05
 DEFAULT_MAX_LATENCY_MS = 5000.0
+
+
+def _shard_map(fn, mesh, in_specs, out_specs, axis: str):
+    """Capability probe: `jax.shard_map` is the public API from jax
+    0.6+; older jax only ships `jax.experimental.shard_map.shard_map`
+    (different kwargs: `check_rep`, no `axis_names`).  Probe the
+    attribute rather than version-compare — backports exist."""
+    import jax  # pylint: disable=import-outside-toplevel
+    if hasattr(jax, 'shard_map'):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, axis_names={axis},
+                             check_vma=False)
+    from jax.experimental import shard_map as shard_map_lib  # pylint: disable=import-outside-toplevel
+    return shard_map_lib.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, check_rep=False)
 
 
 def probe_collectives(mesh, *, bandwidth_mb: float = 64.0,
@@ -79,9 +93,8 @@ def probe_collectives(mesh, *, bandwidth_mb: float = 64.0,
             # heuristics are not.
             return jax.make_array_from_callback(shape, sharding, _block)
 
-        probe = jax.jit(functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(),
-            axis_names={axis}, check_vma=False)(_probe_fn))
+        probe = jax.jit(_shard_map(_probe_fn, mesh, P(axis), P(),
+                                   axis=axis))
 
         tiny = _sharded((n, 8))
         # Each PARTICIPANT holds bandwidth_mb of payload (per-rank

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional
 from skypilot_tpu import exceptions
 from skypilot_tpu import provision
 from skypilot_tpu import sky_logging
+from skypilot_tpu.chaos import injector as chaos_injector
 from skypilot_tpu.observability import events as events_lib
 from skypilot_tpu.provision import common
 from skypilot_tpu.provision import instance_setup
@@ -64,7 +65,15 @@ def wait_for_queued_capacity(provider: str, cluster_name: str,
     interval = 10.0
     polls = 0
     while True:
-        granted = provision.wait_capacity(provider, cluster_name)
+        # Chaos site (cooperative): DENY simulates a queued-resource
+        # request stuck unprovisioned — the poll reports not-granted
+        # without touching the provider.
+        denied = chaos_injector.inject('queued_resource.poll',
+                                       cluster=cluster_name,
+                                       provider=provider,
+                                       polls=polls) is chaos_injector.DENY
+        granted = (False if denied else
+                   provision.wait_capacity(provider, cluster_name))
         polls += 1
         waited = time.monotonic() - start
         if granted:

@@ -20,6 +20,8 @@ import requests
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu import status_lib
+from skypilot_tpu.chaos import faults as chaos_faults
+from skypilot_tpu.chaos import injector as chaos_injector
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
@@ -161,6 +163,11 @@ class ReplicaManager:
             return
         ready = False
         try:
+            # Chaos site: a raise here reads as a failed probe (replica
+            # flap), never as a crashed reconcile loop.
+            chaos_injector.inject('serve.replica_probe',
+                                  service=self.service_name,
+                                  replica_id=replica_id)
             resp = requests.get(url + self.spec.readiness_path,
                                 timeout=self.spec.readiness_timeout_seconds)
             ready = resp.status_code == 200
@@ -178,7 +185,7 @@ class ReplicaManager:
                             engine.get('busy_slots', 0) / slots)
                 except (ValueError, TypeError, ZeroDivisionError):
                     pass
-        except requests.RequestException:
+        except (requests.RequestException, chaos_faults.ChaosError):
             ready = False
         status = ReplicaStatus(replica['status'])
         if ready:

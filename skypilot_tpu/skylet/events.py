@@ -16,6 +16,7 @@ import traceback
 import psutil
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.chaos import injector as chaos_injector
 from skypilot_tpu.observability import events as obs_events
 from skypilot_tpu.skylet import autostop_lib
 from skypilot_tpu.skylet import job_lib
@@ -79,6 +80,9 @@ class SkyletEvent:
         name = type(self).__name__
         t0 = time.perf_counter()
         try:
+            # Chaos site: a raise counts as an event failure, exercising
+            # the exponential failure backoff below.
+            chaos_injector.inject('skylet.tick', event=name)
             self.run()
         except Exception:  # pylint: disable=broad-except
             self._consecutive_failures += 1

@@ -18,11 +18,18 @@ import subprocess
 import time
 from typing import Any, Dict, List, Optional, Tuple, Union
 
+from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
+from skypilot_tpu.chaos import injector as chaos_injector
 from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import subprocess_utils
 
 logger = sky_logging.init_logger(__name__)
+
+# run_with_retry defaults: 3 attempts, ~1s/1.6x capped exponential
+# backoff with jitter (common_utils.Backoff).
+DEFAULT_MAX_ATTEMPTS = 3
+_RETRY_INITIAL_BACKOFF_SECONDS = 1.0
 
 GIT_EXCLUDE = '.git/info/exclude'
 RSYNC_DISPLAY_OPTION = '-Pavz'
@@ -68,6 +75,12 @@ def ssh_options_list(ssh_private_key: Optional[str],
     ] + proxy
 
 
+def _runner_retries():
+    from skypilot_tpu.observability import metrics  # pylint: disable=import-outside-toplevel
+    return metrics.counter('skytpu_runner_retries_total',
+                           'Transient command-runner exec retries')
+
+
 def _ssh_control_path(ssh_control_filename: str) -> str:
     path = f'/tmp/skytpu_ssh_{common_utils.get_user_hash()}/{ssh_control_filename}'
     os.makedirs(path, exist_ok=True)
@@ -82,6 +95,11 @@ class SshMode(enum.Enum):
 
 class CommandRunner:
     """Abstract transport to one slice host: run commands and sync files."""
+
+    # Return codes of `run` that mean the TRANSPORT failed (not the
+    # command): worth a retry.  Empty for local/kubectl transports —
+    # their exit code is the command's own.
+    TRANSIENT_RETURNCODES: Tuple[int, ...] = ()
 
     def __init__(self, node: Tuple[Any, ...], **kwargs: Any) -> None:
         del kwargs
@@ -100,6 +118,52 @@ class CommandRunner:
             process_stream: bool = True,
             **kwargs: Any) -> Union[int, Tuple[int, str, str]]:
         raise NotImplementedError
+
+    def run_with_retry(self,
+                       cmd: Union[str, List[str]],
+                       *,
+                       max_attempts: int = DEFAULT_MAX_ATTEMPTS,
+                       on_retry: Optional[Any] = None,
+                       **kwargs: Any) -> Union[int, Tuple[int, str, str]]:
+        """`run` with transient-failure retries.
+
+        One ssh blip must not fail a whole gang: a transport-level
+        failure (TransientRunnerError, or a returncode in
+        TRANSIENT_RETURNCODES — ssh's 255) is retried up to
+        `max_attempts` times with capped exponential backoff + jitter.
+        The command's own non-zero exits pass through untouched.
+        `on_retry(attempt, reason)` lets callers journal each retry;
+        exhaustion raises TransientRunnerError carrying the attempt
+        count.
+        """
+        backoff = common_utils.Backoff(_RETRY_INITIAL_BACKOFF_SECONDS,
+                                       max_backoff_factor=3)
+        last_error = 'unknown transient failure'
+        for attempt in range(1, max_attempts + 1):
+            try:
+                chaos_injector.inject('runner.exec', node=self.node_id,
+                                      attempt=attempt)
+                result = self.run(cmd, **kwargs)
+            except exceptions.TransientRunnerError as e:
+                last_error = str(e)
+            else:
+                rc = result[0] if isinstance(result, tuple) else result
+                if rc not in self.TRANSIENT_RETURNCODES:
+                    return result
+                last_error = (f'transport returned transient code {rc} '
+                              f'(node {self.node_id})')
+            if attempt == max_attempts:
+                break
+            if on_retry is not None:
+                on_retry(attempt, last_error)
+            _runner_retries().inc()
+            logger.warning(f'Transient exec failure on {self.node_id} '
+                           f'(attempt {attempt}/{max_attempts}): '
+                           f'{last_error}; retrying.')
+            time.sleep(backoff.current_backoff)
+        raise exceptions.TransientRunnerError(
+            f'Exec on {self.node_id} failed after {max_attempts} '
+            f'attempts: {last_error}', attempts=max_attempts)
 
     def rsync(self, source: str, target: str, *, up: bool,
               log_path: str = os.devnull, stream_logs: bool = True) -> None:
@@ -156,6 +220,10 @@ class SSHCommandRunner(CommandRunner):
 
     Parity: reference command_runner.py:399-654.
     """
+
+    # ssh exits 255 on transport failure (connection refused/reset,
+    # auth churn during VM boot); the command's own exits are 0-254.
+    TRANSIENT_RETURNCODES = (255,)
 
     def __init__(self,
                  node: Tuple[str, int],
@@ -386,8 +454,9 @@ def run_on_all(runners: List[CommandRunner], cmd: str,
         if log_dir is not None:
             os.makedirs(log_dir, exist_ok=True)
             log_path = os.path.join(log_dir, f'{idx}-{runner.node_id}.log')
-        return runner.run(cmd, log_path=log_path, stream_logs=stream_logs,
-                          require_outputs=require_outputs)
+        return runner.run_with_retry(cmd, log_path=log_path,
+                                     stream_logs=stream_logs,
+                                     require_outputs=require_outputs)
 
     return subprocess_utils.run_in_parallel(_one, list(enumerate(runners)))
 

@@ -1,0 +1,98 @@
+"""CI guard: chaos injection sites stay in lockstep with the registry.
+
+Style of test_no_bare_print.py (AST-based, ISSUE 5 satellite): every
+``inject(...)`` call site in skypilot_tpu/ must pass a *string literal*
+site name registered in ``chaos/faults.py`` (a computed site would dodge
+both this lint and the docs table), and every registered site must have
+at least one call site — no stale or undocumented vocabulary in either
+direction.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Dict, List
+
+import skypilot_tpu
+from skypilot_tpu.chaos import faults as faults_lib
+
+
+def _inject_calls(tree: ast.AST):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = None
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+        if name == 'inject':
+            yield node
+
+
+def _scan() -> tuple:
+    root = pathlib.Path(skypilot_tpu.__file__).parent
+    call_sites: Dict[str, List[str]] = {}
+    problems: List[str] = []
+    for path in sorted(root.rglob('*.py')):
+        rel = path.relative_to(root).as_posix()
+        if rel.startswith('chaos/'):
+            continue  # the subsystem itself, not an instrumented site
+        tree = ast.parse(path.read_text(encoding='utf-8'),
+                         filename=str(path))
+        for node in _inject_calls(tree):
+            where = f'skypilot_tpu/{rel}:{node.lineno}'
+            if (not node.args or
+                    not isinstance(node.args[0], ast.Constant) or
+                    not isinstance(node.args[0].value, str)):
+                problems.append(
+                    f'{where}: inject() must take a string-literal site '
+                    f'name as its first argument')
+                continue
+            site = node.args[0].value
+            if site not in faults_lib.SITES:
+                problems.append(
+                    f'{where}: site {site!r} is not registered in '
+                    f'chaos/faults.py SITES')
+            call_sites.setdefault(site, []).append(where)
+    return call_sites, problems
+
+
+def test_every_inject_call_uses_a_registered_site():
+    _, problems = _scan()
+    assert not problems, '\n  '.join(['chaos site lint:'] + problems)
+
+
+def test_every_registered_site_has_a_call_site():
+    call_sites, _ = _scan()
+    stale = sorted(set(faults_lib.SITES) - set(call_sites))
+    assert not stale, (
+        f'sites registered in chaos/faults.py with no inject() call '
+        f'site (remove them or instrument them): {stale}')
+
+
+def test_each_site_instruments_its_documented_layer():
+    """The site prefix names the layer; the call site must live there —
+    keeps the docs/chaos.md vocabulary table honest."""
+    expected_prefix = {
+        'provision.create': ('backends/', 'provision/'),
+        'queued_resource.poll': ('provision/',),
+        'runner.exec': ('utils/',),
+        'gang.rank_exec': ('backends/',),
+        'jobs.status_poll': ('jobs/',),
+        'jobs.recover': ('jobs/',),
+        'serve.replica_probe': ('serve/',),
+        'skylet.tick': ('skylet/',),
+    }
+    call_sites, _ = _scan()
+    assert set(expected_prefix) == set(faults_lib.SITES), (
+        'update this map (and docs/chaos.md) when the site vocabulary '
+        'changes')
+    misplaced = []
+    for site, prefixes in expected_prefix.items():
+        for where in call_sites.get(site, []):
+            rel = where.split('skypilot_tpu/', 1)[1]
+            if not rel.startswith(prefixes):
+                misplaced.append(f'{site}: {where}')
+    assert not misplaced, misplaced

@@ -24,6 +24,7 @@ from skypilot_tpu.clouds import local as local_cloud
 from skypilot_tpu.observability import events as events_lib
 from skypilot_tpu.observability import metrics
 from skypilot_tpu.provision import provisioner as provisioner_lib
+from skypilot_tpu.utils import command_runner as command_runner_lib
 
 
 # ------------------------------------------------------------- journal core
@@ -321,9 +322,12 @@ class _StubProc:
         return 0
 
 
-class _StubRunner:
+class _StubRunner(command_runner_lib.CommandRunner):
+    """Real CommandRunner subclass so the supervisor's retrying exec
+    path (run_with_retry) works against it."""
 
     def __init__(self, rc: int) -> None:
+        super().__init__(('stub', rc))
         self._rc = rc
 
     def spawn_spec(self, cmd):

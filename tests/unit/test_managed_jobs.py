@@ -166,6 +166,39 @@ class TestControllerE2E:
         assert rec['status'] == 'SUCCEEDED'
         assert rec['recovery_count'] >= 1
 
+    def test_restart_exhaustion_persists_reason_and_journals(
+            self, monkeypatch):
+        """ISSUE 5 satellite: exhausting max_restarts_on_errors lands a
+        terminal FAILED with the exhaustion reason persisted (not just
+        logged) and a recovery_exhausted journal event."""
+        from skypilot_tpu.observability import events as events_lib
+        orig_make = recovery_strategy.StrategyExecutor.make.__func__
+
+        def make_with_budget(cls, cluster_name, task, job_id=None,
+                             task_id=0):
+            strategy = orig_make(cls, cluster_name, task, job_id=job_id,
+                                 task_id=task_id)
+            strategy.max_restarts_on_errors = 1
+            return strategy
+
+        monkeypatch.setattr(recovery_strategy.StrategyExecutor, 'make',
+                            classmethod(make_with_budget))
+        job_id = _submit(_local_task(name='exhaust', run='exit 9'))
+        _run_controller(job_id)
+        rec = state.get_job_records(job_id)[0]
+        assert rec['status'] == 'FAILED'
+        assert rec['recovery_count'] == 1  # one restart was attempted
+        assert 'max_restarts_on_errors exhausted (1/1)' in \
+            rec['last_recovery_reason']
+        assert 'max_restarts_on_errors exhausted' in \
+            rec['failure_reason']
+        events = events_lib.job_events(job_id)
+        exhausted = [e for e in events
+                     if e['event'] == 'recovery_exhausted']
+        assert len(exhausted) == 1
+        assert exhausted[0]['restarts'] == 1
+        assert exhausted[0]['max_restarts'] == 1
+
     def test_cancel_requested_mid_run(self):
         job_id = _submit(_local_task(name='cancelme', run='sleep 60'))
         # Request cancellation as soon as the controller marks RUNNING.
