@@ -108,8 +108,11 @@ def main() -> None:
     start_step = 0
     mgr = None
     if checkpoints.checkpoint_dir():
-        mgr = checkpoints.checkpoint_manager(save_interval_steps=10)
-        state, start_step = checkpoints.restore_or_init(mgr, state)
+        # Async saves: the bucket write runs on a background writer
+        # (bounded in-flight, retry-with-backoff), so the checkpoint
+        # interval stops taxing step time (docs/training.md, ISSUE 6).
+        mgr = checkpoints.AsyncCheckpointManager(save_interval_steps=10)
+        state, start_step = mgr.restore_or_init(state)
         print(f'resuming from step {start_step}')
     if start_step == 0 and args.init_from:
         # Real-weights finetune start (Llama-3-8B from a converted HF
@@ -167,16 +170,11 @@ def main() -> None:
                   f'grad_norm={float(metrics["grad_norm"]):.3f}',
                   flush=True)
         if mgr is not None:
-            mgr.save(step, args=_ckpt_args(state))
+            mgr.save(step, state)
     if mgr is not None:
-        mgr.wait_until_finished()
+        mgr.close()  # wait-on-exit: drain in-flight saves
     cb.flush()
     print('done', time.strftime('%X'))
-
-
-def _ckpt_args(state):
-    import orbax.checkpoint as ocp
-    return ocp.args.StandardSave(state)
 
 
 if __name__ == '__main__':

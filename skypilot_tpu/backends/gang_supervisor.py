@@ -104,8 +104,15 @@ def run_gang(job_id: int, spec: Dict[str, Any]) -> int:
 
     journal = _journal(job_id)
     if journal is not None:
+        # rank -> host identity, so a post-mortem (and the elastic
+        # recovery path) can tell WHICH host a dead rank lived on —
+        # the report the ELASTIC strategy's survivor query confirms.
+        hosts = {str(i): inst.instance_id
+                 for i, inst in enumerate(
+                     getattr(cluster_info, 'instances', None) or [])}
         journal.append('gang_start', job_id=job_id,
-                       cluster=cluster_name, num_ranks=len(runners))
+                       cluster=cluster_name, num_ranks=len(runners),
+                       hosts=hosts)
     events_lib.gang_ranks_gauge().set(len(runners))
 
     returncodes = _run_gang_native(spec, runners, host_ips, log_dir,

@@ -377,7 +377,9 @@ class SliceBackend(backend_lib.Backend[SliceResourceHandle]):
                 global_user_state.remove_cluster(cluster_name, terminate=True)
                 if not retry_until_up:
                     raise
-                sleep_s = backoff.current_backoff()
+                # current_backoff is a property; calling it was a
+                # latent crash on every retry_until_up wait.
+                sleep_s = backoff.current_backoff
                 logger.info(
                     f'retry_until_up: all candidates exhausted; retrying in '
                     f'{sleep_s:.0f}s.')
@@ -543,7 +545,10 @@ class SliceBackend(backend_lib.Backend[SliceResourceHandle]):
             'env_contract': self._job_env_contract(handle, task, job_id),
             'log_dir': os.path.join(constants.SKY_LOGS_DIRECTORY,
                                     run_timestamp),
-            'num_hosts': handle.num_hosts,
+            # LIVE host count, not the handle's launch-time view: after
+            # an elastic shrink the gang must size itself to the hosts
+            # that actually exist.
+            'num_hosts': cluster_info.num_hosts,
             'hosts_per_slice':
                 (handle.launched_resources.tpu_spec.num_hosts
                  if handle.launched_resources.tpu_spec else 1),
@@ -571,7 +576,7 @@ class SliceBackend(backend_lib.Backend[SliceResourceHandle]):
         subprocess_utils.handle_returncode(rc, code, 'Failed to queue job.',
                                            stderr)
         logger.info(f'Job {job_id} submitted on {handle.cluster_name} '
-                    f'({handle.num_hosts} host(s)).')
+                    f'({cluster_info.num_hosts} host(s)).')
         if not detach_run:
             self.tail_logs(handle, job_id)
         return job_id

@@ -1,0 +1,146 @@
+"""The training task the elastic chaos scenarios gang-launch.
+
+Run as ``python -m skypilot_tpu.chaos.elastic_task`` on every rank of a
+local-backend cluster.  It is a REAL (tiny, CPU) training run wired
+through the framework's elastic machinery — ElasticTrainer, the async
+checkpoint manager, the checkpoint contract — so the scenario verifies
+the actual resize/restore path, not a marker-file pantomime:
+
+- Rank 0 drives the slice's mesh (2 virtual CPU devices per live host,
+  forced via XLA_FLAGS before jax imports) and checkpoints through the
+  contract dir (``SKYTPU_CHECKPOINT_DIR``).  Losses append to a shared
+  CSV so the scenario can assert loss continuity across resizes: the
+  per-step batch is a pure function of the step number, so recomputed
+  overlap steps must reproduce the first run's losses.
+- Ranks != 0 are lightweight placeholders (no jax import): they wait
+  for rank 0's done marker, standing in for the hosts a preemption
+  reclaims.
+
+Segment logic, inferred from the gang env + checkpoint state:
+
+    fresh (no checkpoint, full gang)   warm up fast so checkpoints
+                                       exist early, then train slowly
+                                       until the chaos eviction kills
+                                       the gang mid-step
+    shrunk (checkpoint, gang < full)   sharded-restore onto the small
+                                       mesh, train FINAL_STEPS; in
+                                       'shrink' mode finish (SUCCEEDED),
+                                       in 'roundtrip' mode park and
+                                       await the expansion eviction
+    expanded (checkpoint, full gang)   restore, train FINAL_STEPS,
+                                       finish
+
+Environment (set by chaos/scenarios.py via task envs):
+    SKYTPU_ELASTIC_FULL_HOSTS   full slice size (hosts)
+    SKYTPU_ELASTIC_MODE         'shrink' | 'roundtrip'
+    SKYTPU_ELASTIC_LOSS_LOG     shared CSV path: num_hosts,step,loss
+    SKYTPU_ELASTIC_FINAL_STEPS  steps after the final resume (default 4)
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_CHIPS_PER_EMULATED_HOST = 2
+
+
+def _rank0_main(num_hosts: int, full_hosts: int, mode: str,
+                loss_log: str, final_steps: int, done_marker: str) -> int:
+    # Device count must be pinned BEFORE jax imports: the mesh emulates
+    # this slice's chips — 2 per live host — so a shrunken gang really
+    # does rebuild a smaller mesh.
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    os.environ['XLA_FLAGS'] = (
+        f'--xla_force_host_platform_device_count='
+        f'{_CHIPS_PER_EMULATED_HOST * num_hosts}')
+    import jax  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.data import checkpoints  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.models import configs  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.models.elastic import ElasticTrainer  # pylint: disable=import-outside-toplevel
+
+    ckpt_dir = checkpoints.checkpoint_dir()
+    assert ckpt_dir, 'elastic task needs the checkpoint contract'
+    trainer = ElasticTrainer(configs.get_config('tiny'),
+                             checkpoint_dir=ckpt_dir,
+                             batch_size=8, seq_len=32,
+                             save_interval_steps=2,
+                             devices=jax.devices())
+    resumed = trainer.resumed_from_checkpoint
+
+    def train_and_log(num_steps: int, step_sleep_s: float = 0.0) -> None:
+        # One step at a time, appending the loss IMMEDIATELY: the
+        # eviction kills this process mid-run, and the scenario's
+        # loss-continuity check needs every completed step on disk.
+        for _ in range(num_steps):
+            for step, loss in trainer.train_steps(1):
+                with open(loss_log, 'a', encoding='utf-8') as f:
+                    f.write(f'{num_hosts},{step},{loss:.6f}\n')
+            if step_sleep_s:
+                time.sleep(step_sleep_s)
+        print(f'[elastic_task] hosts={num_hosts} trained to step '
+              f'{trainer.step}', flush=True)
+
+    if not resumed:
+        # Fresh full-size run: warm up fast so the eviction (timed by
+        # the scenario's fault plan) always lands after checkpoints
+        # exist, then train slowly until it kills us mid-step.
+        train_and_log(6)
+        train_and_log(200, step_sleep_s=0.4)
+        # Backstop (chaos never came): finish cleanly so a hung plan
+        # shows up as a missing gang_resize, not a wedged job.
+        trainer.close()
+        _touch(done_marker)
+        return 0
+
+    if num_hosts < full_hosts and mode == 'roundtrip':
+        # Shrunk and awaiting expansion: make some progress on the
+        # small mesh, then park — the capacity-returns eviction
+        # relaunches us at full size.
+        train_and_log(final_steps)
+        trainer.close()
+        time.sleep(300)
+        return 0
+
+    # Final segment: shrunk (mode 'shrink') or expanded back to full.
+    train_and_log(final_steps)
+    trainer.close()
+    _touch(done_marker)
+    return 0
+
+
+def _placeholder_main(done_marker: str) -> int:
+    """Ranks != 0: hold the host until rank 0 finishes (or the chaos
+    eviction reclaims this host)."""
+    deadline = time.time() + 600
+    while time.time() < deadline:
+        if os.path.exists(done_marker):
+            return 0
+        time.sleep(0.25)
+    return 1
+
+
+def _touch(path: str) -> None:
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write('done\n')
+
+
+def main() -> int:
+    rank = int(os.environ.get('SKYTPU_HOST_RANK', '0'))
+    num_hosts = int(os.environ.get('SKYTPU_NUM_HOSTS', '1'))
+    full_hosts = int(os.environ.get('SKYTPU_ELASTIC_FULL_HOSTS',
+                                    str(num_hosts)))
+    mode = os.environ.get('SKYTPU_ELASTIC_MODE', 'shrink')
+    loss_log = os.environ.get('SKYTPU_ELASTIC_LOSS_LOG')
+    final_steps = int(os.environ.get('SKYTPU_ELASTIC_FINAL_STEPS', '4'))
+    assert loss_log, 'SKYTPU_ELASTIC_LOSS_LOG must be set'
+    done_marker = loss_log + '.done'
+    if rank != 0:
+        return _placeholder_main(done_marker)
+    return _rank0_main(num_hosts, full_hosts, mode, loss_log,
+                       final_steps, done_marker)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -73,6 +73,10 @@ SITES: Dict[str, str] = {
     'skylet.tick':
         'skylet periodic event run (skylet/events.py) — a raise counts '
         'as an event failure and exercises the failure backoff',
+    'checkpoint.save':
+        'checkpoint write attempt (data/checkpoints.py '
+        'AsyncCheckpointManager) — a raise is a bucket-write flake; '
+        'the retry-with-backoff loop is the code under test',
 }
 
 EFFECTS = ('raise', 'preempt', 'delay', 'hang', 'deny')
@@ -105,6 +109,10 @@ class Fault:
     message: Optional[str] = None
     delay_s: float = 0.0
     deadline_s: float = 0.0
+    # preempt only: evict just these host ranks (a PARTIAL preemption —
+    # the survivors stay up, the elastic-recovery trigger).  None/empty
+    # keeps the whole-cluster eviction.
+    ranks: Optional[Sequence[int]] = None
     # Trigger: at most one of nth/every/probability; all other given
     # conditions AND together.  Call numbers are 1-based per site.
     nth: Optional[Union[int, Sequence[int]]] = None
@@ -134,6 +142,12 @@ class Fault:
             self.nth = [self.nth]
         elif self.nth is not None:
             self.nth = [int(n) for n in self.nth]
+        if self.ranks is not None:
+            self.ranks = [int(r) for r in self.ranks]
+            if self.effect != 'preempt':
+                raise ValueError(
+                    "'ranks' (partial preemption) only applies to the "
+                    "'preempt' effect")
 
     def matches_ctx(self, ctx: Dict[str, Any]) -> bool:
         """`where` is satisfied iff every key is present in ctx with an
@@ -161,6 +175,7 @@ class Fault:
         # Drop defaults for compact plans.
         for key, default in (('error', 'ChaosError'), ('message', None),
                              ('delay_s', 0.0), ('deadline_s', 0.0),
+                             ('ranks', None),
                              ('nth', None), ('every', None),
                              ('probability', None), ('max_times', None),
                              ('after_s', 0.0), ('until_s', None),

@@ -157,18 +157,36 @@ class ArmedPlan:
         if fault.effect == 'deny':
             return DENY
         if fault.effect == 'preempt':
-            self._preempt(ctx)
+            self._preempt(ctx, fault.ranks)
             raise fault.make_error()
         raise fault.make_error()  # 'raise'
 
     @staticmethod
-    def _preempt(ctx: Dict[str, Any]) -> None:
+    def _preempt(ctx: Dict[str, Any],
+                 ranks: Optional[List[int]] = None) -> None:
         """Kill the cluster named in ctx — the local-backend analogue of
-        a slice eviction (the controller sees the cluster vanish)."""
+        a slice eviction (the controller sees the cluster vanish).  With
+        `ranks`, only those hosts are evicted (a PARTIAL preemption: the
+        survivors stay up and elastic recovery can shrink onto them)."""
         cluster = ctx.get('cluster')
         if not cluster:
             logger.warning('chaos preempt effect fired without a '
                            '`cluster` in ctx; nothing to kill')
+            return
+        if ranks:
+            from skypilot_tpu import global_user_state  # pylint: disable=import-outside-toplevel
+            from skypilot_tpu import provision  # pylint: disable=import-outside-toplevel
+            try:
+                record = global_user_state.get_cluster_from_name(
+                    str(cluster))
+                provider = record['handle'].provider_name
+                evicted = provision.evict_instances(provider,
+                                                    str(cluster), ranks)
+                logger.warning(f'chaos partial preempt of {cluster}: '
+                               f'evicted {evicted}')
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'chaos partial preempt of {cluster} '
+                               f'failed: {e}')
             return
         from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
         try:

@@ -17,6 +17,12 @@ The registry maps names (used by scenarios and the CLI) to checkers:
     queued_wait_terminal   every queued_wait_start reaches a terminal
                            queued_wait_end (granted or timeout)
     spans_closed           every <name>_start has a matching <name>_end
+    resize_monotone_steps  elastic resizes preserve progress: resumes
+                           never start below the last ok checkpoint and
+                           never regress across resizes
+    checkpoint_liveness    every checkpoint_save_start reaches a
+                           terminal checkpoint_save_end (no abandoned
+                           in-flight save)
     no_injections          zero chaos_fault_injected events (clean runs)
 """
 from __future__ import annotations
@@ -146,6 +152,60 @@ def spans_closed(events: Sequence[Event]) -> List[str]:
     return violations
 
 
+def resize_monotone_steps(events: Sequence[Event]) -> List[str]:
+    """Safety: elastic resizes preserve progress.  Every train_resume
+    must start at a step >= the last successfully checkpointed step
+    (the restore actually landed), and resume steps never regress
+    across resizes — a shrink/expand may recompute at most the tail
+    after the newest checkpoint, never travel back in time."""
+    violations = []
+    last_ok_ckpt = -1
+    last_resume = -1
+    for e in events:
+        name = e.get('event')
+        if (name == 'checkpoint_save_end' and e.get('status') == 'ok'
+                and e.get('step') is not None):
+            last_ok_ckpt = max(last_ok_ckpt, int(e['step']))
+        elif name == 'train_resume' and e.get('step') is not None:
+            step = int(e['step'])
+            if step < last_resume:
+                violations.append(
+                    f'train_resume at step {step} regressed below the '
+                    f'previous resume step {last_resume}')
+            if last_ok_ckpt >= 0 and step < last_ok_ckpt:
+                violations.append(
+                    f'train_resume at step {step} lost checkpointed '
+                    f'progress (last ok save was step {last_ok_ckpt})')
+            last_resume = max(last_resume, step)
+    return violations
+
+
+def checkpoint_liveness(events: Sequence[Event]) -> List[str]:
+    """Liveness: every checkpoint_save_start reaches a terminal
+    checkpoint_save_end (ok, or a named failure after retries) — an
+    abandoned in-flight save means wait-on-exit/finalize semantics
+    broke and the newest "checkpoint" may be a torn write.  (A process
+    killed mid-save legitimately violates this — apply it to flows
+    that finish under their own power, same caveat as spans_closed.)"""
+    violations = []
+    open_saves = 0
+    for e in events:
+        name = e.get('event')
+        if name == 'checkpoint_save_start':
+            open_saves += 1
+        elif name == 'checkpoint_save_end':
+            open_saves -= 1
+            if not e.get('status'):
+                violations.append(
+                    f'checkpoint_save_end for step {e.get("step")} '
+                    f'carries no status')
+    if open_saves > 0:
+        violations.append(
+            f'{open_saves} checkpoint_save_start without '
+            f'checkpoint_save_end (in-flight save abandoned)')
+    return violations
+
+
 def no_injections(events: Sequence[Event]) -> List[str]:
     """With no plan armed, the chaos subsystem must be invisible."""
     injected = _named(events, 'chaos_fault_injected')
@@ -161,6 +221,8 @@ CHECKERS: Dict[str, Callable[[Sequence[Event]], List[str]]] = {
     'no_excluded_zone_retry': no_excluded_zone_retry,
     'queued_wait_terminal': queued_wait_terminal,
     'spans_closed': spans_closed,
+    'resize_monotone_steps': resize_monotone_steps,
+    'checkpoint_liveness': checkpoint_liveness,
     'no_injections': no_injections,
 }
 

@@ -19,6 +19,16 @@ Scenarios (CLI: ``sky chaos list`` / ``sky chaos run <name>``):
                            wait times out with a terminal verdict
 - ``serve_replica_flap``   readiness probes fail transiently → replica
                            flaps NOT_READY and returns to READY
+- ``elastic_shrink``       mid-step partial preemption → ELASTIC
+                           recovery shrinks the gang to the survivor,
+                           sharded-restores onto the smaller mesh, and
+                           resumes with loss continuity
+- ``elastic_expand``       shrink → capacity returns → expand round
+                           trip: the resumed job is relaunched at full
+                           size, progress preserved throughout
+- ``checkpoint_storm``     checkpoint-write fault storm → saves retry
+                           with backoff off the step path; training
+                           never stalls past the in-flight bound
 
 Determinism: the fault sequence (site, effect, per-site call number) is
 a pure function of plan + seed over the driven call sequence; the
@@ -30,6 +40,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
+import sys
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
@@ -438,6 +449,296 @@ def queued_stall(seed: int) -> ScenarioResult:
                 'the wait actually lasted to the deadline', extra)
     return _finish('queued_stall', seed, t0, cluster_events,
                    ['queued_wait_terminal'], extra, details)
+
+
+# ------------------------------------------------------ elastic scenarios
+
+
+_ELASTIC_FULL_HOSTS = 2
+# Poll gaps are the scenario clock: the partial eviction fires on the
+# 2nd status poll, which must land AFTER the task's warmup checkpoints
+# exist (jax import ~2-5s + 6 fast steps), hence seconds-scale gaps.
+_ELASTIC_POLL_GAP = '5.0'
+_ELASTIC_STARTED_GAP = '6.0'
+# "Resume within N steps": the resumed segment may recompute at most
+# the save interval (2) plus one in-flight save plus slack.
+_ELASTIC_MAX_LOST_STEPS = 6
+
+
+def _read_loss_log(path: str) -> List[Dict[str, Any]]:
+    rows = []
+    try:
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                hosts, step, loss = line.strip().split(',')
+                rows.append({'hosts': int(hosts), 'step': int(step),
+                             'loss': float(loss)})
+    except OSError:
+        pass
+    return rows
+
+
+def _check_loss_continuity(rows: List[Dict[str, Any]],
+                           extra: List[str],
+                           details: Dict[str, Any]) -> None:
+    """The loss-continuity contract: the batch at step k is a pure
+    function of k, so steps recomputed after a resize must reproduce
+    the pre-resize losses — a sharded restore that lost or mangled
+    state shows up as divergence here.
+
+    Rows are in append order; a change in the gang size between
+    consecutive rows marks a resize boundary.  At every boundary the
+    resumed segment must continue the run (first step <= killed step +
+    1) within the lost-work budget (save interval + one in-flight
+    save + slack)."""
+    segments: List[List[Dict[str, Any]]] = []
+    for row in rows:
+        if not segments or segments[-1][-1]['hosts'] != row['hosts']:
+            segments.append([])
+        segments[-1].append(row)
+    details['segments'] = [
+        (seg[0]['hosts'], seg[0]['step'], seg[-1]['step'])
+        for seg in segments]
+    _expect(len(segments) >= 2,
+            f'the loss log shows a resize (segments: '
+            f'{details["segments"]})', extra)
+    _expect(any(seg[0]['hosts'] < _ELASTIC_FULL_HOSTS
+                for seg in segments),
+            'some segment ran on the shrunken gang', extra)
+    for prev, cur in zip(segments, segments[1:]):
+        killed_at = prev[-1]['step']
+        resumed_at = cur[0]['step']
+        _expect(resumed_at <= killed_at + 1,
+                f'resume continues the run (resumed {resumed_at} '
+                f'after step {killed_at})', extra)
+        _expect(killed_at - resumed_at <= _ELASTIC_MAX_LOST_STEPS,
+                f'resume within {_ELASTIC_MAX_LOST_STEPS} steps '
+                f'(lost {killed_at - resumed_at})', extra)
+    by_step: Dict[int, List[float]] = {}
+    for r in rows:
+        by_step.setdefault(r['step'], []).append(r['loss'])
+    overlap = {s: ls for s, ls in by_step.items() if len(ls) > 1}
+    details['overlap_steps'] = sorted(overlap)
+    if overlap:
+        max_div = max(max(ls) - min(ls) for ls in overlap.values())
+        details['max_loss_divergence'] = max_div
+        _expect(max_div < 1e-3,
+                f'no loss divergence on recomputed steps '
+                f'(max {max_div:.2e})', extra)
+
+
+def _run_elastic(name: str, seed: int, mode: str,
+                 faults: List[faults_lib.Fault],
+                 expect_expand: bool) -> ScenarioResult:
+    import skypilot_tpu as sky  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.jobs import controller as controller_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.jobs import state as jobs_state  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.utils import common_utils  # pylint: disable=import-outside-toplevel
+
+    plan = faults_lib.FaultPlan(seed=seed, name=name, faults=faults)
+    t0 = time.time()
+    extra: List[str] = []
+    details: Dict[str, Any] = {}
+    workdir = os.path.join(common_utils.skytpu_home(),
+                           f'chaos-{name}-{seed}-{t0:.0f}')
+    os.makedirs(workdir, exist_ok=True)
+    ckpt_dir = os.path.join(workdir, 'ckpt')
+    loss_log = os.path.join(workdir, 'loss.csv')
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    run_cmd = (f'PYTHONPATH={repo_root}:$PYTHONPATH '
+               f'{sys.executable} -u -m skypilot_tpu.chaos.elastic_task')
+    poll_env = {'SKYTPU_JOB_STATUS_CHECK_GAP': _ELASTIC_POLL_GAP,
+                'SKYTPU_JOB_STARTED_CHECK_GAP': _ELASTIC_STARTED_GAP}
+    saved_env = {k: os.environ.get(k) for k in poll_env}
+    os.environ.update(poll_env)
+    cluster = None
+    try:
+        with _local_cloud_enabled(), _armed(plan):
+            task = sky.Task(
+                name=f'el-{mode}', num_nodes=_ELASTIC_FULL_HOSTS,
+                run=run_cmd, checkpoint_dir=ckpt_dir,
+                envs={
+                    'SKYTPU_ELASTIC_FULL_HOSTS':
+                        str(_ELASTIC_FULL_HOSTS),
+                    'SKYTPU_ELASTIC_MODE': mode,
+                    'SKYTPU_ELASTIC_LOSS_LOG': loss_log,
+                })
+            task.set_resources(
+                sky.Resources(cloud='local', job_recovery='ELASTIC'))
+            job_id = _submit_managed(task, name)
+            details['job_id'] = job_id
+            cluster = f'el-{mode}-{job_id}-0'
+            controller_lib.JobsController(
+                job_id, jobs_state.get_job_records(job_id)[0]
+                ['dag_yaml_path']).run()
+    finally:
+        for key, value in saved_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+        if cluster is not None:
+            _down(cluster)
+
+    record = jobs_state.get_job_records(details['job_id'])[0]
+    details['status'] = record['status']
+    details['recovery_count'] = record['recovery_count']
+    details['last_recovery_reason'] = record['last_recovery_reason']
+    job_events = _since(events_lib.job_journal(details['job_id']), t0)
+    training_events = _since(events_lib.training_journal(), t0)
+
+    _expect(record['status'] == 'SUCCEEDED',
+            f'managed job SUCCEEDED through the resize(s) '
+            f'(got {record["status"]})', extra)
+    resizes = [e for e in job_events if e.get('event') == 'gang_resize']
+    details['resizes'] = [(e.get('from'), e.get('to'),
+                           e.get('direction')) for e in resizes]
+    shrinks = [e for e in resizes if e.get('direction') == 'shrink']
+    _expect(bool(shrinks), 'a gang_resize shrink was journaled', extra)
+    if shrinks:
+        _expect(shrinks[0].get('from') == _ELASTIC_FULL_HOSTS and
+                shrinks[0].get('to') == _ELASTIC_FULL_HOSTS - 1,
+                f'shrink resized {_ELASTIC_FULL_HOSTS}→'
+                f'{_ELASTIC_FULL_HOSTS - 1} '
+                f'(got {details["resizes"]})', extra)
+    resumes = [e for e in training_events
+               if e.get('event') == 'train_resume']
+    details['resumes'] = [(e.get('step'), e.get('devices'),
+                           e.get('restored')) for e in resumes]
+    _expect(any(e.get('restored') for e in resumes),
+            'a sharded restore onto the rebuilt mesh was journaled '
+            f'(train_resume restored=True; got {details["resumes"]})',
+            extra)
+    if expect_expand:
+        expands = [e for e in resizes
+                   if e.get('direction') == 'expand']
+        _expect(bool(expands), 'a gang_resize expand was journaled',
+                extra)
+        _expect(record['recovery_count'] >= 2,
+                'two recoveries (shrink, then expand)', extra)
+        _expect(record['last_recovery_reason'] ==
+                f'elastic_expand({_ELASTIC_FULL_HOSTS - 1}→'
+                f'{_ELASTIC_FULL_HOSTS})',
+                f'last_recovery_reason records the expand '
+                f'(got {record["last_recovery_reason"]!r})', extra)
+    else:
+        _expect(record['last_recovery_reason'] ==
+                f'elastic_shrink({_ELASTIC_FULL_HOSTS}→'
+                f'{_ELASTIC_FULL_HOSTS - 1})',
+                f'last_recovery_reason records the shrink '
+                f'(got {record["last_recovery_reason"]!r})', extra)
+    _check_loss_continuity(_read_loss_log(loss_log), extra, details)
+
+    # checkpoint_liveness is deliberately NOT applied here: the
+    # eviction may kill the writer thread mid-save, legitimately
+    # leaving one checkpoint_save_start unterminated (same caveat as
+    # spans_closed for crashed processes).
+    scoped = invariants.merge(job_events, training_events)
+    return _finish(name, seed, t0, scoped,
+                   ['recovery_liveness', 'resize_monotone_steps'],
+                   extra, details)
+
+
+@_register(
+    'elastic_shrink',
+    'mid-step partial preemption (1 of 2 hosts evicted) -> ELASTIC '
+    'recovery shrinks the gang to the survivor, sharded-restores onto '
+    'the smaller mesh, and resumes within the save interval with loss '
+    'continuity')
+def elastic_shrink(seed: int) -> ScenarioResult:
+    return _run_elastic(
+        'elastic_shrink', seed, mode='shrink',
+        faults=[faults_lib.Fault(site='jobs.status_poll',
+                                 effect='preempt', ranks=[1],
+                                 nth=2, max_times=1)],
+        expect_expand=False)
+
+
+@_register(
+    'elastic_expand',
+    'shrink -> capacity returns -> expand round trip: a partial '
+    'eviction shrinks the gang, a later full eviction (capacity '
+    'returning) relaunches at full size, progress preserved end to end')
+def elastic_expand(seed: int) -> ScenarioResult:
+    return _run_elastic(
+        'elastic_expand', seed, mode='roundtrip',
+        faults=[
+            faults_lib.Fault(site='jobs.status_poll', effect='preempt',
+                             ranks=[1], nth=2, max_times=1),
+            faults_lib.Fault(site='jobs.status_poll', effect='preempt',
+                             nth=6, max_times=1),
+        ],
+        expect_expand=True)
+
+
+@_register(
+    'checkpoint_storm',
+    'checkpoint-write fault storm -> every save retries with backoff '
+    'off the step path, training never stalls past the in-flight '
+    'bound, and the journal shows the retries')
+def checkpoint_storm(seed: int) -> ScenarioResult:
+    import numpy as np  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.data import checkpoints  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.utils import common_utils  # pylint: disable=import-outside-toplevel
+
+    # Per-site call counter semantics make the storm deterministic:
+    # save 1 fails its 1st+2nd write attempts (calls 1,2), save 2 its
+    # 1st (call 4), save 4 its 1st (call 7); everything else succeeds.
+    plan = faults_lib.FaultPlan(seed=seed, name='checkpoint_storm',
+                                faults=[faults_lib.Fault(
+                                    site='checkpoint.save',
+                                    effect='raise', error='OSError',
+                                    message='chaos: bucket write flake',
+                                    nth=[1, 2, 4, 7])])
+    t0 = time.time()
+    extra: List[str] = []
+    details: Dict[str, Any] = {}
+    workdir = os.path.join(common_utils.skytpu_home(),
+                           f'chaos-ckpt-storm-{seed}-{t0:.0f}')
+    journal = events_lib.training_journal()
+    num_steps = 5
+    state = {'w': np.arange(1024, dtype=np.float32)}
+    step_seconds: List[float] = []
+    with _armed(plan):
+        mgr = checkpoints.AsyncCheckpointManager(
+            workdir, save_interval_steps=1, max_in_flight=1,
+            max_retries=3, retry_backoff_s=0.02, journal=journal)
+        for step in range(num_steps):
+            t_step = time.monotonic()
+            state = {'w': state['w'] + 1.0}  # the "train step"
+            mgr.save(step, state)
+            step_seconds.append(time.monotonic() - t_step)
+        mgr.close()
+
+    training_events = _since(journal, t0)
+    ends = [e for e in training_events
+            if e.get('event') == 'checkpoint_save_end']
+    details['saves'] = [(e.get('step'), e.get('status'),
+                         e.get('attempts')) for e in ends]
+    details['blocked_seconds'] = round(mgr.blocked_seconds, 6)
+    details['max_step_seconds'] = round(max(step_seconds), 6)
+    _expect(len(ends) == num_steps,
+            f'{num_steps} saves reached a terminal status '
+            f'(got {len(ends)})', extra)
+    _expect(all(e.get('status') == 'ok' for e in ends),
+            f'every save eventually succeeded (got {details["saves"]})',
+            extra)
+    _expect(any((e.get('attempts') or 0) > 1 for e in ends),
+            'the journal shows retries (attempts > 1)', extra)
+    _expect(mgr.latest_step() == num_steps - 1,
+            f'newest checkpoint is step {num_steps - 1} '
+            f'(got {mgr.latest_step()})', extra)
+    # Never stalls past the in-flight bound: a step waits at most for
+    # ONE in-flight save (not the whole storm's retries serially).
+    save_wall = sum(float(e.get('duration_s') or 0) for e in ends)
+    _expect(details['max_step_seconds'] <= save_wall + 1.0,
+            f'no step stalled past the in-flight bound '
+            f'(max step {details["max_step_seconds"]}s vs total save '
+            f'wall {round(save_wall, 3)}s)', extra)
+    return _finish('checkpoint_storm', seed, t0, training_events,
+                   ['checkpoint_liveness'], extra, details)
 
 
 @_register(

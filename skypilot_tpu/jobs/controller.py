@@ -133,6 +133,42 @@ class JobsController:
                 return True
             if job_status in (job_lib.JobStatus.FAILED,
                               job_lib.JobStatus.FAILED_SETUP):
+                # Classify before blaming user code: a gang whose rank
+                # died because its HOST was reclaimed exits FAILED all
+                # the same (fail-fast abort), but the cluster view
+                # shows the partial loss — that is a preemption, and
+                # charging it to the restart budget would burn the
+                # budget on the cloud's behavior.
+                cluster_status = self._query_cluster_status(cluster_name)
+                if cluster_status is not status_lib.ClusterStatus.UP:
+                    status_str = (cluster_status.value
+                                  if cluster_status is not None
+                                  else 'gone')
+                    reason = (f'cluster {cluster_name} partially '
+                              f'preempted/lost (status: {status_str}; '
+                              f'gang failed)')
+                    logger.info(f'job FAILED but cluster is '
+                                f'{status_str}; classifying as '
+                                f'preemption and recovering')
+                    events_lib.jobs_preemptions().inc()
+                    journal.append('preemption_detected', job_id=job_id,
+                                   task_id=task_id, cluster=cluster_name,
+                                   cluster_status=status_str,
+                                   via='gang_failure')
+                    state.set_recovering(job_id, task_id, reason=reason)
+                    try:
+                        remote_job_id = strategy.recover()
+                    except exceptions.ResourcesUnavailableError as e:
+                        state.set_status(
+                            job_id, task_id,
+                            state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                            failure_reason=common_utils.format_exception(
+                                e))
+                        return False
+                    state.set_status(job_id, task_id,
+                                     state.ManagedJobStatus.RUNNING)
+                    time.sleep(_check_gap())
+                    continue
                 # User-code failure: bounded restarts, then fail the job
                 # (parity: reference controller.py max_restarts_on_errors).
                 if (strategy.restart_count_on_errors <

@@ -65,6 +65,11 @@ class StrategyExecutor:
         self.job_id = job_id
         self.task_id = task_id
         self.recovery_attempts = 0
+        # Where the previous successful launch landed (region/zone),
+        # captured at launch time — the cluster record is gone by the
+        # time a recovery wants to prefer the same region.
+        self._last_region: Optional[str] = None
+        self._last_zone: Optional[str] = None
 
     @classmethod
     def make(cls, cluster_name: str, task: 'task_lib.Task',
@@ -160,37 +165,76 @@ class StrategyExecutor:
                 f'cleanup of {self.cluster_name} failed (will still '
                 f'relaunch): {common_utils.format_exception(e)}')
 
+    def _record_launch_location(self) -> None:
+        """Remember where the launch landed, for prefer_same_region
+        recoveries (the cluster record does not survive cleanup)."""
+        from skypilot_tpu import global_user_state  # pylint: disable=import-outside-toplevel
+        try:
+            record = global_user_state.get_cluster_from_name(
+                self.cluster_name)
+        except Exception:  # pylint: disable=broad-except
+            return
+        if record is None or record.get('handle') is None:
+            return
+        launched = getattr(record['handle'], 'launched_resources', None)
+        if launched is not None:
+            self._last_region = launched.region
+            self._last_zone = launched.zone
+
+    def _pin_resources(self):
+        """The task's resources pinned to the previous launch's
+        region/zone — the optimizer then searches only the capacity
+        pool the slice just ran in (cheap if the outage was
+        transient)."""
+        return type(self.task.resources)(
+            r.copy(region=self._last_region, zone=self._last_zone)
+            for r in self.task.resources)
+
     def _launch(self, prefer_same_region: bool,
                 raise_on_failure: bool = True) -> Optional[int]:
         from skypilot_tpu import execution  # pylint: disable=import-outside-toplevel
-        del prefer_same_region  # used by subclasses via task mutation
         journal = self._journal()
         backoff = common_utils.Backoff(_RETRY_GAP_SECONDS)
-        for attempt in range(_MAX_LAUNCH_RETRY):
-            try:
-                job_id = execution.launch(
-                    self.task, cluster_name=self.cluster_name,
-                    stream_logs=False, detach_run=True,
-                    retry_until_up=self.retry_until_up)
-                if journal is not None:
-                    journal.append('launch_attempt', job_id=self.job_id,
-                                   task_id=self.task_id,
-                                   attempt=attempt + 1, status='ok',
-                                   cluster=self.cluster_name)
-                return job_id
-            except exceptions.ResourcesUnavailableError as e:
-                if journal is not None:
-                    journal.append('launch_attempt', job_id=self.job_id,
-                                   task_id=self.task_id,
-                                   attempt=attempt + 1, status='fail',
-                                   cluster=self.cluster_name,
-                                   error=str(e)[:500])
-                if raise_on_failure and attempt == _MAX_LAUNCH_RETRY - 1:
-                    raise
-                logger.info(f'launch attempt {attempt + 1} failed: '
-                            f'{common_utils.format_exception(e)}')
-                time.sleep(backoff.current_backoff())
-        return None
+        original_resources = self.task.resources
+        if prefer_same_region and self._last_region is not None:
+            # Pin the optimizer to the previous launch's region/zone
+            # for this attempt; the pin is dropped (resources restored)
+            # before any fallback attempt re-searches the full space.
+            self.task.set_resources(self._pin_resources())
+        try:
+            for attempt in range(_MAX_LAUNCH_RETRY):
+                try:
+                    job_id = execution.launch(
+                        self.task, cluster_name=self.cluster_name,
+                        stream_logs=False, detach_run=True,
+                        retry_until_up=self.retry_until_up)
+                    self._record_launch_location()
+                    if journal is not None:
+                        journal.append('launch_attempt',
+                                       job_id=self.job_id,
+                                       task_id=self.task_id,
+                                       attempt=attempt + 1, status='ok',
+                                       cluster=self.cluster_name)
+                    return job_id
+                except exceptions.ResourcesUnavailableError as e:
+                    if journal is not None:
+                        journal.append('launch_attempt',
+                                       job_id=self.job_id,
+                                       task_id=self.task_id,
+                                       attempt=attempt + 1, status='fail',
+                                       cluster=self.cluster_name,
+                                       error=str(e)[:500])
+                    if (raise_on_failure and
+                            attempt == _MAX_LAUNCH_RETRY - 1):
+                        raise
+                    logger.info(f'launch attempt {attempt + 1} failed: '
+                                f'{common_utils.format_exception(e)}')
+                    # (current_backoff is a property — calling it was a
+                    # latent crash on every real launch retry.)
+                    time.sleep(backoff.current_backoff)
+            return None
+        finally:
+            self.task.set_resources(original_resources)
 
 
 @_register('EAGER_NEXT_REGION')
@@ -219,3 +263,139 @@ class FailoverStrategy(StrategyExecutor):
         if job_id is not None:
             return job_id
         return self._launch(prefer_same_region=False)
+
+
+@_register('ELASTIC')
+class ElasticStrategy(StrategyExecutor):
+    """Recovery = resize, not restart.
+
+    On a PARTIAL preemption (some hosts of the slice reclaimed, the
+    rest alive — the gang supervisor's abort reports the dead ranks,
+    the provider query shows the mixed host state), the gang shrinks to
+    the surviving hosts: dead hosts are trimmed from the cluster, the
+    task is re-exec'd on the survivors (no teardown, no re-provision),
+    and the task resumes from the checkpoint contract onto a smaller
+    mesh (models/elastic.py).  When capacity returns, a later recovery
+    EXPANDS back to the full slice via a full-size relaunch.  Full
+    evictions (nothing survives) fall back to the eager relaunch.
+
+    Every resize is journaled ``gang_resize{from,to}`` and persisted as
+    ``last_recovery_reason=elastic_shrink(n→m)`` / ``elastic_expand``
+    so `jobs queue` post-mortems distinguish resize from relaunch, and
+    the PR 4 recovery-seconds histograms price each path.
+    """
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        # Full-size host count, learned from the live cluster; set once
+        # a shrink happens so a later recovery knows what to expand to.
+        self._full_hosts: Optional[int] = None
+        self._current_hosts: Optional[int] = None
+
+    # ------------------------------------------------------------ helpers
+
+    def _provider_name(self) -> Optional[str]:
+        from skypilot_tpu import global_user_state  # pylint: disable=import-outside-toplevel
+        record = global_user_state.get_cluster_from_name(self.cluster_name)
+        if record is None or record.get('handle') is None:
+            return None
+        return record['handle'].provider_name
+
+    def _surviving_hosts(self) -> tuple:
+        """(alive, total) from the provider's live view; (0, 0) when
+        the cluster is gone entirely."""
+        from skypilot_tpu import provision  # pylint: disable=import-outside-toplevel
+        from skypilot_tpu import status_lib  # pylint: disable=import-outside-toplevel
+        provider = self._provider_name()
+        if provider is None:
+            return 0, 0
+        try:
+            statuses = provision.query_instances(provider,
+                                                 self.cluster_name)
+        except Exception:  # pylint: disable=broad-except
+            return 0, 0
+        alive = sum(1 for s in statuses.values()
+                    if s is status_lib.ClusterStatus.UP)
+        return alive, len(statuses)
+
+    def _set_reason(self, reason: str) -> None:
+        if self.job_id is None:
+            return
+        from skypilot_tpu.jobs import state  # pylint: disable=import-outside-toplevel
+        state.set_last_recovery_reason(self.job_id, self.task_id, reason)
+
+    def _journal_resize(self, old: int, new: int, direction: str) -> None:
+        events_lib.gang_resizes().labels(direction=direction).inc()
+        journal = self._journal()
+        if journal is not None:
+            journal.append('gang_resize', **{'from': old, 'to': new},
+                           job_id=self.job_id, task_id=self.task_id,
+                           direction=direction,
+                           cluster=self.cluster_name)
+
+    # ------------------------------------------------------------ recover
+
+    def _do_recover(self) -> Optional[int]:
+        alive, total = self._surviving_hosts()
+        if 0 < alive < total:
+            try:
+                return self._shrink(alive, total)
+            except exceptions.SkyTpuError as e:
+                logger.warning(
+                    f'elastic shrink of {self.cluster_name} failed '
+                    f'({common_utils.format_exception(e)}); falling '
+                    f'back to full relaunch')
+            except NotImplementedError:
+                logger.info(
+                    f'{self.cluster_name}: provider has no partial-loss '
+                    f'semantics; falling back to full relaunch')
+        return self._relaunch_full()
+
+    def _shrink(self, alive: int, total: int) -> Optional[int]:
+        """Trim dead hosts and re-exec on the survivors — the task
+        resumes from its checkpoint onto the smaller gang."""
+        from skypilot_tpu import execution  # pylint: disable=import-outside-toplevel
+        from skypilot_tpu import global_user_state  # pylint: disable=import-outside-toplevel
+        from skypilot_tpu import provision  # pylint: disable=import-outside-toplevel
+        from skypilot_tpu import status_lib  # pylint: disable=import-outside-toplevel
+        provider = self._provider_name()
+        if provider is None:
+            raise exceptions.ClusterNotUpError(
+                f'{self.cluster_name} has no handle')
+        survivors = provision.trim_instances(provider, self.cluster_name)
+        # The drift matrix marked the mixed-state cluster INIT; after
+        # the trim the surviving hosts ARE the (smaller) healthy
+        # cluster, runtime intact.
+        global_user_state.set_cluster_status(self.cluster_name,
+                                             status_lib.ClusterStatus.UP)
+        if self._full_hosts is None:
+            self._full_hosts = total
+        self._current_hosts = survivors
+        self._journal_resize(total, survivors, 'shrink')
+        self._set_reason(f'elastic_shrink({total}→{survivors})')
+        logger.info(f'elastic shrink: {self.cluster_name} '
+                    f'{total} -> {survivors} host(s); resuming from '
+                    f'checkpoint on the survivors')
+        return execution.exec(self.task, cluster_name=self.cluster_name,
+                              stream_logs=False, detach_run=True)
+
+    def _relaunch_full(self) -> Optional[int]:
+        """Full relaunch at the originally-requested size.  While
+        shrunk, this IS the expand path: capacity returning lets the
+        provision land the full slice again."""
+        expanding = (self._full_hosts is not None and
+                     self._current_hosts is not None and
+                     self._current_hosts < self._full_hosts)
+        self.cleanup_cluster()
+        job_id = self._launch(prefer_same_region=False)
+        if expanding:
+            self._journal_resize(self._current_hosts, self._full_hosts,
+                                 'expand')
+            self._set_reason(f'elastic_expand({self._current_hosts}→'
+                             f'{self._full_hosts})')
+            logger.info(f'elastic expand: {self.cluster_name} '
+                        f'{self._current_hosts} -> {self._full_hosts} '
+                        f'host(s)')
+        # A full relaunch lands the originally-requested size either way.
+        self._current_hosts = self._full_hosts
+        return job_id

@@ -185,6 +185,19 @@ def set_recovering(job_id: int, task_id: int,
             'WHERE job_id=? AND task_id=?', vals)
 
 
+def set_last_recovery_reason(job_id: int, task_id: int,
+                             reason: str) -> None:
+    """Refine WHY the current recovery is happening once the strategy
+    has classified it (e.g. ``elastic_shrink(2→1)`` vs a full
+    relaunch) — the controller records a generic reason at detection
+    time, before the strategy knows whether it will resize or
+    relaunch.  `jobs queue` REASON surfaces whichever wrote last."""
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE managed_jobs SET last_recovery_reason=? '
+            'WHERE job_id=? AND task_id=?', (reason, job_id, task_id))
+
+
 def set_cluster_name(job_id: int, task_id: int,
                      cluster_name: str) -> None:
     with _conn() as conn:

@@ -1,0 +1,203 @@
+"""Elastic training: survive gang resizes without losing progress.
+
+The step from "recovery = restart" to "recovery = resize"
+(ROADMAP item 4): when a partial preemption kills some hosts of a
+slice, the surviving capacity keeps training instead of idling through
+a full teardown/relaunch —
+
+1. the gang shrinks to the surviving hosts (jobs/recovery_strategy.py
+   ELASTIC at the orchestration layer),
+2. the mesh is rebuilt over the remaining devices with re-inferred
+   data/fsdp axis sizes (parallel/mesh.py elastic_mesh_config — model
+   axes never change),
+3. the latest checkpoint is restored SHARDED onto the smaller mesh
+   (data/checkpoints.py restore_sharded — orbax reshards on read), and
+4. training resumes; when capacity returns a later recovery expands
+   back the same way.
+
+:class:`ElasticTrainer` packages steps 2-4 for user code (and for the
+chaos elastic scenarios, which are the executable spec of this
+contract).  Every resize is journaled ``gang_resize{from,to}`` and
+every resume ``train_resume{step}`` into the training journal, so the
+flight recorder shows resize → sharded restore → resume as one
+timeline and the invariant checkers (chaos/invariants.py
+resize_monotone_steps) can replay it.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import events as events_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+class ElasticTrainer:
+    """Drive train steps over a resizable device mesh with async
+    checkpointing.
+
+    The trainer owns: the mesh (rebuilt on resize), the train state
+    (restored sharded from the newest checkpoint), the jitted step, and
+    an :class:`~skypilot_tpu.data.checkpoints.AsyncCheckpointManager`
+    (finalized before every resize, so no in-flight save is abandoned).
+    """
+
+    def __init__(self,
+                 cfg: Any,
+                 tcfg: Any = None,
+                 *,
+                 checkpoint_dir: str,
+                 mesh_config: Any = None,
+                 batch_size: int = 8,
+                 seq_len: int = 64,
+                 devices: Optional[Sequence[Any]] = None,
+                 save_interval_steps: int = 2,
+                 max_in_flight: int = 1,
+                 async_save: bool = True,
+                 max_retries: int = 3,
+                 retry_backoff_s: float = 0.05,
+                 journal: Optional[Any] = None) -> None:
+        import jax  # pylint: disable=import-outside-toplevel
+        from skypilot_tpu.models.train import TrainConfig  # pylint: disable=import-outside-toplevel
+        from skypilot_tpu.parallel import mesh as mesh_lib  # pylint: disable=import-outside-toplevel
+        self.cfg = cfg
+        self.tcfg = tcfg or TrainConfig()
+        self.checkpoint_dir = checkpoint_dir
+        self.mesh_config = mesh_config or mesh_lib.MeshConfig(data=1,
+                                                              fsdp=-1)
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.save_interval_steps = save_interval_steps
+        self.max_in_flight = max_in_flight
+        self.async_save = async_save
+        self.max_retries = max_retries
+        self.retry_backoff_s = retry_backoff_s
+        self._journal = (journal if journal is not None
+                         else events_lib.training_journal())
+        self.devices = list(devices) if devices is not None \
+            else list(jax.devices())
+        self.mesh = None
+        self.state = None
+        self.shardings = None
+        self.step = 0
+        self.resumed_from_checkpoint = False
+        self._step_fn = None
+        self._ckpt = None
+        self._setup(self.devices)
+
+    # ------------------------------------------------------------- setup
+
+    def _setup(self, devices: Sequence[Any]) -> None:
+        from skypilot_tpu.data import checkpoints  # pylint: disable=import-outside-toplevel
+        from skypilot_tpu.models import train as train_lib  # pylint: disable=import-outside-toplevel
+        from skypilot_tpu.parallel import mesh as mesh_lib  # pylint: disable=import-outside-toplevel
+        from skypilot_tpu.parallel.sharding import token_batch_sharding  # pylint: disable=import-outside-toplevel
+        self.devices = list(devices)
+        cfgm = mesh_lib.elastic_mesh_config(self.mesh_config,
+                                            len(self.devices))
+        self.mesh = mesh_lib.build_mesh(cfgm, devices=self.devices)
+        abstract, shardings = train_lib.abstract_train_state(
+            self.cfg, self.tcfg, mesh=self.mesh,
+            batch_size=self.batch_size, seq_len=self.seq_len)
+        state, start_step = checkpoints.restore_sharded(
+            self.checkpoint_dir, abstract, shardings)
+        self.resumed_from_checkpoint = state is not None
+        if state is None:
+            state, shardings = train_lib.create_train_state(
+                self.cfg, self.tcfg, mesh=self.mesh,
+                batch_size=self.batch_size, seq_len=self.seq_len)
+            start_step = 0
+        self.state = state
+        self.shardings = shardings
+        self.step = start_step
+        self._step_fn = train_lib.jit_train_step(
+            shardings, token_batch_sharding(self.mesh), self.tcfg)
+        self._ckpt = checkpoints.AsyncCheckpointManager(
+            self.checkpoint_dir,
+            save_interval_steps=self.save_interval_steps,
+            max_in_flight=self.max_in_flight,
+            async_save=self.async_save,
+            max_retries=self.max_retries,
+            retry_backoff_s=self.retry_backoff_s,
+            journal=self._journal)
+        self._journal.append('train_resume', step=start_step,
+                             devices=len(self.devices),
+                             mesh={k: int(v)
+                                   for k, v in self.mesh.shape.items()},
+                             restored=self.resumed_from_checkpoint)
+        logger.info(f'elastic trainer: step {start_step}, '
+                    f'{len(self.devices)} device(s), mesh '
+                    f'{dict(self.mesh.shape)}, '
+                    f'restored={self.resumed_from_checkpoint}')
+
+    # ----------------------------------------------------------- training
+
+    def default_batch(self, step: int) -> Dict[str, Any]:
+        """Deterministic per-step batch (a pure function of the step
+        number, NOT of mesh size or host count) — the property the
+        loss-continuity chaos invariant relies on."""
+        import jax  # pylint: disable=import-outside-toplevel
+        import jax.numpy as jnp  # pylint: disable=import-outside-toplevel
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(step),
+            (self.batch_size, self.seq_len + 1), 0, self.cfg.vocab_size,
+            dtype=jnp.int32)
+        return {'tokens': tokens}
+
+    def train_steps(self, num_steps: int,
+                    batch_fn: Optional[Callable[[int], Dict[str, Any]]]
+                    = None,
+                    step_sleep_s: float = 0.0
+                    ) -> List[Tuple[int, float]]:
+        """Run `num_steps` optimizer steps from the current step;
+        returns [(step, loss)].  Checkpoints ride the save interval via
+        the async manager — the save's bucket write never blocks the
+        next step (beyond the bounded in-flight slot)."""
+        batch_fn = batch_fn or self.default_batch
+        losses: List[Tuple[int, float]] = []
+        for _ in range(num_steps):
+            step = self.step
+            batch = batch_fn(step)
+            self.state, metrics = self._step_fn(self.state, batch)
+            loss = float(metrics['loss'])
+            losses.append((step, loss))
+            self.step = step + 1
+            self._ckpt.save(step, self.state)
+            if step_sleep_s:
+                time.sleep(step_sleep_s)
+        return losses
+
+    # ------------------------------------------------------------- resize
+
+    def resize(self, devices: Sequence[Any],
+               reason: str = '') -> None:
+        """Shrink/expand to `devices`: finalize in-flight saves, journal
+        ``gang_resize{from,to}``, rebuild the mesh with re-inferred
+        data/fsdp axes, and sharded-restore the newest checkpoint onto
+        it.  Any progress after the last checkpoint is recomputed — the
+        resize contract trades at most one save interval of work for
+        not losing the slice."""
+        old = len(self.devices)
+        new = len(devices)
+        self._ckpt.close()
+        direction = 'shrink' if new < old else 'expand'
+        events_lib.gang_resizes().labels(direction=direction).inc()
+        self._journal.append('gang_resize',
+                             **{'from': old, 'to': new},
+                             direction=direction, reason=reason or None)
+        logger.info(f'elastic resize ({direction}): {old} -> {new} '
+                    f'device(s)')
+        self._setup(devices)
+
+    # -------------------------------------------------------------- misc
+
+    @property
+    def checkpointer(self):
+        return self._ckpt
+
+    def close(self) -> None:
+        """Wait-on-exit: drain queued saves before returning."""
+        if self._ckpt is not None:
+            self._ckpt.close()

@@ -77,6 +77,46 @@ def loss_fn(logits, targets, mask=None, reduction: str = 'mean'):
     return -jnp.sum(ll) / jnp.maximum(jnp.sum(mask), 1)
 
 
+def _init_fn(cfg: ModelConfig, tcfg: TrainConfig, mesh,
+             batch_size: int, seq_len: int):
+    model = Transformer(cfg, mesh)
+    tokens = jnp.zeros((batch_size, seq_len), jnp.int32)
+    tx = make_optimizer(tcfg)
+
+    def init_fn(rng):
+        params = model.init(rng, tokens)['params']
+        return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+    return init_fn
+
+
+def abstract_train_state(cfg: ModelConfig,
+                         tcfg: Optional[TrainConfig] = None,
+                         *,
+                         mesh,
+                         batch_size: int = 8,
+                         seq_len: Optional[int] = None) -> Tuple[Any, Any]:
+    """Returns (abstract_state, state_shardings) WITHOUT materializing
+    any params: the eval_shape'd TrainState plus its NamedShardings on
+    `mesh`.
+
+    The elastic-recovery entry point: after a gang resize the new mesh's
+    shardings come from here, and checkpoints.restore_sharded streams
+    the checkpoint straight onto them — no full-size init, no one-chip
+    materialization (the restore-side counterpart of create_train_state
+    never allocating the 8B flagship unsharded).
+    """
+    tcfg = tcfg or TrainConfig()
+    seq_len = seq_len or min(cfg.max_seq_len, 2048)
+    init_fn = _init_fn(cfg, tcfg, mesh, batch_size, seq_len)
+    with mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
+        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        specs = nn.get_partition_spec(abstract)
+        shardings = nn.logical_to_mesh_sharding(specs, mesh,
+                                                LOGICAL_AXIS_RULES)
+    return abstract, shardings
+
+
 def create_train_state(cfg: ModelConfig,
                        tcfg: Optional[TrainConfig] = None,
                        *,
@@ -92,17 +132,15 @@ def create_train_state(cfg: ModelConfig,
     tcfg = tcfg or TrainConfig()
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     seq_len = seq_len or min(cfg.max_seq_len, 2048)
-    model = Transformer(cfg, mesh)
-    tokens = jnp.zeros((batch_size, seq_len), jnp.int32)
-    tx = make_optimizer(tcfg)
-
-    def init_fn(rng):
-        params = model.init(rng, tokens)['params']
-        return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+    init_fn = _init_fn(cfg, tcfg, mesh, batch_size, seq_len)
 
     if mesh is None:
         return init_fn(rng), None
 
+    # NOTE: shardings must come from THIS init_fn (not a fresh
+    # abstract_train_state call): TrainState's treedef carries
+    # apply_fn/tx as static metadata, so trees from two model
+    # instances never match under jit's out_shardings check.
     with mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
         abstract = jax.eval_shape(init_fn, rng)
         specs = nn.get_partition_spec(abstract)

@@ -184,6 +184,14 @@ def skylet_journal() -> EventJournal:
     return get_journal(os.path.join(journal_root(), 'skylet.jsonl'))
 
 
+def training_journal() -> EventJournal:
+    """Training-side control events on this host (async checkpoint
+    saves, elastic resume/resize) — written by user-code processes that
+    share this SKYTPU_HOME, so a managed job's checkpoint timeline lands
+    next to the controller's recovery timeline."""
+    return get_journal(os.path.join(journal_root(), 'training.jsonl'))
+
+
 def cluster_events(cluster_name: str) -> List[Dict[str, Any]]:
     return cluster_journal(cluster_name).read()
 
@@ -364,3 +372,32 @@ def jobs_recovery_hist() -> metrics.Histogram:
         'skytpu_jobs_recovery_seconds',
         'Managed-job recovery duration (detection to relaunched)',
         buckets=LONG_WAIT_BUCKETS)
+
+
+# Checkpoint saves run seconds-to-minutes (bucket write + retries), far
+# below the provisioning waits but above serving latencies.
+CHECKPOINT_SAVE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                           10.0, 30.0, 60.0, 120.0)
+
+
+def checkpoint_save_hist() -> metrics.Histogram:
+    return metrics.histogram(
+        'skytpu_checkpoint_save_seconds',
+        'Checkpoint save wall time (write + retries; off the step '
+        'critical path for async saves)',
+        buckets=CHECKPOINT_SAVE_BUCKETS)
+
+
+def checkpoint_blocked_counter() -> metrics.Counter:
+    return metrics.counter(
+        'skytpu_checkpoint_blocked_seconds_total',
+        'Seconds train steps spent blocked waiting on the bounded '
+        'in-flight checkpoint save slot (nonzero means saves are '
+        'slower than the save interval)')
+
+
+def gang_resizes() -> metrics.Counter:
+    return metrics.counter(
+        'skytpu_gang_resizes_total',
+        'Elastic gang resizes (shrink on partial preemption, expand '
+        'when capacity returns)', labelnames=('direction',))

@@ -10,6 +10,7 @@ the env the gang-exec layer exports, and sharding-rule helpers.
 from skypilot_tpu.parallel.distributed import initialize_from_env
 from skypilot_tpu.parallel.mesh import MeshConfig
 from skypilot_tpu.parallel.mesh import build_mesh
+from skypilot_tpu.parallel.mesh import elastic_mesh_config
 from skypilot_tpu.parallel.mesh import slice_topology
 from skypilot_tpu.parallel.sharding import LOGICAL_AXIS_RULES
 from skypilot_tpu.parallel.sharding import logical_sharding
@@ -18,6 +19,7 @@ __all__ = [
     'LOGICAL_AXIS_RULES',
     'MeshConfig',
     'build_mesh',
+    'elastic_mesh_config',
     'initialize_from_env',
     'logical_sharding',
     'slice_topology',

@@ -115,6 +115,51 @@ def _infer(sizes: List[int], total: int, what: str) -> List[int]:
     return sizes
 
 
+def elastic_mesh_config(config: MeshConfig,
+                        num_devices: int) -> MeshConfig:
+    """Re-infer the BATCH axes (data, fsdp) of `config` for a new
+    device count, keeping the MODEL axes (pipeline, sequence, tensor,
+    expert) fixed.
+
+    The elastic-resize contract: a shrink/expand after partial
+    preemption never changes how the model is partitioned — a layer's
+    tensor shards must still fit one chip, pipeline stages must still
+    line up — only how much data/fsdp parallelism exists.  Preference
+    order on rescale: fsdp keeps the largest size that divides the new
+    parallel capacity (gcd with the requested size), data absorbs the
+    rest — so a shrink sheds data replicas before it sheds parameter
+    sharding, and an expand grows data replicas first.
+    """
+    sizes = config.axis_sizes()
+    fixed = 1
+    for axis in ('pipeline', 'sequence', 'tensor', 'expert'):
+        if sizes[axis] == -1:
+            raise ValueError(
+                f'model axis {axis!r} cannot be inferred (-1) in an '
+                f'elastic resize; only data/fsdp rescale')
+        fixed *= sizes[axis]
+    if num_devices <= 0 or num_devices % fixed != 0:
+        raise ValueError(
+            f'{num_devices} device(s) not divisible by the model-axis '
+            f'product {fixed} (pipeline*sequence*tensor*expert)')
+    parallel = num_devices // fixed
+    data, fsdp = sizes['data'], sizes['fsdp']
+    if fsdp == -1 and data == -1:
+        fsdp, data = parallel, 1
+    elif fsdp == -1:
+        if parallel % data != 0:
+            raise ValueError(
+                f'data={data} does not divide the parallel capacity '
+                f'{parallel} of {num_devices} devices')
+        fsdp = parallel // data
+    else:
+        fsdp = math.gcd(fsdp, parallel)
+        data = parallel // fsdp
+    return MeshConfig(data=data, pipeline=sizes['pipeline'], fsdp=fsdp,
+                      sequence=sizes['sequence'], tensor=sizes['tensor'],
+                      expert=sizes['expert'])
+
+
 def build_mesh(config: Optional[MeshConfig] = None,
                *,
                devices=None,

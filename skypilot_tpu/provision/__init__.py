@@ -99,3 +99,22 @@ def get_command_runners(cluster_info: common.ClusterInfo,
                         **kwargs: Any) -> List[Any]:
     """Rank-ordered CommandRunners, head host first."""
     raise AssertionError
+
+
+@_route
+def evict_instances(cluster_name: str, ranks: List[int]) -> List[str]:
+    """Kill specific hosts of the cluster (a PARTIAL preemption — the
+    cloud analogue is losing some workers of a slice).  Returns the
+    evicted instance ids.  Only emulating providers implement this;
+    it exists for chaos scenarios, never for production paths."""
+    raise AssertionError
+
+
+@_route
+def trim_instances(cluster_name: str) -> int:
+    """Drop hosts that are no longer running from the cluster's
+    membership, so the surviving hosts form a (smaller) healthy
+    cluster.  Returns the number of surviving hosts.  The shrink half
+    of elastic recovery; providers without partial-loss semantics need
+    not implement it (the ELASTIC strategy falls back to relaunch)."""
+    raise AssertionError

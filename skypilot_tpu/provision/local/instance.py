@@ -88,9 +88,10 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
             'deploy_vars': deploy_vars,
             'hosts_per_slice': hosts_per_slice,
             'hosts': hosts,
+            'next_host_idx': num_hosts,
         }
     else:
-        if len(meta['hosts']) != num_hosts:
+        if len(meta['hosts']) > num_hosts:
             from skypilot_tpu import exceptions  # pylint: disable=import-outside-toplevel
             raise exceptions.ResourcesMismatchError(
                 f'Cluster {cluster_name} exists with {len(meta["hosts"])} '
@@ -99,6 +100,34 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
             if host['status'] != 'running':
                 host['status'] = 'running'
                 resumed.append(host['instance_id'])
+        if len(meta['hosts']) < num_hosts:
+            # Elastic expand: the cluster was trimmed after a partial
+            # preemption and capacity has returned — create the missing
+            # hosts.  Indices never recycle (a new host is a NEW VM,
+            # not the ghost of the evicted one); rank order = position.
+            next_idx = meta.get('next_host_idx')
+            if next_idx is None:
+                next_idx = 1 + max(
+                    (int(h['instance_id'].rsplit('host', 1)[1])
+                     for h in meta['hosts']), default=-1)
+            while len(meta['hosts']) < num_hosts:
+                host_id = f'{cluster_name}-host{next_idx}'
+                root = os.path.join(_cluster_dir(cluster_name),
+                                    f'host{next_idx}')
+                os.makedirs(root, exist_ok=True)
+                meta['hosts'].append({
+                    'instance_id': host_id,
+                    'root_dir': root,
+                    'slice_id': 0,
+                    'worker_id': 0,
+                    'status': 'running',
+                })
+                created.append(host_id)
+                next_idx += 1
+            meta['next_host_idx'] = next_idx
+            for i, host in enumerate(meta['hosts']):
+                host['slice_id'] = i // hosts_per_slice
+                host['worker_id'] = i % hosts_per_slice
     _write_meta(cluster_name, meta)
     return common.ProvisionRecord(
         provider_name='local',
@@ -134,38 +163,39 @@ def stop_instances(cluster_name: str, worker_only: bool = False) -> None:
     _write_meta(cluster_name, meta)
 
 
-def _kill_host_processes(cluster_name: str) -> None:
-    """Kill skylet + job supervisors spawned inside the emulated hosts.
-
-    A real terminate destroys the VMs and everything on them; here the
-    equivalent is killing every process whose pid we recorded under the
-    host roots (skylet pid file + nonterminal jobs in the head's jobs.db).
-    """
-    import psutil  # pylint: disable=import-outside-toplevel
+def _host_pids(host: Dict[str, Any]) -> List[int]:
+    """Pids recorded under one emulated host's root: its skylet, any
+    nonterminal jobs in its jobs.db, and gang rank tasks (pidfiles the
+    task bash scripts write under ~/.skytpu/gang/)."""
+    import glob  # pylint: disable=import-outside-toplevel
     import sqlite3  # pylint: disable=import-outside-toplevel
-    meta = _read_meta(cluster_name)
-    if meta is None:
-        return
-    pids = []
-    for host in meta['hosts']:
-        pid_file = os.path.join(host['root_dir'], '.skytpu', 'skylet.pid')
+    pids: List[int] = []
+    pid_files = [os.path.join(host['root_dir'], '.skytpu', 'skylet.pid')]
+    pid_files += glob.glob(
+        os.path.join(host['root_dir'], '.skytpu', 'gang', '*.pid'))
+    for pid_file in pid_files:
         try:
             with open(pid_file, encoding='utf-8') as f:
                 pids.append(int(f.read().strip()))
         except (OSError, ValueError):
             pass
-        job_db = os.path.join(host['root_dir'], '.skytpu', 'jobs.db')
-        if os.path.exists(job_db):
-            try:
-                conn = sqlite3.connect(job_db, timeout=2)
-                rows = conn.execute(
-                    'SELECT pid FROM jobs WHERE pid > 0 AND status NOT IN '
-                    "('SUCCEEDED','FAILED','FAILED_SETUP','FAILED_DRIVER',"
-                    "'CANCELLED')").fetchall()
-                conn.close()
-                pids.extend(int(r[0]) for r in rows)
-            except sqlite3.Error:
-                pass
+    job_db = os.path.join(host['root_dir'], '.skytpu', 'jobs.db')
+    if os.path.exists(job_db):
+        try:
+            conn = sqlite3.connect(job_db, timeout=2)
+            rows = conn.execute(
+                'SELECT pid FROM jobs WHERE pid > 0 AND status NOT IN '
+                "('SUCCEEDED','FAILED','FAILED_SETUP','FAILED_DRIVER',"
+                "'CANCELLED')").fetchall()
+            conn.close()
+            pids.extend(int(r[0]) for r in rows)
+        except sqlite3.Error:
+            pass
+    return pids
+
+
+def _kill_pids(pids: List[int]) -> None:
+    import psutil  # pylint: disable=import-outside-toplevel
     for pid in pids:
         try:
             proc = psutil.Process(pid)
@@ -174,6 +204,61 @@ def _kill_host_processes(cluster_name: str) -> None:
             proc.kill()
         except (psutil.NoSuchProcess, psutil.AccessDenied):
             pass
+
+
+def _kill_host_processes(cluster_name: str) -> None:
+    """Kill skylet + job supervisors spawned inside the emulated hosts.
+
+    A real terminate destroys the VMs and everything on them; here the
+    equivalent is killing every process whose pid we recorded under the
+    host roots (skylet pid file + nonterminal jobs in the head's jobs.db).
+    """
+    meta = _read_meta(cluster_name)
+    if meta is None:
+        return
+    pids: List[int] = []
+    for host in meta['hosts']:
+        pids.extend(_host_pids(host))
+    _kill_pids(pids)
+
+
+def evict_instances(cluster_name: str, ranks: List[int]) -> List[str]:
+    """Partial preemption: kill the hosts at the given rank indices and
+    mark them 'preempted' (query_instances then reports them gone while
+    the survivors stay UP — the mixed state a real slice shows when the
+    cloud reclaims some of its workers)."""
+    meta = _read_meta(cluster_name)
+    if meta is None:
+        return []
+    evicted = []
+    for rank in ranks:
+        if 0 <= rank < len(meta['hosts']):
+            host = meta['hosts'][rank]
+            if host['status'] == 'preempted':
+                continue
+            _kill_pids(_host_pids(host))
+            host['status'] = 'preempted'
+            evicted.append(host['instance_id'])
+    _write_meta(cluster_name, meta)
+    return evicted
+
+
+def trim_instances(cluster_name: str) -> int:
+    """Shrink the cluster to its surviving hosts: drop every
+    non-running host from the membership (their dirs are removed — the
+    VMs are gone).  Rank order of the survivors is preserved; the head
+    is whichever surviving host comes first.  Returns the surviving
+    host count."""
+    meta = _read_meta(cluster_name)
+    if meta is None:
+        return 0
+    survivors = [h for h in meta['hosts'] if h['status'] == 'running']
+    for host in meta['hosts']:
+        if host['status'] != 'running':
+            shutil.rmtree(host['root_dir'], ignore_errors=True)
+    meta['hosts'] = survivors
+    _write_meta(cluster_name, meta)
+    return len(survivors)
 
 
 def terminate_instances(cluster_name: str, worker_only: bool = False) -> None:

@@ -219,8 +219,11 @@ _SLOW_TESTS = {
                        'test_prefill_logits_match_full_forward',
                        'test_batched_step_matches_per_sequence_decode',
                        'test_multi_step_generation_parity'),
+    'test_chaos.py': ('test_elastic_expand_round_trip',),
     'test_distributed_bootstrap.py': (
         'test_two_process_bootstrap_and_psum',),
+    'test_elastic.py': (
+        'test_shrink_expand_round_trip_with_loss_continuity',),
     'test_flash_kernels.py': ('test_pallas_backward_bf16',
                               'test_pallas_backward_matches_reference',
                               'test_ring_attention_uses_pallas_kernels'),

@@ -1,0 +1,39 @@
+"""Tier-1 perf smoke: async checkpointing stays off the step critical
+path (<10% overhead vs checkpointing disabled) while a blocking save
+costs a large multiple — the ISSUE 6 acceptance bar, pinned in
+BENCH_ckpt.json by bench_checkpoint.py."""
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+
+_REPO_ROOT = pathlib.Path(__file__).parents[2]
+
+
+def test_async_checkpoint_overhead_under_10_pct(tmp_path):
+    out = tmp_path / 'bench_ckpt.json'
+    proc = subprocess.run(
+        [sys.executable, str(_REPO_ROOT / 'bench_checkpoint.py'),
+         '--smoke', '--out', str(out)],
+        capture_output=True, text=True, timeout=300, check=False,
+        cwd=str(_REPO_ROOT))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    results = json.loads(out.read_text())
+    async_oh = results['async']['overhead_pct']
+    blocking_oh = results['blocking']['overhead_pct']
+    assert async_oh < 10.0, results
+    assert blocking_oh > async_oh, results
+    # The blocking mode's worst step eats a whole bucket write; the
+    # async mode's worst step must not.
+    assert results['async']['max_step_s'] < \
+        results['blocking']['max_step_s']
+
+
+def test_bench_ckpt_json_is_pinned():
+    """The committed BENCH_ckpt.json stays consistent with the claim."""
+    pinned = json.loads((_REPO_ROOT / 'BENCH_ckpt.json').read_text())
+    assert pinned['async']['overhead_pct'] < 10.0
+    assert pinned['blocking']['overhead_pct'] > \
+        pinned['async']['overhead_pct']

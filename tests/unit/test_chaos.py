@@ -478,6 +478,57 @@ class TestScenarios:
         with pytest.raises(ValueError, match='Unknown scenario'):
             scenarios_lib.run_scenario('not_a_scenario')
 
+    def test_checkpoint_storm_and_seed_reproducibility(self, local_infra):
+        """Checkpoint-write fault storm: every save retries to success
+        off the step path; same seed → byte-identical fault sequence."""
+        first = scenarios_lib.run_scenario('checkpoint_storm', seed=21)
+        assert first.ok, first.violations
+        saves = first.details['saves']
+        assert all(status == 'ok' for _, status, _ in saves)
+        assert any(attempts > 1 for _, _, attempts in saves)
+        second = scenarios_lib.run_scenario('checkpoint_storm', seed=21)
+        assert second.ok, second.violations
+        assert json.dumps(first.fault_sequence, sort_keys=True) == \
+            json.dumps(second.fault_sequence, sort_keys=True)
+
+    def test_elastic_shrink(self, local_infra, _isolated_home):
+        """Tier-1 acceptance (ISSUE 6): mid-step partial preemption →
+        gang_resize shrink, sharded restore on the smaller mesh, resume
+        within the lost-work budget, no loss divergence."""
+        os.environ['SKYTPU_MANAGED_JOB_DB'] = str(
+            _isolated_home / 'managed_jobs.db')
+        try:
+            result = scenarios_lib.run_scenario('elastic_shrink', seed=22)
+        finally:
+            os.environ.pop('SKYTPU_MANAGED_JOB_DB', None)
+        assert result.ok, (result.violations, result.details)
+        assert result.details['status'] == 'SUCCEEDED'
+        assert result.details['last_recovery_reason'] == \
+            'elastic_shrink(2→1)'
+        assert (2, 1, 'shrink') in result.details['resizes']
+        # A sharded restore landed on the rebuilt (smaller) mesh.
+        assert any(restored and devices == 2
+                   for _, devices, restored in result.details['resumes'])
+        assert [f['site'] for f in result.fault_sequence] == \
+            ['jobs.status_poll']
+
+    def test_elastic_expand_round_trip(self, local_infra, _isolated_home):
+        """shrink → capacity returns → expand: both resizes journaled,
+        progress preserved end to end."""
+        os.environ['SKYTPU_MANAGED_JOB_DB'] = str(
+            _isolated_home / 'managed_jobs.db')
+        try:
+            result = scenarios_lib.run_scenario('elastic_expand', seed=23)
+        finally:
+            os.environ.pop('SKYTPU_MANAGED_JOB_DB', None)
+        assert result.ok, (result.violations, result.details)
+        assert result.details['status'] == 'SUCCEEDED'
+        directions = [d for _, _, d in result.details['resizes']]
+        assert directions == ['shrink', 'expand']
+        assert result.details['last_recovery_reason'] == \
+            'elastic_expand(1→2)'
+        assert result.details['recovery_count'] >= 2
+
 
 def test_chaos_cli_list_and_run(local_infra):
     from click.testing import CliRunner

@@ -84,6 +84,7 @@ def test_each_site_instruments_its_documented_layer():
         'jobs.recover': ('jobs/',),
         'serve.replica_probe': ('serve/',),
         'skylet.tick': ('skylet/',),
+        'checkpoint.save': ('data/',),
     }
     call_sites, _ = _scan()
     assert set(expected_prefix) == set(faults_lib.SITES), (

@@ -1,0 +1,219 @@
+"""Checkpoint contract tests (ISSUE 6): restore_or_init round trip,
+async-vs-sync save equivalence, retry behavior under an injected
+``checkpoint.save`` fault, and the restore_params/restore_sharded
+validation paths."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.chaos import faults as faults_lib
+from skypilot_tpu.chaos import injector
+from skypilot_tpu.data import checkpoints
+from skypilot_tpu.models import configs
+from skypilot_tpu.models import train as train_lib
+from skypilot_tpu.observability import events as events_lib
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+
+def _tiny_state():
+    cfg = configs.get_config('tiny')
+    state, _ = train_lib.create_train_state(cfg, batch_size=4, seq_len=16)
+    return cfg, state
+
+
+def _leaves_allclose(a, b):
+    la = jax.tree_util.tree_leaves(jax.device_get(a))
+    lb = jax.tree_util.tree_leaves(jax.device_get(b))
+    assert len(la) == len(lb)
+    return all(np.allclose(x, y) for x, y in zip(la, lb))
+
+
+def test_restore_or_init_round_trip(tmp_path):
+    """save → restore → start_step: the auto-resume convention."""
+    _, state = _tiny_state()
+    directory = str(tmp_path / 'ckpt')
+    mgr = checkpoints.AsyncCheckpointManager(directory,
+                                             save_interval_steps=1)
+    assert mgr.save(7, state)
+    mgr.close()
+    assert mgr.latest_step() == 7
+
+    mgr2 = checkpoints.AsyncCheckpointManager(directory)
+    restored, start_step = mgr2.restore_or_init(state)
+    assert start_step == 8
+    assert _leaves_allclose(state, restored)
+    mgr2.close()
+
+
+def test_restore_or_init_no_checkpoint(tmp_path):
+    _, state = _tiny_state()
+    mgr = checkpoints.AsyncCheckpointManager(str(tmp_path / 'none'))
+    same, start_step = mgr.restore_or_init(state)
+    assert start_step == 0
+    assert same is state
+    mgr.close()
+
+
+def test_async_and_sync_saves_are_equivalent(tmp_path):
+    """A restored async save must be tree-allclose to a restored
+    blocking save of the same state — async moves the write off the
+    step path, never changes what lands on disk."""
+    _, state = _tiny_state()
+    async_dir = str(tmp_path / 'async')
+    sync_dir = str(tmp_path / 'sync')
+    with checkpoints.AsyncCheckpointManager(async_dir,
+                                            async_save=True) as amgr:
+        amgr.save(3, state)
+    with checkpoints.AsyncCheckpointManager(sync_dir,
+                                            async_save=False) as smgr:
+        smgr.save(3, state)
+    a, a_step = checkpoints.AsyncCheckpointManager(
+        async_dir).restore_or_init(state)
+    s, s_step = checkpoints.AsyncCheckpointManager(
+        sync_dir).restore_or_init(state)
+    assert a_step == s_step == 4
+    assert _leaves_allclose(a, s)
+    assert _leaves_allclose(a, state)
+
+
+def test_save_interval_skips_off_interval_steps(tmp_path):
+    _, state = _tiny_state()
+    with checkpoints.AsyncCheckpointManager(
+            str(tmp_path / 'ckpt'), save_interval_steps=3) as mgr:
+        assert mgr.save(0, state)
+        assert not mgr.save(1, state)
+        assert not mgr.save(2, state)
+        assert mgr.save(3, state)
+        mgr.wait_until_finished()
+        assert mgr.latest_step() == 3
+
+
+def test_save_retries_through_injected_fault(tmp_path):
+    """A bucket-write flake (chaos checkpoint.save raise) is retried
+    with backoff and the save still lands; the journal records the
+    attempt count."""
+    _, state = _tiny_state()
+    journal = events_lib.training_journal()
+    plan = faults_lib.FaultPlan(seed=0, faults=[faults_lib.Fault(
+        site='checkpoint.save', effect='raise', error='OSError',
+        nth=[1])])
+    injector.arm(plan)
+    try:
+        with checkpoints.AsyncCheckpointManager(
+                str(tmp_path / 'ckpt'), max_retries=3,
+                retry_backoff_s=0.01, journal=journal) as mgr:
+            mgr.save(0, state)
+            mgr.wait_until_finished()
+            assert mgr.saves_ok == 1
+            assert mgr.saves_failed == 0
+            assert mgr.latest_step() == 0
+    finally:
+        injector.disarm()
+    ends = [e for e in journal.tail()
+            if e.get('event') == 'checkpoint_save_end']
+    assert ends and ends[-1]['status'] == 'ok'
+    assert ends[-1]['attempts'] == 2
+
+
+def test_save_exhausts_retries_without_killing_training(tmp_path):
+    """Retry exhaustion journals the failure and training continues —
+    a flaky bucket degrades checkpoint freshness, never kills the
+    run."""
+    _, state = _tiny_state()
+    journal = events_lib.training_journal()
+    plan = faults_lib.FaultPlan(seed=0, faults=[faults_lib.Fault(
+        site='checkpoint.save', effect='raise', error='OSError')])
+    injector.arm(plan)
+    try:
+        with checkpoints.AsyncCheckpointManager(
+                str(tmp_path / 'ckpt'), max_retries=1,
+                retry_backoff_s=0.01, journal=journal) as mgr:
+            mgr.save(0, state)
+            mgr.wait_until_finished()
+            assert mgr.saves_failed == 1
+            assert isinstance(mgr.last_error, OSError)
+            # The step loop keeps going: another save schedules fine.
+            mgr.save(1, state)
+    finally:
+        injector.disarm()
+    ends = [e for e in journal.tail()
+            if e.get('event') == 'checkpoint_save_end']
+    assert any(e['status'] == 'OSError' and e['attempts'] == 2
+               for e in ends)
+
+
+def test_restore_params_leaf_count_mismatch_raises(tmp_path):
+    """A shardings tree whose leaf count mismatches the checkpoint's
+    params subtree used to die with a bare StopIteration; now it's a
+    ValueError naming both counts."""
+    _, state = _tiny_state()
+    directory = str(tmp_path / 'ckpt')
+    with checkpoints.AsyncCheckpointManager(directory) as mgr:
+        mgr.save(0, state)
+    n_params = len(jax.tree_util.tree_leaves(state.params))
+    device = jax.devices()[0]
+    bad_shardings = [jax.sharding.SingleDeviceSharding(device)] * 3
+    with pytest.raises(ValueError, match=f'3 leaves.*{n_params}'):
+        checkpoints.restore_params(directory, shardings=bad_shardings)
+
+
+def test_restore_sharded_onto_smaller_mesh(tmp_path):
+    """The elastic restore: a checkpoint saved on an 8-device mesh
+    streams onto a 4-device mesh's shardings, numerically intact."""
+    cfg = configs.get_config('tiny')
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip('needs 8 virtual devices')
+    mesh8 = mesh_lib.build_mesh(mesh_lib.MeshConfig(data=1, fsdp=8),
+                                devices=devices)
+    state, _ = train_lib.create_train_state(cfg, mesh=mesh8,
+                                            batch_size=8, seq_len=16)
+    directory = str(tmp_path / 'ckpt')
+    with checkpoints.AsyncCheckpointManager(directory) as mgr:
+        mgr.save(5, state)
+
+    mesh4 = mesh_lib.build_mesh(mesh_lib.MeshConfig(data=1, fsdp=4),
+                                devices=devices[:4])
+    abstract, shardings = train_lib.abstract_train_state(
+        cfg, mesh=mesh4, batch_size=8, seq_len=16)
+    restored, start_step = checkpoints.restore_sharded(
+        directory, abstract, shardings)
+    assert start_step == 6
+    assert _leaves_allclose(state, restored)
+    param_leaf = jax.tree_util.tree_leaves(restored.params)[0]
+    assert len(param_leaf.sharding.device_set) <= 4
+
+
+def test_restore_sharded_empty_dir(tmp_path):
+    cfg = configs.get_config('tiny')
+    mesh = mesh_lib.build_mesh(mesh_lib.MeshConfig(data=1, fsdp=-1))
+    abstract, shardings = train_lib.abstract_train_state(
+        cfg, mesh=mesh, batch_size=8, seq_len=16)
+    state, step = checkpoints.restore_sharded(
+        str(tmp_path / 'missing'), abstract, shardings)
+    assert state is None and step == 0
+
+
+def test_blocked_in_flight_accounting(tmp_path, monkeypatch):
+    """With max_in_flight=1 and a slow write, the second save blocks
+    and the blocked time is accounted (the signal that the save
+    interval is shorter than the write)."""
+    import orbax.checkpoint as ocp
+    import time as time_mod
+    _, state = _tiny_state()
+    real_save = ocp.CheckpointManager.save
+
+    def slow_save(self, *args, **kwargs):
+        time_mod.sleep(0.2)
+        return real_save(self, *args, **kwargs)
+
+    monkeypatch.setattr(ocp.CheckpointManager, 'save', slow_save)
+    with checkpoints.AsyncCheckpointManager(
+            str(tmp_path / 'ckpt'), max_in_flight=1) as mgr:
+        mgr.save(0, state)
+        mgr.save(1, state)
+        mgr.wait_until_finished()
+    assert mgr.blocked_seconds > 0.05

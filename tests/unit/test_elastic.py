@@ -1,0 +1,159 @@
+"""Elastic resize machinery: mesh re-inference and the in-process
+shrink/expand round trip with loss continuity (the fine-grained
+counterpart of the chaos elastic scenarios)."""
+from __future__ import annotations
+
+import jax
+import pytest
+
+from skypilot_tpu.chaos import invariants
+from skypilot_tpu.models import configs
+from skypilot_tpu.models.elastic import ElasticTrainer
+from skypilot_tpu.observability import events as events_lib
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+
+# ------------------------------------------------------ elastic_mesh_config
+
+
+def _sizes(cfgm):
+    return cfgm.axis_sizes()
+
+
+def test_mesh_config_shrinks_fsdp():
+    cfgm = mesh_lib.elastic_mesh_config(
+        mesh_lib.MeshConfig(data=1, fsdp=8), 4)
+    assert _sizes(cfgm)['fsdp'] == 4 and _sizes(cfgm)['data'] == 1
+
+
+def test_mesh_config_sheds_data_before_fsdp():
+    cfgm = mesh_lib.elastic_mesh_config(
+        mesh_lib.MeshConfig(data=4, fsdp=2), 4)
+    assert _sizes(cfgm)['fsdp'] == 2 and _sizes(cfgm)['data'] == 2
+
+
+def test_mesh_config_expand_grows_data_first():
+    cfgm = mesh_lib.elastic_mesh_config(
+        mesh_lib.MeshConfig(data=1, fsdp=4), 16)
+    assert _sizes(cfgm)['fsdp'] == 4 and _sizes(cfgm)['data'] == 4
+
+
+def test_mesh_config_inferred_axes():
+    cfgm = mesh_lib.elastic_mesh_config(
+        mesh_lib.MeshConfig(data=-1, fsdp=-1), 6)
+    assert _sizes(cfgm)['fsdp'] == 6 and _sizes(cfgm)['data'] == 1
+    cfgm = mesh_lib.elastic_mesh_config(
+        mesh_lib.MeshConfig(data=2, fsdp=-1), 6)
+    assert _sizes(cfgm)['fsdp'] == 3 and _sizes(cfgm)['data'] == 2
+
+
+def test_mesh_config_model_axes_fixed():
+    cfgm = mesh_lib.elastic_mesh_config(
+        mesh_lib.MeshConfig(data=-1, fsdp=2, tensor=2), 8)
+    s = _sizes(cfgm)
+    assert s['tensor'] == 2 and s['fsdp'] == 2 and s['data'] == 2
+
+
+def test_mesh_config_rejects_indivisible_model_axes():
+    with pytest.raises(ValueError, match='model-axis product'):
+        mesh_lib.elastic_mesh_config(
+            mesh_lib.MeshConfig(data=-1, tensor=4), 6)
+
+
+def test_mesh_config_rejects_inferred_model_axis():
+    with pytest.raises(ValueError, match='cannot be inferred'):
+        mesh_lib.elastic_mesh_config(
+            mesh_lib.MeshConfig(data=1, tensor=-1), 8)
+
+
+def test_mesh_config_rejects_indivisible_data():
+    with pytest.raises(ValueError, match='does not divide'):
+        mesh_lib.elastic_mesh_config(
+            mesh_lib.MeshConfig(data=3, fsdp=-1), 8)
+
+
+# ---------------------------------------------------------- ElasticTrainer
+
+
+@pytest.fixture
+def _eight_devices():
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip('needs 8 virtual devices')
+    return devices
+
+
+def test_shrink_expand_round_trip_with_loss_continuity(
+        tmp_path, _eight_devices):
+    """8→4→8 devices: progress survives both resizes, recomputed
+    overlap steps reproduce the original losses (the batch is a pure
+    function of the step), and the journal replays clean through the
+    resize_monotone_steps invariant."""
+    devices = _eight_devices
+    journal = events_lib.training_journal()
+    trainer = ElasticTrainer(configs.get_config('tiny'),
+                             checkpoint_dir=str(tmp_path / 'ckpt'),
+                             batch_size=8, seq_len=32,
+                             save_interval_steps=2, devices=devices,
+                             journal=journal)
+    try:
+        phase1 = dict(trainer.train_steps(6))
+        assert trainer.mesh.shape['fsdp'] == 8
+
+        trainer.resize(devices[:4], reason='partial preemption')
+        assert trainer.mesh.shape['fsdp'] == 4
+        assert trainer.resumed_from_checkpoint
+        # Progress preserved: resumed at the newest checkpoint + 1
+        # (saves land at even steps; phase 1 ended after step 5).
+        assert trainer.step == 5
+        phase2 = dict(trainer.train_steps(4))
+
+        overlap = set(phase1) & set(phase2)
+        assert overlap, 'the shrink must recompute the unsaved tail'
+        for step in overlap:
+            assert abs(phase1[step] - phase2[step]) < 1e-4, (
+                step, phase1[step], phase2[step])
+
+        trainer.resize(devices, reason='capacity returned')
+        assert trainer.mesh.shape['fsdp'] == 8
+        assert trainer.resumed_from_checkpoint
+        phase3 = dict(trainer.train_steps(2))
+        assert min(phase3) >= max(phase2)
+    finally:
+        trainer.close()
+
+    events = journal.tail()
+    resizes = [e for e in events if e['event'] == 'gang_resize']
+    assert [(e['from'], e['to']) for e in resizes] == [(8, 4), (4, 8)]
+    assert not invariants.resize_monotone_steps(events)
+    assert not invariants.checkpoint_liveness(events)
+
+
+def test_resize_before_any_checkpoint_is_fresh_init(
+        tmp_path, _eight_devices):
+    devices = _eight_devices
+    trainer = ElasticTrainer(configs.get_config('tiny'),
+                             checkpoint_dir=str(tmp_path / 'ckpt'),
+                             batch_size=8, seq_len=32,
+                             save_interval_steps=100, devices=devices)
+    try:
+        trainer.resize(devices[:4])
+        assert not trainer.resumed_from_checkpoint
+        assert trainer.step == 0
+    finally:
+        trainer.close()
+
+
+def test_resize_monotone_steps_invariant_catches_regression():
+    events = [
+        {'event': 'checkpoint_save_end', 'status': 'ok', 'step': 10},
+        {'event': 'train_resume', 'step': 4},
+    ]
+    violations = invariants.resize_monotone_steps(events)
+    assert violations and 'lost checkpointed progress' in violations[0]
+
+
+def test_checkpoint_liveness_invariant_catches_abandoned_save():
+    events = [{'event': 'checkpoint_save_start', 'step': 2}]
+    violations = invariants.checkpoint_liveness(events)
+    assert violations and 'abandoned' in violations[0]

@@ -101,6 +101,70 @@ class TestStrategySelection:
         with pytest.raises(Exception):
             recovery_strategy.StrategyExecutor.make('c', task)
 
+    def test_elastic_strategy_selected(self):
+        task = sky.Task(name='t', run='true')
+        task.set_resources(
+            sky.Resources(cloud='local', job_recovery='elastic'))
+        ex = recovery_strategy.StrategyExecutor.make('c', task)
+        assert ex.NAME == 'ELASTIC'
+
+
+class TestFailoverRegionPin:
+    """ISSUE 6 satellite: `_launch(prefer_same_region=True)` was a
+    silent no-op (the flag was `del`'d); the same-region attempt must
+    actually pin the previous launch's region/zone and the fallback
+    must clear the pin — proven by the requests the optimizer sees."""
+
+    def _strategy_with_history(self, monkeypatch, seen):
+        task = sky.Task(name='t', run='true')
+        task.set_resources(sky.Resources(job_recovery='failover'))
+        ex = recovery_strategy.StrategyExecutor.make('c', task)
+        ex._last_region = 'region-prev'  # pylint: disable=protected-access
+        ex._last_zone = 'zone-prev'  # pylint: disable=protected-access
+        monkeypatch.setattr(ex, 'cleanup_cluster', lambda: None)
+        monkeypatch.setattr(recovery_strategy.time, 'sleep',
+                            lambda _: None)
+
+        def fake_launch(task, **kwargs):
+            del kwargs
+            resources = next(iter(task.resources))
+            seen.append((resources.region, resources.zone))
+            raise sky.exceptions.ResourcesUnavailableError('no capacity')
+
+        from skypilot_tpu import execution
+        monkeypatch.setattr(execution, 'launch', fake_launch)
+        return ex
+
+    def test_same_region_attempt_pins_then_fallback_unpins(
+            self, monkeypatch):
+        seen = []
+        ex = self._strategy_with_history(monkeypatch, seen)
+        with pytest.raises(sky.exceptions.ResourcesUnavailableError):
+            ex._do_recover()  # pylint: disable=protected-access
+        # 3 pinned attempts (same-region phase), then 3 unpinned
+        # (full-search fallback): the optimizer request DIFFERS.
+        assert seen[:3] == [('region-prev', 'zone-prev')] * 3
+        assert seen[3:] == [(None, None)] * 3
+
+    def test_pin_restored_after_launch(self, monkeypatch):
+        """The task's own resources are never left mutated, even when
+        the pinned attempt raises."""
+        seen = []
+        ex = self._strategy_with_history(monkeypatch, seen)
+        with pytest.raises(sky.exceptions.ResourcesUnavailableError):
+            ex._do_recover()  # pylint: disable=protected-access
+        resources = next(iter(ex.task.resources))
+        assert resources.region is None and resources.zone is None
+
+    def test_no_history_launches_unpinned(self, monkeypatch):
+        seen = []
+        ex = self._strategy_with_history(monkeypatch, seen)
+        ex._last_region = None  # pylint: disable=protected-access
+        ex._last_zone = None  # pylint: disable=protected-access
+        with pytest.raises(sky.exceptions.ResourcesUnavailableError):
+            ex._do_recover()  # pylint: disable=protected-access
+        assert all(r == (None, None) for r in seen)
+
 
 class TestControllerE2E:
 

@@ -27,6 +27,8 @@ _ALLOWED = {
     'models/import_weights.py': 'conversion script: JSON result on stdout',
     'jobs/core.py': 'tail_logs dumps the controller log to stdout',
     'serve/core.py': 'tail_logs dumps the service log to stdout',
+    'chaos/elastic_task.py':
+        'gang-exec\'d task: stdout is the rank log `sky logs` tails',
 }
 
 
