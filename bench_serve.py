@@ -14,6 +14,16 @@ reports the numbers a serving SLO is written in:
   LONG prompt and measure the worst ITL the running requests suffer;
   with chunked prefill that stall is bounded by ONE chunk's compute
   (reported alongside the unchunked stall for contrast).
+- paged-KV capacity probe: at a FIXED cache-memory budget (what the
+  dense `[L, slots, h_kv, max_len, d]` cache occupies), size an
+  int8-paged pool with the same bytes and run that many requests
+  CONCURRENTLY — max concurrent slots at fixed memory is the number
+  the paged cache exists to move (dense reserves max_len per slot;
+  pages reserve only what a request can touch).
+- prefix-cache TTFT probe: a shared system prompt is prefilled cold
+  once, then re-requested — the hit adopts the cached pages and
+  prefills only the tail chunk, so TTFT collapses (reported as
+  hit/cold ratio, with the hit's `prefix_hit_pages` from its span).
 - --smoke also scrapes `/metrics` (observability/metrics.py exposition
   served on a loopback port) before, during, and after the pipelined
   run, asserts the key engine series are present and monotone (ticks,
@@ -234,6 +244,130 @@ def _stall_probe(cfg, params, *, slots: int, prompt_len: int,
         eng.stop()
 
 
+def _kv_bytes_per_position(cfg, quantized: bool) -> int:
+    """KV bytes one cache position costs per layer per kv-head (k+v):
+    the unit the fixed-memory comparison is stated in."""
+    import numpy as np
+    if quantized:
+        return 2 * (cfg.head_dim * 1 + 4)   # int8 values + f32 scale
+    return 2 * cfg.head_dim * np.dtype(cfg.dtype).itemsize
+
+
+def _capacity_probe(cfg, params, *, dense_slots: int, max_len: int,
+                    page_size: int, prompt_len: int, max_new: int,
+                    vocab: int, quantize_kv: bool = True,
+                    max_concurrency: int = 512) -> Dict[str, Any]:
+    """Max concurrent requests at the DENSE cache's memory budget.
+
+    Dense concurrency at this budget IS dense_slots (each slot
+    reserves max_len positions no matter what requests need).  The
+    paged pool with the same bytes holds n_pages pages; a request
+    pins ceil((prompt + max_new - 1)/page_size) of them — the probe
+    builds that engine and actually runs the full complement
+    concurrently to completion.
+    """
+    import numpy as np
+
+    from skypilot_tpu.serve import batching_engine
+    budget_bytes = (dense_slots * max_len *
+                    _kv_bytes_per_position(cfg, quantized=False))
+    page_bytes = page_size * _kv_bytes_per_position(cfg, quantize_kv)
+    n_pages = budget_bytes // page_bytes
+    pages_per_request = -(-(prompt_len + max_new - 1) // page_size)
+    paged_slots = min(int(n_pages // pages_per_request),
+                      max_concurrency)
+    eng = batching_engine.ContinuousBatchingEngine(
+        cfg, params, max_len=max_len, slots=paged_slots,
+        prefill_chunk=max(page_size, 16), kv_pages=int(n_pages) + 1,
+        page_size=page_size, quantize_kv=quantize_kv,
+        prefix_caching=False)
+    rng = np.random.default_rng(0)
+    peak_busy = 0
+    try:
+        eng.generate([1, 2, 3], 2, timeout=600)  # warm compiles
+        handles = [
+            eng.submit([int(x) for x in
+                        rng.integers(1, vocab - 1, size=prompt_len)],
+                       max_new)
+            for _ in range(paged_slots)
+        ]
+        while not all(h.done.is_set() for h in handles):
+            peak_busy = max(peak_busy, eng.stats()['busy_slots'])
+            time.sleep(0.01)
+        for h in handles:
+            assert len(h.result(timeout=600)) == max_new
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    return {
+        'budget_bytes': int(budget_bytes),
+        'page_size': page_size,
+        'quantize_kv': quantize_kv,
+        'kv_pages': int(n_pages),
+        'pages_per_request': pages_per_request,
+        'prompt_len': prompt_len,
+        'max_new_tokens': max_new,
+        'max_concurrent_dense': dense_slots,
+        'max_concurrent_paged': paged_slots,
+        'peak_busy_slots': peak_busy,
+        'concurrency_ratio': round(paged_slots / max(dense_slots, 1),
+                                   2),
+        'pool_drained': stats['kv_pages_used'] == 0,
+    }
+
+
+def _prefix_probe(cfg, params, *, max_len: int, page_size: int,
+                  chunk: int, prefix_len: int, vocab: int,
+                  trials: int = 3,
+                  quantize_kv: bool = True) -> Dict[str, Any]:
+    """Shared-prefix TTFT: cold prefill once, then hits that adopt the
+    cached pages and prefill only the unmatched tail."""
+    import numpy as np
+
+    from skypilot_tpu.serve import batching_engine
+    pages_needed = -(-(prefix_len + 8) // page_size) * (trials + 3)
+    eng = batching_engine.ContinuousBatchingEngine(
+        cfg, params, max_len=max_len, slots=2, prefill_chunk=chunk,
+        kv_pages=pages_needed + 8, page_size=page_size,
+        quantize_kv=quantize_kv, prefix_caching=True)
+    rng = np.random.default_rng(1)
+
+    def ttft_of(prompt):
+        handle = eng.submit(prompt, 4)
+        handle.result(timeout=600)
+        span = eng.span(handle.request_id)
+        return span['ttft_ms'], span['prefix_hit_pages']
+
+    try:
+        # Warm EVERY compile on both paths (chunk-0 bucket, chunk
+        # continuation, page insert, prefix seed) with a throwaway
+        # prompt of the same length, measured afterwards on a prompt
+        # the cache has never seen.
+        warm = [int(x) for x in rng.integers(1, vocab - 1,
+                                             size=prefix_len)]
+        ttft_of(warm)
+        ttft_of(warm)          # warms the hit path (seed compile)
+        shared = [int(x) for x in rng.integers(1, vocab - 1,
+                                               size=prefix_len)]
+        ttft_cold, _ = ttft_of(shared)
+        hits = [ttft_of(shared) for _ in range(trials)]
+        hit_ttfts = sorted(t for t, _ in hits)
+        ttft_hit = hit_ttfts[len(hit_ttfts) // 2]
+        hit_pages = hits[0][1]
+    finally:
+        eng.stop()
+    return {
+        'prefix_len': prefix_len,
+        'page_size': page_size,
+        'prefill_chunk': chunk,
+        'quantize_kv': quantize_kv,
+        'ttft_cold_ms': round(ttft_cold, 3),
+        'ttft_hit_ms': round(ttft_hit, 3),
+        'ttft_hit_ratio': round(ttft_hit / max(ttft_cold, 1e-9), 4),
+        'prefix_hit_pages': hit_pages,
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--model', default='tiny')
@@ -257,13 +391,27 @@ def main() -> None:
     parser.add_argument('--skip-legacy', action='store_true',
                         help='Skip the pre-pipeline A/B run.')
     parser.add_argument('--skip-stall-probe', action='store_true')
+    parser.add_argument('--skip-paged-probes', action='store_true',
+                        help='Skip the paged-KV capacity and '
+                             'prefix-cache TTFT probes.')
+    parser.add_argument('--page-size', type=int, default=16,
+                        help='KV page size for the paged probes.')
+    parser.add_argument('--prefix-len', type=int, default=256,
+                        help='Shared system-prompt length for the '
+                             'prefix-cache TTFT probe.')
     parser.add_argument('--smoke', action='store_true',
                         help='Seconds-scale config for CI '
                              '(tests/unit/test_bench_serve.py).')
+    parser.add_argument('--pin', action='store_true',
+                        help='With --smoke: write the pinned '
+                             'BENCH_serve_smoke.json at the repo root. '
+                             'Default smoke output goes to a temp path '
+                             'so every tier-1 run does not churn the '
+                             'pinned file.')
     parser.add_argument('--out', default=None,
                         help='Output JSON path (default '
-                             'BENCH_serve.json, or '
-                             'BENCH_serve_smoke.json with --smoke).')
+                             'BENCH_serve.json; --smoke defaults to a '
+                             'temp path unless --pin).')
     args = parser.parse_args()
     if args.smoke:
         # Seconds-scale but still SATURATING (offered load well above
@@ -276,8 +424,24 @@ def main() -> None:
         args.max_len = 64
         args.prefill_chunk = 32
         args.stall_prompt_len = 96
-    out_path = args.out or ('BENCH_serve_smoke.json' if args.smoke
-                            else 'BENCH_serve.json')
+        args.page_size = 8
+        args.prefix_len = 96
+    if args.out:
+        out_path = args.out
+    elif args.smoke:
+        # Smoke runs on every tier-1 pass; writing the pinned file
+        # each time was pure VCS churn — temp by default, --pin to
+        # refresh the committed sample.
+        if args.pin:
+            out_path = 'BENCH_serve_smoke.json'
+        else:
+            import os
+            import tempfile
+            out_path = os.path.join(
+                tempfile.gettempdir(),
+                f'bench_serve_smoke-{os.getpid()}.json')
+    else:
+        out_path = 'BENCH_serve.json'
 
     import flax.linen as nn
     import jax
@@ -422,6 +586,21 @@ def main() -> None:
             'unchunked_max_itl_ms':
                 unchunked['max_itl_during_admission_ms'],
         }
+
+    if not args.skip_paged_probes:
+        ps = args.page_size
+        payload['paged_capacity'] = _capacity_probe(
+            cfg, params, dense_slots=args.slots,
+            max_len=args.max_len, page_size=ps,
+            prompt_len=8, max_new=8, vocab=vocab, quantize_kv=True,
+            # Smoke caps concurrency at 16 (a 4x ratio already proves
+            # the mechanism in seconds); the full run lets it ride.
+            max_concurrency=16 if args.smoke else 256)
+        probe_max_len = -(-(args.prefix_len + 16) // ps) * ps
+        payload['prefix_cache'] = _prefix_probe(
+            cfg, params, max_len=probe_max_len, page_size=ps,
+            chunk=max(ps, 8), prefix_len=args.prefix_len,
+            vocab=vocab, quantize_kv=True)
 
     line = json.dumps(payload)
     print(line)

@@ -70,6 +70,12 @@ SITES: Dict[str, str] = {
     'serve.replica_probe':
         'replica readiness probe (serve/replica_managers.py) — raise '
         'RequestException (or ChaosError) to flap a replica',
+    'serve.page_pool':
+        'KV page-pool allocation (serve/cache_manager.py PagePool.'
+        'alloc) — effect "deny" makes the pool report exhaustion (the '
+        'engine must degrade to admission backpressure / HTTP 429, '
+        'never an engine failure); "delay" slows admissions (running '
+        'decodes must keep their bounded ITL)',
     'skylet.tick':
         'skylet periodic event run (skylet/events.py) — a raise counts '
         'as an event failure and exercises the failure backoff',

@@ -23,6 +23,9 @@ The registry maps names (used by scenarios and the CLI) to checkers:
     checkpoint_liveness    every checkpoint_save_start reaches a
                            terminal checkpoint_save_end (no abandoned
                            in-flight save)
+    page_pool_balance      every KV page allocated by the serving page
+                           pool is eventually freed, and never freed
+                           twice
     no_injections          zero chaos_fault_injected events (clean runs)
 """
 from __future__ import annotations
@@ -206,6 +209,34 @@ def checkpoint_liveness(events: Sequence[Event]) -> List[str]:
     return violations
 
 
+def page_pool_balance(events: Sequence[Event]) -> List[str]:
+    """Safety/liveness for the serving KV page pool: every page the
+    allocator handed out (`kv_pages_alloc`) is eventually returned
+    (`kv_pages_free`), and nothing is freed that was never allocated —
+    a leaked page is capacity the replica never gets back; a double
+    free is a page two requests would scribble on."""
+    violations = []
+    outstanding: Dict[int, int] = {}
+    for e in events:
+        name = e.get('event')
+        if name == 'kv_pages_alloc':
+            for p in (e.get('pages') or []):
+                outstanding[p] = outstanding.get(p, 0) + 1
+        elif name == 'kv_pages_free':
+            for p in (e.get('pages') or []):
+                held = outstanding.get(p, 0)
+                if held <= 0:
+                    violations.append(
+                        f'page {p} freed without a matching alloc')
+                else:
+                    outstanding[p] = held - 1
+    leaked = sorted(p for p, n in outstanding.items() if n > 0)
+    if leaked:
+        violations.append(
+            f'pages {leaked} allocated but never freed (pool leak)')
+    return violations
+
+
 def no_injections(events: Sequence[Event]) -> List[str]:
     """With no plan armed, the chaos subsystem must be invisible."""
     injected = _named(events, 'chaos_fault_injected')
@@ -223,6 +254,7 @@ CHECKERS: Dict[str, Callable[[Sequence[Event]], List[str]]] = {
     'spans_closed': spans_closed,
     'resize_monotone_steps': resize_monotone_steps,
     'checkpoint_liveness': checkpoint_liveness,
+    'page_pool_balance': page_pool_balance,
     'no_injections': no_injections,
 }
 

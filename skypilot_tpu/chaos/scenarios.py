@@ -19,6 +19,11 @@ Scenarios (CLI: ``sky chaos list`` / ``sky chaos run <name>``):
                            wait times out with a terminal verdict
 - ``serve_replica_flap``   readiness probes fail transiently → replica
                            flaps NOT_READY and returns to READY
+- ``page_pool_exhaustion`` KV page allocations denied → the batching
+                           engine backpressures (429/Retry-After)
+                           instead of erroring, recovers when the
+                           window passes, and the journal proves every
+                           allocated page was freed
 - ``elastic_shrink``       mid-step partial preemption → ELASTIC
                            recovery shrinks the gang to the survivor,
                            sharded-restores onto the smaller mesh, and
@@ -739,6 +744,83 @@ def checkpoint_storm(seed: int) -> ScenarioResult:
             f'wall {round(save_wall, 3)}s)', extra)
     return _finish('checkpoint_storm', seed, t0, training_events,
                    ['checkpoint_liveness'], extra, details)
+
+
+@_register(
+    'page_pool_exhaustion',
+    'KV page-pool allocation denied (deny effect) -> the batching '
+    'engine degrades to admission backpressure (QueueFull/429 + '
+    'Retry-After), never an engine failure; once the denial window '
+    'passes every queued request completes, and the journal proves '
+    'every allocated page was freed')
+def page_pool_exhaustion(seed: int) -> ScenarioResult:
+    import flax.linen as nn  # pylint: disable=import-outside-toplevel
+    import jax  # pylint: disable=import-outside-toplevel
+    import jax.numpy as jnp  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.models import configs  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.models.transformer import Transformer  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import batching_engine  # pylint: disable=import-outside-toplevel
+
+    # Deny the first page allocations for a wall-clock window: during
+    # it NOTHING can be admitted, so the bounded queue fills and new
+    # submits must get the 429 class; afterwards the engine recovers
+    # on its own.
+    plan = faults_lib.FaultPlan(
+        seed=seed, name='page_pool_exhaustion',
+        faults=[faults_lib.Fault(site='serve.page_pool',
+                                 effect='deny', until_s=1.0)])
+    t0 = time.time()
+    extra: List[str] = []
+    details: Dict[str, Any] = {}
+    cfg = configs.get_config('tiny')
+    params = nn.meta.unbox(Transformer(cfg).init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32))
+        ['params'])
+    serve_journal = events_lib.get_journal(
+        os.path.join(events_lib.journal_root(), 'serve.jsonl'))
+    with _armed(plan):
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=32, slots=2, prefill_chunk=8,
+            kv_pages=16, page_size=8, max_queue=2)
+        rejections = 0
+        try:
+            # These fill the (denied) admission queue...
+            queued = [eng.submit([1, 2, 3], 4) for _ in range(2)]
+            # ...so overflow submits during the denial window must be
+            # rejected with the 429 class, not crash the engine.
+            deadline = time.time() + 0.8
+            while time.time() < deadline:
+                try:
+                    queued.append(eng.submit([4, 5], 4))
+                except batching_engine.QueueFull:
+                    rejections += 1
+                time.sleep(0.02)
+            # Window over: the engine must drain the backlog unaided.
+            results = [r.result(timeout=120) for r in queued]
+            details['completed'] = len(results)
+            details['tokens_ok'] = all(len(r) == 4 for r in results)
+        finally:
+            eng.stop()
+        details['rejections'] = rejections
+        details['engine_failed'] = eng.stats()['failed']
+        details['kv_pages_used'] = eng.stats()['kv_pages_used']
+    serve_events = _since(serve_journal, t0)
+    _expect(rejections >= 1,
+            f'overflow submits saw QueueFull/429 during the denial '
+            f'window (got {rejections})', extra)
+    _expect(details['engine_failed'] is False,
+            'pool exhaustion never failed the engine', extra)
+    _expect(details.get('tokens_ok', False),
+            'every queued request completed after the window', extra)
+    _expect(details['kv_pages_used'] == 0,
+            f'pool fully drained at shutdown '
+            f'(got {details["kv_pages_used"]} pages used)', extra)
+    injected = [e for e in _since(injector.chaos_journal(), t0)
+                if e.get('event') == 'chaos_fault_injected']
+    _expect(len(injected) >= 1, 'the deny fault actually fired', extra)
+    return _finish('page_pool_exhaustion', seed, t0, serve_events,
+                   ['page_pool_balance'], extra, details)
 
 
 @_register(

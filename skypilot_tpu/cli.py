@@ -834,7 +834,8 @@ def _serve_metrics_table(records) -> None:
                 parsed = metrics_lib.parse_exposition(resp.text)
             except (requests.RequestException, ValueError) as e:
                 rows.append((r['name'], rep['replica_id'], url,
-                             f'scrape failed: {e}', '-', '-', '-', '-'))
+                             f'scrape failed: {e}', '-', '-', '-', '-',
+                             '-'))
                 continue
 
             def total(name, parsed=parsed):
@@ -842,10 +843,24 @@ def _serve_metrics_table(records) -> None:
 
             busy = int(total('skytpu_engine_busy_slots'))
             slots = int(total('skytpu_engine_slots'))
+            # Paged-KV replicas: pages used/total plus the prefix-
+            # cache hit share; dense replicas show '-'.
+            pages_total = int(total('skytpu_engine_kv_pages_total'))
+            if pages_total:
+                hits = total('skytpu_engine_prefix_cache_hits_total')
+                misses = total(
+                    'skytpu_engine_prefix_cache_misses_total')
+                share = (f' {hits / (hits + misses):.0%}hit'
+                         if hits + misses else '')
+                pages = (f'{int(total("skytpu_engine_kv_pages_used"))}'
+                         f'/{pages_total}{share}')
+            else:
+                pages = '-'
             rows.append((
                 r['name'], rep['replica_id'], url,
                 f'{total("skytpu_engine_decode_tokens_per_s"):g}',
                 f'{busy}/{slots}',
+                pages,
                 int(total('skytpu_engine_queue_depth')),
                 f'{fmt_ms(_hist_quantile(parsed, "skytpu_engine_ttft_seconds", 0.5))}'
                 f'/{fmt_ms(_hist_quantile(parsed, "skytpu_engine_ttft_seconds", 0.99))}',
@@ -857,7 +872,8 @@ def _serve_metrics_table(records) -> None:
         return
     click.echo('')
     _print_table(['SERVICE', 'REPLICA', 'URL', 'TOK/S', 'SLOTS',
-                  'QUEUE', 'TTFT p50/p99', 'ITL p50/p99'], rows)
+                  'KV PAGES', 'QUEUE', 'TTFT p50/p99',
+                  'ITL p50/p99'], rows)
 
 
 @serve_group.command(name='down')

@@ -200,12 +200,21 @@ def _embed(cfg, params, tokens):
 
 
 def _scan_layers_and_unembed(cfg, params, x, positions, cache_k, cache_v,
-                             write_fn, *, use_flash: bool):
+                             write_fn, *, use_flash: bool,
+                             view_fn=None):
     """The shared per-layer loop: project+rope k/v, write them into the
     cache via `write_fn(k_cache, k_new) -> k_cache`, run the layer, then
     final-norm + unembed the last position.  Single-sequence decode and
-    slot-batched decode differ ONLY in write_fn / positions shapes."""
+    slot-batched decode differ ONLY in write_fn / positions shapes.
+
+    `view_fn(cache_leaf) -> [b, h_kv, len, d]` maps the stored cache to
+    the array attention reads — identity for dense caches; the paged
+    cache gathers (and dequantizes) its pages through it, so one layer
+    body serves every cache layout.
+    """
     layers = _layer_params(params, cfg)
+    if view_fn is None:
+        view_fn = lambda c: c
 
     def body(x, layer_state):
         lp, k_cache, v_cache = layer_state
@@ -216,8 +225,8 @@ def _scan_layers_and_unembed(cfg, params, x, positions, cache_k, cache_v,
         k = _rope(k, positions, cfg)
         k_cache = write_fn(k_cache, k)
         v_cache = write_fn(v_cache, v)
-        x = _layer_forward(x, lp, cfg, positions, k_cache, v_cache,
-                           use_flash=use_flash)
+        x = _layer_forward(x, lp, cfg, positions, view_fn(k_cache),
+                           view_fn(v_cache), use_flash=use_flash)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -488,10 +497,15 @@ def engine_step(cfg: ModelConfig, params, state, slot_cache, *,
     Inactive slots freeze: their token/remaining are unchanged and
     their cache length does not advance.
     """
+    return _select_and_bookkeep(state, *batched_step(
+        cfg, params, state['tokens'][:, None], slot_cache,
+        state['active']), max_top_k=max_top_k)
+
+
+def _select_and_bookkeep(state, logits, new_cache, *, max_top_k: int):
+    """Shared tick tail for dense and paged steps: on-device token
+    selection + stop/countdown bookkeeping (see engine_step docs)."""
     active = state['active']
-    logits, new_cache = batched_step(cfg, params,
-                                     state['tokens'][:, None],
-                                     slot_cache, active)
     split = jax.vmap(lambda k: jax.random.split(k, 2))(state['keys'])
     nxt = batched_sample(logits, split[:, 1], state['temperature'],
                          state['top_k'], max_top_k=max_top_k)
@@ -507,6 +521,220 @@ def engine_step(cfg: ModelConfig, params, state, slot_cache, *,
         keys=split[:, 0],
     )
     return new_state, new_cache, finished
+
+
+# ------------------------------------------------------ paged KV cache
+# Block-pool decoding (serve/cache_manager.py owns the host-side
+# allocator): the KV cache is a fixed pool of PAGES
+# [L, n_pages, h_kv, page_size, d] plus per-slot block tables — a
+# slot's cache is the concatenation of the pages its table names, so
+# memory is bounded by the tokens a request actually touches, not by
+# slots * max_len.  Attention gathers pages by table index inside the
+# jitted step; writes scatter one token into (page, offset) derived
+# from the slot's length.  Optional int8 KV storage (per-page-per-head
+# scales at token granularity, absmax symmetric like models/quantize)
+# halves page bytes; dequant happens on the gathered operand where XLA
+# fuses it into the attention einsum.
+
+
+def _page_size_of(paged: Dict[str, Any]) -> int:
+    leaf = paged['k']['q'] if isinstance(paged['k'], dict) else paged['k']
+    return leaf.shape[3]
+
+
+def init_paged_cache(cfg: ModelConfig, n_pages: int, page_size: int,
+                     slots: int, max_pages_per_slot: int,
+                     quantize_kv: bool = False) -> Dict[str, Any]:
+    """Zeroed page-pool cache.  k/v are [L, n_pages, h_kv, ps, d]
+    (int8 {'q','scale'} leaves when quantize_kv); block_tables [B, P]
+    name each slot's pages in order (0 = the reserved null page) and
+    lengths [B] are the per-slot decode depths."""
+    kv_shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, page_size,
+                cfg.head_dim)
+
+    def kv_leaf():
+        if quantize_kv:
+            return {'q': jnp.zeros(kv_shape, jnp.int8),
+                    'scale': jnp.ones(kv_shape[:-1], jnp.float32)}
+        return jnp.zeros(kv_shape, cfg.dtype)
+
+    return {
+        'k': kv_leaf(),
+        'v': kv_leaf(),
+        'block_tables': jnp.zeros((slots, max_pages_per_slot),
+                                  jnp.int32),
+        'lengths': jnp.zeros((slots,), jnp.int32),
+    }
+
+
+def _quant_kv(x):
+    """Symmetric absmax int8 over the last (head_dim) axis: returns
+    (int8 values, f32 scales without the last axis).  Round-trip
+    stable: requantizing dequantized values reproduces the same bytes
+    (the absmax element quantizes to exactly +-127)."""
+    x32 = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x32 / scale[..., None]), -127,
+                 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def _dequant_kv(leaf_slice, dtype):
+    """Dequantize a gathered int8 kv slice {'q','scale'} (or pass an
+    array through).  The multiply fuses into the consuming einsum's
+    operand read — int8 stays the HBM-resident form."""
+    if isinstance(leaf_slice, dict):
+        return (leaf_slice['q'].astype(dtype) *
+                leaf_slice['scale'].astype(dtype)[..., None])
+    return leaf_slice.astype(dtype)
+
+
+def paged_batched_step(cfg: ModelConfig, params, tokens, paged,
+                       active=None):
+    """One decode step across all slots against the page pool; exact
+    parity with `batched_step` (same masked attention math — the
+    gathered pages in table order ARE the slot's cache with positions
+    page_index * page_size + offset).
+
+    Writes scatter each slot's token at (block_tables[b, len//ps],
+    len % ps).  Inactive slots still write (at their frozen length) —
+    the engine parks freed slots' tables on the null page so a stale
+    write can never corrupt recycled pages.
+    """
+    lengths = paged['lengths']                     # [B]
+    tables = paged['block_tables']                 # [B, P]
+    ps = _page_size_of(paged)
+    n_rows = tables.shape[1]
+    positions = lengths[:, None]                   # [B, 1]
+    rows = jnp.clip(lengths // ps, 0, n_rows - 1)
+    pages = jnp.take_along_axis(tables, rows[:, None], axis=1)[:, 0]
+    offsets = lengths % ps                         # [B]
+
+    def write(c, new):
+        tok = new[:, :, 0, :]                      # [B, h_kv, d]
+        if isinstance(c, dict):
+            q, scale = _quant_kv(tok)
+            return {'q': c['q'].at[pages, :, offsets].set(q),
+                    'scale': c['scale'].at[pages, :, offsets].set(scale)}
+        return c.at[pages, :, offsets].set(tok.astype(c.dtype))
+
+    def view(c):
+        # Gather the pool rows each slot's table names ->
+        # [B, P, h_kv, ps, d], dequantized, then fold pages into the
+        # position axis (table order IS position order).
+        if isinstance(c, dict):
+            arr = _dequant_kv({'q': c['q'][tables],
+                               'scale': c['scale'][tables]}, cfg.dtype)
+        else:
+            arr = c[tables]
+        b, p, h, s, d = arr.shape
+        return arr.transpose(0, 2, 1, 3, 4).reshape(b, h, p * s, d)
+
+    logits, new_k, new_v = _scan_layers_and_unembed(
+        cfg, params, _embed(cfg, params, tokens), positions,
+        paged['k'], paged['v'], write, use_flash=False, view_fn=view)
+    advance = (jnp.ones_like(lengths) if active is None
+               else active.astype(lengths.dtype))
+    return logits, dict(paged, k=new_k, v=new_v,
+                        lengths=lengths + advance)
+
+
+def paged_engine_step(cfg: ModelConfig, params, state, paged, *,
+                      max_top_k: int = 64):
+    """`engine_step` against the page pool: same on-device token
+    selection and stop bookkeeping, cache reads/writes through the
+    block tables.  Returns (new_state, new_paged, finished [B])."""
+    return _select_and_bookkeep(state, *paged_batched_step(
+        cfg, params, state['tokens'][:, None], paged,
+        state['active']), max_top_k=max_top_k)
+
+
+def paged_admit_slot(paged, slot, pages_row, length):
+    """Point `slot` at its pages and depth (jit with paged donated)."""
+    return dict(
+        paged,
+        block_tables=paged['block_tables'].at[slot].set(
+            jnp.asarray(pages_row, jnp.int32)),
+        lengths=paged['lengths'].at[slot].set(
+            jnp.asarray(length, jnp.int32)))
+
+
+def paged_release_slot(paged, slot):
+    """Park a freed slot's table on the null page BEFORE its pages are
+    recycled: the slot may still be written by an in-flight tick (at
+    its frozen length), and that write must land in garbage nobody
+    reads, not in a page the allocator just handed to someone else."""
+    row = jnp.zeros((paged['block_tables'].shape[1],), jnp.int32)
+    return dict(
+        paged,
+        block_tables=paged['block_tables'].at[slot].set(row),
+        lengths=paged['lengths'].at[slot].set(jnp.zeros((), jnp.int32)))
+
+
+def _private_as_pages(private_leaf, ps: int):
+    """[L, 1, h_kv, T, d] private prefill cache -> [L, T/ps, h_kv,
+    ps, d] page-major layout (T must be a multiple of ps)."""
+    l, _, h, t, d = private_leaf.shape
+    return private_leaf.reshape(l, h, t // ps, ps, d).transpose(
+        0, 2, 1, 3, 4)
+
+
+def insert_prefill_pages(paged, private_cache, pages_row, *,
+                         first_page: int):
+    """Scatter a completed private prefill cache into pool pages.
+
+    private_cache k/v are [L, 1, h_kv, T, d] with T % page_size == 0;
+    its pages [first_page, first_page + len(pages_row)) land in pool
+    pages `pages_row` (skipping the first_page prefix-cache hits whose
+    pool pages already hold identical content — rewriting a SHARED
+    page, even with equal values, is what this avoids).  Jit with
+    first_page static and paged donated.
+    """
+    ps = _page_size_of(paged)
+    n = pages_row.shape[0]
+    ids = jnp.asarray(pages_row, jnp.int32)
+
+    def leaf(pool_leaf, private_leaf):
+        piece = _private_as_pages(private_leaf, ps)[
+            :, first_page:first_page + n]      # [L, n, h_kv, ps, d]
+        if isinstance(pool_leaf, dict):
+            q, scale = _quant_kv(piece)
+            return {'q': pool_leaf['q'].at[:, ids].set(q),
+                    'scale': pool_leaf['scale'].at[:, ids].set(scale)}
+        return pool_leaf.at[:, ids].set(piece.astype(pool_leaf.dtype))
+
+    return dict(paged, k=leaf(paged['k'], private_cache['k']),
+                v=leaf(paged['v'], private_cache['v']))
+
+
+def paged_seed_private(cfg: ModelConfig, paged, pages_row, *,
+                       priv_len: int):
+    """Build a private prefill cache whose leading positions are the
+    dequantized contents of cached pages `pages_row` — the prefix-hit
+    admission path: the remaining prompt tokens then chunk-prefill
+    against this cache from index len(pages_row) * page_size, exactly
+    as if the prefix had been prefilled here.  Jit with priv_len
+    static; paged is read-only (NOT donated)."""
+    ps = _page_size_of(paged)
+    r = pages_row.shape[0]
+    ids = jnp.asarray(pages_row, jnp.int32)
+
+    def leaf(pool_leaf):
+        if isinstance(pool_leaf, dict):
+            arr = _dequant_kv({'q': pool_leaf['q'][:, ids],
+                               'scale': pool_leaf['scale'][:, ids]},
+                              cfg.dtype)
+        else:
+            arr = pool_leaf[:, ids]            # [L, r, h_kv, ps, d]
+        l, _, h, _, d = arr.shape
+        dense = arr.transpose(0, 2, 1, 3, 4).reshape(
+            l, 1, h, r * ps, d)               # [L, 1, h_kv, r*ps, d]
+        out = jnp.zeros((l, 1, h, priv_len, d), cfg.dtype)
+        return out.at[:, :, :, :r * ps, :].set(dense.astype(cfg.dtype))
+
+    return {'k': leaf(paged['k']), 'v': leaf(paged['v']),
+            'index': jnp.asarray(r * ps, jnp.int32)}
 
 
 def admit_slot_state(state, slot, token, max_new_tokens, stop_row, key,

@@ -58,6 +58,9 @@ class RequestSpan:
         self.queue_wait_s: Optional[float] = None
         self.prefill_chunks = 0
         self.prefill_s = 0.0
+        # Prompt pages adopted from the engine's prefix cache instead
+        # of prefilled (paged-KV engines; 0 = cold / dense engine).
+        self.prefix_hit_pages = 0
         self.ttft_s: Optional[float] = None
         self._last_token: Optional[float] = None
         self.itl_count = 0
@@ -115,6 +118,7 @@ class RequestSpan:
             'queue_wait_ms': ms(self.queue_wait_s),
             'prefill_chunks': self.prefill_chunks,
             'prefill_ms': ms(self.prefill_s),
+            'prefix_hit_pages': self.prefix_hit_pages,
             'ttft_ms': ms(self.ttft_s),
             'itl_mean_ms': ms(itl_mean),
             'itl_max_ms': ms(self.itl_max_s if self.itl_count else None),

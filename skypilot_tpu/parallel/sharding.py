@@ -93,6 +93,37 @@ def slot_cache_sharding(mesh):
                             'head_dim')
 
 
+def page_pool_sharding(mesh):
+    """Sharding for one paged-KV pool leaf
+    [layers, n_pages, kv_heads, page_size, head_dim]: kv_heads ride
+    'tensor' exactly like `slot_cache_sharding` (the paged gather /
+    scatter in the tick stays local per tensor shard); pages and
+    in-page positions are replicated axes — the page POOL is the
+    memory unit, every chip holds every page's slice of its own
+    heads."""
+    return logical_sharding(mesh, 'layers', None, 'kv_heads', None,
+                            'head_dim')
+
+
+def page_scale_sharding(mesh):
+    """Sharding for int8-KV per-token scales
+    [layers, n_pages, kv_heads, page_size] (the head_dim axis is
+    reduced away by the absmax)."""
+    return logical_sharding(mesh, 'layers', None, 'kv_heads', None)
+
+
+def paged_cache_sharding(mesh, quantized: bool = False):
+    """Sharding pytree matching `models/decode.init_paged_cache`:
+    pool leaves per `page_pool_sharding` (int8 pools add the scale
+    leaves), block tables and lengths replicated (tiny int32 arrays
+    every tensor shard must agree on)."""
+    kv = page_pool_sharding(mesh)
+    if quantized:
+        kv = {'q': kv, 'scale': page_scale_sharding(mesh)}
+    rep = replicated(mesh)
+    return {'k': kv, 'v': kv, 'block_tables': rep, 'lengths': rep}
+
+
 def engine_state_sharding(mesh):
     """Sharding for the engine's per-slot decode state arrays (tokens,
     masks, counters, keys): fully replicated — they are a few bytes per

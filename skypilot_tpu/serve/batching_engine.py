@@ -8,6 +8,43 @@ and one `models.decode.engine_step` call advances every active slot a
 token per engine tick — new arrivals ride along with half-finished
 generations.
 
+This module is the compatibility FACADE over the engine's three parts
+(split per ROADMAP before the page pool landed):
+
+- `serve/scheduler.py`  — Request handles, the bounded/TTL'd admission
+  queue (QueueFull -> 429, QueueExpired -> 503), slot bookkeeping.
+- `serve/cache_manager.py` — the paged-KV host side: page pool
+  allocator (refcounts/pins/COW, null page), chain-hashed prefix
+  cache with LRU eviction, per-slot page ownership.
+- `serve/sampler.py`    — submit-side sampling validation + the jitted
+  per-slot admission staging.
+
+Existing imports keep working: `batching_engine.QueueFull`,
+`batching_engine._Request`, `ContinuousBatchingEngine`, ... are all
+re-exported here.
+
+KV cache modes:
+
+- DENSE (default, `kv_pages=None`): one `[L, slots, h_kv, max_len, d]`
+  cache — every slot reserves max_len positions, so concurrency is
+  bounded by the worst-case sequence length.
+- PAGED (`kv_pages=N`): a pool of N pages `[L, N, h_kv, page_size, d]`
+  with per-slot block tables (`models/decode.paged_engine_step`
+  gathers pages by table index inside the jitted tick).  Memory is
+  bounded by the tokens a request can actually touch, decoupling slot
+  count from max_len; admission allocates `ceil((prompt + max_new - 1)
+  / page_size)` pages and BACKPRESSURES (QueueFull/429 + Retry-After)
+  on pool exhaustion instead of failing the engine.  Pages free on
+  completion, cancel, and TTL expiry.  `quantize_kv=True` stores pages
+  as int8 with per-page-per-head scales (~2x more tokens per byte;
+  dequant fuses into the attention einsum).  `prefix_caching=True`
+  registers every FULL prefilled prompt page under a chain hash, so
+  requests sharing a system prompt adopt the cached pages instead of
+  re-prefilling — TTFT on a prefix hit collapses to the tail chunks.
+  Sessions diverging mid-page stop matching at the divergence page and
+  each writes its own copy (full pages are immutable once written, so
+  shared pages are never mutated).
+
 Decode hot loop (the device never waits on Python):
 - Token selection happens ON DEVICE inside the jitted step — greedy
   argmax plus per-slot temperature/top-k sampling, stop-set matching,
@@ -31,11 +68,14 @@ overwrites the first pad position and attends only real keys, so
 logits match unpadded decode exactly (tests pin this against
 decode.generate).  Chunk 0 keeps that flash-prefill path; chunks at
 index > 0 run `decode.prefill_chunk` (per-position causal mask), which
-preserves the same n-1/last-token trick per chunk.  MoE models instead
-prefill the FULL prompt unpadded in one piece (the capacity dispatch
-couples every token, so padding, the n-1 split, and chunk boundaries
-would all perturb expert drops) and take their first token from the
-prefill logits.
+preserves the same n-1/last-token trick per chunk.  A prefix-cache hit
+replaces chunk 0: the cached pages seed the private prefill cache and
+only the unmatched tail chunks run.  MoE models instead prefill the
+FULL prompt unpadded in one piece (the capacity dispatch couples every
+token, so padding, the n-1 split, and chunk boundaries would all
+perturb expert drops) and take their first token from the prefill
+logits; the capacity dispatch also couples KV to the whole prompt, so
+MoE skips prefix reuse (pages still pool).
 
 Admission is BOUNDED: `max_queue` rejects new submits when the backlog
 is full (`QueueFull` -> HTTP 429) and `queue_ttl` expires requests
@@ -43,13 +83,15 @@ that waited too long queued (`QueueExpired` -> HTTP 503), so a load
 spike degrades with fast, honest rejections instead of unbounded TTFT.
 
 `pipelined=False` keeps the pre-pipeline loop (inline full-prompt
-prefill, one host sync per generated token, greedy only) for A/B
-benchmarking — `bench_serve.py` reports the speedup against it.
+prefill, one host sync per generated token, greedy only, dense cache
+only) for A/B benchmarking — `bench_serve.py` reports the speedup
+against it.
 """
 from __future__ import annotations
 
 import collections
-import queue
+import functools
+import os
 import threading
 import time
 from typing import Any, Deque, Dict, List, Optional, Tuple
@@ -57,19 +99,30 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing
+from skypilot_tpu.serve import cache_manager
+from skypilot_tpu.serve import sampler as sampler_lib
+from skypilot_tpu.serve import scheduler
 
 logger = sky_logging.init_logger(__name__)
 
+# ------------------------------------------------- compatibility facade
+QueueFull = scheduler.QueueFull
+QueueExpired = scheduler.QueueExpired
+PagesExhausted = cache_manager.PagesExhausted
+_Request = scheduler.Request
+_Slot = scheduler.Slot
+_PendingPrefill = scheduler.PendingPrefill
+_WAIT_BUCKETS = scheduler.WAIT_BUCKETS
+
 _PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
-# Queue-wait histogram bucket upper bounds (seconds); the last bucket
-# is open-ended.  Surfaced via stats() -> /health for autoscaling.
-_WAIT_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
 
 # Process-global registry instruments (observability/metrics.py) —
 # what `GET /metrics` on the serving fronts exposes.  Counters are
 # process-cumulative (Prometheus semantics: rates come from deltas);
 # the per-ENGINE view lives in stats().  Gauges describe the most
 # recently constructed engine — one engine per serving process.
+# Queue/admission instruments live in serve/scheduler.py; page-pool
+# and prefix-cache instruments in serve/cache_manager.py.
 _M_TICKS = metrics_lib.counter(
     'skytpu_engine_ticks_total', 'Decode engine ticks dispatched.')
 _M_TOKENS = metrics_lib.counter(
@@ -78,14 +131,6 @@ _M_TOKENS = metrics_lib.counter(
 _M_PREFILL_CHUNKS = metrics_lib.counter(
     'skytpu_engine_prefill_chunks_total',
     'Prompt prefill chunks executed.')
-_M_ADMITTED = metrics_lib.counter(
-    'skytpu_engine_admitted_total',
-    'Requests admitted into a KV slot.')
-_M_REJECTED = metrics_lib.counter(
-    'skytpu_engine_rejected_total',
-    'Requests rejected at admission, by reason.', ('reason',))
-_M_QUEUE_DEPTH = metrics_lib.gauge(
-    'skytpu_engine_queue_depth', 'Requests waiting for a slot.')
 _M_BUSY_SLOTS = metrics_lib.gauge(
     'skytpu_engine_busy_slots', 'KV slots currently decoding.')
 _M_SLOTS = metrics_lib.gauge(
@@ -93,194 +138,20 @@ _M_SLOTS = metrics_lib.gauge(
 _M_DECODE_RATE = metrics_lib.gauge(
     'skytpu_engine_decode_tokens_per_s',
     'Decode tokens/s over the trailing 10s window.')
-_M_QUEUE_WAIT = metrics_lib.histogram(
-    'skytpu_engine_queue_wait_seconds',
-    'Seconds a request waited queued before admission.',
-    buckets=_WAIT_BUCKETS)
-_M_TTFT = metrics_lib.histogram(
-    'skytpu_engine_ttft_seconds',
-    'Submit-to-first-token latency per request.')
-_M_ITL = metrics_lib.histogram(
-    'skytpu_engine_itl_seconds',
-    'Inter-token gaps during decode.',
-    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
-             0.5, 1.0, 2.5, 5.0))
 
 
-class QueueFull(RuntimeError):
-    """submit() rejected: the admission queue is at max_queue.
-
-    `retry_after` is the engine's estimate (seconds) of when a slot's
-    worth of backlog will have drained — servers surface it as an HTTP
-    Retry-After header on the 429.
-    """
-
-    def __init__(self, message: str, retry_after: float = 1.0) -> None:
-        super().__init__(message)
-        self.retry_after = max(1.0, retry_after)
-
-
-class QueueExpired(RuntimeError):
-    """The request sat queued past queue_ttl and was never admitted
-    (servers map this to 503 + Retry-After)."""
-
-    def __init__(self, message: str, retry_after: float = 1.0) -> None:
-        super().__init__(message)
-        self.retry_after = max(1.0, retry_after)
-
-
-class _Request:
-
-    def __init__(self, prompt_ids: List[int], max_new_tokens: int,
-                 stop_token, temperature: float = 0.0, top_k: int = 0,
-                 seed: int = 0,
-                 request_id: Optional[str] = None) -> None:
-        self.prompt_ids = list(prompt_ids)
-        self.max_new_tokens = max_new_tokens
-        # Per-request phase trace (queue/prefill/TTFT/ITL/total); the
-        # id arrives via X-SkyTPU-Request-Id or is generated here.
-        self.span = tracing.RequestSpan(request_id)
-        self.request_id = self.span.request_id
-        # stop_token: None, a single id, or any iterable of ids (the
-        # tokenizer's multi-EOS stop set — instruct checkpoints stop at
-        # chat turn-end markers, not just the model-level EOS).
-        if stop_token is None:
-            self.stop_ids = frozenset()
-        elif isinstance(stop_token, int):
-            self.stop_ids = frozenset({stop_token})
-        else:
-            self.stop_ids = frozenset(int(t) for t in stop_token)
-        self.temperature = float(temperature)
-        self.top_k = int(top_k)
-        self.seed = int(seed)
-        self.submit_time = time.monotonic()
-        self.done = threading.Event()
-        self.tokens: List[int] = []
-        self.error: Optional[Exception] = None
-        self.cancelled = False
-        # Streaming consumers read tokens as they are produced; the
-        # None sentinel marks the end of the stream.
-        self._live: 'queue.Queue[Optional[int]]' = queue.Queue()
-        # _finish can race (worker finishing vs stop() failing-fast vs
-        # submit() losing the stop race): first caller wins, later
-        # calls are no-ops — otherwise two None sentinels truncate a
-        # stream() and a success can be overwritten with an error.
-        self._state_lock = threading.Lock()
-        # Event-loop bridges (serve/async_server.py): called with each
-        # token and a final None, from the engine worker thread, under
-        # the state lock — watchers must be cheap and non-blocking
-        # (call_soon_threadsafe qualifies).
-        self._watchers: List[Any] = []
-        # Set by the engine at submit(): finished spans land here.
-        self._span_store: Optional[tracing.SpanStore] = None
-
-    def add_watcher(self, fn) -> None:
-        """Subscribe fn(token|None) to this request's token stream;
-        tokens already produced are replayed first, so late subscribers
-        never miss a prefix (the admission path can push the first
-        token before the caller gets the request handle back)."""
-        with self._state_lock:
-            for token in self.tokens:
-                fn(token)
-            if self.done.is_set():
-                fn(None)
-            else:
-                self._watchers.append(fn)
-
-    def _push(self, token: int) -> None:
-        with self._state_lock:
-            if self.done.is_set():
-                # stop() already finished this request; a worker still
-                # mid-tick must not append past the sentinel.
-                return
-            gap = self.span.mark_token()
-            if gap is None:
-                if self.span.ttft_s is not None:
-                    _M_TTFT.observe(self.span.ttft_s)
-            else:
-                _M_ITL.observe(gap)
-            self.tokens.append(token)
-            self._live.put(token)
-            self._notify(token)
-
-    def _finish(self, error: Optional[Exception] = None) -> None:
-        with self._state_lock:
-            if self.done.is_set():
-                return
-            self.error = error
-            self.done.set()
-            if error is not None:
-                status = type(error).__name__
-            elif self.cancelled:
-                status = 'cancelled'
-            else:
-                status = 'ok'
-            self.span.finish(status)
-            if self._span_store is not None:
-                self._span_store.add(self.span)
-            self._live.put(None)
-            self._notify(None)
-            self._watchers.clear()
-
-    def _notify(self, token: Optional[int]) -> None:
-        # A raising watcher (e.g. call_soon_threadsafe on a closed
-        # event loop at shutdown) must not propagate into the engine
-        # worker — that would fail the WHOLE engine for one dead
-        # subscriber.  Drop it instead.
-        for fn in list(self._watchers):
-            try:
-                fn(token)
-            except Exception:  # pylint: disable=broad-except
-                try:
-                    self._watchers.remove(fn)
-                except ValueError:
-                    pass
-
-    def result(self, timeout: Optional[float] = None) -> List[int]:
-        if not self.done.wait(timeout):
-            raise TimeoutError('generation timed out')
-        if self.error is not None:
-            raise self.error
-        return self.tokens
-
-    def stream(self, timeout: Optional[float] = None):
-        """Yield tokens as the engine produces them."""
-        while True:
-            token = self._live.get(timeout=timeout)
-            if token is None:
-                if self.error is not None:
-                    raise self.error
-                return
-            yield token
-
-    def cancel(self) -> None:
-        """Stop generating for this request (client went away); the
-        engine frees the slot on its next tick."""
-        self.cancelled = True
-
-
-class _Slot:
-
-    def __init__(self) -> None:
-        self.request: Optional[_Request] = None
-        self.next_token = 0          # legacy (unpipelined) loop only
-
-    @property
-    def active(self) -> bool:
-        return self.request is not None
-
-
-class _PendingPrefill:
-    """A dense prompt mid-chunked-prefill: the slot is reserved but
-    does not join decode ticks until every chunk has run."""
-
-    def __init__(self, slot_id: int, request: _Request,
-                 n_target: int) -> None:
-        self.slot_id = slot_id
-        self.request = request
-        self.n_target = n_target     # tokens to prefill (n-1, dense)
-        self.consumed = 0
-        self.cache: Optional[Dict[str, Any]] = None  # private [*,1,..]
+def _maybe_page_journal():
+    """Journal page alloc/free events only when someone is watching:
+    the `serve.page_pool` chaos site is armed (scenarios replay the
+    journal to prove alloc/free balance) or SKYTPU_SERVE_PAGE_EVENTS
+    is set.  Production admissions stay I/O-free."""
+    from skypilot_tpu.chaos import injector as chaos_injector  # pylint: disable=import-outside-toplevel
+    if not (os.environ.get('SKYTPU_SERVE_PAGE_EVENTS') or
+            chaos_injector.site_armed('serve.page_pool')):
+        return None
+    from skypilot_tpu.observability import events as events_lib  # pylint: disable=import-outside-toplevel
+    return events_lib.get_journal(
+        os.path.join(events_lib.journal_root(), 'serve.jsonl'))
 
 
 class ContinuousBatchingEngine:
@@ -291,9 +162,10 @@ class ContinuousBatchingEngine:
                  max_queue: int = 0,
                  queue_ttl: Optional[float] = None,
                  max_top_k: int = 64, max_stop_ids: int = 16,
-                 pipelined: bool = True, mesh=None) -> None:
-        import functools
-
+                 pipelined: bool = True, mesh=None,
+                 kv_pages: Optional[int] = None, page_size: int = 16,
+                 quantize_kv: bool = False,
+                 prefix_caching: bool = True) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -310,28 +182,80 @@ class ContinuousBatchingEngine:
         self.pipelined = pipelined
         self._jnp = jnp
         self._jax = jax
-        self._slots = [_Slot() for _ in range(slots)]
-        self._cache = decode.init_slot_cache(cfg, slots, max_len)
+        self._slots = [scheduler.Slot() for _ in range(slots)]
+        self._queue = scheduler.AdmissionQueue(
+            max_queue=max_queue, queue_ttl=queue_ttl,
+            drain_estimate=self._drain_estimate)
+        self._cond = self._queue.cond
+        self._stop = threading.Event()
+        self._sampler = sampler_lib.SlotSampler(self.max_top_k,
+                                                self.max_stop_ids)
+
+        self._kv: Optional[cache_manager.PagedKVManager] = None
+        if kv_pages is not None:
+            if not pipelined:
+                raise ValueError('kv_pages (paged KV cache) requires '
+                                 'the pipelined engine')
+            if max_len % page_size:
+                raise ValueError(
+                    f'max_len {max_len} must be a multiple of '
+                    f'page_size {page_size} (private prefill caches '
+                    f'scatter whole pages into the pool)')
+            self._kv = cache_manager.PagedKVManager(
+                int(kv_pages), int(page_size), slots,
+                prefix_caching=prefix_caching,
+                journal=_maybe_page_journal())
+            self._cache = decode.init_paged_cache(
+                cfg, int(kv_pages), int(page_size), slots,
+                max_len // int(page_size), quantize_kv=quantize_kv)
+        else:
+            self._cache = decode.init_slot_cache(cfg, slots, max_len)
         self._state = decode.init_engine_state(slots, max_stop_ids)
         if mesh is not None:
-            # Tensor-sharded serving: place the slot KV pool and the
-            # tiny per-slot state explicitly (kv_heads on 'tensor',
-            # state replicated) instead of leaving GSPMD to guess from
-            # the first donated step.
+            # Tensor-sharded serving: place the KV pool and the tiny
+            # per-slot state explicitly (kv_heads on 'tensor', state
+            # replicated) instead of leaving GSPMD to guess from the
+            # first donated step.
             from skypilot_tpu.parallel import sharding as sharding_lib
-            self._cache = jax.device_put(
-                self._cache, sharding_lib.slot_cache_sharding(mesh))
+            if self._kv is not None:
+                self._cache = jax.device_put(
+                    self._cache, sharding_lib.paged_cache_sharding(
+                        mesh, quantized=quantize_kv))
+            else:
+                self._cache = jax.device_put(
+                    self._cache, sharding_lib.slot_cache_sharding(mesh))
             self._state = jax.device_put(
                 self._state, sharding_lib.engine_state_sharding(mesh))
         self._tokens = jnp.zeros((slots, 1), jnp.int32)  # legacy loop
-        self._queue: Deque[_Request] = collections.deque()
-        self._cond = threading.Condition()
-        self._stop = threading.Event()
 
-        self._step = jax.jit(
-            functools.partial(decode.engine_step, cfg,
-                              max_top_k=self.max_top_k),
-            donate_argnums=(2,))
+        if self._kv is not None:
+            self._step = jax.jit(
+                functools.partial(decode.paged_engine_step, cfg,
+                                  max_top_k=self.max_top_k),
+                donate_argnums=(2,))
+            # Block-table surgery: donated so XLA patches the pool's
+            # tiny int32 tables in place.
+            self._admit_paged = jax.jit(decode.paged_admit_slot,
+                                        donate_argnums=(0,))
+            self._release_paged = jax.jit(decode.paged_release_slot,
+                                          donate_argnums=(0,))
+            # Private-prefill -> pool page scatter (quantizing when the
+            # pool is int8); the pool is donated (in-place patch), the
+            # private cache is not (its [L,1,h,T,d] layout cannot alias
+            # the page-major pool output — donating it just warns).
+            self._insert_pages = jax.jit(
+                decode.insert_prefill_pages,
+                static_argnames=('first_page',), donate_argnums=(0,))
+            # Prefix-hit seeding: cached pages -> the leading positions
+            # of a fresh private cache (pool read-only, NOT donated).
+            self._seed_private = jax.jit(
+                functools.partial(decode.paged_seed_private, cfg),
+                static_argnames=('priv_len',))
+        else:
+            self._step = jax.jit(
+                functools.partial(decode.engine_step, cfg,
+                                  max_top_k=self.max_top_k),
+                donate_argnums=(2,))
         self._legacy_step = jax.jit(
             lambda p, t, c: decode.batched_step(cfg, p, t, c),
             donate_argnums=(2,))
@@ -348,18 +272,11 @@ class ContinuousBatchingEngine:
             lambda params, toks, cache: decode.prefill_chunk(
                 cfg, params, toks, cache),
             donate_argnums=(2,))
-        # Jitted in-place slot adoption: eager dynamic_update_slice
-        # would materialize two full copies of the pool cache per
-        # admission; donation lets XLA update it in place.
+        # Jitted in-place slot adoption (dense): eager
+        # dynamic_update_slice would materialize two full copies of the
+        # pool cache per admission; donation lets XLA update in place.
         self._insert = jax.jit(decode.insert_prefill,
                                donate_argnums=(0,))
-        # One dispatch per admission for the whole per-slot state write
-        # (NOT donated: the previous tick's token buffer may still be
-        # pending its one-tick-behind host read).
-        self._admit_state = jax.jit(decode.admit_slot_state)
-        self._sample_one = jax.jit(
-            functools.partial(decode.batched_sample,
-                              max_top_k=self.max_top_k))
         self._failed: Optional[Exception] = None
 
         # ---- metrics (updated under _metrics_lock; read by stats()).
@@ -370,16 +287,13 @@ class ContinuousBatchingEngine:
         self._tokens_generated = 0
         self._ticks = 0
         self._prefill_chunks = 0
-        self._queue_full_rejections = 0
-        self._queue_ttl_expiries = 0
-        self._queue_wait_hist = [0] * (len(_WAIT_BUCKETS) + 1)
+        self._page_deferrals = 0
         self._rate_window: Deque[Tuple[float, int]] = collections.deque()
         # Finished per-request spans (queue/prefill/TTFT/ITL/total),
         # bounded; surfaced via stats()['recent_spans'] and span().
         self._spans = tracing.SpanStore()
         _M_SLOTS.set(slots)
         _M_BUSY_SLOTS.set(0)
-        _M_QUEUE_DEPTH.set(0)
 
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -388,7 +302,7 @@ class ContinuousBatchingEngine:
 
     def submit(self, prompt_ids: List[int], max_new_tokens: int,
                stop_token=None, sampling=None,
-               request_id: Optional[str] = None) -> _Request:
+               request_id: Optional[str] = None) -> scheduler.Request:
         """stop_token: None, one id, or an iterable of ids — the
         request finishes at the FIRST generated member of the set
         (multi-EOS: model-level EOS + chat turn-end markers).
@@ -410,41 +324,39 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f'prompt {len(prompt_ids)} + new {max_new_tokens} '
                 f'exceeds max_len {self.max_len}')
-        temperature, top_k, seed = 0.0, 0, 0
-        if sampling is not None:
-            temperature = float(sampling.temperature)
-            top_k = int(sampling.top_k)
-            seed = int(getattr(sampling, 'seed', 0))
-        if top_k > self.max_top_k:
-            raise ValueError(
-                f'top_k {top_k} > engine max_top_k {self.max_top_k}')
-        if temperature > 0.0 and not self.pipelined:
-            raise ValueError(
-                'the legacy (pipelined=False) loop serves greedy '
-                'decoding only')
-        request = _Request(prompt_ids, max_new_tokens, stop_token,
-                           temperature=temperature, top_k=top_k,
-                           seed=seed, request_id=request_id)
+        temperature, top_k, seed = sampler_lib.validate_sampling(
+            sampling, max_top_k=self.max_top_k,
+            pipelined=self.pipelined)
+        request = scheduler.Request(prompt_ids, max_new_tokens,
+                                    stop_token, temperature=temperature,
+                                    top_k=top_k, seed=seed,
+                                    request_id=request_id)
         request._span_store = self._spans  # pylint: disable=protected-access
-        if len(request.stop_ids) > self.max_stop_ids:
-            raise ValueError(
-                f'{len(request.stop_ids)} stop ids > engine '
-                f'max_stop_ids {self.max_stop_ids}')
+        sampler_lib.validate_stop_ids(request.stop_ids,
+                                      self.max_stop_ids)
         if self._stop.is_set() or self._failed is not None:
             raise RuntimeError('batching engine is stopped'
                                if self._failed is None else
                                f'batching engine failed: {self._failed}')
-        with self._cond:
-            if self.max_queue and len(self._queue) >= self.max_queue:
-                with self._metrics_lock:
-                    self._queue_full_rejections += 1
-                _M_REJECTED.labels(reason='queue_full').inc()
-                raise QueueFull(
-                    f'admission queue full ({self.max_queue} waiting); '
-                    'retry later', retry_after=self._drain_estimate())
-            self._queue.append(request)
-            _M_QUEUE_DEPTH.set(len(self._queue))
-            self._cond.notify()
+        if self._kv is not None:
+            # Admission is page-aware: a request that could NEVER fit
+            # is a caller error; a pool too busy RIGHT NOW while a
+            # backlog already waits is backpressure (429 + Retry-After)
+            # — the honest degraded mode for an exhausted pool.
+            need = self._kv.pages_needed(len(prompt_ids),
+                                         max_new_tokens)
+            if need > self._kv.pool.capacity:
+                raise ValueError(
+                    f'request needs {need} KV pages > pool capacity '
+                    f'{self._kv.pool.capacity} (pool of '
+                    f'{self._kv.pool.capacity} pages x '
+                    f'{self._kv.page_size} tokens)')
+            if len(self._queue) > 0 and not self._kv.can_admit(need):
+                raise self._queue.reject(
+                    'pages_exhausted',
+                    f'KV page pool exhausted ({need} page(s) needed, '
+                    f'{self._kv.pool.free_count} free); retry later')
+        self._queue.submit(request)
         if self._stop.is_set():
             # Lost the race with stop(): its drain may have already run,
             # so fail this request directly (idempotent via the event).
@@ -484,28 +396,27 @@ class ContinuousBatchingEngine:
         scale-out signals, decode_tokens_per_s and the queue-wait
         histogram say whether the replica is decode-bound rather than
         merely popular (serve/autoscalers.py consumes busy/slots as
-        replica load)."""
+        replica load).  Paged engines add the page-pool view:
+        kv_pages_{total,used,free,pinned}, prefix-cache entry/hit/miss
+        counts, and pages_exhausted_deferrals."""
         busy = sum(1 for s in self._slots if s.active)
         with self._metrics_lock:
-            hist = {}
-            for i, bound in enumerate(_WAIT_BUCKETS):
-                hist[f'<{bound}s'] = self._queue_wait_hist[i]
-            hist[f'>={_WAIT_BUCKETS[-1]}s'] = self._queue_wait_hist[-1]
             stats = {
                 'slots': len(self._slots),
                 'busy_slots': busy,
-                'queued_requests': len(self._queue),
                 'tokens_generated': self._tokens_generated,
                 'failed': self._failed is not None,
                 'ticks': self._ticks,
                 'prefill_chunks': self._prefill_chunks,
-                'queue_full_rejections': self._queue_full_rejections,
-                'queue_ttl_expiries': self._queue_ttl_expiries,
-                'queue_wait_hist': hist,
-                'max_queue': self.max_queue,
                 'prefill_chunk': self.prefill_chunk,
                 'pipelined': self.pipelined,
+                'paged': self._kv is not None,
             }
+        stats.update(self._queue.stats())
+        if self._kv is not None:
+            stats.update(self._kv.stats())
+            with self._metrics_lock:
+                stats['pages_exhausted_deferrals'] = self._page_deferrals
         rate = round(self._decode_rate(), 3)
         stats['decode_tokens_per_s'] = rate
         # Per-request phase traces (newest first) — the "why was THIS
@@ -515,7 +426,6 @@ class ContinuousBatchingEngine:
         # /health no matter which is polled.
         _M_SLOTS.set(stats['slots'])
         _M_BUSY_SLOTS.set(busy)
-        _M_QUEUE_DEPTH.set(stats['queued_requests'])
         _M_DECODE_RATE.set(rate)
         return stats
 
@@ -531,17 +441,18 @@ class ContinuousBatchingEngine:
         self._thread.join(timeout=10)
         # Fail fast for anything still queued or in flight — callers
         # must not sit out their full result() timeout at shutdown.
-        shutdown_error = RuntimeError('batching engine stopped')
-        while True:
-            with self._cond:
-                if not self._queue:
-                    break
-                request = self._queue.popleft()
-            request._finish(shutdown_error)  # pylint: disable=protected-access
+        self._queue.drain(
+            lambda: RuntimeError('batching engine stopped'))
         for slot in self._slots:
             if slot.request is not None:
-                slot.request._finish(shutdown_error)  # pylint: disable=protected-access
+                slot.request._finish(  # pylint: disable=protected-access
+                    RuntimeError('batching engine stopped'))
                 slot.request = None
+        if self._kv is not None:
+            # Host-side accounting only (the device is going away):
+            # every slot- and prefix-held page returns to the pool, so
+            # the alloc/free journal balances.
+            self._kv.release_all()
 
     # ------------------------------------------------------------ metrics
 
@@ -556,17 +467,10 @@ class ContinuousBatchingEngine:
         _M_TOKENS.inc(n)
         _M_DECODE_RATE.set(round(self._decode_rate(), 3))
 
-    def _record_queue_wait(self, request: _Request) -> None:
-        request.span.mark_admitted()
-        wait = time.monotonic() - request.submit_time
-        _M_ADMITTED.inc()
-        _M_QUEUE_WAIT.observe(wait)
+    def _record_chunk(self) -> None:
+        _M_PREFILL_CHUNKS.inc()
         with self._metrics_lock:
-            for i, bound in enumerate(_WAIT_BUCKETS):
-                if wait < bound:
-                    self._queue_wait_hist[i] += 1
-                    return
-            self._queue_wait_hist[-1] += 1
+            self._prefill_chunks += 1
 
     # ------------------------------------------------------------ worker
 
@@ -576,71 +480,46 @@ class ContinuousBatchingEngine:
                 return b
         return n
 
-    def _pop_request(self) -> Optional[_Request]:
-        """Pop the next live queued request, expiring stale ones."""
-        while True:
-            with self._cond:
-                if not self._queue:
-                    return None
-                request = self._queue.popleft()
-            if request.cancelled:
-                request._finish()  # pylint: disable=protected-access
-                continue
-            if (self.queue_ttl is not None and
-                    time.monotonic() - request.submit_time >
-                    self.queue_ttl):
-                self._record_expiry(1)
-                request._finish(QueueExpired(  # pylint: disable=protected-access
-                    f'request expired after {self.queue_ttl}s queued',
-                    retry_after=self._drain_estimate()))
-                continue
-            self._record_queue_wait(request)
-            with self._cond:
-                _M_QUEUE_DEPTH.set(len(self._queue))
-            return request
-
-    def _record_expiry(self, n: int) -> None:
-        with self._metrics_lock:
-            self._queue_ttl_expiries += n
-        _M_REJECTED.labels(reason='queue_expired').inc(n)
-
-    def _expire_queued(self) -> None:
-        """Fail requests that outlived queue_ttl while still queued —
-        without this a saturated engine leaves them waiting out their
-        whole client timeout."""
-        if self.queue_ttl is None:
-            return
-        now = time.monotonic()
-        expired = []
-        with self._cond:
-            if not self._queue:
-                return
-            keep: Deque[_Request] = collections.deque()
-            for request in self._queue:
-                if now - request.submit_time > self.queue_ttl:
-                    expired.append(request)
-                else:
-                    keep.append(request)
-            self._queue = keep
-            _M_QUEUE_DEPTH.set(len(keep))
-        if expired:
-            self._record_expiry(len(expired))
-        for request in expired:
-            request._finish(QueueExpired(  # pylint: disable=protected-access
-                f'request expired after {self.queue_ttl}s queued',
-                retry_after=self._drain_estimate()))
-
     # ----------------------------------------------- pipelined admission
 
-    def _start_admission(self, slot_id: int, request: _Request
-                         ) -> Optional[_PendingPrefill]:
+    def _plan_pages(self, request: scheduler.Request
+                    ) -> Optional[cache_manager.AdmissionPlan]:
+        """Paged mode: match the prefix cache and allocate this
+        request's pages (raises PagesExhausted -> caller defers)."""
+        if self._kv is None:
+            return None
+        # MoE prefill couples every prompt token through the capacity
+        # dispatch, so a shared prefix does NOT have shared KV — pages
+        # pool, but never cross-request reuse.
+        plan = self._kv.plan_admission(
+            request.prompt_ids, request.max_new_tokens,
+            prefix_ok=(self.cfg.n_experts == 0))
+        request.span.prefix_hit_pages = plan.prefix_hit_pages
+        return plan
+
+    def _pad_row(self, row: List[int]):
+        import numpy as np  # pylint: disable=import-outside-toplevel
+        padded = np.zeros((self.max_len // self._kv.page_size,),
+                          np.int32)
+        padded[:len(row)] = row
+        return self._jnp.asarray(padded)
+
+    def _start_admission(self, slot_id: int,
+                         request: scheduler.Request
+                         ) -> Optional[scheduler.PendingPrefill]:
         """Begin admitting `request` into `slot_id`.  Returns a
-        _PendingPrefill when chunks remain, None when the slot is live
-        (or the request finished at admission)."""
+        PendingPrefill when chunks remain, None when the slot is live
+        (or the request finished at admission).  Raises PagesExhausted
+        (pool backpressure) BEFORE touching any state — the caller
+        requeues the request at the head."""
         jnp = self._jnp
         slot = self._slots[slot_id]
         prompt = request.prompt_ids
         n = len(prompt)
+        plan = self._plan_pages(request)   # may raise PagesExhausted
+        if plan is not None:
+            self._kv.commit(slot_id, plan)
+        self._queue.record_admission(request)
         if self.cfg.n_experts > 0 and n > 0:
             # MoE: the capacity dispatch couples EVERY prompt token, so
             # pad tokens, an n-1/last-token split, and chunk boundaries
@@ -654,19 +533,31 @@ class ContinuousBatchingEngine:
                 self.params, jnp.asarray([prompt], jnp.int32))
             request.span.mark_prefill_chunk(
                 time.perf_counter() - t_prefill)
-            self._cache = self._insert(self._cache, slot_id, pre, n)
+            if plan is not None:
+                import numpy as np  # pylint: disable=import-outside-toplevel
+                n_pages = -(-n // self._kv.page_size)
+                self._cache = self._insert_pages(
+                    self._cache, pre,
+                    np.asarray(plan.row[:n_pages], np.int32),
+                    first_page=0)
+            else:
+                self._cache = self._insert(self._cache, slot_id, pre, n)
             key = self._jax.random.PRNGKey(request.seed)
             carry, sub = self._jax.random.split(key)
-            first = int(self._sample_one(
-                logits, sub[None],
-                jnp.asarray([request.temperature], jnp.float32),
-                jnp.asarray([request.top_k], jnp.int32))[0])
+            first = self._sampler.sample_one(logits, sub,
+                                             request.temperature,
+                                             request.top_k)
             request._push(first)  # pylint: disable=protected-access
             self._record_tokens(1)
             if (request.max_new_tokens <= 1 or
                     first in request.stop_ids):
                 request._finish()  # pylint: disable=protected-access
+                if plan is not None:
+                    self._kv.release(slot_id)
                 return None
+            if plan is not None:
+                self._cache = self._admit_paged(
+                    self._cache, slot_id, self._pad_row(plan.row), n)
             slot.request = request
             self._activate(slot_id, request, first, n,
                            remaining=request.max_new_tokens - 1,
@@ -676,11 +567,26 @@ class ContinuousBatchingEngine:
             # Single-token prompt: empty slot; stale keys are masked
             # (per-position causal mask) and position 0 is overwritten
             # by the first step's write.
-            self._cache = dict(
-                self._cache,
-                lengths=self._cache['lengths'].at[slot_id].set(0))
+            if plan is not None:
+                self._cache = self._admit_paged(
+                    self._cache, slot_id, self._pad_row(plan.row), 0)
+            else:
+                self._cache = dict(
+                    self._cache,
+                    lengths=self._cache['lengths'].at[slot_id].set(0))
             slot.request = request
             self._activate(slot_id, request, int(prompt[-1]), 0,
+                           remaining=request.max_new_tokens,
+                           key=self._jax.random.PRNGKey(request.seed))
+            return None
+        if plan is not None and plan.n_reuse_tokens >= n - 1:
+            # Full prefix hit (the prefilled region [0, n-1) is page-
+            # aligned and entirely cached): no prefill at all — the
+            # slot joins the next tick and TTFT collapses to one step.
+            self._cache = self._admit_paged(
+                self._cache, slot_id, self._pad_row(plan.row), n - 1)
+            slot.request = request
+            self._activate(slot_id, request, int(prompt[-1]), n - 1,
                            remaining=request.max_new_tokens,
                            key=self._jax.random.PRNGKey(request.seed))
             return None
@@ -689,10 +595,12 @@ class ContinuousBatchingEngine:
         # overwrites the first pad position and attends only real
         # keys, so logits match unpadded decode exactly).
         slot.request = request
-        pending = _PendingPrefill(slot_id, request, n - 1)
+        pending = scheduler.PendingPrefill(slot_id, request, n - 1)
+        pending.plan = plan
         return pending
 
-    def _advance_prefill(self, pending: _PendingPrefill) -> bool:
+    def _advance_prefill(self, pending: scheduler.PendingPrefill
+                         ) -> bool:
         """Run ONE chunk of a pending prefill (this is the whole point:
         an admission stalls running decodes by at most one chunk).
         Returns True when the prefill completed and the slot went live.
@@ -702,11 +610,27 @@ class ContinuousBatchingEngine:
         if request.cancelled:
             request._finish()  # pylint: disable=protected-access
             self._slots[pending.slot_id].request = None
+            if pending.plan is not None:
+                self._release_slot_pages(pending.slot_id)
             return True  # pending is finished (slot freed)
         import numpy as np  # pylint: disable=import-outside-toplevel
         t_chunk0 = time.perf_counter()
         n_target = pending.n_target
         chunk = self.prefill_chunk
+        plan = pending.plan
+        reuse_tokens = plan.n_reuse_tokens if plan is not None else 0
+        if pending.cache is None and reuse_tokens > 0:
+            # Prefix hit: seed the private cache from the cached pages
+            # — positions [0, reuse_tokens) appear exactly as if they
+            # had been prefilled here; only the tail chunks run.
+            pending.cache = self._seed_private(
+                self._cache,
+                np.asarray(plan.reuse_pages, np.int32),
+                priv_len=self.max_len)
+            pending.consumed = reuse_tokens
+            request.span.mark_prefill_chunk(
+                time.perf_counter() - t_chunk0)
+            return False
         if pending.cache is None:
             # Chunk 0: flash prefill from index 0 into a fresh private
             # cache.  Width = the bucket of min(n_target, chunk) so
@@ -729,13 +653,22 @@ class ContinuousBatchingEngine:
             pending.consumed = take
         else:
             # Chunk i>0: masked per-position-causal continuation at
-            # index = consumed.  Always `chunk` wide (one compile);
-            # the final partial chunk is zero-padded — pad positions
-            # are beyond every real query's causal horizon and each is
-            # overwritten by the decode step that reaches it.
+            # index = consumed.  Width is the POWER-OF-TWO BUCKET of
+            # the remaining tail capped at `chunk` (bounded compile
+            # count) AND at max_len - start: the write must fit the
+            # private cache — a wider piece would make
+            # dynamic_update_slice clamp its start index and silently
+            # overwrite already-prefilled positions (reachable when
+            # chunk does not divide max_len, and on every prefix-hit
+            # seed whose tail is shorter than one chunk).  Pad
+            # positions are beyond every real query's causal horizon
+            # and each is overwritten by the decode step that reaches
+            # it.
             start = pending.consumed
             take = min(n_target - start, chunk)
-            piece = np.zeros((1, chunk), np.int32)
+            width = min(self._bucket(take), chunk,
+                        self.max_len - start)
+            piece = np.zeros((1, width), np.int32)
             piece[0, :take] = request.prompt_ids[start:start + take]
             _, pending.cache = self._prefill_chunk(
                 self.params, jnp.asarray(piece), pending.cache)
@@ -744,34 +677,47 @@ class ContinuousBatchingEngine:
                 index=jnp.asarray(start + take, jnp.int32))
             pending.consumed = start + take
         request.span.mark_prefill_chunk(time.perf_counter() - t_chunk0)
-        _M_PREFILL_CHUNKS.inc()
-        with self._metrics_lock:
-            self._prefill_chunks += 1
+        self._record_chunk()
         if pending.consumed < n_target:
             return False
         # All chunks in: adopt the private cache into the slot pool and
         # join the next decode tick at length n-1 with the last REAL
         # prompt token as input.
-        self._cache = self._insert(self._cache, pending.slot_id,
-                                   pending.cache, n_target)
+        if plan is not None:
+            # Scatter only the FRESH pages (the reused prefix already
+            # lives in the pool — rewriting pages another slot shares,
+            # even with identical values, is what this skips), then
+            # point the block table at the full row and publish the
+            # fresh full pages for the next prefix hit.
+            ps = self._kv.page_size
+            r = len(plan.reuse_pages)
+            n_prompt_pages = -(-n_target // ps)
+            self._cache = self._insert_pages(
+                self._cache, pending.cache,
+                np.asarray(plan.row[r:n_prompt_pages], np.int32),
+                first_page=r)
+            pending.cache = None   # donated to the scatter
+            self._cache = self._admit_paged(
+                self._cache, pending.slot_id,
+                self._pad_row(plan.row), n_target)
+            self._kv.register_prefix(plan)
+        else:
+            self._cache = self._insert(self._cache, pending.slot_id,
+                                       pending.cache, n_target)
         self._activate(pending.slot_id, request,
                        int(request.prompt_ids[-1]), n_target,
                        remaining=request.max_new_tokens,
                        key=self._jax.random.PRNGKey(request.seed))
         return True
 
-    def _activate(self, slot_id: int, request: _Request, token: int,
-                  length: int, *, remaining: int, key) -> None:
+    def _activate(self, slot_id: int, request: scheduler.Request,
+                  token: int, length: int, *, remaining: int,
+                  key) -> None:
         """Flip a slot live in the device state (one jitted dispatch)."""
         del length  # cache lengths are set by insert/admission paths
-        jnp = self._jnp
-        stop_row = [-1] * self.max_stop_ids
-        for i, sid in enumerate(sorted(request.stop_ids)):
-            stop_row[i] = sid
-        self._state = self._admit_state(
-            self._state, slot_id, token, remaining,
-            jnp.asarray(stop_row, jnp.int32), key,
-            request.temperature, request.top_k)
+        self._state = self._sampler.admit(
+            self._state, slot_id, token, remaining, request.stop_ids,
+            key, request.temperature, request.top_k)
 
     def _deactivate(self, slot_ids: List[int]) -> None:
         """Host-forced slot shutdown (cancel): flip active off so the
@@ -780,6 +726,15 @@ class ContinuousBatchingEngine:
         for i in slot_ids:
             active = active.at[i].set(False)
         self._state = dict(self._state, active=active)
+
+    def _release_slot_pages(self, slot_id: int) -> None:
+        """Paged mode: park the slot's block table on the null page
+        (stale in-flight writes land in garbage, never in recycled
+        pages), THEN return its pages to the pool."""
+        if self._kv is None:
+            return
+        self._cache = self._release_paged(self._cache, slot_id)
+        self._kv.release(slot_id)
 
     # ------------------------------------------------- pipelined worker
 
@@ -791,11 +746,12 @@ class ContinuousBatchingEngine:
         # One in-flight tick: (state_handles, finished_handle,
         # [(slot_id, request), ...]) — read one tick behind.
         inflight: Optional[Tuple[Any, Any, List[Tuple[int, Any]]]] = None
-        pending_prefills: Deque[_PendingPrefill] = collections.deque()
-        live: Dict[int, _Request] = {}   # slot -> decoding request
+        pending_prefills: Deque[scheduler.PendingPrefill] = (
+            collections.deque())
+        live: Dict[int, scheduler.Request] = {}  # slot -> decoding req
         while not self._stop.is_set():
             try:
-                self._expire_queued()
+                self._queue.expire_stale()
                 # Cancelled live requests: freeze their slots on device
                 # before the next dispatch, free them for admission.
                 cancelled = [i for i, r in live.items() if r.cancelled]
@@ -804,16 +760,29 @@ class ContinuousBatchingEngine:
                     for i in cancelled:
                         request = live.pop(i)
                         self._slots[i].request = None
+                        self._release_slot_pages(i)
                         request._finish()  # pylint: disable=protected-access
                 # Admissions: hand free slots to queued requests.  The
                 # prompt's chunks run interleaved with ticks below.
+                # Page-pool exhaustion DEFERS (the request goes back to
+                # the queue head and waits for pages to free or its
+                # TTL) — it must never fail the engine.
+                deferred = False
                 free = [i for i, s in enumerate(self._slots)
                         if not s.active]
                 for slot_id in free:
-                    request = self._pop_request()
+                    request = self._queue.pop()
                     if request is None:
                         break
-                    pending = self._start_admission(slot_id, request)
+                    try:
+                        pending = self._start_admission(slot_id,
+                                                        request)
+                    except cache_manager.PagesExhausted:
+                        self._queue.requeue_front(request)
+                        with self._metrics_lock:
+                            self._page_deferrals += 1
+                        deferred = True
+                        break
                     if pending is not None:
                         pending_prefills.append(pending)
                     elif self._slots[slot_id].request is not None:
@@ -852,6 +821,7 @@ class ContinuousBatchingEngine:
                         if fins[slot_id]:
                             live.pop(slot_id, None)
                             self._slots[slot_id].request = None
+                            self._release_slot_pages(slot_id)
                             request._finish()  # pylint: disable=protected-access
                     if pushed:
                         self._record_tokens(pushed)
@@ -863,9 +833,17 @@ class ContinuousBatchingEngine:
                 inflight = dispatched
                 if (inflight is None and not live and
                         not pending_prefills):
-                    with self._cond:
-                        if not self._queue and not self._stop.is_set():
-                            self._cond.wait(timeout=0.05)
+                    if deferred:
+                        # Pool exhausted and nothing running to free
+                        # pages soon: throttle the retry loop (TTL
+                        # expiry / cancel / submit backpressure are
+                        # what resolve this state).
+                        time.sleep(0.005)
+                    else:
+                        with self._cond:
+                            if (not len(self._queue) and
+                                    not self._stop.is_set()):
+                                self._cond.wait(timeout=0.05)
             except Exception as e:  # pylint: disable=broad-except
                 logger.exception('batching engine tick failed')
                 # The jit'd step donates the slot cache — after a
@@ -878,10 +856,11 @@ class ContinuousBatchingEngine:
 
     # --------------------------------------------------- legacy worker
 
-    def _admit_legacy(self, slot_id: int, request: _Request) -> None:
+    def _admit_legacy(self, slot_id: int,
+                      request: scheduler.Request) -> None:
         """Pre-pipeline admission: the WHOLE prompt prefills inline
         (one long stall for every running request — what chunked
-        prefill bounds)."""
+        prefill bounds).  Dense cache only."""
         if request.cancelled:
             request._finish()  # pylint: disable=protected-access
             return
@@ -963,19 +942,19 @@ class ContinuousBatchingEngine:
     def _run_legacy(self) -> None:
         while not self._stop.is_set():
             try:
-                self._expire_queued()
+                self._queue.expire_stale()
                 idle = not any(s.active for s in self._slots)
                 free = [i for i, s in enumerate(self._slots)
                         if not s.active]
                 for slot_id in free:
-                    request = self._pop_request()
+                    request = self._pop_admitted()
                     if request is None:
                         if idle:
                             with self._cond:
-                                if (not self._queue and
+                                if (not len(self._queue) and
                                         not self._stop.is_set()):
                                     self._cond.wait(timeout=0.05)
-                            request = self._pop_request()
+                            request = self._pop_admitted()
                         if request is None:
                             break
                     try:
@@ -989,6 +968,12 @@ class ContinuousBatchingEngine:
                 self._fail_everything(e)
                 return
 
+    def _pop_admitted(self) -> Optional[scheduler.Request]:
+        request = self._queue.pop()
+        if request is not None:
+            self._queue.record_admission(request)
+        return request
+
     # ------------------------------------------------------------ failure
 
     def _fail_everything(self, e: Exception) -> None:
@@ -999,10 +984,7 @@ class ContinuousBatchingEngine:
                 slot.request._finish(RuntimeError(  # pylint: disable=protected-access
                     f'batching engine failed: {e}'))
                 slot.request = None
-        while True:
-            with self._cond:
-                if not self._queue:
-                    break
-                request = self._queue.popleft()
-            request._finish(RuntimeError(  # pylint: disable=protected-access
-                f'batching engine failed: {e}'))
+        self._queue.drain(
+            lambda: RuntimeError(f'batching engine failed: {e}'))
+        if self._kv is not None:
+            self._kv.release_all()

@@ -71,7 +71,11 @@ class ModelServer:
                  prefill_chunk: int = 512,
                  default_temperature: float = 0.0,
                  default_top_k: int = 0,
-                 default_seed: int = 0) -> None:
+                 default_seed: int = 0,
+                 kv_pages: Optional[int] = None,
+                 page_size: int = 16,
+                 quantize_kv: bool = False,
+                 prefix_caching: bool = True) -> None:
         import jax
         import flax.linen as nn
 
@@ -205,7 +209,9 @@ class ModelServer:
                 self.cfg, self.params, max_len=max_len,
                 slots=max_batch, max_queue=max_queue,
                 queue_ttl=queue_ttl, prefill_chunk=prefill_chunk,
-                mesh=self._mesh)
+                mesh=self._mesh, kv_pages=kv_pages,
+                page_size=page_size, quantize_kv=quantize_kv,
+                prefix_caching=prefix_caching)
 
     def close(self) -> None:
         """Release background resources (the batching engine's worker
@@ -611,6 +617,36 @@ def main() -> None:
                              'prefill in chunks interleaved with '
                              'decode ticks, bounding the ITL stall an '
                              'admission imposes on running requests.')
+    import os as _os
+    parser.add_argument('--kv-pages', type=int,
+                        default=(int(_os.environ['SKYTPU_SERVE_KV_PAGES'])
+                                 if _os.environ.get(
+                                     'SKYTPU_SERVE_KV_PAGES')
+                                 else None),
+                        help='Paged KV cache: pool of N pages with '
+                             'per-slot block tables — slot count '
+                             'decouples from --max-len, pool '
+                             'exhaustion backpressures (429). '
+                             'Default: dense per-slot cache '
+                             '(env SKYTPU_SERVE_KV_PAGES).')
+    parser.add_argument('--page-size', type=int,
+                        default=int(_os.environ.get(
+                            'SKYTPU_SERVE_PAGE_SIZE', '16')),
+                        help='Tokens per KV page (--kv-pages mode; '
+                             '--max-len must be a multiple; env '
+                             'SKYTPU_SERVE_PAGE_SIZE).')
+    parser.add_argument('--quantize-kv', action='store_true',
+                        default=_os.environ.get(
+                            'SKYTPU_SERVE_KV_INT8', '') == '1',
+                        help='Store KV pages as int8 with per-page-'
+                             'per-head scales: ~2x tokens per byte of '
+                             'cache (env SKYTPU_SERVE_KV_INT8=1).')
+    parser.add_argument('--no-prefix-cache', action='store_true',
+                        default=_os.environ.get(
+                            'SKYTPU_SERVE_PREFIX_CACHE', '1') == '0',
+                        help='Disable prompt prefix reuse across '
+                             'requests (--kv-pages mode; env '
+                             'SKYTPU_SERVE_PREFIX_CACHE=0).')
     parser.add_argument('--temperature', type=float, default=0.0,
                         help='Default sampling temperature for '
                              'requests that omit it (0 = greedy).')
@@ -642,7 +678,11 @@ def main() -> None:
                          prefill_chunk=args.prefill_chunk,
                          default_temperature=args.temperature,
                          default_top_k=args.top_k,
-                         default_seed=args.seed)
+                         default_seed=args.seed,
+                         kv_pages=args.kv_pages,
+                         page_size=args.page_size,
+                         quantize_kv=args.quantize_kv,
+                         prefix_caching=not args.no_prefix_cache)
     if args.http_server == 'async':
         from skypilot_tpu.serve import async_server  # pylint: disable=import-outside-toplevel
         async_server.serve_forever(server, args.port)

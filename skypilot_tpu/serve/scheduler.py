@@ -1,0 +1,379 @@
+"""Request lifecycle + bounded admission queue for the batching engine.
+
+Split out of `serve/batching_engine.py` (which remains the facade and
+re-exports every public name here): this module owns everything about a
+request BEFORE it reaches a KV slot and AFTER tokens start flowing —
+
+- :class:`Request` — the handle `submit()` returns: token stream with
+  replaying watchers, result()/stream()/cancel(), idempotent finish
+  (worker-finish vs stop() vs submit-after-stop races resolve to one
+  winner), per-request :class:`~..observability.tracing.RequestSpan`.
+- :class:`AdmissionQueue` — bounded FIFO with TTL: `max_queue` rejects
+  new submits (:class:`QueueFull` -> HTTP 429 + Retry-After) and
+  `queue_ttl` expires stale waiters (:class:`QueueExpired` -> 503), so
+  a load spike degrades with fast honest rejections instead of
+  unbounded TTFT.  The queue records admission waits into the
+  histogram only when a request actually lands in a slot — a deferred
+  pop (page pool exhausted) goes back to the FRONT uncounted.
+- :class:`Slot` / :class:`PendingPrefill` — per-slot host bookkeeping.
+"""
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import tracing
+
+# Queue-wait histogram bucket upper bounds (seconds); the last bucket
+# is open-ended.  Surfaced via stats() -> /health for autoscaling.
+WAIT_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+
+_M_ADMITTED = metrics_lib.counter(
+    'skytpu_engine_admitted_total',
+    'Requests admitted into a KV slot.')
+_M_REJECTED = metrics_lib.counter(
+    'skytpu_engine_rejected_total',
+    'Requests rejected at admission, by reason.', ('reason',))
+_M_QUEUE_DEPTH = metrics_lib.gauge(
+    'skytpu_engine_queue_depth', 'Requests waiting for a slot.')
+_M_QUEUE_WAIT = metrics_lib.histogram(
+    'skytpu_engine_queue_wait_seconds',
+    'Seconds a request waited queued before admission.',
+    buckets=WAIT_BUCKETS)
+_M_TTFT = metrics_lib.histogram(
+    'skytpu_engine_ttft_seconds',
+    'Submit-to-first-token latency per request.')
+_M_ITL = metrics_lib.histogram(
+    'skytpu_engine_itl_seconds',
+    'Inter-token gaps during decode.',
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5, 5.0))
+
+
+class QueueFull(RuntimeError):
+    """submit() rejected: the admission queue is at max_queue, or the
+    KV page pool cannot cover the request while a backlog waits.
+
+    `retry_after` is the engine's estimate (seconds) of when a slot's
+    worth of backlog will have drained — servers surface it as an HTTP
+    Retry-After header on the 429.
+    """
+
+    def __init__(self, message: str, retry_after: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after = max(1.0, retry_after)
+
+
+class QueueExpired(RuntimeError):
+    """The request sat queued past queue_ttl and was never admitted
+    (servers map this to 503 + Retry-After)."""
+
+    def __init__(self, message: str, retry_after: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after = max(1.0, retry_after)
+
+
+class Request:
+
+    def __init__(self, prompt_ids: List[int], max_new_tokens: int,
+                 stop_token, temperature: float = 0.0, top_k: int = 0,
+                 seed: int = 0,
+                 request_id: Optional[str] = None) -> None:
+        self.prompt_ids = list(prompt_ids)
+        self.max_new_tokens = max_new_tokens
+        # Per-request phase trace (queue/prefill/TTFT/ITL/total); the
+        # id arrives via X-SkyTPU-Request-Id or is generated here.
+        self.span = tracing.RequestSpan(request_id)
+        self.request_id = self.span.request_id
+        # stop_token: None, a single id, or any iterable of ids (the
+        # tokenizer's multi-EOS stop set — instruct checkpoints stop at
+        # chat turn-end markers, not just the model-level EOS).
+        if stop_token is None:
+            self.stop_ids = frozenset()
+        elif isinstance(stop_token, int):
+            self.stop_ids = frozenset({stop_token})
+        else:
+            self.stop_ids = frozenset(int(t) for t in stop_token)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
+        self.submit_time = time.monotonic()
+        self.done = threading.Event()
+        self.tokens: List[int] = []
+        self.error: Optional[Exception] = None
+        self.cancelled = False
+        # Streaming consumers read tokens as they are produced; the
+        # None sentinel marks the end of the stream.
+        self._live: 'queue.Queue[Optional[int]]' = queue.Queue()
+        # _finish can race (worker finishing vs stop() failing-fast vs
+        # submit() losing the stop race): first caller wins, later
+        # calls are no-ops — otherwise two None sentinels truncate a
+        # stream() and a success can be overwritten with an error.
+        self._state_lock = threading.Lock()
+        # Event-loop bridges (serve/async_server.py): called with each
+        # token and a final None, from the engine worker thread, under
+        # the state lock — watchers must be cheap and non-blocking
+        # (call_soon_threadsafe qualifies).
+        self._watchers: List[Any] = []
+        # Set by the engine at submit(): finished spans land here.
+        self._span_store: Optional[tracing.SpanStore] = None
+
+    def add_watcher(self, fn) -> None:
+        """Subscribe fn(token|None) to this request's token stream;
+        tokens already produced are replayed first, so late subscribers
+        never miss a prefix (the admission path can push the first
+        token before the caller gets the request handle back)."""
+        with self._state_lock:
+            for token in self.tokens:
+                fn(token)
+            if self.done.is_set():
+                fn(None)
+            else:
+                self._watchers.append(fn)
+
+    def _push(self, token: int) -> None:
+        with self._state_lock:
+            if self.done.is_set():
+                # stop() already finished this request; a worker still
+                # mid-tick must not append past the sentinel.
+                return
+            gap = self.span.mark_token()
+            if gap is None:
+                if self.span.ttft_s is not None:
+                    _M_TTFT.observe(self.span.ttft_s)
+            else:
+                _M_ITL.observe(gap)
+            self.tokens.append(token)
+            self._live.put(token)
+            self._notify(token)
+
+    def _finish(self, error: Optional[Exception] = None) -> None:
+        with self._state_lock:
+            if self.done.is_set():
+                return
+            self.error = error
+            self.done.set()
+            if error is not None:
+                status = type(error).__name__
+            elif self.cancelled:
+                status = 'cancelled'
+            else:
+                status = 'ok'
+            self.span.finish(status)
+            if self._span_store is not None:
+                self._span_store.add(self.span)
+            self._live.put(None)
+            self._notify(None)
+            self._watchers.clear()
+
+    def _notify(self, token: Optional[int]) -> None:
+        # A raising watcher (e.g. call_soon_threadsafe on a closed
+        # event loop at shutdown) must not propagate into the engine
+        # worker — that would fail the WHOLE engine for one dead
+        # subscriber.  Drop it instead.
+        for fn in list(self._watchers):
+            try:
+                fn(token)
+            except Exception:  # pylint: disable=broad-except
+                try:
+                    self._watchers.remove(fn)
+                except ValueError:
+                    pass
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self.done.wait(timeout):
+            raise TimeoutError('generation timed out')
+        if self.error is not None:
+            raise self.error
+        return self.tokens
+
+    def stream(self, timeout: Optional[float] = None):
+        """Yield tokens as the engine produces them."""
+        while True:
+            token = self._live.get(timeout=timeout)
+            if token is None:
+                if self.error is not None:
+                    raise self.error
+                return
+            yield token
+
+    def cancel(self) -> None:
+        """Stop generating for this request (client went away); the
+        engine frees the slot on its next tick."""
+        self.cancelled = True
+
+
+class Slot:
+
+    def __init__(self) -> None:
+        self.request: Optional[Request] = None
+        self.next_token = 0          # legacy (unpipelined) loop only
+
+    @property
+    def active(self) -> bool:
+        return self.request is not None
+
+
+class PendingPrefill:
+    """A dense prompt mid-chunked-prefill: the slot is reserved but
+    does not join decode ticks until every chunk has run."""
+
+    def __init__(self, slot_id: int, request: Request,
+                 n_target: int) -> None:
+        self.slot_id = slot_id
+        self.request = request
+        self.n_target = n_target     # tokens to prefill (n-1, dense)
+        self.consumed = 0
+        self.cache: Optional[Dict[str, Any]] = None  # private [*,1,..]
+        # Paged mode: the cache_manager.AdmissionPlan holding this
+        # request's pages (reuse + fresh) until activation/abandon.
+        self.plan: Optional[Any] = None
+
+
+class AdmissionQueue:
+    """Bounded, TTL'd FIFO between submit() threads and the worker."""
+
+    def __init__(self, max_queue: int = 0,
+                 queue_ttl: Optional[float] = None,
+                 drain_estimate: Callable[[], float] = lambda: 1.0
+                 ) -> None:
+        self.max_queue = int(max_queue)      # 0 = unbounded
+        self.queue_ttl = queue_ttl           # None = no expiry
+        self._drain_estimate = drain_estimate
+        self._queue: Deque[Request] = collections.deque()
+        self.cond = threading.Condition()
+        # Engine-local metric mirror (stats()); the process-global
+        # registry instruments above carry the /metrics view.
+        self._metrics_lock = threading.Lock()
+        self.queue_full_rejections = 0
+        self.queue_ttl_expiries = 0
+        self.wait_hist = [0] * (len(WAIT_BUCKETS) + 1)
+        _M_QUEUE_DEPTH.set(0)
+
+    def __len__(self) -> int:
+        with self.cond:
+            return len(self._queue)
+
+    def submit(self, request: Request) -> None:
+        """Append (FIFO) or reject with QueueFull at the bound."""
+        with self.cond:
+            if self.max_queue and len(self._queue) >= self.max_queue:
+                with self._metrics_lock:
+                    self.queue_full_rejections += 1
+                _M_REJECTED.labels(reason='queue_full').inc()
+                raise QueueFull(
+                    f'admission queue full ({self.max_queue} waiting); '
+                    'retry later', retry_after=self._drain_estimate())
+            self._queue.append(request)
+            _M_QUEUE_DEPTH.set(len(self._queue))
+            self.cond.notify()
+
+    def reject(self, reason: str, message: str) -> QueueFull:
+        """Count a non-queue-bound rejection (e.g. page-pool
+        exhaustion) and build the QueueFull to raise."""
+        with self._metrics_lock:
+            self.queue_full_rejections += 1
+        _M_REJECTED.labels(reason=reason).inc()
+        return QueueFull(message, retry_after=self._drain_estimate())
+
+    def requeue_front(self, request: Request) -> None:
+        """Put a popped-but-not-admitted request back at the head
+        (admission deferred: no pages/slots right now); its queue-wait
+        keeps accruing and is recorded only at the real admission."""
+        with self.cond:
+            self._queue.appendleft(request)
+            _M_QUEUE_DEPTH.set(len(self._queue))
+
+    def pop(self) -> Optional[Request]:
+        """Pop the next live queued request, expiring stale ones.  Does
+        NOT record the admission — call record_admission() once the
+        request actually lands in a slot."""
+        while True:
+            with self.cond:
+                if not self._queue:
+                    return None
+                request = self._queue.popleft()
+                _M_QUEUE_DEPTH.set(len(self._queue))
+            if request.cancelled:
+                request._finish()  # pylint: disable=protected-access
+                continue
+            if (self.queue_ttl is not None and
+                    time.monotonic() - request.submit_time >
+                    self.queue_ttl):
+                self._record_expiry(1)
+                request._finish(QueueExpired(  # pylint: disable=protected-access
+                    f'request expired after {self.queue_ttl}s queued',
+                    retry_after=self._drain_estimate()))
+                continue
+            return request
+
+    def record_admission(self, request: Request) -> None:
+        request.span.mark_admitted()
+        wait = time.monotonic() - request.submit_time
+        _M_ADMITTED.inc()
+        _M_QUEUE_WAIT.observe(wait)
+        with self._metrics_lock:
+            for i, bound in enumerate(WAIT_BUCKETS):
+                if wait < bound:
+                    self.wait_hist[i] += 1
+                    return
+            self.wait_hist[-1] += 1
+
+    def _record_expiry(self, n: int) -> None:
+        with self._metrics_lock:
+            self.queue_ttl_expiries += n
+        _M_REJECTED.labels(reason='queue_expired').inc(n)
+
+    def expire_stale(self) -> None:
+        """Fail requests that outlived queue_ttl while still queued —
+        without this a saturated engine leaves them waiting out their
+        whole client timeout."""
+        if self.queue_ttl is None:
+            return
+        now = time.monotonic()
+        expired = []
+        with self.cond:
+            if not self._queue:
+                return
+            keep: Deque[Request] = collections.deque()
+            for request in self._queue:
+                if now - request.submit_time > self.queue_ttl:
+                    expired.append(request)
+                else:
+                    keep.append(request)
+            self._queue = keep
+            _M_QUEUE_DEPTH.set(len(keep))
+        if expired:
+            self._record_expiry(len(expired))
+        for request in expired:
+            request._finish(QueueExpired(  # pylint: disable=protected-access
+                f'request expired after {self.queue_ttl}s queued',
+                retry_after=self._drain_estimate()))
+
+    def drain(self, error_factory: Callable[[], Exception]) -> None:
+        """Fail everything still queued (shutdown/engine failure)."""
+        while True:
+            with self.cond:
+                if not self._queue:
+                    _M_QUEUE_DEPTH.set(0)
+                    return
+                request = self._queue.popleft()
+            request._finish(error_factory())  # pylint: disable=protected-access
+
+    def stats(self) -> Dict[str, Any]:
+        hist = {}
+        with self._metrics_lock:
+            for i, bound in enumerate(WAIT_BUCKETS):
+                hist[f'<{bound}s'] = self.wait_hist[i]
+            hist[f'>={WAIT_BUCKETS[-1]}s'] = self.wait_hist[-1]
+            return {
+                'queued_requests': len(self._queue),
+                'queue_full_rejections': self.queue_full_rejections,
+                'queue_ttl_expiries': self.queue_ttl_expiries,
+                'queue_wait_hist': hist,
+                'max_queue': self.max_queue,
+            }

@@ -284,6 +284,21 @@ class TestChunkedPrefill:
         finally:
             eng.stop()
 
+    def test_chunk_not_dividing_max_len_stays_exact(self, setup):
+        """Regression: a continuation chunk whose width would run past
+        max_len (chunk 48 from index 48 in a 64-length cache) must be
+        narrowed, not clamped backwards by dynamic_update_slice over
+        already-prefilled positions."""
+        cfg, params = setup
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=64, slots=1, prefill_chunk=48)
+        try:
+            prompt = list(range(1, 61))       # 59 to prefill: 48 + 11
+            got = eng.generate(prompt, 3, timeout=180)
+            assert got == _reference(cfg, params, prompt, 3)
+        finally:
+            eng.stop()
+
     def test_cancel_mid_prefill_frees_slot(self, setup):
         """Cancelling a request whose prompt is still chunking must
         abandon the remaining chunks and free the slot."""

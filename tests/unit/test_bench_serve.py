@@ -1,7 +1,8 @@
 """Serving perf smoke: `bench_serve.py --smoke` runs on every PR
 (tier-1, NOT slow-marked — this is the guardrail that keeps the decode
-hot loop fast), writing BENCH_serve_smoke.json at the repo root so the
-serving perf trajectory has a point per change."""
+hot loop fast).  Output goes to a TEMP path (the pinned
+BENCH_serve_smoke.json at the repo root only refreshes behind
+`--pin`, so tier-1 runs stop churning the committed sample)."""
 from __future__ import annotations
 
 import json
@@ -13,8 +14,11 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 
 
-def test_bench_serve_smoke():
-    out_path = os.path.join(_REPO_ROOT, 'BENCH_serve_smoke.json')
+def test_bench_serve_smoke(tmp_path):
+    out_path = os.path.join(str(tmp_path), 'BENCH_serve_smoke.json')
+    pinned = os.path.join(_REPO_ROOT, 'BENCH_serve_smoke.json')
+    pinned_mtime = (os.path.getmtime(pinned)
+                    if os.path.exists(pinned) else None)
     env = dict(os.environ, JAX_PLATFORMS='cpu')
     # The remote-compile PJRT plugin must not route this CPU smoke
     # through a TPU tunnel (same scrub as conftest's re-exec).
@@ -27,6 +31,10 @@ def test_bench_serve_smoke():
     assert proc.returncode == 0, proc.stderr[-2000:]
     with open(out_path, encoding='utf-8') as f:
         data = json.load(f)
+    # The pinned repo-root sample must NOT have been rewritten (that
+    # was pure VCS churn; only --pin updates it).
+    if pinned_mtime is not None:
+        assert os.path.getmtime(pinned) == pinned_mtime
     # Schema the BENCH trajectory depends on.
     assert data['metric'] == 'serve_decode_tokens_per_sec'
     assert data['unit'] == 'tokens/s'
@@ -57,3 +65,18 @@ def test_bench_serve_smoke():
     # Chunked admission must stall running decodes by at most ~one
     # chunk's compute (the bound includes scheduling slack).
     assert stall['stall_bounded_by_chunk'], stall
+    # Paged KV: at the dense cache's exact memory budget, the int8
+    # page pool must run >= 2x the concurrent slots (the full bench
+    # pins >10x; 2x is the flake-proof floor) — and actually ran them
+    # concurrently, then drained the pool.
+    cap = data['paged_capacity']
+    assert cap['max_concurrent_paged'] >= 2 * cap['max_concurrent_dense'], cap
+    assert cap['peak_busy_slots'] >= 2 * cap['max_concurrent_dense'], cap
+    assert cap['pool_drained'] is True, cap
+    # Prefix cache: a shared-prefix hit must collapse TTFT (adopting
+    # cached pages instead of re-prefilling; the full bench pins
+    # <= 0.25x, the smoke floor is looser for CI noise).
+    prefix = data['prefix_cache']
+    assert prefix['prefix_hit_pages'] > 0, prefix
+    assert prefix['ttft_hit_ratio'] <= 0.5, prefix
+    assert prefix['ttft_hit_ms'] < prefix['ttft_cold_ms'], prefix

@@ -465,6 +465,24 @@ class TestScenarios:
         assert result.details['transitions'][-1] == 'READY'
         assert 'NOT_READY' in result.details['transitions']
 
+    def test_page_pool_exhaustion(self, local_infra):
+        """KV page-pool denial must degrade to admission backpressure
+        (QueueFull/429) — never an engine failure — and the serve
+        journal must prove every allocated page was freed
+        (page_pool_balance invariant)."""
+        result = scenarios_lib.run_scenario('page_pool_exhaustion',
+                                            seed=21)
+        assert result.ok, result.violations
+        assert result.details['rejections'] >= 1
+        assert result.details['engine_failed'] is False
+        assert result.details['tokens_ok'] is True
+        assert result.details['kv_pages_used'] == 0
+        names = [e['event'] for e in result.events]
+        assert 'kv_pages_alloc' in names
+        assert 'kv_pages_free' in names
+        assert all(f['site'] == 'serve.page_pool'
+                   for f in result.fault_sequence)
+
     def test_export_trace(self, local_infra, tmp_path):
         trace_path = str(tmp_path / 'chaos.trace')
         result = scenarios_lib.run_scenario('queued_stall', seed=16,

@@ -1,0 +1,423 @@
+"""Paged-KV engine tests: paged/dense logits parity (greedy + sampled,
+native + int8 pages), chunked prefill across page boundaries, prefix
+reuse with mid-page divergence, pool exhaustion -> 429 backpressure,
+and no page leaks across completion/cancel/TTL.
+
+Engines are module-scoped where possible: every engine instance
+re-jits the paged step, so tests share one plain and one int8 engine
+(using disjoint token ranges so prefix-cache state cannot couple
+them) and only pool-accounting tests build their own small pools."""
+from __future__ import annotations
+
+import time
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import configs
+from skypilot_tpu.models import decode
+from skypilot_tpu.models.transformer import Transformer
+from skypilot_tpu.serve import batching_engine
+from skypilot_tpu.serve import cache_manager
+
+
+@pytest.fixture(scope='module')
+def setup():
+    cfg = configs.get_config('tiny')
+    model = Transformer(cfg)
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))['params'])
+    return cfg, params
+
+
+def _reference(cfg, params, prompt_ids, n, max_len=64):
+    prompt = jnp.asarray([prompt_ids], jnp.int32)
+    _, new = decode.generate(cfg, params, prompt, max_new_tokens=n,
+                             max_len=max_len)
+    return [int(t) for t in np.asarray(new)[0]]
+
+
+def _paged_engine(cfg, params, **kw):
+    kw.setdefault('max_len', 64)
+    kw.setdefault('slots', 2)
+    kw.setdefault('prefill_chunk', 8)
+    kw.setdefault('kv_pages', 48)
+    kw.setdefault('page_size', 8)
+    return batching_engine.ContinuousBatchingEngine(cfg, params, **kw)
+
+
+@pytest.fixture(scope='module')
+def plain_engine(setup):
+    cfg, params = setup
+    eng = _paged_engine(cfg, params)
+    yield eng
+    eng.stop()
+
+
+@pytest.fixture(scope='module')
+def int8_engine(setup):
+    cfg, params = setup
+    eng = _paged_engine(cfg, params, quantize_kv=True)
+    yield eng
+    eng.stop()
+
+
+class TestPagedParity:
+
+    def test_greedy_parity_vs_dense_generate(self, setup,
+                                             plain_engine):
+        """Greedy decode through the page pool must match the dense
+        single-sequence reference token-for-token (same masked
+        attention over the same values, gathered by page index)."""
+        cfg, params = setup
+        for prompt, n in (([3, 1, 4, 1, 5, 9, 2, 6], 6),
+                          ([7], 4),        # single-token prompt
+                          ([2, 7], 8),
+                          (list(range(1, 25)), 5)):  # multi-page
+            got = plain_engine.generate(prompt, n, timeout=180)
+            assert got == _reference(cfg, params, prompt, n), prompt
+
+    def test_greedy_parity_int8_kv(self, setup, int8_engine):
+        """int8 pages must still agree with the dense reference on the
+        tiny config's logit margins (the acceptance pin)."""
+        cfg, params = setup
+        for prompt, n in (([3, 1, 4, 1, 5, 9, 2, 6], 6),
+                          ([7], 4),
+                          (list(range(1, 25)), 5)):
+            got = int8_engine.generate(prompt, n, timeout=180)
+            assert got == _reference(cfg, params, prompt, n), prompt
+
+    def test_concurrent_requests_exact(self, setup, plain_engine):
+        cfg, params = setup
+        prompts = [([3, 1, 4, 1, 5], 5), ([2, 7], 8),
+                   ([9, 9, 8, 2, 1, 0, 3], 3)]
+        requests = [plain_engine.submit(p, n) for p, n in prompts]
+        results = [r.result(timeout=180) for r in requests]
+        for (p, n), got in zip(prompts, results):
+            assert got == _reference(cfg, params, p, n), (p, n)
+
+    def test_sampled_parity_vs_dense_engine(self, setup, plain_engine):
+        """Temperature sampling depends only on (logits, key chain);
+        paged at a given seed must match the dense single-sequence
+        path — sampled-path parity for the page gather.  (The dense
+        engine's row-parity vs decode.generate's sampling is pinned in
+        test_batching_engine; generate() is the shared reference.)"""
+        cfg, params = setup
+        sampling = decode.SamplingConfig(temperature=0.8, top_k=10,
+                                         seed=123)
+        prompt = [3, 1, 4, 1, 5, 9, 2]
+        a = plain_engine.generate(prompt, 6, sampling=sampling,
+                                  timeout=180)
+        b = plain_engine.generate(prompt, 6, sampling=sampling,
+                                  timeout=180)
+        assert a == b          # seed-deterministic through pages
+        assert len(a) == 6
+        greedy = plain_engine.generate(
+            prompt, 5, timeout=180,
+            sampling=decode.SamplingConfig(temperature=0.0))
+        assert greedy == _reference(cfg, params, prompt, 5)
+
+    def test_chunked_prefill_across_page_boundaries(self, setup):
+        """Chunk width (6) deliberately misaligned with page size (8):
+        chunk boundaries land mid-page and page boundaries mid-chunk —
+        the scatter/gather must stay exact either way."""
+        cfg, params = setup
+        eng = _paged_engine(cfg, params, prefill_chunk=6)
+        try:
+            for prompt in (list(range(1, 21)),   # 19 = 3 chunks + tail
+                           [7, 9]):
+                got = eng.generate(prompt, 5, timeout=180)
+                assert got == _reference(cfg, params, prompt, 5), prompt
+            assert eng.stats()['prefill_chunks'] >= 3
+        finally:
+            eng.stop()
+
+    def test_moe_paged_exact(self):
+        """MoE + pages: full-prompt prefill scatters into pages (no
+        prefix reuse — the capacity dispatch couples KV to the whole
+        prompt) and decode stays exact."""
+        cfg = configs.get_config('tiny-moe')
+        prompt = [3, 1, 4, 1, 5, 9, 2]
+        params = nn.meta.unbox(Transformer(cfg).init(
+            jax.random.PRNGKey(0),
+            jnp.asarray([prompt], jnp.int32))['params'])
+        eng = _paged_engine(cfg, params)
+        try:
+            got = eng.generate(prompt, 5, timeout=180)
+            assert got == _reference(cfg, params, prompt, 5)
+            assert eng.stats()['prefix_cache_entries'] == 0
+        finally:
+            eng.stop()
+
+
+class TestInt8KVBound:
+
+    def test_int8_logits_divergence_bounded(self, setup):
+        """int8 KV vs native KV: the step logits may drift but must
+        stay within a small relative error of the dense reference —
+        the quantization-noise contract behind the greedy-parity pin."""
+        cfg, params = setup
+        prompt = jnp.asarray([list(range(1, 17))], jnp.int32)
+        ref_logits, _ = decode.prefill(cfg, params, prompt, max_len=32)
+
+        ps, n_pages = 8, 8
+        paged = decode.init_paged_cache(cfg, n_pages, ps, 1, 4,
+                                        quantize_kv=True)
+        _, priv = decode.prefill(cfg, params, prompt, max_len=32)
+        pages = jnp.asarray([1, 2, 3, 4], jnp.int32)
+        paged = decode.insert_prefill_pages(paged, priv, pages,
+                                            first_page=0)
+        row = jnp.zeros((4,), jnp.int32).at[:4].set(pages)
+        paged = decode.paged_admit_slot(paged, 0, row, 15)
+        logits, _ = decode.paged_batched_step(
+            cfg, params, prompt[:, -1:], paged)
+        ref = np.asarray(ref_logits)[0]
+        got = np.asarray(logits)[0]
+        rel = np.max(np.abs(got - ref)) / max(np.max(np.abs(ref)), 1e-9)
+        assert rel < 0.05, rel
+        # ...and small enough that greedy agrees here.
+        assert int(np.argmax(got)) == int(np.argmax(ref))
+
+
+class TestPrefixReuse:
+    # Each test uses its own token range so shared-engine cache state
+    # cannot couple tests.
+
+    def test_identical_prompts_hit_and_stay_exact(self, setup,
+                                                  plain_engine):
+        cfg, params = setup
+        eng = plain_engine
+        shared = list(range(40, 80))            # 40 tokens -> 4 pages
+        a = eng.generate(shared, 5, timeout=180)
+        hits0 = eng.stats()['prefix_cache_hits']
+        handle = eng.submit(shared, 5)
+        b = handle.result(timeout=180)
+        assert a == b == _reference(cfg, params, shared, 5)
+        stats = eng.stats()
+        assert stats['prefix_cache_hits'] == hits0 + 4
+        assert stats['prefix_cache_entries'] >= 4
+        # The hit is visible on the request's span.
+        span = eng.span(handle.request_id)
+        assert span['prefix_hit_pages'] == 4
+        assert span['prefill_chunks'] <= 2       # seed + tail only
+
+    def test_mid_page_divergence_correct(self, setup, int8_engine):
+        """Two sessions share a prefix that ends MID-page: the shared
+        full pages reuse, the divergence page is private per session,
+        and both decode exactly (int8 pages — the quantized gather
+        must honor the same sharing rules)."""
+        cfg, params = setup
+        eng = int8_engine
+        base = list(range(100, 140))            # 40 tokens, ps=8
+        s1 = base[:37] + [5, 6, 7]              # diverge at pos 37
+        s2 = base[:37] + [8, 9, 1]              # (mid page 5)
+        a = eng.generate(s1, 5, timeout=180)
+        hits0 = eng.stats()['prefix_cache_hits']
+        b = eng.generate(s2, 5, timeout=180)
+        assert a == _reference(cfg, params, s1, 5)
+        assert b == _reference(cfg, params, s2, 5)
+        # s2 shared s1's 4 full pages, not the divergence page.
+        assert eng.stats()['prefix_cache_hits'] >= hits0 + 4
+
+    def test_full_hit_skips_prefill_entirely(self, setup,
+                                             plain_engine):
+        """A page-aligned fully-cached prefix admits with ZERO prefill
+        chunks — the TTFT-collapse mechanism."""
+        cfg, params = setup
+        eng = plain_engine
+        prompt = list(range(150, 183))          # n-1 = 32 = 4 pages
+        eng.generate(prompt, 4, timeout=180)
+        chunks0 = eng.stats()['prefill_chunks']
+        handle = eng.submit(prompt, 4)
+        got = handle.result(timeout=180)
+        assert got == _reference(cfg, params, prompt, 4)
+        assert eng.stats()['prefill_chunks'] == chunks0
+        assert eng.span(handle.request_id)['prefix_hit_pages'] == 4
+
+    def test_hit_tail_shorter_than_chunk(self, setup):
+        """Regression: a prefix hit seeds the private cache near the
+        end of the prompt, so the remaining tail can be far shorter
+        than prefill_chunk — with the default chunk (512) wider than
+        max_len (128) the continuation piece must be narrowed to fit
+        the cache instead of clamping over the seeded prefix."""
+        cfg, params = setup
+        eng = _paged_engine(cfg, params, max_len=128,
+                            prefill_chunk=512, slots=2)
+        try:
+            shared = list(range(30, 90))        # 60 tokens, ps=8
+            a = eng.generate(shared, 5, timeout=180)
+            b = eng.generate(shared, 5, timeout=180)  # hit: tail of 3
+            assert a == b == _reference(cfg, params, shared, 5,
+                                        max_len=128)
+        finally:
+            eng.stop()
+
+    def test_prefix_cache_disabled(self, setup):
+        cfg, params = setup
+        eng = _paged_engine(cfg, params, prefix_caching=False,
+                            slots=1)
+        try:
+            shared = list(range(40, 60))
+            a = eng.generate(shared, 4, timeout=180)
+            b = eng.generate(shared, 4, timeout=180)
+            assert a == b == _reference(cfg, params, shared, 4)
+            stats = eng.stats()
+            assert stats['prefix_cache_hits'] == 0
+            assert stats['prefix_cache_entries'] == 0
+        finally:
+            eng.stop()
+
+
+class TestPoolAccounting:
+
+    def test_pages_freed_on_completion_cancel_and_ttl(self, setup):
+        cfg, params = setup
+        eng = _paged_engine(cfg, params, slots=1, queue_ttl=0.05,
+                            prefix_caching=False)
+        try:
+            done = eng.submit(list(range(1, 20)), 20)
+            stale = eng.submit([4, 5], 4)        # expires queued (TTL)
+            with pytest.raises(batching_engine.QueueExpired):
+                stale.result(timeout=60)
+            # Cancel the long request mid-decode.
+            stream = done.stream(timeout=60)
+            next(stream)
+            done.cancel()
+            assert done.done.wait(30)
+            deadline = time.time() + 30
+            while (eng.stats()['kv_pages_used'] > 0 and
+                   time.time() < deadline):
+                time.sleep(0.01)
+            assert eng.stats()['kv_pages_used'] == 0
+            # The pool is fully reusable afterwards.
+            got = eng.generate([4, 5], 3, timeout=60)
+            assert got == _reference(cfg, params, [4, 5], 3)
+        finally:
+            eng.stop()
+        assert eng._kv.pool.used_count == 0  # pylint: disable=protected-access
+
+    def test_cancel_mid_prefill_frees_pages(self, setup):
+        cfg, params = setup
+        eng = _paged_engine(cfg, params, slots=1, prefill_chunk=4,
+                            prefix_caching=False)
+        try:
+            blocker = eng.submit(list(range(1, 25)), 6)
+            victim = eng.submit(list(range(1, 20)), 6)
+            victim.cancel()
+            assert blocker.result(timeout=180) == _reference(
+                cfg, params, list(range(1, 25)), 6)
+            assert victim.done.wait(60)
+            deadline = time.time() + 30
+            while (eng.stats()['kv_pages_used'] > 0 and
+                   time.time() < deadline):
+                time.sleep(0.01)
+            assert eng.stats()['kv_pages_used'] == 0
+        finally:
+            eng.stop()
+
+    def test_exhaustion_backpressures_with_429_class(self, setup):
+        """Pool too small for two concurrent requests: the second
+        stays queued (not crashed), and a third submit gets QueueFull
+        (the HTTP 429 mapping) with Retry-After while the pool is
+        exhausted.  Also covers submit-time rejection of requests that
+        could NEVER fit."""
+        cfg, params = setup
+        eng = _paged_engine(cfg, params, kv_pages=6, page_size=8,
+                            slots=2, prefix_caching=False)
+        try:
+            with pytest.raises(ValueError, match='pool capacity'):
+                eng.submit(list(range(1, 40)), 20)   # needs 8 of 5
+            # 4 pages: 25 prompt + 7 new -> ceil(31/8) = 4 of 5 usable.
+            blocker = eng.submit(list(range(1, 26)), 7)
+            deadline = time.time() + 30
+            while (eng.stats()['kv_pages_used'] < 4 and
+                   time.time() < deadline):
+                time.sleep(0.005)
+            queued = eng.submit(list(range(1, 20)), 8)   # needs 4
+            # The worker must DEFER the queued request (pool can't
+            # cover it while the blocker holds pages) — poll rather
+            # than sleep: first-time compiles can stall the loop.
+            deadline = time.time() + 60
+            while (eng.stats()['pages_exhausted_deferrals'] < 1 and
+                   not queued.done.is_set() and
+                   time.time() < deadline):
+                time.sleep(0.005)
+            if not queued.done.is_set():
+                assert eng.stats()['pages_exhausted_deferrals'] >= 1
+                with pytest.raises(batching_engine.QueueFull) as err:
+                    eng.submit(list(range(1, 20)), 8)
+                assert err.value.retry_after >= 1.0
+            assert eng.stats()['failed'] is False
+            # The blocker finishing frees pages; the queued request
+            # must then complete on its own.
+            assert blocker.result(timeout=120) == _reference(
+                cfg, params, list(range(1, 26)), 7)
+            assert queued.result(timeout=120) == _reference(
+                cfg, params, list(range(1, 20)), 8)
+        finally:
+            eng.stop()
+
+    def test_validation(self, setup):
+        cfg, params = setup
+        with pytest.raises(ValueError, match='multiple'):
+            batching_engine.ContinuousBatchingEngine(
+                cfg, params, max_len=60, kv_pages=16, page_size=8)
+        with pytest.raises(ValueError, match='pipelined'):
+            batching_engine.ContinuousBatchingEngine(
+                cfg, params, max_len=64, kv_pages=16, page_size=8,
+                pipelined=False)
+
+
+class TestStatsAndMetrics:
+
+    def test_paged_stats_and_gauges(self, setup, plain_engine):
+        from skypilot_tpu.observability import metrics as metrics_lib
+        stats = plain_engine.stats()
+        assert stats['paged'] is True
+        assert stats['kv_pages_total'] == 47
+        assert stats['page_size'] == 8
+        assert stats['prefix_cache_misses'] >= 0
+        text = metrics_lib.expose()
+        for name in ('skytpu_engine_kv_pages_total',
+                     'skytpu_engine_kv_pages_used',
+                     'skytpu_engine_kv_pages_pinned',
+                     'skytpu_engine_prefix_cache_hits_total',
+                     'skytpu_engine_prefix_cache_misses_total'):
+            assert name in text, name
+        parsed = metrics_lib.parse_exposition(text)
+        assert sum(parsed['skytpu_engine_kv_pages_total']
+                   .values()) == 47
+
+    def test_dense_engine_unaffected(self, setup):
+        """A dense engine reports paged=False and no page keys —
+        the facade split must not change the dense contract."""
+        cfg, params = setup
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=32, slots=1)
+        try:
+            stats = eng.stats()
+            assert stats['paged'] is False
+            assert 'kv_pages_total' not in stats
+        finally:
+            eng.stop()
+
+
+class TestFacadeCompat:
+
+    def test_legacy_names_still_importable(self):
+        """The batching_engine facade keeps the pre-split import
+        surface (ROADMAP satellite: existing imports keep working)."""
+        from skypilot_tpu.serve import sampler
+        from skypilot_tpu.serve import scheduler
+        assert batching_engine.QueueFull is scheduler.QueueFull
+        assert batching_engine.QueueExpired is scheduler.QueueExpired
+        assert batching_engine._Request is scheduler.Request  # pylint: disable=protected-access
+        assert batching_engine._Slot is scheduler.Slot  # pylint: disable=protected-access
+        assert batching_engine._PendingPrefill is scheduler.PendingPrefill  # pylint: disable=protected-access
+        assert batching_engine.PagesExhausted is (
+            cache_manager.PagesExhausted)
+        assert sampler.validate_sampling(None, max_top_k=4,
+                                         pipelined=True) == (0.0, 0, 0)
