@@ -24,6 +24,14 @@ reports the numbers a serving SLO is written in:
   once, then re-requested — the hit adopts the cached pages and
   prefills only the tail chunk, so TTFT collapses (reported as
   hit/cold ratio, with the hit's `prefix_hit_pages` from its span).
+- disaggregation A/B: the SAME bursty workload (steady chat SSE
+  streams + Poisson long-prompt bursts) through the real routing LB
+  over HTTP against two replica fleets — role-blind mixed vs
+  prefill+decode with KV page handoff.  The pinned number is the
+  chat ITL p99 ratio during bursts (disaggregated / mixed): keeping
+  long prefills off decode replicas is THE tail-latency lever under
+  mixed traffic, and the handed-off pages land the decode-side
+  admission as a prefix hit.
 - --smoke also scrapes `/metrics` (observability/metrics.py exposition
   served on a loopback port) before, during, and after the pipelined
   run, asserts the key engine series are present and monotone (ticks,
@@ -368,6 +376,306 @@ def _prefix_probe(cfg, params, *, max_len: int, page_size: int,
     }
 
 
+def _run_disagg_config(*, replica_urls, roles, page_size, threshold,
+                       long_prompt_len, chat_prompt_len, chat_max_new,
+                       n_chat, n_bursts, burst_interval_s, vocab,
+                       seed) -> Dict[str, Any]:
+    """One routing-LB fleet over two ALREADY-RUNNING replica processes
+    under the bursty mixed workload: N steady chat token streams
+    decode while long prompts burst in Poisson-spaced.  Roles are an
+    LB-side attribute, so the SAME replica processes serve both
+    configs — the caller contrasts roles=['mixed','mixed']
+    (role-blind) against ['prefill','decode'] (disaggregated + KV
+    handoff)."""
+    import numpy as np
+    import requests
+
+    from skypilot_tpu.observability import metrics as obs_metrics
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.serve import router as router_lib
+
+    def counter_total(name: str, **labels) -> float:
+        parsed = obs_metrics.parse_exposition(obs_metrics.expose())
+        total = 0.0
+        for labelset, value in (parsed.get(name) or {}).items():
+            d = dict(labelset)
+            if all(d.get(k) == v for k, v in labels.items()):
+                total += value
+        return total
+
+    handoff_ok_0 = counter_total('skytpu_lb_handoff_total',
+                                 outcome='ok')
+    handoff_fb_0 = counter_total('skytpu_lb_handoff_total',
+                                 outcome='fallback')
+    rng = np.random.default_rng(seed)
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:1',
+        router=router_lib.Router(threshold=threshold))
+    try:
+        lb.set_replicas([
+            {'url': url, 'role': role, 'page_size': page_size}
+            for url, role in zip(replica_urls, roles)])
+        lb_port = lb.start()
+        base = f'http://127.0.0.1:{lb_port}'
+
+        def long_prompt():
+            return [int(x) for x in rng.integers(
+                1, vocab - 1, size=long_prompt_len)]
+
+        # Warm the routed path for THIS fleet config (any cold compile
+        # belongs to warmup, not the measured window).
+        requests.post(f'{base}/generate',
+                      json={'prompt_ids': [long_prompt()],
+                            'max_new_tokens': 2}, timeout=300)
+
+        # Steady chat decodes: each client keeps an SSE stream open
+        # back-to-back (a finished conversation is immediately
+        # replaced), recording every token arrival per session — gaps
+        # are only ever measured WITHIN a session, never across the
+        # reconnect seam.
+        chat_sessions: List[List[float]] = []
+        sessions_lock = threading.Lock()
+        chat_stop = threading.Event()
+        tokens_seen = [0]
+
+        def chat_client(idx: int) -> None:
+            session_rng = np.random.default_rng((seed, idx))
+            while not chat_stop.is_set():
+                prompt = [int(x) for x in session_rng.integers(
+                    1, vocab - 1, size=chat_prompt_len)]
+                times: List[float] = []
+                with sessions_lock:
+                    chat_sessions.append(times)
+                try:
+                    with requests.post(
+                            f'{base}/generate_stream',
+                            json={'prompt_ids': prompt,
+                                  'max_new_tokens': chat_max_new},
+                            stream=True, timeout=300) as resp:
+                        for line in resp.iter_lines(chunk_size=16):
+                            if chat_stop.is_set():
+                                return
+                            if line.startswith(b'data:') and \
+                                    b'[DONE]' not in line:
+                                times.append(time.perf_counter())
+                                tokens_seen[0] += 1
+                except requests.RequestException:
+                    if not chat_stop.is_set():
+                        time.sleep(0.01)
+
+        chat_threads = [threading.Thread(target=chat_client, args=(i,))
+                        for i in range(n_chat)]
+        for t in chat_threads:
+            t.start()
+        deadline = time.time() + 60
+        while tokens_seen[0] < 3 * n_chat and time.time() < deadline:
+            time.sleep(0.01)
+
+        # Long-prompt bursts, Poisson-spaced, while the chats decode.
+        long_latencies: List[float] = []
+        lat_lock = threading.Lock()
+
+        def burst_client(prompt) -> None:
+            t0 = time.perf_counter()
+            try:
+                requests.post(f'{base}/generate',
+                              json={'prompt_ids': [prompt],
+                                    'max_new_tokens': 2}, timeout=300)
+            except requests.RequestException:
+                return
+            with lat_lock:
+                long_latencies.append(
+                    (time.perf_counter() - t0) * 1e3)
+
+        t_burst0 = time.perf_counter()
+        burst_threads = []
+        for _ in range(n_bursts):
+            thread = threading.Thread(target=burst_client,
+                                      args=(long_prompt(),))
+            thread.start()
+            burst_threads.append(thread)
+            time.sleep(float(rng.exponential(burst_interval_s)))
+        for thread in burst_threads:
+            thread.join()
+        t_burst1 = time.perf_counter()
+        time.sleep(0.1)
+        chat_stop.set()
+        for thread in chat_threads:
+            thread.join(timeout=30)
+    finally:
+        lb.stop()
+    # Chat ITL during the burst window: the number disaggregation
+    # exists to protect.
+    itls = []
+    for times in chat_sessions:
+        window = [x for x in times
+                  if t_burst0 - 0.05 <= x <= t_burst1 + 0.1]
+        itls.extend(b - a for a, b in zip(window, window[1:]))
+    return {
+        'roles': list(roles),
+        'chat_streams': n_chat,
+        'chat_tokens_in_burst_window': len(itls),
+        'chat_itl_p50_ms': round(_percentile(itls, 50) * 1e3, 2),
+        'chat_itl_p99_ms': round(_percentile(itls, 99) * 1e3, 2),
+        'chat_itl_max_ms': round(max(itls, default=0.0) * 1e3, 2),
+        'long_requests': len(long_latencies),
+        'long_latency_p50_ms': round(
+            _percentile(long_latencies, 50), 2),
+        'long_latency_p99_ms': round(
+            _percentile(long_latencies, 99), 2),
+        'handoffs_ok': counter_total(
+            'skytpu_lb_handoff_total', outcome='ok') - handoff_ok_0,
+        'handoff_fallbacks': counter_total(
+            'skytpu_lb_handoff_total',
+            outcome='fallback') - handoff_fb_0,
+    }
+
+
+def _spawn_replica(port: int, *, max_len: int, slots: int,
+                   kv_pages: int, page_size: int, prefill_chunk: int,
+                   cpus=None):
+    """One model-server replica as a REAL subprocess (its own GIL, GC,
+    and XLA thread pool — like a real fleet; in-process replicas bleed
+    each other's pauses into the ITL measurements).  `cpus` pins the
+    replica to a core set: two replicas on disjoint halves of the
+    machine are the closest local stand-in for two hosts — without it,
+    one replica's wide prefill steals the other's decode cores and the
+    A/B measures this box's scheduler, not the routing policy."""
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    preexec = None
+    if cpus and hasattr(os, 'sched_setaffinity'):
+        preexec = lambda: os.sched_setaffinity(0, cpus)  # noqa: E731
+    return subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.serve.model_server',
+         '--model', 'tiny', '--port', str(port),
+         '--max-len', str(max_len), '--max-batch', str(slots),
+         '--continuous-batching', '--kv-pages', str(kv_pages),
+         '--page-size', str(page_size),
+         '--prefill-chunk', str(prefill_chunk), '--quantize-kv'],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        preexec_fn=preexec)
+
+
+def _disagg_probe(*, smoke: bool, vocab: int, seed: int
+                  ) -> Dict[str, Any]:
+    """Prefill/decode disaggregation A/B: the SAME bursty workload
+    (steady chat SSE streams + Poisson long-prompt bursts) against a
+    role-blind mixed fleet vs a prefill+decode fleet with KV page
+    handoff — over real HTTP, with each replica its own process (int8
+    KV: the production paged config, and the compact int8+scales wire
+    format).  The claim under test: in-flight decode ITL p99 during
+    bursts collapses when long prefills are kept off decode replicas
+    (and the handed-off pages make the decode-side prefill a prefix
+    hit)."""
+    import socket
+    import time as time_lib
+
+    import requests
+
+    # long_prompt_len is chosen PAGE-ALIGNED (prompt-1 divisible by
+    # page_size): the handed-off pages then cover the whole prefilled
+    # region and the decode replica admits the request as a FULL
+    # prefix hit — zero prefill compute on the decode pool, the
+    # best-case the page-granular wire format was designed for.
+    # The prompt is long enough that each prefill chunk's compute
+    # (attention is quadratic in context) dwarfs a decode tick AND the
+    # decode-side page-adoption scatter; ~4 chunks per admission keeps
+    # the stall-event count well above the p99 index so the percentile
+    # reads the stalls, not scheduler noise.
+    engine = dict(max_len=1024, slots=3, kv_pages=768, page_size=8,
+                  prefill_chunk=224)
+    knobs: Dict[str, Any] = dict(
+        page_size=8, threshold=64, long_prompt_len=897,
+        chat_prompt_len=8, chat_max_new=280, n_chat=2, n_bursts=10,
+        burst_interval_s=0.15, vocab=vocab, seed=seed)
+    if not smoke:
+        engine = dict(max_len=2048, slots=3, kv_pages=1024,
+                      page_size=8, prefill_chunk=480)
+        knobs.update(long_prompt_len=1921, n_bursts=12,
+                     chat_max_new=600, burst_interval_s=0.25)
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(('', 0))
+            return s.getsockname()[1]
+
+    import os
+    ports = [free_port(), free_port()]
+    try:
+        cores = sorted(os.sched_getaffinity(0))
+    except AttributeError:
+        cores = []
+    halves = [None, None]
+    if len(cores) >= 2:
+        halves = [set(cores[:len(cores) // 2]),
+                  set(cores[len(cores) // 2:])]
+    procs = [_spawn_replica(p, cpus=half, **engine)
+             for p, half in zip(ports, halves)]
+    urls = [f'http://127.0.0.1:{p}' for p in ports]
+    try:
+        # Readiness + warmup per replica: the long-prompt chunks, the
+        # chat shape, and the handoff legs (export on replica 0,
+        # import on replica 1) all compile before anything is timed.
+        deadline = time_lib.time() + 300
+        for url in urls:
+            while True:
+                try:
+                    if requests.get(url + '/', timeout=2) \
+                            .status_code == 200:
+                        break
+                except requests.RequestException:
+                    pass
+                if time_lib.time() > deadline:
+                    raise RuntimeError(
+                        f'replica {url} never became ready')
+                time_lib.sleep(0.25)
+        warm_long = list(range(1, knobs['long_prompt_len'] + 1))
+        for url in urls:
+            requests.post(f'{url}/generate',
+                          json={'prompt_ids': [warm_long],
+                                'max_new_tokens': 2}, timeout=300)
+            requests.post(f'{url}/generate',
+                          json={'prompt_ids':
+                                [[1] * knobs['chat_prompt_len']],
+                                'max_new_tokens': 2}, timeout=300)
+        export = requests.post(
+            f'{urls[0]}/prefill_export',
+            json={'prompt_ids': warm_long,
+                  'page_size': knobs['page_size']}, timeout=300)
+        export.raise_for_status()
+        requests.post(f'{urls[1]}/kv_import', json=export.json(),
+                      timeout=300).raise_for_status()
+        mixed = _run_disagg_config(replica_urls=urls,
+                                   roles=('mixed', 'mixed'), **knobs)
+        disagg = _run_disagg_config(replica_urls=urls,
+                                    roles=('prefill', 'decode'),
+                                    **knobs)
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # pylint: disable=broad-except
+                proc.kill()
+    ratio = (disagg['chat_itl_p99_ms'] /
+             max(mixed['chat_itl_p99_ms'], 1e-9))
+    return {
+        'long_prompt_len': knobs['long_prompt_len'],
+        'prefill_chunk': engine['prefill_chunk'],
+        'page_size': knobs['page_size'],
+        'prefill_threshold': knobs['threshold'],
+        'replicas_per_fleet': 2,
+        'mixed': mixed,
+        'disaggregated': disagg,
+        'itl_p99_ratio_vs_mixed': round(ratio, 4),
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--model', default='tiny')
@@ -394,6 +702,10 @@ def main() -> None:
     parser.add_argument('--skip-paged-probes', action='store_true',
                         help='Skip the paged-KV capacity and '
                              'prefix-cache TTFT probes.')
+    parser.add_argument('--skip-disagg-probe', action='store_true',
+                        help='Skip the prefill/decode disaggregation '
+                             'A/B (two replicas + routing LB over '
+                             'real HTTP).')
     parser.add_argument('--page-size', type=int, default=16,
                         help='KV page size for the paged probes.')
     parser.add_argument('--prefix-len', type=int, default=256,
@@ -601,6 +913,10 @@ def main() -> None:
             cfg, params, max_len=probe_max_len, page_size=ps,
             chunk=max(ps, 8), prefix_len=args.prefix_len,
             vocab=vocab, quantize_kv=True)
+
+    if not args.skip_disagg_probe:
+        payload['disaggregation'] = _disagg_probe(
+            smoke=args.smoke, vocab=vocab, seed=args.seed)
 
     line = json.dumps(payload)
     print(line)

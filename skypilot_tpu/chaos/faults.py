@@ -76,6 +76,13 @@ SITES: Dict[str, str] = {
         'engine must degrade to admission backpressure / HTTP 429, '
         'never an engine failure); "delay" slows admissions (running '
         'decodes must keep their bounded ITL)',
+    'serve.kv_handoff':
+        'KV page handoff import (serve/batching_engine.py '
+        'import_pages, the decode side of prefill/decode '
+        'disaggregation) — effect "deny" makes the decode replica '
+        'refuse the pages (the router must fall back to local '
+        'prefill; the request completes either way); "delay" adds '
+        'handoff latency without stalling decode ticks',
     'skylet.tick':
         'skylet periodic event run (skylet/events.py) — a raise counts '
         'as an event failure and exercises the failure backoff',

@@ -26,6 +26,11 @@ The registry maps names (used by scenarios and the CLI) to checkers:
     page_pool_balance      every KV page allocated by the serving page
                            pool is eventually freed, and never freed
                            twice
+    handoff_consistency    every router-dispatched request completes
+                           exactly once (a failed KV handoff degrades
+                           to local prefill, never loses or re-runs a
+                           request), and every handoff start reaches
+                           an ok/fallback end
     no_injections          zero chaos_fault_injected events (clean runs)
 """
 from __future__ import annotations
@@ -237,6 +242,58 @@ def page_pool_balance(events: Sequence[Event]) -> List[str]:
     return violations
 
 
+def handoff_consistency(events: Sequence[Event]) -> List[str]:
+    """Safety for disaggregated serving: every request the router
+    dispatched (`lb_route`) completes EXACTLY once on a replica
+    (`serve_request_done`) — a handoff failure may cost latency
+    (fallback to local prefill) but never a lost or double-executed
+    request — and every `kv_handoff_start` reaches a
+    `kv_handoff_end` (ok or fallback; a vanished handoff means the
+    router hung between the export and the forward)."""
+    violations = []
+    routed = [e for e in _named(events, 'lb_route')
+              if e.get('request_id')]
+    done: Dict[str, int] = {}
+    for e in _named(events, 'serve_request_done'):
+        rid = e.get('request_id')
+        if rid:
+            done[rid] = done.get(rid, 0) + 1
+    for e in routed:
+        rid = e['request_id']
+        count = done.get(rid, 0)
+        if count == 0:
+            violations.append(
+                f'request {rid} was routed but never completed on any '
+                f'replica (lost across a handoff?)')
+        elif count > 1:
+            violations.append(
+                f'request {rid} completed {count} times '
+                f'(double-executed)')
+    open_handoffs: Dict[str, int] = {}
+    for e in events:
+        name = e.get('event')
+        if name == 'kv_handoff_start':
+            rid = e.get('request_id', '?')
+            open_handoffs[rid] = open_handoffs.get(rid, 0) + 1
+        elif name == 'kv_handoff_end':
+            rid = e.get('request_id', '?')
+            held = open_handoffs.get(rid, 0)
+            if held <= 0:
+                violations.append(
+                    f'kv_handoff_end for {rid} without a start')
+            else:
+                open_handoffs[rid] = held - 1
+            if e.get('status') not in ('ok', 'fallback'):
+                violations.append(
+                    f'kv_handoff_end for {rid} carries status '
+                    f'{e.get("status")!r} (want ok/fallback)')
+    dangling = [rid for rid, n in open_handoffs.items() if n > 0]
+    if dangling:
+        violations.append(
+            f'kv_handoff_start without kv_handoff_end for {dangling}')
+    return violations
+
+
 def no_injections(events: Sequence[Event]) -> List[str]:
     """With no plan armed, the chaos subsystem must be invisible."""
     injected = _named(events, 'chaos_fault_injected')
@@ -255,6 +312,7 @@ CHECKERS: Dict[str, Callable[[Sequence[Event]], List[str]]] = {
     'resize_monotone_steps': resize_monotone_steps,
     'checkpoint_liveness': checkpoint_liveness,
     'page_pool_balance': page_pool_balance,
+    'handoff_consistency': handoff_consistency,
     'no_injections': no_injections,
 }
 

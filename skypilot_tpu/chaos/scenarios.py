@@ -18,7 +18,13 @@ Scenarios (CLI: ``sky chaos list`` / ``sky chaos run <name>``):
 - ``queued_stall``         queued-resource capacity never granted →
                            wait times out with a terminal verdict
 - ``serve_replica_flap``   readiness probes fail transiently → replica
-                           flaps NOT_READY and returns to READY
+                           flaps NOT_READY and returns to READY; the
+                           router re-pins prefix affinity off a dead
+                           replica
+- ``handoff_fallback``     KV handoff import denied → the router falls
+                           back to local prefill on the decode
+                           replica; journal proves no request was lost
+                           or double-executed
 - ``page_pool_exhaustion`` KV page allocations denied → the batching
                            engine backpressures (429/Retry-After)
                            instead of erroring, recovers when the
@@ -824,6 +830,96 @@ def page_pool_exhaustion(seed: int) -> ScenarioResult:
 
 
 @_register(
+    'handoff_fallback',
+    'KV handoff import denied (deny effect on serve.kv_handoff) -> '
+    'the router falls back to LOCAL prefill on the decode replica; '
+    'the request completes with the same tokens, nothing is lost or '
+    'double-executed (handoff_consistency over the serve journal), '
+    'and the next handoff goes through clean')
+def handoff_fallback(seed: int) -> ScenarioResult:
+    import requests  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.serve import load_balancer as lb_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import model_server as model_server_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import router as router_lib  # pylint: disable=import-outside-toplevel
+
+    # Deny exactly the FIRST import at the decode replica: request 1
+    # must complete via local prefill (fallback), request 2's handoff
+    # must go through.
+    plan = faults_lib.FaultPlan(
+        seed=seed, name='handoff_fallback',
+        faults=[faults_lib.Fault(site='serve.kv_handoff',
+                                 effect='deny', nth=[1])])
+    t0 = time.time()
+    extra: List[str] = []
+    details: Dict[str, Any] = {}
+    serve_journal = events_lib.get_journal(
+        os.path.join(events_lib.journal_root(), 'serve.jsonl'))
+
+    def make_server():
+        return model_server_lib.ModelServer(
+            'tiny', max_len=64, max_batch=2, continuous_batching=True,
+            kv_pages=48, page_size=8, prefill_chunk=16)
+
+    prefill_server = make_server()
+    decode_server = make_server()
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:1', router=router_lib.Router(threshold=24))
+    shutdowns = []
+    try:
+        p_port, p_stop = model_server_lib.start_background(
+            prefill_server)
+        shutdowns.append(p_stop)
+        d_port, d_stop = model_server_lib.start_background(
+            decode_server)
+        shutdowns.append(d_stop)
+        lb.set_replicas([
+            {'url': f'http://127.0.0.1:{p_port}', 'role': 'prefill',
+             'page_size': 8},
+            {'url': f'http://127.0.0.1:{d_port}', 'role': 'decode',
+             'page_size': 8},
+        ])
+        prompt = list(range(1, 41))   # 40 tokens >= threshold 24
+        with _armed(plan):
+            lb_port = lb.start()
+            responses = []
+            for _ in range(2):
+                responses.append(requests.post(
+                    f'http://127.0.0.1:{lb_port}/generate',
+                    json={'prompt_ids': [prompt],
+                          'max_new_tokens': 4},
+                    timeout=120))
+        details['statuses'] = [r.status_code for r in responses]
+        details['tokens'] = [r.json().get('tokens') for r in responses]
+        _expect(all(r.status_code == 200 for r in responses),
+                f'both requests completed 200 '
+                f'(got {details["statuses"]})', extra)
+        _expect(details['tokens'][0] == details['tokens'][1],
+                'fallback (local prefill) and handoff produced '
+                'identical tokens', extra)
+        serve_events = _since(serve_journal, t0)
+        handoff_ends = [e.get('status') for e in serve_events
+                        if e.get('event') == 'kv_handoff_end']
+        details['handoff_ends'] = handoff_ends
+        _expect(handoff_ends == ['fallback', 'ok'],
+                f'first handoff fell back, second succeeded '
+                f'(got {handoff_ends})', extra)
+        injected = [e for e in _since(injector.chaos_journal(), t0)
+                    if e.get('event') == 'chaos_fault_injected']
+        _expect(len(injected) == 1,
+                f'exactly one deny fault fired (got {len(injected)})',
+                extra)
+    finally:
+        lb.stop()
+        for stop in shutdowns:
+            stop()
+        prefill_server.close()
+        decode_server.close()
+    return _finish('handoff_fallback', seed, t0, serve_events,
+                   ['handoff_consistency'], extra, details)
+
+
+@_register(
     'serve_replica_flap',
     'readiness probes fail transiently -> the replica flaps READY -> '
     'NOT_READY and returns to READY once probes pass again')
@@ -891,6 +987,34 @@ def serve_replica_flap(seed: int) -> ScenarioResult:
     _expect(transitions and transitions[-1] == 'READY',
             f'replica returned to READY (transitions: {transitions})',
             extra)
+    # Router-level consequence of a flap: prefix affinity pinned to the
+    # dead replica must re-route to a survivor (and re-pin there), not
+    # keep sending a session at a black hole.
+    from skypilot_tpu.serve import router as router_lib  # pylint: disable=import-outside-toplevel
+    rtr = router_lib.Router(threshold=10_000)
+    url_a, url_b = 'http://replica-a', 'http://replica-b'
+    rtr.set_endpoints([
+        router_lib.ReplicaEndpoint(url_a, role='decode'),
+        router_lib.ReplicaEndpoint(url_b, role='decode')])
+    key = router_lib.prompt_key(prompt_ids=[1, 2, 3, 4])
+    first = rtr.route(key, 4)
+    rtr.record_affinity(key, first.url)
+    pinned = rtr.route(key, 4)
+    _expect(pinned.affinity == 'hit' and pinned.url == first.url,
+            f'prefix affinity pinned to {first.url} '
+            f'(got {pinned.affinity}/{pinned.url})', extra)
+    survivor = url_b if first.url == url_a else url_a
+    rtr.set_endpoints([router_lib.ReplicaEndpoint(survivor,
+                                                  role='decode')])
+    rerouted = rtr.route(key, 4)
+    _expect(rerouted.url == survivor and rerouted.affinity == 'miss',
+            f'affinity re-routed off the dead replica to {survivor} '
+            f'(got {rerouted.affinity}/{rerouted.url})', extra)
+    rtr.record_affinity(key, rerouted.url)
+    repinned = rtr.route(key, 4)
+    _expect(repinned.affinity == 'hit' and repinned.url == survivor,
+            'affinity re-pinned to the survivor', extra)
+    details['affinity_rerouted'] = rerouted.url == survivor
     chaos_events = _since(injector.chaos_journal(), t0)
     injected = [e for e in chaos_events
                 if e.get('event') == 'chaos_fault_injected']

@@ -828,14 +828,15 @@ def _serve_metrics_table(records) -> None:
             if rep['status'] != 'READY' or not rep.get('url'):
                 continue
             url = rep['url']
+            role = rep.get('role') or 'mixed'
             try:
                 resp = requests.get(url + '/metrics', timeout=5)
                 resp.raise_for_status()
                 parsed = metrics_lib.parse_exposition(resp.text)
             except (requests.RequestException, ValueError) as e:
-                rows.append((r['name'], rep['replica_id'], url,
+                rows.append((r['name'], rep['replica_id'], url, role,
                              f'scrape failed: {e}', '-', '-', '-', '-',
-                             '-'))
+                             '-', '-'))
                 continue
 
             def total(name, parsed=parsed):
@@ -856,11 +857,23 @@ def _serve_metrics_table(records) -> None:
                          f'/{pages_total}{share}')
             else:
                 pages = '-'
+            # Router view from the replica side: LB-routed requests
+            # and the share whose prompt prefix hit a pinned replica
+            # (the skytpu_engine_routed_total{role,affinity} counter).
+            routed = parsed.get('skytpu_engine_routed_total') or {}
+            routed_total = sum(routed.values())
+            if routed_total:
+                hits = sum(v for labels, v in routed.items()
+                           if dict(labels).get('affinity') == 'hit')
+                affinity = f'{hits / routed_total:.0%}hit'
+            else:
+                affinity = '-'
             rows.append((
-                r['name'], rep['replica_id'], url,
+                r['name'], rep['replica_id'], url, role,
                 f'{total("skytpu_engine_decode_tokens_per_s"):g}',
                 f'{busy}/{slots}',
                 pages,
+                affinity,
                 int(total('skytpu_engine_queue_depth')),
                 f'{fmt_ms(_hist_quantile(parsed, "skytpu_engine_ttft_seconds", 0.5))}'
                 f'/{fmt_ms(_hist_quantile(parsed, "skytpu_engine_ttft_seconds", 0.99))}',
@@ -871,9 +884,9 @@ def _serve_metrics_table(records) -> None:
         click.echo('No READY replicas to scrape.')
         return
     click.echo('')
-    _print_table(['SERVICE', 'REPLICA', 'URL', 'TOK/S', 'SLOTS',
-                  'KV PAGES', 'QUEUE', 'TTFT p50/p99',
-                  'ITL p50/p99'], rows)
+    _print_table(['SERVICE', 'REPLICA', 'URL', 'ROLE', 'TOK/S',
+                  'SLOTS', 'KV PAGES', 'AFFINITY', 'QUEUE',
+                  'TTFT p50/p99', 'ITL p50/p99'], rows)
 
 
 @serve_group.command(name='down')

@@ -737,6 +737,70 @@ def paged_seed_private(cfg: ModelConfig, paged, pages_row, *,
             'index': jnp.asarray(r * ps, jnp.int32)}
 
 
+def write_pages(paged, k_pages, v_pages, pages_row):
+    """Adopt IMPORTED page contents into pool pages (KV handoff).
+
+    k_pages/v_pages are float `[L, n, h_kv, ps, d]` (the wire format
+    dequantizes int8 payloads to f32 before this); they land in pool
+    pages `pages_row`, quantized on the way in when the pool is int8 —
+    `_quant_kv` is round-trip stable, so a quantize -> dequantize ->
+    requantize chain across replicas reproduces the same bytes as a
+    local prefill would have written.  Jit with paged donated.
+    """
+    ids = jnp.asarray(pages_row, jnp.int32)
+
+    def leaf(pool_leaf, piece):
+        if isinstance(pool_leaf, dict):
+            q, scale = _quant_kv(piece)
+            return {'q': pool_leaf['q'].at[:, ids].set(q),
+                    'scale': pool_leaf['scale'].at[:, ids].set(scale)}
+        return pool_leaf.at[:, ids].set(piece.astype(pool_leaf.dtype))
+
+    return dict(paged, k=leaf(paged['k'], k_pages),
+                v=leaf(paged['v'], v_pages))
+
+
+def write_pages_quantized(paged, k_q, v_q, k_scale, v_scale,
+                          pages_row):
+    """Adopt ALREADY-QUANTIZED page contents into an int8 pool (the
+    int8->int8 handoff fast path): the wire's q/scale bytes land
+    verbatim — no dequantize/requantize round trip on the decode
+    replica's critical path, and byte-identity with the exporter is
+    trivial.  Jit with paged donated."""
+    ids = jnp.asarray(pages_row, jnp.int32)
+
+    def leaf(pool_leaf, q, scale):
+        return {'q': pool_leaf['q'].at[:, ids].set(q),
+                'scale': pool_leaf['scale'].at[:, ids].set(scale)}
+
+    return dict(paged, k=leaf(paged['k'], k_q, k_scale),
+                v=leaf(paged['v'], v_q, v_scale))
+
+
+def export_private_pages(private_cache, n_pages: int, page_size: int,
+                         quantize: bool = False):
+    """Slice a private prefill cache's first `n_pages` FULL pages into
+    page-major layout for the handoff wire.
+
+    Returns `(k, v)` as `[L, n_pages, h_kv, ps, d]` float32 arrays, or
+    `(k, v, k_scale, v_scale)` with int8 values + f32 scales when
+    `quantize` (the same `_quant_kv` the int8 pool uses, so receiver-
+    side requantization is byte-identical)."""
+    span = n_pages * page_size
+
+    def leaf(private_leaf):
+        return _private_as_pages(private_leaf[:, :, :, :span, :],
+                                 page_size)
+
+    k = leaf(private_cache['k'])
+    v = leaf(private_cache['v'])
+    if quantize:
+        kq, ks = _quant_kv(k)
+        vq, vs = _quant_kv(v)
+        return kq, vq, ks, vs
+    return k.astype(jnp.float32), v.astype(jnp.float32)
+
+
 def admit_slot_state(state, slot, token, max_new_tokens, stop_row, key,
                      temperature, top_k):
     """Write one slot's admission into the engine state (jit this with

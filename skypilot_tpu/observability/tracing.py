@@ -61,6 +61,12 @@ class RequestSpan:
         # Prompt pages adopted from the engine's prefix cache instead
         # of prefilled (paged-KV engines; 0 = cold / dense engine).
         self.prefix_hit_pages = 0
+        # Router facts (disaggregated serving): which role pool the LB
+        # picked, whether prefix affinity hit, and how long the KV
+        # page handoff took.  None when the request bypassed the LB.
+        self.routed_role: Optional[str] = None
+        self.affinity_hit: Optional[bool] = None
+        self.handoff_ms: Optional[float] = None
         self.ttft_s: Optional[float] = None
         self._last_token: Optional[float] = None
         self.itl_count = 0
@@ -111,7 +117,7 @@ class RequestSpan:
 
         itl_mean = (self.itl_sum_s / self.itl_count
                     if self.itl_count else None)
-        return {
+        out = {
             'request_id': self.request_id,
             'submit_time': self.submit_wall,
             'status': self.status,
@@ -125,6 +131,15 @@ class RequestSpan:
             'tokens': self.tokens,
             'total_ms': ms(self.total_s),
         }
+        # Router fields appear only for LB-routed requests: span dicts
+        # predating disaggregation keep their exact shape.
+        if self.routed_role is not None:
+            out['routed_role'] = self.routed_role
+        if self.affinity_hit is not None:
+            out['affinity_hit'] = self.affinity_hit
+        if self.handoff_ms is not None:
+            out['handoff_ms'] = round(self.handoff_ms, 3)
+        return out
 
     def _emit_timeline(self) -> None:
         if not timeline.enabled():

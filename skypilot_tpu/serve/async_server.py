@@ -31,11 +31,32 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import batching_engine as batching_engine_lib
+from skypilot_tpu.serve import handoff as handoff_lib
 from skypilot_tpu.serve import model_server as model_server_lib
+from skypilot_tpu.serve import router as router_lib
 
 logger = sky_logging.init_logger(__name__)
 
 _REQUEST_ID_KEY = tracing.REQUEST_ID_HEADER.lower()
+
+
+def _route_meta(headers: Dict[str, str]) -> Optional[Dict[str, Any]]:
+    """Routing facts the LB forwarded (lower-cased header map); None
+    for direct hits.  Mirrors the threaded front's counting."""
+    role = headers.get(router_lib.ROUTED_ROLE_HEADER.lower())
+    affinity = headers.get(router_lib.AFFINITY_HEADER.lower())
+    handoff_ms = headers.get(router_lib.HANDOFF_MS_HEADER.lower())
+    if not (role or affinity or handoff_ms):
+        return None
+    model_server_lib._M_ROUTED.labels(  # pylint: disable=protected-access
+        role=role or 'unknown', affinity=affinity or 'none').inc()
+    try:
+        ms = float(handoff_ms) if handoff_ms else None
+    except ValueError:
+        ms = None
+    return {'routed_role': role,
+            'affinity_hit': affinity == 'hit' if affinity else None,
+            'handoff_ms': ms}
 
 _MAX_BODY = 64 * 1024 * 1024
 _IDLE_TIMEOUT = 300.0
@@ -145,6 +166,7 @@ class AsyncModelServer:
         payload: Dict[str, Any] = {
             'status': 'ok',
             'model': f'{server.cfg.d_model}x{server.cfg.n_layers}',
+            'role': server.role,
         }
         engine = server._engine  # pylint: disable=protected-access
         code = 200
@@ -164,21 +186,73 @@ class AsyncModelServer:
                 int(req.get('top_k', server.default_top_k)),
                 int(req.get('seed', server.default_seed)))
 
-    async def _generate(self, req: Dict[str, Any],
-                        rid: str) -> Dict[str, Any]:
+    async def _generate(self, req: Dict[str, Any], rid: str,
+                        route_meta: Optional[Dict[str, Any]] = None
+                        ) -> Dict[str, Any]:
         t0 = time.perf_counter()
         temperature, top_k, seed = self._sampling(req)
         tokens = await asyncio.get_running_loop().run_in_executor(
             None, lambda: self.server.generate(
                 req['prompt_ids'],
                 int(req.get('max_new_tokens', 16)),
-                temperature, top_k, seed=seed, request_id=rid))
+                temperature, top_k, seed=seed, request_id=rid,
+                route_meta=route_meta))
+        model_server_lib._maybe_journal_request(  # pylint: disable=protected-access
+            'serve_request_done', request_id=rid, status='ok',
+            tokens=sum(len(t) for t in tokens))
         return {'tokens': tokens,
                 'latency_ms': round((time.perf_counter() - t0) * 1e3, 1)}
 
+    async def _prefill_export(self, req: Dict[str, Any]
+                              ) -> Dict[str, Any]:
+        """KV handoff, prefill side (compute runs in the executor so
+        token streams on this loop keep flowing)."""
+        engine = self.server._engine  # pylint: disable=protected-access
+        if engine is None:
+            raise _HttpError(400, 'KV handoff requires '
+                                  '--continuous-batching')
+        prompt = req['prompt_ids']
+        if (isinstance(prompt, list) and prompt and
+                isinstance(prompt[0], list)):
+            if len(prompt) != 1:
+                raise _HttpError(400,
+                                 'export serves one prompt per request')
+            prompt = prompt[0]
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, lambda: engine.export_prefill(
+                    [int(t) for t in prompt],
+                    page_size=req.get('page_size')))
+        except handoff_lib.HandoffError as e:
+            raise _HttpError(400, str(e)) from e
+
+    async def _kv_import(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        """KV handoff, decode side (waits on the engine worker in the
+        executor — the loop never blocks on the import)."""
+        engine = self.server._engine  # pylint: disable=protected-access
+        if engine is None:
+            raise _HttpError(400, 'KV handoff requires '
+                                  '--continuous-batching')
+        try:
+            decoded = handoff_lib.decode_payload(req)
+            imported, cached = (
+                await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: engine.import_pages(
+                        decoded['hashes'], decoded['page_size'],
+                        decoded['k'], decoded['v'],
+                        k_scale=decoded.get('k_scale'),
+                        v_scale=decoded.get('v_scale'))))
+        except handoff_lib.HandoffRejected as e:
+            raise _HttpError(503, str(e)) from e
+        except handoff_lib.HandoffError as e:
+            raise _HttpError(400, str(e)) from e
+        return {'imported_pages': imported, 'cached_pages': cached}
+
     async def _generate_text(self, req: Dict[str, Any],
                              writer: asyncio.StreamWriter,
-                             rid: str) -> None:
+                             rid: str,
+                             route_meta: Optional[Dict[str, Any]] = None
+                             ) -> None:
         server = self.server
         tok = server.tokenizer
         if server.cfg.vocab_size < tok.vocab_size:
@@ -193,7 +267,8 @@ class AsyncModelServer:
         if not ids:
             raise _HttpError(400, 'prompt tokenized to nothing')
         if req.get('stream'):
-            await self._stream(writer, ids, req, rid, text_mode=True)
+            await self._stream(writer, ids, req, rid, text_mode=True,
+                               route_meta=route_meta)
             return
         t0 = time.perf_counter()
         temperature, top_k, seed = self._sampling(req)
@@ -202,7 +277,7 @@ class AsyncModelServer:
                 [ids], int(req.get('max_new_tokens', 64)),
                 temperature, top_k,
                 stop_token=tok.eos_ids or None, seed=seed,
-                request_id=rid)))[0]
+                request_id=rid, route_meta=route_meta)))[0]
         stops = [i for i, t in enumerate(tokens) if t in tok.eos_ids]
         if stops:
             tokens = tokens[:stops[0]]
@@ -214,7 +289,9 @@ class AsyncModelServer:
         await writer.drain()
 
     async def _stream(self, writer: asyncio.StreamWriter, ids, req,
-                      rid: str, *, text_mode: bool) -> None:
+                      rid: str, *, text_mode: bool,
+                      route_meta: Optional[Dict[str, Any]] = None
+                      ) -> None:
         """SSE over chunked transfer; token events or UTF-8-safe text
         deltas.  Purely event-driven: no thread parks waiting."""
         server = self.server
@@ -237,7 +314,7 @@ class AsyncModelServer:
                 stop_token=stop_ids,
                 sampling=decode.SamplingConfig(
                     temperature=temperature, top_k=top_k, seed=seed),
-                request_id=rid)
+                request_id=rid, route_meta=route_meta)
         except ValueError:
             raise
         except Exception as e:  # pylint: disable=broad-except
@@ -357,9 +434,10 @@ class AsyncModelServer:
                     # client didn't send it); echoed on every reply.
                     rid = (headers.get(_REQUEST_ID_KEY) or
                            tracing.new_request_id())
+                    meta = _route_meta(headers)
                     if path == '/generate':
                         writer.write(_json_response(
-                            200, await self._generate(req, rid),
+                            200, await self._generate(req, rid, meta),
                             {tracing.REQUEST_ID_HEADER: rid}))
                         await writer.drain()
                     elif path == '/generate_stream':
@@ -373,9 +451,19 @@ class AsyncModelServer:
                                     'per request')
                             prompt = prompt[0]
                         await self._stream(writer, prompt, req, rid,
-                                           text_mode=False)
+                                           text_mode=False,
+                                           route_meta=meta)
                     elif path == '/generate_text':
-                        await self._generate_text(req, writer, rid)
+                        await self._generate_text(req, writer, rid,
+                                                  meta)
+                    elif path == '/prefill_export':
+                        writer.write(_json_response(
+                            200, await self._prefill_export(req)))
+                        await writer.drain()
+                    elif path == '/kv_import':
+                        writer.write(_json_response(
+                            200, await self._kv_import(req)))
+                        await writer.drain()
                     else:
                         raise _HttpError(404, 'unknown path')
                 except _HttpError as e:

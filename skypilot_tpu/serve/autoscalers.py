@@ -30,7 +30,11 @@ class AutoscalerDecision:
 
 
 class RequestRateAutoscaler:
-    """Scale to ceil(qps / target_qps_per_replica) with hysteresis."""
+    """Scale to ceil(qps / target_qps_per_replica) with hysteresis.
+
+    `spec` is anything carrying the pool-shaped attributes (a
+    SkyServiceSpec, or one service_spec.RolePool when each
+    disaggregated role pool scales independently)."""
 
     def __init__(self, spec: 'SkyServiceSpec') -> None:
         self.min_replicas = spec.min_replicas
@@ -146,7 +150,13 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
         return decision
 
 
-def make_autoscaler(spec: 'SkyServiceSpec') -> RequestRateAutoscaler:
-    if spec.base_ondemand_fallback_replicas > 0:
-        return FallbackRequestRateAutoscaler(spec)
-    return RequestRateAutoscaler(spec)
+def make_autoscaler(spec: 'SkyServiceSpec',
+                    role: Optional[str] = None) -> RequestRateAutoscaler:
+    """Build the autoscaler for a service — or for ONE of its role
+    pools (`role=...`), each of which holds its own targets/bounds so
+    a prefill burst scales the prefill pool without churning decode
+    replicas."""
+    pool = spec if role is None else spec.role_specs[role]
+    if getattr(pool, 'base_ondemand_fallback_replicas', 0) > 0:
+        return FallbackRequestRateAutoscaler(pool)
+    return RequestRateAutoscaler(pool)

@@ -100,6 +100,7 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import cache_manager
+from skypilot_tpu.serve import handoff as handoff_lib
 from skypilot_tpu.serve import sampler as sampler_lib
 from skypilot_tpu.serve import scheduler
 
@@ -109,6 +110,8 @@ logger = sky_logging.init_logger(__name__)
 QueueFull = scheduler.QueueFull
 QueueExpired = scheduler.QueueExpired
 PagesExhausted = cache_manager.PagesExhausted
+HandoffError = handoff_lib.HandoffError
+HandoffRejected = handoff_lib.HandoffRejected
 _Request = scheduler.Request
 _Slot = scheduler.Slot
 _PendingPrefill = scheduler.PendingPrefill
@@ -138,6 +141,13 @@ _M_SLOTS = metrics_lib.gauge(
 _M_DECODE_RATE = metrics_lib.gauge(
     'skytpu_engine_decode_tokens_per_s',
     'Decode tokens/s over the trailing 10s window.')
+_M_HANDOFF_EXPORTS = metrics_lib.counter(
+    'skytpu_engine_handoff_exports_total',
+    'KV page exports served (the prefill side of a handoff).')
+_M_HANDOFF_IMPORTS = metrics_lib.counter(
+    'skytpu_engine_handoff_imports_total',
+    'KV page imports (the decode side of a handoff), by result.',
+    ('result',))
 
 
 def _maybe_page_journal():
@@ -190,6 +200,16 @@ class ContinuousBatchingEngine:
         self._stop = threading.Event()
         self._sampler = sampler_lib.SlotSampler(self.max_top_k,
                                                 self.max_stop_ids)
+        self.quantize_kv = bool(quantize_kv)
+        # Host ops the worker runs between ticks (KV handoff imports
+        # mutate self._cache, which only the worker may touch); each
+        # entry is a no-raise closure that reports through its own
+        # result holder.
+        self._host_ops: Deque[Any] = collections.deque()
+        self._host_ops_lock = threading.Lock()
+        # Exports materialize a private prefill cache each; bound the
+        # concurrent ones so a handoff stampede can't blow memory.
+        self._export_sem = threading.BoundedSemaphore(2)
 
         self._kv: Optional[cache_manager.PagedKVManager] = None
         if kv_pages is not None:
@@ -251,6 +271,14 @@ class ContinuousBatchingEngine:
             self._seed_private = jax.jit(
                 functools.partial(decode.paged_seed_private, cfg),
                 static_argnames=('priv_len',))
+            # KV handoff adoption: imported page contents -> pool pages
+            # (quantizing when the pool is int8); pool donated.  The
+            # quantized variant lands int8 wire bytes verbatim — the
+            # import path's hot case never dequantizes.
+            self._write_pages = jax.jit(decode.write_pages,
+                                        donate_argnums=(0,))
+            self._write_pages_q = jax.jit(decode.write_pages_quantized,
+                                          donate_argnums=(0,))
         else:
             self._step = jax.jit(
                 functools.partial(decode.engine_step, cfg,
@@ -302,7 +330,9 @@ class ContinuousBatchingEngine:
 
     def submit(self, prompt_ids: List[int], max_new_tokens: int,
                stop_token=None, sampling=None,
-               request_id: Optional[str] = None) -> scheduler.Request:
+               request_id: Optional[str] = None,
+               route_meta: Optional[Dict[str, Any]] = None
+               ) -> scheduler.Request:
         """stop_token: None, one id, or an iterable of ids — the
         request finishes at the FIRST generated member of the set
         (multi-EOS: model-level EOS + chat turn-end markers).
@@ -330,7 +360,8 @@ class ContinuousBatchingEngine:
         request = scheduler.Request(prompt_ids, max_new_tokens,
                                     stop_token, temperature=temperature,
                                     top_k=top_k, seed=seed,
-                                    request_id=request_id)
+                                    request_id=request_id,
+                                    route_meta=route_meta)
         request._span_store = self._spans  # pylint: disable=protected-access
         sampler_lib.validate_stop_ids(request.stop_ids,
                                       self.max_stop_ids)
@@ -370,6 +401,221 @@ class ContinuousBatchingEngine:
                  timeout: float = 600.0) -> List[int]:
         return self.submit(prompt_ids, max_new_tokens, stop_token,
                            sampling=sampling).result(timeout)
+
+    # ------------------------------------------------------- KV handoff
+
+    def export_prefill(self, prompt_ids: List[int],
+                       page_size: Optional[int] = None
+                       ) -> Dict[str, Any]:
+        """Prefill a prompt and export its FULL KV pages for another
+        replica to adopt (the prefill side of a disaggregated handoff).
+
+        Runs the same chunked-prefill path an admission would, but into
+        a private cache that never touches this engine's slot pool or
+        page pool — a prefill replica can export for many decode
+        replicas without competing with its own admissions.  Returns
+        the serve/handoff.py wire payload: the prompt's full pages in
+        page-major layout (int8 + scales when this engine quantizes
+        KV), plus the chain hashes the importer registers them under.
+        The sub-page tail of the prompt is the importer's to prefill
+        (it is < one page and rides the normal partial-prefix path).
+        """
+        import numpy as np  # pylint: disable=import-outside-toplevel
+        jnp = self._jnp
+
+        from skypilot_tpu.models import decode  # pylint: disable=import-outside-toplevel
+        if self.cfg.n_experts > 0:
+            raise HandoffError(
+                'MoE prefill couples every prompt token through the '
+                'capacity dispatch; its KV cannot transfer page-wise')
+        if self._stop.is_set() or self._failed is not None:
+            raise RuntimeError('batching engine is stopped'
+                               if self._failed is None else
+                               f'batching engine failed: {self._failed}')
+        ps = int(page_size) if page_size else (
+            self._kv.page_size if self._kv is not None else 16)
+        n = len(prompt_ids)
+        if n < 2:
+            raise HandoffError('prompt too short to export')
+        if n > self.max_len:
+            raise HandoffError(
+                f'prompt {n} exceeds this replica\'s max_len '
+                f'{self.max_len}')
+        full = (n - 1) // ps     # full pages inside the prefilled [0, n-1)
+        if full < 1:
+            raise HandoffError(
+                f'prompt {n} holds no full {ps}-token page to export')
+        hashes = cache_manager.chunk_hashes(prompt_ids[:n - 1], ps)
+        n_target = n - 1
+        chunk = self.prefill_chunk
+        with self._export_sem:
+            # Chunk 0: bucketed flash prefill (same compile cache the
+            # admission path uses), then masked continuations.
+            take = min(n_target, chunk)
+            bucket = min(self._bucket(take), self.max_len)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :take] = prompt_ids[:take]
+            _, cache = self._prefill(self.params, jnp.asarray(padded))
+            cache = dict(cache, index=jnp.asarray(take, jnp.int32))
+            consumed = take
+            while consumed < n_target:
+                take = min(n_target - consumed, chunk)
+                width = min(self._bucket(take), chunk,
+                            self.max_len - consumed)
+                piece = np.zeros((1, width), np.int32)
+                piece[0, :take] = prompt_ids[consumed:consumed + take]
+                _, cache = self._prefill_chunk(self.params,
+                                               jnp.asarray(piece), cache)
+                cache = dict(cache,
+                             index=jnp.asarray(consumed + take,
+                                               jnp.int32))
+                consumed += take
+            if self.quantize_kv:
+                kq, vq, ks, vs = decode.export_private_pages(
+                    cache, full, ps, quantize=True)
+                payload = handoff_lib.encode_payload(
+                    hashes[:full], ps, np.asarray(kq), np.asarray(vq),
+                    np.asarray(ks), np.asarray(vs))
+            else:
+                k, v = decode.export_private_pages(cache, full, ps)
+                payload = handoff_lib.encode_payload(
+                    hashes[:full], ps, np.asarray(k), np.asarray(v))
+        _M_HANDOFF_EXPORTS.inc()
+        return payload
+
+    def import_pages(self, hashes: List[int], page_size: int,
+                     k_pages, v_pages, k_scale=None,
+                     v_scale=None) -> Tuple[int, int]:
+        """Adopt exported KV pages into this engine's pool + prefix
+        cache (the decode side of a handoff).  Returns
+        (pages_imported, pages_already_cached).
+
+        The pages are published exactly like locally prefilled ones:
+        registered in the prefix cache under their chain hashes, so
+        the follow-up submit() adopts them as a prefix hit (and so do
+        later requests sharing the prompt).  Pool exhaustion raises
+        QueueFull (reason pages_exhausted -> HTTP 429 + Retry-After);
+        any structural mismatch raises HandoffError — the router falls
+        back to local prefill, the request is never lost.
+        """
+        import numpy as np  # pylint: disable=import-outside-toplevel
+        from skypilot_tpu.chaos import injector  # pylint: disable=import-outside-toplevel
+        if self._kv is None:
+            raise HandoffError('KV import needs a paged engine '
+                               '(--kv-pages)')
+        if not self._kv.prefix_caching:
+            raise HandoffError('KV import needs the prefix cache '
+                               '(imports publish pages through it)')
+        if self.cfg.n_experts > 0:
+            raise HandoffError('MoE engines do not reuse prefix pages')
+        if int(page_size) != self._kv.page_size:
+            raise HandoffError(
+                f'page_size mismatch: payload {page_size}, '
+                f'pool {self._kv.page_size}')
+        if len(hashes) > self._kv.pool.capacity:
+            raise HandoffError(
+                f'{len(hashes)} pages exceed pool capacity '
+                f'{self._kv.pool.capacity}')
+        if (getattr(k_pages, 'dtype', None) is not None and
+                str(k_pages.dtype) == 'int8' and k_scale is None):
+            raise HandoffError('int8 pages need their scales')
+        # Chaos: deny -> the decode replica refuses the handoff (the
+        # router must fall back to local prefill); delay -> handoff
+        # latency (runs on the HTTP thread, never stalls the ticks).
+        if injector.inject('serve.kv_handoff',
+                           pages=len(hashes)) is injector.DENY:
+            _M_HANDOFF_IMPORTS.labels(result='denied').inc()
+            raise HandoffRejected(
+                'chaos: KV handoff import denied')
+        if self._stop.is_set() or self._failed is not None:
+            raise RuntimeError('batching engine is stopped'
+                               if self._failed is None else
+                               f'batching engine failed: {self._failed}')
+        holder: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def op() -> None:
+            # Runs ON THE WORKER THREAD: self._cache and the prefix
+            # cache are worker-owned; every outcome lands in `holder`.
+            try:
+                if self._stop.is_set():
+                    raise RuntimeError('batching engine stopped')
+                cached = self._kv.import_prefix_depth(hashes)
+                fresh_hashes = hashes[cached:]
+                if not fresh_hashes:
+                    holder['result'] = (0, cached)
+                    return
+                fresh = self._kv.alloc_pages(len(fresh_hashes))
+                try:
+                    jnp = self._jnp
+                    ids = np.asarray(fresh, np.int32)
+                    if k_scale is not None and self.quantize_kv:
+                        # int8 wire -> int8 pool: scatter q/scale
+                        # verbatim (no dequant/requant on the decode
+                        # replica's critical path).
+                        self._cache = self._write_pages_q(
+                            self._cache,
+                            jnp.asarray(k_pages[:, cached:]),
+                            jnp.asarray(v_pages[:, cached:]),
+                            jnp.asarray(k_scale[:, cached:]),
+                            jnp.asarray(v_scale[:, cached:]), ids)
+                    elif k_scale is not None:
+                        # int8 wire -> float pool: dequantize once.
+                        self._cache = self._write_pages(
+                            self._cache,
+                            jnp.asarray(
+                                k_pages[:, cached:].astype(np.float32)
+                                * k_scale[:, cached:, ..., None]),
+                            jnp.asarray(
+                                v_pages[:, cached:].astype(np.float32)
+                                * v_scale[:, cached:, ..., None]),
+                            ids)
+                    else:
+                        self._cache = self._write_pages(
+                            self._cache,
+                            jnp.asarray(k_pages[:, cached:]),
+                            jnp.asarray(v_pages[:, cached:]), ids)
+                    self._kv.prefix.register(fresh_hashes, fresh)
+                finally:
+                    # register() pinned the published pages; dropping
+                    # the import's alloc ref leaves them pin-held (and
+                    # frees them outright if anything above raised).
+                    self._kv.pool.decref(fresh)
+                holder['result'] = (len(fresh_hashes), cached)
+            except BaseException as e:  # pylint: disable=broad-except
+                holder['error'] = e
+            finally:
+                done.set()
+
+        with self._host_ops_lock:
+            self._host_ops.append(op)
+        with self._cond:
+            self._cond.notify_all()
+        if not done.wait(timeout=60):
+            _M_HANDOFF_IMPORTS.labels(result='timeout').inc()
+            raise HandoffError('KV import timed out waiting for the '
+                               'engine worker')
+        if 'error' in holder:
+            error = holder['error']
+            if isinstance(error, cache_manager.PagesExhausted):
+                _M_HANDOFF_IMPORTS.labels(
+                    result='pages_exhausted').inc()
+                raise self._queue.reject(
+                    'pages_exhausted',
+                    f'KV page pool exhausted for handoff import '
+                    f'({len(hashes)} page(s) needed); retry later')
+            _M_HANDOFF_IMPORTS.labels(result='error').inc()
+            raise error
+        _M_HANDOFF_IMPORTS.labels(result='ok').inc()
+        return holder['result']
+
+    def _drain_host_ops(self) -> None:
+        while True:
+            with self._host_ops_lock:
+                if not self._host_ops:
+                    return
+                op = self._host_ops.popleft()
+            op()   # no-raise by construction
 
     def _drain_estimate(self) -> float:
         """Rough seconds until one queue position frees: backlog size
@@ -453,6 +699,8 @@ class ContinuousBatchingEngine:
             # every slot- and prefix-held page returns to the pool, so
             # the alloc/free journal balances.
             self._kv.release_all()
+        # Handoff imports still queued never ran; unblock their waiters.
+        self._drain_host_ops()
 
     # ------------------------------------------------------------ metrics
 
@@ -752,6 +1000,9 @@ class ContinuousBatchingEngine:
         while not self._stop.is_set():
             try:
                 self._queue.expire_stale()
+                # Host ops (KV handoff imports) run between ticks: they
+                # mutate self._cache, which only this thread owns.
+                self._drain_host_ops()
                 # Cancelled live requests: freeze their slots on device
                 # before the next dispatch, free them for admission.
                 cancelled = [i for i, r in live.items() if r.cancelled]
@@ -841,7 +1092,10 @@ class ContinuousBatchingEngine:
                         time.sleep(0.005)
                     else:
                         with self._cond:
+                            with self._host_ops_lock:
+                                ops_waiting = bool(self._host_ops)
                             if (not len(self._queue) and
+                                    not ops_waiting and
                                     not self._stop.is_set()):
                                 self._cond.wait(timeout=0.05)
             except Exception as e:  # pylint: disable=broad-except
@@ -988,3 +1242,4 @@ class ContinuousBatchingEngine:
             lambda: RuntimeError(f'batching engine failed: {e}'))
         if self._kv is not None:
             self._kv.release_all()
+        self._drain_host_ops()  # stop is set: pending imports error out

@@ -274,6 +274,11 @@ class PrefixCache:
     def __len__(self) -> int:
         return len(self._entries)
 
+    def contains(self, h: int) -> bool:
+        """Pure membership probe (no incref, no LRU touch) — the KV
+        handoff import uses it to skip pages already resident."""
+        return h in self._entries
+
     def match(self, hashes: Sequence[int]) -> List[int]:
         """Longest chain of cached pages for these chain hashes; the
         matched pages are incref'd for the caller (one ref per page)."""
@@ -416,6 +421,24 @@ class PagedKVManager:
         if shortfall > 0:
             self.prefix.evict(shortfall)
         return self.pool.alloc(n)
+
+    def alloc_pages(self, n: int) -> List[int]:
+        """Allocate n pages (evicting idle prefix entries under
+        pressure); raises PagesExhausted.  The KV-handoff import path
+        uses this to stage incoming pages before publishing them."""
+        return self._alloc_with_eviction(n)
+
+    def import_prefix_depth(self, hashes: Sequence[int]) -> int:
+        """Longest leading run of `hashes` already resident in the
+        prefix cache — an import skips those pages (the chain property
+        means a later hash can only be cached if every earlier one
+        was; stop at the first miss)."""
+        depth = 0
+        for h in hashes:
+            if not self.prefix.contains(h):
+                break
+            depth += 1
+        return depth
 
     def commit(self, slot: int, plan: AdmissionPlan) -> None:
         """Record slot ownership (release() undoes it)."""

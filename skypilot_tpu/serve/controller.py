@@ -42,6 +42,11 @@ _M_READY_REPLICAS = metrics_lib.gauge(
     'skytpu_autoscaler_ready_replicas',
     'Ready replicas serving traffic at evaluation time.',
     ('service',))
+_M_ROLE_TARGET = metrics_lib.gauge(
+    'skytpu_autoscaler_role_target_replicas',
+    'Per-role-pool replica target from the last scaling evaluation '
+    '(disaggregated serving: each role autoscales independently).',
+    ('service', 'role'))
 
 
 def _sync_interval() -> float:
@@ -59,7 +64,15 @@ class SkyServeController:
         task = task_lib.Task.from_yaml(record['task_yaml_path'])
         self.replica_manager = replica_managers.ReplicaManager(
             service_name, self.spec, task, version=self.version)
-        self.autoscaler = autoscalers.make_autoscaler(self.spec)
+        # One autoscaler per role pool (a single 'mixed' pool without
+        # `roles:` — identical to the pre-disaggregation behavior);
+        # self.autoscaler stays the first pool's scaler for callers
+        # that predate role pools.
+        self.autoscalers = {
+            role: autoscalers.make_autoscaler(self.spec, role=role)
+            for role in self.spec.role_specs
+        }
+        self.autoscaler = next(iter(self.autoscalers.values()))
         self.port = port
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -86,7 +99,9 @@ class SkyServeController:
                 if self.path == '/controller/load_balancer_sync':
                     self._json(200, {
                         'ready_replica_urls':
-                            controller.serving_urls()})
+                            controller.serving_urls(),
+                        'ready_replicas':
+                            controller.serving_replicas()})
                 else:
                     self._json(404, {'error': 'unknown path'})
 
@@ -94,11 +109,15 @@ class SkyServeController:
                 length = int(self.headers.get('Content-Length', 0))
                 data = json.loads(self.rfile.read(length) or b'{}')
                 if self.path == '/controller/load_balancer_sync':
-                    controller.autoscaler.collect_request_information(
-                        data.get('request_timestamps', []), time.time())
+                    controller.collect_request_information(
+                        data.get('request_timestamps', []),
+                        data.get('role_request_timestamps') or {},
+                        time.time())
                     self._json(200, {
                         'ready_replica_urls':
-                            controller.serving_urls()})
+                            controller.serving_urls(),
+                        'ready_replicas':
+                            controller.serving_replicas()})
                 elif self.path == '/controller/update_service':
                     controller.reload_version()
                     self._json(200, {'version': controller.version})
@@ -120,6 +139,32 @@ class SkyServeController:
 
     # ------------------------------------------------------------- traffic
 
+    def collect_request_information(self, timestamps, role_timestamps,
+                                    now: float) -> None:
+        """Feed the LB's QPS samples to the role pools' autoscalers.
+
+        With per-role samples each pool sees ONLY its own traffic (a
+        prefill burst scales the prefill pool, not every pool); absent
+        them (an older LB) every pool sees the aggregate — the legacy
+        behavior, conservative for multi-pool specs."""
+        for role, scaler in self.autoscalers.items():
+            if role_timestamps:
+                scaler.collect_request_information(
+                    role_timestamps.get(role, []), now)
+            else:
+                scaler.collect_request_information(timestamps, now)
+
+    def _total_target(self) -> int:
+        return sum(s.target_num_replicas
+                   for s in self.autoscalers.values())
+
+    def serving_replicas(self):
+        """READY replicas with role/load/page-size facts — what the
+        LB's router dispatches and hands off with."""
+        urls = set(self.serving_urls())
+        return [info for info in self.replica_manager.ready_infos()
+                if info['url'] in urls]
+
     def serving_urls(self):
         """Replica URLs the LB should serve.
 
@@ -134,7 +179,7 @@ class SkyServeController:
                  if r['status'] == ReplicaStatus.READY.value and r['url']]
         old_ready = [r for r in ready if r['version'] < self.version]
         new_ready = [r for r in ready if r['version'] >= self.version]
-        target = self.autoscaler.target_num_replicas
+        target = self._total_target()
         if old_ready and len(new_ready) < target:
             return [r['url'] for r in old_ready]  # green not ready yet
         return [r['url'] for r in new_ready]
@@ -149,12 +194,20 @@ class SkyServeController:
         self.spec = SkyServiceSpec.from_yaml_config(record['spec'])
         task = task_lib.Task.from_yaml(record['task_yaml_path'])
         self.replica_manager.set_version(self.spec, task, self.version)
-        new_scaler = autoscalers.make_autoscaler(self.spec)
         # Keep live request history + scale target across the update
         # (a reset would collapse the blue-green flip threshold to
-        # min_replicas — a capacity cliff).
-        new_scaler.carry_over(self.autoscaler)
-        self.autoscaler = new_scaler
+        # min_replicas — a capacity cliff).  Role pools carry over per
+        # role; a pool new in this version starts fresh.
+        new_scalers = {
+            role: autoscalers.make_autoscaler(self.spec, role=role)
+            for role in self.spec.role_specs
+        }
+        for role, scaler in new_scalers.items():
+            old = self.autoscalers.get(role)
+            if old is not None:
+                scaler.carry_over(old)
+        self.autoscalers = new_scalers
+        self.autoscaler = next(iter(self.autoscalers.values()))
         logger.info(f'service {self.service_name} updated to '
                     f'version {self.version}')
 
@@ -176,7 +229,7 @@ class SkyServeController:
             if r['version'] == self.version and
             r['status'] == ReplicaStatus.READY.value]
         current = [r for r in replicas if r['version'] == self.version]
-        target = self.autoscaler.target_num_replicas
+        target = self._total_target()
         if len(current) < target:
             return  # new-version capacity still coming up
         if self.spec.update_mode == 'blue_green':
@@ -192,46 +245,60 @@ class SkyServeController:
     def reconcile_once(self) -> None:
         self.reload_version()
         self.replica_manager.sync()
-        # Decode-saturation signal from the replicas' last healthy
-        # probes (busy_slots/slots out of the model server's /health):
-        # with target_slot_utilization set, the autoscaler scales on
-        # decode pressure even when QPS reads as idle.
-        self.autoscaler.collect_replica_load(
-            self.replica_manager.ready_loads())
-        decision = self.autoscaler.evaluate_scaling(time.time())
+        replicas = self.replica_manager.active_replicas()
+        current_version = [r for r in replicas
+                           if r['version'] >= self.version]
+        # Each role pool reconciles INDEPENDENTLY: its own decode-load
+        # signal ((busy + queued)/slots out of the replicas' /health),
+        # its own QPS slice, its own hysteresis — a prefill burst
+        # grows the prefill pool without churning decode replicas.
+        for role, scaler in self.autoscalers.items():
+            scaler.collect_replica_load(
+                self.replica_manager.ready_loads(role=role))
+            decision = scaler.evaluate_scaling(time.time())
+            _M_ROLE_TARGET.labels(service=self.service_name,
+                                  role=role).set(
+                decision.target_num_replicas)
+            pool = [r for r in current_version
+                    if (r.get('role') or 'mixed') == role]
+            n_active = len(pool)
+            if n_active < decision.target_num_replicas:
+                # Spot/on-demand mix: keep `num_ondemand` on-demand
+                # replicas, the rest spot (None = as the task asked).
+                # Recount per launch so a cold start fills the base
+                # before going spot.
+                n_ondemand = sum(1 for r in pool if not r['is_spot'])
+                for _ in range(decision.target_num_replicas - n_active):
+                    use_spot: Optional[bool] = None
+                    if decision.num_ondemand > 0:
+                        use_spot = n_ondemand >= decision.num_ondemand
+                        if not use_spot:
+                            n_ondemand += 1
+                    self.replica_manager.scale_up(use_spot=use_spot,
+                                                  role=role)
+            elif n_active > decision.target_num_replicas:
+                extra = n_active - decision.target_num_replicas
+                # Retire not-ready first, then newest.
+                candidates = sorted(
+                    pool,
+                    key=lambda r: (
+                        r['status'] == ReplicaStatus.READY.value,
+                        r['replica_id']))
+                for replica in candidates[:extra]:
+                    self.replica_manager.scale_down(
+                        replica['replica_id'])
+        # Replicas whose role pool no longer exists in the spec (a
+        # roles: change) have no autoscaler to own them — retire.
+        for replica in current_version:
+            if (replica.get('role') or 'mixed') not in self.autoscalers:
+                self.replica_manager.scale_down(replica['replica_id'])
         _M_TARGET_REPLICAS.labels(service=self.service_name).set(
-            decision.target_num_replicas)
+            self._total_target())
         _M_QPS.labels(service=self.service_name).set(
             len(self.autoscaler.request_timestamps) /
             autoscalers.QPS_WINDOW_SIZE_SECONDS)
         _M_READY_REPLICAS.labels(service=self.service_name).set(
             len(self.replica_manager.ready_urls()))
-        replicas = self.replica_manager.active_replicas()
-        current_version = [r for r in replicas
-                           if r['version'] >= self.version]
-        n_active = len(current_version)
-        if n_active < decision.target_num_replicas:
-            # Spot/on-demand mix: keep `num_ondemand` on-demand replicas,
-            # the rest spot (None = as the task asked).  Recount per
-            # launch so a cold start fills the base before going spot.
-            n_ondemand = sum(
-                1 for r in current_version if not r['is_spot'])
-            for _ in range(decision.target_num_replicas - n_active):
-                use_spot: Optional[bool] = None
-                if decision.num_ondemand > 0:
-                    use_spot = n_ondemand >= decision.num_ondemand
-                    if not use_spot:
-                        n_ondemand += 1
-                self.replica_manager.scale_up(use_spot=use_spot)
-        elif n_active > decision.target_num_replicas:
-            extra = n_active - decision.target_num_replicas
-            # Retire not-ready first, then newest.
-            candidates = sorted(
-                current_version,
-                key=lambda r: (r['status'] == ReplicaStatus.READY.value,
-                               r['replica_id']))
-            for replica in candidates[:extra]:
-                self.replica_manager.scale_down(replica['replica_id'])
         self._replace_outdated()
         self._update_service_status()
 

@@ -1,4 +1,4 @@
-"""Load balancer: asyncio streaming HTTP reverse proxy over ready replicas.
+"""Load balancer: role-aware, prefix-affine router over ready replicas.
 
 Parity: /root/reference/sky/serve/load_balancer.py:22-205
 (SkyServeLoadBalancer: syncs ready-replica URLs + reports request
@@ -10,15 +10,38 @@ the replica as they arrive and response bytes stream back chunk-by-
 chunk with backpressure — SSE / LLM token streams are never buffered.
 Policies: round_robin and least_connections (better for LLM serving,
 where generation lengths make request costs wildly uneven).
+
+Beyond the flat policies, generation traffic (`/generate*` POSTs with
+a bounded JSON body) goes through `serve/router.py` — a real router:
+
+- **role dispatch** — replicas run in prefill/decode/mixed pools
+  (service_spec `roles:`); generation lands on the decode pool, and a
+  prompt at/above the prefill threshold is first prefilled on a
+  PREFILL replica whose KV pages are handed to the decode replica
+  (`/prefill_export` -> `/kv_import`, serve/handoff.py wire format),
+  so long prompts never stall in-flight decodes.  A failed handoff
+  falls back to local prefill on the decode replica — never a failed
+  request (chaos site `serve.kv_handoff`).
+- **prefix affinity** — repeat prompt heads route to the replica whose
+  prefix cache already pins those pages (TTFT collapses to the PR 7
+  hit path); affinity re-pins when the replica dies.
+- **backpressure retry** — an upstream 429 (`pages_exhausted` /
+  `QueueFull`) retries ONCE on an alternate same-role replica,
+  honoring Retry-After (bounded), instead of relaying the 429
+  straight to the client.
+
+Requests without a parseable body (streams, oversized, GET) keep the
+legacy policy path untouched.
 """
 from __future__ import annotations
 
 import asyncio
+import json
 import os
 import ssl as ssl_lib
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import urlsplit
 
 import requests
@@ -26,6 +49,7 @@ import requests
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing
+from skypilot_tpu.serve import router as router_lib
 
 logger = sky_logging.init_logger(__name__)
 
@@ -56,8 +80,71 @@ _M_DROPPED_TIMESTAMPS = metrics_lib.counter(
 _M_SYNC_FAILURES = metrics_lib.counter(
     'skytpu_lb_controller_sync_failures_total',
     'Controller sync attempts that failed.')
+_M_ROUTE = metrics_lib.counter(
+    'skytpu_lb_route_total',
+    'Routed generation requests, by role pool and affinity outcome.',
+    ('role', 'affinity'))
+_M_AFFINITY_HITS = metrics_lib.counter(
+    'skytpu_lb_affinity_hits_total',
+    'Routed requests whose prompt prefix was pinned to a live '
+    'replica.')
+_M_AFFINITY_MISSES = metrics_lib.counter(
+    'skytpu_lb_affinity_misses_total',
+    'Routed requests with a prefix key but no live pinned replica.')
+_M_RETRIES = metrics_lib.counter(
+    'skytpu_lb_retries_total',
+    'Requests retried on an alternate same-role replica, by reason '
+    '(pages_exhausted / queue_full backpressure, upstream errors).',
+    ('reason',))
+_M_HANDOFF = metrics_lib.counter(
+    'skytpu_lb_handoff_total',
+    'KV page handoffs attempted, by outcome (ok = pages imported on '
+    'the decode replica; fallback = request served via local '
+    'prefill).', ('outcome',))
+_M_HANDOFF_SECONDS = metrics_lib.histogram(
+    'skytpu_lb_handoff_seconds',
+    'prefill_export + kv_import wall time per successful handoff.')
 
 _REQUEST_ID_KEY = tracing.REQUEST_ID_HEADER.lower()
+
+# Generation endpoints the router may parse (bounded JSON bodies).
+_ROUTABLE_PATHS = ('/generate', '/generate_stream', '/generate_text')
+
+
+def _max_route_body() -> int:
+    """Bodies above this stream through the legacy policy path instead
+    of being buffered for routing."""
+    return int(os.environ.get('SKYTPU_LB_ROUTE_BODY_LIMIT',
+                              str(4 * 1024 * 1024)))
+
+
+def _retry_max_delay() -> float:
+    """Cap on how long a 429's Retry-After can hold the one in-LB
+    retry (the client owns longer backoffs)."""
+    return float(os.environ.get('SKYTPU_LB_RETRY_MAX_DELAY', '2'))
+
+
+def _handoff_timeout() -> float:
+    return float(os.environ.get('SKYTPU_LB_HANDOFF_TIMEOUT', '30'))
+
+
+def _journal_handoff(event: str, **fields: Any) -> None:
+    """Journal routing/handoff events only while someone is watching
+    (the `serve.kv_handoff` chaos site armed or
+    SKYTPU_SERVE_HANDOFF_EVENTS set) — the `handoff_consistency`
+    invariant replays them to prove no request is lost or
+    double-executed across a handoff failure."""
+    from skypilot_tpu.chaos import injector as chaos_injector  # pylint: disable=import-outside-toplevel
+    if not (os.environ.get('SKYTPU_SERVE_HANDOFF_EVENTS') or
+            chaos_injector.site_armed('serve.kv_handoff')):
+        return
+    from skypilot_tpu.observability import events as events_lib  # pylint: disable=import-outside-toplevel
+    try:
+        events_lib.get_journal(
+            os.path.join(events_lib.journal_root(),
+                         'serve.jsonl')).append(event, **fields)
+    except Exception:  # pylint: disable=broad-except
+        pass  # recording must never break the proxy path
 
 
 def _max_pending_timestamps() -> int:
@@ -283,12 +370,19 @@ class SkyServeLoadBalancer:
     """Streams requests to replicas; reports QPS to the controller."""
 
     def __init__(self, controller_url: str, port: int = 0,
-                 policy: Optional[LoadBalancingPolicy] = None) -> None:
+                 policy: Optional[LoadBalancingPolicy] = None,
+                 router: Optional[router_lib.Router] = None) -> None:
         self.controller_url = controller_url.rstrip('/')
         self.port = port
         self.policy = policy or RoundRobinPolicy()
+        # Role/affinity routing for generation requests; non-routable
+        # traffic keeps the flat policy above.
+        self.router = router or router_lib.Router()
         self.ready_urls: List[str] = []
         self.request_timestamps: List[float] = []
+        # Per-role QPS samples (the controller autoscales each role
+        # pool independently); same drop-oldest bound as above.
+        self.role_request_timestamps: Dict[str, List[float]] = {}
         self.dropped_timestamps = 0
         self._sync_failures = 0       # consecutive; reset on success
         self._next_failure_warn = 1   # exponential-backoff WARNING
@@ -300,17 +394,39 @@ class SkyServeLoadBalancer:
 
     # ------------------------------------------------------ controller sync
 
+    def set_replicas(self, replicas: List[Dict[str, Any]]) -> None:
+        """Install the ready set with role/load info (what the
+        controller sync delivers; tests and benches call it directly).
+        Dicts carry at least `url`, optionally `role`, `load`,
+        `page_size`."""
+        endpoints = [router_lib.ReplicaEndpoint(
+            url=r['url'], role=r.get('role') or router_lib.DEFAULT_ROLE,
+            load=float(r.get('load') or 0.0),
+            page_size=r.get('page_size')) for r in replicas]
+        self.router.set_endpoints(endpoints)
+        with self._lock:
+            self.ready_urls = [e.url for e in endpoints]
+
     def _sync_with_controller(self) -> None:
         with self._lock:
             timestamps, self.request_timestamps = \
                 self.request_timestamps, []
+            role_timestamps, self.role_request_timestamps = \
+                self.role_request_timestamps, {}
         try:
             resp = requests.post(
                 self.controller_url + '/controller/load_balancer_sync',
-                json={'request_timestamps': timestamps}, timeout=5)
-            urls = resp.json().get('ready_replica_urls', [])
+                json={'request_timestamps': timestamps,
+                      'role_request_timestamps': role_timestamps},
+                timeout=5)
+            data = resp.json()
+            urls = data.get('ready_replica_urls', [])
+            infos = data.get('ready_replicas')
+            if infos is not None:
+                self.set_replicas(infos)
             with self._lock:
-                self.ready_urls = urls
+                self.ready_urls = urls if infos is None else \
+                    self.ready_urls
                 if self._sync_failures:
                     logger.info(
                         f'LB sync recovered after '
@@ -323,6 +439,10 @@ class SkyServeLoadBalancer:
             with self._lock:
                 self.request_timestamps = (timestamps +
                                            self.request_timestamps)
+                for role, samples in role_timestamps.items():
+                    self.role_request_timestamps[role] = (
+                        samples +
+                        self.role_request_timestamps.get(role, []))
                 self._trim_timestamps_locked()
                 self._sync_failures += 1
                 failures = self._sync_failures
@@ -348,6 +468,10 @@ class SkyServeLoadBalancer:
             del self.request_timestamps[:overflow]
             self.dropped_timestamps += overflow
             _M_DROPPED_TIMESTAMPS.inc(overflow)
+        for samples in self.role_request_timestamps.values():
+            extra = len(samples) - cap
+            if extra > 0:
+                del samples[:extra]
 
     def _sync_loop(self) -> None:
         while not self._stop.is_set():
@@ -367,9 +491,29 @@ class SkyServeLoadBalancer:
                 self.request_timestamps.append(time.time())
                 self._trim_timestamps_locked()
                 urls = list(self.ready_urls)
-            target = self.policy.select(urls)
+            # Keep the router's endpoint set in lockstep with however
+            # ready_urls was installed (controller sync, set_replicas,
+            # or a test assigning the attribute directly).
+            self.router.ensure_urls(urls)
             _M_REQUESTS.labels(policy=getattr(
                 self.policy, 'NAME', type(self.policy).__name__)).inc()
+            # Generation POSTs with a bounded JSON body go through the
+            # role/affinity router (and can be retried/handed off —
+            # the body is replayable).  Everything else streams through
+            # the legacy policy path.
+            parts = start_line.split(' ')
+            method = parts[0] if parts else ''
+            path = (parts[1].split('?', 1)[0] if len(parts) > 1 else '')
+            framing = _body_framing(headers)
+            if (method == 'POST' and path in _ROUTABLE_PATHS and
+                    framing[0] == 'length' and
+                    framing[1] <= _max_route_body()):
+                body = await asyncio.wait_for(
+                    reader.readexactly(framing[1]), timeout=60)
+                await self._handle_routed(writer, start_line, headers,
+                                          body, t_start)
+                return
+            target = self.policy.select(urls)
             if target is None:
                 _M_NO_REPLICA.inc()
                 writer.write(_simple_response(
@@ -416,6 +560,312 @@ class SkyServeLoadBalancer:
                 await writer.wait_closed()
             except (ConnectionError, OSError):
                 pass
+
+    # ------------------------------------------------------ routed path
+
+    @staticmethod
+    def _parse_prompt(body: bytes):
+        """(request_json, prompt_ids | None, prefix_key, prompt_len)
+        from a generation body.  Unparseable bodies route with no key
+        (plain least-loaded in the decode pool)."""
+        try:
+            req = json.loads(body or b'{}')
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return None, None, None, 0
+        if not isinstance(req, dict):
+            return None, None, None, 0
+        ids = None
+        prompt = req.get('prompt_ids')
+        if (isinstance(prompt, list) and prompt and
+                isinstance(prompt[0], list)):
+            if len(prompt) == 1:
+                ids = prompt[0]
+        elif isinstance(prompt, list):
+            ids = prompt
+        if ids is not None:
+            try:
+                ids = [int(t) for t in ids]
+            except (TypeError, ValueError):
+                ids = None
+        if ids:
+            return req, ids, router_lib.prompt_key(prompt_ids=ids), \
+                len(ids)
+        text = req.get('prompt')
+        if isinstance(text, str) and text:
+            # ~4 chars per token: only the threshold comparison needs
+            # it, so a rough estimate is fine.
+            return req, None, router_lib.prompt_key(text=text), \
+                len(text) // 4 + 1
+        return req, None, None, 0
+
+    def _record_role_timestamp(self, role: str) -> None:
+        with self._lock:
+            self.role_request_timestamps.setdefault(
+                role, []).append(time.time())
+            self._trim_timestamps_locked()
+
+    async def _handle_routed(self, cwriter: asyncio.StreamWriter,
+                             start_line: str,
+                             headers: List[Tuple[str, str]],
+                             body: bytes, t_start: float) -> None:
+        """Route one buffered generation request: role dispatch +
+        prefix affinity + (for prefill-heavy prompts) KV handoff, with
+        one bounded same-role retry on upstream 429 backpressure."""
+        _, ids, key, prompt_len = self._parse_prompt(body)
+        decision = self.router.route(key, prompt_len)
+        if decision.url is None:
+            _M_NO_REPLICA.inc()
+            cwriter.write(_simple_response(
+                503, 'Service Unavailable', b'No ready replicas.'))
+            await cwriter.drain()
+            return
+        _M_ROUTE.labels(role=decision.role,
+                        affinity=decision.affinity).inc()
+        if decision.affinity == 'hit':
+            _M_AFFINITY_HITS.inc()
+        elif decision.affinity == 'miss':
+            _M_AFFINITY_MISSES.inc()
+        self._record_role_timestamp(decision.role)
+        rid = next((v for n, v in headers
+                    if n.lower() == _REQUEST_ID_KEY), None) or \
+            tracing.new_request_id()
+        _journal_handoff('lb_route', request_id=rid, url=decision.url,
+                         role=decision.role,
+                         affinity=decision.affinity,
+                         handoff=bool(decision.handoff_source))
+        handoff_ms: Optional[float] = None
+        if decision.handoff_source and ids is not None:
+            handoff_ms = await self._do_handoff(decision, ids, rid)
+        extra = {
+            tracing.REQUEST_ID_HEADER: rid,
+            router_lib.ROUTED_ROLE_HEADER: decision.role,
+            router_lib.AFFINITY_HEADER: decision.affinity,
+        }
+        if handoff_ms is not None:
+            extra[router_lib.HANDOFF_MS_HEADER] = f'{handoff_ms:.3f}'
+        target: Optional[str] = decision.url
+        tried: List[str] = []
+        delay = 0.0
+        for attempt in (0, 1):
+            if delay > 0:
+                # Retry-After honored, but bounded: the client owns
+                # longer backoffs, not an idle LB connection.
+                await asyncio.sleep(delay)
+            next_target: Optional[str] = None
+            delay = 0.0
+            self.policy.acquire(target)
+            self.router.acquire(target)
+            inflight = _M_UPSTREAM_INFLIGHT.labels(upstream=target)
+            inflight.inc()
+            try:
+                tried.append(target)
+                try:
+                    status, retry_after, resp_head, ureader, uwriter = \
+                        await self._forward_buffered(
+                            target, start_line, headers, body, extra)
+                except _UpstreamError:
+                    alternates = self.router.alternates(
+                        target, exclude=tried)
+                    if attempt == 1 or not alternates:
+                        raise
+                    # Dead/dropped replica but a replayable body: one
+                    # same-role failover beats a 502.
+                    _M_RETRIES.labels(reason='upstream_error').inc()
+                    next_target = alternates[0]
+                else:
+                    try:
+                        if status == 429 and attempt == 0:
+                            alternates = self.router.alternates(
+                                target, exclude=tried)
+                            if alternates:
+                                # Backpressure (pages_exhausted /
+                                # queue_full): one bounded retry on a
+                                # same-role sibling beats relaying the
+                                # 429 to a client that would retry
+                                # through us anyway.
+                                reason = (
+                                    'pages_exhausted'
+                                    if b'page' in resp_head.lower()
+                                    else 'queue_full')
+                                _M_RETRIES.labels(reason=reason).inc()
+                                next_target = alternates[0]
+                                delay = min(retry_after,
+                                            _retry_max_delay())
+                        if next_target is None:
+                            # Relay (any status): head then stream.
+                            cwriter.write(resp_head)
+                            await asyncio.wait_for(
+                                cwriter.drain(),
+                                timeout=_UPSTREAM_IDLE_TIMEOUT)
+                            await _relay_until_eof(ureader, cwriter)
+                            if status == 200:
+                                self.router.record_affinity(key,
+                                                            target)
+                            _M_PROXY_LATENCY.observe(
+                                time.perf_counter() - t_start)
+                            return
+                    finally:
+                        try:
+                            uwriter.close()
+                            await uwriter.wait_closed()
+                        except (ConnectionError, OSError):
+                            pass
+            finally:
+                inflight.dec()
+                self.router.release(target)
+                self.policy.release(target)
+            target = next_target
+
+    async def _forward_buffered(self, target: str, start_line: str,
+                                headers: List[Tuple[str, str]],
+                                body: bytes,
+                                extra: Dict[str, str]):
+        """Send a fully-buffered request; returns (status, retry_after,
+        response_head_bytes, ureader, uwriter) once the response head
+        is in.  The caller relays or retries; raising closes nothing
+        the caller holds (_UpstreamError means no connection)."""
+        split = urlsplit(target)
+        host = split.hostname or '127.0.0.1'
+        use_tls = split.scheme == 'https'
+        port = split.port or (443 if use_tls else 80)
+        try:
+            ureader, uwriter = await asyncio.wait_for(
+                asyncio.open_connection(
+                    host, port,
+                    ssl=ssl_lib.create_default_context() if use_tls
+                    else None),
+                timeout=_UPSTREAM_CONNECT_TIMEOUT)
+        except (OSError, asyncio.TimeoutError) as e:
+            raise _UpstreamError(
+                f'cannot reach replica {target}: {e}') from e
+        try:
+            skip = {n.lower() for n in extra} | _HOP_HEADERS | \
+                {'host', 'expect'}
+            out = [start_line]
+            out.extend(f'{n}: {v}' for n, v in headers
+                       if n.lower() not in skip)
+            out.extend(f'{n}: {v}' for n, v in extra.items())
+            out.append(f'Host: {host}:{port}')
+            out.append('Connection: close')
+            uwriter.write(
+                ('\r\n'.join(out) + '\r\n\r\n').encode('latin-1') +
+                body)
+            await asyncio.wait_for(uwriter.drain(),
+                                   timeout=_UPSTREAM_IDLE_TIMEOUT)
+            resp_head = await asyncio.wait_for(
+                ureader.readuntil(b'\r\n\r\n'),
+                timeout=_UPSTREAM_IDLE_TIMEOUT)
+        except (OSError, ConnectionError, asyncio.TimeoutError,
+                asyncio.IncompleteReadError,
+                asyncio.LimitOverrunError) as e:
+            try:
+                uwriter.close()
+            except (ConnectionError, OSError):
+                pass
+            raise _UpstreamError(
+                f'replica {target} dropped the request: {e}') from e
+        try:
+            status = int(resp_head.split(b' ', 2)[1])
+        except (IndexError, ValueError) as e:
+            try:
+                uwriter.close()
+            except (ConnectionError, OSError):
+                pass
+            raise _UpstreamError(
+                f'replica {target} sent a malformed response') from e
+        retry_after = 1.0
+        for line in resp_head.decode('latin-1').split('\r\n')[1:]:
+            name, _, value = line.partition(':')
+            if name.strip().lower() == 'retry-after':
+                try:
+                    retry_after = float(value.strip())
+                except ValueError:
+                    pass
+        return status, retry_after, resp_head, ureader, uwriter
+
+    async def _json_request(self, target: str, path: str,
+                            payload: Dict[str, Any],
+                            timeout: float) -> Tuple[int, Any]:
+        """One bounded JSON POST to a replica (the handoff legs);
+        returns (status, parsed body or None)."""
+        split = urlsplit(target)
+        host = split.hostname or '127.0.0.1'
+        port = split.port or 80
+        body = json.dumps(payload).encode()
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port),
+            timeout=_UPSTREAM_CONNECT_TIMEOUT)
+        try:
+            writer.write((f'POST {path} HTTP/1.1\r\n'
+                          f'Host: {host}:{port}\r\n'
+                          f'Content-Type: application/json\r\n'
+                          f'Content-Length: {len(body)}\r\n'
+                          f'Connection: close\r\n\r\n').encode() + body)
+            await asyncio.wait_for(writer.drain(), timeout=timeout)
+            head = await asyncio.wait_for(
+                reader.readuntil(b'\r\n\r\n'), timeout=timeout)
+            status = int(head.split(b' ', 2)[1])
+            length = None
+            for line in head.decode('latin-1').split('\r\n')[1:]:
+                name, _, value = line.partition(':')
+                if name.strip().lower() == 'content-length':
+                    length = int(value.strip())
+            if length is not None:
+                raw = await asyncio.wait_for(reader.readexactly(length),
+                                             timeout=timeout)
+            else:
+                raw = await asyncio.wait_for(reader.read(-1),
+                                             timeout=timeout)
+            try:
+                return status, json.loads(raw or b'null')
+            except json.JSONDecodeError:
+                return status, None
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _do_handoff(self, decision: router_lib.RouteDecision,
+                          prompt_ids: List[int],
+                          rid: str) -> Optional[float]:
+        """Prefill-replica export -> decode-replica import.  Returns
+        the handoff wall time in ms, or None when any leg failed — the
+        request then proceeds with LOCAL prefill on the decode replica
+        (degraded latency, never a lost request)."""
+        t0 = time.perf_counter()
+        _journal_handoff('kv_handoff_start', request_id=rid,
+                         source=decision.handoff_source,
+                         target=decision.url)
+        try:
+            export_req: Dict[str, Any] = {'prompt_ids': prompt_ids}
+            if decision.page_size:
+                export_req['page_size'] = decision.page_size
+            timeout = _handoff_timeout()
+            status, payload = await self._json_request(
+                decision.handoff_source, '/prefill_export', export_req,
+                timeout)
+            if status != 200 or not isinstance(payload, dict):
+                raise _UpstreamError(f'prefill_export -> {status}')
+            status, _ = await self._json_request(
+                decision.url, '/kv_import', payload, timeout)
+            if status != 200:
+                raise _UpstreamError(f'kv_import -> {status}')
+        except (_UpstreamError, OSError, ConnectionError,
+                asyncio.TimeoutError, asyncio.IncompleteReadError,
+                asyncio.LimitOverrunError, ValueError) as e:
+            logger.debug(f'KV handoff fell back to local prefill: {e}')
+            _M_HANDOFF.labels(outcome='fallback').inc()
+            _journal_handoff('kv_handoff_end', request_id=rid,
+                             status='fallback', error=str(e))
+            return None
+        dt = time.perf_counter() - t0
+        _M_HANDOFF.labels(outcome='ok').inc()
+        _M_HANDOFF_SECONDS.observe(dt)
+        _journal_handoff('kv_handoff_end', request_id=rid, status='ok',
+                         duration_ms=round(dt * 1e3, 3))
+        return dt * 1e3
 
     async def _proxy_to(self, target: str, creader: asyncio.StreamReader,
                         cwriter: asyncio.StreamWriter, start_line: str,

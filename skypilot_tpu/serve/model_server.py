@@ -54,8 +54,39 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import batching_engine as batching_engine_lib
+from skypilot_tpu.serve import handoff as handoff_lib
+from skypilot_tpu.serve import router as router_lib
 
 logger = sky_logging.init_logger(__name__)
+
+# Requests routed by role (the LB's X-SkyTPU-Routed-Role /
+# X-SkyTPU-Affinity headers) — the replica-side view of the router's
+# decisions, scraped by `serve status --metrics` for the AFFINITY
+# column.
+_M_ROUTED = metrics_lib.counter(
+    'skytpu_engine_routed_total',
+    'LB-routed requests served, by routed role and affinity outcome.',
+    ('role', 'affinity'))
+
+
+def _maybe_journal_request(event: str, **fields) -> None:
+    """Journal request execution only while someone is watching (the
+    `serve.kv_handoff` chaos site armed, or SKYTPU_SERVE_HANDOFF_EVENTS
+    set): the handoff_consistency invariant replays these to prove no
+    request is lost or double-executed across a handoff failure."""
+    import os  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.chaos import injector as chaos_injector  # pylint: disable=import-outside-toplevel
+    if not (os.environ.get('SKYTPU_SERVE_HANDOFF_EVENTS') or
+            chaos_injector.site_armed('serve.kv_handoff')):
+        return
+    from skypilot_tpu.observability import events as events_lib  # pylint: disable=import-outside-toplevel
+    try:
+        events_lib.get_journal(
+            os.path.join(events_lib.journal_root(),
+                         'serve.jsonl')).append(event, **fields)
+    except Exception:  # pylint: disable=broad-except
+        pass  # recording must never break the serving path
 
 
 class ModelServer:
@@ -75,7 +106,8 @@ class ModelServer:
                  kv_pages: Optional[int] = None,
                  page_size: int = 16,
                  quantize_kv: bool = False,
-                 prefix_caching: bool = True) -> None:
+                 prefix_caching: bool = True,
+                 role: str = router_lib.DEFAULT_ROLE) -> None:
         import jax
         import flax.linen as nn
 
@@ -122,6 +154,15 @@ class ModelServer:
                 'early and will always run to max_new_tokens.')
         self.max_len = max_len
         self.max_batch = max_batch
+        # Disaggregated serving role (prefill / decode / mixed):
+        # advertised via /health so the controller and LB can dispatch
+        # by role; the engine itself is role-agnostic — a prefill
+        # replica mostly serves /prefill_export, a decode replica
+        # mostly /kv_import + generation, and either can do both.
+        if role not in router_lib.ROLES:
+            raise ValueError(f'Unknown replica role {role!r}; one of '
+                             f'{router_lib.ROLES}')
+        self.role = role
         model_mod = Transformer(self.cfg)
         init_tokens = jax.numpy.zeros((1, 8), jax.numpy.int32)
         key = jax.random.PRNGKey(seed)
@@ -223,7 +264,8 @@ class ModelServer:
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, top_k: int = 0,
                  stop_token=None, seed: int = 0,
-                 request_id: Optional[str] = None) -> Any:
+                 request_id: Optional[str] = None,
+                 route_meta: Optional[Dict[str, Any]] = None) -> Any:
         """stop_token: None, a single id, or an iterable of ids (the
         tokenizer's multi-EOS stop set).
 
@@ -259,7 +301,8 @@ class ModelServer:
                                     request_id=(
                                         None if request_id is None
                                         else (request_id if i == 0 else
-                                              f'{request_id}-{i}')))
+                                              f'{request_id}-{i}')),
+                                    route_meta=route_meta)
                 for i, row in enumerate(prompt_ids)
             ]
             return [r.result(timeout=600) for r in requests]
@@ -324,6 +367,26 @@ def _make_handler(server: ModelServer):
             return (self.headers.get(tracing.REQUEST_ID_HEADER) or
                     tracing.new_request_id())
 
+        def _route_meta(self) -> Optional[Dict[str, Any]]:
+            """Routing facts the LB forwarded; None for direct hits.
+            Counting happens here so the replica's /metrics carries
+            the per-role/affinity view the CLI table shows."""
+            role = self.headers.get(router_lib.ROUTED_ROLE_HEADER)
+            affinity = self.headers.get(router_lib.AFFINITY_HEADER)
+            handoff_ms = self.headers.get(router_lib.HANDOFF_MS_HEADER)
+            if not (role or affinity or handoff_ms):
+                return None
+            _M_ROUTED.labels(role=role or 'unknown',
+                             affinity=affinity or 'none').inc()
+            try:
+                ms = float(handoff_ms) if handoff_ms else None
+            except ValueError:
+                ms = None
+            return {'routed_role': role,
+                    'affinity_hit': (affinity == 'hit'
+                                     if affinity else None),
+                    'handoff_ms': ms}
+
         def do_GET(self):
             if self.path == '/metrics':
                 engine = server._engine  # pylint: disable=protected-access
@@ -339,7 +402,8 @@ def _make_handler(server: ModelServer):
                 return
             payload = {'status': 'ok',
                        'model': f'{server.cfg.d_model}x'
-                                f'{server.cfg.n_layers}'}
+                                f'{server.cfg.n_layers}',
+                       'role': server.role}
             engine = server._engine  # pylint: disable=protected-access
             code = 200
             if engine is not None:  # local bind: close() may race
@@ -385,7 +449,11 @@ def _make_handler(server: ModelServer):
                     [ids], int(req.get('max_new_tokens', 64)),
                     temperature, top_k,
                     stop_token=tok.eos_ids or None, seed=seed,
-                    request_id=rid)[0]
+                    request_id=rid,
+                    route_meta=self._route_meta())[0]
+                _maybe_journal_request('serve_request_done',
+                                       request_id=rid, status='ok',
+                                       tokens=len(tokens))
                 stops = [i for i, t in enumerate(tokens)
                          if t in tok.eos_ids]
                 if stops:
@@ -419,7 +487,7 @@ def _make_handler(server: ModelServer):
                 stop_token=tok.eos_ids or None,
                 sampling=decode.SamplingConfig(
                     temperature=temperature, top_k=top_k, seed=seed),
-                request_id=rid)
+                request_id=rid, route_meta=self._route_meta())
             self._start_sse(rid)
             decoder = StreamDecoder(tok)
             try:
@@ -474,7 +542,7 @@ def _make_handler(server: ModelServer):
                     sampling=decode.SamplingConfig(
                         temperature=temperature, top_k=top_k,
                         seed=seed),
-                    request_id=rid)
+                    request_id=rid, route_meta=self._route_meta())
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as e:
                 self._reply(400, {'error': str(e)})
@@ -493,6 +561,9 @@ def _make_handler(server: ModelServer):
                     self._sse_chunk(json.dumps({'token': token}))
                 self._sse_chunk('[DONE]')
                 self.wfile.write(b'0\r\n\r\n')
+                _maybe_journal_request('serve_request_done',
+                                       request_id=rid, status='ok',
+                                       tokens=len(request.tokens))
             except (BrokenPipeError, ConnectionResetError):
                 # Client went away: free the slot instead of decoding
                 # the rest of max_new_tokens for nobody.
@@ -524,12 +595,80 @@ def _make_handler(server: ModelServer):
                              payload + b'\r\n')
             self.wfile.flush()
 
+        def _prefill_export(self):
+            """KV handoff, prefill side: prefill the prompt and return
+            its full pages as a serve/handoff.py wire payload — the
+            router imports it on a decode replica and then forwards the
+            request there (where it lands as a prefix hit)."""
+            engine = server._engine  # pylint: disable=protected-access
+            if engine is None:
+                self._reply(400, {'error': 'KV handoff requires '
+                                           '--continuous-batching'})
+                return
+            try:
+                req = self._read_json()
+                prompt = req['prompt_ids']
+                if (isinstance(prompt, list) and prompt and
+                        isinstance(prompt[0], list)):
+                    if len(prompt) != 1:
+                        raise ValueError(
+                            'export serves one prompt per request')
+                    prompt = prompt[0]
+                payload = engine.export_prefill(
+                    [int(t) for t in prompt],
+                    page_size=req.get('page_size'))
+                self._reply(200, payload)
+            except (handoff_lib.HandoffError, KeyError, ValueError,
+                    TypeError, json.JSONDecodeError) as e:
+                self._reply(400, {'error': str(e)})
+            except Exception as e:  # pylint: disable=broad-except
+                if not self._reply_backpressure(e):
+                    self._reply(500,
+                                {'error': f'{type(e).__name__}: {e}'})
+
+        def _kv_import(self):
+            """KV handoff, decode side: adopt exported pages into the
+            pool + prefix cache.  429 pages_exhausted when the pool
+            cannot hold them right now; 503 when the import is refused
+            (chaos deny / shedding) — the router falls back to local
+            prefill either way."""
+            engine = server._engine  # pylint: disable=protected-access
+            if engine is None:
+                self._reply(400, {'error': 'KV handoff requires '
+                                           '--continuous-batching'})
+                return
+            try:
+                decoded = handoff_lib.decode_payload(self._read_json())
+                imported, cached = engine.import_pages(
+                    decoded['hashes'], decoded['page_size'],
+                    decoded['k'], decoded['v'],
+                    k_scale=decoded.get('k_scale'),
+                    v_scale=decoded.get('v_scale'))
+                self._reply(200, {'imported_pages': imported,
+                                  'cached_pages': cached})
+            except handoff_lib.HandoffRejected as e:
+                self._reply(503, {'error': str(e),
+                                  'reason': 'kv_handoff_denied'})
+            except (handoff_lib.HandoffError, KeyError, ValueError,
+                    TypeError, json.JSONDecodeError) as e:
+                self._reply(400, {'error': str(e)})
+            except Exception as e:  # pylint: disable=broad-except
+                if not self._reply_backpressure(e):
+                    self._reply(500,
+                                {'error': f'{type(e).__name__}: {e}'})
+
         def do_POST(self):
             if self.path == '/generate_stream':
                 self._generate_stream()
                 return
             if self.path == '/generate_text':
                 self._generate_text()
+                return
+            if self.path == '/prefill_export':
+                self._prefill_export()
+                return
+            if self.path == '/kv_import':
+                self._kv_import()
                 return
             if self.path != '/generate':
                 self._reply(404, {'error': 'unknown path'})
@@ -542,7 +681,11 @@ def _make_handler(server: ModelServer):
                 tokens = server.generate(
                     req['prompt_ids'],
                     int(req.get('max_new_tokens', 16)),
-                    temperature, top_k, seed=seed, request_id=rid)
+                    temperature, top_k, seed=seed, request_id=rid,
+                    route_meta=self._route_meta())
+                _maybe_journal_request(
+                    'serve_request_done', request_id=rid, status='ok',
+                    tokens=sum(len(t) for t in tokens))
                 self._reply(200, {
                     'tokens': tokens,
                     'latency_ms': round(
@@ -660,6 +803,17 @@ def main() -> None:
                         help='Tensor-shard the model over N local '
                              'devices (models too big for one chip); '
                              'GSPMD partitions the decode einsums.')
+    parser.add_argument('--role',
+                        default=_os.environ.get(
+                            'SKYTPU_SERVE_REPLICA_ROLE', 'mixed'),
+                        choices=list(router_lib.ROLES),
+                        help='Disaggregated-serving role this replica '
+                             'advertises: prefill (serves '
+                             '/prefill_export for KV handoff), decode '
+                             '(receives handoffs + streams tokens), or '
+                             'mixed (both; the default).  Env '
+                             'SKYTPU_SERVE_REPLICA_ROLE — set by the '
+                             'controller per role pool.')
     parser.add_argument('--http-server', default='async',
                         choices=['async', 'threaded'],
                         help='Connection front end: one asyncio event '
@@ -682,7 +836,8 @@ def main() -> None:
                          kv_pages=args.kv_pages,
                          page_size=args.page_size,
                          quantize_kv=args.quantize_kv,
-                         prefix_caching=not args.no_prefix_cache)
+                         prefix_caching=not args.no_prefix_cache,
+                         role=args.role)
     if args.http_server == 'async':
         from skypilot_tpu.serve import async_server  # pylint: disable=import-outside-toplevel
         async_server.serve_forever(server, args.port)

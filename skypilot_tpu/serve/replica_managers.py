@@ -46,6 +46,7 @@ _M_REPLICA_LOAD = metrics_lib.gauge(
 
 ENV_REPLICA_ID = 'SKYTPU_SERVE_REPLICA_ID'
 ENV_REPLICA_PORT = 'SKYTPU_SERVE_REPLICA_PORT'
+ENV_REPLICA_ROLE = 'SKYTPU_SERVE_REPLICA_ROLE'
 
 
 def _free_port() -> int:
@@ -67,6 +68,10 @@ class ReplicaManager:
         # replica_id -> busy_slots/slots from the last healthy probe
         # (decode-saturation autoscaling signal).
         self._last_load: Dict[int, float] = {}
+        # replica_id -> richer probe facts: queue depth (load signal
+        # includes it: queued work is future decode pressure), KV page
+        # size + prefix stats (the LB's handoff/affinity inputs).
+        self._last_stats: Dict[int, Dict] = {}
         self._lock = threading.Lock()
 
     def set_version(self, spec: 'SkyServiceSpec', task: 'task_lib.Task',
@@ -89,16 +94,18 @@ class ReplicaManager:
 
     # ----------------------------------------------------------- scale up
 
-    def scale_up(self, use_spot: Optional[bool] = None) -> int:
-        """Launch one replica asynchronously; returns its id."""
+    def scale_up(self, use_spot: Optional[bool] = None,
+                 role: str = 'mixed') -> int:
+        """Launch one replica asynchronously (into `role`'s pool);
+        returns its id."""
         replica_id = serve_state.allocate_replica(
             self.service_name, self.service_name,
-            is_spot=bool(use_spot), version=self.version)
+            is_spot=bool(use_spot), version=self.version, role=role)
         cluster_name = self._cluster_name(replica_id)
         port = _free_port() if self._is_local() else self.spec.replica_port
         thread = threading.Thread(
             target=self._launch_replica,
-            args=(replica_id, cluster_name, port, use_spot),
+            args=(replica_id, cluster_name, port, use_spot, role),
             daemon=True)
         with self._lock:
             self._launch_threads[replica_id] = thread
@@ -106,7 +113,8 @@ class ReplicaManager:
         return replica_id
 
     def _launch_replica(self, replica_id: int, cluster_name: str,
-                        port: int, use_spot: Optional[bool]) -> None:
+                        port: int, use_spot: Optional[bool],
+                        role: str = 'mixed') -> None:
         from skypilot_tpu import execution  # pylint: disable=import-outside-toplevel
         from skypilot_tpu.backends import backend_utils  # pylint: disable=import-outside-toplevel
         import copy  # pylint: disable=import-outside-toplevel
@@ -114,6 +122,9 @@ class ReplicaManager:
         task.update_envs({
             ENV_REPLICA_ID: str(replica_id),
             ENV_REPLICA_PORT: str(port),
+            # The model server's --role default: replicas of a role
+            # pool advertise it without YAML changes per pool.
+            ENV_REPLICA_ROLE: role,
         })
         if use_spot is not None:
             task.set_resources({
@@ -153,6 +164,8 @@ class ReplicaManager:
         serve_state.set_replica_status(self.service_name, replica_id,
                                        final_status)
         self._first_probe_at.pop(replica_id, None)
+        self._last_load.pop(replica_id, None)
+        self._last_stats.pop(replica_id, None)
 
     # -------------------------------------------------------------- probe
 
@@ -178,11 +191,25 @@ class ReplicaManager:
             # engine stats just never report).
             if ready:
                 try:
-                    engine = resp.json().get('engine') or {}
+                    payload = resp.json()
+                    engine = payload.get('engine') or {}
                     slots = engine.get('slots')
                     if slots:
-                        self._last_load[replica_id] = (
-                            engine.get('busy_slots', 0) / slots)
+                        # Load = decode saturation PLUS queued backlog
+                        # (queued work is decode pressure the busy
+                        # count hasn't absorbed yet), capped at 1 so
+                        # the autoscaler math stays a fraction.
+                        queued = engine.get('queued_requests', 0) or 0
+                        self._last_load[replica_id] = min(
+                            1.0,
+                            (engine.get('busy_slots', 0) + queued) /
+                            slots)
+                        self._last_stats[replica_id] = {
+                            'queue_depth': queued,
+                            'page_size': engine.get('page_size'),
+                            'prefix_cache_entries': engine.get(
+                                'prefix_cache_entries'),
+                        }
                 except (ValueError, TypeError, ZeroDivisionError):
                     pass
         except (requests.RequestException, chaos_faults.ChaosError):
@@ -194,6 +221,7 @@ class ReplicaManager:
                     self.service_name, replica_id, ReplicaStatus.READY)
             return
         self._last_load.pop(replica_id, None)
+        self._last_stats.pop(replica_id, None)
         if status is ReplicaStatus.READY:
             serve_state.set_replica_status(self.service_name, replica_id,
                                            ReplicaStatus.NOT_READY)
@@ -278,13 +306,36 @@ class ReplicaManager:
             self.service_name)
                 if r['status'] == ReplicaStatus.READY.value and r['url']]
 
-    def ready_loads(self) -> List[float]:
-        """Per-replica decode load (busy_slots/slots) from the latest
-        healthy probes — the autoscaler's decode-saturation input.
-        Only replicas whose health payload reports engine stats appear."""
+    def ready_infos(self) -> List[Dict]:
+        """READY replicas with the facts the LB's router needs: url,
+        role pool, last-probed load, and KV page size (handoff
+        geometry).  The controller sends this through
+        /controller/load_balancer_sync as `ready_replicas`."""
+        infos = []
+        for r in serve_state.get_replicas(self.service_name):
+            if r['status'] != ReplicaStatus.READY.value or not r['url']:
+                continue
+            rid = r['replica_id']
+            stats = self._last_stats.get(rid, {})
+            infos.append({
+                'url': r['url'],
+                'replica_id': rid,
+                'role': r.get('role') or 'mixed',
+                'load': self._last_load.get(rid, 0.0),
+                'page_size': stats.get('page_size'),
+                'queue_depth': stats.get('queue_depth', 0),
+            })
+        return infos
+
+    def ready_loads(self, role: Optional[str] = None) -> List[float]:
+        """Per-replica decode load ((busy + queued)/slots) from the
+        latest healthy probes — the autoscaler's decode-saturation
+        input, filterable per role pool.  Only replicas whose health
+        payload reports engine stats appear."""
         ready_ids = {r['replica_id'] for r in serve_state.get_replicas(
             self.service_name)
-            if r['status'] == ReplicaStatus.READY.value}
+            if r['status'] == ReplicaStatus.READY.value and
+            (role is None or (r.get('role') or 'mixed') == role)}
         return [load for rid, load in self._last_load.items()
                 if rid in ready_ids]
 

@@ -1,0 +1,264 @@
+"""Request router: role-aware, prefix-affine replica selection.
+
+The load balancer used to be role-blind (round-robin / least
+connections over one flat pool).  Under heavy mixed traffic that
+wastes both layers PR 7 built: long-prompt prefills stall in-flight
+decodes on whichever replica they land on, and repeat prefixes keep
+re-prefilling because nothing routes them back to the replica whose
+prefix cache already holds their pages.  This module is the pure
+routing brain (`serve/load_balancer.py` owns the sockets):
+
+- **Roles.**  Replicas run as ``prefill`` / ``decode`` / ``mixed``
+  pools (service_spec ``roles:``).  Generation traffic lands on the
+  decode pool (mixed when no decode pool exists); prompts at or above
+  ``prefill_threshold`` tokens additionally get a *handoff source* —
+  the least-loaded prefill replica, which prefills the prompt and
+  exports its KV pages so the decode replica never runs the long
+  prefill (serve/handoff.py carries the pages).
+- **Prefix affinity.**  The head of each prompt is a session/prefix
+  key; repeat keys route to the replica that served them last — the
+  replica whose paged prefix cache (PR 7) already pins those pages, so
+  the hit skips prefill entirely.  Affinity is advisory: a dead or
+  retired replica drops out of the map and the key re-pins to the
+  next target (chaos `serve_replica_flap` covers this).
+- **Least-loaded.**  Within the chosen pool, pick by (live in-flight
+  count here, last replica-reported load, url) — the LB's own
+  in-flight view reacts instantly; the controller-synced load
+  (busy+queued slots from `/health`) breaks ties across LBs.
+
+Everything is process-local and lock-protected; no I/O.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import threading
+from typing import Any, Dict, Hashable, List, Optional, Sequence
+
+ROLES = ('prefill', 'decode', 'mixed')
+DEFAULT_ROLE = 'mixed'
+
+# Routing metadata the LB forwards to the replica (and the replica
+# stamps into the request's span): which role pool served the request,
+# whether prefix affinity hit, and how long the KV handoff took.
+ROUTED_ROLE_HEADER = 'X-SkyTPU-Routed-Role'
+AFFINITY_HEADER = 'X-SkyTPU-Affinity'
+HANDOFF_MS_HEADER = 'X-SkyTPU-Handoff-Ms'
+
+# Prompt tokens (or chars/4 for text prompts) at which a request
+# counts as prefill-heavy and is eligible for prefill-pool handoff.
+_PREFIX_KEY_TOKENS = 64
+_PREFIX_KEY_CHARS = 256
+
+
+def prefill_threshold() -> int:
+    return int(os.environ.get('SKYTPU_LB_PREFILL_THRESHOLD', '64'))
+
+
+def prompt_key(prompt_ids: Optional[Sequence[int]] = None,
+               text: Optional[str] = None) -> Optional[Hashable]:
+    """Session/prefix key of a prompt: its head, verbatim.
+
+    The head itself is the key (no lossy hash — a collision would
+    silently pin unrelated sessions together); bounded so a 100k-token
+    prompt keys on its first page-aligned stretch, which is exactly
+    the part the prefix cache can share."""
+    if prompt_ids:
+        return ('ids', tuple(int(t) for t in
+                             prompt_ids[:_PREFIX_KEY_TOKENS]))
+    if text:
+        return ('text', text[:_PREFIX_KEY_CHARS])
+    return None
+
+
+@dataclasses.dataclass
+class ReplicaEndpoint:
+    """What the router knows about one ready replica."""
+    url: str
+    role: str = DEFAULT_ROLE
+    load: float = 0.0           # (busy + queued) / slots, last probe
+    page_size: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        if self.role not in ROLES:
+            raise ValueError(f'Unknown replica role {self.role!r}; '
+                             f'one of {ROLES}')
+
+
+@dataclasses.dataclass
+class RouteDecision:
+    """One routing outcome: where the request goes and why."""
+    url: Optional[str]                  # None = no target (503)
+    role: str = DEFAULT_ROLE            # role of the chosen target
+    affinity: str = 'none'              # 'hit' | 'miss' | 'none'
+    key: Optional[Hashable] = None      # prompt prefix key (affinity)
+    handoff_source: Optional[str] = None  # prefill replica to export from
+    page_size: Optional[int] = None     # target's KV page size (if known)
+
+
+class Router:
+    """Role dispatch + prefix affinity + least-loaded selection."""
+
+    def __init__(self, threshold: Optional[int] = None,
+                 affinity_capacity: int = 4096) -> None:
+        self.threshold = (prefill_threshold() if threshold is None
+                          else int(threshold))
+        self._lock = threading.Lock()
+        self._endpoints: Dict[str, ReplicaEndpoint] = {}
+        # prefix key -> url last served, LRU-bounded (a router serving
+        # millions of sessions must not grow without bound).
+        self._affinity: 'collections.OrderedDict[Hashable, str]' = (
+            collections.OrderedDict())
+        self._affinity_capacity = int(affinity_capacity)
+        self._inflight: Dict[str, int] = {}
+        self.affinity_hits = 0
+        self.affinity_misses = 0
+
+    # ------------------------------------------------------------ fleet
+
+    def set_endpoints(self, endpoints: List[ReplicaEndpoint]) -> None:
+        """Replace the ready set (controller sync)."""
+        with self._lock:
+            self._endpoints = {e.url: e for e in endpoints}
+            self._drop_stale_affinity_locked()
+
+    def ensure_urls(self, urls: List[str]) -> None:
+        """Reconcile with a bare url list (legacy sync / tests that
+        assign `ready_urls` directly): unknown urls join as 'mixed',
+        known ones keep their role/load, missing ones drop out."""
+        with self._lock:
+            if set(urls) == set(self._endpoints):
+                return
+            self._endpoints = {
+                url: self._endpoints.get(url, ReplicaEndpoint(url))
+                for url in urls
+            }
+            self._drop_stale_affinity_locked()
+
+    def _drop_stale_affinity_locked(self) -> None:
+        for key in [k for k, url in self._affinity.items()
+                    if url not in self._endpoints]:
+            del self._affinity[key]
+
+    def endpoints(self) -> List[ReplicaEndpoint]:
+        with self._lock:
+            return list(self._endpoints.values())
+
+    def roles_present(self) -> Dict[str, int]:
+        with self._lock:
+            counts: Dict[str, int] = {}
+            for e in self._endpoints.values():
+                counts[e.role] = counts.get(e.role, 0) + 1
+            return counts
+
+    # ------------------------------------------------------- load view
+
+    def acquire(self, url: str) -> None:
+        with self._lock:
+            self._inflight[url] = self._inflight.get(url, 0) + 1
+
+    def release(self, url: str) -> None:
+        with self._lock:
+            n = self._inflight.get(url, 0) - 1
+            if n <= 0:
+                self._inflight.pop(url, None)
+            else:
+                self._inflight[url] = n
+
+    def _rank_locked(self, urls: List[str]) -> List[str]:
+        return sorted(urls, key=lambda u: (
+            self._inflight.get(u, 0),
+            self._endpoints[u].load if u in self._endpoints else 0.0,
+            u))
+
+    def _pool_locked(self, role: str) -> List[str]:
+        return [u for u, e in self._endpoints.items() if e.role == role]
+
+    def _target_pool_locked(self) -> List[str]:
+        """Where generation traffic goes: the decode pool, else the
+        mixed pool, else whatever is ready (a prefill-only fleet must
+        still serve rather than 503)."""
+        for role in ('decode', 'mixed'):
+            pool = self._pool_locked(role)
+            if pool:
+                return pool
+        return list(self._endpoints)
+
+    # ----------------------------------------------------------- route
+
+    def route(self, key: Optional[Hashable] = None,
+              prompt_len: int = 0,
+              exclude: Sequence[str] = ()) -> RouteDecision:
+        """Pick the target replica (and, for prefill-heavy prompts, a
+        prefill-pool handoff source).  `exclude` removes replicas that
+        already failed this request (same-role failover/retry)."""
+        with self._lock:
+            pool = [u for u in self._target_pool_locked()
+                    if u not in exclude]
+            if not pool:
+                return RouteDecision(url=None, key=key)
+            affinity = 'none'
+            target: Optional[str] = None
+            if key is not None:
+                pinned = self._affinity.get(key)
+                if pinned is not None and pinned in pool:
+                    target = pinned
+                    affinity = 'hit'
+                    self._affinity.move_to_end(key)
+                    self.affinity_hits += 1
+                else:
+                    affinity = 'miss'
+                    self.affinity_misses += 1
+            if target is None:
+                target = self._rank_locked(pool)[0]
+            endpoint = self._endpoints.get(target)
+            role = endpoint.role if endpoint else DEFAULT_ROLE
+            handoff_source = None
+            if (prompt_len >= self.threshold and role != 'prefill'):
+                prefill = [u for u in self._pool_locked('prefill')
+                           if u not in exclude]
+                if prefill:
+                    handoff_source = self._rank_locked(prefill)[0]
+            return RouteDecision(
+                url=target, role=role, affinity=affinity, key=key,
+                handoff_source=handoff_source,
+                page_size=endpoint.page_size if endpoint else None)
+
+    def alternates(self, url: str,
+                   exclude: Sequence[str] = ()) -> List[str]:
+        """Same-role fallbacks for a failed/backpressured target,
+        best first."""
+        with self._lock:
+            endpoint = self._endpoints.get(url)
+            role = endpoint.role if endpoint else DEFAULT_ROLE
+            skip = set(exclude) | {url}
+            pool = [u for u in self._pool_locked(role) if u not in skip]
+            return self._rank_locked(pool)
+
+    def record_affinity(self, key: Optional[Hashable],
+                        url: str) -> None:
+        """Pin a prefix key to the replica that just served it (its
+        prefix cache now holds those pages)."""
+        if key is None:
+            return
+        with self._lock:
+            self._affinity[key] = url
+            self._affinity.move_to_end(key)
+            while len(self._affinity) > self._affinity_capacity:
+                self._affinity.popitem(last=False)
+
+    def affinity_target(self, key: Hashable) -> Optional[str]:
+        with self._lock:
+            return self._affinity.get(key)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                'endpoints': len(self._endpoints),
+                'roles': {r: len(self._pool_locked(r)) for r in ROLES},
+                'affinity_entries': len(self._affinity),
+                'affinity_hits': self.affinity_hits,
+                'affinity_misses': self.affinity_misses,
+                'prefill_threshold': self.threshold,
+            }

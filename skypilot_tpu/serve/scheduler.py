@@ -82,13 +82,21 @@ class Request:
     def __init__(self, prompt_ids: List[int], max_new_tokens: int,
                  stop_token, temperature: float = 0.0, top_k: int = 0,
                  seed: int = 0,
-                 request_id: Optional[str] = None) -> None:
+                 request_id: Optional[str] = None,
+                 route_meta: Optional[Dict[str, Any]] = None) -> None:
         self.prompt_ids = list(prompt_ids)
         self.max_new_tokens = max_new_tokens
         # Per-request phase trace (queue/prefill/TTFT/ITL/total); the
         # id arrives via X-SkyTPU-Request-Id or is generated here.
         self.span = tracing.RequestSpan(request_id)
         self.request_id = self.span.request_id
+        if route_meta:
+            # Routing facts the LB forwarded (X-SkyTPU-Routed-Role /
+            # -Affinity / -Handoff-Ms): stamped into the span so "why
+            # was THIS request slow" includes how it was routed.
+            self.span.routed_role = route_meta.get('routed_role')
+            self.span.affinity_hit = route_meta.get('affinity_hit')
+            self.span.handoff_ms = route_meta.get('handoff_ms')
         # stop_token: None, a single id, or any iterable of ids (the
         # tokenizer's multi-EOS stop set — instruct checkpoints stop at
         # chat turn-end markers, not just the model-level EOS).

@@ -81,8 +81,19 @@ CREATE TABLE IF NOT EXISTS replicas (
     is_spot INTEGER DEFAULT 0,
     version INTEGER DEFAULT 1,
     launched_at REAL,
+    role TEXT DEFAULT 'mixed',
     PRIMARY KEY (service_name, replica_id)
 )"""
+
+
+def _migrate(conn: sqlite3.Connection) -> None:
+    """Additive migrations for DBs created before a column existed
+    (same PRAGMA pattern as jobs/state.py)."""
+    columns = {row[1] for row in
+               conn.execute('PRAGMA table_info(replicas)')}
+    if 'role' not in columns:
+        conn.execute("ALTER TABLE replicas ADD COLUMN role TEXT "
+                     "DEFAULT 'mixed'")
 
 
 def _db_path() -> str:
@@ -98,6 +109,7 @@ def _conn() -> sqlite3.Connection:
     conn = sqlite3.connect(_db_path(), timeout=10)
     conn.execute(_CREATE_SERVICES)
     conn.execute(_CREATE_REPLICAS)
+    _migrate(conn)
     return conn
 
 
@@ -187,15 +199,16 @@ def update_service_spec(name: str, spec_json: Dict[str, Any],
 
 
 def add_replica(service_name: str, replica_id: int, cluster_name: str,
-                is_spot: bool = False, version: int = 1) -> None:
+                is_spot: bool = False, version: int = 1,
+                role: str = 'mixed') -> None:
     with _conn() as conn:
         conn.execute(
             'INSERT OR REPLACE INTO replicas (service_name, replica_id, '
-            'cluster_name, status, is_spot, version, launched_at) '
-            'VALUES (?,?,?,?,?,?,?)',
+            'cluster_name, status, is_spot, version, launched_at, role) '
+            'VALUES (?,?,?,?,?,?,?,?)',
             (service_name, replica_id, cluster_name,
              ReplicaStatus.PROVISIONING.value, int(is_spot), version,
-             time.time()))
+             time.time(), role))
 
 
 def set_replica_status(service_name: str, replica_id: int,
@@ -231,17 +244,18 @@ def get_replicas(service_name: str) -> List[Dict[str, Any]]:
 
 
 def allocate_replica(service_name: str, cluster_prefix: str,
-                     is_spot: bool = False, version: int = 1) -> int:
+                     is_spot: bool = False, version: int = 1,
+                     role: str = 'mixed') -> int:
     """Atomically claim the next replica id and insert its row (ids stay
     monotonic and unique under concurrent scale-ups)."""
     with _conn() as conn:
         conn.execute(
             'INSERT INTO replicas (service_name, replica_id, '
-            'cluster_name, status, is_spot, version, launched_at) '
-            "SELECT ?, COALESCE(MAX(replica_id), 0) + 1, '', ?, ?, ?, ? "
-            'FROM replicas WHERE service_name=?',
+            'cluster_name, status, is_spot, version, launched_at, role) '
+            "SELECT ?, COALESCE(MAX(replica_id), 0) + 1, '', ?, ?, ?, "
+            '?, ? FROM replicas WHERE service_name=?',
             (service_name, ReplicaStatus.PROVISIONING.value,
-             int(is_spot), version, time.time(), service_name))
+             int(is_spot), version, time.time(), role, service_name))
         rid = conn.execute(
             'SELECT MAX(replica_id) FROM replicas WHERE service_name=?',
             (service_name,)).fetchone()[0]

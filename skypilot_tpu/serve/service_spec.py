@@ -2,9 +2,25 @@
 
 Parity: /root/reference/sky/serve/service_spec.py:312 (SkyServiceSpec —
 readiness probe, replica policy, QPS target, spot fallback mix).
+
+Disaggregated serving (`roles:`): replicas can run in independently
+sized prefill / decode / mixed pools, each with its own replica bounds
+and autoscaling targets — a prefill burst grows the prefill pool
+without churning decode replicas (and vice versa):
+
+    service:
+      roles:
+        prefill: {min_replicas: 1, max_replicas: 4,
+                  target_slot_utilization: 0.8}
+        decode:  {min_replicas: 2, max_replicas: 8,
+                  target_qps_per_replica: 10}
+
+Without `roles:` the service is one `mixed` pool driven by the legacy
+top-level fields — nothing changes for existing YAMLs.
 """
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Dict, Optional
 
 from skypilot_tpu import exceptions
@@ -12,6 +28,50 @@ from skypilot_tpu.utils import common_utils
 
 DEFAULT_INITIAL_DELAY_SECONDS = 1200
 DEFAULT_READINESS_PATH = '/'
+
+VALID_ROLES = ('prefill', 'decode', 'mixed')
+
+
+@dataclasses.dataclass
+class RolePool:
+    """Replica bounds + autoscaling targets of ONE role pool.  Carries
+    the same attribute names RequestRateAutoscaler reads off the spec,
+    so a pool drops in wherever a spec did."""
+    role: str
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_qps_per_replica: Optional[float] = None
+    target_slot_utilization: Optional[float] = None
+    upscale_delay_seconds: int = 300
+    downscale_delay_seconds: int = 1200
+    base_ondemand_fallback_replicas: int = 0
+
+    def __post_init__(self) -> None:
+        if self.role not in VALID_ROLES:
+            raise exceptions.InvalidTaskError(
+                f'Unknown replica role {self.role!r}; one of '
+                f'{VALID_ROLES}')
+        if self.min_replicas < 0:
+            raise exceptions.InvalidTaskError(
+                f'{self.role}: min_replicas must be >= 0')
+        if self.max_replicas < max(1, self.min_replicas):
+            raise exceptions.InvalidTaskError(
+                f'{self.role}: max_replicas must be >= '
+                f'max(1, min_replicas)')
+        if (self.target_qps_per_replica is not None and
+                self.target_qps_per_replica <= 0):
+            raise exceptions.InvalidTaskError(
+                f'{self.role}: target_qps_per_replica must be positive')
+        if (self.target_slot_utilization is not None and
+                not 0.0 < self.target_slot_utilization <= 1.0):
+            raise exceptions.InvalidTaskError(
+                f'{self.role}: target_slot_utilization must be in '
+                f'(0, 1]')
+
+    @property
+    def autoscaling_enabled(self) -> bool:
+        return (self.target_qps_per_replica is not None or
+                self.target_slot_utilization is not None)
 
 
 class SkyServiceSpec:
@@ -29,7 +89,9 @@ class SkyServiceSpec:
                  replica_port: int = 8080,
                  base_ondemand_fallback_replicas: int = 0,
                  load_balancing_policy: Optional[str] = None,
-                 update_mode: str = 'rolling') -> None:
+                 update_mode: str = 'rolling',
+                 roles: Optional[Dict[str, Dict[str, Any]]] = None
+                 ) -> None:
         if not readiness_path.startswith('/'):
             raise exceptions.InvalidTaskError(
                 f'readiness path must start with /, got {readiness_path!r}')
@@ -72,11 +134,63 @@ class SkyServiceSpec:
                 f'update_mode must be rolling or blue_green, '
                 f'got {update_mode!r}')
         self.update_mode = update_mode
+        # Disaggregated role pools.  Explicit `roles:` builds one pool
+        # per entry; otherwise the legacy top-level fields ARE the
+        # single 'mixed' pool (so every consumer can just iterate
+        # role_specs).
+        self.explicit_roles = roles is not None
+        if roles:
+            if not isinstance(roles, dict) or not roles:
+                raise exceptions.InvalidTaskError(
+                    'roles must map role name -> pool config')
+            self.role_specs: Dict[str, RolePool] = {}
+            for role, pool_cfg in roles.items():
+                pool_cfg = dict(pool_cfg or {})
+                common_utils.validate_schema_keys(
+                    pool_cfg,
+                    {'replicas', 'min_replicas', 'max_replicas',
+                     'target_qps_per_replica',
+                     'target_slot_utilization'}, f'roles.{role}')
+                if 'replicas' in pool_cfg:
+                    n = int(pool_cfg.pop('replicas'))
+                    pool_cfg.setdefault('min_replicas', n)
+                    pool_cfg.setdefault('max_replicas', n)
+                pool_cfg.setdefault(
+                    'max_replicas',
+                    max(1, int(pool_cfg.get('min_replicas', 1))))
+                self.role_specs[str(role)] = RolePool(
+                    role=str(role),
+                    min_replicas=int(pool_cfg.get('min_replicas', 1)),
+                    max_replicas=int(pool_cfg['max_replicas']),
+                    target_qps_per_replica=(
+                        float(pool_cfg['target_qps_per_replica'])
+                        if pool_cfg.get('target_qps_per_replica')
+                        is not None else None),
+                    target_slot_utilization=(
+                        float(pool_cfg['target_slot_utilization'])
+                        if pool_cfg.get('target_slot_utilization')
+                        is not None else None),
+                    upscale_delay_seconds=upscale_delay_seconds,
+                    downscale_delay_seconds=downscale_delay_seconds)
+            if sum(p.max_replicas for p in self.role_specs.values()) < 1:
+                raise exceptions.InvalidTaskError(
+                    'roles must allow at least one replica in total')
+        else:
+            self.role_specs = {'mixed': RolePool(
+                role='mixed',
+                min_replicas=self.min_replicas,
+                max_replicas=self.max_replicas,
+                target_qps_per_replica=self.target_qps_per_replica,
+                target_slot_utilization=self.target_slot_utilization,
+                upscale_delay_seconds=self.upscale_delay_seconds,
+                downscale_delay_seconds=self.downscale_delay_seconds,
+                base_ondemand_fallback_replicas=(
+                    self.base_ondemand_fallback_replicas))}
 
     @property
     def autoscaling_enabled(self) -> bool:
-        return (self.target_qps_per_replica is not None or
-                self.target_slot_utilization is not None)
+        return any(p.autoscaling_enabled
+                   for p in self.role_specs.values())
 
     # --------------------------------------------------------------- yaml
 
@@ -86,7 +200,7 @@ class SkyServiceSpec:
         common_utils.validate_schema_keys(
             config, {'readiness_probe', 'replica_policy', 'replicas',
                      'replica_port', 'load_balancing_policy',
-                     'update_mode'}, 'service')
+                     'update_mode', 'roles'}, 'service')
         kwargs: Dict[str, Any] = {}
         probe = config.get('readiness_probe')
         if isinstance(probe, str):
@@ -136,6 +250,8 @@ class SkyServiceSpec:
                 config['load_balancing_policy'])
         if config.get('update_mode') is not None:
             kwargs['update_mode'] = str(config['update_mode'])
+        if config.get('roles') is not None:
+            kwargs['roles'] = config['roles']
         return cls(**kwargs)
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -170,6 +286,21 @@ class SkyServiceSpec:
             config['load_balancing_policy'] = self.load_balancing_policy
         if self.update_mode != 'rolling':
             config['update_mode'] = self.update_mode
+        if self.explicit_roles:
+            roles: Dict[str, Any] = {}
+            for role, pool in self.role_specs.items():
+                entry: Dict[str, Any] = {
+                    'min_replicas': pool.min_replicas,
+                    'max_replicas': pool.max_replicas,
+                }
+                if pool.target_qps_per_replica is not None:
+                    entry['target_qps_per_replica'] = (
+                        pool.target_qps_per_replica)
+                if pool.target_slot_utilization is not None:
+                    entry['target_slot_utilization'] = (
+                        pool.target_slot_utilization)
+                roles[role] = entry
+            config['roles'] = roles
         return config
 
     def __repr__(self) -> str:

@@ -288,6 +288,34 @@ class TestInvariants:
         assert invariants.no_injections(
             [{'event': 'chaos_fault_injected', 'ts': 1}])
 
+    def test_handoff_consistency(self):
+        ok = [
+            {'event': 'lb_route', 'request_id': 'r1', 'ts': 1},
+            {'event': 'kv_handoff_start', 'request_id': 'r1', 'ts': 2},
+            {'event': 'kv_handoff_end', 'request_id': 'r1',
+             'status': 'fallback', 'ts': 3},
+            {'event': 'serve_request_done', 'request_id': 'r1',
+             'status': 'ok', 'ts': 4},
+        ]
+        assert invariants.handoff_consistency(ok) == []
+        # Lost request: routed, never done.
+        lost = invariants.handoff_consistency(
+            [{'event': 'lb_route', 'request_id': 'r2', 'ts': 1}])
+        assert lost and 'never completed' in lost[0]
+        # Double-executed.
+        double = invariants.handoff_consistency(
+            [{'event': 'lb_route', 'request_id': 'r3', 'ts': 1},
+             {'event': 'serve_request_done', 'request_id': 'r3',
+              'ts': 2},
+             {'event': 'serve_request_done', 'request_id': 'r3',
+              'ts': 3}])
+        assert double and 'double-executed' in double[0]
+        # Dangling handoff span.
+        dangling = invariants.handoff_consistency(
+            [{'event': 'kv_handoff_start', 'request_id': 'r4',
+              'ts': 1}])
+        assert dangling and 'without kv_handoff_end' in dangling[0]
+
     def test_check_unknown_invariant(self):
         out = invariants.check([], ['nope'])
         assert out and 'unknown invariant' in out[0]
@@ -464,6 +492,22 @@ class TestScenarios:
         assert result.ok, result.violations
         assert result.details['transitions'][-1] == 'READY'
         assert 'NOT_READY' in result.details['transitions']
+        # Router consequence: affinity pinned to the dead replica
+        # re-routed to the survivor (ISSUE 8 satellite).
+        assert result.details['affinity_rerouted'] is True
+
+    def test_handoff_fallback(self, local_infra):
+        """KV handoff denied on the decode replica -> the router falls
+        back to LOCAL prefill; the request completes with identical
+        tokens, the journal proves nothing was lost or double-executed
+        (handoff_consistency), and the next handoff goes through."""
+        result = scenarios_lib.run_scenario('handoff_fallback', seed=23)
+        assert result.ok, (result.violations, result.details)
+        assert result.details['statuses'] == [200, 200]
+        assert result.details['tokens'][0] == result.details['tokens'][1]
+        assert result.details['handoff_ends'] == ['fallback', 'ok']
+        assert [f['site'] for f in result.fault_sequence] == \
+            ['serve.kv_handoff']
 
     def test_page_pool_exhaustion(self, local_infra):
         """KV page-pool denial must degrade to admission backpressure

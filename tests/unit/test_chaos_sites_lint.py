@@ -84,6 +84,7 @@ def test_each_site_instruments_its_documented_layer():
         'jobs.recover': ('jobs/',),
         'serve.replica_probe': ('serve/',),
         'serve.page_pool': ('serve/',),
+        'serve.kv_handoff': ('serve/',),
         'skylet.tick': ('skylet/',),
         'checkpoint.save': ('data/',),
     }

@@ -1,0 +1,292 @@
+"""KV handoff tests (prefill/decode disaggregation, ISSUE 8).
+
+The load-bearing claim: decode-after-handoff is TOKEN-EXACT against
+the same request served on one replica — for bf16(f32) pools and for
+int8 pools (quantize -> dequantize -> requantize across the wire is
+byte-stable).  Plus the failure modes: page-size mismatch, pool
+exhaustion (429 class), dedupe on repeat imports, and the HTTP
+round trip through two model servers + the routing LB.
+"""
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from skypilot_tpu.serve import batching_engine
+from skypilot_tpu.serve import handoff
+
+
+@pytest.fixture(scope='module')
+def tiny():
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import configs
+    from skypilot_tpu.models.transformer import Transformer
+    cfg = configs.get_config('tiny')
+    params = nn.meta.unbox(Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))['params'])
+    return cfg, params
+
+
+def _engine(tiny, quantize_kv=False, kv_pages=48, page_size=8,
+            prefix_caching=True, **kw):
+    cfg, params = tiny
+    return batching_engine.ContinuousBatchingEngine(
+        cfg, params, max_len=64, slots=2, prefill_chunk=16,
+        kv_pages=kv_pages, page_size=page_size,
+        quantize_kv=quantize_kv, prefix_caching=prefix_caching, **kw)
+
+
+def _handoff(src, dst, prompt, page_size=8):
+    payload = src.export_prefill(prompt, page_size=page_size)
+    decoded = handoff.decode_payload(payload)
+    return dst.import_pages(decoded['hashes'], decoded['page_size'],
+                            decoded['k'], decoded['v'],
+                            k_scale=decoded.get('k_scale'),
+                            v_scale=decoded.get('v_scale'))
+
+
+@pytest.mark.parametrize('quantize_kv', [False, True],
+                         ids=['bf16', 'int8'])
+def test_handoff_token_exact_vs_single_replica(tiny, quantize_kv):
+    """Acceptance: export on replica A, import on replica B, generate
+    on B == generating the same request on one untouched replica."""
+    src = _engine(tiny, quantize_kv)
+    dst = _engine(tiny, quantize_kv)
+    ref = _engine(tiny, quantize_kv)
+    try:
+        prompt = list(range(1, 42))           # 41 tokens, 5 full pages
+        imported, cached = _handoff(src, dst, prompt)
+        assert imported == 5 and cached == 0
+        via_handoff = dst.generate(prompt, 8, timeout=120)
+        single = ref.generate(prompt, 8, timeout=120)
+        assert via_handoff == single
+        # The decode replica's admission adopted the imported pages.
+        span = dst.span(via_handoff and dst._spans.recent(1)[0]['request_id'])  # pylint: disable=protected-access
+        assert span['prefix_hit_pages'] == 5
+    finally:
+        for engine in (src, dst, ref):
+            engine.stop()
+
+
+def test_cross_precision_import_dequantizes(tiny):
+    """int8 exporter -> float pool: the import dequantizes once and
+    the request still serves as a prefix hit."""
+    src = _engine(tiny, quantize_kv=True)
+    dst = _engine(tiny, quantize_kv=False)
+    try:
+        prompt = list(range(1, 42))
+        imported, cached = _handoff(src, dst, prompt)
+        assert (imported, cached) == (5, 0)
+        tokens = dst.generate(prompt, 6, timeout=120)
+        assert len(tokens) == 6
+    finally:
+        src.stop()
+        dst.stop()
+
+
+def test_repeat_import_dedupes(tiny):
+    src = _engine(tiny)
+    dst = _engine(tiny)
+    try:
+        prompt = list(range(1, 42))
+        first = _handoff(src, dst, prompt)
+        again = _handoff(src, dst, prompt)
+        assert first == (5, 0)
+        assert again == (0, 5)       # all pages already resident
+        # Pool holds exactly the 5 published pages (pinned), no leak.
+        assert dst._kv.pool.used_count == 5  # pylint: disable=protected-access
+    finally:
+        src.stop()
+        dst.stop()
+
+
+def test_page_size_mismatch_rejected(tiny):
+    src = _engine(tiny, page_size=8)
+    dst = _engine(tiny, page_size=16)
+    try:
+        payload = src.export_prefill(list(range(1, 42)), page_size=8)
+        decoded = handoff.decode_payload(payload)
+        with pytest.raises(handoff.HandoffError, match='page_size'):
+            dst.import_pages(decoded['hashes'], decoded['page_size'],
+                             decoded['k'], decoded['v'])
+    finally:
+        src.stop()
+        dst.stop()
+
+
+def test_import_needs_prefix_cache(tiny):
+    src = _engine(tiny)
+    dst = _engine(tiny, prefix_caching=False)
+    try:
+        with pytest.raises(handoff.HandoffError, match='prefix'):
+            _handoff(src, dst, list(range(1, 42)))
+    finally:
+        src.stop()
+        dst.stop()
+
+
+def test_import_pool_exhaustion_is_backpressure(tiny):
+    """A pool that cannot hold the pages answers the 429 class
+    (QueueFull, reason pages_exhausted) — the router falls back to
+    local prefill, the engine never fails."""
+    src = _engine(tiny)
+    dst = _engine(tiny, kv_pages=4)   # 3 allocatable pages < 5 needed
+    try:
+        payload = src.export_prefill(list(range(1, 42)), page_size=8)
+        decoded = handoff.decode_payload(payload)
+        with pytest.raises(handoff.HandoffError):
+            # 5 pages exceed a 3-page pool outright (structural).
+            dst.import_pages(decoded['hashes'], decoded['page_size'],
+                             decoded['k'], decoded['v'])
+        assert dst.stats()['failed'] is False
+    finally:
+        src.stop()
+        dst.stop()
+
+
+def test_import_exhaustion_while_pages_held(tiny):
+    """Capacity exists but live slots hold the pages: the import gets
+    QueueFull (429 + Retry-After), not an engine error."""
+    src = _engine(tiny)
+    dst = _engine(tiny, kv_pages=12)  # 11 allocatable
+    try:
+        # Occupy most of the pool with a live decode.
+        hold = dst.submit(list(range(1, 50)), 14)   # 8 pages
+        payload = src.export_prefill(list(range(101, 142)),
+                                     page_size=8)
+        decoded = handoff.decode_payload(payload)
+        with pytest.raises(batching_engine.QueueFull) as err:
+            dst.import_pages(decoded['hashes'], decoded['page_size'],
+                             decoded['k'], decoded['v'])
+        assert err.value.retry_after >= 1.0
+        hold.result(timeout=120)
+        assert dst.stats()['failed'] is False
+    finally:
+        src.stop()
+        dst.stop()
+
+
+def test_export_requires_full_page(tiny):
+    src = _engine(tiny)
+    try:
+        with pytest.raises(handoff.HandoffError):
+            src.export_prefill([1, 2, 3], page_size=8)  # < 1 full page
+    finally:
+        src.stop()
+
+
+def test_wire_payload_roundtrip_and_validation():
+    import numpy as np
+    rng = np.random.default_rng(0)
+    k = rng.standard_normal((2, 3, 2, 8, 4)).astype(np.float32)
+    v = rng.standard_normal((2, 3, 2, 8, 4)).astype(np.float32)
+    payload = handoff.encode_payload([11, 22, 33], 8, k, v)
+    decoded = handoff.decode_payload(payload)
+    assert decoded['hashes'] == [11, 22, 33]
+    np.testing.assert_array_equal(decoded['k'], k)
+    np.testing.assert_array_equal(decoded['v'], v)
+    # Version and shape validation.
+    with pytest.raises(handoff.HandoffError, match='version'):
+        handoff.decode_payload(dict(payload, version=99))
+    with pytest.raises(handoff.HandoffError):
+        handoff.decode_payload(dict(payload, hashes=[1]))
+    with pytest.raises(handoff.HandoffError):
+        handoff.decode_payload(dict(payload, k=payload['k'][:-8]))
+
+
+def test_http_handoff_end_to_end_through_router(tiny):
+    """Two model servers (prefill + decode roles) behind the routing
+    LB: a long prompt is exported on the prefill replica, imported on
+    the decode replica, and the answer matches a direct single-server
+    call; the replica stamps the router's span fields."""
+    import requests
+
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.serve import model_server as model_server_lib
+    from skypilot_tpu.serve import router as router_lib
+
+    cfg, params = tiny
+    del cfg, params
+
+    def make_server():
+        return model_server_lib.ModelServer(
+            'tiny', max_len=64, max_batch=2,
+            continuous_batching=True, kv_pages=48, page_size=8,
+            prefill_chunk=16)
+
+    prefill_server = make_server()
+    decode_server = make_server()
+    reference = make_server()
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:1', router=router_lib.Router(threshold=24))
+    shutdowns = []
+    try:
+        p_port, p_stop = model_server_lib.start_background(
+            prefill_server)
+        d_port, d_stop = model_server_lib.start_background(
+            decode_server)
+        shutdowns.extend([p_stop, d_stop])
+        lb.set_replicas([
+            {'url': f'http://127.0.0.1:{p_port}', 'role': 'prefill',
+             'page_size': 8},
+            {'url': f'http://127.0.0.1:{d_port}', 'role': 'decode',
+             'page_size': 8},
+        ])
+        lb_port = lb.start()
+        prompt = list(range(1, 41))
+        resp = requests.post(
+            f'http://127.0.0.1:{lb_port}/generate',
+            json={'prompt_ids': [prompt], 'max_new_tokens': 4},
+            timeout=120)
+        assert resp.status_code == 200
+        tokens = resp.json()['tokens']
+        assert tokens == reference.generate([prompt], 4)
+        # The prefill replica exported, the decode replica served.
+        rid = resp.headers['X-SkyTPU-Request-Id']
+        span = decode_server._engine.span(rid)  # pylint: disable=protected-access
+        assert span is not None
+        assert span['routed_role'] == 'decode'
+        assert span['prefix_hit_pages'] == 4    # 39 // 8 full pages
+        assert span['handoff_ms'] > 0
+        assert prefill_server._engine.span(rid) is None  # pylint: disable=protected-access
+    finally:
+        lb.stop()
+        for stop in shutdowns:
+            stop()
+        for server in (prefill_server, decode_server, reference):
+            server.close()
+
+
+def test_concurrent_imports_thread_safe(tiny):
+    """Imports from several HTTP threads serialize through the worker
+    host-op queue without corrupting pool accounting."""
+    src = _engine(tiny, kv_pages=64)
+    dst = _engine(tiny, kv_pages=64)
+    try:
+        payloads = []
+        for base in (1, 101, 201):
+            prompt = list(range(base, base + 33))   # 4 full pages
+            payloads.append(handoff.decode_payload(
+                src.export_prefill(prompt, page_size=8)))
+        results = []
+
+        def worker(decoded):
+            results.append(dst.import_pages(
+                decoded['hashes'], decoded['page_size'],
+                decoded['k'], decoded['v']))
+
+        threads = [threading.Thread(target=worker, args=(p,))
+                   for p in payloads]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert sorted(r[0] for r in results) == [4, 4, 4]
+        assert dst._kv.pool.used_count == 12  # pylint: disable=protected-access
+    finally:
+        src.stop()
+        dst.stop()

@@ -1,0 +1,318 @@
+"""Router tests: role dispatch, prefix affinity, same-role failover,
+least-loaded selection — plus the LB's HTTP-level 429 retry path
+(ISSUE 8 satellite: retry once on an alternate same-role replica
+instead of relaying backpressure to the client)."""
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+
+import pytest
+import requests
+
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import router as router_lib
+
+
+def _endpoints(*specs):
+    return [router_lib.ReplicaEndpoint(**s) for s in specs]
+
+
+class TestRouterRoles:
+
+    def test_short_prompt_goes_to_decode_pool(self):
+        router = router_lib.Router(threshold=64)
+        router.set_endpoints(_endpoints(
+            {'url': 'http://p', 'role': 'prefill'},
+            {'url': 'http://d', 'role': 'decode'}))
+        decision = router.route(None, prompt_len=8)
+        assert decision.url == 'http://d'
+        assert decision.role == 'decode'
+        assert decision.handoff_source is None
+
+    def test_long_prompt_gets_prefill_handoff_source(self):
+        router = router_lib.Router(threshold=64)
+        router.set_endpoints(_endpoints(
+            {'url': 'http://p', 'role': 'prefill'},
+            {'url': 'http://d', 'role': 'decode', 'page_size': 8}))
+        decision = router.route(None, prompt_len=128)
+        assert decision.url == 'http://d'
+        assert decision.handoff_source == 'http://p'
+        assert decision.page_size == 8
+
+    def test_no_handoff_without_prefill_pool(self):
+        router = router_lib.Router(threshold=64)
+        router.set_endpoints(_endpoints(
+            {'url': 'http://a'}, {'url': 'http://b'}))
+        decision = router.route(None, prompt_len=128)
+        assert decision.url in ('http://a', 'http://b')
+        assert decision.handoff_source is None
+
+    def test_decode_pool_falls_back_to_mixed_then_any(self):
+        router = router_lib.Router(threshold=64)
+        router.set_endpoints(_endpoints(
+            {'url': 'http://p', 'role': 'prefill'},
+            {'url': 'http://m', 'role': 'mixed'}))
+        assert router.route(None, 8).url == 'http://m'
+        # Prefill-only fleet still serves rather than 503.
+        router.set_endpoints(_endpoints(
+            {'url': 'http://p', 'role': 'prefill'}))
+        decision = router.route(None, 128)
+        assert decision.url == 'http://p'
+        assert decision.handoff_source is None  # target IS prefill
+
+    def test_least_loaded_within_pool(self):
+        router = router_lib.Router(threshold=64)
+        router.set_endpoints(_endpoints(
+            {'url': 'http://d1', 'role': 'decode'},
+            {'url': 'http://d2', 'role': 'decode'}))
+        router.acquire('http://d1')
+        assert router.route(None, 8).url == 'http://d2'
+        router.acquire('http://d2')
+        router.acquire('http://d2')
+        assert router.route(None, 8).url == 'http://d1'
+        router.release('http://d2')
+        router.release('http://d2')
+        router.release('http://d2')  # over-release never goes negative
+        assert router.route(None, 8).url == 'http://d2'
+
+    def test_controller_load_breaks_ties(self):
+        router = router_lib.Router(threshold=64)
+        router.set_endpoints(_endpoints(
+            {'url': 'http://d1', 'role': 'decode', 'load': 0.9},
+            {'url': 'http://d2', 'role': 'decode', 'load': 0.1}))
+        assert router.route(None, 8).url == 'http://d2'
+
+    def test_no_replicas_routes_none(self):
+        router = router_lib.Router(threshold=64)
+        assert router.route(None, 8).url is None
+
+
+class TestRouterAffinity:
+
+    def test_prefix_affinity_sticks_across_requests(self):
+        router = router_lib.Router(threshold=1000)
+        router.set_endpoints(_endpoints(
+            {'url': 'http://d1', 'role': 'decode'},
+            {'url': 'http://d2', 'role': 'decode'}))
+        key = router_lib.prompt_key(prompt_ids=[1, 2, 3])
+        first = router.route(key, 8)
+        assert first.affinity == 'miss'
+        router.record_affinity(key, first.url)
+        # Load the pinned replica: affinity must still win.
+        router.acquire(first.url)
+        router.acquire(first.url)
+        again = router.route(key, 8)
+        assert again.affinity == 'hit'
+        assert again.url == first.url
+        # A different prefix spreads by load as usual.
+        other = router.route(router_lib.prompt_key(
+            prompt_ids=[9, 9, 9]), 8)
+        assert other.url != first.url
+
+    def test_affinity_reroutes_when_pinned_replica_dies(self):
+        router = router_lib.Router(threshold=1000)
+        router.set_endpoints(_endpoints(
+            {'url': 'http://d1', 'role': 'decode'},
+            {'url': 'http://d2', 'role': 'decode'}))
+        key = router_lib.prompt_key(prompt_ids=[5, 6, 7])
+        router.record_affinity(key, 'http://d1')
+        router.set_endpoints(_endpoints(
+            {'url': 'http://d2', 'role': 'decode'}))
+        decision = router.route(key, 8)
+        assert decision.url == 'http://d2'
+        assert decision.affinity == 'miss'
+
+    def test_affinity_capacity_bounded(self):
+        router = router_lib.Router(threshold=1000, affinity_capacity=2)
+        router.set_endpoints(_endpoints(
+            {'url': 'http://d', 'role': 'decode'}))
+        keys = [router_lib.prompt_key(prompt_ids=[i]) for i in range(3)]
+        for key in keys:
+            router.record_affinity(key, 'http://d')
+        assert router.affinity_target(keys[0]) is None  # LRU-evicted
+        assert router.affinity_target(keys[2]) == 'http://d'
+
+    def test_prompt_key_bounded_and_distinct(self):
+        long_a = router_lib.prompt_key(prompt_ids=list(range(500)))
+        long_b = router_lib.prompt_key(
+            prompt_ids=list(range(500)) + [7])
+        assert long_a == long_b  # same head
+        assert router_lib.prompt_key(prompt_ids=[1]) != \
+            router_lib.prompt_key(prompt_ids=[2])
+        assert router_lib.prompt_key(text='hello') == \
+            router_lib.prompt_key(text='hello')
+        assert router_lib.prompt_key() is None
+
+    def test_alternates_same_role_only(self):
+        router = router_lib.Router(threshold=64)
+        router.set_endpoints(_endpoints(
+            {'url': 'http://d1', 'role': 'decode'},
+            {'url': 'http://d2', 'role': 'decode'},
+            {'url': 'http://p', 'role': 'prefill'}))
+        assert router.alternates('http://d1') == ['http://d2']
+        assert router.alternates('http://d1',
+                                 exclude=['http://d2']) == []
+
+    def test_ensure_urls_keeps_roles_for_known(self):
+        router = router_lib.Router(threshold=64)
+        router.set_endpoints(_endpoints(
+            {'url': 'http://p', 'role': 'prefill'}))
+        router.ensure_urls(['http://p', 'http://new'])
+        roles = {e.url: e.role for e in router.endpoints()}
+        assert roles == {'http://p': 'prefill', 'http://new': 'mixed'}
+
+
+class _Replica(http.server.ThreadingHTTPServer):
+    """Scripted replica: answers /generate per the queued behaviors
+    ('ok' or 'busy' -> 429 + Retry-After)."""
+
+    def __init__(self, behaviors):
+        super().__init__(('127.0.0.1', 0), _Handler)
+        self.behaviors = list(behaviors)
+        self.hits = 0
+
+    @property
+    def url(self):
+        return f'http://127.0.0.1:{self.server_address[1]}'
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    protocol_version = 'HTTP/1.1'
+
+    def log_message(self, *args):
+        del args
+
+    def do_POST(self):
+        length = int(self.headers.get('Content-Length', 0))
+        self.rfile.read(length)
+        server = self.server
+        server.hits += 1
+        behavior = (server.behaviors.pop(0) if server.behaviors
+                    else 'ok')
+        if behavior == 'busy':
+            body = json.dumps(
+                {'error': 'KV page pool exhausted '
+                          '(pages_exhausted); retry later'}).encode()
+            self.send_response(429)
+            self.send_header('Retry-After', '0')
+        else:
+            body = json.dumps({'tokens': [[1, 2]],
+                               'port': server.server_address[1],
+                               'role': self.headers.get(
+                                   'X-SkyTPU-Routed-Role'),
+                               'affinity': self.headers.get(
+                                   'X-SkyTPU-Affinity')}).encode()
+            self.send_response(200)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def replica_pair():
+    servers = [_Replica([]), _Replica([])]
+    for server in servers:
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+    yield servers
+    for server in servers:
+        server.shutdown()
+
+
+def _start_lb(replicas, **router_kw):
+    balancer = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:1',
+        router=router_lib.Router(**router_kw))
+    balancer.set_replicas(replicas)
+    port = balancer.start()
+    return balancer, port
+
+
+class TestLbRetryPath:
+
+    def test_429_retries_once_on_same_role_sibling(self, replica_pair):
+        first, second = replica_pair
+        first.behaviors.append('busy')
+        balancer, port = _start_lb(
+            [{'url': first.url, 'role': 'decode'},
+             {'url': second.url, 'role': 'decode'}],
+            threshold=1000)
+        try:
+            # Pin the first replica via affinity so the 429 provably
+            # comes from it, then the retry lands on the sibling.
+            balancer.router.record_affinity(
+                router_lib.prompt_key(prompt_ids=[1, 2, 3]), first.url)
+            resp = requests.post(
+                f'http://127.0.0.1:{port}/generate',
+                json={'prompt_ids': [[1, 2, 3]],
+                      'max_new_tokens': 2}, timeout=10)
+            assert resp.status_code == 200
+            assert resp.json()['port'] == second.server_address[1]
+            assert first.hits == 1 and second.hits == 1
+        finally:
+            balancer.stop()
+
+    def test_429_relayed_when_no_alternate(self, replica_pair):
+        first, _ = replica_pair
+        first.behaviors.extend(['busy', 'busy'])
+        balancer, port = _start_lb(
+            [{'url': first.url, 'role': 'decode'}], threshold=1000)
+        try:
+            resp = requests.post(
+                f'http://127.0.0.1:{port}/generate',
+                json={'prompt_ids': [[1, 2, 3]],
+                      'max_new_tokens': 2}, timeout=10)
+            assert resp.status_code == 429
+            assert resp.headers.get('Retry-After') is not None
+        finally:
+            balancer.stop()
+
+    def test_dead_replica_fails_over_with_buffered_body(
+            self, replica_pair):
+        _, second = replica_pair
+        balancer, port = _start_lb(
+            [{'url': 'http://127.0.0.1:9', 'role': 'decode'},
+             {'url': second.url, 'role': 'decode'}], threshold=1000)
+        try:
+            balancer.router.record_affinity(
+                router_lib.prompt_key(prompt_ids=[1]),
+                'http://127.0.0.1:9')
+            resp = requests.post(
+                f'http://127.0.0.1:{port}/generate',
+                json={'prompt_ids': [[1]], 'max_new_tokens': 2},
+                timeout=10)
+            assert resp.status_code == 200
+            assert resp.json()['port'] == second.server_address[1]
+        finally:
+            balancer.stop()
+
+    def test_routed_role_and_affinity_headers_forwarded(
+            self, replica_pair):
+        first, _ = replica_pair
+        balancer, port = _start_lb(
+            [{'url': first.url, 'role': 'decode'}], threshold=1000)
+        try:
+            url = f'http://127.0.0.1:{port}/generate'
+            body = {'prompt_ids': [[4, 5, 6]], 'max_new_tokens': 2}
+            one = requests.post(url, json=body, timeout=10).json()
+            assert one['role'] == 'decode'
+            assert one['affinity'] == 'miss'
+            two = requests.post(url, json=body, timeout=10).json()
+            assert two['affinity'] == 'hit'
+        finally:
+            balancer.stop()
+
+    def test_unparseable_body_still_routes(self, replica_pair):
+        first, _ = replica_pair
+        balancer, port = _start_lb(
+            [{'url': first.url, 'role': 'decode'}], threshold=1000)
+        try:
+            resp = requests.post(
+                f'http://127.0.0.1:{port}/generate',
+                data=b'this is not json', timeout=10)
+            assert resp.status_code == 200
+        finally:
+            balancer.stop()

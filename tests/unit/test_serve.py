@@ -73,6 +73,58 @@ class TestServiceSpec:
         with pytest.raises(Exception):
             SkyServiceSpec(min_replicas=3, max_replicas=1)
 
+    def test_role_pools_round_trip(self):
+        spec = SkyServiceSpec.from_yaml_config({
+            'roles': {
+                'prefill': {'min_replicas': 1, 'max_replicas': 4,
+                            'target_slot_utilization': 0.8},
+                'decode': {'replicas': 2,
+                           'target_qps_per_replica': 10},
+            },
+        })
+        assert set(spec.role_specs) == {'prefill', 'decode'}
+        assert spec.role_specs['prefill'].max_replicas == 4
+        assert spec.role_specs['decode'].min_replicas == 2
+        assert spec.autoscaling_enabled
+        spec2 = SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+        assert spec2.role_specs['prefill'].target_slot_utilization \
+            == 0.8
+        assert spec2.role_specs['decode'].target_qps_per_replica == 10
+
+    def test_default_is_one_mixed_pool(self):
+        spec = _spec(min_replicas=2, max_replicas=5,
+                     target_qps_per_replica=3.0)
+        assert set(spec.role_specs) == {'mixed'}
+        pool = spec.role_specs['mixed']
+        assert pool.min_replicas == 2 and pool.max_replicas == 5
+        assert pool.target_qps_per_replica == 3.0
+        assert not spec.explicit_roles
+
+    def test_bad_role_rejected(self):
+        with pytest.raises(Exception):
+            SkyServiceSpec(roles={'gpu': {'replicas': 1}})
+
+    def test_per_role_autoscalers_independent(self):
+        spec = SkyServiceSpec.from_yaml_config({
+            'roles': {
+                'prefill': {'min_replicas': 1, 'max_replicas': 4,
+                            'target_qps_per_replica': 1.0},
+                'decode': {'min_replicas': 1, 'max_replicas': 4,
+                           'target_qps_per_replica': 1.0},
+            },
+        })
+        prefill = autoscalers.make_autoscaler(spec, role='prefill')
+        decode = autoscalers.make_autoscaler(spec, role='decode')
+        prefill.upscale_delay_seconds = 0
+        now = 1000.0
+        # A prefill burst scales ONLY the prefill pool.
+        prefill.collect_request_information([now] * 180, now)
+        decode.collect_request_information([now], now)
+        assert prefill.evaluate_scaling(now + 1) \
+            .target_num_replicas >= 3
+        assert decode.evaluate_scaling(now + 1) \
+            .target_num_replicas == 1
+
 
 class TestAutoscaler:
 
