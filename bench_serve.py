@@ -531,6 +531,62 @@ def _run_disagg_config(*, replica_urls, roles, page_size, threshold,
     }
 
 
+def _sp_prefill_probe(*, smoke: bool, model: str = 'tiny'
+                      ) -> Dict[str, Any]:
+    """Long-context prefill scaling with host count (ISSUE 9).
+
+    Each host count runs `python -m skypilot_tpu.serve.slice_replica
+    --bench-prefill` in its OWN subprocess pinned to `hosts x
+    cores_per_host` CPU cores — the local stand-in for "each host
+    brings its own chips": the sequence axis splits the quadratic
+    attention across the slice, and the extra hosts' cores are what
+    turn that split into wall-clock.  The pinned number is
+    prefill_speedup_Nx = t(1 host) / t(N hosts); the tier-1 smoke
+    floor-asserts the 2-host ratio."""
+    import os
+    import subprocess
+    import sys
+
+    prompt_len = 3072 if smoke else 8192
+    host_counts = [1, 2] if smoke else [1, 2, 4]
+    try:
+        cores = sorted(os.sched_getaffinity(0))
+    except AttributeError:
+        cores = []
+    cores_per_host = max(1, len(cores) // max(host_counts)) \
+        if cores else 0
+    results: Dict[int, Dict[str, Any]] = {}
+    for hosts in host_counts:
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        env.pop('PALLAS_AXON_POOL_IPS', None)
+        env['XLA_FLAGS'] = (
+            f'--xla_force_host_platform_device_count={hosts}')
+        preexec = None
+        if cores_per_host and hasattr(os, 'sched_setaffinity'):
+            pinned = set(cores[:hosts * cores_per_host])
+            preexec = (lambda p=pinned:
+                       os.sched_setaffinity(0, p))  # noqa: E731
+        proc = subprocess.run(
+            [sys.executable, '-m',
+             'skypilot_tpu.serve.slice_replica', '--bench-prefill',
+             '--num-hosts', str(hosts), '--sequence', str(hosts),
+             '--prompt-len', str(prompt_len), '--model', model,
+             '--iters', '3' if smoke else '5'],
+            env=env, capture_output=True, text=True, timeout=600,
+            preexec_fn=preexec, check=True)
+        results[hosts] = json.loads(proc.stdout.strip().splitlines()[-1])
+    base = results[1]['prefill_s']
+    out: Dict[str, Any] = {
+        'prompt_len': prompt_len,
+        'cores_per_host': cores_per_host,
+        'per_hosts': {str(h): r for h, r in results.items()},
+    }
+    for hosts in host_counts[1:]:
+        out[f'prefill_speedup_{hosts}x'] = round(
+            base / max(results[hosts]['prefill_s'], 1e-9), 3)
+    return out
+
+
 def _spawn_replica(port: int, *, max_len: int, slots: int,
                    kv_pages: int, page_size: int, prefill_chunk: int,
                    cpus=None):
@@ -649,6 +705,22 @@ def _disagg_probe(*, smoke: bool, vocab: int, seed: int
         export.raise_for_status()
         requests.post(f'{urls[1]}/kv_import', json=export.json(),
                       timeout=300).raise_for_status()
+        # Bytes-on-wire: the SAME export over the binary octet-stream
+        # frame vs the JSON/base64 payload (the LB ships binary by
+        # default; the ratio is the drop the binary wire buys).
+        export_bin = requests.post(
+            f'{urls[0]}/prefill_export',
+            json={'prompt_ids': warm_long,
+                  'page_size': knobs['page_size'],
+                  'wire': 'binary'}, timeout=300)
+        export_bin.raise_for_status()
+        handoff_wire = {
+            'json_bytes': len(export.content),
+            'binary_bytes': len(export_bin.content),
+            'bytes_ratio': round(
+                len(export_bin.content) / max(len(export.content), 1),
+                4),
+        }
         mixed = _run_disagg_config(replica_urls=urls,
                                    roles=('mixed', 'mixed'), **knobs)
         disagg = _run_disagg_config(replica_urls=urls,
@@ -673,6 +745,7 @@ def _disagg_probe(*, smoke: bool, vocab: int, seed: int
         'mixed': mixed,
         'disaggregated': disagg,
         'itl_p99_ratio_vs_mixed': round(ratio, 4),
+        'handoff_wire': handoff_wire,
     }
 
 
@@ -706,6 +779,10 @@ def main() -> None:
                         help='Skip the prefill/decode disaggregation '
                              'A/B (two replicas + routing LB over '
                              'real HTTP).')
+    parser.add_argument('--skip-sp-probe', action='store_true',
+                        help='Skip the multi-host sequence-parallel '
+                             'long-context prefill scaling probe '
+                             '(subprocess per host count).')
     parser.add_argument('--page-size', type=int, default=16,
                         help='KV page size for the paged probes.')
     parser.add_argument('--prefix-len', type=int, default=256,
@@ -917,6 +994,10 @@ def main() -> None:
     if not args.skip_disagg_probe:
         payload['disaggregation'] = _disagg_probe(
             smoke=args.smoke, vocab=vocab, seed=args.seed)
+
+    if not args.skip_sp_probe:
+        payload['sp_prefill'] = _sp_prefill_probe(smoke=args.smoke,
+                                                  model=args.model)
 
     line = json.dumps(payload)
     print(line)

@@ -76,6 +76,14 @@ SITES: Dict[str, str] = {
         'engine must degrade to admission backpressure / HTTP 429, '
         'never an engine failure); "delay" slows admissions (running '
         'decodes must keep their bounded ITL)',
+    'serve.rank_exec':
+        'slice-replica rank command execution (serve/coordinator.py '
+        '_execute — the gang protocol of a multi-host serving '
+        'replica) — a raise is that host dying mid-command: the '
+        'coordinator marks the rank dead, the replica fails AS A '
+        'UNIT (/health 503 with slice.degraded), the controller '
+        'retires and replaces it, and the LB re-routes to surviving '
+        'replicas with zero lost requests',
     'serve.kv_handoff':
         'KV page handoff import (serve/batching_engine.py '
         'import_pages, the decode side of prefill/decode '

@@ -21,6 +21,12 @@ Scenarios (CLI: ``sky chaos list`` / ``sky chaos run <name>``):
                            flaps NOT_READY and returns to READY; the
                            router re-pins prefix affinity off a dead
                            replica
+- ``replica_rank_death``   one rank of a 2-host slice replica dies →
+                           the replica fails AS A UNIT (503 +
+                           slice.degraded), the LB re-routes with zero
+                           lost requests, the controller probe retires
+                           it (``replica_rank_death_rebuild`` adds the
+                           full replacement roundtrip)
 - ``handoff_fallback``     KV handoff import denied → the router falls
                            back to local prefill on the decode
                            replica; journal proves no request was lost
@@ -917,6 +923,235 @@ def handoff_fallback(seed: int) -> ScenarioResult:
         decode_server.close()
     return _finish('handoff_fallback', seed, t0, serve_events,
                    ['handoff_consistency'], extra, details)
+
+
+def _run_replica_rank_death(name: str, seed: int,
+                            rebuild: bool) -> ScenarioResult:
+    """Shared body of replica_rank_death (fast: kill -> LB re-route ->
+    retire) and replica_rank_death_rebuild (adds the slow full-rebuild
+    roundtrip: a fresh slice replica takes the dead one's place and
+    serves)."""
+    import requests  # pylint: disable=import-outside-toplevel
+
+    import skypilot_tpu as sky  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import load_balancer as lb_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import model_server as model_server_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import replica_managers  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import router as router_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import serve_state  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import service_spec  # pylint: disable=import-outside-toplevel
+
+    # Kill rank 1 of the slice replica on the FIRST coordinated
+    # broadcast after arming: per broadcast, rank 0 executes inline
+    # (site call 1) then rank 1 (call 2) — nth=2 is deterministic.
+    plan = faults_lib.FaultPlan(
+        seed=seed, name=name,
+        faults=[faults_lib.Fault(site='serve.rank_exec',
+                                 effect='raise', where={'rank': 1},
+                                 nth=[2], max_times=1)])
+    t0 = time.time()
+    extra: List[str] = []
+    details: Dict[str, Any] = {}
+    serve_journal = events_lib.get_journal(
+        os.path.join(events_lib.journal_root(), 'serve.jsonl'))
+
+    def make_slice():
+        return model_server_lib.ModelServer(
+            'tiny', max_len=64, max_batch=2, continuous_batching=True,
+            prefill_chunk=16, num_hosts=2)
+
+    slice_server = make_slice()
+    solo_server = model_server_lib.ModelServer(
+        'tiny', max_len=64, max_batch=2, continuous_batching=True,
+        prefill_chunk=16)
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:1', router=router_lib.Router(threshold=10_000))
+    shutdowns = []
+    serve_events: List[Dict[str, Any]] = []
+    try:
+        s_port, s_stop = model_server_lib.start_background(slice_server)
+        shutdowns.append(s_stop)
+        b_port, b_stop = model_server_lib.start_background(solo_server)
+        shutdowns.append(b_stop)
+        slice_url = f'http://127.0.0.1:{s_port}'
+        solo_url = f'http://127.0.0.1:{b_port}'
+        lb.set_replicas([{'url': slice_url, 'role': 'mixed'},
+                         {'url': solo_url, 'role': 'mixed'}])
+        lb_port = lb.start()
+        base = f'http://127.0.0.1:{lb_port}'
+
+        def gen(prompt, timeout=120):
+            return requests.post(
+                f'{base}/generate',
+                json={'prompt_ids': [prompt], 'max_new_tokens': 4},
+                timeout=timeout)
+
+        # Phase 1 (no faults armed): pin a session onto the SLICE
+        # replica via prefix affinity, so the kill provably lands in
+        # the path of live traffic.  Idle least-loaded ranking is
+        # deterministic by url, so if the organic pin landed on the
+        # solo replica, pin the session key explicitly (the router's
+        # documented affinity API) and verify the next request really
+        # is an affinity HIT on the slice replica.
+        probe_prompts = [[p, p + 1, p + 2, p + 3, 9, 9]
+                         for p in (10, 20, 30, 40)]
+        slice_prompt = None
+        warm_statuses = []
+        for prompt in probe_prompts:
+            warm_statuses.append(gen(prompt).status_code)
+            key = router_lib.prompt_key(prompt_ids=prompt)
+            if lb.router.affinity_target(key) == slice_url:
+                slice_prompt = prompt
+                break
+        details['warm_statuses'] = warm_statuses
+        _expect(all(s == 200 for s in warm_statuses),
+                f'warmup requests all 200 (got {warm_statuses})', extra)
+        if slice_prompt is None:
+            slice_prompt = probe_prompts[0]
+            lb.router.record_affinity(
+                router_lib.prompt_key(prompt_ids=slice_prompt),
+                slice_url)
+        pinned = lb.router.route(
+            router_lib.prompt_key(prompt_ids=slice_prompt),
+            len(slice_prompt))
+        _expect(pinned.url == slice_url and pinned.affinity == 'hit',
+                f'the session is pinned to the slice replica '
+                f'(got {pinned.affinity}/{pinned.url})', extra)
+
+        # Phase 2 (fault armed): the next coordinated broadcast kills
+        # rank 1 mid-admission.  Every request must still come back
+        # 200 — the LB's same-role 5xx retry re-routes onto the
+        # surviving replica while the slice is down.
+        with _armed(plan):
+            statuses = [gen(slice_prompt).status_code
+                        for _ in range(4)]
+            details['statuses_during_death'] = statuses
+            _expect(all(s == 200 for s in statuses),
+                    f'zero lost requests across the rank death '
+                    f'(got {statuses})', extra)
+            health = requests.get(slice_url + '/', timeout=10)
+            details['slice_health_status'] = health.status_code
+            payload = health.json()
+            details['slice'] = payload.get('slice')
+            _expect(health.status_code == 503,
+                    f'degraded slice fails its readiness probe '
+                    f'(got {health.status_code})', extra)
+            _expect(bool((payload.get('slice') or {}).get('degraded')),
+                    'health payload carries slice.degraded', extra)
+            _expect((payload.get('slice') or {}).get(
+                'dead_ranks') == [1], 'rank 1 is the dead rank', extra)
+
+            # Controller-side consequence: the probe retires a
+            # degraded slice as a UNIT (NOT_READY -> torn down,
+            # FAILED_PROBING) instead of waiting out initial_delay.
+            service = f'chaos-rankdeath-{seed}'
+            spec = service_spec.SkyServiceSpec(
+                initial_delay_seconds=120, readiness_timeout_seconds=5)
+            task = sky.Task(name='chaos-rankdeath', run='sleep 1')
+            task.set_resources(sky.Resources(cloud='local'))
+            serve_state.add_service(service, spec_json={},
+                                    task_yaml_path='')
+            manager = replica_managers.ReplicaManager(service, spec,
+                                                      task)
+            replica_id = serve_state.allocate_replica(
+                service, service, num_hosts=2)
+            serve_state.set_replica_status(
+                service, replica_id, serve_state.ReplicaStatus.READY,
+                url=slice_url)
+            manager._probe_one(  # pylint: disable=protected-access
+                serve_state.get_replicas(service)[0])
+            retired = serve_state.get_replicas(service)[0]['status']
+            details['retired_status'] = retired
+            _expect(retired == 'FAILED_PROBING',
+                    f'degraded slice retired as a unit '
+                    f'(got {retired})', extra)
+
+            # The LB drops the dead replica (as the controller sync
+            # would after the retire) and the pinned session re-routes.
+            lb.set_replicas([{'url': solo_url, 'role': 'mixed'}])
+            after = gen(slice_prompt).status_code
+            details['status_after_retire'] = after
+            _expect(after == 200,
+                    'pinned session re-routed to the survivor', extra)
+
+            if rebuild:
+                # Full rebuild roundtrip: a FRESH slice replica (the
+                # controller's replacement launch) joins the fleet and
+                # serves the same session again.
+                shutdowns.append(None)  # placeholder replaced below
+                rebuilt = make_slice()
+                r_port, r_stop = model_server_lib.start_background(
+                    rebuilt)
+                shutdowns[-1] = r_stop
+                rebuilt_url = f'http://127.0.0.1:{r_port}'
+                # Its probe goes READY (fresh gang, no dead ranks)...
+                new_id = serve_state.allocate_replica(
+                    service, service, num_hosts=2)
+                serve_state.set_replica_status(
+                    service, new_id,
+                    serve_state.ReplicaStatus.STARTING,
+                    url=rebuilt_url)
+                manager._probe_one(  # pylint: disable=protected-access
+                    [r for r in serve_state.get_replicas(service)
+                     if r['replica_id'] == new_id][0])
+                rebuilt_status = [
+                    r for r in serve_state.get_replicas(service)
+                    if r['replica_id'] == new_id][0]['status']
+                details['rebuilt_status'] = rebuilt_status
+                _expect(rebuilt_status == 'READY',
+                        f'rebuilt slice probes READY '
+                        f'(got {rebuilt_status})', extra)
+                # ...and serves through the LB.
+                lb.set_replicas([
+                    {'url': rebuilt_url, 'role': 'mixed'},
+                    {'url': solo_url, 'role': 'mixed'}])
+                rebuilt_statuses = [gen(slice_prompt).status_code
+                                    for _ in range(3)]
+                details['rebuilt_statuses'] = rebuilt_statuses
+                _expect(all(s == 200 for s in rebuilt_statuses),
+                        f'rebuilt fleet serves (got '
+                        f'{rebuilt_statuses})', extra)
+                health = requests.get(rebuilt_url + '/', timeout=10)
+                _expect(health.status_code == 200,
+                        'rebuilt slice is healthy', extra)
+                rebuilt.close()
+            serve_events = _since(serve_journal, t0)
+    finally:
+        lb.stop()
+        for stop in shutdowns:
+            if stop is not None:
+                stop()
+        slice_server.close()
+        solo_server.close()
+    injected = [e for e in _since(injector.chaos_journal(), t0)
+                if e.get('event') == 'chaos_fault_injected']
+    _expect(len(injected) == 1,
+            f'exactly one rank-death fault fired (got {len(injected)})',
+            extra)
+    return _finish(name, seed, t0, serve_events,
+                   ['handoff_consistency'], extra, details)
+
+
+@_register(
+    'replica_rank_death',
+    'one rank of a 2-host slice replica dies mid-service (raise on '
+    'serve.rank_exec) -> the replica fails AS A UNIT (503 + '
+    'slice.degraded), the LB re-routes every request to the surviving '
+    'replica with zero lost requests (journal-verified), and the '
+    'controller probe retires the slice for replacement')
+def replica_rank_death(seed: int) -> ScenarioResult:
+    return _run_replica_rank_death('replica_rank_death', seed,
+                                   rebuild=False)
+
+
+@_register(
+    'replica_rank_death_rebuild',
+    'replica_rank_death plus the full rebuild roundtrip: a fresh slice '
+    'replica takes the dead one\'s place, probes READY, and serves the '
+    'same pinned session through the LB')
+def replica_rank_death_rebuild(seed: int) -> ScenarioResult:
+    return _run_replica_rank_death('replica_rank_death_rebuild', seed,
+                                   rebuild=True)
 
 
 @_register(

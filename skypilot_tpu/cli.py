@@ -780,10 +780,20 @@ def serve_status(service_names, show_metrics):
     for r in records:
         ready = sum(1 for rep in r['replicas']
                     if rep['status'] == 'READY')
+        # Multi-host slice replicas: surface the fleet's host footprint
+        # (sum of per-replica num_hosts; '2x2' reads "2 replicas x 2
+        # hosts" when uniform, else the plain total).
+        host_counts = [rep.get('num_hosts') or 1 for rep in r['replicas']]
+        if host_counts and len(set(host_counts)) == 1:
+            hosts = (f'{len(host_counts)}x{host_counts[0]}'
+                     if host_counts[0] > 1 else str(len(host_counts)))
+        else:
+            hosts = str(sum(host_counts)) if host_counts else '-'
         rows.append((r['name'], r['status'], r['version'],
-                     f'{ready}/{len(r["replicas"])}',
+                     f'{ready}/{len(r["replicas"])}', hosts,
                      r.get('load_balancer_port') or '-'))
-    _print_table(['NAME', 'STATUS', 'VERSION', 'READY', 'LB PORT'], rows)
+    _print_table(['NAME', 'STATUS', 'VERSION', 'READY', 'HOSTS',
+                  'LB PORT'], rows)
     if show_metrics:
         _serve_metrics_table(records)
 
@@ -829,14 +839,15 @@ def _serve_metrics_table(records) -> None:
                 continue
             url = rep['url']
             role = rep.get('role') or 'mixed'
+            num_hosts = rep.get('num_hosts') or 1
             try:
                 resp = requests.get(url + '/metrics', timeout=5)
                 resp.raise_for_status()
                 parsed = metrics_lib.parse_exposition(resp.text)
             except (requests.RequestException, ValueError) as e:
                 rows.append((r['name'], rep['replica_id'], url, role,
-                             f'scrape failed: {e}', '-', '-', '-', '-',
-                             '-', '-'))
+                             num_hosts, f'scrape failed: {e}', '-',
+                             '-', '-', '-', '-', '-'))
                 continue
 
             def total(name, parsed=parsed):
@@ -869,7 +880,7 @@ def _serve_metrics_table(records) -> None:
             else:
                 affinity = '-'
             rows.append((
-                r['name'], rep['replica_id'], url, role,
+                r['name'], rep['replica_id'], url, role, num_hosts,
                 f'{total("skytpu_engine_decode_tokens_per_s"):g}',
                 f'{busy}/{slots}',
                 pages,
@@ -884,8 +895,8 @@ def _serve_metrics_table(records) -> None:
         click.echo('No READY replicas to scrape.')
         return
     click.echo('')
-    _print_table(['SERVICE', 'REPLICA', 'URL', 'ROLE', 'TOK/S',
-                  'SLOTS', 'KV PAGES', 'AFFINITY', 'QUEUE',
+    _print_table(['SERVICE', 'REPLICA', 'URL', 'ROLE', 'HOSTS',
+                  'TOK/S', 'SLOTS', 'KV PAGES', 'AFFINITY', 'QUEUE',
                   'TTFT p50/p99', 'ITL p50/p99'], rows)
 
 

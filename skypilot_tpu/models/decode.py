@@ -275,6 +275,90 @@ def decode_step(cfg: ModelConfig, params, token, cache):
                                use_flash=False)
 
 
+def prefill_sp(cfg: ModelConfig, params, tokens, *, mesh, max_len: int,
+               axis_name: str = 'sequence'):
+    """Sequence-parallel full-prompt prefill for multi-host slices.
+
+    tokens [1, S] (S divisible by the mesh's sequence-axis size) ->
+    a private prefill cache {'k', 'v', 'index'} with k/v
+    [L, 1, h_kv, max_len, d] — the SAME layout the chunked admission
+    path produces, so `insert_prefill`/`insert_prefill_pages` adopt it
+    unchanged.  Attention runs through ops/ring_attention over the
+    'sequence' axis: each host holds S/P positions and k/v chunks
+    rotate the ring, so a 100k-token context splits its quadratic
+    attention (and its activation memory) across the slice instead of
+    OOMing one host.  Projections and MLP stay GSPMD-partitioned (the
+    params keep their fsdp/tensor sharding; activations are constrained
+    onto the sequence axis), matching models/transformer.py's own SP
+    composition.
+
+    Exactness: k/v are cached post-RoPE exactly like
+    `_scan_layers_and_unembed` writes them, and the ring merge is the
+    same logaddexp-weighted flash combine the training path uses — so
+    a slice replica's prefill is token-compatible with the
+    single-process chunked path (pinned by tests/unit/
+    test_slice_replica.py).
+
+    MoE configs are rejected: the capacity dispatch couples every
+    prompt token globally, so a sequence-split prefill changes which
+    tokens drop (same reason MoE skips chunked prefill and prefix
+    reuse).
+    """
+    if cfg.n_experts > 0:
+        raise ValueError('sequence-parallel prefill does not support '
+                         'MoE configs (the capacity dispatch couples '
+                         'every prompt token)')
+    from skypilot_tpu.ops.ring_attention import ring_attention  # pylint: disable=import-outside-toplevel
+
+    b, s = tokens.shape
+    if b != 1:
+        raise ValueError(f'prefill_sp serves one sequence, got '
+                         f'batch {b}')
+    positions = jnp.arange(s)
+    x = _embed(cfg, params, tokens)
+    if axis_name in mesh.axis_names:
+        # Pin activations onto the sequence axis so the projections
+        # below compute sequence-parallel instead of gathering the
+        # whole prompt onto every host.
+        seq_sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(None, axis_name, None))
+        x = jax.lax.with_sharding_constraint(x, seq_sharding)
+    layers = _layer_params(params, cfg)
+
+    def body(x, lp):
+        h = _norm(x, lp['attn_norm']['scale'], cfg.norm_eps,
+                  cfg.norm_scale_plus_one)
+        q = _rope(_attn_proj(h, lp['attn']['q_proj']), positions, cfg)
+        k = _rope(_attn_proj(h, lp['attn']['k_proj']), positions, cfg)
+        v = _attn_proj(h, lp['attn']['v_proj'])
+        out = ring_attention(q, k, v, mesh=mesh, axis_name=axis_name,
+                             causal=True,
+                             sm_scale=cfg.head_dim ** -0.5)
+        out = jnp.einsum('bhsk,hkd->bsd', out,
+                         maybe_dequant(lp['attn']['o_proj']['kernel'],
+                                       x.dtype))
+        x = x + out
+        h = _norm(x, lp['mlp_norm']['scale'], cfg.norm_eps,
+                  cfg.norm_scale_plus_one)
+        # k/v cached post-RoPE, exactly like the chunked write path.
+        return x + _mlp(h, lp, cfg), (k.astype(cfg.dtype),
+                                      v.astype(cfg.dtype))
+
+    _, (ks, vs) = jax.lax.scan(body, x, layers)
+
+    # ks/vs: [L, 1, h_kv, S, d] -> pad the position axis to max_len so
+    # the cache drops into the engine's private-prefill slots verbatim.
+    def pad(leaf):
+        full = jnp.zeros(
+            (cfg.n_layers, 1, cfg.n_kv_heads, max_len, cfg.head_dim),
+            cfg.dtype)
+        return jax.lax.dynamic_update_slice(
+            full, leaf.astype(cfg.dtype), (0, 0, 0, 0, 0))
+
+    return {'k': pad(ks), 'v': pad(vs),
+            'index': jnp.asarray(s, jnp.int32)}
+
+
 def prefill_chunk(cfg: ModelConfig, params, tokens, cache):
     """Continue a prefill at cache['index'] with a multi-token chunk.
 

@@ -67,6 +67,10 @@ class RequestSpan:
         self.routed_role: Optional[str] = None
         self.affinity_hit: Optional[bool] = None
         self.handoff_ms: Optional[float] = None
+        # Multi-host slice replicas: mean coordinated-tick sync
+        # overhead (rank-0 broadcast until every rank acked) while this
+        # request was in flight.  None on single-host replicas.
+        self.slice_sync_ms: Optional[float] = None
         self.ttft_s: Optional[float] = None
         self._last_token: Optional[float] = None
         self.itl_count = 0
@@ -139,6 +143,8 @@ class RequestSpan:
             out['affinity_hit'] = self.affinity_hit
         if self.handoff_ms is not None:
             out['handoff_ms'] = round(self.handoff_ms, 3)
+        if self.slice_sync_ms is not None:
+            out['slice_sync_ms'] = round(self.slice_sync_ms, 3)
         return out
 
     def _emit_timeline(self) -> None:

@@ -101,6 +101,15 @@ def ring_attention(q, k, v, *, mesh, axis_name: str = 'sequence',
     """
     if sm_scale is None:
         sm_scale = float(q.shape[-1]) ** -0.5
+    if sp_common.sp_degree(mesh, axis_name) <= 1 and (
+            mesh is None or axis_name not in mesh.axis_names):
+        # Degenerate slice without the axis at all: a one-hop ring IS
+        # the plain causal flash kernel — run it directly rather than
+        # reference an axis the mesh does not carry.
+        out, _ = flash_attention_with_lse(
+            q, k, v, causal=causal, sm_scale=float(sm_scale),
+            block_q=block_q, block_k=block_k)
+        return out
     # Keep batch on the data axes and heads on the tensor axis — only
     # the sequence dim participates in the ring.  Replicating them here
     # would force all-gathers and redundant compute across every
@@ -114,5 +123,5 @@ def ring_attention(q, k, v, *, mesh, axis_name: str = 'sequence',
     fn = functools.partial(_ring_attention_sharded, axis_name=axis_name,
                            sm_scale=float(sm_scale), causal=causal,
                            block_q=block_q, block_k=block_k)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    return sp_common.sp_shard_map(fn, mesh, (spec, spec, spec),
+                                  spec)(q, k, v)

@@ -4,6 +4,14 @@ ring_attention and ulysses_attention wrap the same mesh logic: batch
 stays on the data axes, heads on the tensor axis, only the sequence dim
 participates in the SP collective.  One copy here so axis selection and
 the GQA fallback cannot diverge between the two strategies.
+
+Degenerate meshes are first-class: a slice-serving replica builds ONE
+mesh per slice and runs the SAME prefill code whether the slice has one
+host or eight — so `sp_degree` treats a missing sequence axis (or one
+of size 1) as degree 1, and the wrappers fall back to the plain flash
+kernel there instead of spinning up a one-party collective.  This is
+what lets `serve/slice_replica.py` ship a single code path for every
+`num_hosts:` value.
 """
 from __future__ import annotations
 
@@ -12,8 +20,41 @@ from typing import Optional, Tuple
 import jax
 
 
+def sp_shard_map(fn, mesh, in_specs, out_specs):
+    """Version-portable shard_map (same capability split as
+    parallel/preflight.py `_shard_map`): `jax.shard_map` is the public
+    API from jax 0.6+ (replication checking via check_vma); older jax
+    only ships `jax.experimental.shard_map.shard_map`, whose
+    replication checker predates several collectives used here — so it
+    runs with check_rep=False, exactly like the preflight probe."""
+    if hasattr(jax, 'shard_map'):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental import shard_map as shard_map_lib  # pylint: disable=import-outside-toplevel
+    return shard_map_lib.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, check_rep=False)
+
+
+def sp_degree(mesh, axis_name: str) -> int:
+    """Size of the sequence-parallel axis; 1 when the mesh does not
+    carry the axis at all (degenerate single-host slice) or carries it
+    at size 1 — both mean "no sequence collective", and callers must
+    treat them identically."""
+    if mesh is None or axis_name not in mesh.axis_names:
+        return 1
+    return int(mesh.shape[axis_name])
+
+
 def sp_partition(mesh, axis_name: str) -> Tuple[object, tuple, int]:
-    """→ (PartitionSpec for [b, h, s, d], head_axes, tensor degree)."""
+    """→ (PartitionSpec for [b, h, s, d], head_axes, tensor degree).
+
+    Accepts a degenerate mesh (sequence axis of size 1): the axis still
+    appears in the spec — shard_map over a size-1 axis is exact, the
+    ring simply has one hop — so the same jitted program serves every
+    slice width.  A mesh MISSING the axis entirely is the caller's cue
+    to skip shard_map (see `sp_degree`); putting an unknown axis in a
+    PartitionSpec would be an error, so it is omitted here.
+    """
     P = jax.sharding.PartitionSpec
 
     def _axes(*names):
@@ -26,7 +67,8 @@ def sp_partition(mesh, axis_name: str) -> Tuple[object, tuple, int]:
     tp = 1
     for a in (head_axes or ()):
         tp *= mesh.shape[a]
-    return P(batch_axes, head_axes, axis_name, None), head_axes, tp
+    seq_axis = axis_name if axis_name in mesh.axis_names else None
+    return P(batch_axes, head_axes, seq_axis, None), head_axes, tp
 
 
 def broadcast_gqa_if_indivisible(q, k, v, divisor: int):

@@ -76,7 +76,13 @@ def ulysses_attention(q, k, v, *, mesh, axis_name: str = 'sequence',
     """
     if sm_scale is None:
         sm_scale = float(q.shape[-1]) ** -0.5
-    sp = mesh.shape[axis_name]
+    sp = sp_common.sp_degree(mesh, axis_name)
+    if sp <= 1 and (mesh is None or axis_name not in mesh.axis_names):
+        # Degenerate slice without the axis: one party's all-to-all is
+        # the identity, so this IS plain causal flash.
+        return flash_attention(q, k, v, causal=causal,
+                               sm_scale=float(sm_scale),
+                               block_q=block_q, block_k=block_k)
     spec, _, tp = sp_common.sp_partition(mesh, axis_name)
     # Heads are sharded tensor-wise first, then each tensor shard's
     # heads are all-to-all'd over the sequence axis — so heads must
@@ -90,5 +96,5 @@ def ulysses_attention(q, k, v, *, mesh, axis_name: str = 'sequence',
     fn = functools.partial(_ulysses_attention_sharded,
                            axis_name=axis_name, sm_scale=float(sm_scale),
                            causal=causal, block_q=block_q, block_k=block_k)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    return sp_common.sp_shard_map(fn, mesh, (spec, spec, spec),
+                                  spec)(q, k, v)

@@ -167,12 +167,17 @@ class AsyncModelServer:
             'status': 'ok',
             'model': f'{server.cfg.d_model}x{server.cfg.n_layers}',
             'role': server.role,
+            'num_hosts': server.num_hosts,
         }
         engine = server._engine  # pylint: disable=protected-access
         code = 200
         if engine is not None:
             stats = engine.stats()
             payload['engine'] = stats
+            if 'slice' in stats:
+                # Gang health top-level: the controller probe retires a
+                # degraded slice (dead rank) instead of waiting it out.
+                payload['slice'] = stats['slice']
             if stats['failed']:
                 payload['status'] = 'engine_failed'
                 code = 503
@@ -203,10 +208,11 @@ class AsyncModelServer:
         return {'tokens': tokens,
                 'latency_ms': round((time.perf_counter() - t0) * 1e3, 1)}
 
-    async def _prefill_export(self, req: Dict[str, Any]
-                              ) -> Dict[str, Any]:
+    async def _prefill_export(self, req: Dict[str, Any],
+                              binary: bool = False) -> Any:
         """KV handoff, prefill side (compute runs in the executor so
-        token streams on this loop keep flowing)."""
+        token streams on this loop keep flowing).  binary=True returns
+        the raw octet-stream frame instead of the JSON payload."""
         engine = self.server._engine  # pylint: disable=protected-access
         if engine is None:
             raise _HttpError(400, 'KV handoff requires '
@@ -222,19 +228,21 @@ class AsyncModelServer:
             return await asyncio.get_running_loop().run_in_executor(
                 None, lambda: engine.export_prefill(
                     [int(t) for t in prompt],
-                    page_size=req.get('page_size')))
+                    page_size=req.get('page_size'), binary=binary))
         except handoff_lib.HandoffError as e:
             raise _HttpError(400, str(e)) from e
 
-    async def _kv_import(self, req: Dict[str, Any]) -> Dict[str, Any]:
+    async def _kv_import(self, decoded: Dict[str, Any]
+                         ) -> Dict[str, Any]:
         """KV handoff, decode side (waits on the engine worker in the
-        executor — the loop never blocks on the import)."""
+        executor — the loop never blocks on the import).  `decoded` is
+        the wire-agnostic dict from handoff.decode_payload /
+        decode_binary."""
         engine = self.server._engine  # pylint: disable=protected-access
         if engine is None:
             raise _HttpError(400, 'KV handoff requires '
                                   '--continuous-batching')
         try:
-            decoded = handoff_lib.decode_payload(req)
             imported, cached = (
                 await asyncio.get_running_loop().run_in_executor(
                     None, lambda: engine.import_pages(
@@ -426,6 +434,19 @@ class AsyncModelServer:
                         continue
                     if method != 'POST':
                         raise _HttpError(404, 'unknown method')
+                    ctype = headers.get('content-type') or ''
+                    if (path == '/kv_import' and
+                            handoff_lib.CONTENT_TYPE_BINARY in ctype):
+                        # Binary handoff frame: raw array bytes, no
+                        # JSON parse of a megabyte body.
+                        try:
+                            decoded = handoff_lib.decode_binary(body)
+                        except handoff_lib.HandoffError as e:
+                            raise _HttpError(400, str(e)) from e
+                        writer.write(_json_response(
+                            200, await self._kv_import(decoded)))
+                        await writer.drain()
+                        continue
                     try:
                         req = json.loads(body or b'{}')
                     except json.JSONDecodeError as e:
@@ -457,12 +478,29 @@ class AsyncModelServer:
                         await self._generate_text(req, writer, rid,
                                                   meta)
                     elif path == '/prefill_export':
-                        writer.write(_json_response(
-                            200, await self._prefill_export(req)))
+                        binary = (req.get('wire') == 'binary' or
+                                  handoff_lib.CONTENT_TYPE_BINARY in
+                                  (headers.get('accept') or ''))
+                        result = await self._prefill_export(
+                            req, binary=binary)
+                        if binary:
+                            writer.write(
+                                (f'HTTP/1.1 200 OK\r\n'
+                                 f'Content-Type: '
+                                 f'{handoff_lib.CONTENT_TYPE_BINARY}'
+                                 f'\r\nContent-Length: '
+                                 f'{len(result)}\r\n\r\n'
+                                 ).encode() + result)
+                        else:
+                            writer.write(_json_response(200, result))
                         await writer.drain()
                     elif path == '/kv_import':
+                        try:
+                            decoded = handoff_lib.decode_payload(req)
+                        except handoff_lib.HandoffError as e:
+                            raise _HttpError(400, str(e)) from e
                         writer.write(_json_response(
-                            200, await self._kv_import(req)))
+                            200, await self._kv_import(decoded)))
                         await writer.drain()
                     else:
                         raise _HttpError(404, 'unknown path')

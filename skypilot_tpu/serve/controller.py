@@ -274,8 +274,10 @@ class SkyServeController:
                         use_spot = n_ondemand >= decision.num_ondemand
                         if not use_spot:
                             n_ondemand += 1
-                    self.replica_manager.scale_up(use_spot=use_spot,
-                                                  role=role)
+                    self.replica_manager.scale_up(
+                        use_spot=use_spot, role=role,
+                        num_hosts=getattr(
+                            self.spec.role_specs[role], 'num_hosts', 1))
             elif n_active > decision.target_num_replicas:
                 extra = n_active - decision.target_num_replicas
                 # Retire not-ready first, then newest.

@@ -28,6 +28,22 @@ The tail of the prompt — positions past the last FULL page — is NOT
 shipped: the decode replica chunk-prefills it locally (< one page of
 tokens), exactly like a partial prefix-cache hit.  That keeps the
 transfer page-granular and reuses the PR 7 admission path unchanged.
+
+Binary wire (``application/octet-stream``): the JSON/base64 wire above
+costs 4/3x the page bytes in base64 alone, plus a json.dumps/loads of
+megabyte strings on both sides.  The binary frame ships the SAME
+fields with the arrays raw:
+
+    b'SKTH1\\n' | u32 header_len | header JSON | k | v [| k_scale | v_scale]
+
+where the header is the JSON payload minus the blobs (version,
+page_size, n_pages, hashes, dtype, shape) and the arrays follow
+little-endian, C-contiguous, in that fixed order.  `encode_binary` /
+`decode_binary` are the codec; the replica fronts accept it on
+`/prefill_export` (request `{"wire": "binary"}` -> octet-stream
+response) and `/kv_import` (octet-stream request body), and the LB
+prefers it (SKYTPU_LB_HANDOFF_BINARY), falling back to JSON/base64
+when either leg refuses — old replicas keep working mid-rollout.
 """
 from __future__ import annotations
 
@@ -37,6 +53,10 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 WIRE_VERSION = 1
+
+# Binary-frame magic (versioned: bump with WIRE_VERSION).
+BINARY_MAGIC = b'SKTH1\n'
+CONTENT_TYPE_BINARY = 'application/octet-stream'
 
 
 class HandoffError(RuntimeError):
@@ -87,6 +107,101 @@ def encode_payload(hashes: Sequence[int], page_size: int,
         payload['k_scale'] = _b64(np.asarray(k_scale, np.float32))
         payload['v_scale'] = _b64(np.asarray(v_scale, np.float32))
     return payload
+
+
+def encode_binary(hashes: Sequence[int], page_size: int,
+                  k_pages: np.ndarray, v_pages: np.ndarray,
+                  k_scale: Optional[np.ndarray] = None,
+                  v_scale: Optional[np.ndarray] = None) -> bytes:
+    """Pack exported pages as the binary frame (see module docs):
+    header JSON + raw little-endian arrays in fixed order.  ~25% fewer
+    bytes on the wire than the base64 form of the same payload, and no
+    megabyte-string json round trip on either side."""
+    import json  # pylint: disable=import-outside-toplevel
+    quantized = k_scale is not None
+    header = {
+        'version': WIRE_VERSION,
+        'page_size': int(page_size),
+        'n_pages': int(k_pages.shape[1]),
+        'hashes': [int(h) for h in hashes],
+        'dtype': 'int8' if quantized else 'float32',
+        'shape': [int(s) for s in k_pages.shape],
+    }
+    head = json.dumps(header).encode()
+    parts = [BINARY_MAGIC, len(head).to_bytes(4, 'little'), head,
+             np.ascontiguousarray(k_pages).tobytes(),
+             np.ascontiguousarray(v_pages).tobytes()]
+    if quantized:
+        parts.append(np.ascontiguousarray(
+            np.asarray(k_scale, np.float32)).tobytes())
+        parts.append(np.ascontiguousarray(
+            np.asarray(v_scale, np.float32)).tobytes())
+    return b''.join(parts)
+
+
+def decode_binary(data: bytes) -> Dict[str, Any]:
+    """Unpack a binary frame into the same dict `decode_payload`
+    returns: {'hashes', 'page_size', 'k', 'v'[, 'k_scale', 'v_scale']}
+    with k/v `[L, N, h_kv, ps, d]`."""
+    import json  # pylint: disable=import-outside-toplevel
+    if not data.startswith(BINARY_MAGIC):
+        raise HandoffError('not a binary handoff frame (bad magic)')
+    off = len(BINARY_MAGIC)
+    if len(data) < off + 4:
+        raise HandoffError('truncated binary handoff frame')
+    head_len = int.from_bytes(data[off:off + 4], 'little')
+    off += 4
+    if len(data) < off + head_len:
+        raise HandoffError('truncated binary handoff header')
+    try:
+        header = json.loads(data[off:off + head_len])
+    except (ValueError, UnicodeDecodeError) as e:
+        raise HandoffError(f'malformed binary handoff header: {e}') \
+            from e
+    off += head_len
+    version = header.get('version')
+    if version != WIRE_VERSION:
+        raise HandoffError(f'unsupported handoff wire version '
+                           f'{version!r} (have {WIRE_VERSION})')
+    try:
+        shape = [int(s) for s in header['shape']]
+        hashes = [int(h) for h in header['hashes']]
+        page_size = int(header['page_size'])
+        dtype = header['dtype']
+    except (KeyError, ValueError, TypeError) as e:
+        raise HandoffError(f'malformed binary handoff header: {e}') \
+            from e
+    if len(shape) != 5 or shape[3] != page_size or \
+            shape[1] != len(hashes):
+        raise HandoffError(f'bad binary handoff geometry: shape '
+                           f'{shape}, page_size {page_size}, '
+                           f'{len(hashes)} hashes')
+    if dtype not in ('float32', 'int8'):
+        raise HandoffError(f'unsupported handoff dtype {dtype!r}')
+    count = int(np.prod(shape))
+    itemsize = 1 if dtype == 'int8' else 4
+
+    def take(n_bytes: int, np_dtype, arr_shape) -> np.ndarray:
+        nonlocal off
+        if len(data) < off + n_bytes:
+            raise HandoffError('truncated binary handoff arrays')
+        arr = np.frombuffer(data, dtype=np_dtype, count=int(
+            np.prod(arr_shape)), offset=off).reshape(arr_shape)
+        off += n_bytes
+        return arr
+
+    k = take(count * itemsize, dtype, shape)
+    v = take(count * itemsize, dtype, shape)
+    out = {'hashes': hashes, 'page_size': page_size, 'k': k, 'v': v}
+    if dtype == 'int8':
+        scale_count = int(np.prod(shape[:4]))
+        out['k_scale'] = take(scale_count * 4, np.float32, shape[:4])
+        out['v_scale'] = take(scale_count * 4, np.float32, shape[:4])
+    if off != len(data):
+        raise HandoffError(
+            f'binary handoff frame has {len(data) - off} trailing '
+            f'bytes')
+    return out
 
 
 def decode_payload(payload: Dict[str, Any]) -> Dict[str, Any]:

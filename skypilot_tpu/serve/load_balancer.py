@@ -104,6 +104,11 @@ _M_HANDOFF = metrics_lib.counter(
 _M_HANDOFF_SECONDS = metrics_lib.histogram(
     'skytpu_lb_handoff_seconds',
     'prefill_export + kv_import wall time per successful handoff.')
+_M_HANDOFF_WIRE_BYTES = metrics_lib.counter(
+    'skytpu_lb_handoff_wire_bytes_total',
+    'Bytes shipped on the kv_import leg of KV page handoffs, by wire '
+    '(binary = application/octet-stream frame; json = base64 '
+    'payload).', ('wire',))
 
 _REQUEST_ID_KEY = tracing.REQUEST_ID_HEADER.lower()
 
@@ -128,15 +133,24 @@ def _handoff_timeout() -> float:
     return float(os.environ.get('SKYTPU_LB_HANDOFF_TIMEOUT', '30'))
 
 
+def _handoff_binary() -> bool:
+    """Prefer the binary (octet-stream) handoff wire; '0' pins the
+    legacy JSON/base64 wire.  Either way a refused binary leg falls
+    back to JSON before falling back to local prefill — old replicas
+    keep working mid-rollout."""
+    return os.environ.get('SKYTPU_LB_HANDOFF_BINARY', '1') != '0'
+
+
 def _journal_handoff(event: str, **fields: Any) -> None:
     """Journal routing/handoff events only while someone is watching
-    (the `serve.kv_handoff` chaos site armed or
+    (the `serve.kv_handoff` / `serve.rank_exec` chaos sites armed or
     SKYTPU_SERVE_HANDOFF_EVENTS set) — the `handoff_consistency`
     invariant replays them to prove no request is lost or
-    double-executed across a handoff failure."""
+    double-executed across a handoff failure or a slice-rank death."""
     from skypilot_tpu.chaos import injector as chaos_injector  # pylint: disable=import-outside-toplevel
     if not (os.environ.get('SKYTPU_SERVE_HANDOFF_EVENTS') or
-            chaos_injector.site_armed('serve.kv_handoff')):
+            chaos_injector.site_armed('serve.kv_handoff') or
+            chaos_injector.site_armed('serve.rank_exec')):
         return
     from skypilot_tpu.observability import events as events_lib  # pylint: disable=import-outside-toplevel
     try:
@@ -691,6 +705,23 @@ class SkyServeLoadBalancer:
                                 next_target = alternates[0]
                                 delay = min(retry_after,
                                             _retry_max_delay())
+                        elif status >= 500 and attempt == 0:
+                            # Replica-side failure (engine failed —
+                            # e.g. a slice replica losing a rank mid-
+                            # decode — or queue TTL expiry): the body
+                            # is replayable and nothing was relayed,
+                            # so one same-role sibling retry turns a
+                            # dead replica's 5xx into a served
+                            # request.  The controller retires the
+                            # failed replica on its next probe; until
+                            # then this is what "zero lost requests
+                            # while the slice rebuilds" means.
+                            alternates = self.router.alternates(
+                                target, exclude=tried)
+                            if alternates:
+                                _M_RETRIES.labels(
+                                    reason='replica_error').inc()
+                                next_target = alternates[0]
                         if next_target is None:
                             # Relay (any status): head then stream.
                             cwriter.write(resp_head)
@@ -783,22 +814,24 @@ class SkyServeLoadBalancer:
                     pass
         return status, retry_after, resp_head, ureader, uwriter
 
-    async def _json_request(self, target: str, path: str,
-                            payload: Dict[str, Any],
-                            timeout: float) -> Tuple[int, Any]:
-        """One bounded JSON POST to a replica (the handoff legs);
-        returns (status, parsed body or None)."""
+    async def _http_request(self, target: str, path: str, body: bytes,
+                            content_type: str, timeout: float,
+                            accept: Optional[str] = None
+                            ) -> Tuple[int, str, bytes]:
+        """One bounded POST to a replica (the handoff legs); returns
+        (status, response content-type, raw response body)."""
         split = urlsplit(target)
         host = split.hostname or '127.0.0.1'
         port = split.port or 80
-        body = json.dumps(payload).encode()
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(host, port),
             timeout=_UPSTREAM_CONNECT_TIMEOUT)
         try:
+            accept_line = f'Accept: {accept}\r\n' if accept else ''
             writer.write((f'POST {path} HTTP/1.1\r\n'
                           f'Host: {host}:{port}\r\n'
-                          f'Content-Type: application/json\r\n'
+                          f'Content-Type: {content_type}\r\n'
+                          f'{accept_line}'
                           f'Content-Length: {len(body)}\r\n'
                           f'Connection: close\r\n\r\n').encode() + body)
             await asyncio.wait_for(writer.drain(), timeout=timeout)
@@ -806,20 +839,21 @@ class SkyServeLoadBalancer:
                 reader.readuntil(b'\r\n\r\n'), timeout=timeout)
             status = int(head.split(b' ', 2)[1])
             length = None
+            resp_ctype = ''
             for line in head.decode('latin-1').split('\r\n')[1:]:
                 name, _, value = line.partition(':')
-                if name.strip().lower() == 'content-length':
+                lname = name.strip().lower()
+                if lname == 'content-length':
                     length = int(value.strip())
+                elif lname == 'content-type':
+                    resp_ctype = value.strip()
             if length is not None:
                 raw = await asyncio.wait_for(reader.readexactly(length),
                                              timeout=timeout)
             else:
                 raw = await asyncio.wait_for(reader.read(-1),
                                              timeout=timeout)
-            try:
-                return status, json.loads(raw or b'null')
-            except json.JSONDecodeError:
-                return status, None
+            return status, resp_ctype, raw
         finally:
             try:
                 writer.close()
@@ -827,31 +861,104 @@ class SkyServeLoadBalancer:
             except (ConnectionError, OSError):
                 pass
 
+    async def _json_request(self, target: str, path: str,
+                            payload: Dict[str, Any],
+                            timeout: float) -> Tuple[int, Any]:
+        """One bounded JSON POST to a replica (the handoff legs);
+        returns (status, parsed body or None)."""
+        status, _, raw = await self._http_request(
+            target, path, json.dumps(payload).encode(),
+            'application/json', timeout)
+        try:
+            return status, json.loads(raw or b'null')
+        except json.JSONDecodeError:
+            return status, None
+
     async def _do_handoff(self, decision: router_lib.RouteDecision,
                           prompt_ids: List[int],
                           rid: str) -> Optional[float]:
         """Prefill-replica export -> decode-replica import.  Returns
         the handoff wall time in ms, or None when any leg failed — the
         request then proceeds with LOCAL prefill on the decode replica
-        (degraded latency, never a lost request)."""
+        (degraded latency, never a lost request).
+
+        Wire selection: the binary octet-stream frame by default
+        (SKYTPU_LB_HANDOFF_BINARY=0 pins JSON).  A replica that does
+        not speak binary — an old export replying JSON, or an old
+        importer 400/404/415-ing the frame — degrades to ONE
+        JSON/base64 attempt before local-prefill fallback, so mixed
+        fleets keep handing off mid-rollout."""
+        from skypilot_tpu.serve import handoff as handoff_lib  # pylint: disable=import-outside-toplevel
         t0 = time.perf_counter()
         _journal_handoff('kv_handoff_start', request_id=rid,
                          source=decision.handoff_source,
                          target=decision.url)
+        wire = 'binary' if _handoff_binary() else 'json'
+        wire_bytes = 0
         try:
             export_req: Dict[str, Any] = {'prompt_ids': prompt_ids}
             if decision.page_size:
                 export_req['page_size'] = decision.page_size
             timeout = _handoff_timeout()
-            status, payload = await self._json_request(
-                decision.handoff_source, '/prefill_export', export_req,
-                timeout)
-            if status != 200 or not isinstance(payload, dict):
-                raise _UpstreamError(f'prefill_export -> {status}')
-            status, _ = await self._json_request(
-                decision.url, '/kv_import', payload, timeout)
-            if status != 200:
-                raise _UpstreamError(f'kv_import -> {status}')
+            if wire == 'binary':
+                export_req['wire'] = 'binary'
+                status, ctype, raw = await self._http_request(
+                    decision.handoff_source, '/prefill_export',
+                    json.dumps(export_req).encode(),
+                    'application/json', timeout,
+                    accept=handoff_lib.CONTENT_TYPE_BINARY)
+                if status != 200:
+                    raise _UpstreamError(f'prefill_export -> {status}')
+                if handoff_lib.CONTENT_TYPE_BINARY not in ctype:
+                    # Old prefill replica answered JSON: import it as
+                    # JSON (the payload is already in hand).
+                    wire = 'json'
+                    try:
+                        payload = json.loads(raw or b'null')
+                    except json.JSONDecodeError as e:
+                        raise _UpstreamError(
+                            f'prefill_export sent neither wire: {e}'
+                        ) from e
+                    if not isinstance(payload, dict):
+                        raise _UpstreamError(
+                            'prefill_export sent no payload')
+                    raw = json.dumps(payload).encode()
+                wire_bytes = len(raw)
+                import_ctype = (handoff_lib.CONTENT_TYPE_BINARY
+                                if wire == 'binary'
+                                else 'application/json')
+                status, _, _ = await self._http_request(
+                    decision.url, '/kv_import', raw, import_ctype,
+                    timeout)
+                if wire == 'binary' and status in (400, 404, 415):
+                    # Old decode replica: one JSON retry of the SAME
+                    # pages before giving up on the handoff.
+                    _M_RETRIES.labels(reason='handoff_wire').inc()
+                    wire = 'json'
+                    export_req.pop('wire', None)
+                    status, payload = await self._json_request(
+                        decision.handoff_source, '/prefill_export',
+                        export_req, timeout)
+                    if status != 200 or not isinstance(payload, dict):
+                        raise _UpstreamError(
+                            f'prefill_export (json retry) -> {status}')
+                    raw = json.dumps(payload).encode()
+                    wire_bytes = len(raw)
+                    status, _ = await self._json_request(
+                        decision.url, '/kv_import', payload, timeout)
+                if status != 200:
+                    raise _UpstreamError(f'kv_import -> {status}')
+            else:
+                status, payload = await self._json_request(
+                    decision.handoff_source, '/prefill_export',
+                    export_req, timeout)
+                if status != 200 or not isinstance(payload, dict):
+                    raise _UpstreamError(f'prefill_export -> {status}')
+                wire_bytes = len(json.dumps(payload).encode())
+                status, _ = await self._json_request(
+                    decision.url, '/kv_import', payload, timeout)
+                if status != 200:
+                    raise _UpstreamError(f'kv_import -> {status}')
         except (_UpstreamError, OSError, ConnectionError,
                 asyncio.TimeoutError, asyncio.IncompleteReadError,
                 asyncio.LimitOverrunError, ValueError) as e:
@@ -863,8 +970,10 @@ class SkyServeLoadBalancer:
         dt = time.perf_counter() - t0
         _M_HANDOFF.labels(outcome='ok').inc()
         _M_HANDOFF_SECONDS.observe(dt)
+        _M_HANDOFF_WIRE_BYTES.labels(wire=wire).inc(wire_bytes)
         _journal_handoff('kv_handoff_end', request_id=rid, status='ok',
-                         duration_ms=round(dt * 1e3, 3))
+                         duration_ms=round(dt * 1e3, 3), wire=wire,
+                         wire_bytes=wire_bytes)
         return dt * 1e3
 
     async def _proxy_to(self, target: str, creader: asyncio.StreamReader,

@@ -71,14 +71,16 @@ _M_ROUTED = metrics_lib.counter(
 
 def _maybe_journal_request(event: str, **fields) -> None:
     """Journal request execution only while someone is watching (the
-    `serve.kv_handoff` chaos site armed, or SKYTPU_SERVE_HANDOFF_EVENTS
-    set): the handoff_consistency invariant replays these to prove no
-    request is lost or double-executed across a handoff failure."""
+    `serve.kv_handoff` / `serve.rank_exec` chaos sites armed, or
+    SKYTPU_SERVE_HANDOFF_EVENTS set): the handoff_consistency
+    invariant replays these to prove no request is lost or
+    double-executed across a handoff failure OR a slice-rank death."""
     import os  # pylint: disable=import-outside-toplevel
 
     from skypilot_tpu.chaos import injector as chaos_injector  # pylint: disable=import-outside-toplevel
     if not (os.environ.get('SKYTPU_SERVE_HANDOFF_EVENTS') or
-            chaos_injector.site_armed('serve.kv_handoff')):
+            chaos_injector.site_armed('serve.kv_handoff') or
+            chaos_injector.site_armed('serve.rank_exec')):
         return
     from skypilot_tpu.observability import events as events_lib  # pylint: disable=import-outside-toplevel
     try:
@@ -107,7 +109,11 @@ class ModelServer:
                  page_size: int = 16,
                  quantize_kv: bool = False,
                  prefix_caching: bool = True,
-                 role: str = router_lib.DEFAULT_ROLE) -> None:
+                 role: str = router_lib.DEFAULT_ROLE,
+                 num_hosts: int = 1,
+                 sp_threshold: Optional[int] = None,
+                 slice_sequence: Optional[int] = None,
+                 slice_tensor: Optional[int] = None) -> None:
         import jax
         import flax.linen as nn
 
@@ -124,6 +130,23 @@ class ModelServer:
                 'quantize + tensor sharding is not supported yet '
                 '(quantized leaves change the param pytree the '
                 'shardings were computed for).')
+        self.num_hosts = int(num_hosts)
+        self.sp_threshold = sp_threshold
+        if self.num_hosts > 1:
+            if tensor > 1:
+                raise ValueError(
+                    '--num-hosts subsumes --tensor: the slice mesh '
+                    'lays out sequence x tensor itself '
+                    '(--slice-tensor pins the factor).')
+            if quantize:
+                raise ValueError(
+                    'quantize + multi-host sharding is not supported '
+                    'yet (quantized leaves change the param pytree '
+                    'the shardings were computed for).')
+            if not continuous_batching:
+                raise ValueError('--num-hosts > 1 requires '
+                                 '--continuous-batching (the slice '
+                                 'engine IS the batching engine)')
         if model == 'auto':
             # Converted checkpoints carry their own ModelConfig
             # (import_weights writes model_config.json next to the
@@ -178,7 +201,24 @@ class ModelServer:
         self.default_seed = int(default_seed)
         self._shardings = None
         self._mesh = None
-        if tensor > 1:
+        if self.num_hosts > 1:
+            # Slice replica: one mesh (sequence x tensor) over the
+            # slice's hosts; weights shard per the same SpecLayout the
+            # tensor path uses (heads/mlp/vocab on 'tensor', embed on
+            # 'fsdp' — trivial axes resolve to replication).
+            from skypilot_tpu.parallel.sharding import LOGICAL_AXIS_RULES
+            from skypilot_tpu.serve import slice_replica as slice_lib
+            mesh = slice_lib.build_slice_mesh(
+                self.num_hosts, self.cfg, sequence=slice_sequence,
+                tensor=slice_tensor)
+            self._mesh = mesh
+            abstract = jax.eval_shape(
+                lambda rng: model_mod.init(rng, init_tokens)['params'],
+                key)
+            specs = nn.get_partition_spec(abstract)
+            self._shardings = nn.meta.unbox(nn.logical_to_mesh_sharding(
+                specs, mesh, LOGICAL_AXIS_RULES))
+        elif tensor > 1:
             from skypilot_tpu.parallel import MeshConfig, build_mesh
             from skypilot_tpu.parallel.sharding import LOGICAL_AXIS_RULES
             if len(jax.devices()) < tensor:
@@ -226,9 +266,15 @@ class ModelServer:
             else:
                 logger.warning('No --checkpoint-dir given; serving '
                                'FRESH random-init weights.')
-            params = jax.jit(
-                _init,
-                out_shardings=self._shardings)(key)
+            # Init deterministically UNSHARDED, then place: generating
+            # the random weights under GSPMD partitioning changes the
+            # values with the mesh layout (the partitioned RNG lowers
+            # differently), so a sharded replica would not be
+            # weight-identical to a single-process one.  Checkpoints —
+            # the real serving path — stream sharded regardless.
+            params = jax.jit(_init)(key)
+            if self._shardings is not None:
+                params = jax.device_put(params, self._shardings)
         if quantize:
             from skypilot_tpu.models import quantize as quantize_lib
             params = quantize_lib.quantize_params(params)
@@ -246,13 +292,26 @@ class ModelServer:
             # Requests join a running batch as slots free; token
             # selection (greedy or per-request temperature/top-k) runs
             # on device inside the pipelined tick.
-            self._engine = batching_engine_lib.ContinuousBatchingEngine(
-                self.cfg, self.params, max_len=max_len,
-                slots=max_batch, max_queue=max_queue,
-                queue_ttl=queue_ttl, prefill_chunk=prefill_chunk,
-                mesh=self._mesh, kv_pages=kv_pages,
-                page_size=page_size, quantize_kv=quantize_kv,
-                prefix_caching=prefix_caching)
+            if self.num_hosts > 1:
+                # Slice replica: coordinated ticks across the gang +
+                # sequence-parallel long-context prefill.
+                from skypilot_tpu.serve import slice_replica as slice_lib
+                self._engine = slice_lib.SliceReplicaEngine(
+                    self.cfg, self.params, num_hosts=self.num_hosts,
+                    sp_threshold=sp_threshold, mesh=self._mesh,
+                    max_len=max_len, slots=max_batch,
+                    max_queue=max_queue, queue_ttl=queue_ttl,
+                    prefill_chunk=prefill_chunk, kv_pages=kv_pages,
+                    page_size=page_size, quantize_kv=quantize_kv,
+                    prefix_caching=prefix_caching)
+            else:
+                self._engine = batching_engine_lib.ContinuousBatchingEngine(
+                    self.cfg, self.params, max_len=max_len,
+                    slots=max_batch, max_queue=max_queue,
+                    queue_ttl=queue_ttl, prefill_chunk=prefill_chunk,
+                    mesh=self._mesh, kv_pages=kv_pages,
+                    page_size=page_size, quantize_kv=quantize_kv,
+                    prefix_caching=prefix_caching)
 
     def close(self) -> None:
         """Release background resources (the batching engine's worker
@@ -323,9 +382,12 @@ def _make_handler(server: ModelServer):
         def log_message(self, *args):
             del args
 
-        def _read_json(self) -> Dict[str, Any]:
+        def _read_body(self) -> bytes:
             length = int(self.headers.get('Content-Length', 0))
-            return json.loads(self.rfile.read(length) or b'{}')
+            return self.rfile.read(length)
+
+        def _read_json(self) -> Dict[str, Any]:
+            return json.loads(self._read_body() or b'{}')
 
         def _reply(self, code: int, payload: Dict[str, Any],
                    headers: Optional[Dict[str, str]] = None) -> None:
@@ -403,12 +465,18 @@ def _make_handler(server: ModelServer):
             payload = {'status': 'ok',
                        'model': f'{server.cfg.d_model}x'
                                 f'{server.cfg.n_layers}',
-                       'role': server.role}
+                       'role': server.role,
+                       'num_hosts': server.num_hosts}
             engine = server._engine  # pylint: disable=protected-access
             code = 200
             if engine is not None:  # local bind: close() may race
                 stats = engine.stats()
                 payload['engine'] = stats
+                if 'slice' in stats:
+                    # Slice replicas surface gang health top-level so
+                    # the controller's probe can tell "rank died, tear
+                    # down and replace" from a transient flap.
+                    payload['slice'] = stats['slice']
                 if stats['failed']:
                     # A dead engine must fail the readiness probe or
                     # the LB keeps routing to a black hole.
@@ -599,7 +667,10 @@ def _make_handler(server: ModelServer):
             """KV handoff, prefill side: prefill the prompt and return
             its full pages as a serve/handoff.py wire payload — the
             router imports it on a decode replica and then forwards the
-            request there (where it lands as a prefix hit)."""
+            request there (where it lands as a prefix hit).  A request
+            carrying {"wire": "binary"} (or Accept: application/
+            octet-stream) gets the raw binary frame instead of
+            JSON/base64."""
             engine = server._engine  # pylint: disable=protected-access
             if engine is None:
                 self._reply(400, {'error': 'KV handoff requires '
@@ -614,10 +685,22 @@ def _make_handler(server: ModelServer):
                         raise ValueError(
                             'export serves one prompt per request')
                     prompt = prompt[0]
+                binary = (req.get('wire') == 'binary' or
+                          handoff_lib.CONTENT_TYPE_BINARY in
+                          (self.headers.get('Accept') or ''))
                 payload = engine.export_prefill(
                     [int(t) for t in prompt],
-                    page_size=req.get('page_size'))
-                self._reply(200, payload)
+                    page_size=req.get('page_size'), binary=binary)
+                if binary:
+                    self.send_response(200)
+                    self.send_header('Content-Type',
+                                     handoff_lib.CONTENT_TYPE_BINARY)
+                    self.send_header('Content-Length',
+                                     str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                else:
+                    self._reply(200, payload)
             except (handoff_lib.HandoffError, KeyError, ValueError,
                     TypeError, json.JSONDecodeError) as e:
                 self._reply(400, {'error': str(e)})
@@ -628,17 +711,25 @@ def _make_handler(server: ModelServer):
 
         def _kv_import(self):
             """KV handoff, decode side: adopt exported pages into the
-            pool + prefix cache.  429 pages_exhausted when the pool
-            cannot hold them right now; 503 when the import is refused
-            (chaos deny / shedding) — the router falls back to local
-            prefill either way."""
+            pool + prefix cache.  Accepts the JSON/base64 payload OR
+            the binary frame (Content-Type: application/octet-stream).
+            429 pages_exhausted when the pool cannot hold them right
+            now; 503 when the import is refused (chaos deny /
+            shedding) — the router falls back to local prefill either
+            way."""
             engine = server._engine  # pylint: disable=protected-access
             if engine is None:
                 self._reply(400, {'error': 'KV handoff requires '
                                            '--continuous-batching'})
                 return
             try:
-                decoded = handoff_lib.decode_payload(self._read_json())
+                ctype = self.headers.get('Content-Type') or ''
+                if handoff_lib.CONTENT_TYPE_BINARY in ctype:
+                    decoded = handoff_lib.decode_binary(
+                        self._read_body())
+                else:
+                    decoded = handoff_lib.decode_payload(
+                        self._read_json())
                 imported, cached = engine.import_pages(
                     decoded['hashes'], decoded['page_size'],
                     decoded['k'], decoded['v'],
@@ -803,6 +894,39 @@ def main() -> None:
                         help='Tensor-shard the model over N local '
                              'devices (models too big for one chip); '
                              'GSPMD partitions the decode einsums.')
+    parser.add_argument('--num-hosts', type=int,
+                        default=int(_os.environ.get(
+                            'SKYTPU_SERVE_REPLICA_NUM_HOSTS', '1')),
+                        help='Serve this replica as a multi-host SLICE '
+                             'of N gang-scheduled hosts: weights '
+                             'tensor/fsdp-sharded over the slice mesh, '
+                             'paged KV pool sharded with them, ticks '
+                             'coordinated across ranks, long prompts '
+                             'prefilled sequence-parallel (ring '
+                             'attention).  Emulated hosts = virtual '
+                             'devices; env '
+                             'SKYTPU_SERVE_REPLICA_NUM_HOSTS — set by '
+                             'the controller from the role pool\'s '
+                             'num_hosts:.  Requires '
+                             '--continuous-batching.')
+    parser.add_argument('--sp-threshold', type=int,
+                        default=(int(_os.environ[
+                            'SKYTPU_SLICE_SP_THRESHOLD'])
+                                 if _os.environ.get(
+                                     'SKYTPU_SLICE_SP_THRESHOLD')
+                                 else None),
+                        help='Prompt tokens at which a multi-host '
+                             'replica prefills sequence-parallel in '
+                             'one shot instead of chunked (default '
+                             '1024; env SKYTPU_SLICE_SP_THRESHOLD).')
+    parser.add_argument('--slice-sequence', type=int, default=None,
+                        help='Pin the sequence-axis factor of the '
+                             'slice mesh (default: hosts left over '
+                             'after the tensor factor).')
+    parser.add_argument('--slice-tensor', type=int, default=None,
+                        help='Pin the tensor-axis factor of the slice '
+                             'mesh (default: the largest divisor of '
+                             '--num-hosts the model shapes support).')
     parser.add_argument('--role',
                         default=_os.environ.get(
                             'SKYTPU_SERVE_REPLICA_ROLE', 'mixed'),
@@ -837,7 +961,11 @@ def main() -> None:
                          page_size=args.page_size,
                          quantize_kv=args.quantize_kv,
                          prefix_caching=not args.no_prefix_cache,
-                         role=args.role)
+                         role=args.role,
+                         num_hosts=args.num_hosts,
+                         sp_threshold=args.sp_threshold,
+                         slice_sequence=args.slice_sequence,
+                         slice_tensor=args.slice_tensor)
     if args.http_server == 'async':
         from skypilot_tpu.serve import async_server  # pylint: disable=import-outside-toplevel
         async_server.serve_forever(server, args.port)

@@ -47,6 +47,7 @@ _M_REPLICA_LOAD = metrics_lib.gauge(
 ENV_REPLICA_ID = 'SKYTPU_SERVE_REPLICA_ID'
 ENV_REPLICA_PORT = 'SKYTPU_SERVE_REPLICA_PORT'
 ENV_REPLICA_ROLE = 'SKYTPU_SERVE_REPLICA_ROLE'
+ENV_REPLICA_NUM_HOSTS = 'SKYTPU_SERVE_REPLICA_NUM_HOSTS'
 
 
 def _free_port() -> int:
@@ -95,17 +96,22 @@ class ReplicaManager:
     # ----------------------------------------------------------- scale up
 
     def scale_up(self, use_spot: Optional[bool] = None,
-                 role: str = 'mixed') -> int:
+                 role: str = 'mixed', num_hosts: int = 1) -> int:
         """Launch one replica asynchronously (into `role`'s pool);
-        returns its id."""
+        returns its id.  num_hosts > 1 launches it as a SLICE replica:
+        a gang of that many hosts serving as one unit
+        (serve/slice_replica.py — the model server reads
+        SKYTPU_SERVE_REPLICA_NUM_HOSTS)."""
         replica_id = serve_state.allocate_replica(
             self.service_name, self.service_name,
-            is_spot=bool(use_spot), version=self.version, role=role)
+            is_spot=bool(use_spot), version=self.version, role=role,
+            num_hosts=int(num_hosts))
         cluster_name = self._cluster_name(replica_id)
         port = _free_port() if self._is_local() else self.spec.replica_port
         thread = threading.Thread(
             target=self._launch_replica,
-            args=(replica_id, cluster_name, port, use_spot, role),
+            args=(replica_id, cluster_name, port, use_spot, role,
+                  num_hosts),
             daemon=True)
         with self._lock:
             self._launch_threads[replica_id] = thread
@@ -114,7 +120,8 @@ class ReplicaManager:
 
     def _launch_replica(self, replica_id: int, cluster_name: str,
                         port: int, use_spot: Optional[bool],
-                        role: str = 'mixed') -> None:
+                        role: str = 'mixed',
+                        num_hosts: int = 1) -> None:
         from skypilot_tpu import execution  # pylint: disable=import-outside-toplevel
         from skypilot_tpu.backends import backend_utils  # pylint: disable=import-outside-toplevel
         import copy  # pylint: disable=import-outside-toplevel
@@ -125,7 +132,15 @@ class ReplicaManager:
             # The model server's --role default: replicas of a role
             # pool advertise it without YAML changes per pool.
             ENV_REPLICA_ROLE: role,
+            # Slice width: the model server brings the replica up as a
+            # num_hosts gang (--num-hosts default).
+            ENV_REPLICA_NUM_HOSTS: str(int(num_hosts)),
         })
+        if int(num_hosts) > 1 and getattr(task, 'num_nodes', 1) <= 1:
+            # The replica cluster must provision the whole slice: one
+            # node per host rank (the gang supervisor fans the run
+            # command out to every host).
+            task.num_nodes = int(num_hosts)
         if use_spot is not None:
             task.set_resources({
                 r.copy(use_spot=use_spot) for r in task.resources})
@@ -175,6 +190,7 @@ class ReplicaManager:
         if not url:
             return
         ready = False
+        degraded_slice = False
         try:
             # Chaos site: a raise here reads as a failed probe (replica
             # flap), never as a crashed reconcile loop.
@@ -184,6 +200,19 @@ class ReplicaManager:
             resp = requests.get(url + self.spec.readiness_path,
                                 timeout=self.spec.readiness_timeout_seconds)
             ready = resp.status_code == 200
+            if not ready:
+                # A multi-host slice replica that lost a rank reports
+                # slice.degraded on its 503 health payload — that is
+                # NOT a transient flap: the gang cannot re-form
+                # without a rebuild, so waiting out initial_delay just
+                # burns capacity.  Retire it now; the pool refills on
+                # the next reconcile.
+                try:
+                    payload = resp.json()
+                    degraded_slice = bool(
+                        (payload.get('slice') or {}).get('degraded'))
+                except (ValueError, TypeError):
+                    pass
             # Decode-saturation signal: the native model server's
             # health payload carries engine stats; remember
             # busy_slots/slots per replica so the controller can feed
@@ -222,6 +251,22 @@ class ReplicaManager:
             return
         self._last_load.pop(replica_id, None)
         self._last_stats.pop(replica_id, None)
+        if degraded_slice:
+            # One dead rank = the whole slice replica is done: surface
+            # the NOT_READY transition for the journal/staties, then
+            # tear it down and let the autoscaler replace it.  The LB
+            # keeps serving off the surviving replicas meanwhile
+            # (chaos scenario `replica_rank_death`).
+            logger.warning(
+                f'replica {replica_id} is a degraded slice (dead '
+                f'rank); retiring and replacing')
+            if status is ReplicaStatus.READY:
+                serve_state.set_replica_status(
+                    self.service_name, replica_id,
+                    ReplicaStatus.NOT_READY)
+            self.scale_down(replica_id,
+                            final_status=ReplicaStatus.FAILED_PROBING)
+            return
         if status is ReplicaStatus.READY:
             serve_state.set_replica_status(self.service_name, replica_id,
                                            ReplicaStatus.NOT_READY)
@@ -324,6 +369,7 @@ class ReplicaManager:
                 'load': self._last_load.get(rid, 0.0),
                 'page_size': stats.get('page_size'),
                 'queue_depth': stats.get('queue_depth', 0),
+                'num_hosts': r.get('num_hosts') or 1,
             })
         return infos
 

@@ -82,6 +82,7 @@ CREATE TABLE IF NOT EXISTS replicas (
     version INTEGER DEFAULT 1,
     launched_at REAL,
     role TEXT DEFAULT 'mixed',
+    num_hosts INTEGER DEFAULT 1,
     PRIMARY KEY (service_name, replica_id)
 )"""
 
@@ -94,6 +95,11 @@ def _migrate(conn: sqlite3.Connection) -> None:
     if 'role' not in columns:
         conn.execute("ALTER TABLE replicas ADD COLUMN role TEXT "
                      "DEFAULT 'mixed'")
+    if 'num_hosts' not in columns:
+        # Multi-host slice replicas (ISSUE 9): how many gang-scheduled
+        # hosts this replica spans; 1 for every pre-slice row.
+        conn.execute('ALTER TABLE replicas ADD COLUMN num_hosts '
+                     'INTEGER DEFAULT 1')
 
 
 def _db_path() -> str:
@@ -200,15 +206,15 @@ def update_service_spec(name: str, spec_json: Dict[str, Any],
 
 def add_replica(service_name: str, replica_id: int, cluster_name: str,
                 is_spot: bool = False, version: int = 1,
-                role: str = 'mixed') -> None:
+                role: str = 'mixed', num_hosts: int = 1) -> None:
     with _conn() as conn:
         conn.execute(
             'INSERT OR REPLACE INTO replicas (service_name, replica_id, '
-            'cluster_name, status, is_spot, version, launched_at, role) '
-            'VALUES (?,?,?,?,?,?,?,?)',
+            'cluster_name, status, is_spot, version, launched_at, role, '
+            'num_hosts) VALUES (?,?,?,?,?,?,?,?,?)',
             (service_name, replica_id, cluster_name,
              ReplicaStatus.PROVISIONING.value, int(is_spot), version,
-             time.time(), role))
+             time.time(), role, int(num_hosts)))
 
 
 def set_replica_status(service_name: str, replica_id: int,
@@ -245,17 +251,19 @@ def get_replicas(service_name: str) -> List[Dict[str, Any]]:
 
 def allocate_replica(service_name: str, cluster_prefix: str,
                      is_spot: bool = False, version: int = 1,
-                     role: str = 'mixed') -> int:
+                     role: str = 'mixed', num_hosts: int = 1) -> int:
     """Atomically claim the next replica id and insert its row (ids stay
     monotonic and unique under concurrent scale-ups)."""
     with _conn() as conn:
         conn.execute(
             'INSERT INTO replicas (service_name, replica_id, '
-            'cluster_name, status, is_spot, version, launched_at, role) '
+            'cluster_name, status, is_spot, version, launched_at, role, '
+            'num_hosts) '
             "SELECT ?, COALESCE(MAX(replica_id), 0) + 1, '', ?, ?, ?, "
-            '?, ? FROM replicas WHERE service_name=?',
+            '?, ?, ? FROM replicas WHERE service_name=?',
             (service_name, ReplicaStatus.PROVISIONING.value,
-             int(is_spot), version, time.time(), role, service_name))
+             int(is_spot), version, time.time(), role, int(num_hosts),
+             service_name))
         rid = conn.execute(
             'SELECT MAX(replica_id) FROM replicas WHERE service_name=?',
             (service_name,)).fetchone()[0]

@@ -45,12 +45,20 @@ class RolePool:
     upscale_delay_seconds: int = 300
     downscale_delay_seconds: int = 1200
     base_ondemand_fallback_replicas: int = 0
+    # Multi-host slice replicas: every replica of this pool is a gang
+    # of num_hosts hosts (serve/slice_replica.py) — weights sharded
+    # over the slice mesh, one HTTP front on rank 0, replica fails and
+    # is replaced as a unit.
+    num_hosts: int = 1
 
     def __post_init__(self) -> None:
         if self.role not in VALID_ROLES:
             raise exceptions.InvalidTaskError(
                 f'Unknown replica role {self.role!r}; one of '
                 f'{VALID_ROLES}')
+        if self.num_hosts < 1:
+            raise exceptions.InvalidTaskError(
+                f'{self.role}: num_hosts must be >= 1')
         if self.min_replicas < 0:
             raise exceptions.InvalidTaskError(
                 f'{self.role}: min_replicas must be >= 0')
@@ -150,7 +158,8 @@ class SkyServiceSpec:
                     pool_cfg,
                     {'replicas', 'min_replicas', 'max_replicas',
                      'target_qps_per_replica',
-                     'target_slot_utilization'}, f'roles.{role}')
+                     'target_slot_utilization', 'num_hosts'},
+                    f'roles.{role}')
                 if 'replicas' in pool_cfg:
                     n = int(pool_cfg.pop('replicas'))
                     pool_cfg.setdefault('min_replicas', n)
@@ -171,7 +180,8 @@ class SkyServiceSpec:
                         if pool_cfg.get('target_slot_utilization')
                         is not None else None),
                     upscale_delay_seconds=upscale_delay_seconds,
-                    downscale_delay_seconds=downscale_delay_seconds)
+                    downscale_delay_seconds=downscale_delay_seconds,
+                    num_hosts=int(pool_cfg.get('num_hosts', 1)))
             if sum(p.max_replicas for p in self.role_specs.values()) < 1:
                 raise exceptions.InvalidTaskError(
                     'roles must allow at least one replica in total')
@@ -299,6 +309,8 @@ class SkyServiceSpec:
                 if pool.target_slot_utilization is not None:
                     entry['target_slot_utilization'] = (
                         pool.target_slot_utilization)
+                if pool.num_hosts != 1:
+                    entry['num_hosts'] = pool.num_hosts
                 roles[role] = entry
             config['roles'] = roles
         return config

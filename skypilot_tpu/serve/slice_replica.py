@@ -1,0 +1,398 @@
+"""Slice-serving runtime: one replica = one gang-scheduled multi-host
+slice.
+
+ROADMAP item 3, the last pillar of the serving story.  Training
+already treats a TPU pod slice as the unit of compute (gang supervisor,
+`parallel/mesh.py`, fsdp/tp sharding); serving replicas were single
+processes.  This module makes "replica" mean "slice":
+
+- **Mesh.**  `build_slice_mesh(num_hosts, cfg)` lays the slice out as
+  `sequence x tensor` over its hosts (emulated hosts = one virtual
+  device each; real hosts contribute their local chips).  The tensor
+  factor takes as many hosts as the config's head/ff/vocab counts
+  divide — weights shard per `parallel/sharding.py`'s SpecLayout
+  (heads/mlp/vocab on 'tensor', embed on 'fsdp'), so a model too big
+  for one host spreads across the slice; the remainder lands on
+  'sequence' for long-context prefill.  The paged KV pool shards
+  through the existing `page_pool_sharding` (kv heads on 'tensor').
+- **Gang.**  :class:`SliceReplicaEngine` wraps the continuous-batching
+  engine with a rank protocol (`serve/coordinator.py`): rank 0 owns
+  the HTTP front (the LB keeps talking to ONE url) and broadcasts
+  every host-side scheduling decision — admit, prefill, tick — so all
+  ranks dispatch identical SPMD steps.  One dead rank fails the
+  replica AS A UNIT: the engine fails everything in flight, `/health`
+  turns 503 with ``slice.degraded``, the controller retires and
+  replaces the replica, and the LB re-routes to survivors (chaos
+  scenario ``replica_rank_death`` proves zero lost requests).
+- **Sequence-parallel prefill.**  Prompts at/above ``sp_threshold``
+  tokens skip the chunked-prefill ladder and run ONE
+  `models/decode.prefill_sp` shot: ring attention
+  (`ops/ring_attention.py`) splits the quadratic attention and its
+  activations across the slice's sequence axis, so a 100k-token
+  context that would OOM (or stall) one host prefills in ~1/hosts the
+  time (bench_serve.py `sp_prefill` pins the scaling).
+
+Emulated vs real:
+
+- *Emulated* (tests, CPU bench): all `num_hosts` virtual devices live
+  in this process (`xla_force_host_platform_device_count`); follower
+  ranks are `LocalRank` threads that execute the command log (and its
+  `serve.rank_exec` chaos site) while rank 0's dispatch covers every
+  device.
+- *Real slices*: each TPU-VM worker runs ``python -m
+  skypilot_tpu.serve.slice_replica`` under the gang supervisor.
+  Rank 0 (`SKYTPU_HOST_RANK=0`) initializes `jax.distributed`, accepts
+  follower connections on the coordinator port, and serves HTTP; ranks
+  > 0 connect and execute each broadcast command by dispatching the
+  same jitted step on their local devices (`follower_serve`).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import batching_engine as batching_engine_lib
+from skypilot_tpu.serve import coordinator as coordinator_lib
+
+logger = sky_logging.init_logger(__name__)
+
+# Port offset from the JAX coordinator for the serve rank protocol
+# (real slices; the gang env contract pins the jax.distributed port).
+SLICE_COORD_PORT_OFFSET = 17
+
+
+def sp_threshold_default() -> int:
+    """Prompt tokens at which a slice replica prefills sequence-
+    parallel instead of chunked (env SKYTPU_SLICE_SP_THRESHOLD)."""
+    return int(os.environ.get('SKYTPU_SLICE_SP_THRESHOLD', '1024'))
+
+
+def slice_axes(num_hosts: int, cfg,
+               tensor: Optional[int] = None,
+               sequence: Optional[int] = None) -> Dict[str, int]:
+    """Factor a slice's hosts into (sequence, tensor) mesh axes.
+
+    Default policy: tensor takes the LARGEST divisor of num_hosts the
+    config's shapes support (n_heads, n_kv_heads, d_ff, vocab_size all
+    divisible) — weight sharding is why the model needs a slice at all
+    — and the remainder rides 'sequence' for long-context prefill.
+    Either factor can be pinned explicitly (``--slice-sequence`` /
+    ``--slice-tensor``); they must multiply to num_hosts.
+    """
+    if num_hosts < 1:
+        raise ValueError(f'num_hosts must be >= 1, got {num_hosts}')
+    if tensor is not None and sequence is not None:
+        if tensor * sequence != num_hosts:
+            raise ValueError(
+                f'sequence ({sequence}) x tensor ({tensor}) must equal '
+                f'num_hosts ({num_hosts})')
+        return {'sequence': int(sequence), 'tensor': int(tensor)}
+    if sequence is not None:
+        if num_hosts % sequence:
+            raise ValueError(f'sequence ({sequence}) must divide '
+                             f'num_hosts ({num_hosts})')
+        return {'sequence': int(sequence),
+                'tensor': num_hosts // int(sequence)}
+    if tensor is None:
+        tensor = 1
+        for d in range(1, num_hosts + 1):
+            if num_hosts % d:
+                continue
+            if (cfg.n_heads % d or cfg.n_kv_heads % d or
+                    cfg.d_ff % d or cfg.vocab_size % d):
+                continue
+            tensor = d
+    if num_hosts % tensor:
+        raise ValueError(f'tensor ({tensor}) must divide num_hosts '
+                         f'({num_hosts})')
+    for dim, value in (('n_heads', cfg.n_heads),
+                       ('n_kv_heads', cfg.n_kv_heads),
+                       ('d_ff', cfg.d_ff),
+                       ('vocab_size', cfg.vocab_size)):
+        if value % tensor:
+            raise ValueError(
+                f'tensor={tensor} must divide {dim} ({value}); pin '
+                f'--slice-sequence to keep more hosts on the sequence '
+                f'axis')
+    return {'sequence': num_hosts // int(tensor), 'tensor': int(tensor)}
+
+
+def build_slice_mesh(num_hosts: int, cfg, *, devices=None,
+                     tensor: Optional[int] = None,
+                     sequence: Optional[int] = None):
+    """jax.sharding.Mesh for one slice replica: `sequence x tensor`
+    over the slice's devices (emulated host = one virtual device)."""
+    import jax  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.parallel import mesh as mesh_lib  # pylint: disable=import-outside-toplevel
+    axes = slice_axes(num_hosts, cfg, tensor=tensor, sequence=sequence)
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < num_hosts:
+        raise ValueError(
+            f'num_hosts={num_hosts} needs {num_hosts} devices; have '
+            f'{len(devices)} (emulated hosts ride '
+            f'xla_force_host_platform_device_count on CPU)')
+    return mesh_lib.build_mesh(
+        mesh_lib.MeshConfig(sequence=axes['sequence'],
+                            tensor=axes['tensor']),
+        devices=devices[:num_hosts])
+
+
+class SliceReplicaEngine(batching_engine_lib.ContinuousBatchingEngine):
+    """Continuous-batching engine whose replica is a multi-host slice.
+
+    Extends the base engine with (a) the slice mesh — weights, KV pool
+    and engine state land sharded/replicated per parallel/sharding.py;
+    (b) the rank protocol — every tick/admission broadcasts through the
+    SliceCoordinator before the SPMD dispatch, and a dead rank fails
+    the replica as a unit; (c) sequence-parallel prefill for prompts at
+    or above `sp_threshold` tokens."""
+
+    def __init__(self, cfg, params, *, num_hosts: int,
+                 sp_threshold: Optional[int] = None,
+                 sequence: Optional[int] = None,
+                 tensor: Optional[int] = None,
+                 mesh=None,
+                 rank_channels: Optional[List[Any]] = None,
+                 **kwargs) -> None:
+        import functools  # pylint: disable=import-outside-toplevel
+
+        import jax  # pylint: disable=import-outside-toplevel
+
+        from skypilot_tpu.models import decode  # pylint: disable=import-outside-toplevel
+        self.num_hosts = int(num_hosts)
+        self.sp_threshold = (sp_threshold_default()
+                             if sp_threshold is None
+                             else int(sp_threshold))
+        if mesh is None:
+            mesh = build_slice_mesh(self.num_hosts, cfg,
+                                    sequence=sequence, tensor=tensor)
+        self._slice_mesh = mesh
+        self._sp_degree = int(mesh.shape.get('sequence', 1))
+        self._coordinator = coordinator_lib.SliceCoordinator(
+            self.num_hosts, channels=rank_channels)
+        self._sp_prefills = 0
+        # One compile per padded prompt width (the bucket ladder bounds
+        # the count, same as the chunked path).
+        self._sp_prefill_jit = jax.jit(functools.partial(
+            decode.prefill_sp, cfg, mesh=mesh,
+            max_len=kwargs.get('max_len', 512)))
+        super().__init__(cfg, params, mesh=mesh, **kwargs)
+
+    # --------------------------------------------------- gang protocol
+
+    def _dispatch_step(self):
+        """Coordinated tick: rank 0 broadcasts TICK and waits for every
+        rank's ack (the `slice_sync_ms` overhead), then dispatches the
+        SPMD step.  RankDead propagates to the worker loop, which fails
+        the replica as a unit — a half-dead slice must never keep
+        half-serving."""
+        self._coordinator.tick()
+        return super()._dispatch_step()
+
+    def _start_admission(self, slot_id, request):
+        pending = super()._start_admission(slot_id, request)
+        request.span.slice_sync_ms = round(
+            self._coordinator.sync_ms_mean(), 4)
+        self._coordinator.broadcast(
+            coordinator_lib.CMD_ADMIT, slot=slot_id,
+            tokens=len(request.prompt_ids))
+        return pending
+
+    # ------------------------------------------------------ SP prefill
+
+    def _sp_padded_width(self, n_target: int) -> Optional[int]:
+        """Padded prompt width for the one-shot SP prefill: the bucket
+        of n_target, rounded up to a multiple of the sequence degree,
+        capped at max_len.  None = does not fit; use the chunked
+        path."""
+        sp = self._sp_degree
+        width = min(self._bucket(n_target), self.max_len)
+        width = -(-width // sp) * sp
+        if width > self.max_len:
+            width = -(-n_target // sp) * sp
+        if width > self.max_len:
+            return None
+        return width
+
+    def _try_sp_prefill(self, prompt_ids: List[int],
+                        n_target: int) -> Optional[Dict[str, Any]]:
+        """One-shot sequence-parallel prefill of [0, n_target), or None
+        when the prompt should take the chunked path (below threshold,
+        MoE, or padding does not fit)."""
+        import numpy as np  # pylint: disable=import-outside-toplevel
+        if (n_target < self.sp_threshold or self.cfg.n_experts > 0):
+            return None
+        width = self._sp_padded_width(n_target)
+        if width is None:
+            return None
+        jnp = self._jnp
+        padded = np.zeros((1, width), np.int32)
+        padded[0, :n_target] = prompt_ids[:n_target]
+        cache = self._sp_prefill_jit(self.params, jnp.asarray(padded))
+        with self._metrics_lock:
+            self._sp_prefills += 1
+        return dict(cache, index=jnp.asarray(n_target, jnp.int32))
+
+    def _advance_prefill(self, pending) -> bool:
+        request = pending.request
+        reuse = (pending.plan.n_reuse_tokens
+                 if pending.plan is not None else 0)
+        if (pending.cache is None and reuse == 0 and
+                not request.cancelled):
+            t0 = time.perf_counter()
+            cache = self._try_sp_prefill(request.prompt_ids,
+                                         pending.n_target)
+            if cache is not None:
+                pending.cache = cache
+                pending.consumed = pending.n_target
+                request.span.mark_prefill_chunk(
+                    time.perf_counter() - t0)
+                self._record_chunk()
+                self._coordinator.broadcast(
+                    coordinator_lib.CMD_PREFILL,
+                    slot=pending.slot_id, tokens=pending.n_target,
+                    sp=self._sp_degree)
+                return self._finish_prefill(pending)
+        return super()._advance_prefill(pending)
+
+    def _prefill_private(self, prompt_ids: List[int],
+                         n_target: int) -> Dict[str, Any]:
+        """Export-side prefill (`export_prefill`): long prompts go
+        sequence-parallel here too — a prefill-role slice exports
+        100k-token KV without the chunk ladder."""
+        cache = self._try_sp_prefill(prompt_ids, n_target)
+        if cache is not None:
+            return cache
+        return super()._prefill_private(prompt_ids, n_target)
+
+    # ----------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, Any]:
+        stats = super().stats()
+        slice_stats = self._coordinator.stats()
+        with self._metrics_lock:
+            slice_stats['sp_prefills'] = self._sp_prefills
+        slice_stats['sp_degree'] = self._sp_degree
+        slice_stats['tensor_degree'] = int(
+            self._slice_mesh.shape.get('tensor', 1))
+        slice_stats['sp_threshold'] = self.sp_threshold
+        stats['num_hosts'] = self.num_hosts
+        stats['slice'] = slice_stats
+        return stats
+
+    def stop(self) -> None:
+        super().stop()
+        self._coordinator.close()
+
+
+# ----------------------------------------------------------- real slices
+
+
+def follower_main(rank: int, coordinator_address: str) -> None:
+    """Rank > 0 of a REAL slice: connect to rank 0's rank-protocol
+    port and execute the command log.  The executor is where a real
+    deployment dispatches its local shard of each jitted step; the
+    emulated tier keeps device work on rank 0 (all virtual devices are
+    local there), so this process just holds the gang together."""
+    sock = coordinator_lib.follower_connect(coordinator_address, rank)
+    logger.info(f'slice follower rank {rank} connected to '
+                f'{coordinator_address}')
+    coordinator_lib.follower_serve(sock, rank)
+
+
+def _bench_prefill(args) -> None:
+    """--bench-prefill: time ONE sequence-parallel prefill at a given
+    host count (used by bench_serve.py's long-context scaling probe;
+    each invocation is its own process so CPU affinity can model
+    per-host compute)."""
+    import flax.linen as nn  # pylint: disable=import-outside-toplevel
+    import jax  # pylint: disable=import-outside-toplevel
+    import jax.numpy as jnp  # pylint: disable=import-outside-toplevel
+    import numpy as np  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.models import configs  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.models import decode  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.models.transformer import Transformer  # pylint: disable=import-outside-toplevel
+
+    cfg = configs.get_config(args.model)
+    params = nn.meta.unbox(Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))['params'])
+    n = int(args.prompt_len)
+    sp = int(args.sequence or args.num_hosts)
+    width = -(-n // sp) * sp
+    max_len = width + 16
+    mesh = build_slice_mesh(args.num_hosts, cfg, sequence=sp)
+    rng = np.random.default_rng(0)
+    tokens = np.zeros((1, width), np.int32)
+    tokens[0, :n] = rng.integers(1, cfg.vocab_size - 1, size=n)
+    tokens = jnp.asarray(tokens)
+    fn = jax.jit(lambda p, t: decode.prefill_sp(cfg, p, t, mesh=mesh,
+                                                max_len=max_len))
+    cache = fn(params, tokens)             # compile
+    jax.block_until_ready(cache)
+    times = []
+    for _ in range(int(args.iters)):
+        t0 = time.perf_counter()
+        cache = fn(params, tokens)
+        jax.block_until_ready(cache)
+        times.append(time.perf_counter() - t0)
+    print(json.dumps({
+        'num_hosts': int(args.num_hosts),
+        'sequence': sp,
+        'tensor': int(mesh.shape.get('tensor', 1)),
+        'prompt_len': n,
+        'prefill_s': sorted(times)[len(times) // 2],
+        'prefill_s_all': [round(t, 6) for t in times],
+    }))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--num-hosts', type=int,
+                        default=int(os.environ.get(
+                            'SKYTPU_NUM_HOSTS', '1')))
+    parser.add_argument('--rank', type=int,
+                        default=int(os.environ.get(
+                            'SKYTPU_HOST_RANK', '0')))
+    parser.add_argument('--coordinator',
+                        default=os.environ.get(
+                            'SKYTPU_COORDINATOR_ADDRESS'))
+    parser.add_argument('--model', default='tiny')
+    parser.add_argument('--bench-prefill', action='store_true')
+    parser.add_argument('--prompt-len', type=int, default=2048)
+    parser.add_argument('--sequence', type=int, default=None)
+    parser.add_argument('--iters', type=int, default=3)
+    args, extra = parser.parse_known_args()
+    if args.bench_prefill:
+        _bench_prefill(args)
+        return
+    if args.rank > 0:
+        # Follower rank of a real slice: the rank-protocol port is the
+        # JAX coordinator's + a fixed offset.
+        if not args.coordinator:
+            raise SystemExit('rank > 0 needs --coordinator (or the '
+                             'gang env contract)')
+        host, _, port = args.coordinator.rpartition(':')
+        follower_main(args.rank,
+                      f'{host}:{int(port) + SLICE_COORD_PORT_OFFSET}')
+        return
+    # Rank 0: hand over to the model server CLI with num_hosts set —
+    # one entrypoint for `run: python -m skypilot_tpu.serve.
+    # slice_replica` task YAMLs.
+    import sys  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.serve import model_server  # pylint: disable=import-outside-toplevel
+    sys.argv = ([sys.argv[0], '--num-hosts', str(args.num_hosts),
+                 '--model', args.model, '--continuous-batching'] +
+                list(extra))
+    model_server.main()
+
+
+if __name__ == '__main__':
+    main()

@@ -47,7 +47,77 @@ if os.environ.get('SKYTPU_TPU_TESTS') != '1':
         os.environ['XLA_FLAGS'] = (
             _flags + ' --xla_force_host_platform_device_count=8').strip()
 
+import time as _time  # noqa: E402
+
 import pytest  # noqa: E402
+
+# ----------------------------------------------------- tier-1 time budget
+# The tier-1 verify command hard-kills the suite at 870s (`timeout -k 10
+# 870`).  A suite that finishes at 860s is one flaky rerun away from a
+# kill with NO failure attribution — so when a full tier-1 run crosses
+# the trip fraction of the budget, this guard FAILS the run explicitly
+# and names the top-10 slowest tests (the ones to slow-mark or speed
+# up).  Partial dev runs (< _TIER1_MIN_ITEMS collected tests) never
+# trip.
+
+_TIER1_BUDGET_ENV = 'SKYTPU_TIER1_WALLCLOCK_BUDGET_S'
+_TIER1_DEFAULT_BUDGET_S = 870.0
+_TIER1_TRIP_FRACTION = 0.92
+_TIER1_MIN_ITEMS = 400
+
+_session_t0 = _time.monotonic()
+_test_durations = {}
+
+
+def tier1_wallclock_violation(elapsed_s, n_items, durations,
+                              budget_s=_TIER1_DEFAULT_BUDGET_S,
+                              trip_fraction=_TIER1_TRIP_FRACTION,
+                              min_items=_TIER1_MIN_ITEMS):
+    """Pure guard logic (unit-tested in test_wallclock_guard.py):
+    returns the failure report string, or None when within budget or
+    not a full-suite run."""
+    if n_items < min_items:
+        return None
+    trip_s = budget_s * trip_fraction
+    if elapsed_s <= trip_s:
+        return None
+    slowest = sorted(durations.items(), key=lambda kv: -kv[1])[:10]
+    lines = [
+        f'tier-1 wall clock {elapsed_s:.0f}s exceeded the guard '
+        f'threshold {trip_s:.0f}s ({trip_fraction:.0%} of the '
+        f'{budget_s:.0f}s timeout budget) — slow-mark or speed up the '
+        f'worst offenders before the hard timeout starts killing CI '
+        f'runs with no attribution.',
+        'Top 10 slowest tests:',
+    ]
+    lines += [f'  {dur:8.1f}s  {nodeid}' for nodeid, dur in slowest]
+    return '\n'.join(lines)
+
+
+def pytest_sessionstart(session):
+    del session
+    global _session_t0
+    _session_t0 = _time.monotonic()
+
+
+def pytest_runtest_logreport(report):
+    if report.when == 'call':
+        _test_durations[report.nodeid] = report.duration
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtestloop(session):
+    yield
+    budget = float(os.environ.get(_TIER1_BUDGET_ENV,
+                                  _TIER1_DEFAULT_BUDGET_S))
+    message = tier1_wallclock_violation(
+        _time.monotonic() - _session_t0, len(session.items),
+        _test_durations, budget_s=budget)
+    if message is not None:
+        import sys as _sys
+        print(f'\nFAILED (wall-clock guard)\n{message}',
+              file=_sys.stderr)
+        session.testsfailed += 1
 
 
 def _reap_daemons(home: str) -> None:
@@ -219,7 +289,8 @@ _SLOW_TESTS = {
                        'test_prefill_logits_match_full_forward',
                        'test_batched_step_matches_per_sequence_decode',
                        'test_multi_step_generation_parity'),
-    'test_chaos.py': ('test_elastic_expand_round_trip',),
+    'test_chaos.py': ('test_elastic_expand_round_trip',
+                      'test_replica_rank_death_full_rebuild'),
     'test_distributed_bootstrap.py': (
         'test_two_process_bootstrap_and_psum',),
     'test_elastic.py': (
@@ -243,6 +314,8 @@ _SLOW_TESTS = {
                          'test_tied_embeddings_not_quantized_path'),
     'test_serve_cluster_mode.py': ('test_',),
     'test_serve_real_checkpoint.py': ('test_',),
+    'test_slice_replica.py': ('test_two_host_through_lb',
+                              'test_four_host_through_lb'),
     'test_usage.py': ('test_exec_records_separately',),
     'test_stress.py': ('test_',),
 }

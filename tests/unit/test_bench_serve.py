@@ -27,7 +27,7 @@ def test_bench_serve_smoke(tmp_path):
         [sys.executable, os.path.join(_REPO_ROOT, 'bench_serve.py'),
          '--smoke', '--out', out_path],
         cwd=_REPO_ROOT, env=env, capture_output=True, text=True,
-        timeout=480, check=False)
+        timeout=600, check=False)
     assert proc.returncode == 0, proc.stderr[-2000:]
     with open(out_path, encoding='utf-8') as f:
         data = json.load(f)
@@ -93,3 +93,18 @@ def test_bench_serve_smoke(tmp_path):
     assert disagg['disaggregated']['chat_tokens_in_burst_window'] > 50, \
         disagg
     assert disagg['itl_p99_ratio_vs_mixed'] <= 0.75, disagg
+    # Binary KV-handoff wire (ISSUE 9 satellite): the octet-stream
+    # frame must ship the SAME pages in materially fewer bytes than
+    # the JSON/base64 wire (theory ~0.75x from dropping base64; the
+    # floor leaves headroom for header overhead on tiny payloads).
+    wire = disagg['handoff_wire']
+    assert wire['binary_bytes'] > 0 and wire['json_bytes'] > 0, wire
+    assert wire['bytes_ratio'] <= 0.85, wire
+    # Multi-host slice prefill (ISSUE 9 tentpole): a 2-host emulated
+    # slice (sequence-parallel ring attention, each host bringing its
+    # own cores) must prefill the long context faster than one host.
+    # Observed ~1.3x on the CI box; 1.05x is the flake-proof floor —
+    # the claim is "improves with host count", pinned conservatively.
+    sp = data['sp_prefill']
+    assert sp['per_hosts']['1']['prefill_s'] > 0, sp
+    assert sp['prefill_speedup_2x'] >= 1.05, sp

@@ -509,6 +509,35 @@ class TestScenarios:
         assert [f['site'] for f in result.fault_sequence] == \
             ['serve.kv_handoff']
 
+    def test_replica_rank_death(self, local_infra):
+        """One rank of a 2-host slice replica dies mid-service -> the
+        replica fails AS A UNIT (503 + slice.degraded), the LB
+        re-routes every request to the surviving replica (zero lost,
+        journal-verified via handoff_consistency), and the controller
+        probe retires the slice for replacement (ISSUE 9)."""
+        result = scenarios_lib.run_scenario('replica_rank_death',
+                                            seed=31)
+        assert result.ok, (result.violations, result.details)
+        assert all(s == 200
+                   for s in result.details['statuses_during_death'])
+        assert result.details['slice_health_status'] == 503
+        assert result.details['slice']['degraded'] is True
+        assert result.details['slice']['dead_ranks'] == [1]
+        assert result.details['retired_status'] == 'FAILED_PROBING'
+        assert result.details['status_after_retire'] == 200
+        assert [f['site'] for f in result.fault_sequence] == \
+            ['serve.rank_exec']
+
+    def test_replica_rank_death_full_rebuild(self, local_infra):
+        """Slow variant: the full rebuild roundtrip — a fresh slice
+        replica takes the dead one's place, probes READY, and serves
+        the same pinned session through the LB."""
+        result = scenarios_lib.run_scenario(
+            'replica_rank_death_rebuild', seed=32)
+        assert result.ok, (result.violations, result.details)
+        assert result.details['rebuilt_status'] == 'READY'
+        assert all(s == 200 for s in result.details['rebuilt_statuses'])
+
     def test_page_pool_exhaustion(self, local_infra):
         """KV page-pool denial must degrade to admission backpressure
         (QueueFull/429) — never an engine failure — and the serve

@@ -85,6 +85,7 @@ def test_each_site_instruments_its_documented_layer():
         'serve.replica_probe': ('serve/',),
         'serve.page_pool': ('serve/',),
         'serve.kv_handoff': ('serve/',),
+        'serve.rank_exec': ('serve/',),
         'skylet.tick': ('skylet/',),
         'checkpoint.save': ('data/',),
     }

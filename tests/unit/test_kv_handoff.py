@@ -198,6 +198,78 @@ def test_wire_payload_roundtrip_and_validation():
         handoff.decode_payload(dict(payload, k=payload['k'][:-8]))
 
 
+@pytest.mark.parametrize('quantized', [False, True],
+                         ids=['f32', 'int8'])
+def test_binary_wire_roundtrip(quantized):
+    """ISSUE 9 satellite: the octet-stream frame carries the same
+    fields byte-exact and materially smaller than the base64 JSON."""
+    import json
+
+    import numpy as np
+    rng = np.random.default_rng(0)
+    shape = (2, 3, 2, 8, 4)
+    if quantized:
+        k = rng.integers(-127, 128, size=shape).astype(np.int8)
+        v = rng.integers(-127, 128, size=shape).astype(np.int8)
+        ks = rng.random(shape[:4]).astype(np.float32)
+        vs = rng.random(shape[:4]).astype(np.float32)
+        blob = handoff.encode_binary([11, 22, 33], 8, k, v, ks, vs)
+        json_payload = handoff.encode_payload([11, 22, 33], 8, k, v,
+                                              ks, vs)
+    else:
+        k = rng.standard_normal(shape).astype(np.float32)
+        v = rng.standard_normal(shape).astype(np.float32)
+        blob = handoff.encode_binary([11, 22, 33], 8, k, v)
+        json_payload = handoff.encode_payload([11, 22, 33], 8, k, v)
+    decoded = handoff.decode_binary(blob)
+    assert decoded['hashes'] == [11, 22, 33]
+    assert decoded['page_size'] == 8
+    np.testing.assert_array_equal(decoded['k'], k)
+    np.testing.assert_array_equal(decoded['v'], v)
+    if quantized:
+        np.testing.assert_array_equal(decoded['k_scale'], ks)
+        np.testing.assert_array_equal(decoded['v_scale'], vs)
+    # The whole point: fewer bytes on the wire than JSON/base64.
+    assert len(blob) < 0.85 * len(json.dumps(json_payload).encode())
+
+
+def test_binary_wire_validation():
+    import numpy as np
+    k = np.zeros((2, 1, 2, 8, 4), np.float32)
+    blob = handoff.encode_binary([7], 8, k, k)
+    with pytest.raises(handoff.HandoffError, match='magic'):
+        handoff.decode_binary(b'not-a-frame')
+    with pytest.raises(handoff.HandoffError, match='truncated'):
+        handoff.decode_binary(blob[:-16])
+    with pytest.raises(handoff.HandoffError, match='trailing'):
+        handoff.decode_binary(blob + b'xx')
+
+
+def test_binary_export_import_token_exact(tiny):
+    """export_prefill(binary=True) -> decode_binary -> import_pages is
+    token-exact vs the single-replica reference — the int8 pool case
+    (wire q/scale land verbatim)."""
+    src = _engine(tiny, quantize_kv=True)
+    dst = _engine(tiny, quantize_kv=True)
+    ref = _engine(tiny, quantize_kv=True)
+    try:
+        prompt = list(range(1, 42))
+        blob = src.export_prefill(prompt, page_size=8, binary=True)
+        assert isinstance(blob, bytes)
+        decoded = handoff.decode_binary(blob)
+        imported, cached = dst.import_pages(
+            decoded['hashes'], decoded['page_size'],
+            decoded['k'], decoded['v'],
+            k_scale=decoded.get('k_scale'),
+            v_scale=decoded.get('v_scale'))
+        assert (imported, cached) == (5, 0)
+        assert dst.generate(prompt, 8, timeout=120) == \
+            ref.generate(prompt, 8, timeout=120)
+    finally:
+        for engine in (src, dst, ref):
+            engine.stop()
+
+
 def test_http_handoff_end_to_end_through_router(tiny):
     """Two model servers (prefill + decode roles) behind the routing
     LB: a long prompt is exported on the prefill replica, imported on

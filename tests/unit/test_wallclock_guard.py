@@ -1,0 +1,52 @@
+"""Unit tests for the tier-1 wall-clock guard (ISSUE 9 satellite):
+conftest fails a FULL tier-1 run that crosses the trip fraction of the
+870s timeout budget, naming the top-10 slowest tests."""
+from __future__ import annotations
+
+import importlib.util
+import pathlib
+
+
+def _load_conftest():
+    path = pathlib.Path(__file__).parents[1] / 'conftest.py'
+    spec = importlib.util.spec_from_file_location('_t1_conftest', path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+_conftest = _load_conftest()
+_guard = _conftest.tier1_wallclock_violation
+
+
+def test_within_budget_is_clean():
+    assert _guard(500.0, 800, {'a': 1.0}, budget_s=870.0) is None
+
+
+def test_partial_run_never_trips():
+    # A dev loop running one file must not be failed for slowness.
+    assert _guard(5000.0, 12, {'a': 1.0}, budget_s=870.0) is None
+
+
+def test_over_threshold_trips_with_top10():
+    durations = {f'tests/unit/test_x.py::t{i}': float(i)
+                 for i in range(1, 25)}
+    msg = _guard(860.0, 800, durations, budget_s=870.0)
+    assert msg is not None
+    assert 'Top 10 slowest' in msg
+    # The worst offender leads the report; the 10 slowest are named,
+    # the 14 fastest are not.
+    assert 't24' in msg and 't15' in msg
+    assert 't14' not in msg
+    assert '870' in msg
+
+
+def test_threshold_is_the_trip_fraction():
+    # 0.92 * 870 = 800.4: just under stays green, just over trips.
+    assert _guard(800.0, 800, {}, budget_s=870.0) is None
+    assert _guard(801.0, 800, {}, budget_s=870.0) is not None
+
+
+def test_budget_override():
+    assert _guard(300.0, 800, {}, budget_s=200.0) is not None
+    assert _guard(300.0, 800, {}, budget_s=2000.0) is None
