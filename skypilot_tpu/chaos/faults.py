@@ -84,6 +84,13 @@ SITES: Dict[str, str] = {
         'UNIT (/health 503 with slice.degraded), the controller '
         'retires and replaces it, and the LB re-routes to surviving '
         'replicas with zero lost requests',
+    'serve.controller_tick':
+        'serve controller reconcile pass (serve/controller.py '
+        'reconcile_once) — effect "deny" skips the tick (a wedged/'
+        'paused control plane: the LB must keep serving its last-'
+        'known replica set), "delay" slows it, a raise is a crashing '
+        'tick the run loop must survive; the serving data plane must '
+        'tolerate all three',
     'serve.kv_handoff':
         'KV page handoff import (serve/batching_engine.py '
         'import_pages, the decode side of prefill/decode '

@@ -31,6 +31,11 @@ The registry maps names (used by scenarios and the CLI) to checkers:
                            to local prefill, never loses or re-runs a
                            request), and every handoff start reaches
                            an ok/fallback end
+    drain_no_lost_requests graceful drain: after a replica's lb_retire
+                           nothing routes to it, every routed request
+                           completes exactly once, and every
+                           replica_drain_start reaches a terminal
+                           replica_drain_end
     no_injections          zero chaos_fault_injected events (clean runs)
 """
 from __future__ import annotations
@@ -294,6 +299,68 @@ def handoff_consistency(events: Sequence[Event]) -> List[str]:
     return violations
 
 
+def drain_no_lost_requests(events: Sequence[Event]) -> List[str]:
+    """Safety for graceful drain: once the LB processed a replica's
+    retire nudge (`lb_retire`), no generate is routed there again
+    (`lb_route` with that url) until a controller sync legitimately
+    re-adds the address (a NEW replica at the same url — tracked via a
+    later `replica_drain_start` for a different replica id is out of
+    scope for the scenarios that apply this), AND every routed request
+    still completes exactly once — a drain may cost a retry hop, never
+    a lost or double-executed request."""
+    violations = []
+    retired_at: Dict[str, bool] = {}
+    for e in events:
+        name = e.get('event')
+        if name == 'lb_retire':
+            url = e.get('url')
+            if url:
+                retired_at[url] = True
+        elif name == 'lb_route':
+            url = e.get('url')
+            if url and retired_at.get(url):
+                violations.append(
+                    f'request {e.get("request_id")} routed to {url} '
+                    f'AFTER its retire event (drain raced routing)')
+    routed = [e for e in _named(events, 'lb_route')
+              if e.get('request_id')]
+    done: Dict[str, int] = {}
+    for e in _named(events, 'serve_request_done'):
+        rid = e.get('request_id')
+        if rid:
+            done[rid] = done.get(rid, 0) + 1
+    for e in routed:
+        rid = e['request_id']
+        count = done.get(rid, 0)
+        if count == 0:
+            violations.append(
+                f'request {rid} was routed but never completed '
+                f'(lost across a drain?)')
+        elif count > 1:
+            violations.append(
+                f'request {rid} completed {count} times '
+                f'(double-executed)')
+    # Drain lifecycle liveness: every started drain terminates.
+    open_drains: Dict[Any, int] = {}
+    for e in events:
+        name = e.get('event')
+        key = (e.get('service'), e.get('replica_id'))
+        if name == 'replica_drain_start':
+            open_drains[key] = open_drains.get(key, 0) + 1
+        elif name == 'replica_drain_end':
+            open_drains[key] = open_drains.get(key, 0) - 1
+            if e.get('reason') not in ('drained', 'timeout', 'dead'):
+                violations.append(
+                    f'replica_drain_end for {key} carries unknown '
+                    f'reason {e.get("reason")!r}')
+    dangling = [k for k, n in open_drains.items() if n > 0]
+    if dangling:
+        violations.append(
+            f'replica_drain_start without replica_drain_end for '
+            f'{dangling}')
+    return violations
+
+
 def no_injections(events: Sequence[Event]) -> List[str]:
     """With no plan armed, the chaos subsystem must be invisible."""
     injected = _named(events, 'chaos_fault_injected')
@@ -313,6 +380,7 @@ CHECKERS: Dict[str, Callable[[Sequence[Event]], List[str]]] = {
     'checkpoint_liveness': checkpoint_liveness,
     'page_pool_balance': page_pool_balance,
     'handoff_consistency': handoff_consistency,
+    'drain_no_lost_requests': drain_no_lost_requests,
     'no_injections': no_injections,
 }
 

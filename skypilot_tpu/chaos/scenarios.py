@@ -21,6 +21,15 @@ Scenarios (CLI: ``sky chaos list`` / ``sky chaos run <name>``):
                            flaps NOT_READY and returns to READY; the
                            router re-pins prefix affinity off a dead
                            replica
+- ``drain_under_load``     scale-down + rolling replacement mid-
+                           traffic → zero non-2xx, no request routed
+                           to a retired replica, hot prefix pages
+                           handed to the surviving sibling
+- ``controller_crash_recovery`` controller killed/restarted mid-
+                           service (first new tick chaos-wedged) →
+                           fleet re-adopted from serve_state, warm-
+                           started autoscaler, zero churn on the first
+                           real reconcile pass
 - ``replica_rank_death``   one rank of a 2-host slice replica dies →
                            the replica fails AS A UNIT (503 +
                            slice.degraded), the LB re-routes with zero
@@ -1152,6 +1161,329 @@ def replica_rank_death(seed: int) -> ScenarioResult:
 def replica_rank_death_rebuild(seed: int) -> ScenarioResult:
     return _run_replica_rank_death('replica_rank_death_rebuild', seed,
                                    rebuild=True)
+
+
+@_register(
+    'drain_under_load',
+    'scale-down and a rolling replacement mid-traffic -> every client '
+    'request completes 2xx (the LB retire nudge + same-role retry '
+    'absorb the retirement), journal replay proves no request was '
+    'routed to a replica after its retire event, none was lost or '
+    'double-executed, and the retiring replica handed its hot prefix '
+    'pages to the surviving sibling')
+def drain_under_load(seed: int) -> ScenarioResult:
+    import random  # pylint: disable=import-outside-toplevel
+    import threading  # pylint: disable=import-outside-toplevel
+
+    import requests  # pylint: disable=import-outside-toplevel
+
+    import skypilot_tpu as sky  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import load_balancer as lb_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import model_server as model_server_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import replica_managers  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import router as router_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import serve_state  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import service_spec  # pylint: disable=import-outside-toplevel
+
+    t0 = time.time()
+    extra: List[str] = []
+    details: Dict[str, Any] = {}
+    serve_journal = events_lib.get_journal(
+        os.path.join(events_lib.journal_root(), 'serve.jsonl'))
+    service = f'chaos-drain-{seed}'
+
+    def make_server():
+        return model_server_lib.ModelServer(
+            'tiny', max_len=64, max_batch=2, continuous_batching=True,
+            kv_pages=48, page_size=8, prefill_chunk=16)
+
+    servers = [make_server(), make_server()]
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:1', router=router_lib.Router(threshold=10_000))
+    shutdowns: List[Any] = []
+    statuses: List[int] = []
+    statuses_lock = threading.Lock()
+    env_keys = {'SKYTPU_SERVE_HANDOFF_EVENTS': '1',
+                'SKYTPU_SERVE_DRAIN_TIMEOUT_S': '30'}
+    saved_env = {k: os.environ.get(k) for k in env_keys}
+    os.environ.update(env_keys)
+    try:
+        urls = []
+        for server in servers:
+            port, stop = model_server_lib.start_background(server)
+            shutdowns.append(stop)
+            urls.append(f'http://127.0.0.1:{port}')
+        lb.set_replicas([{'url': u, 'role': 'mixed'} for u in urls])
+        lb_port = lb.start()
+
+        # The replica fleet as the controller would see it: two READY
+        # rows pointing at the live servers; the LB port is registered
+        # so begin_drain's retire nudge finds it.
+        spec = service_spec.SkyServiceSpec(
+            initial_delay_seconds=120, readiness_timeout_seconds=5)
+        task = sky.Task(name='chaos-drain', run='sleep 1')
+        task.set_resources(sky.Resources(cloud='local'))
+        serve_state.add_service(service, spec_json={},
+                                task_yaml_path='')
+        serve_state.set_service_ports(service, 0, lb_port)
+        manager = replica_managers.ReplicaManager(service, spec, task)
+        rids = []
+        for url in urls:
+            rid = serve_state.allocate_replica(service, service)
+            serve_state.set_replica_status(
+                service, rid, serve_state.ReplicaStatus.READY, url=url)
+            rids.append(rid)
+
+        # Live Poisson traffic against the LB while the fleet churns.
+        stop_traffic = threading.Event()
+
+        def client(worker: int) -> None:
+            worker_rng = random.Random(f'{seed}:{worker}')
+            n = 0
+            while not stop_traffic.is_set() and n < 40:
+                # Long enough that the prefilled region [0, n-1) spans
+                # full 8-token pages — the drain-time prefix handoff
+                # needs cached pages to ship.
+                prompt = ([worker * 50 + (n % 7) + 1] +
+                          [3, 5, 7, 9, 11, 13, 15, 17] * 2 + [19, 21])
+                try:
+                    resp = requests.post(
+                        f'http://127.0.0.1:{lb_port}/generate',
+                        json={'prompt_ids': [prompt],
+                              'max_new_tokens': 6}, timeout=60)
+                    code = resp.status_code
+                except requests.RequestException:
+                    code = -1
+                with statuses_lock:
+                    statuses.append(code)
+                n += 1
+                time.sleep(worker_rng.expovariate(1 / 0.05))
+
+        threads = [threading.Thread(target=client, args=(w,),
+                                    daemon=True) for w in range(3)]
+        for t in threads:
+            t.start()
+
+        def wait_responses(count: int, timeout: float = 30.0) -> None:
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                with statuses_lock:
+                    if len(statuses) >= count:
+                        return
+                time.sleep(0.05)
+
+        def drain_and_wait(rid: int, reason: str,
+                           timeout: float = 30.0) -> str:
+            manager.scale_down(rid, drain=True, reason=reason)
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                row = next(r for r in serve_state.get_replicas(service)
+                           if r['replica_id'] == rid)
+                if serve_state.ReplicaStatus(
+                        row['status']).is_terminal():
+                    return row['status']
+                manager.sync_draining()
+                time.sleep(0.1)
+            return 'DRAIN_TIMEOUT'
+
+        # Phase 1: scale-down mid-traffic — replica 1 drains while
+        # replicas keep answering.
+        wait_responses(6)
+        details['scale_down_final'] = drain_and_wait(rids[0],
+                                                     'scale_down')
+        # Phase 2: rolling replacement — a fresh replica joins (the
+        # new version coming READY), then the remaining old replica
+        # drains, still under traffic.
+        replacement = make_server()
+        r_port, r_stop = model_server_lib.start_background(replacement)
+        shutdowns.append(r_stop)
+        r_url = f'http://127.0.0.1:{r_port}'
+        new_rid = serve_state.allocate_replica(service, service)
+        serve_state.set_replica_status(
+            service, new_rid, serve_state.ReplicaStatus.READY,
+            url=r_url)
+        lb.set_replicas([{'url': urls[1], 'role': 'mixed'},
+                         {'url': r_url, 'role': 'mixed'}])
+        wait_responses(14)
+        details['rolling_final'] = drain_and_wait(rids[1],
+                                                  'rolling_update')
+        stop_traffic.set()
+        for t in threads:
+            t.join(timeout=60)
+        servers.append(replacement)
+    finally:
+        lb.stop()
+        for stop in shutdowns:
+            stop()
+        for server in servers:
+            server.close()
+        for key, value in saved_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+    details['requests'] = len(statuses)
+    details['statuses'] = sorted(set(statuses))
+    _expect(len(statuses) >= 20,
+            f'traffic actually ran ({len(statuses)} requests)', extra)
+    _expect(all(s == 200 for s in statuses),
+            f'ZERO non-2xx client responses across both drains '
+            f'(got {details["statuses"]})', extra)
+    _expect(details.get('scale_down_final') == 'TERMINATED',
+            f'scale-down drain reached TERMINATED '
+            f'(got {details.get("scale_down_final")})', extra)
+    _expect(details.get('rolling_final') == 'TERMINATED',
+            f'rolling-update drain reached TERMINATED '
+            f'(got {details.get("rolling_final")})', extra)
+    serve_events = _since(serve_journal, t0)
+    drain_ends = [(e.get('replica_id'), e.get('reason'))
+                  for e in serve_events
+                  if e.get('event') == 'replica_drain_end']
+    details['drain_ends'] = drain_ends
+    _expect(len(drain_ends) == 2 and
+            all(reason == 'drained' for _, reason in drain_ends),
+            f'both drains finished by running dry, not timeout '
+            f'(got {drain_ends})', extra)
+    retires = [e.get('url') for e in serve_events
+               if e.get('event') == 'lb_retire']
+    details['lb_retires'] = retires
+    _expect(len(retires) == 2,
+            f'the LB processed both retire nudges (got {retires})',
+            extra)
+    handoffs = [e.get('status') for e in serve_events
+                if e.get('event') == 'drain_prefix_handoff']
+    details['prefix_handoffs'] = handoffs
+    _expect(any(s == 'ok' for s in handoffs),
+            f'hot prefix pages handed to a sibling (got {handoffs})',
+            extra)
+    return _finish('drain_under_load', seed, t0, serve_events,
+                   ['drain_no_lost_requests'], extra, details)
+
+
+@_register(
+    'controller_crash_recovery',
+    'controller killed and restarted mid-service (plus a chaos-wedged '
+    'first tick) -> the new controller re-adopts the live fleet from '
+    'serve_state, warm-starts the autoscaler at the live replica '
+    'count, and its first real reconcile pass neither launches nor '
+    'retires anything')
+def controller_crash_recovery(seed: int) -> ScenarioResult:
+    import skypilot_tpu as sky  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import serve_state  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import service_spec  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve.controller import SkyServeController  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.utils import common_utils  # pylint: disable=import-outside-toplevel
+
+    # The new controller's FIRST tick is wedged (deny) — recovery must
+    # already have adopted the fleet, and the next tick must still not
+    # churn it.
+    plan = faults_lib.FaultPlan(
+        seed=seed, name='controller_crash_recovery',
+        faults=[faults_lib.Fault(site='serve.controller_tick',
+                                 effect='deny', nth=[1],
+                                 max_times=1)])
+    t0 = time.time()
+    extra: List[str] = []
+    details: Dict[str, Any] = {}
+    service = f'chaos-ctl-crash-{seed}'
+    serve_journal = events_lib.get_journal(
+        os.path.join(events_lib.journal_root(), 'serve.jsonl'))
+
+    task = sky.Task(
+        name='chaos-ctl',
+        run='exec python3 -m http.server $SKYTPU_SERVE_REPLICA_PORT')
+    task.set_resources(sky.Resources(cloud='local'))
+    task.service = service_spec.SkyServiceSpec(
+        min_replicas=1, max_replicas=3, target_qps_per_replica=1.0,
+        initial_delay_seconds=60, readiness_timeout_seconds=2)
+    yaml_dir = common_utils.ensure_dir(
+        os.path.join(common_utils.skytpu_home(), 'serve'))
+    yaml_path = os.path.join(yaml_dir, f'{service}.yaml')
+    common_utils.dump_yaml(yaml_path, task.to_yaml_config())
+    serve_state.add_service(service, task.service.to_yaml_config(),
+                            yaml_path)
+
+    controller = None
+    try:
+        with _local_cloud_enabled():
+            controller = SkyServeController(service)
+            # Scale to 2 (as live traffic would have) and drive until
+            # both replicas serve.
+            controller.autoscalers['mixed'].target_num_replicas = 2
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                controller.reconcile_once()
+                if len(controller.replica_manager.ready_urls()) >= 2:
+                    break
+                time.sleep(0.5)
+            ready_before = sorted(
+                controller.replica_manager.ready_urls())
+            details['ready_before'] = ready_before
+            _expect(len(ready_before) == 2,
+                    f'fleet of 2 came up (got {ready_before})', extra)
+
+            # CRASH: the controller object is dropped cold — no
+            # teardown, no state flush.  The replicas keep serving.
+            controller.stop()
+            controller = None
+
+            with _armed(plan):
+                restarted = SkyServeController(service)
+                controller = restarted
+                restarted.recover_fleet()
+                target = restarted.autoscalers[
+                    'mixed'].target_num_replicas
+                details['warm_start_target'] = target
+                _expect(target == 2,
+                        f'autoscaler warm-started at the live count 2, '
+                        f'not min_replicas 1 (got {target})', extra)
+
+                def fleet_snapshot():
+                    return sorted(
+                        (r['replica_id'], r['status'])
+                        for r in serve_state.get_replicas(service)
+                        if not serve_state.ReplicaStatus(
+                            r['status']).is_terminal())
+
+                before = fleet_snapshot()
+                restarted.reconcile_once()   # wedged (deny) tick
+                restarted.reconcile_once()   # first REAL pass
+                after = fleet_snapshot()
+                details['fleet_before'] = before
+                details['fleet_after'] = after
+                _expect(before == after,
+                        f'no replica churn in the first post-restart '
+                        f'reconcile (before {before}, after {after})',
+                        extra)
+                _expect(all(s == 'READY' for _, s in after),
+                        f'every adopted replica stayed READY '
+                        f'(got {after})', extra)
+    finally:
+        if controller is not None:
+            controller.stop()
+            controller.replica_manager.terminate_all()
+
+    serve_events = _since(serve_journal, t0)
+    recovered = [e for e in serve_events
+                 if e.get('event') == 'controller_recovered']
+    details['recovered_events'] = [
+        (e.get('adopted'), e.get('draining_resumed'))
+        for e in recovered]
+    _expect(len(recovered) == 1,
+            f'exactly one controller_recovered journal event '
+            f'(got {len(recovered)})', extra)
+    if recovered:
+        _expect(len(recovered[0].get('adopted') or []) == 2,
+                f'both live replicas were re-adopted '
+                f'(got {recovered[0].get("adopted")})', extra)
+    injected = [e for e in _since(injector.chaos_journal(), t0)
+                if e.get('event') == 'chaos_fault_injected']
+    _expect(len(injected) == 1,
+            f'exactly one wedged-tick fault fired '
+            f'(got {len(injected)})', extra)
+    return _finish('controller_crash_recovery', seed, t0, serve_events,
+                   [], extra, details)
 
 
 @_register(

@@ -820,6 +820,58 @@ def _hist_quantile(parsed, name: str, q: float):
     return rows[-1][0]
 
 
+def _rank_lag(parsed) -> str:
+    """Tick lag across a slice replica's ranks, from the
+    skytpu_slice_rank_ticks_total{rank} counter: max - min ticks.  A
+    growing lag names a degraded-but-alive rank (visible during drains
+    and rolling updates, before the gang actually fails)."""
+    ticks = parsed.get('skytpu_slice_rank_ticks_total') or {}
+    per_rank = {}
+    for labels, value in ticks.items():
+        rank = dict(labels).get('rank')
+        if rank is not None:
+            per_rank[rank] = per_rank.get(rank, 0) + value
+    if len(per_rank) < 2:
+        return '-'
+    return f'{int(max(per_rank.values()) - min(per_rank.values()))}'
+
+
+def _serve_lb_table(records) -> None:
+    """One row per service's load balancer, scraped from its
+    /lb/metrics: controller-sync staleness (a dead controller shows up
+    HERE, before replicas start flapping unseen)."""
+    import requests  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.observability import metrics as metrics_lib  # pylint: disable=import-outside-toplevel
+    rows = []
+    for r in records:
+        lb_port = r.get('load_balancer_port')
+        if not lb_port:
+            continue
+        try:
+            resp = requests.get(
+                f'http://127.0.0.1:{lb_port}/lb/metrics', timeout=5)
+            resp.raise_for_status()
+            parsed = metrics_lib.parse_exposition(resp.text)
+            age = sum((parsed.get(
+                'skytpu_lb_controller_sync_age_seconds') or {})
+                .values())
+            retries = sum((parsed.get('skytpu_lb_retries_total')
+                           or {}).values())
+            retired = sum((parsed.get('skytpu_lb_retired_total')
+                           or {}).values())
+            rows.append((r['name'], lb_port, f'{age:.0f}s',
+                         int(retries), int(retired)))
+        except (requests.RequestException, ValueError) as e:
+            rows.append((r['name'], lb_port,
+                         f'scrape failed: {e}', '-', '-'))
+    if not rows:
+        return
+    click.echo('')
+    _print_table(['SERVICE', 'LB PORT', 'SYNC AGE', 'RETRIES',
+                  'RETIRED'], rows)
+
+
 def _serve_metrics_table(records) -> None:
     """One row per READY replica, scraped live from GET /metrics
     (observability/metrics.py exposition on the model server)."""
@@ -847,7 +899,7 @@ def _serve_metrics_table(records) -> None:
             except (requests.RequestException, ValueError) as e:
                 rows.append((r['name'], rep['replica_id'], url, role,
                              num_hosts, f'scrape failed: {e}', '-',
-                             '-', '-', '-', '-', '-'))
+                             '-', '-', '-', '-', '-', '-'))
                 continue
 
             def total(name, parsed=parsed):
@@ -886,6 +938,7 @@ def _serve_metrics_table(records) -> None:
                 pages,
                 affinity,
                 int(total('skytpu_engine_queue_depth')),
+                _rank_lag(parsed),
                 f'{fmt_ms(_hist_quantile(parsed, "skytpu_engine_ttft_seconds", 0.5))}'
                 f'/{fmt_ms(_hist_quantile(parsed, "skytpu_engine_ttft_seconds", 0.99))}',
                 f'{fmt_ms(_hist_quantile(parsed, "skytpu_engine_itl_seconds", 0.5))}'
@@ -893,11 +946,13 @@ def _serve_metrics_table(records) -> None:
             ))
     if not rows:
         click.echo('No READY replicas to scrape.')
-        return
-    click.echo('')
-    _print_table(['SERVICE', 'REPLICA', 'URL', 'ROLE', 'HOSTS',
-                  'TOK/S', 'SLOTS', 'KV PAGES', 'AFFINITY', 'QUEUE',
-                  'TTFT p50/p99', 'ITL p50/p99'], rows)
+    else:
+        click.echo('')
+        _print_table(['SERVICE', 'REPLICA', 'URL', 'ROLE', 'HOSTS',
+                      'TOK/S', 'SLOTS', 'KV PAGES', 'AFFINITY',
+                      'QUEUE', 'RANK LAG', 'TTFT p50/p99',
+                      'ITL p50/p99'], rows)
+    _serve_lb_table(records)
 
 
 @serve_group.command(name='down')

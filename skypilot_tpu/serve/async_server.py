@@ -74,14 +74,30 @@ class _HttpError(Exception):
 def _backpressure_error(e: Exception) -> Optional[_HttpError]:
     """Admission-control pushback as honest HTTP: 429 + Retry-After
     when the engine queue is full, 503 + Retry-After when the request
-    expired queued — so the LB/client backs off instead of timing out."""
+    expired queued, 504 when its own deadline passed — so the
+    LB/client backs off instead of timing out."""
     if isinstance(e, batching_engine_lib.QueueFull):
         return _HttpError(429, str(e),
                           {'Retry-After': str(int(e.retry_after))})
     if isinstance(e, batching_engine_lib.QueueExpired):
         return _HttpError(503, str(e),
                           {'Retry-After': str(int(e.retry_after))})
+    if isinstance(e, batching_engine_lib.DeadlineExceeded):
+        return _HttpError(504, str(e))
     return None
+
+
+def _deadline_ms(headers: Dict[str, str]) -> Optional[float]:
+    """The request's X-SkyTPU-Deadline-Ms (lower-cased header map),
+    else the replica's env default."""
+    raw = headers.get(router_lib.DEADLINE_HEADER.lower())
+    if raw:
+        try:
+            ms = float(raw)
+            return ms if ms > 0 else None
+        except ValueError:
+            pass
+    return model_server_lib.default_deadline_ms()
 
 
 async def _read_request(reader: asyncio.StreamReader
@@ -131,7 +147,8 @@ def _json_response(code: int, payload: Dict[str, Any],
               408: 'Request Timeout', 413: 'Payload Too Large',
               429: 'Too Many Requests',
               500: 'Internal Server Error',
-              503: 'Service Unavailable'}.get(code, 'Error')
+              503: 'Service Unavailable',
+              504: 'Gateway Timeout'}.get(code, 'Error')
     extra = ''.join(f'{k}: {v}\r\n'
                     for k, v in (headers or {}).items())
     return (f'HTTP/1.1 {code} {reason}\r\n'
@@ -168,6 +185,7 @@ class AsyncModelServer:
             'model': f'{server.cfg.d_model}x{server.cfg.n_layers}',
             'role': server.role,
             'num_hosts': server.num_hosts,
+            'draining': server.draining,
         }
         engine = server._engine  # pylint: disable=protected-access
         code = 200
@@ -192,21 +210,76 @@ class AsyncModelServer:
                 int(req.get('seed', server.default_seed)))
 
     async def _generate(self, req: Dict[str, Any], rid: str,
-                        route_meta: Optional[Dict[str, Any]] = None
+                        route_meta: Optional[Dict[str, Any]] = None,
+                        deadline_ms: Optional[float] = None,
+                        reader: Optional[asyncio.StreamReader] = None,
+                        watch_disconnect: bool = False
                         ) -> Dict[str, Any]:
         t0 = time.perf_counter()
         temperature, top_k, seed = self._sampling(req)
-        tokens = await asyncio.get_running_loop().run_in_executor(
+        handles: list = []
+        loop = asyncio.get_running_loop()
+        gen = loop.run_in_executor(
             None, lambda: self.server.generate(
                 req['prompt_ids'],
                 int(req.get('max_new_tokens', 16)),
                 temperature, top_k, seed=seed, request_id=rid,
-                route_meta=route_meta))
+                route_meta=route_meta, deadline_ms=deadline_ms,
+                on_submit=handles.extend))
+        if watch_disconnect and reader is not None:
+            # Connection: close (the LB's routed path, one-shot
+            # clients): no further request bytes are legitimate, so a
+            # read completing with EOF IS the client hanging up —
+            # cancel the engine slots instead of decoding to a dead
+            # socket.  Data would mean a protocol violation; treat it
+            # the same and let the write path surface the error.
+            watchdog = asyncio.ensure_future(reader.read(1))
+            done, _ = await asyncio.wait(
+                {gen, watchdog}, return_when=asyncio.FIRST_COMPLETED)
+            if gen not in done:
+                for handle in handles:
+                    handle.cancel()
+                # The executor call returns promptly once the worker
+                # reaps the cancelled slots; await it so nothing leaks.
+                try:
+                    await gen
+                except Exception:  # pylint: disable=broad-except
+                    pass
+                raise model_server_lib.ClientDisconnected(
+                    'client disconnected mid-generation')
+            watchdog.cancel()
+            tokens = gen.result()
+        else:
+            tokens = await gen
         model_server_lib._maybe_journal_request(  # pylint: disable=protected-access
             'serve_request_done', request_id=rid, status='ok',
             tokens=sum(len(t) for t in tokens))
         return {'tokens': tokens,
                 'latency_ms': round((time.perf_counter() - t0) * 1e3, 1)}
+
+    def _reject_if_draining(self) -> None:
+        """503 + Retry-After for new generation work on a draining
+        replica — the LB's same-role retry lands it on a sibling."""
+        if self.server.draining:
+            model_server_lib._M_DRAIN_REJECTED.inc()  # pylint: disable=protected-access
+            raise _HttpError(503, 'replica is draining',
+                             {'Retry-After': '5'})
+
+    async def _prefix_export(self, req: Dict[str, Any],
+                             binary: bool = False) -> Any:
+        """Drain-time sibling handoff: export the hottest prefix-cache
+        POOL pages (no prefill runs); allowed while draining."""
+        engine = self.server._engine  # pylint: disable=protected-access
+        if engine is None:
+            raise _HttpError(400, 'prefix export requires '
+                                  '--continuous-batching')
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, lambda: engine.export_prefix_pages(
+                    max_pages=int(req.get('max_pages', 64)),
+                    binary=binary))
+        except handoff_lib.HandoffError as e:
+            raise _HttpError(404, str(e)) from e
 
     async def _prefill_export(self, req: Dict[str, Any],
                               binary: bool = False) -> Any:
@@ -217,6 +290,7 @@ class AsyncModelServer:
         if engine is None:
             raise _HttpError(400, 'KV handoff requires '
                                   '--continuous-batching')
+        self._reject_if_draining()
         prompt = req['prompt_ids']
         if (isinstance(prompt, list) and prompt and
                 isinstance(prompt[0], list)):
@@ -242,6 +316,8 @@ class AsyncModelServer:
         if engine is None:
             raise _HttpError(400, 'KV handoff requires '
                                   '--continuous-batching')
+        # Imported pages would die with this replica anyway.
+        self._reject_if_draining()
         try:
             imported, cached = (
                 await asyncio.get_running_loop().run_in_executor(
@@ -259,8 +335,10 @@ class AsyncModelServer:
     async def _generate_text(self, req: Dict[str, Any],
                              writer: asyncio.StreamWriter,
                              rid: str,
-                             route_meta: Optional[Dict[str, Any]] = None
+                             route_meta: Optional[Dict[str, Any]] = None,
+                             deadline_ms: Optional[float] = None
                              ) -> None:
+        self._reject_if_draining()
         server = self.server
         tok = server.tokenizer
         if server.cfg.vocab_size < tok.vocab_size:
@@ -276,7 +354,8 @@ class AsyncModelServer:
             raise _HttpError(400, 'prompt tokenized to nothing')
         if req.get('stream'):
             await self._stream(writer, ids, req, rid, text_mode=True,
-                               route_meta=route_meta)
+                               route_meta=route_meta,
+                               deadline_ms=deadline_ms)
             return
         t0 = time.perf_counter()
         temperature, top_k, seed = self._sampling(req)
@@ -285,7 +364,8 @@ class AsyncModelServer:
                 [ids], int(req.get('max_new_tokens', 64)),
                 temperature, top_k,
                 stop_token=tok.eos_ids or None, seed=seed,
-                request_id=rid, route_meta=route_meta)))[0]
+                request_id=rid, route_meta=route_meta,
+                deadline_ms=deadline_ms)))[0]
         stops = [i for i, t in enumerate(tokens) if t in tok.eos_ids]
         if stops:
             tokens = tokens[:stops[0]]
@@ -298,10 +378,12 @@ class AsyncModelServer:
 
     async def _stream(self, writer: asyncio.StreamWriter, ids, req,
                       rid: str, *, text_mode: bool,
-                      route_meta: Optional[Dict[str, Any]] = None
+                      route_meta: Optional[Dict[str, Any]] = None,
+                      deadline_ms: Optional[float] = None
                       ) -> None:
         """SSE over chunked transfer; token events or UTF-8-safe text
         deltas.  Purely event-driven: no thread parks waiting."""
+        self._reject_if_draining()
         server = self.server
         engine = server._engine  # pylint: disable=protected-access
         if engine is None:
@@ -322,7 +404,8 @@ class AsyncModelServer:
                 stop_token=stop_ids,
                 sampling=decode.SamplingConfig(
                     temperature=temperature, top_k=top_k, seed=seed),
-                request_id=rid, route_meta=route_meta)
+                request_id=rid, route_meta=route_meta,
+                deadline_ms=deadline_ms)
         except ValueError:
             raise
         except Exception as e:  # pylint: disable=broad-except
@@ -456,9 +539,21 @@ class AsyncModelServer:
                     rid = (headers.get(_REQUEST_ID_KEY) or
                            tracing.new_request_id())
                     meta = _route_meta(headers)
+                    deadline_ms = _deadline_ms(headers)
                     if path == '/generate':
+                        self._reject_if_draining()
+                        one_shot = 'close' in (
+                            headers.get('connection') or '').lower()
+                        try:
+                            payload = await self._generate(
+                                req, rid, meta,
+                                deadline_ms=deadline_ms,
+                                reader=reader,
+                                watch_disconnect=one_shot)
+                        except model_server_lib.ClientDisconnected:
+                            break  # no reply owed; slots already freed
                         writer.write(_json_response(
-                            200, await self._generate(req, rid, meta),
+                            200, payload,
                             {tracing.REQUEST_ID_HEADER: rid}))
                         await writer.drain()
                     elif path == '/generate_stream':
@@ -473,10 +568,33 @@ class AsyncModelServer:
                             prompt = prompt[0]
                         await self._stream(writer, prompt, req, rid,
                                            text_mode=False,
-                                           route_meta=meta)
+                                           route_meta=meta,
+                                           deadline_ms=deadline_ms)
                     elif path == '/generate_text':
                         await self._generate_text(req, writer, rid,
-                                                  meta)
+                                                  meta,
+                                                  deadline_ms=deadline_ms)
+                    elif path == '/drain':
+                        writer.write(_json_response(
+                            200, self.server.drain()))
+                        await writer.drain()
+                    elif path == '/prefix_export':
+                        binary = (req.get('wire') == 'binary' or
+                                  handoff_lib.CONTENT_TYPE_BINARY in
+                                  (headers.get('accept') or ''))
+                        result = await self._prefix_export(
+                            req, binary=binary)
+                        if binary:
+                            writer.write(
+                                (f'HTTP/1.1 200 OK\r\n'
+                                 f'Content-Type: '
+                                 f'{handoff_lib.CONTENT_TYPE_BINARY}'
+                                 f'\r\nContent-Length: '
+                                 f'{len(result)}\r\n\r\n'
+                                 ).encode() + result)
+                        else:
+                            writer.write(_json_response(200, result))
+                        await writer.drain()
                     elif path == '/prefill_export':
                         binary = (req.get('wire') == 'binary' or
                                   handoff_lib.CONTENT_TYPE_BINARY in
@@ -532,7 +650,10 @@ class AsyncModelServer:
             try:
                 writer.close()
                 await writer.wait_closed()
-            except (BrokenPipeError, ConnectionResetError, OSError):
+            except (BrokenPipeError, ConnectionResetError, OSError,
+                    RuntimeError):
+                # RuntimeError: loop already closed during shutdown —
+                # the transport dies with it either way.
                 pass
 
     # ------------------------------------------------------------ server

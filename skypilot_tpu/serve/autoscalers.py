@@ -55,6 +55,18 @@ class RequestRateAutoscaler:
 
     # ------------------------------------------------------------- inputs
 
+    def warm_start(self, live_replicas: int) -> None:
+        """Controller crash recovery: seed the scale target from the
+        fleet actually running instead of min_replicas.  A restarted
+        controller has no request history yet — without this, its
+        first reconcile pass reads 'target = min' and retires healthy
+        replicas (a scale-to-min cliff under live load).  The QPS
+        history refills from the LB's next sync."""
+        if live_replicas > 0:
+            self.target_num_replicas = max(
+                self.min_replicas,
+                min(live_replicas, self.max_replicas))
+
     def carry_over(self, old: 'RequestRateAutoscaler') -> None:
         """Adopt a predecessor's live state across a service update.
 

@@ -109,6 +109,7 @@ logger = sky_logging.init_logger(__name__)
 # ------------------------------------------------- compatibility facade
 QueueFull = scheduler.QueueFull
 QueueExpired = scheduler.QueueExpired
+DeadlineExceeded = scheduler.DeadlineExceeded
 PagesExhausted = cache_manager.PagesExhausted
 HandoffError = handoff_lib.HandoffError
 HandoffRejected = handoff_lib.HandoffRejected
@@ -148,6 +149,10 @@ _M_HANDOFF_IMPORTS = metrics_lib.counter(
     'skytpu_engine_handoff_imports_total',
     'KV page imports (the decode side of a handoff), by result.',
     ('result',))
+_M_DEADLINE_REAPED = metrics_lib.counter(
+    'skytpu_engine_deadline_reaped_total',
+    'Decoding requests cancelled mid-generation because their '
+    'X-SkyTPU-Deadline-Ms passed (slot and KV pages freed).')
 
 
 def _maybe_page_journal():
@@ -336,7 +341,8 @@ class ContinuousBatchingEngine:
     def submit(self, prompt_ids: List[int], max_new_tokens: int,
                stop_token=None, sampling=None,
                request_id: Optional[str] = None,
-               route_meta: Optional[Dict[str, Any]] = None
+               route_meta: Optional[Dict[str, Any]] = None,
+               deadline_ms: Optional[float] = None
                ) -> scheduler.Request:
         """stop_token: None, one id, or an iterable of ids — the
         request finishes at the FIRST generated member of the set
@@ -349,7 +355,12 @@ class ContinuousBatchingEngine:
         once per generated token, independent of other traffic).
 
         request_id: the propagated X-SkyTPU-Request-Id (generated when
-        absent); names the request's span record and timeline events."""
+        absent); names the request's span record and timeline events.
+
+        deadline_ms: total time budget from submission (the propagated
+        X-SkyTPU-Deadline-Ms).  Queued past it -> DeadlineExceeded at
+        pop; mid-decode past it -> the worker reaps the slot and frees
+        its KV pages on the next tick."""
         if not prompt_ids:
             raise ValueError('empty prompt')
         if max_new_tokens < 1:
@@ -366,7 +377,8 @@ class ContinuousBatchingEngine:
                                     stop_token, temperature=temperature,
                                     top_k=top_k, seed=seed,
                                     request_id=request_id,
-                                    route_meta=route_meta)
+                                    route_meta=route_meta,
+                                    deadline_ms=deadline_ms)
         request._span_store = self._spans  # pylint: disable=protected-access
         sampler_lib.validate_stop_ids(request.stop_ids,
                                       self.max_stop_ids)
@@ -629,6 +641,77 @@ class ContinuousBatchingEngine:
         _M_HANDOFF_IMPORTS.labels(result='ok').inc()
         return holder['result']
 
+    def export_prefix_pages(self, max_pages: int = 64,
+                            binary: bool = True) -> Any:
+        """Export the hottest prefix-cache pages as a handoff payload
+        (the drain-time sibling handoff: a retiring replica ships its
+        still-pinned session prefixes to a same-role survivor so those
+        sessions don't cold-start).  Unlike export_prefill this reads
+        the POOL pages the prefix cache pins — no prefill runs.
+
+        Returns the binary octet-stream frame (binary=True) or the
+        JSON/base64 dict; raises HandoffError when this engine has no
+        exportable prefixes (dense cache, prefix caching off, empty
+        cache)."""
+        import numpy as np  # pylint: disable=import-outside-toplevel
+        if self._kv is None:
+            raise HandoffError('prefix export needs a paged engine '
+                               '(--kv-pages)')
+        if not self._kv.prefix_caching:
+            raise HandoffError('prefix export needs the prefix cache')
+        if self._stop.is_set() or self._failed is not None:
+            raise RuntimeError('batching engine is stopped'
+                               if self._failed is None else
+                               f'batching engine failed: {self._failed}')
+        holder: Dict[str, Any] = {}
+        done = threading.Event()
+        encode = (handoff_lib.encode_binary if binary
+                  else handoff_lib.encode_payload)
+
+        def op() -> None:
+            # Worker thread: the pool cache and prefix cache are
+            # worker-owned; the gather below reads pages no tick
+            # mutates (full prefix pages are immutable once written).
+            try:
+                if self._stop.is_set():
+                    raise RuntimeError('batching engine stopped')
+                entries = self._kv.prefix.hot_entries(int(max_pages))
+                if not entries:
+                    raise HandoffError('no cached prefixes to export')
+                hashes = [h for h, _ in entries]
+                ids = np.asarray([p for _, p in entries], np.int32)
+                k = self._cache['k']
+                v = self._cache['v']
+                if self.quantize_kv:
+                    payload = encode(
+                        hashes, self._kv.page_size,
+                        np.asarray(k['q'][:, ids]),
+                        np.asarray(v['q'][:, ids]),
+                        np.asarray(k['scale'][:, ids]),
+                        np.asarray(v['scale'][:, ids]))
+                else:
+                    payload = encode(
+                        hashes, self._kv.page_size,
+                        np.asarray(k[:, ids], np.float32),
+                        np.asarray(v[:, ids], np.float32))
+                holder['result'] = payload
+            except BaseException as e:  # pylint: disable=broad-except
+                holder['error'] = e
+            finally:
+                done.set()
+
+        with self._host_ops_lock:
+            self._host_ops.append(op)
+        with self._cond:
+            self._cond.notify_all()
+        if not done.wait(timeout=60):
+            raise HandoffError('prefix export timed out waiting for '
+                               'the engine worker')
+        if 'error' in holder:
+            raise holder['error']
+        _M_HANDOFF_EXPORTS.inc()
+        return holder['result']
+
     def _drain_host_ops(self) -> None:
         while True:
             with self._host_ops_lock:
@@ -875,8 +958,14 @@ class ContinuousBatchingEngine:
         """
         jnp = self._jnp
         request = pending.request
-        if request.cancelled:
-            request._finish()  # pylint: disable=protected-access
+        if request.cancelled or request.deadline_exceeded():
+            if request.cancelled:
+                request._finish()  # pylint: disable=protected-access
+            else:
+                _M_DEADLINE_REAPED.inc()
+                request._finish(  # pylint: disable=protected-access
+                    scheduler.DeadlineExceeded(
+                        'request deadline passed mid-prefill'))
             self._slots[pending.slot_id].request = None
             if pending.plan is not None:
                 self._release_slot_pages(pending.slot_id)
@@ -1039,16 +1128,28 @@ class ContinuousBatchingEngine:
                 # Host ops (KV handoff imports) run between ticks: they
                 # mutate self._cache, which only this thread owns.
                 self._drain_host_ops()
-                # Cancelled live requests: freeze their slots on device
-                # before the next dispatch, free them for admission.
-                cancelled = [i for i, r in live.items() if r.cancelled]
-                if cancelled:
-                    self._deactivate(cancelled)
-                    for i in cancelled:
+                # Cancelled or deadline-expired live requests: freeze
+                # their slots on device before the next dispatch, free
+                # them (and their KV pages) for admission.  Deadline
+                # reaps finish with DeadlineExceeded so the HTTP front
+                # answers 504 instead of a silent truncation.
+                now = time.monotonic()
+                reaped = [(i, r.cancelled) for i, r in live.items()
+                          if r.cancelled or r.deadline_exceeded(now)]
+                if reaped:
+                    self._deactivate([i for i, _ in reaped])
+                    for i, was_cancel in reaped:
                         request = live.pop(i)
                         self._slots[i].request = None
                         self._release_slot_pages(i)
-                        request._finish()  # pylint: disable=protected-access
+                        if was_cancel:
+                            request._finish()  # pylint: disable=protected-access
+                        else:
+                            _M_DEADLINE_REAPED.inc()
+                            request._finish(  # pylint: disable=protected-access
+                                scheduler.DeadlineExceeded(
+                                    'request deadline passed '
+                                    'mid-generation'))
                 # Admissions: hand free slots to queued requests.  The
                 # prompt's chunks run interleaved with ticks below.
                 # Page-pool exhaustion DEFERS (the request goes back to
@@ -1198,6 +1299,11 @@ class ContinuousBatchingEngine:
             if req.cancelled:
                 self._slots[i].request = None
                 req._finish()  # pylint: disable=protected-access
+            elif req.deadline_exceeded():
+                self._slots[i].request = None
+                _M_DEADLINE_REAPED.inc()
+                req._finish(scheduler.DeadlineExceeded(  # pylint: disable=protected-access
+                    'request deadline passed mid-generation'))
         active = [i for i, s in enumerate(self._slots) if s.active]
         if not active:
             return

@@ -330,6 +330,15 @@ class PrefixCache:
         return sum(1 for page in self._entries.values()
                    if self._pool.refcount(page) == 0)
 
+    def hot_entries(self, n: int) -> List[Tuple[int, int]]:
+        """The n most-recently-used (hash, page) entries.  Entries are
+        independent hash->page mappings (a chain lookup walks its own
+        hashes), so any subset transfers cleanly.  Drain-time handoff
+        exports these to a surviving sibling so a retirement does not
+        cold-start every pinned session."""
+        items = list(self._entries.items())
+        return items[-n:] if n > 0 else []
+
     def clear(self) -> None:
         for h in list(self._entries):
             page = self._entries.pop(h)

@@ -15,10 +15,13 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler
 from http.server import ThreadingHTTPServer
-from typing import Optional
+from typing import Dict, List, Optional
+
+import requests
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.chaos import injector as chaos_injector
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.serve import autoscalers
 from skypilot_tpu.serve import replica_managers
@@ -51,6 +54,16 @@ _M_ROLE_TARGET = metrics_lib.gauge(
 
 def _sync_interval() -> float:
     return float(os.environ.get('SKYTPU_SERVE_SYNC_INTERVAL', '20'))
+
+
+def retirement_order(pool: List[Dict]) -> List[Dict]:
+    """Scale-down candidate order: not-ready replicas first, then the
+    NEWEST among equal status.  Newest-first matters: the oldest READY
+    replica has the warmest prefix cache (the sessions the router pins
+    there), so retiring it costs the most re-prefill — retire the
+    replica that has accumulated the least instead."""
+    return sorted(pool, key=lambda r: (
+        r['status'] == ReplicaStatus.READY.value, -r['replica_id']))
 
 
 class SkyServeController:
@@ -224,6 +237,10 @@ class SkyServeController:
         outdated = [r for r in replicas if r['version'] < self.version]
         if not outdated:
             return
+        draining = [r for r in outdated
+                    if r['status'] == ReplicaStatus.DRAINING.value]
+        pending = [r for r in outdated
+                   if r['status'] != ReplicaStatus.DRAINING.value]
         current_ready = [
             r for r in replicas
             if r['version'] == self.version and
@@ -234,15 +251,32 @@ class SkyServeController:
             return  # new-version capacity still coming up
         if self.spec.update_mode == 'blue_green':
             if len(current_ready) >= target:
-                for replica in outdated:
-                    self.replica_manager.scale_down(replica['replica_id'])
+                for replica in pending:
+                    self.replica_manager.scale_down(
+                        replica['replica_id'], drain=True,
+                        reason='blue_green_update')
             return
-        if current_ready:
-            self.replica_manager.scale_down(outdated[0]['replica_id'])
+        if draining:
+            # Rolling: the previously retired replica is still
+            # finishing its in-flight work — one graceful exit at a
+            # time keeps the capacity dip bounded to a single replica.
+            return
+        if current_ready and pending:
+            self.replica_manager.scale_down(pending[0]['replica_id'],
+                                            drain=True,
+                                            reason='rolling_update')
 
     # ---------------------------------------------------------- main loop
 
     def reconcile_once(self) -> None:
+        # Chaos site: raise = a crashing tick (run_loop survives it),
+        # delay = a slow control plane, deny = a wedged/skipped tick —
+        # the serve plane must tolerate all three (scenario
+        # controller_crash_recovery).
+        if chaos_injector.inject(
+                'serve.controller_tick',
+                service=self.service_name) is chaos_injector.DENY:
+            return
         self.reload_version()
         self.replica_manager.sync()
         replicas = self.replica_manager.active_replicas()
@@ -259,8 +293,12 @@ class SkyServeController:
             _M_ROLE_TARGET.labels(service=self.service_name,
                                   role=role).set(
                 decision.target_num_replicas)
+            # DRAINING replicas are already on their way out: they
+            # neither count toward the pool's capacity (or every pass
+            # would retire one more) nor are scale-down candidates.
             pool = [r for r in current_version
-                    if (r.get('role') or 'mixed') == role]
+                    if (r.get('role') or 'mixed') == role and
+                    r['status'] != ReplicaStatus.DRAINING.value]
             n_active = len(pool)
             if n_active < decision.target_num_replicas:
                 # Spot/on-demand mix: keep `num_ondemand` on-demand
@@ -280,20 +318,22 @@ class SkyServeController:
                             self.spec.role_specs[role], 'num_hosts', 1))
             elif n_active > decision.target_num_replicas:
                 extra = n_active - decision.target_num_replicas
-                # Retire not-ready first, then newest.
-                candidates = sorted(
-                    pool,
-                    key=lambda r: (
-                        r['status'] == ReplicaStatus.READY.value,
-                        r['replica_id']))
-                for replica in candidates[:extra]:
+                # Retire not-ready first, then NEWEST (retirement_order
+                # — the oldest replica holds the warmest prefix cache).
+                # READY replicas drain gracefully; the DRAINING row is
+                # excluded from the pool next pass, so the target math
+                # stays stable while the drain runs.
+                for replica in retirement_order(pool)[:extra]:
                     self.replica_manager.scale_down(
-                        replica['replica_id'])
+                        replica['replica_id'], drain=True,
+                        reason='scale_down')
         # Replicas whose role pool no longer exists in the spec (a
         # roles: change) have no autoscaler to own them — retire.
         for replica in current_version:
             if (replica.get('role') or 'mixed') not in self.autoscalers:
-                self.replica_manager.scale_down(replica['replica_id'])
+                self.replica_manager.scale_down(replica['replica_id'],
+                                                drain=True,
+                                                reason='role_removed')
         _M_TARGET_REPLICAS.labels(service=self.service_name).set(
             self._total_target())
         _M_QPS.labels(service=self.service_name).set(
@@ -314,6 +354,68 @@ class SkyServeController:
         else:
             status = ServiceStatus.NO_REPLICA
         serve_state.set_service_status(self.service_name, status)
+
+    # ---------------------------------------------------- crash recovery
+
+    def recover_fleet(self) -> None:
+        """Reconcile serve_state against reality on startup instead of
+        assuming a cold fleet.  A controller crash forgets only the
+        in-memory state: the replicas keep serving, the LB keeps
+        routing its last-known set.  Re-adopt live replicas by probing
+        their recorded URLs, resume interrupted drains (the persisted
+        drain clock keeps the original timeout), and warm-start every
+        role pool's autoscaler from the live count — the first
+        reconcile pass after a restart must not churn the fleet."""
+        replicas = self.replica_manager.active_replicas()
+        adopted: List[int] = []
+        lost: List[int] = []
+        draining: List[int] = []
+        for replica in replicas:
+            status = ReplicaStatus(replica['status'])
+            url = replica['url']
+            if status is ReplicaStatus.DRAINING:
+                # The drain monitor resumes it on the next sync pass
+                # with its persisted drain_started_at.
+                draining.append(replica['replica_id'])
+                continue
+            if status not in (ReplicaStatus.READY,
+                              ReplicaStatus.NOT_READY) or not url:
+                continue  # STARTING rows re-enter the probe loop as-is
+            try:
+                resp = requests.get(
+                    url + self.spec.readiness_path,
+                    timeout=self.spec.readiness_timeout_seconds)
+                alive = resp.status_code == 200
+            except requests.RequestException:
+                alive = False
+            if alive:
+                adopted.append(replica['replica_id'])
+                if status is not ReplicaStatus.READY:
+                    serve_state.set_replica_status(
+                        self.service_name, replica['replica_id'],
+                        ReplicaStatus.READY)
+            else:
+                lost.append(replica['replica_id'])
+                if status is ReplicaStatus.READY:
+                    # Let the normal probe/preemption path decide its
+                    # fate — recovery itself never tears down.
+                    serve_state.set_replica_status(
+                        self.service_name, replica['replica_id'],
+                        ReplicaStatus.NOT_READY)
+        for role, scaler in self.autoscalers.items():
+            live = [r for r in replicas
+                    if r['version'] >= self.version and
+                    (r.get('role') or 'mixed') == role and
+                    r['status'] != ReplicaStatus.DRAINING.value]
+            scaler.warm_start(len(live))
+        replica_managers._journal_drain(  # pylint: disable=protected-access
+            'controller_recovered', service=self.service_name,
+            adopted=adopted, lost=lost, draining_resumed=draining)
+        logger.info(
+            f'controller recovered service {self.service_name}: '
+            f'adopted {len(adopted)} live replica(s), '
+            f'{len(draining)} drain(s) resumed, {len(lost)} '
+            f'unreachable')
 
     def stop(self) -> None:
         self._stop.set()
@@ -336,4 +438,5 @@ class SkyServeController:
         serve_state.set_service_ports(self.service_name, self.port,
                                       lb_port or 0)
         logger.info(f'controller for {self.service_name} on :{self.port}')
+        self.recover_fleet()
         self.run_loop()

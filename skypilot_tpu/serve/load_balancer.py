@@ -80,6 +80,16 @@ _M_DROPPED_TIMESTAMPS = metrics_lib.counter(
 _M_SYNC_FAILURES = metrics_lib.counter(
     'skytpu_lb_controller_sync_failures_total',
     'Controller sync attempts that failed.')
+_M_SYNC_AGE = metrics_lib.gauge(
+    'skytpu_lb_controller_sync_age_seconds',
+    'Seconds since the last successful controller sync.  The LB keeps '
+    'serving its last-known replica set while this grows (controller '
+    'down != outage) — but a climbing value means the fleet view is '
+    'stale and replicas will start flapping unseen.')
+_M_RETIRED = metrics_lib.counter(
+    'skytpu_lb_retired_total',
+    'Replicas dropped via the /lb/retire drain nudge (push from the '
+    'controller, ahead of the next sync).')
 _M_ROUTE = metrics_lib.counter(
     'skytpu_lb_route_total',
     'Routed generation requests, by role pool and affinity outcome.',
@@ -143,14 +153,17 @@ def _handoff_binary() -> bool:
 
 def _journal_handoff(event: str, **fields: Any) -> None:
     """Journal routing/handoff events only while someone is watching
-    (the `serve.kv_handoff` / `serve.rank_exec` chaos sites armed or
-    SKYTPU_SERVE_HANDOFF_EVENTS set) — the `handoff_consistency`
-    invariant replays them to prove no request is lost or
-    double-executed across a handoff failure or a slice-rank death."""
+    (the `serve.kv_handoff` / `serve.rank_exec` /
+    `serve.controller_tick` chaos sites armed or
+    SKYTPU_SERVE_HANDOFF_EVENTS set) — the `handoff_consistency` and
+    `drain_no_lost_requests` invariants replay them to prove no
+    request is lost, double-executed, or routed to a retired
+    replica."""
     from skypilot_tpu.chaos import injector as chaos_injector  # pylint: disable=import-outside-toplevel
     if not (os.environ.get('SKYTPU_SERVE_HANDOFF_EVENTS') or
             chaos_injector.site_armed('serve.kv_handoff') or
-            chaos_injector.site_armed('serve.rank_exec')):
+            chaos_injector.site_armed('serve.rank_exec') or
+            chaos_injector.site_armed('serve.controller_tick')):
         return
     from skypilot_tpu.observability import events as events_lib  # pylint: disable=import-outside-toplevel
     try:
@@ -186,6 +199,26 @@ _CHUNK = 64 * 1024
 
 def _lb_sync_interval() -> float:
     return float(os.environ.get('SKYTPU_LB_SYNC_INTERVAL', '20'))
+
+
+def _sync_stale_warn_s() -> float:
+    """Sync age past which the LB WARNs (once per outage) that it is
+    serving a stale fleet view — a dead controller should be visible
+    in logs and `serve status --metrics` before replicas flap."""
+    return float(os.environ.get('SKYTPU_LB_SYNC_STALE_WARN_S', '90'))
+
+
+def _default_deadline_ms() -> Optional[float]:
+    """Fleet-wide default X-SkyTPU-Deadline-Ms the LB stamps on routed
+    generation requests that carry none (None = no default)."""
+    value = os.environ.get('SKYTPU_LB_DEFAULT_DEADLINE_MS')
+    if not value:
+        return None
+    try:
+        ms = float(value)
+    except ValueError:
+        return None
+    return ms if ms > 0 else None
 
 
 class LoadBalancingPolicy:
@@ -400,6 +433,16 @@ class SkyServeLoadBalancer:
         self.dropped_timestamps = 0
         self._sync_failures = 0       # consecutive; reset on success
         self._next_failure_warn = 1   # exponential-backoff WARNING
+        # Controller liveness view: when the last sync succeeded (the
+        # skytpu_lb_controller_sync_age_seconds gauge), and whether
+        # the once-per-outage staleness WARNING already fired.
+        self._last_sync_ok = time.monotonic()
+        self._stale_warned = False
+        # Urls retired via /lb/retire (drain push): excluded from sync
+        # payloads until the controller's own view catches up (a
+        # payload without the url clears the entry), so a stale
+        # in-flight sync cannot resurrect a draining replica.
+        self._retired: set = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -421,6 +464,33 @@ class SkyServeLoadBalancer:
         with self._lock:
             self.ready_urls = [e.url for e in endpoints]
 
+    def sync_age(self) -> float:
+        """Seconds since the last successful controller sync (also the
+        skytpu_lb_controller_sync_age_seconds gauge)."""
+        age = time.monotonic() - self._last_sync_ok
+        _M_SYNC_AGE.set(round(age, 3))
+        return age
+
+    def retire_url(self, url: str) -> bool:
+        """Drop one replica from routing NOW (the controller's drain
+        nudge — ahead of the next sync): removed from the ready set
+        and the router, prefix-affinity pins re-home, and a stale
+        in-flight sync payload cannot re-add it (the retired set
+        filters syncs until the controller's view catches up)."""
+        with self._lock:
+            present = url in self.ready_urls
+            if present:
+                self.ready_urls = [u for u in self.ready_urls
+                                   if u != url]
+            self._retired.add(url)
+        removed = self.router.remove_endpoint(url)
+        if present or removed:
+            _M_RETIRED.inc()
+        _journal_handoff('lb_retire', url=url,
+                         known=bool(present or removed))
+        logger.info(f'LB retired replica {url} (drain nudge)')
+        return present or removed
+
     def _sync_with_controller(self) -> None:
         with self._lock:
             timestamps, self.request_timestamps = \
@@ -436,8 +506,18 @@ class SkyServeLoadBalancer:
             data = resp.json()
             urls = data.get('ready_replica_urls', [])
             infos = data.get('ready_replicas')
+            with self._lock:
+                # A retired (draining) url still present in this
+                # payload means the sync raced the retire push — keep
+                # it excluded.  Absent means the controller caught up;
+                # forget the entry so a future replica at the same
+                # address is routable again.
+                retired = self._retired = {
+                    u for u in self._retired if u in urls}
+            urls = [u for u in urls if u not in retired]
             if infos is not None:
-                self.set_replicas(infos)
+                self.set_replicas([i for i in infos
+                                   if i.get('url') not in retired])
             with self._lock:
                 self.ready_urls = urls if infos is None else \
                     self.ready_urls
@@ -447,6 +527,9 @@ class SkyServeLoadBalancer:
                         f'{self._sync_failures} failed attempt(s)')
                 self._sync_failures = 0
                 self._next_failure_warn = 1
+                self._last_sync_ok = time.monotonic()
+                self._stale_warned = False
+            _M_SYNC_AGE.set(0.0)
         except (requests.RequestException, ValueError) as e:
             # The samples go back on the (bounded) buffer so a
             # transient controller outage doesn't lose the QPS signal.
@@ -465,6 +548,17 @@ class SkyServeLoadBalancer:
                     self._next_failure_warn = max(
                         2, self._next_failure_warn * 2)
             _M_SYNC_FAILURES.inc()
+            age = self.sync_age()
+            if age > _sync_stale_warn_s() and not self._stale_warned:
+                # Once per outage (reset on recovery), distinct from
+                # the per-attempt backoff below: the fleet view is now
+                # officially stale — last-known replicas keep serving,
+                # but new/retired replicas are invisible to this LB.
+                self._stale_warned = True
+                logger.warning(
+                    f'LB fleet view is STALE: no successful controller '
+                    f'sync for {age:.0f}s (> {_sync_stale_warn_s():.0f}s'
+                    f'); serving the last-known replica set')
             # WARNING with exponential backoff (attempt 1, 2, 4, 8,
             # ...), DEBUG otherwise: a controller that is down for an
             # hour must not emit 180 identical warnings.
@@ -519,6 +613,12 @@ class SkyServeLoadBalancer:
             method = parts[0] if parts else ''
             path = (parts[1].split('?', 1)[0] if len(parts) > 1 else '')
             framing = _body_framing(headers)
+            if path.startswith('/lb/'):
+                # LB control plane (never proxied): the controller's
+                # drain nudge and the LB's own metrics exposition.
+                await self._handle_control(writer, method, path,
+                                           reader, framing)
+                return
             if (method == 'POST' and path in _ROUTABLE_PATHS and
                     framing[0] == 'length' and
                     framing[1] <= _max_route_body()):
@@ -574,6 +674,53 @@ class SkyServeLoadBalancer:
                 await writer.wait_closed()
             except (ConnectionError, OSError):
                 pass
+
+    # ----------------------------------------------------- control plane
+
+    async def _handle_control(self, writer: asyncio.StreamWriter,
+                              method: str, path: str,
+                              reader: asyncio.StreamReader,
+                              framing: Tuple[str, int]) -> None:
+        """`/lb/*` endpoints served by the LB itself:
+
+        POST /lb/retire {"url": ...} — the controller's drain nudge:
+        stop routing to the replica NOW instead of at the next sync.
+        GET /lb/metrics — this LB process's Prometheus exposition
+        (sync age, retries, handoffs); `serve status --metrics` reads
+        the SYNC AGE column here."""
+        body = b''
+        if framing[0] == 'length' and framing[1] > 0:
+            body = await asyncio.wait_for(
+                reader.readexactly(min(framing[1], _max_route_body())),
+                timeout=30)
+        if method == 'POST' and path == '/lb/retire':
+            try:
+                url = (json.loads(body or b'{}') or {}).get('url')
+            except (json.JSONDecodeError, AttributeError):
+                url = None
+            if not url:
+                writer.write(_simple_response(
+                    400, 'Bad Request', b'missing "url"'))
+            else:
+                known = self.retire_url(str(url))
+                payload = json.dumps({'retired': known}).encode()
+                writer.write(
+                    (f'HTTP/1.1 200 OK\r\n'
+                     f'Content-Type: application/json\r\n'
+                     f'Content-Length: {len(payload)}\r\n'
+                     f'Connection: close\r\n\r\n').encode() + payload)
+        elif method == 'GET' and path == '/lb/metrics':
+            self.sync_age()   # freshen the gauge at scrape time
+            text = metrics_lib.expose().encode()
+            writer.write(
+                (f'HTTP/1.1 200 OK\r\n'
+                 f'Content-Type: {metrics_lib.CONTENT_TYPE}\r\n'
+                 f'Content-Length: {len(text)}\r\n'
+                 f'Connection: close\r\n\r\n').encode() + text)
+        else:
+            writer.write(_simple_response(
+                404, 'Not Found', b'unknown LB control path'))
+        await writer.drain()
 
     # ------------------------------------------------------ routed path
 
@@ -657,6 +804,13 @@ class SkyServeLoadBalancer:
         }
         if handoff_ms is not None:
             extra[router_lib.HANDOFF_MS_HEADER] = f'{handoff_ms:.3f}'
+        # Fleet-default request deadline: stamped only when the client
+        # sent none (the client's own budget always wins).
+        default_deadline = _default_deadline_ms()
+        if default_deadline is not None and not any(
+                n.lower() == router_lib.DEADLINE_HEADER.lower()
+                for n, _ in headers):
+            extra[router_lib.DEADLINE_HEADER] = f'{default_deadline:g}'
         target: Optional[str] = decision.url
         tried: List[str] = []
         delay = 0.0

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -67,6 +68,28 @@ _M_ROUTED = metrics_lib.counter(
     'skytpu_engine_routed_total',
     'LB-routed requests served, by routed role and affinity outcome.',
     ('role', 'affinity'))
+_M_DRAIN_REJECTED = metrics_lib.counter(
+    'skytpu_serve_drain_rejected_total',
+    'Generation requests answered 503 because the replica is '
+    'draining (the LB retries them on a sibling).')
+
+
+class ClientDisconnected(RuntimeError):
+    """The client hung up while its request was in flight: the engine
+    slot was cancelled and its KV pages freed; no response is owed."""
+
+
+def default_deadline_ms() -> Optional[float]:
+    """Replica-side default request deadline (ms) for requests that
+    carry no X-SkyTPU-Deadline-Ms header; None = no deadline."""
+    value = os.environ.get('SKYTPU_SERVE_DEFAULT_DEADLINE_MS')
+    if not value:
+        return None
+    try:
+        ms = float(value)
+    except ValueError:
+        return None
+    return ms if ms > 0 else None
 
 
 def _maybe_journal_request(event: str, **fields) -> None:
@@ -80,7 +103,8 @@ def _maybe_journal_request(event: str, **fields) -> None:
     from skypilot_tpu.chaos import injector as chaos_injector  # pylint: disable=import-outside-toplevel
     if not (os.environ.get('SKYTPU_SERVE_HANDOFF_EVENTS') or
             chaos_injector.site_armed('serve.kv_handoff') or
-            chaos_injector.site_armed('serve.rank_exec')):
+            chaos_injector.site_armed('serve.rank_exec') or
+            chaos_injector.site_armed('serve.controller_tick')):
         return
     from skypilot_tpu.observability import events as events_lib  # pylint: disable=import-outside-toplevel
     try:
@@ -186,6 +210,10 @@ class ModelServer:
             raise ValueError(f'Unknown replica role {role!r}; one of '
                              f'{router_lib.ROLES}')
         self.role = role
+        # Graceful drain: once set (POST /drain, from the controller's
+        # retirement path), new generation work is refused with 503 +
+        # Retry-After while in-flight decodes run to completion.
+        self.draining = False
         model_mod = Transformer(self.cfg)
         init_tokens = jax.numpy.zeros((1, 8), jax.numpy.int32)
         key = jax.random.PRNGKey(seed)
@@ -320,17 +348,46 @@ class ModelServer:
             self._engine.stop()
             self._engine = None
 
+    def drain(self) -> Dict[str, Any]:
+        """Enter draining: refuse new generates (503 + Retry-After)
+        while the engine finishes what it holds.  Idempotent; returns
+        the in-flight snapshot the controller's drain monitor reads."""
+        self.draining = True
+        return {'draining': True, 'inflight': self.inflight()}
+
+    def inflight(self) -> int:
+        """Busy slots + queued admissions (0 without an engine): the
+        occupancy signal a drain waits on, per the concurrency-limits
+        framing — slot/page occupancy, not wall-clock guesses."""
+        engine = self._engine
+        if engine is None:
+            return 0
+        stats = engine.stats()
+        return (int(stats.get('busy_slots', 0)) +
+                int(stats.get('queued_requests', 0)))
+
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, top_k: int = 0,
                  stop_token=None, seed: int = 0,
                  request_id: Optional[str] = None,
-                 route_meta: Optional[Dict[str, Any]] = None) -> Any:
+                 route_meta: Optional[Dict[str, Any]] = None,
+                 deadline_ms: Optional[float] = None,
+                 on_submit=None, disconnect_probe=None) -> Any:
         """stop_token: None, a single id, or an iterable of ids (the
         tokenizer's multi-EOS stop set).
 
         request_id: propagated X-SkyTPU-Request-Id; under continuous
         batching it names the request's span record (multi-row batches
-        suffix `-1`, `-2`, ... on rows after the first)."""
+        suffix `-1`, `-2`, ... on rows after the first).
+
+        deadline_ms: per-request time budget (X-SkyTPU-Deadline-Ms);
+        the engine reaps the slot(s) past it -> DeadlineExceeded.
+
+        on_submit: called with the engine request handles right after
+        submission (async front's disconnect watchdog cancels through
+        them).  disconnect_probe: polled while waiting; returning True
+        means the client hung up — every handle is cancelled and
+        ClientDisconnected raised (threaded front, MSG_PEEK probe)."""
         import jax
         import jax.numpy as jnp
 
@@ -361,9 +418,29 @@ class ModelServer:
                                         None if request_id is None
                                         else (request_id if i == 0 else
                                               f'{request_id}-{i}')),
-                                    route_meta=route_meta)
+                                    route_meta=route_meta,
+                                    deadline_ms=deadline_ms)
                 for i, row in enumerate(prompt_ids)
             ]
+            if on_submit is not None:
+                on_submit(requests)
+            if disconnect_probe is not None:
+                # Poll the connection while waiting: a client that hung
+                # up must free its slots NOW, not after max_new_tokens.
+                wait_until = time.monotonic() + 600
+                while True:
+                    pending = next((r for r in requests
+                                    if not r.done.is_set()), None)
+                    if pending is None:
+                        break
+                    if disconnect_probe():
+                        for r in requests:
+                            r.cancel()
+                        raise ClientDisconnected(
+                            'client disconnected mid-generation')
+                    if time.monotonic() > wait_until:
+                        raise TimeoutError('generation timed out')
+                    pending.done.wait(0.1)
             return [r.result(timeout=600) for r in requests]
         with self._lock:
             tokens, new = decode.generate(
@@ -404,7 +481,8 @@ def _make_handler(server: ModelServer):
             """Admission-control errors become honest HTTP status +
             Retry-After instead of a generic 500: 429 when the queue is
             full, 503 when the request expired waiting (the client
-            should hit another replica / back off, not time out)."""
+            should hit another replica / back off, not time out), 504
+            when the request's own deadline passed."""
             if isinstance(e, batching_engine_lib.QueueFull):
                 self._reply(429, {'error': str(e)},
                             {'Retry-After': str(int(e.retry_after))})
@@ -413,7 +491,57 @@ def _make_handler(server: ModelServer):
                 self._reply(503, {'error': str(e)},
                             {'Retry-After': str(int(e.retry_after))})
                 return True
+            if isinstance(e, batching_engine_lib.DeadlineExceeded):
+                self._reply(504, {'error': str(e),
+                                  'reason': 'deadline_exceeded'})
+                return True
             return False
+
+        def _reject_if_draining(self) -> bool:
+            """503 + Retry-After for new generation work on a draining
+            replica — the LB's same-role retry lands it on a sibling
+            (this is what makes a drain invisible to clients)."""
+            if not server.draining:
+                return False
+            # Consume the request body first: replying with unread
+            # body bytes on the socket would desync a keep-alive
+            # connection's framing for the next request.
+            self._read_body()
+            _M_DRAIN_REJECTED.inc()
+            self._reply(503, {'error': 'replica is draining',
+                              'reason': 'draining'},
+                        {'Retry-After': '5'})
+            return True
+
+        def _deadline_ms(self) -> Optional[float]:
+            """The request's X-SkyTPU-Deadline-Ms, else the replica's
+            env default (SKYTPU_SERVE_DEFAULT_DEADLINE_MS)."""
+            raw = self.headers.get(router_lib.DEADLINE_HEADER)
+            if raw:
+                try:
+                    ms = float(raw)
+                    return ms if ms > 0 else None
+                except ValueError:
+                    pass
+            return default_deadline_ms()
+
+        def _disconnect_probe(self):
+            """True once the client socket is closed.  MSG_PEEK never
+            consumes pipelined bytes: data waiting reads as 'still
+            connected', only a clean EOF (or a dead socket) as gone."""
+            import select  # pylint: disable=import-outside-toplevel
+            import socket as socket_lib  # pylint: disable=import-outside-toplevel
+            sock = self.connection
+
+            def probe() -> bool:
+                try:
+                    readable, _, _ = select.select([sock], [], [], 0)
+                    if not readable:
+                        return False
+                    return sock.recv(1, socket_lib.MSG_PEEK) == b''
+                except (OSError, ValueError):
+                    return True
+            return probe
 
         def _sampling(self, req: Dict[str, Any]):
             """(temperature, top_k, seed) — request fields, falling
@@ -466,7 +594,8 @@ def _make_handler(server: ModelServer):
                        'model': f'{server.cfg.d_model}x'
                                 f'{server.cfg.n_layers}',
                        'role': server.role,
-                       'num_hosts': server.num_hosts}
+                       'num_hosts': server.num_hosts,
+                       'draining': server.draining}
             engine = server._engine  # pylint: disable=protected-access
             code = 200
             if engine is not None:  # local bind: close() may race
@@ -490,6 +619,8 @@ def _make_handler(server: ModelServer):
             present, byte-level fallback otherwise).  With
             {"stream": true} the response is SSE {"text": delta}
             events, decoded incrementally UTF-8-safe."""
+            if self._reject_if_draining():
+                return
             try:
                 tok = server.tokenizer
                 if server.cfg.vocab_size < tok.vocab_size:
@@ -518,7 +649,9 @@ def _make_handler(server: ModelServer):
                     temperature, top_k,
                     stop_token=tok.eos_ids or None, seed=seed,
                     request_id=rid,
-                    route_meta=self._route_meta())[0]
+                    route_meta=self._route_meta(),
+                    deadline_ms=self._deadline_ms(),
+                    disconnect_probe=self._disconnect_probe())[0]
                 _maybe_journal_request('serve_request_done',
                                        request_id=rid, status='ok',
                                        tokens=len(tokens))
@@ -532,6 +665,8 @@ def _make_handler(server: ModelServer):
                     'latency_ms': round(
                         (time.perf_counter() - t0) * 1e3, 1),
                 }, {tracing.REQUEST_ID_HEADER: rid})
+            except ClientDisconnected:
+                return  # nobody is owed a reply; the slot is freed
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as e:
                 self._reply(400, {'error': str(e)})
@@ -555,7 +690,8 @@ def _make_handler(server: ModelServer):
                 stop_token=tok.eos_ids or None,
                 sampling=decode.SamplingConfig(
                     temperature=temperature, top_k=top_k, seed=seed),
-                request_id=rid, route_meta=self._route_meta())
+                request_id=rid, route_meta=self._route_meta(),
+                deadline_ms=self._deadline_ms())
             self._start_sse(rid)
             decoder = StreamDecoder(tok)
             try:
@@ -586,6 +722,8 @@ def _make_handler(server: ModelServer):
             `data: [DONE]`.  Requires --continuous-batching (the engine
             produces tokens one step at a time); single prompt only.
             The LB relays these chunks unbuffered end-to-end."""
+            if self._reject_if_draining():
+                return
             try:
                 req = self._read_json()
                 prompt = req['prompt_ids']
@@ -610,7 +748,8 @@ def _make_handler(server: ModelServer):
                     sampling=decode.SamplingConfig(
                         temperature=temperature, top_k=top_k,
                         seed=seed),
-                    request_id=rid, route_meta=self._route_meta())
+                    request_id=rid, route_meta=self._route_meta(),
+                    deadline_ms=self._deadline_ms())
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as e:
                 self._reply(400, {'error': str(e)})
@@ -676,6 +815,8 @@ def _make_handler(server: ModelServer):
                 self._reply(400, {'error': 'KV handoff requires '
                                            '--continuous-batching'})
                 return
+            if self._reject_if_draining():
+                return
             try:
                 req = self._read_json()
                 prompt = req['prompt_ids']
@@ -722,6 +863,9 @@ def _make_handler(server: ModelServer):
                 self._reply(400, {'error': 'KV handoff requires '
                                            '--continuous-batching'})
                 return
+            if self._reject_if_draining():
+                # Imported pages would die with this replica anyway.
+                return
             try:
                 ctype = self.headers.get('Content-Type') or ''
                 if handoff_lib.CONTENT_TYPE_BINARY in ctype:
@@ -748,6 +892,48 @@ def _make_handler(server: ModelServer):
                     self._reply(500,
                                 {'error': f'{type(e).__name__}: {e}'})
 
+        def _drain(self):
+            """Controller retirement path: flip the replica to
+            draining (new generates 503 while in-flight work
+            finishes) and report the occupancy the drain waits on."""
+            self._reply(200, server.drain())
+
+        def _prefix_export(self):
+            """Drain-time sibling handoff: export the hottest prefix-
+            cache pages (POOL pages — no prefill runs) so a surviving
+            replica inherits the pinned sessions.  Allowed while
+            draining — that is the point."""
+            engine = server._engine  # pylint: disable=protected-access
+            if engine is None:
+                self._reply(400, {'error': 'prefix export requires '
+                                           '--continuous-batching'})
+                return
+            try:
+                req = self._read_json()
+                binary = (req.get('wire') == 'binary' or
+                          handoff_lib.CONTENT_TYPE_BINARY in
+                          (self.headers.get('Accept') or ''))
+                payload = engine.export_prefix_pages(
+                    max_pages=int(req.get('max_pages', 64)),
+                    binary=binary)
+                if binary:
+                    self.send_response(200)
+                    self.send_header('Content-Type',
+                                     handoff_lib.CONTENT_TYPE_BINARY)
+                    self.send_header('Content-Length',
+                                     str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                else:
+                    self._reply(200, payload)
+            except (handoff_lib.HandoffError, KeyError, ValueError,
+                    TypeError, json.JSONDecodeError) as e:
+                self._reply(404, {'error': str(e)})
+            except Exception as e:  # pylint: disable=broad-except
+                if not self._reply_backpressure(e):
+                    self._reply(500,
+                                {'error': f'{type(e).__name__}: {e}'})
+
         def do_POST(self):
             if self.path == '/generate_stream':
                 self._generate_stream()
@@ -761,8 +947,16 @@ def _make_handler(server: ModelServer):
             if self.path == '/kv_import':
                 self._kv_import()
                 return
+            if self.path == '/drain':
+                self._drain()
+                return
+            if self.path == '/prefix_export':
+                self._prefix_export()
+                return
             if self.path != '/generate':
                 self._reply(404, {'error': 'unknown path'})
+                return
+            if self._reject_if_draining():
                 return
             try:
                 req = self._read_json()
@@ -773,7 +967,9 @@ def _make_handler(server: ModelServer):
                     req['prompt_ids'],
                     int(req.get('max_new_tokens', 16)),
                     temperature, top_k, seed=seed, request_id=rid,
-                    route_meta=self._route_meta())
+                    route_meta=self._route_meta(),
+                    deadline_ms=self._deadline_ms(),
+                    disconnect_probe=self._disconnect_probe())
                 _maybe_journal_request(
                     'serve_request_done', request_id=rid, status='ok',
                     tokens=sum(len(t) for t in tokens))
@@ -782,6 +978,8 @@ def _make_handler(server: ModelServer):
                     'latency_ms': round(
                         (time.perf_counter() - t0) * 1e3, 1),
                 }, {tracing.REQUEST_ID_HEADER: rid})
+            except ClientDisconnected:
+                return  # nobody is owed a reply; the slots are freed
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as e:
                 self._reply(400, {'error': str(e)})

@@ -43,6 +43,16 @@ _M_REPLICA_LOAD = metrics_lib.gauge(
     'skytpu_serve_replica_load_mean',
     'Mean busy_slots/slots across ready replicas reporting engine '
     'stats (the decode-saturation autoscaler signal).', ('service',))
+_M_DRAIN_SECONDS = metrics_lib.histogram(
+    'skytpu_serve_drain_seconds',
+    'Wall time from replica_drain_start to replica_drain_end '
+    '(graceful retirements; timeouts land in the top buckets).',
+    buckets=(1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0))
+_M_DRAINS = metrics_lib.counter(
+    'skytpu_serve_drains_total',
+    'Replica drains finished, by terminal reason (drained = in-flight '
+    'work ran out; timeout = SKYTPU_SERVE_DRAIN_TIMEOUT_S force-kill; '
+    'dead = the replica vanished mid-drain).', ('reason',))
 
 ENV_REPLICA_ID = 'SKYTPU_SERVE_REPLICA_ID'
 ENV_REPLICA_PORT = 'SKYTPU_SERVE_REPLICA_PORT'
@@ -54,6 +64,39 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(('', 0))
         return s.getsockname()[1]
+
+
+def _drain_timeout() -> float:
+    """Hard bound on a graceful drain: past it the replica is torn
+    down with whatever it still holds (in-flight work is otherwise
+    bounded only by max_new_tokens)."""
+    return float(os.environ.get('SKYTPU_SERVE_DRAIN_TIMEOUT_S', '120'))
+
+
+def _drain_enabled() -> bool:
+    return os.environ.get('SKYTPU_SERVE_GRACEFUL_DRAIN', '1') != '0'
+
+
+def _drain_export_pages() -> int:
+    """Prefix pages shipped to a same-role sibling when a drain
+    finishes (0 disables the handoff)."""
+    return int(os.environ.get('SKYTPU_SERVE_DRAIN_EXPORT_PAGES', '64'))
+
+
+def _serve_journal():
+    """Drain lifecycle events are control-plane rare — journaled
+    unconditionally (unlike the per-request routing events, which are
+    gated on a chaos site being armed)."""
+    from skypilot_tpu.observability import events as events_lib  # pylint: disable=import-outside-toplevel
+    return events_lib.get_journal(
+        os.path.join(events_lib.journal_root(), 'serve.jsonl'))
+
+
+def _journal_drain(event: str, **fields) -> None:
+    try:
+        _serve_journal().append(event, **fields)
+    except Exception:  # pylint: disable=broad-except
+        pass  # recording must never break the control plane
 
 
 class ReplicaManager:
@@ -164,10 +207,27 @@ class ReplicaManager:
     # --------------------------------------------------------- scale down
 
     def scale_down(self, replica_id: int,
-                   final_status: ReplicaStatus = ReplicaStatus.TERMINATED
+                   final_status: ReplicaStatus = ReplicaStatus.TERMINATED,
+                   drain: bool = False, reason: str = 'scale_down'
                    ) -> None:
-        """Tear down the replica's cluster; the row is kept in a
-        terminal state (history + monotonic replica ids)."""
+        """Retire a replica.  drain=True (the controller's scale-down /
+        rolling-update paths) routes a READY replica through graceful
+        drain first: DRAINING status, the LB stops routing to it, its
+        HTTP fronts 503 new generates, and the drain monitor tears it
+        down once in-flight work finishes (or the timeout fires).
+        drain=False (preemption, failed probes, service teardown) is
+        the immediate kill; the row is kept in a terminal state
+        (history + monotonic replica ids)."""
+        if drain and _drain_enabled() and \
+                final_status is ReplicaStatus.TERMINATED:
+            replica = self._get_replica(replica_id)
+            if replica is not None:
+                status = ReplicaStatus(replica['status'])
+                if status is ReplicaStatus.DRAINING:
+                    return  # already draining; the monitor owns it
+                if status is ReplicaStatus.READY and replica['url']:
+                    self.begin_drain(replica_id, reason=reason)
+                    return
         from skypilot_tpu import core  # pylint: disable=import-outside-toplevel
         serve_state.set_replica_status(self.service_name, replica_id,
                                        ReplicaStatus.SHUTTING_DOWN)
@@ -181,6 +241,192 @@ class ReplicaManager:
         self._first_probe_at.pop(replica_id, None)
         self._last_load.pop(replica_id, None)
         self._last_stats.pop(replica_id, None)
+
+    def _get_replica(self, replica_id: int) -> Optional[Dict]:
+        for replica in serve_state.get_replicas(self.service_name):
+            if replica['replica_id'] == replica_id:
+                return replica
+        return None
+
+    # ------------------------------------------------------------- drain
+
+    def begin_drain(self, replica_id: int,
+                    reason: str = 'scale_down') -> None:
+        """Enter graceful drain: persist DRAINING (+ drain clock),
+        journal replica_drain_start, tell the replica to refuse new
+        generates, and nudge the LB off it immediately (a push, so the
+        drain does not wait out a full sync interval).  The drain
+        monitor (`sync_draining`) finishes the job."""
+        replica = self._get_replica(replica_id)
+        if replica is None:
+            return
+        url = replica['url']
+        serve_state.set_replica_draining(self.service_name, replica_id,
+                                         time.time())
+        inflight = self._post_drain(url)
+        _journal_drain('replica_drain_start',
+                       service=self.service_name,
+                       replica_id=replica_id, url=url, reason=reason,
+                       inflight=inflight,
+                       timeout=_drain_timeout())
+        logger.info(f'replica {replica_id} draining ({reason}; '
+                    f'{inflight if inflight is not None else "?"} '
+                    f'in flight)')
+        self._nudge_lb_retire(url)
+
+    def notify_preemption_warning(self, replica_id: int) -> None:
+        """A cloud preemption notice arrived for this replica's slice:
+        drain NOW so in-flight work finishes (or hands off) before the
+        capacity disappears under it."""
+        self.scale_down(replica_id, drain=True,
+                        reason='preemption_warning')
+
+    def _post_drain(self, url: Optional[str]) -> Optional[int]:
+        """Best-effort POST /drain; returns the replica's reported
+        in-flight count (None when unreachable or not a native
+        replica — user containers drain by LB exclusion alone)."""
+        if not url:
+            return None
+        try:
+            resp = requests.post(url + '/drain', json={}, timeout=5)
+            if resp.status_code == 200:
+                return resp.json().get('inflight')
+        except (requests.RequestException, ValueError):
+            pass
+        return None
+
+    def _nudge_lb_retire(self, url: Optional[str]) -> None:
+        """Push the retirement to the LB instead of waiting for its
+        next controller sync (~SKYTPU_SERVE_SYNC_INTERVAL): the LB
+        drops the url from its ready set and re-pins prefix affinity
+        right away.  Best effort — the sync payload (which excludes
+        DRAINING replicas) is the backstop."""
+        if not url:
+            return
+        record = serve_state.get_service(self.service_name)
+        lb_port = (record or {}).get('load_balancer_port')
+        if not lb_port:
+            return
+        try:
+            requests.post(f'http://127.0.0.1:{lb_port}/lb/retire',
+                          json={'url': url}, timeout=2)
+        except requests.RequestException:
+            pass
+
+    def sync_draining(self) -> None:
+        """Drain monitor: one pass over DRAINING replicas.  A replica
+        leaves the state when its engine runs dry (busy + queued == 0),
+        when the hard timeout fires, or when it vanishes — each path
+        journals replica_drain_end{reason} and tears the cluster
+        down."""
+        for replica in serve_state.get_replicas(self.service_name):
+            if replica['status'] == ReplicaStatus.DRAINING.value:
+                self._sync_draining_one(replica)
+
+    def _sync_draining_one(self, replica: Dict) -> None:
+        replica_id = replica['replica_id']
+        url = replica['url']
+        started = replica.get('drain_started_at') or \
+            replica.get('launched_at') or time.time()
+        timeout = _drain_timeout()
+        inflight: Optional[int] = None
+        alive = False
+        if url:
+            try:
+                resp = requests.get(
+                    url + self.spec.readiness_path,
+                    timeout=self.spec.readiness_timeout_seconds)
+                alive = resp.status_code in (200, 503)
+                if alive:
+                    try:
+                        payload = resp.json()
+                        engine = payload.get('engine') or {}
+                        inflight = (
+                            int(engine.get('busy_slots', 0) or 0) +
+                            int(engine.get('queued_requests', 0) or 0))
+                        if not payload.get('draining'):
+                            # The /drain from begin_drain never landed
+                            # (transient failure, replica restart):
+                            # re-assert, or it keeps accepting work.
+                            self._post_drain(url)
+                    except (ValueError, TypeError):
+                        inflight = 0  # alive but no engine stats
+            except requests.RequestException:
+                alive = False
+        if not alive:
+            self._finish_drain(replica, 'dead', inflight, started)
+        elif inflight is not None and inflight <= 0:
+            self._finish_drain(replica, 'drained', 0, started)
+        elif time.time() - started > timeout:
+            self._finish_drain(replica, 'timeout', inflight, started)
+
+    def _finish_drain(self, replica: Dict, reason: str,
+                      inflight: Optional[int],
+                      started: float) -> None:
+        replica_id = replica['replica_id']
+        url = replica['url']
+        if reason != 'dead':
+            self._export_hot_prefixes(replica)
+        duration = max(0.0, time.time() - started)
+        _M_DRAIN_SECONDS.observe(duration)
+        _M_DRAINS.labels(reason=reason).inc()
+        _journal_drain('replica_drain_end',
+                       service=self.service_name,
+                       replica_id=replica_id, url=url, reason=reason,
+                       inflight=inflight, timeout=_drain_timeout(),
+                       duration_s=round(duration, 3))
+        logger.info(f'replica {replica_id} drain finished ({reason} '
+                    f'after {duration:.1f}s); terminating')
+        self.scale_down(replica_id, drain=False)
+
+    def _export_hot_prefixes(self, replica: Dict) -> None:
+        """Best-effort drain-time handoff: ship the retiring replica's
+        hottest prefix-cache pages to a same-role READY sibling over
+        the PR 8 wire (/prefix_export -> /kv_import), so its pinned
+        sessions land warm instead of re-prefilling from scratch."""
+        max_pages = _drain_export_pages()
+        url = replica['url']
+        if max_pages <= 0 or not url:
+            return
+        role = replica.get('role') or 'mixed'
+        sibling = next(
+            (r['url'] for r in serve_state.get_replicas(
+                self.service_name)
+             if r['status'] == ReplicaStatus.READY.value and r['url']
+             and (r.get('role') or 'mixed') == role
+             and r['replica_id'] != replica['replica_id']), None)
+        if sibling is None:
+            return
+        from skypilot_tpu.serve import handoff as handoff_lib  # pylint: disable=import-outside-toplevel
+        status = 'ok'
+        pages = 0
+        try:
+            resp = requests.post(
+                url + '/prefix_export',
+                json={'max_pages': max_pages, 'wire': 'binary'},
+                headers={'Accept': handoff_lib.CONTENT_TYPE_BINARY},
+                timeout=30)
+            if resp.status_code != 200:
+                raise requests.RequestException(
+                    f'prefix_export -> {resp.status_code}')
+            imp = requests.post(
+                sibling + '/kv_import', data=resp.content,
+                headers={'Content-Type':
+                         handoff_lib.CONTENT_TYPE_BINARY},
+                timeout=30)
+            if imp.status_code != 200:
+                raise requests.RequestException(
+                    f'kv_import -> {imp.status_code}')
+            body = imp.json() if imp.content else {}
+            pages = int(body.get('imported_pages', 0) or 0) + \
+                int(body.get('cached_pages', 0) or 0)
+        except (requests.RequestException, ValueError) as e:
+            status = f'failed: {e}'
+            logger.debug(f'drain prefix handoff skipped: {e}')
+        _journal_drain('drain_prefix_handoff',
+                       service=self.service_name,
+                       replica_id=replica['replica_id'],
+                       target=sibling, pages=pages, status=status)
 
     # -------------------------------------------------------------- probe
 
@@ -301,8 +547,10 @@ class ReplicaManager:
         for replica in serve_state.get_replicas(self.service_name):
             status = ReplicaStatus(replica['status'])
             replica_id = replica['replica_id']
-            if status in (ReplicaStatus.READY, ReplicaStatus.NOT_READY,
-                          ReplicaStatus.STARTING):
+            if status is ReplicaStatus.DRAINING:
+                self._sync_draining_one(replica)
+            elif status in (ReplicaStatus.READY, ReplicaStatus.NOT_READY,
+                            ReplicaStatus.STARTING):
                 if status is not ReplicaStatus.STARTING and \
                         self._check_preempted(replica):
                     logger.info(f'replica {replica_id} preempted')

@@ -45,6 +45,10 @@ DEFAULT_ROLE = 'mixed'
 ROUTED_ROLE_HEADER = 'X-SkyTPU-Routed-Role'
 AFFINITY_HEADER = 'X-SkyTPU-Affinity'
 HANDOFF_MS_HEADER = 'X-SkyTPU-Handoff-Ms'
+# Per-request time budget in milliseconds; propagated LB -> server ->
+# engine slot.  Past it, the request is reaped and its KV pages freed
+# (HTTP 504) instead of decoding to a client that stopped waiting.
+DEADLINE_HEADER = 'X-SkyTPU-Deadline-Ms'
 
 # Prompt tokens (or chars/4 for text prompts) at which a request
 # counts as prefill-heavy and is eligible for prefill-pool handoff.
@@ -140,6 +144,17 @@ class Router:
         for key in [k for k, url in self._affinity.items()
                     if url not in self._endpoints]:
             del self._affinity[key]
+
+    def remove_endpoint(self, url: str) -> bool:
+        """Drop one replica immediately (a drain/retire push from the
+        controller — don't wait for the next sync): it stops receiving
+        routes and its prefix-affinity pins re-home on next use.
+        Returns whether the url was present."""
+        with self._lock:
+            present = self._endpoints.pop(url, None) is not None
+            if present:
+                self._drop_stale_affinity_locked()
+            return present
 
     def endpoints(self) -> List[ReplicaEndpoint]:
         with self._lock:

@@ -77,13 +77,22 @@ class QueueExpired(RuntimeError):
         self.retry_after = max(1.0, retry_after)
 
 
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline (X-SkyTPU-Deadline-Ms) passed before it
+    finished: queued requests expire at pop, decoding requests are
+    reaped mid-generation — either way the slot and its KV pages are
+    freed instead of decoding for a client that stopped waiting.
+    Servers map this to HTTP 504."""
+
+
 class Request:
 
     def __init__(self, prompt_ids: List[int], max_new_tokens: int,
                  stop_token, temperature: float = 0.0, top_k: int = 0,
                  seed: int = 0,
                  request_id: Optional[str] = None,
-                 route_meta: Optional[Dict[str, Any]] = None) -> None:
+                 route_meta: Optional[Dict[str, Any]] = None,
+                 deadline_ms: Optional[float] = None) -> None:
         self.prompt_ids = list(prompt_ids)
         self.max_new_tokens = max_new_tokens
         # Per-request phase trace (queue/prefill/TTFT/ITL/total); the
@@ -110,6 +119,12 @@ class Request:
         self.top_k = int(top_k)
         self.seed = int(seed)
         self.submit_time = time.monotonic()
+        # Absolute monotonic deadline (None = no deadline): after it,
+        # the engine cancels the slot and frees its pages instead of
+        # decoding to a client that stopped waiting.
+        self.deadline: Optional[float] = (
+            self.submit_time + float(deadline_ms) / 1e3
+            if deadline_ms is not None else None)
         self.done = threading.Event()
         self.tokens: List[int] = []
         self.error: Optional[Exception] = None
@@ -214,6 +229,11 @@ class Request:
         engine frees the slot on its next tick."""
         self.cancelled = True
 
+    def deadline_exceeded(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None and
+                (time.monotonic() if now is None else now) >
+                self.deadline)
+
 
 class Slot:
 
@@ -309,6 +329,11 @@ class AdmissionQueue:
             if request.cancelled:
                 request._finish()  # pylint: disable=protected-access
                 continue
+            if request.deadline_exceeded():
+                _M_REJECTED.labels(reason='deadline_exceeded').inc()
+                request._finish(DeadlineExceeded(  # pylint: disable=protected-access
+                    'request deadline passed while queued'))
+                continue
             if (self.queue_ttl is not None and
                     time.monotonic() - request.submit_time >
                     self.queue_ttl):
@@ -337,19 +362,21 @@ class AdmissionQueue:
         _M_REJECTED.labels(reason='queue_expired').inc(n)
 
     def expire_stale(self) -> None:
-        """Fail requests that outlived queue_ttl while still queued —
-        without this a saturated engine leaves them waiting out their
-        whole client timeout."""
-        if self.queue_ttl is None:
-            return
+        """Fail requests that outlived queue_ttl (or their own
+        deadline) while still queued — without this a saturated engine
+        leaves them waiting out their whole client timeout."""
         now = time.monotonic()
         expired = []
+        deadlined = []
         with self.cond:
             if not self._queue:
                 return
             keep: Deque[Request] = collections.deque()
             for request in self._queue:
-                if now - request.submit_time > self.queue_ttl:
+                if request.deadline_exceeded(now):
+                    deadlined.append(request)
+                elif (self.queue_ttl is not None and
+                        now - request.submit_time > self.queue_ttl):
                     expired.append(request)
                 else:
                     keep.append(request)
@@ -361,6 +388,12 @@ class AdmissionQueue:
             request._finish(QueueExpired(  # pylint: disable=protected-access
                 f'request expired after {self.queue_ttl}s queued',
                 retry_after=self._drain_estimate()))
+        if deadlined:
+            _M_REJECTED.labels(reason='deadline_exceeded').inc(
+                len(deadlined))
+        for request in deadlined:
+            request._finish(DeadlineExceeded(  # pylint: disable=protected-access
+                'request deadline passed while queued'))
 
     def drain(self, error_factory: Callable[[], Exception]) -> None:
         """Fail everything still queued (shutdown/engine failure)."""

@@ -34,6 +34,12 @@ class ReplicaStatus(enum.Enum):
     STARTING = 'STARTING'
     READY = 'READY'
     NOT_READY = 'NOT_READY'
+    # Graceful retirement in progress: the LB stopped routing here, the
+    # replica's HTTP fronts 503 new generates, and the engine finishes
+    # its in-flight decodes before the cluster is torn down (bounded by
+    # SKYTPU_SERVE_DRAIN_TIMEOUT_S).  Non-terminal: the drain monitor
+    # in replica_managers owns the transition to TERMINATED.
+    DRAINING = 'DRAINING'
     SHUTTING_DOWN = 'SHUTTING_DOWN'
     FAILED = 'FAILED'
     FAILED_INITIAL_DELAY = 'FAILED_INITIAL_DELAY'
@@ -83,6 +89,7 @@ CREATE TABLE IF NOT EXISTS replicas (
     launched_at REAL,
     role TEXT DEFAULT 'mixed',
     num_hosts INTEGER DEFAULT 1,
+    drain_started_at REAL,
     PRIMARY KEY (service_name, replica_id)
 )"""
 
@@ -100,6 +107,12 @@ def _migrate(conn: sqlite3.Connection) -> None:
         # hosts this replica spans; 1 for every pre-slice row.
         conn.execute('ALTER TABLE replicas ADD COLUMN num_hosts '
                      'INTEGER DEFAULT 1')
+    if 'drain_started_at' not in columns:
+        # Graceful drain (ISSUE 10): persisted so the drain timeout
+        # survives controller restarts (an interrupted drain resumes
+        # with its original clock, never a fresh one).
+        conn.execute('ALTER TABLE replicas ADD COLUMN '
+                     'drain_started_at REAL')
 
 
 def _db_path() -> str:
@@ -231,6 +244,19 @@ def set_replica_status(service_name: str, replica_id: int,
                 'UPDATE replicas SET status=? '
                 'WHERE service_name=? AND replica_id=?',
                 (status.value, service_name, replica_id))
+
+
+def set_replica_draining(service_name: str, replica_id: int,
+                         drain_started_at: float) -> None:
+    """Enter DRAINING with a persisted drain clock (the timeout must
+    survive controller restarts; resumed drains keep the original
+    start, never reset it)."""
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE replicas SET status=?, drain_started_at=? '
+            'WHERE service_name=? AND replica_id=?',
+            (ReplicaStatus.DRAINING.value, drain_started_at,
+             service_name, replica_id))
 
 
 def remove_replica(service_name: str, replica_id: int) -> None:

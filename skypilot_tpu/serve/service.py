@@ -33,6 +33,12 @@ def run_service(service_name: str, lb_port: int = 0) -> None:
     # process mode the parent overwrites this with the same value).
     serve_state.set_service_pids(service_name, controller_pid=os.getpid(),
                                  lb_pid=os.getpid())
+    # Crash recovery: a restarted daemon re-adopts the live fleet from
+    # serve_state (probing recorded URLs), resumes interrupted drains,
+    # and warm-starts the autoscalers at the live count — the first
+    # reconcile pass must not churn replicas that kept serving while
+    # the control plane was down.
+    controller.recover_fleet()
     try:
         controller.run_loop()
     finally:

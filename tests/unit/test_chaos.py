@@ -316,6 +316,53 @@ class TestInvariants:
               'ts': 1}])
         assert dangling and 'without kv_handoff_end' in dangling[0]
 
+    def test_drain_no_lost_requests(self):
+        ok = [
+            {'event': 'replica_drain_start', 'service': 's',
+             'replica_id': 1, 'url': 'http://a', 'ts': 1},
+            {'event': 'lb_retire', 'url': 'http://a', 'ts': 2},
+            {'event': 'lb_route', 'request_id': 'r1',
+             'url': 'http://b', 'ts': 3},
+            {'event': 'serve_request_done', 'request_id': 'r1',
+             'ts': 4},
+            {'event': 'replica_drain_end', 'service': 's',
+             'replica_id': 1, 'url': 'http://a', 'reason': 'drained',
+             'ts': 5},
+        ]
+        assert invariants.drain_no_lost_requests(ok) == []
+        # Routed to the retired replica AFTER its retire event.
+        raced = invariants.drain_no_lost_requests(ok + [
+            {'event': 'lb_route', 'request_id': 'r2',
+             'url': 'http://a', 'ts': 6},
+            {'event': 'serve_request_done', 'request_id': 'r2',
+             'ts': 7}])
+        assert raced and 'AFTER its retire event' in raced[0]
+        # Routed before the retire is fine.
+        before = invariants.drain_no_lost_requests([
+            {'event': 'lb_route', 'request_id': 'r3',
+             'url': 'http://a', 'ts': 1},
+            {'event': 'serve_request_done', 'request_id': 'r3',
+             'ts': 2},
+            {'event': 'lb_retire', 'url': 'http://a', 'ts': 3}])
+        assert before == []
+        # Lost and double-executed requests.
+        lost = invariants.drain_no_lost_requests(
+            [{'event': 'lb_route', 'request_id': 'r4', 'ts': 1}])
+        assert lost and 'never completed' in lost[0]
+        # Dangling drain (started, never terminated).
+        dangling = invariants.drain_no_lost_requests(
+            [{'event': 'replica_drain_start', 'service': 's',
+              'replica_id': 9, 'url': 'http://c', 'ts': 1}])
+        assert dangling and 'without replica_drain_end' in dangling[0]
+        # Unknown terminal reason.
+        weird = invariants.drain_no_lost_requests(ok + [
+            {'event': 'replica_drain_start', 'service': 's',
+             'replica_id': 2, 'url': 'http://b', 'ts': 8},
+            {'event': 'replica_drain_end', 'service': 's',
+             'replica_id': 2, 'url': 'http://b', 'reason': 'shrug',
+             'ts': 9}])
+        assert weird and 'unknown reason' in weird[0]
+
     def test_check_unknown_invariant(self):
         out = invariants.check([], ['nope'])
         assert out and 'unknown invariant' in out[0]
@@ -537,6 +584,42 @@ class TestScenarios:
         assert result.ok, (result.violations, result.details)
         assert result.details['rebuilt_status'] == 'READY'
         assert all(s == 200 for s in result.details['rebuilt_statuses'])
+
+    def test_drain_under_load(self, local_infra):
+        """ISSUE 10 acceptance: scale-down AND a rolling replacement
+        under live Poisson traffic complete with ZERO non-2xx client
+        responses; journal replay (drain_no_lost_requests) proves no
+        request was routed to a replica after its retire event, none
+        was lost or double-executed, and the retiring replica handed
+        its hot prefix pages to the surviving sibling."""
+        result = scenarios_lib.run_scenario('drain_under_load',
+                                            seed=41)
+        assert result.ok, (result.violations, result.details)
+        assert result.details['statuses'] == [200]
+        assert result.details['requests'] >= 20
+        assert result.details['scale_down_final'] == 'TERMINATED'
+        assert result.details['rolling_final'] == 'TERMINATED'
+        assert [r for _, r in result.details['drain_ends']] == \
+            ['drained', 'drained']
+        assert len(result.details['lb_retires']) == 2
+        assert 'ok' in result.details['prefix_handoffs']
+
+    def test_controller_crash_recovery(self, local_infra):
+        """ISSUE 10 acceptance: controller killed/restarted
+        mid-service re-adopts the fleet from serve_state (no replica
+        churn in the first real reconcile pass) and warm-starts the
+        autoscaler at the live count — even with the first tick
+        chaos-wedged."""
+        result = scenarios_lib.run_scenario(
+            'controller_crash_recovery', seed=42)
+        assert result.ok, (result.violations, result.details)
+        assert result.details['warm_start_target'] == 2
+        assert result.details['fleet_before'] == \
+            result.details['fleet_after']
+        assert all(s == 'READY'
+                   for _, s in result.details['fleet_after'])
+        assert [f['site'] for f in result.fault_sequence] == \
+            ['serve.controller_tick']
 
     def test_page_pool_exhaustion(self, local_infra):
         """KV page-pool denial must degrade to admission backpressure

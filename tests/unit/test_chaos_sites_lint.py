@@ -83,6 +83,7 @@ def test_each_site_instruments_its_documented_layer():
         'jobs.status_poll': ('jobs/',),
         'jobs.recover': ('jobs/',),
         'serve.replica_probe': ('serve/',),
+        'serve.controller_tick': ('serve/',),
         'serve.page_pool': ('serve/',),
         'serve.kv_handoff': ('serve/',),
         'serve.rank_exec': ('serve/',),
