@@ -799,25 +799,11 @@ def serve_status(service_names, show_metrics):
 
 
 def _hist_quantile(parsed, name: str, q: float):
-    """Approximate quantile from an exposed Prometheus histogram
-    (upper bound of the bucket where the cumulative count crosses q)."""
-    buckets = parsed.get(f'{name}_bucket')
-    if not buckets:
-        return None
-    rows = []
-    for labels, value in buckets.items():
-        le = dict(labels).get('le')
-        if le is None:
-            continue
-        rows.append((float('inf') if le == '+Inf' else float(le), value))
-    rows.sort()
-    if not rows or rows[-1][1] <= 0:
-        return None
-    target = q * rows[-1][1]
-    for bound, cum in rows:
-        if cum >= target:
-            return bound
-    return rows[-1][0]
+    """Thin import: the real implementation (with linear interpolation
+    inside the winning bucket) lives in observability/metrics.py as
+    `histogram_quantile`, next to the exposition parser it consumes."""
+    from skypilot_tpu.observability import metrics as metrics_lib  # pylint: disable=import-outside-toplevel
+    return metrics_lib.histogram_quantile(parsed, name, q)
 
 
 def _rank_lag(parsed) -> str:
@@ -979,6 +965,221 @@ def serve_logs(service_name, replica_id, target):
     """Show replica or controller logs."""
     from skypilot_tpu import serve  # pylint: disable=import-outside-toplevel
     serve.tail_logs(service_name, target=target, replica_id=replica_id)
+
+
+def _trace_targets(record) -> Tuple[List[Dict[str, Any]],
+                                    Optional[str]]:
+    """(replica span targets, lb url) for one service record — every
+    replica with a URL is queried (a DRAINING replica may still hold
+    the span the user is after)."""
+    targets = [{'url': rep['url'], 'replica_id': rep['replica_id'],
+                'role': rep.get('role') or 'mixed'}
+               for rep in record['replicas']
+               if rep.get('url') and rep['status'] in
+               ('READY', 'NOT_READY', 'DRAINING')]
+    lb_port = record.get('load_balancer_port')
+    lb_url = f'http://127.0.0.1:{lb_port}' if lb_port else None
+    return targets, lb_url
+
+
+def _pick_service(records, service_name: Optional[str]):
+    if not records:
+        raise click.ClickException('No services.')
+    if service_name is None:
+        if len(records) > 1:
+            names = ', '.join(r['name'] for r in records)
+            raise click.ClickException(
+                f'Several services exist ({names}); pass --service.')
+        return records[0]
+    for record in records:
+        if record['name'] == service_name:
+            return record
+    raise click.ClickException(f'Service {service_name!r} not found.')
+
+
+@serve_group.command(name='trace')
+@click.argument('request_id')
+@click.option('--service', '-s', 'service_name', default=None,
+              help='Service to query (default: the only one).')
+@click.option('--export-trace', 'export_trace', default=None,
+              help='Also write the stitched trace as a Chrome-trace '
+                   'JSON to this path.')
+def serve_trace(request_id, service_name, export_trace):
+    """Stitch one request's spans across the fleet into a waterfall.
+
+    Every process that touched the request exports its span segments
+    (the LB's route/handoff/attempt phases via /lb/spans, each
+    replica's engine + handoff-endpoint spans via /spans); this
+    assembles them by request id into one end-to-end view — LB queue,
+    route, KV handoff export/import, prefill, decode — with a failed
+    attempt and its retry shown as distinct segments."""
+    from skypilot_tpu import serve  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.observability import traces as traces_lib  # pylint: disable=import-outside-toplevel
+    record = _pick_service(
+        serve.status([service_name] if service_name else None),
+        service_name)
+    targets, lb_url = _trace_targets(record)
+    if not targets and not lb_url:
+        raise click.ClickException(
+            f'Service {record["name"]} has no reachable processes.')
+    segments = traces_lib.collect(request_id, targets, lb_url)
+    if not segments:
+        raise click.ClickException(
+            f'No spans found for request {request_id!r} (finished '
+            'long ago and aged out of the bounded span stores, or '
+            'never reached this service).')
+    click.echo(f'Trace {request_id} — {len(segments)} segment(s) '
+               f'across {len({(s.get("process"), s.get("replica_id")) for s in segments})} '
+               f'process(es):')
+    for line in traces_lib.format_waterfall(segments):
+        click.echo(f'  {line}')
+    if export_trace:
+        traces_lib.export_chrome_trace(segments, export_trace)
+        click.echo(f'Chrome trace written to {export_trace} '
+                   '(open in chrome://tracing or Perfetto).')
+
+
+def _sparkline(values, empty: str = '-') -> str:
+    """Unicode sparkline of a binned series (None bins render as a
+    space); scaled to the series max."""
+    blocks = '▁▂▃▄▅▆▇█'
+    present = [v for v in values or [] if v is not None]
+    if not present:
+        return empty
+    hi = max(present)
+    out = []
+    for v in values:
+        if v is None:
+            out.append(' ')
+        elif hi <= 0:
+            out.append(blocks[0])
+        else:
+            out.append(blocks[min(len(blocks) - 1,
+                                  int(v / hi * (len(blocks) - 1)
+                                      + 0.5))])
+    return ''.join(out)
+
+
+def _fetch_telemetry(record) -> Optional[Dict[str, Any]]:
+    """GET /controller/telemetry for one service (None when the
+    controller is unreachable — `serve top` then shows fleet state
+    only)."""
+    import requests  # pylint: disable=import-outside-toplevel
+    port = record.get('controller_port')
+    if not port:
+        return None
+    try:
+        resp = requests.get(
+            f'http://127.0.0.1:{port}/controller/telemetry',
+            timeout=5)
+        resp.raise_for_status()
+        return resp.json()
+    except (requests.RequestException, ValueError):
+        return None
+
+
+def _render_top(records, telemetry_by_service) -> None:
+    """One `serve top` frame from already-fetched data (pure render —
+    tests drive this directly)."""
+    for r in records:
+        telemetry = telemetry_by_service.get(r['name']) or {}
+        mfu = telemetry.get('mfu') or {}
+        ready = sum(1 for rep in r['replicas']
+                    if rep['status'] == 'READY')
+        click.echo(f"{r['name']}  [{r['status']}]  v{r['version']}  "
+                   f"{ready}/{len(r['replicas'])} ready  "
+                   f"LB :{r.get('load_balancer_port') or '-'}")
+        def fmt_mfu(v):
+            if v is None:
+                return '-'
+            # Tiny models / emulated chips produce real-but-minuscule
+            # MFU; scientific notation beats rendering 0.0000.
+            return f'{v:.4f}' if v >= 5e-4 or v == 0 else f'{v:.1e}'
+
+        rows = []
+        for rep in r['replicas']:
+            rows.append((rep['replica_id'],
+                         rep.get('role') or 'mixed',
+                         rep['status'], rep.get('url') or '-',
+                         fmt_mfu(mfu.get(str(rep['replica_id'])))))
+        if rows:
+            _print_table(['REPLICA', 'ROLE', 'STATUS', 'URL', 'MFU'],
+                         rows)
+        roles = telemetry.get('roles') or {}
+        if roles:
+            click.echo('')
+            rows = []
+            for role, sig in sorted(roles.items()):
+                def fmt(v, suffix=''):
+                    return '-' if v is None else f'{v:g}{suffix}'
+                rows.append((
+                    role, fmt(sig.get('qps')),
+                    _sparkline(sig.get('qps_spark')),
+                    _sparkline(sig.get('tokens_per_s_spark')),
+                    fmt(sig.get('ttft_p99_ms'), 'ms'),
+                    fmt(sig.get('itl_p99_ms'), 'ms')))
+            _print_table(['ROLE', 'QPS', 'QPS HISTORY',
+                          'TOK/S HISTORY', 'TTFT p99', 'ITL p99'],
+                         rows)
+        slos = telemetry.get('slos') or []
+        if slos:
+            click.echo('')
+            rows = [(s['slo'], s.get('target', '-'),
+                     f"{s.get('burn_fast', 0):g}",
+                     f"{s.get('burn_slow', 0):g}",
+                     'BREACH' if s.get('breaching') else 'ok')
+                    for s in slos]
+            _print_table(['SLO', 'TARGET', 'BURN fast', 'BURN slow',
+                          'STATUS'], rows)
+        slow = telemetry.get('slow_traces') or []
+        if slow:
+            click.echo('')
+            rows = [(s.get('request_id', '?'),
+                     s.get('replica_id', '-'),
+                     s.get('role') or '-',
+                     f"{s.get('duration_ms', 0):.1f}ms",
+                     f"{s['ttft_ms']:.1f}ms"
+                     if s.get('ttft_ms') is not None else '-',
+                     s.get('status', '-'))
+                    for s in slow[:8]]
+            _print_table(['SLOWEST TRACES', 'REPLICA', 'ROLE',
+                          'TOTAL', 'TTFT', 'STATUS'], rows)
+        click.echo('')
+
+
+@serve_group.command(name='top')
+@click.argument('service_names', nargs=-1)
+@click.option('--refresh', '-r', 'refresh_every', type=float,
+              default=2.0, help='Redraw every N seconds.')
+@click.option('--once', is_flag=True, default=False,
+              help='Print one frame and exit (scripting/CI).')
+def serve_top(service_names, refresh_every, once):
+    """Live fleet dashboard: replica table with per-replica MFU,
+    per-role QPS/throughput sparklines and latency quantiles from the
+    controller's telemetry ring buffers, SLO burn status, and the
+    slowest recent traces."""
+    import time as time_lib  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu import serve  # pylint: disable=import-outside-toplevel
+
+    def _frame():
+        records = serve.status(list(service_names) or None)
+        if not records:
+            click.echo('No services.')
+            return
+        telemetry = {r['name']: _fetch_telemetry(r) for r in records}
+        _render_top(records, telemetry)
+
+    if once or refresh_every <= 0:
+        _frame()
+        return
+    try:
+        while True:
+            click.clear()
+            _frame()
+            time_lib.sleep(refresh_every)
+    except KeyboardInterrupt:
+        pass
 
 
 # ------------------------------------------------------------ bench group

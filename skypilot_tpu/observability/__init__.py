@@ -16,6 +16,16 @@ telemetry):
   JSONL event journals, `ControlSpan` phase spans over the launch and
   recovery paths, and the `skytpu_provision_* / skytpu_gang_* /
   skytpu_skylet_* / skytpu_jobs_*` fleet-health series.
+- `aggregator`: the controller-side fleet telemetry plane — a bounded
+  ring-buffer time-series store scraped from every replica + the LB,
+  with windowed rates/quantiles, smoothed autoscaler signals, and
+  per-replica MFU gauges.
+- `slo`: service-level objectives from the spec's `slos:` block,
+  evaluated multi-window / multi-burn-rate against the aggregator
+  store, with breaches journaled as `slo_burn_start/_end`.
+- `traces`: cross-process trace assembly — every process exports its
+  span segments (`GET /spans`, `GET /lb/spans`) and `sky serve trace`
+  stitches them into one waterfall / Chrome trace.
 
 See docs/observability.md for the metrics catalog, the request-id
 propagation diagram, and the control-plane event schema.

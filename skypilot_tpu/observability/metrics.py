@@ -141,7 +141,8 @@ class _Instrument:
         with self._lock:
             return dict(self._children)
 
-    def expose_lines(self) -> List[str]:
+    def expose_lines(self, const: Sequence[Tuple[str, str]] = ()
+                     ) -> List[str]:
         raise NotImplementedError
 
     def _header(self) -> List[str]:
@@ -201,12 +202,15 @@ class Counter(_Instrument):
     def value(self) -> float:
         return self._read_child(self._default_child())
 
-    def expose_lines(self) -> List[str]:
+    def expose_lines(self, const: Sequence[Tuple[str, str]] = ()
+                     ) -> List[str]:
         lines = self._header()
         with self._lock:
             for key, child in sorted(self._children.items()):
                 lines.append(_format_series(
-                    self.name, list(zip(self.labelnames, key)), child[0]))
+                    self.name,
+                    list(const) + list(zip(self.labelnames, key)),
+                    child[0]))
         return lines
 
 
@@ -243,12 +247,15 @@ class Gauge(_Instrument):
     def value(self) -> float:
         return self._read_child(self._default_child())
 
-    def expose_lines(self) -> List[str]:
+    def expose_lines(self, const: Sequence[Tuple[str, str]] = ()
+                     ) -> List[str]:
         lines = self._header()
         with self._lock:
             for key, child in sorted(self._children.items()):
                 lines.append(_format_series(
-                    self.name, list(zip(self.labelnames, key)), child[0]))
+                    self.name,
+                    list(const) + list(zip(self.labelnames, key)),
+                    child[0]))
         return lines
 
 
@@ -324,11 +331,12 @@ class Histogram(_Instrument):
         with self._lock:
             return list(child.counts)
 
-    def expose_lines(self) -> List[str]:
+    def expose_lines(self, const: Sequence[Tuple[str, str]] = ()
+                     ) -> List[str]:
         lines = self._header()
         with self._lock:
             for key, child in sorted(self._children.items()):
-                base = list(zip(self.labelnames, key))
+                base = list(const) + list(zip(self.labelnames, key))
                 acc = 0
                 for bound, n in zip(self.buckets, child.counts):
                     acc += n
@@ -351,6 +359,23 @@ class Registry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Instrument] = {}
+        # Constant process-identity labels stamped on EVERY exposed
+        # series (replica_id / role / num_hosts on a serving replica):
+        # the fleet aggregator's store keys series by their full label
+        # set, so without these, same-named series scraped from
+        # different replicas would collapse into one.
+        self._const_labels: Tuple[Tuple[str, str], ...] = ()
+
+    def set_const_labels(self, labels: Dict[str, Any]) -> None:
+        """Install the constant labels appended to every series this
+        registry exposes (sorted by label name for a stable format)."""
+        with self._lock:
+            self._const_labels = tuple(sorted(
+                (str(k), str(v)) for k, v in labels.items()))
+
+    def const_labels(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._const_labels)
 
     def register(self, metric: _Instrument) -> _Instrument:
         with self._lock:
@@ -402,9 +427,10 @@ class Registry:
         with self._lock:
             metrics = sorted(self._metrics.values(),
                              key=lambda m: m.name)
+            const = self._const_labels
         lines: List[str] = []
         for metric in metrics:
-            lines.extend(metric.expose_lines())
+            lines.extend(metric.expose_lines(const))
         return '\n'.join(lines) + '\n'
 
     def clear(self) -> None:
@@ -412,6 +438,7 @@ class Registry:
         instruments through the get-or-create constructors)."""
         with self._lock:
             self._metrics.clear()
+            self._const_labels = ()
 
 
 # The process-global registry every layer reports into; `GET /metrics`
@@ -469,6 +496,53 @@ def parse_exposition(text: str) -> Dict[str, Dict[Tuple[Tuple[str, str],
         value = float('inf') if value_str == '+Inf' else float(value_str)
         out.setdefault(name.strip(), {})[key] = value
     return out
+
+
+def histogram_quantile(parsed: Dict[str, Dict[Tuple[Tuple[str, str],
+                                                    ...], float]],
+                       name: str, q: float) -> Optional[float]:
+    """Quantile of an exposed Prometheus histogram, from
+    `parse_exposition` output (the CLI tables and the fleet aggregator
+    both feed through here).
+
+    Buckets from every label set of `<name>_bucket` are summed per
+    upper bound (an aggregated quantile across replicas/roles), then
+    the quantile is read Prometheus-style: find the bucket where the
+    cumulative count crosses q and interpolate LINEARLY inside it
+    (lower edge = the previous bucket's bound, 0 for the first).  A
+    quantile landing in the +Inf bucket clamps to the highest finite
+    bound.  Returns None without data."""
+    buckets = parsed.get(f'{name}_bucket')
+    if not buckets:
+        return None
+    cum: Dict[float, float] = {}
+    for labels, value in buckets.items():
+        le = dict(labels).get('le')
+        if le is None:
+            continue
+        bound = float('inf') if le == '+Inf' else float(le)
+        cum[bound] = cum.get(bound, 0.0) + value
+    rows = sorted(cum.items())
+    if not rows or rows[-1][1] <= 0:
+        return None
+    total = rows[-1][1]
+    target = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, acc in rows:
+        if acc >= target:
+            if bound == float('inf'):
+                # Prometheus convention: the +Inf bucket has no upper
+                # edge to interpolate into; report the highest finite
+                # bound (None when every observation overflowed).
+                finite = [b for b, _ in rows if b != float('inf')]
+                return finite[-1] if finite else None
+            if acc == prev_cum:
+                return bound
+            frac = (target - prev_cum) / (acc - prev_cum)
+            return prev_bound + (bound - prev_bound) * max(
+                0.0, min(1.0, frac))
+        prev_bound, prev_cum = bound, acc
+    return rows[-1][0]
 
 
 def _split_labels(label_str: str) -> Iterable[str]:

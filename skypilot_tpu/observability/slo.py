@@ -1,0 +1,225 @@
+"""SLO tracking: objectives from the service spec, evaluated
+multi-window / multi-burn-rate against the fleet telemetry store.
+
+The service spec's optional ``slos:`` block names the objectives:
+
+    service:
+      slos:
+        ttft_p99_ms: 500      # 99% of requests see first token <= 500ms
+        itl_p99_ms: 100       # 99% of inter-token gaps <= 100ms
+        error_rate: 0.01      # <= 1% of LB requests fail upstream
+        availability: 0.999   # <= 0.1% of LB requests see no replica
+
+Each objective defines an *error budget* (1% of requests may exceed
+the TTFT threshold, etc.).  The tracker computes the **burn rate** —
+the fraction of budget being consumed per unit time, i.e.
+``bad_fraction / budget`` — over a FAST and a SLOW trailing window
+(Google SRE multi-window multi-burn-rate alerting: the fast window
+catches a fresh regression quickly, the slow window keeps one noisy
+scrape from paging).  A breach requires the burn rate above threshold
+in BOTH windows; recovery requires the fast window back under it.
+
+Breach transitions are journaled (``slo_burn_start`` /
+``slo_burn_end`` in ``events/serve.jsonl`` — the same flight recorder
+the drain lifecycle uses) and exported as gauges
+(``skytpu_slo_burn_rate{slo,window}``, ``skytpu_slo_breached{slo}``),
+and `sky serve top` renders the live status.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import aggregator as aggregator_lib
+from skypilot_tpu.observability import metrics as metrics_lib
+
+logger = sky_logging.init_logger(__name__)
+
+_M_BURN = metrics_lib.gauge(
+    'skytpu_slo_burn_rate',
+    'Error-budget burn rate per SLO and evaluation window (1.0 = '
+    'consuming budget exactly as fast as the objective allows).',
+    ('service', 'slo', 'window'))
+_M_BREACHED = metrics_lib.gauge(
+    'skytpu_slo_breached',
+    'Whether the SLO is currently breaching (burn rate above '
+    'threshold in both windows).', ('service', 'slo'))
+
+# The slos: block vocabulary (service_spec validates against this).
+SLO_KEYS = ('ttft_p99_ms', 'itl_p99_ms', 'error_rate', 'availability')
+
+
+def fast_window_s() -> float:
+    return float(os.environ.get('SKYTPU_SLO_FAST_WINDOW_S', '60'))
+
+
+def slow_window_s() -> float:
+    return float(os.environ.get('SKYTPU_SLO_SLOW_WINDOW_S', '300'))
+
+
+def burn_threshold() -> float:
+    return float(os.environ.get('SKYTPU_SLO_BURN_THRESHOLD', '1.0'))
+
+
+@dataclasses.dataclass
+class SLO:
+    """One objective: how to measure its bad fraction + the budget."""
+    name: str                  # the slos: key, e.g. 'ttft_p99_ms'
+    kind: str                  # 'latency' | 'error_rate' | 'availability'
+    budget: float              # allowed bad fraction (e.g. 0.01)
+    threshold_s: float = 0.0   # latency SLOs: the bound in seconds
+    series: str = ''           # latency SLOs: the histogram base name
+    target: float = 0.0        # the raw spec value (for display)
+
+
+def parse_slos(slos: Optional[Dict[str, Any]]) -> List[SLO]:
+    """The spec's slos: block -> SLO objects (service_spec already
+    validated keys and ranges)."""
+    out: List[SLO] = []
+    if not slos:
+        return out
+    if 'ttft_p99_ms' in slos:
+        out.append(SLO('ttft_p99_ms', 'latency', budget=0.01,
+                       threshold_s=float(slos['ttft_p99_ms']) / 1e3,
+                       series='skytpu_engine_ttft_seconds',
+                       target=float(slos['ttft_p99_ms'])))
+    if 'itl_p99_ms' in slos:
+        out.append(SLO('itl_p99_ms', 'latency', budget=0.01,
+                       threshold_s=float(slos['itl_p99_ms']) / 1e3,
+                       series='skytpu_engine_itl_seconds',
+                       target=float(slos['itl_p99_ms'])))
+    if 'error_rate' in slos:
+        rate = float(slos['error_rate'])
+        out.append(SLO('error_rate', 'error_rate', budget=rate,
+                       target=rate))
+    if 'availability' in slos:
+        avail = float(slos['availability'])
+        out.append(SLO('availability', 'availability',
+                       budget=1.0 - avail, target=avail))
+    return out
+
+
+def _bad_fraction(slo: SLO, store: 'aggregator_lib.TimeSeriesStore',
+                  window_s: float, now: float) -> Optional[float]:
+    """Fraction of the window's events that violate the objective;
+    None when the window holds no traffic (no traffic = no burn)."""
+    if slo.kind == 'latency':
+        deltas = store.bucket_deltas(slo.series, window_s, now)
+        if not deltas:
+            return None
+        total = max(deltas.values())  # cumulative: +Inf (or top) bucket
+        if total <= 0:
+            return None
+        # Good = observations at or under the threshold: the tightest
+        # bucket bound >= threshold (conservative when the threshold
+        # falls between bounds).
+        good_bounds = [b for b in deltas if b >= slo.threshold_s]
+        good = deltas[min(good_bounds)] if good_bounds else 0.0
+        return max(0.0, 1.0 - good / total)
+    requests = store.counter_rate('skytpu_lb_requests_total',
+                                  window_s, now)
+    if not requests:
+        return None
+    if slo.kind == 'error_rate':
+        bad = (store.counter_rate('skytpu_lb_upstream_errors_total',
+                                  window_s, now) or 0.0)
+    else:  # availability
+        bad = (store.counter_rate('skytpu_lb_no_replica_total',
+                                  window_s, now) or 0.0)
+    return min(1.0, bad / requests)
+
+
+class SLOTracker:
+    """Evaluate the objectives each reconcile pass; journal breaches."""
+
+    def __init__(self, service_name: str, slos: List[SLO],
+                 journal: Optional[Any] = None) -> None:
+        self.service_name = service_name
+        self.slos = slos
+        self._journal = journal
+        # slo name -> breach start ts while breaching.
+        self._breaching: Dict[str, float] = {}
+        self._last: List[Dict[str, Any]] = []
+
+    def _get_journal(self):
+        if self._journal is not None:
+            return self._journal
+        from skypilot_tpu.observability import events as events_lib  # pylint: disable=import-outside-toplevel
+        return events_lib.get_journal(
+            os.path.join(events_lib.journal_root(), 'serve.jsonl'))
+
+    def _journal_event(self, event: str, **fields: Any) -> None:
+        try:
+            self._get_journal().append(event, service=self.service_name,
+                                       **fields)
+        except Exception:  # pylint: disable=broad-except
+            pass  # recording must never break the control plane
+
+    def evaluate(self, store: 'aggregator_lib.TimeSeriesStore',
+                 now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """One evaluation pass; returns (and caches) per-SLO status
+        dicts for `/controller/telemetry`."""
+        now = time.time() if now is None else now
+        fast_w, slow_w = fast_window_s(), slow_window_s()
+        threshold = burn_threshold()
+        out: List[Dict[str, Any]] = []
+        for slo in self.slos:
+            burns = {}
+            for window_name, window in (('fast', fast_w),
+                                        ('slow', slow_w)):
+                bad = _bad_fraction(slo, store, window, now)
+                burn = (bad / slo.budget) if (
+                    bad is not None and slo.budget > 0) else 0.0
+                burns[window_name] = burn
+                _M_BURN.labels(service=self.service_name, slo=slo.name,
+                               window=window_name).set(round(burn, 6))
+            was_breaching = slo.name in self._breaching
+            if not was_breaching:
+                breaching = (burns['fast'] > threshold and
+                             burns['slow'] > threshold)
+            else:
+                # Recovery needs only the fast window back under the
+                # threshold: the slow window keeps the breach's history
+                # long after the regression is fixed.
+                breaching = burns['fast'] > threshold
+            if breaching and not was_breaching:
+                self._breaching[slo.name] = now
+                self._journal_event(
+                    'slo_burn_start', slo=slo.name, target=slo.target,
+                    burn_fast=round(burns['fast'], 4),
+                    burn_slow=round(burns['slow'], 4),
+                    window_fast_s=fast_w, window_slow_s=slow_w)
+                logger.warning(
+                    f'SLO {slo.name} breaching for '
+                    f'{self.service_name}: burn fast='
+                    f'{burns["fast"]:.2f} slow={burns["slow"]:.2f} '
+                    f'(threshold {threshold})')
+            elif not breaching and was_breaching:
+                started = self._breaching.pop(slo.name)
+                self._journal_event(
+                    'slo_burn_end', slo=slo.name,
+                    duration_s=round(now - started, 3),
+                    burn_fast=round(burns['fast'], 4))
+                logger.info(f'SLO {slo.name} recovered for '
+                            f'{self.service_name} after '
+                            f'{now - started:.0f}s')
+            _M_BREACHED.labels(service=self.service_name,
+                               slo=slo.name).set(1.0 if breaching
+                                                 else 0.0)
+            out.append({
+                'slo': slo.name, 'kind': slo.kind,
+                'target': slo.target, 'budget': slo.budget,
+                'burn_fast': round(burns['fast'], 4),
+                'burn_slow': round(burns['slow'], 4),
+                'breaching': breaching,
+                'since': self._breaching.get(slo.name),
+            })
+        self._last = out
+        return out
+
+    def status(self) -> List[Dict[str, Any]]:
+        """The most recent evaluation (for the telemetry endpoint)."""
+        return list(self._last)

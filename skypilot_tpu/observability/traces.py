@@ -1,0 +1,200 @@
+"""Distributed trace assembly: one request's life across the fleet.
+
+A disaggregated request touches several processes — LB queue → route →
+KV handoff export (prefill replica) → import (decode replica) →
+prefill/decode ticks → completion — and each process records only its
+own leg (a `RequestSpan` in the engine, a `SegmentStore` entry on the
+LB and the handoff endpoints).  This module stitches them:
+
+- every process exports its segments over HTTP (`GET /spans` on the
+  replica fronts, `GET /lb/spans` on the LB control plane), each
+  tagged with `process` / `replica_id` / `role` / `attempt`;
+- :func:`collect` fans those endpoints in for one request id;
+- :func:`assemble` orders the segments causally (by wall start, LB
+  attempts before the replica spans they produced);
+- :func:`format_waterfall` renders the classic text waterfall
+  (`sky serve trace <request-id>`);
+- :func:`to_chrome_trace` / :func:`export_chrome_trace` emit the same
+  segments as a Chrome trace (one pid per process, one tid per
+  attempt) through utils/timeline.write_trace.
+
+Clock caveat: segments carry *wall-clock* starts from different
+machines; ordering is as honest as NTP.  Within one process the
+ordering is exact.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import requests
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import timeline
+
+logger = sky_logging.init_logger(__name__)
+
+
+def fetch_segments(url: str, path: str = '/spans',
+                   request_id: Optional[str] = None,
+                   since: Optional[float] = None,
+                   timeout: float = 5.0) -> List[Dict[str, Any]]:
+    """One process's exported segments; [] on any failure (assembly is
+    best-effort — a dead replica must not kill the whole trace)."""
+    params: Dict[str, Any] = {}
+    if request_id is not None:
+        params['request_id'] = request_id
+    if since is not None:
+        params['since'] = since
+    try:
+        resp = requests.get(url.rstrip('/') + path, params=params,
+                            timeout=timeout)
+        if resp.status_code != 200:
+            return []
+        return (resp.json() or {}).get('segments') or []
+    except (requests.RequestException, ValueError) as e:
+        logger.debug(f'span fetch failed for {url}: {e}')
+        return []
+
+
+def collect(request_id: str, replica_targets: List[Dict[str, Any]],
+            lb_url: Optional[str] = None,
+            timeout: float = 5.0) -> List[Dict[str, Any]]:
+    """Fan in the fleet's segments for one request id.
+
+    `replica_targets`: dicts with `url` (and optionally `replica_id`,
+    `role` — used to tag segments from older replicas that predate
+    identity tagging).  `lb_url`: the LB base url, queried on its
+    `/lb/spans` control path."""
+    segments: List[Dict[str, Any]] = []
+    if lb_url:
+        for seg in fetch_segments(lb_url, '/lb/spans',
+                                  request_id=request_id,
+                                  timeout=timeout):
+            seg.setdefault('process', 'lb')
+            segments.append(seg)
+    for target in replica_targets:
+        for seg in fetch_segments(target['url'], '/spans',
+                                  request_id=request_id,
+                                  timeout=timeout):
+            seg.setdefault('process', 'replica')
+            seg.setdefault('replica_id', target.get('replica_id'))
+            seg.setdefault('role', target.get('role'))
+            segments.append(seg)
+    return assemble(segments)
+
+
+def assemble(segments: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Causal order: wall start first; ties break LB-before-replica
+    (the LB necessarily dispatched before the replica worked), then by
+    attempt so a failed attempt renders before its retry."""
+    def key(seg: Dict[str, Any]):
+        return (float(seg.get('start') or 0.0),
+                0 if seg.get('process') == 'lb' else 1,
+                int(seg.get('attempt') or 0))
+
+    return sorted((dict(s) for s in segments), key=key)
+
+
+def _who(seg: Dict[str, Any]) -> str:
+    if seg.get('process') == 'lb':
+        return 'lb'
+    rid = seg.get('replica_id')
+    role = seg.get('role')
+    who = f'replica {rid}' if rid is not None else 'replica'
+    return f'{who} ({role})' if role else who
+
+
+def format_waterfall(segments: List[Dict[str, Any]],
+                     width: int = 40) -> List[str]:
+    """Text waterfall, one line per segment plus indented phase lines:
+
+        +0.000ms  lb                 route            ▕████▍      ▏
+        +1.2ms    replica 1 (prefill) prefill_export  ▕  ██▊      ▏
+    """
+    if not segments:
+        return ['(no segments)']
+    t0 = min(float(s.get('start') or 0.0) for s in segments)
+    t_end = max(float(s.get('start') or 0.0) +
+                (float(s.get('duration_ms') or 0.0)) / 1e3
+                for s in segments)
+    total = max(t_end - t0, 1e-6)
+
+    def bar(start: float, duration_ms: float) -> str:
+        lo = int((start - t0) / total * width)
+        hi = int((start - t0 + duration_ms / 1e3) / total * width)
+        hi = max(hi, lo + 1)
+        return ('.' * lo + '#' * (hi - lo) +
+                '.' * max(0, width - hi))[:width]
+
+    rows: List[List[str]] = []
+    for seg in segments:
+        start = float(seg.get('start') or 0.0)
+        dur = float(seg.get('duration_ms') or 0.0)
+        name = str(seg.get('name') or 'span')
+        attempt = int(seg.get('attempt') or 0)
+        label = name if attempt == 0 else f'{name}#{attempt}'
+        status = seg.get('status')
+        rows.append([f'+{(start - t0) * 1e3:.1f}ms', _who(seg), label,
+                     f'{dur:.1f}ms',
+                     str(status) if status is not None else '',
+                     f'|{bar(start, dur)}|'])
+        for phase in seg.get('phases') or []:
+            p_start = float(phase.get('start') or start)
+            p_dur = float(phase.get('duration_ms') or 0.0)
+            detail = phase.get('target') or phase.get('status') or ''
+            rows.append([f'+{(p_start - t0) * 1e3:.1f}ms', '',
+                         f'  {phase.get("name", "?")}',
+                         f'{p_dur:.1f}ms', str(detail),
+                         f'|{bar(p_start, p_dur)}|'])
+    widths = [max(len(r[i]) for r in rows) for i in range(5)]
+    return ['  '.join(cell.ljust(w)
+                      for cell, w in zip(row[:5], widths)).rstrip() +
+            '  ' + row[5] for row in rows]
+
+
+def to_chrome_trace(segments: List[Dict[str, Any]]
+                    ) -> List[Dict[str, Any]]:
+    """Segments -> Chrome trace events: one pid per process (named via
+    'M' metadata events), one tid per attempt, segments and their
+    phases as 'X' complete events."""
+    pids: Dict[str, int] = {}
+    events: List[Dict[str, Any]] = []
+    for seg in assemble(segments):
+        who = _who(seg)
+        pid = pids.get(who)
+        if pid is None:
+            pid = len(pids)
+            pids[who] = pid
+            events.append({'ph': 'M', 'name': 'process_name',
+                           'pid': pid, 'tid': 0,
+                           'args': {'name': who}})
+        tid = int(seg.get('attempt') or 0)
+        start = float(seg.get('start') or 0.0)
+        dur = float(seg.get('duration_ms') or 0.0)
+        args = {k: v for k, v in seg.items()
+                if k not in ('phases',) and
+                isinstance(v, (str, int, float, bool))}
+        events.append({'ph': 'X',
+                       'name': str(seg.get('name') or 'span'),
+                       'cat': 'trace', 'pid': pid, 'tid': tid,
+                       'ts': int(start * 1e6),
+                       'dur': max(0, int(dur * 1e3)), 'args': args})
+        for phase in seg.get('phases') or []:
+            p_start = float(phase.get('start') or start)
+            p_dur = float(phase.get('duration_ms') or 0.0)
+            events.append({
+                'ph': 'X', 'name': str(phase.get('name') or 'phase'),
+                'cat': 'trace', 'pid': pid, 'tid': tid,
+                'ts': int(p_start * 1e6),
+                'dur': max(0, int(p_dur * 1e3)),
+                'args': {k: v for k, v in phase.items()
+                         if isinstance(v, (str, int, float, bool))}})
+    return events
+
+
+def export_chrome_trace(segments: List[Dict[str, Any]],
+                        path: str) -> None:
+    """Write the stitched trace as a standalone Chrome trace file
+    (reuses timeline.write_trace — same format `status --events
+    --export-trace` emits)."""
+    timeline.write_trace(path, to_chrome_trace(segments))

@@ -20,6 +20,14 @@ queue/prefill/decode bars next to the control-plane spans.
 
 Span bookkeeping is mutation-from-one-thread (the engine worker) plus
 read-from-any (stats()); the store's lock covers the handoff.
+
+Fleet telemetry (PR 11) turns these per-process spans into *trace
+segments*: every process exports its spans through `GET /spans` (the
+replica fronts) / `GET /lb/spans` (the load balancer), each segment
+tagged with process identity (`process`, `replica_id`, `role`) and the
+LB `attempt` number, so `sky serve trace <request-id>` can stitch one
+request's life across the disaggregated fleet
+(observability/traces.py does the assembly).
 """
 from __future__ import annotations
 
@@ -47,6 +55,26 @@ def new_request_id() -> str:
     return uuid.uuid4().hex[:16]
 
 
+def parse_span_query(query: str) -> Dict[str, Any]:
+    """`GET /spans` / `GET /lb/spans` query args -> export kwargs
+    (`since`, `request_id`, `limit`); malformed values are ignored,
+    not 400s — the trace CLI must degrade, never fail, on version
+    skew."""
+    from urllib.parse import parse_qs  # pylint: disable=import-outside-toplevel
+    parsed = parse_qs(query or '')
+    out: Dict[str, Any] = {}
+    if parsed.get('request_id'):
+        out['request_id'] = parsed['request_id'][0]
+    for key in ('since', 'limit'):
+        if parsed.get(key):
+            try:
+                value = float(parsed[key][0])
+                out[key] = int(value) if key == 'limit' else value
+            except ValueError:
+                pass
+    return out
+
+
 class RequestSpan:
     """Phase timings of one serving request (times are monotonic
     internally; wall-clock start is kept for the timeline)."""
@@ -67,6 +95,12 @@ class RequestSpan:
         self.routed_role: Optional[str] = None
         self.affinity_hit: Optional[bool] = None
         self.handoff_ms: Optional[float] = None
+        # LB retry attempt that produced this span (X-SkyTPU-Attempt).
+        # The router's one-shot same-role retry reuses the request id
+        # on a SECOND replica; without the attempt tag the two
+        # processes' spans conflate on assembly.  None = not LB-routed
+        # (reads as attempt 0).
+        self.attempt: Optional[int] = None
         # Multi-host slice replicas: mean coordinated-tick sync
         # overhead (rank-0 broadcast until every rank acked) while this
         # request was in flight.  None on single-host replicas.
@@ -145,7 +179,41 @@ class RequestSpan:
             out['handoff_ms'] = round(self.handoff_ms, 3)
         if self.slice_sync_ms is not None:
             out['slice_sync_ms'] = round(self.slice_sync_ms, 3)
+        if self.attempt is not None:
+            out['attempt'] = self.attempt
         return out
+
+    def segment(self, identity: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, Any]:
+        """This span as a trace segment: the cross-process exchange
+        format of `GET /spans` (see observability/traces.py).  The
+        phase sub-spans mirror `_emit_timeline`'s bars so the stitched
+        waterfall and the live timeline agree."""
+        seg: Dict[str, Any] = dict(identity or {})
+        seg.setdefault('process', 'replica')
+        seg.setdefault('name', 'engine')
+        seg.update(self.to_dict())
+        seg['attempt'] = self.attempt or 0
+        seg['start'] = self.submit_wall
+        seg['duration_ms'] = seg.pop('total_ms', None)
+        phases: List[Dict[str, Any]] = []
+        wall0 = self.submit_wall
+        if self.queue_wait_s:
+            phases.append({'name': 'queue', 'start': wall0,
+                           'duration_ms': round(
+                               self.queue_wait_s * 1e3, 3)})
+        if self.prefill_s:
+            phases.append({'name': 'prefill',
+                           'start': wall0 + (self.queue_wait_s or 0.0),
+                           'duration_ms': round(self.prefill_s * 1e3,
+                                                3)})
+        if self.ttft_s is not None and self.total_s is not None:
+            phases.append({'name': 'decode',
+                           'start': wall0 + self.ttft_s,
+                           'duration_ms': round(
+                               (self.total_s - self.ttft_s) * 1e3, 3)})
+        seg['phases'] = phases
+        return seg
 
     def _emit_timeline(self) -> None:
         if not timeline.enabled():
@@ -198,6 +266,66 @@ class SpanStore:
             spans = list(self._spans)[-n:]
         return [s.to_dict() for s in reversed(spans)]
 
+    def export(self, identity: Optional[Dict[str, Any]] = None,
+               since: Optional[float] = None,
+               request_id: Optional[str] = None,
+               limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Finished spans as identity-tagged trace segments (the
+        `GET /spans?since=&request_id=` payload), oldest first."""
+        with self._lock:
+            spans = list(self._spans)
+        out = []
+        for span in spans:
+            if since is not None and span.submit_wall < since:
+                continue
+            if request_id is not None and \
+                    span.request_id != request_id:
+                continue
+            out.append(span.segment(identity))
+        if limit is not None:
+            out = out[-int(limit):]
+        return out
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._spans)
+
+
+class SegmentStore:
+    """Bounded store of already-built trace segments (plain dicts).
+
+    The LB and the handoff endpoints record here: their work is not an
+    engine request (no RequestSpan exists), but it IS a leg of some
+    request's life — `/prefill_export` on the prefill replica, the
+    route/handoff/attempt phases on the LB.  Same export contract as
+    SpanStore so `sky serve trace` stitches both."""
+
+    def __init__(self, maxlen: int = DEFAULT_STORE_SIZE) -> None:
+        self._segments: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def add(self, segment: Dict[str, Any]) -> None:
+        with self._lock:
+            self._segments.append(segment)
+
+    def export(self, since: Optional[float] = None,
+               request_id: Optional[str] = None,
+               limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            segments = list(self._segments)
+        out = []
+        for seg in segments:
+            if since is not None and seg.get('start', 0.0) < since:
+                continue
+            if request_id is not None and \
+                    seg.get('request_id') != request_id:
+                continue
+            out.append(dict(seg))
+        if limit is not None:
+            out = out[-int(limit):]
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._segments)

@@ -56,7 +56,9 @@ def _route_meta(headers: Dict[str, str]) -> Optional[Dict[str, Any]]:
         ms = None
     return {'routed_role': role,
             'affinity_hit': affinity == 'hit' if affinity else None,
-            'handoff_ms': ms}
+            'handoff_ms': ms,
+            'attempt': model_server_lib._attempt_header(  # pylint: disable=protected-access
+                headers.get(router_lib.ATTEMPT_HEADER.lower()))}
 
 _MAX_BODY = 64 * 1024 * 1024
 _IDLE_TIMEOUT = 300.0
@@ -497,6 +499,7 @@ class AsyncModelServer:
                 if parsed is None:
                     break
                 method, path, headers, body = parsed
+                path, _, query = path.partition('?')
                 try:
                     if method == 'GET':
                         if path == '/metrics':
@@ -510,6 +513,13 @@ class AsyncModelServer:
                                  f'{metrics_lib.CONTENT_TYPE}\r\n'
                                  f'Content-Length: {len(text)}\r\n'
                                  f'\r\n').encode() + text)
+                        elif path == '/spans':
+                            # Trace-segment export for cross-process
+                            # assembly (sky serve trace).
+                            writer.write(_json_response(
+                                200, self.server.export_spans(
+                                    **model_server_lib.parse_span_query(
+                                        query))))
                         else:
                             code, payload = self._health()
                             writer.write(_json_response(code, payload))
@@ -526,8 +536,20 @@ class AsyncModelServer:
                             decoded = handoff_lib.decode_binary(body)
                         except handoff_lib.HandoffError as e:
                             raise _HttpError(400, str(e)) from e
-                        writer.write(_json_response(
-                            200, await self._kv_import(decoded)))
+                        t0, wall0 = time.perf_counter(), time.time()
+                        result = await self._kv_import(decoded)
+                        self.server.record_handoff_segment(
+                            'kv_import',
+                            headers.get(_REQUEST_ID_KEY) or
+                            tracing.new_request_id(), wall0,
+                            (time.perf_counter() - t0) * 1e3,
+                            attempt=model_server_lib._attempt_header(  # pylint: disable=protected-access
+                                headers.get(
+                                    router_lib.ATTEMPT_HEADER.lower())),
+                            imported_pages=result.get(
+                                'imported_pages'),
+                            cached_pages=result.get('cached_pages'))
+                        writer.write(_json_response(200, result))
                         await writer.drain()
                         continue
                     try:
@@ -599,8 +621,15 @@ class AsyncModelServer:
                         binary = (req.get('wire') == 'binary' or
                                   handoff_lib.CONTENT_TYPE_BINARY in
                                   (headers.get('accept') or ''))
+                        t0, wall0 = time.perf_counter(), time.time()
                         result = await self._prefill_export(
                             req, binary=binary)
+                        self.server.record_handoff_segment(
+                            'prefill_export', rid, wall0,
+                            (time.perf_counter() - t0) * 1e3,
+                            attempt=model_server_lib._attempt_header(  # pylint: disable=protected-access
+                                headers.get(
+                                    router_lib.ATTEMPT_HEADER.lower())))
                         if binary:
                             writer.write(
                                 (f'HTTP/1.1 200 OK\r\n'
@@ -617,8 +646,18 @@ class AsyncModelServer:
                             decoded = handoff_lib.decode_payload(req)
                         except handoff_lib.HandoffError as e:
                             raise _HttpError(400, str(e)) from e
-                        writer.write(_json_response(
-                            200, await self._kv_import(decoded)))
+                        t0, wall0 = time.perf_counter(), time.time()
+                        result = await self._kv_import(decoded)
+                        self.server.record_handoff_segment(
+                            'kv_import', rid, wall0,
+                            (time.perf_counter() - t0) * 1e3,
+                            attempt=model_server_lib._attempt_header(  # pylint: disable=protected-access
+                                headers.get(
+                                    router_lib.ATTEMPT_HEADER.lower())),
+                            imported_pages=result.get(
+                                'imported_pages'),
+                            cached_pages=result.get('cached_pages'))
+                        writer.write(_json_response(200, result))
                         await writer.drain()
                     else:
                         raise _HttpError(404, 'unknown path')

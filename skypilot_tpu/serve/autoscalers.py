@@ -52,6 +52,13 @@ class RequestRateAutoscaler:
         # from the replicas' /health engine stats); empty until the
         # controller's probe loop reports.
         self.replica_loads: List[float] = []
+        # Smoothed QPS from the controller's fleet aggregator
+        # (windowed rate of the LB route counter).  When present it
+        # replaces the raw timestamp count in the scaling rule: a
+        # one-scrape burst no longer whipsaws the target.  None until
+        # the aggregator has enough history — the instantaneous
+        # signal then applies unchanged.
+        self.windowed_qps: Optional[float] = None
 
     # ------------------------------------------------------------- inputs
 
@@ -76,6 +83,7 @@ class RequestRateAutoscaler:
         enough" and blue_green flips a 5-replica service onto a single
         replica (a capacity cliff under live load)."""
         self.request_timestamps = list(old.request_timestamps)
+        self.windowed_qps = old.windowed_qps
         self.target_num_replicas = max(
             self.min_replicas,
             min(old.target_num_replicas, self.max_replicas))
@@ -96,6 +104,19 @@ class RequestRateAutoscaler:
         self.replica_loads = [max(0.0, min(1.0, float(u)))
                               for u in loads]
 
+    def collect_windowed_signals(self, qps: Optional[float] = None,
+                                 loads: Optional[List[float]] = None
+                                 ) -> None:
+        """Adopt the fleet aggregator's smoothed signals (PR 11):
+        windowed per-role QPS and windowed per-replica loads.  None
+        for either leaves the corresponding instantaneous signal in
+        force — a cold or scrape-less controller behaves exactly as
+        before."""
+        self.windowed_qps = (None if qps is None
+                             else max(0.0, float(qps)))
+        if loads is not None:
+            self.collect_replica_load(loads)
+
     def _desired_from_load(self) -> int:
         """ceil(ready * mean_util / target_util), the slot-utilization
         analogue of the QPS rule; 0 when the signal is absent."""
@@ -113,7 +134,12 @@ class RequestRateAutoscaler:
             return self.target_num_replicas
         desired = self._desired_from_load()
         if self.target_qps_per_replica is not None:
-            qps = len(self.request_timestamps) / QPS_WINDOW_SIZE_SECONDS
+            # The aggregator's windowed rate when available (smoothed
+            # over the scrape history), else the raw timestamp count.
+            qps = (self.windowed_qps
+                   if self.windowed_qps is not None else
+                   len(self.request_timestamps) /
+                   QPS_WINDOW_SIZE_SECONDS)
             desired = max(desired,
                           math.ceil(qps / self.target_qps_per_replica))
         return max(self.min_replicas,

@@ -22,7 +22,9 @@ import requests
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.chaos import injector as chaos_injector
+from skypilot_tpu.observability import aggregator as aggregator_lib
 from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import slo as slo_lib
 from skypilot_tpu.serve import autoscalers
 from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import serve_state
@@ -86,6 +88,13 @@ class SkyServeController:
             for role in self.spec.role_specs
         }
         self.autoscaler = next(iter(self.autoscalers.values()))
+        # Fleet telemetry plane (PR 11): the controller scrapes every
+        # replica's /metrics + the LB's /lb/metrics into a bounded
+        # time-series store, feeds the autoscalers windowed signals,
+        # computes per-replica MFU, and evaluates the spec's SLOs.
+        self.aggregator = aggregator_lib.FleetAggregator(service_name)
+        self.slo_tracker = slo_lib.SLOTracker(
+            service_name, slo_lib.parse_slos(self.spec.slos))
         self.port = port
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -115,6 +124,13 @@ class SkyServeController:
                             controller.serving_urls(),
                         'ready_replicas':
                             controller.serving_replicas()})
+                elif self.path.split('?', 1)[0] == \
+                        '/controller/telemetry':
+                    # What `sky serve top` renders: per-role sparkline
+                    # series + windowed quantiles out of the
+                    # aggregator's ring buffers, SLO status, MFU, and
+                    # the slowest recent traces.
+                    self._json(200, controller.telemetry())
                 else:
                     self._json(404, {'error': 'unknown path'})
 
@@ -221,6 +237,11 @@ class SkyServeController:
                 scaler.carry_over(old)
         self.autoscalers = new_scalers
         self.autoscaler = next(iter(self.autoscalers.values()))
+        # SLO objectives may have changed with the spec; breach state
+        # resets with them (a new objective starts clean).  The
+        # telemetry store itself carries over — history survives.
+        self.slo_tracker = slo_lib.SLOTracker(
+            self.service_name, slo_lib.parse_slos(self.spec.slos))
         logger.info(f'service {self.service_name} updated to '
                     f'version {self.version}')
 
@@ -279,6 +300,11 @@ class SkyServeController:
             return
         self.reload_version()
         self.replica_manager.sync()
+        # Fleet telemetry scrape (interval-gated inside): replicas'
+        # /metrics + /spans, the LB's /lb/metrics -> the ring-buffer
+        # store the autoscalers, SLO tracker, and `sky serve top`
+        # read.  Best-effort: telemetry must never wedge reconcile.
+        self._scrape_fleet()
         replicas = self.replica_manager.active_replicas()
         current_version = [r for r in replicas
                            if r['version'] >= self.version]
@@ -289,6 +315,14 @@ class SkyServeController:
         for role, scaler in self.autoscalers.items():
             scaler.collect_replica_load(
                 self.replica_manager.ready_loads(role=role))
+            # Smoothed signals override the instantaneous ones when
+            # the aggregator has history (None = keep instantaneous).
+            try:
+                signals = self.aggregator.role_signals(role)
+                scaler.collect_windowed_signals(
+                    qps=signals['qps'], loads=signals['loads'])
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('windowed-signal computation failed')
             decision = scaler.evaluate_scaling(time.time())
             _M_ROLE_TARGET.labels(service=self.service_name,
                                   role=role).set(
@@ -341,8 +375,49 @@ class SkyServeController:
             autoscalers.QPS_WINDOW_SIZE_SECONDS)
         _M_READY_REPLICAS.labels(service=self.service_name).set(
             len(self.replica_manager.ready_urls()))
+        # SLO evaluation against the aggregated store; breaches
+        # journal slo_burn_start/_end and gauge skytpu_slo_breached.
+        if self.slo_tracker.slos:
+            try:
+                self.slo_tracker.evaluate(self.aggregator.store,
+                                          time.time())
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('SLO evaluation failed')
         self._replace_outdated()
         self._update_service_status()
+
+    # ------------------------------------------------- fleet telemetry
+
+    def _scrape_targets(self) -> List[Dict]:
+        """READY replicas (+ the LB) as aggregator scrape targets."""
+        targets: List[Dict] = [
+            {'url': info['url'], 'kind': 'replica',
+             'replica_id': info['replica_id'],
+             'role': info.get('role') or 'mixed',
+             'num_hosts': info.get('num_hosts') or 1}
+            for info in self.replica_manager.ready_infos()]
+        record = serve_state.get_service(self.service_name)
+        lb_port = (record or {}).get('load_balancer_port')
+        if lb_port:
+            targets.append({'url': f'http://127.0.0.1:{lb_port}',
+                            'kind': 'lb'})
+        return targets
+
+    def _scrape_fleet(self) -> None:
+        try:
+            self.aggregator.maybe_scrape(self._scrape_targets())
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('fleet telemetry scrape failed')
+
+    def telemetry(self) -> Dict:
+        """The `/controller/telemetry` payload (`sky serve top`)."""
+        return {
+            'service': self.service_name,
+            'version': self.version,
+            **self.aggregator.fleet_snapshot(
+                roles=sorted(self.autoscalers)),
+            'slos': self.slo_tracker.status(),
+        }
 
     def _update_service_status(self) -> None:
         ready = self.replica_manager.ready_urls()

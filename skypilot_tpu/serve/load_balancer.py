@@ -425,6 +425,10 @@ class SkyServeLoadBalancer:
         # Role/affinity routing for generation requests; non-routable
         # traffic keeps the flat policy above.
         self.router = router or router_lib.Router()
+        # LB-side trace segments (one per routed request: route /
+        # handoff / per-attempt phases), exported via GET /lb/spans
+        # for cross-process assembly (sky serve trace).
+        self.spans = tracing.SegmentStore()
         self.ready_urls: List[str] = []
         self.request_timestamps: List[float] = []
         # Per-role QPS samples (the controller autoscales each role
@@ -616,8 +620,10 @@ class SkyServeLoadBalancer:
             if path.startswith('/lb/'):
                 # LB control plane (never proxied): the controller's
                 # drain nudge and the LB's own metrics exposition.
+                query = (parts[1].split('?', 1)[1]
+                         if len(parts) > 1 and '?' in parts[1] else '')
                 await self._handle_control(writer, method, path,
-                                           reader, framing)
+                                           reader, framing, query)
                 return
             if (method == 'POST' and path in _ROUTABLE_PATHS and
                     framing[0] == 'length' and
@@ -680,14 +686,17 @@ class SkyServeLoadBalancer:
     async def _handle_control(self, writer: asyncio.StreamWriter,
                               method: str, path: str,
                               reader: asyncio.StreamReader,
-                              framing: Tuple[str, int]) -> None:
+                              framing: Tuple[str, int],
+                              query: str = '') -> None:
         """`/lb/*` endpoints served by the LB itself:
 
         POST /lb/retire {"url": ...} — the controller's drain nudge:
         stop routing to the replica NOW instead of at the next sync.
         GET /lb/metrics — this LB process's Prometheus exposition
         (sync age, retries, handoffs); `serve status --metrics` reads
-        the SYNC AGE column here."""
+        the SYNC AGE column here.
+        GET /lb/spans — this LB's trace segments (route / handoff /
+        per-attempt phases), for cross-process trace assembly."""
         body = b''
         if framing[0] == 'length' and framing[1] > 0:
             body = await asyncio.wait_for(
@@ -717,6 +726,14 @@ class SkyServeLoadBalancer:
                  f'Content-Type: {metrics_lib.CONTENT_TYPE}\r\n'
                  f'Content-Length: {len(text)}\r\n'
                  f'Connection: close\r\n\r\n').encode() + text)
+        elif method == 'GET' and path == '/lb/spans':
+            payload = json.dumps({'segments': self.spans.export(
+                **tracing.parse_span_query(query))}).encode()
+            writer.write(
+                (f'HTTP/1.1 200 OK\r\n'
+                 f'Content-Type: application/json\r\n'
+                 f'Content-Length: {len(payload)}\r\n'
+                 f'Connection: close\r\n\r\n').encode() + payload)
         else:
             writer.write(_simple_response(
                 404, 'Not Found', b'unknown LB control path'))
@@ -771,7 +788,14 @@ class SkyServeLoadBalancer:
                              body: bytes, t_start: float) -> None:
         """Route one buffered generation request: role dispatch +
         prefix affinity + (for prefill-heavy prompts) KV handoff, with
-        one bounded same-role retry on upstream 429 backpressure."""
+        one bounded same-role retry on upstream 429 backpressure.
+
+        The whole life of the request on this LB is recorded as one
+        trace segment (route / handoff / per-attempt phases) in
+        self.spans, and each upstream try is stamped with
+        X-SkyTPU-Attempt so the replicas' spans stay distinct when a
+        retry reuses the request id."""
+        wall_start = time.time()
         _, ids, key, prompt_len = self._parse_prompt(body)
         decision = self.router.route(key, prompt_len)
         if decision.url is None:
@@ -790,13 +814,34 @@ class SkyServeLoadBalancer:
         rid = next((v for n, v in headers
                     if n.lower() == _REQUEST_ID_KEY), None) or \
             tracing.new_request_id()
+        seg: Dict[str, Any] = {
+            'request_id': rid, 'process': 'lb', 'name': 'lb',
+            'attempt': 0, 'start': wall_start,
+            'role': decision.role, 'affinity': decision.affinity,
+            'phases': [{
+                'name': 'route', 'start': wall_start,
+                'duration_ms': round(
+                    (time.perf_counter() - t_start) * 1e3, 3),
+                'target': decision.url,
+            }],
+        }
         _journal_handoff('lb_route', request_id=rid, url=decision.url,
                          role=decision.role,
                          affinity=decision.affinity,
                          handoff=bool(decision.handoff_source))
         handoff_ms: Optional[float] = None
         if decision.handoff_source and ids is not None:
+            handoff_wall = time.time()
             handoff_ms = await self._do_handoff(decision, ids, rid)
+            seg['phases'].append({
+                'name': 'handoff', 'start': handoff_wall,
+                'duration_ms': round(
+                    handoff_ms if handoff_ms is not None else
+                    (time.time() - handoff_wall) * 1e3, 3),
+                'target': decision.handoff_source,
+                'status': 'ok' if handoff_ms is not None
+                          else 'fallback',
+            })
         extra = {
             tracing.REQUEST_ID_HEADER: rid,
             router_lib.ROUTED_ROLE_HEADER: decision.role,
@@ -814,92 +859,139 @@ class SkyServeLoadBalancer:
         target: Optional[str] = decision.url
         tried: List[str] = []
         delay = 0.0
-        for attempt in (0, 1):
-            if delay > 0:
-                # Retry-After honored, but bounded: the client owns
-                # longer backoffs, not an idle LB connection.
-                await asyncio.sleep(delay)
-            next_target: Optional[str] = None
-            delay = 0.0
-            self.policy.acquire(target)
-            self.router.acquire(target)
-            inflight = _M_UPSTREAM_INFLIGHT.labels(upstream=target)
-            inflight.inc()
-            try:
-                tried.append(target)
+        recorded = False
+        try:
+            for attempt in (0, 1):
+                if delay > 0:
+                    # Retry-After honored, but bounded: the client owns
+                    # longer backoffs, not an idle LB connection.
+                    await asyncio.sleep(delay)
+                next_target: Optional[str] = None
+                delay = 0.0
+                self.policy.acquire(target)
+                self.router.acquire(target)
+                inflight = _M_UPSTREAM_INFLIGHT.labels(upstream=target)
+                inflight.inc()
+                # Which delivery attempt this is, end to end: the
+                # replica stamps it into its span (distinct segments
+                # when a retry reuses the request id).
+                extra[router_lib.ATTEMPT_HEADER] = str(attempt)
+                attempt_phase = {'name': f'attempt-{attempt}',
+                                 'start': time.time(),
+                                 'target': target}
+                seg['phases'].append(attempt_phase)
+                seg['attempt'] = attempt
+                attempt_t0 = time.perf_counter()
+
+                def _close_attempt(status: Any) -> None:
+                    attempt_phase['status'] = status
+                    attempt_phase['duration_ms'] = round(
+                        (time.perf_counter() - attempt_t0) * 1e3, 3)
+
                 try:
-                    status, retry_after, resp_head, ureader, uwriter = \
-                        await self._forward_buffered(
-                            target, start_line, headers, body, extra)
-                except _UpstreamError:
-                    alternates = self.router.alternates(
-                        target, exclude=tried)
-                    if attempt == 1 or not alternates:
-                        raise
-                    # Dead/dropped replica but a replayable body: one
-                    # same-role failover beats a 502.
-                    _M_RETRIES.labels(reason='upstream_error').inc()
-                    next_target = alternates[0]
-                else:
+                    tried.append(target)
                     try:
-                        if status == 429 and attempt == 0:
-                            alternates = self.router.alternates(
-                                target, exclude=tried)
-                            if alternates:
-                                # Backpressure (pages_exhausted /
-                                # queue_full): one bounded retry on a
-                                # same-role sibling beats relaying the
-                                # 429 to a client that would retry
-                                # through us anyway.
-                                reason = (
-                                    'pages_exhausted'
-                                    if b'page' in resp_head.lower()
-                                    else 'queue_full')
-                                _M_RETRIES.labels(reason=reason).inc()
-                                next_target = alternates[0]
-                                delay = min(retry_after,
-                                            _retry_max_delay())
-                        elif status >= 500 and attempt == 0:
-                            # Replica-side failure (engine failed —
-                            # e.g. a slice replica losing a rank mid-
-                            # decode — or queue TTL expiry): the body
-                            # is replayable and nothing was relayed,
-                            # so one same-role sibling retry turns a
-                            # dead replica's 5xx into a served
-                            # request.  The controller retires the
-                            # failed replica on its next probe; until
-                            # then this is what "zero lost requests
-                            # while the slice rebuilds" means.
-                            alternates = self.router.alternates(
-                                target, exclude=tried)
-                            if alternates:
-                                _M_RETRIES.labels(
-                                    reason='replica_error').inc()
-                                next_target = alternates[0]
-                        if next_target is None:
-                            # Relay (any status): head then stream.
-                            cwriter.write(resp_head)
-                            await asyncio.wait_for(
-                                cwriter.drain(),
-                                timeout=_UPSTREAM_IDLE_TIMEOUT)
-                            await _relay_until_eof(ureader, cwriter)
-                            if status == 200:
-                                self.router.record_affinity(key,
-                                                            target)
-                            _M_PROXY_LATENCY.observe(
-                                time.perf_counter() - t_start)
-                            return
-                    finally:
+                        status, retry_after, resp_head, ureader, \
+                            uwriter = await self._forward_buffered(
+                                target, start_line, headers, body,
+                                extra)
+                    except _UpstreamError:
+                        _close_attempt('upstream_error')
+                        alternates = self.router.alternates(
+                            target, exclude=tried)
+                        if attempt == 1 or not alternates:
+                            seg['status'] = 'upstream_error'
+                            raise
+                        # Dead/dropped replica but a replayable body:
+                        # one same-role failover beats a 502.
+                        _M_RETRIES.labels(reason='upstream_error').inc()
+                        next_target = alternates[0]
+                    else:
                         try:
-                            uwriter.close()
-                            await uwriter.wait_closed()
-                        except (ConnectionError, OSError):
-                            pass
-            finally:
-                inflight.dec()
-                self.router.release(target)
-                self.policy.release(target)
-            target = next_target
+                            if status == 429 and attempt == 0:
+                                alternates = self.router.alternates(
+                                    target, exclude=tried)
+                                if alternates:
+                                    # Backpressure (pages_exhausted /
+                                    # queue_full): one bounded retry
+                                    # on a same-role sibling beats
+                                    # relaying the 429 to a client
+                                    # that would retry through us
+                                    # anyway.
+                                    reason = (
+                                        'pages_exhausted'
+                                        if b'page' in resp_head.lower()
+                                        else 'queue_full')
+                                    _M_RETRIES.labels(
+                                        reason=reason).inc()
+                                    next_target = alternates[0]
+                                    delay = min(retry_after,
+                                                _retry_max_delay())
+                            elif status >= 500 and attempt == 0:
+                                # Replica-side failure (engine failed
+                                # — e.g. a slice replica losing a rank
+                                # mid-decode — or queue TTL expiry):
+                                # the body is replayable and nothing
+                                # was relayed, so one same-role
+                                # sibling retry turns a dead replica's
+                                # 5xx into a served request.  The
+                                # controller retires the failed
+                                # replica on its next probe; until
+                                # then this is what "zero lost
+                                # requests while the slice rebuilds"
+                                # means.
+                                alternates = self.router.alternates(
+                                    target, exclude=tried)
+                                if alternates:
+                                    _M_RETRIES.labels(
+                                        reason='replica_error').inc()
+                                    next_target = alternates[0]
+                            if next_target is None:
+                                # Relay (any status): head then
+                                # stream.  Record the segment NOW (the
+                                # outcome is known) — a long token
+                                # stream must not keep this request
+                                # invisible to `sky serve trace` until
+                                # the relay ends; the finally block
+                                # refreshes the final duration on the
+                                # same dict.
+                                _close_attempt(status)
+                                seg['status'] = status
+                                seg['duration_ms'] = round(
+                                    (time.perf_counter() - t_start) *
+                                    1e3, 3)
+                                self.spans.add(seg)
+                                recorded = True
+                                cwriter.write(resp_head)
+                                await asyncio.wait_for(
+                                    cwriter.drain(),
+                                    timeout=_UPSTREAM_IDLE_TIMEOUT)
+                                await _relay_until_eof(ureader, cwriter)
+                                if status == 200:
+                                    self.router.record_affinity(key,
+                                                                target)
+                                _M_PROXY_LATENCY.observe(
+                                    time.perf_counter() - t_start)
+                                _close_attempt(status)
+                                return
+                            _close_attempt(status)
+                        finally:
+                            try:
+                                uwriter.close()
+                                await uwriter.wait_closed()
+                            except (ConnectionError, OSError):
+                                pass
+                finally:
+                    inflight.dec()
+                    self.router.release(target)
+                    self.policy.release(target)
+                target = next_target
+        finally:
+            seg['duration_ms'] = round(
+                (time.perf_counter() - t_start) * 1e3, 3)
+            seg.setdefault('status', 'error')
+            if not recorded:
+                self.spans.add(seg)
 
     async def _forward_buffered(self, target: str, start_line: str,
                                 headers: List[Tuple[str, str]],
@@ -970,8 +1062,9 @@ class SkyServeLoadBalancer:
 
     async def _http_request(self, target: str, path: str, body: bytes,
                             content_type: str, timeout: float,
-                            accept: Optional[str] = None
-                            ) -> Tuple[int, str, bytes]:
+                            accept: Optional[str] = None,
+                            extra_headers: Optional[Dict[str, str]]
+                            = None) -> Tuple[int, str, bytes]:
         """One bounded POST to a replica (the handoff legs); returns
         (status, response content-type, raw response body)."""
         split = urlsplit(target)
@@ -982,10 +1075,13 @@ class SkyServeLoadBalancer:
             timeout=_UPSTREAM_CONNECT_TIMEOUT)
         try:
             accept_line = f'Accept: {accept}\r\n' if accept else ''
+            extra_lines = ''.join(
+                f'{k}: {v}\r\n'
+                for k, v in (extra_headers or {}).items())
             writer.write((f'POST {path} HTTP/1.1\r\n'
                           f'Host: {host}:{port}\r\n'
                           f'Content-Type: {content_type}\r\n'
-                          f'{accept_line}'
+                          f'{accept_line}{extra_lines}'
                           f'Content-Length: {len(body)}\r\n'
                           f'Connection: close\r\n\r\n').encode() + body)
             await asyncio.wait_for(writer.drain(), timeout=timeout)
@@ -1017,12 +1113,14 @@ class SkyServeLoadBalancer:
 
     async def _json_request(self, target: str, path: str,
                             payload: Dict[str, Any],
-                            timeout: float) -> Tuple[int, Any]:
+                            timeout: float,
+                            extra_headers: Optional[Dict[str, str]]
+                            = None) -> Tuple[int, Any]:
         """One bounded JSON POST to a replica (the handoff legs);
         returns (status, parsed body or None)."""
         status, _, raw = await self._http_request(
             target, path, json.dumps(payload).encode(),
-            'application/json', timeout)
+            'application/json', timeout, extra_headers=extra_headers)
         try:
             return status, json.loads(raw or b'null')
         except json.JSONDecodeError:
@@ -1049,6 +1147,9 @@ class SkyServeLoadBalancer:
                          target=decision.url)
         wire = 'binary' if _handoff_binary() else 'json'
         wire_bytes = 0
+        # The request id rides the handoff legs so the prefill
+        # replica's export segment joins this request's trace.
+        rid_header = {tracing.REQUEST_ID_HEADER: rid}
         try:
             export_req: Dict[str, Any] = {'prompt_ids': prompt_ids}
             if decision.page_size:
@@ -1060,7 +1161,8 @@ class SkyServeLoadBalancer:
                     decision.handoff_source, '/prefill_export',
                     json.dumps(export_req).encode(),
                     'application/json', timeout,
-                    accept=handoff_lib.CONTENT_TYPE_BINARY)
+                    accept=handoff_lib.CONTENT_TYPE_BINARY,
+                    extra_headers=rid_header)
                 if status != 200:
                     raise _UpstreamError(f'prefill_export -> {status}')
                 if handoff_lib.CONTENT_TYPE_BINARY not in ctype:
@@ -1083,7 +1185,7 @@ class SkyServeLoadBalancer:
                                 else 'application/json')
                 status, _, _ = await self._http_request(
                     decision.url, '/kv_import', raw, import_ctype,
-                    timeout)
+                    timeout, extra_headers=rid_header)
                 if wire == 'binary' and status in (400, 404, 415):
                     # Old decode replica: one JSON retry of the SAME
                     # pages before giving up on the handoff.
@@ -1092,25 +1194,28 @@ class SkyServeLoadBalancer:
                     export_req.pop('wire', None)
                     status, payload = await self._json_request(
                         decision.handoff_source, '/prefill_export',
-                        export_req, timeout)
+                        export_req, timeout,
+                        extra_headers=rid_header)
                     if status != 200 or not isinstance(payload, dict):
                         raise _UpstreamError(
                             f'prefill_export (json retry) -> {status}')
                     raw = json.dumps(payload).encode()
                     wire_bytes = len(raw)
                     status, _ = await self._json_request(
-                        decision.url, '/kv_import', payload, timeout)
+                        decision.url, '/kv_import', payload, timeout,
+                        extra_headers=rid_header)
                 if status != 200:
                     raise _UpstreamError(f'kv_import -> {status}')
             else:
                 status, payload = await self._json_request(
                     decision.handoff_source, '/prefill_export',
-                    export_req, timeout)
+                    export_req, timeout, extra_headers=rid_header)
                 if status != 200 or not isinstance(payload, dict):
                     raise _UpstreamError(f'prefill_export -> {status}')
                 wire_bytes = len(json.dumps(payload).encode())
                 status, _ = await self._json_request(
-                    decision.url, '/kv_import', payload, timeout)
+                    decision.url, '/kv_import', payload, timeout,
+                    extra_headers=rid_header)
                 if status != 200:
                     raise _UpstreamError(f'kv_import -> {status}')
         except (_UpstreamError, OSError, ConnectionError,

@@ -72,6 +72,21 @@ _M_DRAIN_REJECTED = metrics_lib.counter(
     'skytpu_serve_drain_rejected_total',
     'Generation requests answered 503 because the replica is '
     'draining (the LB retries them on a sibling).')
+# Process identity marker: always 1; its labels (via the registry's
+# constant labels when SKYTPU_SERVE_REPLICA_ID is set) name this
+# replica, so scrapers can join any series to the replica it came
+# from even without target labels.
+_M_PROCESS_INFO = metrics_lib.gauge(
+    'skytpu_process_info',
+    'Constant 1 carrying this process\'s identity labels '
+    '(replica_id / role / num_hosts on serving replicas).')
+# Forward-pass FLOPs per generated token (~2 x params): the fleet
+# aggregator multiplies this by decode tokens/s and divides by the
+# chip roofline for the per-replica skytpu_mfu_estimate gauge.
+_M_FLOPS_PER_TOKEN = metrics_lib.gauge(
+    'skytpu_engine_model_flops_per_token',
+    'Approximate forward FLOPs per generated token (2 x parameter '
+    'count) of the model this replica serves.')
 
 
 class ClientDisconnected(RuntimeError):
@@ -90,6 +105,22 @@ def default_deadline_ms() -> Optional[float]:
     except ValueError:
         return None
     return ms if ms > 0 else None
+
+
+def _attempt_header(raw: Optional[str]) -> Optional[int]:
+    """Parse the LB's X-SkyTPU-Attempt header value (None when absent
+    or malformed — spans then read as attempt 0)."""
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+# `GET /spans` query parsing lives with the span stores; both HTTP
+# fronts and the LB control plane share it.
+parse_span_query = tracing.parse_span_query
 
 
 def _maybe_journal_request(event: str, **fields) -> None:
@@ -137,7 +168,8 @@ class ModelServer:
                  num_hosts: int = 1,
                  sp_threshold: Optional[int] = None,
                  slice_sequence: Optional[int] = None,
-                 slice_tensor: Optional[int] = None) -> None:
+                 slice_tensor: Optional[int] = None,
+                 replica_id: Optional[int] = None) -> None:
         import jax
         import flax.linen as nn
 
@@ -214,6 +246,31 @@ class ModelServer:
         # retirement path), new generation work is refused with 503 +
         # Retry-After while in-flight decodes run to completion.
         self.draining = False
+        # Process identity for fleet telemetry: which replica this is.
+        # Explicit kwarg (tests run several servers per process), else
+        # the controller-set env var (real replica processes).
+        env_rid = os.environ.get('SKYTPU_SERVE_REPLICA_ID')
+        if replica_id is not None:
+            self.replica_id: Optional[int] = int(replica_id)
+        elif env_rid and env_rid.isdigit():
+            self.replica_id = int(env_rid)
+        else:
+            self.replica_id = None
+        if env_rid and env_rid.isdigit():
+            # Constant identity labels on EVERY exposed series: the
+            # controller's aggregator keys its time-series store by
+            # the full label set, so replicas must not expose
+            # indistinguishable series.  Env-gated: only a real
+            # replica process (one server per process) owns the
+            # process-global registry's identity.
+            metrics_lib.REGISTRY.set_const_labels({
+                'replica_id': env_rid, 'role': role,
+                'num_hosts': int(num_hosts)})
+        _M_PROCESS_INFO.set(1)
+        # Trace segments for non-engine legs of a request's life (the
+        # /prefill_export and /kv_import handoff endpoints record
+        # here); exported with the engine spans via GET /spans.
+        self.trace_segments = tracing.SegmentStore()
         model_mod = Transformer(self.cfg)
         init_tokens = jax.numpy.zeros((1, 8), jax.numpy.int32)
         key = jax.random.PRNGKey(seed)
@@ -312,6 +369,14 @@ class ModelServer:
                 f'{report["quantized_bytes"] / 1e6:.1f} MB '
                 f'({report["ratio"]:.2f}x of f32)')
         self.params = params
+        # Serving roofline input: forward FLOPs per generated token
+        # ~= 2 x params (decode is one forward pass per token).  The
+        # controller's aggregator turns this + decode tokens/s into
+        # the per-replica skytpu_mfu_estimate gauge.
+        n_params = sum(int(p.size)
+                       for p in jax.tree_util.tree_leaves(params))
+        self.flops_per_token = 2.0 * n_params
+        _M_FLOPS_PER_TOKEN.set(self.flops_per_token)
         # One generation at a time: KV caches are sized per call and
         # the chip is exclusive anyway; the HTTP layer queues.
         self._lock = threading.Lock()
@@ -365,6 +430,44 @@ class ModelServer:
         stats = engine.stats()
         return (int(stats.get('busy_slots', 0)) +
                 int(stats.get('queued_requests', 0)))
+
+    def identity(self) -> Dict[str, Any]:
+        """Trace-segment identity tags for this replica's exports."""
+        return {'process': 'replica', 'replica_id': self.replica_id,
+                'role': self.role, 'num_hosts': self.num_hosts}
+
+    def export_spans(self, since: Optional[float] = None,
+                     request_id: Optional[str] = None,
+                     limit: Optional[int] = None) -> Dict[str, Any]:
+        """The `GET /spans` payload: engine request spans + the
+        handoff-endpoint segments, identity-tagged, oldest first."""
+        segments = self.trace_segments.export(
+            since=since, request_id=request_id)
+        engine = self._engine
+        if engine is not None:
+            segments.extend(engine._spans.export(  # pylint: disable=protected-access
+                self.identity(), since=since, request_id=request_id))
+        segments.sort(key=lambda s: s.get('start') or 0.0)
+        if limit is not None:
+            segments = segments[-int(limit):]
+        return {'segments': segments}
+
+    def record_handoff_segment(self, name: str, request_id: str,
+                               start: float, duration_ms: float,
+                               attempt: Optional[int] = None,
+                               **fields: Any) -> None:
+        """One non-engine leg of a request's life (the prefill
+        replica's /prefill_export, the decode replica's /kv_import)
+        as a trace segment — without this, `sky serve trace` of a
+        disaggregated request would miss the prefill replica
+        entirely (exports never create an engine span)."""
+        seg = self.identity()
+        seg.update({'name': name, 'request_id': request_id,
+                    'start': start,
+                    'duration_ms': round(duration_ms, 3),
+                    'attempt': int(attempt or 0), 'phases': []})
+        seg.update(fields)
+        self.trace_segments.add(seg)
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, top_k: int = 0,
@@ -575,10 +678,13 @@ def _make_handler(server: ModelServer):
             return {'routed_role': role,
                     'affinity_hit': (affinity == 'hit'
                                      if affinity else None),
-                    'handoff_ms': ms}
+                    'handoff_ms': ms,
+                    'attempt': _attempt_header(
+                        self.headers.get(router_lib.ATTEMPT_HEADER))}
 
         def do_GET(self):
-            if self.path == '/metrics':
+            path, _, query = self.path.partition('?')
+            if path == '/metrics':
                 engine = server._engine  # pylint: disable=protected-access
                 if engine is not None:
                     engine.stats()  # freshen the scrape-time gauges
@@ -589,6 +695,13 @@ def _make_handler(server: ModelServer):
                 self.send_header('Content-Length', str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+                return
+            if path == '/spans':
+                # Trace-segment export: this replica's leg of each
+                # request's life, for cross-process assembly
+                # (sky serve trace / the controller aggregator).
+                self._reply(200, server.export_spans(
+                    **parse_span_query(query)))
                 return
             payload = {'status': 'ok',
                        'model': f'{server.cfg.d_model}x'
@@ -829,9 +942,16 @@ def _make_handler(server: ModelServer):
                 binary = (req.get('wire') == 'binary' or
                           handoff_lib.CONTENT_TYPE_BINARY in
                           (self.headers.get('Accept') or ''))
+                t0, wall0 = time.perf_counter(), time.time()
                 payload = engine.export_prefill(
                     [int(t) for t in prompt],
                     page_size=req.get('page_size'), binary=binary)
+                server.record_handoff_segment(
+                    'prefill_export', self._request_id(), wall0,
+                    (time.perf_counter() - t0) * 1e3,
+                    attempt=_attempt_header(self.headers.get(
+                        router_lib.ATTEMPT_HEADER)),
+                    tokens=len(prompt))
                 if binary:
                     self.send_response(200)
                     self.send_header('Content-Type',
@@ -874,11 +994,18 @@ def _make_handler(server: ModelServer):
                 else:
                     decoded = handoff_lib.decode_payload(
                         self._read_json())
+                t0, wall0 = time.perf_counter(), time.time()
                 imported, cached = engine.import_pages(
                     decoded['hashes'], decoded['page_size'],
                     decoded['k'], decoded['v'],
                     k_scale=decoded.get('k_scale'),
                     v_scale=decoded.get('v_scale'))
+                server.record_handoff_segment(
+                    'kv_import', self._request_id(), wall0,
+                    (time.perf_counter() - t0) * 1e3,
+                    attempt=_attempt_header(self.headers.get(
+                        router_lib.ATTEMPT_HEADER)),
+                    imported_pages=imported, cached_pages=cached)
                 self._reply(200, {'imported_pages': imported,
                                   'cached_pages': cached})
             except handoff_lib.HandoffRejected as e:

@@ -45,6 +45,11 @@ DEFAULT_ROLE = 'mixed'
 ROUTED_ROLE_HEADER = 'X-SkyTPU-Routed-Role'
 AFFINITY_HEADER = 'X-SkyTPU-Affinity'
 HANDOFF_MS_HEADER = 'X-SkyTPU-Handoff-Ms'
+# Which LB delivery attempt this is (0 = first try, 1 = the one-shot
+# same-role retry).  The retry reuses the request id on a SECOND
+# replica; the attempt tag keeps the two processes' span segments
+# distinct when `sky serve trace` stitches them.
+ATTEMPT_HEADER = 'X-SkyTPU-Attempt'
 # Per-request time budget in milliseconds; propagated LB -> server ->
 # engine slot.  Past it, the request is reaped and its KV pages freed
 # (HTTP 504) instead of decoding to a client that stopped waiting.

@@ -106,6 +106,10 @@ class Request:
             self.span.routed_role = route_meta.get('routed_role')
             self.span.affinity_hit = route_meta.get('affinity_hit')
             self.span.handoff_ms = route_meta.get('handoff_ms')
+            # X-SkyTPU-Attempt: disambiguates this span from the
+            # other replica's when the LB's one-shot retry reused the
+            # request id (trace assembly shows both legs).
+            self.span.attempt = route_meta.get('attempt')
         # stop_token: None, a single id, or any iterable of ids (the
         # tokenizer's multi-EOS stop set — instruct checkpoints stop at
         # chat turn-end markers, not just the model-level EOS).

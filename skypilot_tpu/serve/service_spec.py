@@ -98,8 +98,8 @@ class SkyServiceSpec:
                  base_ondemand_fallback_replicas: int = 0,
                  load_balancing_policy: Optional[str] = None,
                  update_mode: str = 'rolling',
-                 roles: Optional[Dict[str, Dict[str, Any]]] = None
-                 ) -> None:
+                 roles: Optional[Dict[str, Dict[str, Any]]] = None,
+                 slos: Optional[Dict[str, Any]] = None) -> None:
         if not readiness_path.startswith('/'):
             raise exceptions.InvalidTaskError(
                 f'readiness path must start with /, got {readiness_path!r}')
@@ -142,6 +142,40 @@ class SkyServiceSpec:
                 f'update_mode must be rolling or blue_green, '
                 f'got {update_mode!r}')
         self.update_mode = update_mode
+        # Service-level objectives (`slos:`), evaluated by the
+        # controller multi-window/multi-burn-rate against the fleet
+        # telemetry store (observability/slo.py); breaches journal
+        # slo_burn_start/_end and show in `sky serve top`.
+        self.slos: Optional[Dict[str, float]] = None
+        if slos is not None:
+            if not isinstance(slos, dict) or not slos:
+                raise exceptions.InvalidTaskError(
+                    'slos must map objective name -> target')
+            common_utils.validate_schema_keys(
+                slos, {'ttft_p99_ms', 'itl_p99_ms', 'error_rate',
+                       'availability'}, 'slos')
+            parsed: Dict[str, float] = {}
+            for slo_key, value in slos.items():
+                try:
+                    parsed[str(slo_key)] = float(value)
+                except (TypeError, ValueError):
+                    raise exceptions.InvalidTaskError(
+                        f'slos.{slo_key} must be a number, '
+                        f'got {value!r}')  # pylint: disable=raise-missing-from
+            for latency_key in ('ttft_p99_ms', 'itl_p99_ms'):
+                if latency_key in parsed and parsed[latency_key] <= 0:
+                    raise exceptions.InvalidTaskError(
+                        f'slos.{latency_key} must be positive')
+            for frac_key in ('error_rate',):
+                if frac_key in parsed and \
+                        not 0.0 < parsed[frac_key] < 1.0:
+                    raise exceptions.InvalidTaskError(
+                        f'slos.{frac_key} must be in (0, 1)')
+            if 'availability' in parsed and \
+                    not 0.0 < parsed['availability'] < 1.0:
+                raise exceptions.InvalidTaskError(
+                    'slos.availability must be in (0, 1)')
+            self.slos = parsed
         # Disaggregated role pools.  Explicit `roles:` builds one pool
         # per entry; otherwise the legacy top-level fields ARE the
         # single 'mixed' pool (so every consumer can just iterate
@@ -210,7 +244,7 @@ class SkyServiceSpec:
         common_utils.validate_schema_keys(
             config, {'readiness_probe', 'replica_policy', 'replicas',
                      'replica_port', 'load_balancing_policy',
-                     'update_mode', 'roles'}, 'service')
+                     'update_mode', 'roles', 'slos'}, 'service')
         kwargs: Dict[str, Any] = {}
         probe = config.get('readiness_probe')
         if isinstance(probe, str):
@@ -262,6 +296,8 @@ class SkyServiceSpec:
             kwargs['update_mode'] = str(config['update_mode'])
         if config.get('roles') is not None:
             kwargs['roles'] = config['roles']
+        if config.get('slos') is not None:
+            kwargs['slos'] = config['slos']
         return cls(**kwargs)
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -313,6 +349,8 @@ class SkyServiceSpec:
                     entry['num_hosts'] = pool.num_hosts
                 roles[role] = entry
             config['roles'] = roles
+        if self.slos is not None:
+            config['slos'] = dict(self.slos)
         return config
 
     def __repr__(self) -> str:

@@ -1,0 +1,496 @@
+"""Fleet telemetry plane tests (ISSUE 11 tentpole).
+
+The controller-side time-series store (ring buffers, windowed rates,
+histogram-delta quantiles), the fleet aggregator's scrape + derived
+signals (smoothed autoscaler inputs, MFU), multi-window multi-burn-rate
+SLO tracking with journaled breach transitions, the spec's `slos:`
+block, and the `/controller/telemetry` endpoint `sky serve top` reads.
+"""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu.observability import aggregator as aggregator_lib
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import slo as slo_lib
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+
+@pytest.fixture(autouse=True)
+def _serve_env(monkeypatch, _isolated_home):
+    monkeypatch.setenv('SKYTPU_SERVE_DB',
+                       str(_isolated_home / 'serve.db'))
+    global_user_state.set_enabled_clouds(['local'])
+    yield
+
+
+def _store(**kw) -> aggregator_lib.TimeSeriesStore:
+    kw.setdefault('retention', 600)
+    kw.setdefault('samples', 128)
+    return aggregator_lib.TimeSeriesStore(**kw)
+
+
+class TestTimeSeriesStore:
+
+    def test_ring_buffer_and_retention_bounds(self):
+        store = _store(retention=10, samples=4)
+        now = time.time()
+        for i in range(8):
+            store.add('g', {'replica_id': '1'}, now - 8 + i, i)
+        [(labels, samples)] = store.series('g')
+        assert labels == {'replica_id': '1'}
+        assert len(samples) == 4                     # maxlen wins
+        store.add('g', {'replica_id': '1'}, now + 100, 99)
+        [(_, samples)] = store.series('g')
+        assert [v for _, v in samples] == [99]       # retention wins
+        store.prune(now + 10000)
+        assert store.series('g') == []               # dry series drop
+
+    def test_label_sets_never_collapse(self):
+        store = _store()
+        now = time.time()
+        store.add('g', {'replica_id': '1'}, now, 1)
+        store.add('g', {'replica_id': '2'}, now, 2)
+        assert len(store.series('g')) == 2
+        assert store.latest('g', replica_id='2') == [
+            ({'replica_id': '2'}, 2.0)]
+
+    def test_counter_rate_and_reset_tolerance(self):
+        store = _store()
+        now = time.time()
+        for t, v in ((50, 0), (40, 10), (30, 20)):
+            store.add('c', {}, now - t, v)
+        rate = store.counter_rate('c', 60, now)
+        assert rate == pytest.approx(1.0)            # 20 over 20s
+        # Counter reset (replica restart): post-reset value counts.
+        store.add('c', {}, now - 20, 5)
+        rate = store.counter_rate('c', 60, now)
+        assert rate == pytest.approx(25 / 30)
+        assert store.counter_rate('c', 60, now, role='x') is None
+
+    def test_windowed_histogram_quantile(self):
+        store = _store()
+        now = time.time()
+        # Two scrapes of a cumulative histogram: the window's delta is
+        # 20 <=0.1, +20 in (0.1, 0.5], nothing beyond.
+        for t, mult in ((now - 50, 1), (now - 1, 3)):
+            for le, cum in (('0.1', 10), ('0.5', 20), ('+Inf', 20)):
+                store.add('h_bucket', {'le': le}, t, cum * mult)
+        assert store.quantile('h', 0.5, 60, now) == \
+            pytest.approx(0.1)
+        assert store.quantile('h', 0.75, 60, now) == \
+            pytest.approx(0.3)   # interpolated inside (0.1, 0.5]
+        assert store.quantile('h', 0.99, 60, now, role='x') is None
+
+    def test_binned_sparkline_series(self):
+        store = _store()
+        now = time.time()
+        for t, v in ((55, 0), (35, 20), (15, 40)):
+            store.add('c', {}, now - t, v)
+        rates = store.binned('c', 60, 6, now, mode='rate')
+        assert len(rates) == 6
+        assert rates[-1] is None                 # nothing in last 10s
+        assert any(r and r > 0 for r in rates)
+        store.add('g', {}, now - 5, 3.0)
+        means = store.binned('g', 60, 6, now)
+        assert means[-1] == pytest.approx(3.0)
+        assert means[0] is None
+
+
+class TestAggregatorScrape:
+
+    def test_scrape_ingests_with_target_labels_and_mfu(self):
+        registry = metrics_lib.Registry()
+        registry.gauge('skytpu_engine_decode_tokens_per_s',
+                       'tok/s').set(100.0)
+        registry.gauge('skytpu_engine_model_flops_per_token',
+                       'flops').set(2e9)
+        registry.gauge('unrelated_series', 'ignored').set(1.0)
+        port, shutdown = metrics_lib.start_exposition_server(
+            registry=registry)
+        try:
+            agg = aggregator_lib.FleetAggregator('svc', _store())
+            agg.scrape_fleet([{'url': f'http://127.0.0.1:{port}',
+                               'kind': 'replica', 'replica_id': 7,
+                               'role': 'decode', 'num_hosts': 1}])
+        finally:
+            shutdown()
+        [(labels, value)] = agg.store.latest(
+            'skytpu_engine_decode_tokens_per_s')
+        assert labels['replica_id'] == '7'
+        assert labels['role'] == 'decode'
+        assert value == 100.0
+        # Non-skytpu series are not ingested.
+        assert agg.store.series('unrelated_series') == []
+        # MFU = 100 tok/s * 2e9 flops / peak (197e12 default).
+        [(mfu_labels, mfu)] = agg.store.latest('skytpu_mfu_estimate')
+        assert mfu_labels['replica_id'] == '7'
+        assert mfu == pytest.approx(100 * 2e9 / 197e12)
+
+    def test_scrape_interval_gating_and_dead_target(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_SERVE_SCRAPE_INTERVAL', '3600')
+        agg = aggregator_lib.FleetAggregator('svc', _store(),
+                                             timeout=0.3)
+        # Dead target: degrades, never raises.
+        assert agg.maybe_scrape([{'url': 'http://127.0.0.1:9',
+                                  'kind': 'replica',
+                                  'replica_id': 1, 'role': 'mixed'}])
+        # Second call inside the interval is a no-op.
+        assert not agg.maybe_scrape([])
+
+    def test_role_signals_smooth_qps_and_loads(self):
+        agg = aggregator_lib.FleetAggregator('svc', _store())
+        now = time.time()
+        for t, v in ((40, 0), (20, 40), (0, 80)):
+            agg.store.add('skytpu_lb_route_total',
+                          {'role': 'decode'}, now - t, v)
+        for rid, busy in (('1', 2.0), ('2', 4.0)):
+            agg.store.add('skytpu_engine_busy_slots',
+                          {'replica_id': rid, 'role': 'decode'},
+                          now - 5, busy)
+            agg.store.add('skytpu_engine_slots',
+                          {'replica_id': rid, 'role': 'decode'},
+                          now - 5, 8.0)
+            agg.store.add('skytpu_engine_queue_depth',
+                          {'replica_id': rid, 'role': 'decode'},
+                          now - 5, 0.0)
+        signals = agg.role_signals('decode', 60, now)
+        assert signals['qps'] == pytest.approx(2.0)
+        assert sorted(signals['loads']) == [
+            pytest.approx(0.25), pytest.approx(0.5)]
+        # No data for the prefill pool -> both None (callers keep the
+        # instantaneous signals).
+        empty = agg.role_signals('prefill', 60, now)
+        assert empty == {'qps': None, 'loads': None}
+
+
+class TestWindowedAutoscalerSignals:
+
+    def _spec(self, **kw):
+        kw.setdefault('min_replicas', 1)
+        kw.setdefault('max_replicas', 10)
+        kw.setdefault('target_qps_per_replica', 2.0)
+        kw.setdefault('upscale_delay_seconds', 0)
+        kw.setdefault('downscale_delay_seconds', 0)
+        return SkyServiceSpec(**kw)
+
+    def test_windowed_qps_replaces_timestamp_count(self):
+        scaler = autoscalers.RequestRateAutoscaler(self._spec())
+        now = time.time()
+        # No raw timestamps at all — the smoothed signal alone drives.
+        scaler.collect_windowed_signals(qps=8.0)
+        decision = scaler.evaluate_scaling(now)
+        assert decision.target_num_replicas == 4  # ceil(8 / 2)
+
+    def test_none_falls_back_to_instantaneous(self):
+        scaler = autoscalers.RequestRateAutoscaler(self._spec())
+        now = time.time()
+        scaler.collect_request_information(
+            [now] * int(6 * autoscalers.QPS_WINDOW_SIZE_SECONDS), now)
+        scaler.collect_windowed_signals(qps=None)
+        assert scaler.evaluate_scaling(now).target_num_replicas == 3
+        # A later smoothed value overrides again.
+        scaler.collect_windowed_signals(qps=0.0)
+        assert scaler.evaluate_scaling(
+            now + 1).target_num_replicas == 1
+
+    def test_windowed_loads_feed_slot_utilization(self):
+        scaler = autoscalers.RequestRateAutoscaler(self._spec(
+            target_qps_per_replica=None, target_slot_utilization=0.5))
+        scaler.collect_windowed_signals(loads=[1.0, 1.0])
+        assert scaler.evaluate_scaling(
+            time.time()).target_num_replicas == 4
+
+    def test_carry_over_keeps_windowed_qps(self):
+        old = autoscalers.RequestRateAutoscaler(self._spec())
+        old.collect_windowed_signals(qps=8.0)
+        old.evaluate_scaling(time.time())
+        new = autoscalers.RequestRateAutoscaler(self._spec())
+        new.carry_over(old)
+        assert new.windowed_qps == 8.0
+        assert new.target_num_replicas == 4
+
+    def test_warm_start_behavior_preserved(self):
+        scaler = autoscalers.RequestRateAutoscaler(self._spec())
+        scaler.warm_start(5)
+        assert scaler.target_num_replicas == 5
+        # A fresh warm-started scaler has no smoothed signal yet.
+        assert scaler.windowed_qps is None
+
+
+class _Journal:
+
+    def __init__(self):
+        self.events = []
+
+    def append(self, event, **fields):
+        self.events.append({'event': event, **fields})
+
+
+def _fill_latency(store, now, frac_bad, total=100.0,
+                  series='skytpu_engine_ttft_seconds'):
+    """Scrapes whose fast- AND slow-window deltas have `frac_bad` of
+    observations above 0.1s (SLO threshold 100ms sits exactly at the
+    bound)."""
+    for t, mult in ((now - 290, 0.0), (now - 50, 0.0), (now - 1, 1.0)):
+        good = total * (1.0 - frac_bad) * mult
+        for le, cum in (('0.1', good), ('+Inf', total * mult)):
+            store.add(f'{series}_bucket', {'le': le}, t, cum)
+
+
+class TestSLOTracker:
+
+    def test_latency_breach_journals_start_and_end(self):
+        store = _store()
+        journal = _Journal()
+        tracker = slo_lib.SLOTracker(
+            'svc', slo_lib.parse_slos({'ttft_p99_ms': 100}),
+            journal=journal)
+        now = time.time()
+        # 20% of requests above 100ms against a 1% budget -> burn 20x
+        # in both windows -> breach.
+        _fill_latency(store, now, frac_bad=0.2)
+        [status] = tracker.evaluate(store, now)
+        assert status['breaching']
+        assert status['burn_fast'] == pytest.approx(20.0)
+        assert [e['event'] for e in journal.events] == \
+            ['slo_burn_start']
+        assert journal.events[0]['slo'] == 'ttft_p99_ms'
+        # Still breaching: no duplicate start event.
+        tracker.evaluate(store, now + 1)
+        assert len(journal.events) == 1
+        # Recovery: an all-good fast window ends the burn.
+        store2 = _store()
+        _fill_latency(store2, now + 10, frac_bad=0.0)
+        [status] = tracker.evaluate(store2, now + 10)
+        assert not status['breaching']
+        assert [e['event'] for e in journal.events] == \
+            ['slo_burn_start', 'slo_burn_end']
+        assert journal.events[1]['duration_s'] >= 0
+
+    def test_multi_window_rule_one_noisy_window_does_not_page(self,
+                                                              monkeypatch):
+        monkeypatch.setenv('SKYTPU_SLO_FAST_WINDOW_S', '30')
+        monkeypatch.setenv('SKYTPU_SLO_SLOW_WINDOW_S', '300')
+        store = _store()
+        journal = _Journal()
+        tracker = slo_lib.SLOTracker(
+            'svc', slo_lib.parse_slos({'ttft_p99_ms': 100}),
+            journal=journal)
+        now = time.time()
+        # Bad samples confined to the OLD part of the slow window: the
+        # fast window is clean -> no breach despite the slow burn.
+        for t, mult in ((now - 200, 0.0), (now - 100, 1.0)):
+            for le, cum in (('0.1', 0.0), ('+Inf', 100.0 * mult)):
+                store.add('skytpu_engine_ttft_seconds_bucket',
+                          {'le': le}, t, cum)
+        [status] = tracker.evaluate(store, now)
+        assert status['burn_slow'] > 1.0
+        assert status['burn_fast'] == 0.0
+        assert not status['breaching']
+        assert journal.events == []
+
+    def test_breach_lands_in_the_real_serve_journal(self):
+        """Default journal wiring: slo_burn_start/_end are appended to
+        $SKYTPU_HOME/events/serve.jsonl — the same flight-recorder
+        scope the drain lifecycle uses, post-mortemable after the
+        controller dies (ISSUE 11 acceptance: a slow-decode breach
+        produces journal events)."""
+        import os as _os
+
+        from skypilot_tpu.observability import events as events_lib
+        tracker = slo_lib.SLOTracker(
+            'svc-journal', slo_lib.parse_slos({'itl_p99_ms': 100}))
+        now = time.time()
+        slow_decode = _store()
+        # Chaos-shaped input: a delayed decode pushes inter-token gaps
+        # past the 100ms objective for 30% of tokens.
+        _fill_latency(slow_decode, now, frac_bad=0.3,
+                      series='skytpu_engine_itl_seconds')
+        [status] = tracker.evaluate(slow_decode, now)
+        assert status['breaching']
+        recovered = _store()
+        _fill_latency(recovered, now + 5, frac_bad=0.0,
+                      series='skytpu_engine_itl_seconds')
+        tracker.evaluate(recovered, now + 5)
+        journal = events_lib.get_journal(_os.path.join(
+            events_lib.journal_root(), 'serve.jsonl'))
+        events = [e for e in journal.read()
+                  if e.get('service') == 'svc-journal']
+        assert [e['event'] for e in events] == \
+            ['slo_burn_start', 'slo_burn_end']
+        assert events[0]['slo'] == 'itl_p99_ms'
+        assert events[1]['duration_s'] >= 0
+
+    def test_no_traffic_is_no_burn(self):
+        tracker = slo_lib.SLOTracker(
+            'svc', slo_lib.parse_slos(
+                {'ttft_p99_ms': 100, 'error_rate': 0.01,
+                 'availability': 0.999}))
+        statuses = tracker.evaluate(_store(), time.time())
+        assert len(statuses) == 3
+        assert all(not s['breaching'] and s['burn_fast'] == 0
+                   for s in statuses)
+
+    def test_error_rate_and_availability_burns(self):
+        store = _store()
+        now = time.time()
+        for t, mult in ((now - 50, 0.0), (now - 1, 1.0)):
+            store.add('skytpu_lb_requests_total', {}, t, 1000 * mult)
+            store.add('skytpu_lb_upstream_errors_total', {}, t,
+                      50 * mult)
+            store.add('skytpu_lb_no_replica_total', {}, t, 10 * mult)
+        tracker = slo_lib.SLOTracker(
+            'svc', slo_lib.parse_slos({'error_rate': 0.01,
+                                       'availability': 0.999}))
+        by_name = {s['slo']: s for s in tracker.evaluate(store, now)}
+        # 5% errors on a 1% budget; 1% no-replica on a 0.1% budget.
+        assert by_name['error_rate']['burn_fast'] == pytest.approx(
+            5.0, rel=1e-3)
+        assert by_name['availability']['burn_fast'] == pytest.approx(
+            10.0, rel=1e-3)
+        assert by_name['error_rate']['breaching']
+
+
+class TestSLOSpecBlock:
+
+    def test_round_trip(self):
+        spec = SkyServiceSpec.from_yaml_config({
+            'replicas': 1,
+            'slos': {'ttft_p99_ms': 500, 'itl_p99_ms': 100,
+                     'error_rate': 0.01, 'availability': 0.999}})
+        assert spec.slos == {'ttft_p99_ms': 500.0,
+                             'itl_p99_ms': 100.0,
+                             'error_rate': 0.01,
+                             'availability': 0.999}
+        again = SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+        assert again.slos == spec.slos
+        assert SkyServiceSpec.from_yaml_config(
+            {'replicas': 1}).slos is None
+
+    @pytest.mark.parametrize('bad', [
+        {'bogus_key': 1},
+        {'ttft_p99_ms': -5},
+        {'error_rate': 1.5},
+        {'availability': 0.0},
+        {'ttft_p99_ms': 'fast'},
+    ])
+    def test_validation(self, bad):
+        with pytest.raises(exceptions.InvalidTaskError):
+            SkyServiceSpec(slos=bad)
+
+    def test_parse_slos_objects(self):
+        slos = slo_lib.parse_slos({'ttft_p99_ms': 500,
+                                   'availability': 0.99})
+        by_name = {s.name: s for s in slos}
+        assert by_name['ttft_p99_ms'].threshold_s == \
+            pytest.approx(0.5)
+        assert by_name['ttft_p99_ms'].budget == pytest.approx(0.01)
+        assert by_name['availability'].budget == pytest.approx(0.01)
+        assert slo_lib.parse_slos(None) == []
+
+
+class TestControllerTelemetryEndpoint:
+
+    def test_telemetry_payload_shape(self):
+        import requests
+
+        from skypilot_tpu.serve.controller import SkyServeController
+        from skypilot_tpu.utils import common_utils
+        import os as _os
+        task = sky.Task(name='svc-tel', run='echo hi')
+        task.set_resources(sky.Resources(cloud='local'))
+        task.service = SkyServiceSpec(
+            min_replicas=1, max_replicas=1,
+            slos={'ttft_p99_ms': 500})
+        yaml_dir = common_utils.ensure_dir(
+            _os.path.join(common_utils.skytpu_home(), 'serve'))
+        yaml_path = _os.path.join(yaml_dir, 'svc-tel.yaml')
+        common_utils.dump_yaml(yaml_path, task.to_yaml_config())
+        serve_state.add_service('svc-tel',
+                                task.service.to_yaml_config(),
+                                yaml_path)
+        controller = SkyServeController('svc-tel')
+        port = controller.start_http()
+        try:
+            # Seed some history so the snapshot carries numbers.
+            now = time.time()
+            controller.aggregator.store.add(
+                'skytpu_lb_route_total', {'role': 'mixed'},
+                now - 30, 0)
+            controller.aggregator.store.add(
+                'skytpu_lb_route_total', {'role': 'mixed'}, now, 60)
+            controller.slo_tracker.evaluate(
+                controller.aggregator.store, now)
+            resp = requests.get(
+                f'http://127.0.0.1:{port}/controller/telemetry',
+                timeout=5)
+            assert resp.status_code == 200
+            payload = resp.json()
+            assert payload['service'] == 'svc-tel'
+            assert 'mixed' in payload['roles']
+            assert payload['roles']['mixed']['qps'] == \
+                pytest.approx(2.0)
+            assert len(payload['roles']['mixed']['qps_spark']) > 0
+            assert payload['slos'][0]['slo'] == 'ttft_p99_ms'
+            assert payload['slow_traces'] == []
+        finally:
+            controller.stop()
+
+
+class TestServeTopRender:
+
+    def _record(self):
+        return {'name': 'svc', 'status': 'READY', 'version': 1,
+                'load_balancer_port': 8080,
+                'replicas': [
+                    {'replica_id': 1, 'role': 'decode',
+                     'status': 'READY', 'url': 'http://r1'},
+                    {'replica_id': 2, 'role': 'prefill',
+                     'status': 'READY', 'url': 'http://r2'},
+                ]}
+
+    def test_render_shows_fleet_slos_and_breach(self, capsys):
+        from skypilot_tpu import cli
+        telemetry = {
+            'mfu': {'1': 0.1234},
+            'roles': {'decode': {
+                'qps': 3.5, 'qps_spark': [1.0, 2.0, None, 4.0],
+                'tokens_per_s_spark': [10.0, 20.0],
+                'ttft_p99_ms': 120.0, 'itl_p99_ms': 9.0}},
+            'slos': [{'slo': 'ttft_p99_ms', 'target': 100,
+                      'burn_fast': 20.0, 'burn_slow': 15.0,
+                      'breaching': True}],
+            'slow_traces': [{'request_id': 'abcd', 'replica_id': 1,
+                             'role': 'decode', 'duration_ms': 812.0,
+                             'ttft_ms': 300.0, 'status': 'ok'}],
+        }
+        cli._render_top([self._record()], {'svc': telemetry})  # pylint: disable=protected-access
+        out = capsys.readouterr().out
+        assert 'svc' in out and '2/2 ready' in out
+        assert '0.1234' in out                  # per-replica MFU
+        assert 'BREACH' in out                  # SLO status
+        assert 'abcd' in out and '812.0ms' in out
+        assert 'TTFT p99' in out
+
+    def test_render_without_telemetry_still_shows_fleet(self, capsys):
+        from skypilot_tpu import cli
+        cli._render_top([self._record()], {'svc': None})  # pylint: disable=protected-access
+        out = capsys.readouterr().out
+        assert 'REPLICA' in out and 'BREACH' not in out
+
+    def test_sparkline(self):
+        from skypilot_tpu import cli
+        spark = cli._sparkline([0.0, 1.0, 2.0, None, 4.0])  # pylint: disable=protected-access
+        assert len(spark) == 5
+        assert spark[3] == ' '
+        assert spark[4] == '█'
+        assert cli._sparkline([]) == '-'  # pylint: disable=protected-access
+        assert cli._sparkline([None, None]) == '-'  # pylint: disable=protected-access

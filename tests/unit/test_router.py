@@ -7,6 +7,7 @@ from __future__ import annotations
 import http.server
 import json
 import threading
+import time
 
 import pytest
 import requests
@@ -300,6 +301,15 @@ class TestLbRetryPath:
             one = requests.post(url, json=body, timeout=10).json()
             assert one['role'] == 'decode'
             assert one['affinity'] == 'miss'
+            # The pin is recorded after the LB sees upstream EOF,
+            # which can land a beat AFTER the client has the full
+            # response — wait for it instead of racing it.
+            key = router_lib.prompt_key(prompt_ids=[4, 5, 6])
+            deadline = time.time() + 5
+            while (balancer.router.affinity_target(key) is None and
+                   time.time() < deadline):
+                time.sleep(0.02)
+            assert balancer.router.affinity_target(key) is not None
             two = requests.post(url, json=body, timeout=10).json()
             assert two['affinity'] == 'hit'
         finally:

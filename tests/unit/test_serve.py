@@ -479,7 +479,17 @@ class TestServeClientAPI:
                     break
                 time.sleep(0.5)
             assert ready, serve_core.status(['svc-api'])
-            resp = requests.get(endpoint + '/', timeout=10)
+            # Service READY = the replica probe passed; the LB's fleet
+            # view converges one sync interval (0.3s here) LATER by
+            # design (additions ride the pull sync; only retirements
+            # get the /lb/retire push).  Absorb that window instead of
+            # racing it.
+            deadline = time.time() + 10
+            while True:
+                resp = requests.get(endpoint + '/', timeout=10)
+                if resp.status_code != 503 or time.time() > deadline:
+                    break
+                time.sleep(0.2)
             assert resp.status_code == 200
         finally:
             serve_core.down('svc-api', purge=True)

@@ -1,0 +1,189 @@
+"""End-to-end distributed trace assembly through the real LB
+(ISSUE 11 acceptance): a prefill->handoff->decode request stitched
+from all three processes' span exports in causal order, and the
+retry path (attempt 0 vs attempt 1) kept distinct.
+"""
+from __future__ import annotations
+
+import socket
+
+import pytest
+import requests
+
+from skypilot_tpu.observability import traces as traces_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import model_server as model_server_lib
+from skypilot_tpu.serve import router as router_lib
+
+
+def _make_server(role, replica_id):
+    return model_server_lib.ModelServer(
+        'tiny', max_len=64, max_batch=2, continuous_batching=True,
+        kv_pages=48, page_size=8, prefill_chunk=16, role=role,
+        replica_id=replica_id)
+
+
+def _dead_url() -> str:
+    """A url nothing listens on (bound then closed)."""
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+    return f'http://127.0.0.1:{port}'
+
+
+def test_disaggregated_request_stitches_three_processes():
+    """`sky serve trace` substance: LB + prefill replica + decode
+    replica segments assemble into one causal waterfall, and the
+    Chrome export is a valid trace."""
+    prefill = _make_server('prefill', 1)
+    decode = _make_server('decode', 2)
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:1', router=router_lib.Router(threshold=24))
+    shutdowns = []
+    try:
+        p_port, p_stop = model_server_lib.start_background(prefill)
+        d_port, d_stop = model_server_lib.start_background(decode)
+        shutdowns.extend([p_stop, d_stop])
+        lb.set_replicas([
+            {'url': f'http://127.0.0.1:{p_port}', 'role': 'prefill',
+             'page_size': 8},
+            {'url': f'http://127.0.0.1:{d_port}', 'role': 'decode',
+             'page_size': 8},
+        ])
+        lb_port = lb.start()
+        prompt = list(range(1, 41))   # above threshold -> handoff
+        resp = requests.post(
+            f'http://127.0.0.1:{lb_port}/generate',
+            json={'prompt_ids': [prompt], 'max_new_tokens': 4},
+            timeout=120)
+        assert resp.status_code == 200
+        rid = resp.headers['X-SkyTPU-Request-Id']
+
+        targets = [
+            {'url': f'http://127.0.0.1:{p_port}', 'replica_id': 1,
+             'role': 'prefill'},
+            {'url': f'http://127.0.0.1:{d_port}', 'replica_id': 2,
+             'role': 'decode'},
+        ]
+        segments = traces_lib.collect(
+            rid, targets, f'http://127.0.0.1:{lb_port}')
+        by_name = {s['name']: s for s in segments}
+        # All three processes contributed.
+        assert by_name['lb']['process'] == 'lb'
+        assert by_name['prefill_export']['replica_id'] == 1
+        assert by_name['kv_import']['replica_id'] == 2
+        assert by_name['engine']['replica_id'] == 2
+        # Causal order: LB first, prefill export before the decode
+        # replica's import, engine span last.
+        names = [s['name'] for s in segments]
+        assert names.index('lb') == 0
+        assert names.index('prefill_export') < names.index('kv_import')
+        assert names.index('kv_import') < names.index('engine')
+        # LB segment carries the route/handoff/attempt phases.
+        lb_phases = [p['name'] for p in by_name['lb']['phases']]
+        assert lb_phases[:2] == ['route', 'handoff']
+        assert 'attempt-0' in lb_phases
+        assert by_name['lb']['status'] == 200
+        # Engine span kept its routed facts + handoff timing.
+        assert by_name['engine']['routed_role'] == 'decode'
+        assert by_name['engine']['handoff_ms'] > 0
+        # Waterfall renders every process; Chrome export is valid.
+        text = '\n'.join(traces_lib.format_waterfall(segments))
+        assert 'replica 1 (prefill)' in text
+        assert 'replica 2 (decode)' in text
+        events = traces_lib.to_chrome_trace(segments)
+        assert {e['args']['name'] for e in events
+                if e['ph'] == 'M'} == {
+                    'lb', 'replica 1 (prefill)',
+                    'replica 2 (decode)'}
+        # The since= filter excludes everything already exported.
+        assert traces_lib.fetch_segments(
+            f'http://127.0.0.1:{p_port}', request_id=rid,
+            since=9e12) == []
+    finally:
+        lb.stop()
+        for stop in shutdowns:
+            stop()
+        prefill.close()
+        decode.close()
+
+
+def test_retry_attempts_stay_distinct():
+    """A dead first target forces the LB's one-shot same-role retry:
+    the reused request id shows up as attempt-0 (upstream_error) and
+    attempt-1 (served), and the replica span is tagged attempt=1."""
+    alive = _make_server('decode', 5)
+    lb = lb_lib.SkyServeLoadBalancer('http://127.0.0.1:1',
+                                     router=router_lib.Router(
+                                         threshold=1000))
+    try:
+        a_port, a_stop = model_server_lib.start_background(alive)
+        dead = _dead_url()
+        # The dead replica ranks first (load 0 vs 0.9) so attempt 0
+        # hits it and fails before any byte.
+        lb.set_replicas([
+            {'url': dead, 'role': 'decode', 'load': 0.0},
+            {'url': f'http://127.0.0.1:{a_port}', 'role': 'decode',
+             'load': 0.9},
+        ])
+        lb_port = lb.start()
+        resp = requests.post(
+            f'http://127.0.0.1:{lb_port}/generate',
+            json={'prompt_ids': [[1, 2, 3]], 'max_new_tokens': 3},
+            timeout=120)
+        assert resp.status_code == 200
+        rid = resp.headers['X-SkyTPU-Request-Id']
+        [lb_seg] = traces_lib.fetch_segments(
+            f'http://127.0.0.1:{lb_port}', '/lb/spans',
+            request_id=rid)
+        phases = {p['name']: p for p in lb_seg['phases']}
+        assert phases['attempt-0']['status'] == 'upstream_error'
+        assert phases['attempt-0']['target'] == dead
+        assert phases['attempt-1']['status'] == 200
+        # The replica's span names the retry attempt, so assembly
+        # can't conflate it with the (never-served) first attempt.
+        [engine_seg] = traces_lib.fetch_segments(
+            f'http://127.0.0.1:{a_port}', request_id=rid)
+        assert engine_seg['attempt'] == 1
+        assert engine_seg['replica_id'] == 5
+    finally:
+        lb.stop()
+        a_stop()
+        alive.close()
+
+
+@pytest.mark.slow
+def test_streaming_request_traced_through_async_front():
+    """Heavy variant: the async front's SSE stream also exports its
+    span, assembled with the LB segment."""
+    from skypilot_tpu.serve import async_server
+
+    server = _make_server('mixed', 3)
+    lb = lb_lib.SkyServeLoadBalancer('http://127.0.0.1:1',
+                                     router=router_lib.Router(
+                                         threshold=1000))
+    try:
+        port, stop = async_server.start_background(server)
+        lb.set_replicas([{'url': f'http://127.0.0.1:{port}',
+                          'role': 'mixed'}])
+        lb_port = lb.start()
+        resp = requests.post(
+            f'http://127.0.0.1:{lb_port}/generate_stream',
+            json={'prompt_ids': [[1, 2, 3, 4]], 'max_new_tokens': 4},
+            timeout=120, stream=True)
+        assert resp.status_code == 200
+        list(resp.iter_content(1024))    # drain the stream
+        rid = resp.headers['X-SkyTPU-Request-Id']
+        segments = traces_lib.collect(
+            rid, [{'url': f'http://127.0.0.1:{port}',
+                   'replica_id': 3, 'role': 'mixed'}],
+            f'http://127.0.0.1:{lb_port}')
+        names = [s['name'] for s in segments]
+        assert 'lb' in names and 'engine' in names
+        engine_seg = next(s for s in segments
+                          if s['name'] == 'engine')
+        assert engine_seg['tokens'] == 4
+    finally:
+        lb.stop()
+        stop()
+        server.close()

@@ -1,0 +1,207 @@
+"""Distributed trace assembly tests (ISSUE 11).
+
+Span segment export (identity + attempt tagging), the SegmentStore,
+causal assembly, waterfall rendering, Chrome-trace export, and the
+constant process-identity labels on the metrics exposition.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import traces as traces_lib
+from skypilot_tpu.observability import tracing
+
+
+def _span(rid, attempt=None, routed_role=None):
+    span = tracing.RequestSpan(rid)
+    span.routed_role = routed_role
+    span.attempt = attempt
+    span.mark_admitted()
+    span.mark_token()
+    span.mark_token()
+    span.finish('ok')
+    return span
+
+
+class TestSegmentExport:
+
+    def test_span_segment_carries_identity_and_phases(self):
+        span = _span('req1', attempt=1, routed_role='decode')
+        seg = span.segment({'process': 'replica', 'replica_id': 3,
+                            'role': 'decode'})
+        assert seg['request_id'] == 'req1'
+        assert seg['process'] == 'replica'
+        assert seg['replica_id'] == 3
+        assert seg['attempt'] == 1
+        assert seg['name'] == 'engine'
+        assert seg['start'] == span.submit_wall
+        assert seg['duration_ms'] is not None
+        names = [p['name'] for p in seg['phases']]
+        assert 'decode' in names
+
+    def test_store_export_filters(self):
+        store = tracing.SpanStore()
+        t0 = time.time()
+        store.add(_span('a'))
+        store.add(_span('b'))
+        store.add(_span('c'))
+        assert [s['request_id'] for s in store.export()] == \
+            ['a', 'b', 'c']
+        assert [s['request_id']
+                for s in store.export(request_id='b')] == ['b']
+        assert store.export(since=t0 + 3600) == []
+        assert len(store.export(limit=2)) == 2
+        # Identity tags ride every exported segment.
+        [seg] = store.export({'replica_id': 9}, request_id='a')
+        assert seg['replica_id'] == 9
+
+    def test_attempt_disambiguates_retried_request_id(self):
+        """The LB's one-shot retry reuses the request id on a second
+        replica: with attempt tags the two segments stay distinct."""
+        first = _span('same-rid', attempt=0).segment(
+            {'replica_id': 1})
+        retry = _span('same-rid', attempt=1).segment(
+            {'replica_id': 2})
+        merged = traces_lib.assemble([retry, first])
+        assert [(s['replica_id'], s['attempt']) for s in merged] == \
+            [(1, 0), (2, 1)]
+
+    def test_segment_store(self):
+        store = tracing.SegmentStore(maxlen=2)
+        for i in range(3):
+            store.add({'request_id': f'r{i}', 'start': float(i),
+                       'name': 'lb'})
+        assert len(store) == 2                       # bounded
+        assert [s['request_id'] for s in store.export()] == \
+            ['r1', 'r2']
+        assert store.export(request_id='r2')[0]['start'] == 2.0
+        assert store.export(since=2.0)[0]['request_id'] == 'r2'
+
+    def test_parse_span_query(self):
+        parsed = tracing.parse_span_query(
+            'since=12.5&request_id=abc&limit=3')
+        assert parsed == {'since': 12.5, 'request_id': 'abc',
+                          'limit': 3}
+        assert tracing.parse_span_query('') == {}
+        assert tracing.parse_span_query('since=bogus') == {}
+
+
+class TestAssembly:
+
+    def _segments(self):
+        t0 = 1000.0
+        return [
+            {'request_id': 'r', 'process': 'replica', 'replica_id': 2,
+             'role': 'decode', 'name': 'engine', 'attempt': 0,
+             'start': t0 + 0.5, 'duration_ms': 200.0,
+             'status': 'ok',
+             'phases': [{'name': 'decode', 'start': t0 + 0.55,
+                         'duration_ms': 150.0}]},
+            {'request_id': 'r', 'process': 'lb', 'name': 'lb',
+             'attempt': 0, 'start': t0, 'duration_ms': 800.0,
+             'status': 200,
+             'phases': [{'name': 'route', 'start': t0,
+                         'duration_ms': 1.0}]},
+            {'request_id': 'r', 'process': 'replica', 'replica_id': 1,
+             'role': 'prefill', 'name': 'prefill_export',
+             'attempt': 0, 'start': t0 + 0.1, 'duration_ms': 120.0,
+             'phases': []},
+        ]
+
+    def test_causal_order(self):
+        ordered = traces_lib.assemble(self._segments())
+        assert [s['name'] for s in ordered] == \
+            ['lb', 'prefill_export', 'engine']
+        # Ties at the same start put the LB first.
+        tie = traces_lib.assemble([
+            {'process': 'replica', 'start': 5.0, 'name': 'engine'},
+            {'process': 'lb', 'start': 5.0, 'name': 'lb'}])
+        assert [s['name'] for s in tie] == ['lb', 'engine']
+
+    def test_waterfall_renders_all_processes(self):
+        lines = traces_lib.format_waterfall(
+            traces_lib.assemble(self._segments()))
+        text = '\n'.join(lines)
+        assert 'lb' in text
+        assert 'replica 1 (prefill)' in text
+        assert 'replica 2 (decode)' in text
+        assert 'prefill_export' in text
+        assert 'route' in text
+        # Bars render and every line carries one.
+        assert all('|' in line for line in lines)
+        assert traces_lib.format_waterfall([]) == ['(no segments)']
+
+    def test_chrome_trace_export(self, tmp_path):
+        segments = self._segments()
+        events = traces_lib.to_chrome_trace(segments)
+        x_events = [e for e in events if e['ph'] == 'X']
+        meta = [e for e in events if e['ph'] == 'M']
+        # One pid per process, named via metadata events.
+        assert {e['args']['name'] for e in meta} == \
+            {'lb', 'replica 1 (prefill)', 'replica 2 (decode)'}
+        assert len({e['pid'] for e in meta}) == 3
+        # Segments + phases all land as complete events with ts/dur.
+        assert len(x_events) == 3 + 2
+        assert all(e['dur'] >= 0 and e['ts'] > 0 for e in x_events)
+        path = tmp_path / 'trace.json'
+        traces_lib.export_chrome_trace(segments, str(path))
+        payload = json.loads(path.read_text())
+        assert len(payload['traceEvents']) == len(events)
+
+
+class TestConstLabels:
+
+    def test_every_series_carries_process_identity(self):
+        registry = metrics_lib.Registry()
+        registry.counter('c_total', 'c').inc()
+        registry.gauge('g', 'g', ('shard',)).labels(shard='0').set(2)
+        registry.histogram('h', 'h', buckets=(1.0,)).observe(0.5)
+        registry.set_const_labels({'replica_id': 7, 'role': 'decode',
+                                  'num_hosts': 2})
+        text = registry.expose()
+        parsed = metrics_lib.parse_exposition(text)
+        for name in ('c_total', 'g', 'h_bucket', 'h_sum', 'h_count'):
+            for labels in parsed[name]:
+                ldict = dict(labels)
+                assert ldict['replica_id'] == '7', (name, labels)
+                assert ldict['role'] == 'decode'
+                assert ldict['num_hosts'] == '2'
+        # Instrument's own labels still present alongside.
+        [labels] = list(parsed['g'])
+        assert dict(labels)['shard'] == '0'
+        # clear() resets identity (test isolation contract).
+        registry.clear()
+        assert registry.const_labels() == {}
+
+    def test_histogram_quantile_interpolates(self):
+        parsed = {'h_bucket': {
+            (('le', '0.1'),): 50.0,
+            (('le', '0.2'),): 100.0,
+            (('le', '+Inf'),): 100.0}}
+        assert metrics_lib.histogram_quantile(parsed, 'h', 0.5) == 0.1
+        # Linear interpolation INSIDE the winning bucket.
+        assert abs(metrics_lib.histogram_quantile(parsed, 'h', 0.75)
+                   - 0.15) < 1e-9
+        # First bucket interpolates from 0.
+        assert abs(metrics_lib.histogram_quantile(parsed, 'h', 0.25)
+                   - 0.05) < 1e-9
+        # +Inf clamps to the highest finite bound.
+        overflow = {'h_bucket': {(('le', '0.1'),): 0.0,
+                                 (('le', '+Inf'),): 10.0}}
+        assert metrics_lib.histogram_quantile(overflow, 'h',
+                                              0.99) == 0.1
+        assert metrics_lib.histogram_quantile({}, 'h', 0.5) is None
+        empty = {'h_bucket': {(('le', '+Inf'),): 0.0}}
+        assert metrics_lib.histogram_quantile(empty, 'h', 0.5) is None
+
+    def test_quantile_aggregates_across_label_sets(self):
+        # Two replicas' buckets sum before the quantile is read.
+        parsed = {'h_bucket': {
+            (('le', '0.1'), ('replica_id', '1')): 100.0,
+            (('le', '+Inf'), ('replica_id', '1')): 100.0,
+            (('le', '0.1'), ('replica_id', '2')): 0.0,
+            (('le', '+Inf'), ('replica_id', '2')): 100.0}}
+        q = metrics_lib.histogram_quantile(parsed, 'h', 0.5)
+        assert q == 0.1
