@@ -1,0 +1,34 @@
+"""Static-analysis plane: `sky lint` (ISSUE 12).
+
+One parse of the whole package into a shared :class:`~skypilot_tpu.
+analysis.index.PackageIndex` (ASTs, per-module import-alias maps,
+per-class attribute tables, a lightweight call graph), then pluggable
+checker passes over it producing ``(rule_id, file, line, message)``
+findings.  Three layers:
+
+- `analysis/index.py`  — the parse-once package index.  AST only: the
+  analyzed modules are never imported, so a lint run cannot execute
+  package code (and runs in seconds on CPU).
+- `analysis/core.py`   — Finding / Pass / the runner: inline
+  suppressions (``# skytpu: lint-ok[rule] reason=...`` — the reason is
+  mandatory), the committed baseline for grandfathered findings
+  (`lint-baseline.json`, stale entries are themselves findings), and
+  deterministic JSON output.
+- `analysis/passes/`   — the checker passes (rule catalog in
+  docs/static-analysis.md): the concurrency race detector, the JAX
+  tracer-safety pass, the env-knob / journal-event / metrics-catalog
+  registries, the chaos-site and bare-print lints (migrated from
+  their ad-hoc test walkers), and the batching-engine facade-surface
+  check.
+
+Surfaced as ``skytpu lint [--rule ...] [--json]`` (exit 1 on
+unsuppressed findings) and the tier-1 `tests/unit/test_sky_lint.py`
+run over the repo itself.
+"""
+from skypilot_tpu.analysis.core import Finding
+from skypilot_tpu.analysis.core import LintResult
+from skypilot_tpu.analysis.core import Pass
+from skypilot_tpu.analysis.core import run_lint
+from skypilot_tpu.analysis.index import PackageIndex
+
+__all__ = ['Finding', 'LintResult', 'Pass', 'PackageIndex', 'run_lint']

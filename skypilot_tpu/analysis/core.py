@@ -1,0 +1,227 @@
+"""Pass framework: findings, suppressions, baseline, the runner.
+
+A checker pass is a :class:`Pass` subclass declaring the rule ids it
+owns and yielding :class:`Finding`s from one shared
+:class:`~skypilot_tpu.analysis.index.PackageIndex`.  The runner then:
+
+1. drops findings covered by an inline suppression —
+   ``# skytpu: lint-ok[rule] reason=...`` on the finding's line (or a
+   comment-only line directly above it).  The reason is MANDATORY: a
+   reasonless suppression does not suppress and is itself a
+   `suppression-invalid` finding.
+2. drops findings recorded in the committed baseline
+   (`lint-baseline.json`: grandfathered findings keyed by
+   ``rule//file//message`` — line numbers drift, messages don't), and
+   flags baseline entries that no longer reproduce as
+   `baseline-stale` findings so the baseline can only shrink.
+3. sorts everything by (file, line, rule, message) so two runs over
+   the same tree are byte-identical (`--json` is diffable and the
+   determinism test pins it).
+
+Exit contract (the CLI and tier-1 test): unsuppressed findings -> 1.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import time
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+from skypilot_tpu.analysis import index as index_lib
+
+BASELINE_FILENAME = 'lint-baseline.json'
+
+# Rules owned by the framework itself (not any pass).
+RULE_SUPPRESSION_INVALID = 'suppression-invalid'
+RULE_BASELINE_STALE = 'baseline-stale'
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    file: str          # package-relative, e.g. 'serve/router.py'
+    line: int
+    message: str
+
+    def key(self) -> str:
+        """Baseline identity: stable across unrelated line drift."""
+        return f'{self.rule}//{self.file}//{self.message}'
+
+    def as_dict(self) -> Dict[str, object]:
+        return {'rule': self.rule, 'file': self.file,
+                'line': self.line, 'message': self.message}
+
+    def render(self) -> str:
+        return f'{self.file}:{self.line}: [{self.rule}] {self.message}'
+
+
+class Pass:
+    """One checker.  Subclasses set `name`, `rules`, `description` and
+    implement :meth:`run`."""
+
+    name: str = ''
+    rules: Sequence[str] = ()
+    description: str = ''
+
+    def run(self, idx: index_lib.PackageIndex) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]            # unsuppressed: these fail lint
+    suppressed: List[Finding]          # silenced by an inline lint-ok
+    baselined: List[Finding]           # silenced by the baseline file
+    duration_s: float
+    passes: List[str]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> str:
+        """Deterministic (two runs over one tree are byte-identical:
+        no timestamps, stable sort everywhere)."""
+        payload = {
+            'version': 1,
+            'ok': self.ok,
+            'passes': list(self.passes),
+            'findings': [f.as_dict() for f in self.findings],
+            'suppressed': [f.as_dict() for f in self.suppressed],
+            'baselined': [f.as_dict() for f in self.baselined],
+        }
+        return json.dumps(payload, indent=2, sort_keys=True)
+
+
+def _sort(findings: Iterable[Finding]) -> List[Finding]:
+    return sorted(findings,
+                  key=lambda f: (f.file, f.line, f.rule, f.message))
+
+
+def load_baseline(path: Optional[pathlib.Path]) -> List[str]:
+    """Baseline keys from `lint-baseline.json` (absent file = empty)."""
+    if path is None or not path.is_file():
+        return []
+    data = json.loads(path.read_text(encoding='utf-8'))
+    return [str(k) for k in data.get('findings', [])]
+
+
+def write_baseline(path: pathlib.Path,
+                   findings: Iterable[Finding]) -> None:
+    """Grandfather the given findings (the `--update-baseline`
+    workflow: commit the shrinking file, never grow it by hand)."""
+    payload = {'version': 1,
+               'findings': sorted(f.key() for f in findings)}
+    path.write_text(json.dumps(payload, indent=2) + '\n',
+                    encoding='utf-8')
+
+
+def default_passes() -> List[Pass]:
+    from skypilot_tpu.analysis import passes as passes_lib  # pylint: disable=import-outside-toplevel
+    return passes_lib.all_passes()
+
+
+def rule_catalog(passes: Optional[Sequence[Pass]] = None) \
+        -> Dict[str, str]:
+    """rule id -> owning pass name (plus the framework's own rules)."""
+    catalog = {RULE_SUPPRESSION_INVALID: 'framework',
+               RULE_BASELINE_STALE: 'framework'}
+    for p in (default_passes() if passes is None else passes):
+        for rule in p.rules:
+            catalog[rule] = p.name
+    return catalog
+
+
+def run_lint(idx: index_lib.PackageIndex,
+             passes: Optional[Sequence[Pass]] = None,
+             rules: Optional[Sequence[str]] = None,
+             baseline_path: Optional[pathlib.Path] = None) \
+        -> LintResult:
+    """Run the pass suite over one index.
+
+    `rules` filters which rule ids may report (passes owning none of
+    the requested rules are skipped entirely).  The framework rules
+    (`suppression-invalid`, `baseline-stale`) always run: a filter
+    must not hide a broken suppression or a stale baseline.
+    """
+    t0 = time.perf_counter()
+    if passes is None:
+        passes = default_passes()
+    wanted = set(rules) if rules else None
+    known = set(rule_catalog(passes))
+    if wanted is not None:
+        unknown = sorted(wanted - known)
+        if unknown:
+            raise ValueError(
+                f'unknown rule id(s) {unknown}; have {sorted(known)}')
+    raw: List[Finding] = []
+    ran: List[str] = []
+    ran_rules: set = set()
+    for p in passes:
+        if wanted is not None and not wanted.intersection(p.rules):
+            continue
+        ran.append(p.name)
+        ran_rules.update(p.rules if wanted is None
+                         else wanted.intersection(p.rules))
+        for f in p.run(idx):
+            if wanted is not None and f.rule not in wanted:
+                continue
+            raw.append(f)
+
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    seen_sups: set = set()
+    for f in _sort(raw):
+        mod = idx.modules.get(f.file)
+        sup = mod.suppression_for(f.line, f.rule) if mod else None
+        if sup is not None and sup.reason:
+            suppressed.append(f)
+        elif sup is not None:
+            # Matching suppression but no reason: the finding stands
+            # AND the suppression itself is flagged (once per line).
+            findings.append(f)
+            if (f.file, sup.line) not in seen_sups:
+                seen_sups.add((f.file, sup.line))
+                findings.append(Finding(
+                    RULE_SUPPRESSION_INVALID, f.file, sup.line,
+                    'lint-ok suppression without a reason= — the '
+                    'reason is mandatory'))
+        else:
+            findings.append(f)
+
+    # Reasonless suppressions that matched NO finding still get
+    # flagged: they are dead weight waiting to silently eat a future
+    # finding without justification.
+    for rel, mod in sorted(idx.modules.items()):
+        for sup in mod.suppressions:
+            if not sup.reason and (rel, sup.line) not in seen_sups:
+                seen_sups.add((rel, sup.line))
+                findings.append(Finding(
+                    RULE_SUPPRESSION_INVALID, rel, sup.line,
+                    'lint-ok suppression without a reason= — the '
+                    'reason is mandatory'))
+
+    baseline = set(load_baseline(baseline_path))
+    if baseline:
+        baselined = [f for f in findings if f.key() in baseline]
+        matched = {f.key() for f in baselined}
+        findings = [f for f in findings if f.key() not in baseline]
+        for key in sorted(baseline - matched):
+            rule, file, _ = (key.split('//', 2) + ['', ''])[:3]
+            if rule not in ran_rules:
+                # Its pass did not run (a --rule filter): absence of
+                # the finding proves nothing about staleness.
+                continue
+            findings.append(Finding(
+                RULE_BASELINE_STALE, file or '<baseline>', 0,
+                f'baselined finding no longer reproduces — remove it '
+                f'from {BASELINE_FILENAME}: {key}'))
+    else:
+        baselined = []
+
+    return LintResult(findings=_sort(findings),
+                      suppressed=_sort(suppressed),
+                      baselined=_sort(baselined),
+                      duration_s=time.perf_counter() - t0,
+                      passes=ran)

@@ -1,0 +1,279 @@
+"""Parse-once package index the checker passes share.
+
+The three pre-existing ad-hoc lints each re-walked the whole package
+with their own `ast.parse` loop; every new pass would have added
+another.  This module parses each module ONCE and exposes the derived
+tables every pass needs:
+
+- per-module: the AST, raw source lines, import-alias map
+  (``name -> dotted module``, resolving relative imports inside the
+  package), and the inline-suppression table (`core.py` consumes it).
+- per-class: attribute assignment sites (``self.X = ...``) and which
+  attributes hold `threading` locks.
+- per-function: a qualname table (``module::Class.method`` /
+  ``module::func``) with the raw nodes, for the call-graph passes.
+
+Everything here is `ast`-only — building an index never imports an
+analyzed module, so linting cannot execute package code.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# ``# skytpu: lint-ok[rule-a,rule-b] reason=...`` — reason mandatory
+# (enforced by core.py; an empty reason is a `suppression-invalid`
+# finding, and the suppression does NOT apply).
+_SUPPRESS_RE = re.compile(
+    r'#\s*skytpu:\s*lint-ok\[([a-z0-9_,\- ]*)\]\s*(?:reason=(.*))?$')
+
+_LOCK_FACTORIES = ('Lock', 'RLock', 'Condition', 'Semaphore',
+                   'BoundedSemaphore')
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int            # line the suppression comment sits on
+    applies_to: int      # line whose findings it suppresses
+    rules: Tuple[str, ...]
+    reason: str          # '' = invalid (reason is mandatory)
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    module: str                      # rel path, e.g. 'serve/router.py'
+    name: str
+    node: ast.ClassDef
+    # attr -> [(method_name, lineno)] for every ``self.attr = ...``.
+    attr_writes: Dict[str, List[Tuple[str, int]]]
+    # attrs assigned a threading.Lock()/RLock()/Condition()/... value.
+    lock_attrs: Tuple[str, ...]
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    module: str
+    qualname: str                    # 'Class.method' or 'func'
+    node: ast.AST                    # FunctionDef / AsyncFunctionDef
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    rel: str                         # path relative to the package root
+    path: pathlib.Path
+    tree: ast.Module
+    lines: List[str]
+    # local name -> dotted module target ('np' -> 'numpy',
+    # 'scheduler' -> 'skypilot_tpu.serve.scheduler').
+    import_aliases: Dict[str, str]
+    # local name -> (dotted module, attr) for `from m import a [as b]`.
+    from_imports: Dict[str, Tuple[str, str]]
+    suppressions: List[Suppression]
+
+    def suppression_for(self, line: int, rule: str) \
+            -> Optional[Suppression]:
+        for sup in self.suppressions:
+            if sup.applies_to == line and (not sup.rules or
+                                           rule in sup.rules):
+                return sup
+        return None
+
+
+def _parse_suppressions(lines: List[str]) -> List[Suppression]:
+    sups: List[Suppression] = []
+    for i, raw in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(raw)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(',')
+                      if r.strip())
+        reason = (m.group(2) or '').strip()
+        # A comment-only line suppresses the next non-comment line;
+        # a trailing comment suppresses its own line.
+        if raw.strip().startswith('#'):
+            applies = i + 1
+            for j in range(i, len(lines)):
+                if not lines[j].strip().startswith('#'):
+                    applies = j + 1
+                    break
+        else:
+            applies = i
+        sups.append(Suppression(line=i, applies_to=applies,
+                                rules=rules, reason=reason))
+    return sups
+
+
+def _resolve_relative(package: str, rel: str, module: Optional[str],
+                      level: int) -> Optional[str]:
+    """Dotted target of a `from ... import` seen in module `rel`."""
+    if level == 0:
+        return module
+    parts = (package + '/' + rel).split('/')[:-1]  # containing package
+    up = level - 1
+    if up > len(parts):
+        return None
+    base = parts[:len(parts) - up]
+    dotted = '.'.join(base)
+    if module:
+        dotted = f'{dotted}.{module}' if dotted else module
+    return dotted or None
+
+
+def _is_lock_factory(value: ast.AST) -> bool:
+    """``threading.Lock()`` / ``Lock()`` / ``threading.Condition(...)``."""
+    if not isinstance(value, ast.Call):
+        return False
+    func = value.func
+    if isinstance(func, ast.Attribute):
+        return (isinstance(func.value, ast.Name) and
+                func.value.id == 'threading' and
+                func.attr in _LOCK_FACTORIES)
+    if isinstance(func, ast.Name):
+        return func.id in _LOCK_FACTORIES
+    return False
+
+
+def _class_info(rel: str, node: ast.ClassDef) -> ClassInfo:
+    attr_writes: Dict[str, List[Tuple[str, int]]] = {}
+    lock_attrs: List[str] = []
+    for item in node.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        method = item.name
+        for sub in ast.walk(item):
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(sub, ast.Assign):
+                targets, value = sub.targets, sub.value
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                targets = [sub.target]
+                value = getattr(sub, 'value', None)
+            for tgt in targets:
+                if (isinstance(tgt, ast.Attribute) and
+                        isinstance(tgt.value, ast.Name) and
+                        tgt.value.id == 'self'):
+                    attr_writes.setdefault(tgt.attr, []).append(
+                        (method, sub.lineno))
+                    if value is not None and _is_lock_factory(value):
+                        if tgt.attr not in lock_attrs:
+                            lock_attrs.append(tgt.attr)
+    return ClassInfo(module=rel, name=node.name, node=node,
+                     attr_writes=attr_writes,
+                     lock_attrs=tuple(lock_attrs))
+
+
+class PackageIndex:
+    """All modules of one package, parsed once."""
+
+    def __init__(self, root: pathlib.Path,
+                 package: Optional[str] = None) -> None:
+        self.root = pathlib.Path(root)
+        self.package = package or self.root.name
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.classes: Dict[Tuple[str, str], ClassInfo] = {}
+        self.functions: Dict[Tuple[str, str], FunctionInfo] = {}
+        for path in sorted(self.root.rglob('*.py')):
+            rel = path.relative_to(self.root).as_posix()
+            if '__pycache__' in rel:
+                continue
+            self._add_module(rel, path)
+
+    # ----------------------------------------------------------- build
+
+    def _add_module(self, rel: str, path: pathlib.Path) -> None:
+        source = path.read_text(encoding='utf-8')
+        tree = ast.parse(source, filename=str(path))
+        lines = source.splitlines()
+        aliases: Dict[str, str] = {}
+        from_imports: Dict[str, Tuple[str, str]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        aliases[a.asname] = a.name
+                    else:
+                        top = a.name.split('.')[0]
+                        aliases[top] = top
+            elif isinstance(node, ast.ImportFrom):
+                target = _resolve_relative(self.package, rel,
+                                           node.module, node.level)
+                if target is None:
+                    continue
+                for a in node.names:
+                    if a.name == '*':
+                        continue
+                    local = a.asname or a.name
+                    from_imports[local] = (target, a.name)
+        self.modules[rel] = ModuleInfo(
+            rel=rel, path=path, tree=tree, lines=lines,
+            import_aliases=aliases, from_imports=from_imports,
+            suppressions=_parse_suppressions(lines))
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                info = _class_info(rel, node)
+                self.classes[(rel, node.name)] = info
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        qual = f'{node.name}.{item.name}'
+                        self.functions[(rel, qual)] = FunctionInfo(
+                            rel, qual, item)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                self.functions[(rel, node.name)] = FunctionInfo(
+                    rel, node.name, node)
+
+    # --------------------------------------------------------- queries
+
+    def _dotted_to_rel(self, dotted: str) -> Optional[str]:
+        """'skypilot_tpu.serve.router' -> 'serve/router.py' (None when
+        the dotted path is not a module of this package)."""
+        prefix = self.package + '.'
+        if dotted == self.package:
+            inner = ''
+        elif dotted.startswith(prefix):
+            inner = dotted[len(prefix):].replace('.', '/')
+        else:
+            return None
+        for cand in (f'{inner}.py' if inner else '__init__.py',
+                     f'{inner}/__init__.py' if inner else '__init__.py'):
+            if cand in self.modules:
+                return cand
+        return None
+
+    def resolve_module_alias(self, rel: str, name: str) \
+            -> Optional[str]:
+        """Local `name` in module `rel` -> rel path of the package
+        module it aliases (None for stdlib / third-party)."""
+        mod = self.modules.get(rel)
+        if mod is None:
+            return None
+        dotted = mod.import_aliases.get(name)
+        if dotted is not None:
+            return self._dotted_to_rel(dotted)
+        # `from skypilot_tpu.serve import scheduler` binds a MODULE —
+        # resolved lazily (at parse time the target module may not be
+        # in the index yet).
+        from_import = mod.from_imports.get(name)
+        if from_import is not None:
+            return self._dotted_to_rel(
+                f'{from_import[0]}.{from_import[1]}')
+        return None
+
+    def iter_calls(self, tree: ast.AST) -> Iterator[ast.Call]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                yield node
+
+    def callee_name(self, call: ast.Call) -> Optional[str]:
+        """Trailing name of the called expression ('append' for
+        `x.y.append(...)`, 'jit' for `jit(...)`)."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            return func.id
+        if isinstance(func, ast.Attribute):
+            return func.attr
+        return None

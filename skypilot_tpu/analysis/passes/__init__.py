@@ -1,0 +1,30 @@
+"""The checker passes.  Rule catalog: docs/static-analysis.md."""
+from typing import List
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.passes.bare_print import BarePrintPass
+from skypilot_tpu.analysis.passes.chaos_sites import ChaosSitesPass
+from skypilot_tpu.analysis.passes.concurrency import ConcurrencyPass
+from skypilot_tpu.analysis.passes.env_knobs import EnvKnobsPass
+from skypilot_tpu.analysis.passes.facade_surface import (
+    FacadeSurfacePass)
+from skypilot_tpu.analysis.passes.journal_events import (
+    JournalEventsPass)
+from skypilot_tpu.analysis.passes.metrics_catalog import (
+    MetricsCatalogPass)
+from skypilot_tpu.analysis.passes.tracer_safety import TracerSafetyPass
+
+
+def all_passes() -> List[core.Pass]:
+    """Deterministic order (output sorting does not depend on it, but
+    `--json`'s pass list does)."""
+    return [
+        ConcurrencyPass(),
+        TracerSafetyPass(),
+        EnvKnobsPass(),
+        JournalEventsPass(),
+        MetricsCatalogPass(),
+        ChaosSitesPass(),
+        BarePrintPass(),
+        FacadeSurfacePass(),
+    ]

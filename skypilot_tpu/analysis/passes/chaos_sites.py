@@ -1,0 +1,141 @@
+"""`chaos-site-*`: inject() call sites stay in lockstep with the
+fault registry.
+
+Migrated from tests/unit/test_chaos_sites_lint.py (ISSUE 5 satellite;
+the test is now a thin wrapper).  Every ``inject(...)`` call site must
+pass a *string literal* site name registered in ``chaos/faults.py``
+(a computed site would dodge both this lint and the docs table), every
+registered site must have at least one call site, and each site's
+call sites must live in the layer its prefix documents — the
+docs/chaos.md vocabulary table stays honest.
+
+The registry is read from the AST of chaos/faults.py (``SITES``
+mapping keys), not by importing it — the lint plane never imports
+analyzed code.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import index as index_lib
+
+_FAULTS_MODULE = 'chaos/faults.py'
+
+# site prefix -> layer its call sites must live in (mirrors the
+# docs/chaos.md vocabulary table).
+EXPECTED_LAYER = {
+    'provision.create': ('backends/', 'provision/'),
+    'queued_resource.poll': ('provision/',),
+    'runner.exec': ('utils/',),
+    'gang.rank_exec': ('backends/',),
+    'jobs.status_poll': ('jobs/',),
+    'jobs.recover': ('jobs/',),
+    'serve.replica_probe': ('serve/',),
+    'serve.controller_tick': ('serve/',),
+    'serve.page_pool': ('serve/',),
+    'serve.kv_handoff': ('serve/',),
+    'serve.rank_exec': ('serve/',),
+    'skylet.tick': ('skylet/',),
+    'checkpoint.save': ('data/',),
+}
+
+
+def registered_sites(idx: index_lib.PackageIndex) -> List[str]:
+    """SITES keys from the chaos/faults.py AST (string dict keys of a
+    top-level ``SITES = {...}`` assignment, or ``SITES = (...)``)."""
+    mod = idx.modules.get(_FAULTS_MODULE)
+    if mod is None:
+        return []
+    for node in mod.tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id == 'SITES':
+                value = getattr(node, 'value', None)
+                if isinstance(value, ast.Dict):
+                    return [k.value for k in value.keys
+                            if isinstance(k, ast.Constant) and
+                            isinstance(k.value, str)]
+                if isinstance(value, (ast.List, ast.Tuple, ast.Set)):
+                    return [e.value for e in value.elts
+                            if isinstance(e, ast.Constant) and
+                            isinstance(e.value, str)]
+    return []
+
+
+def inject_call_sites(idx: index_lib.PackageIndex) \
+        -> Tuple[Dict[str, List[Tuple[str, int]]],
+                 List[Tuple[str, int]]]:
+    """(site -> [(file, line)]), plus non-literal inject() sites."""
+    sites: Dict[str, List[Tuple[str, int]]] = {}
+    non_literal: List[Tuple[str, int]] = []
+    for rel, mod in sorted(idx.modules.items()):
+        if rel.startswith('chaos/'):
+            continue  # the subsystem itself, not an instrumented site
+        for call in idx.iter_calls(mod.tree):
+            if idx.callee_name(call) != 'inject':
+                continue
+            if (not call.args or
+                    not isinstance(call.args[0], ast.Constant) or
+                    not isinstance(call.args[0].value, str)):
+                non_literal.append((rel, call.lineno))
+                continue
+            sites.setdefault(call.args[0].value, []).append(
+                (rel, call.lineno))
+    return sites, non_literal
+
+
+class ChaosSitesPass(core.Pass):
+
+    name = 'chaos-sites'
+    rules = ('chaos-site-unregistered', 'chaos-site-computed',
+             'chaos-site-stale', 'chaos-site-misplaced',
+             'chaos-site-unmapped')
+    description = ('inject() sites registered in chaos/faults.py, '
+                   'registered sites instrumented, each in its '
+                   'documented layer')
+
+    def run(self, idx: index_lib.PackageIndex) \
+            -> Iterator[core.Finding]:
+        if _FAULTS_MODULE not in idx.modules:
+            return  # not this package (fixture trees in tests)
+        registered = registered_sites(idx)
+        call_sites, non_literal = inject_call_sites(idx)
+        for rel, line in non_literal:
+            yield core.Finding(
+                'chaos-site-computed', rel, line,
+                'inject() must take a string-literal site name as its '
+                'first argument')
+        for site in sorted(call_sites):
+            if site not in registered:
+                for rel, line in call_sites[site]:
+                    yield core.Finding(
+                        'chaos-site-unregistered', rel, line,
+                        f'site {site!r} is not registered in '
+                        f'chaos/faults.py SITES')
+        for site in sorted(set(registered) - set(call_sites)):
+            yield core.Finding(
+                'chaos-site-stale', _FAULTS_MODULE, 0,
+                f'site {site!r} registered in chaos/faults.py has no '
+                f'inject() call site (remove it or instrument it)')
+        # Layer map drift: the vocabulary changed but EXPECTED_LAYER
+        # (and docs/chaos.md) did not.
+        for site in sorted(set(registered) ^ set(EXPECTED_LAYER)):
+            yield core.Finding(
+                'chaos-site-unmapped', _FAULTS_MODULE, 0,
+                f'site {site!r}: chaos/faults.py SITES and the '
+                f'EXPECTED_LAYER map in analysis/passes/chaos_sites.py '
+                f'disagree — update the map and docs/chaos.md')
+        for site, prefixes in sorted(EXPECTED_LAYER.items()):
+            for rel, line in call_sites.get(site, []):
+                if not rel.startswith(prefixes):
+                    yield core.Finding(
+                        'chaos-site-misplaced', rel, line,
+                        f'site {site!r} must be instrumented under '
+                        f'{"/".join(prefixes)} (docs/chaos.md layer '
+                        f'table)')

@@ -1,0 +1,567 @@
+"""`lock-order` / `blocking-under-lock` / `unlocked-attr`: the
+concurrency race detector.
+
+The serve fleet is a heavily threaded system (router, load balancer,
+engine, page pool, coordinator) whose correctness rests on lock
+discipline no test can exhaustively exercise.  This pass derives the
+lock structure statically:
+
+- **Lock registry.**  Every ``threading.Lock/RLock/Condition/
+  Semaphore`` bound to a class attribute (``self._lock = ...``), a
+  module global, or a function local is a lock identity.
+- **Lock graph / `lock-order`.**  A walker tracks the held-lock stack
+  through each function, one level of attribute-type inference
+  (``self.router = Router()``) plus a transitive-closure fixpoint over
+  the intra-package call graph resolves which locks a call acquires,
+  and every (held -> acquired) pair is an edge.  Cycles in the global
+  edge set — including a plain (non-reentrant) Lock re-acquired while
+  held through any call chain — are ordered-deadlock findings.
+- **`blocking-under-lock`.**  While any lock is held, calls that can
+  block indefinitely or do I/O are flagged: HTTP/sockets
+  (``requests.*``, ``urllib``, ``socket.create_connection``),
+  ``time.sleep``, subprocess spawns, file writes (``open``), journal
+  appends, and JAX device transfers (``jax.device_put/device_get``,
+  ``.block_until_ready()``).  ``Condition.wait`` is exempt — it
+  releases the lock by contract.  Blocking-ness propagates through
+  the call graph, so holding a lock across a helper that journals is
+  flagged at the call site.
+- **`unlocked-attr`.**  In a class that owns locks, an attribute
+  written both under a lock and lock-free (outside ``__init__``) has
+  no consistent guard — the classic lost-update smell.
+
+Findings name the locks and the witness line; intended exceptions are
+suppressed inline with a written reason (no blanket baselines for
+`serve/` — see docs/static-analysis.md).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import index as index_lib
+from skypilot_tpu.analysis.passes.journal_events import _is_journalish
+
+_REENTRANT_FACTORIES = ('RLock', 'Condition')
+_LOCK_RELEASING_WAITS = ('wait', 'wait_for')
+
+# (module alias base, callee) shapes that can block indefinitely or
+# hit I/O.  `None` base = bare-call / any-receiver match.
+_BLOCKING_MODULE_CALLS = {
+    'time': {'sleep'},
+    'requests': None,          # every requests.* call is network I/O
+    'urllib': None,
+    'socket': {'create_connection', 'getaddrinfo', 'gethostbyname'},
+    'subprocess': {'run', 'Popen', 'call', 'check_call',
+                   'check_output'},
+    'os': {'system'},
+    'jax': {'device_put', 'device_get'},
+    'shutil': {'copy', 'copy2', 'copytree', 'move', 'rmtree'},
+}
+_BLOCKING_ATTR_CALLS = {'block_until_ready'}
+_BLOCKING_BARE_CALLS = {'open'}
+
+
+@dataclasses.dataclass(frozen=True)
+class Lock:
+    lock_id: str          # 'serve/router.py::Router._lock'
+    reentrant: bool
+
+
+@dataclasses.dataclass
+class _FnFacts:
+    """Per-function facts feeding the interprocedural fixpoint."""
+    key: Tuple[str, str]
+    acquires: Set[str]                     # locks taken anywhere in fn
+    blocking: List[Tuple[int, str]]        # (line, what) direct blocks
+    callees: Set[Tuple[str, str]]          # resolved package callees
+    # (held lock, acquired lock, line) edges from direct nesting.
+    edges: List[Tuple[str, str, int]]
+    # (held locks, line, callee key) — call made while locks held.
+    held_calls: List[Tuple[Tuple[str, ...], int, Tuple[str, str]]]
+    # (held locks, line, what) — direct blocking while locks held.
+    held_blocking: List[Tuple[Tuple[str, ...], int, str]]
+    # attr writes: attr -> [(line, locked?)]   (methods only)
+    attr_writes: Dict[str, List[Tuple[int, bool]]]
+
+
+def _call_base_name(call: ast.Call) -> Optional[str]:
+    """'requests' for requests.post(...), 'time' for time.sleep(...);
+    walks chains ('urllib' for urllib.request.urlopen)."""
+    node = call.func
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _blocking_kind(idx: index_lib.PackageIndex, rel: str,
+                   call: ast.Call) -> Optional[str]:
+    callee = idx.callee_name(call)
+    if callee is None:
+        return None
+    if isinstance(call.func, ast.Name):
+        if callee in _BLOCKING_BARE_CALLS:
+            return f'{callee}() file I/O'
+        # `from time import sleep`-style direct imports.
+        mod = idx.modules[rel].from_imports.get(callee)
+        if mod is not None:
+            base, attr = mod[0].split('.')[0], mod[1]
+            allowed = _BLOCKING_MODULE_CALLS.get(base)
+            if allowed is None and base in _BLOCKING_MODULE_CALLS:
+                return f'{base}.{attr}()'
+            if allowed and attr in allowed:
+                return f'{base}.{attr}()'
+        return None
+    if callee in _BLOCKING_ATTR_CALLS:
+        return f'.{callee}() device sync'
+    base = _call_base_name(call)
+    if base is not None:
+        # Resolve `req_lib.post` style aliases back to the module.
+        dotted = idx.modules[rel].import_aliases.get(base, base)
+        top = dotted.split('.')[0]
+        allowed = _BLOCKING_MODULE_CALLS.get(top)
+        if top in _BLOCKING_MODULE_CALLS and (
+                allowed is None or callee in allowed):
+            return f'{top}.{callee}()'
+    if (callee == 'append' and
+            isinstance(call.func, ast.Attribute) and
+            _is_journalish(call.func.value)):
+        return 'journal append (file I/O)'
+    return None
+
+
+class _FnWalker(ast.NodeVisitor):
+    """Walks one function body tracking the held-lock stack."""
+
+    def __init__(self, idx: index_lib.PackageIndex, rel: str,
+                 cls: Optional[index_lib.ClassInfo],
+                 method_name: str,
+                 locks: Dict[str, Lock],
+                 module_locks: Dict[str, str],
+                 attr_types: Dict[Tuple[str, str],
+                                  Tuple[str, str]]) -> None:
+        self.idx = idx
+        self.rel = rel
+        self.cls = cls
+        self.method_name = method_name
+        self.locks = locks
+        self.module_locks = module_locks
+        self.attr_types = attr_types
+        self.local_locks: Dict[str, str] = {}
+        self.held: List[str] = []
+        self.facts = _FnFacts(
+            key=(rel, (f'{cls.name}.{method_name}' if cls
+                       else method_name)),
+            acquires=set(), blocking=[], callees=set(), edges=[],
+            held_calls=[], held_blocking=[], attr_writes={})
+
+    # -------------------------------------------------- lock identity
+
+    def _lock_of(self, expr: ast.AST) -> Optional[str]:
+        if (isinstance(expr, ast.Attribute) and
+                isinstance(expr.value, ast.Name) and
+                expr.value.id == 'self' and self.cls is not None and
+                expr.attr in self.cls.lock_attrs):
+            return f'{self.rel}::{self.cls.name}.{expr.attr}'
+        if isinstance(expr, ast.Name):
+            if expr.id in self.local_locks:
+                return self.local_locks[expr.id]
+            if expr.id in self.module_locks:
+                return self.module_locks[expr.id]
+        return None
+
+    # ------------------------------------------------------- visitors
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # Nested defs execute later with an empty held stack; their
+        # bodies are still part of this function's facts (closures run
+        # on the same objects), so walk them with the stack cleared.
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def _enter_locks(self, node) -> List[str]:
+        entered: List[str] = []
+        for item in node.items:
+            lock = self._lock_of(item.context_expr)
+            if lock is None:
+                continue
+            self.facts.acquires.add(lock)
+            for held in self.held:
+                self.facts.edges.append((held, lock,
+                                         item.context_expr.lineno))
+            self.held.append(lock)
+            entered.append(lock)
+        return entered
+
+    def visit_With(self, node: ast.With) -> None:
+        entered = self._enter_locks(node)
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in entered:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        value = node.value
+        for tgt in node.targets:
+            if (isinstance(tgt, ast.Name) and
+                    index_lib._is_lock_factory(value)):
+                self.local_locks[tgt.id] = (
+                    f'{self.rel}::{self.facts.key[1]}.{tgt.id}')
+            self._record_attr_write(tgt, node.lineno)
+        self.visit(value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_attr_write(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._record_attr_write(node.target, node.lineno)
+        if node.value is not None:
+            self.visit(node.value)
+
+    def _record_attr_write(self, tgt: ast.AST, line: int) -> None:
+        if (isinstance(tgt, ast.Attribute) and
+                isinstance(tgt.value, ast.Name) and
+                tgt.value.id == 'self' and self.cls is not None):
+            self.facts.attr_writes.setdefault(tgt.attr, []).append(
+                (line, bool(self.held)))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        callee_key = self._resolve_callee(node)
+        if callee_key is not None:
+            self.facts.callees.add(callee_key)
+            if self.held:
+                self.facts.held_calls.append(
+                    (tuple(self.held), node.lineno, callee_key))
+        kind = None
+        if not self._is_lock_releasing_wait(node):
+            kind = _blocking_kind(self.idx, self.rel, node)
+        if kind is not None:
+            self.facts.blocking.append((node.lineno, kind))
+            if self.held:
+                self.facts.held_blocking.append(
+                    (tuple(self.held), node.lineno, kind))
+        self.generic_visit(node)
+
+    def _is_lock_releasing_wait(self, call: ast.Call) -> bool:
+        """cond.wait()/wait_for() releases the held condition lock."""
+        return (isinstance(call.func, ast.Attribute) and
+                call.func.attr in _LOCK_RELEASING_WAITS)
+
+    def _resolve_callee(self, call: ast.Call) \
+            -> Optional[Tuple[str, str]]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            key = (self.rel, func.id)
+            return key if key in self.idx.functions else None
+        if not isinstance(func, ast.Attribute):
+            return None
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id == 'self' and self.cls is not None:
+                key = (self.rel, f'{self.cls.name}.{func.attr}')
+                return key if key in self.idx.functions else None
+            target = self.idx.resolve_module_alias(self.rel, base.id)
+            if target is not None:
+                key = (target, func.attr)
+                return key if key in self.idx.functions else None
+        if (isinstance(base, ast.Attribute) and
+                isinstance(base.value, ast.Name) and
+                base.value.id == 'self' and self.cls is not None):
+            typed = self.attr_types.get((self.cls.name, base.attr))
+            if typed is not None:
+                key = (typed[0], f'{typed[1]}.{func.attr}')
+                return key if key in self.idx.functions else None
+        return None
+
+
+def _module_locks(idx: index_lib.PackageIndex, rel: str) \
+        -> Dict[str, Tuple[str, bool]]:
+    """top-level `name = threading.Lock()` -> (lock_id, reentrant)."""
+    out: Dict[str, Tuple[str, bool]] = {}
+    for node in idx.modules[rel].tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not index_lib._is_lock_factory(node.value):
+            continue
+        factory = node.value.func
+        name = (factory.attr if isinstance(factory, ast.Attribute)
+                else factory.id)
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = (f'{rel}::{tgt.id}',
+                               name in _REENTRANT_FACTORIES)
+    return out
+
+
+def _lock_registry(idx: index_lib.PackageIndex) -> Dict[str, Lock]:
+    """Every class-attr and module-global lock in the package."""
+    locks: Dict[str, Lock] = {}
+    for (rel, cname), cls in sorted(idx.classes.items()):
+        for attr in cls.lock_attrs:
+            reentrant = _attr_lock_reentrant(cls, attr)
+            lid = f'{rel}::{cname}.{attr}'
+            locks[lid] = Lock(lid, reentrant)
+    for rel in sorted(idx.modules):
+        for _, (lid, reentrant) in _module_locks(idx, rel).items():
+            locks[lid] = Lock(lid, reentrant)
+    return locks
+
+
+def _attr_lock_reentrant(cls: index_lib.ClassInfo, attr: str) -> bool:
+    for item in ast.walk(cls.node):
+        if not isinstance(item, ast.Assign):
+            continue
+        if not index_lib._is_lock_factory(item.value):
+            continue
+        for tgt in item.targets:
+            if (isinstance(tgt, ast.Attribute) and
+                    tgt.attr == attr):
+                factory = item.value.func
+                name = (factory.attr
+                        if isinstance(factory, ast.Attribute)
+                        else factory.id)
+                return name in _REENTRANT_FACTORIES
+    return False
+
+
+def _attr_types(idx: index_lib.PackageIndex, rel: str) \
+        -> Dict[Tuple[str, str], Tuple[str, str]]:
+    """(ClassName, attr) -> (rel, AttrClassName) for ``self.attr =
+    SomeClass(...)`` / ``x or SomeClass(...)`` inits, intra-package."""
+    out: Dict[Tuple[str, str], Tuple[str, str]] = {}
+
+    def class_of(value: ast.AST) -> Optional[Tuple[str, str]]:
+        if isinstance(value, ast.BoolOp):
+            for operand in value.values:
+                got = class_of(operand)
+                if got is not None:
+                    return got
+            return None
+        if not isinstance(value, ast.Call):
+            return None
+        func = value.func
+        if isinstance(func, ast.Name):
+            key = (rel, func.id)
+            return key if key in idx.classes else None
+        if (isinstance(func, ast.Attribute) and
+                isinstance(func.value, ast.Name)):
+            target = idx.resolve_module_alias(rel, func.value.id)
+            if target is not None and \
+                    (target, func.attr) in idx.classes:
+                return (target, func.attr)
+        return None
+
+    for (crel, cname), cls in idx.classes.items():
+        if crel != rel:
+            continue
+        for item in ast.walk(cls.node):
+            if not isinstance(item, ast.Assign):
+                continue
+            got = class_of(item.value)
+            if got is None:
+                continue
+            for tgt in item.targets:
+                if (isinstance(tgt, ast.Attribute) and
+                        isinstance(tgt.value, ast.Name) and
+                        tgt.value.id == 'self'):
+                    out[(cname, tgt.attr)] = got
+    return out
+
+
+class ConcurrencyPass(core.Pass):
+
+    name = 'concurrency'
+    rules = ('lock-order', 'blocking-under-lock', 'unlocked-attr')
+    description = ('lock-acquisition cycle detection, blocking calls '
+                   'under a held lock, attributes with inconsistent '
+                   'lock guards')
+
+    def run(self, idx: index_lib.PackageIndex) \
+            -> Iterator[core.Finding]:
+        locks = _lock_registry(idx)
+        facts: Dict[Tuple[str, str], _FnFacts] = {}
+        for rel in sorted(idx.modules):
+            module_locks = {name: lid for name, (lid, _)
+                            in _module_locks(idx, rel).items()}
+            attr_types = _attr_types(idx, rel)
+            for (frel, qual), fn in sorted(idx.functions.items()):
+                if frel != rel:
+                    continue
+                cls = None
+                method = qual
+                if '.' in qual:
+                    cname, method = qual.split('.', 1)
+                    cls = idx.classes.get((rel, cname))
+                walker = _FnWalker(idx, rel, cls, method, locks,
+                                   module_locks, attr_types)
+                node = fn.node
+                for stmt in getattr(node, 'body', []):
+                    walker.visit(stmt)
+                facts[(rel, qual)] = walker.facts
+
+        # ---- fixpoint: transitive lock sets + blocking-ness.
+        all_locks: Dict[Tuple[str, str], Set[str]] = {
+            k: set(f.acquires) for k, f in facts.items()}
+        blocks: Dict[Tuple[str, str], bool] = {
+            k: bool(f.blocking) for k, f in facts.items()}
+        changed = True
+        while changed:
+            changed = False
+            for k, f in facts.items():
+                for callee in f.callees:
+                    if callee not in facts:
+                        continue
+                    extra = all_locks[callee] - all_locks[k]
+                    if extra:
+                        all_locks[k] |= extra
+                        changed = True
+                    if blocks[callee] and not blocks[k]:
+                        blocks[k] = True
+                        changed = True
+
+        # ---- edges: direct nesting + locks acquired via calls.
+        edges: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+        for (rel, _), f in sorted(facts.items()):
+            for held, acquired, line in f.edges:
+                edges.setdefault((held, acquired), []).append(
+                    (rel, line))
+            for held_stack, line, callee in f.held_calls:
+                for acquired in sorted(all_locks.get(callee, ())):
+                    for held in held_stack:
+                        edges.setdefault((held, acquired),
+                                         []).append((rel, line))
+
+        yield from self._cycle_findings(locks, edges)
+
+        # ---- blocking under lock (direct + via callee).
+        for (rel, qual), f in sorted(facts.items()):
+            for held_stack, line, kind in f.held_blocking:
+                yield core.Finding(
+                    'blocking-under-lock', rel, line,
+                    f'{kind} while holding '
+                    f'{_short(held_stack[-1])} (in {qual})')
+            for held_stack, line, callee in f.held_calls:
+                if blocks.get(callee):
+                    yield core.Finding(
+                        'blocking-under-lock', rel, line,
+                        f'call to {callee[1]} (which does blocking '
+                        f'I/O) while holding '
+                        f'{_short(held_stack[-1])} (in {qual})')
+
+        # ---- unlocked-attr.
+        writes: Dict[Tuple[str, str, str],
+                     Dict[bool, List[Tuple[str, int]]]] = {}
+        for (rel, qual), f in sorted(facts.items()):
+            if '.' not in qual:
+                continue
+            cname, method = qual.split('.', 1)
+            cls = idx.classes.get((rel, cname))
+            if cls is None or not cls.lock_attrs:
+                continue
+            if method in ('__init__', '__post_init__'):
+                continue
+            for attr, sites in f.attr_writes.items():
+                if attr in cls.lock_attrs:
+                    continue
+                slot = writes.setdefault((rel, cname, attr),
+                                         {True: [], False: []})
+                for line, locked in sites:
+                    slot[locked].append((method, line))
+        for (rel, cname, attr), slot in sorted(writes.items()):
+            if slot[True] and slot[False]:
+                method, line = slot[False][0]
+                locked_method, locked_line = slot[True][0]
+                yield core.Finding(
+                    'unlocked-attr', rel, line,
+                    f'{cname}.{attr} is written lock-free in '
+                    f'{method} (line {line}) but under a lock in '
+                    f'{locked_method} (line {locked_line}) — pick '
+                    f'one guard')
+
+    def _cycle_findings(self, locks: Dict[str, Lock],
+                        edges: Dict[Tuple[str, str],
+                                    List[Tuple[str, int]]]) \
+            -> Iterator[core.Finding]:
+        # Self-edges: re-acquiring a non-reentrant lock while held is
+        # an unconditional deadlock, no cycle search needed.
+        graph: Dict[str, Set[str]] = {}
+        for (a, b), sites in sorted(edges.items()):
+            if a == b:
+                lock = locks.get(a)
+                if lock is None or lock.reentrant:
+                    continue
+                rel, line = sorted(sites)[0]
+                yield core.Finding(
+                    'lock-order', rel, line,
+                    f'non-reentrant {_short(a)} re-acquired while '
+                    f'already held — unconditional deadlock')
+                continue
+            graph.setdefault(a, set()).add(b)
+        # Cross-lock cycles: report every edge inside a strongly
+        # connected component.
+        for component in _sccs(graph):
+            if len(component) < 2:
+                continue
+            members = set(component)
+            order = ' -> '.join(_short(lid)
+                                for lid in sorted(members))
+            for (a, b), sites in sorted(edges.items()):
+                if a in members and b in members and a != b:
+                    rel, line = sorted(sites)[0]
+                    yield core.Finding(
+                        'lock-order', rel, line,
+                        f'lock-order cycle [{order}]: {_short(a)} '
+                        f'held while acquiring {_short(b)} here, and '
+                        f'the reverse order exists elsewhere')
+
+
+def _short(lock_id: str) -> str:
+    return lock_id.split('::', 1)[-1]
+
+
+def _sccs(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Tarjan, iterative enough for our graph sizes (recursion fine:
+    lock graphs are tiny)."""
+    indices: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        indices[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in sorted(graph.get(v, ())):
+            if w not in indices:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], indices[w])
+        if low[v] == indices[v]:
+            comp: List[str] = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            out.append(comp)
+
+    for v in sorted(set(graph) |
+                    {w for ws in graph.values() for w in ws}):
+        if v not in indices:
+            strongconnect(v)
+    return out

@@ -1,0 +1,115 @@
+"""`env-*`: every SKYTPU_* knob the code reads is documented, and
+every documented knob still exists in code.
+
+The knob registry is docs/environment-variables.md: a backticked
+``SKYTPU_*`` name in the FIRST cell of a markdown table row documents
+that knob.  Code side, any string literal that IS a ``SKYTPU_*`` name
+counts as a reference — read sites (`os.environ.get`), export sites
+(the skylet contract builds the env it ships to ranks), and default
+maps all pin the name the same way, and a knob that exists only as an
+export is still part of the user-facing contract.
+
+Directionality is asymmetric on purpose:
+
+- code -> docs runs over the package only: a knob the package
+  references must be documented.
+- docs -> code also accepts references under ``tests/`` and the
+  top-level ``bench*.py`` drivers: a knob like the tier-1 wall-clock
+  budget is consumed by the test harness, not the package, but its
+  doc row is not stale.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from typing import Dict, Iterator, List, Set, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import index as index_lib
+from skypilot_tpu.analysis.passes import metrics_catalog
+
+_DOC = 'environment-variables.md'
+_NAME_RE = re.compile(r'^SKYTPU_[A-Z0-9_]+$')
+_DOC_NAME_RE = re.compile(r'`(SKYTPU_[A-Z0-9_]+)`')
+
+
+def package_references(idx: index_lib.PackageIndex) \
+        -> Dict[str, List[Tuple[str, int]]]:
+    """knob name -> [(file, line)] for every SKYTPU_* string literal
+    in the package."""
+    refs: Dict[str, List[Tuple[str, int]]] = {}
+    for rel, mod in sorted(idx.modules.items()):
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Constant) and
+                    isinstance(node.value, str) and
+                    _NAME_RE.match(node.value)):
+                refs.setdefault(node.value, []).append(
+                    (rel, node.lineno))
+    return refs
+
+
+def harness_references(idx: index_lib.PackageIndex) -> Set[str]:
+    """SKYTPU_* literals in tests/ and bench*.py (docs->code
+    direction only; parse failures in a test file are its own
+    test run's problem, not lint's)."""
+    repo = idx.root.parent
+    names: Set[str] = set()
+    paths: List[pathlib.Path] = sorted(
+        list((repo / 'tests').rglob('*.py')) +
+        list(repo.glob('bench*.py')))
+    for path in paths:
+        if '__pycache__' in path.as_posix():
+            continue
+        try:
+            tree = ast.parse(path.read_text(encoding='utf-8'))
+        except (SyntaxError, OSError):
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Constant) and
+                    isinstance(node.value, str) and
+                    _NAME_RE.match(node.value)):
+                names.add(node.value)
+    return names
+
+
+def documented_knobs(doc_dir: pathlib.Path) -> Set[str]:
+    doc = (doc_dir / _DOC).read_text(encoding='utf-8')
+    names: Set[str] = set()
+    for line in doc.splitlines():
+        if not line.startswith('|'):
+            continue
+        cells = line.split('|')
+        if len(cells) < 2:
+            continue
+        names.update(_DOC_NAME_RE.findall(cells[1]))
+    return names
+
+
+class EnvKnobsPass(core.Pass):
+
+    name = 'env-knobs'
+    rules = ('env-undocumented', 'env-stale-doc')
+    description = ('SKYTPU_* knobs registered in '
+                   'docs/environment-variables.md, both directions')
+
+    def run(self, idx: index_lib.PackageIndex) \
+            -> Iterator[core.Finding]:
+        doc_dir = metrics_catalog.docs_root(idx)
+        if doc_dir is None or not (doc_dir / _DOC).is_file():
+            return
+        refs = package_references(idx)
+        documented = documented_knobs(doc_dir)
+        for name in sorted(set(refs) - documented):
+            rel, line = refs[name][0]
+            yield core.Finding(
+                'env-undocumented', rel, line,
+                f'env knob {name!r} is not documented in docs/{_DOC} '
+                f'(add a table row)')
+        known = set(refs) | harness_references(idx)
+        for name in sorted(documented - known):
+            yield core.Finding(
+                'env-stale-doc', 'skylet/constants.py', 0,
+                f'docs/{_DOC} documents {name!r} but nothing in the '
+                f'package, tests/, or bench drivers references it '
+                f'(delete the row or restore the knob)')

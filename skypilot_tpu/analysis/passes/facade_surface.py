@@ -1,0 +1,127 @@
+"""`facade-*`: serve/batching_engine.py re-exports exactly the public
+surface of the engine's three parts.
+
+PR 7 split the continuous-batching engine into scheduler.py /
+cache_manager.py / sampler.py and left batching_engine.py as the
+compatibility facade.  A facade drifts silently: a class added to
+scheduler.py is invisible to facade importers until someone notices,
+and a renamed one leaves a stale re-export that fails only at import
+time of the one module that still uses it.  This pass pins both
+directions, from the ASTs alone:
+
+- `facade-missing`: a public top-level name of a part module with no
+  same-name ``X = <part>.X`` re-export in the facade.
+- `facade-stale`: a facade re-export ``Y = <part>.X`` (any Y,
+  including the underscore compat aliases) naming an X that no longer
+  exists at the part's top level.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import index as index_lib
+
+FACADE = 'serve/batching_engine.py'
+PARTS = ('serve/scheduler.py', 'serve/cache_manager.py',
+         'serve/sampler.py')
+# Module plumbing every part defines for itself — not facade surface.
+_NOT_SURFACE = {'logger'}
+
+
+def public_surface(idx: index_lib.PackageIndex, rel: str) -> Set[str]:
+    """Public top-level defs of one module (classes, functions,
+    constants; imports and underscore names excluded)."""
+    mod = idx.modules[rel]
+    names: Set[str] = set()
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            names.update(t.id for t in node.targets
+                         if isinstance(t, ast.Name))
+        elif (isinstance(node, ast.AnnAssign) and
+              isinstance(node.target, ast.Name)):
+            names.add(node.target.id)
+    return {n for n in names
+            if not n.startswith('_') and n not in _NOT_SURFACE}
+
+
+def facade_reexports(idx: index_lib.PackageIndex) \
+        -> List[Tuple[str, str, str, int]]:
+    """[(local_name, part_rel, part_attr, line)] for every top-level
+    ``name = <part_alias>.attr`` in the facade."""
+    mod = idx.modules[FACADE]
+    out: List[Tuple[str, str, str, int]] = []
+    for node in mod.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not (isinstance(value, ast.Attribute) and
+                isinstance(value.value, ast.Name)):
+            continue
+        part = idx.resolve_module_alias(FACADE, value.value.id)
+        if part not in PARTS:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out.append((tgt.id, part, value.attr, node.lineno))
+    return out
+
+
+class FacadeSurfacePass(core.Pass):
+
+    name = 'facade-surface'
+    rules = ('facade-missing', 'facade-stale')
+    description = ('batching_engine facade re-exports the full public '
+                   'surface of scheduler + cache_manager + sampler, '
+                   'nothing stale')
+
+    def run(self, idx: index_lib.PackageIndex) \
+            -> Iterator[core.Finding]:
+        if FACADE not in idx.modules:
+            return
+        reexports = facade_reexports(idx)
+        same_name: Dict[str, Set[str]] = {}
+        for local, part, attr, _ in reexports:
+            if local == attr:
+                same_name.setdefault(part, set()).add(local)
+        for part in PARTS:
+            if part not in idx.modules:
+                continue
+            surface = public_surface(idx, part)
+            for name in sorted(surface -
+                               same_name.get(part, set())):
+                yield core.Finding(
+                    'facade-missing', FACADE, 0,
+                    f'public name {part}:{name} is not re-exported '
+                    f'by the facade (add `{name} = '
+                    f'{part.rsplit("/", 1)[-1][:-3]}.{name}`)')
+        for local, part, attr, line in sorted(
+                set(reexports), key=lambda r: (r[3], r[0])):
+            if (part in idx.modules and
+                    attr not in _all_top_level(idx, part)):
+                yield core.Finding(
+                    'facade-stale', FACADE, line,
+                    f'facade re-export {local} = ...{attr} names an '
+                    f'attribute {part} no longer defines')
+
+
+def _all_top_level(idx: index_lib.PackageIndex, rel: str) -> Set[str]:
+    """Every top-level binding (incl. underscore names): staleness is
+    about existence, not publicness."""
+    mod = idx.modules[rel]
+    names: Set[str] = set()
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            names.update(t.id for t in node.targets
+                         if isinstance(t, ast.Name))
+        elif (isinstance(node, ast.AnnAssign) and
+              isinstance(node.target, ast.Name)):
+            names.add(node.target.id)
+    return names

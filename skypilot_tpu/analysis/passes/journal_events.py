@@ -1,0 +1,288 @@
+"""`journal-*`: every journal event name the code can emit is in the
+docs/observability.md vocabulary table, and vice versa.
+
+The flight-recorder journals (observability/events.py) are the
+verification substrate for the chaos invariants — an event the docs
+don't name is telemetry nobody can replay deliberately, and a
+documented event nothing emits is a vocabulary lie.  Emission sites
+come in three shapes, all resolved from the ASTs:
+
+1. **direct appends** — ``<journalish>.append('name', ...)`` where the
+   receiver expression mentions ``journal`` (``journal.append``,
+   ``self._journal.append``, ``chaos_journal().append``,
+   ``events_lib.get_journal(...).append``).  List ``.append`` never
+   matches: lists aren't named journal.
+2. **wrappers** — a function whose body forwards its own first
+   parameter into a journalish append (``def _journal_drain(event,
+   **f): _serve_journal().append(event, **f)``); its call sites with a
+   string-literal first argument emit that name.  A wrapper appending
+   ``f'{param}_start'`` emits ``<literal>_start`` per call site.
+3. **ControlSpan** — ``ControlSpan(journal, 'name')`` (and a
+   journalish ``.span('name')``) emits ``name_start`` + ``name_end``.
+
+A name argument that is a local variable resolves when every
+module-level assignment to it is a literal (or a conditional between
+literals: ``'launch' if ... else 'exec'``).  Anything else is its own
+`journal-computed-name` finding: make it a literal, or suppress with
+a reason naming the events it can produce — and document those.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import index as index_lib
+from skypilot_tpu.analysis.passes import metrics_catalog
+
+_DOC = 'observability.md'
+_SECTION = '### Journal event vocabulary'
+_EVENT_RE = re.compile(r'`([a-z][a-z0-9_]*)`')
+
+
+def _is_journalish(expr: ast.AST) -> bool:
+    try:
+        text = ast.unparse(expr)
+    except Exception:  # pylint: disable=broad-except
+        return False
+    return 'journal' in text.lower()
+
+
+def _literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _fstring_suffix(node: ast.AST) -> Optional[Tuple[str, str]]:
+    """``f'{name}_start'`` -> ('name', '_start'); None otherwise."""
+    if not isinstance(node, ast.JoinedStr) or len(node.values) != 2:
+        return None
+    fmt, tail = node.values
+    if not (isinstance(fmt, ast.FormattedValue) and
+            isinstance(fmt.value, ast.Name)):
+        return None
+    suffix = _literal_str(tail)
+    if suffix is None:
+        return None
+    return fmt.value.id, suffix
+
+
+def _resolve_literals(arg: ast.AST,
+                      mod: index_lib.ModuleInfo) -> Optional[List[str]]:
+    """Possible literal values of an event-name argument: a literal, a
+    conditional between literals, or a variable whose every assignment
+    in the module is one of those.  None = computed."""
+    lit = _literal_str(arg)
+    if lit is not None:
+        return [lit]
+    if isinstance(arg, ast.IfExp):
+        body = _resolve_literals(arg.body, mod)
+        orelse = _resolve_literals(arg.orelse, mod)
+        if body is not None and orelse is not None:
+            return sorted(set(body + orelse))
+        return None
+    if isinstance(arg, ast.Name):
+        values: List[str] = []
+        assigned = False
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(isinstance(t, ast.Name) and t.id == arg.id
+                       for t in node.targets):
+                continue
+            assigned = True
+            sub = _resolve_literals(node.value, mod)
+            if sub is None:
+                return None
+            values.extend(sub)
+        return sorted(set(values)) if assigned else None
+    return None
+
+
+class _Emitter:
+    """How a wrapper's first argument maps to event names: the name
+    itself (suffixes None) or ``<name><suffix>`` per suffix."""
+
+    def __init__(self, param: str,
+                 suffixes: Optional[List[str]] = None) -> None:
+        self.param = param
+        self.suffixes = suffixes
+
+
+def collect_events(idx: index_lib.PackageIndex) \
+        -> Tuple[Dict[str, List[Tuple[str, int]]],
+                 List[Tuple[str, int, str]]]:
+    """(event -> [(file, line)], [(file, line, why)] computed names)."""
+    events: Dict[str, List[Tuple[str, int]]] = {}
+    computed: List[Tuple[str, int, str]] = []
+
+    def emit(name: str, rel: str, line: int) -> None:
+        events.setdefault(name, []).append((rel, line))
+
+    # ---- pass 1: wrapper functions (first param -> journal append).
+    # The append nodes that *define* a wrapper are remembered so pass 2
+    # does not re-flag them as computed names.
+    wrappers: Dict[Tuple[str, str], _Emitter] = {}
+    wrapper_sinks: Set[int] = set()
+    for (rel, qual), fn in sorted(idx.functions.items()):
+        node = fn.node
+        args = node.args
+        params = [a.arg for a in args.posonlyargs + args.args
+                  if a.arg not in ('self', 'cls')]
+        if not params:
+            continue
+        first = params[0]
+        suffixes: List[str] = []
+        direct = False
+        sinks: List[int] = []
+        for call in idx.iter_calls(node):
+            if (idx.callee_name(call) != 'append' or not call.args or
+                    not isinstance(call.func, ast.Attribute) or
+                    not _is_journalish(call.func.value)):
+                continue
+            arg0 = call.args[0]
+            if isinstance(arg0, ast.Name) and arg0.id == first:
+                direct = True
+                sinks.append(id(call))
+            else:
+                fs = _fstring_suffix(arg0)
+                if fs is not None and fs[0] == first:
+                    suffixes.append(fs[1])
+                    sinks.append(id(call))
+        if direct or suffixes:
+            wrappers[(rel, qual)] = _Emitter(
+                first, None if direct else sorted(set(suffixes)))
+            wrapper_sinks.update(sinks)
+
+    def emit_arg(arg: ast.AST, em: Optional[_Emitter], rel: str,
+                 line: int, mod: index_lib.ModuleInfo,
+                 what: str) -> None:
+        lits = _resolve_literals(arg, mod)
+        if lits is None:
+            computed.append((rel, line,
+                             f'{what} event name is not resolvable to '
+                             f'string literals'))
+            return
+        suffixes = em.suffixes if em is not None else None
+        for lit in lits:
+            if suffixes is None:
+                emit(lit, rel, line)
+            else:
+                for sfx in suffixes:
+                    emit(lit + sfx, rel, line)
+
+    # ---- pass 2: every call site, walked per function so self-calls
+    # resolve against the ENCLOSING class (a `_record` wrapper in one
+    # class must not capture `self._record` of another).
+    for (rel, qual), fn in sorted(idx.functions.items()):
+        mod = idx.modules[rel]
+        cls_name = qual.split('.', 1)[0] if '.' in qual else None
+        for call in idx.iter_calls(fn.node):
+            callee = idx.callee_name(call)
+            if callee == 'append':
+                if (id(call) in wrapper_sinks or not call.args or
+                        not isinstance(call.func, ast.Attribute) or
+                        not _is_journalish(call.func.value)):
+                    continue
+                fs = _fstring_suffix(call.args[0])
+                if fs is not None:
+                    # f'{x}_start' outside a wrapper: resolve x from
+                    # module assignments.
+                    lits = _resolve_literals(
+                        ast.Name(id=fs[0], ctx=ast.Load()), mod)
+                    if lits is None:
+                        computed.append(
+                            (rel, call.lineno,
+                             'journal append event name is not '
+                             'resolvable to string literals'))
+                    else:
+                        for lit in lits:
+                            emit(lit + fs[1], rel, call.lineno)
+                    continue
+                emit_arg(call.args[0], None, rel, call.lineno, mod,
+                         'journal append')
+            elif callee == 'ControlSpan':
+                if len(call.args) < 2:
+                    continue
+                emit_arg(call.args[1], _Emitter('', ['_start', '_end']),
+                         rel, call.lineno, mod, 'ControlSpan')
+            elif callee == 'span':
+                if (not call.args or
+                        not isinstance(call.func, ast.Attribute) or
+                        not _is_journalish(call.func.value)):
+                    continue
+                emit_arg(call.args[0], _Emitter('', ['_start', '_end']),
+                         rel, call.lineno, mod, 'journal span')
+            elif callee is not None:
+                em = None
+                if isinstance(call.func, ast.Name):
+                    em = wrappers.get((rel, callee))
+                elif (isinstance(call.func, ast.Attribute) and
+                      isinstance(call.func.value, ast.Name)):
+                    base = call.func.value.id
+                    if base == 'self' and cls_name is not None:
+                        em = wrappers.get((rel,
+                                           f'{cls_name}.{callee}'))
+                    else:
+                        # module-alias call into another module's
+                        # wrapper (controller.py journaling through
+                        # replica_managers._journal_drain).
+                        target = idx.resolve_module_alias(rel, base)
+                        if target is not None:
+                            em = wrappers.get((target, callee))
+                if em is None or not call.args:
+                    continue
+                emit_arg(call.args[0], em, rel, call.lineno, mod,
+                         f'{callee}()')
+    return events, computed
+
+
+def documented_events(doc_dir) -> Set[str]:
+    """Backticked event names in the FIRST cell of the vocabulary
+    section's table rows (prose in other cells never registers)."""
+    doc = (doc_dir / _DOC).read_text(encoding='utf-8')
+    names: Set[str] = set()
+    in_section = False
+    for line in doc.splitlines():
+        if line.startswith('#'):
+            in_section = line.strip() == _SECTION
+            continue
+        if in_section and line.startswith('|'):
+            cells = line.split('|')
+            if len(cells) >= 2:
+                names.update(_EVENT_RE.findall(cells[1]))
+    return names
+
+
+class JournalEventsPass(core.Pass):
+
+    name = 'journal-events'
+    rules = ('journal-undocumented', 'journal-stale-doc',
+             'journal-computed-name')
+    description = ('journal event vocabulary matches '
+                   'docs/observability.md, both directions; computed '
+                   'event names flagged')
+
+    def run(self, idx: index_lib.PackageIndex) \
+            -> Iterator[core.Finding]:
+        doc_dir = metrics_catalog.docs_root(idx)
+        if doc_dir is None or not (doc_dir / _DOC).is_file():
+            return
+        events, computed = collect_events(idx)
+        for rel, line, why in sorted(set(computed)):
+            yield core.Finding('journal-computed-name', rel, line, why)
+        documented = documented_events(doc_dir)
+        for name in sorted(set(events) - documented):
+            rel, line = events[name][0]
+            yield core.Finding(
+                'journal-undocumented', rel, line,
+                f'journal event {name!r} is not in the docs/{_DOC} '
+                f'vocabulary table (add a row)')
+        for name in sorted(documented - set(events)):
+            yield core.Finding(
+                'journal-stale-doc', 'observability/events.py', 0,
+                f'docs/{_DOC} vocabulary names event {name!r} that '
+                f'no code emits (delete the row or restore the '
+                f'emitter)')

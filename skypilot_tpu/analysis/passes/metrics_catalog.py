@@ -1,0 +1,94 @@
+"""`metrics-*`: the docs/observability.md catalog stays in lockstep
+with the instruments the code registers.
+
+Migrated from tests/unit/test_metrics_catalog_lint.py (ISSUE 11
+satellite; the test is now a thin wrapper).  Every ``skytpu_*``
+instrument registered anywhere in the package (a string-literal first
+argument to a ``counter``/``gauge``/``histogram`` constructor) must
+appear in the catalog tables (a backticked name in the first cell of
+a markdown table row), and every catalog row must name a series that
+still exists in code — no undocumented telemetry, no stale catalog
+entries, in either direction.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import index as index_lib
+
+_CONSTRUCTORS = ('counter', 'gauge', 'histogram')
+_DOC = 'observability.md'
+
+
+def docs_root(idx: index_lib.PackageIndex) -> Optional[pathlib.Path]:
+    """The repo's docs/ directory (package root's sibling); None when
+    linting an installed tree with no docs checkout."""
+    cand = idx.root.parent / 'docs'
+    return cand if cand.is_dir() else None
+
+
+def registered_series(idx: index_lib.PackageIndex) \
+        -> Dict[str, List[Tuple[str, int]]]:
+    names: Dict[str, List[Tuple[str, int]]] = {}
+    for rel, mod in sorted(idx.modules.items()):
+        for call in idx.iter_calls(mod.tree):
+            if idx.callee_name(call) not in _CONSTRUCTORS:
+                continue
+            if not call.args:
+                continue
+            first = call.args[0]
+            if not (isinstance(first, ast.Constant) and
+                    isinstance(first.value, str)):
+                continue
+            if not first.value.startswith('skytpu_'):
+                continue
+            names.setdefault(first.value, []).append(
+                (rel, call.lineno))
+    return names
+
+
+def documented_series(doc_dir: pathlib.Path) -> Set[str]:
+    """Series named in the catalog tables (a backticked `skytpu_*`
+    in the first cell of a markdown table row)."""
+    doc = (doc_dir / _DOC).read_text(encoding='utf-8')
+    names: Set[str] = set()
+    for line in doc.splitlines():
+        if not line.startswith('|'):
+            continue
+        cells = line.split('|')
+        if len(cells) < 2:
+            continue
+        names.update(re.findall(r'`(skytpu_[a-z0-9_]+)`', cells[1]))
+    return names
+
+
+class MetricsCatalogPass(core.Pass):
+
+    name = 'metrics-catalog'
+    rules = ('metrics-undocumented', 'metrics-stale-doc')
+    description = ('skytpu_* instruments cataloged in '
+                   'docs/observability.md, both directions')
+
+    def run(self, idx: index_lib.PackageIndex) \
+            -> Iterator[core.Finding]:
+        doc_dir = docs_root(idx)
+        if doc_dir is None or not (doc_dir / _DOC).is_file():
+            return
+        registered = registered_series(idx)
+        documented = documented_series(doc_dir)
+        for name in sorted(set(registered) - documented):
+            rel, line = registered[name][0]
+            yield core.Finding(
+                'metrics-undocumented', rel, line,
+                f'instrument {name!r} is not in the '
+                f'docs/{_DOC} catalog tables (add a row)')
+        for name in sorted(documented - set(registered)):
+            yield core.Finding(
+                'metrics-stale-doc', 'observability/metrics.py', 0,
+                f'docs/{_DOC} catalogs series {name!r} that no code '
+                f'registers (delete the row or restore the '
+                f'instrument)')

@@ -1,0 +1,440 @@
+"""`tracer-safety`: code reachable from a jit/shard_map/lax.scan
+entry point must stay traceable.
+
+On real TPUs a Python-side branch on a traced value either retraces
+per step (silent 100x slowdown) or crashes with a
+ConcretizationTypeError the CPU tests never see ("Exploring the limits
+of Concurrency in ML Training on Google TPUs", PAPERS.md).  This pass
+finds the traced region statically and flags host-semantics inside it:
+
+- **Entry points**: first arguments of ``jax.jit`` / ``shard_map`` /
+  ``sp_shard_map`` / ``jax.lax.scan`` calls and ``@jit``-style
+  decorators — including lambdas and ``functools.partial`` wrappers
+  (partial-bound and ``static_argnums``/``static_argnames`` params are
+  static; the rest are traced).
+- **Reachability**: calls from traced functions to package functions
+  (same module, or through a module alias) extend the region.
+- **Findings inside the region**:
+  - Python branching (`if`/`while`/`for`) on a *tainted* expression —
+    a traced param or a value derived from ``jnp.*``/``lax.*`` calls.
+    ``x.shape``/``.ndim``/``.dtype`` access and ``is None`` checks
+    stay static and are exempt.
+  - ``int()``/``bool()``/``float()`` on tainted values and any
+    ``.item()`` call — host concretization.
+  - ``np.asarray``/``np.array`` on tainted values — device->host
+    transfer inside the trace.
+  - wall-clock reads (``time.time``/``perf_counter``/...) — traced
+    once, frozen forever.
+  - fresh constant-seed ``PRNGKey``/``random.key`` — the "random"
+    stream is identical every call.
+
+Taint tracking is intentionally local (per function, no loop
+fixpoint): callee parameters without array annotations are NOT
+assumed traced, so static-config branching in model code stays clean.
+False negatives are possible; false positives should be rare — and
+suppressable with a reason.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import index as index_lib
+
+_JIT_NAMES = {'jit'}
+_SHARD_MAP_NAMES = {'shard_map', 'sp_shard_map', '_shard_map'}
+_SCAN_NAMES = {'scan'}
+_WALL_CLOCK = {'time', 'perf_counter', 'monotonic', 'time_ns', 'now'}
+_KEY_FACTORIES = {'PRNGKey', 'key'}
+_STATIC_ATTRS = {'shape', 'ndim', 'dtype', 'size', 'sharding',
+                 'weak_type'}
+_ARRAY_ANNOTATIONS = ('Array', 'ndarray')
+# Call bases producing traced values (resolved through import aliases).
+_TRACED_BASES = {'jax', 'jnp', 'lax'}
+_TRACED_BASE_MODULES = {'jax', 'jax.numpy', 'jax.lax'}
+
+
+@dataclasses.dataclass
+class _Unit:
+    """One function body in the traced region."""
+    rel: str
+    label: str
+    node: ast.AST                   # FunctionDef / Lambda
+    traced_params: Set[str]
+    is_entry: bool
+
+
+def _param_names(node: ast.AST) -> List[str]:
+    """Positional params only: keyword-only params are config in this
+    codebase (mesh, axis names, bucket widths) and never trace."""
+    args = node.args
+    return [a.arg for a in args.posonlyargs + args.args]
+
+
+def _static_from_jit_call(call: ast.Call, params: List[str]) \
+        -> Set[str]:
+    """Params pinned static by static_argnums / static_argnames."""
+    static: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == 'static_argnums':
+            for idx_const in ast.walk(kw.value):
+                if (isinstance(idx_const, ast.Constant) and
+                        isinstance(idx_const.value, int) and
+                        0 <= idx_const.value < len(params)):
+                    static.add(params[idx_const.value])
+        elif kw.arg == 'static_argnames':
+            for name_const in ast.walk(kw.value):
+                if (isinstance(name_const, ast.Constant) and
+                        isinstance(name_const.value, str)):
+                    static.add(name_const.value)
+    return static
+
+
+class _TaintChecker:
+    """Expression-level taint: does this expression depend on a traced
+    value at trace time?"""
+
+    def __init__(self, mod: index_lib.ModuleInfo,
+                 tainted: Set[str]) -> None:
+        self.mod = mod
+        self.tainted = tainted
+
+    def _traced_factory(self, call: ast.Call) -> bool:
+        """jnp.zeros(...) / lax.scan(...) / jax.numpy... produce
+        traced values inside a traced region."""
+        node = call.func
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return False
+        dotted = self.mod.import_aliases.get(node.id)
+        if dotted is None:
+            return node.id in _TRACED_BASES
+        return (dotted in _TRACED_BASE_MODULES or
+                dotted.split('.')[0] == 'jax')
+
+    def tainted_expr(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.tainted
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _STATIC_ATTRS:
+                return False
+            return self.tainted_expr(expr.value)
+        if isinstance(expr, ast.Subscript):
+            return self.tainted_expr(expr.value)
+        if isinstance(expr, ast.Call):
+            func = expr.func
+            if isinstance(func, ast.Name) and func.id == 'len':
+                return False
+            if isinstance(func, ast.Attribute):
+                if self.tainted_expr(func.value):
+                    return True
+            if self._traced_factory(expr):
+                return True
+            return any(self.tainted_expr(a) for a in expr.args) or \
+                any(self.tainted_expr(kw.value)
+                    for kw in expr.keywords)
+        if isinstance(expr, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in expr.ops):
+                return False
+            return (self.tainted_expr(expr.left) or
+                    any(self.tainted_expr(c)
+                        for c in expr.comparators))
+        if isinstance(expr, ast.BoolOp):
+            return any(self.tainted_expr(v) for v in expr.values)
+        if isinstance(expr, (ast.BinOp,)):
+            return (self.tainted_expr(expr.left) or
+                    self.tainted_expr(expr.right))
+        if isinstance(expr, ast.UnaryOp):
+            return self.tainted_expr(expr.operand)
+        if isinstance(expr, ast.IfExp):
+            return (self.tainted_expr(expr.test) or
+                    self.tainted_expr(expr.body) or
+                    self.tainted_expr(expr.orelse))
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self.tainted_expr(e) for e in expr.elts)
+        return False
+
+
+def _array_annotated(node: ast.AST) -> Set[str]:
+    """Params whose annotation names an array type."""
+    out: Set[str] = set()
+    args = node.args
+    for a in args.posonlyargs + args.args + args.kwonlyargs:
+        if a.annotation is None:
+            continue
+        try:
+            text = ast.unparse(a.annotation)
+        except Exception:  # pylint: disable=broad-except
+            continue
+        if any(marker in text for marker in _ARRAY_ANNOTATIONS):
+            out.add(a.arg)
+    return out
+
+
+def _nested_defs(fn: ast.AST) -> Dict[str, ast.AST]:
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+def _find_entries(idx: index_lib.PackageIndex) -> List[_Unit]:
+    """Every function object handed to jit / shard_map / lax.scan."""
+    units: List[_Unit] = []
+    seen: Set[int] = set()
+
+    def add(rel: str, label: str, node: ast.AST,
+            traced: Set[str]) -> None:
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        units.append(_Unit(rel, label, node, traced, True))
+
+    def resolve_target(rel: str, expr: ast.AST,
+                       scope: Dict[str, ast.AST]) \
+            -> Optional[Tuple[str, str, ast.AST]]:
+        if isinstance(expr, ast.Lambda):
+            return (rel, '<lambda>', expr)
+        if isinstance(expr, ast.Name):
+            if expr.id in scope:
+                return (rel, expr.id, scope[expr.id])
+            key = (rel, expr.id)
+            if key in idx.functions:
+                return (rel, expr.id, idx.functions[key].node)
+            return None
+        if (isinstance(expr, ast.Attribute) and
+                isinstance(expr.value, ast.Name)):
+            target = idx.resolve_module_alias(rel, expr.value.id)
+            if target is not None and \
+                    (target, expr.attr) in idx.functions:
+                return (target, expr.attr,
+                        idx.functions[(target, expr.attr)].node)
+        return None
+
+    def register(rel: str, call: ast.Call, kind: str,
+                 scope: Dict[str, ast.AST]) -> None:
+        if not call.args:
+            return
+        target = call.args[0]
+        bound_pos = 0
+        bound_kw: Set[str] = set()
+        # Unwrap functools.partial(fn, a, b, kw=...).
+        if (isinstance(target, ast.Call) and
+                idx.callee_name(target) == 'partial' and
+                target.args):
+            bound_pos = len(target.args) - 1
+            bound_kw = {kw.arg for kw in target.keywords if kw.arg}
+            target = target.args[0]
+        got = resolve_target(rel, target, scope)
+        if got is None:
+            return
+        trel, label, node = got
+        # Keyword-only params are config in this codebase (mesh, axis
+        # names, bucket widths) — bound in the partial or left at
+        # their default, never traced.  Only positional params trace.
+        params = _param_names(node)
+        static = set(params[:bound_pos]) | bound_kw
+        if kind == 'jit':
+            static |= _static_from_jit_call(call, params)
+        traced = {p for p in params
+                  if p not in static and p not in ('self', 'cls')}
+        add(trel, label, node, traced)
+
+    for rel, mod in sorted(idx.modules.items()):
+        # Whole-module walk: jit() calls appear at module level
+        # (`step_jit = jax.jit(step)`), in __init__ bodies, anywhere.
+        scope = _nested_defs(mod.tree)
+        for call in idx.iter_calls(mod.tree):
+            callee = idx.callee_name(call)
+            if callee in _JIT_NAMES:
+                register(rel, call, 'jit', scope)
+            elif callee in _SHARD_MAP_NAMES:
+                register(rel, call, 'shard_map', scope)
+            elif callee in _SCAN_NAMES:
+                register(rel, call, 'scan', scope)
+        # Decorators: @jax.jit / @functools.partial(jax.jit, ...).
+        for fn_key, fn in sorted(idx.functions.items()):
+            if fn_key[0] != rel:
+                continue
+            node = fn.node
+            for dec in getattr(node, 'decorator_list', []):
+                dec_call = dec if isinstance(dec, ast.Call) else None
+                name = None
+                if isinstance(dec, ast.Name):
+                    name = dec.id
+                elif isinstance(dec, ast.Attribute):
+                    name = dec.attr
+                elif dec_call is not None:
+                    name = idx.callee_name(dec_call)
+                    if name == 'partial' and dec_call.args:
+                        inner = dec_call.args[0]
+                        name = (inner.attr if isinstance(
+                            inner, ast.Attribute) else
+                            inner.id if isinstance(inner, ast.Name)
+                            else None)
+                if name in _JIT_NAMES:
+                    params = _param_names(node)
+                    static: Set[str] = set()
+                    if dec_call is not None:
+                        static = _static_from_jit_call(dec_call,
+                                                       params)
+                    add(rel, fn_key[1], node,
+                        {p for p in params if p not in static})
+    return units
+
+
+def _reachable(idx: index_lib.PackageIndex,
+               entries: List[_Unit]) -> List[_Unit]:
+    """Close the region over intra-package calls."""
+    units = list(entries)
+    seen_fns: Set[Tuple[str, str]] = set()
+    for u in units:
+        for key, fn in idx.functions.items():
+            if fn.node is u.node:
+                seen_fns.add(key)
+    queue = list(units)
+    while queue:
+        u = queue.pop()
+        for call in idx.iter_calls(u.node):
+            func = call.func
+            key: Optional[Tuple[str, str]] = None
+            if isinstance(func, ast.Name):
+                key = (u.rel, func.id)
+            elif (isinstance(func, ast.Attribute) and
+                  isinstance(func.value, ast.Name)):
+                target = idx.resolve_module_alias(u.rel,
+                                                  func.value.id)
+                if target is not None:
+                    key = (target, func.attr)
+            if key is None or key in seen_fns or \
+                    key not in idx.functions:
+                continue
+            seen_fns.add(key)
+            node = idx.functions[key].node
+            callee_unit = _Unit(key[0], key[1], node,
+                                _array_annotated(node), False)
+            units.append(callee_unit)
+            queue.append(callee_unit)
+    return units
+
+
+class TracerSafetyPass(core.Pass):
+
+    name = 'tracer-safety'
+    rules = ('tracer-safety',)
+    description = ('no host-side branching/concretization/wall-clock/'
+                   'fresh PRNG keys inside jit/shard_map/scan traced '
+                   'regions')
+
+    def run(self, idx: index_lib.PackageIndex) \
+            -> Iterator[core.Finding]:
+        units = _reachable(idx, _find_entries(idx))
+        emitted: Set[Tuple[str, int, str]] = set()
+        for u in sorted(units, key=lambda u: (u.rel, u.label)):
+            mod = idx.modules[u.rel]
+            for f in self._check_unit(idx, mod, u):
+                dedup = (f.file, f.line, f.message)
+                if dedup not in emitted:
+                    emitted.add(dedup)
+                    yield f
+
+    def _check_unit(self, idx: index_lib.PackageIndex,
+                    mod: index_lib.ModuleInfo,
+                    u: _Unit) -> Iterator[core.Finding]:
+        tainted = set(u.traced_params)
+        checker = _TaintChecker(mod, tainted)
+        where = f'traced region via {u.label}'
+
+        body = (u.node.body if isinstance(u.node.body, list)
+                else [u.node.body])
+        for stmt in body:
+            for node in ast.walk(stmt):
+                # Taint propagation through simple assignments, in
+                # source order (ast.walk is close enough for lint).
+                if isinstance(node, ast.Assign):
+                    if checker.tainted_expr(node.value):
+                        for tgt in node.targets:
+                            for name in ast.walk(tgt):
+                                if isinstance(name, ast.Name):
+                                    tainted.add(name.id)
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.If, ast.While)):
+                    if checker.tainted_expr(node.test):
+                        yield core.Finding(
+                            'tracer-safety', u.rel, node.lineno,
+                            f'Python branch on a traced value '
+                            f'({where}) — use lax.cond/lax.select or '
+                            f'hoist the value out of the trace')
+                elif isinstance(node, ast.For):
+                    if checker.tainted_expr(node.iter):
+                        yield core.Finding(
+                            'tracer-safety', u.rel, node.lineno,
+                            f'Python iteration over a traced value '
+                            f'({where}) — use lax.scan/fori_loop')
+                elif isinstance(node, ast.Call):
+                    yield from self._check_call(idx, mod, checker,
+                                                u, node, where)
+
+    def _check_call(self, idx: index_lib.PackageIndex,
+                    mod: index_lib.ModuleInfo,
+                    checker: _TaintChecker, u: _Unit,
+                    call: ast.Call, where: str) \
+            -> Iterator[core.Finding]:
+        callee = idx.callee_name(call)
+        func = call.func
+        if callee == 'item' and isinstance(func, ast.Attribute):
+            yield core.Finding(
+                'tracer-safety', u.rel, call.lineno,
+                f'.item() concretizes on host ({where}) — a traced '
+                f'operand crashes the trace')
+            return
+        if (callee in ('int', 'bool', 'float') and
+                isinstance(func, ast.Name) and call.args and
+                checker.tainted_expr(call.args[0])):
+            yield core.Finding(
+                'tracer-safety', u.rel, call.lineno,
+                f'{callee}() on a traced value ({where}) — '
+                f'ConcretizationTypeError on real inputs')
+            return
+        if callee in ('asarray', 'array') and \
+                isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name):
+            dotted = mod.import_aliases.get(func.value.id, '')
+            if dotted.split('.')[0] == 'numpy' and call.args and \
+                    checker.tainted_expr(call.args[0]):
+                yield core.Finding(
+                    'tracer-safety', u.rel, call.lineno,
+                    f'np.{callee}() on a traced value ({where}) — '
+                    f'forces a device->host transfer inside the '
+                    f'trace')
+                return
+        if callee in _WALL_CLOCK and isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name):
+            dotted = mod.import_aliases.get(func.value.id,
+                                            func.value.id)
+            if dotted.split('.')[0] in ('time', 'datetime'):
+                yield core.Finding(
+                    'tracer-safety', u.rel, call.lineno,
+                    f'wall-clock read inside a traced region '
+                    f'({where}) — traced once, frozen into the '
+                    f'compiled graph')
+                return
+        if callee in _KEY_FACTORIES and \
+                isinstance(func, ast.Attribute) and call.args and \
+                isinstance(call.args[0], ast.Constant):
+            base = func.value
+            text = ast.unparse(base) if base is not None else ''
+            if 'random' in text:
+                yield core.Finding(
+                    'tracer-safety', u.rel, call.lineno,
+                    f'fresh constant-seed PRNGKey inside a traced '
+                    f'region ({where}) — the stream repeats every '
+                    f'call; thread keys in as arguments')

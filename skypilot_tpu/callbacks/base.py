@@ -104,6 +104,10 @@ class SkyTpuCallback:
             self.step_begins.append(time.time())
 
     def on_step_end(self) -> None:
+        # Snapshot under the lock, write OUTSIDE it: the summary file
+        # write must never stall a concurrent on_step_begin (sky lint
+        # blocking-under-lock).
+        summary = None
         with self._lock:
             now = time.time()
             self.step_ends.append(now)
@@ -111,7 +115,9 @@ class SkyTpuCallback:
             if len(self.step_begins) >= n:
                 _M_STEP_SECONDS.observe(now - self.step_begins[n - 1])
             if n % self.flush_every == 0:
-                self._flush_no_lock()
+                summary = self.summary()
+        if summary is not None:
+            self._write_summary(summary)
         _M_STEPS.inc()
         if self.tokens_per_step:
             rate = self._tokens_per_s()
@@ -185,13 +191,16 @@ class SkyTpuCallback:
 
     def flush(self) -> None:
         with self._lock:
-            self._flush_no_lock()
+            summary = self.summary()
+        self._write_summary(summary)
 
-    def _flush_no_lock(self) -> None:
+    def _write_summary(self, summary: Dict[str, Any]) -> None:
+        """File I/O only — callers snapshot state under the lock and
+        write with it RELEASED, so flushes never block the step path."""
         path = os.path.join(self.log_dir, SUMMARY_FILE)
         tmp = path + '.tmp'
         with open(tmp, 'w', encoding='utf-8') as f:
-            json.dump(self.summary(), f)
+            json.dump(summary, f)
         os.replace(tmp, path)
 
 

@@ -1413,6 +1413,66 @@ def chaos_run(scenario, seed, export_trace):
             f'{len(result.violations)} invariant violation(s).')
 
 
+@cli.command()
+@click.option('--rule', 'rules', multiple=True,
+              help='Run only the passes owning these rule ids '
+                   '(repeatable); framework rules always run.')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='Deterministic JSON report (diffable; byte-'
+                   'identical across runs on one tree).')
+@click.option('--list-rules', is_flag=True, default=False,
+              help='Print the rule catalog and exit.')
+@click.option('--update-baseline', is_flag=True, default=False,
+              help='Grandfather every current unsuppressed finding '
+                   'into lint-baseline.json (the file only shrinks '
+                   'after that: stale entries fail lint).')
+def lint(rules, as_json, list_rules, update_baseline):
+    """Static analysis over the whole package (AST-only, no imports).
+
+    Exit 1 on unsuppressed findings.  Rule catalog, suppression
+    syntax, and the baseline workflow: docs/static-analysis.md.
+    """
+    import pathlib  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu import analysis  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.analysis import core as lint_core  # pylint: disable=import-outside-toplevel
+    if list_rules:
+        for rule, owner in sorted(lint_core.rule_catalog().items()):
+            click.echo(f'{rule:24s} {owner}')
+        return
+    pkg_root = pathlib.Path(__file__).resolve().parent
+    baseline = pkg_root.parent / lint_core.BASELINE_FILENAME
+    idx = analysis.PackageIndex(pkg_root)
+    try:
+        result = lint_core.run_lint(
+            idx, rules=list(rules) or None,
+            baseline_path=baseline if baseline.is_file() else None)
+    except ValueError as e:   # unknown --rule
+        raise click.ClickException(str(e))
+    if update_baseline:
+        # Keep still-reproducing grandfathered findings, add the new
+        # ones; never baseline the framework's own meta-findings.
+        keep = [f for f in result.findings + result.baselined
+                if f.rule not in (lint_core.RULE_BASELINE_STALE,
+                                  'suppression-invalid')]
+        lint_core.write_baseline(baseline, keep)
+        click.echo(f'Baselined {len(keep)} finding(s) into '
+                   f'{baseline}.')
+        return
+    if as_json:
+        click.echo(result.to_json())
+    else:
+        for f in result.findings:
+            click.echo(f'skypilot_tpu/{f.render()}')
+        click.echo(f'{len(result.findings)} finding(s), '
+                   f'{len(result.suppressed)} suppressed, '
+                   f'{len(result.baselined)} baselined '
+                   f'({len(idx.modules)} modules, '
+                   f'{result.duration_s:.1f}s).')
+    if not result.ok:
+        raise SystemExit(1)
+
+
 def main() -> None:
     # Pin the completion trigger var: click otherwise derives it from
     # the program name, which breaks completion when invoked as

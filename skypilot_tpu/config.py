@@ -57,6 +57,7 @@ def _load() -> Dict[str, Any]:
         if _dict is not None and _loaded_path == path:
             return _dict
         if os.path.exists(path):
+            # skytpu: lint-ok[blocking-under-lock] reason=one-time lazy load of a small local YAML; the lock is what makes the cache fill once instead of per-thread
             config = common_utils.read_yaml(path)
             _validate(config, path)
             _dict = config

@@ -94,6 +94,7 @@ class EventJournal:
             try:
                 self._maybe_rotate()
                 os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                # skytpu: lint-ok[blocking-under-lock] reason=this lock EXISTS to serialize the O_APPEND line write; appends are one bounded line and callers are never on a request hot path
                 with open(self.path, 'a', encoding='utf-8') as f:
                     f.write(json.dumps(record, default=str) + '\n')
             except OSError as e:
@@ -233,6 +234,7 @@ class ControlSpan:
         self._t0 = time.monotonic()
         self._wall0 = time.time()
         if self._journal is not None:
+            # skytpu: lint-ok[journal-computed-name] reason=span names are literals at every ControlSpan call site; the journal-events pass resolves them there as <name>_start/_end
             self._journal.append(f'{self._name}_start', **self._fields)
         return self
 
@@ -243,6 +245,7 @@ class ControlSpan:
         if exc is not None:
             fields.setdefault('error', str(exc)[:500])
         if self._journal is not None:
+            # skytpu: lint-ok[journal-computed-name] reason=span names are literals at every ControlSpan call site; the journal-events pass resolves them there as <name>_start/_end
             self._journal.append(f'{self._name}_end', status=status,
                                  duration_s=round(duration, 6), **fields)
         timeline.add_complete_event(

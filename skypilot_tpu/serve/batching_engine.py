@@ -117,6 +117,23 @@ _Request = scheduler.Request
 _Slot = scheduler.Slot
 _PendingPrefill = scheduler.PendingPrefill
 _WAIT_BUCKETS = scheduler.WAIT_BUCKETS
+# Full public surface of the three parts, same names (the facade
+# contract `sky lint` pins: facade-missing/facade-stale findings when
+# this drifts — see analysis/passes/facade_surface.py).
+AdmissionQueue = scheduler.AdmissionQueue
+PendingPrefill = scheduler.PendingPrefill
+Request = scheduler.Request
+Slot = scheduler.Slot
+WAIT_BUCKETS = scheduler.WAIT_BUCKETS
+AdmissionPlan = cache_manager.AdmissionPlan
+NULL_PAGE = cache_manager.NULL_PAGE
+PagePool = cache_manager.PagePool
+PagedKVManager = cache_manager.PagedKVManager
+PrefixCache = cache_manager.PrefixCache
+chunk_hashes = cache_manager.chunk_hashes
+SlotSampler = sampler_lib.SlotSampler
+validate_sampling = sampler_lib.validate_sampling
+validate_stop_ids = sampler_lib.validate_stop_ids
 
 _PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 

@@ -553,12 +553,21 @@ class SkyServeLoadBalancer:
                         2, self._next_failure_warn * 2)
             _M_SYNC_FAILURES.inc()
             age = self.sync_age()
-            if age > _sync_stale_warn_s() and not self._stale_warned:
+            warn_stale = False
+            if age > _sync_stale_warn_s():
+                # _stale_warned is written under the lock everywhere
+                # (sync success resets it there); claiming the
+                # once-per-outage warning lock-free would let two
+                # failing syncs both claim it.
+                with self._lock:
+                    if not self._stale_warned:
+                        self._stale_warned = True
+                        warn_stale = True
+            if warn_stale:
                 # Once per outage (reset on recovery), distinct from
                 # the per-attempt backoff below: the fleet view is now
                 # officially stale — last-known replicas keep serving,
                 # but new/retired replicas are invisible to this LB.
-                self._stale_warned = True
                 logger.warning(
                     f'LB fleet view is STALE: no successful controller '
                     f'sync for {age:.0f}s (> {_sync_stale_warn_s():.0f}s'

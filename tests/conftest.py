@@ -327,3 +327,17 @@ def pytest_collection_modifyitems(config, items):
         prefixes = _SLOW_TESTS.get(item.path.name)
         if prefixes and item.name.startswith(prefixes):
             item.add_marker(pytest.mark.slow)
+
+
+# ------------------------------------------------------- sky lint index
+# One parse of the whole package shared by every lint-plane test
+# (test_sky_lint + the three migrated lint wrappers): the index is
+# immutable, so session scope is safe and saves ~1s per consumer.
+@pytest.fixture(scope='session')
+def lint_index():
+    import pathlib
+
+    import skypilot_tpu
+    from skypilot_tpu.analysis import index as index_lib
+    return index_lib.PackageIndex(
+        pathlib.Path(skypilot_tpu.__file__).resolve().parent)

@@ -1,0 +1,532 @@
+"""Per-pass unit tests on synthetic fixture packages.
+
+Each checker pass gets true-positive and true-negative snippets, plus
+the framework contracts: an inline suppression with a reason silences
+a finding, a suppression WITHOUT a reason does not (and is itself a
+finding), and the baseline round-trips.  Fixture trees are tiny —
+every test parses a handful of lines.
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Dict, Optional
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import index as index_lib
+from skypilot_tpu.analysis.passes import bare_print
+from skypilot_tpu.analysis.passes import chaos_sites
+from skypilot_tpu.analysis.passes import concurrency
+from skypilot_tpu.analysis.passes import env_knobs
+from skypilot_tpu.analysis.passes import facade_surface
+from skypilot_tpu.analysis.passes import journal_events
+from skypilot_tpu.analysis.passes import metrics_catalog
+from skypilot_tpu.analysis.passes import tracer_safety
+
+
+def _pkg(tmp_path, files: Dict[str, str],
+         docs: Optional[Dict[str, str]] = None,
+         tests: Optional[Dict[str, str]] = None) \
+        -> index_lib.PackageIndex:
+    root = tmp_path / 'pkg'
+    for rel, content in {'__init__.py': '', **files}.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(content, encoding='utf-8')
+    for rel, content in (docs or {}).items():
+        path = tmp_path / 'docs' / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(content, encoding='utf-8')
+    for rel, content in (tests or {}).items():
+        path = tmp_path / 'tests' / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(content, encoding='utf-8')
+    return index_lib.PackageIndex(root)
+
+
+def _run(idx, pass_obj, rules=None, baseline=None):
+    return core.run_lint(idx, passes=[pass_obj], rules=rules,
+                         baseline_path=baseline)
+
+
+def _rules(result):
+    return [f.rule for f in result.findings]
+
+
+# ------------------------------------------------------- concurrency
+
+_LOCK_CYCLE = '''
+import threading
+
+
+class A:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def rev(self):
+        with self._b:
+            with self._a:
+                pass
+'''
+
+
+def test_concurrency_lock_order_cycle(tmp_path):
+    idx = _pkg(tmp_path, {'mod.py': _LOCK_CYCLE})
+    result = _run(idx, concurrency.ConcurrencyPass())
+    assert _rules(result).count('lock-order') == 2
+    assert 'A._a' in result.findings[0].message
+
+
+def test_concurrency_consistent_order_is_clean(tmp_path):
+    clean = _LOCK_CYCLE.replace(
+        'with self._b:\n            with self._a:',
+        'with self._a:\n            with self._b:')
+    idx = _pkg(tmp_path, {'mod.py': clean})
+    result = _run(idx, concurrency.ConcurrencyPass())
+    assert result.ok, _rules(result)
+
+
+def test_concurrency_blocking_and_transitive_self_deadlock(tmp_path):
+    idx = _pkg(tmp_path, {'mod.py': '''
+import threading
+import time
+import requests
+
+
+class A:
+    def __init__(self):
+        self._a = threading.Lock()
+
+    def slow(self):
+        with self._a:
+            time.sleep(1)
+
+    def net(self):
+        with self._a:
+            requests.post('http://x')
+
+    def reenter(self):
+        with self._a:
+            self.slow()
+'''})
+    result = _run(idx, concurrency.ConcurrencyPass())
+    rules = _rules(result)
+    assert rules.count('blocking-under-lock') >= 3  # sleep, post, call
+    # Holding _a while calling slow() (which takes _a) is an
+    # unconditional deadlock for a plain Lock.
+    assert 'lock-order' in rules
+
+
+def test_concurrency_rlock_reentry_and_cond_wait_clean(tmp_path):
+    idx = _pkg(tmp_path, {'mod.py': '''
+import threading
+
+
+class A:
+    def __init__(self):
+        self._a = threading.RLock()
+        self._cond = threading.Condition()
+
+    def inner(self):
+        with self._a:
+            pass
+
+    def outer(self):
+        with self._a:
+            self.inner()
+
+    def waiter(self):
+        with self._cond:
+            self._cond.wait(1.0)
+'''})
+    result = _run(idx, concurrency.ConcurrencyPass())
+    assert result.ok, [f.render() for f in result.findings]
+
+
+def test_concurrency_unlocked_attr(tmp_path):
+    idx = _pkg(tmp_path, {'mod.py': '''
+import threading
+
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def locked(self):
+        with self._lock:
+            self.count += 1
+
+    def unlocked(self):
+        self.count = 0
+'''})
+    result = _run(idx, concurrency.ConcurrencyPass())
+    assert _rules(result) == ['unlocked-attr']
+    assert 'A.count' in result.findings[0].message
+
+
+def test_suppression_with_reason_honored(tmp_path):
+    idx = _pkg(tmp_path, {'mod.py': '''
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def slow():
+    with _lock:
+        # skytpu: lint-ok[blocking-under-lock] reason=test fixture
+        time.sleep(1)
+'''})
+    result = _run(idx, concurrency.ConcurrencyPass())
+    assert result.ok
+    assert [f.rule for f in result.suppressed] == \
+        ['blocking-under-lock']
+
+
+def test_suppression_without_reason_rejected(tmp_path):
+    idx = _pkg(tmp_path, {'mod.py': '''
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def slow():
+    with _lock:
+        time.sleep(1)  # skytpu: lint-ok[blocking-under-lock]
+'''})
+    result = _run(idx, concurrency.ConcurrencyPass())
+    rules = set(_rules(result))
+    # The finding stands AND the reasonless suppression is flagged.
+    assert rules == {'blocking-under-lock',
+                     core.RULE_SUPPRESSION_INVALID}
+    assert not result.suppressed
+
+
+# ----------------------------------------------------- tracer safety
+
+def test_tracer_branch_item_and_clock_flagged(tmp_path):
+    idx = _pkg(tmp_path, {'mod.py': '''
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def step(state, tokens):
+    t = time.time()
+    if tokens > 0:
+        state = state + 1
+    n = int(tokens.sum().item())
+    return state, t, n
+
+
+step_jit = jax.jit(step)
+'''})
+    result = _run(idx, tracer_safety.TracerSafetyPass())
+    messages = ' / '.join(f.message for f in result.findings)
+    assert 'wall-clock' in messages
+    assert 'Python branch' in messages
+    assert '.item()' in messages
+
+
+def test_tracer_static_shapes_and_none_checks_clean(tmp_path):
+    idx = _pkg(tmp_path, {'mod.py': '''
+import jax
+
+
+def step(state, mask, cfg=None):
+    if state.shape[0] > 4:
+        pass
+    if mask is None:
+        return state
+    return state * 2
+
+
+step_jit = jax.jit(step, static_argnames=('cfg',))
+'''})
+    result = _run(idx, tracer_safety.TracerSafetyPass())
+    assert result.ok, [f.render() for f in result.findings]
+
+
+def test_tracer_reachability_through_callee(tmp_path):
+    idx = _pkg(tmp_path, {'mod.py': '''
+import time
+
+import jax
+
+
+def helper(x):
+    time.time()
+    return x
+
+
+def entry(x):
+    return helper(x)
+
+
+entry_jit = jax.jit(entry)
+'''})
+    result = _run(idx, tracer_safety.TracerSafetyPass())
+    assert _rules(result) == ['tracer-safety']
+    assert result.findings[0].line == 8  # the time.time() in helper
+
+
+def test_tracer_partial_bound_params_static(tmp_path):
+    idx = _pkg(tmp_path, {'mod.py': '''
+import functools
+
+import jax
+
+
+def step(cfg, tokens):
+    if cfg.debug:
+        pass
+    return tokens
+
+
+step_jit = jax.jit(functools.partial(step, object()))
+'''})
+    result = _run(idx, tracer_safety.TracerSafetyPass())
+    assert result.ok, [f.render() for f in result.findings]
+
+
+# --------------------------------------------------------- env knobs
+
+_ENV_DOC = '''# Env vars
+
+| variable | meaning |
+|---|---|
+| `SKYTPU_FOO` | documented and read |
+| `SKYTPU_BAZ` | read only by the test harness |
+| `SKYTPU_GONE` | documented but dead |
+'''
+
+
+def test_env_knobs_both_directions(tmp_path):
+    idx = _pkg(
+        tmp_path,
+        {'mod.py': '''
+import os
+
+FOO = os.environ.get('SKYTPU_FOO')
+BAR = os.environ.get('SKYTPU_BAR')
+'''},
+        docs={'environment-variables.md': _ENV_DOC},
+        tests={'test_x.py': "import os; os.environ['SKYTPU_BAZ']"})
+    result = _run(idx, env_knobs.EnvKnobsPass())
+    by_rule = {}
+    for f in result.findings:
+        by_rule.setdefault(f.rule, []).append(f.message)
+    assert list(by_rule.get('env-undocumented', [])) and \
+        'SKYTPU_BAR' in by_rule['env-undocumented'][0]
+    # SKYTPU_BAZ is harness-referenced -> not stale; SKYTPU_GONE is.
+    stale = ' '.join(by_rule.get('env-stale-doc', []))
+    assert 'SKYTPU_GONE' in stale
+    assert 'SKYTPU_BAZ' not in stale
+
+
+# ---------------------------------------------------- journal events
+
+_JOURNAL_DOC = '''# Obs
+
+### Journal event vocabulary
+
+| event | journal | fields |
+|---|---|---|
+| `good_event` | t | documented |
+| `span_start` `span_end` | t | via ControlSpan |
+| `ghost_event` | t | documented but never emitted |
+'''
+
+
+def test_journal_events_both_directions(tmp_path):
+    idx = _pkg(
+        tmp_path,
+        {'mod.py': '''
+from pkg import events_lib
+
+
+def _journal_it(event, **fields):
+    events_lib.get_journal().append(event, **fields)
+
+
+def work(journal, name):
+    _journal_it('good_event', x=1)
+    _journal_it('rogue_event')
+    events_lib.ControlSpan(journal, 'span')
+    journal.append(name, y=2)
+''',
+         'events_lib.py': '''
+def get_journal():
+    raise NotImplementedError
+
+
+class ControlSpan:
+    def __init__(self, journal, name):
+        self._journal = journal
+        self._name = name
+'''},
+        docs={'observability.md': _JOURNAL_DOC})
+    result = _run(idx, journal_events.JournalEventsPass())
+    by_rule = {}
+    for f in result.findings:
+        by_rule.setdefault(f.rule, []).append(f.message)
+    assert 'rogue_event' in ' '.join(by_rule['journal-undocumented'])
+    assert 'good_event' not in ' '.join(
+        by_rule['journal-undocumented'])
+    assert 'ghost_event' in ' '.join(by_rule['journal-stale-doc'])
+    # journal.append(name, ...) with a non-literal name is flagged.
+    assert by_rule.get('journal-computed-name')
+
+
+# --------------------------------------------------- metrics catalog
+
+def test_metrics_catalog_both_directions(tmp_path):
+    doc = '''# Obs
+
+| series | type |
+|---|---|
+| `skytpu_documented_total` | counter |
+| `skytpu_ghost_total` | counter |
+'''
+    idx = _pkg(
+        tmp_path,
+        {'mod.py': '''
+from pkg import m
+
+A = m.counter('skytpu_documented_total', 'x')
+B = m.counter('skytpu_rogue_total', 'x')
+'''},
+        docs={'observability.md': doc})
+    result = _run(idx, metrics_catalog.MetricsCatalogPass())
+    rules = _rules(result)
+    assert rules == ['metrics-undocumented', 'metrics-stale-doc'] or \
+        sorted(rules) == ['metrics-stale-doc', 'metrics-undocumented']
+    messages = ' '.join(f.message for f in result.findings)
+    assert 'skytpu_rogue_total' in messages
+    assert 'skytpu_ghost_total' in messages
+
+
+# ------------------------------------------------------- chaos sites
+
+def test_chaos_sites_helpers(tmp_path):
+    idx = _pkg(tmp_path, {
+        'chaos/__init__.py': '',
+        'chaos/faults.py': "SITES = {'a.b': 'doc', 'c.d': 'doc'}\n",
+        'mod.py': '''
+def work(inject, name):
+    inject('a.b')
+    inject('x.y')
+    inject(name)
+''',
+    })
+    registered = chaos_sites.registered_sites(idx)
+    assert registered == ['a.b', 'c.d']
+    sites, non_literal = chaos_sites.inject_call_sites(idx)
+    assert set(sites) == {'a.b', 'x.y'}
+    assert non_literal == [('mod.py', 5)]
+    findings = list(chaos_sites.ChaosSitesPass().run(idx))
+    rules = sorted({f.rule for f in findings})
+    assert 'chaos-site-unregistered' in rules   # x.y
+    assert 'chaos-site-computed' in rules       # inject(name)
+    assert 'chaos-site-stale' in rules          # c.d never injected
+
+
+# ---------------------------------------------------- facade surface
+
+def test_facade_missing_and_stale(tmp_path):
+    idx = _pkg(tmp_path, {
+        'serve/__init__.py': '',
+        'serve/scheduler.py': 'class Request:\n    pass\nLIMIT = 3\n',
+        'serve/cache_manager.py': 'class PagePool:\n    pass\n',
+        'serve/sampler.py': 'def validate_sampling():\n    pass\n',
+        'serve/batching_engine.py': '''
+from pkg.serve import cache_manager
+from pkg.serve import sampler as sampler_lib
+from pkg.serve import scheduler
+
+Request = scheduler.Request
+PagePool = cache_manager.PagePool
+validate_sampling = sampler_lib.validate_sampling
+Ghost = scheduler.LongGone
+''',
+    })
+    findings = list(facade_surface.FacadeSurfacePass().run(idx))
+    missing = [f.message for f in findings
+               if f.rule == 'facade-missing']
+    stale = [f.message for f in findings if f.rule == 'facade-stale']
+    assert any('LIMIT' in m for m in missing)
+    assert len(missing) == 1
+    assert len(stale) == 1 and 'LongGone' in stale[0]
+
+
+# -------------------------------------------------------- bare print
+
+def test_bare_print_flagged_outside_allowlist(tmp_path):
+    idx = _pkg(tmp_path, {
+        'mod.py': "print('no')\n",
+        'cli.py': "print('stdout is the product here')\n",
+    })
+    findings = list(bare_print.BarePrintPass().run(idx))
+    flagged = [f for f in findings if f.rule == 'bare-print']
+    assert [f.file for f in flagged] == ['mod.py']
+
+
+# ----------------------------------------------- baseline round-trip
+
+def test_baseline_grandfathers_then_goes_stale(tmp_path):
+    files = {'mod.py': "print('x')\n"}
+    idx = _pkg(tmp_path, files)
+    pass_obj = bare_print.BarePrintPass()
+    first = _run(idx, pass_obj)
+    flagged = [f for f in first.findings if f.rule == 'bare-print']
+    assert flagged
+    baseline = tmp_path / core.BASELINE_FILENAME
+    core.write_baseline(baseline, flagged)
+
+    # Grandfathered: same tree is now clean (modulo the allowlist
+    # staleness this fixture package inherently has).
+    second = _run(idx, pass_obj, rules=['bare-print'],
+                  baseline=baseline)
+    assert second.ok, [f.render() for f in second.findings]
+    assert [f.rule for f in second.baselined] == ['bare-print']
+
+    # The print is fixed -> the baseline entry is stale -> finding.
+    fixed = _pkg(tmp_path / 'v2', {'mod.py': 'x = 1\n'})
+    third = _run(fixed, pass_obj, rules=['bare-print'],
+                 baseline=baseline)
+    assert core.RULE_BASELINE_STALE in _rules(third)
+    assert not third.ok
+
+
+def test_baseline_stale_scoped_to_ran_rules(tmp_path):
+    """A --rule filter must not declare other rules' baseline entries
+    stale: their passes did not run, so absence proves nothing."""
+    idx = _pkg(tmp_path, {'mod.py': 'x = 1\n'})
+    baseline = tmp_path / core.BASELINE_FILENAME
+    baseline.write_text(json.dumps(
+        {'version': 1, 'findings': ['lock-order//mod.py//gone']}))
+    passes = [bare_print.BarePrintPass(),
+              concurrency.ConcurrencyPass()]
+    filtered = core.run_lint(idx, passes=passes,
+                             rules=['bare-print'],
+                             baseline_path=baseline)
+    assert core.RULE_BASELINE_STALE not in _rules(filtered)
+    full = core.run_lint(idx, passes=passes, baseline_path=baseline)
+    assert core.RULE_BASELINE_STALE in _rules(full)
+
+
+def test_fixture_json_deterministic(tmp_path):
+    idx = _pkg(tmp_path, {'mod.py': _LOCK_CYCLE})
+    a = _run(idx, concurrency.ConcurrencyPass()).to_json()
+    b = _run(idx, concurrency.ConcurrencyPass()).to_json()
+    assert a == b
+    assert json.loads(a)['findings']

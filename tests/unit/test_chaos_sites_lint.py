@@ -1,103 +1,48 @@
 """CI guard: chaos injection sites stay in lockstep with the registry.
 
-Style of test_no_bare_print.py (AST-based, ISSUE 5 satellite): every
-``inject(...)`` call site in skypilot_tpu/ must pass a *string literal*
-site name registered in ``chaos/faults.py`` (a computed site would dodge
-both this lint and the docs table), and every registered site must have
-at least one call site — no stale or undocumented vocabulary in either
-direction.
+Since ISSUE 12 this is a thin wrapper over the `chaos-sites` pass
+(skypilot_tpu/analysis/passes/chaos_sites.py): string-literal site
+names, both-direction registry parity, and the per-layer placement
+map all live there; these tests pin the pass green on the repo under
+the original names.
 """
 from __future__ import annotations
 
-import ast
-import pathlib
-from typing import Dict, List
-
-import skypilot_tpu
-from skypilot_tpu.chaos import faults as faults_lib
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.passes import chaos_sites
 
 
-def _inject_calls(tree: ast.AST):
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        func = node.func
-        name = None
-        if isinstance(func, ast.Name):
-            name = func.id
-        elif isinstance(func, ast.Attribute):
-            name = func.attr
-        if name == 'inject':
-            yield node
+def _run(lint_index, rules):
+    return core.run_lint(lint_index,
+                         passes=[chaos_sites.ChaosSitesPass()],
+                         rules=rules)
 
 
-def _scan() -> tuple:
-    root = pathlib.Path(skypilot_tpu.__file__).parent
-    call_sites: Dict[str, List[str]] = {}
-    problems: List[str] = []
-    for path in sorted(root.rglob('*.py')):
-        rel = path.relative_to(root).as_posix()
-        if rel.startswith('chaos/'):
-            continue  # the subsystem itself, not an instrumented site
-        tree = ast.parse(path.read_text(encoding='utf-8'),
-                         filename=str(path))
-        for node in _inject_calls(tree):
-            where = f'skypilot_tpu/{rel}:{node.lineno}'
-            if (not node.args or
-                    not isinstance(node.args[0], ast.Constant) or
-                    not isinstance(node.args[0].value, str)):
-                problems.append(
-                    f'{where}: inject() must take a string-literal site '
-                    f'name as its first argument')
-                continue
-            site = node.args[0].value
-            if site not in faults_lib.SITES:
-                problems.append(
-                    f'{where}: site {site!r} is not registered in '
-                    f'chaos/faults.py SITES')
-            call_sites.setdefault(site, []).append(where)
-    return call_sites, problems
+def test_every_inject_call_uses_a_registered_site(lint_index):
+    result = _run(lint_index, ['chaos-site-unregistered',
+                               'chaos-site-computed'])
+    assert result.ok, '\n  '.join(['chaos site lint:'] +
+                                  [f.render()
+                                   for f in result.findings])
 
 
-def test_every_inject_call_uses_a_registered_site():
-    _, problems = _scan()
-    assert not problems, '\n  '.join(['chaos site lint:'] + problems)
+def test_every_registered_site_has_a_call_site(lint_index):
+    result = _run(lint_index, ['chaos-site-stale'])
+    assert result.ok, '\n'.join(f.render() for f in result.findings)
 
 
-def test_every_registered_site_has_a_call_site():
-    call_sites, _ = _scan()
-    stale = sorted(set(faults_lib.SITES) - set(call_sites))
-    assert not stale, (
-        f'sites registered in chaos/faults.py with no inject() call '
-        f'site (remove them or instrument them): {stale}')
-
-
-def test_each_site_instruments_its_documented_layer():
+def test_each_site_instruments_its_documented_layer(lint_index):
     """The site prefix names the layer; the call site must live there —
     keeps the docs/chaos.md vocabulary table honest."""
-    expected_prefix = {
-        'provision.create': ('backends/', 'provision/'),
-        'queued_resource.poll': ('provision/',),
-        'runner.exec': ('utils/',),
-        'gang.rank_exec': ('backends/',),
-        'jobs.status_poll': ('jobs/',),
-        'jobs.recover': ('jobs/',),
-        'serve.replica_probe': ('serve/',),
-        'serve.controller_tick': ('serve/',),
-        'serve.page_pool': ('serve/',),
-        'serve.kv_handoff': ('serve/',),
-        'serve.rank_exec': ('serve/',),
-        'skylet.tick': ('skylet/',),
-        'checkpoint.save': ('data/',),
-    }
-    call_sites, _ = _scan()
-    assert set(expected_prefix) == set(faults_lib.SITES), (
-        'update this map (and docs/chaos.md) when the site vocabulary '
-        'changes')
-    misplaced = []
-    for site, prefixes in expected_prefix.items():
-        for where in call_sites.get(site, []):
-            rel = where.split('skypilot_tpu/', 1)[1]
-            if not rel.startswith(prefixes):
-                misplaced.append(f'{site}: {where}')
-    assert not misplaced, misplaced
+    result = _run(lint_index, ['chaos-site-misplaced',
+                               'chaos-site-unmapped'])
+    assert result.ok, '\n'.join(f.render() for f in result.findings)
+
+
+def test_scanner_sees_the_known_sites(lint_index):
+    """The AST scanner must not silently go blind: pin a few
+    load-bearing sites from different layers."""
+    sites, _ = chaos_sites.inject_call_sites(lint_index)
+    for site in ('provision.create', 'serve.kv_handoff',
+                 'skylet.tick', 'checkpoint.save'):
+        assert site in sites, site

@@ -1,96 +1,39 @@
 """CI guard: the metrics catalog stays in lockstep with the code.
 
-Style of test_no_bare_print.py / test_chaos_sites_lint.py (ISSUE 11
-satellite): every ``skytpu_*`` instrument registered anywhere in
-skypilot_tpu/ (a string-literal first argument to a
-``counter``/``gauge``/``histogram`` constructor) must appear in the
-docs/observability.md catalog tables, and every catalog row must name
-a series that still exists in code — no undocumented telemetry, no
-stale catalog entries, in either direction.
+Since ISSUE 12 this is a thin wrapper over the `metrics-catalog` pass
+(skypilot_tpu/analysis/passes/metrics_catalog.py): the constructor
+scan and the docs/observability.md table parse live there; these
+tests pin the pass green on the repo under the original names.
 """
 from __future__ import annotations
 
-import ast
-import pathlib
-import re
-from typing import Dict, List, Set, Tuple
-
-import skypilot_tpu
-
-_CONSTRUCTORS = ('counter', 'gauge', 'histogram')
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.passes import metrics_catalog
 
 
-def _registered() -> Tuple[Dict[str, List[str]], List[str]]:
-    root = pathlib.Path(skypilot_tpu.__file__).parent
-    names: Dict[str, List[str]] = {}
-    problems: List[str] = []
-    for path in sorted(root.rglob('*.py')):
-        rel = path.relative_to(root).as_posix()
-        tree = ast.parse(path.read_text(encoding='utf-8'),
-                         filename=str(path))
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            func = node.func
-            attr = None
-            if isinstance(func, ast.Name):
-                attr = func.id
-            elif isinstance(func, ast.Attribute):
-                attr = func.attr
-            if attr not in _CONSTRUCTORS or not node.args:
-                continue
-            first = node.args[0]
-            if not (isinstance(first, ast.Constant) and
-                    isinstance(first.value, str)):
-                continue
-            name = first.value
-            if not name.startswith('skytpu_'):
-                continue
-            names.setdefault(name, []).append(
-                f'skypilot_tpu/{rel}:{node.lineno}')
-    return names, problems
+def _run(lint_index, rules):
+    return core.run_lint(
+        lint_index, passes=[metrics_catalog.MetricsCatalogPass()],
+        rules=rules)
 
 
-def _documented() -> Set[str]:
-    """Series named in the catalog tables (a backticked `skytpu_*`
-    in the first cell of a markdown table row)."""
-    doc = (pathlib.Path(__file__).parents[2] / 'docs' /
-           'observability.md').read_text(encoding='utf-8')
-    names: Set[str] = set()
-    for line in doc.splitlines():
-        if not line.startswith('|'):
-            continue
-        cells = line.split('|')
-        if len(cells) < 2:
-            continue
-        names.update(re.findall(r'`(skytpu_[a-z0-9_]+)`', cells[1]))
-    return names
-
-
-def test_every_registered_series_is_cataloged():
-    registered, _ = _registered()
-    documented = _documented()
-    missing = {name: sites for name, sites in registered.items()
-               if name not in documented}
-    assert not missing, (
+def test_every_registered_series_is_cataloged(lint_index):
+    result = _run(lint_index, ['metrics-undocumented'])
+    assert result.ok, (
         'skytpu_* instruments registered in code but missing from the '
         'docs/observability.md catalog tables (add a row):\n  ' +
-        '\n  '.join(f'{name} ({sites[0]})'
-                    for name, sites in sorted(missing.items())))
+        '\n  '.join(f.render() for f in result.findings))
 
 
-def test_no_stale_catalog_entries():
-    registered, _ = _registered()
-    stale = sorted(_documented() - set(registered))
-    assert not stale, (
-        'docs/observability.md catalogs series no code registers '
-        f'(delete the rows or restore the instruments): {stale}')
+def test_no_stale_catalog_entries(lint_index):
+    result = _run(lint_index, ['metrics-stale-doc'])
+    assert result.ok, '\n'.join(f.render() for f in result.findings)
 
 
-def test_catalog_scan_sees_the_known_instruments():
+def test_catalog_scan_sees_the_known_instruments(lint_index):
     """The scanner itself must not silently go blind: a few
     load-bearing series from different layers are pinned here."""
-    registered, _ = _registered()
+    registered = metrics_catalog.registered_series(lint_index)
     for name in ('skytpu_engine_ticks_total',
                  'skytpu_lb_requests_total',
                  'skytpu_mfu_estimate',

@@ -1,67 +1,31 @@
 """CI guard: no bare print() in skypilot_tpu/.
 
-Diagnostics must go through sky_logging (so they land in the log
-infrastructure and the flight recorder, not a lost stdout) — ISSUE 4
-satellite: once gang_supervisor's prints were converted to tagged
-logger calls, this lint keeps the regression from reappearing.
-
-AST-based, not grep-based: codegen modules build `print(...)` INSIDE
-string literals shipped to remote hosts (job_lib/jobs/serve utils) and
-those are fine — only real `print` call nodes count.  Files where
-stdout IS the product (CLI tables, log tailing, script JSON output)
-are explicitly allowlisted with the reason.
+Since ISSUE 12 this is a thin wrapper over the `bare-print` pass of
+the static-analysis plane (skypilot_tpu/analysis/passes/
+bare_print.py) — the walker, the allowlist (with its reasons), and
+the suppression machinery all live there; this test pins that the
+pass stays green on the repo under its original name.
 """
 from __future__ import annotations
 
-import ast
-import pathlib
-
-import skypilot_tpu
-
-# rel-path -> why stdout is the interface there.
-_ALLOWED = {
-    'cli.py': 'click CLI: echo/table output is the product',
-    'skylet/log_lib.py': 'log tailing: stdout is the data channel',
-    'skylet/attempt_skylet.py': 'spawn status for the invoking shell',
-    'native/__init__.py': 'fan-in line mirroring to the supervisor log',
-    'models/import_weights.py': 'conversion script: JSON result on stdout',
-    'jobs/core.py': 'tail_logs dumps the controller log to stdout',
-    'serve/core.py': 'tail_logs dumps the service log to stdout',
-    'chaos/elastic_task.py':
-        'gang-exec\'d task: stdout is the rank log `sky logs` tails',
-    'serve/slice_replica.py':
-        '--bench-prefill prints its JSON result on stdout (bench_serve '
-        'subprocess protocol)',
-}
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.passes import bare_print
 
 
-def _print_calls(tree: ast.AST):
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Call) and
-                isinstance(node.func, ast.Name) and
-                node.func.id == 'print'):
-            yield node.lineno
-
-
-def test_no_bare_print_outside_allowlist():
-    root = pathlib.Path(skypilot_tpu.__file__).parent
-    offenders = []
-    for path in sorted(root.rglob('*.py')):
-        rel = path.relative_to(root).as_posix()
-        if rel in _ALLOWED:
-            continue
-        tree = ast.parse(path.read_text(encoding='utf-8'),
-                         filename=str(path))
-        offenders.extend(f'skypilot_tpu/{rel}:{line}'
-                         for line in _print_calls(tree))
-    assert not offenders, (
+def test_no_bare_print_outside_allowlist(lint_index):
+    result = core.run_lint(lint_index,
+                           passes=[bare_print.BarePrintPass()],
+                           rules=['bare-print'])
+    assert result.ok, (
         'bare print() found — use sky_logging.init_logger(__name__) '
-        '(or add the file to _ALLOWED with a reason if stdout is its '
-        f'interface):\n  ' + '\n  '.join(offenders))
+        '(or allowlist the file in analysis/passes/bare_print.py '
+        'with a reason if stdout is its interface):\n  ' +
+        '\n  '.join(f.render() for f in result.findings))
 
 
-def test_allowlist_entries_still_exist():
+def test_allowlist_entries_still_exist(lint_index):
     """A moved/deleted allowlisted file should shrink the allowlist."""
-    root = pathlib.Path(skypilot_tpu.__file__).parent
-    missing = [rel for rel in _ALLOWED if not (root / rel).is_file()]
-    assert not missing, f'stale allowlist entries: {missing}'
+    result = core.run_lint(lint_index,
+                           passes=[bare_print.BarePrintPass()],
+                           rules=['bare-print-stale-allow'])
+    assert result.ok, '\n'.join(f.render() for f in result.findings)
