@@ -8,8 +8,13 @@ from skypilot_tpu.analysis.passes.concurrency import ConcurrencyPass
 from skypilot_tpu.analysis.passes.env_knobs import EnvKnobsPass
 from skypilot_tpu.analysis.passes.facade_surface import (
     FacadeSurfacePass)
+from skypilot_tpu.analysis.passes.http_contract import HttpContractPass
 from skypilot_tpu.analysis.passes.journal_events import (
     JournalEventsPass)
+from skypilot_tpu.analysis.passes.journal_protocol import (
+    JournalProtocolPass)
+from skypilot_tpu.analysis.passes.mesh_consistency import (
+    MeshConsistencyPass)
 from skypilot_tpu.analysis.passes.metrics_catalog import (
     MetricsCatalogPass)
 from skypilot_tpu.analysis.passes.tracer_safety import TracerSafetyPass
@@ -21,8 +26,11 @@ def all_passes() -> List[core.Pass]:
     return [
         ConcurrencyPass(),
         TracerSafetyPass(),
+        MeshConsistencyPass(),
         EnvKnobsPass(),
         JournalEventsPass(),
+        JournalProtocolPass(),
+        HttpContractPass(),
         MetricsCatalogPass(),
         ChaosSitesPass(),
         BarePrintPass(),

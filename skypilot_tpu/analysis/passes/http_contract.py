@@ -1,0 +1,668 @@
+"""`http-*`: the cross-process HTTP contracts hold statically.
+
+The serving fleet is a multi-process system: two replica HTTP fronts
+(serve/model_server.py threaded, serve/async_server.py asyncio), the
+load balancer's `/lb/` control plane, and the controller's
+`/controller/` endpoint — plus a dozen in-package clients (the LB's
+handoff legs, the controller's probes, the CLI scrapers, the trace
+assembler).  Nothing at runtime checks that a client's path still hits
+a registered route or that a header a server reads is still stamped by
+anyone; this pass derives both sides from the ASTs and cross-checks:
+
+- **routes** — a server module registers a route wherever it compares
+  a path-ish expression against a string literal (or a
+  serve/http_protocol.py constant): `self.path == GENERATE`,
+  `path in _ROUTABLE_PATHS`.  Client call sites (`requests.get/post`,
+  the LB's `_http_request`/`_json_request`, urlopen) contribute the
+  trailing path of their URL argument (literal, `url + CONST`,
+  f-string, or a local conditional between constants).  Namespaces
+  split by prefix: `/lb/` -> the LB, `/controller/` -> the
+  controller, everything else -> the replica fronts.
+- **headers** — `X-SkyTPU-*` reads (`headers.get(...)` in a server
+  module) vs stamps (any other use of the header constant anywhere).
+- **status codes** — int literals a client branches on
+  (`status == 429`, `status in (400, 404)`) must be emittable by some
+  server (`_reply(429, ...)`, `send_response(code)`,
+  `_HttpError(503, ...)`, ...).
+
+Rules:
+
+- `http-front-parity` — the threaded and async replica fronts must
+  expose the identical route surface and read the identical header
+  set (threaded/async drift is exactly what nothing else tests).
+- `http-unknown-route` — a client path no server registers.
+- `http-header-unstamped` — a server reads a header nothing stamps.
+- `http-header-unread` — a canonical header no server module reads.
+- `http-raw-literal` — a raw `X-SkyTPU-*` or canonical-path string
+  literal outside serve/http_protocol.py (use the constants; the
+  module exists so the contract has one home).
+- `http-status-unemittable` — a client equality/membership branch on
+  a status code no server can emit.
+- `http-doc-drift` — the `### HTTP API` table in docs/serving.md
+  must list exactly the registered routes, both directions.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import index as index_lib
+from skypilot_tpu.analysis.passes import metrics_catalog
+
+PROTOCOL_MODULE = 'serve/http_protocol.py'
+REPLICA_FRONTS = ('serve/model_server.py', 'serve/async_server.py')
+SERVER_MODULES = REPLICA_FRONTS + ('serve/load_balancer.py',
+                                   'serve/controller.py')
+# Where header constants are DEFINED (module-level assignments there
+# are neither reads nor stamps).
+_HEADER_HOMES = (PROTOCOL_MODULE, 'serve/router.py',
+                 'observability/tracing.py')
+
+_HEADER_RE = re.compile(r'^X-SkyTPU-')
+_CLIENT_CALLEES = {'get', 'post', 'urlopen', 'request'}
+_CLIENT_PATH_ARG = {'_http_request': 1, '_json_request': 1}
+_REPLY_CALLEES = {'_reply', '_json', '_json_response', 'send_response',
+                  '_simple_response', '_HttpError'}
+
+# Namespace prefixes (the one place the pass itself needs the raw
+# strings: it classifies client paths before knowing the route sets).
+# skytpu: lint-ok[http-raw-literal] reason=the pass that enforces the ban needs the LB namespace prefix to classify client paths
+_LB_PREFIX = '/lb/'
+# skytpu: lint-ok[http-raw-literal] reason=the pass that enforces the ban needs the controller namespace prefix to classify client paths
+_CONTROLLER_PREFIX = '/controller/'
+
+_DOC = 'serving.md'
+_SECTION = '### HTTP API'
+_DOC_PATH_RE = re.compile(r'`(/[a-z_/]*)`')
+
+
+# ------------------------------------------------------------ resolution
+
+
+class _Resolver:
+    """Constant-string resolution through module-level assignments and
+    cross-module imports (the http_protocol constants)."""
+
+    def __init__(self, idx: index_lib.PackageIndex) -> None:
+        self.idx = idx
+        self.consts: Dict[Tuple[str, str], ast.AST] = {}
+        for rel, mod in idx.modules.items():
+            for node in mod.tree.body:
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.consts[(rel, tgt.id)] = node.value
+
+    def resolve_str(self, rel: str, expr: ast.AST,
+                    depth: int = 0) -> Optional[str]:
+        """expr -> string value (constants, names, attributes)."""
+        if depth > 8 or expr is None:
+            return None
+        if isinstance(expr, ast.Constant) and \
+                isinstance(expr.value, str):
+            return expr.value
+        if isinstance(expr, ast.Call) and \
+                isinstance(expr.func, ast.Attribute) and \
+                expr.func.attr == 'lower' and not expr.args:
+            # HEADER.lower() — async fronts keep lower-cased header
+            # maps; the canonical name is what the contract compares.
+            return self.resolve_str(rel, expr.func.value, depth + 1)
+        if isinstance(expr, ast.Name):
+            target = self.consts.get((rel, expr.id))
+            if target is not None:
+                return self.resolve_str(rel, target, depth + 1)
+            mod = self.idx.modules.get(rel)
+            if mod is not None and expr.id in mod.from_imports:
+                trel = self.idx._dotted_to_rel(  # pylint: disable=protected-access
+                    mod.from_imports[expr.id][0])
+                name = mod.from_imports[expr.id][1]
+                if trel is not None:
+                    return self.resolve_str(
+                        trel, self.consts.get((trel, name)), depth + 1)
+            return None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            target_rel = self.idx.resolve_module_alias(
+                rel, expr.value.id)
+            if target_rel is not None:
+                return self.resolve_str(
+                    target_rel,
+                    self.consts.get((target_rel, expr.attr)),
+                    depth + 1)
+        return None
+
+    def resolve_str_list(self, rel: str, expr: ast.AST,
+                         depth: int = 0) -> List[str]:
+        """Strings of a tuple/list-ish constant expression."""
+        if depth > 8 or expr is None:
+            return []
+        one = self.resolve_str(rel, expr, depth)
+        if one is not None:
+            return [one]
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for elt in expr.elts:
+                out.extend(self.resolve_str_list(rel, elt, depth + 1))
+            return out
+        if isinstance(expr, ast.Name):
+            target = self.consts.get((rel, expr.id))
+            if target is not None:
+                return self.resolve_str_list(rel, target, depth + 1)
+            mod = self.idx.modules.get(rel)
+            if mod is not None and expr.id in mod.from_imports:
+                trel = self.idx._dotted_to_rel(  # pylint: disable=protected-access
+                    mod.from_imports[expr.id][0])
+                name = mod.from_imports[expr.id][1]
+                if trel is not None:
+                    return self.resolve_str_list(
+                        trel, self.consts.get((trel, name)), depth + 1)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            trel = self.idx.resolve_module_alias(rel, expr.value.id)
+            if trel is not None:
+                return self.resolve_str_list(
+                    trel, self.consts.get((trel, expr.attr)),
+                    depth + 1)
+        return []
+
+
+def _url_tail(value: str) -> Optional[str]:
+    """Path component of a URL-ish string ('/x' stays, full URLs lose
+    scheme+host, bare hosts have no path)."""
+    if value.startswith('/'):
+        return value
+    if '://' in value:
+        rest = value.split('://', 1)[1]
+        if '/' in rest:
+            return '/' + rest.split('/', 1)[1]
+    return None
+
+
+# ---------------------------------------------------------- extraction
+
+
+def _docstring_ids(tree: ast.AST) -> Set[int]:
+    ids: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, 'body', [])
+            if body and isinstance(body[0], ast.Expr) and \
+                    isinstance(body[0].value, ast.Constant) and \
+                    isinstance(body[0].value.value, str):
+                ids.add(id(body[0].value))
+    return ids
+
+
+def server_routes(idx: index_lib.PackageIndex, res: _Resolver,
+                  rel: str) -> Dict[str, int]:
+    """path -> first registration line, from path comparisons in one
+    server module."""
+    mod = idx.modules.get(rel)
+    if mod is None:
+        return {}
+    routes: Dict[str, int] = {}
+
+    def record(path: str, line: int) -> None:
+        if path.startswith('/') and len(path) > 1:
+            routes.setdefault(path, line)
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not all(isinstance(op, (ast.Eq, ast.NotEq, ast.In))
+                   for op in node.ops):
+            continue
+        sides = [node.left] + list(node.comparators)
+        texts = []
+        for side in sides:
+            try:
+                texts.append(ast.unparse(side).lower())
+            except Exception:  # pylint: disable=broad-except
+                texts.append('')
+        if not any('path' in t for t in texts):
+            continue
+        for side in sides:
+            for value in res.resolve_str_list(rel, side):
+                record(value, node.lineno)
+    return routes
+
+
+def client_paths(idx: index_lib.PackageIndex, res: _Resolver) \
+        -> List[Tuple[str, int, str]]:
+    """(file, line, path) for every constant-resolvable client call."""
+    out: List[Tuple[str, int, str]] = []
+    markers = ('requests', 'urlopen', '_http_request', '_json_request')
+    for rel, mod in sorted(idx.modules.items()):
+        if rel == PROTOCOL_MODULE:
+            continue
+        text = '\n'.join(mod.lines)
+        if not any(m in text for m in markers):
+            continue
+        for call in idx.iter_calls(mod.tree):
+            callee = idx.callee_name(call)
+            arg_i = None
+            if callee in _CLIENT_PATH_ARG:
+                arg_i = _CLIENT_PATH_ARG[callee]
+            elif callee in _CLIENT_CALLEES and \
+                    isinstance(call.func, ast.Attribute) and \
+                    isinstance(call.func.value, ast.Name) and \
+                    call.func.value.id in ('requests', 'urllib',
+                                           'request'):
+                arg_i = 0
+            elif callee == 'urlopen':
+                arg_i = 0
+            if arg_i is None or len(call.args) <= arg_i:
+                continue
+            for path in _arg_paths(idx, res, rel, call.args[arg_i]):
+                out.append((rel, call.lineno, path))
+    return out
+
+
+def _arg_paths(idx: index_lib.PackageIndex, res: _Resolver, rel: str,
+               arg: ast.AST, depth: int = 0) -> List[str]:
+    """Trailing path(s) of a URL argument expression."""
+    if depth > 6:
+        return []
+    value = res.resolve_str(rel, arg)
+    if value is not None:
+        tail = _url_tail(value)
+        return [tail] if tail else []
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add):
+        right = res.resolve_str(rel, arg.right)
+        if right is not None and right.startswith('/'):
+            return [right]
+        return _arg_paths(idx, res, rel, arg.right, depth + 1)
+    if isinstance(arg, ast.JoinedStr) and arg.values:
+        last = arg.values[-1]
+        if isinstance(last, ast.Constant) and \
+                isinstance(last.value, str):
+            tail = _url_tail(last.value)
+            return [tail] if tail else []
+        if isinstance(last, ast.FormattedValue):
+            return _arg_paths(idx, res, rel, last.value, depth + 1)
+    if isinstance(arg, ast.IfExp):
+        return (_arg_paths(idx, res, rel, arg.body, depth + 1) +
+                _arg_paths(idx, res, rel, arg.orelse, depth + 1))
+    if isinstance(arg, ast.Call) and \
+            idx.callee_name(arg) == 'rstrip' and \
+            isinstance(arg.func, ast.Attribute):
+        return []
+    if isinstance(arg, ast.Name):
+        # Function-local assignment (the aggregator's
+        # `path = LB_METRICS if kind == 'lb' else METRICS`).
+        fn = _enclosing_function(idx, rel, arg)
+        if fn is not None:
+            paths: List[str] = []
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == arg.id
+                        for t in node.targets):
+                    paths.extend(_arg_paths(idx, res, rel, node.value,
+                                            depth + 1))
+            return paths
+    return []
+
+
+def _enclosing_function(idx: index_lib.PackageIndex, rel: str,
+                        node: ast.AST) -> Optional[ast.AST]:
+    for (frel, _), fn in idx.functions.items():
+        if frel != rel:
+            continue
+        for sub in ast.walk(fn.node):
+            if sub is node:
+                return fn.node
+    return None
+
+
+def header_reads(idx: index_lib.PackageIndex, res: _Resolver,
+                 rel: str) -> Dict[str, int]:
+    """header -> first read line: `<headers-ish>.get(HEADER)` calls."""
+    mod = idx.modules.get(rel)
+    if mod is None:
+        return {}
+    reads: Dict[str, int] = {}
+    for call in idx.iter_calls(mod.tree):
+        if idx.callee_name(call) != 'get' or not call.args:
+            continue
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        try:
+            recv = ast.unparse(func.value).lower()
+        except Exception:  # pylint: disable=broad-except
+            continue
+        if 'headers' not in recv:
+            continue
+        arg = call.args[0]
+        # HEADER or HEADER.lower()
+        if isinstance(arg, ast.Call) and \
+                idx.callee_name(arg) == 'lower' and \
+                isinstance(arg.func, ast.Attribute):
+            arg = arg.func.value
+        value = res.resolve_str(rel, arg)
+        if value is not None and _HEADER_RE.match(value):
+            reads.setdefault(value, call.lineno)
+    return reads
+
+
+def _read_arg_ids(idx: index_lib.PackageIndex, rel: str) -> Set[int]:
+    """Node ids used as header-read `.get()` arguments (excluded from
+    the stamp scan)."""
+    mod = idx.modules.get(rel)
+    ids: Set[int] = set()
+    if mod is None:
+        return ids
+    for call in idx.iter_calls(mod.tree):
+        if idx.callee_name(call) != 'get' or not call.args:
+            continue
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        arg = call.args[0]
+        ids.update(id(n) for n in ast.walk(arg))
+    return ids
+
+
+def header_stamps(idx: index_lib.PackageIndex,
+                  res: _Resolver) -> Dict[str, int]:
+    """header -> stamp count: any resolvable reference to an
+    X-SkyTPU-* constant that is not a read key or a definition."""
+    stamps: Dict[str, int] = {}
+    for rel, mod in sorted(idx.modules.items()):
+        text = '\n'.join(mod.lines)
+        if 'X-SkyTPU' not in text and '_HEADER' not in text:
+            continue
+        defs: Set[int] = set()
+        if rel in _HEADER_HOMES:
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign):
+                    defs.update(id(n) for n in ast.walk(node))
+        read_ids = _read_arg_ids(idx, rel)
+        for node in ast.walk(mod.tree):
+            if id(node) in defs or id(node) in read_ids:
+                continue
+            # Cheap prefilter before constant resolution: header
+            # references are X-SkyTPU-* literals or *_HEADER names.
+            if isinstance(node, ast.Constant):
+                if not (isinstance(node.value, str) and
+                        _HEADER_RE.match(node.value)):
+                    continue
+            elif isinstance(node, ast.Name):
+                if not node.id.endswith('_HEADER'):
+                    continue
+            elif isinstance(node, ast.Attribute):
+                if not (isinstance(node.value, ast.Name) and
+                        node.attr.endswith('_HEADER')):
+                    continue
+            else:
+                continue
+            value = res.resolve_str(rel, node)
+            if value is not None and _HEADER_RE.match(value):
+                stamps[value] = stamps.get(value, 0) + 1
+    return stamps
+
+
+def emitted_statuses(idx: index_lib.PackageIndex,
+                     res: _Resolver) -> Set[int]:
+    """Status codes any server module can emit."""
+    codes: Set[int] = set()
+    for rel in SERVER_MODULES:
+        mod = idx.modules.get(rel)
+        if mod is None:
+            continue
+        for (frel, _), fn in sorted(idx.functions.items()):
+            if frel != rel:
+                continue
+            local_ints: Dict[str, List[int]] = {}
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Constant) and \
+                        isinstance(node.value.value, int):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            local_ints.setdefault(tgt.id, []).append(
+                                node.value.value)
+            for call in idx.iter_calls(fn.node):
+                if idx.callee_name(call) not in _REPLY_CALLEES or \
+                        not call.args:
+                    continue
+                arg = call.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, int):
+                    codes.add(arg.value)
+                elif isinstance(arg, ast.Name):
+                    codes.update(local_ints.get(arg.id, []))
+    return codes
+
+
+def client_status_branches(idx: index_lib.PackageIndex) \
+        -> List[Tuple[str, int, int]]:
+    """(file, line, code) for client-side `status ==`/`in` branches."""
+    out: List[Tuple[str, int, int]] = []
+    for rel, mod in sorted(idx.modules.items()):
+        if not any('status' in line for line in mod.lines):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            if not all(isinstance(op, (ast.Eq, ast.NotEq, ast.In))
+                       for op in node.ops):
+                continue
+            try:
+                left = ast.unparse(node.left).lower()
+            except Exception:  # pylint: disable=broad-except
+                continue
+            if 'status' not in left:
+                continue
+            for comp in node.comparators:
+                elts = (comp.elts if isinstance(comp, (ast.Tuple,
+                                                       ast.List))
+                        else [comp])
+                for elt in elts:
+                    if isinstance(elt, ast.Constant) and \
+                            isinstance(elt.value, int):
+                        out.append((rel, node.lineno, elt.value))
+    return out
+
+
+def documented_routes(doc_dir) -> Set[str]:
+    doc_path = doc_dir / _DOC
+    if not doc_path.is_file():
+        return set()
+    doc = doc_path.read_text(encoding='utf-8')
+    in_section = False
+    paths: Set[str] = set()
+    for line in doc.splitlines():
+        if line.startswith('#'):
+            in_section = line.strip() == _SECTION
+            continue
+        if in_section and line.startswith('|'):
+            cells = line.split('|')
+            if len(cells) >= 2:
+                paths.update(_DOC_PATH_RE.findall(cells[1]))
+    return paths
+
+
+# ---------------------------------------------------------------- pass
+
+
+class HttpContractPass(core.Pass):
+
+    name = 'http-contract'
+    rules = ('http-front-parity', 'http-unknown-route',
+             'http-header-unstamped', 'http-header-unread',
+             'http-raw-literal', 'http-status-unemittable',
+             'http-doc-drift')
+    description = ('client call sites match registered routes; the '
+                   'two replica fronts expose identical surfaces; '
+                   'headers read are stamped; status codes branched '
+                   'on are emittable; raw protocol literals live in '
+                   'serve/http_protocol.py only')
+
+    def run(self, idx: index_lib.PackageIndex) \
+            -> Iterator[core.Finding]:
+        if PROTOCOL_MODULE not in idx.modules:
+            return
+        res = _Resolver(idx)
+        canonical = self._canonical(idx)
+        headers = {v for v in canonical if _HEADER_RE.match(v)}
+        paths = {v for v in canonical if v.startswith('/')}
+
+        front_routes = {rel: server_routes(idx, res, rel)
+                        for rel in REPLICA_FRONTS}
+        lb_routes = {p: line for p, line in server_routes(
+            idx, res, 'serve/load_balancer.py').items()
+            if p.startswith(_LB_PREFIX)}
+        controller_routes = {p: line for p, line in server_routes(
+            idx, res, 'serve/controller.py').items()
+            if p.startswith(_CONTROLLER_PREFIX)}
+
+        # ---- threaded/async parity: routes, then header reads.
+        threaded, asyncf = (front_routes.get(rel, {})
+                            for rel in REPLICA_FRONTS)
+        for path in sorted(set(threaded) - set(asyncf)):
+            yield core.Finding(
+                'http-front-parity', REPLICA_FRONTS[1], 0,
+                f'route {path!r} is handled by the threaded front '
+                f'only — the async front must expose the identical '
+                f'surface')
+        for path in sorted(set(asyncf) - set(threaded)):
+            yield core.Finding(
+                'http-front-parity', REPLICA_FRONTS[0], 0,
+                f'route {path!r} is handled by the async front only '
+                f'— the threaded front must expose the identical '
+                f'surface')
+        front_reads = {rel: header_reads(idx, res, rel)
+                       for rel in REPLICA_FRONTS}
+        t_reads, a_reads = (front_reads[rel] for rel in REPLICA_FRONTS)
+        for header in sorted(set(t_reads) - set(a_reads)):
+            yield core.Finding(
+                'http-front-parity', REPLICA_FRONTS[1], 0,
+                f'header {header!r} is read by the threaded front '
+                f'only — async must honor it too')
+        for header in sorted(set(a_reads) - set(t_reads)):
+            yield core.Finding(
+                'http-front-parity', REPLICA_FRONTS[0], 0,
+                f'header {header!r} is read by the async front only '
+                f'— threaded must honor it too')
+
+        # ---- client paths hit registered routes (by namespace).
+        replica_surface = set(threaded) | set(asyncf)
+        for rel, line, path in sorted(set(client_paths(idx, res))):
+            if path == '/':
+                continue  # every GET answers the health payload
+            if path.startswith(_LB_PREFIX):
+                known = set(lb_routes)
+                where = 'LB control plane'
+            elif path.startswith(_CONTROLLER_PREFIX):
+                known = set(controller_routes)
+                where = 'controller'
+            else:
+                known = replica_surface
+                where = 'replica fronts'
+            if path not in known:
+                yield core.Finding(
+                    'http-unknown-route', rel, line,
+                    f'client calls {path!r} but the {where} register '
+                    f'no such route')
+
+        # ---- headers: reads across all server modules vs stamps.
+        all_reads: Dict[str, Tuple[str, int]] = {}
+        for rel in SERVER_MODULES:
+            for header, line in header_reads(idx, res, rel).items():
+                all_reads.setdefault(header, (rel, line))
+        stamps = header_stamps(idx, res)
+        for header in sorted(all_reads):
+            if not stamps.get(header):
+                rel, line = all_reads[header]
+                yield core.Finding(
+                    'http-header-unstamped', rel, line,
+                    f'server reads header {header!r} but nothing in '
+                    f'the package stamps it on any request')
+        for header in sorted(headers - set(all_reads)):
+            yield core.Finding(
+                'http-header-unread', PROTOCOL_MODULE, 0,
+                f'canonical header {header!r} is read by no server '
+                f'module — dead protocol surface, delete it or wire '
+                f'the consumer')
+
+        # ---- raw literals outside the protocol module.
+        yield from self._raw_literals(idx, headers, paths)
+
+        # ---- status codes.
+        emittable = emitted_statuses(idx, res)
+        for rel, line, code in sorted(set(
+                client_status_branches(idx))):
+            if 100 <= code < 600 and code not in emittable:
+                yield core.Finding(
+                    'http-status-unemittable', rel, line,
+                    f'client branches on HTTP status {code}, which no '
+                    f'server module can emit — dead branch or a '
+                    f'contract typo')
+
+        # ---- docs table.
+        doc_dir = metrics_catalog.docs_root(idx)
+        if doc_dir is not None and (doc_dir / _DOC).is_file():
+            registered = (replica_surface | set(lb_routes) |
+                          set(controller_routes))
+            documented = documented_routes(doc_dir)
+            for path in sorted(registered - documented):
+                yield core.Finding(
+                    'http-doc-drift', PROTOCOL_MODULE, 0,
+                    f'route {path!r} is registered but missing '
+                    f'from the docs/{_DOC} {_SECTION!r} table')
+            for path in sorted(documented - registered):
+                yield core.Finding(
+                    'http-doc-drift', PROTOCOL_MODULE, 0,
+                    f'docs/{_DOC} {_SECTION!r} table lists '
+                    f'{path!r}, which no server registers')
+
+    @staticmethod
+    def _canonical(idx: index_lib.PackageIndex) -> Set[str]:
+        """String constants defined at the protocol module's top level
+        (headers + endpoint paths — the ban list for raw literals)."""
+        mod = idx.modules[PROTOCOL_MODULE]
+        values: Set[str] = set()
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                values.add(node.value.value)
+        return values
+
+    def _raw_literals(self, idx: index_lib.PackageIndex,
+                      headers: Set[str],
+                      paths: Set[str]) -> Iterator[core.Finding]:
+        banned = headers | paths
+        quoted = [q for v in sorted(banned)
+                  for q in (f"'{v}'", f'"{v}"')]
+        for rel, mod in sorted(idx.modules.items()):
+            if rel == PROTOCOL_MODULE:
+                continue
+            text = '\n'.join(mod.lines)
+            if 'X-SkyTPU' not in text and \
+                    not any(q in text for q in quoted):
+                continue
+            doc_ids = _docstring_ids(mod.tree)
+            seen: Set[Tuple[int, str]] = set()
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Constant) or \
+                        not isinstance(node.value, str):
+                    continue
+                if id(node) in doc_ids:
+                    continue
+                value = node.value
+                if value in banned or _HEADER_RE.match(value):
+                    key = (node.lineno, value)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield core.Finding(
+                        'http-raw-literal', rel, node.lineno,
+                        f'raw protocol literal {value!r} — import it '
+                        f'from serve/http_protocol.py instead (the '
+                        f'contract has one home)')

@@ -29,6 +29,7 @@ a reason naming the events it can produce — and document those.
 from __future__ import annotations
 
 import ast
+import dataclasses
 import re
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
@@ -111,15 +112,32 @@ class _Emitter:
         self.suffixes = suffixes
 
 
-def collect_events(idx: index_lib.PackageIndex) \
-        -> Tuple[Dict[str, List[Tuple[str, int]]],
-                 List[Tuple[str, int, str]]]:
-    """(event -> [(file, line)], [(file, line, why)] computed names)."""
-    events: Dict[str, List[Tuple[str, int]]] = {}
-    computed: List[Tuple[str, int, str]] = []
+@dataclasses.dataclass
+class EmitSite:
+    """One resolved journal-emission call site.
 
-    def emit(name: str, rel: str, line: int) -> None:
-        events.setdefault(name, []).append((rel, line))
+    `names` is the list of event names the site can emit (None =
+    computed/unresolvable — a `journal-computed-name` finding).
+    `kind` records the mechanism: 'append' (direct journalish append),
+    'span' (ControlSpan / journalish .span — the context manager
+    guarantees the `_end`), or 'wrapper' (a call through a journaling
+    wrapper function).  `func` is the enclosing function's index key,
+    `call` the AST call node — the journal-protocol pass uses both to
+    check finally/except coverage of `_start` emits.
+    """
+    rel: str
+    line: int
+    func: Tuple[str, str]
+    call: ast.Call
+    names: Optional[List[str]]
+    kind: str
+    what: str      # message prefix for computed-name findings
+
+
+def collect_emit_sites(idx: index_lib.PackageIndex) -> List[EmitSite]:
+    """Every journal-emission call site in the package, in the
+    deterministic (sorted functions, AST walk) order."""
+    sites: List[EmitSite] = []
 
     # ---- pass 1: wrapper functions (first param -> journal append).
     # The append nodes that *define* a wrapper are remembered so pass 2
@@ -157,27 +175,30 @@ def collect_events(idx: index_lib.PackageIndex) \
             wrapper_sinks.update(sinks)
 
     def emit_arg(arg: ast.AST, em: Optional[_Emitter], rel: str,
-                 line: int, mod: index_lib.ModuleInfo,
+                 func: Tuple[str, str], call: ast.Call,
+                 mod: index_lib.ModuleInfo, kind: str,
                  what: str) -> None:
         lits = _resolve_literals(arg, mod)
         if lits is None:
-            computed.append((rel, line,
-                             f'{what} event name is not resolvable to '
-                             f'string literals'))
+            sites.append(EmitSite(rel, call.lineno, func, call, None,
+                                  kind, what))
             return
         suffixes = em.suffixes if em is not None else None
+        names: List[str] = []
         for lit in lits:
             if suffixes is None:
-                emit(lit, rel, line)
+                names.append(lit)
             else:
-                for sfx in suffixes:
-                    emit(lit + sfx, rel, line)
+                names.extend(lit + sfx for sfx in suffixes)
+        sites.append(EmitSite(rel, call.lineno, func, call, names,
+                              kind, what))
 
     # ---- pass 2: every call site, walked per function so self-calls
     # resolve against the ENCLOSING class (a `_record` wrapper in one
     # class must not capture `self._record` of another).
     for (rel, qual), fn in sorted(idx.functions.items()):
         mod = idx.modules[rel]
+        func = (rel, qual)
         cls_name = qual.split('.', 1)[0] if '.' in qual else None
         for call in idx.iter_calls(fn.node):
             callee = idx.callee_name(call)
@@ -193,28 +214,29 @@ def collect_events(idx: index_lib.PackageIndex) \
                     lits = _resolve_literals(
                         ast.Name(id=fs[0], ctx=ast.Load()), mod)
                     if lits is None:
-                        computed.append(
-                            (rel, call.lineno,
-                             'journal append event name is not '
-                             'resolvable to string literals'))
+                        sites.append(EmitSite(
+                            rel, call.lineno, func, call, None,
+                            'append', 'journal append'))
                     else:
-                        for lit in lits:
-                            emit(lit + fs[1], rel, call.lineno)
+                        sites.append(EmitSite(
+                            rel, call.lineno, func, call,
+                            [lit + fs[1] for lit in lits], 'append',
+                            'journal append'))
                     continue
-                emit_arg(call.args[0], None, rel, call.lineno, mod,
-                         'journal append')
+                emit_arg(call.args[0], None, rel, func, call, mod,
+                         'append', 'journal append')
             elif callee == 'ControlSpan':
                 if len(call.args) < 2:
                     continue
                 emit_arg(call.args[1], _Emitter('', ['_start', '_end']),
-                         rel, call.lineno, mod, 'ControlSpan')
+                         rel, func, call, mod, 'span', 'ControlSpan')
             elif callee == 'span':
                 if (not call.args or
                         not isinstance(call.func, ast.Attribute) or
                         not _is_journalish(call.func.value)):
                     continue
                 emit_arg(call.args[0], _Emitter('', ['_start', '_end']),
-                         rel, call.lineno, mod, 'journal span')
+                         rel, func, call, mod, 'span', 'journal span')
             elif callee is not None:
                 em = None
                 if isinstance(call.func, ast.Name):
@@ -234,8 +256,26 @@ def collect_events(idx: index_lib.PackageIndex) \
                             em = wrappers.get((target, callee))
                 if em is None or not call.args:
                     continue
-                emit_arg(call.args[0], em, rel, call.lineno, mod,
-                         f'{callee}()')
+                emit_arg(call.args[0], em, rel, func, call, mod,
+                         'wrapper', f'{callee}()')
+    return sites
+
+
+def collect_events(idx: index_lib.PackageIndex) \
+        -> Tuple[Dict[str, List[Tuple[str, int]]],
+                 List[Tuple[str, int, str]]]:
+    """(event -> [(file, line)], [(file, line, why)] computed names)."""
+    events: Dict[str, List[Tuple[str, int]]] = {}
+    computed: List[Tuple[str, int, str]] = []
+    for site in collect_emit_sites(idx):
+        if site.names is None:
+            computed.append((site.rel, site.line,
+                             f'{site.what} event name is not '
+                             f'resolvable to string literals'))
+        else:
+            for name in site.names:
+                events.setdefault(name, []).append(
+                    (site.rel, site.line))
     return events, computed
 
 

@@ -1,0 +1,256 @@
+"""`journal-protocol-*`: paired journal events fit the machine-readable
+protocol table and every `_start` has a guaranteed `_end`.
+
+The paired-event lifecycles (drain_start/_end, kv_handoff, kv_pages
+alloc/free, ControlSpan spans, ...) live in ONE table —
+`observability/event_protocol.py` — shared by the chaos invariant
+checkers (which replay journals at runtime) and this pass (which
+verifies the emit sites statically).  The table is read from the
+analyzed package's AST, never imported: lint stays AST-only.
+
+Checks:
+
+- **journal-protocol-unregistered** — an emitted event named like a
+  lifecycle (`*_start` / `*_end`) whose base is not a table row.  New
+  lifecycles must register, or the invariants can never replay them.
+- **journal-protocol-stale** — a table row whose start or end event no
+  code emits (the lifecycle is a vocabulary lie).
+- **journal-unguarded-start** — an invocation-scoped lifecycle whose
+  `_start` is emitted by a function that does not guarantee the `_end`
+  on exception paths: the matching end emit must sit in a `finally`
+  or `except` block of the same function.  ControlSpan/`.span()` call
+  sites are exempt — the context manager's `__exit__` IS the
+  guarantee.  Process-scoped lifecycles (state machines like
+  replica_drain or slo_burn) are exempt; only journal replay can
+  check those.
+- **journal-protocol-status** — an end emit whose literal
+  status/reason value is outside the table's allowed terminal set
+  (the same set the invariants enforce at replay time): a typo'd
+  status would pass the emitter and fail every future chaos run.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import index as index_lib
+from skypilot_tpu.analysis.passes import journal_events
+
+PROTOCOL_MODULE = 'observability/event_protocol.py'
+
+
+class PairSpec:
+    """One protocol-table row, as parsed from the AST."""
+
+    def __init__(self, name: str, start: str, end: str, scope: str,
+                 status_field: Optional[str],
+                 statuses: Optional[Tuple[str, ...]]) -> None:
+        self.name = name
+        self.start = start
+        self.end = end
+        self.scope = scope
+        self.status_field = status_field
+        self.statuses = statuses
+
+
+def _literal(node: ast.AST, consts: Dict[str, str]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def load_protocol(idx: index_lib.PackageIndex) -> List[PairSpec]:
+    """Parse the PAIRS table out of the protocol module's AST.
+
+    Rows are `_pair(name, scope, ...)` / `PairedEvents(...)` calls
+    inside the module-level `PAIRS = (...)` assignment; module-level
+    string constants (the SCOPE_* names) resolve as arguments."""
+    mod = idx.modules.get(PROTOCOL_MODULE)
+    if mod is None:
+        return []
+    consts: Dict[str, str] = {}
+    pairs_node: Optional[ast.AST] = None
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):  # PAIRS: Tuple[...] = ..
+            targets = [node.target]
+        else:
+            continue
+        for tgt in targets:
+            if not isinstance(tgt, ast.Name):
+                continue
+            if tgt.id == 'PAIRS':
+                pairs_node = node.value
+            elif (isinstance(node.value, ast.Constant) and
+                  isinstance(node.value.value, str)):
+                consts[tgt.id] = node.value.value
+    if pairs_node is None or not isinstance(pairs_node,
+                                            (ast.Tuple, ast.List)):
+        return []
+    out: List[PairSpec] = []
+    for elt in pairs_node.elts:
+        if not isinstance(elt, ast.Call):
+            continue
+        pos = [_literal(a, consts) for a in elt.args]
+        kw: Dict[str, ast.AST] = {k.arg: k.value
+                                  for k in elt.keywords if k.arg}
+        name = pos[0] if pos else _literal(kw.get('name'), consts)
+        scope = (pos[1] if len(pos) > 1
+                 else _literal(kw.get('scope'), consts))
+        if name is None or scope is None:
+            continue
+        start = _literal(kw.get('start'), consts) or f'{name}_start'
+        end = _literal(kw.get('end'), consts) or f'{name}_end'
+        status_field = _literal(kw.get('status_field'), consts)
+        statuses: Optional[Tuple[str, ...]] = None
+        st = kw.get('statuses')
+        if isinstance(st, (ast.Tuple, ast.List)):
+            vals = [_literal(e, consts) for e in st.elts]
+            if all(v is not None for v in vals):
+                statuses = tuple(vals)  # type: ignore[arg-type]
+        out.append(PairSpec(name, start, end, scope, status_field,
+                            statuses))
+    return out
+
+
+def _guard_nodes(fn: ast.AST) -> List[ast.AST]:
+    """Every statement living under a `finally:` or `except:` of the
+    function — the regions where an end-emit is exception-guaranteed."""
+    guarded: List[ast.AST] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Try):
+            for stmt in node.finalbody:
+                guarded.extend(ast.walk(stmt))
+            for handler in node.handlers:
+                for stmt in handler.body:
+                    guarded.extend(ast.walk(stmt))
+    return guarded
+
+
+class JournalProtocolPass(core.Pass):
+
+    name = 'journal-protocol'
+    rules = ('journal-protocol-unregistered', 'journal-protocol-stale',
+             'journal-unguarded-start', 'journal-protocol-status')
+    description = ('paired journal events match the event_protocol '
+                   'table; _start emits guarantee their _end on '
+                   'exception paths; terminal statuses are from the '
+                   'allowed set')
+
+    def run(self, idx: index_lib.PackageIndex) \
+            -> Iterator[core.Finding]:
+        pairs = load_protocol(idx)
+        if not pairs:
+            return
+        by_start = {p.start: p for p in pairs}
+        by_end = {p.end: p for p in pairs}
+        sites = journal_events.collect_emit_sites(idx)
+
+        emitted: Dict[str, Tuple[str, int]] = {}
+        for site in sites:
+            for name in site.names or ():
+                emitted.setdefault(name, (site.rel, site.line))
+
+        # Unregistered lifecycles: the _start/_end naming convention IS
+        # the registration trigger (asymmetric pairs like rank_exit or
+        # kv_pages_alloc/free register through their table row).
+        registered = set(by_start) | set(by_end)
+        for name in sorted(emitted):
+            if not (name.endswith('_start') or name.endswith('_end')):
+                continue
+            if name in registered:
+                continue
+            rel, line = emitted[name]
+            yield core.Finding(
+                'journal-protocol-unregistered', rel, line,
+                f'paired event {name!r} is not in the '
+                f'{PROTOCOL_MODULE} protocol table — register the '
+                f'lifecycle (scope + terminal statuses) so the chaos '
+                f'invariants can replay it')
+
+        for p in pairs:
+            for which, event in (('start', p.start), ('end', p.end)):
+                if event not in emitted:
+                    yield core.Finding(
+                        'journal-protocol-stale', PROTOCOL_MODULE, 0,
+                        f'protocol table row {p.name!r} names {which} '
+                        f'event {event!r} that no code emits — delete '
+                        f'the row or restore the emitter')
+
+        # Guard check: invocation-scoped starts emitted by a direct
+        # append/wrapper need a finally/except end in the SAME function.
+        for site in sites:
+            if site.kind == 'span' or site.names is None:
+                continue
+            for name in site.names:
+                p = by_start.get(name)
+                if p is None or p.scope != 'invocation':
+                    continue
+                if self._guarded(idx, sites, site, p):
+                    continue
+                yield core.Finding(
+                    'journal-unguarded-start', site.rel, site.line,
+                    f'{p.start!r} is emitted without a guaranteed '
+                    f'{p.end!r} on exception paths — emit the end '
+                    f'from a finally/except in this function (or use '
+                    f'ControlSpan), else a crash here reads as a '
+                    f'lifecycle that never terminated')
+
+        # Terminal-status check at end-emit sites.
+        for site in sites:
+            if site.names is None:
+                continue
+            for name in site.names:
+                p = by_end.get(name)
+                if p is None or not p.statuses or not p.status_field:
+                    continue
+                if site.kind == 'span':
+                    continue  # ControlSpan stamps 'ok'/<exc name>
+                for kwarg in site.call.keywords:
+                    if kwarg.arg != p.status_field:
+                        continue
+                    value = kwarg.value
+                    if isinstance(value, ast.Constant) and \
+                            isinstance(value.value, str) and \
+                            value.value not in p.statuses:
+                        yield core.Finding(
+                            'journal-protocol-status', site.rel,
+                            site.line,
+                            f'{p.end!r} emitted with '
+                            f'{p.status_field}={value.value!r}, not an '
+                            f'allowed terminal status '
+                            f'({"/".join(p.statuses)}) — the chaos '
+                            f'invariants will reject it at replay')
+
+    @staticmethod
+    def _guarded(idx: index_lib.PackageIndex,
+                 sites: List[journal_events.EmitSite],
+                 start_site: journal_events.EmitSite,
+                 p: PairSpec) -> bool:
+        fn = idx.functions.get(start_site.func)
+        if fn is None:
+            return False
+        # __enter__ emitting the start with the end in the same class's
+        # __exit__ IS the context-manager guarantee (ControlSpan-style
+        # implementations).
+        qual = start_site.func[1]
+        if qual.endswith('.__enter__'):
+            cls = qual.rsplit('.', 1)[0]
+            exit_key = (start_site.func[0], f'{cls}.__exit__')
+            for other in sites:
+                if other.func == exit_key and p.end in (other.names
+                                                        or ()):
+                    return True
+        guarded_ids = {id(n) for n in _guard_nodes(fn.node)}
+        for other in sites:
+            if other.func != start_site.func:
+                continue
+            if p.end not in (other.names or ()):
+                continue
+            if id(other.call) in guarded_ids:
+                return True
+        return False

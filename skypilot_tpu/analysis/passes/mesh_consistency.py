@@ -1,0 +1,348 @@
+"""`mesh-*`: sharding axis names are real mesh axes, and donated jit
+arguments are not reused.
+
+Tracer-safety v2, for the failure modes that only surface on real TPU
+meshes (CPU emulation shards trivially, so tier-1 tests can't see
+them):
+
+- **mesh-unknown-axis** — a string axis name in a
+  `PartitionSpec(...)` (including through a `P = jax.sharding.
+  PartitionSpec` alias, and therefore every `NamedSharding` /
+  `with_sharding_constraint` / `device_put` built on one) must be an
+  axis of a mesh some call site in the package constructs.  The known
+  set is derived from the ASTs: `Mesh(devices, axis_names)` arguments
+  (resolved through local/module constants like
+  `DCN_AXES + ICI_AXES`), plus the literal keys of axis dicts
+  returned by `*axes*` factory functions (`slice_axes`).  A typo'd
+  axis passes every CPU test and fails only when GSPMD partitions on
+  hardware.
+- **mesh-donated-reuse** — an argument donated to a jitted function
+  (`donate_argnums`) whose buffer is read again after the call: the
+  donated buffer is invalid, and XLA's error (or silent alias) only
+  reproduces on device.  Flagged when a plain-name argument at a
+  donated position is loaded again after the call before being
+  rebound (assignment targets bind AFTER the call's value computes,
+  so `state = step(state)` is clean).
+
+Both checks are conservative: non-literal axis names and non-Name
+donated arguments resolve to "unknown" and are skipped, never
+guessed.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import index as index_lib
+
+
+# ------------------------------------------------------- axis collection
+
+
+def _collect_strs(idx: index_lib.PackageIndex, rel: str,
+                  expr: ast.AST, scope: Optional[ast.AST],
+                  depth: int = 0) -> List[str]:
+    """Literal strings reachable from a constant-ish expression:
+    tuples, concatenation, list()/tuple() wrappers, local and module
+    names, cross-module constants."""
+    if depth > 8 or expr is None:
+        return []
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [expr.value]
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in expr.elts:
+            out.extend(_collect_strs(idx, rel, elt, scope, depth + 1))
+        return out
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        return (_collect_strs(idx, rel, expr.left, scope, depth + 1) +
+                _collect_strs(idx, rel, expr.right, scope, depth + 1))
+    if isinstance(expr, ast.Call) and \
+            idx.callee_name(expr) in ('list', 'tuple') and expr.args:
+        return _collect_strs(idx, rel, expr.args[0], scope, depth + 1)
+    if isinstance(expr, ast.Name):
+        out = []
+        if scope is not None:
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == expr.id
+                        for t in node.targets):
+                    out.extend(_collect_strs(idx, rel, node.value,
+                                             scope, depth + 1))
+        if not out:
+            mod = idx.modules.get(rel)
+            if mod is not None:
+                for node in mod.tree.body:
+                    if isinstance(node, ast.Assign) and any(
+                            isinstance(t, ast.Name) and
+                            t.id == expr.id for t in node.targets):
+                        out.extend(_collect_strs(idx, rel, node.value,
+                                                 None, depth + 1))
+        return out
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name):
+        trel = idx.resolve_module_alias(rel, expr.value.id)
+        if trel is not None:
+            mod = idx.modules.get(trel)
+            if mod is not None:
+                out = []
+                for node in mod.tree.body:
+                    if isinstance(node, ast.Assign) and any(
+                            isinstance(t, ast.Name) and
+                            t.id == expr.attr for t in node.targets):
+                        out.extend(_collect_strs(idx, trel, node.value,
+                                                 None, depth + 1))
+                return out
+    return []
+
+
+def known_axes(idx: index_lib.PackageIndex) -> Set[str]:
+    """Every axis name some mesh constructor in the package can
+    produce, plus the logical axis names a *_AXIS_RULES table maps to
+    mesh axes (PartitionSpecs fed through logical_to_mesh_sharding
+    legitimately carry those)."""
+    axes: Set[str] = set()
+    by_module: Dict[str, List[ast.AST]] = {}
+    for (frel, qual), fn in sorted(idx.functions.items()):
+        by_module.setdefault(frel, []).append(fn.node)
+        # Axis-dict factories: literal keys of dicts returned by
+        # functions whose name mentions 'axes' (slice_axes).
+        if 'axes' in qual.lower():
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Return) and \
+                        isinstance(node.value, ast.Dict):
+                    for key in node.value.keys:
+                        if isinstance(key, ast.Constant) and \
+                                isinstance(key.value, str):
+                            axes.add(key.value)
+    for rel, mod in sorted(idx.modules.items()):
+        text = '\n'.join(mod.lines)
+        if 'Mesh(' not in text and 'AXIS_RULES' not in text:
+            continue
+        # Mesh(devices, axis_names) calls, resolved per enclosing
+        # function (axis_names is typically a local).
+        scopes: List[Tuple[Optional[ast.AST], ast.AST]] = \
+            [(None, mod.tree)]
+        scopes.extend((fn, fn) for fn in by_module.get(rel, []))
+        for scope, tree in scopes:
+            for call in idx.iter_calls(tree):
+                if idx.callee_name(call) != 'Mesh':
+                    continue
+                names_arg: Optional[ast.AST] = None
+                if len(call.args) >= 2:
+                    names_arg = call.args[1]
+                for kw in call.keywords:
+                    if kw.arg == 'axis_names':
+                        names_arg = kw.value
+                if names_arg is not None:
+                    axes.update(_collect_strs(idx, rel, names_arg,
+                                              scope))
+        # Logical-axis rules tables: ('stage', 'pipeline') pairs in a
+        # module-level *_AXIS_RULES assignment register the logical
+        # name (the rules translate it to a real mesh axis).
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            else:
+                continue
+            if not any(isinstance(t, ast.Name) and
+                       'AXIS_RULES' in t.id for t in targets):
+                continue
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                for elt in node.value.elts:
+                    if isinstance(elt, (ast.Tuple, ast.List)) and \
+                            elt.elts and \
+                            isinstance(elt.elts[0], ast.Constant) and \
+                            isinstance(elt.elts[0].value, str):
+                        axes.add(elt.elts[0].value)
+    return axes
+
+
+def _spec_aliases(idx: index_lib.PackageIndex, rel: str) -> Set[str]:
+    """Local names bound to PartitionSpec (`P = jax.sharding.
+    PartitionSpec`)."""
+    mod = idx.modules.get(rel)
+    aliases: Set[str] = set()
+    if mod is None:
+        return aliases
+    for node in mod.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        try:
+            text = ast.unparse(node.value)
+        except Exception:  # pylint: disable=broad-except
+            continue
+        if text.endswith('PartitionSpec'):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    aliases.add(tgt.id)
+    return aliases
+
+
+# ---------------------------------------------------------- donated jits
+
+
+def _donated_positions(call: ast.Call) -> List[int]:
+    for kw in call.keywords:
+        if kw.arg == 'donate_argnums':
+            out = []
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, int):
+                    out.append(node.value)
+            return out
+    return []
+
+
+class MeshConsistencyPass(core.Pass):
+
+    name = 'mesh-consistency'
+    rules = ('mesh-unknown-axis', 'mesh-donated-reuse')
+    description = ('PartitionSpec axis names exist on a constructed '
+                   'mesh; donated jit arguments are not read after '
+                   'the call')
+
+    def run(self, idx: index_lib.PackageIndex) \
+            -> Iterator[core.Finding]:
+        axes = known_axes(idx)
+        yield from self._check_specs(idx, axes)
+        yield from self._check_donation(idx)
+
+    # -------------------------------------------------------- axis names
+
+    def _check_specs(self, idx: index_lib.PackageIndex,
+                     axes: Set[str]) -> Iterator[core.Finding]:
+        if not axes:
+            return
+        for rel, mod in sorted(idx.modules.items()):
+            if 'PartitionSpec' not in '\n'.join(mod.lines):
+                continue
+            aliases = _spec_aliases(idx, rel) | {'PartitionSpec'}
+            for call in idx.iter_calls(mod.tree):
+                callee = idx.callee_name(call)
+                if callee not in aliases:
+                    continue
+                if callee != 'PartitionSpec' and not \
+                        isinstance(call.func, ast.Name):
+                    continue
+                for arg in list(call.args) + \
+                        [kw.value for kw in call.keywords]:
+                    for elt in ([arg] if not isinstance(
+                            arg, (ast.Tuple, ast.List))
+                            else list(arg.elts)):
+                        if isinstance(elt, ast.Constant) and \
+                                isinstance(elt.value, str) and \
+                                elt.value not in axes:
+                            yield core.Finding(
+                                'mesh-unknown-axis', rel, call.lineno,
+                                f'PartitionSpec axis {elt.value!r} is '
+                                f'not an axis of any mesh this '
+                                f'package constructs '
+                                f'({", ".join(sorted(axes))}) — '
+                                f'GSPMD fails on real TPU meshes')
+
+    # ---------------------------------------------------------- donation
+
+    def _check_donation(self, idx: index_lib.PackageIndex) \
+            -> Iterator[core.Finding]:
+        for rel, mod in sorted(idx.modules.items()):
+            if 'donate_argnums' not in '\n'.join(mod.lines):
+                continue
+            # Donated-jit bindings: `g = jit(f, donate_argnums=...)`
+            # and `self.X = jit(f, donate_argnums=...)`.
+            donated: Dict[str, List[int]] = {}
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Assign) or \
+                        not isinstance(node.value, ast.Call):
+                    continue
+                if idx.callee_name(node.value) != 'jit':
+                    continue
+                positions = _donated_positions(node.value)
+                if not positions:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        donated[tgt.id] = positions
+                    elif isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == 'self':
+                        donated[f'self.{tgt.attr}'] = positions
+            if not donated:
+                continue
+            for (frel, qual), fn in sorted(idx.functions.items()):
+                if frel != rel:
+                    continue
+                yield from self._check_function(rel, fn.node, donated)
+
+    def _check_function(self, rel: str, fn: ast.AST,
+                        donated: Dict[str, List[int]]) \
+            -> Iterator[core.Finding]:
+        # Donated calls in this function: (position, donated arg name).
+        calls: List[Tuple[Tuple[int, int], str, ast.Call]] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            key = None
+            if isinstance(func, ast.Name):
+                key = func.id
+            elif isinstance(func, ast.Attribute) and \
+                    isinstance(func.value, ast.Name) and \
+                    func.value.id == 'self':
+                key = f'self.{func.attr}'
+            if key is None or key not in donated:
+                continue
+            for pos in donated[key]:
+                if pos < len(node.args) and \
+                        isinstance(node.args[pos], ast.Name):
+                    calls.append(((node.lineno, node.col_offset),
+                                  node.args[pos].id, node))
+        if not calls:
+            return
+        # Name events: loads at their own position, stores at the END
+        # of their assignment statement (Python binds targets after the
+        # RHS computes, so `state = step(state)` rebinds cleanly).
+        events: Dict[str, List[Tuple[Tuple[int, int], str]]] = {}
+        watched = {name for _, name, _ in calls}
+        call_arg_ids: Set[int] = set()
+        for _, _, call in calls:
+            call_arg_ids.update(id(n) for n in ast.walk(call))
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    for name in ast.walk(tgt):
+                        if isinstance(name, ast.Name) and \
+                                name.id in watched:
+                            end = (getattr(node, 'end_lineno',
+                                           node.lineno), 10 ** 9)
+                            events.setdefault(name.id, []).append(
+                                (end, 'store'))
+            elif isinstance(node, ast.For):
+                for name in ast.walk(node.target):
+                    if isinstance(name, ast.Name) and \
+                            name.id in watched:
+                        events.setdefault(name.id, []).append(
+                            ((name.lineno, name.col_offset), 'store'))
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.id in watched and \
+                    id(node) not in call_arg_ids:
+                events.setdefault(node.id, []).append(
+                    ((node.lineno, node.col_offset), 'load'))
+        for pos, name, call in calls:
+            after = sorted(e for e in events.get(name, [])
+                           if e[0] > pos)
+            if after and after[0][1] == 'load':
+                yield core.Finding(
+                    'mesh-donated-reuse', rel, after[0][0][0],
+                    f'{name!r} is donated to the jitted call at line '
+                    f'{pos[0]} and read again afterwards — the '
+                    f'donated buffer is invalid on real devices; '
+                    f'rebind the result or drop donate_argnums')

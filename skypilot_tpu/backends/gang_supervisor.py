@@ -115,11 +115,21 @@ def run_gang(job_id: int, spec: Dict[str, Any]) -> int:
                        hosts=hosts)
     events_lib.gang_ranks_gauge().set(len(runners))
 
-    returncodes = _run_gang_native(spec, runners, host_ips, log_dir,
-                                   run_cmd, job_id=job_id)
-    if returncodes is None:
-        returncodes = _run_gang_python(runners, spec, host_ips, log_dir,
+    try:
+        returncodes = _run_gang_native(spec, runners, host_ips, log_dir,
                                        run_cmd, job_id=job_id)
+        if returncodes is None:
+            returncodes = _run_gang_python(runners, spec, host_ips,
+                                           log_dir, run_cmd,
+                                           job_id=job_id)
+    except BaseException:
+        # The opened gang lifecycle must terminate even when the
+        # supervisor itself dies (journal-replay invariants would
+        # otherwise read a crash here as a gang that never finished).
+        if journal is not None:
+            journal.append('gang_end', job_id=job_id, status='error',
+                           returncodes={})
+        raise
 
     ok = bool(returncodes) and all(rc == 0
                                    for rc in returncodes.values())

@@ -42,8 +42,19 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, List, Sequence
 
+from skypilot_tpu.observability import event_protocol
 
 Event = Dict[str, Any]
+
+# Lifecycle names and terminal statuses come from the shared paired-
+# event protocol table (observability/event_protocol.py): the same
+# table `sky lint`'s journal-protocol pass verifies the emit sites
+# against, so checkers and emitters cannot drift apart.
+_QUEUED_WAIT = event_protocol.BY_NAME['queued_wait']
+_CHECKPOINT_SAVE = event_protocol.BY_NAME['checkpoint_save']
+_KV_PAGES = event_protocol.BY_NAME['kv_pages']
+_KV_HANDOFF = event_protocol.BY_NAME['kv_handoff']
+_REPLICA_DRAIN = event_protocol.BY_NAME['replica_drain']
 
 
 def merge(*event_lists: Sequence[Event]) -> List[Event]:
@@ -131,11 +142,11 @@ def queued_wait_terminal(events: Sequence[Event]) -> List[str]:
     violations = []
     open_waits = 0
     for e in events:
-        if e.get('event') == 'queued_wait_start':
+        if e.get('event') == _QUEUED_WAIT.start:
             open_waits += 1
-        elif e.get('event') == 'queued_wait_end':
+        elif e.get('event') == _QUEUED_WAIT.end:
             open_waits -= 1
-            if e.get('status') not in ('granted', 'timeout'):
+            if e.get('status') not in _QUEUED_WAIT.statuses:
                 violations.append(
                     f'queued_wait_end has non-terminal status '
                     f'{e.get("status")!r}')
@@ -204,9 +215,9 @@ def checkpoint_liveness(events: Sequence[Event]) -> List[str]:
     open_saves = 0
     for e in events:
         name = e.get('event')
-        if name == 'checkpoint_save_start':
+        if name == _CHECKPOINT_SAVE.start:
             open_saves += 1
-        elif name == 'checkpoint_save_end':
+        elif name == _CHECKPOINT_SAVE.end:
             open_saves -= 1
             if not e.get('status'):
                 violations.append(
@@ -229,10 +240,10 @@ def page_pool_balance(events: Sequence[Event]) -> List[str]:
     outstanding: Dict[int, int] = {}
     for e in events:
         name = e.get('event')
-        if name == 'kv_pages_alloc':
+        if name == _KV_PAGES.start:
             for p in (e.get('pages') or []):
                 outstanding[p] = outstanding.get(p, 0) + 1
-        elif name == 'kv_pages_free':
+        elif name == _KV_PAGES.end:
             for p in (e.get('pages') or []):
                 held = outstanding.get(p, 0)
                 if held <= 0:
@@ -277,10 +288,10 @@ def handoff_consistency(events: Sequence[Event]) -> List[str]:
     open_handoffs: Dict[str, int] = {}
     for e in events:
         name = e.get('event')
-        if name == 'kv_handoff_start':
+        if name == _KV_HANDOFF.start:
             rid = e.get('request_id', '?')
             open_handoffs[rid] = open_handoffs.get(rid, 0) + 1
-        elif name == 'kv_handoff_end':
+        elif name == _KV_HANDOFF.end:
             rid = e.get('request_id', '?')
             held = open_handoffs.get(rid, 0)
             if held <= 0:
@@ -288,10 +299,11 @@ def handoff_consistency(events: Sequence[Event]) -> List[str]:
                     f'kv_handoff_end for {rid} without a start')
             else:
                 open_handoffs[rid] = held - 1
-            if e.get('status') not in ('ok', 'fallback'):
+            if e.get('status') not in _KV_HANDOFF.statuses:
                 violations.append(
                     f'kv_handoff_end for {rid} carries status '
-                    f'{e.get("status")!r} (want ok/fallback)')
+                    f'{e.get("status")!r} (want one of '
+                    f'{"/".join(_KV_HANDOFF.statuses)})')
     dangling = [rid for rid, n in open_handoffs.items() if n > 0]
     if dangling:
         violations.append(
@@ -345,11 +357,11 @@ def drain_no_lost_requests(events: Sequence[Event]) -> List[str]:
     for e in events:
         name = e.get('event')
         key = (e.get('service'), e.get('replica_id'))
-        if name == 'replica_drain_start':
+        if name == _REPLICA_DRAIN.start:
             open_drains[key] = open_drains.get(key, 0) + 1
-        elif name == 'replica_drain_end':
+        elif name == _REPLICA_DRAIN.end:
             open_drains[key] = open_drains.get(key, 0) - 1
-            if e.get('reason') not in ('drained', 'timeout', 'dead'):
+            if e.get('reason') not in _REPLICA_DRAIN.statuses:
                 violations.append(
                     f'replica_drain_end for {key} carries unknown '
                     f'reason {e.get("reason")!r}')

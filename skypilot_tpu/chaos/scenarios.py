@@ -75,6 +75,7 @@ from skypilot_tpu.chaos import faults as faults_lib
 from skypilot_tpu.chaos import injector
 from skypilot_tpu.chaos import invariants
 from skypilot_tpu.observability import events as events_lib
+from skypilot_tpu.serve import http_protocol
 
 logger = sky_logging.init_logger(__name__)
 
@@ -900,7 +901,8 @@ def handoff_fallback(seed: int) -> ScenarioResult:
             responses = []
             for _ in range(2):
                 responses.append(requests.post(
-                    f'http://127.0.0.1:{lb_port}/generate',
+                    f'http://127.0.0.1:{lb_port}'
+                    f'{http_protocol.GENERATE}',
                     json={'prompt_ids': [prompt],
                           'max_new_tokens': 4},
                     timeout=120))
@@ -991,7 +993,7 @@ def _run_replica_rank_death(name: str, seed: int,
 
         def gen(prompt, timeout=120):
             return requests.post(
-                f'{base}/generate',
+                f'{base}{http_protocol.GENERATE}',
                 json={'prompt_ids': [prompt], 'max_new_tokens': 4},
                 timeout=timeout)
 
@@ -1248,7 +1250,8 @@ def drain_under_load(seed: int) -> ScenarioResult:
                           [3, 5, 7, 9, 11, 13, 15, 17] * 2 + [19, 21])
                 try:
                     resp = requests.post(
-                        f'http://127.0.0.1:{lb_port}/generate',
+                        f'http://127.0.0.1:{lb_port}'
+                    f'{http_protocol.GENERATE}',
                         json={'prompt_ids': [prompt],
                               'max_new_tokens': 6}, timeout=60)
                     code = resp.status_code

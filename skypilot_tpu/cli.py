@@ -829,6 +829,7 @@ def _serve_lb_table(records) -> None:
     import requests  # pylint: disable=import-outside-toplevel
 
     from skypilot_tpu.observability import metrics as metrics_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import http_protocol  # pylint: disable=import-outside-toplevel
     rows = []
     for r in records:
         lb_port = r.get('load_balancer_port')
@@ -836,7 +837,8 @@ def _serve_lb_table(records) -> None:
             continue
         try:
             resp = requests.get(
-                f'http://127.0.0.1:{lb_port}/lb/metrics', timeout=5)
+                f'http://127.0.0.1:{lb_port}'
+                f'{http_protocol.LB_METRICS}', timeout=5)
             resp.raise_for_status()
             parsed = metrics_lib.parse_exposition(resp.text)
             age = sum((parsed.get(
@@ -864,6 +866,7 @@ def _serve_metrics_table(records) -> None:
     import requests  # pylint: disable=import-outside-toplevel
 
     from skypilot_tpu.observability import metrics as metrics_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import http_protocol  # pylint: disable=import-outside-toplevel
 
     def fmt_ms(seconds):
         return '-' if seconds is None else (
@@ -879,7 +882,8 @@ def _serve_metrics_table(records) -> None:
             role = rep.get('role') or 'mixed'
             num_hosts = rep.get('num_hosts') or 1
             try:
-                resp = requests.get(url + '/metrics', timeout=5)
+                resp = requests.get(url + http_protocol.METRICS,
+                                    timeout=5)
                 resp.raise_for_status()
                 parsed = metrics_lib.parse_exposition(resp.text)
             except (requests.RequestException, ValueError) as e:
@@ -1065,12 +1069,15 @@ def _fetch_telemetry(record) -> Optional[Dict[str, Any]]:
     controller is unreachable — `serve top` then shows fleet state
     only)."""
     import requests  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.serve import http_protocol  # pylint: disable=import-outside-toplevel
     port = record.get('controller_port')
     if not port:
         return None
     try:
         resp = requests.get(
-            f'http://127.0.0.1:{port}/controller/telemetry',
+            f'http://127.0.0.1:{port}'
+            f'{http_protocol.CONTROLLER_TELEMETRY}',
             timeout=5)
         resp.raise_for_status()
         return resp.json()
@@ -1413,6 +1420,30 @@ def chaos_run(scenario, seed, export_trace):
             f'{len(result.violations)} invariant violation(s).')
 
 
+def _changed_package_files(pkg_root) -> Optional[set]:
+    """Package-relative paths of files touched vs git HEAD (staged,
+    unstaged, and untracked); None when git is unavailable — the
+    caller then falls back to the full-tree report."""
+    import pathlib  # pylint: disable=import-outside-toplevel
+    import subprocess  # pylint: disable=import-outside-toplevel
+    repo_root = pathlib.Path(pkg_root).parent
+    try:
+        out = subprocess.run(
+            ['git', 'status', '--porcelain', '--untracked-files=all'],
+            cwd=repo_root, capture_output=True, text=True, timeout=10,
+            check=True).stdout
+    except (OSError, subprocess.SubprocessError):
+        return None
+    changed = set()
+    prefix = pathlib.Path(pkg_root).name + '/'
+    for line in out.splitlines():
+        # XY <path> (or `XY <old> -> <new>` for renames: take the new).
+        path = line[3:].split(' -> ')[-1].strip().strip('"')
+        if path.startswith(prefix):
+            changed.add(path[len(prefix):])
+    return changed
+
+
 @cli.command()
 @click.option('--rule', 'rules', multiple=True,
               help='Run only the passes owning these rule ids '
@@ -1422,11 +1453,17 @@ def chaos_run(scenario, seed, export_trace):
                    'identical across runs on one tree).')
 @click.option('--list-rules', is_flag=True, default=False,
               help='Print the rule catalog and exit.')
+@click.option('--changed', 'changed_only', is_flag=True, default=False,
+              help='Report only findings in files changed vs git HEAD '
+                   '(staged/unstaged/untracked).  The FULL package is '
+                   'still indexed and every pass still runs — cross-'
+                   'module contracts need the whole tree — only the '
+                   'report is filtered, for fast fix iteration.')
 @click.option('--update-baseline', is_flag=True, default=False,
               help='Grandfather every current unsuppressed finding '
                    'into lint-baseline.json (the file only shrinks '
                    'after that: stale entries fail lint).')
-def lint(rules, as_json, list_rules, update_baseline):
+def lint(rules, as_json, list_rules, changed_only, update_baseline):
     """Static analysis over the whole package (AST-only, no imports).
 
     Exit 1 on unsuppressed findings.  Rule catalog, suppression
@@ -1440,6 +1477,10 @@ def lint(rules, as_json, list_rules, update_baseline):
         for rule, owner in sorted(lint_core.rule_catalog().items()):
             click.echo(f'{rule:24s} {owner}')
         return
+    if changed_only and update_baseline:
+        raise click.ClickException(
+            '--changed filters the report; the baseline must be '
+            'written from a full run.')
     pkg_root = pathlib.Path(__file__).resolve().parent
     baseline = pkg_root.parent / lint_core.BASELINE_FILENAME
     idx = analysis.PackageIndex(pkg_root)
@@ -1449,6 +1490,18 @@ def lint(rules, as_json, list_rules, update_baseline):
             baseline_path=baseline if baseline.is_file() else None)
     except ValueError as e:   # unknown --rule
         raise click.ClickException(str(e))
+    if changed_only:
+        changed = _changed_package_files(pkg_root)
+        if changed is None:
+            click.echo('git unavailable; reporting the full tree.',
+                       err=True)
+        else:
+            result.findings = [f for f in result.findings
+                               if f.file in changed]
+            result.suppressed = [f for f in result.suppressed
+                                 if f.file in changed]
+            result.baselined = [f for f in result.baselined
+                                if f.file in changed]
     if update_baseline:
         # Keep still-reproducing grandfathered findings, add the new
         # ones; never baseline the framework's own meta-findings.

@@ -396,33 +396,41 @@ class AsyncCheckpointManager:
         t0 = time.monotonic()
         attempts = 0
         backoff = self.retry_backoff_s
-        status = 'ok'
-        while True:
-            attempts += 1
-            try:
-                # Chaos site: a raise here is a bucket-write flake; the
-                # retry loop below is the code under test.
-                chaos_injector.inject('checkpoint.save', step=step,
-                                      attempt=attempts,
-                                      directory=self.directory)
-                self._mgr.save(step, args=ocp.args.StandardSave(snapshot),
-                               force=True)
-                self._mgr.wait_until_finished()
-                self.saves_ok += 1
-                break
-            except Exception as e:  # pylint: disable=broad-except
-                if attempts > self.max_retries:
-                    status = type(e).__name__
-                    self.last_error = e
-                    self.saves_failed += 1
-                    logger.warning(
-                        f'checkpoint save at step {step} failed after '
-                        f'{attempts} attempt(s): {e}')
+        # 'interrupted' survives only when something non-retryable
+        # (worker shutdown, KeyboardInterrupt) escapes the loop: the
+        # finally below still closes the checkpoint_save lifecycle, so
+        # an abandoned in-flight save is diagnosable from the journal.
+        status = 'interrupted'
+        try:
+            while True:
+                attempts += 1
+                try:
+                    # Chaos site: a raise here is a bucket-write flake;
+                    # the retry loop below is the code under test.
+                    chaos_injector.inject('checkpoint.save', step=step,
+                                          attempt=attempts,
+                                          directory=self.directory)
+                    self._mgr.save(step,
+                                   args=ocp.args.StandardSave(snapshot),
+                                   force=True)
+                    self._mgr.wait_until_finished()
+                    self.saves_ok += 1
+                    status = 'ok'
                     break
-                time.sleep(backoff)
-                backoff *= 2
-        duration = time.monotonic() - t0
-        events_lib.checkpoint_save_hist().observe(duration)
-        self._journal.append('checkpoint_save_end', step=step,
-                             status=status, attempts=attempts,
-                             duration_s=round(duration, 6))
+                except Exception as e:  # pylint: disable=broad-except
+                    if attempts > self.max_retries:
+                        status = type(e).__name__
+                        self.last_error = e
+                        self.saves_failed += 1
+                        logger.warning(
+                            f'checkpoint save at step {step} failed '
+                            f'after {attempts} attempt(s): {e}')
+                        break
+                    time.sleep(backoff)
+                    backoff *= 2
+        finally:
+            duration = time.monotonic() - t0
+            events_lib.checkpoint_save_hist().observe(duration)
+            self._journal.append('checkpoint_save_end', step=step,
+                                 status=status, attempts=attempts,
+                                 duration_s=round(duration, 6))

@@ -102,6 +102,25 @@ class JobsController:
         state.set_status(job_id, task_id, state.ManagedJobStatus.STARTING)
         journal.append('task_start', job_id=job_id, task_id=task_id,
                        task=task.name, cluster=cluster_name)
+        # The task lifecycle must terminate on EVERY exit — early
+        # failure, cancellation, a controller exception mid-supervision
+        # — so the end event is emitted from one finally; the
+        # supervision loop records the terminal status into `end`
+        # ('error' survives only when an exception escapes it).
+        end = {'status': 'error', 'recoveries': 0}
+        try:
+            return self._supervise_task(task_id, task, cluster_name,
+                                        journal, end)
+        finally:
+            journal.append('task_end', job_id=job_id, task_id=task_id,
+                           **end)
+
+    def _supervise_task(self, task_id: int, task, cluster_name: str,
+                        journal, end: dict) -> bool:
+        """Launch + babysit one task; writes the terminal status and
+        recovery count into `end` (journaled as task_end by the
+        caller's finally)."""
+        job_id = self.job_id
         strategy = recovery_strategy.StrategyExecutor.make(
             cluster_name, task, job_id=job_id, task_id=task_id)
         try:
@@ -110,6 +129,9 @@ class JobsController:
             state.set_status(
                 job_id, task_id, state.ManagedJobStatus.FAILED_NO_RESOURCE,
                 failure_reason=common_utils.format_exception(e))
+            end.update(
+                status=state.ManagedJobStatus.FAILED_NO_RESOURCE.value,
+                recoveries=strategy.recovery_attempts)
             return False
         state.set_status(job_id, task_id, state.ManagedJobStatus.RUNNING)
 
@@ -119,6 +141,8 @@ class JobsController:
                 strategy.cleanup_cluster()
                 state.set_status(job_id, task_id,
                                  state.ManagedJobStatus.CANCELLED)
+                end.update(status=state.ManagedJobStatus.CANCELLED.value,
+                           recoveries=strategy.recovery_attempts)
                 return False
 
             job_status = self._query_job_status(cluster_name,
@@ -126,9 +150,8 @@ class JobsController:
             if job_status is job_lib.JobStatus.SUCCEEDED:
                 state.set_status(job_id, task_id,
                                  state.ManagedJobStatus.SUCCEEDED)
-                journal.append('task_end', job_id=job_id,
-                               task_id=task_id, status='SUCCEEDED',
-                               recoveries=strategy.recovery_attempts)
+                end.update(status='SUCCEEDED',
+                           recoveries=strategy.recovery_attempts)
                 strategy.cleanup_cluster()
                 return True
             if job_status in (job_lib.JobStatus.FAILED,
@@ -164,6 +187,10 @@ class JobsController:
                             state.ManagedJobStatus.FAILED_NO_RESOURCE,
                             failure_reason=common_utils.format_exception(
                                 e))
+                        end.update(
+                            status=state.ManagedJobStatus
+                            .FAILED_NO_RESOURCE.value,
+                            recoveries=strategy.recovery_attempts)
                         return False
                     state.set_status(job_id, task_id,
                                      state.ManagedJobStatus.RUNNING)
@@ -213,15 +240,15 @@ class JobsController:
                     job_id, task_id, failed_status,
                     failure_reason=failure_reason,
                     last_recovery_reason=recovery_reason)
-                journal.append('task_end', job_id=job_id,
-                               task_id=task_id,
-                               status=failed_status.value,
-                               recoveries=strategy.recovery_attempts)
+                end.update(status=failed_status.value,
+                           recoveries=strategy.recovery_attempts)
                 strategy.cleanup_cluster()
                 return False
             if job_status is job_lib.JobStatus.CANCELLED:
                 state.set_status(job_id, task_id,
                                  state.ManagedJobStatus.CANCELLED)
+                end.update(status=state.ManagedJobStatus.CANCELLED.value,
+                           recoveries=strategy.recovery_attempts)
                 return False
             if job_status is None:
                 # Cannot read the job queue: cluster preempted, hardware
@@ -251,6 +278,10 @@ class JobsController:
                             state.ManagedJobStatus.FAILED_NO_RESOURCE,
                             failure_reason=common_utils.format_exception(
                                 e))
+                        end.update(
+                            status=state.ManagedJobStatus
+                            .FAILED_NO_RESOURCE.value,
+                            recoveries=strategy.recovery_attempts)
                         return False
                     state.set_status(job_id, task_id,
                                      state.ManagedJobStatus.RUNNING)

@@ -48,6 +48,7 @@ import requests
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.serve import http_protocol
 
 logger = sky_logging.init_logger(__name__)
 
@@ -355,7 +356,8 @@ class FleetAggregator:
     def _scrape_one(self, target: Dict[str, Any], now: float) -> None:
         url = target['url'].rstrip('/')
         kind = target.get('kind', 'replica')
-        path = '/lb/metrics' if kind == 'lb' else '/metrics'
+        path = (http_protocol.LB_METRICS if kind == 'lb'
+                else http_protocol.METRICS)
         resp = requests.get(url + path, timeout=self.timeout)
         resp.raise_for_status()
         parsed = metrics_lib.parse_exposition(resp.text)
@@ -402,7 +404,7 @@ class FleetAggregator:
         into the bounded slowest-traces list (`sky serve top`'s
         SLOWEST TRACES table)."""
         since = self._span_since.get(url, 0.0)
-        resp = requests.get(url + '/spans',
+        resp = requests.get(url + http_protocol.SPANS,
                             params={'since': since or None},
                             timeout=self.timeout)
         if resp.status_code != 200:

@@ -1,0 +1,107 @@
+"""Machine-readable protocol table for paired journal events.
+
+The flight-recorder journals (observability/events.py) carry paired
+lifecycle events — `<base>_start`/`<base>_end`, `kv_pages_alloc`/
+`kv_pages_free`, `rank_start`/`rank_exit` — that two independent
+consumers must agree on:
+
+- the chaos invariant checkers (`chaos/invariants.py`) replay journals
+  and demand that every opened lifecycle terminates with an allowed
+  terminal status;
+- `sky lint`'s journal-protocol pass (analysis/passes/
+  journal_protocol.py) statically verifies every emit site against
+  this table: a paired event the table does not name, a `_start` whose
+  `_end` is not guaranteed on exception paths, or an end emitted with
+  a status outside the allowed set is a finding.
+
+This module is pure data (no imports from the package) so both the
+runtime checkers and the AST-only lint plane can share it.  Scopes:
+
+- ``invocation`` — start and end belong to ONE function invocation;
+  the end must be reachable on exception paths (a `finally`/`except`
+  emit, or the ControlSpan context manager).  Lint enforces this.
+- ``process`` — a state machine spanning calls or processes (a drain
+  opened by the controller and closed by the drain monitor, an SLO
+  breach opened on one evaluate() and closed on a later one).  Only
+  journal replay (the invariants) can check these.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+SCOPE_INVOCATION = 'invocation'
+SCOPE_PROCESS = 'process'
+
+
+@dataclasses.dataclass(frozen=True)
+class PairedEvents:
+    """One paired-event lifecycle."""
+    name: str                 # lifecycle name (usually the shared base)
+    start: str                # opening event
+    end: str                  # terminal event
+    scope: str                # SCOPE_INVOCATION | SCOPE_PROCESS
+    # The end event's terminal-status field and its allowed literal
+    # values (None = any / dynamic values like exception type names).
+    status_field: Optional[str] = None
+    statuses: Optional[Tuple[str, ...]] = None
+
+
+def _pair(name: str, scope: str,
+          start: Optional[str] = None, end: Optional[str] = None,
+          status_field: Optional[str] = None,
+          statuses: Optional[Tuple[str, ...]] = None) -> PairedEvents:
+    return PairedEvents(name=name,
+                        start=start or f'{name}_start',
+                        end=end or f'{name}_end',
+                        scope=scope, status_field=status_field,
+                        statuses=statuses)
+
+
+# The complete paired-event protocol.  Adding a new `<base>_start` /
+# `<base>_end` (or alloc/free-style) lifecycle anywhere in the package
+# requires a row here — `skytpu lint` fails otherwise — which is what
+# keeps the chaos invariants and the emitters from drifting apart.
+PAIRS: Tuple[PairedEvents, ...] = (
+    # Control-plane phases (ControlSpan context-manager spans: the end
+    # is guaranteed by __exit__, status 'ok' or the exception name).
+    _pair('launch', SCOPE_INVOCATION),
+    _pair('exec', SCOPE_INVOCATION),
+    _pair('optimize', SCOPE_INVOCATION),
+    _pair('provision', SCOPE_INVOCATION),
+    _pair('sync_workdir', SCOPE_INVOCATION),
+    _pair('sync_file_mounts', SCOPE_INVOCATION),
+    _pair('setup', SCOPE_INVOCATION),
+    # Provisioning lifecycles (direct appends).
+    _pair('provision_attempt', SCOPE_INVOCATION,
+          status_field='status', statuses=('ok', 'fail')),
+    _pair('queued_wait', SCOPE_INVOCATION, status_field='status',
+          statuses=('granted', 'timeout', 'error')),
+    # Managed-jobs lifecycles.
+    _pair('task', SCOPE_INVOCATION),
+    _pair('recovery', SCOPE_INVOCATION),
+    # Cluster-job gang execution.
+    _pair('gang', SCOPE_INVOCATION, status_field='status',
+          statuses=('ok', 'fail', 'error')),
+    _pair('rank', SCOPE_PROCESS, start='rank_start', end='rank_exit'),
+    # Training checkpoints (async writer thread).
+    _pair('checkpoint_save', SCOPE_INVOCATION),
+    # Serving lifecycles.
+    _pair('replica_drain', SCOPE_PROCESS, status_field='reason',
+          statuses=('drained', 'timeout', 'dead')),
+    _pair('slo_burn', SCOPE_PROCESS),
+    _pair('kv_handoff', SCOPE_INVOCATION, status_field='status',
+          statuses=('ok', 'fallback', 'error')),
+    _pair('kv_pages', SCOPE_PROCESS, start='kv_pages_alloc',
+          end='kv_pages_free'),
+)
+
+BY_NAME: Dict[str, PairedEvents] = {p.name: p for p in PAIRS}
+BY_START: Dict[str, PairedEvents] = {p.start: p for p in PAIRS}
+BY_END: Dict[str, PairedEvents] = {p.end: p for p in PAIRS}
+
+
+def pair_for_event(event: str) -> Optional[PairedEvents]:
+    """The lifecycle an event opens or closes (None for point
+    events)."""
+    return BY_START.get(event) or BY_END.get(event)

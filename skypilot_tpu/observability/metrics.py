@@ -586,7 +586,8 @@ def start_exposition_server(port: int = 0,
             del args
 
         def do_GET(self):
-            if self.path not in ('/metrics', '/'):
+            from skypilot_tpu.serve import http_protocol  # pylint: disable=import-outside-toplevel
+            if self.path not in (http_protocol.METRICS, '/'):
                 self.send_response(404)
                 self.end_headers()
                 return

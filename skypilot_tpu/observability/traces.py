@@ -29,12 +29,13 @@ from typing import Any, Dict, List, Optional
 import requests
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import http_protocol
 from skypilot_tpu.utils import timeline
 
 logger = sky_logging.init_logger(__name__)
 
 
-def fetch_segments(url: str, path: str = '/spans',
+def fetch_segments(url: str, path: str = http_protocol.SPANS,
                    request_id: Optional[str] = None,
                    since: Optional[float] = None,
                    timeout: float = 5.0) -> List[Dict[str, Any]]:
@@ -67,13 +68,13 @@ def collect(request_id: str, replica_targets: List[Dict[str, Any]],
     `/lb/spans` control path."""
     segments: List[Dict[str, Any]] = []
     if lb_url:
-        for seg in fetch_segments(lb_url, '/lb/spans',
+        for seg in fetch_segments(lb_url, http_protocol.LB_SPANS,
                                   request_id=request_id,
                                   timeout=timeout):
             seg.setdefault('process', 'lb')
             segments.append(seg)
     for target in replica_targets:
-        for seg in fetch_segments(target['url'], '/spans',
+        for seg in fetch_segments(target['url'], http_protocol.SPANS,
                                   request_id=request_id,
                                   timeout=timeout):
             seg.setdefault('process', 'replica')

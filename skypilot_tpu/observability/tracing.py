@@ -37,11 +37,13 @@ import time
 import uuid
 from typing import Any, Deque, Dict, List, Optional
 
+from skypilot_tpu.serve import http_protocol
 from skypilot_tpu.utils import timeline
 
 # Propagated load_balancer -> model_server/async_server -> engine slot;
 # servers echo it on the response so clients can correlate.
-REQUEST_ID_HEADER = 'X-SkyTPU-Request-Id'
+# (Re-exported from the canonical serve/http_protocol.py module.)
+REQUEST_ID_HEADER = http_protocol.REQUEST_ID_HEADER
 
 # Spans kept per store; old spans fall off (a replica serving millions
 # of requests must not grow without bound).

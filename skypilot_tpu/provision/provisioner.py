@@ -64,32 +64,41 @@ def wait_for_queued_capacity(provider: str, cluster_name: str,
     deadline = time.time() + timeout
     interval = 10.0
     polls = 0
-    while True:
-        # Chaos site (cooperative): DENY simulates a queued-resource
-        # request stuck unprovisioned — the poll reports not-granted
-        # without touching the provider.
-        denied = chaos_injector.inject('queued_resource.poll',
-                                       cluster=cluster_name,
-                                       provider=provider,
-                                       polls=polls) is chaos_injector.DENY
-        granted = (False if denied else
-                   provision.wait_capacity(provider, cluster_name))
-        polls += 1
-        waited = time.monotonic() - start
-        if granted:
-            journal.append('queued_wait_end', status='granted',
-                           wait_s=round(waited, 3), polls=polls)
-            events_lib.provision_wait_hist().observe(waited)
-            return True
-        if time.time() >= deadline:
-            journal.append('queued_wait_end', status='timeout',
-                           wait_s=round(waited, 3), polls=polls)
-            events_lib.provision_wait_hist().observe(waited)
-            return False
-        journal.append('queued_wait_poll', wait_s=round(waited, 3),
+    try:
+        while True:
+            # Chaos site (cooperative): DENY simulates a queued-resource
+            # request stuck unprovisioned — the poll reports not-granted
+            # without touching the provider.
+            denied = chaos_injector.inject(
+                'queued_resource.poll', cluster=cluster_name,
+                provider=provider,
+                polls=polls) is chaos_injector.DENY
+            granted = (False if denied else
+                       provision.wait_capacity(provider, cluster_name))
+            polls += 1
+            waited = time.monotonic() - start
+            if granted:
+                journal.append('queued_wait_end', status='granted',
+                               wait_s=round(waited, 3), polls=polls)
+                events_lib.provision_wait_hist().observe(waited)
+                return True
+            if time.time() >= deadline:
+                journal.append('queued_wait_end', status='timeout',
+                               wait_s=round(waited, 3), polls=polls)
+                events_lib.provision_wait_hist().observe(waited)
+                return False
+            journal.append('queued_wait_poll', wait_s=round(waited, 3),
+                           polls=polls)
+            time.sleep(min(interval, max(0.0, deadline - time.time())))
+            interval = min(interval * 1.5, 120.0)
+    except BaseException:
+        # A provider exception (or Ctrl-C) mid-wait must still close
+        # the queued_wait lifecycle: journal replay otherwise reads it
+        # as a wait that never terminated.
+        journal.append('queued_wait_end', status='error',
+                       wait_s=round(time.monotonic() - start, 3),
                        polls=polls)
-        time.sleep(min(interval, max(0.0, deadline - time.time())))
-        interval = min(interval * 1.5, 120.0)
+        raise
 
 
 def post_provision_runtime_setup(

@@ -32,6 +32,7 @@ from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import batching_engine as batching_engine_lib
 from skypilot_tpu.serve import handoff as handoff_lib
+from skypilot_tpu.serve import http_protocol
 from skypilot_tpu.serve import model_server as model_server_lib
 from skypilot_tpu.serve import router as router_lib
 
@@ -502,7 +503,7 @@ class AsyncModelServer:
                 path, _, query = path.partition('?')
                 try:
                     if method == 'GET':
-                        if path == '/metrics':
+                        if path == http_protocol.METRICS:
                             engine = self.server._engine  # pylint: disable=protected-access
                             if engine is not None:
                                 engine.stats()  # freshen gauges
@@ -513,7 +514,7 @@ class AsyncModelServer:
                                  f'{metrics_lib.CONTENT_TYPE}\r\n'
                                  f'Content-Length: {len(text)}\r\n'
                                  f'\r\n').encode() + text)
-                        elif path == '/spans':
+                        elif path == http_protocol.SPANS:
                             # Trace-segment export for cross-process
                             # assembly (sky serve trace).
                             writer.write(_json_response(
@@ -528,7 +529,7 @@ class AsyncModelServer:
                     if method != 'POST':
                         raise _HttpError(404, 'unknown method')
                     ctype = headers.get('content-type') or ''
-                    if (path == '/kv_import' and
+                    if (path == http_protocol.KV_IMPORT and
                             handoff_lib.CONTENT_TYPE_BINARY in ctype):
                         # Binary handoff frame: raw array bytes, no
                         # JSON parse of a megabyte body.
@@ -562,7 +563,7 @@ class AsyncModelServer:
                            tracing.new_request_id())
                     meta = _route_meta(headers)
                     deadline_ms = _deadline_ms(headers)
-                    if path == '/generate':
+                    if path == http_protocol.GENERATE:
                         self._reject_if_draining()
                         one_shot = 'close' in (
                             headers.get('connection') or '').lower()
@@ -578,7 +579,7 @@ class AsyncModelServer:
                             200, payload,
                             {tracing.REQUEST_ID_HEADER: rid}))
                         await writer.drain()
-                    elif path == '/generate_stream':
+                    elif path == http_protocol.GENERATE_STREAM:
                         prompt = req['prompt_ids']
                         if (isinstance(prompt, list) and prompt and
                                 isinstance(prompt[0], list)):
@@ -592,15 +593,15 @@ class AsyncModelServer:
                                            text_mode=False,
                                            route_meta=meta,
                                            deadline_ms=deadline_ms)
-                    elif path == '/generate_text':
+                    elif path == http_protocol.GENERATE_TEXT:
                         await self._generate_text(req, writer, rid,
                                                   meta,
                                                   deadline_ms=deadline_ms)
-                    elif path == '/drain':
+                    elif path == http_protocol.DRAIN:
                         writer.write(_json_response(
                             200, self.server.drain()))
                         await writer.drain()
-                    elif path == '/prefix_export':
+                    elif path == http_protocol.PREFIX_EXPORT:
                         binary = (req.get('wire') == 'binary' or
                                   handoff_lib.CONTENT_TYPE_BINARY in
                                   (headers.get('accept') or ''))
@@ -617,7 +618,7 @@ class AsyncModelServer:
                         else:
                             writer.write(_json_response(200, result))
                         await writer.drain()
-                    elif path == '/prefill_export':
+                    elif path == http_protocol.PREFILL_EXPORT:
                         binary = (req.get('wire') == 'binary' or
                                   handoff_lib.CONTENT_TYPE_BINARY in
                                   (headers.get('accept') or ''))
@@ -641,7 +642,7 @@ class AsyncModelServer:
                         else:
                             writer.write(_json_response(200, result))
                         await writer.drain()
-                    elif path == '/kv_import':
+                    elif path == http_protocol.KV_IMPORT:
                         try:
                             decoded = handoff_lib.decode_payload(req)
                         except handoff_lib.HandoffError as e:

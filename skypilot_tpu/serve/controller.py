@@ -26,6 +26,7 @@ from skypilot_tpu.observability import aggregator as aggregator_lib
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import slo as slo_lib
 from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import http_protocol
 from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
@@ -118,14 +119,14 @@ class SkyServeController:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == '/controller/load_balancer_sync':
+                if self.path == http_protocol.CONTROLLER_SYNC:
                     self._json(200, {
                         'ready_replica_urls':
                             controller.serving_urls(),
                         'ready_replicas':
                             controller.serving_replicas()})
                 elif self.path.split('?', 1)[0] == \
-                        '/controller/telemetry':
+                        http_protocol.CONTROLLER_TELEMETRY:
                     # What `sky serve top` renders: per-role sparkline
                     # series + windowed quantiles out of the
                     # aggregator's ring buffers, SLO status, MFU, and
@@ -137,7 +138,7 @@ class SkyServeController:
             def do_POST(self):
                 length = int(self.headers.get('Content-Length', 0))
                 data = json.loads(self.rfile.read(length) or b'{}')
-                if self.path == '/controller/load_balancer_sync':
+                if self.path == http_protocol.CONTROLLER_SYNC:
                     controller.collect_request_information(
                         data.get('request_timestamps', []),
                         data.get('role_request_timestamps') or {},
@@ -147,10 +148,10 @@ class SkyServeController:
                             controller.serving_urls(),
                         'ready_replicas':
                             controller.serving_replicas()})
-                elif self.path == '/controller/update_service':
+                elif self.path == http_protocol.CONTROLLER_UPDATE:
                     controller.reload_version()
                     self._json(200, {'version': controller.version})
-                elif self.path == '/controller/terminate':
+                elif self.path == http_protocol.CONTROLLER_TERMINATE:
                     controller.stop()
                     self._json(200, {'ok': True})
                 else:

@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import http_protocol
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ServiceStatus
 from skypilot_tpu.utils import common_utils
@@ -179,7 +180,8 @@ def update(task: task_lib.Task, service_name: str) -> int:
         try:
             import requests  # pylint: disable=import-outside-toplevel
             requests.post(
-                f'http://127.0.0.1:{port}/controller/update_service',
+                f'http://127.0.0.1:{port}'
+                f'{http_protocol.CONTROLLER_UPDATE}',
                 json={}, timeout=5)
         except Exception:  # pylint: disable=broad-except
             pass

@@ -1,0 +1,81 @@
+"""Canonical cross-process HTTP protocol: every header and endpoint
+path the serving fleet's processes speak to each other.
+
+The fleet is a multi-process distributed system — two replica HTTP
+fronts (serve/model_server.py threaded, serve/async_server.py asyncio),
+the load balancer's `/lb/` control plane, and the controller's
+`/controller/` endpoint — and the contracts BETWEEN them (which paths
+exist, which headers are stamped and read) used to live as ~30
+scattered string literals.  This module is the single home for those
+literals; `sky lint`'s http-contract pass (analysis/passes/
+http_contract.py) forbids new raw `X-SkyTPU-*` header or endpoint-path
+literals anywhere else in the package and cross-checks client call
+sites against registered routes.
+
+Import direction: this module imports nothing from the package, so
+every layer (router, tracing, servers, CLI) can depend on it.
+`serve/router.py` and `observability/tracing.py` re-export the header
+names they historically owned — existing importers keep working.
+"""
+from __future__ import annotations
+
+# --------------------------------------------------------------- headers
+# Propagated load_balancer -> model_server/async_server -> engine slot;
+# servers echo it on the response so clients can correlate.
+REQUEST_ID_HEADER = 'X-SkyTPU-Request-Id'
+# Routing metadata the LB forwards to the replica (and the replica
+# stamps into the request's span): which role pool served the request,
+# whether prefix affinity hit, and how long the KV handoff took.
+ROUTED_ROLE_HEADER = 'X-SkyTPU-Routed-Role'
+AFFINITY_HEADER = 'X-SkyTPU-Affinity'
+HANDOFF_MS_HEADER = 'X-SkyTPU-Handoff-Ms'
+# Which LB delivery attempt this is (0 = first try, 1 = the one-shot
+# same-role retry).  The retry reuses the request id on a SECOND
+# replica; the attempt tag keeps the two processes' span segments
+# distinct when `sky serve trace` stitches them.
+ATTEMPT_HEADER = 'X-SkyTPU-Attempt'
+# Per-request time budget in milliseconds; propagated LB -> server ->
+# engine slot.  Past it, the request is reaped and its KV pages freed
+# (HTTP 504) instead of decoding to a client that stopped waiting.
+DEADLINE_HEADER = 'X-SkyTPU-Deadline-Ms'
+
+HEADERS = (REQUEST_ID_HEADER, ROUTED_ROLE_HEADER, AFFINITY_HEADER,
+           HANDOFF_MS_HEADER, ATTEMPT_HEADER, DEADLINE_HEADER)
+
+# --------------------------------------------- replica front (both HTTP
+# fronts expose the identical surface; the http-contract pass proves it)
+METRICS = '/metrics'                  # GET: Prometheus exposition
+SPANS = '/spans'                      # GET: trace-segment export
+GENERATE = '/generate'                # POST: batch token generation
+GENERATE_STREAM = '/generate_stream'  # POST: SSE token stream
+GENERATE_TEXT = '/generate_text'      # POST: text in/out (tokenizer)
+PREFILL_EXPORT = '/prefill_export'    # POST: KV handoff, prefill side
+KV_IMPORT = '/kv_import'              # POST: KV handoff, decode side
+DRAIN = '/drain'                      # POST: controller retirement path
+PREFIX_EXPORT = '/prefix_export'      # POST: drain-time sibling handoff
+# Any other GET answers the health/readiness payload (the probe path).
+
+REPLICA_PATHS = (METRICS, SPANS, GENERATE, GENERATE_STREAM,
+                 GENERATE_TEXT, PREFILL_EXPORT, KV_IMPORT, DRAIN,
+                 PREFIX_EXPORT)
+
+# ------------------------------------------------- LB control plane (the
+# `/lb/` prefix is never proxied; the LB answers these itself)
+LB_PREFIX = '/lb/'
+LB_RETIRE = '/lb/retire'              # POST: controller's drain nudge
+LB_METRICS = '/lb/metrics'            # GET: LB process exposition
+LB_SPANS = '/lb/spans'                # GET: LB trace segments
+
+LB_PATHS = (LB_RETIRE, LB_METRICS, LB_SPANS)
+
+# ------------------------------------------------------------ controller
+CONTROLLER_PREFIX = '/controller/'
+CONTROLLER_SYNC = '/controller/load_balancer_sync'   # GET+POST
+CONTROLLER_TELEMETRY = '/controller/telemetry'       # GET: serve top
+CONTROLLER_UPDATE = '/controller/update_service'     # POST
+CONTROLLER_TERMINATE = '/controller/terminate'       # POST
+
+CONTROLLER_PATHS = (CONTROLLER_SYNC, CONTROLLER_TELEMETRY,
+                    CONTROLLER_UPDATE, CONTROLLER_TERMINATE)
+
+PATHS = REPLICA_PATHS + LB_PATHS + CONTROLLER_PATHS

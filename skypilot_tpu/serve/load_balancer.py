@@ -49,6 +49,7 @@ import requests
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing
+from skypilot_tpu.serve import http_protocol
 from skypilot_tpu.serve import router as router_lib
 
 logger = sky_logging.init_logger(__name__)
@@ -123,7 +124,9 @@ _M_HANDOFF_WIRE_BYTES = metrics_lib.counter(
 _REQUEST_ID_KEY = tracing.REQUEST_ID_HEADER.lower()
 
 # Generation endpoints the router may parse (bounded JSON bodies).
-_ROUTABLE_PATHS = ('/generate', '/generate_stream', '/generate_text')
+_ROUTABLE_PATHS = (http_protocol.GENERATE,
+                   http_protocol.GENERATE_STREAM,
+                   http_protocol.GENERATE_TEXT)
 
 
 def _max_route_body() -> int:
@@ -503,7 +506,7 @@ class SkyServeLoadBalancer:
                 self.role_request_timestamps, {}
         try:
             resp = requests.post(
-                self.controller_url + '/controller/load_balancer_sync',
+                self.controller_url + http_protocol.CONTROLLER_SYNC,
                 json={'request_timestamps': timestamps,
                       'role_request_timestamps': role_timestamps},
                 timeout=5)
@@ -626,7 +629,7 @@ class SkyServeLoadBalancer:
             method = parts[0] if parts else ''
             path = (parts[1].split('?', 1)[0] if len(parts) > 1 else '')
             framing = _body_framing(headers)
-            if path.startswith('/lb/'):
+            if path.startswith(http_protocol.LB_PREFIX):
                 # LB control plane (never proxied): the controller's
                 # drain nudge and the LB's own metrics exposition.
                 query = (parts[1].split('?', 1)[1]
@@ -711,7 +714,7 @@ class SkyServeLoadBalancer:
             body = await asyncio.wait_for(
                 reader.readexactly(min(framing[1], _max_route_body())),
                 timeout=30)
-        if method == 'POST' and path == '/lb/retire':
+        if method == 'POST' and path == http_protocol.LB_RETIRE:
             try:
                 url = (json.loads(body or b'{}') or {}).get('url')
             except (json.JSONDecodeError, AttributeError):
@@ -727,7 +730,7 @@ class SkyServeLoadBalancer:
                      f'Content-Type: application/json\r\n'
                      f'Content-Length: {len(payload)}\r\n'
                      f'Connection: close\r\n\r\n').encode() + payload)
-        elif method == 'GET' and path == '/lb/metrics':
+        elif method == 'GET' and path == http_protocol.LB_METRICS:
             self.sync_age()   # freshen the gauge at scrape time
             text = metrics_lib.expose().encode()
             writer.write(
@@ -735,7 +738,7 @@ class SkyServeLoadBalancer:
                  f'Content-Type: {metrics_lib.CONTENT_TYPE}\r\n'
                  f'Content-Length: {len(text)}\r\n'
                  f'Connection: close\r\n\r\n').encode() + text)
-        elif method == 'GET' and path == '/lb/spans':
+        elif method == 'GET' and path == http_protocol.LB_SPANS:
             payload = json.dumps({'segments': self.spans.export(
                 **tracing.parse_span_query(query))}).encode()
             writer.write(
@@ -1146,9 +1149,9 @@ class SkyServeLoadBalancer:
         Wire selection: the binary octet-stream frame by default
         (SKYTPU_LB_HANDOFF_BINARY=0 pins JSON).  A replica that does
         not speak binary — an old export replying JSON, or an old
-        importer 400/404/415-ing the frame — degrades to ONE
-        JSON/base64 attempt before local-prefill fallback, so mixed
-        fleets keep handing off mid-rollout."""
+        importer 400/404-ing the frame — degrades to ONE JSON/base64
+        attempt before local-prefill fallback, so mixed fleets keep
+        handing off mid-rollout."""
         from skypilot_tpu.serve import handoff as handoff_lib  # pylint: disable=import-outside-toplevel
         t0 = time.perf_counter()
         _journal_handoff('kv_handoff_start', request_id=rid,
@@ -1167,7 +1170,7 @@ class SkyServeLoadBalancer:
             if wire == 'binary':
                 export_req['wire'] = 'binary'
                 status, ctype, raw = await self._http_request(
-                    decision.handoff_source, '/prefill_export',
+                    decision.handoff_source, http_protocol.PREFILL_EXPORT,
                     json.dumps(export_req).encode(),
                     'application/json', timeout,
                     accept=handoff_lib.CONTENT_TYPE_BINARY,
@@ -1193,16 +1196,18 @@ class SkyServeLoadBalancer:
                                 if wire == 'binary'
                                 else 'application/json')
                 status, _, _ = await self._http_request(
-                    decision.url, '/kv_import', raw, import_ctype,
+                    decision.url, http_protocol.KV_IMPORT, raw, import_ctype,
                     timeout, extra_headers=rid_header)
-                if wire == 'binary' and status in (400, 404, 415):
-                    # Old decode replica: one JSON retry of the SAME
-                    # pages before giving up on the handoff.
+                if wire == 'binary' and status in (400, 404):
+                    # Old decode replica (one that predates the binary
+                    # wire answers 400 from its JSON parse, or 404):
+                    # one JSON retry of the SAME pages before giving
+                    # up on the handoff.
                     _M_RETRIES.labels(reason='handoff_wire').inc()
                     wire = 'json'
                     export_req.pop('wire', None)
                     status, payload = await self._json_request(
-                        decision.handoff_source, '/prefill_export',
+                        decision.handoff_source, http_protocol.PREFILL_EXPORT,
                         export_req, timeout,
                         extra_headers=rid_header)
                     if status != 200 or not isinstance(payload, dict):
@@ -1211,19 +1216,19 @@ class SkyServeLoadBalancer:
                     raw = json.dumps(payload).encode()
                     wire_bytes = len(raw)
                     status, _ = await self._json_request(
-                        decision.url, '/kv_import', payload, timeout,
+                        decision.url, http_protocol.KV_IMPORT, payload, timeout,
                         extra_headers=rid_header)
                 if status != 200:
                     raise _UpstreamError(f'kv_import -> {status}')
             else:
                 status, payload = await self._json_request(
-                    decision.handoff_source, '/prefill_export',
+                    decision.handoff_source, http_protocol.PREFILL_EXPORT,
                     export_req, timeout, extra_headers=rid_header)
                 if status != 200 or not isinstance(payload, dict):
                     raise _UpstreamError(f'prefill_export -> {status}')
                 wire_bytes = len(json.dumps(payload).encode())
                 status, _ = await self._json_request(
-                    decision.url, '/kv_import', payload, timeout,
+                    decision.url, http_protocol.KV_IMPORT, payload, timeout,
                     extra_headers=rid_header)
                 if status != 200:
                     raise _UpstreamError(f'kv_import -> {status}')
@@ -1235,6 +1240,14 @@ class SkyServeLoadBalancer:
             _journal_handoff('kv_handoff_end', request_id=rid,
                              status='fallback', error=str(e))
             return None
+        except BaseException as e:
+            # Anything else (task cancellation on LB shutdown, a bug):
+            # the opened kv_handoff lifecycle must still terminate or
+            # the journal reads as a router that hung mid-handoff
+            # (handoff_consistency would blame the wrong component).
+            _journal_handoff('kv_handoff_end', request_id=rid,
+                             status='error', error=str(e))
+            raise
         dt = time.perf_counter() - t0
         _M_HANDOFF.labels(outcome='ok').inc()
         _M_HANDOFF_SECONDS.observe(dt)

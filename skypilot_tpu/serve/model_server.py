@@ -56,6 +56,7 @@ from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import batching_engine as batching_engine_lib
 from skypilot_tpu.serve import handoff as handoff_lib
+from skypilot_tpu.serve import http_protocol
 from skypilot_tpu.serve import router as router_lib
 
 logger = sky_logging.init_logger(__name__)
@@ -684,7 +685,7 @@ def _make_handler(server: ModelServer):
 
         def do_GET(self):
             path, _, query = self.path.partition('?')
-            if path == '/metrics':
+            if path == http_protocol.METRICS:
                 engine = server._engine  # pylint: disable=protected-access
                 if engine is not None:
                     engine.stats()  # freshen the scrape-time gauges
@@ -696,7 +697,7 @@ def _make_handler(server: ModelServer):
                 self.end_headers()
                 self.wfile.write(body)
                 return
-            if path == '/spans':
+            if path == http_protocol.SPANS:
                 # Trace-segment export: this replica's leg of each
                 # request's life, for cross-process assembly
                 # (sky serve trace / the controller aggregator).
@@ -1062,25 +1063,25 @@ def _make_handler(server: ModelServer):
                                 {'error': f'{type(e).__name__}: {e}'})
 
         def do_POST(self):
-            if self.path == '/generate_stream':
+            if self.path == http_protocol.GENERATE_STREAM:
                 self._generate_stream()
                 return
-            if self.path == '/generate_text':
+            if self.path == http_protocol.GENERATE_TEXT:
                 self._generate_text()
                 return
-            if self.path == '/prefill_export':
+            if self.path == http_protocol.PREFILL_EXPORT:
                 self._prefill_export()
                 return
-            if self.path == '/kv_import':
+            if self.path == http_protocol.KV_IMPORT:
                 self._kv_import()
                 return
-            if self.path == '/drain':
+            if self.path == http_protocol.DRAIN:
                 self._drain()
                 return
-            if self.path == '/prefix_export':
+            if self.path == http_protocol.PREFIX_EXPORT:
                 self._prefix_export()
                 return
-            if self.path != '/generate':
+            if self.path != http_protocol.GENERATE:
                 self._reply(404, {'error': 'unknown path'})
                 return
             if self._reject_if_draining():

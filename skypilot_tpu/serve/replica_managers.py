@@ -23,6 +23,7 @@ from skypilot_tpu import status_lib
 from skypilot_tpu.chaos import faults as chaos_faults
 from skypilot_tpu.chaos import injector as chaos_injector
 from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.serve import http_protocol
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.utils import common_utils
@@ -288,7 +289,8 @@ class ReplicaManager:
         if not url:
             return None
         try:
-            resp = requests.post(url + '/drain', json={}, timeout=5)
+            resp = requests.post(url + http_protocol.DRAIN, json={},
+                                 timeout=5)
             if resp.status_code == 200:
                 return resp.json().get('inflight')
         except (requests.RequestException, ValueError):
@@ -308,7 +310,8 @@ class ReplicaManager:
         if not lb_port:
             return
         try:
-            requests.post(f'http://127.0.0.1:{lb_port}/lb/retire',
+            requests.post(f'http://127.0.0.1:{lb_port}'
+                          f'{http_protocol.LB_RETIRE}',
                           json={'url': url}, timeout=2)
         except requests.RequestException:
             pass
@@ -402,7 +405,7 @@ class ReplicaManager:
         pages = 0
         try:
             resp = requests.post(
-                url + '/prefix_export',
+                url + http_protocol.PREFIX_EXPORT,
                 json={'max_pages': max_pages, 'wire': 'binary'},
                 headers={'Accept': handoff_lib.CONTENT_TYPE_BINARY},
                 timeout=30)
@@ -410,7 +413,7 @@ class ReplicaManager:
                 raise requests.RequestException(
                     f'prefix_export -> {resp.status_code}')
             imp = requests.post(
-                sibling + '/kv_import', data=resp.content,
+                sibling + http_protocol.KV_IMPORT, data=resp.content,
                 headers={'Content-Type':
                          handoff_lib.CONTENT_TYPE_BINARY},
                 timeout=30)

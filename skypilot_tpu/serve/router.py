@@ -36,24 +36,19 @@ import os
 import threading
 from typing import Any, Dict, Hashable, List, Optional, Sequence
 
+from skypilot_tpu.serve import http_protocol
+
 ROLES = ('prefill', 'decode', 'mixed')
 DEFAULT_ROLE = 'mixed'
 
-# Routing metadata the LB forwards to the replica (and the replica
-# stamps into the request's span): which role pool served the request,
-# whether prefix affinity hit, and how long the KV handoff took.
-ROUTED_ROLE_HEADER = 'X-SkyTPU-Routed-Role'
-AFFINITY_HEADER = 'X-SkyTPU-Affinity'
-HANDOFF_MS_HEADER = 'X-SkyTPU-Handoff-Ms'
-# Which LB delivery attempt this is (0 = first try, 1 = the one-shot
-# same-role retry).  The retry reuses the request id on a SECOND
-# replica; the attempt tag keeps the two processes' span segments
-# distinct when `sky serve trace` stitches them.
-ATTEMPT_HEADER = 'X-SkyTPU-Attempt'
-# Per-request time budget in milliseconds; propagated LB -> server ->
-# engine slot.  Past it, the request is reaped and its KV pages freed
-# (HTTP 504) instead of decoding to a client that stopped waiting.
-DEADLINE_HEADER = 'X-SkyTPU-Deadline-Ms'
+# Routing metadata headers (re-exported from the canonical protocol
+# module — serve/http_protocol.py — which `sky lint`'s http-contract
+# pass pins as the only home for header literals).
+ROUTED_ROLE_HEADER = http_protocol.ROUTED_ROLE_HEADER
+AFFINITY_HEADER = http_protocol.AFFINITY_HEADER
+HANDOFF_MS_HEADER = http_protocol.HANDOFF_MS_HEADER
+ATTEMPT_HEADER = http_protocol.ATTEMPT_HEADER
+DEADLINE_HEADER = http_protocol.DEADLINE_HEADER
 
 # Prompt tokens (or chars/4 for text prompts) at which a request
 # counts as prefill-heavy and is eligible for prefill-pool handoff.

@@ -19,7 +19,10 @@ from skypilot_tpu.analysis.passes import chaos_sites
 from skypilot_tpu.analysis.passes import concurrency
 from skypilot_tpu.analysis.passes import env_knobs
 from skypilot_tpu.analysis.passes import facade_surface
+from skypilot_tpu.analysis.passes import http_contract
 from skypilot_tpu.analysis.passes import journal_events
+from skypilot_tpu.analysis.passes import journal_protocol
+from skypilot_tpu.analysis.passes import mesh_consistency
 from skypilot_tpu.analysis.passes import metrics_catalog
 from skypilot_tpu.analysis.passes import tracer_safety
 
@@ -530,3 +533,359 @@ def test_fixture_json_deterministic(tmp_path):
     b = _run(idx, concurrency.ConcurrencyPass()).to_json()
     assert a == b
     assert json.loads(a)['findings']
+
+
+# ----------------------------------------------------- http contract
+
+_HTTP_PROTOCOL = '''
+REQUEST_ID_HEADER = 'X-SkyTPU-Request-Id'
+DEADLINE_HEADER = 'X-SkyTPU-Deadline-Ms'
+GENERATE = '/generate'
+DRAIN = '/drain'
+LB_RETIRE = '/lb/retire'
+CONTROLLER_SYNC = '/controller/load_balancer_sync'
+'''
+
+_HTTP_DOC = '''# Serving
+
+### HTTP API
+
+| route | method |
+|---|---|
+| `/generate` | POST |
+| `/drain` | POST |
+| `/lb/retire` | POST |
+| `/controller/load_balancer_sync` | POST |
+'''
+
+
+def _http_pkg(tmp_path, threaded, asyncf, lb='', controller='',
+              extra=None, doc=_HTTP_DOC):
+    files = {
+        'serve/__init__.py': '',
+        'serve/http_protocol.py': _HTTP_PROTOCOL,
+        'serve/model_server.py': threaded,
+        'serve/async_server.py': asyncf,
+        'serve/load_balancer.py': lb,
+        'serve/controller.py': controller,
+    }
+    files.update(extra or {})
+    return _pkg(tmp_path, files, docs={'serving.md': doc})
+
+
+_FRONT = '''
+from pkg.serve import http_protocol
+
+
+def handle(self, path):
+    if path == http_protocol.GENERATE:
+        rid = self.headers.get(http_protocol.REQUEST_ID_HEADER)
+        self._reply(200, {'rid': rid})
+    elif path == http_protocol.DRAIN:
+        self._reply(200, {})
+    else:
+        self._reply(404, {})
+'''
+
+_LB = '''
+import requests
+
+from pkg.serve import http_protocol
+
+
+def control(self, method, path):
+    if method == 'POST' and path == http_protocol.LB_RETIRE:
+        self._reply(200, {})
+
+
+def sync(self, url):
+    resp = requests.post(url + http_protocol.CONTROLLER_SYNC, json={})
+    if resp.status_code == 200:
+        return resp.json()
+    return None
+
+
+def stamp(self, extra):
+    extra[http_protocol.REQUEST_ID_HEADER] = 'rid'
+    extra[http_protocol.DEADLINE_HEADER] = '100'
+'''
+
+_CONTROLLER = '''
+from pkg.serve import http_protocol
+
+
+def handle(self, path):
+    if self.path == http_protocol.CONTROLLER_SYNC:
+        self._json(200, {})
+    deadline = self.headers.get(http_protocol.DEADLINE_HEADER)
+    return deadline
+'''
+
+
+def test_http_contract_clean_fixture(tmp_path):
+    idx = _http_pkg(tmp_path, _FRONT, _FRONT, _LB, _CONTROLLER)
+    result = _run(idx, http_contract.HttpContractPass())
+    assert result.ok, [f.render() for f in result.findings]
+
+
+def test_http_contract_front_parity_drift(tmp_path):
+    # The async front forgets /drain AND stops reading the request id.
+    async_front = '''
+from pkg.serve import http_protocol
+
+
+def handle(self, path):
+    if path == http_protocol.GENERATE:
+        self._reply(200, {})
+    else:
+        self._reply(404, {})
+'''
+    idx = _http_pkg(tmp_path, _FRONT, async_front, _LB, _CONTROLLER)
+    result = _run(idx, http_contract.HttpContractPass())
+    parity = [f.message for f in result.findings
+              if f.rule == 'http-front-parity']
+    assert any("'/drain'" in m and 'threaded front only' in m
+               for m in parity)
+    assert any('X-SkyTPU-Request-Id' in m for m in parity)
+
+
+def test_http_contract_unknown_route_and_status(tmp_path):
+    lb = _LB + '''
+
+def probe(url):
+    resp = requests.post(url + '/nope', json={})
+    if resp.status_code == 418:
+        return True
+    return False
+'''
+    idx = _http_pkg(tmp_path, _FRONT, _FRONT, lb, _CONTROLLER)
+    result = _run(idx, http_contract.HttpContractPass())
+    rules = _rules(result)
+    assert 'http-unknown-route' in rules
+    assert 'http-status-unemittable' in rules
+    # '/nope' is also a raw path literal?  No: only canonical values
+    # are banned; unknown paths surface through http-unknown-route.
+    messages = ' '.join(f.message for f in result.findings)
+    assert "'/nope'" in messages
+    assert '418' in messages
+
+
+def test_http_contract_raw_literal_and_unstamped(tmp_path):
+    front = _FRONT + '''
+
+def rogue(self):
+    token = self.headers.get('X-SkyTPU-Secret-Token')
+    raw = '/generate'
+    return token, raw
+'''
+    idx = _http_pkg(tmp_path, front, _FRONT, _LB, _CONTROLLER)
+    result = _run(idx, http_contract.HttpContractPass())
+    rules = _rules(result)
+    assert 'http-raw-literal' in rules       # the raw '/generate'
+    assert 'http-header-unstamped' in rules  # nothing stamps the token
+    messages = ' '.join(f.message for f in result.findings)
+    assert 'X-SkyTPU-Secret-Token' in messages
+
+
+def test_http_contract_header_unread_and_doc_drift(tmp_path):
+    # DEADLINE_HEADER defined but no server reads it; docs list a
+    # ghost route and miss /drain.
+    controller = '''
+from pkg.serve import http_protocol
+
+
+def handle(self):
+    if self.path == http_protocol.CONTROLLER_SYNC:
+        self._json(200, {})
+'''
+    doc = _HTTP_DOC.replace('| `/drain` | POST |\n', '') + \
+        '| `/ghost` | GET |\n'
+    idx = _http_pkg(tmp_path, _FRONT, _FRONT, _LB, controller,
+                    doc=doc)
+    result = _run(idx, http_contract.HttpContractPass())
+    by_rule = {}
+    for f in result.findings:
+        by_rule.setdefault(f.rule, []).append(f.message)
+    assert any('X-SkyTPU-Deadline-Ms' in m
+               for m in by_rule.get('http-header-unread', []))
+    drift = ' '.join(by_rule.get('http-doc-drift', []))
+    assert "'/drain'" in drift and "'/ghost'" in drift
+
+
+# --------------------------------------------------- journal protocol
+
+_EVENT_PROTOCOL = '''
+SCOPE_INVOCATION = 'invocation'
+SCOPE_PROCESS = 'process'
+
+
+def _pair(name, scope, start=None, end=None, status_field=None,
+          statuses=None):
+    raise NotImplementedError
+
+
+PAIRS = (
+    _pair('work', SCOPE_INVOCATION, status_field='status',
+          statuses=('ok', 'fail')),
+    _pair('drain', SCOPE_PROCESS),
+)
+'''
+
+
+_WORK_ONLY_PROTOCOL = _EVENT_PROTOCOL.replace(
+    "    _pair('drain', SCOPE_PROCESS),\n", '')
+
+
+def _journal_pkg(tmp_path, mod, protocol=_EVENT_PROTOCOL):
+    return _pkg(tmp_path, {
+        'observability/__init__.py': '',
+        'observability/event_protocol.py': protocol,
+        'mod.py': mod,
+    })
+
+
+def test_journal_protocol_clean_guarded(tmp_path):
+    idx = _journal_pkg(tmp_path, '''
+def run(journal, ok):
+    journal.append('work_start', n=1)
+    try:
+        do_work()
+    finally:
+        journal.append('work_end', status='ok' if ok else 'fail')
+
+
+def open_drain(journal):
+    journal.append('drain_start')
+
+
+def close_drain(journal):
+    journal.append('drain_end')
+''')
+    result = _run(idx, journal_protocol.JournalProtocolPass())
+    assert result.ok, [f.render() for f in result.findings]
+
+
+def test_journal_protocol_unguarded_start(tmp_path):
+    idx = _journal_pkg(tmp_path, '''
+def run(journal):
+    journal.append('work_start', n=1)
+    do_work()
+    journal.append('work_end', status='ok')
+''', protocol=_WORK_ONLY_PROTOCOL)
+    result = _run(idx, journal_protocol.JournalProtocolPass())
+    assert _rules(result) == ['journal-unguarded-start']
+
+
+def test_journal_protocol_unregistered_and_stale(tmp_path):
+    protocol = _EVENT_PROTOCOL.replace(
+        "    _pair('drain', SCOPE_PROCESS),\n",
+        "    _pair('drain', SCOPE_PROCESS),\n"
+        "    _pair('ghost', SCOPE_PROCESS),\n")
+    idx = _journal_pkg(tmp_path, '''
+def run(journal):
+    journal.append('rogue_start')
+''', protocol=protocol)
+    result = _run(idx, journal_protocol.JournalProtocolPass())
+    rules = set(_rules(result))
+    assert 'journal-protocol-unregistered' in rules   # rogue_start
+    assert 'journal-protocol-stale' in rules          # ghost + drain
+    messages = ' '.join(f.message for f in result.findings)
+    assert 'rogue_start' in messages and 'ghost' in messages
+
+
+def test_journal_protocol_bad_status(tmp_path):
+    idx = _journal_pkg(tmp_path, '''
+def run(journal):
+    journal.append('work_start')
+    try:
+        do_work()
+    finally:
+        journal.append('work_end', status='oops')
+''', protocol=_WORK_ONLY_PROTOCOL)
+    result = _run(idx, journal_protocol.JournalProtocolPass())
+    assert _rules(result) == ['journal-protocol-status']
+    assert "'oops'" in result.findings[0].message
+
+
+def test_journal_protocol_wrapper_and_except_guard(tmp_path):
+    # Wrapper-mediated emits count; an except-handler end guards too.
+    idx = _journal_pkg(tmp_path, '''
+def _emit(event, **fields):
+    get_journal().append(event, **fields)
+
+
+def run(journal):
+    _emit('work_start')
+    try:
+        do_work()
+    except Exception:
+        _emit('work_end', status='fail')
+        raise
+    _emit('work_end', status='ok')
+''', protocol=_WORK_ONLY_PROTOCOL)
+    result = _run(idx, journal_protocol.JournalProtocolPass())
+    assert result.ok, [f.render() for f in result.findings]
+
+
+# --------------------------------------------------- mesh consistency
+
+def test_mesh_unknown_axis_flagged(tmp_path):
+    idx = _pkg(tmp_path, {'mod.py': '''
+import jax
+import numpy as np
+
+P = jax.sharding.PartitionSpec
+
+mesh = jax.sharding.Mesh(np.array(jax.devices()), ('data', 'tensor'))
+good = jax.sharding.NamedSharding(mesh, P(None, 'tensor'))
+bad = jax.sharding.NamedSharding(mesh, P(None, 'tensr'))
+'''})
+    result = _run(idx, mesh_consistency.MeshConsistencyPass())
+    assert _rules(result) == ['mesh-unknown-axis']
+    assert "'tensr'" in result.findings[0].message
+
+
+def test_mesh_axes_resolved_through_constants(tmp_path):
+    idx = _pkg(tmp_path, {'mod.py': '''
+import jax
+
+DCN = ('data',)
+ICI = ('fsdp', 'tensor')
+
+
+def build(devices):
+    axis_names = list(DCN + ICI)
+    return jax.sharding.Mesh(devices, axis_names)
+
+
+P = jax.sharding.PartitionSpec
+spec = P('data', ('fsdp', 'tensor'))
+'''})
+    result = _run(idx, mesh_consistency.MeshConsistencyPass())
+    assert result.ok, [f.render() for f in result.findings]
+
+
+def test_mesh_donated_reuse_flagged(tmp_path):
+    idx = _pkg(tmp_path, {'mod.py': '''
+import jax
+
+
+def step(state):
+    return state
+
+
+step_jit = jax.jit(step, donate_argnums=(0,))
+
+
+def bad(state):
+    out = step_jit(state)
+    return state.loss, out
+
+
+def good(state):
+    state = step_jit(state)
+    return state.loss
+'''})
+    result = _run(idx, mesh_consistency.MeshConsistencyPass())
+    assert _rules(result) == ['mesh-donated-reuse']
+    assert result.findings[0].file == 'mod.py'

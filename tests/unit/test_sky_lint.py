@@ -135,3 +135,108 @@ def test_cli_lint_json():
     payload = json.loads(out.output)
     assert payload['ok'] is True
     assert payload['passes'] == ['facade-surface']
+
+
+# --------------------------------------------- ISSUE 13: protocol lint
+
+def test_new_passes_registered():
+    """The three distributed-protocol passes are in the default suite
+    and own their documented rules."""
+    catalog = core.rule_catalog()
+    assert catalog['http-front-parity'] == 'http-contract'
+    assert catalog['http-unknown-route'] == 'http-contract'
+    assert catalog['http-raw-literal'] == 'http-contract'
+    assert catalog['journal-unguarded-start'] == 'journal-protocol'
+    assert catalog['journal-protocol-status'] == 'journal-protocol'
+    assert catalog['mesh-unknown-axis'] == 'mesh-consistency'
+    assert catalog['mesh-donated-reuse'] == 'mesh-consistency'
+
+
+def test_replica_front_surfaces_identical(lint_index):
+    """The threaded and async replica fronts expose byte-identical
+    route surfaces and read the identical header set — proven from
+    the ASTs, not sampled by HTTP tests.  This is the regression gate
+    for every front-parity drift the http-contract pass can catch."""
+    from skypilot_tpu.analysis.passes import http_contract
+
+    res = http_contract._Resolver(lint_index)  # pylint: disable=protected-access
+    threaded = http_contract.server_routes(
+        lint_index, res, 'serve/model_server.py')
+    asyncf = http_contract.server_routes(
+        lint_index, res, 'serve/async_server.py')
+    assert set(threaded) == set(asyncf)
+    # The surface is the real one, not an empty-extraction artifact.
+    assert {'/generate', '/generate_stream', '/generate_text',
+            '/prefill_export', '/kv_import', '/drain',
+            '/prefix_export', '/metrics', '/spans'} <= set(threaded)
+    t_reads = http_contract.header_reads(
+        lint_index, res, 'serve/model_server.py')
+    a_reads = http_contract.header_reads(
+        lint_index, res, 'serve/async_server.py')
+    assert set(t_reads) == set(a_reads)
+    assert 'X-SkyTPU-Deadline-Ms' in t_reads
+
+
+def test_client_status_branches_covered(lint_index):
+    """Every status code an in-package client equality-branches on is
+    emittable by some server (regression gate for the 415 fix: the LB
+    used to branch on a code no server could send)."""
+    from skypilot_tpu.analysis.passes import http_contract
+
+    res = http_contract._Resolver(lint_index)  # pylint: disable=protected-access
+    emittable = http_contract.emitted_statuses(lint_index, res)
+    for rel, line, code in http_contract.client_status_branches(
+            lint_index):
+        if 100 <= code < 600:
+            assert code in emittable, (
+                f'skypilot_tpu/{rel}:{line} branches on {code}, '
+                f'which no server emits')
+
+
+def test_protocol_table_shared_with_invariants():
+    """chaos/invariants.py consumes the SAME paired-event table the
+    journal-protocol pass verifies emit sites against — the lifecycle
+    names and terminal statuses cannot drift apart."""
+    from skypilot_tpu.chaos import invariants
+    from skypilot_tpu.observability import event_protocol
+
+    assert invariants._KV_HANDOFF is \
+        event_protocol.BY_NAME['kv_handoff']  # pylint: disable=protected-access
+    assert invariants._REPLICA_DRAIN is \
+        event_protocol.BY_NAME['replica_drain']  # pylint: disable=protected-access
+    assert invariants._QUEUED_WAIT.statuses == \
+        ('granted', 'timeout', 'error')  # pylint: disable=protected-access
+
+
+def test_protocol_table_parses_from_ast(lint_index):
+    """The lint plane reads the protocol table from the AST (no
+    imports); the parsed rows must match the runtime table exactly."""
+    from skypilot_tpu.analysis.passes import journal_protocol
+    from skypilot_tpu.observability import event_protocol
+
+    parsed = {p.name: p for p in
+              journal_protocol.load_protocol(lint_index)}
+    assert set(parsed) == set(event_protocol.BY_NAME)
+    for name, runtime in event_protocol.BY_NAME.items():
+        ast_row = parsed[name]
+        assert (ast_row.start, ast_row.end, ast_row.scope) == \
+            (runtime.start, runtime.end, runtime.scope), name
+        assert ast_row.statuses == runtime.statuses, name
+
+
+def test_cli_lint_changed_flag():
+    """`skytpu lint --changed` filters the report to git-changed files
+    (full index, filtered findings); exits 0 on a clean tree."""
+    from click.testing import CliRunner
+
+    from skypilot_tpu import cli as cli_mod
+    runner = CliRunner()
+    out = runner.invoke(
+        cli_mod.cli,
+        ['lint', '--changed', '--rule', 'facade-missing', '--json'])
+    assert out.exit_code == 0, out.output
+    payload = json.loads(out.output)
+    assert payload['ok'] is True
+    out = runner.invoke(
+        cli_mod.cli, ['lint', '--changed', '--update-baseline'])
+    assert out.exit_code != 0  # mutually exclusive
