@@ -37,6 +37,7 @@ EXPECTED_LAYER = {
     'serve.page_pool': ('serve/',),
     'serve.kv_handoff': ('serve/',),
     'serve.rank_exec': ('serve/',),
+    'serve.router_push': ('serve/',),
     'skylet.tick': ('skylet/',),
     'checkpoint.save': ('data/',),
 }

@@ -98,6 +98,13 @@ SITES: Dict[str, str] = {
         'refuse the pages (the router must fall back to local '
         'prefill; the request completes either way); "delay" adds '
         'handoff latency without stalling decode ticks',
+    'serve.router_push':
+        'brain-store delta replication to a sibling router instance '
+        '(serve/brain_store.py ReplicatedBrainStore._fan_out) — effect '
+        '"deny" (or a raise) fails the push: the sibling must converge '
+        'through its own controller sync, and the epoch-guarded '
+        'retired set must keep a dropped retire-delta from ever '
+        'resurrecting a replica',
     'skylet.tick':
         'skylet periodic event run (skylet/events.py) — a raise counts '
         'as an event failure and exercises the failure backoff',

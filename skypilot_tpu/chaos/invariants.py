@@ -36,6 +36,11 @@ The registry maps names (used by scenarios and the CLI) to checkers:
                            completes exactly once, and every
                            replica_drain_start reaches a terminal
                            replica_drain_end
+    qos_fairness           weighted QoS admission: every qos_request
+                           reaches a terminal end (ok/shed/error) and a
+                           shed never happens while a lower-weight
+                           class holds more in-flight slots (no
+                           priority inversion at admission)
     no_injections          zero chaos_fault_injected events (clean runs)
 """
 from __future__ import annotations
@@ -55,6 +60,7 @@ _CHECKPOINT_SAVE = event_protocol.BY_NAME['checkpoint_save']
 _KV_PAGES = event_protocol.BY_NAME['kv_pages']
 _KV_HANDOFF = event_protocol.BY_NAME['kv_handoff']
 _REPLICA_DRAIN = event_protocol.BY_NAME['replica_drain']
+_QOS_REQUEST = event_protocol.BY_NAME['qos_request']
 
 
 def merge(*event_lists: Sequence[Event]) -> List[Event]:
@@ -373,6 +379,65 @@ def drain_no_lost_requests(events: Sequence[Event]) -> List[str]:
     return violations
 
 
+def qos_fairness(events: Sequence[Event]) -> List[str]:
+    """Safety/liveness for weighted QoS admission at the router tier:
+
+    - lifecycle completeness: every `qos_request_start` reaches a
+      terminal `qos_request_end` (ok, shed, or error) — a vanished
+      admission means the router dropped a request without answering;
+    - no priority inversion AT ADMISSION: when a class's request is
+      shed, no LOWER-WEIGHT class may be holding more in-flight slots
+      than the shed request's class at that moment (the weighted
+      shares would then not have been enforced: the heavier class
+      starved while the lighter one over-consumed)."""
+    violations = []
+    weights: Dict[str, int] = {}
+    inflight: Dict[str, int] = {}
+    open_requests: Dict[str, str] = {}  # request_id -> class
+    for e in events:
+        name = e.get('event')
+        if name == _QOS_REQUEST.start:
+            rid = e.get('request_id')
+            cls = e.get('qos_class') or 'interactive'
+            if e.get('weight') is not None:
+                weights[cls] = int(e['weight'])
+            if rid:
+                open_requests[rid] = cls
+            inflight[cls] = inflight.get(cls, 0) + 1
+        elif name == _QOS_REQUEST.end:
+            rid = e.get('request_id')
+            cls = open_requests.pop(rid, None) or \
+                e.get('qos_class') or 'interactive'
+            status = e.get('status')
+            if status not in _QOS_REQUEST.statuses:
+                violations.append(
+                    f'qos_request_end for {rid} carries status '
+                    f'{status!r} (want one of '
+                    f'{"/".join(_QOS_REQUEST.statuses)})')
+            if status == 'shed':
+                # Weighted admission means a class is shed only once
+                # it exceeds ITS OWN share — a lower-weight class
+                # simultaneously holding MORE in-flight slots would
+                # mean the shares were never enforced.
+                shed_weight = weights.get(cls, 1)
+                for other, count in inflight.items():
+                    if other == cls:
+                        continue
+                    if (weights.get(other, 1) < shed_weight and
+                            count > inflight.get(cls, 0)):
+                        violations.append(
+                            f'priority inversion: {cls} (weight '
+                            f'{shed_weight}) shed request {rid} while '
+                            f'lower-weight {other} held {count} '
+                            f'in-flight (> {inflight.get(cls, 0)})')
+            inflight[cls] = max(0, inflight.get(cls, 0) - 1)
+    if open_requests:
+        violations.append(
+            f'{len(open_requests)} qos_request_start without '
+            f'qos_request_end: {sorted(open_requests)[:5]}')
+    return violations
+
+
 def no_injections(events: Sequence[Event]) -> List[str]:
     """With no plan armed, the chaos subsystem must be invisible."""
     injected = _named(events, 'chaos_fault_injected')
@@ -393,6 +458,7 @@ CHECKERS: Dict[str, Callable[[Sequence[Event]], List[str]]] = {
     'page_pool_balance': page_pool_balance,
     'handoff_consistency': handoff_consistency,
     'drain_no_lost_requests': drain_no_lost_requests,
+    'qos_fairness': qos_fairness,
     'no_injections': no_injections,
 }
 

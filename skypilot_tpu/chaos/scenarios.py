@@ -45,6 +45,14 @@ Scenarios (CLI: ``sky chaos list`` / ``sky chaos run <name>``):
                            instead of erroring, recovers when the
                            window passes, and the journal proves every
                            allocated page was freed
+- ``router_instance_death`` one router of a two-router tier killed
+                           mid-traffic → the hash ring re-homes its
+                           keys, the shared brain store keeps every
+                           pin, zero non-2xx, no QoS inversion
+- ``region_loss_failover`` every replica of the router-local region
+                           dies abruptly → region-aware dispatch
+                           fails over cross-region with zero lost
+                           requests
 - ``elastic_shrink``       mid-step partial preemption → ELASTIC
                            recovery shrinks the gang to the survivor,
                            sharded-restores onto the smaller mesh, and
@@ -1593,3 +1601,347 @@ def serve_replica_flap(seed: int) -> ScenarioResult:
             extra)
     return _finish('serve_replica_flap', seed, t0, [], [], extra,
                    details)
+
+
+@_register(
+    'router_instance_death',
+    'one router instance of a two-router tier is killed mid-traffic '
+    '-> the hash ring re-homes its prefix keys to the survivor, the '
+    'shared brain store keeps every pin, every client request still '
+    'completes 2xx, and journal replay proves zero lost requests and '
+    'no QoS priority inversion')
+def router_instance_death(seed: int) -> ScenarioResult:
+    import random  # pylint: disable=import-outside-toplevel
+    import threading  # pylint: disable=import-outside-toplevel
+
+    import requests  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.serve import model_server as model_server_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import router as router_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import router_tier as router_tier_lib  # pylint: disable=import-outside-toplevel
+
+    t0 = time.time()
+    extra: List[str] = []
+    details: Dict[str, Any] = {}
+    serve_journal = events_lib.get_journal(
+        os.path.join(events_lib.journal_root(), 'serve.jsonl'))
+
+    def make_server():
+        return model_server_lib.ModelServer(
+            'tiny', max_len=64, max_batch=2, continuous_batching=True,
+            kv_pages=48, page_size=8, prefill_chunk=16)
+
+    servers = [make_server(), make_server()]
+    tier = router_tier_lib.RouterTier(
+        'http://127.0.0.1:1', replicas=2,
+        router_kwargs={'threshold': 10_000})
+    shutdowns: List[Any] = []
+    statuses: List[int] = []
+    statuses_lock = threading.Lock()
+    env_keys = {'SKYTPU_SERVE_HANDOFF_EVENTS': '1'}
+    saved_env = {k: os.environ.get(k) for k in env_keys}
+    os.environ.update(env_keys)
+    try:
+        urls = []
+        for server in servers:
+            port, stop = model_server_lib.start_background(server)
+            shutdowns.append(stop)
+            urls.append(f'http://127.0.0.1:{port}')
+        tier.start()
+        tier.set_replicas([{'url': u, 'role': 'mixed'} for u in urls])
+
+        # Live traffic resolved through the front door: every request
+        # asks the ring which instance owns its prefix (repeat
+        # prefixes -> same router -> same replica-side prefix cache).
+        # The gate pauses new sends around the kill so the scenario
+        # exercises instance death, not torn TCP streams; the sibling
+        # retry below covers the residual race.
+        stop_traffic = threading.Event()
+        gate = threading.Event()
+        gate.set()
+
+        def client(worker: int) -> None:
+            worker_rng = random.Random(f'{seed}:{worker}')
+            n = 0
+            while not stop_traffic.is_set() and n < 30:
+                gate.wait(timeout=30)
+                prompt = ([worker * 50 + (n % 5) + 1] +
+                          [3, 5, 7, 9, 11, 13, 15, 17] * 2 + [19, 21])
+                qos_class = 'interactive' if n % 2 == 0 else 'batch'
+                headers = {router_lib.QOS_CLASS_HEADER: qos_class}
+                code = -1
+                for _ in range(2):  # once + one sibling retry
+                    base = tier.url_for(prompt_ids=prompt)
+                    if base is None:
+                        break
+                    try:
+                        resp = requests.post(
+                            f'{base}{http_protocol.GENERATE}',
+                            json={'prompt_ids': [prompt],
+                                  'max_new_tokens': 6},
+                            headers=headers, timeout=60)
+                        code = resp.status_code
+                        break
+                    except requests.RequestException:
+                        code = -1
+                with statuses_lock:
+                    statuses.append(code)
+                n += 1
+                time.sleep(worker_rng.expovariate(1 / 0.05))
+
+        threads = [threading.Thread(target=client, args=(w,),
+                                    daemon=True) for w in range(3)]
+        for t in threads:
+            t.start()
+
+        def wait_responses(count: int, timeout: float = 60.0) -> None:
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                with statuses_lock:
+                    if len(statuses) >= count:
+                        return
+                time.sleep(0.05)
+
+        def quiesce(timeout: float = 30.0) -> None:
+            """Wait until no client request is mid-flight (the
+            response count stays flat), so the kill lands on an idle
+            listener."""
+            deadline = time.time() + timeout
+            stable = 0
+            with statuses_lock:
+                last = len(statuses)
+            while time.time() < deadline and stable < 5:
+                time.sleep(0.1)
+                with statuses_lock:
+                    now = len(statuses)
+                stable = stable + 1 if now == last else 0
+                last = now
+
+        wait_responses(9)
+        # Kill the instance that OWNS a hot prefix, so the re-homing
+        # is observable: the key must resolve to the survivor after.
+        hot_prompt = [1] + [3, 5, 7, 9, 11, 13, 15, 17] * 2 + [19, 21]
+        hot_key = router_lib.prompt_key(prompt_ids=hot_prompt)
+        victim = tier.owner(hot_key)
+        gate.clear()
+        quiesce()
+        with statuses_lock:
+            details['requests_before_kill'] = len(statuses)
+        tier.stop_instance(victim.instance_id, reason='killed')
+        survivor = tier.owner(hot_key)
+        details['victim'] = victim.instance_id
+        details['new_owner'] = survivor.instance_id \
+            if survivor else None
+        gate.set()
+        wait_responses(details['requests_before_kill'] + 12)
+        stop_traffic.set()
+        gate.set()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        tier.stop()
+        for stop in shutdowns:
+            stop()
+        for server in servers:
+            server.close()
+        for key, value in saved_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+    details['requests'] = len(statuses)
+    details['statuses'] = sorted(set(statuses))
+    details['requests_after_kill'] = (
+        len(statuses) - details.get('requests_before_kill', 0))
+    _expect(len(statuses) >= 20,
+            f'traffic actually ran ({len(statuses)} requests)', extra)
+    _expect(all(s == 200 for s in statuses),
+            f'ZERO non-2xx client responses across the kill '
+            f'(got {details["statuses"]})', extra)
+    _expect(details['requests_after_kill'] >= 6,
+            f'traffic kept flowing after the kill '
+            f'({details["requests_after_kill"]} requests)', extra)
+    _expect(details.get('new_owner') is not None and
+            details['new_owner'] != details.get('victim'),
+            f'the hot prefix key re-homed to the survivor '
+            f'(victim={details.get("victim")}, '
+            f'owner={details.get("new_owner")})', extra)
+    serve_events = _since(serve_journal, t0)
+    starts = [e.get('instance') for e in serve_events
+              if e.get('event') == 'router_instance_start']
+    ends = [(e.get('instance'), e.get('reason'))
+            for e in serve_events
+            if e.get('event') == 'router_instance_end']
+    details['instance_starts'] = starts
+    details['instance_ends'] = ends
+    _expect(len(starts) == 2,
+            f'both router instances journaled start (got {starts})',
+            extra)
+    _expect((details.get('victim'), 'killed') in ends,
+            f'the victim journaled router_instance_end/killed '
+            f'(got {ends})', extra)
+    qos_classes = sorted({e.get('qos_class') for e in serve_events
+                          if e.get('event') == 'qos_request_start'})
+    details['qos_classes'] = qos_classes
+    _expect(qos_classes == ['batch', 'interactive'],
+            f'both QoS classes passed weighted admission '
+            f'(got {qos_classes})', extra)
+    routers_used = sorted({e.get('router') for e in serve_events
+                           if e.get('event') == 'lb_route' and
+                           e.get('router')})
+    details['routers_used'] = routers_used
+    return _finish('router_instance_death', seed, t0, serve_events,
+                   ['drain_no_lost_requests', 'qos_fairness'], extra,
+                   details)
+
+
+@_register(
+    'region_loss_failover',
+    'every replica of the router-local region dies abruptly '
+    'mid-traffic -> region-aware dispatch fails over cross-region '
+    '(the LB same-role retry absorbs requests caught mid-death), '
+    'every client response stays 2xx, and journal replay proves zero '
+    'lost requests')
+def region_loss_failover(seed: int) -> ScenarioResult:
+    import random  # pylint: disable=import-outside-toplevel
+    import threading  # pylint: disable=import-outside-toplevel
+
+    import requests  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.serve import model_server as model_server_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import router_tier as router_tier_lib  # pylint: disable=import-outside-toplevel
+
+    t0 = time.time()
+    extra: List[str] = []
+    details: Dict[str, Any] = {}
+    serve_journal = events_lib.get_journal(
+        os.path.join(events_lib.journal_root(), 'serve.jsonl'))
+    local_region, remote_region = 'us-central1', 'europe-west4'
+
+    def make_server():
+        return model_server_lib.ModelServer(
+            'tiny', max_len=64, max_batch=2, continuous_batching=True,
+            kv_pages=48, page_size=8, prefill_chunk=16)
+
+    # One replica per region; the router tier lives in us-central1 and
+    # prefers it until the region is gone.
+    servers = [make_server(), make_server()]
+    tier = router_tier_lib.RouterTier(
+        'http://127.0.0.1:1', replicas=2, region=local_region,
+        router_kwargs={'threshold': 10_000})
+    shutdowns: List[Any] = []
+    statuses: List[int] = []
+    statuses_lock = threading.Lock()
+    env_keys = {'SKYTPU_SERVE_HANDOFF_EVENTS': '1'}
+    saved_env = {k: os.environ.get(k) for k in env_keys}
+    os.environ.update(env_keys)
+    try:
+        urls = []
+        for server in servers:
+            port, stop = model_server_lib.start_background(server)
+            shutdowns.append(stop)
+            urls.append(f'http://127.0.0.1:{port}')
+        tier.start()
+        tier.set_replicas([
+            {'url': urls[0], 'role': 'mixed', 'region': local_region},
+            {'url': urls[1], 'role': 'mixed',
+             'region': remote_region}])
+
+        stop_traffic = threading.Event()
+
+        def client(worker: int) -> None:
+            worker_rng = random.Random(f'{seed}:{worker}')
+            n = 0
+            while not stop_traffic.is_set() and n < 30:
+                prompt = ([worker * 50 + (n % 5) + 1] +
+                          [3, 5, 7, 9, 11, 13, 15, 17] * 2 + [19, 21])
+                code = -1
+                for _ in range(2):  # once + one sibling retry
+                    base = tier.url_for(prompt_ids=prompt)
+                    if base is None:
+                        break
+                    try:
+                        resp = requests.post(
+                            f'{base}{http_protocol.GENERATE}',
+                            json={'prompt_ids': [prompt],
+                                  'max_new_tokens': 6}, timeout=60)
+                        code = resp.status_code
+                        break
+                    except requests.RequestException:
+                        code = -1
+                with statuses_lock:
+                    statuses.append(code)
+                n += 1
+                time.sleep(worker_rng.expovariate(1 / 0.05))
+
+        threads = [threading.Thread(target=client, args=(w,),
+                                    daemon=True) for w in range(2)]
+        for t in threads:
+            t.start()
+
+        def wait_responses(count: int, timeout: float = 60.0) -> None:
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                with statuses_lock:
+                    if len(statuses) >= count:
+                        return
+                time.sleep(0.05)
+
+        wait_responses(8)
+        with statuses_lock:
+            details['requests_before_loss'] = len(statuses)
+        # Full region loss, ABRUPT: the local replica's server dies
+        # first (requests caught mid-death ride the LB's same-role
+        # retry to the surviving region), THEN the control plane
+        # notices and pushes the shrunken ready set.
+        shutdowns[0]()
+        servers[0].close()
+        time.sleep(0.2)
+        tier.apply_state({'ready': [
+            {'url': urls[1], 'role': 'mixed',
+             'region': remote_region}]})
+        wait_responses(details['requests_before_loss'] + 10)
+        stop_traffic.set()
+        for t in threads:
+            t.join(timeout=120)
+    finally:
+        tier.stop()
+        for stop in shutdowns[1:]:
+            stop()
+        for server in servers[1:]:
+            server.close()
+        for key, value in saved_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+    details['requests'] = len(statuses)
+    details['statuses'] = sorted(set(statuses))
+    details['requests_after_loss'] = (
+        len(statuses) - details.get('requests_before_loss', 0))
+    _expect(len(statuses) >= 16,
+            f'traffic actually ran ({len(statuses)} requests)', extra)
+    _expect(all(s == 200 for s in statuses),
+            f'ZERO non-2xx client responses across the region loss '
+            f'(got {details["statuses"]})', extra)
+    _expect(details['requests_after_loss'] >= 6,
+            f'traffic kept flowing after the region loss '
+            f'({details["requests_after_loss"]} requests)', extra)
+    serve_events = _since(serve_journal, t0)
+    routes = [e for e in serve_events if e.get('event') == 'lb_route']
+    local_routes = [e for e in routes
+                    if e.get('region') == local_region]
+    cross = [e for e in routes if e.get('cross_region')]
+    details['local_routes'] = len(local_routes)
+    details['cross_region_routes'] = len(cross)
+    _expect(len(local_routes) >= 1,
+            'region-aware dispatch preferred the local region before '
+            'the loss', extra)
+    _expect(len(cross) >= 1 and
+            all(e.get('region') == remote_region for e in cross),
+            f'dispatch failed over cross-region to {remote_region} '
+            f'({len(cross)} cross-region routes)', extra)
+    return _finish('region_loss_failover', seed, t0, serve_events,
+                   ['drain_no_lost_requests'], extra, details)

@@ -860,6 +860,77 @@ def _serve_lb_table(records) -> None:
                   'RETIRED'], rows)
 
 
+def _serve_router_table(records) -> None:
+    """One row per router-tier instance, from the skytpu_router_*
+    series on each registered router port's /lb/metrics.  In-process
+    tiers share one metric registry (every port exposes every
+    instance's series, distinguished by the `router` label), so rows
+    are unioned across ports by that label."""
+    import requests  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.observability import metrics as metrics_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import http_protocol  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import serve_state  # pylint: disable=import-outside-toplevel
+    rows = []
+    for r in records:
+        ports = serve_state.get_router_ports(r)
+        per_router = {}
+        for port in ports:
+            try:
+                resp = requests.get(
+                    f'http://127.0.0.1:{port}'
+                    f'{http_protocol.LB_METRICS}', timeout=5)
+                resp.raise_for_status()
+                parsed = metrics_lib.parse_exposition(resp.text)
+            except (requests.RequestException, ValueError):
+                continue
+
+            def by_router(name, parsed=parsed):
+                out = {}
+                for labels, value in (parsed.get(name) or {}).items():
+                    rid = dict(labels).get('router')
+                    if rid is not None:
+                        out[rid] = value
+                return out
+
+            affinity = {}
+            for labels, value in (parsed.get(
+                    'skytpu_router_affinity_total') or {}).items():
+                d = dict(labels)
+                affinity.setdefault(d.get('router'), {})[
+                    d.get('outcome')] = value
+            for name, values in (
+                    ('qps', by_router('skytpu_router_qps')),
+                    ('inflight',
+                     by_router('skytpu_router_inflight')),
+                    ('sync_age',
+                     by_router('skytpu_router_sync_age_seconds')),
+                    ('requests',
+                     by_router('skytpu_router_requests_total'))):
+                for rid, value in values.items():
+                    per_router.setdefault(rid, {})[name] = value
+            for rid, outcomes in affinity.items():
+                per_router.setdefault(rid, {})['affinity'] = outcomes
+        for rid in sorted(per_router):
+            stats = per_router[rid]
+            outcomes = stats.get('affinity') or {}
+            routed = sum(outcomes.values())
+            share = (f'{outcomes.get("hit", 0) / routed:.0%}hit'
+                     if routed else '-')
+            age = stats.get('sync_age')
+            rows.append((r['name'], rid,
+                         f'{stats.get("qps", 0):g}',
+                         int(stats.get('inflight', 0)),
+                         share,
+                         '-' if age is None else f'{age:.0f}s',
+                         int(stats.get('requests', 0))))
+    if not rows:
+        return
+    click.echo('')
+    _print_table(['SERVICE', 'ROUTER', 'QPS', 'INFLIGHT', 'AFFINITY',
+                  'SYNC AGE', 'REQUESTS'], rows)
+
+
 def _serve_metrics_table(records) -> None:
     """One row per READY replica, scraped live from GET /metrics
     (observability/metrics.py exposition on the model server)."""
@@ -943,6 +1014,7 @@ def _serve_metrics_table(records) -> None:
                       'QUEUE', 'RANK LAG', 'TTFT p50/p99',
                       'ITL p50/p99'], rows)
     _serve_lb_table(records)
+    _serve_router_table(records)
 
 
 @serve_group.command(name='down')

@@ -94,6 +94,16 @@ PAIRS: Tuple[PairedEvents, ...] = (
           statuses=('ok', 'fallback', 'error')),
     _pair('kv_pages', SCOPE_PROCESS, start='kv_pages_alloc',
           end='kv_pages_free'),
+    # Router tier + QoS (ISSUE 15).  qos_request brackets one request's
+    # pass through a router instance's weighted admission: 'ok' =
+    # admitted and served, 'shed' = over the class's in-flight share
+    # (429 + Retry-After), 'error' = admitted but failed downstream.
+    _pair('qos_request', SCOPE_INVOCATION, status_field='status',
+          statuses=('ok', 'shed', 'error')),
+    # router_instance brackets one router instance's life in the tier
+    # (spawn -> scale_down/killed/shutdown).
+    _pair('router_instance', SCOPE_PROCESS, status_field='reason',
+          statuses=('scale_down', 'killed', 'shutdown')),
 )
 
 BY_NAME: Dict[str, PairedEvents] = {p.name: p for p in PAIRS}

@@ -423,3 +423,97 @@ def format_plan_table(
     if any_measured:
         lines.append('* = effective TFLOPs from a measured bench MFU')
     return '\n'.join(lines)
+
+
+# ----------------------------------------------------- multi-region placement
+
+# Per-region TPU serving catalog: relative $/chip-hr (1.0 = the
+# cheapest region's on-demand price) and an availability score in
+# (0, 1] (how often capacity requests succeed — the stockout signal
+# preemption telemetry feeds in real deployments).  Override/extend
+# with SKYTPU_REGION_CATALOG (JSON of the same shape).
+REGION_CATALOG: Dict[str, Dict[str, float]] = {
+    'us-central1': {'cost': 1.00, 'availability': 0.97},
+    'us-east1': {'cost': 1.04, 'availability': 0.93},
+    'europe-west4': {'cost': 1.10, 'availability': 0.95},
+    'asia-east1': {'cost': 1.18, 'availability': 0.90},
+}
+
+
+def region_catalog() -> Dict[str, Dict[str, float]]:
+    """The region catalog with SKYTPU_REGION_CATALOG overrides merged
+    in (unknown/malformed entries ignored — placement must not fail on
+    a bad override)."""
+    import json  # pylint: disable=import-outside-toplevel
+    import os  # pylint: disable=import-outside-toplevel
+    catalog = {name: dict(entry)
+               for name, entry in REGION_CATALOG.items()}
+    raw = os.environ.get('SKYTPU_REGION_CATALOG')
+    if raw:
+        try:
+            override = json.loads(raw)
+        except json.JSONDecodeError:
+            override = None
+        if isinstance(override, dict):
+            for name, entry in override.items():
+                if not isinstance(entry, dict):
+                    continue
+                merged = catalog.setdefault(
+                    str(name), {'cost': 1.0, 'availability': 0.9})
+                for key in ('cost', 'availability'):
+                    if entry.get(key) is not None:
+                        try:
+                            merged[key] = float(entry[key])
+                        except (TypeError, ValueError):
+                            pass
+    return catalog
+
+
+def rank_regions(catalog: Optional[Dict[str, Dict[str, float]]] = None
+                 ) -> List[str]:
+    """Regions best-first by availability-per-dollar (an unavailable
+    cheap region loses to a slightly pricier one that actually has
+    chips); name-ordered tiebreak keeps the ranking deterministic."""
+    catalog = catalog if catalog is not None else region_catalog()
+    def score(name: str) -> float:
+        entry = catalog[name]
+        cost = max(float(entry.get('cost', 1.0)), 1e-6)
+        return float(entry.get('availability', 0.9)) / cost
+    return sorted(catalog, key=lambda name: (-score(name), name))
+
+
+def place_role_pools(spec) -> Dict[str, List[str]]:
+    """Region placement per role pool of a service spec.
+
+    Pools that can run >= 2 replicas get the TOP TWO regions (survive a
+    full-region loss: the router tier's cross-region failover needs a
+    same-role replica somewhere else); single-replica pools take the
+    best region only.  Replicas round-robin over the returned list, so
+    a 4-replica pool lands 2+2 across the pair."""
+    plan: Dict[str, List[str]] = {}
+    ranked = rank_regions()
+    if not ranked:
+        return plan
+    for role, pool in getattr(spec, 'role_specs', {}).items():
+        width = 2 if getattr(pool, 'max_replicas', 1) >= 2 else 1
+        plan[role] = ranked[:max(1, min(width, len(ranked)))]
+    return plan
+
+
+def format_region_plan(plan: Dict[str, List[str]]) -> str:
+    """Human-readable multi-region placement summary (the serve-side
+    sibling of format_plan_table)."""
+    catalog = region_catalog()
+    lines = ['Multi-region placement:', '']
+    header = f'{"ROLE":<12} {"REGIONS":<40} {"REL.$":>6} {"AVAIL":>6}'
+    lines.append(header)
+    lines.append('-' * len(header))
+    for role, regions in sorted(plan.items()):
+        costs = [catalog.get(r, {}).get('cost', 1.0) for r in regions]
+        avail = [catalog.get(r, {}).get('availability', 0.9)
+                 for r in regions]
+        mean_cost = sum(costs) / len(costs) if costs else 1.0
+        min_avail = min(avail) if avail else 0.0
+        lines.append(f'{role[:12]:<12} {", ".join(regions):<40} '
+                     f'{mean_cost:>6.2f} {min_avail:>6.2f}')
+    return '\n'.join(lines)

@@ -34,6 +34,7 @@ from skypilot_tpu.serve import batching_engine as batching_engine_lib
 from skypilot_tpu.serve import handoff as handoff_lib
 from skypilot_tpu.serve import http_protocol
 from skypilot_tpu.serve import model_server as model_server_lib
+from skypilot_tpu.serve import qos as qos_lib
 from skypilot_tpu.serve import router as router_lib
 
 logger = sky_logging.init_logger(__name__)
@@ -101,6 +102,13 @@ def _deadline_ms(headers: Dict[str, str]) -> Optional[float]:
         except ValueError:
             pass
     return model_server_lib.default_deadline_ms()
+
+
+def _qos_class(headers: Dict[str, str]) -> str:
+    """The request's X-SkyTPU-QoS-Class (lower-cased header map),
+    clamped to a known class."""
+    return qos_lib.normalize(
+        headers.get(router_lib.QOS_CLASS_HEADER.lower()))
 
 
 async def _read_request(reader: asyncio.StreamReader
@@ -215,6 +223,7 @@ class AsyncModelServer:
     async def _generate(self, req: Dict[str, Any], rid: str,
                         route_meta: Optional[Dict[str, Any]] = None,
                         deadline_ms: Optional[float] = None,
+                        qos_class: Optional[str] = None,
                         reader: Optional[asyncio.StreamReader] = None,
                         watch_disconnect: bool = False
                         ) -> Dict[str, Any]:
@@ -228,7 +237,7 @@ class AsyncModelServer:
                 int(req.get('max_new_tokens', 16)),
                 temperature, top_k, seed=seed, request_id=rid,
                 route_meta=route_meta, deadline_ms=deadline_ms,
-                on_submit=handles.extend))
+                qos_class=qos_class, on_submit=handles.extend))
         if watch_disconnect and reader is not None:
             # Connection: close (the LB's routed path, one-shot
             # clients): no further request bytes are legitimate, so a
@@ -339,7 +348,8 @@ class AsyncModelServer:
                              writer: asyncio.StreamWriter,
                              rid: str,
                              route_meta: Optional[Dict[str, Any]] = None,
-                             deadline_ms: Optional[float] = None
+                             deadline_ms: Optional[float] = None,
+                             qos_class: Optional[str] = None
                              ) -> None:
         self._reject_if_draining()
         server = self.server
@@ -358,7 +368,8 @@ class AsyncModelServer:
         if req.get('stream'):
             await self._stream(writer, ids, req, rid, text_mode=True,
                                route_meta=route_meta,
-                               deadline_ms=deadline_ms)
+                               deadline_ms=deadline_ms,
+                               qos_class=qos_class)
             return
         t0 = time.perf_counter()
         temperature, top_k, seed = self._sampling(req)
@@ -368,7 +379,7 @@ class AsyncModelServer:
                 temperature, top_k,
                 stop_token=tok.eos_ids or None, seed=seed,
                 request_id=rid, route_meta=route_meta,
-                deadline_ms=deadline_ms)))[0]
+                deadline_ms=deadline_ms, qos_class=qos_class)))[0]
         stops = [i for i, t in enumerate(tokens) if t in tok.eos_ids]
         if stops:
             tokens = tokens[:stops[0]]
@@ -382,7 +393,8 @@ class AsyncModelServer:
     async def _stream(self, writer: asyncio.StreamWriter, ids, req,
                       rid: str, *, text_mode: bool,
                       route_meta: Optional[Dict[str, Any]] = None,
-                      deadline_ms: Optional[float] = None
+                      deadline_ms: Optional[float] = None,
+                      qos_class: Optional[str] = None
                       ) -> None:
         """SSE over chunked transfer; token events or UTF-8-safe text
         deltas.  Purely event-driven: no thread parks waiting."""
@@ -408,7 +420,7 @@ class AsyncModelServer:
                 sampling=decode.SamplingConfig(
                     temperature=temperature, top_k=top_k, seed=seed),
                 request_id=rid, route_meta=route_meta,
-                deadline_ms=deadline_ms)
+                deadline_ms=deadline_ms, qos_class=qos_class)
         except ValueError:
             raise
         except Exception as e:  # pylint: disable=broad-except
@@ -563,6 +575,7 @@ class AsyncModelServer:
                            tracing.new_request_id())
                     meta = _route_meta(headers)
                     deadline_ms = _deadline_ms(headers)
+                    qos_class = _qos_class(headers)
                     if path == http_protocol.GENERATE:
                         self._reject_if_draining()
                         one_shot = 'close' in (
@@ -571,6 +584,7 @@ class AsyncModelServer:
                             payload = await self._generate(
                                 req, rid, meta,
                                 deadline_ms=deadline_ms,
+                                qos_class=qos_class,
                                 reader=reader,
                                 watch_disconnect=one_shot)
                         except model_server_lib.ClientDisconnected:
@@ -592,11 +606,13 @@ class AsyncModelServer:
                         await self._stream(writer, prompt, req, rid,
                                            text_mode=False,
                                            route_meta=meta,
-                                           deadline_ms=deadline_ms)
+                                           deadline_ms=deadline_ms,
+                                           qos_class=qos_class)
                     elif path == http_protocol.GENERATE_TEXT:
-                        await self._generate_text(req, writer, rid,
-                                                  meta,
-                                                  deadline_ms=deadline_ms)
+                        await self._generate_text(
+                            req, writer, rid, meta,
+                            deadline_ms=deadline_ms,
+                            qos_class=qos_class)
                     elif path == http_protocol.DRAIN:
                         writer.write(_json_response(
                             200, self.server.drain()))

@@ -359,7 +359,8 @@ class ContinuousBatchingEngine:
                stop_token=None, sampling=None,
                request_id: Optional[str] = None,
                route_meta: Optional[Dict[str, Any]] = None,
-               deadline_ms: Optional[float] = None
+               deadline_ms: Optional[float] = None,
+               qos_class: Optional[str] = None
                ) -> scheduler.Request:
         """stop_token: None, one id, or an iterable of ids — the
         request finishes at the FIRST generated member of the set
@@ -377,7 +378,12 @@ class ContinuousBatchingEngine:
         deadline_ms: total time budget from submission (the propagated
         X-SkyTPU-Deadline-Ms).  Queued past it -> DeadlineExceeded at
         pop; mid-decode past it -> the worker reaps the slot and frees
-        its KV pages on the next tick."""
+        its KV pages on the next tick.
+
+        qos_class: the propagated X-SkyTPU-QoS-Class.  The scheduler
+        clamps max_new_tokens to the class token budget, applies the
+        class deadline default when deadline_ms is None, and pops
+        queued work in smooth-weighted class order."""
         if not prompt_ids:
             raise ValueError('empty prompt')
         if max_new_tokens < 1:
@@ -395,7 +401,8 @@ class ContinuousBatchingEngine:
                                     top_k=top_k, seed=seed,
                                     request_id=request_id,
                                     route_meta=route_meta,
-                                    deadline_ms=deadline_ms)
+                                    deadline_ms=deadline_ms,
+                                    qos_class=qos_class)
         request._span_store = self._spans  # pylint: disable=protected-access
         sampler_lib.validate_stop_ids(request.stop_ids,
                                       self.max_stop_ids)

@@ -1,0 +1,317 @@
+"""Router brain store: the shared state behind the router tier.
+
+PR 8 put the routing brain (ready/retired sets, prefix-affinity map,
+in-flight counts) directly inside `serve/router.py` as process-local
+dicts — correct for one router, and a hard wall for N of them: two
+routers with private brains double-prefill repeat prefixes, resurrect
+replicas their sibling retired, and balance against stale in-flight
+views.  This module extracts that state behind a store interface:
+
+- **InProcessBrainStore** — the PR 8 dicts behind the interface, one
+  lock.  A single router (the default) is bit-for-bit the old
+  behavior; N routers *in one process* (the router tier's local mode)
+  simply share one instance and the lock makes every route decision
+  atomic across the tier.
+- **ReplicatedBrainStore** — wraps an in-process store and fans
+  retire / affinity deltas to sibling router instances over the
+  ``POST /lb/state`` control-plane route, so routers in *separate
+  processes* converge without waiting out a controller sync.  Applies
+  of replicated deltas never re-fan (no echo storms).
+
+Retired entries carry an **epoch** (generation counter).  A retirement
+at epoch `e` can only be cleared by a controller view stamped with
+`retired_epoch >= e` — a stale sync captured before the retirement can
+never resurrect the replica on any router (the PR 15 two-router
+regression).  Epochs are seeded from the wall clock so a restarted
+controller keeps issuing larger ones.
+
+The store holds *state*; `serve/router.py` keeps the selection logic
+(role dispatch, affinity, least-loaded ranking) and takes the store's
+lock around each decision.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import http_protocol
+
+logger = sky_logging.init_logger(__name__)
+
+
+def next_epoch_seed() -> int:
+    """Starting value for a fresh epoch counter: wall-clock seconds,
+    so counters restarted in a new process still dominate epochs
+    issued before the restart."""
+    return int(time.time())
+
+
+def encode_affinity_key(key: Hashable) -> Any:
+    """JSON-safe form of a prompt prefix key (router.prompt_key returns
+    ('ids', tuple) / ('text', str) — tuples don't survive JSON)."""
+    if isinstance(key, tuple):
+        return [encode_affinity_key(k) for k in key]
+    return key
+
+
+def decode_affinity_key(wire: Any) -> Hashable:
+    if isinstance(wire, list):
+        return tuple(decode_affinity_key(k) for k in wire)
+    return wire
+
+
+class InProcessBrainStore:
+    """The routing brain's state, one lock.  Thread-safe; shared by
+    every router instance of an in-process tier."""
+
+    def __init__(self, affinity_capacity: int = 4096) -> None:
+        self.lock = threading.RLock()
+        # url -> ReplicaEndpoint (typed by serve/router.py; the store
+        # treats endpoints as opaque values keyed by url).
+        self.endpoints: Dict[str, Any] = {}
+        # prefix key -> url last served, LRU-bounded.
+        self.affinity: 'collections.OrderedDict[Hashable, str]' = (
+            collections.OrderedDict())
+        self.affinity_capacity = int(affinity_capacity)
+        self.inflight: Dict[str, int] = {}
+        self.affinity_hits = 0
+        self.affinity_misses = 0
+        # url -> retirement epoch.  Filtered out of every ready view
+        # until a controller sync stamped with a >= epoch clears it.
+        self._retired: Dict[str, int] = {}
+        self._epochs = itertools.count(next_epoch_seed())
+
+    # ------------------------------------------------------------ fleet
+
+    def set_endpoints(self, endpoints: Dict[str, Any]) -> None:
+        with self.lock:
+            self.endpoints = dict(endpoints)
+            self.drop_stale_affinity_locked()
+
+    def drop_stale_affinity_locked(self) -> None:
+        for key in [k for k, url in self.affinity.items()
+                    if url not in self.endpoints]:
+            del self.affinity[key]
+
+    # ---------------------------------------------------------- retired
+
+    def next_local_epoch(self) -> int:
+        """Epoch for a locally-originated retirement (an `/lb/retire`
+        nudge that carried none)."""
+        return next(self._epochs)
+
+    def retire(self, url: str, epoch: Optional[int] = None) -> int:
+        """Mark a url retired at `epoch` (a later epoch wins; an older
+        one never downgrades).  Returns the effective epoch."""
+        with self.lock:
+            if epoch is None:
+                epoch = self.next_local_epoch()
+            epoch = max(int(epoch), self._retired.get(url, 0))
+            self._retired[url] = epoch
+            return epoch
+
+    def retired_urls(self) -> Dict[str, int]:
+        with self.lock:
+            return dict(self._retired)
+
+    def is_retired(self, url: str) -> bool:
+        with self.lock:
+            return url in self._retired
+
+    def reconcile_retired(self, urls: List[str],
+                          view_epoch: Optional[int]) -> List[str]:
+        """Apply a controller ready-set view and return it with retired
+        urls filtered out.
+
+        An entry retired at epoch `e` is cleared only by a view stamped
+        `view_epoch >= e`: the controller demonstrably processed that
+        retirement, so if the url is listed again it was *re-readied*,
+        not resurrected by a stale snapshot.  Unstamped (legacy) views
+        keep filtering listed urls and only garbage-collect entries
+        whose url left the fleet entirely."""
+        with self.lock:
+            kept: Dict[str, int] = {}
+            for url, e in self._retired.items():
+                if view_epoch is not None and int(view_epoch) >= e:
+                    continue                    # confirmed by controller
+                if view_epoch is None and url not in urls:
+                    continue                    # legacy GC: url is gone
+                kept[url] = e
+            self._retired = kept
+            return [u for u in urls if u not in kept]
+
+    # --------------------------------------------------------- affinity
+
+    def record_affinity(self, key: Hashable, url: str) -> None:
+        with self.lock:
+            self.affinity[key] = url
+            self.affinity.move_to_end(key)
+            while len(self.affinity) > self.affinity_capacity:
+                self.affinity.popitem(last=False)
+
+    def affinity_target(self, key: Hashable) -> Optional[str]:
+        with self.lock:
+            return self.affinity.get(key)
+
+    # --------------------------------------------------------- inflight
+
+    def acquire(self, url: str) -> None:
+        with self.lock:
+            self.inflight[url] = self.inflight.get(url, 0) + 1
+
+    def release(self, url: str) -> None:
+        with self.lock:
+            n = self.inflight.get(url, 0) - 1
+            if n <= 0:
+                self.inflight.pop(url, None)
+            else:
+                self.inflight[url] = n
+
+    def inflight_total(self) -> int:
+        with self.lock:
+            return sum(self.inflight.values())
+
+
+class ReplicatedBrainStore(InProcessBrainStore):
+    """An in-process store that replicates retire / affinity deltas to
+    sibling router instances over ``POST /lb/state``.
+
+    Replication is best-effort and asymmetric by design: retirements
+    and affinity pins fan out immediately (they are the correctness-
+    and latency-critical deltas), while the full ready set converges
+    through the controller's own push/sync to every instance.  A
+    delta applied *from* a sibling sets ``replicated=True`` so the
+    apply never fans back out (no echo loops)."""
+
+    def __init__(self, affinity_capacity: int = 4096,
+                 post: Optional[Callable[..., Any]] = None) -> None:
+        super().__init__(affinity_capacity=affinity_capacity)
+        # Sibling /lb/ control-plane base urls, e.g.
+        # ['http://127.0.0.1:5001', ...] (never includes self).
+        self._peers: List[str] = []
+        self._post = post or self._default_post
+        self.push_failures = 0
+
+    def set_peers(self, peer_urls: List[str]) -> None:
+        with self.lock:
+            self._peers = list(peer_urls)
+
+    def peers(self) -> List[str]:
+        with self.lock:
+            return list(self._peers)
+
+    @staticmethod
+    def _default_post(url: str, payload: Dict[str, Any],
+                      timeout: float = 2.0) -> None:
+        import requests  # pylint: disable=import-outside-toplevel
+        requests.post(url, json=payload, timeout=timeout)
+
+    def _fan_out(self, payload: Dict[str, Any]) -> None:
+        from skypilot_tpu.chaos import injector  # pylint: disable=import-outside-toplevel
+        for peer in self.peers():
+            try:
+                if injector.inject('serve.router_push', peer=peer):
+                    raise RuntimeError('state push denied (chaos)')
+                self._post(peer + http_protocol.LB_STATE, payload)
+            except Exception as e:  # pylint: disable=broad-except
+                # Best effort: the controller's periodic state push is
+                # the convergence backstop for a missed delta.
+                self.push_failures += 1
+                logger.debug(f'router state push to {peer} failed: {e}')
+
+    def retire(self, url: str, epoch: Optional[int] = None,
+               replicated: bool = False) -> int:
+        epoch = super().retire(url, epoch)
+        if not replicated:
+            self._fan_out({'retire': {'url': url, 'epoch': epoch}})
+        return epoch
+
+    def record_affinity(self, key: Hashable, url: str,
+                        replicated: bool = False) -> None:
+        super().record_affinity(key, url)
+        if not replicated:
+            self._fan_out({'affinity': {
+                'key': encode_affinity_key(key), 'url': url}})
+
+    def apply_delta(self, payload: Dict[str, Any]) -> None:
+        """Apply a sibling's replicated delta (never re-fans)."""
+        retire = payload.get('retire')
+        if isinstance(retire, dict) and retire.get('url'):
+            self.retire(retire['url'], retire.get('epoch'),
+                        replicated=True)
+        affinity = payload.get('affinity')
+        if isinstance(affinity, dict) and affinity.get('url'):
+            key = decode_affinity_key(affinity.get('key'))
+            if key is not None:
+                self.record_affinity(key, affinity['url'],
+                                     replicated=True)
+
+
+def make_store(replicated: bool = False,
+               affinity_capacity: int = 4096,
+               post: Optional[Callable[..., Any]] = None
+               ) -> InProcessBrainStore:
+    if replicated:
+        return ReplicatedBrainStore(affinity_capacity=affinity_capacity,
+                                    post=post)
+    return InProcessBrainStore(affinity_capacity=affinity_capacity)
+
+
+def consistent_hash(value: str) -> int:
+    """Stable 64-bit hash for the ring (md5 head; Python's `hash` is
+    salted per process, useless for cross-router agreement)."""
+    import hashlib  # pylint: disable=import-outside-toplevel
+    digest = hashlib.md5(value.encode('utf-8', 'surrogatepass')).digest()
+    return int.from_bytes(digest[:8], 'big')
+
+
+class HashRing:
+    """Consistent-hash ring mapping prefix keys to router instances.
+
+    Virtual nodes smooth the split; the classic property holds: when
+    an instance joins or leaves, only the keys in its arcs move
+    (~K/N of them), every other key keeps its owner — which is what
+    keeps repeat prefixes landing on the same router (and therefore
+    the same affinity-pinned replica) across tier resizes."""
+
+    def __init__(self, vnodes: int = 64) -> None:
+        self._vnodes = int(vnodes)
+        self._ring: List[Tuple[int, str]] = []   # (point, member) sorted
+        self._members: List[str] = []
+
+    def members(self) -> List[str]:
+        return list(self._members)
+
+    def add(self, member: str) -> None:
+        if member in self._members:
+            return
+        self._members.append(member)
+        for i in range(self._vnodes):
+            self._ring.append(
+                (consistent_hash(f'{member}#{i}'), member))
+        self._ring.sort()
+
+    def remove(self, member: str) -> None:
+        if member not in self._members:
+            return
+        self._members.remove(member)
+        self._ring = [(p, m) for p, m in self._ring if m != member]
+
+    def owner(self, key: Hashable) -> Optional[str]:
+        """The single instance that owns `key` (clockwise successor on
+        the ring); None on an empty ring."""
+        if not self._ring:
+            return None
+        point = consistent_hash(repr(key))
+        lo, hi = 0, len(self._ring)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._ring[mid][0] < point:
+                lo = mid + 1
+            else:
+                hi = mid
+        return self._ring[lo % len(self._ring)][1]

@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 
 import requests
 
+from skypilot_tpu import optimizer as optimizer_lib
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.chaos import injector as chaos_injector
@@ -99,6 +100,13 @@ class SkyServeController:
         self.port = port
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
+        # Last ready set pushed to the router tier (fleet-change
+        # detection for _push_router_state).
+        self._last_pushed_ready: Optional[List[str]] = None
+        # Multi-region placement plan (optimizer.place_role_pools):
+        # role -> ordered region list new replicas round-robin over.
+        self.region_plan = optimizer_lib.place_role_pools(self.spec)
+        self._region_cursor: Dict[str, int] = {}
 
     # -------------------------------------------------------- HTTP control
 
@@ -120,11 +128,7 @@ class SkyServeController:
 
             def do_GET(self):
                 if self.path == http_protocol.CONTROLLER_SYNC:
-                    self._json(200, {
-                        'ready_replica_urls':
-                            controller.serving_urls(),
-                        'ready_replicas':
-                            controller.serving_replicas()})
+                    self._json(200, controller.sync_payload())
                 elif self.path.split('?', 1)[0] == \
                         http_protocol.CONTROLLER_TELEMETRY:
                     # What `sky serve top` renders: per-role sparkline
@@ -143,11 +147,7 @@ class SkyServeController:
                         data.get('request_timestamps', []),
                         data.get('role_request_timestamps') or {},
                         time.time())
-                    self._json(200, {
-                        'ready_replica_urls':
-                            controller.serving_urls(),
-                        'ready_replicas':
-                            controller.serving_replicas()})
+                    self._json(200, controller.sync_payload())
                 elif self.path == http_protocol.CONTROLLER_UPDATE:
                     controller.reload_version()
                     self._json(200, {'version': controller.version})
@@ -188,12 +188,36 @@ class SkyServeController:
         return sum(s.target_num_replicas
                    for s in self.autoscalers.values())
 
+    def _next_region(self, role: str) -> Optional[str]:
+        """Round-robin over the role's region plan (a multi-replica
+        pool lands spread across its top regions, so a full-region
+        loss leaves same-role capacity standing elsewhere)."""
+        regions = self.region_plan.get(role) or []
+        if not regions:
+            return None
+        cursor = self._region_cursor.get(role, 0)
+        self._region_cursor[role] = cursor + 1
+        return regions[cursor % len(regions)]
+
     def serving_replicas(self):
         """READY replicas with role/load/page-size facts — what the
         LB's router dispatches and hands off with."""
         urls = set(self.serving_urls())
         return [info for info in self.replica_manager.ready_infos()
                 if info['url'] in urls]
+
+    def sync_payload(self) -> Dict:
+        """The /controller/load_balancer_sync response.  retired_epoch
+        stamps the view: 'this ready set reflects every retirement up
+        to here', so a router clears its epoch-guarded retired entries
+        only once a sync provably includes them (never resurrecting a
+        replica a sibling router retired moments ago)."""
+        return {
+            'ready_replica_urls': self.serving_urls(),
+            'ready_replicas': self.serving_replicas(),
+            'retired_epoch':
+                replica_managers.current_retire_epoch(),
+        }
 
     def serving_urls(self):
         """Replica URLs the LB should serve.
@@ -243,6 +267,7 @@ class SkyServeController:
         # telemetry store itself carries over — history survives.
         self.slo_tracker = slo_lib.SLOTracker(
             self.service_name, slo_lib.parse_slos(self.spec.slos))
+        self.region_plan = optimizer_lib.place_role_pools(self.spec)
         logger.info(f'service {self.service_name} updated to '
                     f'version {self.version}')
 
@@ -350,7 +375,8 @@ class SkyServeController:
                     self.replica_manager.scale_up(
                         use_spot=use_spot, role=role,
                         num_hosts=getattr(
-                            self.spec.role_specs[role], 'num_hosts', 1))
+                            self.spec.role_specs[role], 'num_hosts', 1),
+                        region=self._next_region(role))
             elif n_active > decision.target_num_replicas:
                 extra = n_active - decision.target_num_replicas
                 # Retire not-ready first, then NEWEST (retirement_order
@@ -386,11 +412,19 @@ class SkyServeController:
                 logger.exception('SLO evaluation failed')
         self._replace_outdated()
         self._update_service_status()
+        # Push the (possibly changed) ready set to every router
+        # instance — the tier hears about fleet changes immediately
+        # rather than each instance on its own sync clock.
+        try:
+            self._push_router_state()
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('router state push failed')
 
     # ------------------------------------------------- fleet telemetry
 
     def _scrape_targets(self) -> List[Dict]:
-        """READY replicas (+ the LB) as aggregator scrape targets."""
+        """READY replicas (+ every router instance) as aggregator
+        scrape targets."""
         targets: List[Dict] = [
             {'url': info['url'], 'kind': 'replica',
              'replica_id': info['replica_id'],
@@ -398,11 +432,36 @@ class SkyServeController:
              'num_hosts': info.get('num_hosts') or 1}
             for info in self.replica_manager.ready_infos()]
         record = serve_state.get_service(self.service_name)
-        lb_port = (record or {}).get('load_balancer_port')
-        if lb_port:
-            targets.append({'url': f'http://127.0.0.1:{lb_port}',
+        for port in serve_state.get_router_ports(record or {}):
+            targets.append({'url': f'http://127.0.0.1:{port}',
                             'kind': 'lb'})
         return targets
+
+    def _push_router_state(self) -> None:
+        """Push the ready set (+ view epoch) to every router instance
+        the moment the fleet changes, instead of waiting out each
+        router's own sync interval — with N routers, pull-only sync
+        means N windows of stale routing per fleet change.  Best
+        effort: the routers' pull sync is the backstop."""
+        payload = self.sync_payload()
+        ready = payload['ready_replica_urls']
+        if ready == self._last_pushed_ready:
+            return
+        record = serve_state.get_service(self.service_name)
+        ports = serve_state.get_router_ports(record or {})
+        if not ports:
+            self._last_pushed_ready = ready
+            return
+        state = {'ready': payload['ready_replicas'],
+                 'retired_epoch': payload['retired_epoch']}
+        for port in ports:
+            try:
+                requests.post(
+                    f'http://127.0.0.1:{port}{http_protocol.LB_STATE}',
+                    json=state, timeout=2)
+            except requests.RequestException:
+                pass
+        self._last_pushed_ready = ready
 
     def _scrape_fleet(self) -> None:
         try:

@@ -38,9 +38,15 @@ ATTEMPT_HEADER = 'X-SkyTPU-Attempt'
 # engine slot.  Past it, the request is reaped and its KV pages freed
 # (HTTP 504) instead of decoding to a client that stopped waiting.
 DEADLINE_HEADER = 'X-SkyTPU-Deadline-Ms'
+# QoS priority class ('interactive' | 'batch').  Clients may set it;
+# the router stamps the default class when absent, applies weighted
+# admission per class, and the engine scheduler enforces the class's
+# token budget and deadline default.
+QOS_CLASS_HEADER = 'X-SkyTPU-QoS-Class'
 
 HEADERS = (REQUEST_ID_HEADER, ROUTED_ROLE_HEADER, AFFINITY_HEADER,
-           HANDOFF_MS_HEADER, ATTEMPT_HEADER, DEADLINE_HEADER)
+           HANDOFF_MS_HEADER, ATTEMPT_HEADER, DEADLINE_HEADER,
+           QOS_CLASS_HEADER)
 
 # --------------------------------------------- replica front (both HTTP
 # fronts expose the identical surface; the http-contract pass proves it)
@@ -65,8 +71,13 @@ LB_PREFIX = '/lb/'
 LB_RETIRE = '/lb/retire'              # POST: controller's drain nudge
 LB_METRICS = '/lb/metrics'            # GET: LB process exposition
 LB_SPANS = '/lb/spans'                # GET: LB trace segments
+# Router-tier brain replication: the controller pushes ready/retired
+# deltas here (fan-out to every router instance), and sibling routers
+# replicate retire/affinity deltas peer-to-peer so a prefix pinned on
+# one instance re-homes identically on all of them.
+LB_STATE = '/lb/state'                # POST: ready/retired/affinity deltas
 
-LB_PATHS = (LB_RETIRE, LB_METRICS, LB_SPANS)
+LB_PATHS = (LB_RETIRE, LB_METRICS, LB_SPANS, LB_STATE)
 
 # ------------------------------------------------------------ controller
 CONTROLLER_PREFIX = '/controller/'

@@ -49,7 +49,9 @@ import requests
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing
+from skypilot_tpu.serve import brain_store as brain_store_lib
 from skypilot_tpu.serve import http_protocol
+from skypilot_tpu.serve import qos as qos_lib
 from skypilot_tpu.serve import router as router_lib
 
 logger = sky_logging.init_logger(__name__)
@@ -120,6 +122,39 @@ _M_HANDOFF_WIRE_BYTES = metrics_lib.counter(
     'Bytes shipped on the kv_import leg of KV page handoffs, by wire '
     '(binary = application/octet-stream frame; json = base64 '
     'payload).', ('wire',))
+# Router-tier instruments: every instance of a tier shares the process
+# registry, so each series carries the instance id — `serve status
+# --metrics` builds its ROUTERS table from these (scraped per instance
+# via GET /lb/metrics).
+_M_ROUTER_REQUESTS = metrics_lib.counter(
+    'skytpu_router_requests_total',
+    'Requests handled, per router-tier instance.', ('router',))
+_M_ROUTER_QPS = metrics_lib.gauge(
+    'skytpu_router_qps',
+    'Recent requests/second per router instance (60s window, '
+    'refreshed at scrape time).', ('router',))
+_M_ROUTER_INFLIGHT = metrics_lib.gauge(
+    'skytpu_router_inflight',
+    'Requests currently in flight through this router instance.',
+    ('router',))
+_M_ROUTER_SYNC_AGE = metrics_lib.gauge(
+    'skytpu_router_sync_age_seconds',
+    'Seconds since this router instance last converged with the '
+    'controller (its own sync or a /lb/state push).', ('router',))
+_M_ROUTER_AFFINITY = metrics_lib.counter(
+    'skytpu_router_affinity_total',
+    'Prefix-affinity outcomes per router-tier instance (hit = prompt '
+    'prefix pinned to a live replica; the tier-wide totals stay in '
+    'skytpu_lb_affinity_*_total).', ('router', 'outcome'))
+_M_ROUTER_QOS = metrics_lib.counter(
+    'skytpu_router_qos_total',
+    'QoS admission decisions per router instance, by class and '
+    'outcome (admitted / shed).', ('router', 'qos_class', 'outcome'))
+_M_ROUTER_STATE_APPLIED = metrics_lib.counter(
+    'skytpu_router_state_applied_total',
+    'Brain-store deltas applied from /lb/state, by kind (push = '
+    'controller ready-set push; retire / affinity = sibling-router '
+    'replication).', ('kind',))
 
 _REQUEST_ID_KEY = tracing.REQUEST_ID_HEADER.lower()
 
@@ -421,13 +456,29 @@ class SkyServeLoadBalancer:
 
     def __init__(self, controller_url: str, port: int = 0,
                  policy: Optional[LoadBalancingPolicy] = None,
-                 router: Optional[router_lib.Router] = None) -> None:
+                 router: Optional[router_lib.Router] = None,
+                 router_id: Optional[str] = None,
+                 qos: Optional[Dict[str, Any]] = None) -> None:
         self.controller_url = controller_url.rstrip('/')
         self.port = port
         self.policy = policy or RoundRobinPolicy()
         # Role/affinity routing for generation requests; non-routable
         # traffic keeps the flat policy above.
         self.router = router or router_lib.Router()
+        # Identity within a router tier; defaults to 'r<port>' once the
+        # port is bound (the skytpu_router_* metric label).
+        self.router_id = router_id
+        # QoS weighted admission: per-class in-flight caps derived from
+        # the class weights and this instance's in-flight bound
+        # (service spec `routers.qos` / SKYTPU_LB_QOS_MAX_INFLIGHT);
+        # a class over its share is shed with 429 + Retry-After.
+        self.qos_specs = qos_lib.from_config(qos)
+        self.qos_max_inflight = qos_lib.router_max_inflight()
+        self._qos_inflight: Dict[str, int] = {}
+        # Rolling per-instance request timestamps (60s) for the
+        # skytpu_router_qps gauge, refreshed at scrape time.
+        self._recent_requests: List[float] = []
+        self._inflight_here = 0
         # LB-side trace segments (one per routed request: route /
         # handoff / per-attempt phases), exported via GET /lb/spans
         # for cross-process assembly (sky serve trace).
@@ -445,11 +496,6 @@ class SkyServeLoadBalancer:
         # the once-per-outage staleness WARNING already fired.
         self._last_sync_ok = time.monotonic()
         self._stale_warned = False
-        # Urls retired via /lb/retire (drain push): excluded from sync
-        # payloads until the controller's own view catches up (a
-        # payload without the url clears the entry), so a stale
-        # in-flight sync cannot resurrect a draining replica.
-        self._retired: set = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -462,11 +508,12 @@ class SkyServeLoadBalancer:
         """Install the ready set with role/load info (what the
         controller sync delivers; tests and benches call it directly).
         Dicts carry at least `url`, optionally `role`, `load`,
-        `page_size`."""
+        `page_size`, `region`."""
         endpoints = [router_lib.ReplicaEndpoint(
             url=r['url'], role=r.get('role') or router_lib.DEFAULT_ROLE,
             load=float(r.get('load') or 0.0),
-            page_size=r.get('page_size')) for r in replicas]
+            page_size=r.get('page_size'),
+            region=r.get('region')) for r in replicas]
         self.router.set_endpoints(endpoints)
         with self._lock:
             self.ready_urls = [e.url for e in endpoints]
@@ -478,24 +525,39 @@ class SkyServeLoadBalancer:
         _M_SYNC_AGE.set(round(age, 3))
         return age
 
-    def retire_url(self, url: str) -> bool:
+    @property
+    def _retired(self) -> Dict[str, int]:
+        """The shared retired set (url -> epoch) — lives in the brain
+        store so every router instance of a tier sees one view."""
+        return self.router.store.retired_urls()
+
+    def retire_url(self, url: str, epoch: Optional[int] = None,
+                   replicated: bool = False) -> bool:
         """Drop one replica from routing NOW (the controller's drain
         nudge — ahead of the next sync): removed from the ready set
-        and the router, prefix-affinity pins re-home, and a stale
-        in-flight sync payload cannot re-add it (the retired set
-        filters syncs until the controller's view catches up)."""
+        and the router, prefix-affinity pins re-home, and the retire
+        is recorded in the shared brain store at `epoch` — a sync
+        captured before that epoch cannot re-add the replica on THIS
+        router or any sibling (the store fans the delta out;
+        `replicated` marks a delta that arrived from a sibling and
+        must not fan back)."""
+        store = self.router.store
+        if isinstance(store, brain_store_lib.ReplicatedBrainStore):
+            epoch = store.retire(url, epoch, replicated=replicated)
+        else:
+            epoch = store.retire(url, epoch)
         with self._lock:
             present = url in self.ready_urls
             if present:
                 self.ready_urls = [u for u in self.ready_urls
                                    if u != url]
-            self._retired.add(url)
         removed = self.router.remove_endpoint(url)
         if present or removed:
             _M_RETIRED.inc()
-        _journal_handoff('lb_retire', url=url,
+        _journal_handoff('lb_retire', url=url, epoch=epoch,
                          known=bool(present or removed))
-        logger.info(f'LB retired replica {url} (drain nudge)')
+        logger.info(f'LB retired replica {url} (drain nudge, '
+                    f'epoch {epoch})')
         return present or removed
 
     def _sync_with_controller(self) -> None:
@@ -513,15 +575,15 @@ class SkyServeLoadBalancer:
             data = resp.json()
             urls = data.get('ready_replica_urls', [])
             infos = data.get('ready_replicas')
-            with self._lock:
-                # A retired (draining) url still present in this
-                # payload means the sync raced the retire push — keep
-                # it excluded.  Absent means the controller caught up;
-                # forget the entry so a future replica at the same
-                # address is routable again.
-                retired = self._retired = {
-                    u for u in self._retired if u in urls}
-            urls = [u for u in urls if u not in retired]
+            # Epoch-guarded retired reconciliation: an entry retired at
+            # epoch e only clears once the controller's view is stamped
+            # retired_epoch >= e.  A stale sync — captured before a
+            # sibling router's retire, arriving here late — still lists
+            # the url but carries an older epoch, so it keeps being
+            # filtered instead of resurrecting the replica.
+            urls = self.router.store.reconcile_retired(
+                urls, data.get('retired_epoch'))
+            retired = set(self.router.store.retired_urls())
             if infos is not None:
                 self.set_replicas([i for i in infos
                                    if i.get('url') not in retired])
@@ -607,14 +669,20 @@ class SkyServeLoadBalancer:
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         target = None
+        tracked = False
         try:
             head = await asyncio.wait_for(_read_head(reader), timeout=60)
             start_line, headers = _parse_head(head)
             t_start = time.perf_counter()
             with self._lock:
                 self.request_timestamps.append(time.time())
+                self._recent_requests.append(time.time())
+                self._inflight_here += 1
+                tracked = True
                 self._trim_timestamps_locked()
                 urls = list(self.ready_urls)
+            _M_ROUTER_REQUESTS.labels(
+                router=self.router_id or 'r0').inc()
             # Keep the router's endpoint set in lockstep with however
             # ready_urls was installed (controller sync, set_replicas,
             # or a test assigning the attribute directly).
@@ -687,6 +755,9 @@ class SkyServeLoadBalancer:
             # Client went away or the stream broke mid-relay: close.
             pass
         finally:
+            if tracked:
+                with self._lock:
+                    self._inflight_here -= 1
             try:
                 writer.close()
                 await writer.wait_closed()
@@ -695,6 +766,71 @@ class SkyServeLoadBalancer:
 
     # ----------------------------------------------------- control plane
 
+    def _update_router_gauges(self) -> None:
+        """Refresh this instance's skytpu_router_* gauges (called at
+        /lb/metrics scrape time)."""
+        now = time.time()
+        with self._lock:
+            self._recent_requests = [t for t in self._recent_requests
+                                     if now - t <= 60.0]
+            qps = len(self._recent_requests) / 60.0
+            inflight = self._inflight_here
+        rid = self.router_id or 'r0'
+        _M_ROUTER_QPS.labels(router=rid).set(round(qps, 4))
+        _M_ROUTER_INFLIGHT.labels(router=rid).set(inflight)
+        _M_ROUTER_SYNC_AGE.labels(router=rid).set(
+            round(time.monotonic() - self._last_sync_ok, 3))
+
+    def apply_state(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Apply a POST /lb/state payload — the generalized control
+        plane a router tier converges through:
+
+        - `{'ready': [infos], 'retired_epoch': E}` — the controller's
+          ready-set push (same shape as its sync response), delivered
+          to every instance the moment the fleet changes.
+        - `{'retire': {'url', 'epoch'}}` — a sibling router's
+          replicated retirement (never re-fanned).
+        - `{'affinity': {'key', 'url'}}` — a sibling's replicated
+          prefix pin, so a repeat prefix re-homes identically on
+          every instance."""
+        applied: List[str] = []
+        store = self.router.store
+        infos = payload.get('ready')
+        if isinstance(infos, list):
+            urls = [i.get('url') for i in infos
+                    if isinstance(i, dict) and i.get('url')]
+            urls = store.reconcile_retired(
+                urls, payload.get('retired_epoch'))
+            keep = set(urls)
+            self.set_replicas([i for i in infos
+                               if isinstance(i, dict) and
+                               i.get('url') in keep])
+            with self._lock:
+                self._last_sync_ok = time.monotonic()
+                self._stale_warned = False
+            _M_ROUTER_STATE_APPLIED.labels(kind='push').inc()
+            applied.append('ready')
+        retire = payload.get('retire')
+        if isinstance(retire, dict) and retire.get('url'):
+            self.retire_url(str(retire['url']), retire.get('epoch'),
+                            replicated=True)
+            _M_ROUTER_STATE_APPLIED.labels(kind='retire').inc()
+            applied.append('retire')
+        affinity = payload.get('affinity')
+        if isinstance(affinity, dict) and affinity.get('url'):
+            key = brain_store_lib.decode_affinity_key(
+                affinity.get('key'))
+            if key is not None:
+                if isinstance(store,
+                              brain_store_lib.ReplicatedBrainStore):
+                    store.record_affinity(key, affinity['url'],
+                                          replicated=True)
+                else:
+                    store.record_affinity(key, affinity['url'])
+                _M_ROUTER_STATE_APPLIED.labels(kind='affinity').inc()
+                applied.append('affinity')
+        return {'applied': applied}
+
     async def _handle_control(self, writer: asyncio.StreamWriter,
                               method: str, path: str,
                               reader: asyncio.StreamReader,
@@ -702,8 +838,12 @@ class SkyServeLoadBalancer:
                               query: str = '') -> None:
         """`/lb/*` endpoints served by the LB itself:
 
-        POST /lb/retire {"url": ...} — the controller's drain nudge:
-        stop routing to the replica NOW instead of at the next sync.
+        POST /lb/retire {"url": ..., "epoch": ...} — the controller's
+        drain nudge: stop routing to the replica NOW instead of at the
+        next sync; the epoch guards against stale-sync resurrection.
+        POST /lb/state — the router-tier state plane: controller
+        ready-set pushes and sibling retire/affinity deltas
+        (see apply_state).
         GET /lb/metrics — this LB process's Prometheus exposition
         (sync age, retries, handoffs); `serve status --metrics` reads
         the SYNC AGE column here.
@@ -716,15 +856,31 @@ class SkyServeLoadBalancer:
                 timeout=30)
         if method == 'POST' and path == http_protocol.LB_RETIRE:
             try:
-                url = (json.loads(body or b'{}') or {}).get('url')
+                parsed = json.loads(body or b'{}') or {}
+                url = parsed.get('url')
             except (json.JSONDecodeError, AttributeError):
-                url = None
+                parsed, url = {}, None
             if not url:
                 writer.write(_simple_response(
                     400, 'Bad Request', b'missing "url"'))
             else:
-                known = self.retire_url(str(url))
+                known = self.retire_url(str(url), parsed.get('epoch'))
                 payload = json.dumps({'retired': known}).encode()
+                writer.write(
+                    (f'HTTP/1.1 200 OK\r\n'
+                     f'Content-Type: application/json\r\n'
+                     f'Content-Length: {len(payload)}\r\n'
+                     f'Connection: close\r\n\r\n').encode() + payload)
+        elif method == 'POST' and path == http_protocol.LB_STATE:
+            try:
+                state = json.loads(body or b'{}') or {}
+            except (json.JSONDecodeError, AttributeError):
+                state = None
+            if not isinstance(state, dict):
+                writer.write(_simple_response(
+                    400, 'Bad Request', b'expected a JSON object'))
+            else:
+                payload = json.dumps(self.apply_state(state)).encode()
                 writer.write(
                     (f'HTTP/1.1 200 OK\r\n'
                      f'Content-Type: application/json\r\n'
@@ -732,6 +888,7 @@ class SkyServeLoadBalancer:
                      f'Connection: close\r\n\r\n').encode() + payload)
         elif method == 'GET' and path == http_protocol.LB_METRICS:
             self.sync_age()   # freshen the gauge at scrape time
+            self._update_router_gauges()
             text = metrics_lib.expose().encode()
             writer.write(
                 (f'HTTP/1.1 200 OK\r\n'
@@ -808,6 +965,78 @@ class SkyServeLoadBalancer:
         X-SkyTPU-Attempt so the replicas' spans stay distinct when a
         retry reuses the request id."""
         wall_start = time.time()
+        rid = next((v for n, v in headers
+                    if n.lower() == _REQUEST_ID_KEY), None) or \
+            tracing.new_request_id()
+        # QoS class: the client's header, clamped to a known class
+        # (absent/unknown -> the default class).
+        qos_class = qos_lib.normalize(next(
+            (v for n, v in headers
+             if n.lower() == router_lib.QOS_CLASS_HEADER.lower()),
+            None))
+        router_label = self.router_id or 'r0'
+        # Weighted admission: near the in-flight cap each class only
+        # gets its weighted share; over it the request is shed with
+        # 429 + Retry-After (the class's own backlog must not consume
+        # the other class's floor).
+        limits = qos_lib.admission_limits(self.qos_max_inflight,
+                                          self.qos_specs)
+        with self._lock:
+            limit = limits.get(qos_class)
+            shed = (limit is not None and
+                    self._qos_inflight.get(qos_class, 0) >= limit)
+            if not shed:
+                self._qos_inflight[qos_class] = \
+                    self._qos_inflight.get(qos_class, 0) + 1
+        spec = self.qos_specs.get(qos_class)
+        _journal_handoff('qos_request_start', request_id=rid,
+                         qos_class=qos_class,
+                         weight=spec.weight if spec else 1,
+                         shed_limit=limits.get(qos_class))
+        if shed:
+            _M_ROUTER_QOS.labels(router=router_label,
+                                 qos_class=qos_class,
+                                 outcome='shed').inc()
+            _journal_handoff('qos_request_end', request_id=rid,
+                             qos_class=qos_class, status='shed')
+            body_text = (f'QoS class {qos_class} over its admission '
+                         f'share; retry later.').encode()
+            cwriter.write(
+                (f'HTTP/1.1 429 Too Many Requests\r\n'
+                 f'Retry-After: 1\r\n'
+                 f'Content-Length: {len(body_text)}\r\n'
+                 f'Content-Type: text/plain\r\n'
+                 f'Connection: close\r\n\r\n').encode() + body_text)
+            await cwriter.drain()
+            return
+        _M_ROUTER_QOS.labels(router=router_label, qos_class=qos_class,
+                             outcome='admitted').inc()
+        qos_status = 'error'
+        try:
+            await self._route_admitted(cwriter, start_line, headers,
+                                       body, t_start, wall_start, rid,
+                                       qos_class)
+            qos_status = 'ok'
+        finally:
+            with self._lock:
+                n = self._qos_inflight.get(qos_class, 0) - 1
+                if n <= 0:
+                    self._qos_inflight.pop(qos_class, None)
+                else:
+                    self._qos_inflight[qos_class] = n
+            # The qos_request lifecycle terminates on EVERY path (the
+            # qos_fairness invariant replays start/end pairs).
+            _journal_handoff('qos_request_end', request_id=rid,
+                             qos_class=qos_class, status=qos_status)
+
+    async def _route_admitted(self, cwriter: asyncio.StreamWriter,
+                              start_line: str,
+                              headers: List[Tuple[str, str]],
+                              body: bytes, t_start: float,
+                              wall_start: float, rid: str,
+                              qos_class: str) -> None:
+        """The routed path after QoS admission: role/affinity routing,
+        optional KV handoff, bounded same-role retry, relay."""
         _, ids, key, prompt_len = self._parse_prompt(body)
         decision = self.router.route(key, prompt_len)
         if decision.url is None:
@@ -822,13 +1051,16 @@ class SkyServeLoadBalancer:
             _M_AFFINITY_HITS.inc()
         elif decision.affinity == 'miss':
             _M_AFFINITY_MISSES.inc()
+        if decision.affinity in ('hit', 'miss'):
+            _M_ROUTER_AFFINITY.labels(
+                router=self.router_id or 'r0',
+                outcome=decision.affinity).inc()
         self._record_role_timestamp(decision.role)
-        rid = next((v for n, v in headers
-                    if n.lower() == _REQUEST_ID_KEY), None) or \
-            tracing.new_request_id()
         seg: Dict[str, Any] = {
             'request_id': rid, 'process': 'lb', 'name': 'lb',
             'attempt': 0, 'start': wall_start,
+            'router': self.router_id or 'r0',
+            'qos_class': qos_class,
             'role': decision.role, 'affinity': decision.affinity,
             'phases': [{
                 'name': 'route', 'start': wall_start,
@@ -840,6 +1072,10 @@ class SkyServeLoadBalancer:
         _journal_handoff('lb_route', request_id=rid, url=decision.url,
                          role=decision.role,
                          affinity=decision.affinity,
+                         qos_class=qos_class,
+                         router=self.router_id or 'r0',
+                         region=decision.region,
+                         cross_region=decision.cross_region,
                          handoff=bool(decision.handoff_source))
         handoff_ms: Optional[float] = None
         if decision.handoff_source and ids is not None:
@@ -858,6 +1094,10 @@ class SkyServeLoadBalancer:
             tracing.REQUEST_ID_HEADER: rid,
             router_lib.ROUTED_ROLE_HEADER: decision.role,
             router_lib.AFFINITY_HEADER: decision.affinity,
+            # Stamped on every routed request (normalized — the engine
+            # scheduler applies the class's token budget and deadline
+            # default without re-validating).
+            router_lib.QOS_CLASS_HEADER: qos_class,
         }
         if handoff_ms is not None:
             extra[router_lib.HANDOFF_MS_HEADER] = f'{handoff_ms:.3f}'
@@ -1356,6 +1596,8 @@ class SkyServeLoadBalancer:
         threading.Thread(target=self._run_loop, daemon=True).start()
         if not self._started.wait(10):
             raise RuntimeError('load balancer failed to bind')
+        if self.router_id is None:
+            self.router_id = f'r{self.port}'
         threading.Thread(target=self._sync_loop, daemon=True).start()
         logger.info(f'load balancer on :{self.port} -> '
                     f'{self.controller_url}')

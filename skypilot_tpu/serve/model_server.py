@@ -57,6 +57,7 @@ from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import batching_engine as batching_engine_lib
 from skypilot_tpu.serve import handoff as handoff_lib
 from skypilot_tpu.serve import http_protocol
+from skypilot_tpu.serve import qos as qos_lib
 from skypilot_tpu.serve import router as router_lib
 
 logger = sky_logging.init_logger(__name__)
@@ -476,6 +477,7 @@ class ModelServer:
                  request_id: Optional[str] = None,
                  route_meta: Optional[Dict[str, Any]] = None,
                  deadline_ms: Optional[float] = None,
+                 qos_class: Optional[str] = None,
                  on_submit=None, disconnect_probe=None) -> Any:
         """stop_token: None, a single id, or an iterable of ids (the
         tokenizer's multi-EOS stop set).
@@ -523,7 +525,8 @@ class ModelServer:
                                         else (request_id if i == 0 else
                                               f'{request_id}-{i}')),
                                     route_meta=route_meta,
-                                    deadline_ms=deadline_ms)
+                                    deadline_ms=deadline_ms,
+                                    qos_class=qos_class)
                 for i, row in enumerate(prompt_ids)
             ]
             if on_submit is not None:
@@ -628,6 +631,12 @@ def _make_handler(server: ModelServer):
                 except ValueError:
                     pass
             return default_deadline_ms()
+
+        def _qos_class(self) -> str:
+            """The request's X-SkyTPU-QoS-Class, clamped to a known
+            class (absent -> the env default class)."""
+            return qos_lib.normalize(
+                self.headers.get(router_lib.QOS_CLASS_HEADER))
 
         def _disconnect_probe(self):
             """True once the client socket is closed.  MSG_PEEK never
@@ -765,6 +774,7 @@ def _make_handler(server: ModelServer):
                     request_id=rid,
                     route_meta=self._route_meta(),
                     deadline_ms=self._deadline_ms(),
+                    qos_class=self._qos_class(),
                     disconnect_probe=self._disconnect_probe())[0]
                 _maybe_journal_request('serve_request_done',
                                        request_id=rid, status='ok',
@@ -805,7 +815,8 @@ def _make_handler(server: ModelServer):
                 sampling=decode.SamplingConfig(
                     temperature=temperature, top_k=top_k, seed=seed),
                 request_id=rid, route_meta=self._route_meta(),
-                deadline_ms=self._deadline_ms())
+                deadline_ms=self._deadline_ms(),
+                qos_class=self._qos_class())
             self._start_sse(rid)
             decoder = StreamDecoder(tok)
             try:
@@ -863,7 +874,8 @@ def _make_handler(server: ModelServer):
                         temperature=temperature, top_k=top_k,
                         seed=seed),
                     request_id=rid, route_meta=self._route_meta(),
-                    deadline_ms=self._deadline_ms())
+                    deadline_ms=self._deadline_ms(),
+                    qos_class=self._qos_class())
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as e:
                 self._reply(400, {'error': str(e)})
@@ -1097,6 +1109,7 @@ def _make_handler(server: ModelServer):
                     temperature, top_k, seed=seed, request_id=rid,
                     route_meta=self._route_meta(),
                     deadline_ms=self._deadline_ms(),
+                    qos_class=self._qos_class(),
                     disconnect_probe=self._disconnect_probe())
                 _maybe_journal_request(
                     'serve_request_done', request_id=rid, status='ok',
@@ -1140,7 +1153,15 @@ def start_background(server: ModelServer, port: int = 0):
     httpd = ThreadingHTTPServer(('0.0.0.0', port),
                                 _make_handler(server))
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
-    return httpd.server_port, httpd.shutdown
+
+    def stop() -> None:
+        httpd.shutdown()
+        # Close the listening socket too: a stopped replica must
+        # REFUSE connections (so an LB retries a sibling fast), not
+        # strand them in the accept backlog.
+        httpd.server_close()
+
+    return httpd.server_port, stop
 
 
 def main() -> None:

@@ -8,6 +8,8 @@ its slot is refilled (slices fail as a unit).
 """
 from __future__ import annotations
 
+import itertools
+import json
 import os
 import socket
 import threading
@@ -65,6 +67,31 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(('', 0))
         return s.getsockname()[1]
+
+
+# Retirement epochs: every retirement the controller side announces
+# (drain nudge or sync payload) carries one.  Time-seeded so a
+# restarted controller keeps issuing LARGER epochs than anything a
+# router remembers — the router clears a retired entry only once a
+# sync's epoch proves the controller processed that retirement, which
+# is what stops a stale sync at one router from resurrecting a replica
+# a sibling router just retired (ISSUE 15 epoch guard).
+_retire_epochs = itertools.count(int(time.time()))
+_retire_epoch_lock = threading.Lock()
+
+
+def next_retire_epoch() -> int:
+    with _retire_epoch_lock:
+        return next(_retire_epochs)
+
+
+def current_retire_epoch() -> int:
+    """The newest issued epoch (what a controller sync stamps as
+    `retired_epoch`: 'my view includes every retirement up to here')."""
+    with _retire_epoch_lock:
+        # itertools.count has no peek; issue-and-use keeps the
+        # invariant (a sync's view epoch >= every prior nudge epoch).
+        return next(_retire_epochs)
 
 
 def _drain_timeout() -> float:
@@ -140,16 +167,19 @@ class ReplicaManager:
     # ----------------------------------------------------------- scale up
 
     def scale_up(self, use_spot: Optional[bool] = None,
-                 role: str = 'mixed', num_hosts: int = 1) -> int:
+                 role: str = 'mixed', num_hosts: int = 1,
+                 region: Optional[str] = None) -> int:
         """Launch one replica asynchronously (into `role`'s pool);
         returns its id.  num_hosts > 1 launches it as a SLICE replica:
         a gang of that many hosts serving as one unit
         (serve/slice_replica.py — the model server reads
-        SKYTPU_SERVE_REPLICA_NUM_HOSTS)."""
+        SKYTPU_SERVE_REPLICA_NUM_HOSTS).  region (multi-region
+        placement, optimizer.place_role_pools) is recorded and rides
+        the LB sync so routers can prefer same-region replicas."""
         replica_id = serve_state.allocate_replica(
             self.service_name, self.service_name,
             is_spot=bool(use_spot), version=self.version, role=role,
-            num_hosts=int(num_hosts))
+            num_hosts=int(num_hosts), region=region)
         cluster_name = self._cluster_name(replica_id)
         port = _free_port() if self._is_local() else self.spec.replica_port
         thread = threading.Thread(
@@ -180,6 +210,12 @@ class ReplicaManager:
             # num_hosts gang (--num-hosts default).
             ENV_REPLICA_NUM_HOSTS: str(int(num_hosts)),
         })
+        qos_config = getattr(self.spec, 'qos', None)
+        if qos_config:
+            # The spec's routers.qos block rides to the replica as
+            # JSON: the engine scheduler reads it for class token
+            # budgets / deadline defaults (serve/qos.py).
+            task.update_envs({'SKYTPU_QOS_SPEC': json.dumps(qos_config)})
         if int(num_hosts) > 1 and getattr(task, 'num_nodes', 1) <= 1:
             # The replica cluster must provision the whole slice: one
             # node per host rank (the gang supervisor fans the run
@@ -298,23 +334,29 @@ class ReplicaManager:
         return None
 
     def _nudge_lb_retire(self, url: Optional[str]) -> None:
-        """Push the retirement to the LB instead of waiting for its
-        next controller sync (~SKYTPU_SERVE_SYNC_INTERVAL): the LB
-        drops the url from its ready set and re-pins prefix affinity
-        right away.  Best effort — the sync payload (which excludes
-        DRAINING replicas) is the backstop."""
+        """Push the retirement to EVERY router instance instead of
+        waiting for their next controller sync
+        (~SKYTPU_SERVE_SYNC_INTERVAL): each drops the url from its
+        ready set and re-pins prefix affinity right away.  The nudge
+        carries a retire epoch so a router that took it can't be
+        talked out of it by a sibling's staler sync.  Best effort —
+        the sync payload (which excludes DRAINING replicas) is the
+        backstop."""
         if not url:
             return
         record = serve_state.get_service(self.service_name)
-        lb_port = (record or {}).get('load_balancer_port')
-        if not lb_port:
+        ports = serve_state.get_router_ports(record or {})
+        if not ports:
             return
-        try:
-            requests.post(f'http://127.0.0.1:{lb_port}'
-                          f'{http_protocol.LB_RETIRE}',
-                          json={'url': url}, timeout=2)
-        except requests.RequestException:
-            pass
+        epoch = next_retire_epoch()
+        for port in ports:
+            try:
+                requests.post(f'http://127.0.0.1:{port}'
+                              f'{http_protocol.LB_RETIRE}',
+                              json={'url': url, 'epoch': epoch},
+                              timeout=2)
+            except requests.RequestException:
+                pass
 
     def sync_draining(self) -> None:
         """Drain monitor: one pass over DRAINING replicas.  A replica
@@ -621,6 +663,7 @@ class ReplicaManager:
                 'page_size': stats.get('page_size'),
                 'queue_depth': stats.get('queue_depth', 0),
                 'num_hosts': r.get('num_hosts') or 1,
+                'region': r.get('region'),
             })
         return infos
 

@@ -25,17 +25,25 @@ routing brain (`serve/load_balancer.py` owns the sockets):
   count here, last replica-reported load, url) — the LB's own
   in-flight view reacts instantly; the controller-synced load
   (busy+queued slots from `/health`) breaks ties across LBs.
+- **Regions.**  Endpoints carry the region their replica was placed
+  in (`optimizer.place_role_pools`); a router with a region of its own
+  (``SKYTPU_LB_REGION``) prefers same-region targets and fails over
+  cross-region the moment the local pool empties (chaos
+  `region_loss_failover` covers the full-region case).
 
-Everything is process-local and lock-protected; no I/O.
+The brain *state* (ready set, affinity map, in-flight counts, retired
+epochs) lives in `serve/brain_store.py` — one in-process store per
+single router, one shared store across a router tier.  This module
+keeps the selection logic and takes the store's lock around each
+decision, so tier-wide route decisions stay atomic.
 """
 from __future__ import annotations
 
-import collections
 import dataclasses
 import os
-import threading
 from typing import Any, Dict, Hashable, List, Optional, Sequence
 
+from skypilot_tpu.serve import brain_store as brain_store_lib
 from skypilot_tpu.serve import http_protocol
 
 ROLES = ('prefill', 'decode', 'mixed')
@@ -49,6 +57,7 @@ AFFINITY_HEADER = http_protocol.AFFINITY_HEADER
 HANDOFF_MS_HEADER = http_protocol.HANDOFF_MS_HEADER
 ATTEMPT_HEADER = http_protocol.ATTEMPT_HEADER
 DEADLINE_HEADER = http_protocol.DEADLINE_HEADER
+QOS_CLASS_HEADER = http_protocol.QOS_CLASS_HEADER
 
 # Prompt tokens (or chars/4 for text prompts) at which a request
 # counts as prefill-heavy and is eligible for prefill-pool handoff.
@@ -58,6 +67,12 @@ _PREFIX_KEY_CHARS = 256
 
 def prefill_threshold() -> int:
     return int(os.environ.get('SKYTPU_LB_PREFILL_THRESHOLD', '64'))
+
+
+def router_region() -> Optional[str]:
+    """Region identity of this router instance (region-aware dispatch
+    prefers same-region replicas); unset = region-blind."""
+    return os.environ.get('SKYTPU_LB_REGION') or None
 
 
 def prompt_key(prompt_ids: Optional[Sequence[int]] = None,
@@ -83,6 +98,7 @@ class ReplicaEndpoint:
     role: str = DEFAULT_ROLE
     load: float = 0.0           # (busy + queued) / slots, last probe
     page_size: Optional[int] = None
+    region: Optional[str] = None   # placement region (None = unplaced)
 
     def __post_init__(self) -> None:
         if self.role not in ROLES:
@@ -99,51 +115,65 @@ class RouteDecision:
     key: Optional[Hashable] = None      # prompt prefix key (affinity)
     handoff_source: Optional[str] = None  # prefill replica to export from
     page_size: Optional[int] = None     # target's KV page size (if known)
+    region: Optional[str] = None        # target's region
+    cross_region: bool = False          # local pool empty -> failover
 
 
 class Router:
     """Role dispatch + prefix affinity + least-loaded selection."""
 
     def __init__(self, threshold: Optional[int] = None,
-                 affinity_capacity: int = 4096) -> None:
+                 affinity_capacity: int = 4096,
+                 store: Optional[brain_store_lib.InProcessBrainStore]
+                 = None,
+                 region: Optional[str] = None) -> None:
         self.threshold = (prefill_threshold() if threshold is None
                           else int(threshold))
-        self._lock = threading.Lock()
-        self._endpoints: Dict[str, ReplicaEndpoint] = {}
-        # prefix key -> url last served, LRU-bounded (a router serving
-        # millions of sessions must not grow without bound).
-        self._affinity: 'collections.OrderedDict[Hashable, str]' = (
-            collections.OrderedDict())
-        self._affinity_capacity = int(affinity_capacity)
-        self._inflight: Dict[str, int] = {}
-        self.affinity_hits = 0
-        self.affinity_misses = 0
+        self.store = store if store is not None else (
+            brain_store_lib.InProcessBrainStore(
+                affinity_capacity=affinity_capacity))
+        self.region = region if region is not None else router_region()
+        self._lock = self.store.lock
+
+    # Counters live on the shared store so the whole tier reports one
+    # affinity hit rate; exposed as properties for API compat.
+    @property
+    def affinity_hits(self) -> int:
+        return self.store.affinity_hits
+
+    @property
+    def affinity_misses(self) -> int:
+        return self.store.affinity_misses
+
+    @property
+    def _endpoints(self) -> Dict[str, ReplicaEndpoint]:
+        return self.store.endpoints
+
+    @property
+    def _affinity(self):
+        return self.store.affinity
+
+    @property
+    def _inflight(self) -> Dict[str, int]:
+        return self.store.inflight
 
     # ------------------------------------------------------------ fleet
 
     def set_endpoints(self, endpoints: List[ReplicaEndpoint]) -> None:
         """Replace the ready set (controller sync)."""
-        with self._lock:
-            self._endpoints = {e.url: e for e in endpoints}
-            self._drop_stale_affinity_locked()
+        self.store.set_endpoints({e.url: e for e in endpoints})
 
     def ensure_urls(self, urls: List[str]) -> None:
         """Reconcile with a bare url list (legacy sync / tests that
         assign `ready_urls` directly): unknown urls join as 'mixed',
         known ones keep their role/load, missing ones drop out."""
         with self._lock:
-            if set(urls) == set(self._endpoints):
+            if set(urls) == set(self.store.endpoints):
                 return
-            self._endpoints = {
-                url: self._endpoints.get(url, ReplicaEndpoint(url))
+            self.store.set_endpoints({
+                url: self.store.endpoints.get(url, ReplicaEndpoint(url))
                 for url in urls
-            }
-            self._drop_stale_affinity_locked()
-
-    def _drop_stale_affinity_locked(self) -> None:
-        for key in [k for k, url in self._affinity.items()
-                    if url not in self._endpoints]:
-            del self._affinity[key]
+            })
 
     def remove_endpoint(self, url: str) -> bool:
         """Drop one replica immediately (a drain/retire push from the
@@ -151,44 +181,41 @@ class Router:
         routes and its prefix-affinity pins re-home on next use.
         Returns whether the url was present."""
         with self._lock:
-            present = self._endpoints.pop(url, None) is not None
+            present = self.store.endpoints.pop(url, None) is not None
             if present:
-                self._drop_stale_affinity_locked()
+                self.store.drop_stale_affinity_locked()
             return present
 
     def endpoints(self) -> List[ReplicaEndpoint]:
         with self._lock:
-            return list(self._endpoints.values())
+            return list(self.store.endpoints.values())
 
     def roles_present(self) -> Dict[str, int]:
         with self._lock:
             counts: Dict[str, int] = {}
-            for e in self._endpoints.values():
+            for e in self.store.endpoints.values():
                 counts[e.role] = counts.get(e.role, 0) + 1
             return counts
 
     # ------------------------------------------------------- load view
 
     def acquire(self, url: str) -> None:
-        with self._lock:
-            self._inflight[url] = self._inflight.get(url, 0) + 1
+        self.store.acquire(url)
 
     def release(self, url: str) -> None:
-        with self._lock:
-            n = self._inflight.get(url, 0) - 1
-            if n <= 0:
-                self._inflight.pop(url, None)
-            else:
-                self._inflight[url] = n
+        self.store.release(url)
 
     def _rank_locked(self, urls: List[str]) -> List[str]:
+        endpoints = self.store.endpoints
+        inflight = self.store.inflight
         return sorted(urls, key=lambda u: (
-            self._inflight.get(u, 0),
-            self._endpoints[u].load if u in self._endpoints else 0.0,
+            inflight.get(u, 0),
+            endpoints[u].load if u in endpoints else 0.0,
             u))
 
     def _pool_locked(self, role: str) -> List[str]:
-        return [u for u, e in self._endpoints.items() if e.role == role]
+        return [u for u, e in self.store.endpoints.items()
+                if e.role == role]
 
     def _target_pool_locked(self) -> List[str]:
         """Where generation traffic goes: the decode pool, else the
@@ -198,7 +225,19 @@ class Router:
             pool = self._pool_locked(role)
             if pool:
                 return pool
-        return list(self._endpoints)
+        return list(self.store.endpoints)
+
+    def _prefer_region_locked(self, pool: List[str]) -> List[str]:
+        """Same-region subset when this router has a region and the
+        subset is non-empty; the full pool otherwise (cross-region
+        failover — a lost region must degrade latency, not serve
+        503s)."""
+        if not self.region:
+            return pool
+        local = [u for u in pool
+                 if (e := self.store.endpoints.get(u)) is not None
+                 and e.region == self.region]
+        return local or pool
 
     # ----------------------------------------------------------- route
 
@@ -213,42 +252,57 @@ class Router:
                     if u not in exclude]
             if not pool:
                 return RouteDecision(url=None, key=key)
+            regional = self._prefer_region_locked(pool)
+            cross_region = bool(self.region) and regional is pool and \
+                any(e.region for e in self.store.endpoints.values())
             affinity = 'none'
             target: Optional[str] = None
             if key is not None:
-                pinned = self._affinity.get(key)
+                pinned = self.store.affinity.get(key)
                 if pinned is not None and pinned in pool:
+                    # An affinity pin beats region preference: the
+                    # pinned replica already holds the prefix pages.
                     target = pinned
                     affinity = 'hit'
-                    self._affinity.move_to_end(key)
-                    self.affinity_hits += 1
+                    self.store.affinity.move_to_end(key)
+                    self.store.affinity_hits += 1
                 else:
                     affinity = 'miss'
-                    self.affinity_misses += 1
+                    self.store.affinity_misses += 1
             if target is None:
-                target = self._rank_locked(pool)[0]
-            endpoint = self._endpoints.get(target)
+                target = self._rank_locked(regional)[0]
+            endpoint = self.store.endpoints.get(target)
             role = endpoint.role if endpoint else DEFAULT_ROLE
             handoff_source = None
             if (prompt_len >= self.threshold and role != 'prefill'):
                 prefill = [u for u in self._pool_locked('prefill')
                            if u not in exclude]
                 if prefill:
+                    prefill = self._prefer_region_locked(prefill)
                     handoff_source = self._rank_locked(prefill)[0]
             return RouteDecision(
                 url=target, role=role, affinity=affinity, key=key,
                 handoff_source=handoff_source,
-                page_size=endpoint.page_size if endpoint else None)
+                page_size=endpoint.page_size if endpoint else None,
+                region=endpoint.region if endpoint else None,
+                cross_region=cross_region)
 
     def alternates(self, url: str,
                    exclude: Sequence[str] = ()) -> List[str]:
         """Same-role fallbacks for a failed/backpressured target,
-        best first."""
+        best first (same-region ones before cross-region)."""
         with self._lock:
-            endpoint = self._endpoints.get(url)
+            endpoint = self.store.endpoints.get(url)
             role = endpoint.role if endpoint else DEFAULT_ROLE
             skip = set(exclude) | {url}
             pool = [u for u in self._pool_locked(role) if u not in skip]
+            if self.region:
+                local = [u for u in pool
+                         if (e := self.store.endpoints.get(u))
+                         is not None and e.region == self.region]
+                remote = [u for u in pool if u not in set(local)]
+                return self._rank_locked(local) + \
+                    self._rank_locked(remote)
             return self._rank_locked(pool)
 
     def record_affinity(self, key: Optional[Hashable],
@@ -257,23 +311,19 @@ class Router:
         prefix cache now holds those pages)."""
         if key is None:
             return
-        with self._lock:
-            self._affinity[key] = url
-            self._affinity.move_to_end(key)
-            while len(self._affinity) > self._affinity_capacity:
-                self._affinity.popitem(last=False)
+        self.store.record_affinity(key, url)
 
     def affinity_target(self, key: Hashable) -> Optional[str]:
-        with self._lock:
-            return self._affinity.get(key)
+        return self.store.affinity_target(key)
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {
-                'endpoints': len(self._endpoints),
+                'endpoints': len(self.store.endpoints),
                 'roles': {r: len(self._pool_locked(r)) for r in ROLES},
-                'affinity_entries': len(self._affinity),
-                'affinity_hits': self.affinity_hits,
-                'affinity_misses': self.affinity_misses,
+                'affinity_entries': len(self.store.affinity),
+                'affinity_hits': self.store.affinity_hits,
+                'affinity_misses': self.store.affinity_misses,
                 'prefill_threshold': self.threshold,
+                'region': self.region,
             }

@@ -1,0 +1,219 @@
+"""Router tier: N stateless router instances behind one front door.
+
+One `serve/load_balancer.py` process was the last single point the
+whole fleet funneled through — ROADMAP item 4's "millions of users do
+not fit through one router".  This module runs N of them as a tier:
+
+- **Shared brain.**  Every instance routes against ONE brain store
+  (`serve/brain_store.py`): the ready set, prefix-affinity map,
+  in-flight counts, and epoch-guarded retired set are tier-wide, so
+  any instance retiring a replica retires it everywhere and two
+  instances never double-commit the same affinity slot.  In-process
+  tiers share the store object; cross-process instances replicate
+  deltas over ``POST /lb/state``.
+- **Consistent hashing.**  The prefix-affinity key maps onto a
+  virtual-node hash ring over the instances: repeat prefixes enter
+  through the same router (whose affinity map then pins the same
+  replica), and an instance joining or leaving moves only ~K/N keys —
+  every other session keeps its router AND its replica-side prefix
+  cache.
+- **Controller pushes.**  The controller reconciles the tier like a
+  role pool (service spec ``routers: {replicas, qos}``), pushing
+  ready/retired deltas to every instance over the generalized
+  ``/lb/`` control plane the moment the fleet changes.
+- **Death is boring.**  Instances are stateless; when one dies
+  (`router_instance_death` chaos scenario) the ring re-homes its keys
+  to survivors, the shared store keeps every retirement and pin, and
+  in-flight requests retry through a sibling with zero lost requests.
+
+Journal: `router_instance_start` / `router_instance_end` (process
+scope) bracket each instance's life; the chaos invariants replay them
+alongside the `lb_*` / `qos_*` events.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Hashable, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import brain_store as brain_store_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import qos as qos_lib
+from skypilot_tpu.serve import router as router_lib
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_VNODES = 64
+
+
+class RouterInstance:
+    """One running router of the tier: an id, a load balancer bound to
+    its own port, and liveness state."""
+
+    def __init__(self, instance_id: str,
+                 balancer: lb_lib.SkyServeLoadBalancer) -> None:
+        self.instance_id = instance_id
+        self.balancer = balancer
+        self.alive = False
+
+    @property
+    def port(self) -> int:
+        return self.balancer.port
+
+    @property
+    def url(self) -> str:
+        return f'http://127.0.0.1:{self.balancer.port}'
+
+
+class RouterTier:
+    """N router instances sharing one brain store and one hash ring."""
+
+    def __init__(self, controller_url: str, replicas: int = 1,
+                 qos: Optional[Dict[str, Any]] = None,
+                 region: Optional[str] = None,
+                 affinity_capacity: int = 4096,
+                 vnodes: int = DEFAULT_VNODES,
+                 router_kwargs: Optional[Dict[str, Any]] = None) -> None:
+        self.controller_url = controller_url
+        self.qos = dict(qos or {})
+        self.region = region
+        self._router_kwargs = dict(router_kwargs or {})
+        self._affinity_capacity = int(affinity_capacity)
+        # One shared in-process store: every instance's Router takes
+        # the same lock, so tier-wide decisions stay atomic.
+        self.store = brain_store_lib.InProcessBrainStore(
+            affinity_capacity=self._affinity_capacity)
+        self.ring = brain_store_lib.HashRing(vnodes=vnodes)
+        self._lock = threading.Lock()
+        self._instances: Dict[str, RouterInstance] = {}
+        self._next_index = 0
+        self._want = max(1, int(replicas))
+
+    # -------------------------------------------------------- lifecycle
+
+    def _spawn_locked(self) -> RouterInstance:
+        instance_id = f'router-{self._next_index}'
+        self._next_index += 1
+        balancer = lb_lib.SkyServeLoadBalancer(
+            self.controller_url,
+            router=router_lib.Router(
+                store=self.store, region=self.region,
+                **self._router_kwargs),
+            router_id=instance_id, qos=self.qos)
+        port = balancer.start()
+        instance = RouterInstance(instance_id, balancer)
+        instance.alive = True
+        self._instances[instance_id] = instance
+        self.ring.add(instance_id)
+        # Same gating as the LB's routing events: the journal only
+        # records while a scenario/operator is watching.
+        lb_lib._journal_handoff(  # pylint: disable=protected-access
+            'router_instance_start', instance=instance_id, port=port,
+            tier_size=len(self._instances))
+        logger.info(f'router tier: {instance_id} up on :{port} '
+                    f'({len(self._instances)} instance(s))')
+        return instance
+
+    def start(self) -> List[int]:
+        """Bring the tier to its target size; returns instance ports
+        in instance order."""
+        with self._lock:
+            while len(self._instances) < self._want:
+                self._spawn_locked()  # skytpu: lint-ok[blocking-under-lock] reason=tier membership changes are rare operator/controller actions; the lock makes ring+instance-map updates atomic against url_for
+            return [i.port for i in self._instances.values()]
+
+    def reconcile(self, replicas: int) -> List[int]:
+        """Converge the tier to `replicas` instances (the controller
+        calls this like a role-pool autoscaler target): spawn up,
+        retire down (newest first, like retirement_order)."""
+        self._want = max(1, int(replicas))
+        with self._lock:
+            while len(self._instances) < self._want:
+                self._spawn_locked()  # skytpu: lint-ok[blocking-under-lock] reason=tier membership changes are rare operator/controller actions; the lock makes ring+instance-map updates atomic against url_for
+            while len(self._instances) > self._want:
+                victim = list(self._instances)[-1]
+                self._stop_locked(victim, reason='scale_down')  # skytpu: lint-ok[blocking-under-lock] reason=tier membership changes are rare operator/controller actions; the lock makes ring+instance-map updates atomic against url_for
+            return [i.port for i in self._instances.values()]
+
+    def _stop_locked(self, instance_id: str, reason: str) -> None:
+        instance = self._instances.pop(instance_id, None)
+        if instance is None:
+            return
+        self.ring.remove(instance_id)
+        instance.alive = False
+        try:
+            instance.balancer.stop()
+        except Exception:  # pylint: disable=broad-except
+            pass
+        lb_lib._journal_handoff(  # pylint: disable=protected-access
+            'router_instance_end', instance=instance_id, reason=reason,
+            tier_size=len(self._instances))
+        logger.info(f'router tier: {instance_id} down ({reason}; '
+                    f'{len(self._instances)} left)')
+
+    def stop_instance(self, instance_id: str,
+                      reason: str = 'killed') -> None:
+        """Take one instance down (chaos / operator action).  Its ring
+        arcs re-home to survivors; the shared store keeps every
+        retirement and affinity pin."""
+        with self._lock:
+            self._stop_locked(instance_id, reason=reason)  # skytpu: lint-ok[blocking-under-lock] reason=tier membership changes are rare operator/controller actions; the lock makes ring+instance-map updates atomic against url_for
+
+    def stop(self) -> None:
+        with self._lock:
+            for instance_id in list(self._instances):
+                self._stop_locked(instance_id, reason='shutdown')  # skytpu: lint-ok[blocking-under-lock] reason=tier membership changes are rare operator/controller actions; the lock makes ring+instance-map updates atomic against url_for
+
+    # ------------------------------------------------------------ query
+
+    def instances(self) -> List[RouterInstance]:
+        with self._lock:
+            return list(self._instances.values())
+
+    def ports(self) -> List[int]:
+        with self._lock:
+            return [i.port for i in self._instances.values()]
+
+    def owner(self, key: Hashable) -> Optional[RouterInstance]:
+        """The single instance that owns a prefix key (front doors /
+        tests dispatch repeat prefixes through it so the affinity map
+        is written by one router and replicated to the rest)."""
+        with self._lock:
+            instance_id = self.ring.owner(key)
+            return self._instances.get(instance_id) \
+                if instance_id else None
+
+    def url_for(self, prompt_ids: Optional[List[int]] = None,
+                text: Optional[str] = None) -> Optional[str]:
+        """Front-door resolution: the owning instance's url for a
+        prompt (falls back to any live instance for key-less
+        requests)."""
+        key = router_lib.prompt_key(prompt_ids=prompt_ids, text=text)
+        instance = self.owner(key) if key is not None else None
+        if instance is None:
+            live = self.instances()
+            instance = live[0] if live else None
+        return instance.url if instance else None
+
+    def set_replicas(self, replicas: List[Dict[str, Any]]) -> None:
+        """Install the ready set tier-wide (the brain store is shared,
+        but each instance also tracks its own ready_urls list)."""
+        for instance in self.instances():
+            instance.balancer.set_replicas(replicas)
+
+    def apply_state(self, payload: Dict[str, Any]) -> None:
+        """Apply a controller state push to every instance (in-process
+        fast path of the POST /lb/state plane)."""
+        for instance in self.instances():
+            instance.balancer.apply_state(payload)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                'instances': len(self._instances),
+                'want': self._want,
+                'ports': [i.port for i in self._instances.values()],
+                'ring_members': self.ring.members(),
+                'qos': {name: spec.to_dict() for name, spec in
+                        qos_lib.from_config(self.qos).items()},
+            }

@@ -27,6 +27,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional
 
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing
+from skypilot_tpu.serve import qos as qos_lib
 
 # Queue-wait histogram bucket upper bounds (seconds); the last bucket
 # is open-ended.  Surfaced via stats() -> /health for autoscaling.
@@ -52,6 +53,9 @@ _M_ITL = metrics_lib.histogram(
     'Inter-token gaps during decode.',
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
              0.5, 1.0, 2.5, 5.0))
+_M_QOS_ADMITTED = metrics_lib.counter(
+    'skytpu_engine_qos_admitted_total',
+    'Requests admitted into a KV slot, by QoS class.', ('qos_class',))
 
 
 class QueueFull(RuntimeError):
@@ -92,8 +96,21 @@ class Request:
                  seed: int = 0,
                  request_id: Optional[str] = None,
                  route_meta: Optional[Dict[str, Any]] = None,
-                 deadline_ms: Optional[float] = None) -> None:
+                 deadline_ms: Optional[float] = None,
+                 qos_class: Optional[str] = None) -> None:
         self.prompt_ids = list(prompt_ids)
+        # QoS class (X-SkyTPU-QoS-Class, stamped by the router): the
+        # class's token budget clamps max_new_tokens and its deadline
+        # default applies when the request carries no deadline of its
+        # own (an explicit client deadline always wins).
+        self.qos_class = qos_lib.normalize(qos_class)
+        qos_spec = qos_lib.engine_config().get(self.qos_class)
+        if qos_spec is not None:
+            if qos_spec.max_new_tokens is not None:
+                max_new_tokens = min(int(max_new_tokens),
+                                     qos_spec.max_new_tokens)
+            if deadline_ms is None and qos_spec.deadline_ms is not None:
+                deadline_ms = qos_spec.deadline_ms
         self.max_new_tokens = max_new_tokens
         # Per-request phase trace (queue/prefill/TTFT/ITL/total); the
         # id arrives via X-SkyTPU-Request-Id or is generated here.
@@ -277,6 +294,11 @@ class AdmissionQueue:
         self.queue_ttl = queue_ttl           # None = no expiry
         self._drain_estimate = drain_estimate
         self._queue: Deque[Request] = collections.deque()
+        # Smooth weighted round-robin credits per QoS class: when BOTH
+        # classes have queued work, pops interleave by class weight
+        # (interactive's floor under a batch backlog and vice versa);
+        # single-class queues stay strictly FIFO.
+        self._wrr_credit: Dict[str, int] = {}
         self.cond = threading.Condition()
         # Engine-local metric mirror (stats()); the process-global
         # registry instruments above carry the /metrics view.
@@ -320,6 +342,30 @@ class AdmissionQueue:
             self._queue.appendleft(request)
             _M_QUEUE_DEPTH.set(len(self._queue))
 
+    def _pop_index_locked(self) -> int:
+        """Index of the next request to pop: FIFO within a class;
+        across classes, smooth weighted round-robin by QoS weight
+        (call with self.cond held)."""
+        first_of: Dict[str, int] = {}
+        for idx, request in enumerate(self._queue):
+            cls = getattr(request, 'qos_class', None) or \
+                qos_lib.default_class()
+            if cls not in first_of:
+                first_of[cls] = idx
+        if len(first_of) <= 1:
+            return 0
+        specs = qos_lib.engine_config()
+        total = 0
+        for cls in first_of:
+            weight = specs[cls].weight if cls in specs else 1
+            self._wrr_credit[cls] = \
+                self._wrr_credit.get(cls, 0) + weight
+            total += weight
+        chosen = max(first_of,
+                     key=lambda c: (self._wrr_credit.get(c, 0), c))
+        self._wrr_credit[chosen] -= total
+        return first_of[chosen]
+
     def pop(self) -> Optional[Request]:
         """Pop the next live queued request, expiring stale ones.  Does
         NOT record the admission — call record_admission() once the
@@ -328,7 +374,12 @@ class AdmissionQueue:
             with self.cond:
                 if not self._queue:
                     return None
-                request = self._queue.popleft()
+                index = self._pop_index_locked()
+                if index == 0:
+                    request = self._queue.popleft()
+                else:
+                    request = self._queue[index]
+                    del self._queue[index]
                 _M_QUEUE_DEPTH.set(len(self._queue))
             if request.cancelled:
                 request._finish()  # pylint: disable=protected-access
@@ -352,6 +403,9 @@ class AdmissionQueue:
         request.span.mark_admitted()
         wait = time.monotonic() - request.submit_time
         _M_ADMITTED.inc()
+        _M_QOS_ADMITTED.labels(
+            qos_class=getattr(request, 'qos_class', None) or
+            qos_lib.default_class()).inc()
         _M_QUEUE_WAIT.observe(wait)
         with self._metrics_lock:
             for i, bound in enumerate(WAIT_BUCKETS):

@@ -74,7 +74,8 @@ CREATE TABLE IF NOT EXISTS services (
     spec_json TEXT,
     task_yaml_path TEXT,
     version INTEGER DEFAULT 1,
-    created_at REAL
+    created_at REAL,
+    router_ports TEXT
 )"""
 
 _CREATE_REPLICAS = """\
@@ -90,6 +91,7 @@ CREATE TABLE IF NOT EXISTS replicas (
     role TEXT DEFAULT 'mixed',
     num_hosts INTEGER DEFAULT 1,
     drain_started_at REAL,
+    region TEXT,
     PRIMARY KEY (service_name, replica_id)
 )"""
 
@@ -113,6 +115,19 @@ def _migrate(conn: sqlite3.Connection) -> None:
         # with its original clock, never a fresh one).
         conn.execute('ALTER TABLE replicas ADD COLUMN '
                      'drain_started_at REAL')
+    if 'region' not in columns:
+        # Multi-region placement (ISSUE 15): which region the
+        # optimizer placed this replica in; NULL for single-region
+        # services.
+        conn.execute('ALTER TABLE replicas ADD COLUMN region TEXT')
+    service_columns = {row[1] for row in
+                       conn.execute('PRAGMA table_info(services)')}
+    if 'router_ports' not in service_columns:
+        # Router tier (ISSUE 15): JSON list of every router instance
+        # port; load_balancer_port stays the first entry for
+        # single-router compat.
+        conn.execute('ALTER TABLE services ADD COLUMN '
+                     'router_ports TEXT')
 
 
 def _db_path() -> str:
@@ -152,11 +167,42 @@ def set_service_status(name: str, status: ServiceStatus) -> None:
 
 
 def set_service_ports(name: str, controller_port: int,
-                      lb_port: int) -> None:
+                      lb_port: int,
+                      router_ports: Optional[List[int]] = None) -> None:
+    """lb_port is the tier's first router (single-router compat);
+    router_ports records every instance when a tier is running."""
     with _conn() as conn:
         conn.execute(
-            'UPDATE services SET controller_port=?, load_balancer_port=? '
-            'WHERE name=?', (controller_port, lb_port, name))
+            'UPDATE services SET controller_port=?, load_balancer_port=?, '
+            'router_ports=? WHERE name=?',
+            (controller_port, lb_port,
+             json.dumps(router_ports) if router_ports else None, name))
+
+
+def set_router_ports(name: str, router_ports: List[int]) -> None:
+    """Record the live router-tier ports (and keep load_balancer_port
+    pointed at the first surviving instance)."""
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE services SET router_ports=?, load_balancer_port=? '
+            'WHERE name=?',
+            (json.dumps(router_ports),
+             router_ports[0] if router_ports else None, name))
+
+
+def get_router_ports(record: Dict[str, Any]) -> List[int]:
+    """Every router port of a service record (falls back to the single
+    load_balancer_port for pre-tier rows)."""
+    raw = record.get('router_ports')
+    if raw:
+        try:
+            ports = json.loads(raw)
+            if isinstance(ports, list) and ports:
+                return [int(p) for p in ports]
+        except (json.JSONDecodeError, TypeError, ValueError):
+            pass
+    lb_port = record.get('load_balancer_port')
+    return [int(lb_port)] if lb_port else []
 
 
 def set_service_pids(name: str, controller_pid: Optional[int] = None,
@@ -219,15 +265,16 @@ def update_service_spec(name: str, spec_json: Dict[str, Any],
 
 def add_replica(service_name: str, replica_id: int, cluster_name: str,
                 is_spot: bool = False, version: int = 1,
-                role: str = 'mixed', num_hosts: int = 1) -> None:
+                role: str = 'mixed', num_hosts: int = 1,
+                region: Optional[str] = None) -> None:
     with _conn() as conn:
         conn.execute(
             'INSERT OR REPLACE INTO replicas (service_name, replica_id, '
             'cluster_name, status, is_spot, version, launched_at, role, '
-            'num_hosts) VALUES (?,?,?,?,?,?,?,?,?)',
+            'num_hosts, region) VALUES (?,?,?,?,?,?,?,?,?,?)',
             (service_name, replica_id, cluster_name,
              ReplicaStatus.PROVISIONING.value, int(is_spot), version,
-             time.time(), role, int(num_hosts)))
+             time.time(), role, int(num_hosts), region))
 
 
 def set_replica_status(service_name: str, replica_id: int,
@@ -277,19 +324,20 @@ def get_replicas(service_name: str) -> List[Dict[str, Any]]:
 
 def allocate_replica(service_name: str, cluster_prefix: str,
                      is_spot: bool = False, version: int = 1,
-                     role: str = 'mixed', num_hosts: int = 1) -> int:
+                     role: str = 'mixed', num_hosts: int = 1,
+                     region: Optional[str] = None) -> int:
     """Atomically claim the next replica id and insert its row (ids stay
     monotonic and unique under concurrent scale-ups)."""
     with _conn() as conn:
         conn.execute(
             'INSERT INTO replicas (service_name, replica_id, '
             'cluster_name, status, is_spot, version, launched_at, role, '
-            'num_hosts) '
+            'num_hosts, region) '
             "SELECT ?, COALESCE(MAX(replica_id), 0) + 1, '', ?, ?, ?, "
-            '?, ?, ? FROM replicas WHERE service_name=?',
+            '?, ?, ?, ? FROM replicas WHERE service_name=?',
             (service_name, ReplicaStatus.PROVISIONING.value,
              int(is_spot), version, time.time(), role, int(num_hosts),
-             service_name))
+             region, service_name))
         rid = conn.execute(
             'SELECT MAX(replica_id) FROM replicas WHERE service_name=?',
             (service_name,)).fetchone()[0]

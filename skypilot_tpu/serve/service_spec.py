@@ -99,6 +99,7 @@ class SkyServiceSpec:
                  load_balancing_policy: Optional[str] = None,
                  update_mode: str = 'rolling',
                  roles: Optional[Dict[str, Dict[str, Any]]] = None,
+                 routers: Optional[Dict[str, Any]] = None,
                  slos: Optional[Dict[str, Any]] = None) -> None:
         if not readiness_path.startswith('/'):
             raise exceptions.InvalidTaskError(
@@ -176,6 +177,34 @@ class SkyServiceSpec:
                 raise exceptions.InvalidTaskError(
                     'slos.availability must be in (0, 1)')
             self.slos = parsed
+        # Front-door router tier (`routers:`): how many router
+        # instances serve the front door, and the QoS class policy
+        # they (and the engine scheduler, via SKYTPU_QOS_SPEC) enforce.
+        # Reconciled by the controller like a role pool.
+        self.router_replicas = 1
+        self.qos: Optional[Dict[str, Any]] = None
+        if routers is not None:
+            if not isinstance(routers, dict):
+                raise exceptions.InvalidTaskError(
+                    'routers must be a mapping')
+            common_utils.validate_schema_keys(
+                routers, {'replicas', 'qos'}, 'routers')
+            if routers.get('replicas') is not None:
+                self.router_replicas = int(routers['replicas'])
+                if self.router_replicas < 1:
+                    raise exceptions.InvalidTaskError(
+                        'routers.replicas must be >= 1')
+            if routers.get('qos') is not None:
+                from skypilot_tpu.serve import qos as qos_lib  # pylint: disable=import-outside-toplevel
+                try:
+                    qos_lib.validate_config(routers['qos'],
+                                            'routers.qos')
+                except ValueError as e:
+                    raise exceptions.InvalidTaskError(str(e)) from e
+                self.qos = {
+                    name: dict(cfg)
+                    for name, cfg in routers['qos'].items()}
+        self.explicit_routers = routers is not None
         # Disaggregated role pools.  Explicit `roles:` builds one pool
         # per entry; otherwise the legacy top-level fields ARE the
         # single 'mixed' pool (so every consumer can just iterate
@@ -244,7 +273,8 @@ class SkyServiceSpec:
         common_utils.validate_schema_keys(
             config, {'readiness_probe', 'replica_policy', 'replicas',
                      'replica_port', 'load_balancing_policy',
-                     'update_mode', 'roles', 'slos'}, 'service')
+                     'update_mode', 'roles', 'routers', 'slos'},
+            'service')
         kwargs: Dict[str, Any] = {}
         probe = config.get('readiness_probe')
         if isinstance(probe, str):
@@ -296,6 +326,8 @@ class SkyServiceSpec:
             kwargs['update_mode'] = str(config['update_mode'])
         if config.get('roles') is not None:
             kwargs['roles'] = config['roles']
+        if config.get('routers') is not None:
+            kwargs['routers'] = config['routers']
         if config.get('slos') is not None:
             kwargs['slos'] = config['slos']
         return cls(**kwargs)
@@ -349,6 +381,12 @@ class SkyServiceSpec:
                     entry['num_hosts'] = pool.num_hosts
                 roles[role] = entry
             config['roles'] = roles
+        if self.explicit_routers:
+            routers: Dict[str, Any] = {'replicas': self.router_replicas}
+            if self.qos is not None:
+                routers['qos'] = {name: dict(cfg)
+                                  for name, cfg in self.qos.items()}
+            config['routers'] = routers
         if self.slos is not None:
             config['slos'] = dict(self.slos)
         return config

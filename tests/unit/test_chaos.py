@@ -604,6 +604,37 @@ class TestScenarios:
         assert len(result.details['lb_retires']) == 2
         assert 'ok' in result.details['prefix_handoffs']
 
+    def test_router_instance_death(self, local_infra):
+        """ISSUE 15 acceptance: one router of a two-router tier is
+        killed mid-traffic -> the hash ring re-homes its prefix keys
+        to the survivor, every client request completes 2xx, and
+        journal replay proves zero lost requests and no QoS priority
+        inversion (drain_no_lost_requests + qos_fairness)."""
+        result = scenarios_lib.run_scenario('router_instance_death',
+                                            seed=51)
+        assert result.ok, (result.violations, result.details)
+        assert result.details['statuses'] == [200]
+        assert result.details['requests'] >= 20
+        assert result.details['requests_after_kill'] >= 6
+        assert result.details['new_owner'] != result.details['victim']
+        assert (result.details['victim'], 'killed') in \
+            result.details['instance_ends']
+        assert result.details['qos_classes'] == ['batch',
+                                                 'interactive']
+
+    @pytest.mark.slow
+    def test_region_loss_failover(self, local_infra):
+        """ISSUE 15 acceptance (slow): every replica of the
+        router-local region dies abruptly mid-traffic -> region-aware
+        dispatch fails over cross-region, zero non-2xx, zero lost
+        requests."""
+        result = scenarios_lib.run_scenario('region_loss_failover',
+                                            seed=52)
+        assert result.ok, (result.violations, result.details)
+        assert result.details['statuses'] == [200]
+        assert result.details['local_routes'] >= 1
+        assert result.details['cross_region_routes'] >= 1
+
     def test_controller_crash_recovery(self, local_infra):
         """ISSUE 10 acceptance: controller killed/restarted
         mid-service re-adopts the fleet from serve_state (no replica

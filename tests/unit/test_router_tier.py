@@ -1,0 +1,533 @@
+"""Router-tier tests (ISSUE 15): the shared brain store with its
+epoch-guarded retired set, the consistent-hash ring, the N-instance
+tier itself, QoS classes end to end (router admission shares + engine
+budgets/deadlines/WRR), multi-region placement, and the service-spec
+`routers:` block.
+
+The acceptance-critical ones:
+
+- **Stale-sync resurrection regression** — two routers sharing a
+  brain store; a retirement on one must survive a stale controller
+  view applied to the *other* (the epoch guard).
+- **Never double-route** — a prefix pinned through one router routes
+  to the same replica through every sibling.
+- **Ring stability** — instance join/leave moves only the departed
+  member's keys (~K/N), every other key keeps its owner.
+- **Token-exact tier** — a 2-router tier serves byte-identical tokens
+  to the single-LB path.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+import requests
+
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu.exceptions import InvalidTaskError
+from skypilot_tpu.serve import brain_store as brain_store_lib
+from skypilot_tpu.serve import http_protocol
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import qos as qos_lib
+from skypilot_tpu.serve import router as router_lib
+from skypilot_tpu.serve import router_tier as router_tier_lib
+from skypilot_tpu.serve import scheduler
+from skypilot_tpu.serve import service_spec
+
+
+def _endpoints(*urls, region=None):
+    return {url: router_lib.ReplicaEndpoint(url, region=region)
+            for url in urls}
+
+
+# ------------------------------------------------------------ brain store
+
+
+class TestBrainStore:
+
+    def test_retire_filters_ready_views(self):
+        store = brain_store_lib.InProcessBrainStore()
+        epoch = store.retire('http://a')
+        assert store.is_retired('http://a')
+        # A view stamped BEFORE the retirement keeps filtering.
+        assert store.reconcile_retired(['http://a', 'http://b'],
+                                       epoch - 1) == ['http://b']
+        assert store.is_retired('http://a')
+        # A view stamped at/after the retirement clears it: the
+        # controller demonstrably processed the retire, so a re-listed
+        # url was re-readied, not resurrected.
+        assert store.reconcile_retired(['http://a', 'http://b'],
+                                       epoch) == ['http://a', 'http://b']
+        assert not store.is_retired('http://a')
+
+    def test_unstamped_view_never_resurrects(self):
+        store = brain_store_lib.InProcessBrainStore()
+        store.retire('http://a')
+        # Legacy (no-epoch) views filter listed urls forever...
+        assert store.reconcile_retired(['http://a'], None) == []
+        assert store.is_retired('http://a')
+        # ...and only GC the entry once the url left the fleet.
+        assert store.reconcile_retired(['http://b'], None) == ['http://b']
+        assert not store.is_retired('http://a')
+
+    def test_later_epoch_wins_earlier_never_downgrades(self):
+        store = brain_store_lib.InProcessBrainStore()
+        assert store.retire('http://a', epoch=100) == 100
+        assert store.retire('http://a', epoch=50) == 100
+        assert store.reconcile_retired(['http://a'], 99) == []
+        assert store.reconcile_retired(['http://a'], 100) == ['http://a']
+
+    def test_local_epochs_are_monotonic_and_wall_clock_seeded(self):
+        store = brain_store_lib.InProcessBrainStore()
+        first = store.next_local_epoch()
+        assert first >= brain_store_lib.next_epoch_seed() - 2
+        assert store.next_local_epoch() == first + 1
+
+    def test_affinity_lru_bounded(self):
+        store = brain_store_lib.InProcessBrainStore(affinity_capacity=2)
+        store.set_endpoints(_endpoints('http://a'))
+        store.record_affinity('k1', 'http://a')
+        store.record_affinity('k2', 'http://a')
+        store.record_affinity('k1', 'http://a')   # refresh k1
+        store.record_affinity('k3', 'http://a')   # evicts k2 (LRU)
+        assert store.affinity_target('k1') == 'http://a'
+        assert store.affinity_target('k2') is None
+        assert store.affinity_target('k3') == 'http://a'
+
+    def test_set_endpoints_drops_dead_affinity(self):
+        store = brain_store_lib.InProcessBrainStore()
+        store.set_endpoints(_endpoints('http://a', 'http://b'))
+        store.record_affinity('k', 'http://a')
+        store.set_endpoints(_endpoints('http://b'))
+        assert store.affinity_target('k') is None
+
+    def test_inflight_accounting(self):
+        store = brain_store_lib.InProcessBrainStore()
+        store.acquire('http://a')
+        store.acquire('http://a')
+        store.acquire('http://b')
+        assert store.inflight_total() == 3
+        store.release('http://a')
+        store.release('http://b')
+        assert store.inflight == {'http://a': 1}
+
+    def test_affinity_key_wire_round_trip(self):
+        key = ('ids', (1, 2, 3))
+        wire = json.loads(json.dumps(
+            brain_store_lib.encode_affinity_key(key)))
+        assert brain_store_lib.decode_affinity_key(wire) == key
+
+
+class TestReplicatedBrainStore:
+
+    def _store_with_capture(self):
+        sent = []
+        store = brain_store_lib.ReplicatedBrainStore(
+            post=lambda url, payload, timeout=2.0:
+            sent.append((url, payload)))
+        return store, sent
+
+    def test_retire_and_affinity_fan_out_to_peers(self):
+        store, sent = self._store_with_capture()
+        store.set_peers(['http://peer'])
+        epoch = store.retire('http://a')
+        store.record_affinity('k', 'http://a')
+        assert sent == [
+            ('http://peer' + http_protocol.LB_STATE,
+             {'retire': {'url': 'http://a', 'epoch': epoch}}),
+            ('http://peer' + http_protocol.LB_STATE,
+             {'affinity': {'key': 'k', 'url': 'http://a'}}),
+        ]
+
+    def test_replicated_apply_never_re_fans(self):
+        store, sent = self._store_with_capture()
+        store.set_peers(['http://peer'])
+        store.apply_delta({'retire': {'url': 'http://a', 'epoch': 7}})
+        store.apply_delta({'affinity': {'key': 'k', 'url': 'http://a'}})
+        assert sent == []                      # no echo storms
+        assert store.is_retired('http://a')
+        assert store.affinity_target('k') == 'http://a'
+
+    def test_chaos_denied_push_counts_and_epoch_guard_holds(self):
+        """serve.router_push denied: the push fails (best-effort), and
+        the epoch-guarded retired set still keeps a stale view from
+        resurrecting the replica on the origin router."""
+        from skypilot_tpu.chaos import faults as faults_lib
+        from skypilot_tpu.chaos import injector
+        store, sent = self._store_with_capture()
+        store.set_peers(['http://peer'])
+        injector.arm(faults_lib.FaultPlan(seed=0, faults=[
+            faults_lib.Fault(site='serve.router_push', effect='deny')]))
+        try:
+            epoch = store.retire('http://a')
+        finally:
+            injector.disarm()
+        assert sent == []
+        assert store.push_failures == 1
+        assert store.reconcile_retired(['http://a'], epoch - 1) == []
+
+
+# -------------------------------------------------------------- hash ring
+
+
+class TestHashRing:
+
+    def test_empty_and_single_member(self):
+        ring = brain_store_lib.HashRing()
+        assert ring.owner('k') is None
+        ring.add('r0')
+        assert all(ring.owner(f'k{i}') == 'r0' for i in range(20))
+
+    def test_same_members_agree_across_rings(self):
+        a = brain_store_lib.HashRing()
+        b = brain_store_lib.HashRing()
+        for member in ('r0', 'r1', 'r2'):
+            a.add(member)
+        for member in ('r2', 'r0', 'r1'):      # insertion order differs
+            b.add(member)
+        keys = [('ids', (i, i + 1)) for i in range(200)]
+        assert [a.owner(k) for k in keys] == [b.owner(k) for k in keys]
+
+    def test_leave_moves_only_the_departed_members_keys(self):
+        ring = brain_store_lib.HashRing()
+        for member in ('r0', 'r1', 'r2'):
+            ring.add(member)
+        keys = [('ids', tuple(range(i, i + 4))) for i in range(300)]
+        before = {k: ring.owner(k) for k in keys}
+        ring.remove('r1')
+        for k in keys:
+            after = ring.owner(k)
+            if before[k] != 'r1':
+                assert after == before[k]      # survivors keep keys
+            else:
+                assert after in ('r0', 'r2')   # orphans re-home
+
+    def test_join_steals_roughly_its_share_and_nothing_else_moves(self):
+        ring = brain_store_lib.HashRing()
+        for member in ('r0', 'r1', 'r2'):
+            ring.add(member)
+        keys = [('ids', tuple(range(i, i + 4))) for i in range(600)]
+        before = {k: ring.owner(k) for k in keys}
+        ring.add('r3')
+        moved = 0
+        for k in keys:
+            after = ring.owner(k)
+            if after != before[k]:
+                moved += 1
+                assert after == 'r3'           # moves only TO the joiner
+        # ~K/N = 150 of 600; generous bounds against vnode variance.
+        assert 0 < moved < 300
+
+
+# ------------------------------------------------------------ router tier
+
+
+class TestRouterTier:
+
+    def _tier(self, replicas=2, **kwargs):
+        tier = router_tier_lib.RouterTier(
+            'http://127.0.0.1:1', replicas=replicas,
+            router_kwargs={'threshold': 10_000}, **kwargs)
+        tier.start()
+        return tier
+
+    def test_start_reconcile_stop(self):
+        tier = self._tier(replicas=2)
+        try:
+            assert len(tier.ports()) == 2
+            assert sorted(tier.ring.members()) == ['router-0',
+                                                   'router-1']
+            tier.reconcile(3)
+            assert len(tier.ports()) == 3
+            tier.reconcile(1)
+            assert len(tier.ports()) == 1
+            assert tier.ring.members() == ['router-0']
+        finally:
+            tier.stop()
+        assert tier.ports() == []
+        assert tier.ring.members() == []
+
+    def test_two_routers_never_double_route_a_prefix(self):
+        """A prefix pinned through one instance routes to the SAME
+        replica through every sibling: the affinity map is tier-wide
+        (shared store), so two routers can't double-prefill."""
+        tier = self._tier(replicas=2)
+        try:
+            urls = ['http://a', 'http://b', 'http://c']
+            tier.set_replicas([{'url': u, 'role': 'mixed'}
+                               for u in urls])
+            routers = [inst.balancer.router
+                       for inst in tier.instances()]
+            for i in range(40):
+                key = router_lib.prompt_key(
+                    prompt_ids=list(range(i, i + 6)))
+                first = routers[i % 2].route(key, 6)
+                routers[i % 2].record_affinity(key, first.url)
+                second = routers[(i + 1) % 2].route(key, 6)
+                assert second.affinity == 'hit'
+                assert second.url == first.url
+        finally:
+            tier.stop()
+
+    def test_stale_sync_cannot_resurrect_on_any_router(self):
+        """The two-router stale-sync regression: a replica retired
+        through instance 0 must stay retired on instance 1 even when a
+        controller view captured BEFORE the retirement is applied to
+        instance 1 — only a view stamped at/after the retire epoch
+        re-readies it (and then on every instance at once)."""
+        tier = self._tier(replicas=2)
+        try:
+            urls = ['http://a', 'http://b']
+            tier.set_replicas([{'url': u, 'role': 'mixed'}
+                               for u in urls])
+            inst0, inst1 = tier.instances()
+            stale_epoch = tier.store.next_local_epoch()
+            retire_epoch = stale_epoch + 1
+            assert inst0.balancer.retire_url('http://a',
+                                             epoch=retire_epoch)
+            assert inst0.balancer.ready_urls == ['http://b']
+            # The store is shared, so the SIBLING's routing excludes
+            # the retired replica immediately (its own ready_urls list
+            # converges on the next state push).
+            key = router_lib.prompt_key(prompt_ids=[1, 2, 3, 4])
+            assert inst1.balancer.router.route(key, 4).url == 'http://b'
+            # The stale view (snapshotted before the retire) lists the
+            # retired url — applied to the SIBLING, it must not bite.
+            stale = {'ready': [{'url': u, 'role': 'mixed'}
+                               for u in urls],
+                     'retired_epoch': stale_epoch}
+            inst1.balancer.apply_state(stale)
+            assert inst1.balancer.ready_urls == ['http://b']
+            assert tier.store.is_retired('http://a')
+            # A fresh view stamped past the retirement re-readies.
+            fresh = dict(stale, retired_epoch=retire_epoch)
+            inst1.balancer.apply_state(fresh)
+            assert sorted(inst1.balancer.ready_urls) == urls
+            assert not tier.store.is_retired('http://a')
+        finally:
+            tier.stop()
+
+    def test_url_for_owner_and_fallback(self):
+        tier = self._tier(replicas=2)
+        try:
+            key = router_lib.prompt_key(prompt_ids=[1, 2, 3, 4])
+            owner = tier.owner(key)
+            assert owner is not None
+            assert tier.url_for(prompt_ids=[1, 2, 3, 4]) == owner.url
+            # Key-less requests land on any live instance.
+            assert tier.url_for() in [i.url for i in tier.instances()]
+            tier.stop_instance(owner.instance_id)
+            survivor = tier.owner(key)
+            assert survivor is not None
+            assert survivor.instance_id != owner.instance_id
+        finally:
+            tier.stop()
+
+    def test_stats_shape(self):
+        tier = self._tier(replicas=2, qos={'batch': {'weight': 2}})
+        try:
+            stats = tier.stats()
+            assert stats['instances'] == 2
+            assert stats['want'] == 2
+            assert len(stats['ports']) == 2
+            assert stats['qos']['batch']['weight'] == 2
+        finally:
+            tier.stop()
+
+
+@pytest.mark.slow
+class TestTierTokenExact:
+
+    def test_two_router_tier_matches_single_lb_tokens(self):
+        """Acceptance: the 2-router tier serves token-exact output vs
+        the single-LB path (greedy decode, same replicas)."""
+        from skypilot_tpu.serve import model_server as model_server_lib
+        server = model_server_lib.ModelServer(
+            'tiny', max_len=64, max_batch=2, continuous_batching=True,
+            kv_pages=48, page_size=8, prefill_chunk=16)
+        port, stop = model_server_lib.start_background(server)
+        url = f'http://127.0.0.1:{port}'
+        prompts = [[w * 10 + 1] + [3, 5, 7, 9, 11, 13, 15, 17]
+                   for w in range(4)]
+
+        def generate(base, prompt):
+            resp = requests.post(
+                f'{base}{http_protocol.GENERATE}',
+                json={'prompt_ids': [prompt], 'max_new_tokens': 6},
+                timeout=60)
+            assert resp.status_code == 200
+            return resp.json()['tokens']
+
+        lb = lb_lib.SkyServeLoadBalancer(
+            'http://127.0.0.1:1',
+            router=router_lib.Router(threshold=10_000))
+        tier = router_tier_lib.RouterTier(
+            'http://127.0.0.1:1', replicas=2,
+            router_kwargs={'threshold': 10_000})
+        try:
+            lb.set_replicas([{'url': url, 'role': 'mixed'}])
+            lb_port = lb.start()
+            single = [generate(f'http://127.0.0.1:{lb_port}', p)
+                      for p in prompts]
+            tier.start()
+            tier.set_replicas([{'url': url, 'role': 'mixed'}])
+            tiered = [generate(tier.url_for(prompt_ids=p), p)
+                      for p in prompts]
+            assert tiered == single
+        finally:
+            lb.stop()
+            tier.stop()
+            stop()
+            server.close()
+
+
+# -------------------------------------------------------------------- QoS
+
+
+class TestQosClasses:
+
+    def test_normalize_clamps_unknown_to_default(self, monkeypatch):
+        assert qos_lib.normalize('batch') == 'batch'
+        assert qos_lib.normalize(' Interactive ') == 'interactive'
+        assert qos_lib.normalize('gold') == 'interactive'
+        assert qos_lib.normalize(None) == 'interactive'
+        monkeypatch.setenv('SKYTPU_QOS_DEFAULT_CLASS', 'batch')
+        assert qos_lib.normalize(None) == 'batch'
+        assert qos_lib.normalize('junk') == 'batch'
+
+    def test_admission_limits_weighted_shares(self):
+        specs = {'interactive': qos_lib.QosClassSpec(weight=4),
+                 'batch': qos_lib.QosClassSpec(weight=1)}
+        limits = qos_lib.admission_limits(10, specs)
+        assert limits == {'interactive': 8, 'batch': 2}
+        # Tiny caps never round a class to zero.
+        assert qos_lib.admission_limits(1, specs)['batch'] == 1
+        # No cap = weighted admission disarmed.
+        assert qos_lib.admission_limits(None, specs) == {
+            'interactive': None, 'batch': None}
+
+    def test_env_weights_and_spec_precedence(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_LB_QOS_WEIGHTS',
+                           'interactive=2,batch=3')
+        specs = qos_lib.from_config(None)
+        assert specs['interactive'].weight == 2
+        assert specs['batch'].weight == 3
+        specs = qos_lib.from_config({'batch': {'weight': 5}})
+        assert specs['batch'].weight == 5       # spec wins over env
+
+    def test_engine_budget_clamp_and_deadline_default(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_QOS_SPEC', json.dumps({
+            'interactive': {'max_new_tokens': 4, 'deadline_ms': 1500}}))
+        clamped = scheduler.Request([1, 2, 3], max_new_tokens=100,
+                                    stop_token=None,
+                                    qos_class='interactive')
+        assert clamped.max_new_tokens == 4
+        # Class deadline default applied: ~1.5s past submit.
+        assert clamped.deadline is not None
+        assert clamped.deadline - clamped.submit_time == \
+            pytest.approx(1.5, abs=0.01)
+        # An explicit client deadline always wins over the class
+        # default; the batch class (no config) is untouched.
+        own = scheduler.Request([1], max_new_tokens=100,
+                                stop_token=None, deadline_ms=99,
+                                qos_class='interactive')
+        assert own.deadline - own.submit_time == \
+            pytest.approx(0.099, abs=0.01)
+        batch = scheduler.Request([1], max_new_tokens=100,
+                                  stop_token=None, qos_class='batch')
+        assert batch.max_new_tokens == 100
+        assert batch.deadline is None
+
+    def test_wrr_pop_interleaves_by_weight(self, monkeypatch):
+        """Under a backlog of BOTH classes, pops follow smooth
+        weighted round-robin: interactive (weight 4) gets 4 of every
+        5 slots, batch is never starved."""
+        monkeypatch.delenv('SKYTPU_QOS_SPEC', raising=False)
+        monkeypatch.setenv('SKYTPU_LB_QOS_WEIGHTS',
+                           'interactive=4,batch=1')
+        q = scheduler.AdmissionQueue()
+        for i in range(10):
+            q.submit(scheduler.Request(
+                [i], max_new_tokens=1, stop_token=None,
+                qos_class='interactive' if i < 5 else 'batch'))
+        order = [q.pop().qos_class for _ in range(10)]
+        assert order.count('batch') == 5
+        # batch's smooth-WRR slot comes once per full cycle, not after
+        # the whole interactive backlog drains.
+        assert 'batch' in order[:5]
+        assert order[:2] != ['batch', 'batch']
+
+    def test_single_class_queue_stays_fifo(self, monkeypatch):
+        monkeypatch.delenv('SKYTPU_QOS_SPEC', raising=False)
+        q = scheduler.AdmissionQueue()
+        ids = []
+        for i in range(5):
+            r = scheduler.Request([i], max_new_tokens=1,
+                                  stop_token=None, qos_class='batch')
+            ids.append(r.request_id)
+            q.submit(r)
+        assert [q.pop().request_id for _ in range(5)] == ids
+
+
+# ------------------------------------------------- spec + region placement
+
+
+class TestServiceSpecRouters:
+
+    def test_routers_block_round_trips(self):
+        spec = service_spec.SkyServiceSpec(
+            routers={'replicas': 3,
+                     'qos': {'interactive': {'weight': 4,
+                                             'max_new_tokens': 128}}})
+        assert spec.router_replicas == 3
+        assert spec.qos['interactive']['max_new_tokens'] == 128
+        out = spec.to_yaml_config()
+        again = service_spec.SkyServiceSpec.from_yaml_config(out)
+        assert again.router_replicas == 3
+        assert again.qos == spec.qos
+
+    def test_routers_defaults_and_validation(self):
+        assert service_spec.SkyServiceSpec().router_replicas == 1
+        assert service_spec.SkyServiceSpec().qos is None
+        with pytest.raises(InvalidTaskError):
+            service_spec.SkyServiceSpec(routers={'replicas': 0})
+        with pytest.raises(InvalidTaskError):
+            service_spec.SkyServiceSpec(routers={'bogus': 1})
+        with pytest.raises(InvalidTaskError):
+            service_spec.SkyServiceSpec(
+                routers={'qos': {'gold': {'weight': 1}}})
+        with pytest.raises(InvalidTaskError):
+            service_spec.SkyServiceSpec(
+                routers={'qos': {'batch': {'weight': 0}}})
+
+
+class TestRegionPlacement:
+
+    def test_rank_regions_by_availability_per_cost(self):
+        ranked = optimizer_lib.rank_regions()
+        assert ranked[0] == 'us-central1'
+        assert set(ranked) == set(optimizer_lib.REGION_CATALOG)
+
+    def test_env_catalog_override(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_REGION_CATALOG', json.dumps({
+            'asia-east1': {'cost': 0.10, 'availability': 0.99}}))
+        assert optimizer_lib.rank_regions()[0] == 'asia-east1'
+        monkeypatch.setenv('SKYTPU_REGION_CATALOG', 'not json')
+        assert optimizer_lib.rank_regions()[0] == 'us-central1'
+
+    def test_place_role_pools_spreads_scalable_pools(self):
+        spec = service_spec.SkyServiceSpec(min_replicas=2,
+                                           max_replicas=4)
+        plan = optimizer_lib.place_role_pools(spec)
+        assert plan == {'mixed': ['us-central1', 'us-east1']}
+        # A single-replica pool stays single-region (no cross-region
+        # traffic tax for a pool that can't survive a region anyway).
+        solo = optimizer_lib.place_role_pools(
+            service_spec.SkyServiceSpec(min_replicas=1,
+                                        max_replicas=1))
+        assert solo == {'mixed': ['us-central1']}
+
+    def test_format_region_plan(self):
+        table = optimizer_lib.format_region_plan(
+            {'mixed': ['us-central1', 'us-east1']})
+        assert 'us-central1' in table and 'ROLE' in table
