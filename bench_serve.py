@@ -32,6 +32,22 @@ reports the numbers a serving SLO is written in:
   long prefills off decode replicas is THE tail-latency lever under
   mixed traffic, and the handed-off pages land the decode-side
   admission as a prefix hit.
+- self-speculative decoding A/B: the SAME repetitive-text workload
+  (periodic prompts — greedy decode on the tiny model locks into
+  cycles, the regime prompt-lookup drafting exists for) with
+  `spec_tokens=0` vs `spec_tokens=3`.  The pinned numbers are the
+  ITL p50 speedup (one verify tick emits every accepted token, so
+  accepted tokens arrive with near-zero gaps) and the mean
+  acceptance length from engine stats; greedy outputs must be
+  byte-identical across the two runs (token-exactness is the
+  contract, speed is the only variable).
+- paged decode-kernel A/B: the same paged int8 workload under
+  `SKYTPU_DECODE_KERNEL=gather` (XLA gather reference) vs `pallas`
+  (block-table-indexed in-kernel page reads).  Off-TPU the Pallas
+  path runs under the interpreter (`SKYTPU_PALLAS_INTERPRET=1`), so
+  the section asserts PARITY and presence only — interpret-mode
+  wall-clock is not a perf claim; on a TPU backend the same section
+  reads out the fused kernel's tokens/s against the gather path.
 - --smoke also scrapes `/metrics` (observability/metrics.py exposition
   served on a loopback port) before, during, and after the pipelined
   run, asserts the key engine series are present and monotone (ticks,
@@ -373,6 +389,172 @@ def _prefix_probe(cfg, params, *, max_len: int, page_size: int,
         'ttft_hit_ms': round(ttft_hit, 3),
         'ttft_hit_ratio': round(ttft_hit / max(ttft_cold, 1e-9), 4),
         'prefix_hit_pages': hit_pages,
+    }
+
+
+def _spec_probe(cfg, params, *, smoke: bool, vocab: int, seed: int,
+                spec_tokens: int = 3) -> Dict[str, Any]:
+    """Self-speculative decoding A/B on repetitive text.
+
+    Periodic prompts push the tiny model's greedy decode into cycles
+    — exactly the regime the n-gram prompt-lookup drafter targets.
+    The SAME workload runs with drafting off (`spec_tokens=0`) and on
+    (`spec_tokens=k`); accepted tokens all land in one verify tick,
+    so the per-token gap (ITL) collapses while the token stream stays
+    byte-identical (longest-exact-prefix acceptance under greedy)."""
+    import numpy as np
+
+    from skypilot_tpu.serve import batching_engine
+
+    n_requests = 3 if smoke else 6
+    max_new = 48 if smoke else 160
+    prompt_len = 24 if smoke else 48
+    page_size = 8
+    max_len = -(-(prompt_len + max_new + 2) // page_size) * page_size
+    rng = np.random.default_rng(seed)
+    prompts = []
+    for _ in range(n_requests):
+        period = int(rng.integers(2, 5))
+        motif = [int(x) for x in
+                 rng.integers(1, vocab - 1, size=period)]
+        prompts.append((motif * (prompt_len // period + 1))
+                       [:prompt_len])
+
+    def run(k: int):
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=max_len, slots=n_requests,
+            prefill_chunk=max(prompt_len, 16),
+            kv_pages=(n_requests + 1) * (max_len // page_size) + 4,
+            page_size=page_size, prefix_caching=False,
+            spec_tokens=k)
+        try:
+            # Warm every compile on the measured path (prefill
+            # bucket, page insert, and the plain OR spec tick).
+            eng.generate(prompts[0], 4, timeout=600)
+            tracked = [_Tracked(p, max_new) for p in prompts]
+            t0 = time.perf_counter()
+            for t in tracked:
+                t.submit_t = time.perf_counter()
+                t.handle = eng.submit(t.prompt, t.max_new)
+                t.handle.add_watcher(t.watcher)
+            outputs = [t.handle.result(timeout=600) for t in tracked]
+            wall = time.perf_counter() - t0
+            stats = eng.stats()
+        finally:
+            eng.stop()
+        itls = [g for t in tracked for g in t.itls]
+        tokens = sum(len(o) for o in outputs)
+        return {
+            'tokens': tokens,
+            'wall_s': round(wall, 3),
+            'tokens_per_s': round(tokens / max(wall, 1e-9), 2),
+            'itl_p50_ms': round(_percentile(itls, 50) * 1e3, 3),
+            'itl_p99_ms': round(_percentile(itls, 99) * 1e3, 3),
+        }, outputs, stats
+
+    off, out_off, _ = run(0)
+    on, out_on, stats = run(spec_tokens)
+    return {
+        'spec_tokens': spec_tokens,
+        'requests': n_requests,
+        'prompt_len': prompt_len,
+        'max_new_tokens': max_new,
+        'spec_off': off,
+        'spec_on': on,
+        'outputs_match': out_off == out_on,
+        'spec_ticks': stats['spec_ticks'],
+        'spec_proposed_tokens': stats['spec_proposed_tokens'],
+        'spec_accepted_tokens': stats['spec_accepted_tokens'],
+        'spec_accept_len_mean': stats['spec_accept_len_mean'],
+        'itl_p50_speedup': round(
+            off['itl_p50_ms'] / max(on['itl_p50_ms'], 1e-9), 3),
+        'itl_p99_speedup': round(
+            off['itl_p99_ms'] / max(on['itl_p99_ms'], 1e-9), 3),
+    }
+
+
+def _kernel_probe(cfg, params, *, smoke: bool, vocab: int,
+                  seed: int) -> Dict[str, Any]:
+    """Paged decode-kernel A/B: gather reference vs the Pallas
+    paged-attention kernel on the same int8-paged workload.
+
+    Off-TPU the Pallas path runs under the interpreter, so the
+    numbers here pin PARITY (greedy outputs byte-identical) and
+    presence — interpret-mode wall-clock is not a perf claim.  On a
+    TPU backend the same section reads the fused kernel's tokens/s
+    against the gather path."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from skypilot_tpu.serve import batching_engine
+
+    n_requests = 2
+    max_new = 8 if smoke else 24
+    prompt_len = 12 if smoke else 48
+    page_size = 8
+    max_len = -(-(prompt_len + max_new + 2) // page_size) * page_size
+    rng = np.random.default_rng(seed)
+    prompts = [[int(x) for x in
+                rng.integers(1, vocab - 1, size=prompt_len)]
+               for _ in range(n_requests)]
+    interpret = jax.default_backend() != 'tpu'
+
+    def run(kernel: str):
+        # The kernel choice is resolved ONCE at engine construction
+        # from SKYTPU_DECODE_KERNEL; pin it for the build, restore
+        # the caller's environment after.
+        saved = {k: os.environ.get(k)
+                 for k in ('SKYTPU_DECODE_KERNEL',
+                           'SKYTPU_PALLAS_INTERPRET')}
+        os.environ['SKYTPU_DECODE_KERNEL'] = kernel
+        if interpret:
+            os.environ['SKYTPU_PALLAS_INTERPRET'] = '1'
+        try:
+            eng = batching_engine.ContinuousBatchingEngine(
+                cfg, params, max_len=max_len, slots=n_requests,
+                prefill_chunk=16,
+                kv_pages=(n_requests + 1) * (max_len // page_size)
+                + 4,
+                page_size=page_size, quantize_kv=True,
+                prefix_caching=False)
+        finally:
+            for key, value in saved.items():
+                if value is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = value
+        try:
+            if eng.decode_kernel != kernel:
+                raise RuntimeError(
+                    f'engine resolved kernel {eng.decode_kernel!r}, '
+                    f'wanted {kernel!r}')
+            eng.generate(prompts[0], 2, timeout=600)  # warm compiles
+            t0 = time.perf_counter()
+            handles = [eng.submit(p, max_new) for p in prompts]
+            outputs = [h.result(timeout=600) for h in handles]
+            wall = time.perf_counter() - t0
+        finally:
+            eng.stop()
+        tokens = sum(len(o) for o in outputs)
+        return {
+            'decode_kernel': kernel,
+            'tokens': tokens,
+            'wall_s': round(wall, 3),
+            'tokens_per_s': round(tokens / max(wall, 1e-9), 2),
+        }, outputs
+
+    gather, out_gather = run('gather')
+    pallas, out_pallas = run('pallas')
+    return {
+        'page_size': page_size,
+        'quantize_kv': True,
+        'prompt_len': prompt_len,
+        'max_new_tokens': max_new,
+        'interpret_mode': interpret,
+        'kernels': {'gather': gather, 'pallas': pallas},
+        'outputs_match': out_gather == out_pallas,
     }
 
 
@@ -779,6 +961,12 @@ def main() -> None:
                         help='Skip the prefill/decode disaggregation '
                              'A/B (two replicas + routing LB over '
                              'real HTTP).')
+    parser.add_argument('--skip-spec-probe', action='store_true',
+                        help='Skip the self-speculative decoding A/B '
+                             '(repetitive-text ITL + acceptance).')
+    parser.add_argument('--skip-kernel-probe', action='store_true',
+                        help='Skip the paged decode-kernel A/B '
+                             '(gather vs Pallas parity/perf).')
     parser.add_argument('--skip-sp-probe', action='store_true',
                         help='Skip the multi-host sequence-parallel '
                              'long-context prefill scaling probe '
@@ -990,6 +1178,16 @@ def main() -> None:
             cfg, params, max_len=probe_max_len, page_size=ps,
             chunk=max(ps, 8), prefix_len=args.prefix_len,
             vocab=vocab, quantize_kv=True)
+
+    if not args.skip_spec_probe:
+        payload['spec_decode'] = _spec_probe(
+            cfg, params, smoke=args.smoke, vocab=vocab,
+            seed=args.seed)
+
+    if not args.skip_kernel_probe:
+        payload['paged_kernel'] = _kernel_probe(
+            cfg, params, smoke=args.smoke, vocab=vocab,
+            seed=args.seed)
 
     if not args.skip_disagg_probe:
         payload['disaggregation'] = _disagg_probe(

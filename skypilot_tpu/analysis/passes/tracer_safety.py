@@ -8,10 +8,14 @@ of Concurrency in ML Training on Google TPUs", PAPERS.md).  This pass
 finds the traced region statically and flags host-semantics inside it:
 
 - **Entry points**: first arguments of ``jax.jit`` / ``shard_map`` /
-  ``sp_shard_map`` / ``jax.lax.scan`` calls and ``@jit``-style
-  decorators — including lambdas and ``functools.partial`` wrappers
-  (partial-bound and ``static_argnums``/``static_argnames`` params are
-  static; the rest are traced).
+  ``sp_shard_map`` / ``jax.lax.scan`` / ``pl.pallas_call`` calls and
+  ``@jit``-style decorators — including lambdas and
+  ``functools.partial`` wrappers (partial-bound and
+  ``static_argnums``/``static_argnames`` params are static; the rest
+  are traced).  A ``pallas_call`` additionally registers every lambda
+  in its spec arguments (BlockSpec index maps, grid maps): index maps
+  run on traced grid indices, so host semantics there break or retrace
+  exactly like a jit body.
 - **Reachability**: calls from traced functions to package functions
   (same module, or through a module alias) extend the region.
 - **Findings inside the region**:
@@ -46,6 +50,10 @@ from skypilot_tpu.analysis import index as index_lib
 _JIT_NAMES = {'jit'}
 _SHARD_MAP_NAMES = {'shard_map', 'sp_shard_map', '_shard_map'}
 _SCAN_NAMES = {'scan'}
+# Pallas kernel launches: the kernel body traces like a jit entry
+# (Refs in, Refs out), and index-map/grid lambdas trace on grid
+# indices.
+_PALLAS_NAMES = {'pallas_call'}
 _WALL_CLOCK = {'time', 'perf_counter', 'monotonic', 'time_ns', 'now'}
 _KEY_FACTORIES = {'PRNGKey', 'key'}
 _STATIC_ATTRS = {'shape', 'ndim', 'dtype', 'size', 'sharding',
@@ -129,6 +137,12 @@ class _TaintChecker:
         if isinstance(expr, ast.Call):
             func = expr.func
             if isinstance(func, ast.Name) and func.id == 'len':
+                return False
+            if (isinstance(func, ast.Attribute) and
+                    func.attr == 'psum' and expr.args and
+                    isinstance(expr.args[0], ast.Constant)):
+                # psum of a literal is the axis-size idiom — concrete
+                # (static) under shard_map, not a traced value.
                 return False
             if isinstance(func, ast.Attribute):
                 if self.tainted_expr(func.value):
@@ -219,46 +233,82 @@ def _find_entries(idx: index_lib.PackageIndex) -> List[_Unit]:
         return None
 
     def register(rel: str, call: ast.Call, kind: str,
-                 scope: Dict[str, ast.AST]) -> None:
+                 scope: Dict[str, ast.AST],
+                 partial_bindings: Dict[str, List[ast.Call]]) -> None:
         if not call.args:
             return
+        # Unwrap functools.partial(fn, a, b, kw=...) — inline, or
+        # name-bound a few lines up (`kernel = partial(fn, ...)`, the
+        # pallas_call idiom where specs and kernel build together).
+        candidates: List[Tuple[ast.AST, int, Set[str]]] = []
         target = call.args[0]
-        bound_pos = 0
-        bound_kw: Set[str] = set()
-        # Unwrap functools.partial(fn, a, b, kw=...).
         if (isinstance(target, ast.Call) and
                 idx.callee_name(target) == 'partial' and
                 target.args):
-            bound_pos = len(target.args) - 1
-            bound_kw = {kw.arg for kw in target.keywords if kw.arg}
-            target = target.args[0]
-        got = resolve_target(rel, target, scope)
-        if got is None:
-            return
-        trel, label, node = got
-        # Keyword-only params are config in this codebase (mesh, axis
-        # names, bucket widths) — bound in the partial or left at
-        # their default, never traced.  Only positional params trace.
-        params = _param_names(node)
-        static = set(params[:bound_pos]) | bound_kw
-        if kind == 'jit':
-            static |= _static_from_jit_call(call, params)
-        traced = {p for p in params
-                  if p not in static and p not in ('self', 'cls')}
-        add(trel, label, node, traced)
+            candidates.append(
+                (target.args[0], len(target.args) - 1,
+                 {kw.arg for kw in target.keywords if kw.arg}))
+        elif (isinstance(target, ast.Name) and
+              target.id in partial_bindings):
+            for bound in partial_bindings[target.id]:
+                candidates.append(
+                    (bound.args[0], len(bound.args) - 1,
+                     {kw.arg for kw in bound.keywords if kw.arg}))
+        else:
+            candidates.append((target, 0, set()))
+        for target, bound_pos, bound_kw in candidates:
+            got = resolve_target(rel, target, scope)
+            if got is None:
+                continue
+            trel, label, node = got
+            # Keyword-only params are config in this codebase (mesh,
+            # axis names, bucket widths) — bound in the partial or
+            # left at their default, never traced.  Only positional
+            # params trace.
+            params = _param_names(node)
+            static = set(params[:bound_pos]) | bound_kw
+            if kind == 'jit':
+                static |= _static_from_jit_call(call, params)
+            traced = {p for p in params
+                      if p not in static and p not in ('self', 'cls')}
+            add(trel, label, node, traced)
 
     for rel, mod in sorted(idx.modules.items()):
         # Whole-module walk: jit() calls appear at module level
         # (`step_jit = jax.jit(step)`), in __init__ bodies, anywhere.
         scope = _nested_defs(mod.tree)
+        partial_bindings: Dict[str, List[ast.Call]] = {}
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Assign) and
+                    isinstance(node.value, ast.Call) and
+                    idx.callee_name(node.value) == 'partial' and
+                    node.value.args):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        partial_bindings.setdefault(
+                            tgt.id, []).append(node.value)
         for call in idx.iter_calls(mod.tree):
             callee = idx.callee_name(call)
             if callee in _JIT_NAMES:
-                register(rel, call, 'jit', scope)
+                register(rel, call, 'jit', scope, partial_bindings)
             elif callee in _SHARD_MAP_NAMES:
-                register(rel, call, 'shard_map', scope)
+                register(rel, call, 'shard_map', scope,
+                         partial_bindings)
             elif callee in _SCAN_NAMES:
-                register(rel, call, 'scan', scope)
+                register(rel, call, 'scan', scope, partial_bindings)
+            elif callee in _PALLAS_NAMES:
+                # The kernel body is a traced entry (every positional
+                # param is a Ref the grid loop hands in)...
+                register(rel, call, 'pallas', scope, partial_bindings)
+                # ...and so is every lambda in the spec arguments:
+                # BlockSpec index maps and grid maps run on traced
+                # grid indices.
+                for holder in (list(call.args[1:]) +
+                               [kw.value for kw in call.keywords]):
+                    for sub in ast.walk(holder):
+                        if isinstance(sub, ast.Lambda):
+                            add(rel, '<pallas index_map>', sub,
+                                set(_param_names(sub)))
         # Decorators: @jax.jit / @functools.partial(jax.jit, ...).
         for fn_key, fn in sorted(idx.functions.items()):
             if fn_key[0] != rel:

@@ -19,7 +19,7 @@ Design notes:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -28,8 +28,21 @@ from skypilot_tpu.models import heads
 from skypilot_tpu.models.configs import ModelConfig
 from skypilot_tpu.models.quantize import maybe_dequant
 from skypilot_tpu.models.transformer import _rope
+from skypilot_tpu.ops import paged_attention as paged_attention_ops
 from skypilot_tpu.ops.attention import NEG_INF
 from skypilot_tpu.ops.attention import flash_attention
+
+
+class _PagedView(NamedTuple):
+    """The paged-KERNEL path's cache 'view': instead of gathering the
+    pool into a dense [b, h_kv, len, d] array, attention receives the
+    raw pool leaf + block tables + lengths and the Pallas kernel does
+    the table-indexed page reads inside its grid (the gathered view
+    never materialises in HBM).  Produced by `_paged_forward`'s view_fn
+    when kernel='pallas'; `_layer_forward` dispatches on it."""
+    leaf: Any
+    tables: jax.Array
+    lengths: jax.Array
 
 
 @dataclasses.dataclass(frozen=True)
@@ -147,7 +160,16 @@ def _layer_forward(x, lp, cfg, positions, k_cache, v_cache,
     q = _attn_proj(h, lp['attn']['q_proj'])
     q = _rope(q, positions, cfg)
 
-    if use_flash:
+    if isinstance(k_cache, _PagedView):
+        # Paged-kernel decode: the Pallas kernel reads K/V pages from
+        # the pool by block-table index in-grid (fused int8 dequant on
+        # the loaded operand); `positions` is implied by the view's
+        # lengths — query token j of slot b sits at lengths[b] + j.
+        out = paged_attention_ops.paged_attention(
+            q, k_cache.leaf, v_cache.leaf, k_cache.tables,
+            k_cache.lengths, sm_scale=cfg.head_dim ** -0.5)
+        out = out.astype(x.dtype)
+    elif use_flash:
         # Prefill from index 0: the valid cache region is exactly the
         # prompt window [0, s) — a STATIC slice (q.shape[2]), as jit
         # requires.  (Chunks at index>0 take the masked path instead.)
@@ -201,7 +223,7 @@ def _embed(cfg, params, tokens):
 
 def _scan_layers_and_unembed(cfg, params, x, positions, cache_k, cache_v,
                              write_fn, *, use_flash: bool,
-                             view_fn=None):
+                             view_fn=None, all_positions: bool = False):
     """The shared per-layer loop: project+rope k/v, write them into the
     cache via `write_fn(k_cache, k_new) -> k_cache`, run the layer, then
     final-norm + unembed the last position.  Single-sequence decode and
@@ -209,8 +231,15 @@ def _scan_layers_and_unembed(cfg, params, x, positions, cache_k, cache_v,
 
     `view_fn(cache_leaf) -> [b, h_kv, len, d]` maps the stored cache to
     the array attention reads — identity for dense caches; the paged
-    cache gathers (and dequantizes) its pages through it, so one layer
-    body serves every cache layout.
+    cache gathers (and dequantizes) its pages through it (or hands the
+    Pallas kernel a `_PagedView`), so one layer body serves every cache
+    layout.
+
+    `all_positions=True` unembeds EVERY position ([b, s, V] logits
+    instead of last-position [b, V]) — the speculative verify step
+    needs the model's output after each drafted token.  RMSNorm and
+    unembed are per-position, so position j's logits are the same
+    either way.
     """
     layers = _layer_params(params, cfg)
     if view_fn is None:
@@ -232,6 +261,10 @@ def _scan_layers_and_unembed(cfg, params, x, positions, cache_k, cache_v,
     x, (new_k, new_v) = jax.lax.scan(
         lambda carry, ls: body(carry, ls),
         x, (layers, cache_k, cache_v))
+    if all_positions:
+        x = _norm(x, params['final_norm']['scale'], cfg.norm_eps,
+                  cfg.norm_scale_plus_one)
+        return heads.unembed(x, params, cfg), new_k, new_v
     x = _norm(x[:, -1:], params['final_norm']['scale'], cfg.norm_eps,
               cfg.norm_scale_plus_one)
     logits = heads.unembed(x, params, cfg)[:, 0]
@@ -674,50 +707,88 @@ def _dequant_kv(leaf_slice, dtype):
     return leaf_slice.astype(dtype)
 
 
-def paged_batched_step(cfg: ModelConfig, params, tokens, paged,
-                       active=None):
-    """One decode step across all slots against the page pool; exact
-    parity with `batched_step` (same masked attention math — the
-    gathered pages in table order ARE the slot's cache with positions
-    page_index * page_size + offset).
+def _paged_forward(cfg: ModelConfig, params, tokens, paged, *,
+                   kernel=None, all_positions: bool = False):
+    """Shared write-then-attend body for paged decode: tokens [B, S]
+    land at positions lengths..lengths+S-1, then every query attends
+    through the pool.  Returns (logits, new_k, new_v) WITHOUT
+    advancing lengths — callers own the bookkeeping (the speculative
+    step only advances by the accepted count).
 
-    Writes scatter each slot's token at (block_tables[b, len//ps],
-    len % ps).  Inactive slots still write (at their frozen length) —
-    the engine parks freed slots' tables on the null page so a stale
-    write can never corrupt recycled pages.
+    Writes scatter each (slot, token) at (block_tables[b, pos//ps],
+    pos % ps).  Positions past the slot's table ([n_rows * ps, ...))
+    route to the reserved null page instead of clipping — clipping
+    would corrupt the LAST VALID page of a near-full slot when a
+    speculative tick writes drafts beyond the allocation.  Inactive
+    slots still write (at their frozen length) — the engine parks
+    freed slots' tables on the null page so a stale write can never
+    corrupt recycled pages.
+
+    kernel='pallas' hands attention a `_PagedView` (the Pallas kernel
+    reads pages by table index in-grid); None/'gather' keeps the dense
+    page-gather view.
     """
     lengths = paged['lengths']                     # [B]
     tables = paged['block_tables']                 # [B, P]
     ps = _page_size_of(paged)
     n_rows = tables.shape[1]
-    positions = lengths[:, None]                   # [B, 1]
-    rows = jnp.clip(lengths // ps, 0, n_rows - 1)
-    pages = jnp.take_along_axis(tables, rows[:, None], axis=1)[:, 0]
-    offsets = lengths % ps                         # [B]
+    b, s_q = tokens.shape
+    positions = lengths[:, None] + jnp.arange(s_q)[None, :]   # [B, S]
+    rows_raw = positions // ps                     # [B, S]
+    in_range = rows_raw < n_rows
+    rows = jnp.clip(rows_raw, 0, n_rows - 1)
+    pages = jnp.where(in_range,
+                      jnp.take_along_axis(tables, rows, axis=1), 0)
+    offsets = positions % ps                       # [B, S]
+    flat_pages = pages.reshape(-1)                 # [B*S]
+    flat_off = offsets.reshape(-1)
 
     def write(c, new):
-        tok = new[:, :, 0, :]                      # [B, h_kv, d]
+        # new [B, h_kv, S, d] -> one (page, offset) scatter per
+        # (slot, token).
+        tok = new.transpose(0, 2, 1, 3).reshape(
+            b * s_q, new.shape[1], new.shape[3])   # [B*S, h_kv, d]
         if isinstance(c, dict):
             q, scale = _quant_kv(tok)
-            return {'q': c['q'].at[pages, :, offsets].set(q),
-                    'scale': c['scale'].at[pages, :, offsets].set(scale)}
-        return c.at[pages, :, offsets].set(tok.astype(c.dtype))
+            return {'q': c['q'].at[flat_pages, :, flat_off].set(q),
+                    'scale':
+                        c['scale'].at[flat_pages, :, flat_off].set(scale)}
+        return c.at[flat_pages, :, flat_off].set(tok.astype(c.dtype))
 
-    def view(c):
-        # Gather the pool rows each slot's table names ->
-        # [B, P, h_kv, ps, d], dequantized, then fold pages into the
-        # position axis (table order IS position order).
-        if isinstance(c, dict):
-            arr = _dequant_kv({'q': c['q'][tables],
-                               'scale': c['scale'][tables]}, cfg.dtype)
-        else:
-            arr = c[tables]
-        b, p, h, s, d = arr.shape
-        return arr.transpose(0, 2, 1, 3, 4).reshape(b, h, p * s, d)
+    if kernel == 'pallas':
+        def view(c):
+            return _PagedView(c, tables, lengths)
+    else:
+        def view(c):
+            # Gather the pool rows each slot's table names ->
+            # [B, P, h_kv, ps, d], dequantized, then fold pages into
+            # the position axis (table order IS position order).
+            if isinstance(c, dict):
+                arr = _dequant_kv({'q': c['q'][tables],
+                                   'scale': c['scale'][tables]},
+                                  cfg.dtype)
+            else:
+                arr = c[tables]
+            bb, p, h, s, d = arr.shape
+            return arr.transpose(0, 2, 1, 3, 4).reshape(bb, h, p * s, d)
 
-    logits, new_k, new_v = _scan_layers_and_unembed(
+    return _scan_layers_and_unembed(
         cfg, params, _embed(cfg, params, tokens), positions,
-        paged['k'], paged['v'], write, use_flash=False, view_fn=view)
+        paged['k'], paged['v'], write, use_flash=False, view_fn=view,
+        all_positions=all_positions)
+
+
+def paged_batched_step(cfg: ModelConfig, params, tokens, paged,
+                       active=None, *, kernel=None):
+    """One decode step across all slots against the page pool; exact
+    parity with `batched_step` (same masked attention math — the
+    gathered pages in table order ARE the slot's cache with positions
+    page_index * page_size + offset; the Pallas kernel path computes
+    the same online-softmax sums without materialising the gather).
+    """
+    logits, new_k, new_v = _paged_forward(cfg, params, tokens, paged,
+                                          kernel=kernel)
+    lengths = paged['lengths']
     advance = (jnp.ones_like(lengths) if active is None
                else active.astype(lengths.dtype))
     return logits, dict(paged, k=new_k, v=new_v,
@@ -725,13 +796,107 @@ def paged_batched_step(cfg: ModelConfig, params, tokens, paged,
 
 
 def paged_engine_step(cfg: ModelConfig, params, state, paged, *,
-                      max_top_k: int = 64):
+                      max_top_k: int = 64, kernel=None):
     """`engine_step` against the page pool: same on-device token
     selection and stop bookkeeping, cache reads/writes through the
     block tables.  Returns (new_state, new_paged, finished [B])."""
     return _select_and_bookkeep(state, *paged_batched_step(
         cfg, params, state['tokens'][:, None], paged,
-        state['active']), max_top_k=max_top_k)
+        state['active'], kernel=kernel), max_top_k=max_top_k)
+
+
+def paged_spec_engine_step(cfg: ModelConfig, params, state, paged,
+                           drafts, *, max_top_k: int = 64, kernel=None):
+    """Self-speculative verify tick: ONE batched forward checks k
+    drafted tokens per slot against the paged cache and the longest
+    exact prefix (plus the bonus correction token) is emitted.
+
+    drafts [B, k] are host-proposed continuations of state['tokens']
+    (any valid vocab ids — wrong guesses cost nothing but the write).
+    The forward feeds [t0, d1..dk] at positions len..len+k, writes all
+    k+1 KV entries, and unembeds every position; token selection then
+    replays the per-slot PRNG chain ONE SPLIT PER EMITTED TOKEN — so
+    greedy output is byte-identical to plain ticking by construction,
+    and sampled output is seed-deterministic parity (each emitted
+    token sees the same (logits, key) pair a plain tick would have).
+    Rejected drafts' KV writes land beyond the advanced length and are
+    overwritten by the next tick before anything attends them;
+    overflow past the slot's table routes to the reserved null page
+    (see `_paged_forward`).
+
+    Returns (new_state, new_paged, finished [B], toks [B, k+1],
+    counts [B]); the host pushes toks[b, :counts[b]] per live slot.
+    Inactive slots emit nothing (counts 0).
+    """
+    active = state['active']
+    b, _ = drafts.shape
+    s_q = drafts.shape[1] + 1
+    tokens = jnp.concatenate(
+        [state['tokens'][:, None], jnp.asarray(drafts, jnp.int32)],
+        axis=1)                                    # [B, S]
+    logits, new_k, new_v = _paged_forward(
+        cfg, params, tokens, paged, kernel=kernel, all_positions=True)
+
+    # Per-slot key chain: position j samples with exactly the key a
+    # plain tick would use at that step; carries[j] is the post-split
+    # carry after j+1 splits (matches _select_and_bookkeep's
+    # split-sample-carry convention).
+    def chain(key):
+        def body(c, _):
+            s = jax.random.split(c, 2)
+            return s[0], (s[0], s[1])
+        _, (carries, skeys) = jax.lax.scan(body, key, None, length=s_q)
+        return carries, skeys
+
+    carries, skeys = jax.vmap(chain)(state['keys'])   # [B, S, 2] each
+    vocab = logits.shape[-1]
+    toks = batched_sample(
+        logits.reshape(b * s_q, vocab), skeys.reshape(b * s_q, 2),
+        jnp.repeat(state['temperature'], s_q),
+        jnp.repeat(state['top_k'], s_q),
+        max_top_k=max_top_k).reshape(b, s_q).astype(jnp.int32)
+
+    # Longest exact prefix: draft j is accepted iff it equals the
+    # model's own output at the previous position AND everything
+    # before it was accepted.
+    match = (jnp.asarray(drafts, jnp.int32) == toks[:, :-1])
+    accepted = jnp.cumprod(match.astype(jnp.int32), axis=1)
+    num_accepted = jnp.sum(accepted, axis=1)       # [B] in 0..k
+
+    # Emission replays the plain-tick stop/countdown bookkeeping
+    # sequentially: position j emits iff it is inside the accepted
+    # prefix (+1 bonus), no EARLIER emitted token was a stop (the stop
+    # itself emits, like a plain tick), and the max_new_tokens
+    # countdown still covers it.
+    is_stop = jnp.any(
+        toks[:, :, None] == state['stop_ids'][:, None, :], axis=2)
+    stops_before = (jnp.cumsum(is_stop.astype(jnp.int32), axis=1) -
+                    is_stop.astype(jnp.int32))
+    idx = jnp.arange(s_q)[None, :]
+    emit = ((idx <= num_accepted[:, None]) & (stops_before == 0) &
+            (idx < state['remaining'][:, None]) & active[:, None])
+    counts = jnp.sum(emit.astype(jnp.int32), axis=1)   # [B]
+
+    last = jnp.clip(counts - 1, 0, s_q - 1)[:, None]
+    nxt = jnp.take_along_axis(toks, last, axis=1)[:, 0]
+    nxt = jnp.where(active, nxt, state['tokens'])
+    new_keys = jnp.where(
+        active[:, None],
+        jnp.take_along_axis(carries, last[:, :, None], axis=1)[:, 0],
+        carries[:, 0])
+    remaining = state['remaining'] - counts
+    emitted_stop = jnp.any(is_stop & emit, axis=1)
+    finished = active & (emitted_stop | (remaining <= 0))
+    new_state = dict(
+        state,
+        tokens=nxt,
+        active=active & ~finished,
+        remaining=remaining,
+        keys=new_keys,
+    )
+    new_paged = dict(paged, k=new_k, v=new_v,
+                     lengths=paged['lengths'] + counts)
+    return new_state, new_paged, finished, toks, counts
 
 
 def paged_admit_slot(paged, slot, pages_row, length):

@@ -107,6 +107,14 @@ class RequestSpan:
         # overhead (rank-0 broadcast until every rank acked) while this
         # request was in flight.  None on single-host replicas.
         self.slice_sync_ms: Optional[float] = None
+        # Self-speculative decoding (engines with --spec-tokens > 0):
+        # verify ticks this request rode, draft tokens proposed for it,
+        # and drafts accepted — the per-request acceptance story behind
+        # the engine-level skytpu_engine_spec_* counters.  All stay 0
+        # (and the dict fields absent) when spec decoding is off.
+        self.spec_steps = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         self.ttft_s: Optional[float] = None
         self._last_token: Optional[float] = None
         self.itl_count = 0
@@ -183,6 +191,15 @@ class RequestSpan:
             out['slice_sync_ms'] = round(self.slice_sync_ms, 3)
         if self.attempt is not None:
             out['attempt'] = self.attempt
+        if self.spec_steps:
+            out['spec_steps'] = self.spec_steps
+            out['spec_proposed'] = self.spec_proposed
+            out['spec_accepted'] = self.spec_accepted
+            # Mean tokens emitted per verify tick (>= 1.0; the verified
+            # base token always emits, accepted drafts ride on top).
+            out['spec_accept_mean'] = round(
+                (self.spec_accepted + self.spec_steps) /
+                self.spec_steps, 3)
         return out
 
     def segment(self, identity: Optional[Dict[str, Any]] = None

@@ -124,6 +124,17 @@ def paged_cache_sharding(mesh, quantized: bool = False):
     return {'k': kv, 'v': kv, 'block_tables': rep, 'lengths': rep}
 
 
+def spec_drafts_sharding(mesh):
+    """Sharding for the speculative-decoding draft batch [slots, k]
+    the host stages each verify tick: fully replicated, like the rest
+    of the per-slot engine state — every tensor shard must verify the
+    same drafts, and the array is a handful of int32s, so an explicit
+    placement keeps GSPMD from speculating about its tiny batch
+    axis."""
+    import jax  # pylint: disable=import-outside-toplevel
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+
 def engine_state_sharding(mesh):
     """Sharding for the engine's per-slot decode state arrays (tokens,
     masks, counters, keys): fully replicated — they are a few bytes per

@@ -60,6 +60,24 @@ Decode hot loop (the device never waits on Python):
   between ticks), so the worst ITL stall any admission can impose on
   running requests is one chunk's compute, not one prompt's.
 
+Self-speculative decoding (`spec_tokens=k > 0`, paged engines only): a
+per-slot host-side n-gram/prompt-lookup drafter
+(`serve/sampler.NgramDrafter`) proposes k tokens, ONE batched verify
+tick (`decode.paged_spec_engine_step`) scores all of them against the
+paged cache, and each slot emits its longest exactly-matching draft
+prefix plus the verified bonus token.  Token streams are byte-identical
+to spec-off — greedy AND seeded sampling — because every emitted token
+is the engine's own verified choice; drafts only decide how many land
+per dispatch.  Rejected drafts' KV writes land beyond the slot's
+advanced length (overwritten by later ticks before any query attends
+them) or, past the block table, in the pool's reserved null page.
+Spec mode runs ticks SYNCHRONOUSLY (the drafter needs the tokens a
+tick just emitted), trading the one-deep pipeline for up to k+1 tokens
+per dispatch.  The paged attention inside every tick runs the Pallas
+paged-attention kernel where it can (`SKYTPU_DECODE_KERNEL=
+pallas|gather`, ops/paged_attention.py) with the jnp gather fallback
+elsewhere — both parity-pinned against the dense engine.
+
 Exact-prefill trick for static shapes (dense models): the prompt's
 first n-1 tokens are prefilled PADDED to a power-of-two bucket
 (bounding compile count), the slot is inserted at length n-1, and the
@@ -131,6 +149,7 @@ PagePool = cache_manager.PagePool
 PagedKVManager = cache_manager.PagedKVManager
 PrefixCache = cache_manager.PrefixCache
 chunk_hashes = cache_manager.chunk_hashes
+NgramDrafter = sampler_lib.NgramDrafter
 SlotSampler = sampler_lib.SlotSampler
 validate_sampling = sampler_lib.validate_sampling
 validate_stop_ids = sampler_lib.validate_stop_ids
@@ -170,6 +189,23 @@ _M_DEADLINE_REAPED = metrics_lib.counter(
     'skytpu_engine_deadline_reaped_total',
     'Decoding requests cancelled mid-generation because their '
     'X-SkyTPU-Deadline-Ms passed (slot and KV pages freed).')
+_M_SPEC_PROPOSED = metrics_lib.counter(
+    'skytpu_engine_spec_proposed_tokens_total',
+    'Draft tokens proposed to speculative verify ticks (k per live '
+    'slot per tick).')
+_M_SPEC_ACCEPTED = metrics_lib.counter(
+    'skytpu_engine_spec_accepted_tokens_total',
+    'Draft tokens accepted by speculative verify ticks (the emitted '
+    'base token per tick is not counted).')
+_M_SPEC_ACCEPT_LEN = metrics_lib.histogram(
+    'skytpu_engine_spec_accept_len_tokens',
+    'Tokens emitted per slot per speculative verify tick (1 = every '
+    'draft rejected; k+1 = all accepted plus the bonus token).',
+    buckets=(1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0))
+_M_KERNEL_PALLAS = metrics_lib.gauge(
+    'skytpu_engine_decode_kernel_pallas',
+    'Whether the paged decode attention runs the Pallas kernel '
+    '(1) or the jnp gather fallback (0); absent-dense engines set 0.')
 
 
 def _maybe_page_journal():
@@ -197,11 +233,13 @@ class ContinuousBatchingEngine:
                  pipelined: bool = True, mesh=None,
                  kv_pages: Optional[int] = None, page_size: int = 16,
                  quantize_kv: bool = False,
-                 prefix_caching: bool = True) -> None:
+                 prefix_caching: bool = True,
+                 spec_tokens: int = 0) -> None:
         import jax
         import jax.numpy as jnp
 
         from skypilot_tpu.models import decode
+        from skypilot_tpu.ops import paged_attention as paged_attention_lib
 
         self.cfg = cfg
         self.params = params
@@ -233,6 +271,10 @@ class ContinuousBatchingEngine:
         # concurrent ones so a handoff stampede can't blow memory.
         self._export_sem = threading.BoundedSemaphore(2)
 
+        self.spec_tokens = int(spec_tokens)
+        if self.spec_tokens < 0:
+            raise ValueError(
+                f'spec_tokens must be >= 0, got {spec_tokens}')
         self._kv: Optional[cache_manager.PagedKVManager] = None
         if kv_pages is not None:
             if not pipelined:
@@ -251,8 +293,24 @@ class ContinuousBatchingEngine:
                 cfg, int(kv_pages), int(page_size), slots,
                 max_len // int(page_size), quantize_kv=quantize_kv)
         else:
+            if self.spec_tokens:
+                raise ValueError(
+                    'spec_tokens (speculative decoding) requires the '
+                    'paged KV engine (kv_pages): rejected drafts roll '
+                    'back through the pool\'s reserved null page')
             self._cache = decode.init_slot_cache(cfg, slots, max_len)
+        # Which attention path the paged tick runs — resolved ONCE here
+        # (env SKYTPU_DECODE_KERNEL, defaulting to the Pallas kernel
+        # wherever it can run) and baked into the jitted partials below
+        # as a closure constant, so the hot loop never re-reads the
+        # environment.
+        self.decode_kernel = (
+            paged_attention_lib.decode_kernel_choice()
+            if self._kv is not None else 'dense')
+        _M_KERNEL_PALLAS.set(
+            1 if self.decode_kernel == 'pallas' else 0)
         self._state = decode.init_engine_state(slots, max_stop_ids)
+        self._mesh = mesh
         if mesh is not None:
             # Tensor-sharded serving: place the KV pool and the tiny
             # per-slot state explicitly (kv_heads on 'tensor', state
@@ -278,7 +336,17 @@ class ContinuousBatchingEngine:
         if self._kv is not None:
             self._step = jax.jit(
                 functools.partial(decode.paged_engine_step, cfg,
-                                  max_top_k=self.max_top_k),
+                                  max_top_k=self.max_top_k,
+                                  kernel=self.decode_kernel),
+                donate_argnums=(2,))
+            # Speculative verify tick: same donated-pool discipline as
+            # the plain tick, plus the [slots, k] draft batch; the
+            # kernel choice is a closure constant, so both ticks hit
+            # the same attention path.
+            self._spec_step = jax.jit(
+                functools.partial(decode.paged_spec_engine_step, cfg,
+                                  max_top_k=self.max_top_k,
+                                  kernel=self.decode_kernel),
                 donate_argnums=(2,))
             # Block-table surgery: donated so XLA patches the pool's
             # tiny int32 tables in place.
@@ -343,6 +411,10 @@ class ContinuousBatchingEngine:
         self._ticks = 0
         self._prefill_chunks = 0
         self._page_deferrals = 0
+        self._spec_ticks = 0
+        self._spec_slot_ticks = 0   # (live slot, verify tick) pairs
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self._rate_window: Deque[Tuple[float, int]] = collections.deque()
         # Finished per-request spans (queue/prefill/TTFT/ITL/total),
         # bounded; surfaced via stats()['recent_spans'] and span().
@@ -784,7 +856,20 @@ class ContinuousBatchingEngine:
                 'prefill_chunk': self.prefill_chunk,
                 'pipelined': self.pipelined,
                 'paged': self._kv is not None,
+                'decode_kernel': self.decode_kernel,
+                'spec_tokens': self.spec_tokens,
             }
+            if self.spec_tokens:
+                stats['spec_ticks'] = self._spec_ticks
+                stats['spec_proposed_tokens'] = self._spec_proposed
+                stats['spec_accepted_tokens'] = self._spec_accepted
+                # Mean tokens per slot per verify tick: accepted
+                # drafts plus the always-emitted verified base token.
+                stats['spec_accept_len_mean'] = (
+                    round((self._spec_accepted +
+                           self._spec_slot_ticks) /
+                          self._spec_slot_ticks, 3)
+                    if self._spec_slot_ticks else None)
         stats.update(self._queue.stats())
         if self._kv is not None:
             stats.update(self._kv.stats())
@@ -821,6 +906,7 @@ class ContinuousBatchingEngine:
                 slot.request._finish(  # pylint: disable=protected-access
                     RuntimeError('batching engine stopped'))
                 slot.request = None
+            slot.drafter = None
         if self._kv is not None:
             # Host-side accounting only (the device is going away):
             # every slot- and prefix-held page returns to the pool, so
@@ -1105,6 +1191,13 @@ class ContinuousBatchingEngine:
                   key) -> None:
         """Flip a slot live in the device state (one jitted dispatch)."""
         del length  # cache lengths are set by insert/admission paths
+        if self.spec_tokens:
+            # Seed the slot's drafter with everything decoded so far:
+            # the history must END with the token the next tick feeds
+            # (prompt[-1], or the MoE first-from-prefill token) so the
+            # n-gram tail predicts continuations of it.
+            self._slots[slot_id].drafter = sampler_lib.NgramDrafter(
+                list(request.prompt_ids) + list(request.tokens))
         self._state = self._sampler.admit(
             self._state, slot_id, token, remaining, request.stop_ids,
             key, request.temperature, request.top_k)
@@ -1132,6 +1225,88 @@ class ContinuousBatchingEngine:
         through its rank coordinator first — every host of a multi-host
         replica must dispatch the same SPMD step in lockstep."""
         return self._step(self.params, self._state, self._cache)
+
+    def _dispatch_spec_step(self, drafts):
+        """Dispatch one jitted speculative verify tick (the slice
+        engine broadcasts it through its rank coordinator, exactly
+        like `_dispatch_step`)."""
+        return self._spec_step(self.params, self._state, self._cache,
+                               drafts)
+
+    def _spec_tick(self, live: Dict[int, scheduler.Request]) -> None:
+        """One SYNCHRONOUS speculative tick: host drafters propose k
+        tokens per live slot, ONE batched verify dispatch scores all of
+        them against the paged cache, and each slot emits its longest
+        exactly-matching prefix plus the verified bonus token.
+
+        Spec mode gives up the one-deep tick pipeline on purpose: the
+        drafter needs the tokens a tick just emitted before it can
+        propose the next batch, so tick t+1's input depends on a host
+        read of tick t.  What it buys back is up to k+1 tokens per
+        dispatch — on repetitive text the dispatch count (the per-token
+        floor on ITL) drops by the mean acceptance length.  Token
+        streams are byte-identical to spec-off by construction: every
+        emitted token is the engine's own verified choice, drafts only
+        decide how many land per dispatch.
+        """
+        import numpy as np  # pylint: disable=import-outside-toplevel
+        k = self.spec_tokens
+        n_live = len(live)
+        drafts = np.zeros((len(self._slots), k), np.int32)
+        for slot_id in live:
+            drafter = self._slots[slot_id].drafter
+            if drafter is not None:
+                drafts[slot_id] = drafter.propose(k)
+        drafts_dev = self._jnp.asarray(drafts)
+        if self._mesh is not None:
+            from skypilot_tpu.parallel import sharding as sharding_lib  # pylint: disable=import-outside-toplevel
+            drafts_dev = self._jax.device_put(
+                drafts_dev,
+                sharding_lib.spec_drafts_sharding(self._mesh))
+        self._state, self._cache, finished, toks_d, counts_d = (
+            self._dispatch_spec_step(drafts_dev))
+        toks = np.asarray(toks_d)
+        counts = np.asarray(counts_d)
+        fins = np.asarray(finished)
+        pushed = 0
+        accepted = 0
+        slot_ticks = 0
+        for slot_id, request in list(live.items()):
+            if request.done.is_set():
+                continue
+            slot_ticks += 1
+            c = int(counts[slot_id])
+            emitted = [int(t) for t in toks[slot_id, :c]]
+            drafter = self._slots[slot_id].drafter
+            if drafter is not None and emitted:
+                drafter.observe(emitted)
+            for token in emitted:
+                request._push(token)  # pylint: disable=protected-access
+            pushed += c
+            accepted += max(c - 1, 0)
+            span = request.span
+            span.spec_steps += 1
+            span.spec_proposed += k
+            span.spec_accepted += max(c - 1, 0)
+            _M_SPEC_ACCEPT_LEN.observe(float(max(c, 1)))
+            if fins[slot_id]:
+                live.pop(slot_id, None)
+                self._slots[slot_id].request = None
+                self._slots[slot_id].drafter = None
+                self._release_slot_pages(slot_id)
+                request._finish()  # pylint: disable=protected-access
+        if pushed:
+            self._record_tokens(pushed)
+        with self._metrics_lock:
+            self._ticks += 1
+            self._spec_ticks += 1
+            self._spec_slot_ticks += slot_ticks
+            self._spec_proposed += k * n_live
+            self._spec_accepted += accepted
+        _M_TICKS.inc()
+        _M_SPEC_PROPOSED.inc(k * n_live)
+        _M_SPEC_ACCEPTED.inc(accepted)
+        _M_BUSY_SLOTS.set(sum(1 for s in self._slots if s.active))
 
     # ------------------------------------------------- pipelined worker
 
@@ -1165,6 +1340,7 @@ class ContinuousBatchingEngine:
                     for i, was_cancel in reaped:
                         request = live.pop(i)
                         self._slots[i].request = None
+                        self._slots[i].drafter = None
                         self._release_slot_pages(i)
                         if was_cancel:
                             request._finish()  # pylint: disable=protected-access
@@ -1213,7 +1389,11 @@ class ContinuousBatchingEngine:
                 # token fetch and stream bookkeeping below overlap the
                 # device's compute of this new step.
                 dispatched = None
-                if live:
+                if live and self.spec_tokens:
+                    # Speculative mode: synchronous multi-token verify
+                    # ticks (see _spec_tick); `inflight` stays empty.
+                    self._spec_tick(live)
+                elif live:
                     self._state, self._cache, finished = (
                         self._dispatch_step())
                     dispatched = (self._state, finished,
@@ -1404,6 +1584,7 @@ class ContinuousBatchingEngine:
                 slot.request._finish(RuntimeError(  # pylint: disable=protected-access
                     f'batching engine failed: {e}'))
                 slot.request = None
+            slot.drafter = None
         self._queue.drain(
             lambda: RuntimeError(f'batching engine failed: {e}'))
         if self._kv is not None:

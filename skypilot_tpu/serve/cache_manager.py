@@ -453,6 +453,13 @@ class PagedKVManager:
         """Record slot ownership (release() undoes it)."""
         self._slot_pages[slot] = list(plan.row)
 
+    def slot_row(self, slot: int) -> Optional[List[int]]:
+        """The page row a slot currently owns (None before commit) —
+        what a slice replica's rank 0 broadcasts so follower ranks can
+        mirror the block-table admission without re-planning."""
+        pages = self._slot_pages.get(slot)
+        return list(pages) if pages is not None else None
+
     def abandon(self, plan: AdmissionPlan) -> None:
         """Drop a plan that never reached a slot (cancelled mid-
         prefill before commit, admission error)."""

@@ -166,6 +166,7 @@ class ModelServer:
                  page_size: int = 16,
                  quantize_kv: bool = False,
                  prefix_caching: bool = True,
+                 spec_tokens: int = 0,
                  role: str = router_lib.DEFAULT_ROLE,
                  num_hosts: int = 1,
                  sp_threshold: Optional[int] = None,
@@ -398,7 +399,8 @@ class ModelServer:
                     max_queue=max_queue, queue_ttl=queue_ttl,
                     prefill_chunk=prefill_chunk, kv_pages=kv_pages,
                     page_size=page_size, quantize_kv=quantize_kv,
-                    prefix_caching=prefix_caching)
+                    prefix_caching=prefix_caching,
+                    spec_tokens=spec_tokens)
             else:
                 self._engine = batching_engine_lib.ContinuousBatchingEngine(
                     self.cfg, self.params, max_len=max_len,
@@ -406,7 +408,8 @@ class ModelServer:
                     queue_ttl=queue_ttl, prefill_chunk=prefill_chunk,
                     mesh=self._mesh, kv_pages=kv_pages,
                     page_size=page_size, quantize_kv=quantize_kv,
-                    prefix_caching=prefix_caching)
+                    prefix_caching=prefix_caching,
+                    spec_tokens=spec_tokens)
 
     def close(self) -> None:
         """Release background resources (the batching engine's worker
@@ -1222,6 +1225,17 @@ def main() -> None:
                         help='Store KV pages as int8 with per-page-'
                              'per-head scales: ~2x tokens per byte of '
                              'cache (env SKYTPU_SERVE_KV_INT8=1).')
+    parser.add_argument('--spec-tokens', type=int,
+                        default=int(_os.environ.get(
+                            'SKYTPU_SERVE_SPEC_TOKENS', '0')),
+                        help='Self-speculative decoding: propose N '
+                             'draft tokens per slot from an n-gram '
+                             'prompt-lookup drafter and verify them '
+                             'all in one batched tick — token streams '
+                             'stay byte-identical, ITL drops by the '
+                             'acceptance length on repetitive text '
+                             '(--kv-pages mode; 0 = off; env '
+                             'SKYTPU_SERVE_SPEC_TOKENS).')
     parser.add_argument('--no-prefix-cache', action='store_true',
                         default=_os.environ.get(
                             'SKYTPU_SERVE_PREFIX_CACHE', '1') == '0',
@@ -1308,6 +1322,7 @@ def main() -> None:
                          page_size=args.page_size,
                          quantize_kv=args.quantize_kv,
                          prefix_caching=not args.no_prefix_cache,
+                         spec_tokens=args.spec_tokens,
                          role=args.role,
                          num_hosts=args.num_hosts,
                          sp_threshold=args.sp_threshold,

@@ -14,12 +14,59 @@ thin, recompile-safe edge around it:
   (`max_stop_ids` wide — the multi-EOS stop sets of instruct
   checkpoints);
 - `admit_state` writes a whole slot admission in ONE jitted dispatch
-  instead of seven eager scatters on the hot path.
+  instead of seven eager scatters on the hot path;
+- :class:`NgramDrafter` — the per-slot host-side draft proposer for
+  self-speculative decoding (lives next to the sampling state it
+  shares a slot with; the engine verifies its drafts on device in one
+  batched tick).
 """
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Iterable, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+
+class NgramDrafter:
+    """Prompt-lookup / n-gram draft proposer for self-speculative
+    decoding: model-free and host-side.
+
+    The draft for the next k tokens is the continuation of the most
+    recent EARLIER occurrence of the current tail n-gram in the
+    request's (prompt + generated) history, longest n first (n down
+    from `max_ngram`).  Repetitive text — code, templated JSON,
+    retrieval quotes, degenerate greedy cycles — makes these drafts
+    mostly right, collapsing ITL by the acceptance length; random text
+    makes them mostly wrong, which costs nothing beyond the
+    already-batched verify tick.  Misses pad with the request's last
+    token: pads must be VALID vocab ids because the verify forward
+    embeds them before rejecting them.
+    """
+
+    def __init__(self, prompt_ids: Iterable[int], *,
+                 max_ngram: int = 3) -> None:
+        self.history: List[int] = [int(t) for t in prompt_ids]
+        self.max_ngram = int(max_ngram)
+
+    def observe(self, tokens: Iterable[int]) -> None:
+        """Record tokens the engine actually emitted for this slot."""
+        self.history.extend(int(t) for t in tokens)
+
+    def propose(self, k: int) -> List[int]:
+        """k draft tokens continuing the current history."""
+        hist = self.history
+        out: List[int] = []
+        for n in range(min(self.max_ngram, len(hist) - 1), 0, -1):
+            tail = hist[-n:]
+            for i in range(len(hist) - n - 1, -1, -1):
+                if hist[i:i + n] == tail:
+                    out = hist[i + n:i + n + k]
+                    break
+            if out:
+                break
+        pad = hist[-1] if hist else 0
+        out = out[:k]
+        out.extend([pad] * (k - len(out)))
+        return out
 
 
 def validate_sampling(sampling: Optional[Any], *, max_top_k: int,

@@ -261,6 +261,7 @@ class Slot:
     def __init__(self) -> None:
         self.request: Optional[Request] = None
         self.next_token = 0          # legacy (unpipelined) loop only
+        self.drafter = None          # NgramDrafter when spec decoding
 
     @property
     def active(self) -> bool:

@@ -195,14 +195,52 @@ class SliceReplicaEngine(batching_engine_lib.ContinuousBatchingEngine):
         self._coordinator.tick()
         return super()._dispatch_step()
 
-    def _start_admission(self, slot_id, request):
-        pending = super()._start_admission(slot_id, request)
-        request.span.slice_sync_ms = round(
-            self._coordinator.sync_ms_mean(), 4)
+    def _dispatch_spec_step(self, drafts):
+        """Coordinated speculative verify tick: the draft batch rides
+        the TICK payload so real followers (`FollowerExecutor`) dispatch
+        the identical spec step — drafts are rank 0's host-side
+        decision, exactly like admissions."""
+        import numpy as np  # pylint: disable=import-outside-toplevel
+        self._coordinator.broadcast(
+            coordinator_lib.CMD_TICK,
+            spec=np.asarray(drafts).tolist())
+        return super()._dispatch_spec_step(drafts)
+
+    def _activate(self, slot_id, request, token, length, *,
+                  remaining, key) -> None:
+        """Slot activation broadcasts the FULL admission so follower
+        ranks can mirror it against their local shard: the prompt (the
+        follower re-runs the prefill — on real hardware each host must
+        compute its shard of every step anyway), the page row rank 0's
+        planner allocated, and the per-slot decode state (token,
+        budget, stop set, key chain seed, sampling params)."""
+        import numpy as np  # pylint: disable=import-outside-toplevel
+        row = (self._kv.slot_row(slot_id)
+               if self._kv is not None else None)
         self._coordinator.broadcast(
             coordinator_lib.CMD_ADMIT, slot=slot_id,
-            tokens=len(request.prompt_ids))
-        return pending
+            tokens=len(request.prompt_ids),
+            prompt=[int(t) for t in request.prompt_ids],
+            length=int(length), token=int(token),
+            remaining=int(remaining),
+            stop_ids=sorted(int(s) for s in request.stop_ids),
+            key=np.asarray(key).tolist(),
+            temperature=float(request.temperature),
+            top_k=int(request.top_k), row=row)
+        request.span.slice_sync_ms = round(
+            self._coordinator.sync_ms_mean(), 4)
+        super()._activate(slot_id, request, token, length,
+                          remaining=remaining, key=key)
+
+    def _release_slot_pages(self, slot_id) -> None:
+        """Slot release is a coordinated command too: followers park
+        the slot's block table on the null page exactly when rank 0
+        does, so stale in-flight writes land in garbage on EVERY
+        host."""
+        if self._kv is not None:
+            self._coordinator.broadcast(
+                coordinator_lib.CMD_RELEASE, slot=slot_id)
+        super()._release_slot_pages(slot_id)
 
     # ------------------------------------------------------ SP prefill
 
@@ -294,16 +332,218 @@ class SliceReplicaEngine(batching_engine_lib.ContinuousBatchingEngine):
 # ----------------------------------------------------------- real slices
 
 
-def follower_main(rank: int, coordinator_address: str) -> None:
+class FollowerExecutor:
+    """Execute the rank-0 command log against REAL local devices.
+
+    A follower rank of a real slice holds the same weights and the
+    same engine geometry as rank 0; every broadcast command carries
+    rank 0's host-side scheduling decision (which slot, which pages,
+    which drafts), so replaying the log with the SAME jitted functions
+    reproduces rank 0's device state bit-for-bit — that is the whole
+    gang contract: identical SPMD dispatches in identical order.
+
+    Command semantics:
+
+    - ``TICK``: one jitted engine step; a ``spec`` payload (the draft
+      batch rank 0's n-gram drafters proposed) selects the speculative
+      verify tick instead — same attention kernel either way.
+    - ``ADMIT``: replay the chunked prefill of prompt positions
+      ``[0, length)`` into a private cache, scatter it into the page
+      row rank 0's planner allocated (or the dense slot), point the
+      slot's block table at the row, and arm the sampler state
+      (token/budget/stop set/key chain/sampling params).  Prefix
+      reuse needs no special case: rewriting a reused page lands the
+      identical KV bytes (causal KV at position i depends only on
+      tokens [0..i], and both prefill paths are deterministic).
+    - ``RELEASE``: park the slot's table on the null page, exactly
+      when rank 0 does.
+    - ``PREFILL``: informational (the SP one-shot); the ADMIT replay
+      covers the KV, so nothing to do here.
+    - ``SHUTDOWN``: handled by `follower_serve` (closes the loop).
+
+    The executor keeps per-follower throughput honest: all heavy work
+    goes through jits compiled once per shape bucket, mirroring the
+    engine's compile-count discipline.
+    """
+
+    def __init__(self, cfg, params, *, max_len: int = 512,
+                 slots: int = 4, prefill_chunk: int = 512,
+                 kv_pages: Optional[int] = None, page_size: int = 16,
+                 quantize_kv: bool = False, spec_tokens: int = 0,
+                 max_top_k: int = 64, max_stop_ids: int = 16) -> None:
+        import functools  # pylint: disable=import-outside-toplevel
+
+        import jax  # pylint: disable=import-outside-toplevel
+        import jax.numpy as jnp  # pylint: disable=import-outside-toplevel
+
+        from skypilot_tpu.models import decode  # pylint: disable=import-outside-toplevel
+        from skypilot_tpu.ops import paged_attention as paged_attention_lib  # pylint: disable=import-outside-toplevel
+        from skypilot_tpu.serve import sampler as sampler_lib  # pylint: disable=import-outside-toplevel
+        self.cfg = cfg
+        self.params = params
+        self.max_len = int(max_len)
+        self.prefill_chunk = int(prefill_chunk)
+        self._jnp = jnp
+        self._sampler = sampler_lib.SlotSampler(int(max_top_k),
+                                                int(max_stop_ids))
+        self._paged = kv_pages is not None
+        self._page_size = int(page_size)
+        self._commands = 0
+        if self._paged:
+            kernel = paged_attention_lib.decode_kernel_choice()
+            self._step = jax.jit(
+                functools.partial(decode.paged_engine_step, cfg,
+                                  max_top_k=int(max_top_k),
+                                  kernel=kernel),
+                donate_argnums=(2,))
+            self._spec_step = jax.jit(
+                functools.partial(decode.paged_spec_engine_step, cfg,
+                                  max_top_k=int(max_top_k),
+                                  kernel=kernel),
+                donate_argnums=(2,))
+            self._admit_paged = jax.jit(decode.paged_admit_slot,
+                                        donate_argnums=(0,))
+            self._release_paged = jax.jit(decode.paged_release_slot,
+                                          donate_argnums=(0,))
+            self._insert_pages = jax.jit(
+                decode.insert_prefill_pages,
+                static_argnames=('first_page',), donate_argnums=(0,))
+            self._cache = decode.init_paged_cache(
+                cfg, int(kv_pages), self._page_size, int(slots),
+                self.max_len // self._page_size,
+                quantize_kv=bool(quantize_kv))
+        else:
+            if spec_tokens:
+                raise ValueError('spec_tokens requires the paged KV '
+                                 'engine (kv_pages)')
+            self._step = jax.jit(
+                functools.partial(decode.engine_step, cfg,
+                                  max_top_k=int(max_top_k)),
+                donate_argnums=(2,))
+            self._insert = jax.jit(decode.insert_prefill,
+                                   donate_argnums=(0,))
+            self._cache = decode.init_slot_cache(cfg, int(slots),
+                                                 self.max_len)
+        self._state = decode.init_engine_state(int(slots),
+                                               int(max_stop_ids))
+        self._prefill = jax.jit(
+            lambda p, toks: decode.prefill(cfg, p, toks,
+                                           max_len=self.max_len))
+        self._prefill_chunk_jit = jax.jit(
+            lambda p, toks, cache: decode.prefill_chunk(
+                cfg, p, toks, cache),
+            donate_argnums=(2,))
+
+    def _bucket(self, n: int) -> int:
+        for b in batching_engine_lib._PREFILL_BUCKETS:  # pylint: disable=protected-access
+            if n <= b:
+                return b
+        return n
+
+    def _replay_prefill(self, prompt: List[int], length: int):
+        """Chunked prefill of prompt positions [0, length) — the same
+        bucket ladder the engine runs, so follower compile counts stay
+        bounded by the same buckets."""
+        import numpy as np  # pylint: disable=import-outside-toplevel
+        jnp = self._jnp
+        chunk = self.prefill_chunk
+        take = min(length, chunk)
+        bucket = min(self._bucket(take), self.max_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :take] = prompt[:take]
+        _, cache = self._prefill(self.params, jnp.asarray(padded))
+        cache = dict(cache, index=jnp.asarray(take, jnp.int32))
+        consumed = take
+        while consumed < length:
+            take = min(length - consumed, chunk)
+            width = min(self._bucket(take), chunk,
+                        self.max_len - consumed)
+            piece = np.zeros((1, width), np.int32)
+            piece[0, :take] = prompt[consumed:consumed + take]
+            _, cache = self._prefill_chunk_jit(self.params,
+                                               jnp.asarray(piece),
+                                               cache)
+            cache = dict(cache,
+                         index=jnp.asarray(consumed + take, jnp.int32))
+            consumed += take
+        return cache
+
+    def _pad_row(self, row: List[int]):
+        import numpy as np  # pylint: disable=import-outside-toplevel
+        padded = np.zeros((self.max_len // self._page_size,), np.int32)
+        padded[:len(row)] = row
+        return self._jnp.asarray(padded)
+
+    def _admit(self, payload: Dict[str, Any]) -> None:
+        import numpy as np  # pylint: disable=import-outside-toplevel
+        jnp = self._jnp
+        slot = int(payload['slot'])
+        length = int(payload['length'])
+        prompt = payload['prompt']
+        row = payload.get('row')
+        if length > 0:
+            pre = self._replay_prefill(prompt, length)
+            if self._paged:
+                n_pages = -(-length // self._page_size)
+                self._cache = self._insert_pages(
+                    self._cache, pre,
+                    np.asarray(row[:n_pages], np.int32), first_page=0)
+            else:
+                self._cache = self._insert(self._cache, slot, pre,
+                                           length)
+        if self._paged:
+            self._cache = self._admit_paged(
+                self._cache, slot, self._pad_row(row), length)
+        elif length == 0:
+            self._cache = dict(
+                self._cache,
+                lengths=self._cache['lengths'].at[slot].set(0))
+        self._state = self._sampler.admit(
+            self._state, slot, int(payload['token']),
+            int(payload['remaining']),
+            frozenset(payload['stop_ids']),
+            jnp.asarray(payload['key'], jnp.uint32),
+            float(payload['temperature']), int(payload['top_k']))
+
+    def __call__(self, cmd) -> None:
+        payload = cmd.payload
+        self._commands += 1
+        if cmd.kind == coordinator_lib.CMD_TICK:
+            drafts = payload.get('spec') if payload else None
+            if drafts is not None:
+                out = self._spec_step(
+                    self.params, self._state, self._cache,
+                    self._jnp.asarray(drafts, self._jnp.int32))
+                self._state, self._cache = out[0], out[1]
+            else:
+                out = self._step(self.params, self._state, self._cache)
+                self._state, self._cache = out[0], out[1]
+        elif cmd.kind == coordinator_lib.CMD_ADMIT:
+            # Pre-follower-executor ADMITs carried only slot/tokens;
+            # tolerate them so mixed-version logs replay (state just
+            # won't mirror — the emulated tier).
+            if payload and 'prompt' in payload:
+                self._admit(payload)
+        elif cmd.kind == coordinator_lib.CMD_RELEASE:
+            if self._paged:
+                self._cache = self._release_paged(self._cache,
+                                                  int(payload['slot']))
+        # CMD_PREFILL: SP one-shot notification — the ADMIT replay
+        # writes the same KV, nothing to mirror here.
+
+
+def follower_main(rank: int, coordinator_address: str,
+                  executor: Optional[FollowerExecutor] = None) -> None:
     """Rank > 0 of a REAL slice: connect to rank 0's rank-protocol
-    port and execute the command log.  The executor is where a real
-    deployment dispatches its local shard of each jitted step; the
-    emulated tier keeps device work on rank 0 (all virtual devices are
-    local there), so this process just holds the gang together."""
+    port and execute the command log.  With an executor (built from
+    the same model/geometry flags as rank 0), every command dispatches
+    the matching jitted step on this host's local devices; without
+    one, the process just holds the gang together (the emulated tier,
+    where all virtual devices live on rank 0)."""
     sock = coordinator_lib.follower_connect(coordinator_address, rank)
     logger.info(f'slice follower rank {rank} connected to '
                 f'{coordinator_address}')
-    coordinator_lib.follower_serve(sock, rank)
+    coordinator_lib.follower_serve(sock, rank, executor)
 
 
 def _bench_prefill(args) -> None:
@@ -364,6 +604,9 @@ def main() -> None:
                         default=os.environ.get(
                             'SKYTPU_COORDINATOR_ADDRESS'))
     parser.add_argument('--model', default='tiny')
+    parser.add_argument('--max-len', type=int, default=512)
+    parser.add_argument('--max-batch', type=int, default=8)
+    parser.add_argument('--prefill-chunk', type=int, default=512)
     parser.add_argument('--bench-prefill', action='store_true')
     parser.add_argument('--prompt-len', type=int, default=2048)
     parser.add_argument('--sequence', type=int, default=None)
@@ -374,13 +617,38 @@ def main() -> None:
         return
     if args.rank > 0:
         # Follower rank of a real slice: the rank-protocol port is the
-        # JAX coordinator's + a fixed offset.
+        # JAX coordinator's + a fixed offset.  The executor mirrors
+        # rank 0's engine geometry: model/max-len/max-batch/prefill-
+        # chunk from the (gang-identical) CLI, KV pool shape from the
+        # SKYTPU_SERVE_* env the task YAML exports to every worker.
         if not args.coordinator:
             raise SystemExit('rank > 0 needs --coordinator (or the '
                              'gang env contract)')
+        import flax.linen as nn  # pylint: disable=import-outside-toplevel
+        import jax  # pylint: disable=import-outside-toplevel
+        import jax.numpy as jnp  # pylint: disable=import-outside-toplevel
+
+        from skypilot_tpu.models import configs  # pylint: disable=import-outside-toplevel
+        from skypilot_tpu.models.transformer import Transformer  # pylint: disable=import-outside-toplevel
+        cfg = configs.get_config(args.model)
+        params = nn.meta.unbox(Transformer(cfg).init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 8), jnp.int32))['params'])
+        kv_pages_env = os.environ.get('SKYTPU_SERVE_KV_PAGES')
+        executor = FollowerExecutor(
+            cfg, params, max_len=args.max_len, slots=args.max_batch,
+            prefill_chunk=args.prefill_chunk,
+            kv_pages=(int(kv_pages_env) if kv_pages_env else None),
+            page_size=int(os.environ.get('SKYTPU_SERVE_PAGE_SIZE',
+                                         '16')),
+            quantize_kv=os.environ.get('SKYTPU_SERVE_KV_INT8',
+                                       '') == '1',
+            spec_tokens=int(os.environ.get('SKYTPU_SERVE_SPEC_TOKENS',
+                                           '0')))
         host, _, port = args.coordinator.rpartition(':')
         follower_main(args.rank,
-                      f'{host}:{int(port) + SLICE_COORD_PORT_OFFSET}')
+                      f'{host}:{int(port) + SLICE_COORD_PORT_OFFSET}',
+                      executor)
         return
     # Rank 0: hand over to the model server CLI with num_hosts set —
     # one entrypoint for `run: python -m skypilot_tpu.serve.
@@ -389,7 +657,11 @@ def main() -> None:
 
     from skypilot_tpu.serve import model_server  # pylint: disable=import-outside-toplevel
     sys.argv = ([sys.argv[0], '--num-hosts', str(args.num_hosts),
-                 '--model', args.model, '--continuous-batching'] +
+                 '--model', args.model,
+                 '--max-len', str(args.max_len),
+                 '--max-batch', str(args.max_batch),
+                 '--prefill-chunk', str(args.prefill_chunk),
+                 '--continuous-batching'] +
                 list(extra))
     model_server.main()
 

@@ -80,6 +80,25 @@ def test_bench_serve_smoke(tmp_path):
     assert prefix['prefix_hit_pages'] > 0, prefix
     assert prefix['ttft_hit_ratio'] <= 0.5, prefix
     assert prefix['ttft_hit_ms'] < prefix['ttft_cold_ms'], prefix
+    # Self-speculative decoding (ISSUE 16): on repetitive text the
+    # n-gram drafter must accept more than one token per verify tick
+    # on average, the accepted burst must collapse ITL p50 (the full
+    # bench sees ~80x; 1.2x is the flake-proof floor), and the token
+    # stream must be byte-identical with drafting on vs off — speed
+    # is the ONLY thing speculation is allowed to change.
+    spec = data['spec_decode']
+    assert spec['outputs_match'] is True, spec
+    assert spec['spec_ticks'] > 0, spec
+    assert spec['spec_accept_len_mean'] > 1.0, spec
+    assert spec['itl_p50_speedup'] >= 1.2, spec
+    # Pallas paged-attention kernel (ISSUE 16): both decode-kernel
+    # paths run the same int8-paged workload and must agree token-for
+    # -token.  No wall-clock claim — off-TPU the Pallas path runs
+    # under the interpreter, so parity + presence is the contract.
+    kern = data['paged_kernel']
+    assert kern['outputs_match'] is True, kern
+    for kernel in ('gather', 'pallas'):
+        assert kern['kernels'][kernel]['tokens'] > 0, kern
     # Disaggregation (ISSUE 8): under the bursty long-prompt +
     # chat-decode workload, routing prefills to a prefill replica and
     # handing the KV pages to the decode replica must beat the

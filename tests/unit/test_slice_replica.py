@@ -556,3 +556,80 @@ def test_two_host_through_lb_token_exact(tiny):
 def test_four_host_through_lb_token_exact(tiny):
     # 4 hosts factor as sequence=2 x tensor=2 for tiny.
     _serve_and_compare(tiny, num_hosts=4, sp_threshold=24)
+
+
+# -------------------------------------------------- follower executors
+
+
+class TestFollowerExecutor:
+    """Real-slice followers execute the command log against their own
+    devices: replaying rank 0's broadcasts through a FollowerExecutor
+    must mirror the engine's device state — the gang contract a real
+    multi-host slice rests on."""
+
+    GEOM = dict(max_len=64, slots=2, prefill_chunk=8, kv_pages=48,
+                page_size=8)
+
+    def _run(self, tiny, spec_tokens):
+        import numpy as np
+        cfg, params = tiny
+        follower = slice_replica.FollowerExecutor(
+            cfg, params, spec_tokens=spec_tokens, **self.GEOM)
+        chan = coordinator_lib.LocalRank(1, follower)
+        eng = slice_replica.SliceReplicaEngine(
+            cfg, params, num_hosts=2, rank_channels=[chan],
+            spec_tokens=spec_tokens, **self.GEOM)
+        try:
+            outs = [eng.generate(p, n, timeout=300)
+                    for p, n in (([3, 1, 4, 1, 5, 9, 2, 6], 8),
+                                 ([7], 4), (list(range(1, 25)), 6))]
+            # Broadcasts ack synchronously, so the follower has fully
+            # executed the log: its sampler state and block tables
+            # must equal rank 0's BIT-FOR-BIT (same jitted ops, same
+            # order), and the KV pool must match to float rounding
+            # (rank 0 computes under the slice mesh, the follower
+            # unsharded).
+            for k in eng._state:
+                assert np.array_equal(np.asarray(eng._state[k]),
+                                      np.asarray(follower._state[k])), k
+            for k in ('block_tables', 'lengths'):
+                assert np.array_equal(
+                    np.asarray(eng._cache[k]),
+                    np.asarray(follower._cache[k])), k
+            a, b = eng._cache['k'], follower._cache['k']
+            diff = np.abs(np.asarray(a, np.float32) -
+                          np.asarray(b, np.float32)).max()
+            assert diff < 1e-3, diff
+            assert follower._commands > 0
+        finally:
+            eng.stop()
+        return outs
+
+    def test_follower_mirrors_engine_state(self, tiny):
+        self._run(tiny, spec_tokens=0)
+
+    def test_follower_mirrors_spec_ticks(self, tiny):
+        """Draft batches ride the TICK broadcast: a spec engine's
+        follower dispatches the identical verify steps and lands in
+        the identical state — and outputs stay byte-identical to the
+        non-spec slice."""
+        assert self._run(tiny, spec_tokens=0) == \
+            self._run(tiny, spec_tokens=3)
+
+    def test_follower_release_parks_tables(self, tiny):
+        import numpy as np
+        cfg, params = tiny
+        follower = slice_replica.FollowerExecutor(cfg, params,
+                                                  **self.GEOM)
+        chan = coordinator_lib.LocalRank(1, follower)
+        eng = slice_replica.SliceReplicaEngine(
+            cfg, params, num_hosts=2, rank_channels=[chan],
+            **self.GEOM)
+        try:
+            eng.generate([3, 1, 4, 1, 5], 4, timeout=300)
+            # The finished slot's RELEASE was broadcast: the
+            # follower's table row is parked on the null page.
+            tables = np.asarray(follower._cache['block_tables'])
+            assert (tables == 0).all()
+        finally:
+            eng.stop()
