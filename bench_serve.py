@@ -931,6 +931,194 @@ def _disagg_probe(*, smoke: bool, vocab: int, seed: int
     }
 
 
+def _dynamic_roles_probe(cfg, params, *, smoke: bool, vocab: int,
+                         seed: int) -> Dict[str, Any]:
+    """Dynamic fractional role budgets vs static roles (ISSUE 17)
+    under an adversarial shifting mix: an all-prefill burst (long
+    prompts, 2 new tokens) flips mid-window into an all-decode burst
+    (short prompts, long generations).  One replica must serve the
+    whole shift — the per-replica core of the fleet A/B (the chaos
+    scenario `workload_flip_morph` covers the fleet/LB layer; here the
+    replica is an in-process engine so the measurement is engine
+    capacity, not HTTP or GIL artifacts).  Static keeps a launch-time
+    pure-role budget through the shift — BOTH pure roles are measured,
+    and dynamic is scored against the better one, so the baseline is
+    the strongest static choice, not a strawman: whichever pure role
+    you pin, the other phase starves at its 1-token liveness floor.
+    Dynamic gets what the controller's rebalancer pushes over
+    /role_budget: prefill-leaning split while the burst is prefill,
+    flipped in place (version-stamped, warm weights, no restart) to
+    decode-leaning when the workload flips.  Headline:
+    in_window_tokens_ratio (prompt + generated tokens of requests
+    COMPLETED inside the fixed window, dynamic / best static).  The
+    probe then replays the same prompts through a budget-flipping
+    engine non-contended and byte-compares against an unclamped run:
+    budgets may only reschedule work, never change tokens."""
+    import itertools
+
+    import numpy as np
+
+    from skypilot_tpu.serve import batching_engine
+    from skypilot_tpu.serve import scheduler as scheduler_lib
+
+    slots = 8
+    chunk = 32
+    max_len = 96 if smoke else 224
+    long_len = 64 if smoke else 160
+    short_len = 4
+    long_max_new = 2
+    short_max_new = 40 if smoke else 48
+    # The prefill burst is a wash by construction (the prefill-pinned
+    # static and the prefill-leaning dynamic run the same budget); the
+    # decode burst is where budget-matching pays, so it gets the
+    # longer half of the window.
+    phase_prefill_s = 0.6 if smoke else 2.0
+    phase_decode_s = 1.8 if smoke else 5.0
+    workers = 2 * slots
+    ver = itertools.count(1)
+
+    engine = batching_engine.ContinuousBatchingEngine(
+        cfg, params, max_len=max_len, slots=slots,
+        prefill_chunk=chunk)
+    try:
+        budget = scheduler_lib.RoleBudget
+
+        # Warm every compile before any measured window — including
+        # the SHRUNK chunk widths a decode-leaning budget clamps
+        # prefill to (a 6-token budget buckets pieces at widths 8/6/4,
+        # the 1-token pure-decode floor at width 1; cold, each is a
+        # fresh XLA compile landing right in the window).  The one
+        # engine is reused across configs, so all of them are equally
+        # warm.
+        engine.generate(list(range(1, long_len + 1)), long_max_new,
+                        timeout=600)
+        engine.generate(list(range(1, short_len + 1)), 4, timeout=600)
+        engine.set_role_budget(budget.from_split(
+            0.1, slots=slots, prefill_chunk=chunk, version=next(ver)))
+        engine.generate(list(range(1, long_len + 1)), long_max_new,
+                        timeout=600)
+        engine.set_role_budget(budget.for_role(
+            'decode', slots=slots, prefill_chunk=chunk,
+            version=next(ver)))
+        engine.generate(list(range(1, short_len + 1)), 4, timeout=600)
+        engine.set_role_budget(None)
+
+        def run_config(mode: str) -> Dict[str, Any]:
+            swaps0 = engine.stats()['budget_swaps']
+            if mode == 'dynamic':
+                # The rebalancer's clamped prefill-leaning extreme;
+                # flipped to decode-leaning mid-window below.
+                engine.set_role_budget(budget.from_split(
+                    0.9, slots=slots, prefill_chunk=chunk,
+                    version=next(ver)))
+            else:
+                engine.set_role_budget(budget.for_role(
+                    mode, slots=slots, prefill_chunk=chunk,
+                    version=next(ver)))
+            lock = threading.Lock()
+            totals = {'in_window_tokens': 0, 'requests': 0,
+                      'prefill_phase_tokens': 0,
+                      'decode_phase_tokens': 0}
+            t0 = time.perf_counter()
+            t_flip = t0 + phase_prefill_s
+            t_end = t_flip + phase_decode_s
+
+            def client(idx: int) -> None:
+                wrng = np.random.default_rng((seed, idx))
+                while True:
+                    now = time.perf_counter()
+                    if now >= t_end:
+                        return
+                    prefill_phase = now < t_flip
+                    if prefill_phase:
+                        prompt = [int(x) for x in wrng.integers(
+                            1, vocab - 1, size=long_len)]
+                        max_new = long_max_new
+                    else:
+                        prompt = [int(x) for x in wrng.integers(
+                            1, vocab - 1, size=short_len)]
+                        max_new = short_max_new
+                    out = engine.generate(prompt, max_new,
+                                          timeout=120)
+                    if time.perf_counter() <= t_end:
+                        with lock:
+                            totals['in_window_tokens'] += \
+                                len(prompt) + len(out)
+                            totals['requests'] += 1
+                            key = ('prefill_phase_tokens'
+                                   if prefill_phase
+                                   else 'decode_phase_tokens')
+                            totals[key] += len(prompt) + len(out)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(workers)]
+            for t in threads:
+                t.start()
+            if mode == 'dynamic':
+                # The mid-window rebalance: the workload flipped, so
+                # the budget flips with it (in place, version-ordered
+                # — running decodes finish, no restart).
+                time.sleep(max(0.0, t_flip - time.perf_counter()))
+                engine.set_role_budget(budget.from_split(
+                    0.1, slots=slots, prefill_chunk=chunk,
+                    version=next(ver)))
+            for t in threads:
+                t.join(timeout=180)
+            totals['budget_swaps'] = (
+                engine.stats()['budget_swaps'] - swaps0)
+            return totals
+
+        # The decode-pinned static is strictly the weaker baseline on
+        # this mix (its prefill burst crawls at the 1-token floor); the
+        # smoke skips it for tier-1 wall-clock and scores dynamic
+        # against the prefill pin — the full run measures all three.
+        static_prefill = run_config('prefill')
+        static_decode = None if smoke else run_config('decode')
+        dynamic = run_config('dynamic')
+
+        # Token-exactness, non-contended: the SAME prompts through an
+        # unclamped engine vs one whose budget flips between requests.
+        # Budgets reschedule; they must never touch the token stream.
+        exact_rng = np.random.default_rng((seed, 104729))
+        exact_prompts = [
+            [int(x) for x in exact_rng.integers(1, vocab - 1, size=n)]
+            for n in (short_len, long_len, short_len + 3, long_len // 2)
+        ]
+        engine.set_role_budget(None)
+        reference = [engine.generate(p, 8, timeout=120)
+                     for p in exact_prompts]
+        flipped = []
+        for i, prompt in enumerate(exact_prompts):
+            role = ('prefill', 'decode', 'mixed')[i % 3]
+            engine.set_role_budget(budget.for_role(
+                role, slots=slots, prefill_chunk=chunk,
+                version=next(ver)))
+            flipped.append(engine.generate(prompt, 8, timeout=120))
+    finally:
+        engine.stop()
+    statics = [s for s in (static_prefill, static_decode)
+               if s is not None]
+    best_static = max(s['in_window_tokens'] for s in statics)
+    ratio = dynamic['in_window_tokens'] / max(best_static, 1)
+    out = {
+        'slots': slots,
+        'prefill_chunk': chunk,
+        'long_prompt_len': long_len,
+        'short_prompt_len': short_len,
+        'phase_prefill_s': phase_prefill_s,
+        'phase_decode_s': phase_decode_s,
+        'workers': workers,
+        'static_prefill': static_prefill,
+        'dynamic': dynamic,
+        'best_static_in_window_tokens': best_static,
+        'in_window_tokens_ratio': round(ratio, 4),
+        'outputs_match': flipped == reference,
+    }
+    if static_decode is not None:
+        out['static_decode'] = static_decode
+    return out
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--model', default='tiny')
@@ -967,6 +1155,11 @@ def main() -> None:
     parser.add_argument('--skip-kernel-probe', action='store_true',
                         help='Skip the paged decode-kernel A/B '
                              '(gather vs Pallas parity/perf).')
+    parser.add_argument('--skip-dynamic-roles', action='store_true',
+                        help='Skip the dynamic fractional-role-budget '
+                             'A/B (static pure pools vs in-place '
+                             'budget rebalancing under a shifting '
+                             'prefill/decode mix).')
     parser.add_argument('--skip-sp-probe', action='store_true',
                         help='Skip the multi-host sequence-parallel '
                              'long-context prefill scaling probe '
@@ -1192,6 +1385,11 @@ def main() -> None:
     if not args.skip_disagg_probe:
         payload['disaggregation'] = _disagg_probe(
             smoke=args.smoke, vocab=vocab, seed=args.seed)
+
+    if not args.skip_dynamic_roles:
+        payload['dynamic_roles'] = _dynamic_roles_probe(
+            cfg, params, smoke=args.smoke, vocab=vocab,
+            seed=args.seed)
 
     if not args.skip_sp_probe:
         payload['sp_prefill'] = _sp_prefill_probe(smoke=args.smoke,

@@ -38,6 +38,7 @@ EXPECTED_LAYER = {
     'serve.kv_handoff': ('serve/',),
     'serve.rank_exec': ('serve/',),
     'serve.router_push': ('serve/',),
+    'serve.role_morph': ('serve/',),
     'skylet.tick': ('skylet/',),
     'checkpoint.save': ('data/',),
 }

@@ -105,6 +105,16 @@ SITES: Dict[str, str] = {
         'through its own controller sync, and the epoch-guarded '
         'retired set must keep a dropped retire-delta from ever '
         'resurrecting a replica',
+    'serve.role_morph':
+        'live role-morph driver (serve/replica_managers.py '
+        'morph_replica, the ISSUE 17 dynamic co-location flip) — '
+        'effect "deny" aborts the morph before the scoped drain (the '
+        'replica must keep serving under its OLD role and budget; no '
+        'request may be lost either way), "delay" stretches the '
+        'drain-to-commit window (routers must not double-route during '
+        'the epoch-stamped flip), a raise is the controller dying '
+        'mid-morph: the journaled role_morph lifecycle must still '
+        'terminate',
     'skylet.tick':
         'skylet periodic event run (skylet/events.py) — a raise counts '
         'as an event failure and exercises the failure backoff',

@@ -320,10 +320,13 @@ def handoff_consistency(events: Sequence[Event]) -> List[str]:
 def drain_no_lost_requests(events: Sequence[Event]) -> List[str]:
     """Safety for graceful drain: once the LB processed a replica's
     retire nudge (`lb_retire`), no generate is routed there again
-    (`lb_route` with that url) until a controller sync legitimately
-    re-adds the address (a NEW replica at the same url — tracked via a
-    later `replica_drain_start` for a different replica id is out of
-    scope for the scenarios that apply this), AND every routed request
+    (`lb_route` with that url) until the address is legitimately
+    re-opened — a committed role morph (`role_morph_end` with status
+    ok/timeout) flips the SAME replica to its new role in place, so
+    routes after the commit are the rebalanced fleet working, not a
+    drain race (a NEW replica at the same url — tracked via a later
+    `replica_drain_start` for a different replica id — is out of scope
+    for the scenarios that apply this).  AND every routed request
     still completes exactly once — a drain may cost a retry hop, never
     a lost or double-executed request."""
     violations = []
@@ -334,6 +337,13 @@ def drain_no_lost_requests(events: Sequence[Event]) -> List[str]:
             url = e.get('url')
             if url:
                 retired_at[url] = True
+        elif name == 'role_morph_end':
+            # The morph protocol's commit point: the replica re-opened
+            # under its new role behind a fresh retire epoch, so the
+            # next controller push re-admits the address on purpose.
+            url = e.get('url')
+            if url and e.get('status') in ('ok', 'timeout'):
+                retired_at[url] = False
         elif name == 'lb_route':
             url = e.get('url')
             if url and retired_at.get(url):

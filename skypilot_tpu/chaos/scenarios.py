@@ -25,6 +25,11 @@ Scenarios (CLI: ``sky chaos list`` / ``sky chaos run <name>``):
                            traffic → zero non-2xx, no request routed
                            to a retired replica, hot prefix pages
                            handed to the surviving sibling
+- ``workload_flip_morph``  all-prefill burst flips all-decode mid-
+                           traffic → the prefill replica LIVE-morphs
+                           into the decode pool (no restart), zero
+                           non-2xx, ITL p99 bounded, the morph
+                           journaled and replay-verified
 - ``controller_crash_recovery`` controller killed/restarted mid-
                            service (first new tick chaos-wedged) →
                            fleet re-adopted from serve_state, warm-
@@ -1370,6 +1375,232 @@ def drain_under_load(seed: int) -> ScenarioResult:
             extra)
     return _finish('drain_under_load', seed, t0, serve_events,
                    ['drain_no_lost_requests'], extra, details)
+
+
+@_register(
+    'workload_flip_morph',
+    'adversarial workload flip (all-prefill burst -> all-decode burst) '
+    'mid-traffic -> the fleet rebalances by LIVE role morph: the '
+    'prefill replica joins the decode pool without restart (scoped '
+    'drain + epoch-stamped retire nudge + in-place budget swap), zero '
+    'non-2xx, ITL p99 stays bounded, and journal replay proves the '
+    'morph committed with no request lost or double-routed')
+def workload_flip_morph(seed: int) -> ScenarioResult:
+    import random  # pylint: disable=import-outside-toplevel
+    import threading  # pylint: disable=import-outside-toplevel
+
+    import requests  # pylint: disable=import-outside-toplevel
+
+    import skypilot_tpu as sky  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.observability import metrics as metrics_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import load_balancer as lb_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import model_server as model_server_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import replica_managers  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import router as router_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import serve_state  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import service_spec  # pylint: disable=import-outside-toplevel
+
+    t0 = time.time()
+    extra: List[str] = []
+    details: Dict[str, Any] = {}
+    serve_journal = events_lib.get_journal(
+        os.path.join(events_lib.journal_root(), 'serve.jsonl'))
+    service = f'chaos-flip-{seed}'
+
+    def make_server(role: str):
+        return model_server_lib.ModelServer(
+            'tiny', max_len=64, max_batch=2, continuous_batching=True,
+            kv_pages=48, page_size=8, prefill_chunk=16, role=role)
+
+    # A disaggregated pair under a role-aware router: generate traffic
+    # lands on the decode pool, so the prefill replica is the fleet's
+    # spare capacity once the workload flips.
+    servers = [make_server('prefill'), make_server('decode')]
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:1', router=router_lib.Router(threshold=10_000))
+    shutdowns: List[Any] = []
+    statuses: List[int] = []
+    statuses_lock = threading.Lock()
+    env_keys = {'SKYTPU_SERVE_HANDOFF_EVENTS': '1',
+                'SKYTPU_SERVE_DRAIN_TIMEOUT_S': '30'}
+    saved_env = {k: os.environ.get(k) for k in env_keys}
+    os.environ.update(env_keys)
+    # ITL histogram snapshot: the bound below is computed on the DELTA
+    # so observations from earlier scenarios in this process don't
+    # launder (or poison) this run's tail.
+    itl_name = 'skytpu_engine_itl_seconds'
+    itl_before = metrics_lib.parse_exposition(metrics_lib.expose())
+    flip = threading.Event()
+    t_morph = time.time()
+    try:
+        urls = []
+        for server in servers:
+            port, stop = model_server_lib.start_background(server)
+            shutdowns.append(stop)
+            urls.append(f'http://127.0.0.1:{port}')
+        lb.set_replicas([{'url': urls[0], 'role': 'prefill'},
+                         {'url': urls[1], 'role': 'decode'}])
+        lb_port = lb.start()
+
+        spec = service_spec.SkyServiceSpec(
+            initial_delay_seconds=120, readiness_timeout_seconds=5)
+        task = sky.Task(name='chaos-flip', run='sleep 1')
+        task.set_resources(sky.Resources(cloud='local'))
+        serve_state.add_service(service, spec_json={},
+                                task_yaml_path='')
+        serve_state.set_service_ports(service, 0, lb_port)
+        manager = replica_managers.ReplicaManager(service, spec, task)
+        rids = []
+        for url, role in zip(urls, ('prefill', 'decode')):
+            rid = serve_state.allocate_replica(service, service,
+                                               role=role)
+            serve_state.set_replica_status(
+                service, rid, serve_state.ReplicaStatus.READY, url=url)
+            rids.append(rid)
+
+        stop_traffic = threading.Event()
+
+        def client(worker: int) -> None:
+            worker_rng = random.Random(f'{seed}:{worker}')
+            n = 0
+            while not stop_traffic.is_set() and n < 40:
+                if flip.is_set():
+                    # Decode-heavy phase: short prompt, long decode.
+                    prompt = [worker * 50 + (n % 7) + 1, 3, 5, 7]
+                    max_new = 12
+                else:
+                    # Prefill-heavy phase: page-spanning prompts,
+                    # almost no decode.
+                    prompt = ([worker * 50 + (n % 7) + 1] +
+                              [3, 5, 7, 9, 11, 13, 15, 17] * 2 +
+                              [19, 21])
+                    max_new = 2
+                try:
+                    resp = requests.post(
+                        f'http://127.0.0.1:{lb_port}'
+                        f'{http_protocol.GENERATE}',
+                        json={'prompt_ids': [prompt],
+                              'max_new_tokens': max_new}, timeout=60)
+                    code = resp.status_code
+                except requests.RequestException:
+                    code = -1
+                with statuses_lock:
+                    statuses.append(code)
+                n += 1
+                time.sleep(worker_rng.expovariate(1 / 0.05))
+
+        threads = [threading.Thread(target=client, args=(w,),
+                                    daemon=True) for w in range(3)]
+        for t in threads:
+            t.start()
+
+        def wait_responses(count: int, timeout: float = 30.0) -> None:
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                with statuses_lock:
+                    if len(statuses) >= count:
+                        return
+                time.sleep(0.05)
+
+        # Phase 1: the all-prefill burst hammers the decode replica
+        # alone (prompts stay under the handoff threshold).
+        wait_responses(8)
+        # Phase 2: the workload flips all-decode mid-traffic; the
+        # fleet answers with a LIVE morph — the idle prefill replica
+        # joins the decode pool, warm weights and page pool intact.
+        flip.set()
+        t_morph = time.time()
+        details['morphed'] = manager.morph_replica(rids[0], 'decode')
+        # The controller's next sync, compressed into a push: the
+        # post-morph ready set stamped with a fresh epoch (>= the
+        # morph's retire nudge) re-admits the address in its NEW role.
+        lb.apply_state({
+            'ready': [{'url': urls[0], 'role': 'decode'},
+                      {'url': urls[1], 'role': 'decode'}],
+            'retired_epoch': replica_managers.next_retire_epoch()})
+        wait_responses(24)
+        stop_traffic.set()
+        for t in threads:
+            t.join(timeout=60)
+
+        # The morph must be visible everywhere role is read: the DB
+        # row (status tables / scrape targets) and live /health.
+        row = next(r for r in serve_state.get_replicas(service)
+                   if r['replica_id'] == rids[0])
+        details['db_role'] = row.get('role')
+        try:
+            health = requests.get(urls[0] + '/', timeout=5).json()
+            details['health_role'] = health.get('role')
+            details['health_draining'] = health.get('draining')
+        except (requests.RequestException, ValueError) as e:
+            extra.append(f'expectation: post-morph health probe '
+                         f'failed ({e})')
+    finally:
+        lb.stop()
+        for stop in shutdowns:
+            stop()
+        for server in servers:
+            server.close()
+        for key, value in saved_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+    itl_after = metrics_lib.parse_exposition(metrics_lib.expose())
+    before_buckets = itl_before.get(f'{itl_name}_bucket', {})
+    delta = {f'{itl_name}_bucket': {
+        labels: value - before_buckets.get(labels, 0.0)
+        for labels, value in itl_after.get(f'{itl_name}_bucket',
+                                           {}).items()}}
+    itl_p99 = metrics_lib.histogram_quantile(delta, itl_name, 0.99)
+    details['itl_p99_s'] = itl_p99
+    details['requests'] = len(statuses)
+    details['statuses'] = sorted(set(statuses))
+    _expect(len(statuses) >= 20,
+            f'traffic actually ran ({len(statuses)} requests)', extra)
+    _expect(all(s == 200 for s in statuses),
+            f'ZERO non-2xx client responses across the flip '
+            f'(got {details["statuses"]})', extra)
+    _expect(details.get('morphed') is True,
+            'the live morph committed (morph_replica returned True)',
+            extra)
+    _expect(details.get('db_role') == 'decode',
+            f'serve_state role column tracks the morph '
+            f'(got {details.get("db_role")})', extra)
+    _expect(details.get('health_role') == 'decode' and
+            details.get('health_draining') is False,
+            f'replica /health advertises the new role and re-opened '
+            f'(role={details.get("health_role")}, '
+            f'draining={details.get("health_draining")})', extra)
+    _expect(itl_p99 is not None and itl_p99 <= 2.5,
+            f'ITL p99 stays bounded through the flip '
+            f'(got {itl_p99})', extra)
+    serve_events = _since(serve_journal, t0)
+    morph_ends = [(e.get('from_role'), e.get('to_role'),
+                   e.get('status')) for e in serve_events
+                  if e.get('event') == 'role_morph_end']
+    details['morph_ends'] = morph_ends
+    _expect(('prefill', 'decode', 'ok') in morph_ends,
+            f'at least one LIVE morph journaled prefill -> decode '
+            f'with a dry drain (got {morph_ends})', extra)
+    retires = [e.get('url') for e in serve_events
+               if e.get('event') == 'lb_retire']
+    details['lb_retires'] = retires
+    _expect(len(retires) >= 1,
+            f'the morph parked the replica behind a retire nudge '
+            f'(got {retires})', extra)
+    post_morph_routes = sum(
+        1 for e in serve_events
+        if e.get('event') == 'lb_route' and urls and
+        e.get('url') == urls[0] and e.get('ts', 0.0) >= t_morph)
+    details['post_morph_routes'] = post_morph_routes
+    _expect(post_morph_routes >= 1,
+            f'the morphed replica actually serves decode traffic '
+            f'(got {post_morph_routes} routes)', extra)
+    return _finish('workload_flip_morph', seed, t0, serve_events,
+                   ['drain_no_lost_requests', 'qos_fairness'], extra,
+                   details)
 
 
 @_register(

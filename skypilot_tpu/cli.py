@@ -952,6 +952,15 @@ def _serve_metrics_table(records) -> None:
             url = rep['url']
             role = rep.get('role') or 'mixed'
             num_hosts = rep.get('num_hosts') or 1
+            # LIVE role from the replica's health payload: a morphed
+            # replica (dynamic co-location) must never render its
+            # launch-time role; the serve_state record is the
+            # fallback when the probe fails.
+            try:
+                health = requests.get(url + '/', timeout=5).json()
+                role = health.get('role') or role
+            except (requests.RequestException, ValueError):
+                pass
             try:
                 resp = requests.get(url + http_protocol.METRICS,
                                     timeout=5)

@@ -49,6 +49,7 @@ import requests
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.serve import http_protocol
+from skypilot_tpu.serve import roles as roles_lib
 
 logger = sky_logging.init_logger(__name__)
 
@@ -365,7 +366,7 @@ class FleetAggregator:
             extra = {'process': 'lb'}
         else:
             extra = {'replica_id': str(target.get('replica_id', '')),
-                     'role': target.get('role') or 'mixed'}
+                     'role': roles_lib.role_of(target)}
         for name, by_labels in parsed.items():
             if not name.startswith(_INGEST_PREFIX):
                 continue
@@ -392,7 +393,7 @@ class FleetAggregator:
         mfu = (tokens_per_s * flops_per_token /
                (peak_flops() * hosts)) if flops_per_token else 0.0
         rid = str(target.get('replica_id', ''))
-        role = target.get('role') or 'mixed'
+        role = roles_lib.role_of(target)
         _M_MFU.labels(service=self.service_name, replica_id=rid,
                       role=role).set(mfu)
         self.store.add('skytpu_mfu_estimate',

@@ -104,6 +104,19 @@ PAIRS: Tuple[PairedEvents, ...] = (
     # (spawn -> scale_down/killed/shutdown).
     _pair('router_instance', SCOPE_PROCESS, status_field='reason',
           statuses=('scale_down', 'killed', 'shutdown')),
+    # Dynamic roles (ISSUE 17).  role_rebalance brackets one
+    # controller rebalance pass pushing fractional budgets to the
+    # fleet (end guaranteed by try/finally: 'ok' = every push landed,
+    # 'partial' = some replicas refused/unreachable, 'error' = the
+    # pass itself raised).
+    _pair('role_rebalance', SCOPE_INVOCATION, status_field='status',
+          statuses=('ok', 'partial', 'error')),
+    # role_morph brackets one live role change (scoped drain ->
+    # prefix handoff -> budget swap -> re-register): a state machine
+    # spanning controller ticks, closed by the morph driver with the
+    # outcome.
+    _pair('role_morph', SCOPE_PROCESS, status_field='status',
+          statuses=('ok', 'timeout', 'error')),
 )
 
 BY_NAME: Dict[str, PairedEvents] = {p.name: p for p in PAIRS}

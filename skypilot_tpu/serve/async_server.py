@@ -617,6 +617,13 @@ class AsyncModelServer:
                         writer.write(_json_response(
                             200, self.server.drain()))
                         await writer.drain()
+                    elif path == http_protocol.ROLE_BUDGET:
+                        try:
+                            result = self.server.apply_role_budget(req)
+                        except (KeyError, ValueError, TypeError) as e:
+                            raise _HttpError(400, str(e)) from e
+                        writer.write(_json_response(200, result))
+                        await writer.drain()
                     elif path == http_protocol.PREFIX_EXPORT:
                         binary = (req.get('wire') == 'binary' or
                                   handoff_lib.CONTENT_TYPE_BINARY in

@@ -141,6 +141,7 @@ _WAIT_BUCKETS = scheduler.WAIT_BUCKETS
 AdmissionQueue = scheduler.AdmissionQueue
 PendingPrefill = scheduler.PendingPrefill
 Request = scheduler.Request
+RoleBudget = scheduler.RoleBudget
 Slot = scheduler.Slot
 WAIT_BUCKETS = scheduler.WAIT_BUCKETS
 AdmissionPlan = cache_manager.AdmissionPlan
@@ -892,6 +893,19 @@ class ContinuousBatchingEngine:
         request is still running or once it aged out of the store)."""
         return self._spans.get(request_id)
 
+    def set_role_budget(
+            self, budget: Optional[scheduler.RoleBudget]) -> bool:
+        """Swap the fractional-role budget in place — warm weights and
+        page pool untouched; the next tick's admission gate and prefill
+        chunk clamp pick it up.  Version-ordered: a stale push (lower
+        version than the one in force) is dropped and False returned.
+        None removes the clamp entirely."""
+        return self._queue.set_role_budget(budget)
+
+    @property
+    def role_budget(self) -> Optional[scheduler.RoleBudget]:
+        return self._queue.role_budget
+
     def stop(self) -> None:
         self._stop.set()
         with self._cond:
@@ -1083,7 +1097,9 @@ class ContinuousBatchingEngine:
         import numpy as np  # pylint: disable=import-outside-toplevel
         t_chunk0 = time.perf_counter()
         n_target = pending.n_target
-        chunk = self.prefill_chunk
+        # Fractional-role clamp: a decode-heavy budget shrinks the
+        # per-tick piece (floor 1 — prefill slows, never stalls).
+        chunk = self._queue.prefill_tokens_per_tick(self.prefill_chunk)
         plan = pending.plan
         reuse_tokens = plan.n_reuse_tokens if plan is not None else 0
         if pending.cache is None and reuse_tokens > 0:
@@ -1358,7 +1374,14 @@ class ContinuousBatchingEngine:
                 deferred = False
                 free = [i for i, s in enumerate(self._slots)
                         if not s.active]
+                occupied = len(self._slots) - len(free)
                 for slot_id in free:
+                    # Fractional-role decode budget: stop admitting
+                    # once occupied slots reach the decode-token cap
+                    # (queued requests keep their WRR order; running
+                    # decodes always finish).
+                    if not self._queue.admission_allowed(occupied):
+                        break
                     request = self._queue.pop()
                     if request is None:
                         break
@@ -1373,8 +1396,10 @@ class ContinuousBatchingEngine:
                         break
                     if pending is not None:
                         pending_prefills.append(pending)
+                        occupied += 1
                     elif self._slots[slot_id].request is not None:
                         live[slot_id] = request
+                        occupied += 1
                 # At most ONE prefill chunk between ticks — the bound
                 # on the ITL stall an admission can impose.
                 if pending_prefills:

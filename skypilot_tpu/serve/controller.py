@@ -29,6 +29,7 @@ from skypilot_tpu.observability import slo as slo_lib
 from skypilot_tpu.serve import autoscalers
 from skypilot_tpu.serve import http_protocol
 from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.serve import roles as roles_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.serve.serve_state import ServiceStatus
@@ -54,6 +55,11 @@ _M_ROLE_TARGET = metrics_lib.gauge(
     'Per-role-pool replica target from the last scaling evaluation '
     '(disaggregated serving: each role autoscales independently).',
     ('service', 'role'))
+_M_PREFILL_SHARE = metrics_lib.gauge(
+    'skytpu_serve_prefill_demand_share',
+    'Windowed prefill share of fleet demand the rebalancer computed '
+    'this pass (0.5 = balanced; outside the morph hysteresis band a '
+    'replica changes role).', ('service',))
 
 
 def _sync_interval() -> float:
@@ -107,6 +113,9 @@ class SkyServeController:
         # role -> ordered region list new replicas round-robin over.
         self.region_plan = optimizer_lib.place_role_pools(self.spec)
         self._region_cursor: Dict[str, int] = {}
+        # Dynamic co-location: wall clock of the last fleet rebalance
+        # pass (budget pushes + morph check run once per window).
+        self._last_rebalance = 0.0
 
     # -------------------------------------------------------- HTTP control
 
@@ -357,7 +366,7 @@ class SkyServeController:
             # neither count toward the pool's capacity (or every pass
             # would retire one more) nor are scale-down candidates.
             pool = [r for r in current_version
-                    if (r.get('role') or 'mixed') == role and
+                    if roles_lib.role_of(r) == role and
                     r['status'] != ReplicaStatus.DRAINING.value]
             n_active = len(pool)
             if n_active < decision.target_num_replicas:
@@ -391,10 +400,18 @@ class SkyServeController:
         # Replicas whose role pool no longer exists in the spec (a
         # roles: change) have no autoscaler to own them — retire.
         for replica in current_version:
-            if (replica.get('role') or 'mixed') not in self.autoscalers:
+            if roles_lib.role_of(replica) not in self.autoscalers:
                 self.replica_manager.scale_down(replica['replica_id'],
                                                 drain=True,
                                                 reason='role_removed')
+        # Dynamic co-location: recompute fractional budget splits from
+        # the aggregator's windowed per-role signals and push them to
+        # the fleet; morph a replica outright past the hysteresis
+        # band.  Best-effort — rebalancing must never wedge reconcile.
+        try:
+            self._rebalance_fleet()
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('fleet rebalance failed')
         _M_TARGET_REPLICAS.labels(service=self.service_name).set(
             self._total_target())
         _M_QPS.labels(service=self.service_name).set(
@@ -420,6 +437,142 @@ class SkyServeController:
         except Exception:  # pylint: disable=broad-except
             logger.exception('router state push failed')
 
+    # ---------------------------------------------- dynamic co-location
+
+    def _dynamic_roles_enabled(self) -> bool:
+        """SKYTPU_SERVE_DYNAMIC_ROLES=1/0 overrides the spec's
+        `roles: {dynamic: ...}` flag (chaos/bench runs flip it without
+        re-deploying the service)."""
+        env = os.environ.get('SKYTPU_SERVE_DYNAMIC_ROLES')
+        if env is not None and env != '':
+            return env == '1'
+        return bool(self.spec.dynamic_roles)
+
+    def _rebalance_window(self) -> float:
+        env = os.environ.get('SKYTPU_SERVE_REBALANCE_WINDOW_S')
+        if env:
+            return float(env)
+        return float(self.spec.rebalance_window_s)
+
+    def _morph_hysteresis(self) -> float:
+        env = os.environ.get('SKYTPU_SERVE_MORPH_HYSTERESIS')
+        if env:
+            return float(env)
+        return float(self.spec.morph_hysteresis)
+
+    def _prefill_share(self) -> float:
+        """Windowed prefill share of fleet demand in [0, 1]: the
+        prefill pool's routed QPS plus half the mixed pool's, over the
+        total (0.5 = balanced / no signal).  This one number drives
+        both the fractional budget split pushed to mixed replicas and
+        the morph decision."""
+        try:
+            sig = {role: self.aggregator.role_signals(role)
+                   for role in roles_lib.ROLES}
+        except Exception:  # pylint: disable=broad-except
+            return 0.5
+        q_prefill = sig['prefill'].get('qps') or 0.0
+        q_decode = sig['decode'].get('qps') or 0.0
+        q_mixed = sig['mixed'].get('qps') or 0.0
+        total = q_prefill + q_decode + q_mixed
+        if total <= 0:
+            return 0.5
+        return (q_prefill + 0.5 * q_mixed) / total
+
+    def _rebalance_fleet(self) -> None:
+        """One rebalance pass (window-gated): push the current
+        fractional budget split to every READY mixed replica over
+        /role_budget, then check the hysteresis band for a morph.
+        Journaled as a role_rebalance_start/_end pair — the end lands
+        on every exit path (try/finally); 'partial' records pushes
+        that failed (unreachable replica, stale version)."""
+        if not self._dynamic_roles_enabled():
+            return
+        now = time.time()
+        if now - self._last_rebalance < self._rebalance_window():
+            return
+        self._last_rebalance = now
+        infos = self.replica_manager.ready_infos()
+        if not infos:
+            return
+        share = self._prefill_share()
+        _M_PREFILL_SHARE.labels(service=self.service_name).set(share)
+        replica_managers._journal_drain(  # pylint: disable=protected-access
+            'role_rebalance_start', service=self.service_name,
+            prefill_share=round(share, 4), replicas=len(infos))
+        status = 'error'
+        pushed = failed = 0
+        try:
+            version = replica_managers.next_retire_epoch()
+            # Mixed replicas track the demand split fractionally;
+            # clamped so neither phase is ever starved outright —
+            # morphing, not budgets, is the answer past the band.
+            split = min(0.9, max(0.1, share))
+            for info in infos:
+                if roles_lib.role_of(info) != 'mixed':
+                    continue
+                ok = False
+                try:
+                    resp = requests.post(
+                        info['url'] + http_protocol.ROLE_BUDGET,
+                        json={'role': 'mixed', 'split': split,
+                              'version': version},
+                        timeout=5)
+                    ok = (resp.status_code == 200 and
+                          bool(resp.json().get('applied')))
+                except (requests.RequestException, ValueError):
+                    ok = False
+                if ok:
+                    pushed += 1
+                else:
+                    failed += 1
+            self._maybe_morph(share)
+            status = 'ok' if failed == 0 else 'partial'
+        finally:
+            replica_managers._journal_drain(  # pylint: disable=protected-access
+                'role_rebalance_end', service=self.service_name,
+                status=status, prefill_share=round(share, 4),
+                pushed=pushed, failed=failed)
+
+    def _maybe_morph(self, share: float) -> None:
+        """Past the hysteresis band, morph ONE replica per pass from
+        the oversupplied pure pool into the starved one (bounded
+        churn), respecting both pools' spec bounds — the donor pool
+        never dips below its min_replicas and the target pool never
+        exceeds its max_replicas, so the per-pool autoscalers don't
+        fight the morph on the next tick."""
+        hysteresis = self._morph_hysteresis()
+        if abs(share - 0.5) <= hysteresis:
+            return
+        want = 'prefill' if share > 0.5 else 'decode'
+        donor_role = 'decode' if want == 'prefill' else 'prefill'
+        if (want not in self.autoscalers or
+                donor_role not in self.autoscalers):
+            return  # morphing needs both pure pools declared
+        replicas = [r for r in self.replica_manager.active_replicas()
+                    if r['version'] >= self.version]
+        donors = [r for r in replicas
+                  if roles_lib.role_of(r) == donor_role and
+                  r['status'] == ReplicaStatus.READY.value]
+        if not donors:
+            return
+        target_pool = [r for r in replicas
+                       if roles_lib.role_of(r) == want and
+                       r['status'] != ReplicaStatus.DRAINING.value]
+        donor_spec = self.spec.role_specs.get(donor_role)
+        want_spec = self.spec.role_specs.get(want)
+        if (donor_spec is not None and
+                len(donors) - 1 < donor_spec.min_replicas):
+            return
+        if (want_spec is not None and
+                len(target_pool) + 1 > want_spec.max_replicas):
+            return
+        # Newest donor first: the oldest replica holds the warmest
+        # prefix cache for its CURRENT pool — same rationale as
+        # retirement_order.
+        donor = retirement_order(donors)[0]
+        self.replica_manager.morph_replica(donor['replica_id'], want)
+
     # ------------------------------------------------- fleet telemetry
 
     def _scrape_targets(self) -> List[Dict]:
@@ -428,7 +581,7 @@ class SkyServeController:
         targets: List[Dict] = [
             {'url': info['url'], 'kind': 'replica',
              'replica_id': info['replica_id'],
-             'role': info.get('role') or 'mixed',
+             'role': roles_lib.role_of(info),
              'num_hosts': info.get('num_hosts') or 1}
             for info in self.replica_manager.ready_infos()]
         record = serve_state.get_service(self.service_name)
@@ -540,7 +693,7 @@ class SkyServeController:
         for role, scaler in self.autoscalers.items():
             live = [r for r in replicas
                     if r['version'] >= self.version and
-                    (r.get('role') or 'mixed') == role and
+                    roles_lib.role_of(r) == role and
                     r['status'] != ReplicaStatus.DRAINING.value]
             scaler.warm_start(len(live))
         replica_managers._journal_drain(  # pylint: disable=protected-access

@@ -59,11 +59,12 @@ PREFILL_EXPORT = '/prefill_export'    # POST: KV handoff, prefill side
 KV_IMPORT = '/kv_import'              # POST: KV handoff, decode side
 DRAIN = '/drain'                      # POST: controller retirement path
 PREFIX_EXPORT = '/prefix_export'      # POST: drain-time sibling handoff
+ROLE_BUDGET = '/role_budget'          # POST: rebalance push / role morph
 # Any other GET answers the health/readiness payload (the probe path).
 
 REPLICA_PATHS = (METRICS, SPANS, GENERATE, GENERATE_STREAM,
                  GENERATE_TEXT, PREFILL_EXPORT, KV_IMPORT, DRAIN,
-                 PREFIX_EXPORT)
+                 PREFIX_EXPORT, ROLE_BUDGET)
 
 # ------------------------------------------------- LB control plane (the
 # `/lb/` prefix is never proxied; the LB answers these itself)

@@ -52,6 +52,7 @@ from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import brain_store as brain_store_lib
 from skypilot_tpu.serve import http_protocol
 from skypilot_tpu.serve import qos as qos_lib
+from skypilot_tpu.serve import roles as roles_lib
 from skypilot_tpu.serve import router as router_lib
 
 logger = sky_logging.init_logger(__name__)
@@ -510,7 +511,7 @@ class SkyServeLoadBalancer:
         Dicts carry at least `url`, optionally `role`, `load`,
         `page_size`, `region`."""
         endpoints = [router_lib.ReplicaEndpoint(
-            url=r['url'], role=r.get('role') or router_lib.DEFAULT_ROLE,
+            url=r['url'], role=roles_lib.role_of(r),
             load=float(r.get('load') or 0.0),
             page_size=r.get('page_size'),
             region=r.get('region')) for r in replicas]

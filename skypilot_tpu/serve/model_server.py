@@ -58,6 +58,7 @@ from skypilot_tpu.serve import batching_engine as batching_engine_lib
 from skypilot_tpu.serve import handoff as handoff_lib
 from skypilot_tpu.serve import http_protocol
 from skypilot_tpu.serve import qos as qos_lib
+from skypilot_tpu.serve import roles as roles_lib
 from skypilot_tpu.serve import router as router_lib
 
 logger = sky_logging.init_logger(__name__)
@@ -424,6 +425,51 @@ class ModelServer:
         the in-flight snapshot the controller's drain monitor reads."""
         self.draining = True
         return {'draining': True, 'inflight': self.inflight()}
+
+    def apply_role_budget(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        """POST /role_budget: controller rebalance push or role-morph
+        commit.  Swaps the engine's fractional-role budget IN PLACE
+        (warm weights and page pool untouched) and, when the payload
+        names a different role, flips the advertised role and clears
+        draining — the morph's scoped drain is over and the replica
+        re-opens under its new role.  Version-ordered: a stale push
+        (older `version` than the budget in force) is dropped so a
+        rebalance racing a morph cannot resurrect the old split."""
+        engine = self._engine
+        if engine is None:
+            raise ValueError('role budgets require --continuous-batching')
+        new_role = roles_lib.normalize(req.get('role') or self.role)
+        version = int(req.get('version', 0))
+        split = req.get('split')
+        if (req.get('prefill_tokens') is not None and
+                req.get('decode_tokens') is not None):
+            budget = batching_engine_lib.RoleBudget(
+                prefill_tokens=int(req['prefill_tokens']),
+                decode_tokens=int(req['decode_tokens']),
+                role=new_role,
+                split=float(split) if split is not None
+                else roles_lib.DEFAULT_SPLITS[new_role],
+                version=version)
+        elif split is not None:
+            budget = batching_engine_lib.RoleBudget.from_split(
+                float(split), slots=self.max_batch,
+                prefill_chunk=engine.prefill_chunk, role=new_role,
+                version=version)
+        else:
+            budget = batching_engine_lib.RoleBudget.for_role(
+                new_role, slots=self.max_batch,
+                prefill_chunk=engine.prefill_chunk, version=version)
+        applied = engine.set_role_budget(budget)
+        morphed = applied and new_role != self.role
+        if morphed:
+            self.role = new_role
+            self.draining = False
+        elif applied and req.get('resume'):
+            # Aborted morph rollback: re-open under the same role.
+            self.draining = False
+        return {'applied': applied, 'morphed': morphed,
+                'role': self.role, 'draining': self.draining,
+                'budget': budget.as_dict()}
 
     def inflight(self) -> int:
         """Busy slots + queued admissions (0 without an engine): the
@@ -1041,6 +1087,19 @@ def _make_handler(server: ModelServer):
             finishes) and report the occupancy the drain waits on."""
             self._reply(200, server.drain())
 
+        def _role_budget(self):
+            """Rebalance push / morph commit: swap the fractional-role
+            budget in place (see ModelServer.apply_role_budget).
+            Allowed while draining — a morph drains, then commits."""
+            try:
+                self._reply(200,
+                            server.apply_role_budget(self._read_json()))
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._reply(400, {'error': str(e)})
+            except Exception as e:  # pylint: disable=broad-except
+                self._reply(500, {'error': f'{type(e).__name__}: {e}'})
+
         def _prefix_export(self):
             """Drain-time sibling handoff: export the hottest prefix-
             cache pages (POOL pages — no prefill runs) so a surviving
@@ -1095,6 +1154,9 @@ def _make_handler(server: ModelServer):
                 return
             if self.path == http_protocol.PREFIX_EXPORT:
                 self._prefix_export()
+                return
+            if self.path == http_protocol.ROLE_BUDGET:
+                self._role_budget()
                 return
             if self.path != http_protocol.GENERATE:
                 self._reply(404, {'error': 'unknown path'})

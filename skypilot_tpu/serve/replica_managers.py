@@ -26,6 +26,7 @@ from skypilot_tpu.chaos import faults as chaos_faults
 from skypilot_tpu.chaos import injector as chaos_injector
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.serve import http_protocol
+from skypilot_tpu.serve import roles as roles_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.utils import common_utils
@@ -56,6 +57,10 @@ _M_DRAINS = metrics_lib.counter(
     'Replica drains finished, by terminal reason (drained = in-flight '
     'work ran out; timeout = SKYTPU_SERVE_DRAIN_TIMEOUT_S force-kill; '
     'dead = the replica vanished mid-drain).', ('reason',))
+_M_MORPHS = metrics_lib.counter(
+    'skytpu_serve_role_morphs_total',
+    'Live role morphs committed (scoped drain + in-place budget swap; '
+    'no restart), by the role the replica morphed INTO.', ('to_role',))
 
 ENV_REPLICA_ID = 'SKYTPU_SERVE_REPLICA_ID'
 ENV_REPLICA_PORT = 'SKYTPU_SERVE_REPLICA_PORT'
@@ -433,12 +438,12 @@ class ReplicaManager:
         url = replica['url']
         if max_pages <= 0 or not url:
             return
-        role = replica.get('role') or 'mixed'
+        role = roles_lib.role_of(replica)
         sibling = next(
             (r['url'] for r in serve_state.get_replicas(
                 self.service_name)
              if r['status'] == ReplicaStatus.READY.value and r['url']
-             and (r.get('role') or 'mixed') == role
+             and roles_lib.role_of(r) == role
              and r['replica_id'] != replica['replica_id']), None)
         if sibling is None:
             return
@@ -472,6 +477,134 @@ class ReplicaManager:
                        service=self.service_name,
                        replica_id=replica['replica_id'],
                        target=sibling, pages=pages, status=status)
+
+    # -------------------------------------------------------------- morph
+
+    def _inflight(self, url: str) -> Optional[int]:
+        """Busy + queued from the replica's health payload (None when
+        unreachable or the payload has no engine stats)."""
+        try:
+            resp = requests.get(
+                url + self.spec.readiness_path,
+                timeout=self.spec.readiness_timeout_seconds)
+            if resp.status_code not in (200, 503):
+                return None
+            engine = resp.json().get('engine') or {}
+            return (int(engine.get('busy_slots', 0) or 0) +
+                    int(engine.get('queued_requests', 0) or 0))
+        except (requests.RequestException, ValueError, TypeError):
+            return None
+
+    def morph_replica(self, replica_id: int, new_role: str,
+                      budget: Optional[Dict] = None,
+                      timeout_s: Optional[float] = None) -> bool:
+        """Live role morph (dynamic co-location): flip a READY replica
+        to `new_role` WITHOUT restart.  Sequence: journal
+        role_morph_start; park the replica DRAINING in serve_state and
+        epoch-nudge every router off it (no router double-routes while
+        the flip is in progress — the DRAINING row also keeps it out
+        of every sync payload a router could pull mid-flip); POST
+        /drain so the old role's queue runs dry while in-flight work
+        finishes (bounded by the drain timeout; running decodes always
+        finish); ship the hottest prefix pages to a sibling still
+        serving the OLD role (that pool owns the pinned sessions);
+        POST /role_budget — the engine swaps its budget profile in
+        place keeping warm weights + page pool, and the server
+        re-opens under the new role; persist the new role and flip the
+        row back to READY so the next controller sync (view epoch >=
+        the nudge) re-registers the replica in its new pool.  Journals
+        role_morph_end{status: ok|timeout|error}; returns True iff the
+        budget commit landed ('timeout' commits too — the drain just
+        never ran dry)."""
+        replica = self._get_replica(replica_id)
+        if replica is None or not replica.get('url'):
+            return False
+        if replica['status'] != ReplicaStatus.READY.value:
+            return False
+        url = replica['url']
+        old_role = roles_lib.role_of(replica)
+        new_role = roles_lib.normalize(new_role)
+        if new_role == old_role:
+            return False
+        t0 = time.time()
+        timeout = (timeout_s if timeout_s is not None
+                   else _drain_timeout())
+        _journal_drain('role_morph_start', service=self.service_name,
+                       replica_id=replica_id, url=url,
+                       from_role=old_role, to_role=new_role)
+        status = 'error'
+        drained_posted = False
+        try:
+            # Chaos site: "deny" aborts BEFORE the scoped drain — the
+            # replica keeps serving under its old role and budget.
+            if chaos_injector.inject(
+                    'serve.role_morph', service=self.service_name,
+                    replica_id=replica_id, from_role=old_role,
+                    to_role=new_role) is chaos_injector.DENY:
+                return False
+            serve_state.set_replica_draining(self.service_name,
+                                             replica_id, t0)
+            self._nudge_lb_retire(url)
+            self._post_drain(url)
+            drained_posted = True
+            deadline = t0 + timeout
+            dry = False
+            while time.time() < deadline:
+                inflight = self._inflight(url)
+                if inflight is not None and inflight <= 0:
+                    dry = True
+                    break
+                time.sleep(0.05)
+            self._export_hot_prefixes(replica)
+            payload = dict(budget or {})
+            payload['role'] = new_role
+            payload.setdefault('version', next_retire_epoch())
+            resp = requests.post(url + http_protocol.ROLE_BUDGET,
+                                 json=payload, timeout=10)
+            if resp.status_code != 200 or not resp.json().get(
+                    'applied'):
+                raise requests.RequestException(
+                    f'role_budget -> {resp.status_code}')
+            serve_state.set_replica_role(self.service_name,
+                                         replica_id, new_role)
+            serve_state.set_replica_status(self.service_name,
+                                           replica_id,
+                                           ReplicaStatus.READY)
+            status = 'ok' if dry else 'timeout'
+            _M_MORPHS.labels(to_role=new_role).inc()
+            logger.info(
+                f'replica {replica_id} morphed {old_role} -> '
+                f'{new_role} ({status} after '
+                f'{time.time() - t0:.1f}s)')
+            return True
+        except (requests.RequestException, ValueError) as e:
+            logger.warning(
+                f'role morph {old_role} -> {new_role} failed for '
+                f'replica {replica_id}: {e}')
+            # Re-open under the OLD role (clears the server's
+            # draining flag) and un-park the row; best effort — the
+            # drain monitor's timeout is the backstop if this POST
+            # fails too.
+            if drained_posted:
+                try:
+                    requests.post(
+                        url + http_protocol.ROLE_BUDGET,
+                        json={'role': old_role, 'resume': True,
+                              'version': next_retire_epoch()},
+                        timeout=5)
+                except requests.RequestException:
+                    pass
+                serve_state.set_replica_status(self.service_name,
+                                               replica_id,
+                                               ReplicaStatus.READY)
+            return False
+        finally:
+            _journal_drain('role_morph_end',
+                           service=self.service_name,
+                           replica_id=replica_id, url=url,
+                           from_role=old_role, to_role=new_role,
+                           status=status,
+                           duration_s=round(time.time() - t0, 3))
 
     # -------------------------------------------------------------- probe
 
@@ -658,7 +791,7 @@ class ReplicaManager:
             infos.append({
                 'url': r['url'],
                 'replica_id': rid,
-                'role': r.get('role') or 'mixed',
+                'role': roles_lib.role_of(r),
                 'load': self._last_load.get(rid, 0.0),
                 'page_size': stats.get('page_size'),
                 'queue_depth': stats.get('queue_depth', 0),
@@ -675,7 +808,7 @@ class ReplicaManager:
         ready_ids = {r['replica_id'] for r in serve_state.get_replicas(
             self.service_name)
             if r['status'] == ReplicaStatus.READY.value and
-            (role is None or (r.get('role') or 'mixed') == role)}
+            (role is None or roles_lib.role_of(r) == role)}
         return [load for rid, load in self._last_load.items()
                 if rid in ready_ids]
 

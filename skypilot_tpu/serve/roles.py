@@ -1,0 +1,40 @@
+"""Replica roles: the single home for role names and the
+missing-role default.
+
+Every plane that reads a replica record used to spell the default
+inline (`r.get('role') or 'mixed'` — replica_managers' ready set,
+drain-sibling pick and load view, the router's endpoints, the
+controller's scrape targets, the CLI tables).  One stale copy is a
+routing bug: a record without a role must mean *mixed* everywhere or
+a morphed/legacy replica lands in the wrong pool.  This module is
+deliberately a leaf (no serve imports) so every layer can use it.
+"""
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional
+
+ROLES = ('prefill', 'decode', 'mixed')
+DEFAULT_ROLE = 'mixed'
+
+# Launch-time prefill share per static role (scheduler.RoleBudget
+# derives per-tick budgets from these; 0.5 = unclamped mixed).
+DEFAULT_SPLITS = {'prefill': 1.0, 'decode': 0.0, 'mixed': 0.5}
+
+
+def normalize(role: Optional[str]) -> str:
+    """A possibly-missing role value -> a valid role name (None/''
+    -> the mixed default).  Unknown names raise: silently coercing a
+    typo to 'mixed' would hide a misrouted pool."""
+    if not role:
+        return DEFAULT_ROLE
+    if role not in ROLES:
+        raise ValueError(f'Unknown replica role {role!r}; '
+                         f'one of {ROLES}')
+    return role
+
+
+def role_of(record: Mapping[str, Any]) -> str:
+    """The role of a replica record/info dict, defaulting missing or
+    empty values to 'mixed' (pre-roles rows and user containers that
+    never advertise one)."""
+    return normalize(record.get('role'))

@@ -45,9 +45,12 @@ from typing import Any, Dict, Hashable, List, Optional, Sequence
 
 from skypilot_tpu.serve import brain_store as brain_store_lib
 from skypilot_tpu.serve import http_protocol
+from skypilot_tpu.serve import roles as roles_lib
 
-ROLES = ('prefill', 'decode', 'mixed')
-DEFAULT_ROLE = 'mixed'
+# Re-exported from the canonical role module (serve/roles.py) — this
+# module historically owned the names and importers keep working.
+ROLES = roles_lib.ROLES
+DEFAULT_ROLE = roles_lib.DEFAULT_ROLE
 
 # Routing metadata headers (re-exported from the canonical protocol
 # module — serve/http_protocol.py — which `sky lint`'s http-contract

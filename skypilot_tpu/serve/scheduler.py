@@ -16,14 +16,25 @@ request BEFORE it reaches a KV slot and AFTER tokens start flowing —
   histogram only when a request actually lands in a slot — a deferred
   pop (page pool exhausted) goes back to the FRONT uncounted.
 - :class:`Slot` / :class:`PendingPrefill` — per-slot host bookkeeping.
+- :class:`RoleBudget` — per-tick prefill/decode token budgets: the
+  replica's role expressed as a *fraction* instead of a static launch
+  property.  The engine's chunked-prefill interleave clamps each
+  tick's prefill chunk to the prefill budget, and the smooth-WRR
+  admission stops admitting new decode slots past the decode budget —
+  a decode-heavy budget starves prefill gracefully mid-prompt rather
+  than blocking a tick, and the controller can swap the whole budget
+  in place (live role morph) without restarting the engine.
 """
 from __future__ import annotations
 
 import collections
+import dataclasses
 import queue
 import threading
 import time
 from typing import Any, Callable, Deque, Dict, List, Optional
+
+from skypilot_tpu.serve import roles as roles_lib
 
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing
@@ -56,6 +67,18 @@ _M_ITL = metrics_lib.histogram(
 _M_QOS_ADMITTED = metrics_lib.counter(
     'skytpu_engine_qos_admitted_total',
     'Requests admitted into a KV slot, by QoS class.', ('qos_class',))
+_M_PREFILL_BUDGET = metrics_lib.gauge(
+    'skytpu_engine_prefill_budget_tokens',
+    'Per-tick prefill token budget in force (fractional role; set on '
+    'every budget swap).')
+_M_DECODE_BUDGET = metrics_lib.gauge(
+    'skytpu_engine_decode_budget_tokens',
+    'Per-tick decode token budget in force (caps concurrent decode '
+    'slots; set on every budget swap).')
+_M_BUDGET_SWAPS = metrics_lib.counter(
+    'skytpu_engine_budget_swaps_total',
+    'Role-budget swaps applied (controller rebalance pushes + live '
+    'role morphs).')
 
 
 class QueueFull(RuntimeError):
@@ -284,6 +307,71 @@ class PendingPrefill:
         self.plan: Optional[Any] = None
 
 
+@dataclasses.dataclass
+class RoleBudget:
+    """Per-tick token budgets that make replica role fractional.
+
+    ``prefill_tokens`` caps the prompt tokens a tick's chunked-prefill
+    advance may consume; ``decode_tokens`` caps the decode tokens a
+    tick may spend, which — at one token per busy slot per tick — is a
+    cap on *concurrent decode slots* enforced at admission (running
+    decodes always finish; a shrunk decode budget bites as slots
+    free).  Both floors at 1: budgets throttle, they never deadlock —
+    a starved phase still makes one token of progress per tick, so a
+    mid-prompt prefill crawls rather than wedges.
+
+    ``split`` is the prefill share the budget was derived from (the
+    controller's rebalance unit); ``version`` orders controller pushes
+    so a stale rebalance can never overwrite a newer one.
+    """
+    prefill_tokens: int
+    decode_tokens: int
+    role: str = roles_lib.DEFAULT_ROLE
+    split: float = 0.5
+    version: int = 0
+
+    def __post_init__(self) -> None:
+        self.prefill_tokens = max(1, int(self.prefill_tokens))
+        self.decode_tokens = max(1, int(self.decode_tokens))
+        self.split = min(1.0, max(0.0, float(self.split)))
+        self.version = int(self.version)
+        if self.role not in roles_lib.ROLES:
+            raise ValueError(f'Unknown role {self.role!r}; one of '
+                             f'{roles_lib.ROLES}')
+
+    @classmethod
+    def from_split(cls, split: float, *, slots: int,
+                   prefill_chunk: int,
+                   role: str = roles_lib.DEFAULT_ROLE,
+                   version: int = 0) -> 'RoleBudget':
+        """Budget from a prefill share in [0, 1].  At 0.5 both phases
+        run unclamped (byte-identical to the pre-budget engine — the
+        mixed default costs nothing); pushing the split toward either
+        end linearly starves the other phase down to its 1-token
+        liveness floor."""
+        split = min(1.0, max(0.0, float(split)))
+        return cls(
+            prefill_tokens=round(prefill_chunk * min(1.0, 2 * split)),
+            decode_tokens=round(slots * min(1.0, 2 * (1 - split))),
+            role=role, split=split, version=version)
+
+    @classmethod
+    def for_role(cls, role: str, *, slots: int, prefill_chunk: int,
+                 version: int = 0) -> 'RoleBudget':
+        """The launch-time profile of a static role pool: prefill
+        replicas spend their ticks prefilling (decode floor), decode
+        replicas the reverse, mixed replicas are unclamped."""
+        return cls.from_split(roles_lib.DEFAULT_SPLITS[role],
+                              slots=slots, prefill_chunk=prefill_chunk,
+                              role=role, version=version)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {'role': self.role, 'split': self.split,
+                'prefill_tokens': self.prefill_tokens,
+                'decode_tokens': self.decode_tokens,
+                'version': self.version}
+
+
 class AdmissionQueue:
     """Bounded, TTL'd FIFO between submit() threads and the worker."""
 
@@ -295,6 +383,12 @@ class AdmissionQueue:
         self.queue_ttl = queue_ttl           # None = no expiry
         self._drain_estimate = drain_estimate
         self._queue: Deque[Request] = collections.deque()
+        # Per-tick role budget (None = unclamped, the pre-budget
+        # behavior).  Swapped atomically under the condition lock by
+        # set_role_budget (controller rebalance push / live morph);
+        # admission_allowed gates new decode slots against it.
+        self.role_budget: Optional[RoleBudget] = None
+        self.budget_swaps = 0
         # Smooth weighted round-robin credits per QoS class: when BOTH
         # classes have queued work, pops interleave by class weight
         # (interactive's floor under a batch backlog and vice versa);
@@ -326,6 +420,42 @@ class AdmissionQueue:
             self._queue.append(request)
             _M_QUEUE_DEPTH.set(len(self._queue))
             self.cond.notify()
+
+    def set_role_budget(self, budget: Optional[RoleBudget]) -> bool:
+        """Install a new per-tick budget (None = unclamped).  Stale
+        pushes lose: a budget older than the one in force is dropped
+        (version-ordered), so a slow rebalance POST can never undo a
+        newer morph.  Returns whether the swap was applied."""
+        with self.cond:
+            current = self.role_budget
+            if (budget is not None and current is not None and
+                    budget.version < current.version):
+                return False
+            self.role_budget = budget
+            self.budget_swaps += 1
+            self.cond.notify_all()
+        _M_BUDGET_SWAPS.inc()
+        if budget is not None:
+            _M_PREFILL_BUDGET.set(budget.prefill_tokens)
+            _M_DECODE_BUDGET.set(budget.decode_tokens)
+        return True
+
+    def admission_allowed(self, busy_slots: int) -> bool:
+        """May this tick admit one more decode slot?  The decode-token
+        budget is a concurrency cap: each busy slot spends one decode
+        token per tick, so admission stops once the busy count reaches
+        the budget — queued requests wait (smooth-WRR order preserved)
+        until the budget flips back or a slot frees."""
+        budget = self.role_budget
+        return budget is None or busy_slots < budget.decode_tokens
+
+    def prefill_tokens_per_tick(self, default: int) -> int:
+        """Per-tick prompt-token allowance for chunked prefill
+        (`default` = the configured chunk size when unclamped)."""
+        budget = self.role_budget
+        if budget is None:
+            return default
+        return min(default, budget.prefill_tokens)
 
     def reject(self, reason: str, message: str) -> QueueFull:
         """Count a non-queue-bound rejection (e.g. page-pool
@@ -476,4 +606,8 @@ class AdmissionQueue:
                 'queue_ttl_expiries': self.queue_ttl_expiries,
                 'queue_wait_hist': hist,
                 'max_queue': self.max_queue,
+                'role_budget': (self.role_budget.as_dict()
+                                if self.role_budget is not None
+                                else None),
+                'budget_swaps': self.budget_swaps,
             }

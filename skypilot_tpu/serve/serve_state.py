@@ -306,6 +306,18 @@ def set_replica_draining(service_name: str, replica_id: int,
              service_name, replica_id))
 
 
+def set_replica_role(service_name: str, replica_id: int,
+                     role: str) -> None:
+    """Persist a live role morph: the DB role column tracks the role
+    the replica currently serves (launch role until the first morph),
+    so status tables and scrape targets never show a stale pool."""
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE replicas SET role=? '
+            'WHERE service_name=? AND replica_id=?',
+            (role, service_name, replica_id))
+
+
 def remove_replica(service_name: str, replica_id: int) -> None:
     with _conn() as conn:
         conn.execute(

@@ -210,10 +210,38 @@ class SkyServiceSpec:
         # single 'mixed' pool (so every consumer can just iterate
         # role_specs).
         self.explicit_roles = roles is not None
+        # Dynamic co-location (fractional budgets + live morphing):
+        # `roles: {dynamic: true, rebalance_window_s: ..,
+        # morph_hysteresis: ..}` ride alongside the pool entries.  The
+        # controller's rebalancer recomputes per-replica budget splits
+        # from the aggregator's windowed per-role signals every
+        # rebalance_window_s, and morphs a replica's role outright
+        # when the demand imbalance exceeds the hysteresis band.
+        self.dynamic_roles = False
+        self.rebalance_window_s = 60.0
+        self.morph_hysteresis = 0.25
         if roles:
             if not isinstance(roles, dict) or not roles:
                 raise exceptions.InvalidTaskError(
                     'roles must map role name -> pool config')
+            roles = dict(roles)
+            if 'dynamic' in roles:
+                self.dynamic_roles = bool(roles.pop('dynamic'))
+            if 'rebalance_window_s' in roles:
+                self.rebalance_window_s = float(
+                    roles.pop('rebalance_window_s'))
+                if self.rebalance_window_s <= 0:
+                    raise exceptions.InvalidTaskError(
+                        'roles.rebalance_window_s must be > 0')
+            if 'morph_hysteresis' in roles:
+                self.morph_hysteresis = float(
+                    roles.pop('morph_hysteresis'))
+                if not 0.0 <= self.morph_hysteresis <= 1.0:
+                    raise exceptions.InvalidTaskError(
+                        'roles.morph_hysteresis must be in [0, 1]')
+            if not roles:
+                raise exceptions.InvalidTaskError(
+                    'roles must name at least one pool')
             self.role_specs: Dict[str, RolePool] = {}
             for role, pool_cfg in roles.items():
                 pool_cfg = dict(pool_cfg or {})
@@ -380,6 +408,12 @@ class SkyServiceSpec:
                 if pool.num_hosts != 1:
                     entry['num_hosts'] = pool.num_hosts
                 roles[role] = entry
+            if self.dynamic_roles:
+                roles['dynamic'] = True
+            if self.rebalance_window_s != 60.0:
+                roles['rebalance_window_s'] = self.rebalance_window_s
+            if self.morph_hysteresis != 0.25:
+                roles['morph_hysteresis'] = self.morph_hysteresis
             config['roles'] = roles
         if self.explicit_routers:
             routers: Dict[str, Any] = {'replicas': self.router_replicas}

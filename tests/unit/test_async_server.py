@@ -229,3 +229,28 @@ def test_async_429_and_retry_after_on_full_queue():
     finally:
         shutdown()
         srv.close()
+
+
+def test_role_budget_on_async_front(cb_server):
+    """The asyncio front serves the same /role_budget contract as the
+    threaded one: a same-role push is a rebalance (applied, NOT a
+    morph); bad payloads are 400s."""
+    srv, port = cb_server
+    url = f'http://127.0.0.1:{port}'
+    try:
+        resp = requests.post(url + '/role_budget',
+                             json={'split': 0.9, 'version': 1},
+                             timeout=10)
+        assert resp.status_code == 200, resp.text
+        body = resp.json()
+        assert body['applied'] is True
+        assert body['morphed'] is False  # same role: rebalance
+        assert body['role'] == srv.role
+        assert body['budget']['split'] == 0.9
+        assert requests.post(url + '/role_budget',
+                             json={'role': 'training'},
+                             timeout=10).status_code == 400
+    finally:
+        # Re-open the shared fixture unclamped for later tests.
+        requests.post(url + '/role_budget',
+                      json={'split': 0.5, 'version': 2}, timeout=10)

@@ -453,6 +453,164 @@ class TestBoundedAdmission:
             eng.stop()
 
 
+class TestRoleBudgets:
+    """Fractional-role budgets (dynamic co-location): derivation pins,
+    version-ordered swaps, and smooth-WRR admission order under
+    mid-stream budget flips."""
+
+    def test_budget_derivation_pins(self):
+        RoleBudget = batching_engine.RoleBudget
+        mixed = RoleBudget.from_split(0.5, slots=8, prefill_chunk=16)
+        # The mixed default is BOTH phases unclamped — byte-identical
+        # to the pre-budget engine.
+        assert (mixed.prefill_tokens, mixed.decode_tokens) == (16, 8)
+        prefill = RoleBudget.for_role('prefill', slots=8,
+                                      prefill_chunk=16)
+        assert (prefill.prefill_tokens, prefill.decode_tokens) == (16, 1)
+        dec = RoleBudget.for_role('decode', slots=8, prefill_chunk=16)
+        assert (dec.prefill_tokens, dec.decode_tokens) == (1, 8)
+        # Budgets throttle, they never deadlock: both floors are 1.
+        floor = RoleBudget(prefill_tokens=0, decode_tokens=-3)
+        assert (floor.prefill_tokens, floor.decode_tokens) == (1, 1)
+        with pytest.raises(ValueError, match='Unknown role'):
+            RoleBudget(prefill_tokens=1, decode_tokens=1,
+                       role='training')
+
+    def test_role_helpers_pinned(self):
+        """Satellite pin: roles.py is the ONE place role strings are
+        normalized; every `r.get('role') or 'mixed'` went through it."""
+        from skypilot_tpu.serve import roles
+        assert roles.ROLES == ('prefill', 'decode', 'mixed')
+        assert roles.DEFAULT_ROLE == 'mixed'
+        assert roles.normalize(None) == 'mixed'
+        assert roles.normalize('') == 'mixed'
+        assert roles.normalize('prefill') == 'prefill'
+        with pytest.raises(ValueError):
+            roles.normalize('training')
+        assert roles.role_of({}) == 'mixed'
+        assert roles.role_of({'role': None}) == 'mixed'
+        assert roles.role_of({'role': 'decode'}) == 'decode'
+        assert roles.DEFAULT_SPLITS == {'prefill': 1.0, 'decode': 0.0,
+                                        'mixed': 0.5}
+
+    def test_version_ordered_swaps(self):
+        from skypilot_tpu.serve import scheduler
+        queue = scheduler.AdmissionQueue()
+        assert queue.set_role_budget(scheduler.RoleBudget.for_role(
+            'decode', slots=4, prefill_chunk=16, version=5))
+        # A stale rebalance POST must never undo a newer morph.
+        assert not queue.set_role_budget(scheduler.RoleBudget.for_role(
+            'prefill', slots=4, prefill_chunk=16, version=3))
+        assert queue.role_budget.role == 'decode'
+        swaps = queue.budget_swaps
+        assert queue.set_role_budget(scheduler.RoleBudget.for_role(
+            'mixed', slots=4, prefill_chunk=16, version=5))
+        assert queue.budget_swaps == swaps + 1
+        # None (unclamp) always applies — the escape hatch is never
+        # version-gated.
+        assert queue.set_role_budget(None)
+        assert queue.role_budget is None
+        assert queue.admission_allowed(10**6)
+        assert queue.prefill_tokens_per_tick(512) == 512
+
+    def test_admission_gate_and_prefill_clamp(self):
+        from skypilot_tpu.serve import scheduler
+        queue = scheduler.AdmissionQueue()
+        queue.set_role_budget(scheduler.RoleBudget(
+            prefill_tokens=4, decode_tokens=2))
+        assert queue.admission_allowed(0)
+        assert queue.admission_allowed(1)
+        assert not queue.admission_allowed(2)  # cap reached
+        assert queue.prefill_tokens_per_tick(16) == 4
+        # The budget can only SHRINK the configured chunk.
+        assert queue.prefill_tokens_per_tick(2) == 2
+
+    def test_wrr_order_survives_midstream_budget_flips(self):
+        """Satellite: smooth-WRR admission under mid-stream budget
+        flips — every queued request is admitted exactly once (no
+        double-admission), both QoS classes keep popping (no
+        starvation), and the replayed qos_request journal passes the
+        qos_fairness invariant."""
+        from skypilot_tpu.chaos import invariants
+        from skypilot_tpu.serve import scheduler
+        queue = scheduler.AdmissionQueue()
+        ids = []
+        for cls, prefix in (('interactive', 'i'), ('batch', 'b')):
+            for i in range(8):
+                rid = f'{prefix}{i}'
+                queue.submit(scheduler.Request(
+                    [1, 2], 2, None, request_id=rid, qos_class=cls))
+                ids.append(rid)
+        flips = [scheduler.RoleBudget.for_role('prefill', slots=4,
+                                               prefill_chunk=16),
+                 scheduler.RoleBudget.for_role('decode', slots=4,
+                                               prefill_chunk=16),
+                 None]
+        popped = []
+        events = []
+        busy = 0
+        for step in range(200):
+            if not popped or len(popped) % 3 == 0:
+                # Mid-stream flip: a rebalance push lands between
+                # admissions; queued requests must neither vanish nor
+                # be admitted twice.
+                assert queue.set_role_budget(flips[step % 3])
+            if not queue.admission_allowed(busy):
+                busy = 0  # a tick passes; slots all free
+                continue
+            request = queue.pop()
+            if request is None:
+                break
+            queue.record_admission(request)
+            popped.append((request.request_id, request.qos_class))
+            busy += 1
+            weight = 4 if request.qos_class == 'interactive' else 1
+            events.append({'event': 'qos_request_start', 'ts': step,
+                           'request_id': request.request_id,
+                           'qos_class': request.qos_class,
+                           'weight': weight})
+            events.append({'event': 'qos_request_end', 'ts': step,
+                           'request_id': request.request_id,
+                           'qos_class': request.qos_class,
+                           'status': 'ok'})
+        # No starvation, no double-admission: all 16 admitted, once.
+        assert sorted(r for r, _ in popped) == sorted(ids)
+        assert len(popped) == len(set(r for r, _ in popped)) == 16
+        # Smooth interleave: the batch class pops well before the
+        # interactive backlog drains (4:1 weights, not segregated).
+        first_batch = next(i for i, (_, c) in enumerate(popped)
+                           if c == 'batch')
+        last_interactive = max(i for i, (_, c) in enumerate(popped)
+                               if c == 'interactive')
+        assert first_batch < 5
+        assert first_batch < last_interactive
+        assert invariants.check(events, ['qos_fairness']) == []
+
+    def test_engine_token_exact_under_budget_flips(self, setup):
+        """Budgets clamp PACING only: flipping prefill->decode->mixed
+        mid-stream changes when tokens are produced, never which."""
+        cfg, params = setup
+        RoleBudget = batching_engine.RoleBudget
+        eng = batching_engine.ContinuousBatchingEngine(
+            cfg, params, max_len=64, slots=2)
+        try:
+            prompts = [[i + 1, i + 2, i + 3, i + 4] for i in range(5)]
+            requests = [eng.submit(p, 4) for p in prompts]
+            for version, role in enumerate(
+                    ('decode', 'prefill', 'mixed')):
+                assert eng.set_role_budget(RoleBudget.for_role(
+                    role, slots=2, prefill_chunk=512,
+                    version=version))
+            for p, r in zip(prompts, requests):
+                assert r.result(timeout=240) == _reference(
+                    cfg, params, p, 4)
+            stats = eng.stats()
+            assert stats['budget_swaps'] >= 3
+            assert stats['role_budget']['role'] == 'mixed'
+        finally:
+            eng.stop()
+
+
 def test_legacy_mode_parity(setup):
     """pipelined=False keeps the pre-change loop (bench baseline):
     still token-exact vs decode.generate."""

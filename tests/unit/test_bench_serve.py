@@ -10,6 +10,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 _REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 
@@ -127,3 +129,53 @@ def test_bench_serve_smoke(tmp_path):
     sp = data['sp_prefill']
     assert sp['per_hosts']['1']['prefill_s'] > 0, sp
     assert sp['prefill_speedup_2x'] >= 1.05, sp
+    # Dynamic fractional role budgets (ISSUE 17): one replica serves a
+    # prefill burst that flips into a decode burst.  Rebalanced
+    # budgets (prefill-leaning, then flipped in place mid-window) must
+    # out-produce the BEST static pure-role pin on in-window tokens —
+    # whichever pure role you choose, the other phase starves at its
+    # 1-token liveness floor.  Observed ~1.4-1.8x on the CI box; 1.2x
+    # is the flake-proof floor.  Budgets may reschedule work but never
+    # change tokens: the non-contended replay must match exactly.
+    # (The smoke pins only the prefill-leaning static — empirically the
+    # stronger baseline on this mix; the slow full A/B measures the
+    # decode pin too and scores dynamic against the best of both.)
+    dyn = data['dynamic_roles']
+    assert dyn['outputs_match'] is True, dyn
+    assert dyn['dynamic']['budget_swaps'] >= 2, dyn
+    for config in ('static_prefill', 'dynamic'):
+        assert dyn[config]['in_window_tokens'] > 0, dyn
+        assert dyn[config]['requests'] > 0, dyn
+    assert dyn['in_window_tokens_ratio'] >= 1.2, dyn
+
+
+@pytest.mark.slow
+def test_bench_dynamic_roles_full(tmp_path):
+    """The full (non-smoke) dynamic-roles A/B: longer windows, longer
+    prompts/generations — the committed BENCH_serve.json section.
+    Slow-marked; tier-1 runs the seconds-scale smoke floor above."""
+    out_path = os.path.join(str(tmp_path), 'BENCH_dyn_roles.json')
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO_ROOT, 'bench_serve.py'),
+         '--skip-legacy', '--skip-stall-probe', '--skip-paged-probes',
+         '--skip-disagg-probe', '--skip-spec-probe',
+         '--skip-kernel-probe', '--skip-sp-probe', '--out', out_path],
+        cwd=_REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=900, check=False)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(out_path, encoding='utf-8') as f:
+        data = json.load(f)
+    dyn = data['dynamic_roles']
+    assert dyn['outputs_match'] is True, dyn
+    # Full run measures BOTH pure-role pins; the ratio is vs the best.
+    assert dyn['static_decode']['in_window_tokens'] > 0, dyn
+    assert dyn['best_static_in_window_tokens'] == max(
+        dyn['static_prefill']['in_window_tokens'],
+        dyn['static_decode']['in_window_tokens']), dyn
+    assert dyn['in_window_tokens_ratio'] >= 1.2, dyn
+    # The decode burst is where budget-matching pays: the in-place
+    # flip must clearly beat the prefill-pinned replica there.
+    assert dyn['dynamic']['decode_phase_tokens'] > \
+        1.5 * dyn['static_prefill']['decode_phase_tokens'], dyn

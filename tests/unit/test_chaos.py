@@ -604,6 +604,29 @@ class TestScenarios:
         assert len(result.details['lb_retires']) == 2
         assert 'ok' in result.details['prefix_handoffs']
 
+    def test_workload_flip_morph(self, local_infra):
+        """ISSUE 17 acceptance: an adversarial all-prefill ->
+        all-decode workload flip under live traffic is absorbed by a
+        LIVE role morph — the prefill replica joins the decode pool
+        without restart, ZERO non-2xx, ITL p99 stays bounded, the DB
+        role and /health track the flip, and journal replay
+        (drain_no_lost_requests + qos_fairness) proves the epoch-
+        stamped retire nudge kept every router off the replica
+        mid-flip with no request lost or double-executed."""
+        result = scenarios_lib.run_scenario('workload_flip_morph',
+                                            seed=17)
+        assert result.ok, (result.violations, result.details)
+        assert result.details['statuses'] == [200]
+        assert result.details['requests'] >= 20
+        assert result.details['morphed'] is True
+        assert result.details['db_role'] == 'decode'
+        assert result.details['health_role'] == 'decode'
+        assert result.details['health_draining'] is False
+        assert ('prefill', 'decode', 'ok') in \
+            result.details['morph_ends']
+        assert result.details['itl_p99_s'] <= 2.5
+        assert result.details['post_morph_routes'] >= 1
+
     def test_router_instance_death(self, local_infra):
         """ISSUE 15 acceptance: one router of a two-router tier is
         killed mid-traffic -> the hash ring re-homes its prefix keys

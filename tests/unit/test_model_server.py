@@ -450,3 +450,69 @@ def test_generate_text_byte_tokenizer():
     finally:
         shutdown()
         server.close()
+
+
+def test_role_budget_requires_continuous_batching(server):
+    """/role_budget on a non-CB server is a clean 400, not a 500."""
+    _, port = server
+    resp = requests.post(f'http://127.0.0.1:{port}/role_budget',
+                         json={'split': 0.5}, timeout=10)
+    assert resp.status_code == 400
+
+
+def test_role_budget_morph_round_trip():
+    """POST /role_budget (threaded front): a morph commit flips the
+    advertised role WITHOUT restart, a stale push is dropped, and a
+    resume push re-opens a draining replica under its old role."""
+    srv = model_server.ModelServer('tiny', max_len=64, max_batch=2,
+                                   continuous_batching=True,
+                                   role='prefill')
+    port, shutdown = model_server.start_background(srv)
+    url = f'http://127.0.0.1:{port}'
+    try:
+        resp = requests.post(url + '/role_budget',
+                             json={'role': 'decode', 'version': 1},
+                             timeout=10)
+        assert resp.status_code == 200, resp.text
+        body = resp.json()
+        assert body['applied'] is True
+        assert body['morphed'] is True
+        assert body['role'] == 'decode'
+        assert body['budget']['decode_tokens'] == 2
+        # /health advertises the new role live (the CLI ROLE column
+        # and the controller's scrape targets read this).
+        health = requests.get(url + '/', timeout=10).json()
+        assert health['role'] == 'decode'
+        assert health['engine']['role_budget']['role'] == 'decode'
+        # Stale push (older version) is dropped: role keeps.
+        resp = requests.post(url + '/role_budget',
+                             json={'role': 'prefill', 'version': 0},
+                             timeout=10)
+        assert resp.json()['applied'] is False
+        assert resp.json()['role'] == 'decode'
+        # Unknown role / malformed version are 400s.
+        assert requests.post(url + '/role_budget',
+                             json={'role': 'training'},
+                             timeout=10).status_code == 400
+        assert requests.post(url + '/role_budget',
+                             json={'version': 'nope'},
+                             timeout=10).status_code == 400
+        # Warm weights kept: generation still works post-morph.
+        g = requests.post(url + '/generate',
+                          json={'prompt_ids': [[3, 5]],
+                                'max_new_tokens': 3}, timeout=120)
+        assert g.status_code == 200, g.text
+        # Aborted-morph rollback: /drain parks the server; a resume
+        # push under the SAME role re-opens it.
+        requests.post(url + '/drain', json={}, timeout=10)
+        assert requests.get(url + '/',
+                            timeout=10).json()['draining'] is True
+        resp = requests.post(url + '/role_budget',
+                             json={'role': 'decode', 'resume': True,
+                                   'version': 2}, timeout=10)
+        assert resp.json()['draining'] is False
+        assert requests.get(url + '/',
+                            timeout=10).json()['draining'] is False
+    finally:
+        shutdown()
+        srv.close()

@@ -104,6 +104,44 @@ class TestServiceSpec:
         with pytest.raises(Exception):
             SkyServiceSpec(roles={'gpu': {'replicas': 1}})
 
+    def test_dynamic_roles_round_trip(self):
+        spec = SkyServiceSpec.from_yaml_config({
+            'roles': {
+                'dynamic': True,
+                'rebalance_window_s': 15,
+                'morph_hysteresis': 0.3,
+                'prefill': {'replicas': 1},
+                'decode': {'replicas': 1},
+            },
+        })
+        assert spec.dynamic_roles
+        assert spec.rebalance_window_s == 15.0
+        assert spec.morph_hysteresis == 0.3
+        # The reserved keys are NOT pools.
+        assert set(spec.role_specs) == {'prefill', 'decode'}
+        spec2 = SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+        assert spec2.dynamic_roles
+        assert spec2.rebalance_window_s == 15.0
+        assert spec2.morph_hysteresis == 0.3
+        # Defaults stay off and off the YAML.
+        plain = SkyServiceSpec.from_yaml_config(
+            {'roles': {'mixed': {'replicas': 1}}})
+        assert not plain.dynamic_roles
+        out = plain.to_yaml_config()
+        assert 'dynamic' not in out.get('roles', {})
+
+    def test_dynamic_roles_validation(self):
+        with pytest.raises(Exception):
+            SkyServiceSpec(roles={'dynamic': True,
+                                  'rebalance_window_s': 0,
+                                  'mixed': {'replicas': 1}})
+        with pytest.raises(Exception):
+            SkyServiceSpec(roles={'morph_hysteresis': 1.5,
+                                  'mixed': {'replicas': 1}})
+        with pytest.raises(Exception):
+            # Tuning keys alone don't make a fleet.
+            SkyServiceSpec(roles={'dynamic': True})
+
     def test_per_role_autoscalers_independent(self):
         spec = SkyServiceSpec.from_yaml_config({
             'roles': {
@@ -518,3 +556,72 @@ class TestAutoscalerCarryOver:
                   target_qps_per_replica=1.0))
         small.carry_over(old)
         assert small.target_num_replicas == 3
+
+
+class TestFleetRebalancer:
+    """ISSUE 17: reconcile-loop rebalancer — windowed prefill-share
+    signal -> fractional budget push to mixed replicas, journaled as a
+    role_rebalance pair."""
+
+    def test_rebalance_pushes_fractional_split(self, monkeypatch):
+        from skypilot_tpu.observability import events as events_lib
+        from skypilot_tpu.serve import model_server as model_server_lib
+
+        task = _serve_task(name='svc-dyn',
+                           roles={'dynamic': True,
+                                  'mixed': {'replicas': 1}})
+        _register_service(task, 'svc-dyn')
+        controller = SkyServeController('svc-dyn')
+        srv = model_server_lib.ModelServer(
+            'tiny', max_len=64, max_batch=4, continuous_batching=True)
+        port, shutdown = model_server_lib.start_background(srv)
+        url = f'http://127.0.0.1:{port}'
+        try:
+            rid = serve_state.allocate_replica('svc-dyn', 'svc-dyn')
+            serve_state.set_replica_status(
+                'svc-dyn', rid, ReplicaStatus.READY, url=url)
+            monkeypatch.setenv('SKYTPU_SERVE_REBALANCE_WINDOW_S',
+                               '0.01')
+            # Prefill-heavy demand: 9:1 -> share 0.9 (split clamps
+            # keep both phases alive; morphing handles the rest).
+            monkeypatch.setattr(
+                controller.aggregator, 'role_signals',
+                lambda role: {'qps': {'prefill': 9.0, 'decode': 1.0,
+                                      'mixed': 0.0}[role]})
+            t0 = time.time()
+            controller._rebalance_fleet()  # pylint: disable=protected-access
+            health = requests.get(url + '/', timeout=10).json()
+            budget = health['engine']['role_budget']
+            assert budget is not None
+            assert budget['role'] == 'mixed'
+            assert budget['split'] == 0.9
+            journal = events_lib.get_journal(os.path.join(
+                events_lib.journal_root(), 'serve.jsonl'))
+            events = [e for e in journal.read()
+                      if e.get('ts', 0) >= t0 and
+                      str(e.get('event', '')).startswith(
+                          'role_rebalance')]
+            assert [e['event'] for e in events] == \
+                ['role_rebalance_start', 'role_rebalance_end']
+            assert events[-1]['status'] == 'ok'
+            assert events[-1]['pushed'] == 1
+            assert events[-1]['prefill_share'] == 0.9
+            # Window gate: an immediate second pass is a no-op.
+            monkeypatch.setenv('SKYTPU_SERVE_REBALANCE_WINDOW_S',
+                               '3600')
+            t1 = time.time()
+            controller._rebalance_fleet()  # pylint: disable=protected-access
+            assert not [e for e in journal.read()
+                        if e.get('ts', 0) >= t1 and
+                        e.get('event') == 'role_rebalance_start']
+            # And the master switch: env 0 wins over the spec flag.
+            monkeypatch.setenv('SKYTPU_SERVE_REBALANCE_WINDOW_S',
+                               '0.01')
+            monkeypatch.setenv('SKYTPU_SERVE_DYNAMIC_ROLES', '0')
+            controller._rebalance_fleet()  # pylint: disable=protected-access
+            assert not [e for e in journal.read()
+                        if e.get('ts', 0) >= t1 and
+                        e.get('event') == 'role_rebalance_start']
+        finally:
+            shutdown()
+            srv.close()

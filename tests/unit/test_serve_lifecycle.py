@@ -758,3 +758,68 @@ class TestDisconnectReap:
         self._hang_up(server, port, 'disc-async-1',
                       {'X-SkyTPU-Request-Id': 'disc-async-1',
                        'Connection': 'close'})
+
+
+# ------------------------------------------------------------ role morph
+
+
+class TestRoleMorph:
+    """ISSUE 17 state layer: the DB role column tracks live morphs,
+    and a failed budget commit rolls the replica back instead of
+    wedging it DRAINING."""
+
+    def test_set_replica_role_pins_db(self):
+        serve_state.add_service('svc-role', spec_json={},
+                                task_yaml_path='')
+        rid = serve_state.allocate_replica('svc-role', 'svc-role',
+                                           role='prefill')
+        assert serve_state.get_replicas('svc-role')[0]['role'] == \
+            'prefill'
+        serve_state.set_replica_role('svc-role', rid, 'decode')
+        assert serve_state.get_replicas('svc-role')[0]['role'] == \
+            'decode'
+
+    def test_morph_rollback_when_budget_push_not_applied(self):
+        """The stub accepts /drain and /role_budget but never answers
+        `applied: true` -> the commit fails, the morph journals
+        status=error, and the replica is re-opened READY in its OLD
+        role (never stuck DRAINING)."""
+        manager, _ = _make_manager('svc-morph')
+        url, _set, shutdown = _stub_replica(
+            {'status': 'ok', 'engine': {'busy_slots': 0,
+                                        'queued_requests': 0}})
+        try:
+            rid = serve_state.allocate_replica('svc-morph',
+                                               'svc-morph',
+                                               role='prefill')
+            serve_state.set_replica_status(
+                'svc-morph', rid, ReplicaStatus.READY, url=url)
+            t0 = time.time()
+            assert manager.morph_replica(rid, 'decode',
+                                         timeout_s=2) is False
+            row = serve_state.get_replicas('svc-morph')[0]
+            assert row['status'] == ReplicaStatus.READY.value
+            assert row['role'] == 'prefill'  # commit never landed
+            ends = [e for e in _serve_events()
+                    if e.get('ts', 0) >= t0 and
+                    e.get('event') == 'role_morph_end']
+            assert len(ends) == 1
+            assert ends[0]['status'] == 'error'
+            assert (ends[0]['from_role'], ends[0]['to_role']) == \
+                ('prefill', 'decode')
+        finally:
+            shutdown()
+
+    def test_morph_noops(self):
+        """Same-role and non-READY morphs are refused outright —
+        before the drain machinery ever engages."""
+        manager, _ = _make_manager('svc-noop')
+        rid = serve_state.allocate_replica('svc-noop', 'svc-noop',
+                                           role='decode')
+        serve_state.set_replica_status(
+            'svc-noop', rid, ReplicaStatus.READY,
+            url='http://127.0.0.1:1')
+        assert manager.morph_replica(rid, 'decode') is False
+        serve_state.set_replica_status('svc-noop', rid,
+                                       ReplicaStatus.STARTING)
+        assert manager.morph_replica(rid, 'prefill') is False
