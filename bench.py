@@ -220,6 +220,23 @@ def main() -> None:
     print(f'# device={dev.device_kind} config={config_name} '
           f'params={n_params/1e6:.1f}M mfu={mfu:.3f} '
           f'loss={final_loss:.3f}', file=sys.stderr)
+    # Perf-regression observatory: one record per run (sky bench diff
+    # compares against the committed history with noise-aware
+    # thresholds, finally grounding vs_baseline in our own trajectory).
+    try:
+        from skypilot_tpu.observability import bench_history
+        bench_history.append_record({
+            'source': 'bench',
+            'metric': _METRIC,
+            'value': round(best_tps, 1),
+            'unit': 'tokens/s',
+            'config': {'model': config_name,
+                       'device': dev.device_kind},
+            'tokens_per_s': round(best_tps, 1),
+            'mfu_estimate': round(mfu, 4),
+        })
+    except Exception as e:  # pylint: disable=broad-except
+        print(f'# bench history append failed: {e}', file=sys.stderr)
     if on_tpu:
         # Feed the optimizer's fungibility prior with the measured MFU
         # (utils/throughput_registry; VERDICT r2 weak #8).

@@ -1239,6 +1239,7 @@ def main() -> None:
             obs_metrics.start_exposition_server())
 
     results: Dict[str, Any] = {}
+    profile_snapshot: Optional[Dict[str, Any]] = None
     for mode, pipelined in (('pipelined', True), ('legacy', False)):
         if mode == 'legacy' and args.skip_legacy:
             continue
@@ -1271,6 +1272,10 @@ def main() -> None:
             if scraper is not None:
                 scraper.join()
                 scrape_samples.append(_scrape_metrics(metrics_port))
+            if mode == 'pipelined':
+                # Tick-phase attribution for the history record (the
+                # perf-regression observatory keys breakdowns to runs).
+                profile_snapshot = eng.profile()
         finally:
             eng.stop()
         results[mode] = result
@@ -1399,6 +1404,53 @@ def main() -> None:
     print(line)
     with open(out_path, 'w', encoding='utf-8') as f:
         f.write(line + '\n')
+    _append_history(args, payload, profile_snapshot)
+
+
+def _append_history(args, payload: Dict[str, Any],
+                    profile_snapshot: Optional[Dict[str, Any]]) -> None:
+    """One run record into the perf-regression observatory
+    (BENCH_history.jsonl; `sky bench diff` consumes it).  The
+    COMMITTED history only grows behind --pin (a blessed run) or an
+    explicit SKYTPU_BENCH_HISTORY_PATH — tier-1 runs this script
+    (smoke AND full probes) on every pass and must not churn the
+    repo; unblessed runs land in a throwaway per-process path."""
+    import os
+    import tempfile
+
+    from skypilot_tpu.observability import bench_history
+    path = None
+    if (not args.pin and
+            not os.environ.get('SKYTPU_BENCH_HISTORY_PATH')):
+        path = os.path.join(
+            tempfile.gettempdir(),
+            f'bench_serve_history-{os.getpid()}.jsonl')
+    pipelined = payload.get('pipelined') or {}
+    phases = None
+    if profile_snapshot:
+        phases = {name: agg.get('total_s')
+                  for name, agg in
+                  (profile_snapshot.get('phases') or {}).items()}
+    record = {
+        'source': 'bench_serve',
+        'metric': payload['metric'],
+        'value': payload['value'],
+        'unit': payload['unit'],
+        'config': payload['config'],
+        'tokens_per_s': pipelined.get('tokens_per_s'),
+        'ttft_p50_ms': pipelined.get('ttft_p50_ms'),
+        'ttft_p99_ms': pipelined.get('ttft_p99_ms'),
+        'itl_p50_ms': pipelined.get('itl_p50_ms'),
+        'itl_p99_ms': pipelined.get('itl_p99_ms'),
+        'speedup_vs_legacy': payload.get('speedup_vs_legacy'),
+        'phases': phases,
+        'profiled_ticks': (profile_snapshot or {}).get('ticks'),
+    }
+    try:
+        where = bench_history.append_record(record, path)
+        print(f'# bench history appended: {where}')
+    except OSError as e:
+        print(f'# bench history append failed: {e}')
 
 
 if __name__ == '__main__':

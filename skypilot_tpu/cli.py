@@ -1124,6 +1124,99 @@ def serve_trace(request_id, service_name, export_trace):
                    '(open in chrome://tracing or Perfetto).')
 
 
+@serve_group.command(name='profile')
+@click.argument('service_name', required=False, default=None)
+@click.option('--replica', '-R', 'replica_id', type=int, default=None,
+              help='Only this replica (default: every reachable one).')
+@click.option('--export-trace', 'export_trace', default=None,
+              help='Write the tick-phase ring as Chrome-trace JSON '
+                   'to this path (chrome://tracing / Perfetto).')
+def serve_profile(service_name, replica_id, export_trace):
+    """Tick-phase profile of a service's replicas.
+
+    Pulls each replica's `GET /profile` payload — the engine's bounded
+    ring of per-tick phase timings (admit / prefill-chunk / decode-step
+    / spec-verify / sample / page-scatter / handoff / slice-sync), the
+    recompile sentinel's per-jit-entry compile counts, and
+    device-memory watermarks — and renders per-phase quantiles plus a
+    collapsed-stack summary (pipe into a flamegraph tool)."""
+    import json  # pylint: disable=import-outside-toplevel
+
+    import requests  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu import serve  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.observability import profiling  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import http_protocol  # pylint: disable=import-outside-toplevel
+    record = _pick_service(
+        serve.status([service_name] if service_name else None),
+        service_name)
+    targets, _ = _trace_targets(record)
+    if replica_id is not None:
+        targets = [t for t in targets
+                   if t['replica_id'] == replica_id]
+    if not targets:
+        raise click.ClickException(
+            f'Service {record["name"]} has no reachable replica'
+            + (f' {replica_id}.' if replica_id is not None else 's.'))
+    profiles = []
+    for target in targets:
+        try:
+            resp = requests.get(
+                target['url'].rstrip('/') + http_protocol.PROFILE,
+                timeout=5)
+            resp.raise_for_status()
+            payload = resp.json()
+        except (requests.RequestException, ValueError) as e:
+            click.echo(f'replica {target["replica_id"]}: '
+                       f'unreachable ({e})')
+            continue
+        if payload.get('profile'):
+            profiles.append((target, payload['profile']))
+    if not profiles:
+        raise click.ClickException('No replica answered /profile with '
+                                   'a profiling snapshot.')
+    trace_events = []
+    for target, snap in profiles:
+        rid = target['replica_id']
+        click.echo(f'Replica {rid} ({target.get("role") or "mixed"}) — '
+                   f'{snap.get("ticks", 0)} profiled tick(s), ring '
+                   f'{snap.get("ring_ticks")}:')
+        rows = []
+        for phase, agg in sorted((snap.get('phases') or {}).items()):
+            def ms(v):
+                return '-' if v is None else f'{v * 1e3:.3f}ms'
+            rows.append((phase, agg.get('count', 0),
+                         ms(agg.get('p50_s')), ms(agg.get('p99_s')),
+                         ms(agg.get('max_s')),
+                         f"{agg.get('total_s', 0.0) * 1e3:.1f}ms"))
+        if rows:
+            _print_table(['PHASE', 'COUNT', 'p50', 'p99', 'MAX',
+                          'TOTAL'], rows)
+        recomp = (snap.get('recompiles') or {})
+        total_recompiles = recomp.get('steady_recompiles_total', 0)
+        click.echo(f'  steady-state recompiles: {total_recompiles}')
+        for fn, st in sorted((recomp.get('fns') or {}).items()):
+            if st.get('steady_recompiles'):
+                click.echo(f'    {fn}: {st["steady_recompiles"]} '
+                           f'(compiles {st["compiles"]}, calls '
+                           f'{st["calls"]})')
+        mem = (snap.get('device_memory') or {}).get('watermark_bytes')
+        if mem is not None:
+            click.echo(f'  device memory watermark: {mem / 1e6:.1f} MB')
+        click.echo('  collapsed stacks:')
+        for line in profiling.collapsed_stacks(snap).splitlines():
+            click.echo(f'    {line}')
+        trace = profiling.chrome_trace(snap, pid=int(rid))
+        trace_events.extend(trace['traceEvents'])
+        click.echo('')
+    if export_trace:
+        with open(export_trace, 'w', encoding='utf-8') as f:
+            json.dump({'traceEvents': trace_events,
+                       'displayTimeUnit': 'ms'}, f)
+        click.echo(f'Chrome trace written to {export_trace} '
+                   '(open in chrome://tracing or Perfetto).')
+
+
 def _sparkline(values, empty: str = '-') -> str:
     """Unicode sparkline of a binned series (None bins render as a
     space); scaled to the series max."""
@@ -1166,12 +1259,28 @@ def _fetch_telemetry(record) -> Optional[Dict[str, Any]]:
         return None
 
 
+def _fmt_tick_breakdown(phases: Optional[Dict[str, float]],
+                        top: int = 2) -> str:
+    """Compact `phase NN%` summary of a replica's tick-phase rates
+    (the dominant `top` phases, shares of the recorded total)."""
+    if not phases:
+        return '-'
+    total = sum(v for v in phases.values() if v) or 0.0
+    if total <= 0:
+        return '-'
+    ranked = sorted(phases.items(), key=lambda kv: -(kv[1] or 0.0))
+    return ' '.join(f'{name} {100.0 * (v or 0.0) / total:.0f}%'
+                    for name, v in ranked[:top])
+
+
 def _render_top(records, telemetry_by_service) -> None:
     """One `serve top` frame from already-fetched data (pure render —
     tests drive this directly)."""
     for r in records:
         telemetry = telemetry_by_service.get(r['name']) or {}
         mfu = telemetry.get('mfu') or {}
+        breakdown = telemetry.get('tick_breakdown') or {}
+        recompiles = telemetry.get('recompiles') or {}
         ready = sum(1 for rep in r['replicas']
                     if rep['status'] == 'READY')
         click.echo(f"{r['name']}  [{r['status']}]  v{r['version']}  "
@@ -1186,13 +1295,17 @@ def _render_top(records, telemetry_by_service) -> None:
 
         rows = []
         for rep in r['replicas']:
+            rid = str(rep['replica_id'])
+            recomp = recompiles.get(rid)
             rows.append((rep['replica_id'],
                          rep.get('role') or 'mixed',
                          rep['status'], rep.get('url') or '-',
-                         fmt_mfu(mfu.get(str(rep['replica_id'])))))
+                         fmt_mfu(mfu.get(rid)),
+                         _fmt_tick_breakdown(breakdown.get(rid)),
+                         '-' if recomp is None else f'{recomp:g}'))
         if rows:
-            _print_table(['REPLICA', 'ROLE', 'STATUS', 'URL', 'MFU'],
-                         rows)
+            _print_table(['REPLICA', 'ROLE', 'STATUS', 'URL', 'MFU',
+                          'TICK-BREAKDOWN', 'RECOMPILES'], rows)
         roles = telemetry.get('roles') or {}
         if roles:
             click.echo('')
@@ -1357,6 +1470,50 @@ def bench_delete(benchmark, yes):
                       abort=True)
     benchmark_state.remove_benchmark(benchmark)
     click.echo('Deleted.')
+
+
+@bench_group.command(name='diff')
+@click.option('--last', 'last_n', type=int, default=None,
+              help='Baseline only the last N prior runs of each '
+                   'group (default: all of them).')
+@click.option('--history', 'history_file', default=None,
+              help='History file (default: BENCH_history.jsonl at the '
+                   'repo root, or SKYTPU_BENCH_HISTORY_PATH).')
+@click.option('--min-rel', type=float,
+              default=None, help='Minimum relative move that can '
+              'count as a regression (default 0.10).')
+def bench_diff(last_n, history_file, min_rel):
+    """Diff the newest bench run of each (metric, config) group
+    against its history with noise-aware thresholds.
+
+    `bench.py` / `bench_serve.py` append one record per run to
+    BENCH_history.jsonl; this compares throughput, latency quantiles,
+    and MFU against the baseline runs and **exits non-zero when any
+    key moved past ``max(min_rel, 3 x cv)`` in the bad direction** —
+    wire it after a bench run for a perf-regression gate."""
+    from skypilot_tpu.observability import bench_history  # pylint: disable=import-outside-toplevel
+    records = bench_history.load_records(history_file)
+    if not records:
+        raise click.ClickException(
+            f'No bench history at '
+            f'{bench_history.history_path(history_file)} — run '
+            f'bench_serve.py / bench.py first.')
+    kwargs = {}
+    if min_rel is not None:
+        kwargs['min_rel'] = min_rel
+    findings = bench_history.diff_records(records, last=last_n,
+                                          **kwargs)
+    if not findings:
+        click.echo(f'{len(records)} run(s), but no group has two '
+                   'comparable runs yet — nothing to diff.')
+        return
+    for line in bench_history.format_findings(findings):
+        click.echo(line)
+    regressions = [f for f in findings if f['regression']]
+    if regressions:
+        raise SystemExit(
+            f'{len(regressions)} perf regression(s) detected.')
+    click.echo('No regressions.')
 
 
 # ---------------------------------------------------------- storage group

@@ -365,8 +365,9 @@ class FleetAggregator:
         if kind == 'lb':
             extra = {'process': 'lb'}
         else:
+            role = self._live_role(target, url)
             extra = {'replica_id': str(target.get('replica_id', '')),
-                     'role': roles_lib.role_of(target)}
+                     'role': role}
         for name, by_labels in parsed.items():
             if not name.startswith(_INGEST_PREFIX):
                 continue
@@ -375,11 +376,30 @@ class FleetAggregator:
                 merged.update(extra)
                 self.store.add(name, merged, now, value)
         if kind == 'replica':
-            self._update_mfu(target, parsed)
+            self._update_mfu(target, parsed, role)
             self._scrape_spans(target, url)
 
+    def _live_role(self, target: Dict[str, Any], url: str) -> str:
+        """The replica's CURRENT role, from its health payload.
+
+        Registration-time target labels pin the role a replica was
+        LAUNCHED with; after a live role morph (serve/role_morph.py)
+        the replica answers with its new role while the controller's
+        target dict still says the old one — and every windowed
+        per-role signal (the rebalancer's inputs) would keep flowing
+        into the stale series.  Falls back to the target label when
+        the health probe fails or answers something unparseable."""
+        try:
+            resp = requests.get(url + '/', timeout=self.timeout)
+            live = roles_lib.normalize((resp.json() or {}).get('role'))
+            target['role'] = live   # keep span/top labels in step
+            return live
+        except (requests.RequestException, ValueError, KeyError,
+                TypeError, AttributeError):
+            return roles_lib.role_of(target)
+
     def _update_mfu(self, target: Dict[str, Any],
-                    parsed: Dict[str, Any]) -> None:
+                    parsed: Dict[str, Any], role: str) -> None:
         """skytpu_mfu_estimate{replica_id,role}: decode tokens/s x the
         replica's advertised model FLOPs/token over the slice's
         roofline.  0 when the replica does not advertise FLOPs (user
@@ -393,7 +413,6 @@ class FleetAggregator:
         mfu = (tokens_per_s * flops_per_token /
                (peak_flops() * hosts)) if flops_per_token else 0.0
         rid = str(target.get('replica_id', ''))
-        role = roles_lib.role_of(target)
         _M_MFU.labels(service=self.service_name, replica_id=rid,
                       role=role).set(mfu)
         self.store.add('skytpu_mfu_estimate',
@@ -520,6 +539,32 @@ class FleetAggregator:
         mfu = {labels.get('replica_id'): float(f'{value:.3g}')
                for labels, value in self.store.latest(
                    'skytpu_mfu_estimate')}
+        # Per-replica tick-phase breakdown (seconds of phase time per
+        # wall second over the window; falls back to the cumulative
+        # total until two scrapes land) and steady-state recompile
+        # counts — `sky serve top`'s TICK-BREAKDOWN / RECOMPILES
+        # columns.
+        tick_breakdown: Dict[str, Dict[str, float]] = {}
+        for labels, value in self.store.latest(
+                'skytpu_engine_tick_phase_seconds_sum'):
+            rid = labels.get('replica_id')
+            phase = labels.get('phase')
+            if rid is None or phase is None:
+                continue
+            rate = self.store.counter_rate(
+                'skytpu_engine_tick_phase_seconds_sum',
+                min(60.0, window_s), now, phase=phase, replica_id=rid)
+            tick_breakdown.setdefault(rid, {})[phase] = (
+                rate if rate is not None else value)
+        recompiles: Dict[str, float] = {}
+        for labels, value in self.store.latest(
+                'skytpu_engine_recompiles_total'):
+            rid = labels.get('replica_id')
+            if rid is None:
+                continue
+            recompiles[rid] = recompiles.get(rid, 0.0) + value
         return {'window_s': window_s, 'roles': out_roles, 'mfu': mfu,
+                'tick_breakdown': tick_breakdown,
+                'recompiles': recompiles,
                 'slow_traces': self.slow_traces(),
                 'series_names': self.store.names()}

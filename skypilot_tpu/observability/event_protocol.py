@@ -117,6 +117,13 @@ PAIRS: Tuple[PairedEvents, ...] = (
     # outcome.
     _pair('role_morph', SCOPE_PROCESS, status_field='status',
           statuses=('ok', 'timeout', 'error')),
+    # Continuous profiling (ISSUE 18).  tick_profile brackets one
+    # engine worker incarnation's profiling ring (end guaranteed by
+    # try/finally: 'ok' = drained/stopped, 'error' = the worker died
+    # and failed the engine); recompile_detected is a point event the
+    # sentinel journals alongside it.
+    _pair('tick_profile', SCOPE_INVOCATION, status_field='status',
+          statuses=('ok', 'error')),
 )
 
 BY_NAME: Dict[str, PairedEvents] = {p.name: p for p in PAIRS}

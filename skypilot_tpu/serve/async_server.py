@@ -533,6 +533,11 @@ class AsyncModelServer:
                                 200, self.server.export_spans(
                                     **model_server_lib.parse_span_query(
                                         query))))
+                        elif path == http_protocol.PROFILE:
+                            # Continuous-profiling export (tick-phase
+                            # ring + recompile sentinel).
+                            writer.write(_json_response(
+                                200, self.server.export_profile()))
                         else:
                             code, payload = self._health()
                             writer.write(_json_response(code, payload))

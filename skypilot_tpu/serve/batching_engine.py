@@ -116,6 +116,7 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import profiling
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import cache_manager
 from skypilot_tpu.serve import handoff as handoff_lib
@@ -401,6 +402,23 @@ class ContinuousBatchingEngine:
         # pool cache per admission; donation lets XLA update in place.
         self._insert = jax.jit(decode.insert_prefill,
                                donate_argnums=(0,))
+        # ---- continuous profiling plane (observability/profiling.py).
+        # Tick-phase ring + recompile sentinel; both collapse to no-ops
+        # under SKYTPU_PROFILE_DISABLE.  Every resolved jit entry above
+        # (incl. the Pallas kernel path, a closure constant of _step)
+        # gets the sentinel's O(1) cache-size probe so a steady-state
+        # recompile is counted and journaled instead of silently
+        # stalling ticks.
+        self._profiler = profiling.TickProfiler()
+        self._sentinel = profiling.RecompileSentinel()
+        for attr in ('_step', '_spec_step', '_admit_paged',
+                     '_release_paged', '_insert_pages', '_seed_private',
+                     '_write_pages', '_write_pages_q', '_legacy_step',
+                     '_prefill', '_prefill_chunk', '_insert'):
+            entry = getattr(self, attr, None)
+            if entry is not None:
+                setattr(self, attr,
+                        self._sentinel.wrap(attr.lstrip('_'), entry))
         self._failed: Optional[Exception] = None
 
         # ---- metrics (updated under _metrics_lock; read by stats()).
@@ -809,13 +827,15 @@ class ContinuousBatchingEngine:
         _M_HANDOFF_EXPORTS.inc()
         return holder['result']
 
-    def _drain_host_ops(self) -> None:
+    def _drain_host_ops(self) -> int:
+        ran = 0
         while True:
             with self._host_ops_lock:
                 if not self._host_ops:
-                    return
+                    return ran
                 op = self._host_ops.popleft()
             op()   # no-raise by construction
+            ran += 1
 
     def _drain_estimate(self) -> float:
         """Rough seconds until one queue position frees: backlog size
@@ -892,6 +912,16 @@ class ContinuousBatchingEngine:
         """The finished span record for a request id (None while the
         request is still running or once it aged out of the store)."""
         return self._spans.get(request_id)
+
+    def profile(self) -> Dict[str, Any]:
+        """Continuous-profiling snapshot (what `GET /profile` serves):
+        the tick-phase ring with per-phase quantiles, device-memory
+        watermarks, the profiler's modeled self-overhead, and the
+        recompile sentinel's per-jit-entry compile counts."""
+        snap = self._profiler.snapshot()
+        snap['recompiles'] = self._sentinel.snapshot()
+        snap['pipelined'] = self.pipelined
+        return snap
 
     def set_role_budget(
             self, budget: Optional[scheduler.RoleBudget]) -> bool:
@@ -1161,6 +1191,7 @@ class ContinuousBatchingEngine:
             pending.consumed = start + take
         request.span.mark_prefill_chunk(time.perf_counter() - t_chunk0)
         self._record_chunk()
+        self._profiler.lap('prefill-chunk')
         if pending.consumed < n_target:
             return False
         return self._finish_prefill(pending)
@@ -1200,6 +1231,9 @@ class ContinuousBatchingEngine:
                        int(request.prompt_ids[-1]), n_target,
                        remaining=request.max_new_tokens,
                        key=self._jax.random.PRNGKey(request.seed))
+        # Cache adoption (page scatter / dense insert) + activation:
+        # its own phase so prefill compute and pool surgery separate.
+        self._profiler.lap('page-scatter')
         return True
 
     def _activate(self, slot_id: int, request: scheduler.Request,
@@ -1330,6 +1364,28 @@ class ContinuousBatchingEngine:
         if not self.pipelined:
             self._run_legacy()
             return
+        # Profiling lifecycle: one start/end pair brackets the worker's
+        # whole run so journal replay can attribute the ring's ticks to
+        # an engine incarnation (and see whether it died or drained).
+        prof = self._profiler
+        try:
+            journal = profiling.serve_journal()
+        except Exception:  # pylint: disable=broad-except
+            journal = None
+        if journal is not None:
+            journal.append('tick_profile_start',
+                           ring_ticks=prof.ring_ticks,
+                           enabled=not prof.disabled)
+        try:
+            self._run_pipelined(prof)
+        finally:
+            if journal is not None:
+                journal.append(
+                    'tick_profile_end',
+                    status='error' if self._failed is not None else 'ok',
+                    ticks=prof.ticks)
+
+    def _run_pipelined(self, prof: profiling.TickProfiler) -> None:
         import numpy as np  # pylint: disable=import-outside-toplevel
         # One in-flight tick: (state_handles, finished_handle,
         # [(slot_id, request), ...]) — read one tick behind.
@@ -1339,10 +1395,12 @@ class ContinuousBatchingEngine:
         live: Dict[int, scheduler.Request] = {}  # slot -> decoding req
         while not self._stop.is_set():
             try:
+                prof.begin_tick()
                 self._queue.expire_stale()
                 # Host ops (KV handoff imports) run between ticks: they
                 # mutate self._cache, which only this thread owns.
-                self._drain_host_ops()
+                ran_ops = self._drain_host_ops()
+                prof.lap('handoff', record=bool(ran_ops))
                 # Cancelled or deadline-expired live requests: freeze
                 # their slots on device before the next dispatch, free
                 # them (and their KV pages) for admission.  Deadline
@@ -1372,6 +1430,7 @@ class ContinuousBatchingEngine:
                 # the queue head and waits for pages to free or its
                 # TTL) — it must never fail the engine.
                 deferred = False
+                admitted = False
                 free = [i for i, s in enumerate(self._slots)
                         if not s.active]
                 occupied = len(self._slots) - len(free)
@@ -1385,6 +1444,7 @@ class ContinuousBatchingEngine:
                     request = self._queue.pop()
                     if request is None:
                         break
+                    admitted = True
                     try:
                         pending = self._start_admission(slot_id,
                                                         request)
@@ -1400,6 +1460,12 @@ class ContinuousBatchingEngine:
                     elif self._slots[slot_id].request is not None:
                         live[slot_id] = request
                         occupied += 1
+                # The admit phase is everything since the handoff lap:
+                # stale-expiry, reaps, and the admission loop (minus
+                # any page-scatter laps a full-prefix admission took
+                # inside _finish_prefill — laps are exclusive).
+                prof.lap('admit',
+                         record=bool(admitted or deferred or reaped))
                 # At most ONE prefill chunk between ticks — the bound
                 # on the ITL stall an admission can impose.
                 if pending_prefills:
@@ -1418,11 +1484,13 @@ class ContinuousBatchingEngine:
                     # Speculative mode: synchronous multi-token verify
                     # ticks (see _spec_tick); `inflight` stays empty.
                     self._spec_tick(live)
+                    prof.lap('spec-verify')
                 elif live:
                     self._state, self._cache, finished = (
                         self._dispatch_step())
                     dispatched = (self._state, finished,
                                   list(live.items()))
+                    prof.lap('decode-step')
                 if inflight is not None:
                     state_t, finished_t, snapshot = inflight
                     toks = np.asarray(state_t['tokens'])
@@ -1447,7 +1515,9 @@ class ContinuousBatchingEngine:
                     _M_TICKS.inc()
                     _M_BUSY_SLOTS.set(
                         sum(1 for s in self._slots if s.active))
+                    prof.lap('sample')
                 inflight = dispatched
+                prof.end_tick()
                 if (inflight is None and not live and
                         not pending_prefills):
                     if deferred:

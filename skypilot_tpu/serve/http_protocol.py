@@ -60,11 +60,12 @@ KV_IMPORT = '/kv_import'              # POST: KV handoff, decode side
 DRAIN = '/drain'                      # POST: controller retirement path
 PREFIX_EXPORT = '/prefix_export'      # POST: drain-time sibling handoff
 ROLE_BUDGET = '/role_budget'          # POST: rebalance push / role morph
+PROFILE = '/profile'                  # GET: tick-phase profiling ring
 # Any other GET answers the health/readiness payload (the probe path).
 
 REPLICA_PATHS = (METRICS, SPANS, GENERATE, GENERATE_STREAM,
                  GENERATE_TEXT, PREFILL_EXPORT, KV_IMPORT, DRAIN,
-                 PREFIX_EXPORT, ROLE_BUDGET)
+                 PREFIX_EXPORT, ROLE_BUDGET, PROFILE)
 
 # ------------------------------------------------- LB control plane (the
 # `/lb/` prefix is never proxied; the LB answers these itself)

@@ -83,13 +83,37 @@ _M_PROCESS_INFO = metrics_lib.gauge(
     'skytpu_process_info',
     'Constant 1 carrying this process\'s identity labels '
     '(replica_id / role / num_hosts on serving replicas).')
-# Forward-pass FLOPs per generated token (~2 x params): the fleet
-# aggregator multiplies this by decode tokens/s and divides by the
-# chip roofline for the per-replica skytpu_mfu_estimate gauge.
+# Forward-pass FLOPs per generated token: the fleet aggregator
+# multiplies this by decode tokens/s and divides by the chip roofline
+# for the per-replica skytpu_mfu_estimate gauge.
 _M_FLOPS_PER_TOKEN = metrics_lib.gauge(
     'skytpu_engine_model_flops_per_token',
     'Approximate forward FLOPs per generated token (2 x parameter '
-    'count) of the model this replica serves.')
+    'count plus the context-dependent attention term) of the model '
+    'this replica serves.')
+
+
+def model_flops_per_token(cfg, n_params: int, max_len: int) -> float:
+    """Forward FLOPs per generated token for the MFU roofline.
+
+    Matmul work is ~2 x params (one multiply-add per parameter per
+    token).  On top of that, attention reads the KV cache: per layer
+    and cached position, QK^T and attn x V each cost
+    2 x n_heads x head_dim FLOPs; at the mean decode context
+    (max_len / 2) that adds 2 x n_layers x n_heads x head_dim x
+    max_len.  `SKYTPU_MODEL_FLOPS_PER_TOKEN` overrides the whole
+    estimate for imported models whose param tree misleads the count
+    (quantized or partially-frozen checkpoints)."""
+    override = os.environ.get('SKYTPU_MODEL_FLOPS_PER_TOKEN')
+    if override:
+        try:
+            return float(override)
+        except ValueError:
+            logger.warning('Ignoring non-numeric '
+                           f'SKYTPU_MODEL_FLOPS_PER_TOKEN={override!r}')
+    attn = (2.0 * cfg.n_layers * cfg.n_heads * cfg.head_dim
+            * float(max_len))
+    return 2.0 * float(n_params) + attn
 
 
 class ClientDisconnected(RuntimeError):
@@ -373,13 +397,13 @@ class ModelServer:
                 f'{report["quantized_bytes"] / 1e6:.1f} MB '
                 f'({report["ratio"]:.2f}x of f32)')
         self.params = params
-        # Serving roofline input: forward FLOPs per generated token
-        # ~= 2 x params (decode is one forward pass per token).  The
-        # controller's aggregator turns this + decode tokens/s into
-        # the per-replica skytpu_mfu_estimate gauge.
+        # Serving roofline input: forward FLOPs per generated token.
+        # The controller's aggregator turns this + decode tokens/s
+        # into the per-replica skytpu_mfu_estimate gauge.
         n_params = sum(int(p.size)
                        for p in jax.tree_util.tree_leaves(params))
-        self.flops_per_token = 2.0 * n_params
+        self.flops_per_token = model_flops_per_token(
+            self.cfg, n_params, max_len)
         _M_FLOPS_PER_TOKEN.set(self.flops_per_token)
         # One generation at a time: KV caches are sized per call and
         # the chip is exclusive anyway; the HTTP layer queues.
@@ -502,6 +526,16 @@ class ModelServer:
         if limit is not None:
             segments = segments[-int(limit):]
         return {'segments': segments}
+
+    def export_profile(self) -> Dict[str, Any]:
+        """The `GET /profile` payload: the engine's tick-phase ring +
+        recompile-sentinel snapshot, identity-tagged so `sky serve
+        profile` can stitch a fleet view."""
+        payload = self.identity()
+        engine = self._engine
+        payload['profile'] = (engine.profile() if engine is not None
+                              else None)
+        return payload
 
     def record_handoff_segment(self, name: str, request_id: str,
                                start: float, duration_ms: float,
@@ -761,6 +795,11 @@ def _make_handler(server: ModelServer):
                 # (sky serve trace / the controller aggregator).
                 self._reply(200, server.export_spans(
                     **parse_span_query(query)))
+                return
+            if path == http_protocol.PROFILE:
+                # Continuous-profiling export: tick-phase ring +
+                # recompile sentinel (sky serve profile).
+                self._reply(200, server.export_profile())
                 return
             payload = {'status': 'ok',
                        'model': f'{server.cfg.d_model}x'

@@ -183,6 +183,10 @@ class SliceReplicaEngine(batching_engine_lib.ContinuousBatchingEngine):
             decode.prefill_sp, cfg, mesh=mesh,
             max_len=kwargs.get('max_len', 512)))
         super().__init__(cfg, params, mesh=mesh, **kwargs)
+        # The SP prefill entry is created before the base engine builds
+        # the recompile sentinel; enroll it now.
+        self._sp_prefill_jit = self._sentinel.wrap('sp_prefill',
+                                                   self._sp_prefill_jit)
 
     # --------------------------------------------------- gang protocol
 
@@ -193,6 +197,7 @@ class SliceReplicaEngine(batching_engine_lib.ContinuousBatchingEngine):
         the replica as a unit — a half-dead slice must never keep
         half-serving."""
         self._coordinator.tick()
+        self._profiler.lap('slice-sync')
         return super()._dispatch_step()
 
     def _dispatch_spec_step(self, drafts):
@@ -204,6 +209,7 @@ class SliceReplicaEngine(batching_engine_lib.ContinuousBatchingEngine):
         self._coordinator.broadcast(
             coordinator_lib.CMD_TICK,
             spec=np.asarray(drafts).tolist())
+        self._profiler.lap('slice-sync')
         return super()._dispatch_spec_step(drafts)
 
     def _activate(self, slot_id, request, token, length, *,

@@ -1,0 +1,186 @@
+"""Perf-regression observatory tests (ISSUE 18): bench run history
+append/load, noise-aware diffing, and the `sky bench diff` gate.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu import cli
+from skypilot_tpu.observability import bench_history
+
+
+def _run(i, *, itl_p99=4.2, tps=2450.0, ts0=1000.0):
+    return {
+        'source': 'bench_serve', 'ts': ts0 + i * 60,
+        'git_rev': f'rev{i:02d}',
+        'metric': 'serve_decode_tokens_per_sec',
+        'value': tps, 'unit': 'tokens/s',
+        'config': {'model': 'tiny', 'slots': 4},
+        'tokens_per_s': tps,
+        'ttft_p99_ms': 190.0, 'itl_p99_ms': itl_p99,
+    }
+
+
+class TestAppendLoad:
+
+    def test_append_stamps_and_roundtrips(self, tmp_path):
+        path = str(tmp_path / 'hist.jsonl')
+        got = bench_history.append_record(
+            {'metric': 'm', 'config': {}, 'value': 1.0}, path)
+        assert got == path
+        [rec] = bench_history.load_records(path)
+        assert rec['value'] == 1.0
+        assert 'ts' in rec and 'git_rev' in rec   # stamped
+
+    def test_env_override_and_default_path(self, monkeypatch,
+                                           tmp_path):
+        assert bench_history.history_path().endswith(
+            'BENCH_history.jsonl')
+        env_path = str(tmp_path / 'elsewhere.jsonl')
+        monkeypatch.setenv('SKYTPU_BENCH_HISTORY_PATH', env_path)
+        assert bench_history.history_path() == env_path
+        # Explicit path beats the env.
+        assert bench_history.history_path('/x.jsonl') == '/x.jsonl'
+
+    def test_malformed_lines_are_skipped(self, tmp_path):
+        path = tmp_path / 'hist.jsonl'
+        path.write_text(json.dumps(_run(0)) + '\n'
+                        '{truncated\n'
+                        '[1, 2, 3]\n'
+                        + json.dumps(_run(1)) + '\n')
+        records = bench_history.load_records(str(path))
+        assert len(records) == 2
+
+    def test_committed_seed_history_parses(self):
+        """The checked-in BENCH_history.jsonl is always loadable and
+        diffable (the observatory must never start from a broken
+        seed)."""
+        records = bench_history.load_records()
+        assert len(records) >= 2
+        findings = bench_history.diff_records(records)
+        assert findings
+        assert not any(f['regression'] for f in findings)
+
+
+class TestDiff:
+
+    def test_identical_runs_never_regress(self):
+        records = [_run(i) for i in range(5)]
+        findings = bench_history.diff_records(records)
+        assert findings
+        assert all(not f['regression'] for f in findings)
+        assert all(f['change'] == pytest.approx(0.0) for f in findings)
+
+    def test_injected_20pct_itl_regression_is_flagged(self):
+        records = [_run(i) for i in range(4)]
+        records.append(_run(4, itl_p99=4.2 * 1.20))   # 20% worse ITL
+        findings = bench_history.diff_records(records)
+        flagged = [f for f in findings if f['regression']]
+        assert [f['field'] for f in flagged] == ['itl_p99_ms']
+        [f] = flagged
+        assert f['change'] == pytest.approx(0.20)
+        assert f['latest_rev'] == 'rev04'
+
+    def test_direction_matters(self):
+        # 20% FASTER itl + 20% MORE throughput: improvements, not
+        # regressions; 20% throughput DROP: regression.
+        better = [_run(i) for i in range(3)] + [
+            _run(3, itl_p99=4.2 * 0.8, tps=2450.0 * 1.2)]
+        assert not any(f['regression']
+                       for f in bench_history.diff_records(better))
+        worse = [_run(i) for i in range(3)] + [
+            _run(3, tps=2450.0 * 0.8)]
+        flagged = [f for f in bench_history.diff_records(worse)
+                   if f['regression']]
+        assert {'tokens_per_s', 'value'} == {f['field']
+                                             for f in flagged}
+
+    def test_noise_aware_threshold_spares_jittery_series(self):
+        # Baseline ITL bounces ±25%: a 30% move is inside 3x cv.
+        itls = [3.0, 5.0, 3.2, 4.8, 3.1, 4.9]
+        records = [_run(i, itl_p99=v) for i, v in enumerate(itls)]
+        records.append(_run(len(itls), itl_p99=5.2))
+        findings = bench_history.diff_records(records)
+        itl = [f for f in findings if f['field'] == 'itl_p99_ms']
+        assert itl and not itl[0]['regression']
+        assert itl[0]['threshold'] > bench_history.DEFAULT_MIN_REL
+
+    def test_last_n_window_limits_the_baseline(self):
+        # Old slow era, then a fast era; the newest run matches the
+        # fast era — against the FULL history it looks like a huge
+        # itl improvement / none against --last 2.
+        records = [_run(i, itl_p99=10.0) for i in range(4)]
+        records += [_run(4 + i, itl_p99=4.0) for i in range(2)]
+        records.append(_run(6, itl_p99=4.0))
+        full = {f['field']: f for f in
+                bench_history.diff_records(records)}
+        windowed = {f['field']: f for f in
+                    bench_history.diff_records(records, last=2)}
+        assert full['itl_p99_ms']['change'] < -0.3
+        assert windowed['itl_p99_ms']['change'] == pytest.approx(0.0)
+        assert windowed['itl_p99_ms']['baseline_runs'] == 2
+
+    def test_configs_never_cross_baseline(self):
+        a = [_run(i) for i in range(3)]
+        b = [dict(_run(i, tps=100.0), config={'model': 'big'})
+             for i in range(3)]
+        findings = bench_history.diff_records(a + b)
+        # Two independent groups, no cross-contamination: every
+        # finding's baseline matches its own group's values.
+        for f in findings:
+            if f['field'] == 'tokens_per_s':
+                expect = 100.0 if f['config']['model'] == 'big' \
+                    else 2450.0
+                assert f['baseline'] == pytest.approx(expect)
+
+    def test_single_run_groups_are_silent(self):
+        assert bench_history.diff_records([_run(0)]) == []
+
+
+class TestBenchDiffCli:
+
+    def _write(self, tmp_path, records):
+        path = tmp_path / 'hist.jsonl'
+        path.write_text(''.join(json.dumps(r) + '\n' for r in records))
+        return str(path)
+
+    def test_clean_history_exits_zero(self, tmp_path):
+        path = self._write(tmp_path, [_run(i) for i in range(3)])
+        result = CliRunner().invoke(
+            cli.cli, ['bench', 'diff', '--history', path])
+        assert result.exit_code == 0, result.output
+        assert 'No regressions.' in result.output
+        assert '[ok]' in result.output
+
+    def test_regression_exits_nonzero_with_the_culprit_named(
+            self, tmp_path):
+        records = [_run(i) for i in range(3)]
+        records.append(_run(3, itl_p99=4.2 * 1.25))
+        path = self._write(tmp_path, records)
+        result = CliRunner().invoke(
+            cli.cli, ['bench', 'diff', '--history', path])
+        assert result.exit_code != 0
+        assert '[REGRESSION]' in result.output
+        assert 'itl_p99_ms' in result.output
+
+    def test_missing_history_fails_loud(self, tmp_path):
+        result = CliRunner().invoke(
+            cli.cli, ['bench', 'diff', '--history',
+                      str(tmp_path / 'nope.jsonl')])
+        assert result.exit_code != 0
+        assert 'No bench history' in result.output
+
+    def test_min_rel_tightens_the_gate(self, tmp_path):
+        records = [_run(i) for i in range(3)]
+        records.append(_run(3, itl_p99=4.2 * 1.05))   # 5% worse
+        path = self._write(tmp_path, records)
+        ok = CliRunner().invoke(
+            cli.cli, ['bench', 'diff', '--history', path])
+        assert ok.exit_code == 0
+        strict = CliRunner().invoke(
+            cli.cli, ['bench', 'diff', '--history', path,
+                      '--min-rel', '0.02'])
+        assert strict.exit_code != 0

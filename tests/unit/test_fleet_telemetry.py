@@ -145,6 +145,68 @@ class TestAggregatorScrape:
         # Second call inside the interval is a no-op.
         assert not agg.maybe_scrape([])
 
+    def test_role_label_follows_live_role_morph(self):
+        """PR 17 regression: after a live role morph the replica's
+        health payload advertises the NEW role while the controller's
+        registration-time target dict still pins the old one — each
+        scrape pass must re-resolve the role from `/health` so per-role
+        series (QPS, loads) follow the morph instead of going stale."""
+        import http.server
+        import json
+        import threading
+
+        state = {'role': 'prefill'}
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+
+            def do_GET(self):          # noqa: N802
+                if self.path.startswith('/metrics'):
+                    body = ('skytpu_engine_decode_tokens_per_s '
+                            '50.0\n').encode()
+                    ctype = 'text/plain'
+                else:                  # health payload
+                    body = json.dumps({'status': 'ok',
+                                       'role': state['role']}).encode()
+                    ctype = 'application/json'
+                self.send_response(200)
+                self.send_header('Content-Type', ctype)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(('127.0.0.1', 0),
+                                                Handler)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        port = httpd.server_address[1]
+        target = {'url': f'http://127.0.0.1:{port}',
+                  'kind': 'replica', 'replica_id': 3,
+                  'role': 'prefill', 'num_hosts': 1}
+        agg = aggregator_lib.FleetAggregator('svc', _store())
+        try:
+            agg.scrape_fleet([target])
+            assert agg.store.latest('skytpu_engine_decode_tokens_per_s',
+                                    role='prefill')
+            # The replica morphs: only its health payload changes.
+            state['role'] = 'decode'
+            agg.scrape_fleet([target])
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+        [(labels, value)] = agg.store.latest(
+            'skytpu_engine_decode_tokens_per_s', role='decode')
+        assert labels['replica_id'] == '3' and value == 50.0
+        # The target dict is kept in step so span/top labels agree.
+        assert target['role'] == 'decode'
+        # Label sets never collapse: the pre-morph samples stay under
+        # the prefill-labelled series (and age out via retention)
+        # while all fresh samples land under decode.
+        assert agg.store.latest('skytpu_engine_decode_tokens_per_s',
+                                role='prefill')
+
     def test_role_signals_smooth_qps_and_loads(self):
         agg = aggregator_lib.FleetAggregator('svc', _store())
         now = time.time()
@@ -479,6 +541,54 @@ class TestServeTopRender:
         assert 'BREACH' in out                  # SLO status
         assert 'abcd' in out and '812.0ms' in out
         assert 'TTFT p99' in out
+
+    def test_render_tick_breakdown_and_recompiles_columns(
+            self, capsys):
+        from skypilot_tpu import cli
+        telemetry = {
+            'mfu': {'1': 0.1234},
+            'roles': {},
+            'slos': [],
+            'slow_traces': [],
+            'tick_breakdown': {'1': {'decode-step': 0.6,
+                                     'prefill-chunk': 0.3,
+                                     'admit': 0.1}},
+            'recompiles': {'1': 2.0},
+        }
+        cli._render_top([self._record()], {'svc': telemetry})  # pylint: disable=protected-access
+        out = capsys.readouterr().out
+        assert 'TICK-BREAKDOWN' in out and 'RECOMPILES' in out
+        # Top-2 phases by share, largest first.
+        assert 'decode-step 60%' in out
+        assert 'prefill-chunk 30%' in out
+        assert 'admit' not in out.split('TICK-BREAKDOWN')[1]
+        assert ' 2 ' in out or ' 2\n' in out  # recompile count rendered
+
+    def test_fmt_tick_breakdown(self):
+        from skypilot_tpu import cli
+        assert cli._fmt_tick_breakdown(None) == '-'  # pylint: disable=protected-access
+        assert cli._fmt_tick_breakdown({}) == '-'  # pylint: disable=protected-access
+        got = cli._fmt_tick_breakdown(  # pylint: disable=protected-access
+            {'sample': 0.25, 'decode-step': 0.75})
+        assert got == 'decode-step 75% sample 25%'
+
+    def test_fleet_snapshot_carries_profiling_series(self):
+        agg = aggregator_lib.FleetAggregator('svc', _store())
+        now = time.time()
+        for t, v in ((40, 1.0), (20, 7.0), (0, 13.0)):
+            agg.store.add('skytpu_engine_tick_phase_seconds_sum',
+                          {'replica_id': '1', 'phase': 'decode-step'},
+                          now - t, v)
+        agg.store.add('skytpu_engine_recompiles_total',
+                      {'replica_id': '1', 'fn': 'step'}, now, 2.0)
+        agg.store.add('skytpu_engine_recompiles_total',
+                      {'replica_id': '1', 'fn': 'prefill'}, now, 1.0)
+        snap = agg.fleet_snapshot(['mixed'], now=now)
+        # 12s of decode-step time over 40s of wall = 0.3 s/s.
+        assert snap['tick_breakdown']['1']['decode-step'] == \
+            pytest.approx(0.3)
+        # Recompiles sum across jit entries per replica.
+        assert snap['recompiles']['1'] == pytest.approx(3.0)
 
     def test_render_without_telemetry_still_shows_fleet(self, capsys):
         from skypilot_tpu import cli

@@ -1,0 +1,442 @@
+"""Continuous profiling plane tests (ISSUE 18 tentpole).
+
+TickProfiler (exclusive phase laps, bounded ring, idle-tick skip,
+disable gate), the recompile sentinel (warm-up compiles free,
+steady-state recompiles journaled + counted exactly once), the
+collapsed-stack / Chrome-trace exports, `/profile` on BOTH HTTP
+fronts, the sharpened MFU numerator, and the ≤3% overhead budget.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+import requests
+
+from skypilot_tpu.models import configs
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import profiling
+from skypilot_tpu.serve import async_server, model_server
+
+
+class FakeClock:
+    """Deterministic monotonic clock: every read advances by `step`
+    unless ticks are queued explicitly."""
+
+    def __init__(self, step: float = 1.0) -> None:
+        self.now = 0.0
+        self.step = step
+        self.queued = []
+
+    def __call__(self) -> float:
+        self.now += self.queued.pop(0) if self.queued else self.step
+        return self.now
+
+
+class RecordingJournal:
+    def __init__(self) -> None:
+        self.events = []
+
+    def append(self, name, **fields) -> None:
+        self.events.append((name, fields))
+
+
+def _profiler(**kw):
+    kw.setdefault('clock', FakeClock())
+    kw.setdefault('memory_cb', lambda: None)
+    kw.setdefault('disabled', False)
+    return profiling.TickProfiler(**kw)
+
+
+class TestTickProfiler:
+
+    def test_laps_are_exclusive_and_one_read_each(self):
+        clock = FakeClock(step=1.0)
+        prof = _profiler(clock=clock)
+        prof.begin_tick()                       # t=1
+        prof.lap('handoff', record=False)       # t=2, not attributed
+        prof.lap('admit')                       # t=3: admit gets 1s
+        prof.lap('decode-step')                 # t=4: decode gets 1s
+        prof.end_tick()
+        snap = prof.snapshot()
+        assert snap['ticks'] == 1
+        assert set(snap['phases']) == {'admit', 'decode-step'}
+        assert snap['phases']['admit']['total_s'] == pytest.approx(1.0)
+        assert snap['phases']['decode-step']['total_s'] == \
+            pytest.approx(1.0)
+        # The unrecorded handoff lap still advanced the lap clock, so
+        # its second was attributed to NO phase (phases sum < tick).
+        [rec] = snap['ring']
+        assert rec['dur_s'] == pytest.approx(3.0)
+        assert sum(d for _, _, d in rec['phases']) == pytest.approx(2.0)
+
+    def test_idle_ticks_never_enter_the_ring(self):
+        prof = _profiler()
+        for _ in range(5):
+            prof.begin_tick()
+            prof.lap('admit', record=False)     # machinery ran, no work
+            prof.end_tick()
+        assert prof.ticks == 0
+        assert prof.snapshot()['ring'] == []
+
+    def test_ring_is_bounded_but_aggregates_are_cumulative(self):
+        prof = _profiler(ring_ticks=4)
+        for _ in range(10):
+            prof.begin_tick()
+            prof.lap('decode-step')
+            prof.end_tick()
+        snap = prof.snapshot()
+        assert len(snap['ring']) == 4
+        assert snap['ticks'] == 10
+        assert snap['phases']['decode-step']['count'] == 10
+
+    def test_disable_gate_is_a_noop(self):
+        prof = _profiler(disabled=True)
+        prof.begin_tick()
+        prof.lap('decode-step')
+        prof.end_tick()
+        snap = prof.snapshot()
+        assert snap['enabled'] is False
+        assert snap['ticks'] == 0 and snap['ring'] == []
+
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_PROFILE_RING_TICKS', '7')
+        monkeypatch.setenv('SKYTPU_PROFILE_DISABLE', '1')
+        prof = profiling.TickProfiler(memory_cb=lambda: None)
+        assert prof.ring_ticks == 7
+        assert prof.disabled is True
+
+    def test_quantiles_over_the_ring(self):
+        clock = FakeClock(step=0.0)
+        prof = _profiler(clock=clock, ring_ticks=128)
+        for dur in (1.0, 2.0, 3.0, 4.0):
+            clock.queued = [0.0, dur]          # begin, lap
+            prof.begin_tick()
+            prof.lap('sample')
+            prof.end_tick()
+        agg = prof.snapshot()['phases']['sample']
+        assert agg['p50_s'] == pytest.approx(3.0)
+        assert agg['max_s'] == pytest.approx(4.0)
+        assert agg['total_s'] == pytest.approx(10.0)
+
+    def test_memory_watermark_and_dead_backend(self):
+        mems = [100, 300, 200]
+        prof = _profiler(memory_cb=lambda: mems.pop(0) if mems else None)
+        for _ in range(3):
+            prof.begin_tick()
+            prof.lap('decode-step')
+            prof.end_tick()
+        snap = prof.snapshot()
+        assert snap['device_memory']['watermark_bytes'] == 300
+        assert snap['device_memory']['last_bytes'] == 200
+        # Backend went dark: the profiler stops asking (no raise).
+        prof.begin_tick()
+        prof.lap('decode-step')
+        prof.end_tick()
+        assert prof._mem_dead is True
+
+
+def _counter_value(name, **labels):
+    parsed = metrics_lib.parse_exposition(metrics_lib.expose())
+    want = set(labels.items())
+    for got_labels, value in parsed.get(name, {}).items():
+        if want <= set(got_labels):
+            return value
+    return 0.0
+
+
+class TestRecompileSentinel:
+
+    def test_warmup_compiles_are_free_steady_trips_exactly_once(self):
+        journal = RecordingJournal()
+        sentinel = profiling.RecompileSentinel(
+            steady_after=8, journal_factory=lambda: journal,
+            disabled=False)
+        fn = sentinel.wrap('step', jax.jit(lambda x: x * 2))
+        before = _counter_value('skytpu_engine_recompiles_total',
+                                fn='step')
+        # Warm-up compile + a steady run of identical shapes.
+        for _ in range(12):
+            fn(jnp.ones((4,), jnp.float32))
+        snap = sentinel.snapshot()['fns']['step']
+        assert snap['compiles'] == 1
+        assert snap['steady_recompiles'] == 0
+        assert journal.events == []
+        # Shape-buster after a quiet streak: exactly one detection.
+        fn(jnp.ones((5,), jnp.float32))
+        snap = sentinel.snapshot()['fns']['step']
+        assert snap['compiles'] == 2
+        assert snap['steady_recompiles'] == 1
+        [(event, fields)] = journal.events
+        assert event == 'recompile_detected'
+        assert fields['fn'] == 'step'
+        assert 'float32[5]' in fields['shapes']
+        assert fields['quiet_calls'] >= 8
+        after = _counter_value('skytpu_engine_recompiles_total',
+                               fn='step')
+        assert after == before + 1
+        # The new shape is now cached: steady state again, no retrips.
+        for _ in range(12):
+            fn(jnp.ones((5,), jnp.float32))
+        assert sentinel.snapshot()['fns']['step'][
+            'steady_recompiles'] == 1
+        assert len(journal.events) == 1
+
+    def test_immediate_reshape_is_warmup_not_steady(self):
+        journal = RecordingJournal()
+        sentinel = profiling.RecompileSentinel(
+            steady_after=8, journal_factory=lambda: journal,
+            disabled=False)
+        fn = sentinel.wrap('prefill', jax.jit(lambda x: x + 1))
+        # Back-to-back new shapes (bucketed prefill warm-up): compiles
+        # counted, but none had a quiet streak -> zero steady.
+        for n in (1, 2, 3, 4):
+            fn(jnp.ones((n,), jnp.float32))
+        snap = sentinel.snapshot()['fns']['prefill']
+        assert snap['compiles'] == 4
+        assert snap['steady_recompiles'] == 0
+        assert journal.events == []
+
+    def test_signature_fallback_for_uncached_callables(self):
+        sentinel = profiling.RecompileSentinel(
+            steady_after=2, journal_factory=RecordingJournal,
+            disabled=False)
+        fn = sentinel.wrap('plain', lambda x: x)   # no _cache_size()
+        for _ in range(5):
+            fn(jnp.ones((3,), jnp.float32))
+        fn(jnp.ones((9,), jnp.float32))
+        snap = sentinel.snapshot()['fns']['plain']
+        assert snap['compiles'] == 2
+        assert snap['steady_recompiles'] == 1
+
+    def test_disabled_wrap_is_identity(self):
+        sentinel = profiling.RecompileSentinel(disabled=True)
+        fn = lambda x: x            # noqa: E731
+        assert sentinel.wrap('f', fn) is fn
+        assert sentinel.wrap('g', None) is None
+
+
+class TestExports:
+
+    def _snapshot_all_phases(self):
+        clock = FakeClock(step=0.001)
+        prof = _profiler(clock=clock, memory_cb=lambda: 4096)
+        prof.begin_tick()
+        for phase in profiling.PHASES:
+            prof.lap(phase)
+        prof.end_tick()
+        return prof.snapshot()
+
+    def test_collapsed_stacks(self):
+        lines = profiling.collapsed_stacks(
+            self._snapshot_all_phases()).splitlines()
+        assert len(lines) == len(profiling.PHASES)
+        for line in lines:
+            frame, count = line.rsplit(' ', 1)
+            assert frame.startswith('engine;')
+            assert int(count) > 0
+        assert {l.split(';')[1].split(' ')[0] for l in lines} == \
+            set(profiling.PHASES)
+
+    def test_chrome_trace_is_valid_and_carries_all_phases(self):
+        trace = profiling.chrome_trace(self._snapshot_all_phases(),
+                                       pid=3)
+        blob = json.loads(json.dumps(trace))   # JSON-serializable
+        assert blob['displayTimeUnit'] == 'ms'
+        events = blob['traceEvents']
+        bars = [e for e in events if e['ph'] == 'X']
+        assert {e['name'] for e in bars} == set(profiling.PHASES)
+        for e in bars:
+            assert e['dur'] > 0 and e['ts'] > 0 and e['pid'] == 3
+        [mem] = [e for e in events if e['ph'] == 'C']
+        assert mem['args']['bytes_in_use'] == 4096
+
+
+@pytest.fixture(scope='module')
+def profiled_server():
+    srv = model_server.ModelServer('tiny', max_len=64, max_batch=2,
+                                   continuous_batching=True)
+    yield srv
+    srv.close()
+
+
+class TestProfileEndpoint:
+
+    def _check_payload(self, payload):
+        prof = payload['profile']
+        assert prof['enabled'] is True
+        assert prof['ticks'] > 0
+        assert 'decode-step' in prof['phases']
+        # Steady-state must be clean on a well-behaved run.
+        assert prof['recompiles']['steady_recompiles_total'] == 0
+        assert 'step' in prof['recompiles']['fns']
+        assert prof['pipelined'] is True
+
+    def test_threaded_front(self, profiled_server):
+        port, shutdown = model_server.start_background(profiled_server)
+        try:
+            gen = requests.post(f'http://127.0.0.1:{port}/generate',
+                                json={'prompt_ids': [[3, 1, 4]],
+                                      'max_new_tokens': 4},
+                                timeout=120)
+            assert gen.status_code == 200, gen.text
+            resp = requests.get(f'http://127.0.0.1:{port}/profile',
+                                timeout=10)
+        finally:
+            shutdown()
+        assert resp.status_code == 200
+        self._check_payload(resp.json())
+
+    def test_async_front(self, profiled_server):
+        port, shutdown = async_server.start_background(profiled_server)
+        try:
+            resp = requests.get(f'http://127.0.0.1:{port}/profile',
+                                timeout=10)
+        finally:
+            shutdown()
+        assert resp.status_code == 200
+        self._check_payload(resp.json())
+
+
+class TestServeProfileCli:
+
+    def test_export_trace_carries_all_phases(self, tmp_path,
+                                             monkeypatch):
+        """`sky serve profile --export-trace` against a replica whose
+        ring saw every phase writes a valid Chrome trace with all
+        eight phase bars."""
+        import http.server
+        import threading
+
+        from click.testing import CliRunner
+
+        from skypilot_tpu import cli, serve
+
+        clock = FakeClock(step=0.001)
+        prof = profiling.TickProfiler(ring_ticks=16, disabled=False,
+                                      memory_cb=lambda: 2048,
+                                      clock=clock)
+        prof.begin_tick()
+        for phase in profiling.PHASES:
+            prof.lap(phase)
+        prof.end_tick()
+        sentinel = profiling.RecompileSentinel(
+            disabled=False, journal_factory=RecordingJournal)
+        snap = prof.snapshot()
+        snap['recompiles'] = sentinel.snapshot()
+        payload = json.dumps({'status': 'ok', 'profile': snap}).encode()
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+
+            def do_GET(self):          # noqa: N802
+                self.send_response(200)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length',
+                                 str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(('127.0.0.1', 0),
+                                                Handler)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        port = httpd.server_address[1]
+        record = {'name': 'svc', 'status': 'READY',
+                  'load_balancer_port': None,
+                  'replicas': [{'replica_id': 1, 'role': 'mixed',
+                                'status': 'READY',
+                                'url': f'http://127.0.0.1:{port}'}]}
+        monkeypatch.setattr(serve, 'status', lambda names=None: [record])
+        out_path = tmp_path / 'tick.json'
+        try:
+            result = CliRunner().invoke(
+                cli.cli, ['serve', 'profile', 'svc',
+                          '--export-trace', str(out_path)])
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+        assert result.exit_code == 0, result.output
+        assert 'steady-state recompiles: 0' in result.output
+        assert 'engine;decode-step' in result.output
+        trace = json.loads(out_path.read_text())
+        assert trace['displayTimeUnit'] == 'ms'
+        bars = [e for e in trace['traceEvents'] if e['ph'] == 'X']
+        assert {e['name'] for e in bars} == set(profiling.PHASES)
+
+
+class TestModelFlopsPerToken:
+
+    def test_computed_path_includes_attention_term(self):
+        cfg = configs.get_config('tiny')
+        n_params, max_len = 100_000, 64
+        attn = 2.0 * cfg.n_layers * cfg.n_heads * cfg.head_dim * max_len
+        got = model_server.model_flops_per_token(cfg, n_params, max_len)
+        assert got == pytest.approx(2.0 * n_params + attn)
+        # The attention term is sequence-length dependent.
+        longer = model_server.model_flops_per_token(cfg, n_params, 128)
+        assert longer - got == pytest.approx(attn)
+
+    def test_env_override_wins_and_non_numeric_falls_back(
+            self, monkeypatch):
+        cfg = configs.get_config('tiny')
+        monkeypatch.setenv('SKYTPU_MODEL_FLOPS_PER_TOKEN', '3.5e9')
+        assert model_server.model_flops_per_token(cfg, 1, 64) == 3.5e9
+        monkeypatch.setenv('SKYTPU_MODEL_FLOPS_PER_TOKEN', 'banana')
+        got = model_server.model_flops_per_token(cfg, 1000, 64)
+        assert got == pytest.approx(
+            2000 + 2.0 * cfg.n_layers * cfg.n_heads * cfg.head_dim * 64)
+
+
+class TestOverheadBudget:
+    """The always-on budget: profile-on vs SKYTPU_PROFILE_DISABLE=1
+    may differ by at most 3% of a tick's work.
+
+    Wall-clocking two full workloads head-to-head is hopeless on a
+    noisy CI box (run-to-run jitter alone exceeds 3%), so the A/B is
+    factored: the profiler's marginal per-tick cost comes from a tight
+    on-vs-off microbenchmark of the instrumentation alone (stable —
+    both arms are long uniform loops), and the budget is asserted
+    against a measured representative tick's compute."""
+
+    TICKS = 4000
+
+    @classmethod
+    def _per_tick_cost(cls, prof):
+        """Seconds per tick of the instrumentation calls alone, at the
+        real call pattern (4 laps + begin/end per tick)."""
+        t0 = time.perf_counter()
+        for _ in range(cls.TICKS):
+            prof.begin_tick()
+            prof.lap('handoff', record=False)
+            prof.lap('admit')
+            prof.lap('decode-step')
+            prof.lap('sample')
+            prof.end_tick()
+        return (time.perf_counter() - t0) / cls.TICKS
+
+    def test_profiler_overhead_within_3_percent(self):
+        on = profiling.TickProfiler(disabled=False,
+                                    memory_cb=lambda: None)
+        off = profiling.TickProfiler(disabled=True,
+                                     memory_cb=lambda: None)
+        self._per_tick_cost(on), self._per_tick_cost(off)   # warm-up
+        marginal = min(self._per_tick_cost(on) -
+                       self._per_tick_cost(off) for _ in range(5))
+        # A representative tick's work: even the tiny model's decode
+        # step is milliseconds; 300us is a conservative floor.
+        def tick_work():
+            t0 = time.perf_counter()
+            assert sum(range(30000)) > 0
+            return time.perf_counter() - t0
+        work = min(tick_work() for _ in range(20))
+        assert marginal <= 0.03 * work, (marginal, work)
+        # The profiler's own overhead model stays in the same regime.
+        snap = on.snapshot()
+        per_tick_model = snap['overhead_s'] / max(1, snap['ticks'])
+        assert per_tick_model <= 0.03 * work

@@ -1,0 +1,144 @@
+"""SpanStore / SegmentStore under concurrent export + eviction
+(ISSUE 18 satellite).
+
+Parallel exporters paginating with `since=` while a writer races the
+ring bound: an exporter must never see a segment twice, never miss a
+segment that survived long enough to be seen, and the store must never
+exceed its cap.
+"""
+from __future__ import annotations
+
+import threading
+
+from skypilot_tpu.observability import tracing
+
+
+def _seg(i: int) -> dict:
+    # Strictly increasing synthetic start times: `since=` pagination
+    # cursors are exact.
+    return {'request_id': f'r{i:05d}', 'seq': i,
+            'start': 1000.0 + i * 1e-3}
+
+
+class _Exporter(threading.Thread):
+    """Pages `export(since=cursor)` in a loop, deduping nothing —
+    duplicates are a failure, not something to paper over."""
+
+    def __init__(self, store, done: threading.Event) -> None:
+        super().__init__(daemon=True)
+        self.store = store
+        self.done = done
+        self.seen = []
+        self.duplicates = []
+
+    def run(self) -> None:
+        cursor = None
+        seen_ids = set()
+        while True:
+            finished = self.done.is_set()
+            page = self.store.export(since=cursor)
+            for seg in page:
+                if seg['request_id'] in seen_ids:
+                    self.duplicates.append(seg['request_id'])
+                seen_ids.add(seg['request_id'])
+                self.seen.append(seg)
+            if page:
+                # Starts are unique + monotonic: strictly-after cursor.
+                cursor = page[-1]['start'] + 5e-4
+            if finished:
+                return
+
+
+class TestSegmentStoreConcurrency:
+
+    CAP = 64
+    WRITES = 600
+
+    def test_parallel_export_races_eviction(self):
+        store = tracing.SegmentStore(maxlen=self.CAP)
+        done = threading.Event()
+        exporters = [_Exporter(store, done) for _ in range(4)]
+        for exp in exporters:
+            exp.start()
+
+        cap_violations = []
+        for i in range(self.WRITES):
+            store.add(_seg(i))
+            if len(store) > self.CAP:
+                cap_violations.append(len(store))
+        done.set()
+        for exp in exporters:
+            exp.join(timeout=30)
+            assert not exp.is_alive()
+
+        assert not cap_violations
+        final_ids = [s['request_id'] for s in store.export()]
+        assert len(final_ids) == self.CAP          # exactly the cap
+        for exp in exporters:
+            # Never a duplicate, pages in order.
+            assert exp.duplicates == []
+            seqs = [s['seq'] for s in exp.seen]
+            assert seqs == sorted(seqs)
+            # Never a dropped unseen segment: everything still in the
+            # store at the end was either exported earlier or picked
+            # up by the exporter's final page — the union must cover
+            # the survivors completely.
+            seen_ids = {s['request_id'] for s in exp.seen}
+            assert seen_ids >= set(final_ids)
+
+    def test_limit_and_filters_stay_consistent_under_writes(self):
+        store = tracing.SegmentStore(maxlen=32)
+        stop = threading.Event()
+        errors = []
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    page = store.export(limit=8)
+                    assert len(page) <= 8
+                    one = store.export(request_id='r00005')
+                    assert all(s['request_id'] == 'r00005'
+                               for s in one)
+                except Exception as e:  # pylint: disable=broad-except
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=reader, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        for i in range(400):
+            store.add(_seg(i))
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert errors == []
+
+
+class TestSpanStoreConcurrency:
+
+    CAP = 48
+
+    def test_span_export_pagination_races_the_bound(self):
+        store = tracing.SpanStore(maxlen=self.CAP)
+        done = threading.Event()
+        exporters = [_Exporter(store, done) for _ in range(3)]
+        for exp in exporters:
+            exp.start()
+
+        for i in range(300):
+            span = tracing.RequestSpan(request_id=f'r{i:05d}')
+            span.submit_wall = 1000.0 + i * 1e-3   # deterministic cursor
+            span.finish('ok')
+            store.add(span)
+            assert len(store) <= self.CAP
+        done.set()
+        for exp in exporters:
+            exp.join(timeout=30)
+            assert not exp.is_alive()
+
+        final_ids = {s['request_id'] for s in store.export()}
+        assert len(final_ids) == self.CAP
+        for exp in exporters:
+            assert exp.duplicates == []
+            assert {s['request_id'] for s in exp.seen} >= final_ids
