@@ -41,6 +41,10 @@ The registry maps names (used by scenarios and the CLI) to checkers:
                            shed never happens while a lower-weight
                            class holds more in-flight slots (no
                            priority inversion at admission)
+    log_spike_terminates   every log_error_spike_start (a replica's
+                           WARN/ERROR rate excursion) reaches a later
+                           log_error_spike_end — an alert that never
+                           clears is a stuck tracker
     no_injections          zero chaos_fault_injected events (clean runs)
 """
 from __future__ import annotations
@@ -61,6 +65,7 @@ _KV_PAGES = event_protocol.BY_NAME['kv_pages']
 _KV_HANDOFF = event_protocol.BY_NAME['kv_handoff']
 _REPLICA_DRAIN = event_protocol.BY_NAME['replica_drain']
 _QOS_REQUEST = event_protocol.BY_NAME['qos_request']
+_LOG_ERROR_SPIKE = event_protocol.BY_NAME['log_error_spike']
 
 
 def merge(*event_lists: Sequence[Event]) -> List[Event]:
@@ -448,6 +453,35 @@ def qos_fairness(events: Sequence[Event]) -> List[str]:
     return violations
 
 
+def log_spike_terminates(events: Sequence[Event]) -> List[str]:
+    """Liveness for the fleet log plane: every log_error_spike_start
+    (one replica's WARN/ERROR rate above the spike threshold) reaches
+    a later log_error_spike_end for the same replica — an error-spike
+    alert that never clears means the tracker wedged or the fleet
+    never quieted, and either way the operator is staring at a stale
+    red light."""
+    violations = []
+    open_spikes: Dict[Any, int] = {}
+    for e in events:
+        name = e.get('event')
+        key = (e.get('service'), e.get('replica_id'))
+        if name == _LOG_ERROR_SPIKE.start:
+            open_spikes[key] = open_spikes.get(key, 0) + 1
+        elif name == _LOG_ERROR_SPIKE.end:
+            held = open_spikes.get(key, 0)
+            if held <= 0:
+                violations.append(
+                    f'log_error_spike_end for {key} without a start')
+            else:
+                open_spikes[key] = held - 1
+    dangling = sorted(k for k, n in open_spikes.items() if n > 0)
+    if dangling:
+        violations.append(
+            f'log_error_spike_start without log_error_spike_end for '
+            f'{dangling}')
+    return violations
+
+
 def no_injections(events: Sequence[Event]) -> List[str]:
     """With no plan armed, the chaos subsystem must be invisible."""
     injected = _named(events, 'chaos_fault_injected')
@@ -469,6 +503,7 @@ CHECKERS: Dict[str, Callable[[Sequence[Event]], List[str]]] = {
     'handoff_consistency': handoff_consistency,
     'drain_no_lost_requests': drain_no_lost_requests,
     'qos_fairness': qos_fairness,
+    'log_spike_terminates': log_spike_terminates,
     'no_injections': no_injections,
 }
 

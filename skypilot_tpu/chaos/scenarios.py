@@ -45,6 +45,11 @@ Scenarios (CLI: ``sky chaos list`` / ``sky chaos run <name>``):
                            back to local prefill on the decode
                            replica; journal proves no request was lost
                            or double-executed
+- ``error_spike``          a rank death floods the replica's WARN/
+                           ERROR logs → the fleet log plane journals
+                           log_error_spike_start; once the fleet
+                           quiets the spike terminates (replay proves
+                           every spike start has its end)
 - ``page_pool_exhaustion`` KV page allocations denied → the batching
                            engine backpressures (429/Retry-After)
                            instead of erroring, recovers when the
@@ -947,6 +952,114 @@ def handoff_fallback(seed: int) -> ScenarioResult:
         decode_server.close()
     return _finish('handoff_fallback', seed, t0, serve_events,
                    ['handoff_consistency'], extra, details)
+
+
+@_register(
+    'error_spike',
+    'one rank of a 2-host slice replica dies mid-request (raise on '
+    'serve.rank_exec) -> the replica\'s WARN/ERROR log rate spikes '
+    'above threshold, the fleet log plane journals '
+    'log_error_spike_start, and once the fleet quiets the spike '
+    'terminates (log_error_spike_end); journal replay proves every '
+    'spike start has its end')
+def error_spike(seed: int) -> ScenarioResult:
+    import requests  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.observability import aggregator as aggregator_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.observability import logs as logs_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import model_server as model_server_lib  # pylint: disable=import-outside-toplevel
+
+    # Kill rank 1 on its first coordinated broadcast (rank 0 executes
+    # inline = site call 1, rank 1 = call 2) — the admission path then
+    # logs the rank death and the failed engine tick, which IS the
+    # error burst the log plane must notice.
+    plan = faults_lib.FaultPlan(
+        seed=seed, name='error_spike',
+        faults=[faults_lib.Fault(site='serve.rank_exec',
+                                 effect='raise', where={'rank': 1},
+                                 nth=[2], max_times=1)])
+    t0 = time.time()
+    extra: List[str] = []
+    details: Dict[str, Any] = {}
+    serve_journal = events_lib.get_journal(
+        os.path.join(events_lib.journal_root(), 'serve.jsonl'))
+    service = f'chaos-errspike-{seed}'
+    # A handful of burst records over the scenario's synthetic clock
+    # must clear the threshold; the production default of 1 err/s
+    # would need a flood.
+    env_keys = {'SKYTPU_LOG_ERROR_SPIKE_THRESHOLD': '0.01'}
+    saved_env = {k: os.environ.get(k) for k in env_keys}
+    os.environ.update(env_keys)
+    server = model_server_lib.ModelServer(
+        'tiny', max_len=64, max_batch=2, continuous_batching=True,
+        prefill_chunk=16, num_hosts=2)
+    aggregator = aggregator_lib.FleetAggregator(service)
+    tracker = logs_lib.LogSpikeTracker(service, journal=serve_journal)
+    stop = None
+    try:
+        port, stop = model_server_lib.start_background(server)
+        targets = [{'url': f'http://127.0.0.1:{port}',
+                    'kind': 'replica', 'replica_id': 0,
+                    'role': 'mixed'}]
+        # Seed both level series so the baseline scrape gives the
+        # windowed rate its first sample per level (a series born
+        # mid-window has no baseline to rate against).
+        with sky_logging.silent():
+            logger.warning('chaos error_spike baseline warning')
+            logger.error('chaos error_spike baseline error')
+        # Scrape timestamps are the scenario's clock (counter values
+        # stay real): baseline now, the burst read at now+30, then two
+        # flat scrapes past the fast window.
+        now = time.time()
+        aggregator.scrape_fleet(targets, now)
+        baseline = tracker.evaluate(aggregator.store, now)
+        _expect(not any(s['spiking'] for s in baseline),
+                f'no spike before the fault (got {baseline})', extra)
+        with _armed(plan):
+            try:
+                resp = requests.post(
+                    f'http://127.0.0.1:{port}{http_protocol.GENERATE}',
+                    json={'prompt_ids': [[1, 2, 3, 4]],
+                          'max_new_tokens': 4}, timeout=60)
+                details['request_status'] = resp.status_code
+            except requests.RequestException:
+                details['request_status'] = None  # dying replica
+        time.sleep(0.5)  # let the engine's failure logging settle
+        aggregator.scrape_fleet(targets, now + 30)
+        during = tracker.evaluate(aggregator.store, now + 30)
+        details['during'] = during
+        _expect(any(s['spiking'] for s in during),
+                f'the WARN/ERROR burst starts a spike (got {during})',
+                extra)
+        aggregator.scrape_fleet(targets, now + 120)
+        aggregator.scrape_fleet(targets, now + 125)
+        after = tracker.evaluate(aggregator.store, now + 125)
+        details['after'] = after
+        _expect(not any(s['spiking'] for s in after),
+                f'the spike terminates once the fleet quiets '
+                f'(got {after})', extra)
+    finally:
+        for key, value in saved_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+        if stop is not None:
+            stop()
+        server.close()
+    serve_events = _since(serve_journal, t0)
+    names = [e.get('event') for e in serve_events]
+    _expect('log_error_spike_start' in names,
+            'log_error_spike_start was journaled', extra)
+    _expect('log_error_spike_end' in names,
+            'log_error_spike_end was journaled', extra)
+    injected = [e for e in _since(injector.chaos_journal(), t0)
+                if e.get('event') == 'chaos_fault_injected']
+    _expect(len(injected) == 1,
+            f'exactly one rank-death fault fired (got {len(injected)})',
+            extra)
+    return _finish('error_spike', seed, t0, serve_events,
+                   ['log_spike_terminates'], extra, details)
 
 
 def _run_replica_rank_death(name: str, seed: int,

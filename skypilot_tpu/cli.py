@@ -1041,15 +1041,152 @@ def serve_down(service_names, purge, yes):
         click.echo(f'Service {name} torn down.')
 
 
+def _log_sources(record) -> List[Dict[str, Any]]:
+    """Every structured-log endpoint of one service: each replica
+    front's /logs, the LB's /lb/logs, the controller's
+    /controller/logs."""
+    from skypilot_tpu.serve import http_protocol  # pylint: disable=import-outside-toplevel
+    targets, lb_url = _trace_targets(record)
+    sources: List[Dict[str, Any]] = [
+        {'kind': 'replica', 'url': t['url'],
+         'path': http_protocol.LOGS,
+         'replica_id': t['replica_id'], 'role': t['role']}
+        for t in targets]
+    if lb_url:
+        sources.append({'kind': 'lb', 'url': lb_url,
+                        'path': http_protocol.LB_LOGS})
+    port = record.get('controller_port')
+    if port:
+        sources.append({'kind': 'controller',
+                        'url': f'http://127.0.0.1:{port}',
+                        'path': http_protocol.CONTROLLER_LOGS})
+    return sources
+
+
+def _merge_log_records(batches, seen=None) -> List[Dict[str, Any]]:
+    """Merge per-endpoint record batches into one timestamp-ordered
+    stream.  Dedup matters because in-process fleets (tests, single
+    host) share one ring: every endpoint exports the same records."""
+    seen = seen if seen is not None else set()
+    out: List[Dict[str, Any]] = []
+    for records in batches:
+        for rec in records:
+            key = (rec.get('seq'), rec.get('ts'), rec.get('logger'),
+                   rec.get('msg'))
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(rec)
+    out.sort(key=lambda r: (float(r.get('ts') or 0.0),
+                            int(r.get('seq') or 0)))
+    return out
+
+
+def _log_record_matches(rec, replica_id, role) -> bool:
+    """Client-side identity filter — per-record, not per-endpoint,
+    because record identity is authoritative (a shared ring tags each
+    record with the process that emitted it)."""
+    if replica_id is not None and rec.get('replica_id') != replica_id:
+        return False
+    if role is not None and rec.get('role') != role:
+        return False
+    return True
+
+
+def _fmt_log_record(rec) -> str:
+    import datetime  # pylint: disable=import-outside-toplevel
+    ts = float(rec.get('ts') or 0.0)
+    stamp = datetime.datetime.fromtimestamp(ts).strftime(
+        '%m-%d %H:%M:%S.%f')[:-3]
+    proc = rec.get('process')
+    if proc == 'lb':
+        who = 'lb'
+    elif proc not in (None, 'replica'):
+        who = str(proc)
+    else:
+        rid = rec.get('replica_id')
+        who = f'replica {rid}' if rid is not None else 'replica'
+        if rec.get('role'):
+            who += f' ({rec["role"]})'
+    line = (f'{stamp} {str(rec.get("level") or "?")[:1]} [{who}] '
+            f'{rec.get("logger", "?")}: {rec.get("msg", "")}')
+    if rec.get('request_id'):
+        line += f' (req {rec["request_id"]})'
+    return line
+
+
 @serve_group.command(name='logs')
-@click.argument('service_name')
-@click.option('--replica-id', type=int, default=None)
-@click.option('--target', default='replica',
-              type=click.Choice(['replica', 'controller']))
-def serve_logs(service_name, replica_id, target):
-    """Show replica or controller logs."""
+@click.argument('service_name', required=False, default=None)
+@click.option('--replica', '-R', 'replica_id', type=int, default=None,
+              help='Only records emitted by this replica.')
+@click.option('--role', default=None,
+              help='Only records emitted by replicas of this role.')
+@click.option('--follow', '-f', is_flag=True, default=False,
+              help='Keep streaming new records (live fleet tail).')
+@click.option('--level', '-l', default=None,
+              help='Minimum level (DEBUG/INFO/WARNING/ERROR).')
+@click.option('--grep', 'grep_pat', default=None,
+              help='Only records whose message matches this pattern.')
+@click.option('--request-id', 'request_id', default=None,
+              help='Only records bound to this request id.')
+@click.option('--target', default=None,
+              type=click.Choice(['replica', 'controller']),
+              help='Legacy raw file tail (pre-structured-ring path).')
+def serve_logs(service_name, replica_id, role, follow, level,
+               grep_pat, request_id, target):
+    """Stream the fleet's structured logs, merged by timestamp.
+
+    Fans in every process's bounded log ring — each replica front's
+    `GET /logs`, the LB's `/lb/logs`, the controller's
+    `/controller/logs` — and merges the records into one
+    identity-prefixed stream, so one request's prefill, KV handoff and
+    decode lines from three different processes read as one story.
+    Server-side filters (--level/--grep/--request-id) keep the fan-in
+    cheap; --follow pages each source by its sequence cursor."""
+    import time as time_lib  # pylint: disable=import-outside-toplevel
+
     from skypilot_tpu import serve  # pylint: disable=import-outside-toplevel
-    serve.tail_logs(service_name, target=target, replica_id=replica_id)
+    from skypilot_tpu.observability import traces as traces_lib  # pylint: disable=import-outside-toplevel
+    if target is not None:
+        if service_name is None:
+            raise click.ClickException('--target needs a service name.')
+        serve.tail_logs(service_name, target=target,
+                        replica_id=replica_id)
+        return
+    record = _pick_service(
+        serve.status([service_name] if service_name else None),
+        service_name)
+    sources = _log_sources(record)
+    if not sources:
+        raise click.ClickException(
+            f'Service {record["name"]} has no reachable processes.')
+    cursors = {i: 0.0 for i in range(len(sources))}
+    seen: set = set()
+
+    def _poll() -> List[Dict[str, Any]]:
+        batches = []
+        for i, src in enumerate(sources):
+            records = traces_lib.fetch_log_records(
+                src['url'], src['path'], since=cursors[i] or None,
+                level=level, grep=grep_pat, request_id=request_id)
+            for rec in records:
+                cursors[i] = max(cursors[i],
+                                 float(rec.get('seq') or 0))
+            batches.append(records)
+        return [rec for rec in _merge_log_records(batches, seen)
+                if _log_record_matches(rec, replica_id, role)]
+
+    for rec in _poll():
+        click.echo(_fmt_log_record(rec))
+    if not follow:
+        return
+    try:
+        while True:
+            time_lib.sleep(1.0)
+            for rec in _poll():
+                click.echo(_fmt_log_record(rec))
+    except KeyboardInterrupt:
+        pass
 
 
 def _trace_targets(record) -> Tuple[List[Dict[str, Any]],
@@ -1113,10 +1250,16 @@ def serve_trace(request_id, service_name, export_trace):
             f'No spans found for request {request_id!r} (finished '
             'long ago and aged out of the bounded span stores, or '
             'never reached this service).')
+    # The request's log lines, interleaved into the waterfall by wall
+    # time (same fan-in as `serve logs --request-id`).
+    log_records = _merge_log_records([
+        traces_lib.fetch_log_records(src['url'], src['path'],
+                                     request_id=request_id)
+        for src in _log_sources(record)])
     click.echo(f'Trace {request_id} — {len(segments)} segment(s) '
                f'across {len({(s.get("process"), s.get("replica_id")) for s in segments})} '
                f'process(es):')
-    for line in traces_lib.format_waterfall(segments):
+    for line in traces_lib.interleave_logs(segments, log_records):
         click.echo(f'  {line}')
     if export_trace:
         traces_lib.export_chrome_trace(segments, export_trace)
@@ -1281,6 +1424,7 @@ def _render_top(records, telemetry_by_service) -> None:
         mfu = telemetry.get('mfu') or {}
         breakdown = telemetry.get('tick_breakdown') or {}
         recompiles = telemetry.get('recompiles') or {}
+        err_rates = telemetry.get('log_error_rates') or {}
         ready = sum(1 for rep in r['replicas']
                     if rep['status'] == 'READY')
         click.echo(f"{r['name']}  [{r['status']}]  v{r['version']}  "
@@ -1297,15 +1441,18 @@ def _render_top(records, telemetry_by_service) -> None:
         for rep in r['replicas']:
             rid = str(rep['replica_id'])
             recomp = recompiles.get(rid)
+            err = err_rates.get(rid)
             rows.append((rep['replica_id'],
                          rep.get('role') or 'mixed',
                          rep['status'], rep.get('url') or '-',
                          fmt_mfu(mfu.get(rid)),
                          _fmt_tick_breakdown(breakdown.get(rid)),
-                         '-' if recomp is None else f'{recomp:g}'))
+                         '-' if recomp is None else f'{recomp:g}',
+                         '-' if err is None else f'{err:.3g}'))
         if rows:
             _print_table(['REPLICA', 'ROLE', 'STATUS', 'URL', 'MFU',
-                          'TICK-BREAKDOWN', 'RECOMPILES'], rows)
+                          'TICK-BREAKDOWN', 'RECOMPILES', 'ERR/s'],
+                         rows)
         roles = telemetry.get('roles') or {}
         if roles:
             click.echo('')
@@ -1332,6 +1479,17 @@ def _render_top(records, telemetry_by_service) -> None:
                     for s in slos]
             _print_table(['SLO', 'TARGET', 'BURN fast', 'BURN slow',
                           'STATUS'], rows)
+        spikes = telemetry.get('log_spikes') or []
+        if spikes:
+            click.echo('')
+            rows = [(s.get('replica_id', '?'),
+                     f"{s.get('rate_fast', 0):g}",
+                     f"{s.get('rate_slow', 0):g}",
+                     f"{s.get('threshold', 0):g}",
+                     'SPIKE' if s.get('spiking') else 'ok')
+                    for s in spikes]
+            _print_table(['LOG ERRORS', 'ERR/s fast', 'ERR/s slow',
+                          'THRESHOLD', 'STATUS'], rows)
         slow = telemetry.get('slow_traces') or []
         if slow:
             click.echo('')

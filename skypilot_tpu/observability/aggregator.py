@@ -563,8 +563,17 @@ class FleetAggregator:
             if rid is None:
                 continue
             recompiles[rid] = recompiles.get(rid, 0.0) + value
+        # Per-replica WARN+ERROR log rate out of the scraped
+        # skytpu_log_records_total counters — `sky serve top`'s ERR/s
+        # column.  Deferred import: logs is import-light but keeping
+        # the aggregator importable without the serve package matters
+        # for analysis tooling.
+        from skypilot_tpu.observability import logs as logs_lib  # pylint: disable=import-outside-toplevel
+        log_error_rates = logs_lib.error_rates(
+            self.store, min(60.0, window_s), now)
         return {'window_s': window_s, 'roles': out_roles, 'mfu': mfu,
                 'tick_breakdown': tick_breakdown,
                 'recompiles': recompiles,
+                'log_error_rates': log_error_rates,
                 'slow_traces': self.slow_traces(),
                 'series_names': self.store.names()}

@@ -124,6 +124,11 @@ PAIRS: Tuple[PairedEvents, ...] = (
     # sentinel journals alongside it.
     _pair('tick_profile', SCOPE_INVOCATION, status_field='status',
           statuses=('ok', 'error')),
+    # Fleet log plane (ISSUE 19).  log_error_spike brackets one
+    # replica's WARN+ERROR-rate excursion above the spike threshold
+    # (same fast/slow multi-window shape as slo_burn; the controller's
+    # LogSpikeTracker journals both edges each reconcile pass).
+    _pair('log_error_spike', SCOPE_PROCESS),
 )
 
 BY_NAME: Dict[str, PairedEvents] = {p.name: p for p in PAIRS}

@@ -1,0 +1,485 @@
+"""Fleet log plane: structured request-scoped logs + error-spike alerts.
+
+The fourth observability pillar (after metrics, traces/SLOs, and
+profiling): every log record the framework emits is captured — in
+addition to stderr — into a bounded in-process ring of structured
+entries:
+
+    {seq, ts, level, logger, msg,
+     process, replica_id, role,      # who said it
+     request_id, attempt}            # on whose behalf
+
+The identity fields come from a **contextvar** that each serving layer
+binds around the request it is handling (the HTTP fronts, the LB
+routed path, the engine worker admission, the coordinator's follower
+executor), reusing the `X-SkyTPU-Request-Id` / `X-SkyTPU-Attempt`
+propagation the tracing plane already ships — so a log line emitted
+three processes away from the client still knows which request it
+belongs to.  contextvars survive `await` boundaries natively; thread
+handoffs (`run_in_executor`, the engine worker) re-bind explicitly.
+
+The ring is exported over `GET /logs?since=&level=&request_id=&grep=
+&limit=` on the replica fronts (`/lb/logs`, `/controller/logs` for the
+other processes); `since=` is an exact **seq cursor** (records with
+`seq > since`), so paginating exporters never see a record twice and
+never miss one that survived the ring bound (same contract the span
+stores pin in test_span_store_concurrency.py).
+
+`skytpu_log_records_total{level}` counts captured records; the fleet
+aggregator scrapes it per replica and `LogSpikeTracker` turns the
+WARN+ERROR rate into `log_error_spike_start/_end` journal alerts with
+the same fast/slow-window shape as SLO burn (a spike needs the rate
+over threshold in BOTH windows; recovery needs the fast window back
+under).  `sky serve top` renders the rate as the ERR/s column.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import logging
+import os
+import re
+import threading
+import time
+from typing import Any, Deque, Dict, Iterator, List, Optional
+
+from skypilot_tpu.serve import http_protocol
+
+# Default bound on the in-process record ring.  ~2k records of ~200
+# bytes keeps the whole plane under a megabyte per process.
+DEFAULT_RING_RECORDS = 2048
+
+# Levels that count toward the error-spike rate.
+_BAD_LEVELS = ('WARNING', 'ERROR', 'CRITICAL')
+
+# Series name shared by the handler counter and the spike tracker.
+LOG_RECORDS_SERIES = 'skytpu_log_records_total'
+
+
+def ring_records() -> int:
+    try:
+        return int(os.environ.get('SKYTPU_LOG_RING_RECORDS',
+                                  str(DEFAULT_RING_RECORDS)))
+    except ValueError:
+        return DEFAULT_RING_RECORDS
+
+
+def spike_fast_window_s() -> float:
+    return float(os.environ.get('SKYTPU_LOG_ERROR_SPIKE_FAST_WINDOW_S',
+                                '60'))
+
+
+def spike_slow_window_s() -> float:
+    return float(os.environ.get('SKYTPU_LOG_ERROR_SPIKE_SLOW_WINDOW_S',
+                                '300'))
+
+
+def spike_threshold() -> float:
+    """WARN+ERROR records per second above which a replica spikes."""
+    return float(os.environ.get('SKYTPU_LOG_ERROR_SPIKE_THRESHOLD',
+                                '1.0'))
+
+
+# --------------------------------------------------------------- context
+
+# One merged dict of bound fields (request_id/attempt/process/
+# replica_id/role).  asyncio tasks inherit it at creation; executor
+# threads need contextvars.copy_context().run (see wrap_context).
+_CTX: 'contextvars.ContextVar[Optional[Dict[str, Any]]]' = \
+    contextvars.ContextVar('skytpu_log_ctx', default=None)
+
+# Process-level fallback identity: the normal one-server-per-process
+# deployment sets it once at startup; tests hosting several "processes"
+# in one interpreter rely on the contextvar binding instead.
+_process_identity: Dict[str, Any] = {}
+
+
+def set_process_identity(process: str,
+                         replica_id: Optional[Any] = None,
+                         role: Optional[str] = None) -> None:
+    """Default identity stamped on records with no bound context."""
+    _process_identity.clear()
+    _process_identity['process'] = process
+    if replica_id is not None:
+        _process_identity['replica_id'] = replica_id
+    if role is not None:
+        _process_identity['role'] = role
+
+
+@contextlib.contextmanager
+def bind(request_id: Optional[str] = None,
+         attempt: Optional[int] = None,
+         process: Optional[str] = None,
+         replica_id: Optional[Any] = None,
+         role: Optional[str] = None) -> Iterator[None]:
+    """Bind request/identity fields for log records emitted inside the
+    context (merging over any outer binding; None fields inherit)."""
+    merged = dict(_CTX.get() or {})
+    for key, value in (('request_id', request_id), ('attempt', attempt),
+                       ('process', process), ('replica_id', replica_id),
+                       ('role', role)):
+        if value is not None:
+            merged[key] = value
+    token = _CTX.set(merged)
+    try:
+        yield
+    finally:
+        _CTX.reset(token)
+
+
+def current_context() -> Dict[str, Any]:
+    """The fields a record emitted right now would carry (bound
+    context over the process fallback)."""
+    out = dict(_process_identity)
+    out.update(_CTX.get() or {})
+    return out
+
+
+def wrap_context(fn):
+    """Carry the CURRENT context into a thread-pool callable: asyncio's
+    `run_in_executor` runs the function in a bare worker thread where
+    contextvars reset to defaults — the classic request-id-loss bug."""
+    ctx = contextvars.copy_context()
+    return lambda *args, **kwargs: ctx.run(fn, *args, **kwargs)
+
+
+# ------------------------------------------------------------------ ring
+
+def parse_log_query(query: str) -> Dict[str, Any]:
+    """`GET /logs` query args -> export kwargs; malformed values are
+    ignored, not 400s (same degradation contract as
+    tracing.parse_span_query — the CLI must survive version skew)."""
+    from urllib.parse import parse_qs  # pylint: disable=import-outside-toplevel
+    parsed = parse_qs(query or '')
+    out: Dict[str, Any] = {}
+    for key in ('request_id', 'level', 'grep'):
+        if parsed.get(key):
+            out[key] = parsed[key][0]
+    for key in ('since', 'limit'):
+        if parsed.get(key):
+            try:
+                value = float(parsed[key][0])
+                out[key] = int(value) if key == 'limit' else value
+            except ValueError:
+                pass
+    return out
+
+
+def _level_no(level: Any) -> Optional[int]:
+    """'warning' / 'WARNING' / '30' -> 30; unknown names -> None
+    (filter ignored rather than rejected)."""
+    if level is None:
+        return None
+    text = str(level).strip()
+    if not text:
+        return None
+    try:
+        return int(float(text))
+    except ValueError:
+        pass
+    resolved = logging.getLevelName(text.upper())
+    return resolved if isinstance(resolved, int) else None
+
+
+class LogRecordRing:
+    """Bounded ring of structured log records with exact `since=` seq
+    pagination (strictly-after cursor; seq is unique + monotonic)."""
+
+    def __init__(self, maxlen: Optional[int] = None) -> None:
+        self._records: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=maxlen if maxlen is not None else ring_records())
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def add(self, record: Dict[str, Any]) -> None:
+        with self._lock:
+            self._seq += 1
+            record['seq'] = self._seq
+            self._records.append(record)
+
+    def export(self, since: Optional[float] = None,
+               level: Any = None,
+               request_id: Optional[str] = None,
+               grep: Optional[str] = None,
+               limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Matching records oldest-first; `since` is a seq cursor
+        (records with seq > since), `level` a minimum severity,
+        `grep` a regex (substring fallback on a bad pattern),
+        `limit` keeps the newest n."""
+        with self._lock:
+            records = list(self._records)
+        min_no = _level_no(level)
+        pattern = None
+        if grep:
+            try:
+                pattern = re.compile(grep)
+            except re.error:
+                pattern = None
+        out = []
+        for rec in records:
+            if since is not None and rec['seq'] <= since:
+                continue
+            if min_no is not None and rec.get('levelno', 0) < min_no:
+                continue
+            if request_id is not None and \
+                    rec.get('request_id') != request_id:
+                continue
+            if grep:
+                msg = str(rec.get('msg', ''))
+                if pattern is not None:
+                    if not pattern.search(msg):
+                        continue
+                elif grep not in msg:
+                    continue
+            out.append(dict(rec))
+        if limit is not None:
+            out = out[-int(limit):]
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+
+_global_ring: Optional[LogRecordRing] = None
+_ring_lock = threading.Lock()
+
+
+def get_ring() -> LogRecordRing:
+    """The process-wide ring the installed handler writes to."""
+    global _global_ring
+    with _ring_lock:
+        if _global_ring is None:
+            _global_ring = LogRecordRing()
+        return _global_ring
+
+
+def reset_ring() -> LogRecordRing:
+    """Swap in a fresh ring (tests; re-reads the env cap).  Handlers
+    constructed without an explicit ring resolve through get_ring()
+    on every emit, so they follow the swap."""
+    global _global_ring
+    with _ring_lock:
+        _global_ring = LogRecordRing()
+        return _global_ring
+
+
+# --------------------------------------------------------------- metrics
+
+def _records_counter():
+    """Lazy: sky_logging._setup installs the handler during the FIRST
+    init_logger call, which can happen while metrics.py itself is
+    still importing — instruments must not be created at import."""
+    from skypilot_tpu.observability import metrics as metrics_lib  # pylint: disable=import-outside-toplevel
+    # Literal name (= LOG_RECORDS_SERIES): the metrics-catalog lint
+    # ties doc rows to statically visible registrations.
+    return metrics_lib.counter(
+        'skytpu_log_records_total',
+        'Log records captured by the structured handler, per level.',
+        ('level',))
+
+
+def _http_counter():
+    from skypilot_tpu.observability import metrics as metrics_lib  # pylint: disable=import-outside-toplevel
+    return metrics_lib.counter(
+        'skytpu_http_requests_total',
+        'HTTP requests served by the serving fronts, per route and '
+        'status code.', ('route', 'code'))
+
+
+def _spike_gauges():
+    from skypilot_tpu.observability import metrics as metrics_lib  # pylint: disable=import-outside-toplevel
+    rate = metrics_lib.gauge(
+        'skytpu_log_error_rate',
+        'Windowed WARN+ERROR log records per second, per replica and '
+        'evaluation window.', ('service', 'replica_id', 'window'))
+    spiking = metrics_lib.gauge(
+        'skytpu_log_error_spiking',
+        'Whether the replica is inside a log error spike (rate above '
+        'threshold in both windows).', ('service', 'replica_id'))
+    return rate, spiking
+
+
+# -------------------------------------------------------------- handler
+
+class StructuredLogHandler(logging.Handler):
+    """Capture every framework record into the ring + level counter.
+
+    emit() is on the path of every log call the process makes, so it
+    does the minimum: getMessage, one dict, one deque append, one
+    counter bump — and never raises (a broken observability plane must
+    not take the serving plane with it)."""
+
+    def __init__(self, ring: Optional[LogRecordRing] = None) -> None:
+        super().__init__(level=logging.DEBUG)
+        self._ring = ring
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            entry: Dict[str, Any] = {
+                'ts': record.created,
+                'level': record.levelname,
+                'levelno': record.levelno,
+                'logger': record.name,
+                'msg': record.getMessage(),
+            }
+            entry.update(_process_identity)
+            bound = _CTX.get()
+            if bound:
+                entry.update(bound)
+            (self._ring or get_ring()).add(entry)
+            _records_counter().labels(level=record.levelname).inc()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+# ----------------------------------------------------------- access logs
+
+# Scrape/probe hot paths whose per-request access lines log at DEBUG:
+# the controller polls them every few seconds and the ring must not be
+# wall-to-wall scrape noise.  Generation routes stay at INFO.
+# ('/health' is the replica fronts' catch-all GET, not a canonical
+# protocol path — every other entry comes from http_protocol.)
+HEALTH_ROUTE = '/health'
+PROBE_ROUTES = (HEALTH_ROUTE, http_protocol.METRICS,
+                http_protocol.SPANS, http_protocol.PROFILE,
+                http_protocol.LOGS, http_protocol.LB_METRICS,
+                http_protocol.LB_SPANS, http_protocol.LB_STATE,
+                http_protocol.LB_LOGS)
+
+
+def access_log(logger: logging.Logger, method: str, route: str,
+               code: int) -> None:
+    """Count + log one served HTTP request.  `route` must be the
+    matched route constant, never the raw path (label cardinality)."""
+    try:
+        _http_counter().labels(route=route, code=str(code)).inc()
+    except Exception:  # pylint: disable=broad-except
+        pass
+    level = logging.DEBUG if route in PROBE_ROUTES else logging.INFO
+    logger.log(level, f'{method} {route} -> {code}')
+
+
+# ---------------------------------------------------------- spike alerts
+
+def error_rates(store: Any, window_s: float, now: float
+                ) -> Dict[str, float]:
+    """Per-replica WARN+ERROR records/s from the scraped fleet store:
+    {replica_id: rate} over every replica whose log counter the
+    aggregator has seen (the scraper stamps replica_id/role labels on
+    every ingested series)."""
+    rates: Dict[str, float] = {}
+    rids = {labels.get('replica_id')
+            for labels, _ in store.series(LOG_RECORDS_SERIES)
+            if labels.get('replica_id') not in (None, '')}
+    for rid in sorted(rids):
+        total = None
+        for level in _BAD_LEVELS:
+            rate = store.counter_rate(LOG_RECORDS_SERIES, window_s,
+                                      now, replica_id=rid, level=level)
+            if rate is not None:
+                total = (total or 0.0) + rate
+        if total is not None:
+            rates[str(rid)] = total
+    return rates
+
+
+class LogSpikeTracker:
+    """Journal `log_error_spike_start/_end` per replica — the same
+    multi-window shape as SLO burn: a spike needs the WARN+ERROR rate
+    above threshold in BOTH the fast and slow windows; recovery needs
+    the fast window back under it."""
+
+    def __init__(self, service_name: str,
+                 journal: Optional[Any] = None) -> None:
+        self.service_name = service_name
+        self._journal = journal
+        # replica_id -> spike start ts while spiking.
+        self._spiking: Dict[str, float] = {}
+        self._last: List[Dict[str, Any]] = []
+
+    def _get_journal(self):
+        if self._journal is not None:
+            return self._journal
+        from skypilot_tpu.observability import events as events_lib  # pylint: disable=import-outside-toplevel
+        return events_lib.get_journal(
+            os.path.join(events_lib.journal_root(), 'serve.jsonl'))
+
+    def _journal_event(self, event: str, **fields: Any) -> None:
+        try:
+            self._get_journal().append(event,
+                                       service=self.service_name,
+                                       **fields)
+        except Exception:  # pylint: disable=broad-except
+            pass  # recording must never break the control plane
+
+    def evaluate(self, store: Any, now: Optional[float] = None
+                 ) -> List[Dict[str, Any]]:
+        """One pass over the fleet store; returns (and caches)
+        per-replica status dicts for `/controller/telemetry`."""
+        now = time.time() if now is None else now
+        fast_w, slow_w = spike_fast_window_s(), spike_slow_window_s()
+        threshold = spike_threshold()
+        fast = error_rates(store, fast_w, now)
+        slow = error_rates(store, slow_w, now)
+        gauge_rate, gauge_spiking = _spike_gauges()
+        logger = logging.getLogger(
+            'skypilot_tpu.observability.logs')
+        out: List[Dict[str, Any]] = []
+        for rid in sorted(set(fast) | set(slow) | set(self._spiking)):
+            rate_fast = fast.get(rid, 0.0)
+            rate_slow = slow.get(rid, 0.0)
+            for window, rate in (('fast', rate_fast),
+                                 ('slow', rate_slow)):
+                gauge_rate.labels(service=self.service_name,
+                                  replica_id=rid,
+                                  window=window).set(round(rate, 6))
+            was_spiking = rid in self._spiking
+            if not was_spiking:
+                spiking = (rate_fast > threshold and
+                           rate_slow > threshold)
+            else:
+                # Recovery needs only the fast window back under: the
+                # slow window remembers the spike long after the
+                # replica quiets down.
+                spiking = rate_fast > threshold
+            if spiking and not was_spiking:
+                self._spiking[rid] = now
+                self._journal_event(
+                    'log_error_spike_start', replica_id=rid,
+                    rate_fast=round(rate_fast, 4),
+                    rate_slow=round(rate_slow, 4),
+                    threshold=threshold,
+                    window_fast_s=fast_w, window_slow_s=slow_w)
+                logger.warning(
+                    f'log error spike on replica {rid} of '
+                    f'{self.service_name}: {rate_fast:.2f} err/s fast '
+                    f'/ {rate_slow:.2f} slow (threshold {threshold})')
+            elif not spiking and was_spiking:
+                started = self._spiking.pop(rid)
+                self._journal_event(
+                    'log_error_spike_end', replica_id=rid,
+                    duration_s=round(now - started, 3),
+                    rate_fast=round(rate_fast, 4))
+                logger.info(
+                    f'log error spike on replica {rid} of '
+                    f'{self.service_name} ended after '
+                    f'{now - started:.0f}s')
+            gauge_spiking.labels(service=self.service_name,
+                                 replica_id=rid).set(
+                                     1.0 if spiking else 0.0)
+            out.append({
+                'replica_id': rid,
+                'rate_fast': round(rate_fast, 4),
+                'rate_slow': round(rate_slow, 4),
+                'threshold': threshold,
+                'spiking': spiking,
+                'since': self._spiking.get(rid),
+            })
+        self._last = out
+        return out
+
+    def status(self) -> List[Dict[str, Any]]:
+        """The most recent evaluation (for the telemetry endpoint)."""
+        return list(self._last)

@@ -97,11 +97,13 @@ def assemble(segments: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
 
 
 def _who(seg: Dict[str, Any]) -> str:
-    if seg.get('process') == 'lb':
+    proc = seg.get('process')
+    if proc == 'lb':
         return 'lb'
     rid = seg.get('replica_id')
     role = seg.get('role')
-    who = f'replica {rid}' if rid is not None else 'replica'
+    who = (f'replica {rid}' if rid is not None
+           else str(proc or 'replica'))
     return f'{who} ({role})' if role else who
 
 
@@ -151,6 +153,66 @@ def format_waterfall(segments: List[Dict[str, Any]],
     return ['  '.join(cell.ljust(w)
                       for cell, w in zip(row[:5], widths)).rstrip() +
             '  ' + row[5] for row in rows]
+
+
+def fetch_log_records(url: str, path: str = http_protocol.LOGS,
+                      timeout: float = 5.0,
+                      **query: Any) -> List[Dict[str, Any]]:
+    """One process's structured log records (`GET /logs` family); []
+    on any failure — like spans, the fan-in is best-effort."""
+    params = {k: v for k, v in query.items() if v is not None}
+    try:
+        resp = requests.get(url.rstrip('/') + path, params=params,
+                            timeout=timeout)
+        if resp.status_code != 200:
+            return []
+        return (resp.json() or {}).get('records') or []
+    except (requests.RequestException, ValueError) as e:
+        logger.debug(f'log fetch failed for {url}: {e}')
+        return []
+
+
+def interleave_logs(segments: List[Dict[str, Any]],
+                    records: List[Dict[str, Any]],
+                    width: int = 40) -> List[str]:
+    """The waterfall with the request's log lines slotted in by wall
+    time (`sky serve trace <rid>`): each record renders after the last
+    segment/phase row that started at or before it, so a warning
+    emitted mid-prefill reads under the prefill bar."""
+    records = sorted(records, key=lambda r: float(r.get('ts') or 0.0))
+    if not segments:
+        if not records:
+            return ['(no segments)']
+        t0 = float(records[0].get('ts') or 0.0)
+        return [_log_line(r, t0) for r in records]
+    lines = format_waterfall(segments, width)
+    # Row anchors mirror format_waterfall's emission order exactly:
+    # one per segment, then one per phase of that segment.
+    anchors: List[float] = []
+    for seg in segments:
+        start = float(seg.get('start') or 0.0)
+        anchors.append(start)
+        for phase in seg.get('phases') or []:
+            anchors.append(float(phase.get('start') or start))
+    t0 = min(float(s.get('start') or 0.0) for s in segments)
+    out: List[str] = []
+    ri = 0
+    for line, anchor in zip(lines, anchors):
+        while (ri < len(records) and
+               float(records[ri].get('ts') or 0.0) < anchor):
+            out.append(_log_line(records[ri], t0))
+            ri += 1
+        out.append(line)
+    out.extend(_log_line(r, t0) for r in records[ri:])
+    return out
+
+
+def _log_line(record: Dict[str, Any], t0: float) -> str:
+    ts = float(record.get('ts') or 0.0)
+    level = str(record.get('level') or '?')
+    msg = str(record.get('msg') or '')
+    return (f'+{(ts - t0) * 1e3:.1f}ms  [{_who(record)}] '
+            f'{level[:1]} {record.get("logger", "?")}: {msg}')
 
 
 def to_chrome_trace(segments: List[Dict[str, Any]]

@@ -28,6 +28,7 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import logs as logs_lib
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import batching_engine as batching_engine_lib
@@ -231,13 +232,22 @@ class AsyncModelServer:
         temperature, top_k, seed = self._sampling(req)
         handles: list = []
         loop = asyncio.get_running_loop()
-        gen = loop.run_in_executor(
-            None, lambda: self.server.generate(
-                req['prompt_ids'],
-                int(req.get('max_new_tokens', 16)),
-                temperature, top_k, seed=seed, request_id=rid,
-                route_meta=route_meta, deadline_ms=deadline_ms,
-                qos_class=qos_class, on_submit=handles.extend))
+
+        def _call():
+            # Explicit rid bind: the context carries the header's id,
+            # but a direct hit may have had rid generated above.
+            with logs_lib.bind(request_id=rid):
+                return self.server.generate(
+                    req['prompt_ids'],
+                    int(req.get('max_new_tokens', 16)),
+                    temperature, top_k, seed=seed, request_id=rid,
+                    route_meta=route_meta, deadline_ms=deadline_ms,
+                    qos_class=qos_class, on_submit=handles.extend)
+        # wrap_context: run_in_executor runs the callable in a bare
+        # pool thread where contextvars reset — without the copied
+        # context, records emitted inside generate() would lose (or
+        # worse, inherit a sibling's) request id.
+        gen = loop.run_in_executor(None, logs_lib.wrap_context(_call))
         if watch_disconnect and reader is not None:
             # Connection: close (the LB's routed path, one-shot
             # clients): no further request bytes are legitimate, so a
@@ -287,9 +297,10 @@ class AsyncModelServer:
                                   '--continuous-batching')
         try:
             return await asyncio.get_running_loop().run_in_executor(
-                None, lambda: engine.export_prefix_pages(
-                    max_pages=int(req.get('max_pages', 64)),
-                    binary=binary))
+                None, logs_lib.wrap_context(
+                    lambda: engine.export_prefix_pages(
+                        max_pages=int(req.get('max_pages', 64)),
+                        binary=binary)))
         except handoff_lib.HandoffError as e:
             raise _HttpError(404, str(e)) from e
 
@@ -312,9 +323,11 @@ class AsyncModelServer:
             prompt = prompt[0]
         try:
             return await asyncio.get_running_loop().run_in_executor(
-                None, lambda: engine.export_prefill(
-                    [int(t) for t in prompt],
-                    page_size=req.get('page_size'), binary=binary))
+                None, logs_lib.wrap_context(
+                    lambda: engine.export_prefill(
+                        [int(t) for t in prompt],
+                        page_size=req.get('page_size'),
+                        binary=binary)))
         except handoff_lib.HandoffError as e:
             raise _HttpError(400, str(e)) from e
 
@@ -333,11 +346,12 @@ class AsyncModelServer:
         try:
             imported, cached = (
                 await asyncio.get_running_loop().run_in_executor(
-                    None, lambda: engine.import_pages(
-                        decoded['hashes'], decoded['page_size'],
-                        decoded['k'], decoded['v'],
-                        k_scale=decoded.get('k_scale'),
-                        v_scale=decoded.get('v_scale'))))
+                    None, logs_lib.wrap_context(
+                        lambda: engine.import_pages(
+                            decoded['hashes'], decoded['page_size'],
+                            decoded['k'], decoded['v'],
+                            k_scale=decoded.get('k_scale'),
+                            v_scale=decoded.get('v_scale')))))
         except handoff_lib.HandoffRejected as e:
             raise _HttpError(503, str(e)) from e
         except handoff_lib.HandoffError as e:
@@ -373,13 +387,17 @@ class AsyncModelServer:
             return
         t0 = time.perf_counter()
         temperature, top_k, seed = self._sampling(req)
+
+        def _call():
+            with logs_lib.bind(request_id=rid):
+                return server.generate(
+                    [ids], int(req.get('max_new_tokens', 64)),
+                    temperature, top_k,
+                    stop_token=tok.eos_ids or None, seed=seed,
+                    request_id=rid, route_meta=route_meta,
+                    deadline_ms=deadline_ms, qos_class=qos_class)
         tokens = (await asyncio.get_running_loop().run_in_executor(
-            None, lambda: server.generate(
-                [ids], int(req.get('max_new_tokens', 64)),
-                temperature, top_k,
-                stop_token=tok.eos_ids or None, seed=seed,
-                request_id=rid, route_meta=route_meta,
-                deadline_ms=deadline_ms, qos_class=qos_class)))[0]
+            None, logs_lib.wrap_context(_call)))[0]
         stops = [i for i, t in enumerate(tokens) if t in tok.eos_ids]
         if stops:
             tokens = tokens[:stops[0]]
@@ -513,6 +531,24 @@ class AsyncModelServer:
                     break
                 method, path, headers, body = parsed
                 path, _, query = path.partition('?')
+                route = (path if path in http_protocol.REPLICA_PATHS
+                         else (logs_lib.HEALTH_ROUTE
+                               if method == 'GET' else 'unknown'))
+                status = 200
+                # Request-scoped log context for everything this task
+                # awaits while serving the request (contextvars flow
+                # through awaits natively; executor hops re-wrap via
+                # logs_lib.wrap_context).  Entered without `with` so
+                # the existing try/except chain keeps its shape; the
+                # finally below closes it.
+                _log_ctx = logs_lib.bind(
+                    request_id=headers.get(_REQUEST_ID_KEY),
+                    attempt=model_server_lib._attempt_header(  # pylint: disable=protected-access
+                        headers.get(router_lib.ATTEMPT_HEADER.lower())),
+                    process='replica',
+                    replica_id=self.server.replica_id,
+                    role=self.server.role)
+                _log_ctx.__enter__()  # pylint: disable=unnecessary-dunder-call
                 try:
                     if method == 'GET':
                         if path == http_protocol.METRICS:
@@ -538,8 +574,17 @@ class AsyncModelServer:
                             # ring + recompile sentinel).
                             writer.write(_json_response(
                                 200, self.server.export_profile()))
+                        elif path == http_protocol.LOGS:
+                            # Structured log-ring export (sky serve
+                            # logs): recent records, seq-paginated.
+                            writer.write(_json_response(
+                                200, {'records':
+                                      logs_lib.get_ring().export(
+                                          **logs_lib.parse_log_query(
+                                              query))}))
                         else:
                             code, payload = self._health()
+                            status = code
                             writer.write(_json_response(code, payload))
                         await writer.drain()
                         continue
@@ -691,13 +736,16 @@ class AsyncModelServer:
                     else:
                         raise _HttpError(404, 'unknown path')
                 except _HttpError as e:
+                    status = e.code
                     writer.write(_json_response(
                         e.code, {'error': str(e)}, e.headers))
                     await writer.drain()
                 except (KeyError, ValueError, TypeError) as e:
+                    status = 400
                     writer.write(_json_response(400, {'error': str(e)}))
                     await writer.drain()
                 except (BrokenPipeError, ConnectionResetError):
+                    status = 0  # client gone; nothing went on the wire
                     break
                 except Exception as e:  # pylint: disable=broad-except
                     # Engine failures must reach the client as HTTP,
@@ -705,12 +753,19 @@ class AsyncModelServer:
                     # 429/503 + Retry-After.
                     bp = _backpressure_error(e)
                     if bp is not None:
+                        status = bp.code
                         writer.write(_json_response(
                             bp.code, {'error': str(bp)}, bp.headers))
                     else:
+                        status = 500
                         writer.write(_json_response(
                             500, {'error': f'{type(e).__name__}: {e}'}))
                     await writer.drain()
+                finally:
+                    # Access log INSIDE the binding so the record
+                    # carries the request identity.
+                    logs_lib.access_log(logger, method, route, status)
+                    _log_ctx.__exit__(None, None, None)
         except (BrokenPipeError, ConnectionResetError,
                 asyncio.IncompleteReadError):
             pass

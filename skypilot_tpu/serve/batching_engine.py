@@ -115,6 +115,7 @@ import time
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import logs as logs_lib
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import profiling
 from skypilot_tpu.observability import tracing
@@ -1446,8 +1447,16 @@ class ContinuousBatchingEngine:
                         break
                     admitted = True
                     try:
-                        pending = self._start_admission(slot_id,
-                                                        request)
+                        # Bind request identity so engine-worker log
+                        # lines land in the structured ring under the
+                        # request that triggered them (the worker
+                        # thread never sees the HTTP front's context).
+                        with logs_lib.bind(
+                                request_id=request.request_id,
+                                **(getattr(self, 'log_identity', None)
+                                   or {})):
+                            pending = self._start_admission(
+                                slot_id, request)
                     except cache_manager.PagesExhausted:
                         self._queue.requeue_front(request)
                         with self._metrics_lock:

@@ -24,6 +24,7 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.chaos import injector as chaos_injector
 from skypilot_tpu.observability import aggregator as aggregator_lib
+from skypilot_tpu.observability import logs as logs_lib
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import slo as slo_lib
 from skypilot_tpu.serve import autoscalers
@@ -103,6 +104,9 @@ class SkyServeController:
         self.aggregator = aggregator_lib.FleetAggregator(service_name)
         self.slo_tracker = slo_lib.SLOTracker(
             service_name, slo_lib.parse_slos(self.spec.slos))
+        # Fleet log plane (ISSUE 19): per-replica WARN+ERROR-rate
+        # spikes, journaled like SLO burn and rendered by serve top.
+        self.log_spikes = logs_lib.LogSpikeTracker(service_name)
         self.port = port
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -136,15 +140,21 @@ class SkyServeController:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == http_protocol.CONTROLLER_SYNC:
+                path, _, query = self.path.partition('?')
+                if path == http_protocol.CONTROLLER_SYNC:
                     self._json(200, controller.sync_payload())
-                elif self.path.split('?', 1)[0] == \
-                        http_protocol.CONTROLLER_TELEMETRY:
+                elif path == http_protocol.CONTROLLER_TELEMETRY:
                     # What `sky serve top` renders: per-role sparkline
                     # series + windowed quantiles out of the
                     # aggregator's ring buffers, SLO status, MFU, and
                     # the slowest recent traces.
                     self._json(200, controller.telemetry())
+                elif path == http_protocol.CONTROLLER_LOGS:
+                    self._json(
+                        200, {
+                            'records': logs_lib.get_ring().export(
+                                **logs_lib.parse_log_query(query))
+                        })
                 else:
                     self._json(404, {'error': 'unknown path'})
 
@@ -427,6 +437,13 @@ class SkyServeController:
                                           time.time())
             except Exception:  # pylint: disable=broad-except
                 logger.exception('SLO evaluation failed')
+        # Log-spike evaluation: per-replica WARN+ERROR rates from the
+        # scraped skytpu_log_records_total counters; excursions journal
+        # log_error_spike_start/_end.
+        try:
+            self.log_spikes.evaluate(self.aggregator.store, time.time())
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('log spike evaluation failed')
         self._replace_outdated()
         self._update_service_status()
         # Push the (possibly changed) ready set to every router
@@ -630,6 +647,7 @@ class SkyServeController:
             **self.aggregator.fleet_snapshot(
                 roles=sorted(self.autoscalers)),
             'slos': self.slo_tracker.status(),
+            'log_spikes': self.log_spikes.status(),
         }
 
     def _update_service_status(self) -> None:

@@ -50,6 +50,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.chaos import injector as chaos_injector
+from skypilot_tpu.observability import logs as logs_lib
 from skypilot_tpu.observability import metrics as metrics_lib
 
 logger = sky_logging.init_logger(__name__)
@@ -120,7 +121,14 @@ def _execute(rank: int, cmd: Command,
     missing/failed ack and the slice degrades as a unit."""
     chaos_injector.inject('serve.rank_exec', rank=rank, command=cmd.kind)
     if executor is not None:
-        executor(cmd)
+        rid = cmd.payload.get('request_id') if cmd.payload else None
+        if rid is not None:
+            # ADMIT replays carry the originating request id — bind it
+            # so follower-rank log lines correlate in `serve logs`.
+            with logs_lib.bind(request_id=str(rid)):
+                executor(cmd)
+        else:
+            executor(cmd)
 
 
 class RankChannel:

@@ -61,11 +61,12 @@ DRAIN = '/drain'                      # POST: controller retirement path
 PREFIX_EXPORT = '/prefix_export'      # POST: drain-time sibling handoff
 ROLE_BUDGET = '/role_budget'          # POST: rebalance push / role morph
 PROFILE = '/profile'                  # GET: tick-phase profiling ring
+LOGS = '/logs'                        # GET: structured log-ring export
 # Any other GET answers the health/readiness payload (the probe path).
 
 REPLICA_PATHS = (METRICS, SPANS, GENERATE, GENERATE_STREAM,
                  GENERATE_TEXT, PREFILL_EXPORT, KV_IMPORT, DRAIN,
-                 PREFIX_EXPORT, ROLE_BUDGET, PROFILE)
+                 PREFIX_EXPORT, ROLE_BUDGET, PROFILE, LOGS)
 
 # ------------------------------------------------- LB control plane (the
 # `/lb/` prefix is never proxied; the LB answers these itself)
@@ -78,8 +79,9 @@ LB_SPANS = '/lb/spans'                # GET: LB trace segments
 # replicate retire/affinity deltas peer-to-peer so a prefix pinned on
 # one instance re-homes identically on all of them.
 LB_STATE = '/lb/state'                # POST: ready/retired/affinity deltas
+LB_LOGS = '/lb/logs'                  # GET: LB structured log ring
 
-LB_PATHS = (LB_RETIRE, LB_METRICS, LB_SPANS, LB_STATE)
+LB_PATHS = (LB_RETIRE, LB_METRICS, LB_SPANS, LB_STATE, LB_LOGS)
 
 # ------------------------------------------------------------ controller
 CONTROLLER_PREFIX = '/controller/'
@@ -87,8 +89,10 @@ CONTROLLER_SYNC = '/controller/load_balancer_sync'   # GET+POST
 CONTROLLER_TELEMETRY = '/controller/telemetry'       # GET: serve top
 CONTROLLER_UPDATE = '/controller/update_service'     # POST
 CONTROLLER_TERMINATE = '/controller/terminate'       # POST
+CONTROLLER_LOGS = '/controller/logs'                 # GET: log ring
 
 CONTROLLER_PATHS = (CONTROLLER_SYNC, CONTROLLER_TELEMETRY,
-                    CONTROLLER_UPDATE, CONTROLLER_TERMINATE)
+                    CONTROLLER_UPDATE, CONTROLLER_TERMINATE,
+                    CONTROLLER_LOGS)
 
 PATHS = REPLICA_PATHS + LB_PATHS + CONTROLLER_PATHS

@@ -47,6 +47,7 @@ from urllib.parse import urlsplit
 import requests
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import logs as logs_lib
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import brain_store as brain_store_lib
@@ -904,9 +905,22 @@ class SkyServeLoadBalancer:
                  f'Content-Type: application/json\r\n'
                  f'Content-Length: {len(payload)}\r\n'
                  f'Connection: close\r\n\r\n').encode() + payload)
+        elif method == 'GET' and path == http_protocol.LB_LOGS:
+            # This LB process's structured log ring, seq-paginated
+            # (sky serve logs fans it in next to the replica rings).
+            payload = json.dumps({'records': logs_lib.get_ring().export(
+                **logs_lib.parse_log_query(query))}).encode()
+            writer.write(
+                (f'HTTP/1.1 200 OK\r\n'
+                 f'Content-Type: application/json\r\n'
+                 f'Content-Length: {len(payload)}\r\n'
+                 f'Connection: close\r\n\r\n').encode() + payload)
         else:
             writer.write(_simple_response(
                 404, 'Not Found', b'unknown LB control path'))
+        route = path if path in http_protocol.LB_PATHS else 'unknown'
+        logs_lib.access_log(logger, method, route,
+                            200 if route != 'unknown' else 404)
         await writer.drain()
 
     # ------------------------------------------------------ routed path
@@ -1013,12 +1027,28 @@ class SkyServeLoadBalancer:
         _M_ROUTER_QOS.labels(router=router_label, qos_class=qos_class,
                              outcome='admitted').inc()
         qos_status = 'error'
+        # Request-scoped log context for the routed leg: every record
+        # the LB emits while relaying this request (routing decisions,
+        # handoff legs, retries) carries the request id + process=lb.
+        _log_ctx = logs_lib.bind(request_id=rid, process='lb')
+        _log_ctx.__enter__()  # pylint: disable=unnecessary-dunder-call
         try:
             await self._route_admitted(cwriter, start_line, headers,
                                        body, t_start, wall_start, rid,
                                        qos_class)
             qos_status = 'ok'
         finally:
+            # Access log inside the binding: the routed leg's record
+            # carries request_id + process=lb for `serve logs` fan-in.
+            parts = start_line.split(' ')
+            req_path = (parts[1].partition('?')[0]
+                        if len(parts) > 1 else '')
+            logs_lib.access_log(
+                logger, parts[0] if parts else '?',
+                (req_path if req_path in http_protocol.REPLICA_PATHS
+                 else 'unknown'),
+                200 if qos_status == 'ok' else 500)
+            _log_ctx.__exit__(None, None, None)
             with self._lock:
                 n = self._qos_inflight.get(qos_class, 0) - 1
                 if n <= 0:

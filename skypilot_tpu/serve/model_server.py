@@ -52,6 +52,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import logs as logs_lib
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import batching_engine as batching_engine_lib
@@ -294,6 +295,10 @@ class ModelServer:
             metrics_lib.REGISTRY.set_const_labels({
                 'replica_id': env_rid, 'role': role,
                 'num_hosts': int(num_hosts)})
+            # Same ownership rule for the log plane's process-level
+            # identity fallback (per-request contextvar binds win).
+            logs_lib.set_process_identity(
+                'replica', replica_id=int(env_rid), role=role)
         _M_PROCESS_INFO.set(1)
         # Trace segments for non-engine legs of a request's life (the
         # /prefill_export and /kv_import handoff endpoints record
@@ -435,6 +440,14 @@ class ModelServer:
                     page_size=page_size, quantize_kv=quantize_kv,
                     prefix_caching=prefix_caching,
                     spec_tokens=spec_tokens)
+        if self._engine is not None:
+            # The engine worker thread emits records outside any HTTP
+            # request context; it stamps this identity (plus the
+            # request id it re-binds around each admission) so the log
+            # plane can attribute worker-side lines in-process too.
+            self._engine.log_identity = {
+                'process': 'replica', 'replica_id': self.replica_id,
+                'role': self.role}
 
     def close(self) -> None:
         """Release background resources (the batching engine's worker
@@ -649,6 +662,13 @@ def _make_handler(server: ModelServer):
         def log_message(self, *args):
             del args
 
+        def send_response(self, code, message=None):
+            # Remember the status for the access log/counter (the
+            # last send_response of the exchange wins, matching what
+            # actually went on the wire).
+            self._status = code
+            super().send_response(code, message)
+
         def _read_body(self) -> bytes:
             length = int(self.headers.get('Content-Length', 0))
             return self.rfile.read(length)
@@ -777,6 +797,28 @@ def _make_handler(server: ModelServer):
 
         def do_GET(self):
             path, _, query = self.path.partition('?')
+            route = (path if path in http_protocol.REPLICA_PATHS
+                     else logs_lib.HEALTH_ROUTE)
+            self._status = 0
+            # Request-scoped log context: every record emitted while
+            # handling this request carries the propagated id + this
+            # replica's identity.  Probe/scrape access lines log at
+            # DEBUG (logs_lib.PROBE_ROUTES) so the ring isn't
+            # wall-to-wall controller scrape noise.
+            with logs_lib.bind(
+                    request_id=self.headers.get(
+                        tracing.REQUEST_ID_HEADER),
+                    attempt=_attempt_header(
+                        self.headers.get(router_lib.ATTEMPT_HEADER)),
+                    process='replica', replica_id=server.replica_id,
+                    role=server.role):
+                try:
+                    self._get(path, query)
+                finally:
+                    logs_lib.access_log(logger, 'GET', route,
+                                        self._status)
+
+        def _get(self, path, query):
             if path == http_protocol.METRICS:
                 engine = server._engine  # pylint: disable=protected-access
                 if engine is not None:
@@ -800,6 +842,12 @@ def _make_handler(server: ModelServer):
                 # Continuous-profiling export: tick-phase ring +
                 # recompile sentinel (sky serve profile).
                 self._reply(200, server.export_profile())
+                return
+            if path == http_protocol.LOGS:
+                # Structured log-ring export (sky serve logs): this
+                # process's recent records, seq-cursor paginated.
+                self._reply(200, {'records': logs_lib.get_ring().export(
+                    **logs_lib.parse_log_query(query))})
                 return
             payload = {'status': 'ok',
                        'model': f'{server.cfg.d_model}x'
@@ -1176,6 +1224,24 @@ def _make_handler(server: ModelServer):
                                 {'error': f'{type(e).__name__}: {e}'})
 
         def do_POST(self):
+            path = self.path.partition('?')[0]
+            route = (path if path in http_protocol.REPLICA_PATHS
+                     else 'unknown')
+            self._status = 0
+            with logs_lib.bind(
+                    request_id=self.headers.get(
+                        tracing.REQUEST_ID_HEADER),
+                    attempt=_attempt_header(
+                        self.headers.get(router_lib.ATTEMPT_HEADER)),
+                    process='replica', replica_id=server.replica_id,
+                    role=server.role):
+                try:
+                    self._post()
+                finally:
+                    logs_lib.access_log(logger, 'POST', route,
+                                        self._status)
+
+        def _post(self):
             if self.path == http_protocol.GENERATE_STREAM:
                 self._generate_stream()
                 return

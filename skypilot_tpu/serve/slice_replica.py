@@ -232,7 +232,8 @@ class SliceReplicaEngine(batching_engine_lib.ContinuousBatchingEngine):
             stop_ids=sorted(int(s) for s in request.stop_ids),
             key=np.asarray(key).tolist(),
             temperature=float(request.temperature),
-            top_k=int(request.top_k), row=row)
+            top_k=int(request.top_k), row=row,
+            request_id=request.request_id)
         request.span.slice_sync_ms = round(
             self._coordinator.sync_ms_mean(), 4)
         super()._activate(slot_id, request, token, length,

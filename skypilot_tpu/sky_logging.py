@@ -15,9 +15,15 @@ import threading
 _FORMAT = '%(levelname).1s %(asctime)s %(filename)s:%(lineno)d] %(message)s'
 _DATE_FORMAT = '%m-%d %H:%M:%S'
 
-_lock = threading.Lock()
+# Reentrant: _setup imports the structured-handler module while
+# holding the lock, and that import chain may itself call init_logger
+# (which re-enters _setup and returns on the already-set handler).
+_lock = threading.RLock()
 _root_logger = logging.getLogger('skypilot_tpu')
 _default_handler: 'logging.Handler | None' = None
+# The fleet log plane (observability/logs.py): every record also lands
+# in the bounded structured ring behind `GET /logs`.
+_structured_handler: 'logging.Handler | None' = None
 
 # Thread-local silence flag, toggled by the `silent()` context manager.
 _local = threading.local()
@@ -34,7 +40,7 @@ class _FmtFilter(logging.Filter):
 
 
 def _setup() -> None:
-    global _default_handler
+    global _default_handler, _structured_handler
     with _lock:
         if _default_handler is not None:
             return
@@ -45,6 +51,14 @@ def _setup() -> None:
             logging.Formatter(fmt, datefmt=_DATE_FORMAT))
         _default_handler.addFilter(_FmtFilter())
         _root_logger.addHandler(_default_handler)
+        try:
+            # Deferred import: the first init_logger call can arrive
+            # while observability modules are themselves importing.
+            from skypilot_tpu.observability import logs as _logs  # pylint: disable=import-outside-toplevel
+            _structured_handler = _logs.StructuredLogHandler()
+            _root_logger.addHandler(_structured_handler)
+        except Exception:  # pylint: disable=broad-except
+            _structured_handler = None  # never break logging itself
         level = logging.DEBUG if os.environ.get('SKYTPU_DEBUG') else logging.INFO
         _root_logger.setLevel(level)
         _root_logger.propagate = False
@@ -56,12 +70,15 @@ def init_logger(name: str) -> logging.Logger:
 
 
 def reload_logger() -> None:
-    """Re-create the handler (e.g. after env flags change in tests)."""
-    global _default_handler
+    """Re-create the handlers (e.g. after env flags change in tests)."""
+    global _default_handler, _structured_handler
     with _lock:
         if _default_handler is not None:
             _root_logger.removeHandler(_default_handler)
             _default_handler = None
+        if _structured_handler is not None:
+            _root_logger.removeHandler(_structured_handler)
+            _structured_handler = None
     _setup()
 
 

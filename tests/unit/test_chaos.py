@@ -627,6 +627,19 @@ class TestScenarios:
         assert result.details['itl_p99_s'] <= 2.5
         assert result.details['post_morph_routes'] >= 1
 
+    def test_error_spike(self, local_infra):
+        """ISSUE 19 chaos satellite: a rank death floods the replica's
+        WARN/ERROR log rate -> the fleet log plane journals
+        log_error_spike_start, and once the fleet quiets the spike
+        terminates; journal replay (log_spike_terminates) proves every
+        spike start reached its end."""
+        result = scenarios_lib.run_scenario('error_spike', seed=19)
+        assert result.ok, (result.violations, result.details)
+        assert any(s['spiking'] for s in result.details['during'])
+        assert not any(s['spiking'] for s in result.details['after'])
+        assert [f['site'] for f in result.fault_sequence] == \
+            ['serve.rank_exec']
+
     def test_router_instance_death(self, local_infra):
         """ISSUE 15 acceptance: one router of a two-router tier is
         killed mid-traffic -> the hash ring re-homes its prefix keys

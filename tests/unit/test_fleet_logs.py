@@ -1,0 +1,406 @@
+"""Fleet log plane unit tests (ISSUE 19): the structured record ring,
+request-identity context binding, the access-log demotion + HTTP
+counter, the error-spike tracker's journal alerts, and the CLI fan-in
+helpers — plus the handler's ≤3% overhead budget.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu import cli
+from skypilot_tpu import sky_logging
+from skypilot_tpu.chaos import invariants
+from skypilot_tpu.observability import aggregator as aggregator_lib
+from skypilot_tpu.observability import events as events_lib
+from skypilot_tpu.observability import logs as logs_lib
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import traces as traces_lib
+from skypilot_tpu.serve import http_protocol
+
+
+def _counter_value(name, **labels):
+    parsed = metrics_lib.parse_exposition(metrics_lib.expose())
+    key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+    return parsed.get(name, {}).get(key, 0.0)
+
+
+def _rec(i, **over):
+    rec = {'ts': 1000.0 + i * 1e-3, 'level': 'INFO', 'levelno': 20,
+           'logger': 'unit', 'msg': f'line {i}'}
+    rec.update(over)
+    return rec
+
+
+# ------------------------------------------------------------------ ring
+
+class TestRingExport:
+
+    def test_since_is_exact_seq_cursor(self):
+        ring = logs_lib.LogRecordRing(maxlen=16)
+        for i in range(5):
+            ring.add(_rec(i))
+        page = ring.export()
+        assert [r['msg'] for r in page] == [f'line {i}'
+                                           for i in range(5)]
+        cursor = page[2]['seq']
+        rest = ring.export(since=cursor)
+        # Strictly after: the cursor record itself never reappears.
+        assert [r['msg'] for r in rest] == ['line 3', 'line 4']
+        assert ring.export(since=page[-1]['seq']) == []
+
+    def test_level_is_a_minimum_severity(self):
+        ring = logs_lib.LogRecordRing(maxlen=16)
+        ring.add(_rec(0, level='DEBUG', levelno=10))
+        ring.add(_rec(1, level='INFO', levelno=20))
+        ring.add(_rec(2, level='WARNING', levelno=30))
+        ring.add(_rec(3, level='ERROR', levelno=40))
+        assert len(ring.export(level='WARNING')) == 2
+        assert len(ring.export(level='warning')) == 2    # case-blind
+        assert len(ring.export(level='30')) == 2         # numeric
+        # Unknown level names degrade to no filter, not a 400.
+        assert len(ring.export(level='bogus')) == 4
+
+    def test_request_id_grep_and_limit(self):
+        ring = logs_lib.LogRecordRing(maxlen=32)
+        for i in range(10):
+            ring.add(_rec(i, request_id=f'r{i % 2}'))
+        mine = ring.export(request_id='r1')
+        assert {r['request_id'] for r in mine} == {'r1'}
+        assert len(mine) == 5
+        # grep is a regex; a broken pattern falls back to substring.
+        assert len(ring.export(grep=r'line [0-3]$')) == 4
+        assert [r['msg'] for r in ring.export(grep='line 7[')] \
+            == []                        # bad regex, substring miss
+        assert len(ring.export(grep='line 7')) == 1
+        # limit keeps the NEWEST n.
+        tail = ring.export(limit=3)
+        assert [r['msg'] for r in tail] == ['line 7', 'line 8',
+                                            'line 9']
+
+    def test_cap_evicts_oldest(self):
+        ring = logs_lib.LogRecordRing(maxlen=4)
+        for i in range(10):
+            ring.add(_rec(i))
+        assert len(ring) == 4
+        assert [r['msg'] for r in ring.export()] == [
+            'line 6', 'line 7', 'line 8', 'line 9']
+
+    def test_ring_cap_env_knob(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_LOG_RING_RECORDS', '3')
+        ring = logs_lib.LogRecordRing()
+        for i in range(5):
+            ring.add(_rec(i))
+        assert len(ring) == 3
+        monkeypatch.setenv('SKYTPU_LOG_RING_RECORDS', 'banana')
+        assert logs_lib.ring_records() == \
+            logs_lib.DEFAULT_RING_RECORDS
+
+
+class TestParseLogQuery:
+
+    def test_full_query(self):
+        got = logs_lib.parse_log_query(
+            'since=7&level=WARNING&request_id=r1&grep=foo&limit=5')
+        assert got == {'since': 7.0, 'level': 'WARNING',
+                       'request_id': 'r1', 'grep': 'foo', 'limit': 5}
+
+    def test_malformed_values_are_dropped_not_400(self):
+        assert logs_lib.parse_log_query('since=abc&limit=xyz') == {}
+        assert logs_lib.parse_log_query('') == {}
+        assert logs_lib.parse_log_query('bogus=1') == {}
+
+
+# --------------------------------------------------------------- context
+
+class TestContextBinding:
+
+    def test_bind_merges_and_restores(self):
+        with logs_lib.bind(request_id='outer', process='replica',
+                           replica_id=1):
+            assert logs_lib.current_context()['request_id'] == 'outer'
+            with logs_lib.bind(request_id='inner', attempt=1):
+                ctx = logs_lib.current_context()
+                # Inner overrides rid, inherits the rest.
+                assert ctx['request_id'] == 'inner'
+                assert ctx['attempt'] == 1
+                assert ctx['replica_id'] == 1
+            assert logs_lib.current_context()['request_id'] == 'outer'
+            assert 'attempt' not in logs_lib.current_context()
+
+    def test_wrap_context_carries_into_bare_thread(self):
+        seen = {}
+
+        def probe(key):
+            seen[key] = logs_lib.current_context().get('request_id')
+
+        with logs_lib.bind(request_id='r-wrapped'):
+            wrapped = logs_lib.wrap_context(probe)
+        # A bare worker thread resets contextvars — the classic
+        # request-id-loss bug wrap_context exists to fix.
+        bare = threading.Thread(target=probe, args=('bare',))
+        carried = threading.Thread(target=wrapped, args=('wrapped',))
+        for t in (bare, carried):
+            t.start()
+            t.join()
+        assert seen['bare'] is None
+        assert seen['wrapped'] == 'r-wrapped'
+
+    def test_process_identity_is_the_fallback(self):
+        saved = dict(logs_lib._process_identity)
+        try:
+            logs_lib.set_process_identity('lb')
+            assert logs_lib.current_context()['process'] == 'lb'
+            with logs_lib.bind(process='replica', replica_id=2):
+                assert logs_lib.current_context()['process'] == \
+                    'replica'
+        finally:
+            logs_lib._process_identity.clear()
+            logs_lib._process_identity.update(saved)
+
+
+# --------------------------------------------------------------- handler
+
+class TestStructuredHandler:
+
+    def test_framework_records_land_in_the_ring(self):
+        logger = sky_logging.init_logger('fleet_logs_unit')
+        ring = logs_lib.reset_ring()
+        before = _counter_value(logs_lib.LOG_RECORDS_SERIES,
+                                level='INFO')
+        with sky_logging.silent():
+            with logs_lib.bind(request_id='rid-h', process='replica',
+                               replica_id=3, role='decode'):
+                logger.info('hello ring')
+        [rec] = ring.export(request_id='rid-h')
+        assert rec['msg'] == 'hello ring'
+        assert rec['level'] == 'INFO' and rec['levelno'] == 20
+        assert rec['logger'] == 'skypilot_tpu.fleet_logs_unit'
+        assert rec['process'] == 'replica'
+        assert rec['replica_id'] == 3 and rec['role'] == 'decode'
+        assert rec['ts'] == pytest.approx(time.time(), abs=30)
+        assert _counter_value(logs_lib.LOG_RECORDS_SERIES,
+                              level='INFO') == before + 1
+
+    def test_debug_records_dropped_at_default_level(self):
+        logger = sky_logging.init_logger('fleet_logs_unit')
+        ring = logs_lib.reset_ring()
+        with sky_logging.silent():
+            logger.debug('too quiet')
+        assert ring.export() == []
+
+
+class TestAccessLog:
+
+    def test_probe_routes_demoted_to_debug(self):
+        """The satellite: scrape-path access lines are DEBUG, so at
+        the default INFO level they never reach the ring — but the
+        HTTP counter still counts them."""
+        logger = sky_logging.init_logger('fleet_logs_unit')
+        ring = logs_lib.reset_ring()
+        before = _counter_value('skytpu_http_requests_total',
+                                route=http_protocol.METRICS, code=200)
+        with sky_logging.silent():
+            logs_lib.access_log(logger, 'GET', http_protocol.METRICS,
+                                200)
+        assert ring.export() == []
+        assert _counter_value('skytpu_http_requests_total',
+                              route=http_protocol.METRICS,
+                              code=200) == before + 1
+
+    def test_generate_routes_stay_at_info(self):
+        logger = sky_logging.init_logger('fleet_logs_unit')
+        ring = logs_lib.reset_ring()
+        before = _counter_value('skytpu_http_requests_total',
+                                route=http_protocol.GENERATE, code=500)
+        with sky_logging.silent():
+            logs_lib.access_log(logger, 'POST',
+                                http_protocol.GENERATE, 500)
+        [rec] = ring.export()
+        assert rec['msg'] == 'POST /generate -> 500'
+        assert rec['level'] == 'INFO'
+        assert _counter_value('skytpu_http_requests_total',
+                              route=http_protocol.GENERATE,
+                              code=500) == before + 1
+
+    def test_every_probe_route_is_a_canonical_path(self):
+        for route in logs_lib.PROBE_ROUTES:
+            assert route == logs_lib.HEALTH_ROUTE or \
+                route in http_protocol.PATHS
+
+
+# ---------------------------------------------------------- spike alerts
+
+def _seed_linear(store, rid, level, t0, t1, slope, step=30.0):
+    """Counter samples growing `slope`/s from t0..t1 inclusive."""
+    t = t0
+    while t <= t1 + 1e-9:
+        store.add(logs_lib.LOG_RECORDS_SERIES,
+                  {'replica_id': rid, 'level': level}, t,
+                  slope * (t - t0))
+        t += step
+
+
+class TestErrorRatesAndSpikes:
+
+    def test_error_rates_sums_bad_levels_per_replica(self):
+        store = aggregator_lib.TimeSeriesStore(retention=1e6)
+        now = 10000.0
+        _seed_linear(store, '0', 'ERROR', now - 60, now, 1.5)
+        _seed_linear(store, '0', 'WARNING', now - 60, now, 0.5)
+        _seed_linear(store, '1', 'INFO', now - 60, now, 9.0)
+        rates = logs_lib.error_rates(store, 60.0, now)
+        assert rates['0'] == pytest.approx(2.0)
+        # INFO volume never counts toward the error rate.
+        assert '1' not in rates
+
+    def test_spike_starts_and_terminates(self, monkeypatch, tmp_path):
+        monkeypatch.setenv('SKYTPU_LOG_ERROR_SPIKE_FAST_WINDOW_S',
+                           '60')
+        monkeypatch.setenv('SKYTPU_LOG_ERROR_SPIKE_SLOW_WINDOW_S',
+                           '300')
+        monkeypatch.setenv('SKYTPU_LOG_ERROR_SPIKE_THRESHOLD', '1.0')
+        journal = events_lib.EventJournal(
+            str(tmp_path / 'serve.jsonl'))
+        tracker = logs_lib.LogSpikeTracker('svc', journal=journal)
+        store = aggregator_lib.TimeSeriesStore(retention=1e6)
+        t0 = 20000.0
+        # 2 err/s sustained across the whole slow window: above the
+        # 1/s threshold in BOTH windows -> spike starts.
+        _seed_linear(store, '0', 'ERROR', t0 - 300, t0, 2.0)
+        with sky_logging.silent():
+            [status] = tracker.evaluate(store, t0)
+        assert status['spiking'] is True
+        assert status['rate_fast'] == pytest.approx(2.0)
+        assert status['since'] == t0
+        # Still spiking while only the slow window remembers: recovery
+        # needs the FAST window back under, nothing else.
+        flat = 2.0 * 300
+        for t in (t0 + 30, t0 + 60, t0 + 90, t0 + 120):
+            store.add(logs_lib.LOG_RECORDS_SERIES,
+                      {'replica_id': '0', 'level': 'ERROR'}, t, flat)
+        with sky_logging.silent():
+            [status] = tracker.evaluate(store, t0 + 120)
+        assert status['spiking'] is False
+        assert tracker.status() == [status]
+
+        events = journal.tail()
+        names = [e['event'] for e in events]
+        assert names == ['log_error_spike_start',
+                         'log_error_spike_end']
+        start, end = events
+        assert start['replica_id'] == '0'
+        assert start['rate_fast'] == pytest.approx(2.0)
+        assert start['threshold'] == 1.0
+        assert end['duration_s'] == pytest.approx(120.0)
+        # Gauges reflect the latest evaluation.
+        assert _counter_value('skytpu_log_error_spiking',
+                              service='svc', replica_id='0') == 0.0
+        # The chaos invariant passes on a terminated spike...
+        assert invariants.log_spike_terminates(events) == []
+        # ...and flags a dangling one.
+        assert invariants.log_spike_terminates(events[:1]) != []
+
+    def test_invariant_registered(self):
+        assert 'log_spike_terminates' in invariants.CHECKERS
+
+
+# ------------------------------------------------------------ CLI fan-in
+
+class TestCliLogHelpers:
+
+    def test_merge_dedupes_shared_ring_exports(self):
+        a, b, c = (_rec(0, seq=1), _rec(1, seq=2), _rec(2, seq=3))
+        merged = cli._merge_log_records([[b, a], [b, c]])
+        # One copy of b, ordered by (ts, seq).
+        assert [r['msg'] for r in merged] == ['line 0', 'line 1',
+                                              'line 2']
+        # A persistent `seen` set makes follow-mode polls incremental.
+        seen = set()
+        assert len(cli._merge_log_records([[a, b]], seen)) == 2
+        assert cli._merge_log_records([[a, b]], seen) == []
+
+    def test_identity_filter_is_per_record(self):
+        rec = _rec(0, replica_id=1, role='prefill')
+        assert cli._log_record_matches(rec, None, None)
+        assert cli._log_record_matches(rec, 1, 'prefill')
+        assert not cli._log_record_matches(rec, 2, None)
+        assert not cli._log_record_matches(rec, 1, 'decode')
+
+    def test_format_prefixes_identity(self):
+        line = cli._fmt_log_record(
+            _rec(0, replica_id=4, role='decode', request_id='r-9'))
+        assert '[replica 4 (decode)]' in line
+        assert line.endswith('(req r-9)')
+        assert 'line 0' in line
+        assert '[lb]' in cli._fmt_log_record(_rec(1, process='lb'))
+
+    def test_interleave_logs_slots_lines_into_waterfall(self):
+        segments = [
+            {'name': 'lb', 'process': 'lb', 'start': 1000.0,
+             'duration_ms': 10.0,
+             'phases': [{'name': 'route', 'start': 1000.0,
+                         'duration_ms': 1.0}]},
+            {'name': 'engine', 'replica_id': 1, 'role': 'decode',
+             'start': 1000.002, 'duration_ms': 8.0, 'phases': []},
+        ]
+        records = [_rec(0, ts=1000.004, process='replica',
+                        replica_id=1, role='decode')]
+        out = traces_lib.interleave_logs(segments, records)
+        text = '\n'.join(out)
+        assert 'lb' in text and 'engine' in text
+        assert '[replica 1 (decode)] I unit: line 0' in text
+        # The log line lands AFTER the engine row it belongs under.
+        engine_row = next(i for i, line in enumerate(out)
+                          if 'engine' in line)
+        log_row = next(i for i, line in enumerate(out)
+                       if 'line 0' in line)
+        assert log_row > engine_row
+        # Without segments the records still render, never crash.
+        only_logs = traces_lib.interleave_logs([], records)
+        assert any('line 0' in line for line in only_logs)
+        assert traces_lib.interleave_logs([], []) == ['(no segments)']
+
+
+# ---------------------------------------------------------------- budget
+
+class TestLogHandlerOverheadBudget:
+    """ISSUE 19 acceptance: the structured handler may cost at most 3%
+    of a tick's work.  Same factored A/B as the profiler budget
+    (TestOverheadBudget in test_profiling.py): wall-clocking two full
+    workloads is hopeless on a noisy CI box, so the marginal per-record
+    cost comes from a tight with/without-handler microbenchmark and is
+    asserted against a measured representative tick's compute."""
+
+    TICKS = 4000
+
+    @classmethod
+    def _per_record_cost(cls, logger):
+        t0 = time.perf_counter()
+        for _ in range(cls.TICKS):
+            logger.info('tick access line')
+        return (time.perf_counter() - t0) / cls.TICKS
+
+    def test_handler_overhead_within_3_percent(self):
+        on = logging.Logger('skytpu_log_overhead_on', logging.INFO)
+        off = logging.Logger('skytpu_log_overhead_off', logging.INFO)
+        # Both arms pay record creation + one no-op handler; only the
+        # `on` arm pays the structured capture being budgeted.
+        for arm in (on, off):
+            arm.propagate = False
+            arm.addHandler(logging.NullHandler())
+        on.addHandler(logs_lib.StructuredLogHandler(
+            ring=logs_lib.LogRecordRing(maxlen=2048)))
+        self._per_record_cost(on), self._per_record_cost(off)  # warm-up
+        marginal = min(self._per_record_cost(on) -
+                       self._per_record_cost(off) for _ in range(5))
+
+        def tick_work():
+            t0 = time.perf_counter()
+            assert sum(range(30000)) > 0
+            return time.perf_counter() - t0
+        work = min(tick_work() for _ in range(20))
+        assert marginal <= 0.03 * work, (marginal, work)

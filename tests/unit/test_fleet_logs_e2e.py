@@ -1,0 +1,228 @@
+"""End-to-end fleet log correlation (ISSUE 19 acceptance): a
+prefill->handoff->decode request through the real LB yields
+request-scoped log records from all three processes, merged in causal
+order and interleaved into the trace waterfall — and the async front's
+executor handoff keeps concurrent streams' request ids apart.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+import requests
+
+from skypilot_tpu import cli
+from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import logs as logs_lib
+from skypilot_tpu.observability import traces as traces_lib
+from skypilot_tpu.serve import http_protocol
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import model_server as model_server_lib
+from skypilot_tpu.serve import router as router_lib
+
+
+def _make_server(role, replica_id):
+    return model_server_lib.ModelServer(
+        'tiny', max_len=64, max_batch=2, continuous_batching=True,
+        kv_pages=48, page_size=8, prefill_chunk=16, role=role,
+        replica_id=replica_id)
+
+
+def test_disaggregated_request_logs_correlate_across_processes():
+    """`sky serve logs --request-id` substance: the LB's routed leg,
+    the prefill replica, and the decode replica each contribute
+    records tagged with the same request id; the merge orders them
+    causally and `serve trace` interleaves them into the waterfall."""
+    logs_lib.reset_ring()
+    prefill = _make_server('prefill', 1)
+    decode = _make_server('decode', 2)
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:1', router=router_lib.Router(threshold=24))
+    shutdowns = []
+    try:
+        p_port, p_stop = model_server_lib.start_background(prefill)
+        d_port, d_stop = model_server_lib.start_background(decode)
+        shutdowns.extend([p_stop, d_stop])
+        lb.set_replicas([
+            {'url': f'http://127.0.0.1:{p_port}', 'role': 'prefill',
+             'page_size': 8},
+            {'url': f'http://127.0.0.1:{d_port}', 'role': 'decode',
+             'page_size': 8},
+        ])
+        lb_port = lb.start()
+        prompt = list(range(1, 41))   # above threshold -> handoff
+        resp = requests.post(
+            f'http://127.0.0.1:{lb_port}/generate',
+            json={'prompt_ids': [prompt], 'max_new_tokens': 4},
+            timeout=120)
+        assert resp.status_code == 200
+        rid = resp.headers['X-SkyTPU-Request-Id']
+
+        # Fan-in exactly like `sky serve logs --request-id`: every
+        # endpoint of the fleet, merged + deduped (in-process fleets
+        # share one ring) into a timestamp-ordered stream.
+        batches = [
+            traces_lib.fetch_log_records(
+                f'http://127.0.0.1:{p_port}', request_id=rid),
+            traces_lib.fetch_log_records(
+                f'http://127.0.0.1:{d_port}', request_id=rid),
+            traces_lib.fetch_log_records(
+                f'http://127.0.0.1:{lb_port}',
+                http_protocol.LB_LOGS, request_id=rid),
+        ]
+        records = cli._merge_log_records(batches)
+        assert all(r['request_id'] == rid for r in records)
+
+        def ident(rec):
+            return (rec.get('process'), rec.get('replica_id'))
+        idents = {ident(r) for r in records}
+        # At least three distinct processes spoke for this request.
+        assert {('lb', None), ('replica', 1),
+                ('replica', 2)} <= idents
+        # Causal order: the prefill leg completes before the decode
+        # leg, and the LB's routed access line lands last of all.
+        order = [ident(r) for r in records]
+        assert order.index(('replica', 1)) < \
+            order.index(('replica', 2))
+        assert order[-1] == ('lb', None)
+        tses = [r['ts'] for r in records]
+        assert tses == sorted(tses)
+        # The decode replica's line is the routed /generate; roles
+        # ride every replica record.
+        roles = {r.get('role') for r in records
+                 if r.get('process') == 'replica'}
+        assert roles == {'prefill', 'decode'}
+
+        # Server-side filters work over HTTP, not just in-process.
+        assert traces_lib.fetch_log_records(
+            f'http://127.0.0.1:{p_port}', request_id=rid,
+            level='WARNING') == []
+        assert traces_lib.fetch_log_records(
+            f'http://127.0.0.1:{p_port}', request_id=rid,
+            since=9e12) == []
+
+        # `sky serve trace <rid>`: the waterfall interleaves the log
+        # lines under the segments they belong to.
+        targets = [
+            {'url': f'http://127.0.0.1:{p_port}', 'replica_id': 1,
+             'role': 'prefill'},
+            {'url': f'http://127.0.0.1:{d_port}', 'replica_id': 2,
+             'role': 'decode'},
+        ]
+        segments = traces_lib.collect(
+            rid, targets, f'http://127.0.0.1:{lb_port}')
+        assert segments
+        text = '\n'.join(traces_lib.interleave_logs(segments, records))
+        assert 'replica 1 (prefill)' in text
+        assert 'replica 2 (decode)' in text
+        assert f'-> 200' in text          # an access log line made it
+        # CLI line formatting keeps the identity prefix + rid suffix.
+        lines = [cli._fmt_log_record(r) for r in records]
+        assert any('[lb]' in line for line in lines)
+        assert all(line.endswith(f'(req {rid})') for line in lines)
+    finally:
+        lb.stop()
+        for stop in shutdowns:
+            stop()
+        prefill.close()
+        decode.close()
+
+
+def test_async_front_keeps_concurrent_rids_apart():
+    """ISSUE 19 satellite regression: the async front hands blocking
+    generate() calls to a thread pool (contextvars reset there — the
+    copied-context wrapper must carry each request's id across), and
+    streamed requests' engine-side records come from the worker
+    thread's explicit per-request bind.  Concurrent streams + batch
+    generates must each log under their OWN rid."""
+    from skypilot_tpu.serve import async_server
+
+    logs_lib.reset_ring()
+    server = _make_server('mixed', 3)
+    probe_logger = sky_logging.init_logger('fleet_logs_e2e_probe')
+    real_generate = server.generate
+
+    def noisy_generate(*args, **kwargs):
+        # Runs INSIDE the front's executor thread: the record's
+        # context tag must match the rid the call was made with.
+        with sky_logging.silent():
+            probe_logger.info(
+                f'executor probe {kwargs.get("request_id")}')
+        return real_generate(*args, **kwargs)
+
+    server.generate = noisy_generate
+    engine = server._engine  # pylint: disable=protected-access
+    real_admit = engine._start_admission  # pylint: disable=protected-access
+    def noisy_admit(slot_id, request):
+        # Runs on the ENGINE worker thread (streams never touch the
+        # front's executor): the worker's per-request bind must tag
+        # this with the admitted request's id.
+        with sky_logging.silent():
+            probe_logger.info(
+                f'admission probe {request.request_id}')
+        return real_admit(slot_id, request)
+    engine._start_admission = noisy_admit
+    try:
+        port, stop = async_server.start_background(server)
+        stream_rids = ['stream-rid-a', 'stream-rid-b']
+        batch_rids = ['batch-rid-c', 'batch-rid-d']
+        errors = []
+
+        def one(route, rid):
+            try:
+                resp = requests.post(
+                    f'http://127.0.0.1:{port}{route}',
+                    json={'prompt_ids': [[1, 2, 3, 4]],
+                          'max_new_tokens': 3},
+                    headers={http_protocol.REQUEST_ID_HEADER: rid},
+                    timeout=120, stream=True)
+                assert resp.status_code == 200
+                list(resp.iter_content(1024))    # drain
+                assert resp.headers[
+                    http_protocol.REQUEST_ID_HEADER] == rid
+            except Exception as e:  # pylint: disable=broad-except
+                errors.append(e)
+
+        threads = [threading.Thread(
+            target=one, args=(http_protocol.GENERATE_STREAM, rid))
+            for rid in stream_rids]
+        threads += [threading.Thread(
+            target=one, args=(http_protocol.GENERATE, rid))
+            for rid in batch_rids]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert errors == []
+
+        ring = logs_lib.get_ring()
+        # Access lines are emitted in the handler's `finally`, AFTER
+        # the last response bytes hit the wire — a client can observe
+        # a complete reply a beat before the event loop resumes the
+        # handler coroutine past its final drain().  Wait for all four
+        # access records instead of racing that resumption.
+        deadline = time.time() + 10
+        while (len(ring.export(grep='-> 200')) < len(threads) and
+               time.time() < deadline):
+            time.sleep(0.02)
+        for rid in stream_rids + batch_rids:
+            probes = ring.export(request_id=rid, grep='probe')
+            # Every request's probe records exist under ITS OWN rid:
+            # a lost context drops the tag (empty export), a leaked
+            # sibling context mismatches the message cross-check.
+            assert probes, rid
+            assert all(p['msg'].endswith(rid) for p in probes), probes
+            assert all(p['replica_id'] == 3 for p in probes)
+            kinds = {p['msg'].split(' probe')[0] for p in probes}
+            # Engine worker tagged every admitted request...
+            assert 'admission' in kinds
+            # ...and the executor hop tagged the batch generates.
+            if rid in batch_rids:
+                assert 'executor' in kinds
+            # The front's own access line carries the rid too.
+            access = ring.export(request_id=rid, grep='-> 200')
+            assert len(access) == 1
+    finally:
+        stop()
+        server.close()
