@@ -931,6 +931,134 @@ def _disagg_probe(*, smoke: bool, vocab: int, seed: int
     }
 
 
+def _batch_infer_probe(*, smoke: bool, vocab: int, seed: int
+                       ) -> Dict[str, Any]:
+    """Offline bulk inference riding the QoS floor (ISSUE 20): a
+    saturating batch-infer driver streams a sharded manifest through
+    the routing LB as QoS class `batch` while one interactive chat
+    stream decodes.  A/B: the interactive stream's ITL on an idle
+    fleet vs with the batch driver saturating — the floor the weighted
+    QoS admission exists to protect — plus batch row throughput and
+    how often the driver was shed-and-retried (the 429/Retry-After
+    cooperative backoff contract)."""
+    import json as json_lib
+    import os
+    import tempfile
+
+    import numpy as np
+    import requests
+
+    from skypilot_tpu.batch import manifest as manifest_lib
+    from skypilot_tpu.batch import runner as runner_lib
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.serve import model_server as model_server_lib
+    from skypilot_tpu.serve import router as router_lib
+
+    n_rows = 24 if smoke else 120
+    max_new = 6 if smoke else 16
+    chat_max_new = 32 if smoke else 300
+    rng = np.random.default_rng(seed)
+    tmp = tempfile.mkdtemp(prefix='skytpu-bench-batch-')
+    input_path = os.path.join(tmp, 'input.jsonl')
+    with open(input_path, 'w', encoding='utf-8') as f:
+        for _ in range(n_rows):
+            ids = [int(x) for x in rng.integers(1, vocab - 1, size=6)]
+            f.write(json_lib.dumps({'prompt_ids': ids}) + '\n')
+    run_dir = os.path.join(tmp, 'run')
+    manifest_lib.build_manifest(input_path, run_dir, num_shards=4)
+
+    def make_server():
+        return model_server_lib.ModelServer(
+            'tiny', max_len=64, max_batch=2, continuous_batching=True,
+            kv_pages=48, page_size=8, prefill_chunk=16)
+
+    # Smoke keeps one replica: the floor A/B (driver saturating the
+    # engine vs one interactive stream) needs contention, not a fleet,
+    # and a second server is mostly tier-1 compile time.
+    servers = [make_server()] if smoke else [make_server(), make_server()]
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:1',
+        router=router_lib.Router(threshold=10_000))
+    shutdowns: List[Any] = []
+    try:
+        urls = []
+        for server in servers:
+            port, stop = model_server_lib.start_background(server)
+            shutdowns.append(stop)
+            urls.append(f'http://127.0.0.1:{port}')
+        lb.set_replicas([{'url': u, 'role': 'mixed'} for u in urls])
+        lb_port = lb.start()
+        base = f'http://127.0.0.1:{lb_port}'
+        # Warm both replicas' shapes before anything is timed.
+        for url in urls:
+            requests.post(f'{url}/generate',
+                          json={'prompt_ids': [[1, 2, 3, 4, 5, 6]],
+                                'max_new_tokens': 2}, timeout=300)
+
+        def chat_session(max_new_tokens: int) -> List[float]:
+            """One interactive SSE stream; token arrival times."""
+            times: List[float] = []
+            prompt = [int(x) for x in
+                      rng.integers(1, vocab - 1, size=4)]
+            with requests.post(f'{base}/generate_stream',
+                               json={'prompt_ids': prompt,
+                                     'max_new_tokens': max_new_tokens},
+                               stream=True, timeout=300) as resp:
+                for line in resp.iter_lines(chunk_size=16):
+                    if line.startswith(b'data:') and \
+                            b'[DONE]' not in line:
+                        times.append(time.perf_counter())
+            return times
+
+        def itls_ms(times: List[float]) -> List[float]:
+            return [(b - a) * 1e3 for a, b in zip(times, times[1:])]
+
+        # A: the interactive stream on an idle fleet.
+        idle_itls = itls_ms(chat_session(chat_max_new))
+
+        # B: same stream with the batch driver saturating the pool.
+        job = runner_lib.BatchInferJob(run_dir, base,
+                                       max_new_tokens=max_new,
+                                       inflight=8)
+        summary_holder: Dict[str, Any] = {}
+
+        def drive() -> None:
+            summary_holder.update(job.run())
+
+        driver = threading.Thread(target=drive, daemon=True)
+        t0 = time.perf_counter()
+        driver.start()
+        loaded_itls: List[float] = []
+        while True:  # at least one full interactive session under load
+            loaded_itls.extend(itls_ms(chat_session(chat_max_new)))
+            if not driver.is_alive():
+                break
+        driver.join(timeout=600)
+        elapsed = time.perf_counter() - t0
+    finally:
+        lb.stop()
+        for stop in shutdowns:
+            stop()
+        for server in servers:
+            server.close()
+    rows_done = summary_holder.get('rows') or 0
+    return {
+        'rows': rows_done,
+        'shards': summary_holder.get('shards_total'),
+        'duplicates_dropped': summary_holder.get('duplicates_dropped'),
+        'driver_retries': summary_holder.get('retries'),
+        'elapsed_s': round(elapsed, 3),
+        'rows_per_s': round(rows_done / max(elapsed, 1e-9), 3),
+        'idle_itl_p50_ms': round(_percentile(idle_itls, 50), 2),
+        'idle_itl_p99_ms': round(_percentile(idle_itls, 99), 2),
+        'loaded_itl_p50_ms': round(_percentile(loaded_itls, 50), 2),
+        'loaded_itl_p99_ms': round(_percentile(loaded_itls, 99), 2),
+        'itl_p99_ratio_vs_idle': round(
+            _percentile(loaded_itls, 99) /
+            max(_percentile(idle_itls, 99), 1e-9), 4),
+    }
+
+
 def _dynamic_roles_probe(cfg, params, *, smoke: bool, vocab: int,
                          seed: int) -> Dict[str, Any]:
     """Dynamic fractional role budgets vs static roles (ISSUE 17)
@@ -1164,6 +1292,10 @@ def main() -> None:
                         help='Skip the multi-host sequence-parallel '
                              'long-context prefill scaling probe '
                              '(subprocess per host count).')
+    parser.add_argument('--skip-batch-probe', action='store_true',
+                        help='Skip the offline batch-infer QoS-floor '
+                             'probe (saturating batch driver vs one '
+                             'interactive stream, A/B ITL).')
     parser.add_argument('--page-size', type=int, default=16,
                         help='KV page size for the paged probes.')
     parser.add_argument('--prefix-len', type=int, default=256,
@@ -1400,6 +1532,10 @@ def main() -> None:
         payload['sp_prefill'] = _sp_prefill_probe(smoke=args.smoke,
                                                   model=args.model)
 
+    if not args.skip_batch_probe:
+        payload['batch_infer'] = _batch_infer_probe(
+            smoke=args.smoke, vocab=vocab, seed=args.seed)
+
     line = json.dumps(payload)
     print(line)
     with open(out_path, 'w', encoding='utf-8') as f:
@@ -1445,6 +1581,11 @@ def _append_history(args, payload: Dict[str, Any],
         'speedup_vs_legacy': payload.get('speedup_vs_legacy'),
         'phases': phases,
         'profiled_ticks': (profile_snapshot or {}).get('ticks'),
+        'batch_rows_per_s':
+            (payload.get('batch_infer') or {}).get('rows_per_s'),
+        'batch_itl_p99_ratio':
+            (payload.get('batch_infer') or {}).get(
+                'itl_p99_ratio_vs_idle'),
     }
     try:
         where = bench_history.append_record(record, path)

@@ -30,6 +30,9 @@ ALLOWED = {
     'serve/slice_replica.py':
         '--bench-prefill prints its JSON result on stdout (bench_serve '
         'subprocess protocol)',
+    'batch/runner.py':
+        'managed-job driver: the summary JSON on stdout is the run '
+        'output `sky jobs logs` tails',
 }
 
 

@@ -41,6 +41,7 @@ EXPECTED_LAYER = {
     'serve.role_morph': ('serve/',),
     'skylet.tick': ('skylet/',),
     'checkpoint.save': ('data/',),
+    'batch.shard_write': ('batch/',),
 }
 
 

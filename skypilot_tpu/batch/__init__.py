@@ -1,0 +1,10 @@
+"""Offline bulk inference (`sky batch-infer`): sharded JSONL manifests
+streamed through the serving fleet as QoS class ``batch``, with a
+journal-backed per-shard ledger for exactly-once resume and live
+weight swap on the replicas (see docs/batch-inference.md)."""
+from skypilot_tpu.batch.manifest import (Manifest, ShardLedger,
+                                         build_manifest)
+from skypilot_tpu.batch.runner import BatchInferJob
+
+__all__ = ['Manifest', 'ShardLedger', 'build_manifest',
+           'BatchInferJob']

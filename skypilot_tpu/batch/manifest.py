@@ -1,0 +1,312 @@
+"""Sharded JSONL manifests + the exactly-once shard ledger.
+
+A batch-infer run is rooted in one directory:
+
+    manifest.json        num_shards / per-shard row counts / source
+    shard-00000.jsonl    input rows (contiguous split of the source)
+    ...
+    ledger.jsonl         append-only progress log (rows + shard ends)
+    output-00000.jsonl   one output row per input row, {shard, row_idx,
+    ...                  tokens/completion, weight_version, ...}
+
+Exactly-once protocol (the whole point of the ledger):
+
+- ``commit_row`` appends the OUTPUT row first, then the ledger record.
+  The `batch.shard_write` chaos site sits between the two appends — a
+  driver dying there leaves an output row with no ledger record.
+- Resume replays ``ledger.jsonl`` into a done-set and skips every
+  ``(shard, row_idx)`` it names: no committed row ever re-runs (no
+  duplicated work), no uncommitted row is skipped (no lost rows).
+- A row that died mid-commit re-runs, so its output file can hold the
+  row TWICE; ``finalize()`` rewrites each output shard keeping the
+  first copy per ``(shard, row_idx)`` — exactly-once on rewrite.
+
+Ledger appends are flushed + fsync'd: a record the driver acted on
+(skipping the row after restart) must actually be on disk.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
+
+MANIFEST_FILE = 'manifest.json'
+LEDGER_FILE = 'ledger.jsonl'
+
+
+def _shard_file(shard: int) -> str:
+    return f'shard-{shard:05d}.jsonl'
+
+
+def _output_file(shard: int) -> str:
+    return f'output-{shard:05d}.jsonl'
+
+
+def _maybe_journal(event: str, **fields) -> None:
+    """Journal the batch lifecycle only while someone is watching (the
+    `batch.shard_write` chaos site armed, or SKYTPU_BATCH_EVENTS set):
+    the batch_exactly_once invariant replays these."""
+    from skypilot_tpu.chaos import injector as chaos_injector  # pylint: disable=import-outside-toplevel
+    if not (os.environ.get('SKYTPU_BATCH_EVENTS') or
+            chaos_injector.site_armed('batch.shard_write')):
+        return
+    from skypilot_tpu.observability import events as events_lib  # pylint: disable=import-outside-toplevel
+    try:
+        events_lib.get_journal(
+            os.path.join(events_lib.journal_root(),
+                         'serve.jsonl')).append(event, **fields)
+    except Exception:  # pylint: disable=broad-except
+        pass  # recording must never break the driver
+
+
+def build_manifest(input_path: str, out_dir: str, *,
+                   num_shards: int = 8) -> 'Manifest':
+    """Shard a source JSONL (one request object per line — `prompt`
+    string or `prompt_ids` list, plus optional per-row overrides) into
+    `out_dir` as a batch-infer manifest.  Rows split contiguously so a
+    shard is a readable slice of the source."""
+    if num_shards < 1:
+        raise ValueError(f'num_shards must be >= 1, got {num_shards}')
+    rows: List[Dict[str, Any]] = []
+    with open(input_path, encoding='utf-8') as f:
+        for lineno, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f'{input_path}:{lineno}: bad JSON: {e}') from e
+            if not isinstance(row, dict):
+                raise ValueError(
+                    f'{input_path}:{lineno}: each row must be a JSON '
+                    f'object, got {type(row).__name__}')
+            if 'prompt' not in row and 'prompt_ids' not in row:
+                raise ValueError(
+                    f'{input_path}:{lineno}: row needs a "prompt" '
+                    'string or a "prompt_ids" list')
+            rows.append(row)
+    if not rows:
+        raise ValueError(f'{input_path}: no input rows')
+    num_shards = min(num_shards, len(rows))
+    os.makedirs(out_dir, exist_ok=True)
+    base, extra = divmod(len(rows), num_shards)
+    counts: List[int] = []
+    cursor = 0
+    for shard in range(num_shards):
+        take = base + (1 if shard < extra else 0)
+        with open(os.path.join(out_dir, _shard_file(shard)), 'w',
+                  encoding='utf-8') as f:
+            for row in rows[cursor:cursor + take]:
+                f.write(json.dumps(row) + '\n')
+        counts.append(take)
+        cursor += take
+    meta = {'version': 1, 'num_shards': num_shards,
+            'shard_rows': counts, 'total_rows': len(rows),
+            'source': os.path.abspath(input_path)}
+    with open(os.path.join(out_dir, MANIFEST_FILE), 'w',
+              encoding='utf-8') as f:
+        json.dump(meta, f, indent=2)
+    return Manifest(out_dir)
+
+
+class Manifest:
+    """A built manifest directory: shard metadata + row iteration."""
+
+    def __init__(self, manifest_dir: str) -> None:
+        self.dir = os.path.abspath(manifest_dir)
+        path = os.path.join(self.dir, MANIFEST_FILE)
+        try:
+            with open(path, encoding='utf-8') as f:
+                meta = json.load(f)
+        except FileNotFoundError as e:
+            raise ValueError(
+                f'{manifest_dir} is not a batch manifest (no '
+                f'{MANIFEST_FILE}; build one with '
+                f'`sky batch-infer launch --input ...`)') from e
+        self.num_shards = int(meta['num_shards'])
+        self.shard_rows = [int(n) for n in meta['shard_rows']]
+        self.total_rows = int(meta['total_rows'])
+        self.source = meta.get('source')
+
+    def rows(self, shard: int) -> Iterator[Tuple[int, Dict[str, Any]]]:
+        """(row_idx, row) pairs of one shard, in file order."""
+        if not 0 <= shard < self.num_shards:
+            raise ValueError(f'shard {shard} out of range '
+                             f'[0, {self.num_shards})')
+        path = os.path.join(self.dir, _shard_file(shard))
+        with open(path, encoding='utf-8') as f:
+            for row_idx, line in enumerate(f):
+                line = line.strip()
+                if line:
+                    yield row_idx, json.loads(line)
+
+
+class ShardLedger:
+    """Append-only progress ledger + per-shard output writers.
+
+    Records (one JSON object per line):
+      {"kind": "row", "shard": S, "row_idx": I}   committed row
+      {"kind": "shard_end", "shard": S}           shard fully committed
+    """
+
+    def __init__(self, manifest_dir: str) -> None:
+        self.dir = os.path.abspath(manifest_dir)
+        self.path = os.path.join(self.dir, LEDGER_FILE)
+        self._ledger_f = None
+        self._output_fs: Dict[int, Any] = {}
+
+    # ------------------------------------------------------------ replay
+
+    def replay(self) -> Tuple[Set[Tuple[int, int]], Set[int]]:
+        """(done_rows, done_shards) from the ledger on disk — the
+        resume state.  Torn trailing lines (a write the crash cut
+        short) are ignored: the row they named never entered the
+        done-set, so it simply re-runs."""
+        done_rows: Set[Tuple[int, int]] = set()
+        done_shards: Set[int] = set()
+        if not os.path.exists(self.path):
+            return done_rows, done_shards
+        with open(self.path, encoding='utf-8') as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail; the row re-runs
+                if rec.get('kind') == 'row':
+                    done_rows.add((int(rec['shard']),
+                                   int(rec['row_idx'])))
+                elif rec.get('kind') == 'shard_end':
+                    done_shards.add(int(rec['shard']))
+        return done_rows, done_shards
+
+    def progress(self, manifest: Manifest) -> Dict[str, int]:
+        """Shards/rows done vs total — what `sky jobs queue` renders
+        in its PROGRESS column and `batch-infer status` prints."""
+        done_rows, done_shards = self.replay()
+        return {'rows_done': len(done_rows),
+                'rows_total': manifest.total_rows,
+                'shards_done': len(done_shards),
+                'shards_total': manifest.num_shards}
+
+    # ------------------------------------------------------------ commit
+
+    def _ledger_handle(self):
+        if self._ledger_f is None:
+            self._ledger_f = open(self.path, 'a', encoding='utf-8')
+        return self._ledger_f
+
+    def _output_handle(self, shard: int):
+        f = self._output_fs.get(shard)
+        if f is None:
+            f = open(os.path.join(self.dir, _output_file(shard)), 'a',
+                     encoding='utf-8')
+            self._output_fs[shard] = f
+        return f
+
+    def _append_ledger(self, record: Dict[str, Any]) -> None:
+        f = self._ledger_handle()
+        f.write(json.dumps(record) + '\n')
+        f.flush()
+        os.fsync(f.fileno())
+
+    def commit_row(self, shard: int, row_idx: int,
+                   output_row: Dict[str, Any]) -> None:
+        """Durably commit one finished row: output append, THEN ledger
+        append.  A crash between the two (the `batch.shard_write`
+        chaos site) leaves a committed-looking output row with no
+        ledger record — the row re-runs on resume and finalize()'s
+        dedupe keeps exactly one copy."""
+        from skypilot_tpu.chaos import injector  # pylint: disable=import-outside-toplevel
+        out = self._output_handle(shard)
+        out.write(json.dumps({'shard': shard, 'row_idx': row_idx,
+                              **output_row}) + '\n')
+        out.flush()
+        # Chaos: a raise here is the driver dying mid-commit (output
+        # written, ledger not) — the exactly-once seam under test.
+        injector.inject('batch.shard_write', shard=shard,
+                        row_idx=row_idx)
+        self._append_ledger({'kind': 'row', 'shard': shard,
+                             'row_idx': row_idx})
+        _maybe_journal('batch_row_commit', shard=shard,
+                       row_idx=row_idx)
+
+    def finish_shard(self, shard: int) -> None:
+        self._append_ledger({'kind': 'shard_end', 'shard': shard})
+
+    def close(self) -> None:
+        for f in self._output_fs.values():
+            f.close()
+        self._output_fs.clear()
+        if self._ledger_f is not None:
+            self._ledger_f.close()
+            self._ledger_f = None
+
+    # ---------------------------------------------------------- finalize
+
+    def finalize(self, manifest: Manifest) -> Dict[str, int]:
+        """Exactly-once on rewrite: rewrite every output shard keeping
+        the FIRST copy of each (shard, row_idx) — duplicates exist
+        precisely when a commit was cut between its two appends — and
+        verify the deduped outputs cover the manifest.  Returns
+        {'rows', 'duplicates_dropped'}; raises on missing rows (a
+        resume that should have re-run them)."""
+        self.close()
+        total = 0
+        dropped = 0
+        for shard in range(manifest.num_shards):
+            path = os.path.join(self.dir, _output_file(shard))
+            seen: Set[int] = set()
+            kept: List[str] = []
+            if os.path.exists(path):
+                with open(path, encoding='utf-8') as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            row_idx = int(json.loads(line)['row_idx'])
+                        except (json.JSONDecodeError, KeyError,
+                                ValueError):
+                            dropped += 1  # torn tail of a cut write
+                            continue
+                        if row_idx in seen:
+                            dropped += 1
+                            continue
+                        seen.add(row_idx)
+                        kept.append(line)
+            expected = manifest.shard_rows[shard]
+            if len(kept) != expected:
+                raise RuntimeError(
+                    f'shard {shard}: {len(kept)} output rows != '
+                    f'{expected} input rows — resume before '
+                    'finalizing')
+            tmp = path + '.tmp'
+            with open(tmp, 'w', encoding='utf-8') as f:
+                for line in kept:
+                    f.write(line + '\n')
+            os.replace(tmp, path)
+            total += len(kept)
+        return {'rows': total, 'duplicates_dropped': dropped}
+
+    def output_rows(self, manifest: Manifest,
+                    shard: Optional[int] = None
+                    ) -> List[Dict[str, Any]]:
+        """Parsed output rows (all shards, or one), file order."""
+        shards = ([shard] if shard is not None
+                  else range(manifest.num_shards))
+        rows: List[Dict[str, Any]] = []
+        for s in shards:
+            path = os.path.join(self.dir, _output_file(s))
+            if not os.path.exists(path):
+                continue
+            with open(path, encoding='utf-8') as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        rows.append(json.loads(line))
+        return rows

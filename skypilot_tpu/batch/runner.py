@@ -1,0 +1,305 @@
+"""BatchInferJob: the bulk-inference driver.
+
+Streams a manifest's shards through the serving front door as QoS
+class ``batch`` — the router's weighted admission gives interactive
+traffic its floor and sheds batch overflow with 429 + Retry-After,
+which this driver HONORS (that is the cooperative backoff contract:
+batch soaks residual capacity instead of fighting chat traffic).
+
+Runs as a managed job (`sky batch-infer launch` builds a task whose
+run command is `python -m skypilot_tpu.batch.runner ...`), so the jobs
+controller classifies a dead driver like any preempted task and
+relaunches it; the shard ledger (batch/manifest.py) makes the relaunch
+a RESUME — committed rows never re-run, half-committed rows re-run and
+dedupe on the final rewrite.
+
+Env knobs (see docs/environment-variables.md):
+  SKYTPU_BATCH_INFLIGHT           bounded in-flight rows (default 4)
+  SKYTPU_BATCH_MAX_RETRIES        per-row retry budget (default 16)
+  SKYTPU_BATCH_RETRY_AFTER_CAP_S  cap on honored Retry-After sleeps
+  SKYTPU_BATCH_EVENTS             journal the batch lifecycle always
+                                  (chaos arms it implicitly)
+"""
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Set, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.batch import manifest as manifest_lib
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.serve import http_protocol
+
+logger = sky_logging.init_logger(__name__)
+
+# Driver-side progress series (scraped when the driver process exposes
+# /metrics; the replica-side skytpu_batch_rows_served_total is what the
+# fleet aggregator folds into `sky serve top`).
+_M_ROWS = metrics_lib.counter(
+    'skytpu_batch_driver_rows_total',
+    'Rows the batch driver committed to the shard ledger, by outcome.',
+    ('status',))
+_M_SHARDS = metrics_lib.counter(
+    'skytpu_batch_driver_shards_total',
+    'Shards the batch driver finished, by outcome.', ('status',))
+_M_RETRIES = metrics_lib.counter(
+    'skytpu_batch_driver_retries_total',
+    'Row submissions retried after a shed (429/503 + Retry-After) or '
+    'a transport error.')
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        value = int(os.environ.get(name, ''))
+    except ValueError:
+        return default
+    return value if value > 0 else default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        value = float(os.environ.get(name, ''))
+    except ValueError:
+        return default
+    return value if value > 0 else default
+
+
+def default_inflight() -> int:
+    return _env_int('SKYTPU_BATCH_INFLIGHT', 4)
+
+
+def max_retries() -> int:
+    return _env_int('SKYTPU_BATCH_MAX_RETRIES', 16)
+
+
+def retry_after_cap_s() -> float:
+    return _env_float('SKYTPU_BATCH_RETRY_AFTER_CAP_S', 10.0)
+
+
+class RowFailed(RuntimeError):
+    """A row exhausted its retry budget; the run stops (resume picks
+    the row back up — it never entered the ledger)."""
+
+
+class BatchInferJob:
+    """One driver incarnation over a manifest directory.
+
+    `run()` resumes from the ledger, processes every remaining shard,
+    then finalizes (dedupe rewrite) — idempotent: re-running a
+    finished job is a no-op that re-verifies the outputs."""
+
+    def __init__(self, manifest_dir: str, endpoint: str, *,
+                 max_new_tokens: int = 16,
+                 inflight: Optional[int] = None,
+                 request_timeout_s: float = 120.0,
+                 job_id: Optional[int] = None,
+                 task_id: int = 0) -> None:
+        self.manifest = manifest_lib.Manifest(manifest_dir)
+        self.ledger = manifest_lib.ShardLedger(manifest_dir)
+        self.endpoint = endpoint.rstrip('/')
+        self.max_new_tokens = int(max_new_tokens)
+        self.inflight = max(1, int(inflight if inflight is not None
+                                   else default_inflight()))
+        self.request_timeout_s = float(request_timeout_s)
+        self.max_retries = max_retries()
+        self.retry_after_cap_s = retry_after_cap_s()
+        # Managed-job context for the PROGRESS column: explicit, else
+        # the controller-exported env (jobs/constants.py).
+        if job_id is None:
+            from skypilot_tpu.jobs import constants as jobs_constants  # pylint: disable=import-outside-toplevel
+            raw = os.environ.get(jobs_constants.ENV_MANAGED_JOB_ID)
+            job_id = int(raw) if raw and raw.isdigit() else None
+        self.job_id = job_id
+        self.task_id = int(task_id)
+        self.retries = 0
+        self._commit_lock = threading.Lock()
+
+    # ------------------------------------------------------------- HTTP
+
+    def _post_row(self, session, row: Dict[str, Any]
+                  ) -> Dict[str, Any]:
+        """One row through POST /generate as QoS class batch, honoring
+        429/503 Retry-After (the router's shed path + a draining
+        replica) and retrying transport errors — the driver-side half
+        of the LB's retry machinery."""
+        import requests  # pylint: disable=import-outside-toplevel
+        if 'prompt_ids' in row:
+            prompt_ids = [list(map(int, row['prompt_ids']))]
+        else:
+            # Byte-level convention (models/tokenizer.py fallback):
+            # keeps the driver usable against any replica without
+            # shipping a tokenizer.
+            prompt_ids = [[b + 1 for b in
+                           str(row['prompt']).encode('utf-8')]]
+        payload = {'prompt_ids': prompt_ids,
+                   'max_new_tokens': int(row.get('max_new_tokens',
+                                                 self.max_new_tokens))}
+        for key in ('temperature', 'top_k', 'seed'):
+            if key in row:
+                payload[key] = row[key]
+        headers = {http_protocol.QOS_CLASS_HEADER: 'batch'}
+        attempts = 0
+        while True:
+            try:
+                resp = session.post(
+                    self.endpoint + http_protocol.GENERATE,
+                    json=payload, headers=headers,
+                    timeout=self.request_timeout_s)
+            except requests.RequestException as e:
+                attempts += 1
+                self.retries += 1
+                _M_RETRIES.inc()
+                if attempts > self.max_retries:
+                    raise RowFailed(
+                        f'row failed after {attempts} attempts: '
+                        f'{e}') from e
+                time.sleep(min(0.2 * attempts, 2.0))
+                continue
+            if resp.status_code in (429, 503):
+                # Shed or draining: back off for the stamped
+                # Retry-After (the router derives it from the engine's
+                # queue-wait p50 when it has one), capped so a stale
+                # huge stamp cannot stall the driver.
+                attempts += 1
+                self.retries += 1
+                _M_RETRIES.inc()
+                if attempts > self.max_retries:
+                    raise RowFailed(
+                        f'row shed {attempts} times '
+                        f'(HTTP {resp.status_code})')
+                try:
+                    retry_after = float(
+                        resp.headers.get('Retry-After', 1))
+                except ValueError:
+                    retry_after = 1.0
+                time.sleep(max(0.05,
+                               min(retry_after,
+                                   self.retry_after_cap_s)))
+                continue
+            if resp.status_code != 200:
+                raise RowFailed(f'HTTP {resp.status_code}: '
+                                f'{resp.text[:200]}')
+            return resp.json()
+
+    # ------------------------------------------------------------ driver
+
+    def _process_row(self, session, shard: int, row_idx: int,
+                     row: Dict[str, Any]) -> None:
+        result = self._post_row(session, row)
+        output = {'tokens': result.get('tokens', [None])[0],
+                  'weight_version': result.get('weight_version'),
+                  'latency_ms': result.get('latency_ms')}
+        # Single-writer commit: output append -> ledger append is the
+        # exactly-once seam and must never interleave across rows.
+        with self._commit_lock:
+            self.ledger.commit_row(shard, row_idx, output)  # skytpu: lint-ok[blocking-under-lock] reason=the lock EXISTS to serialize the output+ledger append pair (the exactly-once seam); commits are one line each and the driver is offline batch, not a request hot path
+        _M_ROWS.labels(status='ok').inc()
+
+    def _report_progress(self) -> None:
+        if self.job_id is None:
+            return
+        try:
+            from skypilot_tpu.jobs import state as jobs_state  # pylint: disable=import-outside-toplevel
+            progress = self.ledger.progress(self.manifest)
+            jobs_state.set_batch_progress(
+                self.job_id, self.task_id,
+                f'{progress["shards_done"]}/'
+                f'{progress["shards_total"]} shards '
+                f'({progress["rows_done"]}/'
+                f'{progress["rows_total"]} rows)')
+        except Exception:  # pylint: disable=broad-except
+            pass  # progress is advisory; never fail the run over it
+
+    def _run_shard(self, session, pool, shard: int,
+                   done_rows: Set[Tuple[int, int]]) -> int:
+        todo = [(idx, row) for idx, row in self.manifest.rows(shard)
+                if (shard, idx) not in done_rows]
+        pending: Set[concurrent.futures.Future] = set()
+        committed = 0
+        try:
+            for row_idx, row in todo:
+                while len(pending) >= self.inflight:
+                    finished, pending = concurrent.futures.wait(
+                        pending,
+                        return_when=concurrent.futures.FIRST_COMPLETED)
+                    for fut in finished:
+                        fut.result()  # re-raise row failures here
+                        committed += 1
+                pending.add(pool.submit(self._process_row, session,
+                                        shard, row_idx, row))
+            for fut in concurrent.futures.as_completed(pending):
+                fut.result()
+                committed += 1
+            pending.clear()
+            return committed
+        finally:
+            for fut in pending:
+                fut.cancel()
+
+    def run(self) -> Dict[str, Any]:
+        import requests  # pylint: disable=import-outside-toplevel
+        t0 = time.monotonic()
+        done_rows, done_shards = self.ledger.replay()
+        resumed = bool(done_rows or done_shards)
+        logger.info(
+            f'batch-infer: {self.manifest.total_rows} rows in '
+            f'{self.manifest.num_shards} shards; resuming with '
+            f'{len(done_rows)} rows / {len(done_shards)} shards done'
+            if resumed else
+            f'batch-infer: {self.manifest.total_rows} rows in '
+            f'{self.manifest.num_shards} shards')
+        session = requests.Session()
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.inflight) as pool:
+            for shard in range(self.manifest.num_shards):
+                if shard in done_shards:
+                    continue
+                manifest_lib._maybe_journal(  # pylint: disable=protected-access
+                    'batch_shard_start', shard=shard,
+                    resumed=resumed)
+                status = 'error'
+                try:
+                    self._run_shard(session, pool, shard, done_rows)
+                    self.ledger.finish_shard(shard)
+                    status = 'ok'
+                finally:
+                    manifest_lib._maybe_journal(  # pylint: disable=protected-access
+                        'batch_shard_end', shard=shard, status=status)
+                    _M_SHARDS.labels(status=status).inc()
+                self._report_progress()
+        summary = self.ledger.finalize(self.manifest)
+        summary.update(self.ledger.progress(self.manifest))
+        summary['retries'] = self.retries
+        summary['resumed'] = resumed
+        summary['elapsed_s'] = round(time.monotonic() - t0, 3)
+        self._report_progress()
+        logger.info(f'batch-infer done: {summary}')
+        return summary
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description='Bulk-inference driver (sky batch-infer).')
+    parser.add_argument('--manifest-dir', required=True)
+    parser.add_argument('--endpoint', required=True,
+                        help='Serving front door (LB or replica) URL.')
+    parser.add_argument('--max-new-tokens', type=int, default=16)
+    parser.add_argument('--inflight', type=int, default=None)
+    parser.add_argument('--job-id', type=int, default=None)
+    parser.add_argument('--task-id', type=int, default=0)
+    args = parser.parse_args()
+    job = BatchInferJob(args.manifest_dir, args.endpoint,
+                        max_new_tokens=args.max_new_tokens,
+                        inflight=args.inflight, job_id=args.job_id,
+                        task_id=args.task_id)
+    summary = job.run()
+    print(json.dumps(summary))
+
+
+if __name__ == '__main__':
+    main()

@@ -115,6 +115,13 @@ SITES: Dict[str, str] = {
         'the epoch-stamped flip), a raise is the controller dying '
         'mid-morph: the journaled role_morph lifecycle must still '
         'terminate',
+    'batch.shard_write':
+        'batch-infer output/ledger write (batch/manifest.py '
+        'ShardLedger.commit_row — the exactly-once seam: the output '
+        'row is appended BEFORE its ledger record) — a raise between '
+        'the two appends is the driver dying mid-commit: resume must '
+        're-run the row and the rewrite dedupe must keep exactly one '
+        'output copy; "delay" stretches the commit window',
     'skylet.tick':
         'skylet periodic event run (skylet/events.py) — a raise counts '
         'as an event failure and exercises the failure backoff',

@@ -45,11 +45,15 @@ The registry maps names (used by scenarios and the CLI) to checkers:
                            WARN/ERROR rate excursion) reaches a later
                            log_error_spike_end — an alert that never
                            clears is a stuck tracker
+    batch_exactly_once     the batch-infer ledger commits every
+                           (shard, row_idx) at most once, every opened
+                           shard's final lifecycle event is an end, and
+                           every live weight swap terminates
     no_injections          zero chaos_fault_injected events (clean runs)
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Sequence
+from typing import Any, Callable, Dict, List, Sequence, Tuple
 
 from skypilot_tpu.observability import event_protocol
 
@@ -66,6 +70,8 @@ _KV_HANDOFF = event_protocol.BY_NAME['kv_handoff']
 _REPLICA_DRAIN = event_protocol.BY_NAME['replica_drain']
 _QOS_REQUEST = event_protocol.BY_NAME['qos_request']
 _LOG_ERROR_SPIKE = event_protocol.BY_NAME['log_error_spike']
+_BATCH_SHARD = event_protocol.BY_NAME['batch_shard']
+_WEIGHT_SWAP = event_protocol.BY_NAME['weight_swap']
 
 
 def merge(*event_lists: Sequence[Event]) -> List[Event]:
@@ -482,6 +488,53 @@ def log_spike_terminates(events: Sequence[Event]) -> List[str]:
     return violations
 
 
+def batch_exactly_once(events: Sequence[Event]) -> List[str]:
+    """Exactly-once for the batch-infer ledger: no (shard, row_idx)
+    commits twice, every opened shard eventually closes (a driver
+    killed mid-shard leaves a dangling batch_shard_start that the
+    RESUMED driver must re-open and close — SCOPE_PROCESS pair), and
+    every live weight swap terminates."""
+    violations = []
+    commits: Dict[Tuple[Any, Any], int] = {}
+    for e in _named(events, 'batch_row_commit'):
+        key = (e.get('shard'), e.get('row_idx'))
+        commits[key] = commits.get(key, 0) + 1
+    for key, n in sorted(commits.items()):
+        if n > 1:
+            violations.append(
+                f'row (shard={key[0]}, row_idx={key[1]}) committed '
+                f'{n} times — the ledger replay re-ran a committed row')
+    # Shard lifecycle: the LAST event per shard must be an end (the
+    # pre-kill incarnation may legally leave a dangling start; the
+    # resumed one re-opens and must close it).
+    last_by_shard: Dict[Any, str] = {}
+    opened: set = set()
+    for e in events:
+        name = e.get('event')
+        if name in (_BATCH_SHARD.start, _BATCH_SHARD.end):
+            last_by_shard[e.get('shard')] = name
+            if name == _BATCH_SHARD.start:
+                opened.add(e.get('shard'))
+    for shard in sorted(opened):
+        if last_by_shard.get(shard) != _BATCH_SHARD.end:
+            violations.append(
+                f'shard {shard}: batch_shard_start never reached a '
+                f'final batch_shard_end (the resume never finished it)')
+    swaps_open = 0
+    for e in events:
+        name = e.get('event')
+        if name == _WEIGHT_SWAP.start:
+            swaps_open += 1
+        elif name == _WEIGHT_SWAP.end:
+            swaps_open -= 1
+            if swaps_open < 0:
+                violations.append('weight_swap_end without a start')
+    if swaps_open > 0:
+        violations.append(
+            f'{swaps_open} weight_swap_start without weight_swap_end')
+    return violations
+
+
 def no_injections(events: Sequence[Event]) -> List[str]:
     """With no plan armed, the chaos subsystem must be invisible."""
     injected = _named(events, 'chaos_fault_injected')
@@ -504,6 +557,7 @@ CHECKERS: Dict[str, Callable[[Sequence[Event]], List[str]]] = {
     'drain_no_lost_requests': drain_no_lost_requests,
     'qos_fairness': qos_fairness,
     'log_spike_terminates': log_spike_terminates,
+    'batch_exactly_once': batch_exactly_once,
     'no_injections': no_injections,
 }
 

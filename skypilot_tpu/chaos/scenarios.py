@@ -73,6 +73,11 @@ Scenarios (CLI: ``sky chaos list`` / ``sky chaos run <name>``):
 - ``checkpoint_storm``     checkpoint-write fault storm → saves retry
                            with backoff off the step path; training
                            never stalls past the in-flight bound
+- ``batch_resume``         batch-infer driver killed mid-commit + a
+                           replica killed mid-shard + a live weight
+                           swap → a fresh driver resumes off the shard
+                           ledger and completes with exactly-once
+                           outputs
 
 Determinism: the fault sequence (site, effect, per-site call number) is
 a pure function of plan + seed over the driven call sequence; the
@@ -2289,3 +2294,209 @@ def region_loss_failover(seed: int) -> ScenarioResult:
             f'({len(cross)} cross-region routes)', extra)
     return _finish('region_loss_failover', seed, t0, serve_events,
                    ['drain_no_lost_requests'], extra, details)
+
+
+@_register(
+    'batch_resume',
+    'batch-infer driver killed mid-commit (raise between the output '
+    'append and the ledger append) AND one replica killed mid-shard, '
+    'plus a live /weights_swap landing mid-run -> a fresh driver '
+    'resumes off the shard ledger and completes with exactly-once '
+    'outputs; the KV pool and an in-flight interactive request '
+    'survive the swap')
+def batch_resume(seed: int) -> ScenarioResult:
+    import json  # pylint: disable=import-outside-toplevel
+    import tempfile  # pylint: disable=import-outside-toplevel
+    import threading  # pylint: disable=import-outside-toplevel
+
+    import flax.linen as nn  # pylint: disable=import-outside-toplevel
+    import jax  # pylint: disable=import-outside-toplevel
+    import jax.numpy as jnp  # pylint: disable=import-outside-toplevel
+    import orbax.checkpoint as ocp  # pylint: disable=import-outside-toplevel
+    import requests  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.batch import manifest as manifest_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.batch import runner as runner_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.data import checkpoints  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.models import configs  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.models.transformer import Transformer  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import load_balancer as lb_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import model_server as model_server_lib  # pylint: disable=import-outside-toplevel
+    from skypilot_tpu.serve import router as router_lib  # pylint: disable=import-outside-toplevel
+
+    # Kill the driver's 3rd row commit BETWEEN its two appends: the
+    # output row lands, the ledger record does not — the exactly-once
+    # seam.  The raise unwinds the whole first driver incarnation.
+    plan = faults_lib.FaultPlan(
+        seed=seed, name='batch_resume',
+        faults=[faults_lib.Fault(site='batch.shard_write',
+                                 effect='raise', nth=[3])])
+    t0 = time.time()
+    extra: List[str] = []
+    details: Dict[str, Any] = {}
+    serve_journal = events_lib.get_journal(
+        os.path.join(events_lib.journal_root(), 'serve.jsonl'))
+
+    tmp = tempfile.mkdtemp(prefix='skytpu-chaos-batch-')
+    input_path = os.path.join(tmp, 'input.jsonl')
+    with open(input_path, 'w', encoding='utf-8') as f:
+        for i in range(10):
+            f.write(json.dumps(
+                {'prompt_ids': [i + 1, 3, 5, 7, 9]}) + '\n')
+    run_dir = os.path.join(tmp, 'run')
+    manifest = manifest_lib.build_manifest(input_path, run_dir,
+                                           num_shards=3)
+
+    # The swap target: a REAL orbax checkpoint of differently-seeded
+    # tiny weights, saved in the training layout (params subtree) the
+    # serve-side partial restore reads.
+    cfg = configs.get_config('tiny')
+    swap_params = nn.meta.unbox(Transformer(cfg).init(
+        jax.random.PRNGKey(seed + 1),
+        jnp.zeros((1, 8), jnp.int32))['params'])
+    ckpt_dir = os.path.join(tmp, 'ckpt')
+    mgr = checkpoints.checkpoint_manager(ckpt_dir)
+    mgr.save(1, args=ocp.args.StandardSave({'params': swap_params}))
+    mgr.wait_until_finished()
+
+    def make_server():
+        return model_server_lib.ModelServer(
+            'tiny', max_len=64, max_batch=2, continuous_batching=True,
+            kv_pages=48, page_size=8, prefill_chunk=16)
+
+    servers = [make_server(), make_server()]
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:1',
+        router=router_lib.Router(threshold=10_000))
+    shutdowns: List[Any] = []
+    summary: Dict[str, Any] = {}
+    try:
+        urls = []
+        for server in servers:
+            port, stop = model_server_lib.start_background(server)
+            shutdowns.append(stop)
+            urls.append(f'http://127.0.0.1:{port}')
+        lb.set_replicas([{'url': u, 'role': 'mixed'} for u in urls])
+        lb_port = lb.start()
+        endpoint = f'http://127.0.0.1:{lb_port}'
+        with _armed(plan):
+            # Incarnation 1: dies mid-commit on the chaos raise.
+            job = runner_lib.BatchInferJob(
+                run_dir, endpoint, max_new_tokens=4, inflight=2)
+            died = False
+            try:
+                job.run()
+            except faults_lib.ChaosError:
+                died = True
+            job.ledger.close()
+            _expect(died, 'the chaos raise killed the first driver '
+                    'incarnation mid-commit', extra)
+            done_rows, _ = job.ledger.replay()
+            details['rows_before_resume'] = len(done_rows)
+            _expect(0 < len(done_rows) < manifest.total_rows,
+                    f'the first incarnation committed some but not '
+                    f'all rows (got {len(done_rows)})', extra)
+
+            # Replica death mid-shard: the second replica dies
+            # abruptly; the LB's same-role failover carries the
+            # resume's requests to the survivor.
+            shutdowns[1]()
+            servers[1].close()
+
+            # Live weight swap mid-run, with an interactive request in
+            # flight: the swap must drop neither the KV pool nor the
+            # request.
+            interactive: Dict[str, Any] = {}
+
+            def interactive_request() -> None:
+                try:
+                    r = requests.post(
+                        f'{urls[0]}{http_protocol.GENERATE}',
+                        json={'prompt_ids': [[2, 4, 6, 8, 10]],
+                              'max_new_tokens': 16}, timeout=60)
+                    interactive['status'] = r.status_code
+                    interactive['tokens'] = len(
+                        (r.json().get('tokens') or [[]])[0])
+                except requests.RequestException:
+                    interactive['status'] = -1
+
+            th = threading.Thread(target=interactive_request,
+                                  daemon=True)
+            th.start()
+            swap = requests.post(
+                f'{urls[0]}{http_protocol.WEIGHTS_SWAP}',
+                json={'checkpoint_dir': ckpt_dir}, timeout=120)
+            th.join(timeout=60)
+            details['swap_status'] = swap.status_code
+            details['interactive'] = dict(interactive)
+            _expect(swap.status_code == 200,
+                    f'live weight swap succeeded (HTTP '
+                    f'{swap.status_code}: {swap.text[:200]})', extra)
+            swap_version = (swap.json().get('weight_version')
+                            if swap.status_code == 200 else None)
+            _expect(swap_version == 1,
+                    f'the swap bumped the weight epoch to 1 '
+                    f'(got {swap_version})', extra)
+            _expect(interactive.get('status') == 200 and
+                    interactive.get('tokens') == 16,
+                    f'the in-flight interactive request survived the '
+                    f'swap (got {interactive})', extra)
+            health = requests.get(f'{urls[0]}/', timeout=10).json()
+            details['weight_version'] = health.get('weight_version')
+            _expect(health.get('weight_version') == 1,
+                    f'the health payload reports the bumped weight '
+                    f'version (got {health.get("weight_version")})',
+                    extra)
+
+            # Incarnation 2: resume off the ledger — must complete
+            # with exactly-once outputs despite the dead replica.
+            job2 = runner_lib.BatchInferJob(
+                run_dir, endpoint, max_new_tokens=4, inflight=2)
+            summary = job2.run()
+            job2.ledger.close()
+            details['summary'] = summary
+            stats = servers[0]._engine.stats()  # pylint: disable=protected-access
+            details['engine_failed'] = stats['failed']
+            details['kv_pages_used'] = stats['kv_pages_used']
+            details['weight_epoch'] = stats.get('weight_epoch')
+    finally:
+        lb.stop()
+        shutdowns[0]()
+        servers[0].close()
+
+    output = manifest_lib.ShardLedger(run_dir).output_rows(manifest)
+    keys = {(r.get('shard'), r.get('row_idx')) for r in output}
+    details['output_rows'] = len(output)
+    _expect(len(output) == manifest.total_rows and
+            len(keys) == manifest.total_rows,
+            f'deduped outputs exactly cover the manifest '
+            f'({len(output)} rows, {len(keys)} unique)', extra)
+    _expect(all(len(r.get('tokens') or []) == 4 for r in output),
+            'every output row carries its generated tokens', extra)
+    details['rows_on_new_weights'] = sum(
+        1 for r in output if r.get('weight_version') == 1)
+    _expect(details['rows_on_new_weights'] >= 1,
+            'resumed rows are stamped with the post-swap weight '
+            'version', extra)
+    _expect(summary.get('duplicates_dropped', 0) >= 1,
+            f'the half-committed row re-ran and deduped on rewrite '
+            f'(dropped {summary.get("duplicates_dropped")})', extra)
+    _expect(summary.get('resumed') is True,
+            'the second incarnation actually resumed off the ledger',
+            extra)
+    _expect(details.get('engine_failed') is False,
+            'the swap never failed the engine', extra)
+    _expect(details.get('kv_pages_used') == 0,
+            f'KV pool intact and fully drained after the swap '
+            f'(got {details.get("kv_pages_used")} pages used)', extra)
+    _expect(details.get('weight_epoch') == 1,
+            f'engine weight epoch settled at 1 '
+            f'(got {details.get("weight_epoch")})', extra)
+    injected = [e for e in _since(injector.chaos_journal(), t0)
+                if e.get('event') == 'chaos_fault_injected']
+    _expect(len(injected) == 1,
+            f'exactly one mid-commit raise fired '
+            f'(got {len(injected)})', extra)
+    serve_events = _since(serve_journal, t0)
+    return _finish('batch_resume', seed, t0, serve_events,
+                   ['batch_exactly_once'], extra, details)

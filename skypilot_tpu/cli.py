@@ -618,11 +618,14 @@ def jobs_queue():
         # WHY the job is (or last was) recovering, not just that it is.
         reason = r.get('last_recovery_reason') or r.get(
             'failure_reason') or '-'
+        # Batch-infer drivers report shard-ledger progress through
+        # jobs/state.py (same plumbing as the recovery reason).
+        progress = r.get('batch_progress') or '-'
         rows.append((r['job_id'], r['task_id'], r['job_name'],
-                     r['status'], r['recovery_count'],
+                     r['status'], r['recovery_count'], progress,
                      common_utils.truncate_long_string(str(reason), 48)))
     _print_table(['ID', 'TASK', 'NAME', 'STATUS', 'RECOVERIES',
-                  'REASON'], rows)
+                  'PROGRESS', 'REASON'], rows)
 
 
 @jobs_group.command(name='events')
@@ -722,6 +725,104 @@ def jobs_dashboard(refresh_every):
             time_lib.sleep(refresh_every)
     except KeyboardInterrupt:
         pass
+
+
+# ------------------------------------------------------ batch-infer group
+
+
+@cli.group(name='batch-infer')
+def batch_infer_group():
+    """Offline bulk inference riding the serving QoS floor."""
+
+
+@batch_infer_group.command(name='launch')
+@click.option('--input', 'input_path', required=True,
+              help='Source JSONL: one request object per line '
+                   '("prompt" string or "prompt_ids" list, plus '
+                   'optional per-row overrides).')
+@click.option('--endpoint', required=True,
+              help='Serving front door (LB or replica) URL.')
+@click.option('--run-dir', default=None,
+              help='Manifest/run directory '
+                   '(default: <input>.batchrun).')
+@click.option('--num-shards', type=int, default=8)
+@click.option('--max-new-tokens', type=int, default=16)
+@click.option('--inflight', type=int, default=None,
+              help='Bounded in-flight rows '
+                   '(default: SKYTPU_BATCH_INFLIGHT or 4).')
+@click.option('--managed', is_flag=True, default=False,
+              help='Submit the driver as a managed job (a dead driver '
+                   'is relaunched and resumes off the ledger) instead '
+                   'of running it inline.')
+def batch_infer_launch(input_path, endpoint, run_dir, num_shards,
+                       max_new_tokens, inflight, managed):
+    """Shard INPUT into a run directory and drive it through ENDPOINT.
+
+    Rows flow as QoS class `batch`: the router's weighted admission
+    keeps interactive traffic at its floor and sheds batch overflow
+    with 429 + Retry-After, which the driver honors.  The run
+    directory's shard ledger makes any restart a resume — committed
+    rows never re-run, and the final rewrite dedupes half-committed
+    ones (exactly-once outputs)."""
+    import json as json_lib  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.batch import manifest as manifest_lib  # pylint: disable=import-outside-toplevel
+    run_dir = run_dir or input_path + '.batchrun'
+    manifest = manifest_lib.build_manifest(input_path, run_dir,
+                                           num_shards=num_shards)
+    click.echo(f'Manifest: {manifest.total_rows} rows in '
+               f'{manifest.num_shards} shards under {run_dir}')
+    if managed:
+        import skypilot_tpu as sky  # pylint: disable=import-outside-toplevel
+        from skypilot_tpu import jobs  # pylint: disable=import-outside-toplevel
+        cmd = (f'python -m skypilot_tpu.batch.runner '
+               f'--manifest-dir {run_dir} --endpoint {endpoint} '
+               f'--max-new-tokens {max_new_tokens}')
+        if inflight:
+            cmd += f' --inflight {inflight}'
+        task = sky.Task(name='batch-infer', run=cmd)
+        job_id = jobs.launch(task)
+        click.echo(f'Managed job ID: {job_id} (watch `sky jobs queue` '
+                   f'PROGRESS, or `sky batch-infer status {run_dir}`)')
+        return
+    from skypilot_tpu.batch import runner as runner_lib  # pylint: disable=import-outside-toplevel
+    job = runner_lib.BatchInferJob(run_dir, endpoint,
+                                   max_new_tokens=max_new_tokens,
+                                   inflight=inflight)
+    click.echo(json_lib.dumps(job.run()))
+
+
+@batch_infer_group.command(name='status')
+@click.argument('run_dir')
+def batch_infer_status(run_dir):
+    """Show a run's shard-ledger progress."""
+    from skypilot_tpu.batch import manifest as manifest_lib  # pylint: disable=import-outside-toplevel
+    manifest = manifest_lib.Manifest(run_dir)
+    progress = manifest_lib.ShardLedger(run_dir).progress(manifest)
+    click.echo(
+        f'{progress["shards_done"]}/{progress["shards_total"]} shards '
+        f'({progress["rows_done"]}/{progress["rows_total"]} rows)')
+
+
+@batch_infer_group.command(name='resume')
+@click.argument('run_dir')
+@click.option('--endpoint', required=True,
+              help='Serving front door (LB or replica) URL.')
+@click.option('--max-new-tokens', type=int, default=16)
+@click.option('--inflight', type=int, default=None)
+def batch_infer_resume(run_dir, endpoint, max_new_tokens, inflight):
+    """Resume a dead run off its ledger.
+
+    Committed rows never re-run; rows cut mid-commit re-run and dedupe
+    on the final rewrite.  Resuming a finished run is an idempotent
+    re-verification of the outputs."""
+    import json as json_lib  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.batch import runner as runner_lib  # pylint: disable=import-outside-toplevel
+    job = runner_lib.BatchInferJob(run_dir, endpoint,
+                                   max_new_tokens=max_new_tokens,
+                                   inflight=inflight)
+    click.echo(json_lib.dumps(job.run()))
 
 
 # ------------------------------------------------------------ serve group
@@ -1469,6 +1570,22 @@ def _render_top(records, telemetry_by_service) -> None:
             _print_table(['ROLE', 'QPS', 'QPS HISTORY',
                           'TOK/S HISTORY', 'TTFT p99', 'ITL p99'],
                          rows)
+        batch = telemetry.get('batch') or None
+        if batch:
+            # Bulk-inference plane: only rendered while a batch driver
+            # is actually pushing rows through the fleet.
+            click.echo('')
+            epochs = batch.get('weight_epochs') or {}
+            epoch_str = ','.join(
+                f'{rid}:{ep}' for rid, ep in sorted(epochs.items())
+                if rid is not None) or '-'
+            rps = batch.get('rows_per_s')
+            _print_table(
+                ['BATCH ROWS', 'ROWS/s', 'WEIGHT EPOCHS', 'SWAPS'],
+                [(f"{batch.get('rows_total', 0):g}",
+                  '-' if rps is None else f'{rps:.3g}',
+                  epoch_str,
+                  f"{batch.get('weight_swaps_total', 0):g}")])
         slos = telemetry.get('slos') or []
         if slos:
             click.echo('')

@@ -109,6 +109,11 @@ def _migrate(conn: sqlite3.Connection) -> None:
     if 'last_recovery_reason' not in cols:
         conn.execute('ALTER TABLE managed_jobs '
                      'ADD COLUMN last_recovery_reason TEXT')
+    if 'batch_progress' not in cols:
+        # Batch-infer drivers report ledger progress ("2/8 shards
+        # (37/128 rows)") here; `jobs queue` renders it as PROGRESS.
+        conn.execute('ALTER TABLE managed_jobs '
+                     'ADD COLUMN batch_progress TEXT')
 
 
 def allocate_job_id(job_name: str) -> int:
@@ -196,6 +201,20 @@ def set_last_recovery_reason(job_id: int, task_id: int,
         conn.execute(
             'UPDATE managed_jobs SET last_recovery_reason=? '
             'WHERE job_id=? AND task_id=?', (reason, job_id, task_id))
+
+
+def set_batch_progress(job_id: int, task_id: int,
+                       progress: str) -> None:
+    """Record a batch-infer driver's ledger progress (shards/rows done
+    vs total).  Written by the driver itself (it knows its job id from
+    SKYTPU_MANAGED_JOB_ID) each time a shard commits; `jobs queue`
+    surfaces it in the PROGRESS column — same plumbing as the
+    recovery-reason column."""
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE managed_jobs SET batch_progress=? '
+            'WHERE job_id=? AND task_id=?',
+            (progress, job_id, task_id))
 
 
 def set_cluster_name(job_id: int, task_id: int,

@@ -571,9 +571,31 @@ class FleetAggregator:
         from skypilot_tpu.observability import logs as logs_lib  # pylint: disable=import-outside-toplevel
         log_error_rates = logs_lib.error_rates(
             self.store, min(60.0, window_s), now)
+        # Batch-infer plane: the replica-side bulk-inference signals
+        # (rows served under QoS class batch, live weight-swap epochs)
+        # — only present while a batch driver is actually running, so
+        # `sky serve top` can hide the BATCH line otherwise.
+        batch: Optional[Dict[str, Any]] = None
+        batch_rows = self.store.latest('skytpu_batch_rows_served_total')
+        if batch_rows:
+            rate = self.store.counter_rate(
+                'skytpu_batch_rows_served_total',
+                min(60.0, window_s), now)
+            epochs = {labels.get('replica_id'): int(value)
+                      for labels, value in self.store.latest(
+                          'skytpu_batch_weight_epoch')}
+            swaps = sum(value for _, value in self.store.latest(
+                'skytpu_batch_weight_swaps_total'))
+            batch = {
+                'rows_total': sum(v for _, v in batch_rows),
+                'rows_per_s': rate,
+                'weight_epochs': epochs,
+                'weight_swaps_total': swaps,
+            }
         return {'window_s': window_s, 'roles': out_roles, 'mfu': mfu,
                 'tick_breakdown': tick_breakdown,
                 'recompiles': recompiles,
                 'log_error_rates': log_error_rates,
+                'batch': batch,
                 'slow_traces': self.slow_traces(),
                 'series_names': self.store.names()}

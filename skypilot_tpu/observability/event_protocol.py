@@ -129,6 +129,21 @@ PAIRS: Tuple[PairedEvents, ...] = (
     # (same fast/slow multi-window shape as slo_burn; the controller's
     # LogSpikeTracker journals both edges each reconcile pass).
     _pair('log_error_spike', SCOPE_PROCESS),
+    # Bulk inference (ISSUE 20).  batch_shard brackets one shard's
+    # processing by the batch driver; a driver killed mid-shard leaves
+    # a dangling start that the RESUMED driver re-opens and closes —
+    # a state machine spanning processes, so the batch_exactly_once
+    # invariant (not lint) checks closure.  'ok' = every row committed,
+    # 'error' = the shard loop raised (resume will retry it).
+    _pair('batch_shard', SCOPE_PROCESS, status_field='status',
+          statuses=('ok', 'error')),
+    # weight_swap brackets one live checkpoint swap on a replica
+    # (POST /weights_swap; end guaranteed by try/finally): 'ok' = the
+    # engine serves the new epoch, 'error' = restore/swap failed and
+    # the old weights keep serving.  batch_row_commit point events
+    # ride alongside in the same journal.
+    _pair('weight_swap', SCOPE_INVOCATION, status_field='status',
+          statuses=('ok', 'error')),
 )
 
 BY_NAME: Dict[str, PairedEvents] = {p.name: p for p in PAIRS}

@@ -115,6 +115,12 @@ class RequestSpan:
         self.spec_steps = 0
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # Which weight epoch served this request (live weight swap:
+        # POST /weights_swap bumps the engine's epoch; every span
+        # records the epoch in force at submit so batch output rows
+        # can attribute each generation to a checkpoint).  None on
+        # engines predating the swap path.
+        self.weight_epoch: Optional[int] = None
         self.ttft_s: Optional[float] = None
         self._last_token: Optional[float] = None
         self.itl_count = 0
@@ -191,6 +197,8 @@ class RequestSpan:
             out['slice_sync_ms'] = round(self.slice_sync_ms, 3)
         if self.attempt is not None:
             out['attempt'] = self.attempt
+        if self.weight_epoch is not None:
+            out['weight_epoch'] = self.weight_epoch
         if self.spec_steps:
             out['spec_steps'] = self.spec_steps
             out['spec_proposed'] = self.spec_proposed

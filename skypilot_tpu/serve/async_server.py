@@ -198,6 +198,7 @@ class AsyncModelServer:
             'role': server.role,
             'num_hosts': server.num_hosts,
             'draining': server.draining,
+            'weight_version': server.weight_version,
         }
         engine = server._engine  # pylint: disable=protected-access
         code = 200
@@ -276,7 +277,10 @@ class AsyncModelServer:
         model_server_lib._maybe_journal_request(  # pylint: disable=protected-access
             'serve_request_done', request_id=rid, status='ok',
             tokens=sum(len(t) for t in tokens))
+        if qos_class == qos_lib.BATCH:
+            model_server_lib._M_BATCH_ROWS.inc(len(tokens))  # pylint: disable=protected-access
         return {'tokens': tokens,
+                'weight_version': self.server.weight_version,
                 'latency_ms': round((time.perf_counter() - t0) * 1e3, 1)}
 
     def _reject_if_draining(self) -> None:
@@ -670,6 +674,22 @@ class AsyncModelServer:
                     elif path == http_protocol.ROLE_BUDGET:
                         try:
                             result = self.server.apply_role_budget(req)
+                        except (KeyError, ValueError, TypeError) as e:
+                            raise _HttpError(400, str(e)) from e
+                        writer.write(_json_response(200, result))
+                        await writer.drain()
+                    elif path == http_protocol.WEIGHTS_SWAP:
+                        # Checkpoint restore is blocking I/O: run it in
+                        # the executor so in-flight streams keep
+                        # flowing while the weights load.
+                        try:
+                            result = await (
+                                asyncio.get_running_loop()
+                                .run_in_executor(
+                                    None, logs_lib.wrap_context(
+                                        lambda r=req: (
+                                            self.server
+                                            .weights_swap(r)))))
                         except (KeyError, ValueError, TypeError) as e:
                             raise _HttpError(400, str(e)) from e
                         writer.write(_json_response(200, result))

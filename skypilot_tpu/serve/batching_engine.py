@@ -246,6 +246,11 @@ class ContinuousBatchingEngine:
 
         self.cfg = cfg
         self.params = params
+        # Live weight swap (POST /weights_swap): bumped by swap_params()
+        # ON THE WORKER THREAD between ticks; read anywhere (int loads
+        # are atomic under the GIL).  Epoch 0 = the params the engine
+        # booted with.
+        self._weight_epoch = 0
         self.max_len = max_len
         self.prefill_chunk = max(1, int(prefill_chunk))
         self.max_queue = int(max_queue)          # 0 = unbounded
@@ -496,6 +501,9 @@ class ContinuousBatchingEngine:
                                     deadline_ms=deadline_ms,
                                     qos_class=qos_class)
         request._span_store = self._spans  # pylint: disable=protected-access
+        # The epoch in force AT SUBMIT: a swap landing mid-decode still
+        # attributes this request to the weights that prefilled it.
+        request.span.weight_epoch = self._weight_epoch
         sampler_lib.validate_stop_ids(request.stop_ids,
                                       self.max_stop_ids)
         if self._stop.is_set() or self._failed is not None:
@@ -828,6 +836,59 @@ class ContinuousBatchingEngine:
         _M_HANDOFF_EXPORTS.inc()
         return holder['result']
 
+    def swap_params(self, new_params) -> int:
+        """Swap the serving weights in place WITHOUT dropping the KV
+        page pool or any in-flight request — the live half of
+        `POST /weights_swap`.
+
+        Runs as a host op ON THE WORKER THREAD between ticks: that IS
+        the scoped tick pause — no tick can be mid-flight while
+        self.params is reassigned, and the jitted steps take params as
+        an argument (never donated), so the next tick simply decodes
+        with the new weights against the same cache.  In-flight
+        requests keep their KV pages; requests submitted after the
+        swap are span-stamped with the new epoch.  Returns the new
+        weight epoch.
+
+        Callers are responsible for device placement (the server
+        restores the checkpoint with the engine's shardings before
+        calling); this method only performs the epoch-ordered
+        assignment."""
+        if self._stop.is_set() or self._failed is not None:
+            raise RuntimeError('batching engine is stopped'
+                               if self._failed is None else
+                               f'batching engine failed: {self._failed}')
+        holder: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def op() -> None:
+            # Worker thread: between ticks by construction.
+            try:
+                if self._stop.is_set():
+                    raise RuntimeError('batching engine stopped')
+                self.params = new_params
+                self._weight_epoch += 1
+                holder['result'] = self._weight_epoch
+            except BaseException as e:  # pylint: disable=broad-except
+                holder['error'] = e
+            finally:
+                done.set()
+
+        with self._host_ops_lock:
+            self._host_ops.append(op)
+        with self._cond:
+            self._cond.notify_all()
+        if not done.wait(timeout=60):
+            raise RuntimeError('weight swap timed out waiting for the '
+                               'engine worker')
+        if 'error' in holder:
+            raise holder['error']
+        return holder['result']
+
+    @property
+    def weight_epoch(self) -> int:
+        return self._weight_epoch
+
     def _drain_host_ops(self) -> int:
         ran = 0
         while True:
@@ -880,6 +941,7 @@ class ContinuousBatchingEngine:
                 'paged': self._kv is not None,
                 'decode_kernel': self.decode_kernel,
                 'spec_tokens': self.spec_tokens,
+                'weight_epoch': self._weight_epoch,
             }
             if self.spec_tokens:
                 stats['spec_ticks'] = self._spec_ticks

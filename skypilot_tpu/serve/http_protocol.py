@@ -60,13 +60,15 @@ KV_IMPORT = '/kv_import'              # POST: KV handoff, decode side
 DRAIN = '/drain'                      # POST: controller retirement path
 PREFIX_EXPORT = '/prefix_export'      # POST: drain-time sibling handoff
 ROLE_BUDGET = '/role_budget'          # POST: rebalance push / role morph
+WEIGHTS_SWAP = '/weights_swap'        # POST: live checkpoint swap
 PROFILE = '/profile'                  # GET: tick-phase profiling ring
 LOGS = '/logs'                        # GET: structured log-ring export
 # Any other GET answers the health/readiness payload (the probe path).
 
 REPLICA_PATHS = (METRICS, SPANS, GENERATE, GENERATE_STREAM,
                  GENERATE_TEXT, PREFILL_EXPORT, KV_IMPORT, DRAIN,
-                 PREFIX_EXPORT, ROLE_BUDGET, PROFILE, LOGS)
+                 PREFIX_EXPORT, ROLE_BUDGET, WEIGHTS_SWAP, PROFILE,
+                 LOGS)
 
 # ------------------------------------------------- LB control plane (the
 # `/lb/` prefix is never proxied; the LB answers these itself)

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 import os
 import ssl as ssl_lib
 import threading
@@ -477,6 +478,9 @@ class SkyServeLoadBalancer:
         self.qos_specs = qos_lib.from_config(qos)
         self.qos_max_inflight = qos_lib.router_max_inflight()
         self._qos_inflight: Dict[str, int] = {}
+        # Worst ready-replica median queue wait (seconds) from the last
+        # controller sync; None until a replica reports a histogram.
+        self._queue_wait_p50: Optional[float] = None
         # Rolling per-instance request timestamps (60s) for the
         # skytpu_router_qps gauge, refreshed at scrape time.
         self._recent_requests: List[float] = []
@@ -517,8 +521,28 @@ class SkyServeLoadBalancer:
             page_size=r.get('page_size'),
             region=r.get('region')) for r in replicas]
         self.router.set_endpoints(endpoints)
+        # Congestion-aware shed backoff: the worst median admission
+        # wait across the ready pool (seconds, from each engine's
+        # queue-wait histogram) drives the 429 Retry-After stamp.
+        p50s = [float(r['queue_wait_p50']) for r in replicas
+                if r.get('queue_wait_p50') is not None]
         with self._lock:
             self.ready_urls = [e.url for e in endpoints]
+            self._queue_wait_p50 = max(p50s) if p50s else None
+
+    def shed_retry_after_s(self) -> int:
+        """Retry-After (whole seconds) stamped on QoS sheds: the worst
+        ready-replica median queue wait when the fleet reports one
+        (rounded UP so the stamp never understates the wait, floor 1s
+        — Retry-After is integer seconds on the wire), else the static
+        default of 1s.  This is what makes batch backoff track real
+        engine congestion instead of hammering a loaded fleet once a
+        second."""
+        with self._lock:
+            p50 = self._queue_wait_p50
+        if p50 is None or p50 <= 0:
+            return 1
+        return max(1, int(math.ceil(p50)))
 
     def sync_age(self) -> float:
         """Seconds since the last successful controller sync (also the
@@ -1018,7 +1042,7 @@ class SkyServeLoadBalancer:
                          f'share; retry later.').encode()
             cwriter.write(
                 (f'HTTP/1.1 429 Too Many Requests\r\n'
-                 f'Retry-After: 1\r\n'
+                 f'Retry-After: {self.shed_retry_after_s()}\r\n'
                  f'Content-Length: {len(body_text)}\r\n'
                  f'Content-Type: text/plain\r\n'
                  f'Connection: close\r\n\r\n').encode() + body_text)

@@ -92,6 +92,21 @@ _M_FLOPS_PER_TOKEN = metrics_lib.gauge(
     'Approximate forward FLOPs per generated token (2 x parameter '
     'count plus the context-dependent attention term) of the model '
     'this replica serves.')
+# Live weight swap + bulk inference (sky batch-infer): the replica-side
+# series the fleet aggregator folds into its batch section for
+# `sky serve top`.
+_M_WEIGHT_SWAPS = metrics_lib.counter(
+    'skytpu_batch_weight_swaps_total',
+    'Live weight swaps attempted on this replica (POST /weights_swap), '
+    'by outcome.', ('status',))
+_M_WEIGHT_EPOCH = metrics_lib.gauge(
+    'skytpu_batch_weight_epoch',
+    'Weight epoch currently serving (0 = boot weights; each '
+    'successful live swap bumps it).')
+_M_BATCH_ROWS = metrics_lib.counter(
+    'skytpu_batch_rows_served_total',
+    'Generation rows served under QoS class batch — the replica-side '
+    'progress signal of a bulk-inference run.')
 
 
 def model_flops_per_token(cfg, n_params: int, max_len: int) -> float:
@@ -164,6 +179,26 @@ def _maybe_journal_request(event: str, **fields) -> None:
             chaos_injector.site_armed('serve.kv_handoff') or
             chaos_injector.site_armed('serve.rank_exec') or
             chaos_injector.site_armed('serve.controller_tick')):
+        return
+    from skypilot_tpu.observability import events as events_lib  # pylint: disable=import-outside-toplevel
+    try:
+        events_lib.get_journal(
+            os.path.join(events_lib.journal_root(),
+                         'serve.jsonl')).append(event, **fields)
+    except Exception:  # pylint: disable=broad-except
+        pass  # recording must never break the serving path
+
+
+def _maybe_journal_batch(event: str, **fields) -> None:
+    """Journal the weight-swap lifecycle only while someone is watching
+    (the `batch.shard_write` chaos site armed, or SKYTPU_BATCH_EVENTS
+    set): the batch_exactly_once invariant replays these alongside the
+    batch driver's shard/row events."""
+    import os  # pylint: disable=import-outside-toplevel
+
+    from skypilot_tpu.chaos import injector as chaos_injector  # pylint: disable=import-outside-toplevel
+    if not (os.environ.get('SKYTPU_BATCH_EVENTS') or
+            chaos_injector.site_armed('batch.shard_write')):
         return
     from skypilot_tpu.observability import events as events_lib  # pylint: disable=import-outside-toplevel
     try:
@@ -402,6 +437,11 @@ class ModelServer:
                 f'{report["quantized_bytes"] / 1e6:.1f} MB '
                 f'({report["ratio"]:.2f}x of f32)')
         self.params = params
+        # Live weight swap (POST /weights_swap): the epoch now serving
+        # (0 = boot weights; mirrors the engine's counter) and how to
+        # re-quantize swapped checkpoints when this server quantizes.
+        self.weight_version = 0
+        self._quantize = quantize
         # Serving roofline input: forward FLOPs per generated token.
         # The controller's aggregator turns this + decode tokens/s
         # into the per-replica skytpu_mfu_estimate gauge.
@@ -507,6 +547,54 @@ class ModelServer:
         return {'applied': applied, 'morphed': morphed,
                 'role': self.role, 'draining': self.draining,
                 'budget': budget.as_dict()}
+
+    def weights_swap(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        """POST /weights_swap: live checkpoint swap — restore the
+        latest orbax checkpoint under `checkpoint_dir` and swap it
+        into the running engine WITHOUT dropping the KV page pool or
+        any in-flight request (the engine assigns the new tree between
+        ticks — the scoped pause; see
+        ContinuousBatchingEngine.swap_params).  The bumped weight
+        epoch lands in /health, every later request's span, and every
+        generate response, so batch output rows record which weights
+        produced them."""
+        from skypilot_tpu.data import checkpoints  # pylint: disable=import-outside-toplevel
+        engine = self._engine
+        if engine is None:
+            raise ValueError('live weight swap requires '
+                             '--continuous-batching')
+        checkpoint_dir = req.get('checkpoint_dir')
+        if not checkpoint_dir or not isinstance(checkpoint_dir, str):
+            raise ValueError('weights_swap needs a checkpoint_dir')
+        step = checkpoints.latest_step(checkpoint_dir)
+        if step is None:
+            raise ValueError(f'no checkpoint under {checkpoint_dir}')
+        _maybe_journal_batch('weight_swap_start',
+                             replica_id=self.replica_id,
+                             checkpoint_dir=checkpoint_dir, step=step)
+        t0 = time.perf_counter()
+        status = 'error'
+        epoch: Optional[int] = None
+        try:
+            params = checkpoints.restore_params(
+                checkpoint_dir, None, shardings=self._shardings)
+            if self._quantize:
+                from skypilot_tpu.models import quantize as quantize_lib  # pylint: disable=import-outside-toplevel
+                params = quantize_lib.quantize_params(params)
+            epoch = engine.swap_params(params)
+            self.params = params
+            self.weight_version = epoch
+            status = 'ok'
+        finally:
+            _M_WEIGHT_SWAPS.labels(status=status).inc()
+            if epoch is not None:
+                _M_WEIGHT_EPOCH.set(epoch)
+            _maybe_journal_batch('weight_swap_end',
+                                 replica_id=self.replica_id,
+                                 status=status, weight_epoch=epoch)
+        return {'weight_version': epoch, 'step': step,
+                'restore_ms': round(
+                    (time.perf_counter() - t0) * 1e3, 1)}
 
     def inflight(self) -> int:
         """Busy slots + queued admissions (0 without an engine): the
@@ -854,7 +942,8 @@ def _make_handler(server: ModelServer):
                                 f'{server.cfg.n_layers}',
                        'role': server.role,
                        'num_hosts': server.num_hosts,
-                       'draining': server.draining}
+                       'draining': server.draining,
+                       'weight_version': server.weight_version}
             engine = server._engine  # pylint: disable=protected-access
             code = 200
             if engine is not None:  # local bind: close() may race
@@ -922,6 +1011,7 @@ def _make_handler(server: ModelServer):
                 self._reply(200, {
                     'completion': tok.decode(tokens),
                     'tokens': tokens,
+                    'weight_version': server.weight_version,
                     'latency_ms': round(
                         (time.perf_counter() - t0) * 1e3, 1),
                 }, {tracing.REQUEST_ID_HEADER: rid})
@@ -1187,6 +1277,19 @@ def _make_handler(server: ModelServer):
             except Exception as e:  # pylint: disable=broad-except
                 self._reply(500, {'error': f'{type(e).__name__}: {e}'})
 
+        def _weights_swap(self):
+            """Live checkpoint swap (see ModelServer.weights_swap).
+            Allowed while draining — a fleet can pre-stage fresh
+            weights on replicas it is about to re-open."""
+            try:
+                self._reply(200,
+                            server.weights_swap(self._read_json()))
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._reply(400, {'error': str(e)})
+            except Exception as e:  # pylint: disable=broad-except
+                self._reply(500, {'error': f'{type(e).__name__}: {e}'})
+
         def _prefix_export(self):
             """Drain-time sibling handoff: export the hottest prefix-
             cache pages (POOL pages — no prefill runs) so a surviving
@@ -1263,6 +1366,9 @@ def _make_handler(server: ModelServer):
             if self.path == http_protocol.ROLE_BUDGET:
                 self._role_budget()
                 return
+            if self.path == http_protocol.WEIGHTS_SWAP:
+                self._weights_swap()
+                return
             if self.path != http_protocol.GENERATE:
                 self._reply(404, {'error': 'unknown path'})
                 return
@@ -1273,19 +1379,23 @@ def _make_handler(server: ModelServer):
                 t0 = time.perf_counter()
                 temperature, top_k, seed = self._sampling(req)
                 rid = self._request_id()
+                qos_class = self._qos_class()
                 tokens = server.generate(
                     req['prompt_ids'],
                     int(req.get('max_new_tokens', 16)),
                     temperature, top_k, seed=seed, request_id=rid,
                     route_meta=self._route_meta(),
                     deadline_ms=self._deadline_ms(),
-                    qos_class=self._qos_class(),
+                    qos_class=qos_class,
                     disconnect_probe=self._disconnect_probe())
+                if qos_class == qos_lib.BATCH:
+                    _M_BATCH_ROWS.inc(len(tokens))
                 _maybe_journal_request(
                     'serve_request_done', request_id=rid, status='ok',
                     tokens=sum(len(t) for t in tokens))
                 self._reply(200, {
                     'tokens': tokens,
+                    'weight_version': server.weight_version,
                     'latency_ms': round(
                         (time.perf_counter() - t0) * 1e3, 1),
                 }, {tracing.REQUEST_ID_HEADER: rid})

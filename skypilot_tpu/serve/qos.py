@@ -155,6 +155,49 @@ def router_max_inflight() -> Optional[int]:
     return value if value > 0 else None
 
 
+def queue_wait_p50(hist: Optional[Dict[str, Any]]) -> Optional[float]:
+    """Median queue wait in SECONDS from an engine's queue-wait
+    histogram (scheduler.AdmissionQueue.stats()['queue_wait_hist'],
+    bucket labels like ``'<0.5s'`` / ``'>=5.0s'``).
+
+    Returns the upper bound of the first bucket whose cumulative count
+    reaches half the total — a conservative (upper) median estimate,
+    which is what the router's shed path wants for Retry-After: batch
+    clients back off at least as long as the median admitted request
+    waited.  None when the histogram is missing, empty, or malformed
+    (callers fall back to the static default)."""
+    if not isinstance(hist, dict) or not hist:
+        return None
+    buckets = []
+    overflow = 0
+    try:
+        for label, count in hist.items():
+            count = int(count)
+            if count < 0:
+                return None
+            if label.startswith('<'):
+                buckets.append((float(label[1:].rstrip('s')), count))
+            elif label.startswith('>='):
+                overflow += count
+            else:
+                return None
+    except (ValueError, AttributeError, TypeError):
+        return None
+    buckets.sort()
+    total = sum(c for _, c in buckets) + overflow
+    if total <= 0:
+        return None
+    half = total / 2.0
+    cumulative = 0
+    for upper, count in buckets:
+        cumulative += count
+        if cumulative >= half:
+            return upper
+    # Median sits in the open-ended bucket: its lower bound is the
+    # best defensible estimate (the largest finite bucket edge).
+    return buckets[-1][0] if buckets else None
+
+
 def validate_config(config: Any, where: str) -> None:
     """Spec-time validation for a ``qos:`` block (service_spec calls
     this; raising ValueError surfaces as InvalidTaskError there)."""

@@ -26,6 +26,7 @@ from skypilot_tpu.chaos import faults as chaos_faults
 from skypilot_tpu.chaos import injector as chaos_injector
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.serve import http_protocol
+from skypilot_tpu.serve import qos as qos_lib
 from skypilot_tpu.serve import roles as roles_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
@@ -662,6 +663,12 @@ class ReplicaManager:
                             'page_size': engine.get('page_size'),
                             'prefix_cache_entries': engine.get(
                                 'prefix_cache_entries'),
+                            # Median admission wait (seconds) from the
+                            # engine's queue-wait histogram: the LB's
+                            # QoS shed path stamps Retry-After from it
+                            # so batch backoff tracks real congestion.
+                            'queue_wait_p50': qos_lib.queue_wait_p50(
+                                engine.get('queue_wait_hist')),
                         }
                 except (ValueError, TypeError, ZeroDivisionError):
                     pass
@@ -795,6 +802,7 @@ class ReplicaManager:
                 'load': self._last_load.get(rid, 0.0),
                 'page_size': stats.get('page_size'),
                 'queue_depth': stats.get('queue_depth', 0),
+                'queue_wait_p50': stats.get('queue_wait_p50'),
                 'num_hosts': r.get('num_hosts') or 1,
                 'region': r.get('region'),
             })

@@ -1,0 +1,293 @@
+"""Batch inference (ISSUE 20): sharded manifests, the exactly-once
+shard ledger, the driver's cooperative 429/Retry-After backoff, the
+congestion-derived shed Retry-After stamp, live weight swap, and the
+`jobs queue` PROGRESS plumbing.
+
+The end-to-end crash/resume story (driver killed mid-commit, replica
+killed mid-shard, live swap under interactive load) lives in the
+`batch_resume` chaos scenario (tests/unit/test_chaos.py); this file
+pins the unit seams."""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import pytest
+
+from skypilot_tpu.batch import manifest as manifest_lib
+from skypilot_tpu.batch import runner as runner_lib
+from skypilot_tpu.serve import http_protocol
+
+
+def _write_input(path, n_rows):
+    with open(path, 'w', encoding='utf-8') as f:
+        for i in range(n_rows):
+            f.write(json.dumps({'prompt_ids': [i + 1, 2, 3]}) + '\n')
+
+
+class TestManifest:
+
+    def test_build_and_reload_roundtrip(self, tmp_path):
+        src = str(tmp_path / 'input.jsonl')
+        _write_input(src, 10)
+        run_dir = str(tmp_path / 'run')
+        built = manifest_lib.build_manifest(src, run_dir, num_shards=3)
+        # Contiguous split: 10 rows over 3 shards -> 4, 3, 3.
+        assert built.shard_rows == [4, 3, 3]
+        assert built.total_rows == 10
+        reloaded = manifest_lib.Manifest(run_dir)
+        assert reloaded.num_shards == 3
+        assert reloaded.shard_rows == built.shard_rows
+        rows = list(reloaded.rows(0))
+        assert [idx for idx, _ in rows] == [0, 1, 2, 3]
+        assert rows[0][1]['prompt_ids'] == [1, 2, 3]
+        # Shard 1 continues where shard 0 stopped (source order).
+        assert next(iter(reloaded.rows(1)))[1]['prompt_ids'][0] == 5
+        with pytest.raises(ValueError, match='out of range'):
+            list(reloaded.rows(3))
+
+    def test_more_shards_than_rows_collapses(self, tmp_path):
+        src = str(tmp_path / 'input.jsonl')
+        _write_input(src, 2)
+        built = manifest_lib.build_manifest(
+            src, str(tmp_path / 'run'), num_shards=8)
+        assert built.num_shards == 2
+
+    def test_bad_inputs_rejected(self, tmp_path):
+        bad = str(tmp_path / 'bad.jsonl')
+        with open(bad, 'w', encoding='utf-8') as f:
+            f.write(json.dumps({'no_prompt': 1}) + '\n')
+        with pytest.raises(ValueError, match='prompt'):
+            manifest_lib.build_manifest(bad, str(tmp_path / 'r1'))
+        with open(bad, 'w', encoding='utf-8') as f:
+            f.write('not json\n')
+        with pytest.raises(ValueError, match='bad JSON'):
+            manifest_lib.build_manifest(bad, str(tmp_path / 'r2'))
+        empty = str(tmp_path / 'empty.jsonl')
+        open(empty, 'w', encoding='utf-8').close()
+        with pytest.raises(ValueError, match='no input rows'):
+            manifest_lib.build_manifest(empty, str(tmp_path / 'r3'))
+        with pytest.raises(ValueError, match='not a batch manifest'):
+            manifest_lib.Manifest(str(tmp_path))
+
+
+class TestShardLedger:
+
+    def _built(self, tmp_path, n_rows=6, num_shards=2):
+        src = str(tmp_path / 'input.jsonl')
+        _write_input(src, n_rows)
+        run_dir = str(tmp_path / 'run')
+        return (manifest_lib.build_manifest(src, run_dir,
+                                            num_shards=num_shards),
+                run_dir)
+
+    def test_replay_resumes_committed_rows(self, tmp_path):
+        manifest, run_dir = self._built(tmp_path)
+        ledger = manifest_lib.ShardLedger(run_dir)
+        ledger.commit_row(0, 0, {'tokens': [9]})
+        ledger.commit_row(0, 1, {'tokens': [9]})
+        ledger.commit_row(0, 2, {'tokens': [9]})
+        ledger.finish_shard(0)
+        ledger.commit_row(1, 0, {'tokens': [9]})
+        ledger.close()
+        # A fresh ledger (the resumed driver) sees exactly that state.
+        done_rows, done_shards = manifest_lib.ShardLedger(
+            run_dir).replay()
+        assert done_rows == {(0, 0), (0, 1), (0, 2), (1, 0)}
+        assert done_shards == {0}
+        progress = manifest_lib.ShardLedger(run_dir).progress(manifest)
+        assert progress == {'rows_done': 4, 'rows_total': 6,
+                            'shards_done': 1, 'shards_total': 2}
+
+    def test_torn_ledger_tail_rerun_not_lost(self, tmp_path):
+        _, run_dir = self._built(tmp_path)
+        ledger = manifest_lib.ShardLedger(run_dir)
+        ledger.commit_row(0, 0, {'tokens': [9]})
+        ledger.close()
+        # A crash mid-append leaves a torn trailing line: the row it
+        # named never enters the done-set (it re-runs; never lost).
+        with open(os.path.join(run_dir, manifest_lib.LEDGER_FILE),
+                  'a', encoding='utf-8') as f:
+            f.write('{"kind": "row", "shard": 0, "row_i')
+        done_rows, _ = manifest_lib.ShardLedger(run_dir).replay()
+        assert done_rows == {(0, 0)}
+
+    def test_finalize_dedupes_half_committed_row(self, tmp_path):
+        manifest, run_dir = self._built(tmp_path)
+        ledger = manifest_lib.ShardLedger(run_dir)
+        for shard in range(2):
+            for row_idx, _ in manifest.rows(shard):
+                ledger.commit_row(shard, row_idx, {'tokens': [1]})
+        # The crash seam: output appended, ledger record lost -> the
+        # resumed driver re-ran the row, so the output holds it TWICE.
+        ledger.commit_row(1, 2, {'tokens': [1]})
+        summary = ledger.finalize(manifest)
+        assert summary == {'rows': 6, 'duplicates_dropped': 1}
+        out = manifest_lib.ShardLedger(run_dir).output_rows(manifest)
+        keys = [(r['shard'], r['row_idx']) for r in out]
+        assert len(keys) == 6 and len(set(keys)) == 6
+
+    def test_finalize_refuses_missing_rows(self, tmp_path):
+        manifest, run_dir = self._built(tmp_path)
+        ledger = manifest_lib.ShardLedger(run_dir)
+        ledger.commit_row(0, 0, {'tokens': [1]})
+        with pytest.raises(RuntimeError, match='resume before'):
+            ledger.finalize(manifest)
+
+
+class TestDriverBackoff:
+
+    def test_retry_after_honored_then_success(self, tmp_path):
+        """The cooperative contract: a 429 + Retry-After from the shed
+        path makes the driver back off and retry, not fail the row."""
+        import http.server
+
+        import requests
+
+        src = str(tmp_path / 'input.jsonl')
+        _write_input(src, 1)
+        run_dir = str(tmp_path / 'run')
+        manifest_lib.build_manifest(src, run_dir, num_shards=1)
+        hits = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+
+            def do_POST(self):  # noqa: N802
+                hits.append(self.path)
+                self.rfile.read(
+                    int(self.headers.get('Content-Length', 0)))
+                if len(hits) == 1:
+                    self.send_response(429)
+                    self.send_header('Retry-After', '0')
+                    self.end_headers()
+                    return
+                body = json.dumps({'tokens': [[7, 8]],
+                                   'weight_version': 3,
+                                   'latency_ms': 1.0}).encode()
+                self.send_response(200)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(('127.0.0.1', 0),
+                                                Handler)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        try:
+            job = runner_lib.BatchInferJob(
+                run_dir, f'http://127.0.0.1:{httpd.server_port}',
+                max_new_tokens=2, job_id=None)
+            result = job._post_row(  # pylint: disable=protected-access
+                requests.Session(), {'prompt_ids': [1, 2, 3]})
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+        assert result['tokens'] == [[7, 8]]
+        assert job.retries == 1
+        assert len(hits) == 2
+
+    def test_env_knobs_parse_with_fallbacks(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_BATCH_INFLIGHT', '12')
+        monkeypatch.setenv('SKYTPU_BATCH_MAX_RETRIES', 'nope')
+        monkeypatch.setenv('SKYTPU_BATCH_RETRY_AFTER_CAP_S', '2.5')
+        assert runner_lib.default_inflight() == 12
+        assert runner_lib.max_retries() == 16  # bad value -> default
+        assert runner_lib.retry_after_cap_s() == 2.5
+        monkeypatch.setenv('SKYTPU_BATCH_INFLIGHT', '-3')
+        assert runner_lib.default_inflight() == 4  # non-positive
+
+
+class TestShedRetryAfter:
+
+    def test_queue_wait_p50_unit_pin(self):
+        """Bucket labels are seconds; the estimate is the upper edge of
+        the bucket holding the median — EXACT values, a unit mix-up
+        (ms vs s) breaks this pin."""
+        from skypilot_tpu.serve import qos
+        hist = {'<0.5s': 3, '<1.0s': 2, '>=5.0s': 0}
+        assert qos.queue_wait_p50(hist) == 0.5
+        hist = {'<0.5s': 1, '<2.0s': 1, '<4.0s': 2}
+        assert qos.queue_wait_p50(hist) == 2.0
+        # Median in the open-ended bucket: largest finite edge.
+        assert qos.queue_wait_p50({'<0.5s': 1, '>=5.0s': 9}) == 0.5
+        assert qos.queue_wait_p50(None) is None
+        assert qos.queue_wait_p50({}) is None
+        assert qos.queue_wait_p50({'weird': 1}) is None
+        assert qos.queue_wait_p50({'<0.5s': -1}) is None
+
+    def test_shed_stamp_tracks_worst_replica_p50(self):
+        """The 429 Retry-After stamp: worst ready-replica median queue
+        wait, rounded UP to whole seconds (floor 1s); static default
+        1s when no replica reports a histogram."""
+        from skypilot_tpu.serve import load_balancer as lb_lib
+        from skypilot_tpu.serve import router as router_lib
+        lb = lb_lib.SkyServeLoadBalancer(
+            'http://127.0.0.1:1',
+            router=router_lib.Router(threshold=10))
+        lb.set_replicas([{'url': 'http://a', 'role': 'mixed'},
+                         {'url': 'http://b', 'role': 'mixed'}])
+        assert lb.shed_retry_after_s() == 1
+        lb.set_replicas([
+            {'url': 'http://a', 'role': 'mixed',
+             'queue_wait_p50': 0.3},
+            {'url': 'http://b', 'role': 'mixed',
+             'queue_wait_p50': 2.4},
+        ])
+        assert lb.shed_retry_after_s() == 3  # ceil(2.4), worst wins
+
+
+class TestWeightSwap:
+
+    def test_route_registered(self):
+        assert http_protocol.WEIGHTS_SWAP == '/weights_swap'
+        assert http_protocol.WEIGHTS_SWAP in http_protocol.REPLICA_PATHS
+
+    def test_swap_requires_continuous_batching(self):
+        from skypilot_tpu.serve import model_server
+        srv = model_server.ModelServer('tiny', max_len=32, max_batch=1)
+        with pytest.raises(ValueError, match='continuous-batching'):
+            srv.weights_swap({'checkpoint_dir': '/nowhere'})
+
+    def test_swap_validates_request(self, tmp_path):
+        from skypilot_tpu.serve import model_server
+        srv = model_server.ModelServer(
+            'tiny', max_len=32, max_batch=1, continuous_batching=True,
+            kv_pages=8, page_size=8, prefill_chunk=16)
+        try:
+            with pytest.raises(ValueError, match='checkpoint_dir'):
+                srv.weights_swap({})
+            with pytest.raises(ValueError, match='no checkpoint'):
+                srv.weights_swap({'checkpoint_dir': str(tmp_path)})
+            # swap_params is the engine half: epoch bumps per swap and
+            # the KV pool is untouched (no pages dropped by a swap).
+            engine = srv._engine  # pylint: disable=protected-access
+            before = engine.stats()
+            assert before['weight_epoch'] == 0
+            assert engine.swap_params(srv.params) == 1
+            assert engine.swap_params(srv.params) == 2
+            after = engine.stats()
+            assert after['weight_epoch'] == 2
+            assert after['kv_pages_used'] == before['kv_pages_used']
+        finally:
+            srv.close()
+
+
+class TestJobsProgressColumn:
+
+    def test_set_batch_progress_roundtrip(self, monkeypatch, tmp_path):
+        monkeypatch.setenv('SKYTPU_MANAGED_JOB_DB',
+                           str(tmp_path / 'mj.db'))
+        from skypilot_tpu.jobs import state as jobs_state
+        job_id = jobs_state.allocate_job_id('batchy')
+        records = jobs_state.get_job_records(job_id)
+        # Additive migration: the column exists and starts empty.
+        assert records[0]['batch_progress'] is None
+        jobs_state.set_batch_progress(job_id, 0,
+                                      '1/3 shards (4/10 rows)')
+        records = jobs_state.get_job_records(job_id)
+        assert records[0]['batch_progress'] == '1/3 shards (4/10 rows)'

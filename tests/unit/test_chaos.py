@@ -627,6 +627,27 @@ class TestScenarios:
         assert result.details['itl_p99_s'] <= 2.5
         assert result.details['post_morph_routes'] >= 1
 
+    def test_batch_resume(self, local_infra):
+        """ISSUE 20 acceptance: the batch-infer driver is killed
+        mid-commit (between the output append and the ledger append),
+        one replica dies mid-shard, and a live /weights_swap lands
+        mid-run -> a fresh driver resumes off the shard ledger and
+        completes with exactly-once outputs (batch_exactly_once over
+        the journal); the KV pool and an in-flight interactive
+        request survive the swap."""
+        result = scenarios_lib.run_scenario('batch_resume', seed=20)
+        assert result.ok, (result.violations, result.details)
+        summary = result.details['summary']
+        assert summary['rows_done'] == summary['rows_total']
+        assert summary['duplicates_dropped'] >= 1
+        assert summary['resumed'] is True
+        assert result.details['interactive']['status'] == 200
+        assert result.details['weight_version'] == 1
+        assert result.details['kv_pages_used'] == 0
+        assert result.details['rows_on_new_weights'] >= 1
+        assert [f['site'] for f in result.fault_sequence] == \
+            ['batch.shard_write']
+
     def test_error_spike(self, local_infra):
         """ISSUE 19 chaos satellite: a rank death floods the replica's
         WARN/ERROR log rate -> the fleet log plane journals
